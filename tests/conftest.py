@@ -1,0 +1,40 @@
+"""Test harness: force JAX onto CPU with a virtual 8-device mesh so all
+parallelism (tp/sp/cfg/dp) is exercised without TPU hardware — the TPU-native
+upgrade of the reference's fake-process-group trick
+(tests/diffusion/distributed/test_parallel_state_sp_groups.py:20-56), which
+could only test group *construction*; a virtual CPU mesh tests collective
+*numerics* too.
+"""
+
+import os
+
+# Hard override: the surrounding environment may pin JAX to a real TPU
+# backend (e.g. JAX_PLATFORMS=axon, initialized eagerly by sitecustomize);
+# unit tests always run on the virtual CPU mesh, so re-point the platform
+# and clear any already-initialized backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("OMNI_TPU_PALLAS_INTERPRET", "1")
+
+import jax  # noqa: E402
+import jax.extend.backend  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.extend.backend.clear_backends()
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)

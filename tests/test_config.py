@@ -1,0 +1,76 @@
+import os
+import textwrap
+
+import pytest
+
+from vllm_omni_tpu.config import (
+    OmniDiffusionConfig,
+    OmniModelConfig,
+    load_stage_configs_from_yaml,
+)
+from vllm_omni_tpu.config.stage import load_stage_configs_from_model
+
+
+def test_model_config_from_kwargs_filters_extra():
+    cfg = OmniModelConfig.from_kwargs(
+        model="m", max_model_len=128, not_a_field=7
+    )
+    assert cfg.max_model_len == 128
+    assert cfg.extra == {"not_a_field": 7}
+
+
+def test_diffusion_config_parallel_dict():
+    cfg = OmniDiffusionConfig.from_kwargs(
+        model="qwen-image", parallel={"tp": 2, "ulysses": 2}
+    )
+    assert cfg.parallel.tensor_parallel_size == 2
+    assert cfg.parallel.sequence_parallel_size == 2
+
+
+def test_stage_yaml_roundtrip(tmp_path):
+    y = textwrap.dedent(
+        """
+        stage_args:
+          - stage_id: 0
+            stage_type: llm
+            runtime: {max_batch_size: 8, batch_timeout: 0.05}
+            engine_args: {model: thinker, max_model_len: 512}
+            engine_input_source: -1
+            output_connectors:
+              "1": {connector: shm}
+          - stage_id: 1
+            stage_type: llm
+            engine_args: {model: talker}
+            engine_input_source: [0]
+            final_output: true
+            final_output_type: audio
+        """
+    )
+    p = tmp_path / "pipe.yaml"
+    p.write_text(y)
+    stages = load_stage_configs_from_yaml(str(p))
+    assert len(stages) == 2
+    assert stages[0].runtime.max_batch_size == 8
+    assert stages[0].engine_input_source == [-1]
+    assert stages[0].output_connectors["1"]["connector"] == "shm"
+    assert stages[1].final_output and stages[1].final_output_type == "audio"
+
+
+def test_stage_yaml_rejects_bad_ids(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("stage_args:\n  - {stage_id: 1, stage_type: llm}\n")
+    with pytest.raises(ValueError):
+        load_stage_configs_from_yaml(str(p))
+
+
+def test_default_single_stage():
+    stages = load_stage_configs_from_model("some/unknown-model")
+    assert len(stages) == 1 and stages[0].final_output
+
+
+def test_diffusion_autodetect(tmp_path):
+    d = tmp_path / "model"
+    d.mkdir()
+    (d / "model_index.json").write_text("{}")
+    stages = load_stage_configs_from_model(str(d))
+    assert stages[0].stage_type == "diffusion"

@@ -1,0 +1,66 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from vllm_omni_tpu.parallel import MESH_AXES, MeshConfig, build_mesh
+from vllm_omni_tpu.parallel.sharding import (
+    pad_to_multiple,
+    seq_sharded,
+    sp_pad_len,
+    tp_col_sharded,
+)
+
+
+def test_mesh_axis_order_and_sizes(devices8):
+    cfg = MeshConfig(data_parallel_size=2, tensor_parallel_size=4)
+    mesh = build_mesh(cfg, devices8)
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+    # tp is innermost: tp neighbours are adjacent device ids (ICI locality,
+    # mirroring the reference's "tp fastest" rank order).
+    arr = np.asarray(mesh.devices).reshape(2, 4)
+    ids = [[d.id for d in row] for row in arr]
+    assert ids[0] == sorted(ids[0])
+
+
+def test_mesh_validation():
+    cfg = MeshConfig(tensor_parallel_size=3)
+    with pytest.raises(ValueError):
+        cfg.validate(8)
+    with pytest.raises(ValueError):
+        MeshConfig(cfg_parallel_size=4).validate(4)
+    MeshConfig(cfg_parallel_size=2, ulysses_degree=2, ring_degree=2).validate(8)
+
+
+def test_mesh_config_from_dict_aliases():
+    cfg = MeshConfig.from_dict(
+        {"tp": 2, "ulysses_degree": 2, "ring": 2, "dp": 1}
+    )
+    assert cfg.tensor_parallel_size == 2
+    assert cfg.sequence_parallel_size == 4
+    # bare sequence_parallel_size defaults to all-ulysses
+    cfg2 = MeshConfig.from_dict({"sequence_parallel_size": 4})
+    assert cfg2.ulysses_degree == 4 and cfg2.ring_degree == 1
+    with pytest.raises(ValueError):
+        MeshConfig.from_dict({"sequence_parallel_size": 4, "ulysses": 2})
+
+
+def test_sp_sharding_roundtrip(devices8):
+    cfg = MeshConfig(ulysses_degree=2, ring_degree=2, tensor_parallel_size=2)
+    mesh = build_mesh(cfg, devices8)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    xs = jax.device_put(x, seq_sharded(mesh))
+    assert np.allclose(np.asarray(xs), np.asarray(x))
+    w = jnp.ones((4, 6), jnp.float32)
+    ws = jax.device_put(w, tp_col_sharded(mesh))
+    y = jax.jit(lambda a, b: a @ b)(xs, ws)
+    assert y.shape == (2, 8, 6)
+
+
+def test_sp_padding():
+    assert sp_pad_len(10, 4) == 2
+    assert sp_pad_len(8, 4) == 0
+    x = jnp.ones((2, 10, 3))
+    assert pad_to_multiple(x, 1, 4).shape == (2, 12, 3)

@@ -1,0 +1,35 @@
+"""vllm_omni_tpu — a TPU-native omni-modality inference & serving framework.
+
+Brand-new JAX/XLA/Pallas implementation with the capabilities of the
+vLLM-Omni reference (see SURVEY.md): autoregressive engines with continuous
+batching over a paged KV cache, Diffusion-Transformer engines, multi-stage
+heterogeneous pipelines with disaggregated stage transfer, and an
+OpenAI-compatible serving layer — all with no GPU/CUDA in the loop.
+"""
+
+from vllm_omni_tpu.version import __version__
+
+__all__ = [
+    "__version__",
+    "Omni",
+    "OmniModelConfig",
+    "OmniDiffusionConfig",
+]
+
+
+def __getattr__(name):
+    # Lazy top-level exports (reference: vllm_omni/__init__.py:24-43) so
+    # `import vllm_omni_tpu` stays light for kernel-only users.
+    if name == "Omni":
+        from vllm_omni_tpu.entrypoints.omni import Omni
+
+        return Omni
+    if name == "OmniModelConfig":
+        from vllm_omni_tpu.config.model import OmniModelConfig
+
+        return OmniModelConfig
+    if name == "OmniDiffusionConfig":
+        from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+
+        return OmniDiffusionConfig
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,15 @@
+from vllm_omni_tpu.config.model import OmniModelConfig
+from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+from vllm_omni_tpu.config.stage import (
+    StageConfig,
+    load_stage_configs_from_model,
+    load_stage_configs_from_yaml,
+)
+
+__all__ = [
+    "OmniModelConfig",
+    "OmniDiffusionConfig",
+    "StageConfig",
+    "load_stage_configs_from_model",
+    "load_stage_configs_from_yaml",
+]

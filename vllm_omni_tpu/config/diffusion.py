@@ -1,0 +1,56 @@
+"""Diffusion engine configuration (reference: ``OmniDiffusionConfig``,
+vllm_omni/diffusion/data.py:245-385, and ``DiffusionParallelConfig``
+data.py:28-52)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from vllm_omni_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass
+class OmniDiffusionConfig:
+    model: str = ""
+    model_arch: str = ""  # pipeline class key in DiffusionModelRegistry
+    dtype: str = "auto"
+    seed: int = 0
+
+    # attention backend override ("auto" => platform pick)
+    attention_backend: str = "auto"
+
+    # step-cache acceleration (reference: cache/base.py:31 + selector):
+    # "" => off; "teacache" | "residual" ...
+    cache_backend: str = ""
+    cache_config: dict[str, Any] = field(default_factory=dict)
+
+    # parallel degrees (dp/cfg/sp=ulysses*ring/pp/tp)
+    parallel: MeshConfig = field(default_factory=MeshConfig)
+    # VAE spatial patch parallel degree (reference: data.py:52)
+    vae_patch_parallel_size: int = 1
+
+    # host offload of weights between stage invocations (reference sleep
+    # mode via CuMemAllocator, diffusion_worker.py:204-271 -> TPU host
+    # offload via device_put)
+    enable_sleep_mode: bool = False
+
+    # quantization: "" | "int8" | "fp8"
+    quantization: str = ""
+
+    # default generation geometry
+    default_height: int = 1024
+    default_width: int = 1024
+    default_num_inference_steps: int = 50
+
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_kwargs(cls, **kwargs: Any) -> "OmniDiffusionConfig":
+        fields = cls.__dataclass_fields__
+        if "parallel" in kwargs and isinstance(kwargs["parallel"], dict):
+            kwargs["parallel"] = MeshConfig.from_dict(kwargs["parallel"])
+        known = {k: v for k, v in kwargs.items() if k in fields and k != "extra"}
+        extra = {k: v for k, v in kwargs.items() if k not in fields}
+        extra.update(kwargs.get("extra") or {})
+        return cls(**known, extra=extra)

@@ -1,0 +1,96 @@
+"""Model / engine configuration.
+
+``OmniModelConfig`` covers the reference's ``OmniModelConfig``
+(vllm_omni/config/model.py:18,46-60): per-stage identity (stage_id,
+model_stage), worker type (ar vs one-shot generation vs diffusion), the
+engine output type flowing to the next stage, sub-config selection for
+multi-part HF checkpoints, and cross-stage connector/KV config.  It also
+absorbs the slice of vLLM's ``ModelConfig``/``EngineArgs`` the reference
+leans on (max_model_len, dtype, kv-cache geometry) since there is no
+upstream vllm dependency here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "auto": None,
+}
+
+
+def resolve_dtype(name: Optional[str]):
+    if name is None or name == "auto":
+        from vllm_omni_tpu.platforms import current_platform
+
+        return current_platform().preferred_dtype()
+    if isinstance(name, str):
+        return _DTYPES[name]
+    return name
+
+
+@dataclass
+class OmniModelConfig:
+    # --- identity -----------------------------------------------------
+    model: str = ""  # model name or local path
+    stage_id: int = 0
+    # thinker / talker / code2wav / dit / text_encoder / vae ...
+    model_stage: str = ""
+    model_arch: str = ""  # architecture key into the model registry
+    # "ar" (continuous batching) | "generation" (one-shot) | "diffusion"
+    worker_type: str = "ar"
+    # what the engine emits for the next stage / user:
+    # "text" | "latent" | "audio" | "image" | "embedding" | "token_ids"
+    engine_output_type: str = "text"
+    # sub-config name inside a multi-part HF checkpoint
+    # (reference: hf_config_name, config/model.py:52)
+    hf_config_name: str = ""
+
+    # --- engine geometry ---------------------------------------------
+    dtype: str = "auto"
+    seed: int = 0
+    max_model_len: int = 4096
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 2048
+    block_size: int = 16  # paged-KV block (tokens per page)
+    num_kv_cache_blocks: Optional[int] = None  # None => auto from memory
+    gpu_memory_utilization: float = 0.9  # kept for CLI parity; HBM fraction
+    enforce_eager: bool = False
+
+    # --- parallel -----------------------------------------------------
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    prefill_context_parallel_size: int = 1
+    expert_parallel_size: int = 1
+
+    # --- cross-stage --------------------------------------------------
+    stage_connector_config: dict[str, Any] = field(default_factory=dict)
+    omni_kv_config: dict[str, Any] = field(default_factory=dict)
+    async_chunk: bool = False
+
+    # --- escape hatch for per-arch extras ----------------------------
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def jax_dtype(self):
+        return resolve_dtype(self.dtype)
+
+    @classmethod
+    def from_kwargs(cls, **kwargs: Any) -> "OmniModelConfig":
+        """Filtering constructor in the style of the reference's
+        ``OmniDiffusionConfig.from_kwargs`` (diffusion/data.py:~500):
+        known keys become fields, the rest land in ``extra``."""
+        fields = cls.__dataclass_fields__
+        known = {k: v for k, v in kwargs.items() if k in fields and k != "extra"}
+        extra = {k: v for k, v in kwargs.items() if k not in fields}
+        extra.update(kwargs.get("extra") or {})
+        return cls(**known, extra=extra)

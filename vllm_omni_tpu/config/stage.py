@@ -1,0 +1,155 @@
+"""Declarative multi-stage pipeline configuration.
+
+Reimplements the reference's stage-config YAML system
+(vllm_omni/model_executor/stage_configs/*.yaml, e.g. qwen3_omni_moe.yaml:8-101,
+loaded by entrypoints/utils.py ``load_stage_configs_from_model`` /
+``load_stage_configs_from_yaml`` / ``resolve_model_config_path``).
+
+Schema (YAML):
+
+.. code-block:: yaml
+
+    stage_args:
+      - stage_id: 0
+        stage_type: llm            # llm | diffusion
+        runtime:
+          devices: "0"             # device ids for this stage
+          max_batch_size: 8
+          batch_timeout: 0.05
+        engine_args: { ... }       # OmniModelConfig / OmniDiffusionConfig kwargs
+        engine_input_source: [-1]  # stage ids feeding this stage (-1 = user)
+        custom_process_input_func: "pkg.mod:fn"   # optional
+        final_output: true
+        final_output_type: text
+        default_sampling_params: { ... }
+        output_connectors: { "1": {connector: shm} }
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import yaml
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# In-tree stage configs directory (analogue of
+# vllm_omni/model_executor/stage_configs/).
+_STAGE_CONFIG_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "models",
+    "stage_configs",
+)
+
+
+@dataclass
+class StageRuntime:
+    devices: str = "all"  # "all" | comma-separated local device ids
+    max_batch_size: int = 1
+    batch_timeout: float = 0.0
+
+
+@dataclass
+class StageConfig:
+    stage_id: int
+    stage_type: str  # "llm" | "diffusion"
+    runtime: StageRuntime = field(default_factory=StageRuntime)
+    engine_args: dict[str, Any] = field(default_factory=dict)
+    # stage ids whose outputs feed this stage; -1 means the user prompt
+    engine_input_source: list[int] = field(default_factory=lambda: [-1])
+    custom_process_input_func: str = ""
+    final_output: bool = False
+    final_output_type: str = "text"
+    default_sampling_params: dict[str, Any] = field(default_factory=dict)
+    # next_stage_id(str) -> connector spec dict
+    output_connectors: dict[str, dict[str, Any]] = field(default_factory=dict)
+
+    def resolve_input_processor(self) -> Optional[Callable]:
+        """Import the ``pkg.mod:fn`` hook deriving this stage's inputs from
+        upstream outputs (reference: custom_process_input_func in stage YAML,
+        e.g. stage_input_processors/qwen3_omni.py)."""
+        if not self.custom_process_input_func:
+            return None
+        mod_name, _, fn_name = self.custom_process_input_func.partition(":")
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, fn_name)
+
+
+def _parse_stage(d: dict[str, Any]) -> StageConfig:
+    d = dict(d)
+    runtime = d.pop("runtime", {}) or {}
+    known = StageConfig.__dataclass_fields__
+    unknown = [k for k in d if k not in known]
+    if unknown:
+        raise KeyError(f"unknown stage config keys: {unknown}")
+    eis = d.pop("engine_input_source", [-1])
+    if isinstance(eis, int):
+        eis = [eis]
+    oc = d.pop("output_connectors", {}) or {}
+    oc = {str(k): dict(v) for k, v in oc.items()}
+    return StageConfig(
+        runtime=StageRuntime(**runtime),
+        engine_input_source=[int(x) for x in eis],
+        output_connectors=oc,
+        **d,
+    )
+
+
+def load_stage_configs_from_yaml(path: str) -> list[StageConfig]:
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict) or "stage_args" not in doc:
+        raise ValueError(f"{path}: expected top-level 'stage_args' list")
+    stages = [_parse_stage(s) for s in doc["stage_args"]]
+    ids = [s.stage_id for s in stages]
+    if sorted(ids) != list(range(len(stages))):
+        raise ValueError(f"{path}: stage_ids must be 0..N-1, got {ids}")
+    stages.sort(key=lambda s: s.stage_id)
+    if not any(s.final_output for s in stages):
+        stages[-1].final_output = True
+    return stages
+
+
+def resolve_model_config_path(model: str) -> Optional[str]:
+    """Map a model name/path to an in-tree stage YAML (reference:
+    entrypoints/utils.py resolve_model_config_path)."""
+    base = os.path.basename(os.path.normpath(model)).lower().replace("-", "_")
+    candidates = [base, base.replace(".", "_")]
+    for cand in candidates:
+        p = os.path.join(_STAGE_CONFIG_DIR, cand + ".yaml")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def load_stage_configs_from_model(
+    model: str, stage_configs_path: Optional[str] = None
+) -> list[StageConfig]:
+    """Load stage configs for a model: explicit path wins, then the in-tree
+    YAML for the model name, else a single-stage default (llm)."""
+    if stage_configs_path:
+        return load_stage_configs_from_yaml(stage_configs_path)
+    p = resolve_model_config_path(model)
+    if p is not None:
+        logger.info("Using stage config %s for model %s", p, model)
+        return load_stage_configs_from_yaml(p)
+    # Single-stage default, like the reference's diffusion autodetect
+    # (cli/serve.py:55-63): model_index.json => diffusion.
+    stage_type = "llm"
+    if os.path.isdir(model) and os.path.exists(
+        os.path.join(model, "model_index.json")
+    ):
+        stage_type = "diffusion"
+    return [
+        StageConfig(
+            stage_id=0,
+            stage_type=stage_type,
+            engine_args={"model": model},
+            final_output=True,
+        )
+    ]

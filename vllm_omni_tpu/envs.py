@@ -1,0 +1,55 @@
+"""Environment-variable registry.
+
+Equivalent in role to the reference's ``vllm_omni/diffusion/envs.py:19`` env
+registry: one module that owns every environment knob, with typed accessors,
+so flags are discoverable and greppable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+# name -> (default, parser)
+_ENV_REGISTRY: dict[str, tuple[str, Callable[[str], object]]] = {}
+
+
+def _register(name: str, default: str, parser: Callable[[str], object]):
+    _ENV_REGISTRY[name] = (default, parser)
+
+
+def _get(name: str):
+    default, parser = _ENV_REGISTRY[name]
+    return parser(os.environ.get(name, default))
+
+
+_bool = lambda s: s.lower() in ("1", "true", "yes", "on")
+
+# Attention backend override for DiT stages (reference:
+# DIFFUSION_ATTENTION_BACKEND, attention/selector.py:77). Values:
+# "pallas_flash", "xla", "auto".
+_register("OMNI_TPU_DIFFUSION_ATTENTION_BACKEND", "auto", str)
+# Attention backend for AR paged attention: "pallas_paged", "xla", "auto".
+_register("OMNI_TPU_AR_ATTENTION_BACKEND", "auto", str)
+# Force interpret mode for pallas kernels (CPU testing).
+_register("OMNI_TPU_PALLAS_INTERPRET", "0", _bool)
+# Directory for jax profiler traces (reference: VLLM_TORCH_PROFILER_DIR).
+_register("OMNI_TPU_PROFILER_DIR", "", str)
+# Stats jsonl output (reference: --log-stats).
+_register("OMNI_TPU_STATS_DIR", "", str)
+# Connector backend default for single-node stage transfer.
+_register("OMNI_TPU_CONNECTOR", "shm", str)
+# Per-stage logging prefix.
+_register("OMNI_TPU_LOGGING_PREFIX", "", str)
+# RNG seed default.
+_register("OMNI_TPU_SEED", "0", int)
+
+
+def __getattr__(name: str):
+    if name in _ENV_REGISTRY:
+        return _get(name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def env_names() -> list[str]:
+    return sorted(_ENV_REGISTRY)

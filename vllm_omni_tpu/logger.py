@@ -1,0 +1,39 @@
+"""Logging for the TPU-native omni framework.
+
+Mirrors the behaviour of the reference's logger (vllm_omni/logger.py): a
+package-scoped logger with an optional per-stage prefix taken from the
+environment, so logs from disaggregated stage processes are distinguishable.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(levelname)s %(asctime)s [%(name)s] %(message)s"
+_DATEFMT = "%m-%d %H:%M:%S"
+
+_initialized = False
+
+
+def _init_root() -> None:
+    global _initialized
+    if _initialized:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    prefix = os.environ.get("OMNI_TPU_LOGGING_PREFIX", "")
+    handler.setFormatter(logging.Formatter(prefix + _FORMAT, datefmt=_DATEFMT))
+    root = logging.getLogger("vllm_omni_tpu")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("OMNI_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    """Return a logger under the package hierarchy (``vllm_omni_tpu.*``)."""
+    _init_root()
+    if not name.startswith("vllm_omni_tpu"):
+        name = "vllm_omni_tpu." + name
+    return logging.getLogger(name)

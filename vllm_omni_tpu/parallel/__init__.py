@@ -1,0 +1,25 @@
+from vllm_omni_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_CFG,
+    AXIS_PP,
+    AXIS_RING,
+    AXIS_TP,
+    AXIS_ULYSSES,
+    MESH_AXES,
+    MeshConfig,
+    build_mesh,
+    single_device_mesh,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_CFG",
+    "AXIS_PP",
+    "AXIS_RING",
+    "AXIS_TP",
+    "AXIS_ULYSSES",
+    "MESH_AXES",
+    "MeshConfig",
+    "build_mesh",
+    "single_device_mesh",
+]

@@ -1,0 +1,169 @@
+"""Device-mesh construction — the TPU-native analogue of the reference's
+``initialize_model_parallel`` (reference: vllm_omni/diffusion/distributed/
+parallel_state.py:624 and RankGenerator order "tp-sp-pp-cfg-dp" at :170).
+
+Where the reference builds N orthogonal NCCL process-group families
+(DP x CFG x SP(ulysses x ring) x PP x TP) and a 938-LoC GroupCoordinator on
+top, the TPU-native design is a single ``jax.sharding.Mesh`` with one named
+axis per parallelism strategy.  XLA inserts the collectives:
+
+=============== ======================= =============================
+reference group mesh axis               collective mechanism
+=============== ======================= =============================
+_TP             ``tp``                  psum / sharded matmul (pjit)
+_SP ulysses     ``ulysses``             lax.all_to_all over heads/seq
+_SP ring        ``ring``                lax.ppermute blockwise KV
+_CFG            ``cfg``                 pbroadcast/psum combine
+_PP             ``pp``                  ppermute microbatch handoff
+_DP             ``dp``                  fully-replicated params, batch shard
+=============== ======================= =============================
+
+Axis ordering matters for ICI locality: JAX lays devices out with the *last*
+mesh axis fastest-varying, so ``tp`` (highest-bandwidth collectives) occupies
+adjacent devices, mirroring the reference's "tp fastest" rank order
+(parallel_state.py:170).  ``dp`` is outermost — suitable for the DCN boundary
+on multi-slice deployments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_CFG = "cfg"
+AXIS_PP = "pp"
+AXIS_RING = "ring"
+AXIS_ULYSSES = "ulysses"
+AXIS_TP = "tp"
+
+# Outermost -> innermost (innermost varies fastest over the device list).
+MESH_AXES: tuple[str, ...] = (
+    AXIS_DP,
+    AXIS_CFG,
+    AXIS_PP,
+    AXIS_RING,
+    AXIS_ULYSSES,
+    AXIS_TP,
+)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Parallel degrees for one stage.
+
+    Field-for-field coverage of the reference's ``DiffusionParallelConfig``
+    (vllm_omni/diffusion/data.py:28-52): data/cfg/sequence(=ulysses x ring)/
+    pipeline/tensor parallel sizes.  ``sequence_parallel_size`` in the
+    reference is the product ``ulysses_degree * ring_degree``
+    (validated at parallel_state.py:688-699); here the factors are explicit.
+    """
+
+    data_parallel_size: int = 1
+    cfg_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    ring_degree: int = 1
+    ulysses_degree: int = 1
+    tensor_parallel_size: int = 1
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.ring_degree * self.ulysses_degree
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.cfg_parallel_size
+            * self.pipeline_parallel_size
+            * self.ring_degree
+            * self.ulysses_degree
+            * self.tensor_parallel_size
+        )
+
+    @property
+    def axis_sizes(self) -> tuple[int, ...]:
+        return (
+            self.data_parallel_size,
+            self.cfg_parallel_size,
+            self.pipeline_parallel_size,
+            self.ring_degree,
+            self.ulysses_degree,
+            self.tensor_parallel_size,
+        )
+
+    def validate(self, n_devices: int) -> None:
+        for name, size in zip(MESH_AXES, self.axis_sizes):
+            if size < 1:
+                raise ValueError(f"mesh axis {name!r} must be >=1, got {size}")
+        if self.cfg_parallel_size not in (1, 2):
+            # CFG parallel = positive/negative guidance branch split
+            # (reference: distributed/cfg_parallel.py:21; data.py:49).
+            raise ValueError(
+                f"cfg_parallel_size must be 1 or 2, got {self.cfg_parallel_size}"
+            )
+        if self.world_size != n_devices:
+            raise ValueError(
+                f"mesh degrees {dict(zip(MESH_AXES, self.axis_sizes))} "
+                f"require {self.world_size} devices, have {n_devices}"
+            )
+
+    @staticmethod
+    def from_dict(d: dict) -> "MeshConfig":
+        """Accept both our names and the reference's stage-YAML spellings."""
+        alias = {
+            "dp": "data_parallel_size",
+            "cfg": "cfg_parallel_size",
+            "pp": "pipeline_parallel_size",
+            "tp": "tensor_parallel_size",
+            "ulysses": "ulysses_degree",
+            "ring": "ring_degree",
+            "sequence_parallel_size": None,  # handled below
+        }
+        kwargs: dict[str, int] = {}
+        sp: Optional[int] = None
+        for k, v in d.items():
+            if k == "sequence_parallel_size":
+                sp = int(v)
+            elif k in alias and alias[k]:
+                kwargs[alias[k]] = int(v)
+            elif k in MeshConfig.__dataclass_fields__:
+                kwargs[k] = int(v)
+            else:
+                raise KeyError(f"unknown parallel config key {k!r}")
+        cfg = MeshConfig(**kwargs)
+        if sp is not None and cfg.sequence_parallel_size != sp:
+            if cfg.ring_degree == 1 and cfg.ulysses_degree == 1:
+                # Bare sequence_parallel_size defaults to all-ulysses, the
+                # same default the reference applies (data.py:40-46).
+                cfg = MeshConfig(
+                    **{**kwargs, "ulysses_degree": sp, "ring_degree": 1}
+                )
+            else:
+                raise ValueError(
+                    "sequence_parallel_size "
+                    f"{sp} != ulysses*ring {cfg.sequence_parallel_size}"
+                )
+        return cfg
+
+
+def build_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the stage mesh over the given devices (default: all local)."""
+    if devices is None:
+        devices = jax.devices()
+    config.validate(len(devices))
+    dev_array = np.asarray(devices).reshape(config.axis_sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    if device is None:
+        device = jax.devices()[0]
+    return build_mesh(MeshConfig(), [device])

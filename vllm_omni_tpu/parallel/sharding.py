@@ -1,0 +1,81 @@
+"""Sharding helpers: NamedSharding constructors + sequence shard/gather.
+
+Replaces the reference's hook-based SP sharding utilities
+(vllm_omni/diffusion/distributed/sp_sharding.py:27,74,104 — sp_shard /
+sp_gather / sp_shard_with_padding) with compiler-visible shardings: instead
+of torch forward hooks slicing tensors per rank, we annotate arrays with
+``NamedSharding`` / use ``shard_map`` and let XLA partition.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vllm_omni_tpu.parallel.mesh import (
+    AXIS_RING,
+    AXIS_TP,
+    AXIS_ULYSSES,
+)
+
+# The sequence axis of DiT activations is sharded over both SP factors;
+# equivalent to the reference's ulysses x ring decomposition of
+# sequence_parallel_size (parallel_state.py:477-622).
+SP_AXES = (AXIS_RING, AXIS_ULYSSES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def seq_sharded(mesh: Mesh, seq_dim: int = 1, ndim: int = 3) -> NamedSharding:
+    """Activation sharding with the sequence dimension split over SP axes.
+
+    Default layout [batch, seq, hidden] matches DiT hidden states.
+    """
+    spec = [None] * ndim
+    spec[seq_dim] = SP_AXES
+    return NamedSharding(mesh, P(*spec))
+
+
+def heads_sharded(mesh: Mesh, head_dim_index: int = 2, ndim: int = 4) -> NamedSharding:
+    """Attention-layout sharding [batch, seq, heads, head_dim] with heads
+    split over the ulysses axis — the post-all-to-all layout of Ulysses SP
+    (reference: attention/parallel/ulysses.py:29)."""
+    spec: list = [None] * ndim
+    spec[head_dim_index] = AXIS_ULYSSES
+    return NamedSharding(mesh, P(*spec))
+
+
+def tp_col_sharded(mesh: Mesh) -> NamedSharding:
+    """Column-parallel weight [in, out]: out split over tp."""
+    return NamedSharding(mesh, P(None, AXIS_TP))
+
+
+def tp_row_sharded(mesh: Mesh) -> NamedSharding:
+    """Row-parallel weight [in, out]: in split over tp."""
+    return NamedSharding(mesh, P(AXIS_TP, None))
+
+
+def sp_pad_len(seq_len: int, sp_size: int) -> int:
+    """Padding needed so the sequence divides the SP degree; mirrors
+    sp_shard_with_padding (sp_sharding.py:104)."""
+    return (-seq_len) % sp_size
+
+
+def pad_to_multiple(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def with_sharding(x: jax.Array, sharding: Optional[NamedSharding]) -> jax.Array:
+    if sharding is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, sharding)

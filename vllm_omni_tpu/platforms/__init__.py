@@ -1,0 +1,49 @@
+"""Platform resolution (reference: vllm_omni/platforms/__init__.py:153-165
+``current_omni_platform`` lazy singleton).
+
+On the reference, platform detection probes NVML/amdsmi/torch to pick
+CUDA/ROCm/XPU/NPU.  Here the platforms are the JAX backends: TPU when a TPU
+is attached, CPU otherwise (used for unit tests with a virtual device mesh).
+Entry-point plugins can still override via ``register_platform``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from vllm_omni_tpu.platforms.interface import OmniPlatform
+
+_current: Optional[OmniPlatform] = None
+_registered: dict[str, type[OmniPlatform]] = {}
+
+
+def register_platform(name: str, cls: type[OmniPlatform]) -> None:
+    _registered[name] = cls
+
+
+def _detect() -> OmniPlatform:
+    import jax
+
+    backend = jax.default_backend()
+    if backend in _registered:
+        return _registered[backend]()
+    if backend == "tpu" or backend.startswith("axon"):
+        from vllm_omni_tpu.platforms.tpu import TpuPlatform
+
+        return TpuPlatform()
+    from vllm_omni_tpu.platforms.cpu import CpuPlatform
+
+    return CpuPlatform()
+
+
+def current_platform() -> OmniPlatform:
+    global _current
+    if _current is None:
+        _current = _detect()
+    return _current
+
+
+def reset_platform() -> None:
+    """Testing hook."""
+    global _current
+    _current = None

@@ -1,0 +1,35 @@
+"""Platform interface (reference: vllm_omni/platforms/interface.py:20
+``OmniPlatform`` — per-platform worker classes, attention-backend selection,
+device ops, default stage-config path)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class OmniPlatform(ABC):
+    name: str = "abstract"
+    # Whether pallas kernels compile natively (TPU) or must run in
+    # interpret mode (CPU tests).
+    supports_pallas: bool = False
+
+    @abstractmethod
+    def ar_attention_backend(self) -> str:
+        """Backend name for AR paged attention ("pallas_paged" | "xla")."""
+
+    @abstractmethod
+    def diffusion_attention_backend(self) -> str:
+        """Backend name for DiT attention ("pallas_flash" | "xla")."""
+
+    def device_count(self) -> int:
+        import jax
+
+        return jax.local_device_count()
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<OmniPlatform {self.name}>"

@@ -1,0 +1,24 @@
+"""TPU platform (role of reference's platforms/cuda/platform.py:15 — picks
+worker classes and attention backends per device capability)."""
+
+from __future__ import annotations
+
+from vllm_omni_tpu import envs
+from vllm_omni_tpu.platforms.interface import OmniPlatform
+
+
+class TpuPlatform(OmniPlatform):
+    name = "tpu"
+    supports_pallas = True
+
+    def ar_attention_backend(self) -> str:
+        override = envs.OMNI_TPU_AR_ATTENTION_BACKEND
+        if override != "auto":
+            return override
+        return "pallas_paged"
+
+    def diffusion_attention_backend(self) -> str:
+        override = envs.OMNI_TPU_DIFFUSION_ATTENTION_BACKEND
+        if override != "auto":
+            return override
+        return "pallas_flash"
