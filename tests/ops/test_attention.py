@@ -1,0 +1,76 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.ops import attention_ref, flash_attention
+
+
+def _np_attention(q, k, v, causal=False, scale=None):
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    k = np.repeat(k, g, axis=2)
+    v = np.repeat(v, g, axis=2)
+    scale = scale or 1.0 / np.sqrt(d)
+    s = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float64), k.astype(np.float64)) * scale
+    if causal:
+        skv = k.shape[1]
+        qi = np.arange(sq)[:, None]
+        ki = np.arange(skv)[None, :]
+        s = np.where(qi + (skv - sq) >= ki, s, -1e30)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v.astype(np.float64))
+
+
+def _mk(rng, b, sq, skv, h, hkv, d):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, sq, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, skv, hkv, d), jnp.float32)
+    v = jax.random.normal(k3, (b, skv, hkv, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize(
+    "b,sq,skv,h,hkv,d",
+    [
+        (1, 32, 32, 2, 2, 64),
+        (2, 17, 33, 4, 2, 64),  # ragged + GQA
+        (1, 8, 40, 4, 4, 128),  # q aligned to kv suffix (prefix cache)
+    ],
+)
+def test_flash_vs_numpy(rng, causal, b, sq, skv, h, hkv, d):
+    q, k, v = _mk(rng, b, sq, skv, h, hkv, d)
+    want = _np_attention(
+        np.asarray(q), np.asarray(k), np.asarray(v), causal=causal
+    )
+    ref = attention_ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), want, atol=2e-5)
+    got = flash_attention(
+        q, k, v, causal=causal, block_q=16, block_k=16, use_pallas=True
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-3, rtol=1e-3)
+
+
+def test_flash_lse(rng):
+    q, k, v = _mk(rng, 1, 16, 16, 2, 2, 64)
+    o_ref, lse_ref = attention_ref(q, k, v, return_lse=True)
+    o, lse = flash_attention(
+        q, k, v, return_lse=True, block_q=8, block_k=8, use_pallas=True
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=2e-3)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref), atol=1e-3)
+    assert lse.shape == (1, 2, 16)
+
+
+def test_flash_bf16(rng):
+    q, k, v = _mk(rng, 1, 32, 32, 2, 2, 64)
+    q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    want = attention_ref(q, k, v)
+    got = flash_attention(q, k, v, block_q=16, block_k=16, use_pallas=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+    )

@@ -1,0 +1,96 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.ops import (
+    apply_rope,
+    apply_rope_ref,
+    compute_mrope_freqs,
+    compute_rope_freqs,
+    rms_norm,
+    rms_norm_ref,
+    silu_mul,
+)
+
+
+def _np_rmsnorm(x, w, eps):
+    xf = x.astype(np.float32)
+    var = (xf * xf).mean(-1, keepdims=True)
+    return xf / np.sqrt(var + eps) * w
+
+
+@pytest.mark.parametrize("shape", [(4, 64), (2, 7, 128), (24, 256)])
+def test_rmsnorm_matches_numpy(shape, rng):
+    x = jax.random.normal(rng, shape, jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), shape[-1:], jnp.float32)
+    want = _np_rmsnorm(np.asarray(x), np.asarray(w), 1e-6)
+    np.testing.assert_allclose(np.asarray(rms_norm_ref(x, w)), want, atol=1e-5)
+    # pallas kernel (interpret mode on CPU)
+    got = rms_norm(x, w, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_rmsnorm_fused_residual(rng):
+    x = jax.random.normal(rng, (16, 64), jnp.float32)
+    r = jax.random.normal(jax.random.PRNGKey(2), (16, 64), jnp.float32)
+    w = jnp.ones((64,))
+    y_ref, r_ref_out = rms_norm_ref(x, w, 1e-6, residual=r)
+    y_pl, r_pl = rms_norm(x, w, residual=r, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(y_pl), np.asarray(y_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(r_pl), np.asarray(x + r), atol=1e-6)
+
+
+def test_rope_matches_reference(rng):
+    t, h, d = 24, 4, 64
+    x = jax.random.normal(rng, (t, h, d), jnp.float32)
+    cos, sin = compute_rope_freqs(jnp.arange(t), d)
+    ref = apply_rope_ref(x, cos, sin)
+    got = apply_rope(x, cos, sin, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_rope_rotation_property():
+    # rotating a position-0 vector is identity
+    d = 32
+    x = jnp.ones((1, 2, d))
+    cos, sin = compute_rope_freqs(jnp.zeros(1, jnp.int32), d)
+    y = apply_rope_ref(x, cos, sin)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+    # norm is preserved at any position
+    cos, sin = compute_rope_freqs(jnp.array([17]), d)
+    y = apply_rope_ref(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y)), np.linalg.norm(np.asarray(x)), rtol=1e-5
+    )
+
+
+def test_mrope_sections_match_plain_rope_when_positions_equal():
+    # If all 3 position streams are identical, sectioned MRoPE == plain RoPE.
+    t, d = 8, 48
+    pos = jnp.arange(t)
+    mpos = jnp.stack([pos, pos, pos])
+    c1, s1 = compute_rope_freqs(pos, d)
+    c3, s3 = compute_mrope_freqs(mpos, d, [8, 8, 8])
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c3), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s3), atol=1e-6)
+
+
+def test_mrope_sections_select_streams():
+    t, d = 4, 24  # half=12, sections [4, 4, 4]
+    mpos = jnp.stack(
+        [jnp.arange(t), jnp.arange(t) * 10, jnp.arange(t) * 100]
+    )
+    c, s = compute_mrope_freqs(mpos, d, [4, 4, 4])
+    # first section uses stream 0, last uses stream 2
+    c0, s0 = compute_rope_freqs(mpos[0], d)
+    c2, s2 = compute_rope_freqs(mpos[2], d)
+    np.testing.assert_allclose(np.asarray(c[:, :4]), np.asarray(c0[:, :4]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c[:, 8:]), np.asarray(c2[:, 8:]), atol=1e-5)
+
+
+def test_silu_mul():
+    x = jnp.array([[1.0, 2.0, 3.0, 4.0]])  # gate=[1,2], up=[3,4]
+    got = np.asarray(silu_mul(x))
+    want = np.array([[1 / (1 + np.exp(-1.0)) * 1 * 3, 2 / (1 + np.exp(-2.0)) * 4]])
+    np.testing.assert_allclose(got, want, rtol=1e-5)

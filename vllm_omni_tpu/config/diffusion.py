@@ -7,6 +7,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from vllm_omni_tpu.config.model import split_known_kwargs
 from vllm_omni_tpu.parallel.mesh import MeshConfig
 
 
@@ -47,10 +48,7 @@ class OmniDiffusionConfig:
 
     @classmethod
     def from_kwargs(cls, **kwargs: Any) -> "OmniDiffusionConfig":
-        fields = cls.__dataclass_fields__
         if "parallel" in kwargs and isinstance(kwargs["parallel"], dict):
             kwargs["parallel"] = MeshConfig.from_dict(kwargs["parallel"])
-        known = {k: v for k, v in kwargs.items() if k in fields and k != "extra"}
-        extra = {k: v for k, v in kwargs.items() if k not in fields}
-        extra.update(kwargs.get("extra") or {})
+        known, extra = split_known_kwargs(cls, kwargs)
         return cls(**known, extra=extra)

@@ -28,6 +28,16 @@ _DTYPES = {
 }
 
 
+def split_known_kwargs(cls, kwargs: dict) -> tuple[dict, dict]:
+    """Split kwargs into dataclass fields vs the ``extra`` escape hatch
+    (shared by the config ``from_kwargs`` constructors)."""
+    fields = cls.__dataclass_fields__
+    known = {k: v for k, v in kwargs.items() if k in fields and k != "extra"}
+    extra = {k: v for k, v in kwargs.items() if k not in fields}
+    extra.update(kwargs.get("extra") or {})
+    return known, extra
+
+
 def resolve_dtype(name: Optional[str]):
     if name is None or name == "auto":
         from vllm_omni_tpu.platforms import current_platform
@@ -89,8 +99,5 @@ class OmniModelConfig:
         """Filtering constructor in the style of the reference's
         ``OmniDiffusionConfig.from_kwargs`` (diffusion/data.py:~500):
         known keys become fields, the rest land in ``extra``."""
-        fields = cls.__dataclass_fields__
-        known = {k: v for k, v in kwargs.items() if k in fields and k != "extra"}
-        extra = {k: v for k, v in kwargs.items() if k not in fields}
-        extra.update(kwargs.get("extra") or {})
+        known, extra = split_known_kwargs(cls, kwargs)
         return cls(**known, extra=extra)

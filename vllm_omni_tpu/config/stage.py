@@ -103,8 +103,8 @@ def _parse_stage(d: dict[str, Any]) -> StageConfig:
 def load_stage_configs_from_yaml(path: str) -> list[StageConfig]:
     with open(path) as f:
         doc = yaml.safe_load(f)
-    if not isinstance(doc, dict) or "stage_args" not in doc:
-        raise ValueError(f"{path}: expected top-level 'stage_args' list")
+    if not isinstance(doc, dict) or not doc.get("stage_args"):
+        raise ValueError(f"{path}: expected non-empty top-level 'stage_args' list")
     stages = [_parse_stage(s) for s in doc["stage_args"]]
     ids = [s.stage_id for s in stages]
     if sorted(ids) != list(range(len(stages))):

@@ -41,6 +41,8 @@ _register("OMNI_TPU_STATS_DIR", "", str)
 _register("OMNI_TPU_CONNECTOR", "shm", str)
 # Per-stage logging prefix.
 _register("OMNI_TPU_LOGGING_PREFIX", "", str)
+# Root log level for the package logger.
+_register("OMNI_TPU_LOG_LEVEL", "INFO", str)
 # RNG seed default.
 _register("OMNI_TPU_SEED", "0", int)
 

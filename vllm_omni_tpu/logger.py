@@ -21,12 +21,15 @@ def _init_root() -> None:
     global _initialized
     if _initialized:
         return
+    from vllm_omni_tpu import envs
+
     handler = logging.StreamHandler(sys.stderr)
-    prefix = os.environ.get("OMNI_TPU_LOGGING_PREFIX", "")
+    # Escape % so an arbitrary prefix can't break the format string.
+    prefix = envs.OMNI_TPU_LOGGING_PREFIX.replace("%", "%%")
     handler.setFormatter(logging.Formatter(prefix + _FORMAT, datefmt=_DATEFMT))
     root = logging.getLogger("vllm_omni_tpu")
     root.addHandler(handler)
-    root.setLevel(os.environ.get("OMNI_TPU_LOG_LEVEL", "INFO").upper())
+    root.setLevel(envs.OMNI_TPU_LOG_LEVEL.upper())
     root.propagate = False
     _initialized = True
 

@@ -1,0 +1,25 @@
+"""Backend dispatch for ops: pallas-native (TPU), pallas-interpret (CPU
+tests), or pure-XLA fallback.  The analogue of the reference's attention
+backend selector (vllm_omni/diffusion/attention/selector.py:54-85) and
+CustomOp dispatch base (diffusion/layers/custom_op.py:9)."""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.cache
+def pallas_mode() -> str:
+    """"native" | "interpret" | "off"."""
+    from vllm_omni_tpu import envs
+    from vllm_omni_tpu.platforms import current_platform
+
+    if envs.OMNI_TPU_PALLAS_INTERPRET:
+        return "interpret"
+    if current_platform().supports_pallas:
+        return "native"
+    return "interpret"
+
+
+def interpret_flag() -> bool:
+    return pallas_mode() == "interpret"

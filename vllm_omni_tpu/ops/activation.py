@@ -1,0 +1,21 @@
+"""Gated-MLP activations (reference: vLLM's fused SiLU-mul CUDA op,
+SURVEY.md §2.10).  On TPU, XLA fuses these elementwise ops into the
+surrounding matmuls, so the idiomatic implementation is plain jnp — kept
+here as named ops so model code reads like the reference's layer inventory.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def silu_mul(gate_up: jax.Array) -> jax.Array:
+    """Input [..., 2*d] = concat(gate, up); returns silu(gate) * up."""
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return jax.nn.silu(gate) * up
+
+
+def gelu_tanh_mul(gate_up: jax.Array) -> jax.Array:
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return jax.nn.gelu(gate, approximate=True) * up

@@ -1,0 +1,264 @@
+"""Dense flash attention — Pallas TPU kernel.
+
+Replaces the reference's FlashAttention/SDPA/SageAttention backend stack
+(vllm_omni/diffusion/attention/backends/{flash_attn,sdpa,sage_attn}.py and
+the vLLM prefill attention kernels; SURVEY.md §2.10).  One kernel serves:
+
+- DiT block attention (non-causal, joint text+image sequences — the joint
+  QKV layout of backends/abstract.py:55 is handled by concatenating text
+  and image tokens before the call),
+- AR prefill attention (causal, GQA),
+- the per-chunk inner step of ring attention (returns the logsumexp so
+  chunk results merge with the numerically-stable LSE rule that
+  ring/ring_utils.py `update_out_and_lse` implements in the reference).
+
+Layout: q [B, Sq, H, D]; k/v [B, Skv, Hkv, D] with Hkv | H (GQA).
+Online-softmax accumulation over KV blocks, fp32 accumulators in VMEM
+scratch, MXU matmuls via jnp.dot with preferred_element_type=f32.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vllm_omni_tpu.ops._dispatch import interpret_flag
+
+_NEG_INF = -1e30
+
+
+def attention_ref(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Skv, Hkv, D]
+    v: jax.Array,  # [B, Skv, Hkv, D]
+    causal: bool = False,
+    scale: Optional[float] = None,
+    return_lse: bool = False,
+):
+    """Pure-JAX reference with identical semantics (fp32 softmax)."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    group = h // hkv
+    kx = jnp.repeat(k, group, axis=2)
+    vx = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), kx.astype(jnp.float32)
+    ) * scale
+    if causal:
+        qi = jnp.arange(sq)[:, None]
+        ki = jnp.arange(k.shape[1])[None, :]
+        offset = k.shape[1] - sq  # q positions align to the KV suffix
+        s = jnp.where(qi + offset >= ki, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / l, vx.astype(jnp.float32))
+    o = o.astype(q.dtype)
+    if return_lse:
+        lse = (m + jnp.log(l))[..., 0]  # [B, H, Sq]
+        return o, lse
+    return o
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    causal: bool,
+    kv_len: int,
+    q_len: int,
+    causal_offset: int,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # Skip KV blocks fully above the causal diagonal.
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1 + causal_offset
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        # Mask: KV padding + (optionally) causal.
+        k_idx = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_idx < kv_len
+        if causal:
+            q_idx = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (q_idx + causal_offset >= k_idx)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # Explicitly zero masked probabilities: in a fully-masked block
+        # s - m_new == 0, and exp(0) would silently count masked slots.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # Zero padded V rows: out-of-bounds block reads are undefined
+        # (NaN in interpret mode) and 0 * NaN = NaN in the matmul.
+        v_valid = (
+            k_start
+            + jax.lax.broadcasted_iota(jnp.int32, v_ref.shape[1:], 0)
+        ) < kv_len
+        v = jnp.where(v_valid, v_ref[0].astype(jnp.float32), 0.0)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        # Fully-masked rows (e.g. ring-attention chunks before this rank's
+        # KV, or padded q rows) have l == 0: emit zeros / -inf lse.
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(
+            l == 0.0, _NEG_INF, m_scr[:, :1] + jnp.log(l_safe)
+        )
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal",
+        "scale",
+        "return_lse",
+        "block_q",
+        "block_k",
+        "use_pallas",
+    ),
+)
+def _flash_attention(
+    q, k, v, causal, scale, return_lse, block_q, block_k, use_pallas
+):
+    b, sq, h, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if not use_pallas:
+        return attention_ref(q, k, v, causal, scale, return_lse)
+
+    group = h // hkv
+    block_q = min(block_q, max(8, sq))
+    block_k = min(block_k, max(8, skv))
+    # q positions align to the KV suffix (AR prefill with cached prefix).
+    causal_offset = skv - sq
+
+    qx = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
+    kx = jnp.moveaxis(k, 2, 1).reshape(b * hkv, skv, d)
+    vx = jnp.moveaxis(v, 2, 1).reshape(b * hkv, skv, d)
+
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(skv, block_k)
+    grid = (b * h, nq, nk)
+
+    q_spec = pl.BlockSpec(
+        (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d),
+        lambda bh, qi, ki, group=group: (bh // group, ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+    o_spec = q_spec
+    lse_spec = pl.BlockSpec(
+        (1, block_q, 128),
+        lambda bh, qi, ki: (bh, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        kv_len=skv,
+        q_len=sq,
+        causal_offset=causal_offset,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=(o_spec, lse_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, nq * block_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, nq * block_q, 128), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret_flag(),
+    )(qx, kx, vx)
+
+    out = out[:, :sq].reshape(b, h, sq, d)
+    out = jnp.moveaxis(out, 1, 2)
+    if return_lse:
+        return out, lse[:, :sq, 0].reshape(b, h, sq)
+    return out
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    return_lse: bool = False,
+    block_q: int = 256,
+    block_k: int = 256,
+    use_pallas: Optional[bool] = None,
+):
+    """Flash attention over [B, S, H, D] tensors (GQA via Hkv | H)."""
+    if use_pallas is None:
+        from vllm_omni_tpu.ops._dispatch import pallas_mode
+
+        use_pallas = pallas_mode() == "native"
+    return _flash_attention(
+        q, k, v, causal, scale, return_lse, block_q, block_k, use_pallas
+    )

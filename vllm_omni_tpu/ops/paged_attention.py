@@ -1,0 +1,271 @@
+"""Paged KV-cache attention — Pallas replacement for vLLM's PagedAttention
+CUDA kernels (SURVEY.md §2.10; used by the reference through
+GPUModelRunner's attention metadata, worker/gpu_ar_model_runner.py:243-255).
+
+Cache layout (TPU-first): ``[Hkv, num_pages, page_size, D]`` — fixing the
+head and page indices yields a *contiguous* (page_size, D) tile, so the
+decode kernel's HBM→VMEM page DMAs are dense (the CUDA layout
+[pages, page_size, Hkv, D] would stride every row on TPU).
+
+Three ops:
+- ``write_kv_cache``  — slot-mapping scatter of new K/V into the paged cache
+- ``paged_attention_ref`` — gather-based XLA fallback (also the test oracle)
+- ``paged_attention`` — Pallas decode kernel: per (seq, kv-head) grid cell,
+  double-buffered page DMAs + online softmax over pages.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vllm_omni_tpu.ops._dispatch import interpret_flag
+
+_NEG_INF = -1e30
+
+
+def init_kv_cache(
+    num_layers: int,
+    num_pages: int,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+):
+    """Allocate per-layer (k, v) caches."""
+    shape = (num_kv_heads, num_pages, page_size, head_dim)
+    return [
+        (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        for _ in range(num_layers)
+    ]
+
+
+@jax.jit
+def write_kv_cache(
+    k_cache: jax.Array,  # [Hkv, P, page, D]
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [T, Hkv, D]
+    v_new: jax.Array,
+    slot_mapping: jax.Array,  # [T] int32, flat slot = page*page_size + offset
+):
+    """Scatter new KV into the paged cache at the given flat slots.
+
+    Padded tokens use slot -1: they scatter out of bounds, which XLA drops
+    (mode=drop), matching the CUDA kernel's ignore-negative-slot contract.
+    """
+    hkv, p, ps, d = k_cache.shape
+    kc = k_cache.reshape(hkv, p * ps, d)
+    vc = v_cache.reshape(hkv, p * ps, d)
+    kn = jnp.moveaxis(k_new, 1, 0)  # [Hkv, T, D]
+    vn = jnp.moveaxis(v_new, 1, 0)
+    # Negative slots would wrap Python-style; push them out of bounds so
+    # mode="drop" discards them.
+    slots = jnp.where(slot_mapping < 0, p * ps, slot_mapping)
+    kc = kc.at[:, slots].set(kn, mode="drop")
+    vc = vc.at[:, slots].set(vn, mode="drop")
+    return kc.reshape(k_cache.shape), vc.reshape(v_cache.shape)
+
+
+def paged_attention_ref(
+    q: jax.Array,  # [B, H, D] (one decode token per sequence)
+    k_cache: jax.Array,  # [Hkv, P, page, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_pages] int32 page ids
+    context_lens: jax.Array,  # [B] int32
+    scale: Optional[float] = None,
+):
+    b, h, d = q.shape
+    hkv, _, page, _ = k_cache.shape
+    group = h // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    max_pages = block_tables.shape[1]
+    # Gather pages: [B, Hkv, max_pages, page, D] -> [B, Hkv, L, D]
+    kg = jnp.moveaxis(k_cache[:, block_tables], 0, 1).reshape(
+        b, hkv, max_pages * page, d
+    )
+    vg = jnp.moveaxis(v_cache[:, block_tables], 0, 1).reshape(
+        b, hkv, max_pages * page, d
+    )
+    qg = q.reshape(b, hkv, group, d).astype(jnp.float32)
+    s = jnp.einsum("bngd,bnld->bngl", qg, kg.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages * page)[None, None, None, :]
+    mask = pos < context_lens[:, None, None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    p_ = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bngl,bnld->bngd", p_, vg.astype(jnp.float32))
+    return o.reshape(b, h, d).astype(q.dtype)
+
+
+def _paged_decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_pages] (SMEM)
+    context_lens_ref,  # [B] (SMEM)
+    # inputs
+    q_ref,  # [1, 1, group_p, D] VMEM
+    k_hbm,  # [Hkv, P, page, D] ANY/HBM
+    v_hbm,
+    # outputs
+    o_ref,  # [1, 1, group_p, D] VMEM
+    # scratch
+    k_buf,  # [2, page, D]
+    v_buf,
+    sems,  # DMA sems [2, 2]
+    acc_scr,  # [group_p, D]
+    *,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kvh = pl.program_id(1)
+    ctx_len = context_lens_ref[b]
+    num_pages = jax.lax.div(ctx_len + page_size - 1, page_size)
+
+    def page_dma(slot, p_idx):
+        page_id = block_tables_ref[b, p_idx]
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[kvh, page_id], k_buf.at[slot], sems.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[kvh, page_id], v_buf.at[slot], sems.at[slot, 1]
+            ),
+        )
+
+    @pl.when(num_pages > 0)
+    def _run():
+        for dma in page_dma(0, 0):
+            dma.start()
+
+        def body(p_idx, carry):
+            m_prev, l_prev, _ = carry  # acc lives in scratch
+            slot = jax.lax.rem(p_idx, 2)
+            nxt = jax.lax.rem(p_idx + 1, 2)
+
+            @pl.when(p_idx + 1 < num_pages)
+            def _prefetch():
+                for dma in page_dma(nxt, p_idx + 1):
+                    dma.start()
+
+            for dma in page_dma(slot, p_idx):
+                dma.wait()
+
+            q = q_ref[0, 0].astype(jnp.float32)
+            k = k_buf[slot].astype(jnp.float32)
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            pos = p_idx * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(pos < ctx_len, s, _NEG_INF)
+
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+                p, v_buf[slot].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, 0
+
+        group_p = q_ref.shape[2]
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m0 = jnp.full((group_p, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((group_p, 1), jnp.float32)
+        m_fin, l_fin, _ = jax.lax.fori_loop(
+            0, num_pages, body, (m0, l0, 0)
+        )
+        l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+    @pl.when(num_pages == 0)
+    def _empty():
+        o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "use_pallas"))
+def _paged_attention(
+    q, k_cache, v_cache, block_tables, context_lens, scale, use_pallas
+):
+    b, h, d = q.shape
+    hkv, num_pages_total, page_size, _ = k_cache.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if not use_pallas:
+        return paged_attention_ref(
+            q, k_cache, v_cache, block_tables, context_lens, scale
+        )
+    group = h // hkv
+    group_p = max(8, group)  # sublane-align the per-kv-head q group
+    qx = q.reshape(b, hkv, group, d)
+    if group_p != group:
+        qx = jnp.pad(qx, ((0, 0), (0, 0), (0, group_p - group), (0, 0)))
+    max_pages = block_tables.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group_p, d),
+                lambda b_, h_, *_: (b_, h_, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group_p, d),
+            lambda b_, h_, *_: (b_, h_, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, d), k_cache.dtype),
+            pltpu.VMEM((2, page_size, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((group_p, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel,
+            page_size=page_size,
+            scale=scale,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group_p, d), q.dtype),
+        interpret=interpret_flag(),
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        qx,
+        k_cache,
+        v_cache,
+    )
+    return out[:, :, :group].reshape(b, h, d)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+):
+    """Single-token-per-sequence paged decode attention."""
+    if use_pallas is None:
+        from vllm_omni_tpu.ops._dispatch import pallas_mode
+
+        use_pallas = pallas_mode() == "native"
+    return _paged_attention(
+        q, k_cache, v_cache, block_tables, context_lens, scale, use_pallas
+    )

@@ -1,0 +1,142 @@
+"""Rotary position embedding — Pallas replacement for vLLM's fused
+RoPE/MRoPE CUDA op (SURVEY.md §2.10).
+
+Frequencies (cos/sin tables) are computed host/XLA-side — including the
+*sectioned multimodal* MRoPE layout where the rotary feature dims are split
+among temporal/height/width position streams (the position math the
+reference implements in model_executor/layers/rotary_embedding/mrope.py:25);
+the Pallas kernel then applies the rotation to Q and K in one fused pass.
+
+Convention: GPT-NeoX half-split rotation (x1 = x[..., :d/2], x2 = x[..., d/2:]),
+the layout used by the Qwen model families.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vllm_omni_tpu.ops._dispatch import interpret_flag
+
+
+def compute_rope_freqs(
+    positions: jax.Array,  # [T] int
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Return (cos, sin), each [T, head_dim//2], float32."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    angles = angles * scaling
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def compute_mrope_freqs(
+    positions: jax.Array,  # [3, T] int — (temporal, height, width) streams
+    head_dim: int,
+    mrope_section: Sequence[int],  # splits of head_dim//2 among the streams
+    theta: float = 10000.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Sectioned multimodal RoPE frequencies.
+
+    Each of the 3 position streams owns a contiguous slice of the rotary
+    feature dims (sum(mrope_section) == head_dim//2), matching the
+    interleaved 3D-RoPE the reference computes for image/video/audio
+    positions (mrope.py:25).
+    """
+    assert positions.ndim == 2 and positions.shape[0] == len(mrope_section)
+    half = head_dim // 2
+    assert sum(mrope_section) == half, (mrope_section, half)
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    # angles per stream: [3, T, half]
+    angles = positions.astype(jnp.float32)[:, :, None] * inv_freq[None, None, :]
+    # select the owning stream per feature dim
+    section_id = jnp.repeat(
+        jnp.arange(len(mrope_section)),
+        jnp.asarray(mrope_section),
+        total_repeat_length=half,
+    )  # [half]
+    angles = jnp.take_along_axis(
+        angles, section_id[None, None, :].repeat(positions.shape[1], 1), axis=0
+    )[0]  # [T, half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope_ref(
+    x: jax.Array,  # [T, H, D]
+    cos: jax.Array,  # [T, D//2]
+    sin: jax.Array,  # [T, D//2]
+) -> jax.Array:
+    d = x.shape[-1]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2 :].astype(jnp.float32)
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, heads: int, dim: int):
+    bt = x_ref.shape[0]
+    v = x_ref[:].reshape(bt, heads, dim).astype(jnp.float32)
+    half = dim // 2
+    x1 = v[..., :half]
+    x2 = v[..., half:]
+    c = cos_ref[:].reshape(bt, 1, half)
+    s = sin_ref[:].reshape(bt, 1, half)
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    o = jnp.concatenate([o1, o2], axis=-1)
+    o_ref[:] = o.reshape(bt, heads * dim).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("use_pallas",))
+def _apply_rope(x, cos, sin, use_pallas):
+    if not use_pallas:
+        return apply_rope_ref(x, cos, sin)
+    t, h, d = x.shape
+    x2 = x.reshape(t, h * d)
+    bt = max(8, min(t, 512))
+    bt = max(8, (bt // 8) * 8)
+    grid = (pl.cdiv(t, bt),)
+    out = pl.pallas_call(
+        functools.partial(_rope_kernel, heads=h, dim=d),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, h * d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bt, d // 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bt, d // 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (bt, h * d), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, h * d), x.dtype),
+        interpret=interpret_flag(),
+    )(x2, cos, sin)
+    return out.reshape(t, h, d)
+
+
+def apply_rope(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    use_pallas: Optional[bool] = None,
+) -> jax.Array:
+    """Apply rotary embedding to [T, H, D] activations."""
+    if use_pallas is None:
+        from vllm_omni_tpu.ops._dispatch import pallas_mode
+
+        use_pallas = pallas_mode() == "native"
+    return _apply_rope(x, cos, sin, use_pallas)

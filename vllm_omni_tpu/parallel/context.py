@@ -1,0 +1,190 @@
+"""Sequence/context-parallel attention strategies.
+
+TPU-native re-design of the reference's parallel attention stack
+(SURVEY.md §2.11):
+
+- **Ulysses** (reference: attention/parallel/ulysses.py:29 + SeqAllToAll4D,
+  comm.py:103): here a single ``jax.lax.all_to_all`` over the ``ulysses``
+  mesh axis redistributes heads<->sequence around a local flash attention.
+- **Ring** (reference: attention/backends/ring_flash_attn.py:13-120 +
+  RingComm comm.py:228): blockwise KV rotation via ``jax.lax.ppermute``
+  with numerically-stable LSE merging (the reference's
+  ``update_out_and_lse``, ring/ring_utils.py).
+- **USP hybrid** (reference: set_seq_parallel_pg,
+  parallel_state.py:477-622): ulysses inside ring — heads are
+  redistributed within each ulysses group, KV blocks rotate around the
+  ring axis.
+- **Joint text prefix** (reference: ring.py:38-45, ulysses.py:33-39): DiT
+  joint text+image attention keeps the text KV replicated; it is attended
+  once as a static prefix chunk and merged via LSE, exactly the reference's
+  "joint_tensor as static ring prefix" semantics.
+
+All functions are written to run inside ``shard_map`` over a mesh built by
+``vllm_omni_tpu.parallel.mesh.build_mesh``; sequence shards live on the
+(ring, ulysses) axes.  Collectives ride ICI; XLA overlaps the ppermute with
+the per-step flash kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.ops.attention import flash_attention
+
+
+def _merge_lse(o1, lse1, o2, lse2):
+    """Merge two partial attention results with logsumexp weighting.
+
+    o: [B, S, H, D]; lse: [B, H, S].  Stable for lse == -inf chunks.
+    """
+    m = jnp.maximum(lse1, lse2)
+    # Guard fully-empty chunks (both -inf).
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    den = w1 + w2
+    den_safe = jnp.where(den == 0.0, 1.0, den)
+    w1n = (w1 / den_safe)[..., None].swapaxes(1, 2)  # [B, S, H, 1]
+    w2n = (w2 / den_safe)[..., None].swapaxes(1, 2)
+    o = o1.astype(jnp.float32) * w1n + o2.astype(jnp.float32) * w2n
+    lse = m_safe + jnp.log(den_safe)
+    lse = jnp.where(den == 0.0, -jnp.inf, lse)
+    return o.astype(o1.dtype), lse
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S_local, H, D] (seq sharded over ring axis)
+    k: jax.Array,
+    v: jax.Array,
+    ring_axis: str,
+    joint_k: Optional[jax.Array] = None,  # [B, S_text, H, D] replicated
+    joint_v: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Non-causal blockwise ring attention (DiT long-sequence attention).
+
+    Each step attends the local Q against the currently-held KV block, then
+    rotates the KV block to the next ring neighbour with ``ppermute``.
+    Partial results merge via LSE.  The replicated joint text KV is attended
+    once at step 0 (reference ring_flash_attn.py:72-79 behaviour).
+    """
+    n = jax.lax.axis_size(ring_axis)
+
+    k0, v0 = k, v
+    if joint_k is not None:
+        kj = jnp.concatenate([k0, joint_k], axis=1)
+        vj = jnp.concatenate([v0, joint_v], axis=1)
+    else:
+        kj, vj = k0, v0
+    o, lse = flash_attention(q, kj, vj, causal=False, return_lse=True)
+
+    if n == 1:
+        return o
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        k_nxt = jax.lax.ppermute(k_cur, ring_axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, ring_axis, perm)
+        o_i, lse_i = flash_attention(
+            q, k_nxt, v_nxt, causal=False, return_lse=True
+        )
+        o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_i, lse_i)
+        return (o_acc, lse_acc, k_nxt, v_nxt), None
+
+    (o, lse, _, _), _ = jax.lax.scan(
+        step, (o, lse, k0, v0), None, length=n - 1
+    )
+    return o
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S_local, H, D] (seq sharded over ulysses axis)
+    k: jax.Array,
+    v: jax.Array,
+    ulysses_axis: str,
+    causal: bool = False,
+    joint_k: Optional[jax.Array] = None,
+    joint_v: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Ulysses sequence parallelism: all_to_all heads<->sequence.
+
+    After the first all_to_all each rank holds the *full* sequence for
+    H/u heads; attention is local; the second all_to_all restores the
+    sequence sharding.  Joint (replicated) text KV is sliced per rank to
+    its head group — the reference's ulysses.py:33-39 semantics.
+    """
+    u = jax.lax.axis_size(ulysses_axis)
+    h = q.shape[2]
+
+    def scatter_heads(x):
+        # [B, S/u, H, D] -> [B, S, H/u, D]
+        return jax.lax.all_to_all(
+            x, ulysses_axis, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        # [B, S, H/u, D] -> [B, S/u, H, D]
+        return jax.lax.all_to_all(
+            x, ulysses_axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if joint_k is not None:
+        idx = jax.lax.axis_index(ulysses_axis)
+        hh = h // u
+        kj = jax.lax.dynamic_slice_in_dim(joint_k, idx * hh, hh, axis=2)
+        vj = jax.lax.dynamic_slice_in_dim(joint_v, idx * hh, hh, axis=2)
+        kg = jnp.concatenate([kg, kj], axis=1)
+        vg = jnp.concatenate([vg, vj], axis=1)
+    o = flash_attention(qg, kg, vg, causal=causal)
+    return gather_heads(o)
+
+
+def usp_attention(
+    q: jax.Array,  # [B, S_local, H, D]; seq sharded over (ring, ulysses)
+    k: jax.Array,
+    v: jax.Array,
+    ulysses_axis: str = "ulysses",
+    ring_axis: str = "ring",
+    joint_k: Optional[jax.Array] = None,
+    joint_v: Optional[jax.Array] = None,
+) -> jax.Array:
+    """USP hybrid: ulysses head redistribution nested inside ring KV
+    rotation (sequence_parallel_size = ulysses_degree x ring_degree)."""
+    u = jax.lax.axis_size(ulysses_axis)
+    r = jax.lax.axis_size(ring_axis)
+    if u == 1 and r == 1:
+        if joint_k is not None:
+            k = jnp.concatenate([k, joint_k], axis=1)
+            v = jnp.concatenate([v, joint_v], axis=1)
+        return flash_attention(q, k, v, causal=False)
+    if r == 1:
+        return ulysses_attention(
+            q, k, v, ulysses_axis, joint_k=joint_k, joint_v=joint_v
+        )
+
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, ulysses_axis, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(
+            x, ulysses_axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    h = q.shape[2]
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    jk = jv = None
+    if joint_k is not None:
+        idx = jax.lax.axis_index(ulysses_axis)
+        hh = h // u
+        jk = jax.lax.dynamic_slice_in_dim(joint_k, idx * hh, hh, axis=2)
+        jv = jax.lax.dynamic_slice_in_dim(joint_v, idx * hh, hh, axis=2)
+    o = ring_attention(qg, kg, vg, ring_axis, joint_k=jk, joint_v=jv)
+    return gather_heads(o)

@@ -129,12 +129,19 @@ class MeshConfig:
         for k, v in d.items():
             if k == "sequence_parallel_size":
                 sp = int(v)
-            elif k in alias and alias[k]:
-                kwargs[alias[k]] = int(v)
+                continue
+            if k in alias and alias[k]:
+                field = alias[k]
             elif k in MeshConfig.__dataclass_fields__:
-                kwargs[k] = int(v)
+                field = k
             else:
                 raise KeyError(f"unknown parallel config key {k!r}")
+            if field in kwargs and kwargs[field] != int(v):
+                raise ValueError(
+                    f"conflicting values for {field!r}: "
+                    f"{kwargs[field]} vs {v}"
+                )
+            kwargs[field] = int(v)
         cfg = MeshConfig(**kwargs)
         if sp is not None and cfg.sequence_parallel_size != sp:
             if cfg.ring_degree == 1 and cfg.ulysses_degree == 1:
