@@ -1,0 +1,37 @@
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.diffusion import scheduler as fm
+
+
+def test_schedule_shapes_and_range():
+    s = fm.make_schedule(20, shift=3.0)
+    assert s.sigmas.shape == (21,)
+    assert s.timesteps.shape == (20,)
+    assert float(s.sigmas[-1]) == 0.0
+    assert float(s.sigmas[0]) <= 1.0
+    # monotonically decreasing
+    assert np.all(np.diff(np.asarray(s.sigmas)) <= 0)
+
+
+def test_dynamic_shifting_monotone():
+    s = fm.make_schedule(10, use_dynamic_shifting=True, mu=0.8)
+    sig = np.asarray(s.sigmas)
+    assert np.all(np.diff(sig) <= 0) and sig[0] <= 1.0
+
+
+def test_euler_step_reaches_target():
+    # With the exact constant velocity v = (noise - data), flow matching
+    # integrates from pure noise at sigma=1 to the data at sigma=0.
+    s = fm.make_schedule(8, shift=1.0)
+    data = jnp.full((1, 4), 3.0)
+    noise = jnp.full((1, 4), -1.0)
+    x = noise  # sigma=1 start... x_t = (1-s)*data + s*noise
+    v = noise - data
+    for i in range(8):
+        x = fm.step(s, x, v, i)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(data), atol=1e-4)
+
+
+def test_mu_increases_with_seq_len():
+    assert fm.compute_dynamic_shift_mu(4096) > fm.compute_dynamic_shift_mu(256)

@@ -74,3 +74,23 @@ def test_flash_bf16(rng):
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
     )
+
+
+def test_flash_kv_mask(rng):
+    q, k, v = _mk(rng, 2, 12, 24, 2, 2, 64)
+    kv_mask = (jax.random.uniform(jax.random.PRNGKey(9), (2, 24)) > 0.3).astype(
+        jnp.int32
+    )
+    want = attention_ref(q, k, v, kv_mask=kv_mask)
+    got = flash_attention(
+        q, k, v, kv_mask=kv_mask, block_q=8, block_k=8, use_pallas=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-3, rtol=1e-3
+    )
+    # oracle: dropping masked keys entirely must equal masking them
+    keep = np.asarray(kv_mask[0]).astype(bool)
+    want0 = attention_ref(q[:1], k[:1, keep], v[:1, keep])
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(want0[0]), atol=2e-3, rtol=1e-3
+    )

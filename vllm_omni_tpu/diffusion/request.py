@@ -1,0 +1,56 @@
+"""Diffusion request/sampling types (reference: OmniDiffusionRequest,
+diffusion/request.py:11; OmniDiffusionSamplingParams, inputs/data.py:153)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class OmniDiffusionSamplingParams:
+    height: int = 1024
+    width: int = 1024
+    num_inference_steps: int = 50
+    guidance_scale: float = 4.0
+    negative_prompt: str = ""
+    seed: Optional[int] = None
+    num_images_per_prompt: int = 1
+    # video / audio extensions
+    num_frames: int = 1
+    fps: int = 16
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class OmniDiffusionRequest:
+    prompt: list[str]
+    sampling_params: OmniDiffusionSamplingParams = field(
+        default_factory=OmniDiffusionSamplingParams
+    )
+    request_ids: list[str] = field(default_factory=list)
+    # pre-computed text embeddings from an upstream stage (stage
+    # disaggregation: text-encoder stage -> DiT stage)
+    prompt_embeds: Optional[Any] = None
+    negative_prompt_embeds: Optional[Any] = None
+    arrival_time: float = field(default_factory=time.time)
+
+    def __post_init__(self):
+        if isinstance(self.prompt, str):
+            self.prompt = [self.prompt]
+        if not self.request_ids:
+            self.request_ids = [
+                f"diff-{int(self.arrival_time * 1e6)}-{i}"
+                for i in range(len(self.prompt))
+            ]
+
+
+@dataclass
+class DiffusionOutput:
+    request_id: str
+    prompt: str
+    # [H, W, 3] uint8 (image) | [T, H, W, 3] (video) | [N] float (audio)
+    data: Any = None
+    output_type: str = "image"
+    metrics: dict[str, float] = field(default_factory=dict)

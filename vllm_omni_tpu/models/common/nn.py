@@ -1,0 +1,124 @@
+"""Minimal functional NN primitives over parameter pytrees.
+
+The framework keeps model parameters as nested dicts of jax.Arrays (pytrees)
+and model code as pure functions — the idiomatic layout for pjit/shard_map
+sharding (params are annotated with NamedSharding at load time, activations
+with with_sharding_constraint inside the jitted step).  This replaces the
+reference's torch ``nn.Module`` graph + forward hooks with compiler-visible
+functions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------- init utils
+def linear_init(key, in_dim: int, out_dim: int, bias: bool = True, dtype=jnp.float32):
+    kw, kb = jax.random.split(key)
+    scale = 1.0 / math.sqrt(in_dim)
+    p = {
+        "w": jax.random.uniform(
+            kw, (in_dim, out_dim), dtype, minval=-scale, maxval=scale
+        )
+    }
+    if bias:
+        p["b"] = jnp.zeros((out_dim,), dtype)
+    return p
+
+
+def linear(p, x):
+    y = x @ p["w"]
+    if "b" in p:
+        y = y + p["b"]
+    return y
+
+
+def embedding_init(key, vocab: int, dim: int, dtype=jnp.float32):
+    return {"w": jax.random.normal(key, (vocab, dim), dtype) * 0.02}
+
+
+def embedding(p, ids):
+    return p["w"][ids]
+
+
+def rmsnorm_init(dim: int, dtype=jnp.float32):
+    return {"w": jnp.ones((dim,), dtype)}
+
+
+def layernorm_init(dim: int, affine: bool = True, dtype=jnp.float32):
+    if not affine:
+        return {}
+    return {"w": jnp.ones((dim,), dtype), "b": jnp.zeros((dim,), dtype)}
+
+
+def layernorm(p, x, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    if "w" in p:
+        y = y * p["w"].astype(jnp.float32) + p["b"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def conv2d_init(
+    key, in_ch: int, out_ch: int, kernel: int, bias: bool = True, dtype=jnp.float32
+):
+    fan_in = in_ch * kernel * kernel
+    scale = 1.0 / math.sqrt(fan_in)
+    p = {
+        "w": jax.random.uniform(
+            key, (kernel, kernel, in_ch, out_ch), dtype, minval=-scale, maxval=scale
+        )
+    }
+    if bias:
+        p["b"] = jnp.zeros((out_ch,), dtype)
+    return p
+
+
+def conv2d(p, x, stride: int = 1, padding: str | Sequence = "SAME"):
+    """x: [B, H, W, C] (NHWC — the TPU-native conv layout)."""
+    y = jax.lax.conv_general_dilated(
+        x,
+        p["w"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "b" in p:
+        y = y + p["b"].astype(x.dtype)
+    return y
+
+
+def groupnorm_init(channels: int, dtype=jnp.float32):
+    return {"w": jnp.ones((channels,), dtype), "b": jnp.zeros((channels,), dtype)}
+
+
+def groupnorm(p, x, groups: int = 32, eps: float = 1e-6):
+    """x: [B, H, W, C]."""
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mu = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xf, axis=(1, 2, 4), keepdims=True)
+    y = ((xf - mu) * jax.lax.rsqrt(var + eps)).reshape(b, h, w, c)
+    y = y * p["w"].astype(jnp.float32) + p["b"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep embedding [B] -> [B, dim] (flip_sin_to_cos=True,
+    matching diffusers' Timesteps used by the reference pipelines)."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)

@@ -1,0 +1,218 @@
+"""Generic Qwen-style causal transformer (GQA + RoPE + RMSNorm + SwiGLU).
+
+One implementation serves every AR component in the framework: the
+diffusion pipelines' text encoder (reference: Qwen2.5-VL encode_prompt,
+pipeline_qwen_image.py:622-636), the Qwen3-Omni thinker/talker backbones
+(reference: models/qwen3_omni/qwen3_moe.py — dense variant first, MoE via
+``moe=True``), and the TTS LM.  Pure functions over a param pytree; both a
+full-sequence forward (prefill / text encoding) and a paged-KV decode step
+for the continuous-batching engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import (
+    apply_rope,
+    compute_rope_freqs,
+    flash_attention,
+    paged_attention,
+    rms_norm,
+    silu_mul,
+    write_kv_cache,
+)
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 1024
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 128
+    intermediate_size: int = 4096
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-6
+    qk_norm: bool = False  # per-head q/k RMSNorm (Qwen3 style)
+    tie_word_embeddings: bool = False
+
+    @staticmethod
+    def tiny(vocab_size: int = 128) -> "TransformerConfig":
+        return TransformerConfig(
+            vocab_size=vocab_size,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            intermediate_size=128,
+        )
+
+
+def init_params(key, cfg: TransformerConfig, dtype=jnp.float32):
+    keys = jax.random.split(key, cfg.num_layers + 3)
+    params = {
+        "embed": nn.embedding_init(keys[0], cfg.vocab_size, cfg.hidden_size, dtype),
+        "final_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
+        "layers": [],
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = nn.linear_init(
+            keys[1], cfg.hidden_size, cfg.vocab_size, bias=False, dtype=dtype
+        )
+    q_dim = cfg.num_heads * cfg.head_dim
+    kv_dim = cfg.num_kv_heads * cfg.head_dim
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[i + 3], 8)
+        layer = {
+            "input_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
+            "q_proj": nn.linear_init(k[0], cfg.hidden_size, q_dim, bias=False, dtype=dtype),
+            "k_proj": nn.linear_init(k[1], cfg.hidden_size, kv_dim, bias=False, dtype=dtype),
+            "v_proj": nn.linear_init(k[2], cfg.hidden_size, kv_dim, bias=False, dtype=dtype),
+            "o_proj": nn.linear_init(k[3], q_dim, cfg.hidden_size, bias=False, dtype=dtype),
+            "post_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
+            "gate_up": nn.linear_init(
+                k[4], cfg.hidden_size, 2 * cfg.intermediate_size, bias=False, dtype=dtype
+            ),
+            "down": nn.linear_init(
+                k[5], cfg.intermediate_size, cfg.hidden_size, bias=False, dtype=dtype
+            ),
+        }
+        if cfg.qk_norm:
+            layer["q_norm"] = nn.rmsnorm_init(cfg.head_dim, dtype)
+            layer["k_norm"] = nn.rmsnorm_init(cfg.head_dim, dtype)
+        params["layers"].append(layer)
+    return params
+
+
+def _qkv(layer, cfg: TransformerConfig, x):
+    """x: [T, hidden] -> q [T, H, D], k/v [T, Hkv, D] with RoPE-ready layout."""
+    t = x.shape[0]
+    q = nn.linear(layer["q_proj"], x).reshape(t, cfg.num_heads, cfg.head_dim)
+    k = nn.linear(layer["k_proj"], x).reshape(t, cfg.num_kv_heads, cfg.head_dim)
+    v = nn.linear(layer["v_proj"], x).reshape(t, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, layer["q_norm"]["w"], cfg.rms_eps)
+        k = rms_norm(k, layer["k_norm"]["w"], cfg.rms_eps)
+    return q, k, v
+
+
+def _mlp(layer, x):
+    return nn.linear(layer["down"], silu_mul(nn.linear(layer["gate_up"], x)))
+
+
+def forward_hidden(
+    params,
+    cfg: TransformerConfig,
+    token_ids: jax.Array,  # [B, S]
+    positions: Optional[jax.Array] = None,  # [B, S]
+    inputs_embeds: Optional[jax.Array] = None,  # [B, S, hidden]
+) -> jax.Array:
+    """Full-sequence causal forward returning final hidden states
+    [B, S, hidden] (the text-encoder path; also prefill without cache)."""
+    b, s = token_ids.shape
+    x = inputs_embeds if inputs_embeds is not None else nn.embedding(params["embed"], token_ids)
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    cos, sin = compute_rope_freqs(
+        positions.reshape(-1), cfg.head_dim, cfg.rope_theta
+    )
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+        h2 = h.reshape(b * s, -1)
+        q, k, v = _qkv(layer, cfg, h2)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = flash_attention(
+            q.reshape(b, s, cfg.num_heads, cfg.head_dim),
+            k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+            causal=True,
+        )
+        x = x + o.reshape(b, s, -1) @ layer["o_proj"]["w"]
+        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
+
+
+def logits_from_hidden(params, cfg: TransformerConfig, hidden: jax.Array):
+    if cfg.tie_word_embeddings:
+        return hidden @ params["embed"]["w"].T
+    return nn.linear(params["lm_head"], hidden)
+
+
+def forward_prefill(
+    params,
+    cfg: TransformerConfig,
+    token_ids: jax.Array,  # [B, S] (right-padded)
+    positions: jax.Array,  # [B, S]
+    kv_caches: list,  # per-layer (k, v) paged caches
+    slot_mapping: jax.Array,  # [B, S] flat slots (-1 for padding)
+):
+    """Prefill: causal attention within the prompt, writing KV pages.
+
+    Returns (hidden [B, S, hidden], new kv_caches).
+    """
+    b, s = token_ids.shape
+    x = nn.embedding(params["embed"], token_ids)
+    cos, sin = compute_rope_freqs(
+        positions.reshape(-1), cfg.head_dim, cfg.rope_theta
+    )
+    flat_slots = slot_mapping.reshape(-1)
+    new_caches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+        q, k, v = _qkv(layer, cfg, h.reshape(b * s, -1))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache, v_cache = write_kv_cache(k_cache, v_cache, k, v, flat_slots)
+        new_caches.append((k_cache, v_cache))
+        o = flash_attention(
+            q.reshape(b, s, cfg.num_heads, cfg.head_dim),
+            k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+            causal=True,
+        )
+        x = x + o.reshape(b, s, -1) @ layer["o_proj"]["w"]
+        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
+
+
+def forward_decode(
+    params,
+    cfg: TransformerConfig,
+    token_ids: jax.Array,  # [B]
+    positions: jax.Array,  # [B]
+    kv_caches: list,
+    slot_mapping: jax.Array,  # [B]
+    block_tables: jax.Array,  # [B, max_pages]
+    context_lens: jax.Array,  # [B] (including the new token)
+):
+    """One decode step over a batch of sequences with paged attention.
+
+    Returns (hidden [B, hidden], new kv_caches).
+    """
+    b = token_ids.shape[0]
+    x = nn.embedding(params["embed"], token_ids)  # [B, hidden]
+    cos, sin = compute_rope_freqs(positions, cfg.head_dim, cfg.rope_theta)
+    new_caches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+        q, k, v = _qkv(layer, cfg, h)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache, v_cache = write_kv_cache(k_cache, v_cache, k, v, slot_mapping)
+        new_caches.append((k_cache, v_cache))
+        o = paged_attention(q, k_cache, v_cache, block_tables, context_lens)
+        x = x + o.reshape(b, -1) @ layer["o_proj"]["w"]
+        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches

@@ -1,0 +1,179 @@
+"""Image VAE (AutoencoderKL-style) in functional JAX, NHWC layout.
+
+Role of the reference's ``autoencoder_kl_qwenimage.py`` (16 latent
+channels, 8x spatial compression): encoder for image-edit conditioning,
+decoder for the pipeline's final latents->pixels stage.  Mid-block
+attention + resnet stacks, nearest-neighbour upsampling — all MXU-friendly
+convs that XLA fuses; VAE *patch parallel* (reference
+vae_patch_parallel.py) maps to sharding H over mesh axes with halo
+exchange at the pipeline level (later phase).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+
+
+@dataclass(frozen=True)
+class VAEConfig:
+    latent_channels: int = 16
+    base_channels: int = 128
+    channel_multipliers: tuple[int, ...] = (1, 2, 4, 4)
+    layers_per_block: int = 2
+    scaling_factor: float = 0.3611
+    shift_factor: float = 0.1159
+
+    @property
+    def spatial_ratio(self) -> int:
+        return 2 ** (len(self.channel_multipliers) - 1)
+
+    @staticmethod
+    def tiny() -> "VAEConfig":
+        return VAEConfig(
+            latent_channels=4,
+            base_channels=16,
+            channel_multipliers=(1, 2),
+            layers_per_block=1,
+            scaling_factor=1.0,
+            shift_factor=0.0,
+        )
+
+
+def _resnet_init(key, cin, cout, dtype):
+    k = jax.random.split(key, 3)
+    p = {
+        "norm1": nn.groupnorm_init(cin, dtype),
+        "conv1": nn.conv2d_init(k[0], cin, cout, 3, dtype=dtype),
+        "norm2": nn.groupnorm_init(cout, dtype),
+        "conv2": nn.conv2d_init(k[1], cout, cout, 3, dtype=dtype),
+    }
+    if cin != cout:
+        p["skip"] = nn.conv2d_init(k[2], cin, cout, 1, dtype=dtype)
+    return p
+
+
+def _resnet(p, x):
+    h = nn.conv2d(p["conv1"], jax.nn.silu(nn.groupnorm(p["norm1"], x)))
+    h = nn.conv2d(p["conv2"], jax.nn.silu(nn.groupnorm(p["norm2"], h)))
+    if "skip" in p:
+        x = nn.conv2d(p["skip"], x)
+    return x + h
+
+
+def _attn_init(key, ch, dtype):
+    k = jax.random.split(key, 4)
+    return {
+        "norm": nn.groupnorm_init(ch, dtype),
+        "q": nn.linear_init(k[0], ch, ch, dtype=dtype),
+        "k": nn.linear_init(k[1], ch, ch, dtype=dtype),
+        "v": nn.linear_init(k[2], ch, ch, dtype=dtype),
+        "o": nn.linear_init(k[3], ch, ch, dtype=dtype),
+    }
+
+
+def _attn(p, x):
+    b, h, w, c = x.shape
+    xn = nn.groupnorm(p["norm"], x).reshape(b, h * w, c)
+    q = nn.linear(p["q"], xn)
+    k = nn.linear(p["k"], xn)
+    v = nn.linear(p["v"], xn)
+    s = jnp.einsum("bqc,bkc->bqk", q, k).astype(jnp.float32) / jnp.sqrt(c)
+    a = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    o = nn.linear(p["o"], jnp.einsum("bqk,bkc->bqc", a, v))
+    return x + o.reshape(b, h, w, c)
+
+
+def init_decoder(key, cfg: VAEConfig, dtype=jnp.float32):
+    mults = cfg.channel_multipliers
+    chans = [cfg.base_channels * m for m in mults]
+    top = chans[-1]
+    keys = jax.random.split(key, 4 + len(mults))
+    p = {
+        "conv_in": nn.conv2d_init(keys[0], cfg.latent_channels, top, 3, dtype=dtype),
+        "mid_res1": _resnet_init(keys[1], top, top, dtype),
+        "mid_attn": _attn_init(keys[2], top, dtype),
+        "mid_res2": _resnet_init(keys[3], top, top, dtype),
+        "ups": [],
+    }
+    cur = top
+    for i, ch in enumerate(reversed(chans)):
+        ks = jax.random.split(keys[4 + i], cfg.layers_per_block + 2)
+        blk = {"res": []}
+        for j in range(cfg.layers_per_block + 1):
+            blk["res"].append(_resnet_init(ks[j], cur, ch, dtype))
+            cur = ch
+        if i < len(chans) - 1:
+            blk["up_conv"] = nn.conv2d_init(ks[-1], cur, cur, 3, dtype=dtype)
+        p["ups"].append(blk)
+    p["norm_out"] = nn.groupnorm_init(cur, dtype)
+    p["conv_out"] = nn.conv2d_init(jax.random.fold_in(key, 7), cur, 3, 3, dtype=dtype)
+    return p
+
+
+def decode(p, cfg: VAEConfig, latents: jax.Array) -> jax.Array:
+    """latents: [B, h, w, latent_channels] -> images [B, H, W, 3] in [-1, 1]."""
+    z = latents / cfg.scaling_factor + cfg.shift_factor
+    x = nn.conv2d(p["conv_in"], z)
+    x = _resnet(p["mid_res1"], x)
+    x = _attn(p["mid_attn"], x)
+    x = _resnet(p["mid_res2"], x)
+    for i, blk in enumerate(p["ups"]):
+        for r in blk["res"]:
+            x = _resnet(r, x)
+        if "up_conv" in blk:
+            b, h, w, c = x.shape
+            x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+            x = nn.conv2d(blk["up_conv"], x)
+    x = jax.nn.silu(nn.groupnorm(p["norm_out"], x))
+    return nn.conv2d(p["conv_out"], x)
+
+
+def init_encoder(key, cfg: VAEConfig, dtype=jnp.float32):
+    mults = cfg.channel_multipliers
+    chans = [cfg.base_channels * m for m in mults]
+    keys = jax.random.split(key, 5 + len(mults))
+    p = {
+        "conv_in": nn.conv2d_init(keys[0], 3, chans[0], 3, dtype=dtype),
+        "downs": [],
+    }
+    cur = chans[0]
+    for i, ch in enumerate(chans):
+        ks = jax.random.split(keys[1 + i], cfg.layers_per_block + 2)
+        blk = {"res": []}
+        for j in range(cfg.layers_per_block):
+            blk["res"].append(_resnet_init(ks[j], cur, ch, dtype))
+            cur = ch
+        if i < len(chans) - 1:
+            blk["down_conv"] = nn.conv2d_init(ks[-1], cur, cur, 3, dtype=dtype)
+        p["downs"].append(blk)
+    top = chans[-1]
+    p["mid_res1"] = _resnet_init(keys[-3], top, top, dtype)
+    p["mid_attn"] = _attn_init(keys[-2], top, dtype)
+    p["mid_res2"] = _resnet_init(keys[-1], top, top, dtype)
+    p["norm_out"] = nn.groupnorm_init(top, dtype)
+    p["conv_out"] = nn.conv2d_init(
+        jax.random.fold_in(key, 9), top, 2 * cfg.latent_channels, 3, dtype=dtype
+    )
+    return p
+
+
+def encode(p, cfg: VAEConfig, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] in [-1, 1] -> latent mean [B, h, w, C] (scaled)."""
+    x = nn.conv2d(p["conv_in"], images)
+    for blk in p["downs"]:
+        for r in blk["res"]:
+            x = _resnet(r, x)
+        if "down_conv" in blk:
+            x = nn.conv2d(blk["down_conv"], x, stride=2)
+    x = _resnet(p["mid_res1"], x)
+    x = _attn(p["mid_attn"], x)
+    x = _resnet(p["mid_res2"], x)
+    x = jax.nn.silu(nn.groupnorm(p["norm_out"], x))
+    moments = nn.conv2d(p["conv_out"], x)
+    mean = moments[..., : cfg.latent_channels]
+    return (mean - cfg.shift_factor) * cfg.scaling_factor

@@ -1,0 +1,75 @@
+"""Model registries.
+
+``DiffusionModelRegistry`` mirrors the reference's lazy arch->pipeline map
+(vllm_omni/diffusion/registry.py:16-102, 17 pipelines); ``OmniModelRegistry``
+mirrors the AR model registry (model_executor/models/registry.py:65).
+Builders are lazy import paths so importing the registry stays light.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class _Entry:
+    module: str
+    attr: str
+
+    def load(self):
+        return getattr(importlib.import_module(self.module), self.attr)
+
+
+# arch name (as appears in model_index.json `_class_name` for diffusers
+# checkpoints) -> pipeline class
+_DIFFUSION_MODELS: dict[str, _Entry] = {
+    "QwenImagePipeline": _Entry(
+        "vllm_omni_tpu.models.qwen_image.pipeline", "QwenImagePipeline"
+    ),
+}
+
+# AR architectures -> model module (engine-facing)
+_AR_MODELS: dict[str, _Entry] = {}
+
+
+class DiffusionModelRegistry:
+    @staticmethod
+    def register(arch: str, module: str, attr: str) -> None:
+        _DIFFUSION_MODELS[arch] = _Entry(module, attr)
+
+    @staticmethod
+    def resolve(arch: str):
+        if arch not in _DIFFUSION_MODELS:
+            raise KeyError(
+                f"unknown diffusion architecture {arch!r}; known: "
+                f"{sorted(_DIFFUSION_MODELS)}"
+            )
+        return _DIFFUSION_MODELS[arch].load()
+
+    @staticmethod
+    def supported() -> list[str]:
+        return sorted(_DIFFUSION_MODELS)
+
+
+class OmniModelRegistry:
+    @staticmethod
+    def register(arch: str, module: str, attr: str) -> None:
+        _AR_MODELS[arch] = _Entry(module, attr)
+
+    @staticmethod
+    def resolve(arch: str):
+        if arch not in _AR_MODELS:
+            raise KeyError(
+                f"unknown AR architecture {arch!r}; known: {sorted(_AR_MODELS)}"
+            )
+        return _AR_MODELS[arch].load()
+
+    @staticmethod
+    def supported() -> list[str]:
+        return sorted(_AR_MODELS)

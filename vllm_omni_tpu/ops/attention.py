@@ -5,16 +5,19 @@ Replaces the reference's FlashAttention/SDPA/SageAttention backend stack
 the vLLM prefill attention kernels; SURVEY.md §2.10).  One kernel serves:
 
 - DiT block attention (non-causal, joint text+image sequences — the joint
-  QKV layout of backends/abstract.py:55 is handled by concatenating text
-  and image tokens before the call),
+  QKV layout of backends/abstract.py:13,55 is handled by concatenating text
+  and image tokens before the call, with the per-sequence text padding mask
+  passed as ``kv_mask``, the analogue of the reference's
+  encoder_hidden_states_mask),
 - AR prefill attention (causal, GQA),
 - the per-chunk inner step of ring attention (returns the logsumexp so
   chunk results merge with the numerically-stable LSE rule that
   ring/ring_utils.py `update_out_and_lse` implements in the reference).
 
-Layout: q [B, Sq, H, D]; k/v [B, Skv, Hkv, D] with Hkv | H (GQA).
-Online-softmax accumulation over KV blocks, fp32 accumulators in VMEM
-scratch, MXU matmuls via jnp.dot with preferred_element_type=f32.
+Layout: q [B, Sq, H, D]; k/v [B, Skv, Hkv, D] with Hkv | H (GQA);
+kv_mask [B, Skv] (1 = attend, 0 = masked).  Online-softmax accumulation
+over KV blocks, fp32 accumulators in VMEM scratch, MXU matmuls via
+jnp.dot with preferred_element_type=f32.
 """
 
 from __future__ import annotations
@@ -40,6 +43,7 @@ def attention_ref(
     causal: bool = False,
     scale: Optional[float] = None,
     return_lse: bool = False,
+    kv_mask: Optional[jax.Array] = None,  # [B, Skv]
 ):
     """Pure-JAX reference with identical semantics (fp32 softmax)."""
     b, sq, h, d = q.shape
@@ -57,6 +61,8 @@ def attention_ref(
         ki = jnp.arange(k.shape[1])[None, :]
         offset = k.shape[1] - sq  # q positions align to the KV suffix
         s = jnp.where(qi + offset >= ki, s, _NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -68,12 +74,11 @@ def attention_ref(
     return o
 
 
-def _flash_kernel(
+def _flash_core(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    lse_ref,
+    mask_ref,  # full [B, Skv] (tiny; whole array in VMEM) or None
     m_scr,
     l_scr,
     acc_scr,
@@ -81,14 +86,14 @@ def _flash_kernel(
     scale: float,
     causal: bool,
     kv_len: int,
-    q_len: int,
     causal_offset: int,
     block_q: int,
     block_k: int,
+    num_q_heads: int = 1,
 ):
+    """Shared online-softmax update for one (q_block, kv_block) pair."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
-    nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -110,11 +115,17 @@ def _flash_kernel(
         k = k_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
-        # Mask: KV padding + (optionally) causal.
+        # Mask: KV padding + per-sequence mask + (optionally) causal.
         k_idx = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         mask = k_idx < kv_len
+        if mask_ref is not None:
+            b_idx = pl.program_id(0) // num_q_heads
+            mrow = mask_ref[b_idx, pl.ds(k_start, block_k)]
+            # Out-of-range reads in a partial tail block are undefined but
+            # already excluded by the kv_len term of `mask`.
+            mask = mask & (mrow[None, :] > 0)
         if causal:
             q_idx = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -143,17 +154,40 @@ def _flash_kernel(
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
+
+def _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
     @pl.when(ki == nk - 1)
-    def _finalize():
+    def _():
         l = l_scr[:, :1]
         # Fully-masked rows (e.g. ring-attention chunks before this rank's
         # KV, or padded q rows) have l == 0: emit zeros / -inf lse.
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse = jnp.where(
-            l == 0.0, _NEG_INF, m_scr[:, :1] + jnp.log(l_safe)
+        if lse_ref is not None:
+            lse = jnp.where(
+                l == 0.0, _NEG_INF, m_scr[:, :1] + jnp.log(l_safe)
+            )
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _mk_kernel(with_lse: bool, with_mask: bool, **cfg):
+    def kernel(*refs):
+        i = 3 + (1 if with_mask else 0)
+        q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+        mask_ref = refs[3] if with_mask else None
+        outs = refs[i : i + 1 + (1 if with_lse else 0)]
+        o_ref = outs[0]
+        lse_ref = outs[1] if with_lse else None
+        m_scr, l_scr, acc_scr = refs[-3], refs[-2], refs[-1]
+        _flash_core(
+            q_ref, k_ref, v_ref, mask_ref, m_scr, l_scr, acc_scr, **cfg
         )
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+    return kernel
 
 
 @functools.partial(
@@ -168,14 +202,14 @@ def _flash_kernel(
     ),
 )
 def _flash_attention(
-    q, k, v, causal, scale, return_lse, block_q, block_k, use_pallas
+    q, k, v, kv_mask, causal, scale, return_lse, block_q, block_k, use_pallas
 ):
     b, sq, h, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if not use_pallas:
-        return attention_ref(q, k, v, causal, scale, return_lse)
+        return attention_ref(q, k, v, causal, scale, return_lse, kv_mask)
 
     group = h // hkv
     block_q = min(block_q, max(8, sq))
@@ -199,32 +233,52 @@ def _flash_attention(
         lambda bh, qi, ki, group=group: (bh // group, ki, 0),
         memory_space=pltpu.VMEM,
     )
-    o_spec = q_spec
-    lse_spec = pl.BlockSpec(
-        (1, block_q, 128),
-        lambda bh, qi, ki: (bh, qi, 0),
-        memory_space=pltpu.VMEM,
-    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [qx, kx, vx]
+    if kv_mask is not None:
+        # The mask is tiny (B x Skv int32) — keep the whole array in VMEM
+        # and slice per block in-kernel (a (1, block_k) blocked spec would
+        # violate the (8, 128) tiling rule on the batch axis).
+        in_specs.append(
+            pl.BlockSpec(
+                (b, skv),
+                lambda bh, qi, ki: (0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        inputs.append(kv_mask.astype(jnp.int32))
 
-    kernel = functools.partial(
-        _flash_kernel,
+    out_specs = [q_spec]
+    out_shapes = [jax.ShapeDtypeStruct((b * h, nq * block_q, d), q.dtype)]
+    if return_lse:
+        out_specs.append(
+            pl.BlockSpec(
+                (1, block_q, 128),
+                lambda bh, qi, ki: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        out_shapes.append(
+            jax.ShapeDtypeStruct((b * h, nq * block_q, 128), jnp.float32)
+        )
+
+    kernel = _mk_kernel(
+        return_lse,
+        kv_mask is not None,
         scale=scale,
         causal=causal,
         kv_len=skv,
-        q_len=sq,
         causal_offset=causal_offset,
         block_q=block_q,
         block_k=block_k,
+        num_q_heads=h,
     )
-    out, lse = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=(o_spec, lse_spec),
-        out_shape=(
-            jax.ShapeDtypeStruct((b * h, nq * block_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, nq * block_q, 128), jnp.float32),
-        ),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs) if return_lse else out_specs[0],
+        out_shape=tuple(out_shapes) if return_lse else out_shapes[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -234,12 +288,13 @@ def _flash_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret_flag(),
-    )(qx, kx, vx)
+    )(*inputs)
 
+    out = res[0] if return_lse else res
     out = out[:, :sq].reshape(b, h, sq, d)
     out = jnp.moveaxis(out, 1, 2)
     if return_lse:
-        return out, lse[:, :sq, 0].reshape(b, h, sq)
+        return out, res[1][:, :sq, 0].reshape(b, h, sq)
     return out
 
 
@@ -250,6 +305,7 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     return_lse: bool = False,
+    kv_mask: Optional[jax.Array] = None,
     block_q: int = 256,
     block_k: int = 256,
     use_pallas: Optional[bool] = None,
@@ -260,5 +316,6 @@ def flash_attention(
 
         use_pallas = pallas_mode() == "native"
     return _flash_attention(
-        q, k, v, causal, scale, return_lse, block_q, block_k, use_pallas
+        q, k, v, kv_mask, causal, scale, return_lse, block_q, block_k,
+        use_pallas,
     )

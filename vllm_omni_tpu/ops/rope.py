@@ -88,44 +88,46 @@ def apply_rope_ref(
     return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
 
 
-def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, heads: int, dim: int):
-    bt = x_ref.shape[0]
-    v = x_ref[:].reshape(bt, heads, dim).astype(jnp.float32)
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, dim: int):
+    # x_ref: [bt, H, D] 3D block — no in-kernel reshape (Mosaic layout
+    # inference rejects 2D->3D shape casts for small head dims).
     half = dim // 2
+    v = x_ref[:].astype(jnp.float32)
     x1 = v[..., :half]
     x2 = v[..., half:]
-    c = cos_ref[:].reshape(bt, 1, half)
-    s = sin_ref[:].reshape(bt, 1, half)
+    c = cos_ref[:].astype(jnp.float32)[:, None, :]
+    s = sin_ref[:].astype(jnp.float32)[:, None, :]
     o1 = x1 * c - x2 * s
     o2 = x2 * c + x1 * s
-    o = jnp.concatenate([o1, o2], axis=-1)
-    o_ref[:] = o.reshape(bt, heads * dim).astype(o_ref.dtype)
+    o_ref[:] = jnp.concatenate([o1, o2], axis=-1).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("use_pallas",))
 def _apply_rope(x, cos, sin, use_pallas):
-    if not use_pallas:
-        return apply_rope_ref(x, cos, sin)
     t, h, d = x.shape
-    x2 = x.reshape(t, h * d)
-    bt = max(8, min(t, 512))
+    # Pallas pays off only for MXU-aligned head dims; XLA fuses the rest.
+    if not use_pallas or d % 64 != 0:
+        return apply_rope_ref(x, cos, sin)
+    # Size the token block from a VMEM budget: the kernel holds several
+    # fp32 intermediates of the block shape, so keep one copy ~<=1MB.
+    budget_rows = (1 << 20) // (h * d * 4)
+    bt = max(8, min(t, budget_rows, 512))
     bt = max(8, (bt // 8) * 8)
     grid = (pl.cdiv(t, bt),)
-    out = pl.pallas_call(
-        functools.partial(_rope_kernel, heads=h, dim=d),
+    return pl.pallas_call(
+        functools.partial(_rope_kernel, dim=d),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bt, h * d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bt, h, d), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bt, d // 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bt, d // 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (bt, h * d), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (bt, h, d), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((t, h * d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((t, h, d), x.dtype),
         interpret=interpret_flag(),
-    )(x2, cos, sin)
-    return out.reshape(t, h, d)
+    )(x, cos, sin)
 
 
 def apply_rope(

@@ -102,6 +102,27 @@ def ring_attention(
     return o
 
 
+def _scatter_heads(x, axis):
+    # [B, S/u, H, D] -> [B, S, H/u, D]
+    return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _gather_heads(x, axis):
+    # [B, S, H/u, D] -> [B, S/u, H, D]
+    return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _slice_joint_heads(joint_k, joint_v, ulysses_axis, h):
+    """Slice replicated joint KV to this rank's head group (the reference's
+    ulysses.py:33-39 semantics)."""
+    u = jax.lax.axis_size(ulysses_axis)
+    idx = jax.lax.axis_index(ulysses_axis)
+    hh = h // u
+    jk = jax.lax.dynamic_slice_in_dim(joint_k, idx * hh, hh, axis=2)
+    jv = jax.lax.dynamic_slice_in_dim(joint_v, idx * hh, hh, axis=2)
+    return jk, jv
+
+
 def ulysses_attention(
     q: jax.Array,  # [B, S_local, H, D] (seq sharded over ulysses axis)
     k: jax.Array,
@@ -110,39 +131,30 @@ def ulysses_attention(
     causal: bool = False,
     joint_k: Optional[jax.Array] = None,
     joint_v: Optional[jax.Array] = None,
+    inner_fn=None,
 ) -> jax.Array:
     """Ulysses sequence parallelism: all_to_all heads<->sequence.
 
-    After the first all_to_all each rank holds the *full* sequence for
-    H/u heads; attention is local; the second all_to_all restores the
-    sequence sharding.  Joint (replicated) text KV is sliced per rank to
-    its head group — the reference's ulysses.py:33-39 semantics.
+    After the first all_to_all each rank holds the *full* (or ring-local)
+    sequence for H/u heads; ``inner_fn(q, k, v, joint_k, joint_v)`` runs
+    the local attention (default: dense flash); the second all_to_all
+    restores the sequence sharding.
     """
-    u = jax.lax.axis_size(ulysses_axis)
     h = q.shape[2]
-
-    def scatter_heads(x):
-        # [B, S/u, H, D] -> [B, S, H/u, D]
-        return jax.lax.all_to_all(
-            x, ulysses_axis, split_axis=2, concat_axis=1, tiled=True
-        )
-
-    def gather_heads(x):
-        # [B, S, H/u, D] -> [B, S/u, H, D]
-        return jax.lax.all_to_all(
-            x, ulysses_axis, split_axis=1, concat_axis=2, tiled=True
-        )
-
-    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    qg = _scatter_heads(q, ulysses_axis)
+    kg = _scatter_heads(k, ulysses_axis)
+    vg = _scatter_heads(v, ulysses_axis)
+    jk = jv = None
     if joint_k is not None:
-        idx = jax.lax.axis_index(ulysses_axis)
-        hh = h // u
-        kj = jax.lax.dynamic_slice_in_dim(joint_k, idx * hh, hh, axis=2)
-        vj = jax.lax.dynamic_slice_in_dim(joint_v, idx * hh, hh, axis=2)
-        kg = jnp.concatenate([kg, kj], axis=1)
-        vg = jnp.concatenate([vg, vj], axis=1)
-    o = flash_attention(qg, kg, vg, causal=causal)
-    return gather_heads(o)
+        jk, jv = _slice_joint_heads(joint_k, joint_v, ulysses_axis, h)
+    if inner_fn is None:
+        if jk is not None:
+            kg = jnp.concatenate([kg, jk], axis=1)
+            vg = jnp.concatenate([vg, jv], axis=1)
+        o = flash_attention(qg, kg, vg, causal=causal)
+    else:
+        o = inner_fn(qg, kg, vg, jk, jv)
+    return _gather_heads(o, ulysses_axis)
 
 
 def usp_attention(
@@ -167,24 +179,14 @@ def usp_attention(
         return ulysses_attention(
             q, k, v, ulysses_axis, joint_k=joint_k, joint_v=joint_v
         )
-
-    def scatter_heads(x):
-        return jax.lax.all_to_all(
-            x, ulysses_axis, split_axis=2, concat_axis=1, tiled=True
-        )
-
-    def gather_heads(x):
-        return jax.lax.all_to_all(
-            x, ulysses_axis, split_axis=1, concat_axis=2, tiled=True
-        )
-
-    h = q.shape[2]
-    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    jk = jv = None
-    if joint_k is not None:
-        idx = jax.lax.axis_index(ulysses_axis)
-        hh = h // u
-        jk = jax.lax.dynamic_slice_in_dim(joint_k, idx * hh, hh, axis=2)
-        jv = jax.lax.dynamic_slice_in_dim(joint_v, idx * hh, hh, axis=2)
-    o = ring_attention(qg, kg, vg, ring_axis, joint_k=jk, joint_v=jv)
-    return gather_heads(o)
+    return ulysses_attention(
+        q,
+        k,
+        v,
+        ulysses_axis,
+        joint_k=joint_k,
+        joint_v=joint_v,
+        inner_fn=lambda qg, kg, vg, jk, jv: ring_attention(
+            qg, kg, vg, ring_axis, joint_k=jk, joint_v=jv
+        ),
+    )

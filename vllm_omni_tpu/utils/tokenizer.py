@@ -1,0 +1,68 @@
+"""Self-contained tokenizers.
+
+``ByteTokenizer`` is the zero-dependency default used when no HF tokenizer
+files ship with a model (random-weight pipelines, tests, benches): UTF-8
+bytes + special tokens.  When a model directory carries a real HF
+tokenizer, ``load_tokenizer`` prefers it (transformers is in the image).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class ByteTokenizer:
+    PAD, BOS, EOS = 0, 1, 2
+    _SPECIALS = 3
+
+    def __init__(self, vocab_size: int = 512):
+        if vocab_size < 256 + self._SPECIALS:
+            # byte values collapse modulo the usable range
+            self.byte_span = vocab_size - self._SPECIALS
+        else:
+            self.byte_span = 256
+        self.vocab_size = vocab_size
+        self.pad_token_id = self.PAD
+        self.bos_token_id = self.BOS
+        self.eos_token_id = self.EOS
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = [self._SPECIALS + (b % self.byte_span) for b in text.encode()]
+        return ([self.BOS] if add_bos else []) + ids
+
+    def decode(self, ids) -> str:
+        bs = bytes(
+            (int(i) - self._SPECIALS) % max(1, self.byte_span)
+            for i in ids
+            if int(i) >= self._SPECIALS
+        )
+        return bs.decode(errors="replace")
+
+    def batch_encode(
+        self, texts: list[str], max_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Right-padded [B, max_len] ids + lengths."""
+        out = np.full((len(texts), max_len), self.PAD, np.int32)
+        lens = np.zeros((len(texts),), np.int32)
+        for i, t in enumerate(texts):
+            ids = self.encode(t)[:max_len]
+            out[i, : len(ids)] = ids
+            lens[i] = len(ids)
+        return out, lens
+
+
+def load_tokenizer(model_path: Optional[str], vocab_size: int = 512):
+    """HF tokenizer when available, byte fallback otherwise."""
+    if model_path and os.path.isdir(model_path):
+        for f in ("tokenizer.json", "tokenizer_config.json"):
+            if os.path.exists(os.path.join(model_path, f)):
+                try:
+                    from transformers import AutoTokenizer
+
+                    return AutoTokenizer.from_pretrained(model_path)
+                except Exception:
+                    break
+    return ByteTokenizer(vocab_size)
