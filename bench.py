@@ -1,0 +1,63 @@
+"""Benchmark driver: Qwen-Image DiT text->image on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the north-star bring-up config from BASELINE.md: 512px / 20-step /
+bs=1 single-device generation (reference methodology:
+benchmarks/diffusion/diffusion_benchmark_serving.py; the reference publishes
+no absolute numbers — BASELINE.json "published": {} — so vs_baseline is null).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main():
+    os.environ.setdefault("OMNI_TPU_LOG_LEVEL", "WARNING")
+
+    from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+    from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+
+    size = os.environ.get("OMNI_BENCH_SIZE", "bench")
+    height = width = int(os.environ.get("OMNI_BENCH_PX", "512"))
+    steps = int(os.environ.get("OMNI_BENCH_STEPS", "20"))
+    iters = int(os.environ.get("OMNI_BENCH_ITERS", "3"))
+
+    cfg = OmniDiffusionConfig(
+        model="qwen-image-bench", model_arch="QwenImagePipeline",
+        dtype="bfloat16", extra={"size": size},
+    )
+    engine = DiffusionEngine(cfg, warmup=False)
+
+    sp = OmniDiffusionSamplingParams(
+        height=height, width=width, num_inference_steps=steps,
+        guidance_scale=4.0, seed=0,
+    )
+
+    def one():
+        req = OmniDiffusionRequest(prompt=["a photo of a cat"], sampling_params=sp)
+        return engine.step(req)
+
+    one()  # compile warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        one()
+    dt = (time.perf_counter() - t0) / iters
+
+    print(json.dumps({
+        "metric": f"qwen_image_imgs_per_sec_chip_{height}px_{steps}step",
+        "value": round(1.0 / dt, 5),
+        "unit": "imgs/s",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,46 @@ def test_seed_determinism(tiny_pipe):
     np.testing.assert_array_equal(a[0].data, b[0].data)
 
 
+def test_unseeded_requests_differ(tiny_pipe):
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=1.0,
+        seed=None,
+    )
+    a = tiny_pipe.forward(OmniDiffusionRequest(prompt=["x"], sampling_params=sp))
+    b = tiny_pipe.forward(OmniDiffusionRequest(prompt=["x"], sampling_params=sp))
+    assert np.any(a[0].data != b[0].data)
+
+
+def test_num_images_per_prompt(tiny_pipe):
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=1.0,
+        seed=5, num_images_per_prompt=2,
+    )
+    outs = tiny_pipe.forward(
+        OmniDiffusionRequest(
+            prompt=["x"], request_ids=["r0"], sampling_params=sp
+        )
+    )
+    assert len(outs) == 2
+    assert [o.request_id for o in outs] == ["r0-0", "r0-1"]
+    assert np.any(outs[0].data != outs[1].data)
+
+
+def test_step_count_shares_one_executable(tiny_pipe):
+    """Different step counts at one geometry reuse the same jitted fn
+    (dynamic loop bound over the padded schedule)."""
+    for steps in (1, 2, 3):
+        sp = OmniDiffusionSamplingParams(
+            height=32, width=32, num_inference_steps=steps,
+            guidance_scale=1.0, seed=1,
+        )
+        tiny_pipe.forward(
+            OmniDiffusionRequest(prompt=["x"], sampling_params=sp)
+        )
+    keys = {k for k in tiny_pipe._denoise_cache if k[:2] == (8, 8)}
+    assert len(keys) == 1
+
+
 def test_cfg_path(tiny_pipe):
     sp = OmniDiffusionSamplingParams(
         height=32, width=32, num_inference_steps=2, guidance_scale=4.0,
@@ -86,6 +126,9 @@ def test_engine_from_config(tmp_path):
         model_arch="QwenImagePipeline",
         dtype="float32",
         size="tiny",
+        default_height=32,
+        default_width=32,
+        default_num_inference_steps=2,
     )
     eng = DiffusionEngine.make_engine(cfg)
     outs = eng.step(

@@ -101,6 +101,42 @@ def test_ulysses_attention_matches_dense(devices8, rng, with_joint):
 
 
 @pytest.mark.distributed
+@pytest.mark.parametrize("degrees", [(2, 4), (4, 2), (1, 8), (8, 1)])
+def test_usp_attention_joint_mask(devices8, rng, degrees):
+    """Padded joint text tokens are masked identically on the SP path
+    (the dense path's kv_mask semantics, transformer.py:264-273)."""
+    r, u = degrees
+    mesh = build_mesh(MeshConfig(ring_degree=r, ulysses_degree=u), devices8)
+    q, k, v, jk, jv = _mk(rng, with_joint=True)
+    jm = jnp.asarray(
+        np.arange(ST)[None, :] < np.array([ST // 2, ST])[:, None]
+    ).astype(jnp.int32)
+    seq = P(None, ("ring", "ulysses"), None, None)
+    rep = P(None, None, None, None)
+    rep2 = P(None, None)
+    out = shard_map(
+        lambda q, k, v, jk, jv, jm: usp_attention(
+            q, k, v, joint_k=jk, joint_v=jv, joint_mask=jm
+        ),
+        mesh=mesh,
+        in_specs=(seq, seq, seq, rep, rep, rep2),
+        out_specs=seq,
+    )(q, k, v, jk, jv, jm)
+    kv_mask = jnp.concatenate(
+        [jnp.ones((B, S), jnp.int32), jm], axis=1
+    )
+    want = attention_ref(
+        q,
+        jnp.concatenate([k, jk], axis=1),
+        jnp.concatenate([v, jv], axis=1),
+        kv_mask=kv_mask,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), atol=2e-4, rtol=1e-4
+    )
+
+
+@pytest.mark.distributed
 @pytest.mark.parametrize("degrees", [(2, 4), (4, 2)])
 @pytest.mark.parametrize("with_joint", [False, True])
 def test_usp_attention_matches_dense(devices8, rng, degrees, with_joint):

@@ -83,16 +83,22 @@ class DiffusionEngine:
         return cfg_type()
 
     def _warmup(self):
-        """Compile-warm the denoise loop with a 1-step tiny generation
-        (reference _dummy_run, diffusion_engine.py:316-343)."""
+        """Compile-warm the denoise loop with a 1-step generation at the
+        serving geometry (reference _dummy_run, diffusion_engine.py:316-343).
+        The step count is a dynamic loop bound (pipeline steps_bucket), so
+        the 1-step warmup compiles the same executable real requests use."""
         t0 = time.perf_counter()
-        ratio = self.pipeline.cfg.vae.spatial_ratio * self.pipeline.cfg.dit.patch_size
-        side = 4 * ratio
+        mult = (
+            self.pipeline.cfg.vae.spatial_ratio
+            * self.pipeline.cfg.dit.patch_size
+        )
+        height = max(mult, self.od_config.default_height // mult * mult)
+        width = max(mult, self.od_config.default_width // mult * mult)
         req = OmniDiffusionRequest(
             prompt=["warmup"],
             sampling_params=OmniDiffusionSamplingParams(
-                height=side, width=side, num_inference_steps=1,
-                guidance_scale=1.0, seed=0,
+                height=height, width=width, num_inference_steps=1,
+                guidance_scale=4.0, seed=0,
             ),
         )
         self.pipeline.forward(req)

@@ -8,8 +8,9 @@ encode_prompt (text-encoder hidden states) -> prepare latents/timesteps
 TPU-first: the whole denoise loop is ONE jitted computation
 (lax.fori_loop over steps — no per-step Python dispatch, no CUDA-graph
 machinery); CFG runs as a doubled batch (or, distributed, over the ``cfg``
-mesh axis); shapes are static per (H, W, steps) bucket so XLA caches one
-executable per resolution.
+mesh axis); shapes are static per (H, W) geometry — the step count is a
+dynamic loop bound over a padded schedule, so XLA caches one executable
+per resolution regardless of num_inference_steps.
 """
 
 from __future__ import annotations
@@ -50,6 +51,11 @@ class QwenImagePipelineConfig:
     max_text_len: int = 128
     shift: float = 1.0
     use_dynamic_shifting: bool = True
+    # Schedule arrays are padded to this bucket so the step count is a
+    # *dynamic* fori_loop bound: XLA compiles one executable per (H, W)
+    # geometry, not per step count, and a 1-step warmup warms the same
+    # executable that 50-step requests hit.
+    steps_bucket: int = 64
 
     @staticmethod
     def tiny() -> "QwenImagePipelineConfig":
@@ -127,8 +133,8 @@ class QwenImagePipeline:
         )
 
     # ------------------------------------------------------------ denoise
-    def _denoise_fn(self, grid_h: int, grid_w: int, num_steps: int):
-        key = (grid_h, grid_w, num_steps)
+    def _denoise_fn(self, grid_h: int, grid_w: int, sched_len: int):
+        key = (grid_h, grid_w, sched_len)
         if key in self._denoise_cache:
             return self._denoise_cache[key]
 
@@ -137,9 +143,11 @@ class QwenImagePipeline:
         @jax.jit
         def run(
             dit_params, latents, txt, txt_mask, neg_txt, neg_mask,
-            sigmas, timesteps, gscale,
+            sigmas, timesteps, gscale, num_steps,
         ):
-            # latents: [B, S_img, C_in]; txt/neg_txt: [B, S_txt, joint]
+            # latents: [B, S_img, C_in]; txt/neg_txt: [B, S_txt, joint];
+            # sigmas/timesteps padded to sched_len(+1); num_steps is a
+            # traced scalar — the loop bound is dynamic, the shapes static.
             schedule = fm.FlowMatchSchedule(sigmas=sigmas, timesteps=timesteps)
             do_cfg = neg_txt is not None
             txt_all = (
@@ -187,13 +195,20 @@ class QwenImagePipeline:
         lat_h, lat_w = sp.height // ratio, sp.width // ratio
         grid_h, grid_w = lat_h // patch, lat_w // patch
         seq_len = grid_h * grid_w
-        b = len(req.prompt)
+        n_per = max(1, sp.num_images_per_prompt)
+        prompts = [p for p in req.prompt for _ in range(n_per)]
+        b = len(prompts)
 
+        # Encode each unique prompt once, then repeat embeddings per image
+        # (reference repeats post-encode too, pipeline_qwen_image.py).
         if req.prompt_embeds is not None:
             txt = jnp.asarray(req.prompt_embeds, self.dtype)
             txt_mask = jnp.ones(txt.shape[:2], jnp.int32)
         else:
             txt, txt_mask = self.encode_prompt(req.prompt)
+        if n_per > 1:
+            txt = jnp.repeat(txt, n_per, axis=0)
+            txt_mask = jnp.repeat(txt_mask, n_per, axis=0)
         do_cfg = sp.guidance_scale > 1.0
         neg_txt = neg_mask = None
         if do_cfg:
@@ -202,10 +217,19 @@ class QwenImagePipeline:
                 neg_mask = jnp.ones(neg_txt.shape[:2], jnp.int32)
             else:
                 neg_txt, neg_mask = self.encode_prompt(
-                    [sp.negative_prompt] * b
+                    [sp.negative_prompt] * len(req.prompt)
                 )
+            if n_per > 1:
+                neg_txt = jnp.repeat(neg_txt, n_per, axis=0)
+                neg_mask = jnp.repeat(neg_mask, n_per, axis=0)
 
-        seed = sp.seed if sp.seed is not None else 0
+        # Unseeded requests sample a fresh seed (reference semantics: a
+        # torch Generator is only seeded when the user provides one).
+        seed = (
+            sp.seed
+            if sp.seed is not None
+            else int(np.random.randint(0, 2**31 - 1))
+        )
         noise = jax.random.normal(
             jax.random.PRNGKey(seed),
             (b, seq_len, cfg.dit.in_channels),
@@ -213,13 +237,21 @@ class QwenImagePipeline:
         ).astype(self.dtype)
 
         mu = fm.compute_dynamic_shift_mu(seq_len)
+        num_steps = sp.num_inference_steps
         schedule = fm.make_schedule(
-            sp.num_inference_steps,
+            num_steps,
             shift=cfg.shift,
             use_dynamic_shifting=cfg.use_dynamic_shifting,
             mu=mu,
         )
-        run = self._denoise_fn(grid_h, grid_w, sp.num_inference_steps)
+        sched_len = max(num_steps, cfg.steps_bucket)
+        sigmas = jnp.zeros((sched_len + 1,)).at[: num_steps + 1].set(
+            schedule.sigmas
+        )
+        timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
+            schedule.timesteps
+        )
+        run = self._denoise_fn(grid_h, grid_w, sched_len)
         latents = run(
             self.dit_params,
             noise,
@@ -227,19 +259,23 @@ class QwenImagePipeline:
             txt_mask,
             neg_txt,
             neg_mask,
-            schedule.sigmas,
-            schedule.timesteps,
+            sigmas,
+            timesteps,
             jnp.float32(sp.guidance_scale),
+            jnp.int32(num_steps),
         )
 
         images = self._decode_latents(latents, grid_h, grid_w)
         images = np.asarray(images)
         outs = []
-        for i in range(b):
+        for i, prompt in enumerate(prompts):
+            rid = req.request_ids[i // n_per]
+            if n_per > 1:
+                rid = f"{rid}-{i % n_per}"
             outs.append(
                 DiffusionOutput(
-                    request_id=req.request_ids[i],
-                    prompt=req.prompt[i],
+                    request_id=rid,
+                    prompt=prompt,
                     data=images[i],
                     output_type="image",
                 )

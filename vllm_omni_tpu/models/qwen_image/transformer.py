@@ -217,7 +217,10 @@ def block_forward(
         img_o = o[:, s_txt:].reshape(*img.shape[:2], -1)
     else:
         # Sequence-parallel path: image stream sharded, text KV joint.
-        img_o, txt_o = attn_fn(qi, ki, vi, qt, kt, vt)
+        # The text part of the mask rides along so padded text tokens are
+        # excluded on the distributed path too.
+        txt_kv_mask = None if kv_mask is None else kv_mask[:, : txt.shape[1]]
+        img_o, txt_o = attn_fn(qi, ki, vi, qt, kt, vt, txt_kv_mask)
 
     img = img + img_gate1 * nn.linear(blk["to_out"], img_o)
     txt = txt + txt_gate1 * nn.linear(blk["to_add_out"], txt_o)

@@ -36,6 +36,18 @@ import jax.numpy as jnp
 from vllm_omni_tpu.ops.attention import flash_attention
 
 
+def _joint_kv_mask(local_k, joint_mask):
+    """KV mask for [local KV ++ joint text KV]: local tokens are all real,
+    text tokens follow ``joint_mask`` ([B, S_text], 1=real 0=pad)."""
+    if joint_mask is None:
+        return None
+    b, s_local = local_k.shape[:2]
+    return jnp.concatenate(
+        [jnp.ones((b, s_local), jnp.int32), joint_mask.astype(jnp.int32)],
+        axis=1,
+    )
+
+
 def _merge_lse(o1, lse1, o2, lse2):
     """Merge two partial attention results with logsumexp weighting.
 
@@ -63,23 +75,29 @@ def ring_attention(
     ring_axis: str,
     joint_k: Optional[jax.Array] = None,  # [B, S_text, H, D] replicated
     joint_v: Optional[jax.Array] = None,
+    joint_mask: Optional[jax.Array] = None,  # [B, S_text] 1=real, 0=pad
 ) -> jax.Array:
     """Non-causal blockwise ring attention (DiT long-sequence attention).
 
     Each step attends the local Q against the currently-held KV block, then
     rotates the KV block to the next ring neighbour with ``ppermute``.
     Partial results merge via LSE.  The replicated joint text KV is attended
-    once at step 0 (reference ring_flash_attn.py:72-79 behaviour).
+    once at step 0 (reference ring_flash_attn.py:72-79 behaviour);
+    ``joint_mask`` zeroes attention mass on padded text tokens.
     """
     n = jax.lax.axis_size(ring_axis)
 
     k0, v0 = k, v
+    kv_mask = None
     if joint_k is not None:
         kj = jnp.concatenate([k0, joint_k], axis=1)
         vj = jnp.concatenate([v0, joint_v], axis=1)
+        kv_mask = _joint_kv_mask(k0, joint_mask)
     else:
         kj, vj = k0, v0
-    o, lse = flash_attention(q, kj, vj, causal=False, return_lse=True)
+    o, lse = flash_attention(
+        q, kj, vj, causal=False, kv_mask=kv_mask, return_lse=True
+    )
 
     if n == 1:
         return o
@@ -131,13 +149,14 @@ def ulysses_attention(
     causal: bool = False,
     joint_k: Optional[jax.Array] = None,
     joint_v: Optional[jax.Array] = None,
+    joint_mask: Optional[jax.Array] = None,
     inner_fn=None,
 ) -> jax.Array:
     """Ulysses sequence parallelism: all_to_all heads<->sequence.
 
     After the first all_to_all each rank holds the *full* (or ring-local)
-    sequence for H/u heads; ``inner_fn(q, k, v, joint_k, joint_v)`` runs
-    the local attention (default: dense flash); the second all_to_all
+    sequence for H/u heads; ``inner_fn(q, k, v, joint_k, joint_v, joint_mask)``
+    runs the local attention (default: dense flash); the second all_to_all
     restores the sequence sharding.
     """
     h = q.shape[2]
@@ -148,12 +167,14 @@ def ulysses_attention(
     if joint_k is not None:
         jk, jv = _slice_joint_heads(joint_k, joint_v, ulysses_axis, h)
     if inner_fn is None:
+        kv_mask = None
         if jk is not None:
+            kv_mask = _joint_kv_mask(kg, joint_mask)
             kg = jnp.concatenate([kg, jk], axis=1)
             vg = jnp.concatenate([vg, jv], axis=1)
-        o = flash_attention(qg, kg, vg, causal=causal)
+        o = flash_attention(qg, kg, vg, causal=causal, kv_mask=kv_mask)
     else:
-        o = inner_fn(qg, kg, vg, jk, jv)
+        o = inner_fn(qg, kg, vg, jk, jv, joint_mask)
     return _gather_heads(o, ulysses_axis)
 
 
@@ -165,19 +186,23 @@ def usp_attention(
     ring_axis: str = "ring",
     joint_k: Optional[jax.Array] = None,
     joint_v: Optional[jax.Array] = None,
+    joint_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """USP hybrid: ulysses head redistribution nested inside ring KV
     rotation (sequence_parallel_size = ulysses_degree x ring_degree)."""
     u = jax.lax.axis_size(ulysses_axis)
     r = jax.lax.axis_size(ring_axis)
     if u == 1 and r == 1:
+        kv_mask = None
         if joint_k is not None:
+            kv_mask = _joint_kv_mask(k, joint_mask)
             k = jnp.concatenate([k, joint_k], axis=1)
             v = jnp.concatenate([v, joint_v], axis=1)
-        return flash_attention(q, k, v, causal=False)
+        return flash_attention(q, k, v, causal=False, kv_mask=kv_mask)
     if r == 1:
         return ulysses_attention(
-            q, k, v, ulysses_axis, joint_k=joint_k, joint_v=joint_v
+            q, k, v, ulysses_axis,
+            joint_k=joint_k, joint_v=joint_v, joint_mask=joint_mask,
         )
     return ulysses_attention(
         q,
@@ -186,7 +211,8 @@ def usp_attention(
         ulysses_axis,
         joint_k=joint_k,
         joint_v=joint_v,
-        inner_fn=lambda qg, kg, vg, jk, jv: ring_attention(
-            qg, kg, vg, ring_axis, joint_k=jk, joint_v=jv
+        joint_mask=joint_mask,
+        inner_fn=lambda qg, kg, vg, jk, jv, jm: ring_attention(
+            qg, kg, vg, ring_axis, joint_k=jk, joint_v=jv, joint_mask=jm
         ),
     )
