@@ -1,0 +1,74 @@
+"""KV block-pool accounting (mirrors the reference scheduler's block
+lifecycle incl. transfer pinning, omni_ar_scheduler.py:444-594)."""
+
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.request import Request
+
+
+def _req(rid="r0", n=10):
+    return Request(request_id=rid, prompt_token_ids=list(range(n)))
+
+
+def test_allocate_and_slots():
+    kv = KVCacheManager(num_pages=8, page_size=4)
+    req = _req(n=10)
+    table = kv.allocate(req, 10)
+    assert len(table) == 3  # ceil(10/4)
+    assert kv.num_free_pages == 5
+    slots = kv.slot_mapping(req, 10)
+    assert len(slots) == 10
+    assert slots[0] == table[0] * 4
+    assert slots[4] == table[1] * 4
+    assert slots[9] == table[2] * 4 + 1
+
+
+def test_incremental_growth():
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    req = _req(n=4)
+    kv.allocate(req, 4)
+    req.num_computed_tokens = 4
+    # next token needs a new page
+    table = kv.allocate(req, 1)
+    assert len(table) == 2
+    assert kv.slot_mapping(req, 1) == [table[1] * 4]
+
+
+def test_free_returns_pages():
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    req = _req(n=16)
+    assert kv.allocate(req, 16) is not None
+    assert kv.num_free_pages == 0
+    kv.free(req)
+    assert kv.num_free_pages == 4
+
+
+def test_out_of_pages():
+    kv = KVCacheManager(num_pages=2, page_size=4)
+    r1, r2 = _req("a", 8), _req("b", 4)
+    assert kv.allocate(r1, 8) is not None
+    assert not kv.can_allocate(r2, 4)
+    assert kv.allocate(r2, 4) is None
+
+
+def test_pin_for_transfer_delays_free():
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    req = _req(n=10)
+    kv.allocate(req, 10)
+    snapshot = kv.pin_for_transfer(req, 6)  # 6 tokens -> 2 pages
+    assert len(snapshot) == 2
+    kv.free(req)
+    # 3 pages allocated, 2 pinned -> only 1 + 1 untouched free
+    assert kv.num_free_pages == 2
+    kv.ack_transfer(req.request_id)
+    assert kv.num_free_pages == 4
+
+
+def test_ack_with_live_table_keeps_pages():
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    req = _req(n=8)
+    kv.allocate(req, 8)
+    kv.pin_for_transfer(req, 8)
+    kv.ack_transfer(req.request_id)  # request still running
+    assert kv.num_free_pages == 2
+    kv.free(req)
+    assert kv.num_free_pages == 4

@@ -1,0 +1,166 @@
+"""Scheduler behavioral contract (ports the reference's OmniARScheduler /
+OmniGenerationScheduler semantics, core/sched/*.py)."""
+
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.core.scheduler import (
+    ARScheduler,
+    GenerationScheduler,
+    KVTransferConfig,
+    SchedulerConfig,
+)
+from vllm_omni_tpu.request import KVTransferState, Request, RequestStatus
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+def _mk(cfg=None, pages=64, page_size=4, cls=ARScheduler):
+    cfg = cfg or SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                                 max_model_len=64)
+    return cls(cfg, KVCacheManager(pages, page_size))
+
+
+def _req(rid, n=8, max_tokens=4, **kw):
+    return Request(request_id=rid, prompt_token_ids=list(range(n)),
+                   sampling_params=SamplingParams(max_tokens=max_tokens), **kw)
+
+
+def test_prefill_then_decode_lifecycle():
+    s = _mk()
+    s.add_request(_req("a", n=8, max_tokens=2))
+    out = s.schedule()
+    assert len(out.prefills) == 1 and not out.decodes
+    assert out.prefills[0].num_new_tokens == 8
+    finished = s.update_from_output(out, {"a": 42})
+    assert not finished
+    req = s.running[0]
+    assert req.output_token_ids == [42]
+    assert req.num_computed_tokens == 8
+
+    out2 = s.schedule()
+    assert len(out2.decodes) == 1 and not out2.prefills
+    d = out2.decodes[0]
+    assert d.start_pos == 8 and d.num_new_tokens == 1
+    finished = s.update_from_output(out2, {"a": 7})
+    assert len(finished) == 1  # max_tokens=2 reached
+    assert finished[0].status == RequestStatus.FINISHED_LENGTH
+    assert not s.has_unfinished
+
+
+def test_eos_stops():
+    s = _mk()
+    req = _req("a", n=4, max_tokens=10)
+    req.eos_token_id = 99
+    s.add_request(req)
+    out = s.schedule()
+    finished = s.update_from_output(out, {"a": 99})
+    assert finished and finished[0].status == RequestStatus.FINISHED_STOPPED
+    assert finished[0].finish_reason == "stop"
+
+
+def test_token_budget_defers_waiting():
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=10,
+                          max_model_len=64)
+    s = _mk(cfg)
+    s.add_request(_req("a", n=8))
+    s.add_request(_req("b", n=8))  # doesn't fit in the same step
+    out = s.schedule()
+    assert len(out.prefills) == 1
+    s.update_from_output(out, {"a": 1})
+    out2 = s.schedule()
+    # b prefills now, a decodes
+    assert {sc.request.request_id for sc in out2.prefills} == {"b"}
+    assert {sc.request.request_id for sc in out2.decodes} == {"a"}
+
+
+def test_max_num_seqs_limit():
+    cfg = SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=1024,
+                          max_model_len=64)
+    s = _mk(cfg)
+    for rid in "abc":
+        s.add_request(_req(rid, n=4))
+    out = s.schedule()
+    assert len(out.prefills) == 2
+    assert len(s.waiting) == 1
+
+
+def test_preemption_recompute_on_page_exhaustion():
+    # 4 pages of 4 slots = 16 tokens total; two 8-token requests fill it,
+    # the first decode token forces a preemption
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                          max_model_len=64)
+    s = _mk(cfg, pages=4, page_size=4)
+    s.add_request(_req("a", n=8, max_tokens=8))
+    s.add_request(_req("b", n=8, max_tokens=8))
+    out = s.schedule()
+    assert len(out.prefills) == 2
+    s.update_from_output(out, {"a": 1, "b": 1})
+    out2 = s.schedule()
+    assert out2.preempted, "one request must be preempted on page exhaustion"
+    victim = out2.preempted[0]
+    assert victim.status == RequestStatus.PREEMPTED
+    assert victim.num_computed_tokens == 0  # recompute policy
+    assert victim in s.waiting
+    # the survivor still decoded
+    assert len(out2.decodes) == 1
+
+
+def test_kv_transfer_trigger_on_prefill_finished():
+    cfg = SchedulerConfig(
+        max_num_seqs=4, max_num_batched_tokens=64, max_model_len=64,
+        kv_transfer=KVTransferConfig(trigger="prefill_finished"),
+    )
+    s = _mk(cfg)
+    s.add_request(_req("a", n=8, max_tokens=4))
+    out = s.schedule()
+    s.update_from_output(out, {"a": 5})
+    req = s.running[0]
+    assert req.kv_transfer == KVTransferState.ACTIVE
+    # the transfer rides the *next* schedule() so the runner extracts at
+    # the start of its step (reference: gpu_ar_model_runner.py:100-106)
+    out2 = s.schedule()
+    assert out2.kv_transfer_requests
+    _, block_ids, seq_len = out2.kv_transfer_requests[0]
+    # only computed tokens are in the cache (the sampled token's KV is
+    # written next step)
+    assert seq_len == 8
+    assert len(block_ids) == 2  # ceil(8/4)
+    # ACK frees the pin
+    s.update_from_output(out2, {"a": 6}, kv_extracted_req_ids={"a"})
+    assert req.kv_transfer == KVTransferState.DONE
+
+
+def test_kv_transfer_special_token_trigger():
+    cfg = SchedulerConfig(
+        max_num_seqs=4, max_num_batched_tokens=64, max_model_len=64,
+        kv_transfer=KVTransferConfig(trigger="special_token",
+                                     special_token_id=77),
+    )
+    s = _mk(cfg)
+    s.add_request(_req("a", n=4, max_tokens=8))
+    out = s.schedule()
+    s.update_from_output(out, {"a": 5})
+    assert s.running[0].kv_transfer == KVTransferState.PENDING
+    out2 = s.schedule()
+    s.update_from_output(out2, {"a": 77})
+    assert s.running[0].kv_transfer == KVTransferState.ACTIVE
+
+
+def test_generation_scheduler_one_shot():
+    s = _mk(cls=GenerationScheduler)
+    s.add_request(_req("a", n=12))
+    s.add_request(_req("b", n=6))
+    out = s.schedule()
+    assert len(out.prefills) == 2
+    assert all(sc.num_new_tokens == sc.request.num_prompt_tokens
+               for sc in out.prefills)
+    finished = s.update_from_output(out, {})
+    assert len(finished) == 2
+    assert not s.has_unfinished
+    # all pages returned
+    assert s.kv.num_free_pages == 64
+
+
+def test_abort():
+    s = _mk()
+    s.add_request(_req("a", n=4))
+    s.abort_request("a")
+    assert not s.has_unfinished

@@ -1,0 +1,15 @@
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.core.scheduler import (
+    ARScheduler,
+    GenerationScheduler,
+    SchedulerConfig,
+    SchedulerOutput,
+)
+
+__all__ = [
+    "ARScheduler",
+    "GenerationScheduler",
+    "KVCacheManager",
+    "SchedulerConfig",
+    "SchedulerOutput",
+]

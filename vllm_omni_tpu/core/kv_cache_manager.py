@@ -1,0 +1,112 @@
+"""Host-side paged KV-cache block pool.
+
+The behavioral port of vLLM's KVCacheManager slice that the reference's
+``OmniARScheduler`` leans on (reference: core/sched/omni_ar_scheduler.py —
+block allocation during schedule(), block-id snapshots for KV transfer at
+:553-594, delayed free until extraction ACK at :444-546).
+
+Device arrays never appear here: this class hands out integer page ids; the
+model runner turns them into ``block_tables`` / ``slot_mapping`` arrays for
+the Pallas paged-attention kernel (ops/paged_attention.py).  One pool is
+shared by all layers — every layer uses the same page ids, so the per-layer
+caches stay aligned (same layout the TPU kernel wants).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_omni_tpu.request import Request
+
+
+@dataclass
+class KVCacheConfig:
+    num_pages: int
+    page_size: int
+
+
+class KVCacheManager:
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 1 or page_size < 1:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(num_pages))
+        # request_id -> allocated page ids, in sequence order
+        self._tables: dict[str, list[int]] = {}
+        # pages pinned by an in-flight KV transfer even after request free
+        # (reference: delayed _free_request while transfer ACTIVE)
+        self._pinned: dict[str, list[int]] = {}
+
+    # ------------------------------------------------------------- queries
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.page_size)
+
+    def block_table(self, request_id: str) -> list[int]:
+        return list(self._tables.get(request_id, ()))
+
+    def can_allocate(self, request: Request, num_new_tokens: int) -> bool:
+        have = len(self._tables.get(request.request_id, ()))
+        need = self.pages_needed(request.num_computed_tokens + num_new_tokens)
+        return need - have <= len(self._free)
+
+    # ---------------------------------------------------------- allocation
+    def allocate(self, request: Request, num_new_tokens: int) -> Optional[list[int]]:
+        """Grow the request's table to cover ``num_computed_tokens +
+        num_new_tokens``; returns the full table, or None if out of pages."""
+        table = self._tables.setdefault(request.request_id, [])
+        need = self.pages_needed(request.num_computed_tokens + num_new_tokens)
+        grow = need - len(table)
+        if grow > len(self._free):
+            return None
+        for _ in range(max(grow, 0)):
+            table.append(self._free.pop())
+        return list(table)
+
+    def slot_mapping(self, request: Request, num_new_tokens: int) -> list[int]:
+        """Flat slots (page*page_size + offset) for the next
+        ``num_new_tokens`` tokens starting at num_computed_tokens."""
+        table = self._tables[request.request_id]
+        start = request.num_computed_tokens
+        slots = []
+        for i in range(num_new_tokens):
+            pos = start + i
+            slots.append(table[pos // self.page_size] * self.page_size
+                         + pos % self.page_size)
+        return slots
+
+    # ---------------------------------------------------------------- free
+    def free(self, request: Request) -> None:
+        """Release the request's pages — unless a KV transfer pinned them
+        (then they are released by ack_transfer)."""
+        table = self._tables.pop(request.request_id, None)
+        if table is None:
+            return
+        pinned = set(self._pinned.get(request.request_id, ()))
+        for page in table:
+            if page not in pinned:
+                self._free.append(page)
+
+    def pin_for_transfer(self, request: Request, seq_len: int) -> list[int]:
+        """Snapshot + pin the pages holding the first ``seq_len`` tokens
+        (reference: block-id snapshot truncated to seq_len,
+        omni_ar_scheduler.py:553-594)."""
+        table = self._tables.get(request.request_id, [])
+        keep = self.pages_needed(seq_len)
+        snapshot = table[:keep]
+        self._pinned[request.request_id] = list(snapshot)
+        return list(snapshot)
+
+    def ack_transfer(self, request_id: str) -> None:
+        """Extraction ACK: release pinned pages not still in a live table
+        (reference: free on kv_extracted_req_ids, omni_ar_scheduler.py:444)."""
+        pinned = self._pinned.pop(request_id, [])
+        live = set(self._tables.get(request_id, ()))
+        for page in pinned:
+            if page not in live:
+                self._free.append(page)

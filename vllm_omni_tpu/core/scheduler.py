@@ -1,0 +1,338 @@
+"""Continuous-batching schedulers.
+
+Behavioral port of the reference's two scheduler subclasses onto a
+device-agnostic, host-side core:
+
+- ``ARScheduler``   ≈ OmniARScheduler (reference:
+  core/sched/omni_ar_scheduler.py:40) — waiting/running queues, chunked
+  prefill under a token budget, preemption by recompute, plus the
+  cross-stage KV-transfer lifecycle: trigger criteria (prefill_finished /
+  special_token, :84-136), block snapshot (:553-594), delayed free until
+  extraction ACK (:444-546).
+- ``GenerationScheduler`` ≈ OmniGenerationScheduler (reference:
+  core/sched/omni_generation_scheduler.py:25) — one-shot generators
+  (code2wav / DiT-as-stage): the whole prompt is scheduled at once and the
+  request finishes in a single step.
+
+The scheduler never touches jax; its output is plain ints/lists which the
+model runner buckets and pads into device arrays.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.request import KVTransferState, Request, RequestStatus
+
+
+@dataclass
+class KVTransferConfig:
+    """When to trigger cross-stage KV extraction for a request
+    (reference: omni_ar_scheduler.py:84-136)."""
+
+    trigger: str = "prefill_finished"  # or "special_token"
+    special_token_id: Optional[int] = None
+
+
+@dataclass
+class SchedulerConfig:
+    max_num_seqs: int = 8
+    max_num_batched_tokens: int = 2048
+    max_model_len: int = 4096
+    # Off by default: chunk-continuation attention (new chunk attending to
+    # cached KV of earlier chunks) lands with the ragged prefill kernel.
+    enable_chunked_prefill: bool = False
+    kv_transfer: Optional[KVTransferConfig] = None
+
+
+@dataclass
+class ScheduledRequest:
+    request: Request
+    num_new_tokens: int
+    slot_mapping: list[int]
+    block_table: list[int]
+    # position of the first new token (== num_computed_tokens at schedule)
+    start_pos: int
+
+    @property
+    def is_prefill(self) -> bool:
+        return self.start_pos < self.request.num_prompt_tokens
+
+
+@dataclass
+class SchedulerOutput:
+    prefills: list[ScheduledRequest] = field(default_factory=list)
+    decodes: list[ScheduledRequest] = field(default_factory=list)
+    preempted: list[Request] = field(default_factory=list)
+    # requests whose KV must be extracted+shipped this step
+    # (reference: OmniSchedulerOutput.finished_requests_needing_kv_transfer)
+    kv_transfer_requests: list[tuple[Request, list[int], int]] = field(
+        default_factory=list
+    )
+
+    @property
+    def num_scheduled(self) -> int:
+        return len(self.prefills) + len(self.decodes)
+
+
+class ARScheduler:
+    def __init__(self, config: SchedulerConfig, kv_manager: KVCacheManager):
+        self.config = config
+        self.kv = kv_manager
+        self.waiting: list[Request] = []
+        self.running: list[Request] = []
+        self._finished_ids: set[str] = set()
+        # transfers triggered in update_from_output, delivered to the runner
+        # via the *next* schedule() output (the reference's runner handles
+        # them at the start of execute_model, gpu_ar_model_runner.py:100-106)
+        self._pending_kv_transfers: list[tuple[Request, list[int], int]] = []
+        # requests rejected at intake; drained by the engine into outputs
+        self._errored: list[Request] = []
+
+    # ------------------------------------------------------------- intake
+    def add_request(self, request: Request) -> None:
+        if request.num_prompt_tokens > self.config.max_model_len:
+            request.status = RequestStatus.FINISHED_ERROR
+            self._finished_ids.add(request.request_id)
+            self._errored.append(request)
+            return
+        request.status = RequestStatus.WAITING
+        if self.config.kv_transfer is not None:
+            request.kv_transfer = KVTransferState.PENDING
+        self.waiting.append(request)
+
+    def abort_request(self, request_id: str) -> None:
+        for queue in (self.waiting, self.running):
+            for req in queue:
+                if req.request_id == request_id:
+                    req.status = RequestStatus.FINISHED_ABORTED
+                    queue.remove(req)
+                    self._free_request(req)
+                    return
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ----------------------------------------------------------- schedule
+    def schedule(self) -> SchedulerOutput:
+        out = SchedulerOutput()
+        out.kv_transfer_requests = self.drain_pending_kv_transfers()
+        budget = self.config.max_num_batched_tokens
+
+        # 1. running requests decode first (one token each) — prioritize
+        #    latency of in-flight sequences, preempting the newest on OOM
+        #    (recompute policy, matching vLLM's default the reference extends).
+        still_running: list[Request] = []
+        snapshot = list(self.running)
+        for i, req in enumerate(snapshot):
+            if req.status is not RequestStatus.RUNNING:
+                continue  # preempted earlier in this very loop
+            if budget <= 0:
+                still_running.append(req)
+                continue
+            if not self.kv.can_allocate(req, 1):
+                # victims come only from *unscheduled* requests (later in
+                # the priority order) — preempting one already in
+                # out.decodes would free pages its scheduled KV write
+                # still targets
+                out.preempted.extend(self._preempt_for(req, snapshot[i + 1:]))
+                if not self.kv.can_allocate(req, 1):
+                    # still not enough: preempt this request itself
+                    self._preempt(req)
+                    out.preempted.append(req)
+                    continue
+            table = self.kv.allocate(req, 1)
+            if table is None:
+                self._preempt(req)
+                out.preempted.append(req)
+                continue
+            slots = self.kv.slot_mapping(req, 1)
+            out.decodes.append(ScheduledRequest(
+                request=req, num_new_tokens=1, slot_mapping=slots,
+                block_table=table, start_pos=req.num_computed_tokens,
+            ))
+            budget -= 1
+            still_running.append(req)
+        self.running = still_running
+
+        # 2. admit waiting requests (chunked prefill under the budget).
+        # num_tokens (not num_prompt_tokens): a preempted request resumes by
+        # recomputing KV for its prompt *and* its already-generated tokens.
+        while self.waiting and budget > 0 and len(self.running) < self.config.max_num_seqs:
+            req = self.waiting[0]
+            remaining = req.num_tokens - req.num_computed_tokens
+            if self.config.enable_chunked_prefill:
+                chunk = min(remaining, budget)
+            elif remaining > budget:
+                break  # whole prompt must fit this step's budget
+            else:
+                chunk = remaining
+            if chunk <= 0 or not self.kv.can_allocate(req, chunk):
+                break
+            table = self.kv.allocate(req, chunk)
+            if table is None:
+                break
+            slots = self.kv.slot_mapping(req, chunk)
+            out.prefills.append(ScheduledRequest(
+                request=req, num_new_tokens=chunk, slot_mapping=slots,
+                block_table=table, start_pos=req.num_computed_tokens,
+            ))
+            budget -= chunk
+            self.waiting.pop(0)
+            req.status = RequestStatus.RUNNING
+            self.running.append(req)
+        return out
+
+    def _preempt(self, req: Request) -> None:
+        """Recompute-preemption: free pages, reset progress, back to waiting."""
+        self.kv.free(req)
+        req.num_computed_tokens = 0
+        req.status = RequestStatus.PREEMPTED
+        if req in self.running:
+            self.running.remove(req)
+        self.waiting.insert(0, req)
+
+    def _preempt_for(
+        self, req: Request, candidates: list[Request]
+    ) -> list[Request]:
+        """Preempt newest-first from ``candidates`` until ``req`` fits;
+        returns the victims (possibly insufficient — caller rechecks)."""
+        preempted = []
+        for victim in reversed(candidates):
+            if victim is req or victim.status is not RequestStatus.RUNNING:
+                continue
+            self._preempt(victim)
+            preempted.append(victim)
+            if self.kv.can_allocate(req, 1):
+                break
+        return preempted
+
+    # ------------------------------------------------------ update (post-run)
+    def update_from_output(
+        self,
+        scheduler_output: SchedulerOutput,
+        sampled: dict[str, int],
+        kv_extracted_req_ids: Optional[set[str]] = None,
+    ) -> list[Request]:
+        """Advance request state after the runner executed a step.
+
+        ``sampled`` maps request_id -> new token for every request whose
+        forward covered its last prompt token (i.e. actually sampled).
+        ``kv_extracted_req_ids`` ACKs completed KV extractions so pinned
+        pages can be freed (reference: omni_ar_scheduler.py:444-471).
+        Returns the list of requests that finished this step.
+        """
+        finished: list[Request] = []
+        for sched in scheduler_output.prefills + scheduler_output.decodes:
+            req = sched.request
+            req.num_computed_tokens += sched.num_new_tokens
+            token = sampled.get(req.request_id)
+            if token is None:
+                continue  # mid-prefill chunk: nothing sampled yet
+            req.append_output_token(token)
+            self._maybe_trigger_kv_transfer(req)
+            stopped = req.check_stop()
+            if not stopped and req.num_tokens >= self.config.max_model_len:
+                req.status = RequestStatus.FINISHED_LENGTH
+                stopped = True
+            if stopped:
+                finished.append(req)
+                self.running.remove(req)
+                self._free_request(req)
+        if kv_extracted_req_ids:
+            for rid in kv_extracted_req_ids:
+                self._ack_kv_transfer(rid)
+        return finished
+
+    # ----------------------------------------------------- kv transfer hooks
+    def drain_errored(self) -> list[Request]:
+        errored, self._errored = self._errored, []
+        return errored
+
+    def drain_pending_kv_transfers(self) -> list[tuple[Request, list[int], int]]:
+        pending, self._pending_kv_transfers = self._pending_kv_transfers, []
+        return pending
+
+    def _maybe_trigger_kv_transfer(self, req: Request) -> None:
+        cfg = self.config.kv_transfer
+        if cfg is None or req.kv_transfer is not KVTransferState.PENDING:
+            return
+        trigger = False
+        if cfg.trigger == "prefill_finished":
+            trigger = req.num_computed_tokens >= req.num_prompt_tokens
+        elif cfg.trigger == "special_token":
+            trigger = (cfg.special_token_id is not None
+                       and req.output_token_ids
+                       and req.output_token_ids[-1] == cfg.special_token_id)
+        if not trigger:
+            return
+        # Only tokens whose KV is actually in the cache: the token sampled
+        # this step is written at the *next* step's decode.
+        seq_len = req.num_computed_tokens
+        block_ids = self.kv.pin_for_transfer(req, seq_len)
+        req.kv_transfer = KVTransferState.ACTIVE
+        req.kv_transfer_block_ids = block_ids
+        req.kv_transfer_seq_len = seq_len
+        self._pending_kv_transfers.append((req, block_ids, seq_len))
+
+    def _ack_kv_transfer(self, request_id: str) -> None:
+        self.kv.ack_transfer(request_id)
+        for queue in (self.running, self.waiting):
+            for req in queue:
+                if req.request_id == request_id:
+                    req.kv_transfer = KVTransferState.DONE
+                    return
+
+    def _free_request(self, req: Request) -> None:
+        """Free pages unless a transfer is still ACTIVE (delayed free,
+        reference: omni_ar_scheduler.py:473-546 — pinned pages survive)."""
+        self._finished_ids.add(req.request_id)
+        self.kv.free(req)
+
+
+class GenerationScheduler(ARScheduler):
+    """One-shot generation fast path (reference:
+    omni_generation_scheduler.py:33-261): the entire prompt is allocated and
+    scheduled in one step; there is no decode phase — the model's forward
+    produces the final (multimodal) output and the request finishes
+    (:362-377)."""
+
+    def schedule(self) -> SchedulerOutput:
+        out = SchedulerOutput()
+        while self.waiting and len(self.running) < self.config.max_num_seqs:
+            req = self.waiting[0]
+            n = req.num_prompt_tokens
+            if not self.kv.can_allocate(req, n):
+                break
+            table = self.kv.allocate(req, n)
+            if table is None:
+                break
+            slots = self.kv.slot_mapping(req, n)
+            out.prefills.append(ScheduledRequest(
+                request=req, num_new_tokens=n, slot_mapping=slots,
+                block_table=table, start_pos=0,
+            ))
+            self.waiting.pop(0)
+            req.status = RequestStatus.RUNNING
+            self.running.append(req)
+        return out
+
+    def update_from_output(
+        self,
+        scheduler_output: SchedulerOutput,
+        sampled: dict[str, int],
+        kv_extracted_req_ids: Optional[set[str]] = None,
+    ) -> list[Request]:
+        finished: list[Request] = []
+        for sched in scheduler_output.prefills:
+            req = sched.request
+            req.num_computed_tokens += sched.num_new_tokens
+            req.status = RequestStatus.FINISHED_STOPPED
+            finished.append(req)
+            self.running.remove(req)
+            self._free_request(req)
+        return finished

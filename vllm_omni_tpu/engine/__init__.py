@@ -1,0 +1,3 @@
+from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+
+__all__ = ["EngineConfig", "LLMEngine"]

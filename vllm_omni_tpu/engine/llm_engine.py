@@ -1,0 +1,169 @@
+"""AR engine facade: scheduler + runner step loop.
+
+The TPU-native collapse of the reference's LLMEngine → EngineCore →
+worker-process chain (reference call stack SURVEY.md §3.2: OmniARScheduler
+.schedule → GPUARModelRunner.execute_model/sample_tokens →
+update_from_output).  On TPU the intra-stage fan-out is pjit over a mesh,
+so the engine is a single-process object: schedule → jitted step → update.
+
+``worker_type`` selects the scheduler the way the reference's
+OmniModelConfig.worker_type picks AR vs generation workers
+(reference: config/model.py:46-60).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.core.scheduler import (
+    ARScheduler,
+    GenerationScheduler,
+    KVTransferConfig,
+    SchedulerConfig,
+    SchedulerOutput,
+)
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.request import Request
+from vllm_omni_tpu.sampling_params import SamplingParams
+from vllm_omni_tpu.worker.model_runner import ARModelRunner
+
+
+@dataclass
+class EngineConfig:
+    num_pages: int = 256
+    page_size: int = 16
+    max_model_len: int = 4096
+    max_num_seqs: int = 8
+    max_num_batched_tokens: int = 2048
+    worker_type: str = "ar"  # "ar" | "generation"
+    dtype: Any = jnp.bfloat16
+    kv_transfer: Optional[KVTransferConfig] = None
+    collect_hidden: bool = False
+    seed: Optional[int] = None  # pins sampling entropy for reproducibility
+
+
+class LLMEngine:
+    def __init__(self, params, model_cfg: tfm.TransformerConfig,
+                 config: Optional[EngineConfig] = None,
+                 eos_token_id: Optional[int] = None):
+        config = config if config is not None else EngineConfig()
+        self.config = config
+        self.eos_token_id = eos_token_id
+        kv = KVCacheManager(config.num_pages, config.page_size)
+        sched_cfg = SchedulerConfig(
+            max_num_seqs=config.max_num_seqs,
+            max_num_batched_tokens=config.max_num_batched_tokens,
+            max_model_len=config.max_model_len,
+            kv_transfer=config.kv_transfer,
+        )
+        sched_cls = (GenerationScheduler if config.worker_type == "generation"
+                     else ARScheduler)
+        self.scheduler = sched_cls(sched_cfg, kv)
+        self.runner = ARModelRunner(
+            params, model_cfg,
+            num_pages=config.num_pages, page_size=config.page_size,
+            max_model_len=config.max_model_len, dtype=config.dtype,
+            collect_hidden=config.collect_hidden, seed=config.seed,
+        )
+        # connector hook: called with (request, kv_payload) when a
+        # cross-stage KV extraction completes (OmniKVTransferManager put)
+        self.kv_transfer_sink: Optional[Callable] = None
+        self._req_counter = 0
+
+    # ------------------------------------------------------------- intake
+    def add_request(
+        self,
+        prompt_token_ids: list[int],
+        sampling_params: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+        **kwargs,
+    ) -> str:
+        if request_id is None:
+            request_id = f"req-{self._req_counter}"
+            self._req_counter += 1
+        req = Request(
+            request_id=request_id,
+            prompt_token_ids=list(prompt_token_ids),
+            sampling_params=sampling_params or SamplingParams(),
+            eos_token_id=self.eos_token_id,
+            arrival_time=time.time(),
+            **kwargs,
+        )
+        self.scheduler.add_request(req)
+        return request_id
+
+    def abort_request(self, request_id: str) -> None:
+        self.scheduler.abort_request(request_id)
+
+    @property
+    def has_unfinished_requests(self) -> bool:
+        return self.scheduler.has_unfinished
+
+    # ---------------------------------------------------------------- step
+    def step(self) -> list[OmniRequestOutput]:
+        # surface intake-rejected requests as errored outputs instead of
+        # silently dropping them
+        errored = [OmniRequestOutput.from_pipeline(r)
+                   for r in self.scheduler.drain_errored()]
+        sched_out = self.scheduler.schedule()
+        if sched_out.num_scheduled == 0:
+            # deadlock guard: nothing runnable but requests remain
+            if self.scheduler.has_unfinished:
+                raise RuntimeError(
+                    "scheduler starved: no request fits in the KV cache "
+                    f"({self.scheduler.kv.num_free_pages} pages free)"
+                )
+            return errored
+        run_out = self.runner.execute(sched_out)
+        if self.kv_transfer_sink is not None:
+            for req, _, _ in sched_out.kv_transfer_requests:
+                payload = run_out.extracted_kv.get(req.request_id)
+                if payload is not None:
+                    self.kv_transfer_sink(req, payload)
+        finished = self.scheduler.update_from_output(
+            sched_out, run_out.sampled, run_out.kv_extracted_req_ids
+        )
+        if not self.scheduler.has_unfinished:
+            # no further step will run: drain transfers triggered just now
+            # so finished requests still ship their KV
+            for req, block_ids, seq_len in \
+                    self.scheduler.drain_pending_kv_transfers():
+                payload = self.runner.extract_kv(block_ids, seq_len)
+                if self.kv_transfer_sink is not None:
+                    self.kv_transfer_sink(req, payload)
+                self.scheduler.update_from_output(
+                    SchedulerOutput(), {}, {req.request_id})
+        return errored + [OmniRequestOutput.from_pipeline(r) for r in finished]
+
+    # ---------------------------------------------------------- generate()
+    def generate(
+        self,
+        prompts_token_ids: list[list[int]],
+        sampling_params: Optional[SamplingParams | list[SamplingParams]] = None,
+    ) -> list[OmniRequestOutput]:
+        """Blocking batch generate — the reference's OmniLLM._run_engine
+        step loop (reference: entrypoints/omni_llm.py:199-241)."""
+        if isinstance(sampling_params, list):
+            params_list = sampling_params
+        else:
+            params_list = [sampling_params] * len(prompts_token_ids)
+        order = {}
+        for toks, sp in zip(prompts_token_ids, params_list):
+            rid = self.add_request(toks, sp)
+            order[rid] = len(order)
+        results: dict[str, OmniRequestOutput] = {}
+        while self.has_unfinished_requests:
+            for out in self.step():
+                results[out.request_id] = out
+        # requests rejected at intake when no step ran afterwards
+        for req in self.scheduler.drain_errored():
+            out = OmniRequestOutput.from_pipeline(req)
+            results[out.request_id] = out
+        return [results[rid] for rid in
+                sorted(results, key=lambda r: order.get(r, 1 << 30))]

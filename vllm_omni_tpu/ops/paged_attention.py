@@ -266,6 +266,14 @@ def paged_attention(
         from vllm_omni_tpu.ops._dispatch import pallas_mode
 
         use_pallas = pallas_mode() == "native"
+        # Mosaic tiling constraints: page tiles are (page_size, head_dim)
+        # VMEM buffers → need lane dim % 128 and sublane dim % 8 (f32).
+        # Auto-dispatch routes tiny/test shapes to the XLA ref path;
+        # production shapes (D=128, page_size>=16) take the kernel.  An
+        # explicit use_pallas=True is honored as-is (kernel tests rely on
+        # it; unsupported shapes then fail loudly at compile).
+        if q.shape[-1] % 128 != 0 or k_cache.shape[2] % 8 != 0:
+            use_pallas = False
     return _paged_attention(
         q, k_cache, v_cache, block_tables, context_lens, scale, use_pallas
     )

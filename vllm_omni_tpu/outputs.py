@@ -1,0 +1,63 @@
+"""Request output types.
+
+Mirrors the reference's ``OmniRequestOutput`` union surface (reference:
+vllm_omni/outputs.py:66,90 — one type covering pipeline-stage text outputs
+and diffusion image/audio/video outputs, with ``from_pipeline`` /
+``from_diffusion`` constructors).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class CompletionOutput:
+    index: int
+    token_ids: list[int]
+    text: Optional[str] = None
+    finish_reason: Optional[str] = None
+
+
+@dataclass
+class OmniRequestOutput:
+    request_id: str
+    finished: bool = False
+    # AR pipeline fields
+    prompt_token_ids: list[int] = field(default_factory=list)
+    outputs: list[CompletionOutput] = field(default_factory=list)
+    # which stage produced this output + what modality it is
+    # (reference: engine_output_type text/latent/audio/image)
+    stage_id: int = 0
+    final_output_type: str = "text"
+    # diffusion / multimodal payloads (PIL images, waveforms, latents, ...)
+    images: list[Any] = field(default_factory=list)
+    multimodal_output: dict[str, Any] = field(default_factory=dict)
+    metrics: dict[str, float] = field(default_factory=dict)
+
+    @classmethod
+    def from_pipeline(cls, request, stage_id: int = 0, text: Optional[str] = None):
+        return cls(
+            request_id=request.request_id,
+            finished=request.is_finished,
+            prompt_token_ids=list(request.prompt_token_ids),
+            outputs=[CompletionOutput(
+                index=0,
+                token_ids=list(request.output_token_ids),
+                text=text,
+                finish_reason=request.finish_reason,
+            )],
+            stage_id=stage_id,
+            final_output_type="text",
+            multimodal_output=dict(request.multimodal_output),
+        )
+
+    @classmethod
+    def from_diffusion(cls, request_id: str, images: list, final_output_type: str = "image"):
+        return cls(
+            request_id=request_id,
+            finished=True,
+            images=list(images),
+            final_output_type=final_output_type,
+        )

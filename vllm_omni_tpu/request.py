@@ -1,0 +1,127 @@
+"""Engine request type + lifecycle status.
+
+Mirrors the behavioral surface of the reference's ``OmniRequest``
+(reference: vllm_omni/request.py:14 — adds prompt_embeds,
+additional_information, external_req_id on top of vLLM's Request) and the
+``RequestStatus`` extension with WAITING_FOR_CHUNK
+(reference: vllm_omni/patch.py:21-41).
+
+Host-side bookkeeping only — nothing here touches jax.  Device-side state
+(KV pages, sampler state) is owned by the KV-cache manager and model runner.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+class RequestStatus(enum.IntEnum):
+    # mirrors the reference's extended enum; WAITING_FOR_CHUNK is the
+    # async-chunk streaming state added by patch.py:26-41
+    WAITING_FOR_CHUNK = -1
+    WAITING = 0
+    RUNNING = 1
+    PREEMPTED = 2
+    FINISHED_STOPPED = 3
+    FINISHED_LENGTH = 4
+    FINISHED_ABORTED = 5
+    FINISHED_ERROR = 6
+
+    @property
+    def is_finished(self) -> bool:
+        return self >= RequestStatus.FINISHED_STOPPED
+
+
+FINISH_REASON = {
+    RequestStatus.FINISHED_STOPPED: "stop",
+    RequestStatus.FINISHED_LENGTH: "length",
+    RequestStatus.FINISHED_ABORTED: "abort",
+    RequestStatus.FINISHED_ERROR: "error",
+}
+
+
+class KVTransferState(enum.Enum):
+    """Cross-stage KV-transfer lifecycle of one request (reference:
+    core/sched/omni_ar_scheduler.py:84-136 trigger + :444-546 delayed free)."""
+
+    NONE = "none"          # no transfer configured
+    PENDING = "pending"    # trigger criteria not yet met
+    ACTIVE = "active"      # triggered; blocks pinned until extraction ACK
+    DONE = "done"          # runner ACKed extraction; blocks may be freed
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling_params: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_id: Optional[int] = None
+    arrival_time: float = 0.0
+    # omni extensions (reference: request.py:14)
+    prompt_embeds: Optional[np.ndarray] = None      # [S, hidden]
+    additional_information: dict[str, Any] = field(default_factory=dict)
+    external_req_id: Optional[str] = None
+
+    # ----- mutable engine state -----
+    status: RequestStatus = RequestStatus.WAITING
+    output_token_ids: list[int] = field(default_factory=list)
+    num_computed_tokens: int = 0
+    kv_transfer: KVTransferState = KVTransferState.NONE
+    # block-id snapshot taken at transfer trigger, truncated to seq len
+    # (reference: omni_ar_scheduler.py:553-594)
+    kv_transfer_block_ids: Optional[list[int]] = None
+    kv_transfer_seq_len: int = 0
+    multimodal_output: dict[str, Any] = field(default_factory=dict)
+    # hidden states destined for the next stage (pooler_output payloads,
+    # reference: gpu_ar_model_runner.py:525-568)
+    pooled_hidden: Optional[np.ndarray] = None
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return list(self.prompt_token_ids) + list(self.output_token_ids)
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status.is_finished
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return FINISH_REASON.get(self.status)
+
+    def append_output_token(self, token_id: int) -> None:
+        self.output_token_ids.append(token_id)
+
+    def check_stop(self) -> bool:
+        """Apply finish criteria after a new token; returns True if the
+        request just finished (reference finish logic lives in vLLM's
+        scheduler update_from_output, extended at omni_ar_scheduler.py:193)."""
+        sp = self.sampling_params
+        n_out = len(self.output_token_ids)
+        if n_out == 0:
+            return False
+        last = self.output_token_ids[-1]
+        if n_out >= sp.min_tokens:
+            if not sp.ignore_eos and self.eos_token_id is not None and last == self.eos_token_id:
+                self.status = RequestStatus.FINISHED_STOPPED
+                return True
+            if last in sp.stop_token_ids:
+                self.status = RequestStatus.FINISHED_STOPPED
+                return True
+        if n_out >= sp.max_tokens:
+            self.status = RequestStatus.FINISHED_LENGTH
+            return True
+        return False

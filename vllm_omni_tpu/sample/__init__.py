@@ -1,0 +1,3 @@
+from vllm_omni_tpu.sample.sampler import SamplingTensors, sample_tokens
+
+__all__ = ["SamplingTensors", "sample_tokens"]

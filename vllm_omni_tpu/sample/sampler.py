@@ -1,0 +1,112 @@
+"""Batched token sampler (greedy / temperature / top-k / top-p).
+
+TPU-native replacement for vLLM's GPU sampler as used by the reference's
+AR runner (reference: worker/gpu_ar_model_runner.py:441-444 `_sample`).
+Per-request sampling params are vectorized into device arrays so one jitted
+function serves any mixed batch — greedy requests ride the same kernel with
+temperature 0 handled via argmax selection, avoiding a recompile per
+param combination.
+
+Stateless: the caller supplies a fold-in of (seed, step) per request so
+resampling a step is deterministic (needed for spec-decode verify later).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+_NEG_INF = -1e30
+
+
+@dataclass
+class SamplingTensors:
+    temperature: jax.Array  # [B] f32
+    top_k: jax.Array        # [B] i32 (0 = off)
+    top_p: jax.Array        # [B] f32
+    keys: jax.Array         # [B, 2] u32 PRNG keys
+
+    @staticmethod
+    def build(
+        params: list[SamplingParams],
+        step: int,
+        base_seed: int = 0,
+        salts: Optional[list[int]] = None,
+    ):
+        """``salts`` (e.g. a stable hash of each request_id) decorrelate
+        unseeded requests from each other; explicit per-request seeds remain
+        fully deterministic regardless of salt/base_seed."""
+        temp = np.array([p.temperature for p in params], np.float32)
+        top_k = np.array([p.top_k for p in params], np.int32)
+        top_p = np.array([p.top_p for p in params], np.float32)
+        if salts is None:
+            salts = list(range(len(params)))
+        keys = []
+        for p, salt in zip(params, salts):
+            if p.seed is not None:
+                key = jax.random.PRNGKey(p.seed)
+            else:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(base_seed), salt & 0x7FFFFFFF
+                )
+            keys.append(jax.random.key_data(jax.random.fold_in(key, step)))
+        keys = np.stack(keys)
+        return SamplingTensors(
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
+            keys=jnp.asarray(keys),
+        )
+
+
+def _mask_top_k(logits: jax.Array, k: jax.Array) -> jax.Array:
+    """Per-row top-k mask; k==0 disables."""
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_eff = jnp.where(k <= 0, vocab, jnp.minimum(k, vocab))
+    thresh = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    return jnp.where(logits < thresh, _NEG_INF, logits)
+
+
+def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
+    """Nucleus mask: keep the smallest prefix of the sorted distribution
+    with cumulative prob >= p (always keeps the argmax)."""
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep entries where the cumulative mass *before* them is < p
+    keep = (cum - probs) < p[:, None]
+    thresh = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < thresh, _NEG_INF, logits)
+
+
+@jax.jit
+def sample_tokens(
+    logits: jax.Array,       # [B, vocab]
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,        # [B]
+    top_p: jax.Array,        # [B]
+    keys: jax.Array,         # [B, 2] key data
+) -> jax.Array:
+    """Returns sampled token ids [B] i32."""
+    logits = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
+    scaled = logits / safe_t[:, None]
+    scaled = _mask_top_k(scaled, top_k)
+    scaled = _mask_top_p(scaled, top_p)
+
+    def draw(key_data, row):
+        return jax.random.categorical(jax.random.wrap_key_data(key_data), row)
+
+    sampled_ids = jax.vmap(draw)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)

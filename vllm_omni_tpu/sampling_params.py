@@ -1,0 +1,45 @@
+"""Per-request sampling parameters.
+
+TPU-native analogue of vLLM's ``SamplingParams`` as consumed by the
+reference's stage workers (reference: vllm_omni/entrypoints/omni_stage.py
+batches only requests with identical sampling params, omni_stage.py:797-843;
+default params come from stage YAML ``default_sampling_params``).
+
+Kept deliberately flat: the engine vectorizes these into device arrays per
+scheduled batch (see worker/model_runner.py), so every field must be a
+scalar that can ride a jnp array — no callables, no logits processors v1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 16
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0
+    min_tokens: int = 0
+    seed: Optional[int] = None
+    stop_token_ids: Sequence[int] = field(default_factory=tuple)
+    ignore_eos: bool = False
+    # repetition penalties (applied host-side pre-softmax when != defaults)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+
+    def __post_init__(self):
+        if self.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        self.stop_token_ids = tuple(self.stop_token_ids)
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0

@@ -1,0 +1,3 @@
+from vllm_omni_tpu.worker.model_runner import ARModelRunner, RunnerOutput
+
+__all__ = ["ARModelRunner", "RunnerOutput"]

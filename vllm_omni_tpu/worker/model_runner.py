@@ -1,0 +1,244 @@
+"""AR model runner: bucketed-jit execution of scheduler output.
+
+TPU-native counterpart of the reference's GPUARModelRunner (reference:
+worker/gpu_ar_model_runner.py:59).  Where the CUDA runner manages CUDA-graph
+capture + padded dispatch (:180-205), the TPU runner relies on XLA: every
+(bucket_batch, bucket_seq) shape compiles once and is cached; padding rides
+slot -1 (dropped by the KV scatter) and masked sampling.
+
+Responsibilities (mirroring :90-396 / :398-588):
+- assemble padded device inputs from ``SchedulerOutput``
+- run jitted prefill / decode steps with donated KV caches
+- sample next tokens (sample/sampler.py)
+- slice per-request hidden states for next-stage payloads
+  (pooler_output analogue, reference :525-568)
+- extract KV pages for cross-stage transfer and ACK them
+  (device half of OmniKVTransferManager, reference:
+  distributed/omni_connectors/kv_transfer_manager.py:47)
+"""
+
+from __future__ import annotations
+
+import functools
+import secrets
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.core.scheduler import ScheduledRequest, SchedulerOutput
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.ops.paged_attention import init_kv_cache
+from vllm_omni_tpu.sample.sampler import SamplingTensors, sample_tokens
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+_SEQ_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+@dataclass
+class RunnerOutput:
+    # request_id -> sampled token (only for requests that reached sampling)
+    sampled: dict[str, int] = field(default_factory=dict)
+    # request_id -> extracted KV payload (per-layer (k, v) numpy arrays)
+    extracted_kv: dict[str, list] = field(default_factory=dict)
+    kv_extracted_req_ids: set[str] = field(default_factory=set)
+
+
+class ARModelRunner:
+    def __init__(
+        self,
+        params,
+        cfg: tfm.TransformerConfig,
+        num_pages: int,
+        page_size: int,
+        max_model_len: int = 4096,
+        dtype=jnp.bfloat16,
+        collect_hidden: bool = False,
+        seed: Optional[int] = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.page_size = page_size
+        self.max_pages_per_seq = -(-max_model_len // page_size)
+        self.collect_hidden = collect_hidden
+        self.kv_caches = init_kv_cache(
+            cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+            cfg.head_dim, dtype,
+        )
+        self._step = 0
+        # engine-level entropy for unseeded requests (fresh per process
+        # unless a seed is pinned for reproducibility)
+        self._base_seed = seed if seed is not None else secrets.randbits(31)
+
+        cfg_ = cfg
+
+        # KV caches are donated: each step consumes the old cache buffers and
+        # returns updated ones — no copy, the XLA equivalent of in-place
+        # CUDA cache writes.
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def _prefill(params, token_ids, kv_caches, positions, slot_mapping,
+                     last_idx):
+            hidden, new_caches = tfm.forward_prefill(
+                params, cfg_, token_ids, positions, kv_caches, slot_mapping
+            )
+            b = token_ids.shape[0]
+            last_hidden = hidden[jnp.arange(b), last_idx]  # [B, H]
+            logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
+            return logits, last_hidden, hidden, new_caches
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def _decode(params, token_ids, kv_caches, positions, slot_mapping,
+                    block_tables, context_lens):
+            hidden, new_caches = tfm.forward_decode(
+                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
+                block_tables, context_lens,
+            )
+            logits = tfm.logits_from_hidden(params, cfg_, hidden)
+            return logits, hidden, new_caches
+
+        self._prefill_fn = _prefill
+        self._decode_fn = _decode
+
+    # ---------------------------------------------------------------- step
+    def execute(self, sched_out: SchedulerOutput) -> RunnerOutput:
+        self._step += 1
+        out = RunnerOutput()
+        if sched_out.decodes:
+            self._run_decode(sched_out.decodes, out)
+        if sched_out.prefills:
+            self._run_prefill(sched_out.prefills, out)
+        for req, block_ids, seq_len in sched_out.kv_transfer_requests:
+            out.extracted_kv[req.request_id] = self.extract_kv(
+                block_ids, seq_len
+            )
+            out.kv_extracted_req_ids.add(req.request_id)
+        return out
+
+    # ------------------------------------------------------------- prefill
+    def _run_prefill(self, scheds: list[ScheduledRequest], out: RunnerOutput):
+        b = _bucket(len(scheds), _BATCH_BUCKETS)
+        max_n = max(s.num_new_tokens for s in scheds)
+        s_len = _bucket(max_n, _SEQ_BUCKETS)
+
+        token_ids = np.zeros((b, s_len), np.int32)
+        positions = np.zeros((b, s_len), np.int32)
+        slots = np.full((b, s_len), -1, np.int32)
+        last_idx = np.zeros((b,), np.int32)
+        for i, sc in enumerate(scheds):
+            n = sc.num_new_tokens
+            toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + n]
+            token_ids[i, :n] = toks
+            positions[i, :n] = np.arange(sc.start_pos, sc.start_pos + n)
+            slots[i, :n] = sc.slot_mapping
+            last_idx[i] = n - 1
+
+        logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
+            self.params, jnp.asarray(token_ids), self.kv_caches,
+            jnp.asarray(positions), jnp.asarray(slots), jnp.asarray(last_idx),
+        )
+        self._sample_and_record(scheds, logits, last_hidden, out,
+                                full_hidden=hidden)
+
+    # -------------------------------------------------------------- decode
+    def _run_decode(self, scheds: list[ScheduledRequest], out: RunnerOutput):
+        b = _bucket(len(scheds), _BATCH_BUCKETS)
+        token_ids = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        slots = np.full((b,), -1, np.int32)
+        tables = np.zeros((b, self.max_pages_per_seq), np.int32)
+        ctx = np.zeros((b,), np.int32)
+        for i, sc in enumerate(scheds):
+            req = sc.request
+            token_ids[i] = req.all_token_ids[sc.start_pos]
+            positions[i] = sc.start_pos
+            slots[i] = sc.slot_mapping[0]
+            t = sc.block_table[: self.max_pages_per_seq]
+            tables[i, : len(t)] = t
+            ctx[i] = sc.start_pos + 1
+        logits, hidden, self.kv_caches = self._decode_fn(
+            self.params, jnp.asarray(token_ids), self.kv_caches,
+            jnp.asarray(positions), jnp.asarray(slots),
+            jnp.asarray(tables), jnp.asarray(ctx),
+        )
+        self._sample_and_record(scheds, logits, hidden, out)
+
+    # ------------------------------------------------------------ sampling
+    def _sample_and_record(
+        self,
+        scheds: list[ScheduledRequest],
+        logits: jax.Array,       # [B_padded, vocab]
+        last_hidden: jax.Array,  # [B_padded, H]
+        out: RunnerOutput,
+        full_hidden: Optional[jax.Array] = None,
+    ):
+        # Requests sample only when the forward covered their last token —
+        # num_tokens, not num_prompt_tokens, so a preempted request that
+        # recomputes prompt+generated KV resumes without double-sampling.
+        sampling = [
+            (i, sc) for i, sc in enumerate(scheds)
+            if sc.start_pos + sc.num_new_tokens >= sc.request.num_tokens
+        ]
+        if sampling:
+            # Sample the full padded batch (one compile per bucket shape);
+            # non-sampling rows compute discarded tokens.
+            b_padded = logits.shape[0]
+            params = [SamplingParams()] * b_padded
+            salts = [0] * b_padded
+            for i, sc in sampling:
+                params[i] = sc.request.sampling_params
+                salts[i] = zlib.crc32(sc.request.request_id.encode())
+            tensors = SamplingTensors.build(
+                params, step=self._step, base_seed=self._base_seed,
+                salts=salts,
+            )
+            tokens = sample_tokens(
+                logits, tensors.temperature, tensors.top_k,
+                tensors.top_p, tensors.keys,
+            )
+            tokens = np.asarray(jax.device_get(tokens))
+            for i, sc in sampling:
+                out.sampled[sc.request.request_id] = int(tokens[i])
+        if self.collect_hidden:
+            # per-request hidden payloads for the next stage (reference
+            # pooler_output slicing, gpu_ar_model_runner.py:525-568)
+            hidden_np = np.asarray(jax.device_get(last_hidden))
+            for i, sc in enumerate(scheds):
+                req = sc.request
+                if full_hidden is not None:
+                    h = np.asarray(jax.device_get(
+                        full_hidden[i, : sc.num_new_tokens]
+                    ))
+                else:
+                    h = hidden_np[i: i + 1]
+                prev = req.additional_information.get("_hidden_chunks")
+                if prev is None:
+                    req.additional_information["_hidden_chunks"] = [h]
+                else:
+                    prev.append(h)
+
+    # -------------------------------------------------------- kv extraction
+    def extract_kv(self, block_ids: list[int], seq_len: int) -> list:
+        """Gather the pages holding ``seq_len`` tokens into dense per-layer
+        [Hkv, seq_len, D] arrays (device half of OmniKVTransferManager)."""
+        ids = jnp.asarray(block_ids, jnp.int32)
+        payload = []
+        for k_cache, v_cache in self.kv_caches:
+            k = k_cache[:, ids].reshape(k_cache.shape[0], -1, k_cache.shape[-1])
+            v = v_cache[:, ids].reshape(v_cache.shape[0], -1, v_cache.shape[-1])
+            payload.append((
+                np.asarray(jax.device_get(k[:, :seq_len])),
+                np.asarray(jax.device_get(v[:, :seq_len])),
+            ))
+        return payload
