@@ -159,6 +159,52 @@ def test_generation_scheduler_one_shot():
     assert s.kv.num_free_pages == 64
 
 
+def test_unschedulable_prompt_rejected_at_intake():
+    # budget 10 < prompt 12 with chunked prefill off -> intake error, not
+    # an engine-starving waiting-queue pin
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=10,
+                          max_model_len=64)
+    s = _mk(cfg)
+    s.add_request(_req("a", n=12))
+    assert not s.has_unfinished
+    errored = s.drain_errored()
+    assert len(errored) == 1
+    assert errored[0].status == RequestStatus.FINISHED_ERROR
+
+
+def test_prompt_larger_than_kv_pool_rejected():
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                          max_model_len=64)
+    s = _mk(cfg, pages=2, page_size=4)  # pool holds 8 tokens
+    s.add_request(_req("a", n=12))
+    assert s.drain_errored()
+
+
+def test_ack_after_finish_marks_done():
+    cfg = SchedulerConfig(
+        max_num_seqs=4, max_num_batched_tokens=64, max_model_len=64,
+        kv_transfer=KVTransferConfig(trigger="prefill_finished"),
+    )
+    s = _mk(cfg)
+    req = _req("a", n=4, max_tokens=1)
+    s.add_request(req)
+    out = s.schedule()
+    finished = s.update_from_output(out, {"a": 5})  # finishes (max_tokens=1)
+    assert finished and not s.has_unfinished
+    assert req.kv_transfer == KVTransferState.ACTIVE
+    # ACK lands after the request left running/waiting
+    from vllm_omni_tpu.core.scheduler import SchedulerOutput
+    s.update_from_output(SchedulerOutput(), {}, {"a"})
+    assert req.kv_transfer == KVTransferState.DONE
+
+
+def test_chunked_prefill_raises():
+    import pytest
+    cfg = SchedulerConfig(enable_chunked_prefill=True)
+    with pytest.raises(NotImplementedError):
+        _mk(cfg)
+
+
 def test_abort():
     s = _mk()
     s.add_request(_req("a", n=4))

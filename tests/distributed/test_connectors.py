@@ -1,0 +1,66 @@
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.distributed.connectors import (
+    ConnectorFactory,
+    InProcConnector,
+    SharedMemoryConnector,
+    make_key,
+)
+
+
+@pytest.fixture(params=["inproc", "shm"])
+def connector(request, tmp_path):
+    kwargs = {"namespace": f"test_{request.param}_{time.time_ns()}"}
+    if request.param == "shm":
+        kwargs["base_dir"] = str(tmp_path)
+    return ConnectorFactory.create(request.param, **kwargs)
+
+
+def test_put_get_roundtrip(connector):
+    key = make_key("r1", 0, 1)
+    obj = {"token_ids": [1, 2, 3], "arr": np.eye(3, dtype=np.float32)}
+    n = connector.put(key, obj)
+    assert n > 0
+    out = connector.get(key, timeout=1.0)
+    assert out["token_ids"] == [1, 2, 3]
+    np.testing.assert_array_equal(out["arr"], obj["arr"])
+    # consumed: second get times out
+    assert connector.get(key, timeout=0.05) is None
+
+
+def test_get_timeout(connector):
+    assert connector.get("missing/0_1", timeout=0.05) is None
+
+
+def test_get_blocks_until_put(connector):
+    key = make_key("r2", 0, 1)
+    result = {}
+
+    def reader():
+        result["v"] = connector.get(key, timeout=5.0)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.05)
+    connector.put(key, {"x": 42})
+    t.join(timeout=5)
+    assert result["v"] == {"x": 42}
+
+
+def test_cleanup(connector):
+    connector.put("k/0_1", {"a": 1})
+    connector.cleanup("k/0_1")
+    assert connector.get("k/0_1", timeout=0.05) is None
+
+
+def test_health(connector):
+    assert connector.health()
+
+
+def test_factory_unknown():
+    with pytest.raises(KeyError):
+        ConnectorFactory.create("mooncake")

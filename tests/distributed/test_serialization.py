@@ -1,0 +1,41 @@
+import numpy as np
+import jax.numpy as jnp
+
+from vllm_omni_tpu.distributed.serialization import OmniSerializer
+
+
+def test_roundtrip_plain():
+    obj = {"a": 1, "b": [1, 2, "x"], "c": {"d": None}, "e": (4, 5)}
+    assert OmniSerializer.loads(OmniSerializer.dumps(obj)) == obj
+
+
+def test_roundtrip_numpy():
+    obj = {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+           "nested": [np.ones((2, 2), np.int64), "tag"]}
+    out = OmniSerializer.loads(OmniSerializer.dumps(obj))
+    np.testing.assert_array_equal(out["x"], obj["x"])
+    np.testing.assert_array_equal(out["nested"][0], obj["nested"][0])
+    assert out["nested"][1] == "tag"
+
+
+def test_roundtrip_jax_array():
+    obj = {"j": jnp.asarray([[1.5, 2.5]], jnp.bfloat16)}
+    out = OmniSerializer.loads(OmniSerializer.dumps(obj))
+    assert isinstance(out["j"], np.ndarray)
+    np.testing.assert_array_equal(
+        out["j"].astype(np.float32), np.asarray([[1.5, 2.5]], np.float32)
+    )
+
+
+def test_kv_payload_shape():
+    payload = [(np.random.randn(2, 6, 16).astype(np.float32),) * 2
+               for _ in range(3)]
+    out = OmniSerializer.loads(OmniSerializer.dumps(payload))
+    assert len(out) == 3
+    np.testing.assert_array_equal(out[1][0], payload[1][0])
+
+
+def test_bad_magic():
+    import pytest
+    with pytest.raises(ValueError):
+        OmniSerializer.loads(b"XXXXjunk")

@@ -1,0 +1,141 @@
+"""Multi-stage pipeline orchestration tests — the in-proc analogue of the
+reference's e2e offline tests (SURVEY.md §4, tests/e2e/offline_inference/).
+Two tiny AR stages chained: stage-1's prompt is stage-0's output tokens."""
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig, StageRuntime
+from vllm_omni_tpu.entrypoints.omni import Omni
+from vllm_omni_tpu.entrypoints.omni_stage import OmniStage, StageRequest
+
+
+def _llm_stage(stage_id, *, final=False, sources=None, connectors=None,
+               sampling=None):
+    return StageConfig(
+        stage_id=stage_id,
+        stage_type="llm",
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=sources if sources is not None else [stage_id - 1],
+        final_output=final,
+        final_output_type="text",
+        default_sampling_params=sampling or {"temperature": 0.0,
+                                             "max_tokens": 4},
+        output_connectors=connectors or {},
+    )
+
+
+def test_single_stage_pipeline():
+    omni = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    outs = omni.generate([[1, 2, 3], [7, 8]])
+    assert len(outs) == 2
+    for o in outs:
+        assert len(o.outputs[0].token_ids) == 4
+        assert o.final_output_type == "text"
+
+
+def test_two_stage_chain_feeds_tokens_forward():
+    cfgs = [
+        _llm_stage(0, sources=[-1]),
+        _llm_stage(1, final=True),
+    ]
+    omni = Omni(stage_configs=cfgs)
+    outs = omni.generate([[5, 6, 7]])
+    assert len(outs) == 1
+    assert outs[0].stage_id == 1
+    # oracle: run stage-0 alone, then feed its output as stage-1's prompt
+    solo0 = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    mid = solo0.generate([[5, 6, 7]])[0].outputs[0].token_ids
+    solo1 = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    want = solo1.generate([list(mid)])[0].outputs[0].token_ids
+    assert outs[0].outputs[0].token_ids == want
+
+
+def test_two_stage_with_shm_connector(tmp_path):
+    import time
+    cfgs = [
+        _llm_stage(0, sources=[-1], connectors={
+            "1": {"connector": "shm",
+                  "namespace": f"t{time.time_ns()}",
+                  "base_dir": str(tmp_path)},
+        }),
+        _llm_stage(1, final=True),
+    ]
+    omni = Omni(stage_configs=cfgs)
+    outs = omni.generate([[5, 6, 7]])
+    assert len(outs) == 1
+    edge = omni.metrics.edges[(0, 1)]
+    assert edge.num_transfers == 1 and edge.bytes_total > 0
+
+
+def test_metrics_summary():
+    omni = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    omni.generate([[1, 2, 3]])
+    s = omni.metrics.summary()
+    assert s["e2e"]["num_finished"] == 1
+    assert s["stages"][0]["num_requests"] == 1
+    assert s["stages"][0]["tokens_out"] == 4
+
+
+def test_custom_input_processor():
+    cfgs = [
+        _llm_stage(0, sources=[-1]),
+        _llm_stage(1, final=True),
+    ]
+    cfgs[1].custom_process_input_func = (
+        "tests.entrypoints.test_omni_pipeline:reverse_tokens_processor"
+    )
+    omni = Omni(stage_configs=cfgs)
+    outs = omni.generate([[5, 6, 7]])
+    assert len(outs) == 1
+
+
+def reverse_tokens_processor(config, upstream_outputs):
+    return [
+        StageRequest(request_id=o.request_id,
+                     prompt_token_ids=list(reversed(o.outputs[0].token_ids)))
+        for o in upstream_outputs
+    ]
+
+
+def test_diffusion_stage_pipeline():
+    """Single diffusion stage driven through Omni (tiny QwenImage preset) —
+    the in-proc analogue of the reference's t2i e2e test."""
+    cfg = StageConfig(
+        stage_id=0,
+        stage_type="diffusion",
+        engine_args={
+            "model_arch": "QwenImagePipeline",
+            "size": "tiny",
+            "dtype": "float32",
+            "default_height": 32, "default_width": 32,
+        },
+        engine_input_source=[-1],
+        final_output=True,
+        final_output_type="image",
+        default_sampling_params={
+            "height": 32, "width": 32, "num_inference_steps": 2,
+            "guidance_scale": 1.0, "seed": 0,
+        },
+        runtime=StageRuntime(max_batch_size=2),
+    )
+    omni = Omni(stage_configs=[cfg])
+    outs = omni.generate(["a red square", "a cat"])
+    assert len(outs) == 2
+    for o in outs:
+        assert o.final_output_type == "image"
+        img = o.images[0]
+        assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+
+
+def test_per_request_sampling_params():
+    omni = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    outs = omni.generate(
+        [[1, 2, 3], [4, 5]],
+        sampling_params_list=[{"max_tokens": 2}, {"max_tokens": 6}],
+    )
+    assert len(outs[0].outputs[0].token_ids) == 2
+    assert len(outs[1].outputs[0].token_ids) == 6

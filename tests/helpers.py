@@ -1,0 +1,14 @@
+"""Shared test fixtures: tiny random-weight model factories (the analogue
+of the reference's random-weight HF checkpoints, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import transformer as tfm
+
+
+def tiny_lm_factory():
+    """model_factory hook for llm stages: (params, cfg, eos_token_id)."""
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return params, cfg, None

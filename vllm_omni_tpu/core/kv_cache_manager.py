@@ -14,16 +14,9 @@ caches stay aligned (same layout the TPU kernel wants).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
 from typing import Optional
 
 from vllm_omni_tpu.request import Request
-
-
-@dataclass
-class KVCacheConfig:
-    num_pages: int
-    page_size: int
 
 
 class KVCacheManager:

@@ -20,7 +20,6 @@ model runner buckets and pads into device arrays.
 
 from __future__ import annotations
 
-import enum
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -80,6 +79,13 @@ class SchedulerOutput:
 
 class ARScheduler:
     def __init__(self, config: SchedulerConfig, kv_manager: KVCacheManager):
+        if config.enable_chunked_prefill:
+            # chunk-continuation attention (later chunks attending cached KV
+            # of earlier ones) needs the ragged prefill kernel; honoring the
+            # flag today would silently produce wrong numerics
+            raise NotImplementedError(
+                "enable_chunked_prefill is not supported yet"
+            )
         self.config = config
         self.kv = kv_manager
         self.waiting: list[Request] = []
@@ -91,11 +97,25 @@ class ARScheduler:
         self._pending_kv_transfers: list[tuple[Request, list[int], int]] = []
         # requests rejected at intake; drained by the engine into outputs
         self._errored: list[Request] = []
+        # transfers awaiting extraction ACK, keyed by request_id
+        self._active_transfer_reqs: dict[str, Request] = {}
 
     # ------------------------------------------------------------- intake
     def add_request(self, request: Request) -> None:
-        if request.num_prompt_tokens > self.config.max_model_len:
+        n = request.num_prompt_tokens
+        # reject anything that could never be scheduled — otherwise the
+        # request would pin the waiting queue and starve the engine
+        reason = None
+        if n > self.config.max_model_len:
+            reason = "prompt exceeds max_model_len"
+        elif (not self.config.enable_chunked_prefill
+              and n > self.config.max_num_batched_tokens):
+            reason = "prompt exceeds max_num_batched_tokens (chunked prefill off)"
+        elif self.kv.pages_needed(n) > self.kv.num_pages:
+            reason = "prompt needs more KV pages than the whole pool"
+        if reason is not None:
             request.status = RequestStatus.FINISHED_ERROR
+            request.additional_information.setdefault("error", reason)
             self._finished_ids.add(request.request_id)
             self._errored.append(request)
             return
@@ -278,14 +298,15 @@ class ARScheduler:
         req.kv_transfer_block_ids = block_ids
         req.kv_transfer_seq_len = seq_len
         self._pending_kv_transfers.append((req, block_ids, seq_len))
+        self._active_transfer_reqs[req.request_id] = req
 
     def _ack_kv_transfer(self, request_id: str) -> None:
         self.kv.ack_transfer(request_id)
-        for queue in (self.running, self.waiting):
-            for req in queue:
-                if req.request_id == request_id:
-                    req.kv_transfer = KVTransferState.DONE
-                    return
+        # direct map, not a queue scan: the request may already have
+        # finished and left running/waiting by the time the ACK lands
+        req = self._active_transfer_reqs.pop(request_id, None)
+        if req is not None:
+            req.kv_transfer = KVTransferState.DONE
 
     def _free_request(self, req: Request) -> None:
         """Free pages unless a transfer is still ACTIVE (delayed free,

@@ -1,0 +1,15 @@
+from vllm_omni_tpu.distributed.serialization import OmniSerializer
+from vllm_omni_tpu.distributed.connectors import (
+    ConnectorFactory,
+    InProcConnector,
+    OmniConnectorBase,
+    SharedMemoryConnector,
+)
+
+__all__ = [
+    "ConnectorFactory",
+    "InProcConnector",
+    "OmniConnectorBase",
+    "OmniSerializer",
+    "SharedMemoryConnector",
+]

@@ -1,0 +1,165 @@
+"""OmniConnectors: typed put/get transport between pipeline stages.
+
+Behavioral port of the reference connector stack (reference:
+vllm_omni/distributed/omni_connectors/connectors/base.py:12 ``put/get/
+cleanup/health``; shm_connector.py:17 posix-SHM default transport;
+factory.py:24 name→constructor registry).  The Mooncake/Yuanrong RDMA
+connectors map to a TCP connector on TPU-VM NICs (future: DCN collectives
+for same-pod slices).
+
+Keys follow the reference convention ``{request_id}/{from_stage}_{to_stage}``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+from vllm_omni_tpu.distributed.serialization import OmniSerializer
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def make_key(request_id: str, from_stage: int, to_stage: int) -> str:
+    return f"{request_id}/{from_stage}_{to_stage}"
+
+
+class OmniConnectorBase(ABC):
+    """put/get with centralized serialization (base.py:12)."""
+
+    def put(self, key: str, obj: Any) -> int:
+        data = OmniSerializer.dumps(obj)
+        self._put_bytes(key, data)
+        return len(data)
+
+    def get(self, key: str, timeout: Optional[float] = None) -> Any:
+        data = self._get_bytes(key, timeout)
+        return None if data is None else OmniSerializer.loads(data)
+
+    @abstractmethod
+    def _put_bytes(self, key: str, data: bytes) -> None: ...
+
+    @abstractmethod
+    def _get_bytes(self, key: str, timeout: Optional[float]) -> Optional[bytes]: ...
+
+    def cleanup(self, key: str) -> None:
+        pass
+
+    def health(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+class InProcConnector(OmniConnectorBase):
+    """Same-process dict store — the unit-test fake of distributed transfer
+    (the reference uses SHM connectors in-proc for the same purpose,
+    SURVEY.md §4 fixtures inventory)."""
+
+    _stores: dict[str, dict[str, bytes]] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, namespace: str = "default", **_):
+        with InProcConnector._lock:
+            self._store = InProcConnector._stores.setdefault(namespace, {})
+        self._cv = threading.Condition()
+
+    def _put_bytes(self, key: str, data: bytes) -> None:
+        with self._cv:
+            self._store[key] = data
+            self._cv.notify_all()
+
+    def _get_bytes(self, key: str, timeout: Optional[float]) -> Optional[bytes]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while key not in self._store:
+                if deadline is None:
+                    return self._store.get(key)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return self._store.pop(key)
+
+    def cleanup(self, key: str) -> None:
+        with self._cv:
+            self._store.pop(key, None)
+
+
+class SharedMemoryConnector(OmniConnectorBase):
+    """Single-node cross-process transport over the filesystem (tmpfs).
+
+    The reference's shm connector uses posix SHM + flock
+    (shm_connector.py:17,53-57); files on /dev/shm give the same kernel
+    page-cache path with simpler lifetime management, using atomic rename
+    for the ready signal instead of a lock.
+    """
+
+    def __init__(self, namespace: str = "omni", base_dir: Optional[str] = None, **_):
+        root = base_dir or os.environ.get("OMNI_TPU_SHM_DIR") or (
+            "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+        )
+        self._dir = os.path.join(root, f"omni_tpu_{namespace}")
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self._dir, key.replace("/", "__"))
+
+    def _put_bytes(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.rename(tmp, path)  # atomic publish
+
+    def _get_bytes(self, key: str, timeout: Optional[float]) -> Optional[bytes]:
+        path = self._path(key)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+                os.unlink(path)
+                return data
+            except FileNotFoundError:
+                if deadline is None or time.monotonic() >= deadline:
+                    return None
+                time.sleep(0.002)
+
+    def cleanup(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def health(self) -> bool:
+        return os.path.isdir(self._dir)
+
+
+class ConnectorFactory:
+    """name → constructor registry (factory.py:24,96-100)."""
+
+    _registry: dict[str, type[OmniConnectorBase]] = {}
+
+    @classmethod
+    def register(cls, name: str, ctor: type[OmniConnectorBase]) -> None:
+        cls._registry[name] = ctor
+
+    @classmethod
+    def create(cls, name: str, **kwargs) -> OmniConnectorBase:
+        if name not in cls._registry:
+            raise KeyError(
+                f"unknown connector {name!r}; known: {sorted(cls._registry)}"
+            )
+        return cls._registry[name](**kwargs)
+
+
+ConnectorFactory.register("inproc", InProcConnector)
+ConnectorFactory.register("shm", SharedMemoryConnector)

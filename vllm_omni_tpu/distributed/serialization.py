@@ -1,0 +1,114 @@
+"""Tensor-aware serialization for cross-stage transfer.
+
+Behavioral analogue of the reference's ``OmniSerializer``
+(reference: vllm_omni/distributed/omni_connectors/utils/serialization.py:
+msgpack/pickle hybrid with tensor extraction).  Here the container format is
+a simple length-prefixed frame: a pickled skeleton where every ndarray /
+jax.Array leaf is swapped for a placeholder, followed by raw array buffers.
+Arrays transfer zero-copy out of the buffer on load (np.frombuffer view).
+
+Pickle is used only for the *skeleton* (dicts/lists/dataclasses of plain
+data) — payloads come from our own stage workers, the same trust domain the
+reference operates in.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any
+
+import numpy as np
+
+_MAGIC = b"OTSZ"
+
+
+class _ArrayRef:
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_ArrayRef, (self.index,))
+
+
+def _extract(obj: Any, arrays: list[np.ndarray]):
+    """Recursively swap array leaves for _ArrayRef placeholders."""
+    # jax.Array → numpy without importing jax here (duck-typed)
+    if hasattr(obj, "__array__") and not isinstance(obj, (str, bytes)):
+        if isinstance(obj, np.ndarray) or type(obj).__module__.startswith(
+            ("jax", "jaxlib")
+        ):
+            arr = np.ascontiguousarray(np.asarray(obj))
+            arrays.append(arr)
+            return _ArrayRef(len(arrays) - 1)
+    if isinstance(obj, dict):
+        return {k: _extract(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        mapped = [_extract(v, arrays) for v in obj]
+        return type(obj)(mapped) if not isinstance(obj, tuple) else tuple(mapped)
+    return obj
+
+
+def _restore(obj: Any, arrays: list[np.ndarray]):
+    if isinstance(obj, _ArrayRef):
+        return arrays[obj.index]
+    if isinstance(obj, dict):
+        return {k: _restore(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(_restore(v, arrays) for v in obj)
+    if isinstance(obj, list):
+        return [_restore(v, arrays) for v in obj]
+    return obj
+
+
+class OmniSerializer:
+    @staticmethod
+    def dumps(obj: Any) -> bytes:
+        arrays: list[np.ndarray] = []
+        skeleton = _extract(obj, arrays)
+        buf = io.BytesIO()
+        buf.write(_MAGIC)
+        payload = pickle.dumps(skeleton, protocol=pickle.HIGHEST_PROTOCOL)
+        buf.write(struct.pack("<I", len(payload)))
+        buf.write(payload)
+        buf.write(struct.pack("<I", len(arrays)))
+        for arr in arrays:
+            # pickle the dtype object (not .str): extension dtypes like
+            # ml_dtypes.bfloat16 have no losslessly-parseable str form
+            header = pickle.dumps((arr.dtype, arr.shape))
+            buf.write(struct.pack("<I", len(header)))
+            buf.write(header)
+            raw = arr.tobytes()
+            buf.write(struct.pack("<Q", len(raw)))
+            buf.write(raw)
+        return buf.getvalue()
+
+    @staticmethod
+    def loads(data: bytes) -> Any:
+        view = memoryview(data)
+        if view[:4] != _MAGIC:
+            raise ValueError("bad frame magic")
+        off = 4
+        (skel_len,) = struct.unpack_from("<I", view, off)
+        off += 4
+        skeleton = pickle.loads(view[off: off + skel_len])
+        off += skel_len
+        (n_arrays,) = struct.unpack_from("<I", view, off)
+        off += 4
+        arrays: list[np.ndarray] = []
+        for _ in range(n_arrays):
+            (h_len,) = struct.unpack_from("<I", view, off)
+            off += 4
+            dtype, shape = pickle.loads(view[off: off + h_len])
+            off += h_len
+            (raw_len,) = struct.unpack_from("<Q", view, off)
+            off += 8
+            arr = np.frombuffer(
+                view[off: off + raw_len], dtype=dtype
+            ).reshape(shape)
+            off += raw_len
+            arrays.append(arr)
+        return _restore(skeleton, arrays)

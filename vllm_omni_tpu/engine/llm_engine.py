@@ -70,6 +70,7 @@ class LLMEngine:
             num_pages=config.num_pages, page_size=config.page_size,
             max_model_len=config.max_model_len, dtype=config.dtype,
             collect_hidden=config.collect_hidden, seed=config.seed,
+            max_num_seqs=config.max_num_seqs,
         )
         # connector hook: called with (request, kv_payload) when a
         # cross-stage KV extraction completes (OmniKVTransferManager put)
@@ -120,7 +121,9 @@ class LLMEngine:
                     f"({self.scheduler.kv.num_free_pages} pages free)"
                 )
             return errored
-        run_out = self.runner.execute(sched_out)
+        run_out = self.runner.execute(
+            sched_out, extract_kv=self.kv_transfer_sink is not None
+        )
         if self.kv_transfer_sink is not None:
             for req, _, _ in sched_out.kv_transfer_requests:
                 payload = run_out.extracted_kv.get(req.request_id)
@@ -134,8 +137,8 @@ class LLMEngine:
             # so finished requests still ship their KV
             for req, block_ids, seq_len in \
                     self.scheduler.drain_pending_kv_transfers():
-                payload = self.runner.extract_kv(block_ids, seq_len)
                 if self.kv_transfer_sink is not None:
+                    payload = self.runner.extract_kv(block_ids, seq_len)
                     self.kv_transfer_sink(req, payload)
                 self.scheduler.update_from_output(
                     SchedulerOutput(), {}, {req.request_id})
@@ -150,6 +153,11 @@ class LLMEngine:
         """Blocking batch generate — the reference's OmniLLM._run_engine
         step loop (reference: entrypoints/omni_llm.py:199-241)."""
         if isinstance(sampling_params, list):
+            if len(sampling_params) != len(prompts_token_ids):
+                raise ValueError(
+                    f"sampling_params length {len(sampling_params)} != "
+                    f"prompts length {len(prompts_token_ids)}"
+                )
             params_list = sampling_params
         else:
             params_list = [sampling_params] * len(prompts_token_ids)

@@ -1,0 +1,4 @@
+from vllm_omni_tpu.entrypoints.omni import Omni
+from vllm_omni_tpu.entrypoints.omni_stage import OmniStage, StageRequest
+
+__all__ = ["Omni", "OmniStage", "StageRequest"]

@@ -1,0 +1,154 @@
+"""Omni: the offline multi-stage pipeline orchestrator.
+
+Behavioral port of the reference's Omni entrypoint (reference:
+entrypoints/omni.py:513 ``generate``; _run_generation polling loop
+:640-910 — seed stage-0, forward stage→stage via connectors, yield at
+final_output stages, per-stage + E2E metrics).
+
+The polling loop keeps the reference's dataflow contract:
+
+  user prompts → stage[0] → (process_engine_inputs) → stage[1] → … →
+  OmniRequestOutput at every stage marked final_output
+
+with connector-mediated edges (in-proc by default; shm/tcp for
+cross-process stages) and the metrics aggregator recording per-stage
+stats and transfer-edge bytes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence, Union
+
+from vllm_omni_tpu.config.stage import (
+    StageConfig,
+    load_stage_configs_from_model,
+    load_stage_configs_from_yaml,
+)
+from vllm_omni_tpu.distributed.connectors import ConnectorFactory, make_key
+from vllm_omni_tpu.entrypoints.omni_stage import OmniStage, StageRequest
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.metrics.stats import OrchestratorAggregator
+from vllm_omni_tpu.outputs import OmniRequestOutput
+
+logger = init_logger(__name__)
+
+
+class Omni:
+    def __init__(
+        self,
+        model: Optional[str] = None,
+        stage_configs: Optional[Union[str, list[StageConfig]]] = None,
+        stats_path: Optional[str] = None,
+        **overrides: Any,
+    ):
+        if stage_configs is None:
+            if model is None:
+                raise ValueError("need model name or stage_configs")
+            configs = load_stage_configs_from_model(model)
+        elif isinstance(stage_configs, str):
+            configs = load_stage_configs_from_yaml(stage_configs)
+        else:
+            configs = stage_configs
+        for cfg in configs:
+            cfg.engine_args.update(overrides.get(f"stage{cfg.stage_id}", {}))
+        self.stage_configs = configs
+        self.stages = [OmniStage(cfg) for cfg in configs]
+        self.metrics = OrchestratorAggregator(len(configs), stats_path)
+        # connector per pipeline edge (from->to), from stage YAML
+        # output_connectors; in-proc default
+        self._edge_connectors = {}
+        for cfg in configs:
+            for to_str, spec in cfg.output_connectors.items():
+                spec = dict(spec)
+                name = spec.pop("connector", "inproc")
+                self._edge_connectors[(cfg.stage_id, int(to_str))] = (
+                    ConnectorFactory.create(name, **spec)
+                )
+
+    # ------------------------------------------------------------ dataflow
+    def _consumers(self, stage_id: int) -> list[OmniStage]:
+        return [s for s in self.stages
+                if stage_id in s.config.engine_input_source]
+
+    def _forward(self, from_stage: OmniStage,
+                 outputs: list[OmniRequestOutput]) -> None:
+        """Ship finished outputs to every consumer stage, riding the edge
+        connector when one is configured (reference: try_send_via_connector,
+        omni.py:868-878)."""
+        for consumer in self._consumers(from_stage.stage_id):
+            reqs = consumer.process_engine_inputs(outputs)
+            edge = (from_stage.stage_id, consumer.stage_id)
+            conn = self._edge_connectors.get(edge)
+            if conn is not None:
+                t0 = time.perf_counter()
+                nbytes = 0
+                for r in reqs:
+                    key = make_key(r.request_id, *edge)
+                    nbytes += conn.put(key, r.__dict__)
+                shipped = []
+                for r in reqs:
+                    key = make_key(r.request_id, *edge)
+                    payload = conn.get(key, timeout=30.0)
+                    if payload is None:
+                        raise TimeoutError(f"connector lost {key}")
+                    shipped.append(StageRequest(**payload))
+                self.metrics.record_transfer(
+                    *edge, nbytes, (time.perf_counter() - t0) * 1e3
+                )
+                reqs = shipped
+            consumer.submit(reqs)
+
+    # ------------------------------------------------------------ generate
+    def generate(
+        self,
+        prompts: Sequence[Union[str, dict, list[int]]],
+        sampling_params_list: Optional[Sequence[dict]] = None,
+    ) -> list[OmniRequestOutput]:
+        """Run the full pipeline over the prompts (reference: omni.py:570).
+
+        Prompt forms: token-id list (AR stage-0), str (diffusion stage-0 or
+        tokenizer-equipped AR), or dict with explicit StageRequest fields.
+        """
+        sp_list = list(sampling_params_list or [{}] * len(prompts))
+        if len(sp_list) != len(prompts):
+            raise ValueError("sampling_params_list length mismatch")
+        seed: list[StageRequest] = []
+        for i, (p, sp) in enumerate(zip(prompts, sp_list)):
+            rid = f"omni-{i}"
+            if isinstance(p, dict):
+                seed.append(StageRequest(request_id=rid, sampling_params=sp, **p))
+            elif isinstance(p, str):
+                seed.append(StageRequest(request_id=rid, prompt=p,
+                                         sampling_params=sp))
+            else:
+                seed.append(StageRequest(request_id=rid,
+                                         prompt_token_ids=list(p),
+                                         sampling_params=sp))
+            self.metrics.record_arrival(rid)
+
+        expected = {r.request_id for r in seed}
+        entry = [s for s in self.stages if -1 in s.config.engine_input_source]
+        (entry[0] if entry else self.stages[0]).submit(seed)
+
+        finals: dict[str, OmniRequestOutput] = {}
+        # polling loop (reference hot loop, omni.py:738-741)
+        while any(s.has_unfinished for s in self.stages):
+            for stage in self.stages:
+                outs = stage.poll()
+                if not outs:
+                    continue
+                if stage.config.final_output:
+                    for o in outs:
+                        o.final_output_type = stage.config.final_output_type
+                        finals[o.request_id] = o
+                        self.metrics.record_finish(o.request_id)
+                self._forward(stage, outs)
+        for stage in self.stages:
+            for s in stage.request_stats:
+                self.metrics.record_stage_request(s)
+            stage.request_stats.clear()
+        missing = expected - set(finals)
+        if missing:
+            logger.warning("requests lost in pipeline: %s", sorted(missing))
+        return [finals[r.request_id] for r in seed if r.request_id in finals]

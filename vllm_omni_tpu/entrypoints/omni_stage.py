@@ -1,0 +1,191 @@
+"""OmniStage: one pipeline stage wrapping an engine.
+
+Behavioral port of the reference's OmniStage (reference:
+entrypoints/omni_stage.py:236 — config parse, worker spawn, submit/
+try_collect, process_engine_inputs deriving next-stage inputs).  Where the
+reference always spawns a worker process per stage, the TPU-native default
+is **in-proc**: a stage is an engine object stepped by the orchestrator's
+polling loop (one Python controller per host; pjit does the fan-out).
+Process isolation across TPU slices arrives with the TCP connector — the
+stage surface (submit / poll / collect) is transport-agnostic.
+
+Engine selection mirrors stage_type (llm | diffusion) from the stage YAML
+(reference: omni_stage.py:248-344).
+"""
+
+from __future__ import annotations
+
+import importlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.metrics.stats import StageRequestStats
+from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class StageRequest:
+    """Transport-level request entering a stage (the analogue of the
+    reference's per-stage task dicts in _stage_worker)."""
+
+    request_id: str
+    # AR stages: token ids; diffusion stages: text prompt
+    prompt_token_ids: Optional[list[int]] = None
+    prompt: Optional[str] = None
+    sampling_params: dict[str, Any] = field(default_factory=dict)
+    prompt_embeds: Optional[Any] = None
+    additional_information: dict[str, Any] = field(default_factory=dict)
+
+
+def _import_obj(path: str):
+    mod, _, attr = path.partition(":")
+    return getattr(importlib.import_module(mod), attr)
+
+
+class OmniStage:
+    def __init__(self, config: StageConfig):
+        self.config = config
+        self.stage_id = config.stage_id
+        self.engine = self._build_engine()
+        self._pending: list[StageRequest] = []
+        self._done: list[OmniRequestOutput] = []
+        self._input_processor = config.resolve_input_processor()
+        self._submit_ts: dict[str, float] = {}
+        self.request_stats: list[StageRequestStats] = []
+
+    # -------------------------------------------------------- engine build
+    def _build_engine(self):
+        args = dict(self.config.engine_args)
+        if self.config.stage_type == "llm":
+            factory = args.pop("model_factory", None)
+            if factory is None:
+                raise ValueError(
+                    f"stage {self.stage_id}: llm stages need engine_args."
+                    "model_factory ('pkg.mod:fn' -> (params, cfg, eos_id))"
+                )
+            if isinstance(factory, str):
+                factory = _import_obj(factory)
+            params, model_cfg, eos = factory()
+            from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+
+            known = EngineConfig.__dataclass_fields__
+            eng_kwargs = {k: v for k, v in args.items() if k in known}
+            return LLMEngine(params, model_cfg, EngineConfig(**eng_kwargs),
+                             eos_token_id=eos)
+        elif self.config.stage_type == "diffusion":
+            from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+            from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+
+            od = OmniDiffusionConfig.from_kwargs(**args)
+            return DiffusionEngine.make_engine(od)
+        raise ValueError(f"unknown stage_type {self.config.stage_type!r}")
+
+    # ------------------------------------------------------------- intake
+    def submit(self, reqs: list[StageRequest]) -> None:
+        now = time.perf_counter()
+        for r in reqs:
+            self._submit_ts[r.request_id] = now
+        if self.config.stage_type == "llm":
+            defaults = dict(self.config.default_sampling_params)
+            for r in reqs:
+                sp_kwargs = {**defaults, **r.sampling_params}
+                known = SamplingParams.__dataclass_fields__
+                sp = SamplingParams(
+                    **{k: v for k, v in sp_kwargs.items() if k in known}
+                )
+                self.engine.add_request(
+                    list(r.prompt_token_ids or []), sp,
+                    request_id=r.request_id,
+                    prompt_embeds=r.prompt_embeds,
+                    additional_information=dict(r.additional_information),
+                )
+        else:
+            self._pending.extend(reqs)
+
+    # -------------------------------------------------------------- drive
+    def poll(self) -> list[OmniRequestOutput]:
+        """Advance the stage's engine and return newly finished outputs
+        (the in-proc analogue of try_collect, omni_stage.py:572)."""
+        outs: list[OmniRequestOutput] = []
+        if self.config.stage_type == "llm":
+            if self.engine.has_unfinished_requests:
+                outs = self.engine.step()
+        else:
+            outs = self._run_diffusion_batch()
+        for o in outs:
+            o.stage_id = self.stage_id
+            self._record(o)
+        return outs
+
+    @property
+    def has_unfinished(self) -> bool:
+        if self.config.stage_type == "llm":
+            return self.engine.has_unfinished_requests
+        return bool(self._pending)
+
+    def _run_diffusion_batch(self) -> list[OmniRequestOutput]:
+        if not self._pending:
+            return []
+        from vllm_omni_tpu.diffusion.request import (
+            OmniDiffusionRequest,
+            OmniDiffusionSamplingParams,
+        )
+
+        batch = self._pending[: max(1, self.config.runtime.max_batch_size)]
+        self._pending = self._pending[len(batch):]
+        defaults = dict(self.config.default_sampling_params)
+        sp_kwargs = {**defaults, **batch[0].sampling_params}
+        known = OmniDiffusionSamplingParams.__dataclass_fields__
+        sp = OmniDiffusionSamplingParams(
+            **{k: v for k, v in sp_kwargs.items() if k in known}
+        )
+        req = OmniDiffusionRequest(
+            prompt=[r.prompt or "" for r in batch],
+            sampling_params=sp,
+            request_ids=[r.request_id for r in batch],
+        )
+        diff_outs = self.engine.step(req)
+        return [
+            OmniRequestOutput.from_diffusion(
+                o.request_id, [o.data], final_output_type=o.output_type
+            )
+            for o in diff_outs
+        ]
+
+    # --------------------------------------------- next-stage input derive
+    def process_engine_inputs(
+        self, upstream_outputs: list[OmniRequestOutput]
+    ) -> list[StageRequest]:
+        """Derive this stage's inputs from upstream outputs (reference:
+        omni_stage.py:585-634; default: prev output token ids become the
+        next prompt, custom fn hook for model-specific wiring)."""
+        if self._input_processor is not None:
+            return self._input_processor(self.config, upstream_outputs)
+        reqs = []
+        for out in upstream_outputs:
+            token_ids = out.outputs[0].token_ids if out.outputs else []
+            text = out.outputs[0].text if out.outputs else None
+            reqs.append(StageRequest(
+                request_id=out.request_id,
+                prompt_token_ids=list(token_ids),
+                prompt=text,
+            ))
+        return reqs
+
+    # ------------------------------------------------------------- metrics
+    def _record(self, out: OmniRequestOutput) -> None:
+        t0 = self._submit_ts.pop(out.request_id, None)
+        gen_ms = (time.perf_counter() - t0) * 1e3 if t0 else 0.0
+        self.request_stats.append(StageRequestStats(
+            request_id=out.request_id,
+            stage_id=self.stage_id,
+            tokens_in=len(out.prompt_token_ids),
+            tokens_out=sum(len(c.token_ids) for c in out.outputs),
+            gen_ms=gen_ms,
+        ))

@@ -1,0 +1,15 @@
+from vllm_omni_tpu.metrics.stats import (
+    OrchestratorAggregator,
+    RequestE2EStats,
+    StageRequestStats,
+    StageStats,
+    TransferEdgeStats,
+)
+
+__all__ = [
+    "OrchestratorAggregator",
+    "RequestE2EStats",
+    "StageRequestStats",
+    "StageStats",
+    "TransferEdgeStats",
+]

@@ -1,0 +1,135 @@
+"""Pipeline metrics: per-stage / per-edge / end-to-end aggregation.
+
+Behavioral port of the reference's metrics layer (reference:
+vllm_omni/metrics/stats.py — StageRequestStats:28, StageStats:18,
+TransferEdgeStats:59, RequestE2EStats:75, OrchestratorAggregator:115 with
+per-stage TPS + E2E latency aggregation and optional ``*.stats.jsonl``
+output wired in entrypoints/omni.py:692-697,759-791).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+
+@dataclass
+class StageRequestStats:
+    request_id: str
+    stage_id: int
+    tokens_in: int = 0
+    tokens_out: int = 0
+    gen_ms: float = 0.0
+    rx_bytes: int = 0
+    rx_decode_ms: float = 0.0
+    in_flight_ms: float = 0.0
+
+
+@dataclass
+class StageStats:
+    stage_id: int
+    num_requests: int = 0
+    tokens_in: int = 0
+    tokens_out: int = 0
+    gen_ms_total: float = 0.0
+
+    @property
+    def tps(self) -> float:
+        return (self.tokens_out / (self.gen_ms_total / 1e3)
+                if self.gen_ms_total else 0.0)
+
+
+@dataclass
+class TransferEdgeStats:
+    from_stage: int
+    to_stage: int
+    num_transfers: int = 0
+    bytes_total: int = 0
+    transfer_ms_total: float = 0.0
+
+
+@dataclass
+class RequestE2EStats:
+    request_id: str
+    arrival_ts: float
+    finish_ts: float = 0.0
+
+    @property
+    def e2e_ms(self) -> float:
+        return max(0.0, (self.finish_ts - self.arrival_ts) * 1e3)
+
+
+class OrchestratorAggregator:
+    def __init__(self, num_stages: int, stats_path: Optional[str] = None):
+        self.stages = {i: StageStats(stage_id=i) for i in range(num_stages)}
+        self.edges: dict[tuple[int, int], TransferEdgeStats] = {}
+        self.requests: dict[str, RequestE2EStats] = {}
+        self.per_request: list[StageRequestStats] = []
+        self._stats_path = stats_path
+
+    # ------------------------------------------------------------ recording
+    def record_arrival(self, request_id: str) -> None:
+        self.requests[request_id] = RequestE2EStats(
+            request_id=request_id, arrival_ts=time.time()
+        )
+
+    def record_finish(self, request_id: str) -> None:
+        if request_id in self.requests:
+            self.requests[request_id].finish_ts = time.time()
+
+    def record_stage_request(self, s: StageRequestStats) -> None:
+        self.per_request.append(s)
+        st = self.stages.setdefault(s.stage_id, StageStats(stage_id=s.stage_id))
+        st.num_requests += 1
+        st.tokens_in += s.tokens_in
+        st.tokens_out += s.tokens_out
+        st.gen_ms_total += s.gen_ms
+        if self._stats_path:
+            with open(self._stats_path, "a") as f:
+                f.write(json.dumps(asdict(s)) + "\n")
+
+    def record_transfer(self, from_stage: int, to_stage: int,
+                        nbytes: int, ms: float) -> None:
+        key = (from_stage, to_stage)
+        edge = self.edges.setdefault(
+            key, TransferEdgeStats(from_stage=from_stage, to_stage=to_stage)
+        )
+        edge.num_transfers += 1
+        edge.bytes_total += nbytes
+        edge.transfer_ms_total += ms
+
+    # ------------------------------------------------------------- summary
+    def summary(self) -> dict:
+        finished = [r for r in self.requests.values() if r.finish_ts]
+        e2e = sorted(r.e2e_ms for r in finished)
+
+        def pct(p):
+            return e2e[min(len(e2e) - 1, int(p * len(e2e)))] if e2e else 0.0
+
+        return {
+            "stages": {
+                i: {
+                    "num_requests": st.num_requests,
+                    "tokens_in": st.tokens_in,
+                    "tokens_out": st.tokens_out,
+                    "tps": round(st.tps, 2),
+                }
+                for i, st in self.stages.items()
+            },
+            "edges": {
+                f"{k[0]}->{k[1]}": {
+                    "transfers": e.num_transfers,
+                    "bytes": e.bytes_total,
+                    "ms": round(e.transfer_ms_total, 2),
+                }
+                for k, e in self.edges.items()
+            },
+            "e2e": {
+                "num_finished": len(e2e),
+                "p50_ms": round(pct(0.50), 2),
+                "p90_ms": round(pct(0.90), 2),
+                "p99_ms": round(pct(0.99), 2),
+            },
+        }

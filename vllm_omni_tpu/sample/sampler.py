@@ -47,22 +47,38 @@ class SamplingTensors:
         top_p = np.array([p.top_p for p in params], np.float32)
         if salts is None:
             salts = list(range(len(params)))
-        keys = []
-        for p, salt in zip(params, salts):
-            if p.seed is not None:
-                key = jax.random.PRNGKey(p.seed)
-            else:
-                key = jax.random.fold_in(
-                    jax.random.PRNGKey(base_seed), salt & 0x7FFFFFFF
-                )
-            keys.append(jax.random.key_data(jax.random.fold_in(key, step)))
-        keys = np.stack(keys)
+        # one vectorized dispatch for the whole batch (per-request PRNGKey/
+        # fold_in chains would cost ~4 tiny device ops per row per step)
+        seeds = np.array(
+            [p.seed if p.seed is not None else base_seed for p in params],
+            np.uint32,
+        )
+        salt_arr = np.array(
+            [0 if p.seed is not None else (s & 0x7FFFFFFF)
+             for p, s in zip(params, salts)],
+            np.uint32,
+        )
+        keys = _build_keys(jnp.asarray(seeds), jnp.asarray(salt_arr),
+                           jnp.asarray(step, jnp.uint32))
         return SamplingTensors(
             temperature=jnp.asarray(temp),
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
             keys=jnp.asarray(keys),
         )
+
+
+@jax.jit
+def _build_keys(seeds: jax.Array, salts: jax.Array, step: jax.Array):
+    """[B] seeds + [B] salts + scalar step -> [B, 2] key data, vmapped into
+    a single compiled dispatch. Seeded requests pass salt 0 so their stream
+    depends only on (seed, step)."""
+
+    def one(seed, salt):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), salt)
+        return jax.random.key_data(jax.random.fold_in(key, step))
+
+    return jax.vmap(one)(seeds, salts)
 
 
 def _mask_top_k(logits: jax.Array, k: jax.Array) -> jax.Array:

@@ -40,11 +40,18 @@ def _bucket(n: int, buckets: tuple[int, ...]) -> int:
     for b in buckets:
         if n <= b:
             return b
-    return buckets[-1]
+    raise ValueError(f"size {n} exceeds largest bucket {buckets[-1]}")
 
 
-_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
-_SEQ_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+def _make_buckets(start: int, limit: int) -> tuple[int, ...]:
+    """Powers of two from ``start`` up to (and covering) ``limit``."""
+    buckets = []
+    b = start
+    while b < limit:
+        buckets.append(b)
+        b *= 2
+    buckets.append(limit)
+    return tuple(buckets)
 
 
 @dataclass
@@ -67,11 +74,16 @@ class ARModelRunner:
         dtype=jnp.bfloat16,
         collect_hidden: bool = False,
         seed: Optional[int] = None,
+        max_num_seqs: int = 64,
     ):
         self.params = params
         self.cfg = cfg
         self.page_size = page_size
         self.max_pages_per_seq = -(-max_model_len // page_size)
+        # bucket tables sized to the engine limits — the scheduler never
+        # emits a batch/chunk beyond them, so _bucket cannot overflow
+        self._batch_buckets = _make_buckets(1, max(max_num_seqs, 1))
+        self._seq_buckets = _make_buckets(16, max(max_model_len, 16))
         self.collect_hidden = collect_hidden
         self.kv_caches = init_kv_cache(
             cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
@@ -112,7 +124,9 @@ class ARModelRunner:
         self._decode_fn = _decode
 
     # ---------------------------------------------------------------- step
-    def execute(self, sched_out: SchedulerOutput) -> RunnerOutput:
+    def execute(
+        self, sched_out: SchedulerOutput, extract_kv: bool = True
+    ) -> RunnerOutput:
         self._step += 1
         out = RunnerOutput()
         if sched_out.decodes:
@@ -120,17 +134,20 @@ class ARModelRunner:
         if sched_out.prefills:
             self._run_prefill(sched_out.prefills, out)
         for req, block_ids, seq_len in sched_out.kv_transfer_requests:
-            out.extracted_kv[req.request_id] = self.extract_kv(
-                block_ids, seq_len
-            )
+            # skip the device→host gather when no sink consumes it, but
+            # still ACK so the scheduler releases the pinned pages
+            if extract_kv:
+                out.extracted_kv[req.request_id] = self.extract_kv(
+                    block_ids, seq_len
+                )
             out.kv_extracted_req_ids.add(req.request_id)
         return out
 
     # ------------------------------------------------------------- prefill
     def _run_prefill(self, scheds: list[ScheduledRequest], out: RunnerOutput):
-        b = _bucket(len(scheds), _BATCH_BUCKETS)
+        b = _bucket(len(scheds), self._batch_buckets)
         max_n = max(s.num_new_tokens for s in scheds)
-        s_len = _bucket(max_n, _SEQ_BUCKETS)
+        s_len = _bucket(max_n, self._seq_buckets)
 
         token_ids = np.zeros((b, s_len), np.int32)
         positions = np.zeros((b, s_len), np.int32)
@@ -153,7 +170,7 @@ class ARModelRunner:
 
     # -------------------------------------------------------------- decode
     def _run_decode(self, scheds: list[ScheduledRequest], out: RunnerOutput):
-        b = _bucket(len(scheds), _BATCH_BUCKETS)
+        b = _bucket(len(scheds), self._batch_buckets)
         token_ids = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)
         slots = np.full((b,), -1, np.int32)
