@@ -171,6 +171,73 @@ def test_unseeded_requests_decorrelated(tiny_model):
     assert len(seqs) > 1
 
 
+def test_prompt_embeds_prefill_matches_token_path(tiny_model):
+    """Feeding prompt_embeds equal to the embedding rows of a prompt must
+    reproduce the token-id path exactly (embeds-as-input correctness)."""
+    params, cfg = tiny_model
+    prompt = [3, 7, 11, 2]
+    embeds = np.asarray(params["embed"]["w"])[prompt]
+    eng = _engine(params, cfg)
+    want = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                max_tokens=5))[0]
+    eng2 = _engine(params, cfg)
+    eng2.add_request([0] * len(prompt),
+                     SamplingParams(temperature=0.0, max_tokens=5),
+                     request_id="e", prompt_embeds=embeds)
+    results = []
+    while eng2.has_unfinished_requests:
+        results.extend(eng2.step())
+    assert results[0].outputs[0].token_ids == want.outputs[0].token_ids
+
+
+def test_prompt_embeds_with_width_projection():
+    """Upstream embeds in a different width ride embed_proj (thinker 32 →
+    talker 64)."""
+    from vllm_omni_tpu.models.qwen3_omni import talker
+
+    cfg = talker.tiny_config()
+    params = talker.init_talker_params(jax.random.PRNGKey(5), cfg,
+                                       thinker_hidden=32)
+    eng = _engine(params, cfg)
+    embeds = np.random.RandomState(0).randn(6, 32).astype(np.float32)
+    eng.add_request([0] * 6, SamplingParams(temperature=0.0, max_tokens=4),
+                    request_id="w", prompt_embeds=embeds)
+    results = []
+    while eng.has_unfinished_requests:
+        results.extend(eng.step())
+    toks = results[0].outputs[0].token_ids
+    assert len(toks) == 4 and all(0 <= t < cfg.vocab_size for t in toks)
+
+
+def test_prompt_embeds_survives_preemption():
+    """A preempted embeds request resumes by recomputing prompt (embeds) +
+    generated tokens (table lookups) — no crash, correct output length."""
+    params_cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), params_cfg, jnp.float32)
+    # pool too small for two requests at full length
+    eng = _engine(params, params_cfg, num_pages=6)
+    embeds = np.asarray(params["embed"]["w"])[[1, 2, 3, 4, 5, 6, 7, 8]]
+    eng.add_request([0] * 8, SamplingParams(temperature=0.0, max_tokens=8),
+                    request_id="a", prompt_embeds=embeds)
+    eng.add_request(list(range(9, 17)),
+                    SamplingParams(temperature=0.0, max_tokens=8),
+                    request_id="b")
+    results = {}
+    while eng.has_unfinished_requests:
+        for o in eng.step():
+            results[o.request_id] = o
+    assert len(results["a"].outputs[0].token_ids) == 8
+    assert len(results["b"].outputs[0].token_ids) == 8
+    # the embeds request's output must equal its unpreempted run
+    eng2 = _engine(params, params_cfg, num_pages=64)
+    eng2.add_request([0] * 8, SamplingParams(temperature=0.0, max_tokens=8),
+                     request_id="a2", prompt_embeds=embeds)
+    solo = []
+    while eng2.has_unfinished_requests:
+        solo.extend(eng2.step())
+    assert results["a"].outputs[0].token_ids == solo[0].outputs[0].token_ids
+
+
 def test_generation_scheduler_engine(tiny_model):
     params, cfg = tiny_model
     eng = _engine(params, cfg, worker_type="generation", collect_hidden=True)

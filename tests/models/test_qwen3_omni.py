@@ -1,0 +1,107 @@
+"""Qwen3-Omni family: MoE backbone numerics, vocoder shapes, and the
+3-stage thinker→talker→code2wav pipeline e2e at tiny scale (the analogue of
+the reference's tests/e2e/offline_inference/test_qwen3_omni.py)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.models.qwen3_omni import code2wav, talker, thinker
+
+
+def test_moe_forward_shapes_and_finite(rng):
+    cfg = tfm.TransformerConfig.tiny_moe()
+    params = tfm.init_params(rng, cfg)
+    ids = jnp.asarray([[1, 2, 3, 4]])
+    hidden = tfm.forward_hidden(params, cfg, ids)
+    assert hidden.shape == (1, 4, cfg.hidden_size)
+    assert np.all(np.isfinite(np.asarray(hidden)))
+
+
+def test_moe_router_selects_topk():
+    """Zeroing one expert's weights must change outputs only when that
+    expert is routed — sanity that routing actually gates computation."""
+    cfg = tfm.TransformerConfig.tiny_moe()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, cfg.hidden_size))
+    layer = params["layers"][0]
+    out1 = tfm._moe_mlp(layer, cfg, x)
+    # scaling a *selected* expert's down-proj changes the output
+    probs = jax.nn.softmax(
+        (x @ layer["router"]["w"]).astype(jnp.float32), axis=-1
+    )
+    top = int(jnp.argmax(probs.sum(0)))
+    import copy
+    layer2 = {**layer, "experts": dict(layer["experts"])}
+    layer2["experts"]["down"] = layer["experts"]["down"].at[top].set(0.0)
+    out2 = tfm._moe_mlp(layer2, cfg, x)
+    assert float(jnp.max(jnp.abs(out1 - out2))) > 1e-6
+
+
+def test_moe_greedy_paged_decode_matches_oracle():
+    """MoE backbone through the continuous-batching engine vs full-forward
+    greedy oracle."""
+    from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    params, cfg, _ = thinker.tiny_factory()
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=64, page_size=4, max_model_len=128, dtype=jnp.float32))
+    prompt = [1, 9, 17, 3]
+    outs = eng.generate([prompt], SamplingParams(temperature=0.0, max_tokens=5))
+    toks = list(prompt)
+    for _ in range(5):
+        h = tfm.forward_hidden(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(tfm.logits_from_hidden(params, cfg, h[0, -1]))))
+    assert outs[0].outputs[0].token_ids == toks[4:]
+
+
+def test_code2wav_shapes():
+    cfg = code2wav.Code2WavConfig.tiny()
+    params = code2wav.init_code2wav_params(jax.random.PRNGKey(0), cfg)
+    model = code2wav.Code2WavModel(cfg)
+    ids = jnp.asarray(np.random.randint(0, cfg.codec_vocab, (2, 10)), jnp.int32)
+    out = model.forward(params, ids, jnp.asarray([10, 7]))
+    assert out["audio"].shape == (2, 10 * cfg.total_upsample)
+    assert np.all(np.abs(np.asarray(out["audio"])) <= 1.0)
+    sliced = model.slice_output(
+        {k: np.asarray(v) for k, v in out.items()}, 1, 7)
+    assert sliced["audio"].shape == (7 * cfg.total_upsample,)
+
+
+def test_talker_embed_projection():
+    cfg = talker.tiny_config()
+    params = talker.init_talker_params(jax.random.PRNGKey(0), cfg,
+                                       thinker_hidden=64)
+    assert params["embed_proj"]["w"].shape == (64, cfg.hidden_size)
+
+
+def test_qwen3_omni_tiny_pipeline_e2e():
+    """Full 3-stage pipeline from the in-tree stage YAML: text in, thinker
+    text + vocoder audio out."""
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    yaml_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "vllm_omni_tpu", "models", "stage_configs",
+        "qwen3_omni_moe_tiny.yaml",
+    )
+    omni = Omni(stage_configs=yaml_path)
+    outs = omni.generate([[1, 2, 3]])
+    # two final outputs per request: stage-0 text + stage-2 audio
+    assert len(outs) == 2
+    by_type = {o.final_output_type: o for o in outs}
+    assert set(by_type) == {"text", "audio"}
+    text_out = by_type["text"]
+    assert len(text_out.outputs[0].token_ids) == 6
+    assert "hidden_states" in text_out.multimodal_output
+    audio_out = by_type["audio"]
+    wav = audio_out.multimodal_output["audio"]
+    # talker emits 8 codec tokens, tiny vocoder upsamples 4x
+    assert wav.shape == (8 * 4,)
+    assert np.all(np.isfinite(wav))

@@ -65,13 +65,26 @@ class LLMEngine:
         sched_cls = (GenerationScheduler if config.worker_type == "generation"
                      else ARScheduler)
         self.scheduler = sched_cls(sched_cfg, kv)
-        self.runner = ARModelRunner(
-            params, model_cfg,
-            num_pages=config.num_pages, page_size=config.page_size,
-            max_model_len=config.max_model_len, dtype=config.dtype,
-            collect_hidden=config.collect_hidden, seed=config.seed,
-            max_num_seqs=config.max_num_seqs,
-        )
+        if config.worker_type == "generation" and hasattr(model_cfg, "forward"):
+            # custom one-shot generator (code2wav vocoder etc.): model_cfg
+            # is a model object implementing the generation protocol
+            from vllm_omni_tpu.worker.generation_runner import (
+                GenerationModelRunner,
+            )
+
+            self.runner = GenerationModelRunner(
+                params, model_cfg,
+                max_num_seqs=config.max_num_seqs,
+                max_model_len=config.max_model_len,
+            )
+        else:
+            self.runner = ARModelRunner(
+                params, model_cfg,
+                num_pages=config.num_pages, page_size=config.page_size,
+                max_model_len=config.max_model_len, dtype=config.dtype,
+                collect_hidden=config.collect_hidden, seed=config.seed,
+                max_num_seqs=config.max_num_seqs,
+            )
         # connector hook: called with (request, kv_payload) when a
         # cross-stage KV extraction completes (OmniKVTransferManager put)
         self.kv_transfer_sink: Optional[Callable] = None
@@ -132,6 +145,17 @@ class LLMEngine:
         finished = self.scheduler.update_from_output(
             sched_out, run_out.sampled, run_out.kv_extracted_req_ids
         )
+        if self.config.collect_hidden:
+            # consolidate per-step hidden chunks into the next-stage payload
+            # (reference pooler_output routing, engine/output_processor.py:246)
+            import numpy as np
+
+            for r in finished:
+                chunks = r.additional_information.pop("_hidden_chunks", None)
+                if chunks:
+                    r.multimodal_output["hidden_states"] = np.concatenate(
+                        chunks, axis=0
+                    )
         if not self.scheduler.has_unfinished:
             # no further step will run: drain transfers triggered just now
             # so finished requests still ship their KV

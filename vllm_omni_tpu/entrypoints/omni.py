@@ -131,7 +131,10 @@ class Omni:
         entry = [s for s in self.stages if -1 in s.config.engine_input_source]
         (entry[0] if entry else self.stages[0]).submit(seed)
 
-        finals: dict[str, OmniRequestOutput] = {}
+        # a request may surface at several final_output stages (e.g. thinker
+        # text AND code2wav audio, reference: omni.py:818-844 yields per
+        # final stage) — collect all, ordered by stage
+        finals: dict[str, list[OmniRequestOutput]] = {}
         # polling loop (reference hot loop, omni.py:738-741)
         while any(s.has_unfinished for s in self.stages):
             for stage in self.stages:
@@ -141,7 +144,7 @@ class Omni:
                 if stage.config.final_output:
                     for o in outs:
                         o.final_output_type = stage.config.final_output_type
-                        finals[o.request_id] = o
+                        finals.setdefault(o.request_id, []).append(o)
                         self.metrics.record_finish(o.request_id)
                 self._forward(stage, outs)
         for stage in self.stages:
@@ -151,4 +154,4 @@ class Omni:
         missing = expected - set(finals)
         if missing:
             logger.warning("requests lost in pipeline: %s", sorted(missing))
-        return [finals[r.request_id] for r in seed if r.request_id in finals]
+        return [o for r in seed for o in finals.get(r.request_id, [])]

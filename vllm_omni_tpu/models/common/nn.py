@@ -95,6 +95,51 @@ def conv2d(p, x, stride: int = 1, padding: str | Sequence = "SAME"):
     return y
 
 
+def conv1d_init(
+    key, in_ch: int, out_ch: int, kernel: int, bias: bool = True, dtype=jnp.float32
+):
+    fan_in = in_ch * kernel
+    scale = 1.0 / math.sqrt(fan_in)
+    p = {
+        "w": jax.random.uniform(
+            key, (kernel, in_ch, out_ch), dtype, minval=-scale, maxval=scale
+        )
+    }
+    if bias:
+        p["b"] = jnp.zeros((out_ch,), dtype)
+    return p
+
+
+def conv1d(p, x, stride: int = 1, padding: str | Sequence = "SAME",
+           dilation: int = 1):
+    """x: [B, T, C] (NWC — TPU-native 1-D conv layout)."""
+    y = jax.lax.conv_general_dilated(
+        x,
+        p["w"].astype(x.dtype),
+        window_strides=(stride,),
+        padding=padding,
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    if "b" in p:
+        y = y + p["b"].astype(x.dtype)
+    return y
+
+
+def conv1d_transpose(p, x, stride: int, padding: str = "SAME"):
+    """Transposed 1-D conv (upsampling by ``stride``); x: [B, T, C]."""
+    y = jax.lax.conv_transpose(
+        x,
+        p["w"].astype(x.dtype),
+        strides=(stride,),
+        padding=padding,
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    if "b" in p:
+        y = y + p["b"].astype(x.dtype)
+    return y
+
+
 def groupnorm_init(channels: int, dtype=jnp.float32):
     return {"w": jnp.ones((channels,), dtype), "b": jnp.zeros((channels,), dtype)}
 

@@ -42,6 +42,14 @@ class TransformerConfig:
     rms_eps: float = 1e-6
     qk_norm: bool = False  # per-head q/k RMSNorm (Qwen3 style)
     tie_word_embeddings: bool = False
+    # Mixture-of-Experts (Qwen3-MoE style: softmax-topk router, normalized
+    # gate weights; reference backbone models/qwen3_omni/qwen3_moe.py).
+    # Expert weights are stacked on a leading E axis — shard it over the
+    # mesh "ep" axis and GSPMD partitions the expert einsums (EP).
+    moe: bool = False
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 0  # 0 => intermediate_size
 
     @staticmethod
     def tiny(vocab_size: int = 128) -> "TransformerConfig":
@@ -53,6 +61,22 @@ class TransformerConfig:
             num_kv_heads=2,
             head_dim=16,
             intermediate_size=128,
+        )
+
+    @staticmethod
+    def tiny_moe(vocab_size: int = 128) -> "TransformerConfig":
+        return TransformerConfig(
+            vocab_size=vocab_size,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            intermediate_size=128,
+            moe=True,
+            num_experts=4,
+            num_experts_per_tok=2,
+            moe_intermediate_size=64,
         )
 
 
@@ -78,13 +102,34 @@ def init_params(key, cfg: TransformerConfig, dtype=jnp.float32):
             "v_proj": nn.linear_init(k[2], cfg.hidden_size, kv_dim, bias=False, dtype=dtype),
             "o_proj": nn.linear_init(k[3], q_dim, cfg.hidden_size, bias=False, dtype=dtype),
             "post_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
-            "gate_up": nn.linear_init(
-                k[4], cfg.hidden_size, 2 * cfg.intermediate_size, bias=False, dtype=dtype
-            ),
-            "down": nn.linear_init(
-                k[5], cfg.intermediate_size, cfg.hidden_size, bias=False, dtype=dtype
-            ),
         }
+        if cfg.moe:
+            e = cfg.num_experts
+            inter = cfg.moe_intermediate_size or cfg.intermediate_size
+            scale_in = 1.0 / (cfg.hidden_size ** 0.5)
+            scale_out = 1.0 / (inter ** 0.5)
+            k6, k7 = jax.random.split(k[6])
+            layer["router"] = nn.linear_init(
+                k[5], cfg.hidden_size, e, bias=False, dtype=dtype
+            )
+            # stacked expert weights: leading E axis is the EP shard axis
+            layer["experts"] = {
+                "gate_up": jax.random.uniform(
+                    k6, (e, cfg.hidden_size, 2 * inter), dtype,
+                    minval=-scale_in, maxval=scale_in,
+                ),
+                "down": jax.random.uniform(
+                    k7, (e, inter, cfg.hidden_size), dtype,
+                    minval=-scale_out, maxval=scale_out,
+                ),
+            }
+        else:
+            layer["gate_up"] = nn.linear_init(
+                k[4], cfg.hidden_size, 2 * cfg.intermediate_size, bias=False, dtype=dtype
+            )
+            layer["down"] = nn.linear_init(
+                k[5], cfg.intermediate_size, cfg.hidden_size, bias=False, dtype=dtype
+            )
         if cfg.qk_norm:
             layer["q_norm"] = nn.rmsnorm_init(cfg.head_dim, dtype)
             layer["k_norm"] = nn.rmsnorm_init(cfg.head_dim, dtype)
@@ -104,7 +149,38 @@ def _qkv(layer, cfg: TransformerConfig, x):
     return q, k, v
 
 
-def _mlp(layer, x):
+def _moe_mlp(layer, cfg: TransformerConfig, x):
+    """Dense-dispatch MoE: every expert computes every token, combined with
+    the (renormalized) top-k router weights as a [T, E] mask.
+
+    TPU-first rationale: the combine einsums keep a static shape (no
+    gather/scatter by token count per expert), the E axis shards over the
+    mesh "ep" axis (GSPMD turns the combine into a psum — the XLA analogue
+    of the reference's all-to-all EP dispatch in vLLM's fused MoE), and for
+    the top-k/E ratios Qwen3-MoE uses the wasted FLOPs ride otherwise-idle
+    MXU cycles at decode batch sizes.
+    """
+    lead = x.shape[:-1]
+    x = x.reshape(-1, x.shape[-1])
+    t = x.shape[0]
+    router_logits = x @ layer["router"]["w"]  # [T, E]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    topk_w, topk_idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)  # renormalize
+    # [T, E] combine weights (zero for non-selected experts)
+    combine = jnp.zeros_like(probs).at[
+        jnp.arange(t)[:, None], topk_idx
+    ].set(topk_w)
+    h = jnp.einsum("th,ehf->etf", x, layer["experts"]["gate_up"])
+    h = silu_mul(h)
+    y = jnp.einsum("etf,efh->eth", h, layer["experts"]["down"])
+    out = jnp.einsum("eth,te->th", y, combine.astype(x.dtype))
+    return out.reshape(*lead, out.shape[-1])
+
+
+def _mlp(layer, cfg: TransformerConfig, x):
+    if cfg.moe:
+        return _moe_mlp(layer, cfg, x)
     return nn.linear(layer["down"], silu_mul(nn.linear(layer["gate_up"], x)))
 
 
@@ -138,7 +214,7 @@ def forward_hidden(
         )
         x = x + o.reshape(b, s, -1) @ layer["o_proj"]["w"]
         h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, cfg, h)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
 
 
@@ -155,13 +231,35 @@ def forward_prefill(
     positions: jax.Array,  # [B, S]
     kv_caches: list,  # per-layer (k, v) paged caches
     slot_mapping: jax.Array,  # [B, S] flat slots (-1 for padding)
+    inputs_embeds: Optional[jax.Array] = None,  # [B, S, embed_width]
+    embeds_mask: Optional[jax.Array] = None,  # [B, S] bool: row uses embeds
 ):
     """Prefill: causal attention within the prompt, writing KV pages.
+
+    ``inputs_embeds`` replaces the embedding lookup — the embeds-as-input
+    path a downstream stage uses to consume upstream hidden states
+    (reference: OmniGPUModelRunner._preprocess override,
+    worker/gpu_model_runner.py:925).  ``embeds_mask`` selects per position:
+    True rows take (projected) embeds, False rows take the token embedding —
+    needed when a preempted embeds request re-prefills prompt *and* its
+    generated tokens, whose embeddings come from the table.
 
     Returns (hidden [B, S, hidden], new kv_caches).
     """
     b, s = token_ids.shape
-    x = nn.embedding(params["embed"], token_ids)
+    if inputs_embeds is not None:
+        x = inputs_embeds
+        # upstream-stage hidden states may live in a different width; an
+        # optional input projection adapts them (reference: the talker
+        # projects thinker hidden states before its layer stack,
+        # models/qwen3_omni/qwen3_omni_moe_talker.py)
+        if "embed_proj" in params:
+            x = nn.linear(params["embed_proj"], x)
+        if embeds_mask is not None:
+            tok = nn.embedding(params["embed"], token_ids)
+            x = jnp.where(embeds_mask[..., None], x, tok)
+    else:
+        x = nn.embedding(params["embed"], token_ids)
     cos, sin = compute_rope_freqs(
         positions.reshape(-1), cfg.head_dim, cfg.rope_theta
     )
@@ -182,7 +280,7 @@ def forward_prefill(
         )
         x = x + o.reshape(b, s, -1) @ layer["o_proj"]["w"]
         h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, cfg, h)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
 
 
@@ -214,5 +312,5 @@ def forward_decode(
         o = paged_attention(q, k_cache, v_cache, block_tables, context_lens)
         x = x + o.reshape(b, -1) @ layer["o_proj"]["w"]
         h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, cfg, h)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches

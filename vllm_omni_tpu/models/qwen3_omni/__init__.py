@@ -1,0 +1,3 @@
+from vllm_omni_tpu.models.qwen3_omni import code2wav, talker, thinker
+
+__all__ = ["code2wav", "talker", "thinker"]

@@ -1,0 +1,106 @@
+"""Qwen3-Omni code2wav: ConvNet vocoder, codec tokens → waveform (stage 2).
+
+Reference: vllm_omni/model_executor/models/qwen3_omni/qwen3_omni_code2wav.py
+— a one-shot ConvNet generator run under the generation scheduler fast path
+(core/sched/omni_generation_scheduler.py:33-261): the whole codec sequence
+arrives as the "prompt", one forward emits the waveform, request finishes.
+
+TPU-first layout: NWC 1-D convs (lane dim = channels), transposed-conv
+upsampling stack, snake-ish (silu) activations.  Implements the generation
+runner model protocol (worker/generation_runner.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+
+
+@dataclass(frozen=True)
+class Code2WavConfig:
+    codec_vocab: int = 4099
+    channels: int = 512
+    upsample_factors: tuple = (8, 5, 4, 2)  # total 320x = 16kHz @ 50Hz codes
+    kernel: int = 7
+    num_res_layers: int = 2
+
+    @staticmethod
+    def tiny() -> "Code2WavConfig":
+        return Code2WavConfig(
+            codec_vocab=64, channels=16, upsample_factors=(2, 2), kernel=3,
+            num_res_layers=1,
+        )
+
+    @property
+    def total_upsample(self) -> int:
+        return math.prod(self.upsample_factors)
+
+
+def init_code2wav_params(key, cfg: Code2WavConfig, dtype=jnp.float32):
+    keys = jax.random.split(key, 3 + 2 * len(cfg.upsample_factors)
+                            * (1 + cfg.num_res_layers))
+    ki = iter(keys)
+    params = {
+        "embed": nn.embedding_init(next(ki), cfg.codec_vocab, cfg.channels, dtype),
+        "pre": nn.conv1d_init(next(ki), cfg.channels, cfg.channels,
+                              cfg.kernel, dtype=dtype),
+        "ups": [],
+        "post": nn.conv1d_init(next(ki), cfg.channels
+                               // (2 ** len(cfg.upsample_factors)), 1,
+                               cfg.kernel, dtype=dtype),
+    }
+    ch = cfg.channels
+    for f in cfg.upsample_factors:
+        out_ch = ch // 2
+        block = {
+            "up": nn.conv1d_init(next(ki), ch, out_ch, 2 * f, dtype=dtype),
+            "res": [
+                nn.conv1d_init(next(ki), out_ch, out_ch, cfg.kernel, dtype=dtype)
+                for _ in range(cfg.num_res_layers)
+            ],
+        }
+        params["ups"].append(block)
+        ch = out_ch
+    return params
+
+
+class Code2WavModel:
+    """Generation-runner model protocol implementation."""
+
+    def __init__(self, cfg: Code2WavConfig):
+        self.cfg = cfg
+
+    def forward(self, params, token_ids: jax.Array, lengths: jax.Array):
+        """token_ids [B, S] codec ids, lengths [B] -> {"audio": [B, S*up]}.
+
+        Padding tokens produce garbage samples past lengths*up; the runner
+        slices them off per request (slice_output).
+        """
+        cfg = self.cfg
+        x = nn.embedding(params["embed"], token_ids)  # [B, S, C]
+        x = nn.conv1d(params["pre"], x)
+        for block, f in zip(params["ups"], cfg.upsample_factors):
+            x = jax.nn.silu(x)
+            x = nn.conv1d_transpose(block["up"], x, stride=f)
+            for res in block["res"]:
+                x = x + nn.conv1d(res, jax.nn.silu(x))
+        x = jax.nn.silu(x)
+        wav = jnp.tanh(nn.conv1d(params["post"], x))  # [B, S*up, 1]
+        return {"audio": wav[..., 0]}
+
+    def slice_output(self, outputs: dict, row: int, in_len: int):
+        up = self.cfg.total_upsample
+        return {"audio": np.asarray(outputs["audio"][row, : in_len * up])}
+
+
+def tiny_factory():
+    """model_factory for generation stages: (params, model_obj, eos)."""
+    cfg = Code2WavConfig.tiny()
+    params = init_code2wav_params(jax.random.PRNGKey(2), cfg)
+    return params, Code2WavModel(cfg), None

@@ -1,0 +1,74 @@
+"""Qwen3-Omni-MoE talker: AR codec-token LM (stage 1).
+
+Reference: vllm_omni/model_executor/models/qwen3_omni/
+qwen3_omni_moe_talker.py — a smaller MoE LM that consumes the thinker's
+hidden states (projected into its own width) and autoregressively emits
+speech-codec tokens; the MTP code predictor
+(qwen3_omni_moe_code_predictor_mtp.py) is a later spec-decode extension.
+
+The thinker→talker handoff rides the engine's prompt_embeds path: the
+stage input processor packs thinker hidden states as prompt_embeds, and
+the transformer's optional ``embed_proj`` adapts thinker width → talker
+width (models/common/transformer.py forward_prefill).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.models.common.transformer import TransformerConfig, init_params
+
+# codec vocabulary (speech tokens); real talker: 32 layers hidden 1024.
+QWEN3_OMNI_TALKER_30B = TransformerConfig(
+    vocab_size=4096 + 8,  # codec codes + specials
+    hidden_size=1024,
+    num_layers=20,
+    num_heads=16,
+    num_kv_heads=4,
+    head_dim=128,
+    intermediate_size=3072,
+    qk_norm=True,
+    moe=True,
+    num_experts=64,
+    num_experts_per_tok=6,
+    moe_intermediate_size=384,
+)
+
+CODEC_EOS = 4097  # end-of-speech codec token (tiny preset convention)
+
+
+def tiny_config(codec_vocab: int = 64) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=codec_vocab,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=128,
+        moe=True,
+        num_experts=4,
+        num_experts_per_tok=2,
+        moe_intermediate_size=64,
+    )
+
+
+def init_talker_params(key, cfg: TransformerConfig, thinker_hidden: int,
+                       dtype=jnp.float32):
+    """Talker params = MoE LM + projection from thinker hidden width."""
+    params = init_params(key, cfg, dtype)
+    params["embed_proj"] = nn.linear_init(
+        jax.random.fold_in(key, 99), thinker_hidden, cfg.hidden_size,
+        bias=False, dtype=dtype,
+    )
+    return params
+
+
+def tiny_factory():
+    """model_factory: tiny talker consuming 64-wide thinker states."""
+    cfg = tiny_config()
+    params = init_talker_params(jax.random.PRNGKey(1), cfg,
+                                thinker_hidden=64)
+    return params, cfg, None

@@ -1,0 +1,52 @@
+"""Cross-stage tensor wiring for the Qwen3-Omni pipeline.
+
+Reference: vllm_omni/model_executor/stage_input_processors/qwen3_omni.py —
+``thinker2talker`` packs thinker hidden states + text tokens into talker
+inputs; ``talker2code2wav`` turns codec tokens into the vocoder's input.
+Registered in stage YAML via ``custom_process_input_func``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+
+
+def thinker_to_talker(config, upstream_outputs) -> list[StageRequest]:
+    """Thinker hidden states ride the prompt_embeds path; placeholder token
+    ids keep the scheduler's length accounting aligned with the embeds."""
+    reqs = []
+    for out in upstream_outputs:
+        hidden = out.multimodal_output.get("hidden_states")
+        if hidden is None:
+            # thinker engine was not run with collect_hidden — degrade to
+            # token-bridging so the pipeline still flows
+            toks = out.outputs[0].token_ids if out.outputs else []
+            reqs.append(StageRequest(request_id=out.request_id,
+                                     prompt_token_ids=list(toks)))
+            continue
+        hidden = np.asarray(hidden)
+        reqs.append(StageRequest(
+            request_id=out.request_id,
+            prompt_token_ids=[0] * hidden.shape[0],
+            prompt_embeds=hidden,
+            additional_information={
+                "thinker_token_ids": list(out.outputs[0].token_ids)
+                if out.outputs else [],
+            },
+        ))
+    return reqs
+
+
+def talker_to_code2wav(config, upstream_outputs) -> list[StageRequest]:
+    """Codec tokens emitted by the talker become the vocoder's one-shot
+    prompt (reference: talker2code2wav)."""
+    return [
+        StageRequest(
+            request_id=out.request_id,
+            prompt_token_ids=list(out.outputs[0].token_ids)
+            if out.outputs else [],
+        )
+        for out in upstream_outputs
+    ]

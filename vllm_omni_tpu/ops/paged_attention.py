@@ -62,8 +62,8 @@ def write_kv_cache(
     hkv, p, ps, d = k_cache.shape
     kc = k_cache.reshape(hkv, p * ps, d)
     vc = v_cache.reshape(hkv, p * ps, d)
-    kn = jnp.moveaxis(k_new, 1, 0)  # [Hkv, T, D]
-    vn = jnp.moveaxis(v_new, 1, 0)
+    kn = jnp.moveaxis(k_new, 1, 0).astype(k_cache.dtype)  # [Hkv, T, D]
+    vn = jnp.moveaxis(v_new, 1, 0).astype(v_cache.dtype)
     # Negative slots would wrap Python-style; push them out of bounds so
     # mode="drop" discards them.
     slots = jnp.where(slot_mapping < 0, p * ps, slot_mapping)

@@ -1,6 +1,7 @@
 from vllm_omni_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_CFG,
+    AXIS_EP,
     AXIS_PP,
     AXIS_RING,
     AXIS_TP,
@@ -14,6 +15,7 @@ from vllm_omni_tpu.parallel.mesh import (
 __all__ = [
     "AXIS_DP",
     "AXIS_CFG",
+    "AXIS_EP",
     "AXIS_PP",
     "AXIS_RING",
     "AXIS_TP",

@@ -41,11 +41,14 @@ AXIS_RING = "ring"
 AXIS_ULYSSES = "ulysses"
 AXIS_TP = "tp"
 
+AXIS_EP = "ep"
+
 # Outermost -> innermost (innermost varies fastest over the device list).
 MESH_AXES: tuple[str, ...] = (
     AXIS_DP,
     AXIS_CFG,
     AXIS_PP,
+    AXIS_EP,
     AXIS_RING,
     AXIS_ULYSSES,
     AXIS_TP,
@@ -66,6 +69,9 @@ class MeshConfig:
     data_parallel_size: int = 1
     cfg_parallel_size: int = 1
     pipeline_parallel_size: int = 1
+    # expert parallel: shards the stacked-E MoE weight axis (reference: EP
+    # via vLLM fused-MoE all-to-all, SURVEY.md §2.11)
+    expert_parallel_size: int = 1
     ring_degree: int = 1
     ulysses_degree: int = 1
     tensor_parallel_size: int = 1
@@ -80,6 +86,7 @@ class MeshConfig:
             self.data_parallel_size
             * self.cfg_parallel_size
             * self.pipeline_parallel_size
+            * self.expert_parallel_size
             * self.ring_degree
             * self.ulysses_degree
             * self.tensor_parallel_size
@@ -91,6 +98,7 @@ class MeshConfig:
             self.data_parallel_size,
             self.cfg_parallel_size,
             self.pipeline_parallel_size,
+            self.expert_parallel_size,
             self.ring_degree,
             self.ulysses_degree,
             self.tensor_parallel_size,
@@ -119,6 +127,7 @@ class MeshConfig:
             "dp": "data_parallel_size",
             "cfg": "cfg_parallel_size",
             "pp": "pipeline_parallel_size",
+            "ep": "expert_parallel_size",
             "tp": "tensor_parallel_size",
             "ulysses": "ulysses_degree",
             "ring": "ring_degree",

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from vllm_omni_tpu.parallel.mesh import (
+    AXIS_EP,
     AXIS_RING,
     AXIS_TP,
     AXIS_ULYSSES,
@@ -79,3 +80,21 @@ def with_sharding(x: jax.Array, sharding: Optional[NamedSharding]) -> jax.Array:
     if sharding is None:
         return x
     return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def shard_moe_params(params, mesh: Mesh):
+    """Place a transformer param tree with MoE expert weights sharded over
+    the ``ep`` mesh axis (stacked leading-E axis) and everything else
+    replicated — GSPMD then partitions the expert einsums and inserts the
+    combine psum (the XLA analogue of the reference's all-to-all EP
+    dispatch, SURVEY.md §2.11)."""
+
+    def place(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: place(v, path + (k,)) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [place(v, path + (str(i),)) for i, v in enumerate(tree)]
+        spec = P(AXIS_EP) if "experts" in path else P()
+        return jax.device_put(tree, NamedSharding(mesh, spec))
+
+    return place(params)

@@ -1,0 +1,62 @@
+"""One-shot generation model runner.
+
+TPU-native counterpart of the reference's GPUGenerationModelRunner
+(reference: worker/gpu_generation_model_runner.py:44 — no sampler;
+``_run_generation_model`` returns waveform/image tensors :408-447).  Paired
+with ``GenerationScheduler``: every request arrives as a single full-prompt
+prefill and finishes in one step; the model's forward output (not sampled
+tokens) is the result, stored into ``request.multimodal_output``.
+
+Model protocol (duck-typed):
+- ``forward(params, token_ids [B, S], lengths [B]) -> dict[str, jax.Array]``
+  batched over padded inputs; jit-compatible.
+- ``slice_output(outputs, row, in_len) -> dict[str, np.ndarray]``
+  extract one request's result from the padded batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.core.scheduler import SchedulerOutput
+from vllm_omni_tpu.worker.model_runner import RunnerOutput, _bucket, _make_buckets
+
+
+class GenerationModelRunner:
+    def __init__(self, params, model, max_num_seqs: int = 8,
+                 max_model_len: int = 4096):
+        self.params = params
+        self.model = model
+        self._batch_buckets = _make_buckets(1, max(max_num_seqs, 1))
+        self._seq_buckets = _make_buckets(16, max(max_model_len, 16))
+        self._forward = jax.jit(model.forward)
+
+    def execute(self, sched_out: SchedulerOutput,
+                extract_kv: bool = True) -> RunnerOutput:
+        out = RunnerOutput()
+        scheds = sched_out.prefills
+        if not scheds:
+            return out
+        b = _bucket(len(scheds), self._batch_buckets)
+        s_len = _bucket(max(s.num_new_tokens for s in scheds),
+                        self._seq_buckets)
+        token_ids = np.zeros((b, s_len), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i, sc in enumerate(scheds):
+            n = sc.num_new_tokens
+            token_ids[i, :n] = sc.request.prompt_token_ids[:n]
+            lengths[i] = n
+        outputs = self._forward(
+            self.params, jnp.asarray(token_ids), jnp.asarray(lengths)
+        )
+        outputs = {k: np.asarray(jax.device_get(v)) for k, v in outputs.items()}
+        for i, sc in enumerate(scheds):
+            sc.request.multimodal_output.update(
+                self.model.slice_output(outputs, i, int(lengths[i]))
+            )
+        return out
+
+    def extract_kv(self, block_ids, seq_len):
+        raise NotImplementedError("generation models have no KV cache")

@@ -78,6 +78,7 @@ class ARModelRunner:
     ):
         self.params = params
         self.cfg = cfg
+        self.params_dtype = jax.tree_util.tree_leaves(params)[0].dtype
         self.page_size = page_size
         self.max_pages_per_seq = -(-max_model_len // page_size)
         # bucket tables sized to the engine limits — the scheduler never
@@ -99,11 +100,14 @@ class ARModelRunner:
         # KV caches are donated: each step consumes the old cache buffers and
         # returns updated ones — no copy, the XLA equivalent of in-place
         # CUDA cache writes.
+        # one closure serves both paths: inputs_embeds=None and =array are
+        # two jit specializations of the same function
         @functools.partial(jax.jit, donate_argnums=(2,))
         def _prefill(params, token_ids, kv_caches, positions, slot_mapping,
-                     last_idx):
+                     last_idx, inputs_embeds=None, embeds_mask=None):
             hidden, new_caches = tfm.forward_prefill(
-                params, cfg_, token_ids, positions, kv_caches, slot_mapping
+                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
+                inputs_embeds=inputs_embeds, embeds_mask=embeds_mask,
             )
             b = token_ids.shape[0]
             last_hidden = hidden[jnp.arange(b), last_idx]  # [B, H]
@@ -122,6 +126,13 @@ class ARModelRunner:
 
         self._prefill_fn = _prefill
         self._decode_fn = _decode
+        # width of upstream embeds accepted by this model: the embed_proj
+        # input dim when present (thinker width for the talker), else the
+        # model's own hidden size
+        self.embeds_width = (
+            params["embed_proj"]["w"].shape[0]
+            if "embed_proj" in params else cfg.hidden_size
+        )
 
     # ---------------------------------------------------------------- step
     def execute(
@@ -132,7 +143,17 @@ class ARModelRunner:
         if sched_out.decodes:
             self._run_decode(sched_out.decodes, out)
         if sched_out.prefills:
-            self._run_prefill(sched_out.prefills, out)
+            # embeds-as-input prefills (downstream stages consuming upstream
+            # hidden states) run as a separate padded batch — the jit
+            # signature differs by the inputs_embeds operand
+            with_embeds = [s for s in sched_out.prefills
+                           if s.request.prompt_embeds is not None]
+            token_only = [s for s in sched_out.prefills
+                          if s.request.prompt_embeds is None]
+            if token_only:
+                self._run_prefill(token_only, out)
+            if with_embeds:
+                self._run_prefill(with_embeds, out, use_embeds=True)
         for req, block_ids, seq_len in sched_out.kv_transfer_requests:
             # skip the device→host gather when no sink consumes it, but
             # still ACK so the scheduler releases the pinned pages
@@ -144,7 +165,8 @@ class ARModelRunner:
         return out
 
     # ------------------------------------------------------------- prefill
-    def _run_prefill(self, scheds: list[ScheduledRequest], out: RunnerOutput):
+    def _run_prefill(self, scheds: list[ScheduledRequest], out: RunnerOutput,
+                     use_embeds: bool = False):
         b = _bucket(len(scheds), self._batch_buckets)
         max_n = max(s.num_new_tokens for s in scheds)
         s_len = _bucket(max_n, self._seq_buckets)
@@ -153,6 +175,9 @@ class ARModelRunner:
         positions = np.zeros((b, s_len), np.int32)
         slots = np.full((b, s_len), -1, np.int32)
         last_idx = np.zeros((b,), np.int32)
+        embeds = (np.zeros((b, s_len, self.embeds_width), np.float32)
+                  if use_embeds else None)
+        embeds_mask = np.zeros((b, s_len), bool) if use_embeds else None
         for i, sc in enumerate(scheds):
             n = sc.num_new_tokens
             toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + n]
@@ -160,11 +185,30 @@ class ARModelRunner:
             positions[i, :n] = np.arange(sc.start_pos, sc.start_pos + n)
             slots[i, :n] = sc.slot_mapping
             last_idx[i] = n - 1
+            if use_embeds:
+                # embeds cover prompt rows only; a recompute-resumed request
+                # also re-prefills its generated tokens, which embed from
+                # the table (mask False)
+                pe = np.asarray(sc.request.prompt_embeds)
+                lo = min(sc.start_pos, pe.shape[0])
+                hi = min(sc.start_pos + n, pe.shape[0])
+                embeds[i, : hi - lo] = pe[lo:hi]
+                embeds_mask[i, : hi - lo] = True
 
-        logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
-            self.params, jnp.asarray(token_ids), self.kv_caches,
-            jnp.asarray(positions), jnp.asarray(slots), jnp.asarray(last_idx),
-        )
+        if use_embeds:
+            logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
+                self.params, jnp.asarray(token_ids), self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(last_idx),
+                jnp.asarray(embeds, dtype=self.params_dtype),
+                jnp.asarray(embeds_mask),
+            )
+        else:
+            logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
+                self.params, jnp.asarray(token_ids), self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(last_idx),
+            )
         self._sample_and_record(scheds, logits, last_hidden, out,
                                 full_hidden=hidden)
 
