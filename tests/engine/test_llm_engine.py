@@ -238,6 +238,27 @@ def test_prompt_embeds_survives_preemption():
     assert results["a"].outputs[0].token_ids == solo[0].outputs[0].token_ids
 
 
+def test_collect_hidden_correct_after_preemption(tiny_model):
+    """Preemption must not duplicate collected hidden rows: the final
+    hidden_states length equals prompt + outputs - 1 regardless of
+    recompute."""
+    params, cfg = tiny_model
+    eng = _engine(params, cfg, num_pages=6, collect_hidden=True)
+    eng.add_request([1, 2, 3, 4, 5, 6, 7, 8],
+                    SamplingParams(temperature=0.0, max_tokens=8),
+                    request_id="a")
+    eng.add_request([9, 10, 11, 12, 13, 14, 15, 16],
+                    SamplingParams(temperature=0.0, max_tokens=8),
+                    request_id="b")
+    results = {}
+    while eng.has_unfinished_requests:
+        for o in eng.step():
+            results[o.request_id] = o
+    for o in results.values():
+        hs = o.multimodal_output["hidden_states"]
+        assert hs.shape == (8 + 8 - 1, cfg.hidden_size)
+
+
 def test_generation_scheduler_engine(tiny_model):
     params, cfg = tiny_model
     eng = _engine(params, cfg, worker_type="generation", collect_hidden=True)

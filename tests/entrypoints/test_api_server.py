@@ -1,0 +1,191 @@
+"""OpenAI API server e2e over real HTTP (the analogue of the reference's
+online-serving tests, tests/entrypoints/openai_api/)."""
+
+import base64
+import json
+import threading
+
+import httpx
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.entrypoints.openai.api_server import build_server
+
+
+def _llm_stage():
+    return StageConfig(
+        stage_id=0,
+        stage_type="llm",
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=[-1],
+        final_output=True,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0, "max_tokens": 4},
+    )
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    server, state = build_server(
+        model="tiny-lm", stage_configs=[_llm_stage()],
+        host="127.0.0.1", port=0,
+    )
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    state.shutdown()
+
+
+def test_health(server_url):
+    r = httpx.get(f"{server_url}/health", timeout=30)
+    assert r.status_code == 200 and r.json()["status"] == "ok"
+
+
+def test_models(server_url):
+    r = httpx.get(f"{server_url}/v1/models", timeout=30)
+    assert r.status_code == 200
+    assert r.json()["data"][0]["id"] == "tiny-lm"
+
+
+def test_chat_completions(server_url):
+    r = httpx.post(f"{server_url}/v1/chat/completions", json={
+        "model": "tiny-lm",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 5,
+        "temperature": 0,
+    }, timeout=120)
+    assert r.status_code == 200
+    body = r.json()
+    assert body["object"] == "chat.completion"
+    choice = body["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] == "length"
+    assert body["usage"]["completion_tokens"] == 5
+
+
+def test_chat_completions_stream(server_url):
+    with httpx.stream("POST", f"{server_url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3,
+        "stream": True,
+    }, timeout=120) as r:
+        assert r.status_code == 200
+        assert "text/event-stream" in r.headers["content-type"]
+        events = []
+        for line in r.iter_lines():
+            if line.startswith("data: "):
+                events.append(line[6:])
+    assert events[-1] == "[DONE]"
+    chunk = json.loads(events[0])
+    assert chunk["object"] == "chat.completion.chunk"
+    assert chunk["choices"][0]["delta"]["content"] is not None
+
+
+def test_completions(server_url):
+    r = httpx.post(f"{server_url}/v1/completions", json={
+        "prompt": "abc", "max_tokens": 4, "temperature": 0,
+    }, timeout=120)
+    assert r.status_code == 200
+    assert r.json()["choices"][0]["finish_reason"] == "length"
+
+
+def test_bad_request(server_url):
+    r = httpx.post(f"{server_url}/v1/chat/completions", json={}, timeout=30)
+    assert r.status_code == 400
+    assert "error" in r.json()
+
+
+def test_unknown_path(server_url):
+    r = httpx.get(f"{server_url}/nope", timeout=30)
+    assert r.status_code == 404
+
+
+def test_metrics_endpoint(server_url):
+    r = httpx.get(f"{server_url}/metrics", timeout=30)
+    assert r.status_code == 200
+    assert "stages" in r.json()
+
+
+@pytest.fixture(scope="module")
+def diffusion_server_url():
+    cfg = StageConfig(
+        stage_id=0,
+        stage_type="diffusion",
+        engine_args={
+            "model_arch": "QwenImagePipeline", "size": "tiny",
+            "dtype": "float32", "default_height": 32, "default_width": 32,
+        },
+        engine_input_source=[-1],
+        final_output=True,
+        final_output_type="image",
+        default_sampling_params={
+            "height": 32, "width": 32, "num_inference_steps": 2,
+            "guidance_scale": 1.0, "seed": 0,
+        },
+    )
+    server, state = build_server(model="tiny-diff", stage_configs=[cfg],
+                                 host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    state.shutdown()
+
+
+def test_images_generations(diffusion_server_url):
+    r = httpx.post(f"{diffusion_server_url}/v1/images/generations", json={
+        "prompt": "a red square", "size": "32x32",
+        "num_inference_steps": 2,
+    }, timeout=300)
+    assert r.status_code == 200
+    data = r.json()["data"]
+    assert len(data) == 1 and data[0]["b64_json"]
+    base64.b64decode(data[0]["b64_json"])
+
+
+@pytest.fixture(scope="module")
+def qwen3_server_url():
+    import os
+
+    yaml_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "vllm_omni_tpu", "models", "stage_configs",
+        "qwen3_omni_moe_tiny.yaml",
+    )
+    server, state = build_server(model="qwen3-omni-tiny",
+                                 stage_configs=yaml_path,
+                                 host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    state.shutdown()
+
+
+def test_audio_speech(qwen3_server_url):
+    r = httpx.post(f"{qwen3_server_url}/v1/audio/speech", json={
+        "input": "hello", "voice": "default",
+    }, timeout=300)
+    assert r.status_code == 200
+    wav = np.frombuffer(r.content, np.float32)
+    assert wav.size > 0 and np.all(np.isfinite(wav))
+
+
+def test_chat_with_audio_modality(qwen3_server_url):
+    r = httpx.post(f"{qwen3_server_url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4,
+    }, timeout=300)
+    assert r.status_code == 200
+    msg = r.json()["choices"][0]["message"]
+    assert msg["content"] is not None
+    assert "audio" in msg and msg["audio"]["format"] == "f32le"
+    wav = np.frombuffer(base64.b64decode(msg["audio"]["data"]), np.float32)
+    assert wav.size > 0

@@ -1,0 +1,76 @@
+"""AsyncOmni streaming tests (reference analogue: async orchestration in
+entrypoints/async_omni.py with per-request asyncio streams)."""
+
+import asyncio
+
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.entrypoints.async_omni import AsyncOmni
+
+
+def _llm_stage(stage_id, *, final=False, sources=None, max_tokens=4):
+    return StageConfig(
+        stage_id=stage_id,
+        stage_type="llm",
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=sources if sources is not None else [stage_id - 1],
+        final_output=final,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0, "max_tokens": max_tokens},
+    )
+
+
+@pytest.fixture()
+def async_omni():
+    omni = AsyncOmni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    yield omni
+    omni.shutdown()
+
+
+def test_single_request_stream(async_omni):
+    async def run():
+        outs = []
+        async for o in async_omni.generate([1, 2, 3], {"max_tokens": 5}):
+            outs.append(o)
+        return outs
+
+    outs = asyncio.run(run())
+    assert len(outs) == 1
+    assert len(outs[0].outputs[0].token_ids) == 5
+    assert outs[0].outputs[0].text is not None
+
+
+def test_concurrent_requests(async_omni):
+    async def run():
+        async def one(prompt, rid):
+            outs = []
+            async for o in async_omni.generate(prompt, {"max_tokens": 4},
+                                               request_id=rid):
+                outs.append(o)
+            return rid, outs
+
+        return await asyncio.gather(
+            one([1, 2, 3], "a"), one([7, 8], "b"), one([5], "c")
+        )
+
+    results = asyncio.run(run())
+    assert {rid for rid, _ in results} == {"a", "b", "c"}
+    for _, outs in results:
+        assert len(outs) == 1 and len(outs[0].outputs[0].token_ids) == 4
+
+
+def test_string_prompt_roundtrips_tokenizer(async_omni):
+    async def run():
+        outs = []
+        async for o in async_omni.generate("hello", {"max_tokens": 3}):
+            outs.append(o)
+        return outs
+
+    outs = asyncio.run(run())
+    assert len(outs) == 1
+    # byte tokenizer encoded the prompt: 5 bytes + BOS
+    assert len(outs[0].prompt_token_ids) == 6

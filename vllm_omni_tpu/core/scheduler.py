@@ -211,6 +211,9 @@ class ARScheduler:
         """Recompute-preemption: free pages, reset progress, back to waiting."""
         self.kv.free(req)
         req.num_computed_tokens = 0
+        # collected hidden states are recomputed from scratch on resume —
+        # stale chunks would duplicate the prefix
+        req.additional_information.pop("_hidden_chunks", None)
         req.status = RequestStatus.PREEMPTED
         if req in self.running:
             self.running.remove(req)

@@ -65,9 +65,14 @@ class LLMEngine:
         sched_cls = (GenerationScheduler if config.worker_type == "generation"
                      else ARScheduler)
         self.scheduler = sched_cls(sched_cfg, kv)
-        if config.worker_type == "generation" and hasattr(model_cfg, "forward"):
-            # custom one-shot generator (code2wav vocoder etc.): model_cfg
-            # is a model object implementing the generation protocol
+        if not isinstance(model_cfg, tfm.TransformerConfig):
+            # custom generation model object (code2wav vocoder etc.) —
+            # only valid under the one-shot generation scheduler
+            if config.worker_type != "generation":
+                raise TypeError(
+                    "model_cfg must be a TransformerConfig for AR stages; "
+                    f"got {type(model_cfg).__name__}"
+                )
             from vllm_omni_tpu.worker.generation_runner import (
                 GenerationModelRunner,
             )

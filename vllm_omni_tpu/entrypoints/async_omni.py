@@ -1,0 +1,170 @@
+"""AsyncOmni: online multi-stage orchestrator with per-request streaming.
+
+Behavioral port of the reference's AsyncOmni (reference:
+entrypoints/async_omni.py:60 — per-request asyncio streaming over the same
+stage pipeline, output-handler task, abort).  The in-proc TPU build steps
+the stages on a dedicated engine thread (the analogue of the reference's
+stage worker processes) and bridges results into per-request asyncio queues
+via ``loop.call_soon_threadsafe`` — request intake and SSE streaming stay
+non-blocking on the server's event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import queue
+import threading
+from typing import Any, AsyncIterator, Optional, Union
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.entrypoints.omni import Omni
+from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.outputs import OmniRequestOutput
+
+logger = init_logger(__name__)
+
+_SENTINEL = object()
+
+
+class AsyncOmni:
+    def __init__(
+        self,
+        model: Optional[str] = None,
+        stage_configs: Optional[Union[str, list[StageConfig]]] = None,
+        **overrides: Any,
+    ):
+        # reuse the sync orchestrator's stage construction + dataflow
+        self._omni = Omni(model=model, stage_configs=stage_configs,
+                          **overrides)
+        self._n_finals = sum(
+            1 for s in self._omni.stages if s.config.final_output
+        )
+        self._intake: queue.Queue = queue.Queue()
+        # request_id -> (event loop, asyncio.Queue)
+        self._streams: dict[str, tuple[asyncio.AbstractEventLoop,
+                                       asyncio.Queue]] = {}
+        self._finals_seen: dict[str, int] = {}
+        self._req_counter = itertools.count()
+        self._running = True
+        self._thread = threading.Thread(target=self._engine_loop,
+                                        daemon=True, name="omni-engine")
+        self._thread.start()
+
+    # ----------------------------------------------------------- lifecycle
+    def shutdown(self) -> None:
+        self._running = False
+        self._thread.join(timeout=10)
+
+    @property
+    def stage_configs(self):
+        return self._omni.stage_configs
+
+    @property
+    def metrics(self):
+        return self._omni.metrics
+
+    # -------------------------------------------------------------- intake
+    async def generate(
+        self,
+        prompt: Union[str, list[int], dict],
+        sampling_params: Optional[dict] = None,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[OmniRequestOutput]:
+        """Submit one request; yields one OmniRequestOutput per final stage
+        (reference: AsyncOmni.generate, async_omni.py:235)."""
+        if request_id is None:
+            request_id = f"async-{next(self._req_counter)}"
+        sp = dict(sampling_params or {})
+        if isinstance(prompt, dict):
+            req = StageRequest(request_id=request_id, sampling_params=sp,
+                               **prompt)
+        elif isinstance(prompt, str):
+            req = StageRequest(request_id=request_id, prompt=prompt,
+                               sampling_params=sp)
+        else:
+            req = StageRequest(request_id=request_id,
+                               prompt_token_ids=list(prompt),
+                               sampling_params=sp)
+        loop = asyncio.get_running_loop()
+        out_q: asyncio.Queue = asyncio.Queue()
+        self._streams[request_id] = (loop, out_q)
+        self._finals_seen[request_id] = 0
+        self._omni.metrics.record_arrival(request_id)
+        self._intake.put(req)
+        try:
+            while True:
+                item = await out_q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            self._streams.pop(request_id, None)
+            self._finals_seen.pop(request_id, None)
+
+    def abort(self, request_id: str) -> None:
+        """Best-effort abort: drop the stream; in-flight stage work for the
+        request completes and is discarded."""
+        entry = self._streams.pop(request_id, None)
+        if entry is not None:
+            loop, q = entry
+            loop.call_soon_threadsafe(q.put_nowait, _SENTINEL)
+
+    # --------------------------------------------------------- engine loop
+    def _emit(self, request_id: str, item) -> None:
+        entry = self._streams.get(request_id)
+        if entry is None:
+            return
+        loop, q = entry
+        loop.call_soon_threadsafe(q.put_nowait, item)
+
+    def _engine_loop(self) -> None:
+        omni = self._omni
+        entry_stages = [s for s in omni.stages
+                        if -1 in s.config.engine_input_source]
+        entry_stage = entry_stages[0] if entry_stages else omni.stages[0]
+        while self._running:
+            # 1. drain intake
+            pending = []
+            try:
+                while True:
+                    pending.append(self._intake.get_nowait())
+            except queue.Empty:
+                pass
+            if pending:
+                try:
+                    entry_stage.submit(pending)
+                except Exception as e:  # bad request payloads
+                    for r in pending:
+                        self._emit(r.request_id, e)
+                        self._emit(r.request_id, _SENTINEL)
+            # 2. step stages + forward
+            progressed = False
+            for stage in omni.stages:
+                try:
+                    outs = stage.poll()
+                except Exception as e:
+                    logger.exception("stage %d poll failed", stage.stage_id)
+                    for rid in list(self._streams):
+                        self._emit(rid, e)
+                        self._emit(rid, _SENTINEL)
+                    continue
+                if not outs:
+                    continue
+                progressed = True
+                if stage.config.final_output:
+                    for o in outs:
+                        o.final_output_type = stage.config.final_output_type
+                        omni.metrics.record_finish(o.request_id)
+                        self._emit(o.request_id, o)
+                        seen = self._finals_seen.get(o.request_id, 0) + 1
+                        self._finals_seen[o.request_id] = seen
+                        if seen >= self._n_finals:
+                            self._emit(o.request_id, _SENTINEL)
+                omni._forward(stage, outs)
+            if not progressed and not pending:
+                # idle: avoid a hot spin on the GIL
+                threading.Event().wait(0.002)

@@ -1,0 +1,93 @@
+"""CLI: ``python -m vllm_omni_tpu.entrypoints.cli serve|generate|bench``.
+
+The TPU-native analogue of the reference's ``vllm serve <model> --omni``
+interception (reference: entrypoints/cli/main.py:10-17, OmniServeCommand
+cli/serve.py:42 with diffusion autodetect :55-63).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _add_common(p: argparse.ArgumentParser):
+    p.add_argument("model", nargs="?", default=None,
+                   help="model name/path (resolves an in-tree stage YAML)")
+    p.add_argument("--stage-configs-path", default=None,
+                   help="explicit stage-config YAML (overrides model lookup)")
+
+
+def cmd_serve(args) -> int:
+    from vllm_omni_tpu.entrypoints.openai.api_server import run_server
+
+    run_server(
+        model=args.model,
+        stage_configs=args.stage_configs_path,
+        host=args.host,
+        port=args.port,
+    )
+    return 0
+
+
+def cmd_generate(args) -> int:
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    omni = Omni(model=args.model, stage_configs=args.stage_configs_path)
+    sp = json.loads(args.sampling_params) if args.sampling_params else {}
+    outs = omni.generate([args.prompt], [sp])
+    for o in outs:
+        if o.final_output_type == "text" and o.outputs:
+            print(o.outputs[0].text or o.outputs[0].token_ids)
+        elif o.final_output_type == "image" and o.images:
+            import numpy as np
+
+            path = f"{o.request_id}.npy"
+            np.save(path, np.asarray(o.images[0]))
+            print(f"image saved to {path}")
+        elif "audio" in o.multimodal_output:
+            import numpy as np
+
+            path = f"{o.request_id}.f32"
+            np.asarray(o.multimodal_output["audio"],
+                       dtype=np.float32).tofile(path)
+            print(f"audio saved to {path}")
+    print(json.dumps(omni.metrics.summary(), indent=2), file=sys.stderr)
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import runpy
+
+    sys.argv = ["bench.py"]
+    runpy.run_path("bench.py", run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vllm-omni-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    serve = sub.add_parser("serve", help="start the OpenAI-compatible server")
+    _add_common(serve)
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=8000)
+    serve.set_defaults(fn=cmd_serve)
+
+    gen = sub.add_parser("generate", help="offline one-shot generation")
+    _add_common(gen)
+    gen.add_argument("--prompt", required=True)
+    gen.add_argument("--sampling-params", default=None,
+                     help='JSON, e.g. \'{"max_tokens": 32}\'')
+    gen.set_defaults(fn=cmd_generate)
+
+    bench = sub.add_parser("bench", help="run the repo benchmark")
+    bench.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,7 @@ class OmniStage:
     def __init__(self, config: StageConfig):
         self.config = config
         self.stage_id = config.stage_id
+        self.tokenizer = None  # set for llm stages in _build_engine
         self.engine = self._build_engine()
         self._pending: list[StageRequest] = []
         self._done: list[OmniRequestOutput] = []
@@ -76,6 +77,21 @@ class OmniStage:
 
             known = EngineConfig.__dataclass_fields__
             eng_kwargs = {k: v for k, v in args.items() if k in known}
+            # Tokenizer only where text crosses the boundary: entry stages
+            # encode string prompts, text-final stages decode outputs.
+            # Intermediate codec stages (talker) must NOT decode their token
+            # ids into byte-garbage "text".
+            is_text_stage = (
+                -1 in self.config.engine_input_source
+                or (self.config.final_output
+                    and self.config.final_output_type == "text")
+            )
+            if is_text_stage and getattr(model_cfg, "vocab_size", None):
+                from vllm_omni_tpu.utils.tokenizer import load_tokenizer
+
+                self.tokenizer = load_tokenizer(
+                    args.get("model"), model_cfg.vocab_size
+                )
             return LLMEngine(params, model_cfg, EngineConfig(**eng_kwargs),
                              eos_token_id=eos)
         elif self.config.stage_type == "diffusion":
@@ -94,6 +110,9 @@ class OmniStage:
         if self.config.stage_type == "llm":
             defaults = dict(self.config.default_sampling_params)
             for r in reqs:
+                if (r.prompt_token_ids is None and r.prompt is not None
+                        and self.tokenizer is not None):
+                    r.prompt_token_ids = self.tokenizer.encode(r.prompt)
                 sp_kwargs = {**defaults, **r.sampling_params}
                 known = SamplingParams.__dataclass_fields__
                 sp = SamplingParams(
@@ -118,8 +137,14 @@ class OmniStage:
                 outs = self.engine.step()
         else:
             outs = self._run_diffusion_batch()
+        decode_text = (self.tokenizer is not None
+                       and self.config.final_output_type == "text")
         for o in outs:
             o.stage_id = self.stage_id
+            if decode_text:
+                for c in o.outputs:
+                    if c.text is None:
+                        c.text = self.tokenizer.decode(c.token_ids)
             self._record(o)
         return outs
 

@@ -1,0 +1,412 @@
+"""OpenAI-compatible API server.
+
+Behavioral port of the reference's FastAPI server (reference:
+entrypoints/openai/api_server.py:107 — /v1/chat/completions:729,
+/v1/images/generations:935, /health:860, /v1/models:896, audio speech:805)
+on the standard library's threading HTTP server: the runtime ships zero
+web-framework dependencies, matching the native-runtime stance (handler
+threads submit into AsyncOmni's event loop and stream SSE chunks back).
+
+Run: ``python -m vllm_omni_tpu.entrypoints.cli serve <model> [--port]``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import io
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+from vllm_omni_tpu.entrypoints.async_omni import AsyncOmni
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+
+class ServerState:
+    """Owns the AsyncOmni engine + the asyncio loop it streams on."""
+
+    def __init__(self, omni: AsyncOmni, model_name: str):
+        self.omni = omni
+        self.model_name = model_name
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self.loop.run_forever, daemon=True, name="omni-asyncio"
+        )
+        self._loop_thread.start()
+
+    def shutdown(self):
+        self.omni.shutdown()
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+    # ---------------------------------------------------------- bridging
+    def collect(self, prompt, sampling_params, request_id=None) -> list:
+        """Run one request to completion, returning all final outputs."""
+
+        async def _run():
+            outs = []
+            async for o in self.omni.generate(prompt, sampling_params,
+                                              request_id):
+                outs.append(o)
+            return outs
+
+        return asyncio.run_coroutine_threadsafe(_run(), self.loop).result()
+
+    def stream(self, prompt, sampling_params, request_id=None):
+        """Sync iterator over final outputs (SSE bridging)."""
+        q: "list" = []
+        done = threading.Event()
+        lock = threading.Lock()
+
+        async def _run():
+            try:
+                async for o in self.omni.generate(prompt, sampling_params,
+                                                  request_id):
+                    with lock:
+                        q.append(o)
+            except Exception as e:  # surfaced as an SSE error event
+                with lock:
+                    q.append(e)
+            finally:
+                done.set()
+
+        asyncio.run_coroutine_threadsafe(_run(), self.loop)
+        while True:
+            with lock:
+                items, q[:] = list(q), []
+            yield from items
+            if done.is_set():
+                with lock:
+                    yield from q
+                return
+            time.sleep(0.005)
+
+
+def _chat_prompt_from_messages(messages: list[dict]) -> str:
+    """Minimal chat templating (reference applies HF chat templates via
+    _preprocess_chat, serving_chat.py:335; the byte-tokenizer path uses a
+    plain role-tagged transcript)."""
+    parts = []
+    for m in messages:
+        content = m.get("content", "")
+        if isinstance(content, list):  # multimodal content parts
+            content = " ".join(
+                c.get("text", "") for c in content if c.get("type") == "text"
+            )
+        parts.append(f"{m.get('role', 'user')}: {content}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+def _sampling_from_body(body: dict) -> dict:
+    sp = {}
+    if "max_tokens" in body or "max_completion_tokens" in body:
+        sp["max_tokens"] = body.get("max_completion_tokens",
+                                    body.get("max_tokens"))
+    for k in ("temperature", "top_p", "seed"):
+        if body.get(k) is not None:
+            sp[k] = body[k]
+    if body.get("top_k") is not None:
+        sp["top_k"] = body["top_k"]
+    return sp
+
+
+def _b64_png(img: np.ndarray) -> str:
+    """uint8 [H, W, 3] -> base64 PNG (PIL if present, raw fallback)."""
+    try:
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        return base64.b64encode(buf.getvalue()).decode()
+    except ImportError:
+        return base64.b64encode(img.tobytes()).decode()
+
+
+class OmniRequestHandler(BaseHTTPRequestHandler):
+    state: ServerState  # injected via server class attribute
+    protocol_version = "HTTP/1.1"
+
+    # --------------------------------------------------------------- utils
+    def log_message(self, fmt, *args):
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    def _json(self, code: int, obj: dict):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, message: str, etype: str = "invalid_request_error"):
+        self._json(code, {"error": {"message": message, "type": etype}})
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _sse_start(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _sse_send(self, obj) -> None:
+        payload = ("data: " + (obj if isinstance(obj, str)
+                               else json.dumps(obj)) + "\n\n").encode()
+        self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
+
+    def _sse_end(self):
+        self.wfile.write(b"0\r\n\r\n")
+
+    # --------------------------------------------------------------- GET
+    def do_GET(self):
+        if self.path == "/health":
+            self._json(200, {"status": "ok"})
+        elif self.path == "/v1/models":
+            self._json(200, {
+                "object": "list",
+                "data": [{
+                    "id": self.state.model_name,
+                    "object": "model",
+                    "owned_by": "vllm-omni-tpu",
+                    "max_model_len": None,
+                }],
+            })
+        elif self.path == "/version":
+            self._json(200, {"version": __version__})
+        elif self.path == "/metrics":
+            self._json(200, self.state.omni.metrics.summary())
+        else:
+            self._error(404, f"unknown path {self.path}")
+
+    # --------------------------------------------------------------- POST
+    def do_POST(self):
+        try:
+            body = self._body()
+        except (json.JSONDecodeError, ValueError) as e:
+            return self._error(400, f"bad JSON: {e}")
+        try:
+            if self.path == "/v1/chat/completions":
+                self._chat_completions(body)
+            elif self.path == "/v1/completions":
+                self._completions(body)
+            elif self.path == "/v1/images/generations":
+                self._images_generations(body)
+            elif self.path == "/v1/audio/speech":
+                self._audio_speech(body)
+            else:
+                self._error(404, f"unknown path {self.path}")
+        except BrokenPipeError:
+            pass
+        except Exception as e:
+            logger.exception("request failed")
+            try:
+                self._error(500, str(e), "internal_error")
+            except Exception:
+                pass
+
+    # ------------------------------------------------------ chat/completions
+    def _chat_completions(self, body: dict):
+        messages = body.get("messages")
+        if not messages:
+            return self._error(400, "messages required")
+        prompt = _chat_prompt_from_messages(messages)
+        sp = _sampling_from_body(body)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        created = int(time.time())
+        if body.get("stream"):
+            self._sse_start()
+            for out in self.state.stream(prompt, sp, rid):
+                if isinstance(out, Exception):
+                    self._sse_send({"error": {"message": str(out)}})
+                    break
+                for chunk in self._chat_chunks(out, rid, created):
+                    self._sse_send(chunk)
+            self._sse_send("[DONE]")
+            self._sse_end()
+            return
+        outs = self.state.collect(prompt, sp, rid)
+        text_out = next((o for o in outs if o.final_output_type == "text"),
+                        outs[0] if outs else None)
+        if text_out is None:
+            return self._error(500, "pipeline produced no output",
+                               "internal_error")
+        message: dict[str, Any] = {
+            "role": "assistant",
+            "content": (text_out.outputs[0].text
+                        if text_out.outputs else None),
+        }
+        # multimodal finals ride OpenAI-style audio/images extensions
+        # (reference: audio/image choices, serving_chat.py:1617,1683)
+        for o in outs:
+            if o.final_output_type == "audio" and "audio" in o.multimodal_output:
+                wav = np.asarray(o.multimodal_output["audio"], np.float32)
+                message["audio"] = {
+                    "id": f"audio-{rid}",
+                    "data": base64.b64encode(wav.tobytes()).decode(),
+                    "format": "f32le",
+                }
+            elif o.final_output_type == "image" and o.images:
+                message["images"] = [
+                    {"b64_json": _b64_png(np.asarray(img))}
+                    for img in o.images
+                ]
+        n_prompt = len(text_out.prompt_token_ids)
+        n_out = sum(len(c.token_ids) for c in text_out.outputs)
+        self._json(200, {
+            "id": rid,
+            "object": "chat.completion",
+            "created": created,
+            "model": body.get("model", self.state.model_name),
+            "choices": [{
+                "index": 0,
+                "message": message,
+                "finish_reason": (text_out.outputs[0].finish_reason
+                                  if text_out.outputs else None),
+            }],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": n_out,
+                "total_tokens": n_prompt + n_out,
+            },
+        })
+
+    def _chat_chunks(self, out, rid: str, created: int):
+        base = {
+            "id": rid,
+            "object": "chat.completion.chunk",
+            "created": created,
+            "model": self.state.model_name,
+        }
+        if out.final_output_type == "text" and out.outputs:
+            yield {**base, "choices": [{
+                "index": 0,
+                "delta": {"role": "assistant",
+                          "content": out.outputs[0].text},
+                "finish_reason": out.outputs[0].finish_reason,
+            }]}
+        elif out.final_output_type == "audio" and "audio" in out.multimodal_output:
+            wav = np.asarray(out.multimodal_output["audio"], np.float32)
+            yield {**base, "choices": [{
+                "index": 0,
+                "delta": {"audio": {
+                    "data": base64.b64encode(wav.tobytes()).decode(),
+                    "format": "f32le",
+                }},
+                "finish_reason": None,
+            }]}
+
+    # ---------------------------------------------------------- completions
+    def _completions(self, body: dict):
+        prompt = body.get("prompt")
+        if prompt is None:
+            return self._error(400, "prompt required")
+        sp = _sampling_from_body(body)
+        rid = f"cmpl-{uuid.uuid4().hex[:16]}"
+        outs = self.state.collect(prompt, sp, rid)
+        text_out = next((o for o in outs if o.final_output_type == "text"),
+                        None)
+        if text_out is None:
+            return self._error(500, "no text output", "internal_error")
+        self._json(200, {
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.state.model_name),
+            "choices": [{
+                "index": 0,
+                "text": text_out.outputs[0].text,
+                "finish_reason": text_out.outputs[0].finish_reason,
+            }],
+        })
+
+    # ------------------------------------------------- images/generations
+    def _images_generations(self, body: dict):
+        prompt = body.get("prompt")
+        if not prompt:
+            return self._error(400, "prompt required")
+        sp: dict[str, Any] = {}
+        if body.get("size"):
+            try:
+                w, h = body["size"].lower().split("x")
+                sp["width"], sp["height"] = int(w), int(h)
+            except ValueError:
+                return self._error(400, f"bad size {body['size']!r}")
+        for k in ("num_inference_steps", "guidance_scale", "seed"):
+            if body.get(k) is not None:
+                sp[k] = body[k]
+        n = int(body.get("n", 1))
+        rid = f"img-{uuid.uuid4().hex[:16]}"
+        data = []
+        for i in range(n):
+            outs = self.state.collect(prompt, sp, f"{rid}-{i}")
+            for o in outs:
+                if o.final_output_type == "image":
+                    data.extend(
+                        {"b64_json": _b64_png(np.asarray(img))}
+                        for img in o.images
+                    )
+        self._json(200, {"created": int(time.time()), "data": data})
+
+    # ------------------------------------------------------- audio/speech
+    def _audio_speech(self, body: dict):
+        text = body.get("input")
+        if not text:
+            return self._error(400, "input required")
+        rid = f"speech-{uuid.uuid4().hex[:16]}"
+        outs = self.state.collect(text, {}, rid)
+        audio = next(
+            (o.multimodal_output["audio"] for o in outs
+             if o.final_output_type == "audio"
+             and "audio" in o.multimodal_output),
+            None,
+        )
+        if audio is None:
+            return self._error(500, "pipeline produced no audio",
+                               "internal_error")
+        raw = np.asarray(audio, np.float32).tobytes()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+
+def build_server(
+    model: Optional[str] = None,
+    stage_configs=None,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    **overrides,
+) -> tuple[ThreadingHTTPServer, ServerState]:
+    omni = AsyncOmni(model=model, stage_configs=stage_configs, **overrides)
+    state = ServerState(omni, model or "omni")
+    handler = type("BoundHandler", (OmniRequestHandler,), {"state": state})
+    server = ThreadingHTTPServer((host, port), handler)
+    return server, state
+
+
+def run_server(model=None, stage_configs=None, host="0.0.0.0", port=8000,
+               **overrides):
+    server, state = build_server(model, stage_configs, host, port, **overrides)
+    logger.info("vllm-omni-tpu API server on %s:%d", host, port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        state.shutdown()
+        server.server_close()

@@ -195,20 +195,14 @@ class ARModelRunner:
                 embeds[i, : hi - lo] = pe[lo:hi]
                 embeds_mask[i, : hi - lo] = True
 
-        if use_embeds:
-            logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
-                self.params, jnp.asarray(token_ids), self.kv_caches,
-                jnp.asarray(positions), jnp.asarray(slots),
-                jnp.asarray(last_idx),
-                jnp.asarray(embeds, dtype=self.params_dtype),
-                jnp.asarray(embeds_mask),
-            )
-        else:
-            logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
-                self.params, jnp.asarray(token_ids), self.kv_caches,
-                jnp.asarray(positions), jnp.asarray(slots),
-                jnp.asarray(last_idx),
-            )
+        logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
+            self.params, jnp.asarray(token_ids), self.kv_caches,
+            jnp.asarray(positions), jnp.asarray(slots),
+            jnp.asarray(last_idx),
+            (jnp.asarray(embeds, dtype=self.params_dtype)
+             if use_embeds else None),
+            jnp.asarray(embeds_mask) if use_embeds else None,
+        )
         self._sample_and_record(scheds, logits, last_hidden, out,
                                 full_hidden=hidden)
 
