@@ -238,6 +238,25 @@ def test_prompt_embeds_survives_preemption():
     assert results["a"].outputs[0].token_ids == solo[0].outputs[0].token_ids
 
 
+def test_starved_request_error_finishes_not_crashes(tiny_model):
+    """A request whose recompute footprint outgrows the KV pool is
+    error-finished; the engine stays serviceable for later requests."""
+    params, cfg = tiny_model
+    eng = _engine(params, cfg, num_pages=2)  # pool: 8 tokens
+    eng.add_request([1, 2, 3, 4, 5, 6],
+                    SamplingParams(temperature=0.0, max_tokens=10),
+                    request_id="grow")
+    results = {}
+    while eng.has_unfinished_requests:
+        for o in eng.step():
+            results[o.request_id] = o
+    assert results["grow"].outputs[0].finish_reason == "error"
+    # engine still works afterwards
+    outs = eng.generate([[1, 2]], SamplingParams(temperature=0.0,
+                                                 max_tokens=2))
+    assert outs[0].outputs[0].finish_reason == "length"
+
+
 def test_collect_hidden_correct_after_preemption(tiny_model):
     """Preemption must not duplicate collected hidden rows: the final
     hidden_states length equals prompt + outputs - 1 regardless of

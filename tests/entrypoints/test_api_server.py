@@ -95,6 +95,25 @@ def test_completions(server_url):
     assert r.json()["choices"][0]["finish_reason"] == "length"
 
 
+def test_completions_list_of_strings(server_url):
+    r = httpx.post(f"{server_url}/v1/completions", json={
+        "prompt": ["abc", "def"], "max_tokens": 3, "temperature": 0,
+    }, timeout=120)
+    assert r.status_code == 200
+    choices = r.json()["choices"]
+    assert len(choices) == 2
+    assert [c["index"] for c in choices] == [0, 1]
+
+
+def test_null_max_tokens_treated_as_unset(server_url):
+    r = httpx.post(f"{server_url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 2, "max_completion_tokens": None,
+    }, timeout=120)
+    assert r.status_code == 200
+    assert r.json()["usage"]["completion_tokens"] == 2
+
+
 def test_bad_request(server_url):
     r = httpx.post(f"{server_url}/v1/chat/completions", json={}, timeout=30)
     assert r.status_code == 400

@@ -29,7 +29,7 @@ from vllm_omni_tpu.core.scheduler import (
 )
 from vllm_omni_tpu.models.common import transformer as tfm
 from vllm_omni_tpu.outputs import OmniRequestOutput
-from vllm_omni_tpu.request import Request
+from vllm_omni_tpu.request import Request, RequestStatus
 from vllm_omni_tpu.sampling_params import SamplingParams
 from vllm_omni_tpu.worker.model_runner import ARModelRunner
 
@@ -132,11 +132,23 @@ class LLMEngine:
                    for r in self.scheduler.drain_errored()]
         sched_out = self.scheduler.schedule()
         if sched_out.num_scheduled == 0:
-            # deadlock guard: nothing runnable but requests remain
+            if self.scheduler.waiting:
+                # Starved: the head waiting request can never fit (e.g. its
+                # recompute footprint outgrew the pool). Error-finish it so
+                # one bad request can't wedge the whole engine.
+                victim = self.scheduler.waiting.pop(0)
+                victim.status = RequestStatus.FINISHED_ERROR
+                victim.additional_information.setdefault(
+                    "error",
+                    "request starved: does not fit in the KV cache "
+                    f"({self.scheduler.kv.num_free_pages} pages free)",
+                )
+                errored.append(OmniRequestOutput.from_pipeline(victim))
+                return errored
             if self.scheduler.has_unfinished:
                 raise RuntimeError(
-                    "scheduler starved: no request fits in the KV cache "
-                    f"({self.scheduler.kv.num_free_pages} pages free)"
+                    "scheduler deadlock: running requests but nothing "
+                    "schedulable"
                 )
             return errored
         run_out = self.runner.execute(

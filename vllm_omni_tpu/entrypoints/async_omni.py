@@ -76,6 +76,8 @@ class AsyncOmni:
         (reference: AsyncOmni.generate, async_omni.py:235)."""
         if request_id is None:
             request_id = f"async-{next(self._req_counter)}"
+        elif request_id in self._streams:
+            raise ValueError(f"request_id {request_id!r} already in flight")
         sp = dict(sampling_params or {})
         if isinstance(prompt, dict):
             req = StageRequest(request_id=request_id, sampling_params=sp,
@@ -147,6 +149,9 @@ class AsyncOmni:
                 try:
                     outs = stage.poll()
                 except Exception as e:
+                    # last resort: a poll failure can't be attributed to one
+                    # request (engine-level starvation is error-finished per
+                    # request inside LLMEngine.step and arrives as outputs)
                     logger.exception("stage %d poll failed", stage.stage_id)
                     for rid in list(self._streams):
                         self._emit(rid, e)
@@ -164,7 +169,15 @@ class AsyncOmni:
                         self._finals_seen[o.request_id] = seen
                         if seen >= self._n_finals:
                             self._emit(o.request_id, _SENTINEL)
-                omni._forward(stage, outs)
+                try:
+                    omni._forward(stage, outs)
+                except Exception as e:
+                    # scope the failure to the requests in this batch
+                    logger.exception("forward from stage %d failed",
+                                     stage.stage_id)
+                    for o in outs:
+                        self._emit(o.request_id, e)
+                        self._emit(o.request_id, _SENTINEL)
             if not progressed and not pending:
                 # idle: avoid a hot spin on the GIL
                 threading.Event().wait(0.002)

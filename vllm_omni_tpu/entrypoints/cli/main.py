@@ -58,10 +58,14 @@ def cmd_generate(args) -> int:
 
 
 def cmd_bench(args) -> int:
+    import os
     import runpy
 
-    sys.argv = ["bench.py"]
-    runpy.run_path("bench.py", run_name="__main__")
+    # bench.py lives at the repo root, three levels above this module
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    bench_path = os.path.join(repo_root, "bench.py")
+    runpy.run_path(bench_path, run_name="__main__")
     return 0
 
 

@@ -72,7 +72,8 @@ class OmniStage:
                 )
             if isinstance(factory, str):
                 factory = _import_obj(factory)
-            params, model_cfg, eos = factory()
+            factory_args = args.pop("model_factory_args", {}) or {}
+            params, model_cfg, eos = factory(**factory_args)
             from vllm_omni_tpu.engine import EngineConfig, LLMEngine
 
             known = EngineConfig.__dataclass_fields__

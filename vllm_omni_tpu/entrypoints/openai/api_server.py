@@ -62,32 +62,43 @@ class ServerState:
 
     def stream(self, prompt, sampling_params, request_id=None):
         """Sync iterator over final outputs (SSE bridging)."""
-        q: "list" = []
-        done = threading.Event()
-        lock = threading.Lock()
+        import queue as _queue
+
+        q: _queue.Queue = _queue.Queue()
+        done = object()
 
         async def _run():
             try:
                 async for o in self.omni.generate(prompt, sampling_params,
                                                   request_id):
-                    with lock:
-                        q.append(o)
+                    q.put(o)
             except Exception as e:  # surfaced as an SSE error event
-                with lock:
-                    q.append(e)
+                q.put(e)
             finally:
-                done.set()
+                q.put(done)
 
         asyncio.run_coroutine_threadsafe(_run(), self.loop)
         while True:
-            with lock:
-                items, q[:] = list(q), []
-            yield from items
-            if done.is_set():
-                with lock:
-                    yield from q
+            item = q.get()
+            if item is done:
                 return
-            time.sleep(0.005)
+            yield item
+
+    def collect_many(self, jobs: list[tuple]) -> list[list]:
+        """Run several (prompt, sampling_params, request_id) jobs
+        concurrently so batching stages can batch them."""
+
+        async def _run_all():
+            async def one(prompt, sp, rid):
+                outs = []
+                async for o in self.omni.generate(prompt, sp, rid):
+                    outs.append(o)
+                return outs
+
+            return await asyncio.gather(*(one(*j) for j in jobs))
+
+        return asyncio.run_coroutine_threadsafe(_run_all(),
+                                                self.loop).result()
 
 
 def _chat_prompt_from_messages(messages: list[dict]) -> str:
@@ -108,14 +119,15 @@ def _chat_prompt_from_messages(messages: list[dict]) -> str:
 
 def _sampling_from_body(body: dict) -> dict:
     sp = {}
-    if "max_tokens" in body or "max_completion_tokens" in body:
-        sp["max_tokens"] = body.get("max_completion_tokens",
-                                    body.get("max_tokens"))
-    for k in ("temperature", "top_p", "seed"):
+    # explicit nulls mean "unset" per OpenAI semantics
+    max_toks = body.get("max_completion_tokens")
+    if max_toks is None:
+        max_toks = body.get("max_tokens")
+    if max_toks is not None:
+        sp["max_tokens"] = max_toks
+    for k in ("temperature", "top_p", "top_k", "seed"):
         if body.get(k) is not None:
             sp[k] = body[k]
-    if body.get("top_k") is not None:
-        sp["top_k"] = body["top_k"]
     return sp
 
 
@@ -314,23 +326,39 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         prompt = body.get("prompt")
         if prompt is None:
             return self._error(400, "prompt required")
+        # OpenAI prompt forms: str | [str, ...] | [int, ...] (token ids)
+        if isinstance(prompt, str):
+            prompts = [prompt]
+        elif isinstance(prompt, list) and prompt and all(
+                isinstance(p, str) for p in prompt):
+            prompts = prompt
+        elif isinstance(prompt, list) and all(
+                isinstance(p, int) for p in prompt):
+            prompts = [prompt]
+        else:
+            return self._error(400, "prompt must be a string, list of "
+                               "strings, or list of token ids")
         sp = _sampling_from_body(body)
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
-        outs = self.state.collect(prompt, sp, rid)
-        text_out = next((o for o in outs if o.final_output_type == "text"),
-                        None)
-        if text_out is None:
-            return self._error(500, "no text output", "internal_error")
+        jobs = [(p, sp, f"{rid}-{i}") for i, p in enumerate(prompts)]
+        all_outs = self.state.collect_many(jobs)
+        choices = []
+        for i, outs in enumerate(all_outs):
+            text_out = next(
+                (o for o in outs if o.final_output_type == "text"), None)
+            if text_out is None:
+                return self._error(500, "no text output", "internal_error")
+            choices.append({
+                "index": i,
+                "text": text_out.outputs[0].text,
+                "finish_reason": text_out.outputs[0].finish_reason,
+            })
         self._json(200, {
             "id": rid,
             "object": "text_completion",
             "created": int(time.time()),
             "model": body.get("model", self.state.model_name),
-            "choices": [{
-                "index": 0,
-                "text": text_out.outputs[0].text,
-                "finish_reason": text_out.outputs[0].finish_reason,
-            }],
+            "choices": choices,
         })
 
     # ------------------------------------------------- images/generations
@@ -350,9 +378,10 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 sp[k] = body[k]
         n = int(body.get("n", 1))
         rid = f"img-{uuid.uuid4().hex[:16]}"
+        # submit all n at once so the diffusion stage can batch them
+        jobs = [(prompt, sp, f"{rid}-{i}") for i in range(n)]
         data = []
-        for i in range(n):
-            outs = self.state.collect(prompt, sp, f"{rid}-{i}")
+        for outs in self.state.collect_many(jobs):
             for o in outs:
                 if o.final_output_type == "image":
                     data.extend(

@@ -1,0 +1,12 @@
+from vllm_omni_tpu.model_loader.safetensors_loader import (
+    iter_safetensors,
+    load_checkpoint_tree,
+)
+from vllm_omni_tpu.model_loader.hf_qwen import config_from_hf, load_qwen_lm
+
+__all__ = [
+    "config_from_hf",
+    "iter_safetensors",
+    "load_checkpoint_tree",
+    "load_qwen_lm",
+]

@@ -1,0 +1,209 @@
+"""HF-format Qwen2/Qwen3(-MoE) LM checkpoint loading.
+
+Maps HuggingFace transformer weight names onto the functional param tree of
+models/common/transformer.py (reference loads these models through vLLM's
+loader; omni-side registration at vllm_omni/engine/arg_utils.py:33-43).
+
+Layout conversions:
+- HF linears are [out, in]; ours are [in, out] (transpose).
+- HF gate_proj/up_proj pairs fuse into our ``gate_up`` [in, 2*inter]
+  (silu_mul splits [gate; up] halves, ops/activation.py:13).
+- HF per-expert MLPs stack onto the leading E axis of ``experts.gate_up`` /
+  ``experts.down`` (the EP shard axis).
+
+Streaming: shards load one at a time into preallocated numpy buffers, so
+peak host memory is params + one shard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.model_loader.safetensors_loader import iter_safetensors
+from vllm_omni_tpu.models.common import transformer as tfm
+
+logger = init_logger(__name__)
+
+
+def config_from_hf(model_dir: str,
+                   hf_config_name: Optional[str] = None) -> tfm.TransformerConfig:
+    """Translate an HF config.json into a TransformerConfig.
+
+    ``hf_config_name`` selects a sub-config inside multi-part checkpoints
+    (reference: OmniModelConfig.hf_config_name, config/model.py:46-60 —
+    e.g. "thinker_config.text_config" for Qwen3-Omni).
+    """
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    for part in (hf_config_name or "").split("."):
+        if part:
+            hf = hf[part]
+    num_heads = hf["num_attention_heads"]
+    moe = "num_experts" in hf or "num_routed_experts" in hf
+    return tfm.TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=hf.get("head_dim", hf["hidden_size"] // num_heads),
+        intermediate_size=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 1e6),
+        rms_eps=hf.get("rms_norm_eps", 1e-6),
+        qk_norm="qwen3" in hf.get("model_type", "").lower(),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        moe=moe,
+        num_experts=hf.get("num_experts", hf.get("num_routed_experts", 8)),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        moe_intermediate_size=hf.get("moe_intermediate_size", 0),
+    )
+
+
+def _alloc_tree(cfg: tfm.TransformerConfig, dtype) -> dict:
+    """Numpy buffers shaped like init_params output, without computing
+    random values (jax.eval_shape traces the init)."""
+    shapes = jax.eval_shape(
+        lambda: tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    )
+    return jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, dtype), shapes
+    )
+
+
+_LAYER_RE = re.compile(
+    r"^(?:model|language_model|thinker\.model|talker\.model)\."
+    r"layers\.(\d+)\.(.+?)\.weight$"
+)
+_PREFIX_RE = re.compile(
+    r"^(?:model|language_model|thinker\.model|talker\.model)\."
+)
+
+_DIRECT = {
+    "input_layernorm": ("input_norm", "w", False),
+    "post_attention_layernorm": ("post_norm", "w", False),
+    "self_attn.q_proj": ("q_proj", "w", True),
+    "self_attn.k_proj": ("k_proj", "w", True),
+    "self_attn.v_proj": ("v_proj", "w", True),
+    "self_attn.o_proj": ("o_proj", "w", True),
+    "self_attn.q_norm": ("q_norm", "w", False),
+    "self_attn.k_norm": ("k_norm", "w", False),
+    "mlp.down_proj": ("down", "w", True),
+}
+
+_EXPERT_RE = re.compile(r"^mlp\.experts\.(\d+)\.(gate_proj|up_proj|down_proj)$")
+
+
+def load_qwen_lm(
+    model_dir: str,
+    cfg: Optional[tfm.TransformerConfig] = None,
+    dtype=jnp.bfloat16,
+    hf_config_name: Optional[str] = None,
+):
+    """Load an HF Qwen2/Qwen3(-MoE) checkpoint.
+
+    Returns (params, cfg, eos_token_id) — the model_factory contract.
+    """
+    if cfg is None:
+        cfg = config_from_hf(model_dir, hf_config_name)
+    if isinstance(dtype, str):  # YAML model_factory_args pass strings
+        from vllm_omni_tpu.config.model import resolve_dtype
+
+        dtype = resolve_dtype(dtype)
+    np_dtype = np.dtype(jnp.dtype(dtype).name) if dtype != jnp.bfloat16 \
+        else jnp.bfloat16
+    params = _alloc_tree(cfg, np_dtype)
+    inter = cfg.moe_intermediate_size or cfg.intermediate_size
+
+    loaded, unmapped = 0, []
+    for name, arr in iter_safetensors(model_dir):
+        m = _LAYER_RE.match(name)
+        if m:
+            li, sub = int(m.group(1)), m.group(2)
+            if li >= cfg.num_layers:
+                unmapped.append(name)
+                continue
+            layer = params["layers"][li]
+            if sub in _DIRECT:
+                key, leaf, transpose = _DIRECT[sub]
+                if key not in layer:
+                    unmapped.append(name)
+                    continue
+                layer[key][leaf][...] = arr.T if transpose else arr
+                loaded += 1
+                continue
+            if sub == "mlp.gate_proj":
+                layer["gate_up"]["w"][:, : cfg.intermediate_size] = arr.T
+                loaded += 1
+                continue
+            if sub == "mlp.up_proj":
+                layer["gate_up"]["w"][:, cfg.intermediate_size:] = arr.T
+                loaded += 1
+                continue
+            if sub == "mlp.gate":  # MoE router [E, hidden]
+                layer["router"]["w"][...] = arr.T
+                loaded += 1
+                continue
+            em = _EXPERT_RE.match(sub)
+            if em and cfg.moe:
+                e, which = int(em.group(1)), em.group(2)
+                if which == "gate_proj":
+                    layer["experts"]["gate_up"][e, :, :inter] = arr.T
+                elif which == "up_proj":
+                    layer["experts"]["gate_up"][e, :, inter:] = arr.T
+                else:
+                    layer["experts"]["down"][e] = arr.T
+                loaded += 1
+                continue
+            unmapped.append(name)
+            continue
+        stripped = _PREFIX_RE.sub("", name)
+        if stripped == "embed_tokens.weight":
+            params["embed"]["w"][...] = arr  # embeddings stay [vocab, hidden]
+            loaded += 1
+        elif stripped == "norm.weight":
+            params["final_norm"]["w"][...] = arr
+            loaded += 1
+        elif name in ("lm_head.weight", "thinker.lm_head.weight",
+                      "talker.lm_head.weight"):
+            if cfg.tie_word_embeddings:
+                unmapped.append(name)
+            else:
+                params["lm_head"]["w"][...] = arr.T
+                loaded += 1
+        else:
+            unmapped.append(name)
+    if unmapped:
+        logger.warning("unmapped checkpoint tensors (%d): %s%s",
+                       len(unmapped), unmapped[:8],
+                       "..." if len(unmapped) > 8 else "")
+    logger.info("loaded %d tensors from %s", loaded, model_dir)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    eos = _eos_token_id(model_dir)
+    return params, cfg, eos
+
+
+def _eos_token_id(model_dir: str) -> Optional[int]:
+    for fn in ("generation_config.json", "config.json"):
+        p = os.path.join(model_dir, fn)
+        if os.path.isfile(p):
+            with open(p) as f:
+                eos = json.load(f).get("eos_token_id")
+            if isinstance(eos, list):
+                return eos[0] if eos else None
+            if eos is not None:
+                return int(eos)
+    return None
+
+
+# load_qwen_lm already satisfies the stage model_factory contract directly:
+#   engine_args:
+#     model_factory: "vllm_omni_tpu.model_loader.hf_qwen:load_qwen_lm"
+#     model_factory_args: {model_dir: /path/to/checkpoint}
