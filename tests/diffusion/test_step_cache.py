@@ -1,0 +1,82 @@
+"""Step-cache (TeaCache analogue) tests: skipping saves DiT evals inside
+the compiled loop while staying close to the uncached output (reference
+quality contract: docs/user_guide/diffusion_acceleration.md:15)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+from vllm_omni_tpu.diffusion.cache import StepCacheConfig, cached_eval, init_carry
+from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+from vllm_omni_tpu.diffusion.request import (
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+
+
+def test_cached_eval_skips_when_input_static():
+    cfg = StepCacheConfig(rel_l1_threshold=0.5, warmup_steps=1, tail_steps=1)
+    lat = jnp.ones((1, 4, 4))
+    calls = []
+
+    def eval_fn(x):
+        calls.append(1)
+        return x * 2.0
+
+    carry = init_carry(lat)
+    n = jnp.asarray(10)
+    # step 0: must compute (accum starts at inf)
+    v, carry, skip = cached_eval(cfg, eval_fn, lat, carry, jnp.asarray(0), n)
+    assert not bool(skip)
+    # step 1 with identical input: rel-L1 = 0 < threshold -> skip
+    v2, carry, skip = cached_eval(cfg, eval_fn, lat, carry, jnp.asarray(1), n)
+    assert bool(skip)
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(v))
+    # large input change forces recompute
+    lat2 = lat * 100.0
+    v3, carry, skip = cached_eval(cfg, eval_fn, lat2, carry, jnp.asarray(2), n)
+    assert not bool(skip)
+    np.testing.assert_array_equal(np.asarray(v3), np.asarray(lat2 * 2.0))
+
+
+def test_tail_step_never_skips():
+    cfg = StepCacheConfig(rel_l1_threshold=1e9, warmup_steps=0, tail_steps=1)
+    lat = jnp.ones((1, 4))
+    carry = init_carry(lat)
+    n = jnp.asarray(3)
+    _, carry, _ = cached_eval(cfg, lambda x: x, lat, carry, jnp.asarray(0), n)
+    _, carry, skip1 = cached_eval(cfg, lambda x: x, lat, carry,
+                                  jnp.asarray(1), n)
+    assert bool(skip1)  # mid window skips under the huge threshold
+    _, _, skip2 = cached_eval(cfg, lambda x: x, lat, carry, jnp.asarray(2), n)
+    assert not bool(skip2)  # final step always computes
+
+
+@pytest.mark.parametrize("threshold", [0.3])
+def test_pipeline_with_teacache_skips_and_stays_close(threshold):
+    def make_engine(cache_backend=""):
+        cfg = OmniDiffusionConfig(
+            model_arch="QwenImagePipeline", dtype="float32",
+            cache_backend=cache_backend,
+            cache_config={"rel_l1_threshold": threshold},
+            extra={"size": "tiny"},
+        )
+        return DiffusionEngine(cfg, warmup=False)
+
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=8, guidance_scale=1.0,
+        seed=0,
+    )
+    base = make_engine("")
+    ref_out = base.step(OmniDiffusionRequest(prompt=["x"], sampling_params=sp,
+                                             request_ids=["r"]))[0]
+    cached = make_engine("teacache")
+    got_out = cached.step(OmniDiffusionRequest(prompt=["x"],
+                                               sampling_params=sp,
+                                               request_ids=["r"]))[0]
+    assert cached.pipeline.last_skipped_steps > 0
+    # quality contract: outputs stay close (uint8 images)
+    diff = np.abs(ref_out.data.astype(np.int32) -
+                  got_out.data.astype(np.int32))
+    assert diff.mean() < 40.0

@@ -118,6 +118,51 @@ def test_dense_engine_generation_matches_hf_greedy(hf_dense_ckpt):
     assert outs[0].outputs[0].token_ids == hf_out
 
 
+def test_qwen2_bias_logits_parity(tmp_path):
+    """Qwen2-style checkpoints carry q/k/v projection biases — they must
+    load (not fall into unmapped) and match HF logits."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=96, rope_theta=1e6, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    model = Qwen2ForCausalLM(cfg).eval()
+    # make biases visibly nonzero
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_proj.bias.normal_(0, 0.5)
+            layer.self_attn.k_proj.bias.normal_(0, 0.5)
+            layer.self_attn.v_proj.bias.normal_(0, 0.5)
+    d = str(tmp_path / "q2")
+    model.save_pretrained(d, safe_serialization=True)
+    params, tcfg, _ = load_qwen_lm(d, dtype=jnp.float32)
+    assert tcfg.attention_bias and not tcfg.qk_norm
+    assert "b" in params["layers"][0]["q_proj"]
+    ids = [1, 17, 42, 99]
+    np.testing.assert_allclose(
+        _our_logits(params, tcfg, ids), _hf_logits(model, ids),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_multi_eos_list_stops_generation():
+    from vllm_omni_tpu.request import Request, RequestStatus
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    req = Request(request_id="r", prompt_token_ids=[1, 2],
+                  sampling_params=SamplingParams(max_tokens=10),
+                  eos_token_id=[7, 9])
+    req.append_output_token(3)
+    assert not req.check_stop()
+    req.append_output_token(9)  # secondary eos
+    assert req.check_stop()
+    assert req.status == RequestStatus.FINISHED_STOPPED
+
+
 def test_stage_pipeline_from_checkpoint(hf_dense_ckpt):
     """A stage config can point model_factory at the HF loader with
     model_factory_args — the real-weight serving path."""

@@ -22,7 +22,7 @@ class OmniDiffusionConfig:
     attention_backend: str = "auto"
 
     # step-cache acceleration (reference: cache/base.py:31 + selector):
-    # "" => off; "teacache" | "residual" ...
+    # "" => off; "teacache" (lax.cond-gated rel-L1 step skip)
     cache_backend: str = ""
     cache_config: dict[str, Any] = field(default_factory=dict)
 

@@ -1,0 +1,94 @@
+"""Step-cache acceleration for DiT denoise loops (TeaCache analogue).
+
+Reference: vllm_omni/diffusion/cache/ — ``CacheBackend`` ABC (base.py:31),
+selector (selector.py:9), and the TeaCache hook skipping transformer
+evaluations when the timestep-modulated input changed little
+(teacache/hook.py:30, rel-L1 accumulation vs threshold).  The reference
+reports 1.5-2.0x speedup at preserved quality
+(docs/user_guide/diffusion_acceleration.md:15).
+
+TPU-first mechanics: the reference installs Python forward-hooks that
+branch per step — impossible under jit.  Here the skip decision is a
+``lax.cond`` *inside* the compiled denoise loop: both branches are traced
+once, the TPU executes only the taken branch at runtime, so skipped steps
+genuinely save the DiT forward while the whole loop stays one XLA
+computation.  State (last velocity, last input, accumulated rel-L1) rides
+the ``fori_loop`` carry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class StepCacheConfig:
+    backend: str = "teacache"     # "" disables
+    rel_l1_threshold: float = 0.15
+    # never skip the first/last steps (quality anchors, mirroring the
+    # reference's warmup + final-step guards)
+    warmup_steps: int = 1
+    tail_steps: int = 1
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.backend)
+
+    @staticmethod
+    def from_dict(backend: str, d: dict) -> "StepCacheConfig":
+        known = {k: v for k, v in (d or {}).items()
+                 if k in StepCacheConfig.__dataclass_fields__ and k != "backend"}
+        return StepCacheConfig(backend=backend, **known)
+
+
+def init_carry(latents: jax.Array):
+    """(prev_velocity, prev_input, accumulated rel-L1) — accum starts at
+    +inf so step 0 always computes."""
+    return (
+        jnp.zeros_like(latents),
+        latents,
+        jnp.asarray(jnp.inf, jnp.float32),
+    )
+
+
+def cached_eval(
+    cache_cfg: StepCacheConfig,
+    eval_fn: Callable[[jax.Array], jax.Array],
+    latents: jax.Array,
+    carry,
+    i: jax.Array,
+    num_steps: jax.Array,
+):
+    """Evaluate (or reuse) the velocity for this step.
+
+    Returns (velocity, new_carry, skipped_flag).  ``eval_fn(latents)`` must
+    be shape-preserving from latents to velocity.
+    """
+    prev_v, prev_lat, accum = carry
+    diff = jnp.mean(jnp.abs(
+        latents.astype(jnp.float32) - prev_lat.astype(jnp.float32)))
+    base = jnp.mean(jnp.abs(prev_lat.astype(jnp.float32)))
+    rel = diff / jnp.maximum(base, 1e-8)
+    accum_new = accum + rel
+
+    in_window = (i >= cache_cfg.warmup_steps) & (
+        i < num_steps - cache_cfg.tail_steps
+    )
+    skip = in_window & (accum_new < cache_cfg.rel_l1_threshold)
+
+    def do_skip(_):
+        # reuse the previous velocity; keep accumulating drift
+        return prev_v, prev_lat, accum_new
+
+    def do_compute(_):
+        # match the carry dtype (CFG guidance math may promote to f32)
+        v = eval_fn(latents).astype(prev_v.dtype)
+        # reset the accumulator relative to this freshly-computed input
+        return v, latents, jnp.asarray(0.0, jnp.float32)
+
+    v, new_prev_lat, new_accum = jax.lax.cond(skip, do_skip, do_compute, None)
+    return v, (v, new_prev_lat, new_accum), skip

@@ -59,9 +59,36 @@ class DiffusionEngine:
         size = od_config.extra.get("size", "")
         pipe_cfg = self._pipeline_config(pipeline_cls, size)
         logger.info("Building %s (size=%s dtype=%s)", arch, size or "default", dtype)
+        cache_config = None
+        if od_config.cache_backend:
+            if od_config.cache_backend != "teacache":
+                raise ValueError(
+                    f"unsupported cache_backend {od_config.cache_backend!r} "
+                    "(TPU path supports 'teacache')"
+                )
+            from vllm_omni_tpu.diffusion.cache import StepCacheConfig
+
+            cache_config = StepCacheConfig.from_dict(
+                od_config.cache_backend, od_config.cache_config
+            )
         self.pipeline = pipeline_cls(
-            pipe_cfg, dtype=dtype, seed=od_config.seed
+            pipe_cfg, dtype=dtype, seed=od_config.seed,
+            cache_config=cache_config,
         )
+        if od_config.quantization == "int8":
+            from vllm_omni_tpu.diffusion.quantization import quantize_params
+
+            self.pipeline.dit_params = quantize_params(
+                self.pipeline.dit_params
+            )
+        elif od_config.quantization:
+            raise ValueError(
+                f"unsupported quantization {od_config.quantization!r} "
+                "(TPU path supports 'int8' weight-only)"
+            )
+        from vllm_omni_tpu.diffusion.lora import LoRAManager
+
+        self.lora_manager = LoRAManager()
         if warmup:
             self._warmup()
 
@@ -104,9 +131,36 @@ class DiffusionEngine:
         self.pipeline.forward(req)
         logger.info("Warmup done in %.1fs", time.perf_counter() - t0)
 
+    def load_lora(self, path: str, name: Optional[str] = None) -> str:
+        """Register a LoRA adapter (reference: DiffusionLoRAManager load,
+        lora/manager.py:33)."""
+        if self.od_config.quantization:
+            raise ValueError(
+                "LoRA fusion targets float weights; it cannot combine with "
+                f"quantization={self.od_config.quantization!r}"
+            )
+        return self.lora_manager.load(path, name)
+
     def step(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
         t0 = time.perf_counter()
-        outs = self.pipeline.forward(req)
+        # per-request LoRA activation via sampling extras (reference:
+        # lora_manager.set_active_adapter, diffusion_worker.py:178-184)
+        lora = req.sampling_params.extra.get("lora")
+        base = self.pipeline.dit_params
+        if lora and self.od_config.quantization:
+            raise ValueError(
+                "per-request LoRA cannot combine with quantized weights"
+            )
+        if lora:
+            name, scale = ((lora, 1.0) if isinstance(lora, str)
+                           else (lora["name"], lora.get("scale", 1.0)))
+            self.pipeline.dit_params = self.lora_manager.activate(
+                base, name, scale
+            )
+        try:
+            outs = self.pipeline.forward(req)
+        finally:
+            self.pipeline.dit_params = base
         dt = time.perf_counter() - t0
         for o in outs:
             o.metrics["gen_s"] = dt

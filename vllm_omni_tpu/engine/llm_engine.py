@@ -94,6 +94,7 @@ class LLMEngine:
         # cross-stage KV extraction completes (OmniKVTransferManager put)
         self.kv_transfer_sink: Optional[Callable] = None
         self._req_counter = 0
+        self._starved_ticks = 0
 
     # ------------------------------------------------------------- intake
     def add_request(
@@ -133,6 +134,13 @@ class LLMEngine:
         sched_out = self.scheduler.schedule()
         if sched_out.num_scheduled == 0:
             if self.scheduler.waiting:
+                # Transient zero-scheduled ticks happen while pages are
+                # pinned by an in-flight KV-transfer awaiting its ACK —
+                # only declare starvation after a few consecutive ticks.
+                self._starved_ticks += 1
+                if self._starved_ticks < 3:
+                    return errored
+                self._starved_ticks = 0
                 # Starved: the head waiting request can never fit (e.g. its
                 # recompute footprint outgrew the pool). Error-finish it so
                 # one bad request can't wedge the whole engine.
@@ -151,6 +159,7 @@ class LLMEngine:
                     "schedulable"
                 )
             return errored
+        self._starved_ticks = 0
         run_out = self.runner.execute(
             sched_out, extract_kv=self.kv_transfer_sink is not None
         )

@@ -48,17 +48,22 @@ def config_from_hf(model_dir: str,
             hf = hf[part]
     num_heads = hf["num_attention_heads"]
     moe = "num_experts" in hf or "num_routed_experts" in hf
+    model_type = hf.get("model_type", "").lower()
+    # Qwen2 family uses q/k/v biases implicitly (no config field); Qwen3
+    # exposes attention_bias explicitly (default False)
+    attention_bias = hf.get("attention_bias", model_type.startswith("qwen2"))
     return tfm.TransformerConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
         num_layers=hf["num_hidden_layers"],
         num_heads=num_heads,
         num_kv_heads=hf.get("num_key_value_heads", num_heads),
-        head_dim=hf.get("head_dim", hf["hidden_size"] // num_heads),
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // num_heads,
         intermediate_size=hf["intermediate_size"],
         rope_theta=hf.get("rope_theta", 1e6),
         rms_eps=hf.get("rms_norm_eps", 1e-6),
-        qk_norm="qwen3" in hf.get("model_type", "").lower(),
+        qk_norm="qwen3" in model_type,
+        attention_bias=attention_bias,
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
         moe=moe,
         num_experts=hf.get("num_experts", hf.get("num_routed_experts", 8)),
@@ -80,7 +85,7 @@ def _alloc_tree(cfg: tfm.TransformerConfig, dtype) -> dict:
 
 _LAYER_RE = re.compile(
     r"^(?:model|language_model|thinker\.model|talker\.model)\."
-    r"layers\.(\d+)\.(.+?)\.weight$"
+    r"layers\.(\d+)\.(.+?)\.(weight|bias)$"
 )
 _PREFIX_RE = re.compile(
     r"^(?:model|language_model|thinker\.model|talker\.model)\."
@@ -126,11 +131,19 @@ def load_qwen_lm(
     for name, arr in iter_safetensors(model_dir):
         m = _LAYER_RE.match(name)
         if m:
-            li, sub = int(m.group(1)), m.group(2)
+            li, sub, kind = int(m.group(1)), m.group(2), m.group(3)
             if li >= cfg.num_layers:
                 unmapped.append(name)
                 continue
             layer = params["layers"][li]
+            if kind == "bias":
+                key = _DIRECT.get(sub, (None,))[0]
+                if key is not None and key in layer and "b" in layer[key]:
+                    layer[key]["b"][...] = arr
+                    loaded += 1
+                else:
+                    unmapped.append(name)
+                continue
             if sub in _DIRECT:
                 key, leaf, transpose = _DIRECT[sub]
                 if key not in layer:
@@ -190,14 +203,16 @@ def load_qwen_lm(
     return params, cfg, eos
 
 
-def _eos_token_id(model_dir: str) -> Optional[int]:
+def _eos_token_id(model_dir: str):
+    """Primary eos id or the full list (multi-eos checkpoints like Qwen2.5
+    stop on any of them — Request.check_stop accepts both forms)."""
     for fn in ("generation_config.json", "config.json"):
         p = os.path.join(model_dir, fn)
         if os.path.isfile(p):
             with open(p) as f:
                 eos = json.load(f).get("eos_token_id")
             if isinstance(eos, list):
-                return eos[0] if eos else None
+                return [int(e) for e in eos] if eos else None
             if eos is not None:
                 return int(eos)
     return None

@@ -27,8 +27,10 @@ logger = init_logger(__name__)
 
 
 def _shard_files(model_dir: str) -> list[str]:
-    """Resolve the safetensors shard list: single file, HF index json, or
-    every *.safetensors in the directory."""
+    """Resolve the safetensors shard list: direct file path, single file,
+    HF index json, or every *.safetensors in the directory."""
+    if os.path.isfile(model_dir):
+        return [model_dir]
     single = os.path.join(model_dir, "model.safetensors")
     if os.path.isfile(single):
         return [single]

@@ -32,7 +32,15 @@ def linear_init(key, in_dim: int, out_dim: int, bias: bool = True, dtype=jnp.flo
 
 
 def linear(p, x):
-    y = x @ p["w"]
+    if "w_q" in p:
+        # int8 weight-only quantization: weights live in HBM as int8 +
+        # per-out-channel scales; the dequant multiply fuses into the matmul
+        # (XLA), halving weight bandwidth (reference FP8 path:
+        # diffusion/quantization/fp8.py — TPU gets int8 first)
+        w = p["w_q"].astype(x.dtype) * p["w_scale"].astype(x.dtype)
+        y = x @ w
+    else:
+        y = x @ p["w"]
     if "b" in p:
         y = y + p["b"]
     return y

@@ -41,6 +41,7 @@ class TransformerConfig:
     rope_theta: float = 1e6
     rms_eps: float = 1e-6
     qk_norm: bool = False  # per-head q/k RMSNorm (Qwen3 style)
+    attention_bias: bool = False  # q/k/v projection biases (Qwen2 style)
     tie_word_embeddings: bool = False
     # Mixture-of-Experts (Qwen3-MoE style: softmax-topk router, normalized
     # gate weights; reference backbone models/qwen3_omni/qwen3_moe.py).
@@ -95,11 +96,12 @@ def init_params(key, cfg: TransformerConfig, dtype=jnp.float32):
     kv_dim = cfg.num_kv_heads * cfg.head_dim
     for i in range(cfg.num_layers):
         k = jax.random.split(keys[i + 3], 8)
+        qkv_bias = cfg.attention_bias
         layer = {
             "input_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
-            "q_proj": nn.linear_init(k[0], cfg.hidden_size, q_dim, bias=False, dtype=dtype),
-            "k_proj": nn.linear_init(k[1], cfg.hidden_size, kv_dim, bias=False, dtype=dtype),
-            "v_proj": nn.linear_init(k[2], cfg.hidden_size, kv_dim, bias=False, dtype=dtype),
+            "q_proj": nn.linear_init(k[0], cfg.hidden_size, q_dim, bias=qkv_bias, dtype=dtype),
+            "k_proj": nn.linear_init(k[1], cfg.hidden_size, kv_dim, bias=qkv_bias, dtype=dtype),
+            "v_proj": nn.linear_init(k[2], cfg.hidden_size, kv_dim, bias=qkv_bias, dtype=dtype),
             "o_proj": nn.linear_init(k[3], q_dim, cfg.hidden_size, bias=False, dtype=dtype),
             "post_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
         }

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vllm_omni_tpu.diffusion import cache as step_cache
 from vllm_omni_tpu.diffusion import scheduler as fm
 from vllm_omni_tpu.diffusion.request import (
     DiffusionOutput,
@@ -98,10 +99,12 @@ class QwenImagePipeline:
         dtype=jnp.bfloat16,
         seed: int = 0,
         mesh=None,
+        cache_config=None,  # StepCacheConfig | None (step-skip acceleration)
     ):
         self.cfg = config
         self.dtype = dtype
         self.mesh = mesh
+        self.cache_config = cache_config
         if config.text.hidden_size != config.dit.joint_dim:
             raise ValueError(
                 "text hidden_size must equal dit joint_dim "
@@ -159,7 +162,7 @@ class QwenImagePipeline:
                 else txt_mask
             )
 
-            def body(i, lat):
+            def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
                 lat_in = jnp.concatenate([lat, lat], 0) if do_cfg else lat
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
@@ -170,9 +173,33 @@ class QwenImagePipeline:
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)
                     v = v_neg + gscale * (v_pos - v_neg)
-                return fm.step(schedule, lat, v, i)
+                return v
 
-            return jax.lax.fori_loop(0, num_steps, body, latents)
+            cache_cfg = self.cache_config
+            if cache_cfg is not None and cache_cfg.enabled:
+                # step-skip acceleration: lax.cond-gated DiT eval with the
+                # cache state riding the loop carry (diffusion/cache.py)
+                def body(i, carry):
+                    lat, cache_carry, skipped = carry
+                    v, cache_carry, skip = step_cache.cached_eval(
+                        cache_cfg, lambda l: eval_velocity(l, i), lat,
+                        cache_carry, i, num_steps,
+                    )
+                    lat = fm.step(schedule, lat, v, i)
+                    return lat, cache_carry, skipped + skip.astype(jnp.int32)
+
+                lat, _, skipped = jax.lax.fori_loop(
+                    0, num_steps, body,
+                    (latents, step_cache.init_carry(latents),
+                     jnp.asarray(0, jnp.int32)),
+                )
+                return lat, skipped
+
+            def body(i, lat):
+                return fm.step(schedule, lat, eval_velocity(lat, i), i)
+
+            lat = jax.lax.fori_loop(0, num_steps, body, latents)
+            return lat, jnp.asarray(0, jnp.int32)
 
         self._denoise_cache[key] = run
         return run
@@ -252,7 +279,7 @@ class QwenImagePipeline:
             schedule.timesteps
         )
         run = self._denoise_fn(grid_h, grid_w, sched_len)
-        latents = run(
+        latents, skipped_steps = run(
             self.dit_params,
             noise,
             txt,
@@ -264,6 +291,7 @@ class QwenImagePipeline:
             jnp.float32(sp.guidance_scale),
             jnp.int32(num_steps),
         )
+        self.last_skipped_steps = int(skipped_steps)
 
         images = self._decode_latents(latents, grid_h, grid_w)
         images = np.asarray(images)

@@ -61,7 +61,8 @@ class Request:
     request_id: str
     prompt_token_ids: list[int]
     sampling_params: SamplingParams = field(default_factory=SamplingParams)
-    eos_token_id: Optional[int] = None
+    # single id or a list (multi-eos checkpoints stop on any)
+    eos_token_id: Optional[int | list[int]] = None
     arrival_time: float = 0.0
     # omni extensions (reference: request.py:14)
     prompt_embeds: Optional[np.ndarray] = None      # [S, hidden]
@@ -115,7 +116,10 @@ class Request:
             return False
         last = self.output_token_ids[-1]
         if n_out >= sp.min_tokens:
-            if not sp.ignore_eos and self.eos_token_id is not None and last == self.eos_token_id:
+            eos = self.eos_token_id
+            eos_hit = (last in eos if isinstance(eos, (list, tuple))
+                       else last == eos) if eos is not None else False
+            if not sp.ignore_eos and eos_hit:
                 self.status = RequestStatus.FINISHED_STOPPED
                 return True
             if last in sp.stop_token_ids:
