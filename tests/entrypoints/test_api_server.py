@@ -169,6 +169,42 @@ def test_images_generations(diffusion_server_url):
 
 
 @pytest.fixture(scope="module")
+def video_server_url():
+    cfg = StageConfig(
+        stage_id=0,
+        stage_type="diffusion",
+        engine_args={"model_arch": "WanT2VPipeline", "size": "tiny",
+                     "dtype": "float32"},
+        engine_input_source=[-1],
+        final_output=True,
+        final_output_type="video",
+        default_sampling_params={
+            "height": 16, "width": 16, "num_inference_steps": 2,
+            "guidance_scale": 1.0, "num_frames": 2, "seed": 0,
+        },
+    )
+    server, state = build_server(model="tiny-wan", stage_configs=[cfg],
+                                 host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    state.shutdown()
+
+
+def test_videos_endpoint(video_server_url):
+    r = httpx.post(f"{video_server_url}/v1/videos", json={
+        "prompt": "a river", "size": "16x16", "num_frames": 2,
+        "num_inference_steps": 2,
+    }, timeout=300)
+    assert r.status_code == 200
+    item = r.json()["data"][0]
+    assert item["shape"] == [2, 16, 16, 3]
+    raw = base64.b64decode(item["b64_rgb"])
+    assert len(raw) == 2 * 16 * 16 * 3
+
+
+@pytest.fixture(scope="module")
 def qwen3_server_url():
     import os
 

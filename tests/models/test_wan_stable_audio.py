@@ -1,0 +1,100 @@
+"""Wan video + StableAudio pipeline tests at tiny scale (the analogue of
+the reference's t2v/stable-audio e2e tests, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+from vllm_omni_tpu.diffusion.request import (
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+from vllm_omni_tpu.models.wan import transformer as wdit
+
+
+def test_wan_dit_shapes_and_finite(rng):
+    cfg = wdit.WanDiTConfig.tiny()
+    params = wdit.init_params(rng, cfg)
+    lat = jax.random.normal(rng, (1, 3, 8, 8, cfg.in_channels))
+    ctx = jax.random.normal(rng, (1, 8, cfg.ctx_dim))
+    out = wdit.forward(params, cfg, lat, ctx, jnp.array([500.0]))
+    assert out.shape == lat.shape
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_wan_patchify_roundtrip(rng):
+    x = jax.random.normal(rng, (2, 3, 8, 8, 4))
+    tokens = wdit.patchify(x, 2)
+    assert tokens.shape == (2, 3 * 4 * 4, 16)
+    back = wdit.unpatchify(tokens, 2, 3, 4, 4, 4)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-6)
+
+
+def test_wan_timestep_sensitivity(rng):
+    cfg = wdit.WanDiTConfig.tiny()
+    params = wdit.init_params(rng, cfg)
+    lat = jax.random.normal(rng, (1, 2, 4, 4, cfg.in_channels))
+    ctx = jax.random.normal(rng, (1, 4, cfg.ctx_dim))
+    o1 = wdit.forward(params, cfg, lat, ctx, jnp.array([10.0]))
+    o2 = wdit.forward(params, cfg, lat, ctx, jnp.array([900.0]))
+    assert float(jnp.max(jnp.abs(o1 - o2))) > 1e-4
+
+
+def test_wan_t2v_e2e():
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model_arch="WanT2VPipeline", dtype="float32",
+        extra={"size": "tiny"}), warmup=False)
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=2.0,
+        num_frames=3, seed=0)
+    outs = eng.step(OmniDiffusionRequest(prompt=["a river"],
+                                         sampling_params=sp,
+                                         request_ids=["v"]))
+    assert len(outs) == 1
+    o = outs[0]
+    assert o.output_type == "video"
+    assert o.data.shape == (3, 16, 16, 3) and o.data.dtype == np.uint8
+
+
+def test_wan_text_conditioning_changes_video():
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model_arch="WanT2VPipeline", dtype="float32",
+        extra={"size": "tiny"}), warmup=False)
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=2.0,
+        num_frames=2, seed=5)
+    a = eng.step(OmniDiffusionRequest(prompt=["a dog"], sampling_params=sp,
+                                      request_ids=["a"]))[0]
+    b = eng.step(OmniDiffusionRequest(prompt=["ocean waves at night"],
+                                      sampling_params=sp,
+                                      request_ids=["b"]))[0]
+    assert np.abs(a.data.astype(int) - b.data.astype(int)).max() > 0
+
+
+def test_stable_audio_e2e():
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model_arch="StableAudioPipeline", dtype="float32",
+        extra={"size": "tiny"}), warmup=False)
+    sp = OmniDiffusionSamplingParams(
+        num_inference_steps=2, guidance_scale=1.0, seed=0,
+        extra={"seconds_total": 0.01})
+    outs = eng.step(OmniDiffusionRequest(prompt=["rain"],
+                                         sampling_params=sp,
+                                         request_ids=["s"]))
+    o = outs[0]
+    assert o.output_type == "audio"
+    # tiny: >=8 latent frames x 4 samples each
+    assert o.data.ndim == 1 and o.data.size >= 32
+    assert np.all(np.abs(o.data) <= 1.0)
+    assert o.metrics["sample_rate"] == 16000.0
+
+
+def test_registry_knows_new_families():
+    from vllm_omni_tpu.models.registry import DiffusionModelRegistry
+
+    known = DiffusionModelRegistry.supported()
+    assert {"QwenImagePipeline", "WanPipeline", "WanT2VPipeline",
+            "StableAudioPipeline"} <= set(known)

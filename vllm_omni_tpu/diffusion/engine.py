@@ -115,20 +115,26 @@ class DiffusionEngine:
         The step count is a dynamic loop bound (pipeline steps_bucket), so
         the 1-step warmup compiles the same executable real requests use."""
         t0 = time.perf_counter()
-        mult = (
-            self.pipeline.cfg.vae.spatial_ratio
-            * self.pipeline.cfg.dit.patch_size
-        )
-        height = max(mult, self.od_config.default_height // mult * mult)
-        width = max(mult, self.od_config.default_width // mult * mult)
-        req = OmniDiffusionRequest(
-            prompt=["warmup"],
-            sampling_params=OmniDiffusionSamplingParams(
+        modality = getattr(self.pipeline, "output_type", "image")
+        if modality == "audio":
+            sp = OmniDiffusionSamplingParams(
+                num_inference_steps=1, guidance_scale=1.0, seed=0,
+                extra={"seconds_total": 0.25},
+            )
+        else:
+            mult = (
+                self.pipeline.cfg.vae.spatial_ratio
+                * self.pipeline.cfg.dit.patch_size
+            )
+            height = max(mult, self.od_config.default_height // mult * mult)
+            width = max(mult, self.od_config.default_width // mult * mult)
+            sp = OmniDiffusionSamplingParams(
                 height=height, width=width, num_inference_steps=1,
                 guidance_scale=4.0, seed=0,
-            ),
-        )
-        self.pipeline.forward(req)
+                num_frames=2 if modality == "video" else 1,
+            )
+        self.pipeline.forward(OmniDiffusionRequest(
+            prompt=["warmup"], sampling_params=sp))
         logger.info("Warmup done in %.1fs", time.perf_counter() - t0)
 
     def load_lora(self, path: str, name: Optional[str] = None) -> str:

@@ -219,6 +219,8 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 self._images_generations(body)
             elif self.path == "/v1/audio/speech":
                 self._audio_speech(body)
+            elif self.path == "/v1/videos":
+                self._videos(body)
             else:
                 self._error(404, f"unknown path {self.path}")
         except BrokenPipeError:
@@ -389,6 +391,45 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                         for img in o.images
                     )
         self._json(200, {"created": int(time.time()), "data": data})
+
+    # ------------------------------------------------------------ videos
+    def _videos(self, body: dict):
+        """Video generation (reference: /v1/videos, api_server.py:1528).
+        Returns frames as base64 raw RGB plus geometry metadata."""
+        prompt = body.get("prompt")
+        if not prompt:
+            return self._error(400, "prompt required")
+        sp: dict[str, Any] = {}
+        if body.get("size"):
+            try:
+                w, h = body["size"].lower().split("x")
+                sp["width"], sp["height"] = int(w), int(h)
+            except ValueError:
+                return self._error(400, f"bad size {body['size']!r}")
+        for k in ("num_inference_steps", "guidance_scale", "seed",
+                  "num_frames", "fps"):
+            if body.get(k) is not None:
+                sp[k] = body[k]
+        rid = f"video-{uuid.uuid4().hex[:16]}"
+        outs = self.state.collect(prompt, sp, rid)
+        video = next(
+            (o.multimodal_output.get("video",
+                                     o.images[0] if o.images else None)
+             for o in outs if o.final_output_type == "video"),
+            None,
+        )
+        if video is None:
+            return self._error(500, "pipeline produced no video",
+                               "internal_error")
+        arr = np.asarray(video)
+        self._json(200, {
+            "created": int(time.time()),
+            "data": [{
+                "b64_rgb": base64.b64encode(arr.tobytes()).decode(),
+                "shape": list(arr.shape),  # [F, H, W, 3]
+                "dtype": str(arr.dtype),
+            }],
+        })
 
     # ------------------------------------------------------- audio/speech
     def _audio_speech(self, body: dict):

@@ -1,0 +1,116 @@
+"""Shared cross-attention DiT block (Wan / StableAudio style).
+
+Where the QwenImage/SD3/Flux family uses *joint* text+image attention
+(models/qwen_image/transformer.py block_forward), the video and audio DiT
+families condition via *cross*-attention: self-attention over media tokens
+(RoPE'd), cross-attention into encoder states, gated MLP — all modulated by
+adaLN from the timestep embedding (reference architectures:
+vllm_omni/diffusion/models/wan2_2/, models/stable_audio/).
+
+One functional block implementation serves both families; the caller
+supplies RoPE frequencies for its token geometry (3D for video, 1D for
+audio).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+
+def init_cross_block(key, inner: int, ctx_dim: int, mlp_dim: int,
+                     head_dim: int, dtype=jnp.float32):
+    k = jax.random.split(key, 10)
+    return {
+        # adaLN: shift/scale/gate for self-attn + shift/scale/gate for mlp
+        "mod": nn.linear_init(k[0], inner, 6 * inner, dtype=dtype),
+        "to_q": nn.linear_init(k[1], inner, inner, dtype=dtype),
+        "to_k": nn.linear_init(k[2], inner, inner, dtype=dtype),
+        "to_v": nn.linear_init(k[3], inner, inner, dtype=dtype),
+        "to_out": nn.linear_init(k[4], inner, inner, dtype=dtype),
+        "norm_q": nn.rmsnorm_init(head_dim, dtype),
+        "norm_k": nn.rmsnorm_init(head_dim, dtype),
+        "cross_norm": nn.rmsnorm_init(inner, dtype),
+        "cross_q": nn.linear_init(k[5], inner, inner, dtype=dtype),
+        "cross_k": nn.linear_init(k[6], ctx_dim, inner, dtype=dtype),
+        "cross_v": nn.linear_init(k[7], ctx_dim, inner, dtype=dtype),
+        "cross_out": nn.linear_init(k[8], inner, inner, dtype=dtype),
+        "mlp1": nn.linear_init(k[9], inner, mlp_dim, dtype=dtype),
+        "mlp2": nn.linear_init(jax.random.fold_in(k[9], 1), mlp_dim, inner,
+                               dtype=dtype),
+    }
+
+
+def _heads(x, h):
+    b, s, d = x.shape
+    return x.reshape(b, s, h, d // h)
+
+
+def _merge(x):
+    b, s, h, d = x.shape
+    return x.reshape(b, s, h * d)
+
+
+def _norm_nomod(x):
+    return nn.layernorm({}, x)
+
+
+def _rope_apply(x, cos, sin):
+    # x: [B, S, H, D]; cos/sin: [S, D//2]
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+def cross_block_forward(
+    blk,
+    x: jax.Array,          # [B, S, inner] media tokens
+    ctx: jax.Array,        # [B, S_ctx, ctx_dim] encoder states
+    temb: jax.Array,       # [B, inner] timestep embedding
+    rope: tuple,           # (cos, sin) each [S, head_dim//2]
+    num_heads: int,
+    ctx_mask=None,         # [B, S_ctx] 1/0
+):
+    mod = nn.linear(blk["mod"], jax.nn.silu(temb))[:, None, :]
+    sh1, sc1, g1, sh2, sc2, g2 = jnp.split(mod, 6, axis=-1)
+    cos, sin = rope
+
+    # self-attention (RoPE, qk-norm)
+    h = _norm_nomod(x) * (1 + sc1) + sh1
+    q = rms_norm(_heads(nn.linear(blk["to_q"], h), num_heads),
+                 blk["norm_q"]["w"])
+    k = rms_norm(_heads(nn.linear(blk["to_k"], h), num_heads),
+                 blk["norm_k"]["w"])
+    v = _heads(nn.linear(blk["to_v"], h), num_heads)
+    q = _rope_apply(q, cos, sin)
+    k = _rope_apply(k, cos, sin)
+    attn = flash_attention(q, k, v, causal=False)
+    x = x + g1 * nn.linear(blk["to_out"], _merge(attn))
+
+    # cross-attention into encoder states (un-modulated, Wan style)
+    h = rms_norm(x, blk["cross_norm"]["w"])
+    q = _heads(nn.linear(blk["cross_q"], h), num_heads)
+    k = _heads(nn.linear(blk["cross_k"], ctx), num_heads)
+    v = _heads(nn.linear(blk["cross_v"], ctx), num_heads)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if ctx_mask is not None:
+        s = jnp.where(ctx_mask[:, None, None, :] > 0, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(x.dtype)
+    x = x + nn.linear(blk["cross_out"], _merge(o))
+
+    # gated MLP
+    h = _norm_nomod(x) * (1 + sc2) + sh2
+    x = x + g2 * nn.linear(blk["mlp2"],
+                           jax.nn.gelu(nn.linear(blk["mlp1"], h),
+                                       approximate=True))
+    return x

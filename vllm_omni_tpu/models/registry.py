@@ -32,6 +32,17 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "QwenImagePipeline": _Entry(
         "vllm_omni_tpu.models.qwen_image.pipeline", "QwenImagePipeline"
     ),
+    # video (reference: Wan2.2 T2V family, diffusion/registry.py:16-102)
+    "WanPipeline": _Entry(
+        "vllm_omni_tpu.models.wan.pipeline", "WanT2VPipeline"
+    ),
+    "WanT2VPipeline": _Entry(
+        "vllm_omni_tpu.models.wan.pipeline", "WanT2VPipeline"
+    ),
+    # audio (reference: StableAudio family)
+    "StableAudioPipeline": _Entry(
+        "vllm_omni_tpu.models.stable_audio.pipeline", "StableAudioPipeline"
+    ),
 }
 
 # AR architectures -> model module (engine-facing)

@@ -1,0 +1,246 @@
+"""StableAudio-style text-to-audio pipeline.
+
+Reference: vllm_omni/diffusion/models/stable_audio/ — DiT over 1-D audio
+latents with cross-attention into text + seconds-timing conditioning, then
+an autoencoder decode to waveform.  The TPU build shares the
+cross-attention DiT block (models/common/dit.py) with 1-D RoPE and decodes
+latents through a transposed-conv1d stack (NWC layout, the vocoder
+pattern from models/qwen3_omni/code2wav.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.diffusion import scheduler as fm
+from vllm_omni_tpu.diffusion.request import DiffusionOutput, OmniDiffusionRequest
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import dit, nn
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    forward_hidden,
+    init_params as init_text_params,
+)
+from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class StableAudioDiTConfig:
+    latent_channels: int = 64
+    num_layers: int = 24
+    num_heads: int = 24
+    head_dim: int = 64
+    ctx_dim: int = 768
+    theta: float = 10000.0
+    mlp_ratio: float = 4.0
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @staticmethod
+    def tiny() -> "StableAudioDiTConfig":
+        return StableAudioDiTConfig(
+            latent_channels=8, num_layers=2, num_heads=4, head_dim=16,
+            ctx_dim=64,
+        )
+
+
+@dataclass(frozen=True)
+class StableAudioPipelineConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    dit: StableAudioDiTConfig = field(default_factory=StableAudioDiTConfig)
+    # decoder: latent frame -> upsample x prod(factors) samples
+    decoder_channels: int = 128
+    upsample_factors: tuple = (8, 8, 4, 2)  # 2048 samples per latent frame
+    sample_rate: int = 44100
+    max_text_len: int = 64
+
+    @staticmethod
+    def tiny() -> "StableAudioPipelineConfig":
+        return StableAudioPipelineConfig(
+            text=TransformerConfig.tiny(vocab_size=256),
+            dit=StableAudioDiTConfig.tiny(),
+            decoder_channels=16,
+            upsample_factors=(2, 2),
+            sample_rate=16000,
+        )
+
+    @property
+    def samples_per_latent(self) -> int:
+        out = 1
+        for f in self.upsample_factors:
+            out *= f
+        return out
+
+
+def init_dit_params(key, cfg: StableAudioDiTConfig, dtype=jnp.float32):
+    inner = cfg.inner_dim
+    mlp = int(inner * cfg.mlp_ratio)
+    keys = jax.random.split(key, cfg.num_layers + 5)
+    return {
+        "lat_in": nn.linear_init(keys[0], cfg.latent_channels, inner,
+                                 dtype=dtype),
+        "time_in1": nn.linear_init(keys[1], 256, inner, dtype=dtype),
+        "time_in2": nn.linear_init(keys[2], inner, inner, dtype=dtype),
+        "norm_out_mod": nn.linear_init(keys[3], inner, 2 * inner,
+                                       dtype=dtype),
+        "proj_out": nn.linear_init(keys[4], inner, cfg.latent_channels,
+                                   dtype=dtype),
+        "blocks": [
+            dit.init_cross_block(keys[i + 5], inner, cfg.ctx_dim, mlp,
+                                 cfg.head_dim, dtype)
+            for i in range(cfg.num_layers)
+        ],
+    }
+
+
+def init_decoder_params(key, cfg: StableAudioPipelineConfig,
+                        dtype=jnp.float32):
+    keys = jax.random.split(key, 2 + len(cfg.upsample_factors))
+    ch = cfg.decoder_channels
+    p = {
+        "pre": nn.conv1d_init(keys[0], cfg.dit.latent_channels, ch, 7,
+                              dtype=dtype),
+        "ups": [],
+        "post": nn.conv1d_init(
+            keys[1], max(ch // (2 ** len(cfg.upsample_factors)), 4), 1, 7,
+            dtype=dtype),
+    }
+    for i, f in enumerate(cfg.upsample_factors):
+        out_ch = max(ch // 2, 4)
+        p["ups"].append(nn.conv1d_init(keys[i + 2], ch, out_ch, 2 * f,
+                                       dtype=dtype))
+        ch = out_ch
+    return p
+
+
+def dit_forward(params, cfg: StableAudioDiTConfig, latents, ctx, timesteps,
+                ctx_mask=None):
+    """latents [B, T, C] -> velocity [B, T, C] (1-D RoPE positions)."""
+    x = nn.linear(params["lat_in"], latents)
+    temb = nn.linear(
+        params["time_in2"],
+        jax.nn.silu(nn.linear(
+            params["time_in1"],
+            nn.timestep_embedding(timesteps, 256).astype(x.dtype))),
+    )
+    t = latents.shape[1]
+    half = cfg.head_dim // 2
+    inv = 1.0 / (cfg.theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = jnp.arange(t, dtype=jnp.float32)[:, None] * inv[None, :]
+    rope = (jnp.cos(ang), jnp.sin(ang))
+    for blk in params["blocks"]:
+        x = dit.cross_block_forward(blk, x, ctx, temb, rope, cfg.num_heads,
+                                    ctx_mask)
+    mod = nn.linear(params["norm_out_mod"], jax.nn.silu(temb))[:, None, :]
+    shift, scale = jnp.split(mod, 2, axis=-1)
+    x = nn.layernorm({}, x) * (1 + scale) + shift
+    return nn.linear(params["proj_out"], x)
+
+
+def decode_audio(params, cfg: StableAudioPipelineConfig, latents):
+    """[B, T, C] latents -> [B, T*up] waveform in [-1, 1]."""
+    x = nn.conv1d(params["pre"], latents)
+    for up, f in zip(params["ups"], cfg.upsample_factors):
+        x = jax.nn.silu(x)
+        x = nn.conv1d_transpose(up, x, stride=f)
+    return jnp.tanh(nn.conv1d(params["post"], jax.nn.silu(x)))[..., 0]
+
+
+class StableAudioPipeline:
+    """Text -> audio waveform (float32 [N] in [-1, 1])."""
+
+    output_type = "audio"
+
+    def __init__(self, config: StableAudioPipelineConfig, dtype=jnp.bfloat16,
+                 seed: int = 0, mesh=None, cache_config=None):
+        self.cfg = config
+        self.dtype = dtype
+        self.cache_config = cache_config
+        if config.text.hidden_size != config.dit.ctx_dim:
+            raise ValueError("text hidden_size must equal dit ctx_dim")
+        self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        logger.info("Initializing StableAudioPipeline (dtype=%s)", dtype)
+        self.text_params = init_text_params(k1, config.text, dtype)
+        self.dit_params = init_dit_params(k2, config.dit, dtype)
+        self.decoder_params = init_decoder_params(k3, config, dtype)
+        self._denoise_cache: dict = {}
+
+    def encode_prompt(self, prompts: list[str]):
+        ids, lens = self.tokenizer.batch_encode(prompts,
+                                                self.cfg.max_text_len)
+        hidden = jax.jit(
+            lambda i: forward_hidden(self.text_params, self.cfg.text, i)
+        )(jnp.asarray(ids))
+        mask = (np.arange(self.cfg.max_text_len)[None, :]
+                < lens[:, None]).astype(np.int32)
+        return hidden, jnp.asarray(mask)
+
+    def _denoise_fn(self, lat_len, sched_len):
+        key = (lat_len, sched_len)
+        if key in self._denoise_cache:
+            return self._denoise_cache[key]
+        cfg = self.cfg
+
+        @jax.jit
+        def run(dit_params, latents, ctx, ctx_mask, sigmas, timesteps,
+                num_steps):
+            schedule = fm.FlowMatchSchedule(sigmas=sigmas,
+                                            timesteps=timesteps)
+
+            def body(i, lat):
+                t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
+                v = dit_forward(dit_params, cfg.dit, lat, ctx, t, ctx_mask)
+                return fm.step(schedule, lat, v, i)
+
+            return jax.lax.fori_loop(0, num_steps, body, latents)
+
+        self._denoise_cache[key] = run
+        return run
+
+    def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
+        sp = req.sampling_params
+        cfg = self.cfg
+        # duration in seconds via extras; default 1s
+        seconds = float(sp.extra.get("seconds_total", 1.0))
+        lat_len = max(8, int(seconds * cfg.sample_rate
+                             // cfg.samples_per_latent))
+        prompts = req.prompt
+        b = len(prompts)
+        ctx, ctx_mask = self.encode_prompt(prompts)
+        seed = (sp.seed if sp.seed is not None
+                else int(np.random.randint(0, 2 ** 31 - 1)))
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (b, lat_len, cfg.dit.latent_channels), self.dtype,
+        )
+        num_steps = sp.num_inference_steps
+        sched_len = max(8, 1 << (num_steps - 1).bit_length())
+        schedule = fm.make_schedule(num_steps, shift=1.0)
+        sigmas = jnp.zeros((sched_len + 1,)).at[: num_steps + 1].set(
+            schedule.sigmas)
+        timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
+            schedule.timesteps)
+        run = self._denoise_fn(lat_len, sched_len)
+        latents = run(self.dit_params, noise, ctx, ctx_mask, sigmas,
+                      timesteps, jnp.int32(num_steps))
+        wav = jax.jit(
+            lambda p, l: decode_audio(p, cfg, l)
+        )(self.decoder_params, latents)
+        wav = np.asarray(wav, np.float32)
+        return [
+            DiffusionOutput(
+                request_id=req.request_ids[i], prompt=prompts[i],
+                data=wav[i], output_type="audio",
+                metrics={"sample_rate": float(cfg.sample_rate)},
+            )
+            for i in range(b)
+        ]

@@ -1,0 +1,168 @@
+"""Wan-style text-to-video pipeline.
+
+Reference: vllm_omni/diffusion/models/wan2_2/ — Wan2.2 T2V
+(pipeline: text encode → flow-match denoise over video latents → VAE
+decode).  TPU-first like the image pipeline: the whole denoise loop is one
+jitted fori_loop with a dynamic step bound; frames ride a leading latent
+axis and decode through the image VAE per frame (the reference's
+temporally-compressing video VAE is a follow-up — frame-wise decode keeps
+the same output contract at tiny/bench scales).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.diffusion import scheduler as fm
+from vllm_omni_tpu.diffusion.request import DiffusionOutput, OmniDiffusionRequest
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    forward_hidden,
+    init_params as init_text_params,
+)
+from vllm_omni_tpu.models.qwen_image import vae as vae_mod
+from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+from vllm_omni_tpu.models.wan import transformer as wdit
+from vllm_omni_tpu.models.wan.transformer import WanDiTConfig
+from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class WanPipelineConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    dit: WanDiTConfig = field(default_factory=WanDiTConfig)
+    vae: VAEConfig = field(default_factory=VAEConfig)
+    max_text_len: int = 64
+    flow_shift: float = 3.0
+
+    @staticmethod
+    def tiny() -> "WanPipelineConfig":
+        return WanPipelineConfig(
+            text=TransformerConfig.tiny(vocab_size=256),
+            dit=WanDiTConfig.tiny(),
+            vae=VAEConfig.tiny(),
+        )
+
+
+class WanT2VPipeline:
+    """Text -> video ([F, H, W, 3] uint8 frames)."""
+
+    output_type = "video"
+
+    def __init__(self, config: WanPipelineConfig, dtype=jnp.bfloat16,
+                 seed: int = 0, mesh=None, cache_config=None):
+        self.cfg = config
+        self.dtype = dtype
+        self.cache_config = cache_config
+        if config.text.hidden_size != config.dit.ctx_dim:
+            raise ValueError("text hidden_size must equal dit ctx_dim")
+        self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        logger.info("Initializing WanT2VPipeline (dtype=%s)", dtype)
+        self.text_params = init_text_params(k1, config.text, dtype)
+        self.dit_params = wdit.init_params(k2, config.dit, dtype)
+        self.vae_params = vae_mod.init_decoder(k3, config.vae, dtype)
+        self._denoise_cache: dict = {}
+
+    def encode_prompt(self, prompts: list[str]):
+        ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
+        hidden = jax.jit(
+            lambda i: forward_hidden(self.text_params, self.cfg.text, i)
+        )(jnp.asarray(ids))
+        mask = (np.arange(self.cfg.max_text_len)[None, :]
+                < lens[:, None]).astype(np.int32)
+        return hidden, jnp.asarray(mask)
+
+    def _denoise_fn(self, frames, grid_h, grid_w, sched_len):
+        key = (frames, grid_h, grid_w, sched_len)
+        if key in self._denoise_cache:
+            return self._denoise_cache[key]
+        cfg = self.cfg
+
+        @jax.jit
+        def run(dit_params, latents, ctx, ctx_mask, neg_ctx, neg_mask,
+                sigmas, timesteps, gscale, num_steps):
+            schedule = fm.FlowMatchSchedule(sigmas=sigmas,
+                                            timesteps=timesteps)
+            do_cfg = neg_ctx is not None
+            ctx_all = (jnp.concatenate([ctx, neg_ctx], 0) if do_cfg else ctx)
+            mask_all = (jnp.concatenate([ctx_mask, neg_mask], 0)
+                        if do_cfg else ctx_mask)
+
+            def body(i, lat):
+                t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
+                lat_in = jnp.concatenate([lat, lat], 0) if do_cfg else lat
+                t_in = jnp.concatenate([t, t], 0) if do_cfg else t
+                v = wdit.forward(dit_params, cfg.dit, lat_in, ctx_all, t_in,
+                                 ctx_mask=mask_all)
+                if do_cfg:
+                    v_pos, v_neg = jnp.split(v, 2, axis=0)
+                    v = v_neg + gscale * (v_pos - v_neg)
+                return fm.step(schedule, lat, v, i)
+
+            return jax.lax.fori_loop(0, num_steps, body, latents)
+
+        self._denoise_cache[key] = run
+        return run
+
+    def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
+        sp = req.sampling_params
+        cfg = self.cfg
+        ratio = cfg.vae.spatial_ratio
+        mult = ratio * cfg.dit.patch_size
+        if sp.height % mult or sp.width % mult:
+            raise ValueError(f"height/width must be multiples of {mult}")
+        frames = max(1, sp.num_frames)
+        lat_h, lat_w = sp.height // ratio, sp.width // ratio
+        prompts = req.prompt
+        b = len(prompts)
+
+        ctx, ctx_mask = self.encode_prompt(prompts)
+        do_cfg = sp.guidance_scale > 1.0
+        neg_ctx = neg_mask = None
+        if do_cfg:
+            neg_ctx, neg_mask = self.encode_prompt(
+                [sp.negative_prompt] * b)
+
+        seed = (sp.seed if sp.seed is not None
+                else int(np.random.randint(0, 2 ** 31 - 1)))
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (b, frames, lat_h, lat_w, cfg.dit.in_channels), self.dtype,
+        )
+        num_steps = sp.num_inference_steps
+        sched_len = max(8, 1 << (num_steps - 1).bit_length())
+        schedule = fm.make_schedule(num_steps, shift=cfg.flow_shift)
+        sigmas = jnp.zeros((sched_len + 1,)).at[: num_steps + 1].set(
+            schedule.sigmas)
+        timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
+            schedule.timesteps)
+        run = self._denoise_fn(frames, lat_h // cfg.dit.patch_size,
+                               lat_w // cfg.dit.patch_size, sched_len)
+        latents = run(self.dit_params, noise, ctx, ctx_mask, neg_ctx,
+                      neg_mask, sigmas, timesteps,
+                      jnp.float32(sp.guidance_scale), jnp.int32(num_steps))
+
+        # frame-wise VAE decode: [B, F, h, w, C] -> [B*F, ...] -> frames
+        bf = latents.reshape(b * frames, lat_h, lat_w,
+                             cfg.dit.out_channels)
+        imgs = jax.jit(
+            lambda p, l: vae_mod.decode(p, cfg.vae, l)
+        )(self.vae_params, bf)
+        imgs = np.asarray(imgs)
+        video = ((np.clip(imgs, -1, 1) + 1) * 127.5).astype(np.uint8)
+        video = video.reshape(b, frames, sp.height, sp.width, 3)
+        return [
+            DiffusionOutput(
+                request_id=req.request_ids[i], prompt=prompts[i],
+                data=video[i], output_type="video",
+            )
+            for i in range(b)
+        ]

@@ -1,0 +1,136 @@
+"""Wan-style video DiT: 3D (frame/row/col) RoPE + cross-attention blocks.
+
+Reference: vllm_omni/diffusion/models/wan2_2/ — Wan2.2 T2V/I2V/TI2V
+transformers (cross-attention conditioning, 3D rotary positions, adaLN from
+the flow timestep).  TPU-first: video tokens flatten to one [B, F*H'*W', D]
+sequence (static shape per geometry bucket), all blocks share the
+cross-attention DiT block (models/common/dit.py), and 3D RoPE reuses the
+sectioned axes scheme.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import dit, nn
+
+
+@dataclass(frozen=True)
+class WanDiTConfig:
+    patch_size: int = 2          # spatial patch (temporal patch = 1)
+    in_channels: int = 16        # video VAE latent channels
+    out_channels: int = 16
+    num_layers: int = 30
+    num_heads: int = 12
+    head_dim: int = 128
+    ctx_dim: int = 4096          # text-encoder feature dim
+    axes_dims: tuple = (44, 42, 42)  # frame/row/col rope sections
+    theta: float = 10000.0
+    mlp_ratio: float = 4.0
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @staticmethod
+    def tiny() -> "WanDiTConfig":
+        return WanDiTConfig(
+            in_channels=4, out_channels=4, num_layers=2, num_heads=4,
+            head_dim=32, ctx_dim=64, axes_dims=(16, 8, 8),
+        )
+
+
+def init_params(key, cfg: WanDiTConfig, dtype=jnp.float32):
+    inner = cfg.inner_dim
+    mlp = int(inner * cfg.mlp_ratio)
+    keys = jax.random.split(key, cfg.num_layers + 6)
+    patch_in = cfg.in_channels * cfg.patch_size ** 2
+    p = {
+        "patch_in": nn.linear_init(keys[0], patch_in, inner, dtype=dtype),
+        "time_in1": nn.linear_init(keys[1], 256, inner, dtype=dtype),
+        "time_in2": nn.linear_init(keys[2], inner, inner, dtype=dtype),
+        "norm_out_mod": nn.linear_init(keys[3], inner, 2 * inner, dtype=dtype),
+        "proj_out": nn.linear_init(
+            keys[4], inner, cfg.patch_size ** 2 * cfg.out_channels,
+            dtype=dtype,
+        ),
+        "blocks": [
+            dit.init_cross_block(keys[i + 6], inner, cfg.ctx_dim, mlp,
+                                 cfg.head_dim, dtype)
+            for i in range(cfg.num_layers)
+        ],
+    }
+    return p
+
+
+def rope_freqs(cfg: WanDiTConfig, frames: int, grid_h: int, grid_w: int):
+    """Sectioned 3D RoPE over (frame, row, col), [S, head_dim//2] each."""
+    def axis(pos, half):
+        inv = 1.0 / (cfg.theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+        return pos[:, None] * inv[None, :]
+
+    f = jnp.arange(frames, dtype=jnp.float32)
+    r = jnp.arange(grid_h, dtype=jnp.float32)
+    c = jnp.arange(grid_w, dtype=jnp.float32)
+    af = axis(f, cfg.axes_dims[0] // 2)  # [F, df]
+    ar = axis(r, cfg.axes_dims[1] // 2)
+    ac = axis(c, cfg.axes_dims[2] // 2)
+    ang = jnp.concatenate([
+        jnp.broadcast_to(af[:, None, None, :],
+                         (frames, grid_h, grid_w, af.shape[-1])),
+        jnp.broadcast_to(ar[None, :, None, :],
+                         (frames, grid_h, grid_w, ar.shape[-1])),
+        jnp.broadcast_to(ac[None, None, :, :],
+                         (frames, grid_h, grid_w, ac.shape[-1])),
+    ], axis=-1).reshape(frames * grid_h * grid_w, -1)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def patchify(latents: jax.Array, p: int) -> jax.Array:
+    """[B, F, H, W, C] -> [B, F*(H/p)*(W/p), C*p*p]."""
+    b, f, h, w, c = latents.shape
+    x = latents.reshape(b, f, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 2, 4, 3, 5, 6)
+    return x.reshape(b, f * (h // p) * (w // p), p * p * c)
+
+
+def unpatchify(x: jax.Array, p: int, f: int, gh: int, gw: int,
+               c: int) -> jax.Array:
+    b = x.shape[0]
+    x = x.reshape(b, f, gh, gw, p, p, c)
+    x = x.transpose(0, 1, 2, 4, 3, 5, 6)
+    return x.reshape(b, f, gh * p, gw * p, c)
+
+
+def forward(
+    params,
+    cfg: WanDiTConfig,
+    latents: jax.Array,   # [B, F, H, W, C] (latent video)
+    ctx: jax.Array,       # [B, S_txt, ctx_dim]
+    timesteps: jax.Array, # [B]
+    ctx_mask=None,
+) -> jax.Array:
+    """Velocity prediction, same shape as latents."""
+    b, f, h, w, c = latents.shape
+    p = cfg.patch_size
+    gh, gw = h // p, w // p
+    x = nn.linear(params["patch_in"], patchify(latents, p))
+    temb = nn.linear(
+        params["time_in2"],
+        jax.nn.silu(nn.linear(
+            params["time_in1"],
+            nn.timestep_embedding(timesteps, 256).astype(x.dtype),
+        )),
+    )
+    rope = rope_freqs(cfg, f, gh, gw)
+    for blk in params["blocks"]:
+        x = dit.cross_block_forward(blk, x, ctx, temb, rope, cfg.num_heads,
+                                    ctx_mask)
+    mod = nn.linear(params["norm_out_mod"], jax.nn.silu(temb))[:, None, :]
+    shift, scale = jnp.split(mod, 2, axis=-1)
+    x = nn.layernorm({}, x) * (1 + scale) + shift
+    out = nn.linear(params["proj_out"], x)
+    return unpatchify(out, p, f, gh, gw, cfg.out_channels)
