@@ -43,6 +43,11 @@ class OmniDiffusionConfig:
     default_height: int = 1024
     default_width: int = 1024
     default_num_inference_steps: int = 50
+    # spatial cap (per side) for the video-pipeline warmup generation —
+    # video token counts scale with frames * H * W and must not inherit
+    # the image default geometry (ADVICE r1 high: 1024² video warmup
+    # attempted a ~1.1 TiB allocation)
+    warmup_video_size: int = 256
 
     extra: dict[str, Any] = field(default_factory=dict)
 

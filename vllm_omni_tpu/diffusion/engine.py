@@ -126,8 +126,18 @@ class DiffusionEngine:
                 self.pipeline.cfg.vae.spatial_ratio
                 * self.pipeline.cfg.dit.patch_size
             )
-            height = max(mult, self.od_config.default_height // mult * mult)
-            width = max(mult, self.od_config.default_width // mult * mult)
+            h0, w0 = self.od_config.default_height, self.od_config.default_width
+            if modality == "video":
+                # Video warmup must not reuse the image default geometry:
+                # frames * (H/mult) * (W/mult) latent tokens at 1024² with
+                # CFG-doubled batch tried to allocate ~1.1 TiB (ADVICE
+                # high, round 1). Warm the compile cache at a small spatial
+                # size; serving geometries compile on first use like any
+                # other shape bucket.
+                h0 = min(h0, self.od_config.warmup_video_size)
+                w0 = min(w0, self.od_config.warmup_video_size)
+            height = max(mult, h0 // mult * mult)
+            width = max(mult, w0 // mult * mult)
             sp = OmniDiffusionSamplingParams(
                 height=height, width=width, num_inference_steps=1,
                 guidance_scale=4.0, seed=0,
