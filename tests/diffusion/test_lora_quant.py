@@ -155,3 +155,25 @@ def test_quantized_pipeline_output_close():
     ref, got = run(""), run("int8")
     diff = np.abs(ref.data.astype(np.int32) - got.data.astype(np.int32))
     assert diff.mean() < 8.0
+
+
+def test_manager_cache_invalidates_on_new_base():
+    """ADVICE r1 low: the fused-tree cache must not key on id(base) —
+    a new base tree (e.g. after reload) must rebuild the fusion."""
+    mgr = LoRAManager()
+    mgr.register(_mk_adapter("style", "blk.proj", 8, 16))
+    p1 = {"blk": {"proj": nn.linear_init(jax.random.PRNGKey(1), 8, 16,
+                                         bias=False)}}
+    f1 = mgr.activate(p1, "style", scale=1.0)
+    assert mgr.activate(p1, "style", scale=1.0) is f1
+    p2 = {"blk": {"proj": nn.linear_init(jax.random.PRNGKey(9), 8, 16,
+                                         bias=False)}}
+    f2 = mgr.activate(p2, "style", scale=1.0)
+    assert f2 is not f1
+    np.testing.assert_allclose(
+        np.asarray(f2["blk"]["proj"]["w"]),
+        np.asarray(p2["blk"]["proj"]["w"]
+                   + _mk_adapter("style", "blk.proj", 8, 16).delta(
+                       "blk.proj", 1.0)),
+        rtol=1e-4,
+    )

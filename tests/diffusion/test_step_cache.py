@@ -80,3 +80,40 @@ def test_pipeline_with_teacache_skips_and_stays_close(threshold):
     diff = np.abs(ref_out.data.astype(np.int32) -
                   got_out.data.astype(np.int32))
     assert diff.mean() < 40.0
+
+
+@pytest.mark.parametrize(
+    "arch,sp_extra",
+    [
+        ("WanT2VPipeline", {"num_frames": 2}),
+        ("StableAudioPipeline", {"extra": {"seconds_total": 0.25}}),
+    ],
+)
+def test_teacache_wired_into_video_and_audio(arch, sp_extra):
+    """ADVICE r1 low: cache_config used to be silently ignored by the
+    Wan/StableAudio pipelines; the step-skip loop is now shared."""
+    def make_engine(cache_backend=""):
+        cfg = OmniDiffusionConfig(
+            model_arch=arch, dtype="float32",
+            cache_backend=cache_backend,
+            cache_config={"rel_l1_threshold": 5.0},  # aggressive: force skips
+            extra={"size": "tiny"},
+        )
+        return DiffusionEngine(cfg, warmup=False)
+
+    kwargs = dict(height=32, width=32, num_inference_steps=6,
+                  guidance_scale=1.0, seed=0)
+    kwargs.update(sp_extra)
+    sp = OmniDiffusionSamplingParams(**kwargs)
+    req = OmniDiffusionRequest(prompt=["x"], sampling_params=sp,
+                               request_ids=["r"])
+    base_eng = make_engine("")
+    base_out = base_eng.step(req)[0]
+    assert base_eng.pipeline.last_skipped_steps == 0
+    cached_eng = make_engine("teacache")
+    got_out = cached_eng.step(req)[0]
+    # with an enormous threshold every post-warmup step skips
+    assert cached_eng.pipeline.last_skipped_steps > 0
+    assert base_out.data.shape == got_out.data.shape
+    assert np.abs(base_out.data.astype(np.float64) -
+                  got_out.data.astype(np.float64)).max() > 0

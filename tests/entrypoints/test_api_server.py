@@ -244,3 +244,25 @@ def test_chat_with_audio_modality(qwen3_server_url):
     assert "audio" in msg and msg["audio"]["format"] == "f32le"
     wav = np.frombuffer(base64.b64decode(msg["audio"]["data"]), np.float32)
     assert wav.size > 0
+
+
+def test_images_generations_invalid_size_returns_error(diffusion_server_url):
+    """A request that fails inside the diffusion stage (33 not a multiple
+    of the latent packing) must surface as an HTTP error, not 200 with an
+    empty data array."""
+    r = httpx.post(f"{diffusion_server_url}/v1/images/generations", json={
+        "prompt": "x", "size": "33x33", "num_inference_steps": 1,
+    }, timeout=300)
+    assert r.status_code == 400
+    err = r.json()["error"]
+    assert "multiple" in err["message"]
+
+
+def test_chat_completions_rejected_prompt_returns_error(server_url):
+    """Intake-rejected AR request (prompt > max_model_len) surfaces as an
+    error response instead of hanging or returning garbage."""
+    r = httpx.post(f"{server_url}/v1/completions", json={
+        "model": "tiny-lm", "prompt": list(range(500)),
+    }, timeout=300)
+    assert r.status_code == 500
+    assert "error" in r.json()

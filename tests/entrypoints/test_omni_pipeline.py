@@ -139,3 +139,86 @@ def test_per_request_sampling_params():
     )
     assert len(outs[0].outputs[0].token_ids) == 2
     assert len(outs[1].outputs[0].token_ids) == 6
+
+
+def test_rejected_request_surfaces_as_error():
+    """ADVICE r1 medium: a lone intake-rejected request (prompt longer than
+    max_model_len) must surface as an errored final output, not hang."""
+    omni = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    outs = omni.generate([list(range(500))])  # 500 > max_model_len=128
+    assert len(outs) == 1
+    assert outs[0].is_error
+    assert "max_model_len" in (outs[0].error_message or "") or outs[0].error_message
+
+
+def test_rejected_mixed_with_valid():
+    omni = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    outs = omni.generate([[1, 2, 3], list(range(500))])
+    assert len(outs) == 2
+    ok = [o for o in outs if not o.is_error]
+    bad = [o for o in outs if o.is_error]
+    assert len(ok) == 1 and len(bad) == 1
+    assert len(ok[0].outputs[0].token_ids) == 4
+
+
+def _tiny_diffusion_cfg(**overrides):
+    sampling = {
+        "height": 32, "width": 32, "num_inference_steps": 2,
+        "guidance_scale": 1.0, "seed": 0,
+    }
+    sampling.update(overrides.pop("sampling", {}))
+    cfg = StageConfig(
+        stage_id=0,
+        stage_type="diffusion",
+        engine_args={
+            "model_arch": "QwenImagePipeline",
+            "size": "tiny",
+            "dtype": "float32",
+            "default_height": 32, "default_width": 32,
+        },
+        engine_input_source=[-1],
+        final_output=True,
+        final_output_type="image",
+        default_sampling_params=sampling,
+        runtime=StageRuntime(max_batch_size=4),
+        **overrides,
+    )
+    return cfg
+
+
+def test_diffusion_batch_groups_by_sampling_params():
+    """ADVICE r1 medium: requests with different sampling params must not
+    share a batch (the first request's geometry would silently win)."""
+    stage = OmniStage(_tiny_diffusion_cfg())
+    stage.submit([
+        StageRequest(request_id="a", prompt="x",
+                     sampling_params={"height": 32, "width": 32}),
+        StageRequest(request_id="b", prompt="y",
+                     sampling_params={"height": 64, "width": 64}),
+        StageRequest(request_id="c", prompt="z",
+                     sampling_params={"height": 32, "width": 32}),
+    ])
+    first = stage.poll()   # a + c batch together (same params)
+    assert sorted(o.request_id for o in first) == ["a", "c"]
+    assert all(o.images[0].shape == (32, 32, 3) for o in first)
+    second = stage.poll()  # b runs alone at its own geometry
+    assert [o.request_id for o in second] == ["b"]
+    assert second[0].images[0].shape == (64, 64, 3)
+
+
+def test_diffusion_error_scoped_to_batch():
+    """ADVICE r1 low: a failing request errors only its own batch; queued
+    requests with other params still complete."""
+    stage = OmniStage(_tiny_diffusion_cfg())
+    stage.submit([
+        StageRequest(request_id="bad", prompt="x",
+                     sampling_params={"height": 33, "width": 33}),  # not /8
+        StageRequest(request_id="good", prompt="y",
+                     sampling_params={"height": 32, "width": 32}),
+    ])
+    first = stage.poll()
+    assert [o.request_id for o in first] == ["bad"]
+    assert first[0].is_error and "multiple" in first[0].error_message
+    second = stage.poll()
+    assert [o.request_id for o in second] == ["good"]
+    assert not second[0].is_error

@@ -137,6 +137,13 @@ class ARScheduler:
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.running)
 
+    @property
+    def has_pending_errored(self) -> bool:
+        """Intake-rejected requests waiting to be drained into outputs.
+        Engines must keep stepping while these exist — a lone rejected
+        request would otherwise never surface (ADVICE r1 medium)."""
+        return bool(self._errored)
+
     # ----------------------------------------------------------- schedule
     def schedule(self) -> SchedulerOutput:
         out = SchedulerOutput()

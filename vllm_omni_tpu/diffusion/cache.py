@@ -92,3 +92,36 @@ def cached_eval(
 
     v, new_prev_lat, new_accum = jax.lax.cond(skip, do_skip, do_compute, None)
     return v, (v, new_prev_lat, new_accum), skip
+
+
+def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps):
+    """Shared denoise fori_loop, optionally gated by the step cache.
+
+    ``eval_velocity(latents, i)`` -> velocity (shape-preserving).  Returns
+    ``(final_latents, skipped_count)``.  One implementation for every
+    pipeline (image/video/audio) so cache-semantics changes land once.
+    """
+    from vllm_omni_tpu.diffusion import scheduler as fm
+
+    if cache_cfg is not None and cache_cfg.enabled:
+
+        def body(i, carry):
+            lat, cc, skipped = carry
+            v, cc, skip = cached_eval(
+                cache_cfg, lambda l: eval_velocity(l, i), lat, cc, i,
+                num_steps,
+            )
+            return (fm.step(schedule, lat, v, i), cc,
+                    skipped + skip.astype(jnp.int32))
+
+        lat, _, skipped = jax.lax.fori_loop(
+            0, num_steps, body,
+            (latents, init_carry(latents), jnp.asarray(0, jnp.int32)),
+        )
+        return lat, skipped
+
+    def body(i, lat):
+        return fm.step(schedule, lat, eval_velocity(lat, i), i)
+
+    lat = jax.lax.fori_loop(0, num_steps, body, latents)
+    return lat, jnp.asarray(0, jnp.int32)

@@ -106,6 +106,11 @@ class LoRAManager:
         self._adapters: dict[str, LoRAAdapter] = {}
         self._fused_cache: dict[tuple, object] = {}
         self._max_cached = max_cached
+        # Strong reference to the base tree the cache was built against.
+        # An id()-based key could collide after the old tree is collected
+        # and its id recycled (ADVICE r1 low); identity-checking a held
+        # reference cannot, and the engine keeps the base alive anyway.
+        self._base_ref: object = None
 
     def register(self, adapter: LoRAAdapter) -> None:
         self._adapters[adapter.name] = adapter
@@ -121,7 +126,10 @@ class LoRAManager:
 
     def activate(self, base_params, name: str, scale: float = 1.0):
         """Return the fused param tree for (adapter, scale), cached."""
-        key = (name, round(float(scale), 6), id(base_params))
+        if base_params is not self._base_ref:
+            self._fused_cache.clear()
+            self._base_ref = base_params
+        key = (name, round(float(scale), 6))
         if key in self._fused_cache:
             return self._fused_cache[key]
         adapter = self._adapters[name]

@@ -123,7 +123,12 @@ class LLMEngine:
 
     @property
     def has_unfinished_requests(self) -> bool:
-        return self.scheduler.has_unfinished
+        # Pending errored (intake-rejected) requests count as unfinished so
+        # the stage polling loop keeps stepping until step() drains them —
+        # otherwise a lone invalid request is silently dropped and its
+        # client hangs forever (ADVICE r1 medium).
+        return (self.scheduler.has_unfinished
+                or self.scheduler.has_pending_errored)
 
     # ---------------------------------------------------------------- step
     def step(self) -> list[OmniRequestOutput]:

@@ -160,6 +160,16 @@ class AsyncOmni:
                 if not outs:
                     continue
                 progressed = True
+                # errored outputs terminate their streams and are not
+                # forwarded downstream
+                errs = [o for o in outs if o.is_error]
+                outs = [o for o in outs if not o.is_error]
+                for o in errs:
+                    omni.metrics.record_finish(o.request_id)
+                    self._emit(o.request_id, o)
+                    self._emit(o.request_id, _SENTINEL)
+                if not outs:
+                    continue
                 if stage.config.final_output:
                     for o in outs:
                         o.final_output_type = stage.config.final_output_type

@@ -141,12 +141,21 @@ class Omni:
                 outs = stage.poll()
                 if not outs:
                     continue
+                # Errored outputs terminate their request here: they are
+                # surfaced to the caller from whichever stage failed and
+                # never forwarded downstream.
+                errs = [o for o in outs if o.is_error]
+                outs = [o for o in outs if not o.is_error]
+                for o in errs:
+                    finals.setdefault(o.request_id, []).append(o)
+                    self.metrics.record_finish(o.request_id)
                 if stage.config.final_output:
                     for o in outs:
                         o.final_output_type = stage.config.final_output_type
                         finals.setdefault(o.request_id, []).append(o)
                         self.metrics.record_finish(o.request_id)
-                self._forward(stage, outs)
+                if outs:
+                    self._forward(stage, outs)
         for stage in self.stages:
             for s in stage.request_stats:
                 self.metrics.record_stage_request(s)

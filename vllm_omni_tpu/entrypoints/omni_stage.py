@@ -48,6 +48,33 @@ def _import_obj(path: str):
     return getattr(importlib.import_module(mod), attr)
 
 
+def _sp_equal(a: dict, b: dict) -> bool:
+    """Value equality for merged sampling-param dicts, tolerating array
+    values (conditioning tensors in ``extra``) that make plain dict ==
+    raise on ambiguous truthiness."""
+    if a.keys() != b.keys():
+        return False
+    for k in a:
+        va, vb = a[k], b[k]
+        if va is vb:
+            continue
+        if isinstance(va, dict) and isinstance(vb, dict):
+            if not _sp_equal(va, vb):
+                return False
+            continue
+        try:
+            if bool(va == vb):
+                continue
+            return False
+        except (ValueError, TypeError):
+            import numpy as np
+
+            if not (np.shape(va) == np.shape(vb)
+                    and bool(np.all(np.asarray(va) == np.asarray(vb)))):
+                return False
+    return True
+
+
 class OmniStage:
     def __init__(self, config: StageConfig):
         self.config = config
@@ -155,6 +182,21 @@ class OmniStage:
             return self.engine.has_unfinished_requests
         return bool(self._pending)
 
+    def _merged_sp_kwargs(self, r: StageRequest) -> dict[str, Any]:
+        # memoized per request: requests can sit in _pending across many
+        # polls and the merge/compare runs in the hot polling loop
+        cached = getattr(r, "_merged_sp", None)
+        if cached is not None:
+            return cached
+        from vllm_omni_tpu.diffusion.request import OmniDiffusionSamplingParams
+
+        defaults = dict(self.config.default_sampling_params)
+        merged = {**defaults, **r.sampling_params}
+        known = OmniDiffusionSamplingParams.__dataclass_fields__
+        merged = {k: v for k, v in merged.items() if k in known}
+        r._merged_sp = merged
+        return merged
+
     def _run_diffusion_batch(self) -> list[OmniRequestOutput]:
         if not self._pending:
             return []
@@ -163,20 +205,46 @@ class OmniStage:
             OmniDiffusionSamplingParams,
         )
 
-        batch = self._pending[: max(1, self.config.runtime.max_batch_size)]
-        self._pending = self._pending[len(batch):]
-        defaults = dict(self.config.default_sampling_params)
-        sp_kwargs = {**defaults, **batch[0].sampling_params}
-        known = OmniDiffusionSamplingParams.__dataclass_fields__
-        sp = OmniDiffusionSamplingParams(
-            **{k: v for k, v in sp_kwargs.items() if k in known}
-        )
+        # Batch only requests whose effective sampling params match the
+        # head request's — a diffusion batch shares one geometry/steps/seed
+        # (reference batches under the identical-sampling-params constraint,
+        # omni_stage.py:797-843; ADVICE r1 medium: batching mixed params
+        # silently applied the first request's params to all). Plain dict
+        # equality, not a repr key: repr truncates large arrays and is
+        # insertion-order sensitive.
+        merged = [self._merged_sp_kwargs(r) for r in self._pending]
+        head = merged[0]
+        batch: list[StageRequest] = []
+        rest: list[StageRequest] = []
+        limit = max(1, self.config.runtime.max_batch_size)
+        for r, m in zip(self._pending, merged):
+            if len(batch) < limit and _sp_equal(m, head):
+                batch.append(r)
+            else:
+                rest.append(r)
+        self._pending = rest
+        sp = OmniDiffusionSamplingParams(**head)
         req = OmniDiffusionRequest(
             prompt=[r.prompt or "" for r in batch],
             sampling_params=sp,
             request_ids=[r.request_id for r in batch],
         )
-        diff_outs = self.engine.step(req)
+        try:
+            diff_outs = self.engine.step(req)
+        except Exception as e:
+            # Scope the failure to this batch's requests (ADVICE r1 low:
+            # a poll exception must not take down unrelated streams).
+            logger.exception(
+                "stage %d: diffusion batch failed (%d reqs)",
+                self.stage_id, len(batch),
+            )
+            return [
+                OmniRequestOutput.from_error(
+                    r.request_id, f"{type(e).__name__}: {e}",
+                    stage_id=self.stage_id,
+                )
+                for r in batch
+            ]
         return [
             OmniRequestOutput.from_diffusion(
                 o.request_id, [o.data], final_output_type=o.output_type

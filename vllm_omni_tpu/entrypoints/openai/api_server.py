@@ -162,6 +162,20 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
     def _error(self, code: int, message: str, etype: str = "invalid_request_error"):
         self._json(code, {"error": {"message": message, "type": etype}})
 
+    def _surface_error(self, outs) -> bool:
+        """If any pipeline output is errored, reply with an OpenAI-style
+        error (instead of HTTP 200 with an empty/garbage payload) and
+        return True.  Validation failures (ValueError) map to 400."""
+        err = next((o for o in outs if o.is_error), None)
+        if err is None:
+            return False
+        msg = err.error_message or "request failed"
+        if msg.startswith("ValueError"):
+            self._error(400, msg)
+        else:
+            self._error(500, msg, "internal_error")
+        return True
+
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
         if length == 0:
@@ -247,12 +261,18 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 if isinstance(out, Exception):
                     self._sse_send({"error": {"message": str(out)}})
                     break
+                if out.is_error:
+                    self._sse_send({"error": {
+                        "message": out.error_message or "request failed"}})
+                    break
                 for chunk in self._chat_chunks(out, rid, created):
                     self._sse_send(chunk)
             self._sse_send("[DONE]")
             self._sse_end()
             return
         outs = self.state.collect(prompt, sp, rid)
+        if self._surface_error(outs):
+            return
         text_out = next((o for o in outs if o.final_output_type == "text"),
                         outs[0] if outs else None)
         if text_out is None:
@@ -346,6 +366,8 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         all_outs = self.state.collect_many(jobs)
         choices = []
         for i, outs in enumerate(all_outs):
+            if self._surface_error(outs):
+                return
             text_out = next(
                 (o for o in outs if o.final_output_type == "text"), None)
             if text_out is None:
@@ -384,6 +406,8 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         jobs = [(prompt, sp, f"{rid}-{i}") for i in range(n)]
         data = []
         for outs in self.state.collect_many(jobs):
+            if self._surface_error(outs):
+                return
             for o in outs:
                 if o.final_output_type == "image":
                     data.extend(
@@ -412,6 +436,8 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 sp[k] = body[k]
         rid = f"video-{uuid.uuid4().hex[:16]}"
         outs = self.state.collect(prompt, sp, rid)
+        if self._surface_error(outs):
+            return
         video = next(
             (o.multimodal_output.get("video",
                                      o.images[0] if o.images else None)
@@ -438,6 +464,8 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             return self._error(400, "input required")
         rid = f"speech-{uuid.uuid4().hex[:16]}"
         outs = self.state.collect(text, {}, rid)
+        if self._surface_error(outs):
+            return
         audio = next(
             (o.multimodal_output["audio"] for o in outs
              if o.final_output_type == "audio"

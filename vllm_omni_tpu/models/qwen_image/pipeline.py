@@ -175,31 +175,10 @@ class QwenImagePipeline:
                     v = v_neg + gscale * (v_pos - v_neg)
                 return v
 
-            cache_cfg = self.cache_config
-            if cache_cfg is not None and cache_cfg.enabled:
-                # step-skip acceleration: lax.cond-gated DiT eval with the
-                # cache state riding the loop carry (diffusion/cache.py)
-                def body(i, carry):
-                    lat, cache_carry, skipped = carry
-                    v, cache_carry, skip = step_cache.cached_eval(
-                        cache_cfg, lambda l: eval_velocity(l, i), lat,
-                        cache_carry, i, num_steps,
-                    )
-                    lat = fm.step(schedule, lat, v, i)
-                    return lat, cache_carry, skipped + skip.astype(jnp.int32)
-
-                lat, _, skipped = jax.lax.fori_loop(
-                    0, num_steps, body,
-                    (latents, step_cache.init_carry(latents),
-                     jnp.asarray(0, jnp.int32)),
-                )
-                return lat, skipped
-
-            def body(i, lat):
-                return fm.step(schedule, lat, eval_velocity(lat, i), i)
-
-            lat = jax.lax.fori_loop(0, num_steps, body, latents)
-            return lat, jnp.asarray(0, jnp.int32)
+            return step_cache.run_denoise_loop(
+                self.cache_config, schedule, eval_velocity, latents,
+                num_steps,
+            )
 
         self._denoise_cache[key] = run
         return run

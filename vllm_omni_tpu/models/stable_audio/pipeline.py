@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vllm_omni_tpu.diffusion import cache as step_cache
 from vllm_omni_tpu.diffusion import scheduler as fm
 from vllm_omni_tpu.diffusion.request import DiffusionOutput, OmniDiffusionRequest
 from vllm_omni_tpu.logger import init_logger
@@ -190,18 +191,21 @@ class StableAudioPipeline:
             return self._denoise_cache[key]
         cfg = self.cfg
 
+        cache_cfg = self.cache_config
+
         @jax.jit
         def run(dit_params, latents, ctx, ctx_mask, sigmas, timesteps,
                 num_steps):
             schedule = fm.FlowMatchSchedule(sigmas=sigmas,
                                             timesteps=timesteps)
 
-            def body(i, lat):
+            def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
-                v = dit_forward(dit_params, cfg.dit, lat, ctx, t, ctx_mask)
-                return fm.step(schedule, lat, v, i)
+                return dit_forward(dit_params, cfg.dit, lat, ctx, t,
+                                   ctx_mask)
 
-            return jax.lax.fori_loop(0, num_steps, body, latents)
+            return step_cache.run_denoise_loop(
+                cache_cfg, schedule, eval_velocity, latents, num_steps)
 
         self._denoise_cache[key] = run
         return run
@@ -230,8 +234,9 @@ class StableAudioPipeline:
         timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
             schedule.timesteps)
         run = self._denoise_fn(lat_len, sched_len)
-        latents = run(self.dit_params, noise, ctx, ctx_mask, sigmas,
-                      timesteps, jnp.int32(num_steps))
+        latents, skipped = run(self.dit_params, noise, ctx, ctx_mask,
+                               sigmas, timesteps, jnp.int32(num_steps))
+        self.last_skipped_steps = int(skipped)
         wav = jax.jit(
             lambda p, l: decode_audio(p, cfg, l)
         )(self.decoder_params, latents)

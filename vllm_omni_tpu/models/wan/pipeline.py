@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vllm_omni_tpu.diffusion import cache as step_cache
 from vllm_omni_tpu.diffusion import scheduler as fm
 from vllm_omni_tpu.diffusion.request import DiffusionOutput, OmniDiffusionRequest
 from vllm_omni_tpu.logger import init_logger
@@ -86,6 +87,8 @@ class WanT2VPipeline:
             return self._denoise_cache[key]
         cfg = self.cfg
 
+        cache_cfg = self.cache_config
+
         @jax.jit
         def run(dit_params, latents, ctx, ctx_mask, neg_ctx, neg_mask,
                 sigmas, timesteps, gscale, num_steps):
@@ -96,7 +99,7 @@ class WanT2VPipeline:
             mask_all = (jnp.concatenate([ctx_mask, neg_mask], 0)
                         if do_cfg else ctx_mask)
 
-            def body(i, lat):
+            def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
                 lat_in = jnp.concatenate([lat, lat], 0) if do_cfg else lat
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
@@ -105,9 +108,10 @@ class WanT2VPipeline:
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)
                     v = v_neg + gscale * (v_pos - v_neg)
-                return fm.step(schedule, lat, v, i)
+                return v
 
-            return jax.lax.fori_loop(0, num_steps, body, latents)
+            return step_cache.run_denoise_loop(
+                cache_cfg, schedule, eval_velocity, latents, num_steps)
 
         self._denoise_cache[key] = run
         return run
@@ -146,9 +150,11 @@ class WanT2VPipeline:
             schedule.timesteps)
         run = self._denoise_fn(frames, lat_h // cfg.dit.patch_size,
                                lat_w // cfg.dit.patch_size, sched_len)
-        latents = run(self.dit_params, noise, ctx, ctx_mask, neg_ctx,
-                      neg_mask, sigmas, timesteps,
-                      jnp.float32(sp.guidance_scale), jnp.int32(num_steps))
+        latents, skipped = run(
+            self.dit_params, noise, ctx, ctx_mask, neg_ctx,
+            neg_mask, sigmas, timesteps,
+            jnp.float32(sp.guidance_scale), jnp.int32(num_steps))
+        self.last_skipped_steps = int(skipped)
 
         # frame-wise VAE decode: [B, F, h, w, C] -> [B*F, ...] -> frames
         bf = latents.reshape(b * frames, lat_h, lat_w,

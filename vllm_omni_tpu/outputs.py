@@ -36,8 +36,43 @@ class OmniRequestOutput:
     multimodal_output: dict[str, Any] = field(default_factory=dict)
     metrics: dict[str, float] = field(default_factory=dict)
 
+    @property
+    def is_error(self) -> bool:
+        """True when any completion finished with an error — error outputs
+        terminate the request at the stage that produced them instead of
+        feeding garbage to downstream stages."""
+        return any(c.finish_reason == "error" for c in self.outputs)
+
+    @property
+    def error_message(self) -> Optional[str]:
+        if not self.is_error:
+            return None
+        msg = self.multimodal_output.get("error")
+        if msg:
+            return str(msg)
+        for c in self.outputs:
+            if c.finish_reason == "error" and c.text:
+                return c.text
+        return "request failed"
+
+    @classmethod
+    def from_error(cls, request_id: str, message: str, stage_id: int = 0):
+        return cls(
+            request_id=request_id,
+            finished=True,
+            outputs=[CompletionOutput(
+                index=0, token_ids=[], text=message, finish_reason="error",
+            )],
+            stage_id=stage_id,
+            multimodal_output={"error": message},
+        )
+
     @classmethod
     def from_pipeline(cls, request, stage_id: int = 0, text: Optional[str] = None):
+        mm = dict(request.multimodal_output)
+        if (request.finish_reason == "error"
+                and request.additional_information.get("error")):
+            mm.setdefault("error", request.additional_information["error"])
         return cls(
             request_id=request.request_id,
             finished=request.is_finished,
@@ -50,7 +85,7 @@ class OmniRequestOutput:
             )],
             stage_id=stage_id,
             final_output_type="text",
-            multimodal_output=dict(request.multimodal_output),
+            multimodal_output=mm,
         )
 
     @classmethod
