@@ -259,10 +259,10 @@ def test_images_generations_invalid_size_returns_error(diffusion_server_url):
 
 
 def test_chat_completions_rejected_prompt_returns_error(server_url):
-    """Intake-rejected AR request (prompt > max_model_len) surfaces as an
-    error response instead of hanging or returning garbage."""
+    """Intake-rejected AR request (prompt > max_model_len) surfaces as a
+    400 (client fault) instead of hanging or returning garbage."""
     r = httpx.post(f"{server_url}/v1/completions", json={
         "model": "tiny-lm", "prompt": list(range(500)),
     }, timeout=300)
-    assert r.status_code == 500
-    assert "error" in r.json()
+    assert r.status_code == 400
+    assert "max_model_len" in r.json()["error"]["message"]

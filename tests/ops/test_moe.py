@@ -1,0 +1,103 @@
+"""Routed MoE (grouped matmul + EP shard_map) vs the dense-dispatch
+oracle (VERDICT r1 weak#4: dense dispatch wastes k/E of the FLOPs; the
+routed path must match it exactly).  Reference semantics: vLLM fused MoE
+consumed by the Qwen3 thinker/talker (models/qwen3_omni/qwen3_moe.py)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.ops import moe as moe_ops
+from vllm_omni_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clear_ep_mesh():
+    yield
+    moe_ops.set_ep_mesh(None)
+
+
+def _mk_weights(rng, t=12, hidden=16, e=4, inter=8, k=2):
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    x = jax.random.normal(k1, (t, hidden), jnp.float32)
+    router_w = jax.random.normal(k2, (hidden, e), jnp.float32) * 0.5
+    gate_up = jax.random.normal(k3, (e, hidden, 2 * inter), jnp.float32) * 0.2
+    down = jax.random.normal(k4, (e, inter, hidden), jnp.float32) * 0.2
+    return x, router_w, gate_up, down
+
+
+def _dense_oracle(x, router_w, gate_up, down, k):
+    layer = {"router": {"w": router_w},
+             "experts": {"gate_up": gate_up, "down": down}}
+    cfg = tfm.TransformerConfig(
+        moe=True, num_experts=gate_up.shape[0], num_experts_per_tok=k)
+    return tfm._moe_mlp_dense(layer, cfg, x)
+
+
+def test_routed_matches_dense(rng):
+    x, rw, gu, dn = _mk_weights(rng)
+    want = _dense_oracle(x, rw, gu, dn, 2)
+    got = moe_ops.routed_moe(x, rw, gu, dn, 2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_routed_topk1(rng):
+    x, rw, gu, dn = _mk_weights(rng, e=3)
+    want = _dense_oracle(x, rw, gu, dn, 1)
+    got = moe_ops.routed_moe(x, rw, gu, dn, 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_routed_under_jit(rng):
+    x, rw, gu, dn = _mk_weights(rng)
+    want = _dense_oracle(x, rw, gu, dn, 2)
+    got = jax.jit(
+        lambda *a: moe_ops.routed_moe(*a, 2))(x, rw, gu, dn)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("ep", [2, 4, 8])
+def test_routed_ep_matches_dense(rng, devices8, ep):
+    x, rw, gu, dn = _mk_weights(rng, e=8, t=16)
+    want = _dense_oracle(x, rw, gu, dn, 2)
+    mesh = build_mesh(
+        MeshConfig(expert_parallel_size=ep, data_parallel_size=8 // ep),
+        devices8)
+    got = moe_ops.routed_moe_ep(x, rw, gu, dn, 2, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_transformer_forward_routed_matches_dense(rng, devices8):
+    """forward_hidden with moe_dispatch=routed (incl. EP via set_ep_mesh)
+    matches the dense-dispatch forward token-for-token."""
+    cfg_dense = dataclasses.replace(
+        tfm.TransformerConfig.tiny_moe(), moe_dispatch="dense")
+    cfg_routed = dataclasses.replace(cfg_dense, moe_dispatch="routed")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg_dense, jnp.float32)
+    ids = jax.random.randint(rng, (2, 10), 0, cfg_dense.vocab_size)
+
+    want = tfm.forward_hidden(params, cfg_dense, ids)
+    got_local = tfm.forward_hidden(params, cfg_routed, ids)
+    np.testing.assert_allclose(np.asarray(got_local), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+
+    mesh = build_mesh(
+        MeshConfig(expert_parallel_size=4, data_parallel_size=2), devices8)
+    moe_ops.set_ep_mesh(mesh)
+    try:
+        from vllm_omni_tpu.parallel.sharding import shard_moe_params
+
+        sharded = shard_moe_params(params, mesh)
+        got_ep = tfm.forward_hidden(sharded, cfg_routed, ids)
+    finally:
+        moe_ops.set_ep_mesh(None)
+    np.testing.assert_allclose(np.asarray(got_ep), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)

@@ -116,6 +116,9 @@ class ARScheduler:
         if reason is not None:
             request.status = RequestStatus.FINISHED_ERROR
             request.additional_information.setdefault("error", reason)
+            # intake rejections are the client's fault -> HTTP 400
+            request.additional_information.setdefault(
+                "error_kind", "invalid_request")
             self._finished_ids.add(request.request_id)
             self._errored.append(request)
             return

@@ -71,10 +71,49 @@ class DiffusionEngine:
             cache_config = StepCacheConfig.from_dict(
                 od_config.cache_backend, od_config.cache_config
             )
-        self.pipeline = pipeline_cls(
-            pipe_cfg, dtype=dtype, seed=od_config.seed,
-            cache_config=cache_config,
+        mesh = None
+        if od_config.parallel.world_size > 1:
+            # Stage mesh from the configured parallel degrees (reference:
+            # initialize_model_parallel, parallel_state.py:624); the
+            # pipeline shards weights/activations over it.
+            from vllm_omni_tpu.parallel.mesh import build_mesh
+
+            mesh = build_mesh(
+                od_config.parallel,
+                jax.devices()[: od_config.parallel.world_size],
+            )
+        self.mesh = mesh
+        from_ckpt = (
+            od_config.model
+            and os.path.isfile(os.path.join(od_config.model,
+                                            "model_index.json"))
+            and hasattr(pipeline_cls, "from_pretrained")
         )
+        if from_ckpt:
+            # diffusers-format checkpoint directory: real weights
+            self.pipeline = pipeline_cls.from_pretrained(
+                od_config.model, dtype=dtype, seed=od_config.seed,
+                cache_config=cache_config, mesh=mesh,
+            )
+        else:
+            if od_config.model and os.path.isdir(od_config.model):
+                # a real directory without model_index.json is a broken
+                # checkpoint path, not a preset name — don't silently
+                # serve random weights
+                raise ValueError(
+                    f"model dir {od_config.model!r} has no "
+                    "model_index.json (not a diffusers-format checkpoint)"
+                )
+            if od_config.model:
+                logger.warning(
+                    "model %r is not a local checkpoint directory; "
+                    "building %s with random-init weights",
+                    od_config.model, arch,
+                )
+            self.pipeline = pipeline_cls(
+                pipe_cfg, dtype=dtype, seed=od_config.seed,
+                cache_config=cache_config, mesh=mesh,
+            )
         if od_config.quantization == "int8":
             from vllm_omni_tpu.diffusion.quantization import quantize_params
 

@@ -8,6 +8,11 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 
+class InvalidRequestError(ValueError):
+    """Request-parameter validation failure (client's fault — maps to
+    HTTP 400 at the API layer, unlike internal pipeline errors)."""
+
+
 @dataclass
 class OmniDiffusionSamplingParams:
     height: int = 1024

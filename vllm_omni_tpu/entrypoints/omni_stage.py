@@ -238,10 +238,14 @@ class OmniStage:
                 "stage %d: diffusion batch failed (%d reqs)",
                 self.stage_id, len(batch),
             )
+            from vllm_omni_tpu.diffusion.request import InvalidRequestError
+
+            kind = ("invalid_request" if isinstance(e, InvalidRequestError)
+                    else "internal")
             return [
                 OmniRequestOutput.from_error(
                     r.request_id, f"{type(e).__name__}: {e}",
-                    stage_id=self.stage_id,
+                    stage_id=self.stage_id, kind=kind,
                 )
                 for r in batch
             ]

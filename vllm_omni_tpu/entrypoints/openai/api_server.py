@@ -170,7 +170,7 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         if err is None:
             return False
         msg = err.error_message or "request failed"
-        if msg.startswith("ValueError"):
+        if err.error_kind == "invalid_request":
             self._error(400, msg)
         else:
             self._error(500, msg, "internal_error")

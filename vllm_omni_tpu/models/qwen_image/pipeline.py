@@ -27,6 +27,7 @@ from vllm_omni_tpu.diffusion import cache as step_cache
 from vllm_omni_tpu.diffusion import scheduler as fm
 from vllm_omni_tpu.diffusion.request import (
     DiffusionOutput,
+    InvalidRequestError,
     OmniDiffusionRequest,
 )
 from vllm_omni_tpu.logger import init_logger
@@ -87,9 +88,21 @@ class QwenImagePipelineConfig:
         )
 
 
+# Text-encoder chat template + drop index for Qwen-Image (reference:
+# pipeline_qwen_image.py:293-294 — the first 34 tokens are the fixed
+# system/user preamble and are dropped from the embeddings).
+PROMPT_TEMPLATE = (
+    "<|im_start|>system\nDescribe the image by detailing the color, shape, "
+    "size, texture, quantity, text, spatial relationships of the objects "
+    "and background:<|im_end|>\n<|im_start|>user\n{}<|im_end|>\n"
+    "<|im_start|>assistant\n"
+)
+PROMPT_TEMPLATE_DROP_IDX = 34
+
+
 class QwenImagePipeline:
-    """Text -> image. Weights are random-initialized unless a checkpoint
-    is provided (weight loading lands with the safetensors loader)."""
+    """Text -> image.  Weights are random-initialized from the config, or
+    loaded from a diffusers-format checkpoint via ``from_pretrained``."""
 
     output_type = "image"
 
@@ -100,6 +113,7 @@ class QwenImagePipeline:
         seed: int = 0,
         mesh=None,
         cache_config=None,  # StepCacheConfig | None (step-skip acceleration)
+        init_weights: bool = True,
     ):
         self.cfg = config
         self.dtype = dtype
@@ -113,21 +127,139 @@ class QwenImagePipeline:
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
         key = jax.random.PRNGKey(seed)
         k1, k2, k3 = jax.random.split(key, 3)
-        logger.info("Initializing QwenImagePipeline params (dtype=%s)", dtype)
-        self.text_params = init_text_params(k1, config.text, dtype)
-        self.dit_params = dit.init_params(k2, config.dit, dtype)
-        self.vae_params = vae_mod.init_decoder(k3, config.vae, dtype)
+        # The VAE decoder is always random-init (causal-VAE weight port
+        # pending); DiT/text skip init when a checkpoint will overwrite
+        # them (init_weights=False avoids materializing + placing tens of
+        # GB of randoms only to discard them).
+        self.vae_params = self._place(vae_mod.init_decoder(
+            k3, config.vae, dtype))
+        if init_weights:
+            logger.info(
+                "Initializing QwenImagePipeline params (dtype=%s)", dtype)
+            self.text_params = self._place(
+                init_text_params(k1, config.text, dtype))
+            self.dit_params = self._place(
+                dit.init_params(k2, config.dit, dtype), tp=True)
+        else:
+            self.text_params = self.dit_params = None
         self._denoise_cache: dict = {}
+        # HF text-encode mode (from_pretrained): chat template + drop_idx
+        self.hf_tokenizer = None
+
+    def _place(self, params, tp: bool = False):
+        """Put a param tree on the mesh: TP layout for the DiT, replicated
+        otherwise (reference: SP plan application at model init,
+        diffusion/registry.py:122-294).  No-op without a mesh."""
+        if self.mesh is None:
+            return params
+        from vllm_omni_tpu.parallel.sharding import (
+            replicated,
+            shard_dit_params,
+        )
+
+        if tp:
+            return shard_dit_params(params, self.mesh)
+        return jax.device_put(params, replicated(self.mesh))
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_dir: str,
+        dtype=jnp.bfloat16,
+        seed: int = 0,
+        mesh=None,
+        cache_config=None,
+        max_text_len: int = 512,
+    ) -> "QwenImagePipeline":
+        """Build from a diffusers-format checkpoint directory (reference:
+        DiffusersPipelineLoader, diffusion/model_loader/diffusers_loader.py
+        + pipeline component resolution, omni_diffusion.py:34-109).
+
+        Loads the DiT and the Qwen2.5-VL-style text encoder with real
+        weights, the HF tokenizer, and the FlowMatch scheduler shift
+        config.  The VAE decoder keeps our conv architecture (temporal/
+        causal VAE weight port is tracked separately) — random-init with a
+        warning when the checkpoint's VAE doesn't match.
+        """
+        import os
+
+        from vllm_omni_tpu.model_loader import diffusers_loader as dl
+
+        dl.load_model_index(model_dir)  # validates layout
+        dit_params, dit_cfg = dl.load_qwen_image_dit(
+            os.path.join(model_dir, "transformer"), dtype=dtype
+        )
+        te_dir = os.path.join(model_dir, "text_encoder")
+        text_params, text_cfg = dl.load_text_encoder(te_dir, dtype=dtype)
+        sched = dl.scheduler_config(model_dir)
+        config = QwenImagePipelineConfig(
+            dit=dit_cfg,
+            vae=VAEConfig(latent_channels=dit_cfg.out_channels),
+            text=text_cfg,
+            max_text_len=max_text_len,
+            # defaults mirror diffusers FlowMatchEulerDiscreteScheduler
+            # (and scheduler_config()'s own) so present-but-sparse and
+            # absent scheduler configs behave identically
+            shift=sched.get("shift", 1.0),
+            use_dynamic_shifting=sched.get("use_dynamic_shifting", False),
+        )
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+        pipe.dit_params = pipe._place(dit_params, tp=True)
+        pipe.text_params = pipe._place(text_params)
+        logger.warning(
+            "VAE weights not loaded from %s (conv decoder is random-init; "
+            "causal-VAE port pending)", model_dir,
+        )
+        tok_dir = os.path.join(model_dir, "tokenizer")
+        if os.path.isdir(tok_dir):
+            from transformers import AutoTokenizer
+
+            pipe.hf_tokenizer = AutoTokenizer.from_pretrained(tok_dir)
+            # the drop-34 preamble removal in _encode_prompt_hf is only
+            # correct under right padding; some checkpoints ship
+            # padding_side='left' in tokenizer_config.json
+            pipe.hf_tokenizer.padding_side = "right"
+        else:
+            logger.warning("no tokenizer/ under %s; byte fallback",
+                           model_dir)
+        return pipe
 
     # ------------------------------------------------------------- encode
     def encode_prompt(self, prompts: list[str]):
         """Returns (hidden [B, S, joint_dim], mask [B, S])."""
+        if self.hf_tokenizer is not None:
+            return self._encode_prompt_hf(prompts)
         ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
         hidden = self._encode_jit(jnp.asarray(ids))
         mask = (
             np.arange(self.cfg.max_text_len)[None, :] < lens[:, None]
         ).astype(np.int32)
         return hidden, jnp.asarray(mask)
+
+    def _encode_prompt_hf(self, prompts: list[str]):
+        """Real-checkpoint text encoding: chat-template the prompt, take
+        the final hidden states, and drop the fixed 34-token preamble
+        (reference: _get_qwen_prompt_embeds, pipeline_qwen_image.py:366-399
+        — with right padding, dropping the first `drop_idx` positions
+        equals dropping the first drop_idx real tokens; we keep a static
+        [B, max_text_len] shape and carry validity in the mask)."""
+        drop = PROMPT_TEMPLATE_DROP_IDX
+        txts = [PROMPT_TEMPLATE.format(p) for p in prompts]
+        enc = self.hf_tokenizer(
+            txts,
+            max_length=self.cfg.max_text_len + drop,
+            padding="max_length",
+            truncation=True,
+            return_tensors="np",
+        )
+        ids = np.asarray(enc["input_ids"], np.int32)
+        mask = np.asarray(enc["attention_mask"], np.int32)
+        hidden = self._encode_jit(jnp.asarray(ids))
+        return (
+            hidden[:, drop:].astype(self.dtype),
+            jnp.asarray(mask[:, drop:]),
+        )
 
     @functools.cached_property
     def _encode_jit(self):
@@ -136,12 +268,74 @@ class QwenImagePipeline:
         )
 
     # ------------------------------------------------------------ denoise
-    def _denoise_fn(self, grid_h: int, grid_w: int, sched_len: int):
-        key = (grid_h, grid_w, sched_len)
+    def _sp_attn_fn(self, n_heads: int, seq_len: int, batch2: int):
+        """shard_map-wrapped joint USP attention for the DiT blocks, or
+        None when the mesh/shape constraints don't allow the explicit SP
+        path (GSPMD still partitions the dense fallback correctly)."""
+        mesh = self.mesh
+        if mesh is None:
+            return None
+        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+        sp = ax.get("ring", 1) * ax.get("ulysses", 1)
+        tp = ax.get("tp", 1)
+        if sp == 1 and tp == 1:
+            return None
+        if (seq_len % sp or n_heads % tp
+                or (n_heads // tp) % ax.get("ulysses", 1)
+                or batch2 % (ax.get("cfg", 1) * ax.get("dp", 1))):
+            logger.warning(
+                "mesh %s does not divide (seq=%d, heads=%d, batch=%d); "
+                "falling back to GSPMD-partitioned dense attention",
+                ax, seq_len, n_heads, batch2,
+            )
+            return None
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from vllm_omni_tpu.parallel.context import joint_sp_attention
+
+        bspec = ("cfg", "dp")
+        img_spec = P(bspec, ("ring", "ulysses"), "tp", None)
+        txt_spec = P(bspec, None, "tp", None)
+        mask_spec = P(bspec, None)
+        inner = shard_map(
+            functools.partial(
+                joint_sp_attention, ulysses_axis="ulysses", ring_axis="ring"
+            ),
+            mesh=mesh,
+            in_specs=(img_spec,) * 3 + (txt_spec,) * 3 + (mask_spec,),
+            out_specs=(img_spec, txt_spec),
+        )
+
+        def attn_fn(qi, ki, vi, qt, kt, vt, txt_kv_mask):
+            if txt_kv_mask is None:
+                txt_kv_mask = jnp.ones(qt.shape[:2], jnp.int32)
+            img_o, txt_o = inner(qi, ki, vi, qt, kt, vt, txt_kv_mask)
+            # block_forward's attn_fn contract: flattened [B, S, H*D]
+            return (img_o.reshape(*img_o.shape[:2], -1),
+                    txt_o.reshape(*txt_o.shape[:2], -1))
+
+        return attn_fn
+
+    def _denoise_fn(self, grid_h: int, grid_w: int, sched_len: int,
+                    batch2: int = 0):
+        # batch2 affects only the shard_map attn dispatch decision — keep
+        # it out of the key on meshless pipelines (jit handles shapes).
+        key = (grid_h, grid_w, sched_len) + (
+            (batch2,) if self.mesh is not None else ())
         if key in self._denoise_cache:
             return self._denoise_cache[key]
 
         cfg = self.cfg
+        attn_fn = self._sp_attn_fn(
+            cfg.dit.num_heads, grid_h * grid_w, batch2)
+        mesh = self.mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            lat2_sharding = NamedSharding(
+                mesh, P(("cfg", "dp"), ("ring", "ulysses"), None))
+            txt2_sharding = NamedSharding(mesh, P(("cfg", "dp"), None, None))
 
         @jax.jit
         def run(
@@ -161,14 +355,24 @@ class QwenImagePipeline:
                 if do_cfg
                 else txt_mask
             )
+            if mesh is not None:
+                # CFG parallel: the [positive; negative] halves of the
+                # doubled batch ride the cfg axis (cfg outermost in the
+                # batch spec), image sequence over the SP axes — GSPMD
+                # inserts the cfg combine at the guidance step below.
+                txt_all = jax.lax.with_sharding_constraint(
+                    txt_all, txt2_sharding)
 
             def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
                 lat_in = jnp.concatenate([lat, lat], 0) if do_cfg else lat
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
+                if mesh is not None:
+                    lat_in = jax.lax.with_sharding_constraint(
+                        lat_in, lat2_sharding)
                 v = dit.forward(
                     dit_params, cfg.dit, lat_in, txt_all, t_in,
-                    (grid_h, grid_w), txt_mask=mask_all,
+                    (grid_h, grid_w), attn_fn=attn_fn, txt_mask=mask_all,
                 )
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)
@@ -191,13 +395,13 @@ class QwenImagePipeline:
         patch = cfg.dit.patch_size
         mult = ratio * patch
         if sp.height % mult or sp.width % mult:
-            raise ValueError(
+            raise InvalidRequestError(
                 f"height/width must be multiples of {mult} "
                 f"(vae ratio {ratio} x patch {patch}); got "
                 f"{sp.height}x{sp.width}"
             )
         if sp.num_inference_steps < 1:
-            raise ValueError("num_inference_steps must be >= 1")
+            raise InvalidRequestError("num_inference_steps must be >= 1")
         lat_h, lat_w = sp.height // ratio, sp.width // ratio
         grid_h, grid_w = lat_h // patch, lat_w // patch
         seq_len = grid_h * grid_w
@@ -257,7 +461,8 @@ class QwenImagePipeline:
         timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
             schedule.timesteps
         )
-        run = self._denoise_fn(grid_h, grid_w, sched_len)
+        run = self._denoise_fn(
+            grid_h, grid_w, sched_len, batch2=(2 * b if do_cfg else b))
         latents, skipped_steps = run(
             self.dit_params,
             noise,

@@ -19,7 +19,11 @@ import numpy as np
 
 from vllm_omni_tpu.diffusion import cache as step_cache
 from vllm_omni_tpu.diffusion import scheduler as fm
-from vllm_omni_tpu.diffusion.request import DiffusionOutput, OmniDiffusionRequest
+from vllm_omni_tpu.diffusion.request import (
+    DiffusionOutput,
+    InvalidRequestError,
+    OmniDiffusionRequest,
+)
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.models.common.transformer import (
     TransformerConfig,
@@ -122,7 +126,7 @@ class WanT2VPipeline:
         ratio = cfg.vae.spatial_ratio
         mult = ratio * cfg.dit.patch_size
         if sp.height % mult or sp.width % mult:
-            raise ValueError(f"height/width must be multiples of {mult}")
+            raise InvalidRequestError(f"height/width must be multiples of {mult}")
         frames = max(1, sp.num_frames)
         lat_h, lat_w = sp.height // ratio, sp.width // ratio
         prompts = req.prompt

@@ -142,11 +142,16 @@ def attention_xla(
         )
         return (m_new, l_new, acc), None
 
-    # Derive the init carry from q (zeroed) rather than fresh constants:
-    # under shard_map the inputs carry varying-manual-axis types, and a
-    # plain jnp.zeros init would make scan's carry-in/carry-out types
-    # disagree (ring attention calls this per-chunk inside shard_map).
-    acc0 = jnp.zeros_like(qf).transpose(0, 2, 3, 1, 4)  # [B,Hkv,g,Sq,D]
+    # Derive the init carry from the inputs (zeroed) rather than fresh
+    # constants: under shard_map the inputs carry varying-manual-axis
+    # types, and a plain jnp.zeros init would make scan's carry-in/
+    # carry-out types disagree.  The zero scalar folds in k's and the
+    # mask's vma too (the mask may depend on axis_index when built inside
+    # shard_map, e.g. the joint-SP text path).
+    z = k.astype(jnp.float32).reshape(-1)[0] * 0.0
+    if kv_mask is not None:
+        z = z + kv_mask.astype(jnp.float32).reshape(-1)[0] * 0.0
+    acc0 = jnp.zeros_like(qf).transpose(0, 2, 3, 1, 4) + z  # [B,Hkv,g,Sq,D]
     init = (acc0[..., 0] + _NEG_INF, acc0[..., 0], acc0)
     (m, l, acc), _ = jax.lax.scan(
         body, init, (kx, vx, mx, jnp.arange(nk))

@@ -55,8 +55,16 @@ class OmniRequestOutput:
                 return c.text
         return "request failed"
 
+    @property
+    def error_kind(self) -> Optional[str]:
+        """"invalid_request" (client's fault, HTTP 400) | "internal"."""
+        if not self.is_error:
+            return None
+        return self.multimodal_output.get("error_kind", "internal")
+
     @classmethod
-    def from_error(cls, request_id: str, message: str, stage_id: int = 0):
+    def from_error(cls, request_id: str, message: str, stage_id: int = 0,
+                   kind: str = "internal"):
         return cls(
             request_id=request_id,
             finished=True,
@@ -64,15 +72,19 @@ class OmniRequestOutput:
                 index=0, token_ids=[], text=message, finish_reason="error",
             )],
             stage_id=stage_id,
-            multimodal_output={"error": message},
+            multimodal_output={"error": message, "error_kind": kind},
         )
 
     @classmethod
     def from_pipeline(cls, request, stage_id: int = 0, text: Optional[str] = None):
         mm = dict(request.multimodal_output)
-        if (request.finish_reason == "error"
-                and request.additional_information.get("error")):
-            mm.setdefault("error", request.additional_information["error"])
+        if request.finish_reason == "error":
+            if request.additional_information.get("error"):
+                mm.setdefault("error",
+                              request.additional_information["error"])
+            if request.additional_information.get("error_kind"):
+                mm.setdefault("error_kind",
+                              request.additional_information["error_kind"])
         return cls(
             request_id=request.request_id,
             finished=request.is_finished,

@@ -178,6 +178,72 @@ def ulysses_attention(
     return _gather_heads(o, ulysses_axis)
 
 
+def _text_stream_attention(
+    qt, kt, vt, ki, vi, txt_mask, ulysses_axis, ring_axis
+):
+    """Attention output for the replicated text stream of a joint
+    (text+image) block under sequence parallelism.
+
+    Text queries must attend [text KV ++ ALL image KV], but the image KV is
+    sharded over (ring, ulysses).  Each rank computes a partial over its
+    local image KV (plus, on SP rank 0 only, the text KV so it is counted
+    exactly once), and the partials merge with an LSE-weighted psum over
+    the SP axes — the cross-rank generalization of ``_merge_lse``.
+    """
+    sp_axes = (ring_axis, ulysses_axis)
+    is_first = (
+        (jax.lax.axis_index(ring_axis) == 0)
+        & (jax.lax.axis_index(ulysses_axis) == 0)
+    )
+    b = qt.shape[0]
+    s_txt = kt.shape[1]
+    tmask = (jnp.ones((b, s_txt), jnp.int32) if txt_mask is None
+             else txt_mask.astype(jnp.int32))
+    # Text KV participates only on the first SP rank.
+    tmask = tmask * is_first.astype(jnp.int32)
+    k_loc = jnp.concatenate([kt, ki], axis=1)
+    v_loc = jnp.concatenate([vt, vi], axis=1)
+    mask = jnp.concatenate(
+        [tmask, jnp.ones((b, ki.shape[1]), jnp.int32)], axis=1
+    )
+    o_p, lse_p = flash_attention(
+        qt, k_loc, v_loc, causal=False, kv_mask=mask, return_lse=True
+    )
+    m = jax.lax.pmax(lse_p, sp_axes)  # [B, H, S_txt]
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w = jnp.exp(lse_p - m_safe)  # [B, H, S]
+    w_o = w.swapaxes(1, 2)[..., None]  # [B, S, H, 1]
+    num = jax.lax.psum(o_p.astype(jnp.float32) * w_o, sp_axes)
+    den = jax.lax.psum(w, sp_axes)
+    den_safe = jnp.where(den == 0.0, 1.0, den)
+    return (num / den_safe.swapaxes(1, 2)[..., None]).astype(qt.dtype)
+
+
+def joint_sp_attention(
+    qi, ki, vi,  # image stream [B, S_img/sp, H, D], seq sharded
+    qt, kt, vt,  # text stream [B, S_txt, H, D], replicated
+    txt_mask: Optional[jax.Array] = None,  # [B, S_txt]
+    ulysses_axis: str = "ulysses",
+    ring_axis: str = "ring",
+):
+    """Joint text+image DiT attention under USP sequence parallelism.
+
+    Returns (img_o, txt_o) — the ``attn_fn`` contract of
+    ``qwen_image.transformer.block_forward``.  Image queries run USP
+    (ulysses all_to_all + ring KV rotation) with the replicated text KV as
+    the joint prefix; text queries use partial-LSE merging across the SP
+    shards (reference semantics: ulysses.py:33-39, ring.py:38-45).
+    """
+    img_o = usp_attention(
+        qi, ki, vi, ulysses_axis=ulysses_axis, ring_axis=ring_axis,
+        joint_k=kt, joint_v=vt, joint_mask=txt_mask,
+    )
+    txt_o = _text_stream_attention(
+        qt, kt, vt, ki, vi, txt_mask, ulysses_axis, ring_axis
+    )
+    return img_o, txt_o
+
+
 def usp_attention(
     q: jax.Array,  # [B, S_local, H, D]; seq sharded over (ring, ulysses)
     k: jax.Array,

@@ -82,6 +82,46 @@ def with_sharding(x: jax.Array, sharding: Optional[NamedSharding]) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, sharding)
 
 
+# Tensor-parallel layout for DiT weight trees (reference: TP linears in
+# diffusion parallel_state.py:768-775): QKV/up projections are
+# column-parallel (output dim over tp), output/down projections
+# row-parallel (input dim over tp). GSPMD propagates the activation
+# shardings and inserts the row-parallel psums.
+DIT_TP_COL = frozenset({
+    "to_q", "to_k", "to_v", "add_q", "add_k", "add_v",
+    "img_mlp1", "txt_mlp1", "img_mod", "txt_mod",
+})
+DIT_TP_ROW = frozenset({"to_out", "to_add_out", "img_mlp2", "txt_mlp2"})
+
+
+def dit_param_spec(path: tuple[str, ...]) -> P:
+    """PartitionSpec for one DiT weight-tree leaf, addressed by its tree
+    path.  Matrix weights ("w") of attention/MLP projections split over
+    the tp axis; everything else (biases, norms, embeddings) replicates."""
+    leaf = path[-1] if path else ""
+    parent = path[-2] if len(path) >= 2 else ""
+    if leaf == "w" and parent in DIT_TP_COL:
+        return P(None, AXIS_TP)
+    if leaf == "w" and parent in DIT_TP_ROW:
+        return P(AXIS_TP, None)
+    return P()
+
+
+def shard_dit_params(params, mesh: Mesh):
+    """Place a DiT param tree on the mesh with the TP layout above."""
+
+    def place(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: place(v, path + (k,)) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [place(v, path + (str(i),)) for i, v in enumerate(tree)]
+        return jax.device_put(
+            tree, NamedSharding(mesh, dit_param_spec(path))
+        )
+
+    return place(params)
+
+
 def shard_moe_params(params, mesh: Mesh):
     """Place a transformer param tree with MoE expert weights sharded over
     the ``ep`` mesh axis (stacked leading-E axis) and everything else
