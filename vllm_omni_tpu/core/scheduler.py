@@ -41,8 +41,9 @@ class SchedulerConfig:
     max_num_seqs: int = 8
     max_num_batched_tokens: int = 2048
     max_model_len: int = 4096
-    # Off by default: chunk-continuation attention (new chunk attending to
-    # cached KV of earlier chunks) lands with the ragged prefill kernel.
+    # Chunked prefill: prompts longer than the token budget are split into
+    # chunks; later chunks attend the cached KV of earlier ones
+    # (forward_prefill_chunked / the flash kernel's q_offsets path).
     enable_chunked_prefill: bool = False
     kv_transfer: Optional[KVTransferConfig] = None
 
@@ -79,13 +80,6 @@ class SchedulerOutput:
 
 class ARScheduler:
     def __init__(self, config: SchedulerConfig, kv_manager: KVCacheManager):
-        if config.enable_chunked_prefill:
-            # chunk-continuation attention (later chunks attending cached KV
-            # of earlier ones) needs the ragged prefill kernel; honoring the
-            # flag today would silently produce wrong numerics
-            raise NotImplementedError(
-                "enable_chunked_prefill is not supported yet"
-            )
         self.config = config
         self.kv = kv_manager
         self.waiting: list[Request] = []
@@ -164,6 +158,35 @@ class ARScheduler:
             if budget <= 0:
                 still_running.append(req)
                 continue
+            remaining = req.num_tokens - req.num_computed_tokens
+            if remaining > 1:
+                # mid-prefill, or a preempted request recomputing prompt +
+                # generated tokens (num_tokens, not num_prompt_tokens — a
+                # resumed request chunks through its generated suffix too
+                # instead of crawling it one decode step at a time):
+                # schedule the next chunk rather than a decode token
+                chunk = min(remaining, budget)
+                if not self.kv.can_allocate(req, chunk):
+                    out.preempted.extend(
+                        self._preempt_for(req, snapshot[i + 1:], chunk)
+                    )
+                if not self.kv.can_allocate(req, chunk):
+                    self._preempt(req)
+                    out.preempted.append(req)
+                    continue
+                table = self.kv.allocate(req, chunk)
+                if table is None:
+                    self._preempt(req)
+                    out.preempted.append(req)
+                    continue
+                slots = self.kv.slot_mapping(req, chunk)
+                out.prefills.append(ScheduledRequest(
+                    request=req, num_new_tokens=chunk, slot_mapping=slots,
+                    block_table=table, start_pos=req.num_computed_tokens,
+                ))
+                budget -= chunk
+                still_running.append(req)
+                continue
             if not self.kv.can_allocate(req, 1):
                 # victims come only from *unscheduled* requests (later in
                 # the priority order) — preempting one already in
@@ -230,7 +253,7 @@ class ARScheduler:
         self.waiting.insert(0, req)
 
     def _preempt_for(
-        self, req: Request, candidates: list[Request]
+        self, req: Request, candidates: list[Request], num_tokens: int = 1
     ) -> list[Request]:
         """Preempt newest-first from ``candidates`` until ``req`` fits;
         returns the victims (possibly insufficient — caller rechecks)."""
@@ -240,7 +263,7 @@ class ARScheduler:
                 continue
             self._preempt(victim)
             preempted.append(victim)
-            if self.kv.can_allocate(req, 1):
+            if self.kv.can_allocate(req, num_tokens):
                 break
         return preempted
 

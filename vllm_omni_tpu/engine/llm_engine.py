@@ -42,6 +42,7 @@ class EngineConfig:
     max_num_seqs: int = 8
     max_num_batched_tokens: int = 2048
     worker_type: str = "ar"  # "ar" | "generation"
+    enable_chunked_prefill: bool = False
     dtype: Any = jnp.bfloat16
     kv_transfer: Optional[KVTransferConfig] = None
     collect_hidden: bool = False
@@ -60,6 +61,7 @@ class LLMEngine:
             max_num_seqs=config.max_num_seqs,
             max_num_batched_tokens=config.max_num_batched_tokens,
             max_model_len=config.max_model_len,
+            enable_chunked_prefill=config.enable_chunked_prefill,
             kv_transfer=config.kv_transfer,
         )
         sched_cls = (GenerationScheduler if config.worker_type == "generation"

@@ -208,6 +208,48 @@ def _mlp(layer, cfg: TransformerConfig, x):
     return nn.linear(layer["down"], silu_mul(nn.linear(layer["gate_up"], x)))
 
 
+def _embed_input(params, token_ids, inputs_embeds, embeds_mask):
+    """Token embedding, or upstream-stage hidden states as inputs.
+
+    ``inputs_embeds`` replaces the embedding lookup — the embeds-as-input
+    path a downstream stage uses to consume upstream hidden states
+    (reference: OmniGPUModelRunner._preprocess override,
+    worker/gpu_model_runner.py:925).  An optional ``embed_proj`` adapts a
+    different upstream width (reference: the talker projects thinker
+    hidden states, models/qwen3_omni/qwen3_omni_moe_talker.py).
+    ``embeds_mask`` selects per position: True rows take (projected)
+    embeds, False rows the token embedding — needed when a preempted
+    embeds request re-prefills prompt *and* generated tokens, whose
+    embeddings come from the table.
+    """
+    if inputs_embeds is None:
+        return nn.embedding(params["embed"], token_ids)
+    x = inputs_embeds
+    if "embed_proj" in params:
+        x = nn.linear(params["embed_proj"], x)
+    if embeds_mask is not None:
+        tok = nn.embedding(params["embed"], token_ids)
+        x = jnp.where(embeds_mask[..., None], x, tok)
+    return x
+
+
+def _layer_step(layer, cfg: TransformerConfig, x, cos, sin, attend):
+    """One transformer block: norm → qkv+rope → ``attend`` → residual →
+    norm → MLP → residual.  ``attend(q, k, v)`` supplies the attention
+    variant (dense causal / cached-context chunked / paged decode) and
+    returns o with leading dims matching ``x``'s.  One body serves every
+    forward so the variants cannot silently diverge."""
+    b = x.shape[:-1]
+    h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+    q, k, v = _qkv(layer, cfg, h.reshape(-1, h.shape[-1]))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    o = attend(q, k, v)
+    x = x + o.reshape(*b, -1) @ layer["o_proj"]["w"]
+    h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
+    return x + _mlp(layer, cfg, h)
+
+
 def forward_hidden(
     params,
     cfg: TransformerConfig,
@@ -218,27 +260,23 @@ def forward_hidden(
     """Full-sequence causal forward returning final hidden states
     [B, S, hidden] (the text-encoder path; also prefill without cache)."""
     b, s = token_ids.shape
-    x = inputs_embeds if inputs_embeds is not None else nn.embedding(params["embed"], token_ids)
+    x = _embed_input(params, token_ids, inputs_embeds, None)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     cos, sin = compute_rope_freqs(
         positions.reshape(-1), cfg.head_dim, cfg.rope_theta
     )
-    for layer in params["layers"]:
-        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
-        h2 = h.reshape(b * s, -1)
-        q, k, v = _qkv(layer, cfg, h2)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        o = flash_attention(
+
+    def attend(q, k, v):
+        return flash_attention(
             q.reshape(b, s, cfg.num_heads, cfg.head_dim),
             k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
             v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
             causal=True,
         )
-        x = x + o.reshape(b, s, -1) @ layer["o_proj"]["w"]
-        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
-        x = x + _mlp(layer, cfg, h)
+
+    for layer in params["layers"]:
+        x = _layer_step(layer, cfg, x, cos, sin, attend)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
 
 
@@ -258,53 +296,91 @@ def forward_prefill(
     inputs_embeds: Optional[jax.Array] = None,  # [B, S, embed_width]
     embeds_mask: Optional[jax.Array] = None,  # [B, S] bool: row uses embeds
 ):
-    """Prefill: causal attention within the prompt, writing KV pages.
-
-    ``inputs_embeds`` replaces the embedding lookup — the embeds-as-input
-    path a downstream stage uses to consume upstream hidden states
-    (reference: OmniGPUModelRunner._preprocess override,
-    worker/gpu_model_runner.py:925).  ``embeds_mask`` selects per position:
-    True rows take (projected) embeds, False rows take the token embedding —
-    needed when a preempted embeds request re-prefills prompt *and* its
-    generated tokens, whose embeddings come from the table.
+    """Prefill: causal attention within the prompt, writing KV pages
+    (embeds-as-input handling: see ``_embed_input``).
 
     Returns (hidden [B, S, hidden], new kv_caches).
     """
     b, s = token_ids.shape
-    if inputs_embeds is not None:
-        x = inputs_embeds
-        # upstream-stage hidden states may live in a different width; an
-        # optional input projection adapts them (reference: the talker
-        # projects thinker hidden states before its layer stack,
-        # models/qwen3_omni/qwen3_omni_moe_talker.py)
-        if "embed_proj" in params:
-            x = nn.linear(params["embed_proj"], x)
-        if embeds_mask is not None:
-            tok = nn.embedding(params["embed"], token_ids)
-            x = jnp.where(embeds_mask[..., None], x, tok)
-    else:
-        x = nn.embedding(params["embed"], token_ids)
+    x = _embed_input(params, token_ids, inputs_embeds, embeds_mask)
     cos, sin = compute_rope_freqs(
         positions.reshape(-1), cfg.head_dim, cfg.rope_theta
     )
     flat_slots = slot_mapping.reshape(-1)
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
-        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
-        q, k, v = _qkv(layer, cfg, h.reshape(b * s, -1))
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        k_cache, v_cache = write_kv_cache(k_cache, v_cache, k, v, flat_slots)
-        new_caches.append((k_cache, v_cache))
-        o = flash_attention(
-            q.reshape(b, s, cfg.num_heads, cfg.head_dim),
-            k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
-            v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
-            causal=True,
-        )
-        x = x + o.reshape(b, s, -1) @ layer["o_proj"]["w"]
-        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
-        x = x + _mlp(layer, cfg, h)
+        def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):
+            k_cache, v_cache = write_kv_cache(
+                k_cache, v_cache, k, v, flat_slots
+            )
+            new_caches.append((k_cache, v_cache))
+            return flash_attention(
+                q.reshape(b, s, cfg.num_heads, cfg.head_dim),
+                k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+                v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+                causal=True,
+            )
+
+        x = _layer_step(layer, cfg, x, cos, sin, attend)
+    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
+
+
+def forward_prefill_chunked(
+    params,
+    cfg: TransformerConfig,
+    token_ids: jax.Array,  # [B, S] chunk tokens (right-padded)
+    positions: jax.Array,  # [B, S] global positions
+    kv_caches: list,
+    slot_mapping: jax.Array,  # [B, S] flat slots (-1 for padding)
+    block_tables: jax.Array,  # [B, max_pages] page ids covering the context
+    context_lens: jax.Array,  # [B] prefix + chunk length
+    q_starts: jax.Array,  # [B] global position of the chunk's first token
+    inputs_embeds: Optional[jax.Array] = None,
+    embeds_mask: Optional[jax.Array] = None,
+):
+    """Prefill continuation: a chunk attends the cached KV of earlier
+    chunks plus itself causally (chunked prefill — the capability the
+    reference inherits from vLLM's scheduler and the r1 scheduler left as
+    NotImplementedError).
+
+    The chunk's KV is written to the paged cache first, then each layer
+    gathers the full context ``[B, ctx, Hkv, D]`` through ``block_tables``
+    and runs flash attention with per-sequence query offsets
+    (``q_starts``) so query i attends keys at positions <= q_starts+i.
+    Peak memory is O(B*ctx*Hkv*D) per layer — pages, never O(S²).
+
+    Returns (hidden [B, S, hidden], new kv_caches).
+    """
+    b, s = token_ids.shape
+    hkv, _, page_size, d = kv_caches[0][0].shape
+    x = _embed_input(params, token_ids, inputs_embeds, embeds_mask)
+    cos, sin = compute_rope_freqs(
+        positions.reshape(-1), cfg.head_dim, cfg.rope_theta
+    )
+    flat_slots = slot_mapping.reshape(-1)
+    ctx_width = block_tables.shape[1] * page_size
+    kv_mask = (jnp.arange(ctx_width)[None, :]
+               < context_lens[:, None]).astype(jnp.int32)
+    new_caches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):
+            k_cache, v_cache = write_kv_cache(
+                k_cache, v_cache, k, v, flat_slots
+            )
+            new_caches.append((k_cache, v_cache))
+            # gather context pages: [Hkv, B, P, page, D] -> [B, ctx, Hkv, D]
+            kg = jnp.transpose(
+                k_cache[:, block_tables], (1, 2, 3, 0, 4)
+            ).reshape(b, ctx_width, hkv, d)
+            vg = jnp.transpose(
+                v_cache[:, block_tables], (1, 2, 3, 0, 4)
+            ).reshape(b, ctx_width, hkv, d)
+            return flash_attention(
+                q.reshape(b, s, cfg.num_heads, cfg.head_dim), kg, vg,
+                causal=True, kv_mask=kv_mask, q_offsets=q_starts,
+            )
+
+        x = _layer_step(layer, cfg, x, cos, sin, attend)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
 
 
@@ -322,19 +398,18 @@ def forward_decode(
 
     Returns (hidden [B, hidden], new kv_caches).
     """
-    b = token_ids.shape[0]
     x = nn.embedding(params["embed"], token_ids)  # [B, hidden]
     cos, sin = compute_rope_freqs(positions, cfg.head_dim, cfg.rope_theta)
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
-        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
-        q, k, v = _qkv(layer, cfg, h)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        k_cache, v_cache = write_kv_cache(k_cache, v_cache, k, v, slot_mapping)
-        new_caches.append((k_cache, v_cache))
-        o = paged_attention(q, k_cache, v_cache, block_tables, context_lens)
-        x = x + o.reshape(b, -1) @ layer["o_proj"]["w"]
-        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
-        x = x + _mlp(layer, cfg, h)
+        def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):
+            k_cache, v_cache = write_kv_cache(
+                k_cache, v_cache, k, v, slot_mapping
+            )
+            new_caches.append((k_cache, v_cache))
+            return paged_attention(
+                q, k_cache, v_cache, block_tables, context_lens
+            )
+
+        x = _layer_step(layer, cfg, x, cos, sin, attend)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches

@@ -44,6 +44,7 @@ def attention_ref(
     scale: Optional[float] = None,
     return_lse: bool = False,
     kv_mask: Optional[jax.Array] = None,  # [B, Skv]
+    q_offsets: Optional[jax.Array] = None,  # [B] per-seq q position offset
 ):
     """Pure-JAX reference with identical semantics (fp32 softmax)."""
     b, sq, h, d = q.shape
@@ -59,8 +60,14 @@ def attention_ref(
     if causal:
         qi = jnp.arange(sq)[:, None]
         ki = jnp.arange(k.shape[1])[None, :]
-        offset = k.shape[1] - sq  # q positions align to the KV suffix
-        s = jnp.where(qi + offset >= ki, s, _NEG_INF)
+        if q_offsets is not None:
+            # chunked prefill: query i of sequence b sits at global
+            # position q_offsets[b] + i, keys at 0..Skv
+            cm = qi[None] + q_offsets[:, None, None] >= ki[None]
+            s = jnp.where(cm[:, None], s, _NEG_INF)
+        else:
+            offset = k.shape[1] - sq  # q positions align to the KV suffix
+            s = jnp.where(qi + offset >= ki, s, _NEG_INF)
     if kv_mask is not None:
         s = jnp.where(kv_mask[:, None, None, :] > 0, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
@@ -83,6 +90,7 @@ def attention_xla(
     return_lse: bool = False,
     kv_mask: Optional[jax.Array] = None,  # [B, Skv]
     block_k: int = 512,
+    q_offsets: Optional[jax.Array] = None,  # [B]
 ):
     """Blockwise XLA attention: lax.scan over KV blocks with online
     softmax.  Peak memory is O(B*H*Sq*block_k) — never the full [Sq, Skv]
@@ -126,11 +134,16 @@ def attention_xla(
         if kv_mask is not None:
             mask = mask & (m_blk[:, None, None, None, :] > 0)
         if causal:
-            mask = mask & (
-                (q_idx[:, None] + causal_offset >= k_pos[None, :])[
-                    None, None, None, :, :
-                ]
-            )
+            if q_offsets is not None:
+                cm = (q_idx[None, :, None] + q_offsets[:, None, None]
+                      >= k_pos[None, None, :])  # [B, Sq, block_k]
+                mask = mask & cm[:, None, None]
+            else:
+                mask = mask & (
+                    (q_idx[:, None] + causal_offset >= k_pos[None, :])[
+                        None, None, None, :, :
+                    ]
+                )
         s = jnp.where(mask, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -151,6 +164,8 @@ def attention_xla(
     z = k.astype(jnp.float32).reshape(-1)[0] * 0.0
     if kv_mask is not None:
         z = z + kv_mask.astype(jnp.float32).reshape(-1)[0] * 0.0
+    if q_offsets is not None:
+        z = z + q_offsets.astype(jnp.float32).reshape(-1)[0] * 0.0
     acc0 = jnp.zeros_like(qf).transpose(0, 2, 3, 1, 4) + z  # [B,Hkv,g,Sq,D]
     init = (acc0[..., 0] + _NEG_INF, acc0[..., 0], acc0)
     (m, l, acc), _ = jax.lax.scan(
@@ -171,6 +186,7 @@ def _flash_core(
     k_ref,
     v_ref,
     mask_ref,  # full [B, Skv] (tiny; whole array in VMEM) or None
+    qoff_ref,  # [B, 1] int32 in VMEM (per-seq q position offset) or None
     m_scr,
     l_scr,
     acc_scr,
@@ -195,11 +211,22 @@ def _flash_core(
 
     q_start = qi * block_q
     k_start = ki * block_k
+    # batch row for per-sequence refs; bound OUTSIDE pl.when bodies —
+    # program_id inside a traced-predicate pl.when fails to lower in
+    # interpret mode
+    b_idx = pl.program_id(0) // num_q_heads
+
+    # Per-sequence offset (chunked prefill: queries of sequence b start at
+    # global position qoff[b]) or the static suffix alignment.
+    if qoff_ref is not None:
+        offset = qoff_ref[b_idx, 0]
+    else:
+        offset = causal_offset
 
     # Skip KV blocks fully above the causal diagonal.
     run = True
     if causal:
-        run = k_start <= q_start + block_q - 1 + causal_offset
+        run = k_start <= q_start + block_q - 1 + offset
 
     @pl.when(run)
     def _compute():
@@ -218,7 +245,6 @@ def _flash_core(
             # indexing, which Mosaic supports at any offset. A dynamic
             # pl.ds(k_start, ...) lane slice would require 128-aligned
             # starts and fails to compile for tail block sizes.
-            b_idx = pl.program_id(0) // num_q_heads
             mrow = mask_ref[b_idx, :]
             # Out-of-range reads in a partial tail block are undefined but
             # already excluded by the kv_len term of `mask`.
@@ -227,7 +253,7 @@ def _flash_core(
             q_idx = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            mask = mask & (q_idx + causal_offset >= k_idx)
+            mask = mask & (q_idx + offset >= k_idx)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]
@@ -270,17 +296,24 @@ def _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr):
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _mk_kernel(with_lse: bool, with_mask: bool, **cfg):
+def _mk_kernel(with_lse: bool, with_mask: bool, with_qoff: bool = False, **cfg):
     def kernel(*refs):
-        i = 3 + (1 if with_mask else 0)
+        i = 3 + (1 if with_mask else 0) + (1 if with_qoff else 0)
         q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
-        mask_ref = refs[3] if with_mask else None
+        j = 3
+        mask_ref = qoff_ref = None
+        if with_mask:
+            mask_ref = refs[j]
+            j += 1
+        if with_qoff:
+            qoff_ref = refs[j]
         outs = refs[i : i + 1 + (1 if with_lse else 0)]
         o_ref = outs[0]
         lse_ref = outs[1] if with_lse else None
         m_scr, l_scr, acc_scr = refs[-3], refs[-2], refs[-1]
         _flash_core(
-            q_ref, k_ref, v_ref, mask_ref, m_scr, l_scr, acc_scr, **cfg
+            q_ref, k_ref, v_ref, mask_ref, qoff_ref, m_scr, l_scr, acc_scr,
+            **cfg
         )
         _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
 
@@ -299,7 +332,8 @@ def _mk_kernel(with_lse: bool, with_mask: bool, **cfg):
     ),
 )
 def _flash_attention(
-    q, k, v, kv_mask, causal, scale, return_lse, block_q, block_k, use_pallas
+    q, k, v, kv_mask, causal, scale, return_lse, block_q, block_k,
+    use_pallas, q_offsets=None,
 ):
     b, sq, h, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
@@ -310,7 +344,8 @@ def _flash_attention(
         # ever materializing the [Sq, Skv] score matrix (VERDICT weak#2 —
         # the O(S²) ref path OOM'd at video sequence lengths).
         return attention_xla(
-            q, k, v, causal, scale, return_lse, kv_mask, block_k=block_k
+            q, k, v, causal, scale, return_lse, kv_mask, block_k=block_k,
+            q_offsets=q_offsets,
         )
 
     group = h // hkv
@@ -349,6 +384,15 @@ def _flash_attention(
             )
         )
         inputs.append(kv_mask.astype(jnp.int32))
+    if q_offsets is not None:
+        # whole [B, 1] array in VMEM (tiny); batch row picked dynamically
+        # via sublane indexing, same pattern as the kv_mask spec above
+        in_specs.append(
+            pl.BlockSpec(
+                (b, 1), lambda bh, qi, ki: (0, 0), memory_space=pltpu.VMEM
+            )
+        )
+        inputs.append(q_offsets.astype(jnp.int32).reshape(b, 1))
 
     out_specs = [q_spec]
     out_shapes = [jax.ShapeDtypeStruct((b * h, nq * block_q, d), q.dtype)]
@@ -367,6 +411,7 @@ def _flash_attention(
     kernel = _mk_kernel(
         return_lse,
         kv_mask is not None,
+        q_offsets is not None,
         scale=scale,
         causal=causal,
         kv_len=skv,
@@ -411,13 +456,19 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 256,
     use_pallas: Optional[bool] = None,
+    q_offsets: Optional[jax.Array] = None,
 ):
-    """Flash attention over [B, S, H, D] tensors (GQA via Hkv | H)."""
+    """Flash attention over [B, S, H, D] tensors (GQA via Hkv | H).
+
+    ``q_offsets`` [B] gives each sequence's global position of query row 0
+    (chunked prefill: the chunk attends cached-prefix keys at 0..offset-1
+    plus itself causally); overrides the static suffix alignment.
+    """
     if use_pallas is None:
         from vllm_omni_tpu.ops._dispatch import pallas_mode
 
         use_pallas = pallas_mode() == "native"
     return _flash_attention(
         q, k, v, kv_mask, causal, scale, return_lse, block_q, block_k,
-        use_pallas,
+        use_pallas, q_offsets,
     )

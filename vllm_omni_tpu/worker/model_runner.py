@@ -115,6 +115,21 @@ class ARModelRunner:
             return logits, last_hidden, hidden, new_caches
 
         @functools.partial(jax.jit, donate_argnums=(2,))
+        def _chunk_prefill(params, token_ids, kv_caches, positions,
+                           slot_mapping, last_idx, block_tables,
+                           context_lens, q_starts, inputs_embeds=None,
+                           embeds_mask=None):
+            hidden, new_caches = tfm.forward_prefill_chunked(
+                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
+                block_tables, context_lens, q_starts,
+                inputs_embeds=inputs_embeds, embeds_mask=embeds_mask,
+            )
+            b = token_ids.shape[0]
+            last_hidden = hidden[jnp.arange(b), last_idx]
+            logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
+            return logits, last_hidden, hidden, new_caches
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
         def _decode(params, token_ids, kv_caches, positions, slot_mapping,
                     block_tables, context_lens):
             hidden, new_caches = tfm.forward_decode(
@@ -125,6 +140,7 @@ class ARModelRunner:
             return logits, hidden, new_caches
 
         self._prefill_fn = _prefill
+        self._chunk_prefill_fn = _chunk_prefill
         self._decode_fn = _decode
         # width of upstream embeds accepted by this model: the embed_proj
         # input dim when present (thinker width for the talker), else the
@@ -143,17 +159,23 @@ class ARModelRunner:
         if sched_out.decodes:
             self._run_decode(sched_out.decodes, out)
         if sched_out.prefills:
-            # embeds-as-input prefills (downstream stages consuming upstream
-            # hidden states) run as a separate padded batch — the jit
-            # signature differs by the inputs_embeds operand
-            with_embeds = [s for s in sched_out.prefills
-                           if s.request.prompt_embeds is not None]
-            token_only = [s for s in sched_out.prefills
-                          if s.request.prompt_embeds is None]
-            if token_only:
-                self._run_prefill(token_only, out)
-            if with_embeds:
-                self._run_prefill(with_embeds, out, use_embeds=True)
+            # Three-way split: continuation chunks (cached prefix; the
+            # chunked kernel gathers context pages) run separately from
+            # fresh prefills, and embeds-as-input prefills (downstream
+            # stages consuming upstream hidden states) run as a separate
+            # padded batch — the jit signature differs per variant.
+            fresh = [s for s in sched_out.prefills if s.start_pos == 0]
+            cont = [s for s in sched_out.prefills if s.start_pos > 0]
+            for group, runner in ((fresh, self._run_prefill),
+                                  (cont, self._run_chunk_prefill)):
+                with_embeds = [s for s in group
+                               if s.request.prompt_embeds is not None]
+                token_only = [s for s in group
+                              if s.request.prompt_embeds is None]
+                if token_only:
+                    runner(token_only, out)
+                if with_embeds:
+                    runner(with_embeds, out, use_embeds=True)
         for req, block_ids, seq_len in sched_out.kv_transfer_requests:
             # skip the device→host gather when no sink consumes it, but
             # still ACK so the scheduler releases the pinned pages
@@ -167,6 +189,19 @@ class ARModelRunner:
     # ------------------------------------------------------------- prefill
     def _run_prefill(self, scheds: list[ScheduledRequest], out: RunnerOutput,
                      use_embeds: bool = False):
+        self._prefill_common(scheds, out, use_embeds, cont=False)
+
+    def _run_chunk_prefill(self, scheds: list[ScheduledRequest],
+                           out: RunnerOutput, use_embeds: bool = False):
+        """Later chunks of a chunked prefill: the chunk attends the cached
+        KV of earlier chunks through its block table."""
+        self._prefill_common(scheds, out, use_embeds, cont=True)
+
+    def _prefill_common(self, scheds: list[ScheduledRequest],
+                        out: RunnerOutput, use_embeds: bool, cont: bool):
+        """Shared padded-batch assembly for fresh prefills and chunk
+        continuations; ``cont`` adds the block-table/context/q-start
+        operands the cached-context kernel needs."""
         b = _bucket(len(scheds), self._batch_buckets)
         max_n = max(s.num_new_tokens for s in scheds)
         s_len = _bucket(max_n, self._seq_buckets)
@@ -178,6 +213,13 @@ class ARModelRunner:
         embeds = (np.zeros((b, s_len, self.embeds_width), np.float32)
                   if use_embeds else None)
         embeds_mask = np.zeros((b, s_len), bool) if use_embeds else None
+        if cont:
+            max_ctx = max(s.start_pos + s.num_new_tokens for s in scheds)
+            ctx_bucket = _bucket(max_ctx, self._seq_buckets)
+            pages = -(-ctx_bucket // self.page_size)
+            tables = np.zeros((b, pages), np.int32)
+            ctx = np.zeros((b,), np.int32)
+            q_starts = np.zeros((b,), np.int32)
         for i, sc in enumerate(scheds):
             n = sc.num_new_tokens
             toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + n]
@@ -185,6 +227,11 @@ class ARModelRunner:
             positions[i, :n] = np.arange(sc.start_pos, sc.start_pos + n)
             slots[i, :n] = sc.slot_mapping
             last_idx[i] = n - 1
+            if cont:
+                t = sc.block_table[:pages]
+                tables[i, : len(t)] = t
+                ctx[i] = sc.start_pos + n
+                q_starts[i] = sc.start_pos
             if use_embeds:
                 # embeds cover prompt rows only; a recompute-resumed request
                 # also re-prefills its generated tokens, which embed from
@@ -195,14 +242,26 @@ class ARModelRunner:
                 embeds[i, : hi - lo] = pe[lo:hi]
                 embeds_mask[i, : hi - lo] = True
 
-        logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
-            self.params, jnp.asarray(token_ids), self.kv_caches,
-            jnp.asarray(positions), jnp.asarray(slots),
-            jnp.asarray(last_idx),
+        embeds_args = (
             (jnp.asarray(embeds, dtype=self.params_dtype)
              if use_embeds else None),
             jnp.asarray(embeds_mask) if use_embeds else None,
         )
+        if cont:
+            logits, last_hidden, hidden, self.kv_caches = (
+                self._chunk_prefill_fn(
+                    self.params, jnp.asarray(token_ids), self.kv_caches,
+                    jnp.asarray(positions), jnp.asarray(slots),
+                    jnp.asarray(last_idx), jnp.asarray(tables),
+                    jnp.asarray(ctx), jnp.asarray(q_starts), *embeds_args,
+                )
+            )
+        else:
+            logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
+                self.params, jnp.asarray(token_ids), self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(last_idx), *embeds_args,
+            )
         self._sample_and_record(scheds, logits, last_hidden, out,
                                 full_hidden=hidden)
 
