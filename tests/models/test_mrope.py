@@ -1,0 +1,182 @@
+"""MRoPE: multimodal 3D position computation + engine integration
+(VERDICT r1 missing#3 / next-step #4; reference:
+model_executor/layers/rotary_embedding/mrope.py:25,
+qwen3_omni_moe_thinker.py:1193 get_mrope_input_positions).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.models.common.mrope import (
+    MMItem,
+    compute_mrope_positions,
+    expand_placeholders,
+)
+from vllm_omni_tpu.ops import compute_mrope_freqs, compute_rope_freqs
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+# ------------------------------------------------------- position math
+def test_text_only_positions_are_1d():
+    pos, delta = compute_mrope_positions(5)
+    np.testing.assert_array_equal(pos, np.broadcast_to(np.arange(5), (3, 5)))
+    assert delta == 0
+
+
+def test_image_positions():
+    # 2 text tokens, then a 2x3 image (6 tokens), then 1 text token
+    items = [MMItem("image", offset=2, grid=(1, 2, 3))]
+    pos, delta = compute_mrope_positions(9, items)
+    # text prefix
+    np.testing.assert_array_equal(pos[:, :2], [[0, 1]] * 3)
+    # image: t stays at 2; h enumerates rows; w enumerates cols
+    np.testing.assert_array_equal(pos[0, 2:8], [2] * 6)
+    np.testing.assert_array_equal(pos[1, 2:8], [2, 2, 2, 3, 3, 3])
+    np.testing.assert_array_equal(pos[2, 2:8], [2, 3, 4, 2, 3, 4])
+    # trailing text clears max(h=2, w=3) -> base 2+3=5
+    np.testing.assert_array_equal(pos[:, 8], [5, 5, 5])
+    # delta: next generated token at 6 while seq index is 9
+    assert delta == 6 - 9
+
+
+def test_video_positions_temporal_scale():
+    items = [MMItem("video", offset=0, grid=(2, 2, 2), t_scale=3)]
+    pos, delta = compute_mrope_positions(8, items)
+    np.testing.assert_array_equal(pos[0], [0, 0, 0, 0, 3, 3, 3, 3])
+    np.testing.assert_array_equal(pos[1], [0, 0, 1, 1, 0, 0, 1, 1])
+    np.testing.assert_array_equal(pos[2], [0, 1, 0, 1, 0, 1, 0, 1])
+    # base advances to max emitted position + 1 = (t-1)*scale + 1 = 4
+    # (the HF/reference get_rope_index convention)
+    assert delta == 4 - 8
+
+
+def test_audio_positions_linear():
+    items = [MMItem("audio", offset=1, grid=(4,))]
+    pos, delta = compute_mrope_positions(6, items)
+    np.testing.assert_array_equal(pos[0], [0, 1, 2, 3, 4, 5])
+    assert (pos[0] == pos[1]).all() and (pos[0] == pos[2]).all()
+    assert delta == 0
+
+
+def test_audio_in_video_shared_timeline():
+    # interleaved: video frame (t_base 0), audio chunk (t_base 0)
+    items = [
+        MMItem("video", offset=0, grid=(1, 2, 2), t_base=0),
+        MMItem("audio", offset=4, grid=(3,), t_base=0),
+    ]
+    pos, _ = compute_mrope_positions(7, items)
+    np.testing.assert_array_equal(pos[0, :4], [0, 0, 0, 0])
+    np.testing.assert_array_equal(pos[0, 4:], [0, 1, 2])  # shared timeline
+
+
+def test_expand_placeholders():
+    IMG, AUD = 900, 901
+    toks = [1, 2, IMG, 3, AUD, 4]
+    out, items = expand_placeholders(
+        toks, {"image": IMG, "audio": AUD},
+        [("image", (1, 2, 2)), ("audio", (3,))],
+    )
+    assert out == [1, 2, IMG, IMG, IMG, IMG, 3, AUD, AUD, AUD, 4]
+    assert items[0].offset == 2 and items[0].num_tokens == 4
+    assert items[1].offset == 7 and items[1].num_tokens == 3
+
+
+# ----------------------------------------------------------- freq math
+def test_mrope_freqs_collapse_to_1d_when_streams_equal():
+    p = jnp.asarray(np.arange(10))
+    cos1, sin1 = compute_rope_freqs(p, 16, theta=1e4)
+    p3 = jnp.broadcast_to(p, (3, 10))
+    cos3, sin3 = compute_mrope_freqs(p3, 16, (3, 3, 2), theta=1e4)
+    np.testing.assert_allclose(np.asarray(cos1), np.asarray(cos3), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sin1), np.asarray(sin3), atol=1e-6)
+
+
+# ------------------------------------------------------- engine parity
+def _mrope_tiny():
+    base = tfm.TransformerConfig.tiny()
+    # head_dim 16 -> half 8 -> sections (4, 2, 2)
+    return tfm.TransformerConfig(
+        vocab_size=base.vocab_size, hidden_size=base.hidden_size,
+        num_layers=base.num_layers, num_heads=base.num_heads,
+        num_kv_heads=base.num_kv_heads, head_dim=base.head_dim,
+        intermediate_size=base.intermediate_size,
+        mrope_sections=(4, 2, 2),
+    )
+
+
+def test_engine_mrope_text_only_matches_1d_rope():
+    """With no multimodal items the 3 streams are identical, so an
+    mrope-enabled engine must produce the same tokens as the 1-D engine
+    (validates the runner's [B,3,S]/[B,3] assembly + _rope_tables)."""
+    cfg1 = tfm.TransformerConfig.tiny()
+    cfg3 = _mrope_tiny()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg1, jnp.float32)
+    prompt = list(np.random.default_rng(0).integers(1, 100, size=19))
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    def run(cfg):
+        eng = LLMEngine(params, cfg, EngineConfig(
+            num_pages=32, page_size=4, max_model_len=64, max_num_seqs=2,
+            dtype=jnp.float32, seed=0))
+        return eng.generate([prompt], sp)[0].outputs[0].token_ids
+
+    assert run(cfg3) == run(cfg1)
+
+
+def test_engine_mrope_positions_change_output():
+    """A request with real mrope positions (image span) must flow through
+    and produce a different (but deterministic) continuation than the
+    text-only position layout."""
+    cfg3 = _mrope_tiny()
+    params = tfm.init_params(jax.random.PRNGKey(1), cfg3, jnp.float32)
+    prompt = list(np.random.default_rng(1).integers(1, 100, size=12))
+    pos, delta = compute_mrope_positions(
+        12, [MMItem("image", offset=3, grid=(1, 2, 3))])
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+    def run(mrope_positions, mrope_delta):
+        eng = LLMEngine(params, cfg3, EngineConfig(
+            num_pages=32, page_size=4, max_model_len=64, max_num_seqs=2,
+            dtype=jnp.float32, seed=0))
+        eng.add_request(prompt, sp, request_id="r",
+                        mrope_positions=mrope_positions,
+                        mrope_delta=mrope_delta)
+        outs = []
+        while eng.has_unfinished_requests:
+            outs.extend(eng.step())
+        return outs[0].outputs[0].token_ids
+
+    with_mm = run(pos, delta)
+    text_only = run(None, 0)
+    assert len(with_mm) == 5
+    # deterministic reruns agree
+    assert run(pos, delta) == with_mm
+    # the image layout actually alters attention geometry
+    assert with_mm != text_only
+
+
+def test_engine_mrope_chunked_prefill_parity():
+    """Chunked prefill must reproduce unchunked output under mrope too."""
+    cfg3 = _mrope_tiny()
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg3, jnp.float32)
+    prompt = list(np.random.default_rng(2).integers(1, 100, size=25))
+    pos, delta = compute_mrope_positions(
+        25, [MMItem("image", offset=5, grid=(1, 3, 3))])
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+    def run(chunked, btok):
+        eng = LLMEngine(params, cfg3, EngineConfig(
+            num_pages=64, page_size=4, max_model_len=128, max_num_seqs=2,
+            max_num_batched_tokens=btok, dtype=jnp.float32, seed=0,
+            enable_chunked_prefill=chunked))
+        eng.add_request(prompt, sp, request_id="r",
+                        mrope_positions=pos, mrope_delta=delta)
+        outs = []
+        while eng.has_unfinished_requests:
+            outs.extend(eng.step())
+        return outs[0].outputs[0].token_ids
+
+    assert run(True, 8) == run(False, 2048)

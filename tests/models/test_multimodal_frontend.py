@@ -1,0 +1,210 @@
+"""Multimodal thinker front ends: audio/vision encoders, the mm processor,
+and the image+audio → thinker→talker→code2wav pipeline e2e (VERDICT r1
+next-step #4; reference: qwen3_omni_moe_thinker.py encoders + processor).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.qwen3_omni import (
+    audio_encoder,
+    multimodal,
+    thinker,
+    vision_encoder,
+)
+from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+
+
+# ------------------------------------------------------------ audio encoder
+def test_audio_encoder_shapes_and_mask():
+    cfg = audio_encoder.AudioEncoderConfig.tiny(out_dim=48)
+    params = audio_encoder.init_params(jax.random.PRNGKey(0), cfg)
+    mel = jax.random.normal(jax.random.PRNGKey(1), (2, 24, cfg.n_mels))
+    out, tok_mask = audio_encoder.forward(params, cfg, mel)
+    assert out.shape == (2, 6, 48)  # 24 frames / 4x downsample
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert np.asarray(tok_mask).all()
+
+
+def test_audio_encoder_padding_invariance():
+    """Padded frames (masked) must not change valid-token outputs."""
+    cfg = audio_encoder.AudioEncoderConfig.tiny(out_dim=32)
+    params = audio_encoder.init_params(jax.random.PRNGKey(0), cfg)
+    mel = jax.random.normal(jax.random.PRNGKey(1), (1, 16, cfg.n_mels))
+    out_a, _ = audio_encoder.forward(params, cfg, mel)
+    # pad to 32 frames with garbage, mask the tail
+    pad = jnp.full((1, 16, cfg.n_mels), 1e3)
+    mel_p = jnp.concatenate([mel, pad], axis=1)
+    mask = jnp.concatenate(
+        [jnp.ones((1, 16), jnp.int32), jnp.zeros((1, 16), jnp.int32)], axis=1
+    )
+    out_b, tok_mask = audio_encoder.forward(params, cfg, mel_p, mask)
+    np.testing.assert_allclose(
+        np.asarray(out_a[0]), np.asarray(out_b[0, :4]), atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_array_equal(np.asarray(tok_mask[0]), [1] * 4 + [0] * 4)
+
+
+def test_log_mel_shapes():
+    wav = np.sin(np.linspace(0, 440 * 2 * np.pi, 16000)).astype(np.float32)
+    mel = log_mel_spectrogram(wav, sr=16000, n_mels=16)
+    assert mel.ndim == 2 and mel.shape[1] == 16
+    assert np.all(np.isfinite(mel))
+
+
+# ----------------------------------------------------------- vision encoder
+def test_vision_encoder_shapes_and_grid():
+    cfg = vision_encoder.VisionEncoderConfig.tiny(out_dim=48)
+    params = vision_encoder.init_params(jax.random.PRNGKey(0), cfg)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 24, 3))
+    out = vision_encoder.forward(params, cfg, img)
+    gh, gw = cfg.grid(16, 24)
+    assert (gh, gw) == (2, 3)
+    assert out.shape == (1, 6, 48)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_vision_encoder_rejects_misaligned():
+    cfg = vision_encoder.VisionEncoderConfig.tiny()
+    try:
+        cfg.grid(17, 24)
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+
+
+def test_vision_encoder_position_sensitivity():
+    """2-D rope: permuting image content must change the output — the
+    encoder is not position-blind."""
+    cfg = vision_encoder.VisionEncoderConfig.tiny(out_dim=32)
+    params = vision_encoder.init_params(jax.random.PRNGKey(0), cfg)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16, 3))
+    out_a = vision_encoder.forward(params, cfg, img)
+    out_b = vision_encoder.forward(params, cfg, img[:, ::-1])
+    assert float(jnp.max(jnp.abs(out_a - out_b))) > 1e-5
+
+
+# -------------------------------------------------------------- processor
+def test_mm_processor_builds_embeds_and_positions():
+    params, cfg, _ = thinker.tiny_factory()
+    proc = multimodal.build_tiny_processor(params, cfg)
+    V = cfg.vocab_size
+    img = np.random.default_rng(0).integers(
+        0, 255, size=(16, 24, 3), dtype=np.uint8
+    )
+    wav = np.sin(np.linspace(0, 100, 4000)).astype(np.float32)
+    prompt = [1, 2, V - 3, 3, V - 2, 4]  # text, <image>, text, <audio>, text
+    out = proc(prompt, {"image": [img], "audio": [wav]})
+    # image expands to 2x3=6 tokens; audio to ceil(frames/4)
+    n_img = 6
+    assert out.prompt_token_ids[:4] == [1, 2, V - 3, V - 3]
+    n = len(out.prompt_token_ids)
+    assert out.prompt_embeds.shape == (n, cfg.hidden_size)
+    assert out.mrope_positions.shape == (3, n)
+    # text rows come from the embed table
+    np.testing.assert_allclose(
+        out.prompt_embeds[0], np.asarray(params["embed"]["w"])[1], atol=1e-6
+    )
+    # image rows do NOT (encoder output)
+    tbl = np.asarray(params["embed"]["w"])[V - 3]
+    assert np.abs(out.prompt_embeds[2] - tbl).max() > 1e-4
+    # image h/w streams diverge inside the span
+    span = out.mrope_positions[:, 2:2 + n_img]
+    assert (span[1] != span[2]).any()
+
+
+def test_mm_processor_item_count_mismatch():
+    params, cfg, _ = thinker.tiny_factory()
+    proc = multimodal.build_tiny_processor(params, cfg)
+    V = cfg.vocab_size
+    try:
+        proc([1, V - 3], {"image": []})
+        assert False
+    except ValueError:
+        pass
+    try:
+        proc([1], {"audio": [np.zeros(1000, np.float32)]})
+        assert False
+    except ValueError:
+        pass
+
+
+def test_mm_error_isolated_per_request():
+    """A bad image error-finishes only its own request; batch-mates run
+    (code-review finding: mm failures must not raise out of submit)."""
+    from vllm_omni_tpu.config.stage import StageConfig
+    from vllm_omni_tpu.entrypoints.omni_stage import OmniStage, StageRequest
+
+    cfg = StageConfig(
+        stage_id=0, stage_type="llm",
+        engine_args={
+            "model_factory":
+                "vllm_omni_tpu.models.qwen3_omni.thinker:tiny_factory",
+            "mm_processor":
+                "vllm_omni_tpu.models.qwen3_omni.multimodal:"
+                "build_tiny_processor",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=[-1], final_output=True,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0, "max_tokens": 3},
+    )
+    stage = OmniStage(cfg)
+    V = 128
+    bad_img = np.zeros((10, 10, 3), np.uint8)  # not a multiple of 8
+    good = StageRequest(request_id="good", prompt_token_ids=[1, 2, 3])
+    bad = StageRequest(
+        request_id="bad", prompt_token_ids=[1, V - 3],
+        multi_modal_data={"image": [bad_img]},
+    )
+    stage.submit([good, bad])
+    outs = []
+    while stage.has_unfinished:
+        outs.extend(stage.poll())
+    by_id = {o.request_id: o for o in outs}
+    assert by_id["bad"].is_error
+    assert by_id["bad"].error_kind == "invalid_request"
+    assert not by_id["good"].is_error
+    assert len(by_id["good"].outputs[0].token_ids) == 3
+
+
+# ------------------------------------------------------------------- e2e
+def test_image_audio_pipeline_e2e():
+    """An image+audio prompt flows thinker→talker→code2wav (the VERDICT
+    done-criterion for next-step #4)."""
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    yaml_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "vllm_omni_tpu", "models", "stage_configs",
+        "qwen3_omni_moe_tiny.yaml",
+    )
+    omni = Omni(stage_configs=yaml_path)
+    V = 128
+    img = np.random.default_rng(1).integers(
+        0, 255, size=(16, 16, 3), dtype=np.uint8
+    )
+    wav = np.sin(np.linspace(0, 50, 3000)).astype(np.float32)
+    prompt = {
+        "prompt_token_ids": [1, 2, V - 3, 3, V - 2, 4],
+        "multi_modal_data": {"image": [img], "audio": [wav]},
+    }
+    outs = omni.generate([prompt])
+    by_type = {o.final_output_type: o for o in outs}
+    assert set(by_type) == {"text", "audio"}
+    assert len(by_type["text"].outputs[0].token_ids) == 6
+    assert "hidden_states" in by_type["text"].multimodal_output
+    wav_out = by_type["audio"].multimodal_output["audio"]
+    assert wav_out.shape == (8 * 4,)
+    assert np.all(np.isfinite(wav_out))
+
+    # and the media actually influences generation: different image ->
+    # (deterministically) different thinker continuation is *allowed* but
+    # identical prompts must reproduce identically
+    outs2 = omni.generate([prompt])
+    t2 = {o.final_output_type: o for o in outs2}["text"]
+    assert t2.outputs[0].token_ids == by_type["text"].outputs[0].token_ids

@@ -108,18 +108,24 @@ class ARScheduler:
         elif self.kv.pages_needed(n) > self.kv.num_pages:
             reason = "prompt needs more KV pages than the whole pool"
         if reason is not None:
-            request.status = RequestStatus.FINISHED_ERROR
-            request.additional_information.setdefault("error", reason)
-            # intake rejections are the client's fault -> HTTP 400
-            request.additional_information.setdefault(
-                "error_kind", "invalid_request")
-            self._finished_ids.add(request.request_id)
-            self._errored.append(request)
+            self.reject(request, reason)
             return
         request.status = RequestStatus.WAITING
         if self.config.kv_transfer is not None:
             request.kv_transfer = KVTransferState.PENDING
         self.waiting.append(request)
+
+    def reject(self, request: Request, reason: str,
+               kind: str = "invalid_request") -> None:
+        """Error-finish a request at intake: it surfaces as a FINISHED_ERROR
+        output on the next step() instead of raising into the caller
+        (one bad request must not break its batch-mates)."""
+        request.status = RequestStatus.FINISHED_ERROR
+        request.additional_information.setdefault("error", reason)
+        # invalid_request -> HTTP 400; internal -> 500
+        request.additional_information.setdefault("error_kind", kind)
+        self._finished_ids.add(request.request_id)
+        self._errored.append(request)
 
     def abort_request(self, request_id: str) -> None:
         for queue in (self.waiting, self.running):

@@ -120,6 +120,19 @@ class LLMEngine:
         self.scheduler.add_request(req)
         return request_id
 
+    def add_errored_request(
+        self, request_id: str, reason: str, kind: str = "invalid_request"
+    ) -> str:
+        """Register a request already known to be invalid (e.g. multimodal
+        preprocessing failed) so it surfaces as an error output through the
+        normal step() drain instead of raising into the submitter."""
+        req = Request(
+            request_id=request_id, prompt_token_ids=[],
+            sampling_params=SamplingParams(), arrival_time=time.time(),
+        )
+        self.scheduler.reject(req, reason, kind)
+        return request_id
+
     def abort_request(self, request_id: str) -> None:
         self.scheduler.abort_request(request_id)
 

@@ -41,6 +41,10 @@ class StageRequest:
     sampling_params: dict[str, Any] = field(default_factory=dict)
     prompt_embeds: Optional[Any] = None
     additional_information: dict[str, Any] = field(default_factory=dict)
+    # raw media for multimodal thinker stages: {"image": [...], "audio":
+    # [...]} — run through the stage's mm_processor at submit (reference:
+    # multimodal chat messages -> OmniInputProcessor)
+    multi_modal_data: Optional[dict[str, Any]] = None
 
 
 def _import_obj(path: str):
@@ -80,6 +84,7 @@ class OmniStage:
         self.config = config
         self.stage_id = config.stage_id
         self.tokenizer = None  # set for llm stages in _build_engine
+        self.mm_processor = None  # multimodal front end (set in _build_engine)
         self.engine = self._build_engine()
         self._pending: list[StageRequest] = []
         self._done: list[OmniRequestOutput] = []
@@ -101,6 +106,17 @@ class OmniStage:
                 factory = _import_obj(factory)
             factory_args = args.pop("model_factory_args", {}) or {}
             params, model_cfg, eos = factory(**factory_args)
+            # multimodal front end (thinker stages): factory builds the
+            # encoder+placeholder processor around the model's embed table
+            # (reference: Qwen3OmniMoeThinkerMultiModalProcessor)
+            mm_factory = args.pop("mm_processor", None)
+            if mm_factory is not None:
+                if isinstance(mm_factory, str):
+                    mm_factory = _import_obj(mm_factory)
+                self.mm_processor = mm_factory(
+                    params, model_cfg,
+                    **(args.pop("mm_processor_args", {}) or {}),
+                )
             from vllm_omni_tpu.engine import EngineConfig, LLMEngine
 
             known = EngineConfig.__dataclass_fields__
@@ -146,11 +162,34 @@ class OmniStage:
                 sp = SamplingParams(
                     **{k: v for k, v in sp_kwargs.items() if k in known}
                 )
+                mm_kwargs = {}
+                if r.multi_modal_data and self.mm_processor is not None:
+                    try:
+                        processed = self.mm_processor(
+                            list(r.prompt_token_ids or []),
+                            r.multi_modal_data,
+                        )
+                    except (ValueError, TypeError, KeyError) as e:
+                        # one bad image/audio must not break batch-mates:
+                        # surface as a per-request error output (same
+                        # contract as scheduler intake rejection)
+                        self.engine.add_errored_request(
+                            r.request_id,
+                            f"multimodal processing failed: {e}",
+                        )
+                        continue
+                    r.prompt_token_ids = processed.prompt_token_ids
+                    r.prompt_embeds = processed.prompt_embeds
+                    mm_kwargs = dict(
+                        mrope_positions=processed.mrope_positions,
+                        mrope_delta=processed.mrope_delta,
+                    )
                 self.engine.add_request(
                     list(r.prompt_token_ids or []), sp,
                     request_id=r.request_id,
                     prompt_embeds=r.prompt_embeds,
                     additional_information=dict(r.additional_information),
+                    **mm_kwargs,
                 )
         else:
             self._pending.extend(reqs)

@@ -1,0 +1,163 @@
+"""Multimodal 3D-RoPE position computation (host-side).
+
+The TPU-native counterpart of the reference's OmniMRotaryEmbedding position
+math (reference: model_executor/layers/rotary_embedding/mrope.py:25 — 554
+LoC of image/video/audio/audio-in-video interleave; thinker usage
+qwen3_omni_moe_thinker.py:1193 ``get_mrope_input_positions``).
+
+Positions are three parallel streams (temporal, height, width), one value
+per token.  The behavioral contract:
+
+- **text** tokens advance all three streams together by 1 per token;
+- **image** tokens (grid h×w after spatial merge): temporal stays at the
+  running base, height enumerates rows, width enumerates columns; the base
+  then advances by max(h, w) — so the next text token clears the image's
+  largest spatial extent;
+- **video** tokens (t frames of h×w): like images per frame, with the
+  temporal stream advancing ``t_scale`` per frame (tokens-per-second
+  alignment); base advances by max(t*t_scale, h, w);
+- **audio** tokens: all three streams advance together (audio is purely
+  temporal); base advances by the token count;
+- **audio-in-video**: the caller emits the video chunks and audio chunks
+  as separate interleaved items sharing a ``t_base`` so both modalities
+  ride one timeline (reference: get_updates_use_audio_in_video,
+  qwen3_omni_moe_thinker.py:389).
+
+Everything here is plain numpy on the host — the device only ever sees the
+final [3, T] int32 array (ops/rope.py compute_mrope_freqs applies the
+sectioned frequencies).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MMItem:
+    """One multimodal span inside an (already placeholder-expanded) token
+    sequence."""
+
+    modality: str  # "image" | "video" | "audio"
+    offset: int  # token index where the item's tokens start
+    # image: (1, h, w); video: (t, h, w) — dims AFTER spatial merge;
+    # audio: (n,) token count
+    grid: tuple[int, ...]
+    # temporal scale per video frame (seconds-per-frame * tokens-per-second)
+    t_scale: int = 1
+    # optional shared-timeline override (audio-in-video interleave): the
+    # temporal stream starts at t_base instead of the running base
+    t_base: Optional[int] = None
+
+    @property
+    def num_tokens(self) -> int:
+        if self.modality == "audio":
+            return int(self.grid[0])
+        t, h, w = self.grid
+        return int(t * h * w)
+
+
+def compute_mrope_positions(
+    num_tokens: int,
+    items: Sequence[MMItem] = (),
+) -> tuple[np.ndarray, int]:
+    """Return (positions [3, num_tokens] int32, delta).
+
+    ``delta`` maps generated-token index to its position: a token at
+    sequence index p >= num_tokens sits at position p + delta on all three
+    streams (reference: mrope position delta carried per request).
+    """
+    pos = np.zeros((3, num_tokens), np.int32)
+    items = sorted(items, key=lambda it: it.offset)
+    base = 0  # running position base (shared by the 3 streams for text)
+    idx = 0  # next sequence index to fill
+    for it in items:
+        if it.offset < idx:
+            raise ValueError(
+                f"overlapping multimodal items at offset {it.offset}"
+            )
+        # text run before the item
+        n_text = it.offset - idx
+        if n_text:
+            r = np.arange(base, base + n_text, dtype=np.int32)
+            pos[:, idx:it.offset] = r[None, :]
+            base += n_text
+            idx = it.offset
+        n = it.num_tokens
+        if idx + n > num_tokens:
+            raise ValueError(
+                f"item at offset {it.offset} overruns the sequence "
+                f"({idx + n} > {num_tokens})"
+            )
+        t0 = base if it.t_base is None else it.t_base
+        if it.modality == "audio":
+            r = np.arange(t0, t0 + n, dtype=np.int32)
+            pos[:, idx:idx + n] = r[None, :]
+            base = max(base, t0 + n)
+        elif it.modality in ("image", "video"):
+            t, h, w = it.grid
+            tt = (np.arange(t, dtype=np.int32) * it.t_scale)[:, None, None]
+            hh = np.arange(h, dtype=np.int32)[None, :, None]
+            ww = np.arange(w, dtype=np.int32)[None, None, :]
+            flat_t = np.broadcast_to(tt, (t, h, w)).reshape(-1)
+            flat_h = np.broadcast_to(hh, (t, h, w)).reshape(-1)
+            flat_w = np.broadcast_to(ww, (t, h, w)).reshape(-1)
+            pos[0, idx:idx + n] = t0 + flat_t
+            pos[1, idx:idx + n] = t0 + flat_h
+            pos[2, idx:idx + n] = t0 + flat_w
+            # next base = max emitted position + 1 (the convention the
+            # reference/HF get_rope_index uses): the largest temporal
+            # position is (t-1)*t_scale, not t*t_scale
+            base = max(base, t0 + max((t - 1) * it.t_scale + 1, h, w))
+        else:
+            raise ValueError(f"unknown modality {it.modality!r}")
+        idx += n
+    # trailing text
+    if idx < num_tokens:
+        r = np.arange(base, base + (num_tokens - idx), dtype=np.int32)
+        pos[:, idx:] = r[None, :]
+        base += num_tokens - idx
+    delta = int(base - num_tokens)
+    return pos, delta
+
+
+def expand_placeholders(
+    token_ids: Sequence[int],
+    placeholder_id: dict[str, int],
+    items: Sequence[tuple[str, tuple[int, ...]]],
+) -> tuple[list[int], list[MMItem]]:
+    """Expand single placeholder tokens into per-item token runs.
+
+    ``token_ids`` contains one ``placeholder_id[modality]`` token per
+    multimodal item, in order; ``items`` is the matching (modality, grid)
+    list.  Returns the expanded ids (each placeholder repeated to the
+    item's token count) and the positioned ``MMItem`` list (reference:
+    prompt-update replacement, qwen3_omni_moe_thinker.py:430-536).
+    """
+    id_to_mod = {v: k for k, v in placeholder_id.items()}
+    out: list[int] = []
+    placed: list[MMItem] = []
+    it = iter(items)
+    for tok in token_ids:
+        mod = id_to_mod.get(tok)
+        if mod is None:
+            out.append(int(tok))
+            continue
+        try:
+            want_mod, grid = next(it)
+        except StopIteration:
+            raise ValueError("more placeholder tokens than items") from None
+        if want_mod != mod:
+            raise ValueError(
+                f"placeholder order mismatch: token says {mod!r}, "
+                f"items say {want_mod!r}"
+            )
+        item = MMItem(modality=mod, offset=len(out), grid=tuple(grid))
+        out.extend([int(tok)] * item.num_tokens)
+        placed.append(item)
+    if next(it, None) is not None:
+        raise ValueError("more items than placeholder tokens")
+    return out, placed

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from vllm_omni_tpu.models.common import nn
 from vllm_omni_tpu.ops import (
     apply_rope,
+    compute_mrope_freqs,
     compute_rope_freqs,
     flash_attention,
     paged_attention,
@@ -40,6 +41,10 @@ class TransformerConfig:
     intermediate_size: int = 4096
     rope_theta: float = 1e6
     rms_eps: float = 1e-6
+    # Multimodal 3D-RoPE: when set (3 splits of head_dim//2), positions are
+    # [B, 3, S] (temporal/height/width streams, models/common/mrope.py)
+    # instead of [B, S] (reference: OmniMRotaryEmbedding, mrope.py:25)
+    mrope_sections: Optional[tuple[int, int, int]] = None
     qk_norm: bool = False  # per-head q/k RMSNorm (Qwen3 style)
     attention_bias: bool = False  # q/k/v projection biases (Qwen2 style)
     tie_word_embeddings: bool = False
@@ -208,6 +213,26 @@ def _mlp(layer, cfg: TransformerConfig, x):
     return nn.linear(layer["down"], silu_mul(nn.linear(layer["gate_up"], x)))
 
 
+def _rope_tables(cfg: TransformerConfig, positions):
+    """cos/sin tables from positions, 1-D or multimodal 3-D.
+
+    1-D rope: positions [B, S] (prefill) or [B] (decode).  MRoPE
+    (cfg.mrope_sections set): [B, 3, S] or [B, 3] — the three streams are
+    flattened batch-major to match the activations' [B*S] layout.
+    """
+    if cfg.mrope_sections is None:
+        return compute_rope_freqs(
+            positions.reshape(-1), cfg.head_dim, cfg.rope_theta
+        )
+    if positions.ndim == 3:  # [B, 3, S] -> [3, B*S]
+        p = positions.transpose(1, 0, 2).reshape(3, -1)
+    else:  # [B, 3] -> [3, B]
+        p = positions.T
+    return compute_mrope_freqs(
+        p, cfg.head_dim, cfg.mrope_sections, cfg.rope_theta
+    )
+
+
 def _embed_input(params, token_ids, inputs_embeds, embeds_mask):
     """Token embedding, or upstream-stage hidden states as inputs.
 
@@ -262,10 +287,9 @@ def forward_hidden(
     b, s = token_ids.shape
     x = _embed_input(params, token_ids, inputs_embeds, None)
     if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    cos, sin = compute_rope_freqs(
-        positions.reshape(-1), cfg.head_dim, cfg.rope_theta
-    )
+        shape = (b, s) if cfg.mrope_sections is None else (b, 3, s)
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], shape)
+    cos, sin = _rope_tables(cfg, positions)
 
     def attend(q, k, v):
         return flash_attention(
@@ -303,9 +327,7 @@ def forward_prefill(
     """
     b, s = token_ids.shape
     x = _embed_input(params, token_ids, inputs_embeds, embeds_mask)
-    cos, sin = compute_rope_freqs(
-        positions.reshape(-1), cfg.head_dim, cfg.rope_theta
-    )
+    cos, sin = _rope_tables(cfg, positions)
     flat_slots = slot_mapping.reshape(-1)
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
@@ -354,9 +376,7 @@ def forward_prefill_chunked(
     b, s = token_ids.shape
     hkv, _, page_size, d = kv_caches[0][0].shape
     x = _embed_input(params, token_ids, inputs_embeds, embeds_mask)
-    cos, sin = compute_rope_freqs(
-        positions.reshape(-1), cfg.head_dim, cfg.rope_theta
-    )
+    cos, sin = _rope_tables(cfg, positions)
     flat_slots = slot_mapping.reshape(-1)
     ctx_width = block_tables.shape[1] * page_size
     kv_mask = (jnp.arange(ctx_width)[None, :]
@@ -399,7 +419,7 @@ def forward_decode(
     Returns (hidden [B, hidden], new kv_caches).
     """
     x = nn.embedding(params["embed"], token_ids)  # [B, hidden]
-    cos, sin = compute_rope_freqs(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = _rope_tables(cfg, positions)
     new_caches = []
     for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
         def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):

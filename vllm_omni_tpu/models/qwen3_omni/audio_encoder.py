@@ -1,0 +1,134 @@
+"""AuT-style audio encoder: log-mel frames -> thinker embeddings.
+
+TPU-native counterpart of the reference thinker's audio tower (reference:
+model_executor/models/qwen3_omni/qwen3_omni_moe_thinker.py — the AuT
+encoder consumed via transformers; behavioral shape: whisper-style conv
+subsampling over mel frames, a bidirectional transformer encoder, and an
+output projection into the LM's embedding width; audio token count
+qwen3_omni_moe_thinker.py:991 ``_compute_audio_token_count``).
+
+Design: pure-functional pytree params like the rest of the framework; the
+conv front-end is two stride-2 1-D convolutions (4x temporal downsample)
+expressed as patch-matmuls (reshape + dot — MXU-friendly, no XLA conv
+needed for stride == kernel), sinusoidal absolute positions, and
+bidirectional flash attention with a length mask.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+
+@dataclass(frozen=True)
+class AudioEncoderConfig:
+    n_mels: int = 128
+    d_model: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    out_dim: int = 2048  # thinker hidden width
+    max_frames: int = 3000  # mel frames before downsampling
+    rms_eps: float = 1e-6
+
+    # temporal downsample factor of the conv front-end (2 stride-2 stages)
+    downsample: int = 4
+
+    @staticmethod
+    def tiny(out_dim: int = 64) -> "AudioEncoderConfig":
+        return AudioEncoderConfig(
+            n_mels=16, d_model=32, num_layers=2, num_heads=4,
+            out_dim=out_dim, max_frames=256,
+        )
+
+    def num_tokens(self, num_frames: int) -> int:
+        """Audio token count for a mel clip (reference:
+        _compute_audio_token_count)."""
+        return -(-num_frames // self.downsample)
+
+
+def init_params(key, cfg: AudioEncoderConfig, dtype=jnp.float32):
+    k = jax.random.split(key, cfg.num_layers + 4)
+    head_dim = cfg.d_model // cfg.num_heads
+    params = {
+        # stage 1: pairs of mel frames -> d_model; stage 2: pairs -> d_model
+        "conv1": nn.linear_init(k[0], 2 * cfg.n_mels, cfg.d_model, dtype=dtype),
+        "conv2": nn.linear_init(k[1], 2 * cfg.d_model, cfg.d_model, dtype=dtype),
+        "out_proj": nn.linear_init(k[2], cfg.d_model, cfg.out_dim, dtype=dtype),
+        "final_norm": nn.rmsnorm_init(cfg.d_model, dtype),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        kk = jax.random.split(k[i + 4], 6)
+        params["layers"].append({
+            "input_norm": nn.rmsnorm_init(cfg.d_model, dtype),
+            "q_proj": nn.linear_init(kk[0], cfg.d_model, cfg.d_model, dtype=dtype),
+            "k_proj": nn.linear_init(kk[1], cfg.d_model, cfg.d_model, dtype=dtype),
+            "v_proj": nn.linear_init(kk[2], cfg.d_model, cfg.d_model, dtype=dtype),
+            "o_proj": nn.linear_init(kk[3], cfg.d_model, cfg.d_model, dtype=dtype),
+            "post_norm": nn.rmsnorm_init(cfg.d_model, dtype),
+            "up": nn.linear_init(kk[4], cfg.d_model, 4 * cfg.d_model, dtype=dtype),
+            "down": nn.linear_init(kk[5], 4 * cfg.d_model, cfg.d_model, dtype=dtype),
+        })
+    del head_dim
+    return params
+
+
+def _sinusoid_positions(t: int, d: int) -> np.ndarray:
+    pos = np.arange(t)[:, None].astype(np.float32)
+    dim = np.arange(0, d, 2)[None, :].astype(np.float32)
+    angle = pos / np.power(10000.0, dim / d)
+    out = np.zeros((t, d), np.float32)
+    out[:, 0::2] = np.sin(angle)
+    out[:, 1::2] = np.cos(angle)
+    return out
+
+
+def _downsample_pair(x: jnp.ndarray, w) -> jnp.ndarray:
+    """[B, T, C] -> [B, ceil(T/2), 2C] @ w — a stride-2 'conv' as a patch
+    matmul (kernel == stride keeps it a pure reshape, which XLA tiles on
+    the MXU without any convolution lowering)."""
+    b, t, c = x.shape
+    if t % 2:
+        x = jnp.pad(x, ((0, 0), (0, 1), (0, 0)))
+        t += 1
+    x = x.reshape(b, t // 2, 2 * c)
+    return jax.nn.gelu(nn.linear(w, x))
+
+
+def forward(
+    params,
+    cfg: AudioEncoderConfig,
+    mel: jax.Array,  # [B, T, n_mels] log-mel frames (right-padded)
+    frame_mask: jax.Array | None = None,  # [B, T] 1 = valid frame
+):
+    """Return (embeds [B, T//downsample, out_dim], token_mask [B, T'])."""
+    b, t, _ = mel.shape
+    x = _downsample_pair(mel, params["conv1"])
+    x = _downsample_pair(x, params["conv2"])
+    tp = x.shape[1]
+    x = x + jnp.asarray(_sinusoid_positions(tp, cfg.d_model), x.dtype)
+    if frame_mask is not None:
+        # a token is valid if any of its downsample-window frames is
+        pad = (-t) % cfg.downsample
+        fm = jnp.pad(frame_mask, ((0, 0), (0, pad)))
+        token_mask = fm.reshape(b, tp, cfg.downsample).max(axis=-1)
+    else:
+        token_mask = jnp.ones((b, tp), jnp.int32)
+    head_dim = cfg.d_model // cfg.num_heads
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+        q = nn.linear(layer["q_proj"], h).reshape(b, tp, cfg.num_heads, head_dim)
+        k = nn.linear(layer["k_proj"], h).reshape(b, tp, cfg.num_heads, head_dim)
+        v = nn.linear(layer["v_proj"], h).reshape(b, tp, cfg.num_heads, head_dim)
+        o = flash_attention(q, k, v, causal=False, kv_mask=token_mask)
+        x = x + nn.linear(layer["o_proj"], o.reshape(b, tp, -1))
+        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
+        x = x + nn.linear(layer["down"], jax.nn.gelu(nn.linear(layer["up"], h)))
+    x = rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
+    return nn.linear(params["out_proj"], x), token_mask

@@ -1,0 +1,195 @@
+"""Thinker multimodal front end: images/audio -> prompt embeds + MRoPE.
+
+The TPU-native collapse of the reference's multimodal processing chain
+(reference: Qwen3OmniMoeThinkerMultiModalProcessor placeholder expansion,
+qwen3_omni_moe_thinker.py:235-536; ``embed_multimodal`` merging encoder
+outputs into input embeddings :813-941; interleaved position computation
+:1081,1193).  One host-side processor object:
+
+1. runs the audio/vision encoders over the request's raw media,
+2. expands each modality's placeholder token to the item's token count,
+3. scatters encoder outputs into the text-embedding table lookups to form
+   ``prompt_embeds``,
+4. computes the 3-stream MRoPE positions + generated-token delta.
+
+The result rides the engine's existing embeds-as-input path (the runner's
+``inputs_embeds``/``embeds_mask`` machinery) — no new device plumbing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common.mrope import (
+    MMItem,
+    compute_mrope_positions,
+    expand_placeholders,
+)
+from vllm_omni_tpu.models.qwen3_omni import audio_encoder, vision_encoder
+
+
+@dataclass
+class ProcessedMM:
+    prompt_token_ids: list[int]
+    prompt_embeds: np.ndarray  # [S, hidden]
+    mrope_positions: np.ndarray  # [3, S]
+    mrope_delta: int
+
+
+class ThinkerMMProcessor:
+    """Host-side multimodal input processor for a thinker stage.
+
+    ``multi_modal_data`` accepted by __call__:
+      {"image": [HxWx3 uint8/float arrays...],
+       "audio": [1-D waveforms or [T, n_mels] mel arrays...]}
+    The prompt contains one placeholder token per item, in order.
+    """
+
+    def __init__(
+        self,
+        embed_table: np.ndarray,  # [V, hidden] — thinker token embeddings
+        image_token_id: int,
+        audio_token_id: int,
+        vision_params=None,
+        vision_cfg: Optional[vision_encoder.VisionEncoderConfig] = None,
+        audio_params=None,
+        audio_cfg: Optional[audio_encoder.AudioEncoderConfig] = None,
+        sample_rate: int = 16000,
+    ):
+        self.embed_table = np.asarray(embed_table)
+        self.image_token_id = image_token_id
+        self.audio_token_id = audio_token_id
+        self.vision_params = vision_params
+        self.vision_cfg = vision_cfg
+        self.audio_params = audio_params
+        self.audio_cfg = audio_cfg
+        self.sample_rate = sample_rate
+        self.placeholder_id = {
+            "image": image_token_id, "audio": audio_token_id,
+        }
+        self._id_to_mod = {v: k for k, v in self.placeholder_id.items()}
+        # NOTE: the vision jit compiles once per distinct (H, W) — callers
+        # should normalize to a small set of canonical resolutions; audio
+        # lengths are bucketed below so mel-length variety is bounded.
+        self._vision_fwd = jax.jit(
+            lambda p, x: vision_encoder.forward(p, vision_cfg, x)
+        ) if vision_cfg else None
+        self._audio_fwd = jax.jit(
+            lambda p, x, m: audio_encoder.forward(p, audio_cfg, x, m)[0]
+        ) if audio_cfg else None
+
+    # ------------------------------------------------------------ encoders
+    def _encode_image(self, img: np.ndarray) -> tuple[np.ndarray, tuple]:
+        if self.vision_cfg is None:
+            raise ValueError("no vision encoder configured for this stage")
+        img = np.asarray(img)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 127.5 - 1.0
+        gh, gw = self.vision_cfg.grid(img.shape[0], img.shape[1])
+        feats = self._vision_fwd(self.vision_params, img[None])
+        return np.asarray(feats[0]), (1, gh, gw)
+
+    def _encode_audio(self, aud: np.ndarray) -> tuple[np.ndarray, tuple]:
+        if self.audio_cfg is None:
+            raise ValueError("no audio encoder configured for this stage")
+        aud = np.asarray(aud)
+        if aud.ndim == 1:  # raw waveform -> log-mel
+            from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+
+            aud = log_mel_spectrogram(
+                aud, sr=self.sample_rate, n_mels=self.audio_cfg.n_mels
+            )
+        t = aud.shape[0]
+        if t > self.audio_cfg.max_frames:
+            raise ValueError(
+                f"audio clip has {t} mel frames > max_frames "
+                f"{self.audio_cfg.max_frames}"
+            )
+        # bucket the frame count (powers of two) so the encoder compiles
+        # once per bucket, not once per clip length; padded frames are
+        # masked out inside the encoder
+        bucket = 16
+        while bucket < t:
+            bucket *= 2
+        mel = np.zeros((bucket, aud.shape[1]), np.float32)
+        mel[:t] = aud
+        mask = (np.arange(bucket) < t).astype(np.int32)
+        feats = self._audio_fwd(self.audio_params, mel[None], mask[None])
+        n = self.audio_cfg.num_tokens(t)
+        return np.asarray(feats[0, :n]), (n,)
+
+    # ------------------------------------------------------------- process
+    def __call__(
+        self,
+        prompt_token_ids: Sequence[int],
+        multi_modal_data: dict[str, Any],
+    ) -> ProcessedMM:
+        # encode media in prompt order: walk placeholders, pull from the
+        # per-modality queues (reference placeholder replacement,
+        # qwen3_omni_moe_thinker.py:430-536)
+        queues = {
+            "image": list(multi_modal_data.get("image", ())),
+            "audio": list(multi_modal_data.get("audio", ())),
+        }
+        feats: list[np.ndarray] = []
+        items_spec: list[tuple[str, tuple]] = []
+        for tok in prompt_token_ids:
+            mod = self._id_to_mod.get(int(tok))
+            if mod is None:
+                continue
+            if not queues[mod]:
+                raise ValueError(f"prompt has more {mod} placeholders than "
+                                 f"{mod} items")
+            raw = queues[mod].pop(0)
+            f, grid = (self._encode_image(raw) if mod == "image"
+                       else self._encode_audio(raw))
+            feats.append(f)
+            items_spec.append((mod, grid))
+        for mod, q in queues.items():
+            if q:
+                raise ValueError(f"{len(q)} unused {mod} items")
+
+        expanded, items = expand_placeholders(
+            list(map(int, prompt_token_ids)), self.placeholder_id, items_spec
+        )
+        embeds = self.embed_table[np.asarray(expanded)].astype(np.float32)
+        for item, f in zip(items, feats):
+            embeds[item.offset:item.offset + item.num_tokens] = f
+        positions, delta = compute_mrope_positions(len(expanded), items)
+        return ProcessedMM(
+            prompt_token_ids=expanded,
+            prompt_embeds=embeds,
+            mrope_positions=positions,
+            mrope_delta=delta,
+        )
+
+
+# --------------------------------------------------------------- factories
+def build_tiny_processor(params, model_cfg, **_):
+    """mm_processor factory for tests/dry-runs: tiny random encoders sized
+    to the thinker's hidden width; placeholder ids live at the top of the
+    tiny vocab (image = V-3, audio = V-2)."""
+    hidden = model_cfg.hidden_size
+    v_cfg = vision_encoder.VisionEncoderConfig.tiny(out_dim=hidden)
+    a_cfg = audio_encoder.AudioEncoderConfig.tiny(out_dim=hidden)
+    v_params = vision_encoder.init_params(
+        jax.random.PRNGKey(11), v_cfg, jnp.float32
+    )
+    a_params = audio_encoder.init_params(
+        jax.random.PRNGKey(12), a_cfg, jnp.float32
+    )
+    vocab = model_cfg.vocab_size
+    return ThinkerMMProcessor(
+        embed_table=np.asarray(params["embed"]["w"]),
+        image_token_id=vocab - 3,
+        audio_token_id=vocab - 2,
+        vision_params=v_params,
+        vision_cfg=v_cfg,
+        audio_params=a_params,
+        audio_cfg=a_cfg,
+    )

@@ -36,11 +36,19 @@ QWEN3_OMNI_THINKER_30B = TransformerConfig(
     num_experts=128,
     num_experts_per_tok=8,
     moe_intermediate_size=768,
+    # multimodal 3D-RoPE splits of head_dim//2 = 64 (t/h/w), the Qwen-Omni
+    # mrope_section from the HF config (reference: mrope.py:25 usage)
+    mrope_sections=(24, 20, 20),
 )
 
 
 def tiny_config(vocab_size: int = 128) -> TransformerConfig:
-    return TransformerConfig.tiny_moe(vocab_size)
+    import dataclasses
+
+    # head_dim 16 -> half 8 -> (4, 2, 2) mrope splits
+    return dataclasses.replace(
+        TransformerConfig.tiny_moe(vocab_size), mrope_sections=(4, 2, 2)
+    )
 
 
 def tiny_factory():

@@ -1,0 +1,135 @@
+"""ViT vision tower: pixels -> thinker embeddings.
+
+TPU-native counterpart of the reference thinker's vision tower (reference:
+model_executor/models/qwen3_omni/qwen3_omni_moe_thinker.py — Qwen2.5-VL
+style vision encoder consumed via transformers: 14px patches, 2-D rotary
+positions, bidirectional attention, 2x2 spatial merge into the LM width).
+
+Design: patch embedding as a reshape + matmul (kernel == stride), 2-D RoPE
+reusing ``compute_mrope_freqs`` with two sections (row/col own half the
+rotary dims each), bidirectional flash attention, and a spatial-merge MLP
+whose output grid (h/merge, w/merge) is also the MRoPE image grid.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import (
+    apply_rope,
+    compute_mrope_freqs,
+    flash_attention,
+    rms_norm,
+)
+
+
+@dataclass(frozen=True)
+class VisionEncoderConfig:
+    patch_size: int = 14
+    d_model: int = 1152
+    num_layers: int = 12
+    num_heads: int = 8
+    spatial_merge: int = 2
+    out_dim: int = 2048  # thinker hidden width
+    rms_eps: float = 1e-6
+
+    @staticmethod
+    def tiny(out_dim: int = 64) -> "VisionEncoderConfig":
+        return VisionEncoderConfig(
+            patch_size=4, d_model=32, num_layers=2, num_heads=4,
+            spatial_merge=2, out_dim=out_dim,
+        )
+
+    def grid(self, height: int, width: int) -> tuple[int, int]:
+        """Output token grid (rows, cols) for an image — the MRoPE grid."""
+        m = self.patch_size * self.spatial_merge
+        if height % m or width % m:
+            raise ValueError(
+                f"image {height}x{width} must be a multiple of {m} "
+                f"(patch {self.patch_size} x merge {self.spatial_merge})"
+            )
+        return height // m, width // m
+
+
+def init_params(key, cfg: VisionEncoderConfig, dtype=jnp.float32):
+    k = jax.random.split(key, cfg.num_layers + 3)
+    p = cfg.patch_size
+    m = cfg.spatial_merge
+    params = {
+        "patch_embed": nn.linear_init(k[0], p * p * 3, cfg.d_model, dtype=dtype),
+        "merge": nn.linear_init(
+            k[1], m * m * cfg.d_model, cfg.out_dim, dtype=dtype
+        ),
+        "final_norm": nn.rmsnorm_init(cfg.d_model, dtype),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        kk = jax.random.split(k[i + 3], 6)
+        params["layers"].append({
+            "input_norm": nn.rmsnorm_init(cfg.d_model, dtype),
+            "q_proj": nn.linear_init(kk[0], cfg.d_model, cfg.d_model, dtype=dtype),
+            "k_proj": nn.linear_init(kk[1], cfg.d_model, cfg.d_model, dtype=dtype),
+            "v_proj": nn.linear_init(kk[2], cfg.d_model, cfg.d_model, dtype=dtype),
+            "o_proj": nn.linear_init(kk[3], cfg.d_model, cfg.d_model, dtype=dtype),
+            "post_norm": nn.rmsnorm_init(cfg.d_model, dtype),
+            "up": nn.linear_init(kk[4], cfg.d_model, 4 * cfg.d_model, dtype=dtype),
+            "down": nn.linear_init(kk[5], 4 * cfg.d_model, cfg.d_model, dtype=dtype),
+        })
+    return params
+
+
+def forward(
+    params,
+    cfg: VisionEncoderConfig,
+    pixels: jax.Array,  # [B, H, W, 3] float in [-1, 1]
+):
+    """Return embeds [B, (H/p/m)*(W/p/m), out_dim] (row-major grid —
+    matching the MRoPE h/w enumeration in models/common/mrope.py)."""
+    b, height, width, _ = pixels.shape
+    p = cfg.patch_size
+    m = cfg.spatial_merge
+    gh, gw = height // p, width // p  # patch grid
+    # patchify: [B, gh, p, gw, p, 3] -> [B, gh*gw, p*p*3]
+    x = pixels.reshape(b, gh, p, gw, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(b, gh * gw, p * p * 3)
+    x = nn.linear(params["patch_embed"], x)
+    t = gh * gw
+
+    # 2-D rope: row/col streams own half the rotary dims each
+    head_dim = cfg.d_model // cfg.num_heads
+    rows = jnp.repeat(jnp.arange(gh), gw)
+    cols = jnp.tile(jnp.arange(gw), gh)
+    pos2 = jnp.stack([rows, cols])  # [2, T]
+    half = head_dim // 2
+    cos, sin = compute_mrope_freqs(
+        pos2, head_dim, (half - half // 2, half // 2), theta=10000.0
+    )
+
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+        q = nn.linear(layer["q_proj"], h).reshape(b * t, cfg.num_heads, head_dim)
+        k = nn.linear(layer["k_proj"], h).reshape(b * t, cfg.num_heads, head_dim)
+        v = nn.linear(layer["v_proj"], h).reshape(b, t, cfg.num_heads, head_dim)
+        # rope tables repeat per batch row ([T, half] tiled to [B*T, half])
+        q = apply_rope(q, jnp.tile(cos, (b, 1)), jnp.tile(sin, (b, 1)))
+        k = apply_rope(k, jnp.tile(cos, (b, 1)), jnp.tile(sin, (b, 1)))
+        o = flash_attention(
+            q.reshape(b, t, cfg.num_heads, head_dim),
+            k.reshape(b, t, cfg.num_heads, head_dim),
+            v, causal=False,
+        )
+        x = x + nn.linear(layer["o_proj"], o.reshape(b, t, -1))
+        h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
+        x = x + nn.linear(layer["down"], jax.nn.gelu(nn.linear(layer["up"], h)))
+    x = rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
+
+    # spatial merge: [B, gh, gw, d] -> [B, gh/m, gw/m, m*m*d] -> out_dim
+    x = x.reshape(b, gh // m, m, gw // m, m, cfg.d_model)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, (gh // m) * (gw // m), m * m * cfg.d_model
+    )
+    return nn.linear(params["merge"], x)

@@ -468,6 +468,13 @@ def flash_attention(
         from vllm_omni_tpu.ops._dispatch import pallas_mode
 
         use_pallas = pallas_mode() == "native"
+        # Mosaic tiling: a KV shorter than one sublane tile makes the
+        # mask/kv block shapes unsatisfiable ((1, 8) block over a (1, 5)
+        # array). Sub-tile shapes gain nothing from the kernel — route
+        # them to the blockwise XLA path. Explicit use_pallas=True is
+        # honored as-is (kernel tests), failing loudly if unsupported.
+        if k.shape[1] < 8:
+            use_pallas = False
     return _flash_attention(
         q, k, v, kv_mask, causal, scale, return_lse, block_q, block_k,
         use_pallas, q_offsets,

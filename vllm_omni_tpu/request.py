@@ -68,6 +68,11 @@ class Request:
     prompt_embeds: Optional[np.ndarray] = None      # [S, hidden]
     additional_information: dict[str, Any] = field(default_factory=dict)
     external_req_id: Optional[str] = None
+    # multimodal 3D-RoPE positions for the prompt ([3, S_prompt]) and the
+    # generated-token delta (position of token p = p + delta); computed by
+    # models/common/mrope.compute_mrope_positions (reference: mrope.py:25)
+    mrope_positions: Optional[np.ndarray] = None
+    mrope_delta: int = 0
 
     # ----- mutable engine state -----
     status: RequestStatus = RequestStatus.WAITING

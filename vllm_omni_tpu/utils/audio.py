@@ -1,0 +1,60 @@
+"""Host-side audio feature extraction (numpy).
+
+The whisper-style log-mel front end the reference gets from the HF
+feature extractor (reference: qwen3_omni_moe_thinker.py:222
+``get_feature_extractor``; hop padding ``pad_to_hop_length`` :248).
+Pure numpy — runs on the host before features ship to the device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=8)
+def _mel_filterbank(sr: int, n_fft: int, n_mels: int) -> np.ndarray:
+    """Triangular mel filterbank [n_mels, n_fft//2 + 1] (Slaney-style
+    htk mel scale, unit peak)."""
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + np.asarray(f, np.float64) / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (np.asarray(m, np.float64) / 2595.0) - 1.0)
+
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_bins)
+    mel_pts = mel_to_hz(np.linspace(0, hz_to_mel(sr / 2), n_mels + 2))
+    fb = np.zeros((n_mels, n_bins), np.float32)
+    for i in range(n_mels):
+        lo, ctr, hi = mel_pts[i], mel_pts[i + 1], mel_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    return fb
+
+
+def log_mel_spectrogram(
+    waveform: np.ndarray,  # [T] float
+    sr: int = 16000,
+    n_mels: int = 128,
+    n_fft: int = 400,
+    hop: int = 160,
+) -> np.ndarray:
+    """Return log-mel frames [num_frames, n_mels] float32 (whisper
+    normalization: log10, clamped to max - 8, scaled to ~[-1, 1])."""
+    x = np.asarray(waveform, np.float32)
+    pad = (-len(x)) % hop
+    if pad:
+        x = np.pad(x, (0, pad))
+    n_frames = max(1, (len(x) - n_fft) // hop + 1) if len(x) >= n_fft else 1
+    if len(x) < n_fft:
+        x = np.pad(x, (0, n_fft - len(x)))
+    idx = np.arange(n_fft)[None, :] + hop * np.arange(n_frames)[:, None]
+    frames = x[idx] * np.hanning(n_fft).astype(np.float32)[None, :]
+    power = np.abs(np.fft.rfft(frames, axis=-1)) ** 2  # [F, n_fft//2+1]
+    mel = power @ _mel_filterbank(sr, n_fft, n_mels).T  # [F, n_mels]
+    logmel = np.log10(np.maximum(mel, 1e-10))
+    logmel = np.maximum(logmel, logmel.max() - 8.0)
+    return ((logmel + 4.0) / 4.0).astype(np.float32)

@@ -94,6 +94,8 @@ class ARModelRunner:
         # engine-level entropy for unseeded requests (fresh per process
         # unless a seed is pinned for reproducibility)
         self._base_seed = seed if seed is not None else secrets.randbits(31)
+        # multimodal 3D-RoPE: positions carry 3 streams ([B, 3, S] / [B, 3])
+        self.use_mrope = cfg.mrope_sections is not None
 
         cfg_ = cfg
 
@@ -207,7 +209,8 @@ class ARModelRunner:
         s_len = _bucket(max_n, self._seq_buckets)
 
         token_ids = np.zeros((b, s_len), np.int32)
-        positions = np.zeros((b, s_len), np.int32)
+        positions = (np.zeros((b, 3, s_len), np.int32) if self.use_mrope
+                     else np.zeros((b, s_len), np.int32))
         slots = np.full((b, s_len), -1, np.int32)
         last_idx = np.zeros((b,), np.int32)
         embeds = (np.zeros((b, s_len, self.embeds_width), np.float32)
@@ -224,7 +227,11 @@ class ARModelRunner:
             n = sc.num_new_tokens
             toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + n]
             token_ids[i, :n] = toks
-            positions[i, :n] = np.arange(sc.start_pos, sc.start_pos + n)
+            p = np.arange(sc.start_pos, sc.start_pos + n)
+            if self.use_mrope:
+                positions[i, :, :n] = self._mrope_cols(sc.request, p)
+            else:
+                positions[i, :n] = p
             slots[i, :n] = sc.slot_mapping
             last_idx[i] = n - 1
             if cont:
@@ -265,18 +272,39 @@ class ARModelRunner:
         self._sample_and_record(scheds, logits, last_hidden, out,
                                 full_hidden=hidden)
 
+    # ---------------------------------------------------- mrope positions
+    def _mrope_cols(self, req, p: np.ndarray) -> np.ndarray:
+        """[3, len(p)] position columns for global token indices ``p``:
+        prompt rows come from the request's precomputed table, generated
+        rows sit at p + delta on all three streams."""
+        mp = req.mrope_positions
+        if mp is None:
+            return np.broadcast_to(p, (3, len(p)))
+        mp = np.asarray(mp)
+        out = np.empty((3, len(p)), np.int32)
+        in_prompt = p < mp.shape[1]
+        out[:, in_prompt] = mp[:, p[in_prompt]]
+        out[:, ~in_prompt] = p[~in_prompt][None, :] + req.mrope_delta
+        return out
+
     # -------------------------------------------------------------- decode
     def _run_decode(self, scheds: list[ScheduledRequest], out: RunnerOutput):
         b = _bucket(len(scheds), self._batch_buckets)
         token_ids = np.zeros((b,), np.int32)
-        positions = np.zeros((b,), np.int32)
+        positions = (np.zeros((b, 3), np.int32) if self.use_mrope
+                     else np.zeros((b,), np.int32))
         slots = np.full((b,), -1, np.int32)
         tables = np.zeros((b, self.max_pages_per_seq), np.int32)
         ctx = np.zeros((b,), np.int32)
         for i, sc in enumerate(scheds):
             req = sc.request
             token_ids[i] = req.all_token_ids[sc.start_pos]
-            positions[i] = sc.start_pos
+            if self.use_mrope:
+                positions[i] = self._mrope_cols(
+                    req, np.asarray([sc.start_pos])
+                )[:, 0]
+            else:
+                positions[i] = sc.start_pos
             slots[i] = sc.slot_mapping[0]
             t = sc.block_table[: self.max_pages_per_seq]
             tables[i, : len(t)] = t
