@@ -1,0 +1,229 @@
+"""TCP connector (multi-node transport) + layer-streamed KV shipping +
+the KV receive/inject path (VERDICT r1 next-step #7; reference:
+mooncake_connector.py:22, kv_transfer_manager.py:47/100+,
+chunk_transfer_adapter.py:19).
+"""
+
+import multiprocessing as mp
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.distributed.connectors import ConnectorFactory
+from vllm_omni_tpu.distributed.kv_transfer import (
+    iter_kv,
+    recv_kv,
+    ship_kv,
+)
+from vllm_omni_tpu.distributed.tcp import KVStoreServer, TCPConnector
+
+
+# ----------------------------------------------------------- tcp connector
+def test_tcp_roundtrip_and_types():
+    conn = TCPConnector(serve=True)
+    try:
+        obj = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+               "b": "text", "c": [1, 2, 3]}
+        n = conn.put("k1", obj)
+        assert n > 0
+        got = conn.get("k1", timeout=5.0)
+        np.testing.assert_array_equal(got["a"], obj["a"])
+        assert got["b"] == "text" and got["c"] == [1, 2, 3]
+        # consumed: second get times out
+        assert conn.get("k1", timeout=0.1) is None
+        assert conn.health()
+    finally:
+        conn.close()
+
+
+def test_tcp_blocking_get_wakes_on_put():
+    conn = TCPConnector(serve=True)
+    try:
+        results = {}
+
+        def getter():
+            c2 = TCPConnector(address=conn.address)
+            results["got"] = c2.get("later", timeout=10.0)
+            c2.close()
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.2)
+        conn.put("later", {"x": 42})
+        t.join(10.0)
+        assert results["got"] == {"x": 42}
+    finally:
+        conn.close()
+
+
+def _child_put(address: str) -> None:
+    from vllm_omni_tpu.distributed.tcp import TCPConnector
+
+    c = TCPConnector(address=address)
+    c.put("from_child", np.ones((3, 3), np.float32) * 7)
+    c.close()
+
+
+def test_tcp_cross_process():
+    conn = TCPConnector(serve=True)
+    try:
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_child_put, args=(conn.address,))
+        p.start()
+        got = conn.get("from_child", timeout=30.0)
+        p.join(10.0)
+        np.testing.assert_array_equal(got, np.ones((3, 3)) * 7)
+    finally:
+        conn.close()
+
+
+def test_tcp_registered_in_factory():
+    conn = ConnectorFactory.create("tcp", serve=True)
+    try:
+        conn.put("x", 1)
+        assert conn.get("x", timeout=1.0) == 1
+    finally:
+        conn.close()
+
+
+# ----------------------------------------------------- layer-streamed ship
+def test_ship_recv_kv_streaming():
+    conn = TCPConnector(serve=True)
+    try:
+        rng = np.random.default_rng(0)
+        payload = [
+            (rng.normal(size=(2, 5, 4)).astype(np.float32),
+             rng.normal(size=(2, 5, 4)).astype(np.float32))
+            for _ in range(3)
+        ]
+        nbytes = ship_kv(conn, "req0/0_1", payload)
+        assert nbytes > 0
+        # streaming: layers arrive one by one
+        seen = 0
+        for k, v in iter_kv(conn, "req0/0_1", timeout=5.0):
+            np.testing.assert_array_equal(k, payload[seen][0])
+            np.testing.assert_array_equal(v, payload[seen][1])
+            seen += 1
+        assert seen == 3
+    finally:
+        conn.close()
+
+
+def test_recv_kv_timeout():
+    conn = TCPConnector(serve=True)
+    try:
+        with pytest.raises(TimeoutError):
+            recv_kv(conn, "missing", timeout=0.1)
+    finally:
+        conn.close()
+
+
+# ------------------------------------------------- KV inject (disagg prefill)
+def _mk_engine(params, cfg, **over):
+    from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+
+    base = dict(num_pages=64, page_size=4, max_model_len=128,
+                max_num_seqs=4, dtype=jnp.float32, seed=0)
+    base.update(over)
+    return LLMEngine(params, cfg, EngineConfig(**base))
+
+
+def test_disagg_prefill_token_parity():
+    """Prefill engine extracts KV; decode engine injects it (shipped
+    through a real TCP connector) and must generate token-identical to a
+    single-engine run — the receive path r1 lacked (VERDICT row 58)."""
+    from vllm_omni_tpu.core.scheduler import KVTransferConfig
+    from vllm_omni_tpu.models.common import transformer as tfm
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    prompt = list(np.random.default_rng(1).integers(1, 100, size=23))
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    # oracle: single engine end-to-end
+    want = _mk_engine(params, cfg).generate([prompt], sp)[0] \
+        .outputs[0].token_ids
+
+    # prefill engine: stop after 1 token, extract KV at prefill_finished
+    pre = _mk_engine(
+        params, cfg,
+        kv_transfer=KVTransferConfig(trigger="prefill_finished"),
+    )
+    shipped = {}
+    conn = TCPConnector(serve=True)
+    try:
+        pre.kv_transfer_sink = lambda req, payload: shipped.update(
+            {req.request_id: ship_kv(conn, f"{req.request_id}/pre_dec",
+                                     payload)})
+        first = pre.generate(
+            [prompt], SamplingParams(temperature=0.0, max_tokens=1)
+        )[0].outputs[0].token_ids
+        assert shipped, "prefill engine extracted no KV"
+
+        # decode engine: inject the shipped prefix, recompute only the tail
+        rid = next(iter(shipped))
+        payload = recv_kv(conn, f"{rid}/pre_dec", timeout=10.0)
+        assert payload[0][0].shape[1] == len(prompt)
+        dec = _mk_engine(params, cfg)
+        dec.add_request(prompt, sp, request_id="d", injected_kv=payload)
+        # the injected prefix skips recompute: only the last prompt token
+        # remains
+        req = dec.scheduler.waiting[0]
+        assert req.num_computed_tokens == len(prompt) - 1
+        outs = []
+        while dec.has_unfinished_requests:
+            outs.extend(dec.step())
+        got = outs[0].outputs[0].token_ids
+    finally:
+        conn.close()
+    assert got == want
+    assert got[0] == first[0]
+
+
+def test_injected_kv_with_chunked_prefill():
+    """Injection composes with chunked prefill (partial prefix + chunked
+    remainder)."""
+    from vllm_omni_tpu.models.common import transformer as tfm
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg, jnp.float32)
+    prompt = list(np.random.default_rng(3).integers(1, 100, size=30))
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+    want = _mk_engine(params, cfg).generate([prompt], sp)[0] \
+        .outputs[0].token_ids
+
+    # extract a 12-token prefix payload directly from a scratch engine's
+    # runner by prefilling the prefix
+    src = _mk_engine(params, cfg)
+    src.generate([prompt[:12]],
+                 SamplingParams(temperature=0.0, max_tokens=1))
+    # recompute oracle payload via a fresh forward (transfer-shaped)
+    from vllm_omni_tpu.ops.paged_attention import init_kv_cache
+    from vllm_omni_tpu.models.common import transformer as t2
+
+    caches = init_kv_cache(cfg.num_layers, 16, 4, cfg.num_kv_heads,
+                           cfg.head_dim, jnp.float32)
+    toks = jnp.asarray([prompt[:12]], jnp.int32)
+    posi = jnp.arange(12)[None, :]
+    slots = jnp.arange(12)[None, :]
+    _, caches = t2.forward_prefill(params, cfg, toks, posi, caches, slots)
+    payload = [
+        (np.asarray(k.reshape(cfg.num_kv_heads, -1, cfg.head_dim)[:, :12]),
+         np.asarray(v.reshape(cfg.num_kv_heads, -1, cfg.head_dim)[:, :12]))
+        for k, v in caches
+    ]
+
+    dec = _mk_engine(params, cfg, max_num_batched_tokens=8,
+                     enable_chunked_prefill=True)
+    dec.add_request(prompt, sp, request_id="d", injected_kv=payload)
+    outs = []
+    while dec.has_unfinished_requests:
+        outs.extend(dec.step())
+    assert outs[0].outputs[0].token_ids == want

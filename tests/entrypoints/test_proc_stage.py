@@ -1,0 +1,141 @@
+"""Cross-process stage disaggregation: spawned stage workers with ready
+handshake, a 2-process pipeline over the TCP edge connector, and
+stage-level KV reuse (VERDICT r1 next-step #7; reference:
+entrypoints/omni_stage.py:394-504 worker spawn + :733 stage_ready).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig, StageRuntime
+from vllm_omni_tpu.entrypoints.omni import Omni
+from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+from vllm_omni_tpu.entrypoints.stage_proc import ProcStage
+
+# children must never grab the TPU the parent may hold; they run on the
+# virtual CPU platform like the tests themselves
+_CPU_ENV = {"JAX_PLATFORMS": "cpu", "OMNI_TPU_PALLAS_INTERPRET": "1"}
+
+
+def _llm_stage(stage_id, *, final=False, sources=None, process=False,
+               connectors=None, extra_engine=None, input_func=""):
+    args = {
+        "model_factory": "tests.helpers:tiny_lm_factory",
+        "num_pages": 64, "page_size": 4, "max_model_len": 128,
+    }
+    args.update(extra_engine or {})
+    return StageConfig(
+        stage_id=stage_id,
+        stage_type="llm",
+        runtime=StageRuntime(process=process, device_env=dict(_CPU_ENV)),
+        engine_args=args,
+        engine_input_source=sources if sources is not None else [stage_id - 1],
+        custom_process_input_func=input_func,
+        final_output=final,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0, "max_tokens": 4},
+        output_connectors=connectors or {},
+    )
+
+
+@pytest.mark.slow
+def test_proc_stage_matches_inproc():
+    """A spawned stage produces the same tokens as the in-proc stage."""
+    cfg = _llm_stage(0, final=True, sources=[-1])
+    inproc = Omni(stage_configs=[cfg])
+    want = inproc.generate([[1, 2, 3]])[0].outputs[0].token_ids
+
+    stage = ProcStage(_llm_stage(0, final=True, sources=[-1], process=True),
+                      device_env=_CPU_ENV)
+    try:
+        stage.submit([StageRequest(request_id="r",
+                                   prompt_token_ids=[1, 2, 3],
+                                   sampling_params={"temperature": 0.0,
+                                                    "max_tokens": 4})])
+        outs = []
+        deadline = time.monotonic() + 120
+        while stage.has_unfinished and time.monotonic() < deadline:
+            outs.extend(stage.poll())
+            time.sleep(0.01)
+        assert outs and outs[0].outputs[0].token_ids == want
+        # stats recorded on the orchestrator side
+        assert stage.request_stats and stage.request_stats[0].tokens_out == 4
+    finally:
+        stage.shutdown()
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_over_tcp_connector():
+    """Both stages in their own processes, edge payloads riding a real TCP
+    store — the 2-process pipeline e2e of VERDICT next-step #7."""
+    from vllm_omni_tpu.distributed.tcp import KVStoreServer
+
+    store = KVStoreServer()
+    try:
+        cfgs = [
+            _llm_stage(0, sources=[-1], process=True, connectors={
+                "1": {"connector": "tcp", "address": store.address},
+            }),
+            _llm_stage(1, final=True, process=True),
+        ]
+        omni = Omni(stage_configs=cfgs)
+        try:
+            outs = omni.generate([[5, 6, 7]])
+            assert len(outs) == 1 and outs[0].stage_id == 1
+            assert not outs[0].is_error
+            edge = omni.metrics.edges[(0, 1)]
+            assert edge.num_transfers == 1 and edge.bytes_total > 0
+
+            # oracle: the same two-stage chain fully in-proc
+            inproc = Omni(stage_configs=[
+                _llm_stage(0, sources=[-1]),
+                _llm_stage(1, final=True),
+            ])
+            want = inproc.generate([[5, 6, 7]])[0].outputs[0].token_ids
+            assert outs[0].outputs[0].token_ids == want
+        finally:
+            omni.shutdown()
+    finally:
+        store.close()
+
+
+@pytest.mark.slow
+def test_proc_stage_worker_build_failure_surfaces():
+    cfg = _llm_stage(0, final=True, sources=[-1], process=True)
+    cfg.engine_args["model_factory"] = "tests.helpers:does_not_exist"
+    with pytest.raises(RuntimeError, match="failed to become ready"):
+        ProcStage(cfg, device_env=_CPU_ENV, ready_timeout=120.0)
+
+
+def test_stage_level_kv_reuse():
+    """Stage 1 (same model) consumes stage 0's extracted KV: the injected
+    prefix skips recompute and final tokens match the no-KV chain —
+    the 'talker consumes thinker KV' criterion at the stage boundary."""
+    def chain(with_kv):
+        extra0 = ({"kv_transfer": {"trigger": "prefill_finished"},
+                   "collect_hidden": False} if with_kv else {})
+        cfgs = [
+            _llm_stage(0, sources=[-1], extra_engine=extra0),
+            _llm_stage(1, final=True,
+                       input_func="tests.helpers:forward_tokens_and_kv"),
+        ]
+        omni = Omni(stage_configs=cfgs)
+        injected = []
+        orig = omni.stages[1].engine._inject_prefix_kv
+
+        def spy(req, payload):
+            injected.append(req.num_prompt_tokens)
+            orig(req, payload)
+            assert req.num_computed_tokens > 0  # prefix actually landed
+
+        omni.stages[1].engine._inject_prefix_kv = spy
+        outs = omni.generate([[9, 3, 5, 7]])
+        assert len(outs) == 1 and not outs[0].is_error
+        return outs[0].outputs[0].token_ids, injected
+
+    with_kv, injected = chain(True)
+    without, no_inject = chain(False)
+    assert with_kv == without
+    assert injected and not no_inject  # KV really flowed + landed

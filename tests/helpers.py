@@ -12,3 +12,24 @@ def tiny_lm_factory():
     cfg = tfm.TransformerConfig.tiny(vocab_size=64)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
     return params, cfg, None
+
+
+def forward_tokens_and_kv(config, upstream_outputs):
+    """custom_process_input_func: next stage re-decodes the upstream
+    prompt+output with the upstream's KV prefix injected (same-model KV
+    reuse across a stage boundary)."""
+    from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+
+    reqs = []
+    for out in upstream_outputs:
+        info = {}
+        kv = out.multimodal_output.get("kv_payload")
+        if kv is not None:
+            info["kv_payload"] = kv
+        reqs.append(StageRequest(
+            request_id=out.request_id,
+            prompt_token_ids=(list(out.prompt_token_ids)
+                              + list(out.outputs[0].token_ids)),
+            additional_information=info,
+        ))
+    return reqs

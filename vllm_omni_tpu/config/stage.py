@@ -52,6 +52,12 @@ class StageRuntime:
     devices: str = "all"  # "all" | comma-separated local device ids
     max_batch_size: int = 1
     batch_timeout: float = 0.0
+    # run this stage in its own spawned process (cross-process stage
+    # disaggregation; reference: omni_stage.py:394-504 worker spawn) with
+    # env applied before jax import (device scoping — a TPU chip admits
+    # one process, so sibling stages pin JAX_PLATFORMS/TPU_VISIBLE_CHIPS)
+    process: bool = False
+    device_env: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -95,7 +95,10 @@ class ARScheduler:
         self._active_transfer_reqs: dict[str, Request] = {}
 
     # ------------------------------------------------------------- intake
-    def add_request(self, request: Request) -> None:
+    def add_request(self, request: Request, injected_len: int = 0) -> None:
+        """``injected_len``: prompt-prefix tokens whose KV the engine will
+        inject from an upstream stage — only the remainder must fit the
+        per-step token budget."""
         n = request.num_prompt_tokens
         # reject anything that could never be scheduled — otherwise the
         # request would pin the waiting queue and starve the engine
@@ -103,7 +106,7 @@ class ARScheduler:
         if n > self.config.max_model_len:
             reason = "prompt exceeds max_model_len"
         elif (not self.config.enable_chunked_prefill
-              and n > self.config.max_num_batched_tokens):
+              and n - injected_len > self.config.max_num_batched_tokens):
             reason = "prompt exceeds max_num_batched_tokens (chunked prefill off)"
         elif self.kv.pages_needed(n) > self.kv.num_pages:
             reason = "prompt needs more KV pages than the whole pool"
@@ -256,6 +259,22 @@ class ARScheduler:
         req.status = RequestStatus.PREEMPTED
         if req in self.running:
             self.running.remove(req)
+        if (not self.config.enable_chunked_prefill
+                and req.num_tokens > self.config.max_num_batched_tokens):
+            # the recompute footprint (prompt + generated, or a formerly
+            # injected prefix) no longer fits one step and chunking is off:
+            # requeueing would pin the waiting head forever while other
+            # requests keep the engine busy (the starvation guard never
+            # fires when something else schedules)
+            self.reject(
+                req,
+                "preempted request cannot resume: recompute footprint "
+                f"({req.num_tokens} tokens) exceeds the step budget "
+                f"({self.config.max_num_batched_tokens}) with chunked "
+                "prefill off",
+                kind="internal",
+            )
+            return
         self.waiting.insert(0, req)
 
     def _preempt_for(

@@ -5,11 +5,14 @@ from vllm_omni_tpu.distributed.connectors import (
     OmniConnectorBase,
     SharedMemoryConnector,
 )
+from vllm_omni_tpu.distributed.tcp import KVStoreServer, TCPConnector
 
 __all__ = [
     "ConnectorFactory",
     "InProcConnector",
+    "KVStoreServer",
     "OmniConnectorBase",
     "OmniSerializer",
     "SharedMemoryConnector",
+    "TCPConnector",
 ]

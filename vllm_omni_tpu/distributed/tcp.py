@@ -1,0 +1,241 @@
+"""TCP key-value transport: the multi-node connector.
+
+The TPU-VM NIC counterpart of the reference's Mooncake/Yuanrong multi-node
+connectors (reference: distributed/omni_connectors/connectors/
+mooncake_connector.py:22 — RDMA/TCP object store keyed
+``rid/from_to``; yuanrong_connector.py — etcd-backed store).  One
+orchestrator-side ``KVStoreServer`` holds the object table; any process
+(stage workers on other hosts included) connects a ``TCPConnector``.
+
+Wire protocol (both directions length-prefixed):
+  request : u32 len | u8 op | u16 klen | key utf-8 | payload
+  response: u32 len | u8 status | payload
+Ops: PUT (payload = value bytes), GET (payload = f64 timeout seconds;
+blocking on the server against a condition variable — no client polling),
+DEL, PING.  Values are serialized by the caller (OmniConnectorBase /
+OmniSerializer), so tensors ride the tensor-aware path.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from vllm_omni_tpu.distributed.connectors import (
+    ConnectorFactory,
+    OmniConnectorBase,
+)
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+OP_PUT, OP_GET, OP_DEL, OP_PING = 1, 2, 3, 4
+ST_OK, ST_MISSING, ST_ERR = 0, 1, 2
+
+_MAX_FRAME = 1 << 31
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack("<I", hdr)
+    if n > _MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return _recv_exact(sock, n)
+
+
+class KVStoreServer:
+    """Threaded TCP object store with blocking GET."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._store: dict[str, bytes] = {}
+        self._cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._closing = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                op = frame[0]
+                (klen,) = struct.unpack_from("<H", frame, 1)
+                key = frame[3:3 + klen].decode()
+                payload = frame[3 + klen:]
+                if op == OP_PUT:
+                    with self._cv:
+                        self._store[key] = payload
+                        self._cv.notify_all()
+                    _send_frame(conn, bytes([ST_OK]))
+                elif op == OP_GET:
+                    (timeout,) = struct.unpack("<d", payload)
+                    data = self._blocking_pop(key, timeout)
+                    if data is None:
+                        _send_frame(conn, bytes([ST_MISSING]))
+                    else:
+                        _send_frame(conn, bytes([ST_OK]) + data)
+                elif op == OP_DEL:
+                    with self._cv:
+                        self._store.pop(key, None)
+                    _send_frame(conn, bytes([ST_OK]))
+                elif op == OP_PING:
+                    _send_frame(conn, bytes([ST_OK]))
+                else:
+                    _send_frame(conn, bytes([ST_ERR]))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _blocking_pop(self, key: str, timeout: float) -> Optional[bytes]:
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cv:
+            while key not in self._store:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(min(remaining, 1.0))
+            return self._store.pop(key)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPConnector(OmniConnectorBase):
+    """Client of a KVStoreServer; thread-safe over one persistent socket.
+
+    ``address`` is "host:port" of the store (orchestrator side starts it);
+    pass ``serve=True`` to own an embedded server (then ``address`` is the
+    bind spec and the effective address is ``self.address``).
+    """
+
+    def __init__(self, address: str = "127.0.0.1:0", serve: bool = False, **_):
+        self._server: Optional[KVStoreServer] = None
+        if serve:
+            host, _, port = address.partition(":")
+            self._server = KVStoreServer(host or "127.0.0.1", int(port or 0))
+            address = self._server.address
+        self.address = address
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            host, _, port = self.address.partition(":")
+            s = socket.create_connection((host, int(port)), timeout=30.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _request(self, op: int, key: str, payload: bytes,
+                 timeout: Optional[float] = None) -> tuple[int, bytes]:
+        kb = key.encode()
+        frame = bytes([op]) + struct.pack("<H", len(kb)) + kb + payload
+        # server-side block (GET) + generous network slack; the timeout is
+        # re-applied on the reconnect path too, and ANY failure closes the
+        # socket — a late response left in the stream would otherwise be
+        # read as the next request's reply (desync)
+        deadline = (timeout + 30.0) if timeout is not None else 300.0
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    sock = self._connect()
+                    sock.settimeout(deadline)
+                    _send_frame(sock, frame)
+                    resp = _recv_frame(sock)
+                    if resp is None:
+                        raise ConnectionError(
+                            f"kv store at {self.address} hung up"
+                        )
+                    return resp[0], resp[1:]
+                except (ConnectionError, OSError):
+                    self._drop_sock()
+                    if attempt:
+                        raise
+        raise AssertionError("unreachable")
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _put_bytes(self, key: str, data: bytes) -> None:
+        status, _ = self._request(OP_PUT, key, data)
+        if status != ST_OK:
+            raise RuntimeError(f"PUT {key} failed (status {status})")
+
+    def _get_bytes(self, key: str, timeout: Optional[float]) -> Optional[bytes]:
+        t = 0.0 if timeout is None else float(timeout)
+        status, payload = self._request(
+            OP_GET, key, struct.pack("<d", t), timeout=t
+        )
+        return payload if status == ST_OK else None
+
+    def cleanup(self, key: str) -> None:
+        self._request(OP_DEL, key, b"")
+
+    def health(self) -> bool:
+        try:
+            return self._request(OP_PING, "", b"")[0] == ST_OK
+        except (ConnectionError, OSError):
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+        if self._server is not None:
+            self._server.close()
+
+
+ConnectorFactory.register("tcp", TCPConnector)

@@ -20,6 +20,7 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 
 from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.core.scheduler import (
     ARScheduler,
     GenerationScheduler,
@@ -32,6 +33,8 @@ from vllm_omni_tpu.outputs import OmniRequestOutput
 from vllm_omni_tpu.request import Request, RequestStatus
 from vllm_omni_tpu.sampling_params import SamplingParams
 from vllm_omni_tpu.worker.model_runner import ARModelRunner
+
+logger = init_logger(__name__)
 
 
 @dataclass
@@ -104,8 +107,16 @@ class LLMEngine:
         prompt_token_ids: list[int],
         sampling_params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
+        injected_kv: Optional[list] = None,
         **kwargs,
     ) -> str:
+        """``injected_kv``: per-layer [(k, v)] dense KV of a prompt prefix
+        computed by an upstream engine (disaggregated prefill / cross-stage
+        KV reuse).  The prefix lands in this engine's paged cache and only
+        the remainder of the prompt is (re)computed — at least the last
+        prompt token always recomputes so there are logits to sample from
+        (the receive half of OmniKVTransferManager, reference:
+        kv_transfer_manager.py:100+)."""
         if request_id is None:
             request_id = f"req-{self._req_counter}"
             self._req_counter += 1
@@ -117,8 +128,49 @@ class LLMEngine:
             arrival_time=time.time(),
             **kwargs,
         )
-        self.scheduler.add_request(req)
+        injected_len = 0
+        if injected_kv is not None:
+            injected_len = min(int(injected_kv[0][0].shape[1]),
+                               max(len(prompt_token_ids) - 1, 0))
+        self.scheduler.add_request(req, injected_len=injected_len)
+        if injected_kv is not None and req.status is RequestStatus.WAITING:
+            self._inject_prefix_kv(req, injected_kv)
         return request_id
+
+    def _inject_prefix_kv(self, req: Request, payload: list) -> None:
+        seq_len = int(payload[0][0].shape[1])
+        use = min(seq_len, req.num_prompt_tokens - 1)
+        if use <= 0:
+            return
+        table = self.scheduler.kv.allocate(req, use)
+        if table is not None:
+            try:
+                trimmed = [(k[:, :use], v[:, :use]) for k, v in payload]
+                self.runner.inject_kv(table, trimmed)
+                req.num_computed_tokens = use
+                return
+            except (ValueError, IndexError) as e:
+                # malformed payload (e.g. upstream layer-count mismatch):
+                # fall back to full recompute — the prefix is re-derivable
+                # from the prompt tokens, and the already-allocated pages
+                # cover the same positions the recompute will write
+                logger.warning(
+                    "request %s: injected KV rejected (%s); recomputing "
+                    "the full prompt", req.request_id, e,
+                )
+        # fallback taken (pool pressure or bad payload): the request was
+        # admitted assuming the prefix would be injected — recheck it can
+        # actually be scheduled as a full recompute
+        if (not self.scheduler.config.enable_chunked_prefill
+                and req.num_prompt_tokens
+                > self.scheduler.config.max_num_batched_tokens):
+            self.scheduler.waiting.remove(req)
+            self.scheduler.kv.free(req)
+            self.scheduler.reject(
+                req,
+                "prompt exceeds max_num_batched_tokens and its injected "
+                "KV prefix could not be applied (chunked prefill off)",
+            )
 
     def add_errored_request(
         self, request_id: str, reason: str, kind: str = "invalid_request"
@@ -171,6 +223,9 @@ class LLMEngine:
                     "request starved: does not fit in the KV cache "
                     f"({self.scheduler.kv.num_free_pages} pages free)",
                 )
+                # an injected-KV request may already own prefix pages
+                # while WAITING — evicting without freeing would leak them
+                self.scheduler.kv.free(victim)
                 errored.append(OmniRequestOutput.from_pipeline(victim))
                 return errored
             if self.scheduler.has_unfinished:

@@ -53,7 +53,18 @@ class Omni:
         for cfg in configs:
             cfg.engine_args.update(overrides.get(f"stage{cfg.stage_id}", {}))
         self.stage_configs = configs
-        self.stages = [OmniStage(cfg) for cfg in configs]
+        # process-disaggregated stages spawn workers (ready handshake
+        # inside ProcStage); in-proc stages build engines directly
+        self.stages = []
+        for cfg in configs:
+            if cfg.runtime.process:
+                from vllm_omni_tpu.entrypoints.stage_proc import ProcStage
+
+                self.stages.append(
+                    ProcStage(cfg, device_env=cfg.runtime.device_env)
+                )
+            else:
+                self.stages.append(OmniStage(cfg))
         self.metrics = OrchestratorAggregator(len(configs), stats_path)
         # connector per pipeline edge (from->to), from stage YAML
         # output_connectors; in-proc default
@@ -164,3 +175,11 @@ class Omni:
         if missing:
             logger.warning("requests lost in pipeline: %s", sorted(missing))
         return [o for r in seed for o in finals.get(r.request_id, [])]
+
+    def shutdown(self) -> None:
+        """Stop process-disaggregated stage workers (no-op for in-proc
+        stages)."""
+        for stage in self.stages:
+            stop = getattr(stage, "shutdown", None)
+            if callable(stop):
+                stop()

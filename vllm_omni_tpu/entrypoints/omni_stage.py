@@ -121,6 +121,12 @@ class OmniStage:
 
             known = EngineConfig.__dataclass_fields__
             eng_kwargs = {k: v for k, v in args.items() if k in known}
+            if isinstance(eng_kwargs.get("kv_transfer"), dict):
+                from vllm_omni_tpu.core.scheduler import KVTransferConfig
+
+                eng_kwargs["kv_transfer"] = KVTransferConfig(
+                    **eng_kwargs["kv_transfer"]
+                )
             # Tokenizer only where text crosses the boundary: entry stages
             # encode string prompts, text-final stages decode outputs.
             # Intermediate codec stages (talker) must NOT decode their token
@@ -136,8 +142,18 @@ class OmniStage:
                 self.tokenizer = load_tokenizer(
                     args.get("model"), model_cfg.vocab_size
                 )
-            return LLMEngine(params, model_cfg, EngineConfig(**eng_kwargs),
-                             eos_token_id=eos)
+            engine = LLMEngine(params, model_cfg, EngineConfig(**eng_kwargs),
+                               eos_token_id=eos)
+            if engine.config.kv_transfer is not None:
+                # extracted KV rides the stage output (D2H2D v1); the
+                # consuming stage's input processor forwards it into
+                # additional_information["kv_payload"] for injection
+                from vllm_omni_tpu.distributed.kv_transfer import (
+                    make_output_kv_sink,
+                )
+
+                engine.kv_transfer_sink = make_output_kv_sink()
+            return engine
         elif self.config.stage_type == "diffusion":
             from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
             from vllm_omni_tpu.diffusion.engine import DiffusionEngine
@@ -163,6 +179,15 @@ class OmniStage:
                     **{k: v for k, v in sp_kwargs.items() if k in known}
                 )
                 mm_kwargs = {}
+                if r.multi_modal_data and self.mm_processor is None:
+                    # silently treating placeholders as ordinary text would
+                    # produce wrong output — reject loudly instead
+                    self.engine.add_errored_request(
+                        r.request_id,
+                        "request has multi_modal_data but this stage has "
+                        "no mm_processor configured",
+                    )
+                    continue
                 if r.multi_modal_data and self.mm_processor is not None:
                     try:
                         processed = self.mm_processor(
@@ -184,11 +209,16 @@ class OmniStage:
                         mrope_positions=processed.mrope_positions,
                         mrope_delta=processed.mrope_delta,
                     )
+                info = dict(r.additional_information)
+                # upstream-extracted KV prefix lands in this engine's cache
+                # (receive half of the transfer manager)
+                injected_kv = info.pop("kv_payload", None)
                 self.engine.add_request(
                     list(r.prompt_token_ids or []), sp,
                     request_id=r.request_id,
                     prompt_embeds=r.prompt_embeds,
-                    additional_information=dict(r.additional_information),
+                    additional_information=info,
+                    injected_kv=injected_kv,
                     **mm_kwargs,
                 )
         else:

@@ -1,0 +1,271 @@
+"""Process-spawned pipeline stages: cross-process stage disaggregation.
+
+The TPU counterpart of the reference's stage worker spawn (reference:
+entrypoints/omni_stage.py:394-504 — mp.Process per stage with a
+``stage_ready`` handshake :733; per-stage device env via
+``set_stage_devices``, stage_utils.py).  Each ``ProcStage`` owns a child
+process running a full in-proc ``OmniStage`` (engine included); the
+orchestrator talks to it over a framed TCP socket, so the same worker can
+run on another host (stage disaggregation across TPU-VM slices — pass a
+routable bind host).
+
+Device isolation: a single TPU chip admits one process, so per-stage
+``device_env`` (e.g. {"JAX_PLATFORMS": "cpu"} or TPU_VISIBLE_CHIPS
+selections) is applied in the child *before* jax import — the analogue of
+CUDA_VISIBLE_DEVICES stage scoping.
+
+Frames are length-prefixed OmniSerializer payloads (tensor-aware), the
+same wire format as the TCP connector.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.distributed.serialization import OmniSerializer
+from vllm_omni_tpu.distributed.tcp import _recv_frame, _send_frame
+from vllm_omni_tpu.entrypoints.omni_stage import OmniStage, StageRequest
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.outputs import OmniRequestOutput
+
+logger = init_logger(__name__)
+
+
+def _send_msg(sock: socket.socket, msg: dict) -> None:
+    _send_frame(sock, OmniSerializer.dumps(msg))
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    frame = _recv_frame(sock)
+    return None if frame is None else OmniSerializer.loads(frame)
+
+
+# --------------------------------------------------------------- worker side
+def _stage_worker_main(config: StageConfig, addr: tuple,
+                       device_env: Optional[dict]) -> None:
+    """Child-process entry: env scoping → engine build → ready handshake →
+    serve submit/abort/shutdown (reference: _stage_worker,
+    omni_stage.py:636-733)."""
+    import os
+
+    for k, v in (device_env or {}).items():
+        os.environ[k] = str(v)
+
+    sock = socket.create_connection(addr, timeout=60.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        stage = OmniStage(config)
+    except Exception as e:  # surface build failures to the orchestrator
+        _send_msg(sock, {"type": "fatal",
+                         "error": f"{type(e).__name__}: {e}"})
+        sock.close()
+        raise
+    _send_msg(sock, {"type": "stage_ready", "stage_id": config.stage_id})
+
+    inbox: queue.Queue = queue.Queue()
+
+    def reader() -> None:
+        try:
+            while True:
+                msg = _recv_msg(sock)
+                if msg is None:
+                    break
+                inbox.put(msg)
+        except (ConnectionError, OSError):
+            pass
+        inbox.put({"type": "shutdown"})  # orchestrator gone
+
+    threading.Thread(target=reader, daemon=True).start()
+
+    running = True
+    while running:
+        # drain commands; block briefly when idle so the loop doesn't spin
+        block = not stage.has_unfinished
+        while True:
+            try:
+                msg = inbox.get(block=block, timeout=0.05)
+            except queue.Empty:
+                break
+            block = False
+            t = msg.get("type")
+            if t == "submit":
+                stage.submit(msg["requests"])
+            elif t == "abort":
+                if stage.config.stage_type == "llm":
+                    stage.engine.abort_request(msg["request_id"])
+            elif t == "shutdown":
+                running = False
+            else:
+                logger.warning("stage %d: unknown message %r",
+                               config.stage_id, t)
+        if not running:
+            break
+        if stage.has_unfinished:
+            try:
+                outs = stage.poll()
+            except Exception as e:
+                _send_msg(sock, {"type": "fatal",
+                                 "error": f"{type(e).__name__}: {e}"})
+                raise
+            if outs:
+                _send_msg(sock, {"type": "outputs", "outputs": outs})
+    _send_msg(sock, {"type": "bye"})
+    sock.close()
+
+
+# --------------------------------------------------------- orchestrator side
+class ProcStage(OmniStage):
+    """Orchestrator-side proxy of a stage running in a child process.
+
+    Mirrors the in-proc OmniStage surface the orchestrator touches
+    (submit / poll / has_unfinished / process_engine_inputs / stats);
+    inherits the input-derivation and metrics logic, never builds a local
+    engine."""
+
+    def __init__(self, config: StageConfig,
+                 device_env: Optional[dict] = None,
+                 ready_timeout: float = 300.0):
+        # deliberately NOT calling super().__init__ — no local engine
+        self.config = config
+        self.stage_id = config.stage_id
+        self.tokenizer = None
+        self.mm_processor = None
+        self.engine = None
+        self._pending: list[StageRequest] = []
+        self._done: list[OmniRequestOutput] = []
+        self._input_processor = config.resolve_input_processor()
+        self._submit_ts: dict[str, float] = {}
+        self.request_stats = []
+        self._inflight: set[str] = set()
+        self._inbox: queue.Queue = queue.Queue()
+        self._fatal: Optional[str] = None
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        ctx = mp.get_context("spawn")
+        self._proc = ctx.Process(
+            target=_stage_worker_main,
+            args=(config, listener.getsockname(), device_env),
+            daemon=True,
+        )
+        self._proc.start()
+        listener.settimeout(ready_timeout)
+        try:
+            self._sock, _ = listener.accept()
+        except socket.timeout:
+            self._proc.terminate()
+            raise TimeoutError(
+                f"stage {self.stage_id}: worker process did not connect "
+                f"within {ready_timeout}s — check the child's device_env "
+                "and engine_args (reference: stage-ready watchdog, "
+                "omni.py:352-396)"
+            ) from None
+        finally:
+            listener.close()
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # ready handshake: first message must be stage_ready
+        self._sock.settimeout(ready_timeout)
+        msg = _recv_msg(self._sock)
+        if msg is None or msg.get("type") != "stage_ready":
+            err = (msg or {}).get("error", "worker hung up")
+            self._proc.terminate()
+            raise RuntimeError(
+                f"stage {self.stage_id}: worker failed to become ready: "
+                f"{err}"
+            )
+        self._sock.settimeout(None)
+        threading.Thread(target=self._reader, daemon=True).start()
+
+    def _reader(self) -> None:
+        try:
+            while True:
+                msg = _recv_msg(self._sock)
+                if msg is None:
+                    break
+                self._inbox.put(msg)
+        except (ConnectionError, OSError):
+            pass
+
+    # ------------------------------------------------------------- intake
+    def submit(self, reqs: list[StageRequest]) -> None:
+        now = time.perf_counter()
+        for r in reqs:
+            self._submit_ts[r.request_id] = now
+            self._inflight.add(r.request_id)
+        if self._fatal is None:
+            try:
+                _send_msg(self._sock, {"type": "submit", "requests": reqs})
+            except (ConnectionError, OSError) as e:
+                # worker died between batches: the next poll() converts
+                # the whole in-flight set to per-request error outputs —
+                # never abort batch-mates on healthy stages by raising
+                self._fatal = f"submit failed: {e}"
+
+    # -------------------------------------------------------------- drive
+    def poll(self) -> list[OmniRequestOutput]:
+        outs: list[OmniRequestOutput] = []
+        while True:
+            try:
+                msg = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            t = msg.get("type")
+            if t == "outputs":
+                outs.extend(msg["outputs"])
+            elif t == "fatal":
+                self._fatal = msg.get("error", "unknown")
+        for o in outs:
+            if o.finished:
+                self._inflight.discard(o.request_id)
+            self._record(o)
+        if self._inflight and self._fatal is None \
+                and not self._proc.is_alive():
+            self._fatal = f"worker exited (code {self._proc.exitcode})"
+        if self._inflight and self._fatal is not None:
+            # fail every in-flight request on this stage; the pipeline
+            # keeps serving requests on healthy stages
+            logger.error("stage %d worker died: %s",
+                         self.stage_id, self._fatal)
+            for rid in sorted(self._inflight):
+                o = OmniRequestOutput.from_error(
+                    rid, f"stage worker died: {self._fatal}",
+                    stage_id=self.stage_id)
+                self._record(o)
+                outs.append(o)
+            self._inflight.clear()
+        return outs
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self._inflight)
+
+    # ----------------------------------------------------------- shutdown
+    def shutdown(self, timeout: float = 10.0) -> None:
+        try:
+            _send_msg(self._sock, {"type": "shutdown"})
+        except (ConnectionError, OSError):
+            pass
+        self._proc.join(timeout)
+        if self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(5.0)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __del__(self) -> None:
+        try:
+            if getattr(self, "_proc", None) is not None \
+                    and self._proc.is_alive():
+                self._proc.terminate()
+        except Exception:
+            pass

@@ -31,7 +31,7 @@ import numpy as np
 
 from vllm_omni_tpu.core.scheduler import ScheduledRequest, SchedulerOutput
 from vllm_omni_tpu.models.common import transformer as tfm
-from vllm_omni_tpu.ops.paged_attention import init_kv_cache
+from vllm_omni_tpu.ops.paged_attention import init_kv_cache, write_kv_cache
 from vllm_omni_tpu.sample.sampler import SamplingTensors, sample_tokens
 from vllm_omni_tpu.sampling_params import SamplingParams
 
@@ -369,6 +369,33 @@ class ARModelRunner:
                     req.additional_information["_hidden_chunks"] = [h]
                 else:
                     prev.append(h)
+
+    # -------------------------------------------------------- kv injection
+    def inject_kv(self, block_ids: list[int], payload: list) -> int:
+        """Scatter per-layer dense [Hkv, seq_len, D] KV into the given
+        pages — the receive half of the transfer manager (reference:
+        omni_connectors/kv_transfer_manager.py:100+ receive path, which r1
+        lacked: extracted KV had nowhere to land).  Returns seq_len."""
+        if len(payload) != len(self.kv_caches):
+            raise ValueError(
+                f"KV payload has {len(payload)} layers, cache has "
+                f"{len(self.kv_caches)}"
+            )
+        seq_len = int(payload[0][0].shape[1])
+        pos = np.arange(seq_len)
+        slots = jnp.asarray(
+            np.asarray(block_ids, np.int64)[pos // self.page_size]
+            * self.page_size + pos % self.page_size,
+            jnp.int32,
+        )
+        new_caches = []
+        for (k_cache, v_cache), (k, v) in zip(self.kv_caches, payload):
+            kt = jnp.moveaxis(jnp.asarray(k), 0, 1)  # [seq, Hkv, D]
+            vt = jnp.moveaxis(jnp.asarray(v), 0, 1)
+            k_cache, v_cache = write_kv_cache(k_cache, v_cache, kt, vt, slots)
+            new_caches.append((k_cache, v_cache))
+        self.kv_caches = new_caches
+        return seq_len
 
     # -------------------------------------------------------- kv extraction
     def extract_kv(self, block_ids: list[int], seq_len: int) -> list:
