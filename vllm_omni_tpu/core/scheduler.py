@@ -45,6 +45,9 @@ class SchedulerConfig:
     # chunks; later chunks attend the cached KV of earlier ones
     # (forward_prefill_chunked / the flash kernel's q_offsets path).
     enable_chunked_prefill: bool = False
+    # speculative decoding: max draft tokens verified per decode step
+    # (drafts come from the runner's MTP head via req.spec_draft_tokens)
+    num_speculative_tokens: int = 0
     kv_transfer: Optional[KVTransferConfig] = None
 
 
@@ -207,17 +210,29 @@ class ARScheduler:
                     self._preempt(req)
                     out.preempted.append(req)
                     continue
-            table = self.kv.allocate(req, 1)
+            # speculative decode: verify up to k drafted tokens in this
+            # step's forward (1 regular + n_spec draft positions); degrade
+            # to a plain decode under budget/page pressure
+            n_new = 1
+            k = self.config.num_speculative_tokens
+            if k and req.spec_draft_tokens and budget > 1:
+                n_spec = min(
+                    len(req.spec_draft_tokens), k, budget - 1,
+                    self.config.max_model_len - req.num_tokens,
+                )
+                if n_spec > 0 and self.kv.can_allocate(req, 1 + n_spec):
+                    n_new = 1 + n_spec
+            table = self.kv.allocate(req, n_new)
             if table is None:
                 self._preempt(req)
                 out.preempted.append(req)
                 continue
-            slots = self.kv.slot_mapping(req, 1)
+            slots = self.kv.slot_mapping(req, n_new)
             out.decodes.append(ScheduledRequest(
-                request=req, num_new_tokens=1, slot_mapping=slots,
+                request=req, num_new_tokens=n_new, slot_mapping=slots,
                 block_table=table, start_pos=req.num_computed_tokens,
             ))
-            budget -= 1
+            budget -= n_new
             still_running.append(req)
         self.running = still_running
 
@@ -296,13 +311,15 @@ class ARScheduler:
     def update_from_output(
         self,
         scheduler_output: SchedulerOutput,
-        sampled: dict[str, int],
+        sampled: dict[str, "int | list[int]"],
         kv_extracted_req_ids: Optional[set[str]] = None,
     ) -> list[Request]:
         """Advance request state after the runner executed a step.
 
-        ``sampled`` maps request_id -> new token for every request whose
-        forward covered its last prompt token (i.e. actually sampled).
+        ``sampled`` maps request_id -> new token (int), or — for a
+        speculative-decode verify step — the list of accepted tokens (the
+        regular sample plus every draft that matched; its length is the
+        number of positions whose KV is now verified-valid).
         ``kv_extracted_req_ids`` ACKs completed KV extractions so pinned
         pages can be freed (reference: omni_ar_scheduler.py:444-471).
         Returns the list of requests that finished this step.
@@ -310,16 +327,37 @@ class ARScheduler:
         finished: list[Request] = []
         for sched in scheduler_output.prefills + scheduler_output.decodes:
             req = sched.request
-            req.num_computed_tokens += sched.num_new_tokens
             token = sampled.get(req.request_id)
             if token is None:
+                req.num_computed_tokens += sched.num_new_tokens
                 continue  # mid-prefill chunk: nothing sampled yet
-            req.append_output_token(token)
-            self._maybe_trigger_kv_transfer(req)
-            stopped = req.check_stop()
-            if not stopped and req.num_tokens >= self.config.max_model_len:
-                req.status = RequestStatus.FINISHED_LENGTH
-                stopped = True
+            if isinstance(token, list):
+                # spec decode: only accepted positions advance — rejected
+                # draft slots are re-written when real tokens reach those
+                # positions (slots are position-keyed, stale KV beyond
+                # the context is never attended).  Advance is per-token
+                # inside the loop so a special_token KV-transfer trigger
+                # sees exactly the coverage plain decoding would
+                # (KV through the token BEFORE the one just appended).
+                tokens = token
+                per_token_advance = True
+            else:
+                req.num_computed_tokens += sched.num_new_tokens
+                tokens = [token]
+                per_token_advance = False
+            stopped = False
+            for t in tokens:
+                if per_token_advance:
+                    req.num_computed_tokens += 1
+                req.append_output_token(t)
+                self._maybe_trigger_kv_transfer(req)
+                stopped = req.check_stop()
+                if (not stopped
+                        and req.num_tokens >= self.config.max_model_len):
+                    req.status = RequestStatus.FINISHED_LENGTH
+                    stopped = True
+                if stopped:
+                    break
             if stopped:
                 finished.append(req)
                 self.running.remove(req)

@@ -46,6 +46,9 @@ class EngineConfig:
     max_num_batched_tokens: int = 2048
     worker_type: str = "ar"  # "ar" | "generation"
     enable_chunked_prefill: bool = False
+    # speculative decoding: drafts per step (needs a draft_fn — the MTP
+    # head, models/qwen3_omni/mtp.py); greedy requests only
+    num_speculative_tokens: int = 0
     dtype: Any = jnp.bfloat16
     kv_transfer: Optional[KVTransferConfig] = None
     collect_hidden: bool = False
@@ -55,7 +58,8 @@ class EngineConfig:
 class LLMEngine:
     def __init__(self, params, model_cfg: tfm.TransformerConfig,
                  config: Optional[EngineConfig] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 draft_fn=None):
         config = config if config is not None else EngineConfig()
         self.config = config
         self.eos_token_id = eos_token_id
@@ -65,6 +69,7 @@ class LLMEngine:
             max_num_batched_tokens=config.max_num_batched_tokens,
             max_model_len=config.max_model_len,
             enable_chunked_prefill=config.enable_chunked_prefill,
+            num_speculative_tokens=config.num_speculative_tokens,
             kv_transfer=config.kv_transfer,
         )
         sched_cls = (GenerationScheduler if config.worker_type == "generation"
@@ -94,6 +99,11 @@ class LLMEngine:
                 max_model_len=config.max_model_len, dtype=config.dtype,
                 collect_hidden=config.collect_hidden, seed=config.seed,
                 max_num_seqs=config.max_num_seqs,
+            )
+        if (draft_fn is not None and config.num_speculative_tokens > 0
+                and hasattr(self.runner, "set_draft_fn")):
+            self.runner.set_draft_fn(
+                draft_fn, config.num_speculative_tokens
             )
         # connector hook: called with (request, kv_payload) when a
         # cross-stage KV extraction completes (OmniKVTransferManager put)

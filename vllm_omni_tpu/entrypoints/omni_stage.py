@@ -142,8 +142,20 @@ class OmniStage:
                 self.tokenizer = load_tokenizer(
                     args.get("model"), model_cfg.vocab_size
                 )
+            # MTP draft head for spec decode (talker stages): factory
+            # builds draft_fn around the backbone params
+            draft_fn = None
+            draft_factory = args.pop("draft_factory", None)
+            if (draft_factory is not None
+                    and eng_kwargs.get("num_speculative_tokens", 0) > 0):
+                if isinstance(draft_factory, str):
+                    draft_factory = _import_obj(draft_factory)
+                draft_fn = draft_factory(
+                    params, model_cfg,
+                    eng_kwargs["num_speculative_tokens"],
+                )
             engine = LLMEngine(params, model_cfg, EngineConfig(**eng_kwargs),
-                               eos_token_id=eos)
+                               eos_token_id=eos, draft_fn=draft_fn)
             if engine.config.kv_transfer is not None:
                 # extracted KV rides the stage output (D2H2D v1); the
                 # consuming stage's input processor forwards it into

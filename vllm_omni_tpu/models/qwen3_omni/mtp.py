@@ -1,0 +1,140 @@
+"""MTP draft head: multi-token prediction for talker spec decode.
+
+TPU-native counterpart of the reference's talker code predictor
+(reference: models/qwen3_omni/qwen3_omni_moe_code_predictor_mtp.py; hooked
+into the runner at worker/gpu_model_runner.py:1085, EAGLE-style draft
+propose gpu_ar_model_runner.py:466-497).
+
+Shape: a single transformer block over the fusion of the backbone's last
+hidden state and the embedding of the token just sampled —
+``h' = block(proj([embed(t); h]))`` — whose logits (through the backbone's
+own lm_head) propose the next token; chaining k times yields k draft
+tokens.  The backbone then *verifies* all k in one multi-token forward
+(the runner rides the chunked-prefill kernel), accepting the longest
+matching prefix — output tokens are exactly what plain decoding would
+produce, steps are fewer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    _layer_step,
+    _rope_tables,
+)
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+
+@dataclass(frozen=True)
+class MTPConfig:
+    num_draft_tokens: int = 3
+
+
+def init_mtp_params(key, cfg: TransformerConfig, dtype=jnp.float32):
+    """One extra block + fusion projection; embeddings/lm_head are shared
+    with the backbone (passed at draft time)."""
+    k1, k2 = jax.random.split(key)
+    from vllm_omni_tpu.models.common.transformer import init_params
+
+    # borrow a 1-layer skeleton for the block params
+    skel = init_params(
+        k1,
+        TransformerConfig(
+            vocab_size=1,  # unused — no embed/lm_head of its own
+            hidden_size=cfg.hidden_size,
+            num_layers=1,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            intermediate_size=cfg.intermediate_size,
+            qk_norm=cfg.qk_norm,
+        ),
+        dtype,
+    )
+    return {
+        "fuse": nn.linear_init(
+            k2, 2 * cfg.hidden_size, cfg.hidden_size, bias=False, dtype=dtype
+        ),
+        "block": skel["layers"][0],
+        "norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
+    }
+
+
+def tiny_factory(params, model_cfg, num_draft_tokens: int):
+    """draft_factory hook for stage configs: random-weight MTP head sized
+    to the backbone (acceptance near zero untrained — correctness
+    machinery only; real heads come from checkpoint loading)."""
+    mtp_params = init_mtp_params(
+        jax.random.PRNGKey(21), model_cfg, jnp.float32
+    )
+    return make_draft_fn(params, model_cfg, mtp_params, num_draft_tokens)
+
+
+def make_draft_fn(backbone_params, cfg: TransformerConfig, mtp_params,
+                  num_draft_tokens: int = 3):
+    """Return ``draft(last_hidden [B, H], last_token [B], positions [B])
+    -> draft tokens [B, k]`` (jitted).
+
+    Each chain step attends only its own fused state (sequence length 1 —
+    the draft block is stateless across steps, trading a little accuracy
+    for zero KV bookkeeping; the backbone verify forward is the ground
+    truth either way).
+    """
+    import dataclasses
+
+    from vllm_omni_tpu.models.common.transformer import logits_from_hidden
+
+    # the draft block is always dense, even under an MoE backbone (the
+    # reference MTP head is a plain block too) — and the backbone's
+    # lm_head/embeddings are shared through `cfg` untouched
+    block_cfg = dataclasses.replace(cfg, moe=False)
+
+    @jax.jit
+    def draft(last_hidden, last_token, positions):
+        b = last_hidden.shape[0]
+
+        def one(carry, _):
+            h, tok, pos = carry
+            e = nn.embedding(backbone_params["embed"], tok)
+            x = nn.linear(mtp_params["fuse"],
+                          jnp.concatenate([e, h], axis=-1))
+            cos, sin = _rope_tables(
+                # draft positions continue the sequence; mrope streams are
+                # equal past the prompt so a 1-D continuation is exact
+                block_cfg, pos[:, None] if block_cfg.mrope_sections is None
+                else jnp.broadcast_to(pos[:, None, None], (b, 3, 1)),
+            )
+
+            def attend(q, k, v):
+                return flash_attention(
+                    q.reshape(b, 1, block_cfg.num_heads,
+                              block_cfg.head_dim),
+                    k.reshape(b, 1, block_cfg.num_kv_heads,
+                              block_cfg.head_dim),
+                    v.reshape(b, 1, block_cfg.num_kv_heads,
+                              block_cfg.head_dim),
+                )
+
+            x = _layer_step(
+                mtp_params["block"], block_cfg, x[:, None], cos, sin,
+                attend,
+            )[:, 0]
+            h_new = rms_norm(x, mtp_params["norm"]["w"], block_cfg.rms_eps)
+            logits = logits_from_hidden(backbone_params, block_cfg, h_new)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (h_new, nxt, pos + 1), nxt
+
+        (_, _, _), toks = jax.lax.scan(
+            one, (last_hidden, last_token.astype(jnp.int32),
+                  positions.astype(jnp.int32)),
+            None, length=num_draft_tokens,
+        )
+        return jnp.moveaxis(toks, 0, 1)  # [B, k]
+
+    return draft

@@ -84,6 +84,11 @@ class Request:
     kv_transfer_block_ids: Optional[list[int]] = None
     kv_transfer_seq_len: int = 0
     multimodal_output: dict[str, Any] = field(default_factory=dict)
+    # speculative-decode draft tokens proposed by the MTP head after the
+    # last verified step (reference: talker MTP code predictor,
+    # models/qwen3_omni/qwen3_omni_moe_code_predictor_mtp.py); consumed by
+    # the next decode step's verify forward
+    spec_draft_tokens: list[int] = field(default_factory=list)
     # hidden states destined for the next stage (pooler_output payloads,
     # reference: gpu_ar_model_runner.py:525-568)
     pooled_hidden: Optional[np.ndarray] = None

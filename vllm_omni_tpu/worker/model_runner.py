@@ -56,8 +56,10 @@ def _make_buckets(start: int, limit: int) -> tuple[int, ...]:
 
 @dataclass
 class RunnerOutput:
-    # request_id -> sampled token (only for requests that reached sampling)
-    sampled: dict[str, int] = field(default_factory=dict)
+    # request_id -> sampled token (only for requests that reached
+    # sampling); a spec-decode verify step stores the LIST of accepted
+    # tokens instead of a single int
+    sampled: dict[str, "int | list[int]"] = field(default_factory=dict)
     # request_id -> extracted KV payload (per-layer (k, v) numpy arrays)
     extracted_kv: dict[str, list] = field(default_factory=dict)
     kv_extracted_req_ids: set[str] = field(default_factory=set)
@@ -132,6 +134,19 @@ class ARModelRunner:
             return logits, last_hidden, hidden, new_caches
 
         @functools.partial(jax.jit, donate_argnums=(2,))
+        def _verify(params, token_ids, kv_caches, positions, slot_mapping,
+                    block_tables, context_lens, q_starts):
+            # spec-decode verify: logits at EVERY candidate position
+            # (the chunked forward writes KV for all candidates; rejected
+            # slots are position-keyed and get overwritten by real tokens)
+            hidden, new_caches = tfm.forward_prefill_chunked(
+                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
+                block_tables, context_lens, q_starts,
+            )
+            logits = tfm.logits_from_hidden(params, cfg_, hidden)
+            return logits, hidden, new_caches
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
         def _decode(params, token_ids, kv_caches, positions, slot_mapping,
                     block_tables, context_lens):
             hidden, new_caches = tfm.forward_decode(
@@ -143,7 +158,13 @@ class ARModelRunner:
 
         self._prefill_fn = _prefill
         self._chunk_prefill_fn = _chunk_prefill
+        self._verify_fn = _verify
         self._decode_fn = _decode
+        # speculative decoding (MTP draft head): draft_fn(last_hidden [M,H],
+        # last_token [M], positions [M]) -> [M, k] proposals
+        self.draft_fn = None
+        self.num_draft_tokens = 0
+        self.spec_stats = {"verify_steps": 0, "proposed": 0, "accepted": 0}
         # width of upstream embeds accepted by this model: the embed_proj
         # input dim when present (thinker width for the talker), else the
         # model's own hidden size
@@ -152,14 +173,33 @@ class ARModelRunner:
             if "embed_proj" in params else cfg.hidden_size
         )
 
+    def set_draft_fn(self, draft_fn, num_draft_tokens: int) -> None:
+        """Install the MTP draft head (talker spec decode, reference:
+        gpu_ar_model_runner.py:466-497 EAGLE propose).  A draft_fn taking
+        a ``contexts`` kwarg also receives each drafted request's full
+        post-step token history (oracle/tree drafters)."""
+        import inspect
+
+        self.draft_fn = draft_fn
+        self.num_draft_tokens = num_draft_tokens
+        try:
+            sig = inspect.signature(draft_fn)
+            self._draft_takes_contexts = "contexts" in sig.parameters
+        except (TypeError, ValueError):
+            self._draft_takes_contexts = False
+
     # ---------------------------------------------------------------- step
     def execute(
         self, sched_out: SchedulerOutput, extract_kv: bool = True
     ) -> RunnerOutput:
         self._step += 1
         out = RunnerOutput()
-        if sched_out.decodes:
-            self._run_decode(sched_out.decodes, out)
+        plain = [s for s in sched_out.decodes if s.num_new_tokens == 1]
+        spec = [s for s in sched_out.decodes if s.num_new_tokens > 1]
+        if plain:
+            self._run_decode(plain, out)
+        if spec:
+            self._run_spec_decode(spec, out)
         if sched_out.prefills:
             # Three-way split: continuation chunks (cached prefix; the
             # chunked kernel gathers context pages) run separately from
@@ -217,12 +257,7 @@ class ARModelRunner:
                   if use_embeds else None)
         embeds_mask = np.zeros((b, s_len), bool) if use_embeds else None
         if cont:
-            max_ctx = max(s.start_pos + s.num_new_tokens for s in scheds)
-            ctx_bucket = _bucket(max_ctx, self._seq_buckets)
-            pages = -(-ctx_bucket // self.page_size)
-            tables = np.zeros((b, pages), np.int32)
-            ctx = np.zeros((b,), np.int32)
-            q_starts = np.zeros((b,), np.int32)
+            tables, ctx, q_starts, pages = self._cont_tables(scheds, b)
         for i, sc in enumerate(scheds):
             n = sc.num_new_tokens
             toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + n]
@@ -234,11 +269,6 @@ class ARModelRunner:
                 positions[i, :n] = p
             slots[i, :n] = sc.slot_mapping
             last_idx[i] = n - 1
-            if cont:
-                t = sc.block_table[:pages]
-                tables[i, : len(t)] = t
-                ctx[i] = sc.start_pos + n
-                q_starts[i] = sc.start_pos
             if use_embeds:
                 # embeds cover prompt rows only; a recompute-resumed request
                 # also re-prefills its generated tokens, which embed from
@@ -271,6 +301,24 @@ class ARModelRunner:
             )
         self._sample_and_record(scheds, logits, last_hidden, out,
                                 full_hidden=hidden)
+        self._maybe_draft(scheds, last_hidden, out)
+
+    def _cont_tables(self, scheds: list[ScheduledRequest], b: int):
+        """Block-table / context-length / q-start operands shared by the
+        chunk-continuation and spec-verify paths (both feed
+        forward_prefill_chunked — one assembly, one bucketing policy)."""
+        max_ctx = max(s.start_pos + s.num_new_tokens for s in scheds)
+        ctx_bucket = _bucket(max_ctx, self._seq_buckets)
+        pages = -(-ctx_bucket // self.page_size)
+        tables = np.zeros((b, pages), np.int32)
+        ctx = np.zeros((b,), np.int32)
+        q_starts = np.zeros((b,), np.int32)
+        for i, sc in enumerate(scheds):
+            t = sc.block_table[:pages]
+            tables[i, : len(t)] = t
+            ctx[i] = sc.start_pos + sc.num_new_tokens
+            q_starts[i] = sc.start_pos
+        return tables, ctx, q_starts, pages
 
     # ---------------------------------------------------- mrope positions
     def _mrope_cols(self, req, p: np.ndarray) -> np.ndarray:
@@ -315,6 +363,122 @@ class ARModelRunner:
             jnp.asarray(tables), jnp.asarray(ctx),
         )
         self._sample_and_record(scheds, logits, hidden, out)
+        self._maybe_draft(scheds, hidden, out)
+
+    # ------------------------------------------------- speculative decode
+    def _run_spec_decode(self, scheds: list[ScheduledRequest],
+                         out: RunnerOutput):
+        """Verify step: run the backbone over [last_sampled, drafts...] in
+        one forward (chunked-prefill kernel), accept the longest draft
+        prefix that matches greedy argmax, and re-draft from the last
+        accepted position."""
+        b = _bucket(len(scheds), self._batch_buckets)
+        max_n = max(s.num_new_tokens for s in scheds)
+        s_len = _bucket(max_n, self._seq_buckets)
+
+        token_ids = np.zeros((b, s_len), np.int32)
+        positions = (np.zeros((b, 3, s_len), np.int32) if self.use_mrope
+                     else np.zeros((b, s_len), np.int32))
+        slots = np.full((b, s_len), -1, np.int32)
+        tables, ctx, q_starts, _ = self._cont_tables(scheds, b)
+        cands: list[list[int]] = []
+        for i, sc in enumerate(scheds):
+            req = sc.request
+            n = sc.num_new_tokens
+            row = ([req.all_token_ids[sc.start_pos]]
+                   + list(req.spec_draft_tokens[: n - 1]))
+            cands.append(row)
+            token_ids[i, :n] = row
+            p = np.arange(sc.start_pos, sc.start_pos + n)
+            if self.use_mrope:
+                positions[i, :, :n] = self._mrope_cols(req, p)
+            else:
+                positions[i, :n] = p
+            slots[i, :n] = sc.slot_mapping
+
+        logits, hidden, self.kv_caches = self._verify_fn(
+            self.params, jnp.asarray(token_ids), self.kv_caches,
+            jnp.asarray(positions), jnp.asarray(slots),
+            jnp.asarray(tables), jnp.asarray(ctx), jnp.asarray(q_starts),
+        )
+        greedy = np.asarray(jax.device_get(
+            jnp.argmax(logits, axis=-1)))  # [B, S]
+        # one verify forward per call, however many requests it batched
+        self.spec_stats["verify_steps"] += 1
+        accepted_idx: list[int] = []
+        for i, sc in enumerate(scheds):
+            req = sc.request
+            n = sc.num_new_tokens
+            drafts = cands[i][1:]
+            acc = [int(greedy[i, 0])]
+            for j, d in enumerate(drafts):
+                if d != acc[-1]:
+                    break  # draft j diverges from the true token
+                acc.append(int(greedy[i, j + 1]))
+            out.sampled[req.request_id] = acc
+            accepted_idx.append(len(acc) - 1)
+            self.spec_stats["proposed"] += len(drafts)
+            self.spec_stats["accepted"] += len(acc) - 1
+            if self.collect_hidden:
+                h = np.asarray(jax.device_get(hidden[i, : len(acc)]))
+                req.additional_information.setdefault(
+                    "_hidden_chunks", []).append(h)
+        # re-draft from the last accepted position
+        last_hidden = hidden[jnp.arange(len(scheds)),
+                             jnp.asarray(accepted_idx)]
+        self._maybe_draft(scheds, last_hidden, out)
+
+    def _maybe_draft(self, scheds: list[ScheduledRequest],
+                     last_hidden, out: RunnerOutput):
+        """Propose the next k tokens for every greedy request that sampled
+        this step (spec decode draft phase)."""
+        if self.draft_fn is None or self.num_draft_tokens <= 0:
+            return
+        rows, toks, poss, reqs, ctxs = [], [], [], [], []
+        for i, sc in enumerate(scheds):
+            req = sc.request
+            s = out.sampled.get(req.request_id)
+            if s is None:
+                continue
+            if req.sampling_params.temperature != 0.0:
+                # verify-accept is exact only under greedy matching;
+                # sampled requests decode normally
+                req.spec_draft_tokens = []
+                continue
+            new = s if isinstance(s, list) else [s]
+            # position where the just-sampled token will be computed: the
+            # per-token advance for spec lists, the full chunk width for
+            # int samples (a prefill covers num_new_tokens positions, not
+            # one); mrope models shift generated positions by delta
+            adv = len(new) if isinstance(s, list) else sc.num_new_tokens
+            pos = sc.start_pos + adv
+            if self.use_mrope:
+                pos += req.mrope_delta
+            rows.append(i)
+            toks.append(new[-1])
+            poss.append(pos)
+            reqs.append(req)
+            if self._draft_takes_contexts:
+                # full post-step history (the just-sampled tokens are not
+                # yet appended to the request at draft time); built only
+                # for drafters that want it — it is an O(n) copy
+                ctxs.append(req.all_token_ids + list(new))
+        if not rows:
+            return
+        m = len(rows)
+        mb = _bucket(m, self._batch_buckets)
+        hh = jnp.zeros((mb,) + last_hidden.shape[1:], last_hidden.dtype)
+        hh = hh.at[:m].set(last_hidden[jnp.asarray(rows)])
+        tt = np.zeros((mb,), np.int32)
+        tt[:m] = toks
+        pp = np.zeros((mb,), np.int32)
+        pp[:m] = poss
+        kwargs = {"contexts": ctxs} if self._draft_takes_contexts else {}
+        drafts = np.asarray(jax.device_get(
+            self.draft_fn(hh, jnp.asarray(tt), jnp.asarray(pp), **kwargs)
+        ))
+        for r, req in enumerate(reqs):
+            req.spec_draft_tokens = [int(x) for x in drafts[r]]
 
     # ------------------------------------------------------------ sampling
     def _sample_and_record(
