@@ -116,6 +116,36 @@ def test_spec_decode_sampled_requests_fall_back():
     assert got == want
 
 
+def test_spec_decode_hidden_chunks_align_with_tokens():
+    """collect_hidden + spec decode: the hidden payload must have exactly
+    as many rows as plain decoding would emit, even when a stop lands
+    inside an accepted run (code-review finding: untrimmed acceptance
+    shipped extra rows downstream)."""
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, jnp.float32)
+    prompt = [1, 2, 3, 4]
+    plain_eng = _mk(params, cfg, collect_hidden=True)
+    plain = plain_eng.generate(
+        [prompt], SamplingParams(temperature=0.0, max_tokens=6))[0]
+    eos = plain.outputs[0].token_ids[1]
+
+    def run(k, draft_fn):
+        eng = LLMEngine(params, cfg, EngineConfig(
+            num_pages=64, page_size=4, max_model_len=256,
+            dtype=jnp.float32, seed=0, num_speculative_tokens=k,
+            collect_hidden=True), eos_token_id=eos, draft_fn=draft_fn)
+        out = eng.generate(
+            [prompt], SamplingParams(temperature=0.0, max_tokens=6))[0]
+        return out
+
+    oracle = OracleDraft(params, cfg, 3)
+    want = run(0, None)
+    got = run(3, oracle)
+    assert got.outputs[0].token_ids == want.outputs[0].token_ids
+    assert (got.multimodal_output["hidden_states"].shape
+            == want.multimodal_output["hidden_states"].shape)
+
+
 def test_spec_decode_with_eos_mid_acceptance():
     """A stop token inside the accepted run finishes the request at the
     stop, not after the full accepted list."""

@@ -216,9 +216,14 @@ class ARScheduler:
             n_new = 1
             k = self.config.num_speculative_tokens
             if k and req.spec_draft_tokens and budget > 1:
+                # drafts beyond the request's remaining max_tokens are
+                # guaranteed-discarded work — don't schedule them
+                remaining_out = (req.sampling_params.max_tokens
+                                 - len(req.output_token_ids))
                 n_spec = min(
                     len(req.spec_draft_tokens), k, budget - 1,
                     self.config.max_model_len - req.num_tokens,
+                    max(remaining_out - 1, 0),
                 )
                 if n_spec > 0 and self.kv.can_allocate(req, 1 + n_spec):
                     n_new = 1 + n_spec

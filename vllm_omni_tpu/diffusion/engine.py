@@ -161,10 +161,12 @@ class DiffusionEngine:
                 extra={"seconds_total": 0.25},
             )
         else:
-            mult = (
-                self.pipeline.cfg.vae.spatial_ratio
-                * self.pipeline.cfg.dit.patch_size
-            )
+            mult = getattr(self.pipeline, "geometry_multiple", None)
+            if mult is None:
+                mult = (
+                    self.pipeline.cfg.vae.spatial_ratio
+                    * self.pipeline.cfg.dit.patch_size
+                )
             h0, w0 = self.od_config.default_height, self.od_config.default_width
             if modality == "video":
                 # Video warmup must not reuse the image default geometry:
@@ -182,6 +184,11 @@ class DiffusionEngine:
                 guidance_scale=4.0, seed=0,
                 num_frames=2 if modality == "video" else 1,
             )
+            if getattr(self.pipeline, "needs_image_cond", False):
+                # I2V / image-edit pipelines require a conditioning image
+                import numpy as np
+
+                sp.image = np.zeros((height, width, 3), np.uint8)
         self.pipeline.forward(OmniDiffusionRequest(
             prompt=["warmup"], sampling_params=sp))
         logger.info("Warmup done in %.1fs", time.perf_counter() - t0)

@@ -25,6 +25,9 @@ class OmniDiffusionSamplingParams:
     # video / audio extensions
     num_frames: int = 1
     fps: int = 16
+    # conditioning image for I2V / image-edit pipelines ([H, W, 3] uint8
+    # or float in [-1, 1])
+    image: Optional[Any] = None
     extra: dict[str, Any] = field(default_factory=dict)
 
 

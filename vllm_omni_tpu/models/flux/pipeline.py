@@ -1,0 +1,197 @@
+"""Flux text-to-image pipeline (guidance-distilled MMDiT).
+
+Reference: vllm_omni/diffusion/models/flux/ (registry entry FluxPipeline,
+diffusion/registry.py:16-102).  Structure mirrors QwenImagePipeline —
+text encode → flow-match denoise → VAE decode — with the two Flux
+differences: the double+single-stream transformer (flux/transformer.py)
+and *embedded* guidance instead of CFG batch-doubling (the distilled
+model conditions on the guidance scale directly, so every step runs a
+single batch — no cfg axis needed).
+
+The pooled conditioning vector (CLIP in the original) is the masked mean
+of the text-encoder hidden states projected by the transformer's pooled
+head — one encoder serves both roles (documented deviation; the loader
+can override with a real pooled projection later).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.diffusion import cache as step_cache
+from vllm_omni_tpu.diffusion import scheduler as fm
+from vllm_omni_tpu.diffusion.request import (
+    DiffusionOutput,
+    InvalidRequestError,
+    OmniDiffusionRequest,
+)
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    forward_hidden,
+    init_params as init_text_params,
+)
+from vllm_omni_tpu.models.flux import transformer as fdit
+from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
+from vllm_omni_tpu.models.qwen_image import vae as vae_mod
+from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class FluxPipelineConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    dit: FluxDiTConfig = field(default_factory=FluxDiTConfig)
+    vae: VAEConfig = field(default_factory=VAEConfig)
+    max_text_len: int = 64
+    shift: float = 1.0
+    pack: int = 2  # 2x2 latent packing into channels
+
+    @staticmethod
+    def tiny() -> "FluxPipelineConfig":
+        return FluxPipelineConfig(
+            text=TransformerConfig.tiny(vocab_size=256),
+            dit=FluxDiTConfig.tiny(),
+            vae=VAEConfig.tiny(),
+        )
+
+
+class FluxPipeline:
+    """Text -> image, guidance embedded (no CFG doubling)."""
+
+    output_type = "image"
+
+    @property
+    def geometry_multiple(self) -> int:
+        """Height/width granularity (the engine's warmup geometry hook):
+        Flux packs 2x2 latents into channels instead of a DiT patch_size."""
+        return self.cfg.vae.spatial_ratio * self.cfg.pack
+
+    def __init__(self, config: FluxPipelineConfig, dtype=jnp.bfloat16,
+                 seed: int = 0, mesh=None, cache_config=None):
+        self.cfg = config
+        self.dtype = dtype
+        self.cache_config = cache_config
+        if config.text.hidden_size != config.dit.ctx_dim:
+            raise ValueError("text hidden_size must equal dit ctx_dim")
+        if config.dit.pooled_dim != config.text.hidden_size:
+            raise ValueError(
+                "pooled_dim must equal text hidden_size (the pooled vector "
+                "is the masked mean of text hidden states)"
+            )
+        want_in = config.vae.latent_channels * config.pack ** 2
+        if config.dit.in_channels != want_in:
+            raise ValueError(
+                f"dit.in_channels must be latent*pack^2 = {want_in}"
+            )
+        self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        logger.info("Initializing FluxPipeline params (dtype=%s)", dtype)
+        self.text_params = init_text_params(k1, config.text, dtype)
+        self.dit_params = fdit.init_params(k2, config.dit, dtype)
+        self.vae_params = vae_mod.init_decoder(k3, config.vae, dtype)
+        self._denoise_cache: dict = {}
+        # jitted once (per-request jax.jit(lambda) would recompile)
+        self._text_encode_jit = jax.jit(
+            lambda i: forward_hidden(self.text_params, self.cfg.text, i))
+        self._vae_decode_jit = jax.jit(
+            lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
+
+    # ------------------------------------------------------------- encode
+    def encode_prompt(self, prompts: list[str]):
+        ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
+        hidden = self._text_encode_jit(jnp.asarray(ids))
+        mask = (np.arange(self.cfg.max_text_len)[None, :]
+                < lens[:, None]).astype(np.int32)
+        mask = jnp.asarray(mask)
+        # pooled vector: masked mean over real tokens
+        denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
+        pooled = (hidden * mask[..., None]).sum(axis=1) / denom
+        return hidden, mask, pooled.astype(hidden.dtype)
+
+    # ------------------------------------------------------------ denoise
+    def _denoise_fn(self, grid_h, grid_w, sched_len):
+        key = (grid_h, grid_w, sched_len)
+        if key in self._denoise_cache:
+            return self._denoise_cache[key]
+        cfg = self.cfg
+        cache_cfg = self.cache_config
+
+        @jax.jit
+        def run(dit_params, latents, ctx, ctx_mask, pooled, sigmas,
+                timesteps, gscale, num_steps):
+            schedule = fm.FlowMatchSchedule(sigmas=sigmas,
+                                            timesteps=timesteps)
+            b = latents.shape[0]
+            guidance = jnp.broadcast_to(gscale, (b,)).astype(jnp.float32)
+
+            def eval_velocity(lat, i):
+                t = jnp.broadcast_to(timesteps[i], (b,))
+                return fdit.forward(
+                    dit_params, cfg.dit, lat, ctx, pooled, t,
+                    (grid_h, grid_w), guidance=guidance, txt_mask=ctx_mask,
+                )
+
+            return step_cache.run_denoise_loop(
+                cache_cfg, schedule, eval_velocity, latents, num_steps)
+
+        self._denoise_cache[key] = run
+        return run
+
+    # ------------------------------------------------------------ forward
+    def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
+        sp = req.sampling_params
+        cfg = self.cfg
+        mult = cfg.vae.spatial_ratio * cfg.pack
+        if sp.height % mult or sp.width % mult:
+            raise InvalidRequestError(
+                f"height/width must be multiples of {mult}")
+        lat_h = sp.height // cfg.vae.spatial_ratio
+        lat_w = sp.width // cfg.vae.spatial_ratio
+        gh, gw = lat_h // cfg.pack, lat_w // cfg.pack
+        prompts = req.prompt
+        b = len(prompts)
+
+        ctx, ctx_mask, pooled = self.encode_prompt(prompts)
+        seed = (sp.seed if sp.seed is not None
+                else int(np.random.randint(0, 2 ** 31 - 1)))
+        # noise lives in packed-token space [B, gh*gw, C*pack^2]
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (b, gh * gw, cfg.dit.in_channels), self.dtype,
+        )
+        num_steps = sp.num_inference_steps
+        sched_len = max(8, 1 << (num_steps - 1).bit_length())
+        schedule = fm.make_schedule(num_steps, shift=cfg.shift)
+        sigmas = jnp.zeros((sched_len + 1,)).at[: num_steps + 1].set(
+            schedule.sigmas)
+        timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
+            schedule.timesteps)
+        run = self._denoise_fn(gh, gw, sched_len)
+        latents, skipped = run(
+            self.dit_params, noise, ctx, ctx_mask, pooled, sigmas,
+            timesteps, jnp.float32(sp.guidance_scale),
+            jnp.int32(num_steps))
+        self.last_skipped_steps = int(skipped)
+
+        # unpack tokens -> latent grid [B, lat_h, lat_w, C]
+        c = cfg.vae.latent_channels
+        p = cfg.pack
+        lat = latents.reshape(b, gh, gw, p, p, c).transpose(0, 1, 3, 2, 4, 5)
+        lat = lat.reshape(b, lat_h, lat_w, c)
+        imgs = self._vae_decode_jit(self.vae_params, lat)
+        imgs = np.asarray(imgs)
+        imgs = ((np.clip(imgs, -1, 1) + 1) * 127.5).astype(np.uint8)
+        return [
+            DiffusionOutput(
+                request_id=req.request_ids[i], prompt=prompts[i],
+                data=imgs[i], output_type="image",
+            )
+            for i in range(b)
+        ]

@@ -1,0 +1,272 @@
+"""Flux-style MMDiT: double-stream + single-stream joint attention.
+
+Reference: vllm_omni/diffusion/models/flux/ (FluxPipeline,
+diffusion/registry.py:16-102).  The second joint-attention family next to
+Qwen-Image, proving the MMDiT abstraction generalizes (VERDICT r1
+next-step #8): where Qwen-Image runs double-stream blocks end-to-end,
+Flux runs N double-stream blocks (separate text/image projections, joint
+attention) followed by M *single-stream* blocks operating on the
+concatenated sequence with a fused qkv+mlp projection, plus a guidance
+embedding folded into the timestep conditioning.
+
+Same TPU idioms as qwen_image/transformer.py: functional params, Pallas
+flash attention over the joint sequence, 3-axis rope, AdaLN modulation
+fused by XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+
+@dataclass(frozen=True)
+class FluxDiTConfig:
+    in_channels: int = 64  # 16 VAE latent channels x 2x2 packing
+    out_channels: int = 64
+    num_double_blocks: int = 19
+    num_single_blocks: int = 38
+    num_heads: int = 24
+    head_dim: int = 128
+    ctx_dim: int = 4096  # text-encoder feature dim
+    pooled_dim: int = 768  # pooled conditioning vector width
+    axes_dims: tuple[int, int, int] = (16, 56, 56)
+    theta: float = 10000.0
+    mlp_ratio: float = 4.0
+    guidance_embed: bool = True
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @staticmethod
+    def tiny() -> "FluxDiTConfig":
+        return FluxDiTConfig(
+            in_channels=16, out_channels=16, num_double_blocks=2,
+            num_single_blocks=2, num_heads=4, head_dim=32, ctx_dim=64,
+            pooled_dim=64, axes_dims=(8, 12, 12),
+        )
+
+
+def init_params(key, cfg: FluxDiTConfig, dtype=jnp.float32):
+    inner = cfg.inner_dim
+    mlp = int(inner * cfg.mlp_ratio)
+    nblocks = cfg.num_double_blocks + cfg.num_single_blocks
+    keys = jax.random.split(key, nblocks + 10)
+    p = {
+        "img_in": nn.linear_init(keys[0], cfg.in_channels, inner, dtype=dtype),
+        "txt_in": nn.linear_init(keys[1], cfg.ctx_dim, inner, dtype=dtype),
+        "time_in1": nn.linear_init(keys[2], 256, inner, dtype=dtype),
+        "time_in2": nn.linear_init(keys[3], inner, inner, dtype=dtype),
+        "pooled_in1": nn.linear_init(
+            keys[4], cfg.pooled_dim, inner, dtype=dtype),
+        "pooled_in2": nn.linear_init(keys[5], inner, inner, dtype=dtype),
+        "norm_out_mod": nn.linear_init(keys[6], inner, 2 * inner, dtype=dtype),
+        "proj_out": nn.linear_init(
+            keys[7], inner, cfg.out_channels, dtype=dtype),
+        "double": [],
+        "single": [],
+    }
+    if cfg.guidance_embed:
+        p["guidance_in1"] = nn.linear_init(keys[8], 256, inner, dtype=dtype)
+        p["guidance_in2"] = nn.linear_init(keys[9], inner, inner, dtype=dtype)
+    for i in range(cfg.num_double_blocks):
+        k = jax.random.split(keys[i + 10], 12)
+        p["double"].append({
+            "img_mod": nn.linear_init(k[0], inner, 6 * inner, dtype=dtype),
+            "txt_mod": nn.linear_init(k[1], inner, 6 * inner, dtype=dtype),
+            "img_qkv": nn.linear_init(k[2], inner, 3 * inner, dtype=dtype),
+            "txt_qkv": nn.linear_init(k[3], inner, 3 * inner, dtype=dtype),
+            "img_norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "img_norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "txt_norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "txt_norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "img_out": nn.linear_init(k[4], inner, inner, dtype=dtype),
+            "txt_out": nn.linear_init(k[5], inner, inner, dtype=dtype),
+            "img_mlp1": nn.linear_init(k[6], inner, mlp, dtype=dtype),
+            "img_mlp2": nn.linear_init(k[7], mlp, inner, dtype=dtype),
+            "txt_mlp1": nn.linear_init(k[8], inner, mlp, dtype=dtype),
+            "txt_mlp2": nn.linear_init(k[9], mlp, inner, dtype=dtype),
+        })
+    for i in range(cfg.num_single_blocks):
+        k = jax.random.split(keys[cfg.num_double_blocks + i + 10], 4)
+        p["single"].append({
+            "mod": nn.linear_init(k[0], inner, 3 * inner, dtype=dtype),
+            # fused projection: qkv + mlp hidden in one matmul
+            "lin1": nn.linear_init(
+                k[1], inner, 3 * inner + mlp, dtype=dtype),
+            "norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+            # fused output: [attn_out; gelu(mlp)] -> inner
+            "lin2": nn.linear_init(k[2], inner + mlp, inner, dtype=dtype),
+        })
+    return p
+
+
+def rope_freqs(cfg: FluxDiTConfig, grid_h: int, grid_w: int, txt_len: int):
+    """3-axis rope: text tokens at axis position 0 (Flux convention —
+    text ids are zeros), image tokens on the (0, row, col) grid."""
+    half_dims = [d // 2 for d in cfg.axes_dims]
+
+    def axis_freqs(pos, half):
+        inv = 1.0 / (
+            cfg.theta ** (jnp.arange(half, dtype=jnp.float32) / half)
+        )
+        return pos.astype(jnp.float32)[:, None] * inv[None, :]
+
+    r = jnp.arange(grid_h).repeat(grid_w)
+    c = jnp.tile(jnp.arange(grid_w), grid_h)
+    zeros_img = jnp.zeros_like(r)
+    img_angles = jnp.concatenate([
+        axis_freqs(zeros_img, half_dims[0]),
+        axis_freqs(r, half_dims[1]),
+        axis_freqs(c, half_dims[2]),
+    ], axis=-1)
+    zt = jnp.zeros((txt_len,), jnp.int32)
+    txt_angles = jnp.concatenate(
+        [axis_freqs(zt, h) for h in half_dims], axis=-1
+    )
+    # joint layout: text first
+    angles = jnp.concatenate([txt_angles, img_angles], axis=0)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope_apply(x, cos, sin):
+    d = x.shape[-1]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+def _modulate(x, mod3):
+    shift, scale, gate = jnp.split(mod3, 3, axis=-1)
+    xn = nn.layernorm({}, x)
+    return (xn * (1.0 + scale[:, None, :]) + shift[:, None, :],
+            gate[:, None, :])
+
+
+def _heads(x, h):
+    b, s, _ = x.shape
+    return x.reshape(b, s, h, -1)
+
+
+def _double_block(blk, cfg, img, txt, temb_act, freqs, kv_mask):
+    h = cfg.num_heads
+    s_txt = txt.shape[1]
+    img_mod = nn.linear(blk["img_mod"], temb_act)
+    txt_mod = nn.linear(blk["txt_mod"], temb_act)
+    img_mod1, img_mod2 = jnp.split(img_mod, 2, axis=-1)
+    txt_mod1, txt_mod2 = jnp.split(txt_mod, 2, axis=-1)
+
+    img_n, img_gate1 = _modulate(img, img_mod1)
+    txt_n, txt_gate1 = _modulate(txt, txt_mod1)
+    qi, ki, vi = jnp.split(nn.linear(blk["img_qkv"], img_n), 3, axis=-1)
+    qt, kt, vt = jnp.split(nn.linear(blk["txt_qkv"], txt_n), 3, axis=-1)
+    qi = rms_norm(_heads(qi, h), blk["img_norm_q"]["w"])
+    ki = rms_norm(_heads(ki, h), blk["img_norm_k"]["w"])
+    qt = rms_norm(_heads(qt, h), blk["txt_norm_q"]["w"])
+    kt = rms_norm(_heads(kt, h), blk["txt_norm_k"]["w"])
+    q = _rope_apply(jnp.concatenate([qt, qi], 1), *freqs)
+    k = _rope_apply(jnp.concatenate([kt, ki], 1), *freqs)
+    v = jnp.concatenate([_heads(vt, h), _heads(vi, h)], 1)
+    o = flash_attention(q, k, v, causal=False, kv_mask=kv_mask)
+    txt_o = o[:, :s_txt].reshape(*txt.shape[:2], -1)
+    img_o = o[:, s_txt:].reshape(*img.shape[:2], -1)
+
+    img = img + img_gate1 * nn.linear(blk["img_out"], img_o)
+    txt = txt + txt_gate1 * nn.linear(blk["txt_out"], txt_o)
+    img_n2, img_gate2 = _modulate(img, img_mod2)
+    img = img + img_gate2 * nn.linear(
+        blk["img_mlp2"],
+        jax.nn.gelu(nn.linear(blk["img_mlp1"], img_n2), approximate=True))
+    txt_n2, txt_gate2 = _modulate(txt, txt_mod2)
+    txt = txt + txt_gate2 * nn.linear(
+        blk["txt_mlp2"],
+        jax.nn.gelu(nn.linear(blk["txt_mlp1"], txt_n2), approximate=True))
+    return img, txt
+
+
+def _single_block(blk, cfg, x, temb_act, freqs, kv_mask):
+    """Concatenated-stream block: one fused qkv+mlp projection, one fused
+    output projection (the Flux single-stream shape)."""
+    h = cfg.num_heads
+    inner = cfg.inner_dim
+    x_n, gate = _modulate(x, nn.linear(blk["mod"], temb_act))
+    fused = nn.linear(blk["lin1"], x_n)
+    qkv, mlp_h = fused[..., : 3 * inner], fused[..., 3 * inner:]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = rms_norm(_heads(q, h), blk["norm_q"]["w"])
+    k = rms_norm(_heads(k, h), blk["norm_k"]["w"])
+    q = _rope_apply(q, *freqs)
+    k = _rope_apply(k, *freqs)
+    o = flash_attention(q, k, _heads(v, h), causal=False, kv_mask=kv_mask)
+    o = o.reshape(*x.shape[:2], -1)
+    out = nn.linear(
+        blk["lin2"],
+        jnp.concatenate(
+            [o, jax.nn.gelu(mlp_h, approximate=True)], axis=-1),
+    )
+    return x + gate * out
+
+
+def forward(
+    params,
+    cfg: FluxDiTConfig,
+    img_tokens: jax.Array,  # [B, S_img, in_channels] packed latents
+    txt_states: jax.Array,  # [B, S_txt, ctx_dim]
+    pooled: jax.Array,  # [B, pooled_dim] pooled conditioning
+    timesteps: jax.Array,  # [B] in [0, 1000)
+    grid_hw: tuple[int, int],
+    guidance: Optional[jax.Array] = None,  # [B] guidance scale embedding
+    txt_mask: Optional[jax.Array] = None,  # [B, S_txt]
+) -> jax.Array:
+    """Returns velocity prediction [B, S_img, out_channels]."""
+    img = nn.linear(params["img_in"], img_tokens)
+    txt = nn.linear(params["txt_in"], txt_states)
+    b, s_img = img.shape[:2]
+    s_txt = txt.shape[1]
+
+    temb = nn.timestep_embedding(timesteps, 256).astype(img.dtype)
+    temb = nn.linear(params["time_in2"],
+                     jax.nn.silu(nn.linear(params["time_in1"], temb)))
+    temb = temb + nn.linear(
+        params["pooled_in2"],
+        jax.nn.silu(nn.linear(params["pooled_in1"], pooled)))
+    if cfg.guidance_embed:
+        g = guidance if guidance is not None else jnp.ones((b,), jnp.float32)
+        gemb = nn.timestep_embedding(g * 1000.0, 256).astype(img.dtype)
+        temb = temb + nn.linear(
+            params["guidance_in2"],
+            jax.nn.silu(nn.linear(params["guidance_in1"], gemb)))
+    temb_act = jax.nn.silu(temb)
+
+    freqs = rope_freqs(cfg, grid_hw[0], grid_hw[1], s_txt)
+    kv_mask = None
+    if txt_mask is not None:
+        kv_mask = jnp.concatenate(
+            [txt_mask.astype(jnp.int32), jnp.ones((b, s_img), jnp.int32)],
+            axis=1,
+        )
+
+    for blk in params["double"]:
+        img, txt = _double_block(blk, cfg, img, txt, temb_act, freqs, kv_mask)
+    x = jnp.concatenate([txt, img], axis=1)
+    for blk in params["single"]:
+        x = _single_block(blk, cfg, x, temb_act, freqs, kv_mask)
+    img = x[:, s_txt:]
+
+    mod = nn.linear(params["norm_out_mod"], temb_act)
+    scale, shift = jnp.split(mod, 2, axis=-1)
+    img = nn.layernorm({}, img) * (1.0 + scale[:, None, :]) \
+        + shift[:, None, :]
+    return nn.linear(params["proj_out"], img)

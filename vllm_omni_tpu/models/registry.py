@@ -39,6 +39,23 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "WanT2VPipeline": _Entry(
         "vllm_omni_tpu.models.wan.pipeline", "WanT2VPipeline"
     ),
+    # image(+text)-to-video: first frame anchored via VAE-latent + mask
+    # conditioning channels (reference: WanImageToVideoPipeline /
+    # Wan2.2 TI2V, diffusion/registry.py:16-102)
+    "WanImageToVideoPipeline": _Entry(
+        "vllm_omni_tpu.models.wan.pipeline", "WanI2VPipeline"
+    ),
+    "WanI2VPipeline": _Entry(
+        "vllm_omni_tpu.models.wan.pipeline", "WanI2VPipeline"
+    ),
+    "WanTI2VPipeline": _Entry(
+        "vllm_omni_tpu.models.wan.pipeline", "WanI2VPipeline"
+    ),
+    # joint-attention MMDiT sibling (reference: FluxPipeline,
+    # diffusion/registry.py:16-102)
+    "FluxPipeline": _Entry(
+        "vllm_omni_tpu.models.flux.pipeline", "FluxPipeline"
+    ),
     # audio (reference: StableAudio family)
     "StableAudioPipeline": _Entry(
         "vllm_omni_tpu.models.stable_audio.pipeline", "StableAudioPipeline"

@@ -1,12 +1,13 @@
 """Wan-style text-to-video pipeline.
 
-Reference: vllm_omni/diffusion/models/wan2_2/ — Wan2.2 T2V
-(pipeline: text encode → flow-match denoise over video latents → VAE
-decode).  TPU-first like the image pipeline: the whole denoise loop is one
-jitted fori_loop with a dynamic step bound; frames ride a leading latent
-axis and decode through the image VAE per frame (the reference's
-temporally-compressing video VAE is a follow-up — frame-wise decode keeps
-the same output contract at tiny/bench scales).
+Reference: vllm_omni/diffusion/models/wan2_2/ — Wan2.2 T2V / I2V / TI2V
+(pipeline: text encode [+ first-frame image encode] → flow-match denoise
+over video latents → VAE decode).  TPU-first like the image pipeline: the
+whole denoise loop is one jitted fori_loop with a dynamic step bound;
+latents ride the temporally-compressed layout of the causal video VAE
+(video_vae.py — 1 + (F-1)/r latent frames), and I2V conditions the DiT on
+the first frame's VAE latent plus a presence-mask channel concatenated
+channel-wise (the reference's y/mask conditioning).
 """
 
 from __future__ import annotations
@@ -30,9 +31,9 @@ from vllm_omni_tpu.models.common.transformer import (
     forward_hidden,
     init_params as init_text_params,
 )
-from vllm_omni_tpu.models.qwen_image import vae as vae_mod
-from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
 from vllm_omni_tpu.models.wan import transformer as wdit
+from vllm_omni_tpu.models.wan import video_vae as vvae
+from vllm_omni_tpu.models.wan.video_vae import VideoVAEConfig
 from vllm_omni_tpu.models.wan.transformer import WanDiTConfig
 from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
 
@@ -43,7 +44,7 @@ logger = init_logger(__name__)
 class WanPipelineConfig:
     text: TransformerConfig = field(default_factory=TransformerConfig)
     dit: WanDiTConfig = field(default_factory=WanDiTConfig)
-    vae: VAEConfig = field(default_factory=VAEConfig)
+    vae: VideoVAEConfig = field(default_factory=VideoVAEConfig)
     max_text_len: int = 64
     flow_shift: float = 3.0
 
@@ -52,8 +53,21 @@ class WanPipelineConfig:
         return WanPipelineConfig(
             text=TransformerConfig.tiny(vocab_size=256),
             dit=WanDiTConfig.tiny(),
-            vae=VAEConfig.tiny(),
+            vae=VideoVAEConfig.tiny(),
         )
+
+    @staticmethod
+    def tiny_i2v() -> "WanPipelineConfig":
+        """I2V tiny: DiT consumes [noise, cond_latent, mask] channels."""
+        import dataclasses
+
+        base = WanPipelineConfig.tiny()
+        dit = dataclasses.replace(
+            base.dit,
+            in_channels=2 * base.vae.latent_channels + 1,
+            out_channels=base.vae.latent_channels,
+        )
+        return dataclasses.replace(base, dit=dit)
 
 
 class WanT2VPipeline:
@@ -73,14 +87,22 @@ class WanT2VPipeline:
         logger.info("Initializing WanT2VPipeline (dtype=%s)", dtype)
         self.text_params = init_text_params(k1, config.text, dtype)
         self.dit_params = wdit.init_params(k2, config.dit, dtype)
-        self.vae_params = vae_mod.init_decoder(k3, config.vae, dtype)
+        self.vae_params = vvae.init_decoder(k3, config.vae, dtype)
+        self.vae_encoder_params = None  # built on demand (I2V conditioning)
+        self._seed = seed
         self._denoise_cache: dict = {}
+        # jitted helpers built ONCE — a fresh jax.jit(lambda) per request
+        # would miss the jit cache and recompile every call
+        self._text_encode_jit = jax.jit(
+            lambda i: forward_hidden(self.text_params, self.cfg.text, i))
+        self._vae_decode_jit = jax.jit(
+            lambda pp, l: vvae.decode(pp, self.cfg.vae, l))
+        self._vae_encode_jit = jax.jit(
+            lambda pp, v: vvae.encode(pp, self.cfg.vae, v))
 
     def encode_prompt(self, prompts: list[str]):
         ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
-        hidden = jax.jit(
-            lambda i: forward_hidden(self.text_params, self.cfg.text, i)
-        )(jnp.asarray(ids))
+        hidden = self._text_encode_jit(jnp.asarray(ids))
         mask = (np.arange(self.cfg.max_text_len)[None, :]
                 < lens[:, None]).astype(np.int32)
         return hidden, jnp.asarray(mask)
@@ -95,7 +117,7 @@ class WanT2VPipeline:
 
         @jax.jit
         def run(dit_params, latents, ctx, ctx_mask, neg_ctx, neg_mask,
-                sigmas, timesteps, gscale, num_steps):
+                sigmas, timesteps, gscale, num_steps, cond=None):
             schedule = fm.FlowMatchSchedule(sigmas=sigmas,
                                             timesteps=timesteps)
             do_cfg = neg_ctx is not None
@@ -105,7 +127,12 @@ class WanT2VPipeline:
 
             def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
-                lat_in = jnp.concatenate([lat, lat], 0) if do_cfg else lat
+                # I2V: first-frame latent + presence mask ride extra
+                # channels (the reference y/mask conditioning)
+                lat_model = (lat if cond is None
+                             else jnp.concatenate([lat, cond], axis=-1))
+                lat_in = (jnp.concatenate([lat_model, lat_model], 0)
+                          if do_cfg else lat_model)
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
                 v = wdit.forward(dit_params, cfg.dit, lat_in, ctx_all, t_in,
                                  ctx_mask=mask_all)
@@ -128,6 +155,9 @@ class WanT2VPipeline:
         if sp.height % mult or sp.width % mult:
             raise InvalidRequestError(f"height/width must be multiples of {mult}")
         frames = max(1, sp.num_frames)
+        lat_frames = cfg.vae.latent_frames(frames)
+        # decode covers >= requested frames; trim to the request
+        out_frames = cfg.vae.pixel_frames(lat_frames)
         lat_h, lat_w = sp.height // ratio, sp.width // ratio
         prompts = req.prompt
         b = len(prompts)
@@ -141,10 +171,12 @@ class WanT2VPipeline:
 
         seed = (sp.seed if sp.seed is not None
                 else int(np.random.randint(0, 2 ** 31 - 1)))
+        lat_ch = cfg.vae.latent_channels
         noise = jax.random.normal(
             jax.random.PRNGKey(seed),
-            (b, frames, lat_h, lat_w, cfg.dit.in_channels), self.dtype,
+            (b, lat_frames, lat_h, lat_w, lat_ch), self.dtype,
         )
+        cond = self._make_cond(req, b, lat_frames, lat_h, lat_w)
         num_steps = sp.num_inference_steps
         sched_len = max(8, 1 << (num_steps - 1).bit_length())
         schedule = fm.make_schedule(num_steps, shift=cfg.flow_shift)
@@ -152,23 +184,21 @@ class WanT2VPipeline:
             schedule.sigmas)
         timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
             schedule.timesteps)
-        run = self._denoise_fn(frames, lat_h // cfg.dit.patch_size,
+        run = self._denoise_fn(lat_frames, lat_h // cfg.dit.patch_size,
                                lat_w // cfg.dit.patch_size, sched_len)
         latents, skipped = run(
             self.dit_params, noise, ctx, ctx_mask, neg_ctx,
             neg_mask, sigmas, timesteps,
-            jnp.float32(sp.guidance_scale), jnp.int32(num_steps))
+            jnp.float32(sp.guidance_scale), jnp.int32(num_steps),
+            cond=cond)
         self.last_skipped_steps = int(skipped)
 
-        # frame-wise VAE decode: [B, F, h, w, C] -> [B*F, ...] -> frames
-        bf = latents.reshape(b * frames, lat_h, lat_w,
-                             cfg.dit.out_channels)
-        imgs = jax.jit(
-            lambda p, l: vae_mod.decode(p, cfg.vae, l)
-        )(self.vae_params, bf)
+        # temporal VAE decode: [B, Tl, h, w, C] -> [B, F, H, W, 3]
+        imgs = self._vae_decode_jit(self.vae_params, latents)
         imgs = np.asarray(imgs)
         video = ((np.clip(imgs, -1, 1) + 1) * 127.5).astype(np.uint8)
-        video = video.reshape(b, frames, sp.height, sp.width, 3)
+        video = video.reshape(b, out_frames, sp.height, sp.width, 3)
+        video = video[:, :frames]
         return [
             DiffusionOutput(
                 request_id=req.request_ids[i], prompt=prompts[i],
@@ -176,3 +206,57 @@ class WanT2VPipeline:
             )
             for i in range(b)
         ]
+
+
+    def _make_cond(self, req, b, lat_frames, lat_h, lat_w):
+        """T2V: no conditioning channels."""
+        return None
+
+
+class WanI2VPipeline(WanT2VPipeline):
+    """Image(+text) -> video: the first output frame is anchored to the
+    input image via VAE-latent + presence-mask conditioning channels
+    (reference: Wan2.2 I2V/TI2V, diffusion/models/wan2_2/)."""
+
+    needs_image_cond = True
+
+    def __init__(self, config: WanPipelineConfig, **kw):
+        want = 2 * config.vae.latent_channels + 1
+        if config.dit.in_channels != want:
+            raise ValueError(
+                "I2V DiT must consume [noise, cond, mask] channels: "
+                f"in_channels must be {want} (2*latent+mask), got "
+                f"{config.dit.in_channels} — use a *_i2v config preset"
+            )
+        super().__init__(config, **kw)
+
+    def _make_cond(self, req, b, lat_frames, lat_h, lat_w):
+        sp = req.sampling_params
+        image = sp.image if sp.image is not None else sp.extra.get("image")
+        if image is None:
+            raise InvalidRequestError(
+                "I2V pipeline needs sampling_params.image (first frame)"
+            )
+        if self.vae_encoder_params is None:
+            self.vae_encoder_params = vvae.init_encoder(
+                jax.random.PRNGKey(self._seed + 1), self.cfg.vae, self.dtype
+            )
+        img = np.asarray(image)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 127.5 - 1.0
+        ratio = self.cfg.vae.spatial_ratio
+        if img.shape[:2] != (lat_h * ratio, lat_w * ratio):
+            raise InvalidRequestError(
+                f"conditioning image must be {lat_h * ratio}x"
+                f"{lat_w * ratio}, got {img.shape[:2]}"
+            )
+        # encode as a 1-frame clip -> [1, 1, h, w, C]
+        z = self._vae_encode_jit(
+            self.vae_encoder_params,
+            jnp.asarray(img, self.dtype)[None, None])
+        lat_ch = self.cfg.vae.latent_channels
+        cond = jnp.zeros((b, lat_frames, lat_h, lat_w, lat_ch), self.dtype)
+        cond = cond.at[:, 0].set(z[0, 0])
+        mask = jnp.zeros((b, lat_frames, lat_h, lat_w, 1), self.dtype)
+        mask = mask.at[:, 0].set(1.0)
+        return jnp.concatenate([cond, mask], axis=-1)

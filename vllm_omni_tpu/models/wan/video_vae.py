@@ -1,0 +1,249 @@
+"""Temporally-compressing causal video VAE (functional JAX, NTHWC).
+
+Role of the reference's Wan2.2 video autoencoder (reference:
+vllm_omni/diffusion/models/wan2_2/ — 4x temporal + 8x spatial compression
+with the first frame coded independently, so F frames map to
+``1 + (F-1)/4`` latent frames).  r1 decoded video frame-wise through the
+image VAE (VERDICT row 50); this module adds the real temporal axis.
+
+TPU-first design: factorized (2+1)-D convolutions — the spatial half is
+the image VAE's conv stack applied per frame (XLA batches frames into one
+conv), the temporal half is a *causal* k=3 temporal convolution expressed
+as a shifted-sum (einsum over 3 taps — no 3-D conv lowering needed, MXU
+does the channel contraction).  Temporal up/down-sampling is stride-2 with
+the first frame passed through, matching the 1+(F-1)/r latent layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+
+
+@dataclass(frozen=True)
+class VideoVAEConfig:
+    latent_channels: int = 16
+    base_channels: int = 96
+    channel_multipliers: tuple[int, ...] = (1, 2, 4, 4)
+    temporal_stages: int = 2  # 2 stride-2 stages -> 4x temporal
+    layers_per_block: int = 2
+    scaling_factor: float = 1.0
+
+    @property
+    def spatial_ratio(self) -> int:
+        return 2 ** (len(self.channel_multipliers) - 1)
+
+    @property
+    def temporal_ratio(self) -> int:
+        return 2 ** self.temporal_stages
+
+    def latent_frames(self, frames: int) -> int:
+        """F pixel frames -> latent frames covering them (first frame
+        independent; non-canonical F rounds UP so callers can trim the
+        decoded clip to the requested length)."""
+        if frames < 1:
+            raise ValueError("need at least one frame")
+        return 1 + -(-(frames - 1) // self.temporal_ratio)
+
+    def pixel_frames(self, latent_frames: int) -> int:
+        return 1 + (latent_frames - 1) * self.temporal_ratio
+
+    @staticmethod
+    def tiny() -> "VideoVAEConfig":
+        return VideoVAEConfig(
+            latent_channels=4,
+            base_channels=16,
+            channel_multipliers=(1, 2),
+            temporal_stages=1,
+            layers_per_block=1,
+        )
+
+
+# ------------------------------------------------------------- primitives
+def _causal_tconv_init(key, ch, dtype, taps: int = 3):
+    """Per-channel-mixing causal temporal conv: taps x [C, C] kernels."""
+    ks = jax.random.split(key, taps)
+    scale = 1.0 / (ch * taps) ** 0.5
+    return {
+        "w": jnp.stack([
+            jax.random.uniform(k, (ch, ch), dtype, -scale, scale)
+            for k in ks
+        ]),
+        "b": jnp.zeros((ch,), dtype),
+    }
+
+
+def _causal_tconv(p, x):
+    """x [B, T, H, W, C]: y_t = sum_j w_j @ x_{t-taps+1+j} with the front
+    padded by replicating frame 0 (causal — no future leakage)."""
+    taps = p["w"].shape[0]
+    front = jnp.repeat(x[:, :1], taps - 1, axis=1)
+    xp = jnp.concatenate([front, x], axis=1)
+    t = x.shape[1]
+    y = 0.0
+    for j in range(taps):
+        y = y + jnp.einsum("bthwc,cd->bthwd", xp[:, j: j + t], p["w"][j])
+    return y + p["b"]
+
+
+def _sconv_init(key, cin, cout, dtype, k: int = 3):
+    return nn.conv2d_init(key, cin, cout, k, dtype=dtype)
+
+
+def _sconv(p, x):
+    """Spatial 3x3 conv applied per frame: fold T into batch."""
+    b, t, h, w, c = x.shape
+    y = nn.conv2d(p, x.reshape(b * t, h, w, c))
+    return y.reshape(b, t, h, w, -1)
+
+
+def _block_init(key, cin, cout, dtype):
+    k = jax.random.split(key, 4)
+    p = {
+        "norm1": nn.groupnorm_init(cin, dtype),
+        "conv1": _sconv_init(k[0], cin, cout, dtype),
+        "tconv": _causal_tconv_init(k[1], cout, dtype),
+        "norm2": nn.groupnorm_init(cout, dtype),
+        "conv2": _sconv_init(k[2], cout, cout, dtype),
+    }
+    if cin != cout:
+        p["skip"] = nn.linear_init(k[3], cin, cout, bias=False, dtype=dtype)
+    return p
+
+
+def _block(p, x):
+    """(2+1)-D resnet block: spatial conv → causal temporal conv →
+    spatial conv, with gelu-ish (silu) nonlinearities."""
+    b, t, h, w, c = x.shape
+    y = nn.groupnorm(p["norm1"], x.reshape(b * t, h, w, c))
+    y = jax.nn.silu(y).reshape(b, t, h, w, c)
+    y = _sconv(p["conv1"], y)
+    y = y + _causal_tconv(p["tconv"], y)
+    y2 = nn.groupnorm(p["norm2"], y.reshape(b * t, h, w, y.shape[-1]))
+    y2 = jax.nn.silu(y2).reshape(y.shape)
+    y2 = _sconv(p["conv2"], y2)
+    skip = x if "skip" not in p else x @ p["skip"]["w"]
+    return skip + y2
+
+
+def _t_upsample(x):
+    """Temporal 2x: first frame stays single, later frames repeat —
+    T -> 1 + (T-1)*2 (inverse of the causal stride-2 downsample)."""
+    first = x[:, :1]
+    rest = jnp.repeat(x[:, 1:], 2, axis=1)
+    return jnp.concatenate([first, rest], axis=1)
+
+
+def _t_downsample(x):
+    """Temporal stride-2 keeping frame 0: T -> 1 + (T-1)//2."""
+    return jnp.concatenate([x[:, :1], x[:, 1::2]], axis=1)
+
+
+def _s_upsample(x):
+    b, t, h, w, c = x.shape
+    y = jax.image.resize(
+        x.reshape(b * t, h, w, c), (b * t, 2 * h, 2 * w, c), "nearest"
+    )
+    return y.reshape(b, t, 2 * h, 2 * w, c)
+
+
+def _s_downsample(x):
+    b, t, h, w, c = x.shape
+    return x.reshape(b, t, h // 2, 2, w // 2, 2, c).mean(axis=(3, 5))
+
+
+# ---------------------------------------------------------------- decoder
+def init_decoder(key, cfg: VideoVAEConfig, dtype=jnp.float32):
+    mults = cfg.channel_multipliers
+    chans = [cfg.base_channels * m for m in mults]
+    keys = jax.random.split(key, 3 + len(mults) * (cfg.layers_per_block + 1))
+    p = {
+        "conv_in": _sconv_init(keys[0], cfg.latent_channels, chans[-1], dtype),
+        "stages": [],
+        "norm_out": nn.groupnorm_init(chans[0], dtype),
+        "conv_out": _sconv_init(keys[1], chans[0], 3, dtype),
+    }
+    ki = 2
+    # top (smallest) to bottom: spatial up per stage transition
+    for si in range(len(mults) - 1, -1, -1):
+        cin = chans[min(si + 1, len(mults) - 1)]
+        cout = chans[si]
+        blocks = []
+        for li in range(cfg.layers_per_block):
+            blocks.append(_block_init(
+                keys[ki], cin if li == 0 else cout, cout, dtype))
+            ki += 1
+        p["stages"].append({"blocks": blocks})
+    return p
+
+
+def decode(p, cfg: VideoVAEConfig, latents: jax.Array) -> jax.Array:
+    """[B, Tl, h, w, C] latents -> [B, F, H, W, 3] pixels in [-1, 1]."""
+    x = latents / cfg.scaling_factor
+    x = _sconv(p["conv_in"], x)
+    n = len(cfg.channel_multipliers)
+    for si, stage in enumerate(p["stages"]):
+        for blk in stage["blocks"]:
+            x = _block(blk, x)
+        if si < n - 1:
+            x = _s_upsample(x)
+        if si < cfg.temporal_stages:
+            x = _t_upsample(x)
+    b, t, h, w, c = x.shape
+    x = nn.groupnorm(p["norm_out"], x.reshape(b * t, h, w, c))
+    x = jax.nn.silu(x)
+    x = nn.conv2d(p["conv_out"], x).reshape(b, t, h, w, 3)
+    return jnp.tanh(x)
+
+
+# ---------------------------------------------------------------- encoder
+def init_encoder(key, cfg: VideoVAEConfig, dtype=jnp.float32):
+    mults = cfg.channel_multipliers
+    chans = [cfg.base_channels * m for m in mults]
+    keys = jax.random.split(key, 3 + len(mults) * (cfg.layers_per_block + 1))
+    p = {
+        "conv_in": _sconv_init(keys[0], 3, chans[0], dtype),
+        "stages": [],
+        "norm_out": nn.groupnorm_init(chans[-1], dtype),
+        "conv_out": _sconv_init(
+            keys[1], chans[-1], cfg.latent_channels, dtype),
+    }
+    ki = 2
+    for si in range(len(mults)):
+        cin = chans[max(si - 1, 0)]
+        cout = chans[si]
+        blocks = []
+        for li in range(cfg.layers_per_block):
+            blocks.append(_block_init(
+                keys[ki], cin if li == 0 else cout, cout, dtype))
+            ki += 1
+        p["stages"].append({"blocks": blocks})
+    return p
+
+
+def encode(p, cfg: VideoVAEConfig, video: jax.Array) -> jax.Array:
+    """[B, F, H, W, 3] pixels in [-1, 1] -> [B, Tl, h, w, C] latents
+    (mean of the posterior — deterministic conditioning encode)."""
+    f = video.shape[1]
+    if (f - 1) % cfg.temporal_ratio:
+        raise ValueError(
+            f"frame count must be 1 + k*{cfg.temporal_ratio}, got {f}"
+        )
+    x = _sconv(p["conv_in"], video)
+    n = len(cfg.channel_multipliers)
+    for si, stage in enumerate(p["stages"]):
+        for blk in stage["blocks"]:
+            x = _block(blk, x)
+        if si < n - 1:
+            x = _s_downsample(x)
+        if si < cfg.temporal_stages:
+            x = _t_downsample(x)
+    b, t, h, w, c = x.shape
+    x = nn.groupnorm(p["norm_out"], x.reshape(b * t, h, w, c))
+    x = jax.nn.silu(x)
+    x = nn.conv2d(p["conv_out"], x).reshape(b, t, h, w, -1)
+    return x * cfg.scaling_factor

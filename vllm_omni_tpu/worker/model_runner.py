@@ -415,6 +415,7 @@ class ARModelRunner:
                 if d != acc[-1]:
                     break  # draft j diverges from the true token
                 acc.append(int(greedy[i, j + 1]))
+            acc = self._truncate_at_stop(req, acc)
             out.sampled[req.request_id] = acc
             accepted_idx.append(len(acc) - 1)
             self.spec_stats["proposed"] += len(drafts)
@@ -427,6 +428,28 @@ class ARModelRunner:
         last_hidden = hidden[jnp.arange(len(scheds)),
                              jnp.asarray(accepted_idx)]
         self._maybe_draft(scheds, last_hidden, out)
+
+    @staticmethod
+    def _truncate_at_stop(req, acc: list[int]) -> list[int]:
+        """Trim an accepted spec run at the first stop condition (eos /
+        stop token / max_tokens), keeping the stopping token.  The
+        scheduler re-checks per appended token; trimming here keeps the
+        collect_hidden payload aligned with the tokens actually emitted
+        (hidden rows past the stop would otherwise ship downstream)."""
+        sp = req.sampling_params
+        eos = req.eos_token_id
+        n_out = len(req.output_token_ids)
+        for idx, t in enumerate(acc):
+            n = n_out + idx + 1
+            if n >= sp.min_tokens:
+                eos_hit = (t in eos if isinstance(eos, (list, tuple))
+                           else t == eos) if eos is not None else False
+                if (not sp.ignore_eos and eos_hit) \
+                        or t in sp.stop_token_ids:
+                    return acc[: idx + 1]
+            if n >= sp.max_tokens:
+                return acc[: idx + 1]
+        return acc
 
     def _maybe_draft(self, scheds: list[ScheduledRequest],
                      last_hidden, out: RunnerOutput):
