@@ -1,0 +1,137 @@
+"""Observability (VERDICT r1 next-step #10): jax.profiler fan-out,
+per-stage stats.jsonl, and the online serving bench."""
+
+import glob
+import json
+import os
+import threading
+
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig
+
+
+def _llm_stage(stage_id=0, sources=None, final=True):
+    return StageConfig(
+        stage_id=stage_id,
+        stage_type="llm",
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=sources if sources is not None else [-1],
+        final_output=final,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0, "max_tokens": 4},
+    )
+
+
+# -------------------------------------------------------------- profiler
+def test_profiler_fanout_writes_xplane_trace(tmp_path):
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    omni = Omni(stage_configs=[_llm_stage()])
+    trace_dir = str(tmp_path / "traces")
+    omni.start_profile(trace_dir)
+    omni.generate([[1, 2, 3]])
+    omni.stop_profile()
+    # jax.profiler writes plugins/profile/<ts>/*.xplane.pb under the dir
+    found = glob.glob(
+        os.path.join(trace_dir, "stage_0", "**", "*.xplane.pb"),
+        recursive=True)
+    assert found, f"no xplane trace under {trace_dir}"
+
+
+def test_profiler_single_process_owner(tmp_path):
+    """Two in-proc stages share one process: only one jax trace runs and
+    stop/start sequencing stays consistent."""
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    cfgs = [_llm_stage(0, sources=[-1], final=False),
+            _llm_stage(1, sources=[0], final=True)]
+    omni = Omni(stage_configs=cfgs)
+    d = str(tmp_path / "t2")
+    omni.start_profile(d)   # second stage start must be a harmless no-op
+    omni.generate([[1, 2, 3]])
+    omni.stop_profile()
+    assert glob.glob(os.path.join(d, "stage_0", "**", "*.xplane.pb"),
+                     recursive=True)
+    # and a second full cycle works (owner released)
+    omni.start_profile(d + "b")
+    omni.stop_profile()
+
+
+# ------------------------------------------------------ per-stage jsonl
+def test_per_stage_stats_jsonl(tmp_path):
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    prefix = str(tmp_path / "run1")
+    cfgs = [_llm_stage(0, sources=[-1], final=False),
+            _llm_stage(1, sources=[0], final=True)]
+    omni = Omni(stage_configs=cfgs, stats_path=prefix)
+    omni.generate([[1, 2, 3], [4, 5]])
+
+    for sid in (0, 1):
+        path = f"{prefix}.stage{sid}.stats.jsonl"
+        assert os.path.exists(path), path
+        recs = [json.loads(l) for l in open(path)]
+        assert len(recs) == 2
+        assert {r["stage_id"] for r in recs} == {sid}
+        assert all(r["tokens_out"] == 4 for r in recs)
+    e2e = [json.loads(l) for l in open(f"{prefix}.e2e.stats.jsonl")]
+    assert len(e2e) == 2 and all(r["e2e_ms"] >= 0 for r in e2e)
+
+
+# -------------------------------------------------------- serving bench
+@pytest.fixture(scope="module")
+def bench_server_url():
+    from vllm_omni_tpu.entrypoints.openai.api_server import build_server
+
+    server, state = build_server(
+        model="bench-tiny", stage_configs=[_llm_stage()],
+        host="127.0.0.1", port=0,
+    )
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    state.shutdown()
+
+
+def test_serving_bench_chat_percentiles(bench_server_url):
+    from vllm_omni_tpu.benchmarks.serving import run_bench
+
+    report = run_bench(
+        bench_server_url, endpoint="chat", num_requests=6, concurrency=2,
+        max_tokens=4, stream=True,
+    )
+    assert report["num_requests"] == 6
+    assert report["num_errors"] == 0
+    assert report["requests_per_s"] > 0
+    assert report["e2e_ms"]["p50"] > 0
+    assert report["e2e_ms"]["p99"] >= report["e2e_ms"]["p50"]
+    # streaming gives TTFT
+    assert report["ttft_ms"]["p50"] > 0
+    assert report["ttft_ms"]["p50"] <= report["e2e_ms"]["p99"]
+
+
+def test_serving_bench_nonstream(bench_server_url):
+    from vllm_omni_tpu.benchmarks.serving import run_bench
+
+    report = run_bench(
+        bench_server_url, endpoint="chat", num_requests=3, concurrency=3,
+        max_tokens=4, stream=False,
+    )
+    assert report["num_errors"] == 0 and "ttft_ms" not in report
+
+
+def test_serving_bench_cli(bench_server_url, capsys):
+    from vllm_omni_tpu.entrypoints.cli.main import main
+
+    rc = main(["bench-serve", "--base-url", bench_server_url,
+               "--num-requests", "2", "--concurrency", "1",
+               "--max-tokens", "3"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["num_requests"] == 2 and report["num_errors"] == 0

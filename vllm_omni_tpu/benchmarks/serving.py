@@ -1,0 +1,199 @@
+"""Online serving benchmark: hits the OpenAI server, reports percentiles.
+
+The TPU counterpart of the reference's serving benchmarks (reference:
+benchmarks/diffusion/diffusion_benchmark_serving.py — request throughput,
+latency percentiles, per-request SLO attainment; in-tree
+``vllm bench serve --omni``, vllm_omni/benchmarks/serve.py:8).
+
+Drives ``/v1/chat/completions`` (streaming SSE for TTFT or non-streaming)
+or ``/v1/images/generations`` with a bounded concurrency worker pool, and
+prints one JSON report: throughput, TTFT (streaming) and E2E latency
+p50/p90/p99, and error counts.  Pure stdlib (http.client + threads) so it
+runs anywhere the server does.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class BenchResult:
+    num_requests: int = 0
+    num_errors: int = 0
+    duration_s: float = 0.0
+    e2e_ms: list = field(default_factory=list)
+    ttft_ms: list = field(default_factory=list)
+
+    @staticmethod
+    def _pct(xs: list, p: float) -> float:
+        """Nearest-rank percentile: ceil(p*n)-1 (int(p*n) would bias
+        high — p50 of [10, 20] must be 10, not 20)."""
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        idx = max(0, -(-int(p * 100 * len(xs)) // 100) - 1)
+        return xs[min(len(xs) - 1, idx)]
+
+    def report(self) -> dict:
+        ok = self.num_requests - self.num_errors
+        out = {
+            "num_requests": self.num_requests,
+            "num_errors": self.num_errors,
+            "duration_s": round(self.duration_s, 3),
+            "requests_per_s": round(ok / self.duration_s, 4)
+            if self.duration_s else 0.0,
+            "e2e_ms": {
+                "p50": round(self._pct(self.e2e_ms, 0.50), 2),
+                "p90": round(self._pct(self.e2e_ms, 0.90), 2),
+                "p99": round(self._pct(self.e2e_ms, 0.99), 2),
+            },
+        }
+        if self.ttft_ms:
+            out["ttft_ms"] = {
+                "p50": round(self._pct(self.ttft_ms, 0.50), 2),
+                "p90": round(self._pct(self.ttft_ms, 0.90), 2),
+                "p99": round(self._pct(self.ttft_ms, 0.99), 2),
+            }
+        return out
+
+
+def _one_chat(base_url: str, prompt: str, max_tokens: int,
+              stream: bool, result: BenchResult, lock: threading.Lock):
+    body = json.dumps({
+        "model": "bench",
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "stream": stream,
+    }).encode()
+    req = urllib.request.Request(
+        f"{base_url}/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    ttft = None
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            if stream:
+                for line in resp:
+                    if line.startswith(b"data:") and ttft is None:
+                        ttft = (time.perf_counter() - t0) * 1e3
+                    if line.strip() == b"data: [DONE]":
+                        break
+            else:
+                resp.read()
+        e2e = (time.perf_counter() - t0) * 1e3
+        with lock:
+            result.e2e_ms.append(e2e)
+            if ttft is not None:
+                result.ttft_ms.append(ttft)
+    except Exception:
+        with lock:
+            result.num_errors += 1
+
+
+def _one_image(base_url: str, prompt: str, size: str,
+               result: BenchResult, lock: threading.Lock):
+    body = json.dumps({"prompt": prompt, "size": size, "n": 1}).encode()
+    req = urllib.request.Request(
+        f"{base_url}/v1/images/generations", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            resp.read()
+        with lock:
+            result.e2e_ms.append((time.perf_counter() - t0) * 1e3)
+    except Exception:
+        with lock:
+            result.num_errors += 1
+
+
+def run_bench(
+    base_url: str,
+    endpoint: str = "chat",  # "chat" | "images"
+    num_requests: int = 16,
+    concurrency: int = 4,
+    max_tokens: int = 32,
+    stream: bool = True,
+    size: str = "64x64",
+    prompt: str = "benchmark prompt",
+) -> dict:
+    """Run the bench; returns the report dict (also what the CLI prints)."""
+    if endpoint not in ("chat", "images"):
+        raise ValueError(f"unknown endpoint {endpoint!r}")
+    result = BenchResult(num_requests=num_requests)
+    lock = threading.Lock()
+    # fixed pool of `concurrency` workers pulling indices from a queue —
+    # one thread per request would spawn num_requests stacks that mostly
+    # block, perturbing the latencies being measured
+    import queue as queue_mod
+
+    work: queue_mod.Queue = queue_mod.Queue()
+    for i in range(num_requests):
+        work.put(i)
+
+    def worker():
+        while True:
+            try:
+                i = work.get_nowait()
+            except queue_mod.Empty:
+                return
+            p = f"{prompt} #{i}"
+            if endpoint == "chat":
+                _one_chat(base_url, p, max_tokens, stream, result, lock)
+            else:
+                _one_image(base_url, p, size, result, lock)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker)
+               for _ in range(max(1, min(concurrency, num_requests)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    result.duration_s = time.perf_counter() - t0
+    return result.report()
+
+
+def add_cli_args(ap) -> None:
+    """Shared option set (used by both this module's main() and the
+    vllm-omni-tpu bench-serve subcommand — one definition)."""
+    ap.add_argument("--base-url", default="http://127.0.0.1:8000")
+    ap.add_argument("--endpoint", choices=("chat", "images"),
+                    default="chat")
+    ap.add_argument("--num-requests", type=int, default=16)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--no-stream", action="store_true")
+    ap.add_argument("--size", default="64x64")
+    ap.add_argument("--prompt", default="benchmark prompt")
+
+
+def run_from_args(args) -> int:
+    report = run_bench(
+        args.base_url, endpoint=args.endpoint,
+        num_requests=args.num_requests, concurrency=args.concurrency,
+        max_tokens=args.max_tokens, stream=not args.no_stream,
+        size=args.size, prompt=args.prompt,
+    )
+    print(json.dumps(report))
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_cli_args(ap)
+    return run_from_args(ap.parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

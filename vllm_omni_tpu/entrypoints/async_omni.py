@@ -65,6 +65,14 @@ class AsyncOmni:
     def metrics(self):
         return self._omni.metrics
 
+    def start_profile(self, trace_dir: str) -> None:
+        """Fan a jax.profiler trace out to every stage (reference:
+        profile RPC chain, omni.py:398-497)."""
+        self._omni.start_profile(trace_dir)
+
+    def stop_profile(self) -> None:
+        self._omni.stop_profile()
+
     # -------------------------------------------------------------- intake
     async def generate(
         self,

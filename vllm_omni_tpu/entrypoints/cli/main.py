@@ -69,6 +69,12 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_bench_serve(args) -> int:
+    from vllm_omni_tpu.benchmarks.serving import run_from_args
+
+    return run_from_args(args)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="vllm-omni-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -88,6 +94,16 @@ def main(argv=None) -> int:
 
     bench = sub.add_parser("bench", help="run the repo benchmark")
     bench.set_defaults(fn=cmd_bench)
+
+    bserve = sub.add_parser(
+        "bench-serve",
+        help="online serving benchmark against a running server "
+             "(latency percentiles; reference: vllm bench serve --omni)",
+    )
+    from vllm_omni_tpu.benchmarks.serving import add_cli_args
+
+    add_cli_args(bserve)
+    bserve.set_defaults(fn=cmd_bench_serve)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -183,3 +183,15 @@ class Omni:
             stop = getattr(stage, "shutdown", None)
             if callable(stop):
                 stop()
+
+    # ------------------------------------------------------------ profiling
+    def start_profile(self, trace_dir: str) -> None:
+        """Fan a jax.profiler trace out to every stage (reference:
+        Omni.start_profile RPC chain, omni.py:398-497); traces land under
+        ``trace_dir/stage_{id}`` in XPlane format."""
+        for stage in self.stages:
+            stage.start_profile(trace_dir)
+
+    def stop_profile(self) -> None:
+        for stage in self.stages:
+            stage.stop_profile()

@@ -91,6 +91,18 @@ class OmniStage:
         self._input_processor = config.resolve_input_processor()
         self._submit_ts: dict[str, float] = {}
         self.request_stats: list[StageRequestStats] = []
+        from vllm_omni_tpu.metrics.profiler import StageProfiler
+
+        self.profiler = StageProfiler(self.stage_id)
+
+    # ----------------------------------------------------------- profiling
+    def start_profile(self, trace_dir: str) -> None:
+        """Begin a jax.profiler trace for this stage (reference:
+        PROFILER_START task, omni_stage.py:740-777)."""
+        self.profiler.start(trace_dir)
+
+    def stop_profile(self) -> None:
+        self.profiler.stop()
 
     # -------------------------------------------------------- engine build
     def _build_engine(self):

@@ -225,7 +225,29 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         except (json.JSONDecodeError, ValueError) as e:
             return self._error(400, f"bad JSON: {e}")
         try:
-            if self.path == "/v1/chat/completions":
+            if self.path in ("/start_profile", "/stop_profile"):
+                # gated + server-chosen directory (the reference gates the
+                # torch-profiler endpoint behind VLLM_TORCH_PROFILER_DIR
+                # the same way): a client must never control filesystem
+                # paths or toggle tracing on an ungated server
+                from vllm_omni_tpu import envs
+
+                trace_dir = envs.OMNI_TPU_PROFILER_DIR
+                if not trace_dir:
+                    return self._error(
+                        403,
+                        "profiling disabled: set OMNI_TPU_PROFILER_DIR "
+                        "on the server to enable",
+                    )
+                if self.path == "/start_profile":
+                    self.state.omni.start_profile(trace_dir)
+                    self._json(200, {"status": "profiling",
+                                     "trace_dir": trace_dir})
+                else:
+                    self.state.omni.stop_profile()
+                    self._json(200, {"status": "stopped",
+                                     "trace_dir": trace_dir})
+            elif self.path == "/v1/chat/completions":
                 self._chat_completions(body)
             elif self.path == "/v1/completions":
                 self._completions(body)

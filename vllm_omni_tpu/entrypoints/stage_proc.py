@@ -99,6 +99,14 @@ def _stage_worker_main(config: StageConfig, addr: tuple,
             elif t == "abort":
                 if stage.config.stage_type == "llm":
                     stage.engine.abort_request(msg["request_id"])
+            elif t == "profile_start":
+                stage.start_profile(msg["trace_dir"])
+            elif t == "profile_stop":
+                stage.stop_profile()
+                # ack AFTER jax flushed the trace: the orchestrator's
+                # stop_profile blocks on this so callers can read the
+                # trace dir (or shut down) without losing the profile
+                _send_msg(sock, {"type": "profile_stopped"})
             elif t == "shutdown":
                 running = False
             else:
@@ -145,6 +153,10 @@ class ProcStage(OmniStage):
         self._inflight: set[str] = set()
         self._inbox: queue.Queue = queue.Queue()
         self._fatal: Optional[str] = None
+        # submit (engine loop) and profile RPC (HTTP thread) may send
+        # concurrently; frames must not interleave
+        self._send_lock = threading.Lock()
+        self._profile_ack = threading.Event()
 
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -190,6 +202,11 @@ class ProcStage(OmniStage):
                 msg = _recv_msg(self._sock)
                 if msg is None:
                     break
+                if msg.get("type") == "profile_stopped":
+                    # handled here, not in poll(): stop_profile blocks on
+                    # the ack even when nothing is polling the stage
+                    self._profile_ack.set()
+                    continue
                 self._inbox.put(msg)
         except (ConnectionError, OSError):
             pass
@@ -202,7 +219,9 @@ class ProcStage(OmniStage):
             self._inflight.add(r.request_id)
         if self._fatal is None:
             try:
-                _send_msg(self._sock, {"type": "submit", "requests": reqs})
+                with self._send_lock:
+                    _send_msg(self._sock,
+                              {"type": "submit", "requests": reqs})
             except (ConnectionError, OSError) as e:
                 # worker died between batches: the next poll() converts
                 # the whole in-flight set to per-request error outputs —
@@ -247,10 +266,47 @@ class ProcStage(OmniStage):
     def has_unfinished(self) -> bool:
         return bool(self._inflight)
 
+    # ----------------------------------------------------------- profiling
+    def start_profile(self, trace_dir: str) -> None:
+        """Profiling must run in the worker process (it owns the devices):
+        ship the command over the socket (reference: PROFILER_* tasks).
+        A dead worker is a logged no-op, never an exception — one broken
+        stage must not abort the fan-out over healthy ones."""
+        if self._fatal is not None:
+            logger.warning("stage %d: skip profile_start (worker dead)",
+                           self.stage_id)
+            return
+        try:
+            with self._send_lock:
+                _send_msg(self._sock, {"type": "profile_start",
+                                       "trace_dir": trace_dir})
+        except (ConnectionError, OSError) as e:
+            self._fatal = f"profile_start failed: {e}"
+
+    def stop_profile(self, timeout: float = 60.0) -> None:
+        """Blocks until the worker acked the stop (the trace file is
+        flushed by then) or ``timeout`` passes."""
+        if self._fatal is not None:
+            return
+        self._profile_ack.clear()
+        try:
+            with self._send_lock:
+                _send_msg(self._sock, {"type": "profile_stop"})
+        except (ConnectionError, OSError) as e:
+            self._fatal = f"profile_stop failed: {e}"
+            return
+        if not self._profile_ack.wait(timeout):
+            logger.warning(
+                "stage %d: no profile_stop ack within %.0fs (long step "
+                "in flight?) — trace may be incomplete",
+                self.stage_id, timeout,
+            )
+
     # ----------------------------------------------------------- shutdown
     def shutdown(self, timeout: float = 10.0) -> None:
         try:
-            _send_msg(self._sock, {"type": "shutdown"})
+            with self._send_lock:
+                _send_msg(self._sock, {"type": "shutdown"})
         except (ConnectionError, OSError):
             pass
         self._proc.join(timeout)

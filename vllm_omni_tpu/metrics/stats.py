@@ -62,12 +62,21 @@ class RequestE2EStats:
 
 
 class OrchestratorAggregator:
+    """``stats_path`` is a path *prefix*: per-stage request records stream
+    to ``{prefix}.stage{N}.stats.jsonl`` and E2E records to
+    ``{prefix}.e2e.stats.jsonl`` (reference: the per-stage ``*.stats.jsonl``
+    files of metrics/stats.py:115, wired at omni.py:692-697)."""
+
     def __init__(self, num_stages: int, stats_path: Optional[str] = None):
         self.stages = {i: StageStats(stage_id=i) for i in range(num_stages)}
         self.edges: dict[tuple[int, int], TransferEdgeStats] = {}
         self.requests: dict[str, RequestE2EStats] = {}
         self.per_request: list[StageRequestStats] = []
         self._stats_path = stats_path
+
+    def _append_jsonl(self, suffix: str, record: dict) -> None:
+        with open(f"{self._stats_path}.{suffix}.stats.jsonl", "a") as f:
+            f.write(json.dumps(record) + "\n")
 
     # ------------------------------------------------------------ recording
     def record_arrival(self, request_id: str) -> None:
@@ -77,7 +86,15 @@ class OrchestratorAggregator:
 
     def record_finish(self, request_id: str) -> None:
         if request_id in self.requests:
-            self.requests[request_id].finish_ts = time.time()
+            r = self.requests[request_id]
+            r.finish_ts = time.time()
+            if self._stats_path:
+                self._append_jsonl("e2e", {
+                    "request_id": r.request_id,
+                    "arrival_ts": r.arrival_ts,
+                    "finish_ts": r.finish_ts,
+                    "e2e_ms": round(r.e2e_ms, 3),
+                })
 
     def record_stage_request(self, s: StageRequestStats) -> None:
         self.per_request.append(s)
@@ -87,8 +104,7 @@ class OrchestratorAggregator:
         st.tokens_out += s.tokens_out
         st.gen_ms_total += s.gen_ms
         if self._stats_path:
-            with open(self._stats_path, "a") as f:
-                f.write(json.dumps(asdict(s)) + "\n")
+            self._append_jsonl(f"stage{s.stage_id}", asdict(s))
 
     def record_transfer(self, from_stage: int, to_stage: int,
                         nbytes: int, ms: float) -> None:
@@ -106,7 +122,11 @@ class OrchestratorAggregator:
         e2e = sorted(r.e2e_ms for r in finished)
 
         def pct(p):
-            return e2e[min(len(e2e) - 1, int(p * len(e2e)))] if e2e else 0.0
+            # nearest-rank: ceil(p*n)-1 (int(p*n) biases toward the max)
+            if not e2e:
+                return 0.0
+            idx = max(0, -(-int(p * 100 * len(e2e)) // 100) - 1)
+            return e2e[min(len(e2e) - 1, idx)]
 
         return {
             "stages": {
