@@ -1,0 +1,201 @@
+"""Serving parity (VERDICT r1 missing #9): multimodal chat input,
+/v1/images/edits, /v1/audio/voices, chunked audio streaming."""
+
+import base64
+import io
+import json
+import threading
+
+import httpx
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.entrypoints.openai.api_server import build_server
+
+
+def _serve(cfgs, model="tiny"):
+    server, state = build_server(model=model, stage_configs=cfgs,
+                                 host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, state, f"http://127.0.0.1:{port}"
+
+
+@pytest.fixture(scope="module")
+def mm_server_url():
+    import os
+
+    yaml_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "vllm_omni_tpu", "models", "stage_configs",
+        "qwen3_omni_moe_tiny.yaml",
+    )
+    server, state, url = _serve(yaml_path, model="qwen3-omni-tiny")
+    yield url
+    server.shutdown()
+    state.shutdown()
+
+
+def _png_b64(img: np.ndarray) -> str:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+# ------------------------------------------------------- multimodal chat
+def test_chat_with_image_and_audio(mm_server_url):
+    img = np.random.default_rng(0).integers(
+        0, 255, (16, 16, 3), dtype=np.uint8)
+    wav = np.sin(np.linspace(0, 40, 2500)).astype(np.float32)
+    r = httpx.post(f"{mm_server_url}/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "describe"},
+                {"type": "image_url", "image_url": {
+                    "url": "data:image/png;base64," + _png_b64(img)}},
+                {"type": "input_audio", "input_audio": {
+                    "data": base64.b64encode(wav.tobytes()).decode(),
+                    "format": "f32le"}},
+            ],
+        }],
+        "max_tokens": 4,
+    }, timeout=600)
+    assert r.status_code == 200, r.text
+    msg = r.json()["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    # the 3-stage pipeline also ships vocoder audio
+    assert "audio" in msg
+    # identical request reproduces identically (deterministic pipeline)
+    r2 = httpx.post(f"{mm_server_url}/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "describe"},
+                {"type": "image_url", "image_url": {
+                    "url": "data:image/png;base64," + _png_b64(img)}},
+                {"type": "input_audio", "input_audio": {
+                    "data": base64.b64encode(wav.tobytes()).decode(),
+                    "format": "f32le"}},
+            ],
+        }],
+        "max_tokens": 4,
+    }, timeout=600)
+    assert r2.json()["choices"][0]["message"]["content"] == msg["content"]
+
+
+def test_chat_bad_image_is_400(mm_server_url):
+    r = httpx.post(f"{mm_server_url}/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{
+            "role": "user",
+            "content": [{"type": "image_url", "image_url": {
+                "url": "data:image/png;base64,!!!notbase64"}}],
+        }],
+    }, timeout=120)
+    assert r.status_code == 400
+
+
+def test_wav_audio_content_part(mm_server_url):
+    import wave
+
+    pcm = (np.sin(np.linspace(0, 40, 2000)) * 20000).astype(np.int16)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(16000)
+        w.writeframes(pcm.tobytes())
+    r = httpx.post(f"{mm_server_url}/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "transcribe"},
+                {"type": "input_audio", "input_audio": {
+                    "data": base64.b64encode(buf.getvalue()).decode(),
+                    "format": "wav"}},
+            ],
+        }],
+        "max_tokens": 3,
+    }, timeout=600)
+    assert r.status_code == 200, r.text
+
+
+# --------------------------------------------------------- audio voices
+def test_audio_voices(mm_server_url):
+    r = httpx.get(f"{mm_server_url}/v1/audio/voices", timeout=30)
+    assert r.status_code == 200
+    assert r.json()["voices"] == ["default"]
+
+
+# ------------------------------------------------- chunked audio stream
+def test_streaming_audio_chunks(mm_server_url, monkeypatch):
+    from vllm_omni_tpu.entrypoints.openai import api_server
+
+    monkeypatch.setattr(api_server, "_AUDIO_CHUNK_SAMPLES", 8)
+    audio_deltas = 0
+    with httpx.stream("POST", f"{mm_server_url}/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "stream": True,
+    }, timeout=600) as r:
+        assert r.status_code == 200
+        for line in r.iter_lines():
+            if not line.startswith("data:") or "[DONE]" in line:
+                continue
+            chunk = json.loads(line[5:])
+            delta = chunk.get("choices", [{}])[0].get("delta", {})
+            if "audio" in delta:
+                audio_deltas += 1
+    # talker emits 8 codec tokens -> 32 samples -> 4 chunks of 8
+    assert audio_deltas >= 2
+
+
+# -------------------------------------------------------- images/edits
+@pytest.fixture(scope="module")
+def i2v_server_url():
+    cfg = StageConfig(
+        stage_id=0,
+        stage_type="diffusion",
+        engine_args={"model_arch": "WanI2VPipeline", "size": "tiny_i2v",
+                     "dtype": "float32"},
+        engine_input_source=[-1],
+        final_output=True,
+        final_output_type="video",
+        default_sampling_params={
+            "height": 16, "width": 16, "num_inference_steps": 2,
+            "guidance_scale": 1.0, "num_frames": 2, "seed": 0,
+        },
+    )
+    server, state, url = _serve([cfg], model="tiny-i2v")
+    yield url
+    server.shutdown()
+    state.shutdown()
+
+
+def test_images_edits(i2v_server_url):
+    img = np.random.default_rng(1).integers(
+        0, 255, (16, 16, 3), dtype=np.uint8)
+    r = httpx.post(f"{i2v_server_url}/v1/images/edits", json={
+        "prompt": "make it sunny",
+        "image": "data:image/png;base64," + _png_b64(img),
+        "size": "16x16", "num_inference_steps": 2,
+    }, timeout=600)
+    assert r.status_code == 200, r.text
+    data = r.json()["data"]
+    assert len(data) == 1
+    base64.b64decode(data[0]["b64_json"])
+
+
+def test_images_edits_requires_image(i2v_server_url):
+    r = httpx.post(f"{i2v_server_url}/v1/images/edits", json={
+        "prompt": "x",
+    }, timeout=60)
+    assert r.status_code == 400

@@ -120,16 +120,17 @@ def test_mm_processor_item_count_mismatch():
     params, cfg, _ = thinker.tiny_factory()
     proc = multimodal.build_tiny_processor(params, cfg)
     V = cfg.vocab_size
+    # more placeholders than items: hard error
     try:
         proc([1, V - 3], {"image": []})
         assert False
     except ValueError:
         pass
-    try:
-        proc([1], {"audio": [np.zeros(1000, np.float32)]})
-        assert False
-    except ValueError:
-        pass
+    # more items than placeholders: placeholders are auto-prepended in
+    # media order (plain-text API prompts carry no placeholder tokens)
+    out = proc([1], {"audio": [np.zeros(1000, np.float32)]})
+    assert out.prompt_token_ids[0] == V - 2  # audio placeholder first
+    assert out.prompt_token_ids[-1] == 1
 
 
 def test_mm_error_isolated_per_request():

@@ -32,13 +32,9 @@ class BenchResult:
 
     @staticmethod
     def _pct(xs: list, p: float) -> float:
-        """Nearest-rank percentile: ceil(p*n)-1 (int(p*n) would bias
-        high — p50 of [10, 20] must be 10, not 20)."""
-        if not xs:
-            return 0.0
-        xs = sorted(xs)
-        idx = max(0, -(-int(p * 100 * len(xs)) // 100) - 1)
-        return xs[min(len(xs) - 1, idx)]
+        from vllm_omni_tpu.metrics.stats import nearest_rank_pct
+
+        return nearest_rank_pct(xs, p)
 
     def report(self) -> dict:
         ok = self.num_requests - self.num_errors
@@ -77,21 +73,39 @@ def _one_chat(base_url: str, prompt: str, max_tokens: int,
     )
     t0 = time.perf_counter()
     ttft = None
+    failed = False
     try:
         with urllib.request.urlopen(req, timeout=300) as resp:
             if stream:
                 for line in resp:
-                    if line.startswith(b"data:") and ttft is None:
-                        ttft = (time.perf_counter() - t0) * 1e3
-                    if line.strip() == b"data: [DONE]":
+                    if not line.startswith(b"data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == b"[DONE]":
                         break
+                    # the server surfaces in-stream failures as HTTP 200
+                    # with an error event — count them as errors, not as
+                    # healthy latencies
+                    if b'"error"' in payload:
+                        try:
+                            if "error" in json.loads(payload):
+                                failed = True
+                                break
+                        except json.JSONDecodeError:
+                            pass
+                    if ttft is None:
+                        ttft = (time.perf_counter() - t0) * 1e3
             else:
-                resp.read()
+                body_out = json.loads(resp.read() or b"{}")
+                failed = "error" in body_out
         e2e = (time.perf_counter() - t0) * 1e3
         with lock:
-            result.e2e_ms.append(e2e)
-            if ttft is not None:
-                result.ttft_ms.append(ttft)
+            if failed:
+                result.num_errors += 1
+            else:
+                result.e2e_ms.append(e2e)
+                if ttft is not None:
+                    result.ttft_ms.append(ttft)
     except Exception:
         with lock:
             result.num_errors += 1

@@ -193,5 +193,14 @@ class Omni:
             stage.start_profile(trace_dir)
 
     def stop_profile(self) -> None:
+        # two-phase for proc stages: send every stop first, then wait on
+        # the acks — serial stop+wait would stack timeouts per stage
+        waiters = []
         for stage in self.stages:
-            stage.stop_profile()
+            if hasattr(stage, "wait_profile_ack"):
+                stage.stop_profile(wait=False)
+                waiters.append(stage)
+            else:
+                stage.stop_profile()
+        for stage in waiters:
+            stage.wait_profile_ack()

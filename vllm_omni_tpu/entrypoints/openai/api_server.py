@@ -47,6 +47,24 @@ class ServerState:
         self.omni.shutdown()
         self.loop.call_soon_threadsafe(self.loop.stop)
 
+    def entry_tokenizer(self):
+        """Entry stage's tokenizer (chat-template source), if any.
+        Process-disaggregated entry stages keep their tokenizer in the
+        worker — chat falls back to the plain transcript there (warned
+        once so the divergence from in-proc deployments is visible)."""
+        for stage in self.omni._omni.stages:
+            if -1 in stage.config.engine_input_source:
+                if (stage.tokenizer is None
+                        and stage.config.runtime.process
+                        and not getattr(self, "_warned_proc_tok", False)):
+                    self._warned_proc_tok = True
+                    logger.warning(
+                        "entry stage runs in a worker process; chat "
+                        "templates are not applied (plain transcript)"
+                    )
+                return stage.tokenizer
+        return None
+
     # ---------------------------------------------------------- bridging
     def collect(self, prompt, sampling_params, request_id=None) -> list:
         """Run one request to completion, returning all final outputs."""
@@ -101,20 +119,82 @@ class ServerState:
                                                 self.loop).result()
 
 
-def _chat_prompt_from_messages(messages: list[dict]) -> str:
-    """Minimal chat templating (reference applies HF chat templates via
-    _preprocess_chat, serving_chat.py:335; the byte-tokenizer path uses a
-    plain role-tagged transcript)."""
-    parts = []
+def _decode_image_part(part: dict) -> np.ndarray:
+    """OpenAI image_url content part -> [H, W, 3] uint8 (data: URLs with
+    base64 PNG, or raw base64)."""
+    url = part.get("image_url", {})
+    if isinstance(url, dict):
+        url = url.get("url", "")
+    if url.startswith("data:"):
+        b64 = url.partition(",")[2]
+    else:
+        b64 = url
+    raw = base64.b64decode(b64)
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    return np.asarray(img)
+
+
+def _decode_audio_part(part: dict) -> np.ndarray:
+    """OpenAI input_audio content part -> 1-D float32 waveform.
+    Formats: "wav" (stdlib wave, 16-bit PCM) or "f32le" (raw floats)."""
+    spec = part.get("input_audio", {})
+    raw = base64.b64decode(spec.get("data", ""))
+    fmt = spec.get("format", "wav")
+    if fmt == "f32le":
+        return np.frombuffer(raw, np.float32).copy()
+    if fmt == "wav":
+        import wave
+
+        with wave.open(io.BytesIO(raw)) as w:
+            frames = w.readframes(w.getnframes())
+            width = w.getsampwidth()
+        if width == 2:
+            return (np.frombuffer(frames, np.int16)
+                    .astype(np.float32) / 32768.0)
+        raise ValueError(f"unsupported wav sample width {width}")
+    raise ValueError(f"unsupported audio format {fmt!r}")
+
+
+def _chat_prompt_from_messages(
+    messages: list[dict], tokenizer=None
+) -> tuple[str, dict]:
+    """Chat templating + multimodal content extraction.
+
+    Returns (prompt_text, multi_modal_data).  Image/audio content parts
+    (OpenAI ``image_url`` / ``input_audio``) are decoded into arrays and a
+    textual placeholder marks their position; the stage's mm processor
+    expands markers into encoder tokens (reference: _preprocess_chat with
+    mm data, serving_chat.py:335).  An HF tokenizer with a chat template
+    formats the transcript; the byte-tokenizer path uses a plain
+    role-tagged transcript."""
+    mm: dict[str, list] = {}
+    norm_messages = []
     for m in messages:
         content = m.get("content", "")
         if isinstance(content, list):  # multimodal content parts
-            content = " ".join(
-                c.get("text", "") for c in content if c.get("type") == "text"
-            )
-        parts.append(f"{m.get('role', 'user')}: {content}")
-    parts.append("assistant:")
-    return "\n".join(parts)
+            text_parts = []
+            for c in content:
+                t = c.get("type")
+                if t == "text":
+                    text_parts.append(c.get("text", ""))
+                elif t == "image_url":
+                    mm.setdefault("image", []).append(_decode_image_part(c))
+                elif t == "input_audio":
+                    mm.setdefault("audio", []).append(_decode_audio_part(c))
+            content = " ".join(text_parts)
+        norm_messages.append({"role": m.get("role", "user"),
+                              "content": content})
+    if tokenizer is not None and hasattr(tokenizer, "apply_chat_template") \
+            and getattr(tokenizer, "chat_template", None):
+        prompt = tokenizer.apply_chat_template(
+            norm_messages, tokenize=False, add_generation_prompt=True)
+    else:
+        parts = [f"{m['role']}: {m['content']}" for m in norm_messages]
+        parts.append("assistant:")
+        prompt = "\n".join(parts)
+    return prompt, mm
 
 
 def _sampling_from_body(body: dict) -> dict:
@@ -129,6 +209,10 @@ def _sampling_from_body(body: dict) -> dict:
         if body.get(k) is not None:
             sp[k] = body[k]
     return sp
+
+
+# SSE audio delta granularity (samples per chunk; 12000 ≈ 0.5s @ 24kHz)
+_AUDIO_CHUNK_SAMPLES = 12000
 
 
 def _b64_png(img: np.ndarray) -> str:
@@ -211,6 +295,13 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                     "max_model_len": None,
                 }],
             })
+        elif self.path == "/v1/audio/voices":
+            # voices declared by the stage config (reference:
+            # /v1/audio/voices, api_server.py:833)
+            voices = []
+            for stage in self.state.omni._omni.stages:
+                voices.extend(stage.config.engine_args.get("voices", ()))
+            self._json(200, {"voices": voices or ["default"]})
         elif self.path == "/version":
             self._json(200, {"version": __version__})
         elif self.path == "/metrics":
@@ -251,6 +342,8 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 self._chat_completions(body)
             elif self.path == "/v1/completions":
                 self._completions(body)
+            elif self.path == "/v1/images/edits":
+                self._images_edits(body)
             elif self.path == "/v1/images/generations":
                 self._images_generations(body)
             elif self.path == "/v1/audio/speech":
@@ -273,7 +366,16 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         messages = body.get("messages")
         if not messages:
             return self._error(400, "messages required")
-        prompt = _chat_prompt_from_messages(messages)
+        try:
+            prompt_text, mm = _chat_prompt_from_messages(
+                messages, tokenizer=self.state.entry_tokenizer())
+        except Exception as e:
+            # any failure decoding client-supplied content (corrupt wav ->
+            # wave.Error, non-string url -> AttributeError, bad base64,
+            # ...) is the client's fault, never a 500
+            return self._error(400, f"bad multimodal content: {e}")
+        prompt = ({"prompt": prompt_text, "multi_modal_data": mm}
+                  if mm else prompt_text)
         sp = _sampling_from_body(body)
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
@@ -355,15 +457,21 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 "finish_reason": out.outputs[0].finish_reason,
             }]}
         elif out.final_output_type == "audio" and "audio" in out.multimodal_output:
+            # stream the waveform in bounded chunks so playback can start
+            # before the full clip is serialized (reference: chunked audio
+            # deltas, serving_chat.py:539 + chunk adapter)
             wav = np.asarray(out.multimodal_output["audio"], np.float32)
-            yield {**base, "choices": [{
-                "index": 0,
-                "delta": {"audio": {
-                    "data": base64.b64encode(wav.tobytes()).decode(),
-                    "format": "f32le",
-                }},
-                "finish_reason": None,
-            }]}
+            chunk = max(1, _AUDIO_CHUNK_SAMPLES)
+            for lo in range(0, len(wav), chunk):
+                yield {**base, "choices": [{
+                    "index": 0,
+                    "delta": {"audio": {
+                        "data": base64.b64encode(
+                            wav[lo: lo + chunk].tobytes()).decode(),
+                        "format": "f32le",
+                    }},
+                    "finish_reason": None,
+                }]}
 
     # ---------------------------------------------------------- completions
     def _completions(self, body: dict):
@@ -436,6 +544,50 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                         {"b64_json": _b64_png(np.asarray(img))}
                         for img in o.images
                     )
+        self._json(200, {"created": int(time.time()), "data": data})
+
+    def _images_edits(self, body: dict):
+        """Image editing / image-conditioned generation (reference:
+        /v1/images/edits, api_server.py:1051): a base64 input image rides
+        ``sampling_params.image`` into an image-conditioned pipeline
+        (image-edit or I2V-style conditioning)."""
+        prompt = body.get("prompt")
+        if not prompt:
+            return self._error(400, "prompt required")
+        image_b64 = body.get("image")
+        if not image_b64:
+            return self._error(400, "image required (base64 PNG)")
+        try:
+            img = _decode_image_part(
+                {"image_url": {"url": image_b64}})
+        except Exception as e:
+            return self._error(400, f"bad image: {e}")
+        sp: dict[str, Any] = {}
+        if body.get("size"):
+            try:
+                w, h = body["size"].lower().split("x")
+                sp["width"], sp["height"] = int(w), int(h)
+            except ValueError:
+                return self._error(400, f"bad size {body['size']!r}")
+        for k in ("num_inference_steps", "guidance_scale", "seed"):
+            if body.get(k) is not None:
+                sp[k] = body[k]
+        sp["image"] = img
+        rid = f"imgedit-{uuid.uuid4().hex[:16]}"
+        outs = self.state.collect(prompt, sp, rid)
+        if self._surface_error(outs):
+            return
+        data = []
+        for o in outs:
+            if o.final_output_type == "image" and o.images:
+                data.extend({"b64_json": _b64_png(np.asarray(im))}
+                            for im in o.images)
+            elif o.final_output_type == "video" and o.images:
+                # image-conditioned video pipelines return frames; ship
+                # frame 0 as the edited still
+                vid = np.asarray(o.images[0])
+                if vid.ndim == 4:
+                    data.append({"b64_json": _b64_png(vid[0])})
         self._json(200, {"created": int(time.time()), "data": data})
 
     # ------------------------------------------------------------ videos

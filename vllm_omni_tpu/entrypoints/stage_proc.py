@@ -283,9 +283,11 @@ class ProcStage(OmniStage):
         except (ConnectionError, OSError) as e:
             self._fatal = f"profile_start failed: {e}"
 
-    def stop_profile(self, timeout: float = 60.0) -> None:
+    def stop_profile(self, timeout: float = 60.0, wait: bool = True) -> None:
         """Blocks until the worker acked the stop (the trace file is
-        flushed by then) or ``timeout`` passes."""
+        flushed by then) or ``timeout`` passes; ``wait=False`` lets a
+        multi-stage fan-out send every stop first and then wait on all
+        acks concurrently (bounding worst-case latency at one timeout)."""
         if self._fatal is not None:
             return
         self._profile_ack.clear()
@@ -294,6 +296,12 @@ class ProcStage(OmniStage):
                 _send_msg(self._sock, {"type": "profile_stop"})
         except (ConnectionError, OSError) as e:
             self._fatal = f"profile_stop failed: {e}"
+            return
+        if wait:
+            self.wait_profile_ack(timeout)
+
+    def wait_profile_ack(self, timeout: float = 60.0) -> None:
+        if self._fatal is not None:
             return
         if not self._profile_ack.wait(timeout):
             logger.warning(

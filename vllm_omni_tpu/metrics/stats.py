@@ -61,6 +61,17 @@ class RequestE2EStats:
         return max(0.0, (self.finish_ts - self.arrival_ts) * 1e3)
 
 
+def nearest_rank_pct(xs: list, p: float) -> float:
+    """Nearest-rank percentile over a sequence: index ceil(p*n)-1.
+    (int(p*n) would bias toward the max — p50 of [10, 20] must be 10.)
+    The int(p*100*n) form sidesteps float error in p itself (0.99*n)."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    idx = max(0, -(-int(p * 100 * len(xs)) // 100) - 1)
+    return xs[min(len(xs) - 1, idx)]
+
+
 class OrchestratorAggregator:
     """``stats_path`` is a path *prefix*: per-stage request records stream
     to ``{prefix}.stage{N}.stats.jsonl`` and E2E records to
@@ -122,11 +133,7 @@ class OrchestratorAggregator:
         e2e = sorted(r.e2e_ms for r in finished)
 
         def pct(p):
-            # nearest-rank: ceil(p*n)-1 (int(p*n) biases toward the max)
-            if not e2e:
-                return 0.0
-            idx = max(0, -(-int(p * 100 * len(e2e)) // 100) - 1)
-            return e2e[min(len(e2e) - 1, idx)]
+            return nearest_rank_pct(e2e, p)
 
         return {
             "stages": {

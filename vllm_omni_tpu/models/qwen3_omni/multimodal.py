@@ -136,6 +136,21 @@ class ThinkerMMProcessor:
             "image": list(multi_modal_data.get("image", ())),
             "audio": list(multi_modal_data.get("audio", ())),
         }
+        prompt_token_ids = list(map(int, prompt_token_ids))
+        # Prompts arriving as plain text (API server chat messages) carry
+        # no placeholder tokens; by convention missing placeholders are
+        # prepended in media order — media-first prompts, the common chat
+        # layout (reference inserts placeholders during template
+        # processing, qwen3_omni_moe_thinker.py:330).
+        have = {m: sum(1 for t in prompt_token_ids
+                       if self._id_to_mod.get(t) == m)
+                for m in queues}
+        prepend: list[int] = []
+        for mod, q in queues.items():
+            for _ in range(len(q) - have[mod]):
+                prepend.append(self.placeholder_id[mod])
+        if prepend:
+            prompt_token_ids = prepend + prompt_token_ids
         feats: list[np.ndarray] = []
         items_spec: list[tuple[str, tuple]] = []
         for tok in prompt_token_ids:
