@@ -1,0 +1,187 @@
+"""Acceleration extras (VERDICT r1 missing #8): UniPC multistep solver,
+fp8 weight-only quantization, and host offload (sleep/wake)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+from vllm_omni_tpu.diffusion import cache as step_cache
+from vllm_omni_tpu.diffusion import scheduler as fm
+from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+from vllm_omni_tpu.diffusion.request import (
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+
+
+# ------------------------------------------------------------------ UniPC
+def _integrate(solver, num_steps):
+    """Integrate dx/dsigma = -x from sigma=1 to 0 through the shared
+    denoise loop; exact solution x(0) = x(1) * e."""
+    schedule = fm.make_schedule(num_steps, shift=1.0)
+    x0 = jnp.ones((1, 4))
+
+    def eval_velocity(lat, i):
+        del i
+        return -lat
+
+    lat, _ = step_cache.run_denoise_loop(
+        None, schedule, eval_velocity, x0, num_steps, solver=solver)
+    return np.asarray(lat)
+
+
+def test_unipc_converges_faster_than_euler():
+    """Order 2 in the half-log-SNR variable: doubling steps quarters the
+    UniPC error while Euler's only halves (measured at 32/64 where the
+    sigma=1 endpoint clamp no longer dominates)."""
+    exact = np.e
+    err_euler = abs(float(_integrate("euler", 32)[0, 0]) - exact)
+    err_unipc = abs(float(_integrate("unipc", 32)[0, 0]) - exact)
+    assert np.isfinite(err_unipc)
+    assert err_unipc < err_euler * 0.6, (err_unipc, err_euler)
+    err_unipc64 = abs(float(_integrate("unipc", 64)[0, 0]) - exact)
+    assert err_unipc64 < err_unipc * 0.35  # ~4x drop per doubling
+
+
+def test_unipc_matches_euler_in_the_limit():
+    """Both solvers approach the exact solution as steps grow."""
+    exact = np.e
+    for solver in ("euler", "unipc"):
+        err = abs(float(_integrate(solver, 64)[0, 0]) - exact)
+        assert err < 0.05, (solver, err)
+
+
+def test_unipc_terminal_step_lands_on_x0():
+    """With constant velocity (straight flow path), any solver is exact:
+    x(0) = x(1) - v (integrating dx = v dsigma from 1 to 0)."""
+    schedule = fm.make_schedule(4, shift=1.0)
+    x0 = jnp.full((1, 3), 2.0)
+    v = jnp.full((1, 3), 0.5)
+    lat, _ = step_cache.run_denoise_loop(
+        None, schedule, lambda lat, i: jnp.broadcast_to(v, lat.shape),
+        x0, 4, solver="unipc")
+    np.testing.assert_allclose(np.asarray(lat), 2.0 - 0.5, atol=1e-4)
+
+
+def test_bad_solver_rejected():
+    schedule = fm.make_schedule(2)
+    with pytest.raises(ValueError, match="solver"):
+        step_cache.run_denoise_loop(
+            None, schedule, lambda l, i: l, jnp.ones((1, 2)), 2,
+            solver="dpm")
+
+
+def test_pipeline_unipc_scheduler_via_engine():
+    def run(sched):
+        eng = DiffusionEngine(OmniDiffusionConfig(
+            model="qi-tiny", model_arch="QwenImagePipeline",
+            dtype="float32",
+            extra={"size": "tiny", "scheduler": sched},
+            default_height=32, default_width=32,
+        ), warmup=False)
+        sp = OmniDiffusionSamplingParams(
+            height=32, width=32, num_inference_steps=4,
+            guidance_scale=1.0, seed=0)
+        return eng.step(OmniDiffusionRequest(
+            prompt=["x"], sampling_params=sp, request_ids=["a"]))[0].data
+
+    a = run("unipc")
+    b = run("euler")
+    assert a.shape == b.shape
+    assert (a != b).any()  # the solver is actually live
+    np.testing.assert_array_equal(a, run("unipc"))  # deterministic
+
+
+def test_unipc_composes_with_step_cache():
+    from vllm_omni_tpu.diffusion.cache import StepCacheConfig
+
+    schedule = fm.make_schedule(8, shift=1.0)
+    cfg = StepCacheConfig.from_dict("teacache", {"rel_l1_thresh": 1e9})
+    lat, skipped = step_cache.run_denoise_loop(
+        cfg, schedule, lambda lat, i: -lat, jnp.ones((1, 4)), 8,
+        solver="unipc")
+    assert np.isfinite(np.asarray(lat)).all()
+    assert int(skipped) > 0  # cache gating active under multistep too
+
+
+# -------------------------------------------------------------------- fp8
+def test_fp8_quantization_roundtrip():
+    from vllm_omni_tpu.diffusion.quantization import (
+        quantize_linear_weight_fp8,
+        quantize_params,
+    )
+    from vllm_omni_tpu.models.common import nn
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+    q = quantize_linear_weight_fp8(w)
+    assert q["w_q"].dtype == jnp.float8_e4m3fn
+    deq = q["w_q"].astype(jnp.float32) * q["w_scale"]
+    rel = float(jnp.max(jnp.abs(deq - w)) / jnp.max(jnp.abs(w)))
+    assert rel < 0.1  # e4m3 has ~2 decimal digits
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64))
+    tree = {"w": w, "b": jnp.zeros((32,))}
+    y_ref = nn.linear(tree, x)
+    y_q = nn.linear(quantize_params(tree, mode="fp8"), x)
+    assert float(jnp.max(jnp.abs(y_ref - y_q))) < 0.2
+
+
+def test_fp8_engine_end_to_end():
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model="qi-tiny", model_arch="QwenImagePipeline", dtype="float32",
+        extra={"size": "tiny"}, quantization="fp8",
+        default_height=32, default_width=32,
+    ), warmup=False)
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=1.0,
+        seed=0)
+    out = eng.step(OmniDiffusionRequest(
+        prompt=["x"], sampling_params=sp, request_ids=["a"]))
+    assert out[0].data.shape == (32, 32, 3)
+
+
+def test_text_encode_jit_sees_swapped_params():
+    """The text-encode jit must take params as ARGUMENTS: closure capture
+    would bake them into the executable as constants, so sleep()/LoRA
+    swaps would silently not apply (code-review finding)."""
+    from vllm_omni_tpu.models.flux.pipeline import (
+        FluxPipeline,
+        FluxPipelineConfig,
+    )
+
+    pipe = FluxPipeline(FluxPipelineConfig.tiny(), dtype=jnp.float32)
+    h1, _, _ = pipe.encode_prompt(["hello"])
+    pipe.text_params = jax.tree_util.tree_map(
+        jnp.zeros_like, pipe.text_params)
+    h2, _, _ = pipe.encode_prompt(["hello"])
+    assert float(jnp.max(jnp.abs(h1 - h2))) > 1e-6
+
+
+# ------------------------------------------------------------- sleep/wake
+def test_sleep_wake_roundtrip():
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model="qi-tiny", model_arch="QwenImagePipeline", dtype="float32",
+        extra={"size": "tiny"}, default_height=32, default_width=32,
+    ), warmup=False)
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=1.0,
+        seed=0)
+    req = OmniDiffusionRequest(prompt=["x"], sampling_params=sp,
+                               request_ids=["a"])
+    before = eng.step(req)[0].data
+
+    eng.sleep()
+    assert eng.is_asleep
+    assert eng.pipeline.dit_params is None  # HBM references dropped
+    with pytest.raises(RuntimeError, match="asleep"):
+        eng.step(req)
+    eng.sleep()  # idempotent
+
+    eng.wake()
+    assert not eng.is_asleep
+    after = eng.step(OmniDiffusionRequest(
+        prompt=["x"], sampling_params=sp, request_ids=["b"]))[0].data
+    np.testing.assert_array_equal(before, after)
+    eng.wake()  # idempotent

@@ -94,34 +94,59 @@ def cached_eval(
     return v, (v, new_prev_lat, new_accum), skip
 
 
-def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps):
+def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
+                     solver: str = "euler"):
     """Shared denoise fori_loop, optionally gated by the step cache.
 
     ``eval_velocity(latents, i)`` -> velocity (shape-preserving).  Returns
     ``(final_latents, skipped_count)``.  One implementation for every
     pipeline (image/video/audio) so cache-semantics changes land once.
+
+    ``solver``: "euler" (FlowMatch Euler) or "unipc" (order-2 UniPC-style
+    multistep, scheduler.multistep_step — fewer steps for the same
+    quality; reference: scheduling_flow_unipc_multistep.py:741).
     """
     from vllm_omni_tpu.diffusion import scheduler as fm
 
-    if cache_cfg is not None and cache_cfg.enabled:
+    if solver not in ("euler", "unipc"):
+        raise ValueError(f"unknown solver {solver!r}")
+    multistep = solver == "unipc"
+    use_cache = cache_cfg is not None and cache_cfg.enabled
+
+    def ms_init(lat):
+        return (jnp.zeros_like(lat, jnp.float32),
+                jnp.asarray(0.0, jnp.float32))
+
+    def advance(lat, v, i, ms):
+        if multistep:
+            new_lat, x0, lam = fm.multistep_step(
+                schedule, lat, v, i, ms[0], ms[1])
+            return new_lat, (x0, lam)
+        return fm.step(schedule, lat, v, i), ms
+
+    if use_cache:
 
         def body(i, carry):
-            lat, cc, skipped = carry
+            lat, cc, ms, skipped = carry
             v, cc, skip = cached_eval(
                 cache_cfg, lambda l: eval_velocity(l, i), lat, cc, i,
                 num_steps,
             )
-            return (fm.step(schedule, lat, v, i), cc,
-                    skipped + skip.astype(jnp.int32))
+            lat, ms = advance(lat, v, i, ms)
+            return (lat, cc, ms, skipped + skip.astype(jnp.int32))
 
-        lat, _, skipped = jax.lax.fori_loop(
+        lat, _, _, skipped = jax.lax.fori_loop(
             0, num_steps, body,
-            (latents, init_carry(latents), jnp.asarray(0, jnp.int32)),
+            (latents, init_carry(latents), ms_init(latents),
+             jnp.asarray(0, jnp.int32)),
         )
         return lat, skipped
 
-    def body(i, lat):
-        return fm.step(schedule, lat, eval_velocity(lat, i), i)
+    def body(i, carry):
+        lat, ms = carry
+        lat, ms = advance(lat, eval_velocity(lat, i), i, ms)
+        return lat, ms
 
-    lat = jax.lax.fori_loop(0, num_steps, body, latents)
+    lat, _ = jax.lax.fori_loop(
+        0, num_steps, body, (latents, ms_init(latents)))
     return lat, jnp.asarray(0, jnp.int32)

@@ -58,6 +58,17 @@ class DiffusionEngine:
         dtype = resolve_dtype(od_config.dtype)
         size = od_config.extra.get("size", "")
         pipe_cfg = self._pipeline_config(pipeline_cls, size)
+        solver = od_config.extra.get("scheduler")
+        if solver:
+            if solver not in ("euler", "unipc"):
+                raise ValueError(
+                    f"unknown scheduler {solver!r} (euler | unipc)")
+            if not hasattr(pipe_cfg, "scheduler"):
+                raise ValueError(
+                    f"{arch} does not support a scheduler override")
+            import dataclasses
+
+            pipe_cfg = dataclasses.replace(pipe_cfg, scheduler=solver)
         logger.info("Building %s (size=%s dtype=%s)", arch, size or "default", dtype)
         cache_config = None
         if od_config.cache_backend:
@@ -114,16 +125,16 @@ class DiffusionEngine:
                 pipe_cfg, dtype=dtype, seed=od_config.seed,
                 cache_config=cache_config, mesh=mesh,
             )
-        if od_config.quantization == "int8":
+        if od_config.quantization in ("int8", "fp8"):
             from vllm_omni_tpu.diffusion.quantization import quantize_params
 
             self.pipeline.dit_params = quantize_params(
-                self.pipeline.dit_params
+                self.pipeline.dit_params, mode=od_config.quantization
             )
         elif od_config.quantization:
             raise ValueError(
                 f"unsupported quantization {od_config.quantization!r} "
-                "(TPU path supports 'int8' weight-only)"
+                "(TPU path supports 'int8'/'fp8' weight-only)"
             )
         from vllm_omni_tpu.diffusion.lora import LoRAManager
 
@@ -193,6 +204,56 @@ class DiffusionEngine:
             prompt=["warmup"], sampling_params=sp))
         logger.info("Warmup done in %.1fs", time.perf_counter() - t0)
 
+    # ------------------------------------------------------- sleep / wake
+    _PARAM_ATTRS = ("dit_params", "text_params", "vae_params",
+                    "vae_encoder_params")
+
+    @property
+    def is_asleep(self) -> bool:
+        return getattr(self, "_asleep", False)
+
+    def sleep(self) -> None:
+        """Offload every pipeline weight tree to host RAM, freeing HBM for
+        sibling stages sharing the chip (reference: CuMemAllocator
+        sleep/wake, diffusion/worker/diffusion_worker.py:204-271; the TPU
+        host-offload row of SURVEY §2.10).  ``step`` refuses while asleep;
+        ``wake`` restores the original device placement."""
+        if self.is_asleep:
+            return
+        import numpy as np
+
+        self._host_stash = {}
+        for attr in self._PARAM_ATTRS:
+            tree = getattr(self.pipeline, attr, None)
+            if tree is None:
+                continue
+            # device_get copies to host; dropping the pipeline reference
+            # releases the HBM buffers
+            self._host_stash[attr] = jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(x)), tree
+            )
+            setattr(self.pipeline, attr, None)
+        # fused LoRA trees + the base ref hold full DiT-sized device
+        # buffers; drop them or the eviction is theater
+        self.lora_manager.drop_device_state()
+        self._asleep = True
+        logger.info("engine asleep: %d weight trees offloaded to host",
+                    len(self._host_stash))
+
+    def wake(self) -> None:
+        if not self.is_asleep:
+            return
+        place = getattr(self.pipeline, "_place", None)
+        for attr, tree in self._host_stash.items():
+            if place is not None:
+                tree = place(tree, tp=(attr == "dit_params"))
+            else:
+                tree = jax.device_put(tree)
+            setattr(self.pipeline, attr, tree)
+        self._host_stash = {}
+        self._asleep = False
+        logger.info("engine awake: weights restored to device")
+
     def load_lora(self, path: str, name: Optional[str] = None) -> str:
         """Register a LoRA adapter (reference: DiffusionLoRAManager load,
         lora/manager.py:33)."""
@@ -204,6 +265,10 @@ class DiffusionEngine:
         return self.lora_manager.load(path, name)
 
     def step(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
+        if self.is_asleep:
+            raise RuntimeError(
+                "engine is asleep (weights offloaded to host); call wake()"
+            )
         t0 = time.perf_counter()
         # per-request LoRA activation via sampling extras (reference:
         # lora_manager.set_active_adapter, diffusion_worker.py:178-184)

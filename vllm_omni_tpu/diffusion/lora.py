@@ -112,6 +112,13 @@ class LoRAManager:
         # reference cannot, and the engine keeps the base alive anyway.
         self._base_ref: object = None
 
+    def drop_device_state(self) -> None:
+        """Release the fused-tree cache and base-tree reference (engine
+        sleep support: these hold full DiT-sized device trees — keeping
+        them would defeat the HBM eviction sleep() exists for)."""
+        self._fused_cache.clear()
+        self._base_ref = None
+
     def register(self, adapter: LoRAAdapter) -> None:
         self._adapters[adapter.name] = adapter
 

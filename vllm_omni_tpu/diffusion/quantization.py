@@ -5,10 +5,12 @@ Reference: vllm_omni/diffusion/quantization/{base,fp8}.py —
 weight-only fallback to DiT linear layers, ~1.28x reported speedup
 (docs/user_guide/diffusion_acceleration.md:19,46).
 
-The TPU path is int8 weight-only: per-out-channel absmax scaling, weights
-stored int8 in HBM (halved weight bandwidth — the DiT denoise loop is
-bandwidth-bound at decode-scale batches), dequantized inline where the
-matmul consumes them (models/common/nn.py ``linear``).
+TPU paths: int8 weight-only (per-out-channel absmax scaling) and fp8
+weight-only (float8_e4m3, per-out-channel scale to the e4m3 dynamic
+range).  Either way weights live quantized in HBM (halved weight
+bandwidth — the DiT denoise loop is bandwidth-bound at decode-scale
+batches) and dequantize inline where the matmul consumes them
+(models/common/nn.py ``linear``).
 """
 
 from __future__ import annotations
@@ -31,10 +33,28 @@ def quantize_linear_weight(w: jax.Array) -> dict:
     return {"w_q": w_q, "w_scale": scale}
 
 
-def quantize_params(tree, min_size: int = 0):
-    """Replace every linear-style leaf dict (2-D "w") with its int8
+_FP8_MAX = 448.0  # float8_e4m3 finite max
+
+
+def quantize_linear_weight_fp8(w: jax.Array) -> dict:
+    """[in, out] float -> {w_q float8_e4m3fn [in, out], w_scale f32 [out]}
+    (reference: diffusion/quantization/fp8.py weight-only path)."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)  # [out]
+    scale = jnp.maximum(absmax / _FP8_MAX, 1e-12)
+    w_q = (w.astype(jnp.float32) / scale[None, :]).astype(
+        jnp.float8_e4m3fn)
+    return {"w_q": w_q, "w_scale": scale}
+
+
+def quantize_params(tree, min_size: int = 0, mode: str = "int8"):
+    """Replace every linear-style leaf dict (2-D "w") with its quantized
     weight-only form; "b" and norms pass through.  ``min_size`` skips small
-    matrices where dequant overhead outweighs the bandwidth win."""
+    matrices where dequant overhead outweighs the bandwidth win.
+    ``mode``: "int8" | "fp8"."""
+    quantize = {
+        "int8": quantize_linear_weight,
+        "fp8": quantize_linear_weight_fp8,
+    }[mode]
     n_quant = 0
 
     def walk(node):
@@ -43,7 +63,7 @@ def quantize_params(tree, min_size: int = 0):
             if "w" in node and getattr(node["w"], "ndim", 0) == 2 \
                     and node["w"].size >= min_size:
                 n_quant += 1
-                q = quantize_linear_weight(node["w"])
+                q = quantize(node["w"])
                 rest = {k: v for k, v in node.items() if k != "w"}
                 return {**rest, **q}
             return {k: walk(v) for k, v in node.items()}
@@ -52,5 +72,5 @@ def quantize_params(tree, min_size: int = 0):
         return node
 
     out = walk(tree)
-    logger.info("quantized %d linear weights to int8", n_quant)
+    logger.info("quantized %d linear weights to %s", n_quant, mode)
     return out

@@ -79,3 +79,55 @@ def add_noise(
 ) -> jax.Array:
     """Interpolate clean latents toward noise (image-edit / i2v init)."""
     return (1.0 - sigma) * latents + sigma * noise
+
+
+# --------------------------------------------------------------- multistep
+_LAMBDA_EPS = 1e-5
+
+
+def _flow_lambda(sigma: jax.Array) -> jax.Array:
+    """Half-log-SNR of the flow path x_s = (1-s)x0 + s*eps:
+    lambda = log((1-s)/s), clamped away from the endpoints."""
+    s = jnp.clip(sigma, _LAMBDA_EPS, 1.0 - _LAMBDA_EPS)
+    return jnp.log((1.0 - s) / s)
+
+
+def multistep_step(
+    schedule: FlowMatchSchedule,
+    latents: jax.Array,
+    velocity: jax.Array,
+    step_index: jax.Array,
+    prev_x0: jax.Array,
+    prev_lambda: jax.Array,
+):
+    """One order-2 UniPC-style multistep update (data-prediction form).
+
+    Role of the reference's FlowUniPC multistep scheduler
+    (scheduling_flow_unipc_multistep.py:741): convert the velocity to a
+    data prediction ``x0 = x - sigma*v``, extrapolate with the previous
+    step's x0 (second order in the half-log-SNR variable), and take the
+    exponential-integrator update — at step 0 this degrades to the
+    first-order update, and when sigma_next == 0 it lands exactly on the
+    extrapolated x0.  Carry-friendly: returns (new_latents, x0, lambda)
+    for the jitted fori_loop.
+    """
+    sigma = schedule.sigmas[step_index]
+    sigma_next = schedule.sigmas[step_index + 1]
+    lat32 = latents.astype(jnp.float32)
+    v32 = velocity.astype(jnp.float32)
+    x0 = lat32 - sigma * v32
+    lam = _flow_lambda(sigma)
+    lam_next = _flow_lambda(sigma_next)
+    h = lam_next - lam
+    h0 = lam - prev_lambda
+    r0 = h0 / jnp.where(h == 0.0, 1.0, h)
+    corr = (x0 - prev_x0) / jnp.where(r0 == 0.0, 1.0, 2.0 * r0)
+    # step 0 has no history: pure first-order (corr off)
+    d = x0 + jnp.where(step_index == 0, 0.0, 1.0) * corr
+    alpha_next = 1.0 - sigma_next
+    safe_sigma = jnp.where(sigma == 0.0, 1.0, sigma)
+    new_lat = (sigma_next / safe_sigma) * lat32 \
+        - alpha_next * jnp.expm1(-h) * d
+    # terminal step (sigma_next == 0): the update collapses to d exactly
+    new_lat = jnp.where(sigma_next <= _LAMBDA_EPS, d, new_lat)
+    return new_lat.astype(latents.dtype), x0, lam

@@ -51,6 +51,8 @@ class FluxPipelineConfig:
     vae: VAEConfig = field(default_factory=VAEConfig)
     max_text_len: int = 64
     shift: float = 1.0
+    # "euler" | "unipc" (order-2 multistep, diffusion/scheduler.py)
+    scheduler: str = "euler"
     pack: int = 2  # 2x2 latent packing into channels
 
     @staticmethod
@@ -97,16 +99,18 @@ class FluxPipeline:
         self.dit_params = fdit.init_params(k2, config.dit, dtype)
         self.vae_params = vae_mod.init_decoder(k3, config.vae, dtype)
         self._denoise_cache: dict = {}
-        # jitted once (per-request jax.jit(lambda) would recompile)
+        # jitted once (per-request jax.jit(lambda) would recompile);
+        # params are explicit ARGUMENTS, never closure constants — else
+        # sleep()/weight swaps silently don't reach the executable
         self._text_encode_jit = jax.jit(
-            lambda i: forward_hidden(self.text_params, self.cfg.text, i))
+            lambda p, i: forward_hidden(p, self.cfg.text, i))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
 
     # ------------------------------------------------------------- encode
     def encode_prompt(self, prompts: list[str]):
         ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
-        hidden = self._text_encode_jit(jnp.asarray(ids))
+        hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
         mask = (np.arange(self.cfg.max_text_len)[None, :]
                 < lens[:, None]).astype(np.int32)
         mask = jnp.asarray(mask)
@@ -139,7 +143,8 @@ class FluxPipeline:
                 )
 
             return step_cache.run_denoise_loop(
-                cache_cfg, schedule, eval_velocity, latents, num_steps)
+                cache_cfg, schedule, eval_velocity, latents, num_steps,
+                solver=cfg.scheduler)
 
         self._denoise_cache[key] = run
         return run

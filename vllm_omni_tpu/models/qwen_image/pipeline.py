@@ -53,6 +53,8 @@ class QwenImagePipelineConfig:
     max_text_len: int = 128
     shift: float = 1.0
     use_dynamic_shifting: bool = True
+    # "euler" | "unipc" (order-2 multistep, diffusion/scheduler.py)
+    scheduler: str = "euler"
     # Schedule arrays are padded to this bucket so the step count is a
     # *dynamic* fori_loop bound: XLA compiles one executable per (H, W)
     # geometry, not per step count, and a 1-step warmup warms the same
@@ -231,7 +233,7 @@ class QwenImagePipeline:
         if self.hf_tokenizer is not None:
             return self._encode_prompt_hf(prompts)
         ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
-        hidden = self._encode_jit(jnp.asarray(ids))
+        hidden = self._encode_jit(self.text_params, jnp.asarray(ids))
         mask = (
             np.arange(self.cfg.max_text_len)[None, :] < lens[:, None]
         ).astype(np.int32)
@@ -255,7 +257,7 @@ class QwenImagePipeline:
         )
         ids = np.asarray(enc["input_ids"], np.int32)
         mask = np.asarray(enc["attention_mask"], np.int32)
-        hidden = self._encode_jit(jnp.asarray(ids))
+        hidden = self._encode_jit(self.text_params, jnp.asarray(ids))
         return (
             hidden[:, drop:].astype(self.dtype),
             jnp.asarray(mask[:, drop:]),
@@ -263,8 +265,11 @@ class QwenImagePipeline:
 
     @functools.cached_property
     def _encode_jit(self):
+        # params are an explicit jit ARGUMENT: closure capture would bake
+        # them into the executable as constants, so sleep() couldn't free
+        # the buffers and weight swaps would silently not apply
         return jax.jit(
-            lambda ids: forward_hidden(self.text_params, self.cfg.text, ids)
+            lambda p, ids: forward_hidden(p, self.cfg.text, ids)
         )
 
     # ------------------------------------------------------------ denoise
@@ -381,7 +386,7 @@ class QwenImagePipeline:
 
             return step_cache.run_denoise_loop(
                 self.cache_config, schedule, eval_velocity, latents,
-                num_steps,
+                num_steps, solver=self.cfg.scheduler,
             )
 
         self._denoise_cache[key] = run

@@ -47,6 +47,8 @@ class WanPipelineConfig:
     vae: VideoVAEConfig = field(default_factory=VideoVAEConfig)
     max_text_len: int = 64
     flow_shift: float = 3.0
+    # "euler" | "unipc" (order-2 multistep, diffusion/scheduler.py)
+    scheduler: str = "euler"
 
     @staticmethod
     def tiny() -> "WanPipelineConfig":
@@ -93,8 +95,11 @@ class WanT2VPipeline:
         self._denoise_cache: dict = {}
         # jitted helpers built ONCE — a fresh jax.jit(lambda) per request
         # would miss the jit cache and recompile every call
+        # params are explicit jit ARGUMENTS: a closure-captured tree would
+        # be baked into the executable as constants — sleep() couldn't
+        # free the buffers and wake()/LoRA swaps would silently not apply
         self._text_encode_jit = jax.jit(
-            lambda i: forward_hidden(self.text_params, self.cfg.text, i))
+            lambda p, i: forward_hidden(p, self.cfg.text, i))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vvae.decode(pp, self.cfg.vae, l))
         self._vae_encode_jit = jax.jit(
@@ -102,7 +107,7 @@ class WanT2VPipeline:
 
     def encode_prompt(self, prompts: list[str]):
         ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
-        hidden = self._text_encode_jit(jnp.asarray(ids))
+        hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
         mask = (np.arange(self.cfg.max_text_len)[None, :]
                 < lens[:, None]).astype(np.int32)
         return hidden, jnp.asarray(mask)
@@ -142,7 +147,8 @@ class WanT2VPipeline:
                 return v
 
             return step_cache.run_denoise_loop(
-                cache_cfg, schedule, eval_velocity, latents, num_steps)
+                cache_cfg, schedule, eval_velocity, latents, num_steps,
+                solver=cfg.scheduler)
 
         self._denoise_cache[key] = run
         return run

@@ -104,6 +104,15 @@ def dit_param_spec(path: tuple[str, ...]) -> P:
         return P(None, AXIS_TP)
     if leaf == "w" and parent in DIT_TP_ROW:
         return P(AXIS_TP, None)
+    # weight-only quantized leaves (diffusion/quantization.py): w_q keeps
+    # the float weight's layout; the per-out-channel scale shards with the
+    # out axis (column-parallel) and replicates otherwise
+    if leaf == "w_q" and parent in DIT_TP_COL:
+        return P(None, AXIS_TP)
+    if leaf == "w_q" and parent in DIT_TP_ROW:
+        return P(AXIS_TP, None)
+    if leaf == "w_scale" and parent in DIT_TP_COL:
+        return P(AXIS_TP)
     return P()
 
 
