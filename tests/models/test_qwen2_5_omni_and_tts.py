@@ -1,0 +1,142 @@
+"""Qwen2.5-Omni + Qwen3-TTS families (VERDICT r1 missing #4; reference:
+model_executor/models/qwen2_5_omni/ and models/qwen3_tts/)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_YAML_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "vllm_omni_tpu", "models", "stage_configs",
+)
+
+
+# --------------------------------------------------------------- token2wav
+def test_token2wav_shapes_and_determinism():
+    from vllm_omni_tpu.models.qwen2_5_omni import token2wav as t2w
+
+    cfg = t2w.Token2WavConfig.tiny()
+    params = t2w.init_token2wav_params(jax.random.PRNGKey(0), cfg)
+    model = t2w.Token2WavModel(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.codec_vocab, (2, 6)), jnp.int32)
+    out = model.forward(params, ids, jnp.asarray([6, 4]))
+    assert out["audio"].shape == (2, 6 * cfg.total_upsample)
+    assert out["mel"].shape == (2, 6 * cfg.frames_per_code, cfg.mel_bins)
+    assert np.all(np.abs(np.asarray(out["audio"])) <= 1.0)
+    # deterministic (fixed noise seed): identical codes -> identical audio
+    out2 = model.forward(params, ids, jnp.asarray([6, 4]))
+    np.testing.assert_array_equal(np.asarray(out["audio"]),
+                                  np.asarray(out2["audio"]))
+    sliced = model.slice_output(
+        {k: np.asarray(v) for k, v in out.items()}, 1, 4)
+    assert sliced["audio"].shape == (4 * cfg.total_upsample,)
+
+
+def test_token2wav_codes_condition_the_audio():
+    from vllm_omni_tpu.models.qwen2_5_omni import token2wav as t2w
+
+    cfg = t2w.Token2WavConfig.tiny()
+    params = t2w.init_token2wav_params(jax.random.PRNGKey(0), cfg)
+    model = t2w.Token2WavModel(cfg)
+    a = model.forward(params, jnp.asarray([[1, 2, 3]]), jnp.asarray([3]))
+    b = model.forward(params, jnp.asarray([[4, 5, 6]]), jnp.asarray([3]))
+    assert (np.asarray(a["audio"]) != np.asarray(b["audio"])).any()
+
+
+# ---------------------------------------------------- qwen2.5-omni pipeline
+def test_qwen2_5_omni_pipeline_e2e():
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    omni = Omni(stage_configs=os.path.join(
+        _YAML_DIR, "qwen2_5_omni_tiny.yaml"))
+    V = 128
+    img = np.random.default_rng(2).integers(
+        0, 255, (16, 16, 3), dtype=np.uint8)
+    outs = omni.generate([{
+        "prompt_token_ids": [1, 2, V - 3, 3],
+        "multi_modal_data": {"image": [img]},
+    }])
+    by = {o.final_output_type: o for o in outs}
+    assert set(by) == {"text", "audio"}
+    assert len(by["text"].outputs[0].token_ids) == 6
+    wav = by["audio"].multimodal_output["audio"]
+    # talker emits 8 codec tokens; token2wav upsamples fpc*voc = 2*2 = 4
+    assert wav.shape == (8 * 4,)
+    assert np.all(np.isfinite(wav))
+
+
+# --------------------------------------------------------- speech tokenizer
+def test_speech_tokenizer_roundtrip_shapes():
+    from vllm_omni_tpu.models.qwen3_tts import speech_tokenizer as st
+
+    cfg = st.SpeechTokenizerConfig.tiny()
+    params = st.init_params(jax.random.PRNGKey(0), cfg)
+    mel = jax.random.normal(jax.random.PRNGKey(1), (1, 12, cfg.n_mels))
+    ids = st.encode(params, cfg, mel)
+    assert ids.shape == (1, 12 // cfg.downsample)
+    assert int(ids.max()) < cfg.codebook_size and int(ids.min()) >= 0
+
+    dec = st.SpeechDecoderModel(cfg)
+    out = dec.forward(params, ids, jnp.asarray([ids.shape[1]]))
+    assert out["audio"].shape == (1, ids.shape[1] * cfg.samples_per_code)
+
+
+def test_speech_tokenizer_vq_is_nearest_neighbour():
+    from vllm_omni_tpu.models.qwen3_tts import speech_tokenizer as st
+
+    cfg = st.SpeechTokenizerConfig.tiny()
+    params = st.init_params(jax.random.PRNGKey(0), cfg)
+    # feed codebook vectors straight through a transparent encoder stack:
+    # verify argmin against a brute-force distance computation instead
+    mel = jax.random.normal(jax.random.PRNGKey(3), (1, 8, cfg.n_mels))
+    x = st.nn.conv1d(params["enc_in"], mel)
+    for conv, stride in zip(params["enc"], cfg.encoder_strides):
+        x = st.nn.conv1d(conv, jax.nn.silu(x), stride=stride)
+    cb = params["codebook"]
+    want = np.argmin(
+        np.linalg.norm(np.asarray(x)[0][:, None, :]
+                       - np.asarray(cb)[None], axis=-1), axis=-1)
+    got = np.asarray(st.encode(params, cfg, mel))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tokenize_waveform_host_helper():
+    from vllm_omni_tpu.models.qwen3_tts import speech_tokenizer as st
+
+    cfg = st.SpeechTokenizerConfig.tiny()
+    params = st.init_params(jax.random.PRNGKey(0), cfg)
+    wav = np.sin(np.linspace(0, 80, 4000)).astype(np.float32)
+    ids = st.tokenize_waveform(params, cfg, wav)
+    assert ids.ndim == 1 and len(ids) > 0
+
+
+# ------------------------------------------------------------ tts pipeline
+def test_codec_id_stripping():
+    from vllm_omni_tpu.models.qwen3_tts.tts_lm import (
+        TINY_CODEC_OFFSET,
+        codec_ids_from_lm_tokens,
+    )
+
+    toks = [3, TINY_CODEC_OFFSET + 5, 127, TINY_CODEC_OFFSET + 1, 2]
+    assert codec_ids_from_lm_tokens(toks) == [5, 1]
+
+
+def test_qwen3_tts_pipeline_e2e():
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    omni = Omni(stage_configs=os.path.join(_YAML_DIR, "qwen3_tts_tiny.yaml"))
+    outs = omni.generate([[1, 2, 3]])
+    by = {o.final_output_type: o for o in outs}
+    assert set(by) == {"text", "audio"}
+    wav = by["audio"].multimodal_output["audio"]
+    assert wav.ndim == 1 and len(wav) > 0
+    assert np.all(np.isfinite(wav))
+    # deterministic pipeline reproduces
+    outs2 = omni.generate([[1, 2, 3]])
+    wav2 = {o.final_output_type: o
+            for o in outs2}["audio"].multimodal_output["audio"]
+    np.testing.assert_array_equal(wav, wav2)

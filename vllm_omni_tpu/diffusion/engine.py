@@ -106,6 +106,14 @@ class DiffusionEngine:
                 od_config.model, dtype=dtype, seed=od_config.seed,
                 cache_config=cache_config, mesh=mesh,
             )
+            if solver and hasattr(self.pipeline.cfg, "scheduler"):
+                # from_pretrained builds its own config; re-apply the
+                # override (it was validated above) before any denoise
+                # executable is traced
+                import dataclasses
+
+                self.pipeline.cfg = dataclasses.replace(
+                    self.pipeline.cfg, scheduler=solver)
         else:
             if od_config.model and os.path.isdir(od_config.model):
                 # a real directory without model_index.json is a broken
@@ -206,7 +214,7 @@ class DiffusionEngine:
 
     # ------------------------------------------------------- sleep / wake
     _PARAM_ATTRS = ("dit_params", "text_params", "vae_params",
-                    "vae_encoder_params")
+                    "vae_encoder_params", "decoder_params")
 
     @property
     def is_asleep(self) -> bool:

@@ -113,11 +113,20 @@ class LoRAManager:
         self._base_ref: object = None
 
     def drop_device_state(self) -> None:
-        """Release the fused-tree cache and base-tree reference (engine
-        sleep support: these hold full DiT-sized device trees — keeping
-        them would defeat the HBM eviction sleep() exists for)."""
+        """Release every device buffer this manager holds (engine sleep
+        support): the fused-tree cache and base-tree reference (full
+        DiT-sized trees) AND each registered adapter's A/B matrices —
+        adapters move to host numpy and transparently transfer back on
+        the next activation."""
+        import numpy as np
+
         self._fused_cache.clear()
         self._base_ref = None
+        for ad in self._adapters.values():
+            ad.a = {k: np.asarray(jax.device_get(v))
+                    for k, v in ad.a.items()}
+            ad.b = {k: np.asarray(jax.device_get(v))
+                    for k, v in ad.b.items()}
 
     def register(self, adapter: LoRAAdapter) -> None:
         self._adapters[adapter.name] = adapter

@@ -1,0 +1,7 @@
+"""Qwen2.5-Omni family: thinker / talker / token2wav (3-stage pipeline).
+
+Reference: vllm_omni/model_executor/models/qwen2_5_omni/ — composite
+Qwen2_5OmniForConditionalGeneration split into an AV-L understanding
+thinker, an AR codec talker, and token2wav (a DiT mel generator + BigVGAN
+vocoder — an in-repo diffusion model inside an AR stage; SURVEY §2.8).
+"""

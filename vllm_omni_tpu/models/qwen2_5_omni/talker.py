@@ -1,0 +1,64 @@
+"""Qwen2.5-Omni talker: dense AR codec-token LM (stage 1).
+
+Reference: vllm_omni/model_executor/models/qwen2_5_omni/
+qwen2_5_omni_talker.py — a smaller dense Qwen2 LM consuming the thinker's
+hidden states (projected into its own width) and emitting speech-codec
+tokens for token2wav.  Same handoff as the Qwen3 talker: thinker states
+ride prompt_embeds through the transformer's ``embed_proj``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+# Real Qwen2.5-Omni talker geometry: hidden 896, 24 layers (HF config).
+QWEN2_5_OMNI_TALKER_7B = TransformerConfig(
+    vocab_size=8192 + 8,  # codec codes + specials
+    hidden_size=896,
+    num_layers=24,
+    num_heads=12,
+    num_kv_heads=2,
+    head_dim=128,
+    intermediate_size=4864,
+    attention_bias=True,
+    qk_norm=False,
+)
+
+
+def tiny_config(codec_vocab: int = 64) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=codec_vocab,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=128,
+        attention_bias=True,
+        qk_norm=False,
+    )
+
+
+def init_talker_params(key, cfg: TransformerConfig, thinker_hidden: int,
+                       dtype=jnp.float32):
+    params = init_params(key, cfg, dtype)
+    params["embed_proj"] = nn.linear_init(
+        jax.random.fold_in(key, 77), thinker_hidden, cfg.hidden_size,
+        bias=False, dtype=dtype,
+    )
+    return params
+
+
+def tiny_factory():
+    """model_factory: tiny dense talker consuming 64-wide thinker states."""
+    cfg = tiny_config()
+    params = init_talker_params(jax.random.PRNGKey(11), cfg,
+                                thinker_hidden=64)
+    return params, cfg, None

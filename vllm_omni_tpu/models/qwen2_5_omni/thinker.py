@@ -1,0 +1,53 @@
+"""Qwen2.5-Omni thinker: dense AV-L understanding LM (stage 0).
+
+Reference: vllm_omni/model_executor/models/qwen2_5_omni/
+qwen2_5_omni_thinker.py — a *dense* Qwen2.5 backbone (QKV projection
+biases, no per-head qk-norm — the two switches distinguishing Qwen2 from
+Qwen3 layers) with audio/vision front ends and multimodal 3D-RoPE.  The
+shared functional transformer covers both generations through its config
+flags; the same encoder modules and mm processor as Qwen3-Omni feed the
+prompt_embeds path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+# Real Qwen2.5-Omni-7B thinker geometry (HF config): hidden 3584,
+# 28 layers, 28 heads / 4 kv, dense MLP 18944, mrope_section [16, 24, 24].
+QWEN2_5_OMNI_THINKER_7B = TransformerConfig(
+    vocab_size=152064,
+    hidden_size=3584,
+    num_layers=28,
+    num_heads=28,
+    num_kv_heads=4,
+    head_dim=128,
+    intermediate_size=18944,
+    attention_bias=True,   # Qwen2-style QKV biases
+    qk_norm=False,
+    mrope_sections=(16, 24, 24),
+)
+
+
+def tiny_config(vocab_size: int = 128) -> TransformerConfig:
+    return dataclasses.replace(
+        TransformerConfig.tiny(vocab_size),
+        attention_bias=True,
+        qk_norm=False,
+        mrope_sections=(4, 2, 2),  # head_dim 16 -> half 8
+    )
+
+
+def tiny_factory():
+    """model_factory: random-weight tiny dense thinker."""
+    cfg = tiny_config()
+    params = init_params(jax.random.PRNGKey(10), cfg, jnp.float32)
+    return params, cfg, None

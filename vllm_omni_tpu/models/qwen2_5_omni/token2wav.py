@@ -1,0 +1,185 @@
+"""Qwen2.5-Omni token2wav: flow-matching mel DiT + vocoder (stage 2).
+
+Reference: vllm_omni/model_executor/models/qwen2_5_omni/
+qwen2_5_omni_token2wav.py — a diffusion model *inside an AR stage*: codec
+tokens condition a DiT that flow-matches mel frames, and a BigVGAN
+vocoder renders the waveform.  Runs under the generation scheduler's
+one-shot fast path like code2wav (SURVEY §2.8).
+
+TPU-first: the whole flow loop is a jitted fori_loop (fixed step count —
+one executable per shape bucket); the mel DiT is a small bidirectional
+transformer over frames with the code conditioning concatenated
+channel-wise; the vocoder is the NWC transposed-conv stack of
+qwen3_omni/code2wav.  Deterministic: noise comes from a config seed, so
+identical codec input reproduces identical audio.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+
+@dataclass(frozen=True)
+class Token2WavConfig:
+    codec_vocab: int = 8200
+    mel_bins: int = 80
+    frames_per_code: int = 2  # mel frames per codec token
+    d_model: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    flow_steps: int = 10
+    vocoder_channels: int = 256
+    vocoder_upsample: tuple = (8, 5, 4)  # per mel frame
+    kernel: int = 7
+    noise_seed: int = 0
+
+    @property
+    def total_upsample(self) -> int:
+        """Waveform samples per codec token."""
+        return self.frames_per_code * math.prod(self.vocoder_upsample)
+
+    @staticmethod
+    def tiny() -> "Token2WavConfig":
+        return Token2WavConfig(
+            codec_vocab=64, mel_bins=8, frames_per_code=2, d_model=32,
+            num_layers=2, num_heads=4, flow_steps=4,
+            vocoder_channels=16, vocoder_upsample=(2,), kernel=3,
+        )
+
+
+def init_token2wav_params(key, cfg: Token2WavConfig, dtype=jnp.float32):
+    keys = jax.random.split(key, cfg.num_layers + 8)
+    d = cfg.d_model
+    p = {
+        "code_embed": nn.embedding_init(keys[0], cfg.codec_vocab, d, dtype),
+        # DiT input: [mel ; cond] -> d_model
+        "in_proj": nn.linear_init(keys[1], cfg.mel_bins + d, d, dtype=dtype),
+        "time1": nn.linear_init(keys[2], 256, d, dtype=dtype),
+        "time2": nn.linear_init(keys[3], d, d, dtype=dtype),
+        "out_norm": nn.rmsnorm_init(d, dtype),
+        "out_proj": nn.linear_init(keys[4], d, cfg.mel_bins, dtype=dtype),
+        "blocks": [],
+        # vocoder: mel -> channels -> upsample stack -> wave
+        "voc_pre": nn.conv1d_init(keys[5], cfg.mel_bins,
+                                  cfg.vocoder_channels, cfg.kernel,
+                                  dtype=dtype),
+        "voc_ups": [],
+        "voc_post": None,
+    }
+    head_dim = d // cfg.num_heads
+    del head_dim
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[i + 6], 6)
+        p["blocks"].append({
+            "norm1": nn.rmsnorm_init(d, dtype),
+            "qkv": nn.linear_init(k[0], d, 3 * d, dtype=dtype),
+            "out": nn.linear_init(k[1], d, d, dtype=dtype),
+            "norm2": nn.rmsnorm_init(d, dtype),
+            "up": nn.linear_init(k[2], d, 4 * d, dtype=dtype),
+            "down": nn.linear_init(k[3], 4 * d, d, dtype=dtype),
+            "mod": nn.linear_init(k[4], d, 3 * d, dtype=dtype),
+        })
+    ch = cfg.vocoder_channels
+    kv = jax.random.split(keys[-1], 2 * len(cfg.vocoder_upsample) + 1)
+    for i, f in enumerate(cfg.vocoder_upsample):
+        out_ch = max(4, ch // 2)
+        p["voc_ups"].append({
+            "up": nn.conv1d_init(kv[2 * i], ch, out_ch, 2 * f,
+                                 dtype=dtype),
+            "res": nn.conv1d_init(kv[2 * i + 1], out_ch, out_ch,
+                                  cfg.kernel, dtype=dtype),
+        })
+        ch = out_ch
+    p["voc_post"] = nn.conv1d_init(kv[-1], ch, 1, cfg.kernel, dtype=dtype)
+    return p
+
+
+def _dit_velocity(p, cfg: Token2WavConfig, mel, cond, t):
+    """One DiT evaluation: mel [B, F, M], cond [B, F, D], t [B] in [0,1]
+    -> velocity [B, F, M]."""
+    b, f, _ = mel.shape
+    x = nn.linear(p["in_proj"], jnp.concatenate([mel, cond], axis=-1))
+    temb = nn.timestep_embedding(t * 1000.0, 256).astype(x.dtype)
+    temb = nn.linear(p["time2"], jax.nn.silu(nn.linear(p["time1"], temb)))
+    h = cfg.num_heads
+    hd = cfg.d_model // h
+    for blk in p["blocks"]:
+        shift, scale, gate = jnp.split(
+            nn.linear(blk["mod"], jax.nn.silu(temb)), 3, axis=-1)
+        y = rms_norm(x, blk["norm1"]["w"])
+        y = y * (1.0 + scale[:, None]) + shift[:, None]
+        q, k, v = jnp.split(nn.linear(blk["qkv"], y), 3, axis=-1)
+        o = flash_attention(
+            q.reshape(b, f, h, hd), k.reshape(b, f, h, hd),
+            v.reshape(b, f, h, hd), causal=False,
+        )
+        x = x + gate[:, None] * nn.linear(blk["out"], o.reshape(b, f, -1))
+        y = rms_norm(x, blk["norm2"]["w"])
+        x = x + nn.linear(blk["down"],
+                          jax.nn.gelu(nn.linear(blk["up"], y),
+                                      approximate=True))
+    return nn.linear(p["out_proj"], rms_norm(x, p["out_norm"]["w"]))
+
+
+class Token2WavModel:
+    """Generation-runner model protocol implementation (one-shot)."""
+
+    def __init__(self, cfg: Token2WavConfig):
+        self.cfg = cfg
+
+    def forward(self, params, token_ids: jax.Array, lengths: jax.Array):
+        """token_ids [B, S] codec ids -> {"audio": [B, S*total_upsample]}.
+
+        Flow-matches mel frames conditioned on upsampled code embeddings,
+        then renders the waveform through the vocoder.  Padding rows
+        produce garbage past lengths*up; the runner slices per request.
+        """
+        cfg = self.cfg
+        del lengths  # padded rows are sliced by the runner
+        b, s = token_ids.shape
+        frames = s * cfg.frames_per_code
+        cond = nn.embedding(params["code_embed"], token_ids)  # [B, S, D]
+        cond = jnp.repeat(cond, cfg.frames_per_code, axis=1)  # [B, F, D]
+
+        noise = jax.random.normal(
+            jax.random.PRNGKey(cfg.noise_seed),
+            (b, frames, cfg.mel_bins), cond.dtype,
+        )
+        n = cfg.flow_steps
+
+        def body(i, mel):
+            # straight flow sigma: 1 -> 0 in n steps
+            sigma = 1.0 - i / n
+            t = jnp.full((b,), sigma, jnp.float32)
+            v = _dit_velocity(params, cfg, mel, cond, t)
+            return mel - (1.0 / n) * v
+
+        mel = jax.lax.fori_loop(0, n, body, noise)
+
+        # vocoder: [B, F, M] -> [B, F*up, 1]
+        x = nn.conv1d(params["voc_pre"], mel)
+        for blk, f in zip(params["voc_ups"], cfg.vocoder_upsample):
+            x = jax.nn.silu(x)
+            x = nn.conv1d_transpose(blk["up"], x, stride=f)
+            x = x + nn.conv1d(blk["res"], jax.nn.silu(x))
+        wav = jnp.tanh(nn.conv1d(params["voc_post"], jax.nn.silu(x)))
+        return {"audio": wav[..., 0], "mel": mel}
+
+    def slice_output(self, outputs: dict, row: int, in_len: int):
+        up = self.cfg.total_upsample
+        return {"audio": np.asarray(outputs["audio"][row, : in_len * up])}
+
+
+def tiny_factory():
+    """model_factory for generation stages: (params, model_obj, eos)."""
+    cfg = Token2WavConfig.tiny()
+    params = init_token2wav_params(jax.random.PRNGKey(12), cfg)
+    return params, Token2WavModel(cfg), None

@@ -1,0 +1,6 @@
+"""Qwen3-TTS family: TTS LM + speech tokenizers (text -> speech).
+
+Reference: vllm_omni/model_executor/models/qwen3_tts/ (~7.5k LoC: TTS LM,
+12.5Hz/25Hz speech tokenizers with VQ/whisper encoder stacks, custom HF
+config registration at engine/arg_utils.py:15-30; SURVEY §2.8).
+"""

@@ -1,0 +1,144 @@
+"""Qwen3-TTS speech tokenizer: waveform <-> discrete codec ids.
+
+Reference: vllm_omni/model_executor/models/qwen3_tts/ — the 12.5Hz/25Hz
+speech tokenizers (VQ/whisper encoder stacks) that ground the TTS LM's
+codec vocabulary (SURVEY §2.8).
+
+TPU-first design: the encoder is log-mel frames -> strided NWC conv stack
+-> nearest-neighbour vector quantization against a learned codebook (one
+argmin matmul on the MXU); the decoder renders codec ids back to waveform
+through the same transposed-conv vocoder family as code2wav and runs as a
+one-shot generation-stage model.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+
+
+@dataclass(frozen=True)
+class SpeechTokenizerConfig:
+    codebook_size: int = 8192
+    code_dim: int = 256
+    n_mels: int = 128
+    # stride-2 conv stages: mel frame rate / 2^len -> token rate
+    encoder_strides: tuple = (2, 2)
+    vocoder_channels: int = 256
+    vocoder_upsample: tuple = (8, 5, 4, 2)
+    kernel: int = 5
+
+    @property
+    def downsample(self) -> int:
+        return int(np.prod(self.encoder_strides))
+
+    @property
+    def samples_per_code(self) -> int:
+        return int(math.prod(self.vocoder_upsample))
+
+    @staticmethod
+    def tiny() -> "SpeechTokenizerConfig":
+        return SpeechTokenizerConfig(
+            codebook_size=60, code_dim=16, n_mels=8,
+            encoder_strides=(2,), vocoder_channels=16,
+            vocoder_upsample=(2, 2), kernel=3,
+        )
+
+
+def init_params(key, cfg: SpeechTokenizerConfig, dtype=jnp.float32):
+    keys = jax.random.split(key, 4 + len(cfg.encoder_strides)
+                            + 2 * len(cfg.vocoder_upsample))
+    ki = iter(keys)
+    p = {
+        "codebook": jax.random.normal(
+            next(ki), (cfg.codebook_size, cfg.code_dim), dtype),
+        "enc_in": nn.conv1d_init(next(ki), cfg.n_mels, cfg.code_dim,
+                                 cfg.kernel, dtype=dtype),
+        "enc": [
+            nn.conv1d_init(next(ki), cfg.code_dim, cfg.code_dim,
+                           cfg.kernel, dtype=dtype)
+            for _ in cfg.encoder_strides
+        ],
+        "dec_in": nn.conv1d_init(next(ki), cfg.code_dim,
+                                 cfg.vocoder_channels, cfg.kernel,
+                                 dtype=dtype),
+        "dec_ups": [],
+        "dec_out": None,
+    }
+    ch = cfg.vocoder_channels
+    for f in cfg.vocoder_upsample:
+        out_ch = max(4, ch // 2)
+        p["dec_ups"].append({
+            "up": nn.conv1d_init(next(ki), ch, out_ch, 2 * f, dtype=dtype),
+            "res": nn.conv1d_init(next(ki), out_ch, out_ch, cfg.kernel,
+                                  dtype=dtype),
+        })
+        ch = out_ch
+    p["dec_out"] = nn.conv1d_init(next(ki), ch, 1, cfg.kernel, dtype=dtype)
+    return p
+
+
+def encode(params, cfg: SpeechTokenizerConfig, mel: jax.Array) -> jax.Array:
+    """Log-mel [B, T, n_mels] -> codec ids [B, T // downsample]."""
+    x = nn.conv1d(params["enc_in"], mel)
+    for conv, stride in zip(params["enc"], cfg.encoder_strides):
+        x = nn.conv1d(conv, jax.nn.silu(x), stride=stride)
+    # nearest-neighbour VQ: argmin ||x - c||^2 over the codebook — one
+    # [T, D] @ [D, K] matmul plus norms (MXU-friendly)
+    cb = params["codebook"]
+    dots = jnp.einsum("btd,kd->btk", x, cb)
+    d2 = (jnp.sum(x * x, -1, keepdims=True)
+          - 2.0 * dots + jnp.sum(cb * cb, -1)[None, None, :])
+    return jnp.argmin(d2, axis=-1).astype(jnp.int32)
+
+
+class SpeechDecoderModel:
+    """Generation-runner model: codec ids -> waveform (one-shot)."""
+
+    def __init__(self, cfg: SpeechTokenizerConfig):
+        self.cfg = cfg
+
+    @property
+    def total_upsample(self) -> int:
+        return self.cfg.samples_per_code
+
+    def forward(self, params, token_ids: jax.Array, lengths: jax.Array):
+        cfg = self.cfg
+        del lengths
+        ids = jnp.clip(token_ids, 0, cfg.codebook_size - 1)
+        x = params["codebook"][ids]  # [B, S, D]
+        x = nn.conv1d(params["dec_in"], x)
+        for blk, f in zip(params["dec_ups"], cfg.vocoder_upsample):
+            x = jax.nn.silu(x)
+            x = nn.conv1d_transpose(blk["up"], x, stride=f)
+            x = x + nn.conv1d(blk["res"], jax.nn.silu(x))
+        wav = jnp.tanh(nn.conv1d(params["dec_out"], jax.nn.silu(x)))
+        return {"audio": wav[..., 0]}
+
+    def slice_output(self, outputs: dict, row: int, in_len: int):
+        up = self.cfg.samples_per_code
+        return {"audio": np.asarray(outputs["audio"][row, : in_len * up])}
+
+
+def tiny_decoder_factory():
+    """model_factory for the vocoder stage: (params, model_obj, eos)."""
+    cfg = SpeechTokenizerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(21), cfg)
+    return params, SpeechDecoderModel(cfg), None
+
+
+def tokenize_waveform(params, cfg: SpeechTokenizerConfig,
+                      waveform: np.ndarray, sr: int = 16000) -> np.ndarray:
+    """Host helper: raw waveform -> codec ids (reference-audio prompts /
+    voice cloning intake)."""
+    from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+
+    mel = log_mel_spectrogram(waveform, sr=sr, n_mels=cfg.n_mels)
+    ids = encode(params, cfg, jnp.asarray(mel)[None])
+    return np.asarray(ids[0])

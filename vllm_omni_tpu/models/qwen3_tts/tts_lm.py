@@ -1,0 +1,66 @@
+"""Qwen3-TTS LM: text tokens -> speech-codec tokens (stage 0).
+
+Reference: vllm_omni/model_executor/models/qwen3_tts/ — the TTS language
+model autoregressively emits 12.5Hz speech-codec tokens from text (plus
+optional voice/reference conditioning).  On the shared functional
+transformer the LM is a Qwen3-style (qk-norm) decoder whose output head
+covers the codec vocabulary; text and codec ids share one embedding table
+partitioned by offset (text ids first, codec ids at ``codec_offset``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+# Real Qwen3-TTS LM geometry (HF config scale): hidden 1024, 28 layers.
+QWEN3_TTS_LM = TransformerConfig(
+    vocab_size=151936 + 8192 + 8,  # text vocab + codec codes + specials
+    hidden_size=1024,
+    num_layers=28,
+    num_heads=16,
+    num_kv_heads=4,
+    head_dim=128,
+    intermediate_size=3072,
+    qk_norm=True,
+)
+
+# tiny preset: 64 text ids, 60 codec ids, specials at the top
+TINY_TEXT_VOCAB = 64
+TINY_CODEC_OFFSET = 64
+TINY_CODEC_VOCAB = 60
+TINY_EOS = 127
+
+
+def tiny_config() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=128,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=128,
+        qk_norm=True,
+    )
+
+
+def tiny_factory():
+    """model_factory: tiny TTS LM (text ids < 64, codec ids >= 64)."""
+    cfg = tiny_config()
+    params = init_params(jax.random.PRNGKey(20), cfg, jnp.float32)
+    return params, cfg, TINY_EOS
+
+
+def codec_ids_from_lm_tokens(token_ids, codec_offset: int = TINY_CODEC_OFFSET,
+                             codec_vocab: int = TINY_CODEC_VOCAB):
+    """Strip non-codec tokens and remove the vocabulary offset (the LM's
+    sampled stream may interleave specials; the tokenizer decoder wants
+    pure codec ids)."""
+    return [int(t) - codec_offset for t in token_ids
+            if codec_offset <= int(t) < codec_offset + codec_vocab]

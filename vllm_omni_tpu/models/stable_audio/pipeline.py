@@ -174,13 +174,16 @@ class StableAudioPipeline:
         self.dit_params = init_dit_params(k2, config.dit, dtype)
         self.decoder_params = init_decoder_params(k3, config, dtype)
         self._denoise_cache: dict = {}
+        # params are explicit jit ARGUMENTS (closure capture would bake
+        # them into the executable — sleep()/weight swaps wouldn't apply),
+        # and the jit is built once, not per request
+        self._text_encode_jit = jax.jit(
+            lambda p, i: forward_hidden(p, self.cfg.text, i))
 
     def encode_prompt(self, prompts: list[str]):
         ids, lens = self.tokenizer.batch_encode(prompts,
                                                 self.cfg.max_text_len)
-        hidden = jax.jit(
-            lambda i: forward_hidden(self.text_params, self.cfg.text, i)
-        )(jnp.asarray(ids))
+        hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
         mask = (np.arange(self.cfg.max_text_len)[None, :]
                 < lens[:, None]).astype(np.int32)
         return hidden, jnp.asarray(mask)

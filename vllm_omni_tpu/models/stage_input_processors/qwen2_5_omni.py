@@ -1,0 +1,15 @@
+"""Cross-stage tensor wiring for the Qwen2.5-Omni pipeline.
+
+Reference: vllm_omni/model_executor/stage_input_processors/ (qwen2_5
+variant).  The handoffs are structurally identical to Qwen3-Omni —
+thinker hidden states ride prompt_embeds into the talker, codec tokens
+become the one-shot token2wav prompt — so the shared implementations are
+re-exported under this family's names.
+"""
+
+from vllm_omni_tpu.models.stage_input_processors.qwen3_omni import (
+    thinker_to_talker,
+    talker_to_code2wav as talker_to_token2wav,
+)
+
+__all__ = ["thinker_to_talker", "talker_to_token2wav"]

@@ -1,0 +1,23 @@
+"""Cross-stage wiring for the Qwen3-TTS pipeline: LM codec stream ->
+speech-decoder prompt (reference: qwen3_tts stage wiring, SURVEY §2.8)."""
+
+from __future__ import annotations
+
+from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+from vllm_omni_tpu.models.qwen3_tts.tts_lm import codec_ids_from_lm_tokens
+
+
+def lm_to_speech_decoder(config, upstream_outputs) -> list[StageRequest]:
+    """Strip specials + the text-vocab offset from the LM's sampled stream;
+    the pure codec ids become the one-shot vocoder prompt."""
+    reqs = []
+    for out in upstream_outputs:
+        toks = out.outputs[0].token_ids if out.outputs else []
+        codec = codec_ids_from_lm_tokens(toks)
+        if not codec:
+            # degenerate sample (no codec tokens): emit one silence code
+            # rather than an empty prompt the scheduler would reject
+            codec = [0]
+        reqs.append(StageRequest(request_id=out.request_id,
+                                 prompt_token_ids=codec))
+    return reqs
