@@ -128,7 +128,11 @@ def test_unknown_path(server_url):
 def test_metrics_endpoint(server_url):
     r = httpx.get(f"{server_url}/metrics", timeout=30)
     assert r.status_code == 200
-    assert "stages" in r.json()
+    body = r.json()
+    assert "stages" in body
+    # device memory snapshot rides along (platform hbm_bytes)
+    assert body["device"]["platform"] in ("cpu", "tpu")
+    assert "hbm_bytes" in body["device"]
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,174 @@
+"""Platform-layer depth + plugin system + SD3 sibling (VERDICT r1 rows
+5/61 — the plugin system and a platform layer things dispatch through)."""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+
+from vllm_omni_tpu.platforms import (
+    current_platform,
+    register_platform,
+    reset_platform,
+)
+
+
+def test_platform_surface():
+    p = current_platform()
+    assert p.name in ("cpu", "tpu")
+    assert p.device_count() >= 1
+    assert isinstance(p.device_kind(), str)
+    assert p.peak_tflops_bf16() > 0
+    assert os.path.isdir(p.default_stage_config_dir())
+    # every in-tree stage YAML is discoverable through the platform
+    yamls = os.listdir(p.default_stage_config_dir())
+    assert any(y.endswith(".yaml") for y in yamls)
+    env = p.stage_device_env("all")
+    assert isinstance(env, dict)
+
+
+def test_cpu_stage_device_env_scopes_children():
+    from vllm_omni_tpu.platforms.cpu import CpuPlatform
+
+    env = CpuPlatform().stage_device_env("all")
+    assert env["JAX_PLATFORMS"] == "cpu"
+
+
+def test_tpu_platform_peak_table():
+    from vllm_omni_tpu.platforms.tpu import TpuPlatform
+
+    class FakeV5e(TpuPlatform):
+        def device_kind(self):
+            return "TPU v5 lite0"
+
+    class FakeV6(TpuPlatform):
+        def device_kind(self):
+            return "TPU v6e"
+
+    assert FakeV5e().peak_tflops_bf16() == 197.0
+    assert FakeV6().peak_tflops_bf16() == 918.0
+    env = FakeV5e().stage_device_env("0,1")
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+
+
+def test_env_plugin_loading(tmp_path, monkeypatch):
+    """OMNI_TPU_PLUGINS modules load and can override platform
+    detection (reference: entry-point platform plugins,
+    plugins/__init__.py:24-81)."""
+    mod = tmp_path / "my_omni_plugin.py"
+    mod.write_text(textwrap.dedent("""
+        from vllm_omni_tpu.platforms.cpu import CpuPlatform
+
+        class MyPlatform(CpuPlatform):
+            name = "my-accelerator"
+
+        CALLED = []
+
+        def register():
+            CALLED.append(1)
+            import jax
+            # claim the active backend so detection picks us
+            return jax.default_backend(), MyPlatform
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("OMNI_TPU_PLUGINS", "my_omni_plugin")
+    import vllm_omni_tpu.plugins as plugins
+
+    try:
+        n = plugins.load_plugins(reload=True)
+        assert n >= 1
+        import my_omni_plugin
+
+        assert my_omni_plugin.CALLED == [1]
+        reset_platform()
+        assert current_platform().name == "my-accelerator"
+    finally:
+        reset_platform()
+        # undo the registration so later tests detect normally
+        from vllm_omni_tpu import platforms as plat_mod
+
+        plat_mod._registered.clear()
+        sys.modules.pop("my_omni_plugin", None)
+        reset_platform()
+
+
+def test_plugin_failure_is_non_fatal(monkeypatch):
+    monkeypatch.setenv("OMNI_TPU_PLUGINS", "definitely_not_a_module")
+    import vllm_omni_tpu.plugins as plugins
+
+    # must not raise
+    plugins.load_plugins(reload=True)
+
+
+def test_bench_flop_model_sanity():
+    from bench import dit_flops_per_image
+    from vllm_omni_tpu.models.qwen_image.pipeline import (
+        QwenImagePipelineConfig,
+    )
+
+    cfg = QwenImagePipelineConfig.bench()
+    f = dit_flops_per_image(cfg, 512, 512, 20, txt_len=cfg.max_text_len,
+                            cfg_scale_doubling=True)
+    # 16-layer 2048-dim MMDiT at 4096+128 joint tokens, 20 CFG-doubled
+    # steps: order 100 TFLOPs — sanity band, not an exact pin
+    assert 10e12 < f < 1000e12
+    # scales ~quadratically with resolution (joint-attention term)
+    f2 = dit_flops_per_image(cfg, 1024, 1024, 20,
+                             txt_len=cfg.max_text_len,
+                             cfg_scale_doubling=True)
+    assert f2 > 3.5 * f
+
+
+# ----------------------------------------------------------------- SD3
+def test_sd3_pipeline_and_registry():
+    import jax
+    import jax.numpy as jnp
+
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.registry import DiffusionModelRegistry
+    from vllm_omni_tpu.models.sd3.pipeline import (
+        SD3Pipeline,
+        SD3PipelineConfig,
+    )
+
+    assert DiffusionModelRegistry.resolve(
+        "StableDiffusion3Pipeline") is SD3Pipeline
+    pipe = SD3Pipeline(SD3PipelineConfig.tiny(), dtype=jnp.float32)
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=5.0,
+        seed=0)
+    out = pipe.forward(OmniDiffusionRequest(
+        prompt=["a cat"], sampling_params=sp, request_ids=["r"]))
+    assert out[0].data.shape == (16, 16, 3)
+    # CFG is live: guidance_scale=1 (no CFG) differs
+    sp2 = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=1.0,
+        seed=0)
+    out2 = pipe.forward(OmniDiffusionRequest(
+        prompt=["a cat"], sampling_params=sp2, request_ids=["r2"]))
+    assert (out[0].data != out2[0].data).any()
+    # deterministic
+    out3 = pipe.forward(OmniDiffusionRequest(
+        prompt=["a cat"], sampling_params=sp, request_ids=["r3"]))
+    np.testing.assert_array_equal(out[0].data, out3[0].data)
+
+
+def test_sd3_rejects_flux_shape():
+    import jax.numpy as jnp
+    import pytest
+
+    from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
+    from vllm_omni_tpu.models.sd3.pipeline import (
+        SD3Pipeline,
+        SD3PipelineConfig,
+    )
+    import dataclasses
+
+    cfg = SD3PipelineConfig.tiny()
+    bad = dataclasses.replace(cfg, dit=FluxDiTConfig.tiny())  # has singles
+    with pytest.raises(ValueError, match="double-stream"):
+        SD3Pipeline(bad, dtype=jnp.float32)

@@ -60,9 +60,18 @@ class Omni:
             if cfg.runtime.process:
                 from vllm_omni_tpu.entrypoints.stage_proc import ProcStage
 
-                self.stages.append(
-                    ProcStage(cfg, device_env=cfg.runtime.device_env)
-                )
+                env = cfg.runtime.device_env
+                if not env:
+                    # env-sniffed default (CUDA_VISIBLE_DEVICES analogue)
+                    # — deliberately NOT current_platform(): that would
+                    # initialize jax in the parent and acquire the TPU
+                    # the children need
+                    from vllm_omni_tpu.platforms import (
+                        default_stage_device_env,
+                    )
+
+                    env = default_stage_device_env(cfg.runtime.devices)
+                self.stages.append(ProcStage(cfg, device_env=env))
             else:
                 self.stages.append(OmniStage(cfg))
         self.metrics = OrchestratorAggregator(len(configs), stats_path)

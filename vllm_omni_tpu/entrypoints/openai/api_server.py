@@ -305,7 +305,18 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         elif self.path == "/version":
             self._json(200, {"version": __version__})
         elif self.path == "/metrics":
-            self._json(200, self.state.omni.metrics.summary())
+            summary = self.state.omni.metrics.summary()
+            # device memory snapshot (per-process accounting analogue,
+            # reference: worker/gpu_memory_utils.py NVML probes)
+            from vllm_omni_tpu.platforms import current_platform
+
+            p = current_platform()
+            summary["device"] = {
+                "platform": p.name,
+                "kind": p.device_kind(),
+                "hbm_bytes": p.hbm_bytes(),
+            }
+            self._json(200, summary)
         else:
             self._error(404, f"unknown path {self.path}")
 

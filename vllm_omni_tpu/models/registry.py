@@ -51,10 +51,16 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "WanTI2VPipeline": _Entry(
         "vllm_omni_tpu.models.wan.pipeline", "WanI2VPipeline"
     ),
-    # joint-attention MMDiT sibling (reference: FluxPipeline,
-    # diffusion/registry.py:16-102)
+    # joint-attention MMDiT siblings (reference: FluxPipeline / SD3,
+    # diffusion/registry.py:16-102) — one shared MMDiT block implementation
     "FluxPipeline": _Entry(
         "vllm_omni_tpu.models.flux.pipeline", "FluxPipeline"
+    ),
+    "StableDiffusion3Pipeline": _Entry(
+        "vllm_omni_tpu.models.sd3.pipeline", "SD3Pipeline"
+    ),
+    "SD3Pipeline": _Entry(
+        "vllm_omni_tpu.models.sd3.pipeline", "SD3Pipeline"
     ),
     # audio (reference: StableAudio family)
     "StableAudioPipeline": _Entry(

@@ -39,6 +39,12 @@ def _detect() -> OmniPlatform:
 def current_platform() -> OmniPlatform:
     global _current
     if _current is None:
+        # plugins first: a platform plugin registered for the active jax
+        # backend must win detection (reference: entry-point override,
+        # platforms/__init__.py:118-151)
+        from vllm_omni_tpu.plugins import load_plugins
+
+        load_plugins()
         _current = _detect()
     return _current
 
@@ -47,3 +53,23 @@ def reset_platform() -> None:
     """Testing hook."""
     global _current
     _current = None
+
+
+def default_stage_device_env(devices: str = "all") -> dict:
+    """Child-process device scoping WITHOUT initializing jax in the
+    caller: the orchestrator parent of an all-process pipeline must never
+    touch the TPU runtime itself (acquiring the chips its children need),
+    so this sniffs environment variables only.  The per-platform
+    ``stage_device_env`` methods remain for callers that already hold a
+    platform."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        from vllm_omni_tpu.platforms.cpu import CpuPlatform
+
+        return CpuPlatform().stage_device_env(devices)
+    if devices in ("", "all"):
+        return {}
+    from vllm_omni_tpu.platforms.tpu import TpuPlatform
+
+    return TpuPlatform().stage_device_env(devices)

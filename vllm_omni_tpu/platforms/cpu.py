@@ -25,6 +25,13 @@ class CpuPlatform(OmniPlatform):
             return override
         return "xla"
 
+    def peak_tflops_bf16(self) -> float:
+        return 0.5  # rough host-CPU figure; MFU on CPU is informational
+
+    def stage_device_env(self, devices: str = "all") -> dict:
+        # children must not grab a TPU the parent may hold
+        return {"JAX_PLATFORMS": "cpu", "OMNI_TPU_PALLAS_INTERPRET": "1"}
+
     def preferred_dtype(self):
         import jax.numpy as jnp
 

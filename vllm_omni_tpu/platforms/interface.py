@@ -26,6 +26,44 @@ class OmniPlatform(ABC):
 
         return jax.local_device_count()
 
+    def device_kind(self) -> str:
+        import jax
+
+        return jax.devices()[0].device_kind
+
+    def hbm_bytes(self):
+        """Per-device memory limit in bytes (None when the backend does
+        not report it) — the TPU analogue of the reference's NVML
+        per-process accounting (worker/gpu_memory_utils.py:22-124)."""
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except (RuntimeError, AttributeError):
+            return None
+        if not stats:
+            return None
+        return stats.get("bytes_limit")
+
+    def peak_tflops_bf16(self) -> float:
+        """Peak dense bf16 TFLOP/s of one device (MFU denominators)."""
+        return 0.0
+
+    def stage_device_env(self, devices: str = "all") -> dict:
+        """Env applied to a spawned stage worker BEFORE jax import so the
+        child binds only its share of the hardware (reference:
+        set_stage_devices / CUDA_VISIBLE_DEVICES scoping,
+        entrypoints/stage_utils.py)."""
+        return {}
+
+    def default_stage_config_dir(self) -> str:
+        """Directory of in-tree stage YAMLs (reference:
+        get_default_stage_config_path, platforms/interface.py:43-99);
+        single source of truth lives in config/stage.py."""
+        from vllm_omni_tpu.config.stage import _STAGE_CONFIG_DIR
+
+        return _STAGE_CONFIG_DIR
+
     def preferred_dtype(self):
         import jax.numpy as jnp
 

@@ -22,3 +22,28 @@ class TpuPlatform(OmniPlatform):
         if override != "auto":
             return override
         return "pallas_flash"
+
+    # peak dense bf16 TFLOP/s per chip by generation (public spec sheet
+    # numbers; MFU denominators)
+    _PEAK_TABLE = {
+        "v4": 275.0, "v5 lite": 197.0, "v5e": 197.0, "v5litepod": 197.0,
+        "v5p": 459.0, "v6 lite": 918.0, "v6e": 918.0,
+    }
+
+    def peak_tflops_bf16(self) -> float:
+        kind = self.device_kind().lower()
+        for k, v in self._PEAK_TABLE.items():
+            if k in kind:
+                return v
+        return 197.0
+
+    def stage_device_env(self, devices: str = "all") -> dict:
+        if devices in ("", "all"):
+            return {}
+        # libtpu chip-scoping recipe (as used for single-host
+        # multi-process): visible chips + process bounds + chips-per-
+        # process bounds matching the subset size
+        n = len([d for d in devices.split(",") if d])
+        return {"TPU_VISIBLE_CHIPS": devices,
+                "TPU_PROCESS_BOUNDS": "1,1,1",
+                "TPU_CHIPS_PER_PROCESS_BOUNDS": f"{n},1,1"}

@@ -1,0 +1,96 @@
+"""Plugin loading: entry points + env-listed modules.
+
+Behavioral port of the reference's plugin system (reference:
+vllm_omni/plugins/__init__.py:24,61 — entry-point groups
+``vllm_omni.general_plugins`` (arbitrary setup hooks) and
+``vllm_omni.platform_plugins`` (platform-class providers), loaded once at
+package import).
+
+Two discovery paths:
+- **entry points**: installed packages exposing the groups
+  ``vllm_omni_tpu.general_plugins`` / ``vllm_omni_tpu.platform_plugins``;
+- **env modules**: ``OMNI_TPU_PLUGINS=mod1,mod2`` imports each module and
+  calls its ``register()`` (development / air-gapped images where nothing
+  can be pip-installed).
+
+A platform plugin's entry point (or ``register()``) returns
+``(backend_name, platform_cls)``, registered via
+``platforms.register_platform`` so detection prefers it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+GENERAL_GROUP = "vllm_omni_tpu.general_plugins"
+PLATFORM_GROUP = "vllm_omni_tpu.platform_plugins"
+
+_loaded = False
+
+
+def _entry_points(group: str):
+    from importlib.metadata import entry_points
+
+    try:
+        return list(entry_points(group=group))
+    except TypeError:  # pragma: no cover - pre-3.10 fallback
+        return list(entry_points().get(group, ()))
+
+
+def _apply_platform(result) -> None:
+    from vllm_omni_tpu.platforms import register_platform
+
+    if result is None:
+        return
+    name, cls = result
+    register_platform(name, cls)
+    logger.info("registered platform plugin %r", name)
+
+
+def load_plugins(reload: bool = False) -> int:
+    """Load every discovered plugin; returns how many loaded.  Idempotent
+    unless ``reload`` (the reference loads once at import,
+    plugins/__init__.py:61)."""
+    global _loaded
+    if _loaded and not reload:
+        return 0
+    _loaded = True
+    n = 0
+    for ep in _entry_points(GENERAL_GROUP):
+        try:
+            hook = ep.load()
+            hook()
+            n += 1
+            logger.info("loaded general plugin %r", ep.name)
+        except Exception as e:
+            logger.warning("general plugin %r failed: %s", ep.name, e)
+    for ep in _entry_points(PLATFORM_GROUP):
+        try:
+            _apply_platform(ep.load()())
+            n += 1
+        except Exception as e:
+            logger.warning("platform plugin %r failed: %s", ep.name, e)
+    env = os.environ.get("OMNI_TPU_PLUGINS", "")
+    for mod_name in filter(None, (m.strip() for m in env.split(","))):
+        try:
+            import importlib
+
+            mod = importlib.import_module(mod_name)
+            result = mod.register()
+            # a register() may return a platform tuple or None
+            if isinstance(result, tuple):
+                _apply_platform(result)
+            n += 1
+            logger.info("loaded env plugin %r", mod_name)
+        except Exception as e:
+            logger.warning("env plugin %r failed: %s", mod_name, e)
+    return n
+
+
+def plugins_loaded() -> bool:
+    return _loaded
