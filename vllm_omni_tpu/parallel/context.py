@@ -76,16 +76,29 @@ def ring_attention(
     joint_k: Optional[jax.Array] = None,  # [B, S_text, H, D] replicated
     joint_v: Optional[jax.Array] = None,
     joint_mask: Optional[jax.Array] = None,  # [B, S_text] 1=real, 0=pad
+    causal: bool = False,
 ) -> jax.Array:
-    """Non-causal blockwise ring attention (DiT long-sequence attention).
+    """Blockwise ring attention (DiT long-sequence attention; causal mode
+    for AR prefill context parallelism).
 
     Each step attends the local Q against the currently-held KV block, then
     rotates the KV block to the next ring neighbour with ``ppermute``.
     Partial results merge via LSE.  The replicated joint text KV is attended
     once at step 0 (reference ring_flash_attn.py:72-79 behaviour);
     ``joint_mask`` zeroes attention mass on padded text tokens.
+
+    ``causal=True`` (no joint stream): with sequence chunks laid out in
+    ring order, the KV block held after s rotations came from device
+    (idx - s) mod n; its global offset relative to the local queries is
+    (idx - j) * S_local — earlier chunks attend fully, the own chunk
+    causally, later chunks not at all (the flash kernel's per-sequence
+    q_offsets express all three as one masked call, and fully-masked
+    blocks merge neutrally through the LSE).
     """
     n = jax.lax.axis_size(ring_axis)
+
+    if causal and joint_k is not None:
+        raise ValueError("causal ring attention has no joint text stream")
 
     k0, v0 = k, v
     kv_mask = None
@@ -96,26 +109,36 @@ def ring_attention(
     else:
         kj, vj = k0, v0
     o, lse = flash_attention(
-        q, kj, vj, causal=False, kv_mask=kv_mask, return_lse=True
+        q, kj, vj, causal=causal, kv_mask=kv_mask, return_lse=True
     )
 
     if n == 1:
         return o
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+    idx = jax.lax.axis_index(ring_axis)
+    b, c = q.shape[0], q.shape[1]
 
-    def step(carry, _):
+    def step(carry, s):
         o_acc, lse_acc, k_cur, v_cur = carry
         k_nxt = jax.lax.ppermute(k_cur, ring_axis, perm)
         v_nxt = jax.lax.ppermute(v_cur, ring_axis, perm)
-        o_i, lse_i = flash_attention(
-            q, k_nxt, v_nxt, causal=False, return_lse=True
-        )
+        if causal:
+            j = jnp.mod(idx - s, n)  # origin device of this KV block
+            offset = (idx - j) * c
+            o_i, lse_i = flash_attention(
+                q, k_nxt, v_nxt, causal=True, return_lse=True,
+                q_offsets=jnp.broadcast_to(offset, (b,)),
+            )
+        else:
+            o_i, lse_i = flash_attention(
+                q, k_nxt, v_nxt, causal=False, return_lse=True
+            )
         o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_i, lse_i)
         return (o_acc, lse_acc, k_nxt, v_nxt), None
 
     (o, lse, _, _), _ = jax.lax.scan(
-        step, (o, lse, k0, v0), None, length=n - 1
+        step, (o, lse, k0, v0), jnp.arange(1, n)
     )
     return o
 
