@@ -135,3 +135,36 @@ def test_serving_bench_cli(bench_server_url, capsys):
     assert rc == 0
     report = json.loads(capsys.readouterr().out.strip())
     assert report["num_requests"] == 2 and report["num_errors"] == 0
+
+
+def test_async_engine_stats_heartbeat():
+    """The serving engine loop harvests per-stage stats continuously
+    (reference: do_log_stats keep-alive) — /metrics shows stage counters
+    without waiting for an offline generate() to finish."""
+    import time
+
+    from vllm_omni_tpu.entrypoints.async_omni import AsyncOmni
+
+    omni = AsyncOmni(stage_configs=[_llm_stage()])
+    omni._stats_interval = 0.2
+    try:
+        import asyncio
+
+        async def run():
+            outs = []
+            async for o in omni.generate([1, 2, 3], {"max_tokens": 4}):
+                outs.append(o)
+            return outs
+
+        loop = asyncio.new_event_loop()
+        outs = loop.run_until_complete(run())
+        assert outs and not outs[0].is_error
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if omni.metrics.summary()["stages"][0]["num_requests"] >= 1:
+                break
+            time.sleep(0.1)
+        assert omni.metrics.summary()["stages"][0]["num_requests"] >= 1
+        loop.close()
+    finally:
+        omni.shutdown()

@@ -48,6 +48,8 @@ class AsyncOmni:
         self._finals_seen: dict[str, int] = {}
         self._req_counter = itertools.count()
         self._running = True
+        # engine-level stats heartbeat period (seconds); tests shrink it
+        self._stats_interval = 10.0
         self._thread = threading.Thread(target=self._engine_loop,
                                         daemon=True, name="omni-engine")
         self._thread.start()
@@ -136,7 +138,27 @@ class AsyncOmni:
         entry_stages = [s for s in omni.stages
                         if -1 in s.config.engine_input_source]
         entry_stage = entry_stages[0] if entry_stages else omni.stages[0]
+        import time as _time
+
+        # periodic engine-level stats heartbeat (reference: the
+        # do_log_stats keep-alive task, omni_stage.py:1134-1146)
+        last_stats = _time.monotonic()
         while self._running:
+            now = _time.monotonic()
+            if now - last_stats >= self._stats_interval:
+                last_stats = now
+                # harvest stage request stats continuously (the offline
+                # path collects at end-of-generate) so long-running
+                # servers aggregate + stream jsonl as they go
+                omni.harvest_stage_stats()
+                if self._streams:
+                    summ = omni.metrics.summary()
+                    logger.info(
+                        "stats: %d in flight, e2e p50 %.0fms, stages %s",
+                        len(self._streams), summ["e2e"]["p50_ms"],
+                        {i: st["tps"]
+                         for i, st in summ["stages"].items()},
+                    )
             # 1. drain intake
             pending = []
             try:

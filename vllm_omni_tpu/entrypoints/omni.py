@@ -176,14 +176,19 @@ class Omni:
                         self.metrics.record_finish(o.request_id)
                 if outs:
                     self._forward(stage, outs)
-        for stage in self.stages:
-            for s in stage.request_stats:
-                self.metrics.record_stage_request(s)
-            stage.request_stats.clear()
+        self.harvest_stage_stats()
         missing = expected - set(finals)
         if missing:
             logger.warning("requests lost in pipeline: %s", sorted(missing))
         return [o for r in seed for o in finals.get(r.request_id, [])]
+
+    def harvest_stage_stats(self) -> None:
+        """Drain per-stage request stats into the aggregator (called at
+        end-of-generate offline, and every heartbeat online)."""
+        for stage in self.stages:
+            for s in stage.request_stats:
+                self.metrics.record_stage_request(s)
+            stage.request_stats.clear()
 
     def shutdown(self) -> None:
         """Stop process-disaggregated stage workers (no-op for in-proc

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import time
+from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
@@ -78,11 +79,18 @@ class OrchestratorAggregator:
     ``{prefix}.e2e.stats.jsonl`` (reference: the per-stage ``*.stats.jsonl``
     files of metrics/stats.py:115, wired at omni.py:692-697)."""
 
-    def __init__(self, num_stages: int, stats_path: Optional[str] = None):
+    def __init__(self, num_stages: int, stats_path: Optional[str] = None,
+                 window: int = 4096):
         self.stages = {i: StageStats(stage_id=i) for i in range(num_stages)}
         self.edges: dict[tuple[int, int], TransferEdgeStats] = {}
+        # in-flight only: finished entries are EVICTED (a long-running
+        # server harvests stats every heartbeat — unbounded history would
+        # grow memory forever and make summary() sort a lifetime of
+        # latencies on the engine thread)
         self.requests: dict[str, RequestE2EStats] = {}
-        self.per_request: list[StageRequestStats] = []
+        self.per_request: deque = deque(maxlen=window)
+        self._recent_e2e_ms: deque = deque(maxlen=window)
+        self.num_finished = 0
         self._stats_path = stats_path
 
     def _append_jsonl(self, suffix: str, record: dict) -> None:
@@ -96,16 +104,19 @@ class OrchestratorAggregator:
         )
 
     def record_finish(self, request_id: str) -> None:
-        if request_id in self.requests:
-            r = self.requests[request_id]
-            r.finish_ts = time.time()
-            if self._stats_path:
-                self._append_jsonl("e2e", {
-                    "request_id": r.request_id,
-                    "arrival_ts": r.arrival_ts,
-                    "finish_ts": r.finish_ts,
-                    "e2e_ms": round(r.e2e_ms, 3),
-                })
+        r = self.requests.pop(request_id, None)
+        if r is None:
+            return
+        r.finish_ts = time.time()
+        self.num_finished += 1
+        self._recent_e2e_ms.append(r.e2e_ms)
+        if self._stats_path:
+            self._append_jsonl("e2e", {
+                "request_id": r.request_id,
+                "arrival_ts": r.arrival_ts,
+                "finish_ts": r.finish_ts,
+                "e2e_ms": round(r.e2e_ms, 3),
+            })
 
     def record_stage_request(self, s: StageRequestStats) -> None:
         self.per_request.append(s)
@@ -129,8 +140,7 @@ class OrchestratorAggregator:
 
     # ------------------------------------------------------------- summary
     def summary(self) -> dict:
-        finished = [r for r in self.requests.values() if r.finish_ts]
-        e2e = sorted(r.e2e_ms for r in finished)
+        e2e = list(self._recent_e2e_ms)
 
         def pct(p):
             return nearest_rank_pct(e2e, p)
@@ -154,7 +164,9 @@ class OrchestratorAggregator:
                 for k, e in self.edges.items()
             },
             "e2e": {
-                "num_finished": len(e2e),
+                "num_finished": self.num_finished,
+                # percentiles over the recent window, not lifetime
+                "window": len(e2e),
                 "p50_ms": round(pct(0.50), 2),
                 "p90_ms": round(pct(0.90), 2),
                 "p99_ms": round(pct(0.99), 2),

@@ -17,8 +17,6 @@ Two more reference parallelism strategies (SURVEY §2.11):
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import shard_map
