@@ -139,3 +139,65 @@ def test_stage_level_kv_reuse():
     without, no_inject = chain(False)
     assert with_kv == without
     assert injected and not no_inject  # KV really flowed + landed
+
+
+# ---------------------------------------------------------- native shm ring
+def test_native_shm_ring_roundtrip_and_wraparound():
+    import os
+
+    from vllm_omni_tpu.native import ShmRing, native_available
+
+    assert native_available()
+    name = f"/omni_rt_{os.getpid()}"
+    a = ShmRing(name, capacity=1 << 12, owner=True)
+    b = ShmRing(name, owner=False)
+    try:
+        # many frames larger than capacity/2 force wraparound + skip
+        for i in range(64):
+            payload = bytes([i % 256]) * 1500
+            a.push(payload)
+            assert b.pop() == payload
+        # interleaved frames
+        a.push(b"x")
+        a.push(b"y" * 100)
+        assert b.pop() == b"x"
+        assert b.pop() == b"y" * 100
+        assert b.pop(timeout=0.05) is None
+        # oversized frame rejected loudly
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            a.push(b"z" * (1 << 13))
+    finally:
+        b.close()
+        a.close()
+
+
+@pytest.mark.slow
+def test_proc_stage_over_shm_transport():
+    """The native ring transport carries the full stage protocol (ready
+    handshake, submit, outputs) and matches the in-proc result."""
+    from vllm_omni_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("no native toolchain")
+    cfg = _llm_stage(0, final=True, sources=[-1], process=True)
+    cfg.runtime.transport = "shm"
+    inproc = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    want = inproc.generate([[1, 2, 3]])[0].outputs[0].token_ids
+
+    stage = ProcStage(cfg, device_env=_CPU_ENV)
+    try:
+        assert stage._chan.__class__.__name__ == "_ShmChannel"
+        stage.submit([StageRequest(request_id="r",
+                                   prompt_token_ids=[1, 2, 3],
+                                   sampling_params={"temperature": 0.0,
+                                                    "max_tokens": 4})])
+        outs = []
+        deadline = time.monotonic() + 180
+        while stage.has_unfinished and time.monotonic() < deadline:
+            outs.extend(stage.poll())
+            time.sleep(0.01)
+        assert outs and outs[0].outputs[0].token_ids == want
+    finally:
+        stage.shutdown()

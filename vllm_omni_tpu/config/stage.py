@@ -58,6 +58,10 @@ class StageRuntime:
     # one process, so sibling stages pin JAX_PLATFORMS/TPU_VISIBLE_CHIPS)
     process: bool = False
     device_env: dict = field(default_factory=dict)
+    # orchestrator<->worker message transport for process stages:
+    # "tcp" (default; also cross-host) | "shm" (native C++ shared-memory
+    # rings, same-host — vllm_omni_tpu/native/shm_ring.cpp)
+    transport: str = "tcp"
 
 
 @dataclass

@@ -203,11 +203,12 @@ class AsyncOmni:
                 if stage.config.final_output:
                     for o in outs:
                         o.final_output_type = stage.config.final_output_type
-                        omni.metrics.record_finish(o.request_id)
                         self._emit(o.request_id, o)
                         seen = self._finals_seen.get(o.request_id, 0) + 1
                         self._finals_seen[o.request_id] = seen
                         if seen >= self._n_finals:
+                            # E2E spans through the LAST final output
+                            omni.metrics.record_finish(o.request_id)
                             self._emit(o.request_id, _SENTINEL)
                 try:
                     omni._forward(stage, outs)

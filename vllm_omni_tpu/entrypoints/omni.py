@@ -148,6 +148,8 @@ class Omni:
             self.metrics.record_arrival(rid)
 
         expected = {r.request_id for r in seed}
+        n_finals = max(1, sum(1 for s in self.stages
+                              if s.config.final_output))
         entry = [s for s in self.stages if -1 in s.config.engine_input_source]
         (entry[0] if entry else self.stages[0]).submit(seed)
 
@@ -173,7 +175,11 @@ class Omni:
                     for o in outs:
                         o.final_output_type = stage.config.final_output_type
                         finals.setdefault(o.request_id, []).append(o)
-                        self.metrics.record_finish(o.request_id)
+                        # E2E spans through the LAST final stage (the
+                        # aggregator evicts on finish, so an early call
+                        # would freeze e2e at the first final output)
+                        if len(finals[o.request_id]) >= n_finals:
+                            self.metrics.record_finish(o.request_id)
                 if outs:
                     self._forward(stage, outs)
         self.harvest_stage_stats()

@@ -46,8 +46,86 @@ def _recv_msg(sock: socket.socket) -> Optional[dict]:
     return None if frame is None else OmniSerializer.loads(frame)
 
 
+class _SockChannel:
+    """Framed-message channel over a connected TCP socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+
+    def send(self, msg: dict) -> None:
+        _send_msg(self._sock, msg)
+
+    def recv(self) -> Optional[dict]:
+        """Blocks; None means the peer hung up."""
+        return _recv_msg(self._sock)
+
+    def settimeout(self, t) -> None:
+        self._sock.settimeout(t)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _ShmChannel:
+    """Framed-message channel over a pair of native shared-memory rings
+    (vllm_omni_tpu.native.ShmRing — the C++ SPSC ring buffer, the
+    reference's C-backed shm MessageQueue analogue).  Same-host only;
+    lower latency than the socket for large tensor payloads (no kernel
+    copy per byte stream)."""
+
+    def __init__(self, tx, rx):
+        self._tx = tx
+        self._rx = rx
+        self._timeout = None
+
+    def send(self, msg: dict) -> None:
+        self._tx.push(OmniSerializer.dumps(msg), timeout=60.0)
+
+    def recv(self) -> Optional[dict]:
+        # socket semantics: block until a message or the channel closes;
+        # bounded waits keep the thread interruptible
+        while True:
+            if self._rx is None:
+                return None
+            t = self._timeout if self._timeout is not None else 1.0
+            frame = self._rx.pop(timeout=t)
+            if frame is not None:
+                return OmniSerializer.loads(frame)
+            if self._timeout is not None:
+                raise socket.timeout("shm channel recv timed out")
+
+    def settimeout(self, t) -> None:
+        self._timeout = t
+
+    def close(self) -> None:
+        tx, rx, self._tx, self._rx = self._tx, self._rx, None, None
+        for ring in (tx, rx):
+            if ring is not None:
+                ring.close()
+
+
+def _worker_channel(conn_info) -> "_SockChannel | _ShmChannel":
+    """Child side of the orchestrator<->worker channel."""
+    kind = conn_info[0]
+    if kind == "tcp":
+        sock = socket.create_connection(conn_info[1], timeout=60.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return _SockChannel(sock)
+    if kind == "shm":
+        from vllm_omni_tpu.native import ShmRing
+
+        _, c2p, p2c, capacity = conn_info
+        # child owns nothing; rings were created by the orchestrator
+        return _ShmChannel(tx=ShmRing(c2p, owner=False),
+                           rx=ShmRing(p2c, owner=False))
+    raise ValueError(f"unknown transport {kind!r}")
+
+
 # --------------------------------------------------------------- worker side
-def _stage_worker_main(config: StageConfig, addr: tuple,
+def _stage_worker_main(config: StageConfig, conn_info: tuple,
                        device_env: Optional[dict]) -> None:
     """Child-process entry: env scoping → engine build → ready handshake →
     serve submit/abort/shutdown (reference: _stage_worker,
@@ -57,23 +135,22 @@ def _stage_worker_main(config: StageConfig, addr: tuple,
     for k, v in (device_env or {}).items():
         os.environ[k] = str(v)
 
-    sock = socket.create_connection(addr, timeout=60.0)
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    chan = _worker_channel(conn_info)
     try:
         stage = OmniStage(config)
     except Exception as e:  # surface build failures to the orchestrator
-        _send_msg(sock, {"type": "fatal",
-                         "error": f"{type(e).__name__}: {e}"})
-        sock.close()
+        chan.send({"type": "fatal",
+                   "error": f"{type(e).__name__}: {e}"})
+        chan.close()
         raise
-    _send_msg(sock, {"type": "stage_ready", "stage_id": config.stage_id})
+    chan.send({"type": "stage_ready", "stage_id": config.stage_id})
 
     inbox: queue.Queue = queue.Queue()
 
     def reader() -> None:
         try:
             while True:
-                msg = _recv_msg(sock)
+                msg = chan.recv()
                 if msg is None:
                     break
                 inbox.put(msg)
@@ -83,8 +160,15 @@ def _stage_worker_main(config: StageConfig, addr: tuple,
 
     threading.Thread(target=reader, daemon=True).start()
 
+    parent = os.getppid()
     running = True
     while running:
+        if os.getppid() != parent:
+            # orchestrator died (shm rings carry no EOF the way a socket
+            # does) — exit instead of holding the chip forever
+            logger.warning("stage %d: orchestrator gone; shutting down",
+                           config.stage_id)
+            break
         # drain commands; block briefly when idle so the loop doesn't spin
         block = not stage.has_unfinished
         while True:
@@ -106,7 +190,7 @@ def _stage_worker_main(config: StageConfig, addr: tuple,
                 # ack AFTER jax flushed the trace: the orchestrator's
                 # stop_profile blocks on this so callers can read the
                 # trace dir (or shut down) without losing the profile
-                _send_msg(sock, {"type": "profile_stopped"})
+                chan.send({"type": "profile_stopped"})
             elif t == "shutdown":
                 running = False
             else:
@@ -118,13 +202,24 @@ def _stage_worker_main(config: StageConfig, addr: tuple,
             try:
                 outs = stage.poll()
             except Exception as e:
-                _send_msg(sock, {"type": "fatal",
-                                 "error": f"{type(e).__name__}: {e}"})
+                chan.send({"type": "fatal",
+                           "error": f"{type(e).__name__}: {e}"})
                 raise
             if outs:
-                _send_msg(sock, {"type": "outputs", "outputs": outs})
-    _send_msg(sock, {"type": "bye"})
-    sock.close()
+                try:
+                    chan.send({"type": "outputs", "outputs": outs})
+                except ValueError as e:
+                    # frame exceeded the shm ring admission limit: tell
+                    # the orchestrator with a (small) fatal message
+                    chan.send({"type": "fatal",
+                               "error": f"outputs too large for shm "
+                                        f"transport: {e}"})
+                    raise
+    try:
+        chan.send({"type": "bye"})
+    except (ConnectionError, OSError, ValueError):
+        pass
+    chan.close()
 
 
 # --------------------------------------------------------- orchestrator side
@@ -158,48 +253,94 @@ class ProcStage(OmniStage):
         self._send_lock = threading.Lock()
         self._profile_ack = threading.Event()
 
-        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind(("127.0.0.1", 0))
-        listener.listen(1)
-        ctx = mp.get_context("spawn")
-        self._proc = ctx.Process(
-            target=_stage_worker_main,
-            args=(config, listener.getsockname(), device_env),
-            daemon=True,
-        )
-        self._proc.start()
-        listener.settimeout(ready_timeout)
-        try:
-            self._sock, _ = listener.accept()
-        except socket.timeout:
-            self._proc.terminate()
-            raise TimeoutError(
-                f"stage {self.stage_id}: worker process did not connect "
-                f"within {ready_timeout}s — check the child's device_env "
-                "and engine_args (reference: stage-ready watchdog, "
-                "omni.py:352-396)"
-            ) from None
-        finally:
-            listener.close()
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # ready handshake: first message must be stage_ready
-        self._sock.settimeout(ready_timeout)
-        msg = _recv_msg(self._sock)
+        # transport: TCP socket (default — also works cross-host) or the
+        # native shared-memory ring pair (same-host, C++ SPSC rings;
+        # reference's C-backed shm MessageQueue analogue)
+        transport = getattr(config.runtime, "transport", "tcp")
+        if transport == "shm":
+            from vllm_omni_tpu.native import ShmRing, native_available
+
+            if not native_available():
+                logger.warning(
+                    "stage %d: native shm rings unavailable; "
+                    "falling back to tcp", self.stage_id,
+                )
+                transport = "tcp"
+        if transport == "shm":
+            import uuid
+
+            tag = uuid.uuid4().hex[:12]
+            c2p_name = f"/omni_{tag}_c2p"
+            p2c_name = f"/omni_{tag}_p2c"
+            capacity = 1 << 24
+            # orchestrator owns both rings (unlinked on close)
+            rx = ShmRing(c2p_name, capacity=capacity, owner=True)
+            tx = ShmRing(p2c_name, capacity=capacity, owner=True)
+            conn_info = ("shm", c2p_name, p2c_name, capacity)
+            ctx = mp.get_context("spawn")
+            self._proc = ctx.Process(
+                target=_stage_worker_main,
+                args=(config, conn_info, device_env),
+                daemon=True,
+            )
+            self._proc.start()
+            self._chan = _ShmChannel(tx=tx, rx=rx)
+        elif transport == "tcp":
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(("127.0.0.1", 0))
+            listener.listen(1)
+            ctx = mp.get_context("spawn")
+            self._proc = ctx.Process(
+                target=_stage_worker_main,
+                args=(config, ("tcp", listener.getsockname()), device_env),
+                daemon=True,
+            )
+            self._proc.start()
+            listener.settimeout(ready_timeout)
+            try:
+                sock, _ = listener.accept()
+            except socket.timeout:
+                self._proc.terminate()
+                raise TimeoutError(
+                    f"stage {self.stage_id}: worker process did not "
+                    f"connect within {ready_timeout}s — check the child's "
+                    "device_env and engine_args (reference: stage-ready "
+                    "watchdog, omni.py:352-396)"
+                ) from None
+            finally:
+                listener.close()
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._chan = _SockChannel(sock)
+        else:
+            raise ValueError(f"unknown stage transport {transport!r}")
+        # ready handshake: first message must be stage_ready; sliced
+        # waits so a worker that dies mid-build fails fast on BOTH
+        # transports (shm rings have no EOF)
+        msg = None
+        deadline = time.monotonic() + ready_timeout
+        while time.monotonic() < deadline:
+            self._chan.settimeout(2.0)
+            try:
+                msg = self._chan.recv()
+                break
+            except socket.timeout:
+                if not self._proc.is_alive():
+                    break
         if msg is None or msg.get("type") != "stage_ready":
-            err = (msg or {}).get("error", "worker hung up")
+            err = (msg or {}).get("error", "worker hung up or timed out")
             self._proc.terminate()
             raise RuntimeError(
                 f"stage {self.stage_id}: worker failed to become ready: "
                 f"{err}"
             )
-        self._sock.settimeout(None)
+        self._chan.settimeout(None)
         threading.Thread(target=self._reader, daemon=True).start()
 
     def _reader(self) -> None:
         try:
             while True:
-                msg = _recv_msg(self._sock)
+                msg = self._chan.recv()
                 if msg is None:
                     break
                 if msg.get("type") == "profile_stopped":
@@ -220,9 +361,8 @@ class ProcStage(OmniStage):
         if self._fatal is None:
             try:
                 with self._send_lock:
-                    _send_msg(self._sock,
-                              {"type": "submit", "requests": reqs})
-            except (ConnectionError, OSError) as e:
+                    self._chan.send({"type": "submit", "requests": reqs})
+            except (ConnectionError, OSError, ValueError) as e:
                 # worker died between batches: the next poll() converts
                 # the whole in-flight set to per-request error outputs —
                 # never abort batch-mates on healthy stages by raising
@@ -278,8 +418,8 @@ class ProcStage(OmniStage):
             return
         try:
             with self._send_lock:
-                _send_msg(self._sock, {"type": "profile_start",
-                                       "trace_dir": trace_dir})
+                self._chan.send({"type": "profile_start",
+                                 "trace_dir": trace_dir})
         except (ConnectionError, OSError) as e:
             self._fatal = f"profile_start failed: {e}"
 
@@ -293,7 +433,7 @@ class ProcStage(OmniStage):
         self._profile_ack.clear()
         try:
             with self._send_lock:
-                _send_msg(self._sock, {"type": "profile_stop"})
+                self._chan.send({"type": "profile_stop"})
         except (ConnectionError, OSError) as e:
             self._fatal = f"profile_stop failed: {e}"
             return
@@ -314,17 +454,14 @@ class ProcStage(OmniStage):
     def shutdown(self, timeout: float = 10.0) -> None:
         try:
             with self._send_lock:
-                _send_msg(self._sock, {"type": "shutdown"})
+                self._chan.send({"type": "shutdown"})
         except (ConnectionError, OSError):
             pass
         self._proc.join(timeout)
         if self._proc.is_alive():
             self._proc.terminate()
             self._proc.join(5.0)
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._chan.close()
 
     def __del__(self) -> None:
         try:

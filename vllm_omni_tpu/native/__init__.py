@@ -1,0 +1,163 @@
+"""Native (C++) runtime components.
+
+The reference's runtime leans on native pieces via its dependencies (the
+C-backed shm MessageQueue ring buffer, NCCL, CUDA allocators — SURVEY
+§2.10); the TPU build keeps the compute path in XLA/Pallas and implements
+the *runtime* native pieces here.  Today: ``shm_ring`` — a POSIX
+shared-memory SPSC ring buffer (shm_ring.cpp) bound through ctypes (no
+pybind11 in the image), compiled on first use with g++ and cached next to
+the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "shm_ring.cpp")
+_SO = os.path.join(_HERE, "_shm_ring.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> str:
+    """Compile the ring buffer if the cached .so is missing or stale."""
+    if (os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    logger.info("building native shm_ring: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _SO)  # atomic: concurrent builders race safely
+    return _SO
+
+
+def load_shm_ring() -> ctypes.CDLL:
+    """Load (building if needed) the native library; raises on toolchain
+    failure — callers fall back to the pure-Python transport."""
+    global _lib
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build(), use_errno=True)
+            lib.shm_ring_open.restype = ctypes.c_void_p
+            lib.shm_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                          ctypes.c_int]
+            lib.shm_ring_capacity.restype = ctypes.c_uint64
+            lib.shm_ring_capacity.argtypes = [ctypes.c_void_p]
+            lib.shm_ring_push.restype = ctypes.c_int
+            lib.shm_ring_push.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_char_p,
+                                          ctypes.c_uint64, ctypes.c_int64]
+            lib.shm_ring_next_len.restype = ctypes.c_int64
+            lib.shm_ring_next_len.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
+            lib.shm_ring_pop.restype = ctypes.c_int64
+            lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_uint64, ctypes.c_int64]
+            lib.shm_ring_close.restype = None
+            lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+            _lib = lib
+    return _lib
+
+
+class ShmRing:
+    """One direction of a shared-memory frame channel (SPSC).
+
+    Thread-safety contract: native waits run in short slices under an
+    operation lock so ``close()`` (munmap + unlink) can never pull the
+    mapping out from under a blocked push/pop in another thread — the
+    exact use-after-unmap a socket's close/recv race doesn't have.
+    """
+
+    _SLICE_MS = 100
+
+    def __init__(self, name: str, capacity: int = 1 << 22,
+                 owner: bool = True):
+        import threading
+
+        self._lib = load_shm_ring()
+        self._h = self._lib.shm_ring_open(
+            name.encode(), capacity, 1 if owner else 0)
+        if not self._h:
+            raise OSError(
+                f"shm_ring_open({name!r}, owner={owner}) failed "
+                f"(errno hint: {ctypes.get_errno()})"
+            )
+        self.name = name
+        self.owner = owner
+        self._op_lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return int(self._lib.shm_ring_capacity(self._h))
+
+    def _deadline_slices(self, timeout: float):
+        import time
+
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            rem_ms = int((deadline - time.monotonic()) * 1000)
+            if rem_ms <= 0:
+                return
+            yield min(rem_ms, self._SLICE_MS)
+
+    def push(self, data: bytes, timeout: float = 30.0) -> None:
+        for slice_ms in self._deadline_slices(max(timeout, 1e-3)):
+            with self._op_lock:
+                if self._h is None:
+                    raise OSError(f"shm ring {self.name} is closed")
+                rc = self._lib.shm_ring_push(
+                    self._h, data, len(data), slice_ms)
+            if rc == 0:
+                return
+            if rc == -2:
+                raise ValueError(
+                    f"frame of {len(data)} bytes exceeds ring capacity "
+                    f"{self.capacity}"
+                )
+        raise TimeoutError(f"shm ring {self.name}: push timed out")
+
+    def pop(self, timeout: float = 30.0) -> Optional[bytes]:
+        """Next frame, or None on timeout/closed."""
+        for slice_ms in self._deadline_slices(max(timeout, 1e-3)):
+            with self._op_lock:
+                if self._h is None:
+                    return None
+                n = self._lib.shm_ring_next_len(self._h, slice_ms)
+                if n >= 0:
+                    buf = ctypes.create_string_buffer(int(n))
+                    got = self._lib.shm_ring_pop(self._h, buf, int(n), 0)
+                    if got < 0:
+                        return None
+                    return buf.raw[: int(got)]
+        return None
+
+    def close(self) -> None:
+        with self._op_lock:
+            if self._h:
+                self._lib.shm_ring_close(self._h)
+                self._h = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_available() -> bool:
+    try:
+        load_shm_ring()
+        return True
+    except (subprocess.CalledProcessError, OSError) as e:
+        logger.warning("native shm_ring unavailable: %s", e)
+        return False

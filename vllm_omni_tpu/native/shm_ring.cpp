@@ -1,0 +1,227 @@
+// Shared-memory SPSC ring buffer — the native IPC transport.
+//
+// Role of the reference's C-backed shm MessageQueue (reference: vLLM's
+// ring-buffer MessageQueue consumed at diffusion/executor/
+// multiproc_executor.py:57,334 and diffusion_worker.py:334; SURVEY §2.10
+// row "shm MessageQueue"): a lock-free single-producer single-consumer
+// byte-frame ring over POSIX shared memory, used for same-host
+// orchestrator <-> stage-worker messaging where the TCP socket's
+// copy + syscall overhead matters.
+//
+// Layout (all offsets in one shm segment):
+//   [Header | data bytes ...]
+// Header: capacity, head (write cursor), tail (read cursor) — head/tail
+// are monotonically increasing uint64s (mod capacity for position), with
+// C++11 atomics for cross-process visibility (shm is coherent memory).
+// Frames: u32 length | payload, contiguous; a frame never wraps — if it
+// would, the producer writes a SKIP marker (length 0xFFFFFFFF) and starts
+// at offset 0.
+//
+// Exposed as a tiny C ABI for ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kSkip = 0xFFFFFFFFu;
+constexpr uint64_t kMagic = 0x4f4d4e49524e4731ull;  // "OMNIRNG1"
+
+struct Header {
+  std::atomic<uint64_t> magic;
+  uint64_t capacity;  // data bytes
+  std::atomic<uint64_t> head;  // producer cursor (monotonic)
+  std::atomic<uint64_t> tail;  // consumer cursor (monotonic)
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_len;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+}
+
+void backoff(int attempt) {
+  // escalate: stay responsive for bursts, stop burning CPU when idle
+  long ns = attempt < 20 ? 50000 : (attempt < 200 ? 500000 : 2000000);
+  timespec ts{0, ns};
+  nanosleep(&ts, nullptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0) a ring named `name` with `capacity`
+// data bytes. Returns an opaque handle or null.
+void* shm_ring_open(const char* name, uint64_t capacity, int owner) {
+  int flags = owner ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  size_t len = sizeof(Header) + capacity;
+  if (owner && ftruncate(fd, (off_t)len) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  if (!owner) {
+    // attach: capacity comes from the segment itself
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+      close(fd);
+      return nullptr;
+    }
+    len = (size_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    if (owner) shm_unlink(name);
+    return nullptr;
+  }
+  Ring* r = new Ring;
+  r->hdr = reinterpret_cast<Header*>(mem);
+  r->data = reinterpret_cast<uint8_t*>(mem) + sizeof(Header);
+  r->map_len = len;
+  r->fd = fd;
+  r->owner = owner != 0;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  r->name[sizeof(r->name) - 1] = '\0';
+  if (owner) {
+    r->hdr->capacity = capacity;
+    r->hdr->head.store(0, std::memory_order_relaxed);
+    r->hdr->tail.store(0, std::memory_order_relaxed);
+    r->hdr->magic.store(kMagic, std::memory_order_release);
+  } else {
+    // wait (bounded) for the owner's initialization
+    uint64_t deadline = now_ns() + 5000000000ull;
+    int attempt = 0;
+    while (r->hdr->magic.load(std::memory_order_acquire) != kMagic) {
+      if (now_ns() > deadline) {
+        munmap(mem, len);
+        close(fd);
+        delete r;
+        return nullptr;
+      }
+      backoff(attempt++);
+    }
+  }
+  return r;
+}
+
+uint64_t shm_ring_capacity(void* h) {
+  return reinterpret_cast<Ring*>(h)->hdr->capacity;
+}
+
+// Push one frame; blocks up to timeout_ms for space. Returns 0 on success,
+// -1 timeout, -2 frame too large.
+int shm_ring_push(void* h, const uint8_t* buf, uint64_t n,
+                  int64_t timeout_ms) {
+  Ring* r = reinterpret_cast<Ring*>(h);
+  const uint64_t cap = r->hdr->capacity;
+  const uint64_t need = 4 + n;
+  // worst case a skip marker wastes up to need-1 bytes before the frame,
+  // so only frames with 2*need - 1 <= cap are pushable from EVERY cursor
+  // position — admit exactly those (a larger frame could wedge forever
+  // depending on where head happens to sit)
+  if (2 * need - 1 > cap) return -2;
+  uint64_t deadline = now_ns() + uint64_t(timeout_ms) * 1000000ull;
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  int attempt = 0;
+  for (;;) {
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    uint64_t pos = head % cap;
+    uint64_t contiguous = cap - pos;
+    // a frame never wraps: account for the skip marker if needed
+    uint64_t total = (contiguous >= need) ? need : contiguous + need;
+    if (head + total - tail <= cap) {
+      if (contiguous < need) {
+        if (contiguous >= 4) {
+          uint32_t skip = kSkip;
+          memcpy(r->data + pos, &skip, 4);
+        }
+        head += contiguous;
+        pos = 0;
+      }
+      uint32_t len32 = (uint32_t)n;
+      memcpy(r->data + pos, &len32, 4);
+      memcpy(r->data + pos + 4, buf, n);
+      r->hdr->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && now_ns() > deadline) return -1;
+    backoff(attempt++);
+  }
+}
+
+// Peek next frame length without consuming; -1 if empty after timeout.
+int64_t shm_ring_next_len(void* h, int64_t timeout_ms) {
+  Ring* r = reinterpret_cast<Ring*>(h);
+  const uint64_t cap = r->hdr->capacity;
+  uint64_t deadline = now_ns() + uint64_t(timeout_ms) * 1000000ull;
+  int attempt = 0;
+  for (;;) {
+    uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint64_t pos = tail % cap;
+      uint64_t contiguous = cap - pos;
+      if (contiguous < 4) {
+        // implicit skip (not even room for a marker)
+        r->hdr->tail.store(tail + contiguous, std::memory_order_release);
+        continue;
+      }
+      uint32_t len32;
+      memcpy(&len32, r->data + pos, 4);
+      if (len32 == kSkip) {
+        r->hdr->tail.store(tail + contiguous, std::memory_order_release);
+        continue;
+      }
+      return (int64_t)len32;
+    }
+    if (timeout_ms >= 0 && now_ns() > deadline) return -1;
+    backoff(attempt++);
+  }
+}
+
+// Pop next frame into buf (size bufcap). Returns payload length, -1 empty
+// after timeout, -3 buffer too small (frame left in place).
+int64_t shm_ring_pop(void* h, uint8_t* buf, uint64_t bufcap,
+                     int64_t timeout_ms) {
+  Ring* r = reinterpret_cast<Ring*>(h);
+  int64_t n = shm_ring_next_len(h, timeout_ms);
+  if (n < 0) return n;
+  if ((uint64_t)n > bufcap) return -3;
+  const uint64_t cap = r->hdr->capacity;
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  uint64_t pos = tail % cap;
+  memcpy(buf, r->data + pos + 4, (size_t)n);
+  r->hdr->tail.store(tail + 4 + (uint64_t)n, std::memory_order_release);
+  return n;
+}
+
+void shm_ring_close(void* h) {
+  Ring* r = reinterpret_cast<Ring*>(h);
+  munmap(r->hdr, r->map_len);
+  close(r->fd);
+  if (r->owner) shm_unlink(r->name);
+  delete r;
+}
+
+}  // extern "C"
