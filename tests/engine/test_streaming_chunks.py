@@ -1,0 +1,288 @@
+"""Streaming (async_chunk) prompt intake: a request's prompt grows while
+upstream still generates, prefilling chunk-by-chunk and sampling only
+after the final chunk (VERDICT r1 row 59; reference:
+transfer_adapter/chunk_transfer_adapter.py:19 + WAITING_FOR_CHUNK)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+def _mk(params, cfg, **over):
+    base = dict(num_pages=64, page_size=4, max_model_len=128,
+                max_num_seqs=4, dtype=jnp.float32, seed=0)
+    base.update(over)
+    return LLMEngine(params, cfg, EngineConfig(**base))
+
+
+def _drain(eng):
+    outs = []
+    while eng.has_unfinished_requests:
+        outs.extend(eng.step())
+    return outs
+
+
+def test_streamed_prompt_token_identical_to_one_shot():
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    prompt = list(np.random.default_rng(0).integers(1, 100, size=23))
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    want = _mk(params, cfg).generate([prompt], sp)[0].outputs[0].token_ids
+
+    eng = _mk(params, cfg)
+    eng.add_request(prompt[:5], sp, request_id="s", awaiting_chunks=True)
+    # interleave chunk arrival with engine steps (prefill runs as chunks
+    # arrive — the downstream engine does NOT wait for the full prompt)
+    chunks = [prompt[5:11], prompt[11:18], prompt[18:]]
+    outs = []
+    for i, ch in enumerate(chunks):
+        outs.extend(eng.step())  # compute what has arrived so far
+        eng.append_prompt_chunk("s", ch, final=(i == len(chunks) - 1))
+    outs.extend(_drain(eng))
+    assert [o for o in outs if o.finished]
+    got = [o for o in outs if o.finished][0].outputs[0].token_ids
+    assert got == want
+    # and early chunks were really prefilled before the final arrived
+    # (num_computed advanced between appends) — implied by token parity +
+    # the steps interleaved above
+
+
+def test_streamed_prompt_samples_only_after_final():
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(1), cfg, jnp.float32)
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    eng = _mk(params, cfg)
+    eng.add_request([1, 2, 3], sp, request_id="s", awaiting_chunks=True)
+    for _ in range(5):
+        outs = eng.step()
+        assert not outs  # nothing may finish or sample while awaiting
+    req = eng.scheduler.running[0]
+    assert req.num_computed_tokens == 3  # arrived tokens were prefilled
+    assert req.output_token_ids == []
+    eng.append_prompt_chunk("s", [4, 5], final=True)
+    outs = _drain(eng)
+    assert outs and outs[0].finished
+    assert len(outs[0].outputs[0].token_ids) == 4
+
+
+def test_streamed_embeds_chunks():
+    """Talker-style streaming: upstream hidden states arrive in chunks as
+    prompt_embeds and match the one-shot handoff."""
+    from vllm_omni_tpu.models.qwen3_omni import talker
+
+    params, cfg, _ = talker.tiny_factory()
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+    hidden = np.asarray(jax.random.normal(
+        jax.random.PRNGKey(3), (12, 64)), np.float32)
+    toks = [0] * 12
+
+    def run_oneshot():
+        eng = _mk(params, cfg)
+        eng.add_request(toks, sp, request_id="o", prompt_embeds=hidden)
+        return _drain(eng)[0].outputs[0].token_ids
+
+    def run_streamed():
+        eng = _mk(params, cfg)
+        eng.add_request(toks[:4], sp, request_id="s",
+                        prompt_embeds=hidden[:4], awaiting_chunks=True)
+        eng.step()
+        eng.append_prompt_chunk("s", toks[4:9], prompt_embeds=hidden[4:9])
+        eng.step()
+        eng.append_prompt_chunk("s", toks[9:], prompt_embeds=hidden[9:],
+                                final=True)
+        return _drain(eng)[0].outputs[0].token_ids
+
+    assert run_streamed() == run_oneshot()
+
+
+def test_streamed_chunk_overflow_error_finishes():
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg, jnp.float32)
+    eng = _mk(params, cfg, max_model_len=32)
+    eng.add_request([1, 2], SamplingParams(max_tokens=2),
+                    request_id="s", awaiting_chunks=True)
+    eng.step()
+    eng.append_prompt_chunk("s", list(range(1, 40)), final=True)
+    outs = _drain(eng)
+    assert outs and outs[0].is_error
+    assert "exceeding" in outs[0].error_message
+
+
+def test_append_guards():
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg, jnp.float32)
+    eng = _mk(params, cfg)
+    with pytest.raises(KeyError):
+        eng.append_prompt_chunk("nope", [1])
+    eng.add_request([1, 2, 3], SamplingParams(max_tokens=2),
+                    request_id="plain")
+    with pytest.raises(ValueError, match="not a streaming"):
+        eng.append_prompt_chunk("plain", [4])
+
+
+def test_streaming_cross_engine_handoff():
+    """The async_chunk use: engine B (talker-style) starts prefilling
+    thinker hidden states while engine A is still generating, matching the
+    batch (wait-for-everything) handoff token-for-token."""
+    from vllm_omni_tpu.models.qwen3_omni import talker, thinker
+
+    a_params, a_cfg, _ = thinker.tiny_factory()
+    b_params, b_cfg, _ = talker.tiny_factory()
+    prompt = [1, 9, 17, 3]
+    sp_a = SamplingParams(temperature=0.0, max_tokens=6)
+    sp_b = SamplingParams(temperature=0.0, max_tokens=5)
+
+    # batch handoff oracle
+    eng_a = LLMEngine(a_params, a_cfg, EngineConfig(
+        num_pages=64, page_size=4, max_model_len=128, dtype=jnp.float32,
+        seed=0, collect_hidden=True))
+    eng_a.add_request(prompt, sp_a, request_id="t")
+    a_outs = _drain(eng_a)
+    hidden = a_outs[0].multimodal_output["hidden_states"]
+    eng_b = _mk(b_params, b_cfg)
+    eng_b.add_request([0] * hidden.shape[0], sp_b, request_id="b",
+                      prompt_embeds=hidden)
+    want = _drain(eng_b)[0].outputs[0].token_ids
+
+    # streaming handoff: ship hidden rows to B as A produces them
+    eng_a2 = LLMEngine(a_params, a_cfg, EngineConfig(
+        num_pages=64, page_size=4, max_model_len=128, dtype=jnp.float32,
+        seed=0, collect_hidden=True))
+    eng_a2.add_request(prompt, sp_a, request_id="t")
+    eng_b2 = _mk(b_params, b_cfg)
+    started = False
+    shipped = 0
+
+    def ship(final=False):
+        nonlocal started, shipped
+        req = None
+        for r in (eng_a2.scheduler.running + eng_a2.scheduler.waiting):
+            if r.request_id == "t":
+                req = r
+        chunks = (req.additional_information.get("_hidden_chunks", [])
+                  if req is not None else [])
+        rows = (np.concatenate(chunks, axis=0)
+                if chunks else np.zeros((0, 64), np.float32))
+        new = rows[shipped:]
+        if new.shape[0] == 0 and not final:
+            return
+        if not started:
+            eng_b2.add_request([0] * new.shape[0], sp_b, request_id="b",
+                               prompt_embeds=new, awaiting_chunks=True)
+            started = True
+        else:
+            eng_b2.append_prompt_chunk("b", [0] * new.shape[0],
+                                       prompt_embeds=new, final=False)
+        shipped += new.shape[0]
+
+    final_a = []
+    while eng_a2.has_unfinished_requests:
+        final_a.extend(eng_a2.step())
+        ship()
+        if eng_b2.has_unfinished_requests:
+            eng_b2.step()  # B prefills while A still generates
+    # tail: the oracle's payload is the CONSOLIDATED hidden states of the
+    # finished request
+    tail = final_a[0].multimodal_output["hidden_states"][shipped:]
+    if tail.shape[0]:
+        eng_b2.append_prompt_chunk("b", [0] * tail.shape[0],
+                                   prompt_embeds=tail, final=True)
+    else:
+        eng_b2.append_prompt_chunk("b", [], final=True)
+    got = _drain(eng_b2)[0].outputs[0].token_ids
+    assert got == want
+
+
+def test_single_token_final_embeds_chunk():
+    """Regression: an embeds request whose LAST prompt position arrives as
+    a 1-token chunk must run it as a prefill chunk, never as a decode —
+    the decode path embeds from the token table, not the upstream hidden
+    row (this also covers chunked-prefill resumes ending 1 token short)."""
+    from vllm_omni_tpu.models.qwen3_omni import talker
+
+    params, cfg, _ = talker.tiny_factory()
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    hidden = np.asarray(jax.random.normal(
+        jax.random.PRNGKey(7), (9, 64)), np.float32)
+
+    eng = _mk(params, cfg)
+    eng.add_request([0] * 9, sp, request_id="o", prompt_embeds=hidden)
+    want = _drain(eng)[0].outputs[0].token_ids
+
+    eng2 = _mk(params, cfg)
+    eng2.add_request([0] * 8, sp, request_id="s",
+                     prompt_embeds=hidden[:8], awaiting_chunks=True)
+    eng2.step()
+    eng2.append_prompt_chunk("s", [0], prompt_embeds=hidden[8:9],
+                             final=True)
+    assert _drain(eng2)[0].outputs[0].token_ids == want
+
+
+def test_finalize_after_fully_computed_resamples():
+    """Regression: final=True with nothing left to compute must recompute
+    the last position to sample instead of deadlocking."""
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(5), cfg, jnp.float32)
+    sp = SamplingParams(temperature=0.0, max_tokens=3)
+    prompt = [5, 6, 7, 8]
+
+    want = _mk(params, cfg).generate([prompt], sp)[0].outputs[0].token_ids
+    eng = _mk(params, cfg)
+    eng.add_request(prompt, sp, request_id="s", awaiting_chunks=True)
+    for _ in range(3):
+        eng.step()  # prompt fully prefilled, sampling held
+    eng.append_prompt_chunk("s", [], final=True)
+    assert _drain(eng)[0].outputs[0].token_ids == want
+
+
+def test_finalize_empty_stream_errors_not_deadlocks():
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(6), cfg, jnp.float32)
+    eng = _mk(params, cfg)
+    eng.add_request([], SamplingParams(max_tokens=2), request_id="s",
+                    awaiting_chunks=True)
+    eng.step()
+    eng.append_prompt_chunk("s", [], final=True)
+    outs = _drain(eng)
+    assert outs and outs[0].is_error
+    assert "empty" in outs[0].error_message
+
+
+def test_mixed_mode_chunks_error_finish():
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(6), cfg, jnp.float32)
+    eng = _mk(params, cfg)
+    eng.add_request([1, 2], SamplingParams(max_tokens=2), request_id="s",
+                    awaiting_chunks=True)
+    eng.step()
+    # token-based request must reject an embeds chunk as an error output
+    eng.append_prompt_chunk(
+        "s", [3], prompt_embeds=np.zeros((1, 64), np.float32))
+    outs = _drain(eng)
+    assert outs and outs[0].is_error
+
+
+def test_parked_stream_does_not_starve_waiting_requests():
+    """An idle streaming request holding capacity must not trip the
+    starvation guard into error-finishing healthy waiting requests."""
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(7), cfg, jnp.float32)
+    eng = _mk(params, cfg, max_num_seqs=1)  # stream hogs the only seq slot
+    eng.add_request([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=2),
+                    request_id="s", awaiting_chunks=True)
+    eng.step()
+    eng.add_request([4, 5], SamplingParams(temperature=0.0, max_tokens=2),
+                    request_id="w")
+    for _ in range(10):  # far beyond the 3-tick guard
+        outs = eng.step()
+        assert not any(o.is_error for o in outs)
+    eng.append_prompt_chunk("s", [6], final=True)
+    outs = _drain(eng)
+    by_id = {o.request_id: o for o in outs}
+    assert not by_id["s"].is_error and not by_id["w"].is_error

@@ -133,14 +133,34 @@ class ARScheduler:
         self._finished_ids.add(request.request_id)
         self._errored.append(request)
 
-    def abort_request(self, request_id: str) -> None:
-        for queue in (self.waiting, self.running):
-            for req in queue:
+    def find_request(self, request_id: str):
+        """(queue, request) for an in-flight id, else (None, None)."""
+        for q in (self.waiting, self.running):
+            for req in q:
                 if req.request_id == request_id:
-                    req.status = RequestStatus.FINISHED_ABORTED
-                    queue.remove(req)
-                    self._free_request(req)
-                    return
+                    return q, req
+        return None, None
+
+    def fail_request(self, request_id: str, reason: str,
+                     kind: str = "invalid_request") -> bool:
+        """Error-finish an IN-FLIGHT request (e.g. a streamed prompt chunk
+        overflowed the limits): frees its pages and surfaces a
+        FINISHED_ERROR output on the next step."""
+        q, req = self.find_request(request_id)
+        if req is None:
+            return False
+        q.remove(req)
+        self.kv.free(req)
+        self.reject(req, reason, kind)
+        return True
+
+    def abort_request(self, request_id: str) -> None:
+        q, req = self.find_request(request_id)
+        if req is None:
+            return
+        req.status = RequestStatus.FINISHED_ABORTED
+        q.remove(req)
+        self._free_request(req)
 
     @property
     def has_unfinished(self) -> bool:
@@ -171,7 +191,24 @@ class ARScheduler:
                 still_running.append(req)
                 continue
             remaining = req.num_tokens - req.num_computed_tokens
-            if remaining > 1:
+            if remaining <= 0:
+                # streaming request fully caught up with the chunks that
+                # have arrived: idle until the next append
+                still_running.append(req)
+                continue
+            # awaiting_chunks: the would-be sampling position may still
+            # be mid-prompt (more chunks coming) — compute arrived tokens
+            # as prefill chunks, never as a sampling decode.
+            # mid-prompt embeds: the decode path embeds from the token
+            # table, so any still-in-prompt position of an embeds-based
+            # request MUST run as a prefill chunk (its input is the
+            # upstream hidden row, not token id 0) — this also covers a
+            # chunked-prefill resume whose last chunk is a single token
+            mid_prompt_embeds = (
+                req.prompt_embeds is not None
+                and req.num_computed_tokens < req.num_prompt_tokens
+            )
+            if remaining > 1 or req.awaiting_chunks or mid_prompt_embeds:
                 # mid-prefill, or a preempted request recomputing prompt +
                 # generated tokens (num_tokens, not num_prompt_tokens — a
                 # resumed request chunks through its generated suffix too
@@ -247,6 +284,14 @@ class ARScheduler:
         while self.waiting and budget > 0 and len(self.running) < self.config.max_num_seqs:
             req = self.waiting[0]
             remaining = req.num_tokens - req.num_computed_tokens
+            if remaining <= 0 and req.awaiting_chunks:
+                # streaming request admitted before its first chunk has
+                # content to compute: park it in running (idle) so it
+                # doesn't pin the waiting queue
+                self.waiting.pop(0)
+                req.status = RequestStatus.RUNNING
+                self.running.append(req)
+                continue
             if self.config.enable_chunked_prefill:
                 chunk = min(remaining, budget)
             elif remaining > budget:

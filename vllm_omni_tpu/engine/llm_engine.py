@@ -182,6 +182,102 @@ class LLMEngine:
                 "KV prefix could not be applied (chunked prefill off)",
             )
 
+    def append_prompt_chunk(
+        self,
+        request_id: str,
+        token_ids: list[int] = (),
+        prompt_embeds=None,
+        final: bool = False,
+    ) -> None:
+        """Extend a streaming request's prompt (async_chunk intake,
+        reference: OmniChunkTransferAdapter feeding WAITING_FOR_CHUNK
+        requests, transfer_adapter/chunk_transfer_adapter.py:19).
+
+        The request must have been added with ``awaiting_chunks=True``;
+        arrived tokens prefill as chunks while later ones are still being
+        produced upstream, and sampling starts only after ``final=True``.
+        Embeds-based requests append matching ``prompt_embeds`` rows.
+        """
+        queue, req = self.scheduler.find_request(request_id)
+        if req is None:
+            raise KeyError(f"no in-flight request {request_id!r}")
+        if not req.awaiting_chunks:
+            raise ValueError(
+                f"request {request_id!r} is not a streaming request "
+                "(awaiting_chunks=False)"
+            )
+        token_ids = list(token_ids)
+        # embeds/token mode is fixed by the first content chunk; mixing
+        # silently corrupts positions, so it is an error OUTPUT (the
+        # caller is usually a remote stage that cannot handle a raise)
+        embeds_based = req.prompt_embeds is not None
+        if token_ids and req.num_prompt_tokens > 0:
+            if embeds_based and prompt_embeds is None:
+                self.scheduler.fail_request(
+                    request_id,
+                    "embeds-based streaming request: every chunk must "
+                    "carry prompt_embeds rows matching its token_ids",
+                )
+                return
+            if not embeds_based and prompt_embeds is not None:
+                self.scheduler.fail_request(
+                    request_id,
+                    "token-based streaming request received an embeds "
+                    "chunk (mode is fixed by the first chunk)",
+                )
+                return
+        new_len = req.num_prompt_tokens + len(token_ids)
+        over = (new_len > self.config.max_model_len
+                or self.scheduler.kv.pages_needed(new_len)
+                > self.scheduler.kv.num_pages)
+        # a request still in WAITING is admitted whole: without chunked
+        # prefill its remainder must fit one step's budget (add_request
+        # enforces the same at intake; a grown waiting request would pin
+        # the queue head forever). RUNNING streams are exempt — the
+        # continuation branch chunks them under the budget regardless.
+        if (not over and queue is self.scheduler.waiting
+                and not self.config.enable_chunked_prefill
+                and new_len - req.num_computed_tokens
+                > self.config.max_num_batched_tokens):
+            over = True
+        if over:
+            self.scheduler.fail_request(
+                request_id,
+                f"streamed prompt grew to {new_len} tokens, exceeding "
+                "the engine limits",
+            )
+            return
+        if token_ids:
+            req.prompt_token_ids.extend(int(t) for t in token_ids)
+            if prompt_embeds is not None:
+                import numpy as np
+
+                pe = np.asarray(prompt_embeds)
+                if pe.shape[0] != len(token_ids):
+                    self.scheduler.fail_request(
+                        request_id,
+                        f"chunk embeds rows {pe.shape[0]} != chunk "
+                        f"tokens {len(token_ids)}",
+                    )
+                    return
+                req.prompt_embeds = (
+                    pe if req.prompt_embeds is None
+                    else np.concatenate([req.prompt_embeds, pe], axis=0)
+                )
+        if final:
+            req.awaiting_chunks = False
+            if req.num_tokens == 0:
+                # a stream that never produced content can neither sample
+                # nor finish; error-finish instead of wedging the engine
+                self.scheduler.fail_request(
+                    request_id, "streaming request finalized empty")
+                return
+            if req.num_computed_tokens >= req.num_tokens:
+                # every arrived token was already prefilled with sampling
+                # suppressed — the final position's logits were discarded,
+                # so recompute it (same slot, one-token chunk) to sample
+                req.num_computed_tokens = req.num_tokens - 1
+
     def add_errored_request(
         self, request_id: str, reason: str, kind: str = "invalid_request"
     ) -> str:
@@ -216,6 +312,13 @@ class LLMEngine:
         sched_out = self.scheduler.schedule()
         if sched_out.num_scheduled == 0:
             if self.scheduler.waiting:
+                if any(r.awaiting_chunks for r in self.scheduler.running):
+                    # an idle streaming request makes zero-scheduled ticks
+                    # a NORMAL long-lived state (upstream may be slow) —
+                    # the tick counter would error-finish healthy waiting
+                    # requests within milliseconds
+                    self._starved_ticks = 0
+                    return errored
                 # Transient zero-scheduled ticks happen while pages are
                 # pinned by an in-flight KV-transfer awaiting its ACK —
                 # only declare starvation after a few consecutive ticks.
@@ -238,11 +341,17 @@ class LLMEngine:
                 self.scheduler.kv.free(victim)
                 errored.append(OmniRequestOutput.from_pipeline(victim))
                 return errored
-            if self.scheduler.has_unfinished:
+            stalled = [
+                r for r in self.scheduler.running
+                if not (r.awaiting_chunks
+                        and r.num_computed_tokens >= r.num_tokens)
+            ]
+            if stalled or self.scheduler.waiting:
                 raise RuntimeError(
                     "scheduler deadlock: running requests but nothing "
                     "schedulable"
                 )
+            # only streaming requests idling for their next chunk remain
             return errored
         self._starved_ticks = 0
         run_out = self.runner.execute(

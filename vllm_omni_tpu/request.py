@@ -89,6 +89,12 @@ class Request:
     # models/qwen3_omni/qwen3_omni_moe_code_predictor_mtp.py); consumed by
     # the next decode step's verify forward
     spec_draft_tokens: list[int] = field(default_factory=list)
+    # streaming (async_chunk) intake: the prompt may still GROW via
+    # engine.append_prompt_chunk — prefill chunks run as they arrive and
+    # sampling is held until the final chunk lands (reference:
+    # WAITING_FOR_CHUNK + OmniChunkTransferAdapter,
+    # transfer_adapter/chunk_transfer_adapter.py:19)
+    awaiting_chunks: bool = False
     # hidden states destined for the next stage (pooler_output payloads,
     # reference: gpu_ar_model_runner.py:525-568)
     pooled_hidden: Optional[np.ndarray] = None

@@ -518,6 +518,7 @@ class ARModelRunner:
         sampling = [
             (i, sc) for i, sc in enumerate(scheds)
             if sc.start_pos + sc.num_new_tokens >= sc.request.num_tokens
+            and not sc.request.awaiting_chunks
         ]
         if sampling:
             # Sample the full padded batch (one compile per bucket shape);
