@@ -2,17 +2,26 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Measures the north-star bring-up config from BASELINE.md: 512px / 20-step /
-bs=1 single-device generation (reference methodology:
-benchmarks/diffusion/diffusion_benchmark_serving.py; the reference publishes
-no absolute numbers — BASELINE.json "published": {} — so vs_baseline is
-null).  Extra keys report the analytic DiT MFU (achieved bf16 FLOP/s over
-the chip's peak) and the benched architecture so the number is
-interpretable (VERDICT r1 weak #3: the metric must say what it measures).
+Default measures the NORTH-STAR config from BASELINE.md: the REAL
+Qwen-Image geometry (60-layer / 24-head / 3584 MMDiT, 20.4B params) at
+1024px / 50-step / bs=1.  41 GB of bf16 weights exceed one v5e's 16 GB
+HBM, so the run uses layerwise weight streaming
+(vllm_omni_tpu/diffusion/offload.py) — host->HBM block transfers
+overlapped with compute; the resulting number is transfer-bound and
+honest.  Weights are tiled host randoms (TPU matmul timing is
+value-independent); the geometry is real.  The reference publishes no
+absolute numbers (BASELINE.json "published": {}), so vs_baseline is null.
+Extra keys report analytic DiT MFU and the benched architecture so the
+number is interpretable.
+
+If the real-geometry run fails (e.g. insufficient host RAM), the bench
+falls back to the resident 16-layer `bench` preset and says so in the
+arch block.
 
 Env knobs: OMNI_BENCH_PX / OMNI_BENCH_STEPS / OMNI_BENCH_ITERS /
-OMNI_BENCH_SIZE (config preset) / OMNI_BENCH_SCHEDULER (euler|unipc) /
-OMNI_BENCH_CACHE=1 (TeaCache step skipping) / OMNI_BENCH_PEAK_TFLOPS.
+OMNI_BENCH_SIZE (config preset; "real" => streaming) /
+OMNI_BENCH_SCHEDULER (euler|unipc) / OMNI_BENCH_CACHE=1 (TeaCache step
+skipping) / OMNI_BENCH_PEAK_TFLOPS.
 """
 
 from __future__ import annotations
@@ -59,22 +68,9 @@ def chip_peak_tflops() -> float:
     return peak if peak > 0 else 197.0
 
 
-def main():
-    os.environ.setdefault("OMNI_TPU_LOG_LEVEL", "WARNING")
-
+def _build_engine(size: str, scheduler: str, use_cache: bool):
     from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
     from vllm_omni_tpu.diffusion.engine import DiffusionEngine
-    from vllm_omni_tpu.diffusion.request import (
-        OmniDiffusionRequest,
-        OmniDiffusionSamplingParams,
-    )
-
-    size = os.environ.get("OMNI_BENCH_SIZE", "bench")
-    height = width = int(os.environ.get("OMNI_BENCH_PX", "512"))
-    steps = int(os.environ.get("OMNI_BENCH_STEPS", "20"))
-    iters = int(os.environ.get("OMNI_BENCH_ITERS", "3"))
-    scheduler = os.environ.get("OMNI_BENCH_SCHEDULER", "")
-    use_cache = os.environ.get("OMNI_BENCH_CACHE", "") == "1"
 
     extra = {"size": size}
     if scheduler:
@@ -83,22 +79,55 @@ def main():
         model="qwen-image-bench", model_arch="QwenImagePipeline",
         dtype="bfloat16", extra=extra,
         cache_backend="teacache" if use_cache else "",
+        offload="layerwise" if size == "real" else "",
     )
-    engine = DiffusionEngine(cfg, warmup=False)
+    return DiffusionEngine(cfg, warmup=False)
 
-    sp = OmniDiffusionSamplingParams(
-        height=height, width=width, num_inference_steps=steps,
-        guidance_scale=4.0, seed=0,
+
+def main():
+    os.environ.setdefault("OMNI_TPU_LOG_LEVEL", "WARNING")
+
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
     )
 
-    def one():
-        req = OmniDiffusionRequest(prompt=["a photo of a cat"], sampling_params=sp)
+    size = os.environ.get("OMNI_BENCH_SIZE", "real")
+    default_px = "1024" if size == "real" else "512"
+    default_steps = "50" if size == "real" else "20"
+    default_iters = "1" if size == "real" else "3"
+    height = width = int(os.environ.get("OMNI_BENCH_PX", default_px))
+    steps = int(os.environ.get("OMNI_BENCH_STEPS", default_steps))
+    iters = int(os.environ.get("OMNI_BENCH_ITERS", default_iters))
+    scheduler = os.environ.get("OMNI_BENCH_SCHEDULER", "")
+    use_cache = os.environ.get("OMNI_BENCH_CACHE", "") == "1"
+
+    fallback = ""
+    try:
+        engine = _build_engine(size, scheduler, use_cache)
+    except Exception as e:  # e.g. not enough host RAM for 41 GB weights
+        if size != "real":
+            raise
+        fallback = f"real preset failed ({type(e).__name__}: {e}); "
+        size, height, width, steps, iters = "bench", 512, 512, 20, 3
+        engine = _build_engine(size, scheduler, use_cache)
+
+    def one(n_steps):
+        sp = OmniDiffusionSamplingParams(
+            height=height, width=width, num_inference_steps=n_steps,
+            guidance_scale=4.0, seed=0,
+        )
+        req = OmniDiffusionRequest(
+            prompt=["a photo of a cat"], sampling_params=sp)
         return engine.step(req)
 
-    one()  # compile warmup
+    # compile warmup: 1 step warms every executable the timed run uses
+    # (the dense path's step count is a dynamic loop bound; the streaming
+    # path compiles per-piece) without paying a full generation
+    one(1)
     t0 = time.perf_counter()
     for _ in range(iters):
-        one()
+        one(steps)
     dt = (time.perf_counter() - t0) / iters
 
     pcfg = engine.pipeline.cfg
@@ -128,7 +157,8 @@ def main():
             "scheduler": getattr(pcfg, "scheduler", "euler"),
             "step_cache": use_cache,
             "skipped_steps": skipped,
-            "weights": "random-init (bench preset; real-weight loader "
+            "offload": getattr(engine.pipeline, "offload", ""),
+            "weights": fallback + "random-init (real-weight loader "
                        "exists, no checkpoint in the image)",
         },
     }))

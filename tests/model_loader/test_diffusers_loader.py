@@ -117,12 +117,20 @@ def diffusers_ckpt(tmp_path_factory):
         "_class_name": "FlowMatchEulerDiscreteScheduler",
         "shift": 3.0, "use_dynamic_shifting": False,
     }))
+    # causal VAE with z_dim matching the DiT's out_channels (=4)
+    from tests.model_loader.test_causal_vae_parity import (
+        TINY as TINY_VAE,
+        _write_checkpoint,
+    )
+
+    _write_checkpoint(root, TINY_VAE)
     (root / "model_index.json").write_text(json.dumps({
         "_class_name": "QwenImagePipeline",
         "transformer": ["diffusers", "QwenImageTransformer2DModel"],
         "text_encoder": ["transformers", "Qwen2_5_VLForConditionalGeneration"],
         "tokenizer": ["transformers", "Qwen2Tokenizer"],
         "scheduler": ["diffusers", "FlowMatchEulerDiscreteScheduler"],
+        "vae": ["diffusers", "AutoencoderKLQwenImage"],
     }))
     return root, te
 

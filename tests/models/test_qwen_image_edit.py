@@ -1,0 +1,83 @@
+"""Qwen-Image-Edit: the input image must actually condition generation
+(reference: pipeline_qwen_image_edit.py:218 — VAE-encoded condition
+tokens on the sequence axis, frame -1 RoPE; VERDICT r2 missing #2:
+/v1/images/edits silently ignored the input image)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.request import (
+    InvalidRequestError,
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+from vllm_omni_tpu.models.qwen_image.edit_pipeline import (
+    QwenImageEditPipeline,
+    QwenImageEditPlusPipeline,
+)
+from vllm_omni_tpu.models.qwen_image.pipeline import QwenImagePipelineConfig
+
+
+@pytest.fixture(scope="module")
+def edit_pipe():
+    return QwenImageEditPipeline(
+        QwenImagePipelineConfig.tiny(), dtype=jnp.float32, seed=0)
+
+
+def _img(seed):
+    return np.random.default_rng(seed).integers(
+        0, 255, (32, 32, 3), np.uint8)
+
+
+def _gen(pipe, image, seed=3, prompts=("make it red",)):
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=4.0,
+        seed=seed, image=image)
+    req = OmniDiffusionRequest(
+        prompt=list(prompts), sampling_params=sp,
+        request_ids=[f"r{i}" for i in range(len(prompts))])
+    return [o.data for o in pipe.forward(req)]
+
+
+def test_edit_conditions_on_input_image(edit_pipe):
+    out_a1 = _gen(edit_pipe, _img(1))
+    out_a2 = _gen(edit_pipe, _img(1))
+    out_b = _gen(edit_pipe, _img(2))
+    # deterministic w.r.t. the same image...
+    np.testing.assert_array_equal(out_a1[0], out_a2[0])
+    # ...and sensitive to a different one (conditioning is live)
+    assert not np.array_equal(out_a1[0], out_b[0])
+    assert out_a1[0].shape == (32, 32, 3)
+
+
+def test_edit_requires_image(edit_pipe):
+    with pytest.raises(InvalidRequestError, match="image"):
+        _gen(edit_pipe, None)
+
+
+def test_edit_rejects_multiple_images(edit_pipe):
+    with pytest.raises(InvalidRequestError, match="at most"):
+        _gen(edit_pipe, [_img(1), _img(2)])
+
+
+def test_edit_resizes_condition_image(edit_pipe):
+    # 30x30 is not a multiple of vae_ratio*patch=4 -> snapped + resized
+    out = _gen(edit_pipe, _img(7)[:30, :30])
+    assert out[0].shape == (32, 32, 3)
+
+
+def test_edit_plus_multiple_images():
+    pipe = QwenImageEditPlusPipeline(
+        QwenImagePipelineConfig.tiny(), dtype=jnp.float32, seed=0)
+    one = _gen(pipe, [_img(1)])
+    two = _gen(pipe, [_img(1), _img(2)])
+    assert one[0].shape == (32, 32, 3)
+    # a second condition image changes the result
+    assert not np.array_equal(one[0], two[0])
+
+
+def test_edit_batch_two_prompts(edit_pipe):
+    outs = _gen(edit_pipe, _img(4), prompts=("red", "blue"))
+    assert len(outs) == 2 and outs[0].shape == (32, 32, 3)
+    assert not np.array_equal(outs[0], outs[1])

@@ -74,3 +74,22 @@ def test_diffusion_autodetect(tmp_path):
     (d / "model_index.json").write_text("{}")
     stages = load_stage_configs_from_model(str(d))
     assert stages[0].stage_type == "diffusion"
+
+
+def test_real_model_name_resolves_to_stage_yaml():
+    """Omni("Qwen/Qwen-Image") resolves the in-tree qwen_image.yaml and
+    the user's model path is injected into the diffusion stage's
+    engine_args (reference: serve CLI model arg overriding the stage
+    YAML's model field)."""
+    stages = load_stage_configs_from_model("Qwen/Qwen-Image")
+    assert len(stages) == 1
+    assert stages[0].stage_type == "diffusion"
+    assert stages[0].engine_args["model"] == "Qwen/Qwen-Image"
+    assert stages[0].final_output_type == "image"
+    assert stages[0].default_sampling_params["num_inference_steps"] == 50
+
+
+def test_factory_stages_keep_their_model():
+    """Multi-stage factory YAMLs must NOT have the user model injected."""
+    stages = load_stage_configs_from_model("qwen3-omni-moe-tiny")
+    assert all("model" not in s.engine_args for s in stages)

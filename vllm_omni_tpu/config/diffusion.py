@@ -36,6 +36,11 @@ class OmniDiffusionConfig:
     # offload via device_put)
     enable_sleep_mode: bool = False
 
+    # "" | "layerwise": stream block weights host->HBM per use so models
+    # larger than HBM run on one chip (reference:
+    # diffusion/offloader/layerwise_backend.py)
+    offload: str = ""
+
     # quantization: "" | "int8" | "fp8"
     quantization: str = ""
 

@@ -94,6 +94,16 @@ class DiffusionEngine:
                 jax.devices()[: od_config.parallel.world_size],
             )
         self.mesh = mesh
+        extra_kwargs = {}
+        if od_config.offload:
+            import inspect
+
+            if "offload" not in inspect.signature(
+                    pipeline_cls.__init__).parameters:
+                raise ValueError(
+                    f"{arch} does not support offload="
+                    f"{od_config.offload!r}")
+            extra_kwargs["offload"] = od_config.offload
         from_ckpt = (
             od_config.model
             and os.path.isfile(os.path.join(od_config.model,
@@ -104,7 +114,7 @@ class DiffusionEngine:
             # diffusers-format checkpoint directory: real weights
             self.pipeline = pipeline_cls.from_pretrained(
                 od_config.model, dtype=dtype, seed=od_config.seed,
-                cache_config=cache_config, mesh=mesh,
+                cache_config=cache_config, mesh=mesh, **extra_kwargs,
             )
             if solver and hasattr(self.pipeline.cfg, "scheduler"):
                 # from_pretrained builds its own config; re-apply the
@@ -131,7 +141,7 @@ class DiffusionEngine:
                 )
             self.pipeline = pipeline_cls(
                 pipe_cfg, dtype=dtype, seed=od_config.seed,
-                cache_config=cache_config, mesh=mesh,
+                cache_config=cache_config, mesh=mesh, **extra_kwargs,
             )
         if od_config.quantization in ("int8", "fp8"):
             from vllm_omni_tpu.diffusion.quantization import quantize_params

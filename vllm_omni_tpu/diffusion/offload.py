@@ -1,0 +1,120 @@
+"""Layerwise weight streaming — run models larger than HBM on one chip.
+
+Role of the reference's layerwise offloader (reference:
+vllm_omni/diffusion/offloader/layerwise_backend.py:1 — CUDA-stream
+prefetched CPU<->GPU parameter streaming with per-layer hooks).  The
+TPU-native shape: block weights stay in HOST memory as numpy trees; a
+``BlockStreamer`` walks the block list issuing ``jax.device_put`` ahead of
+use (double-buffered), so the DMA of block i+1 overlaps the MXU compute of
+block i.  Dropping the device reference after use lets the runtime reclaim
+the buffer as soon as its consumer finishes — steady-state HBM holds
+~``prefetch`` blocks plus activations, regardless of model size.
+
+There are no CUDA streams or hooks to port: JAX's async dispatch gives the
+overlap for free, and one jitted per-block executable (shapes are
+identical across blocks) replaces per-layer module wrapping.
+
+Used by the Qwen-Image pipeline (``offload="layerwise"``) to run the REAL
+20.4B-parameter 60-layer geometry — 41 GB of bf16 weights — on a 16 GB
+v5e chip.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+class BlockStreamer:
+    """Stream a list of same-shaped host param trees through a per-block
+    function with lookahead transfers.
+
+    ``prefetch=2`` keeps at most two blocks in flight: one computing, one
+    transferring — the minimum for full DMA/compute overlap.
+    """
+
+    def __init__(self, blocks: list, device=None, prefetch: int = 2):
+        if not blocks:
+            raise ValueError("need at least one block")
+        self.blocks = blocks
+        self.device = device if device is not None else jax.devices()[0]
+        self.prefetch = max(1, prefetch)
+
+    def _put(self, i: int):
+        return jax.device_put(self.blocks[i], self.device)
+
+    def run(self, fn: Callable[[Any, Any], Any], carry):
+        """carry = fn(block_on_device, carry) for each block in order.
+
+        Backpressure: device_put and jitted dispatch are both async, so
+        without a throttle the Python loop would race ahead and enqueue
+        EVERY block's transfer — unbounded HBM, defeating streaming.
+        After dispatching block i, the host blocks on the carry produced
+        ``prefetch`` blocks earlier: at most ~prefetch block weights are
+        resident/in-flight at any moment, and the lookahead transfer
+        still overlaps the current block's compute."""
+        import jax as _jax
+
+        n = len(self.blocks)
+        inflight: deque = deque()
+        lagging: deque = deque()
+        for j in range(min(self.prefetch, n)):
+            inflight.append(self._put(j))
+        for i in range(n):
+            blk = inflight.popleft()
+            nxt = i + self.prefetch
+            if nxt < n:
+                inflight.append(self._put(nxt))
+            carry = fn(blk, carry)
+            # drop the device reference: the runtime frees the buffers
+            # once the dispatched computation consumes them
+            del blk
+            lagging.append(carry)
+            if len(lagging) > self.prefetch:
+                _jax.block_until_ready(lagging.popleft())
+        return carry
+
+
+def host_tiled_init(shapes_tree, dtype, seed: int = 0,
+                    pool_elems: int = 1 << 22):
+    """Fast host-side init for perf runs: fill every leaf by tiling a
+    small N(0, 0.02) pool (memcpy-speed, ~GB/s) instead of generating
+    tens of billions of fresh randoms.  TPU matmul timing is
+    value-independent, so tiled values bench identically to fresh ones —
+    use real checkpoints for quality work.
+
+    ``shapes_tree`` is a ``jax.eval_shape`` result; returns a numpy tree.
+    """
+    rng = np.random.default_rng(seed)
+    np_dtype = np.dtype(jax.numpy.dtype(dtype).name) if not _is_bf16(
+        dtype) else None
+    pool = (rng.standard_normal(pool_elems) * 0.02).astype(np.float32)
+
+    def fill(leaf):
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        arr = np.resize(pool, n).reshape(leaf.shape)
+        if np_dtype is None:
+            import ml_dtypes
+
+            return arr.astype(ml_dtypes.bfloat16)
+        return arr.astype(np_dtype)
+
+    return jax.tree.map(fill, shapes_tree)
+
+
+def _is_bf16(dtype) -> bool:
+    return jax.numpy.dtype(dtype).name == "bfloat16"
+
+
+def split_host_blocks(params, key: str):
+    """Split a host param tree into (top-level tree without ``key``,
+    list-of-blocks under ``key``) for streaming."""
+    top = {k: v for k, v in params.items() if k != key}
+    return top, list(params[key])

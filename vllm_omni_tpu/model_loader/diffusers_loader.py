@@ -189,6 +189,179 @@ def load_text_encoder(text_encoder_dir: str, dtype=jnp.bfloat16):
     return params, cfg
 
 
+# ------------------------------------------------------------- causal VAE
+def causal_vae_config_from_diffusers(config: dict):
+    """AutoencoderKLQwenImage config.json -> CausalVAEConfig (field names
+    per the diffusers class the reference mirrors,
+    autoencoder_kl_qwenimage.py:679-697)."""
+    from vllm_omni_tpu.models.common.causal_vae import CausalVAEConfig
+
+    return CausalVAEConfig(
+        z_channels=config.get("z_dim", 16),
+        base_dim=config.get("base_dim", 96),
+        dim_mult=tuple(config.get("dim_mult", (1, 2, 4, 4))),
+        num_res_blocks=config.get("num_res_blocks", 2),
+        attn_scales=tuple(config.get("attn_scales", ())),
+        temporal_downsample=tuple(
+            config.get("temperal_downsample", (False, True, True))),
+        latents_mean=tuple(config["latents_mean"])
+        if config.get("latents_mean") else None,
+        latents_std=tuple(config["latents_std"])
+        if config.get("latents_std") else None,
+    )
+
+
+_VAE_RES = {
+    "norm1.gamma": ("norm1", "g"),
+    "conv1.weight": ("conv1", "w"),
+    "conv1.bias": ("conv1", "b"),
+    "norm2.gamma": ("norm2", "g"),
+    "conv2.weight": ("conv2", "w"),
+    "conv2.bias": ("conv2", "b"),
+    "conv_shortcut.weight": ("skip", "w"),
+    "conv_shortcut.bias": ("skip", "b"),
+}
+
+_VAE_ATTN = {
+    "norm.gamma": ("norm", "g"),
+    "to_qkv.weight": ("qkv", "w"),
+    "to_qkv.bias": ("qkv", "b"),
+    "proj.weight": ("proj", "w"),
+    "proj.bias": ("proj", "b"),
+}
+
+
+def causal_vae_flat_map(cfg) -> dict[str, tuple]:
+    """hf_name -> tree-path dict for the Wan-family causal VAE.
+
+    Decoder/mid/up-block names are positional; the encoder's
+    ``down_blocks`` is a FLAT ModuleList (resnets, attentions, and
+    resamplers interleaved — autoencoder_kl_qwenimage.py:415-429), so the
+    flat index is reconstructed from the config here.
+    """
+    flat: dict[str, tuple] = {}
+
+    def put(prefix: str, table: dict, path: tuple):
+        for hf_leaf, ours in table.items():
+            flat[f"{prefix}.{hf_leaf}"] = path + ours
+
+    conv = {"weight": "w", "bias": "b"}
+    for side in ("decoder", "encoder"):
+        put(f"{side}.mid_block.resnets.0", _VAE_RES, (side, "mid", "res0"))
+        put(f"{side}.mid_block.attentions.0", _VAE_ATTN,
+            (side, "mid", "attn0"))
+        put(f"{side}.mid_block.resnets.1", _VAE_RES, (side, "mid", "res1"))
+        for leaf, ours in conv.items():
+            flat[f"{side}.conv_in.{leaf}"] = (side, "conv_in", ours)
+            flat[f"{side}.conv_out.{leaf}"] = (side, "conv_out", ours)
+        flat[f"{side}.norm_out.gamma"] = (side, "norm_out", "g")
+    for name in ("quant_conv", "post_quant_conv"):
+        for leaf, ours in conv.items():
+            flat[f"{name}.{leaf}"] = (name, ours)
+
+    n_stages = len(cfg.dim_mult)
+    for i in range(n_stages):
+        for j in range(cfg.num_res_blocks + 1):
+            put(f"decoder.up_blocks.{i}.resnets.{j}", _VAE_RES,
+                ("decoder", "ups", i, "res", j))
+        up = f"decoder.up_blocks.{i}.upsamplers.0"
+        for leaf, ours in conv.items():
+            flat[f"{up}.resample.1.{leaf}"] = (
+                "decoder", "ups", i, "up", "conv", ours)
+            flat[f"{up}.time_conv.{leaf}"] = (
+                "decoder", "ups", i, "up", "time", ours)
+
+    k = 0  # encoder down_blocks flat index
+    scale = 1.0
+    for i in range(n_stages):
+        for j in range(cfg.num_res_blocks):
+            put(f"encoder.down_blocks.{k}", _VAE_RES,
+                ("encoder", "downs", i, "res", j))
+            k += 1
+            if scale in cfg.attn_scales:
+                put(f"encoder.down_blocks.{k}", _VAE_ATTN,
+                    ("encoder", "downs", i, "attn", j))
+                k += 1
+        if i != n_stages - 1:
+            for leaf, ours in conv.items():
+                flat[f"encoder.down_blocks.{k}.resample.1.{leaf}"] = (
+                    "encoder", "downs", i, "down", "conv", ours)
+                flat[f"encoder.down_blocks.{k}.time_conv.{leaf}"] = (
+                    "encoder", "downs", i, "down", "time", ours)
+            k += 1
+            scale /= 2.0
+
+    return flat
+
+
+def causal_vae_name_map(cfg):
+    return causal_vae_flat_map(cfg).get
+
+
+def causal_vae_transform(name: str, arr):
+    """torch layouts -> ours: OIDHW conv3d -> DHWIO, OIHW conv2d -> HWIO,
+    broadcast-shaped norm gammas -> [C]."""
+    if name.endswith("gamma"):
+        return arr.reshape(-1)
+    if arr.ndim == 5:
+        return arr.transpose(2, 3, 4, 1, 0)
+    if arr.ndim == 4:
+        return arr.transpose(2, 3, 1, 0)
+    return arr
+
+
+def load_causal_vae(
+    vae_dir: str,
+    dtype=jnp.bfloat16,
+    encoder: bool = True,
+    decoder: bool = True,
+    device_put=None,
+):
+    """Load a diffusers-format Wan-family causal VAE
+    (AutoencoderKLQwenImage / Wan2.1 layout).  Returns (params,
+    CausalVAEConfig).  Every leaf of the requested halves must be covered
+    by the checkpoint or this raises."""
+    import jax
+    import numpy as np
+
+    from vllm_omni_tpu.models.common import causal_vae as cv
+
+    with open(os.path.join(vae_dir, "config.json")) as f:
+        cfg = causal_vae_config_from_diffusers(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: cv.init_params(jax.random.PRNGKey(0), cfg, jnp.float32,
+                               encoder=encoder, decoder=decoder)
+    )
+    np_dtype = jnp.bfloat16 if dtype == jnp.bfloat16 else np.dtype(
+        jnp.dtype(dtype).name)
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np_dtype), shapes)
+    name_map = causal_vae_name_map(cfg)
+
+    def map_requested(hf_name):
+        path = name_map(hf_name)
+        if path is None:
+            return None
+        if not encoder and path[0] in ("encoder", "quant_conv"):
+            return None
+        if not decoder and path[0] in ("decoder", "post_quant_conv"):
+            return None
+        return path
+
+    n, unmapped = load_checkpoint_tree(
+        vae_dir, map_requested, tree,
+        dtype=np_dtype, device_put=device_put,
+        transform=causal_vae_transform,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if n != n_leaves:
+        raise ValueError(
+            f"checkpoint {vae_dir} covered {n}/{n_leaves} VAE weights — "
+            "incomplete or incompatible checkpoint"
+        )
+    logger.info("causal VAE loader: %d tensors loaded", n)
+    return tree, cfg
+
+
 # -------------------------------------------------------------- scheduler
 def scheduler_config(model_dir: str) -> dict:
     """FlowMatch scheduler knobs from scheduler/scheduler_config.json

@@ -69,13 +69,16 @@ def load_checkpoint_tree(
     transpose_linear: bool = True,
     dtype=None,
     device_put: Optional[Callable] = None,
+    transform: Optional[Callable[[str, np.ndarray], np.ndarray]] = None,
 ) -> tuple[int, list[str]]:
     """Stream a checkpoint into an existing param tree.
 
     ``name_map(hf_name)`` returns a path tuple into ``tree`` (or None to
     skip).  HF linears store [out, in]; our layout is [in, out] —
-    ``transpose_linear`` flips 2-D "w" leaves.  Returns (num_loaded,
-    unmapped_names); shape mismatches raise immediately.
+    ``transpose_linear`` flips 2-D "w" leaves.  ``transform(name, arr)``
+    (when given) handles layouts the flag can't express, e.g. torch
+    OIDHW conv kernels -> DHWIO.  Returns (num_loaded, unmapped_names);
+    shape mismatches raise immediately.
     """
     n = 0
     unmapped: list[str] = []
@@ -88,7 +91,9 @@ def load_checkpoint_tree(
         for key in path[:-1]:
             node = node[int(key)] if isinstance(node, list) else node[key]
         leaf = path[-1]
-        if transpose_linear and leaf == "w" and arr.ndim == 2:
+        if transform is not None:
+            arr = transform(hf_name, arr)
+        elif transpose_linear and leaf == "w" and arr.ndim == 2:
             arr = arr.T
         expected = node[leaf]
         if tuple(expected.shape) != tuple(arr.shape):

@@ -1,0 +1,411 @@
+"""Wan-family causal 3D VAE (functional JAX, NTHWC) — checkpoint-compatible.
+
+The reference's Qwen-Image VAE *is* the Wan video VAE design (reference:
+vllm_omni/diffusion/models/qwen_image/autoencoder_kl_qwenimage.py:667
+``AutoencoderKLQwenImage`` — CausalConv3d stacks, channel-RMS norms,
+single-head spatial attention in the mid block, and temporal up/down
+resampling where the first frame is coded independently so F pixel frames
+map to ``1 + (F-1)/4`` latent frames).  Images are 1-frame videos.
+
+TPU-first design notes:
+- The reference decodes frame-by-frame with a feature cache (GPU memory
+  optimization).  Causal convolutions make that loop equivalent to ONE
+  full-sequence convolution with zero left-padding in time, so here the
+  whole clip decodes in a single conv pass per layer — XLA sees static
+  shapes and large convs for the MXU instead of a Python loop.
+- The cached temporal resamplers have first-frame special cases; their
+  full-sequence equivalents (derived from the cache protocol at
+  autoencoder_kl_qwenimage.py:168-213,629-666) are:
+    * upsample3d: frame 0 passes through; frames 1..T-1 run the
+      (3,1,1)->2C time conv over a zero-history stream and each output
+      splits channel-wise into two interleaved frames.
+    * downsample3d: frame 0 passes through; a VALID stride-2 k=3 time
+      conv over the full stream yields the remaining frames.
+- T==1 (image) inputs take a pure-2D path: with 2 frames of causal zero
+  padding, only the LAST temporal kernel tap ever touches data, so each
+  3D conv collapses exactly to a 2D conv with ``w[kt-1]``.
+
+Weight layout matches the diffusers checkpoint modulo axis order: conv3d
+``[kt, kh, kw, cin, cout]`` (DHWIO), conv2d ``[kh, kw, cin, cout]``
+(HWIO), norms ``[C]`` — see ``model_loader/diffusers_loader.py`` for the
+name map and axis transposes.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Qwen-Image / Wan2.1 per-channel latent statistics (reference:
+# autoencoder_kl_qwenimage.py:692-697 config defaults).
+QWEN_IMAGE_LATENTS_MEAN = (
+    -0.7571, -0.7089, -0.9113, 0.1075, -0.1745, 0.9653, -0.1517, 1.5508,
+    0.4134, -0.0715, 0.5517, -0.3632, -0.1922, -0.9497, 0.2503, -0.2921,
+)
+QWEN_IMAGE_LATENTS_STD = (
+    2.8184, 1.4541, 2.3275, 2.6558, 1.2196, 1.7708, 2.6052, 2.0743,
+    3.2687, 2.1526, 2.8652, 1.5579, 1.6382, 1.1253, 2.8251, 1.9160,
+)
+
+
+@dataclass(frozen=True)
+class CausalVAEConfig:
+    z_channels: int = 16
+    base_dim: int = 96
+    dim_mult: tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    attn_scales: tuple[float, ...] = ()
+    # per down-transition (len == len(dim_mult)-1); decoder reverses it
+    temporal_downsample: tuple[bool, ...] = (False, True, True)
+    latents_mean: tuple[float, ...] | None = None
+    latents_std: tuple[float, ...] | None = None
+
+    @property
+    def spatial_ratio(self) -> int:
+        return 2 ** (len(self.dim_mult) - 1)
+
+    @property
+    def temporal_ratio(self) -> int:
+        return 2 ** sum(self.temporal_downsample)
+
+    def latent_frames(self, frames: int) -> int:
+        if frames < 1:
+            raise ValueError("need at least one frame")
+        return 1 + -(-(frames - 1) // self.temporal_ratio)
+
+    def pixel_frames(self, latent_frames: int) -> int:
+        return 1 + (latent_frames - 1) * self.temporal_ratio
+
+    @staticmethod
+    def qwen_image() -> "CausalVAEConfig":
+        return CausalVAEConfig(
+            latents_mean=QWEN_IMAGE_LATENTS_MEAN,
+            latents_std=QWEN_IMAGE_LATENTS_STD,
+        )
+
+    @staticmethod
+    def tiny() -> "CausalVAEConfig":
+        return CausalVAEConfig(
+            z_channels=4,
+            base_dim=8,
+            dim_mult=(1, 2),
+            num_res_blocks=1,
+            temporal_downsample=(True,),
+        )
+
+
+# ----------------------------------------------------------------- helpers
+def _uniform(key, shape, fan_in, dtype):
+    s = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -s, s)
+
+
+def _c3_init(key, cin, cout, kt, ks, dtype):
+    return {
+        "w": _uniform(key, (kt, ks, ks, cin, cout), cin * kt * ks * ks, dtype),
+        "b": jnp.zeros((cout,), dtype),
+    }
+
+
+def _c2_init(key, cin, cout, ks, dtype):
+    return {
+        "w": _uniform(key, (ks, ks, cin, cout), cin * ks * ks, dtype),
+        "b": jnp.zeros((cout,), dtype),
+    }
+
+
+def _rms_init(ch, dtype):
+    return {"g": jnp.ones((ch,), dtype)}
+
+
+def _rms(p, x):
+    """Channel RMS norm (reference QwenImageRMS_norm: L2-normalize over C,
+    scale by sqrt(C) * gamma) — channel axis is last in NTHWC."""
+    xf = x.astype(jnp.float32)
+    n = jnp.sqrt(jnp.sum(xf * xf, axis=-1, keepdims=True))
+    c = x.shape[-1]
+    y = xf / jnp.maximum(n, 1e-12) * math.sqrt(c)
+    return (y * p["g"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _cconv3d(p, x, t_stride: int = 1, t_pad: str = "causal"):
+    """Causal 3D conv over [B, T, H, W, C]; T==1 stride-1 inputs collapse
+    to a 2D conv with the last temporal tap (zero history contributes 0)."""
+    w = p["w"]
+    kt, kh, kw = w.shape[:3]
+    if x.shape[1] == 1 and t_stride == 1:
+        y = lax.conv_general_dilated(
+            x[:, 0], w[kt - 1].astype(x.dtype), (1, 1),
+            [(kh // 2, kh // 2), (kw // 2, kw // 2)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[:, None]
+    else:
+        pt = (2 * (kt // 2), 0) if t_pad == "causal" else (0, 0)
+        y = lax.conv_general_dilated(
+            x, w.astype(x.dtype), (t_stride, 1, 1),
+            [pt, (kh // 2, kh // 2), (kw // 2, kw // 2)],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        )
+    return y + p["b"].astype(x.dtype)
+
+
+def _conv2d_frames(p, x, stride: int = 1, padding="SAME"):
+    """Per-frame 2D conv: fold T into batch."""
+    b, t, h, w, c = x.shape
+    y = lax.conv_general_dilated(
+        x.reshape(b * t, h, w, c), p["w"].astype(x.dtype), (stride, stride),
+        padding if isinstance(padding, list) else padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + p["b"].astype(x.dtype)
+    return y.reshape(b, t, *y.shape[1:])
+
+
+def _res_init(key, cin, cout, dtype):
+    k = jax.random.split(key, 3)
+    p = {
+        "norm1": _rms_init(cin, dtype),
+        "conv1": _c3_init(k[0], cin, cout, 3, 3, dtype),
+        "norm2": _rms_init(cout, dtype),
+        "conv2": _c3_init(k[1], cout, cout, 3, 3, dtype),
+    }
+    if cin != cout:
+        p["skip"] = _c3_init(k[2], cin, cout, 1, 1, dtype)
+    return p
+
+
+def _res(p, x):
+    h = _cconv3d(p["skip"], x) if "skip" in p else x
+    y = _cconv3d(p["conv1"], jax.nn.silu(_rms(p["norm1"], x)))
+    y = _cconv3d(p["conv2"], jax.nn.silu(_rms(p["norm2"], y)))
+    return h + y
+
+
+def _attn_init(key, ch, dtype):
+    k = jax.random.split(key, 2)
+    return {
+        "norm": _rms_init(ch, dtype),
+        "qkv": _c2_init(k[0], ch, 3 * ch, 1, dtype),
+        "proj": _c2_init(k[1], ch, ch, 1, dtype),
+    }
+
+
+def _attn(p, x):
+    """Per-frame single-head spatial attention (reference
+    QwenImageAttentionBlock)."""
+    b, t, h, w, c = x.shape
+    xn = _rms(p["norm"], x).reshape(b * t, h * w, c)
+    qkv = xn @ p["qkv"]["w"][0, 0] + p["qkv"]["b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    s = jnp.einsum("bqc,bkc->bqk", q, k).astype(jnp.float32) / math.sqrt(c)
+    a = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    o = jnp.einsum("bqk,bkc->bqc", a, v) @ p["proj"]["w"][0, 0]
+    o = o + p["proj"]["b"]
+    return x + o.reshape(b, t, h, w, c)
+
+
+def _mid_init(key, ch, dtype):
+    k = jax.random.split(key, 3)
+    return {
+        "res0": _res_init(k[0], ch, ch, dtype),
+        "attn0": _attn_init(k[1], ch, dtype),
+        "res1": _res_init(k[2], ch, ch, dtype),
+    }
+
+
+def _mid(p, x):
+    return _res(p["res1"], _attn(p["attn0"], _res(p["res0"], x)))
+
+
+def _time_upsample(p, x):
+    """Cached-protocol equivalent (see module docstring): frame 0 passes
+    through; the (3,1,1)->2C conv runs over frames 1.. with zero history,
+    each output splitting channel-wise into two frames."""
+    if x.shape[1] == 1:
+        return x
+    c = x.shape[-1]
+    h = _cconv3d(p, x[:, 1:])  # [B, T-1, H, W, 2C]
+    pairs = jnp.stack([h[..., :c], h[..., c:]], axis=2)
+    inter = pairs.reshape(x.shape[0], -1, *x.shape[2:])
+    return jnp.concatenate([x[:, :1], inter], axis=1)
+
+
+def _time_downsample(p, x):
+    """Frame 0 passes through; VALID stride-2 k=3 time conv over the full
+    stream yields the rest (chunk protocol: windows [x_{2j-2}, x_{2j-1},
+    x_{2j}])."""
+    if x.shape[1] < 3:
+        return x[:, :1]
+    rest = _cconv3d(p, x, t_stride=2, t_pad="valid")
+    return jnp.concatenate([x[:, :1], rest], axis=1)
+
+
+def _s_upsample2x(x):
+    b, t, h, w, c = x.shape
+    y = jnp.repeat(jnp.repeat(x, 2, axis=2), 2, axis=3)
+    return y
+
+
+# ------------------------------------------------------------------ decoder
+def _decoder_dims(cfg: CausalVAEConfig) -> list[int]:
+    mults = [cfg.dim_mult[-1]] + list(reversed(cfg.dim_mult))
+    return [cfg.base_dim * m for m in mults]
+
+
+def init_decoder(key, cfg: CausalVAEConfig, dtype=jnp.float32):
+    dims = _decoder_dims(cfg)
+    t_up = tuple(reversed(cfg.temporal_downsample))
+    keys = jax.random.split(key, 4 + len(cfg.dim_mult))
+    p = {
+        "conv_in": _c3_init(keys[0], cfg.z_channels, dims[0], 3, 3, dtype),
+        "mid": _mid_init(keys[1], dims[0], dtype),
+        "ups": [],
+    }
+    for i, (cin, cout) in enumerate(zip(dims[:-1], dims[1:])):
+        if i > 0:
+            cin //= 2
+        ks = jax.random.split(keys[2 + i], cfg.num_res_blocks + 3)
+        blk = {"res": []}
+        cur = cin
+        for j in range(cfg.num_res_blocks + 1):
+            blk["res"].append(_res_init(ks[j], cur, cout, dtype))
+            cur = cout
+        if i != len(cfg.dim_mult) - 1:
+            blk["up"] = {"conv": _c2_init(ks[-2], cout, cout // 2, 3, dtype)}
+            if t_up[i]:
+                blk["up"]["time"] = _c3_init(
+                    ks[-1], cout, 2 * cout, 3, 1, dtype)
+        p["ups"].append(blk)
+    out_dim = dims[-1]
+    p["norm_out"] = _rms_init(out_dim, dtype)
+    p["conv_out"] = _c3_init(keys[-1], out_dim, 3, 3, 3, dtype)
+    return p
+
+
+def decode_core(p, cfg: CausalVAEConfig, z: jax.Array) -> jax.Array:
+    """decoder-only: [B, T, h, w, z] (post post_quant_conv) -> pixels."""
+    x = _cconv3d(p["conv_in"], z)
+    x = _mid(p["mid"], x)
+    for blk in p["ups"]:
+        for rb in blk["res"]:
+            x = _res(rb, x)
+        if "up" in blk:
+            if "time" in blk["up"]:
+                x = _time_upsample(blk["up"]["time"], x)
+            x = _conv2d_frames(blk["up"]["conv"], _s_upsample2x(x))
+    x = jax.nn.silu(_rms(p["norm_out"], x))
+    return jnp.clip(_cconv3d(p["conv_out"], x), -1.0, 1.0)
+
+
+# ------------------------------------------------------------------ encoder
+def _encoder_dims(cfg: CausalVAEConfig) -> list[int]:
+    return [cfg.base_dim * m for m in [1] + list(cfg.dim_mult)]
+
+
+def init_encoder(key, cfg: CausalVAEConfig, dtype=jnp.float32):
+    dims = _encoder_dims(cfg)
+    keys = jax.random.split(key, 4 + len(cfg.dim_mult))
+    p = {
+        "conv_in": _c3_init(keys[0], 3, dims[0], 3, 3, dtype),
+        "downs": [],
+    }
+    scale = 1.0
+    for i, (cin, cout) in enumerate(zip(dims[:-1], dims[1:])):
+        ks = jax.random.split(keys[1 + i], 2 * cfg.num_res_blocks + 2)
+        blk = {"res": [], "attn": []}
+        cur = cin
+        for j in range(cfg.num_res_blocks):
+            blk["res"].append(_res_init(ks[j], cur, cout, dtype))
+            if scale in cfg.attn_scales:
+                blk["attn"].append(_attn_init(ks[cfg.num_res_blocks + j],
+                                              cout, dtype))
+            cur = cout
+        if i != len(cfg.dim_mult) - 1:
+            blk["down"] = {"conv": _c2_init(ks[-2], cout, cout, 3, dtype)}
+            if cfg.temporal_downsample[i]:
+                blk["down"]["time"] = _c3_init(ks[-1], cout, cout, 3, 1,
+                                               dtype)
+            scale /= 2.0
+        p["downs"].append(blk)
+    top = dims[-1]
+    p["mid"] = _mid_init(keys[-2], top, dtype)
+    p["norm_out"] = _rms_init(top, dtype)
+    p["conv_out"] = _c3_init(keys[-1], top, 2 * cfg.z_channels, 3, 3, dtype)
+    return p
+
+
+def encode_core(p, cfg: CausalVAEConfig, x: jax.Array) -> jax.Array:
+    """encoder-only: [B, T, H, W, 3] -> moments [B, Tl, h, w, 2*z]
+    (pre quant_conv)."""
+    x = _cconv3d(p["conv_in"], x)
+    for blk in p["downs"]:
+        for j, rb in enumerate(blk["res"]):
+            x = _res(rb, x)
+            if blk["attn"]:
+                x = _attn(blk["attn"][j], x)
+        if "down" in blk:
+            x = _conv2d_frames(blk["down"]["conv"], x, stride=2,
+                               padding=[(0, 1), (0, 1)])
+            if "time" in blk["down"]:
+                x = _time_downsample(blk["down"]["time"], x)
+    x = _mid(p["mid"], x)
+    x = jax.nn.silu(_rms(p["norm_out"], x))
+    return _cconv3d(p["conv_out"], x)
+
+
+# ---------------------------------------------------------------- full VAE
+def init_params(key, cfg: CausalVAEConfig, dtype=jnp.float32,
+                encoder: bool = True, decoder: bool = True):
+    k = jax.random.split(key, 4)
+    p = {}
+    if decoder:
+        p["decoder"] = init_decoder(k[0], cfg, dtype)
+        p["post_quant_conv"] = _c3_init(
+            k[1], cfg.z_channels, cfg.z_channels, 1, 1, dtype)
+    if encoder:
+        p["encoder"] = init_encoder(k[2], cfg, dtype)
+        p["quant_conv"] = _c3_init(
+            k[3], 2 * cfg.z_channels, 2 * cfg.z_channels, 1, 1, dtype)
+    return p
+
+
+def _mean_std(cfg: CausalVAEConfig, dtype):
+    mean = jnp.asarray(cfg.latents_mean, dtype)
+    std = jnp.asarray(cfg.latents_std, dtype)
+    return mean, std
+
+
+def decode(p, cfg: CausalVAEConfig, latents: jax.Array) -> jax.Array:
+    """[B, T, h, w, z] normalized latents -> [B, F, H, W, 3] in [-1, 1]
+    (reference decode path: denormalize -> post_quant_conv -> decoder ->
+    clamp, pipeline_qwen_image.py:706-715)."""
+    if cfg.latents_mean is not None:
+        mean, std = _mean_std(cfg, latents.dtype)
+        latents = latents * std + mean
+    z = _cconv3d(p["post_quant_conv"], latents)
+    return decode_core(p["decoder"], cfg, z)
+
+
+def decode_image(p, cfg: CausalVAEConfig, latents: jax.Array) -> jax.Array:
+    """[B, h, w, z] -> [B, H, W, 3] (1-frame video squeeze)."""
+    return decode(p, cfg, latents[:, None])[:, 0]
+
+
+def encode(p, cfg: CausalVAEConfig, x: jax.Array) -> jax.Array:
+    """[B, F, H, W, 3] in [-1, 1] -> normalized latent MEAN [B, Tl, h, w,
+    z] (deterministic conditioning encode — posterior mean, matching the
+    reference's .mode())."""
+    moments = _cconv3d(p["quant_conv"], encode_core(p["encoder"], cfg, x))
+    mean = moments[..., : cfg.z_channels]
+    if cfg.latents_mean is not None:
+        m, s = _mean_std(cfg, mean.dtype)
+        mean = (mean - m) / s
+    return mean
+
+
+def encode_image(p, cfg: CausalVAEConfig, x: jax.Array) -> jax.Array:
+    """[B, H, W, 3] -> [B, h, w, z]."""
+    return encode(p, cfg, x[:, None])[:, 0]

@@ -1,0 +1,98 @@
+"""Qwen-Image-Edit / Edit-Plus pipelines (image -> image, text-guided).
+
+Reference: vllm_omni/diffusion/models/qwen_image/pipeline_qwen_image_edit.py
+(:218 QwenImageEditPipeline) and pipeline_qwen_image_edit_plus.py.  The
+editing mechanism: the input image is VAE-encoded, packed like generated
+latents, and CONCATENATED to the token sequence; the DiT attends across
+both, RoPE gives condition tokens frame coordinate -1
+(qwen_image_transformer.py:279-297), and velocity is read off the
+generated tokens only.  Edit-Plus extends to multiple condition images
+(frame coordinates idx..,-1).
+
+TPU notes: the condition tokens ride the same jitted denoise loop — one
+executable per (geometry, cond geometry) pair; the condition encode is a
+single VAE encoder call (causal_vae.encode_image).
+
+Documented deviation: the reference's edit prompt template feeds the
+input image through the Qwen2.5-VL vision tower during TEXT encoding
+(pipeline_qwen_image_edit.py:266); this pipeline encodes the text prompt
+only — conditioning flows through the VAE-latent path, which is what
+anchors the output to the input image.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.diffusion.request import InvalidRequestError
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.qwen_image.pipeline import QwenImagePipeline
+
+logger = init_logger(__name__)
+
+
+def _to_float_image(img) -> np.ndarray:
+    """uint8/float [H, W, 3] -> float32 in [-1, 1]."""
+    arr = np.asarray(img)
+    if arr.ndim != 3 or arr.shape[-1] != 3:
+        raise InvalidRequestError(
+            f"conditioning image must be [H, W, 3]; got {arr.shape}")
+    if arr.dtype == np.uint8:
+        return arr.astype(np.float32) / 127.5 - 1.0
+    return arr.astype(np.float32)
+
+
+class QwenImageEditPipeline(QwenImagePipeline):
+    """Single condition image; output geometry follows the request."""
+
+    needs_vae_encoder = True
+    max_cond_images = 1
+
+    def _cond_images(self, req) -> list[np.ndarray]:
+        sp = req.sampling_params
+        image = sp.image if sp.image is not None else sp.extra.get("image")
+        if image is None:
+            raise InvalidRequestError(
+                f"{type(self).__name__} needs sampling_params.image "
+                "(the image to edit)")
+        images = image if isinstance(image, (list, tuple)) else [image]
+        if (self.max_cond_images is not None
+                and len(images) > self.max_cond_images):
+            raise InvalidRequestError(
+                f"{type(self).__name__} accepts at most "
+                f"{self.max_cond_images} condition image(s), got "
+                f"{len(images)}")
+        return [_to_float_image(im) for im in images]
+
+    def _edit_cond(self, req, batch: int):
+        sp = req.sampling_params
+        cfg = self.cfg
+        mult = cfg.vae.spatial_ratio * cfg.dit.patch_size
+        tokens = []
+        grids = []
+        for img in self._cond_images(req):
+            h, w = img.shape[:2]
+            # snap the condition geometry to the model's multiple; resize
+            # (reference resizes to a ~1MP target area — here the request
+            # geometry is authoritative)
+            th = max(mult, h // mult * mult)
+            tw = max(mult, w // mult * mult)
+            if (h, w) != (th, tw):
+                img = np.asarray(jax.image.resize(
+                    jnp.asarray(img), (th, tw, 3), "bilinear"))
+            packed = self._encode_image_latents(
+                jnp.asarray(img, jnp.float32)[None])  # [1, S, C]
+            tokens.append(jnp.repeat(packed, batch, axis=0))
+            grids.append((th // mult, tw // mult))
+        cond = jnp.concatenate(tokens, axis=1)
+        return cond, tuple(grids)
+
+
+class QwenImageEditPlusPipeline(QwenImageEditPipeline):
+    """Multiple condition images (reference:
+    pipeline_qwen_image_edit_plus.py — each extra image appends its own
+    token block; RoPE frame coordinates idx.., last at -1)."""
+
+    max_cond_images = None

@@ -31,15 +31,15 @@ from vllm_omni_tpu.diffusion.request import (
     OmniDiffusionRequest,
 )
 from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import causal_vae as vae_mod
+from vllm_omni_tpu.models.common.causal_vae import CausalVAEConfig
 from vllm_omni_tpu.models.common.transformer import (
     TransformerConfig,
     forward_hidden,
     init_params as init_text_params,
 )
 from vllm_omni_tpu.models.qwen_image import transformer as dit
-from vllm_omni_tpu.models.qwen_image import vae as vae_mod
 from vllm_omni_tpu.models.qwen_image.transformer import QwenImageDiTConfig
-from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
 from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
 
 logger = init_logger(__name__)
@@ -48,7 +48,8 @@ logger = init_logger(__name__)
 @dataclass(frozen=True)
 class QwenImagePipelineConfig:
     dit: QwenImageDiTConfig = field(default_factory=QwenImageDiTConfig)
-    vae: VAEConfig = field(default_factory=VAEConfig)
+    vae: CausalVAEConfig = field(
+        default_factory=CausalVAEConfig.qwen_image)
     text: TransformerConfig = field(default_factory=TransformerConfig)
     max_text_len: int = 128
     shift: float = 1.0
@@ -65,7 +66,7 @@ class QwenImagePipelineConfig:
     def tiny() -> "QwenImagePipelineConfig":
         return QwenImagePipelineConfig(
             dit=QwenImageDiTConfig.tiny(),
-            vae=VAEConfig.tiny(),
+            vae=CausalVAEConfig.tiny(),
             text=TransformerConfig.tiny(vocab_size=512),
             max_text_len=32,
         )
@@ -77,7 +78,7 @@ class QwenImagePipelineConfig:
             dit=QwenImageDiTConfig(
                 num_layers=16, num_heads=16, head_dim=128, joint_dim=1024
             ),
-            vae=VAEConfig(base_channels=64),
+            vae=CausalVAEConfig(base_dim=64),
             text=TransformerConfig(
                 vocab_size=512,
                 hidden_size=1024,
@@ -87,6 +88,29 @@ class QwenImagePipelineConfig:
                 head_dim=128,
                 intermediate_size=2816,
             ),
+        )
+
+    @staticmethod
+    def real() -> "QwenImagePipelineConfig":
+        """The REAL Qwen-Image geometry (reference:
+        transformer config.json — 60 layers / 24 heads / joint 3584;
+        Qwen2.5-VL-7B text encoder; 8x causal VAE).  20.4B-param DiT:
+        doesn't fit one v5e chip resident — run with TP over a mesh or
+        layerwise weight streaming (``ops/offload.py``)."""
+        return QwenImagePipelineConfig(
+            dit=QwenImageDiTConfig(),
+            vae=CausalVAEConfig.qwen_image(),
+            text=TransformerConfig(
+                vocab_size=152064,
+                hidden_size=3584,
+                num_layers=28,
+                num_heads=28,
+                num_kv_heads=4,
+                head_dim=128,
+                intermediate_size=18944,
+            ),
+            max_text_len=512,
+            use_dynamic_shifting=True,
         )
 
 
@@ -107,6 +131,9 @@ class QwenImagePipeline:
     loaded from a diffusers-format checkpoint via ``from_pretrained``."""
 
     output_type = "image"
+    # Edit pipelines condition on VAE-encoded input images, so their VAE
+    # keeps the encoder half.
+    needs_vae_encoder = False
 
     def __init__(
         self,
@@ -116,11 +143,35 @@ class QwenImagePipeline:
         mesh=None,
         cache_config=None,  # StepCacheConfig | None (step-skip acceleration)
         init_weights: bool = True,
+        offload: str = "",  # "" | "layerwise" (weights stream from host)
     ):
+        from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
+
         self.cfg = config
         self.dtype = dtype
         self.mesh = mesh
+        self.wiring = MeshWiring(mesh, type(self).__name__).validate(
+            {"dp", "cfg", "ring", "ulysses", "tp", "pp"})
+        if self.wiring.size("pp") > 1 and len(self.wiring.active) > 1:
+            raise ValueError(
+                "pp composes with no other axis yet — rebuild the mesh "
+                f"with pp alone (active: {sorted(self.wiring.active)})")
         self.cache_config = cache_config
+        self.offload = offload
+        if offload not in ("", "layerwise"):
+            raise ValueError(f"unknown offload mode {offload!r}")
+        if offload == "layerwise":
+            # Streaming drives a Python block loop on ONE device; the
+            # multi-chip answer to big models is TP over a mesh instead.
+            if mesh is not None:
+                raise ValueError("layerwise offload is single-device; "
+                                 "use mesh TP for multi-chip")
+            if cache_config is not None:
+                raise ValueError("step cache is not supported with "
+                                 "layerwise offload")
+            if config.scheduler != "euler":
+                raise ValueError("layerwise offload supports the euler "
+                                 "solver only")
         if config.text.hidden_size != config.dit.joint_dim:
             raise ValueError(
                 "text hidden_size must equal dit joint_dim "
@@ -129,13 +180,28 @@ class QwenImagePipeline:
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
         key = jax.random.PRNGKey(seed)
         k1, k2, k3 = jax.random.split(key, 3)
-        # The VAE decoder is always random-init (causal-VAE weight port
-        # pending); DiT/text skip init when a checkpoint will overwrite
-        # them (init_weights=False avoids materializing + placing tens of
-        # GB of randoms only to discard them).
-        self.vae_params = self._place(vae_mod.init_decoder(
-            k3, config.vae, dtype))
-        if init_weights:
+        # Decoder-only VAE for text->image (edit pipelines add the
+        # encoder); fp32 regardless of model dtype — the 127M-param VAE
+        # is not the memory story and bf16 visibly banding-artifacts the
+        # decoded image.  DiT/text skip init when a checkpoint will
+        # overwrite them (init_weights=False avoids materializing +
+        # placing tens of GB of randoms only to discard them).
+        self.vae_params = self._place(vae_mod.init_params(
+            k3, config.vae, jnp.float32, encoder=self.needs_vae_encoder))
+        if init_weights and offload == "layerwise":
+            from vllm_omni_tpu.diffusion import offload as ol
+
+            logger.info("Host-init for layerwise streaming (dtype=%s)",
+                        dtype)
+            self.text_params = ol.host_tiled_init(
+                jax.eval_shape(
+                    lambda: init_text_params(k1, config.text, dtype)),
+                dtype, seed=seed + 1)
+            self.dit_params = ol.host_tiled_init(
+                jax.eval_shape(
+                    lambda: dit.init_params(k2, config.dit, dtype)),
+                dtype, seed=seed + 2)
+        elif init_weights:
             logger.info(
                 "Initializing QwenImagePipeline params (dtype=%s)", dtype)
             self.text_params = self._place(
@@ -151,9 +217,35 @@ class QwenImagePipeline:
     def _place(self, params, tp: bool = False):
         """Put a param tree on the mesh: TP layout for the DiT, replicated
         otherwise (reference: SP plan application at model init,
-        diffusion/registry.py:122-294).  No-op without a mesh."""
+        diffusion/registry.py:122-294).  Without a mesh, commit to the
+        default device once — leaving loader numpy trees uncommitted would
+        re-transfer the weights on every jit call.
+
+        Under pipeline parallelism the DiT blocks restack onto a leading
+        layer axis sharded over ``pp`` (each rank holds L/pp blocks —
+        parallel/pp.py)."""
         if self.mesh is None:
-            return params
+            return jax.device_put(params)
+        if tp and self.wiring.size("pp") > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from vllm_omni_tpu.parallel import pp as pp_mod
+
+            n_blocks = len(params["blocks"])
+            pp = self.wiring.size("pp")
+            if n_blocks % pp:
+                raise ValueError(
+                    f"num_layers={n_blocks} must divide pp={pp}")
+            stacked = pp_mod.stack_blocks(params["blocks"])
+            top = {k: v for k, v in params.items() if k != "blocks"}
+            rep = NamedSharding(self.mesh, P())
+            return {
+                **jax.device_put(top, rep),
+                "blocks_stacked": jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, NamedSharding(self.mesh, P("pp"))),
+                    stacked),
+            }
         from vllm_omni_tpu.parallel.sharding import (
             replicated,
             shard_dit_params,
@@ -172,16 +264,15 @@ class QwenImagePipeline:
         mesh=None,
         cache_config=None,
         max_text_len: int = 512,
+        offload: str = "",
     ) -> "QwenImagePipeline":
         """Build from a diffusers-format checkpoint directory (reference:
         DiffusersPipelineLoader, diffusion/model_loader/diffusers_loader.py
         + pipeline component resolution, omni_diffusion.py:34-109).
 
-        Loads the DiT and the Qwen2.5-VL-style text encoder with real
-        weights, the HF tokenizer, and the FlowMatch scheduler shift
-        config.  The VAE decoder keeps our conv architecture (temporal/
-        causal VAE weight port is tracked separately) — random-init with a
-        warning when the checkpoint's VAE doesn't match.
+        Loads the DiT, the Qwen2.5-VL-style text encoder, and the causal
+        VAE with real weights, plus the HF tokenizer and the FlowMatch
+        scheduler shift config.
         """
         import os
 
@@ -193,10 +284,14 @@ class QwenImagePipeline:
         )
         te_dir = os.path.join(model_dir, "text_encoder")
         text_params, text_cfg = dl.load_text_encoder(te_dir, dtype=dtype)
+        vae_params, vae_cfg = dl.load_causal_vae(
+            os.path.join(model_dir, "vae"), dtype=jnp.float32,
+            encoder=cls.needs_vae_encoder,
+        )
         sched = dl.scheduler_config(model_dir)
         config = QwenImagePipelineConfig(
             dit=dit_cfg,
-            vae=VAEConfig(latent_channels=dit_cfg.out_channels),
+            vae=vae_cfg,
             text=text_cfg,
             max_text_len=max_text_len,
             # defaults mirror diffusers FlowMatchEulerDiscreteScheduler
@@ -206,13 +301,16 @@ class QwenImagePipeline:
             use_dynamic_shifting=sched.get("use_dynamic_shifting", False),
         )
         pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
-                   cache_config=cache_config, init_weights=False)
-        pipe.dit_params = pipe._place(dit_params, tp=True)
-        pipe.text_params = pipe._place(text_params)
-        logger.warning(
-            "VAE weights not loaded from %s (conv decoder is random-init; "
-            "causal-VAE port pending)", model_dir,
-        )
+                   cache_config=cache_config, init_weights=False,
+                   offload=offload)
+        if offload == "layerwise":
+            # keep the loader's host numpy trees — blocks stream per use
+            pipe.dit_params = dit_params
+            pipe.text_params = text_params
+        else:
+            pipe.dit_params = pipe._place(dit_params, tp=True)
+            pipe.text_params = pipe._place(text_params)
+        pipe.vae_params = pipe._place(vae_params)
         tok_dir = os.path.join(model_dir, "tokenizer")
         if os.path.isdir(tok_dir):
             from transformers import AutoTokenizer
@@ -265,6 +363,8 @@ class QwenImagePipeline:
 
     @functools.cached_property
     def _encode_jit(self):
+        if self.offload == "layerwise":
+            return lambda p, ids: self._stream_encode_hidden(ids)
         # params are an explicit jit ARGUMENT: closure capture would bake
         # them into the executable as constants, so sleep() couldn't free
         # the buffers and weight swaps would silently not apply
@@ -272,68 +372,179 @@ class QwenImagePipeline:
             lambda p, ids: forward_hidden(p, self.cfg.text, ids)
         )
 
+    # ---------------------------------------------- layerwise streaming
+    @functools.cached_property
+    def _text_stream(self):
+        from vllm_omni_tpu.diffusion import offload as ol
+
+        top, layers = ol.split_host_blocks(self.text_params, "layers")
+        return jax.device_put(top), layers
+
+    @functools.cached_property
+    def _dit_stream(self):
+        from vllm_omni_tpu.diffusion import offload as ol
+
+        top, blocks = ol.split_host_blocks(self.dit_params, "blocks")
+        return jax.device_put(top), blocks
+
+    @functools.cached_property
+    def _stream_text_jits(self):
+        from vllm_omni_tpu.models.common import nn as cnn
+        from vllm_omni_tpu.models.common import transformer as tfm
+        from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+        tcfg = self.cfg.text
+
+        @jax.jit
+        def prefix(top, ids):
+            b, s = ids.shape
+            x = cnn.embedding(top["embed"], ids)
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+            cos, sin = tfm._rope_tables(tcfg, positions)
+            return x, cos, sin
+
+        @jax.jit
+        def layer(lp, x, cos, sin):
+            b, s, _ = x.shape
+
+            def attend(q, k, v):
+                return flash_attention(
+                    q.reshape(b, s, tcfg.num_heads, tcfg.head_dim),
+                    k.reshape(b, s, tcfg.num_kv_heads, tcfg.head_dim),
+                    v.reshape(b, s, tcfg.num_kv_heads, tcfg.head_dim),
+                    causal=True,
+                )
+
+            return tfm._layer_step(lp, tcfg, x, cos, sin, attend)
+
+        @jax.jit
+        def suffix(top, x):
+            return rms_norm(x, top["final_norm"]["w"], tcfg.rms_eps)
+
+        return prefix, layer, suffix
+
+    def _stream_encode_hidden(self, ids: jax.Array) -> jax.Array:
+        """Text-encoder forward with layer weights streamed from host —
+        the 7B encoder's 15 GB of bf16 weights never need to be resident
+        at once."""
+        from vllm_omni_tpu.diffusion.offload import BlockStreamer
+
+        prefix, layer, suffix = self._stream_text_jits
+        top, layers = self._text_stream
+        x, cos, sin = prefix(top, jnp.asarray(ids))
+        x = BlockStreamer(layers).run(
+            lambda lp, c: layer(lp, c, cos, sin), x)
+        return suffix(top, x)
+
+    @functools.cached_property
+    def _stream_dit_jits(self):
+        from vllm_omni_tpu.models.common import nn as cnn
+        from vllm_omni_tpu.ops import rms_norm
+
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, static_argnames=("grid_h", "grid_w"))
+        def prefix(top, latents, txt_states, txt_mask, t, grid_h, grid_w):
+            img = cnn.linear(top["img_in"], latents)
+            txt = rms_norm(txt_states, top["txt_norm"]["w"])
+            txt = cnn.linear(top["txt_in"], txt)
+            temb = cnn.timestep_embedding(t, 256)
+            temb = cnn.linear(
+                top["time_in2"],
+                jax.nn.silu(cnn.linear(top["time_in1"],
+                                       temb.astype(img.dtype))))
+            temb_act = jax.nn.silu(temb)
+            img_freqs, txt_freqs = dit.rope_freqs(
+                cfg.dit, grid_h, grid_w, txt_states.shape[1])
+            kv_mask = jnp.concatenate(
+                [txt_mask.astype(jnp.int32),
+                 jnp.ones((img.shape[0], img.shape[1]), jnp.int32)],
+                axis=1,
+            )
+            return img, txt, temb_act, img_freqs, txt_freqs, kv_mask
+
+        @jax.jit
+        def block(blk, img, txt, temb_act, img_freqs, txt_freqs, kv_mask):
+            return dit.block_forward(
+                blk, cfg.dit, img, txt, temb_act, img_freqs, txt_freqs,
+                None, kv_mask)
+
+        @jax.jit
+        def suffix(top, img, temb_act):
+            mod = cnn.linear(top["norm_out_mod"], temb_act)
+            scale, shift = jnp.split(mod, 2, axis=-1)
+            img = (cnn.layernorm({}, img) * (1.0 + scale[:, None, :])
+                   + shift[:, None, :])
+            return cnn.linear(top["proj_out"], img)
+
+        @functools.partial(jax.jit, static_argnames=("do_cfg",))
+        def sched_step(latents, v, sigmas, i, gscale, do_cfg):
+            if do_cfg:
+                v_pos, v_neg = jnp.split(v, 2, axis=0)
+                v = v_neg + gscale * (v_pos - v_neg)
+            dt = sigmas[i + 1] - sigmas[i]
+            return (latents.astype(jnp.float32)
+                    + dt * v.astype(jnp.float32)).astype(latents.dtype)
+
+        return prefix, block, suffix, sched_step
+
+    def _stream_denoise(self, latents, txt_all, mask_all, sigmas,
+                        timesteps, gscale, num_steps, grid_h, grid_w,
+                        do_cfg):
+        """Python-driven denoise loop with DiT block weights streamed
+        from host per step (one jitted executable per piece; the 60-block
+        walk transfers 41 GB/step for the real geometry, overlapped with
+        compute by the BlockStreamer lookahead)."""
+        from vllm_omni_tpu.diffusion.offload import BlockStreamer
+
+        prefix, block, suffix, sched_step = self._stream_dit_jits
+        top, blocks = self._dit_stream
+        streamer = BlockStreamer(blocks)
+        sigmas = jnp.asarray(sigmas)
+        gscale = jnp.float32(gscale)
+        for i in range(int(num_steps)):
+            lat_in = (jnp.concatenate([latents, latents], axis=0)
+                      if do_cfg else latents)
+            t = jnp.broadcast_to(timesteps[i], (lat_in.shape[0],))
+            img, txt_i, temb_act, img_f, txt_f, kv_mask = prefix(
+                top, lat_in, txt_all, mask_all, t,
+                grid_h=grid_h, grid_w=grid_w)
+            img, txt_i = streamer.run(
+                lambda blk, c: block(blk, c[0], c[1], temb_act, img_f,
+                                     txt_f, kv_mask),
+                (img, txt_i))
+            v = suffix(top, img, temb_act)
+            latents = sched_step(latents, v, sigmas, jnp.int32(i), gscale,
+                                 do_cfg=do_cfg)
+        return latents
+
     # ------------------------------------------------------------ denoise
     def _sp_attn_fn(self, n_heads: int, seq_len: int, batch2: int):
         """shard_map-wrapped joint USP attention for the DiT blocks, or
         None when the mesh/shape constraints don't allow the explicit SP
-        path (GSPMD still partitions the dense fallback correctly)."""
-        mesh = self.mesh
-        if mesh is None:
-            return None
-        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
-        sp = ax.get("ring", 1) * ax.get("ulysses", 1)
-        tp = ax.get("tp", 1)
-        if sp == 1 and tp == 1:
-            return None
-        if (seq_len % sp or n_heads % tp
-                or (n_heads // tp) % ax.get("ulysses", 1)
-                or batch2 % (ax.get("cfg", 1) * ax.get("dp", 1))):
-            logger.warning(
-                "mesh %s does not divide (seq=%d, heads=%d, batch=%d); "
-                "falling back to GSPMD-partitioned dense attention",
-                ax, seq_len, n_heads, batch2,
-            )
-            return None
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
-
-        from vllm_omni_tpu.parallel.context import joint_sp_attention
-
-        bspec = ("cfg", "dp")
-        img_spec = P(bspec, ("ring", "ulysses"), "tp", None)
-        txt_spec = P(bspec, None, "tp", None)
-        mask_spec = P(bspec, None)
-        inner = shard_map(
-            functools.partial(
-                joint_sp_attention, ulysses_axis="ulysses", ring_axis="ring"
-            ),
-            mesh=mesh,
-            in_specs=(img_spec,) * 3 + (txt_spec,) * 3 + (mask_spec,),
-            out_specs=(img_spec, txt_spec),
-        )
-
-        def attn_fn(qi, ki, vi, qt, kt, vt, txt_kv_mask):
-            if txt_kv_mask is None:
-                txt_kv_mask = jnp.ones(qt.shape[:2], jnp.int32)
-            img_o, txt_o = inner(qi, ki, vi, qt, kt, vt, txt_kv_mask)
-            # block_forward's attn_fn contract: flattened [B, S, H*D]
-            return (img_o.reshape(*img_o.shape[:2], -1),
-                    txt_o.reshape(*txt_o.shape[:2], -1))
-
-        return attn_fn
+        path (GSPMD still partitions the dense fallback correctly).
+        Shared wiring: parallel/pipeline_mesh.py."""
+        return self.wiring.joint_attn_fn(n_heads, seq_len, batch2)
 
     def _denoise_fn(self, grid_h: int, grid_w: int, sched_len: int,
-                    batch2: int = 0):
+                    batch2: int = 0,
+                    cond_grids: tuple[tuple[int, int], ...] = ()):
         # batch2 affects only the shard_map attn dispatch decision — keep
         # it out of the key on meshless pipelines (jit handles shapes).
-        key = (grid_h, grid_w, sched_len) + (
+        key = (grid_h, grid_w, sched_len, cond_grids) + (
             (batch2,) if self.mesh is not None else ())
         if key in self._denoise_cache:
             return self._denoise_cache[key]
 
         cfg = self.cfg
+        n_cond = sum(ch * cw for ch, cw in cond_grids)
+        if self.wiring.size("pp") > 1:
+            run = self._pp_denoise_fn(grid_h, grid_w, sched_len,
+                                      cond_grids)
+            self._denoise_cache[key] = run
+            return run
         attn_fn = self._sp_attn_fn(
-            cfg.dit.num_heads, grid_h * grid_w, batch2)
+            cfg.dit.num_heads, grid_h * grid_w + n_cond, batch2)
         mesh = self.mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -345,7 +556,7 @@ class QwenImagePipeline:
         @jax.jit
         def run(
             dit_params, latents, txt, txt_mask, neg_txt, neg_mask,
-            sigmas, timesteps, gscale, num_steps,
+            sigmas, timesteps, gscale, num_steps, cond=None,
         ):
             # latents: [B, S_img, C_in]; txt/neg_txt: [B, S_txt, joint];
             # sigmas/timesteps padded to sched_len(+1); num_steps is a
@@ -370,7 +581,13 @@ class QwenImagePipeline:
 
             def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
-                lat_in = jnp.concatenate([lat, lat], 0) if do_cfg else lat
+                s_gen = lat.shape[1]
+                # image edit: VAE-encoded condition tokens extend the
+                # sequence; velocity is read off the generated tokens
+                lat_model = (lat if cond is None
+                             else jnp.concatenate([lat, cond], axis=1))
+                lat_in = (jnp.concatenate([lat_model, lat_model], 0)
+                          if do_cfg else lat_model)
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
                 if mesh is not None:
                     lat_in = jax.lax.with_sharding_constraint(
@@ -378,7 +595,8 @@ class QwenImagePipeline:
                 v = dit.forward(
                     dit_params, cfg.dit, lat_in, txt_all, t_in,
                     (grid_h, grid_w), attn_fn=attn_fn, txt_mask=mask_all,
-                )
+                    cond_grids=cond_grids,
+                )[:, :s_gen]
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)
                     v = v_neg + gscale * (v_pos - v_neg)
@@ -466,21 +684,38 @@ class QwenImagePipeline:
         timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
             schedule.timesteps
         )
-        run = self._denoise_fn(
-            grid_h, grid_w, sched_len, batch2=(2 * b if do_cfg else b))
-        latents, skipped_steps = run(
-            self.dit_params,
-            noise,
-            txt,
-            txt_mask,
-            neg_txt,
-            neg_mask,
-            sigmas,
-            timesteps,
-            jnp.float32(sp.guidance_scale),
-            jnp.int32(num_steps),
-        )
-        self.last_skipped_steps = int(skipped_steps)
+        cond_tokens, cond_grids = self._edit_cond(req, b)
+        if self.offload == "layerwise":
+            if cond_tokens is not None:
+                raise InvalidRequestError(
+                    "image-edit conditioning is not supported with "
+                    "layerwise offload yet")
+            txt_all = (jnp.concatenate([txt, neg_txt], axis=0)
+                       if do_cfg else txt)
+            mask_all = (jnp.concatenate([txt_mask, neg_mask], axis=0)
+                        if do_cfg else txt_mask)
+            latents = self._stream_denoise(
+                noise, txt_all, mask_all, sigmas, timesteps,
+                sp.guidance_scale, num_steps, grid_h, grid_w, do_cfg)
+            self.last_skipped_steps = 0
+        else:
+            run = self._denoise_fn(
+                grid_h, grid_w, sched_len, batch2=(2 * b if do_cfg else b),
+                cond_grids=cond_grids)
+            latents, skipped_steps = run(
+                self.dit_params,
+                noise,
+                txt,
+                txt_mask,
+                neg_txt,
+                neg_mask,
+                sigmas,
+                timesteps,
+                jnp.float32(sp.guidance_scale),
+                jnp.int32(num_steps),
+                cond=cond_tokens,
+            )
+            self.last_skipped_steps = int(skipped_steps)
 
         images = self._decode_latents(latents, grid_h, grid_w)
         images = np.asarray(images)
@@ -499,6 +734,97 @@ class QwenImagePipeline:
             )
         return outs
 
+    def _pp_denoise_fn(self, grid_h: int, grid_w: int, sched_len: int,
+                       cond_grids: tuple = ()):
+        """Denoise with the block stack pipelined over the ``pp`` axis
+        (GPipe microbatches, parallel/pp.py): per-rank weight memory
+        drops to L/pp blocks; the CFG-doubled batch supplies the
+        microbatches."""
+        from jax.sharding import PartitionSpec as P
+
+        from vllm_omni_tpu.parallel import pp as pp_mod
+
+        cfg = self.cfg
+        mesh = self.mesh
+        pp = self.wiring.size("pp")
+
+        from jax import shard_map
+
+        @jax.jit
+        def run(dit_params, latents, txt, txt_mask, neg_txt, neg_mask,
+                sigmas, timesteps, gscale, num_steps, cond=None):
+            schedule = fm.FlowMatchSchedule(sigmas=sigmas,
+                                            timesteps=timesteps)
+            do_cfg = neg_txt is not None
+            txt_all = (jnp.concatenate([txt, neg_txt], axis=0)
+                       if do_cfg else txt)
+            mask_all = (jnp.concatenate([txt_mask, neg_mask], axis=0)
+                        if do_cfg else txt_mask)
+            blocks = dit_params["blocks_stacked"]
+
+            def eval_velocity(lat, i):
+                t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
+                s_gen = lat.shape[1]
+                lat_model = (lat if cond is None
+                             else jnp.concatenate([lat, cond], axis=1))
+                lat_in = (jnp.concatenate([lat_model, lat_model], 0)
+                          if do_cfg else lat_model)
+                t_in = jnp.concatenate([t, t], 0) if do_cfg else t
+                img, txt_i, temb_act, img_f, txt_f, kv_mask = \
+                    dit.forward_prefix(
+                        dit_params, cfg.dit, lat_in, txt_all, t_in,
+                        (grid_h, grid_w), txt_mask=mask_all,
+                        cond_grids=cond_grids)
+                b2 = img.shape[0]
+                if b2 % pp:
+                    raise ValueError(
+                        f"(cfg-doubled) batch {b2} must divide pp={pp}")
+
+                # freqs are batch-free trace constants; only batched
+                # activations ride the microbatch carry
+                def scan_blocks(local_blocks, carry):
+                    im, tx, temb_c, kvm = carry
+
+                    def body(c, blk):
+                        i_, t_ = c
+                        i_, t_ = dit.block_forward(
+                            blk, cfg.dit, i_, t_, temb_c, img_f, txt_f,
+                            None, kvm)
+                        return (i_, t_), None
+
+                    (im, tx), _ = jax.lax.scan(body, (im, tx),
+                                               local_blocks)
+                    return (im, tx, temb_c, kvm)
+
+                sm = shard_map(
+                    functools.partial(pp_mod.pipeline_apply,
+                                      scan_fn=scan_blocks),
+                    mesh=mesh,
+                    in_specs=(pp_mod.pp_block_specs(blocks), P()),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+                mb = pp_mod.microbatch(
+                    (img, txt_i, temb_act, kv_mask), pp)
+                img = pp_mod.unmicrobatch(sm(blocks, mb))[0]
+                v = dit.forward_suffix(dit_params, img, temb_act)[:, :s_gen]
+                if do_cfg:
+                    v_pos, v_neg = jnp.split(v, 2, axis=0)
+                    v = v_neg + gscale * (v_pos - v_neg)
+                return v
+
+            return step_cache.run_denoise_loop(
+                self.cache_config, schedule, eval_velocity, latents,
+                num_steps, solver=self.cfg.scheduler,
+            )
+
+        return run
+
+    def _edit_cond(self, req, batch: int):
+        """(cond_tokens [B, S_cond, in_channels] | None, cond_grids) —
+        edit pipelines override to VAE-encode input images."""
+        return None, ()
+
     @functools.cached_property
     def _decode_jit(self):
         @functools.partial(jax.jit, static_argnames=("grid_h", "grid_w"))
@@ -507,13 +833,14 @@ class QwenImagePipeline:
             patch = cfg.dit.patch_size
             b = latents.shape[0]
             # unpack [B, gh*gw, p*p*C] -> [B, gh*p, gw*p, C]
-            c = cfg.vae.latent_channels
+            c = cfg.vae.z_channels
             x = latents.reshape(b, grid_h, grid_w, patch, patch, c)
             x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
                 b, grid_h * patch, grid_w * patch, c
             )
-            img = vae_mod.decode(vae_params, cfg.vae, x)
-            img = jnp.clip((img.astype(jnp.float32) + 1.0) * 127.5, 0, 255)
+            img = vae_mod.decode_image(
+                vae_params, cfg.vae, x.astype(jnp.float32))
+            img = jnp.clip((img + 1.0) * 127.5, 0, 255)
             return img.astype(jnp.uint8)
 
         return dec
@@ -522,3 +849,16 @@ class QwenImagePipeline:
         # DiT out_channels == vae latent channels; proj_out emits
         # patch^2 * C which equals in_channels when packing matches.
         return self._decode_jit(self.vae_params, latents, grid_h, grid_w)
+
+    def _encode_image_latents(self, images: jax.Array) -> jax.Array:
+        """[B, H, W, 3] in [-1, 1] -> packed [B, gh*gw, p*p*z] latents
+        (inverse of the decode unpack) — used by edit pipelines."""
+        cfg = self.cfg
+        patch = cfg.dit.patch_size
+        lat = vae_mod.encode_image(
+            self.vae_params, cfg.vae, images.astype(jnp.float32))
+        b, h, w, c = lat.shape
+        gh, gw = h // patch, w // patch
+        x = lat.reshape(b, gh, patch, gw, patch, c)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, gh * gw, patch * patch * c).astype(self.dtype)

@@ -110,10 +110,17 @@ def rope_freqs(
     grid_w: int,
     txt_len: int,
     frames: int = 1,
+    cond_grids: tuple[tuple[int, int], ...] = (),
 ):
     """3-axis rotary frequencies for the image grid + continued positions
     for the text stream (reference QwenEmbedRope, scale_rope=True: row/col
-    coordinates are centered)."""
+    coordinates are centered).
+
+    ``cond_grids``: (gh, gw) per VAE-encoded condition image appended to
+    the token sequence (image edit).  Condition tokens share the centered
+    row/col layout; their frame coordinate is the entry index, except the
+    LAST condition which sits at frame -1 (reference
+    _compute_condition_freqs, qwen_image_transformer.py:279-297)."""
     half_dims = [d // 2 for d in cfg.axes_dims]  # complex pairs per axis
 
     def axis_freqs(pos, half):
@@ -122,20 +129,32 @@ def rope_freqs(
         )
         return pos.astype(jnp.float32)[:, None] * inv[None, :]
 
-    f = jnp.arange(frames).repeat(grid_h * grid_w)
-    r = jnp.tile(jnp.arange(grid_h).repeat(grid_w), frames) - grid_h // 2
-    c = jnp.tile(jnp.arange(grid_w), frames * grid_h) - grid_w // 2
-    img_angles = jnp.concatenate(
-        [
-            axis_freqs(f, half_dims[0]),
-            axis_freqs(r, half_dims[1]),
-            axis_freqs(c, half_dims[2]),
-        ],
-        axis=-1,
-    )  # [S_img, head_dim//2]
+    def grid_angles(gh, gw, frame_coord, n_frames=1):
+        f = jnp.full((n_frames,), frame_coord).repeat(gh * gw) if \
+            n_frames == 1 else jnp.arange(n_frames).repeat(gh * gw)
+        r = jnp.tile(jnp.arange(gh).repeat(gw), n_frames) - gh // 2
+        c = jnp.tile(jnp.arange(gw), n_frames * gh) - gw // 2
+        return jnp.concatenate(
+            [
+                axis_freqs(f, half_dims[0]),
+                axis_freqs(r, half_dims[1]),
+                axis_freqs(c, half_dims[2]),
+            ],
+            axis=-1,
+        )  # [S, head_dim//2]
+
+    parts = [grid_angles(grid_h, grid_w, 0, n_frames=frames)]
+    for j, (ch, cw) in enumerate(cond_grids):
+        frame_coord = -1 if j == len(cond_grids) - 1 else j + 1
+        parts.append(grid_angles(ch, cw, frame_coord))
+    img_angles = jnp.concatenate(parts, axis=0)
+
     # Text positions continue beyond the image extent on every axis.
-    off = max(grid_h // 2, grid_w // 2) + 1
-    tpos = jnp.arange(txt_len) + off
+    extent = max(
+        [grid_h // 2, grid_w // 2, len(cond_grids)]
+        + [max(ch // 2, cw // 2) for ch, cw in cond_grids]
+    )
+    tpos = jnp.arange(txt_len) + extent + 1
     txt_angles = jnp.concatenate(
         [axis_freqs(tpos, h) for h in half_dims], axis=-1
     )
@@ -247,8 +266,39 @@ def forward(
     grid_hw: tuple[int, int],
     attn_fn=None,
     txt_mask: Optional[jax.Array] = None,  # [B, S_txt] 1=real, 0=pad
+    cond_grids: tuple[tuple[int, int], ...] = (),
 ) -> jax.Array:
-    """Returns velocity prediction [B, S_img, patch^2 * out_channels]."""
+    """Returns velocity prediction [B, S_img, patch^2 * out_channels].
+
+    ``cond_grids``: grids of VAE-encoded condition images appended to
+    ``img_tokens`` after the generated grid (image edit) — the caller
+    slices the velocity back to the generated tokens."""
+    img, txt, temb_act, img_freqs, txt_freqs, kv_mask = forward_prefix(
+        params, cfg, img_tokens, txt_states, timesteps, grid_hw,
+        txt_mask=txt_mask, cond_grids=cond_grids,
+    )
+    for blk in params["blocks"]:
+        img, txt = block_forward(
+            blk, cfg, img, txt, temb_act, img_freqs, txt_freqs, attn_fn,
+            kv_mask,
+        )
+    return forward_suffix(params, img, temb_act)
+
+
+def forward_prefix(
+    params,
+    cfg: QwenImageDiTConfig,
+    img_tokens: jax.Array,
+    txt_states: jax.Array,
+    timesteps: jax.Array,
+    grid_hw: tuple[int, int],
+    txt_mask: Optional[jax.Array] = None,
+    cond_grids: tuple[tuple[int, int], ...] = (),
+):
+    """Everything before the block stack: embeds, time conditioning,
+    rope tables, joint KV mask.  Split out so block-streaming
+    (diffusion/offload.py) and pipeline parallelism (parallel/pp.py) can
+    schedule the stack themselves."""
     img = nn.linear(params["img_in"], img_tokens)
     txt = rms_norm(txt_states, params["txt_norm"]["w"])
     txt = nn.linear(params["txt_in"], txt)
@@ -261,7 +311,8 @@ def forward(
     temb_act = jax.nn.silu(temb)
 
     img_freqs, txt_freqs = rope_freqs(
-        cfg, grid_hw[0], grid_hw[1], txt_states.shape[1]
+        cfg, grid_hw[0], grid_hw[1], txt_states.shape[1],
+        cond_grids=cond_grids,
     )
 
     # Joint-attention KV mask: padded text tokens must not receive
@@ -274,14 +325,11 @@ def forward(
             [txt_mask.astype(jnp.int32), jnp.ones((b, s_img), jnp.int32)],
             axis=1,
         )
+    return img, txt, temb_act, img_freqs, txt_freqs, kv_mask
 
-    for blk in params["blocks"]:
-        img, txt = block_forward(
-            blk, cfg, img, txt, temb_act, img_freqs, txt_freqs, attn_fn,
-            kv_mask,
-        )
 
-    # AdaLayerNormContinuous output head.
+def forward_suffix(params, img: jax.Array, temb_act: jax.Array):
+    """AdaLayerNormContinuous output head."""
     mod = nn.linear(params["norm_out_mod"], temb_act)
     scale, shift = jnp.split(mod, 2, axis=-1)
     img = nn.layernorm({}, img) * (1.0 + scale[:, None, :]) + shift[:, None, :]
