@@ -1,0 +1,81 @@
+"""AR-engine tensor parallelism: the TP-sharded engine must be
+token-identical to the single-device engine (reference:
+tensor_parallel_size in model_executor/stage_configs/qwen3_omni_moe.yaml:27).
+
+Runs on the virtual 8-device CPU mesh from tests/conftest.py."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(num_pages=64, page_size=4, max_model_len=128,
+                    max_num_seqs=4, dtype=jnp.float32)
+    defaults.update(kw)
+    return LLMEngine(params, cfg, EngineConfig(**defaults))
+
+
+def _greedy(eng, prompts, n):
+    outs = eng.generate([list(p) for p in prompts],
+                        SamplingParams(temperature=0.0, max_tokens=n))
+    return [o.outputs[0].token_ids for o in outs]
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return params, cfg
+
+
+PROMPTS = [[3, 1, 4], [1, 5, 9, 2, 6, 5], [10], [8, 8, 8, 8]]
+
+
+def test_tp_greedy_token_identical(tiny_model):
+    params, cfg = tiny_model
+    want = _greedy(_engine(params, cfg), PROMPTS, 6)
+    got = _greedy(_engine(params, cfg, tensor_parallel_size=2), PROMPTS, 6)
+    assert got == want
+
+
+def test_tp4_greedy_token_identical(tiny_model):
+    """tp=4 shards every head singly (kv heads 2 won't divide -> must
+    raise); heads=4/kv=2 admits tp=2 only — so build a 4-kv-head config
+    for the tp=4 leg."""
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=4, head_dim=16, intermediate_size=128)
+    params = tfm.init_params(jax.random.PRNGKey(1), cfg, jnp.float32)
+    want = _greedy(_engine(params, cfg), PROMPTS, 5)
+    got = _greedy(_engine(params, cfg, tensor_parallel_size=4), PROMPTS, 5)
+    assert got == want
+
+
+def test_tp_chunked_prefill_token_identical(tiny_model):
+    params, cfg = tiny_model
+    long_prompt = [(i * 7) % 60 + 1 for i in range(40)]
+    kw = dict(enable_chunked_prefill=True, max_num_batched_tokens=16)
+    want = _greedy(_engine(params, cfg, **kw), [long_prompt], 5)
+    got = _greedy(_engine(params, cfg, tensor_parallel_size=2, **kw),
+                  [long_prompt], 5)
+    assert got == want
+
+
+def test_tp_moe_token_identical():
+    cfg = tfm.TransformerConfig.tiny_moe(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg, jnp.float32)
+    want = _greedy(_engine(params, cfg), PROMPTS[:2], 5)
+    got = _greedy(_engine(params, cfg, tensor_parallel_size=2),
+                  PROMPTS[:2], 5)
+    assert got == want
+
+
+def test_tp_indivisible_heads_raises(tiny_model):
+    params, cfg = tiny_model  # num_kv_heads=2
+    with pytest.raises(ValueError, match="must divide"):
+        _engine(params, cfg, tensor_parallel_size=4)

@@ -97,8 +97,72 @@ def test_spec_decode_oracle_head_accepts_and_saves_steps():
     assert stats["verify_steps"] <= 4
 
 
-def test_spec_decode_sampled_requests_fall_back():
-    """temperature > 0 requests never get drafts; mixed batches work."""
+def test_spec_decode_sampled_rejection_acceptance():
+    """temperature > 0 requests verify by rejection sampling (reference:
+    gpu_ar_model_runner.py:466-497) — with the oracle (greedy-exact)
+    draft head the measured acceptance at temperature 0.9 is nonzero,
+    and seeded runs are deterministic."""
+    cfg = tfm.TransformerConfig.tiny()
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg, jnp.float32)
+    k = 3
+    prompts = [[1, 2, 3], [4, 5, 6]]
+    sp = SamplingParams(temperature=0.9, max_tokens=12, seed=11)
+
+    def run():
+        eng = _mk(params, cfg, draft_fn=OracleDraft(params, cfg, k), k=k)
+        toks = _gen(eng, prompts, sp)
+        return toks, dict(eng.runner.spec_stats)
+
+    got, stats = run()
+    got2, _ = run()
+    assert got == got2  # seeded determinism through the spec path
+    assert stats["proposed"] > 0
+    assert stats["accepted"] > 0  # nonzero acceptance at T=0.9
+    for t in got:
+        assert len(t) == 12
+
+
+def test_rejection_accept_preserves_target_distribution():
+    """The emitted first token of the rejection-verify must be EXACTLY
+    p-distributed (p = temperature/top-k/top-p filtered target): accept
+    draft d w.p. p(d), else draw from p \\ {d} renormalized.  Empirical
+    check over many deterministic (request, step) streams."""
+    import types
+
+    from vllm_omni_tpu.worker.model_runner import ARModelRunner
+
+    from vllm_omni_tpu.sample.sampler import filtered_probs
+
+    vocab = 16
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((4, vocab)) * 2.0,
+                         jnp.float32)
+    temp = 0.9
+    sp = SamplingParams(temperature=temp, max_tokens=4)
+    p_target = np.asarray(jax.nn.softmax(
+        np.asarray(logits[0], np.float64) / temp))
+    draft = int(np.argmax(p_target))  # the greedy draft proposal
+    probs = np.asarray(filtered_probs(
+        logits, jnp.full((4,), temp), jnp.full((4,), sp.top_k, jnp.int32),
+        jnp.full((4,), sp.top_p)))
+
+    counts = np.zeros(vocab)
+    n = 4000
+    dummy = types.SimpleNamespace(_base_seed=123, _step=0)
+    req = types.SimpleNamespace(request_id="", sampling_params=sp)
+    for i in range(n):
+        req.request_id = f"r{i}"
+        acc = ARModelRunner._rejection_accept(
+            dummy, req, probs, [draft, draft, draft])
+        counts[acc[0]] += 1
+    emp = counts / n
+    tv = 0.5 * np.abs(emp - p_target).sum()
+    assert tv < 0.1, (tv, emp, p_target)
+
+
+def test_spec_decode_mixed_batch_greedy_unperturbed():
+    """Greedy requests in a mixed batch stay token-identical to plain
+    decoding even when sampled requests ride the rejection path."""
     cfg = tfm.TransformerConfig.tiny()
     params = tfm.init_params(jax.random.PRNGKey(2), cfg, jnp.float32)
     draft_fn = mtp.tiny_factory(params, cfg, 2)
@@ -106,14 +170,11 @@ def test_spec_decode_sampled_requests_fall_back():
     sps = [SamplingParams(temperature=0.0, max_tokens=6),
            SamplingParams(temperature=0.8, max_tokens=6, seed=7)]
 
-    want = [
-        _gen(_mk(params, cfg), [prompts[0]], sps[0])[0],
-        _gen(_mk(params, cfg), [prompts[1]], sps[1])[0],
-    ]
+    want0 = _gen(_mk(params, cfg), [prompts[0]], sps[0])[0]
     eng = _mk(params, cfg, draft_fn=draft_fn, k=2)
     outs = eng.generate(prompts, sps)
-    got = [o.outputs[0].token_ids for o in outs]
-    assert got == want
+    assert outs[0].outputs[0].token_ids == want0
+    assert len(outs[1].outputs[0].token_ids) == 6
 
 
 def test_spec_decode_hidden_chunks_align_with_tokens():

@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+import jax
 import jax.numpy as jnp
 
 from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
@@ -53,6 +54,9 @@ class EngineConfig:
     kv_transfer: Optional[KVTransferConfig] = None
     collect_hidden: bool = False
     seed: Optional[int] = None  # pins sampling entropy for reproducibility
+    # tensor parallelism over the first N devices (reference:
+    # tensor_parallel_size, stage_configs/qwen3_omni_moe.yaml:27)
+    tensor_parallel_size: int = 1
 
 
 class LLMEngine:
@@ -93,12 +97,26 @@ class LLMEngine:
                 max_model_len=config.max_model_len,
             )
         else:
+            mesh = None
+            if config.tensor_parallel_size > 1:
+                import numpy as _np
+                from jax.sharding import Mesh
+
+                from vllm_omni_tpu.parallel.mesh import AXIS_TP
+
+                devs = jax.devices()
+                tp = config.tensor_parallel_size
+                if len(devs) < tp:
+                    raise ValueError(
+                        f"tensor_parallel_size={tp} but only "
+                        f"{len(devs)} devices visible")
+                mesh = Mesh(_np.array(devs[:tp]), (AXIS_TP,))
             self.runner = ARModelRunner(
                 params, model_cfg,
                 num_pages=config.num_pages, page_size=config.page_size,
                 max_model_len=config.max_model_len, dtype=config.dtype,
                 collect_hidden=config.collect_hidden, seed=config.seed,
-                max_num_seqs=config.max_num_seqs,
+                max_num_seqs=config.max_num_seqs, mesh=mesh,
             )
         if (draft_fn is not None and config.num_speculative_tokens > 0
                 and hasattr(self.runner, "set_draft_fn")):

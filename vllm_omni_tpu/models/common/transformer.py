@@ -60,6 +60,14 @@ class TransformerConfig:
     # when ops.moe.set_ep_mesh was called) | "dense" (oracle: all experts
     # compute all tokens)
     moe_dispatch: str = "routed"
+    # Tensor parallelism (Megatron col/row sharding over a mesh axis).
+    # When set, the forward runs INSIDE shard_map over this axis with
+    # per-shard weights (heads and MLP columns divided): psum after
+    # o_proj/down restores full activations, all_gather reassembles
+    # vocab-sharded logits.  None => single-shard semantics, no
+    # collectives (reference: tensor_parallel_size in stage YAML,
+    # model_executor/stage_configs/qwen3_omni_moe.yaml:27).
+    tp_axis: Optional[str] = None
 
     @staticmethod
     def tiny(vocab_size: int = 128) -> "TransformerConfig":
@@ -149,11 +157,13 @@ def init_params(key, cfg: TransformerConfig, dtype=jnp.float32):
 
 
 def _qkv(layer, cfg: TransformerConfig, x):
-    """x: [T, hidden] -> q [T, H, D], k/v [T, Hkv, D] with RoPE-ready layout."""
+    """x: [T, hidden] -> q [T, H, D], k/v [T, Hkv, D] with RoPE-ready
+    layout.  Head counts derive from the weights, not the config: under
+    tensor parallelism each shard carries num_heads/tp heads."""
     t = x.shape[0]
-    q = nn.linear(layer["q_proj"], x).reshape(t, cfg.num_heads, cfg.head_dim)
-    k = nn.linear(layer["k_proj"], x).reshape(t, cfg.num_kv_heads, cfg.head_dim)
-    v = nn.linear(layer["v_proj"], x).reshape(t, cfg.num_kv_heads, cfg.head_dim)
+    q = nn.linear(layer["q_proj"], x).reshape(t, -1, cfg.head_dim)
+    k = nn.linear(layer["k_proj"], x).reshape(t, -1, cfg.head_dim)
+    v = nn.linear(layer["v_proj"], x).reshape(t, -1, cfg.head_dim)
     if cfg.qk_norm:
         q = rms_norm(q, layer["q_norm"]["w"], cfg.rms_eps)
         k = rms_norm(k, layer["k_norm"]["w"], cfg.rms_eps)
@@ -195,7 +205,10 @@ def _moe_mlp(layer, cfg: TransformerConfig, x):
 
         mesh = moe_ops.ep_mesh()
         if mesh is not None:
-            out = moe_ops.routed_moe_ep(
+            ep_fn = (moe_ops.routed_moe_ep_a2a
+                     if cfg.moe_dispatch == "a2a"
+                     else moe_ops.routed_moe_ep)
+            out = ep_fn(
                 x, layer["router"]["w"], layer["experts"]["gate_up"],
                 layer["experts"]["down"], cfg.num_experts_per_tok, mesh,
             )
@@ -270,9 +283,16 @@ def _layer_step(layer, cfg: TransformerConfig, x, cos, sin, attend):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     o = attend(q, k, v)
-    x = x + o.reshape(*b, -1) @ layer["o_proj"]["w"]
+    o = o.reshape(*b, -1) @ layer["o_proj"]["w"]
+    if cfg.tp_axis is not None:
+        # row-parallel o_proj: each shard holds a partial sum
+        o = jax.lax.psum(o, cfg.tp_axis)
+    x = x + o
     h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
-    return x + _mlp(layer, cfg, h)
+    y = _mlp(layer, cfg, h)
+    if cfg.tp_axis is not None:
+        y = jax.lax.psum(y, cfg.tp_axis)
+    return x + y
 
 
 def forward_hidden(
@@ -293,9 +313,9 @@ def forward_hidden(
 
     def attend(q, k, v):
         return flash_attention(
-            q.reshape(b, s, cfg.num_heads, cfg.head_dim),
-            k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
-            v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+            q.reshape(b, s, -1, cfg.head_dim),
+            k.reshape(b, s, -1, cfg.head_dim),
+            v.reshape(b, s, -1, cfg.head_dim),
             causal=True,
         )
 
@@ -306,8 +326,14 @@ def forward_hidden(
 
 def logits_from_hidden(params, cfg: TransformerConfig, hidden: jax.Array):
     if cfg.tie_word_embeddings:
+        # embed table is replicated under TP — logits already full
         return hidden @ params["embed"]["w"].T
-    return nn.linear(params["lm_head"], hidden)
+    logits = nn.linear(params["lm_head"], hidden)
+    if cfg.tp_axis is not None:
+        # column-parallel lm_head: reassemble the vocab axis
+        logits = jax.lax.all_gather(
+            logits, cfg.tp_axis, axis=logits.ndim - 1, tiled=True)
+    return logits
 
 
 def forward_prefill(
@@ -337,9 +363,9 @@ def forward_prefill(
             )
             new_caches.append((k_cache, v_cache))
             return flash_attention(
-                q.reshape(b, s, cfg.num_heads, cfg.head_dim),
-                k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
-                v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim),
+                q.reshape(b, s, -1, cfg.head_dim),
+                k.reshape(b, s, -1, cfg.head_dim),
+                v.reshape(b, s, -1, cfg.head_dim),
                 causal=True,
             )
 
@@ -396,7 +422,7 @@ def forward_prefill_chunked(
                 v_cache[:, block_tables], (1, 2, 3, 0, 4)
             ).reshape(b, ctx_width, hkv, d)
             return flash_attention(
-                q.reshape(b, s, cfg.num_heads, cfg.head_dim), kg, vg,
+                q.reshape(b, s, -1, cfg.head_dim), kg, vg,
                 causal=True, kv_mask=kv_mask, q_offsets=q_starts,
             )
 

@@ -131,6 +131,89 @@ def shard_dit_params(params, mesh: Mesh):
     return place(params)
 
 
+# --------------------------------------------------------------- AR TP
+# Megatron col/row layout for the AR transformer (models/common/
+# transformer.py param tree); reference: tensor_parallel_size in the
+# stage YAML (model_executor/stage_configs/qwen3_omni_moe.yaml:27).
+AR_TP_COL = frozenset({"q_proj", "k_proj", "v_proj", "gate_up", "lm_head"})
+AR_TP_ROW = frozenset({"o_proj", "down"})
+
+
+def ar_param_spec(path: tuple[str, ...]) -> P:
+    """PartitionSpec for one AR-transformer leaf by tree path.  Columns
+    (head/MLP output dims) over tp for q/k/v/gate_up/lm_head; rows for
+    o_proj/down; MoE expert ffn dims likewise; the rest replicates
+    (embed table included — vocab stays whole for the gather-free embed
+    lookup)."""
+    leaf = path[-1] if path else ""
+    parent = path[-2] if len(path) >= 2 else ""
+    if parent in AR_TP_COL and leaf in ("w", "b"):
+        return P(None, AXIS_TP) if leaf == "w" else P(AXIS_TP)
+    if parent in AR_TP_ROW and leaf == "w":
+        return P(AXIS_TP, None)
+    if parent == "experts":
+        if leaf == "gate_up":
+            return P(None, None, AXIS_TP)
+        if leaf == "down":
+            return P(None, AXIS_TP, None)
+    return P()
+
+
+def _interleave_gate_up(w, tp: int):
+    """Re-order fused [*, 2I] gate_up columns so a contiguous 1/tp column
+    shard holds [gate_j ; up_j] — silu_mul's local halves then line up
+    with the matching down-row shard."""
+    *lead, two_i = w.shape
+    i = two_i // 2
+    if i % tp:
+        raise ValueError(f"intermediate size {i} not divisible by tp={tp}")
+    w = w.reshape(*lead, 2, tp, i // tp)
+    w = jnp.swapaxes(w, -3, -2)  # [*, tp, 2, I/tp]
+    return w.reshape(*lead, two_i)
+
+
+def ar_param_specs_tree(params):
+    """Spec pytree matching ``params``' structure (for shard_map
+    in_specs)."""
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [walk(v, path + (str(i),)) for i, v in enumerate(tree)]
+        return ar_param_spec(path)
+
+    return walk(params)
+
+
+def shard_ar_params(params, mesh: Mesh):
+    """Place an AR param tree on the mesh in the TP layout (and interleave
+    fused gate_up columns so local shards stay [gate_j ; up_j])."""
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_TP, 1)
+
+    def place(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: place(v, path + (k,)) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [place(v, path + (str(i),)) for i, v in enumerate(tree)]
+        leaf = path[-1] if path else ""
+        parent = path[-2] if len(path) >= 2 else ""
+        arr = tree
+        if tp > 1 and ((parent == "experts" and leaf == "gate_up")
+                       or (leaf == "w" and parent == "gate_up")):
+            arr = _interleave_gate_up(jnp.asarray(arr), tp)
+        return jax.device_put(
+            arr, NamedSharding(mesh, ar_param_spec(path)))
+
+    return place(params)
+
+
+def ar_kv_cache_spec() -> tuple[P, P]:
+    """Paged KV caches [Hkv, pages, page_size, D]: KV heads over tp."""
+    spec = P(AXIS_TP, None, None, None)
+    return (spec, spec)
+
+
 def shard_moe_params(params, mesh: Mesh):
     """Place a transformer param tree with MoE expert weights sharded over
     the ``ep`` mesh axis (stacked leading-E axis) and everything else

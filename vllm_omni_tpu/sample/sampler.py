@@ -126,3 +126,27 @@ def sample_tokens(
 
     sampled_ids = jax.vmap(draw)(keys, scaled).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
+
+
+@jax.jit
+def filtered_probs(
+    logits: jax.Array,       # [B, vocab]
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,        # [B]
+    top_p: jax.Array,        # [B]
+) -> jax.Array:
+    """Per-row TARGET distribution under the request's sampling params
+    (temperature + top-k/top-p filtering); temperature 0 rows become a
+    one-hot at the argmax.  The spec-decode verify step scores draft
+    tokens against exactly the distribution ``sample_tokens`` would draw
+    from (reference: rejection sampling in the verify path,
+    gpu_ar_model_runner.py:466-497)."""
+    logits = logits.astype(jnp.float32)
+    vocab = logits.shape[-1]
+    greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1), vocab)
+    safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
+    scaled = logits / safe_t[:, None]
+    scaled = _mask_top_k(scaled, top_k)
+    scaled = _mask_top_p(scaled, top_p)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    return jnp.where((temperature <= 0.0)[:, None], greedy, probs)

@@ -19,6 +19,7 @@ Responsibilities (mirroring :90-396 / :398-588):
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import secrets
 import zlib
@@ -77,7 +78,26 @@ class ARModelRunner:
         collect_hidden: bool = False,
         seed: Optional[int] = None,
         max_num_seqs: int = 64,
+        mesh=None,  # 1-axis "tp" Mesh => tensor-parallel execution
     ):
+        self.mesh = mesh
+        if mesh is not None:
+            # Megatron-style TP inside shard_map: heads and MLP columns
+            # divide across the tp axis; the per-layer code runs on LOCAL
+            # shapes and cfg.tp_axis inserts the psum/all_gather
+            # collectives (reference: tensor_parallel_size,
+            # stage_configs/qwen3_omni_moe.yaml:27).
+            from vllm_omni_tpu.parallel.mesh import AXIS_TP
+            from vllm_omni_tpu.parallel.sharding import shard_ar_params
+
+            tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+                AXIS_TP, 1)
+            if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide num_heads={cfg.num_heads} and "
+                    f"num_kv_heads={cfg.num_kv_heads}")
+            cfg = dataclasses.replace(cfg, tp_axis=AXIS_TP)
+            params = shard_ar_params(params, mesh)
         self.params = params
         self.cfg = cfg
         self.params_dtype = jax.tree_util.tree_leaves(params)[0].dtype
@@ -92,6 +112,17 @@ class ARModelRunner:
             cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
             cfg.head_dim, dtype,
         )
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from vllm_omni_tpu.parallel.sharding import ar_kv_cache_spec
+
+            k_spec, v_spec = ar_kv_cache_spec()
+            self.kv_caches = [
+                (jax.device_put(k, NamedSharding(mesh, k_spec)),
+                 jax.device_put(v, NamedSharding(mesh, v_spec)))
+                for k, v in self.kv_caches
+            ]
         self._step = 0
         # engine-level entropy for unseeded requests (fresh per process
         # unless a seed is pinned for reproducibility)
@@ -106,7 +137,6 @@ class ARModelRunner:
         # CUDA cache writes.
         # one closure serves both paths: inputs_embeds=None and =array are
         # two jit specializations of the same function
-        @functools.partial(jax.jit, donate_argnums=(2,))
         def _prefill(params, token_ids, kv_caches, positions, slot_mapping,
                      last_idx, inputs_embeds=None, embeds_mask=None):
             hidden, new_caches = tfm.forward_prefill(
@@ -118,7 +148,6 @@ class ARModelRunner:
             logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
             return logits, last_hidden, hidden, new_caches
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
         def _chunk_prefill(params, token_ids, kv_caches, positions,
                            slot_mapping, last_idx, block_tables,
                            context_lens, q_starts, inputs_embeds=None,
@@ -133,7 +162,6 @@ class ARModelRunner:
             logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
             return logits, last_hidden, hidden, new_caches
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
         def _verify(params, token_ids, kv_caches, positions, slot_mapping,
                     block_tables, context_lens, q_starts):
             # spec-decode verify: logits at EVERY candidate position
@@ -146,7 +174,6 @@ class ARModelRunner:
             logits = tfm.logits_from_hidden(params, cfg_, hidden)
             return logits, hidden, new_caches
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
         def _decode(params, token_ids, kv_caches, positions, slot_mapping,
                     block_tables, context_lens):
             hidden, new_caches = tfm.forward_decode(
@@ -156,10 +183,43 @@ class ARModelRunner:
             logits = tfm.logits_from_hidden(params, cfg_, hidden)
             return logits, hidden, new_caches
 
-        self._prefill_fn = _prefill
-        self._chunk_prefill_fn = _chunk_prefill
-        self._verify_fn = _verify
-        self._decode_fn = _decode
+        if mesh is None:
+            jit2 = functools.partial(jax.jit, donate_argnums=(2,))
+            self._prefill_fn = jit2(_prefill)
+            self._chunk_prefill_fn = jit2(_chunk_prefill)
+            self._verify_fn = jit2(_verify)
+            self._decode_fn = jit2(_decode)
+        else:
+            # TP: shard_map over the tp axis — params/KV are the only
+            # sharded operands; token inputs replicate, and the psums in
+            # _layer_step make activations (logits/hidden) replicated
+            # outputs. shard_map (not GSPMD) because the Pallas attention
+            # kernels cannot be auto-partitioned by XLA.
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from vllm_omni_tpu.parallel.sharding import (
+                ar_kv_cache_spec,
+                ar_param_specs_tree,
+            )
+
+            pspecs = ar_param_specs_tree(params)
+            kv_specs = [ar_kv_cache_spec()] * cfg.num_layers
+            rep = P()
+
+            def wrap(f, n_rest, n_out):
+                sm = shard_map(
+                    f, mesh=mesh,
+                    in_specs=(pspecs, rep, kv_specs) + (rep,) * n_rest,
+                    out_specs=(rep,) * n_out + (kv_specs,),
+                    check_vma=False,
+                )
+                return jax.jit(sm, donate_argnums=(2,))
+
+            self._prefill_fn = wrap(_prefill, 5, 3)
+            self._chunk_prefill_fn = wrap(_chunk_prefill, 8, 3)
+            self._verify_fn = wrap(_verify, 5, 2)
+            self._decode_fn = wrap(_decode, 4, 2)
         # speculative decoding (MTP draft head): draft_fn(last_hidden [M,H],
         # last_token [M], positions [M]) -> [M, k] proposals
         self.draft_fn = None
@@ -403,6 +463,9 @@ class ARModelRunner:
         )
         greedy = np.asarray(jax.device_get(
             jnp.argmax(logits, axis=-1)))  # [B, S]
+        # target distributions for every SAMPLED request in ONE batched
+        # device call (greedy rows verify off the argmax above)
+        sampled_probs = self._batched_verify_probs(scheds, logits)
         # one verify forward per call, however many requests it batched
         self.spec_stats["verify_steps"] += 1
         accepted_idx: list[int] = []
@@ -410,11 +473,16 @@ class ARModelRunner:
             req = sc.request
             n = sc.num_new_tokens
             drafts = cands[i][1:]
-            acc = [int(greedy[i, 0])]
-            for j, d in enumerate(drafts):
-                if d != acc[-1]:
-                    break  # draft j diverges from the true token
-                acc.append(int(greedy[i, j + 1]))
+            if req.sampling_params.temperature == 0.0:
+                # greedy verify: accept the longest prefix matching argmax
+                acc = [int(greedy[i, 0])]
+                for j, d in enumerate(drafts):
+                    if d != acc[-1]:
+                        break  # draft j diverges from the true token
+                    acc.append(int(greedy[i, j + 1]))
+            else:
+                acc = self._rejection_accept(req, sampled_probs[i],
+                                             drafts)
             acc = self._truncate_at_stop(req, acc)
             out.sampled[req.request_id] = acc
             accepted_idx.append(len(acc) - 1)
@@ -428,6 +496,69 @@ class ARModelRunner:
         last_hidden = hidden[jnp.arange(len(scheds)),
                              jnp.asarray(accepted_idx)]
         self._maybe_draft(scheds, last_hidden, out)
+
+    def _batched_verify_probs(self, scheds, logits) -> dict:
+        """{batch_row: [S, vocab] filtered target probs} for every
+        sampled (temperature > 0) request — ONE filtered_probs dispatch
+        + ONE device_get for the whole verify batch."""
+        from vllm_omni_tpu.sample.sampler import filtered_probs
+
+        rows = [(i, sc.request.sampling_params) for i, sc in
+                enumerate(scheds)
+                if sc.request.sampling_params.temperature != 0.0]
+        if not rows:
+            return {}
+        s_len = logits.shape[1]
+        idx = jnp.asarray([i for i, _ in rows])
+        sub = logits[idx].reshape(len(rows) * s_len, logits.shape[-1])
+        rep = lambda vals: np.repeat(  # noqa: E731
+            np.asarray(vals, np.float32), s_len)
+        flat = filtered_probs(
+            sub,
+            jnp.asarray(rep([sp.temperature for _, sp in rows])),
+            jnp.asarray(rep([sp.top_k for _, sp in rows]).astype(np.int32)),
+            jnp.asarray(rep([sp.top_p for _, sp in rows])),
+        )
+        probs = np.asarray(jax.device_get(flat)).reshape(
+            len(rows), s_len, -1)
+        return {i: probs[r] for r, (i, _) in enumerate(rows)}
+
+    def _rejection_accept(self, req, probs, drafts: list[int]
+                          ) -> list[int]:
+        """Rejection-sampling verify for a sampled request (reference:
+        gpu_ar_model_runner.py:466-497).  ``probs`` are the request's
+        precomputed [S, vocab] filtered target distributions
+        (_batched_verify_probs).  The MTP draft proposes
+        deterministically (greedy head), so the accept probability for
+        draft d at position j is the TARGET probability p_j(d); on
+        rejection the replacement is drawn from p_j with d excluded and
+        renormalized — the emitted stream is exactly p-distributed.
+        Randomness is a deterministic per-(request, step) stream, like
+        the main sampler."""
+        sp = req.sampling_params
+        seed = sp.seed if sp.seed is not None else self._base_seed
+        salt = zlib.crc32(req.request_id.encode())
+        rng = np.random.default_rng((seed, salt, self._step))
+        acc: list[int] = []
+        for j, d in enumerate(drafts):
+            p_d = float(probs[j, d])
+            if rng.uniform() < p_d:
+                acc.append(int(d))
+                continue
+            # rejected: sample the replacement from p_j \ {d}
+            p = probs[j].astype(np.float64)
+            p[d] = 0.0
+            total = p.sum()
+            if total <= 0.0:
+                acc.append(int(np.argmax(probs[j])))
+            else:
+                acc.append(int(rng.choice(len(p), p=p / total)))
+            return acc
+        # every draft accepted: bonus token from the last position
+        p = probs[len(drafts)].astype(np.float64)
+        p = p / p.sum()
+        acc.append(int(rng.choice(len(p), p=p)))
+        return acc
 
     @staticmethod
     def _truncate_at_stop(req, acc: list[int]) -> list[int]:
@@ -463,11 +594,8 @@ class ARModelRunner:
             s = out.sampled.get(req.request_id)
             if s is None:
                 continue
-            if req.sampling_params.temperature != 0.0:
-                # verify-accept is exact only under greedy matching;
-                # sampled requests decode normally
-                req.spec_draft_tokens = []
-                continue
+            # greedy requests verify by argmax match; sampled requests by
+            # rejection sampling (_rejection_accept) — both draft
             new = s if isinstance(s, list) else [s]
             # position where the just-sampled token will be computed: the
             # per-token advance for spec lists, the full chunk width for
