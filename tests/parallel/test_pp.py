@@ -1,0 +1,86 @@
+"""DiT pipeline parallelism (parallel/pp.py): GPipe microbatches over the
+``pp`` mesh axis must produce the single-device image, with per-rank
+block weights actually sharded to L/pp (the memory win that justifies
+the axis — VERDICT r2 next #9; reference:
+diffusion/distributed/group_coordinator.py:548)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.request import (
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+from vllm_omni_tpu.models.qwen_image.pipeline import (
+    QwenImagePipeline,
+    QwenImagePipelineConfig,
+)
+from vllm_omni_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def _pp_mesh(pp):
+    return build_mesh(MeshConfig(pipeline_parallel_size=pp),
+                      jax.devices()[:pp])
+
+
+def _gen(pipe, prompts=("a cat",), seed=3):
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=4.0,
+        seed=seed)
+    req = OmniDiffusionRequest(
+        prompt=list(prompts), sampling_params=sp,
+        request_ids=[f"r{i}" for i in range(len(prompts))])
+    return [o.data for o in pipe.forward(req)]
+
+
+def test_pp_matches_single_device():
+    cfg = QwenImagePipelineConfig.tiny()
+    single = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0)
+    want = _gen(single)
+    pp2 = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
+                            mesh=_pp_mesh(2))
+    got = _gen(pp2)
+    np.testing.assert_allclose(
+        got[0].astype(np.int32), want[0].astype(np.int32), atol=1)
+
+
+def test_pp_blocks_sharded_per_rank():
+    """Each pp rank must hold only L/pp blocks — the per-device weight
+    memory reduction."""
+    cfg = QwenImagePipelineConfig.tiny()  # 2 DiT layers
+    pipe = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
+                             mesh=_pp_mesh(2))
+    stacked = pipe.dit_params["blocks_stacked"]
+    leaf = jax.tree.leaves(stacked)[0]
+    assert leaf.shape[0] == cfg.dit.num_layers
+    for shard in leaf.addressable_shards:
+        assert shard.data.shape[0] == cfg.dit.num_layers // 2
+
+
+def test_pp_excludes_other_axes():
+    cfg = QwenImagePipelineConfig.tiny()
+    mesh = build_mesh(
+        MeshConfig(pipeline_parallel_size=2, cfg_parallel_size=2),
+        jax.devices()[:4])
+    with pytest.raises(ValueError, match="pp composes with no other"):
+        QwenImagePipeline(cfg, dtype=jnp.float32, seed=0, mesh=mesh)
+
+
+def test_pp4_batch_microbatches():
+    """4-stage pipeline (4 DiT layers, 1 per rank) with a 2-prompt CFG
+    batch (batch2=4 -> one microbatch per rank)."""
+    import dataclasses
+
+    base = QwenImagePipelineConfig.tiny()
+    cfg = dataclasses.replace(
+        base, dit=dataclasses.replace(base.dit, num_layers=4))
+    single = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0)
+    want = _gen(single, prompts=("a", "b"))
+    pp4 = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
+                            mesh=_pp_mesh(4))
+    got = _gen(pp4, prompts=("a", "b"))
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(
+            g.astype(np.int32), w.astype(np.int32), atol=1)

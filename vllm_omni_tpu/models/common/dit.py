@@ -77,6 +77,7 @@ def cross_block_forward(
     rope: tuple,           # (cos, sin) each [S, head_dim//2]
     num_heads: int,
     ctx_mask=None,         # [B, S_ctx] 1/0
+    self_attn_fn=None,     # (q, k, v) [B,S,H,D] -> [B,S,H,D]; SP override
 ):
     mod = nn.linear(blk["mod"], jax.nn.silu(temb))[:, None, :]
     sh1, sc1, g1, sh2, sc2, g2 = jnp.split(mod, 6, axis=-1)
@@ -91,7 +92,11 @@ def cross_block_forward(
     v = _heads(nn.linear(blk["to_v"], h), num_heads)
     q = _rope_apply(q, cos, sin)
     k = _rope_apply(k, cos, sin)
-    attn = flash_attention(q, k, v, causal=False)
+    if self_attn_fn is not None:
+        # sequence-parallel path (shard_map USP over the token axis)
+        attn = self_attn_fn(q, k, v)
+    else:
+        attn = flash_attention(q, k, v, causal=False)
     x = x + g1 * nn.linear(blk["to_out"], _merge(attn))
 
     # cross-attention into encoder states (un-modulated, Wan style)

@@ -80,9 +80,16 @@ class SD3Pipeline:
 
     def __init__(self, config: SD3PipelineConfig, dtype=jnp.bfloat16,
                  seed: int = 0, mesh=None, cache_config=None):
+        from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
+
         self.cfg = config
         self.dtype = dtype
+        self.mesh = mesh
         self.cache_config = cache_config
+        # batch parallelism (dp + CFG halves); SP/TP for the
+        # double-stream blocks are not wired — refuse, don't ignore
+        self.wiring = MeshWiring(mesh, type(self).__name__).validate(
+            {"dp", "cfg"})
         if config.dit.num_single_blocks != 0 or config.dit.guidance_embed:
             raise ValueError(
                 "SD3 is double-stream-only with CFG: num_single_blocks "
@@ -99,9 +106,12 @@ class SD3Pipeline:
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
         k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
         logger.info("Initializing SD3Pipeline params (dtype=%s)", dtype)
-        self.text_params = init_text_params(k1, config.text, dtype)
-        self.dit_params = fdit.init_params(k2, config.dit, dtype)
-        self.vae_params = vae_mod.init_decoder(k3, config.vae, dtype)
+        self.text_params = self.wiring.place(
+            init_text_params(k1, config.text, dtype))
+        self.dit_params = self.wiring.place(
+            fdit.init_params(k2, config.dit, dtype))
+        self.vae_params = self.wiring.place(
+            vae_mod.init_decoder(k3, config.vae, dtype))
         self._denoise_cache: dict = {}
         self._text_encode_jit = jax.jit(
             lambda p, i: forward_hidden(p, self.cfg.text, i))
@@ -145,6 +155,8 @@ class SD3Pipeline:
             def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
                 lat_in = jnp.concatenate([lat, lat], 0) if do_cfg else lat
+                # CFG halves ride the cfg axis, requests the dp axis
+                lat_in = self.wiring.constrain(lat_in)
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
                 v = fdit.forward(
                     dit_params, cfg.dit, lat_in, ctx_all, pooled_all, t_in,

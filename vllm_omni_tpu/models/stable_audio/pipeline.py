@@ -123,7 +123,7 @@ def init_decoder_params(key, cfg: StableAudioPipelineConfig,
 
 
 def dit_forward(params, cfg: StableAudioDiTConfig, latents, ctx, timesteps,
-                ctx_mask=None):
+                ctx_mask=None, attn_fn=None):
     """latents [B, T, C] -> velocity [B, T, C] (1-D RoPE positions)."""
     x = nn.linear(params["lat_in"], latents)
     temb = nn.linear(
@@ -139,7 +139,7 @@ def dit_forward(params, cfg: StableAudioDiTConfig, latents, ctx, timesteps,
     rope = (jnp.cos(ang), jnp.sin(ang))
     for blk in params["blocks"]:
         x = dit.cross_block_forward(blk, x, ctx, temb, rope, cfg.num_heads,
-                                    ctx_mask)
+                                    ctx_mask, self_attn_fn=attn_fn)
     mod = nn.linear(params["norm_out_mod"], jax.nn.silu(temb))[:, None, :]
     shift, scale = jnp.split(mod, 2, axis=-1)
     x = nn.layernorm({}, x) * (1 + scale) + shift
@@ -162,17 +162,27 @@ class StableAudioPipeline:
 
     def __init__(self, config: StableAudioPipelineConfig, dtype=jnp.bfloat16,
                  seed: int = 0, mesh=None, cache_config=None):
+        from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
+
         self.cfg = config
         self.dtype = dtype
+        self.mesh = mesh
         self.cache_config = cache_config
+        # dp batches + USP over audio tokens; no CFG batch (guidance-free
+        # sampler) and no TP wiring — refuse those axes
+        self.wiring = MeshWiring(mesh, type(self).__name__).validate(
+            {"dp", "ring", "ulysses"})
         if config.text.hidden_size != config.dit.ctx_dim:
             raise ValueError("text hidden_size must equal dit ctx_dim")
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
         k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
         logger.info("Initializing StableAudioPipeline (dtype=%s)", dtype)
-        self.text_params = init_text_params(k1, config.text, dtype)
-        self.dit_params = init_dit_params(k2, config.dit, dtype)
-        self.decoder_params = init_decoder_params(k3, config, dtype)
+        self.text_params = self.wiring.place(
+            init_text_params(k1, config.text, dtype))
+        self.dit_params = self.wiring.place(
+            init_dit_params(k2, config.dit, dtype))
+        self.decoder_params = self.wiring.place(
+            init_decoder_params(k3, config, dtype))
         self._denoise_cache: dict = {}
         # params are explicit jit ARGUMENTS (closure capture would bake
         # them into the executable — sleep()/weight swaps wouldn't apply),
@@ -188,11 +198,14 @@ class StableAudioPipeline:
                 < lens[:, None]).astype(np.int32)
         return hidden, jnp.asarray(mask)
 
-    def _denoise_fn(self, lat_len, sched_len):
-        key = (lat_len, sched_len)
+    def _denoise_fn(self, lat_len, sched_len, batch=0):
+        key = (lat_len, sched_len) + (
+            (batch,) if self.mesh is not None else ())
         if key in self._denoise_cache:
             return self._denoise_cache[key]
         cfg = self.cfg
+        wiring = self.wiring
+        attn_fn = wiring.self_attn_fn(cfg.dit.num_heads, lat_len, batch)
 
         cache_cfg = self.cache_config
 
@@ -204,8 +217,9 @@ class StableAudioPipeline:
 
             def eval_velocity(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
+                lat = wiring.constrain(lat, seq_dim=1)
                 return dit_forward(dit_params, cfg.dit, lat, ctx, t,
-                                   ctx_mask)
+                                   ctx_mask, attn_fn=attn_fn)
 
             return step_cache.run_denoise_loop(
                 cache_cfg, schedule, eval_velocity, latents, num_steps)
@@ -236,7 +250,7 @@ class StableAudioPipeline:
             schedule.sigmas)
         timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
             schedule.timesteps)
-        run = self._denoise_fn(lat_len, sched_len)
+        run = self._denoise_fn(lat_len, sched_len, batch=b)
         latents, skipped = run(self.dit_params, noise, ctx, ctx_mask,
                                sigmas, timesteps, jnp.int32(num_steps))
         self.last_skipped_steps = int(skipped)

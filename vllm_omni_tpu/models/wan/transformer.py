@@ -112,6 +112,7 @@ def forward(
     ctx: jax.Array,       # [B, S_txt, ctx_dim]
     timesteps: jax.Array, # [B]
     ctx_mask=None,
+    attn_fn=None,         # SP self-attention override (pipeline mesh)
 ) -> jax.Array:
     """Velocity prediction, same shape as latents."""
     b, f, h, w, c = latents.shape
@@ -128,7 +129,7 @@ def forward(
     rope = rope_freqs(cfg, f, gh, gw)
     for blk in params["blocks"]:
         x = dit.cross_block_forward(blk, x, ctx, temb, rope, cfg.num_heads,
-                                    ctx_mask)
+                                    ctx_mask, self_attn_fn=attn_fn)
     mod = nn.linear(params["norm_out_mod"], jax.nn.silu(temb))[:, None, :]
     shift, scale = jnp.split(mod, 2, axis=-1)
     x = nn.layernorm({}, x) * (1 + scale) + shift

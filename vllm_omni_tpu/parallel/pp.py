@@ -1,0 +1,115 @@
+"""Pipeline parallelism for DiT block stacks (GPipe over shard_map).
+
+Reference: the diffusion PipelineGroupCoordinator
+(vllm_omni/diffusion/distributed/group_coordinator.py:548 — send/recv
+groups between pipeline ranks).  The TPU-native shape: transformer blocks
+STACK into leading-axis arrays sharded over the ``pp`` mesh axis (each
+rank holds num_layers/pp blocks — the per-device weight-memory win), and
+one shard_map program runs the classic microbatch schedule: at tick t,
+rank r processes microbatch ``t - r`` through its local blocks
+(lax.scan) and hands the activations to rank r+1 with ``ppermute``.
+T = M + pp - 1 ticks drain the pipeline; outputs accumulate on the last
+rank and a psum (zeros elsewhere) broadcasts them back.
+
+No Send/Recv coordinator processes, no stream management: the schedule is
+data flow inside one jitted SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def stack_blocks(blocks: list) -> dict:
+    """List of per-block param trees -> one tree of [L, ...] leaves
+    (the leading axis is the pp shard axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+
+
+def pp_block_specs(stacked, axis: str = "pp"):
+    """shard_map in_specs for a stacked block tree: leading axis over
+    ``axis``."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _: P(axis), stacked)
+
+
+def microbatch(tree, m: int):
+    """[B, ...] leaves -> [M, B/m, ...]."""
+    return jax.tree.map(
+        lambda x: x.reshape(m, x.shape[0] // m, *x.shape[1:]), tree)
+
+
+def unmicrobatch(tree):
+    return jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]), tree)
+
+
+def pipeline_apply(
+    local_blocks,
+    mb_carry,            # pytree with leading [M, bm, ...] microbatches
+    scan_fn: Callable,   # (local_blocks, carry) -> carry
+    axis: str = "pp",
+):
+    """Run the microbatch pipeline INSIDE shard_map over ``axis``.
+
+    ``mb_carry`` must be replicated across pp ranks (each rank picks its
+    own microbatch per tick); returns the processed microbatches,
+    replicated.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    leaves = jax.tree.leaves(mb_carry)
+    m_count = leaves[0].shape[0]
+    ticks = m_count + n - 1
+
+    def pick(tree, m):
+        mc = jnp.clip(m, 0, m_count - 1)
+        return jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, mc, 0, keepdims=False),
+            tree)
+
+    buf0 = pick(mb_carry, jnp.int32(0))
+    outs0 = jax.tree.map(jnp.zeros_like, mb_carry)
+
+    def tick(t, state):
+        buf, outs = state
+        m = t - idx  # microbatch this rank works on (may be out of range)
+        # stage input: rank 0 reads the embedded microbatch, later ranks
+        # take what the previous rank ppermuted over
+        fresh = pick(mb_carry, m)
+        x = jax.tree.map(
+            lambda a, b: jnp.where(idx == 0, a, b), fresh, buf)
+        y = scan_fn(local_blocks, x)
+        valid = jnp.logical_and(m >= 0, m < m_count)
+        write = jnp.logical_and(valid, idx == n - 1)
+        mc = jnp.clip(m, 0, m_count - 1)
+        outs = jax.tree.map(
+            lambda o, v: jnp.where(
+                write,
+                lax.dynamic_update_index_in_dim(o, v, mc, 0),
+                o),
+            outs, y)
+        # hand activations to the next rank
+        buf = jax.tree.map(
+            lambda v: lax.ppermute(
+                v, axis, [(i, (i + 1) % n) for i in range(n)]),
+            y)
+        return buf, outs
+
+    _, outs = lax.fori_loop(0, ticks, tick, (buf0, outs0))
+    # outputs live on the last rank only; zeros elsewhere -> psum is a
+    # broadcast
+    outs = jax.tree.map(
+        lambda o: lax.psum(jnp.where(idx == n - 1, o, jnp.zeros_like(o)),
+                           axis),
+        outs)
+    return outs
