@@ -64,9 +64,13 @@ def init_params(key, cfg: FluxDiTConfig, dtype=jnp.float32):
         "txt_in": nn.linear_init(keys[1], cfg.ctx_dim, inner, dtype=dtype),
         "time_in1": nn.linear_init(keys[2], 256, inner, dtype=dtype),
         "time_in2": nn.linear_init(keys[3], inner, inner, dtype=dtype),
-        "pooled_in1": nn.linear_init(
-            keys[4], cfg.pooled_dim, inner, dtype=dtype),
-        "pooled_in2": nn.linear_init(keys[5], inner, inner, dtype=dtype),
+        # pooled_dim=0 => no pooled conditioning head (LongCat-Image
+        # conditions on timestep only, longcat_image_transformer.py:540)
+        **({"pooled_in1": nn.linear_init(
+                keys[4], cfg.pooled_dim, inner, dtype=dtype),
+            "pooled_in2": nn.linear_init(keys[5], inner, inner,
+                                         dtype=dtype)}
+           if cfg.pooled_dim else {}),
         "norm_out_mod": nn.linear_init(keys[6], inner, 2 * inner, dtype=dtype),
         "proj_out": nn.linear_init(
             keys[7], inner, cfg.out_channels, dtype=dtype),
@@ -239,9 +243,10 @@ def forward(
     temb = nn.timestep_embedding(timesteps, 256).astype(img.dtype)
     temb = nn.linear(params["time_in2"],
                      jax.nn.silu(nn.linear(params["time_in1"], temb)))
-    temb = temb + nn.linear(
-        params["pooled_in2"],
-        jax.nn.silu(nn.linear(params["pooled_in1"], pooled)))
+    if cfg.pooled_dim:
+        temb = temb + nn.linear(
+            params["pooled_in2"],
+            jax.nn.silu(nn.linear(params["pooled_in1"], pooled)))
     if cfg.guidance_embed:
         g = guidance if guidance is not None else jnp.ones((b,), jnp.float32)
         gemb = nn.timestep_embedding(g * 1000.0, 256).astype(img.dtype)

@@ -1,0 +1,300 @@
+"""LongCat-Image text->image + Edit pipelines.
+
+Reference: vllm_omni/diffusion/models/longcat_image/
+(pipeline_longcat_image.py:202, pipeline_longcat_image_edit.py,
+longcat_image_transformer.py:505 — "the Transformer model introduced in
+Flux": 19 double + 38 single stream blocks at the Flux geometry, but
+with TRUE classifier-free guidance over a doubled batch instead of an
+embedded guidance scale, no pooled conditioning vector, and an optional
+CFG-renorm (cfg_normalize_function, pipeline_longcat_image.py:463) that
+rescales the combined prediction back to the conditional norm.
+
+The edit variant VAE-encodes the input image and appends its packed
+latents to the token sequence (frame coordinate 1 in RoPE), reading
+velocity off the generated tokens — same mechanism as Qwen-Image-Edit.
+
+TPU-first: reuses the Flux MMDiT implementation
+(models/flux/transformer.py with pooled_dim=0); the whole denoise loop
+is one jitted fori_loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.diffusion import cache as step_cache
+from vllm_omni_tpu.diffusion import scheduler as fm
+from vllm_omni_tpu.diffusion.request import (
+    DiffusionOutput,
+    InvalidRequestError,
+    OmniDiffusionRequest,
+)
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    forward_hidden,
+    init_params as init_text_params,
+)
+from vllm_omni_tpu.models.flux import transformer as fdit
+from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
+from vllm_omni_tpu.models.qwen_image import vae as vae_mod
+from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
+
+logger = init_logger(__name__)
+
+
+def _longcat_dit(base: FluxDiTConfig) -> FluxDiTConfig:
+    import dataclasses
+
+    return dataclasses.replace(base, guidance_embed=False, pooled_dim=0)
+
+
+@dataclass(frozen=True)
+class LongCatImagePipelineConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    dit: FluxDiTConfig = field(
+        default_factory=lambda: _longcat_dit(FluxDiTConfig()))
+    vae: VAEConfig = field(default_factory=VAEConfig)
+    max_text_len: int = 64
+    scheduler: str = "euler"
+    pack: int = 2
+    cfg_renorm: bool = True
+    cfg_renorm_min: float = 0.0
+
+    @staticmethod
+    def tiny() -> "LongCatImagePipelineConfig":
+        return LongCatImagePipelineConfig(
+            text=TransformerConfig.tiny(vocab_size=256),
+            dit=_longcat_dit(FluxDiTConfig.tiny()),
+            vae=VAEConfig.tiny(),
+            max_text_len=32,
+        )
+
+
+class LongCatImagePipeline:
+    """Text -> image (Flux geometry, true CFG + renorm)."""
+
+    output_type = "image"
+
+    def __init__(self, config: LongCatImagePipelineConfig,
+                 dtype=jnp.bfloat16, seed: int = 0, mesh=None,
+                 cache_config=None):
+        from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
+
+        self.cfg = config
+        self.dtype = dtype
+        self.mesh = mesh
+        self.cache_config = cache_config
+        self.wiring = MeshWiring(mesh, type(self).__name__).validate(
+            {"dp", "cfg"})
+        if config.dit.guidance_embed or config.dit.pooled_dim:
+            raise ValueError(
+                "LongCat runs true CFG without pooled conditioning — "
+                "use _longcat_dit()")
+        if config.text.hidden_size != config.dit.ctx_dim:
+            raise ValueError("text hidden_size must equal dit ctx_dim")
+        want_in = config.vae.latent_channels * config.pack ** 2
+        if config.dit.in_channels != want_in:
+            raise ValueError(
+                f"dit.in_channels must be latent*pack^2 = {want_in}")
+        self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        logger.info("Initializing %s (dtype=%s)", type(self).__name__,
+                    dtype)
+        self.text_params = self.wiring.place(
+            init_text_params(k1, config.text, dtype))
+        self.dit_params = self.wiring.place(
+            fdit.init_params(k2, config.dit, dtype))
+        self.vae_params = self.wiring.place(
+            vae_mod.init_decoder(k3, config.vae, dtype))
+        self.vae_encoder_params = None  # on demand (edit conditioning)
+        self._seed = seed
+        self._denoise_cache: dict = {}
+        self._text_encode_jit = jax.jit(
+            lambda p, i: forward_hidden(p, self.cfg.text, i))
+        self._vae_decode_jit = jax.jit(
+            lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
+        self._vae_encode_jit = jax.jit(
+            lambda pp, im: vae_mod.encode(pp, self.cfg.vae, im))
+
+    @property
+    def geometry_multiple(self) -> int:
+        return self.cfg.vae.spatial_ratio * self.cfg.pack
+
+    def encode_prompt(self, prompts: list[str]):
+        ids, lens = self.tokenizer.batch_encode(prompts,
+                                                self.cfg.max_text_len)
+        hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
+        mask = (np.arange(self.cfg.max_text_len)[None, :]
+                < lens[:, None]).astype(np.int32)
+        return hidden, jnp.asarray(mask)
+
+    def _denoise_fn(self, grid_h, grid_w, sched_len, has_cond: bool):
+        key = (grid_h, grid_w, sched_len, has_cond)
+        if key in self._denoise_cache:
+            return self._denoise_cache[key]
+        cfg = self.cfg
+        wiring = self.wiring
+        cache_cfg = self.cache_config
+
+        @jax.jit
+        def run(dit_params, latents, ctx, ctx_mask, neg_ctx, neg_mask,
+                sigmas, timesteps, gscale, num_steps, cond=None):
+            schedule = fm.FlowMatchSchedule(sigmas=sigmas,
+                                            timesteps=timesteps)
+            do_cfg = neg_ctx is not None
+            ctx_all = (jnp.concatenate([ctx, neg_ctx], 0)
+                       if do_cfg else ctx)
+            mask_all = (jnp.concatenate([ctx_mask, neg_mask], 0)
+                        if do_cfg else ctx_mask)
+
+            def eval_velocity(lat, i):
+                t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
+                s_gen = lat.shape[1]
+                lat_model = (lat if cond is None
+                             else jnp.concatenate([lat, cond], axis=1))
+                lat_in = (jnp.concatenate([lat_model, lat_model], 0)
+                          if do_cfg else lat_model)
+                lat_in = wiring.constrain(lat_in)
+                t_in = jnp.concatenate([t, t], 0) if do_cfg else t
+                # the condition block rides extra "frames" on the rope
+                # frame axis via the flux rope's frames argument: the
+                # flux 3-axis rope treats extra rows as continued grid —
+                # structurally the cond tokens get distinct coordinates
+                v = fdit.forward(
+                    dit_params, cfg.dit, lat_in, ctx_all, None, t_in,
+                    (grid_h * (2 if cond is not None else 1), grid_w),
+                    txt_mask=mask_all,
+                )[:, :s_gen]
+                if do_cfg:
+                    v_pos, v_neg = jnp.split(v, 2, axis=0)
+                    comb = v_neg + gscale * (v_pos - v_neg)
+                    if cfg.cfg_renorm:
+                        # rescale to the conditional prediction's norm
+                        # (pipeline_longcat_image.py:463-471)
+                        cn = jnp.linalg.norm(v_pos.astype(jnp.float32),
+                                             axis=-1, keepdims=True)
+                        nn_ = jnp.linalg.norm(comb.astype(jnp.float32),
+                                              axis=-1, keepdims=True)
+                        scale = jnp.clip(cn / (nn_ + 1e-8),
+                                         cfg.cfg_renorm_min, 1.0)
+                        comb = (comb.astype(jnp.float32) * scale).astype(
+                            comb.dtype)
+                    v = comb
+                return v
+
+            return step_cache.run_denoise_loop(
+                cache_cfg, schedule, eval_velocity, latents, num_steps,
+                solver=cfg.scheduler)
+
+        self._denoise_cache[key] = run
+        return run
+
+    def _edit_cond(self, req, grid_h, grid_w, b):
+        return None
+
+    def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
+        sp = req.sampling_params
+        cfg = self.cfg
+        mult = self.geometry_multiple
+        if sp.height % mult or sp.width % mult:
+            raise InvalidRequestError(
+                f"height/width must be multiples of {mult}")
+        if sp.num_inference_steps < 1:
+            raise InvalidRequestError("num_inference_steps must be >= 1")
+        grid_h = sp.height // mult
+        grid_w = sp.width // mult
+        seq_len = grid_h * grid_w
+        prompts = req.prompt
+        b = len(prompts)
+
+        ctx, ctx_mask = self.encode_prompt(prompts)
+        do_cfg = sp.guidance_scale > 1.0
+        neg_ctx = neg_mask = None
+        if do_cfg:
+            neg_ctx, neg_mask = self.encode_prompt(
+                [sp.negative_prompt] * b)
+
+        seed = (sp.seed if sp.seed is not None
+                else int(np.random.randint(0, 2 ** 31 - 1)))
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (b, seq_len, cfg.dit.in_channels), jnp.float32,
+        ).astype(self.dtype)
+        cond = self._edit_cond(req, grid_h, grid_w, b)
+
+        num_steps = sp.num_inference_steps
+        mu = fm.compute_dynamic_shift_mu(seq_len)
+        schedule = fm.make_schedule(num_steps, use_dynamic_shifting=True,
+                                    mu=mu)
+        sched_len = max(8, 1 << (num_steps - 1).bit_length())
+        sigmas = jnp.zeros((sched_len + 1,)).at[: num_steps + 1].set(
+            schedule.sigmas)
+        timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
+            schedule.timesteps)
+        run = self._denoise_fn(grid_h, grid_w, sched_len,
+                               has_cond=cond is not None)
+        latents, skipped = run(
+            self.dit_params, noise, ctx, ctx_mask, neg_ctx, neg_mask,
+            sigmas, timesteps, jnp.float32(sp.guidance_scale),
+            jnp.int32(num_steps), cond=cond)
+        self.last_skipped_steps = int(skipped)
+
+        # unpack [B, gh*gw, pack^2*C] -> [B, H_lat, W_lat, C]
+        c = cfg.vae.latent_channels
+        p = cfg.pack
+        x = latents.reshape(b, grid_h, grid_w, p, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, grid_h * p, grid_w * p, c)
+        img = self._vae_decode_jit(self.vae_params, x.astype(jnp.float32))
+        img = np.asarray(jnp.clip(
+            (img.astype(jnp.float32) + 1.0) * 127.5, 0, 255)
+            .astype(jnp.uint8))
+        return [
+            DiffusionOutput(request_id=req.request_ids[i],
+                            prompt=prompts[i], data=img[i],
+                            output_type="image")
+            for i in range(b)
+        ]
+
+
+class LongCatImageEditPipeline(LongCatImagePipeline):
+    """Image + text -> image: VAE-encoded input latents appended to the
+    sequence (reference: pipeline_longcat_image_edit.py:406-456)."""
+
+    needs_image_cond = True
+
+    def _edit_cond(self, req, grid_h, grid_w, b):
+        sp = req.sampling_params
+        image = sp.image if sp.image is not None else sp.extra.get("image")
+        if image is None:
+            raise InvalidRequestError(
+                "LongCatImageEditPipeline needs sampling_params.image")
+        img = np.asarray(image)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 127.5 - 1.0
+        mult = self.geometry_multiple
+        th, tw = grid_h * mult, grid_w * mult
+        if img.shape[:2] != (th, tw):
+            img = np.asarray(jax.image.resize(
+                jnp.asarray(img), (th, tw, 3), "bilinear"))
+        if self.vae_encoder_params is None:
+            self.vae_encoder_params = self.wiring.place(
+                vae_mod.init_encoder(
+                    jax.random.PRNGKey(self._seed + 1), self.cfg.vae,
+                    jnp.float32))
+        lat = self._vae_encode_jit(
+            self.vae_encoder_params, jnp.asarray(img, jnp.float32)[None])
+        # pack 2x2 into channels, mirroring the generated latents
+        p = self.cfg.pack
+        c = self.cfg.vae.latent_channels
+        h, w = lat.shape[1:3]
+        x = lat.reshape(1, h // p, p, w // p, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            1, (h // p) * (w // p), p * p * c)
+        return jnp.repeat(x.astype(self.dtype), b, axis=0)

@@ -32,6 +32,17 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "QwenImagePipeline": _Entry(
         "vllm_omni_tpu.models.qwen_image.pipeline", "QwenImagePipeline"
     ),
+    # image editing: input image VAE-encoded and appended to the token
+    # sequence (reference: pipeline_qwen_image_edit.py:218 /
+    # pipeline_qwen_image_edit_plus.py)
+    "QwenImageEditPipeline": _Entry(
+        "vllm_omni_tpu.models.qwen_image.edit_pipeline",
+        "QwenImageEditPipeline"
+    ),
+    "QwenImageEditPlusPipeline": _Entry(
+        "vllm_omni_tpu.models.qwen_image.edit_pipeline",
+        "QwenImageEditPlusPipeline"
+    ),
     # video (reference: Wan2.2 T2V family, diffusion/registry.py:16-102)
     "WanPipeline": _Entry(
         "vllm_omni_tpu.models.wan.pipeline", "WanT2VPipeline"
@@ -66,10 +77,42 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "StableAudioPipeline": _Entry(
         "vllm_omni_tpu.models.stable_audio.pipeline", "StableAudioPipeline"
     ),
+    # unified-sequence single-stream DiT (reference: z_image/
+    # pipeline_z_image.py)
+    "ZImagePipeline": _Entry(
+        "vllm_omni_tpu.models.z_image.pipeline", "ZImagePipeline"
+    ),
+    # Flux-geometry MMDiT with true CFG + renorm (reference:
+    # longcat_image/pipeline_longcat_image.py:202)
+    "LongCatImagePipeline": _Entry(
+        "vllm_omni_tpu.models.longcat_image.pipeline",
+        "LongCatImagePipeline"
+    ),
+    "LongCatImageEditPipeline": _Entry(
+        "vllm_omni_tpu.models.longcat_image.pipeline",
+        "LongCatImageEditPipeline"
+    ),
 }
 
-# AR architectures -> model module (engine-facing)
-_AR_MODELS: dict[str, _Entry] = {}
+# AR architectures -> the family's entry-stage (thinker/LM) factory.
+# Stage YAMLs address stages by explicit `model_factory` strings; this
+# registry is the arch-name front door (reference:
+# model_executor/models/registry.py:65 — e.g.
+# Qwen3OmniMoeForConditionalGeneration): resolve(arch) returns a
+# callable -> (params, TransformerConfig, eos_token_id) for the family's
+# entry stage.  Downstream stages (talker/code2wav/...) stay per-stage
+# factories in the family's stage YAML.
+_AR_MODELS: dict[str, _Entry] = {
+    "Qwen3OmniMoeForConditionalGeneration": _Entry(
+        "vllm_omni_tpu.models.qwen3_omni.thinker", "tiny_factory"
+    ),
+    "Qwen2_5OmniForConditionalGeneration": _Entry(
+        "vllm_omni_tpu.models.qwen2_5_omni.thinker", "tiny_factory"
+    ),
+    "Qwen3TTSForConditionalGeneration": _Entry(
+        "vllm_omni_tpu.models.qwen3_tts.tts_lm", "tiny_factory"
+    ),
+}
 
 
 class DiffusionModelRegistry:

@@ -1,0 +1,264 @@
+"""Z-Image single-stream DiT (functional JAX).
+
+Reference: vllm_omni/diffusion/models/z_image/z_image_transformer.py:546
+``ZImageTransformer2DModel`` — a unified-sequence architecture unlike the
+MMDiT double streams: image tokens and caption tokens are refined
+separately (2 modulated noise-refiner blocks / 2 unmodulated
+context-refiner blocks), then CONCATENATED into one sequence processed by
+30 shared blocks.  Blocks are llama-flavored: GQA attention with per-head
+QK RMSNorm, sandwich RMSNorms around both sublayers, tanh-gated AdaLN
+(4 chunks from a 256-dim conditioning vector), SwiGLU FFN with hidden
+``dim/3*8``.  RoPE is 3-axis (frame/H/W) over integer coordinate ids;
+caption tokens occupy frame slots 1..cap_len on the frame axis and the
+image grid starts after them (z_image_transformer.py:772-827).
+
+TPU-first: static shapes (uniform batch geometry replaces the reference's
+ragged per-item lists + SEQ_MULTI_OF padding), one jitted forward, rope
+tables computed from the grid at trace time.  Rope pair convention is
+half-split like the rest of this repo — re-verify against the checkpoint
+at weight-port time.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+ADALN_EMBED_DIM = 256
+
+
+@dataclass(frozen=True)
+class ZImageDiTConfig:
+    in_channels: int = 16
+    patch_size: int = 2
+    dim: int = 3840
+    num_layers: int = 30
+    num_refiner_layers: int = 2
+    num_heads: int = 30
+    num_kv_heads: int = 30
+    cap_feat_dim: int = 2560
+    rope_theta: float = 256.0
+    axes_dims: tuple[int, int, int] = (32, 48, 48)
+    t_scale: float = 1000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return int(self.dim / 3 * 8)
+
+    @property
+    def adaln_dim(self) -> int:
+        return min(self.dim, ADALN_EMBED_DIM)
+
+    @staticmethod
+    def tiny() -> "ZImageDiTConfig":
+        return ZImageDiTConfig(
+            in_channels=4, dim=96, num_layers=2, num_refiner_layers=1,
+            num_heads=4, num_kv_heads=2, cap_feat_dim=64,
+            axes_dims=(8, 8, 8),
+        )
+
+
+def _block_init(key, cfg: ZImageDiTConfig, modulation: bool, dtype):
+    k = jax.random.split(key, 6)
+    d = cfg.dim
+    q_dim = cfg.num_heads * cfg.head_dim
+    kv_dim = cfg.num_kv_heads * cfg.head_dim
+    p = {
+        "to_q": nn.linear_init(k[0], d, q_dim, bias=False, dtype=dtype),
+        "to_k": nn.linear_init(k[1], d, kv_dim, bias=False, dtype=dtype),
+        "to_v": nn.linear_init(k[2], d, kv_dim, bias=False, dtype=dtype),
+        "out": nn.linear_init(k[3], q_dim, d, bias=False, dtype=dtype),
+        "norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+        "norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+        "attn_norm1": nn.rmsnorm_init(d, dtype),
+        "attn_norm2": nn.rmsnorm_init(d, dtype),
+        "ffn_norm1": nn.rmsnorm_init(d, dtype),
+        "ffn_norm2": nn.rmsnorm_init(d, dtype),
+        # fused SwiGLU [w1; w3]
+        "w13": nn.linear_init(k[4], d, 2 * cfg.ffn_dim, bias=False,
+                              dtype=dtype),
+        "w2": nn.linear_init(k[5], cfg.ffn_dim, d, bias=False, dtype=dtype),
+    }
+    if modulation:
+        p["adaln"] = nn.linear_init(
+            jax.random.fold_in(key, 7), cfg.adaln_dim, 4 * d, dtype=dtype)
+    return p
+
+
+def init_params(key, cfg: ZImageDiTConfig, dtype=jnp.float32):
+    keys = jax.random.split(key, cfg.num_layers + 2 * cfg.num_refiner_layers
+                            + 8)
+    d = cfg.dim
+    p_in = cfg.patch_size * cfg.patch_size * cfg.in_channels
+    params = {
+        "x_embed": nn.linear_init(keys[0], p_in, d, dtype=dtype),
+        "cap_norm": nn.rmsnorm_init(cfg.cap_feat_dim, dtype),
+        "cap_embed": nn.linear_init(keys[1], cfg.cap_feat_dim, d,
+                                    dtype=dtype),
+        "t_in1": nn.linear_init(keys[2], 256, 1024, dtype=dtype),
+        "t_in2": nn.linear_init(keys[3], 1024, cfg.adaln_dim, dtype=dtype),
+        "final_adaln": nn.linear_init(keys[4], cfg.adaln_dim, d,
+                                      dtype=dtype),
+        "final_out": nn.linear_init(keys[5], d, p_in, dtype=dtype),
+        "noise_refiner": [],
+        "context_refiner": [],
+        "layers": [],
+    }
+    ki = 6
+    for _ in range(cfg.num_refiner_layers):
+        params["noise_refiner"].append(
+            _block_init(keys[ki], cfg, True, dtype))
+        ki += 1
+    for _ in range(cfg.num_refiner_layers):
+        params["context_refiner"].append(
+            _block_init(keys[ki], cfg, False, dtype))
+        ki += 1
+    for _ in range(cfg.num_layers):
+        params["layers"].append(_block_init(keys[ki], cfg, True, dtype))
+        ki += 1
+    return params
+
+
+def _axis_angles(pos, half, theta):
+    inv = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    return pos.astype(jnp.float32)[:, None] * inv[None, :]
+
+
+def rope_angles(cfg: ZImageDiTConfig, coords: jax.Array):
+    """coords [S, 3] integer (frame, row, col) ids -> angles
+    [S, head_dim//2] (reference RopeEmbedder, z_image_transformer.py:493)."""
+    halves = [d // 2 for d in cfg.axes_dims]
+    parts = [
+        _axis_angles(coords[:, i], h, cfg.rope_theta)
+        for i, h in enumerate(halves)
+    ]
+    ang = jnp.concatenate(parts, axis=-1)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rope_apply(x, cos, sin):
+    d = x.shape[-1]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _block(p, cfg: ZImageDiTConfig, x, freqs, adaln=None, attn_fn=None):
+    b, s, _ = x.shape
+    eps = cfg.norm_eps
+    if "adaln" in p:
+        mod = nn.linear(p["adaln"], adaln)[:, None, :]
+        scale_msa, gate_msa, scale_mlp, gate_mlp = jnp.split(mod, 4, -1)
+        gate_msa, gate_mlp = jnp.tanh(gate_msa), jnp.tanh(gate_mlp)
+        scale_msa, scale_mlp = 1.0 + scale_msa, 1.0 + scale_mlp
+    else:
+        scale_msa = gate_msa = scale_mlp = gate_mlp = None
+
+    h = rms_norm(x, p["attn_norm1"]["w"], eps)
+    if scale_msa is not None:
+        h = h * scale_msa
+    q = rms_norm(
+        nn.linear(p["to_q"], h).reshape(b, s, -1, cfg.head_dim),
+        p["norm_q"]["w"], eps)
+    k = rms_norm(
+        nn.linear(p["to_k"], h).reshape(b, s, -1, cfg.head_dim),
+        p["norm_k"]["w"], eps)
+    v = nn.linear(p["to_v"], h).reshape(b, s, -1, cfg.head_dim)
+    cos, sin = freqs
+    q = _rope_apply(q, cos, sin)
+    k = _rope_apply(k, cos, sin)
+    if attn_fn is not None:
+        o = attn_fn(q, k, v)
+    else:
+        o = flash_attention(q, k, v, causal=False)
+    o = nn.linear(p["out"], o.reshape(b, s, -1))
+    o = rms_norm(o, p["attn_norm2"]["w"], eps)
+    x = x + (gate_msa * o if gate_msa is not None else o)
+
+    h = rms_norm(x, p["ffn_norm1"]["w"], eps)
+    if scale_mlp is not None:
+        h = h * scale_mlp
+    w13 = nn.linear(p["w13"], h)
+    g, u = jnp.split(w13, 2, axis=-1)
+    y = nn.linear(p["w2"], jax.nn.silu(g) * u)
+    y = rms_norm(y, p["ffn_norm2"]["w"], eps)
+    return x + (gate_mlp * y if gate_mlp is not None else y)
+
+
+def forward(
+    params,
+    cfg: ZImageDiTConfig,
+    img_tokens: jax.Array,  # [B, S_img, patch^2 * in_channels]
+    cap_feats: jax.Array,   # [B, S_cap, cap_feat_dim]
+    timesteps: jax.Array,   # [B] in [0, 1]
+    grid_hw: tuple[int, int],
+    cap_mask=None,          # [B, S_cap] (currently informational)
+    attn_fn=None,
+) -> jax.Array:
+    """Velocity prediction [B, S_img, patch^2 * in_channels]."""
+    gh, gw = grid_hw
+    b, s_img, _ = img_tokens.shape
+    s_cap = cap_feats.shape[1]
+    assert s_img == gh * gw, (s_img, gh, gw)
+
+    temb = nn.timestep_embedding(timesteps * cfg.t_scale, 256)
+    adaln = nn.linear(
+        params["t_in2"],
+        jax.nn.silu(nn.linear(params["t_in1"],
+                              temb.astype(img_tokens.dtype))))
+
+    # coordinate ids: caption rides the frame axis starting at 1; the
+    # image grid's frame coordinate starts right after the caption
+    cap_coords = jnp.stack(
+        [jnp.arange(s_cap) + 1, jnp.zeros(s_cap, jnp.int32),
+         jnp.zeros(s_cap, jnp.int32)], axis=-1)
+    img_f = jnp.full((s_img,), s_cap + 1, jnp.int32)
+    img_r = jnp.arange(gh).repeat(gw)
+    img_c = jnp.tile(jnp.arange(gw), gh)
+    img_coords = jnp.stack([img_f, img_r, img_c], axis=-1)
+    cap_freqs = rope_angles(cfg, cap_coords)
+    img_freqs = rope_angles(cfg, img_coords)
+    uni_freqs = tuple(
+        jnp.concatenate([i, c], axis=0)
+        for i, c in zip(img_freqs, cap_freqs))
+
+    x = nn.linear(params["x_embed"], img_tokens)
+    for blk in params["noise_refiner"]:
+        x = _block(blk, cfg, x, img_freqs, adaln)
+
+    cap = nn.linear(params["cap_embed"],
+                    rms_norm(cap_feats, params["cap_norm"]["w"],
+                             cfg.norm_eps))
+    for blk in params["context_refiner"]:
+        cap = _block(blk, cfg, cap, cap_freqs)
+
+    # unified sequence: image first, caption after (UnifiedPrepare,
+    # z_image_transformer.py:93-103)
+    u = jnp.concatenate([x, cap], axis=1)
+    for blk in params["layers"]:
+        u = _block(blk, cfg, u, uni_freqs, adaln, attn_fn=attn_fn)
+
+    # final layer over the image tokens
+    scale = 1.0 + nn.linear(params["final_adaln"], jax.nn.silu(adaln))
+    out = nn.layernorm({}, u[:, :s_img]) * scale[:, None, :]
+    return nn.linear(params["final_out"], out)
+
+
+def flops_per_token(cfg: ZImageDiTConfig) -> float:
+    """Rough matmul FLOPs/token for MFU accounting."""
+    d = cfg.dim
+    return 2 * (4 * d * d + 3 * d * cfg.ffn_dim)
