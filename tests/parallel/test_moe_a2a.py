@@ -1,0 +1,103 @@
+"""All-to-all EP token dispatch (ops/moe.py routed_moe_ep_a2a):
+numerics must equal the dense oracle at sufficient capacity, and the
+per-shard grouped-matmul row count must drop ~ep x vs the masked-psum
+variant (VERDICT r2 weak #9 / next #10; reference: fused-MoE all-to-all,
+worker/gpu_ar_model_runner.py:522-523)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.ops import moe as moe_ops
+from vllm_omni_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def _mesh(dp, ep):
+    return build_mesh(
+        MeshConfig(data_parallel_size=dp, expert_parallel_size=ep),
+        jax.devices()[: dp * ep])
+
+
+def _rand_moe(key, t=32, hidden=16, e=8, inter=8):
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (t, hidden), jnp.float32)
+    router_w = jax.random.normal(ks[1], (hidden, e), jnp.float32) * 0.5
+    gate_up = jax.random.normal(ks[2], (e, hidden, 2 * inter),
+                                jnp.float32) * 0.2
+    down = jax.random.normal(ks[3], (e, inter, hidden), jnp.float32) * 0.2
+    return x, router_w, gate_up, down
+
+
+@pytest.mark.parametrize("dp,ep", [(1, 4), (2, 4), (1, 8)])
+def test_a2a_matches_local_oracle(dp, ep):
+    x, rw, gu, dn = _rand_moe(jax.random.PRNGKey(0))
+    k = 2
+    want = moe_ops.routed_moe(x, rw, gu, dn, k)
+    got = moe_ops.routed_moe_ep_a2a(
+        x, rw, gu, dn, k, _mesh(dp, ep), capacity_factor=float(ep))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_a2a_per_shard_rows_scale_down():
+    """The per-shard grouped matmul processes ep*C rows; with the default
+    capacity factor that is ~T*k*factor/ep — an ep-fold drop vs the
+    masked-psum variant's full T*k."""
+    t, k, ep, factor = 64, 2, 8, 2.0
+    tl = t // ep
+    capacity = max(1, math.ceil(k * tl / ep * factor))
+    rows_a2a = ep * capacity
+    rows_masked = t * k
+    assert rows_a2a * (ep / factor) == pytest.approx(rows_masked, rel=0.3)
+    assert rows_a2a < rows_masked / 2
+
+
+def test_a2a_capacity_drops_are_weight_zero():
+    """With capacity 1 pair per bucket, overflow pairs are dropped —
+    output stays finite and deterministic (no garbage slots)."""
+    x, rw, gu, dn = _rand_moe(jax.random.PRNGKey(1))
+    got = moe_ops.routed_moe_ep_a2a(
+        x, rw, gu, dn, 2, _mesh(1, 4), capacity_factor=0.25)
+    got2 = moe_ops.routed_moe_ep_a2a(
+        x, rw, gu, dn, 2, _mesh(1, 4), capacity_factor=0.25)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(got2))
+
+
+def test_a2a_fallback_when_indivisible():
+    """Token counts that don't divide dp*ep fall back to the masked-psum
+    path (still exact)."""
+    x, rw, gu, dn = _rand_moe(jax.random.PRNGKey(2), t=30)
+    k = 2
+    want = moe_ops.routed_moe(x, rw, gu, dn, k)
+    got = moe_ops.routed_moe_ep_a2a(x, rw, gu, dn, k, _mesh(1, 4))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_transformer_a2a_dispatch_matches_dense():
+    """forward_hidden with moe_dispatch='a2a' under an ep mesh equals the
+    dense oracle."""
+    import dataclasses
+
+    cfg = tfm.TransformerConfig.tiny_moe(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, jnp.float32)
+    ids = jnp.asarray(
+        np.arange(1, 33, dtype=np.int32).reshape(1, 32) % 60)
+    dense_cfg = dataclasses.replace(cfg, moe_dispatch="dense")
+    want = tfm.forward_hidden(params, dense_cfg, ids)
+    # ep=2: default capacity (factor 2) provably covers every local pair
+    # -> exact equality with the dense oracle
+    mesh = _mesh(2, 2)
+    a2a_cfg = dataclasses.replace(cfg, moe_dispatch="a2a")
+    moe_ops.set_ep_mesh(mesh)
+    try:
+        got = tfm.forward_hidden(params, a2a_cfg, ids)
+    finally:
+        moe_ops.set_ep_mesh(None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)

@@ -121,6 +121,107 @@ def _routed_moe_ep_shard(x, router_w, gate_up, down, k: int):
     return jax.lax.psum(out, "ep").astype(x.dtype)
 
 
+def _moe_a2a_shard(x, router_w, gate_up, down, k: int, capacity: int):
+    """Per-shard body of all-to-all EP dispatch (inside shard_map over
+    ``ep``): tokens are SHARDED over ep (x is the local [Tl, H] slice).
+
+    GShard/Switch-style capacity dispatch: each shard scatters its
+    routed pairs into per-destination buckets [ep, C, H], one
+    ``lax.all_to_all`` ships them to the experts' shards, the local slab
+    runs ONE grouped matmul over the received [ep*C] rows, and the
+    reverse all_to_all brings results home for the weighted combine.
+    Per-shard grouped-matmul rows = ep*C ≈ T*k*factor/ep — compute
+    scales DOWN with ep (the property the masked-psum variant lacks;
+    VERDICT r2 weak #9).  Pairs beyond a bucket's capacity are dropped
+    (combine weight 0); capacity_factor sizes the headroom.
+    """
+    ep = jax.lax.axis_size("ep")
+    e_local = gate_up.shape[0]
+    tl, hidden = x.shape
+    p = tl * k
+
+    topk_idx, topk_w = router_topk(x, router_w, k)
+    flat_e = topk_idx.reshape(-1)                  # [P] global expert ids
+    flat_w = topk_w.reshape(-1)
+    dest = flat_e // e_local                       # destination shard
+    local_e = flat_e % e_local
+
+    # slot within the destination bucket (stable order by dest)
+    order = jnp.argsort(dest)
+    sdest = dest[order]
+    counts = jnp.bincount(dest, length=ep)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(p) - starts[sdest]
+    keep = pos < capacity
+    slot = jnp.minimum(pos, capacity - 1)
+
+    def scatter(vals, width, dtype):
+        """[P] (or [P, H]) values -> [ep, C, ...] buckets; dropped pairs
+        contribute zero via masked add (no slot collisions among kept)."""
+        buf = jnp.zeros((ep, capacity) + (() if width == 0 else (width,)),
+                        dtype)
+        mask = keep if width == 0 else keep[:, None]
+        return buf.at[sdest, slot].add(
+            jnp.where(mask, vals, jnp.zeros_like(vals)))
+
+    tok_of = order // k
+    buf_x = scatter(x[tok_of], hidden, x.dtype)
+    buf_le = scatter(local_e[order].astype(jnp.int32), 0, jnp.int32)
+
+    rx_x = jax.lax.all_to_all(buf_x, "ep", 0, 0)     # [ep_src, C, H]
+    rx_le = jax.lax.all_to_all(buf_le, "ep", 0, 0)
+
+    rows = rx_x.reshape(ep * capacity, hidden)
+    les = rx_le.reshape(ep * capacity)
+    ro = jnp.argsort(les)
+    group_sizes = jnp.bincount(les, length=e_local)
+    y = _grouped_mlp(rows[ro], gate_up, down, group_sizes)
+    y = jnp.zeros_like(y).at[ro].set(y)              # unsort
+    ret = jax.lax.all_to_all(
+        y.reshape(ep, capacity, hidden), "ep", 0, 0)  # back at sources
+
+    got = ret[sdest, slot]                           # [P, H] per pair
+    w = jnp.where(keep, flat_w[order], 0.0)
+    out = jnp.zeros((tl, hidden), got.dtype).at[tok_of].add(
+        got * w[:, None].astype(got.dtype))
+    return out.astype(x.dtype)
+
+
+def routed_moe_ep_a2a(x, router_w, gate_up, down,
+                      num_experts_per_tok: int, mesh,
+                      capacity_factor: float = 2.0) -> jax.Array:
+    """Token-sharded dp x ep all-to-all EP dispatch (reference: fused MoE
+    all-to-all, worker/gpu_ar_model_runner.py:522-523; SURVEY §2.11 EP).
+    Tokens shard over (dp, ep); experts over ep.  Requires divisibility —
+    callers fall back to ``routed_moe_ep`` otherwise."""
+    import math
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ep = ax.get("ep", 1)
+    dp = ax.get("dp", 1)
+    t = x.shape[0]
+    e = gate_up.shape[0]
+    if ep == 1 or t % (dp * ep) or e % ep:
+        return routed_moe_ep(x, router_w, gate_up, down,
+                             num_experts_per_tok, mesh)
+    tl = t // (dp * ep)
+    capacity = max(1, math.ceil(
+        num_experts_per_tok * tl / ep * capacity_factor))
+    fn = shard_map(
+        lambda xx, rw, gu, dn: _moe_a2a_shard(
+            xx, rw, gu, dn, num_experts_per_tok, capacity),
+        mesh=mesh,
+        in_specs=(P(("dp", "ep")), P(), P("ep"), P("ep")),
+        out_specs=P(("dp", "ep")),
+        check_vma=False,
+    )
+    return fn(x, router_w, gate_up, down)
+
+
 def routed_moe_ep(x, router_w, gate_up, down, num_experts_per_tok: int,
                   mesh) -> jax.Array:
     """Expert-parallel routed MoE: experts sharded over the ``ep`` mesh
