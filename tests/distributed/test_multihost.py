@@ -1,0 +1,189 @@
+"""Multi-host bring-up (VERDICT r2 next #7).
+
+1. Cross-host stage placement: a pipeline whose stage 1 runs in a
+   SEPARATE process started via the serve-stage CLI (simulating another
+   host), connected over TCP — directly and via KV-store discovery
+   (reference: Ray per-node stage scheduling, distributed/ray_utils/
+   utils.py:1; connector address exchange, mooncake_connector.py:22).
+2. jax.distributed: a two-process CPU runtime building ONE global mesh
+   and running a cross-process collective (skipped when this jax build
+   lacks cross-process CPU collectives).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["OMNI_TPU_LOG_LEVEL"] = "WARNING"
+    return env
+
+
+def _stage_yaml(tmp_path, stage1_runtime: dict) -> str:
+    doc = {"stage_args": [
+        {
+            "stage_id": 0,
+            "stage_type": "llm",
+            "engine_args": {
+                "model_factory": "tests.helpers:tiny_lm_factory",
+                "num_pages": 64, "page_size": 4, "max_model_len": 128,
+            },
+            "engine_input_source": [-1],
+            "default_sampling_params": {"temperature": 0.0,
+                                        "max_tokens": 4},
+        },
+        {
+            "stage_id": 1,
+            "stage_type": "llm",
+            "runtime": {"process": True, "transport": "tcp",
+                        **stage1_runtime},
+            "engine_args": {
+                "model_factory": "tests.helpers:tiny_lm_factory",
+                "num_pages": 64, "page_size": 4, "max_model_len": 128,
+            },
+            "engine_input_source": [0],
+            "final_output": True,
+            "final_output_type": "text",
+            "default_sampling_params": {"temperature": 0.0,
+                                        "max_tokens": 4},
+        },
+    ]}
+    p = tmp_path / "pipeline.yaml"
+    p.write_text(yaml.safe_dump(doc))
+    return str(p)
+
+
+def _run_remote_pipeline(tmp_path, stage1_runtime, worker_args):
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    path = _stage_yaml(tmp_path, stage1_runtime)
+    # generous retry window: the orchestrator only starts listening after
+    # stage 0's engine build, which is minutes on a loaded single-core CI
+    wlog = open(os.path.join(str(tmp_path), "worker.log"), "wb")
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "vllm_omni_tpu.entrypoints.cli.main",
+         "serve-stage", "--stage-configs", path, "--stage-id", "1",
+         "--retry-timeout", "900", *worker_args],
+        env=_child_env(), cwd=REPO, stdout=wlog, stderr=wlog,
+    )
+    try:
+        omni = Omni(stage_configs=path)
+        outs = omni.generate([[1, 2, 3]])
+        assert len(outs) == 1
+        got = outs[0].outputs[0].token_ids
+        # oracle: the same two-stage pipeline fully in-proc
+        from vllm_omni_tpu.config.stage import (
+            load_stage_configs_from_yaml,
+        )
+
+        cfgs = load_stage_configs_from_yaml(path)
+        for c in cfgs:
+            c.runtime.process = False
+            c.runtime.remote = False
+        want = Omni(stage_configs=cfgs).generate(
+            [[1, 2, 3]])[0].outputs[0].token_ids
+        assert got == want
+        for s in omni.stages:
+            if hasattr(s, "shutdown"):
+                s.shutdown()
+    finally:
+        worker.terminate()
+        worker.wait(timeout=30)
+        wlog.close()
+        log = (tmp_path / "worker.log").read_bytes()
+        if log:
+            print("---- worker log ----\n", log.decode(errors="replace"))
+
+
+def test_remote_stage_direct_connect(tmp_path):
+    port = _free_port()
+    _run_remote_pipeline(
+        tmp_path,
+        {"remote": True, "bind_host": "127.0.0.1", "bind_port": port},
+        ["--connect", f"127.0.0.1:{port}"],
+    )
+
+
+def test_remote_stage_kv_discovery(tmp_path):
+    from vllm_omni_tpu.distributed.tcp import KVStoreServer
+
+    store = KVStoreServer("127.0.0.1", 0)
+    try:
+        _run_remote_pipeline(
+            tmp_path,
+            {"remote": True, "bind_host": "127.0.0.1",
+             "discovery": store.address},
+            ["--discover", store.address],
+        )
+    finally:
+        store.close()
+
+
+def test_jax_distributed_two_process_mesh(tmp_path):
+    """Two OS processes join one jax.distributed runtime; a Mesh over the
+    2 global devices runs a cross-process reduction."""
+    script = tmp_path / "mh_worker.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        pid = int(sys.argv[1]); coord = sys.argv[2]; out = sys.argv[3]
+        jax.distributed.initialize(coord, num_processes=2, process_id=pid)
+        assert len(jax.devices()) == 2, jax.devices()
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        local = jnp.full((1, 4), float(pid + 1))
+        garr = jax.make_array_from_single_device_arrays(
+            (2, 4), NamedSharding(mesh, P("dp")),
+            [jax.device_put(local, jax.local_devices()[0])])
+        total = jax.jit(
+            lambda a: a.sum(),
+            out_shardings=NamedSharding(mesh, P()))(garr)
+        with open(out, "w") as f:
+            f.write(str(float(total)))
+    """))
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = _child_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), coord,
+             str(tmp_path / f"out{i}.txt")],
+            env=env, cwd=REPO,
+            stderr=subprocess.PIPE, stdout=subprocess.PIPE)
+        for i in range(2)
+    ]
+    rcs = [p.wait(timeout=300) for p in procs]
+    if any(rcs):
+        err = b"\n".join(p.stderr.read()[-2000:] for p in procs)
+        if (b"UNIMPLEMENTED" in err or b"not supported" in err
+                or b"NotImplemented" in err):
+            pytest.skip(f"cross-process CPU collectives unsupported: "
+                        f"{err[-300:]!r}")
+        raise AssertionError(f"workers failed rc={rcs}: {err[-2000:]!r}")
+    for i in range(2):
+        assert float((tmp_path / f"out{i}.txt").read_text()) == 12.0

@@ -62,6 +62,21 @@ class StageRuntime:
     # "tcp" (default; also cross-host) | "shm" (native C++ shared-memory
     # rings, same-host — vllm_omni_tpu/native/shm_ring.cpp)
     transport: str = "tcp"
+    # Cross-HOST stage placement (reference: Ray per-node worker
+    # scheduling, distributed/ray_utils/utils.py): remote=True makes the
+    # orchestrator LISTEN on (bind_host, bind_port) instead of spawning a
+    # local child; the worker is started on its host with the serve-stage
+    # CLI and connects (directly, or via KV-store discovery when
+    # ``discovery`` is a store address the orchestrator publishes to).
+    remote: bool = False
+    bind_host: str = "127.0.0.1"
+    bind_port: int = 0
+    discovery: str = ""
+    # address REMOTE workers should dial (published to discovery): the
+    # bind address is often undialable (0.0.0.0, or 127.0.0.1 from
+    # another host); defaults to this host's primary IP when binding all
+    # interfaces, else bind_host
+    advertise_host: str = ""
 
 
 @dataclass
@@ -147,7 +162,15 @@ def load_stage_configs_from_model(
     p = resolve_model_config_path(model)
     if p is not None:
         logger.info("Using stage config %s for model %s", p, model)
-        return load_stage_configs_from_yaml(p)
+        stages = load_stage_configs_from_yaml(p)
+        for s in stages:
+            # Single-model stages inherit the user's checkpoint path
+            # (reference: the serve CLI's model arg overrides the stage
+            # YAML's model field); factory-built stages keep theirs.
+            if ("model" not in s.engine_args
+                    and "model_factory" not in s.engine_args):
+                s.engine_args["model"] = model
+        return stages
     # Single-stage default, like the reference's diffusion autodetect
     # (cli/serve.py:55-63): model_index.json => diffusion.
     stage_type = "llm"

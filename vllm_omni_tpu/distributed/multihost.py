@@ -1,0 +1,123 @@
+"""Multi-host bring-up: jax.distributed initialization + global meshes.
+
+Role of the reference's Ray-based multi-node runtime (reference:
+vllm_omni/distributed/ray_utils/utils.py:1 — placement groups + per-node
+worker scheduling; NCCL groups spanning hosts).  The TPU-native shape has
+no Ray and no NCCL: ``jax.distributed.initialize`` joins every host
+process into ONE JAX runtime whose ``jax.devices()`` spans all hosts, a
+``Mesh`` over those devices gives multi-host SPMD (XLA routes collectives
+over ICI within a slice and DCN across slices), and cross-host *stage*
+placement rides remote stage workers over the TCP transport
+(entrypoints/stage_proc.py remote mode + KV-store address discovery).
+
+Env bring-up (each host process):
+    OMNI_TPU_COORDINATOR=host:port   # process 0's address
+    OMNI_TPU_NUM_PROCESSES=N
+    OMNI_TPU_PROCESS_ID=i
+then ``initialize()`` (or let the engine call ``ensure_initialized()``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Join this process into the multi-host JAX runtime.  Arguments
+    default from the OMNI_TPU_* env registry; no-op when already
+    initialized."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "OMNI_TPU_COORDINATOR")
+    if num_processes is None:
+        env = os.environ.get("OMNI_TPU_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("OMNI_TPU_PROCESS_ID")
+        process_id = int(env) if env else None
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _INITIALIZED = True
+    logger.info(
+        "multi-host runtime up: process %d/%d, %d global devices "
+        "(%d local)", jax.process_index(), jax.process_count(),
+        len(jax.devices()), len(jax.local_devices()))
+
+
+def ensure_initialized() -> bool:
+    """Initialize iff the env requests multi-host; returns whether the
+    process is part of a multi-host runtime."""
+    if _INITIALIZED:
+        return True
+    if os.environ.get("OMNI_TPU_COORDINATOR"):
+        initialize()
+        return True
+    return False
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index() if _INITIALIZED else 0
+
+
+def global_mesh(mesh_config):
+    """Mesh over ALL hosts' devices (jax.devices() is global after
+    initialize); shardings over it make XLA insert cross-host
+    collectives."""
+    import jax
+
+    from vllm_omni_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(mesh_config, jax.devices())
+
+
+# ------------------------------------------------- stage address discovery
+def publish_stage_address(store_address: str, stage_id: int,
+                          address: str) -> None:
+    """Orchestrator side: announce where a remote stage worker should
+    connect (KV-store discovery — the analogue of the reference's
+    connector address exchange, mooncake_connector.py:22)."""
+    from vllm_omni_tpu.distributed.tcp import TCPConnector
+
+    conn = TCPConnector(address=store_address)
+    conn.put(f"stage-addr/{stage_id}", {"address": address})
+
+
+def discover_stage_address(store_address: str, stage_id: int,
+                           timeout: float = 120.0) -> str:
+    """Remote worker side: look up the orchestrator's listener for this
+    stage."""
+    from vllm_omni_tpu.distributed.tcp import TCPConnector
+
+    conn = TCPConnector(address=store_address)
+    payload = conn.get(f"stage-addr/{stage_id}", timeout=timeout)
+    if not payload:
+        raise TimeoutError(
+            f"no address published for stage {stage_id} at "
+            f"{store_address}")
+    return payload["address"]

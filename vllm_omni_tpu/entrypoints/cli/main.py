@@ -69,6 +69,17 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_serve_stage(args) -> int:
+    from vllm_omni_tpu.entrypoints.stage_proc import run_remote_stage
+
+    run_remote_stage(
+        args.stage_configs, args.stage_id,
+        connect=args.connect, discover=args.discover,
+        retry_timeout=args.retry_timeout,
+    )
+    return 0
+
+
 def cmd_bench_serve(args) -> int:
     from vllm_omni_tpu.benchmarks.serving import run_from_args
 
@@ -104,6 +115,22 @@ def main(argv=None) -> int:
 
     add_cli_args(bserve)
     bserve.set_defaults(fn=cmd_bench_serve)
+
+    sstage = sub.add_parser(
+        "serve-stage",
+        help="run one pipeline stage as a REMOTE worker connecting to an "
+             "orchestrator on another host (cross-host stage placement; "
+             "reference: Ray per-node workers, ray_utils/utils.py)",
+    )
+    sstage.add_argument("--stage-configs", required=True,
+                        help="stage YAML (same file the orchestrator uses)")
+    sstage.add_argument("--stage-id", type=int, required=True)
+    sstage.add_argument("--connect", default=None,
+                        help="orchestrator listener host:port")
+    sstage.add_argument("--discover", default=None,
+                        help="KV-store address publishing stage listeners")
+    sstage.add_argument("--retry-timeout", type=float, default=120.0)
+    sstage.set_defaults(fn=cmd_serve_stage)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -107,6 +107,52 @@ class _ShmChannel:
                 ring.close()
 
 
+def _primary_ip() -> str:
+    """This host's primary outbound IP (no packets are sent — a UDP
+    connect only selects the route)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def _start_scoped(proc, device_env: Optional[dict]) -> None:
+    """Start a worker process with ``device_env`` applied to the env the
+    child is SPAWNED with (temporarily mutating the parent's environ
+    around start()).
+
+    Applying device_env only inside the child's main is too late for
+    platform scoping: deployment site-dirs (e.g. a TPU tunnel plugin's
+    sitecustomize on PYTHONPATH) eagerly initialize their backend at
+    interpreter startup, and an unhealthy chip tunnel then hangs the
+    child before it reaches our code.  CPU-scoped children additionally
+    drop such plugin site-dirs from PYTHONPATH (multiprocessing restores
+    the parent's full sys.path afterwards, so imports are unaffected)."""
+    import os
+
+    from vllm_omni_tpu.platforms import scrub_plugin_sitedirs
+
+    updates = dict(device_env or {})
+    if (updates.get("JAX_PLATFORMS", "").startswith("cpu")
+            and "PYTHONPATH" not in updates):
+        updates["PYTHONPATH"] = scrub_plugin_sitedirs(
+            os.environ.get("PYTHONPATH", ""))
+    saved = {k: os.environ.get(k) for k in updates}
+    os.environ.update({k: str(v) for k, v in updates.items()})
+    try:
+        proc.start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _worker_channel(conn_info) -> "_SockChannel | _ShmChannel":
     """Child side of the orchestrator<->worker channel."""
     kind = conn_info[0]
@@ -136,6 +182,67 @@ def _stage_worker_main(config: StageConfig, conn_info: tuple,
         os.environ[k] = str(v)
 
     chan = _worker_channel(conn_info)
+    _stage_worker_serve(config, chan)
+
+
+def run_remote_stage(
+    stage_configs_path: str,
+    stage_id: int,
+    connect: Optional[str] = None,
+    discover: Optional[str] = None,
+    retry_timeout: float = 120.0,
+) -> None:
+    """Cross-HOST stage worker entry (the serve-stage CLI): resolve the
+    orchestrator's listener (explicit ``connect`` host:port, or KV-store
+    ``discover``), dial with retries (the orchestrator may not be up
+    yet), then serve the stage over the socket — the multi-host half of
+    stage disaggregation (reference: Ray per-node stage placement,
+    distributed/ray_utils/utils.py)."""
+    from vllm_omni_tpu.config.stage import load_stage_configs_from_yaml
+
+    cfgs = load_stage_configs_from_yaml(stage_configs_path)
+    config = next((c for c in cfgs if c.stage_id == stage_id), None)
+    if config is None:
+        raise ValueError(f"no stage {stage_id} in {stage_configs_path}")
+    if discover:
+        from vllm_omni_tpu.distributed.multihost import (
+            discover_stage_address,
+        )
+
+        connect = discover_stage_address(discover, stage_id,
+                                         timeout=retry_timeout)
+    if not connect:
+        raise ValueError("need connect='host:port' or discover=store")
+    host, _, port = connect.partition(":")
+    deadline = time.monotonic() + retry_timeout
+    while True:
+        try:
+            sock = socket.create_connection((host, int(port)),
+                                            timeout=5.0)
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.5)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # the dial timeout must NOT persist: recv() blocks for minutes while
+    # the orchestrator compiles, and a lingering 5s timeout would make
+    # the reader thread conclude the peer died
+    sock.settimeout(None)
+    # watch_parent=False: a remote worker's launcher (ssh/nohup/
+    # supervisor) legitimately exits and reparents us — orchestrator
+    # death shows up as socket EOF instead
+    _stage_worker_serve(config, _SockChannel(sock), watch_parent=False)
+
+
+def _stage_worker_serve(config: StageConfig, chan,
+                        watch_parent: bool = True) -> None:
+    """Engine build → ready handshake → serve loop (shared by local
+    children and remote serve-stage workers).  ``watch_parent`` enables
+    the getppid watchdog — only meaningful for locally-SPAWNED children
+    whose parent is the orchestrator (shm rings carry no EOF)."""
+    import os
+
     try:
         stage = OmniStage(config)
     except Exception as e:  # surface build failures to the orchestrator
@@ -152,10 +259,13 @@ def _stage_worker_main(config: StageConfig, conn_info: tuple,
             while True:
                 msg = chan.recv()
                 if msg is None:
+                    logger.warning("stage %d: channel EOF from "
+                                   "orchestrator", config.stage_id)
                     break
                 inbox.put(msg)
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError) as e:
+            logger.warning("stage %d: channel error: %s",
+                           config.stage_id, e)
         inbox.put({"type": "shutdown"})  # orchestrator gone
 
     threading.Thread(target=reader, daemon=True).start()
@@ -163,7 +273,7 @@ def _stage_worker_main(config: StageConfig, conn_info: tuple,
     parent = os.getppid()
     running = True
     while running:
-        if os.getppid() != parent:
+        if watch_parent and os.getppid() != parent:
             # orchestrator died (shm rings carry no EOF the way a socket
             # does) — exit instead of holding the chip forever
             logger.warning("stage %d: orchestrator gone; shutting down",
@@ -283,25 +393,57 @@ class ProcStage(OmniStage):
                 args=(config, conn_info, device_env),
                 daemon=True,
             )
-            self._proc.start()
+            _start_scoped(self._proc, device_env)
             self._chan = _ShmChannel(tx=tx, rx=rx)
         elif transport == "tcp":
+            remote = getattr(config.runtime, "remote", False)
+            bind_host = (getattr(config.runtime, "bind_host", "127.0.0.1")
+                         if remote else "127.0.0.1")
+            bind_port = (getattr(config.runtime, "bind_port", 0)
+                         if remote else 0)
             listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            listener.bind(("127.0.0.1", 0))
+            listener.bind((bind_host, bind_port))
             listener.listen(1)
-            ctx = mp.get_context("spawn")
-            self._proc = ctx.Process(
-                target=_stage_worker_main,
-                args=(config, ("tcp", listener.getsockname()), device_env),
-                daemon=True,
-            )
-            self._proc.start()
+            if remote:
+                # cross-host placement: the worker runs on ANOTHER host
+                # (serve-stage CLI) and connects here; optionally publish
+                # a DIALABLE address for KV-store discovery (the bind
+                # address may be 0.0.0.0 or loopback — undialable from
+                # the worker's host)
+                self._proc = None
+                port = listener.getsockname()[1]
+                adv = getattr(config.runtime, "advertise_host", "")
+                if not adv:
+                    adv = (_primary_ip() if bind_host == "0.0.0.0"
+                           else bind_host)
+                addr = f"{adv}:{port}"
+                discovery = getattr(config.runtime, "discovery", "")
+                if discovery:
+                    from vllm_omni_tpu.distributed.multihost import (
+                        publish_stage_address,
+                    )
+
+                    publish_stage_address(discovery, self.stage_id, addr)
+                logger.info(
+                    "stage %d: waiting for REMOTE worker on %s "
+                    "(serve-stage CLI on the other host)",
+                    self.stage_id, addr)
+            else:
+                ctx = mp.get_context("spawn")
+                self._proc = ctx.Process(
+                    target=_stage_worker_main,
+                    args=(config, ("tcp", listener.getsockname()),
+                          device_env),
+                    daemon=True,
+                )
+                _start_scoped(self._proc, device_env)
             listener.settimeout(ready_timeout)
             try:
                 sock, _ = listener.accept()
             except socket.timeout:
-                self._proc.terminate()
+                if self._proc is not None:
+                    self._proc.terminate()
                 raise TimeoutError(
                     f"stage {self.stage_id}: worker process did not "
                     f"connect within {ready_timeout}s — check the child's "
@@ -325,11 +467,12 @@ class ProcStage(OmniStage):
                 msg = self._chan.recv()
                 break
             except socket.timeout:
-                if not self._proc.is_alive():
+                if self._proc is not None and not self._proc.is_alive():
                     break
         if msg is None or msg.get("type") != "stage_ready":
             err = (msg or {}).get("error", "worker hung up or timed out")
-            self._proc.terminate()
+            if self._proc is not None:
+                self._proc.terminate()
             raise RuntimeError(
                 f"stage {self.stage_id}: worker failed to become ready: "
                 f"{err}"
@@ -351,6 +494,11 @@ class ProcStage(OmniStage):
                 self._inbox.put(msg)
         except (ConnectionError, OSError):
             pass
+        # channel EOF is the ONLY death signal a REMOTE worker gives us
+        # (self._proc is None, so poll()'s is_alive check never fires) —
+        # without this, in-flight requests spin forever
+        if self._fatal is None and self._inflight:
+            self._fatal = "worker channel closed"
 
     # ------------------------------------------------------------- intake
     def submit(self, reqs: list[StageRequest]) -> None:
@@ -386,7 +534,7 @@ class ProcStage(OmniStage):
                 self._inflight.discard(o.request_id)
             self._record(o)
         if self._inflight and self._fatal is None \
-                and not self._proc.is_alive():
+                and self._proc is not None and not self._proc.is_alive():
             self._fatal = f"worker exited (code {self._proc.exitcode})"
         if self._inflight and self._fatal is not None:
             # fail every in-flight request on this stage; the pipeline
@@ -457,10 +605,11 @@ class ProcStage(OmniStage):
                 self._chan.send({"type": "shutdown"})
         except (ConnectionError, OSError):
             pass
-        self._proc.join(timeout)
-        if self._proc.is_alive():
-            self._proc.terminate()
-            self._proc.join(5.0)
+        if self._proc is not None:
+            self._proc.join(timeout)
+            if self._proc.is_alive():
+                self._proc.terminate()
+                self._proc.join(5.0)
         self._chan.close()
 
     def __del__(self) -> None:

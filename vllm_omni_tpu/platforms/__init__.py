@@ -55,6 +55,22 @@ def reset_platform() -> None:
     _current = None
 
 
+def scrub_plugin_sitedirs(pythonpath: str) -> str:
+    """Drop PYTHONPATH entries whose sitecustomize eagerly initializes a
+    hardware backend (they hang CPU-scoped children at interpreter
+    startup when the device tunnel is unhealthy).  The entry pattern is
+    the OMNI_TPU_STRIP_SITEDIRS env var (substring match on the path
+    basename; default "axon" for the TPU tunnel plugin deployment)."""
+    import os
+
+    pattern = os.environ.get("OMNI_TPU_STRIP_SITEDIRS", "axon")
+    if not pythonpath or not pattern:
+        return pythonpath
+    keep = [p for p in pythonpath.split(os.pathsep)
+            if p and pattern not in os.path.basename(p)]
+    return os.pathsep.join(keep)
+
+
 def default_stage_device_env(devices: str = "all") -> dict:
     """Child-process device scoping WITHOUT initializing jax in the
     caller: the orchestrator parent of an all-process pipeline must never

@@ -29,8 +29,18 @@ class CpuPlatform(OmniPlatform):
         return 0.5  # rough host-CPU figure; MFU on CPU is informational
 
     def stage_device_env(self, devices: str = "all") -> dict:
-        # children must not grab a TPU the parent may hold
-        return {"JAX_PLATFORMS": "cpu", "OMNI_TPU_PALLAS_INTERPRET": "1"}
+        # children must not grab a TPU the parent may hold — nor load
+        # ambient TPU PJRT plugins whose sitecustomize hangs at startup
+        # when the chip tunnel is unhealthy (scrub_plugin_sitedirs)
+        import os
+
+        from vllm_omni_tpu.platforms import scrub_plugin_sitedirs
+
+        env = {"JAX_PLATFORMS": "cpu", "OMNI_TPU_PALLAS_INTERPRET": "1"}
+        pp = os.environ.get("PYTHONPATH", "")
+        if pp:
+            env["PYTHONPATH"] = scrub_plugin_sitedirs(pp)
+        return env
 
     def preferred_dtype(self):
         import jax.numpy as jnp
