@@ -1,0 +1,160 @@
+"""Qwen3-TTS 12.5 Hz speech-tokenizer decoder (VERDICT r2 next #6;
+reference: qwen3_tts/tokenizer_12hz/modeling_qwen3_tts_tokenizer_v2.py).
+
+Pins: waveform geometry (1920x upsample at real scale), causal
+chunked-decode equivalence (the property the reference's streaming
+chunked_decode relies on), RVQ nearest-neighbour quantization, full
+checkpoint name-map coverage from a synthetic HF-layout checkpoint, and
+the text -> codec -> waveform stage pipeline e2e."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.models.qwen3_tts import tokenizer_12hz as tk
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tk.Tokenizer12HzConfig.tiny()
+    params = tk.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _codes(cfg, t, seed=0, b=1):
+    return jnp.asarray(np.random.default_rng(seed).integers(
+        0, cfg.codebook_size, (b, cfg.num_quantizers, t)))
+
+
+def test_decode_shapes_and_determinism(tiny):
+    params, cfg = tiny
+    codes = _codes(cfg, 12, b=2)
+    wav = tk.decode_codes(params, cfg, codes)
+    assert wav.shape == (2, 12 * cfg.total_upsample)
+    assert np.isfinite(np.asarray(wav)).all()
+    assert np.abs(np.asarray(wav)).max() <= 1.0
+    wav2 = tk.decode_codes(params, cfg, codes)
+    np.testing.assert_array_equal(np.asarray(wav), np.asarray(wav2))
+
+
+def test_real_geometry_upsample_rate():
+    cfg = tk.Tokenizer12HzConfig()
+    # 12.5 Hz frames -> 24 kHz samples (reference decode_upsample_rate)
+    assert cfg.total_upsample == 1920
+    assert cfg.output_sample_rate / cfg.total_upsample == 12.5
+
+
+def test_chunked_decode_matches_full(tiny):
+    """Causality: chunked decode with enough left context equals the
+    full decode (reference chunked_decode semantics)."""
+    params, cfg = tiny
+    codes = _codes(cfg, 40, seed=3)
+    full = np.asarray(tk.decode_codes(params, cfg, codes))
+    # left context >= every chunk start -> full causal history -> exact
+    exact = tk.chunked_decode(params, cfg, codes, chunk_size=16,
+                              left_context=40)
+    assert exact.shape == full.shape
+    np.testing.assert_allclose(exact, full, atol=2e-5, rtol=2e-5)
+    # the reference streams with a BOUNDED context (25 frames) and
+    # accepts tail-of-receptive-field error; ours stays small too
+    approx = tk.chunked_decode(params, cfg, codes, chunk_size=16,
+                               left_context=24)
+    np.testing.assert_allclose(approx, full, atol=3e-2)
+
+
+def test_rvq_quantize_recovers_codebook_entries(tiny):
+    """Nearest-neighbour quantization: inputs sitting on (projected)
+    codebook entries come back as their own indices."""
+    params, cfg = tiny
+    rvq = jax.tree.map(lambda x: x, params["rvq_first"])
+    # identity input projection onto the first vq_dim dims
+    eye = np.zeros((cfg.codebook_dim, cfg.vq_dim), np.float32)
+    eye[: cfg.vq_dim, :] = np.eye(cfg.vq_dim)
+    rvq["input_proj"]["w"] = jnp.asarray(eye)
+    emb = np.asarray(tk._codebook(rvq["layers"][0]))
+    want = np.array([3, 7, 1, 30])
+    x = np.zeros((1, len(want), cfg.codebook_dim), np.float32)
+    x[0, :, : cfg.vq_dim] = emb[want] + 1e-4
+    codes = tk._rvq_quantize(rvq, jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(codes[0, 0]), want)
+
+
+def test_checkpoint_name_map_full_coverage(tmp_path, tiny):
+    """A synthetic HF-layout checkpoint (torch tensor layouts) must
+    cover every decoder leaf through the name map + transforms."""
+    from safetensors.numpy import save_file
+
+    _, cfg = tiny
+    flat = tk.hf_flat_map(cfg)
+    shapes = jax.eval_shape(
+        lambda: tk.init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+
+    def torch_shape(name, path, our_shape):
+        if len(our_shape) == 3:  # ours: WIO [k, in, out]
+            k, cin, cout = our_shape
+            if any(t in name for t in tk._TCONV_MARKERS):
+                return (cin, cout, k)  # ConvTranspose1d [in, out, k]
+            return (cout, cin, k)      # Conv1d [out, in, k]
+        if len(our_shape) == 2:
+            if "embedding_sum" in name:
+                return our_shape
+            if "input_proj" in name or "output_proj" in name:
+                return (our_shape[1], our_shape[0], 1)  # 1x1 conv
+            return (our_shape[1], our_shape[0])         # linear
+        return our_shape
+
+    rng = np.random.default_rng(0)
+    sd = {}
+    for hf_name, path in flat.items():
+        node = shapes
+        for key in path:
+            node = node[key]
+        sd[hf_name] = rng.standard_normal(
+            torch_shape(hf_name, path, tuple(node.shape))
+        ).astype(np.float32) * 0.05
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "decoder_config": {
+            "codebook_size": cfg.codebook_size,
+            "num_quantizers": cfg.num_quantizers,
+            "codebook_dim": cfg.codebook_dim,
+            "latent_dim": cfg.latent_dim,
+            "decoder_dim": cfg.decoder_dim,
+            "upsampling_ratios": list(cfg.upsampling_ratios),
+            "upsample_rates": list(cfg.upsample_rates),
+            "hidden_size": cfg.hidden_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "num_key_value_heads": cfg.num_kv_heads,
+            "intermediate_size": cfg.intermediate_size,
+            "sliding_window": cfg.sliding_window,
+        }}))
+    params, loaded_cfg = tk.load_decoder(str(tmp_path))
+    assert loaded_cfg == cfg
+    # loaded weights drive a working decode
+    wav = tk.decode_codes(params, cfg, _codes(cfg, 6))
+    assert wav.shape == (1, 6 * cfg.total_upsample)
+    # spot-check a transform: q_proj round-trips [out,in] -> [in,out]
+    got = np.asarray(params["transformer"]["layers"][0]["q_proj"]["w"])
+    want = sd["decoder.pre_transformer.layers.0.self_attn.q_proj.weight"].T
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_tts_pipeline_text_to_waveform():
+    """Text -> TTS LM -> 12.5Hz codec decode -> waveform through the
+    stage pipeline (qwen3_tts_tiny.yaml)."""
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    omni = Omni(model="qwen3-tts-tiny")
+    outs = omni.generate([[1, 2, 3]])
+    final = [o for o in outs if o.final_output_type == "audio"]
+    assert final, [o.final_output_type for o in outs]
+    audio = final[0].multimodal_output.get("audio")
+    assert audio is not None and audio.ndim == 1 and audio.size > 0
+    cfg = tk.Tokenizer12HzConfig.tiny()
+    # LM emitted N codec ids -> floor(N / K) frames * total_upsample
+    assert audio.size % cfg.total_upsample == 0

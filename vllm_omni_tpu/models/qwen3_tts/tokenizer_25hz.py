@@ -1,0 +1,69 @@
+"""Qwen3-TTS 25 Hz speech tokenizer (V1) — decode path.
+
+Reference: vllm_omni/model_executor/models/qwen3_tts/tokenizer_25hz/
+modeling_qwen3_tts_tokenizer_v1.py — the V1 codec decodes 25 Hz codes to
+waveform through a flow-matching mel DiT (DiTDecoderLayer stack with
+AdaLayerNormZero conditioning + DiTCodecEmbedding) followed by a
+Snake-activated BigVGAN-style vocoder, with an ECAPA-TDNN speaker
+encoder for voice conditioning.
+
+That is the SAME architecture family as this repo's Qwen2.5-Omni
+token2wav stage (models/qwen2_5_omni/token2wav.py: flow-matching mel DiT
++ transposed-conv vocoder), so the V1 decoder composes those shared
+pieces at the 25 Hz geometry instead of duplicating them — codes embed
+into the DiT's conditioning stream, the ODE integrates mel frames, and
+the vocoder renders 24 kHz audio.  Reduced depth vs the reference's
+ECAPA speaker path (speaker embeddings ride the conditioning vector when
+provided; the ECAPA encoder itself is future work at real-weight time).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.qwen2_5_omni.token2wav import (
+    Token2WavConfig,
+    Token2WavModel,
+    init_token2wav_params,
+)
+
+
+@dataclass(frozen=True)
+class Tokenizer25HzConfig:
+    """V1 geometry knobs mapped onto the shared token2wav stack
+    (reference defaults: 22-layer / 1024-hidden DiT, 16 heads,
+    mel 80, 24 kHz out)."""
+    codebook_size: int = 4096
+    frame_rate: int = 25
+    output_sample_rate: int = 24000
+    dit_hidden: int = 1024
+    dit_layers: int = 22
+    dit_heads: int = 16
+    n_mels: int = 80
+
+    def token2wav(self) -> Token2WavConfig:
+        return Token2WavConfig(
+            codec_vocab=self.codebook_size,
+            d_model=self.dit_hidden,
+            num_layers=self.dit_layers,
+            num_heads=self.dit_heads,
+            mel_bins=self.n_mels,
+        )
+
+    @staticmethod
+    def tiny() -> "Tokenizer25HzConfig":
+        return Tokenizer25HzConfig(
+            codebook_size=60, dit_hidden=32, dit_layers=2, dit_heads=4,
+            n_mels=8,
+        )
+
+
+def tiny_decoder_factory():
+    """model_factory for a 25Hz code2wav stage: (params, model, eos)."""
+    t2w_cfg = Token2WavConfig.tiny()
+    params = init_token2wav_params(jax.random.PRNGKey(25), t2w_cfg,
+                                   jnp.float32)
+    return params, Token2WavModel(t2w_cfg), None

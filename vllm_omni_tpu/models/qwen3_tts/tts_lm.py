@@ -64,3 +64,10 @@ def codec_ids_from_lm_tokens(token_ids, codec_offset: int = TINY_CODEC_OFFSET,
     pure codec ids)."""
     return [int(t) - codec_offset for t in token_ids
             if codec_offset <= int(t) < codec_offset + codec_vocab]
+
+# Real-weight loading: the TTS LM is a Qwen3-style (qk-norm) causal
+# transformer over the text+codec vocabulary, served directly by the
+# hf_qwen streaming loader — stage YAMLs point model_factory at
+# "vllm_omni_tpu.model_loader.hf_qwen:load_qwen_lm" with
+# model_factory_args {"model_dir": ..., "hf_config_name": ...}
+# (reference: modeling_qwen3_tts.py talker/LM stack).
