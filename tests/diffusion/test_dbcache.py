@@ -1,0 +1,94 @@
+"""DBCache (dual-block cache) backend — VERDICT r2 missing #7
+(reference: diffusion/cache/cache_dit_backend.py DBCacheConfig): the
+first Fn blocks compute every step as a fresh anchor; the remaining
+blocks' contribution is delta-cached and reused while the anchor's
+drift stays under threshold."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.cache import StepCacheConfig
+from vllm_omni_tpu.diffusion.request import (
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+from vllm_omni_tpu.models.qwen_image.pipeline import (
+    QwenImagePipeline,
+    QwenImagePipelineConfig,
+)
+
+
+def _gen(pipe, steps=6, seed=5):
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=steps,
+        guidance_scale=4.0, seed=seed)
+    req = OmniDiffusionRequest(prompt=["a cat"], sampling_params=sp,
+                               request_ids=["r"])
+    return pipe.forward(req)[0].data
+
+
+def test_dbcache_zero_threshold_matches_baseline():
+    cfg = QwenImagePipelineConfig.tiny()
+    base = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0)
+    db = QwenImagePipeline(
+        cfg, dtype=jnp.float32, seed=0,
+        cache_config=StepCacheConfig(backend="dbcache",
+                                     rel_l1_threshold=0.0,
+                                     fn_compute_blocks=1))
+    want = _gen(base)
+    got = _gen(db)
+    assert db.last_skipped_steps == 0
+    np.testing.assert_allclose(got.astype(np.int32),
+                               want.astype(np.int32), atol=1)
+
+
+def test_dbcache_skips_and_stays_close():
+    cfg = QwenImagePipelineConfig.tiny()
+    base = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0)
+    db = QwenImagePipeline(
+        cfg, dtype=jnp.float32, seed=0,
+        cache_config=StepCacheConfig(backend="dbcache",
+                                     rel_l1_threshold=1e9,
+                                     fn_compute_blocks=1))
+    want = _gen(base, steps=8)
+    got = _gen(db, steps=8)
+    # warmup(1) + tail(1) guards -> 6 of 8 steps reuse the tail delta
+    assert db.last_skipped_steps == 6
+    assert got.shape == want.shape
+    assert np.isfinite(got).all()
+    # the always-computed anchor keeps the output in the same regime
+    assert np.mean(np.abs(got.astype(np.float32)
+                          - want.astype(np.float32))) < 64.0
+
+
+def test_dbcache_requires_split_support():
+    """Pipelines without a split evaluation refuse dbcache instead of
+    silently running uncached."""
+    from vllm_omni_tpu.models.z_image.pipeline import (
+        ZImagePipeline,
+        ZImagePipelineConfig,
+    )
+
+    pipe = ZImagePipeline(
+        ZImagePipelineConfig.tiny(), dtype=jnp.float32, seed=0,
+        cache_config=StepCacheConfig(backend="dbcache"))
+    with pytest.raises(ValueError, match="dbcache"):
+        _gen(pipe, steps=2)
+
+
+def test_engine_accepts_dbcache_backend():
+    from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+    from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model="bench", model_arch="QwenImagePipeline", dtype="float32",
+        cache_backend="dbcache",
+        cache_config={"rel_l1_threshold": 0.3, "fn_compute_blocks": 1},
+        extra={"size": "tiny"},
+    ), warmup=False)
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=3, guidance_scale=4.0,
+        seed=0)
+    outs = eng.step(OmniDiffusionRequest(prompt=["x"], sampling_params=sp))
+    assert outs[0].data.shape == (32, 32, 3)

@@ -1,0 +1,81 @@
+"""Bagel AR+diffusion hybrid (reference: bagel/pipeline_bagel.py:153 —
+the MoT LLM prefills a context KV cache and runs the flow itself)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.request import (
+    InvalidRequestError,
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+from vllm_omni_tpu.models.bagel.pipeline import (
+    BagelPipeline,
+    BagelPipelineConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    return BagelPipeline(BagelPipelineConfig.tiny(), dtype=jnp.float32,
+                         seed=0)
+
+
+def _gen(pipe, prompts=("a cat",), seed=0, hw=16, steps=3, gscale=4.0):
+    sp = OmniDiffusionSamplingParams(
+        height=hw, width=hw, num_inference_steps=steps,
+        guidance_scale=gscale, seed=seed)
+    req = OmniDiffusionRequest(
+        prompt=list(prompts), sampling_params=sp,
+        request_ids=[f"r{i}" for i in range(len(prompts))])
+    return [o.data for o in pipe.forward(req)]
+
+
+def test_generates_and_seed_deterministic(pipe):
+    a = _gen(pipe, seed=7)
+    b = _gen(pipe, seed=7)
+    c = _gen(pipe, seed=8)
+    assert a[0].shape == (16, 16, 3) and a[0].dtype == np.uint8
+    np.testing.assert_array_equal(a[0], b[0])
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_prompt_conditions_through_kv_cache(pipe):
+    """Different prompts -> different context KV -> different images
+    (the AR-side conditioning path)."""
+    a = _gen(pipe, prompts=("red sky",), seed=3)
+    b = _gen(pipe, prompts=("blue sea",), seed=3)
+    assert not np.array_equal(a[0], b[0])
+
+
+def test_mot_generation_expert_drives_the_flow(pipe):
+    """Zeroing the GENERATION expert's attention output changes the
+    image while the understanding expert stays intact — the two MoT
+    expert sets are genuinely separate weights."""
+    base = _gen(pipe, seed=5)
+    mutated = jax.tree.map(lambda x: x, pipe.dit_params)
+    mutated["layers"][0]["gen"]["o_proj"]["w"] = jnp.zeros_like(
+        mutated["layers"][0]["gen"]["o_proj"]["w"])
+    orig = pipe.dit_params
+    pipe.dit_params = mutated
+    try:
+        got = _gen(pipe, seed=5)
+    finally:
+        pipe.dit_params = orig
+    assert not np.array_equal(base[0], got[0])
+
+
+def test_geometry_limit(pipe):
+    cfg = pipe.cfg
+    max_hw = cfg.llm.max_latent_size * cfg.vae.spatial_ratio
+    with pytest.raises(InvalidRequestError, match="exceeds"):
+        _gen(pipe, hw=max_hw * 2)
+
+
+def test_registry_resolves():
+    from vllm_omni_tpu.models.registry import DiffusionModelRegistry
+
+    assert DiffusionModelRegistry.resolve(
+        "BagelPipeline") is BagelPipeline

@@ -1,0 +1,87 @@
+"""Flux-family variants + layered generation (reference registry rows:
+ovis_image/, flux2_klein/, pipeline_qwen_image_layered.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.request import (
+    InvalidRequestError,
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+
+
+def _req(prompts=("x",), **sp_kw):
+    base = dict(height=32, width=32, num_inference_steps=2,
+                guidance_scale=4.0, seed=1)
+    base.update(sp_kw)
+    sp = OmniDiffusionSamplingParams(**base)
+    return OmniDiffusionRequest(
+        prompt=list(prompts), sampling_params=sp,
+        request_ids=[f"r{i}" for i in range(len(prompts))])
+
+
+def test_ovis_generates_plain_cfg():
+    from vllm_omni_tpu.models.ovis_image.pipeline import (
+        OvisImagePipeline,
+        OvisImagePipelineConfig,
+    )
+
+    cfg = OvisImagePipelineConfig.tiny()
+    assert cfg.cfg_renorm is False
+    pipe = OvisImagePipeline(cfg, dtype=jnp.float32, seed=0)
+    out = pipe.forward(_req())[0].data
+    assert out.shape == (32, 32, 3) and out.dtype == np.uint8
+    # real geometry: 6 double + 27 single blocks, ctx 2048
+    real = OvisImagePipelineConfig()
+    assert (real.dit.num_double_blocks, real.dit.num_single_blocks,
+            real.dit.ctx_dim) == (6, 27, 2048)
+    assert not real.dit.guidance_embed and real.dit.pooled_dim == 0
+
+
+def test_flux2_klein_generates_embedded_guidance():
+    from vllm_omni_tpu.models.flux2_klein.pipeline import (
+        Flux2KleinPipeline,
+        Flux2KleinPipelineConfig,
+    )
+
+    pipe = Flux2KleinPipeline(Flux2KleinPipelineConfig.tiny(),
+                              dtype=jnp.float32, seed=0)
+    out = pipe.forward(_req(guidance_scale=3.5))[0].data
+    assert out.shape == (32, 32, 3)
+    real = Flux2KleinPipelineConfig()
+    assert (real.dit.num_double_blocks,
+            real.dit.num_single_blocks) == (8, 48)
+    assert real.dit.guidance_embed
+
+
+def test_layered_generates_composite_plus_layers():
+    from vllm_omni_tpu.models.qwen_image.layered_pipeline import (
+        QwenImageLayeredPipeline,
+    )
+    from vllm_omni_tpu.models.qwen_image.pipeline import (
+        QwenImagePipelineConfig,
+    )
+
+    pipe = QwenImageLayeredPipeline(QwenImagePipelineConfig.tiny(),
+                                    dtype=jnp.float32, seed=0)
+    out = pipe.forward(_req(extra={"layers": 3}))[0].data
+    assert out.shape == (4, 32, 32, 3)  # composite + 3 layers
+    # planes are jointly denoised but distinct
+    assert not np.array_equal(out[0], out[1])
+    # deterministic
+    out2 = pipe.forward(_req(extra={"layers": 3}))[0].data
+    np.testing.assert_array_equal(out, out2)
+    with pytest.raises(InvalidRequestError, match="layers"):
+        pipe.forward(_req(extra={"layers": 0}))
+
+
+def test_registry_covers_new_variants():
+    from vllm_omni_tpu.models.registry import DiffusionModelRegistry
+
+    sup = DiffusionModelRegistry.supported()
+    for arch in ("OvisImagePipeline", "Flux2KleinPipeline",
+                 "QwenImageLayeredPipeline", "BagelPipeline"):
+        assert arch in sup
+        DiffusionModelRegistry.resolve(arch)

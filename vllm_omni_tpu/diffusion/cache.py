@@ -27,12 +27,21 @@ import jax.numpy as jnp
 
 @dataclass(frozen=True)
 class StepCacheConfig:
+    # "teacache": input-drift gate skipping the WHOLE model eval
+    # "dbcache": dual-block cache (reference:
+    #   diffusion/cache/cache_dit_backend.py DBCacheConfig) — the first
+    #   ``fn_compute_blocks`` transformer blocks ALWAYS compute (a fresh
+    #   anchor every step), their output drift gates reuse of a cached
+    #   tail-contribution delta; higher quality than whole-model skipping
+    #   because part of the network tracks every step
     backend: str = "teacache"     # "" disables
     rel_l1_threshold: float = 0.15
     # never skip the first/last steps (quality anchors, mirroring the
     # reference's warmup + final-step guards)
     warmup_steps: int = 1
     tail_steps: int = 1
+    # dbcache: number of leading blocks always computed
+    fn_compute_blocks: int = 4
 
     @property
     def enabled(self) -> bool:
@@ -94,8 +103,58 @@ def cached_eval(
     return v, (v, new_prev_lat, new_accum), skip
 
 
+def dbcache_init_carry(latents: jax.Array):
+    """(prev_anchor_velocity, cached_tail_delta, accumulated rel-L1)."""
+    return (
+        jnp.zeros_like(latents),
+        jnp.zeros_like(latents),
+        jnp.asarray(jnp.inf, jnp.float32),
+    )
+
+
+def dbcache_eval(
+    cache_cfg: StepCacheConfig,
+    eval_first: Callable,   # (latents) -> (state, anchor_velocity)
+    eval_rest: Callable,    # (state) -> full_velocity
+    latents: jax.Array,
+    carry,
+    i: jax.Array,
+    num_steps: jax.Array,
+):
+    """Dual-block cached velocity: the anchor (first Fn blocks + output
+    head) computes EVERY step; when its drift since the last full compute
+    stays under threshold, the cached tail delta (full - anchor) is
+    reused instead of running the remaining blocks.
+
+    Returns (velocity, new_carry, skipped_flag)."""
+    prev_anchor, delta, accum = carry
+    state, v_anchor = eval_first(latents)
+    v_anchor = v_anchor.astype(prev_anchor.dtype)
+    diff = jnp.mean(jnp.abs(
+        v_anchor.astype(jnp.float32) - prev_anchor.astype(jnp.float32)))
+    base = jnp.mean(jnp.abs(prev_anchor.astype(jnp.float32)))
+    rel = diff / jnp.maximum(base, 1e-8)
+    accum_new = accum + rel
+
+    in_window = (i >= cache_cfg.warmup_steps) & (
+        i < num_steps - cache_cfg.tail_steps
+    )
+    skip = in_window & (accum_new < cache_cfg.rel_l1_threshold)
+
+    def do_skip(_):
+        return v_anchor + delta, delta, accum_new
+
+    def do_compute(_):
+        v = eval_rest(state).astype(prev_anchor.dtype)
+        return v, v - v_anchor, jnp.asarray(0.0, jnp.float32)
+
+    v, new_delta, new_accum = jax.lax.cond(skip, do_skip, do_compute,
+                                           None)
+    return v, (v_anchor, new_delta, new_accum), skip
+
+
 def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
-                     solver: str = "euler"):
+                     solver: str = "euler", eval_split=None):
     """Shared denoise fori_loop, optionally gated by the step cache.
 
     ``eval_velocity(latents, i)`` -> velocity (shape-preserving).  Returns
@@ -112,6 +171,12 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
         raise ValueError(f"unknown solver {solver!r}")
     multistep = solver == "unipc"
     use_cache = cache_cfg is not None and cache_cfg.enabled
+    use_dbcache = use_cache and cache_cfg.backend == "dbcache"
+    if use_dbcache and eval_split is None:
+        raise ValueError(
+            "dbcache needs the pipeline's split evaluation "
+            "(eval_first, eval_rest) — this pipeline only supports "
+            "teacache")
 
     def ms_init(lat):
         return (jnp.zeros_like(lat, jnp.float32),
@@ -123,6 +188,25 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
                 schedule, lat, v, i, ms[0], ms[1])
             return new_lat, (x0, lam)
         return fm.step(schedule, lat, v, i), ms
+
+    if use_dbcache:
+        eval_first, eval_rest = eval_split
+
+        def body(i, carry):
+            lat, cc, ms, skipped = carry
+            v, cc, skip = dbcache_eval(
+                cache_cfg, lambda l: eval_first(l, i), eval_rest, lat,
+                cc, i, num_steps,
+            )
+            lat, ms = advance(lat, v, i, ms)
+            return (lat, cc, ms, skipped + skip.astype(jnp.int32))
+
+        lat, _, _, skipped = jax.lax.fori_loop(
+            0, num_steps, body,
+            (latents, dbcache_init_carry(latents), ms_init(latents),
+             jnp.asarray(0, jnp.int32)),
+        )
+        return lat, skipped
 
     if use_cache:
 

@@ -72,10 +72,10 @@ class DiffusionEngine:
         logger.info("Building %s (size=%s dtype=%s)", arch, size or "default", dtype)
         cache_config = None
         if od_config.cache_backend:
-            if od_config.cache_backend != "teacache":
+            if od_config.cache_backend not in ("teacache", "dbcache"):
                 raise ValueError(
                     f"unsupported cache_backend {od_config.cache_backend!r} "
-                    "(TPU path supports 'teacache')"
+                    "(TPU path supports 'teacache' and 'dbcache')"
                 )
             from vllm_omni_tpu.diffusion.cache import StepCacheConfig
 
@@ -162,17 +162,24 @@ class DiffusionEngine:
 
     @staticmethod
     def _pipeline_config(pipeline_cls, size: str):
-        # Pipelines expose tiny()/bench() presets on their config dataclass.
+        # Pipelines expose tiny()/bench() presets on their config
+        # dataclass; subclasses that reuse a parent's __init__ but carry
+        # their own config declare it via ``config_cls``.
         import inspect
 
-        sig = inspect.signature(pipeline_cls.__init__)
-        cfg_type = sig.parameters["config"].annotation
-        if isinstance(cfg_type, str):
-            # postponed annotation: resolve from the pipeline module
-            import importlib
+        cfg_type = getattr(pipeline_cls, "config_cls", None)
+        if cfg_type is None:
+            sig = inspect.signature(pipeline_cls.__init__)
+            cfg_type = sig.parameters["config"].annotation
+            if isinstance(cfg_type, str):
+                # postponed annotation: resolve from the module DEFINING
+                # the __init__ (an inheriting pipeline's own module may
+                # not import the parent's config name)
+                import importlib
 
-            mod = importlib.import_module(pipeline_cls.__module__)
-            cfg_type = getattr(mod, cfg_type)
+                mod = importlib.import_module(
+                    pipeline_cls.__init__.__module__)
+                cfg_type = getattr(mod, cfg_type)
         if size and hasattr(cfg_type, size):
             return getattr(cfg_type, size)()
         return cfg_type()

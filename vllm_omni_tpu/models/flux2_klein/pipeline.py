@@ -1,0 +1,49 @@
+"""Flux2-Klein text->image pipeline.
+
+Reference: vllm_omni/diffusion/models/flux2_klein/ — the Flux-2
+architecture (8 double + 48 single stream blocks,
+flux2_klein_transformer.py:572-576) with an embedded guidance scale;
+the step-distilled "Klein" variant ignores classifier-free guidance at
+sampling time (pipeline_flux2_klein.py:621-622).  Reuses the shared
+Flux MMDiT implementation at the Flux-2 geometry (the reference's
+joint_attention_dim 15360 is the concatenated multi-encoder width; the
+text-encoder hidden size stands in for it here — re-map at real-weight
+time)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from vllm_omni_tpu.models.common.transformer import TransformerConfig
+from vllm_omni_tpu.models.flux.pipeline import (
+    FluxPipeline,
+    FluxPipelineConfig,
+)
+from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
+from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+
+
+def _klein_dit() -> FluxDiTConfig:
+    return FluxDiTConfig(
+        num_double_blocks=8, num_single_blocks=48, num_heads=24,
+        head_dim=128, ctx_dim=4096, guidance_embed=True,
+    )
+
+
+@dataclass(frozen=True)
+class Flux2KleinPipelineConfig(FluxPipelineConfig):
+    dit: FluxDiTConfig = field(default_factory=_klein_dit)
+
+    @staticmethod
+    def tiny() -> "Flux2KleinPipelineConfig":
+        return Flux2KleinPipelineConfig(
+            text=TransformerConfig.tiny(vocab_size=256),
+            dit=FluxDiTConfig.tiny(),
+            vae=VAEConfig.tiny(),
+        )
+
+
+class Flux2KleinPipeline(FluxPipeline):
+    """Text -> image (distilled: embedded guidance, no CFG batch)."""
+
+    config_cls = Flux2KleinPipelineConfig

@@ -1,0 +1,61 @@
+"""Ovis-Image text->image pipeline.
+
+Reference: vllm_omni/diffusion/models/ovis_image/ — a Flux-architecture
+MMDiT (6 double + 27 single stream blocks, 24 heads x 128,
+joint_attention_dim 2048, ovis_image_transformer.py:340-396) with plain
+timestep conditioning (no pooled text vector, no embedded guidance) and
+TRUE classifier-free guidance.  That is exactly the LongCat-Image
+execution shape, so this pipeline reuses it at the Ovis geometry with
+plain CFG (no renorm)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from vllm_omni_tpu.models.common.transformer import TransformerConfig
+from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
+from vllm_omni_tpu.models.longcat_image.pipeline import (
+    LongCatImagePipeline,
+    _longcat_dit,
+)
+from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+
+
+def _ovis_dit() -> FluxDiTConfig:
+    import dataclasses
+
+    return dataclasses.replace(
+        FluxDiTConfig(
+            num_double_blocks=6, num_single_blocks=27, num_heads=24,
+            head_dim=128, ctx_dim=2048,
+        ),
+        guidance_embed=False, pooled_dim=0,
+    )
+
+
+@dataclass(frozen=True)
+class OvisImagePipelineConfig:
+    text: TransformerConfig = field(
+        default_factory=lambda: TransformerConfig(hidden_size=2048))
+    dit: FluxDiTConfig = field(default_factory=_ovis_dit)
+    vae: VAEConfig = field(default_factory=VAEConfig)
+    max_text_len: int = 64
+    scheduler: str = "euler"
+    pack: int = 2
+    cfg_renorm: bool = False      # Ovis runs plain CFG
+    cfg_renorm_min: float = 0.0
+
+    @staticmethod
+    def tiny() -> "OvisImagePipelineConfig":
+        return OvisImagePipelineConfig(
+            text=TransformerConfig.tiny(vocab_size=256),
+            dit=_longcat_dit(FluxDiTConfig.tiny()),
+            vae=VAEConfig.tiny(),
+            max_text_len=32,
+        )
+
+
+class OvisImagePipeline(LongCatImagePipeline):
+    """Text -> image (Ovis geometry over the shared Flux MMDiT)."""
+
+    config_cls = OvisImagePipelineConfig

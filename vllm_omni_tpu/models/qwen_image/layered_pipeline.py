@@ -1,0 +1,37 @@
+"""Qwen-Image-Layered: one denoise produces a composite plus N image
+layers simultaneously.
+
+Reference: vllm_omni/diffusion/models/qwen_image/
+pipeline_qwen_image_layered.py — latents carry ``layers + 1`` planes
+packed along the sequence axis; each plane gets its own rope frame
+coordinate (img_shapes repeats (1, h, w) layers+1 times, :747-751), the
+DiT denoises them jointly so layers stay mutually consistent, and each
+plane VAE-decodes to its own image.
+
+TPU notes: the multi-plane sequence rides the base pipeline's ``frames``
+axis (transformer rope frames) — same jitted loop, sequence just
+``layers+1`` times longer; planes batch through the VAE decoder
+together.  The output's ``data`` is [layers+1, H, W, 3]: composite
+first, then the layers."""
+
+from __future__ import annotations
+
+from vllm_omni_tpu.models.qwen_image.pipeline import QwenImagePipeline
+
+
+class QwenImageLayeredPipeline(QwenImagePipeline):
+    """Text -> composite + N layers (stacked on data's leading axis)."""
+
+    default_layers = 4
+
+    def _latent_frames(self, req) -> int:
+        sp = req.sampling_params
+        layers = sp.extra.get("layers", self.default_layers)
+        if not isinstance(layers, int) or layers < 1:
+            from vllm_omni_tpu.diffusion.request import (
+                InvalidRequestError,
+            )
+
+            raise InvalidRequestError(
+                f"layers must be a positive int, got {layers!r}")
+        return layers + 1

@@ -156,6 +156,11 @@ class QwenImagePipeline:
             raise ValueError(
                 "pp composes with no other axis yet — rebuild the mesh "
                 f"with pp alone (active: {sorted(self.wiring.active)})")
+        if (self.wiring.size("pp") > 1 and cache_config is not None
+                and getattr(cache_config, "backend", "") == "dbcache"):
+            raise ValueError(
+                "dbcache is not wired into the pp denoise path yet — "
+                "use teacache or pp without a step cache")
         self.cache_config = cache_config
         self.offload = offload
         if offload not in ("", "layerwise"):
@@ -528,10 +533,11 @@ class QwenImagePipeline:
 
     def _denoise_fn(self, grid_h: int, grid_w: int, sched_len: int,
                     batch2: int = 0,
-                    cond_grids: tuple[tuple[int, int], ...] = ()):
+                    cond_grids: tuple[tuple[int, int], ...] = (),
+                    frames: int = 1):
         # batch2 affects only the shard_map attn dispatch decision — keep
         # it out of the key on meshless pipelines (jit handles shapes).
-        key = (grid_h, grid_w, sched_len, cond_grids) + (
+        key = (grid_h, grid_w, sched_len, cond_grids, frames) + (
             (batch2,) if self.mesh is not None else ())
         if key in self._denoise_cache:
             return self._denoise_cache[key]
@@ -540,11 +546,11 @@ class QwenImagePipeline:
         n_cond = sum(ch * cw for ch, cw in cond_grids)
         if self.wiring.size("pp") > 1:
             run = self._pp_denoise_fn(grid_h, grid_w, sched_len,
-                                      cond_grids)
+                                      cond_grids, frames)
             self._denoise_cache[key] = run
             return run
         attn_fn = self._sp_attn_fn(
-            cfg.dit.num_heads, grid_h * grid_w + n_cond, batch2)
+            cfg.dit.num_heads, frames * grid_h * grid_w + n_cond, batch2)
         mesh = self.mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -579,7 +585,7 @@ class QwenImagePipeline:
                 txt_all = jax.lax.with_sharding_constraint(
                     txt_all, txt2_sharding)
 
-            def eval_velocity(lat, i):
+            def embed(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
                 s_gen = lat.shape[1]
                 # image edit: VAE-encoded condition tokens extend the
@@ -592,19 +598,58 @@ class QwenImagePipeline:
                 if mesh is not None:
                     lat_in = jax.lax.with_sharding_constraint(
                         lat_in, lat2_sharding)
-                v = dit.forward(
-                    dit_params, cfg.dit, lat_in, txt_all, t_in,
-                    (grid_h, grid_w), attn_fn=attn_fn, txt_mask=mask_all,
-                    cond_grids=cond_grids,
-                )[:, :s_gen]
+                return s_gen, lat_in, t_in
+
+            def finish(img, temb_act, s_gen):
+                v = dit.forward_suffix(dit_params, img,
+                                       temb_act)[:, :s_gen]
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)
                     v = v_neg + gscale * (v_pos - v_neg)
                 return v
 
+            def prefix_state(lat, i):
+                s_gen, lat_in, t_in = embed(lat, i)
+                return s_gen, dit.forward_prefix(
+                    dit_params, cfg.dit, lat_in, txt_all, t_in,
+                    (grid_h, grid_w), txt_mask=mask_all,
+                    cond_grids=cond_grids, frames=frames)
+
+            def run_blocks(state, blocks):
+                img, txt_i, temb_act, img_f, txt_f, kv_mask = state
+                for blk in blocks:
+                    img, txt_i = dit.block_forward(
+                        blk, cfg.dit, img, txt_i, temb_act, img_f,
+                        txt_f, attn_fn, kv_mask)
+                return (img, txt_i, temb_act, img_f, txt_f, kv_mask)
+
+            # ONE block-stack implementation serves the uncached,
+            # teacache, and dbcache paths (dbcache splits it at
+            # fn_compute_blocks — the always-computed anchor)
+            fn_blocks = (self.cache_config.fn_compute_blocks
+                         if self.cache_config is not None else 0)
+
+            def eval_velocity(lat, i):
+                s_gen, state = prefix_state(lat, i)
+                state = run_blocks(state, dit_params["blocks"])
+                return finish(state[0], state[2], s_gen)
+
+            def eval_first(lat, i):
+                s_gen, state = prefix_state(lat, i)
+                state = run_blocks(state,
+                                   dit_params["blocks"][:fn_blocks])
+                return state, finish(state[0], state[2], s_gen)
+
+            def eval_rest(state):
+                state = run_blocks(state,
+                                   dit_params["blocks"][fn_blocks:])
+                return finish(state[0], state[2],
+                              int(latents.shape[1]))
+
             return step_cache.run_denoise_loop(
                 self.cache_config, schedule, eval_velocity, latents,
                 num_steps, solver=self.cfg.scheduler,
+                eval_split=(eval_first, eval_rest),
             )
 
         self._denoise_cache[key] = run
@@ -627,7 +672,8 @@ class QwenImagePipeline:
             raise InvalidRequestError("num_inference_steps must be >= 1")
         lat_h, lat_w = sp.height // ratio, sp.width // ratio
         grid_h, grid_w = lat_h // patch, lat_w // patch
-        seq_len = grid_h * grid_w
+        frames = self._latent_frames(req)
+        seq_len = frames * grid_h * grid_w
         n_per = max(1, sp.num_images_per_prompt)
         prompts = [p for p in req.prompt for _ in range(n_per)]
         b = len(prompts)
@@ -690,6 +736,10 @@ class QwenImagePipeline:
                 raise InvalidRequestError(
                     "image-edit conditioning is not supported with "
                     "layerwise offload yet")
+            if frames != 1:
+                raise InvalidRequestError(
+                    "layered generation (frames > 1) is not supported "
+                    "with layerwise offload yet")
             txt_all = (jnp.concatenate([txt, neg_txt], axis=0)
                        if do_cfg else txt)
             mask_all = (jnp.concatenate([txt_mask, neg_mask], axis=0)
@@ -701,7 +751,7 @@ class QwenImagePipeline:
         else:
             run = self._denoise_fn(
                 grid_h, grid_w, sched_len, batch2=(2 * b if do_cfg else b),
-                cond_grids=cond_grids)
+                cond_grids=cond_grids, frames=frames)
             latents, skipped_steps = run(
                 self.dit_params,
                 noise,
@@ -717,7 +767,8 @@ class QwenImagePipeline:
             )
             self.last_skipped_steps = int(skipped_steps)
 
-        images = self._decode_latents(latents, grid_h, grid_w)
+        images = self._decode_latents(latents, grid_h, grid_w,
+                                      frames=frames)
         images = np.asarray(images)
         outs = []
         for i, prompt in enumerate(prompts):
@@ -735,7 +786,7 @@ class QwenImagePipeline:
         return outs
 
     def _pp_denoise_fn(self, grid_h: int, grid_w: int, sched_len: int,
-                       cond_grids: tuple = ()):
+                       cond_grids: tuple = (), frames: int = 1):
         """Denoise with the block stack pipelined over the ``pp`` axis
         (GPipe microbatches, parallel/pp.py): per-rank weight memory
         drops to L/pp blocks; the CFG-doubled batch supplies the
@@ -774,7 +825,7 @@ class QwenImagePipeline:
                     dit.forward_prefix(
                         dit_params, cfg.dit, lat_in, txt_all, t_in,
                         (grid_h, grid_w), txt_mask=mask_all,
-                        cond_grids=cond_grids)
+                        cond_grids=cond_grids, frames=frames)
                 b2 = img.shape[0]
                 if b2 % pp:
                     raise ValueError(
@@ -845,10 +896,23 @@ class QwenImagePipeline:
 
         return dec
 
-    def _decode_latents(self, latents, grid_h, grid_w):
+    def _latent_frames(self, req) -> int:
+        """Simultaneously-generated image planes (rope frame axis);
+        layered pipelines override (reference:
+        pipeline_qwen_image_layered.py:457-553)."""
+        return 1
+
+    def _decode_latents(self, latents, grid_h, grid_w, frames: int = 1):
         # DiT out_channels == vae latent channels; proj_out emits
         # patch^2 * C which equals in_channels when packing matches.
-        return self._decode_jit(self.vae_params, latents, grid_h, grid_w)
+        if frames == 1:
+            return self._decode_jit(self.vae_params, latents, grid_h,
+                                    grid_w)
+        b = latents.shape[0]
+        per = latents.reshape(b * frames, grid_h * grid_w,
+                              latents.shape[-1])
+        imgs = self._decode_jit(self.vae_params, per, grid_h, grid_w)
+        return imgs.reshape(b, frames, *imgs.shape[1:])
 
     def _encode_image_latents(self, images: jax.Array) -> jax.Array:
         """[B, H, W, 3] in [-1, 1] -> packed [B, gh*gw, p*p*z] latents

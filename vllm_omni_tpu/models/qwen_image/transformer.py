@@ -267,6 +267,7 @@ def forward(
     attn_fn=None,
     txt_mask: Optional[jax.Array] = None,  # [B, S_txt] 1=real, 0=pad
     cond_grids: tuple[tuple[int, int], ...] = (),
+    frames: int = 1,
 ) -> jax.Array:
     """Returns velocity prediction [B, S_img, patch^2 * out_channels].
 
@@ -275,7 +276,7 @@ def forward(
     slices the velocity back to the generated tokens."""
     img, txt, temb_act, img_freqs, txt_freqs, kv_mask = forward_prefix(
         params, cfg, img_tokens, txt_states, timesteps, grid_hw,
-        txt_mask=txt_mask, cond_grids=cond_grids,
+        txt_mask=txt_mask, cond_grids=cond_grids, frames=frames,
     )
     for blk in params["blocks"]:
         img, txt = block_forward(
@@ -294,6 +295,7 @@ def forward_prefix(
     grid_hw: tuple[int, int],
     txt_mask: Optional[jax.Array] = None,
     cond_grids: tuple[tuple[int, int], ...] = (),
+    frames: int = 1,
 ):
     """Everything before the block stack: embeds, time conditioning,
     rope tables, joint KV mask.  Split out so block-streaming
@@ -312,7 +314,7 @@ def forward_prefix(
 
     img_freqs, txt_freqs = rope_freqs(
         cfg, grid_hw[0], grid_hw[1], txt_states.shape[1],
-        cond_grids=cond_grids,
+        cond_grids=cond_grids, frames=frames,
     )
 
     # Joint-attention KV mask: padded text tokens must not receive

@@ -43,6 +43,12 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
         "vllm_omni_tpu.models.qwen_image.edit_pipeline",
         "QwenImageEditPlusPipeline"
     ),
+    # composite + N layers denoised jointly on the rope frame axis
+    # (reference: pipeline_qwen_image_layered.py)
+    "QwenImageLayeredPipeline": _Entry(
+        "vllm_omni_tpu.models.qwen_image.layered_pipeline",
+        "QwenImageLayeredPipeline"
+    ),
     # video (reference: Wan2.2 T2V family, diffusion/registry.py:16-102)
     "WanPipeline": _Entry(
         "vllm_omni_tpu.models.wan.pipeline", "WanT2VPipeline"
@@ -91,6 +97,19 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "LongCatImageEditPipeline": _Entry(
         "vllm_omni_tpu.models.longcat_image.pipeline",
         "LongCatImageEditPipeline"
+    ),
+    # AR+diffusion hybrid: the MoT LLM runs the flow itself (reference:
+    # bagel/pipeline_bagel.py:153)
+    "BagelPipeline": _Entry(
+        "vllm_omni_tpu.models.bagel.pipeline", "BagelPipeline"
+    ),
+    # Flux-architecture variants over the shared MMDiT (reference:
+    # ovis_image/, flux2_klein/)
+    "OvisImagePipeline": _Entry(
+        "vllm_omni_tpu.models.ovis_image.pipeline", "OvisImagePipeline"
+    ),
+    "Flux2KleinPipeline": _Entry(
+        "vllm_omni_tpu.models.flux2_klein.pipeline", "Flux2KleinPipeline"
     ),
 }
 
