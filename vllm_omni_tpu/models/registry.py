@@ -103,6 +103,17 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "BagelPipeline": _Entry(
         "vllm_omni_tpu.models.bagel.pipeline", "BagelPipeline"
     ),
+    # unified causal MM generator, shared single stack (reference:
+    # hunyuan_image_3/pipeline_hunyuan_image_3.py:65)
+    "HunyuanImage3ForCausalMM": _Entry(
+        "vllm_omni_tpu.models.hunyuan_image_3.pipeline",
+        "HunyuanImage3Pipeline"
+    ),
+    # AR-prior + DiT two-model generation (reference:
+    # glm_image/pipeline_glm_image.py:247-255)
+    "GlmImagePipeline": _Entry(
+        "vllm_omni_tpu.models.glm_image.pipeline", "GlmImagePipeline"
+    ),
     # Flux-architecture variants over the shared MMDiT (reference:
     # ovis_image/, flux2_klein/)
     "OvisImagePipeline": _Entry(
