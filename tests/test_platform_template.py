@@ -1,0 +1,91 @@
+"""Second-backend template (VERDICT r2 row 60) + per-stage HBM
+budgeting (row 27): an out-of-tree platform registered at runtime must
+drive the full engine stack, and co-located stages must pass budget
+validation before any engine allocates."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.platforms import current_platform, register_platform
+from vllm_omni_tpu.platforms.memory import StageMemoryAccountant
+from vllm_omni_tpu.platforms.template import ExamplePlatform
+
+
+def test_template_platform_registers_and_serves():
+    import vllm_omni_tpu.platforms as plat
+
+    register_platform("example", ExamplePlatform)
+    prev = plat._current
+    plat._current = ExamplePlatform()
+    try:
+        p = current_platform()
+        assert p.name == "example"
+        assert p.ar_attention_backend() == "xla"
+        p.initialize()
+        # the full AR engine runs under the example platform's backend
+        # picks (xla attention paths)
+        import jax
+
+        from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+        from vllm_omni_tpu.models.common import transformer as tfm
+        from vllm_omni_tpu.sampling_params import SamplingParams
+
+        cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+        eng = LLMEngine(params, cfg, EngineConfig(
+            num_pages=32, page_size=4, max_model_len=64,
+            dtype=jnp.float32))
+        outs = eng.generate([[1, 2, 3]],
+                            SamplingParams(temperature=0.0, max_tokens=4))
+        assert len(outs[0].outputs[0].token_ids) == 4
+    finally:
+        plat._current = prev
+
+
+def test_template_covers_every_override_point():
+    p = ExamplePlatform()
+    assert p.diffusion_attention_backend() == "xla"
+    assert p.peak_tflops_bf16() == 1.0
+    env = p.stage_device_env()
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert p.preferred_dtype() == jnp.float32
+    p.initialize()  # no-op must be callable
+    # memory stats may be None on CPU — the interface must not raise
+    p.memory_stats()
+
+
+def test_memory_accountant_budget_validation():
+    acct = StageMemoryAccountant()
+    acct.register(0, 0.6)
+    acct.register(1, 0.3)
+    acct.validate()  # 0.9 fits
+    acct.register(2, 0.3)
+    with pytest.raises(ValueError, match="over-subscribe"):
+        acct.validate()
+    with pytest.raises(ValueError, match="fraction"):
+        acct.register(3, 0.0)
+
+
+def test_omni_rejects_oversubscribed_stages():
+    from vllm_omni_tpu.config.stage import StageConfig
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    def stage(i, frac):
+        return StageConfig(
+            stage_id=i, stage_type="llm",
+            engine_args={
+                "model_factory": "tests.helpers:tiny_lm_factory",
+                "num_pages": 32, "page_size": 4, "max_model_len": 64,
+                "gpu_memory_utilization": frac,
+            },
+            engine_input_source=[-1] if i == 0 else [i - 1],
+            final_output=(i == 1), final_output_type="text",
+        )
+
+    with pytest.raises(ValueError, match="over-subscribe"):
+        Omni(stage_configs=[stage(0, 0.8), stage(1, 0.8)])
+    # fitting fractions construct and generate normally
+    omni = Omni(stage_configs=[stage(0, 0.5), stage(1, 0.5)])
+    outs = omni.generate([[1, 2, 3]])
+    assert len(outs) >= 1

@@ -230,8 +230,14 @@ class DiffusionEngine:
         logger.info("Warmup done in %.1fs", time.perf_counter() - t0)
 
     # ------------------------------------------------------- sleep / wake
+    # default weight-tree attributes; pipelines with extra trees (e.g.
+    # GLM-Image's AR prior) declare their own ``param_attrs`` so sleep()
+    # frees EVERYTHING
     _PARAM_ATTRS = ("dit_params", "text_params", "vae_params",
                     "vae_encoder_params", "decoder_params")
+
+    def _param_attrs(self):
+        return getattr(self.pipeline, "param_attrs", self._PARAM_ATTRS)
 
     @property
     def is_asleep(self) -> bool:
@@ -248,7 +254,7 @@ class DiffusionEngine:
         import numpy as np
 
         self._host_stash = {}
-        for attr in self._PARAM_ATTRS:
+        for attr in self._param_attrs():
             tree = getattr(self.pipeline, attr, None)
             if tree is None:
                 continue

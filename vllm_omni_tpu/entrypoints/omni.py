@@ -53,6 +53,28 @@ class Omni:
         for cfg in configs:
             cfg.engine_args.update(overrides.get(f"stage{cfg.stage_id}", {}))
         self.stage_configs = configs
+        # HBM budgeting for co-located (in-proc) stages: validate the
+        # declared fractions BEFORE any engine allocates, snapshot after
+        # each build (reference: per-process NVML accounting,
+        # worker/gpu_memory_utils.py:22-124)
+        from vllm_omni_tpu.platforms.memory import StageMemoryAccountant
+
+        self.memory_accountant = StageMemoryAccountant()
+        colocated = [c for c in configs if not c.runtime.process]
+        declared = {c.stage_id: float(c.engine_args["gpu_memory_utilization"])
+                    for c in colocated
+                    if c.engine_args.get("gpu_memory_utilization")
+                    is not None}
+        undeclared = [c for c in colocated
+                      if c.stage_id not in declared]
+        # undeclared stages share whatever budget the declared ones left
+        leftover = max(0.0, 1.0 - sum(declared.values()))
+        default = leftover / len(undeclared) if undeclared else 0.0
+        for c in colocated:
+            frac = declared.get(c.stage_id, default)
+            if frac > 0.0:
+                self.memory_accountant.register(c.stage_id, frac)
+        self.memory_accountant.validate()
         # process-disaggregated stages spawn workers (ready handshake
         # inside ProcStage); in-proc stages build engines directly
         self.stages = []
@@ -74,6 +96,7 @@ class Omni:
                 self.stages.append(ProcStage(cfg, device_env=env))
             else:
                 self.stages.append(OmniStage(cfg))
+                self.memory_accountant.snapshot(cfg.stage_id)
         self.metrics = OrchestratorAggregator(len(configs), stats_path)
         # connector per pipeline edge (from->to), from stage YAML
         # output_connectors; in-proc default

@@ -261,8 +261,11 @@ class BagelPipeline:
         logger.info("Initializing BagelPipeline (dtype=%s)", dtype)
         # the MoT LLM *is* this pipeline's generator; stored as
         # dit_params so engine-level weight bookkeeping (LoRA/quant/
-        # sleep) addresses the same tree the forward reads
-        self.dit_params = self.wiring.place(init_params(k1, config, dtype))
+        # sleep) addresses the same tree the forward reads.  Subclasses
+        # with a different stack override _build_llm_params (a second
+        # full init after super().__init__ would transiently double the
+        # weight memory).
+        self.dit_params = self._build_llm_params(k1, config, dtype)
         self.vae_params = self.wiring.place(
             vae_mod.init_decoder(k2, config.vae, dtype))
         self._seed = seed
@@ -272,6 +275,9 @@ class BagelPipeline:
                                                  mask))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
+
+    def _build_llm_params(self, key, config, dtype):
+        return self.wiring.place(init_params(key, config, dtype))
 
     @property
     def geometry_multiple(self) -> int:

@@ -79,6 +79,9 @@ class GlmImagePipeline:
 
     output_type = "image"
     config_cls = GlmImagePipelineConfig
+    # every tree engine.sleep() must offload (the AR prior included)
+    param_attrs = ("dit_params", "text_params", "vae_params",
+                   "prior_params", "glm_params")
 
     def __init__(self, config: GlmImagePipelineConfig, dtype=jnp.bfloat16,
                  seed: int = 0, mesh=None, cache_config=None):
@@ -116,6 +119,7 @@ class GlmImagePipeline:
         self.vae_params = self.wiring.place(
             vae_mod.init_decoder(ks[5], config.vae, dtype))
         self._denoise_cache: dict = {}
+        self._prior_cache: dict = {}
         self._text_encode_jit = jax.jit(
             lambda p, i: forward_hidden(p, self.cfg.text, i))
         self._vae_decode_jit = jax.jit(
@@ -130,7 +134,10 @@ class GlmImagePipeline:
         """Greedy AR generation of ``n_tokens`` prior ids under one
         jitted scan (full-recompute per token — the serving-scale
         version rides the AR engine's paged cache; this is the
-        self-contained pipeline path)."""
+        self-contained pipeline path).  Cached per n_tokens: a fresh
+        jax.jit per request would recompile every call."""
+        if n_tokens in self._prior_cache:
+            return self._prior_cache[n_tokens]
         cfg = self.cfg.prior_lm
 
         @jax.jit
@@ -151,6 +158,7 @@ class GlmImagePipeline:
             buf = jax.lax.fori_loop(0, n_tokens, step, buf)
             return buf[:, seed_ids.shape[1]:]
 
+        self._prior_cache[n_tokens] = gen
         return gen
 
     # --------------------------------------------------------- denoise

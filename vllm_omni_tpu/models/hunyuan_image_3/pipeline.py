@@ -82,16 +82,11 @@ class HunyuanImage3Pipeline(BagelPipeline):
 
     config_cls = HunyuanImage3PipelineConfig
 
-    def __init__(self, config: HunyuanImage3PipelineConfig,
-                 dtype=jnp.bfloat16, seed: int = 0, mesh=None,
-                 cache_config=None):
-        super().__init__(config, dtype=dtype, seed=seed, mesh=mesh,
-                         cache_config=cache_config)
-        # replace Bagel's dual-expert tree with the shared stack;
-        # aliasing happens AFTER device placement (a pytree with the
-        # same dict twice would be placed as two separate copies)
-        k1 = jax.random.PRNGKey(seed)
-        placed = self.wiring.place(init_params(k1, config, dtype))
+    def _build_llm_params(self, key, config, dtype):
+        # shared single stack instead of Bagel's dual experts; aliasing
+        # happens AFTER device placement (a pytree containing the same
+        # dict twice would be placed as two separate copies)
+        placed = self.wiring.place(init_params(key, config, dtype))
         placed["layers"] = [{"und": l["shared"], "gen": l["shared"]}
                             for l in placed["layers"]]
-        self.dit_params = placed
+        return placed

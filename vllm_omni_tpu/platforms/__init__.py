@@ -46,6 +46,9 @@ def current_platform() -> OmniPlatform:
 
         load_plugins()
         _current = _detect()
+        # once-per-process backend bring-up (PJRT plugin registration
+        # etc. for out-of-tree platforms — template.py)
+        _current.initialize()
     return _current
 
 

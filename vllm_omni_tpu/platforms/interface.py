@@ -13,6 +13,19 @@ class OmniPlatform(ABC):
     # interpret mode (CPU tests).
     supports_pallas: bool = False
 
+    def initialize(self) -> None:
+        """Once-per-process backend bring-up (PJRT plugin registration,
+        topology discovery).  No-op by default; out-of-tree platforms
+        override (see platforms/template.py for the full override-point
+        catalogue)."""
+
+    def memory_stats(self):
+        """Allocator stats {bytes_in_use, bytes_limit,
+        peak_bytes_in_use} or None (platforms/memory.py budgeting)."""
+        from vllm_omni_tpu.platforms.memory import device_memory_stats
+
+        return device_memory_stats()
+
     @abstractmethod
     def ar_attention_backend(self) -> str:
         """Backend name for AR paged attention ("pallas_paged" | "xla")."""
@@ -34,16 +47,10 @@ class OmniPlatform(ABC):
     def hbm_bytes(self):
         """Per-device memory limit in bytes (None when the backend does
         not report it) — the TPU analogue of the reference's NVML
-        per-process accounting (worker/gpu_memory_utils.py:22-124)."""
-        import jax
-
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-        except (RuntimeError, AttributeError):
-            return None
-        if not stats:
-            return None
-        return stats.get("bytes_limit")
+        per-process accounting (worker/gpu_memory_utils.py:22-124).
+        Derived from memory_stats() so there is ONE allocator probe."""
+        stats = self.memory_stats()
+        return stats.get("bytes_limit") if stats else None
 
     def peak_tflops_bf16(self) -> float:
         """Peak dense bf16 TFLOP/s of one device (MFU denominators)."""

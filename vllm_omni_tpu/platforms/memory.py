@@ -1,0 +1,91 @@
+"""Per-stage device-memory accounting.
+
+Role of the reference's per-process GPU memory accounting
+(vllm_omni/worker/gpu_memory_utils.py:22-124 — NVML per-process usage
+feeding gpu_memory_utilization budgeting so co-located stages don't
+fight over one device).  The TPU shape: stages that share a chip declare
+an HBM fraction (the ``gpu_memory_utilization`` engine arg, kept for
+config parity); the orchestrator validates the fractions fit before any
+engine allocates, and each stage snapshots allocator stats after its
+engine build so over-budget stages are flagged with numbers instead of
+surfacing later as opaque RESOURCE_EXHAUSTED errors mid-request.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Allocator stats of the first local device: {bytes_in_use,
+    bytes_limit, peak_bytes_in_use} (None when the backend doesn't
+    report — e.g. CPU)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except (RuntimeError, AttributeError, IndexError):
+        return None
+    if not stats:
+        return None
+    return {
+        "bytes_in_use": stats.get("bytes_in_use"),
+        "bytes_limit": stats.get("bytes_limit"),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+    }
+
+
+@dataclass
+class StageMemoryAccountant:
+    """Budget bookkeeping for stages sharing one device."""
+
+    # stage_id -> declared HBM fraction
+    fractions: dict[int, float] = field(default_factory=dict)
+    # stage_id -> bytes_in_use snapshot after engine build
+    usage: dict[int, int] = field(default_factory=dict)
+
+    def register(self, stage_id: int, fraction: float) -> None:
+        if not (0.0 < fraction <= 1.0):
+            raise ValueError(
+                f"stage {stage_id}: hbm fraction must be in (0, 1], "
+                f"got {fraction}")
+        self.fractions[stage_id] = float(fraction)
+
+    def validate(self) -> None:
+        """Co-located stages must fit: sum of fractions <= 1 (the check
+        the reference performs against NVML before engine init)."""
+        total = sum(self.fractions.values())
+        if total > 1.0 + 1e-6:
+            raise ValueError(
+                "stages sharing one device over-subscribe HBM: "
+                f"sum of gpu_memory_utilization = {total:.2f} > 1.0 "
+                f"({self.fractions}); lower the per-stage fractions")
+
+    def snapshot(self, stage_id: int) -> Optional[dict]:
+        """Record the stage's post-build usage and warn when it exceeds
+        its declared share (stats come through the PLATFORM so
+        out-of-tree backends' memory_stats overrides are honored)."""
+        from vllm_omni_tpu.platforms import current_platform
+
+        stats = current_platform().memory_stats()
+        if stats is None or stats.get("bytes_in_use") is None:
+            return None
+        prev_total = sum(self.usage.values())
+        own = max(0, stats["bytes_in_use"] - prev_total)
+        self.usage[stage_id] = own
+        limit = stats.get("bytes_limit")
+        frac = self.fractions.get(stage_id)
+        if limit and frac and own > frac * limit:
+            logger.warning(
+                "stage %d uses %.2f GiB (%.0f%% of device) but declared "
+                "gpu_memory_utilization=%.2f — co-located stages may "
+                "OOM; raise the fraction or move the stage to its own "
+                "device", stage_id, own / 2**30, 100.0 * own / limit,
+                frac)
+        return {"bytes_in_use": own, "bytes_limit": limit,
+                "fraction": frac}
