@@ -106,10 +106,10 @@ def test_patch_parallel_vae_decode_matches_single_device():
 
 
 def test_patch_parallel_video_vae_decode():
-    from vllm_omni_tpu.models.wan import video_vae as vvae
+    from vllm_omni_tpu.models.common import causal_vae as vvae
 
-    cfg = vvae.VideoVAEConfig.tiny()
-    params = vvae.init_decoder(jax.random.PRNGKey(0), cfg)
+    cfg = vvae.CausalVAEConfig.tiny()
+    params = vvae.init_params(jax.random.PRNGKey(0), cfg, encoder=False)
     lat = jax.random.normal(jax.random.PRNGKey(1),
                             (1, 3, 16, 8, cfg.latent_channels), jnp.float32)
     want = np.asarray(vvae.decode(params, cfg, lat))

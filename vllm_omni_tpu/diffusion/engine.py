@@ -264,6 +264,11 @@ class DiffusionEngine:
                 lambda x: np.asarray(jax.device_get(x)), tree
             )
             setattr(self.pipeline, attr, None)
+        # pipelines with DERIVED trees (e.g. Hunyuan's aliased shared
+        # stack) drop them here so no stale device references survive
+        hook = getattr(self.pipeline, "post_sleep", None)
+        if hook is not None:
+            hook()
         # fused LoRA trees + the base ref hold full DiT-sized device
         # buffers; drop them or the eviction is theater
         self.lora_manager.drop_device_state()
@@ -283,6 +288,9 @@ class DiffusionEngine:
             setattr(self.pipeline, attr, tree)
         self._host_stash = {}
         self._asleep = False
+        hook = getattr(self.pipeline, "post_wake", None)
+        if hook is not None:
+            hook()
         logger.info("engine awake: weights restored to device")
 
     def load_lora(self, path: str, name: Optional[str] = None) -> str:

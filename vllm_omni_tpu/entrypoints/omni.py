@@ -67,14 +67,24 @@ class Omni:
                     is not None}
         undeclared = [c for c in colocated
                       if c.stage_id not in declared]
-        # undeclared stages share whatever budget the declared ones left
-        leftover = max(0.0, 1.0 - sum(declared.values()))
+        # undeclared stages share whatever budget the declared ones left;
+        # no leftover means the declared fractions already consume the
+        # device — fail HERE, not with a RESOURCE_EXHAUSTED mid-request
+        leftover = 1.0 - sum(declared.values())
+        if undeclared and leftover <= 1e-6:
+            raise ValueError(
+                "declared gpu_memory_utilization fractions "
+                f"({declared}) leave no HBM for stages "
+                f"{[c.stage_id for c in undeclared]} sharing the "
+                "device; declare fractions for every co-located stage")
         default = leftover / len(undeclared) if undeclared else 0.0
         for c in colocated:
-            frac = declared.get(c.stage_id, default)
-            if frac > 0.0:
-                self.memory_accountant.register(c.stage_id, frac)
+            # register() rejects fractions outside (0, 1] — an explicit
+            # 0.0 is a config error, not a skip
+            self.memory_accountant.register(
+                c.stage_id, declared.get(c.stage_id, default))
         self.memory_accountant.validate()
+        self.memory_accountant.capture_baseline()
         # process-disaggregated stages spawn workers (ready handshake
         # inside ProcStage); in-proc stages build engines directly
         self.stages = []

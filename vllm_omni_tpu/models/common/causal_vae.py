@@ -69,6 +69,12 @@ class CausalVAEConfig:
         return 2 ** (len(self.dim_mult) - 1)
 
     @property
+    def latent_channels(self) -> int:
+        """Alias so pipelines address the latent width uniformly across
+        VAE families."""
+        return self.z_channels
+
+    @property
     def temporal_ratio(self) -> int:
         return 2 ** sum(self.temporal_downsample)
 

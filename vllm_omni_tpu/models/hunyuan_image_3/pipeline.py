@@ -82,11 +82,28 @@ class HunyuanImage3Pipeline(BagelPipeline):
 
     config_cls = HunyuanImage3PipelineConfig
 
+    # engine.sleep() stashes llm_shared (the alias-free tree); the
+    # derived dit_params would otherwise stash every shared dict TWICE
+    # and wake() would materialize two device copies, silently doubling
+    # weight memory
+    param_attrs = ("llm_shared", "vae_params")
+
     def _build_llm_params(self, key, config, dtype):
         # shared single stack instead of Bagel's dual experts; aliasing
         # happens AFTER device placement (a pytree containing the same
         # dict twice would be placed as two separate copies)
-        placed = self.wiring.place(init_params(key, config, dtype))
-        placed["layers"] = [{"und": l["shared"], "gen": l["shared"]}
-                            for l in placed["layers"]]
-        return placed
+        self.llm_shared = self.wiring.place(
+            init_params(key, config, dtype))
+        return self._alias_shared()
+
+    def _alias_shared(self):
+        tree = dict(self.llm_shared)
+        tree["layers"] = [{"und": l["shared"], "gen": l["shared"]}
+                          for l in self.llm_shared["layers"]]
+        return tree
+
+    def post_sleep(self):
+        self.dit_params = None  # derived aliases must not pin buffers
+
+    def post_wake(self):
+        self.dit_params = self._alias_shared()

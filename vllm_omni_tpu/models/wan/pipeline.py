@@ -31,9 +31,11 @@ from vllm_omni_tpu.models.common.transformer import (
     forward_hidden,
     init_params as init_text_params,
 )
+from vllm_omni_tpu.models.common import causal_vae as vvae
+from vllm_omni_tpu.models.common.causal_vae import (
+    CausalVAEConfig as VideoVAEConfig,
+)
 from vllm_omni_tpu.models.wan import transformer as wdit
-from vllm_omni_tpu.models.wan import video_vae as vvae
-from vllm_omni_tpu.models.wan.video_vae import VideoVAEConfig
 from vllm_omni_tpu.models.wan.transformer import WanDiTConfig
 from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
 
@@ -99,8 +101,11 @@ class WanT2VPipeline:
             init_text_params(k1, config.text, dtype))
         self.dit_params = self.wiring.place(
             wdit.init_params(k2, config.dit, dtype))
-        self.vae_params = self.wiring.place(
-            vvae.init_decoder(k3, config.vae, dtype))
+        # checkpoint-compatible Wan causal 3D VAE (the same family as
+        # the Qwen-Image VAE — models/common/causal_vae.py; diffusers
+        # weights load through model_loader.diffusers_loader)
+        self.vae_params = self.wiring.place(vvae.init_params(
+            k3, config.vae, jnp.float32, encoder=False))
         self.vae_encoder_params = None  # built on demand (I2V conditioning)
         self._seed = seed
         self._denoise_cache: dict = {}
@@ -111,10 +116,14 @@ class WanT2VPipeline:
         # free the buffers and wake()/LoRA swaps would silently not apply
         self._text_encode_jit = jax.jit(
             lambda p, i: forward_hidden(p, self.cfg.text, i))
+        # fp32 VAE compute regardless of model dtype (banding artifacts
+        # in bf16 decode)
         self._vae_decode_jit = jax.jit(
-            lambda pp, l: vvae.decode(pp, self.cfg.vae, l))
+            lambda pp, l: vvae.decode(pp, self.cfg.vae,
+                                      l.astype(jnp.float32)))
         self._vae_encode_jit = jax.jit(
-            lambda pp, v: vvae.encode(pp, self.cfg.vae, v))
+            lambda pp, v: vvae.encode(pp, self.cfg.vae,
+                                      v.astype(jnp.float32)))
 
     def encode_prompt(self, prompts: list[str]):
         ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
@@ -266,9 +275,10 @@ class WanI2VPipeline(WanT2VPipeline):
                 "I2V pipeline needs sampling_params.image (first frame)"
             )
         if self.vae_encoder_params is None:
-            self.vae_encoder_params = vvae.init_encoder(
-                jax.random.PRNGKey(self._seed + 1), self.cfg.vae, self.dtype
-            )
+            enc = vvae.init_params(
+                jax.random.PRNGKey(self._seed + 1), self.cfg.vae,
+                jnp.float32, decoder=False)
+            self.vae_encoder_params = self.wiring.place(enc)
         img = np.asarray(image)
         if img.dtype == np.uint8:
             img = img.astype(np.float32) / 127.5 - 1.0

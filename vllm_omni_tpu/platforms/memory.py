@@ -48,6 +48,16 @@ class StageMemoryAccountant:
     fractions: dict[int, float] = field(default_factory=dict)
     # stage_id -> bytes_in_use snapshot after engine build
     usage: dict[int, int] = field(default_factory=dict)
+    # allocations that predate the stages (runtime buffers, caller
+    # arrays) — captured once so they aren't billed to the first stage
+    baseline: Optional[int] = None
+
+    def capture_baseline(self) -> None:
+        from vllm_omni_tpu.platforms import current_platform
+
+        stats = current_platform().memory_stats()
+        if stats and stats.get("bytes_in_use") is not None:
+            self.baseline = stats["bytes_in_use"]
 
     def register(self, stage_id: int, fraction: float) -> None:
         if not (0.0 < fraction <= 1.0):
@@ -75,7 +85,7 @@ class StageMemoryAccountant:
         stats = current_platform().memory_stats()
         if stats is None or stats.get("bytes_in_use") is None:
             return None
-        prev_total = sum(self.usage.values())
+        prev_total = sum(self.usage.values()) + (self.baseline or 0)
         own = max(0, stats["bytes_in_use"] - prev_total)
         self.usage[stage_id] = own
         limit = stats.get("bytes_limit")
