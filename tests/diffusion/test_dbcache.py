@@ -92,3 +92,49 @@ def test_engine_accepts_dbcache_backend():
         seed=0)
     outs = eng.step(OmniDiffusionRequest(prompt=["x"], sampling_params=sp))
     assert outs[0].data.shape == (32, 32, 3)
+
+
+def test_wan_dbcache_zero_threshold_matches_baseline():
+    """Video: the dual-block cache rides the decomposed Wan DiT too."""
+    from vllm_omni_tpu.models.wan.pipeline import (
+        WanPipelineConfig,
+        WanT2VPipeline,
+    )
+
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_frames=5, num_inference_steps=6,
+        guidance_scale=4.0, seed=1)
+    req = lambda: OmniDiffusionRequest(  # noqa: E731
+        prompt=["x"], sampling_params=sp, request_ids=["r"])
+    base = WanT2VPipeline(WanPipelineConfig.tiny(), dtype=jnp.float32,
+                          seed=0)
+    want = base.forward(req())[0].data
+    db = WanT2VPipeline(
+        WanPipelineConfig.tiny(), dtype=jnp.float32, seed=0,
+        cache_config=StepCacheConfig(backend="dbcache",
+                                     rel_l1_threshold=0.0,
+                                     fn_compute_blocks=1))
+    got = db.forward(req())[0].data
+    assert db.last_skipped_steps == 0
+    np.testing.assert_allclose(got.astype(np.int32),
+                               want.astype(np.int32), atol=1)
+
+
+def test_wan_dbcache_skips():
+    from vllm_omni_tpu.models.wan.pipeline import (
+        WanPipelineConfig,
+        WanT2VPipeline,
+    )
+
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_frames=5, num_inference_steps=6,
+        guidance_scale=4.0, seed=1)
+    db = WanT2VPipeline(
+        WanPipelineConfig.tiny(), dtype=jnp.float32, seed=0,
+        cache_config=StepCacheConfig(backend="dbcache",
+                                     rel_l1_threshold=1e9,
+                                     fn_compute_blocks=1))
+    out = db.forward(OmniDiffusionRequest(
+        prompt=["x"], sampling_params=sp, request_ids=["r"]))[0].data
+    assert db.last_skipped_steps == 4  # warmup + tail guards on 6 steps
+    assert np.isfinite(out).all()

@@ -84,7 +84,11 @@ class Omni:
             self.memory_accountant.register(
                 c.stage_id, declared.get(c.stage_id, default))
         self.memory_accountant.validate()
-        self.memory_accountant.capture_baseline()
+        if colocated:
+            # baseline is only consumed by in-proc snapshots; touching
+            # the platform here for an all-process config would acquire
+            # the TPU in the parent before the children can
+            self.memory_accountant.capture_baseline()
         # process-disaggregated stages spawn workers (ready handshake
         # inside ProcStage); in-proc stages build engines directly
         self.stages = []

@@ -5,7 +5,8 @@ Reference: vllm_omni/diffusion/models/wan2_2/ — Wan2.2 T2V / I2V / TI2V
 over video latents → VAE decode).  TPU-first like the image pipeline: the
 whole denoise loop is one jitted fori_loop with a dynamic step bound;
 latents ride the temporally-compressed layout of the causal video VAE
-(video_vae.py — 1 + (F-1)/r latent frames), and I2V conditions the DiT on
+(models/common/causal_vae.py — 1 + (F-1)/r latent frames, the same
+checkpoint-compatible implementation Qwen-Image loads), and I2V conditions the DiT on
 the first frame's VAE latent plus a presence-mask channel concatenated
 channel-wise (the reference's y/mask conditioning).
 """
@@ -157,7 +158,7 @@ class WanT2VPipeline:
                         if do_cfg else ctx_mask)
             ctx_all = wiring.constrain(ctx_all)
 
-            def eval_velocity(lat, i):
+            def embed(lat, i):
                 t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
                 # I2V: first-frame latent + presence mask ride extra
                 # channels (the reference y/mask conditioning)
@@ -169,16 +170,50 @@ class WanT2VPipeline:
                 # SP axes — the layout the shard_map attention expects
                 lat_in = wiring.constrain(lat_in, seq_dim=1)
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
-                v = wdit.forward(dit_params, cfg.dit, lat_in, ctx_all, t_in,
-                                 ctx_mask=mask_all, attn_fn=attn_fn)
+                return wdit.forward_prefix(dit_params, cfg.dit, lat_in,
+                                           t_in)
+
+            def run_blocks(state, blocks):
+                x, temb, rope, fgw = state
+                from vllm_omni_tpu.models.common import dit as cdit
+
+                for blk in blocks:
+                    x = cdit.cross_block_forward(
+                        blk, x, ctx_all, temb, rope, cfg.dit.num_heads,
+                        mask_all, self_attn_fn=attn_fn)
+                return (x, temb, rope, fgw)
+
+            def finish(state):
+                x, temb, rope, fgw = state
+                v = wdit.forward_suffix(dit_params, cfg.dit, x, temb,
+                                        fgw)
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)
                     v = v_neg + gscale * (v_pos - v_neg)
                 return v
 
+            # one block-stack implementation for the uncached, teacache,
+            # and dbcache (anchor/tail split) paths
+            fn_blocks = (cache_cfg.fn_compute_blocks
+                         if cache_cfg is not None else 0)
+
+            def eval_velocity(lat, i):
+                return finish(run_blocks(embed(lat, i),
+                                         dit_params["blocks"]))
+
+            def eval_first(lat, i):
+                state = run_blocks(embed(lat, i),
+                                   dit_params["blocks"][:fn_blocks])
+                return state, finish(state)
+
+            def eval_rest(state):
+                return finish(run_blocks(state,
+                                         dit_params["blocks"][fn_blocks:]))
+
             return step_cache.run_denoise_loop(
                 cache_cfg, schedule, eval_velocity, latents, num_steps,
-                solver=cfg.scheduler)
+                solver=cfg.scheduler,
+                eval_split=(eval_first, eval_rest))
 
         self._denoise_cache[key] = run
         return run

@@ -105,16 +105,9 @@ def unpatchify(x: jax.Array, p: int, f: int, gh: int, gw: int,
     return x.reshape(b, f, gh * p, gw * p, c)
 
 
-def forward(
-    params,
-    cfg: WanDiTConfig,
-    latents: jax.Array,   # [B, F, H, W, C] (latent video)
-    ctx: jax.Array,       # [B, S_txt, ctx_dim]
-    timesteps: jax.Array, # [B]
-    ctx_mask=None,
-    attn_fn=None,         # SP self-attention override (pipeline mesh)
-) -> jax.Array:
-    """Velocity prediction, same shape as latents."""
+def forward_prefix(params, cfg: WanDiTConfig, latents, timesteps):
+    """Embeds + conditioning before the block stack (split out so the
+    dual-block cache can schedule the stack — diffusion/cache.py)."""
     b, f, h, w, c = latents.shape
     p = cfg.patch_size
     gh, gw = h // p, w // p
@@ -127,11 +120,30 @@ def forward(
         )),
     )
     rope = rope_freqs(cfg, f, gh, gw)
-    for blk in params["blocks"]:
-        x = dit.cross_block_forward(blk, x, ctx, temb, rope, cfg.num_heads,
-                                    ctx_mask, self_attn_fn=attn_fn)
+    return x, temb, rope, (f, gh, gw)
+
+
+def forward_suffix(params, cfg: WanDiTConfig, x, temb, fgw):
+    f, gh, gw = fgw
     mod = nn.linear(params["norm_out_mod"], jax.nn.silu(temb))[:, None, :]
     shift, scale = jnp.split(mod, 2, axis=-1)
     x = nn.layernorm({}, x) * (1 + scale) + shift
     out = nn.linear(params["proj_out"], x)
-    return unpatchify(out, p, f, gh, gw, cfg.out_channels)
+    return unpatchify(out, cfg.patch_size, f, gh, gw, cfg.out_channels)
+
+
+def forward(
+    params,
+    cfg: WanDiTConfig,
+    latents: jax.Array,   # [B, F, H, W, C] (latent video)
+    ctx: jax.Array,       # [B, S_txt, ctx_dim]
+    timesteps: jax.Array, # [B]
+    ctx_mask=None,
+    attn_fn=None,         # SP self-attention override (pipeline mesh)
+) -> jax.Array:
+    """Velocity prediction, same shape as latents."""
+    x, temb, rope, fgw = forward_prefix(params, cfg, latents, timesteps)
+    for blk in params["blocks"]:
+        x = dit.cross_block_forward(blk, x, ctx, temb, rope, cfg.num_heads,
+                                    ctx_mask, self_attn_fn=attn_fn)
+    return forward_suffix(params, cfg, x, temb, fgw)
