@@ -79,3 +79,53 @@ def test_registry_resolves():
 
     assert DiffusionModelRegistry.resolve(
         "BagelPipeline") is BagelPipeline
+
+def _gen_img(pipe, image, seed=2, hw=16, steps=2):
+    sp = OmniDiffusionSamplingParams(
+        height=hw, width=hw, num_inference_steps=steps,
+        guidance_scale=3.0, seed=seed, image=image)
+    req = OmniDiffusionRequest(prompt=["edit"], sampling_params=sp,
+                               request_ids=["r"])
+    return pipe.forward(req)[0].data
+
+
+def test_conditioning_image_joins_context(pipe):
+    """sp.image -> VAE latents -> vae2llm context tokens
+    (forward_cache_update_vae, bagel_transformer.py:1019): the image
+    changes the output, deterministically."""
+    img = np.random.default_rng(0).integers(0, 255, (16, 16, 3),
+                                            np.uint8)
+    img2 = np.random.default_rng(1).integers(0, 255, (16, 16, 3),
+                                             np.uint8)
+    base = _gen_img(pipe, None)
+    a = _gen_img(pipe, img)
+    b = _gen_img(pipe, img)
+    c = _gen_img(pipe, img2)
+    assert not np.array_equal(base, a)   # image conditions
+    np.testing.assert_array_equal(a, b)  # deterministically
+    assert not np.array_equal(a, c)      # on the image CONTENT
+    assert np.isfinite(a.astype(np.float32)).all()
+
+
+def test_conditioning_image_odd_size_resizes(pipe):
+    """Non-multiple sizes snap to the VAE geometry instead of failing."""
+    img = np.random.default_rng(2).integers(0, 255, (19, 13, 3),
+                                            np.uint8)
+    out = _gen_img(pipe, img)
+    assert out.shape == (16, 16, 3)
+
+
+def test_hunyuan_inherits_image_intake():
+    """HunyuanImage-3 rides the same intake through the shared stack."""
+    from vllm_omni_tpu.models.hunyuan_image_3.pipeline import (
+        HunyuanImage3Pipeline,
+        HunyuanImage3PipelineConfig,
+    )
+
+    hp = HunyuanImage3Pipeline(HunyuanImage3PipelineConfig.tiny(),
+                               dtype=jnp.float32, seed=0)
+    img = np.random.default_rng(3).integers(0, 255, (16, 16, 3),
+                                            np.uint8)
+    base = _gen_img(hp, None)
+    got = _gen_img(hp, img)
+    assert not np.array_equal(base, got)

@@ -21,9 +21,12 @@ loop-invariant array pytree (computed once by the prefill jit) — no
 cache mutation inside the loop, latent tokens attend [ctx ; latents]
 with full self-attention among themselves.  CFG branches batch as rows
 of a 3-deep context stack instead of three sequential forwards.
-Reduced scope vs the reference: conditioning-image intake (VAE + SigLIP
-ViT context tokens) and KV-cache injection are future work; text
-conditioning and the dual-branch CFG flow are in.
+Conditioning images join the context as VAE-latent tokens projected
+through ``vae2llm`` (forward_cache_update_vae, :1019) — packed image
+tokens attend each other bidirectionally while text stays causal.
+Reduced scope vs the reference: the SigLIP ViT understanding tower is
+future work; text + VAE-image conditioning and the dual-branch CFG
+flow are in.
 """
 
 from __future__ import annotations
@@ -164,26 +167,66 @@ def _rope(cfg: BagelConfig, positions):
 
 
 def prefill_context(params, cfg: BagelConfig, token_ids: jax.Array,
-                    ctx_mask: jax.Array):
-    """Context prefill through the UNDERSTANDING expert: returns
-    per-layer (k, v) [B, S_ctx, Hkv, D] for the denoise loop to attend
-    (the NaiveCache fill, forward_cache_update_text)."""
+                    ctx_mask: jax.Array, img_tokens=None):
+    """Context prefill (the NaiveCache fill): text rides the
+    UNDERSTANDING expert (forward_cache_update_text); conditioning-image
+    VAE-latent tokens ride the GENERATION expert
+    (forward_cache_update_vae — MoT routes VAE tokens to the gen branch)
+    with shared attention over the packed [text ; image] sequence.
+    Returns per-layer (k, v) [B, S_ctx(+S_img), Hkv, D] plus the
+    extended context mask.  ``img_tokens`` are already embedded
+    (vae2llm + t=0 timestep + 2D pos, see ``_image_context``); image
+    tokens attend each other bidirectionally while text stays causal."""
     b, s = token_ids.shape
-    x = nn.embedding(params["embed"], token_ids)
-    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-    cos, sin = _rope(cfg, positions)
-    bias = jnp.where(
-        (jnp.arange(s)[None, :] <= jnp.arange(s)[:, None])
-        & (ctx_mask[:, None, :] > 0), 0.0, -1e30)[:, None]  # [B,1,S,S]
+    xt = nn.embedding(params["embed"], token_ids)
+    tok_mask = ctx_mask
+    cos_t, sin_t = _rope(cfg, jnp.broadcast_to(
+        jnp.arange(s)[None], (b, s)))
+    if img_tokens is None:
+        s_all, xi = s, None
+    else:
+        s_img = img_tokens.shape[1]
+        s_all = s + s_img
+        xi = img_tokens.astype(xt.dtype)
+        tok_mask = jnp.concatenate(
+            [ctx_mask, jnp.ones((b, s_img), ctx_mask.dtype)], axis=1)
+        cos_i, sin_i = _rope(cfg, jnp.broadcast_to(
+            (s + jnp.arange(s_img))[None], (b, s_img)))
+    causal = jnp.arange(s_all)[None, :] <= jnp.arange(s_all)[:, None]
+    if img_tokens is not None:
+        # packed image attention: image tokens see each other
+        # bidirectionally; text stays causal and precedes the image
+        img_zone = (jnp.arange(s_all) >= s)[None, :] \
+            & (jnp.arange(s_all) >= s)[:, None]
+        causal = causal | img_zone
+    bias = jnp.where(causal[None] & (tok_mask[:, None, :] > 0),
+                     0.0, -1e30)[:, None]  # [B,1,S,S]
     kvs = []
     for layer in params["layers"]:
-        exp = layer["und"]
-        q, k, v = _qkv(exp, cfg, x, cos, sin)
+        und = layer["und"]
+        if xi is None:
+            q, k, v = _qkv(und, cfg, xt, cos_t, sin_t)
+        else:
+            gen = layer["gen"]
+            qt, kt, vt = _qkv(und, cfg, xt, cos_t, sin_t)
+            qi, ki, vi = _qkv(gen, cfg, xi, cos_i, sin_i)
+            q = jnp.concatenate([qt, qi], axis=1)
+            k = jnp.concatenate([kt, ki], axis=1)
+            v = jnp.concatenate([vt, vi], axis=1)
         kvs.append((k, v))
         o = _attend(q, k, v, bias)
-        x = x + nn.linear(exp["o_proj"], o.reshape(b, s, -1))
-        x = x + _mlp(exp, cfg, x)
-    return kvs
+        if xi is None:
+            xt = xt + nn.linear(und["o_proj"], o.reshape(b, s, -1))
+            xt = xt + _mlp(und, cfg, xt)
+        else:
+            xt = xt + nn.linear(und["o_proj"],
+                                o[:, :s].reshape(b, s, -1))
+            xt = xt + _mlp(und, cfg, xt)
+            xi = xi + nn.linear(gen["o_proj"],
+                                o[:, s:].reshape(b, s_all - s, -1))
+            xi = xi + _mlp(gen, cfg, xi)
+    return kvs, tok_mask
+
 
 
 def _attend(q, k, v, bias):
@@ -270,9 +313,14 @@ class BagelPipeline:
             vae_mod.init_decoder(k2, config.vae, dtype))
         self._seed = seed
         self._denoise_cache: dict = {}
+        self.vae_encoder_params = None  # built on demand (image intake)
         self._prefill_jit = jax.jit(
             lambda p, ids, mask: prefill_context(p, self.cfg.llm, ids,
                                                  mask))
+        self._prefill_img_jit = jax.jit(
+            lambda p, ids, mask, img: prefill_context(
+                p, self.cfg.llm, ids, mask, img_tokens=img))
+        self._img_ctx_jit = jax.jit(self._embed_image_context)
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
 
@@ -319,6 +367,59 @@ class BagelPipeline:
         self._denoise_cache[key] = run
         return run
 
+    def _image_context(self, req, batch: int):
+        """sampling_params.image -> vae2llm-projected context tokens
+        [B, S_img, hidden] (prepare_vae_images, pipeline_bagel.py:393)
+        or None."""
+        sp = req.sampling_params
+        image = sp.image if sp.image is not None else sp.extra.get(
+            "image")
+        if image is None:
+            return None
+        img = np.asarray(image)
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 127.5 - 1.0
+        cfg = self.cfg
+        mult = self.geometry_multiple
+        h, w = img.shape[:2]
+        th = max(mult, h // mult * mult)
+        tw = max(mult, w // mult * mult)
+        if (h, w) != (th, tw):
+            img = np.asarray(jax.image.resize(
+                jnp.asarray(img), (th, tw, 3), "bilinear"))
+        if self.vae_encoder_params is None:
+            self.vae_encoder_params = self.wiring.place(
+                vae_mod.init_encoder(
+                    jax.random.PRNGKey(self._seed + 1), cfg.vae,
+                    jnp.float32))
+        tokens = self._img_ctx_jit(self.vae_encoder_params,
+                                   self.dit_params,
+                                   jnp.asarray(img, jnp.float32))
+        return jnp.repeat(tokens, batch, axis=0)
+
+    def _embed_image_context(self, enc_params, params, img):
+        """jit body: [H, W, 3] -> embedded context tokens [1, S, hidden]
+        — VAE encode, 2x2 latent pack, vae2llm + t=0 timestep + 2D pos
+        (the same embedding flow_velocity gives generated latents; the
+        conditioning image is CLEAN, so t=0 on the 1->0 schedule)."""
+        cfg = self.cfg
+        lat = vae_mod.encode(enc_params, cfg.vae, img[None])
+        p = cfg.llm.patch
+        c = cfg.vae.latent_channels
+        lh, lw = lat.shape[1:3]
+        gh, gw = lh // p, lw // p
+        x = lat.reshape(1, gh, p, gw, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(1, gh * gw, p * p * c)
+        x = x.astype(self.dtype)
+        temb = nn.timestep_embedding(jnp.zeros((1,)), 256)
+        temb = nn.linear(params["time_in2"], jax.nn.silu(
+            nn.linear(params["time_in1"], temb.astype(x.dtype))))
+        pos2d = params["pos_embed"][
+            (jnp.arange(gh).repeat(gw) * cfg.llm.max_latent_size
+             + jnp.tile(jnp.arange(gw), gh))]
+        return (nn.linear(params["vae2llm"], x) + temb[:, None, :]
+                + pos2d[None].astype(x.dtype))
+
     def _context_ids(self, prompts: list[str]):
         ids, lens = self.tokenizer.batch_encode(prompts,
                                                 self.cfg.max_text_len)
@@ -346,11 +447,22 @@ class BagelPipeline:
         b = len(prompts)
 
         ids, mask = self._context_ids(prompts)
-        ctx_kvs = self._prefill_jit(self.dit_params, ids, mask)
-        # text-CFG branch: EMPTY context (cfg_text semantics).  The
-        # all-zero mask blanks every context key at attention time, so
-        # the conditional KV tensors can be reused — no second prefill
+        img_tokens = self._image_context(req, b)
+        if img_tokens is None:
+            ctx_kvs, mask = self._prefill_jit(self.dit_params, ids, mask)
+        else:
+            # conditioning image(s): VAE latents join the context through
+            # vae2llm (forward_cache_update_vae semantics)
+            ctx_kvs, mask = self._prefill_img_jit(
+                self.dit_params, ids, mask, img_tokens)
+        # text-CFG branch: drop the TEXT, keep the conditioning image
+        # (cfg_text semantics — the reference cfg_text branch holds the
+        # image context constant and only blanks the prompt).  Masking
+        # keys at attention time lets the conditional KV tensors be
+        # reused — no second prefill
         un_mask = jnp.zeros_like(mask)
+        if img_tokens is not None:
+            un_mask = un_mask.at[:, ids.shape[1]:].set(1)
         uncond_kvs = ctx_kvs
 
         steps = max(1, sp.num_inference_steps)

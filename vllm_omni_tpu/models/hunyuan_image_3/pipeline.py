@@ -86,7 +86,7 @@ class HunyuanImage3Pipeline(BagelPipeline):
     # derived dit_params would otherwise stash every shared dict TWICE
     # and wake() would materialize two device copies, silently doubling
     # weight memory
-    param_attrs = ("llm_shared", "vae_params")
+    param_attrs = ("llm_shared", "vae_params", "vae_encoder_params")
 
     def _build_llm_params(self, key, config, dtype):
         # shared single stack instead of Bagel's dual experts; aliasing
