@@ -31,7 +31,6 @@ flow are in.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, field
 
 import jax
@@ -44,7 +43,7 @@ from vllm_omni_tpu.diffusion.request import (
     OmniDiffusionRequest,
 )
 from vllm_omni_tpu.logger import init_logger
-from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.models.common import intake, nn
 from vllm_omni_tpu.models.qwen_image import vae as vae_mod
 from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
 from vllm_omni_tpu.ops import apply_rope, compute_rope_freqs, rms_norm, silu_mul
@@ -229,16 +228,7 @@ def prefill_context(params, cfg: BagelConfig, token_ids: jax.Array,
 
 
 
-def _attend(q, k, v, bias):
-    """[B, Sq, H, D] x [B, Sk, Hkv, D] with additive bias [B, 1, Sq, Sk]."""
-    hq, hkv = q.shape[2], k.shape[2]
-    if hq != hkv:
-        k = jnp.repeat(k, hq // hkv, axis=2)
-        v = jnp.repeat(v, hq // hkv, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / math.sqrt(q.shape[-1])
-    a = jax.nn.softmax(s + bias.astype(jnp.float32), axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", a.astype(v.dtype), v)
+_attend = nn.bias_attention
 
 
 def flow_velocity(params, cfg: BagelConfig, x_t: jax.Array,
@@ -376,17 +366,19 @@ class BagelPipeline:
             "image")
         if image is None:
             return None
-        img = np.asarray(image)
-        if img.dtype == np.uint8:
-            img = img.astype(np.float32) / 127.5 - 1.0
         cfg = self.cfg
         mult = self.geometry_multiple
-        h, w = img.shape[:2]
+        max_hw = cfg.llm.max_latent_size * cfg.vae.spatial_ratio
+        h, w = np.asarray(image).shape[:2]
         th = max(mult, h // mult * mult)
         tw = max(mult, w // mult * mult)
-        if (h, w) != (th, tw):
-            img = np.asarray(jax.image.resize(
-                jnp.asarray(img), (th, tw, 3), "bilinear"))
+        if th > max_hw or tw > max_hw:
+            # an image beyond the pos_embed grid would index past the
+            # 2D position table and silently corrupt the conditioning
+            raise InvalidRequestError(
+                f"conditioning image {h}x{w} exceeds the checkpoint "
+                f"limit {max_hw}x{max_hw} (max_latent_size)")
+        img = intake.prepare_cond_image(image, th, tw)
         if self.vae_encoder_params is None:
             self.vae_encoder_params = self.wiring.place(
                 vae_mod.init_encoder(
@@ -457,13 +449,20 @@ class BagelPipeline:
                 self.dit_params, ids, mask, img_tokens)
         # text-CFG branch: drop the TEXT, keep the conditioning image
         # (cfg_text semantics — the reference cfg_text branch holds the
-        # image context constant and only blanks the prompt).  Masking
-        # keys at attention time lets the conditional KV tensors be
-        # reused — no second prefill
+        # image context constant and only blanks the prompt).  Without a
+        # conditioning image the all-masked context makes latents attend
+        # only themselves, so the conditional KVs can be reused; WITH an
+        # image the image KVs were computed attending the text, so a
+        # text-free second prefill is required or the prompt leaks into
+        # the "unconditional" branch through the image keys
         un_mask = jnp.zeros_like(mask)
         if img_tokens is not None:
             un_mask = un_mask.at[:, ids.shape[1]:].set(1)
-        uncond_kvs = ctx_kvs
+            uncond_kvs, _ = self._prefill_img_jit(
+                self.dit_params, ids, jnp.zeros_like(mask[:, :ids.shape[1]]),
+                img_tokens)
+        else:
+            uncond_kvs = ctx_kvs
 
         steps = max(1, sp.num_inference_steps)
         sched_len = max(steps, cfg.steps_bucket)

@@ -1,0 +1,31 @@
+"""Conditioning-image intake shared by the causal-MM generator
+pipelines (Bagel, HunyuanImage-3) and the image-edit families.
+
+Reference: vllm_omni/diffusion/models/bagel/pipeline_bagel.py
+prepare_vae_images (:393) / hunyuan_image_3/pipeline_hunyuan_image_3.py
+vae_encode (:369) — uint8 -> [-1, 1] float, bilinear resize to the
+model's geometry, VAE encode.  Centralized so dtype/resize/validation
+fixes reach every family at once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def prepare_cond_image(image, target_h: int, target_w: int) -> np.ndarray:
+    """Any uint8/float HxWx3 array-like -> float32 [target_h, target_w, 3]
+    in [-1, 1] (bilinear resize when the shape differs)."""
+    img = np.asarray(image)
+    if img.ndim != 3 or img.shape[-1] != 3:
+        raise ValueError(f"conditioning image must be HxWx3, got "
+                         f"{img.shape}")
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 127.5 - 1.0
+    img = img.astype(np.float32)
+    if img.shape[:2] != (target_h, target_w):
+        img = np.asarray(jax.image.resize(
+            jnp.asarray(img), (target_h, target_w, 3), "bilinear"))
+    return img

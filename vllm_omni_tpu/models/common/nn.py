@@ -175,3 +175,22 @@ def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0):
     )
     args = t.astype(jnp.float32)[:, None] * freqs[None, :]
     return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def bias_attention(q, k, v, bias):
+    """GQA attention with an additive bias mask, fp32 softmax.
+
+    q [B, Sq, H, D] x k/v [B, Sk, Hkv, D], bias [B, 1, Sq, Sk] ->
+    [B, Sq, H, D].  KV heads repeat up to the query head count
+    (grouped-query attention); scores and softmax run in fp32 with the
+    values' dtype restored on the way out.  Shared by the causal-MM
+    generator families (Bagel, HunyuanImage-3) whose denoise attends a
+    masked prefix context."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(q.shape[-1])
+    a = jax.nn.softmax(s + bias.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", a.astype(v.dtype), v)

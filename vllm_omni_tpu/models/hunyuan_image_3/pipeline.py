@@ -1,22 +1,26 @@
-"""HunyuanImage-3: causal multimodal LLM that runs the image flow.
+"""HunyuanImage-3: one causal MoE LLM that runs the image flow.
 
-Reference: vllm_omni/diffusion/models/hunyuan_image_3/ —
-``HunyuanImage3Pipeline`` (pipeline_hunyuan_image_3.py:65, a
-PreTrainedModel + GenerationMixin): ONE causal (MoE) LLM serves both the
-text context and flow-matching image generation, with TIMESTEP TOKENS
-instantiated into the sequence (instantiate_timestep_tokens, :289), 2D
-rotary embeddings for image positions, and an image KV-cache manager
-(hunyuan_image_3_transformer.py:839) giving the denoise loop a static
-prefilled context — the same unified-AR-diffusion execution shape as
-Bagel, WITHOUT Bagel's dual expert weights.
+Reference: vllm_omni/diffusion/models/hunyuan_image_3/
+pipeline_hunyuan_image_3.py — HunyuanImage3Pipeline (:65, a
+PreTrainedModel + GenerationMixin): the prompt is tokenized with
+<boi><img_size><ratio> special tokens, a TIMESTEP TOKEN is instantiated
+into the sequence (instantiate_timestep_tokens :289), VAE latents are
+projected in through a timestep-conditioned UNetDown patch embed
+(instantiate_vae_image_tokens :200), the MoE transformer attends the
+cached text context with 2D-rope image positions, and the velocity is
+read back out through ragged_final_layer (:338, UNetUp conditioned on a
+second timestep embedding).  Requested sizes snap to ResolutionGroup
+aspect buckets (hunyuan_image_3_transformer.py:468).
 
-Composition: reuses the Bagel machinery (prefill + context-attending
-flow step) with a SINGLE transformer stack (the per-layer und/gen slots
-alias one expert dict — weight sharing, not duplication) and a timestep
-token prepended to the latent stream instead of Bagel's per-token
-timestep addition.  Reduced scope (documented): the ffn is dense here —
-the reference's fused-MoE ffn drops in through ops/moe at real-weight
-time; resolution-group bucketing and image editing follow.
+TPU-first: the text prefix prefills ONCE under jit into a
+loop-invariant KV pytree; the denoise loop is one jitted fori_loop over
+[timestep token ; latent tokens] per step (the reference's
+ImageKVCacheManager + per-step Python loop collapse into loop-carried
+state).  The CFG branch runs a text-free second prefill so no prompt
+information leaks into the unconditional velocity.  Latents stay
+spatial [B, H/16, W/16, C] through the loop; the UNetDown/UNetUp convs
+run NHWC.  Conditioning images (image edit intake) join the context as
+UNetDown-embedded clean latents at t=0.
 """
 
 from __future__ import annotations
@@ -25,85 +29,336 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from vllm_omni_tpu.logger import init_logger
-from vllm_omni_tpu.models.bagel.pipeline import (
-    BagelConfig,
-    BagelPipeline,
-    BagelPipelineConfig,
-    _expert_init,
+from vllm_omni_tpu.diffusion.request import (
+    DiffusionOutput,
+    InvalidRequestError,
+    OmniDiffusionRequest,
 )
-from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import intake, nn
+from vllm_omni_tpu.models.hunyuan_image_3 import projector
+from vllm_omni_tpu.models.hunyuan_image_3.resolution import ResolutionGroup
+from vllm_omni_tpu.models.hunyuan_image_3.transformer import (
+    HunyuanImage3Config,
+    diagonal_positions,
+    gen_image_step,
+    image_grid_positions,
+    init_params,
+    prefill,
+    rope_2d_table,
+)
+from vllm_omni_tpu.models.qwen_image import vae as vae_mod
 from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
 
 logger = init_logger(__name__)
 
 
 @dataclass(frozen=True)
-class HunyuanImage3PipelineConfig(BagelPipelineConfig):
+class HunyuanImage3PipelineConfig:
+    llm: HunyuanImage3Config = field(
+        default_factory=HunyuanImage3Config.real)
+    vae: VAEConfig = field(default_factory=lambda: VAEConfig(
+        latent_channels=32, channel_multipliers=(1, 2, 4, 4, 4)))
+    max_text_len: int = 64
+    steps_bucket: int = 32
+
+    def __post_init__(self):
+        if self.vae.spatial_ratio != self.llm.vae_ratio:
+            raise ValueError(
+                f"VAE spatial ratio {self.vae.spatial_ratio} != "
+                f"llm.vae_ratio {self.llm.vae_ratio}")
+        if self.vae.latent_channels != self.llm.latent_channels:
+            raise ValueError("latent channel mismatch between VAE and "
+                             "patch embed")
+
     @staticmethod
     def tiny() -> "HunyuanImage3PipelineConfig":
         return HunyuanImage3PipelineConfig(
-            llm=BagelConfig.tiny(), vae=VAEConfig.tiny(),
+            llm=HunyuanImage3Config.tiny(), vae=VAEConfig.tiny(),
             max_text_len=16, steps_bucket=8)
 
 
-def init_params(key, pcfg: HunyuanImage3PipelineConfig,
-                dtype=jnp.float32):
-    """Single-stack variant of the Bagel tree: each layer's und/gen
-    slots reference ONE expert dict (the reference has one transformer
-    serving both roles)."""
-    cfg = pcfg.llm
-    keys = jax.random.split(key, cfg.num_layers + 8)
-    ki = iter(keys)
-    shared_layers = [{"shared": _expert_init(next(ki), cfg, dtype)}
-                     for _ in range(cfg.num_layers)]
-    return {
-        "embed": nn.embedding_init(next(ki), cfg.vocab_size,
-                                   cfg.hidden_size, dtype),
-        "layers": shared_layers,
-        "final_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
-        "time_in1": nn.linear_init(next(ki), 256, cfg.hidden_size,
-                                   dtype=dtype),
-        "time_in2": nn.linear_init(next(ki), cfg.hidden_size,
-                                   cfg.hidden_size, dtype=dtype),
-        "vae2llm": nn.linear_init(next(ki), cfg.latent_dim,
-                                  cfg.hidden_size, dtype=dtype),
-        "llm2vae": nn.linear_init(next(ki), cfg.hidden_size,
-                                  cfg.latent_dim, dtype=dtype),
-        "pos_embed": jax.random.normal(
-            next(ki), (cfg.max_latent_size * cfg.max_latent_size,
-                       cfg.hidden_size), dtype) * 0.02,
-    }
+class HunyuanImage3Pipeline:
+    """Text -> image through a single causal MoE MM transformer."""
 
-
-class HunyuanImage3Pipeline(BagelPipeline):
-    """Text -> image through one shared-stack causal MM transformer."""
-
+    output_type = "image"
     config_cls = HunyuanImage3PipelineConfig
 
-    # engine.sleep() stashes llm_shared (the alias-free tree); the
-    # derived dit_params would otherwise stash every shared dict TWICE
-    # and wake() would materialize two device copies, silently doubling
-    # weight memory
-    param_attrs = ("llm_shared", "vae_params", "vae_encoder_params")
+    def __init__(self, config: HunyuanImage3PipelineConfig,
+                 dtype=jnp.bfloat16, seed: int = 0, mesh=None,
+                 cache_config=None):
+        from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
-    def _build_llm_params(self, key, config, dtype):
-        # shared single stack instead of Bagel's dual experts; aliasing
-        # happens AFTER device placement (a pytree containing the same
-        # dict twice would be placed as two separate copies)
-        self.llm_shared = self.wiring.place(
-            init_params(key, config, dtype))
-        return self._alias_shared()
+        self.cfg = config
+        self.dtype = dtype
+        self.mesh = mesh
+        self.cache_config = cache_config
+        self.wiring = MeshWiring(mesh, type(self).__name__).validate(
+            {"dp"})
+        if cache_config is not None:
+            raise ValueError(
+                "HunyuanImage-3's LLM denoise has no step cache yet")
+        llm = config.llm
+        self.tokenizer = ByteTokenizer(llm.vocab_size)
+        self.resolutions = ResolutionGroup(
+            llm.image_base_size,
+            step=max(llm.image_base_size // 16, llm.vae_ratio),
+            align=llm.vae_ratio)
+        if llm.ratio_token_base + len(self.resolutions) > llm.vocab_size:
+            raise ValueError(
+                f"ratio_token_base {llm.ratio_token_base} + "
+                f"{len(self.resolutions)} aspect buckets exceeds "
+                f"vocab_size {llm.vocab_size}")
+        logger.info("Initializing HunyuanImage3Pipeline (dtype=%s, "
+                    "%d resolution buckets)", dtype, len(self.resolutions))
+        keys = jax.random.split(jax.random.PRNGKey(seed), 7)
+        ph = llm.patch_embed_hidden_dim
+        self.dit_params = self.wiring.place({
+            "llm": init_params(keys[0], llm, dtype),
+            # three timestep embedders (reference: time_embed for the
+            # patch embed, timestep_emb for the in-sequence token,
+            # time_embed_2 for the final layer)
+            "time_embed": projector.timestep_embedder_init(
+                keys[1], llm.hidden_size, ph, dtype),
+            "timestep_emb": projector.timestep_embedder_init(
+                keys[2], llm.hidden_size, llm.hidden_size, dtype),
+            "time_embed_2": projector.timestep_embedder_init(
+                keys[3], llm.hidden_size, ph, dtype),
+            "patch_embed": projector.unet_down_init(
+                keys[4], llm.latent_channels, ph, ph, llm.hidden_size,
+                dtype),
+            "final_layer": projector.unet_up_init(
+                keys[5], llm.hidden_size, ph, ph, llm.latent_channels,
+                dtype),
+        })
+        self.vae_params = self.wiring.place(
+            vae_mod.init_decoder(keys[6], config.vae, dtype))
+        self._seed = seed
+        self._denoise_cache: dict = {}
+        self._prefill_jit = jax.jit(
+            lambda p, ids, mask, cos, sin: prefill(
+                p, self.cfg.llm, ids, mask, cos, sin))
+        self._prefill_img_jit = jax.jit(
+            lambda p, ids, mask, cos, sin, img: prefill(
+                p, self.cfg.llm, ids, mask, cos, sin, img_tokens=img))
+        self.vae_encoder_params = None  # built on demand (image intake)
+        self._vae_decode_jit = jax.jit(
+            lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
 
-    def _alias_shared(self):
-        tree = dict(self.llm_shared)
-        tree["layers"] = [{"und": l["shared"], "gen": l["shared"]}
-                          for l in self.llm_shared["layers"]]
-        return tree
+    @property
+    def geometry_multiple(self) -> int:
+        return self.cfg.llm.vae_ratio
 
-    def post_sleep(self):
-        self.dit_params = None  # derived aliases must not pin buffers
+    # ----------------------------------------------------------- context
 
-    def post_wake(self):
-        self.dit_params = self._alias_shared()
+    def _context(self, prompts: list[str], ratio_idx: int):
+        """Token ids [B, S_ctx] + mask: [text pad][<boi><size><ratio>].
+        The three special tokens carry the target resolution into the
+        sequence (prepare_model_inputs builds
+        `<boi><img_size_1024><ratio_i>` before the image slots)."""
+        cfg = self.cfg
+        llm = cfg.llm
+        ids, lens = self.tokenizer.batch_encode(prompts,
+                                                cfg.max_text_len)
+        b = len(prompts)
+        specials = np.array(
+            [llm.boi_token_id, llm.size_token_id,
+             llm.ratio_token_base + ratio_idx],
+            np.int32)
+        ids = np.concatenate(
+            [ids, np.broadcast_to(specials, (b, 3))], axis=1)
+        mask = np.concatenate(
+            [(np.arange(cfg.max_text_len)[None, :]
+              < lens[:, None]).astype(np.int32),
+             np.ones((b, 3), np.int32)], axis=1)
+        return jnp.asarray(ids), jnp.asarray(mask)
+
+    # ----------------------------------------------------------- denoise
+
+    def _denoise_fn(self, grid_h: int, grid_w: int, s_ctx: int,
+                    s_img: int, sched_len: int):
+        key = (grid_h, grid_w, s_ctx, s_img, sched_len)
+        if key in self._denoise_cache:
+            return self._denoise_cache[key]
+        cfg = self.cfg
+        llm = cfg.llm
+
+        # static rope tables: [text/specials diagonal ; cond-image grid],
+        # then the per-step [timestep ; latent grid] section after it
+        ctx_pos = diagonal_positions(0, s_ctx)
+        if s_img:
+            # conditioning image (resized to the same bucket) occupies a
+            # centered 2D grid right after the specials
+            ctx_pos = np.concatenate(
+                [ctx_pos, image_grid_positions(s_ctx, grid_h, grid_w)])
+        off = s_ctx + s_img
+        step_pos = np.concatenate(
+            [diagonal_positions(off, 1),
+             image_grid_positions(off + 1, grid_h, grid_w)])
+        ctx_cos, ctx_sin = rope_2d_table(ctx_pos, llm.head_dim,
+                                         llm.rope_theta)
+        step_cos, step_sin = rope_2d_table(step_pos, llm.head_dim,
+                                           llm.rope_theta)
+
+        def velocity(params, x, t, ctx_kvs, ctx_mask):
+            """x [B, gh, gw, C] spatial latents + flow time t [B] ->
+            velocity, same shape."""
+            tk = t * 1000.0
+            t_patch = projector.timestep_embed(params["time_embed"], tk,
+                                               x.dtype)
+            lat_tokens, _, _ = projector.unet_down(
+                params["patch_embed"], x, t_patch)
+            t_tok = projector.timestep_embed(params["timestep_emb"], tk,
+                                             x.dtype)
+            seq = jnp.concatenate([t_tok[:, None, :], lat_tokens],
+                                  axis=1)
+            hid = gen_image_step(params["llm"], llm, seq, ctx_kvs,
+                                 ctx_mask, jnp.asarray(step_cos),
+                                 jnp.asarray(step_sin))
+            t_fin = projector.timestep_embed(params["time_embed_2"], tk,
+                                             x.dtype)
+            # drop the timestep token (ragged_final_layer x[:, 1:, :])
+            return projector.unet_up(params["final_layer"], hid[:, 1:],
+                                     t_fin, grid_h, grid_w)
+
+        @jax.jit
+        def run(params, noise, ctx_kvs, ctx_mask, uncond_kvs, un_mask,
+                timesteps, dts, gscale, num_steps):
+            def body(i, x):
+                t = jnp.broadcast_to(timesteps[i], (x.shape[0],))
+                v_c = velocity(params, x, t, ctx_kvs, ctx_mask)
+                v_u = velocity(params, x, t, uncond_kvs, un_mask)
+                v = v_u + gscale * (v_c - v_u)
+                return x - v * dts[i].astype(x.dtype)
+
+            return jax.lax.fori_loop(0, num_steps, body, noise)
+
+        self._denoise_cache[key] = (run, ctx_cos, ctx_sin)
+        return self._denoise_cache[key]
+
+    # ------------------------------------------------------- image intake
+
+    def _image_context(self, req, batch: int, th: int, tw: int):
+        """sampling_params.image -> conditioning tokens [B, S_img,
+        hidden] embedded through the UNetDown patch embed at t=0 (the
+        clean-image end of the flow; _encode_cond_image), or None."""
+        sp = req.sampling_params
+        image = sp.image if sp.image is not None else sp.extra.get(
+            "image")
+        if image is None:
+            return None
+        img = intake.prepare_cond_image(image, th, tw)
+        if self.vae_encoder_params is None:
+            self.vae_encoder_params = self.wiring.place(
+                vae_mod.init_encoder(
+                    jax.random.PRNGKey(self._seed + 1), self.cfg.vae,
+                    jnp.float32))
+        if not hasattr(self, "_img_ctx_jit"):
+            self._img_ctx_jit = jax.jit(self._embed_image_context)
+        tokens = self._img_ctx_jit(self.vae_encoder_params,
+                                   self.dit_params,
+                                   jnp.asarray(img, jnp.float32))
+        return jnp.repeat(tokens, batch, axis=0)
+
+    def _embed_image_context(self, enc_params, params, img):
+        lat = vae_mod.encode(enc_params, self.cfg.vae, img[None])
+        lat = lat.astype(self.dtype)
+        t0 = projector.timestep_embed(params["time_embed"],
+                                      jnp.zeros((1,)), lat.dtype)
+        tokens, _, _ = projector.unet_down(params["patch_embed"], lat,
+                                           t0)
+        return tokens
+
+    # ----------------------------------------------------------- forward
+
+    def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
+        sp = req.sampling_params
+        cfg = self.cfg
+        llm = cfg.llm
+        base = llm.image_base_size
+        height = sp.height or base
+        width = sp.width or base
+        if height <= 0 or width <= 0:
+            raise InvalidRequestError("height/width must be positive")
+        # snap to the nearest aspect bucket (get_target_size)
+        tw, th = self.resolutions.get_target_size(width, height)
+        ratio_idx = self.resolutions.ratio_index(width, height)
+        grid_h = th // llm.vae_ratio
+        grid_w = tw // llm.vae_ratio
+        prompts = req.prompt
+        b = len(prompts)
+
+        ids, mask = self._context(prompts, ratio_idx)
+        s_ctx = int(ids.shape[1])
+
+        steps = max(1, sp.num_inference_steps)
+        sched_len = max(steps, cfg.steps_bucket)
+        # intake the conditioning image first: its token count shapes
+        # the rope tables (grid positions come from the denoise-cache
+        # entry)
+        cond_tokens = self._image_context(req, b, th, tw)
+        s_img = 0 if cond_tokens is None else int(cond_tokens.shape[1])
+        run, ctx_cos, ctx_sin = self._denoise_fn(grid_h, grid_w, s_ctx,
+                                                 s_img, sched_len)
+        if s_img:
+            ctx_kvs, mask = self._prefill_img_jit(
+                self.dit_params["llm"], ids, mask, jnp.asarray(ctx_cos),
+                jnp.asarray(ctx_sin), cond_tokens)
+            # text-free second prefill for the CFG branch: the cond
+            # image's KVs must not have attended the prompt (cfg_text
+            # semantics) or the prompt leaks into the "unconditional"
+            # velocity through the image keys
+            uncond_kvs, un_mask = self._prefill_img_jit(
+                self.dit_params["llm"], ids,
+                jnp.asarray(np.concatenate(
+                    [np.zeros((b, cfg.max_text_len), np.int32),
+                     np.ones((b, 3), np.int32)], axis=1)),
+                jnp.asarray(ctx_cos), jnp.asarray(ctx_sin), cond_tokens)
+        else:
+            ctx_kvs, mask = self._prefill_jit(
+                self.dit_params["llm"], ids, mask, jnp.asarray(ctx_cos),
+                jnp.asarray(ctx_sin))
+            uncond_kvs, un_mask = self._prefill_jit(
+                self.dit_params["llm"], ids,
+                jnp.asarray(np.concatenate(
+                    [np.zeros((b, cfg.max_text_len), np.int32),
+                     np.ones((b, 3), np.int32)], axis=1)),
+                jnp.asarray(ctx_cos), jnp.asarray(ctx_sin))
+
+        # shifted flow-match schedule (shared scheduler module — the
+        # reference drives a FlowMatch scheduler via retrieve_timesteps)
+        from vllm_omni_tpu.diffusion.scheduler import make_schedule
+
+        sched = make_schedule(steps, shift=llm.timestep_shift)
+        sig = np.asarray(sched.sigmas, np.float32)
+        t_pad = np.zeros(sched_len, np.float32)
+        t_pad[:steps] = sig[:steps]
+        d_pad = np.zeros(sched_len, np.float32)
+        d_pad[:steps] = sig[:steps] - sig[1:steps + 1]
+
+        seed = (sp.seed if sp.seed is not None
+                else int(np.random.randint(0, 2 ** 31 - 1)))
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (b, grid_h, grid_w, llm.latent_channels), jnp.float32,
+        ).astype(self.dtype)
+
+        latents = run(self.dit_params, noise, ctx_kvs, mask,
+                      uncond_kvs, un_mask, jnp.asarray(t_pad),
+                      jnp.asarray(d_pad), jnp.float32(sp.guidance_scale),
+                      jnp.int32(steps))
+
+        img = self._vae_decode_jit(self.vae_params,
+                                   latents.astype(jnp.float32))
+        img = np.asarray(jnp.clip(
+            (img.astype(jnp.float32) + 1.0) * 127.5, 0, 255)
+            .astype(jnp.uint8))
+        return [
+            DiffusionOutput(request_id=req.request_ids[i],
+                            prompt=prompts[i], data=img[i],
+                            output_type="image")
+            for i in range(b)
+        ]

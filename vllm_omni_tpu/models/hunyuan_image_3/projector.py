@@ -1,0 +1,96 @@
+"""Timestep-conditioned UNet latent projectors (img_proj_type="unet").
+
+Reference: hunyuan_image_3_transformer.py — ResBlock (:2571, adaptive
+group norm: emb -> scale/shift on the out-norm), UNetDown patch embed
+(:2666: conv3x3 -> ResBlock, flatten to tokens), UNetUp final layer
+(:2717: ResBlock -> GN+SiLU+conv3x3 back to latent channels),
+TimestepEmbedder (:2535, 256-dim sinusoid -> MLP).
+
+Convs run in NHWC (TPU-native layout for lax.conv); patch_size=1 is the
+published checkpoint's configuration so no up/down resampling paths are
+carried.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+
+
+def timestep_embedder_init(key, hidden: int, out: int, dtype):
+    k1, k2 = jax.random.split(key)
+    return {"fc1": nn.linear_init(k1, 256, hidden, dtype=dtype),
+            "fc2": nn.linear_init(k2, hidden, out, dtype=dtype)}
+
+
+def timestep_embed(p, t, dtype):
+    """t [B] (0..1 flow time scaled to 0..1000 by the caller) -> [B, out].
+    GELU between the two layers (TimestepEmbedder act_layer=nn.GELU)."""
+    h = nn.timestep_embedding(t, 256).astype(dtype)
+    return nn.linear(p["fc2"], jax.nn.gelu(nn.linear(p["fc1"], h)))
+
+
+def resblock_init(key, cin: int, cemb: int, cout: int, dtype):
+    k = jax.random.split(key, 4)
+    p = {
+        "in_norm": nn.groupnorm_init(cin, dtype),
+        "in_conv": nn.conv2d_init(k[0], cin, cout, 3, dtype=dtype),
+        "emb": nn.linear_init(k[1], cemb, 2 * cout, dtype=dtype),
+        "out_norm": nn.groupnorm_init(cout, dtype),
+        "out_conv": nn.conv2d_init(k[2], cout, cout, 3, dtype=dtype),
+    }
+    # zero_module on the out conv: identity residual at init (:2631)
+    p["out_conv"]["w"] = jnp.zeros_like(p["out_conv"]["w"])
+    p["out_conv"]["b"] = jnp.zeros_like(p["out_conv"]["b"])
+    if cin != cout:
+        p["skip"] = nn.conv2d_init(k[3], cin, cout, 1, dtype=dtype)
+    return p
+
+
+def resblock(p, x, emb, groups: int = 32):
+    """x [B, H, W, C], emb [B, cemb] — adaptive-GN residual block."""
+    h = nn.conv2d(p["in_conv"], jax.nn.silu(
+        nn.groupnorm(p["in_norm"], x, groups)))
+    scale, shift = jnp.split(
+        nn.linear(p["emb"], jax.nn.silu(emb)), 2, axis=-1)
+    h = nn.groupnorm(p["out_norm"], h, groups) \
+        * (1.0 + scale[:, None, None, :]) + shift[:, None, None, :]
+    h = nn.conv2d(p["out_conv"], jax.nn.silu(h))
+    skip = nn.conv2d(p["skip"], x) if "skip" in p else x
+    return skip + h
+
+
+def unet_down_init(key, cin: int, cemb: int, chidden: int, cout: int,
+                   dtype):
+    k1, k2 = jax.random.split(key)
+    return {"conv_in": nn.conv2d_init(k1, cin, chidden, 3, dtype=dtype),
+            "res": resblock_init(k2, chidden, cemb, cout, dtype)}
+
+
+def unet_down(p, lat, t_emb):
+    """VAE latents [B, H, W, C] + t_emb [B, cemb] -> tokens
+    [B, H*W, cout] (patch_size=1: no spatial reduction)."""
+    h = nn.conv2d(p["conv_in"], lat)
+    h = resblock(p["res"], h, t_emb)
+    b, gh, gw, c = h.shape
+    return h.reshape(b, gh * gw, c), gh, gw
+
+
+def unet_up_init(key, cin: int, cemb: int, chidden: int, cout: int,
+                 dtype):
+    k1, k2 = jax.random.split(key)
+    return {"res": resblock_init(k1, cin, cemb, chidden, dtype),
+            "out_norm": nn.groupnorm_init(chidden, dtype),
+            "conv_out": nn.conv2d_init(k2, chidden, cout, 3, dtype=dtype)}
+
+
+def unet_up(p, tokens, t_emb, grid_h: int, grid_w: int):
+    """Hidden tokens [B, S, cin] -> latent prediction [B, H, W, cout]
+    (UNetUp with out_norm: ResBlock -> GN+SiLU+conv3x3)."""
+    b, s, c = tokens.shape
+    x = tokens.reshape(b, grid_h, grid_w, c)
+    x = resblock(p["res"], x, t_emb)
+    x = jax.nn.silu(nn.groupnorm(p["out_norm"], x))
+    return nn.conv2d(p["conv_out"], x)

@@ -1,0 +1,59 @@
+"""Aspect-ratio resolution buckets for HunyuanImage-3.
+
+Reference: hunyuan_image_3_transformer.py — ResolutionGroup (:468):
+starting from (base, base), step height up / width down (and the
+mirror) between base/2 and base*2, aligning each side down to ``align``;
+requests snap to the bucket with the nearest aspect ratio
+(get_target_size :543).  Bucketing keeps the set of compiled
+(grid_h, grid_w) executables finite — on TPU each bucket is one XLA
+compilation, so this doubles as the jit-cache policy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ResolutionGroup:
+    def __init__(self, base_size: int, step: int | None = None,
+                 align: int = 1):
+        if base_size % align:
+            raise ValueError(f"base_size {base_size} not divisible by "
+                             f"align {align}")
+        if step is None:
+            step = max(base_size // 16, align)
+        if step > base_size // 2:
+            raise ValueError(f"step {step} > base_size//2")
+        self.base_size = base_size
+        self.step = step
+        self.align = align
+        self.data = self._calc_by_step()
+        self.ratio = np.array([h / w for h, w in self.data])
+
+    def _calc_by_step(self) -> list[tuple[int, int]]:
+        base, step, align = self.base_size, self.step, self.align
+        lo, hi = base // 2, base * 2
+        out = [(base, base)]
+        h, w = base, base
+        while not (h >= hi and w <= lo):
+            h = min(h + step, hi)
+            w = max(w - step, lo)
+            out.append((h // align * align, w // align * align))
+        h, w = base, base
+        while not (h <= lo and w >= hi):
+            h = max(h - step, lo)
+            w = min(w + step, hi)
+            out.append((h // align * align, w // align * align))
+        return sorted(set(out), key=lambda s: s[0] / s[1])
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def get_target_size(self, width: int, height: int) -> tuple[int, int]:
+        """(width, height) of the nearest-ratio bucket."""
+        idx = self.ratio_index(width, height)
+        h, w = self.data[idx]
+        return w, h
+
+    def ratio_index(self, width: int, height: int) -> int:
+        return int(np.argmin(np.abs(self.ratio - height / width)))

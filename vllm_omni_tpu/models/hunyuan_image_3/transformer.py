@@ -1,0 +1,296 @@
+"""HunyuanImage-3 causal multimodal transformer — TPU-native.
+
+Reference: vllm_omni/diffusion/models/hunyuan_image_3/
+hunyuan_image_3_transformer.py — HunyuanImage3Config (:978, 80B-total /
+13B-active MoE: 64 routed experts + 1 shared, top-8), 2D rotary
+embeddings with centered image grids (build_2d_rope :239),
+HunYuanSparseMoeBlock (:1335, softmax-renormalized top-k + shared
+expert), GQA attention (:1435), decoder layers (:1608).
+
+TPU-first redesign: the reference's per-layer nn.Modules with a mutable
+KV cache become pure functions over a param pytree; the denoise loop's
+context KV is a loop-invariant array computed once by a prefill jit
+(the ImageKVCacheManager :839 exists only to re-materialize the prefix
+KV each step — a fori_loop carrying x with frozen context needs no
+manager).  Routed experts run through ops/moe's ragged_dot grouped
+matmul (MXU-shaped) instead of a fused-CUDA MoE; 2D rope tables are
+precomputed host-side per (text_len, grid) geometry — static shapes,
+one compile per resolution bucket.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import rms_norm, silu_mul
+from vllm_omni_tpu.ops.moe import routed_moe
+
+
+@dataclass(frozen=True)
+class HunyuanImage3Config:
+    """Geometry of the causal MM generator.
+
+    ``real()`` is the published HunyuanImage-3 shape (reference config
+    defaults :1070-1145 + the 80B/13B-active MoE card): 32 layers,
+    hidden 4096, 32 q / 8 kv heads, 64 routed experts top-8 with one
+    shared expert, vocab 290943, 16x-downsampling VAE with patch 1 so a
+    1024px image is 64x64 = 4096 latent tokens (+1 timestep token =
+    the ImageKVCacheManager's 4097, :844)."""
+
+    vocab_size: int = 290943
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate_size: int = 11008       # shared-expert / dense MLP
+    moe_intermediate_size: int = 3072    # per routed expert
+    num_experts: int = 64
+    moe_topk: int = 8
+    moe_layer_num_skipped: int = 0       # leading dense layers
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    # latent interface (vae_downsample_factor=(16,16), patch_size=1)
+    latent_channels: int = 32
+    patch_embed_hidden_dim: int = 1024
+    image_base_size: int = 1024
+    vae_ratio: int = 16
+    timestep_shift: float = 3.0
+    # special vocab ids (reference :1085-1092)
+    boi_token_id: int = 4
+    eoi_token_id: int = 5
+    image_token_id: int = 8
+    # <img_size_1024> / <ratio_i> live in the vocab tail; resolved from
+    # the real tokenizer at load time, stable defaults for random-init
+    size_token_id: int = 290800
+    ratio_token_base: int = 290816
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def is_moe_layer(self, idx: int) -> bool:
+        return self.num_experts > 1 and idx >= self.moe_layer_num_skipped
+
+    @staticmethod
+    def real() -> "HunyuanImage3Config":
+        return HunyuanImage3Config()
+
+    @staticmethod
+    def tiny(moe: bool = True) -> "HunyuanImage3Config":
+        return HunyuanImage3Config(
+            hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128,
+            moe_intermediate_size=32, num_experts=4 if moe else 1,
+            moe_topk=2, latent_channels=4, patch_embed_hidden_dim=32,
+            image_base_size=32, vae_ratio=2,
+            vocab_size=768, size_token_id=600, ratio_token_base=601,
+        )
+
+
+# ---------------------------------------------------------------------------
+# 2D rotary embeddings
+
+
+def rope_2d_table(pos_yx: np.ndarray, head_dim: int,
+                  theta: float) -> tuple[np.ndarray, np.ndarray]:
+    """(y, x) positions [S, 2] -> neox-style cos/sin [S, head_dim].
+
+    Frequency pairs alternate between the y and x axes (reference
+    build_2d_rope :257: theta reshaped [d//4, 2], multiplied by the
+    [S, 1, 2] position stack) — text tokens pass diagonal (p, p)
+    positions so their rotation matches plain 1D rope."""
+    assert head_dim % 4 == 0, head_dim
+    freqs = 1.0 / theta ** (np.arange(0, head_dim, 2,
+                                      dtype=np.float64) / head_dim)
+    freqs = freqs.reshape(head_dim // 4, 2)           # [d//4, (y,x)]
+    ang = (pos_yx[:, None, :] * freqs[None]).reshape(len(pos_yx), -1)
+    cos = np.cos(ang)
+    sin = np.sin(ang)
+    # neox rotate-half convention: duplicate to the full head dim
+    return (np.concatenate([cos, cos], axis=-1).astype(np.float32),
+            np.concatenate([sin, sin], axis=-1).astype(np.float32))
+
+
+def image_grid_positions(start: int, grid_h: int,
+                         grid_w: int) -> np.ndarray:
+    """Centered 2D grid for an image section beginning at sequence
+    offset ``start`` (build_2d_rope :270-276: beta offsets center the
+    grid on the 1D axis so text before/after stays ordered)."""
+    beta_y = start + (grid_w * grid_h - grid_h) / 2.0
+    beta_x = start + (grid_w * grid_h - grid_w) / 2.0
+    ys = beta_y + np.arange(grid_h, dtype=np.float64)
+    xs = beta_x + np.arange(grid_w, dtype=np.float64)
+    grid = np.stack(np.meshgrid(ys, xs, indexing="ij"), axis=-1)
+    return grid.reshape(-1, 2)
+
+
+def diagonal_positions(start: int, n: int) -> np.ndarray:
+    p = np.arange(start, start + n, dtype=np.float64)
+    return np.stack([p, p], axis=-1)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope_2d(x: jax.Array, cos: jax.Array, sin: jax.Array):
+    """x [B, S, H, D] with tables [S, D]."""
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return x * c + _rotate_half(x) * s
+
+
+# ---------------------------------------------------------------------------
+# parameters
+
+
+def _layer_init(key, cfg: HunyuanImage3Config, idx: int, dtype):
+    k = jax.random.split(key, 9)
+    h = cfg.hidden_size
+    p = {
+        "input_norm": nn.rmsnorm_init(h, dtype),
+        "q_proj": nn.linear_init(k[0], h, cfg.q_dim, bias=False,
+                                 dtype=dtype),
+        "k_proj": nn.linear_init(k[1], h, cfg.kv_dim, bias=False,
+                                 dtype=dtype),
+        "v_proj": nn.linear_init(k[2], h, cfg.kv_dim, bias=False,
+                                 dtype=dtype),
+        "o_proj": nn.linear_init(k[3], cfg.q_dim, h, bias=False,
+                                 dtype=dtype),
+        "post_norm": nn.rmsnorm_init(h, dtype),
+    }
+    if cfg.is_moe_layer(idx):
+        e, mi = cfg.num_experts, cfg.moe_intermediate_size
+        scale = 1.0 / math.sqrt(h)
+        p["gate"] = jax.random.normal(k[4], (h, e), dtype) * scale
+        p["experts_gate_up"] = jax.random.normal(
+            k[5], (e, h, 2 * mi), dtype) * scale
+        p["experts_down"] = jax.random.normal(
+            k[6], (e, mi, h), dtype) * (1.0 / math.sqrt(mi))
+        # shared expert: a full dense MLP beside the routed ones
+        p["shared_gate_up"] = nn.linear_init(
+            k[7], h, 2 * cfg.intermediate_size, bias=False, dtype=dtype)
+        p["shared_down"] = nn.linear_init(
+            k[8], cfg.intermediate_size, h, bias=False, dtype=dtype)
+    else:
+        p["gate_up"] = nn.linear_init(k[4], h, 2 * cfg.intermediate_size,
+                                      bias=False, dtype=dtype)
+        p["down"] = nn.linear_init(k[5], cfg.intermediate_size, h,
+                                   bias=False, dtype=dtype)
+    return p
+
+
+def init_params(key, cfg: HunyuanImage3Config, dtype=jnp.float32):
+    keys = jax.random.split(key, cfg.num_layers + 2)
+    return {
+        "embed": nn.embedding_init(keys[0], cfg.vocab_size,
+                                   cfg.hidden_size, dtype),
+        "layers": [_layer_init(keys[1 + i], cfg, i, dtype)
+                   for i in range(cfg.num_layers)],
+        "final_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _mlp(layer, cfg: HunyuanImage3Config, x, moe: bool):
+    h = rms_norm(x, layer["post_norm"]["w"], cfg.rms_eps)
+    if not moe:
+        return nn.linear(layer["down"], silu_mul(
+            nn.linear(layer["gate_up"], h)))
+    b, s, d = h.shape
+    flat = h.reshape(b * s, d)
+    routed = routed_moe(flat, layer["gate"], layer["experts_gate_up"],
+                        layer["experts_down"], cfg.moe_topk)
+    shared = nn.linear(layer["shared_down"], silu_mul(
+        nn.linear(layer["shared_gate_up"], flat)))
+    return (routed + shared).reshape(b, s, d)
+
+
+def _qkv(layer, cfg: HunyuanImage3Config, x, cos, sin):
+    b, s, _ = x.shape
+    h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+    flat = h.reshape(b * s, -1)
+    q = nn.linear(layer["q_proj"], flat).reshape(b, s, -1, cfg.head_dim)
+    k = nn.linear(layer["k_proj"], flat).reshape(b, s, -1, cfg.head_dim)
+    v = nn.linear(layer["v_proj"], flat).reshape(b, s, -1, cfg.head_dim)
+    return (apply_rope_2d(q, cos, sin), apply_rope_2d(k, cos, sin), v)
+
+
+def prefill(params, cfg: HunyuanImage3Config, token_ids: jax.Array,
+            ctx_mask: jax.Array, cos: jax.Array, sin: jax.Array,
+            img_tokens: jax.Array | None = None):
+    """Causal text/special-token prefill -> per-layer (k, v) context.
+
+    The reference fills a HF DynamicCache through gen_text mode; here
+    the whole prefix runs once under jit and the KV pytree is returned
+    as loop-invariant context for the denoise fori_loop.
+
+    ``img_tokens`` (already embedded through the UNetDown patch embed at
+    t=0) extend the sequence after the text/specials as a CONDITIONING
+    image section (_encode_cond_image): bidirectional attention among
+    themselves, causal over the preceding text.  ``cos``/``sin`` must
+    cover the full extended sequence; ``ctx_mask`` only the token ids
+    (the image extension is always live)."""
+    b, s = token_ids.shape
+    x = nn.embedding(params["embed"], token_ids)
+    if img_tokens is not None:
+        s_img = img_tokens.shape[1]
+        x = jnp.concatenate([x, img_tokens.astype(x.dtype)], axis=1)
+        ctx_mask = jnp.concatenate(
+            [ctx_mask, jnp.ones((b, s_img), ctx_mask.dtype)], axis=1)
+    s_all = x.shape[1]
+    causal = jnp.arange(s_all)[None, :] <= jnp.arange(s_all)[:, None]
+    if img_tokens is not None:
+        img_zone = (jnp.arange(s_all) >= s)[None, :] \
+            & (jnp.arange(s_all) >= s)[:, None]
+        causal = causal | img_zone
+    bias = jnp.where(causal[None] & (ctx_mask[:, None, :] > 0),
+                     0.0, -1e30)[:, None]
+    kvs = []
+    for i, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(layer, cfg, x, cos, sin)
+        kvs.append((k, v))
+        o = nn.bias_attention(q, k, v, bias)
+        x = x + nn.linear(layer["o_proj"], o.reshape(b, s_all, -1))
+        x = x + _mlp(layer, cfg, x, cfg.is_moe_layer(i))
+    return kvs, ctx_mask
+
+
+def gen_image_step(params, cfg: HunyuanImage3Config, x_tokens: jax.Array,
+                   ctx_kvs, ctx_mask: jax.Array, cos: jax.Array,
+                   sin: jax.Array):
+    """One gen_image forward: embedded [timestep ; latent] tokens attend
+    [cached context ; themselves] with full self-attention inside the
+    image section (the reference's gen_image attention mode), returning
+    final-norm hidden states [B, S_img, hidden]."""
+    b, s_img, _ = x_tokens.shape
+    s_ctx = ctx_mask.shape[1]
+    x = x_tokens
+    bias = jnp.concatenate(
+        [jnp.where(ctx_mask[:, None, None, :] > 0, 0.0, -1e30),
+         jnp.zeros((b, 1, 1, s_img))], axis=-1)
+    bias = jnp.broadcast_to(bias, (b, 1, s_img, s_ctx + s_img))
+    for i, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(layer, cfg, x, cos, sin)
+        ck, cv = ctx_kvs[i]
+        k = jnp.concatenate([ck, k], axis=1)
+        v = jnp.concatenate([cv, v], axis=1)
+        o = nn.bias_attention(q, k, v, bias)
+        x = x + nn.linear(layer["o_proj"], o.reshape(b, s_img, -1))
+        x = x + _mlp(layer, cfg, x, cfg.is_moe_layer(i))
+    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
