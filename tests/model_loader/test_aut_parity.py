@@ -1,0 +1,102 @@
+"""AuT audio-tower parity vs the transformers oracle.
+
+Builds a tiny ``Qwen3OmniMoeAudioEncoder``, saves its weights as a
+thinker-prefixed safetensors checkpoint, loads it through
+``load_aut_encoder``, and compares forward outputs on random mel clips
+— the same tiny-synthetic-checkpoint methodology as
+test_hf_qwen_parity.py.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from vllm_omni_tpu.models.qwen3_omni import aut_encoder  # noqa: E402
+
+
+def _tiny_hf_cfg():
+    from transformers.models.qwen3_omni_moe.configuration_qwen3_omni_moe import (  # noqa: E501
+        Qwen3OmniMoeAudioEncoderConfig,
+    )
+
+    return Qwen3OmniMoeAudioEncoderConfig(
+        num_mel_bins=32, d_model=64, encoder_layers=2,
+        encoder_attention_heads=4, encoder_ffn_dim=128,
+        downsample_hidden_size=16, n_window=8, n_window_infer=32,
+        output_dim=48, max_source_positions=64,
+    )
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from transformers.models.qwen3_omni_moe.modeling_qwen3_omni_moe import (  # noqa: E501
+        Qwen3OmniMoeAudioEncoder,
+    )
+
+    torch.manual_seed(0)
+    hf_cfg = _tiny_hf_cfg()
+    model = Qwen3OmniMoeAudioEncoder(hf_cfg).eval().float()
+    d = tmp_path_factory.mktemp("aut_ckpt")
+    from safetensors.torch import save_file
+
+    state = {f"thinker.audio_tower.{k}": v.contiguous()
+             for k, v in model.state_dict().items()}
+    save_file(state, os.path.join(d, "model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"thinker_config": {
+            "audio_config": hf_cfg.to_dict()}}, f)
+    return str(d), model, hf_cfg
+
+
+def _jax_forward(ckpt_dir, mel_np):
+    params, cfg = aut_encoder.load_aut_encoder(ckpt_dir)
+    out = aut_encoder.forward(params, cfg, jnp.asarray(mel_np))
+    return np.asarray(out), cfg
+
+
+def _torch_forward(model, mel_np):
+    with torch.no_grad():
+        out = model(
+            torch.from_numpy(mel_np.T.copy()),  # HF takes [n_mels, T]
+            feature_lens=torch.tensor([mel_np.shape[0]]),
+        ).last_hidden_state
+    return out.numpy()
+
+
+@pytest.mark.parametrize("t_frames", [32, 48, 42, 10])
+def test_aut_matches_hf(checkpoint, t_frames):
+    """Window-multiple (32, 48), ragged-tail (42) and sub-window (10)
+    clip lengths all match the oracle."""
+    ckpt_dir, model, hf_cfg = checkpoint
+    rng = np.random.default_rng(t_frames)
+    mel = rng.standard_normal((t_frames, 32)).astype(np.float32)
+    ours, cfg = _jax_forward(ckpt_dir, mel)
+    theirs = _torch_forward(model, mel)
+    assert ours.shape == theirs.shape, (ours.shape, theirs.shape)
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_config_from_checkpoint(checkpoint):
+    ckpt_dir, _, hf_cfg = checkpoint
+    _, cfg = aut_encoder.load_aut_encoder(ckpt_dir)
+    assert cfg.d_model == hf_cfg.d_model
+    assert cfg.n_window == hf_cfg.n_window
+    assert cfg.output_dim == hf_cfg.output_dim
+
+
+def test_token_count_matches_reference_formula(checkpoint):
+    """T' equals the reference's _get_feat_extract_output_lengths
+    composition for every length."""
+    ckpt_dir, model, _ = checkpoint
+    for t in (8, 16, 17, 30, 48):
+        mel = np.zeros((t, 32), np.float32)
+        ours, cfg = _jax_forward(ckpt_dir, mel)
+        theirs = _torch_forward(model, mel)
+        assert ours.shape[0] == theirs.shape[0], t

@@ -321,8 +321,8 @@ class BagelPipeline:
     def geometry_multiple(self) -> int:
         return self.cfg.vae.spatial_ratio * self.cfg.llm.patch
 
-    def _denoise_fn(self, grid_h, grid_w, sched_len):
-        key = (grid_h, grid_w, sched_len)
+    def _denoise_fn(self, grid_h, grid_w, sched_len, use_cfg=True):
+        key = (grid_h, grid_w, sched_len, use_cfg)
         if key in self._denoise_cache:
             return self._denoise_cache[key]
         cfg = self.cfg
@@ -334,6 +334,8 @@ class BagelPipeline:
                 t = jnp.broadcast_to(timesteps[i], (x.shape[0],))
                 v_cond = flow_velocity(params, cfg.llm, x, t, ctx_kvs,
                                        ctx_mask, grid_h, grid_w)
+                if not use_cfg:
+                    return x - v_cond * dts[i].astype(x.dtype)
                 v_un = flow_velocity(params, cfg.llm, x, t, uncond_kvs,
                                      uncond_mask, grid_h, grid_w)
                 v = v_un + gscale * (v_cond - v_un)
@@ -455,8 +457,9 @@ class BagelPipeline:
         # image the image KVs were computed attending the text, so a
         # text-free second prefill is required or the prompt leaks into
         # the "unconditional" branch through the image keys
+        use_cfg = sp.guidance_scale > 1.0
         un_mask = jnp.zeros_like(mask)
-        if img_tokens is not None:
+        if img_tokens is not None and use_cfg:
             un_mask = un_mask.at[:, ids.shape[1]:].set(1)
             uncond_kvs, _ = self._prefill_img_jit(
                 self.dit_params, ids, jnp.zeros_like(mask[:, :ids.shape[1]]),
@@ -482,7 +485,7 @@ class BagelPipeline:
             (b, grid_h * grid_w, cfg.llm.latent_dim), jnp.float32,
         ).astype(self.dtype)
 
-        run = self._denoise_fn(grid_h, grid_w, sched_len)
+        run = self._denoise_fn(grid_h, grid_w, sched_len, use_cfg)
         latents = run(self.dit_params, noise, ctx_kvs, mask, uncond_kvs,
                       un_mask, jnp.asarray(t_pad), jnp.asarray(d_pad),
                       jnp.float32(sp.guidance_scale),

@@ -179,8 +179,8 @@ class HunyuanImage3Pipeline:
     # ----------------------------------------------------------- denoise
 
     def _denoise_fn(self, grid_h: int, grid_w: int, s_ctx: int,
-                    s_img: int, sched_len: int):
-        key = (grid_h, grid_w, s_ctx, s_img, sched_len)
+                    s_img: int, sched_len: int, use_cfg: bool = True):
+        key = (grid_h, grid_w, s_ctx, s_img, sched_len, use_cfg)
         if key in self._denoise_cache:
             return self._denoise_cache[key]
         cfg = self.cfg
@@ -229,9 +229,10 @@ class HunyuanImage3Pipeline:
                 timesteps, dts, gscale, num_steps):
             def body(i, x):
                 t = jnp.broadcast_to(timesteps[i], (x.shape[0],))
-                v_c = velocity(params, x, t, ctx_kvs, ctx_mask)
-                v_u = velocity(params, x, t, uncond_kvs, un_mask)
-                v = v_u + gscale * (v_c - v_u)
+                v = velocity(params, x, t, ctx_kvs, ctx_mask)
+                if use_cfg:
+                    v_u = velocity(params, x, t, uncond_kvs, un_mask)
+                    v = v_u + gscale * (v - v_u)
                 return x - v * dts[i].astype(x.dtype)
 
             return jax.lax.fori_loop(0, num_steps, body, noise)
@@ -301,8 +302,13 @@ class HunyuanImage3Pipeline:
         # entry)
         cond_tokens = self._image_context(req, b, th, tw)
         s_img = 0 if cond_tokens is None else int(cond_tokens.shape[1])
+        use_cfg = sp.guidance_scale > 1.0
         run, ctx_cos, ctx_sin = self._denoise_fn(grid_h, grid_w, s_ctx,
-                                                 s_img, sched_len)
+                                                 s_img, sched_len,
+                                                 use_cfg)
+        blank = jnp.asarray(np.concatenate(
+            [np.zeros((b, cfg.max_text_len), np.int32),
+             np.ones((b, 3), np.int32)], axis=1))
         if s_img:
             ctx_kvs, mask = self._prefill_img_jit(
                 self.dit_params["llm"], ids, mask, jnp.asarray(ctx_cos),
@@ -311,22 +317,17 @@ class HunyuanImage3Pipeline:
             # image's KVs must not have attended the prompt (cfg_text
             # semantics) or the prompt leaks into the "unconditional"
             # velocity through the image keys
-            uncond_kvs, un_mask = self._prefill_img_jit(
-                self.dit_params["llm"], ids,
-                jnp.asarray(np.concatenate(
-                    [np.zeros((b, cfg.max_text_len), np.int32),
-                     np.ones((b, 3), np.int32)], axis=1)),
-                jnp.asarray(ctx_cos), jnp.asarray(ctx_sin), cond_tokens)
+            uncond_kvs, un_mask = (self._prefill_img_jit(
+                self.dit_params["llm"], ids, blank, jnp.asarray(ctx_cos),
+                jnp.asarray(ctx_sin), cond_tokens)
+                if use_cfg else (ctx_kvs, mask))
         else:
             ctx_kvs, mask = self._prefill_jit(
                 self.dit_params["llm"], ids, mask, jnp.asarray(ctx_cos),
                 jnp.asarray(ctx_sin))
-            uncond_kvs, un_mask = self._prefill_jit(
-                self.dit_params["llm"], ids,
-                jnp.asarray(np.concatenate(
-                    [np.zeros((b, cfg.max_text_len), np.int32),
-                     np.ones((b, 3), np.int32)], axis=1)),
-                jnp.asarray(ctx_cos), jnp.asarray(ctx_sin))
+            uncond_kvs, un_mask = (self._prefill_jit(
+                self.dit_params["llm"], ids, blank, jnp.asarray(ctx_cos),
+                jnp.asarray(ctx_sin)) if use_cfg else (ctx_kvs, mask))
 
         # shifted flow-match schedule (shared scheduler module — the
         # reference drives a FlowMatch scheduler via retrieve_timesteps)

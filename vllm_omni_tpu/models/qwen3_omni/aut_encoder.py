@@ -1,0 +1,313 @@
+"""Checkpoint-schema AuT audio encoder (real-weight path).
+
+Structural match for the HF ``Qwen3OmniMoeAudioEncoder`` (transformers
+qwen3_omni_moe/modeling_qwen3_omni_moe.py; reference consumes the same
+tower inside the thinker, vllm_omni/model_executor/models/qwen3_omni/
+qwen3_omni_moe_thinker.py): mel frames are split into windows of
+``2 * n_window`` frames, each window runs three stride-2 3x3 Conv2d
+stages over (freq, time) (8x downsample on both axes), a linear
+``conv_out`` folds the frequency axis into ``d_model``, whisper-style
+sinusoid positions RESTART per window, and the flattened tokens run a
+pre-LayerNorm transformer with BLOCK-DIAGONAL attention
+(``n_window_infer``-frame inference windows).  Output head:
+ln_post -> proj1 -> gelu -> proj2 -> ``output_dim``.
+
+TPU-first: the reference pads ragged chunk lists with
+nn.utils.rnn.pad_sequence and indexes with boolean masks — dynamic
+shapes XLA cannot tile.  Here the clip zero-pads to a whole number of
+windows and ALL windows (tail included) batch as ONE static conv
+([nw, 2w, F] -> [nw, t', d]) — bit-equal to the reference, whose tail
+window is convolved zero-padded too; the valid token set is then a
+single contiguous slice.  Block-diagonal attention is an additive
+[T', T'] bias built host-side from the group ids (exact, and at 30 s
+of audio T' = 750 the bias is 2.2 MB — nothing).  The simplified
+whisper-style tower in ``audio_encoder.py`` remains the random-init
+fast path; this module is the one ``load_aut_encoder`` fills from a
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+
+
+def _gelu(x):
+    # exact (erf) GELU — torch F.gelu / ACT2FN["gelu"]; jax.nn.gelu
+    # defaults to the tanh approximation, which breaks checkpoint parity
+    return jax.nn.gelu(x, approximate=False)
+
+
+@dataclass(frozen=True)
+class AuTEncoderConfig:
+    """Mirrors Qwen3OmniMoeAudioEncoderConfig (HF defaults)."""
+
+    num_mel_bins: int = 128
+    d_model: int = 1280
+    encoder_layers: int = 32
+    encoder_attention_heads: int = 20
+    encoder_ffn_dim: int = 5120
+    downsample_hidden_size: int = 480
+    n_window: int = 100
+    n_window_infer: int = 400
+    output_dim: int = 3584
+    max_source_positions: int = 1500
+
+    @property
+    def window_frames(self) -> int:
+        return 2 * self.n_window
+
+    @property
+    def freq_after_cnn(self) -> int:
+        f = self.num_mel_bins
+        for _ in range(3):
+            f = (f - 1) // 2 + 1
+        return f
+
+    @staticmethod
+    def conv_out_len(frames: int) -> int:
+        t = frames
+        for _ in range(3):
+            t = (t - 1) // 2 + 1
+        return t
+
+    @staticmethod
+    def tiny(output_dim: int = 64) -> "AuTEncoderConfig":
+        return AuTEncoderConfig(
+            num_mel_bins=32, d_model=64, encoder_layers=2,
+            encoder_attention_heads=4, encoder_ffn_dim=128,
+            downsample_hidden_size=16, n_window=8, n_window_infer=32,
+            output_dim=output_dim, max_source_positions=64,
+        )
+
+    @staticmethod
+    def from_hf(hf: dict) -> "AuTEncoderConfig":
+        return AuTEncoderConfig(
+            num_mel_bins=hf.get("num_mel_bins", 128),
+            d_model=hf.get("d_model", 1280),
+            encoder_layers=hf.get("encoder_layers", 32),
+            encoder_attention_heads=hf.get("encoder_attention_heads",
+                                           20),
+            encoder_ffn_dim=hf.get("encoder_ffn_dim", 5120),
+            downsample_hidden_size=hf.get("downsample_hidden_size", 480),
+            n_window=hf.get("n_window", 100),
+            n_window_infer=hf.get("n_window_infer", 400),
+            output_dim=hf.get("output_dim", 3584),
+            max_source_positions=hf.get("max_source_positions", 1500),
+        )
+
+
+def init_params(key, cfg: AuTEncoderConfig, dtype=jnp.float32):
+    k = jax.random.split(key, cfg.encoder_layers + 8)
+    d, dh = cfg.d_model, cfg.downsample_hidden_size
+    params = {
+        "conv2d1": nn.conv2d_init(k[0], 1, dh, 3, dtype=dtype),
+        "conv2d2": nn.conv2d_init(k[1], dh, dh, 3, dtype=dtype),
+        "conv2d3": nn.conv2d_init(k[2], dh, dh, 3, dtype=dtype),
+        "conv_out": nn.linear_init(k[3], dh * cfg.freq_after_cnn, d,
+                                   bias=False, dtype=dtype),
+        "ln_post": nn.layernorm_init(d, dtype=dtype),
+        "proj1": nn.linear_init(k[4], d, d, dtype=dtype),
+        "proj2": nn.linear_init(k[5], d, cfg.output_dim, dtype=dtype),
+        "layers": [],
+    }
+    for i in range(cfg.encoder_layers):
+        kk = jax.random.split(k[i + 8], 6)
+        params["layers"].append({
+            "attn_norm": nn.layernorm_init(d, dtype=dtype),
+            "q_proj": nn.linear_init(kk[0], d, d, dtype=dtype),
+            "k_proj": nn.linear_init(kk[1], d, d, dtype=dtype),
+            "v_proj": nn.linear_init(kk[2], d, d, dtype=dtype),
+            "out_proj": nn.linear_init(kk[3], d, d, dtype=dtype),
+            "final_norm": nn.layernorm_init(d, dtype=dtype),
+            "fc1": nn.linear_init(kk[4], d, cfg.encoder_ffn_dim,
+                                  dtype=dtype),
+            "fc2": nn.linear_init(kk[5], cfg.encoder_ffn_dim, d,
+                                  dtype=dtype),
+        })
+    return params
+
+
+def sinusoid_positions(length: int, channels: int,
+                       max_timescale: float = 10000.0) -> np.ndarray:
+    """Whisper-style [sin | cos] table (SinusoidsPositionEmbedding)."""
+    inc = math.log(max_timescale) / (channels // 2 - 1)
+    inv = np.exp(-inc * np.arange(channels // 2, dtype=np.float32))
+    t = np.arange(length, dtype=np.float32)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(t), np.cos(t)], axis=1)
+
+
+def _conv_stack(params, window: jax.Array) -> jax.Array:
+    """[N, frames, mel] -> [N, t', d_model] through the three stride-2
+    convs (NHWC: H=freq, W=time) + conv_out fold."""
+    x = window.transpose(0, 2, 1)[..., None]  # [N, F, T, 1]
+    for key in ("conv2d1", "conv2d2", "conv2d3"):
+        x = _gelu(nn.conv2d(params[key], x, stride=2,
+                                  padding=((1, 1), (1, 1))))
+    n, f, t, c = x.shape
+    # HF: permute(0,3,1,2).view(b, t, c*f) — channel-major then freq
+    x = x.transpose(0, 2, 3, 1).reshape(n, t, c * f)
+    return nn.linear(params["conv_out"], x)
+
+
+def _group_bias(token_groups: np.ndarray) -> np.ndarray:
+    """[T'] group ids -> additive block-diagonal bias [1, 1, T', T']."""
+    same = token_groups[:, None] == token_groups[None, :]
+    return np.where(same, 0.0, -1e30)[None, None].astype(np.float32)
+
+
+def attention_groups(cfg: AuTEncoderConfig, num_tokens: int) -> np.ndarray:
+    """Group id per token: inference windows of
+    ``conv_out_len(window_frames) * (n_window_infer // window_frames)``
+    tokens (the reference's cu_seqlens construction)."""
+    per = cfg.conv_out_len(cfg.window_frames) \
+        * (cfg.n_window_infer // cfg.window_frames)
+    return np.arange(num_tokens) // max(per, 1)
+
+
+def forward(params, cfg: AuTEncoderConfig, mel: jax.Array):
+    """One clip: mel [T, num_mel_bins] (T need not be a window multiple)
+    -> [T', output_dim] with T' = sum of per-window conv_out lengths.
+
+    The ragged tail window is zero-padded to a full window and run
+    through the SAME batched conv — exactly what the reference's
+    pad_sequence + masked-select does (its tail outputs see the
+    bias-propagated pad region, so convolving the tail at its true
+    length would NOT be bit-equal).  The tail's valid tokens are the
+    first ``conv_out_len(tail)`` rows of the last window, so the valid
+    token set is one contiguous slice — no gather.  Host-side control
+    flow only touches STATIC values (T).
+    """
+    t_frames = int(mel.shape[0])
+    w = cfg.window_frames
+    if w % 8:
+        raise ValueError("window_frames (2*n_window) must be a multiple "
+                         "of 8 so per-window conv lengths compose")
+    n_win = -(-t_frames // w)
+    tail = t_frames - (t_frames // w) * w
+    pad = n_win * w - t_frames
+    mel_p = jnp.pad(mel, ((0, pad), (0, 0))) if pad else mel
+    emb = _conv_stack(params, mel_p.reshape(n_win, w, cfg.num_mel_bins))
+    tp = emb.shape[1]  # conv_out_len(w)
+    emb = emb + jnp.asarray(
+        sinusoid_positions(tp, cfg.d_model), emb.dtype)[None]
+    n_tokens = (t_frames // w) * tp \
+        + (cfg.conv_out_len(tail) if tail else 0)
+    x = emb.reshape(n_win * tp, cfg.d_model)[:n_tokens]
+
+    groups = attention_groups(cfg, int(x.shape[0]))
+    bias = jnp.asarray(_group_bias(groups))
+    nh = cfg.encoder_attention_heads
+    hd = cfg.d_model // nh
+    for layer in params["layers"]:
+        h = nn.layernorm(layer["attn_norm"], x, eps=1e-5)
+        q = nn.linear(layer["q_proj"], h).reshape(1, -1, nh, hd)
+        k = nn.linear(layer["k_proj"], h).reshape(1, -1, nh, hd)
+        v = nn.linear(layer["v_proj"], h).reshape(1, -1, nh, hd)
+        o = nn.bias_attention(q, k, v, bias)
+        x = x + nn.linear(layer["out_proj"],
+                          o.reshape(-1, cfg.d_model))
+        h = nn.layernorm(layer["final_norm"], x, eps=1e-5)
+        x = x + nn.linear(layer["fc2"], _gelu(
+            nn.linear(layer["fc1"], h)))
+    x = nn.layernorm(params["ln_post"], x, eps=1e-5)
+    x = _gelu(nn.linear(params["proj1"], x))
+    return nn.linear(params["proj2"], x)
+
+
+# ------------------------------------------------------------------ loader
+
+_LAYER_MAP = {
+    "self_attn.q_proj": "q_proj",
+    "self_attn.k_proj": "k_proj",
+    "self_attn.v_proj": "v_proj",
+    "self_attn.out_proj": "out_proj",
+    "self_attn_layer_norm": "attn_norm",
+    "final_layer_norm": "final_norm",
+    "fc1": "fc1",
+    "fc2": "fc2",
+}
+
+
+def load_aut_encoder(model_dir: str, cfg: AuTEncoderConfig | None = None,
+                     prefix: str = "thinker.audio_tower.",
+                     dtype=jnp.float32):
+    """Fill the param tree from safetensors under ``prefix``.
+
+    Torch Conv2d weights [out, in, kh, kw] transpose to HWIO; torch
+    linears [out, in] transpose to [in, out]; LayerNorms keep w/b.
+    Returns (params, cfg).
+    """
+    import json
+    import os
+    import re
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        iter_safetensors,
+    )
+
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            hf = json.load(f)
+        for part in ("thinker_config", "audio_config"):
+            if part in hf:
+                hf = hf[part]
+        cfg = AuTEncoderConfig.from_hf(hf)
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, dtype))
+    params = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), shapes)
+    layer_re = re.compile(r"^layers\.(\d+)\.(.+?)\.(weight|bias)$")
+    loaded, unmapped = 0, []
+    for name, arr in iter_safetensors(model_dir):
+        if not name.startswith(prefix):
+            continue
+        sub = name[len(prefix):]
+        m = layer_re.match(sub)
+        if m:
+            li, inner, kind = int(m.group(1)), m.group(2), m.group(3)
+            key = _LAYER_MAP.get(inner)
+            if key is None or li >= cfg.encoder_layers:
+                unmapped.append(name)
+                continue
+            leaf = params["layers"][li][key]
+            if kind == "bias":
+                leaf["b"][...] = arr
+            elif key in ("attn_norm", "final_norm"):
+                leaf["w"][...] = arr
+            else:
+                leaf["w"][...] = arr.T
+            loaded += 1
+            continue
+        base, _, kind = sub.rpartition(".")
+        if base in ("conv2d1", "conv2d2", "conv2d3"):
+            if kind == "weight":
+                params[base]["w"][...] = np.transpose(arr, (2, 3, 1, 0))
+            else:
+                params[base]["b"][...] = arr
+        elif base == "conv_out" and kind == "weight":
+            params[base]["w"][...] = arr.T
+        elif base in ("proj1", "proj2"):
+            params[base]["w" if kind == "weight" else "b"][
+                ...] = arr.T if kind == "weight" else arr
+        elif base == "ln_post":
+            params[base]["w" if kind == "weight" else "b"][...] = arr
+        else:
+            unmapped.append(name)
+            continue
+        loaded += 1
+    if loaded == 0:
+        raise ValueError(f"no tensors under prefix {prefix!r} in "
+                         f"{model_dir}")
+    if unmapped:
+        from vllm_omni_tpu.logger import init_logger
+
+        init_logger(__name__).warning(
+            "unmapped audio-tower tensors (%d): %s", len(unmapped),
+            unmapped[:6])
+    return jax.tree.map(jnp.asarray, params), cfg

@@ -1,0 +1,386 @@
+"""Checkpoint-schema vision tower (real-weight path).
+
+Structural match for the HF ``Qwen3OmniMoeVisionEncoder`` (transformers
+qwen3_omni_moe/modeling_qwen3_omni_moe.py; the reference thinker
+consumes the same tower, vllm_omni/model_executor/models/qwen3_omni/
+qwen3_omni_moe_thinker.py): Conv3d patch embed over
+(temporal_patch, p, p), a learned position table bilinearly
+interpolated to the image grid (fast_pos_embed_interpolate), 2D rotary
+embeddings over merge-grouped (row, col) positions, pre-LN blocks with
+fused-qkv attention and gelu-tanh MLP, a spatial-merge MLP head, and
+DEEPSTACK side outputs (postshuffle-norm mergers at intermediate
+depths) that the LM injects into its early layers.
+
+TPU-first: tokens arrive merge-grouped (the HF processor's patch
+order), so every stage is a static reshape + matmul; the Conv3d with
+kernel == stride is a pure patch matmul (no conv lowering); attention
+runs full (bidirectional) per image — one image per call keeps
+cu_seqlens out of the graph entirely.  The simplified tower in
+``vision_encoder.py`` remains the random-init fast path; this module
+is the one ``load_vit_encoder`` fills from a checkpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+
+
+def _gelu_tanh(x):
+    return jax.nn.gelu(x, approximate=True)  # gelu_pytorch_tanh
+
+
+def _gelu_exact(x):
+    return jax.nn.gelu(x, approximate=False)  # nn.GELU in the mergers
+
+
+@dataclass(frozen=True)
+class ViTEncoderConfig:
+    """Mirrors Qwen3OmniMoeVisionEncoderConfig (HF defaults)."""
+
+    depth: int = 27
+    hidden_size: int = 1152
+    intermediate_size: int = 4304
+    num_heads: int = 16
+    in_channels: int = 3
+    patch_size: int = 16
+    spatial_merge_size: int = 2
+    temporal_patch_size: int = 2
+    out_hidden_size: int = 3584
+    num_position_embeddings: int = 2304
+    deepstack_visual_indexes: tuple[int, ...] = (8, 16, 24)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return (self.in_channels * self.temporal_patch_size
+                * self.patch_size ** 2)
+
+    @property
+    def num_grid_per_side(self) -> int:
+        return int(self.num_position_embeddings ** 0.5)
+
+    @staticmethod
+    def tiny(out_hidden_size: int = 48) -> "ViTEncoderConfig":
+        return ViTEncoderConfig(
+            depth=3, hidden_size=32, intermediate_size=64, num_heads=4,
+            patch_size=4, spatial_merge_size=2, temporal_patch_size=2,
+            out_hidden_size=out_hidden_size, num_position_embeddings=16,
+            deepstack_visual_indexes=(1,),
+        )
+
+    @staticmethod
+    def from_hf(hf: dict) -> "ViTEncoderConfig":
+        return ViTEncoderConfig(
+            depth=hf.get("depth", 27),
+            hidden_size=hf.get("hidden_size", 1152),
+            intermediate_size=hf.get("intermediate_size", 4304),
+            num_heads=hf.get("num_heads", 16),
+            in_channels=hf.get("in_channels", 3),
+            patch_size=hf.get("patch_size", 16),
+            spatial_merge_size=hf.get("spatial_merge_size", 2),
+            temporal_patch_size=hf.get("temporal_patch_size", 2),
+            out_hidden_size=hf.get("out_hidden_size", 3584),
+            num_position_embeddings=hf.get("num_position_embeddings",
+                                           2304),
+            deepstack_visual_indexes=tuple(
+                hf.get("deepstack_visual_indexes", (8, 16, 24))),
+        )
+
+
+def _merger_init(key, cfg: ViTEncoderConfig, dtype, postshuffle: bool):
+    k1, k2 = jax.random.split(key)
+    big = cfg.hidden_size * cfg.spatial_merge_size ** 2
+    return {
+        "ln_q": nn.layernorm_init(big if postshuffle else cfg.hidden_size,
+                                  dtype=dtype),
+        "fc1": nn.linear_init(k1, big, big, dtype=dtype),
+        "fc2": nn.linear_init(k2, big, cfg.out_hidden_size, dtype=dtype),
+    }
+
+
+def init_params(key, cfg: ViTEncoderConfig, dtype=jnp.float32):
+    n_deep = len(cfg.deepstack_visual_indexes)
+    k = jax.random.split(key, cfg.depth + n_deep + 4)
+    params = {
+        "patch_embed": nn.linear_init(k[0], cfg.patch_dim,
+                                      cfg.hidden_size, dtype=dtype),
+        "pos_embed": nn.embedding_init(k[1], cfg.num_position_embeddings,
+                                       cfg.hidden_size, dtype),
+        "merger": _merger_init(k[2], cfg, dtype, postshuffle=False),
+        "deepstack_mergers": [
+            _merger_init(k[3 + i], cfg, dtype, postshuffle=True)
+            for i in range(n_deep)
+        ],
+        "blocks": [],
+    }
+    for i in range(cfg.depth):
+        kk = jax.random.split(k[3 + n_deep + i], 4)
+        params["blocks"].append({
+            "norm1": nn.layernorm_init(cfg.hidden_size, dtype=dtype),
+            "norm2": nn.layernorm_init(cfg.hidden_size, dtype=dtype),
+            "qkv": nn.linear_init(kk[0], cfg.hidden_size,
+                                  3 * cfg.hidden_size, dtype=dtype),
+            "proj": nn.linear_init(kk[1], cfg.hidden_size,
+                                   cfg.hidden_size, dtype=dtype),
+            "fc1": nn.linear_init(kk[2], cfg.hidden_size,
+                                  cfg.intermediate_size, dtype=dtype),
+            "fc2": nn.linear_init(kk[3], cfg.intermediate_size,
+                                  cfg.hidden_size, dtype=dtype),
+        })
+    return params
+
+
+# ------------------------------------------------------------ host tables
+
+
+def merge_grouped_positions(t: int, grid_h: int, grid_w: int,
+                            merge: int) -> np.ndarray:
+    """(row, col) per token in merge-grouped order (rot_pos_emb):
+    [h/m, w/m, m, m] blocks, repeated over t frames."""
+    mh, mw = grid_h // merge, grid_w // merge
+    rows = (np.arange(mh)[:, None, None, None] * merge
+            + np.arange(merge)[None, None, :, None])
+    cols = (np.arange(mw)[None, :, None, None] * merge
+            + np.arange(merge)[None, None, None, :])
+    rows = np.broadcast_to(rows, (mh, mw, merge, merge)).reshape(-1)
+    cols = np.broadcast_to(cols, (mh, mw, merge, merge)).reshape(-1)
+    coords = np.stack([rows, cols], axis=-1)
+    return np.tile(coords, (t, 1))
+
+
+def rope_tables(cfg: ViTEncoderConfig, t: int, grid_h: int,
+                grid_w: int) -> tuple[np.ndarray, np.ndarray]:
+    """Neox cos/sin [T, head_dim]: freq table dim head_dim//2 indexed by
+    (row, col), halves concatenated then doubled (rot_pos_emb +
+    apply_rotary_pos_emb_vision)."""
+    dim = cfg.head_dim // 2
+    inv = 1.0 / 10000.0 ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    pos = merge_grouped_positions(t, grid_h, grid_w,
+                                  cfg.spatial_merge_size)
+    freqs = pos[:, :, None] * inv[None, None, :]  # [T, 2, dim//2]
+    emb = freqs.reshape(len(pos), -1)             # [T, dim]
+    emb = np.concatenate([emb, emb], axis=-1)     # [T, head_dim]
+    return (np.cos(emb).astype(np.float32),
+            np.sin(emb).astype(np.float32))
+
+
+def pos_embed_indices(cfg: ViTEncoderConfig, grid_h: int,
+                      grid_w: int) -> tuple[np.ndarray, np.ndarray]:
+    """Bilinear interpolation of the learned position grid
+    (fast_pos_embed_interpolate): 4 corner index sets + weights, in
+    RASTER order [grid_h * grid_w]."""
+    side = cfg.num_grid_per_side
+    h_idx = np.linspace(0, side - 1, grid_h)
+    w_idx = np.linspace(0, side - 1, grid_w)
+    hf_, wf_ = h_idx.astype(np.int64), w_idx.astype(np.int64)
+    hc = np.clip(hf_ + 1, None, side - 1)
+    wc = np.clip(wf_ + 1, None, side - 1)
+    dh, dw = h_idx - hf_, w_idx - wf_
+    idx = np.stack([
+        (hf_[:, None] * side + wf_[None, :]).reshape(-1),
+        (hf_[:, None] * side + wc[None, :]).reshape(-1),
+        (hc[:, None] * side + wf_[None, :]).reshape(-1),
+        (hc[:, None] * side + wc[None, :]).reshape(-1),
+    ])
+    w = np.stack([
+        ((1 - dh)[:, None] * (1 - dw)[None, :]).reshape(-1),
+        ((1 - dh)[:, None] * dw[None, :]).reshape(-1),
+        (dh[:, None] * (1 - dw)[None, :]).reshape(-1),
+        (dh[:, None] * dw[None, :]).reshape(-1),
+    ]).astype(np.float32)
+    return idx, w
+
+
+def _interp_pos_embed(params, cfg: ViTEncoderConfig, t: int, grid_h: int,
+                      grid_w: int):
+    idx, w = pos_embed_indices(cfg, grid_h, grid_w)
+    table = params["pos_embed"]["w"]
+    pe = (table[idx[0]] * w[0][:, None] + table[idx[1]] * w[1][:, None]
+          + table[idx[2]] * w[2][:, None] + table[idx[3]] * w[3][:, None])
+    # raster -> merge-grouped order, repeated over frames
+    m = cfg.spatial_merge_size
+    pe = pe.reshape(grid_h // m, m, grid_w // m, m, -1)
+    pe = pe.transpose(0, 2, 1, 3, 4).reshape(grid_h * grid_w, -1)
+    return jnp.tile(pe, (t, 1))
+
+
+def _merger(p, x, cfg: ViTEncoderConfig, postshuffle: bool):
+    big = cfg.hidden_size * cfg.spatial_merge_size ** 2
+    if postshuffle:
+        x = nn.layernorm(p["ln_q"], x.reshape(-1, big), eps=1e-6)
+    else:
+        x = nn.layernorm(p["ln_q"], x, eps=1e-6).reshape(-1, big)
+    return nn.linear(p["fc2"], _gelu_exact(nn.linear(p["fc1"], x)))
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def forward(params, cfg: ViTEncoderConfig, patches: jax.Array,
+            grid_thw: tuple[int, int, int]):
+    """One image/video: pre-patchified ``patches`` [T, patch_dim] in the
+    HF processor's merge-grouped order with grid (t, h, w) ->
+    (embeds [T/m^2, out_hidden], deepstack list of the same shape)."""
+    t, gh, gw = grid_thw
+    x = nn.linear(params["patch_embed"], patches)
+    x = x + _interp_pos_embed(params, cfg, t, gh, gw).astype(x.dtype)
+    cos, sin = rope_tables(cfg, t, gh, gw)
+    cos = jnp.asarray(cos)[None, :, None, :]
+    sin = jnp.asarray(sin)[None, :, None, :]
+    n = x.shape[0]
+    nh, hd = cfg.num_heads, cfg.head_dim
+    # frames attend only within themselves (cu_seqlens repeats the
+    # per-frame token count over t)
+    frame = np.arange(n) // (gh * gw)
+    bias = jnp.asarray(np.where(
+        frame[:, None] == frame[None, :], 0.0, -1e30
+    )[None, None].astype(np.float32))
+    deepstack = []
+    for i, blk in enumerate(params["blocks"]):
+        h = nn.layernorm(blk["norm1"], x, eps=1e-6)
+        qkv = nn.linear(blk["qkv"], h).reshape(n, 3, nh, hd)
+        q, k, v = (qkv[:, 0][None], qkv[:, 1][None], qkv[:, 2][None])
+        q = q * cos.astype(q.dtype) + _rotate_half(q) * sin.astype(q.dtype)
+        k = k * cos.astype(k.dtype) + _rotate_half(k) * sin.astype(k.dtype)
+        o = nn.bias_attention(q, k, v, bias)
+        x = x + nn.linear(blk["proj"], o.reshape(n, -1))
+        h = nn.layernorm(blk["norm2"], x, eps=1e-6)
+        x = x + nn.linear(blk["fc2"], _gelu_tanh(
+            nn.linear(blk["fc1"], h)))
+        if i in cfg.deepstack_visual_indexes:
+            di = cfg.deepstack_visual_indexes.index(i)
+            deepstack.append(_merger(params["deepstack_mergers"][di], x,
+                                     cfg, postshuffle=True))
+    return _merger(params["merger"], x, cfg, postshuffle=False), deepstack
+
+
+def patchify(frames: np.ndarray, cfg: ViTEncoderConfig
+             ) -> tuple[np.ndarray, tuple[int, int, int]]:
+    """[T, H, W, 3] float frames -> (patches [N, patch_dim], grid_thw)
+    in the HF processor's order (images with T=1 tile the frame over
+    the temporal patch)."""
+    tp, p, m = cfg.temporal_patch_size, cfg.patch_size, \
+        cfg.spatial_merge_size
+    t, height, width, ch = frames.shape
+    if t % tp:
+        frames = np.concatenate(
+            [frames, np.repeat(frames[-1:], tp - t % tp, axis=0)])
+        t = frames.shape[0]
+    gh, gw = height // p, width // p
+    x = frames.reshape(t // tp, tp, gh // m, m, p, gw // m, m, p, ch)
+    # -> [gt, h/m, w/m, m, m, ch, tp, p, p]
+    x = x.transpose(0, 2, 5, 3, 6, 8, 1, 4, 7)
+    return (x.reshape(t // tp * gh * gw, cfg.patch_dim),
+            (t // tp, gh, gw))
+
+
+# ------------------------------------------------------------------ loader
+
+_BLOCK_MAP = {
+    "norm1": "norm1",
+    "norm2": "norm2",
+    "attn.qkv": "qkv",
+    "attn.proj": "proj",
+    "mlp.linear_fc1": "fc1",
+    "mlp.linear_fc2": "fc2",
+}
+
+
+def load_vit_encoder(model_dir: str, cfg: ViTEncoderConfig | None = None,
+                     prefix: str = "thinker.visual.",
+                     dtype=jnp.float32):
+    """Fill the param tree from safetensors under ``prefix``.  The
+    Conv3d patch embed [out, in, tp, p, p] flattens to the patch-matmul
+    layout [in*tp*p*p, out] matching the processor's (ch, tp, p, p)
+    element order.  Returns (params, cfg)."""
+    import json
+    import os
+    import re
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        iter_safetensors,
+    )
+
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            hf = json.load(f)
+        for part in ("thinker_config", "vision_config"):
+            if part in hf:
+                hf = hf[part]
+        cfg = ViTEncoderConfig.from_hf(hf)
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, dtype))
+    params = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), shapes)
+    block_re = re.compile(r"^blocks\.(\d+)\.(.+?)\.(weight|bias)$")
+    merger_re = re.compile(
+        r"^merger(?:_list\.(\d+))?\.(ln_q|mlp\.0|mlp\.2)\.(weight|bias)$")
+    loaded, unmapped = 0, []
+    for name, arr in iter_safetensors(model_dir):
+        if not name.startswith(prefix):
+            continue
+        sub = name[len(prefix):]
+        m = block_re.match(sub)
+        if m:
+            li, inner, kind = int(m.group(1)), m.group(2), m.group(3)
+            key = _BLOCK_MAP.get(inner)
+            if key is None or li >= cfg.depth:
+                unmapped.append(name)
+                continue
+            leaf = params["blocks"][li][key]
+            if kind == "bias":
+                leaf["b"][...] = arr
+            elif key in ("norm1", "norm2"):
+                leaf["w"][...] = arr
+            else:
+                leaf["w"][...] = arr.T
+            loaded += 1
+            continue
+        m = merger_re.match(sub)
+        if m:
+            which, inner, kind = m.group(1), m.group(2), m.group(3)
+            tree = (params["merger"] if which is None
+                    else params["deepstack_mergers"][int(which)])
+            key = {"ln_q": "ln_q", "mlp.0": "fc1", "mlp.2": "fc2"}[inner]
+            leaf = tree[key]
+            if kind == "bias":
+                leaf["b"][...] = arr
+            elif key == "ln_q":
+                leaf["w"][...] = arr
+            else:
+                leaf["w"][...] = arr.T
+            loaded += 1
+            continue
+        if sub == "patch_embed.proj.weight":
+            # [out, in, tp, p, p] -> [in, tp, p, p, out] -> flat [pd, out]
+            params["patch_embed"]["w"][...] = np.transpose(
+                arr, (1, 2, 3, 4, 0)).reshape(cfg.patch_dim, -1)
+            loaded += 1
+        elif sub == "patch_embed.proj.bias":
+            params["patch_embed"]["b"][...] = arr
+            loaded += 1
+        elif sub == "pos_embed.weight":
+            params["pos_embed"]["w"][...] = arr
+            loaded += 1
+        else:
+            unmapped.append(name)
+    if loaded == 0:
+        raise ValueError(f"no tensors under prefix {prefix!r} in "
+                         f"{model_dir}")
+    if unmapped:
+        from vllm_omni_tpu.logger import init_logger
+
+        init_logger(__name__).warning(
+            "unmapped vision-tower tensors (%d): %s", len(unmapped),
+            unmapped[:6])
+    return jax.tree.map(jnp.asarray, params), cfg
