@@ -200,7 +200,9 @@ def test_image_audio_pipeline_e2e():
     assert len(by_type["text"].outputs[0].token_ids) == 6
     assert "hidden_states" in by_type["text"].multimodal_output
     wav_out = by_type["audio"].multimodal_output["audio"]
-    assert wav_out.shape == (8 * 4,)
+    from vllm_omni_tpu.models.qwen3_omni.code2wav import Code2WavConfig
+    c2w = Code2WavConfig.tiny()
+    assert wav_out.shape == (c2w.waveform_len(8 // c2w.num_quantizers),)
     assert np.all(np.isfinite(wav_out))
 
     # and the media actually influences generation: different image ->

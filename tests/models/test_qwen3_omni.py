@@ -64,13 +64,15 @@ def test_code2wav_shapes():
     cfg = code2wav.Code2WavConfig.tiny()
     params = code2wav.init_code2wav_params(jax.random.PRNGKey(0), cfg)
     model = code2wav.Code2WavModel(cfg)
-    ids = jnp.asarray(np.random.randint(0, cfg.codec_vocab, (2, 10)), jnp.int32)
+    ids = jnp.asarray(np.random.randint(0, cfg.codebook_size, (2, 10)),
+                      jnp.int32)
     out = model.forward(params, ids, jnp.asarray([10, 7]))
-    assert out["audio"].shape == (2, 10 * cfg.total_upsample)
+    # 10 ids / K=2 -> 5 frames; decoder trans-convs trim both sides
+    assert out["audio"].shape == (2, cfg.waveform_len(5))
     assert np.all(np.abs(np.asarray(out["audio"])) <= 1.0)
     sliced = model.slice_output(
         {k: np.asarray(v) for k, v in out.items()}, 1, 7)
-    assert sliced["audio"].shape == (7 * cfg.total_upsample,)
+    assert sliced["audio"].shape == (cfg.waveform_len(4),)
 
 
 def test_talker_embed_projection():
@@ -102,6 +104,7 @@ def test_qwen3_omni_tiny_pipeline_e2e():
     assert "hidden_states" in text_out.multimodal_output
     audio_out = by_type["audio"]
     wav = audio_out.multimodal_output["audio"]
-    # talker emits 8 codec tokens, tiny vocoder upsamples 4x
-    assert wav.shape == (8 * 4,)
+    # talker emits 8 codec tokens -> 4 packed RVQ frames (K=2)
+    c2w = code2wav.Code2WavConfig.tiny()
+    assert wav.shape == (c2w.waveform_len(8 // c2w.num_quantizers),)
     assert np.all(np.isfinite(wav))

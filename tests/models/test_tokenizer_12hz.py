@@ -94,11 +94,10 @@ def test_checkpoint_name_map_full_coverage(tmp_path, tiny):
         lambda: tk.init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
 
     def torch_shape(name, path, our_shape):
-        if len(our_shape) == 3:  # ours: WIO [k, in, out]
-            k, cin, cout = our_shape
-            if any(t in name for t in tk._TCONV_MARKERS):
-                return (cin, cout, k)  # ConvTranspose1d [in, out, k]
-            return (cout, cin, k)      # Conv1d [out, in, k]
+        if len(our_shape) == 3:
+            # Conv1d [out, in, k] vs ours [k, in, out]; ConvTranspose1d
+            # [in, out, k] vs ours [k, out, in] — both are the reverse
+            return tuple(reversed(our_shape))
         if len(our_shape) == 2:
             if "embedding_sum" in name:
                 return our_shape

@@ -1,0 +1,325 @@
+"""Shared neural-vocoder building blocks (functional JAX, NWC layout).
+
+The Qwen audio stacks ship the same component family in several
+checkpoints — causal 1-D convs, causal transposed convs, SnakeBeta
+activations, ConvNeXt blocks, a sliding-window rotary transformer with
+LayerScale residuals, and a progressive Snake/trans-conv decoder — with
+per-model wiring differences:
+
+- Qwen3-TTS 12.5 Hz codec decoder
+  (reference: vllm_omni/model_executor/models/qwen3_tts/tokenizer_12hz/
+  modeling_qwen3_tts_tokenizer_v2.py) — trans-convs trim the RIGHT
+  (kernel - stride) samples.
+- Qwen3-Omni code2wav
+  (reference: vllm_omni/model_executor/models/qwen3_omni/
+  qwen3_omni_code2wav.py + transformers Qwen3OmniMoeCode2Wav) —
+  trans-convs trim (kernel - stride) from BOTH sides.
+
+TPU-first: channel-last [B, T, C] tensors keep channels on the lane
+dim, causal convs are explicit left-pad + VALID `lax` convs (static
+shapes, MXU-friendly), and the sliding window is a static additive mask
+XLA folds into the softmax — the whole decode stays one jitted graph.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import rms_norm
+
+
+# torch parity needs full-precision convs (the XLA default may lower
+# fp32 convs to a faster, lower-precision path); vocoder convs are a
+# negligible share of pipeline FLOPs, so always ask for exact fp32.
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+# ----------------------------------------------------------------- convs
+def cconv_init(key, cin, cout, k, dtype, groups: int = 1):
+    return {"w": nn.conv1d_init(key, cin // groups, cout, k,
+                                dtype=dtype)["w"],
+            "b": jnp.zeros((cout,), dtype)}
+
+
+def cconv(p, x, k: int, dilation: int = 1, stride: int = 1,
+          groups: int = 1):
+    """Causal 1-D conv, NWC: left-pad (k-1)*dilation - (stride-1), plus
+    right pad up to a full output frame (reference CausalConvNet
+    padding)."""
+    eff_k = (k - 1) * dilation + 1
+    pad = eff_k - stride
+    length = x.shape[1]
+    n_frames = (length - eff_k + pad) / stride + 1
+    ideal = (math.ceil(n_frames) - 1) * stride + (eff_k - pad)
+    extra = max(0, ideal - length)
+    y = jax.lax.conv_general_dilated(
+        jnp.pad(x, ((0, 0), (pad, extra), (0, 0))),
+        p["w"].astype(x.dtype),
+        window_strides=(stride,),
+        padding="VALID",
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        feature_group_count=groups,
+        precision=_PRECISION,
+    )
+    return y + p["b"].astype(x.dtype)
+
+
+def tconv_init(key, cin, cout, k, dtype):
+    # stored in forward-conv layout [k, cout, cin] for
+    # ``transpose_kernel=True`` (torch ConvTranspose1d semantics)
+    return {"w": nn.conv1d_init(key, cout, cin, k, dtype=dtype)["w"],
+            "b": jnp.zeros((cout,), dtype)}
+
+
+def tconv(p, x, k: int, stride: int, trim_left: bool = False):
+    """Causal transposed conv: full transpose then trim (k - stride)
+    samples.  ``trim_left=False`` trims the right only (12.5 Hz codec
+    CausalTransConvNet); ``trim_left=True`` trims both sides
+    (Qwen3OmniMoeCausalTransConvNet)."""
+    y = jax.lax.conv_transpose(
+        x, p["w"].astype(x.dtype), strides=(stride,), padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"), transpose_kernel=True,
+        precision=_PRECISION,
+    )
+    trim = k - stride
+    if trim > 0:
+        left = trim if trim_left else 0
+        y = y[:, left: y.shape[1] - trim]
+    return y + p["b"].astype(x.dtype)
+
+
+def snake_init(ch, dtype):
+    return {"alpha": jnp.zeros((ch,), dtype), "beta": jnp.zeros((ch,), dtype)}
+
+
+def snake(p, x):
+    """SnakeBeta: x + 1/exp(beta) * sin^2(x * exp(alpha))."""
+    a = jnp.exp(p["alpha"].astype(jnp.float32))
+    b = jnp.exp(p["beta"].astype(jnp.float32))
+    xf = x.astype(jnp.float32)
+    y = xf + (1.0 / (b + 1e-9)) * jnp.square(jnp.sin(xf * a))
+    return y.astype(x.dtype)
+
+
+def convnext_init(key, dim, dtype):
+    k = jax.random.split(key, 3)
+    return {
+        "dw": cconv_init(k[0], dim, dim, 7, dtype, groups=dim),
+        "norm": nn.layernorm_init(dim, dtype=dtype),
+        "pw1": nn.linear_init(k[1], dim, 4 * dim, dtype=dtype),
+        "pw2": nn.linear_init(k[2], 4 * dim, dim, dtype=dtype),
+        "gamma": jnp.full((dim,), 1e-6, dtype),
+    }
+
+
+def convnext(p, x):
+    h = cconv(p["dw"], x, 7, groups=x.shape[-1])
+    h = nn.layernorm(p["norm"], h)
+    h = nn.linear(p["pw2"], jax.nn.gelu(nn.linear(p["pw1"], h),
+                                        approximate=False))
+    return x + p["gamma"].astype(x.dtype) * h
+
+
+# ------------------------------------------------------------ transformer
+@dataclass(frozen=True)
+class TransformerSpec:
+    """Geometry of the sliding-window rotary pre-transformer."""
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    sliding_window: int
+    layer_scale: float = 0.01
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+
+
+def transformer_layer_init(key, spec: TransformerSpec, dtype):
+    k = jax.random.split(key, 6)
+    h, d = spec.hidden_size, spec.head_dim
+    return {
+        "input_norm": nn.rmsnorm_init(h, dtype),
+        "q_proj": nn.linear_init(k[0], h, spec.num_heads * d, bias=False,
+                                 dtype=dtype),
+        "k_proj": nn.linear_init(k[1], h, spec.num_kv_heads * d,
+                                 bias=False, dtype=dtype),
+        "v_proj": nn.linear_init(k[2], h, spec.num_kv_heads * d,
+                                 bias=False, dtype=dtype),
+        "o_proj": nn.linear_init(k[3], spec.num_heads * d, h, bias=False,
+                                 dtype=dtype),
+        "attn_scale": jnp.full((h,), spec.layer_scale, dtype),
+        "post_norm": nn.rmsnorm_init(h, dtype),
+        # gate/up kept as separate leaves so the HF checkpoint's
+        # gate_proj/up_proj map 1:1 (no fused-weight surgery)
+        "gate": nn.linear_init(k[4], h, spec.intermediate_size,
+                               bias=False, dtype=dtype),
+        "up": nn.linear_init(jax.random.fold_in(k[4], 1), h,
+                             spec.intermediate_size, bias=False,
+                             dtype=dtype),
+        "down": nn.linear_init(k[5], spec.intermediate_size, h,
+                               bias=False, dtype=dtype),
+        "mlp_scale": jnp.full((h,), spec.layer_scale, dtype),
+    }
+
+
+def transformer_init(key, spec: TransformerSpec, dtype):
+    ks = jax.random.split(key, spec.num_layers)
+    return {
+        "layers": [transformer_layer_init(ks[i], spec, dtype)
+                   for i in range(spec.num_layers)],
+        "final_norm": nn.rmsnorm_init(spec.hidden_size, dtype),
+    }
+
+
+def sliding_transformer(params, spec: TransformerSpec, x):
+    """Causal sliding-window rotary transformer with LayerScale
+    residuals (GQA-aware; kv heads repeat when fewer than q heads)."""
+    from vllm_omni_tpu.ops import apply_rope, compute_rope_freqs
+
+    b, t, _ = x.shape
+    pos = jnp.arange(t)
+    cos, sin = compute_rope_freqs(pos, spec.head_dim, spec.rope_theta)
+    # causal + sliding window: key j visible to query i iff
+    # i - window < j <= i
+    dist = pos[:, None] - pos[None, :]
+    mask = (dist >= 0) & (dist < spec.sliding_window)
+    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    rep = spec.num_heads // spec.num_kv_heads
+
+    for lp in params["layers"]:
+        h = rms_norm(x, lp["input_norm"]["w"], spec.rms_eps)
+        flat = h.reshape(b * t, -1)
+        q = nn.linear(lp["q_proj"], flat).reshape(b * t, -1, spec.head_dim)
+        kk = nn.linear(lp["k_proj"], flat).reshape(b * t, -1, spec.head_dim)
+        v = nn.linear(lp["v_proj"], flat).reshape(b * t, -1, spec.head_dim)
+        q = apply_rope(q, cos if b == 1 else jnp.tile(cos, (b, 1)),
+                       sin if b == 1 else jnp.tile(sin, (b, 1)))
+        kk = apply_rope(kk, cos if b == 1 else jnp.tile(cos, (b, 1)),
+                        sin if b == 1 else jnp.tile(sin, (b, 1)))
+        q = q.reshape(b, t, -1, spec.head_dim)
+        kk = kk.reshape(b, t, -1, spec.head_dim)
+        v = v.reshape(b, t, -1, spec.head_dim)
+        if rep > 1:
+            kk = jnp.repeat(kk, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        # dense attention with the window bias: the window is a static
+        # mask, XLA folds it into the softmax
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) / math.sqrt(spec.head_dim)
+        a = jax.nn.softmax(s + bias[None, None], axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(b, t, -1)
+        o = nn.linear(lp["o_proj"], o)
+        x = x + lp["attn_scale"].astype(x.dtype) * o
+        h = rms_norm(x, lp["post_norm"]["w"], spec.rms_eps)
+        y = nn.linear(lp["down"],
+                      jax.nn.silu(nn.linear(lp["gate"], h))
+                      * nn.linear(lp["up"], h))
+        x = x + lp["mlp_scale"].astype(x.dtype) * y
+    return rms_norm(x, params["final_norm"]["w"], spec.rms_eps)
+
+
+def transformer_flat_map(m: dict, prefix: str, path: tuple,
+                         num_layers: int) -> None:
+    """HF layer names (``{prefix}.layers.N...``) -> param-tree paths
+    rooted at ``path`` for the sliding transformer."""
+    for i in range(num_layers):
+        lp = f"{prefix}.layers.{i}"
+        tgt = path + ("layers", i)
+        m[f"{lp}.input_layernorm.weight"] = tgt + ("input_norm", "w")
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            m[f"{lp}.self_attn.{proj}.weight"] = tgt + (proj, "w")
+        m[f"{lp}.self_attn_layer_scale.scale"] = tgt + ("attn_scale",)
+        m[f"{lp}.post_attention_layernorm.weight"] = tgt + ("post_norm",
+                                                            "w")
+        m[f"{lp}.mlp.gate_proj.weight"] = tgt + ("gate", "w")
+        m[f"{lp}.mlp.up_proj.weight"] = tgt + ("up", "w")
+        m[f"{lp}.mlp.down_proj.weight"] = tgt + ("down", "w")
+        m[f"{lp}.mlp_layer_scale.scale"] = tgt + ("mlp_scale",)
+    m[f"{prefix}.norm.weight"] = path + ("final_norm", "w")
+
+
+# ----------------------------------------------------- decoder waveform
+def decoder_stack_init(key, in_dim: int, decoder_dim: int,
+                       upsample_rates, dtype):
+    """Snake/trans-conv progressive decoder: conv(in->decoder_dim, 7),
+    per-rate [Snake, TransConv(2r, r), 3x residual units (dilations
+    1/3/9)], final Snake + conv(->1, 7)."""
+    ks = jax.random.split(key, 2 + 8 * len(upsample_rates))
+    ki = iter(ks)
+    p = {"dec_in": cconv_init(next(ki), in_dim, decoder_dim, 7, dtype),
+         "dec_blocks": []}
+    for i, r in enumerate(upsample_rates):
+        cin = decoder_dim // (2 ** i)
+        cout = decoder_dim // (2 ** (i + 1))
+        blk = {
+            "snake": snake_init(cin, dtype),
+            "tconv": tconv_init(next(ki), cin, cout, 2 * r, dtype),
+            "units": [],
+        }
+        for _ in (1, 3, 9):  # dilations are static (decoder_stack_apply)
+            blk["units"].append({
+                "snake1": snake_init(cout, dtype),
+                "conv1": cconv_init(next(ki), cout, cout, 7, dtype),
+                "snake2": snake_init(cout, dtype),
+                "conv2": cconv_init(next(ki), cout, cout, 1, dtype),
+            })
+        p["dec_blocks"].append(blk)
+    out_dim = decoder_dim // (2 ** len(upsample_rates))
+    p["out_snake"] = snake_init(out_dim, dtype)
+    p["out_conv"] = cconv_init(next(ki), out_dim, 1, 7, dtype)
+    return p
+
+
+def decoder_stack_apply(params, x, upsample_rates,
+                        trim_left: bool = False):
+    """[B, T, in_dim] -> waveform [B, ~T*prod(rates)] in [-1, 1]."""
+    w = cconv(params["dec_in"], x, 7)
+    for blk, r in zip(params["dec_blocks"], upsample_rates):
+        w = snake(blk["snake"], w)
+        w = tconv(blk["tconv"], w, 2 * r, r, trim_left=trim_left)
+        for u, dil in zip(blk["units"], (1, 3, 9)):
+            res = w
+            w = cconv(u["conv1"], snake(u["snake1"], w), 7, dilation=dil)
+            w = cconv(u["conv2"], snake(u["snake2"], w), 1)
+            w = w + res
+    w = cconv(params["out_conv"], snake(params["out_snake"], w), 7)
+    return jnp.clip(w[..., 0], -1.0, 1.0)
+
+
+def decoder_stack_flat_map(m: dict, prefix: str, path: tuple,
+                           n_rates: int) -> None:
+    """HF ModuleList names (``{prefix}.N...``) -> paths rooted at
+    ``path`` for the decoder stack."""
+    m[f"{prefix}.0.conv.weight"] = path + ("dec_in", "w")
+    m[f"{prefix}.0.conv.bias"] = path + ("dec_in", "b")
+    for i in range(n_rates):
+        d = f"{prefix}.{1 + i}.block"
+        tgt = path + ("dec_blocks", i)
+        m[f"{d}.0.alpha"] = tgt + ("snake", "alpha")
+        m[f"{d}.0.beta"] = tgt + ("snake", "beta")
+        m[f"{d}.1.conv.weight"] = tgt + ("tconv", "w")
+        m[f"{d}.1.conv.bias"] = tgt + ("tconv", "b")
+        for j in range(3):
+            u = f"{d}.{2 + j}"
+            ut = tgt + ("units", j)
+            m[f"{u}.act1.alpha"] = ut + ("snake1", "alpha")
+            m[f"{u}.act1.beta"] = ut + ("snake1", "beta")
+            m[f"{u}.conv1.conv.weight"] = ut + ("conv1", "w")
+            m[f"{u}.conv1.conv.bias"] = ut + ("conv1", "b")
+            m[f"{u}.act2.alpha"] = ut + ("snake2", "alpha")
+            m[f"{u}.act2.beta"] = ut + ("snake2", "beta")
+            m[f"{u}.conv2.conv.weight"] = ut + ("conv2", "w")
+            m[f"{u}.conv2.conv.bias"] = ut + ("conv2", "b")
+    last = 1 + n_rates
+    m[f"{prefix}.{last}.alpha"] = path + ("out_snake", "alpha")
+    m[f"{prefix}.{last}.beta"] = path + ("out_snake", "beta")
+    m[f"{prefix}.{last + 1}.conv.weight"] = path + ("out_conv", "w")
+    m[f"{prefix}.{last + 1}.conv.bias"] = path + ("out_conv", "b")

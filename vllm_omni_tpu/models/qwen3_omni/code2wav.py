@@ -1,13 +1,28 @@
-"""Qwen3-Omni code2wav: ConvNet vocoder, codec tokens → waveform (stage 2).
+"""Qwen3-Omni code2wav: RVQ codec codes -> waveform (stage 2).
 
-Reference: vllm_omni/model_executor/models/qwen3_omni/qwen3_omni_code2wav.py
-— a one-shot ConvNet generator run under the generation scheduler fast path
-(core/sched/omni_generation_scheduler.py:33-261): the whole codec sequence
-arrives as the "prompt", one forward emits the waveform, request finishes.
+Checkpoint-schema implementation of the transformers
+``Qwen3OmniMoeCode2Wav`` vocoder the reference serves one-shot under its
+generation scheduler (reference:
+vllm_omni/model_executor/models/qwen3_omni/qwen3_omni_code2wav.py:36-258,
+core/sched/omni_generation_scheduler.py:33-261):
 
-TPU-first layout: NWC 1-D convs (lane dim = channels), transposed-conv
-upsampling stack, snake-ish (silu) activations.  Implements the generation
-runner model protocol (worker/generation_runner.py).
+1. code embedding — one table over ``codebook_size * num_quantizers``
+   ids; each RVQ layer k is offset by ``k * codebook_size`` and the K
+   embeddings per frame are averaged,
+2. pre-transformer — sliding-window rotary transformer with LayerScale
+   residuals (temporal context),
+3. upsampling — trans-conv(f, f) + ConvNeXt per ratio,
+4. decoder — progressive Snake/trans-conv stack to 24 kHz samples,
+   trans-convs trimming (kernel - stride) on BOTH sides
+   (Qwen3OmniMoeCausalTransConvNet semantics).
+
+TPU-first: NWC layout throughout, the full decode is ONE jitted graph
+(the reference chunks in Python for GPU memory; ``chunked_decode`` here
+mirrors its bounded-memory streaming loop).  NOTE: unlike the 12.5 Hz
+TTS codec, the two-sided trans-conv trim gives each decoder stage one
+frame of lookahead, so chunked and full decode intentionally drift near
+chunk boundaries — exactly as the reference's own chunked_decode does
+(pinned in tests/model_loader/test_code2wav_parity.py).
 """
 
 from __future__ import annotations
@@ -19,84 +34,160 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.models.common import vocoder as vk
+
+logger = init_logger(__name__)
 
 
 @dataclass(frozen=True)
 class Code2WavConfig:
-    codec_vocab: int = 4099
-    channels: int = 512
-    upsample_factors: tuple = (8, 5, 4, 2)  # total 320x = 16kHz @ 50Hz codes
-    kernel: int = 7
-    num_res_layers: int = 2
+    """Mirrors transformers ``Qwen3OmniMoeCode2WavConfig``."""
+    codebook_size: int = 2048
+    num_quantizers: int = 16
+    hidden_size: int = 1024
+    decoder_dim: int = 1536
+    upsample_rates: tuple = (8, 5, 4, 3)
+    upsampling_ratios: tuple = (2, 2)
+    num_layers: int = 8
+    num_heads: int = 16
+    num_kv_heads: int = 16
+    intermediate_size: int = 3072
+    sliding_window: int = 72
+    layer_scale: float = 0.01
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    output_sample_rate: int = 24000
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def codec_vocab(self) -> int:
+        """Flat id space across the K offset codebooks."""
+        return self.codebook_size * self.num_quantizers
+
+    @property
+    def total_upsample(self) -> int:
+        return int(math.prod(self.upsample_rates)
+                   * math.prod(self.upsampling_ratios))
+
+    def waveform_len(self, frames: int) -> int:
+        """Exact output samples for ``frames`` codec frames (decoder
+        trans-convs lose one input frame per stage to two-sided trim)."""
+        t = frames * int(math.prod(self.upsampling_ratios))
+        for r in self.upsample_rates:
+            t = (t - 1) * r
+        return max(t, 0)
+
+    def transformer_spec(self) -> vk.TransformerSpec:
+        return vk.TransformerSpec(
+            hidden_size=self.hidden_size, num_layers=self.num_layers,
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            intermediate_size=self.intermediate_size,
+            sliding_window=self.sliding_window,
+            layer_scale=self.layer_scale, rope_theta=self.rope_theta,
+            rms_eps=self.rms_eps,
+        )
 
     @staticmethod
     def tiny() -> "Code2WavConfig":
         return Code2WavConfig(
-            codec_vocab=64, channels=16, upsample_factors=(2, 2), kernel=3,
-            num_res_layers=1,
+            codebook_size=32, num_quantizers=2, hidden_size=16,
+            decoder_dim=24, upsample_rates=(2,), upsampling_ratios=(2,),
+            num_layers=1, num_heads=2, num_kv_heads=1,
+            intermediate_size=32, sliding_window=4,
         )
-
-    @property
-    def total_upsample(self) -> int:
-        return math.prod(self.upsample_factors)
 
 
 def init_code2wav_params(key, cfg: Code2WavConfig, dtype=jnp.float32):
-    keys = jax.random.split(key, 3 + 2 * len(cfg.upsample_factors)
-                            * (1 + cfg.num_res_layers))
+    keys = jax.random.split(key, 4 + 2 * len(cfg.upsampling_ratios))
     ki = iter(keys)
-    params = {
-        "embed": nn.embedding_init(next(ki), cfg.codec_vocab, cfg.channels, dtype),
-        "pre": nn.conv1d_init(next(ki), cfg.channels, cfg.channels,
-                              cfg.kernel, dtype=dtype),
-        "ups": [],
-        "post": nn.conv1d_init(next(ki), cfg.channels
-                               // (2 ** len(cfg.upsample_factors)), 1,
-                               cfg.kernel, dtype=dtype),
+    return {
+        "embed": nn.embedding_init(next(ki), cfg.codec_vocab,
+                                   cfg.hidden_size, dtype),
+        "transformer": vk.transformer_init(next(ki),
+                                           cfg.transformer_spec(), dtype),
+        "upsample": [
+            {"tconv": vk.tconv_init(next(ki), cfg.hidden_size,
+                                    cfg.hidden_size, f, dtype),
+             "convnext": vk.convnext_init(next(ki), cfg.hidden_size,
+                                          dtype)}
+            for f in cfg.upsampling_ratios
+        ],
+        "decoder": vk.decoder_stack_init(next(ki), cfg.hidden_size,
+                                         cfg.decoder_dim,
+                                         cfg.upsample_rates, dtype),
     }
-    ch = cfg.channels
-    for f in cfg.upsample_factors:
-        out_ch = ch // 2
-        block = {
-            "up": nn.conv1d_init(next(ki), ch, out_ch, 2 * f, dtype=dtype),
-            "res": [
-                nn.conv1d_init(next(ki), out_ch, out_ch, cfg.kernel, dtype=dtype)
-                for _ in range(cfg.num_res_layers)
-            ],
-        }
-        params["ups"].append(block)
-        ch = out_ch
-    return params
+
+
+def decode_codes(params, cfg: Code2WavConfig, codes: jax.Array) -> jax.Array:
+    """codes [B, K, T] -> waveform [B, waveform_len(T)] in [-1, 1]."""
+    offsets = (jnp.arange(cfg.num_quantizers)
+               * cfg.codebook_size)[None, :, None]
+    h = nn.embedding(params["embed"], codes + offsets)  # [B, K, T, H]
+    h = jnp.mean(h, axis=1)                             # [B, T, H]
+    h = vk.sliding_transformer(params["transformer"],
+                               cfg.transformer_spec(), h)
+    for up, f in zip(params["upsample"], cfg.upsampling_ratios):
+        h = vk.tconv(up["tconv"], h, f, f)
+        h = vk.convnext(up["convnext"], h)
+    return vk.decoder_stack_apply(params["decoder"], h,
+                                  cfg.upsample_rates, trim_left=True)
+
+
+def chunked_decode(params, cfg: Code2WavConfig, codes,
+                   chunk_size: int = 300, left_context: int = 25):
+    """Frame-chunked decode with left context (reference chunked_decode,
+    qwen3_omni_code2wav.py:160-199) — bounded-memory streaming; causality
+    keeps chunk outputs close to the full decode."""
+    t = codes.shape[-1]
+    wavs = []
+    start = 0
+    while start < t:
+        end = min(start + chunk_size, t)
+        ctx = left_context if start >= left_context else start
+        wav = decode_codes(params, cfg, codes[..., start - ctx: end])
+        wavs.append(np.asarray(wav[..., ctx * cfg.total_upsample:]))
+        start = end
+    return np.concatenate(wavs, axis=-1)
 
 
 class Code2WavModel:
-    """Generation-runner model protocol implementation."""
+    """Generation-runner model protocol: the talker's MTP head emits
+    ``num_quantizers`` interleaved code streams; the runner hands them
+    over as [B, S] rows of packed frames."""
 
     def __init__(self, cfg: Code2WavConfig):
         self.cfg = cfg
 
     def forward(self, params, token_ids: jax.Array, lengths: jax.Array):
-        """token_ids [B, S] codec ids, lengths [B] -> {"audio": [B, S*up]}.
-
-        Padding tokens produce garbage samples past lengths*up; the runner
-        slices them off per request (slice_output).
-        """
         cfg = self.cfg
-        x = nn.embedding(params["embed"], token_ids)  # [B, S, C]
-        x = nn.conv1d(params["pre"], x)
-        for block, f in zip(params["ups"], cfg.upsample_factors):
-            x = jax.nn.silu(x)
-            x = nn.conv1d_transpose(block["up"], x, stride=f)
-            for res in block["res"]:
-                x = x + nn.conv1d(res, jax.nn.silu(x))
-        x = jax.nn.silu(x)
-        wav = jnp.tanh(nn.conv1d(params["post"], x))  # [B, S*up, 1]
-        return {"audio": wav[..., 0]}
+        del lengths
+        b, s = token_ids.shape
+        k = cfg.num_quantizers
+        # partial trailing frames pad with code 0 (never drop to zero
+        # frames — degenerate LM samples still produce audio)
+        frames = max(1, -(-s // k))
+        ids = jnp.clip(token_ids, 0, cfg.codebook_size - 1)
+        ids = jnp.pad(ids, ((0, 0), (0, frames * k - s)))
+        codes = ids.reshape(b, frames, k).transpose(0, 2, 1)
+        wav = decode_codes(params, cfg, codes)
+        return {"audio": wav}
 
     def slice_output(self, outputs: dict, row: int, in_len: int):
-        up = self.cfg.total_upsample
-        return {"audio": np.asarray(outputs["audio"][row, : in_len * up])}
+        # The decoder's per-stage one-frame lookahead means the last few
+        # samples of the slice see the code-0 bucket padding beyond this
+        # request's frames — the same batch semantics as the reference,
+        # whose runner also decodes padded [B, K, T] and prefix-slices
+        # (qwen3_omni_code2wav.py:199-213).  Deterministic, and bounded
+        # by one receptive field.
+        frames = max(1, -(-in_len // self.cfg.num_quantizers))
+        n = self.cfg.waveform_len(frames)
+        return {"audio": np.asarray(outputs["audio"][row, :n])}
 
 
 def tiny_factory():
@@ -104,3 +195,112 @@ def tiny_factory():
     cfg = Code2WavConfig.tiny()
     params = init_code2wav_params(jax.random.PRNGKey(2), cfg)
     return params, Code2WavModel(cfg), None
+
+
+# ------------------------------------------------------- checkpoint load
+def hf_flat_map(cfg: Code2WavConfig, prefix: str = "code2wav.") -> dict:
+    """HF tensor name -> param-tree path for ``Qwen3OmniMoeCode2Wav``
+    (composite Qwen3-Omni checkpoints store it under ``code2wav.``)."""
+    m: dict[str, tuple] = {}
+    m[f"{prefix}code_embedding.weight"] = ("embed", "w")
+    vk.transformer_flat_map(m, f"{prefix}pre_transformer",
+                            ("transformer",), cfg.num_layers)
+    for i in range(len(cfg.upsampling_ratios)):
+        up = f"{prefix}upsample.{i}"
+        m[f"{up}.0.conv.weight"] = ("upsample", i, "tconv", "w")
+        m[f"{up}.0.conv.bias"] = ("upsample", i, "tconv", "b")
+        cn = f"{up}.1"
+        m[f"{cn}.dwconv.conv.weight"] = ("upsample", i, "convnext", "dw",
+                                         "w")
+        m[f"{cn}.dwconv.conv.bias"] = ("upsample", i, "convnext", "dw",
+                                       "b")
+        m[f"{cn}.norm.weight"] = ("upsample", i, "convnext", "norm", "w")
+        m[f"{cn}.norm.bias"] = ("upsample", i, "convnext", "norm", "b")
+        m[f"{cn}.pwconv1.weight"] = ("upsample", i, "convnext", "pw1", "w")
+        m[f"{cn}.pwconv1.bias"] = ("upsample", i, "convnext", "pw1", "b")
+        m[f"{cn}.pwconv2.weight"] = ("upsample", i, "convnext", "pw2", "w")
+        m[f"{cn}.pwconv2.bias"] = ("upsample", i, "convnext", "pw2", "b")
+        m[f"{cn}.gamma"] = ("upsample", i, "convnext", "gamma")
+    vk.decoder_stack_flat_map(m, f"{prefix}decoder", ("decoder",),
+                              len(cfg.upsample_rates))
+    return m
+
+
+def hf_transform(name: str, arr):
+    """torch layouts -> ours: Conv1d [out, in, k] -> WIO [k, in, out]
+    and ConvTranspose1d [in, out, k] -> [k, out, in] (the
+    ``transpose_kernel=True`` forward layout) — both are
+    transpose(2, 1, 0); linears [out, in] -> [in, out]; embeddings stay
+    [vocab, dim]."""
+    if arr.ndim == 3:
+        return arr.transpose(2, 1, 0)
+    if arr.ndim == 2 and name.endswith("weight") \
+            and "code_embedding" not in name:
+        return arr.T
+    return arr
+
+
+def config_from_hf(d: dict) -> Code2WavConfig:
+    """Build from a ``code2wav_config`` dict (HF composite config)."""
+    hidden = d.get("hidden_size", 1024)
+    heads = d.get("num_attention_heads", 16)
+    return Code2WavConfig(
+        codebook_size=d.get("codebook_size", 2048),
+        num_quantizers=d.get("num_quantizers", 16),
+        hidden_size=hidden,
+        decoder_dim=d.get("decoder_dim", 1536),
+        upsample_rates=tuple(d.get("upsample_rates", (8, 5, 4, 3))),
+        upsampling_ratios=tuple(d.get("upsampling_ratios", (2, 2))),
+        num_layers=d.get("num_hidden_layers", 8),
+        num_heads=heads,
+        num_kv_heads=d.get("num_key_value_heads", heads),
+        intermediate_size=d.get("intermediate_size", 3072),
+        sliding_window=d.get("sliding_window", 72),
+        layer_scale=d.get("layer_scale_initial_scale", 0.01),
+        rope_theta=d.get("rope_theta", 10000.0),
+        rms_eps=d.get("rms_norm_eps", 1e-5),
+    )
+
+
+def load_code2wav(model_dir: str, cfg: Code2WavConfig = None,
+                  dtype=jnp.float32):
+    """Stream the ``code2wav.*`` weights of a Qwen3-Omni checkpoint into
+    our param tree; every leaf must be covered."""
+    import json
+    import os
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+
+    if cfg is None:
+        cfg_path = os.path.join(model_dir, "config.json")
+        d = {}
+        if os.path.isfile(cfg_path):
+            with open(cfg_path) as f:
+                d = json.load(f).get("code2wav_config", {})
+        cfg = config_from_hf(d)
+    shapes = jax.eval_shape(
+        lambda: init_code2wav_params(jax.random.PRNGKey(0), cfg,
+                                     jnp.float32))
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    flat = hf_flat_map(cfg)
+    n, unmapped = load_checkpoint_tree(
+        model_dir, flat.get, tree, dtype=np.float32,
+        transform=hf_transform,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if n != n_leaves:
+        raise ValueError(
+            f"{model_dir} covered {n}/{n_leaves} code2wav weights")
+    extra = [u for u in unmapped if u.startswith("code2wav.")]
+    if extra:
+        logger.warning("code2wav loader: %d unmapped code2wav tensors "
+                       "(e.g. %s)", len(extra), extra[:3])
+    return tree, cfg
+
+
+def load_factory(model_dir: str, dtype="float32"):
+    """model_factory for real-weight code2wav stages."""
+    tree, cfg = load_code2wav(model_dir, dtype=jnp.dtype(dtype))
+    return tree, Code2WavModel(cfg), None

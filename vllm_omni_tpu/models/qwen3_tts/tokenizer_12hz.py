@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from vllm_omni_tpu.models.common import nn
-from vllm_omni_tpu.ops import rms_norm
+from vllm_omni_tpu.models.common import vocoder as vk
 
 from vllm_omni_tpu.logger import init_logger
 
@@ -86,157 +86,35 @@ class Tokenizer12HzConfig:
         )
 
 
-# ----------------------------------------------------------------- convs
-def _cconv_init(key, cin, cout, k, dtype, groups: int = 1):
-    return {"w": nn.conv1d_init(key, cin // groups, cout, k,
-                                dtype=dtype)["w"],
-            "b": jnp.zeros((cout,), dtype)}
+# -------- shared vocoder primitives (models/common/vocoder.py) --------
+_cconv_init = vk.cconv_init
+_cconv = vk.cconv
+_tconv_init = vk.tconv_init
+_tconv = vk.tconv  # default trim: RIGHT only (V2 CausalTransConvNet)
+_snake_init = vk.snake_init
+_snake = vk.snake
+_convnext_init = vk.convnext_init
+_convnext = vk.convnext
 
 
-def _cconv(p, x, k: int, dilation: int = 1, stride: int = 1,
-           groups: int = 1):
-    """Causal 1-D conv, NWC: left-pad (k-1)*dilation - (stride-1), plus
-    right pad up to a full output frame (reference CausalConvNet
-    padding)."""
-    eff_k = (k - 1) * dilation + 1
-    pad = eff_k - stride
-    length = x.shape[1]
-    n_frames = (length - eff_k + pad) / stride + 1
-    ideal = (math.ceil(n_frames) - 1) * stride + (eff_k - pad)
-    extra = max(0, ideal - length)
-    y = jax.lax.conv_general_dilated(
-        jnp.pad(x, ((0, 0), (pad, extra), (0, 0))),
-        p["w"].astype(x.dtype),
-        window_strides=(stride,),
-        padding="VALID",
-        rhs_dilation=(dilation,),
-        dimension_numbers=("NWC", "WIO", "NWC"),
-        feature_group_count=groups,
+def _spec(cfg: Tokenizer12HzConfig) -> vk.TransformerSpec:
+    return vk.TransformerSpec(
+        hidden_size=cfg.hidden_size, num_layers=cfg.num_layers,
+        num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim, intermediate_size=cfg.intermediate_size,
+        sliding_window=cfg.sliding_window, layer_scale=cfg.layer_scale,
+        rope_theta=cfg.rope_theta, rms_eps=cfg.rms_eps,
     )
-    return y + p["b"].astype(x.dtype)
 
 
-def _tconv_init(key, cin, cout, k, dtype):
-    return {"w": nn.conv1d_init(key, cin, cout, k, dtype=dtype)["w"],
-            "b": jnp.zeros((cout,), dtype)}
-
-
-def _tconv(p, x, k: int, stride: int):
-    """Causal transposed conv: full transpose then trim (k - stride)
-    samples off the RIGHT (reference CausalTransConvNet)."""
-    y = jax.lax.conv_transpose(
-        x, p["w"].astype(x.dtype), strides=(stride,), padding="VALID",
-        dimension_numbers=("NWC", "WIO", "NWC"),
-    )
-    trim = k - stride
-    if trim > 0:
-        y = y[:, : y.shape[1] - trim]
-    return y + p["b"].astype(x.dtype)
-
-
-def _snake_init(ch, dtype):
-    return {"alpha": jnp.zeros((ch,), dtype), "beta": jnp.zeros((ch,), dtype)}
-
-
-def _snake(p, x):
-    """SnakeBeta: x + 1/exp(beta) * sin^2(x * exp(alpha))
-    (modeling_qwen3_tts_tokenizer_v2.py:578-618)."""
-    a = jnp.exp(p["alpha"].astype(jnp.float32))
-    b = jnp.exp(p["beta"].astype(jnp.float32))
-    xf = x.astype(jnp.float32)
-    y = xf + (1.0 / (b + 1e-9)) * jnp.square(jnp.sin(xf * a))
-    return y.astype(x.dtype)
-
-
-def _convnext_init(key, dim, dtype):
-    k = jax.random.split(key, 3)
-    return {
-        "dw": _cconv_init(k[0], dim, dim, 7, dtype, groups=dim),
-        "norm": nn.layernorm_init(dim, dtype=dtype),
-        "pw1": nn.linear_init(k[1], dim, 4 * dim, dtype=dtype),
-        "pw2": nn.linear_init(k[2], 4 * dim, dim, dtype=dtype),
-        "gamma": jnp.full((dim,), 1e-6, dtype),
-    }
-
-
-def _convnext(p, x):
-    h = _cconv(p["dw"], x, 7, groups=x.shape[-1])
-    h = nn.layernorm(p["norm"], h)
-    h = nn.linear(p["pw2"], jax.nn.gelu(nn.linear(p["pw1"], h),
-                                        approximate=False))
-    return x + p["gamma"].astype(x.dtype) * h
-
-
-# ------------------------------------------------------------ transformer
 def _layer_init(key, cfg: Tokenizer12HzConfig, dtype):
-    k = jax.random.split(key, 6)
-    h, d = cfg.hidden_size, cfg.head_dim
-    return {
-        "input_norm": nn.rmsnorm_init(h, dtype),
-        "q_proj": nn.linear_init(k[0], h, cfg.num_heads * d, bias=False,
-                                 dtype=dtype),
-        "k_proj": nn.linear_init(k[1], h, cfg.num_kv_heads * d,
-                                 bias=False, dtype=dtype),
-        "v_proj": nn.linear_init(k[2], h, cfg.num_kv_heads * d,
-                                 bias=False, dtype=dtype),
-        "o_proj": nn.linear_init(k[3], cfg.num_heads * d, h, bias=False,
-                                 dtype=dtype),
-        "attn_scale": jnp.full((h,), cfg.layer_scale, dtype),
-        "post_norm": nn.rmsnorm_init(h, dtype),
-        # gate/up kept as separate leaves so the HF checkpoint's
-        # gate_proj/up_proj map 1:1 (no fused-weight surgery)
-        "gate": nn.linear_init(k[4], h, cfg.intermediate_size,
-                               bias=False, dtype=dtype),
-        "up": nn.linear_init(jax.random.fold_in(k[4], 1), h,
-                             cfg.intermediate_size, bias=False,
-                             dtype=dtype),
-        "down": nn.linear_init(k[5], cfg.intermediate_size, h,
-                               bias=False, dtype=dtype),
-        "mlp_scale": jnp.full((h,), cfg.layer_scale, dtype),
-    }
+    return vk.transformer_layer_init(key, _spec(cfg), dtype)
 
 
 def _transformer(params, cfg: Tokenizer12HzConfig, x):
     """Causal sliding-window transformer with LayerScale residuals
     (DecoderTransformerLayer, :408-470)."""
-    from vllm_omni_tpu.ops import apply_rope, compute_rope_freqs
-
-    b, t, _ = x.shape
-    pos = jnp.arange(t)
-    cos, sin = compute_rope_freqs(pos, cfg.head_dim, cfg.rope_theta)
-    # causal + sliding window 72: key j visible to query i iff
-    # i - window < j <= i
-    dist = pos[:, None] - pos[None, :]
-    mask = (dist >= 0) & (dist < cfg.sliding_window)
-    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
-
-    for lp in params["layers"]:
-        h = rms_norm(x, lp["input_norm"]["w"], cfg.rms_eps)
-        flat = h.reshape(b * t, -1)
-        q = nn.linear(lp["q_proj"], flat).reshape(b * t, -1, cfg.head_dim)
-        kk = nn.linear(lp["k_proj"], flat).reshape(b * t, -1, cfg.head_dim)
-        v = nn.linear(lp["v_proj"], flat).reshape(b * t, -1, cfg.head_dim)
-        q = apply_rope(q, cos if b == 1 else jnp.tile(cos, (b, 1)),
-                       sin if b == 1 else jnp.tile(sin, (b, 1)))
-        kk = apply_rope(kk, cos if b == 1 else jnp.tile(cos, (b, 1)),
-                        sin if b == 1 else jnp.tile(sin, (b, 1)))
-        q = q.reshape(b, t, -1, cfg.head_dim)
-        kk = kk.reshape(b, t, -1, cfg.head_dim)
-        v = v.reshape(b, t, -1, cfg.head_dim)
-        # dense attention with the window bias: the 72-token window is a
-        # static mask, XLA folds it into the softmax
-        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                       kk.astype(jnp.float32)) / math.sqrt(cfg.head_dim)
-        a = jax.nn.softmax(s + bias[None, None], axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(b, t, -1)
-        o = nn.linear(lp["o_proj"], o)
-        x = x + lp["attn_scale"].astype(x.dtype) * o
-        h = rms_norm(x, lp["post_norm"]["w"], cfg.rms_eps)
-        y = nn.linear(lp["down"],
-                      jax.nn.silu(nn.linear(lp["gate"], h))
-                      * nn.linear(lp["up"], h))
-        x = x + lp["mlp_scale"].astype(x.dtype) * y
-    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
+    return vk.sliding_transformer(params, _spec(cfg), x)
 
 
 # -------------------------------------------------------------------- RVQ
@@ -442,7 +320,6 @@ def tiny_decoder_factory():
 
 
 # ------------------------------------------------------- checkpoint load
-_TCONV_MARKERS = (".upsample.", ".block.1.")
 
 
 def hf_flat_map(cfg: Tokenizer12HzConfig) -> dict:
@@ -524,16 +401,16 @@ def hf_flat_map(cfg: Tokenizer12HzConfig) -> dict:
 
 
 def hf_transform(name: str, arr):
-    """torch layouts -> ours: ConvTranspose1d [in, out, k] and Conv1d
-    [out, in, k] both to WIO [k, in, out]; linears [out, in] -> [in,
-    out]; 1-wide conv projections squeeze to linears."""
+    """torch layouts -> ours: Conv1d [out, in, k] -> WIO [k, in, out]
+    and ConvTranspose1d [in, out, k] -> [k, out, in] (the
+    ``transpose_kernel=True`` forward layout) — both are
+    transpose(2, 1, 0); linears [out, in] -> [in, out]; 1-wide conv
+    projections squeeze to linears."""
     if arr.ndim == 3:
         if arr.shape[-1] == 1 and ("input_proj" in name
                                    or "output_proj" in name):
             return arr[..., 0].transpose(1, 0)  # 1x1 conv -> [in, out]
-        if any(t in name for t in _TCONV_MARKERS):
-            return arr.transpose(2, 0, 1)  # ConvTranspose1d in,out,k
-        return arr.transpose(2, 1, 0)      # Conv1d out,in,k
+        return arr.transpose(2, 1, 0)
     if arr.ndim == 2 and name.endswith("weight") \
             and "embedding_sum" not in name:
         return arr.T
