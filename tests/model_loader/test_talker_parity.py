@@ -1,0 +1,193 @@
+"""Qwen3-Omni talker LM parity vs the transformers oracle.
+
+Builds a tiny ``Qwen3OmniMoeTalkerForConditionalGeneration`` (MoE LM
+with shared expert + norm_topk_prob=False, codec embedding/head,
+thinker-width ResizeMLP projections), saves it as a
+``talker.``-prefixed safetensors checkpoint, loads through
+``load_talker``, and compares codec logits on both the token path and
+the thinker-hidden prompt-embeds path.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from vllm_omni_tpu.models.common import transformer as tfm  # noqa: E402
+from vllm_omni_tpu.models.qwen3_omni import talker  # noqa: E402
+
+THINKER_HIDDEN = 48
+
+
+def _tiny_hf_cfg():
+    from transformers.models.qwen3_omni_moe.configuration_qwen3_omni_moe import (  # noqa: E501
+        Qwen3OmniMoeTalkerCodePredictorConfig,
+        Qwen3OmniMoeTalkerConfig,
+        Qwen3OmniMoeTalkerTextConfig,
+    )
+
+    text = Qwen3OmniMoeTalkerTextConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        intermediate_size=64, moe_intermediate_size=16, num_experts=4,
+        num_experts_per_tok=2, shared_expert_intermediate_size=24,
+        rope_scaling={"mrope_section": [2, 1, 1], "rope_type": "default"},
+    )
+    pred = Qwen3OmniMoeTalkerCodePredictorConfig(
+        vocab_size=48, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        intermediate_size=64, num_code_groups=4,
+    )
+    cfg = Qwen3OmniMoeTalkerConfig(
+        text_config=text.to_dict(), code_predictor_config=pred.to_dict(),
+        num_code_groups=4, thinker_hidden_size=THINKER_HIDDEN,
+    )
+    cfg.spatial_merge_size = 2  # vision attr the talker ctor expects
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from transformers.models.qwen3_omni_moe.modeling_qwen3_omni_moe import (  # noqa: E501
+        Qwen3OmniMoeTalkerForConditionalGeneration,
+    )
+
+    torch.manual_seed(0)
+    cfg = _tiny_hf_cfg()
+    model = Qwen3OmniMoeTalkerForConditionalGeneration(cfg).eval().float()
+    d = tmp_path_factory.mktemp("talker_ckpt")
+    from safetensors.torch import save_file
+
+    state = {f"talker.{k}": v.contiguous()
+             for k, v in model.state_dict().items()
+             if "rotary_emb" not in k}
+    # decoy thinker tensors with INCOMPATIBLE shapes: the composite
+    # checkpoint layout — load_talker must skip these (submodel filter),
+    # not crash or overwrite talker weights
+    state["thinker.model.embed_tokens.weight"] = torch.zeros(128, 16)
+    state["thinker.model.layers.0.self_attn.q_proj.weight"] = \
+        torch.zeros(16, 16)
+    state["thinker.lm_head.weight"] = torch.zeros(128, 16)
+    save_file(state, os.path.join(d, "model.safetensors"))
+    cfg_d = cfg.to_dict()
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"talker_config": cfg_d}, f)
+    return str(d), model, cfg
+
+
+def test_talker_config_translation(checkpoint):
+    ckpt_dir, _, hf_cfg = checkpoint
+    from vllm_omni_tpu.model_loader.hf_qwen import config_from_hf
+
+    cfg = config_from_hf(ckpt_dir, "talker_config.text_config")
+    assert cfg.moe and cfg.shared_expert_size == 24
+    assert cfg.moe_renormalize is False  # norm_topk_prob
+    assert cfg.qk_norm
+    assert cfg.vocab_size == 64
+
+
+def test_talker_token_path_matches_hf(checkpoint):
+    """Codec-token AR forward: our LM logits equal
+    codec_head(model(codec_embedding(ids)))."""
+    ckpt_dir, model, _ = checkpoint
+    params, cfg, eos = talker.load_talker(ckpt_dir, dtype=jnp.float32)
+    assert eos == model.config.codec_eos_token_id
+
+    ids = np.array([[3, 9, 27, 14, 55, 2]])
+    with torch.no_grad():
+        tids = torch.from_numpy(ids)
+        emb = model.model.codec_embedding(tids)
+        pos = torch.arange(ids.shape[1])[None]
+        out = model.model(inputs_embeds=emb,
+                          position_ids=pos).last_hidden_state
+        want = model.codec_head(out).numpy()
+
+    h = tfm.forward_hidden(params, cfg, jnp.asarray(ids))
+    got = np.asarray(tfm.logits_from_hidden(params, cfg, h))
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
+
+
+def test_talker_hidden_projection_path_matches_hf(checkpoint):
+    """Thinker hidden states through hidden_projection (our embed_proj
+    prompt-embeds path) match the oracle's ResizeMLP + LM."""
+    ckpt_dir, model, _ = checkpoint
+    params, cfg, _ = talker.load_talker(ckpt_dir, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    thinker_h = rng.standard_normal((1, 5, THINKER_HIDDEN)) \
+        .astype(np.float32)
+    with torch.no_grad():
+        emb = model.hidden_projection(torch.from_numpy(thinker_h))
+        pos = torch.arange(5)[None]
+        out = model.model(inputs_embeds=emb,
+                          position_ids=pos).last_hidden_state
+        want = model.codec_head(out).numpy()
+
+    h = tfm.forward_hidden(params, cfg,
+                           jnp.zeros((1, 5), jnp.int32),
+                           inputs_embeds=jnp.asarray(thinker_h))
+    got = np.asarray(tfm.logits_from_hidden(params, cfg, h))
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
+
+
+def test_code_predictor_prefill_logits_match_hf(checkpoint):
+    """[hidden, embed0] prefill: lm_head[0] logits match the oracle."""
+    from vllm_omni_tpu.models.qwen3_omni import code_predictor as cp
+
+    ckpt_dir, model, _ = checkpoint
+    params, cfg, groups = cp.load_code_predictor(ckpt_dir)
+    assert groups == 4
+    rng = np.random.default_rng(3)
+    hidden = rng.standard_normal((2, 32)).astype(np.float32)
+    e0 = rng.standard_normal((2, 32)).astype(np.float32)
+    seq = np.stack([hidden, e0], axis=1)
+    with torch.no_grad():
+        want = model.code_predictor(
+            inputs_embeds=torch.from_numpy(seq)).logits[:, -1].numpy()
+    got = np.asarray(cp.predict_group_logits(
+        params, cfg, jnp.asarray(seq), step=0))
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
+
+
+def test_code_predictor_greedy_rollout_matches_hf(checkpoint):
+    """Full groups-1..G-1 greedy rollout equals the oracle's
+    grow-the-sequence loop (HF prefill branch infers the step from the
+    sequence length, mirroring generation with cache)."""
+    from vllm_omni_tpu.models.qwen3_omni import code_predictor as cp
+
+    ckpt_dir, model, _ = checkpoint
+    params, cfg, groups = cp.load_code_predictor(ckpt_dir)
+    rng = np.random.default_rng(4)
+    hidden = rng.standard_normal((2, 32)).astype(np.float32)
+    e0 = rng.standard_normal((2, 32)).astype(np.float32)
+
+    seq = torch.from_numpy(np.stack([hidden, e0], axis=1))
+    want = []
+    with torch.no_grad():
+        for g in range(groups - 1):
+            logits = model.code_predictor(inputs_embeds=seq).logits[:, -1]
+            code = logits.argmax(-1)
+            want.append(code.numpy())
+            emb = model.code_predictor.get_input_embeddings()[g](code)
+            seq = torch.cat([seq, emb[:, None]], dim=1)
+    want = np.stack(want, axis=1)  # [B, G-1]
+
+    got = np.asarray(cp.predict_codes(
+        params, cfg, jnp.asarray(hidden), jnp.asarray(e0), groups))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_text_projection_matches_hf(checkpoint):
+    ckpt_dir, model, _ = checkpoint
+    params, _, _ = talker.load_talker(ckpt_dir, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 3, THINKER_HIDDEN)).astype(np.float32)
+    with torch.no_grad():
+        want = model.text_projection(torch.from_numpy(x)).numpy()
+    got = np.asarray(talker.project_thinker_text(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)

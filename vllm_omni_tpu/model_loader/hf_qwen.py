@@ -27,7 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from vllm_omni_tpu.logger import init_logger
-from vllm_omni_tpu.model_loader.safetensors_loader import iter_safetensors
+from vllm_omni_tpu.model_loader.safetensors_loader import (
+    iter_safetensors,
+    np_param_dtype,
+)
 from vllm_omni_tpu.models.common import transformer as tfm
 
 logger = init_logger(__name__)
@@ -69,6 +72,9 @@ def config_from_hf(model_dir: str,
         num_experts=hf.get("num_experts", hf.get("num_routed_experts", 8)),
         num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         moe_intermediate_size=hf.get("moe_intermediate_size", 0),
+        moe_renormalize=hf.get("norm_topk_prob", True),
+        shared_expert_size=hf.get("shared_expert_intermediate_size", 0)
+        if moe else 0,
     )
 
 
@@ -111,8 +117,14 @@ def load_qwen_lm(
     cfg: Optional[tfm.TransformerConfig] = None,
     dtype=jnp.bfloat16,
     hf_config_name: Optional[str] = None,
+    submodel: Optional[str] = None,
 ):
     """Load an HF Qwen2/Qwen3(-MoE) checkpoint.
+
+    ``submodel`` restricts loading to one component of a composite
+    checkpoint ("thinker" / "talker"): only tensors under that prefix
+    are consumed — without it, a full Qwen3-Omni checkpoint would write
+    both thinker.model.* and talker.model.* into the same tree.
 
     Returns (params, cfg, eos_token_id) — the model_factory contract.
     """
@@ -122,13 +134,14 @@ def load_qwen_lm(
         from vllm_omni_tpu.config.model import resolve_dtype
 
         dtype = resolve_dtype(dtype)
-    np_dtype = np.dtype(jnp.dtype(dtype).name) if dtype != jnp.bfloat16 \
-        else jnp.bfloat16
+    np_dtype = np_param_dtype(dtype)
     params = _alloc_tree(cfg, np_dtype)
     inter = cfg.moe_intermediate_size or cfg.intermediate_size
 
     loaded, unmapped = 0, []
     for name, arr in iter_safetensors(model_dir):
+        if submodel is not None and not name.startswith(f"{submodel}."):
+            continue
         m = _LAYER_RE.match(name)
         if m:
             li, sub, kind = int(m.group(1)), m.group(2), m.group(3)
@@ -164,6 +177,22 @@ def load_qwen_lm(
                 layer["router"]["w"][...] = arr.T
                 loaded += 1
                 continue
+            if sub.startswith("mlp.shared_expert") and cfg.moe \
+                    and "shared_expert" in layer:
+                sse = cfg.shared_expert_size
+                if sub == "mlp.shared_expert.gate_proj":
+                    layer["shared_expert"]["gate_up"]["w"][:, :sse] = arr.T
+                elif sub == "mlp.shared_expert.up_proj":
+                    layer["shared_expert"]["gate_up"]["w"][:, sse:] = arr.T
+                elif sub == "mlp.shared_expert.down_proj":
+                    layer["shared_expert"]["down"]["w"][...] = arr.T
+                elif sub == "mlp.shared_expert_gate":
+                    layer["shared_gate"]["w"][...] = arr.T
+                else:
+                    unmapped.append(name)
+                    continue
+                loaded += 1
+                continue
             em = _EXPERT_RE.match(sub)
             if em and cfg.moe:
                 e, which = int(em.group(1)), em.group(2)
@@ -178,14 +207,17 @@ def load_qwen_lm(
             unmapped.append(name)
             continue
         stripped = _PREFIX_RE.sub("", name)
-        if stripped == "embed_tokens.weight":
+        if stripped in ("embed_tokens.weight", "codec_embedding.weight"):
+            # codec_embedding: the talker's code-token table
+            # (Qwen3OmniMoeTalkerModel)
             params["embed"]["w"][...] = arr  # embeddings stay [vocab, hidden]
             loaded += 1
         elif stripped == "norm.weight":
             params["final_norm"]["w"][...] = arr
             loaded += 1
         elif name in ("lm_head.weight", "thinker.lm_head.weight",
-                      "talker.lm_head.weight"):
+                      "talker.lm_head.weight", "talker.codec_head.weight",
+                      "codec_head.weight"):
             if cfg.tie_word_embeddings:
                 unmapped.append(name)
             else:

@@ -62,6 +62,15 @@ def iter_safetensors(model_dir: str) -> Iterator[tuple[str, np.ndarray]]:
                 yield name, f.get_tensor(name)
 
 
+def np_param_dtype(dtype):
+    """numpy-side dtype for preallocated param buffers (bfloat16 has no
+    numpy dtype name — ml_dtypes' type object works directly)."""
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if dtype == jnp.bfloat16 \
+        else np.dtype(jnp.dtype(dtype).name)
+
+
 def load_checkpoint_tree(
     model_dir: str,
     name_map: Callable[[str], Optional[tuple]],

@@ -56,6 +56,13 @@ class TransformerConfig:
     num_experts: int = 8
     num_experts_per_tok: int = 2
     moe_intermediate_size: int = 0  # 0 => intermediate_size
+    # norm_topk_prob: Qwen3-MoE renormalizes the kept router weights;
+    # the Qwen3-Omni talker keeps raw softmax mass (False)
+    moe_renormalize: bool = True
+    # Qwen2-MoE-style always-on shared expert beside the routed ones,
+    # combined through a learned sigmoid gate (the Qwen3-Omni talker LM,
+    # transformers Qwen3OmniMoeTalkerTextSparseMoeBlock); 0 => none
+    shared_expert_size: int = 0
     # "routed" (grouped-matmul top-k dispatch; EP over the mesh "ep" axis
     # when ops.moe.set_ep_mesh was called) | "dense" (oracle: all experts
     # compute all tokens)
@@ -142,6 +149,18 @@ def init_params(key, cfg: TransformerConfig, dtype=jnp.float32):
                     minval=-scale_out, maxval=scale_out,
                 ),
             }
+            if cfg.shared_expert_size:
+                ks1, ks2, ks3 = jax.random.split(k[7], 3)
+                layer["shared_expert"] = {
+                    "gate_up": nn.linear_init(
+                        ks1, cfg.hidden_size, 2 * cfg.shared_expert_size,
+                        bias=False, dtype=dtype),
+                    "down": nn.linear_init(
+                        ks2, cfg.shared_expert_size, cfg.hidden_size,
+                        bias=False, dtype=dtype),
+                }
+                layer["shared_gate"] = nn.linear_init(
+                    ks3, cfg.hidden_size, 1, bias=False, dtype=dtype)
         else:
             layer["gate_up"] = nn.linear_init(
                 k[4], cfg.hidden_size, 2 * cfg.intermediate_size, bias=False, dtype=dtype
@@ -180,7 +199,8 @@ def _moe_mlp_dense(layer, cfg: TransformerConfig, x):
     router_logits = x @ layer["router"]["w"]  # [T, E]
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     topk_w, topk_idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
-    topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)  # renormalize
+    if cfg.moe_renormalize:
+        topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
     # [T, E] combine weights (zero for non-selected experts)
     combine = jnp.zeros_like(probs).at[
         jnp.arange(t)[:, None], topk_idx
@@ -211,12 +231,22 @@ def _moe_mlp(layer, cfg: TransformerConfig, x):
             out = ep_fn(
                 x, layer["router"]["w"], layer["experts"]["gate_up"],
                 layer["experts"]["down"], cfg.num_experts_per_tok, mesh,
+                renormalize=cfg.moe_renormalize,
             )
         else:
             out = moe_ops.routed_moe(
                 x, layer["router"]["w"], layer["experts"]["gate_up"],
                 layer["experts"]["down"], cfg.num_experts_per_tok,
+                renormalize=cfg.moe_renormalize,
             )
+    if "shared_expert" in layer:
+        # always-on shared expert, sigmoid-gated per token
+        se = nn.linear(layer["shared_expert"]["down"],
+                       silu_mul(nn.linear(layer["shared_expert"]["gate_up"],
+                                          x)))
+        gate = jax.nn.sigmoid(
+            nn.linear(layer["shared_gate"], x).astype(jnp.float32))
+        out = out + (gate.astype(se.dtype) * se)
     return out.reshape(*lead, out.shape[-1])
 
 
@@ -264,7 +294,14 @@ def _embed_input(params, token_ids, inputs_embeds, embeds_mask):
         return nn.embedding(params["embed"], token_ids)
     x = inputs_embeds
     if "embed_proj" in params:
-        x = nn.linear(params["embed_proj"], x)
+        proj = params["embed_proj"]
+        if "fc1" in proj:
+            # two-layer ResizeMLP (the talker's hidden_projection,
+            # transformers Qwen3OmniMoeTalkerResizeMLP)
+            x = nn.linear(proj["fc2"], jax.nn.silu(nn.linear(proj["fc1"],
+                                                             x)))
+        else:
+            x = nn.linear(proj, x)
     if embeds_mask is not None:
         tok = nn.embedding(params["embed"], token_ids)
         x = jnp.where(embeds_mask[..., None], x, tok)

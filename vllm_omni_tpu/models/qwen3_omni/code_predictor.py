@@ -1,0 +1,216 @@
+"""Qwen3-Omni talker code predictor (MTP over RVQ code groups).
+
+Checkpoint-schema implementation of the transformers
+``Qwen3OmniMoeTalkerCodePredictorModelForConditionalGeneration``
+(reference: vllm_omni/model_executor/models/qwen3_omni/
+qwen3_omni_moe_code_predictor_mtp.py) — a small dense Qwen3 transformer
+that, given a talker frame's hidden state and its group-0 codec code,
+autoregressively emits the remaining ``num_code_groups - 1`` RVQ codes:
+the step-g sequence is [hidden, embed_talker(code_0), embed_1(code_1),
+..., embed_g(code_g)] and ``lm_head[g]`` reads code ``g+1`` off the last
+position.
+
+Distinct from the engine's EAGLE-style draft head (mtp.py), which
+accelerates group-0 decoding — this module produces the *other groups*
+of each frame, the codes2wav vocoder's full [K, T] input.
+
+TPU-first: the whole per-frame rollout is one jitted ``lax.scan`` over a
+fixed-width buffer (G+1 positions, causal mask) — no KV bookkeeping, no
+dynamic shapes; at G=32 the sequence is tiny and the MXU cost is the
+lm_head/embed matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import transformer as tfm
+
+logger = init_logger(__name__)
+
+
+def config_from_hf(d: dict) -> tfm.TransformerConfig:
+    """``code_predictor_config`` dict -> dense TransformerConfig."""
+    heads = d.get("num_attention_heads", 16)
+    return tfm.TransformerConfig(
+        vocab_size=d.get("vocab_size", 2048),
+        hidden_size=d.get("hidden_size", 1024),
+        num_layers=d.get("num_hidden_layers", 5),
+        num_heads=heads,
+        num_kv_heads=d.get("num_key_value_heads", heads),
+        head_dim=d.get("head_dim") or d.get("hidden_size", 1024) // heads,
+        intermediate_size=d.get("intermediate_size", 3072),
+        rope_theta=d.get("rope_theta", 10000.0),
+        rms_eps=d.get("rms_norm_eps", 1e-6),
+        qk_norm=True,
+        attention_bias=d.get("attention_bias", False),
+        tie_word_embeddings=True,  # no own single lm_head in the tree
+    )
+
+
+def init_params(key, cfg: tfm.TransformerConfig, num_code_groups: int,
+                dtype=jnp.float32):
+    """Transformer trunk + per-group embedding tables and heads (groups
+    1..G-1; group 0 is embedded by the talker's own codec table).
+
+    The tables live STACKED ([G-1, V, H] / [G-1, H, V]) so the rollout
+    indexes them without per-call restacking — at real geometry the
+    tables are ~250 MB and predict_codes runs once per audio frame."""
+    ke, kh = jax.random.split(jax.random.fold_in(key, 1000))
+    base = tfm.init_params(key, cfg, dtype)
+    g = num_code_groups - 1
+    return {
+        "layers": base["layers"], "final_norm": base["final_norm"],
+        "embeds": jax.random.normal(
+            ke, (g, cfg.vocab_size, cfg.hidden_size), dtype) * 0.02,
+        "heads": jax.random.normal(
+            kh, (g, cfg.hidden_size, cfg.vocab_size), dtype) * 0.02,
+    }
+
+
+def _trunk(params, cfg: tfm.TransformerConfig, seq):
+    """Causal forward over [B, S, H] embeddings -> final hidden."""
+    b, s = seq.shape[:2]
+    return tfm.forward_hidden(
+        params, cfg, jnp.zeros((b, s), jnp.int32), inputs_embeds=seq)
+
+
+def predict_group_logits(params, cfg: tfm.TransformerConfig, seq,
+                         step: int):
+    """Prefill-style logits: ``seq`` is [B, 2+step, H] ([hidden, embed_0,
+    ..., embed_step]); returns lm_head[step] logits at the last position
+    (HF forward with generation_steps inferred from length)."""
+    h = _trunk(params, cfg, seq)
+    return h[:, -1] @ params["heads"][step]
+
+
+def predict_codes(params, cfg: tfm.TransformerConfig,
+                  hidden: jax.Array,        # [B, H] talker frame hidden
+                  code0_embed: jax.Array,   # [B, H] talker embed of code 0
+                  num_code_groups: int) -> jax.Array:
+    """Greedy rollout of groups 1..G-1; returns codes [B, G-1].
+
+    Fixed-width jitted scan: the sequence buffer holds G+1 positions,
+    step g writes embed_g(code_g) into slot 2+g and reads lm_head[g] at
+    position 1+g — causality makes the not-yet-written tail irrelevant.
+    """
+    g_total = num_code_groups - 1
+    b, h = hidden.shape
+    width = 2 + g_total
+    embeds = params["embeds"]   # [G-1, V, H]
+    heads = params["heads"]     # [G-1, H, V]
+
+    buf = jnp.zeros((b, width, h), hidden.dtype)
+    buf = buf.at[:, 0].set(hidden).at[:, 1].set(code0_embed)
+
+    def step(carry, g):
+        buf = carry
+        hall = _trunk(params, cfg, buf)          # [B, width, H]
+        # logits for group g+1 sit at position 1+g
+        pos_h = jax.lax.dynamic_index_in_dim(hall, 1 + g, axis=1,
+                                             keepdims=False)
+        logits = pos_h @ heads[g]
+        code = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+        emb = embeds[g][code]                     # [B, H]
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, emb[:, None].astype(buf.dtype), 2 + g, axis=1)
+        return buf, code
+
+    _, codes = jax.lax.scan(step, buf, jnp.arange(g_total))
+    return jnp.moveaxis(codes, 0, 1)  # [B, G-1]
+
+
+# ------------------------------------------------------- checkpoint load
+_HF_PREFIX = "talker.code_predictor."
+
+
+def load_code_predictor(model_dir: str, dtype=jnp.float32):
+    """Stream ``talker.code_predictor.*`` weights of a Qwen3-Omni
+    checkpoint.  Returns (params, cfg, num_code_groups)."""
+    import json
+    import os
+    import re
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        iter_safetensors,
+        np_param_dtype,
+    )
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        talker_cfg = json.load(f)["talker_config"]
+    pred = talker_cfg["code_predictor_config"]
+    groups = pred.get("num_code_groups",
+                      talker_cfg.get("num_code_groups", 32))
+    cfg = config_from_hf(pred)
+
+    np_dtype = np_param_dtype(dtype)
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, groups,
+                            jnp.float32))
+    params = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, np_dtype), shapes)
+
+    layer_re = re.compile(
+        rf"^{re.escape(_HF_PREFIX)}model\.layers\.(\d+)\.(.+?)\.weight$")
+    direct = {
+        "input_layernorm": ("input_norm", False),
+        "post_attention_layernorm": ("post_norm", False),
+        "self_attn.q_proj": ("q_proj", True),
+        "self_attn.k_proj": ("k_proj", True),
+        "self_attn.v_proj": ("v_proj", True),
+        "self_attn.o_proj": ("o_proj", True),
+        "self_attn.q_norm": ("q_norm", False),
+        "self_attn.k_norm": ("k_norm", False),
+        "mlp.down_proj": ("down", True),
+    }
+    inter = cfg.intermediate_size
+    loaded, unmapped = 0, []
+    for name, arr in iter_safetensors(model_dir):
+        if not name.startswith(_HF_PREFIX):
+            continue
+        m = layer_re.match(name)
+        if m:
+            layer = params["layers"][int(m.group(1))]
+            sub = m.group(2)
+            if sub in direct:
+                key, transpose = direct[sub]
+                layer[key]["w"][...] = arr.T if transpose else arr
+            elif sub == "mlp.gate_proj":
+                layer["gate_up"]["w"][:, :inter] = arr.T
+            elif sub == "mlp.up_proj":
+                layer["gate_up"]["w"][:, inter:] = arr.T
+            else:
+                unmapped.append(name)
+                continue
+            loaded += 1
+            continue
+        tail = name[len(_HF_PREFIX):]
+        em = re.match(r"^model\.codec_embedding\.(\d+)\.weight$", tail)
+        hm = re.match(r"^lm_head\.(\d+)\.weight$", tail)
+        if tail == "model.norm.weight":
+            params["final_norm"]["w"][...] = arr
+        elif em:
+            params["embeds"][int(em.group(1))][...] = arr
+        elif hm:
+            params["heads"][int(hm.group(1))][...] = arr.T
+        else:
+            unmapped.append(name)
+            continue
+        loaded += 1
+    if unmapped:
+        logger.warning("code_predictor: %d unmapped tensors (e.g. %s)",
+                       len(unmapped), unmapped[:4])
+    # coverage: every expected HF tensor must have arrived, else the
+    # zero-filled buffers would silently emit garbage codes
+    # (11 per layer: 2 norms + 4 attn projs + q/k norms + gate/up/down)
+    expected = cfg.num_layers * 11 + 1 + 2 * (groups - 1)
+    if loaded != expected:
+        raise ValueError(
+            f"{model_dir}: code_predictor covered {loaded}/{expected} "
+            f"weights (unmapped: {unmapped[:4]})")
+    logger.info("code_predictor: loaded %d tensors", loaded)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return params, cfg, groups

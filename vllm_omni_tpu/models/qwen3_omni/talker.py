@@ -72,3 +72,79 @@ def tiny_factory():
     params = init_talker_params(jax.random.PRNGKey(1), cfg,
                                 thinker_hidden=64)
     return params, cfg, None
+
+
+# ------------------------------------------------------- checkpoint load
+def load_talker(model_dir: str, dtype=jnp.bfloat16):
+    """Load the ``talker.*`` weights of a Qwen3-Omni checkpoint.
+
+    The talker LM is a Qwen3-MoE with a shared expert
+    (norm_topk_prob=False) whose token table is ``codec_embedding`` and
+    whose output head is ``codec_head`` — both handled by the shared
+    Qwen loader.  On top of it ride two ResizeMLP projections from
+    thinker width (transformers Qwen3OmniMoeTalkerResizeMLP):
+    ``hidden_projection`` feeds the prompt-embeds path (wired as
+    ``embed_proj`` so forward_prefill applies it), ``text_projection``
+    is kept for the thinker-text conditioning stream.
+
+    Returns (params, cfg, eos) — the model_factory contract; eos is the
+    talker's codec EOS id.
+    """
+    import json
+    import os
+
+    import numpy as np
+
+    from vllm_omni_tpu.model_loader.hf_qwen import (
+        config_from_hf,
+        load_qwen_lm,
+    )
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        iter_safetensors,
+        np_param_dtype,
+    )
+
+    cfg = config_from_hf(model_dir, "talker_config.text_config")
+    params, _, _ = load_qwen_lm(model_dir, cfg=cfg, dtype=dtype,
+                                submodel="talker")
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        talker_cfg = json.load(f).get("talker_config", {})
+    eos = talker_cfg.get("codec_eos_token_id")
+
+    # second pass: the thinker-width projections
+    want = {
+        "talker.hidden_projection.linear_fc1.weight": ("embed_proj", "fc1", "w"),
+        "talker.hidden_projection.linear_fc1.bias": ("embed_proj", "fc1", "b"),
+        "talker.hidden_projection.linear_fc2.weight": ("embed_proj", "fc2", "w"),
+        "talker.hidden_projection.linear_fc2.bias": ("embed_proj", "fc2", "b"),
+        "talker.text_projection.linear_fc1.weight": ("text_proj", "fc1", "w"),
+        "talker.text_projection.linear_fc1.bias": ("text_proj", "fc1", "b"),
+        "talker.text_projection.linear_fc2.weight": ("text_proj", "fc2", "w"),
+        "talker.text_projection.linear_fc2.bias": ("text_proj", "fc2", "b"),
+    }
+    np_dtype = np_param_dtype(dtype)
+    extra: dict = {}
+    for name, arr in iter_safetensors(model_dir):
+        path = want.get(name)
+        if path is None:
+            continue
+        if name.endswith("weight"):
+            arr = arr.T
+        node = extra
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = jnp.asarray(np.asarray(arr, np_dtype))
+    for key in ("embed_proj", "text_proj"):
+        if key in extra:
+            params[key] = extra[key]
+    return params, cfg, eos
+
+
+def project_thinker_text(params, text_embeds):
+    """Apply the talker's ``text_projection`` ResizeMLP to thinker text
+    embeddings (the conditioning stream the reference sums with the
+    projected hidden states, qwen3_omni_moe_talker.py)."""
+    p = params["text_proj"]
+    return jax.numpy.asarray(
+        nn.linear(p["fc2"], jax.nn.silu(nn.linear(p["fc1"], text_embeds))))

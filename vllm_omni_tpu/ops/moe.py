@@ -53,12 +53,18 @@ def ep_mesh():
     return None
 
 
-def router_topk(x, router_w, num_experts_per_tok: int):
-    """Softmax router -> renormalized top-k (idx [T,k], weights [T,k])."""
+def router_topk(x, router_w, num_experts_per_tok: int,
+                renormalize: bool = True):
+    """Softmax router -> top-k (idx [T,k], weights [T,k]).
+
+    ``renormalize`` divides the kept weights by their sum (Qwen3-MoE
+    norm_topk_prob=True); the Qwen3-Omni talker keeps the raw softmax
+    mass (norm_topk_prob=False)."""
     logits = x @ router_w  # [T, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     topk_w, topk_idx = jax.lax.top_k(probs, num_experts_per_tok)
-    topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
+    if renormalize:
+        topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
     return topk_idx, topk_w
 
 
@@ -75,13 +81,14 @@ def routed_moe(
     gate_up: jax.Array,    # [E, hidden, 2*inter]
     down: jax.Array,       # [E, inter, hidden]
     num_experts_per_tok: int,
+    renormalize: bool = True,
 ) -> jax.Array:
     """Top-k routed MoE on one shard: sort pairs by expert, grouped
     matmul, weighted scatter-add."""
     t, hidden = x.shape
     e = gate_up.shape[0]
     k = num_experts_per_tok
-    topk_idx, topk_w = router_topk(x, router_w, k)
+    topk_idx, topk_w = router_topk(x, router_w, k, renormalize)
 
     flat_e = topk_idx.reshape(-1)                    # [T*k]
     flat_w = topk_w.reshape(-1)                      # [T*k]
@@ -95,7 +102,8 @@ def routed_moe(
     return out.astype(x.dtype)
 
 
-def _routed_moe_ep_shard(x, router_w, gate_up, down, k: int):
+def _routed_moe_ep_shard(x, router_w, gate_up, down, k: int,
+                         renormalize: bool = True):
     """Per-ep-shard body: full token set, local expert slab.  Pairs routed
     to remote experts keep their slot (static shapes) but are masked to
     weight zero and land in a local expert group; the psum over ep sums
@@ -104,7 +112,7 @@ def _routed_moe_ep_shard(x, router_w, gate_up, down, k: int):
     shard = jax.lax.axis_index("ep")
     lo = shard * e_local
 
-    topk_idx, topk_w = router_topk(x, router_w, k)
+    topk_idx, topk_w = router_topk(x, router_w, k, renormalize)
     flat_e = topk_idx.reshape(-1)
     flat_w = topk_w.reshape(-1)
     mine = (flat_e >= lo) & (flat_e < lo + e_local)
@@ -121,7 +129,8 @@ def _routed_moe_ep_shard(x, router_w, gate_up, down, k: int):
     return jax.lax.psum(out, "ep").astype(x.dtype)
 
 
-def _moe_a2a_shard(x, router_w, gate_up, down, k: int, capacity: int):
+def _moe_a2a_shard(x, router_w, gate_up, down, k: int, capacity: int,
+                   renormalize: bool = True):
     """Per-shard body of all-to-all EP dispatch (inside shard_map over
     ``ep``): tokens are SHARDED over ep (x is the local [Tl, H] slice).
 
@@ -140,7 +149,7 @@ def _moe_a2a_shard(x, router_w, gate_up, down, k: int, capacity: int):
     tl, hidden = x.shape
     p = tl * k
 
-    topk_idx, topk_w = router_topk(x, router_w, k)
+    topk_idx, topk_w = router_topk(x, router_w, k, renormalize)
     flat_e = topk_idx.reshape(-1)                  # [P] global expert ids
     flat_w = topk_w.reshape(-1)
     dest = flat_e // e_local                       # destination shard
@@ -190,7 +199,8 @@ def _moe_a2a_shard(x, router_w, gate_up, down, k: int, capacity: int):
 
 def routed_moe_ep_a2a(x, router_w, gate_up, down,
                       num_experts_per_tok: int, mesh,
-                      capacity_factor: float = 2.0) -> jax.Array:
+                      capacity_factor: float = 2.0,
+                      renormalize: bool = True) -> jax.Array:
     """Token-sharded dp x ep all-to-all EP dispatch (reference: fused MoE
     all-to-all, worker/gpu_ar_model_runner.py:522-523; SURVEY §2.11 EP).
     Tokens shard over (dp, ep); experts over ep.  Requires divisibility —
@@ -207,13 +217,13 @@ def routed_moe_ep_a2a(x, router_w, gate_up, down,
     e = gate_up.shape[0]
     if ep == 1 or t % (dp * ep) or e % ep:
         return routed_moe_ep(x, router_w, gate_up, down,
-                             num_experts_per_tok, mesh)
+                             num_experts_per_tok, mesh, renormalize)
     tl = t // (dp * ep)
     capacity = max(1, math.ceil(
         num_experts_per_tok * tl / ep * capacity_factor))
     fn = shard_map(
         lambda xx, rw, gu, dn: _moe_a2a_shard(
-            xx, rw, gu, dn, num_experts_per_tok, capacity),
+            xx, rw, gu, dn, num_experts_per_tok, capacity, renormalize),
         mesh=mesh,
         in_specs=(P(("dp", "ep")), P(), P("ep"), P("ep")),
         out_specs=P(("dp", "ep")),
@@ -223,7 +233,7 @@ def routed_moe_ep_a2a(x, router_w, gate_up, down,
 
 
 def routed_moe_ep(x, router_w, gate_up, down, num_experts_per_tok: int,
-                  mesh) -> jax.Array:
+                  mesh, renormalize: bool = True) -> jax.Array:
     """Expert-parallel routed MoE: experts sharded over the ``ep`` mesh
     axis; tokens stay sharded over ``dp`` (replicated only over ep —
     each dp rank computes its own token slice, each ep shard its local
@@ -236,7 +246,7 @@ def routed_moe_ep(x, router_w, gate_up, down, num_experts_per_tok: int,
     tok_spec = P("dp") if x.shape[0] % max(dp, 1) == 0 else P()
     fn = shard_map(
         lambda xx, rw, gu, dn: _routed_moe_ep_shard(
-            xx, rw, gu, dn, num_experts_per_tok),
+            xx, rw, gu, dn, num_experts_per_tok, renormalize),
         mesh=mesh,
         in_specs=(tok_spec, P(), P("ep"), P("ep")),
         out_specs=tok_spec,
