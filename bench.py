@@ -122,10 +122,16 @@ def main():
             prompt=["a photo of a cat"], sampling_params=sp)
         return engine.step(req)
 
-    # compile warmup: 1 step warms every executable the timed run uses
-    # (the dense path's step count is a dynamic loop bound; the streaming
-    # path compiles per-piece) without paying a full generation
+    # compile warmup: 1 step warms every executable, then one untimed
+    # full-step generation — measured: the first full-length run after a
+    # 1-step warmup pays a ~4.5 s one-time cost (XLA autotune/allocator
+    # effects) that would otherwise pollute a 2-3 iteration average by
+    # 3x.  The streaming "real" preset skips the full warmup (a 50-step
+    # streamed generation is minutes; its per-piece executables are
+    # already warmed by one(1) and the 1-iter run is transfer-bound).
     one(1)
+    if size != "real":
+        one(steps)
     t0 = time.perf_counter()
     for _ in range(iters):
         one(steps)

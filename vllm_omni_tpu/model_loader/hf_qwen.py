@@ -75,6 +75,11 @@ def config_from_hf(model_dir: str,
         moe_renormalize=hf.get("norm_topk_prob", True),
         shared_expert_size=hf.get("shared_expert_intermediate_size", 0)
         if moe else 0,
+        # multimodal 3-D RoPE sections (thinker/talker text configs carry
+        # rope_scaling.mrope_section; positions then come in [B, 3, S])
+        mrope_sections=tuple(
+            (hf.get("rope_scaling") or {}).get("mrope_section"))
+        if (hf.get("rope_scaling") or {}).get("mrope_section") else None,
     )
 
 
@@ -138,10 +143,21 @@ def load_qwen_lm(
     params = _alloc_tree(cfg, np_dtype)
     inter = cfg.moe_intermediate_size or cfg.intermediate_size
 
+    # sibling components of a composite checkpoint that OTHER loaders
+    # own — skipped at the shard-key level (never decoded, never counted
+    # unmapped) so a correct load stays warning-free
+    sibling = (f"{submodel}.code_predictor.", f"{submodel}.audio_tower.",
+               f"{submodel}.visual.", f"{submodel}.text_projection.",
+               f"{submodel}.hidden_projection.") if submodel else ()
+
+    def keep(name):
+        if submodel is None:
+            return True
+        return name.startswith(f"{submodel}.") \
+            and not any(name.startswith(p) for p in sibling)
+
     loaded, unmapped = 0, []
-    for name, arr in iter_safetensors(model_dir):
-        if submodel is not None and not name.startswith(f"{submodel}."):
-            continue
+    for name, arr in iter_safetensors(model_dir, keep):
         m = _LAYER_RE.match(name)
         if m:
             li, sub, kind = int(m.group(1)), m.group(2), m.group(3)

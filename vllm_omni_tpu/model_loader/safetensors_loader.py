@@ -52,13 +52,22 @@ def _shard_files(model_dir: str) -> list[str]:
     return files
 
 
-def iter_safetensors(model_dir: str) -> Iterator[tuple[str, np.ndarray]]:
+def iter_safetensors(
+    model_dir: str,
+    name_filter: Optional[Callable[[str], bool]] = None,
+) -> Iterator[tuple[str, np.ndarray]]:
     """Yield (hf_name, array) streaming across shards (numpy framework —
-    works for bf16 via ml_dtypes, no torch in the loop)."""
+    works for bf16 via ml_dtypes, no torch in the loop).
+
+    ``name_filter`` skips tensors at the key level — non-matching names
+    are never decoded, so picking a few tensors out of a multi-GB
+    composite checkpoint does not read the rest."""
     for path in _shard_files(model_dir):
         logger.info("loading shard %s", os.path.basename(path))
         with safe_open(path, framework="numpy") as f:
             for name in f.keys():
+                if name_filter is not None and not name_filter(name):
+                    continue
                 yield name, f.get_tensor(name)
 
 
@@ -79,6 +88,7 @@ def load_checkpoint_tree(
     dtype=None,
     device_put: Optional[Callable] = None,
     transform: Optional[Callable[[str, np.ndarray], np.ndarray]] = None,
+    name_filter: Optional[Callable[[str], bool]] = None,
 ) -> tuple[int, list[str]]:
     """Stream a checkpoint into an existing param tree.
 
@@ -86,12 +96,14 @@ def load_checkpoint_tree(
     skip).  HF linears store [out, in]; our layout is [in, out] —
     ``transpose_linear`` flips 2-D "w" leaves.  ``transform(name, arr)``
     (when given) handles layouts the flag can't express, e.g. torch
-    OIDHW conv kernels -> DHWIO.  Returns (num_loaded, unmapped_names);
-    shape mismatches raise immediately.
+    OIDHW conv kernels -> DHWIO.  ``name_filter`` skips non-matching
+    tensors without decoding them (they are not counted as unmapped).
+    Returns (num_loaded, unmapped_names); shape mismatches raise
+    immediately.
     """
     n = 0
     unmapped: list[str] = []
-    for hf_name, arr in iter_safetensors(model_dir):
+    for hf_name, arr in iter_safetensors(model_dir, name_filter):
         path = name_map(hf_name)
         if path is None:
             unmapped.append(hf_name)

@@ -168,9 +168,8 @@ def load_code_predictor(model_dir: str, dtype=jnp.float32):
     }
     inter = cfg.intermediate_size
     loaded, unmapped = 0, []
-    for name, arr in iter_safetensors(model_dir):
-        if not name.startswith(_HF_PREFIX):
-            continue
+    for name, arr in iter_safetensors(
+            model_dir, lambda n: n.startswith(_HF_PREFIX)):
         m = layer_re.match(name)
         if m:
             layer = params["layers"][int(m.group(1))]

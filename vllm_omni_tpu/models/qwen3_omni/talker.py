@@ -100,7 +100,7 @@ def load_talker(model_dir: str, dtype=jnp.bfloat16):
         load_qwen_lm,
     )
     from vllm_omni_tpu.model_loader.safetensors_loader import (
-        iter_safetensors,
+        load_checkpoint_tree,
         np_param_dtype,
     )
 
@@ -111,33 +111,37 @@ def load_talker(model_dir: str, dtype=jnp.bfloat16):
     with open(os.path.join(model_dir, "config.json")) as f:
         talker_cfg = json.load(f).get("talker_config", {})
     eos = talker_cfg.get("codec_eos_token_id")
+    thinker_hidden = talker_cfg.get("thinker_hidden_size", cfg.hidden_size)
 
-    # second pass: the thinker-width projections
-    want = {
-        "talker.hidden_projection.linear_fc1.weight": ("embed_proj", "fc1", "w"),
-        "talker.hidden_projection.linear_fc1.bias": ("embed_proj", "fc1", "b"),
-        "talker.hidden_projection.linear_fc2.weight": ("embed_proj", "fc2", "w"),
-        "talker.hidden_projection.linear_fc2.bias": ("embed_proj", "fc2", "b"),
-        "talker.text_projection.linear_fc1.weight": ("text_proj", "fc1", "w"),
-        "talker.text_projection.linear_fc1.bias": ("text_proj", "fc1", "b"),
-        "talker.text_projection.linear_fc2.weight": ("text_proj", "fc2", "w"),
-        "talker.text_projection.linear_fc2.bias": ("text_proj", "fc2", "b"),
-    }
+    # second pass: the two thinker-width ResizeMLP projections
+    # (decoded selectively — the name_filter skips the rest of the
+    # composite checkpoint at the shard-key level)
+    want = {}
+    for hf_key, ours in (("hidden_projection", "embed_proj"),
+                         ("text_projection", "text_proj")):
+        for fc in ("fc1", "fc2"):
+            for leaf, suffix in (("w", "weight"), ("b", "bias")):
+                want[f"talker.{hf_key}.linear_{fc}.{suffix}"] = \
+                    (ours, fc, leaf)
     np_dtype = np_param_dtype(dtype)
-    extra: dict = {}
-    for name, arr in iter_safetensors(model_dir):
-        path = want.get(name)
-        if path is None:
-            continue
-        if name.endswith("weight"):
-            arr = arr.T
-        node = extra
-        for k in path[:-1]:
-            node = node.setdefault(k, {})
-        node[path[-1]] = jnp.asarray(np.asarray(arr, np_dtype))
+    inter = cfg.intermediate_size
+    proj = {
+        key: {"fc1": {"w": np.zeros((thinker_hidden, inter), np_dtype),
+                      "b": np.zeros((inter,), np_dtype)},
+              "fc2": {"w": np.zeros((inter, cfg.hidden_size), np_dtype),
+                      "b": np.zeros((cfg.hidden_size,), np_dtype)}}
+        for key in ("embed_proj", "text_proj")
+    }
+    n, _ = load_checkpoint_tree(
+        model_dir, want.get, proj, dtype=np_dtype,
+        name_filter=lambda name: name in want,
+    )
+    if n != len(want):
+        raise ValueError(
+            f"{model_dir}: talker projections covered {n}/{len(want)} "
+            "tensors")
     for key in ("embed_proj", "text_proj"):
-        if key in extra:
-            params[key] = extra[key]
+        params[key] = jax.tree_util.tree_map(jnp.asarray, proj[key])
     return params, cfg, eos
 
 
