@@ -132,3 +132,26 @@ def test_streaming_rejects_mesh_and_cache():
                           cache_config=StepCacheConfig())
     with pytest.raises(ValueError, match="unknown offload"):
         QwenImagePipeline(cfg, seed=0, init_weights=False, offload="bogus")
+
+
+def test_streaming_text_encoder_with_mrope_sections():
+    """Qwen2.5-VL text-encoder configs carry rope_scaling.mrope_section;
+    the layerwise-streaming prefix must build 3-stream positions for an
+    mrope config instead of crashing (regression: config_from_hf now
+    propagates mrope sections)."""
+    import dataclasses
+
+    from vllm_omni_tpu.models.qwen_image.pipeline import (
+        QwenImagePipeline,
+        QwenImagePipelineConfig,
+    )
+
+    cfg = QwenImagePipelineConfig.tiny()
+    head_half = cfg.text.head_dim // 2
+    sections = (head_half - 2, 1, 1)
+    cfg = dataclasses.replace(
+        cfg, text=dataclasses.replace(cfg.text, mrope_sections=sections))
+    stream = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
+                               offload="layerwise")
+    txt, mask = stream.encode_prompt(["a cat"])
+    assert txt.shape[0] == 1 and np.isfinite(np.asarray(txt)).all()

@@ -1,0 +1,321 @@
+"""Qwen2.5-Omni BigVGAN vocoder (mel spectrogram -> waveform).
+
+Checkpoint-schema implementation of the transformers
+``Qwen2_5OmniToken2WavBigVGANModel`` (reference:
+vllm_omni/model_executor/models/qwen2_5_omni/qwen2_5_omni_token2wav.py
+serves it as the second half of the token2wav stage): log-mel is
+re-normalized to dB scale, a conv stem lifts it to
+``upsample_initial_channel``, six transposed-conv stages upsample 240x
+to 24 kHz, each stage averaging three AMP residual blocks (dilated
+convs with ANTI-ALIASED SnakeBeta activations — 2x Kaiser-sinc
+upsample, snake, 2x downsample), and a final conv + clamp emits the
+waveform.
+
+TPU-first: NWC layout, every conv an explicit-padding ``lax`` conv; the
+Kaiser-sinc resampling filters are host-precomputed constants (numpy)
+closed over by the jitted forward, and the anti-aliased activation's
+up/down pair are depthwise convs the MXU pipeline handles like any
+other channel-last conv.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import vocoder as vk
+
+logger = init_logger(__name__)
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+@dataclass(frozen=True)
+class BigVGANConfig:
+    """Mirrors transformers ``Qwen2_5OmniBigVGANConfig``."""
+    mel_dim: int = 80
+    upsample_initial_channel: int = 1536
+    resblock_kernel_sizes: tuple = (3, 7, 11)
+    resblock_dilation_sizes: tuple = ((1, 3, 5), (1, 3, 5), (1, 3, 5))
+    upsample_rates: tuple = (5, 3, 2, 2, 2, 2)
+    upsample_kernel_sizes: tuple = (11, 7, 4, 4, 4, 4)
+
+    @property
+    def total_upsample(self) -> int:
+        return int(math.prod(self.upsample_rates))
+
+    @staticmethod
+    def tiny() -> "BigVGANConfig":
+        return BigVGANConfig(
+            mel_dim=8, upsample_initial_channel=16,
+            resblock_kernel_sizes=(3,), resblock_dilation_sizes=((1, 3),),
+            upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        )
+
+    @staticmethod
+    def from_hf(d: dict) -> "BigVGANConfig":
+        return BigVGANConfig(
+            mel_dim=d.get("mel_dim", 80),
+            upsample_initial_channel=d.get("upsample_initial_channel",
+                                           1536),
+            resblock_kernel_sizes=tuple(d.get("resblock_kernel_sizes",
+                                              (3, 7, 11))),
+            resblock_dilation_sizes=tuple(
+                tuple(x) for x in d.get("resblock_dilation_sizes",
+                                        ((1, 3, 5),) * 3)),
+            upsample_rates=tuple(d.get("upsample_rates",
+                                       (5, 3, 2, 2, 2, 2))),
+            upsample_kernel_sizes=tuple(d.get("upsample_kernel_sizes",
+                                              (11, 7, 4, 4, 4, 4))),
+        )
+
+
+# --------------------------------------------------- kaiser-sinc filters
+def kaiser_sinc_filter(cutoff: float, half_width: float,
+                       kernel_size: int) -> np.ndarray:
+    """Kaiser-windowed sinc low-pass, matching the HF reference
+    (kaiser_sinc_filter1d) bit-for-bit in fp32."""
+    even = kernel_size % 2 == 0
+    half = kernel_size // 2
+    delta_f = 4 * half_width
+    atten = 2.285 * (half - 1) * math.pi * delta_f + 7.95
+    if atten > 50.0:
+        beta = 0.1102 * (atten - 8.7)
+    elif atten >= 21.0:
+        beta = 0.5842 * (atten - 21) ** 0.4 + 0.07886 * (atten - 21.0)
+    else:
+        beta = 0.0
+    window = np.kaiser(kernel_size, beta).astype(np.float32)
+    if even:
+        t = np.arange(-half, half, dtype=np.float32) + 0.5
+    else:
+        t = np.arange(kernel_size, dtype=np.float32) - half
+    if cutoff == 0:
+        return np.zeros(kernel_size, np.float32)
+    filt = 2 * cutoff * window * np.sinc(2 * cutoff * t)
+    return (filt / filt.sum()).astype(np.float32)
+
+
+def _aa_filters(ratio: int = 2, kernel_size: int = 12):
+    up = kaiser_sinc_filter(0.5 / ratio, 0.6 / ratio, kernel_size)
+    down = kaiser_sinc_filter(0.5 / ratio, 0.6 / ratio, kernel_size)
+    return jnp.asarray(up), jnp.asarray(down)
+
+
+_UP_FILTER, _DOWN_FILTER = None, None
+
+
+def _filters():
+    global _UP_FILTER, _DOWN_FILTER
+    if _UP_FILTER is None:
+        _UP_FILTER, _DOWN_FILTER = _aa_filters()
+    return _UP_FILTER, _DOWN_FILTER
+
+
+def _aa_snake(p, x):
+    """Anti-aliased SnakeBeta (TorchActivation1d): replicate-pad, 2x
+    Kaiser-sinc upsample (depthwise transpose conv), snake, replicate-
+    pad, 2x downsample.  x: [B, T, C]."""
+    upf, downf = _filters()
+    ch = x.shape[-1]
+    k, ratio = 12, 2
+    pad = k // ratio - 1
+    pad_left = pad * ratio + (k - ratio) // 2
+    pad_right = pad * ratio + (k - ratio + 1) // 2
+    h = jnp.pad(x, ((0, 0), (pad, pad), (0, 0)), mode="edge")
+    kern = jnp.broadcast_to(upf[:, None, None], (k, 1, ch))
+    # depthwise transposed conv as an lhs-dilated conv (conv_transpose
+    # has no feature_group_count); the Kaiser-sinc filter is symmetric
+    # so the kernel flip is a no-op
+    h = ratio * jax.lax.conv_general_dilated(
+        h.astype(jnp.float32), kern, window_strides=(1,),
+        padding=((k - 1, k - 1),), lhs_dilation=(ratio,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        feature_group_count=ch, precision=_PRECISION)
+    h = h[:, pad_left: h.shape[1] - pad_right]
+    h = vk.snake(p, h)
+    pad_left_d = k // 2 - 1  # even kernel
+    pad_right_d = k // 2
+    h = jnp.pad(h, ((0, 0), (pad_left_d, pad_right_d), (0, 0)),
+                mode="edge")
+    kern = jnp.broadcast_to(downf[:, None, None], (k, 1, ch))
+    h = jax.lax.conv_general_dilated(
+        h, kern, window_strides=(ratio,), padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        feature_group_count=ch, precision=_PRECISION)
+    return h.astype(x.dtype)
+
+
+# -------------------------------------------------------------- layers
+def _conv(p, x, k: int, pad: int, dilation: int = 1):
+    """Symmetric-zero-pad conv, NWC (torch Conv1d padding=pad)."""
+    y = jax.lax.conv_general_dilated(
+        jnp.pad(x, ((0, 0), (pad, pad), (0, 0))),
+        p["w"].astype(x.dtype), window_strides=(1,), padding="VALID",
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"), precision=_PRECISION)
+    return y + p["b"].astype(x.dtype) if "b" in p else y
+
+
+def _amp_block(p, x, k: int, dilations):
+    """AMPBlock: per dilation — aa-snake, dilated conv, aa-snake,
+    conv(d=1) — with residuals."""
+    acts = p["acts"]
+    for i, d in enumerate(dilations):
+        res = x
+        h = _aa_snake(acts[2 * i], x)
+        h = _conv(p["convs1"][i], h, k, (k * d - d) // 2, dilation=d)
+        h = _aa_snake(acts[2 * i + 1], h)
+        h = _conv(p["convs2"][i], h, k, (k - 1) // 2)
+        x = res + h
+    return x
+
+
+def init_params(key, cfg: BigVGANConfig, dtype=jnp.float32):
+    from vllm_omni_tpu.models.common import nn
+
+    ki = iter(jax.random.split(key, 256))
+    c0 = cfg.upsample_initial_channel
+    p = {"conv_pre": {"w": nn.conv1d_init(next(ki), cfg.mel_dim, c0, 7,
+                                          dtype=dtype)["w"],
+                      "b": jnp.zeros((c0,), dtype)},
+         "ups": [], "resblocks": []}
+    for i, (r, k) in enumerate(zip(cfg.upsample_rates,
+                                   cfg.upsample_kernel_sizes)):
+        cin, cout = c0 // (2 ** i), c0 // (2 ** (i + 1))
+        p["ups"].append(vk.tconv_init(next(ki), cin, cout, k, dtype))
+        for ks, dils in zip(cfg.resblock_kernel_sizes,
+                            cfg.resblock_dilation_sizes):
+            blk = {"convs1": [], "convs2": [], "acts": []}
+            for d in dils:
+                blk["convs1"].append(
+                    {"w": nn.conv1d_init(next(ki), cout, cout, ks,
+                                         dtype=dtype)["w"],
+                     "b": jnp.zeros((cout,), dtype)})
+                blk["convs2"].append(
+                    {"w": nn.conv1d_init(next(ki), cout, cout, ks,
+                                         dtype=dtype)["w"],
+                     "b": jnp.zeros((cout,), dtype)})
+                blk["acts"].extend([vk.snake_init(cout, dtype),
+                                    vk.snake_init(cout, dtype)])
+            p["resblocks"].append(blk)
+    out_ch = c0 // (2 ** len(cfg.upsample_rates))
+    p["act_post"] = vk.snake_init(out_ch, dtype)
+    p["conv_post"] = {"w": nn.conv1d_init(next(ki), out_ch, 1, 7,
+                                          dtype=dtype)["w"]}
+    return p
+
+
+def process_mel(mel):
+    """log-mel -> clamped dB spectrum (reference
+    process_mel_spectrogram: exp, amplitude->dB w/ -115 floor, -20,
+    normalize to [-1, 1])."""
+    amp = jnp.exp(mel.astype(jnp.float32))
+    min_level = math.exp(-115 / 20.0 * math.log(10))
+    db = 20.0 * jnp.log10(jnp.clip(amp, min_level, None)) - 20.0
+    return jnp.clip(2.0 * ((db + 115.0) / 115.0) - 1.0, -1.0, 1.0)
+
+
+def forward(params, cfg: BigVGANConfig, mel):
+    """mel [B, T, mel_dim] (log scale) -> waveform [B, T*upsample]."""
+    x = process_mel(mel).astype(mel.dtype)
+    x = _conv(params["conv_pre"], x, 7, 3)
+    n_res = len(cfg.resblock_kernel_sizes)
+    for i, (r, k) in enumerate(zip(cfg.upsample_rates,
+                                   cfg.upsample_kernel_sizes)):
+        # torch ConvTranspose1d padding=(k-r)//2 trims both sides
+        y = jax.lax.conv_transpose(
+            x, params["ups"][i]["w"].astype(x.dtype), strides=(r,),
+            padding="VALID", dimension_numbers=("NWC", "WIO", "NWC"),
+            transpose_kernel=True, precision=_PRECISION)
+        trim = (k - r) // 2
+        if trim:
+            y = y[:, trim: y.shape[1] - trim]
+        x = y + params["ups"][i]["b"].astype(x.dtype)
+        acc = 0.0
+        for j, (ks, dils) in enumerate(zip(cfg.resblock_kernel_sizes,
+                                           cfg.resblock_dilation_sizes)):
+            acc = acc + _amp_block(params["resblocks"][i * n_res + j],
+                                   x, ks, dils)
+        x = acc / n_res
+    x = _aa_snake(params["act_post"], x)
+    x = _conv(params["conv_post"], x, 7, 3)
+    return jnp.clip(x[..., 0], -1.0, 1.0)
+
+
+# ------------------------------------------------------- checkpoint load
+def hf_flat_map(cfg: BigVGANConfig,
+                prefix: str = "token2wav.code2wav_bigvgan_model.") -> dict:
+    m: dict[str, tuple] = {}
+    m[f"{prefix}conv_pre.weight"] = ("conv_pre", "w")
+    m[f"{prefix}conv_pre.bias"] = ("conv_pre", "b")
+    n_res = len(cfg.resblock_kernel_sizes)
+    for i in range(len(cfg.upsample_rates)):
+        m[f"{prefix}ups.{i}.0.weight"] = ("ups", i, "w")
+        m[f"{prefix}ups.{i}.0.bias"] = ("ups", i, "b")
+        for j, dils in enumerate([cfg.resblock_dilation_sizes[q]
+                                  for q in range(n_res)]):
+            rb = f"{prefix}resblocks.{i * n_res + j}"
+            tgt = ("resblocks", i * n_res + j)
+            for di in range(len(dils)):
+                for cv in ("convs1", "convs2"):
+                    m[f"{rb}.{cv}.{di}.weight"] = tgt + (cv, di, "w")
+                    m[f"{rb}.{cv}.{di}.bias"] = tgt + (cv, di, "b")
+            for a in range(2 * len(dils)):
+                m[f"{rb}.activations.{a}.act.alpha"] = \
+                    tgt + ("acts", a, "alpha")
+                m[f"{rb}.activations.{a}.act.beta"] = \
+                    tgt + ("acts", a, "beta")
+    m[f"{prefix}activation_post.act.alpha"] = ("act_post", "alpha")
+    m[f"{prefix}activation_post.act.beta"] = ("act_post", "beta")
+    m[f"{prefix}conv_post.weight"] = ("conv_post", "w")
+    return m
+
+
+def hf_transform(name: str, arr):
+    """Conv1d [out, in, k] -> [k, in, out]; ConvTranspose1d (the ups)
+    [in, out, k] -> [k, out, in] (transpose_kernel layout) — both
+    transpose(2, 1, 0)."""
+    if arr.ndim == 3:
+        return arr.transpose(2, 1, 0)
+    return arr
+
+
+def load_bigvgan(model_dir: str, cfg: BigVGANConfig = None,
+                 dtype=jnp.float32,
+                 prefix: str = "token2wav.code2wav_bigvgan_model."):
+    import json
+    import os
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+
+    if cfg is None:
+        cfg_path = os.path.join(model_dir, "config.json")
+        d = {}
+        if os.path.isfile(cfg_path):
+            with open(cfg_path) as f:
+                d = (json.load(f).get("token2wav_config", {})
+                     .get("bigvgan_config", {}))
+        cfg = BigVGANConfig.from_hf(d)
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    flat = hf_flat_map(cfg, prefix)
+    n, _ = load_checkpoint_tree(
+        model_dir, flat.get, tree, dtype=np.float32,
+        transform=hf_transform, name_filter=lambda nm: nm in flat,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if n != n_leaves:
+        raise ValueError(
+            f"{model_dir} covered {n}/{n_leaves} BigVGAN weights")
+    tree = jax.tree.map(
+        lambda a: jnp.asarray(a, dtype), tree)
+    return tree, cfg

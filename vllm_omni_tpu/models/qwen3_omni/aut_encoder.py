@@ -264,9 +264,8 @@ def load_aut_encoder(model_dir: str, cfg: AuTEncoderConfig | None = None,
     params = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), shapes)
     layer_re = re.compile(r"^layers\.(\d+)\.(.+?)\.(weight|bias)$")
     loaded, unmapped = 0, []
-    for name, arr in iter_safetensors(model_dir):
-        if not name.startswith(prefix):
-            continue
+    for name, arr in iter_safetensors(
+            model_dir, lambda n: n.startswith(prefix)):
         sub = name[len(prefix):]
         m = layer_re.match(sub)
         if m:

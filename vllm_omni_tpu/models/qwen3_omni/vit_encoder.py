@@ -326,9 +326,8 @@ def load_vit_encoder(model_dir: str, cfg: ViTEncoderConfig | None = None,
     merger_re = re.compile(
         r"^merger(?:_list\.(\d+))?\.(ln_q|mlp\.0|mlp\.2)\.(weight|bias)$")
     loaded, unmapped = 0, []
-    for name, arr in iter_safetensors(model_dir):
-        if not name.startswith(prefix):
-            continue
+    for name, arr in iter_safetensors(
+            model_dir, lambda n: n.startswith(prefix)):
         sub = name[len(prefix):]
         m = block_re.match(sub)
         if m:

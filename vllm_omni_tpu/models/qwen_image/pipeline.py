@@ -448,7 +448,11 @@ class QwenImagePipeline:
         def prefix(top, ids):
             b, s = ids.shape
             x = cnn.embedding(top["embed"], ids)
-            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+            # text-only positions: 1-D, or equal-stream [B, 3, S] when
+            # the encoder config carries mrope sections (Qwen2.5-VL
+            # checkpoints do — equal streams are numerically 1-D rope)
+            shape = (b, s) if tcfg.mrope_sections is None else (b, 3, s)
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], shape)
             cos, sin = tfm._rope_tables(tcfg, positions)
             return x, cos, sin
 
