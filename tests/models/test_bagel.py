@@ -129,3 +129,39 @@ def test_hunyuan_inherits_image_intake():
     base = _gen_img(hp, None)
     got = _gen_img(hp, img)
     assert not np.array_equal(base, got)
+
+
+def test_siglip_understanding_tower_conditions_context():
+    """With the SigLIP tower configured, a conditioning image changes
+    the generated image through the und-expert vit segment (reference
+    prepare_vit_images), deterministically."""
+    from vllm_omni_tpu.models.bagel.pipeline import (
+        BagelPipeline,
+        BagelPipelineConfig,
+    )
+
+    pipe = BagelPipeline(BagelPipelineConfig.tiny_vit(),
+                         dtype=jnp.float32, seed=0)
+    rng = np.random.default_rng(0)
+    image = (rng.uniform(0, 255, (16, 16, 3))).astype(np.uint8)
+
+    def gen(img):
+        sp = OmniDiffusionSamplingParams(
+            height=16, width=16, num_inference_steps=2,
+            guidance_scale=2.0, seed=5, image=img)
+        req = OmniDiffusionRequest(prompt=["a dog"], sampling_params=sp,
+                                   request_ids=["r"])
+        return pipe.forward(req)[0].data
+
+    with_img = gen(image)
+    with_img2 = gen(image)
+    without = gen(None)
+    assert with_img.shape == without.shape
+    np.testing.assert_array_equal(with_img, with_img2)
+    assert np.any(with_img != without)
+    # vit tokens exist and carry the pos-embed offsets
+    toks = pipe._vit_context(
+        type("R", (), {"sampling_params": type(
+            "S", (), {"image": image, "extra": {}})()})(), 1)
+    assert toks is not None and toks.shape[0] == 1
+    assert np.isfinite(np.asarray(toks)).all()

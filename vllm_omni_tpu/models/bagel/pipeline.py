@@ -87,12 +87,30 @@ class BagelPipelineConfig:
     vae: VAEConfig = field(default_factory=VAEConfig)
     max_text_len: int = 64
     steps_bucket: int = 32
+    # SigLIP understanding tower (reference: SiglipNaViTWrapper +
+    # MLPconnector + frozen 2D sincos vit_pos_embed,
+    # pipeline_bagel.py:121-149, bagel_transformer.py:855-860); None =>
+    # conditioning images ride the VAE/gen-expert path only
+    vit: "object" = None          # SigLIPConfig when enabled
+    vit_max_patch_per_side: int = 70
 
     @staticmethod
     def tiny() -> "BagelPipelineConfig":
         return BagelPipelineConfig(
             llm=BagelConfig.tiny(), vae=VAEConfig.tiny(),
             max_text_len=16, steps_bucket=8)
+
+    @staticmethod
+    def tiny_vit() -> "BagelPipelineConfig":
+        from vllm_omni_tpu.models.common.siglip import SigLIPConfig
+
+        return BagelPipelineConfig(
+            llm=BagelConfig.tiny(), vae=VAEConfig.tiny(),
+            max_text_len=16, steps_bucket=8,
+            vit=SigLIPConfig(hidden_size=16, num_layers=1, num_heads=2,
+                             intermediate_size=32, patch_size=8,
+                             num_positions=16),
+            vit_max_patch_per_side=4)
 
 
 def _expert_init(key, cfg: BagelConfig, dtype):
@@ -166,63 +184,86 @@ def _rope(cfg: BagelConfig, positions):
 
 
 def prefill_context(params, cfg: BagelConfig, token_ids: jax.Array,
-                    ctx_mask: jax.Array, img_tokens=None):
+                    ctx_mask: jax.Array, img_tokens=None,
+                    vit_tokens=None):
     """Context prefill (the NaiveCache fill): text rides the
     UNDERSTANDING expert (forward_cache_update_text); conditioning-image
     VAE-latent tokens ride the GENERATION expert
     (forward_cache_update_vae — MoT routes VAE tokens to the gen branch)
-    with shared attention over the packed [text ; image] sequence.
-    Returns per-layer (k, v) [B, S_ctx(+S_img), Hkv, D] plus the
+    with shared attention over the packed [text ; vit ; image] sequence.
+    Returns per-layer (k, v) [B, S_ctx(+S_vit)(+S_img), Hkv, D] plus the
     extended context mask.  ``img_tokens`` are already embedded
     (vae2llm + t=0 timestep + 2D pos, see ``_image_context``); image
-    tokens attend each other bidirectionally while text stays causal."""
+    tokens attend each other bidirectionally while text stays causal.
+    ``vit_tokens`` are SigLIP understanding features projected to LLM
+    width (connector + frozen 2D sincos pos embed) — they ride the UND
+    expert like text, all at one rope position (the reference packs the
+    whole vit segment at curr_position_id,
+    bagel_transformer.py:1116-1117), attending bidirectionally."""
     b, s = token_ids.shape
     xt = nn.embedding(params["embed"], token_ids)
     tok_mask = ctx_mask
     cos_t, sin_t = _rope(cfg, jnp.broadcast_to(
         jnp.arange(s)[None], (b, s)))
+    s_vit = 0 if vit_tokens is None else vit_tokens.shape[1]
+    xv = None
+    if vit_tokens is not None:
+        xv = vit_tokens.astype(xt.dtype)
+        tok_mask = jnp.concatenate(
+            [tok_mask, jnp.ones((b, s_vit), ctx_mask.dtype)], axis=1)
+        # one shared rope position for the whole vit segment
+        cos_v, sin_v = _rope(cfg, jnp.full((b, s_vit), s, jnp.int32))
     if img_tokens is None:
-        s_all, xi = s, None
+        s_all, xi = s + s_vit, None
     else:
         s_img = img_tokens.shape[1]
-        s_all = s + s_img
+        s_all = s + s_vit + s_img
         xi = img_tokens.astype(xt.dtype)
         tok_mask = jnp.concatenate(
-            [ctx_mask, jnp.ones((b, s_img), ctx_mask.dtype)], axis=1)
+            [tok_mask, jnp.ones((b, s_img), ctx_mask.dtype)], axis=1)
         cos_i, sin_i = _rope(cfg, jnp.broadcast_to(
-            (s + jnp.arange(s_img))[None], (b, s_img)))
+            (s + s_vit + jnp.arange(s_img))[None], (b, s_img)))
     causal = jnp.arange(s_all)[None, :] <= jnp.arange(s_all)[:, None]
+    if vit_tokens is not None:
+        vit_zone = ((jnp.arange(s_all) >= s)
+                    & (jnp.arange(s_all) < s + s_vit))
+        causal = causal | (vit_zone[None, :] & vit_zone[:, None])
     if img_tokens is not None:
         # packed image attention: image tokens see each other
         # bidirectionally; text stays causal and precedes the image
-        img_zone = (jnp.arange(s_all) >= s)[None, :] \
-            & (jnp.arange(s_all) >= s)[:, None]
+        img_zone = (jnp.arange(s_all) >= s + s_vit)[None, :] \
+            & (jnp.arange(s_all) >= s + s_vit)[:, None]
         causal = causal | img_zone
     bias = jnp.where(causal[None] & (tok_mask[:, None, :] > 0),
                      0.0, -1e30)[:, None]  # [B,1,S,S]
     kvs = []
     for layer in params["layers"]:
         und = layer["und"]
-        if xi is None:
-            q, k, v = _qkv(und, cfg, xt, cos_t, sin_t)
-        else:
+        qs, ks, vs = [], [], []
+        qt, kt, vt = _qkv(und, cfg, xt, cos_t, sin_t)
+        qs.append(qt); ks.append(kt); vs.append(vt)
+        if xv is not None:
+            qv, kv, vv = _qkv(und, cfg, xv, cos_v, sin_v)
+            qs.append(qv); ks.append(kv); vs.append(vv)
+        if xi is not None:
             gen = layer["gen"]
-            qt, kt, vt = _qkv(und, cfg, xt, cos_t, sin_t)
             qi, ki, vi = _qkv(gen, cfg, xi, cos_i, sin_i)
-            q = jnp.concatenate([qt, qi], axis=1)
-            k = jnp.concatenate([kt, ki], axis=1)
-            v = jnp.concatenate([vt, vi], axis=1)
+            qs.append(qi); ks.append(ki); vs.append(vi)
+        q = jnp.concatenate(qs, axis=1) if len(qs) > 1 else qs[0]
+        k = jnp.concatenate(ks, axis=1) if len(ks) > 1 else ks[0]
+        v = jnp.concatenate(vs, axis=1) if len(vs) > 1 else vs[0]
         kvs.append((k, v))
         o = _attend(q, k, v, bias)
-        if xi is None:
-            xt = xt + nn.linear(und["o_proj"], o.reshape(b, s, -1))
-            xt = xt + _mlp(und, cfg, xt)
-        else:
-            xt = xt + nn.linear(und["o_proj"],
-                                o[:, :s].reshape(b, s, -1))
-            xt = xt + _mlp(und, cfg, xt)
+        xt = xt + nn.linear(und["o_proj"], o[:, :s].reshape(b, s, -1))
+        xt = xt + _mlp(und, cfg, xt)
+        if xv is not None:
+            xv = xv + nn.linear(und["o_proj"],
+                                o[:, s:s + s_vit].reshape(b, s_vit, -1))
+            xv = xv + _mlp(und, cfg, xv)
+        if xi is not None:
             xi = xi + nn.linear(gen["o_proj"],
-                                o[:, s:].reshape(b, s_all - s, -1))
+                                o[:, s + s_vit:].reshape(
+                                    b, s_all - s - s_vit, -1))
             xi = xi + _mlp(gen, cfg, xi)
     return kvs, tok_mask
 
@@ -310,6 +351,31 @@ class BagelPipeline:
         self._prefill_img_jit = jax.jit(
             lambda p, ids, mask, img: prefill_context(
                 p, self.cfg.llm, ids, mask, img_tokens=img))
+        self._prefill_vit_jit = jax.jit(
+            lambda p, ids, mask, vit: prefill_context(
+                p, self.cfg.llm, ids, mask, vit_tokens=vit))
+        self._prefill_img_vit_jit = jax.jit(
+            lambda p, ids, mask, img, vit: prefill_context(
+                p, self.cfg.llm, ids, mask, img_tokens=img,
+                vit_tokens=vit))
+        # SigLIP understanding tower (optional)
+        self.vit_params = None
+        if config.vit is not None:
+            from vllm_omni_tpu.models.common import siglip
+
+            kv1, kv2, kv3 = jax.random.split(
+                jax.random.fold_in(k3, 7), 3)
+            h = config.llm.hidden_size
+            self.vit_params = self.wiring.place(
+                siglip.init_params(kv1, config.vit, dtype))
+            self.vit_connector = self.wiring.place({
+                "fc1": nn.linear_init(kv2, config.vit.hidden_size, h,
+                                      dtype=dtype),
+                "fc2": nn.linear_init(kv3, h, h, dtype=dtype),
+            })
+            # frozen 2D sincos table at LLM width (PositionEmbedding)
+            self.vit_pos_embed = jnp.asarray(siglip.sincos_2d_pos_embed(
+                h, config.vit_max_patch_per_side))
         self._img_ctx_jit = jax.jit(self._embed_image_context)
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
@@ -414,6 +480,39 @@ class BagelPipeline:
         return (nn.linear(params["vae2llm"], x) + temb[:, None, :]
                 + pos2d[None].astype(x.dtype))
 
+    def _vit_context(self, req, batch: int):
+        """sampling_params.image -> SigLIP understanding tokens
+        [B, S_vit, hidden] (prepare_vit_images semantics: patchify,
+        packed SigLIP, MLPconnector, frozen 2D sincos pos embed) or
+        None when no tower / no image."""
+        if self.vit_params is None:
+            return None
+        sp = req.sampling_params
+        image = sp.image if sp.image is not None else sp.extra.get(
+            "image")
+        if image is None:
+            return None
+        from vllm_omni_tpu.models.common import siglip
+
+        vcfg = self.cfg.vit
+        patch = vcfg.patch_size
+        max_side = self.cfg.vit_max_patch_per_side
+        h, w = np.asarray(image).shape[:2]
+        th = min(max_side * patch, max(patch, h // patch * patch))
+        tw = min(max_side * patch, max(patch, w // patch * patch))
+        img = intake.prepare_cond_image(image, th, tw)
+        toks = siglip.patchify(img.transpose(2, 0, 1), patch)
+        pos = siglip.flattened_position_ids_extrapolate(
+            th, tw, patch, max_side)
+        feats = siglip.forward_packed(
+            self.vit_params, vcfg, jnp.asarray(toks, self.dtype),
+            jnp.asarray(pos), [toks.shape[0]])
+        x = nn.linear(self.vit_connector["fc2"],
+                      jax.nn.gelu(nn.linear(self.vit_connector["fc1"],
+                                            feats), approximate=True))
+        x = x + self.vit_pos_embed[jnp.asarray(pos)].astype(x.dtype)
+        return jnp.repeat(x[None], batch, axis=0)
+
     def _context_ids(self, prompts: list[str]):
         ids, lens = self.tokenizer.batch_encode(prompts,
                                                 self.cfg.max_text_len)
@@ -442,13 +541,24 @@ class BagelPipeline:
 
         ids, mask = self._context_ids(prompts)
         img_tokens = self._image_context(req, b)
-        if img_tokens is None:
-            ctx_kvs, mask = self._prefill_jit(self.dit_params, ids, mask)
-        else:
-            # conditioning image(s): VAE latents join the context through
-            # vae2llm (forward_cache_update_vae semantics)
-            ctx_kvs, mask = self._prefill_img_jit(
-                self.dit_params, ids, mask, img_tokens)
+        vit_tokens = self._vit_context(req, b)
+
+        def prefill(text_mask):
+            # conditioning image(s): VAE latents join the context
+            # through vae2llm (forward_cache_update_vae); SigLIP
+            # understanding tokens ride the und expert
+            if img_tokens is None and vit_tokens is None:
+                return self._prefill_jit(self.dit_params, ids, text_mask)
+            if img_tokens is None:
+                return self._prefill_vit_jit(self.dit_params, ids,
+                                             text_mask, vit_tokens)
+            if vit_tokens is None:
+                return self._prefill_img_jit(self.dit_params, ids,
+                                             text_mask, img_tokens)
+            return self._prefill_img_vit_jit(
+                self.dit_params, ids, text_mask, img_tokens, vit_tokens)
+
+        ctx_kvs, mask = prefill(mask)
         # text-CFG branch: drop the TEXT, keep the conditioning image
         # (cfg_text semantics — the reference cfg_text branch holds the
         # image context constant and only blanks the prompt).  Without a
@@ -459,11 +569,10 @@ class BagelPipeline:
         # the "unconditional" branch through the image keys
         use_cfg = sp.guidance_scale > 1.0
         un_mask = jnp.zeros_like(mask)
-        if img_tokens is not None and use_cfg:
+        if (img_tokens is not None or vit_tokens is not None) and use_cfg:
             un_mask = un_mask.at[:, ids.shape[1]:].set(1)
-            uncond_kvs, _ = self._prefill_img_jit(
-                self.dit_params, ids, jnp.zeros_like(mask[:, :ids.shape[1]]),
-                img_tokens)
+            uncond_kvs, _ = prefill(
+                jnp.zeros_like(mask[:, :ids.shape[1]]))
         else:
             uncond_kvs = ctx_kvs
 

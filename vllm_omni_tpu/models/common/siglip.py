@@ -1,0 +1,242 @@
+"""SigLIP vision tower, packed-NaViT style (understanding input).
+
+Checkpoint-schema implementation of the transformers
+``SiglipVisionModel`` encoder as Bagel consumes it (reference:
+vllm_omni/diffusion/models/bagel/pipeline_bagel.py:121-149
+``SiglipNaViTWrapper``): the conv patch embedding is applied as a
+LINEAR over flattened patches, learned position embeddings are indexed
+by flattened (possibly extrapolated) position ids, and the pre-LN
+encoder runs over a PACKED multi-image sequence with a block-diagonal
+per-image mask.  The pooling head is not used (Bagel takes the packed
+last_hidden_state).
+
+Shared across understanding towers: Bagel's und input; the
+GLM-Image / Ovis understanding encoders are the same SigLIP family.
+
+TPU-first: one packed [N, D] sequence per batch (static shapes from
+bucketed packing), the per-image mask a static additive bias, exact
+GELU-tanh MLPs on the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import nn
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class SigLIPConfig:
+    hidden_size: int = 1152
+    num_layers: int = 27
+    num_heads: int = 16
+    intermediate_size: int = 4304
+    patch_size: int = 14
+    num_positions: int = 1024     # (image_size // patch)^2 table rows
+    num_channels: int = 3
+    eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size * self.patch_size
+
+    @staticmethod
+    def tiny() -> "SigLIPConfig":
+        return SigLIPConfig(hidden_size=32, num_layers=2, num_heads=4,
+                            intermediate_size=64, patch_size=14,
+                            num_positions=4)
+
+    @staticmethod
+    def from_hf(d: dict) -> "SigLIPConfig":
+        img = d.get("image_size", 448)
+        patch = d.get("patch_size", 14)
+        return SigLIPConfig(
+            hidden_size=d.get("hidden_size", 1152),
+            num_layers=d.get("num_hidden_layers", 27),
+            num_heads=d.get("num_attention_heads", 16),
+            intermediate_size=d.get("intermediate_size", 4304),
+            patch_size=patch,
+            num_positions=(img // patch) ** 2,
+            num_channels=d.get("num_channels", 3),
+            eps=d.get("layer_norm_eps", 1e-6),
+        )
+
+
+def init_params(key, cfg: SigLIPConfig, dtype=jnp.float32):
+    ki = iter(jax.random.split(key, 8 + 8 * cfg.num_layers))
+    h = cfg.hidden_size
+    p = {
+        "patch_embed": nn.linear_init(next(ki), cfg.patch_dim, h,
+                                      dtype=dtype),
+        "pos_embed": nn.embedding_init(next(ki), cfg.num_positions, h,
+                                       dtype),
+        "post_norm": nn.layernorm_init(h, dtype=dtype),
+        "layers": [],
+    }
+    for _ in range(cfg.num_layers):
+        p["layers"].append({
+            "norm1": nn.layernorm_init(h, dtype=dtype),
+            "q_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "k_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "v_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "out_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "norm2": nn.layernorm_init(h, dtype=dtype),
+            "fc1": nn.linear_init(next(ki), h, cfg.intermediate_size,
+                                  dtype=dtype),
+            "fc2": nn.linear_init(next(ki), cfg.intermediate_size, h,
+                                  dtype=dtype),
+        })
+    return p
+
+
+def patchify(image: np.ndarray, patch: int) -> np.ndarray:
+    """[C, H, W] -> [n_patches, C*patch*patch] (reference ``patchify``:
+    row-major patch grid, channel-first within a patch)."""
+    c, h, w = image.shape
+    ph, pw = h // patch, w // patch
+    x = image.reshape(c, ph, patch, pw, patch)
+    x = x.transpose(1, 3, 0, 2, 4).reshape(ph * pw, c * patch * patch)
+    return x
+
+
+def flattened_position_ids_extrapolate(img_h: int, img_w: int,
+                                       patch: int,
+                                       max_per_side: int) -> np.ndarray:
+    """Row/col ids into the max_per_side^2 table (reference
+    get_flattened_position_ids_extrapolate)."""
+    ph, pw = img_h // patch, img_w // patch
+    rows = np.arange(ph)[:, None] * max_per_side + np.arange(pw)[None, :]
+    return rows.reshape(-1)
+
+
+def forward_packed(params, cfg: SigLIPConfig, tokens, position_ids,
+                   seqlens):
+    """Packed NaViT forward.
+
+    tokens [N, patch_dim] flattened patches of all images; position_ids
+    [N] into the pos table; seqlens: python list/ints of per-image
+    token counts (static — drives the block-diagonal mask).  Returns
+    [N, hidden] post-layernormed features.
+    """
+    x = nn.linear(params["patch_embed"], tokens)
+    x = x + nn.embedding(params["pos_embed"], position_ids)
+    n = x.shape[0]
+    img_of = np.repeat(np.arange(len(seqlens)), seqlens)
+    assert img_of.shape[0] == n, (img_of.shape, n)
+    same = img_of[:, None] == img_of[None, :]
+    bias = jnp.where(jnp.asarray(same), 0.0, -1e30).astype(jnp.float32)
+
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    for lp in params["layers"]:
+        h = nn.layernorm(lp["norm1"], x, eps=cfg.eps)
+        q = nn.linear(lp["q_proj"], h).reshape(n, cfg.num_heads, -1)
+        k = nn.linear(lp["k_proj"], h).reshape(n, cfg.num_heads, -1)
+        v = nn.linear(lp["v_proj"], h).reshape(n, cfg.num_heads, -1)
+        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32),
+                       precision=jax.lax.Precision.HIGHEST) * scale
+        a = jax.nn.softmax(s + bias[None], axis=-1).astype(x.dtype)
+        o = jnp.einsum("hqk,khd->qhd", a, v,
+                       precision=jax.lax.Precision.HIGHEST)
+        x = x + nn.linear(lp["out_proj"], o.reshape(n, -1))
+        h = nn.layernorm(lp["norm2"], x, eps=cfg.eps)
+        h = nn.linear(lp["fc2"],
+                      jax.nn.gelu(nn.linear(lp["fc1"], h),
+                                  approximate=True))
+        x = x + h
+    return nn.layernorm(params["post_norm"], x, eps=cfg.eps)
+
+
+# ------------------------------------------------------- checkpoint load
+def hf_flat_map(cfg: SigLIPConfig,
+                prefix: str = "vit_model.vision_model.") -> dict:
+    m: dict[str, tuple] = {}
+    m[f"{prefix}embeddings.patch_embedding.weight"] = ("patch_embed", "w")
+    m[f"{prefix}embeddings.patch_embedding.bias"] = ("patch_embed", "b")
+    m[f"{prefix}embeddings.position_embedding.weight"] = \
+        ("pos_embed", "w")
+    m[f"{prefix}post_layernorm.weight"] = ("post_norm", "w")
+    m[f"{prefix}post_layernorm.bias"] = ("post_norm", "b")
+    for i in range(cfg.num_layers):
+        lp = f"{prefix}encoder.layers.{i}"
+        tgt = ("layers", i)
+        for hf, ours in (("layer_norm1", "norm1"),
+                         ("layer_norm2", "norm2"),
+                         ("self_attn.q_proj", "q_proj"),
+                         ("self_attn.k_proj", "k_proj"),
+                         ("self_attn.v_proj", "v_proj"),
+                         ("self_attn.out_proj", "out_proj"),
+                         ("mlp.fc1", "fc1"), ("mlp.fc2", "fc2")):
+            m[f"{lp}.{hf}.weight"] = tgt + (ours, "w")
+            m[f"{lp}.{hf}.bias"] = tgt + (ours, "b")
+    return m
+
+
+def hf_transform(name: str, arr):
+    """Conv2d patch embedding [out, C, p, p] -> linear [C*p*p, out]
+    (the NaViT wrapper flattens it the same way); linears [out, in] ->
+    [in, out]; the position table stays [n, hidden]."""
+    if arr.ndim == 4:
+        return arr.reshape(arr.shape[0], -1).T
+    if arr.ndim == 2 and name.endswith("weight") \
+            and "position_embedding" not in name:
+        return arr.T
+    return arr
+
+
+def load_siglip(model_dir: str, cfg: SigLIPConfig = None,
+                dtype=jnp.float32,
+                prefix: str = "vit_model.vision_model.",
+                hf_cfg: dict = None):
+    """Stream a SigLIP vision tower out of a (composite) checkpoint."""
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+
+    if cfg is None:
+        cfg = SigLIPConfig.from_hf(hf_cfg or {})
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    flat = hf_flat_map(cfg, prefix)
+    n, _ = load_checkpoint_tree(
+        model_dir, flat.get, tree, dtype=np.float32,
+        transform=hf_transform, name_filter=lambda nm: nm in flat,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if n != n_leaves:
+        raise ValueError(
+            f"{model_dir} covered {n}/{n_leaves} SigLIP weights")
+    tree = jax.tree.map(lambda a: jnp.asarray(a, dtype), tree)
+    return tree, cfg
+
+
+def sincos_2d_pos_embed(dim: int, side: int) -> np.ndarray:
+    """Frozen 2-D sin-cos table [side*side, dim] (reference
+    PositionEmbedding / get_2d_sincos_pos_embed)."""
+    def one_dim(d, pos):
+        omega = 1.0 / 10000 ** (np.arange(d // 2, dtype=np.float64)
+                                / (d / 2.0))
+        out = np.einsum("m,d->md", pos.reshape(-1), omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    grid_h = np.arange(side, dtype=np.float32)
+    grid_w = np.arange(side, dtype=np.float32)
+    grid = np.meshgrid(grid_w, grid_h)  # w first (reference)
+    grid = np.stack(grid, axis=0).reshape(2, side, side)
+    emb_h = one_dim(dim // 2, grid[0])
+    emb_w = one_dim(dim // 2, grid[1])
+    return np.concatenate([emb_h, emb_w],
+                          axis=1).astype(np.float32)
