@@ -24,9 +24,10 @@ of a 3-deep context stack instead of three sequential forwards.
 Conditioning images join the context as VAE-latent tokens projected
 through ``vae2llm`` (forward_cache_update_vae, :1019) — packed image
 tokens attend each other bidirectionally while text stays causal.
-Reduced scope vs the reference: the SigLIP ViT understanding tower is
-future work; text + VAE-image conditioning and the dual-branch CFG
-flow are in.
+Understanding input: the SigLIP NaViT tower (models/common/siglip.py)
+feeds the und expert through the MLP connector + frozen 2D sincos
+position table when ``BagelPipelineConfig.vit`` is set; text +
+VAE-image conditioning and the dual-branch CFG flow ride alongside.
 """
 
 from __future__ import annotations
@@ -221,8 +222,12 @@ def prefill_context(params, cfg: BagelConfig, token_ids: jax.Array,
         xi = img_tokens.astype(xt.dtype)
         tok_mask = jnp.concatenate(
             [tok_mask, jnp.ones((b, s_img), ctx_mask.dtype)], axis=1)
+        # the vit segment consumes ONE rope position (reference packs
+        # it at curr_position_id and advances by one) — image tokens
+        # continue right after, not s_vit later
+        rope_start = s + (1 if s_vit else 0)
         cos_i, sin_i = _rope(cfg, jnp.broadcast_to(
-            (s + s_vit + jnp.arange(s_img))[None], (b, s_img)))
+            (rope_start + jnp.arange(s_img))[None], (b, s_img)))
     causal = jnp.arange(s_all)[None, :] <= jnp.arange(s_all)[:, None]
     if vit_tokens is not None:
         vit_zone = ((jnp.arange(s_all) >= s)
@@ -376,6 +381,25 @@ class BagelPipeline:
             # frozen 2D sincos table at LLM width (PositionEmbedding)
             self.vit_pos_embed = jnp.asarray(siglip.sincos_2d_pos_embed(
                 h, config.vit_max_patch_per_side))
+            # the flattened ids index row*max_side+col into the SigLIP
+            # learned table — a too-small table would silently clamp
+            # (real Bagel checkpoints interpolate the table to the
+            # max_side grid at load time)
+            need = config.vit_max_patch_per_side ** 2
+            if config.vit.num_positions < need:
+                raise ValueError(
+                    f"SigLIP pos table ({config.vit.num_positions} rows)"
+                    f" smaller than vit_max_patch_per_side^2 ({need}) — "
+                    "interpolate the table or lower the grid")
+
+            def _vit_fwd(vp, cp, toks, pos):
+                feats = siglip.forward_packed(
+                    vp, config.vit, toks, pos, [toks.shape[0]])
+                x = nn.linear(cp["fc2"], jax.nn.gelu(
+                    nn.linear(cp["fc1"], feats), approximate=True))
+                return x + self.vit_pos_embed[pos].astype(x.dtype)
+
+            self._vit_fwd_jit = jax.jit(_vit_fwd)
         self._img_ctx_jit = jax.jit(self._embed_image_context)
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
@@ -425,15 +449,23 @@ class BagelPipeline:
         self._denoise_cache[key] = run
         return run
 
+    @staticmethod
+    def _cond_image(req):
+        """The request's conditioning image (sampling_params.image with
+        the extra["image"] fallback) — ONE retrieval convention shared
+        by the VAE and ViT intake paths."""
+        sp = req.sampling_params
+        return sp.image if sp.image is not None else sp.extra.get(
+            "image")
+
     def _image_context(self, req, batch: int):
         """sampling_params.image -> vae2llm-projected context tokens
         [B, S_img, hidden] (prepare_vae_images, pipeline_bagel.py:393)
         or None."""
-        sp = req.sampling_params
-        image = sp.image if sp.image is not None else sp.extra.get(
-            "image")
+        image = self._cond_image(req)
         if image is None:
             return None
+        sp = req.sampling_params
         cfg = self.cfg
         mult = self.geometry_multiple
         max_hw = cfg.llm.max_latent_size * cfg.vae.spatial_ratio
@@ -487,15 +519,12 @@ class BagelPipeline:
         None when no tower / no image."""
         if self.vit_params is None:
             return None
-        sp = req.sampling_params
-        image = sp.image if sp.image is not None else sp.extra.get(
-            "image")
+        image = self._cond_image(req)
         if image is None:
             return None
         from vllm_omni_tpu.models.common import siglip
 
-        vcfg = self.cfg.vit
-        patch = vcfg.patch_size
+        patch = self.cfg.vit.patch_size
         max_side = self.cfg.vit_max_patch_per_side
         h, w = np.asarray(image).shape[:2]
         th = min(max_side * patch, max(patch, h // patch * patch))
@@ -504,13 +533,9 @@ class BagelPipeline:
         toks = siglip.patchify(img.transpose(2, 0, 1), patch)
         pos = siglip.flattened_position_ids_extrapolate(
             th, tw, patch, max_side)
-        feats = siglip.forward_packed(
-            self.vit_params, vcfg, jnp.asarray(toks, self.dtype),
-            jnp.asarray(pos), [toks.shape[0]])
-        x = nn.linear(self.vit_connector["fc2"],
-                      jax.nn.gelu(nn.linear(self.vit_connector["fc1"],
-                                            feats), approximate=True))
-        x = x + self.vit_pos_embed[jnp.asarray(pos)].astype(x.dtype)
+        x = self._vit_fwd_jit(self.vit_params, self.vit_connector,
+                              jnp.asarray(toks, self.dtype),
+                              jnp.asarray(pos))
         return jnp.repeat(x[None], batch, axis=0)
 
     def _context_ids(self, prompts: list[str]):
