@@ -37,13 +37,28 @@ _PRECISION = jax.lax.Precision.HIGHEST
 
 @dataclass(frozen=True)
 class BigVGANConfig:
-    """Mirrors transformers ``Qwen2_5OmniBigVGANConfig``."""
+    """Mirrors transformers ``Qwen2_5OmniBigVGANConfig``; the
+    ``tts_v1`` variant covers the Qwen3-TTS 25 Hz tokenizer's BigVGAN
+    (reference modeling_qwen3_tts_tokenizer_v1.py:865-1071): conv stem
+    kernel 5, and CHAINED AMP blocks — causal convs1, the first two
+    upsample stages add a pre-conv + pre-activation and causal convs2,
+    with per-unit outputs accumulating onto the block input."""
     mel_dim: int = 80
     upsample_initial_channel: int = 1536
     resblock_kernel_sizes: tuple = (3, 7, 11)
     resblock_dilation_sizes: tuple = ((1, 3, 5), (1, 3, 5), (1, 3, 5))
     upsample_rates: tuple = (5, 3, 2, 2, 2, 2)
     upsample_kernel_sizes: tuple = (11, 7, 4, 4, 4, 4)
+    variant: str = "qwen2_5"      # | "tts_v1"
+
+    @property
+    def conv_pre_kernel(self) -> int:
+        return 5 if self.variant == "tts_v1" else 7
+
+    def causal_type(self, layer_idx: int) -> str:
+        """V1 AMP flavour per upsample stage ("2" adds pre conv/act and
+        causal convs2)."""
+        return "2" if layer_idx <= 1 else "1"
 
     @property
     def total_upsample(self) -> int:
@@ -58,8 +73,9 @@ class BigVGANConfig:
         )
 
     @staticmethod
-    def from_hf(d: dict) -> "BigVGANConfig":
+    def from_hf(d: dict, variant: str = "qwen2_5") -> "BigVGANConfig":
         return BigVGANConfig(
+            variant=variant,
             mel_dim=d.get("mel_dim", 80),
             upsample_initial_channel=d.get("upsample_initial_channel",
                                            1536),
@@ -176,12 +192,47 @@ def _amp_block(p, x, k: int, dilations):
     return x
 
 
+def _causal_conv(p, x, k: int, dilation: int = 1):
+    """Left-pad-only conv (V1 CausalConv1d)."""
+    pad = dilation * (k - 1)
+    y = jax.lax.conv_general_dilated(
+        jnp.pad(x, ((0, 0), (pad, 0), (0, 0))),
+        p["w"].astype(x.dtype), window_strides=(1,), padding="VALID",
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"), precision=_PRECISION)
+    return y + p["b"].astype(x.dtype)
+
+
+def _amp_block_v1(p, x, k: int, dilations, causal_type: str):
+    """V1 chained AMPBlock (modeling_qwen3_tts_tokenizer_v1.py:979-991):
+    hidden CHAINS through the units while each unit's output accumulates
+    onto the block input; convs1 causal, convs2 causal only for
+    causal_type "2", which also runs a pre conv + pre aa-snake."""
+    acts = p["acts"]
+    if causal_type == "2":
+        h = _conv(p["pre_conv"], x, k, (k - 1) // 2)
+        h = _aa_snake(p["pre_act"], h)
+    else:
+        h = x
+    for i, d in enumerate(dilations):
+        h = _aa_snake(acts[2 * i], h)
+        h = _causal_conv(p["convs1"][i], h, k, dilation=d)
+        h = _aa_snake(acts[2 * i + 1], h)
+        if causal_type == "2":
+            h = _causal_conv(p["convs2"][i], h, k)
+        else:
+            h = _conv(p["convs2"][i], h, k, (k - 1) // 2)
+        x = x + h
+    return x
+
+
 def init_params(key, cfg: BigVGANConfig, dtype=jnp.float32):
     from vllm_omni_tpu.models.common import nn
 
     ki = iter(jax.random.split(key, 256))
     c0 = cfg.upsample_initial_channel
-    p = {"conv_pre": {"w": nn.conv1d_init(next(ki), cfg.mel_dim, c0, 7,
+    kp = cfg.conv_pre_kernel
+    p = {"conv_pre": {"w": nn.conv1d_init(next(ki), cfg.mel_dim, c0, kp,
                                           dtype=dtype)["w"],
                       "b": jnp.zeros((c0,), dtype)},
          "ups": [], "resblocks": []}
@@ -192,6 +243,12 @@ def init_params(key, cfg: BigVGANConfig, dtype=jnp.float32):
         for ks, dils in zip(cfg.resblock_kernel_sizes,
                             cfg.resblock_dilation_sizes):
             blk = {"convs1": [], "convs2": [], "acts": []}
+            if cfg.variant == "tts_v1" and cfg.causal_type(i) == "2":
+                blk["pre_conv"] = {
+                    "w": nn.conv1d_init(next(ki), cout, cout, ks,
+                                        dtype=dtype)["w"],
+                    "b": jnp.zeros((cout,), dtype)}
+                blk["pre_act"] = vk.snake_init(cout, dtype)
             for d in dils:
                 blk["convs1"].append(
                     {"w": nn.conv1d_init(next(ki), cout, cout, ks,
@@ -224,7 +281,8 @@ def process_mel(mel):
 def forward(params, cfg: BigVGANConfig, mel):
     """mel [B, T, mel_dim] (log scale) -> waveform [B, T*upsample]."""
     x = process_mel(mel).astype(mel.dtype)
-    x = _conv(params["conv_pre"], x, 7, 3)
+    kp = cfg.conv_pre_kernel
+    x = _conv(params["conv_pre"], x, kp, (kp - 1) // 2)
     n_res = len(cfg.resblock_kernel_sizes)
     for i, (r, k) in enumerate(zip(cfg.upsample_rates,
                                    cfg.upsample_kernel_sizes)):
@@ -240,8 +298,12 @@ def forward(params, cfg: BigVGANConfig, mel):
         acc = 0.0
         for j, (ks, dils) in enumerate(zip(cfg.resblock_kernel_sizes,
                                            cfg.resblock_dilation_sizes)):
-            acc = acc + _amp_block(params["resblocks"][i * n_res + j],
-                                   x, ks, dils)
+            blk = params["resblocks"][i * n_res + j]
+            if cfg.variant == "tts_v1":
+                acc = acc + _amp_block_v1(blk, x, ks, dils,
+                                          cfg.causal_type(i))
+            else:
+                acc = acc + _amp_block(blk, x, ks, dils)
         x = acc / n_res
     x = _aa_snake(params["act_post"], x)
     x = _conv(params["conv_post"], x, 7, 3)
@@ -262,6 +324,11 @@ def hf_flat_map(cfg: BigVGANConfig,
                                   for q in range(n_res)]):
             rb = f"{prefix}resblocks.{i * n_res + j}"
             tgt = ("resblocks", i * n_res + j)
+            if cfg.variant == "tts_v1" and cfg.causal_type(i) == "2":
+                m[f"{rb}.pre_conv.weight"] = tgt + ("pre_conv", "w")
+                m[f"{rb}.pre_conv.bias"] = tgt + ("pre_conv", "b")
+                m[f"{rb}.pre_act.act.alpha"] = tgt + ("pre_act", "alpha")
+                m[f"{rb}.pre_act.act.beta"] = tgt + ("pre_act", "beta")
             for di in range(len(dils)):
                 for cv in ("convs1", "convs2"):
                     m[f"{rb}.{cv}.{di}.weight"] = tgt + (cv, di, "w")

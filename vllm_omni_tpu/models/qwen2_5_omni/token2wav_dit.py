@@ -64,6 +64,9 @@ class T2WDiTConfig:
     enc_res2net_scale: int = 2
     enc_se_channels: int = 64
     freq_embed_dim: int = 256
+    # the 2.5-Omni checkpoint rotates only head 0 (training quirk); the
+    # Qwen3-TTS 25 Hz V1 decoder rotates every head
+    rope_all_heads: bool = False
 
     @staticmethod
     def tiny() -> "T2WDiTConfig":
@@ -78,8 +81,9 @@ class T2WDiTConfig:
         )
 
     @staticmethod
-    def from_hf(d: dict) -> "T2WDiTConfig":
+    def from_hf(d: dict, rope_all_heads: bool = False) -> "T2WDiTConfig":
         return T2WDiTConfig(
+            rope_all_heads=rope_all_heads,
             hidden_size=d.get("hidden_size", 1024),
             num_layers=d.get("num_hidden_layers", 22),
             num_heads=d.get("num_attention_heads", 16),
@@ -202,8 +206,8 @@ def _sinus_time_embed(t, dim: int):
 
 
 def _rope_first_head(q, k, cfg: T2WDiTConfig):
-    """Rotary on head 0 only (reference quirk), duplicated-pair freq
-    layout with rotate-half application."""
+    """Rotary on head 0 only (reference quirk) — or all heads for the
+    V1 decoder — duplicated-pair freq layout, interleaved rotation."""
     t = q.shape[2]
     half = cfg.head_dim // 2
     inv = 1.0 / (cfg.rope_theta
@@ -220,8 +224,11 @@ def _rope_first_head(q, k, cfg: T2WDiTConfig):
                          axis=-1).reshape(x.shape)
 
     def apply(x):
-        h0 = x[:, :1].astype(jnp.float32)
+        n = x.shape[1] if cfg.rope_all_heads else 1
+        h0 = x[:, :n].astype(jnp.float32)
         h0 = h0 * cos[None, None] + rot_pairs(h0) * sin[None, None]
+        if cfg.rope_all_heads:
+            return h0.astype(x.dtype)
         return jnp.concatenate([h0.astype(x.dtype), x[:, 1:]], axis=1)
 
     return apply(q), apply(k)
@@ -322,8 +329,11 @@ def embed_code(params, cfg: T2WDiTConfig, code, drop: bool = False):
 
 def sample(params, cfg: T2WDiTConfig, code, ref_mel, spk_embedding,
            num_steps: int = 10, guidance_scale: float = 0.5,
-           sway_coefficient: float = -1.0, initial_noise=None):
-    """Flow-matching RK4 integration -> mel [B, T, mel_dim].
+           sway_coefficient: float = -1.0, initial_noise=None,
+           solver: str = "rk4"):
+    """Flow-matching integration -> mel [B, T, mel_dim] (RK4 for the
+    2.5-Omni token2wav; plain Euler for the 25 Hz V1 decoder, whose
+    reference sample loop steps x <- x + v dt).
 
     code [B, Tc]; ref_mel [B, Tref, mel] (speaker reference audio);
     spk_embedding [B, enc_emb_dim] (per-voice vector).  Deterministic
@@ -365,21 +375,25 @@ def sample(params, cfg: T2WDiTConfig, code, ref_mel, spk_embedding,
     if sway_coefficient is not None:
         ts = ts + sway_coefficient * (jnp.cos(jnp.pi / 2 * ts) - 1 + ts)
 
+    def f(t_scalar, yy):
+        return velocity(yy, jnp.broadcast_to(t_scalar, (b,)))
+
     def rk4_step(y, tt):
         t0, t1 = tt
         h = t1 - t0
-
-        def f(t_scalar, yy):
-            return velocity(yy, jnp.broadcast_to(t_scalar, (b,)))
-
         k1 = f(t0, y)
         k2 = f(t0 + h / 3, y + h * k1 / 3)
         k3 = f(t0 + h * 2 / 3, y + h * (k2 - k1 / 3))
         k4 = f(t1, y + h * (k1 - k2 + k3))
         return y + (k1 + 3 * (k2 + k3) + k4) * h / 8, None
 
+    def euler_step(y, tt):
+        t0, t1 = tt
+        return y + f(t0, y) * (t1 - t0), None
+
     pairs = jnp.stack([ts[:-1], ts[1:]], axis=1)
-    state, _ = jax.lax.scan(rk4_step, state, pairs)
+    step = euler_step if solver == "euler" else rk4_step
+    state, _ = jax.lax.scan(step, state, pairs)
     return state
 
 
@@ -562,12 +576,13 @@ class Token2WavRealModel:
 
     def __init__(self, dit_cfg: T2WDiTConfig, bv_cfg, num_steps: int = 10,
                  guidance_scale: float = 0.5,
-                 sway_coefficient: float = -1.0):
+                 sway_coefficient: float = -1.0, solver: str = "rk4"):
         self.cfg = dit_cfg
         self.bv_cfg = bv_cfg
         self.num_steps = num_steps
         self.guidance_scale = guidance_scale
         self.sway = sway_coefficient
+        self.solver = solver
 
     def forward(self, params, token_ids, lengths):
         from vllm_omni_tpu.models.qwen2_5_omni import bigvgan as bv
@@ -581,7 +596,7 @@ class Token2WavRealModel:
         mel = sample(params["dit"], cfg, code, ref_mel, spk,
                      num_steps=self.num_steps,
                      guidance_scale=self.guidance_scale,
-                     sway_coefficient=self.sway,
+                     sway_coefficient=self.sway, solver=self.solver,
                      initial_noise=jax.random.normal(
                          jax.random.PRNGKey(0),
                          (b, code.shape[1] * cfg.repeats, cfg.mel_dim)))

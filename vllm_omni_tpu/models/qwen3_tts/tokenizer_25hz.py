@@ -1,69 +1,141 @@
 """Qwen3-TTS 25 Hz speech tokenizer (V1) — decode path.
 
 Reference: vllm_omni/model_executor/models/qwen3_tts/tokenizer_25hz/
-modeling_qwen3_tts_tokenizer_v1.py — the V1 codec decodes 25 Hz codes to
-waveform through a flow-matching mel DiT (DiTDecoderLayer stack with
-AdaLayerNormZero conditioning + DiTCodecEmbedding) followed by a
-Snake-activated BigVGAN-style vocoder, with an ECAPA-TDNN speaker
-encoder for voice conditioning.
+modeling_qwen3_tts_tokenizer_v1.py — the V1 codec decodes 25 Hz codes
+to waveform through the SAME architecture family as the Qwen2.5-Omni
+token2wav stage, with three deltas this module configures on the shared
+checkpoint-schema stack (models/qwen2_5_omni/{token2wav_dit,bigvgan}):
 
-That is the SAME architecture family as this repo's Qwen2.5-Omni
-token2wav stage (models/qwen2_5_omni/token2wav.py: flow-matching mel DiT
-+ transposed-conv vocoder), so the V1 decoder composes those shared
-pieces at the 25 Hz geometry instead of duplicating them — codes embed
-into the DiT's conditioning stream, the ODE integrates mel frames, and
-the vocoder renders 24 kHz audio.  Reduced depth vs the reference's
-ECAPA speaker path (speaker embeddings ride the conditioning vector when
-provided; the ECAPA encoder itself is future work at real-weight time).
+- the DiT rotates EVERY attention head (the 2.5-Omni checkpoint rotates
+  only head 0),
+- sampling is plain Euler over the sway-warped grid (V1 sample loop,
+  :1174-1232) instead of RK4,
+- the BigVGAN is the ``tts_v1`` variant: conv stem kernel 5 and chained
+  AMP blocks with causal convs (+pre conv/act on the first two stages).
+
+Checkpoint layout: ``decoder.dit.*`` / ``decoder.bigvgan.*`` under a
+``Qwen3TTSTokenizerV1Model``; the ENCODER half (waveform -> codes) is a
+separate model the serving path does not need for synthesis.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from vllm_omni_tpu.models.qwen2_5_omni.token2wav import (
-    Token2WavConfig,
-    Token2WavModel,
-    init_token2wav_params,
-)
+from vllm_omni_tpu.models.qwen2_5_omni import bigvgan as bv
+from vllm_omni_tpu.models.qwen2_5_omni import token2wav_dit as t2w
 
 
 @dataclass(frozen=True)
 class Tokenizer25HzConfig:
-    """V1 geometry knobs mapped onto the shared token2wav stack
-    (reference defaults: 22-layer / 1024-hidden DiT, 16 heads,
-    mel 80, 24 kHz out)."""
-    codebook_size: int = 4096
-    frame_rate: int = 25
+    """V1 decoder geometry over the shared token2wav stack."""
+    dit: t2w.T2WDiTConfig = field(
+        default_factory=lambda: t2w.T2WDiTConfig(rope_all_heads=True))
+    bigvgan: bv.BigVGANConfig = field(
+        default_factory=lambda: bv.BigVGANConfig(variant="tts_v1"))
+    # authoritative values come from the checkpoint's decoder_config
+    # (output_sample_rate / decode_upsample_rate); the reference class
+    # DEFAULTS are mutually inconsistent (decode_upsample_rate=1920 vs
+    # a 2x240 network), so real geometry must be read, not assumed
     output_sample_rate: int = 24000
-    dit_hidden: int = 1024
-    dit_layers: int = 22
-    dit_heads: int = 16
-    n_mels: int = 80
+    num_steps: int = 10
+    guidance_scale: float = 0.5
 
-    def token2wav(self) -> Token2WavConfig:
-        return Token2WavConfig(
-            codec_vocab=self.codebook_size,
-            d_model=self.dit_hidden,
-            num_layers=self.dit_layers,
-            num_heads=self.dit_heads,
-            mel_bins=self.n_mels,
-        )
+    @property
+    def codebook_size(self) -> int:
+        return self.dit.num_embeds
+
+    @property
+    def total_upsample(self) -> int:
+        """Waveform samples per codec frame — derived from the actual
+        network geometry (repeats x BigVGAN upsample product)."""
+        return self.dit.repeats * self.bigvgan.total_upsample
 
     @staticmethod
     def tiny() -> "Tokenizer25HzConfig":
-        return Tokenizer25HzConfig(
-            codebook_size=60, dit_hidden=32, dit_layers=2, dit_heads=4,
-            n_mels=8,
-        )
+        dit = t2w.T2WDiTConfig(
+            hidden_size=32, num_layers=2, num_heads=2, head_dim=8,
+            emb_dim=12, num_embeds=60, mel_dim=8, block_size=4,
+            look_ahead_layers=(1,), look_backward_layers=(0,),
+            enc_dim=10, enc_emb_dim=6, enc_channels=(8, 8, 8, 8, 24),
+            enc_kernel_sizes=(5, 3, 3, 3, 1),
+            enc_dilations=(1, 2, 3, 4, 1), enc_attention_channels=4,
+            enc_res2net_scale=2, enc_se_channels=4,
+            rope_all_heads=True)
+        vgan = bv.BigVGANConfig(
+            variant="tts_v1", mel_dim=8, upsample_initial_channel=16,
+            resblock_kernel_sizes=(3,),
+            resblock_dilation_sizes=((1, 3, 5),),
+            upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4))
+        return Tokenizer25HzConfig(dit=dit, bigvgan=vgan, num_steps=2)
+
+
+class Tokenizer25HzDecoderModel(t2w.Token2WavRealModel):
+    """Generation-runner model protocol: V1 codec ids -> waveform.  The
+    shared Token2WavRealModel does the work; V1 just pins the Euler
+    solver and carries the composed config."""
+
+    def __init__(self, cfg: Tokenizer25HzConfig):
+        super().__init__(cfg.dit, cfg.bigvgan, num_steps=cfg.num_steps,
+                         guidance_scale=cfg.guidance_scale,
+                         solver="euler")
+        self.tokenizer_cfg = cfg
+
+    @property
+    def total_upsample(self) -> int:
+        return self.tokenizer_cfg.total_upsample
 
 
 def tiny_decoder_factory():
     """model_factory for a 25Hz code2wav stage: (params, model, eos)."""
-    t2w_cfg = Token2WavConfig.tiny()
-    params = init_token2wav_params(jax.random.PRNGKey(25), t2w_cfg,
-                                   jnp.float32)
-    return params, Token2WavModel(t2w_cfg), None
+    cfg = Tokenizer25HzConfig.tiny()
+    params = {
+        "dit": t2w.init_params(jax.random.PRNGKey(25), cfg.dit,
+                               jnp.float32),
+        "bigvgan": bv.init_params(jax.random.PRNGKey(26), cfg.bigvgan,
+                                  jnp.float32),
+    }
+    return params, Tokenizer25HzDecoderModel(cfg), None
+
+
+# ------------------------------------------------------- checkpoint load
+def load_decoder(model_dir: str, dtype=jnp.float32,
+                 num_steps: int = 10, guidance_scale: float = 0.5):
+    """Stream the ``decoder.{dit,bigvgan}.*`` halves of a
+    Qwen3TTSTokenizerV1 checkpoint; returns (params, model, eos) — the
+    model_factory contract."""
+    import json
+    import os
+
+    d = {}
+    cfg_path = os.path.join(model_dir, "config.json")
+    if os.path.isfile(cfg_path):
+        with open(cfg_path) as f:
+            d = json.load(f).get("decoder_config", {})
+    dit_cfg = t2w.T2WDiTConfig.from_hf(d.get("dit_config", {}),
+                                       rope_all_heads=True)
+    bv_cfg = bv.BigVGANConfig.from_hf(d.get("bigvgan_config", {}),
+                                      variant="tts_v1")
+    dit_params, _ = t2w.load_dit(model_dir, cfg=dit_cfg, dtype=dtype,
+                                 prefix="decoder.dit.")
+    bv_params, _ = bv.load_bigvgan(model_dir, cfg=bv_cfg, dtype=dtype,
+                                   prefix="decoder.bigvgan.")
+    cfg = Tokenizer25HzConfig(dit=dit_cfg, bigvgan=bv_cfg,
+                              output_sample_rate=d.get(
+                                  "output_sample_rate", 24000),
+                              num_steps=num_steps,
+                              guidance_scale=guidance_scale)
+    declared = d.get("decode_upsample_rate")
+    if declared and declared != cfg.total_upsample:
+        import warnings
+
+        warnings.warn(
+            f"decoder_config declares decode_upsample_rate={declared} "
+            f"but the network geometry yields {cfg.total_upsample} "
+            "samples/code — trusting the network", stacklevel=2)
+    return ({"dit": dit_params, "bigvgan": bv_params},
+            Tokenizer25HzDecoderModel(cfg), None)
