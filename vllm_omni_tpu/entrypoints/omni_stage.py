@@ -118,6 +118,16 @@ class OmniStage:
                 factory = _import_obj(factory)
             factory_args = args.pop("model_factory_args", {}) or {}
             params, model_cfg, eos = factory(**factory_args)
+            # voice registry: engine_args.voices maps name -> conditioning
+            # assets (speaker_embedding / reference_mel); the serving
+            # layer advertises the names (/v1/audio/voices) and vocoder
+            # models resolve them per request (batch_conditioning)
+            voices = args.get("voices")
+            if isinstance(voices, dict) and hasattr(model_cfg, "voices"):
+                model_cfg.voices = {
+                    name: entry for name, entry in voices.items()
+                    if isinstance(entry, dict)
+                }
             # multimodal front end (thinker stages): factory builds the
             # encoder+placeholder processor around the model's embed table
             # (reference: Qwen3OmniMoeThinkerMultiModalProcessor)

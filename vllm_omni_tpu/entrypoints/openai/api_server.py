@@ -648,7 +648,14 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         if not text:
             return self._error(400, "input required")
         rid = f"speech-{uuid.uuid4().hex[:16]}"
-        outs = self.state.collect(text, {}, rid)
+        # a named voice rides additional_information to the vocoder
+        # stage, which resolves it through its voice registry
+        # (reference: speech request voice -> speaker assets)
+        voice = body.get("voice")
+        prompt = ({"prompt": text,
+                   "additional_information": {"voice": voice}}
+                  if voice else text)
+        outs = self.state.collect(prompt, {}, rid)
         if self._surface_error(outs):
             return
         audio = next(

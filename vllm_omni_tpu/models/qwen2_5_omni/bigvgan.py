@@ -118,9 +118,12 @@ def kaiser_sinc_filter(cutoff: float, half_width: float,
 
 
 def _aa_filters(ratio: int = 2, kernel_size: int = 12):
+    # HOST numpy constants: caching jnp arrays here would capture a
+    # tracer when the first call happens inside a jit trace and leak it
+    # into later traces (UnexpectedTracerError)
     up = kaiser_sinc_filter(0.5 / ratio, 0.6 / ratio, kernel_size)
     down = kaiser_sinc_filter(0.5 / ratio, 0.6 / ratio, kernel_size)
-    return jnp.asarray(up), jnp.asarray(down)
+    return up, down
 
 
 _UP_FILTER, _DOWN_FILTER = None, None

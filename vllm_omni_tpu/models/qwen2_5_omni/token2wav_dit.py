@@ -569,29 +569,84 @@ def load_dit(model_dir: str, cfg: T2WDiTConfig = None, dtype=jnp.float32,
 # --------------------------------------------------- stage integration
 class Token2WavRealModel:
     """Generation-runner model protocol over the checkpoint-schema
-    stack: talker codec ids -> RK4 flow-matched mel -> BigVGAN
-    waveform.  Voice conditioning (speaker embedding + reference mel)
-    defaults to neutral zeros when the request carries none — the
-    reference looks both up from its voice registry per request."""
+    stack: talker codec ids -> flow-matched mel -> BigVGAN waveform.
+
+    Voice conditioning rides the generation runner's conditioning hook
+    (``batch_conditioning``): requests may carry a named ``voice``
+    (resolved through the ``voices`` registry — the reference keeps
+    speaker embedding + reference mel per speaker) or raw
+    ``speaker_embedding`` / ``reference_mel`` arrays in
+    additional_information; anything absent falls back to neutral
+    zeros."""
+
+    REF_MEL_FRAMES = 8  # bucketed reference-mel length (resized into)
 
     def __init__(self, dit_cfg: T2WDiTConfig, bv_cfg, num_steps: int = 10,
                  guidance_scale: float = 0.5,
-                 sway_coefficient: float = -1.0, solver: str = "rk4"):
+                 sway_coefficient: float = -1.0, solver: str = "rk4",
+                 voices: dict = None):
         self.cfg = dit_cfg
         self.bv_cfg = bv_cfg
         self.num_steps = num_steps
         self.guidance_scale = guidance_scale
         self.sway = sway_coefficient
         self.solver = solver
+        self.voices = voices or {}
 
-    def forward(self, params, token_ids, lengths):
+    def batch_conditioning(self, requests, batch: int):
+        """[B]-stacked (spk [B, enc_emb], ref_mel [B, F, mel]) from the
+        requests' additional_information; None when every row is
+        unconditioned (keeps the cond-free jit specialization hot)."""
+        cfg = self.cfg
+        f = self.REF_MEL_FRAMES
+        spk = np.zeros((batch, cfg.enc_emb_dim), np.float32)
+        ref = np.zeros((batch, f, cfg.mel_dim), np.float32)
+        any_cond = False
+        for i, req in enumerate(requests):
+            info = getattr(req, "additional_information", None) or {}
+            v = info.get("voice")
+            if v is not None and v in self.voices:
+                entry = self.voices[v]
+                info = {**info, **entry}
+            # malformed per-request assets must not take down the whole
+            # batch (a poll exception kills every in-flight request) —
+            # degrade that row to the neutral voice with a warning
+            try:
+                se = info.get("speaker_embedding")
+                if se is not None:
+                    se = np.asarray(se, np.float32).reshape(-1)
+                    n = min(cfg.enc_emb_dim, se.shape[0])
+                    spk[i, :n] = se[:n]
+                    any_cond = True
+                rm = info.get("reference_mel")
+                if rm is not None:
+                    rm = np.atleast_2d(np.asarray(rm, np.float32))
+                    n = min(f, rm.shape[0])
+                    m = min(cfg.mel_dim, rm.shape[1])
+                    ref[i, :n, :m] = rm[:n, :m]
+                    any_cond = True
+            except Exception as e:
+                logger.warning(
+                    "request %s: malformed voice conditioning (%s) — "
+                    "using the neutral voice",
+                    getattr(req, "request_id", "?"), e)
+        if not any_cond:
+            return None
+        return {"spk": jnp.asarray(spk), "ref_mel": jnp.asarray(ref)}
+
+    def forward(self, params, token_ids, lengths, cond=None):
         from vllm_omni_tpu.models.qwen2_5_omni import bigvgan as bv
 
         del lengths
         b = token_ids.shape[0]
         cfg = self.cfg
-        ref_mel = jnp.zeros((b, 8, cfg.mel_dim), jnp.float32)
-        spk = jnp.zeros((b, cfg.enc_emb_dim), jnp.float32)
+        if cond is not None:
+            ref_mel = cond["ref_mel"]
+            spk = cond["spk"]
+        else:
+            ref_mel = jnp.zeros((b, self.REF_MEL_FRAMES, cfg.mel_dim),
+                                jnp.float32)
+            spk = jnp.zeros((b, cfg.enc_emb_dim), jnp.float32)
         code = jnp.clip(token_ids, 0, cfg.num_embeds - 1)
         mel = sample(params["dit"], cfg, code, ref_mel, spk,
                      num_steps=self.num_steps,

@@ -24,29 +24,47 @@ def thinker_to_talker(config, upstream_outputs) -> list[StageRequest]:
             # token-bridging so the pipeline still flows
             toks = out.outputs[0].token_ids if out.outputs else []
             reqs.append(StageRequest(request_id=out.request_id,
-                                     prompt_token_ids=list(toks)))
+                                     prompt_token_ids=list(toks),
+                                     additional_information=voice_info(
+                                         out)))
             continue
         hidden = np.asarray(hidden)
+        info = voice_info(out)
+        info["thinker_token_ids"] = (list(out.outputs[0].token_ids)
+                                     if out.outputs else [])
         reqs.append(StageRequest(
             request_id=out.request_id,
             prompt_token_ids=[0] * hidden.shape[0],
             prompt_embeds=hidden,
-            additional_information={
-                "thinker_token_ids": list(out.outputs[0].token_ids)
-                if out.outputs else [],
-            },
+            additional_information=info,
         ))
     return reqs
 
 
+# per-request conditioning keys a vocoder stage consumes (voice
+# vectors / reference audio resolved upstream, e.g. by the serving
+# layer's voice registry) — forwarded verbatim across EVERY stage hop
+# so the final vocoder sees them regardless of pipeline depth
+_VOICE_KEYS = ("voice", "speaker_embedding", "reference_mel")
+
+
+def voice_info(out) -> dict:
+    """The voice-conditioning subset of an upstream output's
+    additional_information (empty when absent)."""
+    info = getattr(out, "additional_information", None) or {}
+    return {k: info[k] for k in _VOICE_KEYS if k in info}
+
+
 def talker_to_code2wav(config, upstream_outputs) -> list[StageRequest]:
     """Codec tokens emitted by the talker become the vocoder's one-shot
-    prompt (reference: talker2code2wav)."""
+    prompt (reference: talker2code2wav).  Voice-conditioning entries in
+    the request's additional_information ride along."""
     return [
         StageRequest(
             request_id=out.request_id,
             prompt_token_ids=list(out.outputs[0].token_ids)
             if out.outputs else [],
+            additional_information=voice_info(out),
         )
         for out in upstream_outputs
     ]

@@ -5,11 +5,15 @@ from __future__ import annotations
 
 from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
 from vllm_omni_tpu.models.qwen3_tts.tts_lm import codec_ids_from_lm_tokens
+from vllm_omni_tpu.models.stage_input_processors.qwen3_omni import (
+    voice_info,
+)
 
 
 def lm_to_speech_decoder(config, upstream_outputs) -> list[StageRequest]:
     """Strip specials + the text-vocab offset from the LM's sampled stream;
-    the pure codec ids become the one-shot vocoder prompt."""
+    the pure codec ids become the one-shot vocoder prompt.  Voice
+    conditioning rides additional_information across the hop."""
     reqs = []
     for out in upstream_outputs:
         toks = out.outputs[0].token_ids if out.outputs else []
@@ -19,5 +23,6 @@ def lm_to_speech_decoder(config, upstream_outputs) -> list[StageRequest]:
             # rather than an empty prompt the scheduler would reject
             codec = [0]
         reqs.append(StageRequest(request_id=out.request_id,
-                                 prompt_token_ids=codec))
+                                 prompt_token_ids=codec,
+                                 additional_information=voice_info(out)))
     return reqs

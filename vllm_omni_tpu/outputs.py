@@ -35,6 +35,10 @@ class OmniRequestOutput:
     images: list[Any] = field(default_factory=list)
     multimodal_output: dict[str, Any] = field(default_factory=dict)
     metrics: dict[str, float] = field(default_factory=dict)
+    # the source request's additional_information, carried along so
+    # stage input processors can propagate per-request conditioning
+    # (voice vectors, reference audio) to downstream stages
+    additional_information: dict[str, Any] = field(default_factory=dict)
 
     @property
     def is_error(self) -> bool:
@@ -98,6 +102,7 @@ class OmniRequestOutput:
             stage_id=stage_id,
             final_output_type="text",
             multimodal_output=mm,
+            additional_information=dict(request.additional_information),
         )
 
     @classmethod

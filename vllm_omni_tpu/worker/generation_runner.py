@@ -48,9 +48,23 @@ class GenerationModelRunner:
             n = sc.num_new_tokens
             token_ids[i, :n] = sc.request.prompt_token_ids[:n]
             lengths[i] = n
-        outputs = self._forward(
-            self.params, jnp.asarray(token_ids), jnp.asarray(lengths)
-        )
+        # optional conditioning extension: models exposing
+        # ``batch_conditioning(requests, batch) -> pytree`` take it as a
+        # fourth forward argument (per-request voice vectors etc.);
+        # jax.jit specializes per call signature, so the cond-free path
+        # keeps its own cached executable
+        cond = None
+        if hasattr(self.model, "batch_conditioning"):
+            cond = self.model.batch_conditioning(
+                [sc.request for sc in scheds], b)
+        if cond is not None:
+            outputs = self._forward(
+                self.params, jnp.asarray(token_ids),
+                jnp.asarray(lengths), cond)
+        else:
+            outputs = self._forward(
+                self.params, jnp.asarray(token_ids), jnp.asarray(lengths)
+            )
         outputs = {k: np.asarray(jax.device_get(v)) for k, v in outputs.items()}
         for i, sc in enumerate(scheds):
             sc.request.multimodal_output.update(
