@@ -1,0 +1,63 @@
+"""CLI arg surface (reference: vllm serve flags intercepted by the omni
+CLI): engine args map to entry-stage overrides and --stage-override
+reaches any stage, flowing through the Omni constructor into per-stage
+engine_args."""
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.entrypoints.cli import main as cli
+
+
+def _parse(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    cli._add_common(parser)
+    return parser.parse_args(argv)
+
+
+def test_entry_flags_map_to_stage0():
+    args = _parse(["some-model", "--max-model-len", "128",
+                   "--max-num-seqs", "2", "--dtype", "float32",
+                   "--seed", "7", "--enable-chunked-prefill"])
+    ov = cli._stage_overrides(args)
+    assert ov == {"stage0": {
+        "max_model_len": 128, "max_num_seqs": 2, "dtype": "float32",
+        "seed": 7, "enable_chunked_prefill": True}}
+
+
+def test_stage_override_parses_json_values():
+    args = _parse(["m", "--stage-override", "2.num_steps=4",
+                   "--stage-override", '1.dtype="float32"',
+                   "--stage-override", "2.voices={\"a\": {}}"])
+    ov = cli._stage_overrides(args)
+    assert ov == {"stage2": {"num_steps": 4, "voices": {"a": {}}},
+                  "stage1": {"dtype": "float32"}}
+
+
+def test_stage_override_rejects_malformed():
+    args = _parse(["m", "--stage-override", "nonsense"])
+    with pytest.raises(SystemExit):
+        cli._stage_overrides(args)
+
+
+def test_overrides_reach_engine_args_through_omni():
+    """End-to-end: a CLI-style override changes a stage's engine_args
+    (the same path `vllm-omni-tpu serve --max-model-len ...` takes)."""
+    import os
+
+    from vllm_omni_tpu.config.stage import load_stage_configs_from_yaml
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    yaml_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "vllm_omni_tpu", "models", "stage_configs", "qwen3_tts_tiny.yaml")
+    args = _parse([yaml_path, "--max-num-seqs", "3"])
+    omni = Omni(stage_configs=yaml_path, **cli._stage_overrides(args))
+    assert omni.stages[0].config.engine_args["max_num_seqs"] == 3
+    outs = omni.generate([[1, 2, 3]])
+    assert any(o.final_output_type == "audio" for o in outs)
+    wav = next(o for o in outs if o.final_output_type == "audio")
+    assert np.isfinite(wav.multimodal_output["audio"]).all()

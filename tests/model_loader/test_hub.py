@@ -1,0 +1,53 @@
+"""Pattern-filtered hub resolution (reference:
+download_weights_from_hf_specific): local paths pass through untouched,
+offline mode fails fast with a clear message, and submodel pattern sets
+compose with the always-needed config/tokenizer files."""
+
+import os
+
+import pytest
+
+from vllm_omni_tpu.model_loader import hub
+
+
+def test_local_dir_passes_through(tmp_path):
+    assert hub.resolve_model_path(str(tmp_path)) == str(tmp_path)
+
+
+def test_offline_env_fails_fast(monkeypatch):
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")
+    with pytest.raises(FileNotFoundError, match="HF_HUB_OFFLINE"):
+        hub.resolve_model_path("org/not-a-local-path")
+
+
+def test_download_patterns_filter_by_submodel(monkeypatch, tmp_path):
+    monkeypatch.delenv("HF_HUB_OFFLINE", raising=False)
+    captured = {}
+
+    def fake_snapshot(repo, revision=None, allow_patterns=None):
+        captured["repo"] = repo
+        captured["patterns"] = allow_patterns
+        return str(tmp_path)
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download",
+                        fake_snapshot)
+    out = hub.resolve_model_path("org/model", submodel="talker")
+    assert out == str(tmp_path)
+    assert captured["repo"] == "org/model"
+    assert "*talker*" in captured["patterns"]
+    assert "config.json" in captured["patterns"]
+    assert "tokenizer*" in captured["patterns"]
+
+
+def test_download_failure_mentions_zero_egress(monkeypatch):
+    monkeypatch.delenv("HF_HUB_OFFLINE", raising=False)
+    import huggingface_hub
+
+    def boom(*a, **k):
+        raise ConnectionError("no route to host")
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", boom)
+    with pytest.raises(FileNotFoundError, match="zero-egress"):
+        hub.resolve_model_path("org/model")

@@ -17,6 +17,57 @@ def _add_common(p: argparse.ArgumentParser):
                    help="model name/path (resolves an in-tree stage YAML)")
     p.add_argument("--stage-configs-path", default=None,
                    help="explicit stage-config YAML (overrides model lookup)")
+    # reference-style engine arg surface (vllm serve flags; reference:
+    # entrypoints/cli/serve.py + omni engine args) — applied to the
+    # ENTRY stage; use --stage-override for other stages
+    eng = p.add_argument_group("engine args (entry stage)")
+    eng.add_argument("--tensor-parallel-size", type=int, default=None)
+    eng.add_argument("--max-model-len", type=int, default=None)
+    eng.add_argument("--max-num-seqs", type=int, default=None)
+    eng.add_argument("--max-num-batched-tokens", type=int, default=None)
+    eng.add_argument("--dtype", default=None,
+                     help="bfloat16|float32|float16 — engine compute/"
+                          "KV-cache dtype; WEIGHT dtype comes from the "
+                          "stage YAML's model_factory_args")
+    eng.add_argument("--seed", type=int, default=None)
+    eng.add_argument("--enable-chunked-prefill", action="store_true",
+                     default=None)
+    eng.add_argument("--num-speculative-tokens", type=int, default=None)
+    p.add_argument(
+        "--stage-override", action="append", default=[],
+        metavar="N.KEY=VALUE",
+        help="set engine_args KEY of stage N (repeatable); VALUE parses "
+             "as JSON when possible, e.g. --stage-override "
+             "2.num_steps=4 --stage-override 1.dtype='\"float32\"'")
+
+
+_ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
+                "max_num_batched_tokens", "dtype", "seed",
+                "enable_chunked_prefill", "num_speculative_tokens")
+
+
+def _stage_overrides(args) -> dict:
+    """CLI flags -> the Omni constructor's per-stage override dict
+    ({"stage0": {...}, "stage2": {...}})."""
+    out: dict[str, dict] = {}
+    entry = {k: getattr(args, k) for k in _ENTRY_FLAGS
+             if getattr(args, k, None) is not None}
+    if entry:
+        out["stage0"] = entry
+    for item in args.stage_override:
+        try:
+            target, val = item.split("=", 1)
+            stage, key = target.split(".", 1)
+            stage_key = f"stage{int(stage)}"
+        except ValueError:
+            raise SystemExit(
+                f"--stage-override expects N.KEY=VALUE, got {item!r}")
+        try:
+            val = json.loads(val)
+        except json.JSONDecodeError:
+            pass  # keep the raw string
+        out.setdefault(stage_key, {})[key] = val
+    return out
 
 
 def cmd_serve(args) -> int:
@@ -27,6 +78,7 @@ def cmd_serve(args) -> int:
         stage_configs=args.stage_configs_path,
         host=args.host,
         port=args.port,
+        **_stage_overrides(args),
     )
     return 0
 
@@ -34,7 +86,8 @@ def cmd_serve(args) -> int:
 def cmd_generate(args) -> int:
     from vllm_omni_tpu.entrypoints.omni import Omni
 
-    omni = Omni(model=args.model, stage_configs=args.stage_configs_path)
+    omni = Omni(model=args.model, stage_configs=args.stage_configs_path,
+                **_stage_overrides(args))
     sp = json.loads(args.sampling_params) if args.sampling_params else {}
     outs = omni.generate([args.prompt], [sp])
     for o in outs:

@@ -50,6 +50,13 @@ class Omni:
             configs = load_stage_configs_from_yaml(stage_configs)
         else:
             configs = stage_configs
+        known = {f"stage{cfg.stage_id}" for cfg in configs}
+        bad = [k for k in overrides
+               if k.startswith("stage") and k not in known]
+        if bad:
+            raise ValueError(
+                f"overrides target nonexistent stages {bad}; pipeline "
+                f"has {sorted(known)}")
         for cfg in configs:
             cfg.engine_args.update(overrides.get(f"stage{cfg.stage_id}", {}))
         self.stage_configs = configs

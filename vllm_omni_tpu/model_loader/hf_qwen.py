@@ -133,6 +133,9 @@ def load_qwen_lm(
 
     Returns (params, cfg, eos_token_id) — the model_factory contract.
     """
+    from vllm_omni_tpu.model_loader.hub import resolve_model_path
+
+    model_dir = resolve_model_path(model_dir, submodel=submodel)
     if cfg is None:
         cfg = config_from_hf(model_dir, hf_config_name)
     if isinstance(dtype, str):  # YAML model_factory_args pass strings

@@ -1,0 +1,82 @@
+"""Pattern-filtered HF-hub checkpoint download.
+
+TPU-native counterpart of the reference's
+``download_weights_from_hf_specific`` (reference:
+vllm_omni/model_executor/model_loader/weight_utils.py — snapshot
+download restricted to the tensor/config patterns a stage actually
+needs; per-component savings apply when the repo shards per component,
+see _SUBMODEL_PATTERNS).
+
+Zero-egress stance: every loader in this package takes LOCAL paths;
+this module is the single place network fetch happens, and only when
+the caller passes a repo id that is not a local directory.  Offline
+environments (HF_HUB_OFFLINE) get a clear error instead of a hang.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# submodel -> shard name patterns worth pulling (index + config always).
+# NOTE: composite checkpoints sharded as model-XXXXX-of-YYYYY mix all
+# submodels in shared files the hub cannot filter — per-component
+# savings only materialize for repos that shard per component; pass
+# allow_patterns=["*.safetensors"] to force everything
+_SUBMODEL_PATTERNS = {
+    "thinker": ["*thinker*"],
+    "talker": ["*talker*"],
+    "code2wav": ["*code2wav*"],
+    "token2wav": ["*token2wav*"],
+}
+
+_ALWAYS = ["config.json", "*.index.json", "generation_config.json",
+           "tokenizer*", "preprocessor_config.json", "model_index.json"]
+
+
+def resolve_model_path(
+    model: str,
+    allow_patterns: Optional[Sequence[str]] = None,
+    submodel: Optional[str] = None,
+    revision: Optional[str] = None,
+) -> str:
+    """A local directory passes through; anything else snapshot-downloads
+    (pattern-filtered) and returns the cache path.
+
+    ``submodel`` picks a predefined pattern set ("talker" etc.);
+    ``allow_patterns`` overrides it entirely.
+    """
+    if os.path.isdir(model) or os.path.isfile(model):
+        return model
+    if os.environ.get("HF_HUB_OFFLINE", "").upper() in (
+            "1", "ON", "YES", "TRUE"):  # huggingface_hub's env parsing
+        raise FileNotFoundError(
+            f"{model!r} is not a local path and HF_HUB_OFFLINE=1 — "
+            "download the checkpoint out of band and pass its directory")
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover - baked into the image
+        raise FileNotFoundError(
+            f"{model!r} is not a local path and huggingface_hub is "
+            "unavailable") from e
+
+    patterns = list(allow_patterns) if allow_patterns else list(
+        _SUBMODEL_PATTERNS.get(submodel, ["*.safetensors"]))
+    if submodel and not allow_patterns:
+        # shared-shard composite repos carry no per-component files;
+        # include the common shard naming so such repos still resolve
+        patterns.append("model*.safetensors")
+    patterns = list(dict.fromkeys(patterns + _ALWAYS))
+    logger.info("downloading %s (patterns: %s)", model, patterns)
+    try:
+        return snapshot_download(model, revision=revision,
+                                 allow_patterns=patterns)
+    except Exception as e:
+        raise FileNotFoundError(
+            f"could not download {model!r} from the HF hub ({e}); in "
+            "zero-egress environments pass a local checkpoint directory"
+        ) from e

@@ -604,14 +604,13 @@ class Token2WavRealModel:
         any_cond = False
         for i, req in enumerate(requests):
             info = getattr(req, "additional_information", None) or {}
-            v = info.get("voice")
-            if v is not None and v in self.voices:
-                entry = self.voices[v]
-                info = {**info, **entry}
             # malformed per-request assets must not take down the whole
             # batch (a poll exception kills every in-flight request) —
             # degrade that row to the neutral voice with a warning
             try:
+                v = info.get("voice")
+                if isinstance(v, str) and v in self.voices:
+                    info = {**info, **self.voices[v]}
                 se = info.get("speaker_embedding")
                 if se is not None:
                     se = np.asarray(se, np.float32).reshape(-1)
