@@ -1,0 +1,79 @@
+"""Qwen2.5-Omni audio tower parity vs the transformers oracle — the
+same tiny-synthetic-checkpoint methodology as the Qwen3 AuT test:
+window-multiple, ragged-tail and sub-window clips must all match."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from vllm_omni_tpu.models.qwen2_5_omni import audio_tower  # noqa: E402
+
+
+def _tiny_hf_cfg():
+    from transformers.models.qwen2_5_omni.configuration_qwen2_5_omni import (  # noqa: E501
+        Qwen2_5OmniAudioEncoderConfig,
+    )
+
+    return Qwen2_5OmniAudioEncoderConfig(
+        num_mel_bins=16, d_model=32, encoder_layers=2,
+        encoder_attention_heads=4, encoder_ffn_dim=64, n_window=4,
+        output_dim=24, max_source_positions=64, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from transformers.models.qwen2_5_omni.modeling_qwen2_5_omni import (
+        Qwen2_5OmniAudioEncoder,
+    )
+
+    torch.manual_seed(0)
+    hf_cfg = _tiny_hf_cfg()
+    model = Qwen2_5OmniAudioEncoder._from_config(
+        hf_cfg, attn_implementation="sdpa").eval().float()
+    d = tmp_path_factory.mktemp("q25_audio_ckpt")
+    from safetensors.torch import save_file
+
+    state = {f"thinker.audio_tower.{k}": v.contiguous()
+             for k, v in model.state_dict().items()
+             if "positional_embedding" not in k}
+    save_file(state, os.path.join(d, "model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"thinker_config": {"audio_config":
+                                      hf_cfg.to_dict()}}, f)
+    return str(d), model, hf_cfg
+
+
+@pytest.mark.parametrize("t_frames", [16, 24, 21, 6])
+def test_audio_tower_matches_hf(checkpoint, t_frames):
+    """Chunk-multiple (16, 24), ragged-tail (21) and sub-chunk (6)
+    clips all match the oracle."""
+    ckpt_dir, model, _ = checkpoint
+    params, cfg = audio_tower.load_audio_tower(ckpt_dir)
+    rng = np.random.default_rng(t_frames)
+    mel = rng.standard_normal((t_frames, 16)).astype(np.float32)
+
+    with torch.no_grad():
+        after_cnn = (torch.tensor([t_frames]) - 1) // 2 + 1
+        want = model(
+            torch.from_numpy(mel.T.copy()),  # HF takes [n_mels, T]
+            feature_lens=torch.tensor([t_frames]),
+            aftercnn_lens=after_cnn,
+        ).last_hidden_state.numpy()
+
+    got = np.asarray(audio_tower.forward(params, cfg, jnp.asarray(mel)))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
+
+
+def test_bos_eos_table_loaded(checkpoint):
+    ckpt_dir, model, _ = checkpoint
+    params, cfg = audio_tower.load_audio_tower(ckpt_dir)
+    want = model.audio_bos_eos_token.weight.detach().numpy()
+    np.testing.assert_allclose(
+        np.asarray(audio_tower.bos_eos(params)), want, atol=1e-6)

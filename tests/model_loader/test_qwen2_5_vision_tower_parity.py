@@ -1,0 +1,72 @@
+"""Qwen2.5-Omni vision tower parity vs the transformers oracle:
+windowed + full-attention blocks, 2-D rope, spatial-merge PatchMerger,
+and the inverse window permutation — on square, non-square, and
+non-window-aligned grids."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from vllm_omni_tpu.models.qwen2_5_omni import vision_tower  # noqa: E402
+
+
+def _tiny_hf_cfg():
+    from transformers.models.qwen2_5_omni.configuration_qwen2_5_omni import (  # noqa: E501
+        Qwen2_5OmniVisionEncoderConfig,
+    )
+
+    return Qwen2_5OmniVisionEncoderConfig(
+        depth=2, hidden_size=32, intermediate_size=64, num_heads=4,
+        patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+        out_hidden_size=24, window_size=16, fullatt_block_indexes=[1])
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from transformers.models.qwen2_5_omni.modeling_qwen2_5_omni import (
+        Qwen2_5OmniVisionEncoder,
+    )
+
+    torch.manual_seed(0)
+    hf_cfg = _tiny_hf_cfg()
+    model = Qwen2_5OmniVisionEncoder._from_config(
+        hf_cfg, attn_implementation="sdpa").eval().float()
+    d = tmp_path_factory.mktemp("q25_vision_ckpt")
+    from safetensors.torch import save_file
+
+    state = {f"thinker.visual.{k}": v.contiguous()
+             for k, v in model.state_dict().items()
+             if "rotary" not in k}
+    save_file(state, os.path.join(d, "model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"thinker_config": {"vision_config":
+                                      hf_cfg.to_dict()}}, f)
+    return str(d), model, hf_cfg
+
+
+# grids in PATCH units: (t, h, w); window_size 16 / patch 4 / merge 2
+# -> merger windows of 2x2 merged tokens; 4x4 aligns, 6x4 and 6x6 do not
+@pytest.mark.parametrize("grid", [(1, 4, 4), (1, 6, 4), (1, 6, 6),
+                                  (2, 4, 4)])
+def test_vision_tower_matches_hf(checkpoint, grid):
+    ckpt_dir, model, hf_cfg = checkpoint
+    params, cfg = vision_tower.load_vision_tower(ckpt_dir)
+    t, h, w = grid
+    n = t * h * w
+    patch_dim = 3 * hf_cfg.temporal_patch_size * hf_cfg.patch_size ** 2
+    rng = np.random.default_rng(sum(grid))
+    pixels = rng.standard_normal((n, patch_dim)).astype(np.float32)
+
+    with torch.no_grad():
+        want = model(torch.from_numpy(pixels),
+                     grid_thw=torch.tensor([[t, h, w]])).numpy()
+    got = np.asarray(vision_tower.forward(
+        params, cfg, jnp.asarray(pixels), (t, h, w)))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)

@@ -101,3 +101,53 @@ def test_transformer_a2a_dispatch_matches_dense():
         moe_ops.set_ep_mesh(None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-4, rtol=2e-4)
+
+
+# ------------------------------------------------------------------ EPLB
+def test_eplb_assignments_balance_load():
+    from vllm_omni_tpu.ops import moe as moe_ops
+
+    counts = np.array([100, 1, 1, 1, 90, 1, 1, 1])
+    perm = moe_ops.eplb_assignments(counts, n_shards=2)
+    assert sorted(perm.tolist()) == list(range(8))
+    # the two heavy experts (0, 4) must land on DIFFERENT shards
+    half = perm.reshape(2, 4)
+    shard_of = {int(e): s for s in range(2) for e in half[s]}
+    assert shard_of[0] != shard_of[4]
+    loads = counts[half].sum(axis=1)
+    # optimum under the equal-count constraint: 103 vs 93
+    assert abs(int(loads[0]) - int(loads[1])) <= 10
+    with pytest.raises(ValueError):
+        moe_ops.eplb_assignments(counts, n_shards=3)
+
+
+def test_eplb_apply_preserves_numerics():
+    """Permuting expert placement must not change routed-MoE outputs —
+    only which ep shard owns each expert."""
+    import jax
+
+    from vllm_omni_tpu.models.common import transformer as tfm
+    from vllm_omni_tpu.ops import moe as moe_ops
+
+    cfg = tfm.TransformerConfig.tiny_moe()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (5, cfg.hidden_size)).astype(np.float32))
+    layer = params["layers"][0]
+    before = moe_ops.routed_moe(
+        x, layer["router"]["w"], layer["experts"]["gate_up"],
+        layer["experts"]["down"], cfg.num_experts_per_tok)
+
+    counts = np.array([50, 40, 1, 2])  # forces a non-identity placement
+    rebal = moe_ops.eplb_step(
+        params, counts_per_layer=[counts] * cfg.num_layers, n_shards=2)
+    layer2 = rebal["layers"][0]
+    after = moe_ops.routed_moe(
+        x, layer2["router"]["w"], layer2["experts"]["gate_up"],
+        layer2["experts"]["down"], cfg.num_experts_per_tok)
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before),
+                               atol=1e-6)
+    # placement actually changed (heavy experts 0/2 split across shards)
+    assert not np.array_equal(
+        np.asarray(layer2["experts"]["gate_up"]),
+        np.asarray(layer["experts"]["gate_up"]))

@@ -52,17 +52,26 @@ def resolve_model_path(
     """
     if os.path.isdir(model) or os.path.isfile(model):
         return model
-    if os.environ.get("HF_HUB_OFFLINE", "").upper() in (
-            "1", "ON", "YES", "TRUE"):  # huggingface_hub's env parsing
-        raise FileNotFoundError(
-            f"{model!r} is not a local path and HF_HUB_OFFLINE=1 — "
-            "download the checkpoint out of band and pass its directory")
+    offline = os.environ.get("HF_HUB_OFFLINE", "").upper() in (
+        "1", "ON", "YES", "TRUE")  # huggingface_hub's env parsing
     try:
         from huggingface_hub import snapshot_download
     except ImportError as e:  # pragma: no cover - baked into the image
         raise FileNotFoundError(
             f"{model!r} is not a local path and huggingface_hub is "
             "unavailable") from e
+    if offline:
+        # a pre-warmed cache still resolves offline; only an incomplete
+        # cache errors (LocalEntryNotFoundError)
+        try:
+            return snapshot_download(model, revision=revision,
+                                     local_files_only=True)
+        except Exception as e:
+            raise FileNotFoundError(
+                f"{model!r} is not a local path, HF_HUB_OFFLINE is set, "
+                f"and the local HF cache cannot satisfy it ({e}) — "
+                "download the checkpoint out of band and pass its "
+                "directory") from e
 
     patterns = list(allow_patterns) if allow_patterns else list(
         _SUBMODEL_PATTERNS.get(submodel, ["*.safetensors"]))

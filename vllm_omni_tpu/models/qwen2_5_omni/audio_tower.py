@@ -1,0 +1,265 @@
+"""Checkpoint-schema Qwen2.5-Omni audio tower (real-weight path).
+
+Structural match for the HF ``Qwen2_5OmniAudioEncoder`` (transformers
+qwen2_5_omni/modeling_qwen2_5_omni.py; the reference thinker consumes
+the same tower): mel frames split into chunks of ``2 * n_window``, each
+chunk runs gelu(conv1) masked then gelu(conv2, stride 2), whisper-style
+sinusoid positions RESTART per chunk, the valid tokens run a pre-LN
+transformer with BLOCK-DIAGONAL per-chunk attention, and the head is
+avg-pool(2) -> ln_post -> proj to ``output_dim``.  The 2-row
+``audio_bos_eos_token`` table the thinker wraps audio segments with is
+loaded alongside.
+
+TPU-first (same stance as the Qwen3 AuT tower): the reference splits
+into a ragged python list and boolean-indexes — dynamic shapes XLA
+cannot tile.  Here the clip zero-pads to whole chunks, ALL chunks
+convolve as ONE batched static conv, and validity is a host-computed
+static mask: attention runs over the padded token grid with an additive
+block-diagonal bias, and the valid-token gather is a static index take.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import nn
+
+logger = init_logger(__name__)
+
+_PRECISION = jax.lax.Precision.HIGHEST
+
+
+@dataclass(frozen=True)
+class AudioTowerConfig:
+    num_mel_bins: int = 128
+    d_model: int = 1280
+    encoder_layers: int = 32
+    num_heads: int = 20
+    ffn_dim: int = 5120
+    n_window: int = 50
+    output_dim: int = 3584
+    max_source_positions: int = 1500
+    eps: float = 1e-5
+
+    @property
+    def chunk_frames(self) -> int:
+        return 2 * self.n_window
+
+    @staticmethod
+    def tiny() -> "AudioTowerConfig":
+        return AudioTowerConfig(num_mel_bins=16, d_model=32,
+                                encoder_layers=2, num_heads=4,
+                                ffn_dim=64, n_window=4, output_dim=24,
+                                max_source_positions=64)
+
+    @staticmethod
+    def from_hf(d: dict) -> "AudioTowerConfig":
+        return AudioTowerConfig(
+            num_mel_bins=d.get("num_mel_bins", 128),
+            d_model=d.get("d_model", 1280),
+            encoder_layers=d.get("encoder_layers", 32),
+            num_heads=d.get("encoder_attention_heads", 20),
+            ffn_dim=d.get("encoder_ffn_dim", 5120),
+            n_window=d.get("n_window", 50),
+            output_dim=d.get("output_dim", 3584),
+            max_source_positions=d.get("max_source_positions", 1500),
+        )
+
+
+def sinusoid_positions(length: int, channels: int,
+                       max_timescale: float = 10000.0) -> np.ndarray:
+    """Whisper SinusoidsPositionEmbedding: [length, channels]."""
+    log_inc = math.log(max_timescale) / (channels // 2 - 1)
+    inv = np.exp(-log_inc * np.arange(channels // 2, dtype=np.float32))
+    ang = np.arange(length, dtype=np.float32)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+
+
+def init_params(key, cfg: AudioTowerConfig, dtype=jnp.float32):
+    ki = iter(jax.random.split(key, 8 + 8 * cfg.encoder_layers))
+    d = cfg.d_model
+    p = {
+        "conv1": {"w": nn.conv1d_init(next(ki), cfg.num_mel_bins, d, 3,
+                                      dtype=dtype)["w"],
+                  "b": jnp.zeros((d,), dtype)},
+        "conv2": {"w": nn.conv1d_init(next(ki), d, d, 3,
+                                      dtype=dtype)["w"],
+                  "b": jnp.zeros((d,), dtype)},
+        "bos_eos": nn.embedding_init(next(ki), 2, cfg.output_dim, dtype),
+        "ln_post": nn.layernorm_init(d, dtype=dtype),
+        "proj": nn.linear_init(next(ki), d, cfg.output_dim, dtype=dtype),
+        "layers": [],
+    }
+    for _ in range(cfg.encoder_layers):
+        p["layers"].append({
+            "attn_norm": nn.layernorm_init(d, dtype=dtype),
+            "q_proj": nn.linear_init(next(ki), d, d, dtype=dtype),
+            # whisper-style: k_proj carries no bias
+            "k_proj": nn.linear_init(next(ki), d, d, bias=False,
+                                     dtype=dtype),
+            "v_proj": nn.linear_init(next(ki), d, d, dtype=dtype),
+            "out_proj": nn.linear_init(next(ki), d, d, dtype=dtype),
+            "final_norm": nn.layernorm_init(d, dtype=dtype),
+            "fc1": nn.linear_init(next(ki), d, cfg.ffn_dim, dtype=dtype),
+            "fc2": nn.linear_init(next(ki), cfg.ffn_dim, d, dtype=dtype),
+        })
+    return p
+
+
+def _conv(p, x, stride: int = 1):
+    y = jax.lax.conv_general_dilated(
+        jnp.pad(x, ((0, 0), (1, 1), (0, 0))),
+        p["w"].astype(x.dtype), window_strides=(stride,),
+        padding="VALID", dimension_numbers=("NWC", "WIO", "NWC"),
+        precision=_PRECISION)
+    return y + p["b"].astype(x.dtype)
+
+
+def forward(params, cfg: AudioTowerConfig, mel: jax.Array) -> jax.Array:
+    """One clip: mel [T, num_mel_bins] -> audio tokens
+    [ceil(ceil(T/2)/2)... , output_dim] (conv stride 2, then avg-pool 2;
+    chunked exactly like the reference)."""
+    t = int(mel.shape[0])
+    chunk = cfg.chunk_frames
+    nc = max(1, -(-t // chunk))
+    lens = np.full(nc, chunk, np.int64)
+    tail = t % chunk
+    if tail:
+        lens[-1] = tail
+    pad = nc * chunk - t
+    x = jnp.pad(mel, ((0, pad), (0, 0))).reshape(nc, chunk, -1)
+
+    # gelu(conv1) masked to each chunk's true length, then strided conv2
+    mask1 = (np.arange(chunk)[None, :] < lens[:, None])
+    h = jax.nn.gelu(_conv(params["conv1"], x),
+                    approximate=False) * jnp.asarray(
+        mask1[..., None], x.dtype)
+    h = jax.nn.gelu(_conv(params["conv2"], h, stride=2),
+                    approximate=False)          # [nc, t2, d]
+    t2 = h.shape[1]
+    pos = sinusoid_positions(cfg.max_source_positions, cfg.d_model)
+    h = h + jnp.asarray(pos[None, :t2], h.dtype)
+
+    # valid tokens per chunk after the stride-2 conv
+    lens2 = (lens - 1) // 2 + 1
+    valid = (np.arange(t2)[None, :] < lens2[:, None])   # [nc, t2]
+    n = nc * t2
+    flat_valid = valid.reshape(-1)
+    chunk_of = np.repeat(np.arange(nc), t2)
+    same = (chunk_of[:, None] == chunk_of[None, :]) \
+        & flat_valid[None, :] & flat_valid[:, None]
+    bias = jnp.asarray(np.where(same, 0.0, -1e30), jnp.float32)
+
+    x = h.reshape(n, -1)
+    heads = cfg.num_heads
+    hd = cfg.d_model // heads
+    scale = 1.0 / math.sqrt(hd)
+    for lp in params["layers"]:
+        hh = nn.layernorm(lp["attn_norm"], x, eps=cfg.eps)
+        q = nn.linear(lp["q_proj"], hh).reshape(n, heads, hd)
+        k = nn.linear(lp["k_proj"], hh).reshape(n, heads, hd)
+        v = nn.linear(lp["v_proj"], hh).reshape(n, heads, hd)
+        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32),
+                       precision=_PRECISION) * scale
+        a = jax.nn.softmax(s + bias[None], axis=-1).astype(x.dtype)
+        o = jnp.einsum("hqk,khd->qhd", a, v, precision=_PRECISION)
+        x = x + nn.linear(lp["out_proj"], o.reshape(n, -1))
+        hh = nn.layernorm(lp["final_norm"], x, eps=cfg.eps)
+        hh = nn.linear(lp["fc2"],
+                       jax.nn.gelu(nn.linear(lp["fc1"], hh),
+                                   approximate=False))
+        x = x + hh
+
+    # gather the valid tokens (static host-side indices), then the head:
+    # avg-pool pairs over the WHOLE clip, ln_post, proj
+    idx = np.nonzero(flat_valid)[0]
+    tokens = jnp.take(x, jnp.asarray(idx), axis=0)    # [T2, d]
+    t_valid = idx.shape[0]
+    pairs = t_valid // 2
+    pooled = tokens[: 2 * pairs].reshape(pairs, 2, -1).mean(axis=1)
+    pooled = nn.layernorm(params["ln_post"], pooled, eps=cfg.eps)
+    return nn.linear(params["proj"], pooled)
+
+
+def bos_eos(params):
+    """[2, output_dim] — the audio segment delimiter embeddings."""
+    return params["bos_eos"]["w"]
+
+
+# ------------------------------------------------------- checkpoint load
+def hf_flat_map(cfg: AudioTowerConfig,
+                prefix: str = "thinker.audio_tower.") -> dict:
+    m: dict[str, tuple] = {}
+
+    def lin(hf, path, bias=True):
+        m[f"{hf}.weight"] = path + ("w",)
+        if bias:
+            m[f"{hf}.bias"] = path + ("b",)
+
+    lin(f"{prefix}conv1", ("conv1",))
+    lin(f"{prefix}conv2", ("conv2",))
+    m[f"{prefix}audio_bos_eos_token.weight"] = ("bos_eos", "w")
+    lin(f"{prefix}ln_post", ("ln_post",))
+    lin(f"{prefix}proj", ("proj",))
+    for i in range(cfg.encoder_layers):
+        lp = f"{prefix}layers.{i}"
+        tgt = ("layers", i)
+        lin(f"{lp}.self_attn_layer_norm", tgt + ("attn_norm",))
+        lin(f"{lp}.self_attn.q_proj", tgt + ("q_proj",))
+        lin(f"{lp}.self_attn.k_proj", tgt + ("k_proj",), bias=False)
+        lin(f"{lp}.self_attn.v_proj", tgt + ("v_proj",))
+        lin(f"{lp}.self_attn.out_proj", tgt + ("out_proj",))
+        lin(f"{lp}.final_layer_norm", tgt + ("final_norm",))
+        lin(f"{lp}.fc1", tgt + ("fc1",))
+        lin(f"{lp}.fc2", tgt + ("fc2",))
+    return m
+
+
+def hf_transform(name: str, arr):
+    if arr.ndim == 3:  # Conv1d [out, in, k] -> [k, in, out]
+        return arr.transpose(2, 1, 0)
+    if arr.ndim == 2 and name.endswith("weight") \
+            and "audio_bos_eos_token" not in name:
+        return arr.T
+    return arr
+
+
+def load_audio_tower(model_dir: str, cfg: AudioTowerConfig = None,
+                     dtype=jnp.float32,
+                     prefix: str = "thinker.audio_tower."):
+    import json
+    import os
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+
+    if cfg is None:
+        cfg_path = os.path.join(model_dir, "config.json")
+        d = {}
+        if os.path.isfile(cfg_path):
+            with open(cfg_path) as f:
+                d = (json.load(f).get("thinker_config", {})
+                     .get("audio_config", {}))
+        cfg = AudioTowerConfig.from_hf(d)
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    flat = hf_flat_map(cfg, prefix)
+    n, _ = load_checkpoint_tree(
+        model_dir, flat.get, tree, dtype=np.float32,
+        transform=hf_transform, name_filter=lambda nm: nm in flat,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if n != n_leaves:
+        raise ValueError(
+            f"{model_dir} covered {n}/{n_leaves} audio-tower weights")
+    tree = jax.tree.map(lambda a: jnp.asarray(a, dtype), tree)
+    return tree, cfg

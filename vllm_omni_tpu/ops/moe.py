@@ -30,6 +30,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from vllm_omni_tpu.ops.activation import silu_mul
 
@@ -252,3 +253,70 @@ def routed_moe_ep(x, router_w, gate_up, down, num_experts_per_tok: int,
         out_specs=tok_spec,
     )
     return fn(x, router_w, gate_up, down)
+
+
+# ------------------------------------------------------------------ EPLB
+def eplb_assignments(counts, n_shards: int):
+    """Expert-parallel load balancing: a permutation placing experts on
+    shards so per-shard routed-token load evens out (reference:
+    eplb_step, worker/gpu_ar_model_runner.py:522-523).
+
+    ``counts`` [E] — routed tokens per expert (current weight order).
+    Returns ``perm`` [E] int array: new_position -> old_index, built
+    greedy-LPT (heaviest expert onto the least-loaded shard); slot
+    order inside a shard is load-descending.  Identity-stable: balanced
+    inputs return a permutation with the same per-shard load.
+    """
+    counts = np.asarray(counts)
+    e = counts.shape[0]
+    if e % n_shards:
+        raise ValueError(f"{e} experts do not shard over {n_shards}")
+    cap = e // n_shards
+    order = np.argsort(-counts, kind="stable")
+    shard_load = np.zeros(n_shards, counts.dtype)
+    shard_slots = [[] for _ in range(n_shards)]
+    for idx in order:
+        open_shards = [s for s in range(n_shards)
+                       if len(shard_slots[s]) < cap]
+        s = min(open_shards, key=lambda s: shard_load[s])
+        shard_slots[s].append(idx)
+        shard_load[s] += counts[idx]
+    return np.concatenate([np.asarray(s, np.int64)
+                           for s in shard_slots])
+
+
+def eplb_apply(layer_params: dict, perm) -> dict:
+    """Permute one MoE layer's expert placement: expert stacks reorder
+    along the leading E axis and the router's output columns follow, so
+    logits[t, new_pos] score the expert now stored at new_pos — the
+    routed computation is numerically IDENTICAL, only which ep shard
+    owns each expert changes."""
+    perm = jnp.asarray(perm)
+    out = dict(layer_params)
+    out["experts"] = {
+        "gate_up": layer_params["experts"]["gate_up"][perm],
+        "down": layer_params["experts"]["down"][perm],
+    }
+    out["router"] = dict(layer_params["router"])
+    out["router"]["w"] = layer_params["router"]["w"][:, perm]
+    return out
+
+
+def eplb_step(params: dict, counts_per_layer, n_shards: int) -> dict:
+    """Rebalance every MoE layer of a transformer param tree.
+
+    ``counts_per_layer``: routed-token counts [n_moe_layers, E], one
+    row per MoE layer IN ORDER (dense layers consume no row — the
+    serving layer's sampled router statistics only exist for routed
+    layers).  Returns a new param tree with permuted expert placement;
+    non-MoE layers pass through."""
+    layers = []
+    li = 0
+    for layer in params["layers"]:
+        if "experts" in layer:
+            perm = eplb_assignments(counts_per_layer[li], n_shards)
+            layers.append(eplb_apply(layer, perm))
+            li += 1
+        else:
+            layers.append(layer)
+    return {**params, "layers": layers}
