@@ -93,3 +93,26 @@ def test_factory_stages_keep_their_model():
     """Multi-stage factory YAMLs must NOT have the user model injected."""
     stages = load_stage_configs_from_model("qwen3-omni-moe-tiny")
     assert all("model" not in s.engine_args for s in stages)
+
+
+def test_real_model_yamls_resolve_and_inject_model_dir(tmp_path):
+    """Omni('/path/Qwen3-Omni-MoE') resolves the real-weight 3-stage
+    YAML and the checkpoint path fills every `model_dir: null` factory
+    arg (the reference serve CLI's model-arg override semantics)."""
+    from vllm_omni_tpu.config.stage import load_stage_configs_from_model
+
+    for name, n_stages in (("Qwen3-Omni-MoE", 3), ("Qwen2.5-Omni", 3),
+                           ("Qwen3-Omni-30B-A3B-Instruct", 3),
+                           ("Qwen2.5-Omni-7B", 3)):
+        path = str(tmp_path / name)
+        stages = load_stage_configs_from_model(path)
+        assert len(stages) == n_stages, name
+        for s in stages:
+            fa = s.engine_args.get("model_factory_args")
+            assert fa is not None and fa["model_dir"] == path, (name, s)
+        assert stages[-1].final_output_type == "audio"
+        # factories all resolve to importable callables
+        from vllm_omni_tpu.entrypoints.omni_stage import _import_obj
+
+        for s in stages:
+            assert callable(_import_obj(s.engine_args["model_factory"]))

@@ -140,15 +140,30 @@ def load_stage_configs_from_yaml(path: str) -> list[StageConfig]:
     return stages
 
 
+# real HF checkpoint names carry size/variant suffixes
+# (Qwen3-Omni-30B-A3B-Instruct); the FAMILY prefix picks the pipeline
+_FAMILY_YAMLS = (
+    ("qwen3_omni", "qwen3_omni_moe"),
+    ("qwen2_5_omni", "qwen2_5_omni"),
+    ("qwen_image", "qwen_image"),
+)
+
+
 def resolve_model_config_path(model: str) -> Optional[str]:
     """Map a model name/path to an in-tree stage YAML (reference:
-    entrypoints/utils.py resolve_model_config_path)."""
+    entrypoints/utils.py resolve_model_config_path): exact normalized
+    basename first, then the model-family prefix."""
     base = os.path.basename(os.path.normpath(model)).lower().replace("-", "_")
     candidates = [base, base.replace(".", "_")]
     for cand in candidates:
         p = os.path.join(_STAGE_CONFIG_DIR, cand + ".yaml")
         if os.path.exists(p):
             return p
+    for prefix, yaml_name in _FAMILY_YAMLS:
+        if any(c.startswith(prefix) for c in candidates):
+            p = os.path.join(_STAGE_CONFIG_DIR, yaml_name + ".yaml")
+            if os.path.exists(p):
+                return p
     return None
 
 
@@ -166,10 +181,15 @@ def load_stage_configs_from_model(
         for s in stages:
             # Single-model stages inherit the user's checkpoint path
             # (reference: the serve CLI's model arg overrides the stage
-            # YAML's model field); factory-built stages keep theirs.
+            # YAML's model field); factory-built stages keep theirs —
+            # EXCEPT factory args that declare ``model_dir: null``,
+            # which real-model YAMLs use to receive the user's path.
             if ("model" not in s.engine_args
                     and "model_factory" not in s.engine_args):
                 s.engine_args["model"] = model
+            fa = s.engine_args.get("model_factory_args")
+            if isinstance(fa, dict) and fa.get("model_dir", "") is None:
+                fa["model_dir"] = model
         return stages
     # Single-stage default, like the reference's diffusion autodetect
     # (cli/serve.py:55-63): model_index.json => diffusion.

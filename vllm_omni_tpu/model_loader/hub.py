@@ -61,8 +61,10 @@ def resolve_model_path(
             f"{model!r} is not a local path and huggingface_hub is "
             "unavailable") from e
     if offline:
-        # a pre-warmed cache still resolves offline; only an incomplete
-        # cache errors (LocalEntryNotFoundError)
+        # a pre-warmed cache still resolves offline; a MISSING snapshot
+        # errors here, but hub cannot verify per-file completeness
+        # offline — a half-populated snapshot surfaces later as a
+        # missing-shard error in the safetensors loader
         try:
             return snapshot_download(model, revision=revision,
                                      local_files_only=True)

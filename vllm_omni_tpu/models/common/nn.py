@@ -166,6 +166,20 @@ def groupnorm(p, x, groups: int = 32, eps: float = 1e-6):
     return y.astype(x.dtype)
 
 
+def sinusoid_positions(length: int, channels: int,
+                       max_timescale: float = 10000.0):
+    """Whisper SinusoidsPositionEmbedding table [length, channels]
+    (shared by the Qwen3 AuT and Qwen2.5-Omni audio towers)."""
+    import math
+
+    import numpy as np
+
+    log_inc = math.log(max_timescale) / (channels // 2 - 1)
+    inv = np.exp(-log_inc * np.arange(channels // 2, dtype=np.float32))
+    ang = np.arange(length, dtype=np.float32)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+
+
 def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0):
     """Sinusoidal timestep embedding [B] -> [B, dim] (flip_sin_to_cos=True,
     matching diffusers' Timesteps used by the reference pipelines)."""

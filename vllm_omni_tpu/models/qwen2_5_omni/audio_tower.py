@@ -72,13 +72,7 @@ class AudioTowerConfig:
         )
 
 
-def sinusoid_positions(length: int, channels: int,
-                       max_timescale: float = 10000.0) -> np.ndarray:
-    """Whisper SinusoidsPositionEmbedding: [length, channels]."""
-    log_inc = math.log(max_timescale) / (channels // 2 - 1)
-    inv = np.exp(-log_inc * np.arange(channels // 2, dtype=np.float32))
-    ang = np.arange(length, dtype=np.float32)[:, None] * inv[None, :]
-    return np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+sinusoid_positions = nn.sinusoid_positions
 
 
 def init_params(key, cfg: AudioTowerConfig, dtype=jnp.float32):
@@ -126,8 +120,10 @@ def forward(params, cfg: AudioTowerConfig, mel: jax.Array) -> jax.Array:
     [ceil(ceil(T/2)/2)... , output_dim] (conv stride 2, then avg-pool 2;
     chunked exactly like the reference)."""
     t = int(mel.shape[0])
+    if t == 0:
+        raise ValueError("empty mel clip: audio towers need >= 1 frame")
     chunk = cfg.chunk_frames
-    nc = max(1, -(-t // chunk))
+    nc = -(-t // chunk)
     lens = np.full(nc, chunk, np.int64)
     tail = t % chunk
     if tail:

@@ -167,7 +167,7 @@ def _grid_geometry(cfg: VisionTowerConfig, t: int, h: int, w: int):
     # permute raw tokens into window order
     perm = (window_index[:, None] * unit
             + np.arange(unit)[None, :]).reshape(-1)
-    return perm, win_of_raw, freqs[perm]
+    return perm, win_of_raw, freqs[perm], window_index
 
 
 def forward(params, cfg: VisionTowerConfig, pixels: jax.Array,
@@ -180,7 +180,7 @@ def forward(params, cfg: VisionTowerConfig, pixels: jax.Array,
     (pre-window-permutation) order.
     """
     t, h, w = grid_thw
-    perm, win_of, freqs = _grid_geometry(cfg, t, h, w)
+    perm, win_of, freqs, window_index = _grid_geometry(cfg, t, h, w)
     n = pixels.shape[0]
     assert n == t * h * w, (n, grid_thw)
 
@@ -237,7 +237,6 @@ def forward(params, cfg: VisionTowerConfig, pixels: jax.Array,
                     jax.nn.gelu(nn.linear(m["mlp0"], merged),
                                 approximate=False))
     # out rows follow window_index order; invert it
-    window_index = perm.reshape(-1, cfg.merge_unit)[:, 0] // cfg.merge_unit
     inverse = np.argsort(window_index)
     return jnp.take(out, jnp.asarray(inverse), axis=0)
 

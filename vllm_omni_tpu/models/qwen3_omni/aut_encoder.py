@@ -134,13 +134,7 @@ def init_params(key, cfg: AuTEncoderConfig, dtype=jnp.float32):
     return params
 
 
-def sinusoid_positions(length: int, channels: int,
-                       max_timescale: float = 10000.0) -> np.ndarray:
-    """Whisper-style [sin | cos] table (SinusoidsPositionEmbedding)."""
-    inc = math.log(max_timescale) / (channels // 2 - 1)
-    inv = np.exp(-inc * np.arange(channels // 2, dtype=np.float32))
-    t = np.arange(length, dtype=np.float32)[:, None] * inv[None, :]
-    return np.concatenate([np.sin(t), np.cos(t)], axis=1)
+sinusoid_positions = nn.sinusoid_positions
 
 
 def _conv_stack(params, window: jax.Array) -> jax.Array:
