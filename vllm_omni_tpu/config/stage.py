@@ -145,6 +145,7 @@ def load_stage_configs_from_yaml(path: str) -> list[StageConfig]:
 _FAMILY_YAMLS = (
     ("qwen3_omni", "qwen3_omni_moe"),
     ("qwen2_5_omni", "qwen2_5_omni"),
+    ("qwen3_tts", "qwen3_tts"),
     ("qwen_image", "qwen_image"),
 )
 

@@ -117,6 +117,15 @@ class OmniStage:
             if isinstance(factory, str):
                 factory = _import_obj(factory)
             factory_args = args.pop("model_factory_args", {}) or {}
+            if factory_args.get("model_dir") == "required":
+                # a SEPARATE checkpoint the user must supply (e.g. a
+                # speech tokenizer) — fail with guidance instead of a
+                # weight-coverage error against the wrong directory
+                raise ValueError(
+                    f"stage {self.stage_id} needs its own checkpoint "
+                    "(separate from the model path) — set it with "
+                    f"--stage-override '{self.stage_id}."
+                    'model_factory_args={"model_dir": "/path"}\'')
             params, model_cfg, eos = factory(**factory_args)
             # voice registry: engine_args.voices maps name -> conditioning
             # assets (speaker_embedding / reference_mel); the serving

@@ -28,7 +28,6 @@ checkpoint.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 
 import jax

@@ -459,10 +459,15 @@ def load_decoder(model_dir: str, cfg: Tokenizer12HzConfig = None,
         )
     shapes = jax.eval_shape(
         lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
-    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        np_param_dtype,
+    )
+
+    np_dtype = np_param_dtype(dtype)
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np_dtype), shapes)
     flat = hf_flat_map(cfg)
     n, unmapped = load_checkpoint_tree(
-        model_dir, flat.get, tree, dtype=np.float32,
+        model_dir, flat.get, tree, dtype=np_dtype,
         transform=hf_transform,
     )
     n_leaves = len(jax.tree.leaves(tree))
@@ -474,3 +479,11 @@ def load_decoder(model_dir: str, cfg: Tokenizer12HzConfig = None,
         logger.warning("12.5Hz loader: %d unmapped non-encoder tensors "
                        "(e.g. %s)", len(non_encoder), non_encoder[:3])
     return tree, cfg
+
+
+def load_decoder_factory(model_dir: str, dtype="float32"):
+    """model_factory for real-weight 12.5Hz code2wav stages:
+    (params, model, eos)."""
+    jdtype = jnp.dtype(dtype) if isinstance(dtype, str) else dtype
+    params, cfg = load_decoder(model_dir, dtype=jdtype)
+    return params, Tokenizer12HzDecoderModel(cfg), None

@@ -13,11 +13,20 @@ from vllm_omni_tpu.models.stage_input_processors.qwen3_omni import (
 def lm_to_speech_decoder(config, upstream_outputs) -> list[StageRequest]:
     """Strip specials + the text-vocab offset from the LM's sampled stream;
     the pure codec ids become the one-shot vocoder prompt.  Voice
-    conditioning rides additional_information across the hop."""
+    conditioning rides additional_information across the hop.  The
+    codec id range comes from the stage's engine_args
+    (codec_offset/codec_vocab — real checkpoints put codec ids after
+    the 151936-token text vocabulary); tiny defaults otherwise."""
+    eng = getattr(config, "engine_args", None) or {}
+    kw = {}
+    if "codec_offset" in eng:
+        kw["codec_offset"] = int(eng["codec_offset"])
+    if "codec_vocab" in eng:
+        kw["codec_vocab"] = int(eng["codec_vocab"])
     reqs = []
     for out in upstream_outputs:
         toks = out.outputs[0].token_ids if out.outputs else []
-        codec = codec_ids_from_lm_tokens(toks)
+        codec = codec_ids_from_lm_tokens(toks, **kw)
         if not codec:
             # degenerate sample (no codec tokens): emit one silence code
             # rather than an empty prompt the scheduler would reject
