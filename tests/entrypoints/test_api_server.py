@@ -270,3 +270,32 @@ def test_chat_completions_rejected_prompt_returns_error(server_url):
     }, timeout=300)
     assert r.status_code == 400
     assert "max_model_len" in r.json()["error"]["message"]
+
+
+def test_chat_logprobs(server_url):
+    """OpenAI logprobs: per-token logprob + top-k alternatives in the
+    response; greedy sampling must report the argmax (logprob == top of
+    the alternatives list)."""
+    r = httpx.post(f"{server_url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0.0,
+        "logprobs": True, "top_logprobs": 3,
+    }, timeout=120)
+    assert r.status_code == 200
+    body = r.json()
+    lp = body["choices"][0]["logprobs"]["content"]
+    assert len(lp) == 4
+    for entry in lp:
+        assert isinstance(entry["token"], str)
+        assert len(entry["top_logprobs"]) == 3
+        tops = [t["logprob"] for t in entry["top_logprobs"]]
+        assert tops == sorted(tops, reverse=True)
+        # greedy: the sampled token is the argmax
+        assert abs(entry["logprob"] - tops[0]) < 1e-5
+        assert entry["logprob"] <= 0.0
+    # without the flag there is no logprobs block
+    r2 = httpx.post(f"{server_url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 2, "temperature": 0.0,
+    }, timeout=120)
+    assert "logprobs" not in r2.json()["choices"][0]

@@ -208,6 +208,15 @@ def _sampling_from_body(body: dict) -> dict:
     for k in ("temperature", "top_p", "top_k", "seed"):
         if body.get(k) is not None:
             sp[k] = body[k]
+    # OpenAI logprobs: chat sends a boolean + optional top_logprobs
+    # count; legacy /v1/completions sends an integer count directly
+    lp = body.get("logprobs")
+    if lp:
+        k = int(lp) if not isinstance(lp, bool) \
+            else int(body.get("top_logprobs") or 0)
+        if not 0 <= k <= 20:
+            raise ValueError("top_logprobs must be within [0, 20]")
+        sp["logprobs"] = k
     return sp
 
 
@@ -387,7 +396,10 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             return self._error(400, f"bad multimodal content: {e}")
         prompt = ({"prompt": prompt_text, "multi_modal_data": mm}
                   if mm else prompt_text)
-        sp = _sampling_from_body(body)
+        try:
+            sp = _sampling_from_body(body)
+        except ValueError as e:
+            return self._error(400, str(e))
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
         if body.get("stream"):
@@ -435,23 +447,57 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 ]
         n_prompt = len(text_out.prompt_token_ids)
         n_out = sum(len(c.token_ids) for c in text_out.outputs)
+        choice = {
+            "index": 0,
+            "message": message,
+            "finish_reason": (text_out.outputs[0].finish_reason
+                              if text_out.outputs else None),
+        }
+        lp = (text_out.outputs[0].logprobs if text_out.outputs else None)
+        if lp is not None:
+            choice["logprobs"] = {"content": self._logprob_content(
+                text_out.outputs[0].token_ids, lp)}
         self._json(200, {
             "id": rid,
             "object": "chat.completion",
             "created": created,
             "model": body.get("model", self.state.model_name),
-            "choices": [{
-                "index": 0,
-                "message": message,
-                "finish_reason": (text_out.outputs[0].finish_reason
-                                  if text_out.outputs else None),
-            }],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": n_prompt,
                 "completion_tokens": n_out,
                 "total_tokens": n_prompt + n_out,
             },
         })
+
+    def _logprob_content(self, token_ids, entries) -> list:
+        """Runner logprob entries -> OpenAI response shape, tokens
+        decoded through the entry tokenizer when available."""
+        tok = self.state.entry_tokenizer()
+
+        def decode(tid):
+            if tok is None:
+                return str(tid)
+            try:
+                # convert_ids_to_tokens keeps partial-UTF8 BPE pieces
+                # faithful (decode() would emit U+FFFD for them)
+                if hasattr(tok, "convert_ids_to_tokens"):
+                    return tok.convert_ids_to_tokens([int(tid)])[0]
+                return tok.decode([int(tid)])
+            except Exception:
+                return str(tid)
+
+        content = []
+        for tid, e in zip(token_ids, entries):
+            content.append({
+                "token": decode(tid),
+                "logprob": e["logprob"],
+                "top_logprobs": [
+                    {"token": decode(i), "logprob": v}
+                    for i, v in zip(e["top_ids"], e["top_logprobs"])
+                ],
+            })
+        return content
 
     def _chat_chunks(self, out, rid: str, created: int):
         base = {
@@ -461,12 +507,16 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             "model": self.state.model_name,
         }
         if out.final_output_type == "text" and out.outputs:
-            yield {**base, "choices": [{
+            choice = {
                 "index": 0,
                 "delta": {"role": "assistant",
                           "content": out.outputs[0].text},
                 "finish_reason": out.outputs[0].finish_reason,
-            }]}
+            }
+            if out.outputs[0].logprobs is not None:
+                choice["logprobs"] = {"content": self._logprob_content(
+                    out.outputs[0].token_ids, out.outputs[0].logprobs)}
+            yield {**base, "choices": [choice]}
         elif out.final_output_type == "audio" and "audio" in out.multimodal_output:
             # stream the waveform in bounded chunks so playback can start
             # before the full clip is serialized (reference: chunked audio
@@ -501,7 +551,10 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         else:
             return self._error(400, "prompt must be a string, list of "
                                "strings, or list of token ids")
-        sp = _sampling_from_body(body)
+        try:
+            sp = _sampling_from_body(body)
+        except ValueError as e:
+            return self._error(400, str(e))
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
         jobs = [(p, sp, f"{rid}-{i}") for i, p in enumerate(prompts)]
         all_outs = self.state.collect_many(jobs)
@@ -513,11 +566,25 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
                 (o for o in outs if o.final_output_type == "text"), None)
             if text_out is None:
                 return self._error(500, "no text output", "internal_error")
-            choices.append({
+            choice = {
                 "index": i,
                 "text": text_out.outputs[0].text,
                 "finish_reason": text_out.outputs[0].finish_reason,
-            })
+            }
+            entries = text_out.outputs[0].logprobs
+            if entries is not None:
+                content = self._logprob_content(
+                    text_out.outputs[0].token_ids, entries)
+                choice["logprobs"] = {  # legacy completions shape
+                    "tokens": [c["token"] for c in content],
+                    "token_logprobs": [c["logprob"] for c in content],
+                    "top_logprobs": [
+                        {t["token"]: t["logprob"]
+                         for t in c["top_logprobs"]}
+                        for c in content],
+                    "text_offset": [],
+                }
+            choices.append(choice)
         self._json(200, {
             "id": rid,
             "object": "text_completion",

@@ -18,6 +18,8 @@ class CompletionOutput:
     token_ids: list[int]
     text: Optional[str] = None
     finish_reason: Optional[str] = None
+    # per-token logprob dicts when the request asked for logprobs
+    logprobs: Optional[list] = None
 
 
 @dataclass
@@ -98,6 +100,8 @@ class OmniRequestOutput:
                 token_ids=list(request.output_token_ids),
                 text=text,
                 finish_reason=request.finish_reason,
+                logprobs=(list(request.output_logprobs)
+                          if request.output_logprobs else None),
             )],
             stage_id=stage_id,
             final_output_type="text",

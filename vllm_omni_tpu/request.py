@@ -77,6 +77,11 @@ class Request:
     # ----- mutable engine state -----
     status: RequestStatus = RequestStatus.WAITING
     output_token_ids: list[int] = field(default_factory=list)
+    # per-output-token logprob entries when sampling_params.logprobs is
+    # set: {"logprob": float, "top_ids": [...], "top_logprobs": [...]}
+    # (spec-decode multi-accept steps skip entries — the verify path
+    # has no per-token sampling distribution to report)
+    output_logprobs: list = field(default_factory=list)
     num_computed_tokens: int = 0
     kv_transfer: KVTransferState = KVTransferState.NONE
     # block-id snapshot taken at transfer trigger, truncated to seq len

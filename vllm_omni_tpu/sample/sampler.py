@@ -16,6 +16,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -126,6 +128,25 @@ def sample_tokens(
 
     sampled_ids = jax.vmap(draw)(keys, scaled).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def compute_logprobs(
+    logits: jax.Array,   # [B, vocab]
+    tokens: jax.Array,   # [B] sampled ids
+    k: int,
+):
+    """Log-softmax logprob of each sampled token plus the top-k
+    alternatives (OpenAI logprobs semantics; reference rides vLLM's
+    sampler logprobs)."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(lp, tokens[:, None].astype(jnp.int32),
+                                 axis=-1)[:, 0]
+    if k <= 0:
+        b = logits.shape[0]
+        return chosen, jnp.zeros((b, 0), jnp.float32),             jnp.zeros((b, 0), jnp.int32)
+    top_v, top_i = jax.lax.top_k(lp, k)
+    return chosen, top_v, top_i.astype(jnp.int32)
 
 
 @jax.jit

@@ -30,6 +30,9 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
+    # OpenAI-style logprobs: None = off; k >= 0 returns the sampled
+    # token's logprob plus the top-k alternatives per step (capped at 8)
+    logprobs: "Optional[int]" = None
 
     def __post_init__(self):
         if self.max_tokens < 1:

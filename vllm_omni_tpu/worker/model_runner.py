@@ -594,6 +594,11 @@ class ARModelRunner:
             s = out.sampled.get(req.request_id)
             if s is None:
                 continue
+            if req.sampling_params.logprobs is not None:
+                # multi-token verify accepts have no per-token sampling
+                # distribution to report — logprobs requests stay on the
+                # one-token-per-step path so entries align 1:1
+                continue
             # greedy requests verify by argmax match; sampled requests by
             # rejection sampling (_rejection_accept) — both draft
             new = s if isinstance(s, list) else [s]
@@ -668,6 +673,26 @@ class ARModelRunner:
             tokens = np.asarray(jax.device_get(tokens))
             for i, sc in sampling:
                 out.sampled[sc.request.request_id] = int(tokens[i])
+            want_lp = [(i, sc) for i, sc in sampling
+                       if sc.request.sampling_params.logprobs is not None]
+            if want_lp:
+                from vllm_omni_tpu.sample.sampler import compute_logprobs
+
+                k = min(20, max(int(sc.request.sampling_params.logprobs
+                                    or 0) for _, sc in want_lp))
+                chosen, top_v, top_i = compute_logprobs(
+                    logits, jnp.asarray(tokens), k)
+                chosen = np.asarray(jax.device_get(chosen))
+                top_v = np.asarray(jax.device_get(top_v))
+                top_i = np.asarray(jax.device_get(top_i))
+                for i, sc in want_lp:
+                    kk = min(k, int(sc.request.sampling_params.logprobs
+                                    or 0))
+                    sc.request.output_logprobs.append({
+                        "logprob": float(chosen[i]),
+                        "top_ids": top_i[i, :kk].tolist(),
+                        "top_logprobs": top_v[i, :kk].tolist(),
+                    })
         if self.collect_hidden:
             # per-request hidden payloads for the next stage (reference
             # pooler_output slicing, gpu_ar_model_runner.py:525-568)
