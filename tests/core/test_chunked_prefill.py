@@ -205,11 +205,9 @@ def test_engine_chunked_multi_request_parity():
     assert run(True, 16) == run(False, 2048)
 
 
-def test_resumed_request_chunks_generated_suffix():
-    """A preempted request recomputes prompt + generated tokens in chunks,
-    not one decode step at a time (code-review finding: the continuation
-    branch must gate on num_tokens, not num_prompt_tokens)."""
-    kv = KVCacheManager(num_pages=64, page_size=4)
+def _resume_after_preempt(prefix_caching: bool):
+    kv = KVCacheManager(num_pages=64, page_size=4,
+                        enable_prefix_caching=prefix_caching)
     sched = ARScheduler(SchedulerConfig(
         max_num_seqs=4, max_num_batched_tokens=8, max_model_len=256,
         enable_chunked_prefill=True), kv)
@@ -223,9 +221,17 @@ def test_resumed_request_chunks_generated_suffix():
         assert len(out.decodes) == 1
         sched.update_from_output(out, {"r": t})
     assert req.num_tokens == 16
-    # preempt: recompute from scratch
+    # preempt: pages free (registering full prompt pages when caching)
     sched._preempt(req)
     assert req.num_computed_tokens == 0
+    return sched, req
+
+
+def test_resumed_request_chunks_generated_suffix():
+    """A preempted request recomputes prompt + generated tokens in chunks,
+    not one decode step at a time (code-review finding: the continuation
+    branch must gate on num_tokens, not num_prompt_tokens)."""
+    sched, req = _resume_after_preempt(prefix_caching=False)
     # resume: admission chunk of 8, then the *running* branch must chunk
     # the remaining 8 (which includes generated tokens) in ONE prefill
     out = sched.schedule()
@@ -237,6 +243,22 @@ def test_resumed_request_chunks_generated_suffix():
     # chunk covers through num_tokens-1... the final recompute chunk ends
     # at num_tokens (16), whose last row resamples the next token
     assert out.prefills[0].num_new_tokens == 8
+
+
+def test_resumed_request_reuses_cached_prefix():
+    """With automatic prefix caching, preemption registers the full
+    prompt pages; resume adopts them and recomputes ONLY the
+    tail (prompt remainder + generated tokens) in one chunk."""
+    sched, req = _resume_after_preempt(prefix_caching=True)
+    out = sched.schedule()
+    # 8 prompt tokens rode the cache: only tokens 8..15 recompute
+    assert req.num_computed_tokens == 8
+    assert len(out.prefills) == 1
+    assert out.prefills[0].start_pos == 8
+    assert out.prefills[0].num_new_tokens == 8
+    assert sched.kv.prefix_hit_tokens == 8
+    sched.update_from_output(out, {"r": 7})
+    assert req.num_computed_tokens == 16
 
 
 def test_intake_accepts_long_prompt_when_chunked():

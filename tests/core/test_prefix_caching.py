@@ -1,0 +1,157 @@
+"""Automatic prefix caching (vLLM-core APC semantics over the paged
+pool): content-addressed page reuse must be token-identical to cold
+prefill through the real engine, shared pages must refcount across
+concurrent tables, and cached pages must evict under pressure without
+shrinking capacity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.request import Request
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+def _req(rid, ids, **kw):
+    return Request(request_id=rid, prompt_token_ids=list(ids),
+                   sampling_params=SamplingParams(**kw))
+
+
+# --------------------------------------------------------- manager unit
+def test_match_requires_producer_free():
+    kv = KVCacheManager(num_pages=16, page_size=4)
+    a = _req("a", range(1, 11))
+    assert kv.match_prefix(a) == 0          # cold cache
+    kv.allocate(a, 10)
+    a.num_computed_tokens = 10
+    b = _req("b", range(1, 11))
+    assert kv.match_prefix(b) == 0          # producer still live
+    kv.free(a)
+    c = _req("c", range(1, 11))
+    assert kv.match_prefix(c) == 8          # 2 full pages of 4
+    assert c.num_computed_tokens == 8
+    assert len(kv.block_table("c")) == 2
+
+
+def test_shared_pages_refcount_across_tables():
+    kv = KVCacheManager(num_pages=16, page_size=4)
+    a = _req("a", range(1, 11))
+    kv.allocate(a, 10); a.num_computed_tokens = 10
+    kv.free(a)
+    b = _req("b", range(1, 11))
+    c = _req("c", range(1, 11))
+    assert kv.match_prefix(b) == 8
+    assert kv.match_prefix(c) == 8
+    assert kv.block_table("b")[:2] == kv.block_table("c")[:2]
+    # shared pages are not evictable while referenced
+    free_before = kv.num_free_pages
+    kv.free(b)
+    kv.free(c)
+    # after both release, the cached pages are evictable again
+    assert kv.num_free_pages >= free_before
+
+
+def test_divergent_prompt_matches_only_common_prefix():
+    kv = KVCacheManager(num_pages=16, page_size=4)
+    a = _req("a", [1, 2, 3, 4, 5, 6, 7, 8, 9])
+    kv.allocate(a, 9); a.num_computed_tokens = 9
+    kv.free(a)
+    # same first page, different second page
+    b = _req("b", [1, 2, 3, 4, 99, 98, 97, 96, 95])
+    assert kv.match_prefix(b) == 4
+
+
+def test_cached_pages_evict_under_pressure():
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    a = _req("a", range(1, 17))          # fills all 4 pages
+    kv.allocate(a, 16); a.num_computed_tokens = 16
+    kv.free(a)
+    assert kv.num_free_pages == 4        # cached but allocatable
+    # a new unrelated request takes every page — cache evicts silently
+    b = _req("b", range(100, 116))
+    table = kv.allocate(b, 16)
+    assert table is not None and len(table) == 4
+    # the old prefix is gone now
+    c = _req("c", range(1, 17))
+    b.num_computed_tokens = 16
+    kv.free(b)
+    # b's pages registered for ITS prompt; a's hashes were evicted
+    assert kv.match_prefix(c) == 0
+
+
+def test_embeds_prompts_never_match():
+    kv = KVCacheManager(num_pages=16, page_size=4)
+    a = _req("a", range(1, 11))
+    a.prompt_embeds = np.zeros((10, 8), np.float32)
+    kv.allocate(a, 10); a.num_computed_tokens = 10
+    kv.free(a)
+    b = _req("b", range(1, 11))
+    b.prompt_embeds = np.zeros((10, 8), np.float32)
+    assert kv.match_prefix(b) == 0
+
+
+# ------------------------------------------------------------ engine e2e
+def test_cache_hit_is_token_identical():
+    """The hot path (cached prefix + chunked continuation) must produce
+    the same tokens as the cold path, and different prompts must not
+    cross-contaminate."""
+    from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.models.common import transformer as tfm
+
+    cfg = tfm.TransformerConfig.tiny(vocab_size=128)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+
+    def run(engine, rid, ids):
+        outs = engine.generate(
+            [list(ids)], SamplingParams(temperature=0.0, max_tokens=6))
+        return outs[0].outputs[0].token_ids
+
+    prompt = list(range(1, 40))          # several full pages
+    other = list(range(50, 89))
+
+    cold = LLMEngine(params, cfg, EngineConfig(
+        num_pages=64, page_size=8, enable_prefix_caching=False))
+    want = run(cold, "w", prompt)
+    want_other = run(cold, "x", other)
+
+    hot = LLMEngine(params, cfg, EngineConfig(
+        num_pages=64, page_size=8, enable_prefix_caching=True))
+    first = run(hot, "a", prompt)        # cold fill, registers pages
+    assert first == want
+    assert hot.scheduler.kv.prefix_hit_tokens == 0
+    second = run(hot, "b", prompt)       # cache hit
+    assert second == want
+    assert hot.scheduler.kv.prefix_hit_tokens > 0
+    # unrelated prompt: no contamination from the cached pages
+    assert run(hot, "c", other) == want_other
+    # shared-prefix-divergent-tail prompt reuses only the common pages
+    variant = prompt[:16] + [101, 102, 103]
+    v_cold = run(cold, "y", variant)
+    assert run(hot, "d", variant) == v_cold
+
+
+def test_pinned_shared_page_survives_until_ack():
+    """A transfer-pinned shared cache page must not become evictable (a
+    new allocation would overwrite KV mid-transfer) and must release
+    exactly once at ACK (code-review scenario)."""
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    a = _req("a", range(1, 9))
+    kv.allocate(a, 8); a.num_computed_tokens = 8
+    kv.free(a)                            # 2 pages registered
+    b = _req("b", range(1, 9))
+    assert kv.match_prefix(b) == 4        # adopts page 0 (7 usable)
+    shared = kv.block_table("b")[0]
+    kv.pin_for_transfer(b, 4)             # pin the shared page
+    kv.free(b)                            # producer gone, ref -> 0
+    # pinned page must NOT be allocatable: exhaust everything else
+    grabber = _req("g", range(100, 116))
+    t = kv.allocate(grabber, 12)          # 3 pages max available
+    assert t is not None and shared not in t
+    assert not kv.can_allocate(_req("h", [1]), 1)
+    # ACK releases it (back to evictable — allocatable again)
+    kv.ack_transfer("b")
+    assert kv.can_allocate(_req("h", [1]), 1)
+    h = _req("h", [1, 2])
+    th = kv.allocate(h, 2)
+    assert th == [shared]

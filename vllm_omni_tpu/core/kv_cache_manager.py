@@ -1,9 +1,17 @@
-"""Host-side paged KV-cache block pool.
+"""Host-side paged KV-cache block pool with automatic prefix caching.
 
 The behavioral port of vLLM's KVCacheManager slice that the reference's
 ``OmniARScheduler`` leans on (reference: core/sched/omni_ar_scheduler.py —
 block allocation during schedule(), block-id snapshots for KV transfer at
-:553-594, delayed free until extraction ACK at :444-546).
+:553-594, delayed free until extraction ACK at :444-546), plus the
+content-addressed prefix cache the reference inherits from vLLM core:
+full prompt pages register under a chained content hash when their
+producing request frees; a new request whose prompt shares the prefix
+adopts those pages (refcounted, shared across concurrent tables) and
+starts computing mid-prompt — the runner's chunked-continuation path
+attends the cached context exactly like a resumed chunked prefill.
+Cached pages with no live references stay allocatable (LRU-evicted on
+demand), so prefix caching never reduces effective capacity.
 
 Device arrays never appear here: this class hands out integer page ids; the
 model runner turns them into ``block_tables`` / ``slot_mapping`` arrays for
@@ -14,28 +22,42 @@ caches stay aligned (same layout the TPU kernel wants).
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from typing import Optional
 
 from vllm_omni_tpu.request import Request
 
 
 class KVCacheManager:
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int,
+                 enable_prefix_caching: bool = True):
         if num_pages < 1 or page_size < 1:
             raise ValueError("num_pages and page_size must be positive")
         self.num_pages = num_pages
         self.page_size = page_size
+        self.enable_prefix_caching = enable_prefix_caching
         self._free: list[int] = list(range(num_pages))
         # request_id -> allocated page ids, in sequence order
         self._tables: dict[str, list[int]] = {}
         # pages pinned by an in-flight KV transfer even after request free
         # (reference: delayed _free_request while transfer ACTIVE)
         self._pinned: dict[str, list[int]] = {}
+        # ---- prefix cache state ----
+        # chain-hash -> page holding that full prompt page's KV
+        self._cached: dict[str, int] = {}
+        self._hash_of: dict[int, str] = {}        # page -> its hash
+        self._ref: dict[int, int] = {}            # live refs per cached page
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()  # LRU
+        # cache effectiveness counters (surfaced by engine stats)
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
 
     # ------------------------------------------------------------- queries
     @property
     def num_free_pages(self) -> int:
-        return len(self._free)
+        # evictable cached pages are allocatable on demand
+        return len(self._free) + len(self._evictable)
 
     def pages_needed(self, num_tokens: int) -> int:
         return -(-num_tokens // self.page_size)
@@ -46,7 +68,111 @@ class KVCacheManager:
     def can_allocate(self, request: Request, num_new_tokens: int) -> bool:
         have = len(self._tables.get(request.request_id, ()))
         need = self.pages_needed(request.num_computed_tokens + num_new_tokens)
-        return need - have <= len(self._free)
+        return need - have <= self.num_free_pages
+
+    # ------------------------------------------------------- prefix cache
+    def _page_hashes(self, token_ids, max_pages: Optional[int] = None):
+        """Chained content hashes of the FULL pages of ``token_ids``."""
+        hashes = []
+        prev = b""
+        n_full = len(token_ids) // self.page_size
+        if max_pages is not None:
+            n_full = min(n_full, max_pages)
+        for p in range(n_full):
+            chunk = token_ids[p * self.page_size: (p + 1) * self.page_size]
+            h = hashlib.blake2b(
+                prev + b"," + repr(list(chunk)).encode(), digest_size=16
+            ).hexdigest()
+            hashes.append(h)
+            prev = h.encode()
+        return hashes
+
+    def match_prefix(self, request: Request) -> int:
+        """Adopt cached pages covering the longest full-page prefix of
+        the request's prompt; returns the number of tokens whose KV the
+        request now starts with (``num_computed_tokens`` is updated and
+        the pages seed its block table).  At least one prompt token is
+        always left to compute — its forward produces the first logits.
+        Embeds-based prompts never match (their placeholder ids carry no
+        content)."""
+        if (not self.enable_prefix_caching
+                or request.prompt_embeds is not None
+                or request.num_computed_tokens
+                or request.request_id in self._tables):
+            return 0
+        # leave >= 1 token to compute; hashes memoize on the request —
+        # a head-of-queue request blocked on pages re-matches every
+        # scheduler step and must not re-hash its whole prompt each time
+        usable = len(request.prompt_token_ids) - 1
+        hashes = getattr(request, "_apc_hashes", None)
+        if hashes is None:
+            hashes = self._page_hashes(request.prompt_token_ids,
+                                       max_pages=usable // self.page_size)
+            request._apc_hashes = hashes
+        pages = []
+        for h in hashes:
+            page = self._cached.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        if not pages:
+            return 0
+        for page in pages:
+            self._ref[page] = self._ref.get(page, 0) + 1
+            self._evictable.pop(page, None)
+        self._tables[request.request_id] = list(pages)
+        matched = len(pages) * self.page_size
+        request.num_computed_tokens = matched
+        self.prefix_hits += 1
+        self.prefix_hit_tokens += matched
+        return matched
+
+    def _register_pages(self, request: Request, table: list[int],
+                        candidates: set) -> set:
+        """Content-register the request's full PROMPT pages at free time
+        (pages become shareable once their producer completes).  Only
+        pages in ``candidates`` are considered; returns the set of pages
+        the cache consumed (now evictable, NOT to be freed)."""
+        consumed: set = set()
+        if (not self.enable_prefix_caching
+                or request.prompt_embeds is not None):
+            return consumed
+        hashes = self._page_hashes(request.prompt_token_ids)
+        # only pages whose KV was actually computed/valid
+        valid = min(len(hashes),
+                    request.num_computed_tokens // self.page_size,
+                    len(table))
+        for h, page in zip(hashes[:valid], table[:valid]):
+            if page not in candidates:
+                continue
+            old = self._cached.get(h)
+            if old is not None and old != page:
+                # prefix already cached by another producer: keep the
+                # old page; this one frees normally
+                continue
+            self._cached[h] = page
+            self._hash_of[page] = h
+            self._evictable[page] = None
+            self._evictable.move_to_end(page)
+            consumed.add(page)
+        return consumed
+
+    def _evict_one(self) -> Optional[int]:
+        """Drop the least-recently-used unreferenced cached page back to
+        the free pool."""
+        if not self._evictable:
+            return None
+        page, _ = self._evictable.popitem(last=False)
+        h = self._hash_of.pop(page, None)
+        if h is not None:
+            self._cached.pop(h, None)
+        self._ref.pop(page, None)
+        return page
+
+    def _take_free_page(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        return self._evict_one()
 
     # ---------------------------------------------------------- allocation
     def allocate(self, request: Request, num_new_tokens: int) -> Optional[list[int]]:
@@ -55,10 +181,13 @@ class KVCacheManager:
         table = self._tables.setdefault(request.request_id, [])
         need = self.pages_needed(request.num_computed_tokens + num_new_tokens)
         grow = need - len(table)
-        if grow > len(self._free):
+        if grow > self.num_free_pages:
             return None
         for _ in range(max(grow, 0)):
-            table.append(self._free.pop())
+            page = self._take_free_page()
+            if page is None:
+                return None
+            table.append(page)
         return list(table)
 
     def slot_mapping(self, request: Request, num_new_tokens: int) -> list[int]:
@@ -76,14 +205,39 @@ class KVCacheManager:
     # ---------------------------------------------------------------- free
     def free(self, request: Request) -> None:
         """Release the request's pages — unless a KV transfer pinned them
-        (then they are released by ack_transfer)."""
+        (then they are released by ack_transfer).  Full prompt pages
+        register in the prefix cache instead of returning to the free
+        pool (they remain allocatable via LRU eviction)."""
         table = self._tables.pop(request.request_id, None)
         if table is None:
             return
         pinned = set(self._pinned.get(request.request_id, ()))
+        private = []
         for page in table:
-            if page not in pinned:
-                self._free.append(page)
+            if page in self._ref:
+                # shared cache page: drop this request's reference;
+                # unreferenced registered pages become LRU-evictable —
+                # UNLESS pinned by an in-flight transfer (eviction would
+                # hand the page to a new request mid-read; ack_transfer
+                # releases it)
+                self._ref[page] -= 1
+                if self._ref[page] <= 0:
+                    self._ref.pop(page, None)
+                    if page in pinned:
+                        pass  # released by ack_transfer
+                    elif page in self._hash_of:
+                        self._evictable[page] = None
+                        self._evictable.move_to_end(page)
+                    else:
+                        self._free.append(page)
+                continue
+            private.append(page)
+        consumed = self._register_pages(
+            request, table, candidates=set(private) - pinned)
+        for page in private:
+            if page in pinned or page in consumed:
+                continue
+            self._free.append(page)
 
     def pin_for_transfer(self, request: Request, seq_len: int) -> list[int]:
         """Snapshot + pin the pages holding the first ``seq_len`` tokens
@@ -97,9 +251,17 @@ class KVCacheManager:
 
     def ack_transfer(self, request_id: str) -> None:
         """Extraction ACK: release pinned pages not still in a live table
-        (reference: free on kv_extracted_req_ids, omni_ar_scheduler.py:444)."""
+        (reference: free on kv_extracted_req_ids, omni_ar_scheduler.py:444).
+        Registered pages whose producer already freed become evictable
+        here; re-shared pages (ref > 0) stay live."""
         pinned = self._pinned.pop(request_id, [])
         live = set(self._tables.get(request_id, ()))
         for page in pinned:
-            if page not in live:
+            if page in live or page in self._ref:
+                continue
+            if page in self._hash_of:
+                if page not in self._evictable:
+                    self._evictable[page] = None
+                self._evictable.move_to_end(page)
+            else:
                 self._free.append(page)

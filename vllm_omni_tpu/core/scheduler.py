@@ -283,6 +283,12 @@ class ARScheduler:
         # recomputing KV for its prompt *and* its already-generated tokens.
         while self.waiting and budget > 0 and len(self.running) < self.config.max_num_seqs:
             req = self.waiting[0]
+            if req.num_computed_tokens == 0 and not req.awaiting_chunks:
+                # automatic prefix caching: adopt cached pages covering
+                # the longest full-page prompt prefix; the request then
+                # prefills from mid-prompt through the runner's
+                # chunked-continuation path (vLLM-core APC semantics)
+                self.kv.match_prefix(req)
             remaining = req.num_tokens - req.num_computed_tokens
             if remaining <= 0 and req.awaiting_chunks:
                 # streaming request admitted before its first chunk has

@@ -47,6 +47,11 @@ class EngineConfig:
     max_num_batched_tokens: int = 2048
     worker_type: str = "ar"  # "ar" | "generation"
     enable_chunked_prefill: bool = False
+    # automatic prefix caching: full prompt pages register under a
+    # content hash when their producer frees; later requests sharing the
+    # prefix skip recomputing it (vLLM-core APC; cached pages stay
+    # allocatable via LRU eviction, so capacity is unaffected)
+    enable_prefix_caching: bool = True
     # speculative decoding: drafts per step (needs a draft_fn — the MTP
     # head, models/qwen3_omni/mtp.py); greedy requests only
     num_speculative_tokens: int = 0
@@ -67,7 +72,15 @@ class LLMEngine:
         config = config if config is not None else EngineConfig()
         self.config = config
         self.eos_token_id = eos_token_id
-        kv = KVCacheManager(config.num_pages, config.page_size)
+        # prefix caching skips the forward for cached positions, so it
+        # cannot coexist with collect_hidden (downstream stages need the
+        # hidden row of EVERY prompt position) — thinker-style stages
+        # run uncached, plain LM serving gets APC
+        kv = KVCacheManager(config.num_pages, config.page_size,
+                            enable_prefix_caching=(
+                                config.enable_prefix_caching
+                                and config.worker_type == "ar"
+                                and not config.collect_hidden))
         sched_cfg = SchedulerConfig(
             max_num_seqs=config.max_num_seqs,
             max_num_batched_tokens=config.max_num_batched_tokens,

@@ -211,7 +211,9 @@ def _sampling_from_body(body: dict) -> dict:
     # OpenAI logprobs: chat sends a boolean + optional top_logprobs
     # count; legacy /v1/completions sends an integer count directly
     lp = body.get("logprobs")
-    if lp:
+    if lp is not None and lp is not False:
+        # chat: logprobs=true + top_logprobs=k; legacy completions:
+        # logprobs=k directly (0 is valid — chosen-token logprob only)
         k = int(lp) if not isinstance(lp, bool) \
             else int(body.get("top_logprobs") or 0)
         if not 0 <= k <= 20:

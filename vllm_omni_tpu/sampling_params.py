@@ -30,11 +30,13 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
-    # OpenAI-style logprobs: None = off; k >= 0 returns the sampled
-    # token's logprob plus the top-k alternatives per step (capped at 8)
+    # OpenAI-style logprobs: None = off; 0..20 returns the sampled
+    # token's logprob plus that many top alternatives per step
     logprobs: "Optional[int]" = None
 
     def __post_init__(self):
+        if self.logprobs is not None and not 0 <= int(self.logprobs) <= 20:
+            raise ValueError("logprobs must be within [0, 20]")
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
         if not 0.0 < self.top_p <= 1.0:
