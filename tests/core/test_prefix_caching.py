@@ -155,3 +155,15 @@ def test_pinned_shared_page_survives_until_ack():
     h = _req("h", [1, 2])
     th = kv.allocate(h, 2)
     assert th == [shared]
+
+
+def test_stats_summary_reports_cache_hits():
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    omni = Omni(model="qwen3-tts-tiny")
+    prompt = list(range(1, 40))
+    omni.generate([prompt], [{"temperature": 0.0, "max_tokens": 4}])
+    omni.generate([prompt], [{"temperature": 0.0, "max_tokens": 4}])
+    summ = omni.stats_summary()
+    pc = summ["stages"][0].get("prefix_cache")
+    assert pc is not None and pc["hit_tokens"] > 0

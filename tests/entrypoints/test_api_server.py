@@ -299,3 +299,20 @@ def test_chat_logprobs(server_url):
         "max_tokens": 2, "temperature": 0.0,
     }, timeout=120)
     assert "logprobs" not in r2.json()["choices"][0]
+
+
+def test_chat_n_choices(server_url):
+    """n > 1 returns n independent choices (distinct seeds when seeded)."""
+    r = httpx.post(f"{server_url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3, "n": 3, "temperature": 0.9, "seed": 11,
+    }, timeout=180)
+    assert r.status_code == 200
+    choices = r.json()["choices"]
+    assert [c["index"] for c in choices] == [0, 1, 2]
+    assert all(c["message"]["role"] == "assistant" for c in choices)
+    # out-of-range n is a 400
+    r2 = httpx.post(f"{server_url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}], "n": 99,
+    }, timeout=60)
+    assert r2.status_code == 400

@@ -335,6 +335,14 @@ class LLMEngine:
                 or self.scheduler.has_pending_errored)
 
     # ---------------------------------------------------------------- step
+    @property
+    def prefix_cache_stats(self) -> dict:
+        """APC effectiveness counters (vLLM-core cache hit metrics)."""
+        kv = self.scheduler.kv
+        return {"hits": getattr(kv, "prefix_hits", 0),
+                "hit_tokens": getattr(kv, "prefix_hit_tokens", 0),
+                "enabled": getattr(kv, "enable_prefix_caching", False)}
+
     def step(self) -> list[OmniRequestOutput]:
         # surface intake-rejected requests as errored outputs instead of
         # silently dropping them

@@ -250,6 +250,19 @@ class Omni:
                 self.metrics.record_stage_request(s)
             stage.request_stats.clear()
 
+    def stats_summary(self) -> dict:
+        """Aggregator summary enriched with per-stage engine counters
+        (prefix-cache hits for in-proc AR stages)."""
+        summ = self.metrics.summary()
+        for stage in self.stages:
+            eng = getattr(stage, "engine", None)
+            pcs = getattr(eng, "prefix_cache_stats", None)
+            if pcs and pcs.get("enabled"):
+                summ["stages"].setdefault(stage.config.stage_id, {})[
+                    "prefix_cache"] = {k: pcs[k]
+                                       for k in ("hits", "hit_tokens")}
+        return summ
+
     def shutdown(self) -> None:
         """Stop process-disaggregated stage workers (no-op for in-proc
         stages)."""

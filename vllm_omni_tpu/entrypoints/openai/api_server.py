@@ -316,7 +316,7 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         elif self.path == "/version":
             self._json(200, {"version": __version__})
         elif self.path == "/metrics":
-            summary = self.state.omni.metrics.summary()
+            summary = self.state.omni._omni.stats_summary() if hasattr(self.state.omni, '_omni') else self.state.omni.metrics.summary()
             # device memory snapshot (per-process accounting analogue,
             # reference: worker/gpu_memory_utils.py NVML probes)
             from vllm_omni_tpu.platforms import current_platform
@@ -404,7 +404,16 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
+        try:
+            n = int(body.get("n") or 1)
+        except (TypeError, ValueError):
+            return self._error(400, "n must be an integer")
+        if not 1 <= n <= 16:
+            return self._error(400, "n must be within [1, 16]")
         if body.get("stream"):
+            if n > 1:
+                return self._error(400, "streaming with n > 1 is not "
+                                   "supported")
             self._sse_start()
             for out in self.state.stream(prompt, sp, rid):
                 if isinstance(out, Exception):
@@ -419,52 +428,70 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             self._sse_send("[DONE]")
             self._sse_end()
             return
-        outs = self.state.collect(prompt, sp, rid)
-        if self._surface_error(outs):
-            return
-        text_out = next((o for o in outs if o.final_output_type == "text"),
-                        outs[0] if outs else None)
-        if text_out is None:
-            return self._error(500, "pipeline produced no output",
-                               "internal_error")
-        message: dict[str, Any] = {
-            "role": "assistant",
-            "content": (text_out.outputs[0].text
-                        if text_out.outputs else None),
-        }
-        # multimodal finals ride OpenAI-style audio/images extensions
-        # (reference: audio/image choices, serving_chat.py:1617,1683)
-        for o in outs:
-            if o.final_output_type == "audio" and "audio" in o.multimodal_output:
-                wav = np.asarray(o.multimodal_output["audio"], np.float32)
-                message["audio"] = {
-                    "id": f"audio-{rid}",
-                    "data": base64.b64encode(wav.tobytes()).decode(),
-                    "format": "f32le",
-                }
-            elif o.final_output_type == "image" and o.images:
-                message["images"] = [
-                    {"b64_json": _b64_png(np.asarray(img))}
-                    for img in o.images
-                ]
-        n_prompt = len(text_out.prompt_token_ids)
-        n_out = sum(len(c.token_ids) for c in text_out.outputs)
-        choice = {
-            "index": 0,
-            "message": message,
-            "finish_reason": (text_out.outputs[0].finish_reason
-                              if text_out.outputs else None),
-        }
-        lp = (text_out.outputs[0].logprobs if text_out.outputs else None)
-        if lp is not None:
-            choice["logprobs"] = {"content": self._logprob_content(
-                text_out.outputs[0].token_ids, lp)}
+        # n choices fan out as independent requests with distinct seeds
+        # (vLLM n>1 semantics; batching stages batch them) — n == 1 is
+        # the one-job case of the same loop
+        base_seed = sp.get("seed")
+        jobs = []
+        for i in range(n):
+            spi = dict(sp)
+            if base_seed is not None and n > 1:
+                spi["seed"] = int(base_seed) + i
+            jobs.append((prompt, spi, rid if n == 1 else f"{rid}-{i}"))
+        all_outs = self.state.collect_many(jobs)
+        choices = []
+        n_prompt = n_out = 0
+        for i, outs in enumerate(all_outs):
+            if self._surface_error(outs):
+                return
+            text_out = next(
+                (o for o in outs if o.final_output_type == "text"),
+                outs[0] if outs else None)
+            if text_out is None:
+                return self._error(500, "pipeline produced no output",
+                                   "internal_error")
+            message: dict[str, Any] = {
+                "role": "assistant",
+                "content": (text_out.outputs[0].text
+                            if text_out.outputs else None),
+            }
+            # multimodal finals ride OpenAI-style audio/images extensions
+            # (reference: audio/image choices, serving_chat.py:1617,1683)
+            for o in outs:
+                if o.final_output_type == "audio" \
+                        and "audio" in o.multimodal_output:
+                    wav = np.asarray(o.multimodal_output["audio"],
+                                     np.float32)
+                    message["audio"] = {
+                        "id": f"audio-{rid}-{i}",
+                        "data": base64.b64encode(wav.tobytes()).decode(),
+                        "format": "f32le",
+                    }
+                elif o.final_output_type == "image" and o.images:
+                    message["images"] = [
+                        {"b64_json": _b64_png(np.asarray(img))}
+                        for img in o.images
+                    ]
+            n_prompt = len(text_out.prompt_token_ids)
+            n_out += sum(len(c.token_ids) for c in text_out.outputs)
+            choice = {
+                "index": i,
+                "message": message,
+                "finish_reason": (text_out.outputs[0].finish_reason
+                                  if text_out.outputs else None),
+            }
+            lp = (text_out.outputs[0].logprobs
+                  if text_out.outputs else None)
+            if lp is not None:
+                choice["logprobs"] = {"content": self._logprob_content(
+                    text_out.outputs[0].token_ids, lp)}
+            choices.append(choice)
         self._json(200, {
             "id": rid,
             "object": "chat.completion",
             "created": created,
             "model": body.get("model", self.state.model_name),
-            "choices": [choice],
+            "choices": choices,
             "usage": {
                 "prompt_tokens": n_prompt,
                 "completion_tokens": n_out,
