@@ -1,0 +1,69 @@
+"""Qwen2.5-Omni multimodal front end over the checkpoint towers: the
+image flatten must match the HF Qwen2VL processor order exactly, and
+the processor must produce aligned embeds/positions through the shared
+placeholder machinery."""
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.models.qwen2_5_omni import multimodal as mm
+from vllm_omni_tpu.models.qwen2_5_omni import vision_tower as vt
+
+
+def test_flatten_matches_hf_image_processor():
+    transformers = pytest.importorskip("transformers")
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        Qwen2VLImageProcessor,
+    )
+
+    cfg = vt.VisionTowerConfig.tiny()  # patch 4, merge 2, temporal 2
+    rng = np.random.default_rng(0)
+    img = (rng.uniform(0, 255, (16, 24, 3))).astype(np.uint8)
+    pixels, grid = mm.flatten_image(img, cfg)
+
+    proc = Qwen2VLImageProcessor(
+        patch_size=cfg.patch_size, merge_size=cfg.spatial_merge_size,
+        temporal_patch_size=cfg.temporal_patch_size,
+        do_resize=False)
+    out = proc(images=[img], return_tensors="np")
+    want = out["pixel_values"]
+    want_grid = tuple(out["image_grid_thw"][0].tolist())
+    assert grid == want_grid
+    np.testing.assert_allclose(pixels, want, atol=2e-5, rtol=1e-4)
+
+
+def test_tiny_processor_embeds_and_positions():
+    import jax
+    import jax.numpy as jnp
+
+    from vllm_omni_tpu.models.common.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig.tiny(vocab_size=64)
+    params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    proc = mm.build_tiny_processor(params, cfg)
+    rng = np.random.default_rng(1)
+    img = (rng.uniform(0, 255, (16, 16, 3))).astype(np.uint8)
+    wav = np.sin(np.linspace(0, 40, 2000)).astype(np.float32)
+    out = proc([1, 2, 3], {"image": [img], "audio": [wav]})
+    s = len(out.prompt_token_ids)
+    assert out.prompt_embeds.shape == (s, cfg.hidden_size)
+    assert out.mrope_positions.shape == (3, s)
+    assert np.isfinite(out.prompt_embeds).all()
+    # image tokens = merged grid (16/4/2)^2 = 4
+    assert out.prompt_token_ids.count(64 - 3) == 4
+    assert out.prompt_token_ids.count(64 - 2) >= 1
+
+
+def test_smart_resize_matches_hf():
+    transformers = pytest.importorskip("transformers")
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        smart_resize as hf_smart_resize,
+    )
+
+    for h, w in ((512, 768), (4320, 7680), (30, 41), (28, 28)):
+        ours = mm.smart_resize(h, w, 28)
+        theirs = hf_smart_resize(h, w, factor=28)
+        assert ours == tuple(theirs), (h, w, ours, theirs)

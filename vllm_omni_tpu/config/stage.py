@@ -188,9 +188,10 @@ def load_stage_configs_from_model(
             if ("model" not in s.engine_args
                     and "model_factory" not in s.engine_args):
                 s.engine_args["model"] = model
-            fa = s.engine_args.get("model_factory_args")
-            if isinstance(fa, dict) and fa.get("model_dir", "") is None:
-                fa["model_dir"] = model
+            for key in ("model_factory_args", "mm_processor_args"):
+                fa = s.engine_args.get(key)
+                if isinstance(fa, dict) and fa.get("model_dir", "") is None:
+                    fa["model_dir"] = model
         return stages
     # Single-stage default, like the reference's diffusion autodetect
     # (cli/serve.py:55-63): model_index.json => diffusion.

@@ -1,0 +1,179 @@
+"""Qwen2.5-Omni thinker multimodal front end (real-weight towers).
+
+The qwen3_omni ThinkerMMProcessor machinery (placeholder expansion,
+embeds scatter, MRoPE) reused over the CHECKPOINT-SCHEMA towers
+(audio_tower.py / vision_tower.py): images flatten to the HF
+Qwen2VL patch order (CLIP-normalized, temporal-repeated,
+merge-interleaved — verified against the transformers image processor)
+and run the windowed ViT; waveforms become 128-bin log-mels through the
+chunked whisper-style encoder.  Reference: the thinker's multimodal
+path in vllm_omni/model_executor/models/qwen2_5_omni/.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vllm_omni_tpu.models.qwen2_5_omni import audio_tower as at
+from vllm_omni_tpu.models.qwen2_5_omni import vision_tower as vt
+from vllm_omni_tpu.models.qwen3_omni.multimodal import ThinkerMMProcessor
+
+# CLIP normalization the HF Qwen2VL image processor applies
+_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+# HF Qwen2VLImageProcessor pixel budgets scale with the merge factor:
+# at the real 28-pixel factor they are 56*56 and 28*28*1280
+def _default_budget(factor: int) -> tuple[int, int]:
+    return 4 * factor * factor, 1280 * factor * factor
+
+
+def smart_resize(h: int, w: int, factor: int,
+                 min_pixels: int = None,
+                 max_pixels: int = None) -> tuple[int, int]:
+    """HF Qwen2VL smart_resize: round to the nearest factor multiple,
+    then scale into the [min_pixels, max_pixels] budget preserving
+    aspect — bounds the image token count the way the checkpoint's
+    training-time preprocessing did."""
+    import math
+
+    d_min, d_max = _default_budget(factor)
+    min_pixels = d_min if min_pixels is None else min_pixels
+    max_pixels = d_max if max_pixels is None else max_pixels
+    if max(h, w) / min(h, w) > 200:
+        raise ValueError("aspect ratio beyond 200 is unsupported")
+    hb = max(factor, round(h / factor) * factor)
+    wb = max(factor, round(w / factor) * factor)
+    if hb * wb > max_pixels:
+        beta = math.sqrt((h * w) / max_pixels)
+        hb = max(factor, math.floor(h / beta / factor) * factor)
+        wb = max(factor, math.floor(w / beta / factor) * factor)
+    elif hb * wb < min_pixels:
+        beta = math.sqrt(min_pixels / (h * w))
+        hb = math.ceil(h * beta / factor) * factor
+        wb = math.ceil(w * beta / factor) * factor
+    return hb, wb
+
+
+def flatten_image(img: np.ndarray, cfg: vt.VisionTowerConfig,
+                  max_pixels: int = None):
+    """[H, W, 3] (uint8 or [0, 1] float) -> (pixels [S, patch_dim],
+    (t, h, w) patch grid) in the HF Qwen2VLImageProcessor order:
+    smart-resize into the pixel budget (bicubic, like HF), CLIP-
+    normalize, repeat the frame to temporal_patch_size, and
+    merge-interleave the patch grid."""
+    img = np.asarray(img)
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    img = img.astype(np.float32)
+    ps, sm, tps = cfg.patch_size, cfg.spatial_merge_size, \
+        cfg.temporal_patch_size
+    mult = ps * sm
+    h, w = smart_resize(img.shape[0], img.shape[1], mult,
+                        max_pixels=max_pixels)
+    if (h, w) != img.shape[:2]:
+        import jax
+        import jax.numpy as jnp
+
+        img = np.asarray(jax.image.resize(jnp.asarray(img), (h, w, 3),
+                                          "cubic"))
+    img = (img - _MEAN) / _STD
+    chw = img.transpose(2, 0, 1)                    # [C, H, W]
+    frames = np.repeat(chw[None], tps, axis=0)      # [tps, C, H, W]
+    gh, gw = h // ps, w // ps
+    x = frames.reshape(1, tps, 3, gh // sm, sm, ps, gw // sm, sm, ps)
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return x.reshape(gh * gw, 3 * tps * ps * ps), (1, gh, gw)
+
+
+class Qwen25ThinkerMMProcessor(ThinkerMMProcessor):
+    """Placeholder/MRoPE machinery from the shared processor; encoding
+    through the checkpoint towers."""
+
+    def __init__(self, embed_table, image_token_id: int,
+                 audio_token_id: int, at_params, at_cfg: at.AudioTowerConfig,
+                 vt_params, vt_cfg: vt.VisionTowerConfig,
+                 sample_rate: int = 16000):
+        super().__init__(embed_table, image_token_id, audio_token_id,
+                         vision_params=None, vision_cfg=None,
+                         audio_params=None, audio_cfg=None,
+                         sample_rate=sample_rate)
+        self.at_params, self.at_cfg = at_params, at_cfg
+        self.vt_params, self.vt_cfg = vt_params, vt_cfg
+        import jax
+
+        # shape-keyed jit like the parent's encoders: cfg/grid are
+        # static, so each (grid, mel-length) compiles once and caches
+        self._vt_jit = jax.jit(vt.forward, static_argnums=(1, 3))
+        self._at_jit = jax.jit(at.forward, static_argnums=(1,))
+
+    def _encode_image(self, img: np.ndarray):
+        pixels, grid = flatten_image(img, self.vt_cfg)
+        import jax.numpy as jnp
+
+        feats = self._vt_jit(self.vt_params, self.vt_cfg,
+                             jnp.asarray(pixels), grid)
+        t, gh, gw = grid
+        sm = self.vt_cfg.spatial_merge_size
+        # MRoPE walks the MERGED (llm) grid
+        return np.asarray(feats), (t, gh // sm, gw // sm)
+
+    def _encode_audio(self, aud: np.ndarray):
+        aud = np.asarray(aud)
+        if aud.ndim == 1:
+            from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+
+            aud = log_mel_spectrogram(aud, sr=self.sample_rate,
+                                      n_mels=self.at_cfg.num_mel_bins)
+        import jax.numpy as jnp
+
+        feats = self._at_jit(self.at_params, self.at_cfg,
+                             jnp.asarray(aud))
+        return np.asarray(feats), (feats.shape[0],)
+
+
+def build_real_processor(params, model_cfg, model_dir: str,
+                         image_token_id: int = 151655,
+                         audio_token_id: int = 151646,
+                         dtype="float32", **_):
+    """mm_processor factory for real-weight Qwen2.5-Omni thinker stages:
+    loads both towers from the composite checkpoint (default placeholder
+    ids are the HF thinker config's image/audio token indexes)."""
+    import jax.numpy as jnp
+
+    jdtype = jnp.dtype(dtype) if isinstance(dtype, str) else dtype
+    at_params, at_cfg = at.load_audio_tower(model_dir, dtype=jdtype)
+    vt_params, vt_cfg = vt.load_vision_tower(model_dir, dtype=jdtype)
+    return Qwen25ThinkerMMProcessor(
+        embed_table=np.asarray(params["embed"]["w"]),
+        image_token_id=image_token_id,
+        audio_token_id=audio_token_id,
+        at_params=at_params, at_cfg=at_cfg,
+        vt_params=vt_params, vt_cfg=vt_cfg,
+    )
+
+
+def build_tiny_processor(params, model_cfg, **_):
+    """Random tiny towers at the real schema (placeholder ids at the top
+    of the tiny vocab, matching the shared tiny convention)."""
+    import jax
+    import jax.numpy as jnp
+
+    hidden = model_cfg.hidden_size
+    import dataclasses
+
+    at_cfg = dataclasses.replace(at.AudioTowerConfig.tiny(),
+                                 output_dim=hidden)
+    vt_cfg = dataclasses.replace(vt.VisionTowerConfig.tiny(),
+                                 out_hidden_size=hidden)
+    vocab = model_cfg.vocab_size
+    return Qwen25ThinkerMMProcessor(
+        embed_table=np.asarray(params["embed"]["w"]),
+        image_token_id=vocab - 3,
+        audio_token_id=vocab - 2,
+        at_params=at.init_params(jax.random.PRNGKey(31), at_cfg,
+                                 jnp.float32),
+        at_cfg=at_cfg,
+        vt_params=vt.init_params(jax.random.PRNGKey(32), vt_cfg,
+                                 jnp.float32),
+        vt_cfg=vt_cfg,
+    )
