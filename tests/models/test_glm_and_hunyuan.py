@@ -97,3 +97,43 @@ def test_registry_covers_all_reference_archs():
     assert not missing, missing
     for arch in reference_archs:
         DiffusionModelRegistry.resolve(arch)
+
+
+def test_glm_prior_upsample_and_size_conditioning():
+    """The AR prior generates at the half grid and nearest-upsamples 2x
+    (reference _upsample_token_ids); size/crop conditioning changes the
+    output deterministically."""
+    import jax.numpy as jnp
+
+    from vllm_omni_tpu.models.glm_image.pipeline import (
+        GlmImagePipeline,
+        GlmImagePipelineConfig,
+    )
+
+    # upsample semantics: each token becomes a 2x2 block
+    ids = jnp.asarray([[1, 2, 3, 4]])  # 2x2 grid
+    up = GlmImagePipeline.upsample_prior_ids(ids, 2, 2)
+    assert up.shape == (1, 16)
+    grid = np.asarray(up).reshape(4, 4)
+    np.testing.assert_array_equal(grid[:2, :2], 1)
+    np.testing.assert_array_equal(grid[:2, 2:], 2)
+    np.testing.assert_array_equal(grid[2:, :2], 3)
+    np.testing.assert_array_equal(grid[2:, 2:], 4)
+
+    pipe = GlmImagePipeline(GlmImagePipelineConfig.tiny(),
+                            dtype=jnp.float32, seed=0)
+
+    def gen(crop):
+        sp = OmniDiffusionSamplingParams(
+            height=32, width=32, num_inference_steps=2,
+            guidance_scale=2.0, seed=3,
+            extra={"crop_coords": crop} if crop else {})
+        req = OmniDiffusionRequest(prompt=["a cat"], sampling_params=sp,
+                                   request_ids=["r"])
+        return pipe.forward(req)[0].data
+
+    base = gen(None)
+    base2 = gen(None)
+    cropped = gen((8, 8))
+    np.testing.assert_array_equal(base, base2)
+    assert np.any(base != cropped)

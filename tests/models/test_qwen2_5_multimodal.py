@@ -67,3 +67,52 @@ def test_smart_resize_matches_hf():
         ours = mm.smart_resize(h, w, 28)
         theirs = hf_smart_resize(h, w, factor=28)
         assert ours == tuple(theirs), (h, w, ours, theirs)
+
+
+def test_resized_flatten_close_to_hf():
+    """The antialiased-cubic downscale path stays close to the HF/PIL
+    bicubic preprocessing (kernel families differ slightly — parity is
+    tolerance-based, unlike the exact no-resize case)."""
+    transformers = pytest.importorskip("transformers")
+    pytest.importorskip("PIL")
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        Qwen2VLImageProcessor,
+    )
+
+    cfg = vt.VisionTowerConfig.tiny()  # factor 8
+    rng = np.random.default_rng(2)
+    # smooth image (resampling comparisons on noise are meaningless)
+    yy, xx = np.mgrid[0:64, 0:96].astype(np.float32)
+    img = np.stack([np.sin(yy / 9), np.cos(xx / 7),
+                    np.sin((xx + yy) / 11)], axis=-1)
+    img = ((img + 1) * 127.5).astype(np.uint8)
+    # budget forces a downscale
+    pixels, grid = mm.flatten_image(img, cfg, max_pixels=32 * 32)
+    proc = Qwen2VLImageProcessor(
+        patch_size=cfg.patch_size, merge_size=cfg.spatial_merge_size,
+        temporal_patch_size=cfg.temporal_patch_size,
+        min_pixels=4 * 64, max_pixels=32 * 32)
+    out = proc(images=[img], return_tensors="np")
+    assert grid == tuple(out["image_grid_thw"][0].tolist())
+    want = out["pixel_values"]
+    assert pixels.shape == want.shape
+    # normalized-pixel space: mean abs diff well under one std
+    assert np.abs(pixels - want).mean() < 0.15
+
+
+def test_audio_bucketing_bounds_compiles():
+    import jax
+
+    from vllm_omni_tpu.models.common.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    import jax.numpy as jnp
+
+    cfg = TransformerConfig.tiny(vocab_size=64)
+    params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    proc = mm.build_tiny_processor(params, cfg)
+    # lengths within one bucket produce the same mel width
+    f1, _ = proc._encode_audio(np.zeros(900, np.float32))
+    f2, _ = proc._encode_audio(np.ones(1000, np.float32) * 0.1)
+    assert f1.shape == f2.shape

@@ -152,7 +152,7 @@ class AsyncOmni:
                 # servers aggregate + stream jsonl as they go
                 omni.harvest_stage_stats()
                 if self._streams:
-                    summ = omni.metrics.summary()
+                    summ = omni.stats_summary()
                     logger.info(
                         "stats: %d in flight, e2e p50 %.0fms, stages %s",
                         len(self._streams), summ["e2e"]["p50_ms"],

@@ -61,6 +61,10 @@ class GlmImagePipelineConfig:
     max_text_len: int = 64
     scheduler: str = "euler"
     steps_bucket: int = 32
+    # SDXL-like size/crop conditioning width (reference:
+    # GlmImageCombinedTimestepSizeEmbeddings — sinusoid embeds of
+    # target_size + crop_coords pooled into the timestep stream)
+    condition_dim: int = 64
 
     @staticmethod
     def tiny() -> "GlmImagePipelineConfig":
@@ -71,6 +75,7 @@ class GlmImagePipelineConfig:
             vae=VAEConfig.tiny(),
             prior_vocab=64,
             max_text_len=16,
+            condition_dim=8,
         )
 
 
@@ -108,12 +113,20 @@ class GlmImagePipeline:
             dit.init_params(ks[2], config.dit, dtype))
         # prior-token conditioning head (prior_token_embedding +
         # prior_projector, glm_image_transformer.py:678-683)
+        kc1, kc2 = jax.random.split(jax.random.fold_in(ks[4], 7))
         self.glm_params = self.wiring.place({
             "prior_embed": nn.embedding_init(
                 ks[3], config.prior_vocab, config.prior_lm.hidden_size,
                 dtype),
             "prior_proj": nn.linear_init(
                 ks[4], config.prior_lm.hidden_size, config.dit.inner_dim,
+                dtype=dtype),
+            # size/crop conditioning MLP into the timestep stream
+            "cond_mlp1": nn.linear_init(
+                kc1, 4 * config.condition_dim, config.dit.inner_dim,
+                dtype=dtype),
+            "cond_mlp2": nn.linear_init(
+                kc2, config.dit.inner_dim, config.dit.inner_dim,
                 dtype=dtype),
         })
         self.vae_params = self.wiring.place(
@@ -128,6 +141,16 @@ class GlmImagePipeline:
     @property
     def geometry_multiple(self) -> int:
         return self.cfg.vae.spatial_ratio * self.cfg.dit.patch_size
+
+    @staticmethod
+    def upsample_prior_ids(ids, h: int, w: int):
+        """2x nearest-neighbour upsample of a token grid (reference
+        _upsample_token_ids: the AR prior generates at the d32 grid,
+        the DiT conditions at d64)."""
+        b = ids.shape[0]
+        g = ids.reshape(b, h, w)
+        g = jnp.repeat(jnp.repeat(g, 2, axis=1), 2, axis=2)
+        return g.reshape(b, 4 * h * w)
 
     # -------------------------------------------------------- AR prior
     def _prior_fn(self, n_tokens: int):
@@ -168,9 +191,12 @@ class GlmImagePipeline:
             return self._denoise_cache[key]
         cfg = self.cfg
 
+        cdim = cfg.condition_dim
+
         @jax.jit
         def run(dit_params, glm_params, latents, txt, txt_mask,
-                prior_ids, sigmas, timesteps, gscale, num_steps):
+                prior_ids, cond_vals, sigmas, timesteps, gscale,
+                num_steps):
             schedule = fm.FlowMatchSchedule(sigmas=sigmas,
                                             timesteps=timesteps)
             b = latents.shape[0]
@@ -182,6 +208,16 @@ class GlmImagePipeline:
             prior_tok = nn.linear(glm_params["prior_proj"], pe)
             prior2 = jnp.concatenate(
                 [prior_tok, jnp.zeros_like(prior_tok)], 0)
+            # SDXL-like conditioning: sinusoid embeds of [target_h,
+            # target_w, crop_top, crop_left] pooled into the timestep
+            # stream (GlmImageCombinedTimestepSizeEmbeddings)
+            sin = jnp.concatenate(
+                [nn.timestep_embedding(cond_vals[:, i], cdim)
+                 for i in range(4)], axis=-1)
+            cond = nn.linear(glm_params["cond_mlp2"], jax.nn.silu(
+                nn.linear(glm_params["cond_mlp1"],
+                          sin.astype(prior_tok.dtype))))
+            cond2 = jnp.concatenate([cond, cond], 0)
 
             def body(i, lat):
                 t = jnp.broadcast_to(timesteps[i], (2 * b,))
@@ -190,6 +226,7 @@ class GlmImagePipeline:
                     dit.forward_prefix(
                         dit_params, cfg.dit, lat_in, txt2, t,
                         (grid_h, grid_w), txt_mask=mask2)
+                temb_act = temb_act + cond2.astype(temb_act.dtype)
                 # GLM conditioning: prior tokens ADD into the image
                 # stream before the blocks
                 img = img + prior2.astype(img.dtype)
@@ -227,10 +264,19 @@ class GlmImagePipeline:
             (np.arange(cfg.max_text_len)[None, :]
              < lens[:, None]).astype(np.int32))
 
-        # stage 1: AR prior tokens seeded from the text ids
+        # stage 1: AR prior tokens seeded from the text ids — generated
+        # at the HALF (d32) grid and 2x nearest-upsampled to the DiT
+        # grid when the geometry allows (reference generate_prior_tokens
+        # + _upsample_token_ids); odd grids degrade to full-res priors
         seed_ids = jnp.asarray(ids[:, :8] % cfg.prior_lm.vocab_size,
                                jnp.int32)
-        prior_ids = self._prior_fn(seq_len)(self.prior_params, seed_ids)
+        if grid_h % 2 == 0 and grid_w % 2 == 0:
+            ph, pw = grid_h // 2, grid_w // 2
+            small = self._prior_fn(ph * pw)(self.prior_params, seed_ids)
+            prior_ids = self.upsample_prior_ids(small, ph, pw)
+        else:
+            prior_ids = self._prior_fn(seq_len)(self.prior_params,
+                                                seed_ids)
 
         steps = max(1, sp.num_inference_steps)
         sched_len = max(steps, cfg.steps_bucket)
@@ -247,9 +293,15 @@ class GlmImagePipeline:
             (b, seq_len, cfg.dit.in_channels), jnp.float32,
         ).astype(self.dtype)
 
+        crop = sp.extra.get("crop_coords", (0, 0)) \
+            if hasattr(sp, "extra") and sp.extra else (0, 0)
+        cond_vals = jnp.asarray(
+            np.broadcast_to(np.array(
+                [sp.height, sp.width, crop[0], crop[1]], np.float32),
+                (b, 4)))
         run = self._denoise_fn(grid_h, grid_w, sched_len)
         latents = run(self.dit_params, self.glm_params, noise, txt,
-                      mask, prior_ids, sigmas, timesteps,
+                      mask, prior_ids, cond_vals, sigmas, timesteps,
                       jnp.float32(sp.guidance_scale), jnp.int32(steps))
 
         p = cfg.dit.patch_size

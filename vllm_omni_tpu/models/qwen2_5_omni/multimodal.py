@@ -75,7 +75,7 @@ def flatten_image(img: np.ndarray, cfg: vt.VisionTowerConfig,
         import jax.numpy as jnp
 
         img = np.asarray(jax.image.resize(jnp.asarray(img), (h, w, 3),
-                                          "cubic"))
+                                          "cubic", antialias=True))
     img = (img - _MEAN) / _STD
     chw = img.transpose(2, 0, 1)                    # [C, H, W]
     frames = np.repeat(chw[None], tps, axis=0)      # [tps, C, H, W]
@@ -120,6 +120,17 @@ class Qwen25ThinkerMMProcessor(ThinkerMMProcessor):
     def _encode_audio(self, aud: np.ndarray):
         aud = np.asarray(aud)
         if aud.ndim == 1:
+            # bucket the WAVEFORM length (powers of two) so the tower
+            # compiles once per bucket, not once per clip length; the
+            # zero padding is trailing silence — it becomes a few
+            # near-silent audio tokens, like a clip recorded with a
+            # silent tail (the parent processor buckets the same way)
+            n = aud.shape[0]
+            bucket = 1024
+            while bucket < n:
+                bucket *= 2
+            if bucket != n:
+                aud = np.pad(aud, (0, bucket - n))
             from vllm_omni_tpu.utils.audio import log_mel_spectrogram
 
             aud = log_mel_spectrogram(aud, sr=self.sample_rate,
