@@ -119,6 +119,16 @@ class Qwen25ThinkerMMProcessor(ThinkerMMProcessor):
 
     def _encode_audio(self, aud: np.ndarray):
         aud = np.asarray(aud)
+        max_mel = 2 * self.at_cfg.max_source_positions
+        if aud.ndim == 1 and aud.shape[0] > max_mel * 160:
+            # 160 samples/mel frame @ 16 kHz — reject before the mel
+            # transform and a giant fresh compile
+            raise ValueError(
+                f"audio clip too long ({aud.shape[0]} samples > "
+                f"{max_mel * 160}); max {max_mel} mel frames")
+        if aud.ndim == 2 and aud.shape[0] > max_mel:
+            raise ValueError(
+                f"audio clip has {aud.shape[0]} mel frames > {max_mel}")
         if aud.ndim == 1:
             # bucket the WAVEFORM length (powers of two) so the tower
             # compiles once per bucket, not once per clip length; the

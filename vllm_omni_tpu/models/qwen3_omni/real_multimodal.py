@@ -1,0 +1,138 @@
+"""Qwen3-Omni thinker multimodal front end over the CHECKPOINT towers.
+
+The shared ThinkerMMProcessor machinery (placeholder expansion, embeds
+scatter, MRoPE) driving the real-weight AuT audio encoder
+(aut_encoder.py) and ViT vision tower (vit_encoder.py): images flatten
+through the same HF Qwen2VL smart-resize / merge-interleave path the
+Qwen2.5 intake uses (the Qwen3 ViT consumes the identical patch
+order), waveforms become 128-bin log-mels for the windowed AuT stack.
+Reference: Qwen3OmniMoeThinkerMultiModalProcessor,
+qwen3_omni_moe_thinker.py:235-536.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vllm_omni_tpu.models.qwen2_5_omni.multimodal import flatten_image
+from vllm_omni_tpu.models.qwen3_omni import aut_encoder as aut
+from vllm_omni_tpu.models.qwen3_omni import vit_encoder as vit
+from vllm_omni_tpu.models.qwen3_omni.multimodal import ThinkerMMProcessor
+
+
+class _VitGeom:
+    """flatten_image reads patch geometry fields; adapt the ViT config."""
+
+    def __init__(self, cfg: vit.ViTEncoderConfig):
+        self.patch_size = cfg.patch_size
+        self.spatial_merge_size = cfg.spatial_merge_size
+        self.temporal_patch_size = cfg.temporal_patch_size
+
+
+class Qwen3ThinkerMMProcessor(ThinkerMMProcessor):
+    """Placeholder/MRoPE machinery from the shared processor; encoding
+    through the checkpoint-schema AuT + ViT towers."""
+
+    def __init__(self, embed_table, image_token_id: int,
+                 audio_token_id: int, aut_params,
+                 aut_cfg: aut.AuTEncoderConfig, vit_params,
+                 vit_cfg: vit.ViTEncoderConfig,
+                 sample_rate: int = 16000):
+        super().__init__(embed_table, image_token_id, audio_token_id,
+                         vision_params=None, vision_cfg=None,
+                         audio_params=None, audio_cfg=None,
+                         sample_rate=sample_rate)
+        self.aut_params, self.aut_cfg = aut_params, aut_cfg
+        self.vit_params, self.vit_cfg = vit_params, vit_cfg
+        import jax
+
+        self._vit_jit = jax.jit(vit.forward, static_argnums=(1, 3))
+        self._aut_jit = jax.jit(aut.forward, static_argnums=(1,))
+
+    def _encode_image(self, img: np.ndarray):
+        pixels, grid = flatten_image(img, _VitGeom(self.vit_cfg))
+        import jax.numpy as jnp
+
+        feats, _deepstack = self._vit_jit(
+            self.vit_params, self.vit_cfg, jnp.asarray(pixels), grid)
+        t, gh, gw = grid
+        sm = self.vit_cfg.spatial_merge_size
+        return np.asarray(feats), (t, gh // sm, gw // sm)
+
+    def _encode_audio(self, aud: np.ndarray):
+        aud = np.asarray(aud)
+        max_mel = 2 * self.aut_cfg.max_source_positions
+        if aud.ndim == 1 and aud.shape[0] > max_mel * 160:
+            # 160 samples/mel frame @ 16 kHz — reject before the mel
+            # transform, the bucketed pad, and a giant fresh compile
+            raise ValueError(
+                f"audio clip too long ({aud.shape[0]} samples > "
+                f"{max_mel * 160}); max {max_mel} mel frames")
+        if aud.ndim == 2 and aud.shape[0] > max_mel:
+            raise ValueError(
+                f"audio clip has {aud.shape[0]} mel frames > {max_mel}")
+        if aud.ndim == 1:
+            # waveform-length bucketing bounds tower compiles (the
+            # padding is trailing silence)
+            n = aud.shape[0]
+            bucket = 1024
+            while bucket < n:
+                bucket *= 2
+            if bucket != n:
+                aud = np.pad(aud, (0, bucket - n))
+            from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+
+            aud = log_mel_spectrogram(aud, sr=self.sample_rate,
+                                      n_mels=self.aut_cfg.num_mel_bins)
+        import jax.numpy as jnp
+
+        feats = self._aut_jit(self.aut_params, self.aut_cfg,
+                              jnp.asarray(aud))
+        return np.asarray(feats), (feats.shape[0],)
+
+
+def build_real_processor(params, model_cfg, model_dir: str,
+                         image_token_id: int = 151655,
+                         audio_token_id: int = 151646,
+                         dtype="float32", **_):
+    """mm_processor factory for real-weight Qwen3-Omni thinker stages:
+    loads the AuT audio tower and ViT vision tower from the composite
+    checkpoint."""
+    import jax.numpy as jnp
+
+    jdtype = jnp.dtype(dtype) if isinstance(dtype, str) else dtype
+    aut_params, aut_cfg = aut.load_aut_encoder(model_dir, dtype=jdtype)
+    vit_params, vit_cfg = vit.load_vit_encoder(model_dir, dtype=jdtype)
+    return Qwen3ThinkerMMProcessor(
+        embed_table=np.asarray(params["embed"]["w"]),
+        image_token_id=image_token_id,
+        audio_token_id=audio_token_id,
+        aut_params=aut_params, aut_cfg=aut_cfg,
+        vit_params=vit_params, vit_cfg=vit_cfg,
+    )
+
+
+def build_tiny_processor(params, model_cfg, **_):
+    """Random tiny towers at the real AuT/ViT schema."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    hidden = model_cfg.hidden_size
+    aut_cfg = dataclasses.replace(aut.AuTEncoderConfig.tiny(),
+                                  output_dim=hidden)
+    vit_cfg = dataclasses.replace(vit.ViTEncoderConfig.tiny(),
+                                  out_hidden_size=hidden)
+    vocab = model_cfg.vocab_size
+    return Qwen3ThinkerMMProcessor(
+        embed_table=np.asarray(params["embed"]["w"]),
+        image_token_id=vocab - 3,
+        audio_token_id=vocab - 2,
+        aut_params=aut.init_params(jax.random.PRNGKey(41), aut_cfg,
+                                   jnp.float32),
+        aut_cfg=aut_cfg,
+        vit_params=vit.init_params(jax.random.PRNGKey(42), vit_cfg,
+                                   jnp.float32),
+        vit_cfg=vit_cfg,
+    )
