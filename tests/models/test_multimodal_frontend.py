@@ -211,3 +211,69 @@ def test_image_audio_pipeline_e2e():
     outs2 = omni.generate([prompt])
     t2 = {o.final_output_type: o for o in outs2}["text"]
     assert t2.outputs[0].token_ids == by_type["text"].outputs[0].token_ids
+
+
+def test_bucket_waveform_cap_not_exceeded_by_padding():
+    """A clip admitted by the length guard must not be padded past the
+    cap the guard promises: the power-of-two bucket is clamped to
+    max_frames worth of samples (regression: guard-before-bucketing let
+    padding overshoot the cap by up to 2x)."""
+    import pytest
+
+    from vllm_omni_tpu.utils.audio import bucket_waveform_to_mel
+
+    max_frames = 20  # 3200 samples @ 160/frame
+    # just under the limit: next pow2 (4096) would exceed the cap
+    mel = bucket_waveform_to_mel(
+        np.zeros(3000, np.float32), sr=16000, n_mels=16,
+        max_frames=max_frames)
+    assert mel.shape[0] <= max_frames
+    # over the limit still rejects, on both intake paths
+    with pytest.raises(ValueError):
+        bucket_waveform_to_mel(np.zeros(3300, np.float32), sr=16000,
+                               n_mels=16, max_frames=max_frames)
+    with pytest.raises(ValueError):
+        bucket_waveform_to_mel(np.zeros((21, 16), np.float32), sr=16000,
+                               n_mels=16, max_frames=max_frames)
+    # precomputed mels at the limit pass through untouched
+    keep = np.ones((20, 16), np.float32)
+    np.testing.assert_array_equal(
+        bucket_waveform_to_mel(keep, sr=16000, n_mels=16,
+                               max_frames=max_frames), keep)
+
+
+def test_base_audio_frame_bucket_capped(monkeypatch):
+    """The base processor's mel-frame bucket is clamped to max_frames:
+    a clip just over a power-of-two must not compile/run the tower past
+    the cap (and a mismatched precomputed-mel width fails loudly)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from vllm_omni_tpu.models.common.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from vllm_omni_tpu.utils.audio import bucket_waveform_to_mel
+
+    cfg = TransformerConfig.tiny(vocab_size=64)
+    params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    proc = multimodal.build_tiny_processor(params, cfg)
+    max_f = proc.audio_cfg.max_frames
+    seen = []
+    orig = proc._audio_fwd
+    proc._audio_fwd = lambda p, mel, mask: (
+        seen.append(mel.shape), orig(p, mel, mask))[1]
+    # frames just past a power of two but under the cap: the pow2 bucket
+    # would overshoot max_frames without the clamp
+    t = min(max_f, 17)
+    mel = np.zeros((t, proc.audio_cfg.n_mels), np.float32)
+    proc._encode_audio(mel)
+    assert seen and seen[0][1] <= max_f
+    # over-long waveform rejects BEFORE the mel transform
+    with pytest.raises(ValueError):
+        proc._encode_audio(np.zeros(max_f * 160 + 1, np.float32))
+    # helper: wrong mel-bin width is a clear error, not a jit shape crash
+    with pytest.raises(ValueError, match="bins"):
+        bucket_waveform_to_mel(np.zeros((4, 8), np.float32), sr=16000,
+                               n_mels=16, max_frames=32)

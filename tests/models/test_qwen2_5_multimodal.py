@@ -113,6 +113,6 @@ def test_audio_bucketing_bounds_compiles():
     params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
     proc = mm.build_tiny_processor(params, cfg)
     # lengths within one bucket produce the same mel width
-    f1, _ = proc._encode_audio(np.zeros(900, np.float32))
-    f2, _ = proc._encode_audio(np.ones(1000, np.float32) * 0.1)
+    f1, _, _ = proc._encode_audio(np.zeros(900, np.float32))
+    f2, _, _ = proc._encode_audio(np.ones(1000, np.float32) * 0.1)
     assert f1.shape == f2.shape

@@ -252,6 +252,9 @@ class OmniStage:
                         mrope_positions=processed.mrope_positions,
                         mrope_delta=processed.mrope_delta,
                     )
+                    ds = getattr(processed, "deepstack_embeds", None)
+                    if ds is not None:
+                        mm_kwargs["deepstack_embeds"] = ds
                 info = dict(r.additional_information)
                 # upstream-extracted KV prefix lands in this engine's cache
                 # (receive half of the transfer manager)

@@ -382,9 +382,15 @@ def forward_prefill(
     slot_mapping: jax.Array,  # [B, S] flat slots (-1 for padding)
     inputs_embeds: Optional[jax.Array] = None,  # [B, S, embed_width]
     embeds_mask: Optional[jax.Array] = None,  # [B, S] bool: row uses embeds
+    deepstack: Optional[jax.Array] = None,  # [B, n_deep, S, hidden]
 ):
     """Prefill: causal attention within the prompt, writing KV pages
     (embeds-as-input handling: see ``_embed_input``).
+
+    ``deepstack`` carries multiscale visual features (zeros at non-visual
+    positions); level ``i`` is added to the residual stream after decoder
+    layer ``i`` (reference: Qwen3-Omni thinker deepstack injection,
+    qwen3_omni_moe_thinker.py:177-178).
 
     Returns (hidden [B, S, hidden], new kv_caches).
     """
@@ -393,7 +399,8 @@ def forward_prefill(
     cos, sin = _rope_tables(cfg, positions)
     flat_slots = slot_mapping.reshape(-1)
     new_caches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for i, (layer, (k_cache, v_cache)) in enumerate(
+            zip(params["layers"], kv_caches)):
         def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):
             k_cache, v_cache = write_kv_cache(
                 k_cache, v_cache, k, v, flat_slots
@@ -407,6 +414,8 @@ def forward_prefill(
             )
 
         x = _layer_step(layer, cfg, x, cos, sin, attend)
+        if deepstack is not None and i < deepstack.shape[1]:
+            x = x + deepstack[:, i].astype(x.dtype)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
 
 
@@ -422,11 +431,13 @@ def forward_prefill_chunked(
     q_starts: jax.Array,  # [B] global position of the chunk's first token
     inputs_embeds: Optional[jax.Array] = None,
     embeds_mask: Optional[jax.Array] = None,
+    deepstack: Optional[jax.Array] = None,  # [B, n_deep, S, hidden]
 ):
     """Prefill continuation: a chunk attends the cached KV of earlier
     chunks plus itself causally (chunked prefill — the capability the
     reference inherits from vLLM's scheduler and the r1 scheduler left as
-    NotImplementedError).
+    NotImplementedError).  ``deepstack`` rows cover THIS chunk's positions
+    (the caller slices the request-level table like prompt_embeds).
 
     The chunk's KV is written to the paged cache first, then each layer
     gathers the full context ``[B, ctx, Hkv, D]`` through ``block_tables``
@@ -445,7 +456,8 @@ def forward_prefill_chunked(
     kv_mask = (jnp.arange(ctx_width)[None, :]
                < context_lens[:, None]).astype(jnp.int32)
     new_caches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for i, (layer, (k_cache, v_cache)) in enumerate(
+            zip(params["layers"], kv_caches)):
         def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):
             k_cache, v_cache = write_kv_cache(
                 k_cache, v_cache, k, v, flat_slots
@@ -464,6 +476,8 @@ def forward_prefill_chunked(
             )
 
         x = _layer_step(layer, cfg, x, cos, sin, attend)
+        if deepstack is not None and i < deepstack.shape[1]:
+            x = x + deepstack[:, i].astype(x.dtype)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
 
 

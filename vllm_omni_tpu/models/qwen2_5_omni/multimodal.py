@@ -115,41 +115,19 @@ class Qwen25ThinkerMMProcessor(ThinkerMMProcessor):
         t, gh, gw = grid
         sm = self.vt_cfg.spatial_merge_size
         # MRoPE walks the MERGED (llm) grid
-        return np.asarray(feats), (t, gh // sm, gw // sm)
+        return np.asarray(feats), (t, gh // sm, gw // sm), None
 
     def _encode_audio(self, aud: np.ndarray):
-        aud = np.asarray(aud)
-        max_mel = 2 * self.at_cfg.max_source_positions
-        if aud.ndim == 1 and aud.shape[0] > max_mel * 160:
-            # 160 samples/mel frame @ 16 kHz — reject before the mel
-            # transform and a giant fresh compile
-            raise ValueError(
-                f"audio clip too long ({aud.shape[0]} samples > "
-                f"{max_mel * 160}); max {max_mel} mel frames")
-        if aud.ndim == 2 and aud.shape[0] > max_mel:
-            raise ValueError(
-                f"audio clip has {aud.shape[0]} mel frames > {max_mel}")
-        if aud.ndim == 1:
-            # bucket the WAVEFORM length (powers of two) so the tower
-            # compiles once per bucket, not once per clip length; the
-            # zero padding is trailing silence — it becomes a few
-            # near-silent audio tokens, like a clip recorded with a
-            # silent tail (the parent processor buckets the same way)
-            n = aud.shape[0]
-            bucket = 1024
-            while bucket < n:
-                bucket *= 2
-            if bucket != n:
-                aud = np.pad(aud, (0, bucket - n))
-            from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+        from vllm_omni_tpu.utils.audio import bucket_waveform_to_mel
 
-            aud = log_mel_spectrogram(aud, sr=self.sample_rate,
-                                      n_mels=self.at_cfg.num_mel_bins)
+        aud = bucket_waveform_to_mel(
+            aud, sr=self.sample_rate, n_mels=self.at_cfg.num_mel_bins,
+            max_frames=2 * self.at_cfg.max_source_positions)
         import jax.numpy as jnp
 
         feats = self._at_jit(self.at_params, self.at_cfg,
                              jnp.asarray(aud))
-        return np.asarray(feats), (feats.shape[0],)
+        return np.asarray(feats), (feats.shape[0],), None
 
 
 def build_real_processor(params, model_cfg, model_dir: str,

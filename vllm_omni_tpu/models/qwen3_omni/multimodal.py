@@ -39,6 +39,14 @@ class ProcessedMM:
     prompt_embeds: np.ndarray  # [S, hidden]
     mrope_positions: np.ndarray  # [3, S]
     mrope_delta: int
+    # multiscale visual features as sparse spans: [(offset, arr)] where
+    # arr is [n_deep, T_item, hidden] covering prompt positions
+    # offset..offset+T_item; level i adds to the residual stream after
+    # decoder layer i.  Sparse (per visual item, not a dense [n_deep, S,
+    # hidden] table) so a request's host memory scales with its visual
+    # tokens, not its context length (reference: deepstack injection,
+    # qwen3_omni_moe_thinker.py:177-178)
+    deepstack_embeds: Optional[list[tuple[int, np.ndarray]]] = None
 
 
 class ThinkerMMProcessor:
@@ -84,7 +92,11 @@ class ThinkerMMProcessor:
         ) if audio_cfg else None
 
     # ------------------------------------------------------------ encoders
-    def _encode_image(self, img: np.ndarray) -> tuple[np.ndarray, tuple]:
+    # Contract: encoders return (feats [T, hidden], grid, deepstack) where
+    # deepstack is None or [n_deep, T, hidden] multiscale features to be
+    # injected after early LM layers (a 2-tuple without deepstack is
+    # tolerated for out-of-tree processors).
+    def _encode_image(self, img: np.ndarray):
         if self.vision_cfg is None:
             raise ValueError("no vision encoder configured for this stage")
         img = np.asarray(img)
@@ -92,36 +104,45 @@ class ThinkerMMProcessor:
             img = img.astype(np.float32) / 127.5 - 1.0
         gh, gw = self.vision_cfg.grid(img.shape[0], img.shape[1])
         feats = self._vision_fwd(self.vision_params, img[None])
-        return np.asarray(feats[0]), (1, gh, gw)
+        return np.asarray(feats[0]), (1, gh, gw), None
 
-    def _encode_audio(self, aud: np.ndarray) -> tuple[np.ndarray, tuple]:
+    def _encode_audio(self, aud: np.ndarray):
         if self.audio_cfg is None:
             raise ValueError("no audio encoder configured for this stage")
         aud = np.asarray(aud)
+        max_f = self.audio_cfg.max_frames
         if aud.ndim == 1:  # raw waveform -> log-mel
+            # guard BEFORE the mel transform: an over-long clip must not
+            # get an unbounded host FFT before rejection (160 samples/mel
+            # frame @ 16 kHz)
+            if aud.shape[0] > max_f * 160:
+                raise ValueError(
+                    f"audio clip too long ({aud.shape[0]} samples > "
+                    f"{max_f * 160}); max {max_f} mel frames")
             from vllm_omni_tpu.utils.audio import log_mel_spectrogram
 
             aud = log_mel_spectrogram(
                 aud, sr=self.sample_rate, n_mels=self.audio_cfg.n_mels
             )
         t = aud.shape[0]
-        if t > self.audio_cfg.max_frames:
+        if t > max_f:
             raise ValueError(
-                f"audio clip has {t} mel frames > max_frames "
-                f"{self.audio_cfg.max_frames}"
+                f"audio clip has {t} mel frames > max_frames {max_f}"
             )
-        # bucket the frame count (powers of two) so the encoder compiles
-        # once per bucket, not once per clip length; padded frames are
-        # masked out inside the encoder
+        # bucket the frame count (powers of two, capped at max_frames so
+        # padding never exceeds the cap the guard promises) so the encoder
+        # compiles once per bucket, not once per clip length; padded
+        # frames are masked out inside the encoder
         bucket = 16
         while bucket < t:
             bucket *= 2
+        bucket = min(bucket, max_f)
         mel = np.zeros((bucket, aud.shape[1]), np.float32)
         mel[:t] = aud
         mask = (np.arange(bucket) < t).astype(np.int32)
         feats = self._audio_fwd(self.audio_params, mel[None], mask[None])
         n = self.audio_cfg.num_tokens(t)
-        return np.asarray(feats[0, :n]), (n,)
+        return np.asarray(feats[0, :n]), (n,), None
 
     # ------------------------------------------------------------- process
     def __call__(
@@ -152,6 +173,7 @@ class ThinkerMMProcessor:
         if prepend:
             prompt_token_ids = prepend + prompt_token_ids
         feats: list[np.ndarray] = []
+        deepstacks: list[Optional[np.ndarray]] = []
         items_spec: list[tuple[str, tuple]] = []
         for tok in prompt_token_ids:
             mod = self._id_to_mod.get(int(tok))
@@ -161,8 +183,12 @@ class ThinkerMMProcessor:
                 raise ValueError(f"prompt has more {mod} placeholders than "
                                  f"{mod} items")
             raw = queues[mod].pop(0)
-            f, grid = (self._encode_image(raw) if mod == "image"
-                       else self._encode_audio(raw))
+            res = (self._encode_image(raw) if mod == "image"
+                   else self._encode_audio(raw))
+            # encoders may return (feats, grid) or, for deepstack towers,
+            # (feats, grid, deepstack [n_deep, T, hidden])
+            f, grid = res[0], res[1]
+            deepstacks.append(res[2] if len(res) > 2 else None)
             feats.append(f)
             items_spec.append((mod, grid))
         for mod, q in queues.items():
@@ -175,12 +201,15 @@ class ThinkerMMProcessor:
         embeds = self.embed_table[np.asarray(expanded)].astype(np.float32)
         for item, f in zip(items, feats):
             embeds[item.offset:item.offset + item.num_tokens] = f
+        deep = [(item.offset, d) for item, d in zip(items, deepstacks)
+                if d is not None] or None
         positions, delta = compute_mrope_positions(len(expanded), items)
         return ProcessedMM(
             prompt_token_ids=expanded,
             prompt_embeds=embeds,
             mrope_positions=positions,
             mrope_delta=delta,
+            deepstack_embeds=deep,
         )
 
 

@@ -53,42 +53,28 @@ class Qwen3ThinkerMMProcessor(ThinkerMMProcessor):
         pixels, grid = flatten_image(img, _VitGeom(self.vit_cfg))
         import jax.numpy as jnp
 
-        feats, _deepstack = self._vit_jit(
+        feats, deepstack = self._vit_jit(
             self.vit_params, self.vit_cfg, jnp.asarray(pixels), grid)
         t, gh, gw = grid
         sm = self.vit_cfg.spatial_merge_size
-        return np.asarray(feats), (t, gh // sm, gw // sm)
+        # deepstack merger outputs [n_deep, T/m^2, out_hidden]: injected
+        # into the residual stream after early LM layers (reference:
+        # qwen3_omni_moe_thinker.py:177-178 via _get_deepstack_input_embeds)
+        ds = (np.stack([np.asarray(d) for d in deepstack], axis=0)
+              if deepstack else None)
+        return np.asarray(feats), (t, gh // sm, gw // sm), ds
 
     def _encode_audio(self, aud: np.ndarray):
-        aud = np.asarray(aud)
-        max_mel = 2 * self.aut_cfg.max_source_positions
-        if aud.ndim == 1 and aud.shape[0] > max_mel * 160:
-            # 160 samples/mel frame @ 16 kHz — reject before the mel
-            # transform, the bucketed pad, and a giant fresh compile
-            raise ValueError(
-                f"audio clip too long ({aud.shape[0]} samples > "
-                f"{max_mel * 160}); max {max_mel} mel frames")
-        if aud.ndim == 2 and aud.shape[0] > max_mel:
-            raise ValueError(
-                f"audio clip has {aud.shape[0]} mel frames > {max_mel}")
-        if aud.ndim == 1:
-            # waveform-length bucketing bounds tower compiles (the
-            # padding is trailing silence)
-            n = aud.shape[0]
-            bucket = 1024
-            while bucket < n:
-                bucket *= 2
-            if bucket != n:
-                aud = np.pad(aud, (0, bucket - n))
-            from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+        from vllm_omni_tpu.utils.audio import bucket_waveform_to_mel
 
-            aud = log_mel_spectrogram(aud, sr=self.sample_rate,
-                                      n_mels=self.aut_cfg.num_mel_bins)
+        aud = bucket_waveform_to_mel(
+            aud, sr=self.sample_rate, n_mels=self.aut_cfg.num_mel_bins,
+            max_frames=2 * self.aut_cfg.max_source_positions)
         import jax.numpy as jnp
 
         feats = self._aut_jit(self.aut_params, self.aut_cfg,
                               jnp.asarray(aud))
-        return np.asarray(feats), (feats.shape[0],)
+        return np.asarray(feats), (feats.shape[0],), None
 
 
 def build_real_processor(params, model_cfg, model_dir: str,

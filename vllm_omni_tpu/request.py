@@ -73,6 +73,12 @@ class Request:
     # models/common/mrope.compute_mrope_positions (reference: mrope.py:25)
     mrope_positions: Optional[np.ndarray] = None
     mrope_delta: int = 0
+    # deepstack multiscale visual features as sparse spans:
+    # [(offset, [n_deep, T_item, hidden])] covering each visual item's
+    # prompt positions; level i is added to the hidden states after
+    # decoder layer i (reference: Qwen3-Omni thinker deepstack,
+    # qwen3_omni_moe_thinker.py:177-178)
+    deepstack_embeds: Optional[list] = None
 
     # ----- mutable engine state -----
     status: RequestStatus = RequestStatus.WAITING

@@ -58,3 +58,52 @@ def log_mel_spectrogram(
     logmel = np.log10(np.maximum(mel, 1e-10))
     logmel = np.maximum(logmel, logmel.max() - 8.0)
     return ((logmel + 4.0) / 4.0).astype(np.float32)
+
+
+def bucket_waveform_to_mel(
+    aud: np.ndarray,
+    *,
+    sr: int,
+    n_mels: int,
+    max_frames: int,
+    samples_per_frame: int = 160,
+    min_bucket: int = 1024,
+) -> np.ndarray:
+    """Length-guarded, compile-bounded mel intake shared by the audio
+    towers (Qwen2.5-Omni whisper front end, Qwen3-Omni AuT).
+
+    1-D waveforms are padded to a power-of-two sample count so each tower
+    compiles once per bucket, not once per clip length (the padding is
+    trailing silence).  The bucket is CAPPED at ``max_frames`` worth of
+    samples so padding can never push a just-under-the-limit clip past
+    the cap the error message promises — the raw-waveform and
+    precomputed-mel paths enforce the same limit.  2-D inputs are taken
+    as precomputed ``[T, n_mels]`` mels and only validated.
+    """
+    aud = np.asarray(aud)
+    max_samples = max_frames * samples_per_frame
+    if aud.ndim == 1:
+        n = aud.shape[0]
+        if n > max_samples:
+            raise ValueError(
+                f"audio clip too long ({n} samples > {max_samples}); "
+                f"max {max_frames} mel frames")
+        bucket = min_bucket
+        while bucket < n:
+            bucket *= 2
+        bucket = min(bucket, max_samples)
+        if bucket != n:
+            aud = np.pad(aud, (0, bucket - n))
+        return log_mel_spectrogram(aud, sr=sr, n_mels=n_mels)
+    if aud.ndim == 2:
+        if aud.shape[0] > max_frames:
+            raise ValueError(
+                f"audio clip has {aud.shape[0]} mel frames > {max_frames}")
+        if aud.shape[1] != n_mels:
+            raise ValueError(
+                f"precomputed mel has {aud.shape[1]} bins; this tower "
+                f"expects n_mels={n_mels}")
+        return aud
+    raise ValueError(
+        f"audio must be a 1-D waveform or [T, n_mels] mel; got shape "
+        f"{aud.shape}")

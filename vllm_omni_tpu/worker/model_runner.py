@@ -138,10 +138,12 @@ class ARModelRunner:
         # one closure serves both paths: inputs_embeds=None and =array are
         # two jit specializations of the same function
         def _prefill(params, token_ids, kv_caches, positions, slot_mapping,
-                     last_idx, inputs_embeds=None, embeds_mask=None):
+                     last_idx, inputs_embeds=None, embeds_mask=None,
+                     deepstack=None):
             hidden, new_caches = tfm.forward_prefill(
                 params, cfg_, token_ids, positions, kv_caches, slot_mapping,
                 inputs_embeds=inputs_embeds, embeds_mask=embeds_mask,
+                deepstack=deepstack,
             )
             b = token_ids.shape[0]
             last_hidden = hidden[jnp.arange(b), last_idx]  # [B, H]
@@ -151,11 +153,12 @@ class ARModelRunner:
         def _chunk_prefill(params, token_ids, kv_caches, positions,
                            slot_mapping, last_idx, block_tables,
                            context_lens, q_starts, inputs_embeds=None,
-                           embeds_mask=None):
+                           embeds_mask=None, deepstack=None):
             hidden, new_caches = tfm.forward_prefill_chunked(
                 params, cfg_, token_ids, positions, kv_caches, slot_mapping,
                 block_tables, context_lens, q_starts,
                 inputs_embeds=inputs_embeds, embeds_mask=embeds_mask,
+                deepstack=deepstack,
             )
             b = token_ids.shape[0]
             last_hidden = hidden[jnp.arange(b), last_idx]
@@ -216,8 +219,8 @@ class ARModelRunner:
                 )
                 return jax.jit(sm, donate_argnums=(2,))
 
-            self._prefill_fn = wrap(_prefill, 5, 3)
-            self._chunk_prefill_fn = wrap(_chunk_prefill, 8, 3)
+            self._prefill_fn = wrap(_prefill, 6, 3)
+            self._chunk_prefill_fn = wrap(_chunk_prefill, 9, 3)
             self._verify_fn = wrap(_verify, 5, 2)
             self._decode_fn = wrap(_decode, 4, 2)
         # speculative decoding (MTP draft head): draft_fn(last_hidden [M,H],
@@ -316,6 +319,18 @@ class ARModelRunner:
         embeds = (np.zeros((b, s_len, self.embeds_width), np.float32)
                   if use_embeds else None)
         embeds_mask = np.zeros((b, s_len), bool) if use_embeds else None
+        # deepstack multiscale visual features, shipped as sparse
+        # (offset, [n_deep, T_item, hidden]) spans on the request and
+        # scattered here (zeros at non-visual rows): level i adds to the
+        # residual stream after decoder layer i
+        n_deep = max((arr.shape[0]
+                      for s in scheds
+                      for off, arr in (s.request.deepstack_embeds or ())
+                      if off < s.start_pos + s.num_new_tokens
+                      and off + arr.shape[1] > s.start_pos),
+                     default=0)
+        deep = (np.zeros((b, n_deep, s_len, self.cfg.hidden_size),
+                         np.float32) if n_deep else None)
         if cont:
             tables, ctx, q_starts, pages = self._cont_tables(scheds, b)
         for i, sc in enumerate(scheds):
@@ -338,11 +353,24 @@ class ARModelRunner:
                 hi = min(sc.start_pos + n, pe.shape[0])
                 embeds[i, : hi - lo] = pe[lo:hi]
                 embeds_mask[i, : hi - lo] = True
+            if deep is not None:
+                # intersect each visual span with this chunk's window
+                # [start_pos, start_pos+n); rows outside any span (text,
+                # re-prefilled generated tokens) stay zero
+                for off, arr in sc.request.deepstack_embeds or ():
+                    lo = max(off, sc.start_pos)
+                    hi = min(off + arr.shape[1], sc.start_pos + n)
+                    if lo < hi:
+                        deep[i, : arr.shape[0],
+                             lo - sc.start_pos: hi - sc.start_pos] = (
+                            arr[:, lo - off: hi - off])
 
         embeds_args = (
             (jnp.asarray(embeds, dtype=self.params_dtype)
              if use_embeds else None),
             jnp.asarray(embeds_mask) if use_embeds else None,
+            (jnp.asarray(deep, dtype=self.params_dtype)
+             if deep is not None else None),
         )
         if cont:
             logits, last_hidden, hidden, self.kv_caches = (
