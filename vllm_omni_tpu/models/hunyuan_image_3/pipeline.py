@@ -25,7 +25,9 @@ UNetDown-embedded clean latents at t=0.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +40,8 @@ from vllm_omni_tpu.diffusion.request import (
 )
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.models.common import intake, nn
+from vllm_omni_tpu.models.common import siglip
+from vllm_omni_tpu.models.common.siglip import SigLIPConfig
 from vllm_omni_tpu.models.hunyuan_image_3 import projector
 from vllm_omni_tpu.models.hunyuan_image_3.resolution import ResolutionGroup
 from vllm_omni_tpu.models.hunyuan_image_3.transformer import (
@@ -64,6 +68,13 @@ class HunyuanImage3PipelineConfig:
         latent_channels=32, channel_multipliers=(1, 2, 4, 4, 4)))
     max_text_len: int = 64
     steps_bucket: int = 32
+    # SigLIP-2 understanding tower for conditioning images (reference:
+    # pipeline_hunyuan_image_3.py:86-90 vision_model + vision_aligner;
+    # joint image = VAE tokens + ViT tokens, JointImageInfo :650).
+    # None disables the tower (VAE-only conditioning).
+    vit: Optional[SigLIPConfig] = field(default_factory=SigLIPConfig)
+    # LightProjector mlp_gelu depth (hunyuan_image_3_transformer.py:731)
+    vit_aligner_depth: int = 2
 
     def __post_init__(self):
         if self.vae.spatial_ratio != self.llm.vae_ratio:
@@ -78,7 +89,8 @@ class HunyuanImage3PipelineConfig:
     def tiny() -> "HunyuanImage3PipelineConfig":
         return HunyuanImage3PipelineConfig(
             llm=HunyuanImage3Config.tiny(), vae=VAEConfig.tiny(),
-            max_text_len=16, steps_bucket=8)
+            max_text_len=16, steps_bucket=8,
+            vit=SigLIPConfig.tiny())
 
 
 class HunyuanImage3Pipeline:
@@ -114,9 +126,19 @@ class HunyuanImage3Pipeline:
                 f"vocab_size {llm.vocab_size}")
         logger.info("Initializing HunyuanImage3Pipeline (dtype=%s, "
                     "%d resolution buckets)", dtype, len(self.resolutions))
-        keys = jax.random.split(jax.random.PRNGKey(seed), 7)
+        keys = jax.random.split(jax.random.PRNGKey(seed), 9)
         ph = llm.patch_embed_hidden_dim
+        towers = {}
+        if config.vit is not None:
+            # SigLIP-2 understanding tower + LightProjector aligner
+            # (vision_model / vision_aligner) — conditioning images
+            # contribute semantic ViT tokens beside their VAE tokens
+            towers["vit"] = siglip.init_params(keys[7], config.vit, dtype)
+            towers["vit_aligner"] = projector.light_projector_init(
+                keys[8], config.vit.hidden_size, llm.hidden_size,
+                config.vit_aligner_depth, dtype)
         self.dit_params = self.wiring.place({
+            **towers,
             "llm": init_params(keys[0], llm, dtype),
             # three timestep embedders (reference: time_embed for the
             # patch embed, timestep_emb for the in-sequence token,
@@ -179,22 +201,31 @@ class HunyuanImage3Pipeline:
     # ----------------------------------------------------------- denoise
 
     def _denoise_fn(self, grid_h: int, grid_w: int, s_ctx: int,
-                    s_img: int, sched_len: int, use_cfg: bool = True):
-        key = (grid_h, grid_w, s_ctx, s_img, sched_len, use_cfg)
+                    s_img: int, sched_len: int, use_cfg: bool = True,
+                    vit_grid: tuple[int, int] = (0, 0)):
+        key = (grid_h, grid_w, s_ctx, s_img, sched_len, use_cfg,
+               vit_grid)
         if key in self._denoise_cache:
             return self._denoise_cache[key]
         cfg = self.cfg
         llm = cfg.llm
 
-        # static rope tables: [text/specials diagonal ; cond-image grid],
-        # then the per-step [timestep ; latent grid] section after it
+        # static rope tables: [text/specials diagonal ; cond-image VAE
+        # grid ; cond-image ViT grid], then the per-step [timestep ;
+        # latent grid] section after it (reference JointImageInfo: the
+        # joint image carries one 2D grid per sub-image)
+        s_vit = vit_grid[0] * vit_grid[1]
         ctx_pos = diagonal_positions(0, s_ctx)
         if s_img:
             # conditioning image (resized to the same bucket) occupies a
             # centered 2D grid right after the specials
             ctx_pos = np.concatenate(
                 [ctx_pos, image_grid_positions(s_ctx, grid_h, grid_w)])
-        off = s_ctx + s_img
+        if s_vit:
+            ctx_pos = np.concatenate(
+                [ctx_pos, image_grid_positions(s_ctx + s_img,
+                                               *vit_grid)])
+        off = s_ctx + s_img + s_vit
         step_pos = np.concatenate(
             [diagonal_positions(off, 1),
              image_grid_positions(off + 1, grid_h, grid_w)])
@@ -273,6 +304,41 @@ class HunyuanImage3Pipeline:
                                            t0)
         return tokens
 
+    def _vit_context(self, req, batch: int):
+        """Conditioning image -> semantic ViT tokens [B, gh*gw, hidden]
+        through the SigLIP tower + aligner (reference:
+        instantiate_vit_image_tokens, pipeline_hunyuan_image_3.py:306),
+        plus the token grid for the rope section.  (None, (0, 0)) when
+        the request has no image or the tower is disabled."""
+        vit_cfg = self.cfg.vit
+        sp = req.sampling_params
+        image = sp.image if sp.image is not None else sp.extra.get(
+            "image")
+        if image is None or vit_cfg is None:
+            return None, (0, 0)
+        side_p = int(math.isqrt(vit_cfg.num_positions))
+        side = side_p * vit_cfg.patch_size
+        img = intake.prepare_cond_image(image, side, side)
+        patches = siglip.patchify(img.transpose(2, 0, 1),
+                                  vit_cfg.patch_size)
+        pos = siglip.flattened_position_ids_extrapolate(
+            side, side, vit_cfg.patch_size, side_p)
+        if not hasattr(self, "_vit_jit"):
+            n = side_p * side_p
+
+            def run(p_vit, p_al, toks, pids):
+                feats = siglip.forward_packed(p_vit, vit_cfg, toks, pids,
+                                              [n])
+                return projector.light_projector(p_al, feats)
+
+            self._vit_jit = jax.jit(run)
+        tokens = self._vit_jit(self.dit_params["vit"],
+                               self.dit_params["vit_aligner"],
+                               jnp.asarray(patches, self.dtype),
+                               jnp.asarray(pos))
+        return (jnp.repeat(tokens[None], batch, axis=0),
+                (side_p, side_p))
+
     # ----------------------------------------------------------- forward
 
     def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
@@ -302,14 +368,23 @@ class HunyuanImage3Pipeline:
         # entry)
         cond_tokens = self._image_context(req, b, th, tw)
         s_img = 0 if cond_tokens is None else int(cond_tokens.shape[1])
+        # joint image: the semantic ViT tokens ride beside the VAE
+        # tokens in the conditioning section, each on its own rope grid
+        vit_tokens, vit_grid = self._vit_context(req, b)
+        if vit_tokens is not None:
+            # both context methods gate on the same image lookup, so the
+            # VAE tokens are always present here
+            cond_tokens = jnp.concatenate([cond_tokens, vit_tokens],
+                                          axis=1)
         use_cfg = sp.guidance_scale > 1.0
         run, ctx_cos, ctx_sin = self._denoise_fn(grid_h, grid_w, s_ctx,
                                                  s_img, sched_len,
-                                                 use_cfg)
+                                                 use_cfg,
+                                                 vit_grid=vit_grid)
         blank = jnp.asarray(np.concatenate(
             [np.zeros((b, cfg.max_text_len), np.int32),
              np.ones((b, 3), np.int32)], axis=1))
-        if s_img:
+        if cond_tokens is not None:
             ctx_kvs, mask = self._prefill_img_jit(
                 self.dit_params["llm"], ids, mask, jnp.asarray(ctx_cos),
                 jnp.asarray(ctx_sin), cond_tokens)

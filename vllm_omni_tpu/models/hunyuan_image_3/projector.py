@@ -94,3 +94,23 @@ def unet_up(p, tokens, t_emb, grid_h: int, grid_w: int):
     x = resblock(p["res"], x, t_emb)
     x = jax.nn.silu(nn.groupnorm(p["out_norm"], x))
     return nn.conv2d(p["conv_out"], x)
+
+
+def light_projector_init(key, input_dim: int, n_embed: int, depth: int,
+                         dtype):
+    """ViT aligner (reference: LightProjector mlp_gelu,
+    hunyuan_image_3_transformer.py:723-741): Linear(input, n_embed) then
+    depth-1 x [GELU, Linear(n_embed, n_embed)]."""
+    ks = jax.random.split(key, max(depth, 1))
+    layers = [nn.linear_init(ks[0], input_dim, n_embed, dtype=dtype)]
+    for i in range(1, depth):
+        layers.append(nn.linear_init(ks[i], n_embed, n_embed, dtype=dtype))
+    return {"layers": layers}
+
+
+def light_projector(p, x):
+    x = nn.linear(p["layers"][0], x)
+    for lp in p["layers"][1:]:
+        # torch nn.GELU default is the exact erf form
+        x = nn.linear(lp, jax.nn.gelu(x, approximate=False))
+    return x

@@ -109,26 +109,18 @@ class ThinkerMMProcessor:
     def _encode_audio(self, aud: np.ndarray):
         if self.audio_cfg is None:
             raise ValueError("no audio encoder configured for this stage")
-        aud = np.asarray(aud)
-        max_f = self.audio_cfg.max_frames
-        if aud.ndim == 1:  # raw waveform -> log-mel
-            # guard BEFORE the mel transform: an over-long clip must not
-            # get an unbounded host FFT before rejection (160 samples/mel
-            # frame @ 16 kHz)
-            if aud.shape[0] > max_f * 160:
-                raise ValueError(
-                    f"audio clip too long ({aud.shape[0]} samples > "
-                    f"{max_f * 160}); max {max_f} mel frames")
-            from vllm_omni_tpu.utils.audio import log_mel_spectrogram
+        from vllm_omni_tpu.utils.audio import bucket_waveform_to_mel
 
-            aud = log_mel_spectrogram(
-                aud, sr=self.sample_rate, n_mels=self.audio_cfg.n_mels
-            )
+        max_f = self.audio_cfg.max_frames
+        # shared guard + mel transform (length checks and the
+        # samples-per-frame constant live in ONE place); this tower does
+        # its own frame-count bucketing below because the encoder masks
+        # padded frames rather than treating them as silence
+        aud = bucket_waveform_to_mel(
+            np.asarray(aud), sr=self.sample_rate,
+            n_mels=self.audio_cfg.n_mels, max_frames=max_f,
+            pad_pow2=False)
         t = aud.shape[0]
-        if t > max_f:
-            raise ValueError(
-                f"audio clip has {t} mel frames > max_frames {max_f}"
-            )
         # bucket the frame count (powers of two, capped at max_frames so
         # padding never exceeds the cap the guard promises) so the encoder
         # compiles once per bucket, not once per clip length; padded

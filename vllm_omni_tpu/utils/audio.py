@@ -68,6 +68,7 @@ def bucket_waveform_to_mel(
     max_frames: int,
     samples_per_frame: int = 160,
     min_bucket: int = 1024,
+    pad_pow2: bool = True,
 ) -> np.ndarray:
     """Length-guarded, compile-bounded mel intake shared by the audio
     towers (Qwen2.5-Omni whisper front end, Qwen3-Omni AuT).
@@ -79,6 +80,10 @@ def bucket_waveform_to_mel(
     the cap the error message promises — the raw-waveform and
     precomputed-mel paths enforce the same limit.  2-D inputs are taken
     as precomputed ``[T, n_mels]`` mels and only validated.
+
+    ``pad_pow2=False`` skips the waveform padding (guard + transform
+    only) for towers that bucket FRAME counts themselves and mask the
+    padding rather than treating it as silence.
     """
     aud = np.asarray(aud)
     max_samples = max_frames * samples_per_frame
@@ -88,12 +93,13 @@ def bucket_waveform_to_mel(
             raise ValueError(
                 f"audio clip too long ({n} samples > {max_samples}); "
                 f"max {max_frames} mel frames")
-        bucket = min_bucket
-        while bucket < n:
-            bucket *= 2
-        bucket = min(bucket, max_samples)
-        if bucket != n:
-            aud = np.pad(aud, (0, bucket - n))
+        if pad_pow2:
+            bucket = min_bucket
+            while bucket < n:
+                bucket *= 2
+            bucket = min(bucket, max_samples)
+            if bucket != n:
+                aud = np.pad(aud, (0, bucket - n))
         return log_mel_spectrogram(aud, sr=sr, n_mels=n_mels)
     if aud.ndim == 2:
         if aud.shape[0] > max_frames:
