@@ -1,0 +1,78 @@
+"""CLIP text-encoder parity vs the transformers oracle (the SD3/Flux
+pooled-conditioning tower)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from vllm_omni_tpu.models.common import clip_text  # noqa: E402
+
+
+@pytest.mark.parametrize("act", ["quick_gelu", "gelu"])
+def test_clip_text_parity(tmp_path, act):
+    from safetensors.torch import save_model
+    from transformers import CLIPTextConfig as HFCfg
+    from transformers import CLIPTextModel
+
+    torch.manual_seed(0)
+    hf_cfg = HFCfg(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=64,
+                   max_position_embeddings=16, hidden_act=act,
+                   eos_token_id=63, bos_token_id=62, pad_token_id=0)
+    model = CLIPTextModel(hf_cfg).eval().float()
+    save_model(model, os.path.join(tmp_path, "model.safetensors"))
+
+    params, cfg = clip_text.load_clip_text(
+        str(tmp_path), hf_cfg=hf_cfg.to_dict())
+    rng = np.random.default_rng(0)
+    # rows: [bos, tokens..., eos, eos padding] like the CLIP tokenizer
+    ids = rng.integers(1, 60, (2, 10))
+    ids[:, 0] = 62
+    ids[0, 6:] = 63
+    ids[1, 9:] = 63
+    with torch.no_grad():
+        out = model(input_ids=torch.from_numpy(ids))
+        want = out.last_hidden_state.numpy()
+        want_pool = out.pooler_output.numpy()
+    got, pooled = clip_text.forward(params, cfg, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-5,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(pooled), want_pool, atol=3e-5,
+                               rtol=1e-4)
+
+
+def test_clip_legacy_eos_pooling(tmp_path):
+    """Published CLIP-L/bigG text_encoder configs ship the
+    transformers-legacy eos_token_id=2 while the tokenizer's real EOS is
+    the highest vocab id — pooling must follow the legacy argmax branch
+    (highest token id), matching CLIPTextModel."""
+    from safetensors.torch import save_model
+    from transformers import CLIPTextConfig as HFCfg
+    from transformers import CLIPTextModel
+
+    torch.manual_seed(1)
+    hf_cfg = HFCfg(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=64,
+                   max_position_embeddings=16, hidden_act="quick_gelu",
+                   eos_token_id=2, bos_token_id=1, pad_token_id=0)
+    model = CLIPTextModel(hf_cfg).eval().float()
+    save_model(model, os.path.join(tmp_path, "model.safetensors"))
+    params, cfg = clip_text.load_clip_text(
+        str(tmp_path), hf_cfg=hf_cfg.to_dict())
+    rng = np.random.default_rng(2)
+    ids = rng.integers(3, 60, (2, 10))
+    ids[:, 0] = 1
+    ids[0, 6] = 63  # real EOS = top vocab id, then pad
+    ids[0, 7:] = 0
+    ids[1, 9] = 63
+    with torch.no_grad():
+        out = model(input_ids=torch.from_numpy(ids))
+        want_pool = out.pooler_output.numpy()
+    _, pooled = clip_text.forward(params, cfg, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(pooled), want_pool, atol=3e-5,
+                               rtol=1e-4)

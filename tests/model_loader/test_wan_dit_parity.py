@@ -1,0 +1,232 @@
+"""Checkpoint-schema Wan DiT parity vs a torch oracle.
+
+A synthetic diffusers-named checkpoint (the WanTransformer3DModel
+naming the published Wan2.x repos ship) is saved to safetensors; our
+loader streams it back and the jax forward must match a torch oracle
+transcribed from the reference block semantics
+(vllm_omni/diffusion/models/wan2_2/wan2_2_transformer.py:589-676
+WanTransformerBlock, :251 WanTimeTextImageEmbedding, :147
+WanRotaryPosEmbed, :34 apply_rotary_emb_wan).
+"""
+
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.wan import ckpt_transformer as wc  # noqa: E402
+
+CFG = wc.WanCkptConfig.tiny()
+D = CFG.inner_dim
+
+
+def _mk(shape, g):
+    return torch.from_numpy(
+        g.standard_normal(shape).astype(np.float32) * 0.2)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = _mk((o, i), g)
+        sd[f"{name}.bias"] = _mk((o,), g)
+
+    sd["patch_embedding.weight"] = _mk(
+        (D, CFG.in_channels, 1, CFG.patch_size, CFG.patch_size), g)
+    sd["patch_embedding.bias"] = _mk((D,), g)
+    lin("condition_embedder.time_embedder.linear_1", CFG.freq_dim, D)
+    lin("condition_embedder.time_embedder.linear_2", D, D)
+    lin("condition_embedder.time_proj", D, 6 * D)
+    lin("condition_embedder.text_embedder.linear_1", CFG.text_dim, D)
+    lin("condition_embedder.text_embedder.linear_2", D, D)
+    sd["scale_shift_table"] = _mk((1, 2, D), g)
+    lin("proj_out", D, CFG.patch_size ** 2 * CFG.out_channels)
+    for i in range(CFG.num_layers):
+        b = f"blocks.{i}"
+        for attn in ("attn1", "attn2"):
+            for proj in ("to_q", "to_k", "to_v"):
+                lin(f"{b}.{attn}.{proj}", D, D)
+            lin(f"{b}.{attn}.to_out.0", D, D)
+            sd[f"{b}.{attn}.norm_q.weight"] = _mk((D,), g) + 1.0
+            sd[f"{b}.{attn}.norm_k.weight"] = _mk((D,), g) + 1.0
+        lin(f"{b}.norm2", D, D)
+        sd[f"{b}.norm2.weight"] = _mk((D,), g) + 1.0  # LN affine
+        sd[f"{b}.norm2.bias"] = _mk((D,), g)
+        lin(f"{b}.ffn.net.0.proj", D, CFG.ffn_dim)
+        lin(f"{b}.ffn.net.2", CFG.ffn_dim, D)
+        sd[f"{b}.scale_shift_table"] = _mk((1, 6, D), g)
+    d = tmp_path_factory.mktemp("wan_ckpt")
+    from safetensors.torch import save_file
+
+    save_file({k: v.contiguous() for k, v in sd.items()},
+              os.path.join(d, "model.safetensors"))
+    return str(d), sd
+
+
+# ------------------------------------------------------------ torch oracle
+def _t_linear(sd, name, x):
+    return torch.nn.functional.linear(x, sd[f"{name}.weight"],
+                                      sd[f"{name}.bias"])
+
+
+def _t_rms(w, x, eps):
+    v = x.float().pow(2).mean(-1, keepdim=True)
+    return (x.float() * torch.rsqrt(v + eps) * w.float()).type_as(x)
+
+
+def _t_ln(x, eps):
+    return torch.nn.functional.layer_norm(x.float(), (x.shape[-1],),
+                                          eps=eps)
+
+
+def _t_rope_tables(frames, gh, gw):
+    d = CFG.head_dim
+    sizes = [d - 2 * (d // 3), d // 3, d // 3]
+    cos_parts, sin_parts = [], []
+    for n, dim in zip((frames, gh, gw), sizes):
+        freqs = 1.0 / (CFG.theta ** (
+            torch.arange(0, dim, 2, dtype=torch.float64) / dim))
+        ang = torch.outer(torch.arange(n, dtype=torch.float64), freqs)
+        cos_parts.append(ang.cos().repeat_interleave(2, dim=-1).float())
+        sin_parts.append(ang.sin().repeat_interleave(2, dim=-1).float())
+
+    def expand(parts):
+        f_, h_, w_ = parts
+        f_ = f_.view(frames, 1, 1, -1).expand(frames, gh, gw, -1)
+        h_ = h_.view(1, gh, 1, -1).expand(frames, gh, gw, -1)
+        w_ = w_.view(1, 1, gw, -1).expand(frames, gh, gw, -1)
+        return torch.cat([f_, h_, w_], dim=-1).reshape(
+            1, frames * gh * gw, 1, -1)
+
+    return expand(cos_parts), expand(sin_parts)
+
+
+def _t_rope_apply(x, cos, sin):
+    # reference apply_rotary_emb_wan (wan2_2_transformer.py:34-56)
+    x1, x2 = x.unflatten(-1, (-1, 2)).unbind(-1)
+    c = cos[..., 0::2]
+    s = sin[..., 1::2]
+    out = torch.empty_like(x)
+    out[..., 0::2] = x1 * c - x2 * s
+    out[..., 1::2] = x1 * s + x2 * c
+    return out.type_as(x)
+
+
+def _t_attention(q, k, v):
+    # [B, S, H, Dh] -> standard softmax attention, scale 1/sqrt(Dh)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def oracle(sd, lat, ctx_raw, t, ctx_mask=None):
+    nh, hd, eps = CFG.num_heads, CFG.head_dim, CFG.eps
+    b, f, hh, ww, c = lat.shape
+    p = CFG.patch_size
+    gh, gw = hh // p, ww // p
+    # patchify matches our (row, col, channel) feature order
+    x = lat.reshape(b, f, gh, p, gw, p, c).permute(0, 1, 2, 4, 3, 5, 6)
+    x = x.reshape(b, f * gh * gw, p * p * c)
+    w = sd["patch_embedding.weight"].reshape(D, -1)  # [O, C*1*p*p]
+    # conv weight flattens (C, kh, kw); our patchify is (kh, kw, C)
+    wr = sd["patch_embedding.weight"][:, :, 0].permute(0, 2, 3, 1) \
+        .reshape(D, -1)
+    del w
+    x = torch.nn.functional.linear(x, wr, sd["patch_embedding.bias"])
+
+    half = CFG.freq_dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    sinus = torch.cat([ang.cos(), ang.sin()], dim=-1)
+    temb = _t_linear(
+        sd, "condition_embedder.time_embedder.linear_2",
+        torch.nn.functional.silu(_t_linear(
+            sd, "condition_embedder.time_embedder.linear_1", sinus)))
+    proj = _t_linear(sd, "condition_embedder.time_proj",
+                     torch.nn.functional.silu(temb)).reshape(b, 6, D)
+    ctx = _t_linear(
+        sd, "condition_embedder.text_embedder.linear_2",
+        torch.nn.functional.gelu(_t_linear(
+            sd, "condition_embedder.text_embedder.linear_1", ctx_raw),
+            approximate="tanh"))
+
+    cos, sin = _t_rope_tables(f, gh, gw)
+    for i in range(CFG.num_layers):
+        bn = f"blocks.{i}"
+        mod = sd[f"{bn}.scale_shift_table"].float() + proj.float()
+        sh1, sc1, g1, sh2, sc2, g2 = [mod[:, j].unsqueeze(1)
+                                      for j in range(6)]
+        # 1. self-attention (reference :660-663)
+        h = (_t_ln(x, eps) * (1 + sc1) + sh1).type_as(x)
+        q = _t_rms(sd[f"{bn}.attn1.norm_q.weight"],
+                   _t_linear(sd, f"{bn}.attn1.to_q", h), eps)
+        k = _t_rms(sd[f"{bn}.attn1.norm_k.weight"],
+                   _t_linear(sd, f"{bn}.attn1.to_k", h), eps)
+        v = _t_linear(sd, f"{bn}.attn1.to_v", h)
+        q = _t_rope_apply(q.unflatten(2, (nh, hd)), cos, sin)
+        k = _t_rope_apply(k.unflatten(2, (nh, hd)), cos, sin)
+        attn = _t_attention(q, k, v.unflatten(2, (nh, hd)))
+        attn = _t_linear(sd, f"{bn}.attn1.to_out.0", attn.flatten(2, 3))
+        x = (x.float() + attn.float() * g1).type_as(x)
+        # 2. cross-attention (reference :665-667, norm2 affine)
+        h = (_t_ln(x, eps) * sd[f"{bn}.norm2.weight"].float()
+             + sd[f"{bn}.norm2.bias"].float()).type_as(x)
+        q = _t_rms(sd[f"{bn}.attn2.norm_q.weight"],
+                   _t_linear(sd, f"{bn}.attn2.to_q", h), eps)
+        k = _t_rms(sd[f"{bn}.attn2.norm_k.weight"],
+                   _t_linear(sd, f"{bn}.attn2.to_k", ctx), eps)
+        v = _t_linear(sd, f"{bn}.attn2.to_v", ctx)
+        attn = _t_attention(q.unflatten(2, (nh, hd)),
+                            k.unflatten(2, (nh, hd)),
+                            v.unflatten(2, (nh, hd)))
+        x = x + _t_linear(sd, f"{bn}.attn2.to_out.0",
+                          attn.flatten(2, 3))
+        # 3. feed-forward (reference :669-674)
+        h = (_t_ln(x, eps) * (1 + sc2) + sh2).type_as(x)
+        ff = _t_linear(sd, f"{bn}.ffn.net.2", torch.nn.functional.gelu(
+            _t_linear(sd, f"{bn}.ffn.net.0.proj", h),
+            approximate="tanh"))
+        x = (x.float() + ff.float() * g2).type_as(x)
+
+    mod = sd["scale_shift_table"].float() + temb.float().unsqueeze(1)
+    shift, scale = mod[:, 0].unsqueeze(1), mod[:, 1].unsqueeze(1)
+    x = (_t_ln(x, eps) * (1 + scale) + shift).type_as(x)
+    out = _t_linear(sd, "proj_out", x)
+    out = out.reshape(b, f, gh, gw, p, p, CFG.out_channels)
+    out = out.permute(0, 1, 2, 4, 3, 5, 6).reshape(
+        b, f, gh * p, gw * p, CFG.out_channels)
+    return out
+
+
+def test_wan_ckpt_dit_parity(checkpoint):
+    ckpt_dir, sd = checkpoint
+    params, cfg = wc.load_wan_dit(ckpt_dir, cfg=CFG, dtype=jnp.float32)
+    g = np.random.default_rng(1)
+    lat = g.standard_normal((1, 2, 4, 4, CFG.in_channels)).astype(
+        np.float32)
+    ctx = g.standard_normal((1, 5, CFG.text_dim)).astype(np.float32)
+    t = np.asarray([500.0], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, torch.from_numpy(lat), torch.from_numpy(ctx),
+                      torch.from_numpy(t)).numpy()
+    got = np.asarray(wc.forward(params, cfg, jnp.asarray(lat),
+                                jnp.asarray(ctx), jnp.asarray(t)))
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=1e-4)
+
+
+def test_rope_interleaved_pairs_match():
+    cos, sin = wc.rope_tables(CFG, 2, 2, 2)
+    tc, ts = _t_rope_tables(2, 2, 2)
+    np.testing.assert_allclose(np.asarray(cos), tc[0, :, 0].numpy(),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sin), ts[0, :, 0].numpy(),
+                               atol=1e-6)

@@ -1,0 +1,195 @@
+"""CLIP text encoder (SD3 / Flux pooled-conditioning stack).
+
+Checkpoint-schema implementation of the transformers ``CLIPTextModel``
+tower the reference's SD3 (clip-L + OpenCLIP-bigG) and Flux (clip-L)
+pipelines pool prompt embeddings from (diffusers loads them via
+transformers).  Pre-LN causal transformer over learned positions;
+``quick_gelu`` (CLIP-L) or ``gelu`` activations; the pooled vector is
+the final-LN hidden at the EOS position.
+
+TPU-first: pure functions over a param pytree, one jit per bucketed
+sequence length; the causal bias is built inside the trace from static
+shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import nn
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_positions: int = 77
+    eps: float = 1e-5
+    act: str = "quick_gelu"  # "quick_gelu" (CLIP-L) | "gelu" (bigG)
+    eos_token_id: int = 49407
+
+    @staticmethod
+    def tiny(vocab_size: int = 64) -> "CLIPTextConfig":
+        return CLIPTextConfig(vocab_size=vocab_size, hidden_size=32,
+                              num_layers=2, num_heads=4,
+                              intermediate_size=64, max_positions=16,
+                              eos_token_id=vocab_size - 1)
+
+    @staticmethod
+    def from_hf(d: dict) -> "CLIPTextConfig":
+        return CLIPTextConfig(
+            vocab_size=d.get("vocab_size", 49408),
+            hidden_size=d.get("hidden_size", 768),
+            num_layers=d.get("num_hidden_layers", 12),
+            num_heads=d.get("num_attention_heads", 12),
+            intermediate_size=d.get("intermediate_size", 3072),
+            max_positions=d.get("max_position_embeddings", 77),
+            eps=d.get("layer_norm_eps", 1e-5),
+            act=d.get("hidden_act", "quick_gelu"),
+            eos_token_id=d.get("eos_token_id", 49407),
+        )
+
+
+def init_params(key, cfg: CLIPTextConfig, dtype=jnp.float32):
+    ki = iter(jax.random.split(key, 2 + 6 * cfg.num_layers))
+    h = cfg.hidden_size
+    p = {
+        "token_embed": nn.embedding_init(next(ki), cfg.vocab_size, h,
+                                         dtype),
+        "pos_embed": nn.embedding_init(next(ki), cfg.max_positions, h,
+                                       dtype),
+        "final_norm": nn.layernorm_init(h, dtype=dtype),
+        "layers": [],
+    }
+    for _ in range(cfg.num_layers):
+        p["layers"].append({
+            "norm1": nn.layernorm_init(h, dtype=dtype),
+            "q_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "k_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "v_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "out_proj": nn.linear_init(next(ki), h, h, dtype=dtype),
+            "norm2": nn.layernorm_init(h, dtype=dtype),
+            "fc1": nn.linear_init(next(ki), h, cfg.intermediate_size,
+                                  dtype=dtype),
+            "fc2": nn.linear_init(next(ki), cfg.intermediate_size, h,
+                                  dtype=dtype),
+        })
+    return p
+
+
+def _act(cfg: CLIPTextConfig, x):
+    if cfg.act == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    return jax.nn.gelu(x, approximate=False)
+
+
+def forward(params, cfg: CLIPTextConfig, token_ids: jax.Array):
+    """token_ids [B, S] -> (last_hidden [B, S, h], pooled [B, h]).
+
+    ``pooled`` is the final-LN hidden at each row's EOS position (the
+    first occurrence of eos_token_id; transformers CLIPTextModel pooled
+    output).  S must be <= max_positions; pad WITH eos/pad ids after the
+    real eos like the CLIP tokenizer does.
+    """
+    b, s = token_ids.shape
+    x = nn.embedding(params["token_embed"], token_ids)
+    x = x + nn.embedding(params["pos_embed"], jnp.arange(s))[None]
+    causal = jnp.where(
+        jnp.arange(s)[None, :] <= jnp.arange(s)[:, None], 0.0, -1e30)
+    scale = 1.0 / math.sqrt(cfg.hidden_size // cfg.num_heads)
+    for lp in params["layers"]:
+        h = nn.layernorm(lp["norm1"], x, eps=cfg.eps)
+        q = nn.linear(lp["q_proj"], h).reshape(b, s, cfg.num_heads, -1)
+        k = nn.linear(lp["k_proj"], h).reshape(b, s, cfg.num_heads, -1)
+        v = nn.linear(lp["v_proj"], h).reshape(b, s, cfg.num_heads, -1)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        precision=jax.lax.Precision.HIGHEST) * scale
+        a = jax.nn.softmax(sc + causal[None, None], axis=-1).astype(
+            x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", a, v,
+                       precision=jax.lax.Precision.HIGHEST)
+        x = x + nn.linear(lp["out_proj"], o.reshape(b, s, -1))
+        h = nn.layernorm(lp["norm2"], x, eps=cfg.eps)
+        x = x + nn.linear(lp["fc2"], _act(cfg, nn.linear(lp["fc1"], h)))
+    out = nn.layernorm(params["final_norm"], x, eps=cfg.eps)
+    if cfg.eos_token_id == 2:
+        # transformers-legacy configs (the published CLIP-L/bigG
+        # text_encoder config.json ships eos_token_id=2 while the real
+        # EOS is the highest vocab id): pool at the max token id, the
+        # CLIPTextModel legacy branch
+        eos_pos = jnp.argmax(token_ids, axis=1)
+    else:
+        # first EOS per row (argmax of the == mask finds the first True)
+        eos_pos = jnp.argmax(
+            (token_ids == cfg.eos_token_id).astype(jnp.int32), axis=1)
+    pooled = out[jnp.arange(b), eos_pos]
+    return out, pooled
+
+
+# ------------------------------------------------------- checkpoint load
+def hf_flat_map(cfg: CLIPTextConfig,
+                prefix: str = "text_model.") -> dict:
+    m: dict[str, tuple] = {}
+    m[f"{prefix}embeddings.token_embedding.weight"] = \
+        ("token_embed", "w")
+    m[f"{prefix}embeddings.position_embedding.weight"] = \
+        ("pos_embed", "w")
+    m[f"{prefix}final_layer_norm.weight"] = ("final_norm", "w")
+    m[f"{prefix}final_layer_norm.bias"] = ("final_norm", "b")
+    for i in range(cfg.num_layers):
+        lp = f"{prefix}encoder.layers.{i}"
+        tgt = ("layers", i)
+        for hf, ours in (("layer_norm1", "norm1"),
+                         ("layer_norm2", "norm2"),
+                         ("self_attn.q_proj", "q_proj"),
+                         ("self_attn.k_proj", "k_proj"),
+                         ("self_attn.v_proj", "v_proj"),
+                         ("self_attn.out_proj", "out_proj"),
+                         ("mlp.fc1", "fc1"), ("mlp.fc2", "fc2")):
+            m[f"{lp}.{hf}.weight"] = tgt + (ours, "w")
+            m[f"{lp}.{hf}.bias"] = tgt + (ours, "b")
+    return m
+
+
+def hf_transform(name: str, arr):
+    if arr.ndim == 2 and name.endswith("weight") \
+            and "embedding" not in name:
+        return arr.T
+    return arr
+
+
+def load_clip_text(model_dir: str, cfg: CLIPTextConfig = None,
+                   dtype=jnp.float32, prefix: str = "text_model.",
+                   hf_cfg: dict = None):
+    """Stream a CLIP text tower out of a checkpoint directory."""
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+
+    if cfg is None:
+        cfg = CLIPTextConfig.from_hf(hf_cfg or {})
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    flat = hf_flat_map(cfg, prefix)
+    n, _ = load_checkpoint_tree(
+        model_dir, flat.get, tree, dtype=np.float32,
+        transform=hf_transform, name_filter=lambda nm: nm in flat,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if n < n_leaves:
+        raise ValueError(
+            f"{model_dir} covered {n}/{n_leaves} CLIP text weights")
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), tree), cfg

@@ -1,0 +1,259 @@
+"""T5 / UMT5 text encoder (diffusion-pipeline conditioning stack).
+
+Checkpoint-schema implementation of the transformers
+``T5EncoderModel`` / ``UMT5EncoderModel`` encoders — the text towers the
+reference's Wan (UMT5-XXL), SD3 and Flux (T5-XL) pipelines condition on
+(reference: vllm_omni/diffusion/models/wan2_2/pipeline_wan2_2.py text
+encoder; diffusers loads them via transformers).  T5 specifics honored
+exactly: pre-RMSNorm without mean subtraction or bias, NO 1/sqrt(d)
+attention scaling (folded into init), bucketed relative position bias
+(shared across layers for T5, per-layer for UMT5), gated-GELU or ReLU
+feed-forward.
+
+TPU-first: pure functions over a param pytree; the relative-position
+bucket table is precomputed host-side per (bucketed) sequence length so
+the jitted forward sees a static gather.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import rms_norm
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 256384
+    d_model: int = 4096
+    d_kv: int = 64
+    d_ff: int = 10240
+    num_layers: int = 24
+    num_heads: int = 64
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    eps: float = 1e-6
+    gated_act: bool = True      # gated-gelu (wi_0/wi_1) vs relu (wi)
+    per_layer_rel_bias: bool = True  # UMT5: every layer; T5: layer 0 only
+
+    @staticmethod
+    def tiny(vocab_size: int = 64) -> "T5Config":
+        return T5Config(vocab_size=vocab_size, d_model=32, d_kv=8,
+                        d_ff=64, num_layers=2, num_heads=4)
+
+    @staticmethod
+    def from_hf(d: dict) -> "T5Config":
+        act = d.get("feed_forward_proj", "gated-gelu")
+        return T5Config(
+            vocab_size=d.get("vocab_size", 256384),
+            d_model=d.get("d_model", 4096),
+            d_kv=d.get("d_kv", 64),
+            d_ff=d.get("d_ff", 10240),
+            num_layers=d.get("num_layers", 24),
+            num_heads=d.get("num_heads", 64),
+            rel_buckets=d.get("relative_attention_num_buckets", 32),
+            rel_max_distance=d.get("relative_attention_max_distance",
+                                   128),
+            eps=d.get("layer_norm_epsilon", 1e-6),
+            gated_act="gated" in act,
+            per_layer_rel_bias=d.get("model_type", "umt5") == "umt5",
+        )
+
+
+def init_params(key, cfg: T5Config, dtype=jnp.float32):
+    ki = iter(jax.random.split(key, 2 + 8 * cfg.num_layers))
+    d = cfg.d_model
+    inner = cfg.num_heads * cfg.d_kv
+    p = {
+        "embed": nn.embedding_init(next(ki), cfg.vocab_size, d, dtype),
+        "final_norm": nn.rmsnorm_init(d, dtype),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        layer = {
+            "attn_norm": nn.rmsnorm_init(d, dtype),
+            "q": nn.linear_init(next(ki), d, inner, bias=False,
+                                dtype=dtype),
+            "k": nn.linear_init(next(ki), d, inner, bias=False,
+                                dtype=dtype),
+            "v": nn.linear_init(next(ki), d, inner, bias=False,
+                                dtype=dtype),
+            "o": nn.linear_init(next(ki), inner, d, bias=False,
+                                dtype=dtype),
+            "ff_norm": nn.rmsnorm_init(d, dtype),
+        }
+        if cfg.gated_act:
+            layer["wi_0"] = nn.linear_init(next(ki), d, cfg.d_ff,
+                                           bias=False, dtype=dtype)
+            layer["wi_1"] = nn.linear_init(next(ki), d, cfg.d_ff,
+                                           bias=False, dtype=dtype)
+        else:
+            layer["wi"] = nn.linear_init(next(ki), d, cfg.d_ff,
+                                         bias=False, dtype=dtype)
+        layer["wo"] = nn.linear_init(next(ki), cfg.d_ff, d, bias=False,
+                                     dtype=dtype)
+        if cfg.per_layer_rel_bias or i == 0:
+            layer["rel_bias"] = nn.embedding_init(
+                next(ki), cfg.rel_buckets, cfg.num_heads, dtype)
+        p["layers"].append(layer)
+    return p
+
+
+def relative_position_buckets(seq_len: int, num_buckets: int,
+                              max_distance: int) -> np.ndarray:
+    """[S, S] bucket ids (bidirectional; transformers
+    T5Attention._relative_position_bucket).  Host-side: the table is a
+    static operand of the jitted forward."""
+    ctx = np.arange(seq_len)
+    rel = ctx[None, :] - ctx[:, None]  # memory - query
+    nb = num_buckets // 2
+    buckets = (rel > 0).astype(np.int64) * nb
+    rel = np.abs(rel)
+    max_exact = nb // 2
+    is_small = rel < max_exact
+    large = max_exact + (
+        np.log(np.maximum(rel, 1) / max_exact)
+        / math.log(max_distance / max_exact) * (nb - max_exact)
+    ).astype(np.int64)
+    large = np.minimum(large, nb - 1)
+    buckets += np.where(is_small, rel, large)
+    return buckets
+
+
+def forward(params, cfg: T5Config, token_ids: jax.Array,
+            mask: jax.Array | None = None) -> jax.Array:
+    """token_ids [B, S] (+ padding mask [B, S], 1 = live) ->
+    last_hidden_state [B, S, d_model]."""
+    b, s = token_ids.shape
+    x = nn.embedding(params["embed"], token_ids)
+    buckets = jnp.asarray(
+        relative_position_buckets(s, cfg.rel_buckets,
+                                  cfg.rel_max_distance))
+    pad_bias = (jnp.where(mask > 0, 0.0, -1e30)[:, None, None, :]
+                if mask is not None else 0.0)
+    rel_bias = None
+    for layer in params["layers"]:
+        if "rel_bias" in layer:
+            # [S, S, H] -> [H, S, S]
+            rel_bias = jnp.transpose(
+                nn.embedding(layer["rel_bias"], buckets), (2, 0, 1))
+        h = rms_norm(x, layer["attn_norm"]["w"], cfg.eps)
+        q = nn.linear(layer["q"], h).reshape(b, s, cfg.num_heads, -1)
+        k = nn.linear(layer["k"], h).reshape(b, s, cfg.num_heads, -1)
+        v = nn.linear(layer["v"], h).reshape(b, s, cfg.num_heads, -1)
+        # NO 1/sqrt(d_kv) scale: T5 folds it into the init
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32),
+                            precision=jax.lax.Precision.HIGHEST)
+        scores = scores + rel_bias[None].astype(jnp.float32) + pad_bias
+        a = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", a, v,
+                       precision=jax.lax.Precision.HIGHEST)
+        x = x + nn.linear(layer["o"], o.reshape(b, s, -1))
+        h = rms_norm(x, layer["ff_norm"]["w"], cfg.eps)
+        if cfg.gated_act:
+            h = (jax.nn.gelu(nn.linear(layer["wi_0"], h),
+                             approximate=True)
+                 * nn.linear(layer["wi_1"], h))
+        else:
+            h = jax.nn.relu(nn.linear(layer["wi"], h))
+        x = x + nn.linear(layer["wo"], h)
+    out = rms_norm(x, params["final_norm"]["w"], cfg.eps)
+    if mask is not None:
+        out = out * mask[..., None].astype(out.dtype)
+    return out
+
+
+# ------------------------------------------------------- checkpoint load
+def hf_flat_map(cfg: T5Config, prefix: str = "") -> dict:
+    m: dict[str, tuple] = {
+        # tied table: checkpoints carry either spelling (save_model
+        # dedupes the alias)
+        f"{prefix}shared.weight": ("embed", "w"),
+        f"{prefix}encoder.embed_tokens.weight": ("embed", "w"),
+        f"{prefix}encoder.final_layer_norm.weight": ("final_norm", "w"),
+    }
+    for i in range(cfg.num_layers):
+        blk = f"{prefix}encoder.block.{i}"
+        tgt = ("layers", i)
+        for hf, ours in (("layer.0.SelfAttention.q", "q"),
+                         ("layer.0.SelfAttention.k", "k"),
+                         ("layer.0.SelfAttention.v", "v"),
+                         ("layer.0.SelfAttention.o", "o")):
+            m[f"{blk}.{hf}.weight"] = tgt + (ours, "w")
+        m[f"{blk}.layer.0.layer_norm.weight"] = tgt + ("attn_norm", "w")
+        m[f"{blk}.layer.1.layer_norm.weight"] = tgt + ("ff_norm", "w")
+        ff = ("DenseGatedActDense" if cfg.gated_act else "DenseReluDense")
+        # transformers uses DenseReluDense as the attr name for BOTH
+        # variants in many checkpoints; accept either spelling
+        for dense in (ff, "DenseReluDense", "DenseGatedActDense"):
+            if cfg.gated_act:
+                m.setdefault(f"{blk}.layer.1.{dense}.wi_0.weight",
+                             tgt + ("wi_0", "w"))
+                m.setdefault(f"{blk}.layer.1.{dense}.wi_1.weight",
+                             tgt + ("wi_1", "w"))
+            else:
+                m.setdefault(f"{blk}.layer.1.{dense}.wi.weight",
+                             tgt + ("wi", "w"))
+            m.setdefault(f"{blk}.layer.1.{dense}.wo.weight",
+                         tgt + ("wo", "w"))
+        if cfg.per_layer_rel_bias or i == 0:
+            m[f"{blk}.layer.0.SelfAttention.relative_attention_bias"
+              f".weight"] = tgt + ("rel_bias", "w")
+    return m
+
+
+def hf_transform(name: str, arr):
+    """Linears [out, in] -> [in, out]; embeddings (shared token table and
+    the [num_buckets, n_heads] relative bias) stay as stored."""
+    if arr.ndim == 2 and "shared" not in name \
+            and "embed_tokens" not in name \
+            and "relative_attention_bias" not in name:
+        return arr.T
+    return arr
+
+
+def load_t5(model_dir: str, cfg: T5Config = None, dtype=jnp.float32,
+            prefix: str = "", hf_cfg: dict = None):
+    """Stream a T5/UMT5 encoder out of a checkpoint directory."""
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+
+    if cfg is None:
+        cfg = T5Config.from_hf(hf_cfg or {})
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    flat = hf_flat_map(cfg, prefix)
+    # count coverage per UNIQUE leaf path: a checkpoint carrying BOTH
+    # spellings of the tied token table (shared.weight /
+    # encoder.embed_tokens.weight) must not mask a genuinely missing
+    # tensor elsewhere
+    seen: set[tuple] = set()
+
+    def name_map(nm):
+        path = flat.get(nm)
+        if path is not None:
+            seen.add(path)
+        return path
+
+    load_checkpoint_tree(
+        model_dir, name_map, tree, dtype=np.float32,
+        transform=hf_transform, name_filter=lambda nm: nm in flat,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if len(seen) < n_leaves:
+        raise ValueError(
+            f"{model_dir} covered {len(seen)}/{n_leaves} T5 encoder "
+            f"weights")
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), tree), cfg

@@ -273,13 +273,20 @@ class HunyuanImage3Pipeline:
 
     # ------------------------------------------------------- image intake
 
+    @staticmethod
+    def _cond_image(req):
+        """The request's conditioning image, from either intake key —
+        ONE lookup shared by the VAE and ViT context paths (their
+        outputs are concatenated, so they must agree on presence)."""
+        sp = req.sampling_params
+        return sp.image if sp.image is not None else sp.extra.get(
+            "image")
+
     def _image_context(self, req, batch: int, th: int, tw: int):
         """sampling_params.image -> conditioning tokens [B, S_img,
         hidden] embedded through the UNetDown patch embed at t=0 (the
         clean-image end of the flow; _encode_cond_image), or None."""
-        sp = req.sampling_params
-        image = sp.image if sp.image is not None else sp.extra.get(
-            "image")
+        image = self._cond_image(req)
         if image is None:
             return None
         img = intake.prepare_cond_image(image, th, tw)
@@ -311,9 +318,7 @@ class HunyuanImage3Pipeline:
         plus the token grid for the rope section.  (None, (0, 0)) when
         the request has no image or the tower is disabled."""
         vit_cfg = self.cfg.vit
-        sp = req.sampling_params
-        image = sp.image if sp.image is not None else sp.extra.get(
-            "image")
+        image = self._cond_image(req)
         if image is None or vit_cfg is None:
             return None, (0, 0)
         side_p = int(math.isqrt(vit_cfg.num_positions))

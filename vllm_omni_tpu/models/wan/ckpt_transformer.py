@@ -1,0 +1,391 @@
+"""Checkpoint-schema Wan video DiT (diffusers WanTransformer3DModel).
+
+The real-weight twin of models/wan/transformer.py: same pipeline
+protocol (forward_prefix -> block stack -> forward_suffix, with the
+dual-block cache splitting the stack), parameters and math at the
+published checkpoint schema (reference:
+vllm_omni/diffusion/models/wan2_2/wan2_2_transformer.py —
+WanTransformerBlock :589, WanTimeTextImageEmbedding :251,
+WanRotaryPosEmbed :147, apply_rotary_emb_wan :34).
+
+Schema specifics honored exactly:
+- per-block ``scale_shift_table`` [1, 6, D] added to a GLOBAL
+  timestep projection (not per-block adaLN linears),
+- fp32 non-affine LayerNorms around self-attn/FFN, affine ``norm2``
+  before cross-attention,
+- q/k RMSNorm over the FULL inner dim (before head split), biased
+  projections throughout,
+- interleaved-pair 3D rope ((t, h, w) sections of head_dim:
+  [D - 2*(D//3), D//3, D//3]),
+- GELU-tanh feed-forward (ffn.net.0.proj / ffn.net.2),
+- output modulated by the root scale_shift_table [1, 2, D] + temb.
+
+Supported conditioning matches the repo's Wan pipelines: per-batch
+timesteps [B] (T2V / I2V / TI2V via channel concat); the reference's
+per-token timestep variant is out of scope here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+logger = init_logger(__name__)
+
+
+@dataclass(frozen=True)
+class WanCkptConfig:
+    patch_size: int = 2          # spatial; temporal patch is 1
+    in_channels: int = 16
+    out_channels: int = 16
+    num_layers: int = 30
+    num_heads: int = 12
+    head_dim: int = 128
+    ffn_dim: int = 8960
+    text_dim: int = 4096         # UMT5 feature width
+    freq_dim: int = 256
+    theta: float = 10000.0
+    eps: float = 1e-6
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @staticmethod
+    def tiny() -> "WanCkptConfig":
+        return WanCkptConfig(in_channels=4, out_channels=4, num_layers=2,
+                             num_heads=4, head_dim=32, ffn_dim=64,
+                             text_dim=64, freq_dim=32)
+
+    @staticmethod
+    def from_hf(d: dict) -> "WanCkptConfig":
+        patch = d.get("patch_size", [1, 2, 2])
+        return WanCkptConfig(
+            patch_size=patch[1],
+            in_channels=d.get("in_channels", 16),
+            out_channels=d.get("out_channels", 16),
+            num_layers=d.get("num_layers", 30),
+            num_heads=d.get("num_attention_heads", 12),
+            head_dim=d.get("attention_head_dim", 128),
+            ffn_dim=d.get("ffn_dim", 8960),
+            text_dim=d.get("text_dim", 4096),
+            freq_dim=d.get("freq_dim", 256),
+            eps=d.get("eps", 1e-6),
+        )
+
+
+def _attn_init(key, dim: int, kv_dim: int, dtype):
+    k = jax.random.split(key, 4)
+    return {
+        "to_q": nn.linear_init(k[0], dim, dim, dtype=dtype),
+        "to_k": nn.linear_init(k[1], kv_dim, dim, dtype=dtype),
+        "to_v": nn.linear_init(k[2], kv_dim, dim, dtype=dtype),
+        "to_out": nn.linear_init(k[3], dim, dim, dtype=dtype),
+        "norm_q": nn.rmsnorm_init(dim, dtype),
+        "norm_k": nn.rmsnorm_init(dim, dtype),
+    }
+
+
+def init_params(key, cfg: WanCkptConfig, dtype=jnp.float32):
+    d = cfg.inner_dim
+    keys = jax.random.split(key, cfg.num_layers + 8)
+    patch_in = cfg.in_channels * cfg.patch_size ** 2
+    p = {
+        "patch_embedding": nn.linear_init(keys[0], patch_in, d,
+                                          dtype=dtype),
+        "condition_embedder": {
+            "time_embedder": {
+                "linear_1": nn.linear_init(keys[1], cfg.freq_dim, d,
+                                           dtype=dtype),
+                "linear_2": nn.linear_init(keys[2], d, d, dtype=dtype),
+            },
+            "time_proj": nn.linear_init(keys[3], d, 6 * d, dtype=dtype),
+            "text_embedder": {
+                "linear_1": nn.linear_init(keys[4], cfg.text_dim, d,
+                                           dtype=dtype),
+                "linear_2": nn.linear_init(keys[5], d, d, dtype=dtype),
+            },
+        },
+        "scale_shift_table": jax.random.normal(
+            keys[6], (1, 2, d), dtype) / d ** 0.5,
+        "proj_out": nn.linear_init(
+            keys[7], d, cfg.patch_size ** 2 * cfg.out_channels,
+            dtype=dtype),
+        "blocks": [],
+    }
+    for i in range(cfg.num_layers):
+        bk = jax.random.split(keys[i + 8] if i + 8 < len(keys)
+                              else jax.random.fold_in(key, i), 4)
+        p["blocks"].append({
+            "attn1": _attn_init(bk[0], d, d, dtype),
+            "attn2": _attn_init(bk[1], d, d, dtype),
+            "norm2": nn.layernorm_init(d, dtype=dtype),
+            "ffn": {
+                "fc1": nn.linear_init(bk[2], d, cfg.ffn_dim, dtype=dtype),
+                "fc2": nn.linear_init(bk[3], cfg.ffn_dim, d, dtype=dtype),
+            },
+            "scale_shift_table": jax.random.normal(
+                jax.random.fold_in(bk[3], 1), (1, 6, d), dtype) / d ** 0.5,
+        })
+    return p
+
+
+# ------------------------------------------------------------------ rope
+def rope_tables(cfg: WanCkptConfig, frames: int, grid_h: int,
+                grid_w: int):
+    """Interleaved-pair 3D rope tables [S, head_dim] (cos, sin) —
+    WanRotaryPosEmbed with repeat_interleave(2) over pair frequencies."""
+    d = cfg.head_dim
+    sizes = [d - 2 * (d // 3), d // 3, d // 3]
+
+    def axis(n, dim):
+        inv = 1.0 / (cfg.theta
+                     ** (np.arange(0, dim, 2, np.float64) / dim))
+        ang = np.arange(n, dtype=np.float64)[:, None] * inv[None, :]
+        return (np.repeat(np.cos(ang), 2, axis=-1),
+                np.repeat(np.sin(ang), 2, axis=-1))
+
+    cf, sf = axis(frames, sizes[0])
+    ch, sh = axis(grid_h, sizes[1])
+    cw, sw = axis(grid_w, sizes[2])
+    shape = (frames, grid_h, grid_w)
+
+    def grid(t, h, w):
+        return np.concatenate([
+            np.broadcast_to(t[:, None, None, :], shape + (t.shape[-1],)),
+            np.broadcast_to(h[None, :, None, :], shape + (h.shape[-1],)),
+            np.broadcast_to(w[None, None, :, :], shape + (w.shape[-1],)),
+        ], axis=-1).reshape(frames * grid_h * grid_w, d)
+
+    return (jnp.asarray(grid(cf, ch, cw), jnp.float32),
+            jnp.asarray(grid(sf, sh, sw), jnp.float32))
+
+
+def _rope_apply(x, cos, sin):
+    """x [B, S, H, D]; interleaved pairs (apply_rotary_emb_wan):
+    out[0::2] = x1*c - x2*s ; out[1::2] = x1*s + x2*c."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[None, :, None, 0::2].astype(jnp.float32)
+    s = sin[None, :, None, 1::2].astype(jnp.float32)
+    x1f = x1.astype(jnp.float32)
+    x2f = x2.astype(jnp.float32)
+    out = jnp.stack([x1f * c - x2f * s, x1f * s + x2f * c], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _ln(x, eps):
+    """fp32 non-affine LayerNorm (FP32LayerNorm elementwise_affine=False);
+    returns fp32."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return (xf - mu) * jax.lax.rsqrt(var + eps)
+
+
+def _heads(x, n):
+    b, s, _ = x.shape
+    return x.reshape(b, s, n, -1)
+
+
+def _merge(x):
+    b, s = x.shape[:2]
+    return x.reshape(b, s, -1)
+
+
+# ------------------------------------------------------------ components
+def project_ctx(params, cfg: WanCkptConfig, ctx: jax.Array) -> jax.Array:
+    """Raw text-encoder features [B, S, text_dim] -> [B, S, inner]
+    (PixArtAlphaTextProjection, gelu_tanh)."""
+    te = params["condition_embedder"]["text_embedder"]
+    return nn.linear(te["linear_2"],
+                     jax.nn.gelu(nn.linear(te["linear_1"], ctx),
+                                 approximate=True))
+
+
+def forward_prefix(params, cfg: WanCkptConfig, latents, timesteps):
+    """Embeds + conditioning before the block stack.  Returns the same
+    state tuple shape as the native module, with the temb slot carrying
+    (timestep_proj [B, 6, D], temb [B, D])."""
+    from vllm_omni_tpu.models.wan.transformer import patchify
+
+    b, f, h, w, c = latents.shape
+    p = cfg.patch_size
+    gh, gw = h // p, w // p
+    x = nn.linear(params["patch_embedding"], patchify(latents, p))
+    te = params["condition_embedder"]["time_embedder"]
+    sinus = nn.timestep_embedding(timesteps, cfg.freq_dim).astype(x.dtype)
+    temb = nn.linear(te["linear_2"],
+                     jax.nn.silu(nn.linear(te["linear_1"], sinus)))
+    proj = nn.linear(params["condition_embedder"]["time_proj"],
+                     jax.nn.silu(temb))
+    d = cfg.inner_dim
+    rope = rope_tables(cfg, f, gh, gw)
+    return x, (proj.reshape(b, 6, d), temb), rope, (f, gh, gw)
+
+
+def block_forward(blk, cfg: WanCkptConfig, x, ctx, temb_state, rope,
+                  ctx_mask=None, self_attn_fn=None):
+    """One WanTransformerBlock (reference :634-676); ``ctx`` must already
+    be projected through ``project_ctx``."""
+    proj, _ = temb_state
+    eps = cfg.eps
+    nh = cfg.num_heads
+    cos, sin = rope
+    mod = (blk["scale_shift_table"].astype(jnp.float32)
+           + proj.astype(jnp.float32))  # [B, 6, D]
+    sh1, sc1, g1, sh2, sc2, g2 = [mod[:, i][:, None] for i in range(6)]
+
+    # 1. modulated self-attention (qk-norm over the full inner dim)
+    a = blk["attn1"]
+    h = (_ln(x, eps) * (1 + sc1) + sh1).astype(x.dtype)
+    q = rms_norm(nn.linear(a["to_q"], h), a["norm_q"]["w"], eps)
+    k = rms_norm(nn.linear(a["to_k"], h), a["norm_k"]["w"], eps)
+    v = _heads(nn.linear(a["to_v"], h), nh)
+    q = _rope_apply(_heads(q, nh), cos, sin)
+    k = _rope_apply(_heads(k, nh), cos, sin)
+    if self_attn_fn is not None:
+        attn = self_attn_fn(q, k, v)
+    else:
+        attn = flash_attention(q, k, v, causal=False)
+    attn = nn.linear(a["to_out"], _merge(attn))
+    x = (x.astype(jnp.float32) + attn.astype(jnp.float32) * g1).astype(
+        x.dtype)
+
+    # 2. cross-attention (affine norm2, ungated residual)
+    a = blk["attn2"]
+    h = nn.layernorm(blk["norm2"], x, eps=eps)
+    q = _heads(rms_norm(nn.linear(a["to_q"], h), a["norm_q"]["w"], eps),
+               nh)
+    k = _heads(rms_norm(nn.linear(a["to_k"], ctx), a["norm_k"]["w"],
+                        eps), nh)
+    v = _heads(nn.linear(a["to_v"], ctx), nh)
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if ctx_mask is not None:
+        s = jnp.where(ctx_mask[:, None, None, :] > 0, s, -1e30)
+    pattn = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", pattn,
+                   v.astype(jnp.float32)).astype(x.dtype)
+    x = x + nn.linear(a["to_out"], _merge(o))
+
+    # 3. modulated GELU-tanh feed-forward
+    h = (_ln(x, eps) * (1 + sc2) + sh2).astype(x.dtype)
+    ff = nn.linear(blk["ffn"]["fc2"],
+                   jax.nn.gelu(nn.linear(blk["ffn"]["fc1"], h),
+                               approximate=True))
+    return (x.astype(jnp.float32) + ff.astype(jnp.float32) * g2).astype(
+        x.dtype)
+
+
+def forward_suffix(params, cfg: WanCkptConfig, x, temb_state, fgw):
+    from vllm_omni_tpu.models.wan.transformer import unpatchify
+
+    _, temb = temb_state
+    f, gh, gw = fgw
+    mod = (params["scale_shift_table"].astype(jnp.float32)
+           + temb.astype(jnp.float32)[:, None])  # [B, 2, D]
+    shift, scale = mod[:, 0][:, None], mod[:, 1][:, None]
+    x = ((_ln(x, cfg.eps) * (1 + scale) + shift)).astype(x.dtype)
+    out = nn.linear(params["proj_out"], x)
+    return unpatchify(out, cfg.patch_size, f, gh, gw, cfg.out_channels)
+
+
+def forward(params, cfg: WanCkptConfig, latents, ctx, timesteps,
+            ctx_mask=None, attn_fn=None):
+    """Velocity prediction (ctx = RAW text features; projected here)."""
+    x, temb_state, rope, fgw = forward_prefix(params, cfg, latents,
+                                              timesteps)
+    ctx = project_ctx(params, cfg, ctx)
+    for blk in params["blocks"]:
+        x = block_forward(blk, cfg, x, ctx, temb_state, rope,
+                          ctx_mask, attn_fn)
+    return forward_suffix(params, cfg, x, temb_state, fgw)
+
+
+# ------------------------------------------------------- checkpoint load
+def hf_flat_map(cfg: WanCkptConfig) -> dict:
+    m: dict[str, tuple] = {}
+
+    def wb(hf: str, *path):
+        m[f"{hf}.weight"] = path + ("w",)
+        m[f"{hf}.bias"] = path + ("b",)
+
+    wb("patch_embedding", "patch_embedding")
+    ce = ("condition_embedder",)
+    wb("condition_embedder.time_embedder.linear_1",
+       *ce, "time_embedder", "linear_1")
+    wb("condition_embedder.time_embedder.linear_2",
+       *ce, "time_embedder", "linear_2")
+    wb("condition_embedder.time_proj", *ce, "time_proj")
+    wb("condition_embedder.text_embedder.linear_1",
+       *ce, "text_embedder", "linear_1")
+    wb("condition_embedder.text_embedder.linear_2",
+       *ce, "text_embedder", "linear_2")
+    m["scale_shift_table"] = ("scale_shift_table",)
+    wb("proj_out", "proj_out")
+    for i in range(cfg.num_layers):
+        b = f"blocks.{i}"
+        tgt = ("blocks", i)
+        for attn in ("attn1", "attn2"):
+            for proj in ("to_q", "to_k", "to_v"):
+                wb(f"{b}.{attn}.{proj}", *tgt, attn, proj)
+            wb(f"{b}.{attn}.to_out.0", *tgt, attn, "to_out")
+            m[f"{b}.{attn}.norm_q.weight"] = tgt + (attn, "norm_q", "w")
+            m[f"{b}.{attn}.norm_k.weight"] = tgt + (attn, "norm_k", "w")
+        wb(f"{b}.norm2", *tgt, "norm2")
+        wb(f"{b}.ffn.net.0.proj", *tgt, "ffn", "fc1")
+        wb(f"{b}.ffn.net.2", *tgt, "ffn", "fc2")
+        m[f"{b}.scale_shift_table"] = tgt + ("scale_shift_table",)
+    return m
+
+
+def hf_transform(name: str, arr):
+    """Conv3d patch embedding [O, C, 1, p, p] -> linear [p*p*C, O]
+    matching patchify's (row, col, channel) feature order; other linears
+    [out, in] -> [in, out]; tables keep their stored shape."""
+    if name == "patch_embedding.weight":
+        o, c, kt, kh, kw = arr.shape
+        if kt != 1:
+            raise ValueError(f"temporal patch {kt} != 1 unsupported")
+        return arr.reshape(o, c, kh, kw).transpose(2, 3, 1, 0).reshape(
+            kh * kw * c, o)
+    if arr.ndim == 2 and name.endswith("weight"):
+        return arr.T
+    return arr
+
+
+def load_wan_dit(model_dir: str, cfg: WanCkptConfig = None,
+                 dtype=jnp.bfloat16):
+    """Stream a diffusers-format Wan transformer directory."""
+    import json
+    import os
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = WanCkptConfig.from_hf(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
+    flat = hf_flat_map(cfg)
+    n, _ = load_checkpoint_tree(
+        model_dir, flat.get, tree, dtype=np.float32,
+        transform=hf_transform, name_filter=lambda nm: nm in flat,
+    )
+    n_leaves = len(jax.tree.leaves(tree))
+    if n < n_leaves:
+        raise ValueError(
+            f"{model_dir} covered {n}/{n_leaves} Wan DiT weights")
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), tree), cfg
