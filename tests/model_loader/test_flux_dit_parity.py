@@ -1,0 +1,350 @@
+"""Flux checkpoint-schema parity vs a torch oracle.
+
+A synthetic diffusers-named FluxTransformer2DModel checkpoint is saved;
+our loader fuses/streams it and the jax forward (interleaved-rope
+convention) must match a torch oracle transcribed from the diffusers
+class semantics (AdaLayerNormZero double blocks with joint text-first
+attention, fused single-stream blocks, AdaLayerNormContinuous output).
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.flux import loader as fl  # noqa: E402
+from vllm_omni_tpu.models.flux import transformer as ft  # noqa: E402
+
+DIT_JSON = {
+    "in_channels": 16,
+    "num_layers": 2,
+    "num_single_layers": 2,
+    "attention_head_dim": 32,
+    "num_attention_heads": 4,
+    "joint_attention_dim": 64,
+    "pooled_projection_dim": 48,
+    "axes_dims_rope": [8, 12, 12],
+    "guidance_embeds": True,
+}
+CFG = fl.dit_config_from_diffusers(DIT_JSON)
+D = CFG.inner_dim
+MLP = int(D * CFG.mlp_ratio)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = (0.2 * g.standard_normal((o, i))).astype(
+            np.float32)
+        sd[f"{name}.bias"] = (0.1 * g.standard_normal((o,))).astype(
+            np.float32)
+
+    lin("x_embedder", CFG.in_channels, D)
+    lin("context_embedder", CFG.ctx_dim, D)
+    lin("time_text_embed.timestep_embedder.linear_1", 256, D)
+    lin("time_text_embed.timestep_embedder.linear_2", D, D)
+    lin("time_text_embed.text_embedder.linear_1", CFG.pooled_dim, D)
+    lin("time_text_embed.text_embedder.linear_2", D, D)
+    lin("time_text_embed.guidance_embedder.linear_1", 256, D)
+    lin("time_text_embed.guidance_embedder.linear_2", D, D)
+    lin("norm_out.linear", D, 2 * D)
+    lin("proj_out", D, CFG.out_channels)
+    for i in range(CFG.num_double_blocks):
+        b = f"transformer_blocks.{i}"
+        lin(f"{b}.norm1.linear", D, 6 * D)
+        lin(f"{b}.norm1_context.linear", D, 6 * D)
+        for pr in ("to_q", "to_k", "to_v", "add_q_proj", "add_k_proj",
+                   "add_v_proj"):
+            lin(f"{b}.attn.{pr}", D, D)
+        for nq in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(CFG.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.attn.to_out.0", D, D)
+        lin(f"{b}.attn.to_add_out", D, D)
+        lin(f"{b}.ff.net.0.proj", D, MLP)
+        lin(f"{b}.ff.net.2", MLP, D)
+        lin(f"{b}.ff_context.net.0.proj", D, MLP)
+        lin(f"{b}.ff_context.net.2", MLP, D)
+    for i in range(CFG.num_single_blocks):
+        b = f"single_transformer_blocks.{i}"
+        lin(f"{b}.norm.linear", D, 3 * D)
+        for pr in ("to_q", "to_k", "to_v"):
+            lin(f"{b}.attn.{pr}", D, D)
+        for nq in ("norm_q", "norm_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(CFG.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.proj_mlp", D, MLP)
+        lin(f"{b}.proj_out", D + MLP, D)
+    d = tmp_path_factory.mktemp("flux_ckpt")
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(DIT_JSON, f)
+    return str(d), {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+# ------------------------------------------------------------ torch oracle
+def _lin(sd, n, x):
+    return torch.nn.functional.linear(x, sd[f"{n}.weight"],
+                                      sd[f"{n}.bias"])
+
+
+def _ln(x):
+    return torch.nn.functional.layer_norm(x, (x.shape[-1],), eps=1e-6)
+
+
+def _rms(sd, n, x):
+    v = x.float().pow(2).mean(-1, keepdim=True)
+    return (x.float() * torch.rsqrt(v + 1e-6)
+            * sd[f"{n}.weight"].float()).type_as(x)
+
+
+def _sinus(t, dim=256):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    return torch.cat([ang.cos(), ang.sin()], dim=-1)
+
+
+def _rope_tables(gh, gw, s_txt):
+    halves = [d // 2 for d in CFG.axes_dims]
+    r = torch.arange(gh).repeat_interleave(gw)
+    c = torch.arange(gw).repeat(gh)
+    zeros = torch.zeros_like(r)
+
+    def ax(pos, half):
+        inv = 1.0 / (CFG.theta ** (
+            torch.arange(half, dtype=torch.float32) / half))
+        return pos.float()[:, None] * inv[None, :]
+
+    img = torch.cat([ax(zeros, halves[0]), ax(r, halves[1]),
+                     ax(c, halves[2])], dim=-1)
+    zt = torch.zeros(s_txt, dtype=torch.long)
+    txt = torch.cat([ax(zt, h) for h in halves], dim=-1)
+    ang = torch.cat([txt, img], dim=0)
+    return ang.cos(), ang.sin()
+
+
+def _rope(x, cos, sin):
+    # diffusers apply_rotary_emb use_real_unbind_dim=-1 (interleaved)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = torch.stack([x1 * c - x2 * s, x1 * s + x2 * c], dim=-1)
+    return out.reshape(x.shape)
+
+
+def _attn(q, k, v):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def _heads(x):
+    b, s, _ = x.shape
+    return x.reshape(b, s, CFG.num_heads, CFG.head_dim)
+
+
+def oracle(sd, img_tokens, txt, pooled, t, guidance, gh, gw):
+    b = img_tokens.shape[0]
+    img = _lin(sd, "x_embedder", img_tokens)
+    ctx = _lin(sd, "context_embedder", txt)
+    silu = torch.nn.functional.silu
+    temb = _lin(sd, "time_text_embed.timestep_embedder.linear_2",
+                silu(_lin(sd, "time_text_embed.timestep_embedder"
+                              ".linear_1", _sinus(t))))
+    temb = temb + _lin(sd, "time_text_embed.text_embedder.linear_2",
+                       silu(_lin(sd, "time_text_embed.text_embedder"
+                                     ".linear_1", pooled)))
+    temb = temb + _lin(sd, "time_text_embed.guidance_embedder.linear_2",
+                       silu(_lin(sd, "time_text_embed"
+                                     ".guidance_embedder.linear_1",
+                                 _sinus(guidance * 1000.0))))
+    emb = silu(temb)
+    s_txt = ctx.shape[1]
+    cos, sin = _rope_tables(gh, gw, s_txt)
+    gelu = torch.nn.functional.gelu
+
+    for i in range(CFG.num_double_blocks):
+        bn = f"transformer_blocks.{i}"
+        m_i = _lin(sd, f"{bn}.norm1.linear", emb).chunk(6, dim=-1)
+        m_t = _lin(sd, f"{bn}.norm1_context.linear", emb).chunk(6,
+                                                                dim=-1)
+        img_n = _ln(img) * (1 + m_i[1][:, None]) + m_i[0][:, None]
+        ctx_n = _ln(ctx) * (1 + m_t[1][:, None]) + m_t[0][:, None]
+        q = _rms(sd, f"{bn}.attn.norm_q",
+                 _heads(_lin(sd, f"{bn}.attn.to_q", img_n)))
+        k = _rms(sd, f"{bn}.attn.norm_k",
+                 _heads(_lin(sd, f"{bn}.attn.to_k", img_n)))
+        v = _heads(_lin(sd, f"{bn}.attn.to_v", img_n))
+        qt = _rms(sd, f"{bn}.attn.norm_added_q",
+                  _heads(_lin(sd, f"{bn}.attn.add_q_proj", ctx_n)))
+        kt = _rms(sd, f"{bn}.attn.norm_added_k",
+                  _heads(_lin(sd, f"{bn}.attn.add_k_proj", ctx_n)))
+        vt = _heads(_lin(sd, f"{bn}.attn.add_v_proj", ctx_n))
+        q = _rope(torch.cat([qt, q], dim=1), cos, sin)
+        k = _rope(torch.cat([kt, k], dim=1), cos, sin)
+        o = _attn(q, k, torch.cat([vt, v], dim=1))
+        o = o.reshape(b, o.shape[1], -1)
+        ctx_o, img_o = o[:, :s_txt], o[:, s_txt:]
+        img = img + m_i[2][:, None] * _lin(sd, f"{bn}.attn.to_out.0",
+                                           img_o)
+        ctx = ctx + m_t[2][:, None] * _lin(sd, f"{bn}.attn.to_add_out",
+                                           ctx_o)
+        img_n2 = _ln(img) * (1 + m_i[4][:, None]) + m_i[3][:, None]
+        img = img + m_i[5][:, None] * _lin(
+            sd, f"{bn}.ff.net.2",
+            gelu(_lin(sd, f"{bn}.ff.net.0.proj", img_n2),
+                 approximate="tanh"))
+        ctx_n2 = _ln(ctx) * (1 + m_t[4][:, None]) + m_t[3][:, None]
+        ctx = ctx + m_t[5][:, None] * _lin(
+            sd, f"{bn}.ff_context.net.2",
+            gelu(_lin(sd, f"{bn}.ff_context.net.0.proj", ctx_n2),
+                 approximate="tanh"))
+
+    x = torch.cat([ctx, img], dim=1)
+    for i in range(CFG.num_single_blocks):
+        bn = f"single_transformer_blocks.{i}"
+        m = _lin(sd, f"{bn}.norm.linear", emb).chunk(3, dim=-1)
+        x_n = _ln(x) * (1 + m[1][:, None]) + m[0][:, None]
+        q = _rope(_rms(sd, f"{bn}.attn.norm_q",
+                       _heads(_lin(sd, f"{bn}.attn.to_q", x_n))),
+                  cos, sin)
+        k = _rope(_rms(sd, f"{bn}.attn.norm_k",
+                       _heads(_lin(sd, f"{bn}.attn.to_k", x_n))),
+                  cos, sin)
+        v = _heads(_lin(sd, f"{bn}.attn.to_v", x_n))
+        o = _attn(q, k, v).reshape(b, x.shape[1], -1)
+        mlp = gelu(_lin(sd, f"{bn}.proj_mlp", x_n), approximate="tanh")
+        x = x + m[2][:, None] * _lin(sd, f"{bn}.proj_out",
+                                     torch.cat([o, mlp], dim=-1))
+    img = x[:, s_txt:]
+    m = _lin(sd, "norm_out.linear", emb).chunk(2, dim=-1)
+    img = _ln(img) * (1 + m[0][:, None]) + m[1][:, None]
+    return _lin(sd, "proj_out", img)
+
+
+def test_flux_ckpt_parity(checkpoint):
+    d, sd = checkpoint
+    params, cfg = fl.load_flux_dit(d, dtype=jnp.float32)
+    assert cfg.rope_interleaved
+    g = np.random.default_rng(1)
+    gh = gw = 2
+    img = g.standard_normal((1, gh * gw, CFG.in_channels)).astype(
+        np.float32)
+    txt = g.standard_normal((1, 5, CFG.ctx_dim)).astype(np.float32)
+    pooled = g.standard_normal((1, CFG.pooled_dim)).astype(np.float32)
+    t = np.asarray([500.0], np.float32)
+    gsc = np.asarray([3.5], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, torch.from_numpy(img), torch.from_numpy(txt),
+                      torch.from_numpy(pooled), torch.from_numpy(t),
+                      torch.from_numpy(gsc), gh, gw).numpy()
+    got = np.asarray(ft.forward(
+        params, cfg, jnp.asarray(img), jnp.asarray(txt),
+        jnp.asarray(pooled), jnp.asarray(t), (gh, gw),
+        guidance=jnp.asarray(gsc)))
+    # outputs reach |45| through 4 residual blocks; the fp32
+    # accumulation-order difference (Pallas flash attention vs the
+    # oracle's einsum) bounds agreement at ~2e-3 relative
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)
+
+
+@pytest.fixture(scope="module")
+def full_checkpoint(tmp_path_factory, checkpoint):
+    """Full diffusers-layout FLUX.1 directory: transformer + CLIP-L
+    text_encoder + T5 text_encoder_2 + tokenizers + AutoencoderKL."""
+    import shutil
+
+    from safetensors.torch import save_model
+    from transformers import CLIPTextConfig as HFClipCfg
+    from transformers import CLIPTextModel
+    from transformers import T5Config as HFT5Config
+    from transformers import T5EncoderModel
+
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+    from tests.model_loader.test_image_vae_parity import TINY as VAE_JSON
+
+    d, _ = checkpoint
+    root = tmp_path_factory.mktemp("flux_root")
+    shutil.copytree(d, root / "transformer")
+    torch.manual_seed(0)
+    clip = CLIPTextModel(HFClipCfg(
+        vocab_size=256, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, eos_token_id=255, bos_token_id=254,
+        pad_token_id=0)).eval()
+    (root / "text_encoder").mkdir()
+    save_model(clip, str(root / "text_encoder" / "model.safetensors"))
+    (root / "text_encoder" / "config.json").write_text(
+        json.dumps(clip.config.to_dict()))
+    t5 = T5EncoderModel(HFT5Config(
+        vocab_size=256, d_model=64, d_kv=16, d_ff=96, num_layers=2,
+        num_heads=4, feed_forward_proj="gated-gelu")).eval()
+    (root / "text_encoder_2").mkdir()
+    save_model(t5, str(root / "text_encoder_2" / "model.safetensors"))
+    (root / "text_encoder_2" / "config.json").write_text(
+        json.dumps(t5.config.to_dict()))
+    _write_byte_level_tokenizer(root / "tokenizer")
+    _write_byte_level_tokenizer(root / "tokenizer_2")
+    # reuse the image-VAE synthesis from its parity test
+    from tests.model_loader.test_image_vae_parity import (
+        make_vae_state_dict,
+        write_vae_dir,
+    )
+
+    write_vae_dir(str(root / "vae"), VAE_JSON,
+                  make_vae_state_dict(VAE_JSON, seed=7,
+                                      halves=("decoder",)))
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "FlowMatchEulerDiscreteScheduler",
+                    "shift": 3.0}))
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "FluxPipeline",
+        "transformer": ["diffusers", "FluxTransformer2DModel"],
+        "text_encoder": ["transformers", "CLIPTextModel"],
+        "text_encoder_2": ["transformers", "T5EncoderModel"],
+        "vae": ["diffusers", "AutoencoderKL"],
+    }))
+    return str(root)
+
+
+def test_flux_from_pretrained_generates(full_checkpoint):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.flux.pipeline import FluxPipeline
+
+    pipe = FluxPipeline.from_pretrained(full_checkpoint,
+                                        dtype=jnp.float32,
+                                        max_text_len=8)
+    assert pipe._t5_text and pipe.cfg.clip is not None
+    assert pipe.cfg.shift == 3.0
+    sp = OmniDiffusionSamplingParams(
+        height=8, width=8, num_inference_steps=2, guidance_scale=3.5,
+        seed=0)
+    out = pipe.forward(OmniDiffusionRequest(
+        prompt=["a red ball"], sampling_params=sp, request_ids=["r0"]))
+    img = out[0].data
+    assert img.dtype == np.uint8 and img.shape == (8, 8, 3)
+    out2 = pipe.forward(OmniDiffusionRequest(
+        prompt=["a blue cube"], sampling_params=sp, request_ids=["r1"]))
+    assert not np.array_equal(img, out2[0].data)

@@ -1,0 +1,214 @@
+"""2-D AutoencoderKL (SD3/Flux-style) loader parity vs a torch oracle.
+
+A synthetic diffusers-named checkpoint is written covering every leaf;
+the loader streams it into models/qwen_image/vae.py and decode/encode
+must match a torch reimplementation of the diffusers class semantics
+(GroupNorm(32)+SiLU resnets, single-head mid attention, nearest x2
+upsampling, (0,1)-padded stride-2 downsampling).
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.model_loader import diffusers_loader as dl  # noqa: E402
+from vllm_omni_tpu.models.qwen_image import vae as iv  # noqa: E402
+
+TINY = {
+    "block_out_channels": [16, 32],
+    "layers_per_block": 1,
+    "latent_channels": 4,
+    "scaling_factor": 0.5,
+    "shift_factor": 0.1,
+    "use_quant_conv": False,
+    "use_post_quant_conv": False,
+}
+
+
+def make_vae_state_dict(cfg_json: dict, seed: int = 0,
+                        halves=("decoder", "encoder")) -> dict:
+    """Synthesize a diffusers-named AutoencoderKL state dict covering
+    every leaf of the requested halves (shared with the Flux
+    from_pretrained fixture)."""
+    import jax
+
+    cfg = dl.image_vae_config_from_diffusers(cfg_json)
+    rng = np.random.default_rng(seed)
+    sd = {}
+    for half in halves:
+        init_fn = (iv.init_decoder if half == "decoder"
+                   else iv.init_encoder)
+        shapes = jax.eval_shape(
+            lambda init_fn=init_fn: init_fn(jax.random.PRNGKey(0), cfg,
+                                            jnp.float32))
+        flat = dl.image_vae_flat_map(cfg, encoder=half == "encoder",
+                                     decoder=half == "decoder")
+        for hf_name, path in flat.items():
+            node = shapes
+            for key in path:
+                node = node[int(key)] if isinstance(node, list) \
+                    else node[key]
+            shape = tuple(node.shape)
+            if len(shape) == 4:  # [kh,kw,I,O] -> torch [O,I,kh,kw]
+                shape = (shape[3], shape[2], shape[0], shape[1])
+            elif len(shape) == 2:
+                shape = (shape[1], shape[0])
+            if "norm" in hf_name and hf_name.endswith("weight"):
+                arr = 1.0 + 0.1 * rng.standard_normal(shape)
+            elif hf_name.endswith("bias"):
+                arr = 0.02 * rng.standard_normal(shape)
+            else:
+                fan_in = int(np.prod(shape[1:]))
+                arr = rng.standard_normal(shape) / math.sqrt(fan_in)
+            sd[hf_name] = arr.astype(np.float32)
+    return sd
+
+
+def write_vae_dir(dirpath: str, cfg_json: dict, sd: dict) -> None:
+    from safetensors.numpy import save_file
+
+    os.makedirs(dirpath, exist_ok=True)
+    save_file(sd, os.path.join(dirpath,
+                               "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump(cfg_json, f)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    sd = make_vae_state_dict(TINY)
+    d = tmp_path_factory.mktemp("image_vae")
+    write_vae_dir(str(d), TINY, sd)
+    return str(d), sd
+
+
+# ------------------------------------------------------------ torch oracle
+class _Oracle:
+    def __init__(self, sd):
+        self.sd = {k: torch.from_numpy(v) for k, v in sd.items()}
+
+    def conv(self, name, x, stride=1, pad=1):
+        return torch.nn.functional.conv2d(
+            x, self.sd[f"{name}.weight"], self.sd[f"{name}.bias"],
+            stride=stride, padding=pad)
+
+    def gn(self, name, x):
+        c = x.shape[1]
+        g = min(32, c)
+        while c % g:
+            g -= 1
+        return torch.nn.functional.group_norm(
+            x, g, self.sd[f"{name}.weight"], self.sd[f"{name}.bias"],
+            eps=1e-6)
+
+    def resnet(self, name, x):
+        h = self.conv(f"{name}.conv1",
+                      torch.nn.functional.silu(self.gn(f"{name}.norm1",
+                                                       x)))
+        h = self.conv(f"{name}.conv2",
+                      torch.nn.functional.silu(self.gn(f"{name}.norm2",
+                                                       h)))
+        if f"{name}.conv_shortcut.weight" in self.sd:
+            x = self.conv(f"{name}.conv_shortcut", x, pad=0)
+        return x + h
+
+    def attn(self, name, x):
+        b, c, h, w = x.shape
+        xn = self.gn(f"{name}.group_norm", x).reshape(b, c, h * w) \
+            .transpose(1, 2)
+        lin = torch.nn.functional.linear
+        q = lin(xn, self.sd[f"{name}.to_q.weight"],
+                self.sd[f"{name}.to_q.bias"])
+        k = lin(xn, self.sd[f"{name}.to_k.weight"],
+                self.sd[f"{name}.to_k.bias"])
+        v = lin(xn, self.sd[f"{name}.to_v.weight"],
+                self.sd[f"{name}.to_v.bias"])
+        s = torch.einsum("bqc,bkc->bqk", q, k) / math.sqrt(c)
+        o = torch.einsum("bqk,bkc->bqc", torch.softmax(s, dim=-1), v)
+        o = lin(o, self.sd[f"{name}.to_out.0.weight"],
+                self.sd[f"{name}.to_out.0.bias"])
+        return x + o.transpose(1, 2).reshape(b, c, h, w)
+
+    def decode(self, z, cfg):
+        z = z / cfg.scaling_factor + cfg.shift_factor
+        x = self.conv("decoder.conv_in", z)
+        x = self.resnet("decoder.mid_block.resnets.0", x)
+        x = self.attn("decoder.mid_block.attentions.0", x)
+        x = self.resnet("decoder.mid_block.resnets.1", x)
+        n = len(cfg.channel_multipliers)
+        for i in range(n):
+            for j in range(cfg.layers_per_block + 1):
+                x = self.resnet(f"decoder.up_blocks.{i}.resnets.{j}", x)
+            if i < n - 1:
+                x = torch.nn.functional.interpolate(x, scale_factor=2,
+                                                    mode="nearest")
+                x = self.conv(f"decoder.up_blocks.{i}.upsamplers.0.conv",
+                              x)
+        x = torch.nn.functional.silu(self.gn("decoder.conv_norm_out", x))
+        return self.conv("decoder.conv_out", x)
+
+    def encode(self, img, cfg):
+        x = self.conv("encoder.conv_in", img)
+        n = len(cfg.channel_multipliers)
+        for i in range(n):
+            for j in range(cfg.layers_per_block):
+                x = self.resnet(f"encoder.down_blocks.{i}.resnets.{j}",
+                                x)
+            if i < n - 1:
+                x = torch.nn.functional.pad(x, (0, 1, 0, 1))
+                x = self.conv(f"encoder.down_blocks.{i}"
+                              ".downsamplers.0.conv", x, stride=2,
+                              pad=0)
+        x = self.resnet("encoder.mid_block.resnets.0", x)
+        x = self.attn("encoder.mid_block.attentions.0", x)
+        x = self.resnet("encoder.mid_block.resnets.1", x)
+        x = torch.nn.functional.silu(self.gn("encoder.conv_norm_out", x))
+        moments = self.conv("encoder.conv_out", x)
+        mean = moments[:, : cfg.latent_channels]
+        return (mean - cfg.shift_factor) * cfg.scaling_factor
+
+
+def test_decode_parity(checkpoint):
+    d, sd = checkpoint
+    params, cfg = dl.load_image_vae(d, encoder=True, decoder=True)
+    rng = np.random.default_rng(1)
+    z = rng.standard_normal((1, 4, 4, cfg.latent_channels)).astype(
+        np.float32)
+    with torch.no_grad():
+        want = _Oracle(sd).decode(
+            torch.from_numpy(z.transpose(0, 3, 1, 2)), cfg).numpy()
+    got = np.asarray(iv.decode(params["decoder"], cfg, jnp.asarray(z)))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               atol=5e-5, rtol=1e-4)
+
+
+def test_encode_parity(checkpoint):
+    d, sd = checkpoint
+    params, cfg = dl.load_image_vae(d, encoder=True, decoder=False)
+    rng = np.random.default_rng(2)
+    img = rng.standard_normal((1, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = _Oracle(sd).encode(
+            torch.from_numpy(img.transpose(0, 3, 1, 2)), cfg).numpy()
+    got = np.asarray(iv.encode(params["encoder"], cfg,
+                               jnp.asarray(img)))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               atol=5e-5, rtol=1e-4)
+
+
+def test_incomplete_checkpoint_raises(tmp_path):
+    from safetensors.numpy import save_file
+
+    save_file({"decoder.conv_in.weight":
+               np.zeros((32, 4, 3, 3), np.float32)},
+              os.path.join(tmp_path, "model.safetensors"))
+    with open(os.path.join(tmp_path, "config.json"), "w") as f:
+        json.dump(TINY, f)
+    with pytest.raises(ValueError, match="covered"):
+        dl.load_image_vae(str(tmp_path), decoder=True)

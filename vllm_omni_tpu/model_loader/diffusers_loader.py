@@ -376,3 +376,150 @@ def scheduler_config(model_dir: str) -> dict:
         "shift": sc.get("shift", 1.0),
         "use_dynamic_shifting": sc.get("use_dynamic_shifting", False),
     }
+
+
+# --------------------------------------------------------- 2-D image VAE
+def image_vae_config_from_diffusers(config: dict):
+    """AutoencoderKL config.json -> qwen_image.vae.VAEConfig (the SD3 /
+    Flux VAE variant: no quant/post-quant convs)."""
+    from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+
+    blocks = config.get("block_out_channels", (128, 256, 512, 512))
+    base = blocks[0]
+    mults = []
+    for b in blocks:
+        if b % base:
+            raise ValueError(
+                f"block_out_channels {blocks} are not multiples of "
+                f"{base}")
+        mults.append(b // base)
+    if config.get("use_quant_conv", False) \
+            or config.get("use_post_quant_conv", False):
+        raise ValueError(
+            "quant/post-quant conv VAEs (SD1/SDXL layout) are not "
+            "supported; SD3/Flux-style AutoencoderKL only")
+    return VAEConfig(
+        latent_channels=config.get("latent_channels", 16),
+        base_channels=base,
+        channel_multipliers=tuple(mults),
+        layers_per_block=config.get("layers_per_block", 2),
+        scaling_factor=config.get("scaling_factor", 1.0),
+        shift_factor=config.get("shift_factor", 0.0) or 0.0,
+    )
+
+
+def image_vae_flat_map(cfg, encoder: bool = True,
+                       decoder: bool = True) -> dict[str, tuple]:
+    """diffusers AutoencoderKL names -> qwen_image.vae tree paths."""
+    m: dict[str, tuple] = {}
+
+    def wb(hf: str, *path):
+        m[f"{hf}.weight"] = path + ("w",)
+        m[f"{hf}.bias"] = path + ("b",)
+
+    def resnet(hf: str, tgt: tuple, cin: int, cout: int):
+        wb(f"{hf}.norm1", *tgt, "norm1")
+        wb(f"{hf}.conv1", *tgt, "conv1")
+        wb(f"{hf}.norm2", *tgt, "norm2")
+        wb(f"{hf}.conv2", *tgt, "conv2")
+        if cin != cout:
+            wb(f"{hf}.conv_shortcut", *tgt, "skip")
+
+    def attn(hf: str, tgt: tuple):
+        wb(f"{hf}.group_norm", *tgt, "norm")
+        wb(f"{hf}.to_q", *tgt, "q")
+        wb(f"{hf}.to_k", *tgt, "k")
+        wb(f"{hf}.to_v", *tgt, "v")
+        wb(f"{hf}.to_out.0", *tgt, "o")
+
+    chans = [cfg.base_channels * x for x in cfg.channel_multipliers]
+    n = len(chans)
+    if decoder:
+        top = chans[-1]
+        wb("decoder.conv_in", "conv_in")
+        resnet("decoder.mid_block.resnets.0", ("mid_res1",), top, top)
+        attn("decoder.mid_block.attentions.0", ("mid_attn",))
+        resnet("decoder.mid_block.resnets.1", ("mid_res2",), top, top)
+        cur = top
+        for i, ch in enumerate(reversed(chans)):
+            blk = f"decoder.up_blocks.{i}"
+            for j in range(cfg.layers_per_block + 1):
+                resnet(f"{blk}.resnets.{j}", ("ups", i, "res", j),
+                       cur, ch)
+                cur = ch
+            if i < n - 1:
+                wb(f"{blk}.upsamplers.0.conv", "ups", i, "up_conv")
+        wb("decoder.conv_norm_out", "norm_out")
+        wb("decoder.conv_out", "conv_out")
+    if encoder:
+        wb("encoder.conv_in", "conv_in")
+        cur = chans[0]
+        for i, ch in enumerate(chans):
+            blk = f"encoder.down_blocks.{i}"
+            for j in range(cfg.layers_per_block):
+                resnet(f"{blk}.resnets.{j}", ("downs", i, "res", j),
+                       cur, ch)
+                cur = ch
+            if i < n - 1:
+                wb(f"{blk}.downsamplers.0.conv", "downs", i,
+                   "down_conv")
+        resnet("encoder.mid_block.resnets.0", ("mid_res1",), cur, cur)
+        attn("encoder.mid_block.attentions.0", ("mid_attn",))
+        resnet("encoder.mid_block.resnets.1", ("mid_res2",), cur, cur)
+        wb("encoder.conv_norm_out", "norm_out")
+        wb("encoder.conv_out", "conv_out")
+    return m
+
+
+def image_vae_transform(name: str, arr):
+    """torch conv [O, I, kh, kw] -> [kh, kw, I, O] (NHWC); attention
+    to_* linears [O, I] -> [I, O]."""
+    if arr.ndim == 4:
+        return arr.transpose(2, 3, 1, 0)
+    if arr.ndim == 2:
+        return arr.T
+    return arr
+
+
+def load_image_vae(
+    vae_dir: str,
+    dtype=jnp.float32,
+    encoder: bool = False,
+    decoder: bool = True,
+):
+    """Load a diffusers-format SD3/Flux-style AutoencoderKL directory.
+    Returns ((decoder_params?, encoder_params?), VAEConfig) as a dict
+    with "decoder"/"encoder" halves; raises unless every leaf of the
+    requested halves is covered."""
+    import jax
+    import numpy as np
+
+    from vllm_omni_tpu.models.qwen_image import vae as iv
+
+    with open(os.path.join(vae_dir, "config.json")) as f:
+        cfg = image_vae_config_from_diffusers(json.load(f))
+    out: dict = {}
+    halves = []
+    if decoder:
+        halves.append(("decoder", iv.init_decoder, False))
+    if encoder:
+        halves.append(("encoder", iv.init_encoder, True))
+    for name, init_fn, is_enc in halves:
+        shapes = jax.eval_shape(
+            lambda init_fn=init_fn: init_fn(jax.random.PRNGKey(0), cfg,
+                                            jnp.float32))
+        tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32),
+                            shapes)
+        flat = image_vae_flat_map(cfg, encoder=is_enc,
+                                  decoder=not is_enc)
+        n, _ = load_checkpoint_tree(
+            vae_dir, flat.get, tree, dtype=np.float32,
+            transform=image_vae_transform,
+            name_filter=lambda nm, flat=flat: nm in flat,
+        )
+        n_leaves = len(jax.tree.leaves(tree))
+        if n < n_leaves:
+            raise ValueError(
+                f"{vae_dir} covered {n}/{n_leaves} {name} VAE weights")
+        out[name] = jax.tree.map(lambda a: jnp.asarray(a, dtype), tree)
+    return out, cfg

@@ -30,6 +30,8 @@ from vllm_omni_tpu.diffusion.request import (
     OmniDiffusionRequest,
 )
 from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import clip_text as clip_mod
+from vllm_omni_tpu.models.common import t5 as t5_mod
 from vllm_omni_tpu.models.common.transformer import (
     TransformerConfig,
     forward_hidden,
@@ -46,11 +48,25 @@ logger = init_logger(__name__)
 
 @dataclass(frozen=True)
 class FluxPipelineConfig:
+    # text: generic in-house encoder (TransformerConfig) or the real T5
+    # stack (t5.T5Config); from_pretrained builds the latter and adds
+    # the CLIP pooled tower below
     text: TransformerConfig = field(default_factory=TransformerConfig)
     dit: FluxDiTConfig = field(default_factory=FluxDiTConfig)
     vae: VAEConfig = field(default_factory=VAEConfig)
+    # real checkpoints pool prompt conditioning from CLIP-L
+    # (text_encoder/ beside the T5 text_encoder_2/); None = pooled
+    # vector is the masked mean of the text hidden states (documented
+    # deviation for random-init configs)
+    clip: "clip_mod.CLIPTextConfig | None" = None
     max_text_len: int = 64
+    clip_text_len: int = 77
     shift: float = 1.0
+    # FLUX.1-dev ships use_dynamic_shifting=true: the sigma schedule
+    # shifts with the image token count (diffusers calculate_shift)
+    use_dynamic_shifting: bool = False
+    base_shift: float = 0.5
+    max_shift: float = 1.15
     # "euler" | "unipc" (order-2 multistep, diffusion/scheduler.py)
     scheduler: str = "euler"
     pack: int = 2  # 2x2 latent packing into channels
@@ -76,7 +92,8 @@ class FluxPipeline:
         return self.cfg.vae.spatial_ratio * self.cfg.pack
 
     def __init__(self, config: FluxPipelineConfig, dtype=jnp.bfloat16,
-                 seed: int = 0, mesh=None, cache_config=None):
+                 seed: int = 0, mesh=None, cache_config=None,
+                 init_weights: bool = True):
         from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
         self.cfg = config
@@ -88,12 +105,19 @@ class FluxPipeline:
         # refuse rather than silently ignore (VERDICT r2 weak #3)
         self.wiring = MeshWiring(mesh, type(self).__name__).validate(
             {"dp"})
-        if config.text.hidden_size != config.dit.ctx_dim:
-            raise ValueError("text hidden_size must equal dit ctx_dim")
-        if config.dit.pooled_dim != config.text.hidden_size:
+        self._t5_text = isinstance(config.text, t5_mod.T5Config)
+        text_width = (config.text.d_model if self._t5_text
+                      else config.text.hidden_size)
+        if text_width != config.dit.ctx_dim:
+            raise ValueError("text hidden width must equal dit ctx_dim")
+        if config.clip is not None:
+            if config.dit.pooled_dim != config.clip.hidden_size:
+                raise ValueError(
+                    "pooled_dim must equal the CLIP tower hidden size")
+        elif config.dit.pooled_dim != text_width:
             raise ValueError(
-                "pooled_dim must equal text hidden_size (the pooled vector "
-                "is the masked mean of text hidden states)"
+                "pooled_dim must equal text hidden size (the pooled "
+                "vector is the masked mean of text hidden states)"
             )
         want_in = config.vae.latent_channels * config.pack ** 2
         if config.dit.in_channels != want_in:
@@ -101,34 +125,143 @@ class FluxPipeline:
                 f"dit.in_channels must be latent*pack^2 = {want_in}"
             )
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
-        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        self.hf_tokenizer = None       # T5 (ctx) — set by from_pretrained
+        self.hf_clip_tokenizer = None  # CLIP (pooled)
+        self.clip_params = None
+        if config.clip is not None:
+            # byte fallback so a random-init CLIP tower still tokenizes
+            self._clip_fallback_tok = ByteTokenizer(
+                config.clip.vocab_size)
+        k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(seed), 4)
         logger.info("Initializing FluxPipeline params (dtype=%s)", dtype)
-        self.text_params = self.wiring.place(
-            init_text_params(k1, config.text, dtype))
-        self.dit_params = self.wiring.place(
-            fdit.init_params(k2, config.dit, dtype))
-        self.vae_params = self.wiring.place(
-            vae_mod.init_decoder(k3, config.vae, dtype))
+        if init_weights:
+            self.text_params = self.wiring.place(
+                t5_mod.init_params(k1, config.text, dtype)
+                if self._t5_text
+                else init_text_params(k1, config.text, dtype))
+            self.dit_params = self.wiring.place(
+                fdit.init_params(k2, config.dit, dtype))
+            self.vae_params = self.wiring.place(
+                vae_mod.init_decoder(k3, config.vae, dtype))
+            if config.clip is not None:
+                self.clip_params = self.wiring.place(
+                    clip_mod.init_params(k4, config.clip, dtype))
+        else:
+            self.text_params = self.dit_params = self.vae_params = None
         self._denoise_cache: dict = {}
         # jitted once (per-request jax.jit(lambda) would recompile);
         # params are explicit ARGUMENTS, never closure constants — else
         # sleep()/weight swaps silently don't reach the executable
-        self._text_encode_jit = jax.jit(
-            lambda p, i: forward_hidden(p, self.cfg.text, i))
+        if self._t5_text:
+            self._text_encode_jit = jax.jit(
+                lambda p, i, m: t5_mod.forward(p, self.cfg.text, i, m))
+        else:
+            self._text_encode_jit = jax.jit(
+                lambda p, i: forward_hidden(p, self.cfg.text, i))
+        if config.clip is not None:
+            self._clip_encode_jit = jax.jit(
+                lambda p, i: clip_mod.forward(p, self.cfg.clip, i)[1])
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
 
     # ------------------------------------------------------------- encode
     def encode_prompt(self, prompts: list[str]):
-        ids, lens = self.tokenizer.batch_encode(prompts, self.cfg.max_text_len)
-        hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
-        mask = (np.arange(self.cfg.max_text_len)[None, :]
-                < lens[:, None]).astype(np.int32)
-        mask = jnp.asarray(mask)
-        # pooled vector: masked mean over real tokens
-        denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
-        pooled = (hidden * mask[..., None]).sum(axis=1) / denom
+        if self.hf_tokenizer is not None:
+            # diffusers FluxPipeline convention: T5 runs UNMASKED over
+            # the full padded sequence and the DiT attends every text
+            # token — real checkpoints were trained that way, so the
+            # mask is all-ones here
+            enc = self.hf_tokenizer(
+                prompts, padding="max_length", truncation=True,
+                max_length=self.cfg.max_text_len)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            mask = jnp.ones(ids.shape, jnp.int32)
+        else:
+            ids, lens = self.tokenizer.batch_encode(
+                prompts, self.cfg.max_text_len)
+            mask = jnp.asarray(
+                (np.arange(self.cfg.max_text_len)[None, :]
+                 < lens[:, None]).astype(np.int32))
+        if self._t5_text:
+            hidden = self._text_encode_jit(self.text_params,
+                                           jnp.asarray(ids), mask)
+        else:
+            hidden = self._text_encode_jit(self.text_params,
+                                           jnp.asarray(ids))
+        if self.cfg.clip is not None:
+            # real pooled conditioning: the CLIP-L tower's EOS hidden
+            # (reference: FluxPipeline text_encoder + tokenizer pair);
+            # without a checkpoint tokenizer the byte fallback keeps
+            # random-init configs runnable
+            if self.hf_clip_tokenizer is not None:
+                cenc = self.hf_clip_tokenizer(
+                    prompts, padding="max_length", truncation=True,
+                    max_length=self.cfg.clip_text_len)
+                cids = np.asarray(cenc["input_ids"], np.int32)
+            else:
+                cids, _ = self._clip_fallback_tok.batch_encode(
+                    prompts, self.cfg.clip_text_len)
+            pooled = self._clip_encode_jit(self.clip_params,
+                                           jnp.asarray(cids))
+        else:
+            # pooled vector: masked mean over real tokens
+            denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
+            pooled = (hidden * mask[..., None]).sum(axis=1) / denom
         return hidden, mask, pooled.astype(hidden.dtype)
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = 512) -> "FluxPipeline":
+        """Build from a diffusers-format FLUX.1 checkpoint directory
+        (transformer/ + text_encoder/ CLIP-L + text_encoder_2/ T5 +
+        tokenizer{,_2}/ + vae/).  Every component loads real weights or
+        this raises."""
+        import os
+
+        from transformers import AutoTokenizer
+
+        from vllm_omni_tpu.model_loader import diffusers_loader as dl
+        from vllm_omni_tpu.models.flux import loader as floader
+
+        dl.load_model_index(model_dir)  # validates layout
+        dit_params, dit_cfg = floader.load_flux_dit(
+            os.path.join(model_dir, "transformer"), dtype=dtype)
+        te2 = os.path.join(model_dir, "text_encoder_2")
+        import json
+
+        with open(os.path.join(te2, "config.json")) as f:
+            text_cfg = t5_mod.T5Config.from_hf(json.load(f))
+        text_params, _ = t5_mod.load_t5(te2, cfg=text_cfg, dtype=dtype)
+        te1 = os.path.join(model_dir, "text_encoder")
+        with open(os.path.join(te1, "config.json")) as f:
+            clip_cfg = clip_mod.CLIPTextConfig.from_hf(json.load(f))
+        clip_params, _ = clip_mod.load_clip_text(te1, cfg=clip_cfg,
+                                                 dtype=dtype)
+        vae_tree, vae_cfg = dl.load_image_vae(
+            os.path.join(model_dir, "vae"), dtype=dtype, decoder=True)
+        sched = dl.scheduler_config(model_dir)
+        config = FluxPipelineConfig(
+            text=text_cfg, dit=dit_cfg, vae=vae_cfg, clip=clip_cfg,
+            max_text_len=max_text_len,
+            clip_text_len=clip_cfg.max_positions,
+            shift=sched.get("shift", 1.0),
+            use_dynamic_shifting=sched.get("use_dynamic_shifting",
+                                           False),
+            base_shift=sched.get("base_shift", 0.5),
+            max_shift=sched.get("max_shift", 1.15),
+        )
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+        pipe.dit_params = pipe.wiring.place(dit_params)
+        pipe.text_params = pipe.wiring.place(text_params)
+        pipe.clip_params = pipe.wiring.place(clip_params)
+        pipe.vae_params = pipe.wiring.place(vae_tree["decoder"])
+        pipe.hf_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer_2"))
+        pipe.hf_clip_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer"))
+        return pipe
 
     # ------------------------------------------------------------ denoise
     def _denoise_fn(self, grid_h, grid_w, sched_len):
@@ -184,7 +317,12 @@ class FluxPipeline:
         )
         num_steps = sp.num_inference_steps
         sched_len = max(8, 1 << (num_steps - 1).bit_length())
-        schedule = fm.make_schedule(num_steps, shift=cfg.shift)
+        schedule = fm.make_schedule(
+            num_steps, shift=cfg.shift,
+            use_dynamic_shifting=cfg.use_dynamic_shifting,
+            mu=fm.compute_dynamic_shift_mu(
+                gh * gw, base_shift=cfg.base_shift,
+                max_shift=cfg.max_shift))
         sigmas = jnp.zeros((sched_len + 1,)).at[: num_steps + 1].set(
             schedule.sigmas)
         timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(

@@ -40,6 +40,11 @@ class FluxDiTConfig:
     theta: float = 10000.0
     mlp_ratio: float = 4.0
     guidance_embed: bool = True
+    # rotary pairing convention: False = half-split (TPU-native default),
+    # True = interleaved pairs — the diffusers FluxTransformer2DModel
+    # convention real checkpoints were trained with (apply_rotary_emb
+    # use_real_unbind_dim=-1); from_pretrained sets this
+    rope_interleaved: bool = False
 
     @property
     def inner_dim(self) -> int:
@@ -141,12 +146,18 @@ def rope_freqs(cfg: FluxDiTConfig, grid_h: int, grid_w: int, txt_len: int):
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def _rope_apply(x, cos, sin):
+def _rope_apply(x, cos, sin, interleaved: bool = False):
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    if interleaved:
+        # diffusers pairing: (x0, x1), (x2, x3), ... rotate together
+        x1 = x[..., 0::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+        return out.reshape(x.shape).astype(x.dtype)
     d = x.shape[-1]
     x1 = x[..., : d // 2].astype(jnp.float32)
     x2 = x[..., d // 2:].astype(jnp.float32)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
     return jnp.concatenate(
         [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
     ).astype(x.dtype)
@@ -180,8 +191,10 @@ def _double_block(blk, cfg, img, txt, temb_act, freqs, kv_mask):
     ki = rms_norm(_heads(ki, h), blk["img_norm_k"]["w"])
     qt = rms_norm(_heads(qt, h), blk["txt_norm_q"]["w"])
     kt = rms_norm(_heads(kt, h), blk["txt_norm_k"]["w"])
-    q = _rope_apply(jnp.concatenate([qt, qi], 1), *freqs)
-    k = _rope_apply(jnp.concatenate([kt, ki], 1), *freqs)
+    q = _rope_apply(jnp.concatenate([qt, qi], 1), *freqs,
+                    interleaved=cfg.rope_interleaved)
+    k = _rope_apply(jnp.concatenate([kt, ki], 1), *freqs,
+                    interleaved=cfg.rope_interleaved)
     v = jnp.concatenate([_heads(vt, h), _heads(vi, h)], 1)
     o = flash_attention(q, k, v, causal=False, kv_mask=kv_mask)
     txt_o = o[:, :s_txt].reshape(*txt.shape[:2], -1)
@@ -211,8 +224,8 @@ def _single_block(blk, cfg, x, temb_act, freqs, kv_mask):
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = rms_norm(_heads(q, h), blk["norm_q"]["w"])
     k = rms_norm(_heads(k, h), blk["norm_k"]["w"])
-    q = _rope_apply(q, *freqs)
-    k = _rope_apply(k, *freqs)
+    q = _rope_apply(q, *freqs, interleaved=cfg.rope_interleaved)
+    k = _rope_apply(k, *freqs, interleaved=cfg.rope_interleaved)
     o = flash_attention(q, k, _heads(v, h), causal=False, kv_mask=kv_mask)
     o = o.reshape(*x.shape[:2], -1)
     out = nn.linear(
