@@ -1,34 +1,58 @@
-"""Benchmark driver: Qwen-Image DiT text->image on one chip.
+"""Benchmark driver: the two BASELINE.md north-star metrics on one chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Default measures the NORTH-STAR config from BASELINE.md: the REAL
-Qwen-Image geometry (60-layer / 24-head / 3584 MMDiT, 20.4B params) at
-1024px / 50-step / bs=1.  41 GB of bf16 weights exceed one v5e's 16 GB
-HBM, so the run uses layerwise weight streaming
-(vllm_omni_tpu/diffusion/offload.py) — host->HBM block transfers
-overlapped with compute; the resulting number is transfer-bound and
-honest.  Weights are tiled host randoms (TPU matmul timing is
-value-independent); the geometry is real.  The reference publishes no
-absolute numbers (BASELINE.json "published": {}), so vs_baseline is null.
-Extra keys report analytic DiT MFU and the benched architecture so the
-number is interpretable.
+1. FLAGSHIP (the "metric"/"value" pair): Qwen-Image text->image at the
+   REAL geometry (60-layer / 24-head / 3584 MMDiT, 20.4B params) at
+   1024px / 50-step / bs=1.  41 GB of bf16 weights exceed one v5e's
+   16 GB HBM, so the run pins what fits resident and streams the rest
+   per step (vllm_omni_tpu/diffusion/offload.py) — host->HBM transfers
+   overlapped with compute; the number is transfer-bound and honest.
+   Weights are tiled host randoms (TPU matmul timing is
+   value-independent); the geometry is real.
+2. SECONDARY ("secondary_metrics" key): Qwen3-Omni-style AR serving —
+   thinker tok/s/chip + p50 TTFT from a bench-scale MoE thinker (real
+   head_dim/GQA/top-k structure, layer/expert counts sized to fit one
+   16 GB chip resident; arch disclosed) through the real engine path
+   (paged attention, continuous batching).
+3. OPTIONAL ("step_cache_variant" key, budget permitting): the flagship
+   with TeaCache step skipping (reference claims 1.5-2x,
+   docs/user_guide/diffusion_acceleration.md:15).
+
+The reference publishes no absolute numbers (BASELINE.json
+"published": {}), so vs_baseline is null.  Extra keys report analytic
+DiT MFU and the benched architectures so the numbers are interpretable.
 
 If the real-geometry run fails (e.g. insufficient host RAM), the bench
-falls back to the resident 16-layer `bench` preset and says so in the
-arch block.
+falls back to the resident 16-layer preset and says so in the arch block.
 
 Env knobs: OMNI_BENCH_PX / OMNI_BENCH_STEPS / OMNI_BENCH_ITERS /
-OMNI_BENCH_SIZE (config preset; "real" => streaming) /
-OMNI_BENCH_SCHEDULER (euler|unipc) / OMNI_BENCH_CACHE=1 (TeaCache step
-skipping) / OMNI_BENCH_PEAK_TFLOPS.
+OMNI_BENCH_SIZE (config preset; "real" [default] => streaming) /
+OMNI_BENCH_SCHEDULER (euler|unipc) / OMNI_BENCH_CACHE=1 (force TeaCache
+on the flagship itself) / OMNI_BENCH_PEAK_TFLOPS / OMNI_BENCH_BUDGET_S
+(wall-clock budget; variants are skipped when exceeded) /
+OMNI_BENCH_SKIP_AR=1 / OMNI_BENCH_SKIP_CACHE_VARIANT=1.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+
+_T0 = time.time()
+
+
+def _progress(msg: str) -> None:
+    # stderr: visible in the driver's tail without polluting the single
+    # stdout JSON line
+    print(f"[bench {time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _budget_s() -> float:
+    return float(os.environ.get("OMNI_BENCH_BUDGET_S", 3000))
 
 
 def dit_flops_per_image(cfg, height: int, width: int, steps: int,
@@ -68,6 +92,78 @@ def chip_peak_tflops() -> float:
     return peak if peak > 0 else 197.0
 
 
+def _host_to_hbm_gbps(timeout_s: float = 180) -> float:
+    """Measure host->HBM transfer throughput (SUBPROCESS: a wedged
+    tunnel hangs puts forever).  The streamed real-geometry preset moves
+    ~30 GB per denoise step, so its feasibility is decided by this
+    number, not by FLOPs."""
+    import subprocess
+
+    code = (
+        "import numpy as np, jax, time\n"
+        "x = np.ones((64, 1024, 1024), np.float32)\n"
+        "b = jax.device_put(np.ones(4, np.float32))\n"
+        "b.block_until_ready()\n"
+        "t0 = time.time()\n"
+        "b = jax.device_put(x); b.block_until_ready()\n"
+        "print('GBPS', 0.25 / (time.time() - t0))\n"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=timeout_s, capture_output=True)
+        for line in r.stdout.decode().splitlines():
+            if line.startswith("GBPS"):
+                return float(line.split()[1])
+    except subprocess.TimeoutExpired:
+        pass
+    return 0.0
+
+
+def _pick_size() -> str:
+    """Choose the flagship preset: the REAL streamed 60-layer geometry
+    when the host->HBM path can sustain it inside the bench budget,
+    else the HBM-resident reduced-layer preset (honest fallback — the
+    number is then per-layer-exact at reduced depth, reported as such)."""
+    env = os.environ.get("OMNI_BENCH_SIZE")
+    if env:
+        return env
+    gbps = _host_to_hbm_gbps()
+    _progress(f"host->HBM throughput: {gbps:.2f} GB/s")
+    # ~30 GB streamed per step after pinning; 50 steps must fit the
+    # budget with room for warmup + AR bench
+    steps = int(os.environ.get("OMNI_BENCH_STEPS", 50))
+    est = steps * 30.0 / max(gbps, 1e-6)
+    if est < _budget_s() * 0.6:
+        return "real"
+    _progress(
+        f"streamed real preset infeasible (~{est:.0f}s of transfers "
+        f"for {steps} steps vs {_budget_s():.0f}s budget) — using "
+        "HBM-resident preset")
+    return "resident"
+
+
+def _tpu_alive(timeout_s: float = None) -> bool:
+    """Probe the TPU backend in a SUBPROCESS: when the axon tunnel
+    wedges, ``jax.devices()`` hangs forever rather than erroring (the
+    r02 bench died this way with rc=124) — a killable child turns that
+    hang into a clean False."""
+    import subprocess
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("OMNI_BENCH_PROBE_TIMEOUT", 150))
+    if timeout_s <= 0:  # opt-out for environments with a known-good chip
+        return True
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('tpu-probe-ok')"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0 and b"tpu-probe-ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+# ------------------------------------------------------------- diffusion
 def _build_engine(size: str, scheduler: str, use_cache: bool):
     from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
     from vllm_omni_tpu.diffusion.engine import DiffusionEngine
@@ -84,71 +180,25 @@ def _build_engine(size: str, scheduler: str, use_cache: bool):
     return DiffusionEngine(cfg, warmup=False)
 
 
-def _tpu_alive(timeout_s: float = None) -> bool:
-    """Probe the TPU backend in a SUBPROCESS: when the axon tunnel
-    wedges, ``jax.devices()`` hangs forever rather than erroring (the
-    r02 bench died this way with rc=124) — a killable child turns that
-    hang into a clean False."""
-    import subprocess
-    import sys
-
-    if timeout_s is None:
-        timeout_s = float(os.environ.get("OMNI_BENCH_PROBE_TIMEOUT", 150))
-    if timeout_s <= 0:  # opt-out for environments with a known-good chip
-        return True
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('tpu-probe-ok')"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0 and b"tpu-probe-ok" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
-
-
-def main():
-    os.environ.setdefault("OMNI_TPU_LOG_LEVEL", "WARNING")
-
-    if not _tpu_alive():
-        # honest fast failure: no throughput number exists without the
-        # chip; hanging until the driver's timeout helps nobody
-        print(json.dumps({
-            "metric": "qwen_image_imgs_per_sec_chip",
-            "value": None,
-            "unit": "imgs/s",
-            "vs_baseline": None,
-            "error": "TPU backend unreachable (axon tunnel down); "
-                     "jax.devices() hangs — bench requires the real "
-                     "chip. Last measured: 0.0412 imgs/s @1024px/50step "
-                     "(60.6% MFU) on the resident preset, 0.928 imgs/s "
-                     "@512px/20step (61.6% MFU) on the 16-layer preset.",
-        }))
-        return
-
+def bench_diffusion(size: str, scheduler: str, use_cache: bool,
+                    height: int, width: int, steps: int,
+                    iters: int) -> dict:
     from vllm_omni_tpu.diffusion.request import (
         OmniDiffusionRequest,
         OmniDiffusionSamplingParams,
     )
 
-    size = os.environ.get("OMNI_BENCH_SIZE", "resident")
-    big = size in ("real", "resident")
-    default_px = "1024" if big else "512"
-    default_steps = "50" if big else "20"
-    default_iters = "1" if big else "3"
-    height = width = int(os.environ.get("OMNI_BENCH_PX", default_px))
-    steps = int(os.environ.get("OMNI_BENCH_STEPS", default_steps))
-    iters = int(os.environ.get("OMNI_BENCH_ITERS", default_iters))
-    scheduler = os.environ.get("OMNI_BENCH_SCHEDULER", "")
-    use_cache = os.environ.get("OMNI_BENCH_CACHE", "") == "1"
-
     fallback = ""
     try:
         engine = _build_engine(size, scheduler, use_cache)
-    except Exception as e:  # e.g. not enough host RAM for 41 GB weights
+    except Exception as e:  # e.g. not enough host RAM for the weights
         if size not in ("real", "resident"):
             raise
+        _progress(f"{size} preset failed ({type(e).__name__}: {e}); "
+                  "falling back to 16-layer bench preset")
         fallback = f"{size} preset failed ({type(e).__name__}: {e}); "
         size, height, width, steps, iters = "bench", 512, 512, 20, 3
+
         engine = _build_engine(size, scheduler, use_cache)
 
     def one(n_steps):
@@ -167,13 +217,53 @@ def main():
     # 3x.  The streaming "real" preset skips the full warmup (a 50-step
     # streamed generation is minutes; its per-piece executables are
     # already warmed by one(1) and the 1-iter run is transfer-bound).
+    _progress(f"diffusion[{size}] warmup (1 step + compiles)")
+    tw = time.perf_counter()
     one(1)
-    if size != "real":
+    warm_s = time.perf_counter() - tw
+    _progress(f"diffusion[{size}] warmup done in {warm_s:.1f}s")
+    if size == "real":
+        # Feasibility check on MEASURED streamed timings (the probe's
+        # bandwidth estimate can rot — the tunnel degrades under load).
+        # A second 1-step pass runs with all compiles warm; the
+        # pipeline's own denoise timing separates the per-step streamed
+        # cost from the per-run text-encode/VAE overhead.
+        tw = time.perf_counter()
+        one(1)
+        pass2_s = time.perf_counter() - tw
+        step_s = getattr(engine.pipeline, "last_stream_denoise_s",
+                         pass2_s)
+        overhead_s = max(pass2_s - step_s, 0.0)
+        est_total = overhead_s + steps * step_s
+        remaining = _budget_s() - (time.time() - _T0)
+        _progress(
+            f"streamed step {step_s:.1f}s + {overhead_s:.1f}s/run "
+            f"overhead => ~{est_total:.0f}s for {steps} steps "
+            f"({remaining:.0f}s left in budget)")
+        if est_total > remaining:
+            _progress("streamed real preset measured-infeasible — "
+                      "falling back to HBM-resident preset")
+            fallback = (f"real preset measured-infeasible "
+                        f"({step_s:.0f}s/streamed-step); ")
+            size = "resident"
+            # release the streamed pipeline FIRST: its pinned HBM blocks
+            # plus the resident preset's weights would exceed one chip
+            del engine
+            import gc
+
+            gc.collect()
+            engine = _build_engine(size, scheduler, use_cache)
+            one(1)
+            one(steps)
+    else:
         one(steps)
+    _progress(f"diffusion[{size}] timed run: {iters}x {steps} steps "
+              f"@{height}px")
     t0 = time.perf_counter()
     for _ in range(iters):
         one(steps)
     dt = (time.perf_counter() - t0) / iters
+    _progress(f"diffusion[{size}] done: {dt:.1f}s/image")
 
     pcfg = engine.pipeline.cfg
     # step-cache skipping means fewer DiT evaluations actually ran: count
@@ -185,18 +275,12 @@ def main():
     )
     peak = chip_peak_tflops()
     mfu = flops / dt / (peak * 1e12)
-
-    layers = pcfg.dit.num_layers
-    # scaling TOTAL time by 60/layers also scales the fixed text/VAE
-    # costs, so this is a LOWER bound on full-model throughput
-    extrapolated = (round(1.0 / (dt * 60.0 / layers), 5)
-                    if size == "resident" and layers < 60 else None)
-    print(json.dumps({
+    streamer = engine.pipeline.__dict__.get("_dit_streamer")
+    return {
         "metric": f"qwen_image_imgs_per_sec_chip_{height}px_{steps}step",
         "value": round(1.0 / dt, 5),
         "unit": "imgs/s",
-        "vs_baseline": None,
-        "extrapolated_60layer_imgs_per_sec_lower_bound": extrapolated,
+        "seconds_per_image": round(dt, 2),
         "mfu": round(mfu, 4),
         "dit_tflops_per_image": round(flops / 1e12, 2),
         "peak_tflops_assumed": peak,
@@ -209,10 +293,200 @@ def main():
             "step_cache": use_cache,
             "skipped_steps": skipped,
             "offload": getattr(engine.pipeline, "offload", ""),
+            "hbm_pinned_blocks": getattr(streamer, "pinned", None),
             "weights": fallback + "random-init (real-weight loader "
                        "exists, no checkpoint in the image)",
         },
-    }))
+    }
+
+
+# -------------------------------------------------------------------- AR
+def bench_ar() -> dict:
+    """Qwen3-Omni-style thinker serving on the real engine path.
+
+    The real 30B-A3B thinker (48 layers / 128 experts) is 60 GB bf16 —
+    it does not fit one 16 GB chip resident, and token-by-token decode
+    cannot hide weight streaming, so the honest single-chip config is a
+    REDUCED-DEPTH thinker with the real per-token structure: hidden
+    2048, head_dim 128, GQA 16q/4kv, top-8-of-32 routed experts
+    (reference geometry: Qwen3-Omni-MoE config; arch disclosed in the
+    result).  Paged attention + continuous batching + APC are the
+    production path (engine/llm_engine.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.models.common import transformer as tfm
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=151936,
+        hidden_size=2048,
+        num_layers=24,
+        num_heads=16,
+        num_kv_heads=4,
+        head_dim=128,
+        intermediate_size=6144,
+        moe=True,
+        num_experts=32,
+        num_experts_per_tok=8,
+        moe_intermediate_size=768,
+        qk_norm=True,
+    )
+    _progress("ar: init bench-scale MoE thinker (~8.8 GB bf16)")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.bfloat16)
+    engine = LLMEngine(params, cfg, EngineConfig(
+        num_pages=512, page_size=16, max_model_len=2048,
+        max_num_seqs=8, max_num_batched_tokens=2048,
+        dtype=jnp.bfloat16,
+    ))
+
+    rng = np.random.default_rng(0)
+    prompt_len, max_tokens, n_reqs = 512, 128, 16
+    prompts = [rng.integers(1, 150000, prompt_len).tolist()
+               for _ in range(n_reqs)]
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                        ignore_eos=True)
+
+    _progress("ar: compile warmup (prefill + decode executables)")
+    engine.generate([prompts[0][:64]],
+                    SamplingParams(temperature=0.0, max_tokens=4,
+                                   ignore_eos=True))
+
+    _progress(f"ar: timed run ({n_reqs} reqs, prompt {prompt_len}, "
+              f"gen {max_tokens})")
+    t0 = time.perf_counter()
+    first_token_ms: dict = {}
+    for p in prompts:
+        engine.add_request(list(p), sp)
+    done = 0
+    total_tokens = 0
+    while engine.has_unfinished_requests:
+        outs = engine.step()
+        now_ms = (time.perf_counter() - t0) * 1e3
+        for r in engine.scheduler.running:
+            if (r.request_id not in first_token_ms
+                    and r.num_tokens > len(r.prompt_token_ids)):
+                first_token_ms[r.request_id] = now_ms
+        for o in outs:
+            done += 1
+            first_token_ms.setdefault(o.request_id, now_ms)
+            for c in o.outputs:
+                total_tokens += len(c.token_ids)
+    dur = time.perf_counter() - t0
+    _progress(f"ar: done ({done} finished, {total_tokens} tokens, "
+              f"{dur:.1f}s)")
+
+    from vllm_omni_tpu.metrics.stats import nearest_rank_pct
+
+    ttfts = list(first_token_ms.values())
+    return {
+        "metric": "qwen3_omni_thinker_tok_per_sec_chip",
+        "value": round(total_tokens / dur, 2),
+        "unit": "tok/s",
+        "p50_ttft_ms": round(nearest_rank_pct(ttfts, 0.50), 1),
+        "p99_ttft_ms": round(nearest_rank_pct(ttfts, 0.99), 1),
+        "num_requests": n_reqs,
+        "prompt_len": prompt_len,
+        "gen_len": max_tokens,
+        "duration_s": round(dur, 2),
+        "arch": {
+            "layers": cfg.num_layers,
+            "hidden": cfg.hidden_size,
+            "heads": f"{cfg.num_heads}q/{cfg.num_kv_heads}kv",
+            "experts": f"top{cfg.num_experts_per_tok}of"
+                       f"{cfg.num_experts}",
+            "moe_intermediate": cfg.moe_intermediate_size,
+            "note": "bench-scale thinker (real 30B-A3B is 60 GB bf16 — "
+                    "exceeds one 16 GB chip; depth/expert count reduced "
+                    "to fit resident, per-token structure real)",
+            "weights": "random-init",
+        },
+    }
+
+
+def main():
+    os.environ.setdefault("OMNI_TPU_LOG_LEVEL", "WARNING")
+
+    if not _tpu_alive():
+        # honest fast failure: no throughput number exists without the
+        # chip; hanging until the driver's timeout helps nobody
+        print(json.dumps({
+            "metric": "qwen_image_imgs_per_sec_chip",
+            "value": None,
+            "unit": "imgs/s",
+            "vs_baseline": None,
+            "error": "TPU backend unreachable (axon tunnel down); "
+                     "jax.devices() hangs — bench requires the real "
+                     "chip.",
+        }))
+        return
+
+    size = _pick_size()
+    big = size in ("real", "resident")
+    default_px = "1024" if big else "512"
+    default_steps = "50" if big else "20"
+    default_iters = "1" if big else "3"
+    height = width = int(os.environ.get("OMNI_BENCH_PX", default_px))
+    steps = int(os.environ.get("OMNI_BENCH_STEPS", default_steps))
+    iters = int(os.environ.get("OMNI_BENCH_ITERS", default_iters))
+    scheduler = os.environ.get("OMNI_BENCH_SCHEDULER", "")
+    use_cache = os.environ.get("OMNI_BENCH_CACHE", "") == "1"
+
+    flagship = bench_diffusion(size, scheduler, use_cache, height, width,
+                               steps, iters)
+    out = dict(flagship)
+    out["vs_baseline"] = None
+
+    ar_remaining = _budget_s() - (time.time() - _T0)
+    if os.environ.get("OMNI_BENCH_SKIP_AR", "") == "1":
+        out["secondary_metrics"] = {
+            "ar_serving": {"skipped": "OMNI_BENCH_SKIP_AR=1"}}
+    elif ar_remaining < 420:
+        # ~7 min covers engine init + compiles + the timed run; starting
+        # an unfinishable AR bench would lose the flagship line entirely
+        # if the driver kills the process at its deadline
+        out["secondary_metrics"] = {"ar_serving": {
+            "skipped": f"budget ({ar_remaining:.0f}s left, ~420s needed)"}}
+    else:
+        try:
+            out["secondary_metrics"] = {"ar_serving": bench_ar()}
+        except Exception as e:
+            out["secondary_metrics"] = {
+                "ar_serving": {"error": f"{type(e).__name__}: {e}"}}
+
+    # budget-aware step-cache variant (a second full run)
+    elapsed = time.time() - _T0
+    est_variant = flagship.get("seconds_per_image", 1e9) * 0.8 + 120
+    skip_reason = None
+    if os.environ.get("OMNI_BENCH_SKIP_CACHE_VARIANT", "") == "1":
+        skip_reason = "OMNI_BENCH_SKIP_CACHE_VARIANT=1"
+    elif use_cache:
+        skip_reason = "flagship already ran with the step cache"
+    elif flagship["arch"]["size_preset"] != size:
+        skip_reason = (f"flagship fell back to "
+                       f"{flagship['arch']['size_preset']} preset")
+    elif elapsed + est_variant >= _budget_s():
+        skip_reason = (f"budget ({elapsed:.0f}s elapsed, "
+                       f"~{est_variant:.0f}s needed, "
+                       f"{_budget_s():.0f}s budget)")
+    if skip_reason is None:
+        try:
+            var = bench_diffusion(size, scheduler, True, height, width,
+                                  steps, iters)
+            out["step_cache_variant"] = {
+                k: var[k] for k in ("metric", "value", "unit",
+                                    "seconds_per_image", "mfu")}
+            out["step_cache_variant"]["skipped_steps"] = \
+                var["arch"]["skipped_steps"]
+        except Exception as e:
+            out["step_cache_variant"] = {
+                "error": f"{type(e).__name__}: {e}"}
+    else:
+        out["step_cache_variant"] = {"skipped": skip_reason}
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

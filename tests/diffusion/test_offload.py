@@ -118,6 +118,77 @@ def test_streaming_no_cfg_path(tiny_pipes):
         img_s.astype(np.int32), img_d.astype(np.int32), atol=1)
 
 
+def test_streaming_teacache_skips_and_pinning_matches(tiny_pipes):
+    """TeaCache under the streamed walk must skip steps (saving whole
+    weight transfers) yet stay shape/NaN-clean, and pinned-resident
+    blocks must not change the math."""
+    from vllm_omni_tpu.diffusion.cache import StepCacheConfig
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.qwen_image.pipeline import (
+        QwenImagePipeline,
+        QwenImagePipelineConfig,
+    )
+
+    dense, stream = tiny_pipes
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=8, guidance_scale=4.0,
+        seed=7,
+    )
+    req = OmniDiffusionRequest(prompt=["a cat"], sampling_params=sp,
+                               request_ids=["r"])
+    base = stream.forward(req)[0].data
+
+    cfg = QwenImagePipelineConfig.tiny()
+    cached = QwenImagePipeline(
+        cfg, dtype=jnp.float32, seed=0, init_weights=False,
+        offload="layerwise",
+        cache_config=StepCacheConfig(backend="teacache",
+                                     rel_l1_threshold=10.0))
+    cached.dit_params = stream.dit_params
+    cached.text_params = stream.text_params
+    img_c = cached.forward(req)[0].data
+    # an absurd threshold forces every in-window step to reuse: 8 steps
+    # with 1 warmup + 1 tail anchor => 6 skipped
+    assert cached.last_skipped_steps == 6
+    assert img_c.shape == base.shape
+    assert np.isfinite(img_c.astype(np.float64)).all()
+
+    pinned = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
+                               init_weights=False, offload="layerwise")
+    pinned.dit_params = stream.dit_params
+    pinned.text_params = stream.text_params
+    # force partial pinning through the cached-property slot
+    from vllm_omni_tpu.diffusion.offload import BlockStreamer
+
+    _, blocks = pinned._dit_stream
+    pinned.__dict__["_dit_streamer"] = BlockStreamer(blocks, pinned=1)
+    img_p = pinned.forward(req)[0].data
+    np.testing.assert_array_equal(img_p, base)
+
+
+def test_host_tiled_init_aliased_blocks():
+    from vllm_omni_tpu.diffusion import offload as ol
+
+    shapes = {
+        "top": jax.ShapeDtypeStruct((4, 4), jnp.float32),
+        "blocks": [
+            {"w": jax.ShapeDtypeStruct((8, 8), jnp.float32)}
+            for _ in range(10)
+        ],
+    }
+    tree = ol.host_tiled_init_aliased(shapes, jnp.float32, "blocks",
+                                      distinct=3)
+    assert len(tree["blocks"]) == 10
+    # cyclic aliasing: i and i+3 share a buffer, i and i+1 do not
+    assert tree["blocks"][0]["w"] is tree["blocks"][3]["w"]
+    assert tree["blocks"][1]["w"] is tree["blocks"][4]["w"]
+    assert tree["blocks"][0]["w"] is not tree["blocks"][1]["w"]
+    assert tree["top"].shape == (4, 4)
+
+
 def test_streaming_rejects_mesh_and_cache():
     from vllm_omni_tpu.diffusion.cache import StepCacheConfig
     from vllm_omni_tpu.models.qwen_image.pipeline import (
@@ -126,10 +197,15 @@ def test_streaming_rejects_mesh_and_cache():
     )
 
     cfg = QwenImagePipelineConfig.tiny()
-    with pytest.raises(ValueError, match="step cache"):
+    # teacache composes with streaming (a skipped step saves the whole
+    # weight transfer); dbcache's split eval does not
+    QwenImagePipeline(cfg, seed=0, init_weights=False,
+                      offload="layerwise",
+                      cache_config=StepCacheConfig(backend="teacache"))
+    with pytest.raises(ValueError, match="teacache step cache only"):
         QwenImagePipeline(cfg, seed=0, init_weights=False,
                           offload="layerwise",
-                          cache_config=StepCacheConfig())
+                          cache_config=StepCacheConfig(backend="dbcache"))
     with pytest.raises(ValueError, match="unknown offload"):
         QwenImagePipeline(cfg, seed=0, init_weights=False, offload="bogus")
 

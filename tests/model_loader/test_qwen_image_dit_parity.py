@@ -1,0 +1,271 @@
+"""Qwen-Image DiT checkpoint-schema parity vs a torch oracle.
+
+A synthetic diffusers-named QwenImageTransformer2DModel checkpoint is
+saved; our loader maps/transposes it and the jax forward must match a
+torch oracle transcribed from the reference class semantics
+(vllm_omni/diffusion/models/qwen_image/qwen_image_transformer.py:818):
+AdaLayerNorm double-stream blocks with joint text-first attention,
+per-head QK RMSNorm, 3-axis centered rope applied with the INTERLEAVED
+pairing (RotaryEmbedding(is_neox_style=False) over torch.polar freqs,
+:553,:598-601), txt positions starting AT max_vid_index (:367-368), and
+an AdaLayerNormContinuous output head.
+
+This is the flagship-model analogue of test_flux_dit_parity.py: if the
+rope convention, modulation order, or proj_out head drifted from the
+trained checkpoint's semantics, real weights would produce garbage and
+only this test would notice.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.model_loader import diffusers_loader as dl  # noqa: E402
+from vllm_omni_tpu.models.qwen_image import transformer as qt  # noqa: E402
+
+DIT_JSON = {
+    "patch_size": 2,
+    "in_channels": 16,
+    "out_channels": 4,
+    "num_layers": 2,
+    "attention_head_dim": 32,
+    "num_attention_heads": 4,
+    "joint_attention_dim": 48,
+    "axes_dims_rope": [8, 12, 12],
+}
+CFG = dl.dit_config_from_diffusers(DIT_JSON)
+D = CFG.inner_dim
+MLP = int(D * CFG.mlp_ratio)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = (0.2 * g.standard_normal((o, i))).astype(
+            np.float32)
+        sd[f"{name}.bias"] = (0.1 * g.standard_normal((o,))).astype(
+            np.float32)
+
+    lin("img_in", CFG.in_channels, D)
+    sd["txt_norm.weight"] = (
+        1.0 + 0.1 * g.standard_normal(CFG.joint_dim)).astype(np.float32)
+    lin("txt_in", CFG.joint_dim, D)
+    lin("time_text_embed.timestep_embedder.linear_1", 256, D)
+    lin("time_text_embed.timestep_embedder.linear_2", D, D)
+    lin("norm_out.linear", D, 2 * D)
+    lin("proj_out", D, CFG.patch_size**2 * CFG.out_channels)
+    for i in range(CFG.num_layers):
+        b = f"transformer_blocks.{i}"
+        lin(f"{b}.img_mod.1", D, 6 * D)
+        lin(f"{b}.txt_mod.1", D, 6 * D)
+        for pr in ("to_q", "to_k", "to_v", "add_q_proj", "add_k_proj",
+                   "add_v_proj"):
+            lin(f"{b}.attn.{pr}", D, D)
+        for nq in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(CFG.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.attn.to_out.0", D, D)
+        lin(f"{b}.attn.to_add_out", D, D)
+        lin(f"{b}.img_mlp.net.0.proj", D, MLP)
+        lin(f"{b}.img_mlp.net.2", MLP, D)
+        lin(f"{b}.txt_mlp.net.0.proj", D, MLP)
+        lin(f"{b}.txt_mlp.net.2", MLP, D)
+    d = tmp_path_factory.mktemp("qwen_dit_ckpt")
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(DIT_JSON, f)
+    return str(d), {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+# ------------------------------------------------------------ torch oracle
+def _lin(sd, n, x):
+    return torch.nn.functional.linear(x, sd[f"{n}.weight"],
+                                      sd[f"{n}.bias"])
+
+
+def _ln(x):
+    return torch.nn.functional.layer_norm(x, (x.shape[-1],), eps=1e-6)
+
+
+def _rms(w, x):
+    v = x.float().pow(2).mean(-1, keepdim=True)
+    return (x.float() * torch.rsqrt(v + 1e-6) * w.float()).type_as(x)
+
+
+def _sinus(t, dim=256):
+    # diffusers Timesteps(flip_sin_to_cos=True, downscale_freq_shift=0)
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    return torch.cat([ang.cos(), ang.sin()], dim=-1)
+
+
+def _axis_angles(pos, dim):
+    # QwenEmbedRope.rope_params: theta^-(2j/dim) per complex pair j
+    half = dim // 2
+    inv = 1.0 / (CFG.theta ** (
+        torch.arange(half, dtype=torch.float32) / half))
+    return pos.float()[:, None] * inv[None, :]
+
+
+def _rope_tables(gh, gw, s_txt):
+    # scale_rope video freqs: frame 0; rows/cols -(g - g//2) .. g//2 - 1
+    r = (torch.arange(gh) - (gh - gh // 2)).repeat_interleave(gw)
+    c = (torch.arange(gw) - (gw - gw // 2)).repeat(gh)
+    zeros = torch.zeros(gh * gw)
+    img = torch.cat([_axis_angles(zeros, CFG.axes_dims[0]),
+                     _axis_angles(r, CFG.axes_dims[1]),
+                     _axis_angles(c, CFG.axes_dims[2])], dim=-1)
+    # txt positions start AT max_vid_index on every axis
+    tpos = torch.arange(s_txt) + max(gh // 2, gw // 2)
+    txt = torch.cat([_axis_angles(tpos, d) for d in CFG.axes_dims],
+                    dim=-1)
+    return img, txt
+
+
+def _rope(x, ang):
+    # torch.polar complex multiply == interleaved pairing
+    c = ang.cos()[None, :, None, :]
+    s = ang.sin()[None, :, None, :]
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = torch.stack([x1 * c - x2 * s, x1 * s + x2 * c], dim=-1)
+    return out.reshape(x.shape)
+
+
+def _attn(q, k, v, kv_mask=None):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    if kv_mask is not None:
+        s = s.masked_fill(~kv_mask[:, None, None, :].bool(),
+                          float("-inf"))
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def _heads(x):
+    b, s, _ = x.shape
+    return x.reshape(b, s, CFG.num_heads, CFG.head_dim)
+
+
+def _mod(x, mod3):
+    shift, scale, gate = mod3.chunk(3, dim=-1)
+    return (_ln(x) * (1 + scale[:, None]) + shift[:, None],
+            gate[:, None])
+
+
+def oracle(sd, img_tokens, txt_states, t, gh, gw, txt_mask=None):
+    b = img_tokens.shape[0]
+    img = _lin(sd, "img_in", img_tokens)
+    txt = _rms(sd["txt_norm.weight"], txt_states)
+    txt = _lin(sd, "txt_in", txt)
+    silu = torch.nn.functional.silu
+    temb = _lin(sd, "time_text_embed.timestep_embedder.linear_2",
+                silu(_lin(sd, "time_text_embed.timestep_embedder"
+                              ".linear_1", _sinus(t))))
+    emb = silu(temb)
+    s_txt = txt.shape[1]
+    img_ang, txt_ang = _rope_tables(gh, gw, s_txt)
+    kv_mask = None
+    if txt_mask is not None:
+        kv_mask = torch.cat(
+            [txt_mask, torch.ones(b, img.shape[1])], dim=1)
+    gelu = torch.nn.functional.gelu
+
+    for i in range(CFG.num_layers):
+        bn = f"transformer_blocks.{i}"
+        im1, im2 = _lin(sd, f"{bn}.img_mod.1", emb).chunk(2, dim=-1)
+        tm1, tm2 = _lin(sd, f"{bn}.txt_mod.1", emb).chunk(2, dim=-1)
+        img_n, ig1 = _mod(img, im1)
+        txt_n, tg1 = _mod(txt, tm1)
+        q = _rope(_rms(sd[f"{bn}.attn.norm_q.weight"],
+                       _heads(_lin(sd, f"{bn}.attn.to_q", img_n))),
+                  img_ang)
+        k = _rope(_rms(sd[f"{bn}.attn.norm_k.weight"],
+                       _heads(_lin(sd, f"{bn}.attn.to_k", img_n))),
+                  img_ang)
+        v = _heads(_lin(sd, f"{bn}.attn.to_v", img_n))
+        qt_ = _rope(_rms(sd[f"{bn}.attn.norm_added_q.weight"],
+                         _heads(_lin(sd, f"{bn}.attn.add_q_proj",
+                                     txt_n))), txt_ang)
+        kt = _rope(_rms(sd[f"{bn}.attn.norm_added_k.weight"],
+                        _heads(_lin(sd, f"{bn}.attn.add_k_proj",
+                                    txt_n))), txt_ang)
+        vt = _heads(_lin(sd, f"{bn}.attn.add_v_proj", txt_n))
+        # joint attention, text first
+        o = _attn(torch.cat([qt_, q], dim=1),
+                  torch.cat([kt, k], dim=1),
+                  torch.cat([vt, v], dim=1), kv_mask)
+        o = o.reshape(b, o.shape[1], -1)
+        txt_o, img_o = o[:, :s_txt], o[:, s_txt:]
+        img = img + ig1 * _lin(sd, f"{bn}.attn.to_out.0", img_o)
+        txt = txt + tg1 * _lin(sd, f"{bn}.attn.to_add_out", txt_o)
+        img_n2, ig2 = _mod(img, im2)
+        img = img + ig2 * _lin(
+            sd, f"{bn}.img_mlp.net.2",
+            gelu(_lin(sd, f"{bn}.img_mlp.net.0.proj", img_n2),
+                 approximate="tanh"))
+        txt_n2, tg2 = _mod(txt, tm2)
+        txt = txt + tg2 * _lin(
+            sd, f"{bn}.txt_mlp.net.2",
+            gelu(_lin(sd, f"{bn}.txt_mlp.net.0.proj", txt_n2),
+                 approximate="tanh"))
+
+    # AdaLayerNormContinuous: scale first, then shift
+    scale, shift = _lin(sd, "norm_out.linear", emb).chunk(2, dim=-1)
+    img = _ln(img) * (1 + scale[:, None]) + shift[:, None]
+    return _lin(sd, "proj_out", img)
+
+
+@pytest.mark.parametrize("gh,gw", [(4, 4), (3, 4)])
+def test_qwen_image_dit_ckpt_parity(checkpoint, gh, gw):
+    d, sd = checkpoint
+    params, cfg = dl.load_qwen_image_dit(d, dtype=jnp.float32)
+    assert cfg.rope_interleaved
+    g = np.random.default_rng(1)
+    img = g.standard_normal((1, gh * gw, CFG.in_channels)).astype(
+        np.float32)
+    txt = g.standard_normal((1, 5, CFG.joint_dim)).astype(np.float32)
+    t = np.asarray([500.0], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, torch.from_numpy(img), torch.from_numpy(txt),
+                      torch.from_numpy(t), gh, gw).numpy()
+    got = np.asarray(qt.forward(
+        params, cfg, jnp.asarray(img), jnp.asarray(txt),
+        jnp.asarray(t), (gh, gw)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)
+
+
+def test_qwen_image_dit_ckpt_parity_masked(checkpoint):
+    """Padded text tokens must be excluded from the joint KV."""
+    d, sd = checkpoint
+    params, cfg = dl.load_qwen_image_dit(d, dtype=jnp.float32)
+    g = np.random.default_rng(2)
+    gh = gw = 4
+    img = g.standard_normal((2, gh * gw, CFG.in_channels)).astype(
+        np.float32)
+    txt = g.standard_normal((2, 6, CFG.joint_dim)).astype(np.float32)
+    mask = np.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]],
+                      np.int32)
+    t = np.asarray([250.0, 250.0], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, torch.from_numpy(img), torch.from_numpy(txt),
+                      torch.from_numpy(t), gh, gw,
+                      txt_mask=torch.from_numpy(mask)).numpy()
+    got = np.asarray(qt.forward(
+        params, cfg, jnp.asarray(img), jnp.asarray(txt),
+        jnp.asarray(t), (gh, gw), txt_mask=jnp.asarray(mask)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)

@@ -60,6 +60,10 @@ def dit_config_from_diffusers(config: dict) -> QwenImageDiTConfig:
         head_dim=config.get("attention_head_dim", 128),
         joint_dim=config.get("joint_attention_dim", 3584),
         axes_dims=tuple(config.get("axes_dims_rope", (16, 56, 56))),
+        # checkpoints are trained under the interleaved rotary pairing
+        # (reference RotaryEmbedding(is_neox_style=False) on complex
+        # polar freqs, qwen_image_transformer.py:553)
+        rope_interleaved=True,
     )
 
 

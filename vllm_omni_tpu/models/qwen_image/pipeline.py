@@ -170,6 +170,16 @@ PROMPT_TEMPLATE = (
 PROMPT_TEMPLATE_DROP_IDX = 34
 
 
+@jax.jit
+def _rel_drift(lat, prev):
+    """TeaCache drift gate for the streamed (host-loop) denoise: one
+    fused scalar => ONE host sync per step (module-level jit so the
+    executable compiles once per process, not once per image)."""
+    diff = jnp.mean(jnp.abs(lat.astype(jnp.float32) - prev))
+    base = jnp.mean(jnp.abs(prev))
+    return diff / jnp.maximum(base, 1e-8)
+
+
 class QwenImagePipeline:
     """Text -> image.  Weights are random-initialized from the config, or
     loaded from a diffusers-format checkpoint via ``from_pretrained``."""
@@ -215,9 +225,13 @@ class QwenImagePipeline:
             if mesh is not None:
                 raise ValueError("layerwise offload is single-device; "
                                  "use mesh TP for multi-chip")
-            if cache_config is not None:
-                raise ValueError("step cache is not supported with "
-                                 "layerwise offload")
+            if cache_config is not None and cache_config.backend not in (
+                    "", "teacache"):
+                # teacache's whole-model skip maps cleanly onto the host
+                # block-walk (a skipped step saves the full weight
+                # transfer); dbcache's split eval does not
+                raise ValueError("layerwise offload supports the "
+                                 "teacache step cache only")
             if config.scheduler != "euler":
                 raise ValueError("layerwise offload supports the euler "
                                  "solver only")
@@ -242,14 +256,18 @@ class QwenImagePipeline:
 
             logger.info("Host-init for layerwise streaming (dtype=%s)",
                         dtype)
-            self.text_params = ol.host_tiled_init(
+            # repeated blocks alias a few distinct host buffers: the
+            # streamed transfer volume is identical, and materializing
+            # 50+ GB of distinct randoms first-touch-faults for minutes
+            # on sandboxed hosts (real checkpoints take the loader path)
+            self.text_params = ol.host_tiled_init_aliased(
                 jax.eval_shape(
                     lambda: init_text_params(k1, config.text, dtype)),
-                dtype, seed=seed + 1)
-            self.dit_params = ol.host_tiled_init(
+                dtype, block_key="layers", seed=seed + 1)
+            self.dit_params = ol.host_tiled_init_aliased(
                 jax.eval_shape(
                     lambda: dit.init_params(k2, config.dit, dtype)),
-                dtype, seed=seed + 2)
+                dtype, block_key="blocks", seed=seed + 2)
         elif init_weights:
             logger.info(
                 "Initializing QwenImagePipeline params (dtype=%s)", dtype)
@@ -437,6 +455,18 @@ class QwenImagePipeline:
         return jax.device_put(top), blocks
 
     @functools.cached_property
+    def _dit_streamer(self):
+        """Persistent streamer with as many blocks pinned resident in HBM
+        as fit beyond activations + double buffer — pinned blocks are
+        transferred once per pipeline, not once per step, cutting the
+        transfer-bound step time proportionally."""
+        from vllm_omni_tpu.diffusion.offload import BlockStreamer
+
+        _, blocks = self._dit_stream
+        return BlockStreamer(blocks,
+                             pinned=BlockStreamer.auto_pin(blocks))
+
+    @functools.cached_property
     def _stream_text_jits(self):
         from vllm_omni_tpu.models.common import nn as cnn
         from vllm_omni_tpu.models.common import transformer as tfm
@@ -480,14 +510,21 @@ class QwenImagePipeline:
         """Text-encoder forward with layer weights streamed from host —
         the 7B encoder's 15 GB of bf16 weights never need to be resident
         at once."""
+        import time as _time
+
         from vllm_omni_tpu.diffusion.offload import BlockStreamer
 
+        t0 = _time.perf_counter()
         prefix, layer, suffix = self._stream_text_jits
         top, layers = self._text_stream
         x, cos, sin = prefix(top, jnp.asarray(ids))
         x = BlockStreamer(layers).run(
             lambda lp, c: layer(lp, c, cos, sin), x)
-        return suffix(top, x)
+        out = suffix(top, x)
+        jax.block_until_ready(out)
+        logger.info("streamed text encode: %.1fs (%d layers)",
+                    _time.perf_counter() - t0, len(layers))
+        return out
 
     @functools.cached_property
     def _stream_dit_jits(self):
@@ -547,15 +584,39 @@ class QwenImagePipeline:
         """Python-driven denoise loop with DiT block weights streamed
         from host per step (one jitted executable per piece; the 60-block
         walk transfers 41 GB/step for the real geometry, overlapped with
-        compute by the BlockStreamer lookahead)."""
-        from vllm_omni_tpu.diffusion.offload import BlockStreamer
+        compute by the BlockStreamer lookahead; blocks that fit HBM stay
+        pinned resident across steps).
+
+        TeaCache rides the host loop: the lax.cond gate of the jitted
+        path (diffusion/cache.py:cached_eval) becomes a Python branch —
+        a skipped step here saves not just the DiT FLOPs but the whole
+        per-step weight transfer, which is what the streamed walk is
+        bound by."""
+        import time as _time
 
         prefix, block, suffix, sched_step = self._stream_dit_jits
-        top, blocks = self._dit_stream
-        streamer = BlockStreamer(blocks)
+        top, _ = self._dit_stream
+        streamer = self._dit_streamer
         sigmas = jnp.asarray(sigmas)
         gscale = jnp.float32(gscale)
-        for i in range(int(num_steps)):
+        t_start = _time.perf_counter()
+        cc = self.cache_config
+        use_cache = cc is not None and cc.enabled
+        prev_v = prev_lat = None
+        accum = float("inf")
+        n = int(num_steps)
+        self.last_skipped_steps = 0
+        for i in range(n):
+            if use_cache and prev_lat is not None:
+                accum += float(_rel_drift(latents, prev_lat))
+                in_window = (i >= cc.warmup_steps
+                             and i < n - cc.tail_steps)
+                if in_window and accum < cc.rel_l1_threshold:
+                    self.last_skipped_steps += 1
+                    latents = sched_step(latents, prev_v, sigmas,
+                                         jnp.int32(i), gscale,
+                                         do_cfg=do_cfg)
+                    continue
             lat_in = (jnp.concatenate([latents, latents], axis=0)
                       if do_cfg else latents)
             t = jnp.broadcast_to(timesteps[i], (lat_in.shape[0],))
@@ -567,8 +628,24 @@ class QwenImagePipeline:
                                      txt_f, kv_mask),
                 (img, txt_i))
             v = suffix(top, img, temb_act)
+            if use_cache:
+                prev_v = v
+                prev_lat = latents.astype(jnp.float32)
+                accum = 0.0
             latents = sched_step(latents, v, sigmas, jnp.int32(i), gscale,
                                  do_cfg=do_cfg)
+            if i == 0:
+                jax.block_until_ready(latents)
+                logger.info("streamed denoise: first step %.1fs "
+                            "(includes per-piece compiles)",
+                            _time.perf_counter() - t_start)
+        jax.block_until_ready(latents)
+        n_run = n - self.last_skipped_steps
+        self.last_stream_denoise_s = _time.perf_counter() - t_start
+        logger.info(
+            "streamed denoise: %d steps (%d run, %d cache-skipped) in "
+            "%.1fs", n, n_run, self.last_skipped_steps,
+            self.last_stream_denoise_s)
         return latents
 
     # ------------------------------------------------------------ denoise
@@ -795,7 +872,6 @@ class QwenImagePipeline:
             latents = self._stream_denoise(
                 noise, txt_all, mask_all, sigmas, timesteps,
                 sp.guidance_scale, num_steps, grid_h, grid_w, do_cfg)
-            self.last_skipped_steps = 0
         else:
             run = self._denoise_fn(
                 grid_h, grid_w, sched_len, batch2=(2 * b if do_cfg else b),

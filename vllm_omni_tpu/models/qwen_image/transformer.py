@@ -40,6 +40,12 @@ class QwenImageDiTConfig:
     axes_dims: tuple[int, int, int] = (16, 56, 56)  # frame/row/col rope dims
     theta: float = 10000.0
     mlp_ratio: float = 4.0
+    # rotary pairing convention: False = half-split (TPU-native default),
+    # True = interleaved pairs — the convention real checkpoints were
+    # trained with (reference QwenEmbedRope builds torch.polar complex
+    # freqs consumed by RotaryEmbedding(is_neox_style=False),
+    # qwen_image_transformer.py:553,598-601); from_pretrained sets this
+    rope_interleaved: bool = False
 
     @property
     def inner_dim(self) -> int:
@@ -132,8 +138,11 @@ def rope_freqs(
     def grid_angles(gh, gw, frame_coord, n_frames=1):
         f = jnp.full((n_frames,), frame_coord).repeat(gh * gw) if \
             n_frames == 1 else jnp.arange(n_frames).repeat(gh * gw)
-        r = jnp.tile(jnp.arange(gh).repeat(gw), n_frames) - gh // 2
-        c = jnp.tile(jnp.arange(gw), n_frames * gh) - gw // 2
+        # centered rows/cols: -(g - g//2) .. g//2 - 1 (reference
+        # _compute_video_freqs scale_rope concat of neg+pos positions —
+        # for odd extents the extra row sits on the negative side)
+        r = jnp.tile(jnp.arange(gh).repeat(gw), n_frames) - (gh - gh // 2)
+        c = jnp.tile(jnp.arange(gw), n_frames * gh) - (gw - gw // 2)
         return jnp.concatenate(
             [
                 axis_freqs(f, half_dims[0]),
@@ -149,12 +158,14 @@ def rope_freqs(
         parts.append(grid_angles(ch, cw, frame_coord))
     img_angles = jnp.concatenate(parts, axis=0)
 
-    # Text positions continue beyond the image extent on every axis.
+    # Text positions continue at the image extent on every axis
+    # (reference: txt_freqs = pos_freqs[max_vid_index : max_vid_index +
+    # max_len] — the first text token sits AT max_vid_index).
     extent = max(
         [grid_h // 2, grid_w // 2, len(cond_grids)]
         + [max(ch // 2, cw // 2) for ch, cw in cond_grids]
     )
-    tpos = jnp.arange(txt_len) + extent + 1
+    tpos = jnp.arange(txt_len) + extent
     txt_angles = jnp.concatenate(
         [axis_freqs(tpos, h) for h in half_dims], axis=-1
     )
@@ -164,13 +175,21 @@ def rope_freqs(
     )
 
 
-def _rope_apply(x, cos, sin):
-    """x: [B, S, H, D]; cos/sin: [S, D//2] (half-split rotation)."""
+def _rope_apply(x, cos, sin, interleaved: bool = False):
+    """x: [B, S, H, D]; cos/sin: [S, D//2].
+
+    ``interleaved``: rotate (x0,x1),(x2,x3),... pairs — the trained
+    checkpoint convention; default pairs (x_j, x_{j+D/2})."""
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    if interleaved:
+        x1 = x[..., 0::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+        return out.reshape(x.shape).astype(x.dtype)
     d = x.shape[-1]
     x1 = x[..., : d // 2].astype(jnp.float32)
     x2 = x[..., d // 2 :].astype(jnp.float32)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
     return jnp.concatenate(
         [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
     ).astype(x.dtype)
@@ -219,10 +238,11 @@ def block_forward(
     )
     vt = _heads(nn.linear(blk["add_v"], txt_n), h)
 
-    qi = _rope_apply(qi, *img_freqs)
-    ki = _rope_apply(ki, *img_freqs)
-    qt = _rope_apply(qt, *txt_freqs)
-    kt = _rope_apply(kt, *txt_freqs)
+    il = cfg.rope_interleaved
+    qi = _rope_apply(qi, *img_freqs, interleaved=il)
+    ki = _rope_apply(ki, *img_freqs, interleaved=il)
+    qt = _rope_apply(qt, *txt_freqs, interleaved=il)
+    kt = _rope_apply(kt, *txt_freqs, interleaved=il)
 
     if attn_fn is None:
         # Joint attention, text first (reference layout,
