@@ -1,0 +1,438 @@
+"""LongCat-Image / Ovis-Image checkpoint-schema parity vs torch oracles,
+plus full from_pretrained e2e for both families.
+
+The two families share the Flux MMDiT skeleton with deltas the oracle
+encodes per variant (reference: longcat_image_transformer.py:505,
+ovis_image_transformer.py:340):
+
+- LongCat: timestep-only conditioning nested under
+  ``time_embed.timestep_embedder``, GEGLU double-block FFs, text rope
+  ids (0, n, n), image grid at modality 1 offset by the text length.
+- Ovis: bare ``timestep_embedder``, ``context_embedder_norm`` RMS on
+  text states, SwiGLU double-block FFs, a silu-gated single-block MLP,
+  text rope ids (0, n, n), image grid at modality 0.
+
+If a gating order, rope id, or norm drifted from the trained
+checkpoint's semantics, real weights would produce garbage and only
+these tests would notice.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.flux import loader as fl  # noqa: E402
+from vllm_omni_tpu.models.flux import transformer as ft  # noqa: E402
+from vllm_omni_tpu.models.longcat_image.pipeline import (  # noqa: E402
+    longcat_dit_config_from_diffusers,
+)
+from vllm_omni_tpu.models.ovis_image.pipeline import (  # noqa: E402
+    ovis_dit_config_from_diffusers,
+)
+
+DIT_JSON = {
+    "in_channels": 16,
+    "out_channels": 16,
+    "num_layers": 2,
+    "num_single_layers": 2,
+    "attention_head_dim": 32,
+    "num_attention_heads": 4,
+    "joint_attention_dim": 48,
+    "axes_dims_rope": [8, 12, 12],
+}
+
+VARIANTS = {
+    "longcat": dict(
+        cfg_fn=lambda: longcat_dit_config_from_diffusers(
+            DIT_JSON, txt_max_len=5),
+        time_prefix="time_embed.timestep_embedder",
+        ctx_norm_key=None,
+    ),
+    "ovis": dict(
+        cfg_fn=lambda: ovis_dit_config_from_diffusers(DIT_JSON),
+        time_prefix="timestep_embedder",
+        ctx_norm_key="context_embedder_norm",
+    ),
+}
+
+
+def _write_ckpt(d, variant: str, cfg):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+    D = cfg.inner_dim
+    MLP = int(D * cfg.mlp_ratio)
+    mlp1_out = MLP * (2 if cfg.ff_double in ("geglu", "swiglu") else 1)
+    smlp = MLP * (2 if cfg.ff_single_gated else 1)
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = (0.2 * g.standard_normal((o, i))).astype(
+            np.float32)
+        sd[f"{name}.bias"] = (0.1 * g.standard_normal((o,))).astype(
+            np.float32)
+
+    spec = VARIANTS[variant]
+    lin("x_embedder", cfg.in_channels, D)
+    lin("context_embedder", cfg.ctx_dim, D)
+    lin(f"{spec['time_prefix']}.linear_1", 256, D)
+    lin(f"{spec['time_prefix']}.linear_2", D, D)
+    if spec["ctx_norm_key"]:
+        sd[f"{spec['ctx_norm_key']}.weight"] = (
+            1.0 + 0.1 * g.standard_normal(cfg.ctx_dim)).astype(
+            np.float32)
+    lin("norm_out.linear", D, 2 * D)
+    lin("proj_out", D, cfg.out_channels)
+    for i in range(cfg.num_double_blocks):
+        b = f"transformer_blocks.{i}"
+        lin(f"{b}.norm1.linear", D, 6 * D)
+        lin(f"{b}.norm1_context.linear", D, 6 * D)
+        for pr in ("to_q", "to_k", "to_v", "add_q_proj", "add_k_proj",
+                   "add_v_proj"):
+            lin(f"{b}.attn.{pr}", D, D)
+        for nq in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(cfg.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.attn.to_out.0", D, D)
+        lin(f"{b}.attn.to_add_out", D, D)
+        lin(f"{b}.ff.net.0.proj", D, mlp1_out)
+        lin(f"{b}.ff.net.2", MLP, D)
+        lin(f"{b}.ff_context.net.0.proj", D, mlp1_out)
+        lin(f"{b}.ff_context.net.2", MLP, D)
+    for i in range(cfg.num_single_blocks):
+        b = f"single_transformer_blocks.{i}"
+        lin(f"{b}.norm.linear", D, 3 * D)
+        for pr in ("to_q", "to_k", "to_v"):
+            lin(f"{b}.attn.{pr}", D, D)
+        for nq in ("norm_q", "norm_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(cfg.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.proj_mlp", D, smlp)
+        lin(f"{b}.proj_out", D + MLP, D)
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(DIT_JSON, f)
+    return {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+# ------------------------------------------------------------ torch oracle
+def _lin(sd, n, x):
+    return torch.nn.functional.linear(x, sd[f"{n}.weight"],
+                                      sd[f"{n}.bias"])
+
+
+def _ln(x):
+    return torch.nn.functional.layer_norm(x, (x.shape[-1],), eps=1e-6)
+
+
+def _rms(w, x):
+    v = x.float().pow(2).mean(-1, keepdim=True)
+    return (x.float() * torch.rsqrt(v + 1e-6) * w.float()).type_as(x)
+
+
+def _sinus(t, dim=256):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    return torch.cat([ang.cos(), ang.sin()], dim=-1)
+
+
+def _rope_tables(cfg, gh, gw, s_txt):
+    def ax(pos, dim):
+        half = dim // 2
+        inv = 1.0 / (cfg.theta ** (
+            torch.arange(half, dtype=torch.float32) / half))
+        return pos.float()[:, None] * inv[None, :]
+
+    off = cfg.img_rope_offset
+    r = torch.arange(gh).repeat_interleave(gw) + off
+    c = torch.arange(gw).repeat(gh) + off
+    fr = torch.full_like(r, int(cfg.img_frame_coord))
+    img = torch.cat([ax(fr, cfg.axes_dims[0]),
+                     ax(r, cfg.axes_dims[1]),
+                     ax(c, cfg.axes_dims[2])], dim=-1)
+    zt = torch.zeros(s_txt)
+    tn = torch.arange(s_txt).float() if cfg.txt_rope_arange else zt
+    txt = torch.cat([ax(zt, cfg.axes_dims[0]),
+                     ax(tn, cfg.axes_dims[1]),
+                     ax(tn, cfg.axes_dims[2])], dim=-1)
+    ang = torch.cat([txt, img], dim=0)
+    return ang.cos(), ang.sin()
+
+
+def _rope(x, cos, sin):
+    # diffusers apply_rotary_emb use_real_unbind_dim=-1 (interleaved)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = torch.stack([x1 * c - x2 * s, x1 * s + x2 * c], dim=-1)
+    return out.reshape(x.shape)
+
+
+def _attn(q, k, v):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def _ff(sd, cfg, prefix, x):
+    h = _lin(sd, f"{prefix}.net.0.proj", x)
+    if cfg.ff_double == "geglu":
+        v, g = h.chunk(2, dim=-1)
+        h = v * torch.nn.functional.gelu(g)
+    elif cfg.ff_double == "swiglu":
+        v, g = h.chunk(2, dim=-1)
+        h = v * torch.nn.functional.silu(g)
+    else:
+        h = torch.nn.functional.gelu(h, approximate="tanh")
+    return _lin(sd, f"{prefix}.net.2", h)
+
+
+def oracle(sd, cfg, spec, img_tokens, txt_states, t, gh, gw):
+    b = img_tokens.shape[0]
+    heads, hd = cfg.num_heads, cfg.head_dim
+
+    def _heads(x):
+        return x.reshape(b, x.shape[1], heads, hd)
+
+    img = _lin(sd, "x_embedder", img_tokens)
+    txt = txt_states
+    if spec["ctx_norm_key"]:
+        txt = _rms(sd[f"{spec['ctx_norm_key']}.weight"], txt)
+    txt = _lin(sd, "context_embedder", txt)
+    silu = torch.nn.functional.silu
+    temb = _lin(sd, f"{spec['time_prefix']}.linear_2",
+                silu(_lin(sd, f"{spec['time_prefix']}.linear_1",
+                          _sinus(t))))
+    emb = silu(temb)
+    s_txt = txt.shape[1]
+    cos, sin = _rope_tables(cfg, gh, gw, s_txt)
+
+    for i in range(cfg.num_double_blocks):
+        bn = f"transformer_blocks.{i}"
+        m_i = _lin(sd, f"{bn}.norm1.linear", emb).chunk(6, dim=-1)
+        m_t = _lin(sd, f"{bn}.norm1_context.linear", emb).chunk(6,
+                                                                dim=-1)
+        img_n = _ln(img) * (1 + m_i[1][:, None]) + m_i[0][:, None]
+        txt_n = _ln(txt) * (1 + m_t[1][:, None]) + m_t[0][:, None]
+        q = _rms(sd[f"{bn}.attn.norm_q.weight"],
+                 _heads(_lin(sd, f"{bn}.attn.to_q", img_n)))
+        k = _rms(sd[f"{bn}.attn.norm_k.weight"],
+                 _heads(_lin(sd, f"{bn}.attn.to_k", img_n)))
+        v = _heads(_lin(sd, f"{bn}.attn.to_v", img_n))
+        qt = _rms(sd[f"{bn}.attn.norm_added_q.weight"],
+                  _heads(_lin(sd, f"{bn}.attn.add_q_proj", txt_n)))
+        kt = _rms(sd[f"{bn}.attn.norm_added_k.weight"],
+                  _heads(_lin(sd, f"{bn}.attn.add_k_proj", txt_n)))
+        vt = _heads(_lin(sd, f"{bn}.attn.add_v_proj", txt_n))
+        q = _rope(torch.cat([qt, q], dim=1), cos, sin)
+        k = _rope(torch.cat([kt, k], dim=1), cos, sin)
+        o = _attn(q, k, torch.cat([vt, v], dim=1))
+        o = o.reshape(b, o.shape[1], -1)
+        txt_o, img_o = o[:, :s_txt], o[:, s_txt:]
+        img = img + m_i[2][:, None] * _lin(sd, f"{bn}.attn.to_out.0",
+                                           img_o)
+        txt = txt + m_t[2][:, None] * _lin(sd, f"{bn}.attn.to_add_out",
+                                           txt_o)
+        img_n2 = _ln(img) * (1 + m_i[4][:, None]) + m_i[3][:, None]
+        img = img + m_i[5][:, None] * _ff(sd, cfg, f"{bn}.ff", img_n2)
+        txt_n2 = _ln(txt) * (1 + m_t[4][:, None]) + m_t[3][:, None]
+        txt = txt + m_t[5][:, None] * _ff(sd, cfg, f"{bn}.ff_context",
+                                          txt_n2)
+
+    x = torch.cat([txt, img], dim=1)
+    for i in range(cfg.num_single_blocks):
+        bn = f"single_transformer_blocks.{i}"
+        m = _lin(sd, f"{bn}.norm.linear", emb).chunk(3, dim=-1)
+        x_n = _ln(x) * (1 + m[1][:, None]) + m[0][:, None]
+        q = _rope(_rms(sd[f"{bn}.attn.norm_q.weight"],
+                       _heads(_lin(sd, f"{bn}.attn.to_q", x_n))),
+                  cos, sin)
+        k = _rope(_rms(sd[f"{bn}.attn.norm_k.weight"],
+                       _heads(_lin(sd, f"{bn}.attn.to_k", x_n))),
+                  cos, sin)
+        v = _heads(_lin(sd, f"{bn}.attn.to_v", x_n))
+        o = _attn(q, k, v).reshape(b, x.shape[1], -1)
+        mh = _lin(sd, f"{bn}.proj_mlp", x_n)
+        if cfg.ff_single_gated:
+            mv, mg = mh.chunk(2, dim=-1)
+            mlp = mv * torch.nn.functional.silu(mg)
+        else:
+            mlp = torch.nn.functional.gelu(mh, approximate="tanh")
+        x = x + m[2][:, None] * _lin(sd, f"{bn}.proj_out",
+                                     torch.cat([o, mlp], dim=-1))
+    img = x[:, s_txt:]
+    m = _lin(sd, "norm_out.linear", emb).chunk(2, dim=-1)
+    img = _ln(img) * (1 + m[0][:, None]) + m[1][:, None]
+    return _lin(sd, "proj_out", img)
+
+
+@pytest.mark.parametrize("variant", ["longcat", "ovis"])
+def test_mmdit_variant_ckpt_parity(tmp_path, variant):
+    spec = VARIANTS[variant]
+    cfg = spec["cfg_fn"]()
+    sd = _write_ckpt(str(tmp_path), variant, cfg)
+    params, _ = fl.load_mmdit_family(
+        str(tmp_path), cfg, dtype=jnp.float32,
+        time_prefix=spec["time_prefix"],
+        ctx_norm_key=spec["ctx_norm_key"])
+    g = np.random.default_rng(1)
+    gh = gw = 2
+    img = g.standard_normal((1, gh * gw, cfg.in_channels)).astype(
+        np.float32)
+    txt = g.standard_normal((1, 5, cfg.ctx_dim)).astype(np.float32)
+    t = np.asarray([500.0], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, cfg, spec, torch.from_numpy(img),
+                      torch.from_numpy(txt), torch.from_numpy(t),
+                      gh, gw).numpy()
+    got = np.asarray(ft.forward(
+        params, cfg, jnp.asarray(img), jnp.asarray(txt), None,
+        jnp.asarray(t), (gh, gw)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)
+
+
+# ------------------------------------------------------- from_pretrained
+def _write_common(root, text_encoder, arch: str):
+    """tokenizer + vae + scheduler + model_index around a transformer."""
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+    from tests.model_loader.test_image_vae_parity import (
+        TINY as VAE_JSON,
+        make_vae_state_dict,
+        write_vae_dir,
+    )
+
+    _write_byte_level_tokenizer(root / "tokenizer")
+    write_vae_dir(str(root / "vae"), VAE_JSON,
+                  make_vae_state_dict(VAE_JSON, seed=7,
+                                      halves=("decoder", "encoder")))
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "FlowMatchEulerDiscreteScheduler",
+                    "shift": 1.0}))
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": arch,
+        "transformer": ["diffusers", arch.replace("Pipeline",
+                                                  "Transformer2DModel")],
+        "text_encoder": ["transformers", text_encoder],
+        "vae": ["diffusers", "AutoencoderKL"],
+    }))
+
+
+@pytest.fixture(scope="module")
+def longcat_root(tmp_path_factory):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    root = tmp_path_factory.mktemp("longcat_root")
+    (root / "transformer").mkdir()
+    cfg = longcat_dit_config_from_diffusers(DIT_JSON, txt_max_len=16)
+    _write_ckpt(str(root / "transformer"), "longcat", cfg)
+    torch.manual_seed(0)
+    te = Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=256, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, max_position_embeddings=128)).eval()
+    te.save_pretrained(str(root / "text_encoder"),
+                       safe_serialization=True)
+    _write_common(root, "Qwen2_5_VLForConditionalGeneration",
+                  "LongCatImagePipeline")
+    return root
+
+
+@pytest.fixture(scope="module")
+def ovis_root(tmp_path_factory):
+    from transformers import Qwen3Config, Qwen3Model
+
+    root = tmp_path_factory.mktemp("ovis_root")
+    (root / "transformer").mkdir()
+    cfg = ovis_dit_config_from_diffusers(
+        {**DIT_JSON, "joint_attention_dim": 48})
+    _write_ckpt(str(root / "transformer"), "ovis", cfg)
+    torch.manual_seed(0)
+    te = Qwen3Model(Qwen3Config(
+        vocab_size=256, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=512)).eval()
+    te.save_pretrained(str(root / "text_encoder"),
+                       safe_serialization=True)
+    _write_common(root, "Qwen3Model", "OvisImagePipeline")
+    return root
+
+
+def _generate_two(pipe):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=3.0,
+        seed=0)
+    a = pipe.forward(OmniDiffusionRequest(
+        prompt=["a red ball"], sampling_params=sp,
+        request_ids=["r0"]))[0].data
+    b = pipe.forward(OmniDiffusionRequest(
+        prompt=["a blue cube"], sampling_params=sp,
+        request_ids=["r1"]))[0].data
+    assert a.dtype == np.uint8 and a.shape == (16, 16, 3)
+    assert not np.array_equal(a, b)
+
+
+def test_longcat_from_pretrained_generates(longcat_root):
+    from vllm_omni_tpu.models.longcat_image.pipeline import (
+        LongCatImagePipeline,
+    )
+
+    pipe = LongCatImagePipeline.from_pretrained(
+        str(longcat_root), dtype=jnp.float32, max_text_len=16)
+    assert pipe.hf_tokenizer is not None
+    assert pipe.cfg.dit.ff_double == "geglu"
+    _generate_two(pipe)
+
+
+def test_longcat_edit_from_pretrained(longcat_root):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.longcat_image.pipeline import (
+        LongCatImageEditPipeline,
+    )
+
+    pipe = LongCatImageEditPipeline.from_pretrained(
+        str(longcat_root), dtype=jnp.float32, max_text_len=16)
+    assert pipe.vae_encoder_params is not None
+    img = (np.random.default_rng(0)
+           .integers(0, 255, (16, 16, 3)).astype(np.uint8))
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=3.0,
+        seed=0, image=img)
+    out = pipe.forward(OmniDiffusionRequest(
+        prompt=["make it blue"], sampling_params=sp,
+        request_ids=["r0"]))[0].data
+    assert out.dtype == np.uint8 and out.shape == (16, 16, 3)
+
+
+def test_ovis_from_pretrained_generates(ovis_root):
+    from vllm_omni_tpu.models.ovis_image.pipeline import OvisImagePipeline
+
+    # the byte-level test tokenizer spends ~170 tokens on the wrapped
+    # system prompt — the span must be long enough that the user prompt
+    # survives truncation (the real tokenizer packs it far tighter)
+    pipe = OvisImagePipeline.from_pretrained(
+        str(ovis_root), dtype=jnp.float32, max_text_len=224)
+    assert pipe.cfg.dit.ctx_rmsnorm and pipe.cfg.dit.ff_single_gated
+    _generate_two(pipe)

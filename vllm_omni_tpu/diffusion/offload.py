@@ -74,14 +74,17 @@ class BlockStreamer:
             self.blocks = blocks
 
     @staticmethod
-    def auto_pin(blocks: list, reserve_bytes: float = 2.5e9,
+    def auto_pin(blocks: list, reserve_bytes: float = 3.5e9,
                  prefetch: int = 2, sync_every: int = 4) -> int:
         """How many blocks fit resident: (HBM - reserve - in-flight
-        headroom) / block size.  Conservative: activations, the VAE, and
-        compiled-executable scratch live in ``reserve_bytes``; the
-        in-flight headroom covers the worst case of run()'s batched
-        backpressure (~prefetch + sync_every un-consumed streamed blocks,
-        plus slack)."""
+        headroom) / block size.  ``reserve_bytes`` covers the OTHER
+        persistent consumers of a streaming pipeline — resident non-block
+        params (e.g. a 1.1 GB text embed table), the fp32 VAE,
+        activations, executable scratch; the in-flight headroom covers
+        the worst case of run()'s batched backpressure (~prefetch +
+        sync_every un-consumed streamed blocks, plus slack), which also
+        bounds any sibling streamed walk (the text encoder's layers are
+        smaller than DiT blocks)."""
         per_block = sum(
             leaf.nbytes for leaf in jax.tree.leaves(blocks[0]))
         try:

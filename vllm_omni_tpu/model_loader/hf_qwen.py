@@ -95,9 +95,11 @@ def _alloc_tree(cfg: tfm.TransformerConfig, dtype) -> dict:
 
 
 _LAYER_RE = re.compile(
-    r"^(?:model|language_model|thinker\.model|talker\.model)\."
+    r"^(?:(?:model|language_model|thinker\.model|talker\.model)\.)?"
     r"layers\.(\d+)\.(.+?)\.(weight|bias)$"
 )
+# prefix optional: bare backbone checkpoints (e.g. a Qwen3Model saved as
+# a diffusion text_encoder) name tensors layers.N... with no model. root
 _PREFIX_RE = re.compile(
     r"^(?:model|language_model|thinker\.model|talker\.model)\."
 )

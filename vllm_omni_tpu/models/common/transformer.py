@@ -338,9 +338,15 @@ def forward_hidden(
     token_ids: jax.Array,  # [B, S]
     positions: Optional[jax.Array] = None,  # [B, S]
     inputs_embeds: Optional[jax.Array] = None,  # [B, S, hidden]
+    attn_mask: Optional[jax.Array] = None,  # [B, S] 1=attendable key
 ) -> jax.Array:
     """Full-sequence causal forward returning final hidden states
-    [B, S, hidden] (the text-encoder path; also prefill without cache)."""
+    [B, S, hidden] (the text-encoder path; also prefill without cache).
+
+    ``attn_mask`` excludes padded KEY positions on top of causality —
+    needed when padding sits mid-sequence (LongCat-Image pads the user
+    prompt to a fixed length BETWEEN the template prefix and suffix, so
+    suffix tokens would otherwise attend pad keys)."""
     b, s = token_ids.shape
     x = _embed_input(params, token_ids, inputs_embeds, None)
     if positions is None:
@@ -354,6 +360,7 @@ def forward_hidden(
             k.reshape(b, s, -1, cfg.head_dim),
             v.reshape(b, s, -1, cfg.head_dim),
             causal=True,
+            kv_mask=attn_mask,
         )
 
     for layer in params["layers"]:

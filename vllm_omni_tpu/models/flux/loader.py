@@ -43,9 +43,18 @@ def dit_config_from_diffusers(d: dict) -> FluxDiTConfig:
     )
 
 
-def _routing(cfg: FluxDiTConfig) -> dict:
+def _routing(cfg: FluxDiTConfig,
+             time_prefix: str = "time_text_embed.timestep_embedder",
+             ctx_norm_key: str = None) -> dict:
     """hf tensor name -> placement: ("direct", path) writes the leaf;
-    ("fuse", path, slot, n_slots) buffers one slot of a fused leaf."""
+    ("fuse", path, slot, n_slots) buffers one slot of a fused leaf.
+
+    ``time_prefix``/``ctx_norm_key`` absorb the naming deltas of the
+    MMDiT siblings: LongCat nests its timestep MLP under
+    ``time_embed.timestep_embedder`` (longcat_image_transformer.py:418),
+    Ovis under a bare ``timestep_embedder`` with an extra
+    ``context_embedder_norm`` RMSNorm (ovis_image_transformer.py:396-400).
+    """
     r: dict[str, tuple] = {}
 
     def lin(hf, *path):
@@ -59,10 +68,12 @@ def _routing(cfg: FluxDiTConfig) -> dict:
 
     lin("x_embedder", "img_in")
     lin("context_embedder", "txt_in")
-    lin("time_text_embed.timestep_embedder.linear_1", "time_in1")
-    lin("time_text_embed.timestep_embedder.linear_2", "time_in2")
+    lin(f"{time_prefix}.linear_1", "time_in1")
+    lin(f"{time_prefix}.linear_2", "time_in2")
     lin("norm_out.linear", "norm_out_mod")
     lin("proj_out", "proj_out")
+    if ctx_norm_key:
+        r[f"{ctx_norm_key}.weight"] = ("direct", ("txt_norm", "w"))
     if cfg.pooled_dim:
         lin("time_text_embed.text_embedder.linear_1", "pooled_in1")
         lin("time_text_embed.text_embedder.linear_2", "pooled_in2")
@@ -105,17 +116,28 @@ def _routing(cfg: FluxDiTConfig) -> dict:
 
 def load_flux_dit(model_dir: str, cfg: FluxDiTConfig = None,
                   dtype=jnp.bfloat16):
-    """Streaming load: tensors place (or buffer, for fused leaves) as
-    shards decode — peak host memory stays near one shard plus the
-    pending fusion partners, not the full ~24 GB state dict."""
+    """Streaming load of a FluxTransformer2DModel directory."""
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = dit_config_from_diffusers(json.load(f))
+    return load_mmdit_family(model_dir, cfg, dtype=dtype)
+
+
+def load_mmdit_family(
+    model_dir: str, cfg: FluxDiTConfig, dtype=jnp.bfloat16,
+    time_prefix: str = "time_text_embed.timestep_embedder",
+    ctx_norm_key: str = None,
+):
+    """Streaming load for the Flux MMDiT family (Flux / LongCat-Image /
+    Ovis-Image): tensors place (or buffer, for fused leaves) as shards
+    decode — peak host memory stays near one shard plus the pending
+    fusion partners, not the full ~24 GB state dict."""
     from vllm_omni_tpu.model_loader.safetensors_loader import (
         iter_safetensors,
     )
 
-    if cfg is None:
-        with open(os.path.join(model_dir, "config.json")) as f:
-            cfg = dit_config_from_diffusers(json.load(f))
-    routing = _routing(cfg)
+    routing = _routing(cfg, time_prefix=time_prefix,
+                       ctx_norm_key=ctx_norm_key)
     shapes = jax.eval_shape(
         lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
     p = jax.tree.map(lambda _: None, shapes,

@@ -45,6 +45,25 @@ class FluxDiTConfig:
     # convention real checkpoints were trained with (apply_rotary_emb
     # use_real_unbind_dim=-1); from_pretrained sets this
     rope_interleaved: bool = False
+    # ---- MMDiT family variants: LongCat-Image / Ovis-Image share the
+    # Flux double+single skeleton with these deltas (reference:
+    # longcat_image_transformer.py:505, ovis_image_transformer.py:340)
+    # text rope rows/cols = arange (LongCat prepare_pos_ids type="text",
+    # Ovis text_ids) instead of Flux's zeros
+    txt_rope_arange: bool = False
+    # axis-0 coordinate of generated-image tokens (LongCat modality 1)
+    img_frame_coord: float = 0.0
+    # row/col offset of generated-image tokens (LongCat starts the image
+    # grid at tokenizer_max_length)
+    img_rope_offset: int = 0
+    # RMSNorm on text states before the context embedder (Ovis
+    # context_embedder_norm)
+    ctx_rmsnorm: bool = False
+    # double-block feed-forward: "gelu" (Flux gelu-approximate) |
+    # "geglu" (LongCat, diffusers FeedForward default) | "swiglu" (Ovis)
+    ff_double: str = "gelu"
+    # single-block MLP silu-gated with a doubled projection (Ovis)
+    ff_single_gated: bool = False
 
     @property
     def inner_dim(self) -> int:
@@ -62,6 +81,9 @@ class FluxDiTConfig:
 def init_params(key, cfg: FluxDiTConfig, dtype=jnp.float32):
     inner = cfg.inner_dim
     mlp = int(inner * cfg.mlp_ratio)
+    # gated FFs project value+gate in one matmul
+    mlp1_out = mlp * (2 if cfg.ff_double in ("geglu", "swiglu") else 1)
+    single_mlp = mlp * (2 if cfg.ff_single_gated else 1)
     nblocks = cfg.num_double_blocks + cfg.num_single_blocks
     keys = jax.random.split(key, nblocks + 10)
     p = {
@@ -82,6 +104,8 @@ def init_params(key, cfg: FluxDiTConfig, dtype=jnp.float32):
         "double": [],
         "single": [],
     }
+    if cfg.ctx_rmsnorm:
+        p["txt_norm"] = nn.rmsnorm_init(cfg.ctx_dim, dtype)
     if cfg.guidance_embed:
         p["guidance_in1"] = nn.linear_init(keys[8], 256, inner, dtype=dtype)
         p["guidance_in2"] = nn.linear_init(keys[9], inner, inner, dtype=dtype)
@@ -98,9 +122,9 @@ def init_params(key, cfg: FluxDiTConfig, dtype=jnp.float32):
             "txt_norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
             "img_out": nn.linear_init(k[4], inner, inner, dtype=dtype),
             "txt_out": nn.linear_init(k[5], inner, inner, dtype=dtype),
-            "img_mlp1": nn.linear_init(k[6], inner, mlp, dtype=dtype),
+            "img_mlp1": nn.linear_init(k[6], inner, mlp1_out, dtype=dtype),
             "img_mlp2": nn.linear_init(k[7], mlp, inner, dtype=dtype),
-            "txt_mlp1": nn.linear_init(k[8], inner, mlp, dtype=dtype),
+            "txt_mlp1": nn.linear_init(k[8], inner, mlp1_out, dtype=dtype),
             "txt_mlp2": nn.linear_init(k[9], mlp, inner, dtype=dtype),
         })
     for i in range(cfg.num_single_blocks):
@@ -109,18 +133,29 @@ def init_params(key, cfg: FluxDiTConfig, dtype=jnp.float32):
             "mod": nn.linear_init(k[0], inner, 3 * inner, dtype=dtype),
             # fused projection: qkv + mlp hidden in one matmul
             "lin1": nn.linear_init(
-                k[1], inner, 3 * inner + mlp, dtype=dtype),
+                k[1], inner, 3 * inner + single_mlp, dtype=dtype),
             "norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
             "norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
-            # fused output: [attn_out; gelu(mlp)] -> inner
+            # fused output: [attn_out; act(mlp)] -> inner
             "lin2": nn.linear_init(k[2], inner + mlp, inner, dtype=dtype),
         })
     return p
 
 
-def rope_freqs(cfg: FluxDiTConfig, grid_h: int, grid_w: int, txt_len: int):
-    """3-axis rope: text tokens at axis position 0 (Flux convention —
-    text ids are zeros), image tokens on the (0, row, col) grid."""
+def rope_freqs(cfg: FluxDiTConfig, grid_h: int, grid_w: int, txt_len: int,
+               cond_grids: tuple = ()):
+    """3-axis rope over (frame/modality, row, col) ids.
+
+    Flux convention: text ids are all-zeros, image ids (0, row, col).
+    LongCat: text (0, n, n), image (1, row + offset, col + offset)
+    (prepare_pos_ids, pipeline_longcat_image.py:112-120,412-417).
+    Ovis: text (0, n, n), image (0, row, col).
+
+    ``cond_grids``: (gh, gw) per VAE-encoded condition image appended to
+    the token sequence (image edit); condition j sits at modality
+    coordinate ``img_frame_coord + 1 + j`` with the same row/col offsets
+    (LongCat edit: gen=1, cond=2 — pipeline_longcat_image_edit.py:456-471).
+    """
     half_dims = [d // 2 for d in cfg.axes_dims]
 
     def axis_freqs(pos, half):
@@ -129,18 +164,29 @@ def rope_freqs(cfg: FluxDiTConfig, grid_h: int, grid_w: int, txt_len: int):
         )
         return pos.astype(jnp.float32)[:, None] * inv[None, :]
 
-    r = jnp.arange(grid_h).repeat(grid_w)
-    c = jnp.tile(jnp.arange(grid_w), grid_h)
-    zeros_img = jnp.zeros_like(r)
-    img_angles = jnp.concatenate([
-        axis_freqs(zeros_img, half_dims[0]),
-        axis_freqs(r, half_dims[1]),
-        axis_freqs(c, half_dims[2]),
-    ], axis=-1)
+    off = cfg.img_rope_offset
+
+    def grid_angles(gh, gw, frame_coord):
+        r = jnp.arange(gh).repeat(gw) + off
+        c = jnp.tile(jnp.arange(gw), gh) + off
+        frame = jnp.full_like(r, frame_coord, jnp.float32)
+        return jnp.concatenate([
+            axis_freqs(frame, half_dims[0]),
+            axis_freqs(r, half_dims[1]),
+            axis_freqs(c, half_dims[2]),
+        ], axis=-1)
+
+    parts = [grid_angles(grid_h, grid_w, cfg.img_frame_coord)]
+    for j, (ch, cw) in enumerate(cond_grids):
+        parts.append(grid_angles(ch, cw, cfg.img_frame_coord + 1 + j))
+    img_angles = jnp.concatenate(parts, axis=0)
     zt = jnp.zeros((txt_len,), jnp.int32)
-    txt_angles = jnp.concatenate(
-        [axis_freqs(zt, h) for h in half_dims], axis=-1
-    )
+    tn = jnp.arange(txt_len) if cfg.txt_rope_arange else zt
+    txt_angles = jnp.concatenate([
+        axis_freqs(zt, half_dims[0]),
+        axis_freqs(tn, half_dims[1]),
+        axis_freqs(tn, half_dims[2]),
+    ], axis=-1)
     # joint layout: text first
     angles = jnp.concatenate([txt_angles, img_angles], axis=0)
     return jnp.cos(angles), jnp.sin(angles)
@@ -175,6 +221,20 @@ def _heads(x, h):
     return x.reshape(b, s, h, -1)
 
 
+def _ff_act(cfg, h):
+    """Double-block FF hidden activation: plain (Flux gelu-tanh) or a
+    value*act(gate) pair from a doubled projection (value first, gate
+    second — the diffusers GEGLU/SwiGLU layout)."""
+    if cfg.ff_double == "gelu":
+        return jax.nn.gelu(h, approximate=True)
+    v, g = jnp.split(h, 2, axis=-1)
+    if cfg.ff_double == "geglu":
+        return v * jax.nn.gelu(g, approximate=False)
+    if cfg.ff_double == "swiglu":
+        return v * jax.nn.silu(g)
+    raise ValueError(f"unknown ff_double {cfg.ff_double!r}")
+
+
 def _double_block(blk, cfg, img, txt, temb_act, freqs, kv_mask):
     h = cfg.num_heads
     s_txt = txt.shape[1]
@@ -204,12 +264,10 @@ def _double_block(blk, cfg, img, txt, temb_act, freqs, kv_mask):
     txt = txt + txt_gate1 * nn.linear(blk["txt_out"], txt_o)
     img_n2, img_gate2 = _modulate(img, img_mod2)
     img = img + img_gate2 * nn.linear(
-        blk["img_mlp2"],
-        jax.nn.gelu(nn.linear(blk["img_mlp1"], img_n2), approximate=True))
+        blk["img_mlp2"], _ff_act(cfg, nn.linear(blk["img_mlp1"], img_n2)))
     txt_n2, txt_gate2 = _modulate(txt, txt_mod2)
     txt = txt + txt_gate2 * nn.linear(
-        blk["txt_mlp2"],
-        jax.nn.gelu(nn.linear(blk["txt_mlp1"], txt_n2), approximate=True))
+        blk["txt_mlp2"], _ff_act(cfg, nn.linear(blk["txt_mlp1"], txt_n2)))
     return img, txt
 
 
@@ -228,11 +286,15 @@ def _single_block(blk, cfg, x, temb_act, freqs, kv_mask):
     k = _rope_apply(k, *freqs, interleaved=cfg.rope_interleaved)
     o = flash_attention(q, k, _heads(v, h), causal=False, kv_mask=kv_mask)
     o = o.reshape(*x.shape[:2], -1)
+    if cfg.ff_single_gated:
+        # Ovis single block: value * silu(gate) from a doubled
+        # projection (ovis_image_transformer.py:175-268)
+        mv, mg = jnp.split(mlp_h, 2, axis=-1)
+        mlp_act = mv * jax.nn.silu(mg)
+    else:
+        mlp_act = jax.nn.gelu(mlp_h, approximate=True)
     out = nn.linear(
-        blk["lin2"],
-        jnp.concatenate(
-            [o, jax.nn.gelu(mlp_h, approximate=True)], axis=-1),
-    )
+        blk["lin2"], jnp.concatenate([o, mlp_act], axis=-1))
     return x + gate * out
 
 
@@ -246,10 +308,15 @@ def forward(
     grid_hw: tuple[int, int],
     guidance: Optional[jax.Array] = None,  # [B] guidance scale embedding
     txt_mask: Optional[jax.Array] = None,  # [B, S_txt]
+    cond_grids: tuple = (),  # (gh, gw) per appended condition image
 ) -> jax.Array:
-    """Returns velocity prediction [B, S_img, out_channels]."""
+    """Returns velocity prediction [B, S_img, out_channels] (the caller
+    slices off appended condition tokens)."""
     img = nn.linear(params["img_in"], img_tokens)
-    txt = nn.linear(params["txt_in"], txt_states)
+    txt = txt_states
+    if cfg.ctx_rmsnorm:
+        txt = rms_norm(txt, params["txt_norm"]["w"])
+    txt = nn.linear(params["txt_in"], txt)
     b, s_img = img.shape[:2]
     s_txt = txt.shape[1]
 
@@ -268,7 +335,8 @@ def forward(
             jax.nn.silu(nn.linear(params["guidance_in1"], gemb)))
     temb_act = jax.nn.silu(temb)
 
-    freqs = rope_freqs(cfg, grid_hw[0], grid_hw[1], s_txt)
+    freqs = rope_freqs(cfg, grid_hw[0], grid_hw[1], s_txt,
+                       cond_grids=cond_grids)
     kv_mask = None
     if txt_mask is not None:
         kv_mask = jnp.concatenate(

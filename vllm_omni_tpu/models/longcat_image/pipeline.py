@@ -48,10 +48,50 @@ from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
 logger = init_logger(__name__)
 
 
-def _longcat_dit(base: FluxDiTConfig) -> FluxDiTConfig:
+def _longcat_dit(base: FluxDiTConfig,
+                 txt_max_len: int = 512) -> FluxDiTConfig:
+    """LongCat deltas over the Flux skeleton (reference:
+    longcat_image_transformer.py:505 + prepare_pos_ids
+    pipeline_longcat_image.py:112): timestep-only conditioning, GEGLU
+    double-block FFs, text rope ids (0, n, n), image grid at modality 1
+    offset by the tokenizer max length."""
     import dataclasses
 
-    return dataclasses.replace(base, guidance_embed=False, pooled_dim=0)
+    return dataclasses.replace(
+        base, guidance_embed=False, pooled_dim=0,
+        ff_double="geglu", txt_rope_arange=True,
+        img_frame_coord=1.0, img_rope_offset=txt_max_len)
+
+
+def longcat_dit_config_from_diffusers(d: dict,
+                                      txt_max_len: int = 512
+                                      ) -> FluxDiTConfig:
+    """LongCatImageTransformer2DModel config.json -> FluxDiTConfig."""
+    in_ch = d.get("in_channels", 64)
+    return _longcat_dit(FluxDiTConfig(
+        in_channels=in_ch,
+        out_channels=d.get("out_channels") or in_ch,
+        num_double_blocks=d.get("num_layers", 19),
+        num_single_blocks=d.get("num_single_layers", 38),
+        num_heads=d.get("num_attention_heads", 24),
+        head_dim=d.get("attention_head_dim", 128),
+        ctx_dim=d.get("joint_attention_dim", 3584),
+        axes_dims=tuple(d.get("axes_dims_rope", (16, 56, 56))),
+        rope_interleaved=True,  # diffusers pairing
+    ), txt_max_len=txt_max_len)
+
+
+# Template the text encoder wraps prompts in (reference:
+# pipeline_longcat_image.py:243-249); embeddings keep only the padded
+# user-prompt span between prefix and suffix.
+PROMPT_PREFIX = (
+    "<|im_start|>system\n"
+    "As an image captioning expert, generate a descriptive text prompt "
+    "based on an image content, suitable for input to a text-to-image "
+    "model.<|im_end|>\n"
+    "<|im_start|>user\n"
+)
+PROMPT_SUFFIX = "<|im_end|>\n<|im_start|>assistant\n"
 
 
 @dataclass(frozen=True)
@@ -80,10 +120,11 @@ class LongCatImagePipeline:
     """Text -> image (Flux geometry, true CFG + renorm)."""
 
     output_type = "image"
+    needs_image_cond = False
 
     def __init__(self, config: LongCatImagePipelineConfig,
                  dtype=jnp.bfloat16, seed: int = 0, mesh=None,
-                 cache_config=None):
+                 cache_config=None, init_weights: bool = True):
         from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
         self.cfg = config
@@ -103,20 +144,25 @@ class LongCatImagePipeline:
             raise ValueError(
                 f"dit.in_channels must be latent*pack^2 = {want_in}")
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        self.hf_tokenizer = None  # set by from_pretrained
         k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
         logger.info("Initializing %s (dtype=%s)", type(self).__name__,
                     dtype)
-        self.text_params = self.wiring.place(
-            init_text_params(k1, config.text, dtype))
-        self.dit_params = self.wiring.place(
-            fdit.init_params(k2, config.dit, dtype))
-        self.vae_params = self.wiring.place(
-            vae_mod.init_decoder(k3, config.vae, dtype))
+        if init_weights:
+            self.text_params = self.wiring.place(
+                init_text_params(k1, config.text, dtype))
+            self.dit_params = self.wiring.place(
+                fdit.init_params(k2, config.dit, dtype))
+            self.vae_params = self.wiring.place(
+                vae_mod.init_decoder(k3, config.vae, dtype))
+        else:
+            self.text_params = self.dit_params = self.vae_params = None
         self.vae_encoder_params = None  # on demand (edit conditioning)
         self._seed = seed
         self._denoise_cache: dict = {}
         self._text_encode_jit = jax.jit(
-            lambda p, i: forward_hidden(p, self.cfg.text, i))
+            lambda p, i, m: forward_hidden(p, self.cfg.text, i,
+                                           attn_mask=m))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
         self._vae_encode_jit = jax.jit(
@@ -127,12 +173,97 @@ class LongCatImagePipeline:
         return self.cfg.vae.spatial_ratio * self.cfg.pack
 
     def encode_prompt(self, prompts: list[str]):
+        if self.hf_tokenizer is not None:
+            return self._encode_prompt_hf(prompts)
         ids, lens = self.tokenizer.batch_encode(prompts,
                                                 self.cfg.max_text_len)
-        hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
+        hidden = self._text_encode_jit(self.text_params,
+                                       jnp.asarray(ids), None)
         mask = (np.arange(self.cfg.max_text_len)[None, :]
                 < lens[:, None]).astype(np.int32)
         return hidden, jnp.asarray(mask)
+
+    def _encode_prompt_hf(self, prompts: list[str]):
+        """Reference encode (pipeline_longcat_image.py:284-341): tokens =
+        prefix + user prompt padded to max_text_len + suffix; the LM runs
+        with an attention mask excluding the mid-sequence pads; the
+        embeddings keep only the padded user span.  The DiT attends the
+        whole span (the reference passes no text mask to the
+        transformer), so the returned mask is all-ones."""
+        tok = self.hf_tokenizer
+        prefix = tok(PROMPT_PREFIX, add_special_tokens=False)["input_ids"]
+        suffix = tok(PROMPT_SUFFIX, add_special_tokens=False)["input_ids"]
+        bodies = tok(list(prompts),
+                     add_special_tokens=False)["input_ids"]
+        maxlen = self.cfg.max_text_len
+        pad_id = tok.pad_token_id or 0
+        ids, mask = [], []
+        for body in bodies:
+            body = body[:maxlen]
+            npad = maxlen - len(body)
+            ids.append(prefix + body + [pad_id] * npad + suffix)
+            mask.append([1] * (len(prefix) + len(body)) + [0] * npad
+                        + [1] * len(suffix))
+        hidden = self._text_encode_jit(
+            self.text_params, jnp.asarray(np.asarray(ids, np.int32)),
+            jnp.asarray(np.asarray(mask, np.int32)))
+        hidden = hidden[:, len(prefix):len(prefix) + maxlen]
+        return (hidden.astype(self.dtype),
+                jnp.ones(hidden.shape[:2], jnp.int32))
+
+    # from_pretrained knobs the Ovis subclass overrides (the load
+    # sequence itself is shared)
+    config_cls: type = LongCatImagePipelineConfig
+    _dit_cfg_from_diffusers = staticmethod(
+        lambda d, txt_max_len: longcat_dit_config_from_diffusers(
+            d, txt_max_len=txt_max_len))
+    _loader_kwargs = {"time_prefix": "time_embed.timestep_embedder"}
+    _default_max_text_len = 512
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = None):
+        """Build from a diffusers-format checkpoint (transformer/ +
+        Qwen-LM text_encoder/ + tokenizer/ + AutoencoderKL vae/ +
+        scheduler/).  Shared by LongCat-Image (+Edit) and Ovis-Image —
+        the class attributes above carry the per-family deltas."""
+        import json
+        import os
+
+        from transformers import AutoTokenizer
+
+        from vllm_omni_tpu.model_loader import diffusers_loader as dl
+        from vllm_omni_tpu.models.flux import loader as floader
+
+        if max_text_len is None:
+            max_text_len = cls._default_max_text_len
+        dl.load_model_index(model_dir)
+        tdir = os.path.join(model_dir, "transformer")
+        with open(os.path.join(tdir, "config.json")) as f:
+            dit_cfg = cls._dit_cfg_from_diffusers(
+                json.load(f), txt_max_len=max_text_len)
+        dit_params, _ = floader.load_mmdit_family(
+            tdir, dit_cfg, dtype=dtype, **cls._loader_kwargs)
+        text_params, text_cfg = dl.load_text_encoder(
+            os.path.join(model_dir, "text_encoder"), dtype=dtype)
+        vae_tree, vae_cfg = dl.load_image_vae(
+            os.path.join(model_dir, "vae"), dtype=dtype,
+            decoder=True, encoder=cls.needs_image_cond)
+        config = cls.config_cls(
+            text=text_cfg, dit=dit_cfg, vae=vae_cfg,
+            max_text_len=max_text_len)
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+        pipe.dit_params = pipe.wiring.place(dit_params)
+        pipe.text_params = pipe.wiring.place(text_params)
+        pipe.vae_params = pipe.wiring.place(vae_tree["decoder"])
+        if cls.needs_image_cond:
+            pipe.vae_encoder_params = pipe.wiring.place(
+                vae_tree["encoder"])
+        pipe.hf_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer"))
+        return pipe
 
     def _denoise_fn(self, grid_h, grid_w, sched_len, has_cond: bool):
         key = (grid_h, grid_w, sched_len, has_cond)
@@ -162,14 +293,14 @@ class LongCatImagePipeline:
                           if do_cfg else lat_model)
                 lat_in = wiring.constrain(lat_in)
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
-                # the condition block rides extra "frames" on the rope
-                # frame axis via the flux rope's frames argument: the
-                # flux 3-axis rope treats extra rows as continued grid —
-                # structurally the cond tokens get distinct coordinates
+                # condition tokens carry their own rope ids: modality
+                # img_frame_coord+1 on the same grid (reference edit
+                # pos ids, pipeline_longcat_image_edit.py:456-462)
                 v = fdit.forward(
                     dit_params, cfg.dit, lat_in, ctx_all, None, t_in,
-                    (grid_h * (2 if cond is not None else 1), grid_w),
-                    txt_mask=mask_all,
+                    (grid_h, grid_w), txt_mask=mask_all,
+                    cond_grids=(((grid_h, grid_w),) if cond is not None
+                                else ()),
                 )[:, :s_gen]
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)

@@ -4,27 +4,64 @@ Reference: vllm_omni/diffusion/models/ovis_image/ — a Flux-architecture
 MMDiT (6 double + 27 single stream blocks, 24 heads x 128,
 joint_attention_dim 2048, ovis_image_transformer.py:340-396) with plain
 timestep conditioning (no pooled text vector, no embedded guidance) and
-TRUE classifier-free guidance.  That is exactly the LongCat-Image
-execution shape, so this pipeline reuses it at the Ovis geometry with
-plain CFG (no renorm)."""
+TRUE classifier-free guidance.  Deltas over the shared skeleton: an RMS
+norm on text states before the context embedder
+(context_embedder_norm), SwiGLU double-block FFs, a silu-gated
+single-block MLP, text rope ids (0, n, n), and a Qwen3 LM text encoder
+whose embeddings are mask-zeroed then sliced past the chat-template
+prefix (pipeline_ovis_image.py:216-256).
+"""
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
 
 from vllm_omni_tpu.models.common.transformer import TransformerConfig
 from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
 from vllm_omni_tpu.models.longcat_image.pipeline import (
     LongCatImagePipeline,
-    _longcat_dit,
 )
 from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
 
+# reference system prompt + drop index (pipeline_ovis_image.py:186-189)
+SYSTEM_PROMPT = (
+    "Describe the image by detailing the color, quantity, text, shape, "
+    "size, texture, spatial\n        relationships of the objects and "
+    "background: ")
+USER_PROMPT_BEGIN_ID = 28
+
+
+def _ovis_flags(base: FluxDiTConfig) -> FluxDiTConfig:
+    return dataclasses.replace(
+        base, guidance_embed=False, pooled_dim=0,
+        ctx_rmsnorm=True, ff_double="swiglu", ff_single_gated=True,
+        txt_rope_arange=True)
+
 
 def _ovis_dit() -> FluxDiTConfig:
-    return _longcat_dit(FluxDiTConfig(
+    return _ovis_flags(FluxDiTConfig(
         num_double_blocks=6, num_single_blocks=27, num_heads=24,
         head_dim=128, ctx_dim=2048,
+    ))
+
+
+def ovis_dit_config_from_diffusers(d: dict) -> FluxDiTConfig:
+    """OvisImageTransformer2DModel config.json -> FluxDiTConfig."""
+    in_ch = d.get("in_channels", 64)
+    return _ovis_flags(FluxDiTConfig(
+        in_channels=in_ch,
+        out_channels=d.get("out_channels") or in_ch,
+        num_double_blocks=d.get("num_layers", 6),
+        num_single_blocks=d.get("num_single_layers", 27),
+        num_heads=d.get("num_attention_heads", 24),
+        head_dim=d.get("attention_head_dim", 128),
+        ctx_dim=d.get("joint_attention_dim", 2048),
+        axes_dims=tuple(d.get("axes_dims_rope", (16, 56, 56))),
+        rope_interleaved=True,
     ))
 
 
@@ -44,7 +81,7 @@ class OvisImagePipelineConfig:
     def tiny() -> "OvisImagePipelineConfig":
         return OvisImagePipelineConfig(
             text=TransformerConfig.tiny(vocab_size=256),
-            dit=_longcat_dit(FluxDiTConfig.tiny()),
+            dit=_ovis_flags(FluxDiTConfig.tiny()),
             vae=VAEConfig.tiny(),
             max_text_len=32,
         )
@@ -54,3 +91,46 @@ class OvisImagePipeline(LongCatImagePipeline):
     """Text -> image (Ovis geometry over the shared Flux MMDiT)."""
 
     config_cls = OvisImagePipelineConfig
+    _dit_cfg_from_diffusers = staticmethod(
+        lambda d, txt_max_len: ovis_dit_config_from_diffusers(d))
+    _loader_kwargs = {"time_prefix": "timestep_embedder",
+                      "ctx_norm_key": "context_embedder_norm"}
+    _default_max_text_len = 256
+
+    def _encode_prompt_hf(self, prompts: list[str]):
+        """Reference encode (pipeline_ovis_image.py:216-256): chat-
+        template wrap -> Qwen3 LM last hidden -> zero padded positions ->
+        drop the first USER_PROMPT_BEGIN_ID (template preamble) tokens.
+        Right padding keeps pads causally invisible to real tokens, so no
+        LM attention mask is needed."""
+        tok = self.hf_tokenizer
+        texts = []
+        for p in prompts:
+            msg = [{"role": "user", "content": SYSTEM_PROMPT + p}]
+            try:
+                texts.append(tok.apply_chat_template(
+                    msg, tokenize=False, add_generation_prompt=True,
+                    enable_thinking=False))
+            except Exception:
+                # tokenizer without a chat template (synthetic tests):
+                # the Qwen3 non-thinking layout, spelled out
+                texts.append(
+                    f"<|im_start|>user\n{SYSTEM_PROMPT + p}<|im_end|>\n"
+                    "<|im_start|>assistant\n<think>\n\n</think>\n\n")
+        maxlen = self.cfg.max_text_len + USER_PROMPT_BEGIN_ID
+        # the preamble drop and the causal-invisibility of pads both
+        # assume right padding; generation-oriented Qwen configs ship
+        # padding_side='left'
+        tok.padding_side = "right"
+        enc = tok(texts, padding="max_length", truncation=True,
+                  max_length=maxlen, add_special_tokens=False)
+        ids = np.asarray(enc["input_ids"], np.int32)
+        mask = np.asarray(enc["attention_mask"], np.int32)
+        hidden = self._text_encode_jit(self.text_params,
+                                       jnp.asarray(ids), None)
+        hidden = hidden * jnp.asarray(mask)[..., None]
+        hidden = hidden[:, USER_PROMPT_BEGIN_ID:]
+        # the reference DiT attends the whole (zeroed-pad) span
+        return (hidden.astype(self.dtype),
+                jnp.ones(hidden.shape[:2], jnp.int32))
+
