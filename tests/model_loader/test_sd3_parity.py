@@ -1,0 +1,340 @@
+"""SD3 checkpoint-schema parity vs a torch oracle + from_pretrained e2e.
+
+A synthetic diffusers-named SD3Transformer2DModel checkpoint is saved
+(with a dual-attention layer and the context_pre_only final block); our
+loader reshapes the patch conv into the packed-token matmul and the jax
+forward must match a torch oracle transcribed from the reference class
+semantics (vllm_omni/diffusion/models/sd3/sd3_transformer.py:240-420):
+rope-free joint attention, center-cropped sincos position table,
+AdaLayerNormZero(+X) modulation, AdaLayerNormContinuous context norm on
+the last block, combined timestep+pooled conditioning.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.sd3 import loader as sl  # noqa: E402
+from vllm_omni_tpu.models.sd3 import transformer as st  # noqa: E402
+
+DIT_JSON = {
+    "in_channels": 4,
+    "out_channels": 4,
+    "patch_size": 2,
+    "num_layers": 3,
+    "num_attention_heads": 4,
+    "attention_head_dim": 16,
+    "joint_attention_dim": 48,
+    "pooled_projection_dim": 40,
+    "pos_embed_max_size": 8,
+    "qk_norm": "rms_norm",
+    "dual_attention_layers": [0],
+}
+CFG = sl.dit_config_from_diffusers(DIT_JSON)
+D = CFG.inner_dim
+MLP = int(D * CFG.mlp_ratio)
+P = CFG.patch_size
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = (0.2 * g.standard_normal((o, i))).astype(
+            np.float32)
+        sd[f"{name}.bias"] = (0.1 * g.standard_normal((o,))).astype(
+            np.float32)
+
+    sd["pos_embed.proj.weight"] = (0.2 * g.standard_normal(
+        (D, CFG.in_channels, P, P))).astype(np.float32)
+    sd["pos_embed.proj.bias"] = (0.1 * g.standard_normal((D,))).astype(
+        np.float32)
+    sd["pos_embed.pos_embed"] = (0.2 * g.standard_normal(
+        (1, CFG.pos_embed_max_size ** 2, D))).astype(np.float32)
+    lin("context_embedder", CFG.joint_dim, D)
+    lin("time_text_embed.timestep_embedder.linear_1", 256, D)
+    lin("time_text_embed.timestep_embedder.linear_2", D, D)
+    lin("time_text_embed.text_embedder.linear_1", CFG.pooled_dim, D)
+    lin("time_text_embed.text_embedder.linear_2", D, D)
+    lin("norm_out.linear", D, 2 * D)
+    lin("proj_out", D, P * P * CFG.out_channels)
+    for i in range(CFG.num_layers):
+        b = f"transformer_blocks.{i}"
+        last = i == CFG.num_layers - 1
+        dual = i in CFG.dual_attention_layers
+        lin(f"{b}.norm1.linear", D, (9 if dual else 6) * D)
+        lin(f"{b}.norm1_context.linear", D, (2 if last else 6) * D)
+        for pr in ("to_q", "to_k", "to_v", "add_q_proj", "add_k_proj",
+                   "add_v_proj"):
+            lin(f"{b}.attn.{pr}", D, D)
+        for nq in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(CFG.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.attn.to_out.0", D, D)
+        lin(f"{b}.ff.net.0.proj", D, MLP)
+        lin(f"{b}.ff.net.2", MLP, D)
+        if not last:
+            lin(f"{b}.attn.to_add_out", D, D)
+            lin(f"{b}.ff_context.net.0.proj", D, MLP)
+            lin(f"{b}.ff_context.net.2", MLP, D)
+        if dual:
+            for pr in ("to_q", "to_k", "to_v"):
+                lin(f"{b}.attn2.{pr}", D, D)
+            for nq in ("norm_q", "norm_k"):
+                sd[f"{b}.attn2.{nq}.weight"] = (
+                    1.0 + 0.1 * g.standard_normal(CFG.head_dim)).astype(
+                    np.float32)
+            lin(f"{b}.attn2.to_out.0", D, D)
+    d = tmp_path_factory.mktemp("sd3_ckpt")
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(DIT_JSON, f)
+    return str(d), {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+# ------------------------------------------------------------ torch oracle
+def _lin(sd, n, x):
+    return torch.nn.functional.linear(x, sd[f"{n}.weight"],
+                                      sd[f"{n}.bias"])
+
+
+def _ln(x):
+    return torch.nn.functional.layer_norm(x, (x.shape[-1],), eps=1e-6)
+
+
+def _rms(sd, n, x):
+    v = x.float().pow(2).mean(-1, keepdim=True)
+    return (x.float() * torch.rsqrt(v + 1e-6)
+            * sd[f"{n}.weight"].float()).type_as(x)
+
+
+def _sinus(t, dim=256):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    return torch.cat([ang.cos(), ang.sin()], dim=-1)
+
+
+def _attn(q, k, v):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def _heads(x):
+    b, s, _ = x.shape
+    return x.reshape(b, s, CFG.num_heads, CFG.head_dim)
+
+
+def oracle(sd, img_tokens, txt, pooled, t, gh, gw):
+    b = img_tokens.shape[0]
+    # patch proj as packed matmul in (dy, dx, c) token feature order
+    w = sd["pos_embed.proj.weight"].permute(2, 3, 1, 0).reshape(
+        P * P * CFG.in_channels, D)
+    img = img_tokens @ w + sd["pos_embed.proj.bias"]
+    m = CFG.pos_embed_max_size
+    table = sd["pos_embed.pos_embed"].reshape(m, m, D)
+    top, left = (m - gh) // 2, (m - gw) // 2
+    img = img + table[top:top + gh, left:left + gw].reshape(
+        1, gh * gw, D)
+    txt = _lin(sd, "context_embedder", txt)
+    silu = torch.nn.functional.silu
+    temb = _lin(sd, "time_text_embed.timestep_embedder.linear_2",
+                silu(_lin(sd, "time_text_embed.timestep_embedder"
+                              ".linear_1", _sinus(t))))
+    temb = temb + _lin(sd, "time_text_embed.text_embedder.linear_2",
+                       silu(_lin(sd, "time_text_embed.text_embedder"
+                                     ".linear_1", pooled)))
+    emb = silu(temb)
+    s_txt = txt.shape[1]
+    gelu = torch.nn.functional.gelu
+
+    for i in range(CFG.num_layers):
+        bn = f"transformer_blocks.{i}"
+        last = i == CFG.num_layers - 1
+        dual = i in CFG.dual_attention_layers
+        mod = _lin(sd, f"{bn}.norm1.linear", emb)
+        if dual:
+            (sh, sc, gt, sh_m, sc_m, gt_m, sh2, sc2, gt2) = mod.chunk(
+                9, dim=-1)
+        else:
+            sh, sc, gt, sh_m, sc_m, gt_m = mod.chunk(6, dim=-1)
+        img_n = _ln(img) * (1 + sc[:, None]) + sh[:, None]
+        if dual:
+            # SD35AdaLayerNormZeroX: second view also from the BLOCK
+            # INPUT
+            img_n2 = _ln(img) * (1 + sc2[:, None]) + sh2[:, None]
+        if last:
+            c_sc, c_sh = _lin(sd, f"{bn}.norm1_context.linear",
+                              emb).chunk(2, dim=-1)
+            txt_n = _ln(txt) * (1 + c_sc[:, None]) + c_sh[:, None]
+        else:
+            (c_sh, c_sc, c_gt, c_sh_m, c_sc_m, c_gt_m) = _lin(
+                sd, f"{bn}.norm1_context.linear", emb).chunk(6, dim=-1)
+            txt_n = _ln(txt) * (1 + c_sc[:, None]) + c_sh[:, None]
+        q = _rms(sd, f"{bn}.attn.norm_q",
+                 _heads(_lin(sd, f"{bn}.attn.to_q", img_n)))
+        k = _rms(sd, f"{bn}.attn.norm_k",
+                 _heads(_lin(sd, f"{bn}.attn.to_k", img_n)))
+        v = _heads(_lin(sd, f"{bn}.attn.to_v", img_n))
+        qt = _rms(sd, f"{bn}.attn.norm_added_q",
+                  _heads(_lin(sd, f"{bn}.attn.add_q_proj", txt_n)))
+        kt = _rms(sd, f"{bn}.attn.norm_added_k",
+                  _heads(_lin(sd, f"{bn}.attn.add_k_proj", txt_n)))
+        vt = _heads(_lin(sd, f"{bn}.attn.add_v_proj", txt_n))
+        o = _attn(torch.cat([qt, q], dim=1), torch.cat([kt, k], dim=1),
+                  torch.cat([vt, v], dim=1))
+        o = o.reshape(b, o.shape[1], -1)
+        txt_o, img_o = o[:, :s_txt], o[:, s_txt:]
+        img = img + gt[:, None] * _lin(sd, f"{bn}.attn.to_out.0", img_o)
+        if dual:
+            q2 = _rms(sd, f"{bn}.attn2.norm_q",
+                      _heads(_lin(sd, f"{bn}.attn2.to_q", img_n2)))
+            k2 = _rms(sd, f"{bn}.attn2.norm_k",
+                      _heads(_lin(sd, f"{bn}.attn2.to_k", img_n2)))
+            v2 = _heads(_lin(sd, f"{bn}.attn2.to_v", img_n2))
+            o2 = _attn(q2, k2, v2).reshape(b, img.shape[1], -1)
+            img = img + gt2[:, None] * _lin(sd, f"{bn}.attn2.to_out.0",
+                                            o2)
+        img_nf = _ln(img) * (1 + sc_m[:, None]) + sh_m[:, None]
+        img = img + gt_m[:, None] * _lin(
+            sd, f"{bn}.ff.net.2",
+            gelu(_lin(sd, f"{bn}.ff.net.0.proj", img_nf),
+                 approximate="tanh"))
+        if not last:
+            txt = txt + c_gt[:, None] * _lin(
+                sd, f"{bn}.attn.to_add_out", txt_o)
+            txt_nf = _ln(txt) * (1 + c_sc_m[:, None]) + c_sh_m[:, None]
+            txt = txt + c_gt_m[:, None] * _lin(
+                sd, f"{bn}.ff_context.net.2",
+                gelu(_lin(sd, f"{bn}.ff_context.net.0.proj", txt_nf),
+                     approximate="tanh"))
+
+    sc, sh = _lin(sd, "norm_out.linear", emb).chunk(2, dim=-1)
+    img = _ln(img) * (1 + sc[:, None]) + sh[:, None]
+    return _lin(sd, "proj_out", img)
+
+
+def test_sd3_ckpt_parity(checkpoint):
+    d, sd = checkpoint
+    params, cfg = sl.load_sd3_dit(d, dtype=jnp.float32)
+    assert cfg.qk_norm and cfg.dual_attention_layers == (0,)
+    g = np.random.default_rng(1)
+    gh, gw = 4, 6
+    img = g.standard_normal(
+        (2, gh * gw, P * P * CFG.in_channels)).astype(np.float32)
+    txt = g.standard_normal((2, 5, CFG.joint_dim)).astype(np.float32)
+    pooled = g.standard_normal((2, CFG.pooled_dim)).astype(np.float32)
+    t = np.asarray([500.0, 20.0], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, torch.from_numpy(img), torch.from_numpy(txt),
+                      torch.from_numpy(pooled), torch.from_numpy(t),
+                      gh, gw).numpy()
+    got = np.asarray(st.forward(
+        params, cfg, jnp.asarray(img), jnp.asarray(txt),
+        jnp.asarray(pooled), jnp.asarray(t), (gh, gw)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)
+
+
+# ------------------------------------------------------- from_pretrained
+@pytest.fixture(scope="module")
+def sd3_root(tmp_path_factory, checkpoint):
+    import shutil
+
+    from safetensors.torch import save_model
+    from transformers import (
+        CLIPTextConfig as HFClipCfg,
+        CLIPTextModelWithProjection,
+        T5Config as HFT5Config,
+        T5EncoderModel,
+    )
+
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+    from tests.model_loader.test_image_vae_parity import (
+        TINY as VAE_JSON,
+        make_vae_state_dict,
+        write_vae_dir,
+    )
+
+    d, _ = checkpoint
+    root = tmp_path_factory.mktemp("sd3_root")
+    shutil.copytree(d, root / "transformer")
+    torch.manual_seed(0)
+    # CLIP-L-like (hidden 24, proj 24) + bigG-like (hidden 16, proj 16):
+    # concat pooled = 40 = pooled_projection_dim; concat hidden = 40
+    # padded to the T5 width 48 = joint_attention_dim
+    for sub, hs in (("text_encoder", 24), ("text_encoder_2", 16)):
+        clip = CLIPTextModelWithProjection(HFClipCfg(
+            vocab_size=256, hidden_size=hs, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=32,
+            projection_dim=hs, max_position_embeddings=16,
+            eos_token_id=255, bos_token_id=254, pad_token_id=0)).eval()
+        (root / sub).mkdir()
+        save_model(clip, str(root / sub / "model.safetensors"))
+        (root / sub / "config.json").write_text(
+            json.dumps(clip.config.to_dict()))
+    t5 = T5EncoderModel(HFT5Config(
+        vocab_size=256, d_model=48, d_kv=12, d_ff=64, num_layers=2,
+        num_heads=4, feed_forward_proj="gated-gelu")).eval()
+    (root / "text_encoder_3").mkdir()
+    save_model(t5, str(root / "text_encoder_3" / "model.safetensors"))
+    (root / "text_encoder_3" / "config.json").write_text(
+        json.dumps(t5.config.to_dict()))
+    for tdir in ("tokenizer", "tokenizer_2", "tokenizer_3"):
+        _write_byte_level_tokenizer(root / tdir)
+    write_vae_dir(str(root / "vae"), VAE_JSON,
+                  make_vae_state_dict(VAE_JSON, seed=7,
+                                      halves=("decoder",)))
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "FlowMatchEulerDiscreteScheduler",
+                    "shift": 3.0}))
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "StableDiffusion3Pipeline",
+        "transformer": ["diffusers", "SD3Transformer2DModel"],
+        "text_encoder": ["transformers", "CLIPTextModelWithProjection"],
+        "text_encoder_2": ["transformers",
+                           "CLIPTextModelWithProjection"],
+        "text_encoder_3": ["transformers", "T5EncoderModel"],
+        "vae": ["diffusers", "AutoencoderKL"],
+    }))
+    return root
+
+
+def test_sd3_from_pretrained_generates(sd3_root):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.sd3.pipeline import SD3Pipeline
+
+    pipe = SD3Pipeline.from_pretrained(str(sd3_root), dtype=jnp.float32,
+                                       max_text_len=16)
+    assert pipe.clip_params is not None and "text_proj" in pipe.clip_params
+    assert pipe.cfg.shift == 3.0
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=4.0,
+        seed=0)
+    a = pipe.forward(OmniDiffusionRequest(
+        prompt=["a red ball"], sampling_params=sp,
+        request_ids=["r0"]))[0].data
+    b = pipe.forward(OmniDiffusionRequest(
+        prompt=["a blue cube"], sampling_params=sp,
+        request_ids=["r1"]))[0].data
+    assert a.dtype == np.uint8 and a.shape == (16, 16, 3)
+    assert not np.array_equal(a, b)

@@ -1,0 +1,359 @@
+"""Z-Image checkpoint-schema parity vs a torch oracle + from_pretrained.
+
+A synthetic ZImageTransformer2DModel-named checkpoint is saved; our
+loader fuses w1/w3 and the jax forward must match a torch oracle
+transcribed from the reference class semantics
+(vllm_omni/diffusion/models/z_image/z_image_transformer.py): llama-style
+blocks with sandwich RMSNorms, tanh-gated 4-chunk AdaLN, SiluAndMul FFN,
+per-head QK RMSNorm, interleaved rope over (frame, row, col) ids where
+each item's caption rides frame slots 1..span (span = real length
+rounded to SEQ_MULTI_OF, padded with the learned cap_pad embedding,
+batch padding beyond the span zero-embedded at ids (0,0,0)), the image
+grid starts at span+1 per item and rounds up to SEQ_MULTI_OF with
+x_pad embeddings, a unified [image; caption] sequence, and a scale-only
+final layer.  The test shrinks SEQ_MULTI_OF to 4 to exercise every pad
+class at tiny sizes.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.z_image import loader as zl  # noqa: E402
+from vllm_omni_tpu.models.z_image import transformer as zt  # noqa: E402
+
+DIT_JSON = {
+    "in_channels": 4,
+    "all_patch_size": [2],
+    "all_f_patch_size": [1],
+    "dim": 96,
+    "n_layers": 2,
+    "n_refiner_layers": 1,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "cap_feat_dim": 40,
+    "rope_theta": 256.0,
+    "axes_dims": [8, 8, 8],
+    "norm_eps": 1e-5,
+}
+import dataclasses  # noqa: E402
+
+# SEQ_MULTI_OF=4 exercises cap_pad / zero-pad / x_pad at tiny sizes
+CFG = dataclasses.replace(zl.dit_config_from_diffusers(DIT_JSON),
+                          seq_multiple=4)
+D = CFG.dim
+FFN = CFG.ffn_dim
+ADALN = CFG.adaln_dim
+SM = CFG.seq_multiple
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def lin(name, i, o, bias=True):
+        sd[f"{name}.weight"] = (0.2 * g.standard_normal((o, i))).astype(
+            np.float32)
+        if bias:
+            sd[f"{name}.bias"] = (0.1 * g.standard_normal((o,))).astype(
+                np.float32)
+
+    def norm(name, d):
+        sd[f"{name}.weight"] = (
+            1.0 + 0.1 * g.standard_normal(d)).astype(np.float32)
+
+    p_in = CFG.patch_size ** 2 * CFG.in_channels
+    lin("all_x_embedder.2-1", p_in, D)
+    lin("t_embedder.mlp.0", 256, 1024)
+    lin("t_embedder.mlp.2", 1024, ADALN)
+    norm("cap_embedder.0", CFG.cap_feat_dim)
+    lin("cap_embedder.1", CFG.cap_feat_dim, D)
+    sd["x_pad_token"] = (0.2 * g.standard_normal((1, D))).astype(
+        np.float32)
+    sd["cap_pad_token"] = (0.2 * g.standard_normal((1, D))).astype(
+        np.float32)
+    lin("all_final_layer.2-1.linear", D, p_in)
+    lin("all_final_layer.2-1.adaLN_modulation.1", ADALN, D)
+
+    def block(prefix, modulation):
+        q_dim = CFG.num_heads * CFG.head_dim
+        kv_dim = CFG.num_kv_heads * CFG.head_dim
+        lin(f"{prefix}.attention.to_q", D, q_dim, bias=False)
+        lin(f"{prefix}.attention.to_k", D, kv_dim, bias=False)
+        lin(f"{prefix}.attention.to_v", D, kv_dim, bias=False)
+        lin(f"{prefix}.attention.to_out.0", q_dim, D, bias=False)
+        norm(f"{prefix}.attention.norm_q", CFG.head_dim)
+        norm(f"{prefix}.attention.norm_k", CFG.head_dim)
+        for nm in ("attention_norm1", "attention_norm2", "ffn_norm1",
+                   "ffn_norm2"):
+            norm(f"{prefix}.{nm}", D)
+        lin(f"{prefix}.feed_forward.w1", D, FFN, bias=False)
+        lin(f"{prefix}.feed_forward.w3", D, FFN, bias=False)
+        lin(f"{prefix}.feed_forward.w2", FFN, D, bias=False)
+        if modulation:
+            lin(f"{prefix}.adaLN_modulation.0", ADALN, 4 * D)
+
+    for i in range(CFG.num_refiner_layers):
+        block(f"noise_refiner.{i}", True)
+        block(f"context_refiner.{i}", False)
+    for i in range(CFG.num_layers):
+        block(f"layers.{i}", True)
+    d = tmp_path_factory.mktemp("z_ckpt")
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(DIT_JSON, f)
+    return str(d), {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+# ------------------------------------------------------------ torch oracle
+def _lin(sd, n, x):
+    b = sd.get(f"{n}.bias")
+    return torch.nn.functional.linear(x, sd[f"{n}.weight"], b)
+
+
+def _rms(sd, n, x, eps):
+    v = x.float().pow(2).mean(-1, keepdim=True)
+    return (x.float() * torch.rsqrt(v + eps)
+            * sd[f"{n}.weight"].float()).type_as(x)
+
+
+def _sinus(t, dim=256):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    return torch.cat([ang.cos(), ang.sin()], dim=-1)
+
+
+def _angles(ids):
+    # RopeEmbedder: per-axis theta^-(2j/d) angles indexed by integer ids
+    # ids [B, S, 3] -> [B, S, head_dim//2]
+    parts = []
+    for i, d in enumerate(CFG.axes_dims):
+        half = d // 2
+        inv = 1.0 / (CFG.rope_theta ** (
+            torch.arange(half, dtype=torch.float32) / half))
+        parts.append(ids[..., i].float()[..., None] * inv)
+    return torch.cat(parts, dim=-1)
+
+
+def _rope(x, ang):
+    # RotaryEmbedding(is_neox_style=False): interleaved pairing
+    c = ang.cos()[:, :, None, :]
+    s = ang.sin()[:, :, None, :]
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = torch.stack([x1 * c - x2 * s, x1 * s + x2 * c], dim=-1)
+    return out.reshape(x.shape)
+
+
+def _attn(q, k, v):
+    # GQA: repeat kv heads
+    rep = q.shape[2] // k.shape[2]
+    k = k.repeat_interleave(rep, dim=2)
+    v = v.repeat_interleave(rep, dim=2)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def _block(sd, prefix, x, ang, adaln, eps=1e-5):
+    b, s, _ = x.shape
+    hd = CFG.head_dim
+    if f"{prefix}.adaLN_modulation.0.weight" in sd:
+        mod = _lin(sd, f"{prefix}.adaLN_modulation.0",
+                   adaln)[:, None, :]
+        sc_msa, g_msa, sc_mlp, g_mlp = mod.chunk(4, dim=2)
+        g_msa, g_mlp = g_msa.tanh(), g_mlp.tanh()
+        sc_msa, sc_mlp = 1.0 + sc_msa, 1.0 + sc_mlp
+    else:
+        sc_msa = sc_mlp = 1.0
+        g_msa = g_mlp = None
+    h = _rms(sd, f"{prefix}.attention_norm1", x, eps) * sc_msa
+    q = _rms(sd, f"{prefix}.attention.norm_q",
+             _lin(sd, f"{prefix}.attention.to_q", h).reshape(
+                 b, s, -1, hd), eps)
+    k = _rms(sd, f"{prefix}.attention.norm_k",
+             _lin(sd, f"{prefix}.attention.to_k", h).reshape(
+                 b, s, -1, hd), eps)
+    v = _lin(sd, f"{prefix}.attention.to_v", h).reshape(b, s, -1, hd)
+    q, k = _rope(q, ang), _rope(k, ang)
+    o = _attn(q, k, v).reshape(b, s, -1)
+    o = _lin(sd, f"{prefix}.attention.to_out.0", o)
+    o = _rms(sd, f"{prefix}.attention_norm2", o, eps)
+    x = x + (g_msa * o if g_msa is not None else o)
+    h = _rms(sd, f"{prefix}.ffn_norm1", x, eps) * sc_mlp
+    y = _lin(sd, f"{prefix}.feed_forward.w2",
+             torch.nn.functional.silu(
+                 _lin(sd, f"{prefix}.feed_forward.w1", h))
+             * _lin(sd, f"{prefix}.feed_forward.w3", h))
+    y = _rms(sd, f"{prefix}.ffn_norm2", y, eps)
+    return x + (g_mlp * y if g_mlp is not None else y)
+
+
+def oracle(sd, img_tokens, cap_feats, t, gh, gw, cap_mask=None):
+    b = img_tokens.shape[0]
+    s_img = gh * gw
+    s_cap = cap_feats.shape[1]
+    adaln = _lin(sd, "t_embedder.mlp.2", torch.nn.functional.silu(
+        _lin(sd, "t_embedder.mlp.0", _sinus(t * 1000.0))))
+
+    if cap_mask is None:
+        real = torch.full((b,), s_cap)
+    else:
+        real = cap_mask.sum(dim=1)
+    span = torch.minimum(-(-real // SM) * SM,
+                         torch.full_like(real, s_cap))
+    j = torch.arange(s_cap)
+    in_span = j[None, :] < span[:, None]
+    cap_f = torch.where(in_span, 1 + j[None, :],
+                        torch.zeros_like(j[None, :]))
+    cap_ids = torch.stack(
+        [cap_f, torch.zeros(b, s_cap), torch.zeros(b, s_cap)], dim=-1)
+
+    pad_img = (-s_img) % SM
+    img_ids = torch.stack(
+        [(span + 1)[:, None].expand(b, s_img).float(),
+         torch.arange(gh).repeat_interleave(gw)[None].expand(
+             b, s_img).float(),
+         torch.arange(gw).repeat(gh)[None].expand(b, s_img).float()],
+        dim=-1)
+    if pad_img:
+        img_ids = torch.cat(
+            [img_ids, torch.zeros(b, pad_img, 3)], dim=1)
+    cap_ang = _angles(cap_ids)
+    img_ang = _angles(img_ids)
+    uni_ang = torch.cat([img_ang, cap_ang], dim=1)
+
+    x = _lin(sd, "all_x_embedder.2-1", img_tokens)
+    if pad_img:
+        x = torch.cat(
+            [x, sd["x_pad_token"][None].expand(b, pad_img, -1)], dim=1)
+    for i in range(CFG.num_refiner_layers):
+        x = _block(sd, f"noise_refiner.{i}", x, img_ang, adaln)
+
+    cap = _lin(sd, "cap_embedder.1",
+               _rms(sd, "cap_embedder.0", cap_feats, 1e-5))
+    if cap_mask is not None:
+        cap = torch.where(cap_mask[..., None].bool(), cap,
+                          sd["cap_pad_token"][None])
+        cap = torch.where(in_span[..., None], cap,
+                          torch.zeros_like(cap))
+    for i in range(CFG.num_refiner_layers):
+        cap = _block(sd, f"context_refiner.{i}", cap, cap_ang, None)
+
+    u = torch.cat([x, cap], dim=1)
+    for i in range(CFG.num_layers):
+        u = _block(sd, f"layers.{i}", u, uni_ang, adaln)
+
+    scale = 1.0 + _lin(sd, "all_final_layer.2-1.adaLN_modulation.1",
+                       torch.nn.functional.silu(adaln))
+    out = torch.nn.functional.layer_norm(
+        u[:, :s_img], (D,), eps=1e-6) * scale[:, None, :]
+    return _lin(sd, "all_final_layer.2-1.linear", out)
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_z_image_ckpt_parity(checkpoint, masked):
+    d, sd = checkpoint
+    params, cfg = zl.load_z_image_dit(d, cfg=CFG, dtype=jnp.float32)
+    assert cfg.rope_interleaved
+    g = np.random.default_rng(1)
+    # gh*gw = 6 is NOT a multiple of SEQ_MULTI_OF=4: x_pad exercised;
+    # masked lens (3, 6) exercise cap_pad [3:4) and zero-pad [4:6) with
+    # PER-ITEM image frame coordinates (5 vs 7)
+    gh, gw = 2, 3
+    img = g.standard_normal(
+        (2, gh * gw, CFG.patch_size ** 2 * CFG.in_channels)).astype(
+        np.float32)
+    cap = g.standard_normal((2, 6, CFG.cap_feat_dim)).astype(np.float32)
+    mask = (np.asarray([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]],
+                       np.int32) if masked else None)
+    t = np.asarray([0.4, 0.9], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, torch.from_numpy(img), torch.from_numpy(cap),
+                      torch.from_numpy(t), gh, gw,
+                      cap_mask=(torch.from_numpy(mask)
+                                if masked else None)).numpy()
+    got = np.asarray(zt.forward(
+        params, cfg, jnp.asarray(img), jnp.asarray(cap),
+        jnp.asarray(t), (gh, gw),
+        cap_mask=(jnp.asarray(mask) if masked else None)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)
+
+
+# ------------------------------------------------------- from_pretrained
+@pytest.fixture(scope="module")
+def z_root(tmp_path_factory, checkpoint):
+    import shutil
+
+    from transformers import Qwen3Config, Qwen3Model
+
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+    from tests.model_loader.test_image_vae_parity import (
+        TINY as VAE_JSON,
+        make_vae_state_dict,
+        write_vae_dir,
+    )
+
+    d, _ = checkpoint
+    root = tmp_path_factory.mktemp("z_root")
+    shutil.copytree(d, root / "transformer")
+    torch.manual_seed(0)
+    te = Qwen3Model(Qwen3Config(
+        vocab_size=256, hidden_size=40, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=512)).eval()
+    te.save_pretrained(str(root / "text_encoder"),
+                       safe_serialization=True)
+    _write_byte_level_tokenizer(root / "tokenizer")
+    write_vae_dir(str(root / "vae"), VAE_JSON,
+                  make_vae_state_dict(VAE_JSON, seed=7,
+                                      halves=("decoder",)))
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "FlowMatchEulerDiscreteScheduler",
+                    "shift": 3.0}))
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "ZImagePipeline",
+        "transformer": ["diffusers", "ZImageTransformer2DModel"],
+        "text_encoder": ["transformers", "Qwen3Model"],
+        "vae": ["diffusers", "AutoencoderKL"],
+    }))
+    return root
+
+
+def test_z_image_from_pretrained_generates(z_root):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.z_image.pipeline import ZImagePipeline
+
+    pipe = ZImagePipeline.from_pretrained(str(z_root),
+                                          dtype=jnp.float32,
+                                          max_text_len=64)
+    assert pipe.cfg.dit.rope_interleaved
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=3.0,
+        seed=0)
+    a = pipe.forward(OmniDiffusionRequest(
+        prompt=["a red ball"], sampling_params=sp,
+        request_ids=["r0"]))[0].data
+    b = pipe.forward(OmniDiffusionRequest(
+        prompt=["a blue cube"], sampling_params=sp,
+        request_ids=["r1"]))[0].data
+    assert a.dtype == np.uint8 and a.shape == (16, 16, 3)
+    assert not np.array_equal(a, b)

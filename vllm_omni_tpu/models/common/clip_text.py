@@ -94,13 +94,18 @@ def _act(cfg: CLIPTextConfig, x):
     return jax.nn.gelu(x, approximate=False)
 
 
-def forward(params, cfg: CLIPTextConfig, token_ids: jax.Array):
+def forward(params, cfg: CLIPTextConfig, token_ids: jax.Array,
+            return_penultimate: bool = False):
     """token_ids [B, S] -> (last_hidden [B, S, h], pooled [B, h]).
 
     ``pooled`` is the final-LN hidden at each row's EOS position (the
     first occurrence of eos_token_id; transformers CLIPTextModel pooled
-    output).  S must be <= max_positions; pad WITH eos/pad ids after the
-    real eos like the CLIP tokenizer does.
+    output), projected by ``text_projection`` when the params carry one
+    (CLIPTextModelWithProjection).  S must be <= max_positions; pad WITH
+    eos/pad ids after the real eos like the CLIP tokenizer does.
+
+    ``return_penultimate``: also return the raw hidden BEFORE the last
+    layer (HF ``hidden_states[-2]`` — what SD3/SDXL condition on).
     """
     b, s = token_ids.shape
     x = nn.embedding(params["token_embed"], token_ids)
@@ -108,7 +113,10 @@ def forward(params, cfg: CLIPTextConfig, token_ids: jax.Array):
     causal = jnp.where(
         jnp.arange(s)[None, :] <= jnp.arange(s)[:, None], 0.0, -1e30)
     scale = 1.0 / math.sqrt(cfg.hidden_size // cfg.num_heads)
-    for lp in params["layers"]:
+    penult = None
+    for li, lp in enumerate(params["layers"]):
+        if li == len(params["layers"]) - 1:
+            penult = x
         h = nn.layernorm(lp["norm1"], x, eps=cfg.eps)
         q = nn.linear(lp["q_proj"], h).reshape(b, s, cfg.num_heads, -1)
         k = nn.linear(lp["k_proj"], h).reshape(b, s, cfg.num_heads, -1)
@@ -135,6 +143,10 @@ def forward(params, cfg: CLIPTextConfig, token_ids: jax.Array):
         eos_pos = jnp.argmax(
             (token_ids == cfg.eos_token_id).astype(jnp.int32), axis=1)
     pooled = out[jnp.arange(b), eos_pos]
+    if "text_proj" in params:
+        pooled = pooled @ params["text_proj"]["w"]
+    if return_penultimate:
+        return out, pooled, penult
     return out, pooled
 
 
@@ -184,6 +196,14 @@ def load_clip_text(model_dir: str, cfg: CLIPTextConfig = None,
         lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
     tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32), shapes)
     flat = hf_flat_map(cfg, prefix)
+    # CLIPTextModelWithProjection (SD3/SDXL pooled towers) adds a
+    # bias-free projection on the pooled output
+    proj_shape = _ckpt_tensor_shape(model_dir, "text_projection.weight")
+    if proj_shape is not None:
+        # HF [proj, hidden]; hf_transform transposes to [hidden, proj]
+        tree["text_proj"] = {
+            "w": np.zeros((proj_shape[1], proj_shape[0]), np.float32)}
+        flat["text_projection.weight"] = ("text_proj", "w")
     n, _ = load_checkpoint_tree(
         model_dir, flat.get, tree, dtype=np.float32,
         transform=hf_transform, name_filter=lambda nm: nm in flat,
@@ -193,3 +213,16 @@ def load_clip_text(model_dir: str, cfg: CLIPTextConfig = None,
         raise ValueError(
             f"{model_dir} covered {n}/{n_leaves} CLIP text weights")
     return jax.tree.map(lambda a: jnp.asarray(a, dtype), tree), cfg
+
+
+def _ckpt_tensor_shape(model_dir: str, tensor_name: str):
+    import os
+
+    from safetensors import safe_open
+
+    for fn in sorted(os.listdir(model_dir)):
+        if fn.endswith(".safetensors"):
+            with safe_open(os.path.join(model_dir, fn), "np") as f:
+                if tensor_name in f.keys():
+                    return tuple(f.get_slice(tensor_name).get_shape())
+    return None

@@ -339,6 +339,8 @@ def forward_hidden(
     positions: Optional[jax.Array] = None,  # [B, S]
     inputs_embeds: Optional[jax.Array] = None,  # [B, S, hidden]
     attn_mask: Optional[jax.Array] = None,  # [B, S] 1=attendable key
+    drop_last_layers: int = 0,
+    apply_final_norm: bool = True,
 ) -> jax.Array:
     """Full-sequence causal forward returning final hidden states
     [B, S, hidden] (the text-encoder path; also prefill without cache).
@@ -346,7 +348,12 @@ def forward_hidden(
     ``attn_mask`` excludes padded KEY positions on top of causality —
     needed when padding sits mid-sequence (LongCat-Image pads the user
     prompt to a fixed length BETWEEN the template prefix and suffix, so
-    suffix tokens would otherwise attend pad keys)."""
+    suffix tokens would otherwise attend pad keys).
+
+    ``drop_last_layers=1, apply_final_norm=False`` yields the HF
+    ``output_hidden_states[-2]`` convention (the penultimate layer's
+    raw output) that Z-Image conditions on (pipeline_z_image.py:261-266).
+    """
     b, s = token_ids.shape
     x = _embed_input(params, token_ids, inputs_embeds, None)
     if positions is None:
@@ -363,8 +370,13 @@ def forward_hidden(
             kv_mask=attn_mask,
         )
 
-    for layer in params["layers"]:
+    layers = params["layers"]
+    if drop_last_layers:
+        layers = layers[:len(layers) - drop_last_layers]
+    for layer in layers:
         x = _layer_step(layer, cfg, x, cos, sin, attend)
+    if not apply_final_norm:
+        return x
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
 
 

@@ -140,6 +140,23 @@ def load_mmdit_family(
                        ctx_norm_key=ctx_norm_key)
     shapes = jax.eval_shape(
         lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    return load_routed(model_dir, routing, shapes, dtype), cfg
+
+
+def load_routed(model_dir: str, routing: dict, shapes, dtype,
+                transforms: dict = None):
+    """Streaming routed checkpoint load into a param tree shaped like
+    ``shapes`` (a jax.eval_shape result).  2-D tensors transpose from HF
+    [out, in] to our [in, out]; "fuse" routes buffer partner tensors and
+    concatenate along the output axis; "raw" skips the transpose.
+    ``transforms`` maps tensor names to array->array callables applied
+    BEFORE routing (e.g. reshaping a patch-conv kernel into the packed-
+    token matmul layout).  Raises unless EVERY leaf of the target tree
+    is covered with the exact shape."""
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        iter_safetensors,
+    )
+
     p = jax.tree.map(lambda _: None, shapes,
                      is_leaf=lambda x: not isinstance(x, (dict, list)))
 
@@ -149,16 +166,16 @@ def load_mmdit_family(
         return tree
 
     pending: dict[tuple, dict[int, np.ndarray]] = {}
-    n_direct = 0
     for name, arr in iter_safetensors(
             model_dir, name_filter=lambda nm: nm in routing):
         route = routing[name]
-        if arr.ndim == 2:
+        if transforms and name in transforms:
+            arr = transforms[name](arr)
+        elif arr.ndim == 2 and route[0] != "raw":
             arr = np.ascontiguousarray(arr.T)
-        if route[0] == "direct":
+        if route[0] in ("direct", "raw"):
             path = route[1]
             node_at(p, path)[path[-1]] = jnp.asarray(arr, dtype)
-            n_direct += 1
             continue
         _, path, slot, n_slots = route
         slots = pending.setdefault(path, {})
@@ -185,4 +202,4 @@ def load_mmdit_family(
                 f"{model_dir}: leaf {jax.tree_util.keystr(path)} "
                 f"{'missing' if got is None else tuple(got.shape)} != "
                 f"{tuple(want.shape)}")
-    return p, cfg
+    return p

@@ -1,0 +1,121 @@
+"""Diffusers-format SD3 transformer loader.
+
+Streams an SD3Transformer2DModel directory into
+models/sd3/transformer.py params.  The patch conv kernel
+``pos_embed.proj.weight`` [inner, C, p, p] reshapes into the packed-
+token matmul layout [(p*p*C), inner] matching the pipeline's (dy, dx, c)
+token feature order; the persisted sincos table ``pos_embed.pos_embed``
+loads as-is and is center-cropped at runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.flux.loader import load_routed
+from vllm_omni_tpu.models.sd3.transformer import (
+    SD3DiTConfig,
+    init_params,
+)
+
+
+def dit_config_from_diffusers(d: dict) -> SD3DiTConfig:
+    in_ch = d.get("in_channels", 16)
+    return SD3DiTConfig(
+        in_channels=in_ch,
+        out_channels=d.get("out_channels") or in_ch,
+        patch_size=d.get("patch_size", 2),
+        num_layers=d.get("num_layers", 24),
+        num_heads=d.get("num_attention_heads", 24),
+        head_dim=d.get("attention_head_dim", 64),
+        joint_dim=d.get("joint_attention_dim", 4096),
+        pooled_dim=d.get("pooled_projection_dim", 2048),
+        pos_embed_max_size=d.get("pos_embed_max_size", 192),
+        qk_norm=d.get("qk_norm") == "rms_norm",
+        dual_attention_layers=tuple(
+            d.get("dual_attention_layers", ())),
+    )
+
+
+def _routing(cfg: SD3DiTConfig) -> dict:
+    r: dict[str, tuple] = {}
+
+    def lin(hf, *path):
+        r[f"{hf}.weight"] = ("direct", path + ("w",))
+        r[f"{hf}.bias"] = ("direct", path + ("b",))
+
+    lin("pos_embed.proj", "patch_proj")
+    r["pos_embed.pos_embed"] = ("raw", ("pos_embed",))
+    lin("context_embedder", "ctx_in")
+    lin("time_text_embed.timestep_embedder.linear_1", "time_in1")
+    lin("time_text_embed.timestep_embedder.linear_2", "time_in2")
+    lin("time_text_embed.text_embedder.linear_1", "pooled_in1")
+    lin("time_text_embed.text_embedder.linear_2", "pooled_in2")
+    lin("norm_out.linear", "norm_out_mod")
+    lin("proj_out", "proj_out")
+    for i in range(cfg.num_layers):
+        b = f"transformer_blocks.{i}"
+        t = ("blocks", i)
+        last = i == cfg.num_layers - 1
+        lin(f"{b}.norm1.linear", *t, "img_mod")
+        if last:
+            lin(f"{b}.norm1_context.linear", *t, "ctx_ada")
+        else:
+            lin(f"{b}.norm1_context.linear", *t, "txt_mod")
+        for hf, ours in (("to_q", "to_q"), ("to_k", "to_k"),
+                         ("to_v", "to_v"), ("add_q_proj", "add_q"),
+                         ("add_k_proj", "add_k"),
+                         ("add_v_proj", "add_v")):
+            lin(f"{b}.attn.{hf}", *t, ours)
+        if cfg.qk_norm:
+            for nm in ("norm_q", "norm_k", "norm_added_q",
+                       "norm_added_k"):
+                r[f"{b}.attn.{nm}.weight"] = ("direct", t + (nm, "w"))
+        lin(f"{b}.attn.to_out.0", *t, "to_out")
+        lin(f"{b}.ff.net.0.proj", *t, "img_mlp1")
+        lin(f"{b}.ff.net.2", *t, "img_mlp2")
+        if not last:
+            lin(f"{b}.attn.to_add_out", *t, "to_add_out")
+            lin(f"{b}.ff_context.net.0.proj", *t, "txt_mlp1")
+            lin(f"{b}.ff_context.net.2", *t, "txt_mlp2")
+        if i in cfg.dual_attention_layers:
+            for hf, ours in (("to_q", "to_q2"), ("to_k", "to_k2"),
+                             ("to_v", "to_v2")):
+                lin(f"{b}.attn2.{hf}", *t, ours)
+            if cfg.qk_norm:
+                r[f"{b}.attn2.norm_q.weight"] = (
+                    "direct", t + ("norm_q2", "w"))
+                r[f"{b}.attn2.norm_k.weight"] = (
+                    "direct", t + ("norm_k2", "w"))
+            lin(f"{b}.attn2.to_out.0", *t, "to_out2")
+    return r
+
+
+def load_sd3_dit(model_dir: str, cfg: SD3DiTConfig = None,
+                 dtype=jnp.bfloat16):
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = dit_config_from_diffusers(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    p = cfg.patch_size
+
+    def conv_to_packed(arr):
+        # [inner, C, p, p] -> [(dy, dx, c), inner]
+        return np.ascontiguousarray(
+            arr.transpose(2, 3, 1, 0).reshape(p * p * arr.shape[1], -1))
+
+    def pos_table(arr):
+        # persisted [1, max*max, inner] -> [max*max, inner]
+        return arr.reshape(arr.shape[-2], arr.shape[-1])
+
+    tree = load_routed(
+        model_dir, _routing(cfg), shapes, dtype,
+        transforms={"pos_embed.proj.weight": conv_to_packed,
+                    "pos_embed.pos_embed": pos_table})
+    return tree, cfg

@@ -1,18 +1,21 @@
 """Stable Diffusion 3 text-to-image pipeline (CFG MMDiT).
 
 Reference: vllm_omni/diffusion/models/sd3/ (registry entry SD3,
-diffusion/registry.py:16-102).  SD3's MMDiT is the pure double-stream
-joint-attention shape — exactly the Flux transformer with zero
-single-stream blocks and no guidance embedding (flux/transformer.py
-config switches), which is the point of the shared MMDiT abstraction:
-one block implementation serves Qwen-Image, Flux AND SD3.  Unlike the
-guidance-distilled Flux, SD3 runs classifier-free guidance as a doubled
-batch (positive + negative prompts per step).
+diffusion/registry.py:16-102; pipeline_sd3.py:164-427).  SD3 runs true
+classifier-free guidance over a doubled batch; its transformer
+(models/sd3/transformer.py) is the rope-free MMDiT with a cropped
+sincos position table and a context_pre_only final block.
+
+Text conditioning (from_pretrained): CLIP-L + CLIP-bigG penultimate
+hiddens concatenated on the feature axis, zero-padded to the T5 width,
+then concatenated with the T5 hidden states along the sequence;
+pooled = [CLIP-L projected pooled; bigG projected pooled]
+(pipeline_sd3.py:277-427).  The byte-tokenizer random-init path keeps a
+single in-house encoder with masked-mean pooling.
 """
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass, field
 
 import jax
@@ -27,44 +30,44 @@ from vllm_omni_tpu.diffusion.request import (
     OmniDiffusionRequest,
 )
 from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import clip_text as clip_mod
+from vllm_omni_tpu.models.common import t5 as t5_mod
 from vllm_omni_tpu.models.common.transformer import (
     TransformerConfig,
     forward_hidden,
     init_params as init_text_params,
 )
-from vllm_omni_tpu.models.flux import transformer as fdit
-from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
 from vllm_omni_tpu.models.qwen_image import vae as vae_mod
 from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+from vllm_omni_tpu.models.sd3 import transformer as sdit
+from vllm_omni_tpu.models.sd3.transformer import SD3DiTConfig
 from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
 
 logger = init_logger(__name__)
 
 
-def _sd3_dit(base: FluxDiTConfig) -> FluxDiTConfig:
-    """Force the SD3 shape: double-stream only, CFG instead of embedded
-    guidance."""
-    return dataclasses.replace(
-        base, num_single_blocks=0, guidance_embed=False)
-
-
 @dataclass(frozen=True)
 class SD3PipelineConfig:
     text: TransformerConfig = field(default_factory=TransformerConfig)
-    dit: FluxDiTConfig = field(
-        default_factory=lambda: _sd3_dit(FluxDiTConfig(
-            num_double_blocks=24)))
+    dit: SD3DiTConfig = field(default_factory=SD3DiTConfig)
     vae: VAEConfig = field(default_factory=VAEConfig)
+    # real checkpoints: CLIP-L + CLIP-bigG towers beside the T5 (text)
+    clip: "clip_mod.CLIPTextConfig | None" = None
+    clip2: "clip_mod.CLIPTextConfig | None" = None
     max_text_len: int = 64
+    clip_text_len: int = 77
     shift: float = 3.0
-    pack: int = 2
     scheduler: str = "euler"
+
+    @property
+    def pack(self) -> int:
+        return self.dit.patch_size
 
     @staticmethod
     def tiny() -> "SD3PipelineConfig":
         return SD3PipelineConfig(
             text=TransformerConfig.tiny(vocab_size=256),
-            dit=_sd3_dit(FluxDiTConfig.tiny()),
+            dit=SD3DiTConfig.tiny(),
             vae=VAEConfig.tiny(),
         )
 
@@ -79,7 +82,8 @@ class SD3Pipeline:
         return self.cfg.vae.spatial_ratio * self.cfg.pack
 
     def __init__(self, config: SD3PipelineConfig, dtype=jnp.bfloat16,
-                 seed: int = 0, mesh=None, cache_config=None):
+                 seed: int = 0, mesh=None, cache_config=None,
+                 init_weights: bool = True):
         from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
         self.cfg = config
@@ -90,36 +94,68 @@ class SD3Pipeline:
         # double-stream blocks are not wired — refuse, don't ignore
         self.wiring = MeshWiring(mesh, type(self).__name__).validate(
             {"dp", "cfg"})
-        if config.dit.num_single_blocks != 0 or config.dit.guidance_embed:
+        if not isinstance(config.dit, SD3DiTConfig):
             raise ValueError(
-                "SD3 is double-stream-only with CFG: num_single_blocks "
-                "must be 0 and guidance_embed False (use _sd3_dit)"
-            )
-        if config.text.hidden_size != config.dit.ctx_dim:
-            raise ValueError("text hidden_size must equal dit ctx_dim")
-        if config.dit.pooled_dim != config.text.hidden_size:
-            raise ValueError("pooled_dim must equal text hidden_size")
-        want_in = config.vae.latent_channels * config.pack ** 2
-        if config.dit.in_channels != want_in:
+                "SD3Pipeline needs an SD3DiTConfig (the rope-free "
+                "double-stream MMDiT, models/sd3/transformer.py) — got "
+                f"{type(config.dit).__name__}")
+        self._t5_text = isinstance(config.text, t5_mod.T5Config)
+        text_width = (config.text.d_model if self._t5_text
+                      else config.text.hidden_size)
+        if config.clip is None:
+            if text_width != config.dit.joint_dim:
+                raise ValueError(
+                    "text hidden_size must equal dit joint_dim")
+            if config.dit.pooled_dim != text_width:
+                raise ValueError(
+                    "pooled_dim must equal text hidden size (masked-"
+                    "mean pooling)")
+        else:
+            if config.clip2 is None:
+                raise ValueError("SD3 needs both CLIP towers")
+        if config.dit.in_channels != config.vae.latent_channels:
             raise ValueError(
-                f"dit.in_channels must be latent*pack^2 = {want_in}")
+                "dit.in_channels must equal vae latent_channels (the "
+                "patch packing is the transformer's patch_size)")
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        self.hf_t5_tokenizer = None
+        self.hf_clip_tokenizer = None
+        self.hf_clip2_tokenizer = None
+        self.clip_params = None
+        self.clip2_params = None
         k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
         logger.info("Initializing SD3Pipeline params (dtype=%s)", dtype)
-        self.text_params = self.wiring.place(
-            init_text_params(k1, config.text, dtype))
-        self.dit_params = self.wiring.place(
-            fdit.init_params(k2, config.dit, dtype))
-        self.vae_params = self.wiring.place(
-            vae_mod.init_decoder(k3, config.vae, dtype))
+        if init_weights:
+            self.text_params = self.wiring.place(
+                init_text_params(k1, config.text, dtype))
+            self.dit_params = self.wiring.place(
+                sdit.init_params(k2, config.dit, dtype))
+            self.vae_params = self.wiring.place(
+                vae_mod.init_decoder(k3, config.vae, dtype))
+        else:
+            self.text_params = self.dit_params = self.vae_params = None
         self._denoise_cache: dict = {}
-        self._text_encode_jit = jax.jit(
-            lambda p, i: forward_hidden(p, self.cfg.text, i))
+        if self._t5_text:
+            self._text_encode_jit = jax.jit(
+                lambda p, i, m: t5_mod.forward(p, self.cfg.text, i, m))
+        else:
+            self._text_encode_jit = jax.jit(
+                lambda p, i: forward_hidden(p, self.cfg.text, i))
+        if config.clip is not None:
+            self._clip_encode_jit = jax.jit(
+                lambda p, i: clip_mod.forward(
+                    p, self.cfg.clip, i, return_penultimate=True))
+            self._clip2_encode_jit = jax.jit(
+                lambda p, i: clip_mod.forward(
+                    p, self.cfg.clip2, i, return_penultimate=True))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
 
     # ------------------------------------------------------------- encode
     def encode_prompt(self, prompts: list[str]):
+        """Returns (ctx [B, S, joint_dim], mask [B, S], pooled)."""
+        if self.cfg.clip is not None:
+            return self._encode_prompt_hf(prompts)
         ids, lens = self.tokenizer.batch_encode(prompts,
                                                 self.cfg.max_text_len)
         hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
@@ -129,6 +165,95 @@ class SD3Pipeline:
         denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
         pooled = (hidden * mask[..., None]).sum(axis=1) / denom
         return hidden, mask, pooled.astype(hidden.dtype)
+
+    def _clip_tower(self, tok, params, jit, prompts):
+        enc = tok(prompts, padding="max_length", truncation=True,
+                  max_length=self.cfg.clip_text_len)
+        ids = jnp.asarray(np.asarray(enc["input_ids"], np.int32))
+        _, pooled, penult = jit(params, ids)
+        return penult, pooled
+
+    def _encode_prompt_hf(self, prompts: list[str]):
+        """CLIP-L ++ bigG penultimate hiddens (feature axis), zero-
+        padded to the T5 width, then the T5 hiddens along the sequence;
+        pooled = projected pooled vectors concatenated
+        (pipeline_sd3.py:277-427)."""
+        h1, p1 = self._clip_tower(self.hf_clip_tokenizer,
+                                  self.clip_params,
+                                  self._clip_encode_jit, list(prompts))
+        h2, p2 = self._clip_tower(self.hf_clip2_tokenizer,
+                                  self.clip2_params,
+                                  self._clip2_encode_jit, list(prompts))
+        clip_h = jnp.concatenate([h1, h2], axis=-1)
+        enc = self.hf_t5_tokenizer(
+            list(prompts), padding="max_length", truncation=True,
+            max_length=self.cfg.max_text_len)
+        ids = jnp.asarray(np.asarray(enc["input_ids"], np.int32))
+        t5_mask = jnp.ones(ids.shape, jnp.int32)
+        t5_h = self._text_encode_jit(self.text_params, ids, t5_mask)
+        clip_h = jnp.pad(
+            clip_h, ((0, 0), (0, 0),
+                     (0, t5_h.shape[-1] - clip_h.shape[-1])))
+        ctx = jnp.concatenate([clip_h, t5_h], axis=1).astype(self.dtype)
+        pooled = jnp.concatenate([p1, p2], axis=-1).astype(self.dtype)
+        mask = jnp.ones(ctx.shape[:2], jnp.int32)
+        return ctx, mask, pooled
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = 256) -> "SD3Pipeline":
+        """Build from a diffusers-format SD3/SD3.5 checkpoint
+        (transformer/ + CLIP-L text_encoder/ + CLIP-bigG text_encoder_2/
+        + T5 text_encoder_3/ + tokenizers + AutoencoderKL vae/)."""
+        import json
+        import os
+
+        from transformers import AutoTokenizer
+
+        from vllm_omni_tpu.model_loader import diffusers_loader as dl
+        from vllm_omni_tpu.models.sd3 import loader as sd3_loader
+
+        dl.load_model_index(model_dir)
+        dit_params, dit_cfg = sd3_loader.load_sd3_dit(
+            os.path.join(model_dir, "transformer"), dtype=dtype)
+
+        def clip_tower(sub):
+            d = os.path.join(model_dir, sub)
+            with open(os.path.join(d, "config.json")) as f:
+                ccfg = clip_mod.CLIPTextConfig.from_hf(json.load(f))
+            cp, _ = clip_mod.load_clip_text(d, cfg=ccfg, dtype=dtype)
+            return cp, ccfg
+
+        clip_params, clip_cfg = clip_tower("text_encoder")
+        clip2_params, clip2_cfg = clip_tower("text_encoder_2")
+        te3 = os.path.join(model_dir, "text_encoder_3")
+        with open(os.path.join(te3, "config.json")) as f:
+            text_cfg = t5_mod.T5Config.from_hf(json.load(f))
+        text_params, _ = t5_mod.load_t5(te3, cfg=text_cfg, dtype=dtype)
+        vae_tree, vae_cfg = dl.load_image_vae(
+            os.path.join(model_dir, "vae"), dtype=dtype, decoder=True)
+        sched = dl.scheduler_config(model_dir)
+        config = SD3PipelineConfig(
+            text=text_cfg, dit=dit_cfg, vae=vae_cfg, clip=clip_cfg,
+            clip2=clip2_cfg, max_text_len=max_text_len,
+            clip_text_len=clip_cfg.max_positions,
+            shift=sched.get("shift", 3.0),
+        )
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+        pipe.dit_params = pipe.wiring.place(dit_params)
+        pipe.text_params = pipe.wiring.place(text_params)
+        pipe.clip_params = pipe.wiring.place(clip_params)
+        pipe.clip2_params = pipe.wiring.place(clip2_params)
+        pipe.vae_params = pipe.wiring.place(vae_tree["decoder"])
+        pipe.hf_clip_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer"))
+        pipe.hf_clip2_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer_2"))
+        pipe.hf_t5_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer_3"))
+        return pipe
 
     # ------------------------------------------------------------ denoise
     def _denoise_fn(self, grid_h, grid_w, sched_len):
@@ -143,7 +268,6 @@ class SD3Pipeline:
                 neg_mask, neg_pooled, sigmas, timesteps, gscale, num_steps):
             schedule = fm.FlowMatchSchedule(sigmas=sigmas,
                                             timesteps=timesteps)
-            b = latents.shape[0]
             do_cfg = neg_ctx is not None
             if do_cfg:
                 ctx_all = jnp.concatenate([ctx, neg_ctx], 0)
@@ -158,16 +282,15 @@ class SD3Pipeline:
                 # CFG halves ride the cfg axis, requests the dp axis
                 lat_in = self.wiring.constrain(lat_in)
                 t_in = jnp.concatenate([t, t], 0) if do_cfg else t
-                v = fdit.forward(
-                    dit_params, cfg.dit, lat_in, ctx_all, pooled_all, t_in,
-                    (grid_h, grid_w), txt_mask=mask_all,
+                v = sdit.forward(
+                    dit_params, cfg.dit, lat_in, ctx_all, pooled_all,
+                    t_in, (grid_h, grid_w), txt_mask=mask_all,
                 )
                 if do_cfg:
                     v_pos, v_neg = jnp.split(v, 2, axis=0)
                     v = v_neg + gscale * (v_pos - v_neg)
                 return v
 
-            del b
             return step_cache.run_denoise_loop(
                 cache_cfg, schedule, eval_velocity, latents, num_steps,
                 solver=cfg.scheduler)
@@ -186,6 +309,11 @@ class SD3Pipeline:
         lat_h = sp.height // cfg.vae.spatial_ratio
         lat_w = sp.width // cfg.vae.spatial_ratio
         gh, gw = lat_h // cfg.pack, lat_w // cfg.pack
+        if gh > cfg.dit.pos_embed_max_size or \
+                gw > cfg.dit.pos_embed_max_size:
+            raise InvalidRequestError(
+                f"grid {gh}x{gw} exceeds pos_embed_max_size "
+                f"{cfg.dit.pos_embed_max_size}")
         prompts = req.prompt
         b = len(prompts)
 
@@ -199,7 +327,8 @@ class SD3Pipeline:
                 else int(np.random.randint(0, 2 ** 31 - 1)))
         noise = jax.random.normal(
             jax.random.PRNGKey(seed),
-            (b, gh * gw, cfg.dit.in_channels), self.dtype,
+            (b, gh * gw, cfg.dit.in_channels * cfg.pack ** 2),
+            self.dtype,
         )
         num_steps = sp.num_inference_steps
         sched_len = max(8, 1 << (num_steps - 1).bit_length())

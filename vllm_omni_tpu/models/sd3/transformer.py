@@ -1,0 +1,281 @@
+"""SD3 MMDiT transformer (functional JAX).
+
+Reference: vllm_omni/diffusion/models/sd3/sd3_transformer.py:383
+``SD3Transformer2DModel`` — double-stream joint-attention blocks with NO
+rotary embeddings: position comes from a fixed 2-D sincos table center-
+cropped to the sample grid (PatchEmbed ``pos_embed_max_size``,
+:75-104,383-420).  Conditioning combines the timestep sinusoid with the
+projected pooled text vector; per-head QK RMSNorm is optional
+(SD3.5 ``qk_norm="rms_norm"``, SD3.0 none); SD3.5-medium additionally
+runs a SECOND self-attention branch on listed layers
+(``dual_attention_layers`` + SD35AdaLayerNormZeroX, 9-chunk modulation);
+the LAST block is ``context_pre_only``: its text stream is normalized by
+AdaLayerNormContinuous, feeds the joint attention, and is then dropped.
+
+TPU-first: the patch conv is expressed as a packed-token matmul (the
+loader reshapes the conv kernel), attention is the Pallas flash kernel,
+the whole stack stays one jitted computation.  Joint layout is
+text-first like the rest of the repo — without rope the concat order is
+arbitrary as long as the split-back matches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+
+@dataclass(frozen=True)
+class SD3DiTConfig:
+    in_channels: int = 16
+    out_channels: int = 16
+    patch_size: int = 2
+    num_layers: int = 24
+    num_heads: int = 24
+    head_dim: int = 64
+    joint_dim: int = 4096    # concatenated CLIP(-padded)/T5 text width
+    pooled_dim: int = 2048   # CLIP-L + bigG pooled widths
+    pos_embed_max_size: int = 192
+    mlp_ratio: float = 4.0
+    qk_norm: bool = False    # SD3.5 checkpoints: True
+    dual_attention_layers: tuple = ()  # SD3.5-medium: range(13)
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @staticmethod
+    def tiny() -> "SD3DiTConfig":
+        # joint/pooled widths match TransformerConfig.tiny()'s hidden
+        # (the random-init single-encoder path)
+        return SD3DiTConfig(
+            in_channels=4, out_channels=4, num_layers=2, num_heads=4,
+            head_dim=16, joint_dim=64, pooled_dim=64,
+            pos_embed_max_size=8, qk_norm=True,
+            dual_attention_layers=(0,),
+        )
+
+
+def init_params(key, cfg: SD3DiTConfig, dtype=jnp.float32):
+    inner = cfg.inner_dim
+    mlp = int(inner * cfg.mlp_ratio)
+    p_in = cfg.patch_size ** 2 * cfg.in_channels
+    keys = jax.random.split(key, cfg.num_layers + 10)
+    p = {
+        "patch_proj": nn.linear_init(keys[0], p_in, inner, dtype=dtype),
+        # fixed 2-D sincos table (checkpoints persist it; random init
+        # here only feeds shape/flow tests)
+        "pos_embed": (0.02 * jax.random.normal(
+            keys[1], (cfg.pos_embed_max_size ** 2, inner))).astype(dtype),
+        "ctx_in": nn.linear_init(keys[2], cfg.joint_dim, inner,
+                                 dtype=dtype),
+        "time_in1": nn.linear_init(keys[3], 256, inner, dtype=dtype),
+        "time_in2": nn.linear_init(keys[4], inner, inner, dtype=dtype),
+        "pooled_in1": nn.linear_init(keys[5], cfg.pooled_dim, inner,
+                                     dtype=dtype),
+        "pooled_in2": nn.linear_init(keys[6], inner, inner, dtype=dtype),
+        "norm_out_mod": nn.linear_init(keys[7], inner, 2 * inner,
+                                       dtype=dtype),
+        "proj_out": nn.linear_init(
+            keys[8], inner, cfg.patch_size ** 2 * cfg.out_channels,
+            dtype=dtype),
+        "blocks": [],
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[i + 10], 16)
+        last = i == cfg.num_layers - 1
+        dual = i in cfg.dual_attention_layers
+        blk = {
+            "img_mod": nn.linear_init(
+                k[0], inner, (9 if dual else 6) * inner, dtype=dtype),
+            "to_q": nn.linear_init(k[1], inner, inner, dtype=dtype),
+            "to_k": nn.linear_init(k[2], inner, inner, dtype=dtype),
+            "to_v": nn.linear_init(k[3], inner, inner, dtype=dtype),
+            "add_q": nn.linear_init(k[4], inner, inner, dtype=dtype),
+            "add_k": nn.linear_init(k[5], inner, inner, dtype=dtype),
+            "add_v": nn.linear_init(k[6], inner, inner, dtype=dtype),
+            "to_out": nn.linear_init(k[7], inner, inner, dtype=dtype),
+            "img_mlp1": nn.linear_init(k[8], inner, mlp, dtype=dtype),
+            "img_mlp2": nn.linear_init(k[9], mlp, inner, dtype=dtype),
+        }
+        if cfg.qk_norm:
+            for nm in ("norm_q", "norm_k", "norm_added_q",
+                       "norm_added_k"):
+                blk[nm] = nn.rmsnorm_init(cfg.head_dim, dtype)
+        if last:
+            # context_pre_only: AdaLayerNormContinuous on the text side
+            blk["ctx_ada"] = nn.linear_init(k[10], inner, 2 * inner,
+                                            dtype=dtype)
+        else:
+            blk["txt_mod"] = nn.linear_init(k[10], inner, 6 * inner,
+                                            dtype=dtype)
+            blk["to_add_out"] = nn.linear_init(k[11], inner, inner,
+                                               dtype=dtype)
+            blk["txt_mlp1"] = nn.linear_init(k[12], inner, mlp,
+                                             dtype=dtype)
+            blk["txt_mlp2"] = nn.linear_init(k[13], mlp, inner,
+                                             dtype=dtype)
+        if dual:
+            blk["to_q2"] = nn.linear_init(k[14], inner, inner,
+                                          dtype=dtype)
+            blk["to_k2"] = nn.linear_init(
+                jax.random.fold_in(k[14], 1), inner, inner, dtype=dtype)
+            blk["to_v2"] = nn.linear_init(
+                jax.random.fold_in(k[14], 2), inner, inner, dtype=dtype)
+            blk["to_out2"] = nn.linear_init(k[15], inner, inner,
+                                            dtype=dtype)
+            if cfg.qk_norm:
+                blk["norm_q2"] = nn.rmsnorm_init(cfg.head_dim, dtype)
+                blk["norm_k2"] = nn.rmsnorm_init(cfg.head_dim, dtype)
+        p["blocks"].append(blk)
+    return p
+
+
+def _heads(x, h):
+    b, s, _ = x.shape
+    return x.reshape(b, s, h, -1)
+
+
+def _maybe_rms(blk, name, x):
+    if name in blk:
+        return rms_norm(x, blk[name]["w"])
+    return x
+
+
+def _mod_ln(x, shift, scale):
+    return nn.layernorm({}, x) * (1.0 + scale[:, None, :]) \
+        + shift[:, None, :]
+
+
+def _block(blk, cfg: SD3DiTConfig, img, txt, temb_act, kv_mask, last):
+    h = cfg.num_heads
+    s_txt = txt.shape[1]
+    img_mod = nn.linear(blk["img_mod"], temb_act)
+    if "to_q2" in blk:
+        (shift_msa, scale_msa, gate_msa, shift_mlp, scale_mlp, gate_mlp,
+         shift_msa2, scale_msa2, gate_msa2) = jnp.split(img_mod, 9, -1)
+        # SD35AdaLayerNormZeroX: BOTH normalized views come from the
+        # block INPUT (before any residual)
+        img_n2_pre = _mod_ln(img, shift_msa2, scale_msa2)
+    else:
+        (shift_msa, scale_msa, gate_msa, shift_mlp, scale_mlp,
+         gate_mlp) = jnp.split(img_mod, 6, -1)
+        shift_msa2 = None
+    img_n = _mod_ln(img, shift_msa, scale_msa)
+
+    if last:
+        # AdaLayerNormContinuous (scale first, then shift)
+        mod = nn.linear(blk["ctx_ada"], temb_act)
+        c_scale, c_shift = jnp.split(mod, 2, axis=-1)
+        txt_n = _mod_ln(txt, c_shift, c_scale)
+        c_gate_msa = None
+    else:
+        txt_mod = nn.linear(blk["txt_mod"], temb_act)
+        (c_shift_msa, c_scale_msa, c_gate_msa, c_shift_mlp, c_scale_mlp,
+         c_gate_mlp) = jnp.split(txt_mod, 6, -1)
+        txt_n = _mod_ln(txt, c_shift_msa, c_scale_msa)
+
+    qi = _maybe_rms(blk, "norm_q", _heads(nn.linear(blk["to_q"], img_n), h))
+    ki = _maybe_rms(blk, "norm_k", _heads(nn.linear(blk["to_k"], img_n), h))
+    vi = _heads(nn.linear(blk["to_v"], img_n), h)
+    qt = _maybe_rms(blk, "norm_added_q",
+                    _heads(nn.linear(blk["add_q"], txt_n), h))
+    kt = _maybe_rms(blk, "norm_added_k",
+                    _heads(nn.linear(blk["add_k"], txt_n), h))
+    vt = _heads(nn.linear(blk["add_v"], txt_n), h)
+    q = jnp.concatenate([qt, qi], axis=1)
+    k = jnp.concatenate([kt, ki], axis=1)
+    v = jnp.concatenate([vt, vi], axis=1)
+    o = flash_attention(q, k, v, causal=False, kv_mask=kv_mask)
+    txt_o = o[:, :s_txt].reshape(*txt.shape[:2], -1)
+    img_o = o[:, s_txt:].reshape(*img.shape[:2], -1)
+
+    img = img + gate_msa[:, None, :] * nn.linear(blk["to_out"], img_o)
+    if shift_msa2 is not None:
+        # dual attention: a second SELF-attention branch over the image
+        # stream, reading the BLOCK-INPUT normalized view
+        # (SD3.5-medium, sd3_transformer.py:330-356)
+        q2 = _maybe_rms(blk, "norm_q2",
+                        _heads(nn.linear(blk["to_q2"], img_n2_pre), h))
+        k2 = _maybe_rms(blk, "norm_k2",
+                        _heads(nn.linear(blk["to_k2"], img_n2_pre), h))
+        v2 = _heads(nn.linear(blk["to_v2"], img_n2_pre), h)
+        o2 = flash_attention(q2, k2, v2, causal=False)
+        o2 = o2.reshape(*img.shape[:2], -1)
+        img = img + gate_msa2[:, None, :] * nn.linear(blk["to_out2"], o2)
+
+    img_nf = _mod_ln(img, shift_mlp, scale_mlp)
+    img = img + gate_mlp[:, None, :] * nn.linear(
+        blk["img_mlp2"],
+        jax.nn.gelu(nn.linear(blk["img_mlp1"], img_nf), approximate=True))
+
+    if last:
+        return img, txt
+    txt = txt + c_gate_msa[:, None, :] * nn.linear(blk["to_add_out"],
+                                                   txt_o)
+    txt_nf = _mod_ln(txt, c_shift_mlp, c_scale_mlp)
+    txt = txt + c_gate_mlp[:, None, :] * nn.linear(
+        blk["txt_mlp2"],
+        jax.nn.gelu(nn.linear(blk["txt_mlp1"], txt_nf), approximate=True))
+    return img, txt
+
+
+def cropped_pos_embed(params, cfg: SD3DiTConfig, gh: int, gw: int):
+    """Center-crop the (max, max) sincos table to the sample grid
+    (diffusers PatchEmbed.cropped_pos_embed)."""
+    m = cfg.pos_embed_max_size
+    table = params["pos_embed"].reshape(m, m, cfg.inner_dim)
+    top = (m - gh) // 2
+    left = (m - gw) // 2
+    return table[top:top + gh, left:left + gw].reshape(
+        gh * gw, cfg.inner_dim)
+
+
+def forward(
+    params,
+    cfg: SD3DiTConfig,
+    img_tokens: jax.Array,  # [B, gh*gw, patch^2*in_channels] packed
+    txt_states: jax.Array,  # [B, S_txt, joint_dim]
+    pooled: jax.Array,      # [B, pooled_dim]
+    timesteps: jax.Array,   # [B] in [0, 1000)
+    grid_hw: tuple[int, int],
+    txt_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Velocity prediction [B, gh*gw, patch^2*out_channels]."""
+    gh, gw = grid_hw
+    b = img_tokens.shape[0]
+    img = nn.linear(params["patch_proj"], img_tokens)
+    img = img + cropped_pos_embed(params, cfg, gh, gw)[None].astype(
+        img.dtype)
+    txt = nn.linear(params["ctx_in"], txt_states)
+
+    temb = nn.timestep_embedding(timesteps, 256).astype(img.dtype)
+    temb = nn.linear(params["time_in2"],
+                     jax.nn.silu(nn.linear(params["time_in1"], temb)))
+    temb = temb + nn.linear(
+        params["pooled_in2"],
+        jax.nn.silu(nn.linear(params["pooled_in1"], pooled)))
+    temb_act = jax.nn.silu(temb)
+
+    kv_mask = None
+    if txt_mask is not None:
+        kv_mask = jnp.concatenate(
+            [txt_mask.astype(jnp.int32),
+             jnp.ones((b, img.shape[1]), jnp.int32)], axis=1)
+
+    n = len(params["blocks"])
+    for i, blk in enumerate(params["blocks"]):
+        img, txt = _block(blk, cfg, img, txt, temb_act, kv_mask,
+                          last=(i == n - 1))
+
+    mod = nn.linear(params["norm_out_mod"], temb_act)
+    scale, shift = jnp.split(mod, 2, axis=-1)
+    img = nn.layernorm({}, img) * (1.0 + scale[:, None, :]) \
+        + shift[:, None, :]
+    return nn.linear(params["proj_out"], img)

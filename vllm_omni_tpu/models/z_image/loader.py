@@ -1,0 +1,102 @@
+"""Diffusers-format Z-Image transformer loader.
+
+Streams a ZImageTransformer2DModel directory into
+models/z_image/transformer.py params.  Checkpoint names follow the
+reference's named_parameters (z_image_transformer.py:597-726):
+``all_x_embedder.{p}-{f}``, ``t_embedder.mlp.{0,2}``,
+``cap_embedder.{0,1}``, ``{x,cap}_pad_token``,
+``all_final_layer.{p}-{f}.{linear,adaLN_modulation.1}``, and per block
+``attention.{to_q,to_k,to_v,norm_q,norm_k,to_out.0}``,
+``feed_forward.{w1,w3,w2}`` (w1/w3 fuse into our ``w13``),
+``{attention,ffn}_norm{1,2}``, ``adaLN_modulation.0``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.flux.loader import load_routed
+from vllm_omni_tpu.models.z_image.transformer import (
+    ZImageDiTConfig,
+    init_params,
+)
+
+
+def dit_config_from_diffusers(d: dict) -> ZImageDiTConfig:
+    return ZImageDiTConfig(
+        in_channels=d.get("in_channels", 16),
+        patch_size=tuple(d.get("all_patch_size", (2,)))[0],
+        dim=d.get("dim", 3840),
+        num_layers=d.get("n_layers", 30),
+        num_refiner_layers=d.get("n_refiner_layers", 2),
+        num_heads=d.get("n_heads", 30),
+        num_kv_heads=d.get("n_kv_heads", 30),
+        cap_feat_dim=d.get("cap_feat_dim", 2560),
+        rope_theta=d.get("rope_theta", 256.0),
+        axes_dims=tuple(d.get("axes_dims", (32, 48, 48))),
+        t_scale=d.get("t_scale", 1000.0),
+        norm_eps=d.get("norm_eps", 1e-5),
+        rope_interleaved=True,  # trained-checkpoint pairing
+    )
+
+
+def _routing(cfg: ZImageDiTConfig) -> dict:
+    r: dict[str, tuple] = {}
+
+    def lin(hf, *path, bias=True):
+        r[f"{hf}.weight"] = ("direct", path + ("w",))
+        if bias:
+            r[f"{hf}.bias"] = ("direct", path + ("b",))
+
+    pf = f"{cfg.patch_size}-1"
+    lin(f"all_x_embedder.{pf}", "x_embed")
+    lin("t_embedder.mlp.0", "t_in1")
+    lin("t_embedder.mlp.2", "t_in2")
+    r["cap_embedder.0.weight"] = ("direct", ("cap_norm", "w"))
+    lin("cap_embedder.1", "cap_embed")
+    r["x_pad_token"] = ("raw", ("x_pad",))
+    r["cap_pad_token"] = ("raw", ("cap_pad",))
+    lin(f"all_final_layer.{pf}.linear", "final_out")
+    lin(f"all_final_layer.{pf}.adaLN_modulation.1", "final_adaln")
+
+    def block(hf_prefix, *path, modulation):
+        lin(f"{hf_prefix}.attention.to_q", *path, "to_q", bias=False)
+        lin(f"{hf_prefix}.attention.to_k", *path, "to_k", bias=False)
+        lin(f"{hf_prefix}.attention.to_v", *path, "to_v", bias=False)
+        lin(f"{hf_prefix}.attention.to_out.0", *path, "out", bias=False)
+        for nm in ("norm_q", "norm_k"):
+            r[f"{hf_prefix}.attention.{nm}.weight"] = (
+                "direct", path + (nm, "w"))
+        for nm in ("attention_norm1", "attention_norm2", "ffn_norm1",
+                   "ffn_norm2"):
+            ours = {"attention_norm1": "attn_norm1",
+                    "attention_norm2": "attn_norm2"}.get(nm, nm)
+            r[f"{hf_prefix}.{nm}.weight"] = ("direct", path + (ours, "w"))
+        for s, nm in enumerate(("w1", "w3")):
+            r[f"{hf_prefix}.feed_forward.{nm}.weight"] = (
+                "fuse", path + ("w13", "w"), s, 2)
+        lin(f"{hf_prefix}.feed_forward.w2", *path, "w2", bias=False)
+        if modulation:
+            lin(f"{hf_prefix}.adaLN_modulation.0", *path, "adaln")
+
+    for i in range(cfg.num_refiner_layers):
+        block(f"noise_refiner.{i}", "noise_refiner", i, modulation=True)
+        block(f"context_refiner.{i}", "context_refiner", i,
+              modulation=False)
+    for i in range(cfg.num_layers):
+        block(f"layers.{i}", "layers", i, modulation=True)
+    return r
+
+
+def load_z_image_dit(model_dir: str, cfg: ZImageDiTConfig = None,
+                     dtype=jnp.bfloat16):
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = dit_config_from_diffusers(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    return load_routed(model_dir, _routing(cfg), shapes, dtype), cfg

@@ -8,10 +8,11 @@ the DiT receives REVERSED normalized time ``(1000 - t)/1000`` and
 predicts the NEGATIVE velocity (pipeline_z_image.py:545-618), and CFG is
 true classifier-free guidance over a doubled batch.
 
-Documented deviation: the reference takes the text encoder's
-second-to-last hidden layer (``hidden_states[-2]``); this pipeline uses
-the final hidden states (one functional text encoder serves every
-family here).
+The from_pretrained path matches the reference's text conditioning
+exactly: tokenizer right padding, ``hidden_states[-2]`` (penultimate
+layer, no final norm), caption span bucketed to a multiple of 32 so the
+image grid's frame coordinate matches training.  The byte-tokenizer
+random-init path keeps using final hidden states.
 """
 
 from __future__ import annotations
@@ -69,7 +70,8 @@ class ZImagePipeline:
     output_type = "image"
 
     def __init__(self, config: ZImagePipelineConfig, dtype=jnp.bfloat16,
-                 seed: int = 0, mesh=None, cache_config=None):
+                 seed: int = 0, mesh=None, cache_config=None,
+                 init_weights: bool = True):
         from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
         self.cfg = config
@@ -85,27 +87,96 @@ class ZImagePipeline:
             raise ValueError(
                 "dit in_channels must equal vae latent_channels")
         self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        self.hf_tokenizer = None  # set by from_pretrained
         k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
         logger.info("Initializing ZImagePipeline (dtype=%s)", dtype)
-        self.text_params = self.wiring.place(
-            init_text_params(k1, config.text, dtype))
-        self.dit_params = self.wiring.place(
-            zdit.init_params(k2, config.dit, dtype))
-        self.vae_params = self.wiring.place(
-            vae_mod.init_decoder(k3, config.vae, dtype))
+        if init_weights:
+            self.text_params = self.wiring.place(
+                init_text_params(k1, config.text, dtype))
+            self.dit_params = self.wiring.place(
+                zdit.init_params(k2, config.dit, dtype))
+            self.vae_params = self.wiring.place(
+                vae_mod.init_decoder(k3, config.vae, dtype))
+        else:
+            self.text_params = self.dit_params = self.vae_params = None
         self._denoise_cache: dict = {}
         self._text_encode_jit = jax.jit(
             lambda p, i: forward_hidden(p, self.cfg.text, i))
+        # HF convention: the DiT conditions on hidden_states[-2] (the
+        # penultimate layer's raw output, pipeline_z_image.py:261-266)
+        self._text_encode_hf_jit = jax.jit(
+            lambda p, i: forward_hidden(p, self.cfg.text, i,
+                                        drop_last_layers=1,
+                                        apply_final_norm=False))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
 
     def encode_prompt(self, prompts: list[str]):
+        if self.hf_tokenizer is not None:
+            return self._encode_prompt_hf(prompts)
         ids, lens = self.tokenizer.batch_encode(prompts,
                                                 self.cfg.max_text_len)
         hidden = self._text_encode_jit(self.text_params, jnp.asarray(ids))
         mask = (np.arange(self.cfg.max_text_len)[None, :]
                 < lens[:, None]).astype(np.int32)
         return hidden, jnp.asarray(mask)
+
+    def _encode_prompt_hf(self, prompts: list[str]):
+        """Reference encode (pipeline_z_image.py:250-272): tokenize with
+        right padding, take hidden_states[-2].  The caption span is
+        bucketed to a multiple of 32 of the longest real length
+        (reference SEQ_MULTI_OF padding, z_image_transformer.py:775-787)
+        so the image grid's frame coordinate stays faithful while shapes
+        remain bucketed for XLA."""
+        tok = self.hf_tokenizer
+        tok.padding_side = "right"
+        enc = tok(list(prompts), padding="max_length", truncation=True,
+                  max_length=self.cfg.max_text_len)
+        ids = np.asarray(enc["input_ids"], np.int32)
+        mask = np.asarray(enc["attention_mask"], np.int32)
+        # at least one 32-token bucket: an empty negative prompt has
+        # zero real tokens, and a zero-length caption would collapse the
+        # sequence (pads carry the learned cap_pad embedding, so a
+        # one-bucket empty caption is well-defined conditioning)
+        longest = max(1, int(mask.sum(axis=1).max()))
+        bucket = min(self.cfg.max_text_len, -(-longest // 32) * 32)
+        ids, mask = ids[:, :bucket], mask[:, :bucket]
+        hidden = self._text_encode_hf_jit(self.text_params,
+                                          jnp.asarray(ids))
+        return hidden.astype(self.dtype), jnp.asarray(mask)
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = 512) -> "ZImagePipeline":
+        """Build from a diffusers-format Z-Image checkpoint
+        (transformer/ + Qwen3 text_encoder/ + tokenizer/ + AutoencoderKL
+        vae/ + scheduler/)."""
+        import os
+
+        from transformers import AutoTokenizer
+
+        from vllm_omni_tpu.model_loader import diffusers_loader as dl
+        from vllm_omni_tpu.models.z_image import loader as zloader
+
+        dl.load_model_index(model_dir)
+        dit_params, dit_cfg = zloader.load_z_image_dit(
+            os.path.join(model_dir, "transformer"), dtype=dtype)
+        text_params, text_cfg = dl.load_text_encoder(
+            os.path.join(model_dir, "text_encoder"), dtype=dtype)
+        vae_tree, vae_cfg = dl.load_image_vae(
+            os.path.join(model_dir, "vae"), dtype=dtype, decoder=True)
+        config = ZImagePipelineConfig(
+            text=text_cfg, dit=dit_cfg, vae=vae_cfg,
+            max_text_len=max_text_len)
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+        pipe.dit_params = pipe.wiring.place(dit_params)
+        pipe.text_params = pipe.wiring.place(text_params)
+        pipe.vae_params = pipe.wiring.place(vae_tree["decoder"])
+        pipe.hf_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer"))
+        return pipe
 
     def _denoise_fn(self, grid_h, grid_w, sched_len, batch2=0):
         key = (grid_h, grid_w, sched_len) + (
@@ -173,12 +244,18 @@ class ZImagePipeline:
         prompts = req.prompt
         b = len(prompts)
 
-        cap, cap_mask = self.encode_prompt(prompts)
         do_cfg = sp.guidance_scale > 1.0
         neg_cap = neg_mask = None
         if do_cfg:
-            neg_cap, neg_mask = self.encode_prompt(
-                [sp.negative_prompt] * b)
+            # one joint encode: positive and negative captions share the
+            # caption bucket, so the CFG halves concatenate and the
+            # image grid sits at one frame coordinate for both
+            both, both_mask = self.encode_prompt(
+                list(prompts) + [sp.negative_prompt] * b)
+            cap, neg_cap = both[:b], both[b:]
+            cap_mask, neg_mask = both_mask[:b], both_mask[b:]
+        else:
+            cap, cap_mask = self.encode_prompt(prompts)
 
         seed = (sp.seed if sp.seed is not None
                 else int(np.random.randint(0, 2 ** 31 - 1)))

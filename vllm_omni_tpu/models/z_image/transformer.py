@@ -47,6 +47,15 @@ class ZImageDiTConfig:
     axes_dims: tuple[int, int, int] = (32, 48, 48)
     t_scale: float = 1000.0
     norm_eps: float = 1e-5
+    # rotary pairing: False = half-split (TPU-native default), True =
+    # interleaved pairs — the trained-checkpoint convention (reference
+    # RotaryEmbedding(is_neox_style=False), z_image_transformer.py:305);
+    # from_pretrained sets this
+    rope_interleaved: bool = False
+    # sequence length multiple the reference pads to (SEQ_MULTI_OF):
+    # per-item caption spans round up to it (learned cap_pad embeds) and
+    # the image sequence pads to it (x_pad embeds, ids (0,0,0))
+    seq_multiple: int = 32
 
     @property
     def head_dim(self) -> int:
@@ -111,6 +120,13 @@ def init_params(key, cfg: ZImageDiTConfig, dtype=jnp.float32):
         "final_adaln": nn.linear_init(keys[4], cfg.adaln_dim, d,
                                       dtype=dtype),
         "final_out": nn.linear_init(keys[5], d, p_in, dtype=dtype),
+        # learned pad embeddings replacing padded positions post-embed
+        # (reference x_pad_token / cap_pad_token,
+        # z_image_transformer.py:721-722,888-921)
+        "x_pad": (0.02 * jax.random.normal(
+            jax.random.fold_in(keys[5], 1), (1, d))).astype(dtype),
+        "cap_pad": (0.02 * jax.random.normal(
+            jax.random.fold_in(keys[5], 2), (1, d))).astype(dtype),
         "noise_refiner": [],
         "context_refiner": [],
         "layers": [],
@@ -132,27 +148,36 @@ def init_params(key, cfg: ZImageDiTConfig, dtype=jnp.float32):
 
 def _axis_angles(pos, half, theta):
     inv = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
-    return pos.astype(jnp.float32)[:, None] * inv[None, :]
+    return pos.astype(jnp.float32)[..., None] * inv
 
 
 def rope_angles(cfg: ZImageDiTConfig, coords: jax.Array):
-    """coords [S, 3] integer (frame, row, col) ids -> angles
-    [S, head_dim//2] (reference RopeEmbedder, z_image_transformer.py:493)."""
+    """coords [..., 3] integer (frame, row, col) ids -> angles
+    [..., head_dim//2] (reference RopeEmbedder,
+    z_image_transformer.py:493).  Leading dims may include the batch —
+    caption lengths are per-item, so the image frame coordinate is
+    data-dependent per item."""
     halves = [d // 2 for d in cfg.axes_dims]
     parts = [
-        _axis_angles(coords[:, i], h, cfg.rope_theta)
+        _axis_angles(coords[..., i], h, cfg.rope_theta)
         for i, h in enumerate(halves)
     ]
     ang = jnp.concatenate(parts, axis=-1)
     return jnp.cos(ang), jnp.sin(ang)
 
 
-def _rope_apply(x, cos, sin):
+def _rope_apply(x, cos, sin, interleaved: bool = False):
+    # x [B, S, H, D]; cos/sin [B, S, D//2] (per-item tables)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    if interleaved:
+        x1 = x[..., 0::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+        return out.reshape(x.shape).astype(x.dtype)
     d = x.shape[-1]
     x1 = x[..., : d // 2].astype(jnp.float32)
     x2 = x[..., d // 2:].astype(jnp.float32)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
     return jnp.concatenate(
         [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
 
@@ -179,8 +204,8 @@ def _block(p, cfg: ZImageDiTConfig, x, freqs, adaln=None, attn_fn=None):
         p["norm_k"]["w"], eps)
     v = nn.linear(p["to_v"], h).reshape(b, s, -1, cfg.head_dim)
     cos, sin = freqs
-    q = _rope_apply(q, cos, sin)
-    k = _rope_apply(k, cos, sin)
+    q = _rope_apply(q, cos, sin, interleaved=cfg.rope_interleaved)
+    k = _rope_apply(k, cos, sin, interleaved=cfg.rope_interleaved)
     if attn_fn is not None:
         o = attn_fn(q, k, v)
     else:
@@ -206,14 +231,25 @@ def forward(
     cap_feats: jax.Array,   # [B, S_cap, cap_feat_dim]
     timesteps: jax.Array,   # [B] in [0, 1]
     grid_hw: tuple[int, int],
-    cap_mask=None,          # [B, S_cap] (currently informational)
+    cap_mask=None,          # [B, S_cap] 1=real; pads get the learned pad embed
     attn_fn=None,
 ) -> jax.Array:
-    """Velocity prediction [B, S_img, patch^2 * in_channels]."""
+    """Velocity prediction [B, S_img, patch^2 * in_channels].
+
+    Reference padding semantics (z_image_transformer.py:770-921): each
+    item's caption span rounds up to ``seq_multiple`` with the LEARNED
+    cap_pad embedding at continued frame coordinates; batch-level
+    caption padding beyond an item's rounded span carries zero
+    embeddings at ids (0,0,0); the image grid's frame coordinate starts
+    at that item's rounded caption length + 1; the image sequence rounds
+    up to ``seq_multiple`` with x_pad embeddings at ids (0,0,0).  All
+    pad positions are fully attended (the reference runs attention
+    unmasked)."""
     gh, gw = grid_hw
     b, s_img, _ = img_tokens.shape
     s_cap = cap_feats.shape[1]
     assert s_img == gh * gw, (s_img, gh, gw)
+    sm = cfg.seq_multiple
 
     temb = nn.timestep_embedding(timesteps * cfg.t_scale, 256)
     adaln = nn.linear(
@@ -221,28 +257,54 @@ def forward(
         jax.nn.silu(nn.linear(params["t_in1"],
                               temb.astype(img_tokens.dtype))))
 
-    # coordinate ids: caption rides the frame axis starting at 1; the
-    # image grid's frame coordinate starts right after the caption
-    cap_coords = jnp.stack(
-        [jnp.arange(s_cap) + 1, jnp.zeros(s_cap, jnp.int32),
-         jnp.zeros(s_cap, jnp.int32)], axis=-1)
-    img_f = jnp.full((s_img,), s_cap + 1, jnp.int32)
-    img_r = jnp.arange(gh).repeat(gw)
-    img_c = jnp.tile(jnp.arange(gw), gh)
+    # per-item caption spans: real length -> rounded (cap_pad) span
+    if cap_mask is None:
+        real_len = jnp.full((b,), s_cap, jnp.int32)
+    else:
+        real_len = cap_mask.astype(jnp.int32).sum(axis=1)
+    span = jnp.minimum(-(-real_len // sm) * sm, s_cap)  # [B]
+    j = jnp.arange(s_cap)
+    in_span = j[None, :] < span[:, None]                # [B, S_cap]
+    cap_f = jnp.where(in_span, 1 + j[None, :], 0)
+    zeros_c = jnp.zeros((b, s_cap), jnp.int32)
+    cap_coords = jnp.stack([cap_f, zeros_c, zeros_c], axis=-1)
+
+    pad_img = (-s_img) % sm
+    img_f = jnp.broadcast_to((span + 1)[:, None], (b, s_img))
+    img_r = jnp.broadcast_to(jnp.arange(gh).repeat(gw)[None],
+                             (b, s_img))
+    img_c = jnp.broadcast_to(jnp.tile(jnp.arange(gw), gh)[None],
+                             (b, s_img))
     img_coords = jnp.stack([img_f, img_r, img_c], axis=-1)
+    if pad_img:
+        img_coords = jnp.concatenate(
+            [img_coords, jnp.zeros((b, pad_img, 3), img_coords.dtype)],
+            axis=1)
     cap_freqs = rope_angles(cfg, cap_coords)
     img_freqs = rope_angles(cfg, img_coords)
     uni_freqs = tuple(
-        jnp.concatenate([i, c], axis=0)
+        jnp.concatenate([i, c], axis=1)
         for i, c in zip(img_freqs, cap_freqs))
 
     x = nn.linear(params["x_embed"], img_tokens)
+    if pad_img:
+        x = jnp.concatenate(
+            [x, jnp.broadcast_to(
+                params["x_pad"][None].astype(x.dtype),
+                (b, pad_img, x.shape[-1]))], axis=1)
     for blk in params["noise_refiner"]:
         x = _block(blk, cfg, x, img_freqs, adaln)
 
     cap = nn.linear(params["cap_embed"],
                     rms_norm(cap_feats, params["cap_norm"]["w"],
                              cfg.norm_eps))
+    if cap_mask is not None:
+        is_real = cap_mask.astype(bool)
+        cap = jnp.where(is_real[..., None], cap,
+                        params["cap_pad"][None, :, :].astype(cap.dtype))
+        # batch padding beyond the item's rounded span: zero embeddings
+        cap = jnp.where(in_span[..., None], cap,
+                        jnp.zeros_like(cap))
     for blk in params["context_refiner"]:
         cap = _block(blk, cfg, cap, cap_freqs)
 
@@ -252,7 +314,7 @@ def forward(
     for blk in params["layers"]:
         u = _block(blk, cfg, u, uni_freqs, adaln, attn_fn=attn_fn)
 
-    # final layer over the image tokens
+    # final layer over the (un-padded) image tokens
     scale = 1.0 + nn.linear(params["final_adaln"], jax.nn.silu(adaln))
     out = nn.layernorm({}, u[:, :s_img]) * scale[:, None, :]
     return nn.linear(params["final_out"], out)
