@@ -1,0 +1,340 @@
+"""Flux2-Klein checkpoint-schema parity vs a torch oracle +
+from_pretrained e2e.
+
+Oracle transcribed from the reference class semantics
+(vllm_omni/diffusion/models/flux2_klein/flux2_klein_transformer.py):
+MODEL-LEVEL shared modulation (silu+linear, bias-free), bias-free
+blocks, gate-first SwiGLU FFs with fused input projections, single
+blocks with one fused qkv+mlp matmul, 4-axis interleaved rope (text
+(0,0,0,n), image (0,r,c,0)), AdaLayerNormContinuous output head, and
+the (c,dy,dx)->(dy,dx,c) packed-channel permutation the loader applies
+to x_embedder / proj_out.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.flux2_klein import loader as f2l  # noqa: E402
+from vllm_omni_tpu.models.flux2_klein import (  # noqa: E402
+    transformer as f2t,
+)
+
+DIT_JSON = {
+    "in_channels": 16,
+    "num_layers": 2,
+    "num_single_layers": 2,
+    "attention_head_dim": 32,
+    "num_attention_heads": 4,
+    "joint_attention_dim": 96,
+    "mlp_ratio": 3.0,
+    "axes_dims_rope": [8, 8, 8, 8],
+    "rope_theta": 2000,
+    "guidance_embeds": True,
+}
+CFG = f2l.dit_config_from_diffusers(DIT_JSON)
+D = CFG.inner_dim
+MLP = CFG.mlp_dim
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = (0.2 * g.standard_normal((o, i))).astype(
+            np.float32)
+
+    lin("x_embedder", CFG.in_channels, D)
+    lin("context_embedder", CFG.ctx_dim, D)
+    lin("time_guidance_embed.timestep_embedder.linear_1", 256, D)
+    lin("time_guidance_embed.timestep_embedder.linear_2", D, D)
+    lin("time_guidance_embed.guidance_embedder.linear_1", 256, D)
+    lin("time_guidance_embed.guidance_embedder.linear_2", D, D)
+    lin("double_stream_modulation_img.linear", D, 6 * D)
+    lin("double_stream_modulation_txt.linear", D, 6 * D)
+    lin("single_stream_modulation.linear", D, 3 * D)
+    lin("norm_out.linear", D, 2 * D)
+    lin("proj_out", D, CFG.out_channels)
+    for i in range(CFG.num_double_blocks):
+        b = f"transformer_blocks.{i}"
+        for pr in ("to_q", "to_k", "to_v", "add_q_proj", "add_k_proj",
+                   "add_v_proj"):
+            lin(f"{b}.attn.{pr}", D, D)
+        for nq in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(CFG.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.attn.to_out.0", D, D)
+        lin(f"{b}.attn.to_add_out", D, D)
+        lin(f"{b}.ff.linear_in", D, 2 * MLP)
+        lin(f"{b}.ff.linear_out", MLP, D)
+        lin(f"{b}.ff_context.linear_in", D, 2 * MLP)
+        lin(f"{b}.ff_context.linear_out", MLP, D)
+    for i in range(CFG.num_single_blocks):
+        b = f"single_transformer_blocks.{i}"
+        lin(f"{b}.attn.to_qkv_mlp_proj", D, 3 * D + 2 * MLP)
+        for nq in ("norm_q", "norm_k"):
+            sd[f"{b}.attn.{nq}.weight"] = (
+                1.0 + 0.1 * g.standard_normal(CFG.head_dim)).astype(
+                np.float32)
+        lin(f"{b}.attn.to_out", D + MLP, D)
+    d = tmp_path_factory.mktemp("flux2_ckpt")
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(DIT_JSON, f)
+    return str(d), {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+# ------------------------------------------------------------ torch oracle
+def _lin(sd, n, x):
+    return x @ sd[f"{n}.weight"].T
+
+
+def _ln(x):
+    return torch.nn.functional.layer_norm(x, (x.shape[-1],), eps=1e-6)
+
+
+def _rms(w, x):
+    v = x.float().pow(2).mean(-1, keepdim=True)
+    return (x.float() * torch.rsqrt(v + 1e-6) * w.float()).type_as(x)
+
+
+def _sinus(t, dim=256):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    return torch.cat([ang.cos(), ang.sin()], dim=-1)
+
+
+def _swiglu(x):
+    g, v = x.chunk(2, dim=-1)
+    return torch.nn.functional.silu(g) * v
+
+
+def _rope_tables(gh, gw, s_txt):
+    def ax(pos, dim):
+        half = dim // 2
+        inv = 1.0 / (CFG.theta ** (
+            torch.arange(half, dtype=torch.float32) / half))
+        return pos.float()[:, None] * inv[None, :]
+
+    n = gh * gw
+    r = torch.arange(gh).repeat_interleave(gw)
+    c = torch.arange(gw).repeat(gh)
+    z = torch.zeros(n)
+    img = torch.cat([ax(z, CFG.axes_dims[0]), ax(r, CFG.axes_dims[1]),
+                     ax(c, CFG.axes_dims[2]), ax(z, CFG.axes_dims[3])],
+                    dim=-1)
+    zt = torch.zeros(s_txt)
+    tn = torch.arange(s_txt).float()
+    txt = torch.cat([ax(zt, CFG.axes_dims[0]), ax(zt, CFG.axes_dims[1]),
+                     ax(zt, CFG.axes_dims[2]), ax(tn, CFG.axes_dims[3])],
+                    dim=-1)
+    ang = torch.cat([txt, img], dim=0)
+    return ang.cos(), ang.sin()
+
+
+def _rope(x, cos, sin):
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = torch.stack([x1 * c - x2 * s, x1 * s + x2 * c], dim=-1)
+    return out.reshape(x.shape)
+
+
+def _attn(q, k, v):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def _heads(x):
+    b, s, _ = x.shape
+    return x.reshape(b, s, CFG.num_heads, CFG.head_dim)
+
+
+def oracle(sd, img_ref_order, txt, t, guidance, gh, gw):
+    """``img_ref_order``: packed tokens in the reference's (c, dy, dx)
+    feature order."""
+    b = img_ref_order.shape[0]
+    silu = torch.nn.functional.silu
+    img = _lin(sd, "x_embedder", img_ref_order)
+    txt = _lin(sd, "context_embedder", txt)
+    temb = _lin(sd, "time_guidance_embed.timestep_embedder.linear_2",
+                silu(_lin(sd, "time_guidance_embed.timestep_embedder"
+                              ".linear_1", _sinus(t))))
+    temb = temb + _lin(
+        sd, "time_guidance_embed.guidance_embedder.linear_2",
+        silu(_lin(sd, "time_guidance_embed.guidance_embedder.linear_1",
+                  _sinus(guidance * 1000.0))))
+
+    def mods(name, n_sets):
+        m = _lin(sd, f"{name}.linear", silu(temb)).unsqueeze(1)
+        ch = m.chunk(3 * n_sets, dim=-1)
+        return [ch[3 * i:3 * (i + 1)] for i in range(n_sets)]
+
+    mi = mods("double_stream_modulation_img", 2)
+    mt = mods("double_stream_modulation_txt", 2)
+    (ms,) = mods("single_stream_modulation", 1)
+    s_txt = txt.shape[1]
+    cos, sin = _rope_tables(gh, gw, s_txt)
+
+    for i in range(CFG.num_double_blocks):
+        bn = f"transformer_blocks.{i}"
+        (sh, sc, gt), (sh2, sc2, gt2) = mi
+        (csh, csc, cgt), (csh2, csc2, cgt2) = mt
+        img_n = (1 + sc) * _ln(img) + sh
+        txt_n = (1 + csc) * _ln(txt) + csh
+        q = _rms(sd[f"{bn}.attn.norm_q.weight"],
+                 _heads(_lin(sd, f"{bn}.attn.to_q", img_n)))
+        k = _rms(sd[f"{bn}.attn.norm_k.weight"],
+                 _heads(_lin(sd, f"{bn}.attn.to_k", img_n)))
+        v = _heads(_lin(sd, f"{bn}.attn.to_v", img_n))
+        qt = _rms(sd[f"{bn}.attn.norm_added_q.weight"],
+                  _heads(_lin(sd, f"{bn}.attn.add_q_proj", txt_n)))
+        kt = _rms(sd[f"{bn}.attn.norm_added_k.weight"],
+                  _heads(_lin(sd, f"{bn}.attn.add_k_proj", txt_n)))
+        vt = _heads(_lin(sd, f"{bn}.attn.add_v_proj", txt_n))
+        q = _rope(torch.cat([qt, q], dim=1), cos, sin)
+        k = _rope(torch.cat([kt, k], dim=1), cos, sin)
+        o = _attn(q, k, torch.cat([vt, v], dim=1))
+        o = o.reshape(b, o.shape[1], -1)
+        txt_o, img_o = o[:, :s_txt], o[:, s_txt:]
+        img = img + gt * _lin(sd, f"{bn}.attn.to_out.0", img_o)
+        txt = txt + cgt * _lin(sd, f"{bn}.attn.to_add_out", txt_o)
+        img_n2 = (1 + sc2) * _ln(img) + sh2
+        img = img + gt2 * _lin(sd, f"{bn}.ff.linear_out",
+                               _swiglu(_lin(sd, f"{bn}.ff.linear_in",
+                                            img_n2)))
+        txt_n2 = (1 + csc2) * _ln(txt) + csh2
+        txt = txt + cgt2 * _lin(
+            sd, f"{bn}.ff_context.linear_out",
+            _swiglu(_lin(sd, f"{bn}.ff_context.linear_in", txt_n2)))
+
+    x = torch.cat([txt, img], dim=1)
+    (sh, sc, gt) = ms
+    for i in range(CFG.num_single_blocks):
+        bn = f"single_transformer_blocks.{i}"
+        x_n = (1 + sc) * _ln(x) + sh
+        fused = _lin(sd, f"{bn}.attn.to_qkv_mlp_proj", x_n)
+        qkv, mlp_h = fused[..., :3 * D], fused[..., 3 * D:]
+        q, k, v = qkv.chunk(3, dim=-1)
+        q = _rope(_rms(sd[f"{bn}.attn.norm_q.weight"], _heads(q)),
+                  cos, sin)
+        k = _rope(_rms(sd[f"{bn}.attn.norm_k.weight"], _heads(k)),
+                  cos, sin)
+        o = _attn(q, k, _heads(v)).reshape(b, x.shape[1], -1)
+        x = x + gt * _lin(sd, f"{bn}.attn.to_out",
+                          torch.cat([o, _swiglu(mlp_h)], dim=-1))
+    img = x[:, s_txt:]
+    sc, sh = _lin(sd, "norm_out.linear", silu(temb)).chunk(2, dim=-1)
+    img = _ln(img) * (1 + sc[:, None]) + sh[:, None]
+    return _lin(sd, "proj_out", img)
+
+
+def test_flux2_klein_ckpt_parity(checkpoint):
+    d, sd = checkpoint
+    params, cfg = f2l.load_flux2_dit(d, dtype=jnp.float32)
+    assert cfg.rope_interleaved and cfg.num_heads == 4
+    g = np.random.default_rng(1)
+    gh = gw = 2
+    img_ours = g.standard_normal((1, gh * gw, CFG.in_channels)).astype(
+        np.float32)
+    # reorder token features (dy,dx,c) -> reference (c,dy,dx)
+    perm = f2l._chan_perm(CFG.in_channels)
+    inv = np.argsort(perm)
+    img_ref = img_ours[..., inv]
+    txt = g.standard_normal((1, 5, CFG.ctx_dim)).astype(np.float32)
+    t = np.asarray([500.0], np.float32)
+    gsc = np.asarray([4.0], np.float32)
+    with torch.no_grad():
+        want = oracle(sd, torch.from_numpy(img_ref),
+                      torch.from_numpy(txt), torch.from_numpy(t),
+                      torch.from_numpy(gsc), gh, gw).numpy()
+    # oracle output features are (c,dy,dx); ours (dy,dx,c)
+    want = want[..., perm]
+    got = np.asarray(f2t.forward(
+        params, cfg, jnp.asarray(img_ours), jnp.asarray(txt),
+        jnp.asarray(t), (gh, gw), guidance=jnp.asarray(gsc)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)
+
+
+# ------------------------------------------------------- from_pretrained
+@pytest.fixture(scope="module")
+def flux2_root(tmp_path_factory, checkpoint):
+    import shutil
+
+    from transformers import Qwen3Config, Qwen3Model
+
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+    from tests.model_loader.test_image_vae_parity import (
+        TINY as VAE_JSON,
+        make_vae_state_dict,
+        write_vae_dir,
+    )
+
+    d, _ = checkpoint
+    root = tmp_path_factory.mktemp("flux2_root")
+    shutil.copytree(d, root / "transformer")
+    torch.manual_seed(0)
+    # ctx 96 = 3 stacked layers x hidden 32
+    te = Qwen3Model(Qwen3Config(
+        vocab_size=256, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=512)).eval()
+    te.save_pretrained(str(root / "text_encoder"),
+                       safe_serialization=True)
+    _write_byte_level_tokenizer(root / "tokenizer")
+    write_vae_dir(str(root / "vae"), VAE_JSON,
+                  make_vae_state_dict(VAE_JSON, seed=7,
+                                      halves=("decoder",)))
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "FlowMatchEulerDiscreteScheduler"}))
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "Flux2KleinPipeline",
+        "transformer": ["diffusers", "Flux2Transformer2DModel"],
+        "text_encoder": ["transformers", "Qwen3Model"],
+        "vae": ["diffusers", "AutoencoderKLFlux2"],
+    }))
+    return root
+
+
+def test_flux2_klein_from_pretrained_generates(flux2_root):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.flux2_klein.pipeline import (
+        Flux2KleinPipeline,
+    )
+
+    pipe = Flux2KleinPipeline.from_pretrained(
+        str(flux2_root), dtype=jnp.float32, max_text_len=32)
+    assert pipe.cfg.text_out_layers == (1, 2, 3)
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=3.0,
+        seed=0)
+    a = pipe.forward(OmniDiffusionRequest(
+        prompt=["a red ball"], sampling_params=sp,
+        request_ids=["r0"]))[0].data
+    b = pipe.forward(OmniDiffusionRequest(
+        prompt=["a blue cube"], sampling_params=sp,
+        request_ids=["r1"]))[0].data
+    assert a.dtype == np.uint8 and a.shape == (16, 16, 3)
+    assert not np.array_equal(a, b)

@@ -146,10 +146,11 @@ def _angles(ids):
     return torch.cat(parts, dim=-1)
 
 
-def _rope(x, ang):
-    # RotaryEmbedding(is_neox_style=False): interleaved pairing
-    c = ang.cos()[:, :, None, :]
-    s = ang.sin()[:, :, None, :]
+def _rope(x, cs):
+    # RotaryEmbedding(is_neox_style=False): interleaved pairing;
+    # cs = (cos, sin) tables [B, S, D//2] (zeroed beyond caption spans)
+    c = cs[0][:, :, None, :]
+    s = cs[1][:, :, None, :]
     x1 = x[..., 0::2]
     x2 = x[..., 1::2]
     out = torch.stack([x1 * c - x2 * s, x1 * s + x2 * c], dim=-1)
@@ -232,15 +233,19 @@ def oracle(sd, img_tokens, cap_feats, t, gh, gw, cap_mask=None):
         img_ids = torch.cat(
             [img_ids, torch.zeros(b, pad_img, 3)], dim=1)
     cap_ang = _angles(cap_ids)
+    cap_cs = (cap_ang.cos() * in_span[..., None],
+              cap_ang.sin() * in_span[..., None])
     img_ang = _angles(img_ids)
-    uni_ang = torch.cat([img_ang, cap_ang], dim=1)
+    img_cs = (img_ang.cos(), img_ang.sin())
+    uni_cs = (torch.cat([img_cs[0], cap_cs[0]], dim=1),
+              torch.cat([img_cs[1], cap_cs[1]], dim=1))
 
     x = _lin(sd, "all_x_embedder.2-1", img_tokens)
     if pad_img:
         x = torch.cat(
             [x, sd["x_pad_token"][None].expand(b, pad_img, -1)], dim=1)
     for i in range(CFG.num_refiner_layers):
-        x = _block(sd, f"noise_refiner.{i}", x, img_ang, adaln)
+        x = _block(sd, f"noise_refiner.{i}", x, img_cs, adaln)
 
     cap = _lin(sd, "cap_embedder.1",
                _rms(sd, "cap_embedder.0", cap_feats, 1e-5))
@@ -250,11 +255,11 @@ def oracle(sd, img_tokens, cap_feats, t, gh, gw, cap_mask=None):
         cap = torch.where(in_span[..., None], cap,
                           torch.zeros_like(cap))
     for i in range(CFG.num_refiner_layers):
-        cap = _block(sd, f"context_refiner.{i}", cap, cap_ang, None)
+        cap = _block(sd, f"context_refiner.{i}", cap, cap_cs, None)
 
     u = torch.cat([x, cap], dim=1)
     for i in range(CFG.num_layers):
-        u = _block(sd, f"layers.{i}", u, uni_ang, adaln)
+        u = _block(sd, f"layers.{i}", u, uni_cs, adaln)
 
     scale = 1.0 + _lin(sd, "all_final_layer.2-1.adaLN_modulation.1",
                        torch.nn.functional.silu(adaln))

@@ -40,7 +40,7 @@ def test_ovis_generates_plain_cfg():
     assert not real.dit.guidance_embed and real.dit.pooled_dim == 0
 
 
-def test_flux2_klein_generates_embedded_guidance():
+def test_flux2_klein_generates_true_cfg():
     from vllm_omni_tpu.models.flux2_klein.pipeline import (
         Flux2KleinPipeline,
         Flux2KleinPipelineConfig,
@@ -50,10 +50,14 @@ def test_flux2_klein_generates_embedded_guidance():
                               dtype=jnp.float32, seed=0)
     out = pipe.forward(_req(guidance_scale=3.5))[0].data
     assert out.shape == (32, 32, 3)
+    # the REAL geometry (reference flux2_klein_transformer.py:572-576):
+    # 48 heads, joint width = 3 stacked Qwen3 hidden layers
     real = Flux2KleinPipelineConfig()
     assert (real.dit.num_double_blocks,
             real.dit.num_single_blocks) == (8, 48)
-    assert real.dit.guidance_embed
+    assert real.dit.num_heads == 48
+    assert real.dit.ctx_dim == 15360
+    assert real.dit.in_channels == 128
 
 
 def test_layered_generates_composite_plus_layers():

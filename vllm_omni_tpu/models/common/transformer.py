@@ -341,6 +341,7 @@ def forward_hidden(
     attn_mask: Optional[jax.Array] = None,  # [B, S] 1=attendable key
     drop_last_layers: int = 0,
     apply_final_norm: bool = True,
+    collect_hidden_layers: tuple = (),
 ) -> jax.Array:
     """Full-sequence causal forward returning final hidden states
     [B, S, hidden] (the text-encoder path; also prefill without cache).
@@ -353,6 +354,12 @@ def forward_hidden(
     ``drop_last_layers=1, apply_final_norm=False`` yields the HF
     ``output_hidden_states[-2]`` convention (the penultimate layer's
     raw output) that Z-Image conditions on (pipeline_z_image.py:261-266).
+
+    ``collect_hidden_layers``: HF hidden_states indices (0 = embeddings,
+    k = after layer k) to gather and concatenate on the feature axis —
+    the Flux2-Klein text conditioning stacks Qwen3 layers (9, 18, 27)
+    (pipeline_flux2_klein.py:247-302).  When set, the concatenation is
+    returned instead of the final hidden states.
     """
     b, s = token_ids.shape
     x = _embed_input(params, token_ids, inputs_embeds, None)
@@ -373,8 +380,19 @@ def forward_hidden(
     layers = params["layers"]
     if drop_last_layers:
         layers = layers[:len(layers) - drop_last_layers]
-    for layer in layers:
+    collected = {0: x} if 0 in collect_hidden_layers else {}
+    for li, layer in enumerate(layers):
         x = _layer_step(layer, cfg, x, cos, sin, attend)
+        if li + 1 in collect_hidden_layers:
+            collected[li + 1] = x
+    if collect_hidden_layers:
+        missing = [k for k in collect_hidden_layers if k not in collected]
+        if missing:
+            raise ValueError(
+                f"collect_hidden_layers {missing} out of range for "
+                f"{len(layers)} layers")
+        return jnp.concatenate(
+            [collected[k] for k in collect_hidden_layers], axis=-1)
     if not apply_final_norm:
         return x
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)

@@ -1,0 +1,168 @@
+"""Diffusers-format Flux2-Klein transformer loader.
+
+Checkpoint names per the reference module tree
+(flux2_klein_transformer.py:556-650): model-level modulation linears,
+``time_guidance_embed.{timestep,guidance}_embedder.linear_{1,2}``
+(bias-free), double blocks with separate to_q/to_k/to_v (+add_*) fused
+here into qkv matmuls, fused ``ff.linear_in`` ([gate; value] SwiGLU),
+single blocks with the pre-fused ``attn.to_qkv_mlp_proj`` (some
+checkpoints name it ``to_qkvkv_mlp_proj``) and a bare ``attn.to_out``.
+
+Channel-order shim: the reference packs latents (c, dy, dx) while this
+repo's pipelines pack (dy, dx, c) — x_in input rows, proj_out output
+columns, and the VAE bn latent stats permute accordingly at load time
+(zero runtime cost).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.flux.loader import load_routed
+from vllm_omni_tpu.models.flux2_klein.transformer import (
+    Flux2KleinDiTConfig,
+    init_params,
+)
+
+
+def dit_config_from_diffusers(d: dict) -> Flux2KleinDiTConfig:
+    in_ch = d.get("in_channels", 128)
+    return Flux2KleinDiTConfig(
+        in_channels=in_ch,
+        out_channels=d.get("out_channels") or in_ch,
+        patch_size=d.get("patch_size", 1),
+        num_double_blocks=d.get("num_layers", 8),
+        num_single_blocks=d.get("num_single_layers", 48),
+        num_heads=d.get("num_attention_heads", 48),
+        head_dim=d.get("attention_head_dim", 128),
+        ctx_dim=d.get("joint_attention_dim", 15360),
+        axes_dims=tuple(d.get("axes_dims_rope", (32, 32, 32, 32))),
+        theta=d.get("rope_theta", 2000),
+        mlp_ratio=d.get("mlp_ratio", 3.0),
+        guidance_embed=d.get("guidance_embeds", True),
+        rope_interleaved=True,
+    )
+
+
+def _routing(cfg: Flux2KleinDiTConfig) -> dict:
+    r: dict[str, tuple] = {}
+
+    def lin(hf, *path):
+        # every Flux2 linear is bias-free
+        r[f"{hf}.weight"] = ("direct", path + ("w",))
+
+    def fuse(names, *path):
+        for s, n in enumerate(names):
+            r[f"{n}.weight"] = ("fuse", path + ("w",), s, len(names))
+
+    lin("x_embedder", "x_in")
+    lin("context_embedder", "ctx_in")
+    lin("time_guidance_embed.timestep_embedder.linear_1", "time_in1")
+    lin("time_guidance_embed.timestep_embedder.linear_2", "time_in2")
+    if cfg.guidance_embed:
+        lin("time_guidance_embed.guidance_embedder.linear_1",
+            "guidance_in1")
+        lin("time_guidance_embed.guidance_embedder.linear_2",
+            "guidance_in2")
+    lin("double_stream_modulation_img.linear", "mod_img")
+    lin("double_stream_modulation_txt.linear", "mod_txt")
+    lin("single_stream_modulation.linear", "mod_single")
+    lin("norm_out.linear", "norm_out_mod")
+    lin("proj_out", "proj_out")
+    for i in range(cfg.num_double_blocks):
+        b = f"transformer_blocks.{i}"
+        t = ("double", i)
+        fuse([f"{b}.attn.to_q", f"{b}.attn.to_k", f"{b}.attn.to_v"],
+             *t, "img_qkv")
+        fuse([f"{b}.attn.add_q_proj", f"{b}.attn.add_k_proj",
+              f"{b}.attn.add_v_proj"], *t, "txt_qkv")
+        for hf, ours in (("norm_q", "img_norm_q"),
+                         ("norm_k", "img_norm_k"),
+                         ("norm_added_q", "txt_norm_q"),
+                         ("norm_added_k", "txt_norm_k")):
+            r[f"{b}.attn.{hf}.weight"] = ("direct", t + (ours, "w"))
+        lin(f"{b}.attn.to_out.0", *t, "img_out")
+        lin(f"{b}.attn.to_add_out", *t, "txt_out")
+        lin(f"{b}.ff.linear_in", *t, "img_ff1")
+        lin(f"{b}.ff.linear_out", *t, "img_ff2")
+        lin(f"{b}.ff_context.linear_in", *t, "txt_ff1")
+        lin(f"{b}.ff_context.linear_out", *t, "txt_ff2")
+    for i in range(cfg.num_single_blocks):
+        b = f"single_transformer_blocks.{i}"
+        t = ("single", i)
+        # both published spellings route to the same fused leaf
+        r[f"{b}.attn.to_qkv_mlp_proj.weight"] = (
+            "direct", t + ("fused", "w"))
+        r[f"{b}.attn.to_qkvkv_mlp_proj.weight"] = (
+            "direct", t + ("fused", "w"))
+        r[f"{b}.attn.norm_q.weight"] = ("direct", t + ("norm_q", "w"))
+        r[f"{b}.attn.norm_k.weight"] = ("direct", t + ("norm_k", "w"))
+        lin(f"{b}.attn.to_out", *t, "out")
+    return r
+
+
+def _chan_perm(in_channels: int, pack: int = 2) -> np.ndarray:
+    """Index permutation from the reference's (c, dy, dx) packed order
+    to this repo's (dy, dx, c)."""
+    c = in_channels // (pack * pack)
+    idx = np.arange(in_channels).reshape(c, pack, pack)
+    return idx.transpose(1, 2, 0).reshape(-1)
+
+
+def load_flux2_dit(model_dir: str, cfg: Flux2KleinDiTConfig = None,
+                   dtype=jnp.bfloat16):
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = dit_config_from_diffusers(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    perm_in = _chan_perm(cfg.in_channels)
+    perm_out = _chan_perm(cfg.out_channels)
+
+    def x_in_t(arr):
+        # HF [inner, in] -> [in, inner] with rows permuted to (dy,dx,c)
+        return np.ascontiguousarray(arr.T[perm_in])
+
+    def proj_out_t(arr):
+        # HF [out, inner] -> [inner, out] with cols permuted
+        return np.ascontiguousarray(arr.T[:, perm_out])
+
+    tree = load_routed(
+        model_dir, _routing(cfg), shapes, dtype,
+        transforms={"x_embedder.weight": x_in_t,
+                    "proj_out.weight": proj_out_t})
+    return tree, cfg
+
+
+def load_latent_bn(vae_dir: str, pack: int = 2):
+    """(mean, std) over packed latent channels in this repo's
+    (dy, dx, c) token order, or None when the VAE ships no bn stats
+    (reference: AutoencoderKLFlux2 bn running stats,
+    pipeline_flux2_klein.py:977-984)."""
+    from safetensors import safe_open
+
+    mean = var = eps = None
+    for fn in sorted(os.listdir(vae_dir)):
+        if not fn.endswith(".safetensors"):
+            continue
+        with safe_open(os.path.join(vae_dir, fn), "np") as f:
+            keys = set(f.keys())
+            if "bn.running_mean" in keys:
+                mean = f.get_tensor("bn.running_mean")
+                var = f.get_tensor("bn.running_var")
+    if mean is None:
+        return None
+    cfg_path = os.path.join(vae_dir, "config.json")
+    eps = 1e-4
+    if os.path.isfile(cfg_path):
+        with open(cfg_path) as f:
+            eps = json.load(f).get("batch_norm_eps", 1e-4)
+    perm = _chan_perm(mean.shape[0], pack)
+    std = np.sqrt(var + eps)
+    return (jnp.asarray(mean[perm], jnp.float32),
+            jnp.asarray(std[perm], jnp.float32))

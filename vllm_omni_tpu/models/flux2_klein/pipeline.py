@@ -1,49 +1,322 @@
 """Flux2-Klein text->image pipeline.
 
-Reference: vllm_omni/diffusion/models/flux2_klein/ — the Flux-2
-architecture (8 double + 48 single stream blocks,
-flux2_klein_transformer.py:572-576) with an embedded guidance scale;
-the step-distilled "Klein" variant ignores classifier-free guidance at
-sampling time (pipeline_flux2_klein.py:621-622).  Reuses the shared
-Flux MMDiT implementation at the Flux-2 geometry (the reference's
-joint_attention_dim 15360 is the concatenated multi-encoder width; the
-text-encoder hidden size stands in for it here — re-map at real-weight
-time)."""
+Reference: vllm_omni/diffusion/models/flux2_klein/ — the TRUE Flux-2
+architecture (models/flux2_klein/transformer.py: 8 double + 48 single
+blocks, 48 heads x 128, shared model-level modulation, bias-free
+linears, 4-axis rope) conditioned on THREE stacked Qwen3 hidden layers
+(default (9, 18, 27) -> joint width 3 x hidden = 15360 for the real
+Qwen3-8B encoder; pipeline_flux2_klein.py:247-302).  The Klein variant
+runs true classifier-free guidance with no embedded guidance at
+inference (guidance=None, :927-947); latents live in the VAE's
+batch-norm-normalized space and are unnormalized with the bn running
+stats before decode (:977-990).
+"""
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from vllm_omni_tpu.models.common.transformer import TransformerConfig
-from vllm_omni_tpu.models.flux.pipeline import (
-    FluxPipeline,
-    FluxPipelineConfig,
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.diffusion import cache as step_cache
+from vllm_omni_tpu.diffusion import scheduler as fm
+from vllm_omni_tpu.diffusion.request import (
+    DiffusionOutput,
+    InvalidRequestError,
+    OmniDiffusionRequest,
 )
-from vllm_omni_tpu.models.flux.transformer import FluxDiTConfig
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common.transformer import (
+    TransformerConfig,
+    forward_hidden,
+    init_params as init_text_params,
+)
+from vllm_omni_tpu.models.flux2_klein import transformer as f2dit
+from vllm_omni_tpu.models.flux2_klein.transformer import (
+    Flux2KleinDiTConfig,
+)
+from vllm_omni_tpu.models.qwen_image import vae as vae_mod
 from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+from vllm_omni_tpu.utils.tokenizer import ByteTokenizer
+
+logger = init_logger(__name__)
 
 
-def _klein_dit() -> FluxDiTConfig:
-    return FluxDiTConfig(
-        num_double_blocks=8, num_single_blocks=48, num_heads=24,
-        head_dim=128, ctx_dim=4096, guidance_embed=True,
-    )
+def compute_empirical_mu(image_seq_len: int, num_steps: int) -> float:
+    """Flux2's empirically fitted schedule shift (reference
+    compute_empirical_mu, pipeline_flux2_klein.py:164-179) — NOT the
+    Flux-1 linear calculate_shift."""
+    a1, b1 = 8.73809524e-05, 1.89833333
+    a2, b2 = 0.00016927, 0.45666666
+    if image_seq_len > 4300:
+        return float(a2 * image_seq_len + b2)
+    m_200 = a2 * image_seq_len + b2
+    m_10 = a1 * image_seq_len + b1
+    a = (m_200 - m_10) / 190.0
+    b = m_200 - 200.0 * a
+    return float(a * num_steps + b)
 
 
 @dataclass(frozen=True)
-class Flux2KleinPipelineConfig(FluxPipelineConfig):
-    dit: FluxDiTConfig = field(default_factory=_klein_dit)
+class Flux2KleinPipelineConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    dit: Flux2KleinDiTConfig = field(
+        default_factory=Flux2KleinDiTConfig)
+    vae: VAEConfig = field(default_factory=VAEConfig)
+    # HF hidden_states indices stacked into the DiT context width
+    # (len(text_out_layers) * text hidden == dit.ctx_dim)
+    text_out_layers: tuple = (9, 18, 27)
+    max_text_len: int = 512
+    scheduler: str = "euler"
+    pack: int = 2
 
     @staticmethod
     def tiny() -> "Flux2KleinPipelineConfig":
+        # 2 stacked layers x hidden 64 = dit ctx 128
         return Flux2KleinPipelineConfig(
             text=TransformerConfig.tiny(vocab_size=256),
-            dit=FluxDiTConfig.tiny(),
+            dit=Flux2KleinDiTConfig.tiny(),
             vae=VAEConfig.tiny(),
+            text_out_layers=(1, 2),
+            max_text_len=32,
         )
 
 
-class Flux2KleinPipeline(FluxPipeline):
-    """Text -> image (distilled: embedded guidance, no CFG batch)."""
+class Flux2KleinPipeline:
+    """Text -> image (true CFG; latents in bn-normalized space)."""
 
-    config_cls = Flux2KleinPipelineConfig
+    output_type = "image"
+
+    @property
+    def geometry_multiple(self) -> int:
+        return self.cfg.vae.spatial_ratio * self.cfg.pack
+
+    def __init__(self, config: Flux2KleinPipelineConfig,
+                 dtype=jnp.bfloat16, seed: int = 0, mesh=None,
+                 cache_config=None, init_weights: bool = True):
+        from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
+
+        self.cfg = config
+        self.dtype = dtype
+        self.mesh = mesh
+        self.cache_config = cache_config
+        self.wiring = MeshWiring(mesh, type(self).__name__).validate(
+            {"dp", "cfg"})
+        want_ctx = len(config.text_out_layers) * config.text.hidden_size
+        if want_ctx != config.dit.ctx_dim:
+            raise ValueError(
+                f"dit ctx_dim {config.dit.ctx_dim} != "
+                f"{len(config.text_out_layers)} stacked text layers x "
+                f"hidden {config.text.hidden_size}")
+        want_in = config.vae.latent_channels * config.pack ** 2
+        if config.dit.in_channels != want_in:
+            raise ValueError(
+                f"dit.in_channels must be latent*pack^2 = {want_in}")
+        self.tokenizer = ByteTokenizer(config.text.vocab_size)
+        self.hf_tokenizer = None
+        # bn running stats over the PACKED latent channels ((mean, std)
+        # in token-feature order); identity when absent
+        self.latent_bn = None
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        logger.info("Initializing %s (dtype=%s)", type(self).__name__,
+                    dtype)
+        if init_weights:
+            self.text_params = self.wiring.place(
+                init_text_params(k1, config.text, dtype))
+            self.dit_params = self.wiring.place(
+                f2dit.init_params(k2, config.dit, dtype))
+            self.vae_params = self.wiring.place(
+                vae_mod.init_decoder(k3, config.vae, dtype))
+        else:
+            self.text_params = self.dit_params = self.vae_params = None
+        self._denoise_cache: dict = {}
+        self._text_encode_jit = jax.jit(
+            lambda p, i, m: forward_hidden(
+                p, self.cfg.text, i, attn_mask=m,
+                collect_hidden_layers=self.cfg.text_out_layers))
+        self._vae_decode_jit = jax.jit(
+            lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
+
+    # ------------------------------------------------------------- encode
+    def encode_prompt(self, prompts: list[str]):
+        if self.hf_tokenizer is not None:
+            texts = []
+            for p in prompts:
+                msg = [{"role": "user", "content": p}]
+                try:
+                    texts.append(self.hf_tokenizer.apply_chat_template(
+                        msg, tokenize=False, add_generation_prompt=True,
+                        enable_thinking=False))
+                except Exception:
+                    texts.append(
+                        f"<|im_start|>user\n{p}<|im_end|>\n"
+                        "<|im_start|>assistant\n<think>\n\n</think>\n\n")
+            self.hf_tokenizer.padding_side = "right"
+            enc = self.hf_tokenizer(
+                texts, padding="max_length", truncation=True,
+                max_length=self.cfg.max_text_len)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            # the LM runs with the pad attention mask (reference
+            # :287-292); its output keeps EVERY position and the DiT
+            # attends them all — pad rows differ without the mask
+            mask = jnp.asarray(
+                np.asarray(enc["attention_mask"], np.int32))
+        else:
+            ids, lens = self.tokenizer.batch_encode(
+                prompts, self.cfg.max_text_len)
+            mask = jnp.asarray(
+                (np.arange(self.cfg.max_text_len)[None, :]
+                 < lens[:, None]).astype(np.int32))
+        hidden = self._text_encode_jit(self.text_params,
+                                       jnp.asarray(ids), mask)
+        return hidden.astype(self.dtype)
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = 512):
+        """Build from a diffusers-format Flux2-Klein checkpoint
+        (transformer/ + Qwen3 text_encoder/ + tokenizer/ + vae/ with
+        optional bn latent stats + scheduler/)."""
+        import json
+        import os
+
+        from transformers import AutoTokenizer
+
+        from vllm_omni_tpu.model_loader import diffusers_loader as dl
+        from vllm_omni_tpu.models.flux2_klein import loader as f2loader
+
+        dl.load_model_index(model_dir)
+        tdir = os.path.join(model_dir, "transformer")
+        dit_params, dit_cfg = f2loader.load_flux2_dit(tdir, dtype=dtype)
+        text_params, text_cfg = dl.load_text_encoder(
+            os.path.join(model_dir, "text_encoder"), dtype=dtype)
+        n_stack = dit_cfg.ctx_dim // text_cfg.hidden_size
+        if n_stack * text_cfg.hidden_size != dit_cfg.ctx_dim:
+            raise ValueError(
+                f"text hidden {text_cfg.hidden_size} does not divide "
+                f"dit ctx_dim {dit_cfg.ctx_dim}")
+        # evenly spaced interior layers, matching the reference's
+        # (9, 18, 27) for 36-layer Qwen3-8B
+        step = text_cfg.num_layers // (n_stack + 1)
+        out_layers = tuple(
+            step * (i + 1) for i in range(n_stack)) if step else tuple(
+            range(1, n_stack + 1))
+        vae_dir = os.path.join(model_dir, "vae")
+        vae_tree, vae_cfg = dl.load_image_vae(vae_dir, dtype=dtype,
+                                              decoder=True)
+        config = Flux2KleinPipelineConfig(
+            text=text_cfg, dit=dit_cfg, vae=vae_cfg,
+            text_out_layers=out_layers, max_text_len=max_text_len)
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+        pipe.dit_params = pipe.wiring.place(dit_params)
+        pipe.text_params = pipe.wiring.place(text_params)
+        pipe.vae_params = pipe.wiring.place(vae_tree["decoder"])
+        pipe.latent_bn = f2loader.load_latent_bn(vae_dir)
+        pipe.hf_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer"))
+        return pipe
+
+    # ------------------------------------------------------------ denoise
+    def _denoise_fn(self, grid_h, grid_w, sched_len):
+        key = (grid_h, grid_w, sched_len)
+        if key in self._denoise_cache:
+            return self._denoise_cache[key]
+        cfg = self.cfg
+        wiring = self.wiring
+        cache_cfg = self.cache_config
+
+        @jax.jit
+        def run(dit_params, latents, ctx, neg_ctx, sigmas, timesteps,
+                gscale, num_steps):
+            schedule = fm.FlowMatchSchedule(sigmas=sigmas,
+                                            timesteps=timesteps)
+            do_cfg = neg_ctx is not None
+            ctx_all = (jnp.concatenate([ctx, neg_ctx], 0)
+                       if do_cfg else ctx)
+
+            def eval_velocity(lat, i):
+                t = jnp.broadcast_to(timesteps[i], (lat.shape[0],))
+                lat_in = (jnp.concatenate([lat, lat], 0)
+                          if do_cfg else lat)
+                lat_in = wiring.constrain(lat_in)
+                t_in = jnp.concatenate([t, t], 0) if do_cfg else t
+                v = f2dit.forward(
+                    dit_params, cfg.dit, lat_in, ctx_all, t_in,
+                    (grid_h, grid_w),
+                )
+                if do_cfg:
+                    v_pos, v_neg = jnp.split(v, 2, axis=0)
+                    v = v_neg + gscale * (v_pos - v_neg)
+                return v
+
+            return step_cache.run_denoise_loop(
+                cache_cfg, schedule, eval_velocity, latents, num_steps,
+                solver=cfg.scheduler)
+
+        self._denoise_cache[key] = run
+        return run
+
+    # ------------------------------------------------------------ forward
+    def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
+        sp = req.sampling_params
+        cfg = self.cfg
+        mult = self.geometry_multiple
+        if sp.height % mult or sp.width % mult:
+            raise InvalidRequestError(
+                f"height/width must be multiples of {mult}")
+        if sp.num_inference_steps < 1:
+            raise InvalidRequestError("num_inference_steps must be >= 1")
+        lat_h = sp.height // cfg.vae.spatial_ratio
+        lat_w = sp.width // cfg.vae.spatial_ratio
+        gh, gw = lat_h // cfg.pack, lat_w // cfg.pack
+        prompts = req.prompt
+        b = len(prompts)
+
+        ctx = self.encode_prompt(prompts)
+        do_cfg = sp.guidance_scale > 1.0
+        neg_ctx = (self.encode_prompt([sp.negative_prompt] * b)
+                   if do_cfg else None)
+        seed = (sp.seed if sp.seed is not None
+                else int(np.random.randint(0, 2 ** 31 - 1)))
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (b, gh * gw, cfg.dit.in_channels), jnp.float32,
+        ).astype(self.dtype)
+        num_steps = sp.num_inference_steps
+        mu = compute_empirical_mu(gh * gw, num_steps)
+        schedule = fm.make_schedule(num_steps, use_dynamic_shifting=True,
+                                    mu=mu)
+        sched_len = max(8, 1 << (num_steps - 1).bit_length())
+        sigmas = jnp.zeros((sched_len + 1,)).at[: num_steps + 1].set(
+            schedule.sigmas)
+        timesteps = jnp.zeros((sched_len,)).at[:num_steps].set(
+            schedule.timesteps)
+        run = self._denoise_fn(gh, gw, sched_len)
+        latents, skipped = run(
+            self.dit_params, noise, ctx, neg_ctx, sigmas, timesteps,
+            jnp.float32(sp.guidance_scale), jnp.int32(num_steps))
+        self.last_skipped_steps = int(skipped)
+
+        if self.latent_bn is not None:
+            # latents live in bn-normalized space; unnormalize over the
+            # packed channels before decode (pipeline_flux2_klein.py:977)
+            mean, std = self.latent_bn
+            latents = latents * std + mean
+        c = cfg.vae.latent_channels
+        p = cfg.pack
+        lat = latents.reshape(b, gh, gw, p, p, c).transpose(
+            0, 1, 3, 2, 4, 5)
+        lat = lat.reshape(b, lat_h, lat_w, c)
+        imgs = np.asarray(self._vae_decode_jit(
+            self.vae_params, lat.astype(jnp.float32)))
+        imgs = ((np.clip(imgs, -1, 1) + 1) * 127.5).astype(np.uint8)
+        return [
+            DiffusionOutput(request_id=req.request_ids[i],
+                            prompt=prompts[i], data=imgs[i],
+                            output_type="image")
+            for i in range(b)
+        ]

@@ -1,0 +1,305 @@
+"""Flux2-Klein transformer (functional JAX).
+
+Reference: vllm_omni/diffusion/models/flux2_klein/flux2_klein_transformer.py:556
+``Flux2Transformer2DModel`` — 8 double + 48 single stream blocks at
+48 heads x 128 (inner 6144), joint_attention_dim 15360 (three stacked
+Qwen3 hidden layers), patch_size 1 over 128-channel packed latents.
+Structural deltas vs Flux-1:
+
+- modulation is MODEL-LEVEL and SHARED by all blocks: one silu+linear
+  per stream produces (shift, scale, gate) sets consumed by every
+  double block (2 sets img + 2 sets txt) and every single block (1 set)
+  (Flux2Modulation, :540-554)
+- every linear is bias-free
+- FFs are gate-FIRST SwiGLU (silu(x1) * x2, :45-55) with a fused
+  [inner; inner] input projection; single blocks fuse qkv + the doubled
+  MLP projection into one matmul (Flux2ParallelSelfAttention, :236-334)
+- rope is 4-axis (32, 32, 32, 32) at theta 2000: text ids
+  (0, 0, 0, n), image ids (0, row, col, 0), interleaved pairing
+- no pooled conditioning; timestep (+ optional embedded guidance)
+  bias-free MLPs; AdaLayerNormContinuous output head (bias-free)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+
+@dataclass(frozen=True)
+class Flux2KleinDiTConfig:
+    in_channels: int = 128   # 32 VAE latent channels x 2x2 packing
+    out_channels: int = 128
+    patch_size: int = 1
+    num_double_blocks: int = 8
+    num_single_blocks: int = 48
+    num_heads: int = 48
+    head_dim: int = 128
+    ctx_dim: int = 15360     # 3 stacked Qwen3 hidden layers
+    axes_dims: tuple = (32, 32, 32, 32)
+    theta: float = 2000.0
+    mlp_ratio: float = 3.0
+    guidance_embed: bool = True
+    rope_interleaved: bool = False  # from_pretrained sets True
+    eps: float = 1e-6
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def mlp_dim(self) -> int:
+        return int(self.inner_dim * self.mlp_ratio)
+
+    @staticmethod
+    def tiny() -> "Flux2KleinDiTConfig":
+        return Flux2KleinDiTConfig(
+            in_channels=16, out_channels=16, num_double_blocks=2,
+            num_single_blocks=2, num_heads=4, head_dim=32, ctx_dim=128,
+            axes_dims=(8, 8, 8, 8),
+        )
+
+
+def init_params(key, cfg: Flux2KleinDiTConfig, dtype=jnp.float32):
+    inner = cfg.inner_dim
+    mlp = cfg.mlp_dim
+    nb = cfg.num_double_blocks + cfg.num_single_blocks
+    keys = jax.random.split(key, nb + 12)
+
+    def lin(k, i, o):
+        return nn.linear_init(k, i, o, bias=False, dtype=dtype)
+
+    p = {
+        "x_in": lin(keys[0], cfg.in_channels, inner),
+        "ctx_in": lin(keys[1], cfg.ctx_dim, inner),
+        "time_in1": lin(keys[2], 256, inner),
+        "time_in2": lin(keys[3], inner, inner),
+        "mod_img": lin(keys[4], inner, 6 * inner),
+        "mod_txt": lin(keys[5], inner, 6 * inner),
+        "mod_single": lin(keys[6], inner, 3 * inner),
+        "norm_out_mod": lin(keys[7], inner, 2 * inner),
+        "proj_out": lin(keys[8], inner,
+                        cfg.patch_size ** 2 * cfg.out_channels),
+        "double": [],
+        "single": [],
+    }
+    if cfg.guidance_embed:
+        p["guidance_in1"] = lin(keys[9], 256, inner)
+        p["guidance_in2"] = lin(keys[10], inner, inner)
+    for i in range(cfg.num_double_blocks):
+        k = jax.random.split(keys[i + 12], 8)
+        p["double"].append({
+            "img_qkv": lin(k[0], inner, 3 * inner),
+            "txt_qkv": lin(k[1], inner, 3 * inner),
+            "img_norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "img_norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "txt_norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "txt_norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "img_out": lin(k[2], inner, inner),
+            "txt_out": lin(k[3], inner, inner),
+            # fused gate-first SwiGLU input [gate; value]
+            "img_ff1": lin(k[4], inner, 2 * mlp),
+            "img_ff2": lin(k[5], mlp, inner),
+            "txt_ff1": lin(k[6], inner, 2 * mlp),
+            "txt_ff2": lin(k[7], mlp, inner),
+        })
+    for i in range(cfg.num_single_blocks):
+        k = jax.random.split(keys[cfg.num_double_blocks + i + 12], 2)
+        p["single"].append({
+            # qkv + doubled MLP projection in one matmul
+            "fused": lin(k[0], inner, 3 * inner + 2 * mlp),
+            "norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+            "out": lin(k[1], inner + mlp, inner),
+        })
+    return p
+
+
+def rope_freqs(cfg: Flux2KleinDiTConfig, grid_h: int, grid_w: int,
+               txt_len: int, cond_grids: tuple = ()):
+    """4-axis rope angles [S, head_dim//2], text first.
+
+    Text ids (0, 0, 0, n); generated image (0, row, col, 0); appended
+    condition image j at time coordinate 10*(j+1) with its own grid
+    (reference _prepare_latent_ids/_prepare_text_ids/_prepare_image_ids
+    with scale=10, pipeline_flux2_klein.py:305-395)."""
+    halves = [d // 2 for d in cfg.axes_dims]
+
+    def ax(pos, half):
+        inv = 1.0 / (cfg.theta ** (
+            jnp.arange(half, dtype=jnp.float32) / half))
+        return pos.astype(jnp.float32)[:, None] * inv[None, :]
+
+    def grid(gh, gw, t_coord):
+        n = gh * gw
+        r = jnp.arange(gh).repeat(gw)
+        c = jnp.tile(jnp.arange(gw), gh)
+        z = jnp.zeros((n,), jnp.int32)
+        t = jnp.full((n,), t_coord, jnp.int32)
+        return jnp.concatenate(
+            [ax(t, halves[0]), ax(r, halves[1]), ax(c, halves[2]),
+             ax(z, halves[3])], axis=-1)
+
+    parts = [grid(grid_h, grid_w, 0)]
+    for j, (ch, cw) in enumerate(cond_grids):
+        parts.append(grid(ch, cw, 10 * (j + 1)))
+    img_angles = jnp.concatenate(parts, axis=0)
+    zt = jnp.zeros((txt_len,), jnp.int32)
+    tn = jnp.arange(txt_len)
+    txt_angles = jnp.concatenate(
+        [ax(zt, halves[0]), ax(zt, halves[1]), ax(zt, halves[2]),
+         ax(tn, halves[3])], axis=-1)
+    angles = jnp.concatenate([txt_angles, img_angles], axis=0)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope_apply(x, cos, sin, interleaved):
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    if interleaved:
+        x1 = x[..., 0::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+        return out.reshape(x.shape).astype(x.dtype)
+    d = x.shape[-1]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _swiglu(x):
+    # gate FIRST: silu(x1) * x2 (Flux2SwiGLU)
+    g, v = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(g) * v
+
+
+def _heads(x, h):
+    b, s, _ = x.shape
+    return x.reshape(b, s, h, -1)
+
+
+def _mod_ln(x, mod):
+    shift, scale, gate = mod
+    return (nn.layernorm({}, x, eps=1e-6) * (1.0 + scale)
+            + shift), gate
+
+
+def _double_block(blk, cfg, img, txt, mod_img, mod_txt, freqs, kv_mask):
+    h = cfg.num_heads
+    s_txt = txt.shape[1]
+    (img_msa, img_mlp) = mod_img
+    (txt_msa, txt_mlp) = mod_txt
+    img_n, img_gate = _mod_ln(img, img_msa)
+    txt_n, txt_gate = _mod_ln(txt, txt_msa)
+    qi, ki, vi = jnp.split(nn.linear(blk["img_qkv"], img_n), 3, -1)
+    qt, kt, vt = jnp.split(nn.linear(blk["txt_qkv"], txt_n), 3, -1)
+    qi = rms_norm(_heads(qi, h), blk["img_norm_q"]["w"], cfg.eps)
+    ki = rms_norm(_heads(ki, h), blk["img_norm_k"]["w"], cfg.eps)
+    qt = rms_norm(_heads(qt, h), blk["txt_norm_q"]["w"], cfg.eps)
+    kt = rms_norm(_heads(kt, h), blk["txt_norm_k"]["w"], cfg.eps)
+    q = _rope_apply(jnp.concatenate([qt, qi], 1), *freqs,
+                    cfg.rope_interleaved)
+    k = _rope_apply(jnp.concatenate([kt, ki], 1), *freqs,
+                    cfg.rope_interleaved)
+    v = jnp.concatenate([_heads(vt, h), _heads(vi, h)], 1)
+    o = flash_attention(q, k, v, causal=False, kv_mask=kv_mask)
+    txt_o = o[:, :s_txt].reshape(*txt.shape[:2], -1)
+    img_o = o[:, s_txt:].reshape(*img.shape[:2], -1)
+    img = img + img_gate * nn.linear(blk["img_out"], img_o)
+    txt = txt + txt_gate * nn.linear(blk["txt_out"], txt_o)
+
+    img_n2, img_gate2 = _mod_ln(img, img_mlp)
+    img = img + img_gate2 * nn.linear(
+        blk["img_ff2"], _swiglu(nn.linear(blk["img_ff1"], img_n2)))
+    txt_n2, txt_gate2 = _mod_ln(txt, txt_mlp)
+    txt = txt + txt_gate2 * nn.linear(
+        blk["txt_ff2"], _swiglu(nn.linear(blk["txt_ff1"], txt_n2)))
+    return img, txt
+
+
+def _single_block(blk, cfg, x, mod, freqs, kv_mask):
+    h = cfg.num_heads
+    inner = cfg.inner_dim
+    x_n, gate = _mod_ln(x, mod)
+    fused = nn.linear(blk["fused"], x_n)
+    qkv, mlp_h = fused[..., :3 * inner], fused[..., 3 * inner:]
+    q, k, v = jnp.split(qkv, 3, -1)
+    q = rms_norm(_heads(q, h), blk["norm_q"]["w"], cfg.eps)
+    k = rms_norm(_heads(k, h), blk["norm_k"]["w"], cfg.eps)
+    q = _rope_apply(q, *freqs, cfg.rope_interleaved)
+    k = _rope_apply(k, *freqs, cfg.rope_interleaved)
+    o = flash_attention(q, k, _heads(v, h), causal=False,
+                        kv_mask=kv_mask)
+    o = o.reshape(*x.shape[:2], -1)
+    out = nn.linear(blk["out"],
+                    jnp.concatenate([o, _swiglu(mlp_h)], axis=-1))
+    return x + gate * out
+
+
+def forward(
+    params,
+    cfg: Flux2KleinDiTConfig,
+    img_tokens: jax.Array,   # [B, S_img, in_channels]
+    txt_states: jax.Array,   # [B, S_txt, ctx_dim]
+    timesteps: jax.Array,    # [B] in [0, 1000)
+    grid_hw: tuple,
+    guidance: Optional[jax.Array] = None,  # [B] embedded guidance
+    txt_mask: Optional[jax.Array] = None,
+    cond_grids: tuple = (),
+) -> jax.Array:
+    """Velocity prediction [B, S_img, out_channels] (caller slices off
+    appended condition tokens)."""
+    b, s_img = img_tokens.shape[:2]
+    img = nn.linear(params["x_in"], img_tokens)
+    txt = nn.linear(params["ctx_in"], txt_states)
+    s_txt = txt.shape[1]
+
+    temb = nn.timestep_embedding(timesteps, 256).astype(img.dtype)
+    temb = nn.linear(params["time_in2"],
+                     jax.nn.silu(nn.linear(params["time_in1"], temb)))
+    if cfg.guidance_embed and guidance is not None:
+        g = nn.timestep_embedding(guidance * 1000.0, 256).astype(
+            img.dtype)
+        temb = temb + nn.linear(
+            params["guidance_in2"],
+            jax.nn.silu(nn.linear(params["guidance_in1"], g)))
+
+    def mod_sets(name, n_sets):
+        m = nn.linear(params[name], jax.nn.silu(temb))[:, None, :]
+        chunks = jnp.split(m, 3 * n_sets, axis=-1)
+        return tuple(tuple(chunks[3 * i:3 * (i + 1)])
+                     for i in range(n_sets))
+
+    mod_img = mod_sets("mod_img", 2)
+    mod_txt = mod_sets("mod_txt", 2)
+    (mod_single,) = mod_sets("mod_single", 1)
+
+    freqs = rope_freqs(cfg, grid_hw[0], grid_hw[1], s_txt,
+                       cond_grids=cond_grids)
+    kv_mask = None
+    if txt_mask is not None:
+        kv_mask = jnp.concatenate(
+            [txt_mask.astype(jnp.int32),
+             jnp.ones((b, img.shape[1]), jnp.int32)], axis=1)
+
+    for blk in params["double"]:
+        img, txt = _double_block(blk, cfg, img, txt, mod_img, mod_txt,
+                                 freqs, kv_mask)
+    x = jnp.concatenate([txt, img], axis=1)
+    for blk in params["single"]:
+        x = _single_block(blk, cfg, x, mod_single, freqs, kv_mask)
+    img = x[:, s_txt:]
+
+    # AdaLayerNormContinuous (scale first; silu applied inside)
+    mod = nn.linear(params["norm_out_mod"], jax.nn.silu(temb))
+    scale, shift = jnp.split(mod, 2, axis=-1)
+    img = nn.layernorm({}, img, eps=1e-6) * (1.0 + scale[:, None, :]) \
+        + shift[:, None, :]
+    return nn.linear(params["proj_out"], img)

@@ -122,15 +122,28 @@ class ZImagePipeline:
         return hidden, jnp.asarray(mask)
 
     def _encode_prompt_hf(self, prompts: list[str]):
-        """Reference encode (pipeline_z_image.py:250-272): tokenize with
-        right padding, take hidden_states[-2].  The caption span is
-        bucketed to a multiple of 32 of the longest real length
-        (reference SEQ_MULTI_OF padding, z_image_transformer.py:775-787)
-        so the image grid's frame coordinate stays faithful while shapes
-        remain bucketed for XLA."""
+        """Reference encode (pipeline_z_image.py:236-272): wrap in the
+        Qwen chat template (enable_thinking=True), tokenize with right
+        padding, take hidden_states[-2].  The caption span is bucketed
+        to a multiple of 32 of the longest real length (reference
+        SEQ_MULTI_OF padding, z_image_transformer.py:775-787) so the
+        image grid's frame coordinate stays faithful while shapes remain
+        bucketed for XLA."""
         tok = self.hf_tokenizer
+        texts = []
+        for p in prompts:
+            msg = [{"role": "user", "content": p}]
+            try:
+                texts.append(tok.apply_chat_template(
+                    msg, tokenize=False, add_generation_prompt=True,
+                    enable_thinking=True))
+            except Exception:
+                # tokenizer without a chat template (synthetic tests):
+                # the Qwen thinking layout, spelled out
+                texts.append(f"<|im_start|>user\n{p}<|im_end|>\n"
+                             "<|im_start|>assistant\n<think>\n")
         tok.padding_side = "right"
-        enc = tok(list(prompts), padding="max_length", truncation=True,
+        enc = tok(texts, padding="max_length", truncation=True,
                   max_length=self.cfg.max_text_len)
         ids = np.asarray(enc["input_ids"], np.int32)
         mask = np.asarray(enc["attention_mask"], np.int32)

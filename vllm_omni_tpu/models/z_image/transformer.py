@@ -281,6 +281,11 @@ def forward(
             [img_coords, jnp.zeros((b, pad_img, 3), img_coords.dtype)],
             axis=1)
     cap_freqs = rope_angles(cfg, cap_coords)
+    # batch-level caption padding beyond an item's rounded span carries
+    # ZEROED rope tables (reference pad_sequence pads cap_cos/cap_sin
+    # with 0.0, z_image_transformer.py:929-931) — cos=sin=0 annihilates
+    # those pad keys in every attention layer
+    cap_freqs = tuple(f * in_span[..., None] for f in cap_freqs)
     img_freqs = rope_angles(cfg, img_coords)
     uni_freqs = tuple(
         jnp.concatenate([i, c], axis=1)
