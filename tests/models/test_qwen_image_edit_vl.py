@@ -1,0 +1,171 @@
+"""Qwen-Image-Edit VL vision conditioning: transformers-oracle parity.
+
+The edit pipelines feed condition images through the checkpoint's
+Qwen2.5-VL vision tower during TEXT encoding (reference
+pipeline_qwen_image_edit.py:266-268,332-375).  A synthetic edit
+checkpoint ships a tiny Qwen2_5_VLForConditionalGeneration (text LM +
+vision tower); the conditioned prompt embeddings our pipeline produces
+must match the transformers model run on the same expanded ids + pixel
+values — covering the template expansion, ViT features, embed
+scattering, grid-aware MRoPE positions, and the drop-64 slice.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.qwen_image import (  # noqa: E402
+    edit_pipeline as ep,
+)
+
+# hidden 64 matches TINY_DIT's joint_dim; mrope sections sum to
+# head_dim//2 = 8
+VL_CFG = dict(
+    vocab_size=300, hidden_size=64, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    max_position_embeddings=512, rope_theta=1e6, rms_norm_eps=1e-6,
+    tie_word_embeddings=False,
+    rope_scaling={"type": "mrope", "mrope_section": [4, 2, 2]},
+    image_token_id=256,
+    vision_start_token_id=257,
+    vision_end_token_id=258,
+    vision_config=dict(
+        depth=2, hidden_size=24, out_hidden_size=64, num_heads=2,
+        intermediate_size=48, patch_size=4, spatial_merge_size=2,
+        temporal_patch_size=2, window_size=16, fullatt_block_indexes=[1],
+        in_channels=3, hidden_act="silu"),
+)
+
+
+def _write_tokenizer_with_specials(tok_dir):
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+
+    fast = _write_byte_level_tokenizer(tok_dir)
+    # ids 256/257/258 in tokenization order of addition
+    fast.add_special_tokens({"additional_special_tokens": [
+        "<|image_pad|>", "<|vision_start|>", "<|vision_end|>"]})
+    fast.save_pretrained(str(tok_dir))
+    return fast
+
+
+@pytest.fixture(scope="module")
+def edit_root(tmp_path_factory):
+    from transformers import (
+        Qwen2_5_VLConfig,
+        Qwen2_5_VLForConditionalGeneration,
+    )
+
+    from tests.model_loader.test_causal_vae_parity import (
+        TINY as TINY_VAE,
+        _write_checkpoint,
+    )
+    from tests.model_loader.test_diffusers_loader import (
+        TINY_DIT,
+        _write_dit_checkpoint,
+    )
+    from vllm_omni_tpu.model_loader import diffusers_loader as dl
+
+    root = tmp_path_factory.mktemp("qwen_edit_vl")
+    _write_dit_checkpoint(root / "transformer",
+                          dl.dit_config_from_diffusers(TINY_DIT))
+    torch.manual_seed(3)
+    te = Qwen2_5_VLForConditionalGeneration(
+        Qwen2_5_VLConfig(**VL_CFG)).eval()
+    te.save_pretrained(str(root / "text_encoder"),
+                       safe_serialization=True)
+    _write_tokenizer_with_specials(root / "tokenizer")
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "FlowMatchEulerDiscreteScheduler",
+                    "shift": 3.0}))
+    _write_checkpoint(root, TINY_VAE)
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "QwenImageEditPipeline",
+        "transformer": ["diffusers", "QwenImageTransformer2DModel"],
+        "text_encoder": ["transformers",
+                         "Qwen2_5_VLForConditionalGeneration"],
+        "vae": ["diffusers", "AutoencoderKLQwenImage"],
+    }))
+    return root, te
+
+
+def test_edit_vl_conditioned_embeds_match_transformers(edit_root):
+    from vllm_omni_tpu.models.qwen2_5_omni.multimodal import (
+        flatten_image,
+    )
+
+    root, te = edit_root
+    pipe = ep.QwenImageEditPipeline.from_pretrained(
+        str(root), dtype=jnp.float32)
+    assert pipe.vt_params is not None, "vision tower must load"
+
+    img = (np.random.default_rng(0)
+           .integers(0, 255, (24, 16, 3)).astype(np.uint8))
+    prompt = "make the sky purple"
+    pipe._pending_images = [img.astype(np.float32) / 127.5 - 1.0]
+    got_hidden, got_mask = pipe._encode_prompt_hf([prompt])
+    pipe._pending_images = None
+
+    # ----- transformers oracle on the same expanded ids + pixels
+    pixels, (t, gh, gw) = flatten_image(img, pipe.vt_cfg)
+    n_img = (gh * gw) // pipe.vt_cfg.spatial_merge_size ** 2
+    text = (ep.EDIT_TEMPLATE_PREFIX + ep.VISION_SPAN + prompt
+            + ep.EDIT_TEMPLATE_SUFFIX)
+    tok = pipe.hf_tokenizer
+    ids = tok(text, add_special_tokens=False)["input_ids"]
+    pad_id = tok.convert_tokens_to_ids("<|image_pad|>")
+    pos = ids.index(pad_id)
+    ids = ids[:pos] + [pad_id] * n_img + ids[pos + 1:]
+    with torch.no_grad():
+        out = te(
+            input_ids=torch.tensor([ids]),
+            attention_mask=torch.ones(1, len(ids), dtype=torch.long),
+            pixel_values=torch.from_numpy(pixels),
+            image_grid_thw=torch.tensor([[t, gh, gw]]),
+            output_hidden_states=True,
+        )
+    want = out.hidden_states[-1][0, ep.EDIT_DROP_IDX:].numpy()
+
+    got = np.asarray(got_hidden)[0]
+    # the encode pads to the fixed max_text_len bucket; the real span is
+    # mask-marked and must match the oracle exactly
+    n_real = len(ids) - ep.EDIT_DROP_IDX
+    assert int(np.asarray(got_mask).sum()) == n_real
+    np.testing.assert_allclose(got[:n_real], want, atol=2e-3, rtol=5e-3)
+
+
+def test_edit_vl_e2e_generates(edit_root):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+
+    root, _ = edit_root
+    pipe = ep.QwenImageEditPipeline.from_pretrained(
+        str(root), dtype=jnp.float32)
+    img = (np.random.default_rng(1)
+           .integers(0, 255, (32, 32, 3)).astype(np.uint8))
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=3.0,
+        seed=0, image=img)
+    out = pipe.forward(OmniDiffusionRequest(
+        prompt=["make it blue"], sampling_params=sp,
+        request_ids=["r0"]))[0].data
+    assert out.dtype == np.uint8 and out.shape == (32, 32, 3)
+    # a different condition image must change the output (the image
+    # reaches both the VAE-latent path and the text conditioning)
+    img2 = (np.random.default_rng(2)
+            .integers(0, 255, (32, 32, 3)).astype(np.uint8))
+    sp2 = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=3.0,
+        seed=0, image=img2)
+    out2 = pipe.forward(OmniDiffusionRequest(
+        prompt=["make it blue"], sampling_params=sp2,
+        request_ids=["r1"]))[0].data
+    assert not np.array_equal(out, out2)

@@ -342,6 +342,7 @@ def forward_hidden(
     drop_last_layers: int = 0,
     apply_final_norm: bool = True,
     collect_hidden_layers: tuple = (),
+    embeds_mask: Optional[jax.Array] = None,  # [B, S] True=row uses embeds
 ) -> jax.Array:
     """Full-sequence causal forward returning final hidden states
     [B, S, hidden] (the text-encoder path; also prefill without cache).
@@ -362,7 +363,7 @@ def forward_hidden(
     returned instead of the final hidden states.
     """
     b, s = token_ids.shape
-    x = _embed_input(params, token_ids, inputs_embeds, None)
+    x = _embed_input(params, token_ids, inputs_embeds, embeds_mask)
     if positions is None:
         shape = (b, s) if cfg.mrope_sections is None else (b, 3, s)
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], shape)

@@ -301,6 +301,27 @@ def load_vision_tower(model_dir: str, cfg: VisionTowerConfig = None,
         transform=hf_transform, name_filter=lambda nm: nm in flat,
     )
     n_leaves = len(jax.tree.leaves(tree))
+    if n < n_leaves:
+        # Qwen2.5-VL image checkpoints fuse attention projections into
+        # one ``attn.qkv`` tensor (the Omni thinker ships them split) —
+        # split the fused rows into the q/k/v leaves
+        from vllm_omni_tpu.model_loader.safetensors_loader import (
+            iter_safetensors,
+        )
+
+        def want(nm):
+            return nm.startswith(prefix) and ".attn.qkv." in nm
+
+        for name, arr in iter_safetensors(model_dir, want):
+            i = int(name.split(".blocks.")[1].split(".")[0])
+            layer = tree["layers"][i]
+            for part, key in zip(np.split(arr, 3, axis=0),
+                                 ("q", "k", "v")):
+                if name.endswith("weight"):
+                    layer[key]["w"][...] = part.T
+                else:
+                    layer[key]["b"][...] = part
+                n += 1
     if n != n_leaves:
         raise ValueError(
             f"{model_dir} covered {n}/{n_leaves} vision-tower weights")

@@ -13,11 +13,14 @@ TPU notes: the condition tokens ride the same jitted denoise loop — one
 executable per (geometry, cond geometry) pair; the condition encode is a
 single VAE encoder call (causal_vae.encode_image).
 
-Documented deviation: the reference's edit prompt template feeds the
-input image through the Qwen2.5-VL vision tower during TEXT encoding
-(pipeline_qwen_image_edit.py:266); this pipeline encodes the text prompt
-only — conditioning flows through the VAE-latent path, which is what
-anchors the output to the input image.
+Text conditioning (from_pretrained): the edit prompt template feeds the
+condition image(s) through the checkpoint's Qwen2.5-VL vision tower
+during TEXT encoding — ``<|vision_start|><|image_pad|...|><|vision_end|>``
+spans carry ViT features into the LM with grid-aware MRoPE positions,
+and the first 64 template tokens are dropped
+(pipeline_qwen_image_edit.py:266-268,332-375).  Checkpoints whose
+text_encoder ships no ``visual.*`` weights fall back to text-only
+encoding with a warning.
 """
 
 from __future__ import annotations
@@ -31,6 +34,40 @@ from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.models.qwen_image.pipeline import QwenImagePipeline
 
 logger = init_logger(__name__)
+
+# Reference edit template + drop index
+# (pipeline_qwen_image_edit.py:266-268); one vision span per condition
+# image (Edit-Plus repeats "Picture {i}: <span>" per image,
+# pipeline_qwen_image_edit_plus.py).
+EDIT_TEMPLATE_PREFIX = (
+    "<|im_start|>system\nDescribe the key features of the input image "
+    "(color, shape, size, texture, objects, background), then explain "
+    "how the user's text instruction should alter or modify the image. "
+    "Generate a new image that meets the user's requirements while "
+    "maintaining consistency with the original input where "
+    "appropriate.<|im_end|>\n<|im_start|>user\n"
+)
+VISION_SPAN = "<|vision_start|><|image_pad|><|vision_end|>"
+EDIT_TEMPLATE_SUFFIX = "<|im_end|>\n<|im_start|>assistant\n"
+EDIT_DROP_IDX = 64
+
+
+def _find_visual_prefix(te_dir: str):
+    """(has_visual_weights, prefix) by peeking at the checkpoint keys."""
+    import os
+
+    from safetensors import safe_open
+
+    for fn in sorted(os.listdir(te_dir)):
+        if not fn.endswith(".safetensors"):
+            continue
+        with safe_open(os.path.join(te_dir, fn), "np") as f:
+            for k in f.keys():
+                if k.startswith("visual."):
+                    return True, "visual."
+                if k.startswith("model.visual."):
+                    return True, "model.visual."
+    return False, None
 
 
 def _to_float_image(img) -> np.ndarray:
@@ -49,6 +86,157 @@ class QwenImageEditPipeline(QwenImagePipeline):
 
     needs_vae_encoder = True
     max_cond_images = 1
+
+    # vision tower (set by from_pretrained when the checkpoint's
+    # text_encoder ships visual.* weights)
+    vt_params = None
+    vt_cfg = None
+    _pending_images: "list[np.ndarray] | None" = None
+    # VL pixel budget per condition image during TEXT encoding (None =
+    # the tower's default ~1MP budget); Edit-Plus bounds each image so
+    # several condition images still fit the text bucket (reference
+    # condition resize, pipeline_qwen_image_edit_plus.py)
+    vl_max_pixels = None
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, max_text_len: int = 1024,
+                        **kw):
+        # the reference edit pipelines use tokenizer_max_length 1024
+        # (pipeline_qwen_image_edit.py:265) — the template + vision span
+        # + instruction need the headroom
+        import json
+        import os
+
+        pipe = super().from_pretrained(model_dir,
+                                       max_text_len=max_text_len, **kw)
+        te_dir = os.path.join(model_dir, "text_encoder")
+        from vllm_omni_tpu.models.qwen2_5_omni import vision_tower as vt
+
+        with open(os.path.join(te_dir, "config.json")) as f:
+            vcfg_json = json.load(f).get("vision_config")
+        has_weights, prefix = _find_visual_prefix(te_dir)
+        if vcfg_json is None or not has_weights:
+            # genuinely vision-less text encoder (e.g. a text-only
+            # synthetic checkpoint): degrade with a warning
+            logger.warning(
+                "text_encoder under %s ships no vision tower; edit "
+                "prompts encode text-only", te_dir)
+            return pipe
+        # a vision-equipped checkpoint MUST load — silent text-only
+        # fallback would quietly degrade every edit
+        vt_cfg = vt.VisionTowerConfig.from_hf(vcfg_json)
+        pipe.vt_params, _ = vt.load_vision_tower(
+            te_dir, cfg=vt_cfg, dtype=pipe.dtype, prefix=prefix)
+        pipe.vt_cfg = vt_cfg
+        pipe._vt_jit = jax.jit(vt.forward, static_argnums=(1, 3))
+        return pipe
+
+    def forward(self, req):
+        # stash the condition images so the HF text encode can feed them
+        # through the vision tower (the reference conditions the prompt
+        # embeddings on the image as well as the VAE latents)
+        if self.hf_tokenizer is not None and self.vt_params is not None:
+            self._pending_images = self._cond_images(req)
+        try:
+            return super().forward(req)
+        finally:
+            self._pending_images = None
+
+    def _encode_prompt_hf(self, prompts: list[str]):
+        images = self._pending_images
+        if images is None or self.vt_params is None:
+            return super()._encode_prompt_hf(prompts)
+        from vllm_omni_tpu.models.qwen2_5_omni.multimodal import (
+            flatten_image,
+        )
+        from vllm_omni_tpu.models.qwen3_omni.multimodal import (
+            compute_mrope_positions,
+            expand_placeholders,
+        )
+
+        tok = self.hf_tokenizer
+        pad_id = tok.convert_tokens_to_ids("<|image_pad|>")
+        feats_list, grids = [], []
+        for img in images:
+            # _cond_images yields [-1, 1] floats (the VAE convention);
+            # the ViT preprocessing expects [0, 1]
+            img01 = np.clip((np.asarray(img) + 1.0) / 2.0, 0.0, 1.0)
+            pixels, (t, gh, gw) = flatten_image(
+                img01, self.vt_cfg, max_pixels=self.vl_max_pixels)
+            f = self._vt_jit(self.vt_params, self.vt_cfg,
+                             jnp.asarray(pixels), (t, gh, gw))
+            sm = self.vt_cfg.spatial_merge_size
+            feats_list.append(np.asarray(f, np.float32))
+            grids.append((t, gh // sm, gw // sm))
+
+        spans = "".join(
+            (f"Picture {i + 1}: {VISION_SPAN}" if len(images) > 1
+             else VISION_SPAN)
+            for i in range(len(images)))
+        rows = []
+        for p in prompts:
+            text = (EDIT_TEMPLATE_PREFIX + spans + p
+                    + EDIT_TEMPLATE_SUFFIX)
+            ids = tok(text, add_special_tokens=False)["input_ids"]
+            expanded, items = expand_placeholders(
+                ids, {"image": pad_id},
+                [("image", g) for g in grids])
+            embeds = np.zeros((len(expanded),
+                               self.cfg.text.hidden_size), np.float32)
+            mask = np.zeros((len(expanded),), bool)
+            for item, f in zip(items, feats_list):
+                embeds[item.offset:item.offset + item.num_tokens] = f
+                mask[item.offset:item.offset + item.num_tokens] = True
+            positions, _ = compute_mrope_positions(len(expanded), items)
+            rows.append((expanded, embeds, mask, positions))
+
+        # fixed bucket: positive and negative encodes must agree on the
+        # text length (the denoise concatenates the CFG halves), and
+        # static shapes keep one executable per geometry — the DiT's
+        # kv_mask hides the padding
+        max_len = self.cfg.max_text_len + EDIT_DROP_IDX
+        for ids, *_ in rows:
+            if len(ids) > max_len:
+                raise InvalidRequestError(
+                    f"edit prompt + vision spans need {len(ids)} tokens "
+                    f"but the text bucket holds {max_len}; shorten the "
+                    "prompt or reduce condition images")
+        b = len(rows)
+        ids_b = np.zeros((b, max_len), np.int32)
+        emb_b = np.zeros((b, max_len, self.cfg.text.hidden_size),
+                         np.float32)
+        em_b = np.zeros((b, max_len), bool)
+        pos_b = np.zeros((b, 3, max_len), np.int32)
+        attn_b = np.zeros((b, max_len), np.int32)
+        for i, (ids, emb, em, pos) in enumerate(rows):
+            n = len(ids)
+            ids_b[i, :n] = ids
+            emb_b[i, :n] = emb
+            em_b[i, :n] = em
+            pos_b[i, :, :n] = pos
+            attn_b[i, :n] = 1
+        hidden = self._edit_encode_jit(
+            self.text_params, jnp.asarray(ids_b), jnp.asarray(pos_b),
+            jnp.asarray(emb_b), jnp.asarray(em_b), jnp.asarray(attn_b))
+        hidden = hidden[:, EDIT_DROP_IDX:]
+        mask = jnp.asarray(attn_b[:, EDIT_DROP_IDX:])
+        return hidden.astype(self.dtype), mask
+
+    @property
+    def _edit_encode_jit(self):
+        fn = self.__dict__.get("_edit_encode_jit_fn")
+        if fn is None:
+            from vllm_omni_tpu.models.common.transformer import (
+                forward_hidden,
+            )
+
+            fn = jax.jit(
+                lambda p, ids, pos, emb, em, am: forward_hidden(
+                    p, self.cfg.text, ids, positions=pos,
+                    inputs_embeds=emb, attn_mask=am,
+                    embeds_mask=em))
+            self.__dict__["_edit_encode_jit_fn"] = fn
+        return fn
 
     def _cond_images(self, req) -> list[np.ndarray]:
         sp = req.sampling_params
@@ -96,3 +284,7 @@ class QwenImageEditPlusPipeline(QwenImageEditPipeline):
     token block; RoPE frame coordinates idx.., last at -1)."""
 
     max_cond_images = None
+    # each condition image is bounded to ~384x384 for the VL text
+    # encode so several images fit the text bucket (reference
+    # condition resize, pipeline_qwen_image_edit_plus.py)
+    vl_max_pixels = 384 * 384
