@@ -28,6 +28,27 @@ jax.config.update("jax_platforms", "cpu")
 jax.extend.backend.clear_backends()
 
 
+# Test tiers (reference: per-suite stratification,
+# vllm_omni pyproject.toml:149-176 / .buildkite/pipeline.yml): heavy
+# parity/e2e/multiproc suites are marked ``slow`` by DIRECTORY so
+# ``-m "not slow"`` yields a fast core signal (ops/engine/core/
+# parallel/sample/config stay in it).  Individual tests can still
+# override with their own marks.
+_SLOW_DIRS = ("model_loader", "models", "entrypoints", "distributed",
+              "diffusion", "metrics")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        parts = item.path.parts if hasattr(item, "path") else ()
+        # only components BELOW tests/ count — a repo checked out under
+        # e.g. /data/models/ must not mark everything slow
+        if "tests" in parts:
+            parts = parts[parts.index("tests") + 1:]
+        if any(d in parts for d in _SLOW_DIRS):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

@@ -12,6 +12,9 @@ from vllm_omni_tpu.engine import EngineConfig, LLMEngine
 from vllm_omni_tpu.models.common import transformer as tfm
 from vllm_omni_tpu.sampling_params import SamplingParams
 
+# multi-device compile-heavy suite: slow tier
+pytestmark = pytest.mark.slow
+
 
 def _engine(params, cfg, **kw):
     defaults = dict(num_pages=64, page_size=4, max_model_len=128,

@@ -1,0 +1,314 @@
+"""GLM-Image DiT checkpoint-schema parity vs a torch oracle +
+from_pretrained e2e.
+
+Oracle transcribed from the reference class semantics
+(vllm_omni/diffusion/models/glm_image/glm_image_transformer.py):
+12-chunk interleaved AdaLayerNormZero fed the RAW timestep embedding,
+ONE joint qkv over [text, image], affine-free LayerNorm QK-norm
+(eps 1e-5), 2-axis half-split rope on image tokens only, a SHARED
+feed-forward for both streams, glyph (exact-gelu FF) and prior
+(silu FF over drop-zeroed embeddings) projectors, SDXL-like size/crop
+conditioning, and the activation-free AdaLayerNormContinuous head.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.glm_image import (  # noqa: E402
+    ckpt_transformer as gt,
+)
+from vllm_omni_tpu.models.glm_image import loader as gl  # noqa: E402
+
+DIT_JSON = {
+    "patch_size": 2,
+    "in_channels": 4,
+    "out_channels": 4,
+    "num_layers": 2,
+    "num_attention_heads": 4,
+    "attention_head_dim": 16,
+    "time_embed_dim": 32,
+    "condition_dim": 8,
+    "text_embed_dim": 48,
+    "prior_vq_quantizer_codebook_size": 64,
+}
+CFG = gl.dit_config_from_diffusers(DIT_JSON)
+D = CFG.inner_dim
+MLP = int(D * CFG.mlp_ratio)
+TE = CFG.time_embed_dim
+P = CFG.patch_size
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def lin(name, i, o):
+        sd[f"{name}.weight"] = (0.2 * g.standard_normal((o, i))).astype(
+            np.float32)
+        sd[f"{name}.bias"] = (0.1 * g.standard_normal((o,))).astype(
+            np.float32)
+
+    lin("image_projector.proj", P * P * CFG.in_channels, D)
+    lin("glyph_projector.net.0.proj", CFG.text_embed_dim, D)
+    lin("glyph_projector.net.2", D, D)
+    sd["prior_token_embedding.weight"] = (
+        0.2 * g.standard_normal((CFG.prior_vocab, D))).astype(np.float32)
+    lin("prior_projector.net.0.proj", D, D)
+    lin("prior_projector.net.2", D, D)
+    lin("time_condition_embed.timestep_embedder.linear_1", 256, TE)
+    lin("time_condition_embed.timestep_embedder.linear_2", TE, TE)
+    lin("time_condition_embed.condition_embedder.linear_1",
+        4 * CFG.condition_dim, TE)
+    lin("time_condition_embed.condition_embedder.linear_2", TE, TE)
+    lin("norm_out.linear", TE, 2 * D)
+    lin("proj_out", D, P * P * CFG.out_channels)
+    for i in range(CFG.num_layers):
+        b = f"transformer_blocks.{i}"
+        lin(f"{b}.norm1.linear", TE, 12 * D)
+        for pr in ("to_q", "to_k", "to_v"):
+            lin(f"{b}.attn1.{pr}", D, D)
+        lin(f"{b}.attn1.to_out.0", D, D)
+        lin(f"{b}.ff.net.0.proj", D, MLP)
+        lin(f"{b}.ff.net.2", MLP, D)
+    d = tmp_path_factory.mktemp("glm_ckpt")
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(DIT_JSON, f)
+    return str(d), {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+# ------------------------------------------------------------ torch oracle
+def _lin(sd, n, x):
+    return torch.nn.functional.linear(x, sd[f"{n}.weight"],
+                                      sd[f"{n}.bias"])
+
+
+def _ln(x, eps=1e-5):
+    return torch.nn.functional.layer_norm(x, (x.shape[-1],), eps=eps)
+
+
+def _sinus(t, dim=256):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    ang = t.float()[:, None] * freqs[None, :]
+    return torch.cat([ang.cos(), ang.sin()], dim=-1)
+
+
+def _rope_tables(gh, gw):
+    hd = CFG.head_dim
+    quarter = hd // 4
+    inv = 1.0 / (CFG.theta ** (
+        torch.arange(0, hd // 2, 2, dtype=torch.float32)[:quarter]
+        / (hd // 2)))
+    r = torch.arange(gh).repeat_interleave(gw).float()
+    c = torch.arange(gw).repeat(gh).float()
+    ang = torch.cat([r[:, None] * inv, c[:, None] * inv], dim=-1)
+    return ang.cos(), ang.sin()
+
+
+def _rope_half(x, cos, sin):
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return torch.cat([x1 * c - x2 * s, x2 * c + x1 * s], dim=-1)
+
+
+def _attn(q, k, v, kv_mask=None):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = torch.einsum("bqhd,bkhd->bhqk", q.float(), k.float()) * scale
+    if kv_mask is not None:
+        s = s.masked_fill(~kv_mask[:, None, None, :].bool(),
+                          float("-inf"))
+    p = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", p, v.float()).type_as(q)
+
+
+def oracle(sd, img_tokens, glyph, prior_ids, prior_drop, t, cond_vals,
+           gh, gw, txt_mask=None):
+    b = img_tokens.shape[0]
+    h, hd = CFG.num_heads, CFG.head_dim
+    silu = torch.nn.functional.silu
+    gelu = torch.nn.functional.gelu
+    # image tokens arrive in OUR (dy, dx, c) packing; the reference
+    # proj consumes (c, dy, dx) — permute the features back
+    perm = gl._chan_perm(CFG, CFG.in_channels)
+    inv = np.argsort(perm)
+    img = _lin(sd, "image_projector.proj",
+               img_tokens[..., torch.from_numpy(inv)])
+    txt = _lin(sd, "glyph_projector.net.2",
+               gelu(_lin(sd, "glyph_projector.net.0.proj", glyph)))
+    pe = sd["prior_token_embedding.weight"][prior_ids]
+    pe = torch.where(prior_drop[:, None, None], torch.zeros_like(pe),
+                     pe)
+    img = img + _lin(sd, "prior_projector.net.2",
+                     silu(_lin(sd, "prior_projector.net.0.proj", pe)))
+
+    temb = _lin(sd, "time_condition_embed.timestep_embedder.linear_2",
+                silu(_lin(sd, "time_condition_embed.timestep_embedder"
+                              ".linear_1", _sinus(t))))
+    cond = torch.cat([_sinus(cond_vals[:, i], CFG.condition_dim)
+                      for i in range(4)], dim=-1)
+    temb = temb + _lin(
+        sd, "time_condition_embed.condition_embedder.linear_2",
+        silu(_lin(sd, "time_condition_embed.condition_embedder"
+                      ".linear_1", cond)))
+
+    s_txt = txt.shape[1]
+    cos, sin = _rope_tables(gh, gw)
+    kv_mask = None
+    if txt_mask is not None:
+        kv_mask = torch.cat(
+            [txt_mask, torch.ones(b, img.shape[1])], dim=1)
+
+    for i in range(CFG.num_layers):
+        bn = f"transformer_blocks.{i}"
+        mod = _lin(sd, f"{bn}.norm1.linear", temb)
+        (sh, c_sh, sc, c_sc, gt_, c_gt, sh2, c_sh2, sc2, c_sc2, gt2,
+         c_gt2) = mod.chunk(12, dim=-1)
+        img_n = _ln(img) * (1 + sc[:, None]) + sh[:, None]
+        txt_n = _ln(txt) * (1 + c_sc[:, None]) + c_sh[:, None]
+        x = torch.cat([txt_n, img_n], dim=1)
+        q = _lin(sd, f"{bn}.attn1.to_q", x).reshape(b, -1, h, hd)
+        k = _lin(sd, f"{bn}.attn1.to_k", x).reshape(b, -1, h, hd)
+        v = _lin(sd, f"{bn}.attn1.to_v", x).reshape(b, -1, h, hd)
+        q, k = _ln(q), _ln(k)
+        q = torch.cat([q[:, :s_txt],
+                       _rope_half(q[:, s_txt:], cos, sin)], dim=1)
+        k = torch.cat([k[:, :s_txt],
+                       _rope_half(k[:, s_txt:], cos, sin)], dim=1)
+        o = _attn(q, k, v, kv_mask).reshape(b, x.shape[1], -1)
+        o = _lin(sd, f"{bn}.attn1.to_out.0", o)
+        txt = txt + o[:, :s_txt] * c_gt[:, None]
+        img = img + o[:, s_txt:] * gt_[:, None]
+        img_n2 = _ln(img) * (1 + sc2[:, None]) + sh2[:, None]
+        txt_n2 = _ln(txt) * (1 + c_sc2[:, None]) + c_sh2[:, None]
+
+        def ff(x_):
+            return _lin(sd, f"{bn}.ff.net.2",
+                        gelu(_lin(sd, f"{bn}.ff.net.0.proj", x_),
+                             approximate="tanh"))
+
+        img = img + ff(img_n2) * gt2[:, None]
+        txt = txt + ff(txt_n2) * c_gt2[:, None]
+
+    sc, sh = _lin(sd, "norm_out.linear", temb).chunk(2, dim=-1)
+    img = _ln(img) * (1 + sc[:, None]) + sh[:, None]
+    out = _lin(sd, "proj_out", img)
+    return out[..., torch.from_numpy(gl._chan_perm(CFG,
+                                                   CFG.out_channels))]
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_glm_dit_ckpt_parity(checkpoint, masked):
+    d, sd = checkpoint
+    params, cfg = gl.load_glm_dit(d, dtype=jnp.float32)
+    g = np.random.default_rng(1)
+    gh, gw = 2, 4
+    img = g.standard_normal(
+        (2, gh * gw, P * P * CFG.in_channels)).astype(np.float32)
+    glyph = g.standard_normal((2, 5, CFG.text_embed_dim)).astype(
+        np.float32)
+    prior = g.integers(0, CFG.prior_vocab, (2, gh * gw))
+    drop = np.asarray([False, True])
+    t = np.asarray([500.0, 20.0], np.float32)
+    cond = np.asarray([[64, 64, 0, 0], [32, 64, 4, 8]], np.float32)
+    mask = (np.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.int32)
+            if masked else None)
+    with torch.no_grad():
+        want = oracle(
+            sd, torch.from_numpy(img), torch.from_numpy(glyph),
+            torch.from_numpy(prior), torch.from_numpy(drop),
+            torch.from_numpy(t), torch.from_numpy(cond), gh, gw,
+            txt_mask=torch.from_numpy(mask) if masked else None).numpy()
+    got = np.asarray(gt.forward(
+        params, cfg, jnp.asarray(img), jnp.asarray(glyph),
+        jnp.asarray(prior), jnp.asarray(drop), jnp.asarray(t),
+        jnp.asarray(cond), (gh, gw),
+        txt_mask=jnp.asarray(mask) if masked else None))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=5e-3)
+
+
+# ------------------------------------------------------- from_pretrained
+@pytest.fixture(scope="module")
+def glm_root(tmp_path_factory, checkpoint):
+    import shutil
+
+    from safetensors.torch import save_model
+    from transformers import T5Config as HFT5Config, T5EncoderModel
+
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+    from tests.model_loader.test_image_vae_parity import (
+        TINY as VAE_JSON,
+        make_vae_state_dict,
+        write_vae_dir,
+    )
+
+    d, _ = checkpoint
+    root = tmp_path_factory.mktemp("glm_root")
+    shutil.copytree(d, root / "transformer")
+    torch.manual_seed(0)
+    t5 = T5EncoderModel(HFT5Config(
+        vocab_size=256, d_model=48, d_kv=12, d_ff=64, num_layers=2,
+        num_heads=4, feed_forward_proj="gated-gelu")).eval()
+    (root / "text_encoder").mkdir()
+    save_model(t5, str(root / "text_encoder" / "model.safetensors"))
+    (root / "text_encoder" / "config.json").write_text(
+        json.dumps(t5.config.to_dict()))
+    _write_byte_level_tokenizer(root / "tokenizer")
+    write_vae_dir(str(root / "vae"), VAE_JSON,
+                  make_vae_state_dict(VAE_JSON, seed=7,
+                                      halves=("decoder",)))
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "FlowMatchEulerDiscreteScheduler"}))
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "GlmImagePipeline",
+        "transformer": ["diffusers", "GlmImageTransformer2DModel"],
+        "text_encoder": ["transformers", "T5EncoderModel"],
+        "vae": ["diffusers", "AutoencoderKL"],
+    }))
+    return root
+
+
+def test_glm_from_pretrained_generates(glm_root):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.glm_image.pipeline import GlmImagePipeline
+
+    pipe = GlmImagePipeline.from_pretrained(str(glm_root),
+                                            dtype=jnp.float32,
+                                            max_text_len=16)
+    assert pipe.real_dit_params is not None
+    grid = 16 // pipe.geometry_multiple
+    prior = np.arange(grid * grid, dtype=np.int32) % CFG.prior_vocab
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=3.0,
+        seed=0, extra={"prior_token_ids": prior})
+    out = pipe.forward(OmniDiffusionRequest(
+        prompt=["hello glyphs"], sampling_params=sp,
+        request_ids=["r0"]))[0].data
+    assert out.dtype == np.uint8 and out.shape == (16, 16, 3)
+    # a different prior must change the image (the conditioning path)
+    sp2 = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=3.0,
+        seed=0, extra={"prior_token_ids": (prior + 7) % CFG.prior_vocab})
+    out2 = pipe.forward(OmniDiffusionRequest(
+        prompt=["hello glyphs"], sampling_params=sp2,
+        request_ids=["r1"]))[0].data
+    assert not np.array_equal(out, out2)

@@ -17,6 +17,9 @@ from vllm_omni_tpu.parallel.context import (
     usp_attention,
 )
 
+# multi-device compile-heavy suite: slow tier
+pytestmark = pytest.mark.slow
+
 B, S, H, D = 2, 32, 8, 64
 ST = 8  # joint text tokens
 

@@ -14,6 +14,9 @@ from vllm_omni_tpu.ops.attention import attention_ref
 from vllm_omni_tpu.parallel import cp
 from vllm_omni_tpu.parallel.context import ring_attention
 
+# multi-device compile-heavy suite: slow tier
+pytestmark = pytest.mark.slow
+
 
 def _mesh(n=8, axis="sp"):
     return Mesh(np.array(jax.devices()[:n]), (axis,))

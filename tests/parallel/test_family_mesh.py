@@ -18,6 +18,9 @@ from vllm_omni_tpu.diffusion.request import (
 )
 from vllm_omni_tpu.parallel.mesh import MeshConfig, build_mesh
 
+# multi-device compile-heavy suite: slow tier
+pytestmark = pytest.mark.slow
+
 
 def _mesh(**deg):
     cfg = MeshConfig(

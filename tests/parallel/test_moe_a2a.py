@@ -15,6 +15,9 @@ from vllm_omni_tpu.models.common import transformer as tfm
 from vllm_omni_tpu.ops import moe as moe_ops
 from vllm_omni_tpu.parallel.mesh import MeshConfig, build_mesh
 
+# multi-device compile-heavy suite: slow tier
+pytestmark = pytest.mark.slow
+
 
 def _mesh(dp, ep):
     return build_mesh(

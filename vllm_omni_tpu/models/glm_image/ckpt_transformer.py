@@ -1,0 +1,226 @@
+"""GLM-Image DiT at the real checkpoint schema (functional JAX).
+
+Reference: vllm_omni/diffusion/models/glm_image/glm_image_transformer.py
+:542 ``GlmImageTransformer2DModel`` — double-stream blocks with ONE
+joint qkv over the concatenated [text, image] sequence, affine-free
+LayerNorm QK-norm (eps 1e-5), 2-axis (row, col) half-split rope applied
+to IMAGE tokens only (:52-89, apply_rotary_emb use_real_unbind_dim=-2),
+a single 12-chunk AdaLayerNormZero whose linear consumes the RAW
+timestep embedding (:91-138 — no silu), one SHARED feed-forward for
+both streams (:472-473), glyph/prior projector FFs (:594-597), SDXL-like
+size/crop conditioning summed into the timestep stream
+(GlmImageCombinedTimestepSizeEmbeddings), an activation-free
+AdaLayerNormContinuous output head (:140-161), and the prior-token
+conditioning added to the image stream pre-blocks (:678-683, embedding
+rows zeroed under prior-drop CFG BEFORE the biased projector).
+
+The in-tree stand-in pipeline keeps the shared Qwen-Image MMDiT for
+random-init runs; this module is the real-weight path
+(``GlmImagePipeline.from_pretrained``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention
+
+
+@dataclass(frozen=True)
+class GlmDiTConfig:
+    patch_size: int = 2
+    in_channels: int = 16
+    out_channels: int = 16
+    num_layers: int = 30
+    num_heads: int = 64
+    head_dim: int = 40
+    time_embed_dim: int = 512
+    condition_dim: int = 256
+    text_embed_dim: int = 1472   # ByT5 glyph encoder width
+    prior_vocab: int = 16384
+    theta: float = 10000.0
+    mlp_ratio: float = 4.0
+    eps: float = 1e-5
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @staticmethod
+    def tiny() -> "GlmDiTConfig":
+        return GlmDiTConfig(
+            in_channels=4, out_channels=4, num_layers=2, num_heads=4,
+            head_dim=16, time_embed_dim=32, condition_dim=8,
+            text_embed_dim=48, prior_vocab=64)
+
+
+def init_params(key, cfg: GlmDiTConfig, dtype=jnp.float32):
+    d = cfg.inner_dim
+    mlp = int(d * cfg.mlp_ratio)
+    te = cfg.time_embed_dim
+    p_in = cfg.patch_size ** 2 * cfg.in_channels
+    keys = jax.random.split(key, cfg.num_layers + 12)
+    p = {
+        "image_proj": nn.linear_init(keys[0], p_in, d, dtype=dtype),
+        "glyph1": nn.linear_init(keys[1], cfg.text_embed_dim, d,
+                                 dtype=dtype),
+        "glyph2": nn.linear_init(keys[2], d, d, dtype=dtype),
+        "prior_embed": nn.embedding_init(keys[3], cfg.prior_vocab, d,
+                                         dtype),
+        "prior1": nn.linear_init(keys[4], d, d, dtype=dtype),
+        "prior2": nn.linear_init(keys[5], d, d, dtype=dtype),
+        "time_in1": nn.linear_init(keys[6], 256, te, dtype=dtype),
+        "time_in2": nn.linear_init(keys[7], te, te, dtype=dtype),
+        "cond_in1": nn.linear_init(keys[8], 4 * cfg.condition_dim, te,
+                                   dtype=dtype),
+        "cond_in2": nn.linear_init(keys[9], te, te, dtype=dtype),
+        "norm_out_mod": nn.linear_init(keys[10], te, 2 * d, dtype=dtype),
+        "proj_out": nn.linear_init(
+            keys[11], d, cfg.patch_size ** 2 * cfg.out_channels,
+            dtype=dtype),
+        "blocks": [],
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[i + 12], 4)
+        p["blocks"].append({
+            "ada": nn.linear_init(k[0], te, 12 * d, dtype=dtype),
+            "qkv": nn.linear_init(k[1], d, 3 * d, dtype=dtype),
+            "out": nn.linear_init(k[2], d, d, dtype=dtype),
+            "mlp1": nn.linear_init(k[3], d, mlp, dtype=dtype),
+            "mlp2": nn.linear_init(
+                jax.random.fold_in(k[3], 1), mlp, d, dtype=dtype),
+        })
+    return p
+
+
+def rope_tables(cfg: GlmDiTConfig, gh: int, gw: int):
+    """2-axis (row, col) angles [S_img, head_dim//2]: each axis owns a
+    quarter of the head dim's complex pairs (GlmImageRotaryPosEmbed —
+    its full-dim table duplicates the halves, which the half-split apply
+    folds back into one [S, D/2] table)."""
+    quarter = cfg.head_dim // 4
+
+    def ax(pos):
+        inv = 1.0 / (cfg.theta ** (
+            jnp.arange(quarter, dtype=jnp.float32) * 2 / (cfg.head_dim
+                                                          // 2)))
+        return pos.astype(jnp.float32)[:, None] * inv[None, :]
+
+    r = jnp.arange(gh).repeat(gw)
+    c = jnp.tile(jnp.arange(gw), gh)
+    ang = jnp.concatenate([ax(r), ax(c)], axis=-1)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rope_half(x, cos, sin):
+    # apply_rotary_emb use_real_unbind_dim=-2: rotate-half pairing
+    d = x.shape[-1]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _ln(x, eps):
+    return nn.layernorm({}, x, eps=eps)
+
+
+def _block(blk, cfg: GlmDiTConfig, img, txt, temb, img_freqs, kv_mask):
+    h = cfg.num_heads
+    eps = cfg.eps
+    s_txt = txt.shape[1]
+    mod = nn.linear(blk["ada"], temb)
+    (sh, c_sh, sc, c_sc, gt, c_gt, sh2, c_sh2, sc2, c_sc2, gt2,
+     c_gt2) = jnp.split(mod, 12, axis=-1)
+    img_n = _ln(img, eps) * (1 + sc[:, None]) + sh[:, None]
+    txt_n = _ln(txt, eps) * (1 + c_sc[:, None]) + c_sh[:, None]
+
+    x = jnp.concatenate([txt_n, img_n], axis=1)
+    qkv = nn.linear(blk["qkv"], x)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    b, s = x.shape[:2]
+    q = _ln(q.reshape(b, s, h, -1), eps)
+    k = _ln(k.reshape(b, s, h, -1), eps)
+    v = v.reshape(b, s, h, -1)
+    # rope on the IMAGE tokens only
+    cos, sin = img_freqs
+    q = jnp.concatenate(
+        [q[:, :s_txt], _rope_half(q[:, s_txt:], cos, sin)], axis=1)
+    k = jnp.concatenate(
+        [k[:, :s_txt], _rope_half(k[:, s_txt:], cos, sin)], axis=1)
+    o = flash_attention(q, k, v, causal=False, kv_mask=kv_mask)
+    o = nn.linear(blk["out"], o.reshape(b, s, -1))
+    txt_o, img_o = o[:, :s_txt], o[:, s_txt:]
+    img = img + img_o * gt[:, None]
+    txt = txt + txt_o * c_gt[:, None]
+
+    img_n2 = _ln(img, eps) * (1 + sc2[:, None]) + sh2[:, None]
+    txt_n2 = _ln(txt, eps) * (1 + c_sc2[:, None]) + c_sh2[:, None]
+
+    def ff(x_):
+        return nn.linear(blk["mlp2"], jax.nn.gelu(
+            nn.linear(blk["mlp1"], x_), approximate=True))
+
+    img = img + ff(img_n2) * gt2[:, None]
+    txt = txt + ff(txt_n2) * c_gt2[:, None]
+    return img, txt
+
+
+def forward(
+    params,
+    cfg: GlmDiTConfig,
+    img_tokens: jax.Array,   # [B, gh*gw, p^2*in] packed (dy, dx, c)
+    glyph_states: jax.Array,  # [B, S_txt, text_embed_dim]
+    prior_ids: jax.Array,    # [B, gh*gw] upsampled prior VQ ids
+    prior_drop: jax.Array,   # [B] bool — CFG rows drop the prior
+    timesteps: jax.Array,    # [B] in [0, 1000)
+    cond_vals: jax.Array,    # [B, 4] target_h, target_w, crop_t, crop_l
+    grid_hw: tuple,
+    txt_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Velocity prediction [B, gh*gw, p^2*out_channels]."""
+    gh, gw = grid_hw
+    b = img_tokens.shape[0]
+    img = nn.linear(params["image_proj"], img_tokens)
+    txt = nn.linear(params["glyph2"], jax.nn.gelu(
+        nn.linear(params["glyph1"], glyph_states), approximate=False))
+
+    pe = nn.embedding(params["prior_embed"], prior_ids)
+    pe = jnp.where(prior_drop[:, None, None], jnp.zeros_like(pe), pe)
+    prior = nn.linear(params["prior2"], jax.nn.silu(
+        nn.linear(params["prior1"], pe)))
+    img = img + prior.astype(img.dtype)
+
+    t_emb = nn.linear(params["time_in2"], jax.nn.silu(
+        nn.linear(params["time_in1"],
+                  nn.timestep_embedding(timesteps, 256).astype(
+                      img.dtype))))
+    cond_sin = jnp.concatenate(
+        [nn.timestep_embedding(cond_vals[:, i], cfg.condition_dim)
+         for i in range(4)], axis=-1).astype(img.dtype)
+    cond_emb = nn.linear(params["cond_in2"], jax.nn.silu(
+        nn.linear(params["cond_in1"], cond_sin)))
+    temb = t_emb + cond_emb
+
+    img_freqs = rope_tables(cfg, gh, gw)
+    kv_mask = None
+    if txt_mask is not None:
+        kv_mask = jnp.concatenate(
+            [txt_mask.astype(jnp.int32),
+             jnp.ones((b, img.shape[1]), jnp.int32)], axis=1)
+
+    for blk in params["blocks"]:
+        img, txt = _block(blk, cfg, img, txt, temb, img_freqs, kv_mask)
+
+    # activation-free AdaLayerNormContinuous (scale first)
+    mod = nn.linear(params["norm_out_mod"], temb)
+    scale, shift = jnp.split(mod, 2, axis=-1)
+    img = _ln(img, cfg.eps) * (1 + scale[:, None]) + shift[:, None]
+    return nn.linear(params["proj_out"], img)

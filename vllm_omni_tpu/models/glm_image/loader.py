@@ -1,0 +1,117 @@
+"""Diffusers-format GLM-Image transformer loader.
+
+Checkpoint names per the reference module tree
+(glm_image_transformer.py:594-616): ``image_projector.proj``,
+``glyph_projector.net.{0.proj,2}``, ``prior_token_embedding``,
+``prior_projector.net.{0.proj,2}``,
+``time_condition_embed.{timestep,condition}_embedder.linear_{1,2}``,
+per block ``norm1.linear`` (12-chunk AdaLN), fused-at-load
+``attn1.{to_q,to_k,to_v}`` -> qkv, ``attn1.to_out.0``,
+``ff.net.{0.proj,2}`` (shared by both streams), and
+``norm_out.linear`` / ``proj_out``.
+
+The patch projector consumes (c, dy, dx)-ordered features in the
+reference (:48); rows permute to this repo's (dy, dx, c) packing at
+load, and likewise ``proj_out`` columns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.flux.loader import load_routed
+from vllm_omni_tpu.models.glm_image.ckpt_transformer import (
+    GlmDiTConfig,
+    init_params,
+)
+
+
+def dit_config_from_diffusers(d: dict) -> GlmDiTConfig:
+    in_ch = d.get("in_channels", 16)
+    return GlmDiTConfig(
+        patch_size=d.get("patch_size", 2),
+        in_channels=in_ch,
+        out_channels=d.get("out_channels") or in_ch,
+        num_layers=d.get("num_layers", 30),
+        num_heads=d.get("num_attention_heads", 64),
+        head_dim=d.get("attention_head_dim", 40),
+        time_embed_dim=d.get("time_embed_dim", 512),
+        condition_dim=d.get("condition_dim", 256),
+        text_embed_dim=d.get("text_embed_dim", 1472),
+        prior_vocab=d.get("prior_vq_quantizer_codebook_size", 16384),
+    )
+
+
+def _routing(cfg: GlmDiTConfig) -> dict:
+    r: dict[str, tuple] = {}
+
+    def lin(hf, *path):
+        r[f"{hf}.weight"] = ("direct", path + ("w",))
+        r[f"{hf}.bias"] = ("direct", path + ("b",))
+
+    def fuse(names, *path):
+        for s, n in enumerate(names):
+            r[f"{n}.weight"] = ("fuse", path + ("w",), s, len(names))
+            r[f"{n}.bias"] = ("fuse", path + ("b",), s, len(names))
+
+    lin("image_projector.proj", "image_proj")
+    lin("glyph_projector.net.0.proj", "glyph1")
+    lin("glyph_projector.net.2", "glyph2")
+    r["prior_token_embedding.weight"] = ("raw", ("prior_embed", "w"))
+    lin("prior_projector.net.0.proj", "prior1")
+    lin("prior_projector.net.2", "prior2")
+    lin("time_condition_embed.timestep_embedder.linear_1", "time_in1")
+    lin("time_condition_embed.timestep_embedder.linear_2", "time_in2")
+    lin("time_condition_embed.condition_embedder.linear_1", "cond_in1")
+    lin("time_condition_embed.condition_embedder.linear_2", "cond_in2")
+    lin("norm_out.linear", "norm_out_mod")
+    lin("proj_out", "proj_out")
+    for i in range(cfg.num_layers):
+        b = f"transformer_blocks.{i}"
+        t = ("blocks", i)
+        lin(f"{b}.norm1.linear", *t, "ada")
+        fuse([f"{b}.attn1.to_q", f"{b}.attn1.to_k", f"{b}.attn1.to_v"],
+             *t, "qkv")
+        lin(f"{b}.attn1.to_out.0", *t, "out")
+        lin(f"{b}.ff.net.0.proj", *t, "mlp1")
+        lin(f"{b}.ff.net.2", *t, "mlp2")
+    return r
+
+
+def _chan_perm(cfg: GlmDiTConfig, channels: int) -> np.ndarray:
+    p = cfg.patch_size
+    c = channels
+    idx = np.arange(c * p * p).reshape(c, p, p)
+    return idx.transpose(1, 2, 0).reshape(-1)
+
+
+def load_glm_dit(model_dir: str, cfg: GlmDiTConfig = None,
+                 dtype=jnp.bfloat16):
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = dit_config_from_diffusers(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    perm_in = _chan_perm(cfg, cfg.in_channels)
+    perm_out = _chan_perm(cfg, cfg.out_channels)
+
+    def proj_in_t(arr):
+        return np.ascontiguousarray(arr.T[perm_in])
+
+    def proj_out_t(arr):
+        return np.ascontiguousarray(arr.T[:, perm_out])
+
+    def proj_out_bias_t(arr):
+        return arr[perm_out]
+
+    tree = load_routed(
+        model_dir, _routing(cfg), shapes, dtype,
+        transforms={"image_projector.proj.weight": proj_in_t,
+                    "proj_out.weight": proj_out_t,
+                    "proj_out.bias": proj_out_bias_t})
+    return tree, cfg

@@ -8,14 +8,21 @@ each prior token embeds and ADDS into the image stream before the blocks
 (glm_image_transformer.py:678-683), with prior-drop classifier-free
 guidance (prior_token_drop) instead of text CFG.
 
-TPU-first composition: the DiT reuses the shared Qwen-Image MMDiT
-double-stream blocks through the decomposed forward_prefix / block /
-suffix API — GLM's prior embedding injects between prefix and blocks
-without touching the shared transformer; the AR prior is a causal
-transformer over the prior vocabulary sampled greedily under one jitted
-scan.  Reduced scope vs the reference (documented): the T5 glyph text
-encoder is the shared functional text encoder, SDXL-style size/crop
-conditioning and the image-edit KV-cache modes land with real weights.
+TPU-first composition: the random-init path reuses the shared
+Qwen-Image MMDiT double-stream blocks through the decomposed
+forward_prefix / block / suffix API — GLM's prior embedding injects
+between prefix and blocks without touching the shared transformer; the
+AR prior is a causal transformer over the prior vocabulary sampled
+greedily under one jitted scan.
+
+from_pretrained loads the REAL checkpoint schema: the GLM DiT
+(ckpt_transformer.py — joint-qkv blocks, 12-chunk AdaLN, glyph/prior
+projectors, SDXL size/crop conditioning), the ByT5 glyph text encoder,
+and the AutoencoderKL.  Scope note: the AR prior stage
+(vision_language_encoder/ — a GLM-4V-style VLM) has no in-tree loader
+yet; real-weight runs take precomputed prior tokens via
+``sampling_params.extra["prior_token_ids"]`` or fall back to the
+in-tree random prior with a warning.
 """
 
 from __future__ import annotations
@@ -86,7 +93,8 @@ class GlmImagePipeline:
     config_cls = GlmImagePipelineConfig
     # every tree engine.sleep() must offload (the AR prior included)
     param_attrs = ("dit_params", "text_params", "vae_params",
-                   "prior_params", "glm_params")
+                   "prior_params", "glm_params", "real_dit_params",
+                   "t5_params")
 
     def __init__(self, config: GlmImagePipelineConfig, dtype=jnp.bfloat16,
                  seed: int = 0, mesh=None, cache_config=None):
@@ -137,10 +145,78 @@ class GlmImagePipeline:
             lambda p, i: forward_hidden(p, self.cfg.text, i))
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
+        # real-weight path (from_pretrained): checkpoint-schema GLM DiT
+        # + ByT5 glyph encoder (ckpt_transformer.py)
+        self.real_dit_params = None
+        self.real_dit_cfg = None
+        self.t5_params = None
+        self.t5_cfg = None
+        self._t5_encode_jit = None
+        self.hf_tokenizer = None
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = 512):
+        """Build from a diffusers-format GLM-Image checkpoint
+        (transformer/ + ByT5 text_encoder/ + tokenizer/ + AutoencoderKL
+        vae/ + scheduler/; the vision_language_encoder/ AR prior has no
+        in-tree loader yet — see the module docstring)."""
+        import json as _json
+        import os
+
+        from transformers import AutoTokenizer
+
+        from vllm_omni_tpu.model_loader import diffusers_loader as dl
+        from vllm_omni_tpu.models.common import t5 as t5_mod
+        from vllm_omni_tpu.models.glm_image import loader as gloader
+
+        dl.load_model_index(model_dir)
+        tdir = os.path.join(model_dir, "transformer")
+        real_params, real_cfg = gloader.load_glm_dit(tdir, dtype=dtype)
+        te = os.path.join(model_dir, "text_encoder")
+        with open(os.path.join(te, "config.json")) as f:
+            t5_cfg = t5_mod.T5Config.from_hf(_json.load(f))
+        t5_params, _ = t5_mod.load_t5(te, cfg=t5_cfg, dtype=dtype)
+        vae_tree, vae_cfg = dl.load_image_vae(
+            os.path.join(model_dir, "vae"), dtype=dtype, decoder=True)
+        import dataclasses
+
+        # tiny stand-in text/dit/prior trees satisfy the random-init
+        # invariants; the real path never touches them (the in-tree AR
+        # prior stays available as the fallback prior generator)
+        config = dataclasses.replace(
+            GlmImagePipelineConfig.tiny(),
+            vae=vae_cfg, max_text_len=max_text_len,
+            condition_dim=real_cfg.condition_dim,
+            prior_vocab=real_cfg.prior_vocab)
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config)
+        pipe.real_dit_params = pipe.wiring.place(real_params)
+        pipe.real_dit_cfg = real_cfg
+        pipe.t5_params = pipe.wiring.place(t5_params)
+        pipe.t5_cfg = t5_cfg
+        # jitted ONCE (a per-request jax.jit(lambda) would retrace and
+        # recompile the glyph encoder every call)
+        pipe._t5_encode_jit = jax.jit(
+            lambda p, i, m: t5_mod.forward(p, t5_cfg, i, m))
+        sched = dl.scheduler_config(model_dir)
+        pipe.shift = sched.get("shift", 1.0)
+        pipe.vae_params = pipe.wiring.place(vae_tree["decoder"])
+        pipe.hf_tokenizer = AutoTokenizer.from_pretrained(
+            os.path.join(model_dir, "tokenizer"))
+        logger.warning(
+            "GLM-Image AR prior (vision_language_encoder/) has no "
+            "in-tree loader: pass sampling_params.extra"
+            "['prior_token_ids'] or the random-init prior runs")
+        return pipe
 
     @property
     def geometry_multiple(self) -> int:
-        return self.cfg.vae.spatial_ratio * self.cfg.dit.patch_size
+        patch = (self.real_dit_cfg.patch_size
+                 if self.real_dit_cfg is not None
+                 else self.cfg.dit.patch_size)
+        return self.cfg.vae.spatial_ratio * patch
 
     @staticmethod
     def upsample_prior_ids(ids, h: int, w: int):
@@ -244,6 +320,43 @@ class GlmImagePipeline:
         self._denoise_cache[key] = run
         return run
 
+    def _real_denoise_fn(self, grid_h, grid_w, sched_len):
+        key = ("real", grid_h, grid_w, sched_len)
+        if key in self._denoise_cache:
+            return self._denoise_cache[key]
+        from vllm_omni_tpu.models.glm_image import ckpt_transformer as gd
+
+        rcfg = self.real_dit_cfg
+
+        @jax.jit
+        def run(dit_params, latents, txt, txt_mask, prior_ids,
+                cond_vals, sigmas, timesteps, gscale, num_steps):
+            schedule = fm.FlowMatchSchedule(sigmas=sigmas,
+                                            timesteps=timesteps)
+            b = latents.shape[0]
+            txt2 = jnp.concatenate([txt, txt], 0)
+            mask2 = jnp.concatenate([txt_mask, txt_mask], 0)
+            prior2 = jnp.concatenate([prior_ids, prior_ids], 0)
+            # prior-drop CFG: the unconditional half drops the prior
+            drop2 = jnp.concatenate(
+                [jnp.zeros((b,), bool), jnp.ones((b,), bool)], 0)
+            cond2 = jnp.concatenate([cond_vals, cond_vals], 0)
+
+            def body(i, lat):
+                t = jnp.broadcast_to(timesteps[i], (2 * b,))
+                lat_in = jnp.concatenate([lat, lat], 0)
+                v = gd.forward(
+                    dit_params, rcfg, lat_in, txt2, prior2, drop2, t,
+                    cond2, (grid_h, grid_w), txt_mask=mask2)
+                v_c, v_u = jnp.split(v, 2, axis=0)
+                v = v_u + gscale * (v_c - v_u)
+                return fm.step(schedule, lat, v, i)
+
+            return jax.lax.fori_loop(0, num_steps, body, latents)
+
+        self._denoise_cache[key] = run
+        return run
+
     def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
         sp = req.sampling_params
         cfg = self.cfg
@@ -257,30 +370,74 @@ class GlmImagePipeline:
         prompts = req.prompt
         b = len(prompts)
 
-        ids, lens = self.tokenizer.batch_encode(prompts,
-                                                cfg.max_text_len)
-        txt = self._text_encode_jit(self.text_params, jnp.asarray(ids))
-        mask = jnp.asarray(
-            (np.arange(cfg.max_text_len)[None, :]
-             < lens[:, None]).astype(np.int32))
+        if self.t5_params is not None:
+            # real path: ByT5 glyph encoder with the HF tokenizer
+            enc = self.hf_tokenizer(
+                list(prompts), padding="max_length", truncation=True,
+                max_length=cfg.max_text_len)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            mask = jnp.asarray(np.asarray(enc["attention_mask"],
+                                          np.int32))
+            txt = self._t5_encode_jit(self.t5_params, jnp.asarray(ids),
+                                      mask)
+        else:
+            ids, lens = self.tokenizer.batch_encode(prompts,
+                                                    cfg.max_text_len)
+            txt = self._text_encode_jit(self.text_params,
+                                        jnp.asarray(ids))
+            mask = jnp.asarray(
+                (np.arange(cfg.max_text_len)[None, :]
+                 < lens[:, None]).astype(np.int32))
 
-        # stage 1: AR prior tokens seeded from the text ids — generated
+        # stage 1: AR prior tokens — precomputed ids win (the real AR
+        # prior runs out-of-tree, see module docstring); else generated
         # at the HALF (d32) grid and 2x nearest-upsampled to the DiT
         # grid when the geometry allows (reference generate_prior_tokens
         # + _upsample_token_ids); odd grids degrade to full-res priors
-        seed_ids = jnp.asarray(ids[:, :8] % cfg.prior_lm.vocab_size,
-                               jnp.int32)
-        if grid_h % 2 == 0 and grid_w % 2 == 0:
-            ph, pw = grid_h // 2, grid_w // 2
-            small = self._prior_fn(ph * pw)(self.prior_params, seed_ids)
-            prior_ids = self.upsample_prior_ids(small, ph, pw)
+        pre = (sp.extra or {}).get("prior_token_ids") \
+            if hasattr(sp, "extra") else None
+        if pre is not None:
+            pre_np = np.asarray(pre, np.int32)
+            vocab = (self.real_dit_cfg.prior_vocab
+                     if self.real_dit_cfg is not None
+                     else cfg.prior_vocab)
+            if pre_np.min() < 0 or pre_np.max() >= vocab:
+                # XLA would silently clamp out-of-range gather indices —
+                # wrong conditioning with no error
+                raise InvalidRequestError(
+                    f"prior_token_ids out of range [0, {vocab})")
+            prior_ids = jnp.asarray(pre_np)
+            if prior_ids.ndim == 1:
+                prior_ids = jnp.broadcast_to(prior_ids[None],
+                                             (b, prior_ids.shape[0]))
+            if prior_ids.shape != (b, seq_len):
+                raise InvalidRequestError(
+                    f"prior_token_ids must be [B, {seq_len}] at the DiT "
+                    f"grid; got {tuple(prior_ids.shape)}")
         else:
-            prior_ids = self._prior_fn(seq_len)(self.prior_params,
+            seed_ids = jnp.asarray(
+                np.asarray(ids)[:, :8] % cfg.prior_lm.vocab_size,
+                jnp.int32)
+            if grid_h % 2 == 0 and grid_w % 2 == 0:
+                ph, pw = grid_h // 2, grid_w // 2
+                small = self._prior_fn(ph * pw)(self.prior_params,
                                                 seed_ids)
+                prior_ids = self.upsample_prior_ids(small, ph, pw)
+            else:
+                prior_ids = self._prior_fn(seq_len)(self.prior_params,
+                                                    seed_ids)
+            if self.real_dit_params is not None:
+                logger.warning(
+                    "GLM-Image real-weight run without "
+                    "prior_token_ids: using the random-init AR prior")
+            prior_ids = prior_ids % (
+                self.real_dit_cfg.prior_vocab
+                if self.real_dit_cfg is not None else cfg.prior_vocab)
 
         steps = max(1, sp.num_inference_steps)
         sched_len = max(steps, cfg.steps_bucket)
-        schedule = fm.make_schedule(steps, shift=1.0)
+        schedule = fm.make_schedule(steps,
+                                    shift=getattr(self, "shift", 1.0))
         sigmas = jnp.zeros((sched_len + 1,)).at[: steps + 1].set(
             schedule.sigmas)
         timesteps = jnp.zeros((sched_len,)).at[:steps].set(
@@ -288,9 +445,13 @@ class GlmImagePipeline:
 
         seed = (sp.seed if sp.seed is not None
                 else int(np.random.randint(0, 2 ** 31 - 1)))
+        in_ch = (self.real_dit_cfg.patch_size ** 2
+                 * self.real_dit_cfg.in_channels
+                 if self.real_dit_cfg is not None
+                 else cfg.dit.in_channels)
         noise = jax.random.normal(
             jax.random.PRNGKey(seed),
-            (b, seq_len, cfg.dit.in_channels), jnp.float32,
+            (b, seq_len, in_ch), jnp.float32,
         ).astype(self.dtype)
 
         crop = sp.extra.get("crop_coords", (0, 0)) \
@@ -299,12 +460,21 @@ class GlmImagePipeline:
             np.broadcast_to(np.array(
                 [sp.height, sp.width, crop[0], crop[1]], np.float32),
                 (b, 4)))
-        run = self._denoise_fn(grid_h, grid_w, sched_len)
-        latents = run(self.dit_params, self.glm_params, noise, txt,
-                      mask, prior_ids, cond_vals, sigmas, timesteps,
-                      jnp.float32(sp.guidance_scale), jnp.int32(steps))
+        if self.real_dit_params is not None:
+            run = self._real_denoise_fn(grid_h, grid_w, sched_len)
+            latents = run(self.real_dit_params, noise, txt, mask,
+                          prior_ids, cond_vals, sigmas, timesteps,
+                          jnp.float32(sp.guidance_scale),
+                          jnp.int32(steps))
+        else:
+            run = self._denoise_fn(grid_h, grid_w, sched_len)
+            latents = run(self.dit_params, self.glm_params, noise, txt,
+                          mask, prior_ids, cond_vals, sigmas, timesteps,
+                          jnp.float32(sp.guidance_scale),
+                          jnp.int32(steps))
 
-        p = cfg.dit.patch_size
+        p = (self.real_dit_cfg.patch_size
+             if self.real_dit_cfg is not None else cfg.dit.patch_size)
         c = cfg.vae.latent_channels
         x = latents.reshape(b, grid_h, grid_w, p, p, c)
         x = x.transpose(0, 1, 3, 2, 4, 5).reshape(

@@ -51,3 +51,15 @@ def tiny_factory():
     cfg = tiny_config()
     params = init_params(jax.random.PRNGKey(10), cfg, jnp.float32)
     return params, cfg, None
+
+
+def real_factory(model_dir: str, dtype="bfloat16", **kw):
+    """Arch-registry front door: load the REAL thinker LM from a
+    Qwen2.5-Omni checkpoint directory (the loader the family's stage
+    YAML names, stage_configs/qwen2_5_omni.yaml:10-15)."""
+    from vllm_omni_tpu.model_loader.hf_qwen import load_qwen_lm
+
+    return load_qwen_lm(
+        model_dir, dtype=dtype,
+        hf_config_name="thinker_config.text_config",
+        submodel="thinker", **kw)

@@ -56,3 +56,15 @@ def tiny_factory():
     cfg = tiny_config()
     params = init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
     return params, cfg, None
+
+
+def real_factory(model_dir: str, dtype="bfloat16", **kw):
+    """Arch-registry front door: load the REAL thinker LM from a
+    Qwen3-Omni checkpoint directory (the same loader the family's stage
+    YAML names, stage_configs/qwen3_omni_moe.yaml:11-16)."""
+    from vllm_omni_tpu.model_loader.hf_qwen import load_qwen_lm
+
+    return load_qwen_lm(
+        model_dir, dtype=dtype,
+        hf_config_name="thinker_config.text_config",
+        submodel="thinker", **kw)

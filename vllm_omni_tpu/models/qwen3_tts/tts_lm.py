@@ -57,6 +57,15 @@ def tiny_factory():
     return params, cfg, TINY_EOS
 
 
+def real_factory(model_dir: str, dtype="bfloat16", **kw):
+    """Arch-registry front door: load the REAL TTS LM from a checkpoint
+    directory (the loader the family's stage YAML names,
+    stage_configs/qwen3_tts.yaml:13-16)."""
+    from vllm_omni_tpu.model_loader.hf_qwen import load_qwen_lm
+
+    return load_qwen_lm(model_dir, dtype=dtype, **kw)
+
+
 def codec_ids_from_lm_tokens(token_ids, codec_offset: int = TINY_CODEC_OFFSET,
                              codec_vocab: int = TINY_CODEC_VOCAB):
     """Strip non-codec tokens and remove the vocabulary offset (the LM's

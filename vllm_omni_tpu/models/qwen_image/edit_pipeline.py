@@ -134,13 +134,16 @@ class QwenImageEditPipeline(QwenImagePipeline):
     def forward(self, req):
         # stash the condition images so the HF text encode can feed them
         # through the vision tower (the reference conditions the prompt
-        # embeddings on the image as well as the VAE latents)
+        # embeddings on the image as well as the VAE latents); the ViT
+        # features cache per request — positive and negative encodes
+        # share them
         if self.hf_tokenizer is not None and self.vt_params is not None:
             self._pending_images = self._cond_images(req)
         try:
             return super().forward(req)
         finally:
             self._pending_images = None
+            self._vit_cache = None
 
     def _encode_prompt_hf(self, prompts: list[str]):
         images = self._pending_images
@@ -156,18 +159,22 @@ class QwenImageEditPipeline(QwenImagePipeline):
 
         tok = self.hf_tokenizer
         pad_id = tok.convert_tokens_to_ids("<|image_pad|>")
-        feats_list, grids = [], []
-        for img in images:
-            # _cond_images yields [-1, 1] floats (the VAE convention);
-            # the ViT preprocessing expects [0, 1]
-            img01 = np.clip((np.asarray(img) + 1.0) / 2.0, 0.0, 1.0)
-            pixels, (t, gh, gw) = flatten_image(
-                img01, self.vt_cfg, max_pixels=self.vl_max_pixels)
-            f = self._vt_jit(self.vt_params, self.vt_cfg,
-                             jnp.asarray(pixels), (t, gh, gw))
-            sm = self.vt_cfg.spatial_merge_size
-            feats_list.append(np.asarray(f, np.float32))
-            grids.append((t, gh // sm, gw // sm))
+        if getattr(self, "_vit_cache", None) is not None:
+            feats_list, grids = self._vit_cache
+        else:
+            feats_list, grids = [], []
+            for img in images:
+                # _cond_images yields [-1, 1] floats (the VAE
+                # convention); the ViT preprocessing expects [0, 1]
+                img01 = np.clip((np.asarray(img) + 1.0) / 2.0, 0.0, 1.0)
+                pixels, (t, gh, gw) = flatten_image(
+                    img01, self.vt_cfg, max_pixels=self.vl_max_pixels)
+                f = self._vt_jit(self.vt_params, self.vt_cfg,
+                                 jnp.asarray(pixels), (t, gh, gw))
+                sm = self.vt_cfg.spatial_merge_size
+                feats_list.append(np.asarray(f, np.float32))
+                grids.append((t, gh // sm, gw // sm))
+            self._vit_cache = (feats_list, grids)
 
         spans = "".join(
             (f"Picture {i + 1}: {VISION_SPAN}" if len(images) > 1

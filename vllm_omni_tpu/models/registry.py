@@ -124,23 +124,39 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     ),
 }
 
-# AR architectures -> the family's entry-stage (thinker/LM) factory.
-# Stage YAMLs address stages by explicit `model_factory` strings; this
-# registry is the arch-name front door (reference:
-# model_executor/models/registry.py:65 — e.g.
+# AR architectures -> the family's entry-stage (thinker/LM) REAL
+# checkpoint factory.  Stage YAMLs address stages by explicit
+# `model_factory` strings; this registry is the arch-name front door
+# (reference: model_executor/models/registry.py:65 — e.g.
 # Qwen3OmniMoeForConditionalGeneration): resolve(arch) returns a
-# callable -> (params, TransformerConfig, eos_token_id) for the family's
-# entry stage.  Downstream stages (talker/code2wav/...) stay per-stage
-# factories in the family's stage YAML.
+# callable (model_dir, **kw) -> (params, TransformerConfig,
+# eos_token_id) that LOADS the checkpoint — never a random-init toy
+# (tiny factories stay reachable only via their explicit module paths,
+# e.g. "...thinker:tiny_factory").  Downstream stages
+# (talker/code2wav/...) stay per-stage factories in the family's stage
+# YAML.
 _AR_MODELS: dict[str, _Entry] = {
     "Qwen3OmniMoeForConditionalGeneration": _Entry(
-        "vllm_omni_tpu.models.qwen3_omni.thinker", "tiny_factory"
+        "vllm_omni_tpu.models.qwen3_omni.thinker", "real_factory"
     ),
     "Qwen2_5OmniForConditionalGeneration": _Entry(
-        "vllm_omni_tpu.models.qwen2_5_omni.thinker", "tiny_factory"
+        "vllm_omni_tpu.models.qwen2_5_omni.thinker", "real_factory"
+    ),
+    "Qwen2_5OmniModel": _Entry(
+        "vllm_omni_tpu.models.qwen2_5_omni.thinker", "real_factory"
     ),
     "Qwen3TTSForConditionalGeneration": _Entry(
-        "vllm_omni_tpu.models.qwen3_tts.tts_lm", "tiny_factory"
+        "vllm_omni_tpu.models.qwen3_tts.tts_lm", "real_factory"
+    ),
+    # plain Qwen LMs serve through the same engine (single-stage llm)
+    "Qwen2ForCausalLM": _Entry(
+        "vllm_omni_tpu.model_loader.hf_qwen", "load_qwen_lm"
+    ),
+    "Qwen3ForCausalLM": _Entry(
+        "vllm_omni_tpu.model_loader.hf_qwen", "load_qwen_lm"
+    ),
+    "Qwen3MoeForCausalLM": _Entry(
+        "vllm_omni_tpu.model_loader.hf_qwen", "load_qwen_lm"
     ),
 }
 
