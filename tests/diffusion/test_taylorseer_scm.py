@@ -1,0 +1,161 @@
+"""TaylorSeer calibrator + SCM step masking (reference: cache-dit
+TaylorSeerCalibratorConfig / scm_steps_mask, cache_dit_backend.py:17,
+46-55).
+
+The decisive property test: with a velocity field LINEAR in the step
+index, first-order Taylor extrapolation through the computed anchors
+reconstructs skipped steps exactly — the dense loop and the
+aggressively-skipping taylorseer loop integrate to the same latents
+(plain value-holding teacache provably cannot).  SCM tests pin the
+deterministic skip schedule semantics.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion import cache as sc
+from vllm_omni_tpu.diffusion import scheduler as fm
+
+
+def _schedule(steps, sched_len=16):
+    s = fm.make_schedule(steps, shift=1.0)
+    sigmas = jnp.zeros((sched_len + 1,)).at[: steps + 1].set(s.sigmas)
+    timesteps = jnp.zeros((sched_len,)).at[:steps].set(s.timesteps)
+    return fm.FlowMatchSchedule(sigmas=sigmas, timesteps=timesteps)
+
+
+def _run(cache_cfg, eval_velocity, steps=12, shape=(1, 8, 4)):
+    sched = _schedule(steps)
+    lat0 = jnp.asarray(
+        np.random.default_rng(0).standard_normal(shape), jnp.float32)
+    lat, skipped = sc.run_denoise_loop(
+        cache_cfg, sched, eval_velocity, lat0, jnp.int32(steps))
+    return np.asarray(lat), int(skipped)
+
+
+def _linear_field(shape=(1, 8, 4)):
+    g = np.random.default_rng(1)
+    a = jnp.asarray(g.standard_normal(shape), jnp.float32)
+    b = jnp.asarray(g.standard_normal(shape), jnp.float32)
+
+    def eval_velocity(lat, i):
+        # depends ONLY on the step index, linearly — exactly
+        # representable by a first-order Taylor step
+        return a + b * i.astype(jnp.float32)
+
+    return eval_velocity
+
+
+def test_taylor_order1_exact_on_linear_field():
+    ev = _linear_field()
+    dense, s0 = _run(None, ev)
+    assert s0 == 0
+    cfg = sc.StepCacheConfig(backend="taylorseer",
+                             rel_l1_threshold=1e9,  # skip whenever legal
+                             warmup_steps=2, tail_steps=1)
+    fast, s1 = _run(cfg, ev)
+    # 12 steps: 0,1 warm up, 11 is the tail anchor => 9 skipped
+    assert s1 == 9
+    np.testing.assert_allclose(fast, dense, atol=1e-4, rtol=1e-4)
+
+
+def test_taylor_beats_holding_on_linear_field():
+    ev = _linear_field()
+    dense, _ = _run(None, ev)
+    taylor, st = _run(sc.StepCacheConfig(
+        backend="taylorseer", rel_l1_threshold=1e9, warmup_steps=2,
+        tail_steps=1), ev)
+    hold, sh = _run(sc.StepCacheConfig(
+        backend="teacache", rel_l1_threshold=1e9, warmup_steps=2,
+        tail_steps=1), ev)
+    assert st == sh  # same skip schedule
+    err_t = np.abs(taylor - dense).max()
+    err_h = np.abs(hold - dense).max()
+    assert err_t < err_h * 0.1, (err_t, err_h)
+
+
+def test_taylor_order2_exact_on_quadratic_field():
+    g = np.random.default_rng(2)
+    shape = (1, 8, 4)
+    a = jnp.asarray(g.standard_normal(shape), jnp.float32)
+    b = jnp.asarray(g.standard_normal(shape), jnp.float32)
+    c = jnp.asarray(0.1 * g.standard_normal(shape), jnp.float32)
+
+    def ev(lat, i):
+        t = i.astype(jnp.float32)
+        return a + b * t + c * t * t
+
+    dense, _ = _run(None, ev)
+    # SCM mask: compute every third step so three anchors accumulate
+    mask = tuple(i % 3 == 0 for i in range(12))
+    o2, _ = _run(sc.StepCacheConfig(
+        backend="taylorseer", taylor_order=2, warmup_steps=3,
+        tail_steps=1, scm_steps_mask=mask), ev)
+    o1, _ = _run(sc.StepCacheConfig(
+        backend="taylorseer", taylor_order=1, warmup_steps=3,
+        tail_steps=1, scm_steps_mask=mask), ev)
+    err2 = np.abs(o2 - dense).max()
+    err1 = np.abs(o1 - dense).max()
+    # quadratic field: order 2 reconstructs exactly, order 1 cannot
+    assert err2 < 1e-3, err2
+    assert err2 < err1 * 0.5, (err2, err1)
+
+
+def test_scm_mask_pins_skip_schedule():
+    ev = _linear_field()
+    mask = (True, True, False, True, False, False, True, True, False,
+            True, True, True)
+    cfg = sc.StepCacheConfig(backend="taylorseer", warmup_steps=2,
+                             tail_steps=1, scm_steps_mask=mask)
+    _, skipped = _run(cfg, ev)
+    # skips = masked-False steps inside the window [2, 11)
+    want = sum(1 for i in range(2, 11) if not mask[i])
+    assert skipped == want
+
+
+def test_scm_all_compute_matches_dense_exactly():
+    ev = _linear_field()
+    dense, _ = _run(None, ev)
+    out, skipped = _run(sc.StepCacheConfig(
+        backend="taylorseer", scm_steps_mask=(True,) * 12), ev)
+    assert skipped == 0
+    np.testing.assert_array_equal(out, dense)
+
+
+def test_scm_with_teacache_backend():
+    ev = _linear_field()
+    mask = tuple(i % 2 == 0 for i in range(12))
+    out, skipped = _run(sc.StepCacheConfig(
+        backend="teacache", warmup_steps=1, tail_steps=1,
+        scm_steps_mask=mask), ev)
+    want = sum(1 for i in range(1, 11) if not mask[i])
+    assert skipped == want
+    assert np.isfinite(out).all()
+
+
+def test_taylorseer_through_engine_pipeline():
+    """Engine-level wiring: a tiny QwenImage pipeline with the
+    taylorseer backend skips steps and still renders."""
+    from vllm_omni_tpu.diffusion.cache import StepCacheConfig
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.qwen_image.pipeline import (
+        QwenImagePipeline,
+        QwenImagePipelineConfig,
+    )
+
+    pipe = QwenImagePipeline(
+        QwenImagePipelineConfig.tiny(), dtype=jnp.float32, seed=0,
+        cache_config=StepCacheConfig(backend="taylorseer",
+                                     rel_l1_threshold=10.0,
+                                     warmup_steps=2, tail_steps=1))
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=8, guidance_scale=4.0,
+        seed=0)
+    out = pipe.forward(OmniDiffusionRequest(
+        prompt=["a cat"], sampling_params=sp, request_ids=["r"]))[0]
+    assert out.data.shape == (32, 32, 3)
+    assert pipe.last_skipped_steps == 5  # 8 steps - 2 warmup - 1 tail

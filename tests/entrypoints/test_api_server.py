@@ -316,3 +316,59 @@ def test_chat_n_choices(server_url):
         "messages": [{"role": "user", "content": "hi"}], "n": 99,
     }, timeout=60)
     assert r2.status_code == 400
+
+
+def _write_peft_lora(adapter_dir, module, in_dim, out_dim, scale,
+                     rank=4):
+    """PEFT-named adapter on disk (reference fixture shape:
+    tests/e2e/online_serving/test_images_generations_lora.py:44-75)."""
+    import os
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    os.makedirs(adapter_dir, exist_ok=True)
+    g = np.random.default_rng(0)
+    a = (0.5 * g.standard_normal((rank, in_dim))).astype(np.float32)
+    b = (scale * g.standard_normal((out_dim, rank))).astype(np.float32)
+    save_file({
+        f"base_model.model.{module}.lora_A.weight": a,
+        f"base_model.model.{module}.lora_B.weight": b,
+    }, os.path.join(adapter_dir, "adapter_model.safetensors"))
+
+
+def test_images_generations_per_request_lora(diffusion_server_url,
+                                             tmp_path_factory):
+    """Per-request LoRA through the Images API: {name, path, scale}
+    loads on first use, changes the output, and the base behavior
+    survives (reference: test_images_generations_lora.py)."""
+    tmp = tmp_path_factory.mktemp("loras")
+    # the tiny QwenImagePipeline DiT: blocks.0.to_q is [128, 128]
+    _write_peft_lora(str(tmp / "a"), "blocks.0.to_q", 128, 128,
+                     scale=0.5)
+
+    def gen(payload_extra):
+        r = httpx.post(
+            f"{diffusion_server_url}/v1/images/generations",
+            json={"prompt": "a red square", "size": "32x32",
+                  "num_inference_steps": 2, "seed": 7,
+                  **payload_extra}, timeout=300)
+        assert r.status_code == 200, r.text
+        return base64.b64decode(r.json()["data"][0]["b64_json"])
+
+    base = gen({})
+    lora = gen({"lora": {"name": "a", "path": str(tmp / "a"),
+                         "scale": 8.0}})
+    assert lora != base
+    # adapter already registered: name-only activation works
+    lora2 = gen({"lora": {"name": "a", "scale": 8.0}})
+    assert lora2 == lora
+    # base restored after per-request fusion
+    again = gen({})
+    assert again == base
+    # malformed lora object is a 400, not a stage crash
+    r = httpx.post(
+        f"{diffusion_server_url}/v1/images/generations",
+        json={"prompt": "x", "size": "32x32", "lora": {"scale": 2.0}},
+        timeout=60)
+    assert r.status_code == 400

@@ -1,0 +1,200 @@
+"""GGUF checkpoint intake (reference: arg_utils.py:96-97 gguf
+load_format).  A synthetic GGUF is written from known weights; the
+loader must reproduce the safetensors-loaded model exactly (F32/F16)
+and within quantization error (Q8_0), end to end through engine
+generation and the auto-factory front door."""
+
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.model_loader import gguf_loader as gg
+from vllm_omni_tpu.models.common import transformer as tfm
+
+
+# --------------------------------------------------------- GGUF writer
+def _w_string(out, s: str):
+    b = s.encode()
+    out.append(struct.pack("<Q", len(b)))
+    out.append(b)
+
+
+def _w_kv(out, key, vtype, value):
+    _w_string(out, key)
+    out.append(struct.pack("<I", vtype))
+    if vtype == 4:
+        out.append(struct.pack("<I", value))
+    elif vtype == 6:
+        out.append(struct.pack("<f", value))
+    elif vtype == 8:
+        _w_string(out, value)
+    else:
+        raise ValueError(vtype)
+
+
+def _q8_0(arr: np.ndarray) -> bytes:
+    flat = arr.reshape(-1, 32).astype(np.float32)
+    scales = (np.abs(flat).max(axis=1) / 127.0).astype(np.float32)
+    scales = np.where(scales == 0, 1e-8, scales)
+    q = np.clip(np.round(flat / scales[:, None]), -127, 127).astype(
+        np.int8)
+    blocks = np.zeros((flat.shape[0], 34), np.uint8)
+    blocks[:, :2] = scales.astype(np.float16)[:, None].view(np.uint8)
+    blocks[:, 2:] = q.view(np.uint8)
+    return blocks.tobytes()
+
+
+def write_gguf(path, meta: dict, tensors: dict, q8_names=()):
+    """meta: {key: (vtype, value)}; tensors: {name: np.ndarray fp32}."""
+    out = [b"GGUF", struct.pack("<I", 3),
+           struct.pack("<Q", len(tensors)),
+           struct.pack("<Q", len(meta))]
+    for k, (vt, v) in meta.items():
+        _w_kv(out, k, vt, v)
+    blobs, offset = [], 0
+    for name, arr in tensors.items():
+        _w_string(out, name)
+        dims = arr.shape[::-1]  # ggml innermost-first
+        out.append(struct.pack("<I", len(dims)))
+        for d in dims:
+            out.append(struct.pack("<Q", d))
+        if name in q8_names:
+            ttype, blob = gg.GGML_Q8_0, _q8_0(arr)
+        else:
+            ttype, blob = gg.GGML_F32, arr.astype(np.float32).tobytes()
+        out.append(struct.pack("<I", ttype))
+        out.append(struct.pack("<Q", offset))
+        blob += b"\0" * ((-len(blob)) % 32)
+        blobs.append(blob)
+        offset += len(blob)
+    header = b"".join(out)
+    pad = (-len(header)) % 32
+    with open(path, "wb") as f:
+        f.write(header + b"\0" * pad + b"".join(blobs))
+
+
+def _tiny_cfg():
+    return tfm.TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=8, intermediate_size=48,
+        rope_theta=1e6, qk_norm=False, attention_bias=True)
+
+
+def _export_tensors(params, cfg):
+    """Our param tree -> GGUF-named torch-layout ([out, in]) tensors."""
+    t = {
+        "token_embd.weight": np.asarray(params["embed"]["w"]),
+        "output_norm.weight": np.asarray(params["final_norm"]["w"]),
+        "output.weight": np.asarray(params["lm_head"]["w"]).T,
+    }
+    inter = cfg.intermediate_size
+    for i, layer in enumerate(params["layers"]):
+        b = f"blk.{i}"
+        t[f"{b}.attn_norm.weight"] = np.asarray(layer["input_norm"]["w"])
+        t[f"{b}.ffn_norm.weight"] = np.asarray(layer["post_norm"]["w"])
+        for gg_, ours in (("attn_q", "q_proj"), ("attn_k", "k_proj"),
+                          ("attn_v", "v_proj"),
+                          ("attn_output", "o_proj")):
+            t[f"{b}.{gg_}.weight"] = np.asarray(layer[ours]["w"]).T
+            if "b" in layer[ours]:
+                t[f"{b}.{gg_}.bias"] = np.asarray(layer[ours]["b"])
+        gu = np.asarray(layer["gate_up"]["w"])
+        t[f"{b}.ffn_gate.weight"] = gu[:, :inter].T
+        t[f"{b}.ffn_up.weight"] = gu[:, inter:].T
+        t[f"{b}.ffn_down.weight"] = np.asarray(layer["down"]["w"]).T
+    return t
+
+
+_META = {
+    "general.architecture": (8, "qwen2"),
+    "qwen2.block_count": (4, 2),
+    "qwen2.embedding_length": (4, 32),
+    "qwen2.attention.head_count": (4, 4),
+    "qwen2.attention.head_count_kv": (4, 2),
+    "qwen2.attention.key_length": (4, 8),
+    "qwen2.feed_forward_length": (4, 48),
+    "qwen2.rope.freq_base": (6, 1e6),
+    "qwen2.attention.layer_norm_rms_epsilon": (6, 1e-6),
+    "tokenizer.ggml.eos_token_id": (4, 2),
+}
+
+
+@pytest.fixture(scope="module")
+def gguf_pair(tmp_path_factory):
+    import jax
+
+    cfg = _tiny_cfg()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    tensors = _export_tensors(params, cfg)
+    d = tmp_path_factory.mktemp("gguf")
+    write_gguf(str(d / "model-f32.gguf"), _META, tensors)
+    write_gguf(str(d / "model-q8.gguf"), _META, tensors,
+               q8_names={n for n, a in tensors.items()
+                         if a.ndim == 2 and a.size % 32 == 0})
+    return d, params, cfg
+
+
+def test_gguf_f32_exact(gguf_pair):
+    d, params, cfg = gguf_pair
+    loaded, lcfg, eos = gg.load_gguf_lm(str(d / "model-f32.gguf"),
+                                        dtype="float32")
+    assert eos == 2
+    assert lcfg.num_layers == cfg.num_layers
+    assert lcfg.attention_bias and not lcfg.qk_norm
+    import jax
+
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params),
+            jax.tree_util.tree_leaves_with_path(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, err_msg=str(pa))
+
+
+def test_gguf_q8_close_logits(gguf_pair):
+    d, params, cfg = gguf_pair
+    loaded, lcfg, _ = gg.load_gguf_lm(str(d / "model-q8.gguf"),
+                                      dtype="float32")
+    ids = jnp.asarray([[1, 17, 42, 9]])
+    ours = tfm.logits_from_hidden(
+        params, cfg, tfm.forward_hidden(params, cfg, ids)[0, -1])
+    theirs = tfm.logits_from_hidden(
+        loaded, lcfg, tfm.forward_hidden(loaded, lcfg, ids)[0, -1])
+    # Q8_0 quantization noise, but the argmax must survive
+    np.testing.assert_allclose(np.asarray(theirs), np.asarray(ours),
+                               atol=0.2, rtol=0.2)
+    assert int(jnp.argmax(ours)) == int(jnp.argmax(theirs))
+
+
+def test_gguf_through_stage_auto_factory(gguf_pair):
+    """Omni single-stage llm with a bare .gguf model path resolves the
+    GGUF intake automatically (no model_factory in the config)."""
+    from vllm_omni_tpu.config.stage import StageConfig
+    from vllm_omni_tpu.entrypoints.omni import Omni
+
+    d, params, cfg = gguf_pair
+    sc = StageConfig(
+        stage_id=0, stage_type="llm",
+        engine_args={"model": str(d / "model-f32.gguf"),
+                     "num_pages": 64, "page_size": 4,
+                     "max_model_len": 64,
+                     "model_factory_args": {"dtype": "float32"}},
+        engine_input_source=[-1], final_output=True,
+        default_sampling_params={"temperature": 0.0, "max_tokens": 4},
+    )
+    omni = Omni(stage_configs=[sc])
+    outs = omni.generate([[1, 17, 42]])
+    got = outs[0].outputs[0].token_ids
+    assert len(got) == 4
+
+    # oracle: direct engine on the same weights
+    from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=64, page_size=4, max_model_len=64,
+        dtype=jnp.float32), eos_token_id=2)
+    want = eng.generate([[1, 17, 42]], SamplingParams(
+        temperature=0.0, max_tokens=4))[0].outputs[0].token_ids
+    assert got == want

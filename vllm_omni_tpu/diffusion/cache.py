@@ -19,7 +19,7 @@ the ``fori_loop`` carry.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +28,11 @@ import jax.numpy as jnp
 @dataclass(frozen=True)
 class StepCacheConfig:
     # "teacache": input-drift gate skipping the WHOLE model eval
+    # "taylorseer": like teacache, but skipped steps EXTRAPOLATE the
+    #   velocity with a first/second-order Taylor step from finite
+    #   differences of past computed evals instead of holding the last
+    #   value (reference: cache-dit TaylorSeerCalibratorConfig,
+    #   cache/cache_dit_backend.py:17)
     # "dbcache": dual-block cache (reference:
     #   diffusion/cache/cache_dit_backend.py DBCacheConfig) — the first
     #   ``fn_compute_blocks`` transformer blocks ALWAYS compute (a fresh
@@ -42,6 +47,14 @@ class StepCacheConfig:
     tail_steps: int = 1
     # dbcache: number of leading blocks always computed
     fn_compute_blocks: int = 4
+    # taylorseer: extrapolation order (1 = linear, 2 = quadratic)
+    taylor_order: int = 1
+    # SCM (Step Computation Masking, reference cache-dit
+    # scm_steps_mask, cache_dit_backend.py:46-55): a DETERMINISTIC
+    # compute mask over step indices replacing the drift gate — entry i
+    # True => step i computes, False => the cache serves it (warmup/
+    # tail anchors still always compute).  None => dynamic drift gate.
+    scm_steps_mask: "Optional[tuple]" = None
 
     @property
     def enabled(self) -> bool:
@@ -51,6 +64,9 @@ class StepCacheConfig:
     def from_dict(backend: str, d: dict) -> "StepCacheConfig":
         known = {k: v for k, v in (d or {}).items()
                  if k in StepCacheConfig.__dataclass_fields__ and k != "backend"}
+        if "scm_steps_mask" in known and known["scm_steps_mask"] is not None:
+            known["scm_steps_mask"] = tuple(
+                bool(x) for x in known["scm_steps_mask"])
         return StepCacheConfig(backend=backend, **known)
 
 
@@ -71,6 +87,7 @@ def cached_eval(
     carry,
     i: jax.Array,
     num_steps: jax.Array,
+    scm_mask=None,
 ):
     """Evaluate (or reuse) the velocity for this step.
 
@@ -87,7 +104,13 @@ def cached_eval(
     in_window = (i >= cache_cfg.warmup_steps) & (
         i < num_steps - cache_cfg.tail_steps
     )
-    skip = in_window & (accum_new < cache_cfg.rel_l1_threshold)
+    # a reusable velocity exists only after the first compute (accum is
+    # +inf until then) — the SCM mask must not serve init_carry's zeros
+    computed_once = jnp.isfinite(accum_new)
+    if scm_mask is not None:
+        skip = in_window & computed_once & ~scm_mask[i]
+    else:
+        skip = in_window & (accum_new < cache_cfg.rel_l1_threshold)
 
     def do_skip(_):
         # reuse the previous velocity; keep accumulating drift
@@ -101,6 +124,89 @@ def cached_eval(
 
     v, new_prev_lat, new_accum = jax.lax.cond(skip, do_skip, do_compute, None)
     return v, (v, new_prev_lat, new_accum), skip
+
+
+def _scm_mask_array(cache_cfg: StepCacheConfig, sched_len: int):
+    """Padded compute-mask [sched_len] from the config's tuple (True
+    beyond the configured range so over-length schedules stay exact)."""
+    import numpy as np
+
+    m = np.ones((sched_len,), bool)
+    mask = cache_cfg.scm_steps_mask
+    n = min(len(mask), sched_len)
+    m[:n] = np.asarray(mask[:n], bool)
+    return jnp.asarray(m)
+
+
+def taylor_init_carry(latents: jax.Array):
+    """(v0, v1, v2, i0, i1, i2, prev_lat, accum): the last THREE
+    computed velocities with their step indices (Newton
+    divided-difference anchors, oldest first) plus the last computed
+    input and the rel-L1 drift accumulator."""
+    z = jnp.zeros_like(latents)
+    return (z, z, z,
+            jnp.asarray(-3, jnp.int32), jnp.asarray(-2, jnp.int32),
+            jnp.asarray(-1, jnp.int32),
+            latents, jnp.asarray(jnp.inf, jnp.float32))
+
+
+def taylorseer_eval(
+    cache_cfg: StepCacheConfig,
+    eval_fn: Callable[[jax.Array], jax.Array],
+    latents: jax.Array,
+    carry,
+    i: jax.Array,
+    num_steps: jax.Array,
+    scm_mask=None,
+):
+    """Evaluate, or Taylor-extrapolate, the velocity for this step.
+
+    Skipped steps advance the last computed velocity along its Newton
+    divided-difference derivative(s) through the last 2 (order 1) or 3
+    (order 2) computed anchors instead of holding it — the calibrator
+    idea of cache-dit's TaylorSeer.  Returns
+    (velocity, new_carry, skipped_flag)."""
+    v0, v1, v2, i0, i1, i2, prev_lat, accum = carry
+    diff = jnp.mean(jnp.abs(
+        latents.astype(jnp.float32) - prev_lat.astype(jnp.float32)))
+    base = jnp.mean(jnp.abs(prev_lat.astype(jnp.float32)))
+    rel = diff / jnp.maximum(base, 1e-8)
+    accum_new = accum + rel
+
+    in_window = (i >= cache_cfg.warmup_steps) & (
+        i < num_steps - cache_cfg.tail_steps
+    )
+    # a valid derivative needs at least two computed anchors
+    have_two = i1 >= 0
+    if scm_mask is not None:
+        skip = in_window & have_two & ~scm_mask[i]
+    else:
+        skip = in_window & have_two & (
+            accum_new < cache_cfg.rel_l1_threshold)
+
+    def do_skip(_):
+        f = jnp.float32
+        t, t1, t2 = i.astype(f), i1.astype(f), i2.astype(f)
+        d21 = (v2.astype(f) - v1.astype(f)) / jnp.maximum(t2 - t1, 1.0)
+        v = v2.astype(f) + d21 * (t - t2)
+        if cache_cfg.taylor_order >= 2:
+            t0 = i0.astype(f)
+            have_three = (i0 >= 0).astype(f)
+            d10 = (v1.astype(f) - v0.astype(f)) / jnp.maximum(
+                t1 - t0, 1.0)
+            d210 = (d21 - d10) / jnp.maximum(t2 - t0, 1.0)
+            # Newton form through (t1, t2): + d2 * (t-t2)(t-t1)
+            v = v + have_three * d210 * (t - t2) * (t - t1)
+        return (v.astype(v2.dtype),
+                (v0, v1, v2, i0, i1, i2, prev_lat, accum_new))
+
+    def do_compute(_):
+        v = eval_fn(latents).astype(v2.dtype)
+        return (v, (v1, v2, v, i1, i2, i,
+                    latents, jnp.asarray(0.0, jnp.float32)))
+
+    v, new_carry = jax.lax.cond(skip, do_skip, do_compute, None)
+    return v, new_carry, skip
 
 
 def dbcache_init_carry(latents: jax.Array):
@@ -172,11 +278,19 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
     multistep = solver == "unipc"
     use_cache = cache_cfg is not None and cache_cfg.enabled
     use_dbcache = use_cache and cache_cfg.backend == "dbcache"
+    use_taylor = use_cache and cache_cfg.backend == "taylorseer"
+    scm_mask = None
+    if use_cache and cache_cfg.scm_steps_mask is not None:
+        scm_mask = _scm_mask_array(cache_cfg, int(schedule.sigmas.shape[0]))
     if use_dbcache and eval_split is None:
         raise ValueError(
             "dbcache needs the pipeline's split evaluation "
             "(eval_first, eval_rest) — this pipeline only supports "
             "teacache")
+    if use_dbcache and scm_mask is not None:
+        raise ValueError(
+            "scm_steps_mask is not wired into the dbcache backend — "
+            "use teacache or taylorseer for deterministic step masks")
 
     def ms_init(lat):
         return (jnp.zeros_like(lat, jnp.float32),
@@ -208,13 +322,31 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
         )
         return lat, skipped
 
+    if use_taylor:
+
+        def body(i, carry):
+            lat, cc, ms, skipped = carry
+            v, cc, skip = taylorseer_eval(
+                cache_cfg, lambda l: eval_velocity(l, i), lat, cc, i,
+                num_steps, scm_mask=scm_mask,
+            )
+            lat, ms = advance(lat, v, i, ms)
+            return (lat, cc, ms, skipped + skip.astype(jnp.int32))
+
+        lat, _, _, skipped = jax.lax.fori_loop(
+            0, num_steps, body,
+            (latents, taylor_init_carry(latents), ms_init(latents),
+             jnp.asarray(0, jnp.int32)),
+        )
+        return lat, skipped
+
     if use_cache:
 
         def body(i, carry):
             lat, cc, ms, skipped = carry
             v, cc, skip = cached_eval(
                 cache_cfg, lambda l: eval_velocity(l, i), lat, cc, i,
-                num_steps,
+                num_steps, scm_mask=scm_mask,
             )
             lat, ms = advance(lat, v, i, ms)
             return (lat, cc, ms, skipped + skip.astype(jnp.int32))

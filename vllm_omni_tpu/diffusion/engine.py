@@ -72,10 +72,12 @@ class DiffusionEngine:
         logger.info("Building %s (size=%s dtype=%s)", arch, size or "default", dtype)
         cache_config = None
         if od_config.cache_backend:
-            if od_config.cache_backend not in ("teacache", "dbcache"):
+            if od_config.cache_backend not in ("teacache", "dbcache",
+                                              "taylorseer"):
                 raise ValueError(
                     f"unsupported cache_backend {od_config.cache_backend!r} "
-                    "(TPU path supports 'teacache' and 'dbcache')"
+                    "(TPU path supports 'teacache', 'dbcache', "
+                    "'taylorseer')"
                 )
             from vllm_omni_tpu.diffusion.cache import StepCacheConfig
 
@@ -318,8 +320,33 @@ class DiffusionEngine:
                 "per-request LoRA cannot combine with quantized weights"
             )
         if lora:
-            name, scale = ((lora, 1.0) if isinstance(lora, str)
-                           else (lora["name"], lora.get("scale", 1.0)))
+            if isinstance(lora, str):
+                name, scale, path = lora, 1.0, None
+            else:
+                name = lora.get("name")
+                scale = lora.get("scale", 1.0)
+                path = lora.get("path")
+            from vllm_omni_tpu.diffusion.request import (
+                InvalidRequestError,
+            )
+
+            if name is None:
+                raise InvalidRequestError("lora request needs a 'name'")
+            # serving-layer convenience (reference: per-request lora
+            # {name, path, scale} through the Images API,
+            # tests/e2e/online_serving/test_images_generations_lora.py):
+            # unseen adapters load on first use from their path; the
+            # SAME name with a DIFFERENT path reloads (serving the old
+            # weights silently would be a trap)
+            if path and (name not in self.lora_manager.adapter_names
+                         or self.lora_manager.source_path(name) != path):
+                self.load_lora(path, name)
+            if name not in self.lora_manager.adapter_names:
+                # a client naming typo is a 400, not a stage crash
+                raise InvalidRequestError(
+                    f"unknown lora adapter {name!r} (loaded: "
+                    f"{self.lora_manager.adapter_names}); pass a 'path' "
+                    "to load it")
             self.pipeline.dit_params = self.lora_manager.activate(
                 base, name, scale
             )

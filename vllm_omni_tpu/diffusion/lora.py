@@ -95,6 +95,14 @@ def _set_leaf(tree, path: tuple, value):
     return {**tree, path[0]: _set_leaf(tree[path[0]], path[1:], value)}
 
 
+def _default_path_map(mod: str) -> tuple:
+    for pre in ("base_model.model.", "transformer.", "diffusion_model."):
+        if mod.startswith(pre):
+            mod = mod[len(pre):]
+            break
+    return tuple(mod.split("."))
+
+
 class LoRAManager:
     """Adapter registry + fused-tree cache (reference manager semantics:
     load/cache/activate with scale; manager.py:33)."""
@@ -102,7 +110,9 @@ class LoRAManager:
     def __init__(self, path_map=None, max_cached: int = 4):
         # path_map: adapter module name -> tree path tuple; default maps
         # dotted module names directly ("layers.0.to_q" -> ("layers","0","to_q"))
-        self._path_map = path_map or (lambda mod: tuple(mod.split(".")))
+        # after stripping the wrapper prefixes published adapters carry
+        # (PEFT "base_model.model.", diffusers "transformer.")
+        self._path_map = path_map or _default_path_map
         self._adapters: dict[str, LoRAAdapter] = {}
         self._fused_cache: dict[tuple, object] = {}
         self._max_cached = max_cached
@@ -131,8 +141,17 @@ class LoRAManager:
     def register(self, adapter: LoRAAdapter) -> None:
         self._adapters[adapter.name] = adapter
 
+    def source_path(self, name: str) -> Optional[str]:
+        ad = self._adapters.get(name)
+        return getattr(ad, "source_path", None) if ad else None
+
     def load(self, path: str, name: Optional[str] = None) -> str:
         adapter = load_lora_adapter(path, name)
+        adapter.source_path = path
+        # a reload under the same name invalidates fused trees built
+        # against the previous weights
+        self._fused_cache = {k: v for k, v in self._fused_cache.items()
+                             if k[0] != adapter.name}
         self.register(adapter)
         return adapter.name
 

@@ -52,6 +52,37 @@ def _import_obj(path: str):
     return getattr(importlib.import_module(mod), attr)
 
 
+def _auto_llm_factory(model, stage_id: int):
+    """Resolve an llm stage's model factory from a bare ``model`` path:
+    a .gguf file takes the GGUF intake, a checkpoint directory resolves
+    its architecture through OmniModelRegistry (the arch front door —
+    reference: model resolution, model_executor/models/registry.py:65 +
+    arg_utils.py:96-97 gguf load_format).  Returns (factory, args)."""
+    import os
+
+    if isinstance(model, str) and model.endswith(".gguf") \
+            and os.path.isfile(model):
+        from vllm_omni_tpu.model_loader.gguf_loader import load_gguf_lm
+
+        return load_gguf_lm, {"model_dir": model}
+    if isinstance(model, str) and os.path.isdir(model):
+        from vllm_omni_tpu.config.stage import _arch_of
+        from vllm_omni_tpu.models.registry import OmniModelRegistry
+
+        arch = _arch_of(model)
+        if arch and arch in OmniModelRegistry.supported():
+            return (OmniModelRegistry.resolve(arch),
+                    {"model_dir": model})
+        raise ValueError(
+            f"stage {stage_id}: architecture {arch!r} not in the AR "
+            f"registry ({OmniModelRegistry.supported()}); set "
+            "engine_args.model_factory explicitly")
+    raise ValueError(
+        f"stage {stage_id}: llm stages need engine_args.model_factory "
+        "('pkg.mod:fn' -> (params, cfg, eos_id)) or a checkpoint "
+        "path/.gguf in engine_args.model")
+
+
 def _sp_equal(a: dict, b: dict) -> bool:
     """Value equality for merged sampling-param dicts, tolerating array
     values (conditioning tensors in ``extra``) that make plain dict ==
@@ -110,10 +141,16 @@ class OmniStage:
         if self.config.stage_type == "llm":
             factory = args.pop("model_factory", None)
             if factory is None:
-                raise ValueError(
-                    f"stage {self.stage_id}: llm stages need engine_args."
-                    "model_factory ('pkg.mod:fn' -> (params, cfg, eos_id))"
-                )
+                # arch front door: a bare ``model`` path resolves its
+                # loader from the checkpoint itself — a .gguf file via
+                # the GGUF intake (reference: arg_utils.py:96-97), a
+                # safetensors dir via config.json architectures
+                # (OmniModelRegistry)
+                factory, auto_args = _auto_llm_factory(
+                    args.get("model"), self.stage_id)
+                fa = args.get("model_factory_args") or {}
+                fa.update(auto_args)
+                args["model_factory_args"] = fa
             if isinstance(factory, str):
                 factory = _import_obj(factory)
             factory_args = args.pop("model_factory_args", {}) or {}

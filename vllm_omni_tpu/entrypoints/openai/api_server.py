@@ -622,6 +622,25 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             "choices": choices,
         })
 
+    def _apply_lora_field(self, body: dict, sp: dict):
+        """Per-request LoRA through the Images API (reference payload
+        {"lora": {"name", "path", "scale"}},
+        tests/e2e/online_serving/test_images_generations_lora.py).
+        Returns an error string after responding, or None."""
+        lora = body.get("lora")
+        if lora is None:
+            return None
+        if isinstance(lora, str):
+            lora = {"name": lora}
+        if not isinstance(lora, dict) or not (
+                lora.get("name") or lora.get("path")):
+            self._error(400, "lora must be {'name'|'path'[, 'scale']}")
+            return "bad lora"
+        lora = dict(lora)
+        lora.setdefault("name", lora.get("path"))
+        sp.setdefault("extra", {})["lora"] = lora
+        return None
+
     # ------------------------------------------------- images/generations
     def _images_generations(self, body: dict):
         prompt = body.get("prompt")
@@ -637,6 +656,9 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         for k in ("num_inference_steps", "guidance_scale", "seed"):
             if body.get(k) is not None:
                 sp[k] = body[k]
+        err = self._apply_lora_field(body, sp)
+        if err:
+            return
         n = int(body.get("n", 1))
         rid = f"img-{uuid.uuid4().hex[:16]}"
         # submit all n at once so the diffusion stage can batch them
@@ -679,6 +701,9 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         for k in ("num_inference_steps", "guidance_scale", "seed"):
             if body.get(k) is not None:
                 sp[k] = body[k]
+        err = self._apply_lora_field(body, sp)
+        if err:
+            return
         sp["image"] = img
         rid = f"imgedit-{uuid.uuid4().hex[:16]}"
         outs = self.state.collect(prompt, sp, rid)

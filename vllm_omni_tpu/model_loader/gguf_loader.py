@@ -1,0 +1,234 @@
+"""GGUF checkpoint intake for Qwen-family LMs.
+
+Role of the reference's GGUF support (reference: engine/arg_utils.py:96-97
+``load_format="gguf"`` / quantized checkpoint intake): parse the GGUF
+container (v2/v3), translate the ``general.architecture`` metadata into a
+TransformerConfig, and dequantize tensors into the functional param tree
+(models/common/transformer.py).  Pure numpy — no gguf-py dependency.
+
+Supported tensor encodings: F32, F16, BF16, and Q8_0 (32-element blocks,
+fp16 scale + int8 quants — the llama.cpp 8-bit format).  Other quant
+types raise with the type name so the gap is explicit, not silent.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import transformer as tfm
+
+logger = init_logger(__name__)
+
+_MAGIC = b"GGUF"
+
+# metadata value readers by GGUF type id
+_SCALARS = {
+    0: ("<B", 1), 1: ("<b", 1), 2: ("<H", 2), 3: ("<h", 2),
+    4: ("<I", 4), 5: ("<i", 4), 6: ("<f", 4), 7: ("<?", 1),
+    10: ("<Q", 8), 11: ("<q", 8), 12: ("<d", 8),
+}
+
+GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
+_TYPE_NAMES = {
+    2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1", 8: "Q8_0",
+    10: "Q2_K", 11: "Q3_K", 12: "Q4_K", 13: "Q5_K", 14: "Q6_K",
+}
+
+
+class _Reader:
+    def __init__(self, buf: memoryview):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, fmt: str):
+        v = struct.unpack_from(fmt, self.buf, self.pos)[0]
+        self.pos += struct.calcsize(fmt)
+        return v
+
+    def read_string(self) -> str:
+        n = self.read("<Q")
+        s = bytes(self.buf[self.pos:self.pos + n]).decode("utf-8")
+        self.pos += n
+        return s
+
+    def read_value(self, vtype: int):
+        if vtype in _SCALARS:
+            return self.read(_SCALARS[vtype][0])
+        if vtype == 8:
+            return self.read_string()
+        if vtype == 9:
+            etype = self.read("<I")
+            count = self.read("<Q")
+            return [self.read_value(etype) for _ in range(count)]
+        raise ValueError(f"unknown GGUF metadata type {vtype}")
+
+
+def _dequant(raw: np.ndarray, ggml_type: int, shape: tuple) -> np.ndarray:
+    n = int(np.prod(shape))
+    if ggml_type == GGML_F32:
+        return raw.view(np.float32)[:n].reshape(shape)
+    if ggml_type == GGML_F16:
+        return raw.view(np.float16)[:n].astype(np.float32).reshape(shape)
+    if ggml_type == GGML_BF16:
+        import ml_dtypes
+
+        return raw.view(ml_dtypes.bfloat16)[:n].astype(
+            np.float32).reshape(shape)
+    if ggml_type == GGML_Q8_0:
+        # 34-byte blocks: fp16 scale + 32 int8 quants
+        nblocks = n // 32
+        blocks = raw[: nblocks * 34].reshape(nblocks, 34)
+        scales = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        quants = blocks[:, 2:].view(np.int8).astype(np.float32)
+        return (quants * scales).reshape(shape)
+    raise ValueError(
+        f"unsupported GGUF tensor type {ggml_type} "
+        f"({_TYPE_NAMES.get(ggml_type, '?')}) — supported: F32, F16, "
+        "BF16, Q8_0")
+
+
+def read_gguf(path: str):
+    """Parse a GGUF file -> (metadata dict, {name: np.ndarray fp32})."""
+    data = np.memmap(path, dtype=np.uint8, mode="r")
+    r = _Reader(memoryview(data))
+    if bytes(r.buf[:4]) != _MAGIC:
+        raise ValueError(f"{path}: not a GGUF file")
+    r.pos = 4
+    version = r.read("<I")
+    if version not in (2, 3):
+        raise ValueError(f"{path}: unsupported GGUF version {version}")
+    n_tensors = r.read("<Q")
+    n_kv = r.read("<Q")
+    meta: dict[str, Any] = {}
+    for _ in range(n_kv):
+        key = r.read_string()
+        vtype = r.read("<I")
+        meta[key] = r.read_value(vtype)
+    infos = []
+    for _ in range(n_tensors):
+        name = r.read_string()
+        n_dims = r.read("<I")
+        # ggml dims are innermost-first; numpy wants outermost-first
+        dims = [r.read("<Q") for _ in range(n_dims)][::-1]
+        ttype = r.read("<I")
+        offset = r.read("<Q")
+        infos.append((name, tuple(dims), ttype, offset))
+    align = int(meta.get("general.alignment", 32))
+    base = (r.pos + align - 1) // align * align
+
+    def nbytes(shape, ttype):
+        n = int(np.prod(shape))
+        if ttype == GGML_F32:
+            return n * 4
+        if ttype in (GGML_F16, GGML_BF16):
+            return n * 2
+        if ttype == GGML_Q8_0:
+            return n // 32 * 34
+        raise ValueError(
+            f"unsupported GGUF tensor type {ttype} "
+            f"({_TYPE_NAMES.get(ttype, '?')})")
+
+    tensors: dict[str, np.ndarray] = {}
+    for name, shape, ttype, offset in infos:
+        start = base + offset
+        tensors[name] = _dequant(
+            np.asarray(data[start:start + nbytes(shape, ttype)]),
+            ttype, shape)
+    return meta, tensors
+
+
+def config_from_gguf(meta: dict,
+                     vocab_size: int) -> tfm.TransformerConfig:
+    arch = meta.get("general.architecture", "qwen2")
+
+    def g(key, default=None):
+        return meta.get(f"{arch}.{key}", default)
+
+    heads = int(g("attention.head_count"))
+    hidden = int(g("embedding_length"))
+    return tfm.TransformerConfig(
+        vocab_size=vocab_size,
+        hidden_size=hidden,
+        num_layers=int(g("block_count")),
+        num_heads=heads,
+        num_kv_heads=int(g("attention.head_count_kv", heads)),
+        head_dim=int(g("attention.key_length", hidden // heads)),
+        intermediate_size=int(g("feed_forward_length")),
+        rope_theta=float(g("rope.freq_base", 1e6)),
+        rms_eps=float(g("attention.layer_norm_rms_epsilon", 1e-6)),
+        qk_norm=arch.startswith("qwen3"),
+        attention_bias=arch.startswith("qwen2"),
+        tie_word_embeddings=False,  # set below from tensor presence
+    )
+
+
+def load_gguf_lm(model_dir: str, dtype="bfloat16",
+                 cfg: Optional[tfm.TransformerConfig] = None, **_):
+    """model_factory contract: (params, TransformerConfig, eos_id).
+
+    ``model_dir`` is the .gguf file path."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from vllm_omni_tpu.config.model import resolve_dtype
+
+    jdtype = resolve_dtype(dtype) if isinstance(dtype, str) else dtype
+    meta, tensors = read_gguf(model_dir)
+    vocab = tensors["token_embd.weight"].shape[0]
+    if cfg is None:
+        cfg = config_from_gguf(meta, vocab)
+    tied = "output.weight" not in tensors
+    cfg = dataclasses.replace(cfg, tie_word_embeddings=tied)
+
+    shapes = jax.eval_shape(
+        lambda: tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+    tree = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, np.float32), shapes)
+
+    def put(dst, src, transpose=True):
+        arr = src.T if (transpose and src.ndim == 2) else src
+        if dst.shape != arr.shape:
+            raise ValueError(f"shape {arr.shape} != {dst.shape}")
+        dst[...] = arr
+
+    put(tree["embed"]["w"], tensors["token_embd.weight"],
+        transpose=False)
+    put(tree["final_norm"]["w"], tensors["output_norm.weight"])
+    if not tied:
+        put(tree["lm_head"]["w"], tensors["output.weight"])
+    inter = cfg.intermediate_size
+    for i in range(cfg.num_layers):
+        blk = f"blk.{i}"
+        layer = tree["layers"][i]
+        put(layer["input_norm"]["w"], tensors[f"{blk}.attn_norm.weight"])
+        put(layer["post_norm"]["w"], tensors[f"{blk}.ffn_norm.weight"])
+        for gg, ours in (("attn_q", "q_proj"), ("attn_k", "k_proj"),
+                         ("attn_v", "v_proj"),
+                         ("attn_output", "o_proj")):
+            put(layer[ours]["w"], tensors[f"{blk}.{gg}.weight"])
+            bias = tensors.get(f"{blk}.{gg}.bias")
+            if bias is not None and "b" in layer[ours]:
+                layer[ours]["b"][...] = bias
+        if cfg.qk_norm:
+            put(layer["q_norm"]["w"],
+                tensors[f"{blk}.attn_q_norm.weight"])
+            put(layer["k_norm"]["w"],
+                tensors[f"{blk}.attn_k_norm.weight"])
+        layer["gate_up"]["w"][:, :inter] = \
+            tensors[f"{blk}.ffn_gate.weight"].T
+        layer["gate_up"]["w"][:, inter:] = \
+            tensors[f"{blk}.ffn_up.weight"].T
+        put(layer["down"]["w"], tensors[f"{blk}.ffn_down.weight"])
+    params = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jdtype), tree)
+    eos = meta.get("tokenizer.ggml.eos_token_id")
+    logger.info("GGUF load: %s (%s, %d tensors, tied=%s)",
+                model_dir, meta.get("general.architecture"),
+                len(tensors), tied)
+    return params, cfg, (int(eos) if eos is not None else None)
