@@ -1,0 +1,152 @@
+"""HunyuanImage-3 LM-backbone + projector-head checkpoint loaders.
+
+A synthetic checkpoint is written at the reference's names
+(hunyuan_image_3_transformer.py:1825-2030: [model.]wte / ln_f /
+layers.N.* with fused [up; gate] expert projections and the mlp.gate.wg
+router) and must reproduce a known param tree exactly, including the
+half-swap into this repo's gate-first silu_mul layout; the head loader
+covers the UNetDown/UNetUp/TimestepEmbedder names (:2535-2790)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.models.hunyuan_image_3 import loader as hl
+from vllm_omni_tpu.models.hunyuan_image_3 import projector
+from vllm_omni_tpu.models.hunyuan_image_3.transformer import (
+    HunyuanImage3Config,
+    init_params,
+)
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    cfg = HunyuanImage3Config.tiny(moe=True)
+    params = init_params(jax.random.PRNGKey(3), cfg, jnp.float32)
+    inter = cfg.moe_intermediate_size
+    sd = {}
+    sd["model.wte.weight"] = np.asarray(params["embed"]["w"])
+    sd["model.ln_f.weight"] = np.asarray(params["final_norm"]["w"])
+    for i, layer in enumerate(params["layers"]):
+        b = f"model.layers.{i}"
+        sd[f"{b}.input_layernorm.weight"] = np.asarray(
+            layer["input_norm"]["w"])
+        sd[f"{b}.post_attention_layernorm.weight"] = np.asarray(
+            layer["post_norm"]["w"])
+        for k in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[f"{b}.self_attn.{k}.weight"] = np.ascontiguousarray(
+                np.asarray(layer[k]["w"]).T)
+        sd[f"{b}.mlp.gate.wg.weight"] = np.ascontiguousarray(
+            np.asarray(layer["gate"]).T)
+        gu = np.asarray(layer["experts_gate_up"])  # [E, h, 2i]
+        dn = np.asarray(layer["experts_down"])
+        for e in range(cfg.num_experts):
+            gate = np.ascontiguousarray(gu[e][:, :inter].T)
+            up = np.ascontiguousarray(gu[e][:, inter:].T)
+            # checkpoint fuses [up; gate] (reference
+            # expert_weights_remapping, :1816-1819)
+            sd[f"{b}.mlp.experts.{e}.gate_and_up_proj"] = \
+                np.concatenate([up, gate], axis=0)
+            sd[f"{b}.mlp.experts.{e}.down_proj"] = np.ascontiguousarray(dn[e].T)
+        sgu = np.asarray(layer["shared_gate_up"]["w"])
+        si = cfg.intermediate_size
+        sd[f"{b}.mlp.shared_mlp.gate_and_up_proj"] = np.ascontiguousarray(np.concatenate(
+            [sgu[:, si:].T, sgu[:, :si].T], axis=0))
+        sd[f"{b}.mlp.shared_mlp.down_proj"] = np.ascontiguousarray(
+            np.asarray(layer["shared_down"]["w"]).T)
+    d = tmp_path_factory.mktemp("hunyuan_lm")
+    save_file(sd, str(d / "model.safetensors"))
+    import json
+
+    (d / "config.json").write_text(json.dumps({
+        "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "attention_head_dim": cfg.head_dim,
+        "intermediate_size": cfg.intermediate_size,
+        "moe_intermediate_size": [cfg.moe_intermediate_size],
+        "num_experts": cfg.num_experts, "moe_topk": [cfg.moe_topk],
+        "rope_theta": cfg.rope_theta, "rms_norm_eps": cfg.rms_eps,
+    }))
+    return d, params, cfg
+
+
+def test_hunyuan_lm_exact(ckpt):
+    d, params, cfg = ckpt
+    loaded, lcfg = hl.load_hunyuan_lm(str(d), dtype=jnp.float32)
+    assert lcfg.num_experts == cfg.num_experts
+    assert lcfg.moe_topk == cfg.moe_topk
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params),
+            jax.tree_util.tree_leaves_with_path(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, err_msg=str(pa))
+
+
+def test_hunyuan_heads_roundtrip(tmp_path):
+    from safetensors.numpy import save_file
+
+    cfg = HunyuanImage3Config.tiny()
+    ph = cfg.patch_embed_hidden_dim
+    keys = jax.random.split(jax.random.PRNGKey(0), 5)
+    heads = {
+        "time_embed": projector.timestep_embedder_init(
+            keys[0], cfg.hidden_size, ph, jnp.float32),
+        "timestep_emb": projector.timestep_embedder_init(
+            keys[1], cfg.hidden_size, cfg.hidden_size, jnp.float32),
+        "time_embed_2": projector.timestep_embedder_init(
+            keys[2], cfg.hidden_size, ph, jnp.float32),
+        "patch_embed": projector.unet_down_init(
+            keys[3], cfg.latent_channels, ph, ph, cfg.hidden_size,
+            jnp.float32),
+        "final_layer": projector.unet_up_init(
+            keys[4], cfg.hidden_size, ph, ph, cfg.latent_channels,
+            jnp.float32),
+    }
+    sd = {}
+
+    def put_lin(name, p):
+        sd[f"{name}.weight"] = np.ascontiguousarray(np.asarray(p["w"]).T)
+        sd[f"{name}.bias"] = np.asarray(p["b"])
+
+    def put_gn(name, p):
+        sd[f"{name}.weight"] = np.asarray(p["w"])
+        sd[f"{name}.bias"] = np.asarray(p["b"])
+
+    def put_conv(name, p):
+        # NHWC [kh, kw, in, out] -> torch [out, in, kh, kw]
+        sd[f"{name}.weight"] = np.ascontiguousarray(
+            np.asarray(p["w"]).transpose(3, 2, 0, 1))
+        sd[f"{name}.bias"] = np.asarray(p["b"])
+
+    def put_res(name, p):
+        put_gn(f"{name}.in_layers.0", p["in_norm"])
+        put_conv(f"{name}.in_layers.2", p["in_conv"])
+        put_lin(f"{name}.emb_layers.1", p["emb"])
+        put_gn(f"{name}.out_layers.0", p["out_norm"])
+        put_conv(f"{name}.out_layers.3", p["out_conv"])
+        put_conv(f"{name}.skip_connection", p["skip"])
+
+    for t in ("time_embed", "timestep_emb", "time_embed_2"):
+        put_lin(f"{t}.mlp.0", heads[t]["fc1"])
+        put_lin(f"{t}.mlp.2", heads[t]["fc2"])
+    put_conv("patch_embed.model.0", heads["patch_embed"]["conv_in"])
+    put_res("patch_embed.model.1", heads["patch_embed"]["res"])
+    put_res("final_layer.model.0", heads["final_layer"]["res"])
+    put_gn("final_layer.model.1.0", heads["final_layer"]["out_norm"])
+    put_conv("final_layer.model.1.2", heads["final_layer"]["conv_out"])
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), heads)
+    loaded = hl.load_hunyuan_heads(str(tmp_path), shapes,
+                                   dtype=jnp.float32)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(heads),
+            jax.tree_util.tree_leaves_with_path(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, err_msg=str(pa))
