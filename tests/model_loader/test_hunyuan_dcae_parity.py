@@ -1,0 +1,243 @@
+"""HunyuanImage-3 DCAE autoencoder parity vs a torch oracle.
+
+The oracle transcribes the reference AutoencoderKLConv3D semantics
+(vllm_omni/diffusion/models/hunyuan_image_3/autoencoder.py): 3D convs,
+GroupNorm32/eps1e-6 + swish ResnetBlocks, single-head attention middle,
+DCAE pixel-(un)shuffle resamplers with grouped-mean / repeat-interleave
+shortcuts, and the channel-averaged encoder tail / repeated decoder
+head residuals.  A synthetic checkpoint written at the reference names
+must round-trip through our loader and match both halves' forwards.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.hunyuan_image_3 import (  # noqa: E402
+    autoencoder as ae,
+)
+from vllm_omni_tpu.models.hunyuan_image_3 import loader as hl  # noqa: E402
+
+CFG = ae.DCAEConfig(
+    in_channels=3, out_channels=3, latent_channels=4,
+    block_out_channels=(32, 64), layers_per_block=1,
+    ffactor_spatial=2, ffactor_temporal=1)
+
+
+# ------------------------------------------------------------ torch oracle
+def _gn(sd, n, x):
+    return torch.nn.functional.group_norm(
+        x, num_groups=min(32, x.shape[1]), weight=sd[f"{n}.weight"],
+        bias=sd[f"{n}.bias"], eps=1e-6)
+
+
+def _conv(sd, n, x):
+    w = sd[f"{n}.weight"]
+    pad = (w.shape[-1] - 1) // 2
+    return torch.nn.functional.conv3d(x, w, sd[f"{n}.bias"],
+                                      padding=pad)
+
+
+def _swish(x):
+    return x * torch.sigmoid(x)
+
+
+def _resnet(sd, n, x, cin, cout):
+    h = _conv(sd, f"{n}.conv1", _swish(_gn(sd, f"{n}.norm1", x)))
+    h = _conv(sd, f"{n}.conv2", _swish(_gn(sd, f"{n}.norm2", h)))
+    if cin != cout:
+        x = _conv(sd, f"{n}.nin_shortcut", x)
+    return x + h
+
+
+def _attn(sd, n, x):
+    b, c, f, h, w = x.shape
+    hn = _gn(sd, f"{n}.norm", x)
+    q = _conv(sd, f"{n}.q", hn).reshape(b, c, -1).transpose(1, 2)
+    k = _conv(sd, f"{n}.k", hn).reshape(b, c, -1).transpose(1, 2)
+    v = _conv(sd, f"{n}.v", hn).reshape(b, c, -1).transpose(1, 2)
+    o = torch.nn.functional.scaled_dot_product_attention(
+        q[:, None], k[:, None], v[:, None])[:, 0]
+    o = o.transpose(1, 2).reshape(b, c, f, h, w)
+    return x + _conv(sd, f"{n}.proj_out", o)
+
+
+def _unshuffle(x, r1):
+    b, c, t, hh, ww = x.shape
+    x = x.reshape(b, c, t // r1, r1, hh // 2, 2, ww // 2, 2)
+    x = x.permute(0, 3, 5, 7, 1, 2, 4, 6)
+    return x.reshape(b, r1 * 4 * c, t // r1, hh // 2, ww // 2)
+
+
+def _shuffle(x, r1):
+    b, rc, t, hh, ww = x.shape
+    c = rc // (r1 * 4)
+    x = x.reshape(b, r1, 2, 2, c, t, hh, ww)
+    x = x.permute(0, 4, 5, 1, 6, 2, 7, 3)
+    return x.reshape(b, c, t * r1, hh * 2, ww * 2)
+
+
+def _down(sd, n, x, cout, temporal):
+    r1 = 2 if temporal else 1
+    h = _unshuffle(_conv(sd, f"{n}.conv", x), r1)
+    sc = _unshuffle(x, r1)
+    b, c, t, hh, ww = sc.shape
+    sc = sc.view(b, cout, c // cout, t, hh, ww).mean(dim=2)
+    return h + sc
+
+
+def _up(sd, n, x, cin, cout, temporal):
+    r1 = 2 if temporal else 1
+    factor = 8 if temporal else 4
+    h = _shuffle(_conv(sd, f"{n}.conv", x), r1)
+    sc = x.repeat_interleave(factor * cout // cin, dim=1)
+    return h + _shuffle(sc, r1)
+
+
+def enc_oracle(sd, x):
+    levels, block_in = ae._levels_down(CFG)
+    h = _conv(sd, "encoder.conv_in", x)
+    for i, (blocks, down_out, temporal) in enumerate(levels):
+        for j, (cin, cout) in enumerate(blocks):
+            h = _resnet(sd, f"encoder.down.{i}.block.{j}", h, cin, cout)
+        if down_out is not None:
+            h = _down(sd, f"encoder.down.{i}.downsample", h, down_out,
+                      temporal)
+    h = _resnet(sd, "encoder.mid.block_1", h, block_in, block_in)
+    h = _attn(sd, "encoder.mid.attn_1", h)
+    h = _resnet(sd, "encoder.mid.block_2", h, block_in, block_in)
+    group = CFG.block_out_channels[-1] // (2 * CFG.latent_channels)
+    b, c, t, hh, ww = h.shape
+    sc = h.reshape(b, 2 * CFG.latent_channels, group, t, hh, ww).mean(2)
+    h = _conv(sd, "encoder.conv_out",
+              _swish(_gn(sd, "encoder.norm_out", h)))
+    return h + sc
+
+
+def dec_oracle(sd, z):
+    levels, block_in = ae._levels_up(CFG)
+    first = CFG.block_out_channels[0]
+    h = _conv(sd, "decoder.conv_in", z) + z.repeat_interleave(
+        first // CFG.latent_channels, dim=1)
+    h = _resnet(sd, "decoder.mid.block_1", h, first, first)
+    h = _attn(sd, "decoder.mid.attn_1", h)
+    h = _resnet(sd, "decoder.mid.block_2", h, first, first)
+    for i, (blocks, up_out, temporal) in enumerate(levels):
+        for j, (cin, cout) in enumerate(blocks):
+            h = _resnet(sd, f"decoder.up.{i}.block.{j}", h, cin, cout)
+        if up_out is not None:
+            h = _up(sd, f"decoder.up.{i}.upsample", h, blocks[-1][1],
+                    up_out, temporal)
+    return _conv(sd, "decoder.conv_out",
+                 _swish(_gn(sd, "decoder.norm_out", h)))
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    g = np.random.default_rng(0)
+    sd = {}
+
+    def conv(name, cin, cout, k):
+        sd[f"{name}.weight"] = (0.3 * g.standard_normal(
+            (cout, cin, k, k, k))).astype(np.float32)
+        sd[f"{name}.bias"] = (0.1 * g.standard_normal((cout,))).astype(
+            np.float32)
+
+    def gn(name, c):
+        sd[f"{name}.weight"] = (
+            1.0 + 0.1 * g.standard_normal(c)).astype(np.float32)
+        sd[f"{name}.bias"] = (0.1 * g.standard_normal(c)).astype(
+            np.float32)
+
+    def resnet(name, cin, cout):
+        gn(f"{name}.norm1", cin)
+        conv(f"{name}.conv1", cin, cout, 3)
+        gn(f"{name}.norm2", cout)
+        conv(f"{name}.conv2", cout, cout, 3)
+        if cin != cout:
+            conv(f"{name}.nin_shortcut", cin, cout, 1)
+
+    def attn(name, c):
+        gn(f"{name}.norm", c)
+        for nm in ("q", "k", "v", "proj_out"):
+            conv(f"{name}.{nm}", c, c, 1)
+
+    levels, block_in = ae._levels_down(CFG)
+    conv("encoder.conv_in", CFG.in_channels,
+         CFG.block_out_channels[0], 3)
+    for i, (blocks, down_out, temporal) in enumerate(levels):
+        for j, (cin, cout) in enumerate(blocks):
+            resnet(f"encoder.down.{i}.block.{j}", cin, cout)
+        if down_out is not None:
+            conv(f"encoder.down.{i}.downsample.conv", blocks[-1][1],
+                 down_out // (8 if temporal else 4), 3)
+    resnet("encoder.mid.block_1", block_in, block_in)
+    attn("encoder.mid.attn_1", block_in)
+    resnet("encoder.mid.block_2", block_in, block_in)
+    gn("encoder.norm_out", block_in)
+    conv("encoder.conv_out", block_in, 2 * CFG.latent_channels, 3)
+
+    ulevels, ublock_in = ae._levels_up(CFG)
+    first = CFG.block_out_channels[0]
+    conv("decoder.conv_in", CFG.latent_channels, first, 3)
+    resnet("decoder.mid.block_1", first, first)
+    attn("decoder.mid.attn_1", first)
+    resnet("decoder.mid.block_2", first, first)
+    for i, (blocks, up_out, temporal) in enumerate(ulevels):
+        for j, (cin, cout) in enumerate(blocks):
+            resnet(f"decoder.up.{i}.block.{j}", cin, cout)
+        if up_out is not None:
+            conv(f"decoder.up.{i}.upsample.conv", blocks[-1][1],
+                 up_out * (8 if temporal else 4), 3)
+    gn("decoder.norm_out", ublock_in)
+    conv("decoder.conv_out", ublock_in, CFG.out_channels, 3)
+
+    d = tmp_path_factory.mktemp("dcae")
+    save_file(sd, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({
+            "in_channels": CFG.in_channels,
+            "out_channels": CFG.out_channels,
+            "latent_channels": CFG.latent_channels,
+            "block_out_channels": list(CFG.block_out_channels),
+            "layers_per_block": CFG.layers_per_block,
+            "ffactor_spatial": CFG.ffactor_spatial,
+            "ffactor_temporal": CFG.ffactor_temporal,
+        }, f)
+    return str(d), {k: torch.from_numpy(v) for k, v in sd.items()}
+
+
+def test_dcae_decode_parity(ckpt):
+    d, sd = ckpt
+    trees, cfg = hl.load_dcae(d, dtype=jnp.float32, decoder=True)
+    g = np.random.default_rng(1)
+    z = g.standard_normal((1, 1, 4, 6, CFG.latent_channels)).astype(
+        np.float32)
+    got = np.asarray(ae.decode(trees["decoder"], cfg, jnp.asarray(z)))
+    with torch.no_grad():
+        # oracle runs NCTHW
+        zt = torch.from_numpy(z.transpose(0, 4, 1, 2, 3))
+        want = dec_oracle(sd, zt).numpy().transpose(0, 2, 3, 4, 1)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_dcae_encode_parity(ckpt):
+    d, sd = ckpt
+    trees, cfg = hl.load_dcae(d, dtype=jnp.float32, encoder=True,
+                              decoder=False)
+    g = np.random.default_rng(2)
+    x = g.standard_normal((1, 1, 8, 12, CFG.in_channels)).astype(
+        np.float32)
+    got = np.asarray(ae.encode(trees["encoder"], cfg, jnp.asarray(x)))
+    with torch.no_grad():
+        xt = torch.from_numpy(x.transpose(0, 4, 1, 2, 3))
+        want = enc_oracle(sd, xt).numpy().transpose(0, 2, 3, 4, 1)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)

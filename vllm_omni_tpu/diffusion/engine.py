@@ -14,6 +14,7 @@ through pjit — the intra-stage fan-out machinery collapses into XLA
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -37,7 +38,9 @@ logger = init_logger(__name__)
 
 def resolve_arch(config: OmniDiffusionConfig) -> str:
     """Pipeline class from explicit config or the checkpoint's
-    model_index.json ``_class_name`` (reference: omni_diffusion.py:34-109)."""
+    model_index.json ``_class_name`` (reference: omni_diffusion.py:34-109);
+    single-repo HF checkpoints (HunyuanImage-3) resolve via config.json
+    ``architectures`` instead."""
     if config.model_arch:
         return config.model_arch
     idx = os.path.join(config.model, "model_index.json")
@@ -46,8 +49,34 @@ def resolve_arch(config: OmniDiffusionConfig) -> str:
             name = json.load(f).get("_class_name", "")
         if name:
             return name
+    declared = _declared_arch(config.model)
+    if declared:
+        return declared
     # default flagship
     return "QwenImagePipeline"
+
+
+@functools.lru_cache(maxsize=64)
+def _declared_arch(model: str):
+    """Registry architecture declared by a local dir's config.json
+    (single-repo HF layout, no model_index.json), or None.  Cached so
+    resolve_arch and the from_ckpt gate share one parse (and one view
+    of the file) per engine construction."""
+    p = os.path.join(model, "config.json")
+    if not os.path.isfile(p):
+        return None
+    try:
+        with open(p) as f:
+            archs = json.load(f).get("architectures") or []
+    except Exception:
+        return None
+    if archs and archs[0] in DiffusionModelRegistry.supported():
+        return archs[0]
+    return None
+
+
+def _arch_checkpoint(model: str) -> bool:
+    return _declared_arch(model) is not None
 
 
 class DiffusionEngine:
@@ -108,8 +137,11 @@ class DiffusionEngine:
             extra_kwargs["offload"] = od_config.offload
         from_ckpt = (
             od_config.model
-            and os.path.isfile(os.path.join(od_config.model,
-                                            "model_index.json"))
+            and (os.path.isfile(os.path.join(od_config.model,
+                                             "model_index.json"))
+                 # single-repo HF checkpoints (HunyuanImage-3) carry a
+                 # registry architecture in config.json instead
+                 or _arch_checkpoint(od_config.model))
             and hasattr(pipeline_cls, "from_pretrained")
         )
         if from_ckpt:

@@ -12,10 +12,10 @@ store UP first, GATE second (the reference's expert_weights_remapping,
 :1816-1819) while this repo's ``silu_mul`` wants gate first, so halves
 swap at load.
 
-Scope note: pipeline-level ``from_pretrained`` additionally needs the
-DCAE video-style autoencoder (reference autoencoder.py) which has no
-in-tree implementation yet; the UNet projector / timestep-embedder heads
-load via ``load_hunyuan_heads`` below.
+The UNet projector / timestep-embedder heads load via
+``load_hunyuan_heads``; the DCAE autoencoder halves
+(AutoencoderKLConv3D, models/hunyuan_image_3/autoencoder.py) via
+``load_dcae``.
 """
 
 from __future__ import annotations
@@ -61,8 +61,14 @@ def config_from_hf(model_dir: str) -> HunyuanImage3Config:
                                     3072),
         num_experts=first(hf.get("num_experts"), 1),
         moe_topk=first(hf.get("moe_topk"), 1),
+        moe_layer_num_skipped=first(hf.get("moe_layer_num_skipped"), 0),
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_eps=hf.get("rms_norm_eps", 1e-5),
+        boi_token_id=hf.get("boi_token_id", 4),
+        eoi_token_id=hf.get("eoi_token_id", 5),
+        image_token_id=hf.get("image_token_id", 8),
+        size_token_id=hf.get("size_token_id", 290800),
+        ratio_token_base=hf.get("ratio_token_base", 290816),
     )
 
 
@@ -78,17 +84,25 @@ def load_hunyuan_lm(model_dir: str,
     """Returns (params, cfg).  Raises unless every LM leaf is covered."""
     from vllm_omni_tpu.model_loader.safetensors_loader import (
         iter_safetensors,
+        np_param_dtype,
     )
 
     if cfg is None:
         cfg = config_from_hf(model_dir)
+    np_dtype = np_param_dtype(dtype)
     shapes = jax.eval_shape(
         lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
     tree = jax.tree_util.tree_map(
-        lambda s: np.zeros(s.shape, np.float32), shapes)
+        lambda s: np.zeros(s.shape, np_dtype), shapes)
     inter = cfg.moe_intermediate_size
     n = 0
     unmapped: list[str] = []
+    # per-layer expert write counters: the stacked [E, ...] leaves fill
+    # from E (or 2E split-layout) per-expert writes — a zero-check alone
+    # would miss a truncated shard that covered only some experts
+    from collections import Counter
+
+    expert_writes: Counter = Counter()
 
     def norm_name(name: str) -> str:
         return name[6:] if name.startswith("model.") else name
@@ -153,12 +167,16 @@ def load_hunyuan_lm(model_dir: str,
                 up, gate = np.split(arr, 2, axis=0)
                 layer["experts_gate_up"][e, :, :inter] = gate.T
                 layer["experts_gate_up"][e, :, inter:] = up.T
+                expert_writes[(li, "gate_up")] += 2
             elif which == "gate_proj":
                 layer["experts_gate_up"][e, :, :inter] = arr.T
+                expert_writes[(li, "gate_up")] += 1
             elif which == "up_proj":
                 layer["experts_gate_up"][e, :, inter:] = arr.T
+                expert_writes[(li, "gate_up")] += 1
             else:
                 layer["experts_down"][e] = arr.T
+                expert_writes[(li, "down")] += 1
             n += 1
             continue
         if sub.startswith("mlp.shared_mlp."):
@@ -198,6 +216,18 @@ def load_hunyuan_lm(model_dir: str,
     if unmapped:
         logger.warning("hunyuan LM loader: %d unmapped tensors "
                        "(e.g. %s)", len(unmapped), unmapped[:4])
+    if cfg.num_experts > 1:
+        for li in range(cfg.num_layers):
+            if not cfg.is_moe_layer(li):
+                continue
+            gu = expert_writes[(li, "gate_up")]
+            dn_w = expert_writes[(li, "down")]
+            # fused layout writes 2 per expert into gate_up, split 2
+            if gu < 2 * cfg.num_experts or dn_w < cfg.num_experts:
+                raise ValueError(
+                    f"{model_dir}: layer {li} expert coverage "
+                    f"incomplete (gate_up {gu}/{2 * cfg.num_experts}, "
+                    f"down {dn_w}/{cfg.num_experts})")
     n_leaves = len(jax.tree_util.tree_leaves(tree))
     # fused tensors fill one leaf from two writes; count leaves touched
     # via a zero-check instead of write counts
@@ -225,9 +255,7 @@ def load_hunyuan_heads(model_dir: str, params_shapes, dtype=jnp.bfloat16):
         r[f"{hf}.weight"] = ("direct", path + ("w",))
         r[f"{hf}.bias"] = ("direct", path + ("b",))
 
-    def gn(hf, *path):
-        r[f"{hf}.weight"] = ("direct", path + ("w",))
-        r[f"{hf}.bias"] = ("direct", path + ("b",))
+    gn = lin  # groupnorm routes identically (weight/bias -> w/b)
 
     def conv(hf, *path):
         r[f"{hf}.weight"] = ("conv", path + ("w",))
@@ -264,3 +292,95 @@ def load_hunyuan_heads(model_dir: str, params_shapes, dtype=jnp.bfloat16):
                            transforms=transforms)
 
     return load(model_dir, r, params_shapes, dtype)
+
+
+def _dcae_conv(arr):
+    # torch [out, in, kt, kh, kw] -> NDHWC kernel [kt, kh, kw, in, out]
+    return np.ascontiguousarray(arr.transpose(2, 3, 4, 1, 0))
+
+
+def _dcae_routing(cfg, half: str) -> dict:
+    """Routing for one autoencoder half ('encoder' | 'decoder') of the
+    AutoencoderKLConv3D checkpoint (reference autoencoder.py)."""
+    from vllm_omni_tpu.models.hunyuan_image_3 import autoencoder as ae
+
+    r: dict[str, tuple] = {}
+
+    def conv(hf, *path):
+        r[f"{hf}.weight"] = ("conv3d", path + ("w",))
+        r[f"{hf}.bias"] = ("direct", path + ("b",))
+
+    def gn(hf, *path):
+        r[f"{hf}.weight"] = ("direct", path + ("w",))
+        r[f"{hf}.bias"] = ("direct", path + ("b",))
+
+    def resnet(hf, spec, *path):
+        cin, cout = spec
+        gn(f"{hf}.norm1", *path, "norm1")
+        conv(f"{hf}.conv1", *path, "conv1")
+        gn(f"{hf}.norm2", *path, "norm2")
+        conv(f"{hf}.conv2", *path, "conv2")
+        if cin != cout:
+            conv(f"{hf}.nin_shortcut", *path, "nin_shortcut")
+
+    def attn(hf, *path):
+        gn(f"{hf}.norm", *path, "norm")
+        for nm in ("q", "k", "v", "proj_out"):
+            conv(f"{hf}.{nm}", *path, nm)
+
+    if half == "encoder":
+        levels, block_in = ae._levels_down(cfg)
+        lvl_key = "down"
+    else:
+        levels, block_in = ae._levels_up(cfg)
+        lvl_key = "up"
+    conv(f"{half}.conv_in", "conv_in")
+    for i, (blocks, resample_out, _temporal) in enumerate(levels):
+        for j, spec in enumerate(blocks):
+            resnet(f"{half}.{lvl_key}.{i}.block.{j}", spec,
+                   lvl_key, i, "block", j)
+        if resample_out is not None:
+            name = ("downsample" if half == "encoder" else "upsample")
+            conv(f"{half}.{lvl_key}.{i}.{name}.conv",
+                 lvl_key, i, name, "conv")
+    mid_ch = (block_in if half == "encoder"
+              else cfg.block_out_channels[0])
+    for nm in ("block_1", "block_2"):
+        resnet(f"{half}.mid.{nm}", (mid_ch, mid_ch), f"mid_{nm}")
+    attn(f"{half}.mid.attn_1", "mid_attn_1")
+    gn(f"{half}.norm_out", "norm_out")
+    conv(f"{half}.conv_out", "conv_out")
+    return r
+
+
+def load_dcae(model_dir: str, cfg=None, dtype=jnp.bfloat16,
+              encoder: bool = False, decoder: bool = True,
+              prefix: str = ""):
+    """Load the AutoencoderKLConv3D halves.  Returns
+    ({"encoder"?, "decoder"?}, DCAEConfig)."""
+    from vllm_omni_tpu.models.flux.loader import load_routed
+    from vllm_omni_tpu.models.hunyuan_image_3 import autoencoder as ae
+
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = ae.DCAEConfig.from_hf(json.load(f))
+    out = {}
+    halves = ([("encoder", ae.init_encoder)] if encoder else []) + \
+        ([("decoder", ae.init_decoder)] if decoder else [])
+    for half, init in halves:
+        routing = _dcae_routing(cfg, half)
+        if prefix:
+            # the published repo nests the autoencoder under one key
+            # namespace of the main shards (e.g. "vae.encoder...")
+            routing = {prefix + k: v for k, v in routing.items()}
+        transforms = {name: _dcae_conv
+                      for name, route in routing.items()
+                      if route[0] == "conv3d"}
+        routing = {k: (("raw",) + v[1:] if v[0] == "conv3d" else v)
+                   for k, v in routing.items()}
+        shapes = jax.eval_shape(
+            lambda init=init: init(jax.random.PRNGKey(0), cfg,
+                                   jnp.float32))
+        out[half] = load_routed(model_dir, routing, shapes, dtype,
+                                transforms=transforms)
+    return out, cfg

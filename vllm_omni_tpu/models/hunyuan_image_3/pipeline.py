@@ -25,6 +25,7 @@ UNetDown-embedded clean latents at t=0.
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 from typing import Optional
@@ -98,10 +99,11 @@ class HunyuanImage3Pipeline:
 
     output_type = "image"
     config_cls = HunyuanImage3PipelineConfig
+    param_attrs = ("dit_params", "vae_params", "dcae_decoder_params")
 
     def __init__(self, config: HunyuanImage3PipelineConfig,
                  dtype=jnp.bfloat16, seed: int = 0, mesh=None,
-                 cache_config=None):
+                 cache_config=None, init_weights: bool = True):
         from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
         self.cfg = config
@@ -129,7 +131,7 @@ class HunyuanImage3Pipeline:
         keys = jax.random.split(jax.random.PRNGKey(seed), 9)
         ph = llm.patch_embed_hidden_dim
         towers = {}
-        if config.vit is not None:
+        if config.vit is not None and init_weights:
             # SigLIP-2 understanding tower + LightProjector aligner
             # (vision_model / vision_aligner) — conditioning images
             # contribute semantic ViT tokens beside their VAE tokens
@@ -137,27 +139,34 @@ class HunyuanImage3Pipeline:
             towers["vit_aligner"] = projector.light_projector_init(
                 keys[8], config.vit.hidden_size, llm.hidden_size,
                 config.vit_aligner_depth, dtype)
-        self.dit_params = self.wiring.place({
-            **towers,
-            "llm": init_params(keys[0], llm, dtype),
-            # three timestep embedders (reference: time_embed for the
-            # patch embed, timestep_emb for the in-sequence token,
-            # time_embed_2 for the final layer)
-            "time_embed": projector.timestep_embedder_init(
-                keys[1], llm.hidden_size, ph, dtype),
-            "timestep_emb": projector.timestep_embedder_init(
-                keys[2], llm.hidden_size, llm.hidden_size, dtype),
-            "time_embed_2": projector.timestep_embedder_init(
-                keys[3], llm.hidden_size, ph, dtype),
-            "patch_embed": projector.unet_down_init(
-                keys[4], llm.latent_channels, ph, ph, llm.hidden_size,
-                dtype),
-            "final_layer": projector.unet_up_init(
-                keys[5], llm.hidden_size, ph, ph, llm.latent_channels,
-                dtype),
-        })
-        self.vae_params = self.wiring.place(
-            vae_mod.init_decoder(keys[6], config.vae, dtype))
+        self._ckpt_weights = not init_weights
+        if not init_weights:
+            # from_pretrained overwrites every tree — materializing a
+            # checkpoint-sized random MoE first would double peak memory
+            self.dit_params = None
+            self.vae_params = None
+        else:
+            self.dit_params = self.wiring.place({
+                **towers,
+                "llm": init_params(keys[0], llm, dtype),
+                # three timestep embedders (reference: time_embed for
+                # the patch embed, timestep_emb for the in-sequence
+                # token, time_embed_2 for the final layer)
+                "time_embed": projector.timestep_embedder_init(
+                    keys[1], llm.hidden_size, ph, dtype),
+                "timestep_emb": projector.timestep_embedder_init(
+                    keys[2], llm.hidden_size, llm.hidden_size, dtype),
+                "time_embed_2": projector.timestep_embedder_init(
+                    keys[3], llm.hidden_size, ph, dtype),
+                "patch_embed": projector.unet_down_init(
+                    keys[4], llm.latent_channels, ph, ph,
+                    llm.hidden_size, dtype),
+                "final_layer": projector.unet_up_init(
+                    keys[5], llm.hidden_size, ph, ph,
+                    llm.latent_channels, dtype),
+            })
+            self.vae_params = self.wiring.place(
+                vae_mod.init_decoder(keys[6], config.vae, dtype))
         self._seed = seed
         self._denoise_cache: dict = {}
         self._prefill_jit = jax.jit(
@@ -169,6 +178,134 @@ class HunyuanImage3Pipeline:
         self.vae_encoder_params = None  # built on demand (image intake)
         self._vae_decode_jit = jax.jit(
             lambda pp, l: vae_mod.decode(pp, self.cfg.vae, l))
+        # real-weight DCAE autoencoder (from_pretrained); None => the
+        # random-init stand-in VAE.  A separate attr so engine.sleep()
+        # offloads it with the other trees.
+        self.dcae_decoder_params = None
+        self.dcae_cfg = None
+        self.hf_tokenizer = None
+
+    @functools.cached_property
+    def _dcae_decode_jit(self):
+        from vllm_omni_tpu.models.hunyuan_image_3 import (
+            autoencoder as dcae_mod,
+        )
+
+        dcfg = self.dcae_cfg
+        return jax.jit(lambda pp, z: dcae_mod.decode(pp, dcfg, z))
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = 512):
+        """Build from the published single-repo checkpoint: the causal
+        MoE LM + UNet projector heads + DCAE autoencoder all live in one
+        shard set (the vae under the ``vae.`` key namespace, its config
+        under config.json["vae"]).  The SigLIP understanding tower loads
+        when ``vision_model.*`` weights are present; otherwise
+        text-to-image runs without it."""
+        import dataclasses
+        import json as _json
+        import os
+
+        from vllm_omni_tpu.models.hunyuan_image_3 import (
+            autoencoder as dcae_mod,
+        )
+        from vllm_omni_tpu.models.hunyuan_image_3 import loader as hload
+
+        with open(os.path.join(model_dir, "config.json")) as f:
+            hf = _json.load(f)
+        llm_cfg = hload.config_from_hf(model_dir)
+        dcae_cfg = dcae_mod.DCAEConfig.from_hf(hf.get("vae", {}))
+        llm_cfg = dataclasses.replace(
+            llm_cfg,
+            latent_channels=dcae_cfg.latent_channels,
+            vae_ratio=dcae_cfg.ffactor_spatial,
+            patch_embed_hidden_dim=hf.get("patch_embed_hidden_dim",
+                                          1024),
+            image_base_size=hf.get("img_size", 1024),
+        )
+        gen_cfg_path = os.path.join(model_dir, "generation_config.json")
+        shift = 3.0
+        if os.path.isfile(gen_cfg_path):
+            with open(gen_cfg_path) as f:
+                shift = _json.load(f).get("flow_shift", 3.0)
+        llm_cfg = dataclasses.replace(llm_cfg, timestep_shift=shift)
+        hf_tok = None
+        try:
+            from transformers import AutoTokenizer
+
+            hf_tok = AutoTokenizer.from_pretrained(model_dir)
+        except Exception as e:
+            logger.warning("no usable tokenizer under %s (%s); byte "
+                           "fallback", model_dir, e)
+        if hf_tok is not None:
+            if hf_tok.pad_token is None:
+                hf_tok.pad_token = hf_tok.eos_token
+            # the resolution special tokens (<img_size_1024>,
+            # <img_ratio_0>; reference hunyuan_image_3_tokenizer.py:59)
+            # are tokenizer-assigned — resolve ids from it rather than
+            # trusting config.json to carry them
+            size_tok = f"<img_size_{llm_cfg.image_base_size}>"
+            sid = hf_tok.convert_tokens_to_ids(size_tok)
+            rid = hf_tok.convert_tokens_to_ids("<img_ratio_0>")
+            unk = hf_tok.unk_token_id
+            overrides = {}
+            if sid is not None and sid != unk and sid >= 0:
+                overrides["size_token_id"] = sid
+            if rid is not None and rid != unk and rid >= 0:
+                overrides["ratio_token_base"] = rid
+            if overrides:
+                llm_cfg = dataclasses.replace(llm_cfg, **overrides)
+        # vit=None: the SigLIP tower has no loader wired yet — a
+        # random-init tower beside real LM weights would silently
+        # corrupt image-conditioned requests, so those fail loudly
+        # until vision_model.* loading lands
+        import math as _math
+
+        # stand-in VAEConfig consistent with the llm geometry (its
+        # random weights are never built on this path — the DCAE is the
+        # real decoder); spatial_ratio = 2^(len(multipliers)-1)
+        stand_in_vae = VAEConfig(
+            latent_channels=llm_cfg.latent_channels,
+            channel_multipliers=(1,) * (
+                int(_math.log2(llm_cfg.vae_ratio)) + 1),
+            base_channels=16, layers_per_block=1,
+            scaling_factor=1.0, shift_factor=0.0)
+        config = dataclasses.replace(
+            cls.config_cls.tiny(), llm=llm_cfg, vit=None,
+            vae=stand_in_vae, max_text_len=max_text_len)
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+
+        lm_params, _ = hload.load_hunyuan_lm(model_dir, cfg=llm_cfg,
+                                             dtype=dtype)
+        ph = llm_cfg.patch_embed_hidden_dim
+        keys = jax.random.split(jax.random.PRNGKey(seed), 5)
+        head_shapes = jax.eval_shape(lambda: {
+            "time_embed": projector.timestep_embedder_init(
+                keys[0], llm_cfg.hidden_size, ph, jnp.float32),
+            "timestep_emb": projector.timestep_embedder_init(
+                keys[1], llm_cfg.hidden_size, llm_cfg.hidden_size,
+                jnp.float32),
+            "time_embed_2": projector.timestep_embedder_init(
+                keys[2], llm_cfg.hidden_size, ph, jnp.float32),
+            "patch_embed": projector.unet_down_init(
+                keys[3], llm_cfg.latent_channels, ph, ph,
+                llm_cfg.hidden_size, jnp.float32),
+            "final_layer": projector.unet_up_init(
+                keys[4], llm_cfg.hidden_size, ph, ph,
+                llm_cfg.latent_channels, jnp.float32),
+        })
+        heads = hload.load_hunyuan_heads(model_dir, head_shapes,
+                                         dtype=dtype)
+        pipe.dit_params = pipe.wiring.place({"llm": lm_params, **heads})
+        trees, _ = hload.load_dcae(model_dir, cfg=dcae_cfg, dtype=dtype,
+                                   decoder=True, prefix="vae.")
+        pipe.dcae_decoder_params = pipe.wiring.place(trees["decoder"])
+        pipe.dcae_cfg = dcae_cfg
+        pipe.hf_tokenizer = hf_tok
+        return pipe
 
     @property
     def geometry_multiple(self) -> int:
@@ -183,8 +320,17 @@ class HunyuanImage3Pipeline:
         `<boi><img_size_1024><ratio_i>` before the image slots)."""
         cfg = self.cfg
         llm = cfg.llm
-        ids, lens = self.tokenizer.batch_encode(prompts,
-                                                cfg.max_text_len)
+        if getattr(self, "hf_tokenizer", None) is not None:
+            self.hf_tokenizer.padding_side = "right"
+            enc = self.hf_tokenizer(
+                list(prompts), padding="max_length", truncation=True,
+                max_length=cfg.max_text_len)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            lens = np.asarray(enc["attention_mask"],
+                              np.int32).sum(axis=1)
+        else:
+            ids, lens = self.tokenizer.batch_encode(prompts,
+                                                    cfg.max_text_len)
         b = len(prompts)
         specials = np.array(
             [llm.boi_token_id, llm.size_token_id,
@@ -291,6 +437,12 @@ class HunyuanImage3Pipeline:
             return None
         img = intake.prepare_cond_image(image, th, tw)
         if self.vae_encoder_params is None:
+            if getattr(self, "_ckpt_weights", False):
+                raise RuntimeError(
+                    "image conditioning unavailable: checkpoint VAE "
+                    "encoder weights are not loaded (from_pretrained "
+                    "loads only the DCAE decoder); a random-init "
+                    "encoder would silently corrupt the context")
             self.vae_encoder_params = self.wiring.place(
                 vae_mod.init_encoder(
                     jax.random.PRNGKey(self._seed + 1), self.cfg.vae,
@@ -432,8 +584,20 @@ class HunyuanImage3Pipeline:
                       jnp.asarray(d_pad), jnp.float32(sp.guidance_scale),
                       jnp.int32(steps))
 
-        img = self._vae_decode_jit(self.vae_params,
-                                   latents.astype(jnp.float32))
+        if getattr(self, "dcae_decoder_params", None) is not None:
+            # real DCAE decode: invert (x - shift) * scale, run the 3D
+            # autoencoder on the single frame
+            dcfg = self.dcae_cfg
+            z = latents.astype(jnp.float32)
+            if dcfg.scaling_factor:
+                z = z / dcfg.scaling_factor
+            if dcfg.shift_factor:
+                z = z + dcfg.shift_factor
+            img = self._dcae_decode_jit(self.dcae_decoder_params,
+                                        z[:, None])[:, 0]
+        else:
+            img = self._vae_decode_jit(self.vae_params,
+                                       latents.astype(jnp.float32))
         img = np.asarray(jnp.clip(
             (img.astype(jnp.float32) + 1.0) * 127.5, 0, 255)
             .astype(jnp.uint8))
