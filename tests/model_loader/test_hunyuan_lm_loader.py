@@ -260,6 +260,62 @@ def test_hunyuan_from_pretrained_generates(ckpt, tmp_path_factory):
                       lvl["upsample"]["conv"])
     put_gn("vae.decoder.norm_out", dec["norm_out"])
     put_conv3("vae.decoder.conv_out", dec["conv_out"])
+    # 3b) DCAE encoder (conditioning-image path)
+    enc = dcae_mod.init_encoder(jax.random.PRNGKey(12), dcae_cfg,
+                                jnp.float32)
+    put_conv3("vae.encoder.conv_in", enc["conv_in"])
+    for i, lvl in enumerate(enc["down"]):
+        for j, bp in enumerate(lvl["block"]):
+            put_res3(f"vae.encoder.down.{i}.block.{j}", bp)
+        if "downsample" in lvl:
+            put_conv3(f"vae.encoder.down.{i}.downsample.conv",
+                      lvl["downsample"]["conv"])
+    for nm in ("block_1", "block_2"):
+        put_res3(f"vae.encoder.mid.{nm}", enc[f"mid_{nm}"])
+    put_gn("vae.encoder.mid.attn_1.norm", enc["mid_attn_1"]["norm"])
+    for nm in ("q", "k", "v", "proj_out"):
+        put_conv3(f"vae.encoder.mid.attn_1.{nm}",
+                  enc["mid_attn_1"][nm])
+    put_gn("vae.encoder.norm_out", enc["norm_out"])
+    put_conv3("vae.encoder.conv_out", enc["conv_out"])
+    # 4) SigLIP-2 understanding tower + LightProjector aligner
+    from vllm_omni_tpu.models.common import siglip as sl
+    from vllm_omni_tpu.models.hunyuan_image_3 import (
+        projector as proj_mod,
+    )
+
+    vit_cfg = sl.SigLIPConfig(hidden_size=32, num_layers=2,
+                              num_heads=4, intermediate_size=64,
+                              patch_size=8, num_positions=16)
+    vit = sl.init_params(jax.random.PRNGKey(13), vit_cfg, jnp.float32)
+    vp = "vision_model."
+    # Siglip2's patch embedding is a Linear over flattened patches
+    sd[f"{vp}embeddings.patch_embedding.weight"] = np.ascontiguousarray(
+        np.asarray(vit["patch_embed"]["w"]).T)
+    sd[f"{vp}embeddings.patch_embedding.bias"] = np.asarray(
+        vit["patch_embed"]["b"])
+    sd[f"{vp}embeddings.position_embedding.weight"] = np.asarray(
+        vit["pos_embed"]["w"])
+    sd[f"{vp}post_layernorm.weight"] = np.asarray(vit["post_norm"]["w"])
+    sd[f"{vp}post_layernorm.bias"] = np.asarray(vit["post_norm"]["b"])
+    for i, lp in enumerate(vit["layers"]):
+        base = f"{vp}encoder.layers.{i}"
+        for hfn, ours in (("layer_norm1", "norm1"),
+                          ("layer_norm2", "norm2"),
+                          ("self_attn.q_proj", "q_proj"),
+                          ("self_attn.k_proj", "k_proj"),
+                          ("self_attn.v_proj", "v_proj"),
+                          ("self_attn.out_proj", "out_proj"),
+                          ("mlp.fc1", "fc1"), ("mlp.fc2", "fc2")):
+            w = np.asarray(lp[ours]["w"])
+            sd[f"{base}.{hfn}.weight"] = np.ascontiguousarray(
+                w.T if w.ndim == 2 else w)
+            sd[f"{base}.{hfn}.bias"] = np.asarray(lp[ours]["b"])
+    aligner = proj_mod.light_projector_init(
+        jax.random.PRNGKey(14), vit_cfg.hidden_size, cfg.hidden_size,
+        2, jnp.float32)
+    for i, lp in enumerate(aligner["layers"]):
+        put_lin(f"vision_aligner.layers.{2 * i}", lp)
 
     save_file(sd, str(root / "model.safetensors"))
     import json as _json
@@ -279,6 +335,15 @@ def test_hunyuan_from_pretrained_generates(ckpt, tmp_path_factory):
             "block_out_channels": [32, 64], "layers_per_block": 1,
             "ffactor_spatial": 2, "ffactor_temporal": 1,
         },
+        "vit": {
+            "hidden_size": 32, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "intermediate_size": 64,
+            "patch_size": 8, "num_patches": 16,
+        },
+        "vit_aligner": {
+            "projector_type": "mlp_gelu", "depth": 2,
+            "input_dim": 32, "n_embed": cfg.hidden_size,
+        },
     })
     (root / "config.json").write_text(_json.dumps(hf))
     (root / "generation_config.json").write_text(
@@ -296,6 +361,9 @@ def test_hunyuan_from_pretrained_generates(ckpt, tmp_path_factory):
     pipe = HunyuanImage3Pipeline.from_pretrained(
         str(root), dtype=jnp.float32, max_text_len=16)
     assert pipe.dcae_decoder_params is not None
+    assert pipe.dcae_encoder_params is not None
+    assert pipe.cfg.vit is not None
+    assert "vit" in pipe.dit_params
     assert pipe.cfg.llm.timestep_shift == 2.0
     sp = OmniDiffusionSamplingParams(
         height=32, width=32, num_inference_steps=2, guidance_scale=3.0,
@@ -304,3 +372,14 @@ def test_hunyuan_from_pretrained_generates(ckpt, tmp_path_factory):
         prompt=["a temple"], sampling_params=sp,
         request_ids=["r0"]))[0].data
     assert out.dtype == np.uint8 and out.shape == (32, 32, 3)
+    # image conditioning: VAE tokens via the real DCAE encoder +
+    # semantic tokens via the SigLIP tower
+    rng = np.random.default_rng(5)
+    sp_img = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=3.0,
+        seed=1,
+        image=rng.integers(0, 255, (32, 32, 3), dtype=np.uint8))
+    out2 = pipe.forward(OmniDiffusionRequest(
+        prompt=["same temple, night"], sampling_params=sp_img,
+        request_ids=["r1"]))[0].data
+    assert out2.dtype == np.uint8 and out2.shape == (32, 32, 3)

@@ -14,7 +14,6 @@ through pjit — the intra-stage fan-out machinery collapses into XLA
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import time
@@ -36,11 +35,15 @@ from vllm_omni_tpu.models.registry import DiffusionModelRegistry
 logger = init_logger(__name__)
 
 
-def resolve_arch(config: OmniDiffusionConfig) -> str:
+_UNSET = object()
+
+
+def resolve_arch(config: OmniDiffusionConfig, declared=_UNSET) -> str:
     """Pipeline class from explicit config or the checkpoint's
     model_index.json ``_class_name`` (reference: omni_diffusion.py:34-109);
     single-repo HF checkpoints (HunyuanImage-3) resolve via config.json
-    ``architectures`` instead."""
+    ``architectures`` instead.  ``declared`` lets a caller that already
+    parsed config.json pass its result in (one parse, one view)."""
     if config.model_arch:
         return config.model_arch
     idx = os.path.join(config.model, "model_index.json")
@@ -49,19 +52,17 @@ def resolve_arch(config: OmniDiffusionConfig) -> str:
             name = json.load(f).get("_class_name", "")
         if name:
             return name
-    declared = _declared_arch(config.model)
+    if declared is _UNSET:
+        declared = _declared_arch(config.model) if config.model else None
     if declared:
         return declared
     # default flagship
     return "QwenImagePipeline"
 
 
-@functools.lru_cache(maxsize=64)
 def _declared_arch(model: str):
     """Registry architecture declared by a local dir's config.json
-    (single-repo HF layout, no model_index.json), or None.  Cached so
-    resolve_arch and the from_ckpt gate share one parse (and one view
-    of the file) per engine construction."""
+    (single-repo HF layout, no model_index.json), or None."""
     p = os.path.join(model, "config.json")
     if not os.path.isfile(p):
         return None
@@ -82,7 +83,9 @@ def _arch_checkpoint(model: str) -> bool:
 class DiffusionEngine:
     def __init__(self, od_config: OmniDiffusionConfig, warmup: bool = True):
         self.od_config = od_config
-        arch = resolve_arch(od_config)
+        declared = (_declared_arch(od_config.model)
+                    if od_config.model else None)
+        arch = resolve_arch(od_config, declared)
         pipeline_cls = DiffusionModelRegistry.resolve(arch)
         dtype = resolve_dtype(od_config.dtype)
         size = od_config.extra.get("size", "")
@@ -141,7 +144,7 @@ class DiffusionEngine:
                                              "model_index.json"))
                  # single-repo HF checkpoints (HunyuanImage-3) carry a
                  # registry architecture in config.json instead
-                 or _arch_checkpoint(od_config.model))
+                 or declared is not None)
             and hasattr(pipeline_cls, "from_pretrained")
         )
         if from_ckpt:

@@ -384,3 +384,65 @@ def load_dcae(model_dir: str, cfg=None, dtype=jnp.bfloat16,
         out[half] = load_routed(model_dir, routing, shapes, dtype,
                                 transforms=transforms)
     return out, cfg
+
+
+def checkpoint_has_prefix(model_dir: str, prefix: str) -> bool:
+    """True if any tensor name in the shard set starts with ``prefix``
+    (key-level scan only; no tensor data is read)."""
+    from safetensors import safe_open
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        _shard_files,
+    )
+
+    for path in _shard_files(model_dir):
+        with safe_open(path, framework="numpy") as f:
+            if any(k.startswith(prefix) for k in f.keys()):
+                return True
+    return False
+
+
+def load_hunyuan_vision(model_dir: str, hf: dict, dtype=jnp.bfloat16):
+    """Load the understanding tower out of the single-repo checkpoint:
+    ``vision_model.*`` is a transformers Siglip2 NaViT encoder (linear
+    patch embedding over flattened patches; reference
+    pipeline_hunyuan_image_3.py:88) and ``vision_aligner.*`` the
+    LightProjector MLP (hunyuan_image_3_transformer.py:723-741,
+    nn.Sequential [Linear, GELU, Linear, ...] -> even module indices).
+
+    Returns (vit_params, vit_cfg, aligner_params, aligner_depth)."""
+    import dataclasses
+
+    from vllm_omni_tpu.models.common import siglip as sl
+    from vllm_omni_tpu.models.flux.loader import load_routed
+    from vllm_omni_tpu.models.hunyuan_image_3 import projector
+
+    vit_hf = dict(hf.get("vit") or {})
+    vit_cfg = sl.SigLIPConfig.from_hf(vit_hf)
+    if "num_patches" in vit_hf:
+        # Siglip2 sizes its position table by num_patches, not
+        # (image_size // patch)^2
+        vit_cfg = dataclasses.replace(
+            vit_cfg, num_positions=vit_hf["num_patches"])
+    vit_params, _ = sl.load_siglip(model_dir, cfg=vit_cfg, dtype=dtype,
+                                   prefix="vision_model.")
+
+    al = dict(hf.get("vit_aligner") or {})
+    depth = al.get("depth", 2)
+    proj_type = al.get("projector_type", "mlp_gelu")
+    if proj_type == "linear":
+        depth = 1
+    elif proj_type != "mlp_gelu":
+        raise ValueError(f"unknown vit_aligner type {proj_type!r}")
+    input_dim = al.get("input_dim", vit_cfg.hidden_size)
+    n_embed = al.get("n_embed", hf.get("hidden_size"))
+    shapes = jax.eval_shape(lambda: projector.light_projector_init(
+        jax.random.PRNGKey(0), input_dim, n_embed, depth, jnp.float32))
+    routing: dict[str, tuple] = {}
+    for i in range(depth):
+        hf_name = ("vision_aligner.layers" if proj_type == "linear"
+                   else f"vision_aligner.layers.{2 * i}")
+        routing[f"{hf_name}.weight"] = ("direct", ("layers", i, "w"))
+        routing[f"{hf_name}.bias"] = ("direct", ("layers", i, "b"))
+    al_params = load_routed(model_dir, routing, shapes, dtype)
+    return vit_params, vit_cfg, al_params, depth

@@ -99,7 +99,8 @@ class HunyuanImage3Pipeline:
 
     output_type = "image"
     config_cls = HunyuanImage3PipelineConfig
-    param_attrs = ("dit_params", "vae_params", "dcae_decoder_params")
+    param_attrs = ("dit_params", "vae_params", "vae_encoder_params",
+                   "dcae_decoder_params", "dcae_encoder_params")
 
     def __init__(self, config: HunyuanImage3PipelineConfig,
                  dtype=jnp.bfloat16, seed: int = 0, mesh=None,
@@ -182,6 +183,7 @@ class HunyuanImage3Pipeline:
         # random-init stand-in VAE.  A separate attr so engine.sleep()
         # offloads it with the other trees.
         self.dcae_decoder_params = None
+        self.dcae_encoder_params = None
         self.dcae_cfg = None
         self.hf_tokenizer = None
 
@@ -201,9 +203,11 @@ class HunyuanImage3Pipeline:
         """Build from the published single-repo checkpoint: the causal
         MoE LM + UNet projector heads + DCAE autoencoder all live in one
         shard set (the vae under the ``vae.`` key namespace, its config
-        under config.json["vae"]).  The SigLIP understanding tower loads
-        when ``vision_model.*`` weights are present; otherwise
-        text-to-image runs without it."""
+        under config.json["vae"]).  The SigLIP-2 understanding tower
+        and aligner load when ``vision_model.*`` weights are present
+        (image conditioning runs VAE tokens through the DCAE encoder
+        and semantic tokens through the tower); otherwise
+        text-to-image runs without them."""
         import dataclasses
         import json as _json
         import os
@@ -257,10 +261,16 @@ class HunyuanImage3Pipeline:
                 overrides["ratio_token_base"] = rid
             if overrides:
                 llm_cfg = dataclasses.replace(llm_cfg, **overrides)
-        # vit=None: the SigLIP tower has no loader wired yet — a
-        # random-init tower beside real LM weights would silently
-        # corrupt image-conditioned requests, so those fail loudly
-        # until vision_model.* loading lands
+        # SigLIP-2 understanding tower: load when the checkpoint
+        # carries vision_model.* weights; otherwise image-conditioned
+        # requests fail loudly (never random-init beside real weights)
+        vit_cfg = None
+        vit_trees = None
+        al_depth = 2
+        if hload.checkpoint_has_prefix(model_dir, "vision_model."):
+            vit_p, vit_cfg, al_p, al_depth = hload.load_hunyuan_vision(
+                model_dir, hf, dtype=dtype)
+            vit_trees = {"vit": vit_p, "vit_aligner": al_p}
         import math as _math
 
         # stand-in VAEConfig consistent with the llm geometry (its
@@ -273,7 +283,8 @@ class HunyuanImage3Pipeline:
             base_channels=16, layers_per_block=1,
             scaling_factor=1.0, shift_factor=0.0)
         config = dataclasses.replace(
-            cls.config_cls.tiny(), llm=llm_cfg, vit=None,
+            cls.config_cls.tiny(), llm=llm_cfg, vit=vit_cfg,
+            vit_aligner_depth=al_depth,
             vae=stand_in_vae, max_text_len=max_text_len)
         pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
                    cache_config=cache_config, init_weights=False)
@@ -299,10 +310,13 @@ class HunyuanImage3Pipeline:
         })
         heads = hload.load_hunyuan_heads(model_dir, head_shapes,
                                          dtype=dtype)
-        pipe.dit_params = pipe.wiring.place({"llm": lm_params, **heads})
+        pipe.dit_params = pipe.wiring.place(
+            {"llm": lm_params, **heads, **(vit_trees or {})})
         trees, _ = hload.load_dcae(model_dir, cfg=dcae_cfg, dtype=dtype,
-                                   decoder=True, prefix="vae.")
+                                   encoder=True, decoder=True,
+                                   prefix="vae.")
         pipe.dcae_decoder_params = pipe.wiring.place(trees["decoder"])
+        pipe.dcae_encoder_params = pipe.wiring.place(trees["encoder"])
         pipe.dcae_cfg = dcae_cfg
         pipe.hf_tokenizer = hf_tok
         return pipe
@@ -436,12 +450,22 @@ class HunyuanImage3Pipeline:
         if image is None:
             return None
         img = intake.prepare_cond_image(image, th, tw)
+        if getattr(self, "dcae_encoder_params", None) is not None:
+            if not hasattr(self, "_img_ctx_dcae_jit"):
+                self._img_ctx_dcae_jit = jax.jit(
+                    self._embed_image_context_dcae)
+            heads = {k: self.dit_params[k]
+                     for k in ("time_embed", "patch_embed")}
+            tokens = self._img_ctx_dcae_jit(self.dcae_encoder_params,
+                                            heads,
+                                            jnp.asarray(img,
+                                                        jnp.float32))
+            return jnp.repeat(tokens, batch, axis=0)
         if self.vae_encoder_params is None:
             if getattr(self, "_ckpt_weights", False):
                 raise RuntimeError(
-                    "image conditioning unavailable: checkpoint VAE "
-                    "encoder weights are not loaded (from_pretrained "
-                    "loads only the DCAE decoder); a random-init "
+                    "image conditioning unavailable: the checkpoint "
+                    "carries no DCAE encoder weights; a random-init "
                     "encoder would silently corrupt the context")
             self.vae_encoder_params = self.wiring.place(
                 vae_mod.init_encoder(
@@ -450,9 +474,34 @@ class HunyuanImage3Pipeline:
         if not hasattr(self, "_img_ctx_jit"):
             self._img_ctx_jit = jax.jit(self._embed_image_context)
         tokens = self._img_ctx_jit(self.vae_encoder_params,
-                                   self.dit_params,
+                                   {k: self.dit_params[k]
+                                    for k in ("time_embed",
+                                              "patch_embed")},
                                    jnp.asarray(img, jnp.float32))
         return jnp.repeat(tokens, batch, axis=0)
+
+    def _embed_image_context_dcae(self, enc_params, params, img):
+        """Real-checkpoint conditioning: DCAE encode -> distribution
+        mode -> (x - shift) * scale (reference
+        pipeline_hunyuan_image_3.py:377-381) -> UNetDown patch embed at
+        t=0."""
+        from vllm_omni_tpu.models.hunyuan_image_3 import (
+            autoencoder as dcae_mod,
+        )
+
+        dcfg = self.dcae_cfg
+        moments = dcae_mod.encode(enc_params, dcfg, img[None, None])
+        lat = moments[:, 0, :, :, :dcfg.latent_channels]
+        if dcfg.shift_factor:
+            lat = lat - dcfg.shift_factor
+        if dcfg.scaling_factor:
+            lat = lat * dcfg.scaling_factor
+        lat = lat.astype(self.dtype)
+        t0 = projector.timestep_embed(params["time_embed"],
+                                      jnp.zeros((1,)), lat.dtype)
+        tokens, _, _ = projector.unet_down(params["patch_embed"], lat,
+                                           t0)
+        return tokens
 
     def _embed_image_context(self, enc_params, params, img):
         lat = vae_mod.encode(enc_params, self.cfg.vae, img[None])
