@@ -1,0 +1,430 @@
+"""StableAudio Open real-weight path: checkpoint-schema DiT parity,
+Oobleck decoder parity (weight-norm folding), and the full
+from_pretrained e2e (T5 + projection model + DPM-Solver++ sampler).
+
+Oracles are transcribed in-test from the reference modules
+(vllm_omni/diffusion/models/stable_audio/stable_audio_transformer.py and
+the diffusers AutoencoderOobleck the reference decodes through) — no
+diffusers import.
+"""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from vllm_omni_tpu.models.stable_audio import (  # noqa: E402
+    ckpt_transformer as sdit,
+)
+from vllm_omni_tpu.models.stable_audio import oobleck  # noqa: E402
+
+TINY = sdit.StableAudioCkptConfig.tiny()
+
+
+def _dit_state_dict(rng, cfg):
+    """Diffusers-named tensors for the tiny DiT."""
+    inner, c = cfg.inner_dim, cfg.in_channels
+    kv = cfg.num_kv_heads * cfg.head_dim
+    sd = {"time_proj.weight": rng.standard_normal(
+        cfg.time_proj_dim // 2)}
+
+    def lin(name, i, o, bias=True):
+        sd[f"{name}.weight"] = 0.2 * rng.standard_normal((o, i))
+        if bias:
+            sd[f"{name}.bias"] = 0.1 * rng.standard_normal(o)
+
+    lin("timestep_proj.linear_1", cfg.time_proj_dim, inner)
+    lin("timestep_proj.linear_2", inner, inner)
+    lin("global_proj.linear_1", cfg.global_states_input_dim, inner,
+        bias=False)
+    lin("global_proj.linear_2", inner, inner, bias=False)
+    lin("cross_attention_proj.0", cfg.cross_attention_input_dim,
+        cfg.cross_attention_dim, bias=False)
+    lin("cross_attention_proj.2", cfg.cross_attention_dim,
+        cfg.cross_attention_dim, bias=False)
+    sd["preprocess_conv.weight"] = 0.2 * rng.standard_normal((c, c, 1))
+    lin("proj_in", c, inner, bias=False)
+    lin("proj_out", inner, c, bias=False)
+    sd["postprocess_conv.weight"] = 0.2 * rng.standard_normal((c, c, 1))
+    for i in range(cfg.num_layers):
+        b = f"transformer_blocks.{i}"
+        for nm in ("norm1", "norm2", "norm3"):
+            sd[f"{b}.{nm}.weight"] = 1.0 + 0.1 * rng.standard_normal(
+                inner)
+            sd[f"{b}.{nm}.bias"] = 0.1 * rng.standard_normal(inner)
+        for a, (ki, vi) in (("attn1", (inner, inner)),
+                            ("attn2", (cfg.cross_attention_dim, kv))):
+            lin(f"{b}.{a}.to_q", inner, inner, bias=False)
+            lin(f"{b}.{a}.to_k", ki, vi if a == "attn2" else inner,
+                bias=False)
+            lin(f"{b}.{a}.to_v", ki, vi if a == "attn2" else inner,
+                bias=False)
+            lin(f"{b}.{a}.to_out.0", inner, inner, bias=False)
+        lin(f"{b}.ff.net.0.proj", inner, 2 * cfg.ff_inner)
+        lin(f"{b}.ff.net.2", cfg.ff_inner, inner)
+    return {k: np.ascontiguousarray(v, dtype=np.float32)
+            for k, v in sd.items()}
+
+
+def _oracle_dit(sd, cfg, lat, t, ctx, glob):
+    """Reference forward transcription (stable_audio_transformer.py:
+    489-566) on [B, L, C] torch tensors."""
+    sd = {k: torch.from_numpy(v) for k, v in sd.items()}
+
+    def lin(name, x):
+        y = x @ sd[f"{name}.weight"].T
+        if f"{name}.bias" in sd:
+            y = y + sd[f"{name}.bias"]
+        return y
+
+    cross = lin("cross_attention_proj.2",
+                F.silu(lin("cross_attention_proj.0", ctx)))
+    ge = lin("global_proj.linear_2",
+             F.silu(lin("global_proj.linear_1", glob)))[:, None]
+    xp = 2 * math.pi * t[:, None] * sd["time_proj.weight"][None]
+    four = torch.cat([xp.cos(), xp.sin()], -1)
+    temb = lin("timestep_proj.linear_2",
+               F.silu(lin("timestep_proj.linear_1", four)))
+    ge = ge + temb[:, None]
+
+    x = lat @ sd["preprocess_conv.weight"][:, :, 0].T + lat
+    x = lin("proj_in", x)
+    x = torch.cat([ge, x], 1)
+    b, n, _ = x.shape
+    h, hk, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    rot = cfg.rot_dim
+    freqs = 1.0 / (10000.0 ** (np.arange(0, rot, 2) / rot))
+    ang = torch.from_numpy(
+        np.arange(n)[:, None] * freqs[None]).float()
+    cos = torch.cat([ang.cos(), ang.cos()], -1)
+    sin = torch.cat([ang.sin(), ang.sin()], -1)
+
+    def rope(q):  # [B, N, H, D]
+        xr, xp_ = q[..., :rot], q[..., rot:]
+        x1, x2 = xr.chunk(2, -1)
+        rotated = torch.cat([-x2, x1], -1)
+        out = xr * cos[None, :, None] + rotated * sin[None, :, None]
+        return torch.cat([out, xp_], -1)
+
+    def attn(q, k, v):
+        s = torch.einsum("bshd,bthd->bhst", q, k) / math.sqrt(d)
+        return torch.einsum("bhst,bthd->bshd", s.softmax(-1),
+                            v).reshape(b, q.shape[1], -1)
+
+    for i in range(cfg.num_layers):
+        bl = f"transformer_blocks.{i}"
+
+        def ln(nm, y):
+            return F.layer_norm(y, (y.shape[-1],),
+                                sd[f"{bl}.{nm}.weight"],
+                                sd[f"{bl}.{nm}.bias"])
+
+        y = ln("norm1", x)
+        q = lin(f"{bl}.attn1.to_q", y).view(b, n, h, d)
+        k = lin(f"{bl}.attn1.to_k", y).view(b, n, h, d)
+        v = lin(f"{bl}.attn1.to_v", y).view(b, n, h, d)
+        x = x + lin(f"{bl}.attn1.to_out.0", attn(rope(q), rope(k), v))
+        y = ln("norm2", x)
+        s = ctx.shape[1]
+        q = lin(f"{bl}.attn2.to_q", y).view(b, n, h, d)
+        k = lin(f"{bl}.attn2.to_k", cross).view(b, s, hk, d)
+        v = lin(f"{bl}.attn2.to_v", cross).view(b, s, hk, d)
+        k = k.repeat_interleave(h // hk, dim=2)
+        v = v.repeat_interleave(h // hk, dim=2)
+        x = x + lin(f"{bl}.attn2.to_out.0", attn(q, k, v))
+        y = ln("norm3", x)
+        p = lin(f"{bl}.ff.net.0.proj", y)
+        val, gate = p.chunk(2, -1)
+        x = x + lin(f"{bl}.ff.net.2", val * F.silu(gate))
+
+    x = lin("proj_out", x)[:, 1:]
+    return x @ sd["postprocess_conv.weight"][:, :, 0].T + x
+
+
+def test_stable_audio_dit_parity(tmp_path):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    sd = _dit_state_dict(rng, TINY)
+    save_file(sd, str(tmp_path / "diffusion_pytorch_model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "in_channels": TINY.in_channels, "num_layers": TINY.num_layers,
+        "num_attention_heads": TINY.num_heads,
+        "num_key_value_attention_heads": TINY.num_kv_heads,
+        "attention_head_dim": TINY.head_dim,
+        "cross_attention_dim": TINY.cross_attention_dim,
+        "cross_attention_input_dim": TINY.cross_attention_input_dim,
+        "global_states_input_dim": TINY.global_states_input_dim,
+        "time_proj_dim": TINY.time_proj_dim,
+        "sample_size": TINY.sample_size,
+    }))
+    params, cfg = sdit.load_stable_audio_dit(str(tmp_path),
+                                             dtype=jnp.float32)
+    b, L, s = 2, 12, 5
+    lat = rng.standard_normal((b, L, cfg.in_channels)).astype(np.float32)
+    t = np.asarray([0.3, 0.8], np.float32)
+    ctx = rng.standard_normal(
+        (b, s, cfg.cross_attention_input_dim)).astype(np.float32)
+    glob = rng.standard_normal(
+        (b, cfg.global_states_input_dim)).astype(np.float32)
+    got = np.asarray(sdit.forward(params, cfg, jnp.asarray(lat),
+                                  jnp.asarray(t), jnp.asarray(ctx),
+                                  jnp.asarray(glob)))
+    want = _oracle_dit(sd, cfg, torch.from_numpy(lat),
+                       torch.from_numpy(t), torch.from_numpy(ctx),
+                       torch.from_numpy(glob)).numpy()
+    # f32 accumulation-order noise through softmax attn; semantic
+    # convention errors show up orders of magnitude above this
+    np.testing.assert_allclose(got, want, atol=5e-3, rtol=5e-3)
+
+
+# --------------------------------------------------------------- oobleck
+OB = oobleck.OobleckConfig.tiny()
+
+
+def _oobleck_state_dict(rng, cfg):
+    """weight_g / weight_v decomposed tensors at the diffusers names."""
+    sd = {}
+
+    def wnorm_conv(name, cin, cout, k, bias=True):
+        v = 0.3 * rng.standard_normal((cout, cin, k))
+        g = np.abs(rng.standard_normal((cout, 1, 1))) + 0.5
+        sd[f"{name}.weight_v"] = v
+        sd[f"{name}.weight_g"] = g
+        if bias:
+            sd[f"{name}.bias"] = 0.1 * rng.standard_normal(cout)
+
+    def wnorm_tconv(name, cin, cout, k):
+        v = 0.3 * rng.standard_normal((cin, cout, k))
+        g = np.abs(rng.standard_normal((cin, 1, 1))) + 0.5
+        sd[f"{name}.weight_v"] = v
+        sd[f"{name}.weight_g"] = g
+        sd[f"{name}.bias"] = 0.1 * rng.standard_normal(cout)
+
+    def snake(name, ch):
+        sd[f"{name}.alpha"] = 0.2 * rng.standard_normal((1, ch, 1))
+        sd[f"{name}.beta"] = 0.2 * rng.standard_normal((1, ch, 1))
+
+    dims = oobleck._dims(cfg)
+    wnorm_conv("decoder.conv1", cfg.decoder_input_channels, dims[0][0],
+               7)
+    for i, (cin, cout, s) in enumerate(dims):
+        b = f"decoder.block.{i}"
+        snake(f"{b}.snake1", cin)
+        wnorm_tconv(f"{b}.conv_t1", cin, cout, 2 * s)
+        for j in (1, 2, 3):
+            snake(f"{b}.res_unit{j}.snake1", cout)
+            wnorm_conv(f"{b}.res_unit{j}.conv1", cout, cout, 7)
+            snake(f"{b}.res_unit{j}.snake2", cout)
+            wnorm_conv(f"{b}.res_unit{j}.conv2", cout, cout, 1)
+    snake("decoder.snake1", cfg.decoder_channels)
+    wnorm_conv("decoder.conv2", cfg.decoder_channels,
+               cfg.audio_channels, 7, bias=False)
+    return {k: np.ascontiguousarray(v, dtype=np.float32)
+            for k, v in sd.items()}
+
+
+def _oracle_oobleck(sd, cfg, z):
+    """diffusers OobleckDecoder transcription on [B, C, T] torch."""
+    sd = {k: torch.from_numpy(v) for k, v in sd.items()}
+
+    def fold(name):
+        v, g = sd[f"{name}.weight_v"], sd[f"{name}.weight_g"]
+        norm = v.norm(dim=tuple(range(1, v.ndim)), keepdim=True)
+        return g * v / norm
+
+    def conv(name, x, dilation=1, k=7):
+        pad = ((k - 1) * dilation) // 2
+        return F.conv1d(x, fold(name), sd.get(f"{name}.bias"),
+                        padding=pad, dilation=dilation)
+
+    def tconv(name, x, s):
+        return F.conv_transpose1d(x, fold(name), sd[f"{name}.bias"],
+                                  stride=s, padding=math.ceil(s / 2))
+
+    def snake(name, x):
+        a = sd[f"{name}.alpha"].exp()
+        be = sd[f"{name}.beta"].exp()
+        return x + (be + 1e-9).reciprocal() * (a * x).sin().pow(2)
+
+    def res(name, x, dil):
+        h = snake(f"{name}.snake1", x)
+        h = conv(f"{name}.conv1", h, dilation=dil)
+        h = snake(f"{name}.snake2", h)
+        return x + conv(f"{name}.conv2", h, k=1)
+
+    x = conv("decoder.conv1", z)
+    for i, (_, _, s) in enumerate(oobleck._dims(cfg)):
+        b = f"decoder.block.{i}"
+        x = snake(f"{b}.snake1", x)
+        x = tconv(f"{b}.conv_t1", x, s)
+        for j, dil in ((1, 1), (2, 3), (3, 9)):
+            x = res(f"{b}.res_unit{j}", x, dil)
+    x = snake("decoder.snake1", x)
+    return conv("decoder.conv2", x)
+
+
+def test_oobleck_decoder_parity(tmp_path):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(1)
+    sd = _oobleck_state_dict(rng, OB)
+    save_file(sd, str(tmp_path / "diffusion_pytorch_model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "audio_channels": OB.audio_channels,
+        "decoder_channels": OB.decoder_channels,
+        "decoder_input_channels": OB.decoder_input_channels,
+        "channel_multiples": list(OB.channel_multiples),
+        "downsampling_ratios": list(OB.downsampling_ratios),
+        "sampling_rate": OB.sampling_rate,
+    }))
+    params, cfg = oobleck.load_oobleck_decoder(str(tmp_path),
+                                               dtype=jnp.float32)
+    z = np.random.default_rng(2).standard_normal(
+        (2, 6, cfg.decoder_input_channels)).astype(np.float32)
+    got = np.asarray(oobleck.decode(params, cfg, jnp.asarray(z)))
+    want = _oracle_oobleck(sd, cfg, torch.from_numpy(
+        z.transpose(0, 2, 1))).numpy().transpose(0, 2, 1)
+    assert got.shape == want.shape == (2, 6 * cfg.hop_length,
+                                       cfg.audio_channels)
+    # the sin^2 snake stages amplify f32 accumulation noise; a layout
+    # or fold error would diverge by O(1)
+    np.testing.assert_allclose(got, want, atol=5e-3, rtol=5e-3)
+
+
+# ------------------------------------------------------------------- e2e
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    from safetensors.numpy import save_file
+
+    from tests.model_loader.test_diffusers_loader import (
+        _write_byte_level_tokenizer,
+    )
+    from transformers import T5Config as HfT5Config
+    from transformers import T5EncoderModel
+
+    root = tmp_path_factory.mktemp("stable_audio_repo")
+    rng = np.random.default_rng(7)
+    # DiT with ctx/global dims matching the tiny T5 (d_model 32)
+    dit_cfg = sdit.StableAudioCkptConfig(
+        in_channels=OB.decoder_input_channels, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+        cross_attention_dim=32, cross_attention_input_dim=32,
+        global_states_input_dim=64, time_proj_dim=32, sample_size=16)
+    d = root / "transformer"
+    d.mkdir()
+    save_file(_dit_state_dict(rng, dit_cfg),
+              str(d / "diffusion_pytorch_model.safetensors"))
+    (d / "config.json").write_text(json.dumps({
+        "in_channels": dit_cfg.in_channels, "num_layers": 2,
+        "num_attention_heads": 4, "num_key_value_attention_heads": 2,
+        "attention_head_dim": 16, "cross_attention_dim": 32,
+        "cross_attention_input_dim": 32, "global_states_input_dim": 64,
+        "time_proj_dim": 32, "sample_size": 16}))
+
+    torch.manual_seed(0)
+    te = T5EncoderModel(HfT5Config(
+        vocab_size=256, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4)).eval()
+    te.save_pretrained(str(root / "text_encoder"),
+                       safe_serialization=True)
+    _write_byte_level_tokenizer(root / "tokenizer")
+
+    pm = root / "projection_model"
+    pm.mkdir()
+    psd = {}
+    for grp in ("start_number_conditioner", "end_number_conditioner"):
+        psd[f"{grp}.time_positional_embedding.0.weights"] = \
+            rng.standard_normal(8).astype(np.float32)
+        psd[f"{grp}.time_positional_embedding.1.weight"] = \
+            (0.3 * rng.standard_normal((32, 17))).astype(np.float32)
+        psd[f"{grp}.time_positional_embedding.1.bias"] = \
+            (0.1 * rng.standard_normal(32)).astype(np.float32)
+    save_file(psd, str(pm / "diffusion_pytorch_model.safetensors"))
+    (pm / "config.json").write_text(json.dumps(
+        {"min_value": 0.0, "max_value": 512.0}))
+
+    v = root / "vae"
+    v.mkdir()
+    save_file(_oobleck_state_dict(rng, OB),
+              str(v / "diffusion_pytorch_model.safetensors"))
+    (v / "config.json").write_text(json.dumps({
+        "audio_channels": OB.audio_channels,
+        "decoder_channels": OB.decoder_channels,
+        "decoder_input_channels": OB.decoder_input_channels,
+        "channel_multiples": list(OB.channel_multiples),
+        "downsampling_ratios": list(OB.downsampling_ratios),
+        "sampling_rate": OB.sampling_rate}))
+
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "CosineDPMSolverMultistepScheduler",
+                    "sigma_min": 0.3, "sigma_max": 100.0,
+                    "sigma_data": 1.0}))
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "StableAudioPipeline",
+        "transformer": ["diffusers", "StableAudioDiTModel"],
+        "text_encoder": ["transformers", "T5EncoderModel"],
+        "tokenizer": ["transformers", "T5TokenizerFast"],
+        "projection_model": ["diffusers", "StableAudioProjectionModel"],
+        "scheduler": ["diffusers", "CosineDPMSolverMultistepScheduler"],
+        "vae": ["diffusers", "AutoencoderOobleck"],
+    }))
+    return str(root)
+
+
+def test_from_pretrained_generates(checkpoint):
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.stable_audio.pipeline import (
+        StableAudioPipeline,
+    )
+
+    pipe = StableAudioPipeline.from_pretrained(checkpoint,
+                                               dtype=jnp.float32)
+    assert pipe.ckpt_dit_params is not None
+    assert pipe.sched_cfg["sigma_max"] == 100.0
+    sr = pipe.oobleck_cfg.sampling_rate
+    end_s = 8 * pipe.oobleck_cfg.hop_length / sr  # half the max frames
+    sp = OmniDiffusionSamplingParams(
+        num_inference_steps=3, guidance_scale=4.0, seed=0,
+        extra={"audio_end_in_s": end_s})
+    out = pipe.forward(OmniDiffusionRequest(
+        prompt=["rain on a tin roof"], sampling_params=sp,
+        request_ids=["r0"]))[0]
+    wav = out.data
+    assert wav.dtype == np.float32
+    assert wav.shape == (OB.audio_channels, int(end_s * sr))
+    assert np.isfinite(wav).all()
+    assert out.metrics["sample_rate"] == float(sr)
+    # the prompt conditions the output through the T5 stack
+    out2 = pipe.forward(OmniDiffusionRequest(
+        prompt=["a violin melody"], sampling_params=sp,
+        request_ids=["r1"]))[0]
+    assert not np.array_equal(wav, out2.data)
+    # negative prompts ride the explicit-uncond CFG branch
+    sp_neg = OmniDiffusionSamplingParams(
+        num_inference_steps=3, guidance_scale=4.0, seed=0,
+        negative_prompt="loud noise", extra={"audio_end_in_s": end_s})
+    out3 = pipe.forward(OmniDiffusionRequest(
+        prompt=["rain on a tin roof"], sampling_params=sp_neg,
+        request_ids=["r2"]))[0]
+    assert not np.array_equal(wav, out3.data)
+
+
+def test_engine_builds_real_stable_audio(checkpoint):
+    from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+    from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model=checkpoint, dtype="float32"), warmup=False)
+    assert eng.pipeline.ckpt_dit_params is not None

@@ -17,6 +17,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def compute_dynamic_shift_mu(
@@ -131,3 +132,81 @@ def multistep_step(
     # terminal step (sigma_next == 0): the update collapses to d exactly
     new_lat = jnp.where(sigma_next <= _LAMBDA_EPS, d, new_lat)
     return new_lat.astype(latents.dtype), x0, lam
+
+
+# ------------------------------------------------- EDM cosine DPM-Solver
+# StableAudio Open sampling (reference: CosineDPMSolverMultistepScheduler
+# from diffusers, pipeline_stable_audio.py:134-139,505-553): EDM
+# preconditioning with sigma_data, exponential sigma schedule, the model
+# conditioned on t = atan(sigma) * 2/pi (the "cosine" parameterization),
+# deterministic DPM-Solver++(2M) updates in lambda = -log(sigma) space.
+
+@dataclass(frozen=True)
+class EdmDpmSchedule:
+    sigmas: jax.Array       # [steps + 1], last entry 0
+    sigma_data: float = 1.0
+
+    @property
+    def init_noise_sigma(self) -> float:
+        return float(np.sqrt(float(self.sigmas[0]) ** 2
+                             + self.sigma_data ** 2))
+
+
+def make_edm_dpm_schedule(num_steps: int, sigma_min: float = 0.3,
+                          sigma_max: float = 500.0,
+                          sigma_data: float = 1.0) -> EdmDpmSchedule:
+    """Exponential (log-linear) sigma ramp sigma_max -> sigma_min, then
+    the terminal 0."""
+    sig = np.exp(np.linspace(np.log(sigma_max), np.log(sigma_min),
+                             num_steps))
+    return EdmDpmSchedule(
+        sigmas=jnp.asarray(np.concatenate([sig, [0.0]]), jnp.float32),
+        sigma_data=sigma_data)
+
+
+def edm_precondition_inputs(sample, sigma, sigma_data: float = 1.0):
+    """c_in scaling (scale_model_input)."""
+    c_in = 1.0 / jnp.sqrt(sigma ** 2 + sigma_data ** 2)
+    return sample * c_in
+
+
+def edm_sigma_to_t(sigma):
+    """Model-facing timestep: t = atan(sigma) * 2/pi in [0, 1)."""
+    return jnp.arctan(sigma) * (2.0 / jnp.pi)
+
+
+def edm_precondition_outputs(sample, model_output, sigma,
+                             sigma_data: float = 1.0):
+    """v-prediction EDM preconditioning: denoised = c_skip * x + c_out
+    * F(c_in x, t)."""
+    c_skip = sigma_data ** 2 / (sigma ** 2 + sigma_data ** 2)
+    c_out = -sigma * sigma_data / jnp.sqrt(sigma ** 2 + sigma_data ** 2)
+    return c_skip * sample + c_out * model_output
+
+
+def edm_sde_dpm_step(latents, denoised, prev_denoised, i, sigmas,
+                     noise):
+    """One SDE-DPMSolver++(2M) update (alpha = 1, midpoint) — the only
+    algorithm the reference's CosineDPMSolverMultistepScheduler runs:
+
+        x_t = (sigma_t/sigma_s) e^{-h} x + (1 - e^{-2h}) D~
+              + sigma_t sqrt(1 - e^{-2h}) eps
+
+    with lambda = -log(sigma), h = lambda_t - lambda_s (so e^{-h} =
+    sigma_t/sigma_s), D~ = D0 + (D0 - D_prev)/(2 r) on multistep steps
+    and D0 on the first.  latents/denoised/noise [B, ...] fp32;
+    prev_denoised is ignored at i == 0.  The terminal step
+    (sigma_t == 0) collapses to the denoised sample."""
+    sigma_s, sigma_t = sigmas[i], sigmas[i + 1]
+    sigma_prev = sigmas[jnp.maximum(i - 1, 0)]
+    eps = 1e-12
+    h = jnp.log(sigma_s / jnp.maximum(sigma_t, eps))
+    h_last = jnp.log(sigma_prev / sigma_s)
+    r = h_last / jnp.maximum(h, eps)
+    d1 = (denoised - prev_denoised) / r
+    d = jnp.where(i > 0, denoised + 0.5 * d1, denoised)
+    decay = jnp.exp(-h)                       # == sigma_t / sigma_s
+    grow = -jnp.expm1(-2.0 * h)               # 1 - e^{-2h}
+    out = (sigma_t / sigma_s) * decay * latents + grow * d \
+        + sigma_t * jnp.sqrt(grow) * noise
+    return jnp.where(sigma_t <= eps, denoised, out)

@@ -376,10 +376,16 @@ def scheduler_config(model_dir: str) -> dict:
         return {}
     with open(path) as f:
         sc = json.load(f)
-    return {
+    out = {
         "shift": sc.get("shift", 1.0),
         "use_dynamic_shifting": sc.get("use_dynamic_shifting", False),
     }
+    # EDM-family schedulers (StableAudio's CosineDPMSolverMultistep)
+    # carry sigma knobs instead of a flow shift
+    for k in ("sigma_min", "sigma_max", "sigma_data"):
+        if k in sc:
+            out[k] = sc[k]
+    return out
 
 
 # --------------------------------------------------------- 2-D image VAE

@@ -1,0 +1,276 @@
+"""StableAudio Open DiT at the published checkpoint schema.
+
+Checkpoint-faithful twin of the reference's ``StableAudioDiTModel``
+(vllm_omni/diffusion/models/stable_audio/stable_audio_transformer.py:
+364-602, itself the diffusers StableAudioDiTModel): Gaussian-Fourier
+time embedding, duration (global) token prepended to the latent
+sequence, GQA cross-attention into projected T5 states, SwiGLU FFs, and
+partial 1-D rotary (first head_dim//2 dims only,
+apply_rotary_emb_stable_audio :24-55).
+
+TPU-first: NWC layouts throughout ([B, L, C] — the reference's [B, C, L]
+conv layout would force transposes around every matmul), the 1x1
+pre/post convs are plain matmuls, and the whole step jits into one
+XLA program.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+
+
+@dataclass(frozen=True)
+class StableAudioCkptConfig:
+    in_channels: int = 64
+    num_layers: int = 24
+    num_heads: int = 24
+    num_kv_heads: int = 12          # cross-attention GQA only
+    head_dim: int = 64
+    cross_attention_dim: int = 768
+    cross_attention_input_dim: int = 768
+    global_states_input_dim: int = 1536
+    time_proj_dim: int = 256
+    sample_size: int = 1024         # max latent frames
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def ff_inner(self) -> int:
+        return 4 * self.inner_dim
+
+    @property
+    def rot_dim(self) -> int:
+        return self.head_dim // 2
+
+    @staticmethod
+    def tiny() -> "StableAudioCkptConfig":
+        return StableAudioCkptConfig(
+            in_channels=8, num_layers=2, num_heads=4, num_kv_heads=2,
+            head_dim=16, cross_attention_dim=32,
+            cross_attention_input_dim=32, global_states_input_dim=64,
+            time_proj_dim=32, sample_size=64)
+
+    @staticmethod
+    def from_hf(d: dict) -> "StableAudioCkptConfig":
+        return StableAudioCkptConfig(
+            in_channels=d.get("in_channels", 64),
+            num_layers=d.get("num_layers", 24),
+            num_heads=d.get("num_attention_heads", 24),
+            num_kv_heads=d.get("num_key_value_attention_heads", 12),
+            head_dim=d.get("attention_head_dim", 64),
+            cross_attention_dim=d.get("cross_attention_dim", 768),
+            cross_attention_input_dim=d.get(
+                "cross_attention_input_dim", 768),
+            global_states_input_dim=d.get(
+                "global_states_input_dim", 1536),
+            time_proj_dim=d.get("time_proj_dim", 256),
+            sample_size=d.get("sample_size", 1024),
+        )
+
+
+def init_params(key, cfg: StableAudioCkptConfig, dtype=jnp.float32):
+    inner, c = cfg.inner_dim, cfg.in_channels
+    ks = iter(jax.random.split(key, 16 + 12 * cfg.num_layers))
+
+    def lin(i, o, bias=True):
+        return nn.linear_init(next(ks), i, o, bias=bias, dtype=dtype)
+
+    p = {
+        "time_fourier": jax.random.normal(
+            next(ks), (cfg.time_proj_dim // 2,), dtype),
+        "tfc1": lin(cfg.time_proj_dim, inner),
+        "tfc2": lin(inner, inner),
+        "gfc1": lin(cfg.global_states_input_dim, inner, bias=False),
+        "gfc2": lin(inner, inner, bias=False),
+        "cfc1": lin(cfg.cross_attention_input_dim,
+                    cfg.cross_attention_dim, bias=False),
+        "cfc2": lin(cfg.cross_attention_dim, cfg.cross_attention_dim,
+                    bias=False),
+        "pre_conv": lin(c, c, bias=False),     # 1x1 conv == matmul
+        "proj_in": lin(c, inner, bias=False),
+        "proj_out": lin(inner, c, bias=False),
+        "post_conv": lin(c, c, bias=False),
+        "blocks": [],
+    }
+    kv_dim = cfg.num_kv_heads * cfg.head_dim
+    for _ in range(cfg.num_layers):
+        p["blocks"].append({
+            "norm1": nn.layernorm_init(inner, dtype=dtype),
+            "q1": lin(inner, inner, bias=False),
+            "k1": lin(inner, inner, bias=False),
+            "v1": lin(inner, inner, bias=False),
+            "o1": lin(inner, inner, bias=False),
+            "norm2": nn.layernorm_init(inner, dtype=dtype),
+            "q2": lin(inner, inner, bias=False),
+            "k2": lin(cfg.cross_attention_dim, kv_dim, bias=False),
+            "v2": lin(cfg.cross_attention_dim, kv_dim, bias=False),
+            "o2": lin(inner, inner, bias=False),
+            "norm3": nn.layernorm_init(inner, dtype=dtype),
+            "ff_proj": lin(inner, 2 * cfg.ff_inner),
+            "ff_out": lin(cfg.ff_inner, inner),
+        })
+    return p
+
+
+def rope_1d(cfg: StableAudioCkptConfig, length: int):
+    """diffusers get_1d_rotary_pos_embed(rot_dim, use_real=True,
+    repeat_interleave_real=False): cos/sin each [L, rot_dim] with the
+    rot_dim//2 frequencies tiled twice (half-split convention)."""
+    rot = cfg.rot_dim
+    freqs = 1.0 / (10000.0 ** (np.arange(0, rot, 2, dtype=np.float64)
+                               / rot))
+    ang = np.arange(length, dtype=np.float64)[:, None] * freqs[None, :]
+    cos = np.concatenate([np.cos(ang), np.cos(ang)], axis=-1)
+    sin = np.concatenate([np.sin(ang), np.sin(ang)], axis=-1)
+    return (jnp.asarray(cos, jnp.float32), jnp.asarray(sin, jnp.float32))
+
+
+def _apply_rope(x, rope):
+    """Rotate the first rot_dim dims of each head; pass the rest
+    through (reference apply_rotary_emb_stable_audio)."""
+    cos, sin = rope
+    rot = cos.shape[-1]
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    xf = x_rot.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = xf * cos[None, :, None, :] + rotated * sin[None, :, None, :]
+    return jnp.concatenate([out.astype(x.dtype), x_pass], axis=-1)
+
+
+def _attn(q, k, v, mask=None):
+    """q [B,S,H,D], k/v [B,T,H,D] -> [B,S,H*D] (fp32 softmax)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = s + jnp.where(mask[:, None, None, :], 0.0, -1e30)
+    a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhst,bthd->bshd", a, v)
+    return o.reshape(o.shape[0], o.shape[1], -1)
+
+
+def forward(params, cfg: StableAudioCkptConfig, latents, timesteps, ctx,
+            global_states, ctx_mask=None):
+    """latents [B, L, C], timesteps [B], ctx [B, S, ctx_in],
+    global_states [B, global_in] -> velocity [B, L, C].
+
+    Mirrors the reference forward (stable_audio_transformer.py:489-566):
+    project conditioning, prepend the duration+time token, run the
+    blocks, drop the token, residual 1x1 convs around the stack."""
+    h, hk, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    cross = nn.linear(params["cfc2"],
+                      jax.nn.silu(nn.linear(params["cfc1"], ctx)))
+    glob = nn.linear(params["gfc2"], jax.nn.silu(
+        nn.linear(params["gfc1"], global_states)))[:, None, :]
+    # Gaussian Fourier features, cos first (flip_sin_to_cos)
+    ang = (2.0 * jnp.pi) * timesteps.astype(jnp.float32)[:, None] \
+        * params["time_fourier"].astype(jnp.float32)[None, :]
+    four = jnp.concatenate([jnp.cos(ang), jnp.sin(ang)],
+                           axis=-1).astype(latents.dtype)
+    temb = nn.linear(params["tfc2"],
+                     jax.nn.silu(nn.linear(params["tfc1"], four)))
+    glob = glob + temb[:, None, :]
+
+    x = nn.linear(params["pre_conv"], latents) + latents
+    x = nn.linear(params["proj_in"], x)
+    x = jnp.concatenate([glob.astype(x.dtype), x], axis=1)
+    b, n = x.shape[0], x.shape[1]
+    rope = rope_1d(cfg, n)
+
+    for blk in params["blocks"]:
+        r = x
+        y = nn.layernorm(blk["norm1"], x)
+        q = nn.linear(blk["q1"], y).reshape(b, n, h, d)
+        k = nn.linear(blk["k1"], y).reshape(b, n, h, d)
+        v = nn.linear(blk["v1"], y).reshape(b, n, h, d)
+        q, k = _apply_rope(q, rope), _apply_rope(k, rope)
+        x = r + nn.linear(blk["o1"], _attn(q, k, v))
+
+        r = x
+        y = nn.layernorm(blk["norm2"], x)
+        s = cross.shape[1]
+        q = nn.linear(blk["q2"], y).reshape(b, n, h, d)
+        k = nn.linear(blk["k2"], cross).reshape(b, s, hk, d)
+        v = nn.linear(blk["v2"], cross).reshape(b, s, hk, d)
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        x = r + nn.linear(blk["o2"], _attn(q, k, v, mask=ctx_mask))
+
+        r = x
+        y = nn.layernorm(blk["norm3"], x)
+        val, gate = jnp.split(nn.linear(blk["ff_proj"], y), 2, axis=-1)
+        x = r + nn.linear(blk["ff_out"], val * jax.nn.silu(gate))
+
+    x = nn.linear(params["proj_out"], x)[:, 1:]
+    return nn.linear(params["post_conv"], x) + x
+
+
+# ------------------------------------------------------- checkpoint load
+def load_stable_audio_dit(model_dir: str,
+                          cfg: StableAudioCkptConfig = None,
+                          dtype=jnp.bfloat16):
+    """Stream transformer/ at the diffusers names (reference
+    load_weights name_mapping, stable_audio_transformer.py:570-600)."""
+    import json
+    import os
+
+    from vllm_omni_tpu.models.flux.loader import load_routed
+
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = StableAudioCkptConfig.from_hf(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+
+    r: dict[str, tuple] = {"time_proj.weight": ("raw", ("time_fourier",))}
+
+    def lin(hf, *path, bias=True):
+        r[f"{hf}.weight"] = ("direct", path + ("w",))
+        if bias:
+            r[f"{hf}.bias"] = ("direct", path + ("b",))
+
+    lin("timestep_proj.linear_1", "tfc1")
+    lin("timestep_proj.linear_2", "tfc2")
+    lin("global_proj.linear_1", "gfc1", bias=False)
+    lin("global_proj.linear_2", "gfc2", bias=False)
+    lin("cross_attention_proj.0", "cfc1", bias=False)
+    lin("cross_attention_proj.2", "cfc2", bias=False)
+    lin("proj_in", "proj_in", bias=False)
+    lin("proj_out", "proj_out", bias=False)
+    for nm, tgt in (("preprocess_conv", "pre_conv"),
+                    ("postprocess_conv", "post_conv")):
+        r[f"{nm}.weight"] = ("raw", (tgt, "w"))
+    for i in range(cfg.num_layers):
+        b, t = f"transformer_blocks.{i}", ("blocks", i)
+        for nm in ("norm1", "norm2", "norm3"):
+            r[f"{b}.{nm}.weight"] = ("direct", t + (nm, "w"))
+            r[f"{b}.{nm}.bias"] = ("direct", t + (nm, "b"))
+        for a, (qn, kn, vn, on) in (("attn1", ("q1", "k1", "v1", "o1")),
+                                    ("attn2", ("q2", "k2", "v2", "o2"))):
+            lin(f"{b}.{a}.to_q", *t, qn, bias=False)
+            lin(f"{b}.{a}.to_k", *t, kn, bias=False)
+            lin(f"{b}.{a}.to_v", *t, vn, bias=False)
+            lin(f"{b}.{a}.to_out.0", *t, on, bias=False)
+        lin(f"{b}.ff.net.0.proj", *t, "ff_proj")
+        lin(f"{b}.ff.net.2", *t, "ff_out")
+
+    def conv1x1(arr):
+        # torch Conv1d [out, in, 1] -> [in, out] matmul
+        return np.ascontiguousarray(arr[..., 0].T)
+
+    transforms = {"preprocess_conv.weight": conv1x1,
+                  "postprocess_conv.weight": conv1x1}
+    return load_routed(model_dir, r, shapes, dtype,
+                       transforms=transforms), cfg

@@ -1,0 +1,248 @@
+"""AutoencoderOobleck decoder (StableAudio Open audio VAE) in JAX.
+
+Checkpoint-schema twin of the diffusers ``AutoencoderOobleck`` decoder
+the reference pipeline decodes through (pipeline_stable_audio.py:
+174-181, 555-560): Snake1d activations (log-scale alpha/beta), dilated
+residual units, strided transposed-conv upsampling, all convolutions
+weight-normalized in the checkpoint (folded to plain kernels at load).
+
+TPU-first: NWC layout, weight-norm folded on the host so the device
+kernels are ordinary convs XLA can fuse.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.models.common.vocoder import snake, snake_init
+
+
+@dataclass(frozen=True)
+class OobleckConfig:
+    audio_channels: int = 2
+    decoder_channels: int = 128
+    decoder_input_channels: int = 64
+    channel_multiples: tuple = (1, 2, 4, 8, 16)
+    downsampling_ratios: tuple = (2, 4, 4, 8, 8)
+    sampling_rate: int = 44100
+
+    @property
+    def upsampling_ratios(self) -> tuple:
+        return tuple(reversed(self.downsampling_ratios))
+
+    @property
+    def hop_length(self) -> int:
+        out = 1
+        for rr in self.downsampling_ratios:
+            out *= rr
+        return out
+
+    @staticmethod
+    def tiny() -> "OobleckConfig":
+        return OobleckConfig(audio_channels=1, decoder_channels=8,
+                             decoder_input_channels=4,
+                             channel_multiples=(1, 2),
+                             downsampling_ratios=(2, 4),
+                             sampling_rate=16000)
+
+    @staticmethod
+    def from_hf(d: dict) -> "OobleckConfig":
+        return OobleckConfig(
+            audio_channels=d.get("audio_channels", 2),
+            decoder_channels=d.get("decoder_channels", 128),
+            decoder_input_channels=d.get("decoder_input_channels", 64),
+            channel_multiples=tuple(d.get("channel_multiples",
+                                          (1, 2, 4, 8, 16))),
+            downsampling_ratios=tuple(d.get("downsampling_ratios",
+                                            (2, 4, 4, 8, 8))),
+            sampling_rate=d.get("sampling_rate", 44100),
+        )
+
+
+def _dims(cfg: OobleckConfig):
+    """Per-upsample-block (input_dim, output_dim, stride) following the
+    diffusers OobleckDecoder: multiples [1] + channel_multiples, block i
+    maps channels*mult[n-i] -> channels*mult[n-i-1]."""
+    mult = (1,) + tuple(cfg.channel_multiples)
+    n = len(cfg.upsampling_ratios)
+    ch = cfg.decoder_channels
+    return [(ch * mult[n - i], ch * mult[n - i - 1], s)
+            for i, s in enumerate(cfg.upsampling_ratios)]
+
+
+def init_decoder(key, cfg: OobleckConfig, dtype=jnp.float32):
+    ks = iter(jax.random.split(key, 4 + 16 * len(cfg.upsampling_ratios)))
+    dims = _dims(cfg)
+
+    def res_unit(dim):
+        return {"snake1": snake_init(dim, dtype),
+                "conv1": nn.conv1d_init(next(ks), dim, dim, 7,
+                                        dtype=dtype),
+                "snake2": snake_init(dim, dtype),
+                "conv2": nn.conv1d_init(next(ks), dim, dim, 1,
+                                        dtype=dtype)}
+
+    p = {"conv1": nn.conv1d_init(next(ks), cfg.decoder_input_channels,
+                                 dims[0][0], 7, dtype=dtype),
+         "blocks": [],
+         "snake_out": snake_init(cfg.decoder_channels, dtype),
+         "conv_out": nn.conv1d_init(next(ks), cfg.decoder_channels,
+                                    cfg.audio_channels, 7, bias=False,
+                                    dtype=dtype)}
+    for cin, cout, s in dims:
+        p["blocks"].append({
+            "snake1": snake_init(cin, dtype),
+            # torch ConvTranspose1d [in, out, k] -> [k, out, in] (the
+            # transpose_kernel=True forward layout, as code2wav)
+            "tconv": {"w": jnp.zeros((2 * s, cout, cin), dtype),
+                      "b": jnp.zeros((cout,), dtype)},
+            "res1": res_unit(cout),
+            "res2": res_unit(cout),
+            "res3": res_unit(cout),
+        })
+    return p
+
+
+def _res_unit(p, x, dilation: int):
+    h = snake(p["snake1"], x)
+    h = nn.conv1d(p["conv1"], h, padding=[(3 * dilation, 3 * dilation)],
+                  dilation=dilation)
+    h = snake(p["snake2"], h)
+    return x + nn.conv1d(p["conv2"], h, padding=[(0, 0)])
+
+
+def _tconv(p, x, stride: int):
+    """torch ConvTranspose1d(k=2*stride, stride, padding=ceil(s/2)):
+    VALID transpose then symmetric trim."""
+    y = jax.lax.conv_transpose(
+        x, p["w"].astype(x.dtype), strides=(stride,), padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"), transpose_kernel=True)
+    pad = -(-stride // 2)
+    y = y[:, pad: y.shape[1] - pad]
+    return y + p["b"].astype(x.dtype)
+
+
+def decode(p, cfg: OobleckConfig, z):
+    """z [B, T, decoder_input_channels] -> waveform
+    [B, T*hop, audio_channels] (NWC)."""
+    x = nn.conv1d(p["conv1"], z, padding=[(3, 3)])
+    for bp, (_, _, s) in zip(p["blocks"], _dims(cfg)):
+        x = snake(bp["snake1"], x)
+        x = _tconv(bp["tconv"], x, s)
+        x = _res_unit(bp["res1"], x, 1)
+        x = _res_unit(bp["res2"], x, 3)
+        x = _res_unit(bp["res3"], x, 9)
+    x = snake(p["snake_out"], x)
+    return nn.conv1d(p["conv_out"], x, padding=[(3, 3)])
+
+
+# ------------------------------------------------------- checkpoint load
+def load_oobleck_decoder(model_dir: str, cfg: OobleckConfig = None,
+                         dtype=jnp.float32):
+    """Stream the weight-normalized decoder out of vae/ — each conv's
+    ``weight_g``/``weight_v`` pair (or ``parametrizations.weight.
+    original0/1``) folds to w = g * v / ||v|| on the host."""
+    import json
+    import os
+
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        iter_safetensors,
+    )
+
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = OobleckConfig.from_hf(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_decoder(jax.random.PRNGKey(0), cfg, jnp.float32))
+
+    # hf conv name -> (tree path, kind); kind drives the layout fold
+    routes: dict[str, tuple] = {}
+
+    def conv(hf, *path, kind="conv"):
+        routes[hf] = (path, kind)
+
+    def res_unit(hf, *path):
+        for t, ours in (("snake1.alpha", ("snake1", "alpha")),
+                        ("snake1.beta", ("snake1", "beta")),
+                        ("snake2.alpha", ("snake2", "alpha")),
+                        ("snake2.beta", ("snake2", "beta"))):
+            routes[f"{hf}.{t}"] = (path + ours, "snake")
+        conv(f"{hf}.conv1", *path, "conv1")
+        conv(f"{hf}.conv2", *path, "conv2")
+
+    conv("decoder.conv1", "conv1")
+    for i in range(len(cfg.upsampling_ratios)):
+        b, t = f"decoder.block.{i}", ("blocks", i)
+        routes[f"{b}.snake1.alpha"] = (t + ("snake1", "alpha"), "snake")
+        routes[f"{b}.snake1.beta"] = (t + ("snake1", "beta"), "snake")
+        conv(f"{b}.conv_t1", *t, "tconv", kind="tconv")
+        for j in (1, 2, 3):
+            res_unit(f"{b}.res_unit{j}", *t, f"res{j}")
+    routes["decoder.snake1.alpha"] = (("snake_out", "alpha"), "snake")
+    routes["decoder.snake1.beta"] = (("snake_out", "beta"), "snake")
+    conv("decoder.conv2", "conv_out")
+
+    # expand to tensor-level names: weight-norm pairs + biases
+    want: dict[str, tuple] = {}
+    for hf, (path, kind) in routes.items():
+        if kind == "snake":
+            want[hf] = (path, "snake", None)
+            continue
+        for suf, part in (("weight_g", "g"), ("weight_v", "v"),
+                          ("parametrizations.weight.original0", "g"),
+                          ("parametrizations.weight.original1", "v"),
+                          ("weight", "w"), ("bias", "b")):
+            want[f"{hf}.{suf}"] = (path, kind, part)
+
+    tree = jax.tree.map(lambda _: None, shapes,
+                        is_leaf=lambda x: not isinstance(x, (dict, list)))
+
+    def node(path):
+        t = tree
+        for k in path[:-1]:
+            t = t[k]
+        return t
+
+    pending: dict[tuple, dict] = {}
+    for name, arr in iter_safetensors(model_dir,
+                                      name_filter=lambda nm: nm in want):
+        path, kind, part = want[name]
+        if kind == "snake":
+            node(path)[path[-1]] = jnp.asarray(arr.reshape(-1), dtype)
+            continue
+        if part == "b":
+            node(path + ("b",))["b"] = jnp.asarray(arr, dtype)
+            continue
+        if part == "w":
+            w = arr
+        else:
+            slot = pending.setdefault(path, {})
+            slot[part] = arr
+            if len(slot) < 2:
+                continue
+            v, g = slot.pop("v"), slot.pop("g")
+            del pending[path]
+            # torch weight_norm dim=0: per-out-channel direction
+            norm = np.sqrt((v.astype(np.float64) ** 2)
+                           .sum(axis=tuple(range(1, v.ndim)),
+                                keepdims=True))
+            w = (g.astype(np.float64) * v.astype(np.float64)
+                 / norm).astype(np.float32)
+        # Conv1d [out, in, k] -> WIO [k, in, out]; ConvTranspose1d
+        # [in, out, k] -> [k, out, in] (transpose_kernel layout) — both
+        # are transpose(2, 1, 0)
+        w = np.ascontiguousarray(w.transpose(2, 1, 0))
+        node(path + ("w",))["w"] = jnp.asarray(w, dtype)
+
+    missing = [jax.tree_util.keystr(kp) for kp, leaf
+               in jax.tree_util.tree_leaves_with_path(
+                   tree, is_leaf=lambda x: x is None) if leaf is None]
+    if missing:
+        raise ValueError(f"{model_dir}: oobleck decoder missing "
+                         f"{len(missing)} leaves (e.g. {missing[:3]})")
+    return tree, cfg
