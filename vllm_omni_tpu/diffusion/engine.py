@@ -62,17 +62,23 @@ def resolve_arch(config: OmniDiffusionConfig, declared=_UNSET) -> str:
 
 def _declared_arch(model: str):
     """Registry architecture declared by a local dir's config.json
-    (single-repo HF layout, no model_index.json), or None."""
+    (single-repo HF layout, no model_index.json), or None.  Mirrors the
+    reference routing (omni_diffusion.py:78-83): any listed
+    architecture the registry knows, plus model_type == "bagel"."""
     p = os.path.join(model, "config.json")
     if not os.path.isfile(p):
         return None
     try:
         with open(p) as f:
-            archs = json.load(f).get("architectures") or []
+            cfg = json.load(f)
     except Exception:
         return None
-    if archs and archs[0] in DiffusionModelRegistry.supported():
-        return archs[0]
+    supported = DiffusionModelRegistry.supported()
+    for arch in cfg.get("architectures") or []:
+        if arch in supported:
+            return arch
+    if cfg.get("model_type") == "bagel":
+        return "BagelPipeline"
     return None
 
 

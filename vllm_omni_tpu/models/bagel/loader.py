@@ -1,0 +1,277 @@
+"""BAGEL single-repo checkpoint loaders.
+
+The published repo is non-diffusers: ``config.json`` (bagel core knobs +
+vae/vit sub-dicts), ``llm_config.json`` (Qwen2 MoT fields, qk_norm
+forced on — reference pipeline_bagel.py:183-190), ``vit_config.json``
+(SigLIP), ``ema.safetensors`` (LLM + bagel heads + vit tower) and
+``ae.safetensors`` (FLUX AutoencoderKL at the original BFL module names
+— reference autoencoder.py Decoder/Encoder, NOT the diffusers
+up_blocks naming).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+_LM_PREFIX = "language_model.model."
+
+
+def config_from_bagel(model_dir: str):
+    """(BagelConfig, SigLIPConfig | None, VAEConfig, max_text_len hint)
+    from config.json + llm_config.json + vit_config.json."""
+    from vllm_omni_tpu.models.bagel.pipeline import BagelConfig
+    from vllm_omni_tpu.models.common.siglip import SigLIPConfig
+    from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        bagel = json.load(f)
+    with open(os.path.join(model_dir, "llm_config.json")) as f:
+        llm = json.load(f)
+    heads = llm["num_attention_heads"]
+    llm_cfg = BagelConfig(
+        vocab_size=llm["vocab_size"],
+        hidden_size=llm["hidden_size"],
+        num_layers=llm["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=llm.get("num_key_value_heads", heads),
+        head_dim=llm["hidden_size"] // heads,
+        intermediate_size=llm.get("intermediate_size", 18944),
+        rope_theta=llm.get("rope_theta", 1e6),
+        rms_eps=llm.get("rms_norm_eps", 1e-6),
+        # the reference forces QK-norm on for MoT (pipeline_bagel:185)
+        qk_norm=True,
+        latent_channels=int(
+            (bagel.get("vae_config") or {}).get("z_channels", 16)),
+        patch=int(bagel.get("latent_patch_size", 2)),
+        max_latent_size=int(bagel.get("max_latent_size", 32)),
+        timestep_shift=float(bagel.get("timestep_shift", 1.0)),
+    )
+    vit_cfg = None
+    vit_path = os.path.join(model_dir, "vit_config.json")
+    if os.path.isfile(vit_path):
+        with open(vit_path) as f:
+            vit_hf = json.load(f)
+        vit_cfg = SigLIPConfig.from_hf(vit_hf)
+    vd = bagel.get("vae_config") or {}
+    # flux AE defaults (default_ae_params, :107-120); the extra keys
+    # exist so tiny test checkpoints can shrink the autoencoder
+    vae_cfg = VAEConfig(
+        latent_channels=int(vd.get("z_channels", 16)),
+        base_channels=int(vd.get("base_channels", 128)),
+        channel_multipliers=tuple(vd.get("channel_multipliers",
+                                         (1, 2, 4, 4))),
+        layers_per_block=int(vd.get("layers_per_block", 2)),
+        scaling_factor=float(vd.get("scale_factor", 0.3611)),
+        shift_factor=float(vd.get("shift_factor", 0.1159)),
+    )
+    return llm_cfg, vit_cfg, vae_cfg, bagel
+
+
+def load_bagel_lm(model_dir: str, pcfg, dtype=jnp.bfloat16):
+    """The MoT LLM + bagel heads out of ema.safetensors: per-layer und
+    (plain names) and gen (``_moe_gen``) experts, QK norms, the
+    time/vae2llm/llm2vae heads and the frozen latent pos table.  The
+    gen head norm (``norm_moe_gen``) lands in ``final_norm`` — the
+    velocity head normalizes only VAE tokens (Qwen2MoTModel.forward
+    gen branch)."""
+    from vllm_omni_tpu.models.bagel.pipeline import init_params
+    from vllm_omni_tpu.models.flux.loader import load_routed
+
+    cfg = pcfg.llm
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), pcfg, jnp.float32))
+    # the pipeline tree also carries vit trees when pcfg.vit is set;
+    # init_params only builds the LLM side, which is what we cover here
+    r: dict[str, tuple] = {
+        f"{_LM_PREFIX}embed_tokens.weight": ("raw", ("embed", "w")),
+        f"{_LM_PREFIX}norm_moe_gen.weight":
+            ("direct", ("final_norm", "w")),
+        "time_embedder.mlp.0.weight": ("direct", ("time_in1", "w")),
+        "time_embedder.mlp.0.bias": ("direct", ("time_in1", "b")),
+        "time_embedder.mlp.2.weight": ("direct", ("time_in2", "w")),
+        "time_embedder.mlp.2.bias": ("direct", ("time_in2", "b")),
+        "vae2llm.weight": ("direct", ("vae2llm", "w")),
+        "vae2llm.bias": ("direct", ("vae2llm", "b")),
+        "llm2vae.weight": ("direct", ("llm2vae", "w")),
+        "llm2vae.bias": ("direct", ("llm2vae", "b")),
+        "latent_pos_embed.pos_embed": ("raw", ("pos_embed",)),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{_LM_PREFIX}layers.{i}"
+        for ours, sfx in (("und", ""), ("gen", "_moe_gen")):
+            t = ("layers", i, ours)
+            for nm in ("q_proj", "k_proj", "v_proj"):
+                r[f"{lp}.self_attn.{nm}{sfx}.weight"] = \
+                    ("direct", t + (nm, "w"))
+                r[f"{lp}.self_attn.{nm}{sfx}.bias"] = \
+                    ("direct", t + (nm, "b"))
+            r[f"{lp}.self_attn.o_proj{sfx}.weight"] = \
+                ("direct", t + ("o_proj", "w"))
+            if cfg.qk_norm:
+                r[f"{lp}.self_attn.q_norm{sfx}.weight"] = \
+                    ("direct", t + ("q_norm", "w"))
+                r[f"{lp}.self_attn.k_norm{sfx}.weight"] = \
+                    ("direct", t + ("k_norm", "w"))
+            mlp = f"{lp}.mlp{sfx}" if sfx else f"{lp}.mlp"
+            r[f"{mlp}.gate_proj.weight"] = \
+                ("fuse", t + ("gate_up", "w"), 0, 2)
+            r[f"{mlp}.up_proj.weight"] = \
+                ("fuse", t + ("gate_up", "w"), 1, 2)
+            r[f"{mlp}.down_proj.weight"] = ("direct", t + ("down", "w"))
+            r[f"{lp}.input_layernorm{sfx}.weight"] = \
+                ("direct", t + ("input_norm", "w"))
+            r[f"{lp}.post_attention_layernorm{sfx}.weight"] = \
+                ("direct", t + ("post_norm", "w"))
+    return load_routed(model_dir, r, shapes, dtype)
+
+
+def load_bagel_vit(model_dir: str, pcfg, dtype=jnp.bfloat16):
+    """SigLIP tower (``vit_model.vision_model.*``) + MLPconnector +
+    learned vit position table out of ema.safetensors."""
+    from vllm_omni_tpu.models.common import siglip
+    from vllm_omni_tpu.models.flux.loader import load_routed
+    from vllm_omni_tpu.models.common import nn
+
+    vit_params, _ = siglip.load_siglip(model_dir, cfg=pcfg.vit,
+                                       dtype=dtype)
+    h = pcfg.llm.hidden_size
+    shapes = jax.eval_shape(lambda: {
+        "fc1": nn.linear_init(jax.random.PRNGKey(0),
+                              pcfg.vit.hidden_size, h,
+                              dtype=jnp.float32),
+        "fc2": nn.linear_init(jax.random.PRNGKey(0), h, h,
+                              dtype=jnp.float32),
+        "pos": jnp.zeros((pcfg.vit_max_patch_per_side ** 2, h),
+                         jnp.float32),
+    })
+    r = {
+        "connector.fc1.weight": ("direct", ("fc1", "w")),
+        "connector.fc1.bias": ("direct", ("fc1", "b")),
+        "connector.fc2.weight": ("direct", ("fc2", "w")),
+        "connector.fc2.bias": ("direct", ("fc2", "b")),
+        "vit_pos_embed.pos_embed": ("raw", ("pos",)),
+    }
+    extra = load_routed(model_dir, r, shapes, dtype)
+    return vit_params, extra
+
+
+def _bfl_vae_routing(cfg, half: str):
+    """BFL AutoEncoder names (reference bagel/autoencoder.py) -> the
+    qwen_image.vae tree paths, with the decoder's ``up`` ModuleList in
+    BFL's REVERSED index order (Decoder builds via ``up.insert(0, ...)``
+    so up.{n-1} runs first)."""
+    flat: dict[str, tuple] = {}
+    attn_names: set = set()
+
+    def wb(hf, *path):
+        flat[f"{hf}.weight"] = path + ("w",)
+        flat[f"{hf}.bias"] = path + ("b",)
+
+    def resnet(hf, tgt, cin, cout):
+        wb(f"{hf}.norm1", *tgt, "norm1")
+        wb(f"{hf}.conv1", *tgt, "conv1")
+        wb(f"{hf}.norm2", *tgt, "norm2")
+        wb(f"{hf}.conv2", *tgt, "conv2")
+        if cin != cout:
+            wb(f"{hf}.nin_shortcut", *tgt, "skip")
+
+    def attn(hf, tgt):
+        wb(f"{hf}.norm", *tgt, "norm")
+        for bfl, ours in (("q", "q"), ("k", "k"), ("v", "v"),
+                          ("proj_out", "o")):
+            wb(f"{hf}.{bfl}", *tgt, ours)
+            attn_names.add(f"{hf}.{bfl}.weight")
+
+    chans = [cfg.base_channels * x for x in cfg.channel_multipliers]
+    n = len(chans)
+    if half == "decoder":
+        top = chans[-1]
+        wb("decoder.conv_in", "conv_in")
+        resnet("decoder.mid.block_1", ("mid_res1",), top, top)
+        attn("decoder.mid.attn_1", ("mid_attn",))
+        resnet("decoder.mid.block_2", ("mid_res2",), top, top)
+        cur = top
+        for i, ch in enumerate(reversed(chans)):
+            bfl = f"decoder.up.{n - 1 - i}"
+            for j in range(cfg.layers_per_block + 1):
+                resnet(f"{bfl}.block.{j}", ("ups", i, "res", j), cur, ch)
+                cur = ch
+            if i < n - 1:
+                wb(f"{bfl}.upsample.conv", "ups", i, "up_conv")
+        wb("decoder.norm_out", "norm_out")
+        wb("decoder.conv_out", "conv_out")
+    else:
+        wb("encoder.conv_in", "conv_in")
+        cur = chans[0]
+        for i, ch in enumerate(chans):
+            bfl = f"encoder.down.{i}"
+            for j in range(cfg.layers_per_block):
+                resnet(f"{bfl}.block.{j}", ("downs", i, "res", j),
+                       cur, ch)
+                cur = ch
+            if i < n - 1:
+                wb(f"{bfl}.downsample.conv", "downs", i, "down_conv")
+        resnet("encoder.mid.block_1", ("mid_res1",), cur, cur)
+        attn("encoder.mid.attn_1", ("mid_attn",))
+        resnet("encoder.mid.block_2", ("mid_res2",), cur, cur)
+        wb("encoder.norm_out", "norm_out")
+        wb("encoder.conv_out", "conv_out")
+    return flat, attn_names
+
+
+def load_bagel_vae(ae_path: str, cfg=None, dtype=jnp.float32,
+                   encoder: bool = False, decoder: bool = True):
+    """ae.safetensors (BFL FLUX AutoencoderKL, bare encoder./decoder.
+    names) -> {"decoder"?, "encoder"?} qwen_image.vae trees."""
+    from vllm_omni_tpu.model_loader.safetensors_loader import (
+        load_checkpoint_tree,
+    )
+    from vllm_omni_tpu.models.qwen_image import vae as iv
+    from vllm_omni_tpu.models.qwen_image.vae import VAEConfig
+
+    if cfg is None:
+        cfg = VAEConfig()
+    out = {}
+    halves = ([("decoder", iv.init_decoder)] if decoder else []) + \
+        ([("encoder", iv.init_encoder)] if encoder else [])
+    for half, init in halves:
+        flat, attn_names = _bfl_vae_routing(cfg, half)
+        shapes = jax.eval_shape(
+            lambda init=init: init(jax.random.PRNGKey(0), cfg,
+                                   jnp.float32))
+        tree = jax.tree.map(lambda t: np.zeros(t.shape, np.float32),
+                            shapes)
+
+        def transform(name, arr, attn_names=attn_names):
+            if name in attn_names:
+                # BFL attention q/k/v/proj_out are 1x1 Conv2d
+                # [O, I, 1, 1] -> linear [I, O]
+                return np.ascontiguousarray(
+                    arr.reshape(arr.shape[0], arr.shape[1]).T)
+            if arr.ndim == 4:
+                return arr.transpose(2, 3, 1, 0)   # NHWC
+            if arr.ndim == 2:
+                return arr.T
+            return arr
+
+        nloaded, _ = load_checkpoint_tree(
+            ae_path, flat.get, tree, dtype=np.float32,
+            transform=transform,
+            name_filter=lambda nm, flat=flat: nm in flat,
+        )
+        n_leaves = len(jax.tree.leaves(tree))
+        if nloaded < n_leaves:
+            raise ValueError(
+                f"{ae_path} covered {nloaded}/{n_leaves} {half} VAE "
+                "weights")
+        out[half] = jax.tree.map(lambda a: jnp.asarray(a, dtype), tree)
+    return out, cfg

@@ -68,6 +68,9 @@ class BagelConfig:
     patch: int = 2              # latent 2x2 packing (latent_downsample)
     max_latent_size: int = 64
     timestep_shift: float = 3.0
+    # per-head RMS QK-norm (the published MoT checkpoint has it;
+    # reference forces qk_norm=True, pipeline_bagel.py:185)
+    qk_norm: bool = False
 
     @property
     def latent_dim(self) -> int:
@@ -119,7 +122,11 @@ def _expert_init(key, cfg: BagelConfig, dtype):
     k = jax.random.split(key, 7)
     h, q = cfg.hidden_size, cfg.num_heads * cfg.head_dim
     kv = cfg.num_kv_heads * cfg.head_dim
+    extra = ({"q_norm": nn.rmsnorm_init(cfg.head_dim, dtype),
+              "k_norm": nn.rmsnorm_init(cfg.head_dim, dtype)}
+             if cfg.qk_norm else {})
     return {
+        **extra,
         "input_norm": nn.rmsnorm_init(h, dtype),
         "q_proj": nn.linear_init(k[0], h, q, dtype=dtype),
         "k_proj": nn.linear_init(k[1], h, kv, dtype=dtype),
@@ -169,6 +176,9 @@ def _qkv(exp, cfg: BagelConfig, x, cos, sin):
     q = nn.linear(exp["q_proj"], flat).reshape(b * s, -1, cfg.head_dim)
     k = nn.linear(exp["k_proj"], flat).reshape(b * s, -1, cfg.head_dim)
     v = nn.linear(exp["v_proj"], flat).reshape(b * s, -1, cfg.head_dim)
+    if "q_norm" in exp:
+        q = rms_norm(q, exp["q_norm"]["w"], cfg.rms_eps)
+        k = rms_norm(k, exp["k_norm"]["w"], cfg.rms_eps)
     q = apply_rope(q, cos, sin).reshape(b, s, -1, cfg.head_dim)
     k = apply_rope(k, cos, sin).reshape(b, s, -1, cfg.head_dim)
     return q, k, v.reshape(b, s, -1, cfg.head_dim)
@@ -285,7 +295,7 @@ def flow_velocity(params, cfg: BagelConfig, x_t: jax.Array,
     _forward_flow: vae2llm + time + pos embed, gen-expert layers
     attending [cached context ; latents], llm2vae head)."""
     b, s_lat, _ = x_t.shape
-    temb = nn.timestep_embedding(t * 1000.0, 256)
+    temb = nn.timestep_embedding(t, 256)
     temb = nn.linear(params["time_in2"], jax.nn.silu(
         nn.linear(params["time_in1"], temb.astype(x_t.dtype))))
     pos2d = params["pos_embed"][
@@ -322,9 +332,13 @@ class BagelPipeline:
 
     output_type = "image"
     needs_image_cond = False  # image conditioning is optional
+    # vit trees live outside the default engine list
+    param_attrs = ("dit_params", "vae_params", "vae_encoder_params",
+                   "vit_params", "vit_connector")
 
     def __init__(self, config: BagelPipelineConfig, dtype=jnp.bfloat16,
-                 seed: int = 0, mesh=None, cache_config=None):
+                 seed: int = 0, mesh=None, cache_config=None,
+                 init_weights: bool = True):
         from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
         self.cfg = config
@@ -344,9 +358,16 @@ class BagelPipeline:
         # with a different stack override _build_llm_params (a second
         # full init after super().__init__ would transiently double the
         # weight memory).
-        self.dit_params = self._build_llm_params(k1, config, dtype)
-        self.vae_params = self.wiring.place(
-            vae_mod.init_decoder(k2, config.vae, dtype))
+        if init_weights:
+            self.dit_params = self._build_llm_params(k1, config, dtype)
+            self.vae_params = self.wiring.place(
+                vae_mod.init_decoder(k2, config.vae, dtype))
+        else:
+            # from_pretrained fills every tree — a random 7B MoT first
+            # would double peak host memory
+            self.dit_params = None
+            self.vae_params = None
+        self.hf_tokenizer = None
         self._seed = seed
         self._denoise_cache: dict = {}
         self.vae_encoder_params = None  # built on demand (image intake)
@@ -365,19 +386,21 @@ class BagelPipeline:
                 vit_tokens=vit))
         # SigLIP understanding tower (optional)
         self.vit_params = None
+        self.vit_connector = None
         if config.vit is not None:
             from vllm_omni_tpu.models.common import siglip
 
             kv1, kv2, kv3 = jax.random.split(
                 jax.random.fold_in(k3, 7), 3)
             h = config.llm.hidden_size
-            self.vit_params = self.wiring.place(
-                siglip.init_params(kv1, config.vit, dtype))
-            self.vit_connector = self.wiring.place({
-                "fc1": nn.linear_init(kv2, config.vit.hidden_size, h,
-                                      dtype=dtype),
-                "fc2": nn.linear_init(kv3, h, h, dtype=dtype),
-            })
+            if init_weights:
+                self.vit_params = self.wiring.place(
+                    siglip.init_params(kv1, config.vit, dtype))
+                self.vit_connector = self.wiring.place({
+                    "fc1": nn.linear_init(kv2, config.vit.hidden_size,
+                                          h, dtype=dtype),
+                    "fc2": nn.linear_init(kv3, h, h, dtype=dtype),
+                })
             # frozen 2D sincos table at LLM width (PositionEmbedding)
             self.vit_pos_embed = jnp.asarray(siglip.sincos_2d_pos_embed(
                 h, config.vit_max_patch_per_side))
@@ -539,11 +562,84 @@ class BagelPipeline:
         return jnp.repeat(x[None], batch, axis=0)
 
     def _context_ids(self, prompts: list[str]):
+        if self.hf_tokenizer is not None:
+            # reference prepare_prompts wraps every prompt as
+            # [<|im_start|>] + text + [<|im_end|>] (add_special_tokens
+            # registers them, bagel_transformer.py:886)
+            tok = self.hf_tokenizer
+            bos = tok.convert_tokens_to_ids("<|im_start|>")
+            eos = tok.convert_tokens_to_ids("<|im_end|>")
+            unk = tok.unk_token_id
+            wrap = (bos is not None and bos != unk and bos >= 0
+                    and eos is not None and eos != unk and eos >= 0)
+            s_max = self.cfg.max_text_len
+            body = s_max - 2 if wrap else s_max
+            ids = np.zeros((len(prompts), s_max), np.int64)
+            mask = np.zeros((len(prompts), s_max), np.int32)
+            pad = tok.pad_token_id or 0
+            ids[:] = pad
+            for i, ptxt in enumerate(prompts):
+                t = tok(ptxt, add_special_tokens=False,
+                        truncation=True,
+                        max_length=body)["input_ids"]
+                if wrap:
+                    t = [bos] + list(t) + [eos]
+                ids[i, :len(t)] = t
+                mask[i, :len(t)] = 1
+            return jnp.asarray(ids), jnp.asarray(mask)
         ids, lens = self.tokenizer.batch_encode(prompts,
                                                 self.cfg.max_text_len)
         mask = (np.arange(self.cfg.max_text_len)[None, :]
                 < lens[:, None]).astype(np.int32)
         return jnp.asarray(ids), jnp.asarray(mask)
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        seed: int = 0, mesh=None, cache_config=None,
+                        max_text_len: int = 128) -> "BagelPipeline":
+        """Build from the published single-repo BAGEL checkpoint:
+        config.json + llm_config.json + vit_config.json describe the
+        stacks, ema.safetensors carries the MoT LLM + bagel heads +
+        SigLIP tower, ae.safetensors the FLUX autoencoder at the BFL
+        names (reference pipeline_bagel.py:159-258)."""
+        import os
+
+        from vllm_omni_tpu.models.bagel import loader as bloader
+
+        llm_cfg, vit_cfg, vae_cfg, bagel_hf = \
+            bloader.config_from_bagel(model_dir)
+        config = BagelPipelineConfig(
+            llm=llm_cfg, vae=vae_cfg, max_text_len=max_text_len,
+            vit=vit_cfg,
+            vit_max_patch_per_side=int(
+                bagel_hf.get("vit_max_num_patch_per_side", 70)))
+        pipe = cls(config, dtype=dtype, seed=seed, mesh=mesh,
+                   cache_config=cache_config, init_weights=False)
+        pipe.dit_params = pipe.wiring.place(
+            bloader.load_bagel_lm(model_dir, config, dtype=dtype))
+        if vit_cfg is not None:
+            vit_params, extra = bloader.load_bagel_vit(
+                model_dir, config, dtype=dtype)
+            pipe.vit_params = pipe.wiring.place(vit_params)
+            pipe.vit_connector = pipe.wiring.place(
+                {"fc1": extra["fc1"], "fc2": extra["fc2"]})
+            # the checkpoint's frozen sincos table replaces the
+            # locally built one (identical content, checkpoint wins)
+            pipe.vit_pos_embed = extra["pos"]
+        ae_path = os.path.join(model_dir, "ae.safetensors")
+        if not os.path.isfile(ae_path):
+            raise ValueError(f"{model_dir} has no ae.safetensors")
+        trees, _ = bloader.load_bagel_vae(
+            ae_path, cfg=vae_cfg, dtype=jnp.float32, encoder=True,
+            decoder=True)
+        pipe.vae_params = pipe.wiring.place(trees["decoder"])
+        pipe.vae_encoder_params = pipe.wiring.place(trees["encoder"])
+        from transformers import AutoTokenizer
+
+        # a byte-tokenizer fallback beside real weights would feed
+        # garbage conditioning — fail loudly instead
+        pipe.hf_tokenizer = AutoTokenizer.from_pretrained(model_dir)
+        return pipe
 
     def forward(self, req: OmniDiffusionRequest) -> list[DiffusionOutput]:
         sp = req.sampling_params

@@ -103,6 +103,11 @@ _DIFFUSION_MODELS: dict[str, _Entry] = {
     "BagelPipeline": _Entry(
         "vllm_omni_tpu.models.bagel.pipeline", "BagelPipeline"
     ),
+    # the published repo declares this arch in config.json (reference:
+    # omni_diffusion.py:79 routes it to BagelPipeline)
+    "BagelForConditionalGeneration": _Entry(
+        "vllm_omni_tpu.models.bagel.pipeline", "BagelPipeline"
+    ),
     # unified causal MM generator, shared single stack (reference:
     # hunyuan_image_3/pipeline_hunyuan_image_3.py:65)
     "HunyuanImage3ForCausalMM": _Entry(

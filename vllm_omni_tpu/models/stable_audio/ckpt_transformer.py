@@ -16,7 +16,6 @@ XLA program.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 
 import jax
@@ -148,15 +147,15 @@ def _apply_rope(x, rope):
 
 
 def _attn(q, k, v, mask=None):
-    """q [B,S,H,D], k/v [B,T,H,D] -> [B,S,H*D] (fp32 softmax)."""
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if mask is not None:
-        s = s + jnp.where(mask[:, None, None, :], 0.0, -1e30)
-    a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    o = jnp.einsum("bhst,bthd->bshd", a, v)
-    return o.reshape(o.shape[0], o.shape[1], -1)
+    """q [B,S,H,D], k/v [B,T,Hkv,D] -> [B,S,H*D] via the shared GQA
+    helper (fp32 softmax; KV heads repeat internally)."""
+    b, sq = q.shape[0], q.shape[1]
+    if mask is None:
+        bias = jnp.zeros((b, 1, 1, k.shape[1]), jnp.float32)
+    else:
+        bias = jnp.where(mask[:, None, None, :], 0.0, -1e30)
+    o = nn.bias_attention(q, k, v, bias)
+    return o.reshape(b, sq, -1)
 
 
 def forward(params, cfg: StableAudioCkptConfig, latents, timesteps, ctx,
@@ -203,9 +202,6 @@ def forward(params, cfg: StableAudioCkptConfig, latents, timesteps, ctx,
         q = nn.linear(blk["q2"], y).reshape(b, n, h, d)
         k = nn.linear(blk["k2"], cross).reshape(b, s, hk, d)
         v = nn.linear(blk["v2"], cross).reshape(b, s, hk, d)
-        rep = h // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
         x = r + nn.linear(blk["o2"], _attn(q, k, v, mask=ctx_mask))
 
         r = x

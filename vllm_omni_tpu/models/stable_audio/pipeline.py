@@ -288,6 +288,12 @@ class StableAudioPipeline:
         from transformers import AutoTokenizer
 
         pipe.hf_tokenizer = AutoTokenizer.from_pretrained(tok_dir)
+        # the reference pads to tokenizer.model_max_length
+        # (encode_prompt, pipeline_stable_audio.py:218-224); honor it
+        # when the tokenizer declares a sane value
+        ml = getattr(pipe.hf_tokenizer, "model_max_length", None)
+        if ml and 0 < int(ml) <= 4096:
+            pipe.ckpt_max_text_len = int(ml)
         return pipe
 
     # ------------------------------------------------- real-weight path
