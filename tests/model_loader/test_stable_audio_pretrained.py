@@ -10,9 +10,7 @@ diffusers import.
 
 import json
 import math
-import os
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
