@@ -290,3 +290,33 @@ def test_engine_builds_real_bagel(checkpoint):
         model=root, dtype="float32"), warmup=False)
     assert type(eng.pipeline).__name__ == "BagelPipeline"
     assert eng.pipeline.hf_tokenizer is not None
+
+
+def test_engine_sleep_wake_real_bagel(checkpoint):
+    """sleep() stashes the MoT + vit + both VAE halves; wake() restores
+    a bit-identical generation."""
+    from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+    from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+
+    root, _, _ = checkpoint
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model=root, dtype="float32"), warmup=False)
+    sp = OmniDiffusionSamplingParams(
+        height=16, width=16, num_inference_steps=2, guidance_scale=1.0,
+        seed=0)
+    req = OmniDiffusionRequest(prompt=["a door"], sampling_params=sp,
+                               request_ids=["r0"])
+    before = eng.pipeline.forward(req)[0].data
+    eng.sleep()
+    assert eng.pipeline.dit_params is None
+    assert eng.pipeline.vae_params is None
+    assert eng.pipeline.vit_params is None
+    assert eng.pipeline.vit_connector is None
+    assert eng.pipeline.vae_encoder_params is None
+    eng.wake()
+    after = eng.pipeline.forward(req)[0].data
+    np.testing.assert_array_equal(before, after)

@@ -426,3 +426,31 @@ def test_engine_builds_real_stable_audio(checkpoint):
     eng = DiffusionEngine(OmniDiffusionConfig(
         model=checkpoint, dtype="float32"), warmup=False)
     assert eng.pipeline.ckpt_dit_params is not None
+
+
+def test_engine_sleep_wake_real_stable_audio(checkpoint):
+    """sleep() must stash the ckpt trees (param_attrs contract) and
+    wake() must restore a working generation path."""
+    from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
+    from vllm_omni_tpu.diffusion.engine import DiffusionEngine
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+
+    eng = DiffusionEngine(OmniDiffusionConfig(
+        model=checkpoint, dtype="float32"), warmup=False)
+    sr = eng.pipeline.oobleck_cfg.sampling_rate
+    end_s = 8 * eng.pipeline.oobleck_cfg.hop_length / sr
+    sp = OmniDiffusionSamplingParams(
+        num_inference_steps=2, guidance_scale=1.0, seed=0,
+        extra={"audio_end_in_s": end_s})
+    req = OmniDiffusionRequest(prompt=["wind"], sampling_params=sp,
+                               request_ids=["r0"])
+    before = eng.pipeline.forward(req)[0].data
+    eng.sleep()
+    assert eng.pipeline.ckpt_dit_params is None
+    assert eng.pipeline.oobleck_params is None
+    eng.wake()
+    after = eng.pipeline.forward(req)[0].data
+    np.testing.assert_allclose(before, after, atol=1e-5)
