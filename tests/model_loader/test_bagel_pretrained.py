@@ -320,3 +320,23 @@ def test_engine_sleep_wake_real_bagel(checkpoint):
     eng.wake()
     after = eng.pipeline.forward(req)[0].data
     np.testing.assert_array_equal(before, after)
+
+
+def test_bagel_lm_loader_rejects_truncated(checkpoint, tmp_path):
+    """A shard missing one expert projection must raise, not silently
+    serve a zero tensor."""
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    root, _, pcfg = checkpoint
+    import os
+
+    sd = {}
+    with safe_open(os.path.join(root, "ema.safetensors"), "np") as f:
+        for k in f.keys():
+            sd[k] = f.get_tensor(k)
+    del sd["language_model.model.layers.1.self_attn.q_proj_moe_gen"
+           ".weight"]
+    save_file(sd, str(tmp_path / "ema.safetensors"))
+    with pytest.raises(ValueError):
+        bl.load_bagel_lm(str(tmp_path), pcfg, dtype=jnp.float32)

@@ -454,3 +454,43 @@ def test_engine_sleep_wake_real_stable_audio(checkpoint):
     eng.wake()
     after = eng.pipeline.forward(req)[0].data
     np.testing.assert_allclose(before, after, atol=1e-5)
+
+
+def test_stable_audio_loaders_reject_truncated(tmp_path):
+    """Missing tensors raise for both the DiT and the Oobleck decoder."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(9)
+    sd = _dit_state_dict(rng, TINY)
+    del sd["transformer_blocks.1.ff.net.2.weight"]
+    d = tmp_path / "dit"
+    d.mkdir()
+    save_file(sd, str(d / "diffusion_pytorch_model.safetensors"))
+    (d / "config.json").write_text(json.dumps({
+        "in_channels": TINY.in_channels, "num_layers": TINY.num_layers,
+        "num_attention_heads": TINY.num_heads,
+        "num_key_value_attention_heads": TINY.num_kv_heads,
+        "attention_head_dim": TINY.head_dim,
+        "cross_attention_dim": TINY.cross_attention_dim,
+        "cross_attention_input_dim": TINY.cross_attention_input_dim,
+        "global_states_input_dim": TINY.global_states_input_dim,
+        "time_proj_dim": TINY.time_proj_dim,
+        "sample_size": TINY.sample_size}))
+    with pytest.raises(ValueError):
+        sdit.load_stable_audio_dit(str(d), dtype=jnp.float32)
+
+    osd = _oobleck_state_dict(rng, OB)
+    # drop one weight-norm half: the pair never completes
+    del osd["decoder.block.0.res_unit2.conv1.weight_g"]
+    v = tmp_path / "vae"
+    v.mkdir()
+    save_file(osd, str(v / "diffusion_pytorch_model.safetensors"))
+    (v / "config.json").write_text(json.dumps({
+        "audio_channels": OB.audio_channels,
+        "decoder_channels": OB.decoder_channels,
+        "decoder_input_channels": OB.decoder_input_channels,
+        "channel_multiples": list(OB.channel_multiples),
+        "downsampling_ratios": list(OB.downsampling_ratios),
+        "sampling_rate": OB.sampling_rate}))
+    with pytest.raises(ValueError):
+        oobleck.load_oobleck_decoder(str(v), dtype=jnp.float32)
