@@ -31,7 +31,9 @@ OMNI_BENCH_SIZE (config preset; "real" [default] => streaming) /
 OMNI_BENCH_SCHEDULER (euler|unipc) / OMNI_BENCH_CACHE=1 (force TeaCache
 on the flagship itself) / OMNI_BENCH_PEAK_TFLOPS / OMNI_BENCH_BUDGET_S
 (wall-clock budget; variants are skipped when exceeded) /
-OMNI_BENCH_SKIP_AR=1 / OMNI_BENCH_SKIP_CACHE_VARIANT=1.
+OMNI_BENCH_SKIP_AR=1 / OMNI_BENCH_SKIP_CACHE_VARIANT=1 /
+OMNI_BENCH_QUANT (int8|fp8 weight-only on the flagship; int8 halves the
+streamed transfer bytes) / OMNI_BENCH_SKIP_QUANT_VARIANT=1.
 """
 
 from __future__ import annotations
@@ -119,27 +121,42 @@ def _host_to_hbm_gbps(timeout_s: float = 180) -> float:
     return 0.0
 
 
-def _pick_size() -> str:
-    """Choose the flagship preset: the REAL streamed 60-layer geometry
-    when the host->HBM path can sustain it inside the bench budget,
-    else the HBM-resident reduced-layer preset (honest fallback — the
-    number is then per-layer-exact at reduced depth, reported as such)."""
+def _pick_size() -> tuple:
+    """Choose the flagship (preset, quantization): the REAL streamed
+    60-layer geometry when the host->HBM path can sustain it inside the
+    bench budget — bf16 first, int8 weight-only streaming (half the
+    bytes) when bf16 can't — else the HBM-resident reduced-layer preset
+    (honest fallback — the number is then per-layer-exact at reduced
+    depth, reported as such)."""
     env = os.environ.get("OMNI_BENCH_SIZE")
+    quant_env = os.environ.get("OMNI_BENCH_QUANT", "")
     if env:
-        return env
+        return env, quant_env
     gbps = _host_to_hbm_gbps()
     _progress(f"host->HBM throughput: {gbps:.2f} GB/s")
-    # ~30 GB streamed per step after pinning; 50 steps must fit the
-    # budget with room for warmup + AR bench
+    # ~30 GB streamed per step after pinning (bf16; int8/fp8 weight-only
+    # halves it); 50 steps must fit the budget with room for warmup +
+    # the AR bench
     steps = int(os.environ.get("OMNI_BENCH_STEPS", 50))
     est = steps * 30.0 / max(gbps, 1e-6)
-    if est < _budget_s() * 0.6:
-        return "real"
+    est_q = est / 2
+    feasible = _budget_s() * 0.6
+    if quant_env:  # explicit mode: honor it, bytes already halved
+        if est_q < feasible:
+            return "real", quant_env
+    elif est < feasible:
+        return "real", ""
+    elif est_q < feasible:
+        _progress(
+            f"bf16 streaming infeasible (~{est:.0f}s of transfers for "
+            f"{steps} steps vs {_budget_s():.0f}s budget) — real "
+            "geometry with int8 streamed weights instead")
+        return "real", "int8"
     _progress(
-        f"streamed real preset infeasible (~{est:.0f}s of transfers "
-        f"for {steps} steps vs {_budget_s():.0f}s budget) — using "
-        "HBM-resident preset")
-    return "resident"
+        f"streamed real preset infeasible (~{est:.0f}s bf16 / "
+        f"~{est_q:.0f}s quantized of transfers for {steps} steps vs "
+        f"{_budget_s():.0f}s budget) — using HBM-resident preset")
+    return "resident", quant_env
 
 
 def _tpu_alive(timeout_s: float = None) -> bool:
@@ -164,7 +181,8 @@ def _tpu_alive(timeout_s: float = None) -> bool:
 
 
 # ------------------------------------------------------------- diffusion
-def _build_engine(size: str, scheduler: str, use_cache: bool):
+def _build_engine(size: str, scheduler: str, use_cache: bool,
+                  quant: str = ""):
     from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
     from vllm_omni_tpu.diffusion.engine import DiffusionEngine
 
@@ -176,13 +194,14 @@ def _build_engine(size: str, scheduler: str, use_cache: bool):
         dtype="bfloat16", extra=extra,
         cache_backend="teacache" if use_cache else "",
         offload="layerwise" if size == "real" else "",
+        quantization=quant,
     )
     return DiffusionEngine(cfg, warmup=False)
 
 
 def bench_diffusion(size: str, scheduler: str, use_cache: bool,
                     height: int, width: int, steps: int,
-                    iters: int) -> dict:
+                    iters: int, quant: str = "") -> dict:
     from vllm_omni_tpu.diffusion.request import (
         OmniDiffusionRequest,
         OmniDiffusionSamplingParams,
@@ -190,7 +209,7 @@ def bench_diffusion(size: str, scheduler: str, use_cache: bool,
 
     fallback = ""
     try:
-        engine = _build_engine(size, scheduler, use_cache)
+        engine = _build_engine(size, scheduler, use_cache, quant)
     except Exception as e:  # e.g. not enough host RAM for the weights
         if size not in ("real", "resident"):
             raise
@@ -198,6 +217,7 @@ def bench_diffusion(size: str, scheduler: str, use_cache: bool,
                   "falling back to 16-layer bench preset")
         fallback = f"{size} preset failed ({type(e).__name__}: {e}); "
         size, height, width, steps, iters = "bench", 512, 512, 20, 3
+        quant = ""
 
         engine = _build_engine(size, scheduler, use_cache)
 
@@ -228,32 +248,54 @@ def bench_diffusion(size: str, scheduler: str, use_cache: bool,
         # A second 1-step pass runs with all compiles warm; the
         # pipeline's own denoise timing separates the per-step streamed
         # cost from the per-run text-encode/VAE overhead.
-        tw = time.perf_counter()
-        one(1)
-        pass2_s = time.perf_counter() - tw
-        step_s = getattr(engine.pipeline, "last_stream_denoise_s",
-                         pass2_s)
-        overhead_s = max(pass2_s - step_s, 0.0)
+        def measure_step():
+            tw = time.perf_counter()
+            one(1)
+            pass2_s = time.perf_counter() - tw
+            s = getattr(engine.pipeline, "last_stream_denoise_s", pass2_s)
+            return s, max(pass2_s - s, 0.0)
+
+        def rebuild(new_size, new_quant):
+            # release the old pipeline FIRST: its pinned HBM blocks plus
+            # the replacement's weights would exceed one chip
+            nonlocal engine
+            del engine
+            import gc
+
+            gc.collect()
+            engine = _build_engine(new_size, scheduler, use_cache,
+                                   new_quant)
+            one(1)
+
+        step_s, overhead_s = measure_step()
         est_total = overhead_s + steps * step_s
         remaining = _budget_s() - (time.time() - _T0)
         _progress(
             f"streamed step {step_s:.1f}s + {overhead_s:.1f}s/run "
             f"overhead => ~{est_total:.0f}s for {steps} steps "
             f"({remaining:.0f}s left in budget)")
+        if est_total > remaining and not quant:
+            # int8 weight-only halves the streamed bytes the walk is
+            # bound by — try it before abandoning the real geometry
+            _progress("bf16 streaming measured-infeasible — retrying "
+                      "the real geometry with int8 streamed weights")
+            fallback = (f"bf16 streaming measured-infeasible "
+                        f"({step_s:.0f}s/streamed-step); ")
+            quant = "int8"
+            rebuild(size, quant)
+            step_s, overhead_s = measure_step()
+            est_total = overhead_s + steps * step_s
+            remaining = _budget_s() - (time.time() - _T0)
+            _progress(f"int8 streamed step {step_s:.1f}s => "
+                      f"~{est_total:.0f}s for {steps} steps "
+                      f"({remaining:.0f}s left)")
         if est_total > remaining:
             _progress("streamed real preset measured-infeasible — "
                       "falling back to HBM-resident preset")
-            fallback = (f"real preset measured-infeasible "
-                        f"({step_s:.0f}s/streamed-step); ")
-            size = "resident"
-            # release the streamed pipeline FIRST: its pinned HBM blocks
-            # plus the resident preset's weights would exceed one chip
-            del engine
-            import gc
-
-            gc.collect()
-            engine = _build_engine(size, scheduler, use_cache)
-            one(1)
+            fallback += (f"real preset measured-infeasible "
+                         f"({step_s:.0f}s/streamed-step); ")
+            size, quant = "resident", ""
+            rebuild(size, quant)
             one(steps)
     else:
         one(steps)
@@ -293,6 +335,7 @@ def bench_diffusion(size: str, scheduler: str, use_cache: bool,
             "step_cache": use_cache,
             "skipped_steps": skipped,
             "offload": getattr(engine.pipeline, "offload", ""),
+            "quantization": quant,
             "hbm_pinned_blocks": getattr(streamer, "pinned", None),
             "weights": fallback + "random-init (real-weight loader "
                        "exists, no checkpoint in the image)",
@@ -423,7 +466,7 @@ def main():
         }))
         return
 
-    size = _pick_size()
+    size, quant = _pick_size()
     big = size in ("real", "resident")
     default_px = "1024" if big else "512"
     default_steps = "50" if big else "20"
@@ -435,9 +478,46 @@ def main():
     use_cache = os.environ.get("OMNI_BENCH_CACHE", "") == "1"
 
     flagship = bench_diffusion(size, scheduler, use_cache, height, width,
-                               steps, iters)
+                               steps, iters, quant)
     out = dict(flagship)
     out["vs_baseline"] = None
+
+    # quantized-streaming companion: the bf16-vs-int8 streamed pair is
+    # the headline transfer-bound comparison (int8 halves the ~30 GB/step
+    # weight traffic) — run whichever streamed variant the flagship
+    # didn't, budget permitting
+    ran_size = flagship["arch"]["size_preset"]
+    ran_quant = flagship["arch"]["quantization"]
+    if ran_size == "real" and ran_quant == "":
+        q_remaining = _budget_s() - (time.time() - _T0)
+        est_q = flagship.get("seconds_per_image", 1e9) * 0.55 + 180
+        if os.environ.get("OMNI_BENCH_SKIP_QUANT_VARIANT", "") == "1":
+            out["quantized_stream_variant"] = {
+                "skipped": "OMNI_BENCH_SKIP_QUANT_VARIANT=1"}
+        elif est_q + 480 > q_remaining:
+            # keep ~8 min back for the AR bench — it has never had a
+            # number and must not be starved by a variant
+            out["quantized_stream_variant"] = {
+                "skipped": f"budget ({q_remaining:.0f}s left, "
+                           f"~{est_q:.0f}s needed + AR reserve)"}
+        else:
+            try:
+                qvar = bench_diffusion(size, scheduler, use_cache,
+                                       height, width, steps, iters,
+                                       "int8")
+                # report the arch the variant ACTUALLY ran (its internal
+                # feasibility fallback may have stripped quant or
+                # changed preset) — never stamp the requested mode
+                out["quantized_stream_variant"] = {
+                    k: qvar[k] for k in ("metric", "value", "unit",
+                                         "seconds_per_image", "mfu")}
+                out["quantized_stream_variant"].update(
+                    quantization=qvar["arch"]["quantization"],
+                    size_preset=qvar["arch"]["size_preset"],
+                    weights=qvar["arch"]["weights"])
+            except Exception as e:
+                out["quantized_stream_variant"] = {
+                    "error": f"{type(e).__name__}: {e}"}
 
     ar_remaining = _budget_s() - (time.time() - _T0)
     if os.environ.get("OMNI_BENCH_SKIP_AR", "") == "1":

@@ -187,9 +187,20 @@ class DiffusionEngine:
                 cache_config=cache_config, mesh=mesh, **extra_kwargs,
             )
         if od_config.quantization in ("int8", "fp8"):
-            from vllm_omni_tpu.diffusion.quantization import quantize_params
+            from vllm_omni_tpu.diffusion.quantization import (
+                quantize_params,
+                quantize_params_host,
+            )
 
-            self.pipeline.dit_params = quantize_params(
+            # layerwise-streamed trees live in HOST memory: quantize
+            # there (halves the per-step host->HBM transfer the walk is
+            # bound by); the jnp path would round-trip every block
+            # through the device
+            quantize = (
+                quantize_params_host
+                if getattr(self.pipeline, "offload", "") == "layerwise"
+                else quantize_params)
+            self.pipeline.dit_params = quantize(
                 self.pipeline.dit_params, mode=od_config.quantization
             )
         elif od_config.quantization:

@@ -46,31 +46,85 @@ def quantize_linear_weight_fp8(w: jax.Array) -> dict:
     return {"w_q": w_q, "w_scale": scale}
 
 
-def quantize_params(tree, min_size: int = 0, mode: str = "int8"):
-    """Replace every linear-style leaf dict (2-D "w") with its quantized
-    weight-only form; "b" and norms pass through.  ``min_size`` skips small
-    matrices where dequant overhead outweighs the bandwidth win.
-    ``mode``: "int8" | "fp8"."""
-    quantize = {
-        "int8": quantize_linear_weight,
-        "fp8": quantize_linear_weight_fp8,
-    }[mode]
+def _quantize_tree(tree, quantize_fn, min_size: int):
+    """Shared walk: replace every linear-style leaf dict (2-D "w") with
+    ``quantize_fn(w)``; "b" and 1-D norm weights pass through.
+    ``min_size`` skips small matrices where dequant overhead outweighs
+    the bandwidth win.
+
+    Identity-memoized: bench trees alias repeated blocks to a few
+    distinct host buffers (offload.host_tiled_init_aliased); quantizing
+    each alias separately would materialize tens of GB of near-duplicate
+    arrays and defeat the aliasing.  Aliased inputs stay aliased in the
+    output.  Returns (new_tree, n_distinct_quantized)."""
+    memo: dict[int, object] = {}
     n_quant = 0
 
     def walk(node):
         nonlocal n_quant
         if isinstance(node, dict):
+            hit = memo.get(id(node))
+            if hit is not None:
+                return hit
             if "w" in node and getattr(node["w"], "ndim", 0) == 2 \
                     and node["w"].size >= min_size:
                 n_quant += 1
-                q = quantize(node["w"])
+                q = quantize_fn(node["w"])
                 rest = {k: v for k, v in node.items() if k != "w"}
-                return {**rest, **q}
-            return {k: walk(v) for k, v in node.items()}
+                out = {**rest, **q}
+            else:
+                out = {k: walk(v) for k, v in node.items()}
+            memo[id(node)] = out
+            return out
         if isinstance(node, list):
             return [walk(v) for v in node]
         return node
 
-    out = walk(tree)
+    return walk(tree), n_quant
+
+
+def quantize_params(tree, min_size: int = 0, mode: str = "int8"):
+    """Quantize a DEVICE param tree in place of its float linears.
+    ``mode``: "int8" | "fp8"."""
+    quantize = {
+        "int8": quantize_linear_weight,
+        "fp8": quantize_linear_weight_fp8,
+    }[mode]
+    out, n_quant = _quantize_tree(tree, quantize, min_size)
     logger.info("quantized %d linear weights to %s", n_quant, mode)
+    return out
+
+
+def quantize_linear_weight_host(w, mode: str = "int8") -> dict:
+    """Host (numpy) twin of the device quantizers, for layerwise-streamed
+    param trees that must stay in host memory: quantizing with jnp would
+    round-trip every block through the device.  Same math, same rounding
+    (IEEE f32 max/div + round-half-even), so streamed-quantized equals
+    resident-quantized bit-for-bit."""
+    import numpy as np
+
+    wf = np.asarray(w, dtype=np.float32)
+    absmax = np.max(np.abs(wf), axis=0)  # [out]
+    if mode == "int8":
+        scale = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
+        w_q = np.clip(
+            np.round(wf / scale[None, :]), -127, 127).astype(np.int8)
+    elif mode == "fp8":
+        import ml_dtypes
+
+        scale = np.maximum(absmax / _FP8_MAX, 1e-12).astype(np.float32)
+        w_q = (wf / scale[None, :]).astype(ml_dtypes.float8_e4m3fn)
+    else:
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    return {"w_q": w_q, "w_scale": scale}
+
+
+def quantize_params_host(tree, min_size: int = 0, mode: str = "int8"):
+    """``quantize_params`` for HOST trees (layerwise streaming).  int8
+    halves the host->HBM bytes per streamed block — the streamed denoise
+    walk is transfer-bound, so the step time drops near-proportionally."""
+    out, n_quant = _quantize_tree(
+        tree, lambda w: quantize_linear_weight_host(w, mode), min_size)
+    logger.info("host-quantized %d distinct linear weights to %s",
+                n_quant, mode)
     return out
