@@ -488,7 +488,16 @@ def main():
     # didn't, budget permitting
     ran_size = flagship["arch"]["size_preset"]
     ran_quant = flagship["arch"]["quantization"]
-    if ran_size == "real" and ran_quant == "":
+    if ran_size != "real":
+        out["quantized_stream_variant"] = {
+            "skipped": f"flagship ran the {ran_size} preset (the "
+                       "bf16-vs-int8 pair is a streamed-real comparison)"}
+    elif ran_quant:
+        out["quantized_stream_variant"] = {
+            "skipped": f"flagship itself ran {ran_quant}-quantized "
+                       "streaming (bf16 streaming was infeasible or "
+                       "OMNI_BENCH_QUANT forced the mode)"}
+    elif ran_size == "real" and ran_quant == "":
         q_remaining = _budget_s() - (time.time() - _T0)
         est_q = flagship.get("seconds_per_image", 1e9) * 0.55 + 180
         if os.environ.get("OMNI_BENCH_SKIP_QUANT_VARIANT", "") == "1":

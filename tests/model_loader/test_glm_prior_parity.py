@@ -1,0 +1,264 @@
+"""GLM-Image AR prior VLM: checkpoint-schema parity vs the GLM-4.1V
+torch oracle + rollout behavior.
+
+The prior's trunk is GLM-4.1V (reference loads
+``GlmImageForConditionalGeneration``, pipeline_glm_image.py:285; the
+class is a GLM-4.1V derivative absent from transformers 4.57.6 — but
+``Glm4vForConditionalGeneration`` IS present and defines the published
+checkpoint names).  A synthetic checkpoint saved from the torch model
+must load through ``load_glm_prior`` and reproduce the oracle's hidden
+states/logits (text, GQA + sandwich norms + interleaved mrope) and
+vision features (bicubic pos-embed resample, 2-axis rope, merge
+downsample) to float32 tolerance."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from vllm_omni_tpu.models.glm_image import prior as gp  # noqa: E402
+
+CFG = gp.GlmPriorConfig.tiny()
+
+
+def _torch_cfg():
+    from transformers.models.glm4v import configuration_glm4v as c
+
+    t, v = CFG.text, CFG.vision
+    tc = dict(
+        vocab_size=t.vocab_size, hidden_size=t.hidden_size,
+        intermediate_size=t.intermediate_size,
+        num_hidden_layers=t.num_layers,
+        num_attention_heads=t.num_heads,
+        num_key_value_heads=t.num_kv_heads,
+        rope_theta=t.rope_theta, rms_norm_eps=t.rms_eps,
+        rope_scaling={"rope_type": "default",
+                      "mrope_section": list(t.mrope_section)},
+    )
+    vc = dict(
+        hidden_size=v.hidden_size, depth=v.depth, num_heads=v.num_heads,
+        patch_size=v.patch_size, temporal_patch_size=v.temporal_patch_size,
+        in_channels=v.in_channels, out_hidden_size=v.out_hidden_size,
+        intermediate_size=v.intermediate_size,
+        spatial_merge_size=v.spatial_merge_size, image_size=v.image_size,
+        rms_norm_eps=v.rms_eps,
+    )
+    return c.Glm4vConfig(text_config=tc, vision_config=vc)
+
+
+def write_prior_checkpoint(d):
+    """Save a synthetic GLM-Image prior checkpoint (GLM-4.1V names) at
+    the tiny geometry; returns the torch oracle.  Shared with the
+    pipeline-level e2e (test_glm_dit_parity.py)."""
+    from safetensors.numpy import save_file
+    from transformers.models.glm4v import modeling_glm4v as m
+
+    torch.manual_seed(0)
+    model = m.Glm4vForConditionalGeneration(_torch_cfg()).eval()
+    # break the zero-init / identity-init symmetry a fresh HF model
+    # ships with, so parity actually exercises every projection
+    with torch.no_grad():
+        for p in model.parameters():
+            p.uniform_(-0.08, 0.08)
+
+    os.makedirs(d, exist_ok=True)
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    save_file(sd, os.path.join(d, "model.safetensors"))
+    cfg_json = {
+        "architectures": ["GlmImageForConditionalGeneration"],
+        "text_config": {
+            "vocab_size": CFG.text.vocab_size,
+            "hidden_size": CFG.text.hidden_size,
+            "intermediate_size": CFG.text.intermediate_size,
+            "num_hidden_layers": CFG.text.num_layers,
+            "num_attention_heads": CFG.text.num_heads,
+            "num_key_value_heads": CFG.text.num_kv_heads,
+            "rope_theta": CFG.text.rope_theta,
+            "rms_norm_eps": CFG.text.rms_eps,
+            "rope_scaling": {"rope_type": "default",
+                             "mrope_section": list(CFG.text.mrope_section)},
+        },
+        "vision_config": {
+            "hidden_size": CFG.vision.hidden_size,
+            "depth": CFG.vision.depth,
+            "num_heads": CFG.vision.num_heads,
+            "patch_size": CFG.vision.patch_size,
+            "temporal_patch_size": CFG.vision.temporal_patch_size,
+            "in_channels": CFG.vision.in_channels,
+            "out_hidden_size": CFG.vision.out_hidden_size,
+            "intermediate_size": CFG.vision.intermediate_size,
+            "spatial_merge_size": CFG.vision.spatial_merge_size,
+            "image_size": CFG.vision.image_size,
+            "rms_norm_eps": CFG.vision.rms_eps,
+        },
+        "image_start_token_id": CFG.image_start_id,
+        "image_vocab_size": CFG.image_vocab,
+    }
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(cfg_json, f)
+    return model
+
+
+@pytest.fixture(scope="module")
+def oracle_and_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("glm_prior_ckpt"))
+    model = write_prior_checkpoint(d)
+    return model, d
+
+
+@pytest.fixture(scope="module")
+def loaded(oracle_and_dir):
+    _, d = oracle_and_dir
+    params, cfg = gp.load_glm_prior(d, dtype=jnp.float32)
+    assert cfg.text.num_layers == CFG.text.num_layers
+    assert cfg.image_start_id == CFG.image_start_id
+    return params, cfg
+
+
+def test_config_from_hf_parses_image_fields(loaded):
+    _, cfg = loaded
+    assert cfg.image_vocab == CFG.image_vocab
+    assert cfg.text.mrope_section == CFG.text.mrope_section
+    assert cfg.vision is not None
+
+
+def test_text_trunk_matches_oracle(oracle_and_dir, loaded):
+    model, _ = oracle_and_dir
+    params, cfg = loaded
+    rng = np.random.default_rng(1)
+    b, s = 2, 12
+    ids = rng.integers(0, cfg.text.vocab_size, (b, s))
+    # 3-D positions with DIVERGING streams (an image block) so the
+    # mrope section merge is actually exercised, not just 1-D rope
+    text_pos = np.broadcast_to(np.arange(4, dtype=np.int64), (b, 3, 4))
+    blk, _ = gp._image_block_positions(4, 2, 4)
+    img_pos = np.broadcast_to(blk.astype(np.int64), (b, 3, 8))
+    pos = np.concatenate([text_pos, img_pos], axis=2)  # [B,3,S]
+
+    with torch.no_grad():
+        out = model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            position_ids=torch.tensor(pos).permute(1, 0, 2),
+        )
+    ref = out.logits.numpy()
+
+    hidden = gp.text_forward_hidden(
+        params["lm"], cfg.text, jnp.asarray(ids, jnp.int32),
+        jnp.asarray(pos, jnp.int32))
+    got = np.asarray(gp.lm_logits(params["lm"], hidden))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_vision_trunk_matches_oracle(oracle_and_dir, loaded):
+    model, _ = oracle_and_dir
+    params, cfg = loaded
+    v = cfg.vision
+    gh, gw = 4, 6
+    s = gh * gw
+    patch_dim = v.in_channels * v.temporal_patch_size * v.patch_size ** 2
+    rng = np.random.default_rng(2)
+    patches = (0.1 * rng.standard_normal((s, patch_dim))).astype(
+        np.float32)
+
+    with torch.no_grad():
+        ref = model.model.visual(
+            torch.tensor(patches),
+            grid_thw=torch.tensor([[1, gh, gw]])).numpy()
+
+    got = np.asarray(gp.vision_forward(
+        params["visual"], v, jnp.asarray(patches), gh, gw))
+    assert got.shape == ref.shape  # [S/merge^2, out_hidden]
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_bicubic_matches_torch_grid_sample():
+    rng = np.random.default_rng(3)
+    h, w, d = 8, 8, 5
+    grid = rng.standard_normal((h, w, d)).astype(np.float32)
+    n = 40
+    ys = rng.uniform(-1.5, h + 0.5, n).astype(np.float32)
+    xs = rng.uniform(-1.5, w + 0.5, n).astype(np.float32)
+
+    # torch: unnormalized -> grid_sample normalized coords
+    norm_x = (2 * xs + 1) / w - 1
+    norm_y = (2 * ys + 1) / h - 1
+    g2d = torch.tensor(grid).permute(2, 0, 1).unsqueeze(0)
+    sample_grid = torch.tensor(
+        np.stack([norm_x, norm_y], -1)[None, :, None, :])
+    ref = torch.nn.functional.grid_sample(
+        g2d, sample_grid, mode="bicubic", align_corners=False,
+        padding_mode="border").squeeze(0).squeeze(-1).permute(1, 0)
+
+    got = np.asarray(gp.bicubic_sample(
+        jnp.asarray(grid), jnp.asarray(ys), jnp.asarray(xs)))
+    np.testing.assert_allclose(got, ref.numpy(), atol=1e-4, rtol=1e-4)
+
+
+def test_rollout_ids_in_range_and_deterministic(loaded):
+    params, cfg = loaded
+
+    class Tok:
+        chat_template = None
+
+        def __call__(self, text):
+            return {"input_ids": [5, 7, 11, 13]}
+
+    prior = gp.GlmImagePrior(params, cfg, tokenizer=Tok())
+    ids = prior.generate_prior_tokens("a cat", 2, 4)
+    assert ids.shape == (8,)
+    assert ids.min() >= 0 and ids.max() < cfg.image_vocab
+    again = prior.generate_prior_tokens("a cat", 2, 4)
+    np.testing.assert_array_equal(ids, again)
+    # sampled path stays in range too
+    sampled = prior.generate_prior_tokens("a cat", 2, 4,
+                                          temperature=1.0, seed=3)
+    assert sampled.min() >= 0 and sampled.max() < cfg.image_vocab
+
+
+def test_rollout_matches_oracle_greedy_first_token(oracle_and_dir,
+                                                   loaded):
+    """The rollout's prefill must agree with the oracle: the first
+    generated token (greedy over the image-id range) equals the oracle's
+    masked argmax after the same prompt."""
+    model, _ = oracle_and_dir
+    params, cfg = loaded
+    prompt = [5, 7, 11, 13]
+    grids = [(1, 2), (2, 4)]
+    # bucket LARGER than the prompt: right-padding + the pad-masked
+    # decode must not change the oracle-matched prefill logits
+    bucket = 8
+    padded = np.zeros((bucket,), np.int32)
+    padded[:len(prompt)] = prompt
+    positions = gp.rollout_positions(bucket, len(prompt), grids)
+    gen = gp.make_generate(cfg, bucket, 2 + 8)
+    out = np.asarray(gen(params, jnp.asarray(padded)[None],
+                         jnp.int32(len(prompt)),
+                         jnp.asarray(positions), jnp.float32(0.0),
+                         jax.random.PRNGKey(0)))[0]
+
+    pos_t = torch.tensor(
+        positions[:, :len(prompt)][:, None, :], dtype=torch.long)
+    with torch.no_grad():
+        logits = model(
+            input_ids=torch.tensor([prompt], dtype=torch.long),
+            position_ids=pos_t).logits[0, -1].numpy()
+    lo = cfg.image_start_id
+    expect = int(np.argmax(logits[lo:lo + cfg.image_vocab]))
+    assert out[0] == expect
+
+
+def test_condition_image_tokens_roundtrip(loaded):
+    """Features equal to codebook rows must map to exactly those ids
+    (nearest-neighbour correctness)."""
+    params, cfg = loaded
+    book = np.asarray(params["lm"]["embed"]["w"])[
+        cfg.image_start_id:cfg.image_start_id + cfg.image_vocab]
+    want = np.array([3, 0, 17, cfg.image_vocab - 1])
+    got = np.asarray(gp.get_image_tokens(
+        params, cfg, jnp.asarray(book[want])))
+    np.testing.assert_array_equal(got, want)

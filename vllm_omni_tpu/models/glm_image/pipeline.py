@@ -18,11 +18,12 @@ greedily under one jitted scan.
 from_pretrained loads the REAL checkpoint schema: the GLM DiT
 (ckpt_transformer.py — joint-qkv blocks, 12-chunk AdaLN, glyph/prior
 projectors, SDXL size/crop conditioning), the ByT5 glyph text encoder,
-and the AutoencoderKL.  Scope note: the AR prior stage
-(vision_language_encoder/ — a GLM-4V-style VLM) has no in-tree loader
-yet; real-weight runs take precomputed prior tokens via
-``sampling_params.extra["prior_token_ids"]`` or fall back to the
-in-tree random prior with a warning.
+the AutoencoderKL, and the AR prior VLM (vision_language_encoder/ —
+GLM-4.1V schema, prior.py) whose in-pipeline rollout generates
+``prior_token_ids`` exactly like the reference (:285, :434-453).
+Precomputed priors still win via
+``sampling_params.extra["prior_token_ids"]``; checkpoints without the
+prior stage fall back to the in-tree random prior with a warning.
 """
 
 from __future__ import annotations
@@ -91,10 +92,10 @@ class GlmImagePipeline:
 
     output_type = "image"
     config_cls = GlmImagePipelineConfig
-    # every tree engine.sleep() must offload (the AR prior included)
+    # every tree engine.sleep() must offload (both AR priors included)
     param_attrs = ("dit_params", "text_params", "vae_params",
                    "prior_params", "glm_params", "real_dit_params",
-                   "t5_params")
+                   "t5_params", "prior_vlm_params")
 
     def __init__(self, config: GlmImagePipelineConfig, dtype=jnp.bfloat16,
                  seed: int = 0, mesh=None, cache_config=None):
@@ -153,6 +154,10 @@ class GlmImagePipeline:
         self.t5_cfg = None
         self._t5_encode_jit = None
         self.hf_tokenizer = None
+        # real AR prior VLM (vision_language_encoder/, prior.py); its
+        # param tree lives in a param_attrs slot so sleep() offloads it
+        self.prior_vlm = None
+        self.prior_vlm_params = None
 
     @classmethod
     def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
@@ -160,8 +165,8 @@ class GlmImagePipeline:
                         max_text_len: int = 512):
         """Build from a diffusers-format GLM-Image checkpoint
         (transformer/ + ByT5 text_encoder/ + tokenizer/ + AutoencoderKL
-        vae/ + scheduler/; the vision_language_encoder/ AR prior has no
-        in-tree loader yet — see the module docstring)."""
+        vae/ + scheduler/ + the vision_language_encoder/ AR prior VLM,
+        whose in-pipeline rollout generates prior tokens — prior.py)."""
         import json as _json
         import os
 
@@ -205,10 +210,45 @@ class GlmImagePipeline:
         pipe.vae_params = pipe.wiring.place(vae_tree["decoder"])
         pipe.hf_tokenizer = AutoTokenizer.from_pretrained(
             os.path.join(model_dir, "tokenizer"))
-        logger.warning(
-            "GLM-Image AR prior (vision_language_encoder/) has no "
-            "in-tree loader: pass sampling_params.extra"
-            "['prior_token_ids'] or the random-init prior runs")
+        vle = os.path.join(model_dir, "vision_language_encoder")
+        if os.path.isdir(vle):
+            from vllm_omni_tpu.models.glm_image.prior import (
+                GlmImagePrior,
+                load_glm_prior,
+            )
+
+            # the prior's LM tokenizer is its own (the reference loads
+            # a GlmImageProcessor from processor/; model_dir/tokenizer
+            # is the ByT5 GLYPH tokenizer) — probe the plausible homes
+            ptok = None
+            for sub in ("processor", "vision_language_encoder"):
+                tdir = os.path.join(model_dir, sub)
+                try:
+                    ptok = AutoTokenizer.from_pretrained(tdir)
+                    break
+                except Exception:
+                    continue
+            prior_params, prior_cfg = load_glm_prior(vle, dtype=dtype)
+            if prior_cfg.image_vocab != real_cfg.prior_vocab:
+                # fail at LOAD, not after a per-request AR rollout
+                raise ValueError(
+                    f"prior image_vocab {prior_cfg.image_vocab} != DiT "
+                    f"prior_vocab {real_cfg.prior_vocab} — mismatched "
+                    "checkpoint components")
+            pipe.prior_vlm = GlmImagePrior(None, prior_cfg,
+                                           tokenizer=ptok)
+            pipe.prior_vlm_params = pipe.wiring.place(prior_params)
+            if ptok is None:
+                logger.warning(
+                    "GLM-Image AR prior loaded but no prior tokenizer "
+                    "found (processor/ or vision_language_encoder/): "
+                    "in-pipeline rollout unavailable — pass "
+                    "sampling_params.extra['prior_token_ids']")
+        else:
+            logger.warning(
+                "GLM-Image checkpoint has no vision_language_encoder/: "
+                "pass sampling_params.extra['prior_token_ids'] or the "
+                "random-init prior runs")
         return pipe
 
     @property
@@ -389,11 +429,11 @@ class GlmImagePipeline:
                 (np.arange(cfg.max_text_len)[None, :]
                  < lens[:, None]).astype(np.int32))
 
-        # stage 1: AR prior tokens — precomputed ids win (the real AR
-        # prior runs out-of-tree, see module docstring); else generated
-        # at the HALF (d32) grid and 2x nearest-upsampled to the DiT
-        # grid when the geometry allows (reference generate_prior_tokens
-        # + _upsample_token_ids); odd grids degrade to full-res priors
+        # stage 1: AR prior tokens — precomputed ids win; else the real
+        # prior VLM rolls out in-pipeline (prior.py) at the HALF (d32)
+        # grid and 2x nearest-upsamples to the DiT grid (reference
+        # generate_prior_tokens + _upsample_token_ids); checkpoints
+        # without a prior stage (and odd grids) use the random fallback
         pre = (sp.extra or {}).get("prior_token_ids") \
             if hasattr(sp, "extra") else None
         if pre is not None:
@@ -414,6 +454,25 @@ class GlmImagePipeline:
                 raise InvalidRequestError(
                     f"prior_token_ids must be [B, {seq_len}] at the DiT "
                     f"grid; got {tuple(prior_ids.shape)}")
+        elif (self.prior_vlm is not None
+              and self.prior_vlm.tokenizer is not None
+              and grid_h % 2 == 0 and grid_w % 2 == 0):
+            # real AR prior VLM in-pipeline (reference
+            # generate_prior_tokens, pipeline_glm_image.py:434-525):
+            # rollout at the d32 grid (half the d16 DiT grid), 2x
+            # nearest-upsample to the DiT grid
+            ph, pw = grid_h // 2, grid_w // 2
+            extra = sp.extra if hasattr(sp, "extra") and sp.extra else {}
+            temp = float(extra.get("prior_temperature", 0.0))
+            base_seed = sp.seed if sp.seed is not None else 0
+            rows = [
+                self.prior_vlm.generate_prior_tokens(
+                    ptxt, ph, pw, temperature=temp,
+                    seed=base_seed + i, params=self.prior_vlm_params)
+                for i, ptxt in enumerate(prompts)
+            ]
+            small = jnp.asarray(np.stack(rows), jnp.int32)
+            prior_ids = self.upsample_prior_ids(small, ph, pw)
         else:
             seed_ids = jnp.asarray(
                 np.asarray(ids)[:, :8] % cfg.prior_lm.vocab_size,

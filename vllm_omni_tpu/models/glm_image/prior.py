@@ -1,0 +1,821 @@
+"""GLM-Image AR prior VLM (``vision_language_encoder/``) — the model that
+generates ``prior_token_ids`` in-pipeline.
+
+Role (reference: vllm_omni/diffusion/models/glm_image/
+pipeline_glm_image.py:285 loads ``GlmImageForConditionalGeneration``;
+:434-525 ``generate_prior_tokens`` runs a chat-templated AR rollout and
+extracts the target image-token grid).  The class itself is absent from
+the installed transformers (4.57.6), so this module implements it from
+the checkpoint schema: the trunk is GLM-4.1V — transformers
+``Glm4vForConditionalGeneration``, which IS installed and serves as the
+torch parity oracle (tests/model_loader/test_glm_prior_parity.py) — and
+the image-token machinery follows the reference pipeline's observable
+usage:
+
+- image tokens live in the LM vocabulary: ``generate()`` output ids are
+  sliced directly into prior tokens (pipeline_glm_image.py:414-421), so
+  the LM emits them natively; generation is constrained to the image-id
+  range and ids re-base by ``image_start_id`` before the DiT consumes
+  them (the DiT's prior embedding covers ``[0, prior_vocab)``,
+  glm_image_transformer.py prior_token_embedding);
+- text-to-image generates a small preview grid before the full target
+  grid (``_compute_generation_params``: t2i's target grid is FIRST in
+  ``image_grid_thw`` and extraction offsets past ``sum(grid_sizes[1:])``
+  preview tokens);
+- condition images map to prior ids via the vision tower + a codebook
+  nearest-neighbour (``get_image_features(...).pooler_output`` ->
+  ``get_image_tokens``, pipeline_glm_image.py:492-509): the codebook is
+  the image-id block of the LM embedding matrix.
+
+TPU-first shape: one jitted KV-cached rollout (``lax.fori_loop`` over a
+static token budget, dense single-query attention over a preallocated
+cache) instead of HF ``generate``'s Python loop; the vision tower is a
+flat-patch matmul pipeline with the bicubic position-embedding resample
+implemented as a separable cubic-convolution gather (torch
+``grid_sample(mode="bicubic", align_corners=False, padding_mode=
+"border")`` semantics, parity-tested).
+
+Deliberate deviations from the unobservable parts (disclosed):
+- the chat template is the checkpoint tokenizer's own
+  (``apply_chat_template``) or a plain-prompt fallback — the reference's
+  ``GlmImageProcessor`` subfolder template is not re-derivable from
+  code;
+- rollout positions follow the Qwen2-VL/GLM-4.1V ``get_rope_index``
+  convention (text 1-D; each image grid a 3-D block whose t/h/w streams
+  start where the previous segment ended);
+- the reference generates one trailing token after the target grid
+  (``max_new_tokens = total + 1``) that extraction always discards; the
+  rollout here simply stops at the grid boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.models.common import nn
+from vllm_omni_tpu.ops import flash_attention, rms_norm
+
+logger = init_logger(__name__)
+
+
+# ------------------------------------------------------------------ configs
+@dataclass(frozen=True)
+class GlmPriorTextConfig:
+    """GLM-4.1V text trunk (transformers Glm4vTextConfig schema)."""
+
+    vocab_size: int = 151552
+    hidden_size: int = 4096
+    num_layers: int = 40
+    num_heads: int = 32
+    num_kv_heads: int = 2
+    head_dim: int = 128
+    intermediate_size: int = 13696
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    # 3-D mrope channel split; sum * 2 = rotary dim (partial rotary:
+    # GLM-4.1V ships [8, 12, 12] -> 64 of 128 dims rotate)
+    mrope_section: tuple = (8, 12, 12)
+
+    @property
+    def rotary_dim(self) -> int:
+        return 2 * sum(self.mrope_section)
+
+
+@dataclass(frozen=True)
+class GlmPriorVisionConfig:
+    """GLM-4.1V vision tower (transformers Glm4vVisionConfig schema)."""
+
+    hidden_size: int = 1536
+    depth: int = 24
+    num_heads: int = 12
+    patch_size: int = 14
+    temporal_patch_size: int = 1
+    in_channels: int = 3
+    out_hidden_size: int = 4096
+    intermediate_size: int = 13696
+    spatial_merge_size: int = 2
+    image_size: int = 336  # native pos-embed grid = image_size//patch
+    rms_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def pos_grid(self) -> int:
+        return self.image_size // self.patch_size
+
+
+@dataclass(frozen=True)
+class GlmPriorConfig:
+    text: GlmPriorTextConfig = dataclasses.field(
+        default_factory=GlmPriorTextConfig)
+    vision: Optional[GlmPriorVisionConfig] = dataclasses.field(
+        default_factory=GlmPriorVisionConfig)
+    # image tokens occupy [image_start_id, image_start_id + image_vocab)
+    # of the LM vocabulary; generated ids re-base by image_start_id
+    image_start_id: int = 135168  # 151552 - 16384: trailing vocab block
+    image_vocab: int = 16384
+
+    @staticmethod
+    def from_hf(d: dict) -> "GlmPriorConfig":
+        td = d.get("text_config", d)
+        rope = td.get("rope_scaling") or {}
+        head_dim = td.get("head_dim") or (
+            td["hidden_size"] // td["num_attention_heads"])
+        sections = rope.get("mrope_section")
+        if sections is None:
+            # GLM-4 partial rotary 0.5 proportioned like GLM-4.1V's
+            # published [8, 12, 12] for head_dim 128
+            sections = (head_dim // 16, 3 * head_dim // 32,
+                        3 * head_dim // 32)
+        text = GlmPriorTextConfig(
+            vocab_size=td.get("vocab_size", 151552),
+            hidden_size=td["hidden_size"],
+            num_layers=td.get("num_hidden_layers", 40),
+            num_heads=td.get("num_attention_heads", 32),
+            num_kv_heads=td.get("num_key_value_heads", 2),
+            head_dim=head_dim,
+            intermediate_size=td.get("intermediate_size", 13696),
+            rope_theta=td.get("rope_theta", 10000.0),
+            rms_eps=td.get("rms_norm_eps", 1e-5),
+            mrope_section=tuple(sections),
+        )
+        vision = None
+        if "vision_config" in d:
+            vd = d["vision_config"]
+            vision = GlmPriorVisionConfig(
+                hidden_size=vd.get("hidden_size", 1536),
+                depth=vd.get("depth", 24),
+                num_heads=vd.get("num_heads", 12),
+                patch_size=vd.get("patch_size", 14),
+                temporal_patch_size=vd.get("temporal_patch_size", 1),
+                in_channels=vd.get("in_channels", 3),
+                out_hidden_size=vd.get("out_hidden_size", 4096),
+                intermediate_size=vd.get("intermediate_size", 13696),
+                spatial_merge_size=vd.get("spatial_merge_size", 2),
+                image_size=vd.get("image_size", 336),
+                rms_eps=vd.get("rms_norm_eps", 1e-5),
+            )
+        vocab = text.vocab_size
+        image_vocab = (d.get("image_vocab_size")
+                       or d.get("prior_vq_quantizer_codebook_size")
+                       or 16384)
+        start = (d.get("image_start_token_id")
+                 or d.get("image_token_start_id"))
+        if start is None:
+            start = vocab - image_vocab  # trailing block convention
+        return GlmPriorConfig(text=text, vision=vision,
+                              image_start_id=int(start),
+                              image_vocab=int(image_vocab))
+
+    @staticmethod
+    def tiny() -> "GlmPriorConfig":
+        # head_dim = hidden // heads (the torch oracle hardcodes it);
+        # mrope_section sums to head_dim // 2 (full interleaved rotary,
+        # the default partial_rotary_factor=1.0 oracle config)
+        return GlmPriorConfig(
+            text=GlmPriorTextConfig(
+                vocab_size=256, hidden_size=64, num_layers=2,
+                num_heads=4, num_kv_heads=2, head_dim=16,
+                intermediate_size=96, mrope_section=(2, 3, 3)),
+            vision=GlmPriorVisionConfig(
+                hidden_size=32, depth=2, num_heads=4, patch_size=14,
+                temporal_patch_size=1, in_channels=3,
+                out_hidden_size=32, intermediate_size=64,
+                spatial_merge_size=2, image_size=112),
+            image_start_id=192, image_vocab=64)
+
+
+# -------------------------------------------------------------------- init
+def init_text_params(key, cfg: GlmPriorTextConfig, dtype=jnp.float32):
+    ks = iter(jax.random.split(key, 4 + 10 * cfg.num_layers))
+    d, hd = cfg.hidden_size, cfg.head_dim
+
+    def lin(i, o, bias):
+        return nn.linear_init(next(ks), i, o, bias=bias, dtype=dtype)
+
+    layers = []
+    for _ in range(cfg.num_layers):
+        layers.append({
+            "input_ln": {"w": jnp.ones((d,), dtype)},
+            "q": lin(d, cfg.num_heads * hd, True),
+            "k": lin(d, cfg.num_kv_heads * hd, True),
+            "v": lin(d, cfg.num_kv_heads * hd, True),
+            "o": lin(cfg.num_heads * hd, d, False),
+            "post_self_attn_ln": {"w": jnp.ones((d,), dtype)},
+            "post_attn_ln": {"w": jnp.ones((d,), dtype)},
+            "gate_up": lin(d, 2 * cfg.intermediate_size, False),
+            "down": lin(cfg.intermediate_size, d, False),
+            "post_mlp_ln": {"w": jnp.ones((d,), dtype)},
+        })
+    return {
+        "embed": nn.embedding_init(next(ks), cfg.vocab_size, d, dtype),
+        "layers": layers,
+        "final_norm": {"w": jnp.ones((d,), dtype)},
+        "lm_head": lin(d, cfg.vocab_size, False),
+    }
+
+
+def init_vision_params(key, cfg: GlmPriorVisionConfig, dtype=jnp.float32):
+    ks = iter(jax.random.split(key, 8 + 7 * cfg.depth))
+    d = cfg.hidden_size
+    patch_in = (cfg.in_channels * cfg.temporal_patch_size
+                * cfg.patch_size ** 2)
+
+    def lin(i, o, bias):
+        return nn.linear_init(next(ks), i, o, bias=bias, dtype=dtype)
+
+    blocks = []
+    for _ in range(cfg.depth):
+        blocks.append({
+            "norm1": {"w": jnp.ones((d,), dtype)},
+            "qkv": lin(d, 3 * d, False),
+            "proj": lin(d, d, False),
+            "norm2": {"w": jnp.ones((d,), dtype)},
+            # Glm4VisionMlp: intermediate = out_hidden_size (schema quirk)
+            "gate": lin(d, cfg.out_hidden_size, False),
+            "up": lin(d, cfg.out_hidden_size, False),
+            "down": lin(cfg.out_hidden_size, d, False),
+        })
+    m = cfg.spatial_merge_size
+    oh = cfg.out_hidden_size
+    return {
+        "patch_proj": lin(patch_in, d, True),
+        "pos_embed": (0.02 * jax.random.normal(
+            next(ks), (cfg.pos_grid ** 2, d))).astype(dtype),
+        "post_conv_norm": {"w": jnp.ones((d,), dtype)},
+        "blocks": blocks,
+        "post_norm": {"w": jnp.ones((d,), dtype)},
+        "downsample": lin(d * m * m, oh, True),
+        "merger": {
+            "proj": lin(oh, oh, False),
+            "ln": {"w": jnp.ones((oh,), dtype),
+                   "b": jnp.zeros((oh,), dtype)},
+            "gate": lin(oh, cfg.intermediate_size, False),
+            "up": lin(oh, cfg.intermediate_size, False),
+            "down": lin(cfg.intermediate_size, oh, False),
+        },
+    }
+
+
+def init_params(key, cfg: GlmPriorConfig, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    p = {"lm": init_text_params(k1, cfg.text, dtype)}
+    if cfg.vision is not None:
+        p["visual"] = init_vision_params(k2, cfg.vision, dtype)
+    return p
+
+
+# ------------------------------------------------------------- text trunk
+def _rope_tables(cfg: GlmPriorTextConfig, positions):
+    """positions [B, 3, S] -> (cos, sin) [B, 3, S, rotary_dim] (the
+    pre-merge per-stream tables; transformers Glm4vTextRotaryEmbedding
+    computes freqs then cat(freqs, freqs))."""
+    n = sum(cfg.mrope_section)
+    inv = 1.0 / (cfg.rope_theta ** (
+        jnp.arange(0, n, dtype=jnp.float32) / n))
+    freqs = positions.astype(jnp.float32)[..., None] * inv  # [B,3,S,n]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _merge_mrope(tab, sections):
+    """[B, 3, S, 2n] per-stream table -> [B, S, 2n] merged (sections*2
+    chunks pick stream i%3), then keep the first half and interleave-
+    duplicate (apply_multimodal_rotary_pos_emb)."""
+    n = sum(sections)
+    widths = list(sections) * 2
+    parts, start = [], 0
+    for i, w in enumerate(widths):
+        parts.append(tab[:, i % 3, :, start:start + w])
+        start += w
+    merged = jnp.concatenate(parts, axis=-1)[..., :n]
+    return jnp.repeat(merged, 2, axis=-1)  # [B, S, 2n]
+
+
+def _rotate_interleaved(x):
+    """rotate_half_llm: pairs (x0, x1) -> (-x1, x0), interleaved."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def _apply_mrope(q, k, cos, sin, sections):
+    """q [B, S, H, hd], cos/sin [B, 3, S, 2n] -> partial interleaved
+    rotation of the first 2n dims."""
+    rot = 2 * sum(sections)
+    mc = _merge_mrope(cos, sections)[:, :, None, :]  # [B,S,1,2n]
+    ms = _merge_mrope(sin, sections)[:, :, None, :]
+
+    def rotate(x):
+        xr, xp = x[..., :rot], x[..., rot:]
+        xr = xr * mc + _rotate_interleaved(xr) * ms
+        return jnp.concatenate([xr, xp], axis=-1).astype(x.dtype)
+
+    return rotate(q.astype(jnp.float32)), rotate(k.astype(jnp.float32))
+
+
+def _text_layer(lp, cfg: GlmPriorTextConfig, x, cos, sin, attend):
+    """One GLM sandwich-norm decoder layer (Glm4vTextDecoderLayer:
+    input_ln -> attn -> post_self_attn_ln -> +res; post_attn_ln -> MLP
+    -> post_mlp_ln -> +res; fused gate_up with silu)."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, lp["input_ln"]["w"], cfg.rms_eps)
+    q = nn.linear(lp["q"], h).reshape(b, s, cfg.num_heads, hd)
+    k = nn.linear(lp["k"], h).reshape(b, s, cfg.num_kv_heads, hd)
+    v = nn.linear(lp["v"], h).reshape(b, s, cfg.num_kv_heads, hd)
+    q, k = _apply_mrope(q, k, cos, sin, cfg.mrope_section)
+    o = attend(q, k, v).reshape(b, s, cfg.num_heads * hd)
+    o = nn.linear(lp["o"], o)
+    o = rms_norm(o, lp["post_self_attn_ln"]["w"], cfg.rms_eps)
+    x = x + o
+    h = rms_norm(x, lp["post_attn_ln"]["w"], cfg.rms_eps)
+    gate, up = jnp.split(nn.linear(lp["gate_up"], h), 2, axis=-1)
+    mlp = nn.linear(lp["down"], up * jax.nn.silu(gate))
+    mlp = rms_norm(mlp, lp["post_mlp_ln"]["w"], cfg.rms_eps)
+    return x + mlp
+
+
+def text_forward_hidden(params, cfg: GlmPriorTextConfig, inputs,
+                        positions):
+    """Full-sequence causal forward.  ``inputs``: ids [B, S] or embeds
+    [B, S, D]; ``positions`` [B, 3, S].  Returns final-norm hidden."""
+    x = (nn.embedding(params["embed"], inputs)
+         if inputs.ndim == 2 else inputs)
+    cos, sin = _rope_tables(cfg, positions)
+
+    def attend(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    for lp in params["layers"]:
+        x = _text_layer(lp, cfg, x, cos, sin, attend)
+    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
+
+
+def lm_logits(params, hidden):
+    return nn.linear(params["lm_head"], hidden)
+
+
+# ---------------------------------------------------------- vision trunk
+def _cubic_kernel(x):
+    """torch bicubic convolution kernel (A = -0.75)."""
+    a = -0.75
+    ax = jnp.abs(x)
+    return jnp.where(
+        ax <= 1, ((a + 2) * ax - (a + 3)) * ax * ax + 1,
+        jnp.where(ax < 2, (((ax - 5) * ax + 8) * ax - 4) * a, 0.0))
+
+
+def bicubic_sample(grid, ys, xs):
+    """Sample ``grid`` [H, W, D] at continuous (ys, xs) [N] in INPUT
+    pixel coordinates, bicubic with border padding — the exact math of
+    torch ``grid_sample(mode="bicubic", align_corners=False,
+    padding_mode="border")`` after unnormalization."""
+    h, w, _ = grid.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    fy = (ys - y0)[:, None]
+    fx = (xs - x0)[:, None]
+    offs = jnp.arange(-1, 3, dtype=jnp.float32)
+    wy = _cubic_kernel(fy - offs)  # [N, 4]
+    wx = _cubic_kernel(fx - offs)
+    iy = jnp.clip(y0[:, None] + offs, 0, h - 1).astype(jnp.int32)
+    ix = jnp.clip(x0[:, None] + offs, 0, w - 1).astype(jnp.int32)
+    # [N,4,4,D] neighborhood gather, separable weights
+    patch = grid[iy[:, :, None], ix[:, None, :]]
+    return jnp.einsum("nijd,ni,nj->nd", patch.astype(jnp.float32),
+                      wy, wx)
+
+
+def _vision_pos_embed(pos_embed, cfg: GlmPriorVisionConfig, grid_h,
+                      grid_w, h_coords, w_coords):
+    """Glm4vVisionEmbeddings: resample the native pos-embed grid to the
+    actual patch grid with bicubic interpolation at patch centers."""
+    g = cfg.pos_grid
+    table = pos_embed.reshape(g, g, -1)
+    ys = (h_coords.astype(jnp.float32) + 0.5) / grid_h * g - 0.5
+    xs = (w_coords.astype(jnp.float32) + 0.5) / grid_w * g - 0.5
+    return bicubic_sample(table, ys, xs)
+
+
+def _window_coords(grid_h: int, grid_w: int, merge: int):
+    """Patch (h, w) coordinates in spatial-merge-window order (the
+    processor's patch packing; Glm4vVisionModel.rot_pos_emb)."""
+    hh = np.arange(grid_h)[:, None] * np.ones((1, grid_w), np.int32)
+    ww = np.ones((grid_h, 1), np.int32) * np.arange(grid_w)[None, :]
+
+    def windowed(m2d):
+        return (m2d.reshape(grid_h // merge, merge, grid_w // merge,
+                            merge)
+                .transpose(0, 2, 1, 3).reshape(-1))
+
+    return windowed(hh), windowed(ww)
+
+
+def vision_forward(params, cfg: GlmPriorVisionConfig, patches,
+                   grid_h: int, grid_w: int):
+    """One image's flat patches [S, in*tps*ps*ps] (merge-window order)
+    -> merged features [S/merge^2, out_hidden].  Mirrors
+    Glm4vVisionModel.forward for a single (t=1, h, w) grid."""
+    m = cfg.spatial_merge_size
+    hd = cfg.head_dim
+    x = nn.linear(params["patch_proj"], patches)  # [S, D]
+    x = rms_norm(x, params["post_conv_norm"]["w"], cfg.rms_eps)
+    h_co, w_co = _window_coords(grid_h, grid_w, m)
+    x = x + _vision_pos_embed(
+        params["pos_embed"], cfg, grid_h, grid_w,
+        jnp.asarray(h_co), jnp.asarray(w_co)).astype(x.dtype)
+
+    # 2-axis rope at half head_dim each (Glm4vVisionRotaryEmbedding:
+    # inv_freq over head_dim//2, h- and w-frequencies concatenated)
+    dim = hd // 2
+    inv = 1.0 / (10000.0 ** (
+        jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    fh = jnp.asarray(h_co, jnp.float32)[:, None] * inv
+    fw = jnp.asarray(w_co, jnp.float32)[:, None] * inv
+    emb = jnp.concatenate([fh, fw, fh, fw], axis=-1)  # [S, hd]
+    cos, sin = jnp.cos(emb), jnp.sin(emb)
+
+    def rot_half(t):
+        t1, t2 = jnp.split(t, 2, axis=-1)
+        return jnp.concatenate([-t2, t1], axis=-1)
+
+    s = x.shape[0]
+    for blk in params["blocks"]:
+        h = rms_norm(x, blk["norm1"]["w"], cfg.rms_eps)
+        qkv = nn.linear(blk["qkv"], h).reshape(s, 3, cfg.num_heads, hd)
+        q, k, v = (qkv[:, 0], qkv[:, 1], qkv[:, 2])
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        c4 = cos[:, None, :]
+        s4 = sin[:, None, :]
+        q = (qf * c4 + rot_half(qf) * s4).astype(x.dtype)
+        k = (kf * c4 + rot_half(kf) * s4).astype(x.dtype)
+        o = flash_attention(q[None], k[None], v[None], causal=False)
+        x = x + nn.linear(blk["proj"], o[0].reshape(s, -1))
+        h = rms_norm(x, blk["norm2"]["w"], cfg.rms_eps)
+        x = x + nn.linear(blk["down"], jax.nn.silu(
+            nn.linear(blk["gate"], h)) * nn.linear(blk["up"], h))
+
+    x = rms_norm(x, params["post_norm"]["w"], cfg.rms_eps)
+    # spatial-merge downsample: window [m, m, D] -> (D, m, m)-ordered
+    # conv flatten (torch Conv2d stride=kernel) -> out_hidden
+    x = x.reshape(-1, m, m, cfg.hidden_size).transpose(0, 3, 1, 2)
+    x = nn.linear(params["downsample"], x.reshape(x.shape[0], -1))
+    mg = params["merger"]
+    x = nn.linear(mg["proj"], x)
+    x = jax.nn.gelu(nn.layernorm(mg["ln"], x, eps=1e-5),
+                    approximate=False)
+    return nn.linear(mg["down"], jax.nn.silu(
+        nn.linear(mg["gate"], x)) * nn.linear(mg["up"], x))
+
+
+def get_image_tokens(params, cfg: GlmPriorConfig, feats):
+    """Map pooled vision features [N, D] to prior ids [N] by nearest
+    codebook row — the image-id block of the LM embedding matrix
+    (reference get_image_tokens, pipeline_glm_image.py:496)."""
+    book = jax.lax.dynamic_slice_in_dim(
+        params["lm"]["embed"]["w"], cfg.image_start_id, cfg.image_vocab,
+        axis=0).astype(jnp.float32)
+    f = feats.astype(jnp.float32)
+    # argmin ||f - c||^2 = argmax (f.c - ||c||^2 / 2)
+    scores = f @ book.T - 0.5 * jnp.sum(book * book, axis=-1)[None, :]
+    return jnp.argmax(scores, axis=-1).astype(jnp.int32)
+
+
+# ------------------------------------------------------------ AR rollout
+def _image_block_positions(start: int, h: int, w: int):
+    """Qwen2-VL-convention 3-D positions for one h x w image grid whose
+    streams start at ``start``: t constant, h by row, w by col."""
+    t = np.full((h * w,), start, np.int32)
+    hh = start + np.repeat(np.arange(h, dtype=np.int32), w)
+    ww = start + np.tile(np.arange(w, dtype=np.int32), h)
+    return np.stack([t, hh, ww]), start + max(h, w)
+
+
+def rollout_positions(prompt_bucket: int, prompt_len: int,
+                      grids: list) -> np.ndarray:
+    """[3, prompt_bucket + sum(h*w)] positions: 1-D text (padding slots
+    past ``prompt_len`` continue the arange — their K/V are masked out
+    of every attention), then one 3-D block per generated grid starting
+    where the REAL prompt ended."""
+    segs = [np.broadcast_to(np.arange(prompt_bucket, dtype=np.int32),
+                            (3, prompt_bucket))]
+    nxt = prompt_len
+    for h, w in grids:
+        block, nxt = _image_block_positions(nxt, h, w)
+        segs.append(block)
+    return np.concatenate(segs, axis=1)
+
+
+def make_generate(cfg: GlmPriorConfig, prompt_bucket: int, n_gen: int):
+    """Jitted KV-cached greedy/sampled rollout of ``n_gen`` image tokens
+    after a prefill of up to ``prompt_bucket`` prompt tokens (the REAL
+    length rides in as the dynamic ``prompt_len`` — prompts right-pad to
+    the bucket so novel lengths reuse one executable instead of paying a
+    full-trunk recompile each).  Returns ids REBASED to [0, image_vocab)
+    (logits are masked to the image-id range — the trunk was trained to
+    emit image ids here; masking makes the guarantee structural)."""
+    tcfg = cfg.text
+    total = prompt_bucket + n_gen
+    hd, kvh = tcfg.head_dim, tcfg.num_kv_heads
+
+    @jax.jit
+    def gen(params, prompt_ids, prompt_len, positions, temperature,
+            key):
+        lm = params["lm"]
+        b = prompt_ids.shape[0]
+        cos_all, sin_all = _rope_tables(
+            cfg.text, jnp.broadcast_to(positions[None], (b, 3, total)))
+
+        # ---- prefill: full causal forward, collecting per-layer K/V
+        # (right-padding is invisible to real tokens under causality;
+        # the pad slots' K/V are masked out of decode attention below)
+        x = nn.embedding(lm["embed"], prompt_ids)
+        cos_p = cos_all[:, :, :prompt_bucket]
+        sin_p = sin_all[:, :, :prompt_bucket]
+        caches_k, caches_v = [], []
+
+        def attend_collect(q, k, v):
+            kb = jnp.zeros((b, total, kvh, hd), q.dtype)
+            vb = jnp.zeros((b, total, kvh, hd), q.dtype)
+            caches_k.append(kb.at[:, :prompt_bucket].set(k))
+            caches_v.append(vb.at[:, :prompt_bucket].set(v))
+            return flash_attention(q, k, v, causal=True)
+
+        for lp in lm["layers"]:
+            x = _text_layer(lp, tcfg, x, cos_p, sin_p, attend_collect)
+        x = rms_norm(x, lm["final_norm"]["w"], tcfg.rms_eps)
+        k_cache = jnp.stack(caches_k)  # [L, B, T, kvh, hd]
+        v_cache = jnp.stack(caches_v)
+
+        lo = cfg.image_start_id
+        allow = jnp.zeros((tcfg.vocab_size,), bool).at[
+            lo:lo + cfg.image_vocab].set(True)
+
+        def pick(logits, k):
+            masked = jnp.where(allow[None, :], logits, -jnp.inf)
+            greedy = jnp.argmax(masked, axis=-1)
+            sampled = jax.random.categorical(
+                k, masked / jnp.maximum(temperature, 1e-6))
+            return jnp.where(temperature > 0, sampled,
+                             greedy).astype(jnp.int32)
+
+        key, sub = jax.random.split(key)
+        # logits at the LAST REAL prompt token, not the padded tail
+        x_last = jnp.take(x, prompt_len - 1, axis=1)
+        first = pick(lm_logits(lm, x_last), sub)
+
+        def step(i, carry):
+            k_cache, v_cache, tok, out, kk = carry
+            pos = prompt_bucket + i
+            x = nn.embedding(lm["embed"], tok[:, None])  # [B,1,D]
+            cos_i = jax.lax.dynamic_slice_in_dim(cos_all, pos, 1, axis=2)
+            sin_i = jax.lax.dynamic_slice_in_dim(sin_all, pos, 1, axis=2)
+            ar = jnp.arange(total)
+            # real prompt + already-generated tokens; pad slots excluded
+            valid = (ar < prompt_len) | ((ar >= prompt_bucket)
+                                         & (ar <= pos))
+            groups = tcfg.num_heads // kvh
+
+            nk, nv = [], []
+
+            def attend_cached(li):
+                def attend(q, kq, vq):
+                    # q [B,1,H,hd]; cache [B,T,kvh,hd] updated at pos
+                    kc = jax.lax.dynamic_update_slice_in_dim(
+                        k_cache[li], kq, pos, axis=1)
+                    vc = jax.lax.dynamic_update_slice_in_dim(
+                        v_cache[li], vq, pos, axis=1)
+                    nk.append(kc)
+                    nv.append(vc)
+                    qh = q[:, 0].reshape(b, kvh, groups, hd)
+                    s = jnp.einsum(
+                        "bkgh,btkh->bkgt", qh.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / np.sqrt(hd)
+                    s = jnp.where(valid[None, None, None, :], s,
+                                  -jnp.inf)
+                    p = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("bkgt,btkh->bkgh", p,
+                                   vc.astype(jnp.float32))
+                    return o.reshape(b, 1, kvh * groups,
+                                     hd).astype(q.dtype)
+
+                return attend
+
+            for li, lp in enumerate(lm["layers"]):
+                x = _text_layer(lp, tcfg, x, cos_i, sin_i,
+                                attend_cached(li))
+            x = rms_norm(x, lm["final_norm"]["w"], tcfg.rms_eps)
+            kk, sub = jax.random.split(kk)
+            nxt_tok = pick(lm_logits(lm, x[:, -1]), sub)
+            out = out.at[:, i].set(tok)
+            return (jnp.stack(nk), jnp.stack(nv), nxt_tok, out, kk)
+
+        out = jnp.zeros((b, n_gen), jnp.int32)
+        _, _, _, out, _ = jax.lax.fori_loop(
+            0, n_gen, step, (k_cache, v_cache, first, out, key))
+        return out - lo  # rebase into [0, image_vocab)
+
+    return gen
+
+
+class GlmImagePrior:
+    """The loaded prior VLM + its rollout entry point (the in-pipeline
+    replacement for the reference's ``vision_language_encoder``).
+
+    Params may live on the OWNER (the pipeline keeps the tree in a
+    ``param_attrs`` slot so engine.sleep()/wake() can offload it) — the
+    public methods accept an explicit ``params`` tree and fall back to
+    the one given at construction."""
+
+    def __init__(self, params, cfg: GlmPriorConfig, tokenizer=None):
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self._gen_cache: dict = {}
+        self._vision_jit_cache: dict = {}
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
+                        tokenizer=None) -> "GlmImagePrior":
+        params, cfg = load_glm_prior(model_dir, dtype=dtype)
+        return cls(params, cfg, tokenizer=tokenizer)
+
+    def encode_prompt(self, prompt: str) -> np.ndarray:
+        """Chat-template the prompt when the tokenizer carries one
+        (reference: processor.apply_chat_template,
+        pipeline_glm_image.py:469); plain encode otherwise."""
+        tok = self.tokenizer
+        if tok is None:
+            raise RuntimeError("prior rollout needs a tokenizer")
+        if getattr(tok, "chat_template", None):
+            ids = tok.apply_chat_template(
+                [{"role": "user", "content": prompt}],
+                add_generation_prompt=True)
+            return np.asarray(ids, np.int32)
+        return np.asarray(
+            tok(prompt)["input_ids"], np.int32)
+
+    def generate_prior_tokens(self, prompt: str, token_h: int,
+                              token_w: int, temperature: float = 0.0,
+                              seed: int = 0, params=None) -> np.ndarray:
+        """Text-to-image rollout: a half-res preview grid then the
+        target grid (reference _compute_generation_params t2i branch);
+        returns the TARGET grid ids [token_h * token_w] in
+        [0, image_vocab)."""
+        params = self.params if params is None else params
+        ids = self.encode_prompt(prompt)
+        grids = []
+        if token_h % 2 == 0 and token_w % 2 == 0:
+            grids.append((token_h // 2, token_w // 2))
+        grids.append((token_h, token_w))
+        n_prev = sum(h * w for h, w in grids[:-1])
+        n_gen = n_prev + token_h * token_w
+        # bucket the prompt so novel lengths share one executable (the
+        # 40-layer trunk recompiles cost minutes each otherwise)
+        bucket = max(32, -(-len(ids) // 32) * 32)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:len(ids)] = ids
+        positions = rollout_positions(bucket, len(ids), grids)
+        key = (bucket, n_gen)
+        if key not in self._gen_cache:
+            self._gen_cache[key] = make_generate(self.cfg, bucket, n_gen)
+        out = self._gen_cache[key](
+            params, jnp.asarray(padded)[None], jnp.int32(len(ids)),
+            jnp.asarray(positions), jnp.float32(temperature),
+            jax.random.PRNGKey(seed))
+        return np.asarray(out[0, n_prev:])
+
+    def condition_image_tokens(self, patches, grid_h: int,
+                               grid_w: int, params=None) -> np.ndarray:
+        """Condition-image path: vision tower -> codebook lookup
+        (reference pipeline_glm_image.py:486-509), ids at the merged
+        grid, in [0, image_vocab)."""
+        params = self.params if params is None else params
+        if self.cfg.vision is None:
+            raise RuntimeError("checkpoint has no vision tower")
+        key = (grid_h, grid_w)
+        if key not in self._vision_jit_cache:
+            vcfg = self.cfg.vision
+
+            @jax.jit
+            def run(p, patches):
+                feats = vision_forward(p["visual"], vcfg, patches,
+                                       grid_h, grid_w)
+                return get_image_tokens(p, self.cfg, feats)
+
+            self._vision_jit_cache[key] = run
+        return np.asarray(
+            self._vision_jit_cache[key](params, patches))
+
+
+# ------------------------------------------------------------------ loader
+def _prior_routing(cfg: GlmPriorConfig) -> dict:
+    routing = {}
+
+    def lin(hf, *path, bias=True):
+        routing[f"{hf}.weight"] = ("direct", (*path, "w"))
+        if bias:
+            routing[f"{hf}.bias"] = ("direct", (*path, "b"))
+
+    t = cfg.text
+    for i in range(t.num_layers):
+        hf = f"model.language_model.layers.{i}"
+        p = ("lm", "layers", i)
+        lin(f"{hf}.self_attn.q_proj", *p, "q")
+        lin(f"{hf}.self_attn.k_proj", *p, "k")
+        lin(f"{hf}.self_attn.v_proj", *p, "v")
+        lin(f"{hf}.self_attn.o_proj", *p, "o", bias=False)
+        lin(f"{hf}.mlp.gate_up_proj", *p, "gate_up", bias=False)
+        lin(f"{hf}.mlp.down_proj", *p, "down", bias=False)
+        for hf_n, ours in (
+                ("input_layernorm", "input_ln"),
+                ("post_attention_layernorm", "post_attn_ln"),
+                ("post_self_attn_layernorm", "post_self_attn_ln"),
+                ("post_mlp_layernorm", "post_mlp_ln")):
+            routing[f"{hf}.{hf_n}.weight"] = ("raw", (*p, ours, "w"))
+    routing["model.language_model.embed_tokens.weight"] = (
+        "raw", ("lm", "embed", "w"))
+    routing["model.language_model.norm.weight"] = (
+        "raw", ("lm", "final_norm", "w"))
+    routing["lm_head.weight"] = ("direct", ("lm", "lm_head", "w"))
+
+    if cfg.vision is not None:
+        v = cfg.vision
+        for i in range(v.depth):
+            hf = f"model.visual.blocks.{i}"
+            p = ("visual", "blocks", i)
+            lin(f"{hf}.attn.qkv", *p, "qkv", bias=False)
+            lin(f"{hf}.attn.proj", *p, "proj", bias=False)
+            lin(f"{hf}.mlp.gate_proj", *p, "gate", bias=False)
+            lin(f"{hf}.mlp.up_proj", *p, "up", bias=False)
+            lin(f"{hf}.mlp.down_proj", *p, "down", bias=False)
+            routing[f"{hf}.norm1.weight"] = ("raw", (*p, "norm1", "w"))
+            routing[f"{hf}.norm2.weight"] = ("raw", (*p, "norm2", "w"))
+        lin("model.visual.patch_embed.proj", "visual", "patch_proj")
+        lin("model.visual.downsample", "visual", "downsample")
+        routing["model.visual.embeddings.position_embedding.weight"] = (
+            "raw", ("visual", "pos_embed"))
+        for hf_n, ours in (("post_conv_layernorm", "post_conv_norm"),
+                           ("post_layernorm", "post_norm")):
+            routing[f"model.visual.{hf_n}.weight"] = (
+                "raw", ("visual", ours, "w"))
+        m = "model.visual.merger"
+        lin(f"{m}.proj", "visual", "merger", "proj", bias=False)
+        lin(f"{m}.gate_proj", "visual", "merger", "gate", bias=False)
+        lin(f"{m}.up_proj", "visual", "merger", "up", bias=False)
+        lin(f"{m}.down_proj", "visual", "merger", "down", bias=False)
+        routing[f"{m}.post_projection_norm.weight"] = (
+            "raw", ("visual", "merger", "ln", "w"))
+        routing[f"{m}.post_projection_norm.bias"] = (
+            "raw", ("visual", "merger", "ln", "b"))
+    return routing
+
+
+def load_glm_prior(model_dir: str, cfg: GlmPriorConfig = None,
+                   dtype=jnp.bfloat16):
+    """Load the AR prior from ``vision_language_encoder/`` at the
+    published GLM-4.1V names (model.visual.* / model.language_model.* /
+    lm_head)."""
+    from vllm_omni_tpu.models.flux.loader import load_routed
+
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = GlmPriorConfig.from_hf(json.load(f))
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, dtype))
+
+    transforms = {}
+    if cfg.vision is not None:
+        v = cfg.vision
+
+        def conv3d_flat(arr):  # [D, C, tps, ps, ps] -> [in, D]
+            return np.ascontiguousarray(
+                arr.reshape(arr.shape[0], -1).T)
+
+        def conv2d_flat(arr):  # [out, D, m, m] -> [D*m*m, out]
+            return np.ascontiguousarray(
+                arr.reshape(arr.shape[0], -1).T)
+
+        transforms["model.visual.patch_embed.proj.weight"] = conv3d_flat
+        transforms["model.visual.downsample.weight"] = conv2d_flat
+
+    params = load_routed(model_dir, _prior_routing(cfg), shapes, dtype,
+                         transforms=transforms)
+    logger.info("loaded GLM-Image AR prior: %d-layer LM%s",
+                cfg.text.num_layers,
+                "" if cfg.vision is None
+                else f" + {cfg.vision.depth}-block vision tower")
+    return params, cfg
