@@ -497,7 +497,7 @@ def main():
             "skipped": f"flagship itself ran {ran_quant}-quantized "
                        "streaming (bf16 streaming was infeasible or "
                        "OMNI_BENCH_QUANT forced the mode)"}
-    elif ran_size == "real" and ran_quant == "":
+    else:  # flagship ran real bf16 streaming — run the int8 companion
         q_remaining = _budget_s() - (time.time() - _T0)
         est_q = flagship.get("seconds_per_image", 1e9) * 0.55 + 180
         if os.environ.get("OMNI_BENCH_SKIP_QUANT_VARIANT", "") == "1":

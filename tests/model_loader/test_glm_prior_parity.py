@@ -252,6 +252,44 @@ def test_rollout_matches_oracle_greedy_first_token(oracle_and_dir,
     assert out[0] == expect
 
 
+def test_lm_only_load_defers_vision(oracle_and_dir):
+    """vision=False loads the LM alone (the pipeline's serving path —
+    t2i rollout is text-only); load_vision() completes the tree."""
+    _, d = oracle_and_dir
+    params, cfg = gp.load_glm_prior(d, dtype=jnp.float32, vision=False)
+    assert "visual" not in params and "lm" in params
+    prior = gp.GlmImagePrior(params, cfg, model_dir=d)
+    with pytest.raises(RuntimeError, match="vision tower not loaded"):
+        prior.condition_image_tokens(jnp.zeros((4, 588)), 2, 2)
+    full = prior.load_vision(dtype=jnp.float32)
+    assert "visual" in full
+
+
+def test_batched_greedy_matches_per_prompt(loaded):
+    """Stacked same-length greedy rollouts must equal individual runs
+    (the batching is a pure stacking, not an approximation)."""
+    params, cfg = loaded
+
+    class Tok:
+        chat_template = None
+
+        def __call__(self, text):
+            return {"input_ids": [3 + (ord(c) % 50) for c in text]}
+
+    prior = gp.GlmImagePrior(params, cfg, tokenizer=Tok())
+    prompts = ["abcd", "wxyz"]  # same length -> one stacked call
+    batch = prior.generate_prior_tokens_batch(prompts, 2, 2)
+    for i, p in enumerate(prompts):
+        solo = prior.generate_prior_tokens(p, 2, 2)
+        np.testing.assert_array_equal(batch[i], solo)
+    # mixed lengths group correctly too
+    mixed = prior.generate_prior_tokens_batch(["abcd", "uv"], 2, 2)
+    np.testing.assert_array_equal(
+        mixed[0], prior.generate_prior_tokens("abcd", 2, 2))
+    np.testing.assert_array_equal(
+        mixed[1], prior.generate_prior_tokens("uv", 2, 2))
+
+
 def test_condition_image_tokens_roundtrip(loaded):
     """Features equal to codebook rows must map to exactly those ids
     (nearest-neighbour correctness)."""

@@ -1,0 +1,177 @@
+"""Hunyuan bot_task text modes (VERDICT r4 ask #4): think / recaption /
+img_ratio over the in-tree MoE trunk — the reference's ``gen_text`` mode
+(pipeline_hunyuan_image_3.py:545, tokenizer bot_response_prefix
+:1036-1043, stop sets :616-622, img_ratio max_new_tokens=1 :602)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.diffusion.request import (
+    InvalidRequestError,
+    OmniDiffusionRequest,
+    OmniDiffusionSamplingParams,
+)
+from vllm_omni_tpu.models.hunyuan_image_3 import transformer as ht
+from vllm_omni_tpu.models.hunyuan_image_3.pipeline import (
+    HunyuanImage3Pipeline,
+    HunyuanImage3PipelineConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    return HunyuanImage3Pipeline(HunyuanImage3PipelineConfig.tiny(),
+                                 dtype=jnp.float32, seed=0)
+
+
+def _full_greedy(params, cfg, ids_row, n_gen):
+    """Naive oracle: grow the sequence, full causal recompute each
+    token, greedy argmax — the KV-cached rollout must match exactly."""
+    seq = list(ids_row)
+    out = []
+    for _ in range(n_gen):
+        cos, sin = ht.rope_2d_table(
+            ht.diagonal_positions(0, len(seq)), cfg.head_dim,
+            cfg.rope_theta)
+        ids = jnp.asarray([seq], jnp.int32)
+        mask = jnp.ones((1, len(seq)), jnp.int32)
+        # prefill computes per-layer KV AND the running hidden; reuse
+        # its exact math by replaying through the public pieces
+        from vllm_omni_tpu.models.common import nn as cnn
+        from vllm_omni_tpu.ops import rms_norm
+
+        x = cnn.embedding(params["embed"], ids)
+        s = len(seq)
+        causal = jnp.arange(s)[None, :] <= jnp.arange(s)[:, None]
+        bias = jnp.where(causal[None], 0.0, -1e30)[:, None]
+        for li, layer in enumerate(params["layers"]):
+            q, k, v = ht._qkv(layer, cfg, x, jnp.asarray(cos),
+                              jnp.asarray(sin))
+            o = cnn.bias_attention(q, k, v, bias)
+            x = x + cnn.linear(layer["o_proj"], o.reshape(1, s, -1))
+            x = x + ht._mlp(layer, cfg, x, cfg.is_moe_layer(li))
+        h = rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
+        logits = ht.text_logits(params, h[:, -1])
+        tok = int(jnp.argmax(logits, axis=-1)[0])
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def test_rollout_matches_full_recompute(pipe):
+    """The KV-cached bucketed rollout must be token-identical to naive
+    full recompute — for DIFFERENT per-row context lengths in one batch
+    (exercises pad masking and per-row rope continuation)."""
+    cfg = pipe.cfg.llm
+    params = pipe.dit_params["llm"]
+    rows = [[1, 9, 4, 7, 2], [3, 8, 5]]
+    n_gen = 4
+    bucket = 8
+    ids = np.zeros((2, bucket), np.int32)
+    for i, r in enumerate(rows):
+        ids[i, :len(r)] = r
+    cos, sin = ht.rope_2d_table(
+        ht.diagonal_positions(0, bucket + n_gen), cfg.head_dim,
+        cfg.rope_theta)
+    gen = ht.make_gen_text(cfg, bucket, n_gen)
+    got = np.asarray(gen(
+        params, jnp.asarray(ids), jnp.asarray([5, 3], jnp.int32),
+        jnp.asarray(cos), jnp.asarray(sin), jnp.float32(0.0),
+        jax.random.PRNGKey(0)))
+    for i, r in enumerate(rows):
+        want = _full_greedy(params, cfg, r, n_gen)
+        np.testing.assert_array_equal(got[i], want)
+
+
+@pytest.mark.parametrize("task", ["think", "recaption"])
+def test_text_modes_produce_text(pipe, task):
+    outs = pipe.gen_text(["a cat", "a dog"], bot_task=task,
+                         max_new_tokens=6)
+    assert len(outs) == 2
+    assert all(isinstance(t, str) for t in outs)
+    again = pipe.gen_text(["a cat", "a dog"], bot_task=task,
+                          max_new_tokens=6)
+    assert outs == again  # greedy => deterministic
+
+
+def test_img_ratio_mode(pipe):
+    outs = pipe.gen_text(["a wide banner"], bot_task="img_ratio")
+    (r,) = outs
+    assert set(r) == {"ratio_index", "height", "width"}
+    assert 0 <= r["ratio_index"] < len(pipe.resolutions)
+    assert (r["height"], r["width"]) \
+        == pipe.resolutions.data[r["ratio_index"]]
+
+
+def test_bot_task_through_forward(pipe):
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=1, guidance_scale=1.0,
+        seed=0, extra={"bot_task": "think", "max_new_tokens": 4})
+    outs = pipe.forward(OmniDiffusionRequest(
+        prompt=["why is the sky blue"], sampling_params=sp,
+        request_ids=["r0"]))
+    assert outs[0].output_type == "text"
+    assert isinstance(outs[0].data, str)
+
+    sp2 = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=1, guidance_scale=1.0,
+        seed=0, extra={"bot_task": "img_ratio"})
+    outs2 = pipe.forward(OmniDiffusionRequest(
+        prompt=["a tall poster"], sampling_params=sp2,
+        request_ids=["r1"]))
+    assert outs2[0].output_type == "text"
+    assert "ratio_index" in outs2[0].data
+
+
+def test_unknown_bot_task_rejected(pipe):
+    with pytest.raises(InvalidRequestError, match="bot_task"):
+        pipe.gen_text(["x"], bot_task="paint")
+
+
+def test_lm_head_loads_when_present(tmp_path):
+    """A checkpoint shipping lm_head.weight must load it untied;
+    text_logits then uses it instead of the tied embedding."""
+    from safetensors.numpy import save_file
+
+    from vllm_omni_tpu.models.hunyuan_image_3 import loader as hl
+
+    cfg = ht.HunyuanImage3Config.tiny(moe=False)
+    params = ht.init_params(jax.random.PRNGKey(0), cfg, jnp.float32,
+                            lm_head=True)
+    sd = {
+        "model.wte.weight": np.asarray(params["embed"]["w"]),
+        "model.ln_f.weight": np.asarray(params["final_norm"]["w"]),
+        "lm_head.weight": np.ascontiguousarray(
+            np.asarray(params["lm_head"]["w"]).T),
+    }
+    for i, layer in enumerate(params["layers"]):
+        b = f"model.layers.{i}"
+        sd[f"{b}.input_layernorm.weight"] = np.asarray(
+            layer["input_norm"]["w"])
+        sd[f"{b}.post_attention_layernorm.weight"] = np.asarray(
+            layer["post_norm"]["w"])
+        for k in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[f"{b}.self_attn.{k}.weight"] = np.ascontiguousarray(
+                np.asarray(layer[k]["w"]).T)
+        sd[f"{b}.mlp.gate_and_up_proj.weight"] = np.ascontiguousarray(
+            np.concatenate(
+                [np.asarray(layer["gate_up"]["w"])[
+                    :, cfg.intermediate_size:],
+                 np.asarray(layer["gate_up"]["w"])[
+                    :, :cfg.intermediate_size]], axis=1).T)
+        sd[f"{b}.mlp.down_proj.weight"] = np.ascontiguousarray(
+            np.asarray(layer["down"]["w"]).T)
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    loaded, _ = hl.load_hunyuan_lm(str(tmp_path), cfg=cfg,
+                                   dtype=jnp.float32)
+    assert "lm_head" in loaded
+    np.testing.assert_allclose(
+        np.asarray(loaded["lm_head"]["w"]),
+        np.asarray(params["lm_head"]["w"]), atol=1e-6)
+    h = jnp.ones((1, cfg.hidden_size), jnp.float32)
+    tied = h @ loaded["embed"]["w"].T
+    untied = ht.text_logits(loaded, h)
+    assert not np.allclose(np.asarray(untied), np.asarray(tied))

@@ -228,7 +228,11 @@ class GlmImagePipeline:
                     break
                 except Exception:
                     continue
-            prior_params, prior_cfg = load_glm_prior(vle, dtype=dtype)
+            # LM only: the t2i rollout is text-only; the 24-block
+            # vision tower stays on disk until a condition-image
+            # request needs it (GlmImagePrior.load_vision)
+            prior_params, prior_cfg = load_glm_prior(vle, dtype=dtype,
+                                                     vision=False)
             if prior_cfg.image_vocab != real_cfg.prior_vocab:
                 # fail at LOAD, not after a per-request AR rollout
                 raise ValueError(
@@ -236,7 +240,8 @@ class GlmImagePipeline:
                     f"prior_vocab {real_cfg.prior_vocab} — mismatched "
                     "checkpoint components")
             pipe.prior_vlm = GlmImagePrior(None, prior_cfg,
-                                           tokenizer=ptok)
+                                           tokenizer=ptok,
+                                           model_dir=vle)
             pipe.prior_vlm_params = pipe.wiring.place(prior_params)
             if ptok is None:
                 logger.warning(
@@ -455,24 +460,26 @@ class GlmImagePipeline:
                     f"prior_token_ids must be [B, {seq_len}] at the DiT "
                     f"grid; got {tuple(prior_ids.shape)}")
         elif (self.prior_vlm is not None
-              and self.prior_vlm.tokenizer is not None
-              and grid_h % 2 == 0 and grid_w % 2 == 0):
+              and self.prior_vlm.tokenizer is not None):
             # real AR prior VLM in-pipeline (reference
             # generate_prior_tokens, pipeline_glm_image.py:434-525):
             # rollout at the d32 grid (half the d16 DiT grid), 2x
-            # nearest-upsample to the DiT grid
-            ph, pw = grid_h // 2, grid_w // 2
+            # nearest-upsample; ODD DiT grids roll out at full res and
+            # skip the upsample (still the real prior — never the
+            # random fallback)
+            if grid_h % 2 == 0 and grid_w % 2 == 0:
+                ph, pw, up2 = grid_h // 2, grid_w // 2, True
+            else:
+                ph, pw, up2 = grid_h, grid_w, False
             extra = sp.extra if hasattr(sp, "extra") and sp.extra else {}
             temp = float(extra.get("prior_temperature", 0.0))
             base_seed = sp.seed if sp.seed is not None else 0
-            rows = [
-                self.prior_vlm.generate_prior_tokens(
-                    ptxt, ph, pw, temperature=temp,
-                    seed=base_seed + i, params=self.prior_vlm_params)
-                for i, ptxt in enumerate(prompts)
-            ]
+            rows = self.prior_vlm.generate_prior_tokens_batch(
+                list(prompts), ph, pw, temperature=temp,
+                seed=base_seed, params=self.prior_vlm_params)
             small = jnp.asarray(np.stack(rows), jnp.int32)
-            prior_ids = self.upsample_prior_ids(small, ph, pw)
+            prior_ids = (self.upsample_prior_ids(small, ph, pw)
+                         if up2 else small)
         else:
             seed_ids = jnp.asarray(
                 np.asarray(ids)[:, :8] % cfg.prior_lm.vocab_size,

@@ -640,18 +640,37 @@ class GlmImagePrior:
     public methods accept an explicit ``params`` tree and fall back to
     the one given at construction."""
 
-    def __init__(self, params, cfg: GlmPriorConfig, tokenizer=None):
+    def __init__(self, params, cfg: GlmPriorConfig, tokenizer=None,
+                 model_dir: str = None):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
+        self.model_dir = model_dir  # enables deferred vision load
         self._gen_cache: dict = {}
         self._vision_jit_cache: dict = {}
 
     @classmethod
     def from_pretrained(cls, model_dir: str, dtype=jnp.bfloat16,
-                        tokenizer=None) -> "GlmImagePrior":
-        params, cfg = load_glm_prior(model_dir, dtype=dtype)
-        return cls(params, cfg, tokenizer=tokenizer)
+                        tokenizer=None,
+                        vision: bool = True) -> "GlmImagePrior":
+        params, cfg = load_glm_prior(model_dir, dtype=dtype,
+                                     vision=vision)
+        return cls(params, cfg, tokenizer=tokenizer,
+                   model_dir=model_dir)
+
+    def load_vision(self, params=None, dtype=jnp.bfloat16):
+        """Late-load the vision tower into a params tree that was built
+        with ``vision=False`` (returns the updated tree — the caller
+        owns placement)."""
+        params = self.params if params is None else params
+        if "visual" in params:
+            return params
+        if self.model_dir is None:
+            raise RuntimeError("no model_dir recorded for deferred "
+                               "vision load")
+        full, _ = load_glm_prior(self.model_dir, cfg=self.cfg,
+                                 dtype=dtype, vision=True)
+        return {**params, "visual": full["visual"]}
 
     def encode_prompt(self, prompt: str) -> np.ndarray:
         """Chat-template the prompt when the tokenizer carries one
@@ -672,31 +691,74 @@ class GlmImagePrior:
                               token_w: int, temperature: float = 0.0,
                               seed: int = 0, params=None) -> np.ndarray:
         """Text-to-image rollout: a half-res preview grid then the
-        target grid (reference _compute_generation_params t2i branch);
-        returns the TARGET grid ids [token_h * token_w] in
-        [0, image_vocab)."""
+        target grid (reference _compute_generation_params t2i branch;
+        odd grids skip the preview); returns the TARGET grid ids
+        [token_h * token_w] in [0, image_vocab)."""
+        return self.generate_prior_tokens_batch(
+            [prompt], token_h, token_w, temperature=temperature,
+            seed=seed, params=params)[0]
+
+    def generate_prior_tokens_batch(self, prompts: list, token_h: int,
+                                    token_w: int,
+                                    temperature: float = 0.0,
+                                    seed: int = 0,
+                                    params=None) -> list:
+        """Batched rollout: prompts sharing a length bucket stack into
+        ONE gen() call (exact for greedy — the default; temperature>0
+        keeps the per-prompt seed convention, so sampled rows run
+        individually)."""
         params = self.params if params is None else params
-        ids = self.encode_prompt(prompt)
         grids = []
         if token_h % 2 == 0 and token_w % 2 == 0:
             grids.append((token_h // 2, token_w // 2))
         grids.append((token_h, token_w))
         n_prev = sum(h * w for h, w in grids[:-1])
         n_gen = n_prev + token_h * token_w
-        # bucket the prompt so novel lengths share one executable (the
+
+        encoded = [np.asarray(self.encode_prompt(p), np.int32)
+                   for p in prompts]
+        # bucket prompts so novel lengths share one executable (the
         # 40-layer trunk recompiles cost minutes each otherwise)
-        bucket = max(32, -(-len(ids) // 32) * 32)
-        padded = np.zeros((bucket,), np.int32)
-        padded[:len(ids)] = ids
-        positions = rollout_positions(bucket, len(ids), grids)
-        key = (bucket, n_gen)
-        if key not in self._gen_cache:
-            self._gen_cache[key] = make_generate(self.cfg, bucket, n_gen)
-        out = self._gen_cache[key](
-            params, jnp.asarray(padded)[None], jnp.int32(len(ids)),
-            jnp.asarray(positions), jnp.float32(temperature),
-            jax.random.PRNGKey(seed))
-        return np.asarray(out[0, n_prev:])
+        buckets = [max(32, -(-len(e) // 32) * 32) for e in encoded]
+
+        def run(idx_group, bucket, run_seed):
+            # gen() shares one dynamic prompt_len + positions array per
+            # call, so stacked rows must agree on the REAL length
+            # (callers group by it)
+            rows = [encoded[i] for i in idx_group]
+            b = len(rows)
+            padded = np.zeros((b, bucket), np.int32)
+            for j, r in enumerate(rows):
+                padded[j, :len(r)] = r
+            positions = rollout_positions(bucket, len(rows[0]), grids)
+            key = (bucket, n_gen)
+            if key not in self._gen_cache:
+                self._gen_cache[key] = make_generate(
+                    self.cfg, bucket, n_gen)
+            out = self._gen_cache[key](
+                params, jnp.asarray(padded),
+                jnp.int32(len(rows[0])), jnp.asarray(positions),
+                jnp.float32(temperature),
+                jax.random.PRNGKey(run_seed))
+            return np.asarray(out[:, n_prev:])
+
+        results: list = [None] * len(prompts)
+        if temperature > 0:
+            # per-row seeds keep identical prompts from sampling
+            # identical priors (the pipeline's seed+i convention)
+            for i in range(len(prompts)):
+                results[i] = run([i], buckets[i], seed + i)[0]
+            return results
+        # greedy: stack rows with the SAME real length (positions and
+        # the dynamic prompt_len are shared per call)
+        groups: dict = {}
+        for i, e in enumerate(encoded):
+            groups.setdefault((buckets[i], len(e)), []).append(i)
+        for (bucket, _), idxs in groups.items():
+            outs = run(idxs, bucket, seed)
+            for j, i in enumerate(idxs):
+                results[i] = outs[j]
+        return results
 
     def condition_image_tokens(self, patches, grid_h: int,
                                grid_w: int, params=None) -> np.ndarray:
@@ -706,6 +768,10 @@ class GlmImagePrior:
         params = self.params if params is None else params
         if self.cfg.vision is None:
             raise RuntimeError("checkpoint has no vision tower")
+        if "visual" not in params:
+            raise RuntimeError(
+                "vision tower not loaded (deferred at from_pretrained) "
+                "— call load_vision() and re-place the tree first")
         key = (grid_h, grid_w)
         if key not in self._vision_jit_cache:
             vcfg = self.cfg.vision
@@ -722,7 +788,7 @@ class GlmImagePrior:
 
 
 # ------------------------------------------------------------------ loader
-def _prior_routing(cfg: GlmPriorConfig) -> dict:
+def _prior_routing(cfg: GlmPriorConfig, include_vision: bool) -> dict:
     routing = {}
 
     def lin(hf, *path, bias=True):
@@ -752,7 +818,7 @@ def _prior_routing(cfg: GlmPriorConfig) -> dict:
         "raw", ("lm", "final_norm", "w"))
     routing["lm_head.weight"] = ("direct", ("lm", "lm_head", "w"))
 
-    if cfg.vision is not None:
+    if include_vision and cfg.vision is not None:
         v = cfg.vision
         for i in range(v.depth):
             hf = f"model.visual.blocks.{i}"
@@ -785,22 +851,31 @@ def _prior_routing(cfg: GlmPriorConfig) -> dict:
 
 
 def load_glm_prior(model_dir: str, cfg: GlmPriorConfig = None,
-                   dtype=jnp.bfloat16):
+                   dtype=jnp.bfloat16, vision: bool = True):
     """Load the AR prior from ``vision_language_encoder/`` at the
     published GLM-4.1V names (model.visual.* / model.language_model.* /
-    lm_head)."""
+    lm_head).  ``vision=False`` loads the LM only — the t2i rollout is
+    text-only, so the pipeline skips the 24-block tower's HBM until a
+    condition-image request needs it (``GlmImagePrior.load_vision``)."""
     from vllm_omni_tpu.models.flux.loader import load_routed
 
     if cfg is None:
         with open(os.path.join(model_dir, "config.json")) as f:
             cfg = GlmPriorConfig.from_hf(json.load(f))
-    shapes = jax.eval_shape(
-        lambda: init_params(jax.random.PRNGKey(0), cfg, dtype))
+    include_vision = vision and cfg.vision is not None
+
+    def build():
+        p = {"lm": init_text_params(jax.random.PRNGKey(0), cfg.text,
+                                    dtype)}
+        if include_vision:
+            p["visual"] = init_vision_params(
+                jax.random.PRNGKey(0), cfg.vision, dtype)
+        return p
+
+    shapes = jax.eval_shape(build)
 
     transforms = {}
-    if cfg.vision is not None:
-        v = cfg.vision
-
+    if include_vision:
         def conv3d_flat(arr):  # [D, C, tps, ps, ps] -> [in, D]
             return np.ascontiguousarray(
                 arr.reshape(arr.shape[0], -1).T)
@@ -812,10 +887,10 @@ def load_glm_prior(model_dir: str, cfg: GlmPriorConfig = None,
         transforms["model.visual.patch_embed.proj.weight"] = conv3d_flat
         transforms["model.visual.downsample.weight"] = conv2d_flat
 
-    params = load_routed(model_dir, _prior_routing(cfg), shapes, dtype,
-                         transforms=transforms)
+    params = load_routed(model_dir, _prior_routing(cfg, include_vision),
+                         shapes, dtype, transforms=transforms)
     logger.info("loaded GLM-Image AR prior: %d-layer LM%s",
                 cfg.text.num_layers,
-                "" if cfg.vision is None
-                else f" + {cfg.vision.depth}-block vision tower")
+                f" + {cfg.vision.depth}-block vision tower"
+                if include_vision else " (vision tower deferred)")
     return params, cfg

@@ -90,8 +90,12 @@ def load_hunyuan_lm(model_dir: str,
     if cfg is None:
         cfg = config_from_hf(model_dir)
     np_dtype = np_param_dtype(dtype)
+    # untied output head when the checkpoint ships one (gen_text mode
+    # needs real logits; tie_word_embeddings=False in the reference)
+    has_head = checkpoint_has_prefix(model_dir, "lm_head.")
     shapes = jax.eval_shape(
-        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32))
+        lambda: init_params(jax.random.PRNGKey(0), cfg, jnp.float32,
+                            lm_head=has_head))
     tree = jax.tree_util.tree_map(
         lambda s: np.zeros(s.shape, np_dtype), shapes)
     inter = cfg.moe_intermediate_size
@@ -132,7 +136,8 @@ def load_hunyuan_lm(model_dir: str,
             n += 1
             continue
         if name == "lm_head.weight":
-            # logits ride the tied embedding in this tree
+            tree["lm_head"]["w"][...] = arr.T
+            n += 1
             continue
         # expert projections ship as bare parameters (no .weight
         # suffix) while Linear/RMSNorm tensors carry one — strip either

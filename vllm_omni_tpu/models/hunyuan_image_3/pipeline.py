@@ -358,6 +358,122 @@ class HunyuanImage3Pipeline:
              np.ones((b, 3), np.int32)], axis=1)
         return jnp.asarray(ids), jnp.asarray(mask)
 
+    # ---------------------------------------------------------- gen_text
+
+    def _bot_prefix_ids(self, bot_task: str) -> list[int]:
+        """Token ids of the bot-response prefix for a task (reference
+        hunyuan_image_3_tokenizer.py:1036-1043, pretrain template:
+        think -> "<think>", recaption -> "<recaption>", img_ratio ->
+        "<boi><img_size_N>")."""
+        llm = self.cfg.llm
+        if bot_task == "img_ratio":
+            return [llm.boi_token_id, llm.size_token_id]
+        lit = {"think": "<think>", "recaption": "<recaption>"}[bot_task]
+        tok = getattr(self, "hf_tokenizer", None)
+        if tok is not None:
+            tid = tok.convert_tokens_to_ids(lit)
+            if tid is not None and tid >= 0 and tid != tok.unk_token_id:
+                return [tid]
+            return list(tok(lit, add_special_tokens=False)["input_ids"])
+        return self.tokenizer.encode(lit, add_bos=False)
+
+    def _gen_text_stop_ids(self, bot_task: str) -> list[int]:
+        """Stop set per task (reference pipeline_hunyuan_image_3.py:
+        616-622): think/recaption stop at </recaption>, </answer> or
+        eos; img_ratio emits exactly one token so needs none."""
+        tok = getattr(self, "hf_tokenizer", None)
+        if tok is None:
+            return [self.tokenizer.eos_token_id]
+        stops = []
+        for t in ("</recaption>", "</answer>"):
+            tid = tok.convert_tokens_to_ids(t)
+            if tid is not None and tid >= 0 and tid != tok.unk_token_id:
+                stops.append(tid)
+        if tok.eos_token_id is not None:
+            stops.append(tok.eos_token_id)
+        return stops
+
+    def gen_text(self, prompts: list[str], bot_task: str = "think",
+                 max_new_tokens: int = 128, temperature: float = 0.0,
+                 seed: int = 0):
+        """The reference's ``gen_text`` mode over the same MoE trunk
+        (pipeline_hunyuan_image_3.py:545 bot_task): AR text rollout
+        after [prompt ; task prefix].
+
+        Returns per-prompt strings for think/recaption; for img_ratio a
+        dict ``{"ratio_index", "height", "width"}`` resolved through the
+        ResolutionGroup aspect buckets (the reference stops on the
+        generated ``<img_ratio_i>`` token, :602 max_new_tokens=1)."""
+        from vllm_omni_tpu.models.hunyuan_image_3.transformer import (
+            make_gen_text,
+        )
+
+        if bot_task not in ("think", "recaption", "img_ratio"):
+            raise InvalidRequestError(
+                f"bot_task must be think|recaption|img_ratio, got "
+                f"{bot_task!r}")
+        cfg = self.cfg
+        llm = cfg.llm
+        if bot_task == "img_ratio":
+            max_new_tokens = 1  # one <img_ratio_i> token (reference :602)
+        prefix = self._bot_prefix_ids(bot_task)
+        tok = getattr(self, "hf_tokenizer", None)
+        rows, lens = [], []
+        for p in prompts:
+            if tok is not None:
+                ids = tok(p, truncation=True,
+                          max_length=cfg.max_text_len)["input_ids"]
+            else:
+                ids = self.tokenizer.encode(p)[:cfg.max_text_len]
+            rows.append(list(ids) + prefix)
+            lens.append(len(rows[-1]))
+        bucket = cfg.max_text_len + len(prefix)
+        b = len(rows)
+        ids_np = np.zeros((b, bucket), np.int32)
+        for i, r in enumerate(rows):
+            ids_np[i, :len(r)] = r
+
+        key = ("gen_text", bucket, max_new_tokens)
+        if not hasattr(self, "_gen_text_cache"):
+            self._gen_text_cache = {}
+        if key not in self._gen_text_cache:
+            self._gen_text_cache[key] = make_gen_text(
+                llm, bucket, max_new_tokens)
+        cos, sin = rope_2d_table(
+            diagonal_positions(0, bucket + max_new_tokens),
+            llm.head_dim, llm.rope_theta)
+        out = np.asarray(self._gen_text_cache[key](
+            self.dit_params["llm"], jnp.asarray(ids_np),
+            jnp.asarray(np.asarray(lens, np.int32)),
+            jnp.asarray(cos), jnp.asarray(sin),
+            jnp.float32(temperature), jax.random.PRNGKey(seed)))
+
+        if bot_task == "img_ratio":
+            results = []
+            for i in range(b):
+                idx = int(out[i, 0]) - llm.ratio_token_base
+                if not 0 <= idx < len(self.resolutions):
+                    # random-init/tiny trunks emit arbitrary ids: snap
+                    # into the bucket table rather than crash (disclosed
+                    # — a trained checkpoint emits in-range ratio ids)
+                    idx = idx % len(self.resolutions)
+                h, w = self.resolutions.data[idx]
+                results.append(
+                    {"ratio_index": idx, "height": h, "width": w})
+            return results
+        stops = set(self._gen_text_stop_ids(bot_task))
+        texts = []
+        for i in range(b):
+            toks = []
+            for t in out[i].tolist():
+                if t in stops:
+                    break
+                toks.append(t)
+            texts.append(tok.decode(toks, skip_special_tokens=True)
+                         if tok is not None
+                         else self.tokenizer.decode(toks))
+        return texts
+
     # ----------------------------------------------------------- denoise
 
     def _denoise_fn(self, grid_h: int, grid_w: int, s_ctx: int,
@@ -551,6 +667,23 @@ class HunyuanImage3Pipeline:
         sp = req.sampling_params
         cfg = self.cfg
         llm = cfg.llm
+        extra = sp.extra if getattr(sp, "extra", None) else {}
+        bot_task = extra.get("bot_task")
+        if bot_task:
+            # gen_text mode: think / recaption / img_ratio produce TEXT
+            # (or a ratio choice), not an image (reference bot_task,
+            # pipeline_hunyuan_image_3.py:545)
+            outs = self.gen_text(
+                list(req.prompt), bot_task=bot_task,
+                max_new_tokens=int(extra.get("max_new_tokens", 128)),
+                temperature=float(extra.get("temperature", 0.0)),
+                seed=sp.seed if sp.seed is not None else 0)
+            return [
+                DiffusionOutput(request_id=req.request_ids[i],
+                                prompt=req.prompt[i], data=outs[i],
+                                output_type="text")
+                for i in range(len(req.prompt))
+            ]
         base = llm.image_base_size
         height = sp.height or base
         width = sp.width or base

@@ -192,15 +192,31 @@ def _layer_init(key, cfg: HunyuanImage3Config, idx: int, dtype):
     return p
 
 
-def init_params(key, cfg: HunyuanImage3Config, dtype=jnp.float32):
-    keys = jax.random.split(key, cfg.num_layers + 2)
-    return {
+def init_params(key, cfg: HunyuanImage3Config, dtype=jnp.float32,
+                lm_head: bool = False):
+    keys = jax.random.split(key, cfg.num_layers + 3)
+    p = {
         "embed": nn.embedding_init(keys[0], cfg.vocab_size,
                                    cfg.hidden_size, dtype),
         "layers": [_layer_init(keys[1 + i], cfg, i, dtype)
                    for i in range(cfg.num_layers)],
         "final_norm": nn.rmsnorm_init(cfg.hidden_size, dtype),
     }
+    if lm_head:
+        # untied output head (reference tie_word_embeddings=False,
+        # pipeline_hunyuan_image_3.py:112) — needed by gen_text mode
+        p["lm_head"] = nn.linear_init(
+            keys[-1], cfg.hidden_size, cfg.vocab_size, bias=False,
+            dtype=dtype)
+    return p
+
+
+def text_logits(params, hidden):
+    """LM logits from final-norm hidden (untied lm_head when loaded,
+    tied embedding otherwise)."""
+    if "lm_head" in params:
+        return nn.linear(params["lm_head"], hidden)
+    return hidden @ params["embed"]["w"].T
 
 
 # ---------------------------------------------------------------------------
@@ -269,6 +285,129 @@ def prefill(params, cfg: HunyuanImage3Config, token_ids: jax.Array,
         x = x + nn.linear(layer["o_proj"], o.reshape(b, s_all, -1))
         x = x + _mlp(layer, cfg, x, cfg.is_moe_layer(i))
     return kvs, ctx_mask
+
+
+def make_gen_text(cfg: HunyuanImage3Config, ctx_bucket: int,
+                  n_gen: int):
+    """Jitted KV-cached AR TEXT rollout — the reference's ``gen_text``
+    mode (pipeline_hunyuan_image_3.py:545: bot_task think/recaption/
+    img_ratio runs HF ``generate`` over the same trunk).  Prompts
+    right-pad to ``ctx_bucket`` (mask-aware prefill, one executable per
+    bucket); decode is a fori_loop of dense single-query GQA attention
+    over a preallocated cache.  Text tokens ride diagonal 2D-rope
+    positions, so each generated token continues the 1D axis from the
+    REAL per-prompt context length (pad slots are masked out of every
+    attention and claim no positions).
+
+    Returns ``gen(params, ids [B, ctx_bucket], ctx_lens [B], cos, sin,
+    temperature, key) -> [B, n_gen] token ids`` (cos/sin must cover
+    ctx_bucket + n_gen diagonal positions)."""
+    hd, kvh = cfg.head_dim, cfg.num_kv_heads
+    total = ctx_bucket + n_gen
+    groups = cfg.num_heads // kvh
+
+    def decode_one(params, x_tok, cos_b, sin_b, k_cache, v_cache,
+                   valid, write_pos):
+        """One single-token forward: per-batch rope rows ``cos_b``/
+        ``sin_b`` [B, hd]; K/V written to cache slot ``write_pos``
+        (None = replay a token whose K/V is already cached)."""
+        b = x_tok.shape[0]
+        x = x_tok  # [B, 1, D]
+        nk, nv = [], []
+        for li, layer in enumerate(params["layers"]):
+            h = rms_norm(x, layer["input_norm"]["w"], cfg.rms_eps)
+            flat = h.reshape(b, -1)
+            q = nn.linear(layer["q_proj"], flat).reshape(b, 1, -1, hd)
+            c = cos_b[:, None, None, :].astype(q.dtype)
+            s_ = sin_b[:, None, None, :].astype(q.dtype)
+            q = q * c + _rotate_half(q) * s_
+            if write_pos is None:
+                kc, vc = k_cache[li], v_cache[li]
+            else:
+                kq = nn.linear(layer["k_proj"], flat).reshape(
+                    b, 1, -1, hd)
+                vq = nn.linear(layer["v_proj"], flat).reshape(
+                    b, 1, -1, hd)
+                kq = kq * c + _rotate_half(kq) * s_
+                kc = jax.lax.dynamic_update_slice_in_dim(
+                    k_cache[li], kq, write_pos, axis=1)
+                vc = jax.lax.dynamic_update_slice_in_dim(
+                    v_cache[li], vq, write_pos, axis=1)
+                nk.append(kc)
+                nv.append(vc)
+            qh = q[:, 0].reshape(b, kvh, groups, hd)
+            s = jnp.einsum("bkgh,btkh->bkgt", qh.astype(jnp.float32),
+                           kc.astype(jnp.float32)) / math.sqrt(hd)
+            s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+            o = jnp.einsum("bkgt,btkh->bkgh",
+                           jax.nn.softmax(s, axis=-1),
+                           vc.astype(jnp.float32))
+            o = o.reshape(b, 1, cfg.q_dim).astype(x.dtype)
+            x = x + nn.linear(layer["o_proj"], o)
+            x = x + _mlp(layer, cfg, x, cfg.is_moe_layer(li))
+        h = rms_norm(x, params["final_norm"]["w"], cfg.rms_eps)
+        logits = text_logits(params, h[:, 0])
+        if write_pos is None:
+            return logits, k_cache, v_cache
+        return logits, jnp.stack(nk), jnp.stack(nv)
+
+    @jax.jit
+    def gen(params, ids, ctx_lens, cos, sin, temperature, key):
+        b = ids.shape[0]
+        mask = (jnp.arange(ctx_bucket)[None, :]
+                < ctx_lens[:, None]).astype(jnp.int32)
+        kvs, _ = prefill(params, cfg, ids, mask,
+                         cos[:ctx_bucket], sin[:ctx_bucket])
+        k_cache = jnp.stack([
+            jnp.zeros((b, total, kvh, hd), kvs[0][0].dtype)
+            .at[:, :ctx_bucket].set(k) for k, _ in kvs])
+        v_cache = jnp.stack([
+            jnp.zeros((b, total, kvh, hd), kvs[0][1].dtype)
+            .at[:, :ctx_bucket].set(v) for _, v in kvs])
+
+        def pick(logits, k):
+            greedy = jnp.argmax(logits, axis=-1)
+            sampled = jax.random.categorical(
+                k, logits / jnp.maximum(temperature, 1e-6))
+            return jnp.where(temperature > 0, sampled,
+                             greedy).astype(jnp.int32)
+
+        ar = jnp.arange(total)
+
+        # seed the rollout by REPLAYING the last real context token
+        # through the decode path (its K/V is already cached from the
+        # prefill) to read the next-token logits
+        last_ids = jnp.take_along_axis(ids, ctx_lens[:, None] - 1,
+                                       axis=1)
+        x_last = nn.embedding(params["embed"], last_ids)
+        valid0 = ar[None, :] < ctx_lens[:, None]
+        logits0, _, _ = decode_one(
+            params, x_last, cos[ctx_lens - 1], sin[ctx_lens - 1],
+            k_cache, v_cache, valid0, None)
+        key, sub = jax.random.split(key)
+        first = pick(logits0, sub)
+
+        def step(i, carry):
+            k_cache, v_cache, tok, out, kk = carry
+            x = nn.embedding(params["embed"], tok[:, None])
+            # rope row continues from the REAL length; cache slot is
+            # bucket-aligned
+            valid = valid0 | ((ar[None, :] >= ctx_bucket)
+                              & (ar[None, :] <= ctx_bucket + i))
+            logits, k_cache, v_cache = decode_one(
+                params, x, cos[ctx_lens + i], sin[ctx_lens + i],
+                k_cache, v_cache, valid, ctx_bucket + i)
+            kk, sub = jax.random.split(kk)
+            nxt = pick(logits, sub)
+            out = out.at[:, i].set(tok)
+            return (k_cache, v_cache, nxt, out, kk)
+
+        out = jnp.zeros((b, n_gen), jnp.int32)
+        _, _, _, out, _ = jax.lax.fori_loop(
+            0, n_gen, step, (k_cache, v_cache, first, out, key))
+        return out
+
+    return gen
 
 
 def gen_image_step(params, cfg: HunyuanImage3Config, x_tokens: jax.Array,
