@@ -74,3 +74,90 @@ def test_string_prompt_roundtrips_tokenizer(async_omni):
     assert len(outs) == 1
     # byte tokenizer encoded the prompt: 5 bytes + BOS
     assert len(outs[0].prompt_token_ids) == 6
+
+
+def test_pause_resume_generation(async_omni):
+    """pause_generation blocks NEW intake until resume (reference:
+    async_omni.py:739-782); drain mode waits for in-flight requests;
+    clear_cache releases APC pages."""
+    async def run():
+        assert not await async_omni.is_paused()
+
+        # start an in-flight request, then pause with drain
+        task = asyncio.ensure_future(_collect([1, 2, 3], "inflight"))
+        await asyncio.sleep(0)  # let it enqueue
+        await async_omni.pause_generation(
+            wait_for_inflight_requests=True)
+        assert await async_omni.is_paused()
+        outs = await task  # drained to completion, not aborted
+        assert len(outs) == 1 and outs[0].outputs[0].token_ids
+
+        # new requests block while paused
+        blocked = asyncio.ensure_future(_collect([5, 6], "blocked"))
+        await asyncio.sleep(0.1)
+        assert not blocked.done()
+
+        # idempotent pause; then resume unblocks
+        await async_omni.pause_generation()
+        await async_omni.resume_generation()
+        assert not await async_omni.is_paused()
+        outs = await asyncio.wait_for(blocked, timeout=30)
+        assert len(outs) == 1 and outs[0].outputs[0].token_ids
+        return True
+
+    async def _collect(prompt, rid):
+        outs = []
+        async for o in async_omni.generate(prompt, {"max_tokens": 4},
+                                           request_id=rid):
+            outs.append(o)
+        return outs
+
+    assert asyncio.run(run())
+
+
+def test_pause_abort_mode_kills_inflight(async_omni):
+    """wait_for_inflight_requests=False aborts in-flight streams
+    immediately (the reference docstring's default semantics)."""
+    async def run():
+        async def _collect(prompt, rid, max_tokens):
+            outs = []
+            async for o in async_omni.generate(
+                    prompt, {"max_tokens": max_tokens}, request_id=rid):
+                outs.append(o)
+            return outs
+
+        task = asyncio.ensure_future(_collect([1, 2, 3], "longgen", 64))
+        await asyncio.sleep(0.05)  # in flight
+        await async_omni.pause_generation(
+            wait_for_inflight_requests=False)
+        outs = await asyncio.wait_for(task, timeout=10)
+        # stream terminated early (possibly zero outputs)
+        assert len(outs) <= 1
+        await async_omni.resume_generation()
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_reset_prefix_cache_releases_pages():
+    """Engine-level APC reset: cached pages from a finished request are
+    released; a re-run of the same prompt recomputes (no hit count
+    growth from stale pages)."""
+    from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.models.common import transformer as tfm
+    from vllm_omni_tpu.sampling_params import SamplingParams
+    import jax, jax.numpy as jnp
+
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=32, page_size=4, max_model_len=64, dtype=jnp.float32))
+    prompt = list(range(1, 13))
+    sp = SamplingParams(temperature=0.0, max_tokens=2)
+    eng.generate([prompt], sp)
+    released = eng.reset_prefix_cache()
+    assert released > 0
+    # same prompt again: no cached pages left to hit
+    hits_before = eng.prefix_cache_stats["hits"]
+    eng.generate([prompt], sp)
+    assert eng.prefix_cache_stats["hits"] == hits_before

@@ -1,0 +1,133 @@
+"""Real-weight serving e2e (VERDICT r4 stretch #9): synthetic
+full-schema diffusers checkpoint -> from_pretrained -> OpenAI server ->
+decoded image bytes, crossing the serving x real-weight intersection in
+one test (reference:
+tests/entrypoints/openai_api/test_image_server.py)."""
+
+import base64
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+httpx = pytest.importorskip("httpx")
+
+from vllm_omni_tpu.config.stage import StageConfig  # noqa: E402
+from vllm_omni_tpu.entrypoints.openai.api_server import (  # noqa: E402
+    build_server,
+)
+
+
+@pytest.fixture(scope="module")
+def ckpt_root(tmp_path_factory):
+    """Full tiny diffusers repo (same schema as the loader suite)."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from tests.model_loader.test_causal_vae_parity import (
+        TINY as TINY_VAE,
+        _write_checkpoint,
+    )
+    from tests.model_loader.test_diffusers_loader import (
+        TINY_DIT,
+        _write_byte_level_tokenizer,
+        _write_dit_checkpoint,
+    )
+    from vllm_omni_tpu.model_loader import diffusers_loader as dl
+
+    root = tmp_path_factory.mktemp("qwen_image_srv")
+    _write_dit_checkpoint(root / "transformer",
+                          dl.dit_config_from_diffusers(TINY_DIT))
+    torch.manual_seed(3)
+    te = Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=512, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=96, rope_theta=1e6, rms_norm_eps=1e-6,
+        tie_word_embeddings=False)).eval()
+    te.save_pretrained(str(root / "text_encoder"), safe_serialization=True)
+    _write_byte_level_tokenizer(root / "tokenizer")
+    (root / "scheduler").mkdir()
+    (root / "scheduler" / "scheduler_config.json").write_text(json.dumps({
+        "_class_name": "FlowMatchEulerDiscreteScheduler",
+        "shift": 3.0, "use_dynamic_shifting": False,
+    }))
+    _write_checkpoint(root, TINY_VAE)
+    (root / "model_index.json").write_text(json.dumps({
+        "_class_name": "QwenImagePipeline",
+        "transformer": ["diffusers", "QwenImageTransformer2DModel"],
+        "text_encoder": ["transformers",
+                         "Qwen2_5_VLForConditionalGeneration"],
+        "tokenizer": ["transformers", "Qwen2Tokenizer"],
+        "scheduler": ["diffusers", "FlowMatchEulerDiscreteScheduler"],
+        "vae": ["diffusers", "AutoencoderKLQwenImage"],
+    }))
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def server_url(ckpt_root):
+    cfg = StageConfig(
+        stage_id=0, stage_type="diffusion",
+        # model = the CHECKPOINT DIR: the engine resolves the arch from
+        # model_index.json and routes through from_pretrained — real
+        # weights behind the server, not random-init presets
+        engine_args={"model": ckpt_root, "dtype": "float32",
+                     "default_height": 32, "default_width": 32},
+        engine_input_source=[-1], final_output=True,
+        final_output_type="image",
+        default_sampling_params={
+            "height": 32, "width": 32, "num_inference_steps": 2,
+            "guidance_scale": 1.0, "seed": 0,
+        },
+    )
+    server, state = build_server(model=ckpt_root, stage_configs=[cfg],
+                                 host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    state.shutdown()
+
+
+def test_real_weight_image_bytes_through_server(server_url, ckpt_root):
+    """POST a prompt; the response PNG must decode to the SAME pixels
+    the pipeline produces offline from the same checkpoint — the server
+    serves the loaded weights, end to end."""
+    from PIL import Image
+
+    from vllm_omni_tpu.diffusion.request import (
+        OmniDiffusionRequest,
+        OmniDiffusionSamplingParams,
+    )
+    from vllm_omni_tpu.models.qwen_image.pipeline import QwenImagePipeline
+
+    r = httpx.post(f"{server_url}/v1/images/generations", json={
+        "prompt": "a tiny red square", "size": "32x32",
+        "num_inference_steps": 2, "seed": 0,
+    }, timeout=600)
+    assert r.status_code == 200
+    item = r.json()["data"][0]
+    img = np.asarray(Image.open(io.BytesIO(
+        base64.b64decode(item["b64_json"]))).convert("RGB"))
+    assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+
+    import jax.numpy as jnp
+
+    pipe = QwenImagePipeline.from_pretrained(ckpt_root,
+                                             dtype=jnp.float32)
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=2, guidance_scale=1.0,
+        seed=0)
+    offline = pipe.forward(OmniDiffusionRequest(
+        prompt=["a tiny red square"], sampling_params=sp,
+        request_ids=["off"]))[0].data
+    np.testing.assert_array_equal(img, offline)
+
+
+def test_server_rejects_bad_size(server_url):
+    r = httpx.post(f"{server_url}/v1/images/generations", json={
+        "prompt": "x", "size": "not-a-size",
+    }, timeout=60)
+    assert r.status_code == 400

@@ -5,11 +5,15 @@ benchmarks/diffusion/diffusion_benchmark_serving.py — request throughput,
 latency percentiles, per-request SLO attainment; in-tree
 ``vllm bench serve --omni``, vllm_omni/benchmarks/serve.py:8).
 
-Drives ``/v1/chat/completions`` (streaming SSE for TTFT or non-streaming)
-or ``/v1/images/generations`` with a bounded concurrency worker pool, and
-prints one JSON report: throughput, TTFT (streaming) and E2E latency
-p50/p90/p99, and error counts.  Pure stdlib (http.client + threads) so it
-runs anywhere the server does.
+Drives ``/v1/chat/completions`` (streaming SSE for TTFT or
+non-streaming), ``/v1/images/generations``, ``/v1/audio/speech``, or
+``/v1/videos`` with a bounded concurrency worker pool, and prints one
+JSON report: throughput, TTFT (streaming) and E2E latency p50/p90/p99,
+error counts, and per-request SLO attainment — an explicit ``--slo-ms``
+E2E target, or one inferred from warmup requests scaled by
+``--slo-scale`` (reference ``_populate_slo_ms_from_warmups``,
+diffusion_benchmark_serving.py:629-661).  Pure stdlib (http.client +
+threads) so it runs anywhere the server does.
 """
 
 from __future__ import annotations
@@ -29,6 +33,8 @@ class BenchResult:
     duration_s: float = 0.0
     e2e_ms: list = field(default_factory=list)
     ttft_ms: list = field(default_factory=list)
+    # per-request E2E SLO target; None disables attainment reporting
+    slo_ms: Optional[float] = None
 
     @staticmethod
     def _pct(xs: list, p: float) -> float:
@@ -55,6 +61,17 @@ class BenchResult:
                 "p50": round(self._pct(self.ttft_ms, 0.50), 2),
                 "p90": round(self._pct(self.ttft_ms, 0.90), 2),
                 "p99": round(self._pct(self.ttft_ms, 0.99), 2),
+            }
+        if self.slo_ms is not None:
+            # errored requests count as missed (reference slo_achieved
+            # is only set on success, diffusion_benchmark_serving.py:765)
+            achieved = sum(1 for ms in self.e2e_ms if ms <= self.slo_ms)
+            out["slo"] = {
+                "slo_ms": round(self.slo_ms, 2),
+                "achieved": achieved,
+                "missed": self.num_requests - achieved,
+                "attainment": round(achieved / self.num_requests, 4)
+                if self.num_requests else 0.0,
             }
         return out
 
@@ -111,16 +128,18 @@ def _one_chat(base_url: str, prompt: str, max_tokens: int,
             result.num_errors += 1
 
 
-def _one_image(base_url: str, prompt: str, size: str,
-               result: BenchResult, lock: threading.Lock):
-    body = json.dumps({"prompt": prompt, "size": size, "n": 1}).encode()
+def _one_blocking(base_url: str, path: str, payload: dict,
+                  result: BenchResult, lock: threading.Lock,
+                  timeout: float = 600):
+    """Non-streaming POST leg: images / speech / videos share the same
+    request-to-bytes measurement."""
     req = urllib.request.Request(
-        f"{base_url}/v1/images/generations", data=body,
+        f"{base_url}{path}", data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"},
     )
     t0 = time.perf_counter()
     try:
-        with urllib.request.urlopen(req, timeout=600) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
         with lock:
             result.e2e_ms.append((time.perf_counter() - t0) * 1e3)
@@ -129,20 +148,64 @@ def _one_image(base_url: str, prompt: str, size: str,
             result.num_errors += 1
 
 
+def _endpoint_request(endpoint: str, prompt: str, size: str) -> tuple:
+    """(path, payload) per non-chat endpoint."""
+    if endpoint == "images":
+        return ("/v1/images/generations",
+                {"prompt": prompt, "size": size, "n": 1})
+    if endpoint == "speech":
+        # reference speech leg (vllm_omni/benchmarks/serve.py:8 drives
+        # the audio endpoints)
+        return ("/v1/audio/speech", {"input": prompt, "model": "bench"})
+    if endpoint == "videos":
+        return ("/v1/videos", {"prompt": prompt, "size": size})
+    raise ValueError(f"unknown endpoint {endpoint!r}")
+
+
+def _infer_slo_ms(base_url: str, endpoint: str, prompt: str,
+                  max_tokens: int, size: str, warmup: int,
+                  slo_scale: float) -> Optional[float]:
+    """Derive the per-request E2E SLO from sequential warmup requests:
+    median unloaded latency x slo_scale (reference
+    _infer_slo_base_time_ms_from_warmups + slo_scale default 3.0,
+    diffusion_benchmark_serving.py:590-661)."""
+    probe = BenchResult(num_requests=warmup)
+    lock = threading.Lock()
+    for i in range(warmup):
+        p = f"{prompt} warmup-{i}"
+        if endpoint == "chat":
+            _one_chat(base_url, p, max_tokens, False, probe, lock)
+        else:
+            path, payload = _endpoint_request(endpoint, p, size)
+            _one_blocking(base_url, path, payload, probe, lock)
+    if not probe.e2e_ms:
+        return None
+    med = sorted(probe.e2e_ms)[len(probe.e2e_ms) // 2]
+    return med * slo_scale
+
+
 def run_bench(
     base_url: str,
-    endpoint: str = "chat",  # "chat" | "images"
+    endpoint: str = "chat",  # "chat" | "images" | "speech" | "videos"
     num_requests: int = 16,
     concurrency: int = 4,
     max_tokens: int = 32,
     stream: bool = True,
     size: str = "64x64",
     prompt: str = "benchmark prompt",
+    slo_ms: Optional[float] = None,
+    slo_scale: Optional[float] = None,
+    warmup: int = 2,
 ) -> dict:
-    """Run the bench; returns the report dict (also what the CLI prints)."""
-    if endpoint not in ("chat", "images"):
+    """Run the bench; returns the report dict (also what the CLI
+    prints).  SLO attainment reports when ``slo_ms`` is given, or when
+    ``slo_scale`` is given (target = median warmup latency x scale)."""
+    if endpoint not in ("chat", "images", "speech", "videos"):
         raise ValueError(f"unknown endpoint {endpoint!r}")
-    result = BenchResult(num_requests=num_requests)
+    if slo_ms is None and slo_scale is not None:
+        slo_ms = _infer_slo_ms(base_url, endpoint, prompt, max_tokens,
+                               size, max(1, warmup), slo_scale)
+    result = BenchResult(num_requests=num_requests, slo_ms=slo_ms)
     lock = threading.Lock()
     # fixed pool of `concurrency` workers pulling indices from a queue —
     # one thread per request would spawn num_requests stacks that mostly
@@ -163,7 +226,8 @@ def run_bench(
             if endpoint == "chat":
                 _one_chat(base_url, p, max_tokens, stream, result, lock)
             else:
-                _one_image(base_url, p, size, result, lock)
+                path, payload = _endpoint_request(endpoint, p, size)
+                _one_blocking(base_url, path, payload, result, lock)
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker)
@@ -180,7 +244,8 @@ def add_cli_args(ap) -> None:
     """Shared option set (used by both this module's main() and the
     vllm-omni-tpu bench-serve subcommand — one definition)."""
     ap.add_argument("--base-url", default="http://127.0.0.1:8000")
-    ap.add_argument("--endpoint", choices=("chat", "images"),
+    ap.add_argument("--endpoint",
+                    choices=("chat", "images", "speech", "videos"),
                     default="chat")
     ap.add_argument("--num-requests", type=int, default=16)
     ap.add_argument("--concurrency", type=int, default=4)
@@ -188,6 +253,13 @@ def add_cli_args(ap) -> None:
     ap.add_argument("--no-stream", action="store_true")
     ap.add_argument("--size", default="64x64")
     ap.add_argument("--prompt", default="benchmark prompt")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="per-request E2E SLO target (ms)")
+    ap.add_argument("--slo-scale", type=float, default=None,
+                    help="infer the SLO as median warmup latency x "
+                         "this scale (reference default 3.0)")
+    ap.add_argument("--warmup", type=int, default=2,
+                    help="sequential warmup requests for SLO inference")
 
 
 def run_from_args(args) -> int:
@@ -195,7 +267,8 @@ def run_from_args(args) -> int:
         args.base_url, endpoint=args.endpoint,
         num_requests=args.num_requests, concurrency=args.concurrency,
         max_tokens=args.max_tokens, stream=not args.no_stream,
-        size=args.size, prompt=args.prompt,
+        size=args.size, prompt=args.prompt, slo_ms=args.slo_ms,
+        slo_scale=args.slo_scale, warmup=args.warmup,
     )
     print(json.dumps(report))
     return 0

@@ -157,6 +157,21 @@ class KVCacheManager:
             consumed.add(page)
         return consumed
 
+    def reset_prefix_cache(self) -> int:
+        """Drop EVERY unreferenced cached page back to the free pool
+        (reference: reset_prefix_cache during pause_generation,
+        async_omni.py:771 — weight updates invalidate cached KV).
+        Pages still referenced by live requests stay cached; returns the
+        number of pages released."""
+        n = 0
+        while self._evictable:
+            page = self._evict_one()
+            if page is None:
+                break
+            self._free.append(page)
+            n += 1
+        return n
+
     def _evict_one(self) -> Optional[int]:
         """Drop the least-recently-used unreferenced cached page back to
         the free pool."""

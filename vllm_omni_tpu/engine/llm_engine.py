@@ -343,6 +343,14 @@ class LLMEngine:
                 "hit_tokens": getattr(kv, "prefix_hit_tokens", 0),
                 "enabled": getattr(kv, "enable_prefix_caching", False)}
 
+    def reset_prefix_cache(self) -> int:
+        """Release every unreferenced APC page (reference:
+        reset_prefix_cache — cached KV is stale after a weight swap);
+        returns pages released."""
+        kv = self.scheduler.kv
+        fn = getattr(kv, "reset_prefix_cache", None)
+        return fn() if fn is not None else 0
+
     def step(self) -> list[OmniRequestOutput]:
         # surface intake-rejected requests as errored outputs instead of
         # silently dropping them

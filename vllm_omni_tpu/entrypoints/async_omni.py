@@ -48,6 +48,16 @@ class AsyncOmni:
         self._finals_seen: dict[str, int] = {}
         self._req_counter = itertools.count()
         self._running = True
+        # pause gate (reference: pause_generation/resume_generation,
+        # async_omni.py:739-782): a threading.Event so requests arriving
+        # on ANY event loop and the engine thread agree on the state.
+        # _pause_lock closes the gate-check -> stream-registration race:
+        # a generate() that saw the gate open registers its stream
+        # INSIDE the lock, so a pause clearing the event (also inside
+        # the lock) is guaranteed to see it in _streams
+        self._resume_event = threading.Event()
+        self._resume_event.set()
+        self._pause_lock = threading.Lock()
         # engine-level stats heartbeat period (seconds); tests shrink it
         self._stats_interval = 10.0
         self._thread = threading.Thread(target=self._engine_loop,
@@ -75,6 +85,57 @@ class AsyncOmni:
     def stop_profile(self) -> None:
         self._omni.stop_profile()
 
+    # ------------------------------------------------------- pause/resume
+    async def pause_generation(
+        self,
+        *,
+        wait_for_inflight_requests: bool = False,
+        clear_cache: bool = True,
+    ) -> None:
+        """Pause generation for a weight update (reference:
+        AsyncOmni.pause_generation, async_omni.py:739-773).  New
+        requests block in ``generate`` until ``resume_generation``.
+
+        ``wait_for_inflight_requests``: True drains in-flight requests
+        first; False (default) aborts them immediately.
+        ``clear_cache``: drop every stage engine's unreferenced APC page
+        (cached KV is stale once weights change)."""
+        with self._pause_lock:
+            if not self._resume_event.is_set():
+                return
+            self._resume_event.clear()
+        if wait_for_inflight_requests:
+            while self._streams or not self._intake.empty():
+                await asyncio.sleep(0.005)
+        else:
+            for rid in list(self._streams):
+                self.abort(rid)
+        if clear_cache:
+            # even in abort mode the ENGINE keeps draining aborted work
+            # (stream abort is best-effort); a reset before it finishes
+            # would let freed pages re-register pre-swap KV into the
+            # cache — wait for the engines to go idle first
+            while (not self._intake.empty()
+                   or any(getattr(getattr(s, "engine", None),
+                                  "has_unfinished_requests", False)
+                          for s in self._omni.stages)):
+                await asyncio.sleep(0.005)
+            released = 0
+            for stage in self._omni.stages:
+                eng = getattr(stage, "engine", None)
+                fn = getattr(eng, "reset_prefix_cache", None)
+                if fn is not None:
+                    released += fn()
+            logger.info("paused: %d prefix-cache pages released",
+                        released)
+
+    async def resume_generation(self) -> None:
+        """Unblock requests waiting behind ``pause_generation``."""
+        self._resume_event.set()
+
+    async def is_paused(self) -> bool:
+        return not self._resume_event.is_set()
+
     # -------------------------------------------------------------- intake
     async def generate(
         self,
@@ -88,6 +149,11 @@ class AsyncOmni:
             request_id = f"async-{next(self._req_counter)}"
         elif request_id in self._streams:
             raise ValueError(f"request_id {request_id!r} already in flight")
+        # pause gate: block intake until resume_generation (reference:
+        # "New generation/encoding requests are blocked until resume").
+        # The gate check and the stream registration below share
+        # _pause_lock so a concurrent pause either sees this request in
+        # _streams or blocks it here — never neither.
         sp = dict(sampling_params or {})
         if isinstance(prompt, dict):
             req = StageRequest(request_id=request_id, sampling_params=sp,
@@ -101,8 +167,13 @@ class AsyncOmni:
                                sampling_params=sp)
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
-        self._streams[request_id] = (loop, out_q)
-        self._finals_seen[request_id] = 0
+        while True:
+            with self._pause_lock:
+                if self._resume_event.is_set():
+                    self._streams[request_id] = (loop, out_q)
+                    self._finals_seen[request_id] = 0
+                    break
+            await asyncio.sleep(0.01)
         self._omni.metrics.record_arrival(request_id)
         self._intake.put(req)
         try:

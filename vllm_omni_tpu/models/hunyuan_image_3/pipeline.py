@@ -433,20 +433,26 @@ class HunyuanImage3Pipeline:
         for i, r in enumerate(rows):
             ids_np[i, :len(r)] = r
 
-        key = ("gen_text", bucket, max_new_tokens)
+        # bucket the generation length: user-supplied max_new_tokens
+        # would otherwise mint one minutes-long MoE-trunk compile per
+        # distinct value (the GLM prior buckets for the same reason);
+        # extra tokens are generated and sliced off
+        n_gen = (1 if max_new_tokens == 1
+                 else max(32, -(-max_new_tokens // 32) * 32))
+        key = ("gen_text", bucket, n_gen)
         if not hasattr(self, "_gen_text_cache"):
             self._gen_text_cache = {}
         if key not in self._gen_text_cache:
-            self._gen_text_cache[key] = make_gen_text(
-                llm, bucket, max_new_tokens)
+            self._gen_text_cache[key] = make_gen_text(llm, bucket, n_gen)
         cos, sin = rope_2d_table(
-            diagonal_positions(0, bucket + max_new_tokens),
+            diagonal_positions(0, bucket + n_gen),
             llm.head_dim, llm.rope_theta)
         out = np.asarray(self._gen_text_cache[key](
             self.dit_params["llm"], jnp.asarray(ids_np),
             jnp.asarray(np.asarray(lens, np.int32)),
             jnp.asarray(cos), jnp.asarray(sin),
-            jnp.float32(temperature), jax.random.PRNGKey(seed)))
+            jnp.float32(temperature),
+            jax.random.PRNGKey(seed)))[:, :max_new_tokens]
 
         if bot_task == "img_ratio":
             results = []
