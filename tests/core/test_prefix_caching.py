@@ -7,6 +7,7 @@ shrinking capacity."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
 from vllm_omni_tpu.request import Request
@@ -157,6 +158,7 @@ def test_pinned_shared_page_survives_until_ack():
     assert th == [shared]
 
 
+@pytest.mark.slow  # two-engine stage pipeline; APC logic covered by the token-identical test
 def test_stats_summary_reports_cache_hits():
     from vllm_omni_tpu.entrypoints.omni import Omni
 

@@ -126,6 +126,7 @@ def test_append_guards():
         eng.append_prompt_chunk("plain", [4])
 
 
+@pytest.mark.slow  # two-engine handoff; single-engine streaming tests keep the signal
 def test_streaming_cross_engine_handoff():
     """The async_chunk use: engine B (talker-style) starts prefilling
     thinker hidden states while engine A is still generating, matching the

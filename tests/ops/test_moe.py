@@ -64,6 +64,7 @@ def test_routed_under_jit(rng):
 
 
 @pytest.mark.parametrize("ep", [2, 4, 8])
+@pytest.mark.slow  # multi-device; the dryrun MoE-EP leg covers this
 def test_routed_ep_matches_dense(rng, devices8, ep):
     x, rw, gu, dn = _mk_weights(rng, e=8, t=16)
     want = _dense_oracle(x, rw, gu, dn, 2)
@@ -75,6 +76,7 @@ def test_routed_ep_matches_dense(rng, devices8, ep):
                                atol=1e-5, rtol=1e-4)
 
 
+@pytest.mark.slow  # heavy compile; routed-vs-dense already covered at op level
 def test_transformer_forward_routed_matches_dense(rng, devices8):
     """forward_hidden with moe_dispatch=routed (incl. EP via set_ep_mesh)
     matches the dense-dispatch forward token-for-token."""

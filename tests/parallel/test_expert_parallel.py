@@ -4,6 +4,9 @@ and inserts the combine psum (the XLA analogue of the reference's
 all-to-all EP dispatch, SURVEY.md §2.11)."""
 
 import jax
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-device compile-heavy; the dryrun MoE-EP leg covers this path
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -13,6 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-device compile-heavy; the product dryrun covers this path
+
 from vllm_omni_tpu.diffusion.request import (
     OmniDiffusionRequest,
     OmniDiffusionSamplingParams,

@@ -156,6 +156,7 @@ def test_ar_registry_resolves_real_loaders():
         assert params["model_dir"].default is inspect.Parameter.empty
 
 
+@pytest.mark.slow  # checkpoint-loader e2e; loader suites cover it nightly
 def test_ar_registry_front_door_loads_checkpoint(tmp_path):
     """resolve("Qwen3ForCausalLM")(dir) loads real weights end to end."""
     import torch

@@ -6,6 +6,7 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
 
 from vllm_omni_tpu.platforms import (
     current_platform,
@@ -121,6 +122,7 @@ def test_bench_flop_model_sanity():
 
 
 # ----------------------------------------------------------------- SD3
+@pytest.mark.slow  # full SD3 pipeline build; registry coverage lives in test_registry_covers_all_reference_archs
 def test_sd3_pipeline_and_registry():
     import jax
     import jax.numpy as jnp

@@ -169,6 +169,8 @@ def _infer_slo_ms(base_url: str, endpoint: str, prompt: str,
     median unloaded latency x slo_scale (reference
     _infer_slo_base_time_ms_from_warmups + slo_scale default 3.0,
     diffusion_benchmark_serving.py:590-661)."""
+    from vllm_omni_tpu.metrics.stats import nearest_rank_pct
+
     probe = BenchResult(num_requests=warmup)
     lock = threading.Lock()
     for i in range(warmup):
@@ -179,9 +181,13 @@ def _infer_slo_ms(base_url: str, endpoint: str, prompt: str,
             path, payload = _endpoint_request(endpoint, p, size)
             _one_blocking(base_url, path, payload, probe, lock)
     if not probe.e2e_ms:
-        return None
-    med = sorted(probe.e2e_ms)[len(probe.e2e_ms) // 2]
-    return med * slo_scale
+        # the operator asked for SLO attainment; a report silently
+        # missing the "slo" key would read as success
+        raise RuntimeError(
+            f"SLO inference failed: all {warmup} warmup requests "
+            "errored — server unhealthy or endpoint mismatch")
+    # same p50 definition the report uses (nearest-rank)
+    return nearest_rank_pct(probe.e2e_ms, 0.50) * slo_scale
 
 
 def run_bench(

@@ -111,13 +111,13 @@ class AsyncOmni:
             for rid in list(self._streams):
                 self.abort(rid)
         if clear_cache:
-            # even in abort mode the ENGINE keeps draining aborted work
+            # even in abort mode the STAGES keep draining aborted work
             # (stream abort is best-effort); a reset before it finishes
             # would let freed pages re-register pre-swap KV into the
-            # cache — wait for the engines to go idle first
+            # cache — wait for every stage (its _pending queue AND its
+            # engine, stage.has_unfinished) to go idle first
             while (not self._intake.empty()
-                   or any(getattr(getattr(s, "engine", None),
-                                  "has_unfinished_requests", False)
+                   or any(getattr(s, "has_unfinished", False)
                           for s in self._omni.stages)):
                 await asyncio.sleep(0.005)
             released = 0
@@ -170,12 +170,27 @@ class AsyncOmni:
         while True:
             with self._pause_lock:
                 if self._resume_event.is_set():
+                    # re-check the duplicate guard HERE: two same-id
+                    # calls parked behind a pause both passed the early
+                    # check; the second must fail, not silently steal
+                    # the first's stream
+                    if request_id in self._streams:
+                        raise ValueError(
+                            f"request_id {request_id!r} already in "
+                            "flight")
                     self._streams[request_id] = (loop, out_q)
                     self._finals_seen[request_id] = 0
+                    # enqueue INSIDE the lock: a put after release could
+                    # slip past a concurrent pause's intake-empty check
+                    # and run mid-weight-swap
+                    self._intake.put(req)
                     break
+            if not self._running:
+                raise RuntimeError(
+                    "AsyncOmni is shut down; request rejected while "
+                    "paused")
             await asyncio.sleep(0.01)
         self._omni.metrics.record_arrival(request_id)
-        self._intake.put(req)
         try:
             while True:
                 item = await out_q.get()
