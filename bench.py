@@ -121,18 +121,30 @@ def _host_to_hbm_gbps(timeout_s: float = 180) -> float:
     return 0.0
 
 
+_PROBE_GBPS = None  # measured host->HBM GB/s, reported in the JSON
+
+
 def _pick_size() -> tuple:
-    """Choose the flagship (preset, quantization): the REAL streamed
-    60-layer geometry when the host->HBM path can sustain it inside the
-    bench budget — bf16 first, int8 weight-only streaming (half the
-    bytes) when bf16 can't — else the HBM-resident reduced-layer preset
-    (honest fallback — the number is then per-layer-exact at reduced
-    depth, reported as such)."""
+    """Choose the flagship (preset, quantization, offload): the REAL
+    streamed 60-layer geometry when the host->HBM path can sustain it
+    inside the bench budget — bf16 first, int8 weight-only streaming
+    (half the bytes) when bf16 can't — else the real geometry packed to
+    int4 and RESIDENT (10.3 GB of the 41 GB bf16 DiT fits one 16 GB
+    chip; quantization disclosed, DiT depth/width fully real).  The
+    reduced-layer bf16 ``resident`` preset remains the runtime fallback
+    if the int4 build fails."""
+    global _PROBE_GBPS
     env = os.environ.get("OMNI_BENCH_SIZE")
     quant_env = os.environ.get("OMNI_BENCH_QUANT", "")
+    if env == "real_q" or quant_env == "int4":
+        # real_q only exists as the quantized-resident config (bf16 at
+        # this depth is 41 GB — a guaranteed OOM), and int4 always means
+        # resident: neither needs the bandwidth probe
+        return "real_q", "int4", ""
     if env:
-        return env, quant_env
+        return env, quant_env, "layerwise" if env == "real" else ""
     gbps = _host_to_hbm_gbps()
+    _PROBE_GBPS = round(gbps, 3)
     _progress(f"host->HBM throughput: {gbps:.2f} GB/s")
     # ~30 GB streamed per step after pinning (bf16; int8/fp8 weight-only
     # halves it); 50 steps must fit the budget with room for warmup +
@@ -141,22 +153,23 @@ def _pick_size() -> tuple:
     est = steps * 30.0 / max(gbps, 1e-6)
     est_q = est / 2
     feasible = _budget_s() * 0.6
-    if quant_env:  # explicit mode: honor it, bytes already halved
+    if quant_env:  # explicit streamed mode: honor it, bytes halved
         if est_q < feasible:
-            return "real", quant_env
+            return "real", quant_env, "layerwise"
     elif est < feasible:
-        return "real", ""
+        return "real", "", "layerwise"
     elif est_q < feasible:
         _progress(
             f"bf16 streaming infeasible (~{est:.0f}s of transfers for "
             f"{steps} steps vs {_budget_s():.0f}s budget) — real "
             "geometry with int8 streamed weights instead")
-        return "real", "int8"
+        return "real", "int8", "layerwise"
     _progress(
         f"streamed real preset infeasible (~{est:.0f}s bf16 / "
         f"~{est_q:.0f}s quantized of transfers for {steps} steps vs "
-        f"{_budget_s():.0f}s budget) — using HBM-resident preset")
-    return "resident", quant_env
+        f"{_budget_s():.0f}s budget at {gbps:.2f} GB/s) — real "
+        "geometry int4-resident instead")
+    return "real_q", "int4", ""
 
 
 def _tpu_alive(timeout_s: float = None) -> bool:
@@ -180,20 +193,43 @@ def _tpu_alive(timeout_s: float = None) -> bool:
         return False
 
 
+def _release_device_memory() -> None:
+    """Free HBM still held by dead engines before building the next one.
+
+    Engine/pipeline/closure graphs are cyclic, so dropping the last name
+    does NOT refcount the param trees to zero — the r05 first on-chip run
+    OOMed the AR bench and the step-cache variant this exact way.  A
+    forced gc pass plus clearing jit caches (whose entries can pin traced
+    constants) releases the buffers; the recompile a cleared cache costs
+    (~1 min) is noise next to a lost phase."""
+    import gc
+
+    gc.collect()
+    import jax
+
+    jax.clear_caches()
+    gc.collect()
+
+
 # ------------------------------------------------------------- diffusion
 def _build_engine(size: str, scheduler: str, use_cache: bool,
-                  quant: str = ""):
+                  quant: str = "", offload: str = ""):
     from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
     from vllm_omni_tpu.diffusion.engine import DiffusionEngine
 
     extra = {"size": size}
     if scheduler:
         extra["scheduler"] = scheduler
+    if size == "real_q":
+        # per-step device calls: a 60-layer 50-step single execution
+        # runs minutes in one RPC and the tunnel transport killed the
+        # TPU worker mid-flight ("kernel fault") when we tried it
+        extra["step_loop"] = "host"
     cfg = OmniDiffusionConfig(
         model="qwen-image-bench", model_arch="QwenImagePipeline",
         dtype="bfloat16", extra=extra,
         cache_backend="teacache" if use_cache else "",
-        offload="layerwise" if size == "real" else "",
+        offload=offload,
         quantization=quant,
     )
     return DiffusionEngine(cfg, warmup=False)
@@ -201,25 +237,16 @@ def _build_engine(size: str, scheduler: str, use_cache: bool,
 
 def bench_diffusion(size: str, scheduler: str, use_cache: bool,
                     height: int, width: int, steps: int,
-                    iters: int, quant: str = "") -> dict:
+                    iters: int, quant: str = "",
+                    offload: str = "") -> dict:
     from vllm_omni_tpu.diffusion.request import (
         OmniDiffusionRequest,
         OmniDiffusionSamplingParams,
     )
 
     fallback = ""
-    try:
-        engine = _build_engine(size, scheduler, use_cache, quant)
-    except Exception as e:  # e.g. not enough host RAM for the weights
-        if size not in ("real", "resident"):
-            raise
-        _progress(f"{size} preset failed ({type(e).__name__}: {e}); "
-                  "falling back to 16-layer bench preset")
-        fallback = f"{size} preset failed ({type(e).__name__}: {e}); "
-        size, height, width, steps, iters = "bench", 512, 512, 20, 3
-        quant = ""
-
-        engine = _build_engine(size, scheduler, use_cache)
+    engine = None
+    _release_device_memory()  # a prior phase's engine may still pin HBM
 
     def one(n_steps):
         sp = OmniDiffusionSamplingParams(
@@ -230,81 +257,112 @@ def bench_diffusion(size: str, scheduler: str, use_cache: bool,
             prompt=["a photo of a cat"], sampling_params=sp)
         return engine.step(req)
 
-    # compile warmup: 1 step warms every executable, then one untimed
-    # full-step generation — measured: the first full-length run after a
-    # 1-step warmup pays a ~4.5 s one-time cost (XLA autotune/allocator
-    # effects) that would otherwise pollute a 2-3 iteration average by
-    # 3x.  The streaming "real" preset skips the full warmup (a 50-step
-    # streamed generation is minutes; its per-piece executables are
-    # already warmed by one(1) and the 1-iter run is transfer-bound).
-    _progress(f"diffusion[{size}] warmup (1 step + compiles)")
-    tw = time.perf_counter()
-    one(1)
-    warm_s = time.perf_counter() - tw
-    _progress(f"diffusion[{size}] warmup done in {warm_s:.1f}s")
-    if size == "real":
-        # Feasibility check on MEASURED streamed timings (the probe's
-        # bandwidth estimate can rot — the tunnel degrades under load).
+    # The WHOLE phase (build + warmup compiles + timed run) retries with
+    # preset demotion: first hardware contact breaks after the build as
+    # often as during it (the r05 real_q attempt died in warmup when the
+    # remote-compile service choked on the unrolled 60-block program),
+    # and a demoted number beats a dead bench with no JSON line.
+    def measure_step():
         # A second 1-step pass runs with all compiles warm; the
         # pipeline's own denoise timing separates the per-step streamed
         # cost from the per-run text-encode/VAE overhead.
-        def measure_step():
+        tw = time.perf_counter()
+        one(1)
+        pass2_s = time.perf_counter() - tw
+        s = getattr(engine.pipeline, "last_stream_denoise_s", pass2_s)
+        return s, max(pass2_s - s, 0.0)
+
+    def rebuild(new_size, new_quant, new_offload):
+        # release the old pipeline FIRST: its pinned HBM blocks plus
+        # the replacement's weights would exceed one chip
+        nonlocal engine
+        del engine
+        engine = None
+        _release_device_memory()
+        engine = _build_engine(new_size, scheduler, use_cache,
+                               new_quant, new_offload)
+        one(1)
+
+    while True:
+        try:
+            engine = _build_engine(size, scheduler, use_cache, quant,
+                                   offload)
+            # compile warmup: 1 step warms every executable.  Small
+            # presets then run one untimed full-length pass (measured: a
+            # ~4.5 s one-time autotune cost would pollute a 2-3 iter
+            # average by 3x); the big presets skip it — for streaming the
+            # per-piece executables are already warm and the run is
+            # transfer-bound, for real_q the 1-step warmup warmed the
+            # same dynamic-step-bound executable and ~4.5 s is <3% of a
+            # 60-layer image.
+            _progress(f"diffusion[{size}] warmup (1 step + compiles)")
             tw = time.perf_counter()
             one(1)
-            pass2_s = time.perf_counter() - tw
-            s = getattr(engine.pipeline, "last_stream_denoise_s", pass2_s)
-            return s, max(pass2_s - s, 0.0)
-
-        def rebuild(new_size, new_quant):
-            # release the old pipeline FIRST: its pinned HBM blocks plus
-            # the replacement's weights would exceed one chip
-            nonlocal engine
-            del engine
-            import gc
-
-            gc.collect()
-            engine = _build_engine(new_size, scheduler, use_cache,
-                                   new_quant)
-            one(1)
-
-        step_s, overhead_s = measure_step()
-        est_total = overhead_s + steps * step_s
-        remaining = _budget_s() - (time.time() - _T0)
-        _progress(
-            f"streamed step {step_s:.1f}s + {overhead_s:.1f}s/run "
-            f"overhead => ~{est_total:.0f}s for {steps} steps "
-            f"({remaining:.0f}s left in budget)")
-        if est_total > remaining and not quant:
-            # int8 weight-only halves the streamed bytes the walk is
-            # bound by — try it before abandoning the real geometry
-            _progress("bf16 streaming measured-infeasible — retrying "
-                      "the real geometry with int8 streamed weights")
-            fallback = (f"bf16 streaming measured-infeasible "
-                        f"({step_s:.0f}s/streamed-step); ")
-            quant = "int8"
-            rebuild(size, quant)
-            step_s, overhead_s = measure_step()
-            est_total = overhead_s + steps * step_s
-            remaining = _budget_s() - (time.time() - _T0)
-            _progress(f"int8 streamed step {step_s:.1f}s => "
-                      f"~{est_total:.0f}s for {steps} steps "
-                      f"({remaining:.0f}s left)")
-        if est_total > remaining:
-            _progress("streamed real preset measured-infeasible — "
-                      "falling back to HBM-resident preset")
-            fallback += (f"real preset measured-infeasible "
-                         f"({step_s:.0f}s/streamed-step); ")
-            size, quant = "resident", ""
-            rebuild(size, quant)
-            one(steps)
-    else:
-        one(steps)
-    _progress(f"diffusion[{size}] timed run: {iters}x {steps} steps "
-              f"@{height}px")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        one(steps)
-    dt = (time.perf_counter() - t0) / iters
+            warm_s = time.perf_counter() - tw
+            _progress(f"diffusion[{size}] warmup done in {warm_s:.1f}s")
+            if size == "real" and offload == "layerwise":
+                # Feasibility check on MEASURED streamed timings (the
+                # probe's bandwidth estimate can rot — the tunnel
+                # degrades under load).
+                step_s, overhead_s = measure_step()
+                est_total = overhead_s + steps * step_s
+                remaining = _budget_s() - (time.time() - _T0)
+                _progress(
+                    f"streamed step {step_s:.1f}s + {overhead_s:.1f}s"
+                    f"/run overhead => ~{est_total:.0f}s for {steps} "
+                    f"steps ({remaining:.0f}s left in budget)")
+                if est_total > remaining and not quant:
+                    # int8 weight-only halves the streamed bytes the
+                    # walk is bound by — try it before abandoning
+                    # streaming
+                    _progress("bf16 streaming measured-infeasible — "
+                              "retrying with int8 streamed weights")
+                    fallback = (f"bf16 streaming measured-infeasible "
+                                f"({step_s:.0f}s/streamed-step); ")
+                    quant = "int8"
+                    rebuild(size, quant, offload)
+                    step_s, overhead_s = measure_step()
+                    est_total = overhead_s + steps * step_s
+                    remaining = _budget_s() - (time.time() - _T0)
+                    _progress(f"int8 streamed step {step_s:.1f}s => "
+                              f"~{est_total:.0f}s for {steps} steps "
+                              f"({remaining:.0f}s left)")
+                if est_total > remaining:
+                    _progress("streamed real preset measured-"
+                              "infeasible — switching to the "
+                              "int4-resident real geometry")
+                    fallback += (f"streaming measured-infeasible "
+                                 f"({step_s:.0f}s/streamed-step); ")
+                    size, quant, offload = "real_q", "int4", ""
+                    rebuild(size, quant, offload)
+            elif size not in ("real_q",):
+                one(steps)
+            _progress(f"diffusion[{size}] timed run: {iters}x {steps} "
+                      f"steps @{height}px")
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                one(steps)
+            dt = (time.perf_counter() - t0) / iters
+            break
+        except Exception as e:  # e.g. OOM / compile-service failure
+            engine = None
+            _release_device_memory()  # drop the failed build's partials
+            if size in ("real", "real_q"):
+                _progress(f"{size}/{quant or 'bf16'} preset failed "
+                          f"({type(e).__name__}: {e}); falling back to "
+                          "HBM-resident reduced-layer preset")
+                fallback += (f"{size}/{quant or 'bf16'} failed "
+                             f"({type(e).__name__}: {e}); ")
+                size, quant, offload = "resident", "", ""
+            elif size == "resident":
+                _progress(f"resident preset failed ({type(e).__name__}: "
+                          f"{e}); falling back to 16-layer bench preset")
+                fallback += f"resident failed ({type(e).__name__}: {e}); "
+                size, height, width, steps, iters = \
+                    "bench", 512, 512, 20, 3
+                quant = offload = ""
+            else:
+                raise
     _progress(f"diffusion[{size}] done: {dt:.1f}s/image")
 
     pcfg = engine.pipeline.cfg
@@ -336,6 +394,7 @@ def bench_diffusion(size: str, scheduler: str, use_cache: bool,
             "skipped_steps": skipped,
             "offload": getattr(engine.pipeline, "offload", ""),
             "quantization": quant,
+            "host_to_hbm_gbps": _PROBE_GBPS,
             "hbm_pinned_blocks": getattr(streamer, "pinned", None),
             "weights": fallback + "random-init (real-weight loader "
                        "exists, no checkpoint in the image)",
@@ -359,6 +418,7 @@ def bench_ar() -> dict:
     import jax.numpy as jnp
     import numpy as np
 
+    _release_device_memory()  # the flagship engine's HBM must be gone
     from vllm_omni_tpu.engine import EngineConfig, LLMEngine
     from vllm_omni_tpu.models.common import transformer as tfm
     from vllm_omni_tpu.sampling_params import SamplingParams
@@ -466,8 +526,8 @@ def main():
         }))
         return
 
-    size, quant = _pick_size()
-    big = size in ("real", "resident")
+    size, quant, offload = _pick_size()
+    big = size in ("real", "real_q", "resident")
     default_px = "1024" if big else "512"
     default_steps = "50" if big else "20"
     default_iters = "1" if big else "3"
@@ -478,7 +538,7 @@ def main():
     use_cache = os.environ.get("OMNI_BENCH_CACHE", "") == "1"
 
     flagship = bench_diffusion(size, scheduler, use_cache, height, width,
-                               steps, iters, quant)
+                               steps, iters, quant, offload)
     out = dict(flagship)
     out["vs_baseline"] = None
 
@@ -488,7 +548,14 @@ def main():
     # didn't, budget permitting
     ran_size = flagship["arch"]["size_preset"]
     ran_quant = flagship["arch"]["quantization"]
-    if ran_size != "real":
+    if ran_size == "real_q":
+        cause = (f"host->HBM measured {_PROBE_GBPS} GB/s — too slow "
+                 "for any streamed variant" if _PROBE_GBPS is not None
+                 else "see arch.weights for the demotion cause")
+        out["quantized_stream_variant"] = {
+            "skipped": "flagship ran the real geometry int4-RESIDENT "
+                       f"({cause})"}
+    elif ran_size != "real":
         out["quantized_stream_variant"] = {
             "skipped": f"flagship ran the {ran_size} preset (the "
                        "bf16-vs-int8 pair is a streamed-real comparison)"}
@@ -513,7 +580,7 @@ def main():
             try:
                 qvar = bench_diffusion(size, scheduler, use_cache,
                                        height, width, steps, iters,
-                                       "int8")
+                                       "int8", "layerwise")
                 # report the arch the variant ACTUALLY ran (its internal
                 # feasibility fallback may have stripped quant or
                 # changed preset) — never stamp the requested mode
@@ -556,14 +623,24 @@ def main():
     elif flagship["arch"]["size_preset"] != size:
         skip_reason = (f"flagship fell back to "
                        f"{flagship['arch']['size_preset']} preset")
+    elif size == "real_q":
+        skip_reason = (
+            "real_q drives a host step loop (single-RPC ceiling on the "
+            "tunnel) where per-call step caches cannot accumulate "
+            "skip state")
     elif elapsed + est_variant >= _budget_s():
         skip_reason = (f"budget ({elapsed:.0f}s elapsed, "
                        f"~{est_variant:.0f}s needed, "
                        f"{_budget_s():.0f}s budget)")
     if skip_reason is None:
         try:
+            # rerun what the flagship ACTUALLY ran (it may have demoted
+            # quant mid-flight, e.g. bf16 streaming -> int8, without
+            # changing size_preset) — never repeat a cascade the
+            # flagship already proved infeasible
             var = bench_diffusion(size, scheduler, True, height, width,
-                                  steps, iters)
+                                  steps, iters, ran_quant,
+                                  flagship["arch"]["offload"])
             out["step_cache_variant"] = {
                 k: var[k] for k in ("metric", "value", "unit",
                                     "seconds_per_image", "mfu")}

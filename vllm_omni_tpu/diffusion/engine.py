@@ -144,6 +144,16 @@ class DiffusionEngine:
                     f"{arch} does not support offload="
                     f"{od_config.offload!r}")
             extra_kwargs["offload"] = od_config.offload
+        step_loop = od_config.extra.get("step_loop")
+        if step_loop:
+            import inspect
+
+            if "step_loop" not in inspect.signature(
+                    pipeline_cls.__init__).parameters:
+                raise ValueError(
+                    f"{arch} does not support step_loop="
+                    f"{step_loop!r}")
+            extra_kwargs["step_loop"] = step_loop
         from_ckpt = (
             od_config.model
             and (os.path.isfile(os.path.join(od_config.model,
@@ -153,6 +163,21 @@ class DiffusionEngine:
                  or declared is not None)
             and hasattr(pipeline_cls, "from_pretrained")
         )
+        quant_at_init = False
+        if od_config.quantization in ("int8", "fp8", "int4") \
+                and not from_ckpt and not od_config.offload \
+                and mesh is None:  # sharded builds quantize post-hoc
+            import inspect
+
+            # pipelines exposing quantize_init quantize each DiT block as
+            # it is initialized — the only way a model whose bf16 tree
+            # exceeds HBM (real Qwen-Image: 41 GB vs 16 GB) can be built
+            # quantized-resident; post-hoc quantization would have to
+            # materialize the float tree first
+            if "quantize_init" in inspect.signature(
+                    pipeline_cls.__init__).parameters:
+                extra_kwargs["quantize_init"] = od_config.quantization
+                quant_at_init = True
         if from_ckpt:
             # diffusers-format checkpoint directory: real weights
             self.pipeline = pipeline_cls.from_pretrained(
@@ -186,7 +211,9 @@ class DiffusionEngine:
                 pipe_cfg, dtype=dtype, seed=od_config.seed,
                 cache_config=cache_config, mesh=mesh, **extra_kwargs,
             )
-        if od_config.quantization in ("int8", "fp8"):
+        if quant_at_init:
+            pass  # already quantized block-by-block during init
+        elif od_config.quantization in ("int8", "fp8", "int4"):
             from vllm_omni_tpu.diffusion.quantization import (
                 quantize_params,
                 quantize_params_host,
@@ -206,7 +233,7 @@ class DiffusionEngine:
         elif od_config.quantization:
             raise ValueError(
                 f"unsupported quantization {od_config.quantization!r} "
-                "(TPU path supports 'int8'/'fp8' weight-only)"
+                "(TPU path supports 'int8'/'fp8'/'int4' weight-only)"
             )
         from vllm_omni_tpu.diffusion.lora import LoRAManager
 

@@ -36,6 +36,40 @@ def quantize_linear_weight(w: jax.Array) -> dict:
 _FP8_MAX = 448.0  # float8_e4m3 finite max
 
 
+def quantize_linear_weight_int4(w: jax.Array) -> dict:
+    """[in, out] float -> {w_q4 int8 [ceil(in/2), out], w_scale f32 [out]}.
+
+    Two 4-bit values pack per byte along the IN dimension (row 2i in the
+    low nibble, row 2i+1 in the high nibble); per-out-channel absmax
+    scaling to [-7, 7].  Packed int8 rather than jnp.int4 storage: the
+    sub-byte dtype cannot cross a jit boundary on the axon TPU backend
+    (device_put recurses re-sharding S4 layouts), and packed bytes are
+    backend-portable.  4x smaller than bf16 — the lever that fits the
+    full 60-layer Qwen-Image DiT (41 GB bf16 -> 10.3 GB) resident in one
+    16 GB chip's HBM."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=0)  # [out]
+    scale = jnp.maximum(absmax / 7.0, 1e-12)
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -8, 7).astype(jnp.int8)
+    if q.shape[0] % 2:
+        q = jnp.pad(q, ((0, 1), (0, 0)))
+    lo, hi = q[0::2], q[1::2]
+    packed = jnp.bitwise_or(
+        jnp.left_shift(hi, 4), jnp.bitwise_and(lo, jnp.int8(0x0F)))
+    return {"w_q4": packed, "w_scale": scale}
+
+
+def unpack_int4(packed: jax.Array, in_dim: int, dtype) -> jax.Array:
+    """{[in//2, out] packed int8} -> [in, out] ``dtype`` values in
+    [-8, 7] (the inverse of ``quantize_linear_weight_int4``'s packing,
+    before the scale multiply).  Arithmetic shifts sign-extend both
+    nibbles; the interleave restores the original row order."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)
+    w = jnp.stack([lo, hi], axis=1).reshape(-1, packed.shape[1])
+    return w[:in_dim].astype(dtype)
+
+
 def quantize_linear_weight_fp8(w: jax.Array) -> dict:
     """[in, out] float -> {w_q float8_e4m3fn [in, out], w_scale f32 [out]}
     (reference: diffusion/quantization/fp8.py weight-only path)."""
@@ -89,6 +123,7 @@ def quantize_params(tree, min_size: int = 0, mode: str = "int8"):
     quantize = {
         "int8": quantize_linear_weight,
         "fp8": quantize_linear_weight_fp8,
+        "int4": quantize_linear_weight_int4,
     }[mode]
     out, n_quant = _quantize_tree(tree, quantize, min_size)
     logger.info("quantized %d linear weights to %s", n_quant, mode)
@@ -109,6 +144,16 @@ def quantize_linear_weight_host(w, mode: str = "int8") -> dict:
         scale = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
         w_q = np.clip(
             np.round(wf / scale[None, :]), -127, 127).astype(np.int8)
+    elif mode == "int4":
+        scale = np.maximum(absmax / 7.0, 1e-12).astype(np.float32)
+        q = np.clip(np.round(wf / scale[None, :]), -8, 7).astype(np.int8)
+        if q.shape[0] % 2:
+            q = np.pad(q, ((0, 1), (0, 0)))
+        lo, hi = q[0::2], q[1::2]
+        packed = np.bitwise_or(
+            np.left_shift(hi, 4),
+            np.bitwise_and(lo, np.int8(0x0F))).astype(np.int8)
+        return {"w_q4": packed, "w_scale": scale}
     elif mode == "fp8":
         import ml_dtypes
 

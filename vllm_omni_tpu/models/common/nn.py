@@ -39,6 +39,15 @@ def linear(p, x):
         # diffusion/quantization/fp8.py — TPU gets int8 first)
         w = p["w_q"].astype(x.dtype) * p["w_scale"].astype(x.dtype)
         y = x @ w
+    elif "w_q4" in p:
+        # int4 weight-only: two nibbles per stored byte, unpacked inline
+        # (diffusion/quantization.py) — quarter weight bandwidth, and the
+        # full 60-layer Qwen-Image DiT fits one chip's HBM resident
+        from vllm_omni_tpu.diffusion.quantization import unpack_int4
+
+        w = unpack_int4(p["w_q4"], x.shape[-1], x.dtype) \
+            * p["w_scale"].astype(x.dtype)
+        y = x @ w
     else:
         y = x @ p["w"]
     if "b" in p:

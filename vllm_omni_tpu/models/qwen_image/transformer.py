@@ -298,12 +298,42 @@ def forward(
         params, cfg, img_tokens, txt_states, timesteps, grid_hw,
         txt_mask=txt_mask, cond_grids=cond_grids, frames=frames,
     )
-    for blk in params["blocks"]:
-        img, txt = block_forward(
-            blk, cfg, img, txt, temb_act, img_freqs, txt_freqs, attn_fn,
-            kv_mask,
-        )
+    img, txt = walk_blocks(
+        params.get("blocks_stacked", params.get("blocks")), cfg, img,
+        txt, temb_act, img_freqs, txt_freqs, attn_fn, kv_mask,
+    )
     return forward_suffix(params, img, temb_act)
+
+
+def walk_blocks(blocks, cfg: QwenImageDiTConfig, img, txt, temb_act,
+                img_freqs, txt_freqs, attn_fn=None, kv_mask=None):
+    """Run the block stack: a Python loop over a LIST of per-block
+    pytrees (unrolled — lets XLA fuse across adjacent small blocks), or
+    lax.scan over a DICT stacked on a leading layer axis.
+
+    The scan form keeps the compiled program at ONE block's HLO instead
+    of L copies — at the real 60-layer geometry the unrolled program is
+    large enough to break remote-compile services outright — and pins
+    quantized-weight dequant inside the loop body where LICM can't hoist
+    L dequantized bf16 blocks out of the step loop (= 41 GB).  Same
+    math, identical per-block MXU shapes."""
+    if isinstance(blocks, dict):
+        def body(carry, blk):
+            c_img, c_txt = carry
+            c_img, c_txt = block_forward(
+                blk, cfg, c_img, c_txt, temb_act, img_freqs, txt_freqs,
+                attn_fn, kv_mask,
+            )
+            return (c_img, c_txt), None
+
+        (img, txt), _ = jax.lax.scan(body, (img, txt), blocks)
+        return img, txt
+    for blk in blocks:
+        img, txt = block_forward(
+            blk, cfg, img, txt, temb_act, img_freqs, txt_freqs,
+            attn_fn, kv_mask,
+        )
+    return img, txt
 
 
 def forward_prefix(
