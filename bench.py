@@ -136,13 +136,14 @@ def _pick_size() -> tuple:
     global _PROBE_GBPS
     env = os.environ.get("OMNI_BENCH_SIZE")
     quant_env = os.environ.get("OMNI_BENCH_QUANT", "")
-    if env == "real_q" or quant_env == "int4":
-        # real_q only exists as the quantized-resident config (bf16 at
-        # this depth is 41 GB — a guaranteed OOM), and int4 always means
-        # resident: neither needs the bandwidth probe
-        return "real_q", "int4", ""
-    if env:
+    if env:  # explicit size always wins
+        if env == "real_q":
+            # real_q only exists as the quantized-resident config (bf16
+            # at this depth is 41 GB — a guaranteed OOM)
+            return "real_q", quant_env or "int4", ""
         return env, quant_env, "layerwise" if env == "real" else ""
+    if quant_env == "int4":  # int4 means resident — no probe needed
+        return "real_q", "int4", ""
     gbps = _host_to_hbm_gbps()
     _PROBE_GBPS = round(gbps, 3)
     _progress(f"host->HBM throughput: {gbps:.2f} GB/s")
@@ -439,10 +440,16 @@ def bench_ar() -> dict:
     )
     _progress("ar: init bench-scale MoE thinker (~8.8 GB bf16)")
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.bfloat16)
+    # multi_step_decode=8: eight decode iterations per device call
+    # (on-device sampling) — on a remote-attached chip each host->device
+    # round trip costs network RTT, and single-step decode is RTT-bound
+    # (measured 0.5 s/step vs ~30 ms of compute).  1024 pages = 16k
+    # token slots: all 16 requests decode concurrently instead of two
+    # 8-seat waves, so TTFT measures prefill, not queueing.
     engine = LLMEngine(params, cfg, EngineConfig(
-        num_pages=512, page_size=16, max_model_len=2048,
-        max_num_seqs=8, max_num_batched_tokens=2048,
-        dtype=jnp.bfloat16,
+        num_pages=1024, page_size=16, max_model_len=2048,
+        max_num_seqs=16, max_num_batched_tokens=2048,
+        dtype=jnp.bfloat16, multi_step_decode=8,
     ))
 
     rng = np.random.default_rng(0)
@@ -453,9 +460,15 @@ def bench_ar() -> dict:
                         ignore_eos=True)
 
     _progress("ar: compile warmup (prefill + decode executables)")
-    engine.generate([prompts[0][:64]],
-                    SamplingParams(temperature=0.0, max_tokens=4,
-                                   ignore_eos=True))
+    # DIFFERENT random prompts at the SAME shapes as the timed run: the
+    # prefill bucket (512) and the batch-16 multi-step decode executable
+    # (two full windows) compile here, while the timed prompts stay cold
+    # in the prefix cache (identical warmup prompts would hand the timed
+    # run cached prefills and fake its TTFT)
+    warm = [rng.integers(1, 150000, prompt_len).tolist()
+            for _ in range(n_reqs)]
+    engine.generate(warm, SamplingParams(temperature=0.0, max_tokens=16,
+                                         ignore_eos=True))
 
     _progress(f"ar: timed run ({n_reqs} reqs, prompt {prompt_len}, "
               f"gen {max_tokens})")
@@ -501,6 +514,8 @@ def bench_ar() -> dict:
             "experts": f"top{cfg.num_experts_per_tok}of"
                        f"{cfg.num_experts}",
             "moe_intermediate": cfg.moe_intermediate_size,
+            "multi_step_decode": 8,
+            "max_num_seqs": 16,
             "note": "bench-scale thinker (real 30B-A3B is 60 GB bf16 — "
                     "exceeds one 16 GB chip; depth/expert count reduced "
                     "to fit resident, per-token structure real)",

@@ -49,6 +49,13 @@ class SchedulerConfig:
     # (drafts come from the runner's MTP head via req.spec_draft_tokens)
     num_speculative_tokens: int = 0
     kv_transfer: Optional[KVTransferConfig] = None
+    # multi-step decode: the runner may advance a pure-decode batch W
+    # steps in ONE device call (lax.scan with on-device sampling) —
+    # W-1 host<->device round trips saved per window, which is what
+    # dominates decode latency on remote-attached chips (vLLM's TPU
+    # backend ships the same idea).  The scheduler's part is allocating
+    # KV pages for the whole window up front.
+    multi_step_decode: int = 1
 
 
 @dataclass
@@ -59,6 +66,10 @@ class ScheduledRequest:
     block_table: list[int]
     # position of the first new token (== num_computed_tokens at schedule)
     start_pos: int
+    # decode window: KV pages are allocated for this many steps ahead so
+    # the runner may run them in one multi-step device call (window=1 =>
+    # classic one-token decode)
+    window: int = 1
 
     @property
     def is_prefill(self) -> bool:
@@ -264,17 +275,39 @@ class ARScheduler:
                 )
                 if n_spec > 0 and self.kv.can_allocate(req, 1 + n_spec):
                     n_new = 1 + n_spec
-            table = self.kv.allocate(req, n_new)
+            window = 1
+            if (n_new == 1 and self.config.multi_step_decode > 1
+                    and not req.spec_draft_tokens):
+                # allocate the whole decode window up front (clamped to
+                # the request's own remaining headroom) so the runner can
+                # compute per-step slots on device; surplus pages from a
+                # mid-window stop stay on the table and are reused or
+                # freed with the request
+                window = max(1, min(
+                    self.config.multi_step_decode,
+                    self.config.max_model_len - req.num_tokens,
+                    req.sampling_params.max_tokens
+                    - len(req.output_token_ids),
+                    budget,
+                ))
+            alloc_n = max(n_new, window)
+            table = self.kv.allocate(req, alloc_n)
+            if table is None and window > 1:
+                # window-ahead pages are an optimization, not a need:
+                # degrade to plain one-token decode before preempting
+                window = alloc_n = 1
+                table = self.kv.allocate(req, 1)
             if table is None:
                 self._preempt(req)
                 out.preempted.append(req)
                 continue
-            slots = self.kv.slot_mapping(req, n_new)
+            slots = self.kv.slot_mapping(req, alloc_n)
             out.decodes.append(ScheduledRequest(
                 request=req, num_new_tokens=n_new, slot_mapping=slots,
                 block_table=table, start_pos=req.num_computed_tokens,
+                window=window,
             ))
-            budget -= n_new
+            budget -= alloc_n
             still_running.append(req)
         self.running = still_running
 

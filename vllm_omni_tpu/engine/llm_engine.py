@@ -55,6 +55,13 @@ class EngineConfig:
     # speculative decoding: drafts per step (needs a draft_fn — the MTP
     # head, models/qwen3_omni/mtp.py); greedy requests only
     num_speculative_tokens: int = 0
+    # multi-step decode: run W decode iterations in one device call
+    # (on-device sampling inside a lax.scan) — amortizes the
+    # host<->device round trip that dominates decode latency on
+    # remote-attached chips; incompatible with spec decode,
+    # collect_hidden, and per-token logprobs (those batches fall back
+    # to single-step)
+    multi_step_decode: int = 1
     dtype: Any = jnp.bfloat16
     kv_transfer: Optional[KVTransferConfig] = None
     collect_hidden: bool = False
@@ -88,6 +95,9 @@ class LLMEngine:
             enable_chunked_prefill=config.enable_chunked_prefill,
             num_speculative_tokens=config.num_speculative_tokens,
             kv_transfer=config.kv_transfer,
+            multi_step_decode=(
+                1 if config.num_speculative_tokens else
+                config.multi_step_decode),
         )
         sched_cls = (GenerationScheduler if config.worker_type == "generation"
                      else ARScheduler)
@@ -130,6 +140,7 @@ class LLMEngine:
                 max_model_len=config.max_model_len, dtype=config.dtype,
                 collect_hidden=config.collect_hidden, seed=config.seed,
                 max_num_seqs=config.max_num_seqs, mesh=mesh,
+                multi_step_decode=config.multi_step_decode,
             )
         if (draft_fn is not None and config.num_speculative_tokens > 0
                 and hasattr(self.runner, "set_draft_fn")):

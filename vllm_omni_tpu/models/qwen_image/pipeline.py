@@ -397,37 +397,37 @@ class QwenImagePipeline:
         emitting blocks STACKED on a leading layer axis (the lax.scan
         layout ``dit.forward`` walks).
 
-        The init itself is a scan whose body is (init one bf16 block ->
-        quantize): the bf16 weights exist only as a ~0.7 GB transient
-        inside one scan iteration, and the scan's stacked output buffer
-        is allocated once at the quantized size.  This is how the real
-        60-layer geometry (41 GB bf16) builds on a 16 GB chip."""
-        import dataclasses
-
+        Uses ``init_params``' exact key schedule (split L+8; top from
+        keys[:6], block i from keys[i+8]) so the result is a
+        QUANTIZATION OF THE SAME random model a dense build produces —
+        dense-vs-quantized closeness tests stay meaningful.  The init is
+        a scan whose body is (init one bf16 block -> quantize): the bf16
+        weights exist only as a ~0.7 GB transient inside one scan
+        iteration, and the scan's stacked output buffer is allocated
+        once at the quantized size.  This is how the real 60-layer
+        geometry (41 GB bf16) builds on a 16 GB chip."""
         from vllm_omni_tpu.diffusion.quantization import quantize_params
 
-        cfg1 = dataclasses.replace(self.cfg.dit, num_layers=1)
+        cfg_d = self.cfg.dit
         dtype = self.dtype
 
         @jax.jit
-        def init_top(k):
-            q = quantize_params(dit.init_params(k, cfg1, dtype),
-                                mode=mode)
-            return {kk: v for kk, v in q.items() if kk != "blocks"}
+        def q_top(ks):
+            return quantize_params(dit.init_top(ks, cfg_d, dtype=dtype),
+                                   mode=mode)
 
         @jax.jit
-        def init_blocks(ks):
+        def q_blocks(ks):
             def body(carry, k):
-                q = quantize_params(dit.init_params(k, cfg1, dtype),
-                                    mode=mode)
-                return carry, q["blocks"][0]
+                blk = dit.init_block(k, cfg_d, dtype=dtype)
+                return carry, quantize_params(blk, mode=mode)
 
             _, stacked = jax.lax.scan(body, None, ks)
             return stacked
 
-        keys = jax.random.split(key, self.cfg.dit.num_layers + 1)
-        out = init_top(keys[0])
-        out["blocks_stacked"] = init_blocks(keys[1:])
+        keys = jax.random.split(key, cfg_d.num_layers + 8)
+        out = q_top(keys[:8])
+        out["blocks_stacked"] = q_blocks(keys[8:])
         return out
 
     @classmethod

@@ -64,11 +64,10 @@ class QwenImageDiTConfig:
         )
 
 
-def init_params(key, cfg: QwenImageDiTConfig, dtype=jnp.float32):
+def init_top(keys, cfg: QwenImageDiTConfig, dtype=jnp.float32):
+    """Non-block params from the first 6 of ``init_params``' key array."""
     inner = cfg.inner_dim
-    mlp = int(inner * cfg.mlp_ratio)
-    keys = jax.random.split(key, cfg.num_layers + 8)
-    p = {
+    return {
         "img_in": nn.linear_init(keys[0], cfg.in_channels, inner, dtype=dtype),
         "txt_norm": nn.rmsnorm_init(cfg.joint_dim, dtype),
         "txt_in": nn.linear_init(keys[1], cfg.joint_dim, inner, dtype=dtype),
@@ -78,35 +77,49 @@ def init_params(key, cfg: QwenImageDiTConfig, dtype=jnp.float32):
         "proj_out": nn.linear_init(
             keys[5], inner, cfg.patch_size**2 * cfg.out_channels, dtype=dtype
         ),
-        "blocks": [],
     }
-    for i in range(cfg.num_layers):
-        k = jax.random.split(keys[i + 8], 12)
-        blk = {
-            "img_mod": nn.linear_init(k[0], inner, 6 * inner, dtype=dtype),
-            "txt_mod": nn.linear_init(k[1], inner, 6 * inner, dtype=dtype),
-            "to_q": nn.linear_init(k[2], inner, inner, dtype=dtype),
-            "to_k": nn.linear_init(k[3], inner, inner, dtype=dtype),
-            "to_v": nn.linear_init(k[4], inner, inner, dtype=dtype),
-            "add_q": nn.linear_init(k[5], inner, inner, dtype=dtype),
-            "add_k": nn.linear_init(k[6], inner, inner, dtype=dtype),
-            "add_v": nn.linear_init(k[7], inner, inner, dtype=dtype),
-            "norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
-            "norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
-            "norm_added_q": nn.rmsnorm_init(cfg.head_dim, dtype),
-            "norm_added_k": nn.rmsnorm_init(cfg.head_dim, dtype),
-            "to_out": nn.linear_init(k[8], inner, inner, dtype=dtype),
-            "to_add_out": nn.linear_init(k[9], inner, inner, dtype=dtype),
-            "img_mlp1": nn.linear_init(k[10], inner, mlp, dtype=dtype),
-            "img_mlp2": nn.linear_init(k[11], mlp, inner, dtype=dtype),
-            "txt_mlp1": nn.linear_init(
-                jax.random.fold_in(k[10], 1), inner, mlp, dtype=dtype
-            ),
-            "txt_mlp2": nn.linear_init(
-                jax.random.fold_in(k[11], 1), mlp, inner, dtype=dtype
-            ),
-        }
-        p["blocks"].append(blk)
+
+
+def init_block(key, cfg: QwenImageDiTConfig, dtype=jnp.float32):
+    """One MMDiT block from its per-block key (``init_params`` passes
+    keys[i + 8]; blockwise quantized init reuses the SAME schedule so a
+    quantized build is a quantization of the identical random model)."""
+    inner = cfg.inner_dim
+    mlp = int(inner * cfg.mlp_ratio)
+    k = jax.random.split(key, 12)
+    return {
+        "img_mod": nn.linear_init(k[0], inner, 6 * inner, dtype=dtype),
+        "txt_mod": nn.linear_init(k[1], inner, 6 * inner, dtype=dtype),
+        "to_q": nn.linear_init(k[2], inner, inner, dtype=dtype),
+        "to_k": nn.linear_init(k[3], inner, inner, dtype=dtype),
+        "to_v": nn.linear_init(k[4], inner, inner, dtype=dtype),
+        "add_q": nn.linear_init(k[5], inner, inner, dtype=dtype),
+        "add_k": nn.linear_init(k[6], inner, inner, dtype=dtype),
+        "add_v": nn.linear_init(k[7], inner, inner, dtype=dtype),
+        "norm_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+        "norm_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+        "norm_added_q": nn.rmsnorm_init(cfg.head_dim, dtype),
+        "norm_added_k": nn.rmsnorm_init(cfg.head_dim, dtype),
+        "to_out": nn.linear_init(k[8], inner, inner, dtype=dtype),
+        "to_add_out": nn.linear_init(k[9], inner, inner, dtype=dtype),
+        "img_mlp1": nn.linear_init(k[10], inner, mlp, dtype=dtype),
+        "img_mlp2": nn.linear_init(k[11], mlp, inner, dtype=dtype),
+        "txt_mlp1": nn.linear_init(
+            jax.random.fold_in(k[10], 1), inner, mlp, dtype=dtype
+        ),
+        "txt_mlp2": nn.linear_init(
+            jax.random.fold_in(k[11], 1), mlp, inner, dtype=dtype
+        ),
+    }
+
+
+def init_params(key, cfg: QwenImageDiTConfig, dtype=jnp.float32):
+    keys = jax.random.split(key, cfg.num_layers + 8)
+    p = init_top(keys, cfg, dtype=dtype)
+    p["blocks"] = [
+        init_block(keys[i + 8], cfg, dtype=dtype)
+        for i in range(cfg.num_layers)
+    ]
     return p
 
 
