@@ -222,10 +222,15 @@ def _build_engine(size: str, scheduler: str, use_cache: bool,
     if scheduler:
         extra["scheduler"] = scheduler
     if size == "real_q":
-        # per-step device calls: a 60-layer 50-step single execution
+        # chunked device calls: a 60-layer 50-step single execution
         # runs minutes in one RPC and the tunnel transport killed the
-        # TPU worker mid-flight ("kernel fault") when we tried it
+        # TPU worker mid-flight ("kernel fault") when we tried it, but
+        # per-STEP calls pay one network round trip per step — chunks
+        # of a few steps (~5-10 s each) amortize the RTT and stay far
+        # under the transport's per-call ceiling
         extra["step_loop"] = "host"
+        extra["step_chunk"] = int(
+            os.environ.get("OMNI_BENCH_STEP_CHUNK", "5"))
     cfg = OmniDiffusionConfig(
         model="qwen-image-bench", model_arch="QwenImagePipeline",
         dtype="bfloat16", extra=extra,
@@ -461,13 +466,17 @@ def bench_ar() -> dict:
 
     _progress("ar: compile warmup (prefill + decode executables)")
     # DIFFERENT random prompts at the SAME shapes as the timed run: the
-    # prefill bucket (512) and the batch-16 multi-step decode executable
-    # (two full windows) compile here, while the timed prompts stay cold
-    # in the prefix cache (identical warmup prompts would hand the timed
-    # run cached prefills and fake its TTFT)
+    # prefill bucket (512) and every decode executable compile here,
+    # while the timed prompts stay cold in the prefix cache (identical
+    # warmup prompts would hand the timed run cached prefills and fake
+    # its TTFT).  max_tokens must keep the FIRST prefill wave decoding
+    # until the LAST wave joins (prefills drain over ~5 steps at the
+    # 2048-token budget) or the full-batch decode executable never
+    # compiles in warmup — a measured 23 s compile stall inside the r05
+    # timed run.  6 windows of 8 covers the 5-step prefill drain.
     warm = [rng.integers(1, 150000, prompt_len).tolist()
             for _ in range(n_reqs)]
-    engine.generate(warm, SamplingParams(temperature=0.0, max_tokens=16,
+    engine.generate(warm, SamplingParams(temperature=0.0, max_tokens=48,
                                          ignore_eos=True))
 
     _progress(f"ar: timed run ({n_reqs} reqs, prompt {prompt_len}, "

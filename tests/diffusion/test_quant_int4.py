@@ -174,11 +174,14 @@ def test_stacked_scan_matches_unrolled():
     np.testing.assert_array_equal(gen(stacked), gen(unrolled))
 
 
-def test_host_step_loop_matches_device_loop():
+@pytest.mark.parametrize("chunk", [1, 3])
+def test_host_step_loop_matches_device_loop(chunk):
     """step_loop='host' re-invokes the compiled denoise executable with
-    num_steps=1 on a schedule rolled to step i (the single-RPC-ceiling
-    workaround for remote-attached chips).  Identical math to the
-    device fori_loop: images must match exactly."""
+    num_steps=k on a schedule rolled to the chunk start (the
+    single-RPC-ceiling workaround for remote-attached chips; chunk>1
+    amortizes the per-call round trip).  chunk=3 over 4 steps also
+    exercises the final partial chunk.  Identical math to the device
+    fori_loop: images must match exactly."""
     from vllm_omni_tpu.models.qwen_image.pipeline import (
         QwenImagePipeline,
         QwenImagePipelineConfig,
@@ -187,7 +190,8 @@ def test_host_step_loop_matches_device_loop():
     cfg = QwenImagePipelineConfig.tiny()
     dev = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0)
     host = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
-                             init_weights=False, step_loop="host")
+                             init_weights=False, step_loop="host",
+                             step_chunk=chunk)
     host.dit_params = dev.dit_params
     host.text_params = dev.text_params
     host.vae_params = dev.vae_params

@@ -41,15 +41,29 @@ def test_multi_step_greedy_matches_single_step(tiny_model):
 
 
 def test_multi_step_window_not_dividing_max_tokens(tiny_model):
-    """max_tokens=10 with W=4: two full windows then a clamped-window
-    batch that falls back to single-step — output still exact."""
+    """max_tokens=10 with W=4: the tail window still runs FULL-width
+    (the overshoot is trimmed host-side) — output exact, and no
+    intermediate scan length is ever scheduled.  Distinct scan lengths
+    compile distinct executables; a mid-run tail compile measured 21 s
+    on a remote-attached chip."""
     params, cfg = tiny_model
     sp = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
     base = _engine(params, cfg).generate(PROMPTS, sp)
-    multi = _engine(params, cfg, multi_step_decode=4).generate(PROMPTS, sp)
+    eng = _engine(params, cfg, multi_step_decode=4)
+    seen = set()
+    orig = eng.runner.execute
+
+    def spy(sched_out, extract_kv=True):
+        for s in sched_out.decodes:
+            seen.add(s.window)
+        return orig(sched_out, extract_kv)
+
+    eng.runner.execute = spy
+    multi = eng.generate(PROMPTS, sp)
     for b, m in zip(base, multi):
         assert m.outputs[0].token_ids == b.outputs[0].token_ids
         assert len(m.outputs[0].token_ids) == 10
+    assert seen <= {1, 4}, f"intermediate scan lengths scheduled: {seen}"
 
 
 def test_multi_step_eos_truncates_mid_window(tiny_model):

@@ -128,6 +128,30 @@ def test_xla_chunked_matches_ref(rng, causal, return_lse):
         )
 
 
+def test_auto_blocks():
+    """Shape-aware tiling: divisors of the sequence beat padded blocks
+    (measured 68% vs 13% MFU at the 4608-token DiT shape), and the score
+    block stays under the VMEM cap."""
+    from vllm_omni_tpu.ops.attention import _SCORE_CAP, _auto_blocks
+
+    bq, bk = _auto_blocks(4608, 4608, 128)
+    assert (bq, bk) == (2304, 768)  # exact divisors, measured optimum
+    assert bq * bk <= _SCORE_CAP
+
+    bq, bk = _auto_blocks(131072, 131072, 128)
+    assert 131072 % bq == 0 and 131072 % bk == 0
+    assert bq * bk <= _SCORE_CAP
+
+    bq, bk = _auto_blocks(17, 45, 64)
+    assert bq <= 17 and bk <= 45  # clamped to the sequence
+
+    bq, bk = _auto_blocks(4608, 4608, 256)  # bigger head dim halves cap
+    assert bq * bk <= _SCORE_CAP // 2
+
+    bq, bk = _auto_blocks(4608, 4608, 128, itemsize=4)  # f32 halves cap
+    assert bq * bk <= _SCORE_CAP // 2
+
+
 def test_fallback_dispatch_uses_chunked(rng, monkeypatch):
     """flash_attention(use_pallas=False) routes to the chunked path."""
     import vllm_omni_tpu.ops.attention as A

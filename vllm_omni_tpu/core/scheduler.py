@@ -278,18 +278,22 @@ class ARScheduler:
             window = 1
             if (n_new == 1 and self.config.multi_step_decode > 1
                     and not req.spec_draft_tokens):
-                # allocate the whole decode window up front (clamped to
-                # the request's own remaining headroom) so the runner can
-                # compute per-step slots on device; surplus pages from a
-                # mid-window stop stay on the table and are reused or
-                # freed with the request
-                window = max(1, min(
-                    self.config.multi_step_decode,
-                    self.config.max_model_len - req.num_tokens,
-                    req.sampling_params.max_tokens
-                    - len(req.output_token_ids),
-                    budget,
-                ))
+                # Full window or none: every distinct scan length is a
+                # separate executable, and a runtime compile costs tens
+                # of seconds on a remote-attached chip (a measured 21 s
+                # stall when a request's last window degraded to
+                # max_tokens%W).  A request near max_tokens runs the
+                # FULL window into its up-front-allocated pages and the
+                # runner trims the overshoot host-side
+                # (_truncate_at_stop); KV past the stop is unreferenced
+                # garbage freed with the request.  Only a hard slot
+                # ceiling (max_model_len) or an exhausted token budget
+                # degrades — to the single-step path, whose executable
+                # always exists, never to an intermediate length.
+                w = self.config.multi_step_decode
+                if (w <= self.config.max_model_len - req.num_tokens
+                        and w <= budget):
+                    window = w
             alloc_n = max(n_new, window)
             table = self.kv.allocate(req, alloc_n)
             if table is None and window > 1:

@@ -135,25 +135,27 @@ class DiffusionEngine:
             )
         self.mesh = mesh
         extra_kwargs = {}
-        if od_config.offload:
+
+        def require_ctor_param(name, value):
+            # optional pipeline features are opted into per-arch by
+            # declaring the kwarg; anything else fails loudly here
+            # rather than as a TypeError deep in the constructor
             import inspect
 
-            if "offload" not in inspect.signature(
+            if name not in inspect.signature(
                     pipeline_cls.__init__).parameters:
                 raise ValueError(
-                    f"{arch} does not support offload="
-                    f"{od_config.offload!r}")
-            extra_kwargs["offload"] = od_config.offload
+                    f"{arch} does not support {name}={value!r}")
+            extra_kwargs[name] = value
+
+        if od_config.offload:
+            require_ctor_param("offload", od_config.offload)
         step_loop = od_config.extra.get("step_loop")
         if step_loop:
-            import inspect
-
-            if "step_loop" not in inspect.signature(
-                    pipeline_cls.__init__).parameters:
-                raise ValueError(
-                    f"{arch} does not support step_loop="
-                    f"{step_loop!r}")
-            extra_kwargs["step_loop"] = step_loop
+            require_ctor_param("step_loop", step_loop)
+        step_chunk = od_config.extra.get("step_chunk")
+        if step_chunk is not None:  # 0 must reach pipeline validation
+            require_ctor_param("step_chunk", int(step_chunk))
         from_ckpt = (
             od_config.model
             and (os.path.isfile(os.path.join(od_config.model,

@@ -225,6 +225,7 @@ class QwenImagePipeline:
         offload: str = "",  # "" | "layerwise" (weights stream from host)
         quantize_init: str = "",  # "" | "int8" | "fp8" | "int4"
         step_loop: str = "device",  # "device" (fori_loop) | "host"
+        step_chunk: int = 1,  # denoise steps per device call (host loop)
     ):
         from vllm_omni_tpu.parallel.pipeline_mesh import MeshWiring
 
@@ -247,16 +248,20 @@ class QwenImagePipeline:
         if offload not in ("", "layerwise"):
             raise ValueError(f"unknown offload mode {offload!r}")
         self.step_loop = step_loop
+        self.step_chunk = int(step_chunk)
         if step_loop not in ("device", "host"):
             raise ValueError(f"unknown step_loop mode {step_loop!r}")
+        if self.step_chunk < 1:
+            raise ValueError(f"step_chunk must be >=1, got {step_chunk}")
         if step_loop == "host":
-            # One jitted denoise STEP per device call instead of the
-            # whole loop in one call: a 60-layer 50-step execution runs
-            # minutes in a single RPC, which remote-attached TPUs
-            # (tunnel transports) can kill mid-flight; per-step calls
-            # (~seconds) stay under any per-call ceiling at <0.1%
-            # dispatch overhead.  Same executable, num_steps=1 on a
-            # schedule rolled to step i.
+            # A CHUNK of jitted denoise steps per device call instead of
+            # the whole loop in one call: a 60-layer 50-step execution
+            # runs minutes in a single RPC, which remote-attached TPUs
+            # (tunnel transports) can kill mid-flight; chunked calls
+            # (seconds each) stay under any per-call ceiling while
+            # amortizing the per-RPC round trip over step_chunk steps.
+            # Same executable for every chunk size — num_steps is a
+            # traced scalar, the schedule is rolled to the chunk start.
             if mesh is not None:
                 raise ValueError("step_loop='host' is single-device")
             if offload == "layerwise":
@@ -993,19 +998,20 @@ class QwenImagePipeline:
                 cond_grids=cond_grids, frames=frames)
             gscale = jnp.float32(sp.guidance_scale)
             if self.step_loop == "host":
-                # one step per device call (see __init__): the SAME
-                # compiled executable runs with num_steps=1 over the
-                # schedule rolled so index 0 is step i
+                # step_chunk steps per device call (see __init__): the
+                # SAME compiled executable runs with num_steps=k over
+                # the schedule rolled so index 0 is the chunk start
                 import time as _time
 
                 t_start = _time.perf_counter()
                 latents = noise
-                for i in range(num_steps):
+                for i in range(0, num_steps, self.step_chunk):
+                    k = min(self.step_chunk, num_steps - i)
                     latents, _ = run(
                         self.dit_params, latents, txt, txt_mask,
                         neg_txt, neg_mask,
                         jnp.roll(sigmas, -i), jnp.roll(timesteps, -i),
-                        gscale, jnp.int32(1), cond=cond_tokens,
+                        gscale, jnp.int32(k), cond=cond_tokens,
                     )
                 jax.block_until_ready(latents)
                 self.last_skipped_steps = 0

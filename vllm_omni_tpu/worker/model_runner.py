@@ -304,19 +304,23 @@ class ARModelRunner:
         plain = [s for s in sched_out.decodes if s.num_new_tokens == 1]
         spec = [s for s in sched_out.decodes if s.num_new_tokens > 1]
         if plain:
-            # Multi-step window: the batch runs min(window) steps in one
-            # call — every request has at least that many pages
-            # allocated, and requests near their max_tokens degrade the
-            # window instead of cliffing the whole batch back to
-            # single-step.  Distinct scan lengths compile separate
-            # executables, bounded by the configured window count.
-            w = min((s.window for s in plain), default=1)
-            if (w > 1 and self._decode_multi_fn is not None
+            # Multi-step window: the scheduler hands out the FULL
+            # configured window or window=1, never an intermediate
+            # length (each distinct scan length is its own executable —
+            # a mid-run tail compile measured 21 s on a remote chip).
+            # The rare window=1 stragglers (near max_model_len / budget
+            # exhaustion) run as their own single-step batch instead of
+            # cliffing the windowed batch down with them.
+            full = [s for s in plain if s.window > 1]
+            single = [s for s in plain if s.window == 1]
+            if (full and self._decode_multi_fn is not None
                     and self.draft_fn is None
                     and not self.collect_hidden
                     and all(s.request.sampling_params.logprobs is None
-                            for s in plain)):
-                self._run_decode_multi(plain, w, out)
+                            for s in full)):
+                self._run_decode_multi(full, full[0].window, out)
+                if single:
+                    self._run_decode(single, out)
             else:
                 self._run_decode(plain, out)
         if spec:
