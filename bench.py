@@ -445,20 +445,26 @@ def bench_ar() -> dict:
     )
     _progress("ar: init bench-scale MoE thinker (~8.8 GB bf16)")
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.bfloat16)
-    # multi_step_decode=8: eight decode iterations per device call
-    # (on-device sampling) — on a remote-attached chip each host->device
-    # round trip costs network RTT, and single-step decode is RTT-bound
-    # (measured 0.5 s/step vs ~30 ms of compute).  1024 pages = 16k
-    # token slots: all 16 requests decode concurrently instead of two
-    # 8-seat waves, so TTFT measures prefill, not queueing.
+    # multi_step_decode: W decode iterations per device call (on-device
+    # sampling) — on a remote-attached chip each host->device round trip
+    # costs network RTT, and single-step decode is RTT-bound (measured
+    # 0.5 s/step vs ~30 ms of compute; W=8 took the probe from 35 to
+    # 231 tok/s once mid-run compiles were gone).  The 8192-token
+    # prefill budget admits all 16 default requests in ONE prefill call
+    # (4 calls at the old 2048), so TTFT measures prefill, not RTT
+    # queueing.  64 pages/request = full prompt+gen headroom for every
+    # seat, so the whole fleet decodes concurrently.
+    n_reqs = int(os.environ.get("OMNI_BENCH_AR_REQS", "16"))
+    mbt = int(os.environ.get("OMNI_BENCH_AR_BATCHED", "8192"))
+    w = int(os.environ.get("OMNI_BENCH_AR_WINDOW", "8"))
     engine = LLMEngine(params, cfg, EngineConfig(
-        num_pages=1024, page_size=16, max_model_len=2048,
-        max_num_seqs=16, max_num_batched_tokens=2048,
-        dtype=jnp.bfloat16, multi_step_decode=8,
+        num_pages=64 * n_reqs, page_size=16, max_model_len=2048,
+        max_num_seqs=n_reqs, max_num_batched_tokens=mbt,
+        dtype=jnp.bfloat16, multi_step_decode=w,
     ))
 
     rng = np.random.default_rng(0)
-    prompt_len, max_tokens, n_reqs = 512, 128, 16
+    prompt_len, max_tokens = 512, 128
     prompts = [rng.integers(1, 150000, prompt_len).tolist()
                for _ in range(n_reqs)]
     sp = SamplingParams(temperature=0.0, max_tokens=max_tokens,
@@ -470,14 +476,15 @@ def bench_ar() -> dict:
     # while the timed prompts stay cold in the prefix cache (identical
     # warmup prompts would hand the timed run cached prefills and fake
     # its TTFT).  max_tokens must keep the FIRST prefill wave decoding
-    # until the LAST wave joins (prefills drain over ~5 steps at the
-    # 2048-token budget) or the full-batch decode executable never
-    # compiles in warmup — a measured 23 s compile stall inside the r05
-    # timed run.  6 windows of 8 covers the 5-step prefill drain.
+    # until the LAST wave joins or the full-batch decode executable
+    # never compiles in warmup — a measured 23 s compile stall inside
+    # the r05 timed run.  (waves + 2) windows covers the prefill drain
+    # at any request count / token budget.
+    waves = -(-n_reqs * prompt_len // mbt)
     warm = [rng.integers(1, 150000, prompt_len).tolist()
             for _ in range(n_reqs)]
-    engine.generate(warm, SamplingParams(temperature=0.0, max_tokens=48,
-                                         ignore_eos=True))
+    engine.generate(warm, SamplingParams(
+        temperature=0.0, max_tokens=(waves + 2) * w, ignore_eos=True))
 
     _progress(f"ar: timed run ({n_reqs} reqs, prompt {prompt_len}, "
               f"gen {max_tokens})")
@@ -523,8 +530,9 @@ def bench_ar() -> dict:
             "experts": f"top{cfg.num_experts_per_tok}of"
                        f"{cfg.num_experts}",
             "moe_intermediate": cfg.moe_intermediate_size,
-            "multi_step_decode": 8,
-            "max_num_seqs": 16,
+            "multi_step_decode": w,
+            "max_num_seqs": n_reqs,
+            "max_num_batched_tokens": mbt,
             "note": "bench-scale thinker (real 30B-A3B is 60 GB bf16 — "
                     "exceeds one 16 GB chip; depth/expert count reduced "
                     "to fit resident, per-token structure real)",

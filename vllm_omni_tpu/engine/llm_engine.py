@@ -62,6 +62,12 @@ class EngineConfig:
     # collect_hidden, and per-token logprobs (those batches fall back
     # to single-step)
     multi_step_decode: int = 1
+    # precompile bucketed executables before serving: True warms every
+    # decode batch bucket; a list of (batch, seq_len) pairs additionally
+    # warms those prefill shapes.  A shape-cache miss mid-traffic stalls
+    # all in-flight requests for a full XLA compile (20-40 s per shape
+    # on a remote-attached chip) — see ARModelRunner.precompile.
+    warmup: Any = False  # bool | list[(batch, seq_len)]
     dtype: Any = jnp.bfloat16
     kv_transfer: Optional[KVTransferConfig] = None
     collect_hidden: bool = False
@@ -152,6 +158,26 @@ class LLMEngine:
         self.kv_transfer_sink: Optional[Callable] = None
         self._req_counter = 0
         self._starved_ticks = 0
+        if config.warmup:
+            shapes = (config.warmup if isinstance(
+                config.warmup, (list, tuple)) else ())
+            n = self.warmup(prefill_shapes=shapes)
+            logger.info(
+                "engine warmup: %d executables precompiled before "
+                "serving", n)
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, prefill_shapes=(), progress_fn=None) -> int:
+        """Precompile the runner's bucketed executables before serving
+        (every decode batch bucket, plus the given (batch, seq_len)
+        prefill shapes).  A shape-cache miss mid-traffic stalls all
+        in-flight requests for a full XLA compile — 20-40 s per shape
+        on a remote-attached chip.  Returns executables requested.
+        Reference analogue: worker warmup / graph capture before the
+        engine goes live."""
+        fn = getattr(self.runner, "precompile", None)
+        return 0 if fn is None else fn(
+            prefill_shapes=prefill_shapes, progress_fn=progress_fn)
 
     # ------------------------------------------------------------- intake
     def add_request(

@@ -222,6 +222,9 @@ class OmniStage:
                     params, model_cfg,
                     eng_kwargs["num_speculative_tokens"],
                 )
+            # EngineConfig(warmup=...) in the stage YAML precompiles the
+            # bucketed executables inside LLMEngine.__init__ — before
+            # the stage reports ready, so traffic never hits a compile
             engine = LLMEngine(params, model_cfg, EngineConfig(**eng_kwargs),
                                eos_token_id=eos, draft_fn=draft_fn)
             if engine.config.kv_transfer is not None:

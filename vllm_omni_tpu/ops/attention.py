@@ -538,8 +538,9 @@ def flash_attention(
             # the XLA fallback has its own memory model (peak is
             # O(B*H*Sq*block_k) f32 — Pallas-VMEM-tuned sizes would
             # multiply it 4x at video sequence lengths); block_q is
-            # ignored there entirely
-            abq, abk = 256, 512
+            # ignored there entirely.  256 preserves the pre-auto-tune
+            # default this path always ran with.
+            abq, abk = 256, 256
         block_q = abq if block_q is None else block_q
         block_k = abk if block_k is None else block_k
     return _flash_attention(

@@ -295,6 +295,138 @@ class ARModelRunner:
         except (TypeError, ValueError):
             self._draft_takes_contexts = False
 
+    # ---------------------------------------------------------- precompile
+    def precompile(self, prefill_shapes=(), decode: bool = True,
+                   progress_fn=None) -> int:
+        """Build bucketed executables BEFORE serving traffic.
+
+        XLA compiles one executable per input-shape signature, and a
+        cache miss mid-traffic stalls every in-flight request for the
+        full compile — measured 20-40 s per shape on a remote-attached
+        chip (the reference warms its runner at startup for the same
+        reason: worker warmup / CUDA-graph capture,
+        vllm_omni/worker/gpu_ar_model_runner.py capture path).
+
+        ``decode`` compiles the single-step and (when configured)
+        multi-step executables for every batch bucket — engine traffic
+        can only ever produce those two scan lengths (core/scheduler.py
+        hands out the full window or 1) — plus, when a draft head is
+        installed, the spec-verify executable at its candidate length.
+        ``prefill_shapes`` is an iterable of (batch, seq_len) pairs for
+        the prompt shapes the deployment expects — bucketed and deduped
+        here, so callers pass raw traffic shapes.  Each pair warms BOTH
+        the fresh-prefill and the chunked-continuation executable at
+        EVERY batch bucket up to the given batch (APC prefix hits and
+        scheduler admission split one arrival wave into smaller
+        fresh/chunked sub-batches, each bucketed separately); a
+        continuation whose remainder buckets to a seq bucket not listed
+        still compiles on first hit — include the chunk lengths you
+        expect in ``prefill_shapes``.  Dummy inputs
+        write to KV slot -1, which the paged cache update drops
+        (ops/paged_attention.py write_kv mode="drop"), so the live KV
+        pool is untouched.
+
+        Returns the number of executables requested (cached ones are
+        free)."""
+        built = 0
+
+        def note(msg):
+            if progress_fn is not None:
+                progress_fn(msg)
+
+        def pos_shape(b, s=None):
+            if s is None:
+                return (b, 3) if self.use_mrope else (b,)
+            return (b, 3, s) if self.use_mrope else (b, s)
+
+        if decode:
+            for b in self._batch_buckets:
+                note(f"precompile decode b={b}")
+                zeros_b = jnp.zeros((b,), jnp.int32)
+                tables = jnp.zeros((b, self.max_pages_per_seq), jnp.int32)
+                _, _, self.kv_caches = self._decode_fn(
+                    self.params, zeros_b, self.kv_caches,
+                    jnp.zeros(pos_shape(b), jnp.int32),
+                    jnp.full((b,), -1, jnp.int32), tables,
+                    jnp.ones((b,), jnp.int32))
+                built += 1
+                if (self.multi_step_decode > 1
+                        and self._decode_multi_fn is not None):
+                    t = SamplingTensors.build(
+                        [SamplingParams()] * b, step=0,
+                        base_seed=self._base_seed)
+                    # valid=False derives slot -1 on device: the whole
+                    # window's KV writes drop
+                    toks, self.kv_caches = self._decode_multi_fn(
+                        self.params, zeros_b, self.kv_caches,
+                        jnp.zeros(pos_shape(b), jnp.int32), zeros_b,
+                        jnp.zeros((b,), bool), tables,
+                        t.temperature, t.top_k, t.top_p, t.keys,
+                        self.multi_step_decode)
+                    jax.block_until_ready(toks)
+                    built += 1
+                if self.draft_fn is not None and self.num_draft_tokens:
+                    # spec-decode verify batches run at the candidate
+                    # length (1 regular + k draft positions)
+                    s = _bucket(1 + self.num_draft_tokens,
+                                self._seq_buckets)
+                    _, _, self.kv_caches = self._verify_fn(
+                        self.params, jnp.zeros((b, s), jnp.int32),
+                        self.kv_caches,
+                        jnp.zeros(pos_shape(b, s), jnp.int32),
+                        jnp.full((b, s), -1, jnp.int32), tables,
+                        jnp.ones((b,), jnp.int32),
+                        jnp.zeros((b,), jnp.int32))
+                    built += 1
+
+        todo = set()
+        seen_chunks = set()
+        for raw_b, raw_s in prefill_shapes:
+            b_top = _bucket(min(raw_b, self._batch_buckets[-1]),
+                            self._batch_buckets)
+            s = _bucket(min(raw_s, self._seq_buckets[-1]),
+                        self._seq_buckets)
+            todo.update((b, s) for b in self._batch_buckets
+                        if b <= b_top)
+        for b, s in sorted(todo):
+            note(f"precompile prefill b={b} s={s}")
+            # trailing (None, None, None) mirrors _prefill_common's
+            # *embeds_args for a token-only batch: jit's cache key
+            # covers the argument TREE, so the same shapes with a
+            # different arity would still be a fresh executable
+            _, _, _, self.kv_caches = self._prefill_fn(
+                self.params, jnp.zeros((b, s), jnp.int32),
+                self.kv_caches, jnp.zeros(pos_shape(b, s), jnp.int32),
+                jnp.full((b, s), -1, jnp.int32),
+                jnp.zeros((b,), jnp.int32), None, None, None)
+            built += 1
+            # APC prefix hits / chunked-prefill continuations run the
+            # chunked executable; its signature is (batch, chunk bucket,
+            # context pages) where pages derive from the CONTEXT's seq
+            # bucket (_cont_tables).  Warm the two dominant combos for
+            # this context: a full-width chunk (recompute/resume) and a
+            # minimum-bucket chunk (short APC remainder after a long
+            # cached prefix).  Intermediate chunk buckets still compile
+            # on first hit — list them in prefill_shapes if expected.
+            pages = -(-s // self.page_size)
+            for s_chunk in {s, self._seq_buckets[0]}:
+                key = ("chunk", b, s_chunk, pages)
+                if key in seen_chunks:
+                    continue
+                seen_chunks.add(key)
+                _, _, _, self.kv_caches = self._chunk_prefill_fn(
+                    self.params, jnp.zeros((b, s_chunk), jnp.int32),
+                    self.kv_caches,
+                    jnp.zeros(pos_shape(b, s_chunk), jnp.int32),
+                    jnp.full((b, s_chunk), -1, jnp.int32),
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.zeros((b, pages), jnp.int32),
+                    jnp.ones((b,), jnp.int32),
+                    jnp.zeros((b,), jnp.int32),
+                    None, None, None)
+                built += 1
+        return built
+
     # ---------------------------------------------------------------- step
     def execute(
         self, sched_out: SchedulerOutput, extract_kv: bool = True
