@@ -263,10 +263,33 @@ class ARModelRunner:
             self._chunk_prefill_fn = wrap(_chunk_prefill, 9, 3)
             self._verify_fn = wrap(_verify, 5, 2)
             self._decode_fn = wrap(_decode, 4, 2)
-            # multi-step decode under shard_map needs its own spec
-            # wiring (scan carry of sharded KV) — TP batches run the
-            # classic one-step path for now
-            self._decode_multi_fn = None
+
+            # Multi-step decode under TP: the scan lives INSIDE the
+            # shard_map body, so the KV carry stays on local shard
+            # shapes throughout the window.  The per-layer psums make
+            # hidden/logits replicated, and sampling is deterministic
+            # in (logits, keys) — every shard samples the same token,
+            # so the fed-back carry stays consistent without a
+            # collective.  n_steps must be static for the scan length:
+            # the shard_map closes over it per jit specialization.
+            @functools.partial(jax.jit, donate_argnums=(2,),
+                               static_argnums=(11,))
+            def _decode_multi_tp(params, token_ids, kv_caches, positions,
+                                 gpos, valid, block_tables, temperature,
+                                 top_k, top_p, base_keys, n_steps):
+                sm = shard_map(
+                    lambda p, t, k, *rest: _decode_multi(
+                        p, t, k, *rest, n_steps),
+                    mesh=mesh,
+                    in_specs=(pspecs, rep, kv_specs) + (rep,) * 8,
+                    out_specs=(rep, kv_specs),
+                    check_vma=False,
+                )
+                return sm(params, token_ids, kv_caches, positions, gpos,
+                          valid, block_tables, temperature, top_k, top_p,
+                          base_keys)
+
+            self._decode_multi_fn = _decode_multi_tp
         # speculative decoding (MTP draft head): draft_fn(last_hidden [M,H],
         # last_token [M], positions [M]) -> [M, k] proposals
         self.draft_fn = None
