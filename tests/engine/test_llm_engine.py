@@ -283,3 +283,33 @@ def test_generation_scheduler_engine(tiny_model):
     eng = _engine(params, cfg, worker_type="generation", collect_hidden=True)
     outs = eng.generate([[1, 2, 3, 4]], SamplingParams(max_tokens=1))
     assert len(outs) == 1 and outs[0].finished
+
+
+def test_generation_runner_precompile():
+    """One-shot generation runner warmup: the padded-batch forward
+    compiles at declared shapes, and traffic at the same buckets hits a
+    warm executable (same contract as ARModelRunner.precompile)."""
+    import numpy as np
+
+    from vllm_omni_tpu.core.scheduler import ScheduledRequest, SchedulerOutput
+    from vllm_omni_tpu.request import Request
+    from vllm_omni_tpu.worker.generation_runner import GenerationModelRunner
+
+    class Toy:
+        def forward(self, params, token_ids, lengths):
+            return {"y": token_ids.astype(jnp.float32) * params["w"]}
+
+        def slice_output(self, outputs, row, in_len):
+            return {"y": np.asarray(outputs["y"][row, :in_len])}
+
+    runner = GenerationModelRunner({"w": jnp.float32(2.0)}, Toy(),
+                                   max_num_seqs=4, max_model_len=64)
+    assert runner.precompile(prefill_shapes=[(2, 10)]) == 2  # b in {1, 2}
+    size = runner._forward._cache_size()
+    req = Request(request_id="r", prompt_token_ids=list(range(1, 9)))
+    sched = ScheduledRequest(request=req, num_new_tokens=8,
+                             slot_mapping=[], block_table=[], start_pos=0)
+    runner.execute(SchedulerOutput(prefills=[sched]))
+    np.testing.assert_allclose(
+        req.multimodal_output["y"], np.arange(1, 9, dtype=np.float32) * 2)
+    assert runner._forward._cache_size() == size

@@ -21,7 +21,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from vllm_omni_tpu.core.scheduler import SchedulerOutput
-from vllm_omni_tpu.worker.model_runner import RunnerOutput, _bucket, _make_buckets
+from vllm_omni_tpu.worker.model_runner import (
+    RunnerOutput,
+    _bucket,
+    _bucketed_prefill_shapes,
+    _make_buckets,
+)
 
 
 class GenerationModelRunner:
@@ -32,6 +37,28 @@ class GenerationModelRunner:
         self._batch_buckets = _make_buckets(1, max(max_num_seqs, 1))
         self._seq_buckets = _make_buckets(16, max(max_model_len, 16))
         self._forward = jax.jit(model.forward)
+
+    def precompile(self, prefill_shapes=(), progress_fn=None) -> int:
+        """Warm the cond-free padded-batch forward for declared
+        (batch, seq_len) shapes (same motivation as
+        ARModelRunner.precompile: a shape-cache miss mid-traffic stalls
+        in-flight requests for a full XLA compile).  Conditioning
+        models run this same 3-arg executable whenever
+        ``batch_conditioning`` returns None (an all-unconditioned
+        batch), so it is warmed for them too; only the conditioned
+        4-arg specialization depends on the per-request conditioning
+        pytree and cannot be warmed generically."""
+        built = 0
+        for b, s in _bucketed_prefill_shapes(
+                prefill_shapes, self._batch_buckets, self._seq_buckets):
+            if progress_fn is not None:
+                progress_fn(f"precompile generation b={b} s={s}")
+            out = self._forward(
+                self.params, jnp.zeros((b, s), jnp.int32),
+                jnp.full((b,), s, jnp.int32))
+            jax.block_until_ready(out)
+            built += 1
+        return built
 
     def execute(self, sched_out: SchedulerOutput,
                 extract_kv: bool = True) -> RunnerOutput:

@@ -44,6 +44,21 @@ def _bucket(n: int, buckets: tuple[int, ...]) -> int:
     raise ValueError(f"size {n} exceeds largest bucket {buckets[-1]}")
 
 
+def _bucketed_prefill_shapes(prefill_shapes, batch_buckets,
+                             seq_buckets) -> list[tuple[int, int]]:
+    """Expand declared (batch, seq_len) traffic shapes into the bucketed
+    (b, s) set to warm: every batch bucket up to the declared batch (the
+    scheduler admits whatever arrived, so smaller waves bucket lower),
+    seq clamped to its bucket.  Shared by the AR and generation runners'
+    precompile so their coverage policy cannot drift apart."""
+    todo = set()
+    for raw_b, raw_s in prefill_shapes:
+        b_top = _bucket(min(raw_b, batch_buckets[-1]), batch_buckets)
+        s = _bucket(min(raw_s, seq_buckets[-1]), seq_buckets)
+        todo.update((b, s) for b in batch_buckets if b <= b_top)
+    return sorted(todo)
+
+
 def _make_buckets(start: int, limit: int) -> tuple[int, ...]:
     """Powers of two from ``start`` up to (and covering) ``limit``."""
     buckets = []
@@ -402,16 +417,9 @@ class ARModelRunner:
                         jnp.zeros((b,), jnp.int32))
                     built += 1
 
-        todo = set()
         seen_chunks = set()
-        for raw_b, raw_s in prefill_shapes:
-            b_top = _bucket(min(raw_b, self._batch_buckets[-1]),
-                            self._batch_buckets)
-            s = _bucket(min(raw_s, self._seq_buckets[-1]),
-                        self._seq_buckets)
-            todo.update((b, s) for b in self._batch_buckets
-                        if b <= b_top)
-        for b, s in sorted(todo):
+        for b, s in _bucketed_prefill_shapes(
+                prefill_shapes, self._batch_buckets, self._seq_buckets):
             note(f"precompile prefill b={b} s={s}")
             # trailing (None, None, None) mirrors _prefill_common's
             # *embeds_args for a token-only batch: jit's cache key
