@@ -511,6 +511,19 @@ def bench_ar() -> dict:
               f"{dur:.1f}s)")
 
     from vllm_omni_tpu.metrics.stats import nearest_rank_pct
+    from vllm_omni_tpu.platforms import current_platform
+
+    # Model-bandwidth utilization: decode is weight-read-bound — every
+    # decode iteration streams the full resident weights from HBM once
+    # (the batch shares the read).  iterations ~= gen_len per request
+    # wave; total duration (incl. prefill) makes this a LOWER bound.
+    weights_gb = sum(a.size * a.dtype.itemsize
+                     for a in jax.tree.leaves(params)) / 1e9
+    peak_bw = current_platform().peak_hbm_gbps()
+    # 0 = platform doesn't publish a bandwidth (CPU runs): report null
+    # rather than a confident-looking number against absent hardware
+    mbu = ((weights_gb * max_tokens / dur) / peak_bw if peak_bw
+           else None)
 
     ttfts = list(first_token_ms.values())
     return {
@@ -519,6 +532,10 @@ def bench_ar() -> dict:
         "unit": "tok/s",
         "p50_ttft_ms": round(nearest_rank_pct(ttfts, 0.50), 1),
         "p99_ttft_ms": round(nearest_rank_pct(ttfts, 0.99), 1),
+        "model_bandwidth_utilization": (round(mbu, 4)
+                                        if mbu is not None else None),
+        "weights_gb": round(weights_gb, 2),
+        "peak_hbm_gbps_assumed": peak_bw or None,
         "num_requests": n_reqs,
         "prompt_len": prompt_len,
         "gen_len": max_tokens,

@@ -56,6 +56,11 @@ class OmniPlatform(ABC):
         """Peak dense bf16 TFLOP/s of one device (MFU denominators)."""
         return 0.0
 
+    def peak_hbm_gbps(self) -> float:
+        """Peak HBM GB/s of one device (MBU denominators for
+        bandwidth-bound decode); 0 when unknown."""
+        return 0.0
+
     def stage_device_env(self, devices: str = "all") -> dict:
         """Env applied to a spawned stage worker BEFORE jax import so the
         child binds only its share of the hardware (reference:

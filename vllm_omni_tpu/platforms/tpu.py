@@ -23,19 +23,28 @@ class TpuPlatform(OmniPlatform):
             return override
         return "pallas_flash"
 
-    # peak dense bf16 TFLOP/s per chip by generation (public spec sheet
-    # numbers; MFU denominators)
+    # (peak dense bf16 TFLOP/s, peak HBM GB/s) per chip generation —
+    # public spec sheet numbers; MFU / MBU denominators.  One table so a
+    # new generation cannot land in one metric and not the other.
     _PEAK_TABLE = {
-        "v4": 275.0, "v5 lite": 197.0, "v5e": 197.0, "v5litepod": 197.0,
-        "v5p": 459.0, "v6 lite": 918.0, "v6e": 918.0,
+        "v4": (275.0, 1228.0),
+        "v5 lite": (197.0, 819.0), "v5e": (197.0, 819.0),
+        "v5litepod": (197.0, 819.0), "v5p": (459.0, 2765.0),
+        "v6 lite": (918.0, 1640.0), "v6e": (918.0, 1640.0),
     }
 
-    def peak_tflops_bf16(self) -> float:
+    def _peaks(self) -> tuple:
         kind = self.device_kind().lower()
         for k, v in self._PEAK_TABLE.items():
             if k in kind:
                 return v
-        return 197.0
+        return (197.0, 819.0)  # unlisted generation: v5e floor
+
+    def peak_tflops_bf16(self) -> float:
+        return self._peaks()[0]
+
+    def peak_hbm_gbps(self) -> float:
+        return self._peaks()[1]
 
     def stage_device_env(self, devices: str = "all") -> dict:
         if devices in ("", "all"):
