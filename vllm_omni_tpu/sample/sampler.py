@@ -114,19 +114,31 @@ def sample_tokens(
     top_p: jax.Array,        # [B]
     keys: jax.Array,         # [B, 2] key data
 ) -> jax.Array:
-    """Returns sampled token ids [B] i32."""
+    """Returns sampled token ids [B] i32.
+
+    The top-k/top-p masks each cost a FULL-vocab sort per row — the
+    dominant non-matmul work in a decode step (two sorts of
+    [B, 151936] f32) — so an all-greedy batch (the common serving case,
+    and every step inside the greedy multi-step decode scan) skips the
+    whole sampling branch with a lax.cond rather than computing it and
+    discarding it through the final where."""
     logits = logits.astype(jnp.float32)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
-    scaled = logits / safe_t[:, None]
-    scaled = _mask_top_k(scaled, top_k)
-    scaled = _mask_top_p(scaled, top_p)
+    def _sampled(_):
+        safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
+        scaled = logits / safe_t[:, None]
+        scaled = _mask_top_k(scaled, top_k)
+        scaled = _mask_top_p(scaled, top_p)
 
-    def draw(key_data, row):
-        return jax.random.categorical(jax.random.wrap_key_data(key_data), row)
+        def draw(key_data, row):
+            return jax.random.categorical(
+                jax.random.wrap_key_data(key_data), row)
 
-    sampled_ids = jax.vmap(draw)(keys, scaled).astype(jnp.int32)
+        return jax.vmap(draw)(keys, scaled).astype(jnp.int32)
+
+    sampled_ids = jax.lax.cond(
+        jnp.any(temperature > 0.0), _sampled, lambda _: greedy_ids, None)
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
 
 

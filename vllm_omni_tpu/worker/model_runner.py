@@ -81,6 +81,14 @@ class RunnerOutput:
     kv_extracted_req_ids: set[str] = field(default_factory=set)
 
 
+# Bucket-padding rows must be GREEDY: sample_tokens skips its
+# full-vocab-sort sampling branch only when no row has temperature > 0,
+# and default-temperature padding would defeat that fast path for every
+# batch that doesn't exactly fill its bucket (padding tokens are
+# discarded either way).
+_PAD_SAMPLING = SamplingParams(temperature=0.0)
+
+
 class ARModelRunner:
     def __init__(
         self,
@@ -391,7 +399,7 @@ class ARModelRunner:
                 if (self.multi_step_decode > 1
                         and self._decode_multi_fn is not None):
                     t = SamplingTensors.build(
-                        [SamplingParams()] * b, step=0,
+                        [_PAD_SAMPLING] * b, step=0,
                         base_seed=self._base_seed)
                     # valid=False derives slot -1 on device: the whole
                     # window's KV writes drop
@@ -693,7 +701,7 @@ class ARModelRunner:
         gpos = np.zeros((b,), np.int32)
         valid = np.zeros((b,), bool)
         tables = np.zeros((b, self.max_pages_per_seq), np.int32)
-        params_list = [SamplingParams()] * b
+        params_list = [_PAD_SAMPLING] * b
         salts = [0] * b
         for i, sc in enumerate(scheds):
             req = sc.request
@@ -958,7 +966,7 @@ class ARModelRunner:
             # Sample the full padded batch (one compile per bucket shape);
             # non-sampling rows compute discarded tokens.
             b_padded = logits.shape[0]
-            params = [SamplingParams()] * b_padded
+            params = [_PAD_SAMPLING] * b_padded
             salts = [0] * b_padded
             for i, sc in sampling:
                 params[i] = sc.request.sampling_params
