@@ -128,8 +128,18 @@ def sample_tokens(
     def _sampled(_):
         safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
         scaled = logits / safe_t[:, None]
-        scaled = _mask_top_k(scaled, top_k)
-        scaled = _mask_top_p(scaled, top_p)
+        # each mask costs its own full-vocab sort — skip the ones no
+        # SAMPLING row requests (temperature-only sampling pays zero
+        # sorts; greedy rows' filters are discarded by the final where,
+        # and OpenAI clients routinely send top_p alongside
+        # temperature=0, so greedy rows must not trip the predicate)
+        sampling = temperature > 0.0
+        scaled = jax.lax.cond(
+            jnp.any(sampling & (top_k > 0)),
+            lambda x: _mask_top_k(x, top_k), lambda x: x, scaled)
+        scaled = jax.lax.cond(
+            jnp.any(sampling & (top_p < 1.0)),
+            lambda x: _mask_top_p(x, top_p), lambda x: x, scaled)
 
         def draw(key_data, row):
             return jax.random.categorical(
