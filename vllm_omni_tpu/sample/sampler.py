@@ -106,6 +106,26 @@ def _mask_top_p(logits: jax.Array, p: jax.Array) -> jax.Array:
     return jnp.where(logits < thresh, _NEG_INF, logits)
 
 
+def _filtered_scaled(logits, temperature, top_k, top_p):
+    """Temperature-scaled logits with top-k/top-p masks applied — the
+    ONE definition of the sampling distribution, shared by the draw path
+    (``sample_tokens``) and the spec-decode verify path
+    (``filtered_probs``), which must score exactly the distribution the
+    draw path samples from.  Each mask costs a full-vocab sort, so it
+    runs only when some SAMPLING row requests it (greedy rows' filters
+    are discarded downstream and must not trip the predicate — OpenAI
+    clients routinely send top_p alongside temperature=0)."""
+    safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
+    scaled = logits / safe_t[:, None]
+    sampling = temperature > 0.0
+    scaled = jax.lax.cond(
+        jnp.any(sampling & (top_k > 0)),
+        lambda x: _mask_top_k(x, top_k), lambda x: x, scaled)
+    return jax.lax.cond(
+        jnp.any(sampling & (top_p < 1.0)),
+        lambda x: _mask_top_p(x, top_p), lambda x: x, scaled)
+
+
 @jax.jit
 def sample_tokens(
     logits: jax.Array,       # [B, vocab]
@@ -126,20 +146,7 @@ def sample_tokens(
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def _sampled(_):
-        safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
-        scaled = logits / safe_t[:, None]
-        # each mask costs its own full-vocab sort — skip the ones no
-        # SAMPLING row requests (temperature-only sampling pays zero
-        # sorts; greedy rows' filters are discarded by the final where,
-        # and OpenAI clients routinely send top_p alongside
-        # temperature=0, so greedy rows must not trip the predicate)
-        sampling = temperature > 0.0
-        scaled = jax.lax.cond(
-            jnp.any(sampling & (top_k > 0)),
-            lambda x: _mask_top_k(x, top_k), lambda x: x, scaled)
-        scaled = jax.lax.cond(
-            jnp.any(sampling & (top_p < 1.0)),
-            lambda x: _mask_top_p(x, top_p), lambda x: x, scaled)
+        scaled = _filtered_scaled(logits, temperature, top_k, top_p)
 
         def draw(key_data, row):
             return jax.random.categorical(
@@ -187,9 +194,6 @@ def filtered_probs(
     logits = logits.astype(jnp.float32)
     vocab = logits.shape[-1]
     greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1), vocab)
-    safe_t = jnp.where(temperature <= 0.0, 1.0, temperature)
-    scaled = logits / safe_t[:, None]
-    scaled = _mask_top_k(scaled, top_k)
-    scaled = _mask_top_p(scaled, top_p)
-    probs = jax.nn.softmax(scaled, axis=-1)
+    probs = jax.nn.softmax(
+        _filtered_scaled(logits, temperature, top_k, top_p), axis=-1)
     return jnp.where((temperature <= 0.0)[:, None], greedy, probs)
