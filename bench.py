@@ -214,7 +214,8 @@ def _release_device_memory() -> None:
 
 # ------------------------------------------------------------- diffusion
 def _build_engine(size: str, scheduler: str, use_cache: bool,
-                  quant: str = "", offload: str = ""):
+                  quant: str = "", offload: str = "",
+                  scm_mask=None):
     from vllm_omni_tpu.config.diffusion import OmniDiffusionConfig
     from vllm_omni_tpu.diffusion.engine import DiffusionEngine
 
@@ -235,6 +236,8 @@ def _build_engine(size: str, scheduler: str, use_cache: bool,
         model="qwen-image-bench", model_arch="QwenImagePipeline",
         dtype="bfloat16", extra=extra,
         cache_backend="teacache" if use_cache else "",
+        cache_config=({"scm_steps_mask": list(scm_mask)}
+                      if use_cache and scm_mask is not None else {}),
         offload=offload,
         quantization=quant,
     )
@@ -244,7 +247,7 @@ def _build_engine(size: str, scheduler: str, use_cache: bool,
 def bench_diffusion(size: str, scheduler: str, use_cache: bool,
                     height: int, width: int, steps: int,
                     iters: int, quant: str = "",
-                    offload: str = "") -> dict:
+                    offload: str = "", scm_mask=None) -> dict:
     from vllm_omni_tpu.diffusion.request import (
         OmniDiffusionRequest,
         OmniDiffusionSamplingParams,
@@ -286,13 +289,14 @@ def bench_diffusion(size: str, scheduler: str, use_cache: bool,
         engine = None
         _release_device_memory()
         engine = _build_engine(new_size, scheduler, use_cache,
-                               new_quant, new_offload)
+                               new_quant, new_offload,
+                               scm_mask=scm_mask)
         one(1)
 
     while True:
         try:
             engine = _build_engine(size, scheduler, use_cache, quant,
-                                   offload)
+                                   offload, scm_mask=scm_mask)
             # compile warmup: 1 step warms every executable.  Small
             # presets then run one untimed full-length pass (measured: a
             # ~4.5 s one-time autotune cost would pollute a 2-3 iter
@@ -672,11 +676,6 @@ def main():
     elif flagship["arch"]["size_preset"] != size:
         skip_reason = (f"flagship fell back to "
                        f"{flagship['arch']['size_preset']} preset")
-    elif size == "real_q":
-        skip_reason = (
-            "real_q drives a host step loop (single-RPC ceiling on the "
-            "tunnel) where per-call step caches cannot accumulate "
-            "skip state")
     elif elapsed + est_variant >= _budget_s():
         skip_reason = (f"budget ({elapsed:.0f}s elapsed, "
                        f"~{est_variant:.0f}s needed, "
@@ -686,15 +685,27 @@ def main():
             # rerun what the flagship ACTUALLY ran (it may have demoted
             # quant mid-flight, e.g. bf16 streaming -> int8, without
             # changing size_preset) — never repeat a cascade the
-            # flagship already proved infeasible
+            # flagship already proved infeasible.  Random-init weights
+            # make teacache's drift gate meaningless, so the variant
+            # runs a DETERMINISTIC steps-cache-mask (compute the first
+            # 2 and last 2 steps plus every other step between —
+            # reference scm_steps_mask, cache_dit_backend.py:46-55);
+            # the skip pattern is disclosed via skipped_steps and the
+            # MFU accounting counts executed steps only.
+            mask = [i < 2 or i >= steps - 2 or i % 2 == 0
+                    for i in range(steps)]
             var = bench_diffusion(size, scheduler, True, height, width,
                                   steps, iters, ran_quant,
-                                  flagship["arch"]["offload"])
+                                  flagship["arch"]["offload"],
+                                  scm_mask=mask)
             out["step_cache_variant"] = {
                 k: var[k] for k in ("metric", "value", "unit",
                                     "seconds_per_image", "mfu")}
             out["step_cache_variant"]["skipped_steps"] = \
                 var["arch"]["skipped_steps"]
+            out["step_cache_variant"]["mode"] = (
+                "teacache + deterministic scm mask (random-init "
+                "weights make the drift gate meaningless)")
         except Exception as e:
             out["step_cache_variant"] = {
                 "error": f"{type(e).__name__}: {e}"}

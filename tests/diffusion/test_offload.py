@@ -156,6 +156,22 @@ def test_streaming_teacache_skips_and_pinning_matches(tiny_pipes):
     assert img_c.shape == base.shape
     assert np.isfinite(img_c.astype(np.float64)).all()
 
+    # deterministic scm mask overrides the drift gate in the streamed
+    # walk too: mask computes steps {0,1,4,7}, window excludes 0 and 7,
+    # so exactly steps 2,3,5,6 skip regardless of the huge threshold
+    masked = QwenImagePipeline(
+        cfg, dtype=jnp.float32, seed=0, init_weights=False,
+        offload="layerwise",
+        cache_config=StepCacheConfig(
+            backend="teacache", rel_l1_threshold=10.0,
+            scm_steps_mask=(True, True, False, False, True, False,
+                            False, True)))
+    masked.dit_params = stream.dit_params
+    masked.text_params = stream.text_params
+    img_m = masked.forward(req)[0].data
+    assert masked.last_skipped_steps == 4
+    assert np.isfinite(img_m.astype(np.float64)).all()
+
     pinned = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
                                init_weights=False, offload="layerwise")
     pinned.dit_params = stream.dit_params

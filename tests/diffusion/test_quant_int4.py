@@ -209,18 +209,51 @@ def test_host_step_loop_matches_device_loop(chunk):
     np.testing.assert_array_equal(gen(host), gen(dev))
 
 
-def test_host_step_loop_rejects_step_cache():
+@pytest.mark.parametrize("backend,extra", [
+    ("teacache", {"rel_l1_threshold": 1e9}),     # drift gate always skips
+    ("taylorseer", {"rel_l1_threshold": 1e9}),
+    ("teacache", {"scm_steps_mask": [True, True, False, True, False,
+                                     True]}),    # deterministic mask
+])
+def test_host_step_loop_cache_matches_device_loop(backend, extra):
+    """Step caches under the chunked host loop: the cache carry threads
+    through the device-call boundaries and skip decisions use the GLOBAL
+    step index, so skips and pixels are identical to the uninterrupted
+    device fori_loop.  chunk=2 over 6 steps crosses two chunk
+    boundaries with skip state live."""
     from vllm_omni_tpu.diffusion.cache import StepCacheConfig
     from vllm_omni_tpu.models.qwen_image.pipeline import (
         QwenImagePipeline,
         QwenImagePipelineConfig,
     )
 
-    with pytest.raises(ValueError, match="device loop"):
-        QwenImagePipeline(
-            QwenImagePipelineConfig.tiny(), dtype=jnp.float32,
-            init_weights=False, step_loop="host",
-            cache_config=StepCacheConfig.from_dict("teacache", {}))
+    cfg = QwenImagePipelineConfig.tiny()
+    cc = StepCacheConfig.from_dict(backend, dict(extra))
+    dev = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
+                            cache_config=cc)
+    host = QwenImagePipeline(cfg, dtype=jnp.float32, seed=0,
+                             init_weights=False, step_loop="host",
+                             step_chunk=2, cache_config=cc)
+    host.dit_params = dev.dit_params
+    host.text_params = dev.text_params
+    host.vae_params = dev.vae_params
+
+    sp = OmniDiffusionSamplingParams(
+        height=32, width=32, num_inference_steps=6, guidance_scale=4.0,
+        seed=7)
+
+    def gen(pipe):
+        req = OmniDiffusionRequest(
+            prompt=["a red cube"], sampling_params=sp,
+            request_ids=["a"])
+        out = pipe.forward(req)[0].data
+        return out, pipe.last_skipped_steps
+
+    img_dev, skipped_dev = gen(dev)
+    img_host, skipped_host = gen(host)
+    assert skipped_dev > 0, "cache never fired — test proves nothing"
+    assert skipped_host == skipped_dev
+    np.testing.assert_array_equal(img_host, img_dev)
 
 
 def test_real_q_preset_is_full_depth():

@@ -259,17 +259,43 @@ def dbcache_eval(
     return v, (v_anchor, new_delta, new_accum), skip
 
 
+def init_cache_carry(cache_cfg, latents):
+    """The cache backend's initial carry for ``latents``-shaped state —
+    the host-visible half of the cross-chunk contract (chunked host
+    loops thread this through ``run_denoise_loop(..., carry_in=...,
+    return_carry=True)`` so skip state survives device-call
+    boundaries)."""
+    if cache_cfg is None or not cache_cfg.enabled:
+        return None
+    if cache_cfg.backend == "dbcache":
+        return dbcache_init_carry(latents)
+    if cache_cfg.backend == "taylorseer":
+        return taylor_init_carry(latents)
+    return init_carry(latents)
+
+
 def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
-                     solver: str = "euler", eval_split=None):
+                     solver: str = "euler", eval_split=None,
+                     step_offset=None, total_steps=None, carry_in=None,
+                     return_carry: bool = False):
     """Shared denoise fori_loop, optionally gated by the step cache.
 
     ``eval_velocity(latents, i)`` -> velocity (shape-preserving).  Returns
-    ``(final_latents, skipped_count)``.  One implementation for every
-    pipeline (image/video/audio) so cache-semantics changes land once.
+    ``(final_latents, skipped_count)`` — plus the cache carry when
+    ``return_carry`` is set.  One implementation for every pipeline
+    (image/video/audio) so cache-semantics changes land once.
 
     ``solver``: "euler" (FlowMatch Euler) or "unipc" (order-2 UniPC-style
     multistep, scheduler.multistep_step — fewer steps for the same
     quality; reference: scheduling_flow_unipc_multistep.py:741).
+
+    Chunked host loops (remote-attached chips run K steps per device
+    call on a schedule rolled to the chunk start) pass ``step_offset``
+    (global index of local step 0), ``total_steps`` (the full run
+    length, for the warmup/tail window), and thread the cache carry
+    through ``carry_in``/``return_carry`` — the loop indexes the
+    SCHEDULE locally and the CACHE globally, so skip decisions and
+    Taylor anchors are identical to one uninterrupted loop.
     """
     from vllm_omni_tpu.diffusion import scheduler as fm
 
@@ -279,6 +305,8 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
     use_cache = cache_cfg is not None and cache_cfg.enabled
     use_dbcache = use_cache and cache_cfg.backend == "dbcache"
     use_taylor = use_cache and cache_cfg.backend == "taylorseer"
+    offset = jnp.int32(0) if step_offset is None else step_offset
+    total = num_steps if total_steps is None else total_steps
     scm_mask = None
     if use_cache and cache_cfg.scm_steps_mask is not None:
         scm_mask = _scm_mask_array(cache_cfg, int(schedule.sigmas.shape[0]))
@@ -291,6 +319,11 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
         raise ValueError(
             "scm_steps_mask is not wired into the dbcache backend — "
             "use teacache or taylorseer for deterministic step masks")
+    if multistep and (step_offset is not None or carry_in is not None):
+        raise ValueError(
+            "chunked denoise carries only the cache state — the unipc "
+            "multistep solver state would be lost across chunks; use "
+            "the euler solver")
 
     def ms_init(lat):
         return (jnp.zeros_like(lat, jnp.float32),
@@ -303,59 +336,44 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
             return new_lat, (x0, lam)
         return fm.step(schedule, lat, v, i), ms
 
-    if use_dbcache:
-        eval_first, eval_rest = eval_split
-
-        def body(i, carry):
-            lat, cc, ms, skipped = carry
-            v, cc, skip = dbcache_eval(
-                cache_cfg, lambda l: eval_first(l, i), eval_rest, lat,
-                cc, i, num_steps,
-            )
-            lat, ms = advance(lat, v, i, ms)
-            return (lat, cc, ms, skipped + skip.astype(jnp.int32))
-
-        lat, _, _, skipped = jax.lax.fori_loop(
-            0, num_steps, body,
-            (latents, dbcache_init_carry(latents), ms_init(latents),
-             jnp.asarray(0, jnp.int32)),
-        )
-        return lat, skipped
-
-    if use_taylor:
-
-        def body(i, carry):
-            lat, cc, ms, skipped = carry
-            v, cc, skip = taylorseer_eval(
-                cache_cfg, lambda l: eval_velocity(l, i), lat, cc, i,
-                num_steps, scm_mask=scm_mask,
-            )
-            lat, ms = advance(lat, v, i, ms)
-            return (lat, cc, ms, skipped + skip.astype(jnp.int32))
-
-        lat, _, _, skipped = jax.lax.fori_loop(
-            0, num_steps, body,
-            (latents, taylor_init_carry(latents), ms_init(latents),
-             jnp.asarray(0, jnp.int32)),
-        )
-        return lat, skipped
-
     if use_cache:
+        if use_dbcache:
+            eval_first, eval_rest = eval_split
+
+            def cache_eval(lat, i, ig, cc):
+                return dbcache_eval(
+                    cache_cfg, lambda l: eval_first(l, i), eval_rest,
+                    lat, cc, ig, total)
+
+        elif use_taylor:
+
+            def cache_eval(lat, i, ig, cc):
+                return taylorseer_eval(
+                    cache_cfg, lambda l: eval_velocity(l, i), lat, cc,
+                    ig, total, scm_mask=scm_mask)
+
+        else:
+
+            def cache_eval(lat, i, ig, cc):
+                return cached_eval(
+                    cache_cfg, lambda l: eval_velocity(l, i), lat, cc,
+                    ig, total, scm_mask=scm_mask)
+
+        default_carry = init_cache_carry(cache_cfg, latents)
 
         def body(i, carry):
             lat, cc, ms, skipped = carry
-            v, cc, skip = cached_eval(
-                cache_cfg, lambda l: eval_velocity(l, i), lat, cc, i,
-                num_steps, scm_mask=scm_mask,
-            )
+            v, cc, skip = cache_eval(lat, i, i + offset, cc)
             lat, ms = advance(lat, v, i, ms)
             return (lat, cc, ms, skipped + skip.astype(jnp.int32))
 
-        lat, _, _, skipped = jax.lax.fori_loop(
+        lat, cc, _, skipped = jax.lax.fori_loop(
             0, num_steps, body,
-            (latents, init_carry(latents), ms_init(latents),
-             jnp.asarray(0, jnp.int32)),
+            (latents, carry_in if carry_in is not None else default_carry,
+             ms_init(latents), jnp.asarray(0, jnp.int32)),
         )
+        if return_carry:
+            return lat, skipped, cc
         return lat, skipped
 
     def body(i, carry):
@@ -365,4 +383,6 @@ def run_denoise_loop(cache_cfg, schedule, eval_velocity, latents, num_steps,
 
     lat, _ = jax.lax.fori_loop(
         0, num_steps, body, (latents, ms_init(latents)))
+    if return_carry:
+        return lat, jnp.asarray(0, jnp.int32), None
     return lat, jnp.asarray(0, jnp.int32)

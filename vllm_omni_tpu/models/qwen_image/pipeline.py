@@ -267,11 +267,12 @@ class QwenImagePipeline:
             if offload == "layerwise":
                 raise ValueError(
                     "layerwise offload already drives a host loop")
-            if cache_config is not None and cache_config.backend:
-                raise ValueError(
-                    "step caches need the device loop (the host loop "
-                    "re-enters step 0 each call, so skip-state never "
-                    "accumulates) — use step_loop='device'")
+            # step caches DO work here: the cache carry (skip state,
+            # Taylor anchors, drift accumulator) threads through each
+            # chunked device call explicitly (cache.run_denoise_loop
+            # carry_in/return_carry), with cache decisions indexed by
+            # the GLOBAL step — identical skips to one uninterrupted
+            # device loop.
             if config.scheduler != "euler":
                 raise ValueError(
                     "step_loop='host' supports the euler solver only "
@@ -719,12 +720,21 @@ class QwenImagePipeline:
         accum = float("inf")
         n = int(num_steps)
         self.last_skipped_steps = 0
+        scm = cc.scm_steps_mask if use_cache else None
         for i in range(n):
             if use_cache and prev_lat is not None:
                 accum += float(_rel_drift(latents, prev_lat))
                 in_window = (i >= cc.warmup_steps
                              and i < n - cc.tail_steps)
-                if in_window and accum < cc.rel_l1_threshold:
+                # deterministic steps-cache-mask overrides the drift
+                # gate when configured (same precedence as the jitted
+                # path, diffusion/cache.py:cached_eval); steps beyond
+                # the mask compute, matching _scm_mask_array's padding
+                if scm is not None:
+                    want_skip = i < len(scm) and not bool(scm[i])
+                else:
+                    want_skip = accum < cc.rel_l1_threshold
+                if in_window and want_skip:
                     self.last_skipped_steps += 1
                     latents = sched_step(latents, prev_v, sigmas,
                                          jnp.int32(i), gscale,
@@ -801,6 +811,7 @@ class QwenImagePipeline:
         def run(
             dit_params, latents, txt, txt_mask, neg_txt, neg_mask,
             sigmas, timesteps, gscale, num_steps, cond=None,
+            step_offset=None, total_steps=None, cache_carry=None,
         ):
             # latents: [B, S_img, C_in]; txt/neg_txt: [B, S_txt, joint];
             # sigmas/timesteps padded to sched_len(+1); num_steps is a
@@ -895,6 +906,14 @@ class QwenImagePipeline:
                 self.cache_config, schedule, eval_velocity, latents,
                 num_steps, solver=self.cfg.scheduler,
                 eval_split=(eval_first, eval_rest),
+                step_offset=step_offset, total_steps=total_steps,
+                carry_in=cache_carry,
+                # chunked callers (step_offset set) always get the
+                # 3-tuple — (latents, 0, None) when uncached — so the
+                # host loop has ONE call shape; plain callers keep the
+                # 2-tuple
+                return_carry=(cache_carry is not None
+                              or step_offset is not None),
             )
 
         self._denoise_cache[key] = run
@@ -1000,21 +1019,35 @@ class QwenImagePipeline:
             if self.step_loop == "host":
                 # step_chunk steps per device call (see __init__): the
                 # SAME compiled executable runs with num_steps=k over
-                # the schedule rolled so index 0 is the chunk start
+                # the schedule rolled so index 0 is the chunk start.
+                # With a step cache, its carry threads through the
+                # chunks (device-resident; no host transfer) and skip
+                # decisions use the GLOBAL step index — identical to
+                # one uninterrupted device loop.
                 import time as _time
 
                 t_start = _time.perf_counter()
+                use_cc = (self.cache_config is not None
+                          and self.cache_config.enabled)
+                carry = step_cache.init_cache_carry(
+                    self.cache_config, noise)
                 latents = noise
+                skipped = jnp.int32(0)
                 for i in range(0, num_steps, self.step_chunk):
                     k = min(self.step_chunk, num_steps - i)
-                    latents, _ = run(
+                    latents, sk, carry = run(
                         self.dit_params, latents, txt, txt_mask,
                         neg_txt, neg_mask,
                         jnp.roll(sigmas, -i), jnp.roll(timesteps, -i),
                         gscale, jnp.int32(k), cond=cond_tokens,
+                        step_offset=jnp.int32(i),
+                        total_steps=jnp.int32(num_steps),
+                        cache_carry=carry,
                     )
+                    skipped = skipped + sk
                 jax.block_until_ready(latents)
-                self.last_skipped_steps = 0
+                self.last_skipped_steps = (
+                    int(jax.device_get(skipped)) if use_cc else 0)
                 self.last_stream_denoise_s = (
                     _time.perf_counter() - t_start)
             else:
