@@ -498,6 +498,9 @@ def bench_ar() -> dict:
         engine.add_request(list(p), sp)
     done = 0
     total_tokens = 0
+    # tokens already emitted when the LAST request got its first token —
+    # from here on the whole fleet is pure decode (the MBU window)
+    tokens_at_full_decode = None
     while engine.has_unfinished_requests:
         outs = engine.step()
         now_ms = (time.perf_counter() - t0) * 1e3
@@ -510,6 +513,11 @@ def bench_ar() -> dict:
             first_token_ms.setdefault(o.request_id, now_ms)
             for c in o.outputs:
                 total_tokens += len(c.token_ids)
+        if (tokens_at_full_decode is None
+                and len(first_token_ms) >= n_reqs):
+            tokens_at_full_decode = total_tokens + sum(
+                len(r.output_token_ids)
+                for r in engine.scheduler.running)
     dur = time.perf_counter() - t0
     _progress(f"ar: done ({done} finished, {total_tokens} tokens, "
               f"{dur:.1f}s)")
@@ -519,17 +527,27 @@ def bench_ar() -> dict:
 
     # Model-bandwidth utilization: decode is weight-read-bound — every
     # decode iteration streams the full resident weights from HBM once
-    # (the batch shares the read).  iterations ~= gen_len per request
-    # wave; total duration (incl. prefill) makes this a LOWER bound.
+    # (the batch shares the read).  Numerator AND denominator cover the
+    # same window — the pure-decode phase after the last request's
+    # first token (tokens emitted before it, during mixed
+    # prefill+decode waves, are excluded): the old total-duration
+    # denominator deflated the ratio by the prefill + host-RTT
+    # fraction, while a decode-phase denominator under the full
+    # max_tokens numerator would overcount whenever prefill runs in
+    # more than one wave (ADVICE round 5).
     weights_gb = sum(a.size * a.dtype.itemsize
                      for a in jax.tree.leaves(params)) / 1e9
     peak_bw = current_platform().peak_hbm_gbps()
+    ttfts = list(first_token_ms.values())
+    decode_dur = max(dur - (max(ttfts) / 1e3 if ttfts else 0.0), 1e-9)
+    decode_tokens = total_tokens - (tokens_at_full_decode or 0)
+    # per-request decode iterations in the window (the batch shares
+    # each weight read)
+    decode_iters = decode_tokens / max(n_reqs, 1)
     # 0 = platform doesn't publish a bandwidth (CPU runs): report null
     # rather than a confident-looking number against absent hardware
-    mbu = ((weights_gb * max_tokens / dur) / peak_bw if peak_bw
+    mbu = ((weights_gb * decode_iters / decode_dur) / peak_bw if peak_bw
            else None)
-
-    ttfts = list(first_token_ms.values())
     return {
         "metric": "qwen3_omni_thinker_tok_per_sec_chip",
         "value": round(total_tokens / dur, 2),
@@ -538,6 +556,11 @@ def bench_ar() -> dict:
         "p99_ttft_ms": round(nearest_rank_pct(ttfts, 0.99), 1),
         "model_bandwidth_utilization": (round(mbu, 4)
                                         if mbu is not None else None),
+        "mbu_decode_phase_s": round(decode_dur, 2),
+        "mbu_decode_tokens": decode_tokens,
+        "mbu_note": "numerator and denominator both cover the "
+                    "pure-decode phase (after the last request's first "
+                    "token); prefill waves + host RTT excluded",
         "weights_gb": round(weights_gb, 2),
         "peak_hbm_gbps_assumed": peak_bw or None,
         "num_requests": n_reqs,

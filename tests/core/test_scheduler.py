@@ -211,3 +211,42 @@ def test_abort():
     s.add_request(_req("a", n=4))
     s.abort_request("a")
     assert not s.has_unfinished
+
+
+def test_multi_step_window_degrades_for_last_token():
+    """A request needing exactly one more token must run a single-step
+    decode, not a full W-iteration window of guaranteed-discarded work
+    (ADVICE round 5); requests needing >1 keep the full window."""
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                          max_model_len=64, multi_step_decode=4)
+    s = _mk(cfg)
+    s.add_request(_req("a", n=8, max_tokens=6))
+    s.update_from_output(s.schedule(), {"a": 1})  # prefill, 1 token out
+
+    out = s.schedule()
+    assert out.decodes[0].window == 4  # 5 tokens still needed
+
+    # advance to one-token-remaining (5 of 6 emitted)
+    req = s.running[0]
+    for t in (2, 3, 4):
+        req.append_output_token(t)
+        req.num_computed_tokens += 1
+    s.update_from_output(out, {"a": 5})
+    assert len(req.output_token_ids) == 5
+
+    out = s.schedule()
+    d = out.decodes[0]
+    assert d.window == 1  # degraded: only one token needed
+    assert len(d.slot_mapping) == 1  # no window-ahead page reservation
+    finished = s.update_from_output(out, {"a": 6})
+    assert finished and finished[0].finish_reason == "length"
+
+
+def test_preemption_and_rejection_counters():
+    """Lifetime counters surfaced by /metrics (observability PR)."""
+    s = _mk()
+    assert s.num_preemptions == 0 and s.num_rejections == 0
+    s.add_request(_req("too-long", n=100))  # > max_model_len -> reject
+    assert s.num_rejections == 1
+    s._preempt(_req("victim", n=4))
+    assert s.num_preemptions == 1

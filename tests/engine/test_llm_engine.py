@@ -313,3 +313,58 @@ def test_generation_runner_precompile():
     np.testing.assert_allclose(
         req.multimodal_output["y"], np.arange(1, 9, dtype=np.float32) * 2)
     assert runner._forward._cache_size() == size
+
+
+def test_step_metrics_and_snapshot(tiny_model):
+    """Step-level observability (the /metrics source): TTFT/TPOT/ITL
+    histograms populate from real steps, token counters add up, and the
+    snapshot reports KV utilization + scheduler counters."""
+    params, cfg = tiny_model
+    eng = _engine(params, cfg)
+    outs = eng.generate(
+        [[1, 2, 3], [4, 5, 6, 7]],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+    )
+    assert all(o.outputs[0].finish_reason == "length" for o in outs)
+    snap = eng.metrics_snapshot()
+    assert snap["ttft_ms"]["count"] == 2      # one first token each
+    assert snap["tpot_ms"]["count"] == 2      # one per finished request
+    assert snap["itl_ms"]["count"] == 6       # 3 post-first tokens each
+    assert snap["counters"]["tokens_generated"] == 8
+    assert snap["counters"]["prefill_tokens"] == 7
+    # the prefill step samples the first token: 1 prefill + 3 decodes
+    assert snap["counters"]["num_steps"] == 4
+    assert snap["step_ms"]["count"] == snap["counters"]["num_steps"]
+    # all requests finished: pool drained, queues empty
+    assert snap["kv"]["pages_used"] == 0
+    assert snap["kv"]["pages_total"] == 64
+    assert snap["scheduler"] == {"waiting": 0, "running": 0,
+                                 "preemptions": 0, "rejections": 0}
+    # per-request latency state must not leak
+    assert not eng._req_lat and not eng._trace_started
+
+
+def test_engine_records_spans_for_traced_requests(tiny_model):
+    """Requests carrying a trace context get queue_wait/prefill/decode/
+    sampling spans; untraced requests record nothing."""
+    from vllm_omni_tpu.tracing import get_recorder, new_trace_context
+
+    params, cfg = tiny_model
+    get_recorder().drain()
+    eng = _engine(params, cfg)
+    ctx = new_trace_context("traced")
+    eng.add_request([1, 2, 3],
+                    SamplingParams(temperature=0.0, max_tokens=2,
+                                   ignore_eos=True),
+                    request_id="traced",
+                    additional_information={"trace": ctx})
+    eng.add_request([4, 5], SamplingParams(temperature=0.0, max_tokens=2,
+                                           ignore_eos=True),
+                    request_id="untraced")
+    while eng.has_unfinished_requests:
+        eng.step()
+    spans = get_recorder().drain()
+    assert spans and all(s["request_id"] == "traced" for s in spans)
+    assert all(s["trace_id"] == ctx["trace_id"] for s in spans)
+    names = {s["name"] for s in spans}
+    assert {"queue_wait", "prefill", "decode", "sampling"} <= names

@@ -126,7 +126,13 @@ def test_unknown_path(server_url):
 
 
 def test_metrics_endpoint(server_url):
+    # default is Prometheus text exposition (the scrape surface)
     r = httpx.get(f"{server_url}/metrics", timeout=30)
+    assert r.status_code == 200
+    assert r.headers["content-type"].startswith("text/plain")
+    assert "vllm_omni_tpu_" in r.text
+    # the JSON summary moved to ?format=json
+    r = httpx.get(f"{server_url}/metrics?format=json", timeout=30)
     assert r.status_code == 200
     body = r.json()
     assert "stages" in body

@@ -1,0 +1,76 @@
+"""Prometheus exposition: rendering, parsing, and the metric-surface
+guard (mirror of scripts/check_metrics_names.py, so CI catches drift
+even when nobody runs the script)."""
+
+import importlib.util
+import os
+import re
+
+from vllm_omni_tpu.metrics.prometheus import (
+    METRIC_PREFIX,
+    METRIC_SPECS,
+    NAME_RE,
+    render_exposition,
+    validate_exposition,
+    validate_specs,
+)
+
+
+def _load_check_script():
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "scripts", "check_metrics_names.py")
+    spec = importlib.util.spec_from_file_location("check_metrics_names",
+                                                  os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_spec_name_matches_naming_rule():
+    for name in METRIC_SPECS:
+        assert NAME_RE.fullmatch(METRIC_PREFIX + name), name
+        # the rule bans digits — "e2e"-style names must not creep in
+        assert not re.search(r"\d", name), name
+    assert validate_specs() == []
+
+
+def test_check_script_passes():
+    mod = _load_check_script()
+    assert mod.run_check() == []
+    assert mod.main() == 0
+
+
+def test_render_covers_required_series():
+    mod = _load_check_script()
+    text = render_exposition(mod.synthetic_summary(),
+                             {0: mod.synthetic_engine_snapshot()},
+                             device={"hbm_bytes": 123})
+    assert validate_exposition(text) == []
+    for needle in (
+        'vllm_omni_tpu_ttft_ms_bucket{stage="0",le="+Inf"} 3',
+        'vllm_omni_tpu_tpot_ms_sum{stage="0"} 123',
+        'vllm_omni_tpu_itl_ms_count{stage="0"} 3',
+        'vllm_omni_tpu_scheduler_waiting{stage="0"} 1',
+        'vllm_omni_tpu_kv_page_utilization{stage="0"} 0.125',
+        'vllm_omni_tpu_request_latency_ms{quantile="0.5"} 101',
+        'vllm_omni_tpu_transfer_bytes_total{from_stage="0",to_stage="1"} 4096',
+        'vllm_omni_tpu_prefix_cache_hits_total{stage="0"} 2',
+        "vllm_omni_tpu_hbm_bytes 123",
+    ):
+        assert needle in text, f"missing series: {needle}\n{text}"
+    # HELP/TYPE headers present exactly once per metric
+    assert text.count("# TYPE vllm_omni_tpu_ttft_ms histogram") == 1
+
+
+def test_validate_rejects_undeclared_and_unlabeled():
+    clean = 'vllm_omni_tpu_scheduler_waiting{stage="0"} 1\n'
+    assert validate_exposition(clean) == []
+    # undeclared metric name
+    errs = validate_exposition("vllm_omni_tpu_rogue_metric 1\n")
+    assert errs and "not declared" in errs[0]
+    # declared metric missing its required stage label
+    errs = validate_exposition("vllm_omni_tpu_scheduler_waiting 1\n")
+    assert errs and "missing required label 'stage'" in errs[0]
+    # wrong prefix
+    errs = validate_exposition("other_scheduler_waiting 1\n")
+    assert errs and "prefix" in errs[0]

@@ -169,3 +169,22 @@ def test_fallback_dispatch_uses_chunked(rng, monkeypatch):
         q, k, v, None, False, None, False, 16, 16, False
     )
     assert called.get("yes")
+
+
+def test_auto_blocks_cap_below_smallest_candidate():
+    """A VMEM cap under even the smallest candidate product must fall
+    back to a fitting block pair instead of crashing on ``best[1]``
+    with best=None (ADVICE round 5)."""
+    from vllm_omni_tpu.ops.attention import _SCORE_CAP, _auto_blocks
+
+    # cap = _SCORE_CAP * 128 // d * 2 // itemsize: a huge head dim with
+    # f32 inputs drives it below the 256*256 floor of the candidate grid
+    bq, bk = _auto_blocks(4608, 4608, 16384, itemsize=4)
+    assert bq >= 8 and bk >= 8
+    cap = _SCORE_CAP * 128 // 16384 * 2 // 4
+    # the fallback keeps halving, so the score block honors the cap too
+    assert bq * bk <= cap
+
+    # tiny sequences keep the >= 8 clamp
+    bq, bk = _auto_blocks(3, 5, 16384, itemsize=4)
+    assert (bq, bk) == (8, 8)

@@ -1,0 +1,172 @@
+"""Tracing core units: span recorder, Chrome trace export, trace writer,
+and the step-metrics histogram / percentile math (no engine, no jax —
+these run in the fast tier)."""
+
+import json
+
+from vllm_omni_tpu.metrics.stats import (
+    EngineStepMetrics,
+    Histogram,
+    nearest_rank_pct,
+)
+from vllm_omni_tpu.tracing import (
+    TraceRecorder,
+    TraceWriter,
+    new_trace_context,
+    to_chrome_trace,
+)
+
+
+# ------------------------------------------------------------- recorder
+def test_recorder_record_and_drain():
+    rec = TraceRecorder()
+    ctx = new_trace_context("req-1")
+    rec.record(ctx, "prefill", 100.0, 0.25, stage_id=0,
+               args={"tokens": 8})
+    rec.record(ctx, "decode", 100.25, 0.1, stage_id=0)
+    spans = rec.drain()
+    assert len(spans) == 2 and len(rec) == 0
+    s = spans[0]
+    assert s["trace_id"] == ctx["trace_id"]
+    assert s["request_id"] == "req-1"
+    assert s["name"] == "prefill"
+    assert s["ts_us"] == 100.0 * 1e6
+    assert s["dur_us"] == 0.25 * 1e6
+    assert s["args"] == {"tokens": 8}
+
+
+def test_recorder_none_ctx_is_noop():
+    rec = TraceRecorder()
+    rec.record(None, "prefill", 0.0, 1.0)
+    assert len(rec) == 0
+
+
+def test_recorder_bounded_and_extend():
+    rec = TraceRecorder(capacity=4)
+    ctx = new_trace_context("r")
+    for i in range(10):
+        rec.record(ctx, f"s{i}", float(i), 0.1)
+    assert len(rec) == 4  # oldest dropped, memory bounded
+    other = TraceRecorder()
+    other.extend(rec.drain())
+    assert len(other) == 4
+
+
+def test_distinct_trace_ids():
+    a, b = new_trace_context("a"), new_trace_context("b")
+    assert a["trace_id"] != b["trace_id"]
+    assert a["request_id"] == "a"
+
+
+# ----------------------------------------------------------- chrome trace
+def test_chrome_trace_export():
+    rec = TraceRecorder()
+    ctx = new_trace_context("req-1")
+    rec.record(ctx, "queue_wait", 1.0, 0.5, stage_id=0, cat="queue")
+    rec.record(ctx, "prefill", 1.5, 0.5, stage_id=1)
+    rec.record(ctx, "request", 1.0, 1.2, stage_id=-1, cat="request")
+    doc = to_chrome_trace(rec.drain())
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 3
+    # pid = stage_id + 1 (orchestrator spans land on pid 0)
+    assert {e["pid"] for e in xs} == {0, 1, 2}
+    for e in xs:
+        assert e["args"]["trace_id"] == ctx["trace_id"]
+        assert e["args"]["request_id"] == "req-1"
+    names = {(e["pid"], e["args"]["name"]) for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert (0, "orchestrator") in names
+    assert (1, "stage_0") in names and (2, "stage_1") in names
+
+
+def test_trace_writer_files(tmp_path):
+    prefix = str(tmp_path / "run")
+    w = TraceWriter(prefix)
+    ctx = new_trace_context("r")
+    rec = TraceRecorder()
+    rec.record(ctx, "decode", 2.0, 0.1, stage_id=0)
+    w.write(rec.drain())
+    w.export_chrome()
+    lines = open(w.jsonl_path).read().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["name"] == "decode"
+    doc = json.load(open(w.chrome_path))
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+    # append accumulates in the jsonl, chrome stays a complete document
+    rec.record(ctx, "decode", 2.2, 0.1, stage_id=0)
+    w.write(rec.drain())
+    w.export_chrome()
+    assert len(open(w.jsonl_path).read().splitlines()) == 2
+    assert len([e for e in json.load(open(w.chrome_path))["traceEvents"]
+                if e["ph"] == "X"]) == 2
+
+
+# -------------------------------------------------------------- histogram
+def test_histogram_buckets_cumulative():
+    h = Histogram(buckets=(10.0, 100.0))
+    h.observe(5.0)
+    h.observe(50.0)
+    h.observe(500.0)
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert snap["sum"] == 555.0
+    # cumulative per upper bound, +Inf last
+    assert snap["buckets"] == [[10.0, 1], [100.0, 2], [float("inf"), 3]]
+
+
+def test_histogram_bucket_boundary_is_le():
+    h = Histogram(buckets=(10.0, 100.0))
+    h.observe(10.0)  # boundary value counts in its own bucket (le=10)
+    assert h.snapshot()["buckets"][0] == [10.0, 1]
+
+
+def test_histogram_observe_n_amortized():
+    """A multi-step window's per-token ITLs land as one weighted
+    observation (n tokens in one host round trip)."""
+    h = Histogram(buckets=(10.0,))
+    h.observe(2.0, n=4)
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["sum"] == 8.0
+
+
+def test_histogram_percentiles_nearest_rank():
+    h = Histogram(buckets=(1000.0,))
+    for v in (10.0, 20.0):
+        h.observe(v)
+    # nearest-rank: p50 of [10, 20] is 10, not 20
+    assert h.percentile(0.50) == 10.0
+    assert h.percentile(0.99) == 20.0
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.snapshot()["p99"] == 99.0
+
+
+def test_nearest_rank_pct_edge_cases():
+    assert nearest_rank_pct([], 0.5) == 0.0
+    assert nearest_rank_pct([7.0], 0.99) == 7.0
+    xs = [float(i) for i in range(1, 11)]
+    assert nearest_rank_pct(xs, 0.50) == 5.0
+    assert nearest_rank_pct(xs, 0.90) == 9.0
+    assert nearest_rank_pct(xs, 0.99) == 10.0
+
+
+# ----------------------------------------------------- engine step metrics
+def test_engine_step_metrics_snapshot_shape():
+    m = EngineStepMetrics()
+    m.on_schedule(waiting=3, running=2)
+    m.on_step(step_ms=12.5, new_tokens=4, prefill_tokens=16)
+    m.ttft_ms.observe(80.0)
+    m.itl_ms.observe(9.0, n=3)
+    m.tpot_ms.observe(11.0)
+    snap = m.snapshot()
+    assert snap["gauges"] == {"num_waiting": 3, "num_running": 2}
+    assert snap["counters"] == {"num_steps": 1, "tokens_generated": 4,
+                                "prefill_tokens": 16}
+    assert snap["ttft_ms"]["count"] == 1
+    assert snap["ttft_ms"]["p50"] == 80.0
+    assert snap["itl_ms"]["count"] == 3
+    assert snap["step_ms"]["count"] == 1
+    # snapshot is plain JSON-serializable data (it rides the stage_proc
+    # channel and the /metrics JSON route)
+    json.dumps(snap)

@@ -107,6 +107,9 @@ class ARScheduler:
         self._errored: list[Request] = []
         # transfers awaiting extraction ACK, keyed by request_id
         self._active_transfer_reqs: dict[str, Request] = {}
+        # lifetime counters for step-level metrics (/metrics gauges)
+        self.num_preemptions = 0
+        self.num_rejections = 0
 
     # ------------------------------------------------------------- intake
     def add_request(self, request: Request, injected_len: int = 0) -> None:
@@ -143,6 +146,7 @@ class ARScheduler:
         request.additional_information.setdefault("error_kind", kind)
         self._finished_ids.add(request.request_id)
         self._errored.append(request)
+        self.num_rejections += 1
 
     def find_request(self, request_id: str):
         """(queue, request) for an in-flight id, else (None, None)."""
@@ -286,12 +290,18 @@ class ARScheduler:
                 # FULL window into its up-front-allocated pages and the
                 # runner trims the overshoot host-side
                 # (_truncate_at_stop); KV past the stop is unreferenced
-                # garbage freed with the request.  Only a hard slot
-                # ceiling (max_model_len) or an exhausted token budget
-                # degrades — to the single-step path, whose executable
-                # always exists, never to an intermediate length.
+                # garbage freed with the request.  A hard slot ceiling
+                # (max_model_len), an exhausted token budget, or a
+                # single remaining token degrades — to the single-step
+                # path, whose executable always exists, never to an
+                # intermediate length.
+                # need == 1: W-1 of the window's iterations would be
+                # guaranteed-discarded work (ADVICE round 5)
+                need = (req.sampling_params.max_tokens
+                        - len(req.output_token_ids))
                 w = self.config.multi_step_decode
-                if (w <= self.config.max_model_len - req.num_tokens
+                if (need > 1
+                        and w <= self.config.max_model_len - req.num_tokens
                         and w <= budget):
                     window = w
             alloc_n = max(n_new, window)
@@ -359,6 +369,7 @@ class ARScheduler:
 
     def _preempt(self, req: Request) -> None:
         """Recompute-preemption: free pages, reset progress, back to waiting."""
+        self.num_preemptions += 1
         self.kv.free(req)
         req.num_computed_tokens = 0
         # collected hidden states are recomputed from scratch on resume —

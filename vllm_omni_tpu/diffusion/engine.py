@@ -30,9 +30,14 @@ from vllm_omni_tpu.diffusion.request import (
     OmniDiffusionSamplingParams,
 )
 from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.metrics.stats import Histogram
 from vllm_omni_tpu.models.registry import DiffusionModelRegistry
 
 logger = init_logger(__name__)
+
+# diffusion batch wall times run seconds-to-minutes, not milliseconds
+_GEN_SECONDS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                        60.0, 120.0, 300.0)
 
 
 _UNSET = object()
@@ -240,8 +245,21 @@ class DiffusionEngine:
         from vllm_omni_tpu.diffusion.lora import LoRAManager
 
         self.lora_manager = LoRAManager()
+        # observability: step counters + batch-time histogram surfaced
+        # through /metrics; stage_id stamped by OmniStage
+        self.stage_id = 0
+        self._num_requests = 0
+        self._num_batches = 0
+        self._gen_seconds = Histogram(buckets=_GEN_SECONDS_BUCKETS)
         if warmup:
             self._warmup()
+
+    def metrics_snapshot(self) -> dict:
+        return {"diffusion": {
+            "requests_total": self._num_requests,
+            "batches_total": self._num_batches,
+            "gen_seconds": self._gen_seconds.snapshot(),
+        }}
 
     @staticmethod
     def _pipeline_config(pipeline_cls, size: str):
@@ -436,6 +454,9 @@ class DiffusionEngine:
         finally:
             self.pipeline.dit_params = base
         dt = time.perf_counter() - t0
+        self._num_batches += 1
+        self._num_requests += len(outs)
+        self._gen_seconds.observe(dt)
         for o in outs:
             o.metrics["gen_s"] = dt
         return outs

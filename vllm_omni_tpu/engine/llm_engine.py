@@ -22,6 +22,8 @@ import jax.numpy as jnp
 
 from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
 from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.metrics.stats import EngineStepMetrics
+from vllm_omni_tpu.tracing import get_recorder
 from vllm_omni_tpu.core.scheduler import (
     ARScheduler,
     GenerationScheduler,
@@ -158,6 +160,14 @@ class LLMEngine:
         self.kv_transfer_sink: Optional[Callable] = None
         self._req_counter = 0
         self._starved_ticks = 0
+        # observability: step-level gauges/histograms (TTFT/TPOT/ITL) +
+        # per-request span recording.  stage_id is stamped by OmniStage
+        # so spans and /metrics series carry the pipeline position.
+        self.stage_id = 0
+        self.step_metrics = EngineStepMetrics()
+        # request_id -> [first_token_ts, last_token_ts, tokens_seen]
+        self._req_lat: dict[str, list] = {}
+        self._trace_started: set[str] = set()
         if config.warmup:
             shapes = (config.warmup if isinstance(
                 config.warmup, (list, tuple)) else ())
@@ -223,9 +233,15 @@ class LLMEngine:
         table = self.scheduler.kv.allocate(req, use)
         if table is not None:
             try:
+                t0, w0 = time.perf_counter(), time.time()
                 trimmed = [(k[:, :use], v[:, :use]) for k, v in payload]
                 self.runner.inject_kv(table, trimmed)
                 req.num_computed_tokens = use
+                get_recorder().record(
+                    req.additional_information.get("trace"), "kv_inject",
+                    w0, time.perf_counter() - t0, stage_id=self.stage_id,
+                    cat="kv", args={"tokens": use},
+                )
                 return
             except (ValueError, IndexError) as e:
                 # malformed payload (e.g. upstream layer-count mismatch):
@@ -361,6 +377,8 @@ class LLMEngine:
 
     def abort_request(self, request_id: str) -> None:
         self.scheduler.abort_request(request_id)
+        self._req_lat.pop(request_id, None)
+        self._trace_started.discard(request_id)
 
     @property
     def has_unfinished_requests(self) -> bool:
@@ -388,12 +406,42 @@ class LLMEngine:
         fn = getattr(kv, "reset_prefix_cache", None)
         return fn() if fn is not None else 0
 
+    def metrics_snapshot(self) -> dict:
+        """Step-level engine metrics for /metrics (Prometheus + JSON):
+        latency histograms, scheduler depth + preemption/rejection
+        counters, KV page utilization, prefix-cache effectiveness."""
+        kv = self.scheduler.kv
+        used = kv.num_pages - kv.num_free_pages
+        snap = self.step_metrics.snapshot()
+        snap["scheduler"] = {
+            "waiting": len(self.scheduler.waiting),
+            "running": len(self.scheduler.running),
+            "preemptions": getattr(self.scheduler, "num_preemptions", 0),
+            "rejections": getattr(self.scheduler, "num_rejections", 0),
+        }
+        snap["kv"] = {
+            "pages_total": kv.num_pages,
+            "pages_used": used,
+            "utilization": round(used / kv.num_pages, 4),
+        }
+        snap["prefix_cache"] = self.prefix_cache_stats
+        return snap
+
     def step(self) -> list[OmniRequestOutput]:
+        t_step0 = time.perf_counter()
         # surface intake-rejected requests as errored outputs instead of
         # silently dropping them
+        errored_reqs = self.scheduler.drain_errored()
+        for r in errored_reqs:
+            self._req_lat.pop(r.request_id, None)
+            self._trace_started.discard(r.request_id)
         errored = [OmniRequestOutput.from_pipeline(r)
-                   for r in self.scheduler.drain_errored()]
+                   for r in errored_reqs]
         sched_out = self.scheduler.schedule()
+        self.step_metrics.on_schedule(
+            waiting=len(self.scheduler.waiting),
+            running=len(self.scheduler.running),
+        )
         if sched_out.num_scheduled == 0:
             if self.scheduler.waiting:
                 if any(r.awaiting_chunks for r in self.scheduler.running):
@@ -414,6 +462,11 @@ class LLMEngine:
                 # recompute footprint outgrew the pool). Error-finish it so
                 # one bad request can't wedge the whole engine.
                 victim = self.scheduler.waiting.pop(0)
+                self._req_lat.pop(victim.request_id, None)
+                self._trace_started.discard(victim.request_id)
+                # error-finished outside scheduler.reject(): count it so
+                # rejections_total covers starvation too
+                self.scheduler.num_rejections += 1
                 victim.status = RequestStatus.FINISHED_ERROR
                 victim.additional_information.setdefault(
                     "error",
@@ -438,16 +491,83 @@ class LLMEngine:
             # only streaming requests idling for their next chunk remain
             return errored
         self._starved_ticks = 0
+        rec = get_recorder()
+        scheduled = sched_out.prefills + sched_out.decodes
+        now_w = time.time()
+        for s in scheduled:
+            # queue-wait span: arrival to FIRST time scheduled
+            req = s.request
+            if req.request_id in self._trace_started:
+                continue
+            self._trace_started.add(req.request_id)
+            ctx = req.additional_information.get("trace")
+            if ctx and req.arrival_time:
+                rec.record(ctx, "queue_wait", req.arrival_time,
+                           now_w - req.arrival_time,
+                           stage_id=self.stage_id, cat="queue")
+        t_ex0, w_ex0 = time.perf_counter(), time.time()
         run_out = self.runner.execute(
             sched_out, extract_kv=self.kv_transfer_sink is not None
         )
+        dur_ex = time.perf_counter() - t_ex0
+        for s in sched_out.prefills:
+            rec.record(s.request.additional_information.get("trace"),
+                       "prefill", w_ex0, dur_ex, stage_id=self.stage_id,
+                       args={"tokens": s.num_new_tokens,
+                             "start_pos": s.start_pos})
+        for s in sched_out.decodes:
+            rec.record(s.request.additional_information.get("trace"),
+                       "decode", w_ex0, dur_ex, stage_id=self.stage_id,
+                       args={"window": s.window,
+                             "tokens": s.num_new_tokens})
         if self.kv_transfer_sink is not None:
             for req, _, _ in sched_out.kv_transfer_requests:
                 payload = run_out.extracted_kv.get(req.request_id)
                 if payload is not None:
                     self.kv_transfer_sink(req, payload)
+        t_up0, w_up0 = time.perf_counter(), time.time()
         finished = self.scheduler.update_from_output(
             sched_out, run_out.sampled, run_out.kv_extracted_req_ids
+        )
+        dur_up = time.perf_counter() - t_up0
+        for s in scheduled:
+            rec.record(s.request.additional_information.get("trace"),
+                       "sampling", w_up0, dur_up, stage_id=self.stage_id,
+                       args={"batch": len(scheduled)})
+        # TTFT / ITL / TPOT bookkeeping from the host-visible token deltas
+        now = time.time()
+        sm = self.step_metrics
+        new_total = 0
+        for s in scheduled:
+            req = s.request
+            n_out = len(req.output_token_ids)
+            st = self._req_lat.setdefault(req.request_id, [0.0, 0.0, 0])
+            if n_out <= st[2]:
+                continue
+            new = n_out - st[2]
+            new_total += new
+            if st[2] == 0:
+                if req.arrival_time:
+                    sm.ttft_ms.observe((now - req.arrival_time) * 1e3)
+                st[0] = now
+                new -= 1  # the first token is TTFT, not an ITL
+            if new > 0 and st[1]:
+                # a multi-step window emits its tokens in one host round
+                # trip: amortize the gap over them
+                sm.itl_ms.observe((now - st[1]) * 1e3 / new, n=new)
+            st[1] = now
+            st[2] = n_out
+        for req in finished:
+            st = self._req_lat.pop(req.request_id, None)
+            self._trace_started.discard(req.request_id)
+            n_out = len(req.output_token_ids)
+            if st and st[0] and n_out > 1:
+                sm.tpot_ms.observe((now - st[0]) * 1e3 / (n_out - 1))
+        sm.on_step(
+            step_ms=(time.perf_counter() - t_step0) * 1e3,
+            new_tokens=new_total,
+            prefill_tokens=sum(s.num_new_tokens
+                               for s in sched_out.prefills),
         )
         if self.config.collect_hidden:
             # consolidate per-step hidden chunks into the next-stage payload

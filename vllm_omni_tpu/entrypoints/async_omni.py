@@ -68,6 +68,9 @@ class AsyncOmni:
     def shutdown(self) -> None:
         self._running = False
         self._thread.join(timeout=10)
+        # final drain + the one Chrome-document export (the heartbeat
+        # only streams JSONL)
+        self._omni.flush_traces()
 
     @property
     def stage_configs(self):
@@ -165,6 +168,9 @@ class AsyncOmni:
             req = StageRequest(request_id=request_id,
                                prompt_token_ids=list(prompt),
                                sampling_params=sp)
+        # trace context BEFORE enqueue: the engine thread may drain the
+        # intake the instant the put lands
+        req.trace = self._omni.trace_begin(request_id)
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
         while True:
@@ -210,6 +216,7 @@ class AsyncOmni:
         if entry is not None:
             loop, q = entry
             loop.call_soon_threadsafe(q.put_nowait, _SENTINEL)
+        self._omni.trace_finish(request_id)
 
     # --------------------------------------------------------- engine loop
     def _emit(self, request_id: str, item) -> None:
@@ -237,6 +244,9 @@ class AsyncOmni:
                 # path collects at end-of-generate) so long-running
                 # servers aggregate + stream jsonl as they go
                 omni.harvest_stage_stats()
+                # JSONL only: the full Chrome rewrite is shutdown-time
+                # work, not something to run on the engine thread
+                omni.flush_traces(export_chrome=False)
                 if self._streams:
                     summ = omni.stats_summary()
                     logger.info(
@@ -257,6 +267,7 @@ class AsyncOmni:
                     entry_stage.submit(pending)
                 except Exception as e:  # bad request payloads
                     for r in pending:
+                        omni.trace_finish(r.request_id)
                         self._emit(r.request_id, e)
                         self._emit(r.request_id, _SENTINEL)
             # 2. step stages + forward
@@ -270,6 +281,7 @@ class AsyncOmni:
                     # request inside LLMEngine.step and arrives as outputs)
                     logger.exception("stage %d poll failed", stage.stage_id)
                     for rid in list(self._streams):
+                        omni.trace_finish(rid)
                         self._emit(rid, e)
                         self._emit(rid, _SENTINEL)
                     continue
@@ -282,6 +294,7 @@ class AsyncOmni:
                 outs = [o for o in outs if not o.is_error]
                 for o in errs:
                     omni.metrics.record_finish(o.request_id)
+                    omni.trace_finish(o.request_id)
                     self._emit(o.request_id, o)
                     self._emit(o.request_id, _SENTINEL)
                 if not outs:
@@ -295,6 +308,7 @@ class AsyncOmni:
                         if seen >= self._n_finals:
                             # E2E spans through the LAST final output
                             omni.metrics.record_finish(o.request_id)
+                            omni.trace_finish(o.request_id)
                             self._emit(o.request_id, _SENTINEL)
                 try:
                     omni._forward(stage, outs)
@@ -303,6 +317,10 @@ class AsyncOmni:
                     logger.exception("forward from stage %d failed",
                                      stage.stage_id)
                     for o in outs:
+                        # terminate the stream's trace too: the sync
+                        # generate() sweeps leftover contexts at the end,
+                        # the online loop has no such sweep
+                        omni.trace_finish(o.request_id)
                         self._emit(o.request_id, e)
                         self._emit(o.request_id, _SENTINEL)
             if not progressed and not pending:

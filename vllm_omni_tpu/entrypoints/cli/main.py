@@ -34,6 +34,14 @@ def _add_common(p: argparse.ArgumentParser):
                      default=None)
     eng.add_argument("--num-speculative-tokens", type=int, default=None)
     p.add_argument(
+        "--stats-path", default=None, metavar="PREFIX",
+        help="stream per-stage + E2E stats to PREFIX.*.stats.jsonl")
+    p.add_argument(
+        "--trace-path", default=None, metavar="PREFIX",
+        help="per-request distributed traces: PREFIX.trace.jsonl + "
+             "PREFIX.trace.json (Perfetto-loadable Chrome trace); see "
+             "docs/observability.md")
+    p.add_argument(
         "--stage-override", action="append", default=[],
         metavar="N.KEY=VALUE",
         help="set engine_args KEY of stage N (repeatable); VALUE parses "
@@ -78,6 +86,8 @@ def cmd_serve(args) -> int:
         stage_configs=args.stage_configs_path,
         host=args.host,
         port=args.port,
+        stats_path=args.stats_path,
+        trace_path=args.trace_path,
         **_stage_overrides(args),
     )
     return 0
@@ -87,6 +97,7 @@ def cmd_generate(args) -> int:
     from vllm_omni_tpu.entrypoints.omni import Omni
 
     omni = Omni(model=args.model, stage_configs=args.stage_configs_path,
+                stats_path=args.stats_path, trace_path=args.trace_path,
                 **_stage_overrides(args))
     sp = json.loads(args.sampling_params) if args.sampling_params else {}
     outs = omni.generate([args.prompt], [sp])

@@ -30,6 +30,7 @@ from vllm_omni_tpu.entrypoints.omni_stage import OmniStage, StageRequest
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.metrics.stats import OrchestratorAggregator
 from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.tracing import TraceWriter, get_recorder, new_trace_context
 
 logger = init_logger(__name__)
 
@@ -40,6 +41,7 @@ class Omni:
         model: Optional[str] = None,
         stage_configs: Optional[Union[str, list[StageConfig]]] = None,
         stats_path: Optional[str] = None,
+        trace_path: Optional[str] = None,
         **overrides: Any,
     ):
         if stage_configs is None:
@@ -119,6 +121,19 @@ class Omni:
                 self.stages.append(OmniStage(cfg))
                 self.memory_accountant.snapshot(cfg.stage_id)
         self.metrics = OrchestratorAggregator(len(configs), stats_path)
+        # per-request distributed tracing: a trace context created at
+        # arrival, re-stamped on every stage handoff, closed at final
+        # output.  ``trace_path`` is a path prefix like ``stats_path``
+        # ({prefix}.trace.jsonl + {prefix}.trace.json Chrome trace);
+        # OMNI_TPU_TRACE_PATH is the env fallback.
+        if trace_path is None:
+            from vllm_omni_tpu import envs
+
+            trace_path = envs.OMNI_TPU_TRACE_PATH or None
+        self._trace_writer = (TraceWriter(trace_path)
+                              if trace_path else None)
+        self._trace_ctx: dict[str, dict] = {}
+        self._trace_arrival: dict[str, float] = {}
         # connector per pipeline edge (from->to), from stage YAML
         # output_connectors; in-proc default
         self._edge_connectors = {}
@@ -129,6 +144,46 @@ class Omni:
                 self._edge_connectors[(cfg.stage_id, int(to_str))] = (
                     ConnectorFactory.create(name, **spec)
                 )
+
+    # ------------------------------------------------------------- tracing
+    @property
+    def tracing_enabled(self) -> bool:
+        return self._trace_writer is not None
+
+    def trace_begin(self, request_id: str) -> Optional[dict]:
+        """Create the request's trace context at arrival (None when
+        tracing is disabled — every recording call downstream no-ops)."""
+        if self._trace_writer is None:
+            return None
+        ctx = new_trace_context(request_id)
+        self._trace_ctx[request_id] = ctx
+        self._trace_arrival[request_id] = time.time()
+        return ctx
+
+    def trace_finish(self, request_id: str) -> None:
+        """Close the request's trace at final output: emits the
+        whole-lifetime "request" span on the orchestrator track."""
+        ctx = self._trace_ctx.pop(request_id, None)
+        t0 = self._trace_arrival.pop(request_id, None)
+        if ctx is None or t0 is None:
+            return
+        get_recorder().record(ctx, "request", t0, time.time() - t0,
+                              stage_id=-1, cat="request")
+
+    def flush_traces(self, export_chrome: bool = True) -> None:
+        """Drain recorded spans into the trace files (offline: called at
+        end-of-generate; online: every stats heartbeat + shutdown).
+
+        ``export_chrome=False`` appends the JSONL only — rewriting the
+        complete Chrome document (json.dump of up to 200k spans) every
+        heartbeat would stall the engine loop, spiking in-flight ITL; the
+        heartbeat streams, and the document is written at shutdown (or
+        rebuilt offline from the JSONL)."""
+        if self._trace_writer is None:
+            return
+        self._trace_writer.write(get_recorder().drain())
+        if export_chrome:
+            self._trace_writer.export_chrome()
 
     # ------------------------------------------------------------ dataflow
     def _consumers(self, stage_id: int) -> list[OmniStage]:
@@ -146,6 +201,13 @@ class Omni:
             "OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION") == "1"
         for consumer in self._consumers(from_stage.stage_id):
             reqs = consumer.process_engine_inputs(outputs)
+            # re-stamp the trace context on every handoff: the default
+            # input processor (and custom ones) build fresh StageRequests
+            # that would otherwise drop it at the stage boundary
+            for r in reqs:
+                ctx = self._trace_ctx.get(r.request_id)
+                if ctx is not None:
+                    r.trace = ctx
             edge = (from_stage.stage_id, consumer.stage_id)
             conn = self._edge_connectors.get(edge)
             if (conn is not None and getattr(conn, "zero_copy", False)
@@ -154,12 +216,15 @@ class Omni:
                 # put-then-get on the same thread measures serialization,
                 # not transport (VERDICT r2 weak #5)
                 conn = None
+            t0, w0 = time.perf_counter(), time.time()
+            req_bytes: dict[str, int] = {}
             if conn is not None:
-                t0 = time.perf_counter()
                 nbytes = 0
                 for r in reqs:
                     key = make_key(r.request_id, *edge)
-                    nbytes += conn.put(key, r.__dict__)
+                    n = conn.put(key, r.__dict__)
+                    req_bytes[r.request_id] = n
+                    nbytes += n
                 shipped = []
                 for r in reqs:
                     key = make_key(r.request_id, *edge)
@@ -171,6 +236,15 @@ class Omni:
                     *edge, nbytes, (time.perf_counter() - t0) * 1e3
                 )
                 reqs = shipped
+            dur = time.perf_counter() - t0
+            rec = get_recorder()
+            for r in reqs:
+                # zero-copy handoffs record a (near-zero) span too, so a
+                # trace always shows every edge a request crossed
+                rec.record(r.trace, "transfer", w0, dur,
+                           stage_id=consumer.stage_id, cat="transfer",
+                           args={"edge": f"{edge[0]}->{edge[1]}",
+                                 "bytes": req_bytes.get(r.request_id, 0)})
             consumer.submit(reqs)
 
     # ------------------------------------------------------------ generate
@@ -200,6 +274,7 @@ class Omni:
                                          prompt_token_ids=list(p),
                                          sampling_params=sp))
             self.metrics.record_arrival(rid)
+            seed[-1].trace = self.trace_begin(rid)
 
         expected = {r.request_id for r in seed}
         n_finals = max(1, sum(1 for s in self.stages
@@ -225,6 +300,7 @@ class Omni:
                 for o in errs:
                     finals.setdefault(o.request_id, []).append(o)
                     self.metrics.record_finish(o.request_id)
+                    self.trace_finish(o.request_id)
                 if stage.config.final_output:
                     for o in outs:
                         o.final_output_type = stage.config.final_output_type
@@ -234,9 +310,15 @@ class Omni:
                         # would freeze e2e at the first final output)
                         if len(finals[o.request_id]) >= n_finals:
                             self.metrics.record_finish(o.request_id)
+                            self.trace_finish(o.request_id)
                 if outs:
                     self._forward(stage, outs)
         self.harvest_stage_stats()
+        # requests lost in the pipeline must not leak trace state
+        for r in seed:
+            self._trace_ctx.pop(r.request_id, None)
+            self._trace_arrival.pop(r.request_id, None)
+        self.flush_traces()
         missing = expected - set(finals)
         if missing:
             logger.warning("requests lost in pipeline: %s", sorted(missing))
@@ -252,7 +334,9 @@ class Omni:
 
     def stats_summary(self) -> dict:
         """Aggregator summary enriched with per-stage engine counters
-        (prefix-cache hits for in-proc AR stages)."""
+        (prefix-cache hits for in-proc AR stages) and the step-level
+        engine snapshots (scheduler depth, KV utilization, TTFT/TPOT/ITL
+        — the JSON face of the Prometheus exposition)."""
         summ = self.metrics.summary()
         for stage in self.stages:
             eng = getattr(stage, "engine", None)
@@ -261,11 +345,16 @@ class Omni:
                 summ["stages"].setdefault(stage.config.stage_id, {})[
                     "prefix_cache"] = {k: pcs[k]
                                        for k in ("hits", "hit_tokens")}
+        summ["engines"] = {
+            stage.stage_id: stage.engine_metrics_snapshot()
+            for stage in self.stages
+        }
         return summ
 
     def shutdown(self) -> None:
         """Stop process-disaggregated stage workers (no-op for in-proc
         stages)."""
+        self.flush_traces()
         for stage in self.stages:
             stop = getattr(stage, "shutdown", None)
             if callable(stop):

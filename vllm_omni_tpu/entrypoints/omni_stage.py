@@ -45,6 +45,11 @@ class StageRequest:
     # [...]} — run through the stage's mm_processor at submit (reference:
     # multimodal chat messages -> OmniInputProcessor)
     multi_modal_data: Optional[dict[str, Any]] = None
+    # per-request trace context ({"trace_id", "request_id"}), created at
+    # Omni/AsyncOmni arrival and re-stamped on every stage handoff by the
+    # orchestrator — a plain dict so it survives the stage_proc sockets
+    # and connector edges through OmniSerializer (tracing/trace.py)
+    trace: Optional[dict[str, Any]] = None
 
 
 def _import_obj(path: str):
@@ -121,7 +126,11 @@ class OmniStage:
         self._done: list[OmniRequestOutput] = []
         self._input_processor = config.resolve_input_processor()
         self._submit_ts: dict[str, float] = {}
+        self._trace_ctx: dict[str, dict] = {}
         self.request_stats: list[StageRequestStats] = []
+        # spans/metrics from the engine must carry the pipeline position
+        if hasattr(self.engine, "stage_id"):
+            self.engine.stage_id = self.stage_id
         from vllm_omni_tpu.metrics.profiler import StageProfiler
 
         self.profiler = StageProfiler(self.stage_id)
@@ -250,6 +259,8 @@ class OmniStage:
         now = time.perf_counter()
         for r in reqs:
             self._submit_ts[r.request_id] = now
+            if r.trace:
+                self._trace_ctx[r.request_id] = r.trace
         if self.config.stage_type == "llm":
             defaults = dict(self.config.default_sampling_params)
             for r in reqs:
@@ -296,6 +307,10 @@ class OmniStage:
                     if ds is not None:
                         mm_kwargs["deepstack_embeds"] = ds
                 info = dict(r.additional_information)
+                if r.trace:
+                    # engine-level spans (queue_wait/prefill/decode/
+                    # sampling) key off the request's trace context
+                    info["trace"] = dict(r.trace)
                 # upstream-extracted KV prefix lands in this engine's cache
                 # (receive half of the transfer manager)
                 injected_kv = info.pop("kv_payload", None)
@@ -384,6 +399,7 @@ class OmniStage:
             sampling_params=sp,
             request_ids=[r.request_id for r in batch],
         )
+        t0, w0 = time.perf_counter(), time.time()
         try:
             diff_outs = self.engine.step(req)
         except Exception as e:
@@ -404,6 +420,15 @@ class OmniStage:
                 )
                 for r in batch
             ]
+        dur = time.perf_counter() - t0
+        from vllm_omni_tpu.tracing import get_recorder
+
+        rec = get_recorder()
+        for r in batch:
+            rec.record(r.trace, "diffusion_generate", w0, dur,
+                       stage_id=self.stage_id,
+                       args={"batch": len(batch),
+                             "steps": sp.num_inference_steps})
         return [
             OmniRequestOutput.from_diffusion(
                 o.request_id, [o.data], final_output_type=o.output_type
@@ -432,9 +457,28 @@ class OmniStage:
         return reqs
 
     # ------------------------------------------------------------- metrics
+    def engine_metrics_snapshot(self) -> dict:
+        """Step-level engine metrics for /metrics; {} when the engine
+        exposes none (ProcStage overrides with the worker's last shipped
+        snapshot)."""
+        fn = getattr(self.engine, "metrics_snapshot", None)
+        return fn() if fn is not None else {}
+
     def _record(self, out: OmniRequestOutput) -> None:
         t0 = self._submit_ts.pop(out.request_id, None)
         gen_ms = (time.perf_counter() - t0) * 1e3 if t0 else 0.0
+        ctx = self._trace_ctx.pop(out.request_id, None)
+        if ctx is not None:
+            # stage-granularity span: submit to output (covers queue +
+            # compute; for proc stages it additionally covers transport)
+            from vllm_omni_tpu.tracing import get_recorder
+
+            get_recorder().record(
+                ctx, "stage", time.time() - gen_ms / 1e3, gen_ms / 1e3,
+                stage_id=self.stage_id, cat="stage",
+                args={"tokens_out": sum(len(c.token_ids)
+                                        for c in out.outputs)},
+            )
         self.request_stats.append(StageRequestStats(
             request_id=out.request_id,
             stage_id=self.stage_id,

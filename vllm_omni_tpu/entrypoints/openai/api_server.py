@@ -294,6 +294,11 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
 
     # --------------------------------------------------------------- GET
     def do_GET(self):
+        from urllib.parse import parse_qs, urlsplit
+
+        parts = urlsplit(self.path)
+        if parts.path == "/metrics":
+            return self._metrics(parse_qs(parts.query))
         if self.path == "/health":
             self._json(200, {"status": "ok"})
         elif self.path == "/v1/models":
@@ -315,21 +320,38 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             self._json(200, {"voices": voices or ["default"]})
         elif self.path == "/version":
             self._json(200, {"version": __version__})
-        elif self.path == "/metrics":
-            summary = self.state.omni._omni.stats_summary() if hasattr(self.state.omni, '_omni') else self.state.omni.metrics.summary()
-            # device memory snapshot (per-process accounting analogue,
-            # reference: worker/gpu_memory_utils.py NVML probes)
-            from vllm_omni_tpu.platforms import current_platform
-
-            p = current_platform()
-            summary["device"] = {
-                "platform": p.name,
-                "kind": p.device_kind(),
-                "hbm_bytes": p.hbm_bytes(),
-            }
-            self._json(200, summary)
         else:
             self._error(404, f"unknown path {self.path}")
+
+    def _metrics(self, query: dict):
+        """``GET /metrics``: Prometheus text exposition (the scrape
+        surface); ``/metrics?format=json`` keeps the JSON summary."""
+        omni = getattr(self.state.omni, "_omni", self.state.omni)
+        # device memory snapshot (per-process accounting analogue,
+        # reference: worker/gpu_memory_utils.py NVML probes)
+        from vllm_omni_tpu.platforms import current_platform
+
+        p = current_platform()
+        device = {
+            "platform": p.name,
+            "kind": p.device_kind(),
+            "hbm_bytes": p.hbm_bytes(),
+        }
+        if query.get("format", ["prometheus"])[0] == "json":
+            summary = (omni.stats_summary()
+                       if hasattr(omni, "stats_summary")
+                       else omni.metrics.summary())
+            summary["device"] = device
+            return self._json(200, summary)
+        from vllm_omni_tpu.metrics.prometheus import render_from_omni
+
+        data = render_from_omni(omni, device=device).encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     # --------------------------------------------------------------- POST
     def do_POST(self):

@@ -316,8 +316,22 @@ def _stage_worker_serve(config: StageConfig, chan,
                            "error": f"{type(e).__name__}: {e}"})
                 raise
             if outs:
+                # trace spans recorded in THIS process (engine + stage
+                # spans) ride the outputs frame back to the orchestrator,
+                # which merges them into the request's trace; the engine
+                # metrics snapshot rides along so /metrics covers
+                # process-disaggregated stages too
+                from vllm_omni_tpu.tracing import get_recorder
+
+                msg = {"type": "outputs", "outputs": outs}
+                spans = get_recorder().drain()
+                if spans:
+                    msg["spans"] = spans
+                metrics = stage.engine_metrics_snapshot()
+                if metrics:
+                    msg["metrics"] = metrics
                 try:
-                    chan.send({"type": "outputs", "outputs": outs})
+                    chan.send(msg)
                 except ValueError as e:
                     # frame exceeded the shm ring admission limit: tell
                     # the orchestrator with a (small) fatal message
@@ -354,7 +368,9 @@ class ProcStage(OmniStage):
         self._done: list[OmniRequestOutput] = []
         self._input_processor = config.resolve_input_processor()
         self._submit_ts: dict[str, float] = {}
+        self._trace_ctx: dict[str, dict] = {}
         self.request_stats = []
+        self._engine_metrics: dict = {}
         self._inflight: set[str] = set()
         self._inbox: queue.Queue = queue.Queue()
         self._fatal: Optional[str] = None
@@ -505,6 +521,8 @@ class ProcStage(OmniStage):
         now = time.perf_counter()
         for r in reqs:
             self._submit_ts[r.request_id] = now
+            if r.trace:
+                self._trace_ctx[r.request_id] = r.trace
             self._inflight.add(r.request_id)
         if self._fatal is None:
             try:
@@ -527,6 +545,15 @@ class ProcStage(OmniStage):
             t = msg.get("type")
             if t == "outputs":
                 outs.extend(msg["outputs"])
+                spans = msg.get("spans")
+                if spans:
+                    # merge worker-side spans into this process's trace
+                    from vllm_omni_tpu.tracing import get_recorder
+
+                    get_recorder().extend(spans)
+                metrics = msg.get("metrics")
+                if metrics:
+                    self._engine_metrics = metrics
             elif t == "fatal":
                 self._fatal = msg.get("error", "unknown")
         for o in outs:
@@ -553,6 +580,11 @@ class ProcStage(OmniStage):
     @property
     def has_unfinished(self) -> bool:
         return bool(self._inflight)
+
+    def engine_metrics_snapshot(self) -> dict:
+        """Last engine snapshot shipped by the worker (rides the outputs
+        frames) — the cross-process face of OmniStage's live snapshot."""
+        return self._engine_metrics
 
     # ----------------------------------------------------------- profiling
     def start_profile(self, trace_dir: str) -> None:

@@ -37,6 +37,9 @@ _register("OMNI_TPU_PALLAS_INTERPRET", "0", _bool)
 _register("OMNI_TPU_PROFILER_DIR", "", str)
 # Stats jsonl output (reference: --log-stats).
 _register("OMNI_TPU_STATS_DIR", "", str)
+# Per-request trace output path PREFIX ({prefix}.trace.jsonl +
+# {prefix}.trace.json Chrome trace) — the env face of Omni(trace_path=).
+_register("OMNI_TPU_TRACE_PATH", "", str)
 # Connector backend default for single-node stage transfer.
 _register("OMNI_TPU_CONNECTOR", "shm", str)
 # Per-stage logging prefix.

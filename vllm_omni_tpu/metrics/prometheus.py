@@ -1,0 +1,316 @@
+"""Prometheus text exposition for the pipeline + engine metrics.
+
+The serving layer's ``GET /metrics`` renders through here (JSON summary
+stays available at ``/metrics?format=json``).  Dependency-free on
+purpose — the runtime ships no prometheus_client, matching the
+native-runtime stance of the stdlib HTTP server.
+
+``METRIC_SPECS`` is the single source of truth for the exported metric
+surface: name (without the ``vllm_omni_tpu_`` prefix), type, help, and
+the labels every sample must carry.  ``validate_exposition`` parses a
+rendered exposition back against it — ``scripts/check_metrics_names.py``
+and the metrics tests both run that check so the surface can't silently
+drift.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+METRIC_PREFIX = "vllm_omni_tpu_"
+
+# metric name must match this (prefix + lowercase/underscore only — no
+# digits, which is why the E2E latency series is "request_latency_ms")
+NAME_RE = re.compile(r"vllm_omni_tpu_[a-z_]+")
+
+# name -> (type, help, required label names)
+METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "requests_finished_total": (
+        "counter", "Requests that completed the full pipeline", ()),
+    "request_latency_ms": (
+        "gauge", "End-to-end request latency percentiles (recent window)",
+        ("quantile",)),
+    "stage_requests_total": (
+        "counter", "Requests processed per stage", ("stage",)),
+    "stage_tokens_in_total": (
+        "counter", "Input tokens per stage", ("stage",)),
+    "stage_tokens_out_total": (
+        "counter", "Output tokens per stage", ("stage",)),
+    "stage_tokens_per_second": (
+        "gauge", "Generation throughput per stage", ("stage",)),
+    "transfer_count_total": (
+        "counter", "Inter-stage transfers per edge",
+        ("from_stage", "to_stage")),
+    "transfer_bytes_total": (
+        "counter", "Inter-stage transfer bytes per edge",
+        ("from_stage", "to_stage")),
+    "transfer_ms_total": (
+        "counter", "Inter-stage transfer milliseconds per edge",
+        ("from_stage", "to_stage")),
+    "scheduler_waiting": (
+        "gauge", "Requests in the waiting queue", ("stage",)),
+    "scheduler_running": (
+        "gauge", "Requests in the running batch", ("stage",)),
+    "preemptions_total": (
+        "counter", "Requests preempted (recompute policy)", ("stage",)),
+    "rejections_total": (
+        "counter", "Requests rejected at intake or error-finished",
+        ("stage",)),
+    "kv_pages_total": (
+        "gauge", "KV cache pages in the pool", ("stage",)),
+    "kv_pages_used": (
+        "gauge", "KV cache pages allocated to live requests", ("stage",)),
+    "kv_page_utilization": (
+        "gauge", "Fraction of KV cache pages in use", ("stage",)),
+    "prefix_cache_hits_total": (
+        "counter", "Automatic-prefix-cache hits", ("stage",)),
+    "prefix_cache_hit_tokens_total": (
+        "counter", "Prompt tokens served from the prefix cache",
+        ("stage",)),
+    "engine_steps_total": (
+        "counter", "Engine step() executions", ("stage",)),
+    "tokens_generated_total": (
+        "counter", "Output tokens sampled", ("stage",)),
+    "prefill_tokens_total": (
+        "counter", "Prompt tokens prefilled", ("stage",)),
+    "ttft_ms": (
+        "histogram", "Time to first token", ("stage",)),
+    "tpot_ms": (
+        "histogram", "Time per output token (finished requests)",
+        ("stage",)),
+    "itl_ms": (
+        "histogram", "Inter-token latency", ("stage",)),
+    "engine_step_ms": (
+        "histogram", "Engine step wall time", ("stage",)),
+    "diffusion_requests_total": (
+        "counter", "Diffusion requests generated", ("stage",)),
+    "diffusion_batches_total": (
+        "counter", "Diffusion batches executed", ("stage",)),
+    "diffusion_gen_seconds": (
+        "histogram", "Diffusion batch generation time", ("stage",)),
+    "hbm_bytes": (
+        "gauge", "Device HBM capacity", ()),
+}
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _fmt_value(v) -> str:
+    if v is None:
+        return "0"
+    f = float(v)
+    if f == float("inf"):
+        return "+Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v)}"' for k, v in labels.items()
+    )
+    return "{" + inner + "}"
+
+
+class _Exposition:
+    """Buffers samples per metric family: the text format requires every
+    line of a family to form ONE group (HELP/TYPE then all samples) —
+    interleaving per-stage loop output would break strict OpenMetrics
+    parsers even though the Prometheus server tolerates it."""
+
+    def __init__(self):
+        # family name -> sample lines, in first-use order
+        self._families: dict[str, list[str]] = {}
+
+    def sample(self, name: str, labels: dict, value,
+               suffix: str = "") -> None:
+        full = METRIC_PREFIX + name
+        self._families.setdefault(name, []).append(
+            f"{full}{suffix}{_fmt_labels(labels)} {_fmt_value(value)}")
+
+    def histogram(self, name: str, labels: dict, snap: dict) -> None:
+        """Render a stats.Histogram snapshot (cumulative buckets)."""
+        for le, cum in snap.get("buckets", ()):
+            self.sample(name, {**labels, "le": _fmt_value(le)}, cum,
+                        suffix="_bucket")
+        self.sample(name, labels, snap.get("sum", 0.0), suffix="_sum")
+        self.sample(name, labels, snap.get("count", 0), suffix="_count")
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for name, samples in self._families.items():
+            spec = METRIC_SPECS[name]
+            full = METRIC_PREFIX + name
+            lines.append(f"# HELP {full} {spec[1]}")
+            lines.append(f"# TYPE {full} {spec[0]}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+def render_exposition(summary: dict, engine_snaps: dict,
+                      device: Optional[dict] = None) -> str:
+    """``summary``: OrchestratorAggregator.summary(); ``engine_snaps``:
+    {stage_id: LLMEngine/DiffusionEngine.metrics_snapshot() or {}}."""
+    exp = _Exposition()
+    e2e = summary.get("e2e", {})
+    exp.sample("requests_finished_total", {}, e2e.get("num_finished", 0))
+    for q, key in (("0.5", "p50_ms"), ("0.9", "p90_ms"),
+                   ("0.99", "p99_ms")):
+        exp.sample("request_latency_ms", {"quantile": q}, e2e.get(key, 0.0))
+    for sid, st in sorted(summary.get("stages", {}).items()):
+        labels = {"stage": sid}
+        exp.sample("stage_requests_total", labels, st.get("num_requests", 0))
+        exp.sample("stage_tokens_in_total", labels, st.get("tokens_in", 0))
+        exp.sample("stage_tokens_out_total", labels, st.get("tokens_out", 0))
+        exp.sample("stage_tokens_per_second", labels, st.get("tps", 0.0))
+    for edge, e in sorted(summary.get("edges", {}).items()):
+        frm, _, to = str(edge).partition("->")
+        labels = {"from_stage": frm, "to_stage": to}
+        exp.sample("transfer_count_total", labels, e.get("transfers", 0))
+        exp.sample("transfer_bytes_total", labels, e.get("bytes", 0))
+        exp.sample("transfer_ms_total", labels, e.get("ms", 0.0))
+    for sid, snap in sorted(engine_snaps.items()):
+        if not snap:
+            continue
+        labels = {"stage": sid}
+        sched = snap.get("scheduler")
+        if sched:
+            exp.sample("scheduler_waiting", labels, sched.get("waiting", 0))
+            exp.sample("scheduler_running", labels, sched.get("running", 0))
+            exp.sample("preemptions_total", labels,
+                       sched.get("preemptions", 0))
+            exp.sample("rejections_total", labels,
+                       sched.get("rejections", 0))
+        kv = snap.get("kv")
+        if kv:
+            exp.sample("kv_pages_total", labels, kv.get("pages_total", 0))
+            exp.sample("kv_pages_used", labels, kv.get("pages_used", 0))
+            exp.sample("kv_page_utilization", labels,
+                       kv.get("utilization", 0.0))
+        pc = snap.get("prefix_cache")
+        if pc and pc.get("enabled"):
+            exp.sample("prefix_cache_hits_total", labels, pc.get("hits", 0))
+            exp.sample("prefix_cache_hit_tokens_total", labels,
+                       pc.get("hit_tokens", 0))
+        counters = snap.get("counters")
+        if counters:
+            exp.sample("engine_steps_total", labels,
+                       counters.get("num_steps", 0))
+            exp.sample("tokens_generated_total", labels,
+                       counters.get("tokens_generated", 0))
+            exp.sample("prefill_tokens_total", labels,
+                       counters.get("prefill_tokens", 0))
+        gauges = snap.get("gauges")
+        if gauges and not sched:
+            # engines without a scheduler snapshot still expose depth
+            exp.sample("scheduler_waiting", labels,
+                       gauges.get("num_waiting", 0))
+            exp.sample("scheduler_running", labels,
+                       gauges.get("num_running", 0))
+        for hist_name in ("ttft_ms", "tpot_ms", "itl_ms"):
+            h = snap.get(hist_name)
+            if h:
+                exp.histogram(hist_name, labels, h)
+        if snap.get("step_ms"):
+            exp.histogram("engine_step_ms", labels, snap["step_ms"])
+        diff = snap.get("diffusion")
+        if diff:
+            exp.sample("diffusion_requests_total", labels,
+                       diff.get("requests_total", 0))
+            exp.sample("diffusion_batches_total", labels,
+                       diff.get("batches_total", 0))
+            if diff.get("gen_seconds"):
+                exp.histogram("diffusion_gen_seconds", labels,
+                              diff["gen_seconds"])
+    if device and device.get("hbm_bytes"):
+        exp.sample("hbm_bytes", {}, device["hbm_bytes"])
+    return exp.render()
+
+
+def render_from_omni(omni, device: Optional[dict] = None) -> str:
+    """Render the exposition for a (sync) ``Omni`` orchestrator: the
+    aggregator summary plus every stage's engine snapshot (proc stages
+    report the last snapshot shipped over their command channel)."""
+    summary = omni.metrics.summary()
+    snaps = {}
+    for stage in getattr(omni, "stages", ()):
+        fn = getattr(stage, "engine_metrics_snapshot", None)
+        snaps[stage.stage_id] = fn() if fn is not None else {}
+    return render_exposition(summary, snaps, device=device)
+
+
+# ------------------------------------------------------------ validation
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _base_name(sample_name: str) -> str:
+    """Strip histogram sample suffixes back to the declared metric name."""
+    stripped = sample_name[len(METRIC_PREFIX):]
+    for suffix in _HIST_SUFFIXES:
+        if stripped.endswith(suffix):
+            base = stripped[: -len(suffix)]
+            if base in METRIC_SPECS and METRIC_SPECS[base][0] == "histogram":
+                return base
+    return stripped
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Check a rendered exposition against METRIC_SPECS; returns a list
+    of violations (empty = clean).  Rules: every sample name matches
+    ``vllm_omni_tpu_[a-z_]+`` (histogram ``_bucket/_sum/_count`` samples
+    validate against their base name), is declared in METRIC_SPECS, and
+    carries every label its spec requires (``stage`` where applicable)."""
+    errors = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        sample_name, _, labels_str, _ = m.groups()
+        if not sample_name.startswith(METRIC_PREFIX):
+            errors.append(
+                f"line {lineno}: {sample_name} lacks the "
+                f"{METRIC_PREFIX} prefix")
+            continue
+        base = _base_name(sample_name)
+        spec = METRIC_SPECS.get(base)
+        if spec is None:
+            errors.append(
+                f"line {lineno}: {sample_name} not declared in "
+                "METRIC_SPECS")
+            continue
+        if not NAME_RE.fullmatch(METRIC_PREFIX + base):
+            errors.append(
+                f"line {lineno}: {METRIC_PREFIX + base} violates the "
+                "naming rule vllm_omni_tpu_[a-z_]+")
+        labels = dict(_LABEL_RE.findall(labels_str or ""))
+        for required in spec[2]:
+            if required not in labels:
+                errors.append(
+                    f"line {lineno}: {sample_name} missing required "
+                    f"label {required!r}")
+    return errors
+
+
+def validate_specs() -> list[str]:
+    """Static check of the registry itself (names must be regex-clean
+    even before anything renders)."""
+    errors = []
+    for name, (mtype, help_text, labels) in METRIC_SPECS.items():
+        if not NAME_RE.fullmatch(METRIC_PREFIX + name):
+            errors.append(
+                f"{METRIC_PREFIX + name} violates vllm_omni_tpu_[a-z_]+")
+        if mtype not in ("counter", "gauge", "histogram"):
+            errors.append(f"{name}: unknown type {mtype!r}")
+        if not help_text:
+            errors.append(f"{name}: empty help text")
+    return errors

@@ -9,7 +9,9 @@ output wired in entrypoints/omni.py:692-697,759-791).
 
 from __future__ import annotations
 
+import bisect
 import json
+import threading
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
@@ -60,6 +62,127 @@ class RequestE2EStats:
     @property
     def e2e_ms(self) -> float:
         return max(0.0, (self.finish_ts - self.arrival_ts) * 1e3)
+
+
+# Prometheus-style latency buckets (ms).  Wide on purpose: one set serves
+# TTFT (tens of ms on-chip, seconds under load) and ITL (single-digit ms)
+# — per-metric tuning would make cross-deployment dashboards incomparable.
+LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+                      60000.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram with a recent-value window for percentiles.
+
+    Buckets follow Prometheus semantics (``snapshot()`` returns CUMULATIVE
+    counts per upper bound, plus sum/count) so the exposition layer
+    (metrics/prometheus.py) can render ``_bucket``/``_sum``/``_count``
+    series directly.  Percentiles come from a bounded recent window (the
+    same recency stance as OrchestratorAggregator — a lifetime of
+    latencies would both grow memory and bury regressions under history).
+
+    Thread-safe: the engine thread observes while the /metrics HTTP
+    thread snapshots.
+    """
+
+    def __init__(self, buckets=LATENCY_BUCKETS_MS, window: int = 4096):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._window: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``value`` ``n`` times (n>1 amortizes per-token metrics
+        a multi-step decode window emits in one host round trip)."""
+        if n <= 0:
+            return
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += n
+            self._sum += value * n
+            self._count += n
+            # the window weights repeated observations once per call —
+            # enough for percentile math without O(n) appends
+            self._window.append(value)
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            xs = list(self._window)
+        return nearest_rank_pct(xs, p)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            s, c = self._sum, self._count
+            xs = list(self._window)
+        cum = 0
+        cumulative = []
+        for le, n in zip(self.buckets + (float("inf"),), counts):
+            cum += n
+            cumulative.append([le, cum])
+        return {
+            "buckets": cumulative,
+            "sum": round(s, 3),
+            "count": c,
+            "p50": round(nearest_rank_pct(xs, 0.50), 3),
+            "p90": round(nearest_rank_pct(xs, 0.90), 3),
+            "p99": round(nearest_rank_pct(xs, 0.99), 3),
+        }
+
+
+class EngineStepMetrics:
+    """Step-level engine gauges/counters/histograms, sampled from
+    ``LLMEngine.step()`` (the vLLM-core Stats/StatLogger analogue):
+    scheduler depth gauges, token counters, and the request-latency
+    histograms the serving SLOs are written against — TTFT (arrival to
+    first output token), TPOT (per-output-token time over a finished
+    request, excluding the first token), ITL (inter-token latency
+    between consecutive host-visible emissions).
+    """
+
+    def __init__(self):
+        self.ttft_ms = Histogram()
+        self.tpot_ms = Histogram()
+        self.itl_ms = Histogram()
+        self.step_ms = Histogram()
+        # gauges (last sampled values)
+        self.num_waiting = 0
+        self.num_running = 0
+        # counters
+        self.num_steps = 0
+        self.tokens_generated = 0
+        self.prefill_tokens = 0
+
+    def on_schedule(self, waiting: int, running: int) -> None:
+        self.num_waiting = waiting
+        self.num_running = running
+
+    def on_step(self, step_ms: float, new_tokens: int,
+                prefill_tokens: int) -> None:
+        self.num_steps += 1
+        self.tokens_generated += new_tokens
+        self.prefill_tokens += prefill_tokens
+        self.step_ms.observe(step_ms)
+
+    def snapshot(self) -> dict:
+        return {
+            "gauges": {
+                "num_waiting": self.num_waiting,
+                "num_running": self.num_running,
+            },
+            "counters": {
+                "num_steps": self.num_steps,
+                "tokens_generated": self.tokens_generated,
+                "prefill_tokens": self.prefill_tokens,
+            },
+            "ttft_ms": self.ttft_ms.snapshot(),
+            "tpot_ms": self.tpot_ms.snapshot(),
+            "itl_ms": self.itl_ms.snapshot(),
+            "step_ms": self.step_ms.snapshot(),
+        }
 
 
 def nearest_rank_pct(xs: list, p: float) -> float:

@@ -345,6 +345,20 @@ def _auto_blocks(sq: int, skv: int, d: int,
             cand = (padded(sq, bq_c) * padded(skv, bk_c), -bq_c, -bk_c)
             if best is None or cand < best[0]:
                 best = (cand, bq_c, bk_c)
+    if best is None:
+        # cap below even the smallest candidate product (huge head dim /
+        # wide inputs shrink it past 256*256): fall back instead of
+        # crashing on best[1] (ADVICE round 5).  Start from the smallest
+        # candidate pair and keep halving the larger side until the
+        # score block honors the cap too (floor 8 — the minimum tile).
+        bq = min(256, max(8, sq))
+        bk = min(256, max(8, skv))
+        while bq * bk > cap and (bq > 8 or bk > 8):
+            if bq >= bk and bq > 8:
+                bq = max(8, bq // 2)
+            else:
+                bk = max(8, bk // 2)
+        return bq, bk
     return best[1], best[2]
 
 

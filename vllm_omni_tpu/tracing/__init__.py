@@ -1,0 +1,20 @@
+"""Per-request distributed tracing: span recorder + trace-event export.
+
+See ``trace.py`` for the design; ``docs/observability.md`` for usage.
+"""
+
+from vllm_omni_tpu.tracing.trace import (
+    TraceRecorder,
+    TraceWriter,
+    get_recorder,
+    new_trace_context,
+    to_chrome_trace,
+)
+
+__all__ = [
+    "TraceRecorder",
+    "TraceWriter",
+    "get_recorder",
+    "new_trace_context",
+    "to_chrome_trace",
+]

@@ -1,0 +1,186 @@
+"""Per-request distributed tracing across pipeline stages.
+
+The gap VERDICT.md:116 names: the repo had jax.profiler fan-out and
+aggregate stats jsonl but no request-trace propagation — once stages run
+in separate processes nobody can answer "where did request X spend its
+900 ms".  This module is the span layer underneath:
+
+- a ``trace context`` is a plain dict ``{"trace_id", "request_id"}``
+  created at ``Omni``/``AsyncOmni`` arrival.  Plain dicts (not a class)
+  so the context survives every transport the pipeline already has —
+  ``StageRequest.trace`` rides the stage_proc command sockets and the
+  connector edges through OmniSerializer unchanged.
+- each process owns one global ``TraceRecorder``; engines and stages
+  record finished spans into it (recording is a no-op for requests
+  without a context, so an untraced server pays one dict lookup).
+- cross-process stage workers drain their recorder into the ``outputs``
+  message (entrypoints/stage_proc.py); the orchestrator merges the
+  shipped spans, so one request's trace id carries spans from every
+  stage regardless of process placement.
+- ``TraceWriter`` streams spans as JSONL next to the ``*.stats.jsonl``
+  files and exports the whole trace as Chrome trace-event JSON
+  (Perfetto / chrome://tracing loadable).
+
+Span timestamps are wall-clock (``time.time``) so spans recorded in
+different processes land on one timeline; durations come from the
+caller's monotonic clock.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+
+def new_trace_context(request_id: str) -> dict:
+    """Fresh per-request trace context (created once, at arrival)."""
+    return {"trace_id": uuid.uuid4().hex, "request_id": request_id}
+
+
+class TraceRecorder:
+    """Process-global span sink.  Bounded: a recorder nobody drains (a
+    stage worker between output batches, a server without tracing
+    enabled) must not grow memory forever."""
+
+    def __init__(self, capacity: int = 65536):
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(
+        self,
+        ctx: Optional[dict],
+        name: str,
+        start_ts: float,
+        dur_s: float,
+        *,
+        stage_id: int = -1,
+        cat: str = "engine",
+        args: Optional[dict] = None,
+    ) -> None:
+        """Record one finished span.  ``ctx`` None means the request is
+        untraced — the call is a no-op (this is the enablement switch:
+        no trace context, no spans)."""
+        if not ctx:
+            return
+        span = {
+            "trace_id": ctx.get("trace_id", ""),
+            "request_id": ctx.get("request_id", ""),
+            "name": name,
+            "cat": cat,
+            "stage_id": stage_id,
+            "ts_us": start_ts * 1e6,
+            "dur_us": max(dur_s, 0.0) * 1e6,
+        }
+        if args:
+            span["args"] = args
+        with self._lock:
+            self._spans.append(span)
+
+    def extend(self, spans: list[dict]) -> None:
+        """Merge spans recorded by another process (shipped over the
+        stage worker's outputs message)."""
+        with self._lock:
+            self._spans.extend(spans)
+
+    def drain(self) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+            self._spans.clear()
+        return spans
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+_global_recorder = TraceRecorder()
+
+
+def get_recorder() -> TraceRecorder:
+    """The process-global recorder (one per process; stage workers own
+    their own and ship spans back over the command channel)."""
+    return _global_recorder
+
+
+# ------------------------------------------------------------- exporters
+def to_chrome_trace(spans: list[dict]) -> dict:
+    """Spans -> Chrome trace-event JSON (Perfetto loadable).
+
+    pid = stage_id + 1 (pid 0 is the orchestrator, whose spans carry
+    stage_id -1); tid = one lane per (pid, request_id) so concurrent
+    requests don't overlap in the track view.  Metadata events name the
+    processes/threads."""
+    events: list[dict] = []
+    tids: dict[tuple, int] = {}
+    pids: set[int] = set()
+    for s in spans:
+        pid = int(s.get("stage_id", -1)) + 1
+        pids.add(pid)
+        key = (pid, s.get("request_id", ""))
+        tid = tids.setdefault(key, len(tids) + 1)
+        args = {"trace_id": s.get("trace_id", ""),
+                "request_id": s.get("request_id", "")}
+        args.update(s.get("args") or {})
+        events.append({
+            "name": s.get("name", ""),
+            "cat": s.get("cat", ""),
+            "ph": "X",
+            "ts": s.get("ts_us", 0.0),
+            "dur": s.get("dur_us", 0.0),
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+    for pid in sorted(pids):
+        events.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": ("orchestrator" if pid == 0
+                              else f"stage_{pid - 1}")},
+        })
+    for (pid, rid), tid in tids.items():
+        events.append({
+            "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": rid or "-"},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+class TraceWriter:
+    """Sink for drained spans: streams ``{prefix}.trace.jsonl`` (one
+    span per line, append-only — same convention as the stats jsonl
+    files) and rewrites ``{prefix}.trace.json`` as a complete Chrome
+    trace on every ``export_chrome``.  The in-memory accumulation for the
+    Chrome export is bounded so a long-running server doesn't hold a
+    lifetime of spans (the JSONL keeps the full history)."""
+
+    def __init__(self, path_prefix: str, chrome_capacity: int = 200_000):
+        self._prefix = path_prefix
+        self._spans: deque = deque(maxlen=chrome_capacity)
+        self._lock = threading.Lock()
+
+    @property
+    def jsonl_path(self) -> str:
+        return f"{self._prefix}.trace.jsonl"
+
+    @property
+    def chrome_path(self) -> str:
+        return f"{self._prefix}.trace.json"
+
+    def write(self, spans: list[dict]) -> None:
+        if not spans:
+            return
+        with self._lock:
+            self._spans.extend(spans)
+            with open(self.jsonl_path, "a") as f:
+                for s in spans:
+                    f.write(json.dumps(s) + "\n")
+
+    def export_chrome(self) -> str:
+        with self._lock:
+            doc = to_chrome_trace(list(self._spans))
+        with open(self.chrome_path, "w") as f:
+            json.dump(doc, f)
+        return self.chrome_path
