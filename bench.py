@@ -492,6 +492,9 @@ def bench_ar() -> dict:
 
     _progress(f"ar: timed run ({n_reqs} reqs, prompt {prompt_len}, "
               f"gen {max_tokens})")
+    # omnilint: disable=OL4 - engine.step() syncs internally (sampled
+    # tokens are device_get'd every step), so wall-clock here measures
+    # real end-to-end serving latency, not enqueue
     t0 = time.perf_counter()
     first_token_ms: dict = {}
     for p in prompts:

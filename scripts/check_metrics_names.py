@@ -1,17 +1,14 @@
 #!/usr/bin/env python
 """Guard the Prometheus metric surface against silent drift.
 
-Asserts that every metric declared in ``metrics/prometheus.METRIC_SPECS``
-matches ``vllm_omni_tpu_[a-z_]+`` and that a rendered exposition (from a
-synthetic aggregator summary + engine snapshot covering every series)
-parses back clean — every sample declared, named correctly, and carrying
-the ``stage`` label where its spec requires one.
+Thin shim: the check now lives in omnilint as rule **OL6 metric-drift**
+(``vllm_omni_tpu/analysis/rules/metric_drift.py``) so the full gate
+(``scripts/omnilint.sh`` / ``python -m vllm_omni_tpu.analysis``) runs it
+alongside OL1-OL5.  This entry point stays for existing CI invocations
+and for ``tests/metrics/test_prometheus.py``, which load it by path.
 
 Run standalone (``python scripts/check_metrics_names.py``; exits nonzero
-on violation) or through the mirror pytest
-(``tests/metrics/test_prometheus.py``) which calls the same entry point.
-
-No jax import — safe for any CI lane.
+on violation).  No jax import — safe for any CI lane.
 """
 
 from __future__ import annotations
@@ -22,58 +19,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from vllm_omni_tpu.analysis.rules.metric_drift import (  # noqa: E402
+    run_check,
+    synthetic_engine_snapshot,
+    synthetic_summary,
+)
 
-def synthetic_summary() -> dict:
-    """An aggregator summary exercising every stage/edge series."""
-    return {
-        "stages": {
-            0: {"num_requests": 3, "tokens_in": 30, "tokens_out": 12,
-                "tps": 41.5},
-            1: {"num_requests": 3, "tokens_in": 12, "tokens_out": 12,
-                "tps": 9.0},
-        },
-        "edges": {"0->1": {"transfers": 3, "bytes": 4096, "ms": 1.25}},
-        "e2e": {"num_finished": 3, "window": 3, "p50_ms": 101.0,
-                "p90_ms": 250.0, "p99_ms": 251.0},
-    }
-
-
-def synthetic_engine_snapshot() -> dict:
-    """An engine snapshot exercising every engine series (LLM histograms
-    + scheduler/KV gauges + diffusion counters)."""
-    hist = {"buckets": [[10.0, 1], [100.0, 2], [float("inf"), 3]],
-            "sum": 123.0, "count": 3, "p50": 40.0, "p90": 100.0,
-            "p99": 110.0}
-    return {
-        "gauges": {"num_waiting": 1, "num_running": 2},
-        "counters": {"num_steps": 7, "tokens_generated": 12,
-                     "prefill_tokens": 30},
-        "ttft_ms": hist, "tpot_ms": hist, "itl_ms": hist,
-        "step_ms": hist,
-        "scheduler": {"waiting": 1, "running": 2, "preemptions": 1,
-                      "rejections": 0},
-        "kv": {"pages_total": 64, "pages_used": 8, "utilization": 0.125},
-        "prefix_cache": {"enabled": True, "hits": 2, "hit_tokens": 16},
-        "diffusion": {"requests_total": 3, "batches_total": 2,
-                      "gen_seconds": hist},
-    }
-
-
-def run_check() -> list[str]:
-    from vllm_omni_tpu.metrics.prometheus import (
-        render_exposition,
-        validate_exposition,
-        validate_specs,
-    )
-
-    errors = validate_specs()
-    text = render_exposition(
-        synthetic_summary(),
-        {0: synthetic_engine_snapshot(), 1: synthetic_engine_snapshot()},
-        device={"hbm_bytes": 16 * 2**30},
-    )
-    errors += validate_exposition(text)
-    return errors
+__all__ = ["run_check", "synthetic_engine_snapshot", "synthetic_summary",
+           "main"]
 
 
 def main() -> int:

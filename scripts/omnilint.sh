@@ -1,0 +1,15 @@
+#!/usr/bin/env sh
+# omnilint CI gate: exits non-zero on any NEW finding (beyond the
+# committed analysis/baseline.json and inline suppressions).
+#
+# The tier-1 pytest run exercises the same check through
+# tests/analysis/test_selflint.py; this wrapper is the standalone /
+# pre-commit face.  Deliberate contract changes regenerate the baseline:
+#
+#   python -m vllm_omni_tpu.analysis --update-baseline \
+#       vllm_omni_tpu bench.py scripts
+#
+# then commit the baseline.json diff for review like any code change.
+set -eu
+cd "$(dirname "$0")/.."
+exec python -m vllm_omni_tpu.analysis "$@" vllm_omni_tpu bench.py scripts

@@ -1,0 +1,159 @@
+"""Engine mechanics: suppressions, baseline workflow, OL0, CLI exits."""
+
+import json
+
+from vllm_omni_tpu.analysis import (
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    new_findings,
+    save_baseline,
+)
+from vllm_omni_tpu.analysis.__main__ import main
+from tests.analysis.util import lint, messages
+
+HOT = "vllm_omni_tpu/core/fixture.py"
+
+_BAD = '''
+import jax
+
+def step(arr):
+    return jax.device_get(arr)
+'''
+
+
+def test_syntax_error_is_ol0_finding():
+    found = analyze_source("def broken(:\n", "vllm_omni_tpu/core/x.py")
+    assert len(found) == 1 and found[0].rule == "OL0"
+
+
+def test_suppression_same_line_and_line_above():
+    same = _BAD.replace(
+        "return jax.device_get(arr)",
+        "return jax.device_get(arr)  # omnilint: disable=OL2")
+    above = _BAD.replace(
+        "    return jax.device_get(arr)",
+        "    # omnilint: disable=OL2 - reason\n"
+        "    return jax.device_get(arr)")
+    assert lint(same, path=HOT) == []
+    assert lint(above, path=HOT) == []
+
+
+def test_suppression_atop_comment_block_reaches_code_line():
+    src = _BAD.replace(
+        "    return jax.device_get(arr)",
+        "    # omnilint: disable=OL2\n"
+        "    # long explanation line one\n"
+        "    # long explanation line two\n"
+        "    return jax.device_get(arr)")
+    assert lint(src, path=HOT) == []
+
+
+def test_suppression_anywhere_in_multiline_statement():
+    src = '''
+import jax
+
+def step(a, b):
+    # omnilint: disable=OL2 - single batched sync
+    out = jax.device_get(
+        (a, b))
+    return out
+'''
+    assert lint(src, path=HOT) == []
+
+
+def test_file_wide_suppression_and_wrong_rule_id():
+    filewide = "# omnilint: disable-file=OL2\n" + _BAD
+    assert lint(filewide, path=HOT) == []
+    wrong = _BAD.replace(
+        "return jax.device_get(arr)",
+        "return jax.device_get(arr)  # omnilint: disable=OL1")
+    assert len(lint(wrong, path=HOT)) == 1
+
+
+def test_baseline_roundtrip_counts(tmp_path):
+    two = '''
+import jax
+
+def step(a, b):
+    x = jax.device_get(a)
+    y = jax.device_get(b)
+    return x, y
+'''
+    findings = analyze_source(two, HOT)
+    assert len(findings) == 2
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(findings, bl_path)
+    baseline = load_baseline(bl_path)
+    # same two findings: fully absorbed
+    marked = apply_baseline(analyze_source(two, HOT), baseline)
+    assert new_findings(marked) == []
+    # a THIRD identical sync in the same symbol exceeds the count
+    three = two.replace("return x, y",
+                        "z = jax.device_get(a)\n    return x, y, z")
+    marked = apply_baseline(analyze_source(three, HOT), baseline)
+    assert len(new_findings(marked)) == 1
+
+
+def test_missing_baseline_is_empty():
+    assert load_baseline("/nonexistent/baseline.json") == {}
+
+
+# ---------------------------------------------------------------- CLI
+def test_cli_clean_file_exits_zero(tmp_path, capsys):
+    f = tmp_path / "clean.py"
+    f.write_text("x = 1\n")
+    assert main([str(f)]) == 0
+
+
+def test_cli_new_violation_exits_nonzero(tmp_path, capsys):
+    # the acceptance check: drop a file with a known OL1 violation into
+    # the analyzed tree and the gate must go red
+    f = tmp_path / "vllm_omni_tpu_fixture.py"
+    f.write_text('''
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+''')
+    assert main([str(f)]) == 1
+    out = capsys.readouterr().out
+    assert "OL1" in out
+
+
+def test_cli_update_baseline_then_green(tmp_path, capsys):
+    f = tmp_path / "hot.py"
+    # path-scoped rules won't fire outside the manifest; use OL1 which
+    # is path-agnostic
+    f.write_text('''
+import jax
+
+@jax.jit
+def f(x):
+    return int(x)
+''')
+    bl = str(tmp_path / "bl.json")
+    assert main([str(f), "--baseline", bl]) == 1
+    assert main([str(f), "--baseline", bl, "--update-baseline"]) == 0
+    assert main([str(f), "--baseline", bl]) == 0
+    # audit mode ignores the baseline
+    assert main([str(f), "--baseline", bl, "--no-baseline"]) == 1
+
+
+def test_cli_json_format(tmp_path, capsys):
+    f = tmp_path / "bad.py"
+    f.write_text('''
+import jax
+
+@jax.jit
+def f(x):
+    return bool(x)
+''')
+    assert main([str(f), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["new"] == 1
+    assert payload["findings"][0]["rule"] == "OL1"
+    assert payload["findings"][0]["new"] is True

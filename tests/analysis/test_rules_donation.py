@@ -1,0 +1,172 @@
+"""OL3 donation-safety: reads of donated buffers."""
+
+from tests.analysis.util import lint, messages
+
+PATH = "vllm_omni_tpu/worker/fixture.py"
+
+_PREAMBLE = '''
+import functools
+import jax
+
+jit2 = functools.partial(jax.jit, donate_argnums=(1,))
+
+def _step(params, kv):
+    return kv, kv
+'''
+
+
+def test_rebind_from_result_is_clean():
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def step(self):
+        out, self.kv = self._fn(self.p, self.kv)
+        return out
+''', path=PATH, rule="OL3")
+    assert found == [], messages(found)
+
+
+def test_read_after_donation_flagged():
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def step(self):
+        out = self._fn(self.p, self.kv)
+        return self.kv[0]
+''', path=PATH, rule="OL3")
+    assert len(found) == 1, messages(found)
+    assert "'self.kv' is read after being donated" in found[0].message
+
+
+def test_unrebound_donation_in_loop_flagged():
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def run(self, xs):
+        kv = self.make()
+        for x in xs:
+            out = self._fn(self.p, kv)
+        return out
+''', path=PATH, rule="OL3")
+    assert len(found) == 1, messages(found)
+    assert "inside a loop without re-binding" in found[0].message
+
+
+def test_unrebound_attribute_donation_in_loop_flagged_as_stale():
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def run(self, xs):
+        for x in xs:
+            out = self._fn(self.p, self.kv)
+        return out
+''', path=PATH, rule="OL3")
+    assert len(found) == 1, messages(found)
+    assert "never re-bound" in found[0].message
+
+
+def test_fresh_buffer_per_iteration_is_clean():
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def run(self, xs):
+        for x in xs:
+            kv = self.make()
+            out = self._fn(self.p, kv)
+        return out
+''', path=PATH, rule="OL3")
+    assert found == [], messages(found)
+
+
+def test_decorator_donate_argnames_resolved():
+    found = lint('''
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def fwd(params, cache):
+    return cache
+
+def run(p, cache):
+    out = fwd(p, cache)
+    return cache.sum()
+''', path=PATH, rule="OL3")
+    assert len(found) == 1, messages(found)
+    assert "'cache'" in found[0].message
+
+
+def test_factory_def_returning_jit_tracked():
+    found = lint('''
+import jax
+
+def wrap(f):
+    return jax.jit(f, donate_argnums=(0,))
+
+def _fwd(kv):
+    return kv
+
+class R:
+    def __init__(self):
+        self._fn = wrap(_fwd)
+
+    def bad(self):
+        out = self._fn(self.kv)
+        return self.kv
+''', path=PATH, rule="OL3")
+    assert len(found) == 1, messages(found)
+
+
+def test_donated_local_never_read_again_is_clean():
+    # a LOCAL dies with the frame: consuming it without re-binding is
+    # the legitimate "last use" pattern
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def last_step(self, kv):
+        out, _ = self._fn(self.p, kv)
+        return out
+''', path=PATH, rule="OL3")
+    assert found == [], messages(found)
+
+
+def test_donated_attribute_without_rebind_flagged():
+    # an ATTRIBUTE outlives the function: even with no later read in
+    # this method, the stale handle escapes through the instance (the
+    # exact mutation that breaks `_, _, self.kv = fn(...)` rebinds)
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def last_step(self):
+        out, _ = self._fn(self.p, self.kv)
+        return out
+''', path=PATH, rule="OL3")
+    assert len(found) == 1, messages(found)
+    assert "never re-bound" in found[0].message
+
+
+def test_donated_attribute_rebound_by_later_statement_is_clean():
+    found = lint(_PREAMBLE + '''
+class R:
+    def __init__(self):
+        self._fn = jit2(_step)
+
+    def step(self):
+        out, fresh = self._fn(self.p, self.kv)
+        self.kv = fresh
+        return out
+''', path=PATH, rule="OL3")
+    assert found == [], messages(found)

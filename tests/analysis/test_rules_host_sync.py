@@ -1,0 +1,87 @@
+"""OL2 host-sync: device→host transfers in HOT_PATHS modules only."""
+
+from tests.analysis.util import lint, messages
+
+HOT = "vllm_omni_tpu/core/fixture.py"
+COLD = "vllm_omni_tpu/entrypoints/fixture.py"
+
+
+def test_item_and_device_get_flagged_in_hot_module():
+    src = '''
+import jax
+
+def step(arr):
+    n = arr.item()
+    toks = jax.device_get(arr)
+    return n, toks
+'''
+    found = lint(src, path=HOT, rule="OL2")
+    assert len(found) == 2, messages(found)
+    assert ".item()" in found[0].message
+    assert "jax.device_get" in found[1].message
+
+
+def test_cold_module_not_in_scope():
+    src = '''
+import jax
+
+def step(arr):
+    return jax.device_get(arr)
+'''
+    assert lint(src, path=COLD, rule="OL2") == []
+
+
+def test_np_coercion_of_jax_expr_flagged_host_data_not():
+    src = '''
+import numpy as np
+import jax.numpy as jnp
+
+def step(logits, ids):
+    a = np.asarray(jnp.argmax(logits, axis=-1))   # implicit transfer
+    b = np.asarray([1, 2, 3])                      # host data: fine
+    c = jnp.asarray(ids)                           # host->device: fine
+    return a, b, c
+'''
+    found = lint(src, path=HOT, rule="OL2")
+    assert len(found) == 1, messages(found)
+    assert "np.asarray" in found[0].message
+
+
+def test_scalar_cast_of_jax_expr_flagged():
+    src = '''
+import jax.numpy as jnp
+
+def norm(x):
+    return float(jnp.sum(x * x))
+'''
+    found = lint(src, path=HOT, rule="OL2")
+    assert len(found) == 1, messages(found)
+    assert "float()" in found[0].message
+
+
+def test_implicit_bool_of_array_flagged():
+    src = '''
+import jax.numpy as jnp
+
+def any_hit(x):
+    mask = jnp.equal(x, 0)
+    if mask:
+        return True
+    return False
+'''
+    found = lint(src, path=HOT, rule="OL2")
+    assert len(found) == 1, messages(found)
+    assert "implicit bool" in found[0].message
+
+
+def test_suppression_with_reason_accepted():
+    src = '''
+import jax
+
+def step(arr):
+    # omnilint: disable=OL2 - batch boundary: scheduler needs tokens
+    return jax.device_get(arr)
+'''
+    assert lint(src, path=HOT, rule="OL2") == []
+    withheld = lint(src, path=HOT, rule="OL2", include_suppressed=True)
+    assert len(withheld) == 1 and withheld[0].suppressed

@@ -1,0 +1,179 @@
+"""OL1 jit-hazard: traced-value control flow, static decls, jit-in-loop."""
+
+from tests.analysis.util import lint, messages
+
+
+def test_branch_on_traced_arg_flagged():
+    found = lint('''
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+''', rule="OL1")
+    assert len(found) == 1, messages(found)
+    assert "traced argument 'x'" in found[0].message
+    assert found[0].symbol == "f"
+
+
+def test_while_ternary_assert_flagged():
+    found = lint('''
+import jax
+
+@jax.jit
+def f(x, y, z):
+    while y > 0:
+        y = y - 1
+    a = 1 if z else 0
+    assert x >= 0
+    return a
+''', rule="OL1")
+    assert {m for f in found for m in (f.message.split("'")[1],)} \
+        == {"x", "y", "z"}, messages(found)
+
+
+def test_shape_len_isnone_not_flagged():
+    found = lint('''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x, embeds=None):
+    if x.shape[0] > 4:
+        pass
+    if len(x) > 2:
+        pass
+    if x.ndim == 3 or x.dtype == jnp.float32:
+        pass
+    if embeds is not None:
+        x = x + embeds
+    return jnp.sum(x)
+''', rule="OL1")
+    assert found == [], messages(found)
+
+
+def test_static_args_exempt_and_loop_iter_flagged():
+    found = lint('''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n, m):
+    for _ in range(n):      # n is static: fine
+        x = x * 2
+    for v in m:             # m is traced: unrolls/fails
+        x = x + v
+    return x
+''', rule="OL1")
+    assert len(found) == 1, messages(found)
+    assert "for-loop iterates traced argument 'm'" in found[0].message
+
+
+def test_value_casts_on_traced_flagged():
+    found = lint('''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def f(x, k):
+    r = range(k)            # static: fine
+    return int(x) + len(list(r))
+''', rule="OL1")
+    assert len(found) == 1, messages(found)
+    assert "'int()' on traced argument 'x'" in found[0].message
+
+
+def test_nested_def_params_shadow_traced_names():
+    found = lint('''
+import jax
+
+@jax.jit
+def f(x):
+    def body(x):            # shadows: body's x is its own operand
+        if x is None:
+            return 0
+        return x
+    return jax.lax.map(body, x)
+''', rule="OL1")
+    assert found == [], messages(found)
+
+
+def test_closed_over_traced_arg_in_nested_def_flagged():
+    found = lint('''
+import jax
+
+@jax.jit
+def f(x, lim):
+    def body(c, _):
+        if lim > 0:         # lim is still traced inside the closure
+            c = c + 1
+        return c, c
+    return jax.lax.scan(body, x, None, length=3)
+''', rule="OL1")
+    assert len(found) == 1, messages(found)
+    assert "'lim'" in found[0].message
+
+
+def test_bad_static_argnames_and_argnums_flagged():
+    found = lint('''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("nope",))
+def f(x):
+    return x
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def g(x, y):
+    return x + y
+''', rule="OL1")
+    assert len(found) == 2, messages(found)
+    assert "names parameter 'nope'" in found[0].message
+    assert "index 5 out of range" in found[1].message
+
+
+def test_jit_in_loop_flagged():
+    found = lint('''
+import jax
+
+def build(fns):
+    out = []
+    for fn in fns:
+        out.append(jax.jit(fn))
+    return out
+''', rule="OL1")
+    assert len(found) == 1, messages(found)
+    assert "inside a loop" in found[0].message
+
+
+def test_nonhashable_static_literal_at_call_site_flagged():
+    found = lint('''
+import jax
+
+def f(x, shapes):
+    return x
+
+g = jax.jit(f, static_argnames=("shapes",))
+
+def run(x):
+    return g(x, [1, 2, 3])
+''', rule="OL1")
+    assert len(found) == 1, messages(found)
+    assert "non-hashable list literal" in found[0].message
+
+
+def test_assignment_wrapped_fn_body_analyzed():
+    found = lint('''
+import jax
+
+def _decode(params, tok, budget):
+    if budget > 0:
+        return tok
+    return tok * 0
+
+decode_fn = jax.jit(_decode)
+''', rule="OL1")
+    assert len(found) == 1, messages(found)
+    assert "'budget'" in found[0].message

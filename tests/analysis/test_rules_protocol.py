@@ -1,0 +1,94 @@
+"""OL5 stage-protocol: sent frame types need handlers; span payloads
+must be re-stamped on the receiving side."""
+
+from tests.analysis.util import lint, messages
+
+PROTO = "vllm_omni_tpu/entrypoints/stage_proc.py"
+
+
+def test_sent_without_handler_flagged():
+    src = '''
+def worker(chan):
+    chan.send({"type": "ready"})
+    chan.send({"type": "farewell"})
+
+def reader(chan):
+    msg = chan.recv()
+    if msg.get("type") == "ready":
+        return True
+'''
+    found = lint(src, path=PROTO, rule="OL5")
+    assert len(found) == 1, messages(found)
+    assert "'farewell'" in found[0].message
+
+
+def test_handler_via_bound_type_name():
+    src = '''
+def worker(chan):
+    chan.send({"type": "submit"})
+    chan.send({"type": "abort"})
+
+def serve(inbox):
+    msg = inbox.get()
+    t = msg.get("type")
+    if t == "submit":
+        pass
+    elif t in ("abort", "shutdown"):
+        pass
+'''
+    assert lint(src, path=PROTO, rule="OL5") == []
+
+
+def test_match_case_counts_as_handler():
+    src = '''
+def worker(chan):
+    chan.send({"type": "outputs", "outputs": []})
+
+def serve(msg):
+    match msg.get("type"):
+        case "outputs":
+            return msg["outputs"]
+'''
+    assert lint(src, path=PROTO, rule="OL5") == []
+
+
+def test_spans_payload_must_be_read_back():
+    src = '''
+def worker(chan, outs, spans):
+    msg = {"type": "outputs", "outputs": outs}
+    msg["spans"] = spans
+    chan.send(msg)
+
+def reader(inbox):
+    msg = inbox.get()
+    if msg.get("type") == "outputs":
+        return msg["outputs"]   # spans dropped!
+'''
+    found = lint(src, path=PROTO, rule="OL5")
+    assert len(found) == 1, messages(found)
+    assert "'spans'" in found[0].message and "re-stamp" in found[0].message
+
+
+def test_spans_read_back_is_clean():
+    src = '''
+def worker(chan, outs, spans):
+    chan.send({"type": "outputs", "outputs": outs, "spans": spans})
+
+def reader(inbox, recorder):
+    msg = inbox.get()
+    if msg.get("type") == "outputs":
+        spans = msg.get("spans")
+        if spans:
+            recorder.extend(spans)
+        return msg["outputs"]
+'''
+    assert lint(src, path=PROTO, rule="OL5") == []
+
+
+def test_out_of_scope_module_not_checked():
+    src = '''
+def f(chan):
+    chan.send({"type": "mystery"})
+'''
+    assert lint(src, path="vllm_omni_tpu/distributed/fixture.py",
+                rule="OL5") == []

@@ -1,0 +1,41 @@
+"""Whole-package self-lint: the repo must be clean against its own
+committed baseline — the tier-1 face of the omnilint gate (the same
+check `scripts/omnilint.sh` runs in CI).
+
+If this test fails you either introduced a real OL1-OL6 violation
+(fix it or add a reasoned `# omnilint: disable=OLx - why`), or you
+deliberately changed a contract (regenerate the baseline with
+`python -m vllm_omni_tpu.analysis --update-baseline vllm_omni_tpu
+bench.py scripts` and commit the diff).
+"""
+
+import os
+
+from vllm_omni_tpu.analysis import (
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    new_findings,
+)
+from vllm_omni_tpu.analysis.engine import REPO_ROOT
+
+LINT_TARGETS = ["vllm_omni_tpu", "bench.py", "scripts"]
+
+
+def test_package_is_clean_against_committed_baseline():
+    paths = [os.path.join(REPO_ROOT, p) for p in LINT_TARGETS]
+    findings = apply_baseline(analyze_paths(paths), load_baseline())
+    new = new_findings(findings)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_baseline_entries_still_match_real_findings():
+    # a baseline fingerprint nothing produces anymore is stale debt that
+    # silently widens the gate — force the regeneration commit
+    paths = [os.path.join(REPO_ROOT, p) for p in LINT_TARGETS]
+    produced = {}
+    for f in analyze_paths(paths):
+        if not f.suppressed:
+            produced[f.fingerprint] = produced.get(f.fingerprint, 0) + 1
+    for fp, count in load_baseline().items():
+        assert produced.get(fp, 0) >= count, f"stale baseline entry: {fp}"

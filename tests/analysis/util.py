@@ -1,0 +1,24 @@
+"""Shared helper: run omnilint over an inline fixture snippet.
+
+Fixtures claim a repo-relative ``path`` because several rules scope by
+manifest (OL2 hot paths, OL4 bench paths, OL5 protocol modules, OL6
+metric modules) — the engine never touches the filesystem for these.
+"""
+
+from vllm_omni_tpu.analysis import analyze_source
+
+
+def lint(src: str, path: str = "vllm_omni_tpu/ops/fixture.py",
+         rule: str = None, include_suppressed: bool = False):
+    """Findings for ``src`` as if it lived at ``path``; optionally
+    filtered to one rule id."""
+    found = analyze_source(src, path)
+    if not include_suppressed:
+        found = [f for f in found if not f.suppressed]
+    if rule is not None:
+        found = [f for f in found if f.rule == rule]
+    return found
+
+
+def messages(findings) -> str:
+    return "\n".join(f.render() for f in findings)

@@ -1,0 +1,46 @@
+"""omnilint — JAX/TPU-aware static analysis for vllm-omni-tpu.
+
+A stock linter sees valid Python; this package checks the contracts the
+serving stack actually hangs on: jit staging rules (OL1), hot-path
+host↔device syncs (OL2), buffer donation (OL3), async-dispatch-safe
+benchmarking (OL4), the cross-process stage frame protocol (OL5), and
+Prometheus metric-surface drift (OL6).
+
+CLI::
+
+    python -m vllm_omni_tpu.analysis [--format text|json]
+        [--update-baseline] [--no-baseline] [paths...]
+
+Library::
+
+    from vllm_omni_tpu.analysis import analyze_paths, new_findings
+
+See docs/static_analysis.md for the rule catalogue, the suppression
+syntax (``# omnilint: disable=OL2 - reason``), and the baseline
+workflow.  No jax import anywhere in this package — safe for any CI
+lane.
+"""
+
+from vllm_omni_tpu.analysis.engine import (
+    DEFAULT_BASELINE,
+    Finding,
+    Rule,
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    new_findings,
+    save_baseline,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Rule",
+    "analyze_paths",
+    "analyze_source",
+    "apply_baseline",
+    "load_baseline",
+    "new_findings",
+    "save_baseline",
+]

@@ -1,0 +1,84 @@
+"""omnilint CLI: ``python -m vllm_omni_tpu.analysis [opts] paths...``
+
+Exit codes: 0 = clean against the committed baseline, 1 = NEW findings
+(or OL0 parse failures), 2 = usage error.  ``--update-baseline`` is the
+escape hatch for deliberate changes: it rewrites
+``analysis/baseline.json`` from the current findings and exits 0 —
+review the diff it produces like any other code change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from vllm_omni_tpu.analysis.engine import (
+    DEFAULT_BASELINE,
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+    new_findings,
+    save_baseline,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m vllm_omni_tpu.analysis",
+        description="omnilint: JAX/TPU-aware static analysis "
+                    "(rules OL1-OL6; see docs/static_analysis.md)")
+    parser.add_argument("paths", nargs="*", default=["vllm_omni_tpu"],
+                        help="files/directories to analyze "
+                             "(default: vllm_omni_tpu)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline file (default: the committed "
+                             "analysis/baseline.json)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "and exit 0")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding as new (audit mode)")
+    parser.add_argument("--show-all", action="store_true",
+                        help="also print suppressed/baselined findings")
+    args = parser.parse_args(argv)
+
+    findings = analyze_paths(args.paths)
+    if args.update_baseline:
+        counts = save_baseline(findings, args.baseline)
+        print(f"baseline updated: {sum(counts.values())} finding(s) "
+              f"across {len(counts)} fingerprint(s) -> {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    findings = apply_baseline(findings, baseline)
+    new = new_findings(findings)
+
+    if args.format == "json":
+        payload = [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "symbol": f.symbol, "message": f.message,
+             "suppressed": f.suppressed, "baselined": f.baselined,
+             "new": not (f.suppressed or f.baselined)}
+            for f in findings
+            if args.show_all or not (f.suppressed or f.baselined)
+        ]
+        json.dump({"findings": payload, "new": len(new)},
+                  sys.stdout, indent=1)
+        print()
+    else:
+        shown = findings if args.show_all else new
+        for f in shown:
+            print(f.render())
+        n_supp = sum(f.suppressed for f in findings)
+        n_base = sum(f.baselined for f in findings)
+        print(f"omnilint: {len(new)} new finding(s) "
+              f"({n_base} baselined, {n_supp} suppressed)",
+              file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

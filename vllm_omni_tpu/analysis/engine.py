@@ -1,0 +1,318 @@
+"""omnilint engine: one AST walk per file, dispatching to rule visitors.
+
+The analysis layer is the JAX/TPU-aware counterpart of a stock linter:
+stock tools see valid Python where this codebase sees staged-out traces,
+donated buffers, host↔device sync points, and cross-process frame
+protocols.  Each rule family (``rules/``) encodes one of those invisible
+contracts; the engine owns everything rule-agnostic:
+
+- parsing each file ONCE and walking its AST once, dispatching nodes to
+  every applicable rule's ``visit`` (rules declare ``node_types``);
+  rules that need whole-file aggregation emit from ``finish``
+- suppression comments (same line or the line above a finding)::
+
+      x = foo()  # omnilint: disable=OL2
+      # omnilint: disable=OL1,OL3   (suppresses the next line)
+      # omnilint: disable-file=OL4  (anywhere: suppresses the whole file)
+
+- the committed baseline (``analysis/baseline.json``): pre-existing
+  findings fingerprinted by (rule, path, symbol, message) — NOT line
+  numbers, so unrelated edits don't invalidate it — with per-fingerprint
+  counts.  The gate fails only on findings *beyond* the baselined count.
+
+No jax import anywhere in this package: the CLI must run in any lane
+(the same stance as scripts/check_metrics_names.py, which rule OL6
+absorbed).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field, replace
+from typing import Iterable, Optional
+
+# repo root == parent of the vllm_omni_tpu package dir; fingerprints use
+# paths relative to it so the baseline is stable across checkouts/cwd
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*omnilint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.  ``fingerprint`` deliberately omits the line
+    number: the baseline must survive unrelated edits above a finding."""
+
+    rule: str      # "OL1".."OL6" ("OL0" = file failed to parse)
+    path: str      # repo-relative posix path
+    line: int
+    message: str
+    symbol: str = ""          # enclosing def/class qualname, "" = module
+    suppressed: bool = False  # matched a disable comment
+    baselined: bool = False   # absorbed by the committed baseline
+    # line span of the enclosing statement: a suppression anywhere in it
+    # applies (multi-line calls anchor findings on continuation lines)
+    stmt_span: tuple = ()
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+
+    def render(self) -> str:
+        tag = (" [suppressed]" if self.suppressed
+               else " [baselined]" if self.baselined else "")
+        sym = f" ({self.symbol})" if self.symbol else ""
+        return (f"{self.path}:{self.line}: {self.rule}{tag} "
+                f"{self.message}{sym}")
+
+
+class FileContext:
+    """Everything rules need about one file: source, tree, parent links,
+    and qualname resolution — built once, shared by every rule."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    # ------------------------------------------------------------ lineage
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt:
+        cur = node
+        while not isinstance(cur, ast.stmt):
+            cur = self.parents[cur]
+        return cur
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted def/class chain enclosing ``node`` ("" at module level)."""
+        parts = []
+        scopes = [node] if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ) else []
+        scopes += [a for a in self.ancestors(node) if isinstance(
+            a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))]
+        for scope in scopes:
+            parts.append(scope.name)
+        return ".".join(reversed(parts))
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        span = (line, line)
+        try:
+            stmt = self.enclosing_statement(node)
+            span = (stmt.lineno, stmt.end_lineno or stmt.lineno)
+        except KeyError:
+            pass  # synthetic/module-level anchor
+        return Finding(rule=rule, path=self.path, line=line,
+                       message=message, symbol=self.qualname(node),
+                       stmt_span=span)
+
+
+class Rule:
+    """Base rule: subclasses declare ``node_types`` and yield Findings
+    from ``visit`` (per matching node, one engine walk) and/or
+    ``finish`` (after the walk — whole-file aggregates).  A fresh
+    instance runs per file, so instance state is per-file state."""
+
+    id: str = ""
+    name: str = ""
+    node_types: tuple = ()
+
+    def applies(self, ctx: FileContext) -> bool:
+        return True
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+
+# --------------------------------------------------------------- suppression
+def _suppressions(ctx: FileContext):
+    """(file-wide rule set, {line -> rule set}).  Rule ids are
+    upper-cased; ``all`` suppresses every rule."""
+    file_wide: set[str] = set()
+    by_line: dict[int, set[str]] = {}
+    for i, line in enumerate(ctx.lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip().upper() for r in m.group("rules").split(",")}
+        if m.group("file"):
+            file_wide |= rules
+        else:
+            by_line.setdefault(i, set()).update(rules)
+            # a comment-only line suppresses the next CODE line (the
+            # disable may sit atop a multi-line explanation block)
+            if line.strip().startswith("#"):
+                j = i + 1
+                while j <= len(ctx.lines) \
+                        and ctx.lines[j - 1].strip().startswith("#"):
+                    j += 1
+                by_line.setdefault(j, set()).update(rules)
+    return file_wide, by_line
+
+
+def _apply_suppressions(findings: list[Finding],
+                        ctx: FileContext) -> list[Finding]:
+    file_wide, by_line = _suppressions(ctx)
+    if not file_wide and not by_line:
+        return findings
+    out = []
+    for f in findings:
+        active = file_wide | by_line.get(f.line, set())
+        lo, hi = f.stmt_span if f.stmt_span else (f.line, f.line)
+        for ln in range(lo, hi + 1):
+            active |= by_line.get(ln, set())
+        if f.rule in active or "ALL" in active:
+            f = replace(f, suppressed=True)
+        out.append(f)
+    return out
+
+
+# ------------------------------------------------------------------ analysis
+def canonical_path(path: str) -> str:
+    """Repo-relative posix path when under the repo, else as given."""
+    ap = os.path.abspath(path)
+    if ap.startswith(REPO_ROOT + os.sep):
+        ap = os.path.relpath(ap, REPO_ROOT)
+    return ap.replace(os.sep, "/")
+
+
+def default_rules() -> list[type]:
+    from vllm_omni_tpu.analysis.rules import ALL_RULES
+
+    return list(ALL_RULES)
+
+
+def analyze_source(source: str, path: str,
+                   rules: Optional[list[type]] = None) -> list[Finding]:
+    """Run the rule set over one in-memory source blob.  ``path`` is the
+    repo-relative path the file *claims* to be at — rules scope by it
+    (HOT_PATHS, protocol modules), which is what lets tests feed tiny
+    fixture snippets through the real engine."""
+    path = path.replace(os.sep, "/")
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="OL0", path=path, line=e.lineno or 1,
+                        message=f"file does not parse: {e.msg}")]
+    ctx = FileContext(path, source, tree)
+    active = []
+    for rule_cls in (rules if rules is not None else default_rules()):
+        rule = rule_cls()
+        if rule.applies(ctx):
+            active.append(rule)
+    findings: list[Finding] = []
+    if active:
+        # THE walk: one traversal, every rule sees its node types
+        for node in ast.walk(tree):
+            for rule in active:
+                if isinstance(node, rule.node_types):
+                    findings.extend(rule.visit(node, ctx))
+        for rule in active:
+            findings.extend(rule.finish(ctx))
+    findings.sort(key=lambda f: (f.line, f.rule, f.message))
+    return _apply_suppressions(findings, ctx)
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[list[type]] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for fp in iter_python_files(paths):
+        with open(fp, encoding="utf-8") as fh:
+            source = fh.read()
+        findings.extend(analyze_source(source, canonical_path(fp), rules))
+    return findings
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path: str = DEFAULT_BASELINE) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(findings: Iterable[Finding],
+                  path: str = DEFAULT_BASELINE) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for f in findings:
+        if not f.suppressed:
+            counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    payload = {
+        "comment": ("omnilint baseline: pre-existing findings the gate "
+                    "tolerates. Regenerate with `python -m "
+                    "vllm_omni_tpu.analysis --update-baseline <paths>` "
+                    "after deliberate changes; new code must come in "
+                    "clean or carry an explicit suppression."),
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return counts
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[str, int]) -> list[Finding]:
+    """Mark the first ``baseline[fingerprint]`` unsuppressed occurrences
+    of each fingerprint as baselined; anything beyond the count is NEW
+    and stays unmarked (the gate fails on it)."""
+    remaining = dict(baseline)
+    out = []
+    for f in findings:
+        if not f.suppressed and remaining.get(f.fingerprint, 0) > 0:
+            remaining[f.fingerprint] -= 1
+            f = replace(f, baselined=True)
+        out.append(f)
+    return out
+
+
+def new_findings(findings: Iterable[Finding]) -> list[Finding]:
+    return [f for f in findings if not f.suppressed and not f.baselined]

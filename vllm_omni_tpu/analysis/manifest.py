@@ -1,0 +1,56 @@
+"""Path manifests scoping the TPU-specific rules.
+
+HOT_PATHS: modules on the serving hot path, where an accidental
+host↔device sync (rule OL2) stalls every in-flight request — the
+scheduler/runner/engine step loop and the kernels under it.  Cold
+surfaces (entrypoints, config, model loaders) legitimately sync and are
+not listed.
+
+PROTOCOL_MODULES: files implementing a cross-process frame protocol
+(rule OL5 checks every sent frame type has a receiver handler and that
+span payloads are re-stamped on the other side).
+
+BENCH_PATHS: measurement code where wall-clock timing without
+``block_until_ready`` measures dispatch (enqueue) instead of execution
+(rule OL4).
+
+METRIC_MODULES: the Prometheus registry files rule OL6 (the absorbed
+scripts/check_metrics_names.py drift guard) validates.
+"""
+
+from __future__ import annotations
+
+HOT_PATHS: tuple[str, ...] = (
+    "vllm_omni_tpu/core/",
+    "vllm_omni_tpu/ops/",
+    "vllm_omni_tpu/sample/",
+    "vllm_omni_tpu/worker/",
+    "vllm_omni_tpu/engine/",
+)
+
+PROTOCOL_MODULES: tuple[str, ...] = (
+    "vllm_omni_tpu/entrypoints/stage_proc.py",
+)
+
+BENCH_PATHS: tuple[str, ...] = (
+    "bench.py",
+    "vllm_omni_tpu/benchmarks/",
+    "vllm_omni_tpu/metrics/",
+    "tests/benchmarks/",
+)
+
+METRIC_MODULES: tuple[str, ...] = (
+    "vllm_omni_tpu/metrics/prometheus.py",
+)
+
+
+def in_scope(path: str, prefixes: tuple[str, ...]) -> bool:
+    """True when repo-relative ``path`` matches a manifest entry (a
+    directory prefix ending in "/", an exact file, or a bare filename)."""
+    for p in prefixes:
+        if p.endswith("/"):
+            if path.startswith(p):
+                return True
+        elif path == p or path.endswith("/" + p):
+            return True
+    return False

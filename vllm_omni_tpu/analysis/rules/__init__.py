@@ -1,0 +1,39 @@
+"""omnilint rule registry — one module per rule family.
+
+| id  | name               | contract it guards                         |
+|-----|--------------------|--------------------------------------------|
+| OL1 | jit-hazard         | jax.jit staging rules (traced branching,   |
+|     |                    | static decls, jit-in-loop re-wrapping)     |
+| OL2 | host-sync          | no device→host syncs in HOT_PATHS modules  |
+| OL3 | donation-safety    | no reads of donated buffers                |
+| OL4 | wall-clock-in-trace| bench timing syncs before the 2nd stamp    |
+| OL5 | stage-protocol     | every sent frame type has a handler; span  |
+|     |                    | payloads are re-stamped cross-process      |
+| OL6 | metric-drift       | Prometheus surface matches METRIC_SPECS    |
+"""
+
+from vllm_omni_tpu.analysis.rules.donation import DonationRule
+from vllm_omni_tpu.analysis.rules.host_sync import HostSyncRule
+from vllm_omni_tpu.analysis.rules.jit_hazard import JitHazardRule
+from vllm_omni_tpu.analysis.rules.metric_drift import MetricDriftRule
+from vllm_omni_tpu.analysis.rules.stage_protocol import StageProtocolRule
+from vllm_omni_tpu.analysis.rules.wallclock import WallClockRule
+
+ALL_RULES: tuple[type, ...] = (
+    JitHazardRule,
+    HostSyncRule,
+    DonationRule,
+    WallClockRule,
+    StageProtocolRule,
+    MetricDriftRule,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "JitHazardRule",
+    "HostSyncRule",
+    "DonationRule",
+    "WallClockRule",
+    "StageProtocolRule",
+    "MetricDriftRule",
+]

@@ -1,0 +1,246 @@
+"""Shared jit-wrapper introspection for the OL1/OL3 rule families.
+
+Recognizes the wrapping idioms this codebase actually uses (see
+worker/model_runner.py) without importing jax:
+
+- ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorators
+- ``name = jax.jit(fn, ...)`` assignments
+- ``jit2 = functools.partial(jax.jit, donate_argnums=(2,))`` factories,
+  later applied as ``self._fn = jit2(fn)``
+- factory *functions* whose return value is a jit wrap
+  (``def wrap(f): ... return jax.jit(sm, donate_argnums=(2,))``),
+  later applied as ``self._fn = wrap(fn, ...)``
+- plain aliasing of an already-known jitted name
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+JIT_CALLABLES = ("jax.jit", "jit", "pjit", "jax.pjit")
+PARTIAL_CALLABLES = ("functools.partial", "partial")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """"a.b.c" for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _literal_ints(node: ast.AST) -> Optional[tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            vals.append(e.value)
+        return tuple(vals)
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return tuple(vals)
+    return None
+
+
+@dataclass
+class JitWrap:
+    """Static/donate argument declarations extracted from one jit wrap."""
+
+    node: ast.AST
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+    donate_argnames: tuple[str, ...] = ()
+
+    def merged(self, other: "JitWrap") -> "JitWrap":
+        """Factory kwargs + application kwargs (partial semantics)."""
+        return JitWrap(
+            node=other.node,
+            static_argnums=self.static_argnums + other.static_argnums,
+            static_argnames=self.static_argnames + other.static_argnames,
+            donate_argnums=self.donate_argnums + other.donate_argnums,
+            donate_argnames=self.donate_argnames + other.donate_argnames,
+        )
+
+
+def _wrap_from_keywords(call: ast.Call) -> JitWrap:
+    wrap = JitWrap(node=call)
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            wrap.static_argnums = _literal_ints(kw.value) or ()
+        elif kw.arg == "static_argnames":
+            wrap.static_argnames = _literal_strs(kw.value) or ()
+        elif kw.arg == "donate_argnums":
+            wrap.donate_argnums = _literal_ints(kw.value) or ()
+        elif kw.arg == "donate_argnames":
+            wrap.donate_argnames = _literal_strs(kw.value) or ()
+    return wrap
+
+
+def jit_call_info(call: ast.Call) -> Optional[JitWrap]:
+    """JitWrap if ``call`` is ``jax.jit(...)`` or
+    ``functools.partial(jax.jit, ...)``, else None."""
+    fn = dotted(call.func)
+    if fn in JIT_CALLABLES:
+        return _wrap_from_keywords(call)
+    if fn in PARTIAL_CALLABLES and call.args \
+            and dotted(call.args[0]) in JIT_CALLABLES:
+        return _wrap_from_keywords(call)
+    return None
+
+
+def decorator_jit_info(node: ast.AST) -> Optional[JitWrap]:
+    """JitWrap if a def's decorator expression is a jit wrap."""
+    if dotted(node) in JIT_CALLABLES:
+        return JitWrap(node=node)
+    if isinstance(node, ast.Call):
+        return jit_call_info(node)
+    return None
+
+
+@dataclass
+class ModuleJitIndex:
+    """Module-wide map of jit wrappers, built in one prepass.
+
+    - ``jitted``: callable dotted-name -> (JitWrap, wrapped FunctionDef
+      or None) for every name known to be a jitted function
+    - ``defs``: function name -> FunctionDef (last definition wins)
+    """
+
+    jitted: dict[str, tuple[JitWrap, Optional[ast.FunctionDef]]] = field(
+        default_factory=dict)
+    defs: dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+
+def _assign_target_names(stmt: ast.Assign) -> list[str]:
+    names = []
+    for t in stmt.targets:
+        d = dotted(t)
+        if d:
+            names.append(d)
+    return names
+
+
+def build_index(tree: ast.Module) -> ModuleJitIndex:
+    idx = ModuleJitIndex()
+    factories: dict[str, JitWrap] = {}        # partial(jax.jit, ...) names
+    factory_defs: dict[str, JitWrap] = {}     # defs returning a jit wrap
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            idx.defs[node.name] = node
+            wrap = None
+            for dec in node.decorator_list:
+                wrap = decorator_jit_info(dec)
+                if wrap is not None:
+                    break
+            if wrap is not None:
+                idx.jitted[node.name] = (wrap, node)
+            else:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) \
+                            and isinstance(sub.value, ast.Call):
+                        w = jit_call_info(sub.value)
+                        if w is not None and (w.donate_argnums
+                                              or w.donate_argnames
+                                              or w.static_argnums
+                                              or w.static_argnames):
+                            factory_defs[node.name] = w
+                            break
+
+    # assignment pass (separate loop: factories/defs must be complete —
+    # ast.walk order does not follow execution order)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        targets = _assign_target_names(node)
+        if not targets:
+            continue
+        callee = dotted(call.func)
+        wrap = jit_call_info(call)
+        if wrap is not None:
+            if call.args and dotted(call.args[0]) in JIT_CALLABLES:
+                # name = functools.partial(jax.jit, ...) -> a factory
+                for t in targets:
+                    factories[t] = wrap
+            else:
+                # name = jax.jit(fn, ...)
+                inner = (idx.defs.get(dotted(call.args[0]) or "")
+                         if call.args else None)
+                for t in targets:
+                    idx.jitted[t] = (wrap, inner)
+        elif callee in factories:
+            # name = jit2(fn) -> jitted with the factory's kwargs
+            base = factories[callee]
+            applied = base.merged(_wrap_from_keywords(call))
+            inner = (idx.defs.get(dotted(call.args[0]) or "")
+                     if call.args else None)
+            for t in targets:
+                idx.jitted[t] = (applied, inner)
+        elif callee in factory_defs:
+            # name = wrap(fn, ...) -> jitted with the factory def's kwargs
+            inner = (idx.defs.get(dotted(call.args[0]) or "")
+                     if call.args else None)
+            for t in targets:
+                idx.jitted[t] = (factory_defs[callee], inner)
+
+    # plain aliasing: name = known_jitted_name
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and dotted(node.value) in idx.jitted:
+            src = idx.jitted[dotted(node.value)]
+            for t in _assign_target_names(node):
+                idx.jitted.setdefault(t, src)
+    return idx
+
+
+def param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+def donate_positions(wrap: JitWrap,
+                     fn: Optional[ast.FunctionDef]) -> tuple[int, ...]:
+    """Donated positional indices; argnames resolve through the wrapped
+    def's signature when it is syntactically visible."""
+    pos = list(wrap.donate_argnums)
+    if wrap.donate_argnames and fn is not None:
+        names = param_names(fn)
+        pos += [names.index(n) for n in wrap.donate_argnames if n in names]
+    return tuple(sorted(set(pos)))
+
+
+def static_names(wrap: JitWrap,
+                 fn: Optional[ast.FunctionDef]) -> set[str]:
+    """Parameter names declared static (argnums resolved through the
+    signature when visible)."""
+    names = set(wrap.static_argnames)
+    if fn is not None:
+        params = param_names(fn)
+        for i in wrap.static_argnums:
+            if 0 <= i < len(params):
+                names.add(params[i])
+    return names

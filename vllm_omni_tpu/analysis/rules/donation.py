@@ -1,0 +1,169 @@
+"""OL3 — donation-safety: reading a buffer after donating it.
+
+``donate_argnums``/``donate_argnames`` hands the argument's buffer to
+XLA for in-place reuse — the caller's reference is INVALIDATED the
+moment the call dispatches.  Reading it afterwards raises
+``RuntimeError: Array has been deleted`` on TPU, but silently *works*
+on the CPU backend the tests run on, which is exactly why a linter has
+to catch it.  The safe idiom this repo uses everywhere is
+re-binding the donated expression from the call's result::
+
+    logits, hidden, self.kv_caches = self._decode_fn(
+        ..., self.kv_caches, ...)       # donated slot 2, rebound: OK
+
+The rule resolves the module's jit wrappers through the shared index
+(including ``functools.partial(jax.jit, donate_argnums=...)`` factories
+and wrapper-returning helper defs), then checks every call site of a
+donating callable:
+
+- the donated argument must be re-bound by the same statement, OR
+- never read again in the enclosing function after the call
+  (first later reference being a store also counts as safe)
+- inside a loop, a donated name that the statement does not re-bind is
+  flagged even when the only other read is textually *before* the call
+  (it re-executes on the next iteration against a dead buffer)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.rules._jitinfo import (
+    ModuleJitIndex,
+    build_index,
+    donate_positions,
+    dotted,
+    param_names,
+)
+
+
+def _is_store(node: ast.AST) -> bool:
+    return isinstance(getattr(node, "ctx", None), (ast.Store, ast.Del))
+
+
+def _refs_in(root: ast.AST, key: str):
+    """(position, is_store, node) for every reference to dotted ``key``
+    inside ``root`` — outermost match only (a.b.c doesn't also count as
+    a.b)."""
+    claimed: set[int] = set()
+    refs = []
+    for node in ast.walk(root):
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        if id(node) in claimed:
+            continue
+        if dotted(node) == key:
+            for sub in ast.walk(node):
+                claimed.add(id(sub))
+            refs.append(((node.lineno, node.col_offset),
+                         _is_store(node), node))
+    refs.sort(key=lambda r: r[0])
+    return refs
+
+
+def _stmt_rebinds(stmt: ast.stmt, key: str) -> bool:
+    """Does this statement bind ``key`` as (part of) an assignment
+    target?"""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, (ast.Name, ast.Attribute)) \
+                    and dotted(sub) == key:
+                return True
+    return False
+
+
+class DonationRule(Rule):
+    id = "OL3"
+    name = "donation-safety"
+    node_types = (ast.Call,)
+
+    def __init__(self):
+        self._index: Optional[ModuleJitIndex] = None
+        self._calls: list[ast.Call] = []
+
+    def visit(self, node: ast.Call, ctx: FileContext) -> Iterable[Finding]:
+        self._calls.append(node)
+        return ()
+
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        idx = self._index = build_index(ctx.tree)
+        for call in self._calls:
+            name = dotted(call.func)
+            entry = idx.jitted.get(name or "")
+            if entry is None:
+                continue
+            wrap, fn = entry
+            positions = donate_positions(wrap, fn)
+            if not positions:
+                continue
+            donated: list[tuple[str, ast.AST]] = []
+            for pos in positions:
+                if pos < len(call.args):
+                    key = dotted(call.args[pos])
+                    if key:
+                        donated.append((key, call.args[pos]))
+            if fn is not None:
+                names = param_names(fn)
+                for kw in call.keywords:
+                    if kw.arg in wrap.donate_argnames or (
+                            kw.arg in names
+                            and names.index(kw.arg) in positions):
+                        key = dotted(kw.value)
+                        if key:
+                            donated.append((key, kw.value))
+            for key, anchor in donated:
+                yield from self._check_use_after(call, key, anchor,
+                                                 name, ctx)
+
+    def _check_use_after(self, call: ast.Call, key: str, anchor,
+                         callee: str, ctx: FileContext
+                         ) -> Iterable[Finding]:
+        stmt = ctx.enclosing_statement(call)
+        if _stmt_rebinds(stmt, key):
+            return  # canonical rebind-from-result idiom
+        scope = ctx.enclosing_function(call) or ctx.tree
+        call_pos = (call.end_lineno or call.lineno,
+                    call.end_col_offset or call.col_offset)
+        later = [(pos, is_store) for pos, is_store, node
+                 in _refs_in(scope, key) if pos > call_pos]
+        if later and not later[0][1]:
+            yield ctx.finding(
+                self.id, anchor,
+                f"'{key}' is read after being donated to '{callee}' — "
+                "the buffer is invalidated at dispatch (works on CPU, "
+                "RuntimeError on TPU); re-bind it from the call result")
+            return
+        if "." in key and not any(is_store for _, is_store in later):
+            # an attribute (self.X / obj.attr) OUTLIVES this function:
+            # with no re-bind anywhere after the call, the stale handle
+            # escapes and the next method that touches it reads a dead
+            # buffer — "never read again locally" only clears LOCALS
+            yield ctx.finding(
+                self.id, anchor,
+                f"attribute '{key}' is donated to '{callee}' and never "
+                "re-bound — the stale handle outlives this function "
+                "(dead-buffer read on the next access); assign the "
+                "call's returned buffer back")
+            return
+        # loop-carried: an un-rebound donation re-executes on the next
+        # iteration — the donated argument itself is then a read of a
+        # dead buffer, unless something in the loop body stores a fresh
+        # value into the name first
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                stores = [n for _, is_store, n in _refs_in(anc, key)
+                          if is_store]
+                if not stores:
+                    yield ctx.finding(
+                        self.id, anchor,
+                        f"'{key}' is donated to '{callee}' inside a "
+                        "loop without re-binding — the next iteration "
+                        "donates an already-dead buffer")
+                break

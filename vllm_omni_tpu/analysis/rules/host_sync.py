@@ -1,0 +1,142 @@
+"""OL2 — host-sync: device→host transfers inside hot-path modules.
+
+On TPU every ``.item()`` / ``jax.device_get`` / ``np.asarray(jax_expr)``
+/ ``float(jax_expr)`` blocks the host until the device queue drains —
+one stray sync in the decode loop serializes dispatch and stalls every
+in-flight request (the async-dispatch win multi-step decode exists to
+protect).  Scope is the ``HOT_PATHS`` manifest (core/, ops/, sample/,
+worker/, engine/); cold modules sync freely.
+
+Deliberate batch-boundary syncs (the engine DOES need the sampled
+tokens) carry a same-line suppression with the reason::
+
+    toks = jax.device_get(toks)  # omnilint: disable=OL2 - batch boundary
+
+Detected forms:
+
+- ``x.item()``
+- ``jax.device_get(...)`` / ``jax.device_get(...)`` via any alias
+  written as an attribute of ``jax``
+- ``np.asarray(expr)`` / ``np.array(expr)`` where ``expr`` contains a
+  ``jnp.`` / ``jax.`` call (implicit transfer of a live device array)
+- ``float(expr)`` / ``int(expr)`` / ``bool(expr)`` over a ``jnp.``/
+  ``jax.`` expression (implicit transfer + scalarization)
+- ``if arr:`` / ``while arr:`` / ``not arr`` where ``arr`` was assigned
+  from a ``jnp.``/``jax.`` call earlier in the same function (implicit
+  ``__bool__`` → sync)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.manifest import HOT_PATHS, in_scope
+from vllm_omni_tpu.analysis.rules._jitinfo import dotted
+
+_CASTS = ("float", "int", "bool")
+_NP_COERCE = ("np.asarray", "np.array", "numpy.asarray", "numpy.array")
+
+
+def _jax_rooted(node: ast.AST) -> bool:
+    """Does the expression subtree contain a jnp./jax. qualified use?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "jax"):
+            return True
+    return False
+
+
+def _has_device_get(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and dotted(sub.func) == "jax.device_get":
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    id = "OL2"
+    name = "host-sync"
+    node_types = (ast.Call, ast.Assign, ast.If, ast.While, ast.UnaryOp)
+
+    def __init__(self):
+        # (function node id or None) -> names assigned from jax exprs
+        self._arrayish: dict = {}
+        self._bool_tests: list = []  # (name, test node, scope id)
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx.path, HOT_PATHS)
+
+    def visit(self, node, ctx: FileContext) -> Iterable[Finding]:
+        if isinstance(node, ast.Call):
+            yield from self._visit_call(node, ctx)
+        elif isinstance(node, ast.Assign):
+            self._track_assign(node, ctx)
+        elif isinstance(node, (ast.If, ast.While)):
+            self._track_bool(node.test, node, ctx)
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            self._track_bool(node.operand, node, ctx)
+
+    def _visit_call(self, node: ast.Call, ctx) -> Iterable[Finding]:
+        fn = dotted(node.func)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            yield ctx.finding(
+                self.id, node,
+                ".item() forces a device sync in a hot-path module — "
+                "keep values on device or batch the transfer")
+            return
+        if fn == "jax.device_get":
+            yield ctx.finding(
+                self.id, node,
+                "jax.device_get in a hot-path module blocks on the "
+                "device queue — hoist to a batch boundary or overlap "
+                "with the next dispatch")
+            return
+        if fn in _NP_COERCE and node.args \
+                and _jax_rooted(node.args[0]) \
+                and not _has_device_get(node.args[0]):
+            yield ctx.finding(
+                self.id, node,
+                f"{fn} over a jax expression is an implicit device→host "
+                "transfer — make the sync explicit (jax.device_get) at "
+                "a batch boundary")
+            return
+        if fn in _CASTS and node.args and _jax_rooted(node.args[0]):
+            yield ctx.finding(
+                self.id, node,
+                f"{fn}() over a jax expression scalarizes through an "
+                "implicit device sync — keep the compare/accumulate on "
+                "device (jnp) or sync once per batch")
+
+    # ------------------------------------------------ implicit bool flow
+    def _scope(self, node, ctx):
+        fn = ctx.enclosing_function(node)
+        return id(fn) if fn is not None else None
+
+    def _track_assign(self, node: ast.Assign, ctx) -> None:
+        if not (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            return
+        callee = dotted(node.value.func) or ""
+        if callee.startswith(("jnp.", "jax.")) \
+                and not callee.startswith(("jax.device_get",)):
+            self._arrayish.setdefault(self._scope(node, ctx), {})[
+                node.targets[0].id] = node.lineno
+
+    def _track_bool(self, test, anchor, ctx) -> None:
+        if isinstance(test, ast.Name):
+            self._bool_tests.append((test.id, anchor,
+                                     self._scope(anchor, ctx)))
+
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        for name, anchor, scope in self._bool_tests:
+            assigned = self._arrayish.get(scope, {}).get(name)
+            if assigned is not None and assigned < anchor.lineno:
+                yield ctx.finding(
+                    self.id, anchor,
+                    f"implicit bool of device array '{name}' forces a "
+                    "sync (and raises under jit) — compare explicitly "
+                    "and sync once, or keep the predicate on device")

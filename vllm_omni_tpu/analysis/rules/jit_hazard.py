@@ -1,0 +1,231 @@
+"""OL1 — jit-hazard: Python-level control flow on traced values, bad
+static declarations, and jit re-wrapping inside loops.
+
+``jax.jit`` stages a function out ONCE per input signature; Python
+constructs that inspect traced *values* either crash at trace time
+(``TracerBoolConversionError``) or silently bake one branch into every
+future call.  Shape/dtype inspection is static under tracing and is
+deliberately NOT flagged (``x.shape[i]``, ``x.ndim``, ``len(x)``,
+``is None`` arity checks are how bucketed dispatch is supposed to
+work) — the rule fires on the value-dependent cases a stock linter
+cannot tell apart from them:
+
+- ``if x:`` / ``while x > 0:`` / ternaries / asserts reading a traced
+  argument's value (fix: ``lax.cond`` / ``jnp.where``, or declare the
+  argument static)
+- ``for _ in x`` / ``range(x)`` / ``int(x)`` / ``bool(x)`` /
+  ``float(x)`` on a traced argument (needs ``static_argnames``)
+- ``static_argnames``/``static_argnums`` referencing a parameter the
+  wrapped function does not have (silently ignored by jax at best)
+- list/dict/set literals passed in a static position (unhashable →
+  TypeError at dispatch)
+- ``jax.jit(...)`` / ``functools.partial(jax.jit, ...)`` evaluated
+  inside a loop: every iteration builds a fresh wrapper with an empty
+  compile cache — the classic accidental recompile-per-step
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.rules._jitinfo import (
+    ModuleJitIndex,
+    build_index,
+    dotted,
+    jit_call_info,
+    param_names,
+    static_names,
+)
+
+# attributes that are static (Python values) on a tracer
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size", "sharding")
+_VALUE_CASTS = ("int", "bool", "float", "range")
+
+
+def _parents_within(root: ast.AST) -> dict[ast.AST, ast.AST]:
+    p = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            p[child] = node
+    return p
+
+
+def _traced_value_uses(test: ast.AST, traced: set[str]) -> list[str]:
+    """Traced argument names whose VALUE the expression reads (static
+    shape/dtype/len/is-None inspection exempted)."""
+    parents = _parents_within(test)
+    hits = []
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        parent = parents.get(node)
+        if (isinstance(parent, ast.Attribute) and parent.value is node
+                and parent.attr in _STATIC_ATTRS):
+            continue
+        if (isinstance(parent, ast.Call) and dotted(parent.func) == "len"
+                and node in parent.args):
+            continue
+        if (isinstance(parent, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in parent.ops)
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in parent.comparators)):
+            continue
+        if node.id not in hits:
+            hits.append(node.id)
+    return hits
+
+
+class JitHazardRule(Rule):
+    id = "OL1"
+    name = "jit-hazard"
+    node_types = (ast.Call,)
+
+    def __init__(self):
+        self._index: Optional[ModuleJitIndex] = None
+        self._seen_calls: list[ast.Call] = []
+
+    def _idx(self, ctx: FileContext) -> ModuleJitIndex:
+        if self._index is None:
+            self._index = build_index(ctx.tree)
+        return self._index
+
+    # ------------------------------------------------------------- visit
+    def visit(self, node: ast.Call, ctx: FileContext) -> Iterable[Finding]:
+        if jit_call_info(node) is not None:
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                    yield ctx.finding(
+                        self.id, node,
+                        "jax.jit wrapper built inside a loop — a fresh "
+                        "compile cache per iteration; hoist the wrap out "
+                        "of the loop")
+                    break
+        else:
+            self._seen_calls.append(node)
+
+    # ------------------------------------------------------------ finish
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        idx = self._idx(ctx)
+        seen_wraps: set[int] = set()
+        seen_defs: dict[int, tuple[ast.FunctionDef, set[str]]] = {}
+        for wrap, fn in idx.jitted.values():
+            if id(wrap.node) not in seen_wraps:
+                seen_wraps.add(id(wrap.node))
+                yield from self._check_static_decl(wrap, fn, ctx)
+            if fn is not None:
+                prev = seen_defs.get(id(fn))
+                statics = static_names(wrap, fn)
+                if prev is None:
+                    seen_defs[id(fn)] = (fn, statics)
+                else:
+                    prev[1].intersection_update(statics)
+        for fn, statics in seen_defs.values():
+            yield from self._check_traced_flow(fn, statics, ctx)
+        yield from self._check_static_call_sites(idx, ctx)
+
+    def _check_static_decl(self, wrap, fn, ctx) -> Iterable[Finding]:
+        if fn is None:
+            return
+        params = param_names(fn)
+        for name in wrap.static_argnames:
+            if name not in params:
+                yield ctx.finding(
+                    self.id, wrap.node,
+                    f"static_argnames names parameter '{name}' which "
+                    f"'{fn.name}' does not have")
+        if fn.args.vararg is None:
+            for i in wrap.static_argnums:
+                if i >= len(params) or i < -len(params):
+                    yield ctx.finding(
+                        self.id, wrap.node,
+                        f"static_argnums index {i} out of range for "
+                        f"'{fn.name}' ({len(params)} parameters)")
+
+    def _check_static_call_sites(self, idx, ctx) -> Iterable[Finding]:
+        for call in self._seen_calls:
+            name = dotted(call.func)
+            entry = idx.jitted.get(name or "")
+            if entry is None:
+                continue
+            wrap, fn = entry
+            static_pos = set(wrap.static_argnums)
+            params = param_names(fn) if fn is not None else []
+            for sn in wrap.static_argnames:
+                if sn in params:
+                    static_pos.add(params.index(sn))
+            for pos in static_pos:
+                if 0 <= pos < len(call.args) and isinstance(
+                        call.args[pos], (ast.List, ast.Dict, ast.Set)):
+                    kind = type(call.args[pos]).__name__.lower()
+                    yield ctx.finding(
+                        self.id, call.args[pos],
+                        f"non-hashable {kind} literal passed for static "
+                        f"argument {pos} of '{name}' — TypeError at "
+                        "dispatch; pass a tuple")
+            for kw in call.keywords:
+                if kw.arg in wrap.static_argnames and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    kind = type(kw.value).__name__.lower()
+                    yield ctx.finding(
+                        self.id, kw.value,
+                        f"non-hashable {kind} literal passed for static "
+                        f"argument '{kw.arg}' of '{name}' — TypeError at "
+                        "dispatch; pass a tuple")
+
+    # ------------------------------------------- traced control-flow scan
+    def _check_traced_flow(self, fn: ast.FunctionDef, statics: set[str],
+                           ctx: FileContext) -> Iterable[Finding]:
+        traced = {p for p in param_names(fn)
+                  if p not in statics and p not in ("self", "cls")}
+        if traced:
+            yield from self._scan(fn.body, traced, fn.name, ctx)
+
+    def _scan(self, body, traced: set[str], fn_name: str,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in body:
+            yield from self._scan_node(node, traced, fn_name, ctx)
+
+    def _scan_node(self, node, traced, fn_name, ctx) -> Iterable[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its params shadow, but closed-over jit args
+            # are STILL traced inside it (scan/cond/vmap bodies)
+            inner = traced - set(param_names(node))
+            yield from self._scan(node.body, inner, fn_name, ctx)
+            return
+        if isinstance(node, ast.Lambda):
+            inner = traced - set(param_names(node))
+            yield from self._scan_node(node.body, inner, fn_name, ctx)
+            return
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            kind = {"If": "if", "While": "while", "IfExp": "ternary",
+                    "Assert": "assert"}[type(node).__name__]
+            for name in _traced_value_uses(node.test, traced):
+                yield ctx.finding(
+                    self.id, node,
+                    f"Python {kind} on the value of traced argument "
+                    f"'{name}' in jitted '{fn_name}' — fails or "
+                    "specializes at trace time; use lax.cond/jnp.where "
+                    "or declare it static")
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if isinstance(node.iter, ast.Name) and node.iter.id in traced:
+                yield ctx.finding(
+                    self.id, node,
+                    f"Python for-loop iterates traced argument "
+                    f"'{node.iter.id}' in jitted '{fn_name}' — unrolls "
+                    "or fails at trace time; use lax.scan/fori_loop")
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname in _VALUE_CASTS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in traced:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"'{fname}()' on traced argument '{arg.id}' "
+                            f"in jitted '{fn_name}' — concretizes a "
+                            "tracer; declare it in static_argnames")
+        for child in ast.iter_child_nodes(node):
+            yield from self._scan_node(child, traced, fn_name, ctx)

@@ -1,0 +1,132 @@
+"""OL6 — metric-drift: the Prometheus metric surface can't silently move.
+
+The omnilint absorption of ``scripts/check_metrics_names.py`` (that
+script is now a thin shim over this module so existing CI invocations
+keep working).  Two layers:
+
+- static (pure AST, runs anywhere): every key literal in the
+  ``METRIC_SPECS`` dict must match ``vllm_omni_tpu_[a-z_]+`` after the
+  prefix — lowercase/underscore only, no digits (which is why the E2E
+  latency series is ``request_latency_ms``)
+- dynamic (imports ``metrics/prometheus.py`` — dependency-free by
+  design, so safe in any lane): render a synthetic exposition covering
+  every stage/edge/engine series and parse it back against the specs
+  (``validate_specs`` + ``validate_exposition``)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.manifest import METRIC_MODULES, in_scope
+
+_NAME_RE = re.compile(r"vllm_omni_tpu_[a-z_]+")
+_PREFIX = "vllm_omni_tpu_"
+
+
+def synthetic_summary() -> dict:
+    """An aggregator summary exercising every stage/edge series."""
+    return {
+        "stages": {
+            0: {"num_requests": 3, "tokens_in": 30, "tokens_out": 12,
+                "tps": 41.5},
+            1: {"num_requests": 3, "tokens_in": 12, "tokens_out": 12,
+                "tps": 9.0},
+        },
+        "edges": {"0->1": {"transfers": 3, "bytes": 4096, "ms": 1.25}},
+        "e2e": {"num_finished": 3, "window": 3, "p50_ms": 101.0,
+                "p90_ms": 250.0, "p99_ms": 251.0},
+    }
+
+
+def synthetic_engine_snapshot() -> dict:
+    """An engine snapshot exercising every engine series (LLM histograms
+    + scheduler/KV gauges + diffusion counters)."""
+    hist = {"buckets": [[10.0, 1], [100.0, 2], [float("inf"), 3]],
+            "sum": 123.0, "count": 3, "p50": 40.0, "p90": 100.0,
+            "p99": 110.0}
+    return {
+        "gauges": {"num_waiting": 1, "num_running": 2},
+        "counters": {"num_steps": 7, "tokens_generated": 12,
+                     "prefill_tokens": 30},
+        "ttft_ms": hist, "tpot_ms": hist, "itl_ms": hist,
+        "step_ms": hist,
+        "scheduler": {"waiting": 1, "running": 2, "preemptions": 1,
+                      "rejections": 0},
+        "kv": {"pages_total": 64, "pages_used": 8, "utilization": 0.125},
+        "prefix_cache": {"enabled": True, "hits": 2, "hit_tokens": 16},
+        "diffusion": {"requests_total": 3, "batches_total": 2,
+                      "gen_seconds": hist},
+    }
+
+
+def run_check() -> list[str]:
+    """Spec + rendered-exposition round-trip; returns violation strings
+    (the contract scripts/check_metrics_names.py and
+    tests/metrics/test_prometheus.py have always consumed)."""
+    from vllm_omni_tpu.metrics.prometheus import (
+        render_exposition,
+        validate_exposition,
+        validate_specs,
+    )
+
+    errors = validate_specs()
+    text = render_exposition(
+        synthetic_summary(),
+        {0: synthetic_engine_snapshot(), 1: synthetic_engine_snapshot()},
+        device={"hbm_bytes": 16 * 2**30},
+    )
+    errors += validate_exposition(text)
+    return errors
+
+
+class MetricDriftRule(Rule):
+    id = "OL6"
+    name = "metric-drift"
+    node_types = (ast.Assign, ast.AnnAssign)
+
+    def __init__(self):
+        self._specs_node = None
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx.path, METRIC_MODULES)
+
+    def visit(self, node, ctx: FileContext) -> Iterable[Finding]:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        is_specs = any(
+            isinstance(t, ast.Name) and t.id == "METRIC_SPECS"
+            for t in targets)
+        if not is_specs or node.value is None:
+            return
+        self._specs_node = node
+        if isinstance(node.value, ast.Dict):
+            yield from self._check_keys(node.value, ctx)
+
+    def _check_keys(self, d: ast.Dict, ctx) -> Iterable[Finding]:
+        for k in d.keys:
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            full = _PREFIX + k.value
+            if not _NAME_RE.fullmatch(full) or re.search(r"\d", k.value):
+                yield ctx.finding(
+                    self.id, k,
+                    f"metric name '{k.value}' breaks the naming rule "
+                    f"({_NAME_RE.pattern}, no digits)")
+
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        anchor = self._specs_node or ctx.tree
+        try:
+            errors = run_check()
+        except Exception as e:  # import/render blew up: that IS drift
+            yield ctx.finding(
+                self.id, anchor,
+                f"metric surface check failed to run: "
+                f"{type(e).__name__}: {e}")
+            return
+        for err in errors:
+            yield ctx.finding(self.id, anchor, f"metric drift: {err}")

@@ -1,0 +1,154 @@
+"""OL5 — stage-protocol: frame types sent without a receiver handler.
+
+The orchestrator↔worker channel in ``entrypoints/stage_proc.py`` speaks
+length-prefixed frames whose dispatch key is ``msg["type"]``.  Both
+directions live in the same module (worker serve loop + ProcStage
+proxy), so the contract is statically checkable: every frame type a
+sender constructs must have a handler comparison somewhere in the
+module, and payload keys that carry cross-process trace state
+(``spans`` — the re-stamp PR 1 ships spans across the socket with) must
+be read back on the receiving side.  A new frame type with no handler
+is exactly the silent-drop bug this rule exists for: the frame parses,
+lands in an inbox, and nothing ever reads it.
+
+Detected:
+
+- a ``{"type": "x", ...}`` frame literal whose type string never
+  appears in a handler comparison (``msg.get("type") == "x"``,
+  ``t == "x"``, ``t in ("x", ...)``, match-case)
+- a frame carrying a ``"spans"``/``"metrics"``/``"trace"`` payload key
+  that no receiver reads via ``msg.get(...)``/``msg[...]``
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.manifest import PROTOCOL_MODULES, in_scope
+from vllm_omni_tpu.analysis.rules._jitinfo import dotted
+
+# payload keys that ship cross-process state which MUST be re-stamped
+# into the receiving process (trace spans, engine metrics snapshots)
+_RESTAMP_KEYS = ("spans", "metrics", "trace")
+
+
+def _const_str(node: ast.AST):
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+class StageProtocolRule(Rule):
+    id = "OL5"
+    name = "stage-protocol"
+    node_types = (ast.Dict, ast.Compare, ast.Assign, ast.Subscript,
+                  ast.Call, ast.Match)
+
+    def __init__(self):
+        self._sent: dict[str, ast.AST] = {}      # type -> first frame node
+        self._sent_keys: dict[str, ast.AST] = {}  # payload key -> node
+        self._handled: set[str] = set()
+        self._read_keys: set[str] = set()
+        self._type_names: set[str] = set()       # names bound to .get("type")
+        self._compares: list[ast.Compare] = []   # resolved in finish, once
+        #                                          _type_names is complete
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx.path, PROTOCOL_MODULES)
+
+    def visit(self, node, ctx: FileContext) -> Iterable[Finding]:
+        if isinstance(node, ast.Dict):
+            self._visit_dict(node)
+        elif isinstance(node, ast.Assign):
+            self._visit_assign(node)
+        elif isinstance(node, ast.Compare):
+            self._compares.append(node)
+        elif isinstance(node, ast.Subscript):
+            key = _const_str(node.slice)
+            if key:
+                if isinstance(node.ctx, ast.Load):
+                    self._read_keys.add(key)
+                elif isinstance(node.ctx, ast.Store):
+                    # msg["spans"] = ... augments an existing frame
+                    self._sent_keys.setdefault(key, node)
+        elif isinstance(node, ast.Call):
+            # msg.get("spans") / msg.get("type")
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" and node.args:
+                key = _const_str(node.args[0])
+                if key:
+                    self._read_keys.add(key)
+        elif isinstance(node, ast.Match):
+            for case in node.cases:
+                for sub in ast.walk(case.pattern):
+                    if isinstance(sub, ast.MatchValue):
+                        val = _const_str(sub.value)
+                        if val:
+                            self._handled.add(val)
+        return ()
+
+    def _visit_dict(self, node: ast.Dict) -> None:
+        keys = [(_const_str(k) if k is not None else None)
+                for k in node.keys]
+        if "type" not in keys:
+            return
+        t = _const_str(node.values[keys.index("type")])
+        if t is not None:
+            self._sent.setdefault(t, node)
+        for k in keys:
+            if k and k != "type":
+                self._sent_keys.setdefault(k, node)
+
+    def _visit_assign(self, node: ast.Assign) -> None:
+        # t = msg.get("type") — later comparisons against t are handlers
+        if isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "get" and node.value.args \
+                and _const_str(node.value.args[0]) == "type":
+            for tgt in node.targets:
+                name = dotted(tgt)
+                if name:
+                    self._type_names.add(name)
+
+    def _visit_compare(self, node: ast.Compare) -> None:
+        sides = [node.left] + list(node.comparators)
+        involves_type = any(
+            self._is_type_expr(s) for s in sides)
+        if not involves_type:
+            return
+        for s in sides:
+            v = _const_str(s)
+            if v is not None:
+                self._handled.add(v)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                for e in s.elts:
+                    ev = _const_str(e)
+                    if ev is not None:
+                        self._handled.add(ev)
+
+    def _is_type_expr(self, node: ast.AST) -> bool:
+        if dotted(node) in self._type_names:
+            return True
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args
+                and _const_str(node.args[0]) == "type")
+
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        for cmp_node in self._compares:
+            self._visit_compare(cmp_node)
+        for t, node in sorted(self._sent.items()):
+            if t not in self._handled:
+                yield ctx.finding(
+                    self.id, node,
+                    f"frame type '{t}' is sent but no handler in this "
+                    "module compares against it — the frame lands in an "
+                    "inbox and is silently dropped")
+        for key, node in sorted(self._sent_keys.items()):
+            if key in _RESTAMP_KEYS and key not in self._read_keys:
+                yield ctx.finding(
+                    self.id, node,
+                    f"frames carry a '{key}' payload that no receiver "
+                    "reads back — cross-process trace/metrics state is "
+                    "dropped instead of re-stamped")

@@ -507,6 +507,11 @@ class ProcStage(OmniStage):
                     # the ack even when nothing is polling the stage
                     self._profile_ack.set()
                     continue
+                if msg.get("type") == "bye":
+                    # worker's clean farewell (shutdown path): stop
+                    # reading instead of parking an unhandled frame in
+                    # the inbox (first omnilint OL5 harvest)
+                    break
                 self._inbox.put(msg)
         except (ConnectionError, OSError):
             pass
