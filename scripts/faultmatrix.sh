@@ -1,0 +1,22 @@
+#!/usr/bin/env sh
+# Fault-injection matrix gate: runs the resilience test suite — retry/
+# breaker units, fault-plan replay determinism, deadline propagation,
+# supervisor state machine, and (unless FAULTMATRIX_FAST=1) the
+# cross-process worker-kill e2e matrix on both transports.
+#
+# Standalone face of the same coverage tier-1 carries (the fast units
+# ride `-m 'not slow'`; the kill e2e is slow-marked), sitting next to
+# scripts/omnilint.sh as a pre-merge gate:
+#
+#   scripts/faultmatrix.sh                      # full matrix
+#   FAULTMATRIX_FAST=1 scripts/faultmatrix.sh   # fast units only
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU: the matrix kills workers on purpose; it must never touch
+# a real TPU chip a colocated serving process owns
+if [ "${FAULTMATRIX_FAST:-0}" = "1" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/resilience -q \
+        -p no:cacheprovider -m "not slow" "$@"
+fi
+exec env JAX_PLATFORMS=cpu python -m pytest tests/resilience -q \
+    -p no:cacheprovider "$@"

@@ -1,0 +1,173 @@
+"""End-to-end request deadlines: helpers, scheduler admission + step
+enforcement, cross-stage budget decrement, and the /metrics face."""
+
+import time
+
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig, StageRuntime
+from vllm_omni_tpu.entrypoints.omni import Omni
+from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+from vllm_omni_tpu.outputs import CompletionOutput, OmniRequestOutput
+from vllm_omni_tpu.resilience.deadline import (
+    DEADLINE_EXCEEDED,
+    clamp_timeout,
+    expired,
+    expiry_ts,
+    remaining_s,
+)
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+_CPU_ENV = {"JAX_PLATFORMS": "cpu", "OMNI_TPU_PALLAS_INTERPRET": "1"}
+
+
+def _llm_stage(stage_id, *, final=False, sources=None, max_tokens=4):
+    return StageConfig(
+        stage_id=stage_id,
+        stage_type="llm",
+        runtime=StageRuntime(),
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=(sources if sources is not None
+                             else [stage_id - 1]),
+        final_output=final,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0,
+                                 "max_tokens": max_tokens},
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    resilience_metrics.reset()
+    yield
+    resilience_metrics.reset()
+
+
+# ---------------------------------------------------------------- helpers
+def test_deadline_helpers():
+    assert expiry_ts(None) is None
+    assert remaining_s(None) is None
+    assert not expired(None)
+    ts = expiry_ts(100.0)
+    assert 99.0 < remaining_s(ts) <= 100.0
+    assert not expired(ts)
+    assert expired(time.monotonic() - 0.001)
+    # clamp: a wait never outlives the budget
+    assert clamp_timeout(30.0, None) == 30.0
+    assert clamp_timeout(None, None) is None
+    assert clamp_timeout(30.0, time.monotonic() + 5.0) <= 5.0
+    assert clamp_timeout(None, time.monotonic() + 5.0) <= 5.0
+    assert clamp_timeout(30.0, time.monotonic() - 1.0) == 0.0
+
+
+# --------------------------------------------------- engine-level checks
+def test_admission_rejects_expired_deadline():
+    from tests.helpers import tiny_lm_factory
+    from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    params, cfg, eos = tiny_lm_factory()
+    eng = LLMEngine(params, cfg,
+                    EngineConfig(num_pages=16, page_size=4,
+                                 max_model_len=64),
+                    eos_token_id=eos)
+    rid = eng.add_request([1, 2, 3], SamplingParams(max_tokens=4),
+                          deadline_ts=time.monotonic() - 0.001)
+    outs = eng.step()
+    assert len(outs) == 1 and outs[0].request_id == rid
+    assert outs[0].is_error
+    assert outs[0].error_kind == DEADLINE_EXCEEDED
+    assert "before admission" in outs[0].error_message
+    assert resilience_metrics.get("deadline_exceeded_total", stage=0) == 1
+
+
+def test_step_sweep_kills_expired_inflight_request():
+    from tests.helpers import tiny_lm_factory
+    from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    params, cfg, eos = tiny_lm_factory()
+    eng = LLMEngine(params, cfg,
+                    EngineConfig(num_pages=16, page_size=4,
+                                 max_model_len=64),
+                    eos_token_id=eos)
+    rid = eng.add_request([1, 2, 3],
+                          SamplingParams(max_tokens=32, ignore_eos=True),
+                          deadline_ts=time.monotonic() + 60.0)
+    outs = eng.step()  # prefill: request is now mid-flight
+    assert outs == []
+    _, req = eng.scheduler.find_request(rid)
+    assert req is not None and req.status.name == "RUNNING"
+    req.deadline_ts = time.monotonic() - 0.001  # budget just ran out
+    outs = eng.step()
+    assert len(outs) == 1 and outs[0].error_kind == DEADLINE_EXCEEDED
+    assert not eng.has_unfinished_requests  # pages freed, nothing wedged
+
+
+# ----------------------------------------------- pipeline-level deadlines
+def test_expired_request_terminates_at_stage0():
+    omni = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    outs = omni.generate([[1, 2, 3]], deadline_s=0.0)
+    assert len(outs) == 1
+    assert outs[0].is_error and outs[0].error_kind == DEADLINE_EXCEEDED
+
+
+def test_generous_deadline_does_not_perturb_results():
+    cfgs = [_llm_stage(0, final=True, sources=[-1])]
+    want = Omni(stage_configs=cfgs).generate([[1, 2, 3]])[0]
+    got = Omni(stage_configs=cfgs).generate([[1, 2, 3]],
+                                            deadline_s=120.0)[0]
+    assert not got.is_error
+    assert got.outputs[0].token_ids == want.outputs[0].token_ids
+
+
+def test_handoff_decrements_budget_and_consumer_enforces_it():
+    """The orchestrator re-stamps REMAINING budget at every forward; a
+    budget spent in stage 0 surfaces as DeadlineExceeded at stage 1's
+    admission — the cross-stage propagation contract."""
+    omni = Omni(stage_configs=[
+        _llm_stage(0, sources=[-1]),
+        _llm_stage(1, final=True),
+    ])
+    rid = "r-dead"
+    # arm a deadline that is already spent by "stage 0" time
+    omni._deadline_ts[rid] = time.monotonic() - 1.0
+    upstream = OmniRequestOutput(
+        request_id=rid, finished=True, prompt_token_ids=[1, 2, 3],
+        outputs=[CompletionOutput(index=0, token_ids=[4, 5])])
+    omni._forward(omni.stages[0], [upstream])
+    # the forwarded StageRequest carried a negative remaining budget
+    outs = []
+    deadline = time.monotonic() + 30
+    while not outs and time.monotonic() < deadline:
+        outs = omni.stages[1].poll()
+    assert outs and outs[0].request_id == rid
+    assert outs[0].is_error
+    assert outs[0].error_kind == DEADLINE_EXCEEDED
+
+
+def test_stage_request_deadline_survives_serialization():
+    from vllm_omni_tpu.distributed.serialization import OmniSerializer
+
+    r = StageRequest(request_id="r", prompt_token_ids=[1], deadline_s=2.5)
+    back = StageRequest(**OmniSerializer.loads(
+        OmniSerializer.dumps(r.__dict__)))
+    assert back.deadline_s == 2.5
+
+
+# ------------------------------------------------------------- /metrics
+def test_deadline_counter_scrapes_clean():
+    from vllm_omni_tpu.metrics.prometheus import (
+        render_from_omni,
+        validate_exposition,
+    )
+
+    omni = Omni(stage_configs=[_llm_stage(0, final=True, sources=[-1])])
+    outs = omni.generate([[1, 2, 3]], deadline_s=0.0)
+    assert outs[0].error_kind == DEADLINE_EXCEEDED
+    text = render_from_omni(omni)
+    assert validate_exposition(text) == []
+    assert 'vllm_omni_tpu_deadline_exceeded_total{stage="0"} 1' in text

@@ -1,0 +1,176 @@
+"""RetryPolicy / CircuitBreaker / call_with_retry units on a fake clock
+(no sleeps, fully deterministic schedules)."""
+
+import random
+
+import pytest
+
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+from vllm_omni_tpu.resilience.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetriesExhausted,
+    RetryPolicy,
+    call_with_retry,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    resilience_metrics.reset()
+    yield
+    resilience_metrics.reset()
+
+
+# ------------------------------------------------------------ RetryPolicy
+def test_backoff_sequence_exponential_and_capped():
+    p = RetryPolicy(max_attempts=5, base_delay_s=1.0, multiplier=2.0,
+                    max_delay_s=5.0, jitter=0.0)
+    assert [p.delay_s(a) for a in (1, 2, 3, 4, 5)] == [1, 2, 4, 5, 5]
+
+
+def test_backoff_jitter_is_seed_deterministic_and_bounded():
+    p = RetryPolicy(base_delay_s=1.0, jitter=0.25)
+    a = [p.delay_s(1, random.Random(7)) for _ in range(1)]
+    b = [p.delay_s(1, random.Random(7)) for _ in range(1)]
+    assert a == b  # same seed, same jitter
+    for _ in range(50):
+        d = p.delay_s(1, random.Random())
+        assert 0.75 <= d <= 1.25
+
+
+# --------------------------------------------------------- call_with_retry
+def test_retry_succeeds_after_transient_failures():
+    clk = FakeClock()
+    sleeps = []
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("blip")
+        return 42
+
+    out = call_with_retry(
+        fn, site="edge",
+        policy=RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                           multiplier=2.0, jitter=0.0),
+        clock=clk.now, sleep=sleeps.append)
+    assert out == 42
+    assert calls["n"] == 3
+    assert sleeps == [0.1, 0.2]
+    assert resilience_metrics.get("connector_retries_total",
+                                  site="edge") == 2
+
+
+def test_retry_exhaustion_raises_with_last_error():
+    def fn():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetriesExhausted) as ei:
+        call_with_retry(fn, site="edge",
+                        policy=RetryPolicy(max_attempts=2, jitter=0.0),
+                        sleep=lambda s: None)
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last, ConnectionError)
+    assert isinstance(ei.value, ConnectionError)  # flows existing excepts
+
+
+def test_retry_does_not_catch_non_transient_errors():
+    def fn():
+        raise ValueError("protocol bug")
+
+    with pytest.raises(ValueError):
+        call_with_retry(fn, site="edge", sleep=lambda s: None)
+
+
+def test_retry_deadline_clamps_backoff_and_stops():
+    clk = FakeClock()
+    sleeps = []
+
+    def fn():
+        raise ConnectionError("down")
+
+    # budget of 0.15s: first backoff (0.1) fits, the second would start
+    # past the deadline -> stop early, well short of max_attempts
+    with pytest.raises(RetriesExhausted):
+        call_with_retry(
+            fn, site="edge",
+            policy=RetryPolicy(max_attempts=10, base_delay_s=0.1,
+                               multiplier=1.0, jitter=0.0),
+            deadline_ts=clk.now() + 0.15,
+            clock=clk.now, sleep=clk.sleep)
+    assert clk.t <= 1000.0 + 0.15 + 0.1  # never slept past the budget
+
+
+# ---------------------------------------------------------- CircuitBreaker
+def test_breaker_trips_after_threshold_and_half_opens():
+    clk = FakeClock()
+    br = CircuitBreaker(site="edge", failure_threshold=2,
+                        reset_timeout_s=10.0, clock=clk.now)
+    assert br.state == CircuitBreaker.CLOSED
+    br.record_failure()
+    br.check()  # still closed after 1 failure
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        br.check()
+    assert resilience_metrics.get("circuit_breaker_trips_total",
+                                  site="edge") == 1
+    # reset timeout passes -> half-open lets one probe through
+    clk.sleep(10.0)
+    assert br.state == CircuitBreaker.HALF_OPEN
+    br.check()  # no raise: the probe
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert resilience_metrics.get("circuit_breaker_open",
+                                  site="edge") == 0
+
+
+def test_breaker_reopens_on_failed_probe():
+    clk = FakeClock()
+    br = CircuitBreaker(site="edge", failure_threshold=1,
+                        reset_timeout_s=5.0, clock=clk.now)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    clk.sleep(5.0)
+    assert br.state == CircuitBreaker.HALF_OPEN
+    br.record_failure()  # probe failed -> straight back to OPEN
+    assert br.state == CircuitBreaker.OPEN
+    assert resilience_metrics.get("circuit_breaker_trips_total",
+                                  site="edge") == 2
+
+
+def test_retry_fails_fast_once_breaker_opens():
+    clk = FakeClock()
+    br = CircuitBreaker(site="edge", failure_threshold=2,
+                        reset_timeout_s=60.0, clock=clk.now)
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises((RetriesExhausted, CircuitOpenError)):
+        call_with_retry(fn, site="edge",
+                        policy=RetryPolicy(max_attempts=5, jitter=0.0),
+                        breaker=br, clock=clk.now, sleep=clk.sleep)
+    # breaker opened after 2 failures; the remaining attempts failed
+    # fast without calling fn again
+    assert calls["n"] == 2
+    # and a fresh call fails fast without touching the edge at all
+    with pytest.raises(CircuitOpenError):
+        call_with_retry(fn, site="edge", breaker=br,
+                        clock=clk.now, sleep=clk.sleep)
+    assert calls["n"] == 2

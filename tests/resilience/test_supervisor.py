@@ -1,0 +1,206 @@
+"""StageSupervisor state machine against a fake stage: no spawned
+processes, no real sleeps — crash/restart/redeliver/fail-fast decisions
+are all exercised deterministically."""
+
+import time
+
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig, StageRuntime
+from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+from vllm_omni_tpu.resilience.retry import RetryPolicy
+from vllm_omni_tpu.resilience.supervisor import StageSupervisor
+
+
+class FakeStage:
+    """The slice of the ProcStage surface the supervisor drives."""
+
+    def __init__(self, config=None, device_env=None, ready_timeout=0.0,
+                 supervised=True):
+        self.config = config
+        self._fatal = None
+        self._inflight: set[str] = set()
+        self._started: set[str] = set()
+        self.request_stats = []
+        self.submits: list[list[str]] = []
+        self.restart_calls = 0
+        self.restart_error = None
+        self._restartable = True
+        self.last_pong = time.monotonic()
+        self.pings = 0
+        self.outbox: list[OmniRequestOutput] = []
+
+    @property
+    def started_request_ids(self):
+        return self._started & self._inflight
+
+    @property
+    def restartable(self):
+        return self._restartable
+
+    @property
+    def has_unfinished(self):
+        return bool(self._inflight)
+
+    def submit(self, reqs):
+        self.submits.append([r.request_id for r in reqs])
+        self._inflight.update(r.request_id for r in reqs)
+
+    def poll(self):
+        outs, self.outbox = self.outbox, []
+        for o in outs:
+            self._inflight.discard(o.request_id)
+        return outs
+
+    def _record(self, out):
+        self.request_stats.append(out.request_id)
+
+    def ping(self):
+        self.pings += 1
+        return self._fatal is None
+
+    def mark_hung(self, reason):
+        if self._fatal is None:
+            self._fatal = reason
+
+    def restart(self):
+        self.restart_calls += 1
+        if self.restart_error is not None:
+            raise self.restart_error
+        self._fatal = None
+        self._started.clear()
+
+    def shutdown(self, timeout=10.0):
+        pass
+
+    def process_engine_inputs(self, upstream):
+        return []
+
+    def engine_metrics_snapshot(self):
+        return {}
+
+
+def _mk(max_restarts=3, **kwargs):
+    cfg = StageConfig(stage_id=1, stage_type="llm",
+                      runtime=StageRuntime())
+    sup = StageSupervisor(
+        cfg, stage_factory=FakeStage,
+        heartbeat_interval_s=0,  # no background thread: tests drive poll
+        restart_policy=RetryPolicy(max_attempts=max_restarts,
+                                   base_delay_s=0.0, jitter=0.0),
+        sleep=lambda s: None, **kwargs)
+    return sup, sup._stage
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    resilience_metrics.reset()
+    yield
+    resilience_metrics.reset()
+
+
+def test_crash_fails_started_fast_and_redelivers_unstarted():
+    sup, fake = _mk()
+    sup.submit([StageRequest(request_id="a"),
+                StageRequest(request_id="b")])
+    assert fake.submits == [["a", "b"]]
+    # the worker reported "a" mid-execution (via a heartbeat pong), then
+    # died between batches
+    fake._started.add("a")
+    fake._fatal = "worker exited (code -9)"
+    outs = sup.poll()
+    # "a" failed fast with the structured retryable kind
+    assert [o.request_id for o in outs] == ["a"]
+    assert outs[0].is_error and outs[0].error_kind == "retryable"
+    assert "worker exited" in outs[0].error_message
+    # restart thread redelivers "b" exactly once
+    assert _wait(lambda: len(fake.submits) == 2)
+    assert fake.submits[1] == ["b"]
+    assert _wait(lambda: not sup._restarting)
+    assert fake.restart_calls == 1
+    assert resilience_metrics.get("stage_restarts_total", stage=1) == 1
+    assert resilience_metrics.get("requests_redelivered_total",
+                                  stage=1) == 1
+    assert resilience_metrics.get("requests_failed_retryable_total",
+                                  stage=1) == 1
+    # "b" finishes on the fresh worker and the supervisor goes idle
+    fake.outbox.append(OmniRequestOutput(request_id="b", finished=True))
+    outs = sup.poll()
+    assert [o.request_id for o in outs] == ["b"]
+    assert not sup.has_unfinished
+
+
+def test_second_crash_fails_redelivered_requests():
+    sup, fake = _mk()
+    sup.submit([StageRequest(request_id="b")])
+    fake._fatal = "gone"
+    assert sup.poll() == []  # unstarted: nothing fails yet
+    assert _wait(lambda: len(fake.submits) == 2)  # redelivered
+    assert _wait(lambda: not sup._restarting)
+    # crash again: "b" already used its one redelivery -> fail, not loop
+    fake._fatal = "gone again"
+    outs = sup.poll()
+    assert [o.request_id for o in outs] == ["b"]
+    assert outs[0].error_kind == "retryable"
+    assert "after redelivery" in outs[0].error_message
+
+
+def test_unrestartable_stage_fails_everything():
+    sup, fake = _mk()
+    fake._restartable = False  # e.g. a remote worker
+    sup.submit([StageRequest(request_id="a")])
+    fake._fatal = "channel closed"
+    outs = sup.poll()
+    assert [o.request_id for o in outs] == ["a"]
+    assert outs[0].error_kind == "retryable"
+    assert fake.restart_calls == 0
+    # the stage is dead: later submits fail fast instead of hanging
+    sup.submit([StageRequest(request_id="c")])
+    outs = sup.poll()
+    assert [o.request_id for o in outs] == ["c"]
+    assert not sup.has_unfinished
+
+
+def test_restart_budget_exhaustion_fails_inflight():
+    sup, fake = _mk(max_restarts=2)
+    fake.restart_error = RuntimeError("spawn keeps failing")
+    sup.submit([StageRequest(request_id="a")])
+    fake._fatal = "boom"
+    assert sup.poll() == []
+    # both restart attempts fail -> the request errors out, stage dead
+    assert _wait(lambda: sup._dead)
+    outs = sup.poll()
+    assert [o.request_id for o in outs] == ["a"]
+    assert "unrecoverable" in outs[0].error_message
+    assert fake.restart_calls == 2
+
+
+def test_heartbeat_declares_hung_worker():
+    cfg = StageConfig(stage_id=1, stage_type="llm",
+                      runtime=StageRuntime())
+    sup = StageSupervisor(
+        cfg, stage_factory=FakeStage,
+        heartbeat_interval_s=0.02, heartbeat_misses=3,
+        restart_policy=RetryPolicy(max_attempts=1, base_delay_s=0.0,
+                                   jitter=0.0))
+    fake = sup._stage
+    sup.submit([StageRequest(request_id="a")])
+    # the fake never answers pings: last_pong ages past 3 intervals ->
+    # mark_hung -> restart
+    fake.last_pong = time.monotonic() - 10.0
+    assert _wait(lambda: fake.restart_calls >= 1)
+    assert resilience_metrics.get("stage_heartbeat_misses_total",
+                                  stage=1) >= 1
+    assert fake.pings >= 1
+    sup.shutdown()

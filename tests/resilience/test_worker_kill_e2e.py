@@ -1,0 +1,236 @@
+"""Cross-process worker-kill e2e: a killed stage worker is restarted by
+the supervisor, queued-but-unstarted requests are redelivered (exactly
+once), mid-execution requests fail fast with the structured retryable
+kind, and the orchestrator + healthy stages keep serving.  Covers both
+transports (tcp; shm where the native rings are built) and the
+fault-plan-driven kill."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig, StageRuntime
+from vllm_omni_tpu.entrypoints.omni import Omni
+from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+from vllm_omni_tpu.resilience.retry import RetryPolicy
+from vllm_omni_tpu.resilience.supervisor import StageSupervisor
+
+_CPU_ENV = {"JAX_PLATFORMS": "cpu", "OMNI_TPU_PALLAS_INTERPRET": "1"}
+
+
+def _stage(stage_id, *, final=True, sources=None, transport="tcp",
+           max_tokens=4, extra_sp=None):
+    sp = {"temperature": 0.0, "max_tokens": max_tokens}
+    sp.update(extra_sp or {})
+    return StageConfig(
+        stage_id=stage_id,
+        stage_type="llm",
+        runtime=StageRuntime(process=True, transport=transport,
+                             device_env=dict(_CPU_ENV)),
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=(sources if sources is not None
+                             else [stage_id - 1]),
+        final_output=final,
+        final_output_type="text",
+        default_sampling_params=sp,
+    )
+
+
+def _supervisor(cfg, max_restarts=2):
+    return StageSupervisor(
+        cfg, device_env=_CPU_ENV,
+        heartbeat_interval_s=0,  # tests drive pings explicitly
+        restart_policy=RetryPolicy(max_attempts=max_restarts,
+                                   base_delay_s=0.1, max_delay_s=0.5,
+                                   jitter=0.0))
+
+
+def _drain(sup, want_ids, deadline_s=240.0):
+    outs = {}
+    deadline = time.monotonic() + deadline_s
+    while set(outs) < set(want_ids) and time.monotonic() < deadline:
+        for o in sup.poll():
+            outs[o.request_id] = o
+        time.sleep(0.02)
+    return outs
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    resilience_metrics.reset()
+    yield
+    resilience_metrics.reset()
+
+
+def _kill_redeliver_case(transport):
+    """Kill the worker right after submit (request not yet reported
+    started) -> restart within the backoff budget + redelivery -> the
+    SAME tokens an in-proc run produces, plus restart counters."""
+    inproc_cfg = _stage(0, sources=[-1])
+    inproc_cfg.runtime.process = False
+    want = Omni(stage_configs=[inproc_cfg]).generate(
+        [[1, 2, 3]])[0].outputs[0].token_ids
+
+    sup = _supervisor(_stage(0, sources=[-1], transport=transport))
+    try:
+        if transport == "shm":
+            assert sup._stage._chan.__class__.__name__ == "_ShmChannel"
+        t0 = time.monotonic()
+        sup.submit([StageRequest(request_id="r",
+                                 prompt_token_ids=[1, 2, 3])])
+        sup._stage._proc.kill()  # SIGKILL: no farewell, no cleanup
+        outs = _drain(sup, ["r"])
+        assert "r" in outs, "orchestrator hung: no terminal output"
+        assert not outs["r"].is_error, outs["r"].error_message
+        assert outs["r"].outputs[0].token_ids == want
+        # restart + redelivery happened, inside a sane wall-clock bound
+        assert resilience_metrics.get("stage_restarts_total",
+                                      stage=0) == 1
+        assert resilience_metrics.get("requests_redelivered_total",
+                                      stage=0) == 1
+        assert time.monotonic() - t0 < 240.0
+        assert not sup.has_unfinished
+        # request ids are legitimately reused across batches (Omni
+        # numbers every generate() call omni-0..N): the worker's
+        # redelivery dedup must release finished ids, not drop reuse
+        sup.submit([StageRequest(request_id="r",
+                                 prompt_token_ids=[1, 2, 3])])
+        outs = _drain(sup, ["r"], deadline_s=60.0)
+        assert "r" in outs and not outs["r"].is_error
+        # worker-side resilience counters ride the outputs frames: a
+        # deadline spent before the WORKER's admission must still be
+        # visible to the orchestrator's /metrics merge
+        sup.submit([StageRequest(request_id="dl",
+                                 prompt_token_ids=[1, 2],
+                                 deadline_s=-1.0)])
+        outs = _drain(sup, ["dl"], deadline_s=60.0)
+        assert outs["dl"].is_error
+        assert outs["dl"].error_kind == "deadline_exceeded"
+        assert sup.resilience_snapshot().get("deadline_exceeded_total")
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_worker_kill_restart_redeliver_tcp():
+    _kill_redeliver_case("tcp")
+
+
+@pytest.mark.slow
+def test_worker_kill_restart_redeliver_shm():
+    from vllm_omni_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("no native toolchain")
+    _kill_redeliver_case("shm")
+
+
+@pytest.mark.slow
+def test_mid_execution_requests_fail_fast_as_retryable():
+    """Requests the worker reported started (heartbeat pong) fail fast
+    with error_kind 'retryable' on kill; the restarted worker serves
+    new traffic."""
+    sup = _supervisor(_stage(0, sources=[-1], max_tokens=100,
+                             extra_sp={"ignore_eos": True}))
+    try:
+        ids = [f"r{i}" for i in range(8)]
+        sup.submit([StageRequest(request_id=rid,
+                                 prompt_token_ids=[1, 2, 3])
+                    for rid in ids])
+        # ping until the worker reports running requests
+        deadline = time.monotonic() + 120
+        while (not sup._stage.started_request_ids
+               and time.monotonic() < deadline):
+            sup._stage.ping()
+            sup.poll()
+            time.sleep(0.02)
+        assert sup._stage.started_request_ids, \
+            "worker never reported mid-execution requests"
+        sup._stage._proc.kill()
+        outs = _drain(sup, ids)
+        assert set(outs) == set(ids), "some requests never terminated"
+        # mid-execution requests failed FAST with the structured
+        # retryable kind; everything else was redelivered and finished
+        # clean — nothing hung, nothing got a generic internal error
+        retryable = {rid for rid, o in outs.items()
+                     if o.is_error and o.error_kind == "retryable"}
+        assert retryable, "expected mid-execution retryable failures"
+        for rid, o in outs.items():
+            if rid not in retryable:
+                assert not o.is_error, o.error_message
+        # the restarted worker serves new traffic
+        sup.submit([StageRequest(request_id="fresh",
+                                 prompt_token_ids=[1, 2],
+                                 sampling_params={"max_tokens": 4,
+                                                  "ignore_eos": False})])
+        outs = _drain(sup, ["fresh"])
+        assert "fresh" in outs and not outs["fresh"].is_error
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_fault_plan_kill_ends_in_structured_retryable_error():
+    """OMNI_TPU_FAULTS=stage0:kill_after=1 kills EVERY worker on its
+    first submit frame: after the one redelivery the request ends as a
+    structured retryable error — never a hang, never a silent spin."""
+    os.environ["OMNI_TPU_FAULTS"] = "stage0:kill_after=1"
+    try:
+        sup = _supervisor(_stage(0, sources=[-1]), max_restarts=1)
+        try:
+            sup.submit([StageRequest(request_id="r",
+                                     prompt_token_ids=[1, 2, 3])])
+            outs = _drain(sup, ["r"])
+            assert "r" in outs and outs["r"].is_error
+            assert outs["r"].error_kind == "retryable"
+            assert resilience_metrics.get("stage_restarts_total",
+                                          stage=0) == 1
+            assert not sup.has_unfinished
+        finally:
+            sup.shutdown()
+    finally:
+        del os.environ["OMNI_TPU_FAULTS"]
+
+
+@pytest.mark.slow
+def test_pipeline_survives_worker_kill_and_scrapes_metrics():
+    """Omni-level integration: stage 0's process worker is killed while
+    a request is in flight; the supervised pipeline restarts it,
+    redelivers, and the healthy in-proc stage 1 finishes both requests;
+    /metrics scrapes the resilience counters clean."""
+    from vllm_omni_tpu.metrics.prometheus import (
+        render_from_omni,
+        validate_exposition,
+    )
+
+    stage1 = _stage(1, final=True)
+    stage1.runtime.process = False
+    omni = Omni(stage_configs=[
+        _stage(0, final=False, sources=[-1]),
+        stage1,
+    ])
+    try:
+        sup = omni.stages[0]
+        assert isinstance(sup, StageSupervisor)  # supervise defaults on
+        killer = threading.Timer(0.2, sup._stage._proc.kill)
+        killer.start()
+        outs = omni.generate([[1, 2, 3], [5, 6, 7]])
+        killer.cancel()
+        assert len(outs) == 2
+        assert all(not o.is_error for o in outs), [
+            o.error_message for o in outs]
+        assert all(o.stage_id == 1 for o in outs)
+        assert resilience_metrics.get("stage_restarts_total",
+                                      stage=0) >= 1
+        text = render_from_omni(omni)
+        assert validate_exposition(text) == []
+        assert "vllm_omni_tpu_stage_restarts_total" in text
+        assert "vllm_omni_tpu_requests_redelivered_total" in text
+    finally:
+        omni.shutdown()

@@ -29,7 +29,14 @@ HOT_PATHS: tuple[str, ...] = (
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (
+    # speaks submit/abort/outputs/fatal/profile_*/shutdown/bye plus the
+    # resilience PR's ping/pong heartbeat frames (sender AND handler
+    # both live here, so OL5 can check the pairing statically)
     "vllm_omni_tpu/entrypoints/stage_proc.py",
+    # drives restarts/redelivery over those frames; constructs no frame
+    # literals itself today — listed so any future frame it grows is
+    # linted from day one
+    "vllm_omni_tpu/resilience/supervisor.py",
 )
 
 BENCH_PATHS: tuple[str, ...] = (

@@ -30,8 +30,9 @@ from vllm_omni_tpu.analysis.manifest import PROTOCOL_MODULES, in_scope
 from vllm_omni_tpu.analysis.rules._jitinfo import dotted
 
 # payload keys that ship cross-process state which MUST be re-stamped
-# into the receiving process (trace spans, engine metrics snapshots)
-_RESTAMP_KEYS = ("spans", "metrics", "trace")
+# into the receiving process (trace spans, engine metrics snapshots,
+# worker-side resilience counters)
+_RESTAMP_KEYS = ("spans", "metrics", "trace", "resilience")
 
 
 def _const_str(node: ast.AST):

@@ -77,6 +77,19 @@ class StageRuntime:
     # another host); defaults to this host's primary IP when binding all
     # interfaces, else bind_host
     advertise_host: str = ""
+    # Stage supervision (resilience/supervisor.py): heartbeat the worker
+    # over ping/pong frames, restart it on crash/hang with exponential
+    # backoff (locally-spawned workers only), redeliver queued-but-
+    # unstarted requests once, and fail mid-execution requests fast with
+    # a retryable error.  supervise=False keeps the bare ProcStage
+    # behavior (a dead worker permanently fails its in-flight set).
+    supervise: bool = True
+    max_restarts: int = 3
+    # heartbeat budget before a silent worker is declared HUNG; generous
+    # by default — an XLA compile mid-traffic stalls pongs for tens of
+    # seconds and must not read as a hang (set interval 0 to disable)
+    heartbeat_interval_s: float = 5.0
+    heartbeat_misses: int = 12
 
 
 @dataclass

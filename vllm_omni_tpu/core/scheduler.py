@@ -20,11 +20,13 @@ model runner buckets and pads into device arrays.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
 from vllm_omni_tpu.request import KVTransferState, Request, RequestStatus
+from vllm_omni_tpu.resilience.deadline import DEADLINE_EXCEEDED
 
 
 @dataclass
@@ -110,6 +112,9 @@ class ARScheduler:
         # lifetime counters for step-level metrics (/metrics gauges)
         self.num_preemptions = 0
         self.num_rejections = 0
+        # set once any admitted request carries a deadline, so the
+        # per-step expiry sweep stays free for deadline-less serving
+        self._deadlines_possible = False
 
     # ------------------------------------------------------------- intake
     def add_request(self, request: Request, injected_len: int = 0) -> None:
@@ -130,6 +135,17 @@ class ARScheduler:
         if reason is not None:
             self.reject(request, reason)
             return
+        if (request.deadline_ts is not None
+                and time.monotonic() >= request.deadline_ts):
+            # deadline enforcement at admission: the budget was spent
+            # upstream (earlier stages / queues / transfers) — reject
+            # with the distinct terminal status instead of burning
+            # compute on an answer nobody is waiting for
+            self.reject(request, "deadline exceeded before admission",
+                        kind=DEADLINE_EXCEEDED)
+            return
+        if request.deadline_ts is not None:
+            self._deadlines_possible = True
         request.status = RequestStatus.WAITING
         if self.config.kv_transfer is not None:
             request.kv_transfer = KVTransferState.PENDING
@@ -168,6 +184,27 @@ class ARScheduler:
         self.kv.free(req)
         self.reject(req, reason, kind)
         return True
+
+    def expire_deadlines(self) -> list[Request]:
+        """Error-finish every waiting/running request whose deadline
+        passed (engine calls this each step; the requests surface as
+        ``deadline_exceeded`` outputs through the normal errored
+        drain).  Returns the expired requests so the engine can count
+        them per stage."""
+        if not self._deadlines_possible:
+            return []
+        now = time.monotonic()
+        out: list[Request] = []
+        for q in (self.waiting, self.running):
+            for req in [r for r in q
+                        if r.deadline_ts is not None
+                        and now >= r.deadline_ts]:
+                q.remove(req)
+                self.kv.free(req)
+                self.reject(req, "deadline exceeded",
+                            kind=DEADLINE_EXCEEDED)
+                out.append(req)
+        return out
 
     def abort_request(self, request_id: str) -> None:
         q, req = self.find_request(request_id)

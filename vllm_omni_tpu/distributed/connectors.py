@@ -31,14 +31,25 @@ def make_key(request_id: str, from_stage: int, to_stage: int) -> str:
 
 
 class OmniConnectorBase(ABC):
-    """put/get with centralized serialization (base.py:12)."""
+    """put/get with centralized serialization (base.py:12).
+
+    ``timeout`` contract (all connectors): ``None`` = non-blocking
+    probe, a float = bounded wait, ``float("inf")`` = block until the
+    key appears.  ``fault_point("conn")`` is the resilience fault-plan
+    injection site for both directions (resilience/faults.py)."""
 
     def put(self, key: str, obj: Any) -> int:
+        from vllm_omni_tpu.resilience.faults import fault_point
+
+        fault_point("conn")
         data = OmniSerializer.dumps(obj)
         self._put_bytes(key, data)
         return len(data)
 
     def get(self, key: str, timeout: Optional[float] = None) -> Any:
+        from vllm_omni_tpu.resilience.faults import fault_point
+
+        fault_point("conn")
         data = self._get_bytes(key, timeout)
         return None if data is None else OmniSerializer.loads(data)
 
@@ -93,7 +104,8 @@ class InProcConnector(OmniConnectorBase):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
-                self._cv.wait(remaining)
+                # sliced wait: Condition.wait overflows on float("inf")
+                self._cv.wait(min(remaining, 1.0))
             return self._store.pop(key)
 
     def cleanup(self, key: str) -> None:

@@ -12,43 +12,87 @@ receiver (ARModelRunner.inject_kv) each layer can land as it arrives.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Iterator, Optional
 
 import numpy as np
 
 from vllm_omni_tpu.distributed.connectors import OmniConnectorBase
+from vllm_omni_tpu.resilience.faults import fault_point
+from vllm_omni_tpu.resilience.retry import RetryPolicy, call_with_retry
+
+# Transfer-level retry default: deliberately shallower than the generic
+# policy because the TCP connector ALREADY retries each RPC internally —
+# this layer exists for connectors without internal retries (inproc/shm)
+# and for transfer-scoped fault injection (site "kv"); attempts multiply
+# across the two layers, so keep this one at 2.
+_KV_RETRY = RetryPolicy(max_attempts=2)
 
 
-def ship_kv(conn: OmniConnectorBase, key: str, payload: list) -> int:
+def ship_kv(conn: OmniConnectorBase, key: str, payload: list,
+            retry: Optional[RetryPolicy] = None) -> int:
     """Put a per-layer KV payload ([(k, v)] dense arrays) under ``key``.
-    Returns total bytes shipped."""
-    total = conn.put(f"{key}/meta", {
+    Returns total bytes shipped.  Each per-layer put retries
+    independently under ``retry`` (puts are idempotent: re-putting a
+    layer overwrites the identical bytes)."""
+    retry = retry or _KV_RETRY
+
+    def put(subkey, obj):
+        def attempt():
+            fault_point("kv")
+            return conn.put(subkey, obj)
+
+        return call_with_retry(attempt, site=f"kv:{subkey}",
+                               policy=retry)
+
+    total = put(f"{key}/meta", {
         "num_layers": len(payload),
         "seq_len": int(payload[0][0].shape[1]),
     })
     for i, (k, v) in enumerate(payload):
-        total += conn.put(f"{key}/L{i}", (np.asarray(k), np.asarray(v)))
+        total += put(f"{key}/L{i}", (np.asarray(k), np.asarray(v)))
     return total
 
 
-def iter_kv(conn: OmniConnectorBase, key: str,
-            timeout: float = 30.0) -> Iterator[tuple]:
-    """Yield (k, v) per layer as they arrive (streaming receive)."""
-    meta = conn.get(f"{key}/meta", timeout=timeout)
-    if meta is None:
-        raise TimeoutError(f"KV transfer {key}: no metadata within "
-                           f"{timeout}s")
+def iter_kv(conn: OmniConnectorBase, key: str, timeout: float = 30.0,
+            retry: Optional[RetryPolicy] = None,
+            deadline_ts: Optional[float] = None) -> Iterator[tuple]:
+    """Yield (k, v) per layer as they arrive (streaming receive).
+
+    Transient connector failures retry per fetch under ``retry``;
+    ``deadline_ts`` (monotonic) bounds the WHOLE transfer — per-layer
+    waits shrink to the remaining budget so a stalled sender surfaces
+    as a TimeoutError at the deadline, not layers*timeout later."""
+    retry = retry or _KV_RETRY
+
+    def fetch(subkey: str, what: str):
+        t = timeout
+        if deadline_ts is not None:
+            t = min(t, max(deadline_ts - time.monotonic(), 0.0))
+
+        def attempt():
+            fault_point("kv")
+            return conn.get(subkey, timeout=t)
+
+        data = call_with_retry(
+            attempt, site=f"kv:{subkey}", policy=retry,
+            deadline_ts=deadline_ts)
+        if data is None:
+            raise TimeoutError(
+                f"KV transfer {key}: {what} missing within {t:.1f}s")
+        return data
+
+    meta = fetch(f"{key}/meta", "metadata")
     for i in range(meta["num_layers"]):
-        layer = conn.get(f"{key}/L{i}", timeout=timeout)
-        if layer is None:
-            raise TimeoutError(f"KV transfer {key}: layer {i} missing")
-        yield layer
+        yield fetch(f"{key}/L{i}", f"layer {i}")
 
 
-def recv_kv(conn: OmniConnectorBase, key: str,
-            timeout: float = 30.0) -> list:
+def recv_kv(conn: OmniConnectorBase, key: str, timeout: float = 30.0,
+            retry: Optional[RetryPolicy] = None,
+            deadline_ts: Optional[float] = None) -> list:
     """Assemble the full per-layer payload (blocking)."""
-    return list(iter_kv(conn, key, timeout))
+    return list(iter_kv(conn, key, timeout, retry=retry,
+                        deadline_ts=deadline_ts))
 
 
 def make_output_kv_sink(attach_to: str = "kv_payload"):

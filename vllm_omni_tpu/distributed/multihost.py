@@ -101,21 +101,42 @@ def publish_stage_address(store_address: str, stage_id: int,
                           address: str) -> None:
     """Orchestrator side: announce where a remote stage worker should
     connect (KV-store discovery — the analogue of the reference's
-    connector address exchange, mooncake_connector.py:22)."""
+    connector address exchange, mooncake_connector.py:22).  Retries
+    transient store failures — bring-up races (store just starting)
+    must not kill the whole pipeline."""
     from vllm_omni_tpu.distributed.tcp import TCPConnector
+    from vllm_omni_tpu.resilience.retry import RetryPolicy, call_with_retry
 
     conn = TCPConnector(address=store_address)
-    conn.put(f"stage-addr/{stage_id}", {"address": address})
+    call_with_retry(
+        lambda: conn.put(f"stage-addr/{stage_id}", {"address": address}),
+        site=f"discovery:{store_address}",
+        policy=RetryPolicy(max_attempts=5, base_delay_s=0.2,
+                           max_delay_s=5.0))
 
 
 def discover_stage_address(store_address: str, stage_id: int,
                            timeout: float = 120.0) -> str:
     """Remote worker side: look up the orchestrator's listener for this
-    stage."""
+    stage.  The whole lookup (connect retries included) is bounded by
+    ``timeout``."""
+    import time
+
     from vllm_omni_tpu.distributed.tcp import TCPConnector
+    from vllm_omni_tpu.resilience.retry import RetryPolicy, call_with_retry
 
     conn = TCPConnector(address=store_address)
-    payload = conn.get(f"stage-addr/{stage_id}", timeout=timeout)
+    deadline = time.monotonic() + timeout
+
+    def lookup():
+        remaining = max(deadline - time.monotonic(), 0.0)
+        return conn.get(f"stage-addr/{stage_id}", timeout=remaining)
+
+    payload = call_with_retry(
+        lookup, site=f"discovery:{store_address}",
+        policy=RetryPolicy(max_attempts=8, base_delay_s=0.5,
+                           max_delay_s=10.0),
+        deadline_ts=deadline)
     if not payload:
         raise TimeoutError(
             f"no address published for stage {stage_id} at "

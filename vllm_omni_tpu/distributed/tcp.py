@@ -11,9 +11,21 @@ Wire protocol (both directions length-prefixed):
   request : u32 len | u8 op | u16 klen | key utf-8 | payload
   response: u32 len | u8 status | payload
 Ops: PUT (payload = value bytes), GET (payload = f64 timeout seconds;
-blocking on the server against a condition variable — no client polling),
-DEL, PING.  Values are serialized by the caller (OmniConnectorBase /
-OmniSerializer), so tensors ride the tensor-aware path.
+blocking on the server against a condition variable — no client polling;
+a NEGATIVE timeout means block until the key appears), DEL, PING.
+Values are serialized by the caller (OmniConnectorBase / OmniSerializer),
+so tensors ride the tensor-aware path.
+
+Timeout contract (the resilience PR made this explicit): a GET's wait
+has two independent parts — the SERVER-side block (how long the store
+waits for the key) and the NETWORK slack (socket timeout headroom on
+top of it, ``net_slack_s``).  ``get(key, timeout=None)`` is a
+non-blocking probe (the contract every connector shares);
+``timeout=float("inf")`` blocks indefinitely on the server with NO
+client socket timeout.  Transient connection failures retry under a
+``RetryPolicy`` behind a per-connector ``CircuitBreaker`` — the retry
+deadline covers only the network slack, never re-counting server block
+time already spent.
 """
 
 from __future__ import annotations
@@ -130,9 +142,15 @@ class KVStoreServer:
             conn.close()
 
     def _blocking_pop(self, key: str, timeout: float) -> Optional[bytes]:
-        deadline = time.monotonic() + max(timeout, 0.0)
+        # negative timeout = wait forever (the wire encoding of the
+        # client's explicit infinite-wait contract, timeout=inf)
+        deadline = (None if timeout < 0
+                    else time.monotonic() + timeout)
         with self._cv:
             while key not in self._store:
+                if deadline is None:
+                    self._cv.wait(1.0)
+                    continue
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
@@ -153,52 +171,95 @@ class TCPConnector(OmniConnectorBase):
     ``address`` is "host:port" of the store (orchestrator side starts it);
     pass ``serve=True`` to own an embedded server (then ``address`` is the
     bind spec and the effective address is ``self.address``).
+
+    ``net_slack_s`` is the socket-timeout headroom ON TOP of any
+    server-side block time (it also bounds server-non-blocking ops:
+    PUT/DEL/PING) — the old behavior of silently capping an unspecified
+    timeout at 300 s is gone.  ``retry``/``breaker`` dicts override the
+    RetryPolicy / CircuitBreaker knobs per edge; ``retry=None`` (the
+    dict value ``{"max_attempts": 1}``-equivalent) is spelled
+    ``retry={"max_attempts": 1}``.
     """
 
-    def __init__(self, address: str = "127.0.0.1:0", serve: bool = False, **_):
+    def __init__(self, address: str = "127.0.0.1:0", serve: bool = False,
+                 net_slack_s: float = 30.0,
+                 retry: Optional[dict] = None,
+                 breaker: Optional[dict] = None, **_):
+        from vllm_omni_tpu.resilience.retry import (
+            CircuitBreaker,
+            RetryPolicy,
+        )
+
         self._server: Optional[KVStoreServer] = None
         if serve:
             host, _, port = address.partition(":")
             self._server = KVStoreServer(host or "127.0.0.1", int(port or 0))
             address = self._server.address
         self.address = address
+        self.net_slack_s = float(net_slack_s)
+        self._retry_policy = RetryPolicy(**(retry or {}))
+        self._breaker = CircuitBreaker(
+            site=f"tcp:{address}", **(breaker or {}))
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
             host, _, port = self.address.partition(":")
-            s = socket.create_connection((host, int(port)), timeout=30.0)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.net_slack_s)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
         return self._sock
 
     def _request(self, op: int, key: str, payload: bytes,
-                 timeout: Optional[float] = None) -> tuple[int, bytes]:
+                 server_block_s: float = 0.0) -> tuple[int, bytes]:
+        """One RPC under the retry policy + breaker.
+
+        ``server_block_s`` is how long the SERVER may block before
+        answering (only GET blocks; negative = forever).  The client
+        socket timeout is that block plus ``net_slack_s`` — or None
+        (infinite) for a block-forever GET, making the infinite-wait
+        contract explicit instead of a silent cap.  Retries never
+        double-count server block time: server-non-blocking ops bound
+        their whole retry sequence by the network slack, while blocking
+        GETs retry purely per attempt (bounded by max_attempts — an
+        attempt legitimately spends its time waiting on the server, so
+        a wall-clock retry deadline would eat the retries).
+        """
+        from vllm_omni_tpu.resilience.retry import call_with_retry
+
         kb = key.encode()
         frame = bytes([op]) + struct.pack("<H", len(kb)) + kb + payload
-        # server-side block (GET) + generous network slack; the timeout is
-        # re-applied on the reconnect path too, and ANY failure closes the
-        # socket — a late response left in the stream would otherwise be
-        # read as the next request's reply (desync)
-        deadline = (timeout + 30.0) if timeout is not None else 300.0
-        with self._lock:
-            for attempt in (0, 1):
+        sock_timeout = (None if server_block_s < 0
+                        else server_block_s + self.net_slack_s)
+
+        def rpc() -> tuple[int, bytes]:
+            # ANY failure closes the socket — a late response left in
+            # the stream would otherwise be read as the next request's
+            # reply (desync)
+            with self._lock:
                 try:
                     sock = self._connect()
-                    sock.settimeout(deadline)
+                    sock.settimeout(sock_timeout)
                     _send_frame(sock, frame)
                     resp = _recv_frame(sock)
-                    if resp is None:
-                        raise ConnectionError(
-                            f"kv store at {self.address} hung up"
-                        )
-                    return resp[0], resp[1:]
-                except (ConnectionError, OSError):
+                except BaseException:
                     self._drop_sock()
-                    if attempt:
-                        raise
-        raise AssertionError("unreachable")
+                    raise
+                if resp is None:
+                    self._drop_sock()
+                    raise ConnectionError(
+                        f"kv store at {self.address} hung up"
+                    )
+                return resp[0], resp[1:]
+
+        retry_deadline = (time.monotonic() + self.net_slack_s
+                          if server_block_s == 0 else None)
+        return call_with_retry(
+            rpc, site=f"tcp:{self.address}", policy=self._retry_policy,
+            breaker=self._breaker, deadline_ts=retry_deadline,
+        )
 
     def _drop_sock(self) -> None:
         if self._sock is not None:
@@ -214,9 +275,17 @@ class TCPConnector(OmniConnectorBase):
             raise RuntimeError(f"PUT {key} failed (status {status})")
 
     def _get_bytes(self, key: str, timeout: Optional[float]) -> Optional[bytes]:
-        t = 0.0 if timeout is None else float(timeout)
+        # None = non-blocking probe (the cross-connector contract);
+        # float("inf") = block forever (explicit, end-to-end: negative
+        # sentinel on the wire, no client socket timeout)
+        if timeout is None:
+            t = 0.0
+        elif timeout == float("inf"):
+            t = -1.0
+        else:
+            t = max(float(timeout), 0.0)
         status, payload = self._request(
-            OP_GET, key, struct.pack("<d", t), timeout=t
+            OP_GET, key, struct.pack("<d", t), server_block_s=t
         )
         return payload if status == ST_OK else None
 

@@ -429,12 +429,24 @@ class LLMEngine:
 
     def step(self) -> list[OmniRequestOutput]:
         t_step0 = time.perf_counter()
+        # deadline sweep BEFORE scheduling: expired requests become
+        # deadline_exceeded outputs this very step instead of consuming
+        # another forward (resilience/deadline.py)
+        self.scheduler.expire_deadlines()
         # surface intake-rejected requests as errored outputs instead of
         # silently dropping them
         errored_reqs = self.scheduler.drain_errored()
         for r in errored_reqs:
             self._req_lat.pop(r.request_id, None)
             self._trace_started.discard(r.request_id)
+            if (r.additional_information.get("error_kind")
+                    == "deadline_exceeded"):
+                from vllm_omni_tpu.resilience.metrics import (
+                    resilience_metrics,
+                )
+
+                resilience_metrics.inc("deadline_exceeded_total",
+                                       stage=self.stage_id)
         errored = [OmniRequestOutput.from_pipeline(r)
                    for r in errored_reqs]
         sched_out = self.scheduler.schedule()

@@ -145,9 +145,12 @@ class AsyncOmni:
         prompt: Union[str, list[int], dict],
         sampling_params: Optional[dict] = None,
         request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> AsyncIterator[OmniRequestOutput]:
         """Submit one request; yields one OmniRequestOutput per final stage
-        (reference: AsyncOmni.generate, async_omni.py:235)."""
+        (reference: AsyncOmni.generate, async_omni.py:235).
+        ``deadline_s`` bounds the request end-to-end; expiry surfaces as
+        a ``deadline_exceeded`` error output (HTTP 504 at the server)."""
         if request_id is None:
             request_id = f"async-{next(self._req_counter)}"
         elif request_id in self._streams:
@@ -168,9 +171,12 @@ class AsyncOmni:
             req = StageRequest(request_id=request_id,
                                prompt_token_ids=list(prompt),
                                sampling_params=sp)
-        # trace context BEFORE enqueue: the engine thread may drain the
-        # intake the instant the put lands
+        # trace context + deadline BEFORE enqueue: the engine thread may
+        # drain the intake the instant the put lands
         req.trace = self._omni.trace_begin(request_id)
+        req.deadline_s = self._omni.deadline_begin(
+            request_id,
+            req.deadline_s if req.deadline_s is not None else deadline_s)
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
         while True:

@@ -116,7 +116,18 @@ class Omni:
                     )
 
                     env = default_stage_device_env(cfg.runtime.devices)
-                self.stages.append(ProcStage(cfg, device_env=env))
+                if getattr(cfg.runtime, "supervise", True):
+                    # supervised by default: worker crash/hang becomes
+                    # restart + redeliver instead of a dead stage
+                    # (resilience/supervisor.py)
+                    from vllm_omni_tpu.resilience.supervisor import (
+                        StageSupervisor,
+                    )
+
+                    self.stages.append(
+                        StageSupervisor(cfg, device_env=env))
+                else:
+                    self.stages.append(ProcStage(cfg, device_env=env))
             else:
                 self.stages.append(OmniStage(cfg))
                 self.memory_accountant.snapshot(cfg.stage_id)
@@ -134,6 +145,15 @@ class Omni:
                               if trace_path else None)
         self._trace_ctx: dict[str, dict] = {}
         self._trace_arrival: dict[str, float] = {}
+        # end-to-end request deadlines (resilience/deadline.py): the
+        # authoritative monotonic expiry lives HERE; handoffs ship the
+        # remaining budget.  OMNI_TPU_DEFAULT_DEADLINE_S > 0 applies a
+        # fleet-wide default to requests that don't set their own.
+        from vllm_omni_tpu import envs as _envs
+
+        self._default_deadline_s: Optional[float] = (
+            _envs.OMNI_TPU_DEFAULT_DEADLINE_S or None)
+        self._deadline_ts: dict[str, float] = {}
         # connector per pipeline edge (from->to), from stage YAML
         # output_connectors; in-proc default
         self._edge_connectors = {}
@@ -160,9 +180,21 @@ class Omni:
         self._trace_arrival[request_id] = time.time()
         return ctx
 
+    def deadline_begin(self, request_id: str,
+                       deadline_s: Optional[float]) -> Optional[float]:
+        """Arm the request's end-to-end deadline at arrival (None — and
+        no env default — means unbounded).  Returns the budget used."""
+        if deadline_s is None:
+            deadline_s = self._default_deadline_s
+        if deadline_s is not None:
+            self._deadline_ts[request_id] = (time.monotonic()
+                                             + float(deadline_s))
+        return deadline_s
+
     def trace_finish(self, request_id: str) -> None:
         """Close the request's trace at final output: emits the
         whole-lifetime "request" span on the orchestrator track."""
+        self._deadline_ts.pop(request_id, None)
         ctx = self._trace_ctx.pop(request_id, None)
         t0 = self._trace_arrival.pop(request_id, None)
         if ctx is None or t0 is None:
@@ -199,15 +231,26 @@ class Omni:
 
         force_ser = os.environ.get(
             "OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION") == "1"
+        from vllm_omni_tpu.resilience.deadline import clamp_timeout
+
         for consumer in self._consumers(from_stage.stage_id):
             reqs = consumer.process_engine_inputs(outputs)
-            # re-stamp the trace context on every handoff: the default
-            # input processor (and custom ones) build fresh StageRequests
-            # that would otherwise drop it at the stage boundary
+            # re-stamp the trace context AND the remaining deadline
+            # budget on every handoff: the default input processor (and
+            # custom ones) build fresh StageRequests that would
+            # otherwise drop both at the stage boundary.  The budget is
+            # re-derived from the orchestrator's clock each time, so a
+            # slow stage shrinks what downstream stages get; a <= 0
+            # remainder is still shipped — the consumer's admission
+            # turns it into the DeadlineExceeded output.
+            now_mono = time.monotonic()
             for r in reqs:
                 ctx = self._trace_ctx.get(r.request_id)
                 if ctx is not None:
                     r.trace = ctx
+                dts = self._deadline_ts.get(r.request_id)
+                if dts is not None:
+                    r.deadline_s = dts - now_mono
             edge = (from_stage.stage_id, consumer.stage_id)
             conn = self._edge_connectors.get(edge)
             if (conn is not None and getattr(conn, "zero_copy", False)
@@ -228,8 +271,19 @@ class Omni:
                 shipped = []
                 for r in reqs:
                     key = make_key(r.request_id, *edge)
-                    payload = conn.get(key, timeout=30.0)
+                    # the wait for an edge payload never outlives the
+                    # request's deadline
+                    dts = self._deadline_ts.get(r.request_id)
+                    payload = conn.get(key,
+                                       timeout=clamp_timeout(30.0, dts))
                     if payload is None:
+                        if dts is not None \
+                                and time.monotonic() >= dts:
+                            # expired waiting on the edge: hand the
+                            # in-memory request over; the consumer's
+                            # admission rejects it as DeadlineExceeded
+                            shipped.append(r)
+                            continue
                         raise TimeoutError(f"connector lost {key}")
                     shipped.append(StageRequest(**payload))
                 self.metrics.record_transfer(
@@ -252,11 +306,15 @@ class Omni:
         self,
         prompts: Sequence[Union[str, dict, list[int]]],
         sampling_params_list: Optional[Sequence[dict]] = None,
+        deadline_s: Optional[float] = None,
     ) -> list[OmniRequestOutput]:
         """Run the full pipeline over the prompts (reference: omni.py:570).
 
         Prompt forms: token-id list (AR stage-0), str (diffusion stage-0 or
         tokenizer-equipped AR), or dict with explicit StageRequest fields.
+        ``deadline_s`` bounds each request end-to-end (dict prompts may
+        carry a per-request ``deadline_s`` overriding it); an expired
+        request terminates with a ``deadline_exceeded`` error output.
         """
         sp_list = list(sampling_params_list or [{}] * len(prompts))
         if len(sp_list) != len(prompts):
@@ -275,6 +333,12 @@ class Omni:
                                          sampling_params=sp))
             self.metrics.record_arrival(rid)
             seed[-1].trace = self.trace_begin(rid)
+            # deadline armed at arrival; the seed request carries the
+            # full budget into stage 0's admission
+            seed[-1].deadline_s = self.deadline_begin(
+                rid,
+                seed[-1].deadline_s if seed[-1].deadline_s is not None
+                else deadline_s)
 
         expected = {r.request_id for r in seed}
         n_finals = max(1, sum(1 for s in self.stages
@@ -314,10 +378,11 @@ class Omni:
                 if outs:
                     self._forward(stage, outs)
         self.harvest_stage_stats()
-        # requests lost in the pipeline must not leak trace state
+        # requests lost in the pipeline must not leak trace/deadline state
         for r in seed:
             self._trace_ctx.pop(r.request_id, None)
             self._trace_arrival.pop(r.request_id, None)
+            self._deadline_ts.pop(r.request_id, None)
         self.flush_traces()
         missing = expected - set(finals)
         if missing:

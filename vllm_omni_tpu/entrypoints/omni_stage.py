@@ -50,6 +50,12 @@ class StageRequest:
     # orchestrator — a plain dict so it survives the stage_proc sockets
     # and connector edges through OmniSerializer (tracing/trace.py)
     trace: Optional[dict[str, Any]] = None
+    # REMAINING end-to-end time budget in seconds, decremented by the
+    # orchestrator on every stage handoff (resilience/deadline.py) — a
+    # plain float for the same serialization reasons as ``trace``.
+    # Receiving stages convert it to their own monotonic expiry and
+    # enforce it at admission + every step; <= 0 means already expired.
+    deadline_s: Optional[float] = None
 
 
 def _import_obj(path: str):
@@ -314,15 +320,25 @@ class OmniStage:
                 # upstream-extracted KV prefix lands in this engine's cache
                 # (receive half of the transfer manager)
                 injected_kv = info.pop("kv_payload", None)
+                from vllm_omni_tpu.resilience.deadline import expiry_ts
+
                 self.engine.add_request(
                     list(r.prompt_token_ids or []), sp,
                     request_id=r.request_id,
                     prompt_embeds=r.prompt_embeds,
                     additional_information=info,
                     injected_kv=injected_kv,
+                    # remaining budget -> this process's monotonic clock
+                    deadline_ts=expiry_ts(r.deadline_s),
                     **mm_kwargs,
                 )
         else:
+            from vllm_omni_tpu.resilience.deadline import expiry_ts
+
+            for r in reqs:
+                # diffusion engines have no scheduler admission: the
+                # batch assembly in _run_diffusion_batch enforces this
+                r._deadline_ts = expiry_ts(r.deadline_s)
             self._pending.extend(reqs)
 
     # -------------------------------------------------------------- drive
@@ -370,6 +386,25 @@ class OmniStage:
     def _run_diffusion_batch(self) -> list[OmniRequestOutput]:
         if not self._pending:
             return []
+        from vllm_omni_tpu.resilience.deadline import (
+            deadline_output,
+            expired,
+        )
+
+        # deadline enforcement at batch assembly (the diffusion analogue
+        # of scheduler admission): a queued request whose budget ran out
+        # terminates as deadline_exceeded instead of burning a full
+        # denoising run
+        live, dead = [], []
+        for r in self._pending:
+            (dead if expired(getattr(r, "_deadline_ts", None))
+             else live).append(r)
+        if dead:
+            # poll() records these like any other batch outcome
+            self._pending = live
+            return [deadline_output(r.request_id, self.stage_id,
+                                    "expired in diffusion queue")
+                    for r in dead]
         from vllm_omni_tpu.diffusion.request import (
             OmniDiffusionRequest,
             OmniDiffusionSamplingParams,

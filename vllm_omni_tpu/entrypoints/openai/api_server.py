@@ -267,6 +267,14 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         msg = err.error_message or "request failed"
         if err.error_kind == "invalid_request":
             self._error(400, msg)
+        elif err.error_kind == "deadline_exceeded":
+            # distinct terminal status for a spent time budget — clients
+            # treat 504 as "response abandoned", not "request invalid"
+            self._error(504, msg, "deadline_exceeded")
+        elif err.error_kind == "retryable":
+            # e.g. the stage worker died mid-execution: no partial
+            # output was produced, an idempotent client may resubmit
+            self._error(503, msg, "retryable_error")
         else:
             self._error(500, msg, "internal_error")
         return True

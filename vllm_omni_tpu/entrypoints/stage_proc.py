@@ -33,6 +33,7 @@ from vllm_omni_tpu.distributed.tcp import _recv_frame, _send_frame
 from vllm_omni_tpu.entrypoints.omni_stage import OmniStage, StageRequest
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.resilience.faults import fault_point
 
 logger = init_logger(__name__)
 
@@ -53,10 +54,12 @@ class _SockChannel:
         self._sock = sock
 
     def send(self, msg: dict) -> None:
+        fault_point("chan")
         _send_msg(self._sock, msg)
 
     def recv(self) -> Optional[dict]:
         """Blocks; None means the peer hung up."""
+        fault_point("chan")
         return _recv_msg(self._sock)
 
     def settimeout(self, t) -> None:
@@ -82,9 +85,11 @@ class _ShmChannel:
         self._timeout = None
 
     def send(self, msg: dict) -> None:
+        fault_point("chan")
         self._tx.push(OmniSerializer.dumps(msg), timeout=60.0)
 
     def recv(self) -> Optional[dict]:
+        fault_point("chan")
         # socket semantics: block until a message or the channel closes;
         # bounded waits keep the thread interruptible
         while True:
@@ -272,6 +277,15 @@ def _stage_worker_serve(config: StageConfig, chan,
 
     parent = os.getppid()
     running = True
+    # redelivery dedup: a supervisor restart resubmits queued-but-
+    # unstarted requests, and the at-most-once contract lives HERE — a
+    # request id this worker has already accepted and not yet finished
+    # is never run twice even if delivery and redelivery race.
+    # Finished ids are released below: callers legitimately reuse
+    # request ids across batches (Omni.generate numbers every call
+    # omni-0..N), and a permanent set would silently drop the reuse —
+    # and grow for the worker's lifetime.
+    seen_ids: set[str] = set()
     while running:
         if watch_parent and os.getppid() != parent:
             # orchestrator died (shm rings carry no EOF the way a socket
@@ -289,7 +303,34 @@ def _stage_worker_serve(config: StageConfig, chan,
             block = False
             t = msg.get("type")
             if t == "submit":
-                stage.submit(msg["requests"])
+                # fault site stage{N}: one step per submit frame (e.g.
+                # OMNI_TPU_FAULTS="stage1:kill_after=2" crashes this
+                # worker on its second batch)
+                fault_point(f"stage{config.stage_id}")
+                fresh = [r for r in msg["requests"]
+                         if r.request_id not in seen_ids]
+                dropped = len(msg["requests"]) - len(fresh)
+                if dropped:
+                    logger.warning(
+                        "stage %d: dropped %d duplicate request(s) "
+                        "(redelivery dedup)", config.stage_id, dropped)
+                seen_ids.update(r.request_id for r in fresh)
+                if fresh:
+                    stage.submit(fresh)
+            elif t == "ping":
+                # liveness heartbeat: the pong reports which requests
+                # have STARTED computing (entered the running batch) so
+                # a supervisor restart can redeliver the rest and fail
+                # only the mid-execution ones
+                started: list[str] = []
+                sched = getattr(stage.engine, "scheduler", None)
+                if sched is not None:
+                    started = [r.request_id
+                               for r in getattr(sched, "running", [])]
+                try:
+                    chan.send({"type": "pong", "started": started})
+                except (ConnectionError, OSError, ValueError):
+                    pass
             elif t == "abort":
                 if stage.config.stage_type == "llm":
                     stage.engine.abort_request(msg["request_id"])
@@ -316,11 +357,21 @@ def _stage_worker_serve(config: StageConfig, chan,
                            "error": f"{type(e).__name__}: {e}"})
                 raise
             if outs:
+                # a finished id may be reused by a later batch — release
+                # it from the redelivery dedup set
+                seen_ids.difference_update(
+                    o.request_id for o in outs if o.finished)
                 # trace spans recorded in THIS process (engine + stage
                 # spans) ride the outputs frame back to the orchestrator,
                 # which merges them into the request's trace; the engine
                 # metrics snapshot rides along so /metrics covers
-                # process-disaggregated stages too
+                # process-disaggregated stages too, and the resilience
+                # counters this WORKER accumulated (deadline kills at
+                # its scheduler, faults fired here) ride the same frame
+                # so the orchestrator's /metrics covers them
+                from vllm_omni_tpu.resilience.metrics import (
+                    resilience_metrics,
+                )
                 from vllm_omni_tpu.tracing import get_recorder
 
                 msg = {"type": "outputs", "outputs": outs}
@@ -330,6 +381,9 @@ def _stage_worker_serve(config: StageConfig, chan,
                 metrics = stage.engine_metrics_snapshot()
                 if metrics:
                     msg["metrics"] = metrics
+                resilience = resilience_metrics.snapshot()
+                if resilience:
+                    msg["resilience"] = resilience
                 try:
                     chan.send(msg)
                 except ValueError as e:
@@ -357,7 +411,8 @@ class ProcStage(OmniStage):
 
     def __init__(self, config: StageConfig,
                  device_env: Optional[dict] = None,
-                 ready_timeout: float = 300.0):
+                 ready_timeout: float = 300.0,
+                 supervised: bool = False):
         # deliberately NOT calling super().__init__ — no local engine
         self.config = config
         self.stage_id = config.stage_id
@@ -371,6 +426,7 @@ class ProcStage(OmniStage):
         self._trace_ctx: dict[str, dict] = {}
         self.request_stats = []
         self._engine_metrics: dict = {}
+        self._worker_resilience: dict = {}
         self._inflight: set[str] = set()
         self._inbox: queue.Queue = queue.Queue()
         self._fatal: Optional[str] = None
@@ -378,7 +434,33 @@ class ProcStage(OmniStage):
         # concurrently; frames must not interleave
         self._send_lock = threading.Lock()
         self._profile_ack = threading.Event()
+        # supervision (resilience/supervisor.py): a supervised stage
+        # leaves in-flight requests alone when the worker dies — the
+        # supervisor decides restart/redeliver/fail per request
+        self._supervised = supervised
+        self._device_env = device_env
+        self._ready_timeout = ready_timeout
+        self._remote = bool(getattr(config.runtime, "remote", False))
+        # heartbeat state (ping/pong frames): last pong arrival on this
+        # process's monotonic clock, and the request ids the worker
+        # reported as mid-execution
+        self.last_pong = time.monotonic()
+        self._started_ids: set[str] = set()
+        # epoch guards the reader thread across restarts: a stale
+        # reader observing its (closed) channel's EOF must not latch
+        # _fatal on the fresh worker
+        self._epoch = 0
+        self._proc = None
+        self._chan = None
+        self._connect_worker()
 
+    def _connect_worker(self) -> None:
+        """Spawn (or, for remote stages, await) the worker and run the
+        ready handshake; called at construction and again by
+        ``restart()`` after a supervised worker died."""
+        config = self.config
+        device_env = self._device_env
+        ready_timeout = self._ready_timeout
         # transport: TCP socket (default — also works cross-host) or the
         # native shared-memory ring pair (same-host, C++ SPSC rings;
         # reference's C-backed shm MessageQueue analogue)
@@ -402,6 +484,7 @@ class ProcStage(OmniStage):
             # orchestrator owns both rings (unlinked on close)
             rx = ShmRing(c2p_name, capacity=capacity, owner=True)
             tx = ShmRing(p2c_name, capacity=capacity, owner=True)
+            self._chan = _ShmChannel(tx=tx, rx=rx)
             conn_info = ("shm", c2p_name, p2c_name, capacity)
             ctx = mp.get_context("spawn")
             self._proc = ctx.Process(
@@ -409,8 +492,13 @@ class ProcStage(OmniStage):
                 args=(config, conn_info, device_env),
                 daemon=True,
             )
-            _start_scoped(self._proc, device_env)
-            self._chan = _ShmChannel(tx=tx, rx=rx)
+            try:
+                _start_scoped(self._proc, device_env)
+            except BaseException:
+                # a spawn failure must not leak the orchestrator-owned
+                # rings (closing the channel unlinks them)
+                self._chan.close()
+                raise
         elif transport == "tcp":
             remote = getattr(config.runtime, "remote", False)
             bind_host = (getattr(config.runtime, "bind_host", "127.0.0.1")
@@ -477,35 +565,54 @@ class ProcStage(OmniStage):
         # transports (shm rings have no EOF)
         msg = None
         deadline = time.monotonic() + ready_timeout
-        while time.monotonic() < deadline:
-            self._chan.settimeout(2.0)
-            try:
-                msg = self._chan.recv()
-                break
-            except socket.timeout:
-                if self._proc is not None and not self._proc.is_alive():
+        try:
+            while time.monotonic() < deadline:
+                self._chan.settimeout(2.0)
+                try:
+                    msg = self._chan.recv()
                     break
-        if msg is None or msg.get("type") != "stage_ready":
-            err = (msg or {}).get("error", "worker hung up or timed out")
+                except socket.timeout:
+                    if self._proc is not None and not self._proc.is_alive():
+                        break
+            if msg is None or msg.get("type") != "stage_ready":
+                err = (msg or {}).get("error",
+                                      "worker hung up or timed out")
+                raise RuntimeError(
+                    f"stage {self.stage_id}: worker failed to become "
+                    f"ready: {err}"
+                )
+        except BaseException:
+            # every handshake-failure path must release the transport:
+            # for shm the orchestrator OWNS both rings, and without the
+            # close they stay linked in /dev/shm until GC happens to
+            # collect this half-built stage
             if self._proc is not None:
                 self._proc.terminate()
-            raise RuntimeError(
-                f"stage {self.stage_id}: worker failed to become ready: "
-                f"{err}"
-            )
+            self._chan.close()
+            raise
         self._chan.settimeout(None)
-        threading.Thread(target=self._reader, daemon=True).start()
+        self.last_pong = time.monotonic()
+        threading.Thread(target=self._reader, args=(self._epoch,),
+                         daemon=True).start()
 
-    def _reader(self) -> None:
+    def _reader(self, epoch: int) -> None:
+        chan = self._chan
         try:
             while True:
-                msg = self._chan.recv()
+                msg = chan.recv()
                 if msg is None:
                     break
                 if msg.get("type") == "profile_stopped":
                     # handled here, not in poll(): stop_profile blocks on
                     # the ack even when nothing is polling the stage
                     self._profile_ack.set()
+                    continue
+                if msg.get("type") == "pong":
+                    # heartbeat reply; carries the mid-execution request
+                    # ids so a supervisor restart knows what NOT to
+                    # redeliver
+                    self.last_pong = time.monotonic()
+                    self._started_ids.update(msg.get("started") or ())
                     continue
                 if msg.get("type") == "bye":
                     # worker's clean farewell (shutdown path): stop
@@ -517,9 +624,75 @@ class ProcStage(OmniStage):
             pass
         # channel EOF is the ONLY death signal a REMOTE worker gives us
         # (self._proc is None, so poll()'s is_alive check never fires) —
-        # without this, in-flight requests spin forever
-        if self._fatal is None and self._inflight:
+        # without this, in-flight requests spin forever.  The epoch
+        # check keeps a stale reader (its channel closed by restart())
+        # from latching _fatal on the fresh worker.
+        if (epoch == self._epoch and self._fatal is None
+                and self._inflight):
             self._fatal = "worker channel closed"
+
+    # ---------------------------------------------------------- liveness
+    def ping(self) -> bool:
+        """Send a liveness heartbeat; the worker replies with a ``pong``
+        frame (handled in ``_reader``).  Returns False when the channel
+        is already known-dead."""
+        if self._fatal is not None:
+            return False
+        try:
+            with self._send_lock:
+                self._chan.send({"type": "ping"})
+            return True
+        except (ConnectionError, OSError, ValueError) as e:
+            self._fatal = f"ping failed: {type(e).__name__}: {e}"
+            return False
+
+    def mark_hung(self, reason: str) -> None:
+        """Declare the worker dead (e.g. heartbeat misses exhausted):
+        latch the fatal reason and reap the process so restart() can
+        respawn cleanly."""
+        if self._fatal is None:
+            self._fatal = reason
+        if self._proc is not None and self._proc.is_alive():
+            self._proc.terminate()
+
+    @property
+    def restartable(self) -> bool:
+        """Only locally-spawned workers can be restarted — a remote
+        worker's lifecycle belongs to its own host's launcher."""
+        return not self._remote
+
+    @property
+    def started_request_ids(self) -> set[str]:
+        """Requests the worker last reported as mid-execution (from the
+        heartbeat pong) — still in flight here."""
+        return self._started_ids & self._inflight
+
+    def restart(self) -> None:
+        """Respawn the worker after a crash/hang (supervised stages).
+        The caller (StageSupervisor) owns redelivery; this only rebuilds
+        the transport + process and clears the fatal latch."""
+        if not self.restartable:
+            raise RuntimeError(
+                f"stage {self.stage_id}: remote workers cannot be "
+                "restarted by the orchestrator")
+        self._epoch += 1  # detach the old reader before closing its chan
+        if self._chan is not None:
+            self._chan.close()
+        if self._proc is not None:
+            if self._proc.is_alive():
+                self._proc.terminate()
+            self._proc.join(5.0)
+        # drop frames from the dead worker: outputs for requests the
+        # supervisor is about to fail/redeliver must not resurface
+        while True:
+            try:
+                self._inbox.get_nowait()
+            except queue.Empty:
+                break
+        self._started_ids.clear()
+        self._profile_ack.set()  # never leave a stop_profile waiter hung
+        self._connect_worker()
+        self._fatal = None
 
     # ------------------------------------------------------------- intake
     def submit(self, reqs: list[StageRequest]) -> None:
@@ -536,8 +709,12 @@ class ProcStage(OmniStage):
             except (ConnectionError, OSError, ValueError) as e:
                 # worker died between batches: the next poll() converts
                 # the whole in-flight set to per-request error outputs —
-                # never abort batch-mates on healthy stages by raising
-                self._fatal = f"submit failed: {e}"
+                # never abort batch-mates on healthy stages by raising.
+                # Keep the exception TYPE: a bare OSError often has an
+                # empty str(), and per-request error outputs must say
+                # why the worker was lost, not just that it was.
+                self._fatal = (f"submit failed: "
+                               f"{type(e).__name__}: {e}".rstrip(": "))
 
     # -------------------------------------------------------------- drive
     def poll(self) -> list[OmniRequestOutput]:
@@ -559,15 +736,26 @@ class ProcStage(OmniStage):
                 metrics = msg.get("metrics")
                 if metrics:
                     self._engine_metrics = metrics
+                resilience = msg.get("resilience")
+                if resilience:
+                    # latest worker-lifetime resilience counters; merged
+                    # into /metrics by prometheus.render_from_omni
+                    self._worker_resilience = resilience
             elif t == "fatal":
                 self._fatal = msg.get("error", "unknown")
         for o in outs:
             if o.finished:
                 self._inflight.discard(o.request_id)
+                self._started_ids.discard(o.request_id)
             self._record(o)
         if self._inflight and self._fatal is None \
                 and self._proc is not None and not self._proc.is_alive():
             self._fatal = f"worker exited (code {self._proc.exitcode})"
+        if self._supervised:
+            # the supervisor owns the failure policy (restart, redeliver
+            # unstarted, fail mid-execution as retryable) — never mass-
+            # fail the in-flight set here
+            return outs
         if self._inflight and self._fatal is not None:
             # fail every in-flight request on this stage; the pipeline
             # keeps serving requests on healthy stages
@@ -590,6 +778,13 @@ class ProcStage(OmniStage):
         """Last engine snapshot shipped by the worker (rides the outputs
         frames) — the cross-process face of OmniStage's live snapshot."""
         return self._engine_metrics
+
+    def resilience_snapshot(self) -> dict:
+        """Last resilience-counter snapshot shipped by the worker
+        (deadline kills at its scheduler, faults fired in its process);
+        counts cover the CURRENT worker's lifetime — a restart resets
+        them, which Prometheus counter semantics tolerate."""
+        return self._worker_resilience
 
     # ----------------------------------------------------------- profiling
     def start_profile(self, trace_dir: str) -> None:

@@ -48,6 +48,12 @@ _register("OMNI_TPU_LOGGING_PREFIX", "", str)
 _register("OMNI_TPU_LOG_LEVEL", "INFO", str)
 # RNG seed default.
 _register("OMNI_TPU_SEED", "0", int)
+# Default end-to-end request deadline in seconds (0 = unbounded); per
+# call / per request values override (resilience/deadline.py).
+_register("OMNI_TPU_DEFAULT_DEADLINE_S", "0", float)
+# Fault-injection plan, e.g. "seed=42;stage1:kill_after=2;conn:drop_pct=0.2"
+# (resilience/faults.py grammar).  Inherited by spawned stage workers.
+_register("OMNI_TPU_FAULTS", "", str)
 
 
 def __getattr__(name: str):

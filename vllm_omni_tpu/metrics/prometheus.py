@@ -91,6 +91,35 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "histogram", "Diffusion batch generation time", ("stage",)),
     "hbm_bytes": (
         "gauge", "Device HBM capacity", ()),
+    # ---- resilience subsystem (vllm_omni_tpu/resilience/metrics.py) —
+    # orchestrator-side restart/retry/breaker/deadline/fault counters
+    "stage_restarts_total": (
+        "counter", "Supervised stage worker restarts", ("stage",)),
+    "stage_heartbeat_misses_total": (
+        "counter", "Heartbeat intervals without a worker pong",
+        ("stage",)),
+    "requests_redelivered_total": (
+        "counter",
+        "Queued-but-unstarted requests redelivered after a restart",
+        ("stage",)),
+    "requests_failed_retryable_total": (
+        "counter",
+        "Requests failed fast with a retryable error (worker lost)",
+        ("stage",)),
+    "connector_retries_total": (
+        "counter", "Connector RPC attempts that failed and were retried",
+        ("site",)),
+    "circuit_breaker_trips_total": (
+        "counter", "Circuit breaker transitions to OPEN", ("site",)),
+    "circuit_breaker_open": (
+        "gauge", "Whether the edge's circuit breaker is open",
+        ("site",)),
+    "deadline_exceeded_total": (
+        "counter", "Requests terminated by their end-to-end deadline",
+        ("stage",)),
+    "faults_injected_total": (
+        "counter", "Fault-plan injections fired (testing only)",
+        ("site",)),
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -152,9 +181,12 @@ class _Exposition:
 
 
 def render_exposition(summary: dict, engine_snaps: dict,
-                      device: Optional[dict] = None) -> str:
+                      device: Optional[dict] = None,
+                      resilience: Optional[dict] = None) -> str:
     """``summary``: OrchestratorAggregator.summary(); ``engine_snaps``:
-    {stage_id: LLMEngine/DiffusionEngine.metrics_snapshot() or {}}."""
+    {stage_id: LLMEngine/DiffusionEngine.metrics_snapshot() or {}};
+    ``resilience``: resilience_metrics.snapshot() (restart/retry/
+    breaker/deadline counters, labels already attached)."""
     exp = _Exposition()
     e2e = summary.get("e2e", {})
     exp.sample("requests_finished_total", {}, e2e.get("num_finished", 0))
@@ -228,19 +260,40 @@ def render_exposition(summary: dict, engine_snaps: dict,
                               diff["gen_seconds"])
     if device and device.get("hbm_bytes"):
         exp.sample("hbm_bytes", {}, device["hbm_bytes"])
+    for name, samples in (resilience or {}).items():
+        if name not in METRIC_SPECS:
+            continue  # unknown names never leak past the drift guard
+        for labels, value in samples:
+            exp.sample(name, labels, value)
     return exp.render()
 
 
 def render_from_omni(omni, device: Optional[dict] = None) -> str:
     """Render the exposition for a (sync) ``Omni`` orchestrator: the
     aggregator summary plus every stage's engine snapshot (proc stages
-    report the last snapshot shipped over their command channel)."""
+    report the last snapshot shipped over their command channel) plus
+    the resilience counters — this process's own, merged with the
+    snapshots stage WORKERS ship on their outputs frames (deadline
+    kills happen at the worker's scheduler; without the merge /metrics
+    would report 0 for process-disaggregated stages)."""
+    from vllm_omni_tpu.resilience.metrics import (
+        merge_snapshots,
+        resilience_metrics,
+    )
+
     summary = omni.metrics.summary()
     snaps = {}
+    worker_res = []
     for stage in getattr(omni, "stages", ()):
         fn = getattr(stage, "engine_metrics_snapshot", None)
         snaps[stage.stage_id] = fn() if fn is not None else {}
-    return render_exposition(summary, snaps, device=device)
+        rfn = getattr(stage, "resilience_snapshot", None)
+        if rfn is not None:
+            worker_res.append(rfn())
+    return render_exposition(
+        summary, snaps, device=device,
+        resilience=merge_snapshots(resilience_metrics.snapshot(),
+                                   *worker_res))
 
 
 # ------------------------------------------------------------ validation

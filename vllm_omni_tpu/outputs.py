@@ -63,7 +63,10 @@ class OmniRequestOutput:
 
     @property
     def error_kind(self) -> Optional[str]:
-        """"invalid_request" (client's fault, HTTP 400) | "internal"."""
+        """"invalid_request" (client's fault, HTTP 400) | "internal"
+        (500) | "deadline_exceeded" (time budget spent, 504) |
+        "retryable" (transient infra failure before any output — e.g. a
+        stage worker died mid-execution — safe to resubmit, 503)."""
         if not self.is_error:
             return None
         return self.multimodal_output.get("error_kind", "internal")

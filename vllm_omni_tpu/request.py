@@ -80,6 +80,13 @@ class Request:
     # qwen3_omni_moe_thinker.py:177-178)
     deepstack_embeds: Optional[list] = None
 
+    # end-to-end deadline as a monotonic expiry on THIS process's clock
+    # (resilience/deadline.py: the orchestrator ships REMAINING budget
+    # across process boundaries; each engine converts it back to its own
+    # clock).  None = no deadline.  Enforced at scheduler admission and
+    # on every engine step.
+    deadline_ts: Optional[float] = None
+
     # ----- mutable engine state -----
     status: RequestStatus = RequestStatus.WAITING
     output_token_ids: list[int] = field(default_factory=list)

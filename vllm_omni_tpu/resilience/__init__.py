@@ -1,0 +1,64 @@
+"""Resilience subsystem: supervised stages, retrying connector edges,
+request deadlines, deterministic fault injection.
+
+The failure surface of a disaggregated multi-stage pipeline (stage
+worker processes, shm rings, TCP channels, KV-transfer edges) recovers
+here instead of killing requests: see docs/resilience.md for the
+failure model and knobs.
+"""
+
+from vllm_omni_tpu.resilience.deadline import (
+    DEADLINE_EXCEEDED,
+    RETRYABLE,
+    clamp_timeout,
+    deadline_output,
+    expired,
+    expiry_ts,
+    remaining_s,
+)
+from vllm_omni_tpu.resilience.faults import (
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    fault_point,
+    get_injector,
+    set_fault_plan,
+)
+from vllm_omni_tpu.resilience.metrics import (
+    RESILIENCE_METRIC_NAMES,
+    resilience_metrics,
+)
+from vllm_omni_tpu.resilience.retry import (
+    TRANSIENT_ERRORS,
+    CircuitBreaker,
+    CircuitOpenError,
+    RetriesExhausted,
+    RetryPolicy,
+    call_with_retry,
+)
+from vllm_omni_tpu.resilience.supervisor import StageSupervisor
+
+__all__ = [
+    "DEADLINE_EXCEEDED",
+    "RETRYABLE",
+    "clamp_timeout",
+    "deadline_output",
+    "expired",
+    "expiry_ts",
+    "remaining_s",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "fault_point",
+    "get_injector",
+    "set_fault_plan",
+    "RESILIENCE_METRIC_NAMES",
+    "resilience_metrics",
+    "TRANSIENT_ERRORS",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "RetriesExhausted",
+    "RetryPolicy",
+    "call_with_retry",
+    "StageSupervisor",
+]

@@ -1,0 +1,71 @@
+"""End-to-end request deadlines.
+
+A request enters the pipeline with a time budget (``deadline_s`` at
+``Omni``/``AsyncOmni`` arrival — per call, per request dict, or the
+``OMNI_TPU_DEFAULT_DEADLINE_S`` env default).  The orchestrator keeps
+the authoritative expiry on its monotonic clock and re-stamps the
+REMAINING budget onto every ``StageRequest`` it forwards
+(``StageRequest.deadline_s``, riding OmniSerializer next to the trace
+context), so the budget survives cross-process and cross-host handoffs
+without assuming synchronized wall clocks.  Each receiving engine
+converts the remaining budget back to its own monotonic expiry
+(``Request.deadline_ts``) and enforces it at admission and on every
+scheduler step; connector waits clamp their timeouts to it.
+
+Expiry surfaces as a distinct output status: an error output with
+``error_kind == "deadline_exceeded"`` (HTTP 504 at the serving layer),
+never a hang and never a generic internal error.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+#: error_kind of a deadline kill (outputs.OmniRequestOutput)
+DEADLINE_EXCEEDED = "deadline_exceeded"
+#: error_kind of a retry-safe failure (e.g. the stage worker died while
+#: the request was mid-execution): the request produced no partial
+#: output and an idempotent client may safely resubmit
+RETRYABLE = "retryable"
+
+
+def expiry_ts(deadline_s: Optional[float]) -> Optional[float]:
+    """Remaining budget -> monotonic expiry on THIS process's clock."""
+    if deadline_s is None:
+        return None
+    return time.monotonic() + max(float(deadline_s), 0.0)
+
+
+def remaining_s(deadline_ts: Optional[float]) -> Optional[float]:
+    """Monotonic expiry -> remaining budget (negative once expired)."""
+    if deadline_ts is None:
+        return None
+    return deadline_ts - time.monotonic()
+
+
+def expired(deadline_ts: Optional[float]) -> bool:
+    return deadline_ts is not None and time.monotonic() >= deadline_ts
+
+
+def clamp_timeout(timeout: Optional[float],
+                  deadline_ts: Optional[float]) -> Optional[float]:
+    """Bound a blocking wait by the request's remaining budget: a lost
+    payload must never be waited for past the deadline."""
+    rem = remaining_s(deadline_ts)
+    if rem is None:
+        return timeout
+    rem = max(rem, 0.0)
+    return rem if timeout is None else min(timeout, rem)
+
+
+def deadline_output(request_id: str, stage_id: int,
+                    detail: str = "") -> OmniRequestOutput:
+    """The DeadlineExceeded terminal output (counted per stage)."""
+    resilience_metrics.inc("deadline_exceeded_total", stage=stage_id)
+    msg = f"deadline exceeded{': ' + detail if detail else ''}"
+    return OmniRequestOutput.from_error(
+        request_id, msg, stage_id=stage_id, kind=DEADLINE_EXCEEDED)

@@ -1,0 +1,201 @@
+"""Deterministic fault injection for resilience testing.
+
+``OMNI_TPU_FAULTS`` describes a *fault plan* — which injection sites
+fail, how, and when — with a seed so two runs of the same plan replay
+the exact failure schedule (the replay-determinism test keys on this).
+Spawned stage workers inherit the orchestrator's environ, so one env
+var drives faults on both sides of every channel.
+
+Grammar (sites separated by ``;``, actions by ``,``)::
+
+    OMNI_TPU_FAULTS="seed=42;stage1:kill_after=2;conn:drop_pct=0.25"
+    OMNI_TPU_FAULTS="chan:delay_ms=50,drop_after=10"
+
+Sites (each ``fault_point(site)`` call is one step at that site):
+
+- ``stage{N}``  — stage N's worker main loop (one step per submit frame)
+- ``chan``      — stage command-channel send/recv
+- ``conn``      — connector ``put``/``get``
+- ``kv``        — per-layer KV transfer gets
+
+Actions:
+
+- ``kill_after=N``  — hard-exit the process (``os._exit``) on step N —
+  the worker-crash fault; only meaningful inside a stage worker
+- ``drop_after=N``  — every step > N raises ``InjectedFault`` (a
+  ``ConnectionError``, so it flows through the same except/retry paths
+  a real connection failure would)
+- ``drop_pct=P``    — seeded Bernoulli drop with probability P; the
+  k-th step at a site always gets the k-th draw of that site's RNG
+  stream, so a given (seed, site, step) decision never changes
+- ``delay_ms=D``    — sleep D ms before proceeding (latency fault)
+- ``fail_step=N``   — raise on exactly step N (single-shot fault)
+
+Injection is a no-op (one dict lookup) when no plan is installed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+logger = init_logger(__name__)
+
+_KILL_EXIT_CODE = 86  # distinctive, so tests can assert the fault fired
+
+
+class InjectedFault(ConnectionError):
+    """A fault-plan-injected failure (subclasses ConnectionError so the
+    production except/retry paths treat it as a transport failure)."""
+
+    def __init__(self, site: str, step: int, action: str):
+        super().__init__(f"injected fault at {site} step {step} ({action})")
+        self.site = site
+        self.step = step
+        self.action = action
+
+
+@dataclass
+class SiteFaults:
+    kill_after: Optional[int] = None
+    drop_after: Optional[int] = None
+    drop_pct: float = 0.0
+    delay_ms: float = 0.0
+    fail_step: Optional[int] = None
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    sites: dict[str, SiteFaults] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        plan = cls()
+        for entry in filter(None, (e.strip() for e in spec.split(";"))):
+            if entry.startswith("seed="):
+                plan.seed = int(entry[5:])
+                continue
+            site, sep, actions = entry.partition(":")
+            if not sep:
+                raise ValueError(
+                    f"bad fault entry {entry!r}: want 'site:action=value'")
+            sf = plan.sites.setdefault(site.strip(), SiteFaults())
+            for action in filter(None,
+                                 (a.strip() for a in actions.split(","))):
+                name, sep, value = action.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"bad fault action {action!r}: want 'name=value'")
+                name = name.strip()
+                if name in ("kill_after", "drop_after", "fail_step"):
+                    setattr(sf, name, int(value))
+                elif name == "drop_pct":
+                    sf.drop_pct = float(value)
+                elif name == "delay_ms":
+                    sf.delay_ms = float(value)
+                else:
+                    raise ValueError(f"unknown fault action {name!r}")
+        return plan
+
+
+class FaultInjector:
+    """Executes a plan: per-site step counters + a per-site seeded RNG
+    stream (step-indexed — decision k at a site depends only on
+    (seed, site, k), never on interleaving with other sites)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._steps: dict[str, int] = {}
+        self._rngs: dict[str, random.Random] = {}
+
+    def _advance(self, site: str) -> tuple[int, float]:
+        """(step number, this step's uniform draw) — the draw is taken
+        every step so probabilistic decisions stay aligned to steps."""
+        with self._lock:
+            step = self._steps.get(site, 0) + 1
+            self._steps[site] = step
+            rng = self._rngs.get(site)
+            if rng is None:
+                rng = self._rngs[site] = random.Random(
+                    f"{self.plan.seed}/{site}")
+            return step, rng.random()
+
+    def point(self, site: str) -> None:
+        sf = self.plan.sites.get(site)
+        if sf is None:
+            return
+        step, draw = self._advance(site)
+        if sf.delay_ms > 0:
+            time.sleep(sf.delay_ms / 1e3)
+        action = None
+        if sf.kill_after is not None and step >= sf.kill_after:
+            logger.warning("fault plan: killing process at %s step %d",
+                           site, step)
+            os._exit(_KILL_EXIT_CODE)
+        if sf.fail_step is not None and step == sf.fail_step:
+            action = f"fail_step={sf.fail_step}"
+        elif sf.drop_after is not None and step > sf.drop_after:
+            action = f"drop_after={sf.drop_after}"
+        elif sf.drop_pct > 0 and draw < sf.drop_pct:
+            action = f"drop_pct={sf.drop_pct}"
+        if action is not None:
+            resilience_metrics.inc("faults_injected_total", site=site)
+            raise InjectedFault(site, step, action)
+
+    def schedule(self, site: str, steps: int) -> list[bool]:
+        """Pure preview of the drop decisions the next ``steps`` calls at
+        ``site`` would make (ignores kill/delay) — the determinism test's
+        oracle.  Does not advance the live counters."""
+        sf = self.plan.sites.get(site, SiteFaults())
+        rng = random.Random(f"{self.plan.seed}/{site}")
+        out = []
+        for step in range(1, steps + 1):
+            draw = rng.random()
+            out.append(
+                (sf.fail_step is not None and step == sf.fail_step)
+                or (sf.drop_after is not None and step > sf.drop_after)
+                or (sf.drop_pct > 0 and draw < sf.drop_pct))
+        return out
+
+
+_injector: Optional[FaultInjector] = None
+_env_loaded = False
+_install_lock = threading.Lock()
+
+
+def set_fault_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) the process fault plan
+    programmatically — tests use this instead of the env var."""
+    global _injector, _env_loaded
+    with _install_lock:
+        _injector = FaultInjector(plan) if plan is not None else None
+        _env_loaded = True  # explicit install wins over the env
+
+
+def get_injector() -> Optional[FaultInjector]:
+    global _injector, _env_loaded
+    if not _env_loaded:
+        with _install_lock:
+            if not _env_loaded:
+                spec = os.environ.get("OMNI_TPU_FAULTS", "")
+                if spec:
+                    _injector = FaultInjector(FaultPlan.parse(spec))
+                    logger.warning("fault plan active: %s", spec)
+                _env_loaded = True
+    return _injector
+
+
+def fault_point(site: str) -> None:
+    """Production injection hook: no-op unless a plan names ``site``."""
+    inj = get_injector()
+    if inj is not None:
+        inj.point(site)

@@ -1,0 +1,101 @@
+"""Process-global resilience event counters.
+
+Restarts, retries, breaker trips, deadline kills, heartbeat misses, and
+injected faults all count here; ``metrics/prometheus.py`` renders the
+snapshot into the ``/metrics`` exposition (every name below is declared
+in ``METRIC_SPECS`` so the drift guard covers the resilience surface
+too).  Orchestrator-side events only: a stage WORKER process keeps its
+own instance, and worker-side injected faults surface indirectly (as
+the orchestrator-side retry/restart they provoke).
+
+Deliberately tiny — labeled monotonic counters and gauges, no
+histograms: resilience events are rare and discrete, and the latency
+story already lives in the engine step metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class ResilienceMetrics:
+    """Thread-safe labeled counters/gauges with a render-ready snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {label_key -> value}
+        self._counters: dict[str, dict[tuple, float]] = {}
+        self._gauges: dict[str, dict[tuple, float]] = {}
+
+    def inc(self, name: str, n: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0) + n
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges.setdefault(name, {})[_label_key(labels)] = value
+
+    def get(self, name: str, **labels) -> float:
+        key = _label_key(labels)
+        with self._lock:
+            return (self._counters.get(name, {}).get(key)
+                    or self._gauges.get(name, {}).get(key, 0))
+
+    def snapshot(self) -> dict[str, list[tuple[dict, float]]]:
+        """name -> [(labels, value)] for the exposition renderer."""
+        out: dict[str, list[tuple[dict, float]]] = {}
+        with self._lock:
+            for store in (self._counters, self._gauges):
+                for name, series in store.items():
+                    out.setdefault(name, []).extend(
+                        (dict(key), value)
+                        for key, value in sorted(series.items()))
+        return out
+
+    def reset(self) -> None:
+        """Test isolation only — production counters are monotonic."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+def merge_snapshots(*snaps: dict) -> dict:
+    """Sum snapshot dicts from several processes into one exposition
+    payload (identical (name, labels) series add — each resilience
+    event originates in exactly one process, so summing never double
+    counts a single event).  Worker restarts reset that worker's
+    contribution; Prometheus counter semantics tolerate the reset."""
+    out: dict[str, dict[tuple, float]] = {}
+    for snap in snaps:
+        for name, samples in (snap or {}).items():
+            series = out.setdefault(name, {})
+            for labels, value in samples:
+                key = _label_key(labels)
+                series[key] = series.get(key, 0) + value
+    return {name: [(dict(k), v) for k, v in sorted(series.items())]
+            for name, series in out.items()}
+
+
+resilience_metrics = ResilienceMetrics()
+
+#: metric names this module emits (mirrored in
+#: metrics/prometheus.py METRIC_SPECS; the selflint round-trip keeps
+#: the two in sync)
+RESILIENCE_METRIC_NAMES: Iterable[str] = (
+    "stage_restarts_total",
+    "stage_heartbeat_misses_total",
+    "requests_redelivered_total",
+    "requests_failed_retryable_total",
+    "connector_retries_total",
+    "circuit_breaker_trips_total",
+    "circuit_breaker_open",
+    "deadline_exceeded_total",
+    "faults_injected_total",
+)

@@ -1,0 +1,204 @@
+"""Retry policy + circuit breakers for connector edges.
+
+Every cross-process edge in the disaggregated pipeline (stage command
+channels, the TCP KV store, per-layer KV transfers, address discovery)
+can fail transiently; bare timeouts turn those blips into dead requests.
+``RetryPolicy`` centralizes the retry stance (bounded attempts,
+exponential backoff with deterministic jitter, deadline awareness) and
+``CircuitBreaker`` keeps one flapping edge from stalling the pipeline:
+after ``failure_threshold`` consecutive failures the edge fails fast
+(OPEN) until ``reset_timeout_s`` passes, then a single probe is let
+through (HALF-OPEN) — success closes the breaker, failure re-opens it.
+
+Both take injectable ``clock``/``sleep`` so the unit tests replay exact
+schedules on a fake clock (tests/resilience/test_retry.py), and both
+emit counters through the resilience metrics registry so ``/metrics``
+shows retries and breaker trips per edge.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+logger = init_logger(__name__)
+
+#: exception classes a retry policy treats as transient by default —
+#: connection-level failures, NOT protocol errors (a malformed frame
+#: repeats identically on retry)
+TRANSIENT_ERRORS: tuple[type[BaseException], ...] = (
+    ConnectionError,
+    TimeoutError,
+    OSError,
+)
+
+
+class RetriesExhausted(ConnectionError):
+    """All attempts failed; ``last`` is the final underlying error."""
+
+    def __init__(self, site: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{site}: {attempts} attempt(s) failed; last error: "
+            f"{type(last).__name__}: {last}")
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+
+
+class CircuitOpenError(ConnectionError):
+    """The edge's breaker is OPEN — failing fast instead of waiting on a
+    known-bad peer."""
+
+    def __init__(self, site: str, retry_after_s: float):
+        super().__init__(
+            f"{site}: circuit open (retry after {retry_after_s:.1f}s)")
+        self.site = site
+        self.retry_after_s = retry_after_s
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff.  ``jitter`` is the +/- fraction
+    applied to each delay from a seeded RNG (deterministic given the
+    same seed), so synchronized retry storms decorrelate without making
+    tests flaky."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    jitter: float = 0.1
+    retry_on: tuple[type[BaseException], ...] = TRANSIENT_ERRORS
+
+    def delay_s(self, attempt: int, rng: Optional[random.Random] = None
+                ) -> float:
+        """Backoff before retry number ``attempt`` (1-based: the delay
+        after the first failure is ``delay_s(1)``)."""
+        d = min(self.base_delay_s * (self.multiplier ** (attempt - 1)),
+                self.max_delay_s)
+        if self.jitter and rng is not None:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(d, 0.0)
+
+
+class CircuitBreaker:
+    """Per-edge failure latch: CLOSED -> (N consecutive failures) ->
+    OPEN -> (reset timeout) -> HALF_OPEN -> one probe decides.
+
+    Thread-safe by construction for the pipeline's use: state
+    transitions are simple attribute writes guarded by the GIL, and a
+    duplicate probe in a race degrades to one extra request — never a
+    wrong fail-fast."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, site: str = "edge", failure_threshold: int = 5,
+                 reset_timeout_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.site = site
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        # OPEN decays to HALF_OPEN lazily when the reset timeout passed
+        if (self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.reset_timeout_s):
+            self._state = self.HALF_OPEN
+        return self._state
+
+    def check(self) -> None:
+        """Raise ``CircuitOpenError`` when the edge must fail fast.
+        HALF_OPEN lets the call through as the probe."""
+        if self.state == self.OPEN:
+            remaining = (self._opened_at + self.reset_timeout_s
+                         - self._clock())
+            raise CircuitOpenError(self.site, max(remaining, 0.0))
+
+    def record_success(self) -> None:
+        if self._state != self.CLOSED:
+            logger.info("breaker %s: probe succeeded; closing", self.site)
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        resilience_metrics.set_gauge("circuit_breaker_open", 0,
+                                     site=self.site)
+
+    def record_failure(self) -> None:
+        self._consecutive_failures += 1
+        tripped = (self._state == self.HALF_OPEN
+                   or (self._state == self.CLOSED
+                       and self._consecutive_failures
+                       >= self.failure_threshold))
+        if tripped:
+            self._state = self.OPEN
+            self._opened_at = self._clock()
+            resilience_metrics.inc("circuit_breaker_trips_total",
+                                   site=self.site)
+            resilience_metrics.set_gauge("circuit_breaker_open", 1,
+                                         site=self.site)
+            logger.warning(
+                "breaker %s: OPEN after %d consecutive failures "
+                "(reset in %.1fs)", self.site,
+                self._consecutive_failures, self.reset_timeout_s)
+
+
+def call_with_retry(
+    fn: Callable,
+    *,
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+    breaker: Optional[CircuitBreaker] = None,
+    deadline_ts: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+):
+    """Run ``fn()`` under ``policy`` + ``breaker``.
+
+    ``deadline_ts`` (on ``clock``'s timeline) bounds the WHOLE retry
+    sequence: no retry starts past it, and the backoff sleep is clamped
+    to the remaining budget — a deadline-carrying request never waits
+    out a full backoff schedule it can't use.  The breaker is consulted
+    before every attempt and fed the outcome after, so a tripped edge
+    fails fast inside the retry loop too."""
+    policy = policy or RetryPolicy()
+    last: Optional[BaseException] = None
+    for attempt in range(1, max(policy.max_attempts, 1) + 1):
+        if breaker is not None:
+            breaker.check()
+        try:
+            result = fn()
+        except policy.retry_on as e:
+            last = e
+            if breaker is not None:
+                breaker.record_failure()
+            resilience_metrics.inc("connector_retries_total", site=site)
+            if attempt >= policy.max_attempts:
+                break
+            delay = policy.delay_s(attempt, rng)
+            if deadline_ts is not None:
+                remaining = deadline_ts - clock()
+                if remaining <= 0:
+                    break
+                delay = min(delay, remaining)
+            logger.warning(
+                "%s: attempt %d/%d failed (%s: %s); retrying in %.3fs",
+                site, attempt, policy.max_attempts, type(e).__name__, e,
+                delay)
+            if delay > 0:
+                sleep(delay)
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return result
+    assert last is not None
+    raise RetriesExhausted(site, attempt, last) from last
