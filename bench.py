@@ -31,7 +31,10 @@ OMNI_BENCH_SIZE (config preset; "real" [default] => streaming) /
 OMNI_BENCH_SCHEDULER (euler|unipc) / OMNI_BENCH_CACHE=1 (force TeaCache
 on the flagship itself) / OMNI_BENCH_PEAK_TFLOPS / OMNI_BENCH_BUDGET_S
 (wall-clock budget; variants are skipped when exceeded) /
-OMNI_BENCH_SKIP_AR=1 / OMNI_BENCH_SKIP_CACHE_VARIANT=1 /
+OMNI_BENCH_SKIP_AR=1 / OMNI_BENCH_AR_ASYNC=1 (AR bench runs the async
+pipelined step instead of the multi-step window; the emitted
+"step_phase" block reports host/device ms + overlap ratio either way) /
+OMNI_BENCH_SKIP_CACHE_VARIANT=1 /
 OMNI_BENCH_QUANT (int8|fp8 weight-only on the flagship; int8 halves the
 streamed transfer bytes) / OMNI_BENCH_SKIP_QUANT_VARIANT=1.
 """
@@ -461,10 +464,17 @@ def bench_ar() -> dict:
     n_reqs = int(os.environ.get("OMNI_BENCH_AR_REQS", "16"))
     mbt = int(os.environ.get("OMNI_BENCH_AR_BATCHED", "8192"))
     w = int(os.environ.get("OMNI_BENCH_AR_WINDOW", "8"))
+    # OMNI_BENCH_AR_ASYNC=1: run the async pipelined step instead of the
+    # multi-step window — per-step host work overlaps device compute via
+    # device-resident sampled tokens (docs/async_engine.md); the
+    # step-phase breakdown below makes the two modes comparable
+    use_async = os.environ.get("OMNI_BENCH_AR_ASYNC", "") == "1"
     engine = LLMEngine(params, cfg, EngineConfig(
         num_pages=64 * n_reqs, page_size=16, max_model_len=2048,
         max_num_seqs=n_reqs, max_num_batched_tokens=mbt,
-        dtype=jnp.bfloat16, multi_step_decode=w,
+        dtype=jnp.bfloat16,
+        multi_step_decode=1 if use_async else w,
+        async_scheduling=use_async,
     ))
 
     rng = np.random.default_rng(0)
@@ -551,6 +561,21 @@ def bench_ar() -> dict:
     # rather than a confident-looking number against absent hardware
     mbu = ((weights_gb * decode_iters / decode_dur) / peak_bw if peak_bw
            else None)
+    # step-phase breakdown: host-ms vs. device-ms per engine step and
+    # how much host work overlapped in-flight device compute — the
+    # async pipeline's win stays visible in the trajectory even when the
+    # sync baseline is the mode that ran
+    sm = engine.step_metrics
+    host_snap, dev_snap = sm.host_ms.snapshot(), sm.device_ms.snapshot()
+    step_phase = {
+        "host_ms_p50": host_snap["p50"],
+        "host_ms_p99": host_snap["p99"],
+        "device_ms_p50": dev_snap["p50"],
+        "device_ms_p99": dev_snap["p99"],
+        "host_ms_total": round(sm.host_ms_total, 1),
+        "overlapped_host_ms_total": round(sm.overlapped_host_ms_total, 1),
+        "overlap_ratio": round(sm.overlap_ratio, 4),
+    }
     return {
         "metric": "qwen3_omni_thinker_tok_per_sec_chip",
         "value": round(total_tokens / dur, 2),
@@ -570,6 +595,7 @@ def bench_ar() -> dict:
         "prompt_len": prompt_len,
         "gen_len": max_tokens,
         "duration_s": round(dur, 2),
+        "step_phase": step_phase,
         "arch": {
             "layers": cfg.num_layers,
             "hidden": cfg.hidden_size,
@@ -577,7 +603,8 @@ def bench_ar() -> dict:
             "experts": f"top{cfg.num_experts_per_tok}of"
                        f"{cfg.num_experts}",
             "moe_intermediate": cfg.moe_intermediate_size,
-            "multi_step_decode": w,
+            "multi_step_decode": 1 if use_async else w,
+            "async_scheduling": use_async,
             "max_num_seqs": n_reqs,
             "max_num_batched_tokens": mbt,
             "note": "bench-scale thinker (real 30B-A3B is 60 GB bf16 — "

@@ -51,6 +51,9 @@ def test_render_covers_required_series():
         'vllm_omni_tpu_tpot_ms_sum{stage="0"} 123',
         'vllm_omni_tpu_itl_ms_count{stage="0"} 3',
         'vllm_omni_tpu_scheduler_waiting{stage="0"} 1',
+        'vllm_omni_tpu_engine_step_host_ms_count{stage="0"} 3',
+        'vllm_omni_tpu_engine_step_device_ms_count{stage="0"} 3',
+        'vllm_omni_tpu_engine_step_overlap_ratio{stage="0"} 0.75',
         'vllm_omni_tpu_kv_page_utilization{stage="0"} 0.125',
         'vllm_omni_tpu_request_latency_ms{quantile="0.5"} 101',
         'vllm_omni_tpu_transfer_bytes_total{from_stage="0",to_stage="1"} 4096',

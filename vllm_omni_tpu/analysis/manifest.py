@@ -44,6 +44,12 @@ BENCH_PATHS: tuple[str, ...] = (
     "vllm_omni_tpu/benchmarks/",
     "vllm_omni_tpu/metrics/",
     "tests/benchmarks/",
+    # async pipelined step: the engine's dispatch/retire halves and the
+    # runner's dispatch_decode/retire_decode time host vs. device phases
+    # for the overlap metrics — OL4 watches that any wall-clock pair
+    # around a jax dispatch in them syncs (or says why it must not)
+    "vllm_omni_tpu/engine/llm_engine.py",
+    "vllm_omni_tpu/worker/model_runner.py",
 )
 
 METRIC_MODULES: tuple[str, ...] = (

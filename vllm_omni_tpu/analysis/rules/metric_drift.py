@@ -53,7 +53,9 @@ def synthetic_engine_snapshot() -> dict:
         "counters": {"num_steps": 7, "tokens_generated": 12,
                      "prefill_tokens": 30},
         "ttft_ms": hist, "tpot_ms": hist, "itl_ms": hist,
-        "step_ms": hist,
+        "step_ms": hist, "host_ms": hist, "device_ms": hist,
+        "overlap": {"ratio": 0.75, "host_ms_total": 40.0,
+                    "overlapped_host_ms_total": 30.0},
         "scheduler": {"waiting": 1, "running": 2, "preemptions": 1,
                       "rejections": 0},
         "kv": {"pages_total": 64, "pages_used": 8, "utilization": 0.125},

@@ -242,7 +242,13 @@ class ARScheduler:
             if budget <= 0:
                 still_running.append(req)
                 continue
-            remaining = req.num_tokens - req.num_computed_tokens
+            # async pipelining schedules AHEAD of token knowledge: a
+            # dispatched-but-unretired decode will append exactly one
+            # token, so its in-flight count stands in for the token the
+            # host hasn't seen yet (num_computed_tokens was already
+            # advanced at dispatch; sync mode always has inflight 0)
+            remaining = (req.num_tokens + req.num_inflight_tokens
+                         - req.num_computed_tokens)
             if remaining <= 0:
                 # streaming request fully caught up with the chunks that
                 # have arrived: idle until the next append
@@ -409,6 +415,10 @@ class ARScheduler:
         self.num_preemptions += 1
         self.kv.free(req)
         req.num_computed_tokens = 0
+        # an in-flight async token is discarded with the progress — the
+        # recompute re-derives it (bit-identical for greedy; the retire
+        # skips requests whose in-flight count was reset)
+        req.num_inflight_tokens = 0
         # collected hidden states are recomputed from scratch on resume —
         # stale chunks would duplicate the prefix
         req.additional_information.pop("_hidden_chunks", None)
@@ -490,23 +500,86 @@ class ARScheduler:
             for t in tokens:
                 if per_token_advance:
                     req.num_computed_tokens += 1
-                req.append_output_token(t)
-                self._maybe_trigger_kv_transfer(req)
-                stopped = req.check_stop()
-                if (not stopped
-                        and req.num_tokens >= self.config.max_model_len):
-                    req.status = RequestStatus.FINISHED_LENGTH
-                    stopped = True
+                stopped = self._append_and_check_stop(req, t)
                 if stopped:
                     break
             if stopped:
                 finished.append(req)
-                self.running.remove(req)
-                self._free_request(req)
+                self._finish_running(req)
         if kv_extracted_req_ids:
             for rid in kv_extracted_req_ids:
                 self._ack_kv_transfer(rid)
         return finished
+
+    # ------------------------------------------------- async pipelined step
+    def note_async_dispatch(self, scheduler_output: SchedulerOutput) -> None:
+        """Account a pipelined dispatch BEFORE its tokens are host-
+        visible: each single-token decode advances num_computed_tokens
+        (its KV slot is being written by the in-flight step) and marks
+        one in-flight token, so the next schedule() can emit the
+        following decode without waiting for the readback."""
+        for sched in scheduler_output.decodes:
+            req = sched.request
+            req.num_computed_tokens += sched.num_new_tokens
+            req.num_inflight_tokens += sched.num_new_tokens
+
+    def update_from_async_retire(
+        self,
+        scheduler_output: SchedulerOutput,
+        sampled: dict[str, int],
+    ) -> list[Request]:
+        """The one-step-lagged counterpart of ``update_from_output`` for
+        a pipelined dispatch: num_computed_tokens already advanced at
+        dispatch, so only the token append + stop checks happen here.
+        Requests that finished, aborted, expired, or were preempted
+        while their step was in flight have their token DISCARDED (the
+        overshoot contract — greedy recompute re-derives a preempted
+        request's token bit-identically)."""
+        finished: list[Request] = []
+        for sched in scheduler_output.decodes:
+            req = sched.request
+            had_inflight = req.num_inflight_tokens > 0
+            if had_inflight:
+                req.num_inflight_tokens -= sched.num_new_tokens
+            if req.is_finished:
+                # overshoot: the request stopped one step earlier
+                # (EOS/stop/abort/deadline) while this dispatch was in
+                # flight — discard the token and rewind the speculative
+                # advance so KV accounting matches what sync mode would
+                # have recorded (the overshoot slot's write is garbage
+                # in the request's own freed pages, never attended)
+                if had_inflight:
+                    req.num_computed_tokens -= sched.num_new_tokens
+                continue
+            if not had_inflight:
+                # preempted (possibly re-admitted) while in flight: the
+                # token was discarded with the progress reset
+                continue
+            token = sampled.get(req.request_id)
+            if token is None:
+                continue
+            if self._append_and_check_stop(req, token):
+                finished.append(req)
+                self._finish_running(req)
+        return finished
+
+    def _append_and_check_stop(self, req: Request, token: int) -> bool:
+        """The ONE append/stop sequence shared by the sync update and
+        the async lagged retire — a finish criterion or transfer
+        trigger added here applies to both, preserving the sync/async
+        bit-identity contract."""
+        req.append_output_token(int(token))
+        self._maybe_trigger_kv_transfer(req)
+        stopped = req.check_stop()
+        if not stopped and req.num_tokens >= self.config.max_model_len:
+            req.status = RequestStatus.FINISHED_LENGTH
+            stopped = True
+        return stopped
+
+    def _finish_running(self, req: Request) -> None:
+        if req in self.running:
+            self.running.remove(req)
+        self._free_request(req)
 
     # ----------------------------------------------------- kv transfer hooks
     def drain_errored(self) -> list[Request]:

@@ -13,6 +13,7 @@ OmniModelConfig.worker_type picks AR vs generation workers
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -64,6 +65,18 @@ class EngineConfig:
     # collect_hidden, and per-token logprobs (those batches fall back
     # to single-step)
     multi_step_decode: int = 1
+    # async pipelined step: two-slot pipeline over pure-decode batches —
+    # dispatch step N (forward + ON-DEVICE sampling, the sampled tokens
+    # stay device-resident and feed step N+1's dispatch directly), then
+    # do step N-1's host work (readback, stop checks, metrics) while the
+    # device computes.  Unlike multi_step_decode this works for MIXED
+    # sampling batches and doesn't delay token emission by a window —
+    # host readback lags exactly one step.  Batches needing host-visible
+    # logits (spec decode, logprobs, collect_hidden, streaming-chunk
+    # intake, cross-stage KV transfer) fall back to the synchronous path
+    # per step.  Greedy token streams are bit-identical to sync mode.
+    # See docs/async_engine.md.
+    async_scheduling: bool = False
     # precompile bucketed executables before serving: True warms every
     # decode batch bucket; a list of (batch, seq_len) pairs additionally
     # warms those prefill shapes.  A shape-cache miss mid-traffic stalls
@@ -79,12 +92,29 @@ class EngineConfig:
     tensor_parallel_size: int = 1
 
 
+@dataclass
+class _InflightStep:
+    """One slot of the two-slot pipeline: a dispatched-but-unretired
+    decode step.  The engine retires it (token readback + stop checks +
+    metrics) while the NEXT step's forward runs on the device."""
+
+    sched_out: SchedulerOutput
+    handle: Any                    # worker InflightDecode (device tokens)
+
+
 class LLMEngine:
     def __init__(self, params, model_cfg: tfm.TransformerConfig,
                  config: Optional[EngineConfig] = None,
                  eos_token_id: Optional[int] = None,
                  draft_fn=None):
         config = config if config is not None else EngineConfig()
+        if config.async_scheduling and config.worker_type != "ar":
+            logger.warning(
+                "async_scheduling only applies to AR engines; disabled "
+                "for worker_type=%s", config.worker_type)
+            # private copy — writing through would silently disable
+            # async for other engines built from the same config object
+            config = dataclasses.replace(config, async_scheduling=False)
         self.config = config
         self.eos_token_id = eos_token_id
         # prefix caching skips the forward for cached positions, so it
@@ -103,8 +133,12 @@ class LLMEngine:
             enable_chunked_prefill=config.enable_chunked_prefill,
             num_speculative_tokens=config.num_speculative_tokens,
             kv_transfer=config.kv_transfer,
+            # async pipelining and multi-step windows are alternative
+            # round-trip amortizations; windowed decodes would force the
+            # pipeline into permanent sync fallback, so async wins
             multi_step_decode=(
-                1 if config.num_speculative_tokens else
+                1 if (config.num_speculative_tokens
+                      or config.async_scheduling) else
                 config.multi_step_decode),
         )
         sched_cls = (GenerationScheduler if config.worker_type == "generation"
@@ -148,7 +182,12 @@ class LLMEngine:
                 max_model_len=config.max_model_len, dtype=config.dtype,
                 collect_hidden=config.collect_hidden, seed=config.seed,
                 max_num_seqs=config.max_num_seqs, mesh=mesh,
-                multi_step_decode=config.multi_step_decode,
+                # same forced-to-1 as the scheduler window: otherwise
+                # warmup compiles per-bucket multi-step executables
+                # (~21 s each on a remote chip) that can never run
+                multi_step_decode=(1 if config.async_scheduling
+                                   else config.multi_step_decode),
+                async_scheduling=config.async_scheduling,
             )
         if (draft_fn is not None and config.num_speculative_tokens > 0
                 and hasattr(self.runner, "set_draft_fn")):
@@ -160,6 +199,8 @@ class LLMEngine:
         self.kv_transfer_sink: Optional[Callable] = None
         self._req_counter = 0
         self._starved_ticks = 0
+        # async pipelined step: the dispatched-but-unretired slot
+        self._inflight: Optional[_InflightStep] = None
         # observability: step-level gauges/histograms (TTFT/TPOT/ITL) +
         # per-request span recording.  stage_id is stamped by OmniStage
         # so spans and /metrics series carry the pipeline position.
@@ -449,11 +490,198 @@ class LLMEngine:
                                        stage=self.stage_id)
         errored = [OmniRequestOutput.from_pipeline(r)
                    for r in errored_reqs]
+        if self.config.async_scheduling:
+            return errored + self._step_async(t_step0)
         sched_out = self.scheduler.schedule()
-        self.step_metrics.on_schedule(
-            waiting=len(self.scheduler.waiting),
-            running=len(self.scheduler.running),
+        return errored + self._run_scheduled(sched_out, t_step0)
+
+    # ------------------------------------------------ async pipelined step
+    def _step_async(self, t_step0: float) -> list[OmniRequestOutput]:
+        """Two-slot pipelined step: when the whole batch is pure
+        single-token decode, dispatch step N BEFORE retiring step N-1 —
+        the device starts computing N while the host does N-1's token
+        readback, stop checks, and bookkeeping, plus (on the next call)
+        N+1's scheduling.  Anything needing host-visible logits drains
+        the pipeline and runs the synchronous path for that step."""
+        if self._pipeline_ready():
+            sched_out = self.scheduler.schedule()
+            self.step_metrics.on_schedule(
+                waiting=len(self.scheduler.waiting),
+                running=len(self.scheduler.running),
+            )
+            if self._pipeline_eligible(sched_out):
+                return self._step_pipelined(sched_out, t_step0)
+            # scheduled but not dispatchable (e.g. page pressure
+            # preempted the whole batch): drain the pipeline, drop
+            # requests the retire just finished from the stale
+            # schedule, and run the remainder synchronously
+            outs, drain_wait = self._drain_pipeline()
+            sched_out.decodes = [
+                s for s in sched_out.decodes
+                if not s.request.is_finished
+                and s.request.status is RequestStatus.RUNNING
+            ]
+            return outs + self._run_scheduled(
+                sched_out, t_step0, skip_on_schedule=True,
+                drained_wait_s=drain_wait)
+        # fallback step (prefills / spec / logprobs / streaming / ...):
+        # retire FIRST so scheduling sees post-retire state and decode
+        # inputs are host-visible for the synchronous runner
+        outs, drain_wait = self._drain_pipeline()
+        sched_out = self.scheduler.schedule()
+        return outs + self._run_scheduled(sched_out, t_step0,
+                                          drained_wait_s=drain_wait)
+
+    def _pipeline_ready(self) -> bool:
+        """Cheap pre-schedule check: can the NEXT step be dispatched
+        ahead of token knowledge?  Mirrors the fallback matrix in
+        docs/async_engine.md — every running request must be a plain
+        decode whose host work the pipeline may lag by one step."""
+        s = self.scheduler
+        if s.waiting or not s.running:
+            return False
+        if self.config.kv_transfer is not None or s._pending_kv_transfers:
+            return False
+        if self.config.collect_hidden:
+            return False
+        if getattr(self.runner, "draft_fn", None) is not None:
+            return False
+        for r in s.running:
+            if r.awaiting_chunks or r.spec_draft_tokens:
+                return False
+            if r.sampling_params.logprobs is not None:
+                return False
+            if (r.prompt_embeds is not None
+                    and r.num_computed_tokens < r.num_prompt_tokens):
+                return False
+            remaining = (r.num_tokens + r.num_inflight_tokens
+                         - r.num_computed_tokens)
+            if remaining != 1:
+                return False
+        return True
+
+    def _pipeline_eligible(self, sched_out: SchedulerOutput) -> bool:
+        """Post-schedule check on the actual output (preemption may have
+        reshaped it): pure single-token decodes only, and every input
+        token either host-visible or device-resident in the in-flight
+        handle."""
+        if sched_out.prefills or not sched_out.decodes:
+            return False
+        if sched_out.kv_transfer_requests:
+            return False
+        prev = self._inflight
+        for s in sched_out.decodes:
+            if s.num_new_tokens != 1 or s.window != 1:
+                return False
+            if s.start_pos >= s.request.num_tokens and (
+                    prev is None
+                    or s.request.request_id not in prev.handle.rows):
+                return False
+        return True
+
+    def _step_pipelined(self, sched_out: SchedulerOutput,
+                        t_step0: float) -> list[OmniRequestOutput]:
+        rec = get_recorder()
+        prev = self._inflight
+        t_d0, w_d0 = time.perf_counter(), time.time()
+        handle = self.runner.dispatch_decode(
+            sched_out.decodes,
+            prev.handle if prev is not None else None,
         )
+        # schedule-ahead accounting: the dispatched decodes' tokens are
+        # now in flight; the next schedule() counts them without seeing
+        # their values
+        self.scheduler.note_async_dispatch(sched_out)
+        dur_disp = time.perf_counter() - t_d0
+        for s in sched_out.decodes:
+            rec.record(s.request.additional_information.get("trace"),
+                       "dispatch", w_d0, dur_disp,
+                       stage_id=self.stage_id,
+                       args={"batch": len(sched_out.decodes)})
+        self._inflight = _InflightStep(sched_out=sched_out, handle=handle)
+        outs: list[OmniRequestOutput] = []
+        new_total = 0
+        wait_s = 0.0
+        if prev is not None:
+            # step N-1's host work, overlapped with step N's compute
+            outs, new_total, wait_s = self._retire_step(prev)
+            if not self.scheduler.has_unfinished:
+                # the step just dispatched is pure overshoot (every
+                # request finished at this retire): drain it now instead
+                # of dangling device buffers + finished requests until
+                # the next traffic burst
+                extra, drain_wait = self._drain_pipeline()
+                outs += extra
+                wait_s += drain_wait
+        total_s = time.perf_counter() - t_step0
+        host_ms = max(total_s - wait_s, 0.0) * 1e3
+        # with a predecessor in flight, schedule+dispatch overlapped ITS
+        # compute and the retire's post-wait work overlaps the step just
+        # dispatched — the only unoverlapped host time is the wait
+        self.step_metrics.on_step(
+            step_ms=total_s * 1e3, new_tokens=new_total,
+            prefill_tokens=0, host_ms=host_ms, device_ms=wait_s * 1e3,
+            overlapped_host_ms=host_ms if prev is not None else 0.0,
+        )
+        return outs
+
+    def _retire_step(self, inflight: _InflightStep):
+        """Retire a dispatched step: the single lagged device_get, then
+        token append / stop checks / latency bookkeeping.  Returns
+        (outputs, new_tokens, seconds spent blocked on the device)."""
+        rec = get_recorder()
+        t_g0, w_g0 = time.perf_counter(), time.time()
+        sampled = self.runner.retire_decode(inflight.handle)
+        wait_s = time.perf_counter() - t_g0
+        finished = self.scheduler.update_from_async_retire(
+            inflight.sched_out, sampled)
+        scheds = inflight.sched_out.decodes
+        # only requests that could have appended a token this retire:
+        # an overshoot row for a request that finished at the PREVIOUS
+        # retire (or was aborted/expired mid-flight) already had its
+        # latency entry popped — setdefault would resurrect it with a
+        # zero token count, re-counting the whole stream into
+        # tokens_generated/TTFT and leaking the entry forever
+        just_finished = {r.request_id for r in finished}
+        live = [s for s in scheds
+                if not s.request.is_finished
+                or s.request.request_id in just_finished]
+        new_total = self._observe_token_latencies(live, finished)
+        dur = time.perf_counter() - t_g0
+        for s in scheds:
+            rec.record(s.request.additional_information.get("trace"),
+                       "retire", w_g0, dur, stage_id=self.stage_id,
+                       args={"batch": len(scheds)})
+        outs = [OmniRequestOutput.from_pipeline(r) for r in finished]
+        return outs, new_total, wait_s
+
+    def _drain_pipeline(self) -> tuple[list[OmniRequestOutput], float]:
+        """Retire the in-flight step (if any) so the host state is fully
+        caught up before a synchronous step runs.  Returns (outputs,
+        seconds blocked on the device) — the caller folds the wait into
+        its step's device time so the host/device breakdown stays
+        honest across pipeline-to-sync transitions."""
+        if self._inflight is None:
+            return [], 0.0
+        inflight, self._inflight = self._inflight, None
+        outs, new_total, wait_s = self._retire_step(inflight)
+        # the drained step has no on_step of its own (the sync step that
+        # follows records this call's single on_step, and its per-request
+        # deltas were already consumed here): credit the tokens directly
+        # so throughput counters stay exact
+        self.step_metrics.tokens_generated += new_total
+        return outs, wait_s
+
+    # --------------------------------------------------- synchronous step
+    def _run_scheduled(self, sched_out: SchedulerOutput, t_step0: float,
+                       skip_on_schedule: bool = False,
+                       drained_wait_s: float = 0.0
+                       ) -> list[OmniRequestOutput]:
+        if not skip_on_schedule:
+            self.step_metrics.on_schedule(
+                waiting=len(self.scheduler.waiting),
+                running=len(self.scheduler.running),
+            )
         if sched_out.num_scheduled == 0:
             if self.scheduler.waiting:
                 if any(r.awaiting_chunks for r in self.scheduler.running):
@@ -462,13 +690,13 @@ class LLMEngine:
                     # the tick counter would error-finish healthy waiting
                     # requests within milliseconds
                     self._starved_ticks = 0
-                    return errored
+                    return []
                 # Transient zero-scheduled ticks happen while pages are
                 # pinned by an in-flight KV-transfer awaiting its ACK —
                 # only declare starvation after a few consecutive ticks.
                 self._starved_ticks += 1
                 if self._starved_ticks < 3:
-                    return errored
+                    return []
                 self._starved_ticks = 0
                 # Starved: the head waiting request can never fit (e.g. its
                 # recompute footprint outgrew the pool). Error-finish it so
@@ -488,8 +716,7 @@ class LLMEngine:
                 # an injected-KV request may already own prefix pages
                 # while WAITING — evicting without freeing would leak them
                 self.scheduler.kv.free(victim)
-                errored.append(OmniRequestOutput.from_pipeline(victim))
-                return errored
+                return [OmniRequestOutput.from_pipeline(victim)]
             stalled = [
                 r for r in self.scheduler.running
                 if not (r.awaiting_chunks
@@ -501,7 +728,7 @@ class LLMEngine:
                     "schedulable"
                 )
             # only streaming requests idling for their next chunk remain
-            return errored
+            return []
         self._starved_ticks = 0
         rec = get_recorder()
         scheduled = sched_out.prefills + sched_out.decodes
@@ -546,7 +773,47 @@ class LLMEngine:
             rec.record(s.request.additional_information.get("trace"),
                        "sampling", w_up0, dur_up, stage_id=self.stage_id,
                        args={"batch": len(scheduled)})
-        # TTFT / ITL / TPOT bookkeeping from the host-visible token deltas
+        new_total = self._observe_token_latencies(scheduled, finished)
+        total_s = time.perf_counter() - t_step0
+        self.step_metrics.on_step(
+            step_ms=total_s * 1e3,
+            new_tokens=new_total,
+            prefill_tokens=sum(s.num_new_tokens
+                               for s in sched_out.prefills),
+            # execute() syncs internally, so its span (plus any
+            # pipeline-drain wait that preceded it) is the device-bound
+            # portion; no host work overlaps it
+            host_ms=max(total_s - dur_ex - drained_wait_s, 0.0) * 1e3,
+            device_ms=(dur_ex + drained_wait_s) * 1e3,
+            overlapped_host_ms=0.0,
+        )
+        if self.config.collect_hidden:
+            # consolidate per-step hidden chunks into the next-stage payload
+            # (reference pooler_output routing, engine/output_processor.py:246)
+            import numpy as np
+
+            for r in finished:
+                chunks = r.additional_information.pop("_hidden_chunks", None)
+                if chunks:
+                    r.multimodal_output["hidden_states"] = np.concatenate(
+                        chunks, axis=0
+                    )
+        if not self.scheduler.has_unfinished:
+            # no further step will run: drain transfers triggered just now
+            # so finished requests still ship their KV
+            for req, block_ids, seq_len in \
+                    self.scheduler.drain_pending_kv_transfers():
+                if self.kv_transfer_sink is not None:
+                    payload = self.runner.extract_kv(block_ids, seq_len)
+                    self.kv_transfer_sink(req, payload)
+                self.scheduler.update_from_output(
+                    SchedulerOutput(), {}, {req.request_id})
+        return [OmniRequestOutput.from_pipeline(r) for r in finished]
+
+    def _observe_token_latencies(self, scheduled, finished) -> int:
+        """TTFT / ITL / TPOT bookkeeping from the host-visible token
+        deltas (shared by the sync step and the async lagged retire);
+        returns the number of new tokens observed."""
         now = time.time()
         sm = self.step_metrics
         new_total = 0
@@ -575,34 +842,7 @@ class LLMEngine:
             n_out = len(req.output_token_ids)
             if st and st[0] and n_out > 1:
                 sm.tpot_ms.observe((now - st[0]) * 1e3 / (n_out - 1))
-        sm.on_step(
-            step_ms=(time.perf_counter() - t_step0) * 1e3,
-            new_tokens=new_total,
-            prefill_tokens=sum(s.num_new_tokens
-                               for s in sched_out.prefills),
-        )
-        if self.config.collect_hidden:
-            # consolidate per-step hidden chunks into the next-stage payload
-            # (reference pooler_output routing, engine/output_processor.py:246)
-            import numpy as np
-
-            for r in finished:
-                chunks = r.additional_information.pop("_hidden_chunks", None)
-                if chunks:
-                    r.multimodal_output["hidden_states"] = np.concatenate(
-                        chunks, axis=0
-                    )
-        if not self.scheduler.has_unfinished:
-            # no further step will run: drain transfers triggered just now
-            # so finished requests still ship their KV
-            for req, block_ids, seq_len in \
-                    self.scheduler.drain_pending_kv_transfers():
-                if self.kv_transfer_sink is not None:
-                    payload = self.runner.extract_kv(block_ids, seq_len)
-                    self.kv_transfer_sink(req, payload)
-                self.scheduler.update_from_output(
-                    SchedulerOutput(), {}, {req.request_id})
-        return errored + [OmniRequestOutput.from_pipeline(r) for r in finished]
+        return new_total
 
     # ---------------------------------------------------------- generate()
     def generate(

@@ -33,6 +33,12 @@ def _add_common(p: argparse.ArgumentParser):
     eng.add_argument("--enable-chunked-prefill", action="store_true",
                      default=None)
     eng.add_argument("--num-speculative-tokens", type=int, default=None)
+    eng.add_argument("--async-scheduling", action="store_true",
+                     default=None,
+                     help="two-slot pipelined engine step: overlap host "
+                          "scheduling/readback with device compute via "
+                          "device-resident sampled tokens (see "
+                          "docs/async_engine.md)")
     p.add_argument(
         "--stats-path", default=None, metavar="PREFIX",
         help="stream per-stage + E2E stats to PREFIX.*.stats.jsonl")
@@ -51,7 +57,8 @@ def _add_common(p: argparse.ArgumentParser):
 
 _ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
                 "max_num_batched_tokens", "dtype", "seed",
-                "enable_chunked_prefill", "num_speculative_tokens")
+                "enable_chunked_prefill", "num_speculative_tokens",
+                "async_scheduling")
 
 
 def _stage_overrides(args) -> dict:

@@ -83,6 +83,26 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "histogram", "Inter-token latency", ("stage",)),
     "engine_step_ms": (
         "histogram", "Engine step wall time", ("stage",)),
+    # step-phase breakdown (async pipelined engine, docs/async_engine.md)
+    "engine_step_host_ms": (
+        "histogram",
+        "Host-side work per engine step (schedule, retire, bookkeeping)",
+        ("stage",)),
+    "engine_step_device_ms": (
+        "histogram",
+        "Device-bound wait per engine step (execute or lagged retire)",
+        ("stage",)),
+    "engine_step_overlap_ratio": (
+        "gauge",
+        "Fraction of host step work overlapped with in-flight device "
+        "compute", ("stage",)),
+    # lifetime counter pairing with engine_step_host_ms_sum: rate()
+    # over any window recovers a WINDOWED overlap ratio, which the
+    # cumulative gauge above hides after long uptime
+    "engine_step_overlapped_host_ms_total": (
+        "counter",
+        "Host step work milliseconds performed while a dispatched "
+        "device step was in flight", ("stage",)),
     "diffusion_requests_total": (
         "counter", "Diffusion requests generated", ("stage",)),
     "diffusion_batches_total": (
@@ -249,6 +269,17 @@ def render_exposition(summary: dict, engine_snaps: dict,
                 exp.histogram(hist_name, labels, h)
         if snap.get("step_ms"):
             exp.histogram("engine_step_ms", labels, snap["step_ms"])
+        if snap.get("host_ms"):
+            exp.histogram("engine_step_host_ms", labels, snap["host_ms"])
+        if snap.get("device_ms"):
+            exp.histogram("engine_step_device_ms", labels,
+                          snap["device_ms"])
+        overlap = snap.get("overlap")
+        if overlap:
+            exp.sample("engine_step_overlap_ratio", labels,
+                       overlap.get("ratio", 0.0))
+            exp.sample("engine_step_overlapped_host_ms_total", labels,
+                       overlap.get("overlapped_host_ms_total", 0.0))
         diff = snap.get("diffusion")
         if diff:
             exp.sample("diffusion_requests_total", labels,

@@ -148,6 +148,12 @@ class EngineStepMetrics:
         self.tpot_ms = Histogram()
         self.itl_ms = Histogram()
         self.step_ms = Histogram()
+        # step-phase breakdown: host-side work vs. device-bound wait per
+        # step, plus how much of the host work ran while a dispatched
+        # step was still computing (the async pipeline's win — see
+        # docs/async_engine.md; sync steps overlap nothing)
+        self.host_ms = Histogram()
+        self.device_ms = Histogram()
         # gauges (last sampled values)
         self.num_waiting = 0
         self.num_running = 0
@@ -155,17 +161,36 @@ class EngineStepMetrics:
         self.num_steps = 0
         self.tokens_generated = 0
         self.prefill_tokens = 0
+        self.host_ms_total = 0.0
+        self.overlapped_host_ms_total = 0.0
 
     def on_schedule(self, waiting: int, running: int) -> None:
         self.num_waiting = waiting
         self.num_running = running
 
     def on_step(self, step_ms: float, new_tokens: int,
-                prefill_tokens: int) -> None:
+                prefill_tokens: int, host_ms: Optional[float] = None,
+                device_ms: Optional[float] = None,
+                overlapped_host_ms: float = 0.0) -> None:
         self.num_steps += 1
         self.tokens_generated += new_tokens
         self.prefill_tokens += prefill_tokens
         self.step_ms.observe(step_ms)
+        if host_ms is not None:
+            self.host_ms.observe(host_ms)
+            self.host_ms_total += host_ms
+            self.overlapped_host_ms_total += min(overlapped_host_ms,
+                                                 host_ms)
+        if device_ms is not None:
+            self.device_ms.observe(device_ms)
+
+    @property
+    def overlap_ratio(self) -> float:
+        """Fraction of host-side step work performed while a dispatched
+        device step was in flight (0 for purely synchronous serving)."""
+        if self.host_ms_total <= 0.0:
+            return 0.0
+        return self.overlapped_host_ms_total / self.host_ms_total
 
     def snapshot(self) -> dict:
         return {
@@ -182,6 +207,14 @@ class EngineStepMetrics:
             "tpot_ms": self.tpot_ms.snapshot(),
             "itl_ms": self.itl_ms.snapshot(),
             "step_ms": self.step_ms.snapshot(),
+            "host_ms": self.host_ms.snapshot(),
+            "device_ms": self.device_ms.snapshot(),
+            "overlap": {
+                "ratio": round(self.overlap_ratio, 4),
+                "host_ms_total": round(self.host_ms_total, 3),
+                "overlapped_host_ms_total": round(
+                    self.overlapped_host_ms_total, 3),
+            },
         }
 
 

@@ -90,6 +90,13 @@ class Request:
     # ----- mutable engine state -----
     status: RequestStatus = RequestStatus.WAITING
     output_token_ids: list[int] = field(default_factory=list)
+    # async pipelined engine: tokens sampled by a dispatched-but-not-yet-
+    # retired step (device-resident, not in output_token_ids yet).  The
+    # scheduler counts them when computing the decode remainder so it can
+    # schedule the NEXT step before the token value reaches the host;
+    # retire decrements, preemption/abort resets (the in-flight token is
+    # discarded and greedily re-derived on recompute).
+    num_inflight_tokens: int = 0
     # per-output-token logprob entries when sampling_params.logprobs is
     # set: {"logprob": float, "top_ids": [...], "top_logprobs": [...]}
     # (spec-decode multi-accept steps skip entries — the verify path

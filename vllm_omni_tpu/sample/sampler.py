@@ -13,6 +13,7 @@ resampling a step is deterministic (needed for spec-decode verify later).
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Optional
 
@@ -33,6 +34,21 @@ class SamplingTensors:
     top_k: jax.Array        # [B] i32 (0 = off)
     top_p: jax.Array        # [B] f32
     keys: jax.Array         # [B, 2] u32 PRNG keys
+    # retained inputs of the key derivation so a cached instance can be
+    # re-keyed for a new step without redoing the host-side assembly
+    # (pure-decode batches keep the same params for hundreds of steps)
+    seeds: Optional[jax.Array] = None   # [B] u32
+    salts: Optional[jax.Array] = None   # [B] u32
+
+    def rekey(self, step: int) -> "SamplingTensors":
+        """Same batch, new step: only the PRNG keys depend on the step
+        index, so a cached instance is reused by swapping keys (one tiny
+        fused dispatch instead of rebuilding four arrays)."""
+        if self.seeds is None or self.salts is None:
+            raise ValueError("rekey needs seeds/salts retained by build()")
+        keys = _build_keys(self.seeds, self.salts,
+                           jnp.asarray(step, jnp.uint32))
+        return dataclasses.replace(self, keys=keys)
 
     @staticmethod
     def build(
@@ -60,13 +76,17 @@ class SamplingTensors:
              for p, s in zip(params, salts)],
             np.uint32,
         )
-        keys = _build_keys(jnp.asarray(seeds), jnp.asarray(salt_arr),
+        seeds_dev = jnp.asarray(seeds)
+        salts_dev = jnp.asarray(salt_arr)
+        keys = _build_keys(seeds_dev, salts_dev,
                            jnp.asarray(step, jnp.uint32))
         return SamplingTensors(
             temperature=jnp.asarray(temp),
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
             keys=jnp.asarray(keys),
+            seeds=seeds_dev,
+            salts=salts_dev,
         )
 
 

@@ -81,6 +81,28 @@ class RunnerOutput:
     kv_extracted_req_ids: set[str] = field(default_factory=set)
 
 
+@dataclass
+class InflightDecode:
+    """Handle for a dispatched-but-not-retired pipelined decode step.
+
+    ``tokens`` stays DEVICE-resident: the next dispatch gathers its
+    input tokens straight from it (no host round trip), and the engine
+    retires it one step later with the single lagged ``device_get``
+    (the async pipeline's whole point — host readback leaves the
+    critical path)."""
+
+    tokens: jax.Array                 # [B_padded] i32, on device
+    rows: dict[str, int]              # request_id -> padded batch row
+
+
+def _params_key(sp: SamplingParams) -> tuple:
+    """The fields SamplingTensors actually consumes, by VALUE — cache
+    keys must not use id(sp): CPython reuses freed addresses, so a
+    recycled request_id could silently hit a stale entry built from a
+    dead request's params."""
+    return (sp.temperature, sp.top_k, sp.top_p, sp.seed)
+
+
 # Bucket-padding rows must be GREEDY: sample_tokens skips its
 # full-vocab-sort sampling branch only when no row has temperature > 0,
 # and default-temperature padding would defeat that fast path for every
@@ -103,8 +125,10 @@ class ARModelRunner:
         max_num_seqs: int = 64,
         mesh=None,  # 1-axis "tp" Mesh => tensor-parallel execution
         multi_step_decode: int = 1,  # decode window per device call
+        async_scheduling: bool = False,  # precompile the dispatch path
     ):
         self.multi_step_decode = max(1, int(multi_step_decode))
+        self.async_scheduling = bool(async_scheduling)
         self.mesh = mesh
         if mesh is not None:
             # Megatron-style TP inside shard_map: heads and MLP columns
@@ -152,6 +176,13 @@ class ARModelRunner:
         # engine-level entropy for unseeded requests (fresh per process
         # unless a seed is pinned for reproducibility)
         self._base_seed = seed if seed is not None else secrets.randbits(31)
+        # host-side hot-path caches: crc32 sampling salts per request_id
+        # and assembled SamplingTensors per batch composition — a
+        # pure-decode batch keeps the same (requests, params) for
+        # hundreds of steps, and _sample_and_record used to rebuild both
+        # every step (only the PRNG keys actually depend on the step)
+        self._salt_cache: dict[str, int] = {}
+        self._st_cache: dict[tuple, tuple] = {}
         # multimodal 3D-RoPE: positions carry 3 streams ([B, 3, S] / [B, 3])
         self.use_mrope = cfg.mrope_sections is not None
 
@@ -211,6 +242,23 @@ class ARModelRunner:
             logits = tfm.logits_from_hidden(params, cfg_, hidden)
             return logits, hidden, new_caches
 
+        def _decode_sample(params, token_ids, kv_caches, positions,
+                           slot_mapping, block_tables, context_lens,
+                           temperature, top_k, top_p, keys):
+            # single-step decode with ON-DEVICE sampling — the sampling
+            # hoist out of _decode_multi's scan body that enables the
+            # async pipelined engine step: the sampled tokens stay
+            # device-resident and feed the NEXT decode dispatch directly,
+            # so jax.device_get moves off the critical path and becomes
+            # a one-step-lagged retire (engine/llm_engine.py)
+            hidden, new_caches = tfm.forward_decode(
+                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
+                block_tables, context_lens,
+            )
+            logits = tfm.logits_from_hidden(params, cfg_, hidden)
+            toks = sample_tokens(logits, temperature, top_k, top_p, keys)
+            return toks, new_caches
+
         ps_ = page_size
 
         def _decode_multi(params, token_ids, kv_caches, positions, gpos,
@@ -252,6 +300,7 @@ class ARModelRunner:
             self._chunk_prefill_fn = jit2(_chunk_prefill)
             self._verify_fn = jit2(_verify)
             self._decode_fn = jit2(_decode)
+            self._decode_sample_fn = jit2(_decode_sample)
             self._decode_multi_fn = jax.jit(
                 _decode_multi, donate_argnums=(2,),
                 static_argnums=(11,))
@@ -286,6 +335,10 @@ class ARModelRunner:
             self._chunk_prefill_fn = wrap(_chunk_prefill, 9, 3)
             self._verify_fn = wrap(_verify, 5, 2)
             self._decode_fn = wrap(_decode, 4, 2)
+            # sampling is deterministic in (logits, keys) and the
+            # per-layer psums make logits replicated, so every shard
+            # samples the same token — same argument as _decode_multi_tp
+            self._decode_sample_fn = wrap(_decode_sample, 8, 1)
 
             # Multi-step decode under TP: the scan lives INSIDE the
             # shard_map body, so the KV carry stays on local shard
@@ -396,6 +449,20 @@ class ARModelRunner:
                     jnp.full((b,), -1, jnp.int32), tables,
                     jnp.ones((b,), jnp.int32))
                 built += 1
+                if self.async_scheduling:
+                    # the async pipeline's dispatch path (forward +
+                    # on-device sampling) is its own executable
+                    t = SamplingTensors.build(
+                        [_PAD_SAMPLING] * b, step=0,
+                        base_seed=self._base_seed)
+                    toks, self.kv_caches = self._decode_sample_fn(
+                        self.params, zeros_b, self.kv_caches,
+                        jnp.zeros(pos_shape(b), jnp.int32),
+                        jnp.full((b,), -1, jnp.int32), tables,
+                        jnp.ones((b,), jnp.int32),
+                        t.temperature, t.top_k, t.top_p, t.keys)
+                    jax.block_until_ready(toks)
+                    built += 1
                 if (self.multi_step_decode > 1
                         and self._decode_multi_fn is not None):
                     t = SamplingTensors.build(
@@ -657,27 +724,36 @@ class ARModelRunner:
         return out
 
     # -------------------------------------------------------------- decode
-    def _run_decode(self, scheds: list[ScheduledRequest], out: RunnerOutput):
-        b = _bucket(len(scheds), self._batch_buckets)
-        token_ids = np.zeros((b,), np.int32)
+    def _assemble_decode_rows(self, scheds: list[ScheduledRequest], b: int):
+        """Padded (positions, slots, tables, ctx) rows for a
+        single-token decode batch — ONE assembly shared by the
+        synchronous decode and the pipelined dispatch, so their input
+        semantics (mrope columns, ctx = start_pos + 1, table
+        truncation) cannot drift apart."""
         positions = (np.zeros((b, 3), np.int32) if self.use_mrope
                      else np.zeros((b,), np.int32))
         slots = np.full((b,), -1, np.int32)
         tables = np.zeros((b, self.max_pages_per_seq), np.int32)
         ctx = np.zeros((b,), np.int32)
         for i, sc in enumerate(scheds):
-            req = sc.request
-            token_ids[i] = req.all_token_ids[sc.start_pos]
             if self.use_mrope:
                 positions[i] = self._mrope_cols(
-                    req, np.asarray([sc.start_pos])
-                )[:, 0]
+                    sc.request, np.asarray([sc.start_pos]))[:, 0]
             else:
                 positions[i] = sc.start_pos
             slots[i] = sc.slot_mapping[0]
             t = sc.block_table[: self.max_pages_per_seq]
             tables[i, : len(t)] = t
             ctx[i] = sc.start_pos + 1
+        return positions, slots, tables, ctx
+
+    def _run_decode(self, scheds: list[ScheduledRequest], out: RunnerOutput):
+        b = _bucket(len(scheds), self._batch_buckets)
+        token_ids = np.zeros((b,), np.int32)
+        for i, sc in enumerate(scheds):
+            token_ids[i] = sc.request.all_token_ids[sc.start_pos]
+        positions, slots, tables, ctx = self._assemble_decode_rows(
+            scheds, b)
         logits, hidden, self.kv_caches = self._decode_fn(
             self.params, jnp.asarray(token_ids), self.kv_caches,
             jnp.asarray(positions), jnp.asarray(slots),
@@ -685,6 +761,100 @@ class ARModelRunner:
         )
         self._sample_and_record(scheds, logits, hidden, out)
         self._maybe_draft(scheds, hidden, out)
+
+    # ------------------------------------------------ pipelined dispatch
+    def dispatch_decode(
+        self, scheds: list[ScheduledRequest],
+        prev: Optional[InflightDecode] = None,
+    ) -> InflightDecode:
+        """Dispatch half of the async pipelined step: launch forward +
+        on-device sampling for a pure single-token decode batch and
+        return WITHOUT waiting.  Input tokens that are not host-visible
+        yet (they were sampled by ``prev``, still in flight) are
+        gathered device-side from ``prev.tokens`` — the device-resident
+        feedback that keeps the host out of the token loop.  The engine
+        retires the handle one step later (``retire_decode``)."""
+        self._step += 1
+        b = _bucket(len(scheds), self._batch_buckets)
+        token_host = np.zeros((b,), np.int32)
+        feed_rows: list[int] = []
+        feed_src: list[int] = []
+        params_list = [_PAD_SAMPLING] * b
+        salts = [0] * b
+        for i, sc in enumerate(scheds):
+            req = sc.request
+            if sc.start_pos < req.num_tokens:
+                token_host[i] = req.all_token_ids[sc.start_pos]
+            else:
+                # input token still in flight from the previous dispatch
+                feed_rows.append(i)
+                feed_src.append(prev.rows[req.request_id])
+            params_list[i] = req.sampling_params
+            salts[i] = self._salt_of(req.request_id)
+        positions, slots, tables, ctx = self._assemble_decode_rows(
+            scheds, b)
+        token_ids = jnp.asarray(token_host)
+        if feed_rows:
+            token_ids = token_ids.at[jnp.asarray(feed_rows)].set(
+                prev.tokens[jnp.asarray(feed_src)])
+        key = ("dispatch", b) + tuple(
+            (sc.request.request_id,) + _params_key(
+                sc.request.sampling_params) for sc in scheds)
+        tensors = self._sampling_tensors(key, params_list, salts)
+        toks, self.kv_caches = self._decode_sample_fn(
+            self.params, token_ids, self.kv_caches,
+            jnp.asarray(positions), jnp.asarray(slots),
+            jnp.asarray(tables), jnp.asarray(ctx),
+            tensors.temperature, tensors.top_k, tensors.top_p,
+            tensors.keys,
+        )
+        return InflightDecode(
+            tokens=toks,
+            rows={sc.request.request_id: i for i, sc in enumerate(scheds)},
+        )
+
+    def retire_decode(self, handle: InflightDecode) -> dict[str, int]:
+        """Retire half: the ONE host readback of a pipelined step,
+        lagged a full step behind dispatch so it overlaps the next
+        step's device compute instead of serializing against it."""
+        # omnilint: disable=OL2 - the single lagged retire sync of the
+        # async pipeline: by the time the engine calls this, the NEXT
+        # step is already dispatched, so this get overlaps its compute
+        toks = np.asarray(jax.device_get(handle.tokens))
+        return {rid: int(toks[i]) for rid, i in handle.rows.items()}
+
+    # ----------------------------------------------- sampling host caches
+    def _salt_of(self, request_id: str) -> int:
+        """Cached zlib.crc32 sampling salt (recomputing it for every
+        request every step was measurable in the step-phase breakdown)."""
+        s = self._salt_cache.get(request_id)
+        if s is None:
+            if len(self._salt_cache) > 8192:
+                self._salt_cache.clear()
+            s = self._salt_cache[request_id] = zlib.crc32(
+                request_id.encode())
+        return s
+
+    def _sampling_tensors(self, key: tuple, params_list, salts
+                          ) -> SamplingTensors:
+        """SamplingTensors for this batch, reused across steps while the
+        (request set, params) composition is unchanged.  Only the PRNG
+        keys fold the step index, so a cache hit re-keys in one tiny
+        dispatch — and an all-greedy batch (keys unused by argmax) skips
+        even that."""
+        hit = self._st_cache.get(key)
+        if hit is not None:
+            tensors, any_sampling = hit
+            return tensors.rekey(self._step) if any_sampling else tensors
+        tensors = SamplingTensors.build(
+            params_list, step=self._step, base_seed=self._base_seed,
+            salts=salts,
+        )
+        if len(self._st_cache) > 8:
+            self._st_cache.clear()
+        self._st_cache[key] = (
+            tensors, any(p.temperature > 0.0 for p in params_list))
+        return tensors
 
     # ---------------------------------------------------- multi-step decode
     def _run_decode_multi(self, scheds: list[ScheduledRequest], w: int,
@@ -716,11 +886,11 @@ class ARModelRunner:
             t = sc.block_table[: self.max_pages_per_seq]
             tables[i, : len(t)] = t
             params_list[i] = req.sampling_params
-            salts[i] = zlib.crc32(req.request_id.encode())
-        tensors = SamplingTensors.build(
-            params_list, step=self._step, base_seed=self._base_seed,
-            salts=salts,
-        )
+            salts[i] = self._salt_of(req.request_id)
+        key = ("multi", b) + tuple(
+            (sc.request.request_id,) + _params_key(
+                sc.request.sampling_params) for sc in scheds)
+        tensors = self._sampling_tensors(key, params_list, salts)
         toks, self.kv_caches = self._decode_multi_fn(
             self.params, jnp.asarray(token_ids), self.kv_caches,
             jnp.asarray(positions), jnp.asarray(gpos),
@@ -856,6 +1026,10 @@ class ARModelRunner:
         the main sampler."""
         sp = req.sampling_params
         seed = sp.seed if sp.seed is not None else self._base_seed
+        # plain crc32 (not _salt_of): this method is driven standalone
+        # in tests with a bare namespace, and it runs once per sampled
+        # request per verify step — not the per-step hot loop the salt
+        # cache exists for
         salt = zlib.crc32(req.request_id.encode())
         rng = np.random.default_rng((seed, salt, self._step))
         acc: list[int] = []
@@ -981,11 +1155,12 @@ class ARModelRunner:
             salts = [0] * b_padded
             for i, sc in sampling:
                 params[i] = sc.request.sampling_params
-                salts[i] = zlib.crc32(sc.request.request_id.encode())
-            tensors = SamplingTensors.build(
-                params, step=self._step, base_seed=self._base_seed,
-                salts=salts,
-            )
+                salts[i] = self._salt_of(sc.request.request_id)
+            key = ("single", b_padded) + tuple(
+                (i, sc.request.request_id)
+                + _params_key(sc.request.sampling_params)
+                for i, sc in sampling)
+            tensors = self._sampling_tensors(key, params, salts)
             tokens = sample_tokens(
                 logits, tensors.temperature, tensors.top_k,
                 tensors.top_p, tensors.keys,
