@@ -34,6 +34,9 @@ on the flagship itself) / OMNI_BENCH_PEAK_TFLOPS / OMNI_BENCH_BUDGET_S
 OMNI_BENCH_SKIP_AR=1 / OMNI_BENCH_AR_ASYNC=1 (AR bench runs the async
 pipelined step instead of the multi-step window; the emitted
 "step_phase" block reports host/device ms + overlap ratio either way) /
+OMNI_BENCH_AR_UNIFIED=1 (unified ragged mixed batching: one token-packed
+dispatch per mixed step; step_phase reports padding efficiency either
+way, so split vs unified runs are directly comparable) /
 OMNI_BENCH_SKIP_CACHE_VARIANT=1 /
 OMNI_BENCH_QUANT (int8|fp8 weight-only on the flagship; int8 halves the
 streamed transfer bytes) / OMNI_BENCH_SKIP_QUANT_VARIANT=1.
@@ -469,12 +472,18 @@ def bench_ar() -> dict:
     # device-resident sampled tokens (docs/async_engine.md); the
     # step-phase breakdown below makes the two modes comparable
     use_async = os.environ.get("OMNI_BENCH_AR_ASYNC", "") == "1"
+    # OMNI_BENCH_AR_UNIFIED=1: mixed prefill+decode steps run as ONE
+    # token-packed ragged dispatch (docs/ragged_batching.md); the
+    # step_phase padding_efficiency line quantifies the win over the
+    # split path's (batch, seq) bucket padding
+    use_unified = os.environ.get("OMNI_BENCH_AR_UNIFIED", "") == "1"
     engine = LLMEngine(params, cfg, EngineConfig(
         num_pages=64 * n_reqs, page_size=16, max_model_len=2048,
         max_num_seqs=n_reqs, max_num_batched_tokens=mbt,
         dtype=jnp.bfloat16,
         multi_step_decode=1 if use_async else w,
         async_scheduling=use_async,
+        unified_batching=use_unified,
     ))
 
     rng = np.random.default_rng(0)
@@ -575,6 +584,11 @@ def bench_ar() -> dict:
         "host_ms_total": round(sm.host_ms_total, 1),
         "overlapped_host_ms_total": round(sm.overlapped_host_ms_total, 1),
         "overlap_ratio": round(sm.overlap_ratio, 4),
+        # useful tokens / padded device rows over the whole run — the
+        # number the unified ragged path exists to raise
+        "padding_efficiency": round(sm.padding_efficiency, 4),
+        "useful_tokens_total": sm.useful_tokens_total,
+        "padded_tokens_total": sm.padded_tokens_total,
     }
     return {
         "metric": "qwen3_omni_thinker_tok_per_sec_chip",
@@ -605,6 +619,7 @@ def bench_ar() -> dict:
             "moe_intermediate": cfg.moe_intermediate_size,
             "multi_step_decode": 1 if use_async else w,
             "async_scheduling": use_async,
+            "unified_batching": use_unified,
             "max_num_seqs": n_reqs,
             "max_num_batched_tokens": mbt,
             "note": "bench-scale thinker (real 30B-A3B is 60 GB bf16 — "

@@ -1,0 +1,25 @@
+#!/usr/bin/env sh
+# Unified-ragged-batching gate: the ragged paged-attention kernel vs its
+# XLA reference oracle (GQA, empty-seq, 1-token decode rows, page/
+# q-block boundary lengths, interpret mode) plus the engine-level
+# contract — unified-vs-split greedy bit-equality on staggered mixed
+# waves, ONE device dispatch per mixed step, chunked-prefill resume,
+# preemption mid-chunk, async+unified pipelining, prefix-cache feeding,
+# padding-efficiency improvement.
+#
+# Standalone face of the same coverage tier-1 carries (tests/ops and
+# tests/engine are fast directories), sitting next to
+# scripts/asyncstep.sh, scripts/omnilint.sh and scripts/faultmatrix.sh
+# as a pre-merge gate:
+#
+#   scripts/ragged.sh                # ragged kernel + unified engine
+#   scripts/ragged.sh -k dispatch    # pass-through pytest args
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU: the oracle compares bit-identical greedy streams on the
+# fake-device path; it must never touch a real chip a colocated serving
+# process owns
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/ops/test_ragged_paged_attention.py \
+    tests/engine/test_unified_batch.py \
+    -q -p no:cacheprovider -m "not slow" "$@"

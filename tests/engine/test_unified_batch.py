@@ -1,0 +1,323 @@
+"""Unified ragged mixed batching: ONE token-packed device dispatch for
+prefill + decode (ops/ragged_paged_attention.py through
+ARModelRunner._unified_fn), greedy bit-identical to the split path, and
+— with async_scheduling — mixed steps that stay pipelined instead of
+draining (docs/ragged_batching.md)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return params, cfg
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(num_pages=64, page_size=4, max_model_len=128,
+                    max_num_seqs=8, max_num_batched_tokens=32,
+                    dtype=jnp.float32)
+    defaults.update(kw)
+    return LLMEngine(params, cfg, EngineConfig(**defaults))
+
+
+PROMPTS = [[1, 5, 9, 2, 7], [3, 3, 8], [11, 4, 6, 1, 2, 9, 5],
+           [9, 9, 1, 2], [7, 1], [2, 4, 8, 16, 32, 1]]
+GREEDY = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
+
+
+def _spy_execute(eng):
+    """Record (prefills, decodes, device dispatches) per execute call."""
+    records = []
+    orig = eng.runner.execute
+
+    def spy(sched_out, **kw):
+        d0 = eng.runner.dispatch_count
+        out = orig(sched_out, **kw)
+        records.append((len(sched_out.prefills), len(sched_out.decodes),
+                        eng.runner.dispatch_count - d0))
+        return out
+
+    eng.runner.execute = spy
+    return records
+
+
+def _run_staggered(eng, sp=GREEDY, late=((2, (2, 3)), (4, (4,)))):
+    """Two arrival waves land while earlier requests decode — every
+    step between waves is a MIXED prefill+decode batch."""
+    late = dict(late)
+    outs = {}
+    eng.add_request(PROMPTS[0], sp, request_id="r0")
+    eng.add_request(PROMPTS[1], sp, request_id="r1")
+    steps = 0
+    while eng.has_unfinished_requests:
+        for o in eng.step():
+            outs[o.request_id] = o.outputs[0].token_ids
+        steps += 1
+        for idx in late.pop(steps, ()):
+            eng.add_request(PROMPTS[idx], sp, request_id=f"r{idx}")
+    return outs
+
+
+# ------------------------------------------------------- equality oracle
+def test_unified_greedy_matches_split_batch(tiny_model):
+    params, cfg = tiny_model
+    base = _engine(params, cfg).generate(PROMPTS[:4], GREEDY)
+    outs = _engine(params, cfg, unified_batching=True).generate(
+        PROMPTS[:4], GREEDY)
+    for b, u in zip(base, outs):
+        assert u.outputs[0].token_ids == b.outputs[0].token_ids
+
+
+def test_unified_greedy_matches_split_staggered_mixed(tiny_model):
+    params, cfg = tiny_model
+    split = _run_staggered(_engine(params, cfg))
+    eng = _engine(params, cfg, unified_batching=True)
+    records = _spy_execute(eng)
+    uni = _run_staggered(eng)
+    assert split == uni
+    mixed = [r for r in records if r[0] and r[1]]
+    assert mixed, "staggered waves never produced a mixed batch"
+
+
+def test_mixed_step_is_one_device_dispatch(tiny_model):
+    """The tentpole contract: a mixed prefill+decode step executes as
+    ONE device dispatch under unified batching (the split path needs
+    one per variant)."""
+    params, cfg = tiny_model
+    eng = _engine(params, cfg, unified_batching=True)
+    records = _spy_execute(eng)
+    _run_staggered(eng)
+    mixed = [r for r in records if r[0] and r[1]]
+    assert mixed
+    assert all(r[2] == 1 for r in mixed), records
+    # and the split engine pays >= 2 dispatches for the same steps
+    eng_s = _engine(params, cfg)
+    records_s = _spy_execute(eng_s)
+    _run_staggered(eng_s)
+    mixed_s = [r for r in records_s if r[0] and r[1]]
+    assert mixed_s and all(r[2] >= 2 for r in mixed_s), records_s
+
+
+def test_unified_sampled_seeded_reproducible(tiny_model):
+    params, cfg = tiny_model
+    sp = SamplingParams(temperature=0.9, seed=11, max_tokens=8,
+                        ignore_eos=True)
+    a = _engine(params, cfg, unified_batching=True).generate(
+        PROMPTS[:3], sp)
+    b = _engine(params, cfg, unified_batching=True).generate(
+        PROMPTS[:3], sp)
+    for x, y in zip(a, b):
+        assert x.outputs[0].token_ids == y.outputs[0].token_ids
+
+
+# -------------------------------------------------- chunked prefill rides
+def test_chunked_prefill_is_the_mechanism(tiny_model):
+    """unified_batching implies chunking: a prompt longer than the step
+    budget is accepted and chunked WITHOUT enable_chunked_prefill."""
+    params, cfg = tiny_model
+    long_prompt = [(i % 13) + 1 for i in range(40)]
+    base = _engine(params, cfg, enable_chunked_prefill=True,
+                   max_num_batched_tokens=16).generate(
+        [long_prompt], GREEDY)
+    eng = _engine(params, cfg, unified_batching=True,
+                  max_num_batched_tokens=16)
+    outs = eng.generate([long_prompt], GREEDY)
+    assert outs[0].outputs[0].token_ids == base[0].outputs[0].token_ids
+
+
+def test_chunk_resume_after_preemption_mid_chunk(tiny_model):
+    """Page pressure preempts a request mid-prefill; its recompute
+    resumes through the unified path, token-identical to split."""
+    params, cfg = tiny_model
+    kw = dict(num_pages=12, max_num_seqs=4, max_num_batched_tokens=16,
+              enable_prefix_caching=False)
+    long_a = [(i % 11) + 1 for i in range(30)]
+    long_b = [(i % 7) + 2 for i in range(24)]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    def run(**extra):
+        eng = _engine(params, cfg, **kw, **extra)
+        outs = {}
+        eng.add_request(long_a, sp, request_id="a")
+        steps = 0
+        while eng.has_unfinished_requests:
+            for o in eng.step():
+                outs[o.request_id] = o.outputs[0].token_ids
+            steps += 1
+            if steps == 1:
+                eng.add_request(long_b, sp, request_id="b")
+        return eng, outs
+
+    eng_s, split = run(enable_chunked_prefill=True)
+    eng_u, uni = run(unified_batching=True)
+    assert split == uni
+    # the tight pool must actually have exercised preemption, and every
+    # page must come home
+    assert eng_u.scheduler.num_preemptions > 0
+    assert eng_u.scheduler.kv.num_free_pages == 12
+
+
+def test_prefix_cache_hit_feeds_unified_step(tiny_model):
+    """An APC prefix hit resumes mid-prompt: the remainder chunk rides
+    the unified executable (start_pos > 0), token-identical to split."""
+    params, cfg = tiny_model
+    shared = [5, 3, 7, 1, 9, 2, 4, 6]  # two full pages at page_size=4
+    prompt = shared + [8, 8]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    def run(**extra):
+        eng = _engine(params, cfg, max_num_batched_tokens=32, **extra)
+        first = eng.generate([prompt], sp)[0].outputs[0].token_ids
+        hits0 = eng.scheduler.kv.prefix_hits
+        second = eng.generate([prompt], sp)[0].outputs[0].token_ids
+        assert eng.scheduler.kv.prefix_hits > hits0, "no APC hit"
+        return first, second
+
+    sf, ss = run()
+    uf, us = run(unified_batching=True)
+    assert sf == uf and ss == us
+    assert sf == ss  # cached prefix must not change the stream
+
+
+# ------------------------------------------------------- async pipeline
+def test_async_unified_matches_sync_and_pipelines_prefills(tiny_model):
+    params, cfg = tiny_model
+    split = _run_staggered(_engine(params, cfg))
+    eng = _engine(params, cfg, unified_batching=True,
+                  async_scheduling=True)
+    dispatched = []
+    orig = eng.runner.dispatch_unified
+    eng.runner.dispatch_unified = lambda so, prev=None: (
+        dispatched.append((len(so.prefills), len(so.decodes)))
+        or orig(so, prev))
+    asy = _run_staggered(eng)
+    assert split == asy
+    # mixed batches were DISPATCHED (pipelined), not drained
+    assert any(p and d for p, d in dispatched), dispatched
+    assert "prefill" not in eng.async_fallback, eng.async_fallback
+
+
+def test_async_unified_stop_token_overshoot(tiny_model):
+    """A stop token lands while the next (possibly mixed) step is in
+    flight: the overshoot token is discarded, streams match sync, and
+    the page pool drains to empty."""
+    params, cfg = tiny_model
+    probe = _engine(params, cfg).generate([PROMPTS[0]], GREEDY)
+    stop = probe[0].outputs[0].token_ids[4]
+    sp = SamplingParams(temperature=0.0, max_tokens=10,
+                        stop_token_ids=[stop])
+    split = _run_staggered(_engine(params, cfg), sp=sp)
+    eng = _engine(params, cfg, unified_batching=True,
+                  async_scheduling=True)
+    asy = _run_staggered(eng, sp=sp)
+    assert split == asy
+    assert eng.scheduler.kv.num_free_pages == 64
+
+
+def test_async_fallback_reasons_are_granular(tiny_model):
+    """Per-reason drain counters: a logprobs request shows up as
+    'logprobs', not as an aggregate; under async WITHOUT unified the
+    same workload drains with reason 'prefill'."""
+    params, cfg = tiny_model
+    sp_lp = SamplingParams(temperature=0.0, max_tokens=4,
+                           ignore_eos=True, logprobs=2)
+    eng = _engine(params, cfg, unified_batching=True,
+                  async_scheduling=True)
+    eng.generate([PROMPTS[0]], sp_lp)
+    assert eng.async_fallback.get("logprobs"), eng.async_fallback
+    eng2 = _engine(params, cfg, async_scheduling=True)
+    _run_staggered(eng2)
+    assert eng2.async_fallback.get("prefill"), eng2.async_fallback
+
+
+# ----------------------------------------------------- fallback matrix
+def test_logprobs_request_falls_back_to_split(tiny_model):
+    params, cfg = tiny_model
+    sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True,
+                        logprobs=2)
+    base = _engine(params, cfg).generate(PROMPTS[:2], sp)
+    outs = _engine(params, cfg, unified_batching=True).generate(
+        PROMPTS[:2], sp)
+    for b, u in zip(base, outs):
+        assert u.outputs[0].token_ids == b.outputs[0].token_ids
+        # logprobs still populated (split path served the batch)
+        assert u.outputs[0].logprobs and len(u.outputs[0].logprobs) == 5
+
+
+# ------------------------------------------------------------- metrics
+def test_padding_efficiency_improves_on_ragged_prefill(tiny_model):
+    """Ragged prompt lengths: the split path pays (batch, seq) bucket
+    padding, the unified path only token-block alignment — the exported
+    padding-efficiency must strictly improve."""
+    params, cfg = tiny_model
+    prompts = [[(i % 9) + 1 for i in range(n)] for n in (33, 47, 18, 25)]
+    sp = SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True)
+    kw = dict(max_num_batched_tokens=128, max_model_len=128,
+              num_pages=128)
+    eng_s = _engine(params, cfg, **kw)
+    eng_s.generate(prompts, sp)
+    eng_u = _engine(params, cfg, unified_batching=True, **kw)
+    eng_u.generate(prompts, sp)
+    eff_s = eng_s.step_metrics.padding_efficiency
+    eff_u = eng_u.step_metrics.padding_efficiency
+    assert 0.0 < eff_s < 1.0
+    assert eff_u > eff_s, (eff_u, eff_s)
+
+
+def test_metrics_snapshot_and_exposition(tiny_model):
+    """Padding, batched-tokens, compile, and fallback series render and
+    validate against METRIC_SPECS (the OL6 drift-guard surface)."""
+    from vllm_omni_tpu.metrics.prometheus import (
+        render_exposition,
+        validate_exposition,
+    )
+
+    params, cfg = tiny_model
+    eng = _engine(params, cfg, unified_batching=True,
+                  async_scheduling=True)
+    _run_staggered(eng)
+    snap = eng.metrics_snapshot()
+    assert snap["padding"]["padded_tokens_total"] > 0
+    assert 0.0 < snap["padding"]["efficiency"] <= 1.0
+    assert snap["batched_tokens"]["count"] > 0
+    assert snap["compile"]["compiles"] > 0
+    assert snap["compile"]["cache_hits"] > 0
+    text = render_exposition({}, {0: snap})
+    assert validate_exposition(text) == []
+    for needle in ("engine_step_padding_efficiency",
+                   "engine_step_batched_tokens_count",
+                   "jit_compiles_total",
+                   "jit_compile_seconds_total"):
+        assert needle in text, needle
+
+
+def test_warmup_precompiles_token_buckets(tiny_model):
+    """Unified warmup walks the 1-D token-bucket line; traffic at any
+    packed size then hits the shape cache (no mid-traffic compiles)."""
+    params, cfg = tiny_model
+    eng = _engine(params, cfg, unified_batching=True, warmup=True)
+    compiles_after_warmup = eng.runner.compile_stats["compiles"]
+    assert compiles_after_warmup >= len(eng.runner._token_buckets)
+    _run_staggered(eng)
+    assert eng.runner.compile_stats["compiles"] == compiles_after_warmup
+
+
+# ------------------------------------------------------------------ TP
+@pytest.mark.slow
+def test_unified_tp_token_identical(tiny_model):
+    """Unified ragged step under tensor parallelism (shard_map wrap,
+    local head shapes) matches the single-device split path."""
+    params, cfg = tiny_model
+    split = _run_staggered(_engine(params, cfg))
+    eng = _engine(params, cfg, unified_batching=True,
+                  tensor_parallel_size=2)
+    uni = _run_staggered(eng)
+    assert split == uni

@@ -1,0 +1,208 @@
+"""Ragged paged attention: kernel vs XLA reference oracle across ragged
+shapes — mixed decode + prefill chunks, GQA, empty sequences, 1-token
+decode rows, page-boundary and q-block-boundary lengths — all in Pallas
+interpret mode on CPU (conftest sets OMNI_TPU_PALLAS_INTERPRET=1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.ops import (
+    attention_ref,
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
+    write_kv_cache,
+)
+from vllm_omni_tpu.ops.paged_attention import init_kv_cache
+from vllm_omni_tpu.ops.ragged_paged_attention import align_to_block
+
+TB = 8  # DEFAULT_TOKEN_BLOCK
+
+
+def _pack(specs, h, hkv, d, page, s_max, max_pages, seed=0):
+    """Build a token-packed ragged batch from per-seq (ctx_len, q_len)
+    specs.  Returns (q, k_cache, v_cache, page_tables, cu_q_lens,
+    q_lens, seq_lens, num_seqs, dense) where ``dense`` holds each
+    sequence's full dense K/V [ctx, Hkv, D] for the oracle."""
+    rng = np.random.default_rng(seed)
+    n = len(specs)
+    assert n <= s_max
+    cu = np.zeros(s_max + 1, np.int32)
+    q_lens = np.zeros(s_max, np.int32)
+    seq_lens = np.zeros(s_max, np.int32)
+    tables = np.zeros((s_max, max_pages), np.int32)
+    total = 0
+    next_page = 1  # page 0 stays unused: catches stray page-0 reads
+    num_pages = 1 + sum(-(-c // page) for c, _ in specs) + 1
+    (kc, vc), = init_kv_cache(1, num_pages, page, hkv, d, jnp.float32)
+    dense = []
+    for i, (ctx, qn) in enumerate(specs):
+        assert qn <= ctx
+        cu[i] = total
+        q_lens[i] = qn
+        seq_lens[i] = ctx
+        total += align_to_block(qn, TB)
+        pn = -(-ctx // page)
+        ids = list(range(next_page, next_page + pn))
+        next_page += pn
+        tables[i, :pn] = ids
+        k_dense = rng.standard_normal((ctx, hkv, d)).astype(np.float32)
+        v_dense = rng.standard_normal((ctx, hkv, d)).astype(np.float32)
+        dense.append((k_dense, v_dense))
+        slots = np.asarray(
+            [ids[p // page] * page + p % page for p in range(ctx)],
+            np.int32)
+        kc, vc = write_kv_cache(kc, vc, jnp.asarray(k_dense),
+                                jnp.asarray(v_dense), jnp.asarray(slots))
+    cu[n:] = total
+    t_padded = align_to_block(max(total, TB), TB)
+    q = np.zeros((t_padded, h, d), np.float32)
+    for i, (ctx, qn) in enumerate(specs):
+        q[cu[i]: cu[i] + qn] = rng.standard_normal(
+            (qn, h, d)).astype(np.float32)
+    return (jnp.asarray(q), kc, vc, jnp.asarray(tables),
+            jnp.asarray(cu), jnp.asarray(q_lens), jnp.asarray(seq_lens),
+            n, dense)
+
+
+def _oracle(q, cu, q_lens, seq_lens, dense, h, d):
+    """Per-sequence dense causal attention (attention_ref with the
+    cached prefix as leading keys) laid back into the packed rows."""
+    out = np.zeros((q.shape[0], h, d), np.float32)
+    for i, (k_dense, v_dense) in enumerate(dense):
+        qn = int(q_lens[i])
+        if qn == 0:
+            continue
+        lo = int(cu[i])
+        ctx = int(seq_lens[i])
+        # suffix alignment: queries are the LAST qn positions of ctx
+        o = attention_ref(
+            jnp.asarray(q)[None, lo: lo + qn],
+            jnp.asarray(k_dense[:ctx])[None],
+            jnp.asarray(v_dense[:ctx])[None],
+            causal=True,
+        )[0]
+        out[lo: lo + qn] = np.asarray(o)
+    return out
+
+
+CASES = {
+    "mixed": [(24, 9), (1, 1), (13, 13), (30, 1)],
+    "decode_only": [(9, 1), (4, 1), (14, 1)],
+    "prefill_only": [(16, 16), (11, 11)],
+    "chunk_resume": [(20, 5), (17, 12)],   # later chunks of a prefill
+    "page_boundary": [(8, 8), (16, 1), (4, 4)],   # page=4 multiples
+    "block_boundary": [(8, 8), (24, 16), (9, 9)],  # q-block multiples +1
+    "single": [(5, 5)],
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_matches_dense_oracle(name, use_pallas):
+    h, hkv, d, page = 4, 2, 32, 4
+    specs = CASES[name]
+    (q, kc, vc, tables, cu, q_lens, seq_lens, n, dense) = _pack(
+        specs, h, hkv, d, page, s_max=6, max_pages=12,
+        seed=sum(map(ord, name)) % 97)
+    got = ragged_paged_attention(
+        q, kc, vc, tables, cu, q_lens, seq_lens, n,
+        use_pallas=use_pallas)
+    want = _oracle(q, cu, q_lens, seq_lens, dense, h, d)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                               atol=2e-5)
+    # padding rows (segment tails + trailing) come back exactly zero
+    mask = np.zeros(q.shape[0], bool)
+    for i in range(n):
+        mask[int(cu[i]): int(cu[i]) + int(q_lens[i])] = True
+    assert np.all(np.asarray(got)[~mask] == 0.0)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_gqa_group_wider(use_pallas):
+    """H == 4 * Hkv: every kv head serves 4 query heads in one block."""
+    h, hkv, d, page = 8, 2, 32, 8
+    specs = [(17, 17), (9, 1), (25, 10)]
+    (q, kc, vc, tables, cu, q_lens, seq_lens, n, dense) = _pack(
+        specs, h, hkv, d, page, s_max=4, max_pages=8, seed=3)
+    got = ragged_paged_attention(
+        q, kc, vc, tables, cu, q_lens, seq_lens, n,
+        use_pallas=use_pallas)
+    want = _oracle(q, cu, q_lens, seq_lens, dense, h, d)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_empty_and_padded_seq_rows(use_pallas):
+    """num_seqs < metadata width, plus an explicit zero-length sequence
+    row in the middle: both contribute nothing and corrupt nothing."""
+    h, hkv, d, page = 4, 2, 32, 4
+    (q, kc, vc, tables, cu, q_lens, seq_lens, n, dense) = _pack(
+        [(12, 4), (6, 1)], h, hkv, d, page, s_max=5, max_pages=6, seed=11)
+    # splice a zero-length "sequence" between the two real ones
+    cu = np.asarray(cu).copy()
+    q_lens = np.asarray(q_lens).copy()
+    seq_lens = np.asarray(seq_lens).copy()
+    cu2 = np.array([cu[0], cu[1], cu[1], cu[2], cu[2], cu[2]], np.int32)
+    ql2 = np.array([q_lens[0], 0, q_lens[1], 0, 0], np.int32)
+    sl2 = np.array([seq_lens[0], 0, seq_lens[1], 0, 0], np.int32)
+    tb2 = np.asarray(tables).copy()
+    tb2[2] = tb2[1]
+    tb2[1] = 0
+    got = ragged_paged_attention(
+        q, kc, vc, jnp.asarray(tb2), jnp.asarray(cu2),
+        jnp.asarray(ql2), jnp.asarray(sl2), 3, use_pallas=use_pallas)
+    want = _oracle(q, cu, q_lens, seq_lens, dense, h, d)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_kernel_matches_ref_exactly_shaped():
+    """Kernel (interpret) vs the XLA ref on the same inputs — the pair
+    the engine's auto-dispatch switches between."""
+    h, hkv, d, page = 4, 2, 32, 4
+    (q, kc, vc, tables, cu, q_lens, seq_lens, n, _) = _pack(
+        CASES["mixed"], h, hkv, d, page, s_max=6, max_pages=12, seed=42)
+    kern = ragged_paged_attention(
+        q, kc, vc, tables, cu, q_lens, seq_lens, n, use_pallas=True)
+    ref = ragged_paged_attention_ref(
+        q, kc, vc, tables, cu, q_lens, seq_lens, n)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_row_equals_paged_attention():
+    """A 1-token ragged row reproduces the dedicated decode kernel's
+    semantics (same cache, same tables)."""
+    from vllm_omni_tpu.ops import paged_attention_ref
+
+    h, hkv, d, page = 4, 2, 32, 4
+    (q, kc, vc, tables, cu, q_lens, seq_lens, n, _) = _pack(
+        CASES["decode_only"], h, hkv, d, page, s_max=4, max_pages=6,
+        seed=7)
+    got = ragged_paged_attention(
+        q, kc, vc, tables, cu, q_lens, seq_lens, n, use_pallas=True)
+    q_rows = jnp.stack([q[int(cu[i])] for i in range(n)])  # [B, H, D]
+    want = paged_attention_ref(
+        q_rows, kc, vc, tables[:n], seq_lens[:n])
+    for i in range(n):
+        np.testing.assert_allclose(
+            np.asarray(got)[int(cu[i])], np.asarray(want)[i],
+            rtol=2e-5, atol=2e-5)
+
+
+def test_num_seqs_zero():
+    h, hkv, d, page = 4, 2, 32, 4
+    (q, kc, vc, tables, cu, q_lens, seq_lens, _, _) = _pack(
+        [(8, 4)], h, hkv, d, page, s_max=3, max_pages=4, seed=1)
+    # an empty batch is all zeros on both paths (every block is a
+    # padding block and padding blocks are zeroed)
+    got = ragged_paged_attention_ref(
+        q, kc, vc, tables, cu, q_lens, seq_lens, 0)
+    assert np.all(np.asarray(got) == 0.0)
+    kern = ragged_paged_attention(
+        q, kc, vc, tables, cu, q_lens, seq_lens, 0, use_pallas=True)
+    assert kern.shape == q.shape
+    assert np.all(np.asarray(kern) == 0.0)

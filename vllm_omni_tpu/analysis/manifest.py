@@ -23,6 +23,10 @@ from __future__ import annotations
 HOT_PATHS: tuple[str, ...] = (
     "vllm_omni_tpu/core/",
     "vllm_omni_tpu/ops/",
+    # the ragged unified kernel is covered by the ops/ prefix above;
+    # listed explicitly because a stray host sync inside the ONE
+    # dispatch serving a whole mixed step stalls every request at once
+    "vllm_omni_tpu/ops/ragged_paged_attention.py",
     "vllm_omni_tpu/sample/",
     "vllm_omni_tpu/worker/",
     "vllm_omni_tpu/engine/",
@@ -47,7 +51,11 @@ BENCH_PATHS: tuple[str, ...] = (
     # async pipelined step: the engine's dispatch/retire halves and the
     # runner's dispatch_decode/retire_decode time host vs. device phases
     # for the overlap metrics — OL4 watches that any wall-clock pair
-    # around a jax dispatch in them syncs (or says why it must not)
+    # around a jax dispatch in them syncs (or says why it must not).
+    # model_runner.py also carries the unified ragged dispatch
+    # (_run_unified/dispatch_unified) and the compile-telemetry timing
+    # in _run_jit, whose fresh-compile branch must block_until_ready
+    # before stopping the clock
     "vllm_omni_tpu/engine/llm_engine.py",
     "vllm_omni_tpu/worker/model_runner.py",
 )

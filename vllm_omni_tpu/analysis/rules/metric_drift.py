@@ -56,6 +56,12 @@ def synthetic_engine_snapshot() -> dict:
         "step_ms": hist, "host_ms": hist, "device_ms": hist,
         "overlap": {"ratio": 0.75, "host_ms_total": 40.0,
                     "overlapped_host_ms_total": 30.0},
+        "batched_tokens": hist,
+        "padding": {"useful_tokens_total": 42, "padded_tokens_total": 64,
+                    "efficiency": 0.6563},
+        "compile": {"compiles": 9, "cache_hits": 120,
+                    "compile_s": 33.5},
+        "async_fallback": {"prefill": 4, "kv_transfer": 1},
         "scheduler": {"waiting": 1, "running": 2, "preemptions": 1,
                       "rejections": 0},
         "kv": {"pages_total": 64, "pages_used": 8, "utilization": 0.125},

@@ -58,6 +58,18 @@ class SchedulerConfig:
     # backend ships the same idea).  The scheduler's part is allocating
     # KV pages for the whole window up front.
     multi_step_decode: int = 1
+    # unified ragged batching: emit ONE token-budgeted mixed batch per
+    # step — decodes claim the budget first, prefill chunks fill the
+    # remainder (chunked prefill becomes the mechanism, not an opt-in
+    # special case) — which the runner packs into a single ragged
+    # device dispatch (docs/ragged_batching.md)
+    unified_batching: bool = False
+
+    @property
+    def chunking_enabled(self) -> bool:
+        """Chunked prefill is ON whenever unified batching is: splitting
+        prompts under the token budget is how the unified batch packs."""
+        return self.enable_chunked_prefill or self.unified_batching
 
 
 @dataclass
@@ -77,6 +89,19 @@ class ScheduledRequest:
     def is_prefill(self) -> bool:
         return self.start_pos < self.request.num_prompt_tokens
 
+    @property
+    def samples_final(self) -> bool:
+        """This chunk reaches the sequence's last token, so the step
+        SAMPLES from its final row.  The ONE definition of the
+        final-chunk predicate — the scheduler's async accounting
+        (note_async_dispatch) and the runner's sampling-row selection
+        (_unified_sampling / _sample_and_record) must agree exactly, or
+        a lagged retire consumes a token the runner never sampled.
+        Evaluate BEFORE the step's token is appended (num_tokens moves)."""
+        req = self.request
+        return (self.start_pos + self.num_new_tokens >= req.num_tokens
+                and not req.awaiting_chunks)
+
 
 @dataclass
 class SchedulerOutput:
@@ -88,6 +113,17 @@ class SchedulerOutput:
     kv_transfer_requests: list[tuple[Request, list[int], int]] = field(
         default_factory=list
     )
+    # unified ragged batching: the runner may pack prefills + decodes
+    # into ONE token-packed dispatch (it still applies its own fallback
+    # matrix — spec decode, logprobs, collect_hidden, embeds)
+    unified: bool = False
+    # async pipelining: request_id -> Request.async_generation at
+    # dispatch, for every row the in-flight step SAMPLES (decodes and
+    # sequence-final prefill chunks; mid-prefill chunks are absent).
+    # The lagged retire consumes a token only when the generation still
+    # matches — a preempt-and-readmit while the step was in flight
+    # bumps the generation, discarding the stale token.
+    async_sampled: dict[str, int] = field(default_factory=dict)
 
     @property
     def num_scheduled(self) -> int:
@@ -127,7 +163,7 @@ class ARScheduler:
         reason = None
         if n > self.config.max_model_len:
             reason = "prompt exceeds max_model_len"
-        elif (not self.config.enable_chunked_prefill
+        elif (not self.config.chunking_enabled
               and n - injected_len > self.config.max_num_batched_tokens):
             reason = "prompt exceeds max_num_batched_tokens (chunked prefill off)"
         elif self.kv.pages_needed(n) > self.kv.num_pages:
@@ -228,6 +264,7 @@ class ARScheduler:
     # ----------------------------------------------------------- schedule
     def schedule(self) -> SchedulerOutput:
         out = SchedulerOutput()
+        out.unified = self.config.unified_batching
         out.kv_transfer_requests = self.drain_pending_kv_transfers()
         budget = self.config.max_num_batched_tokens
 
@@ -236,6 +273,21 @@ class ARScheduler:
         #    (recompute policy, matching vLLM's default the reference extends).
         still_running: list[Request] = []
         snapshot = list(self.running)
+        if self.config.unified_batching:
+            # unified admission order: DECODES claim the token budget
+            # first, prefill chunks fill the remainder (stable sort —
+            # relative arrival order preserved within each class).  This
+            # also points the preemption policy (_preempt_for walks the
+            # snapshot tail) at chunking requests before decoding ones.
+            def _needs_chunk(r: Request) -> bool:
+                remaining = (r.num_tokens + r.num_inflight_tokens
+                             - r.num_computed_tokens)
+                return (remaining > 1 or r.awaiting_chunks
+                        or (r.prompt_embeds is not None
+                            and r.num_computed_tokens
+                            < r.num_prompt_tokens))
+
+            snapshot.sort(key=_needs_chunk)
         for i, req in enumerate(snapshot):
             if req.status is not RequestStatus.RUNNING:
                 continue  # preempted earlier in this very loop
@@ -388,7 +440,7 @@ class ARScheduler:
                 req.status = RequestStatus.RUNNING
                 self.running.append(req)
                 continue
-            if self.config.enable_chunked_prefill:
+            if self.config.chunking_enabled:
                 chunk = min(remaining, budget)
             elif remaining > budget:
                 break  # whole prompt must fit this step's budget
@@ -423,9 +475,12 @@ class ARScheduler:
         # stale chunks would duplicate the prefix
         req.additional_information.pop("_hidden_chunks", None)
         req.status = RequestStatus.PREEMPTED
+        # invalidate any in-flight async token for this request (see
+        # Request.async_generation)
+        req.async_generation += 1
         if req in self.running:
             self.running.remove(req)
-        if (not self.config.enable_chunked_prefill
+        if (not self.config.chunking_enabled
                 and req.num_tokens > self.config.max_num_batched_tokens):
             # the recompute footprint (prompt + generated, or a formerly
             # injected prefix) no longer fits one step and chunking is off:
@@ -514,14 +569,27 @@ class ARScheduler:
     # ------------------------------------------------- async pipelined step
     def note_async_dispatch(self, scheduler_output: SchedulerOutput) -> None:
         """Account a pipelined dispatch BEFORE its tokens are host-
-        visible: each single-token decode advances num_computed_tokens
-        (its KV slot is being written by the in-flight step) and marks
-        one in-flight token, so the next schedule() can emit the
-        following decode without waiting for the readback."""
+        visible: every scheduled chunk advances num_computed_tokens (its
+        KV slots are being written by the in-flight step), and each row
+        the step SAMPLES — single-token decodes and sequence-final
+        prefill chunks — marks one in-flight token, so the next
+        schedule() can emit the following decode without waiting for
+        the readback.  Mid-prefill chunks sample nothing; the next
+        chunk pipelines right behind them."""
         for sched in scheduler_output.decodes:
             req = sched.request
             req.num_computed_tokens += sched.num_new_tokens
             req.num_inflight_tokens += sched.num_new_tokens
+            scheduler_output.async_sampled[req.request_id] = \
+                req.async_generation
+        for sched in scheduler_output.prefills:
+            req = sched.request
+            final = sched.samples_final
+            req.num_computed_tokens += sched.num_new_tokens
+            if final:
+                req.num_inflight_tokens += 1
+                scheduler_output.async_sampled[req.request_id] = \
+                    req.async_generation
 
     def update_from_async_retire(
         self,
@@ -534,13 +602,18 @@ class ARScheduler:
         Requests that finished, aborted, expired, or were preempted
         while their step was in flight have their token DISCARDED (the
         overshoot contract — greedy recompute re-derives a preempted
-        request's token bit-identically)."""
+        request's token bit-identically); a preempt-and-readmit is
+        caught by the async_generation stamp, not just the in-flight
+        counter."""
         finished: list[Request] = []
-        for sched in scheduler_output.decodes:
+        for sched in scheduler_output.prefills + scheduler_output.decodes:
             req = sched.request
-            had_inflight = req.num_inflight_tokens > 0
-            if had_inflight:
-                req.num_inflight_tokens -= sched.num_new_tokens
+            gen = scheduler_output.async_sampled.get(req.request_id)
+            consumed = (gen is not None
+                        and gen == req.async_generation
+                        and req.num_inflight_tokens > 0)
+            if consumed:
+                req.num_inflight_tokens -= 1
             if req.is_finished:
                 # overshoot: the request stopped one step earlier
                 # (EOS/stop/abort/deadline) while this dispatch was in
@@ -548,12 +621,13 @@ class ARScheduler:
                 # advance so KV accounting matches what sync mode would
                 # have recorded (the overshoot slot's write is garbage
                 # in the request's own freed pages, never attended)
-                if had_inflight:
+                if consumed:
                     req.num_computed_tokens -= sched.num_new_tokens
                 continue
-            if not had_inflight:
-                # preempted (possibly re-admitted) while in flight: the
-                # token was discarded with the progress reset
+            if not consumed:
+                # mid-prefill chunk (nothing sampled), or preempted /
+                # re-admitted while in flight (token discarded with the
+                # progress reset)
                 continue
             token = sampled.get(req.request_id)
             if token is None:

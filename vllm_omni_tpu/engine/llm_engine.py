@@ -65,6 +65,17 @@ class EngineConfig:
     # collect_hidden, and per-token logprobs (those batches fall back
     # to single-step)
     multi_step_decode: int = 1
+    # unified ragged batching: mixed prefill+decode steps execute as ONE
+    # token-packed device dispatch (ops/ragged_paged_attention.py) —
+    # decodes claim the token budget first, prefill chunks fill the
+    # remainder, and the jit shape-cache shrinks from a (batch, seq)
+    # bucket grid to a 1-D token-bucket line.  Chunked prefill becomes
+    # the mechanism (implied ON).  The split path remains the per-step
+    # fallback for spec decode, logprobs, collect_hidden, and
+    # embeds-as-input batches.  With async_scheduling, mixed steps stay
+    # eligible for the two-slot pipeline — prefills no longer force a
+    # sync drain.  See docs/ragged_batching.md.
+    unified_batching: bool = False
     # async pipelined step: two-slot pipeline over pure-decode batches —
     # dispatch step N (forward + ON-DEVICE sampling, the sampled tokens
     # stay device-resident and feed step N+1's dispatch directly), then
@@ -108,13 +119,18 @@ class LLMEngine:
                  eos_token_id: Optional[int] = None,
                  draft_fn=None):
         config = config if config is not None else EngineConfig()
-        if config.async_scheduling and config.worker_type != "ar":
+        if (config.async_scheduling or config.unified_batching) \
+                and config.worker_type != "ar":
             logger.warning(
-                "async_scheduling only applies to AR engines; disabled "
-                "for worker_type=%s", config.worker_type)
+                "async_scheduling/unified_batching only apply to AR "
+                "engines; disabled for worker_type=%s", config.worker_type)
             # private copy — writing through would silently disable
-            # async for other engines built from the same config object
-            config = dataclasses.replace(config, async_scheduling=False)
+            # async for other engines built from the same config object.
+            # unified too: a generation-stage scheduler never emits
+            # unified batches, so the runner must not warm a token-bucket
+            # line of executables that can never dispatch
+            config = dataclasses.replace(config, async_scheduling=False,
+                                         unified_batching=False)
         self.config = config
         self.eos_token_id = eos_token_id
         # prefix caching skips the forward for cached positions, so it
@@ -133,6 +149,7 @@ class LLMEngine:
             enable_chunked_prefill=config.enable_chunked_prefill,
             num_speculative_tokens=config.num_speculative_tokens,
             kv_transfer=config.kv_transfer,
+            unified_batching=config.unified_batching,
             # async pipelining and multi-step windows are alternative
             # round-trip amortizations; windowed decodes would force the
             # pipeline into permanent sync fallback, so async wins
@@ -188,6 +205,8 @@ class LLMEngine:
                 multi_step_decode=(1 if config.async_scheduling
                                    else config.multi_step_decode),
                 async_scheduling=config.async_scheduling,
+                unified_batching=config.unified_batching,
+                max_num_batched_tokens=config.max_num_batched_tokens,
             )
         if (draft_fn is not None and config.num_speculative_tokens > 0
                 and hasattr(self.runner, "set_draft_fn")):
@@ -206,6 +225,12 @@ class LLMEngine:
         # so spans and /metrics series carry the pipeline position.
         self.stage_id = 0
         self.step_metrics = EngineStepMetrics()
+        # async pipeline drain granularity: how many steps fell back to
+        # the synchronous path, PER REASON ("prefill", "spec",
+        # "logprobs", "kv_transfer", ...) — under unified batching the
+        # prefill row stops growing, which makes the unified win
+        # directly visible on /metrics (async_fallback_total)
+        self.async_fallback: dict[str, int] = {}
         # request_id -> [first_token_ts, last_token_ts, tokens_seen]
         self._req_lat: dict[str, list] = {}
         self._trace_started: set[str] = set()
@@ -227,8 +252,19 @@ class LLMEngine:
         Reference analogue: worker warmup / graph capture before the
         engine goes live."""
         fn = getattr(self.runner, "precompile", None)
-        return 0 if fn is None else fn(
-            prefill_shapes=prefill_shapes, progress_fn=progress_fn)
+        if fn is None:
+            return 0
+        built = fn(prefill_shapes=prefill_shapes, progress_fn=progress_fn)
+        stats = getattr(self.runner, "compile_stats", None)
+        if stats is not None:
+            # the shape-cache telemetry baseline: compiles past this
+            # line are mid-traffic stalls (jit_compiles_total on
+            # /metrics keeps counting them)
+            logger.info(
+                "warmup compiled %d executables in %.1fs "
+                "(%d cache hits)", stats["compiles"],
+                stats["compile_s"], stats["cache_hits"])
+        return built
 
     # ------------------------------------------------------------- intake
     def add_request(
@@ -296,7 +332,7 @@ class LLMEngine:
         # fallback taken (pool pressure or bad payload): the request was
         # admitted assuming the prefix would be injected — recheck it can
         # actually be scheduled as a full recompute
-        if (not self.scheduler.config.enable_chunked_prefill
+        if (not self.scheduler.config.chunking_enabled
                 and req.num_prompt_tokens
                 > self.scheduler.config.max_num_batched_tokens):
             self.scheduler.waiting.remove(req)
@@ -361,7 +397,7 @@ class LLMEngine:
         # the queue head forever). RUNNING streams are exempt — the
         # continuation branch chunks them under the budget regardless.
         if (not over and queue is self.scheduler.waiting
-                and not self.config.enable_chunked_prefill
+                and not self.scheduler.config.chunking_enabled
                 and new_len - req.num_computed_tokens
                 > self.config.max_num_batched_tokens):
             over = True
@@ -447,6 +483,18 @@ class LLMEngine:
         fn = getattr(kv, "reset_prefix_cache", None)
         return fn() if fn is not None else 0
 
+    def _padding_totals(self) -> tuple[int, int]:
+        """Runner-side lifetime (useful, padded) token counters — the
+        per-step deltas feed the padding-efficiency metrics."""
+        return (getattr(self.runner, "useful_tokens", 0),
+                getattr(self.runner, "padded_tokens", 0))
+
+    def _observe_padding(self, useful_before: int, padded_before: int
+                         ) -> None:
+        useful, padded = self._padding_totals()
+        self.step_metrics.on_padding(useful - useful_before,
+                                     padded - padded_before)
+
     def metrics_snapshot(self) -> dict:
         """Step-level engine metrics for /metrics (Prometheus + JSON):
         latency histograms, scheduler depth + preemption/rejection
@@ -466,6 +514,11 @@ class LLMEngine:
             "utilization": round(used / kv.num_pages, 4),
         }
         snap["prefix_cache"] = self.prefix_cache_stats
+        compile_stats = getattr(self.runner, "compile_stats", None)
+        if compile_stats is not None:
+            snap["compile"] = dict(compile_stats)
+        if self.config.async_scheduling:
+            snap["async_fallback"] = dict(self.async_fallback)
         return snap
 
     def step(self) -> list[OmniRequestOutput]:
@@ -496,14 +549,21 @@ class LLMEngine:
         return errored + self._run_scheduled(sched_out, t_step0)
 
     # ------------------------------------------------ async pipelined step
+    def _note_fallback(self, reason: str) -> None:
+        self.async_fallback[reason] = self.async_fallback.get(
+            reason, 0) + 1
+
     def _step_async(self, t_step0: float) -> list[OmniRequestOutput]:
-        """Two-slot pipelined step: when the whole batch is pure
-        single-token decode, dispatch step N BEFORE retiring step N-1 —
+        """Two-slot pipelined step: when the batch is pure single-token
+        decode — or, under unified batching, any mixed batch the ragged
+        executable serves — dispatch step N BEFORE retiring step N-1:
         the device starts computing N while the host does N-1's token
         readback, stop checks, and bookkeeping, plus (on the next call)
         N+1's scheduling.  Anything needing host-visible logits drains
-        the pipeline and runs the synchronous path for that step."""
-        if self._pipeline_ready():
+        the pipeline and runs the synchronous path for that step,
+        counted per reason in ``async_fallback``."""
+        ready, reason = self._pipeline_ready()
+        if ready:
             sched_out = self.scheduler.schedule()
             self.step_metrics.on_schedule(
                 waiting=len(self.scheduler.waiting),
@@ -512,60 +572,85 @@ class LLMEngine:
             if self._pipeline_eligible(sched_out):
                 return self._step_pipelined(sched_out, t_step0)
             # scheduled but not dispatchable (e.g. page pressure
-            # preempted the whole batch): drain the pipeline, drop
-            # requests the retire just finished from the stale
-            # schedule, and run the remainder synchronously
+            # preempted the whole batch, or the reshaped batch fell off
+            # the unified fast path): drain the pipeline, drop requests
+            # the retire just finished from the stale schedule, and run
+            # the remainder synchronously
+            self._note_fallback("reshaped")
             outs, drain_wait = self._drain_pipeline()
-            sched_out.decodes = [
-                s for s in sched_out.decodes
+            drop = lambda ss: [  # noqa: E731
+                s for s in ss
                 if not s.request.is_finished
                 and s.request.status is RequestStatus.RUNNING
             ]
+            sched_out.decodes = drop(sched_out.decodes)
+            sched_out.prefills = drop(sched_out.prefills)
             return outs + self._run_scheduled(
                 sched_out, t_step0, skip_on_schedule=True,
                 drained_wait_s=drain_wait)
         # fallback step (prefills / spec / logprobs / streaming / ...):
         # retire FIRST so scheduling sees post-retire state and decode
         # inputs are host-visible for the synchronous runner
+        if reason is not None:
+            self._note_fallback(reason)
         outs, drain_wait = self._drain_pipeline()
         sched_out = self.scheduler.schedule()
         return outs + self._run_scheduled(sched_out, t_step0,
                                           drained_wait_s=drain_wait)
 
-    def _pipeline_ready(self) -> bool:
+    @property
+    def _unified_async(self) -> bool:
+        """Mixed batches ride the pipeline when the unified executable
+        exists (unified_batching on an AR runner)."""
+        return (self.config.unified_batching
+                and getattr(self.runner, "_unified_fn", None) is not None)
+
+    def _pipeline_ready(self) -> "tuple[bool, Optional[str]]":
         """Cheap pre-schedule check: can the NEXT step be dispatched
         ahead of token knowledge?  Mirrors the fallback matrix in
-        docs/async_engine.md — every running request must be a plain
-        decode whose host work the pipeline may lag by one step."""
+        docs/async_engine.md (prefill row: unified batching keeps mixed
+        steps pipelined).  Returns (ready, fallback_reason) — reason is
+        None when there is simply nothing to dispatch."""
         s = self.scheduler
-        if s.waiting or not s.running:
-            return False
+        unified = self._unified_async
+        if not s.running and not s.waiting:
+            return False, None  # idle: nothing to pipeline
         if self.config.kv_transfer is not None or s._pending_kv_transfers:
-            return False
+            return False, "kv_transfer"
         if self.config.collect_hidden:
-            return False
+            return False, "collect_hidden"
         if getattr(self.runner, "draft_fn", None) is not None:
-            return False
-        for r in s.running:
-            if r.awaiting_chunks or r.spec_draft_tokens:
-                return False
+            return False, "spec"
+        if s.waiting and not unified:
+            return False, "prefill"
+        queues = (list(s.running) + list(s.waiting) if unified
+                  else list(s.running))
+        for r in queues:
+            if r.awaiting_chunks:
+                return False, "streaming"
+            if r.spec_draft_tokens:
+                return False, "spec"
             if r.sampling_params.logprobs is not None:
-                return False
+                return False, "logprobs"
             if (r.prompt_embeds is not None
                     and r.num_computed_tokens < r.num_prompt_tokens):
-                return False
+                return False, "embeds"
+            if r.deepstack_embeds and r.num_computed_tokens \
+                    < r.num_prompt_tokens:
+                return False, "embeds"
             remaining = (r.num_tokens + r.num_inflight_tokens
                          - r.num_computed_tokens)
-            if remaining != 1:
-                return False
-        return True
+            if remaining != 1 and not unified:
+                return False, "prefill"
+        return True, None
 
     def _pipeline_eligible(self, sched_out: SchedulerOutput) -> bool:
         """Post-schedule check on the actual output (preemption may have
-        reshaped it): pure single-token decodes only, and every input
-        token either host-visible or device-resident in the in-flight
-        handle."""
-        if sched_out.prefills or not sched_out.decodes:
+        reshaped it): single-token decodes — plus, under unified
+        batching, prefill chunks the ragged executable accepts — and
+        every decode input token either host-visible or device-resident
+        in the in-flight handle."""
+        if not sched_out.decodes and not sched_out.prefills:
             return False
         if sched_out.kv_transfer_requests:
             return False
@@ -577,27 +662,41 @@ class LLMEngine:
                     prev is None
                     or s.request.request_id not in prev.handle.rows):
                 return False
+        if sched_out.prefills:
+            if not self._unified_async:
+                return False
+            eligible = getattr(self.runner, "_unified_eligible", None)
+            if eligible is None or not eligible(sched_out):
+                return False
         return True
 
     def _step_pipelined(self, sched_out: SchedulerOutput,
                         t_step0: float) -> list[OmniRequestOutput]:
         rec = get_recorder()
         prev = self._inflight
+        scheduled = sched_out.prefills + sched_out.decodes
         t_d0, w_d0 = time.perf_counter(), time.time()
-        handle = self.runner.dispatch_decode(
-            sched_out.decodes,
-            prev.handle if prev is not None else None,
-        )
-        # schedule-ahead accounting: the dispatched decodes' tokens are
+        u0, p0 = self._padding_totals()
+        if sched_out.prefills:
+            # unified mixed dispatch: prefill chunks pipeline too
+            handle = self.runner.dispatch_unified(
+                sched_out, prev.handle if prev is not None else None)
+        else:
+            handle = self.runner.dispatch_decode(
+                sched_out.decodes,
+                prev.handle if prev is not None else None,
+            )
+        # schedule-ahead accounting: the dispatched rows' tokens are
         # now in flight; the next schedule() counts them without seeing
         # their values
         self.scheduler.note_async_dispatch(sched_out)
+        self._observe_padding(u0, p0)
         dur_disp = time.perf_counter() - t_d0
-        for s in sched_out.decodes:
+        for s in scheduled:
             rec.record(s.request.additional_information.get("trace"),
                        "dispatch", w_d0, dur_disp,
                        stage_id=self.stage_id,
-                       args={"batch": len(sched_out.decodes)})
+                       args={"batch": len(scheduled)})
         self._inflight = _InflightStep(sched_out=sched_out, handle=handle)
         outs: list[OmniRequestOutput] = []
         new_total = 0
@@ -620,7 +719,9 @@ class LLMEngine:
         # dispatched — the only unoverlapped host time is the wait
         self.step_metrics.on_step(
             step_ms=total_s * 1e3, new_tokens=new_total,
-            prefill_tokens=0, host_ms=host_ms, device_ms=wait_s * 1e3,
+            prefill_tokens=sum(s.num_new_tokens
+                               for s in sched_out.prefills),
+            host_ms=host_ms, device_ms=wait_s * 1e3,
             overlapped_host_ms=host_ms if prev is not None else 0.0,
         )
         return outs
@@ -635,7 +736,8 @@ class LLMEngine:
         wait_s = time.perf_counter() - t_g0
         finished = self.scheduler.update_from_async_retire(
             inflight.sched_out, sampled)
-        scheds = inflight.sched_out.decodes
+        scheds = (inflight.sched_out.prefills
+                  + inflight.sched_out.decodes)
         # only requests that could have appended a token this retire:
         # an overshoot row for a request that finished at the PREVIOUS
         # retire (or was aborted/expired mid-flight) already had its
@@ -745,9 +847,11 @@ class LLMEngine:
                            now_w - req.arrival_time,
                            stage_id=self.stage_id, cat="queue")
         t_ex0, w_ex0 = time.perf_counter(), time.time()
+        u0, p0 = self._padding_totals()
         run_out = self.runner.execute(
             sched_out, extract_kv=self.kv_transfer_sink is not None
         )
+        self._observe_padding(u0, p0)
         dur_ex = time.perf_counter() - t_ex0
         for s in sched_out.prefills:
             rec.record(s.request.additional_information.get("trace"),

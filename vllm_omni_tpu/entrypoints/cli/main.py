@@ -39,6 +39,13 @@ def _add_common(p: argparse.ArgumentParser):
                           "scheduling/readback with device compute via "
                           "device-resident sampled tokens (see "
                           "docs/async_engine.md)")
+    eng.add_argument("--unified-batching", action="store_true",
+                     default=None,
+                     help="unified ragged mixed batching: prefill "
+                          "chunks and decodes share ONE token-packed "
+                          "device dispatch per step, and mixed steps "
+                          "stay eligible for the async pipeline (see "
+                          "docs/ragged_batching.md)")
     p.add_argument(
         "--stats-path", default=None, metavar="PREFIX",
         help="stream per-stage + E2E stats to PREFIX.*.stats.jsonl")
@@ -58,7 +65,7 @@ def _add_common(p: argparse.ArgumentParser):
 _ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
                 "max_num_batched_tokens", "dtype", "seed",
                 "enable_chunked_prefill", "num_speculative_tokens",
-                "async_scheduling")
+                "async_scheduling", "unified_batching")
 
 
 def _stage_overrides(args) -> dict:

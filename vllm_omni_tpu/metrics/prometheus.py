@@ -103,6 +103,36 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "counter",
         "Host step work milliseconds performed while a dispatched "
         "device step was in flight", ("stage",)),
+    # ---- unified ragged batching (docs/ragged_batching.md)
+    "engine_step_padding_efficiency": (
+        "gauge",
+        "Useful tokens / padded device rows across dispatches "
+        "(1.0 = zero padding)", ("stage",)),
+    "engine_step_batched_tokens": (
+        "histogram", "Real tokens computed per engine step", ("stage",)),
+    "engine_step_useful_tokens_total": (
+        "counter", "Real tokens computed across device dispatches",
+        ("stage",)),
+    "engine_step_padded_tokens_total": (
+        "counter", "Padded device rows across dispatches", ("stage",)),
+    # jit shape-cache telemetry: the unified path shrinks the cache
+    # from a (batch, seq) grid to a token-bucket line — measurable here
+    "jit_compiles_total": (
+        "counter", "Fresh XLA executable compiles in the model runner",
+        ("stage",)),
+    "jit_cache_hits_total": (
+        "counter", "Runner dispatches served by the jit shape cache",
+        ("stage",)),
+    "jit_compile_seconds_total": (
+        "counter",
+        "Cumulative seconds spent blocked on fresh compiles "
+        "(first call per shape, to completion)", ("stage",)),
+    # async pipeline drain granularity (docs/async_engine.md fallback
+    # matrix): sync-path steps per reason while async scheduling is on
+    "async_fallback_total": (
+        "counter",
+        "Async pipeline steps that fell back to the synchronous path",
+        ("stage", "reason")),
     "diffusion_requests_total": (
         "counter", "Diffusion requests generated", ("stage",)),
     "diffusion_batches_total": (
@@ -280,6 +310,29 @@ def render_exposition(summary: dict, engine_snaps: dict,
                        overlap.get("ratio", 0.0))
             exp.sample("engine_step_overlapped_host_ms_total", labels,
                        overlap.get("overlapped_host_ms_total", 0.0))
+        if snap.get("batched_tokens"):
+            exp.histogram("engine_step_batched_tokens", labels,
+                          snap["batched_tokens"])
+        padding = snap.get("padding")
+        if padding:
+            exp.sample("engine_step_padding_efficiency", labels,
+                       padding.get("efficiency", 0.0))
+            exp.sample("engine_step_useful_tokens_total", labels,
+                       padding.get("useful_tokens_total", 0))
+            exp.sample("engine_step_padded_tokens_total", labels,
+                       padding.get("padded_tokens_total", 0))
+        compile_stats = snap.get("compile")
+        if compile_stats:
+            exp.sample("jit_compiles_total", labels,
+                       compile_stats.get("compiles", 0))
+            exp.sample("jit_cache_hits_total", labels,
+                       compile_stats.get("cache_hits", 0))
+            exp.sample("jit_compile_seconds_total", labels,
+                       compile_stats.get("compile_s", 0.0))
+        for reason, count in sorted(
+                (snap.get("async_fallback") or {}).items()):
+            exp.sample("async_fallback_total",
+                       {**labels, "reason": reason}, count)
         diff = snap.get("diffusion")
         if diff:
             exp.sample("diffusion_requests_total", labels,

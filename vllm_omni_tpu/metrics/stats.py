@@ -71,6 +71,11 @@ LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                       500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
                       60000.0)
 
+# Token-count buckets (engine_step_batched_tokens): powers of two up to
+# the largest plausible per-step token budget.
+TOKEN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0, 2048.0, 4096.0, 8192.0)
+
 
 class Histogram:
     """Fixed-bucket histogram with a recent-value window for percentiles.
@@ -154,6 +159,10 @@ class EngineStepMetrics:
         # docs/async_engine.md; sync steps overlap nothing)
         self.host_ms = Histogram()
         self.device_ms = Histogram()
+        # tokens per step (REAL tokens computed, before padding) — with
+        # the useful/padded counters below this makes the unified
+        # ragged path's padding win measurable (docs/ragged_batching.md)
+        self.batched_tokens = Histogram(buckets=TOKEN_BUCKETS)
         # gauges (last sampled values)
         self.num_waiting = 0
         self.num_running = 0
@@ -163,6 +172,10 @@ class EngineStepMetrics:
         self.prefill_tokens = 0
         self.host_ms_total = 0.0
         self.overlapped_host_ms_total = 0.0
+        # padding efficiency: real tokens vs. padded device rows across
+        # every dispatch (bucketed split path vs. token-packed unified)
+        self.useful_tokens_total = 0
+        self.padded_tokens_total = 0
 
     def on_schedule(self, waiting: int, running: int) -> None:
         self.num_waiting = waiting
@@ -183,6 +196,23 @@ class EngineStepMetrics:
                                                  host_ms)
         if device_ms is not None:
             self.device_ms.observe(device_ms)
+
+    def on_padding(self, useful: int, padded: int) -> None:
+        """Per-step device-row accounting: ``useful`` real tokens rode
+        ``padded`` padded rows (engine samples the runner's counters
+        around each dispatch/execute)."""
+        if padded <= 0:
+            return
+        self.useful_tokens_total += useful
+        self.padded_tokens_total += padded
+        self.batched_tokens.observe(float(useful))
+
+    @property
+    def padding_efficiency(self) -> float:
+        """useful / padded over all dispatches (1.0 = zero padding)."""
+        if self.padded_tokens_total <= 0:
+            return 0.0
+        return self.useful_tokens_total / self.padded_tokens_total
 
     @property
     def overlap_ratio(self) -> float:
@@ -209,6 +239,12 @@ class EngineStepMetrics:
             "step_ms": self.step_ms.snapshot(),
             "host_ms": self.host_ms.snapshot(),
             "device_ms": self.device_ms.snapshot(),
+            "batched_tokens": self.batched_tokens.snapshot(),
+            "padding": {
+                "useful_tokens_total": self.useful_tokens_total,
+                "padded_tokens_total": self.padded_tokens_total,
+                "efficiency": round(self.padding_efficiency, 4),
+            },
             "overlap": {
                 "ratio": round(self.overlap_ratio, 4),
                 "host_ms_total": round(self.host_ms_total, 3),

@@ -24,6 +24,7 @@ from vllm_omni_tpu.ops import (
     compute_rope_freqs,
     flash_attention,
     paged_attention,
+    ragged_paged_attention,
     rms_norm,
     silu_mul,
     write_kv_cache,
@@ -516,6 +517,50 @@ def forward_prefill_chunked(
         x = _layer_step(layer, cfg, x, cos, sin, attend)
         if deepstack is not None and i < deepstack.shape[1]:
             x = x + deepstack[:, i].astype(x.dtype)
+    return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
+
+
+def forward_unified(
+    params,
+    cfg: TransformerConfig,
+    token_ids: jax.Array,    # [T] token-packed mixed batch
+    positions: jax.Array,    # [T] global positions ([3, T] under mrope)
+    kv_caches: list,
+    slot_mapping: jax.Array,  # [T] flat slots (-1 for padding rows)
+    page_tables: jax.Array,   # [S, max_pages]
+    seq_lens: jax.Array,      # [S] context incl. this step's tokens
+    cu_q_lens: jax.Array,     # [S+1] aligned packed segment starts
+    q_lens: jax.Array,        # [S] real token count per sequence
+    num_seqs: jax.Array,      # [1]
+):
+    """Unified ragged mixed-batch forward: prefill chunks and 1-token
+    decode rows share ONE token-packed execution (ops/
+    ragged_paged_attention.py; layout contract in its module docstring
+    and docs/ragged_batching.md).  Each layer scatters the step's KV
+    through the slot mapping, then attends the paged context raggedly —
+    replacing the fresh/chunk/decode triple dispatch for mixed steps.
+
+    Returns (hidden [T, hidden], new kv_caches).
+    """
+    x = nn.embedding(params["embed"], token_ids)  # [T, hidden]
+    if cfg.mrope_sections is None:
+        cos, sin = _rope_tables(cfg, positions)
+    else:
+        # [3, T] -> the [B, 3, S] convention with B=1
+        cos, sin = _rope_tables(cfg, positions[None])
+    new_caches = []
+    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+        def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):
+            k_cache, v_cache = write_kv_cache(
+                k_cache, v_cache, k, v, slot_mapping
+            )
+            new_caches.append((k_cache, v_cache))
+            return ragged_paged_attention(
+                q, k_cache, v_cache, page_tables, cu_q_lens, q_lens,
+                seq_lens, num_seqs,
+            )
+
+        x = _layer_step(layer, cfg, x, cos, sin, attend)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
 
 

@@ -21,6 +21,10 @@ from vllm_omni_tpu.ops.paged_attention import (
     paged_attention_ref,
     write_kv_cache,
 )
+from vllm_omni_tpu.ops.ragged_paged_attention import (
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
+)
 from vllm_omni_tpu.ops.activation import silu_mul, gelu_tanh_mul
 
 __all__ = [
@@ -34,6 +38,8 @@ __all__ = [
     "attention_ref",
     "paged_attention",
     "paged_attention_ref",
+    "ragged_paged_attention",
+    "ragged_paged_attention_ref",
     "write_kv_cache",
     "silu_mul",
     "gelu_tanh_mul",

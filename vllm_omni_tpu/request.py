@@ -97,6 +97,12 @@ class Request:
     # retire decrements, preemption/abort resets (the in-flight token is
     # discarded and greedily re-derived on recompute).
     num_inflight_tokens: int = 0
+    # bumped on every preemption: a lagged async retire consumes its
+    # token only when the generation recorded at dispatch still matches,
+    # so a preempt-and-readmit while a step was in flight (possible
+    # under unified batching, where waiting requests join pipelined
+    # steps) can never resurrect the discarded token
+    async_generation: int = 0
     # per-output-token logprob entries when sampling_params.logprobs is
     # set: {"logprob": float, "top_ids": [...], "top_logprobs": [...]}
     # (spec-decode multi-accept steps skip entries — the verify path

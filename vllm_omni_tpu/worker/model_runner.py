@@ -22,9 +22,10 @@ from __future__ import annotations
 import dataclasses
 import functools
 import secrets
+import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,7 @@ import numpy as np
 from vllm_omni_tpu.core.scheduler import ScheduledRequest, SchedulerOutput
 from vllm_omni_tpu.models.common import transformer as tfm
 from vllm_omni_tpu.ops.paged_attention import init_kv_cache, write_kv_cache
+from vllm_omni_tpu.ops.ragged_paged_attention import align_to_block
 from vllm_omni_tpu.sample.sampler import SamplingTensors, sample_tokens
 from vllm_omni_tpu.sampling_params import SamplingParams
 
@@ -81,6 +83,22 @@ class RunnerOutput:
     kv_extracted_req_ids: set[str] = field(default_factory=set)
 
 
+class UnifiedBatch(NamedTuple):
+    """Host-assembled device inputs for one token-packed unified step
+    (the layout contract of ops/ragged_paged_attention.py)."""
+
+    token_ids: np.ndarray   # [T_pad]
+    positions: np.ndarray   # [T_pad] ([3, T_pad] under mrope)
+    slots: np.ndarray       # [T_pad] flat KV slots (-1 padding)
+    tables: np.ndarray      # [S_max, max_pages]
+    seq_lens: np.ndarray    # [S_max]
+    cu_q_lens: np.ndarray   # [S_max + 1] aligned segment starts
+    q_lens: np.ndarray      # [S_max]
+    last_idx: np.ndarray    # [S_max] packed row of each seq's last token
+    t_pad: int              # token bucket the batch padded to
+    total: int              # aligned rows actually occupied
+
+
 @dataclass
 class InflightDecode:
     """Handle for a dispatched-but-not-retired pipelined decode step.
@@ -126,9 +144,12 @@ class ARModelRunner:
         mesh=None,  # 1-axis "tp" Mesh => tensor-parallel execution
         multi_step_decode: int = 1,  # decode window per device call
         async_scheduling: bool = False,  # precompile the dispatch path
+        unified_batching: bool = False,  # build the ragged unified step
+        max_num_batched_tokens: int = 2048,  # sizes the token buckets
     ):
         self.multi_step_decode = max(1, int(multi_step_decode))
         self.async_scheduling = bool(async_scheduling)
+        self.unified_batching = bool(unified_batching)
         self.mesh = mesh
         if mesh is not None:
             # Megatron-style TP inside shard_map: heads and MLP columns
@@ -156,7 +177,28 @@ class ARModelRunner:
         # emits a batch/chunk beyond them, so _bucket cannot overflow
         self._batch_buckets = _make_buckets(1, max(max_num_seqs, 1))
         self._seq_buckets = _make_buckets(16, max(max_model_len, 16))
+        # unified ragged batching pads to TOKEN-count buckets: a 1-D
+        # bucket line replacing the (batch, seq) grid of the split path.
+        # Worst packed size = the step token budget plus per-sequence
+        # q-block alignment (ops/ragged_paged_attention.py layout).
+        t_cap = align_to_block(
+            max_num_batched_tokens
+            + max(max_num_seqs, 1) * (align_to_block(1) - 1))
+        self._token_buckets = _make_buckets(16, max(t_cap, 16))
         self.collect_hidden = collect_hidden
+        # --- telemetry (metrics/stats.py pulls these per step) ---
+        # device dispatches: one jitted-executable launch each; tests
+        # assert a mixed unified step is exactly ONE of these
+        self.dispatch_count = 0
+        # padding efficiency: real tokens vs. padded device rows
+        self.useful_tokens = 0
+        self.padded_tokens = 0
+        # jit shape-cache telemetry: fresh compiles vs. cache hits and
+        # cumulative first-call (compile-dominated) seconds, keyed by
+        # this runner's own (kind, shape) signatures
+        self.compile_stats = {"compiles": 0, "cache_hits": 0,
+                              "compile_s": 0.0}
+        self._jit_seen: set[tuple] = set()
         self.kv_caches = init_kv_cache(
             cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
             cfg.head_dim, dtype,
@@ -259,6 +301,27 @@ class ARModelRunner:
             toks = sample_tokens(logits, temperature, top_k, top_p, keys)
             return toks, new_caches
 
+        def _unified(params, token_ids, kv_caches, positions, slot_mapping,
+                     page_tables, seq_lens, cu_q_lens, q_lens, num_seqs,
+                     last_idx, temperature, top_k, top_p, keys):
+            # ONE executable for a mixed prefill+decode step: the
+            # token-packed ragged forward (ops/ragged_paged_attention.py)
+            # writes KV through the same slot-mapping scatter, then
+            # samples ON DEVICE from each sequence's last-token row —
+            # non-final chunk rows sample discarded tokens (greedy
+            # padding params keep the sampler's fast path).  Shapes vary
+            # only in the token axis, so the jit cache is a 1-D
+            # token-bucket line instead of a (batch, seq) grid.
+            hidden, new_caches = tfm.forward_unified(
+                params, cfg_, token_ids, positions, kv_caches,
+                slot_mapping, page_tables, seq_lens, cu_q_lens, q_lens,
+                num_seqs,
+            )
+            last_hidden = hidden[last_idx]  # [S, hidden]
+            logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
+            toks = sample_tokens(logits, temperature, top_k, top_p, keys)
+            return toks, new_caches
+
         ps_ = page_size
 
         def _decode_multi(params, token_ids, kv_caches, positions, gpos,
@@ -301,6 +364,8 @@ class ARModelRunner:
             self._verify_fn = jit2(_verify)
             self._decode_fn = jit2(_decode)
             self._decode_sample_fn = jit2(_decode_sample)
+            self._unified_fn = (jit2(_unified)
+                                if self.unified_batching else None)
             self._decode_multi_fn = jax.jit(
                 _decode_multi, donate_argnums=(2,),
                 static_argnums=(11,))
@@ -339,6 +404,11 @@ class ARModelRunner:
             # per-layer psums make logits replicated, so every shard
             # samples the same token — same argument as _decode_multi_tp
             self._decode_sample_fn = wrap(_decode_sample, 8, 1)
+            # unified ragged step under TP: the ragged kernel runs on
+            # LOCAL head shapes inside the same shard_map wrap as the
+            # decode path (TPLA stance, PAPERS.md); metadata replicates
+            self._unified_fn = (wrap(_unified, 12, 1)
+                                if self.unified_batching else None)
 
             # Multi-step decode under TP: the scan lives INSIDE the
             # shard_map body, so the KV carry stays on local shard
@@ -394,6 +464,32 @@ class ARModelRunner:
         except (TypeError, ValueError):
             self._draft_takes_contexts = False
 
+    # -------------------------------------------------- dispatch telemetry
+    def _run_jit(self, kind: str, shape_key: tuple, thunk):
+        """Invoke one jitted executable through the telemetry choke
+        point: counts the device dispatch (mixed-step tests assert ONE
+        per unified step) and classifies it fresh-compile vs cache-hit
+        by this runner's own (kind, shape) signature.  A fresh signature
+        is timed TO COMPLETION (block_until_ready) so compile_s measures
+        the real compile+first-run stall — warmup prepopulates the
+        signatures, so steady-state traffic takes the unsynced branch."""
+        self.dispatch_count += 1
+        key = (kind,) + tuple(shape_key)
+        if key in self._jit_seen:
+            self.compile_stats["cache_hits"] += 1
+            return thunk()
+        self._jit_seen.add(key)
+        t0 = time.perf_counter()
+        result = thunk()
+        jax.block_until_ready(result)
+        self.compile_stats["compiles"] += 1
+        self.compile_stats["compile_s"] += time.perf_counter() - t0
+        return result
+
+    def _note_padding(self, useful: int, padded: int) -> None:
+        self.useful_tokens += int(useful)
+        self.padded_tokens += int(padded)
+
     # ---------------------------------------------------------- precompile
     def precompile(self, prefill_shapes=(), decode: bool = True,
                    progress_fn=None) -> int:
@@ -438,31 +534,36 @@ class ARModelRunner:
                 return (b, 3) if self.use_mrope else (b,)
             return (b, 3, s) if self.use_mrope else (b, s)
 
+        def warm(kind, key, thunk):
+            nonlocal built
+            res = self._run_jit(kind, key, thunk)
+            built += 1
+            return res
+
         if decode:
             for b in self._batch_buckets:
                 note(f"precompile decode b={b}")
                 zeros_b = jnp.zeros((b,), jnp.int32)
                 tables = jnp.zeros((b, self.max_pages_per_seq), jnp.int32)
-                _, _, self.kv_caches = self._decode_fn(
-                    self.params, zeros_b, self.kv_caches,
-                    jnp.zeros(pos_shape(b), jnp.int32),
-                    jnp.full((b,), -1, jnp.int32), tables,
-                    jnp.ones((b,), jnp.int32))
-                built += 1
+                _, _, self.kv_caches = warm(
+                    "decode", (b,), lambda: self._decode_fn(
+                        self.params, zeros_b, self.kv_caches,
+                        jnp.zeros(pos_shape(b), jnp.int32),
+                        jnp.full((b,), -1, jnp.int32), tables,
+                        jnp.ones((b,), jnp.int32)))
                 if self.async_scheduling:
                     # the async pipeline's dispatch path (forward +
                     # on-device sampling) is its own executable
                     t = SamplingTensors.build(
                         [_PAD_SAMPLING] * b, step=0,
                         base_seed=self._base_seed)
-                    toks, self.kv_caches = self._decode_sample_fn(
-                        self.params, zeros_b, self.kv_caches,
-                        jnp.zeros(pos_shape(b), jnp.int32),
-                        jnp.full((b,), -1, jnp.int32), tables,
-                        jnp.ones((b,), jnp.int32),
-                        t.temperature, t.top_k, t.top_p, t.keys)
-                    jax.block_until_ready(toks)
-                    built += 1
+                    _, self.kv_caches = warm(
+                        "dispatch", (b,), lambda: self._decode_sample_fn(
+                            self.params, zeros_b, self.kv_caches,
+                            jnp.zeros(pos_shape(b), jnp.int32),
+                            jnp.full((b,), -1, jnp.int32), tables,
+                            jnp.ones((b,), jnp.int32),
+                            t.temperature, t.top_k, t.top_p, t.keys))
                 if (self.multi_step_decode > 1
                         and self._decode_multi_fn is not None):
                     t = SamplingTensors.build(
@@ -470,27 +571,52 @@ class ARModelRunner:
                         base_seed=self._base_seed)
                     # valid=False derives slot -1 on device: the whole
                     # window's KV writes drop
-                    toks, self.kv_caches = self._decode_multi_fn(
-                        self.params, zeros_b, self.kv_caches,
-                        jnp.zeros(pos_shape(b), jnp.int32), zeros_b,
-                        jnp.zeros((b,), bool), tables,
-                        t.temperature, t.top_k, t.top_p, t.keys,
-                        self.multi_step_decode)
-                    jax.block_until_ready(toks)
-                    built += 1
+                    _, self.kv_caches = warm(
+                        "multi", (b, self.multi_step_decode),
+                        lambda: self._decode_multi_fn(
+                            self.params, zeros_b, self.kv_caches,
+                            jnp.zeros(pos_shape(b), jnp.int32), zeros_b,
+                            jnp.zeros((b,), bool), tables,
+                            t.temperature, t.top_k, t.top_p, t.keys,
+                            self.multi_step_decode))
                 if self.draft_fn is not None and self.num_draft_tokens:
                     # spec-decode verify batches run at the candidate
                     # length (1 regular + k draft positions)
                     s = _bucket(1 + self.num_draft_tokens,
                                 self._seq_buckets)
-                    _, _, self.kv_caches = self._verify_fn(
-                        self.params, jnp.zeros((b, s), jnp.int32),
-                        self.kv_caches,
-                        jnp.zeros(pos_shape(b, s), jnp.int32),
-                        jnp.full((b, s), -1, jnp.int32), tables,
-                        jnp.ones((b,), jnp.int32),
-                        jnp.zeros((b,), jnp.int32))
-                    built += 1
+                    _, _, self.kv_caches = warm(
+                        "verify", (b, s, self.max_pages_per_seq),
+                        lambda: self._verify_fn(
+                            self.params, jnp.zeros((b, s), jnp.int32),
+                            self.kv_caches,
+                            jnp.zeros(pos_shape(b, s), jnp.int32),
+                            jnp.full((b, s), -1, jnp.int32), tables,
+                            jnp.ones((b,), jnp.int32),
+                            jnp.zeros((b,), jnp.int32)))
+        if self._unified_fn is not None:
+            # ONE executable per token bucket — the 1-D shape-cache line
+            # that replaces the (batch, seq) grid for mixed steps
+            s_max = self._batch_buckets[-1]
+            t = SamplingTensors.build(
+                [_PAD_SAMPLING] * s_max, step=0,
+                base_seed=self._base_seed)
+            for t_pad in self._token_buckets:
+                note(f"precompile unified t={t_pad}")
+                pos = (jnp.zeros((3, t_pad), jnp.int32) if self.use_mrope
+                       else jnp.zeros((t_pad,), jnp.int32))
+                _, self.kv_caches = warm(
+                    "unified", (t_pad,), lambda: self._unified_fn(
+                        self.params, jnp.zeros((t_pad,), jnp.int32),
+                        self.kv_caches, pos,
+                        jnp.full((t_pad,), -1, jnp.int32),
+                        jnp.zeros((s_max, self.max_pages_per_seq),
+                                  jnp.int32),
+                        jnp.zeros((s_max,), jnp.int32),
+                        jnp.zeros((s_max + 1,), jnp.int32),
+                        jnp.zeros((s_max,), jnp.int32),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.zeros((s_max,), jnp.int32),
+                        t.temperature, t.top_k, t.top_p, t.keys))
 
         seen_chunks = set()
         for b, s in _bucketed_prefill_shapes(
@@ -500,12 +626,12 @@ class ARModelRunner:
             # *embeds_args for a token-only batch: jit's cache key
             # covers the argument TREE, so the same shapes with a
             # different arity would still be a fresh executable
-            _, _, _, self.kv_caches = self._prefill_fn(
-                self.params, jnp.zeros((b, s), jnp.int32),
-                self.kv_caches, jnp.zeros(pos_shape(b, s), jnp.int32),
-                jnp.full((b, s), -1, jnp.int32),
-                jnp.zeros((b,), jnp.int32), None, None, None)
-            built += 1
+            _, _, _, self.kv_caches = warm(
+                "prefill", (b, s, False, False), lambda: self._prefill_fn(
+                    self.params, jnp.zeros((b, s), jnp.int32),
+                    self.kv_caches, jnp.zeros(pos_shape(b, s), jnp.int32),
+                    jnp.full((b, s), -1, jnp.int32),
+                    jnp.zeros((b,), jnp.int32), None, None, None))
             # APC prefix hits / chunked-prefill continuations run the
             # chunked executable; its signature is (batch, chunk bucket,
             # context pages) where pages derive from the CONTEXT's seq
@@ -520,17 +646,18 @@ class ARModelRunner:
                 if key in seen_chunks:
                     continue
                 seen_chunks.add(key)
-                _, _, _, self.kv_caches = self._chunk_prefill_fn(
-                    self.params, jnp.zeros((b, s_chunk), jnp.int32),
-                    self.kv_caches,
-                    jnp.zeros(pos_shape(b, s_chunk), jnp.int32),
-                    jnp.full((b, s_chunk), -1, jnp.int32),
-                    jnp.zeros((b,), jnp.int32),
-                    jnp.zeros((b, pages), jnp.int32),
-                    jnp.ones((b,), jnp.int32),
-                    jnp.zeros((b,), jnp.int32),
-                    None, None, None)
-                built += 1
+                _, _, _, self.kv_caches = warm(
+                    "chunk", (b, s_chunk, pages, False, False),
+                    lambda: self._chunk_prefill_fn(
+                        self.params, jnp.zeros((b, s_chunk), jnp.int32),
+                        self.kv_caches,
+                        jnp.zeros(pos_shape(b, s_chunk), jnp.int32),
+                        jnp.full((b, s_chunk), -1, jnp.int32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b, pages), jnp.int32),
+                        jnp.ones((b,), jnp.int32),
+                        jnp.zeros((b,), jnp.int32),
+                        None, None, None))
         return built
 
     # ---------------------------------------------------------------- step
@@ -539,6 +666,28 @@ class ARModelRunner:
     ) -> RunnerOutput:
         self._step += 1
         out = RunnerOutput()
+        if self._unified_eligible(sched_out):
+            # mixed (or pure-prefill) step as ONE token-packed dispatch
+            self._run_unified(sched_out.decodes + sched_out.prefills, out)
+        else:
+            self._execute_split(sched_out, out)
+        for req, block_ids, seq_len in sched_out.kv_transfer_requests:
+            # skip the device→host gather when no sink consumes it, but
+            # still ACK so the scheduler releases the pinned pages
+            if extract_kv:
+                out.extracted_kv[req.request_id] = self.extract_kv(
+                    block_ids, seq_len
+                )
+            out.kv_extracted_req_ids.add(req.request_id)
+        return out
+
+    def _execute_split(self, sched_out: SchedulerOutput,
+                       out: RunnerOutput) -> None:
+        """The bucketed-jit split path: up to three separately padded
+        executables per step (fresh prefill / chunked continuation /
+        decode) — the fallback matrix behind the unified ragged path
+        (spec decode, logprobs, collect_hidden, embeds inputs; see
+        docs/ragged_batching.md)."""
         plain = [s for s in sched_out.decodes if s.num_new_tokens == 1]
         spec = [s for s in sched_out.decodes if s.num_new_tokens > 1]
         if plain:
@@ -581,15 +730,165 @@ class ARModelRunner:
                     runner(token_only, out)
                 if with_embeds:
                     runner(with_embeds, out, use_embeds=True)
-        for req, block_ids, seq_len in sched_out.kv_transfer_requests:
-            # skip the device→host gather when no sink consumes it, but
-            # still ACK so the scheduler releases the pinned pages
-            if extract_kv:
-                out.extracted_kv[req.request_id] = self.extract_kv(
-                    block_ids, seq_len
-                )
-            out.kv_extracted_req_ids.add(req.request_id)
-        return out
+
+    # ---------------------------------------------------- unified ragged
+    def _unified_eligible(self, sched_out: SchedulerOutput) -> bool:
+        """Mixed/prefill steps ride the unified token-packed executable
+        when the scheduler emitted a unified batch and nothing in it
+        needs the split path (the fallback matrix: spec decode,
+        logprobs, collect_hidden, embeds/deepstack inputs, multi-step
+        windows).  Pure-decode steps keep the dedicated [B] decode
+        executables — 1 row per sequence beats token-block alignment."""
+        if self._unified_fn is None or not getattr(
+                sched_out, "unified", False):
+            return False
+        if not sched_out.prefills:
+            return False
+        if self.collect_hidden or self.draft_fn is not None:
+            return False
+        scheds = sched_out.decodes + sched_out.prefills
+        if len(scheds) > self._batch_buckets[-1]:
+            return False
+        total = sum(align_to_block(s.num_new_tokens) for s in scheds)
+        if total > self._token_buckets[-1]:
+            return False
+        for s in sched_out.decodes:
+            if s.num_new_tokens != 1 or s.window != 1:
+                return False
+        for s in scheds:
+            req = s.request
+            if (req.sampling_params.logprobs is not None
+                    or req.prompt_embeds is not None
+                    or req.deepstack_embeds):
+                return False
+        return True
+
+    def _assemble_unified(self, scheds: list[ScheduledRequest]):
+        """Token-packed device inputs for a mixed batch: each sequence's
+        chunk occupies a token-block-aligned segment of the flat token
+        axis (the layout contract of ops/ragged_paged_attention.py);
+        metadata arrays are fixed [S_max] width so shapes vary only in
+        the token bucket."""
+        s_max = self._batch_buckets[-1]
+        n = len(scheds)
+        cu = np.zeros((s_max + 1,), np.int32)
+        q_lens = np.zeros((s_max,), np.int32)
+        seq_lens = np.zeros((s_max,), np.int32)
+        tables = np.zeros((s_max, self.max_pages_per_seq), np.int32)
+        total = 0
+        for i, sc in enumerate(scheds):
+            cu[i] = total
+            q_lens[i] = sc.num_new_tokens
+            seq_lens[i] = sc.start_pos + sc.num_new_tokens
+            t = sc.block_table[: self.max_pages_per_seq]
+            tables[i, : len(t)] = t
+            total += align_to_block(sc.num_new_tokens)
+        cu[n:] = total
+        t_pad = _bucket(max(total, self._token_buckets[0]),
+                        self._token_buckets)
+        token_ids = np.zeros((t_pad,), np.int32)
+        positions = (np.zeros((3, t_pad), np.int32) if self.use_mrope
+                     else np.zeros((t_pad,), np.int32))
+        slots = np.full((t_pad,), -1, np.int32)
+        last_idx = np.zeros((s_max,), np.int32)
+        for i, sc in enumerate(scheds):
+            m = sc.num_new_tokens
+            lo = int(cu[i])
+            # an async-fed decode row's input token is still in flight
+            # (all_token_ids slice comes back empty): dispatch_unified
+            # scatters it device-side from the previous handle
+            toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + m]
+            token_ids[lo: lo + len(toks)] = toks
+            p = np.arange(sc.start_pos, sc.start_pos + m)
+            if self.use_mrope:
+                positions[:, lo: lo + m] = self._mrope_cols(sc.request, p)
+            else:
+                positions[lo: lo + m] = p
+            slots[lo: lo + m] = sc.slot_mapping
+            last_idx[i] = lo + m - 1
+        return UnifiedBatch(token_ids, positions, slots, tables,
+                            seq_lens, cu, q_lens, last_idx, t_pad, total)
+
+    def _unified_sampling(self, scheds, key_tag: str, t_pad: int):
+        """[S_max]-wide SamplingTensors: real params on rows whose chunk
+        reaches the sequence's last token (the sequence-final flag),
+        greedy padding elsewhere (keeps sample_tokens' fast path)."""
+        s_max = self._batch_buckets[-1]
+        params_list = [_PAD_SAMPLING] * s_max
+        salts = [0] * s_max
+        final = []
+        for i, sc in enumerate(scheds):
+            req = sc.request
+            if sc.samples_final:
+                final.append((i, sc))
+                params_list[i] = req.sampling_params
+                salts[i] = self._salt_of(req.request_id)
+        key = (key_tag, t_pad) + tuple(
+            (i, sc.request.request_id)
+            + _params_key(sc.request.sampling_params) for i, sc in final)
+        return self._sampling_tensors(key, params_list, salts), final
+
+    def _call_unified(self, asm: UnifiedBatch, tensors, token_ids,
+                      n: int):
+        """Shared device-invocation half of the sync and async unified
+        paths — ONE dispatch for the whole mixed batch."""
+        self._note_padding(int(asm.q_lens.sum()), asm.t_pad)
+        toks, self.kv_caches = self._run_jit(
+            "unified", (asm.t_pad,), lambda: self._unified_fn(
+                self.params, token_ids, self.kv_caches,
+                jnp.asarray(asm.positions), jnp.asarray(asm.slots),
+                jnp.asarray(asm.tables), jnp.asarray(asm.seq_lens),
+                jnp.asarray(asm.cu_q_lens), jnp.asarray(asm.q_lens),
+                jnp.asarray([n], jnp.int32), jnp.asarray(asm.last_idx),
+                tensors.temperature, tensors.top_k, tensors.top_p,
+                tensors.keys))
+        return toks
+
+    def _run_unified(self, scheds: list[ScheduledRequest],
+                     out: RunnerOutput) -> None:
+        asm = self._assemble_unified(scheds)
+        tensors, final = self._unified_sampling(scheds, "unified",
+                                                asm.t_pad)
+        toks = self._call_unified(asm, tensors,
+                                  jnp.asarray(asm.token_ids),
+                                  len(scheds))
+        # omnilint: disable=OL2 - batch boundary: scheduler needs tokens
+        toks = np.asarray(jax.device_get(toks))
+        for i, sc in final:
+            out.sampled[sc.request.request_id] = int(toks[i])
+
+    def dispatch_unified(
+        self, sched_out: SchedulerOutput,
+        prev: Optional[InflightDecode] = None,
+    ) -> InflightDecode:
+        """Async dispatch of a unified MIXED step: prefill chunks no
+        longer force the two-slot pipeline to drain (engine/
+        llm_engine.py).  Decode rows whose input token is still in
+        flight gather it device-side from ``prev.tokens`` — the same
+        device-resident feedback as ``dispatch_decode``; the returned
+        handle is retire-compatible with it (``retire_decode``)."""
+        self._step += 1
+        scheds = sched_out.decodes + sched_out.prefills
+        asm = self._assemble_unified(scheds)
+        tensors, final = self._unified_sampling(scheds, "udispatch",
+                                                asm.t_pad)
+        feed_dst: list[int] = []
+        feed_src: list[int] = []
+        for i, sc in enumerate(scheds):
+            if sc.start_pos >= sc.request.num_tokens:
+                # input token sampled by the previous dispatch, still
+                # device-resident
+                feed_dst.append(int(asm.cu_q_lens[i]))
+                feed_src.append(prev.rows[sc.request.request_id])
+        token_ids = jnp.asarray(asm.token_ids)
+        if feed_dst:
+            token_ids = token_ids.at[jnp.asarray(feed_dst)].set(
+                prev.tokens[jnp.asarray(feed_src)])
+        toks = self._call_unified(asm, tensors, token_ids, len(scheds))
+        return InflightDecode(
+            tokens=toks,
+            rows={sc.request.request_id: i for i, sc in final},
+        )
 
     # ------------------------------------------------------------- prefill
     def _run_prefill(self, scheds: list[ScheduledRequest], out: RunnerOutput,
@@ -672,9 +971,12 @@ class ARModelRunner:
             (jnp.asarray(deep, dtype=self.params_dtype)
              if deep is not None else None),
         )
+        self._note_padding(sum(s.num_new_tokens for s in scheds),
+                           b * s_len)
         if cont:
-            logits, last_hidden, hidden, self.kv_caches = (
-                self._chunk_prefill_fn(
+            logits, last_hidden, hidden, self.kv_caches = self._run_jit(
+                "chunk", (b, s_len, pages, use_embeds, deep is not None),
+                lambda: self._chunk_prefill_fn(
                     self.params, jnp.asarray(token_ids), self.kv_caches,
                     jnp.asarray(positions), jnp.asarray(slots),
                     jnp.asarray(last_idx), jnp.asarray(tables),
@@ -682,10 +984,13 @@ class ARModelRunner:
                 )
             )
         else:
-            logits, last_hidden, hidden, self.kv_caches = self._prefill_fn(
-                self.params, jnp.asarray(token_ids), self.kv_caches,
-                jnp.asarray(positions), jnp.asarray(slots),
-                jnp.asarray(last_idx), *embeds_args,
+            logits, last_hidden, hidden, self.kv_caches = self._run_jit(
+                "prefill", (b, s_len, use_embeds, deep is not None),
+                lambda: self._prefill_fn(
+                    self.params, jnp.asarray(token_ids), self.kv_caches,
+                    jnp.asarray(positions), jnp.asarray(slots),
+                    jnp.asarray(last_idx), *embeds_args,
+                )
             )
         self._sample_and_record(scheds, logits, last_hidden, out,
                                 full_hidden=hidden)
@@ -754,10 +1059,13 @@ class ARModelRunner:
             token_ids[i] = sc.request.all_token_ids[sc.start_pos]
         positions, slots, tables, ctx = self._assemble_decode_rows(
             scheds, b)
-        logits, hidden, self.kv_caches = self._decode_fn(
-            self.params, jnp.asarray(token_ids), self.kv_caches,
-            jnp.asarray(positions), jnp.asarray(slots),
-            jnp.asarray(tables), jnp.asarray(ctx),
+        self._note_padding(len(scheds), b)
+        logits, hidden, self.kv_caches = self._run_jit(
+            "decode", (b,), lambda: self._decode_fn(
+                self.params, jnp.asarray(token_ids), self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(tables), jnp.asarray(ctx),
+            )
         )
         self._sample_and_record(scheds, logits, hidden, out)
         self._maybe_draft(scheds, hidden, out)
@@ -801,12 +1109,15 @@ class ARModelRunner:
             (sc.request.request_id,) + _params_key(
                 sc.request.sampling_params) for sc in scheds)
         tensors = self._sampling_tensors(key, params_list, salts)
-        toks, self.kv_caches = self._decode_sample_fn(
-            self.params, token_ids, self.kv_caches,
-            jnp.asarray(positions), jnp.asarray(slots),
-            jnp.asarray(tables), jnp.asarray(ctx),
-            tensors.temperature, tensors.top_k, tensors.top_p,
-            tensors.keys,
+        self._note_padding(len(scheds), b)
+        toks, self.kv_caches = self._run_jit(
+            "dispatch", (b,), lambda: self._decode_sample_fn(
+                self.params, token_ids, self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(tables), jnp.asarray(ctx),
+                tensors.temperature, tensors.top_k, tensors.top_p,
+                tensors.keys,
+            )
         )
         return InflightDecode(
             tokens=toks,
@@ -891,12 +1202,15 @@ class ARModelRunner:
             (sc.request.request_id,) + _params_key(
                 sc.request.sampling_params) for sc in scheds)
         tensors = self._sampling_tensors(key, params_list, salts)
-        toks, self.kv_caches = self._decode_multi_fn(
-            self.params, jnp.asarray(token_ids), self.kv_caches,
-            jnp.asarray(positions), jnp.asarray(gpos),
-            jnp.asarray(valid), jnp.asarray(tables),
-            tensors.temperature, tensors.top_k, tensors.top_p,
-            tensors.keys, w,
+        self._note_padding(len(scheds) * w, b * w)
+        toks, self.kv_caches = self._run_jit(
+            "multi", (b, w), lambda: self._decode_multi_fn(
+                self.params, jnp.asarray(token_ids), self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(gpos),
+                jnp.asarray(valid), jnp.asarray(tables),
+                tensors.temperature, tensors.top_k, tensors.top_p,
+                tensors.keys, w,
+            )
         )
         # omnilint: disable=OL2 - the ONE sync per window (the point of
         # multi-step decode: W steps, one host round trip)
@@ -937,10 +1251,16 @@ class ARModelRunner:
                 positions[i, :n] = p
             slots[i, :n] = sc.slot_mapping
 
-        logits, hidden, self.kv_caches = self._verify_fn(
-            self.params, jnp.asarray(token_ids), self.kv_caches,
-            jnp.asarray(positions), jnp.asarray(slots),
-            jnp.asarray(tables), jnp.asarray(ctx), jnp.asarray(q_starts),
+        self._note_padding(sum(s.num_new_tokens for s in scheds),
+                           b * s_len)
+        logits, hidden, self.kv_caches = self._run_jit(
+            "verify", (b, s_len, tables.shape[1]),
+            lambda: self._verify_fn(
+                self.params, jnp.asarray(token_ids), self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(tables), jnp.asarray(ctx),
+                jnp.asarray(q_starts),
+            )
         )
         # omnilint: disable=OL2 - batch boundary: verify needs argmax host-side
         greedy = np.asarray(jax.device_get(
@@ -1141,11 +1461,11 @@ class ARModelRunner:
     ):
         # Requests sample only when the forward covered their last token —
         # num_tokens, not num_prompt_tokens, so a preempted request that
-        # recomputes prompt+generated KV resumes without double-sampling.
+        # recomputes prompt+generated KV resumes without double-sampling
+        # (samples_final: the predicate shared with the scheduler's
+        # async accounting and the unified path).
         sampling = [
-            (i, sc) for i, sc in enumerate(scheds)
-            if sc.start_pos + sc.num_new_tokens >= sc.request.num_tokens
-            and not sc.request.awaiting_chunks
+            (i, sc) for i, sc in enumerate(scheds) if sc.samples_final
         ]
         if sampling:
             # Sample the full padded batch (one compile per bucket shape);
