@@ -1,0 +1,26 @@
+#!/usr/bin/env sh
+# kvcache gate: the radix prefix index vs the flat chained-hash oracle
+# (randomized request streams — same hits, same refcounts, no page
+# leaks), the pin/evict-under-pressure regression, and the tiered
+# offload contract end to end — park-on-preempt + restore with greedy
+# streams bit-identical to a never-offloaded oracle, eviction offload
+# to the host tier, remote-tier demotion/promotion, int8 cold-path
+# round trips, restore-failure degradation to recompute, and the
+# /metrics series (kv_prefix_hit_tokens_total, kv_tier_*_pages,
+# kv_offload_bytes_total{tier,dir}, kv_restore_seconds).
+#
+# Standalone face of the same coverage tier-1 carries (tests/core and
+# tests/engine are fast directories), sitting next to scripts/ragged.sh,
+# scripts/asyncstep.sh, scripts/omnilint.sh and scripts/faultmatrix.sh
+# as a pre-merge gate:
+#
+#   scripts/kvcache.sh               # radix index + tiered offload
+#   scripts/kvcache.sh -k remote     # pass-through pytest args
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU: the bit-equality oracles run on the fake-device path; the
+# gate must never touch a real chip a colocated serving process owns
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/core/test_radix_prefix.py \
+    tests/engine/test_kv_offload.py \
+    -q -p no:cacheprovider -m "not slow" "$@"

@@ -1,0 +1,502 @@
+"""Radix prefix index: randomized oracle cross-check + tier plumbing.
+
+The radix index (kvcache/radix.py) replaced the flat chained-hash map
+inside ``KVCacheManager``.  Under NO eviction pressure the two must be
+behaviorally identical — same hits, same hit-token counts, same
+refcounts, no page leaks — so a compact reimplementation of the old
+flat map drives the same randomized request stream as the real manager
+and every divergence is a bug.  Under pressure the flat map's behavior
+was the thing being FIXED (mid-chain eviction orphaning suffixes), so
+the pressure phase checks structural invariants and page conservation
+instead of equivalence.
+
+Also the satellite regression for the pin/evict race: a page pinned by
+one request's in-flight transfer must be unevictable even when another
+sharer's free() drops its last cache reference.
+"""
+
+import hashlib
+import random
+
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.kvcache import OffloadPolicy, TieredKVStore
+from vllm_omni_tpu.kvcache.radix import RadixPrefixIndex
+from vllm_omni_tpu.request import Request
+
+
+def _req(rid, ids):
+    return Request(request_id=rid, prompt_token_ids=list(ids))
+
+
+# --------------------------------------------------------------- oracle
+class FlatPrefixOracle:
+    """The OLD flat chained-hash prefix cache, boiled down to its
+    match/register/refcount observables (no real pages — it scores
+    hits on the same prompts the manager sees)."""
+
+    def __init__(self, page_size):
+        self.page_size = page_size
+        self._cached: dict[str, str] = {}   # hash -> producing owner
+        self._ref: dict[str, int] = {}      # hash -> live refs
+        self._adopted: dict[str, list[str]] = {}
+
+    def _hashes(self, ids, max_pages=None):
+        out, prev = [], b""
+        n = len(ids) // self.page_size
+        if max_pages is not None:
+            n = min(n, max_pages)
+        for p in range(n):
+            chunk = ids[p * self.page_size:(p + 1) * self.page_size]
+            h = hashlib.blake2b(
+                prev + b"," + repr(list(chunk)).encode(),
+                digest_size=16).hexdigest()
+            out.append(h)
+            prev = h.encode()
+        return out
+
+    def match(self, rid, ids):
+        usable = len(ids) - 1
+        hashes = self._hashes(ids, max_pages=usable // self.page_size)
+        hit = []
+        for h in hashes:
+            if h not in self._cached:
+                break
+            hit.append(h)
+        if hit:
+            for h in hit:
+                self._ref[h] = self._ref.get(h, 0) + 1
+            self._adopted[rid] = hit
+        return len(hit) * self.page_size
+
+    def free(self, rid, ids, computed):
+        for h in self._adopted.pop(rid, ()):
+            self._ref[h] -= 1
+        valid = min(len(ids) // self.page_size,
+                    computed // self.page_size)
+        for h in self._hashes(ids)[:valid]:
+            self._cached.setdefault(h, rid)
+
+    def refcount(self, ids, n_pages):
+        return [self._ref.get(h, 0)
+                for h in self._hashes(ids)[:n_pages]]
+
+
+def _page_accounting(kv: KVCacheManager) -> dict:
+    """Every page must be exactly one of: free, in a live table (and
+    not index-owned), or index-owned."""
+    owned = set(kv.index._by_page)
+    table_pages = set()
+    for t in kv._tables.values():
+        table_pages.update(t)
+    free = set(kv._free)
+    return {"free": free, "tables": table_pages, "index": owned}
+
+
+def _assert_no_leaks(kv: KVCacheManager):
+    acct = _page_accounting(kv)
+    # free pages never overlap live storage
+    assert not (acct["free"] & acct["tables"]), "free∩tables"
+    assert not (acct["free"] & acct["index"]), "free∩index"
+    pinned = kv._pinned_pages()
+    covered = acct["free"] | acct["tables"] | acct["index"] | pinned
+    assert covered == set(range(kv.num_pages)), (
+        f"leaked pages: {set(range(kv.num_pages)) - covered}")
+    assert not kv.index.check_invariants()
+
+
+# ------------------------------------------------- randomized equivalence
+def test_radix_matches_flat_oracle_no_pressure():
+    """Same random stream, no eviction pressure: identical hits,
+    identical per-page refcounts, zero leaks."""
+    rng = random.Random(1234)
+    page = 4
+    kv = KVCacheManager(num_pages=4096, page_size=page)
+    oracle = FlatPrefixOracle(page)
+    # small alphabet + shared stems => heavy prefix overlap
+    stems = [[rng.randrange(8) for _ in range(rng.randrange(4, 24))]
+             for _ in range(6)]
+    live: dict[str, Request] = {}
+    for i in range(400):
+        op = rng.random()
+        if op < 0.6 or not live:
+            stem = rng.choice(stems)
+            ids = (list(stem)
+                   + [rng.randrange(8)
+                      for _ in range(rng.randrange(1, 12))])
+            rid = f"r{i}"
+            req = _req(rid, ids)
+            got = kv.match_prefix(req)
+            want = oracle.match(rid, ids)
+            assert got == want, f"hit divergence at {i}: {got} != {want}"
+            assert kv.allocate(req, len(ids) - got) is not None
+            req.num_computed_tokens = len(ids)
+            live[rid] = req
+        else:
+            rid = rng.choice(sorted(live))
+            req = live.pop(rid)
+            kv.free(req)
+            oracle.free(rid, req.prompt_token_ids,
+                        req.num_computed_tokens)
+        _assert_no_leaks(kv)
+        # spot-check refcounts on a shared stem's pages
+        stem = stems[0]
+        nodes = kv.index.match(stem, max_pages=len(stem) // page)
+        want_refs = oracle.refcount(stem, len(nodes))
+        assert [n.ref for n in nodes] == want_refs
+    for req in live.values():
+        kv.free(req)
+    _assert_no_leaks(kv)
+    assert kv.prefix_hits > 0 and kv.prefix_hit_tokens > 0
+
+
+def test_radix_invariants_under_pressure():
+    """Tiny pool, constant eviction: structural invariants + page
+    conservation hold on every step (equivalence with the flat map is
+    OUT of scope here — mid-chain orphaning is what got fixed)."""
+    rng = random.Random(99)
+    page = 4
+    kv = KVCacheManager(num_pages=16, page_size=page)
+    stems = [[rng.randrange(4) for _ in range(12)] for _ in range(3)]
+    live: dict[str, Request] = {}
+    for i in range(300):
+        if rng.random() < 0.55 or not live:
+            stem = rng.choice(stems)
+            ids = list(stem) + [rng.randrange(4)
+                                for _ in range(rng.randrange(1, 8))]
+            req = _req(f"p{i}", ids)
+            kv.match_prefix(req)
+            remaining = len(ids) - req.num_computed_tokens
+            if kv.can_allocate(req, remaining) \
+                    and kv.allocate(req, remaining) is not None:
+                req.num_computed_tokens = len(ids)
+                live[req.request_id] = req
+            else:
+                kv.free(req)
+        else:
+            kv.free(live.pop(rng.choice(sorted(live))))
+        _assert_no_leaks(kv)
+    for req in live.values():
+        kv.free(req)
+    _assert_no_leaks(kv)
+    # the pool must be fully recoverable
+    assert kv.reset_prefix_cache() >= 0
+    assert kv.num_free_pages == kv.num_pages
+
+
+def test_deep_eviction_keeps_prefix_over_extension():
+    """The fix over the flat map: under pressure the EXTENSION page is
+    reclaimed first and the shared prefix stays matchable."""
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    a = _req("a", list(range(12)))          # 3 pages, all full: register
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)
+    assert kv.index.hbm_pages() == 3
+    # pressure: a fresh request needs 3 pages -> 1 free + 2 evictions
+    b = _req("b", [50, 51, 52, 53, 54, 55, 56, 57, 58])
+    assert kv.allocate(b, 9) is not None
+    # the SURVIVING cached page is the depth-1 PREFIX — eviction took
+    # the two extensions first — so a follow-up sharing the stem still
+    # hits 4 tokens (the flat map's LRU popped insertion order, i.e.
+    # the chain head, orphaning the whole chain)
+    assert kv.index.hbm_pages() == 1
+    c = _req("c", list(range(12)))
+    assert kv.match_prefix(c) == 4
+    assert c.num_computed_tokens == 4
+    survivor = kv.index._by_page[kv.block_table("c")[0]]
+    assert survivor.tokens == (0, 1, 2, 3)
+
+
+# --------------------------------------------------- pin/evict regression
+def test_pinned_shared_page_is_unevictable():
+    """Satellite fix: R1 pins a SHARED cached page for an in-flight
+    transfer; R2 (the other sharer) frees — the page's last cache ref
+    drops, but it must NOT enter the evictable pool until the ACK."""
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    prod = _req("prod", list(range(8)))     # 2 full pages register
+    kv.allocate(prod, 8)
+    prod.num_computed_tokens = 8
+    kv.free(prod)
+    r1, r2 = _req("r1", list(range(8)) + [9]), _req("r2", list(range(8)) + [9])
+    assert kv.match_prefix(r1) == 8
+    assert kv.match_prefix(r2) == 8
+    shared = kv.block_table("r1")
+    assert kv.block_table("r2") == shared
+    pinned = kv.pin_for_transfer(r1, 8)     # transfer in flight
+    assert pinned == shared
+    kv.free(r1)
+    kv.free(r2)                             # last sharer gone
+    # both shared pages are pinned: NOT free, NOT evictable
+    assert kv.num_free_pages == 2
+    # allocation pressure must not reclaim them mid-read
+    big = _req("big", list(range(100, 116)))
+    table = kv.allocate(big, 8)             # wants 2 pages: the free ones
+    assert table is not None
+    assert not (set(table) & set(pinned)), \
+        "evict-under-pressure handed out a pinned page"
+    assert kv.allocate(_req("more", [1, 2, 3, 4]), 4) is None
+    # ACK releases the pin; the cached pages become evictable again
+    kv.ack_transfer("r1")
+    assert kv.num_free_pages == 2
+    c = _req("c", list(range(8)) + [7])
+    assert kv.match_prefix(c) == 8          # still cached, content kept
+    kv.free(big)
+    kv.free(c)
+    assert kv.reset_prefix_cache() == 2
+    assert kv.num_free_pages == kv.num_pages
+
+
+def test_pin_refcounts_stack_across_requests():
+    """Two transfers pinning the same page: one ACK must not release
+    the other's pin."""
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    prod = _req("prod", list(range(8)))
+    kv.allocate(prod, 8)
+    prod.num_computed_tokens = 8
+    kv.free(prod)
+    r1, r2 = _req("r1", list(range(9))), _req("r2", list(range(9)))
+    kv.match_prefix(r1)
+    kv.match_prefix(r2)
+    kv.pin_for_transfer(r1, 8)
+    kv.pin_for_transfer(r2, 8)
+    kv.free(r1)
+    kv.free(r2)
+    kv.ack_transfer("r1")
+    assert kv.num_free_pages == 2           # r2's pin still holds
+    kv.ack_transfer("r2")
+    assert kv.num_free_pages == 4
+
+
+# ------------------------------------------------------- tiered plumbing
+def _offload_kv(**kw):
+    tiers = TieredKVStore(**kw)
+    kv = KVCacheManager(num_pages=4, page_size=4, tiers=tiers,
+                        policy=OffloadPolicy(mode="always"))
+    return kv, tiers
+
+
+def _drain_offloads(kv, tiers):
+    """Engine-drain stand-in: park each queued payload and clear the
+    in-flight marks, exactly like LLMEngine._drain_kv_moves."""
+    for off in kv.pending_offloads:
+        tiers.put(off.key, [])              # content irrelevant here
+        kv.note_park_extracted(off.key)
+    kv.pending_offloads.clear()
+
+
+def test_eviction_offload_queues_extract_and_keeps_node_matchable():
+    kv, tiers = _offload_kv()
+    a = _req("a", list(range(12)))
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)
+    b = _req("b", [9, 9, 9, 9, 9, 9, 9, 9, 9])
+    assert kv.allocate(b, 9) is not None    # 1 free page + 2 evictions
+    assert len(kv.pending_offloads) == 2
+    for off in kv.pending_offloads:
+        assert off.n_tokens == 4 and len(off.pages) == 1
+    _drain_offloads(kv, tiers)
+    kv.free(b)
+    # cold nodes are still matchable: the hot depth-1 prefix adopts
+    # directly, the cold depth-2 node comes back via a queued restore
+    c = _req("c", list(range(12)))
+    matched = kv.match_prefix(c)
+    assert matched == 8
+    assert len(kv.pending_restores) == 1
+    r = kv.pending_restores[0]
+    assert r.n_tokens == 4 and r.request_id == "c"
+    assert kv.restored_tokens == 4
+
+
+def test_same_pass_evict_then_match_trusts_inflight_extraction():
+    """A node evicted cold earlier in the SAME schedule pass (its
+    extraction queued but not yet drained) must still match: the
+    engine drains extractions before restore fetches, so the payload
+    exists by fetch time.  Dropping it would orphan the payload the
+    drain later stores."""
+    kv, tiers = _offload_kv()
+    a = _req("a", list(range(12)))
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)
+    b = _req("b", [9] * 9)
+    assert kv.allocate(b, 9) is not None    # queues 2 offloads
+    assert len(kv.pending_offloads) == 2
+    assert not tiers.has(kv.pending_offloads[0].key)  # NOT drained yet
+    kv.free(b)
+    c = _req("c", list(range(12)))
+    # same pass: tiers.has() is False but the key is in flight
+    assert kv.match_prefix(c) == 8
+    assert len(kv.pending_restores) == 1
+    _assert_no_leaks(kv)
+
+
+def test_park_and_restore_lifecycle():
+    kv, tiers = _offload_kv()
+    a = _req("a", list(range(10)))
+    kv.allocate(a, 10)
+    a.num_computed_tokens = 10
+    parked = kv.park_request(a)
+    # parks the committed run, always leaving >= 1 token to compute on
+    # resume (its forward produces the logits to sample from)
+    assert parked == 9
+    assert kv.park_in_flight(a)
+    off = kv.pending_offloads[-1]
+    assert off.key == "park/a" and off.n_tokens == 9
+    kv.free(a)
+    a.num_computed_tokens = 0
+    # payload not extracted yet -> not restorable
+    assert not kv.parked_available(a)
+    tiers.put(off.key, [])
+    kv.note_park_extracted(off.key)
+    kv.pending_offloads.clear()
+    assert not kv.park_in_flight(a) and kv.parked_available(a)
+    assert kv.restore_parked(a)
+    assert a.num_computed_tokens == 9
+    assert "_parked_len" not in a.additional_information
+    assert kv.pending_restores[-1].drop_after
+
+
+def test_restore_truncated_rewinds_and_frees():
+    kv, tiers = _offload_kv()
+    a = _req("a", list(range(12)))
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)
+    b = _req("b", [9] * 9)
+    kv.allocate(b, 9)
+    _drain_offloads(kv, tiers)
+    kv.free(b)
+    c = _req("c", list(range(12)))
+    assert kv.match_prefix(c) == 8
+    # drain finds the cold payload gone: keep the hot 4-token prefix
+    kv.restore_truncated(c, 4)
+    assert c.num_computed_tokens == 4
+    assert len(kv.block_table("c")) == 1
+    kv.free(c)
+    _assert_no_leaks(kv)
+
+
+def test_restore_failure_unwinds_node_off_garbage_page():
+    """A cold node whose payload vanished between match and drain must
+    NOT stay bound to its (never-injected, garbage) HBM page — a later
+    match would adopt uninitialized KV.  The unwind marks it cold
+    again; the has() check then prunes it for good."""
+    kv, tiers = _offload_kv()
+    a = _req("a", list(range(12)))
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)
+    kv.allocate(_req("b", [9] * 9), 9)      # evicts 2 nodes cold
+    _drain_offloads(kv, tiers)
+    kv.free(_req("b", [9] * 9))
+    c = _req("c", list(range(12)))
+    assert kv.match_prefix(c) == 8
+    entry = kv.pending_restores[0]
+    node = entry.nodes[0]
+    assert node.page is not None            # rebound, awaiting inject
+    tiers.drop(entry.key)                   # payload vanishes pre-drain
+    kv.restore_failed_entries(c, [entry], entry.start_tokens)
+    assert node.page is None, "failed node left on a garbage page"
+    assert c.num_computed_tokens == entry.start_tokens
+    # the garbage page went back to the pool, not leaked
+    kv.free(c)
+    _assert_no_leaks(kv)
+    # and a later match no longer trusts the lost payload
+    d = _req("d", list(range(12)))
+    assert kv.match_prefix(d) == entry.start_tokens
+
+
+def test_restore_failure_unwinds_coadopter_off_shared_garbage_page():
+    """Two requests admitted in one pass can share a failing restore:
+    the first match rebinds the cold node to a fresh page and queues
+    the restore; the second sees the node hot and adopts it with NO
+    restore entry.  When the fetch fails, BOTH must unwind — and the
+    shared garbage page must be freed exactly once, by whichever
+    truncation runs last (never while the other table still holds it)."""
+    kv, tiers = _offload_kv()
+    a = _req("a", list(range(12)))
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)
+    kv.allocate(_req("b", [9] * 9), 9)      # evicts 2 nodes cold
+    _drain_offloads(kv, tiers)
+    kv.free(_req("b", [9] * 9))
+    c = _req("c", list(range(12)))
+    d = _req("d", list(range(12)))
+    assert kv.match_prefix(c) == 8          # rebinds the cold node
+    assert kv.match_prefix(d) == 8          # co-adopts it HOT
+    assert len(kv.pending_restores) == 1, "d must not queue a restore"
+    entry = kv.pending_restores[0]
+    garbage = entry.nodes[0].page
+    assert garbage in kv.block_table("c") and garbage in kv.block_table("d")
+    tiers.drop(entry.key)                   # payload vanishes pre-drain
+    co = kv.restore_failed_entries(c, [entry], entry.start_tokens)
+    assert co == {"d": entry.start_tokens}, \
+        "co-adopter must be reported for unwinding"
+    # c truncated; the garbage page is still in d's table -> NOT freed
+    assert garbage not in kv._free
+    assert garbage in kv.block_table("d")
+    kv.restore_truncated(d, co["d"])
+    assert d.num_computed_tokens == entry.start_tokens
+    assert garbage in kv._free              # freed exactly once, now
+    assert kv._free.count(garbage) == 1
+    kv.free(c)
+    kv.free(d)
+    _assert_no_leaks(kv)
+
+
+def test_allocate_failure_is_side_effect_free():
+    """A failed allocate must not register a stale (empty or partial)
+    table entry: match_prefix treats ANY registered table as already
+    matched, so the stale entry would permanently disable prefix
+    adoption for that request — it would recompute its whole prompt
+    even with its prefix sitting hot in the index."""
+    kv = KVCacheManager(num_pages=4, page_size=4)
+    a = _req("a", list(range(12)))
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)                              # 3 cached nodes + 1 free
+    big = _req("big", list(range(12)) + [99] * 8)
+    assert kv.allocate(big, 20) is None     # needs 5 pages, pool has 4
+    assert "big" not in kv._tables, "stale empty table entry"
+    assert kv.match_prefix(big) == 12       # prefix adoption still works
+    kv.free(big)
+    _assert_no_leaks(kv)
+    # partial growth rolls back: force a mid-loop page-source failure
+    # (num_free_pages said yes, the pool then came up short)
+    kv2 = KVCacheManager(num_pages=4, page_size=4,
+                         enable_prefix_caching=False)
+    taken = []
+    orig_take = kv2._take_free_page
+
+    def flaky_take():
+        if len(taken) >= 2:
+            return None
+        page = orig_take()
+        taken.append(page)
+        return page
+
+    kv2._take_free_page = flaky_take
+    c = _req("c", list(range(12)))
+    free_before = sorted(kv2._free)
+    assert kv2.allocate(c, 12) is None      # takes 2 pages, 3rd fails
+    assert len(taken) == 2
+    assert "c" not in kv2._tables
+    assert sorted(kv2._free) == free_before, "partial growth leaked"
+
+
+def test_reset_prefix_cache_purges_cold_tiers():
+    kv, tiers = _offload_kv()
+    a = _req("a", list(range(12)))
+    kv.allocate(a, 12)
+    a.num_computed_tokens = 12
+    kv.free(a)
+    kv.allocate(_req("b", [9] * 9), 9)      # evicts two nodes cold
+    offs = list(kv.pending_offloads)
+    _drain_offloads(kv, tiers)
+    assert all(tiers.has(o.key) for o in offs)
+    kv.reset_prefix_cache()
+    assert not any(tiers.has(o.key) for o in offs), \
+        "cold payloads must be purged"
+    assert kv.index.hbm_pages() == 0

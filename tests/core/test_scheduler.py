@@ -97,10 +97,16 @@ def test_preemption_recompute_on_page_exhaustion():
     assert out2.preempted, "one request must be preempted on page exhaustion"
     victim = out2.preempted[0]
     assert victim.status == RequestStatus.PREEMPTED
-    assert victim.num_computed_tokens == 0  # recompute policy
     assert victim in s.waiting
     # the survivor still decoded
     assert len(out2.decodes) == 1
+    # recompute policy, radix-tempered: the victim's in-flight progress
+    # is discarded, but the radix index evicts DEEPEST-first, so the
+    # victim's first prompt page survives the survivor's allocation and
+    # is re-adopted at re-admission (the flat chained-hash map evicted
+    # the chain head and restarted from 0 — docs/kv_cache.md)
+    assert victim.num_computed_tokens == 4
+    assert len(s.kv.block_table(victim.request_id)) == 1
 
 
 def test_kv_transfer_trigger_on_prefill_finished():
@@ -250,3 +256,56 @@ def test_preemption_and_rejection_counters():
     assert s.num_rejections == 1
     s._preempt(_req("victim", n=4))
     assert s.num_preemptions == 1
+
+
+def test_restored_park_resumes_as_decode():
+    """Resume-as-decode: a restored preemption victim whose only
+    outstanding position is the sampling one re-enters through the
+    DECODE path — the executable the uninterrupted stream would have
+    run — not a 1-token prefill chunk (the two agree only to the last
+    ULP, which flips greedy argmaxes on near-flat logits;
+    docs/kv_cache.md)."""
+    import numpy as np
+
+    from vllm_omni_tpu.kvcache.policy import OffloadPolicy
+    from vllm_omni_tpu.kvcache.tiers import TieredKVStore
+
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                          max_model_len=64, kv_offload=True)
+    kv = KVCacheManager(4, 4, enable_prefix_caching=False,
+                        tiers=TieredKVStore(),
+                        policy=OffloadPolicy(mode="always"))
+    s = ARScheduler(cfg, kv)
+    s.add_request(_req("a", n=8, max_tokens=2))
+    s.add_request(_req("b", n=8, max_tokens=8))
+    out = s.schedule()          # both prefill: 2 pages each, pool full
+    assert len(out.prefills) == 2
+    s.update_from_output(out, {"a": 1, "b": 1})
+
+    out2 = s.schedule()         # a's decode page preempts b -> parked
+    assert out2.preempted and out2.preempted[0].request_id == "b"
+    victim = out2.preempted[0]
+    assert victim.additional_information.get("_parked_len") == 8
+    # simulate the engine's same-step extraction drain: the payload
+    # lands in the host tier and the in-flight marker clears
+    offloads, _ = kv.take_pending_moves()
+    parks = [o for o in offloads if o.key.endswith(victim.request_id)]
+    assert parks, "preemption with kv_offload must queue a park"
+    for o in parks:
+        kv.tiers.put(o.key, [(np.zeros(2, np.float32),
+                              np.zeros(2, np.float32))])
+        kv.note_park_extracted(o.key)
+    s.update_from_output(out2, {"a": 2})  # a finishes -> pages free
+
+    out3 = s.schedule()         # b restores; 1 token outstanding
+    assert not out3.prefills, \
+        "restored victim must not re-enter through the prefill path"
+    assert [d.request.request_id for d in out3.decodes] == ["b"]
+    d = out3.decodes[0]
+    assert d.num_new_tokens == 1 and d.window == 1
+    assert d.start_pos == victim.num_computed_tokens == 8
+    assert kv.restored_tokens == 8
+    # the resumed row continues its stream like any running decode
+    s.update_from_output(out3, {"b": 2})
+    assert victim.output_token_ids == [1, 2]
+    assert victim.status == RequestStatus.RUNNING

@@ -22,6 +22,11 @@ from __future__ import annotations
 
 HOT_PATHS: tuple[str, ...] = (
     "vllm_omni_tpu/core/",
+    # kvcache tier moves run between schedule() and execute() on the
+    # engine thread — a stray per-page host sync in the offload path
+    # multiplies by every payload parked that step (the batched
+    # extract/inject discipline of docs/kv_cache.md)
+    "vllm_omni_tpu/kvcache/",
     "vllm_omni_tpu/ops/",
     # the ragged unified kernel is covered by the ops/ prefix above;
     # listed explicitly because a stray host sync inside the ONE

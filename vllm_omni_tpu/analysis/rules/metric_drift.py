@@ -66,6 +66,15 @@ def synthetic_engine_snapshot() -> dict:
                       "rejections": 0},
         "kv": {"pages_total": 64, "pages_used": 8, "utilization": 0.125},
         "prefix_cache": {"enabled": True, "hits": 2, "hit_tokens": 16},
+        "kv_tiers": {
+            "hbm_pages": 8, "host_pages": 3, "remote_pages": 1,
+            "host_bytes": 12288,
+            "bytes_moved": {"host/out": 16384, "host/in": 8192,
+                            "remote/out": 4096, "remote/in": 4096},
+            "prefix_hit_tokens": 16, "restored_tokens": 24,
+            "parked_tokens": 32, "offload_evictions": 2,
+        },
+        "kv_restore_seconds": hist,
         "diffusion": {"requests_total": 3, "batches_total": 2,
                       "gen_seconds": hist},
     }

@@ -1,37 +1,91 @@
-"""Host-side paged KV-cache block pool with automatic prefix caching.
+"""Host-side paged KV-cache block pool over a radix prefix index.
 
 The behavioral port of vLLM's KVCacheManager slice that the reference's
 ``OmniARScheduler`` leans on (reference: core/sched/omni_ar_scheduler.py —
 block allocation during schedule(), block-id snapshots for KV transfer at
-:553-594, delayed free until extraction ACK at :444-546), plus the
-content-addressed prefix cache the reference inherits from vLLM core:
-full prompt pages register under a chained content hash when their
-producing request frees; a new request whose prompt shares the prefix
-adopts those pages (refcounted, shared across concurrent tables) and
-starts computing mid-prompt — the runner's chunked-continuation path
-attends the cached context exactly like a resumed chunked prefill.
-Cached pages with no live references stay allocatable (LRU-evicted on
-demand), so prefix caching never reduces effective capacity.
+:553-594, delayed free until extraction ACK at :444-546), grown from the
+flat chained-hash prefix cache into fleet-scale KV economics
+(docs/kv_cache.md):
 
-Device arrays never appear here: this class hands out integer page ids; the
-model runner turns them into ``block_tables`` / ``slot_mapping`` arrays for
-the Pallas paged-attention kernel (ops/paged_attention.py).  One pool is
-shared by all layers — every layer uses the same page ids, so the per-layer
-caches stay aligned (same layout the TPU kernel wants).
+- **Radix prefix index** (kvcache/radix.py): full prompt pages register
+  as reference-counted trie nodes when their producing request frees; a
+  new request adopts the longest matching root-path — shared across
+  concurrent requests and tenants — and starts computing mid-prompt.
+  Eviction is deepest-first LRU, so a prefix outlives its extensions
+  and the index never holds unmatchable orphan entries (the failure
+  mode of the flat map under pressure).
+- **Tiered offload** (kvcache/tiers.py + kvcache/policy.py): when the
+  pool is under pressure, evicted pages whose round trip beats
+  recompute PARK their KV in the host/remote tiers instead of dropping
+  it, and preempted requests park their whole computed run.  Cold
+  nodes stay matchable; adoption allocates fresh pages and queues a
+  restore.  This class only QUEUES device moves (pending_offloads /
+  pending_parks / pending_restores) — the engine drains the queues
+  between schedule() and execute() with batched pytree transfers
+  (``LLMEngine._drain_kv_moves``).
+
+Device arrays never appear here: this class hands out integer page ids;
+the model runner turns them into ``block_tables`` / ``slot_mapping``
+arrays for the Pallas paged-attention kernel (ops/paged_attention.py).
+One pool is shared by all layers — every layer uses the same page ids,
+so the per-layer caches stay aligned (same layout the TPU kernel wants).
 """
 
 from __future__ import annotations
 
-import hashlib
-from collections import OrderedDict
+from dataclasses import dataclass, field
 from typing import Optional
 
+from vllm_omni_tpu.kvcache.policy import OffloadPolicy
+from vllm_omni_tpu.kvcache.radix import RadixNode, RadixPrefixIndex
+from vllm_omni_tpu.kvcache.tiers import TIER_HOST, TieredKVStore
+from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.request import Request
+
+logger = init_logger(__name__)
+
+
+def park_key(request_id: str) -> str:
+    """Tier-store key of a preempted request's parked KV run."""
+    return f"park/{request_id}"
+
+
+@dataclass
+class PendingOffload:
+    """Extract ``n_tokens`` of KV from ``pages`` and park under ``key``
+    (drained by the engine BEFORE this step's forward reuses the
+    pages)."""
+
+    key: str
+    pages: list[int]
+    n_tokens: int
+
+
+@dataclass
+class PendingRestore:
+    """Inject the tier payload at ``key`` into freshly allocated
+    ``pages`` for ``request_id`` (drained before the forward attends
+    them).  ``start_tokens`` is the payload's position offset within
+    the request — on a fetch failure the contiguous valid prefix ends
+    exactly there (cold nodes can interleave with hot ones, so a sum
+    of injected lengths would be wrong).  ``nodes`` are the adopted
+    radix nodes the payloads back (empty for a park restore);
+    ``drop_after`` deletes the one-shot park payload once injected."""
+
+    request_id: str
+    key: str
+    pages: list[int]
+    n_tokens: int
+    start_tokens: int = 0
+    nodes: list[RadixNode] = field(default_factory=list)
+    drop_after: bool = False
 
 
 class KVCacheManager:
     def __init__(self, num_pages: int, page_size: int,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 tiers: Optional[TieredKVStore] = None,
+                 policy: Optional[OffloadPolicy] = None):
         if num_pages < 1 or page_size < 1:
             raise ValueError("num_pages and page_size must be positive")
         self.num_pages = num_pages
@@ -43,21 +97,45 @@ class KVCacheManager:
         # pages pinned by an in-flight KV transfer even after request free
         # (reference: delayed _free_request while transfer ACTIVE)
         self._pinned: dict[str, list[int]] = {}
-        # ---- prefix cache state ----
-        # chain-hash -> page holding that full prompt page's KV
-        self._cached: dict[str, int] = {}
-        self._hash_of: dict[int, str] = {}        # page -> its hash
-        self._ref: dict[int, int] = {}            # live refs per cached page
-        self._evictable: "OrderedDict[int, None]" = OrderedDict()  # LRU
+        # GLOBAL pin refcounts: a page can be pinned through one
+        # request's snapshot while another request (or the prefix
+        # cache) still owns it — eviction and free() consult THIS, not
+        # the per-request snapshot, so a pinned page can never sit in
+        # the evictable pool (the evict-under-pressure-vs-pin race)
+        self._pin_count: dict[int, int] = {}
+        # ---- prefix cache state: the radix index over full pages ----
+        self.index = RadixPrefixIndex(page_size)
+        # request_id -> adopted radix nodes (released on free)
+        self._adopted: dict[str, list[RadixNode]] = {}
+        # ---- tiered offload ----
+        self.tiers = tiers
+        self.policy = policy or OffloadPolicy(mode="never")
+        self.pending_offloads: list[PendingOffload] = []
+        self.pending_restores: list[PendingRestore] = []
+        # keys queued for extraction but not yet drained (park runs
+        # AND offload-evicted nodes): their payload is not fetchable
+        # yet — park admission waits a step, and match_prefix must not
+        # mistake an in-flight cold node for a dead one
+        self._extract_in_flight: set[str] = set()
         # cache effectiveness counters (surfaced by engine stats)
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
+        # recompute avoided by tier restores (cold prefix adoptions +
+        # park restores), in tokens
+        self.restored_tokens = 0
+        self.parked_tokens = 0
+        self.offload_evictions = 0
+        self.drop_evictions = 0
 
     # ------------------------------------------------------------- queries
+    def _pinned_pages(self) -> set[int]:
+        return {p for p, c in self._pin_count.items() if c > 0}
+
     @property
     def num_free_pages(self) -> int:
-        # evictable cached pages are allocatable on demand
-        return len(self._free) + len(self._evictable)
+        # evictable cached pages are allocatable on demand; pinned
+        # pages are NOT (an in-flight transfer is still reading them)
+        return len(self._free) + self.index.evictable(self._pinned_pages())
 
     def pages_needed(self, num_tokens: int) -> int:
         return -(-num_tokens // self.page_size)
@@ -70,139 +148,194 @@ class KVCacheManager:
         need = self.pages_needed(request.num_computed_tokens + num_new_tokens)
         return need - have <= self.num_free_pages
 
-    # ------------------------------------------------------- prefix cache
-    def _page_hashes(self, token_ids, max_pages: Optional[int] = None):
-        """Chained content hashes of the FULL pages of ``token_ids``."""
-        hashes = []
-        prev = b""
-        n_full = len(token_ids) // self.page_size
-        if max_pages is not None:
-            n_full = min(n_full, max_pages)
-        for p in range(n_full):
-            chunk = token_ids[p * self.page_size: (p + 1) * self.page_size]
-            h = hashlib.blake2b(
-                prev + b"," + repr(list(chunk)).encode(), digest_size=16
-            ).hexdigest()
-            hashes.append(h)
-            prev = h.encode()
-        return hashes
+    @property
+    def offload_enabled(self) -> bool:
+        return self.tiers is not None and self.policy.mode != "never"
 
+    def has_pending_moves(self) -> bool:
+        return bool(self.pending_offloads or self.pending_restores)
+
+    # ------------------------------------------------------- prefix cache
     def match_prefix(self, request: Request) -> int:
-        """Adopt cached pages covering the longest full-page prefix of
+        """Adopt cached nodes covering the longest full-page prefix of
         the request's prompt; returns the number of tokens whose KV the
         request now starts with (``num_computed_tokens`` is updated and
-        the pages seed its block table).  At least one prompt token is
-        always left to compute — its forward produces the first logits.
-        Embeds-based prompts never match (their placeholder ids carry no
-        content)."""
+        the pages seed its block table).  Cold nodes (KV parked in the
+        host/remote tiers) are adopted too: a fresh page is allocated
+        and a restore queued — the engine injects the payload before
+        the forward attends it.  At least one prompt token is always
+        left to compute — its forward produces the first logits.
+        Embeds-based prompts never match (their placeholder ids carry
+        no content)."""
         if (not self.enable_prefix_caching
                 or request.prompt_embeds is not None
                 or request.num_computed_tokens
                 or request.request_id in self._tables):
             return 0
-        # leave >= 1 token to compute; hashes memoize on the request —
+        # leave >= 1 token to compute; keys memoize on the request —
         # a head-of-queue request blocked on pages re-matches every
         # scheduler step and must not re-hash its whole prompt each time
         usable = len(request.prompt_token_ids) - 1
-        hashes = getattr(request, "_apc_hashes", None)
-        if hashes is None:
-            hashes = self._page_hashes(request.prompt_token_ids,
-                                       max_pages=usable // self.page_size)
-            request._apc_hashes = hashes
-        pages = []
-        for h in hashes:
-            page = self._cached.get(h)
-            if page is None:
-                break
-            pages.append(page)
-        if not pages:
+        keys = getattr(request, "_apc_keys", None)
+        if keys is None:
+            keys = self.index.page_keys(request.prompt_token_ids,
+                                        max_pages=usable // self.page_size)
+            request._apc_keys = keys
+        nodes = self.index.match(keys=keys)
+        if not nodes:
             return 0
-        for page in pages:
-            self._ref[page] = self._ref.get(page, 0) + 1
-            self._evictable.pop(page, None)
-        self._tables[request.request_id] = list(pages)
-        matched = len(pages) * self.page_size
+        # acquire the WHOLE match up front: referenced nodes are
+        # invisible to eviction, so allocating pages for cold restores
+        # below can never evict a node this very match adopted
+        for node in nodes:
+            self.index.acquire(node)
+        adopted: list[RadixNode] = []
+        restores: list[PendingRestore] = []
+        restored = 0
+        dead: Optional[RadixNode] = None
+        for pos, node in enumerate(nodes):
+            if node.page is None:
+                # cold node: verify the payload still exists (the host
+                # tier may have shed it with no remote edge), then give
+                # it fresh HBM storage and queue the restore.  A key
+                # whose extraction is queued-but-undrained (evicted
+                # EARLIER IN THIS VERY schedule pass) counts as alive:
+                # the engine drains extractions before restores, so
+                # the payload exists by fetch time
+                if (self.tiers is None
+                        or not (self.tiers.has(node.key)
+                                or node.key in self._extract_in_flight)):
+                    dead = node
+                    break
+                page = self._take_free_page()
+                if page is None:
+                    break
+                self.index.rebind_page(node, page)
+                restores.append(PendingRestore(
+                    request_id=request.request_id, key=node.key,
+                    pages=[page], n_tokens=self.page_size,
+                    start_tokens=pos * self.page_size,
+                    nodes=[node]))
+                restored += self.page_size
+            adopted.append(node)
+        for node in nodes[len(adopted):]:
+            self.index.release(node)
+        if (dead is not None and dead.ref == 0
+                and not dead.children):
+            # unbacked cold leaf: its payload is gone for good — drop
+            # it so later matches don't keep stubbing their toe on it
+            # (interior unbacked nodes stay: dropping them would
+            # orphan live descendants)
+            self.index.drop(dead)
+        if not adopted:
+            return 0
+        self.pending_restores.extend(restores)
+        self._adopted[request.request_id] = adopted
+        self._tables[request.request_id] = [n.page for n in adopted]
+        matched = len(adopted) * self.page_size
         request.num_computed_tokens = matched
         self.prefix_hits += 1
         self.prefix_hit_tokens += matched
+        self.restored_tokens += restored
         return matched
 
-    def _register_pages(self, request: Request, table: list[int],
-                        candidates: set) -> set:
-        """Content-register the request's full PROMPT pages at free time
-        (pages become shareable once their producer completes).  Only
-        pages in ``candidates`` are considered; returns the set of pages
-        the cache consumed (now evictable, NOT to be freed)."""
-        consumed: set = set()
-        if (not self.enable_prefix_caching
-                or request.prompt_embeds is not None):
-            return consumed
-        hashes = self._page_hashes(request.prompt_token_ids)
-        # only pages whose KV was actually computed/valid
-        valid = min(len(hashes),
-                    request.num_computed_tokens // self.page_size,
-                    len(table))
-        for h, page in zip(hashes[:valid], table[:valid]):
-            if page not in candidates:
-                continue
-            old = self._cached.get(h)
-            if old is not None and old != page:
-                # prefix already cached by another producer: keep the
-                # old page; this one frees normally
-                continue
-            self._cached[h] = page
-            self._hash_of[page] = h
-            self._evictable[page] = None
-            self._evictable.move_to_end(page)
-            consumed.add(page)
-        return consumed
-
     def reset_prefix_cache(self) -> int:
-        """Drop EVERY unreferenced cached page back to the free pool
-        (reference: reset_prefix_cache during pause_generation,
-        async_omni.py:771 — weight updates invalidate cached KV).
-        Pages still referenced by live requests stay cached; returns the
-        number of pages released."""
-        n = 0
-        while self._evictable:
-            page = self._evict_one()
-            if page is None:
-                break
-            self._free.append(page)
-            n += 1
-        return n
+        """Drop EVERY unreferenced cached node back to the free pool
+        and purge the WHOLE tier store (reference: reset_prefix_cache
+        during pause_generation, async_omni.py:771 — weight updates
+        invalidate cached KV).  Nodes still referenced by live
+        requests stay, but every cold copy is stale after a weight
+        swap — in-tree keys, restored hot nodes' dedup copies, and
+        ``park/{rid}`` preemption runs alike — so all tier payloads
+        and queued extractions go: a parked victim falls back to
+        recompute under the new weights, and a pending restore fails
+        its fetch and unwinds through the normal lost-payload path.
+        Returns the number of HBM pages released."""
+        freed, _ = self.index.reset(self._pinned_pages())
+        self._free.extend(freed)
+        if self.tiers is not None:
+            self.tiers.clear()
+        self.pending_offloads = []
+        self._extract_in_flight.clear()
+        return len(freed)
 
-    def _evict_one(self) -> Optional[int]:
-        """Drop the least-recently-used unreferenced cached page back to
-        the free pool."""
-        if not self._evictable:
-            return None
-        page, _ = self._evictable.popitem(last=False)
-        h = self._hash_of.pop(page, None)
-        if h is not None:
-            self._cached.pop(h, None)
-        self._ref.pop(page, None)
-        return page
-
+    # ----------------------------------------------------------- eviction
     def _take_free_page(self) -> Optional[int]:
         if self._free:
             return self._free.pop()
         return self._evict_one()
 
+    def _evict_one(self) -> Optional[int]:
+        """Reclaim the LRU unreferenced, unpinned cached page.  When the
+        offload policy says the page's KV earns its transfer, the node
+        goes COLD (stays matchable; extraction queued for the engine to
+        drain before the page is overwritten); otherwise the node — and
+        its now-unmatchable cold subtree — drops outright."""
+        node = self.index.pick_victim(self._pinned_pages())
+        if node is None:
+            return None
+        if (self.offload_enabled
+                and self.policy.worth_offloading_page(self.page_size)):
+            page = node.page
+            if not (self.tiers.has(node.key)
+                    or node.key in self._extract_in_flight):
+                # content not parked yet: extract before reuse.  The
+                # in-flight mark keeps a same-pass match_prefix from
+                # mistaking the node for dead (payload lands at drain)
+                self.pending_offloads.append(PendingOffload(
+                    key=node.key, pages=[page],
+                    n_tokens=self.page_size))
+                self._extract_in_flight.add(node.key)
+            self.index.mark_cold(node, TIER_HOST)
+            self.offload_evictions += 1
+            return page
+        page, purge = self.index.drop(node)
+        doomed = set(purge)
+        doomed.add(node.key)
+        if self.tiers is not None:
+            for key in doomed:
+                self.tiers.drop(key)
+        # an extraction queued for a now-dropped key must not land:
+        # the drain would park its payload under a key no node
+        # references — an unreachable orphan (and, keys being
+        # content-addressed, a stale hit for a same-content node
+        # offloaded after a weight reset)
+        if self.pending_offloads:
+            self.pending_offloads = [
+                o for o in self.pending_offloads if o.key not in doomed]
+        self._extract_in_flight -= doomed
+        self.drop_evictions += 1
+        return page
+
     # ---------------------------------------------------------- allocation
     def allocate(self, request: Request, num_new_tokens: int) -> Optional[list[int]]:
         """Grow the request's table to cover ``num_computed_tokens +
-        num_new_tokens``; returns the full table, or None if out of pages."""
-        table = self._tables.setdefault(request.request_id, [])
+        num_new_tokens``; returns the full table, or None if out of
+        pages.  Failure is side-effect free: partial growth rolls back
+        and a table entry that didn't pre-exist is removed — a stale
+        empty entry would permanently disable ``match_prefix`` for the
+        request (its guard treats any registered table as already
+        matched)."""
+        rid = request.request_id
+        fresh = rid not in self._tables
+        table = self._tables.setdefault(rid, [])
         need = self.pages_needed(request.num_computed_tokens + num_new_tokens)
         grow = need - len(table)
-        if grow > self.num_free_pages:
+        base = len(table)
+        ok = grow <= self.num_free_pages
+        if ok:
+            for _ in range(max(grow, 0)):
+                page = self._take_free_page()
+                if page is None:
+                    ok = False
+                    break
+                table.append(page)
+        if not ok:
+            self._free.extend(table[base:])
+            del table[base:]
+            if fresh:
+                del self._tables[rid]
             return None
-        for _ in range(max(grow, 0)):
-            page = self._take_free_page()
-            if page is None:
-                return None
-            table.append(page)
         return list(table)
 
     def slot_mapping(self, request: Request, num_new_tokens: int) -> list[int]:
@@ -219,64 +352,238 @@ class KVCacheManager:
 
     # ---------------------------------------------------------------- free
     def free(self, request: Request) -> None:
-        """Release the request's pages — unless a KV transfer pinned them
-        (then they are released by ack_transfer).  Full prompt pages
-        register in the prefix cache instead of returning to the free
-        pool (they remain allocatable via LRU eviction)."""
+        """Release the request's pages — unless a KV transfer pinned
+        them (then they are released by ack_transfer).  Full prompt
+        pages register in the radix index instead of returning to the
+        free pool (they remain allocatable via LRU eviction); adopted
+        shared nodes drop this request's reference."""
         table = self._tables.pop(request.request_id, None)
         if table is None:
             return
-        pinned = set(self._pinned.get(request.request_id, ()))
-        private = []
-        for page in table:
-            if page in self._ref:
-                # shared cache page: drop this request's reference;
-                # unreferenced registered pages become LRU-evictable —
-                # UNLESS pinned by an in-flight transfer (eviction would
-                # hand the page to a new request mid-read; ack_transfer
-                # releases it)
-                self._ref[page] -= 1
-                if self._ref[page] <= 0:
-                    self._ref.pop(page, None)
-                    if page in pinned:
-                        pass  # released by ack_transfer
-                    elif page in self._hash_of:
-                        self._evictable[page] = None
-                        self._evictable.move_to_end(page)
-                    else:
-                        self._free.append(page)
-                continue
-            private.append(page)
-        consumed = self._register_pages(
-            request, table, candidates=set(private) - pinned)
+        for node in self._adopted.pop(request.request_id, ()):
+            self.index.release(node)
+        owned = set(self.index._by_page)
+        private = [p for p in table if p not in owned]
+        consumed: set[int] = set()
+        if (self.enable_prefix_caching
+                and request.prompt_embeds is None):
+            # register this request's full, computed PROMPT pages (pages
+            # become shareable once their producer completes); the
+            # insert consumes only pages backing NEW nodes — positions
+            # already cached by another producer free normally
+            valid = min(
+                len(request.prompt_token_ids) // self.page_size,
+                request.num_computed_tokens // self.page_size,
+                len(table))
+            consumed = self.index.insert(
+                request.prompt_token_ids, table[:valid], max_pages=valid)
+        pinned = self._pinned_pages()
         for page in private:
-            if page in pinned or page in consumed:
-                continue
+            if page in consumed:
+                continue  # the index owns it now (evictable, unpinned)
+            if page in pinned:
+                continue  # released by ack_transfer
             self._free.append(page)
 
+    # -------------------------------------------------------- park/restore
+    def park_request(self, request: Request) -> int:
+        """Preemption offload: queue the request's computed KV run for
+        extraction to the host tier instead of discarding it (the
+        engine drains the extraction this very step, before the freed
+        pages are overwritten).  Returns the parked token count, or 0
+        when parking is off / not worth the bytes."""
+        if not self.offload_enabled:
+            return 0
+        # park only positions whose tokens are COMMITTED (host-visible):
+        # an async in-flight step's sampled token will be discarded by
+        # the lagged retire, so its KV slot may describe a token the
+        # recompute re-samples differently — exclude the in-flight
+        # slots and always leave >= 1 position to compute on resume
+        seq_len = min(
+            request.num_computed_tokens - request.num_inflight_tokens,
+            request.num_tokens - 1)
+        if seq_len <= 0 or not self.policy.worth_offloading(seq_len):
+            return 0
+        table = self._tables.get(request.request_id)
+        if not table:
+            return 0
+        keep = self.pages_needed(seq_len)
+        if keep > len(table):
+            return 0
+        key = park_key(request.request_id)
+        self.pending_offloads.append(PendingOffload(
+            key=key, pages=list(table[:keep]), n_tokens=seq_len))
+        self._extract_in_flight.add(key)
+        request.additional_information["_parked_len"] = seq_len
+        self.parked_tokens += seq_len
+        return seq_len
+
+    def park_in_flight(self, request: Request) -> bool:
+        """The request's park extraction is queued but not yet drained
+        (its payload can't be fetched yet — admission waits a step)."""
+        return park_key(request.request_id) in self._extract_in_flight
+
+    def note_park_extracted(self, key: str) -> None:
+        self._extract_in_flight.discard(key)
+
+    def parked_available(self, request: Request) -> bool:
+        """The parked payload can be fetched right now (extraction
+        drained and the tiers still hold it)."""
+        return (self.tiers is not None
+                and self.tiers.has(park_key(request.request_id)))
+
+    def restore_parked(self, request: Request) -> bool:
+        """Re-admit a parked request: allocate pages for its parked run,
+        queue the injection, and fast-forward ``num_computed_tokens`` —
+        the recompute the park exists to avoid.  Returns False when the
+        payload is gone or pages don't fit (caller decides whether to
+        wait or recompute)."""
+        parked = request.additional_information.get("_parked_len", 0)
+        key = park_key(request.request_id)
+        if (not parked or self.tiers is None
+                or not self.tiers.has(key)):
+            return False
+        table = self.allocate(request, parked)
+        if table is None:
+            return False
+        self.pending_restores.append(PendingRestore(
+            request_id=request.request_id, key=key,
+            pages=table[: self.pages_needed(parked)], n_tokens=parked,
+            drop_after=True))
+        request.num_computed_tokens = parked
+        request.additional_information.pop("_parked_len", None)
+        self.restored_tokens += parked
+        return True
+
+    def drop_park(self, request: Request) -> None:
+        """Forget a parked payload (request finished/aborted/errored
+        while parked)."""
+        request.additional_information.pop("_parked_len", None)
+        key = park_key(request.request_id)
+        self._extract_in_flight.discard(key)
+        self.pending_offloads = [
+            o for o in self.pending_offloads if o.key != key]
+        if self.tiers is not None:
+            self.tiers.drop(key)
+
+    def take_pending_moves(self) -> tuple[list[PendingOffload],
+                                          list[PendingRestore]]:
+        offloads, self.pending_offloads = self.pending_offloads, []
+        restores, self.pending_restores = self.pending_restores, []
+        return offloads, restores
+
+    def restore_failed_entries(self, request: Request,
+                               failed: list[PendingRestore],
+                               keep_tokens: int) -> dict[str, int]:
+        """A restore came up short at drain time: the ``failed``
+        entries' payloads never injected, so their nodes are bound to
+        GARBAGE pages — unwind them back to cold (a later-entry payload
+        may still exist and restore fine next time; the truly lost one
+        is pruned by the has() check at the next match), then rewind
+        the request to the contiguous ``keep_tokens`` prefix.
+
+        Returns ``{request_id: keep_tokens}`` for OTHER requests that
+        co-adopted a failed node: a second request admitted in the same
+        schedule pass saw the rebound node hot (page set) and adopted
+        it with NO restore entry of its own — its table references the
+        same garbage page.  The caller must truncate each co-adopter at
+        its first failed node and drop its scheds this step, or it
+        executes attending never-injected KV (and the page, freed by
+        this request's truncation, could be re-allocated while still in
+        the co-adopter's table — silent cross-request corruption)."""
+        failed_nodes = {id(n) for e in failed for n in e.nodes}
+        released: list[int] = []
+        for e in failed:
+            for node in e.nodes:
+                if node.page is not None:
+                    # usually the page stays in the request's table and
+                    # the truncate below frees it; ``released`` catches
+                    # the rest (e.g. this request was already truncated
+                    # as a co-adopter of an earlier failure this drain)
+                    released.append(self.index.mark_cold(node, TIER_HOST))
+        co: dict[str, int] = {}
+        for rid, adopted in self._adopted.items():
+            if rid == request.request_id:
+                continue
+            cut = next((i for i, n in enumerate(adopted)
+                        if id(n) in failed_nodes), None)
+            if cut is not None:
+                co[rid] = cut * self.page_size
+        self.restore_truncated(request, keep_tokens)
+        if released:
+            pinned = self._pinned_pages()
+            live = {p for t in self._tables.values() for p in t}
+            unplaced = set(self._free)
+            for page in released:
+                if (page in pinned or page in unplaced
+                        or page in self.index._by_page or page in live):
+                    continue
+                self._free.append(page)
+                unplaced.add(page)
+        return co
+
+    def restore_truncated(self, request: Request, keep_tokens: int
+                          ) -> None:
+        """Keep the contiguous ``keep_tokens`` prefix that is actually
+        valid, release everything after it, and rewind
+        ``num_computed_tokens`` so the scheduler recomputes the rest."""
+        rid = request.request_id
+        keep_pages = self.pages_needed(keep_tokens)
+        table = self._tables.get(rid, [])
+        adopted = self._adopted.get(rid, [])
+        owned = set(self.index._by_page)
+        for node in adopted[keep_pages:]:
+            self.index.release(node)
+        self._adopted[rid] = adopted[:keep_pages]
+        self._tables[rid] = table[:keep_pages]
+        pinned = self._pinned_pages()
+        live = {p for t in self._tables.values() for p in t}
+        for page in table[keep_pages:]:
+            if page in owned or page in pinned:
+                continue
+            if page in live:
+                # a co-adopter of the same failed-restore node still
+                # references it — the LAST truncation frees it
+                continue
+            self._free.append(page)
+        request.num_computed_tokens = min(request.num_computed_tokens,
+                                          keep_tokens)
+
+    # --------------------------------------------------------- transfers
     def pin_for_transfer(self, request: Request, seq_len: int) -> list[int]:
         """Snapshot + pin the pages holding the first ``seq_len`` tokens
         (reference: block-id snapshot truncated to seq_len,
-        omni_ar_scheduler.py:553-594)."""
+        omni_ar_scheduler.py:553-594).  Pins are GLOBAL refcounts:
+        however the page is also owned (live table, shared cache node),
+        it cannot be evicted or freed until ``ack_transfer``."""
         table = self._tables.get(request.request_id, [])
         keep = self.pages_needed(seq_len)
         snapshot = table[:keep]
         self._pinned[request.request_id] = list(snapshot)
+        for page in snapshot:
+            self._pin_count[page] = self._pin_count.get(page, 0) + 1
         return list(snapshot)
 
     def ack_transfer(self, request_id: str) -> None:
-        """Extraction ACK: release pinned pages not still in a live table
-        (reference: free on kv_extracted_req_ids, omni_ar_scheduler.py:444).
-        Registered pages whose producer already freed become evictable
-        here; re-shared pages (ref > 0) stay live."""
+        """Extraction ACK: unpin the snapshot; pages owned by nobody
+        else (no live table, not a cache node) return to the free pool
+        (reference: free on kv_extracted_req_ids,
+        omni_ar_scheduler.py:444).  Cached nodes simply become
+        evictable again now that the pin is gone."""
         pinned = self._pinned.pop(request_id, [])
-        live = set(self._tables.get(request_id, ()))
+        live: Optional[set[int]] = None
         for page in pinned:
-            if page in live or page in self._ref:
+            c = self._pin_count.get(page, 0) - 1
+            if c > 0:
+                self._pin_count[page] = c
                 continue
-            if page in self._hash_of:
-                if page not in self._evictable:
-                    self._evictable[page] = None
-                self._evictable.move_to_end(page)
-            else:
-                self._free.append(page)
+            self._pin_count.pop(page, None)
+            if page in self.index._by_page:
+                continue  # cache node: evictable via the index now
+            if live is None:
+                # built once per ack, not per page: a long pinned
+                # snapshot over many live tables must not go quadratic
+                live = {p for t in self._tables.values() for p in t}
+            if page in live:
+                continue  # still part of a live table
+            self._free.append(page)

@@ -64,6 +64,12 @@ class SchedulerConfig:
     # special case) — which the runner packs into a single ragged
     # device dispatch (docs/ragged_batching.md)
     unified_batching: bool = False
+    # tiered KV offload (docs/kv_cache.md): preemption PARKS the
+    # victim's computed KV in the host/remote tiers instead of
+    # discarding it, and re-admission restores the run — recompute
+    # becomes a transfer whenever the bytes beat the flops
+    # (kvcache/policy.py decides per run)
+    kv_offload: bool = False
 
     @property
     def chunking_enabled(self) -> bool:
@@ -199,6 +205,8 @@ class ARScheduler:
         self._finished_ids.add(request.request_id)
         self._errored.append(request)
         self.num_rejections += 1
+        # a parked payload for a dead request is unreachable garbage
+        self.kv.drop_park(request)
 
     def find_request(self, request_id: str):
         """(queue, request) for an in-flight id, else (None, None)."""
@@ -425,6 +433,44 @@ class ARScheduler:
         # recomputing KV for its prompt *and* its already-generated tokens.
         while self.waiting and budget > 0 and len(self.running) < self.config.max_num_seqs:
             req = self.waiting[0]
+            if (self.config.kv_offload
+                    and req.num_computed_tokens == 0
+                    and not req.awaiting_chunks
+                    and req.additional_information.get("_parked_len")):
+                # parked preemption victim: restore its KV run from the
+                # tier store instead of recomputing it.  Extraction
+                # still in flight (queued this very step) -> wait one
+                # step; payload gone -> fall through to full recompute
+                if self.kv.park_in_flight(req):
+                    break
+                if self.kv.parked_available(req):
+                    if not self.kv.restore_parked(req):
+                        break  # page pressure: retry next step
+                else:
+                    # payload lost (host tier shed it with no remote
+                    # edge): full recompute — which, with chunking off,
+                    # may no longer fit one step.  _preempt skipped its
+                    # starvation reject trusting the park; re-check
+                    # here or the head request wedges the queue forever
+                    # while other traffic keeps the engine busy
+                    req.additional_information.pop("_parked_len", None)
+                    if (not self.config.chunking_enabled
+                            and req.num_tokens
+                            > self.config.max_num_batched_tokens):
+                        self.waiting.pop(0)
+                        # reject() alone doesn't free: release any pages
+                        # a prior restore attempt left behind
+                        self.kv.free(req)
+                        self.reject(
+                            req,
+                            "parked KV payload lost and the recompute "
+                            f"footprint ({req.num_tokens} tokens) "
+                            "exceeds the step budget "
+                            f"({self.config.max_num_batched_tokens}) "
+                            "with chunked prefill off",
+                            kind="internal",
+                        )
+                        continue
             if req.num_computed_tokens == 0 and not req.awaiting_chunks:
                 # automatic prefix caching: adopt cached pages covering
                 # the longest full-page prompt prefix; the request then
@@ -436,6 +482,31 @@ class ARScheduler:
                 # streaming request admitted before its first chunk has
                 # content to compute: park it in running (idle) so it
                 # doesn't pin the waiting queue
+                self.waiting.pop(0)
+                req.status = RequestStatus.RUNNING
+                self.running.append(req)
+                continue
+            if (remaining == 1 and req.output_token_ids
+                    and not req.awaiting_chunks):
+                # resume-as-decode: a restored preemption victim whose
+                # only outstanding position is the sampling one re-enters
+                # through the decode executable — the one the
+                # uninterrupted stream would have run — not a 1-token
+                # prefill chunk.  The two executables agree only to the
+                # last ULP, and on near-flat logits that flips greedy
+                # argmaxes, breaking the offload bit-equality contract
+                if not self.kv.can_allocate(req, 1):
+                    break
+                table = self.kv.allocate(req, 1)
+                if table is None:
+                    break
+                slots = self.kv.slot_mapping(req, 1)
+                out.decodes.append(ScheduledRequest(
+                    request=req, num_new_tokens=1, slot_mapping=slots,
+                    block_table=table, start_pos=req.num_computed_tokens,
+                    window=1,
+                ))
+                budget -= 1
                 self.waiting.pop(0)
                 req.status = RequestStatus.RUNNING
                 self.running.append(req)
@@ -463,8 +534,19 @@ class ARScheduler:
         return out
 
     def _preempt(self, req: Request) -> None:
-        """Recompute-preemption: free pages, reset progress, back to waiting."""
+        """Preemption: free pages, reset progress, back to waiting.
+        With kv_offload on, the victim's computed KV run is PARKED in
+        the tier store first (extraction drains before this step's
+        forward can overwrite the freed pages) — re-admission restores
+        the run instead of recomputing it.  Recompute remains the
+        fallback: in-flight async tokens, a policy veto, or a lost
+        payload all degrade to the classic path bit-identically."""
         self.num_preemptions += 1
+        if self.config.kv_offload:
+            # the manager parks only the COMMITTED prefix (in-flight
+            # async slots excluded — their tokens are discarded below
+            # and may re-sample differently on recompute)
+            self.kv.park_request(req)
         self.kv.free(req)
         req.num_computed_tokens = 0
         # an in-flight async token is discarded with the progress — the
@@ -480,13 +562,19 @@ class ARScheduler:
         req.async_generation += 1
         if req in self.running:
             self.running.remove(req)
+        parked = req.additional_information.get("_parked_len", 0)
         if (not self.config.chunking_enabled
-                and req.num_tokens > self.config.max_num_batched_tokens):
+                and req.num_tokens - parked
+                > self.config.max_num_batched_tokens):
             # the recompute footprint (prompt + generated, or a formerly
             # injected prefix) no longer fits one step and chunking is off:
             # requeueing would pin the waiting head forever while other
             # requests keep the engine busy (the starvation guard never
-            # fires when something else schedules)
+            # fires when something else schedules).  A parked run
+            # shrinks the footprint to its un-parked remainder — but if
+            # the payload is later lost the starvation guard still
+            # error-finishes the request rather than wedging the queue
+            self.kv.drop_park(req)
             self.reject(
                 req,
                 "preempted request cannot resume: recompute footprint "
@@ -700,6 +788,32 @@ class ARScheduler:
         reference: omni_ar_scheduler.py:473-546 — pinned pages survive)."""
         self._finished_ids.add(req.request_id)
         self.kv.free(req)
+        self.kv.drop_park(req)
+
+    def restore_failed(self, request_id: str, failed_entries: list,
+                       keep_tokens: int) -> set[str]:
+        """A queued tier restore came up short at engine drain time
+        (payload vanished between match and fetch): unwind the
+        never-injected entries (their nodes sit on garbage pages),
+        keep the contiguous ``keep_tokens`` that are valid, and rewind
+        the rest — the scheduler recomputes it as ordinary chunks next
+        step.  The engine drops this step's now-misaligned
+        ScheduledRequest before executing.  Returns the ids of OTHER
+        requests that co-adopted a failed node in the same pass and
+        were truncated along with it — their scheds must drop too."""
+        _, req = self.find_request(request_id)
+        if req is None:
+            return set()
+        co = self.kv.restore_failed_entries(req, failed_entries,
+                                            keep_tokens)
+        unwound: set[str] = set()
+        for rid, keep in co.items():
+            _, co_req = self.find_request(rid)
+            if co_req is None:
+                continue
+            self.kv.restore_truncated(co_req, keep)
+            unwound.add(rid)
+        return unwound
 
 
 class GenerationScheduler(ARScheduler):

@@ -88,6 +88,39 @@ class EngineConfig:
     # per step.  Greedy token streams are bit-identical to sync mode.
     # See docs/async_engine.md.
     async_scheduling: bool = False
+    # tiered KV offload (docs/kv_cache.md): evicted prefix-cache pages
+    # and preempted requests PARK their KV in a host-RAM pool (and
+    # optionally a remote store) instead of dropping it; restores
+    # promote the bytes back before the scheduler re-admits.  The
+    # radix prefix index tracks which tier each cached node lives in.
+    kv_offload: bool = False
+    # cold-path storage: "none" keeps parked payloads bit-exact
+    # (restored greedy streams match the never-offloaded oracle);
+    # "int8" halves the bytes over the ~0.15 GB/s host tunnel
+    kv_offload_quant: str = "none"
+    # bytes-vs-recompute admission (kvcache/policy.py): "auto" runs the
+    # break-even math, "always"/"never" pin the decision
+    kv_offload_policy: str = "auto"
+    # host tier capacity; overflow demotes LRU payloads to the remote
+    # tier (or drops them without one).  None = unbounded
+    kv_host_tier_bytes: Optional[int] = None
+    # remote tier transport: a ConnectorFactory name ("inproc" | "shm"
+    # | "tcp", distributed/connectors.py) + its constructor kwargs;
+    # the edge runs under the PR 3 retry policy + circuit breaker
+    kv_offload_connector: Optional[str] = None
+    kv_offload_connector_args: Optional[dict] = None
+    # pin the single-token decode family (sync, async dispatch,
+    # multi-step window) to the TOP batch bucket.  XLA fuses the
+    # [B]-leading decode matmuls differently per bucket shape, so the
+    # same request decoded next to 3 neighbours vs 7 can differ in the
+    # last bf16 bit — enough to flip a greedy argmax when logits run
+    # close.  With this on, a request's greedy stream is bit-stable
+    # under co-batch churn (arrivals, preemptions, offload restores),
+    # which is what lets the kv_reuse bench compare an offloading
+    # engine against a never-preempted oracle token for token.  Costs
+    # padded rows when the batch runs small; spec-decode verify and
+    # the unified token-packed path keep their dynamic shapes.
+    deterministic_decode: bool = False
     # precompile bucketed executables before serving: True warms every
     # decode batch bucket; a list of (batch, seq_len) pairs additionally
     # warms those prefill shapes.  A shape-cache miss mid-traffic stalls
@@ -133,6 +166,34 @@ class LLMEngine:
                                          unified_batching=False)
         self.config = config
         self.eos_token_id = eos_token_id
+        # tiered KV offload: the cold-side store + break-even policy
+        # (docs/kv_cache.md).  AR engines only — the one-shot
+        # generation scheduler never preempts or prefix-caches.
+        self.kv_tiers = None
+        kv_policy = None
+        if config.kv_offload and config.worker_type == "ar" \
+                and isinstance(model_cfg, tfm.TransformerConfig):
+            remote = None
+            if config.kv_offload_connector:
+                from vllm_omni_tpu.distributed.connectors import (
+                    ConnectorFactory,
+                )
+
+                remote = ConnectorFactory.create(
+                    config.kv_offload_connector,
+                    **(config.kv_offload_connector_args or {}))
+            from vllm_omni_tpu.kvcache import OffloadPolicy, TieredKVStore
+
+            self.kv_tiers = TieredKVStore(
+                quant=config.kv_offload_quant,
+                host_capacity_bytes=config.kv_host_tier_bytes,
+                remote=remote)
+            kv_policy = OffloadPolicy.for_model(
+                model_cfg.num_layers, model_cfg.num_kv_heads,
+                model_cfg.head_dim,
+                jnp.dtype(config.dtype).itemsize,
+                mode=config.kv_offload_policy,
+                quant_mode=config.kv_offload_quant)
         # prefix caching skips the forward for cached positions, so it
         # cannot coexist with collect_hidden (downstream stages need the
         # hidden row of EVERY prompt position) — thinker-style stages
@@ -141,7 +202,8 @@ class LLMEngine:
                             enable_prefix_caching=(
                                 config.enable_prefix_caching
                                 and config.worker_type == "ar"
-                                and not config.collect_hidden))
+                                and not config.collect_hidden),
+                            tiers=self.kv_tiers, policy=kv_policy)
         sched_cfg = SchedulerConfig(
             max_num_seqs=config.max_num_seqs,
             max_num_batched_tokens=config.max_num_batched_tokens,
@@ -150,6 +212,7 @@ class LLMEngine:
             num_speculative_tokens=config.num_speculative_tokens,
             kv_transfer=config.kv_transfer,
             unified_batching=config.unified_batching,
+            kv_offload=self.kv_tiers is not None,
             # async pipelining and multi-step windows are alternative
             # round-trip amortizations; windowed decodes would force the
             # pipeline into permanent sync fallback, so async wins
@@ -207,6 +270,7 @@ class LLMEngine:
                 async_scheduling=config.async_scheduling,
                 unified_batching=config.unified_batching,
                 max_num_batched_tokens=config.max_num_batched_tokens,
+                deterministic_decode=config.deterministic_decode,
             )
         if (draft_fn is not None and config.num_speculative_tokens > 0
                 and hasattr(self.runner, "set_draft_fn")):
@@ -514,6 +578,21 @@ class LLMEngine:
             "utilization": round(used / kv.num_pages, 4),
         }
         snap["prefix_cache"] = self.prefix_cache_stats
+        if self.kv_tiers is not None:
+            st = self.kv_tiers.stats()
+            snap["kv_tiers"] = {
+                # pages holding live KV on the device (tables + hot
+                # cache nodes) vs. payload entries parked per cold tier
+                "hbm_pages": kv.num_pages - len(kv._free),
+                "host_pages": st["host_entries"],
+                "remote_pages": st["remote_entries"],
+                "host_bytes": st["host_bytes"],
+                "bytes_moved": st["bytes_moved"],
+                "prefix_hit_tokens": kv.prefix_hit_tokens,
+                "restored_tokens": kv.restored_tokens,
+                "parked_tokens": kv.parked_tokens,
+                "offload_evictions": kv.offload_evictions,
+            }
         compile_stats = getattr(self.runner, "compile_stats", None)
         if compile_stats is not None:
             snap["compile"] = dict(compile_stats)
@@ -617,6 +696,13 @@ class LLMEngine:
             return False, None  # idle: nothing to pipeline
         if self.config.kv_transfer is not None or s._pending_kv_transfers:
             return False, "kv_transfer"
+        if self.kv_tiers is not None and (
+                s.kv.has_pending_moves()
+                or any(r.additional_information.get("_parked_len")
+                       for r in s.waiting)):
+            # tier moves are host-synchronous (batched extract/inject
+            # between schedule and execute): run those steps sync
+            return False, "kv_offload"
         if self.config.collect_hidden:
             return False, "collect_hidden"
         if getattr(self.runner, "draft_fn", None) is not None:
@@ -653,6 +739,12 @@ class LLMEngine:
         if not sched_out.decodes and not sched_out.prefills:
             return False
         if sched_out.kv_transfer_requests:
+            return False
+        if self.kv_tiers is not None \
+                and self.scheduler.kv.has_pending_moves():
+            # this very schedule() queued tier moves (eviction offload
+            # or a cold-prefix restore): they must drain before the
+            # forward runs, so the step goes synchronous
             return False
         prev = self._inflight
         for s in sched_out.decodes:
@@ -774,11 +866,100 @@ class LLMEngine:
         self.step_metrics.tokens_generated += new_total
         return outs, wait_s
 
+    # ------------------------------------------------------ kv tier moves
+    def _drain_kv_moves(self) -> set[str]:
+        """Drain the KV manager's queued tier moves between schedule()
+        and execute(): batched extraction of evicted/parked pages (ONE
+        pytree transfer for every payload this step), then injection of
+        queued restores (per-request contiguous runs, one transfer
+        each).  Extractions run FIRST — a page reclaimed by eviction
+        may be the very page a restore was just given.  Returns the
+        request_ids whose restore came up short (payload vanished
+        between match and fetch); the caller must drop their scheds
+        from this step before executing."""
+        kv = self.scheduler.kv
+        if self.kv_tiers is None or not kv.has_pending_moves():
+            return set()
+        offloads, restores = kv.take_pending_moves()
+        failed: set[str] = set()
+        if offloads:
+            payloads = self.runner.extract_kv_batch(
+                [(o.pages, o.n_tokens) for o in offloads])
+            for o, payload in zip(offloads, payloads):
+                self.kv_tiers.put(o.key, payload)
+                kv.note_park_extracted(o.key)
+        by_req: dict[str, list] = {}
+        for r in restores:
+            by_req.setdefault(r.request_id, []).append(r)
+        for rid, entries in by_req.items():
+            t0 = time.perf_counter()
+            pages: list[int] = []
+            parts: list[list] = []
+            keep_tokens = 0
+            fail_at: Optional[int] = None
+            for i, e in enumerate(entries):
+                payload = self.kv_tiers.fetch(e.key)
+                if payload is None:
+                    fail_at = i
+                    # the contiguous valid prefix ends where the failed
+                    # payload would have STARTED (cold entries can
+                    # interleave with already-hot pages, so a sum of
+                    # injected lengths would overshoot)
+                    keep_tokens = e.start_tokens
+                    break
+                pages.extend(e.pages)
+                parts.append(payload)
+                keep_tokens = e.start_tokens + e.n_tokens
+                if e.drop_after:
+                    self.kv_tiers.drop(e.key)
+            if parts:
+                import numpy as np
+
+                if len(parts) == 1:
+                    payload = parts[0]
+                else:
+                    payload = [
+                        (np.concatenate([p[i][0] for p in parts], axis=1),
+                         np.concatenate([p[i][1] for p in parts], axis=1))
+                        for i in range(len(parts[0]))]
+                self.runner.inject_kv(pages, payload)
+                self.kv_tiers.restored_tokens += sum(
+                    e.n_tokens for e in entries[:len(parts)])
+                self.step_metrics.kv_restore_s.observe(
+                    time.perf_counter() - t0)
+            if fail_at is not None:
+                unwound = entries[fail_at:]
+                kv.restored_tokens -= sum(e.n_tokens for e in unwound)
+                # restore_failed also truncates any request that
+                # co-adopted a failed node hot in the same pass — its
+                # scheds are misaligned too and must drop with ours
+                failed |= self.scheduler.restore_failed(
+                    rid, unwound, keep_tokens)
+                failed.add(rid)
+        return failed
+
     # --------------------------------------------------- synchronous step
     def _run_scheduled(self, sched_out: SchedulerOutput, t_step0: float,
                        skip_on_schedule: bool = False,
                        drained_wait_s: float = 0.0
                        ) -> list[OmniRequestOutput]:
+        failed_restores = self._drain_kv_moves()
+        if failed_restores:
+            # a restore came up short: this step's chunks for those
+            # requests are positionally misaligned (start_pos past the
+            # rewound num_computed_tokens) — drop them; the scheduler
+            # re-chunks the remainder next step
+            sched_out.prefills = [
+                s for s in sched_out.prefills
+                if s.request.request_id not in failed_restores]
+            sched_out.decodes = [
+                s for s in sched_out.decodes
+                if s.request.request_id not in failed_restores]
+            if sched_out.num_scheduled == 0:
+                # everything scheduled this step was a casualty: the
+                # rewound requests are RUNNING and re-chunk next step —
+                # don't fall through to the starvation/deadlock checks
+                return []
         if not skip_on_schedule:
             self.step_metrics.on_schedule(
                 waiting=len(self.scheduler.waiting),
@@ -816,8 +997,10 @@ class LLMEngine:
                     f"({self.scheduler.kv.num_free_pages} pages free)",
                 )
                 # an injected-KV request may already own prefix pages
-                # while WAITING — evicting without freeing would leak them
+                # while WAITING — evicting without freeing would leak
+                # them; a parked payload of the dead request likewise
                 self.scheduler.kv.free(victim)
+                self.scheduler.kv.drop_park(victim)
                 return [OmniRequestOutput.from_pipeline(victim)]
             stalled = [
                 r for r in self.scheduler.running

@@ -46,6 +46,37 @@ def _add_common(p: argparse.ArgumentParser):
                           "device dispatch per step, and mixed steps "
                           "stay eligible for the async pipeline (see "
                           "docs/ragged_batching.md)")
+    eng.add_argument("--kv-offload", action="store_true", default=None,
+                     help="tiered KV offload: evicted prefix-cache "
+                          "pages and preempted requests park their KV "
+                          "in a host-RAM pool (and optionally a remote "
+                          "store) instead of recomputing (see "
+                          "docs/kv_cache.md)")
+    eng.add_argument("--kv-offload-quant", default=None,
+                     choices=("none", "int8"),
+                     help="cold-path payload storage: none keeps "
+                          "restores bit-exact, int8 halves the bytes "
+                          "over the host tunnel")
+    eng.add_argument("--kv-offload-policy", default=None,
+                     choices=("auto", "always", "never"),
+                     help="bytes-vs-recompute admission: auto runs the "
+                          "break-even math (kvcache/policy.py), "
+                          "always/never pin the decision")
+    eng.add_argument("--kv-host-tier-bytes", type=int, default=None,
+                     help="host-RAM tier capacity; overflow demotes "
+                          "LRU payloads to the remote connector (or "
+                          "drops them without one)")
+    eng.add_argument("--kv-offload-connector", default=None,
+                     help="remote-tier transport: a connector name "
+                          "(inproc|shm|tcp) wired with retry + circuit "
+                          "breaker on the edge")
+    eng.add_argument("--deterministic-decode", action="store_true",
+                     default=None,
+                     help="pin decode batches to the top bucket so a "
+                          "request's greedy stream is bit-stable under "
+                          "co-batch churn (arrivals, preemptions, "
+                          "offload restores); costs padded rows when "
+                          "the batch runs small")
     p.add_argument(
         "--stats-path", default=None, metavar="PREFIX",
         help="stream per-stage + E2E stats to PREFIX.*.stats.jsonl")
@@ -65,7 +96,10 @@ def _add_common(p: argparse.ArgumentParser):
 _ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
                 "max_num_batched_tokens", "dtype", "seed",
                 "enable_chunked_prefill", "num_speculative_tokens",
-                "async_scheduling", "unified_batching")
+                "async_scheduling", "unified_batching",
+                "kv_offload", "kv_offload_quant", "kv_offload_policy",
+                "kv_host_tier_bytes", "kv_offload_connector",
+                "deterministic_decode")
 
 
 def _stage_overrides(args) -> dict:

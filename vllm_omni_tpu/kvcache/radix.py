@@ -1,0 +1,447 @@
+"""Radix prefix index: a token-keyed page-trie over full KV pages.
+
+Replaces the flat chained-hash dict that ``KVCacheManager`` used for
+automatic prefix caching.  Each node covers exactly ONE full page
+(``page_size`` tokens); children are keyed by the next page's token
+tuple, so a root-to-node path spells out a prompt prefix at page
+granularity.  What the tree buys over the flat map:
+
+- **Shape-aware eviction.**  The flat map's LRU could evict a *middle*
+  page of a chain, silently orphaning every suffix entry behind it
+  (the orphans stay in the dict, can never match again, and still
+  occupy pages).  The trie evicts deepest-first: a prefix outlives its
+  extensions, so everything the index holds stays reachable and every
+  cached page stays adoptable — partial overlap between sessions keeps
+  paying off even under pressure.
+- **Reference-counted sharing across tenants.**  A node's refcount is
+  the number of live request tables adopting its page.  Adoption
+  always covers a contiguous root-path prefix, which yields the
+  load-bearing invariant ``node.ref >= child.ref`` — an unreferenced
+  node's whole subtree is unreferenced, so reclaiming its page can
+  never cut a live request's context chain.
+- **Tier residency.**  A node records where its KV bytes live —
+  ``TIER_HBM`` (its ``page`` id is valid device storage) or parked
+  cold (host/remote; payload looked up in the ``TieredKVStore`` by the
+  node's ``key``).  A cold node STAYS in the tree: longest-prefix
+  match can adopt it, and the manager allocates a fresh page and
+  queues a restore.
+
+The index never touches jax: it maps token content to page ids and
+tier keys.  Device bytes move in ``worker/model_runner.py``; the
+``TieredKVStore`` (tiers.py) holds the cold copies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+from itertools import count
+from typing import Iterator, Optional
+
+from vllm_omni_tpu.kvcache.tiers import TIER_HBM
+
+
+class RadixNode:
+    """One full KV page of a shared prompt prefix."""
+
+    __slots__ = ("parent", "children", "tokens", "key", "page", "ref",
+                 "tier", "last_use", "hbm_desc")
+
+    def __init__(self, parent: Optional["RadixNode"],
+                 tokens: tuple[int, ...], key: str,
+                 page: Optional[int], tier: str = TIER_HBM):
+        self.parent = parent
+        self.children: dict[tuple[int, ...], RadixNode] = {}
+        self.tokens = tokens
+        # stable content address: chain hash of the root→node token
+        # path — doubles as the cold-tier storage key (tiers.py)
+        self.key = key
+        # device page holding this node's KV; None while parked cold
+        self.page = page
+        self.ref = 0
+        self.tier = tier
+        self.last_use = 0
+        # HBM pages among strict descendants, maintained incrementally
+        # (``_adjust_hbm_desc``): ``hbm_desc == 0`` makes an
+        # unreferenced HBM node an eviction candidate without a
+        # subtree walk
+        self.hbm_desc = 0
+
+
+class RadixPrefixIndex:
+    def __init__(self, page_size: int):
+        if page_size < 1:
+            raise ValueError("page_size must be positive")
+        self.page_size = page_size
+        self._root = RadixNode(None, (), "", None)
+        # logical LRU clock: ticks on every match/insert touch, so
+        # eviction order is deterministic and test-replayable
+        self._clock = 0
+        # page id -> node, for pin/evict cross-checks and invariants
+        self._by_page: dict[int, RadixNode] = {}
+        # incrementally maintained count of unreferenced HBM nodes:
+        # ``evictable`` sits on the scheduler's per-step hot path
+        # (num_free_pages / can_allocate), so it must not walk the
+        # tree — check_invariants audits this counter against a
+        # recount
+        self._unref_hbm = 0
+        # lazy min-heap of eviction candidates ("effective leaves":
+        # unreferenced HBM nodes with no HBM descendant), keyed by
+        # last_use at push time.  Every transition INTO candidacy
+        # pushes; pick_victim validates on pop and re-queues entries
+        # whose recency went stale — amortized O(log n) per eviction
+        # where the full-tree walk was O(n · subtree) per evicted
+        # page, i.e. quadratic exactly under the allocation pressure
+        # eviction exists for
+        self._victims: list[tuple[int, int, RadixNode]] = []
+        self._vseq = count()
+
+    # ------------------------------------------------------------- stats
+    def __len__(self) -> int:
+        """Cached nodes in the index, all tiers."""
+        return sum(1 for _ in self._iter_nodes())
+
+    def _iter_nodes(self) -> Iterator[RadixNode]:
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    def hbm_pages(self) -> int:
+        return len(self._by_page)
+
+    def cold_nodes(self) -> int:
+        return sum(1 for n in self._iter_nodes() if n.page is None)
+
+    # ----------------------------------------------------------- hashing
+    def page_keys(self, token_ids, max_pages: Optional[int] = None
+                  ) -> list[tuple[tuple[int, ...], str]]:
+        """[(page token tuple, chain-hash key)] for the FULL pages of
+        ``token_ids`` — the same chained content address the flat map
+        used, so cold-tier payloads stay findable across index
+        rebuilds."""
+        out = []
+        prev = b""
+        n_full = len(token_ids) // self.page_size
+        if max_pages is not None:
+            n_full = min(n_full, max_pages)
+        for p in range(n_full):
+            chunk = tuple(
+                int(t) for t in
+                token_ids[p * self.page_size: (p + 1) * self.page_size])
+            # chain hash: a page's key commits to every page before it
+            h = hashlib.blake2b(
+                prev + b"," + repr(list(chunk)).encode(), digest_size=16
+            ).hexdigest()
+            out.append((chunk, h))
+            prev = h.encode()
+        return out
+
+    # ------------------------------------------------------------- match
+    def match(self, token_ids=None, max_pages: Optional[int] = None,
+              *, keys=None) -> list[RadixNode]:
+        """Longest-prefix walk: the chain of nodes covering the leading
+        full pages of ``token_ids`` (any tier).  ``keys`` takes
+        precomputed ``page_keys`` output instead (the manager memoizes
+        them per request — a head-of-queue request re-matches every
+        step).  Touches each matched node's LRU clock; takes NO
+        references — the caller adopts explicitly via ``acquire`` once
+        it commits to the pages."""
+        if keys is None:
+            keys = self.page_keys(token_ids, max_pages)
+        nodes = []
+        cur = self._root
+        for chunk, _ in keys:
+            child = cur.children.get(chunk)
+            if child is None:
+                break
+            nodes.append(child)
+            cur = child
+        self._clock += 1
+        for n in nodes:
+            n.last_use = self._clock
+        return nodes
+
+    def acquire(self, node: RadixNode) -> None:
+        if node.ref == 0 and node.page is not None:
+            self._unref_hbm -= 1
+        node.ref += 1
+
+    def release(self, node: RadixNode) -> None:
+        node.ref -= 1
+        if node.ref < 0:
+            raise AssertionError(
+                f"radix node {node.key} refcount went negative")
+        if node.ref == 0 and node.page is not None:
+            self._unref_hbm += 1
+            self._push_victim(node)
+
+    # --------------------------------------------------- candidate heap
+    def _push_victim(self, node: RadixNode) -> None:
+        """Queue ``node`` as an eviction candidate if it qualifies
+        right now (unreferenced HBM effective leaf).  Call on every
+        transition into candidacy; duplicates and entries invalidated
+        by later transitions are discarded at pop time."""
+        if node.page is None or node.ref or node.hbm_desc:
+            return
+        heapq.heappush(self._victims,
+                       (node.last_use, next(self._vseq), node))
+
+    def _adjust_hbm_desc(self, node: RadixNode, delta: int) -> None:
+        """Propagate an HBM page gained/lost at ``node`` into its
+        ancestors' descendant counters; a loss can turn an ancestor
+        into an effective leaf, i.e. an eviction candidate."""
+        n = node.parent
+        while n is not None:
+            n.hbm_desc += delta
+            if delta < 0 and n.hbm_desc == 0:
+                self._push_victim(n)
+            n = n.parent
+
+    # ------------------------------------------------------------ insert
+    def insert(self, token_ids, pages: list[int],
+               max_pages: Optional[int] = None) -> set[int]:
+        """Register the full pages of ``token_ids`` (KV resident in
+        ``pages``, parallel order).  Existing nodes keep their storage
+        (the first producer wins, matching the flat map's collision
+        rule) and the incoming duplicate page is NOT consumed — except
+        a COLD existing node, which re-adopts the incoming hot page
+        (same content, already in HBM: strictly better than a restore).
+        Returns the set of pages the index took ownership of."""
+        consumed: set[int] = set()
+        cur = self._root
+        self._clock += 1
+        for (chunk, key), page in zip(
+                self.page_keys(token_ids, max_pages), pages):
+            child = cur.children.get(chunk)
+            gained = False
+            if child is None:
+                child = RadixNode(cur, chunk, key, page)
+                cur.children[chunk] = child
+                self._by_page[page] = child
+                consumed.add(page)
+                self._unref_hbm += 1
+                gained = True
+            elif child.page is None:
+                child.page = page
+                child.tier = TIER_HBM
+                self._by_page[page] = child
+                consumed.add(page)
+                if child.ref == 0:
+                    self._unref_hbm += 1
+                gained = True
+            child.last_use = self._clock
+            if gained:
+                self._adjust_hbm_desc(child, +1)
+                self._push_victim(child)
+            cur = child
+        return consumed
+
+    # ----------------------------------------------------------- restore
+    def rebind_page(self, node: RadixNode, page: int) -> None:
+        """Give a cold node fresh HBM storage (restore path)."""
+        if node.page is not None:
+            raise AssertionError(
+                f"rebind of node {node.key} which still owns page "
+                f"{node.page}")
+        node.page = page
+        node.tier = TIER_HBM
+        self._by_page[page] = node
+        self._adjust_hbm_desc(node, +1)
+        if node.ref == 0:
+            self._unref_hbm += 1
+        self._push_victim(node)
+
+    # ---------------------------------------------------------- eviction
+    def evictable(self, pinned: set[int]) -> int:
+        """HBM pages reclaimable right now: unreferenced AND unpinned.
+        The ref invariant (ancestor.ref >= child.ref) means repeated
+        deepest-first eviction reaches all of them.  O(|pinned|), not
+        O(tree): the unreferenced count is maintained incrementally and
+        pins are few (one snapshot per in-flight transfer)."""
+        if not pinned:
+            return self._unref_hbm
+        pinned_unref = sum(
+            1 for p in pinned
+            if (n := self._by_page.get(p)) is not None and n.ref == 0)
+        return self._unref_hbm - pinned_unref
+
+    def pick_victim(self, pinned: set[int]) -> Optional[RadixNode]:
+        """The eviction victim: the least-recently-used unreferenced,
+        unpinned HBM node with no HBM descendant ("effectively a
+        leaf" — cold descendants don't count, their bytes already left
+        the device).  Served from the lazy candidate heap — amortized
+        O(log n) instead of a full-tree walk per evicted page.  Such a
+        node always exists when ``evictable`` > 0: any unreferenced
+        HBM node's deepest HBM descendant qualifies."""
+        pinned_back: list[tuple[int, RadixNode]] = []
+        victim: Optional[RadixNode] = None
+        while self._victims:
+            use, _, node = heapq.heappop(self._victims)
+            if node.page is None or node.ref or node.hbm_desc:
+                continue  # stale: a future candidacy event re-pushes
+            if node.last_use != use:
+                # touched since push: re-queue at its current recency
+                self._push_victim(node)
+                continue
+            if node.page in pinned:
+                # still a candidate — no radix event fires when the
+                # pin releases (ack_transfer), so it must stay queued
+                pinned_back.append((use, node))
+                continue
+            victim = node
+            break
+        for use, node in pinned_back:
+            heapq.heappush(self._victims, (use, next(self._vseq), node))
+        return victim
+
+    def mark_cold(self, node: RadixNode, tier: str) -> Optional[int]:
+        """Offload-evict: the node's KV left HBM for ``tier`` but the
+        node STAYS matchable in the tree.  Returns the released page."""
+        page = node.page
+        if page is not None:
+            self._by_page.pop(page, None)
+            if node.ref == 0:
+                self._unref_hbm -= 1
+            self._adjust_hbm_desc(node, -1)
+        node.page = None
+        node.tier = tier
+        return page
+
+    def drop(self, node: RadixNode) -> tuple[Optional[int], list[str]]:
+        """Drop-evict: detach the node AND its (necessarily
+        unreferenced) subtree — a dropped prefix makes every extension
+        unmatchable, so keeping them would recreate exactly the orphan
+        garbage the flat map suffered from.  Returns (the node's HBM
+        page, cold keys whose tier payloads should be purged).  Any
+        HBM descendants' pages are returned via ``extra_pages`` on the
+        keys list caller — callers evict deepest-first so in practice
+        the subtree holds only cold nodes."""
+        purge: list[str] = []
+        stack = list(node.children.values())
+        while stack:
+            n = stack.pop()
+            if n.ref > 0:
+                raise AssertionError(
+                    "drop of a node with a referenced descendant")
+            if n.page is not None:
+                # deepest-first callers never hit this; keep the audit
+                raise AssertionError(
+                    "drop of a node with an HBM descendant")
+            purge.append(n.key)
+            stack.extend(n.children.values())
+        page = node.page
+        if page is not None:
+            self._by_page.pop(page, None)
+            if node.ref == 0:
+                self._unref_hbm -= 1
+            self._adjust_hbm_desc(node, -1)
+        if node.parent is not None:
+            node.parent.children.pop(node.tokens, None)
+        node.parent = None
+        node.children = {}
+        return page, purge
+
+    # ------------------------------------------------------------- reset
+    def reset(self, pinned: set[int]) -> tuple[list[int], list[str]]:
+        """Drop every node not protected by a live reference or a pin
+        (reference: reset_prefix_cache — weight updates invalidate
+        cached KV).  A protected node protects its ancestors (their
+        chain is its context).  Returns (freed HBM pages, cold keys to
+        purge from the tier store)."""
+        keep: set[int] = set()
+        for node in self._iter_nodes():
+            if node.ref > 0 or (node.page is not None
+                                and node.page in pinned):
+                n: Optional[RadixNode] = node
+                while n is not None and id(n) not in keep:
+                    keep.add(id(n))
+                    n = n.parent
+        freed: list[int] = []
+        purged: list[str] = []
+        for node in list(self._iter_nodes()):
+            if id(node) in keep:
+                continue
+            if node.page is not None:
+                freed.append(node.page)
+                self._by_page.pop(node.page, None)
+            else:
+                purged.append(node.key)
+            # unlink from a surviving parent (the root always
+            # survives); doomed parents need no unlink — their own
+            # topmost doomed ancestor is cut from a survivor here
+            if node.parent is self._root or id(node.parent) in keep:
+                node.parent.children.pop(node.tokens, None)
+        # reset is rare: recount / rebuild rather than threading deltas
+        self._unref_hbm = 0
+        self._root.hbm_desc = 0
+        for n in self._iter_nodes():
+            n.hbm_desc = 0
+        for n in self._iter_nodes():
+            if n.page is None:
+                continue
+            if n.ref == 0:
+                self._unref_hbm += 1
+            a = n.parent
+            while a is not None:
+                a.hbm_desc += 1
+                a = a.parent
+        self._victims = []
+        for n in self._iter_nodes():
+            self._push_victim(n)
+        return freed, purged
+
+    # -------------------------------------------------------- invariants
+    def check_invariants(self) -> list[str]:
+        """Structural audit for the property-test harness: returns a
+        list of violations (empty = healthy)."""
+        errors = []
+        seen_pages: set[int] = set()
+        for node in self._iter_nodes():
+            if node.ref < 0:
+                errors.append(f"node {node.key}: negative ref {node.ref}")
+            if len(node.tokens) != self.page_size:
+                errors.append(
+                    f"node {node.key}: tokens len {len(node.tokens)} != "
+                    f"page_size {self.page_size}")
+            if node.parent is not None \
+                    and node.parent.children.get(node.tokens) is not node:
+                errors.append(f"node {node.key}: parent link broken")
+            for child in node.children.values():
+                if child.ref > node.ref:
+                    errors.append(
+                        f"ref invariant broken: child {child.key} ref "
+                        f"{child.ref} > parent {node.key} ref {node.ref}")
+            if node.page is not None:
+                if node.page in seen_pages:
+                    errors.append(f"page {node.page} owned by two nodes")
+                seen_pages.add(node.page)
+                if self._by_page.get(node.page) is not node:
+                    errors.append(
+                        f"page {node.page} missing from _by_page")
+                if node.tier != TIER_HBM:
+                    errors.append(
+                        f"node {node.key}: page set but tier {node.tier}")
+            elif node.tier == TIER_HBM:
+                errors.append(f"node {node.key}: tier hbm but no page")
+            actual_desc = 0
+            stack = list(node.children.values())
+            while stack:
+                d = stack.pop()
+                if d.page is not None:
+                    actual_desc += 1
+                stack.extend(d.children.values())
+            if node.hbm_desc != actual_desc:
+                errors.append(
+                    f"node {node.key}: hbm_desc drifted: counter "
+                    f"{node.hbm_desc} != recount {actual_desc}")
+        if seen_pages != set(self._by_page):
+            errors.append("_by_page out of sync with tree")
+        recount = sum(1 for n in self._iter_nodes()
+                      if n.page is not None and n.ref == 0)
+        if recount != self._unref_hbm:
+            errors.append(
+                f"_unref_hbm drifted: counter {self._unref_hbm} != "
+                f"recount {recount}")
+        return errors

@@ -68,6 +68,33 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "prefix_cache_hit_tokens_total": (
         "counter", "Prompt tokens served from the prefix cache",
         ("stage",)),
+    # ---- kvcache subsystem: radix prefix index + tiered offload
+    # (docs/kv_cache.md)
+    "kv_prefix_hit_tokens_total": (
+        "counter",
+        "Prompt tokens adopted from the radix prefix index (all tiers)",
+        ("stage",)),
+    "kv_tier_hbm_pages": (
+        "gauge", "KV pages holding live data on the device", ("stage",)),
+    "kv_tier_host_pages": (
+        "gauge", "KV payloads parked in the host-RAM tier", ("stage",)),
+    "kv_tier_remote_pages": (
+        "gauge", "KV payloads parked in the remote tier", ("stage",)),
+    "kv_offload_bytes_total": (
+        "counter",
+        "KV bytes moved per tier and direction (out = away from HBM, "
+        "in = restored toward it)", ("stage", "tier", "dir")),
+    "kv_restore_seconds": (
+        "histogram",
+        "Tier-restore latency per request run (fetch + inject)",
+        ("stage",)),
+    "kv_restored_tokens_total": (
+        "counter",
+        "Recompute tokens avoided by tier restores (cold prefix "
+        "adoptions + park restores)", ("stage",)),
+    "kv_parked_tokens_total": (
+        "counter", "Tokens parked to the tiers at preemption",
+        ("stage",)),
     "engine_steps_total": (
         "counter", "Engine step() executions", ("stage",)),
     "tokens_generated_total": (
@@ -278,6 +305,28 @@ def render_exposition(summary: dict, engine_snaps: dict,
             exp.sample("prefix_cache_hits_total", labels, pc.get("hits", 0))
             exp.sample("prefix_cache_hit_tokens_total", labels,
                        pc.get("hit_tokens", 0))
+        tiers = snap.get("kv_tiers")
+        if tiers:
+            exp.sample("kv_prefix_hit_tokens_total", labels,
+                       tiers.get("prefix_hit_tokens", 0))
+            exp.sample("kv_tier_hbm_pages", labels,
+                       tiers.get("hbm_pages", 0))
+            exp.sample("kv_tier_host_pages", labels,
+                       tiers.get("host_pages", 0))
+            exp.sample("kv_tier_remote_pages", labels,
+                       tiers.get("remote_pages", 0))
+            for edge, n in sorted(
+                    (tiers.get("bytes_moved") or {}).items()):
+                tier, _, direction = str(edge).partition("/")
+                exp.sample("kv_offload_bytes_total",
+                           {**labels, "tier": tier, "dir": direction}, n)
+            exp.sample("kv_restored_tokens_total", labels,
+                       tiers.get("restored_tokens", 0))
+            exp.sample("kv_parked_tokens_total", labels,
+                       tiers.get("parked_tokens", 0))
+        if snap.get("kv_restore_seconds", {}).get("count"):
+            exp.histogram("kv_restore_seconds", labels,
+                          snap["kv_restore_seconds"])
         counters = snap.get("counters")
         if counters:
             exp.sample("engine_steps_total", labels,

@@ -76,6 +76,12 @@ LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 TOKEN_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                  512.0, 1024.0, 2048.0, 4096.0, 8192.0)
 
+# KV tier-restore latency buckets, in SECONDS: sub-ms for host-tier
+# hits on fast tunnels up to tens of seconds for big runs over the
+# measured ~0.15 GB/s host<->HBM path (docs/kv_cache.md).
+KV_RESTORE_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
 
 class Histogram:
     """Fixed-bucket histogram with a recent-value window for percentiles.
@@ -163,6 +169,9 @@ class EngineStepMetrics:
         # the useful/padded counters below this makes the unified
         # ragged path's padding win measurable (docs/ragged_batching.md)
         self.batched_tokens = Histogram(buckets=TOKEN_BUCKETS)
+        # per-request KV tier restore latency (fetch + inject), seconds
+        # — the cold path must earn its transfers (docs/kv_cache.md)
+        self.kv_restore_s = Histogram(buckets=KV_RESTORE_BUCKETS_S)
         # gauges (last sampled values)
         self.num_waiting = 0
         self.num_running = 0
@@ -240,6 +249,7 @@ class EngineStepMetrics:
             "host_ms": self.host_ms.snapshot(),
             "device_ms": self.device_ms.snapshot(),
             "batched_tokens": self.batched_tokens.snapshot(),
+            "kv_restore_seconds": self.kv_restore_s.snapshot(),
             "padding": {
                 "useful_tokens_total": self.useful_tokens_total,
                 "padded_tokens_total": self.padded_tokens_total,

@@ -146,10 +146,12 @@ class ARModelRunner:
         async_scheduling: bool = False,  # precompile the dispatch path
         unified_batching: bool = False,  # build the ragged unified step
         max_num_batched_tokens: int = 2048,  # sizes the token buckets
+        deterministic_decode: bool = False,  # pin decode batches to one bucket
     ):
         self.multi_step_decode = max(1, int(multi_step_decode))
         self.async_scheduling = bool(async_scheduling)
         self.unified_batching = bool(unified_batching)
+        self.deterministic_decode = bool(deterministic_decode)
         self.mesh = mesh
         if mesh is not None:
             # Megatron-style TP inside shard_map: heads and MLP columns
@@ -490,6 +492,20 @@ class ARModelRunner:
         self.useful_tokens += int(useful)
         self.padded_tokens += int(padded)
 
+    def _decode_bucket(self, n: int) -> int:
+        """Batch bucket for the single-token decode family.  With
+        ``deterministic_decode`` every decode step pads to the TOP
+        bucket: XLA fuses the [B]-leading decode matmuls differently
+        per bucket shape, so the same row decoded in a bucket-4 batch
+        and a bucket-8 batch can differ in the last bf16 bit — enough
+        to flip a greedy argmax on near-flat logits.  One fixed bucket
+        makes a request's stream invariant to co-batch occupancy
+        (preemptions and arrivals stop perturbing OTHER requests'
+        tokens) at the cost of padded rows when the batch runs small."""
+        if self.deterministic_decode:
+            return self._batch_buckets[-1]
+        return _bucket(n, self._batch_buckets)
+
     # ---------------------------------------------------------- precompile
     def precompile(self, prefill_shapes=(), decode: bool = True,
                    progress_fn=None) -> int:
@@ -541,7 +557,12 @@ class ARModelRunner:
             return res
 
         if decode:
-            for b in self._batch_buckets:
+            # deterministic decode runs every step at the top bucket —
+            # the smaller executables can never be dispatched
+            decode_buckets = (self._batch_buckets[-1:]
+                              if self.deterministic_decode
+                              else self._batch_buckets)
+            for b in decode_buckets:
                 note(f"precompile decode b={b}")
                 zeros_b = jnp.zeros((b,), jnp.int32)
                 tables = jnp.zeros((b, self.max_pages_per_seq), jnp.int32)
@@ -1053,7 +1074,7 @@ class ARModelRunner:
         return positions, slots, tables, ctx
 
     def _run_decode(self, scheds: list[ScheduledRequest], out: RunnerOutput):
-        b = _bucket(len(scheds), self._batch_buckets)
+        b = self._decode_bucket(len(scheds))
         token_ids = np.zeros((b,), np.int32)
         for i, sc in enumerate(scheds):
             token_ids[i] = sc.request.all_token_ids[sc.start_pos]
@@ -1083,7 +1104,7 @@ class ARModelRunner:
         feedback that keeps the host out of the token loop.  The engine
         retires the handle one step later (``retire_decode``)."""
         self._step += 1
-        b = _bucket(len(scheds), self._batch_buckets)
+        b = self._decode_bucket(len(scheds))
         token_host = np.zeros((b,), np.int32)
         feed_rows: list[int] = []
         feed_src: list[int] = []
@@ -1175,7 +1196,7 @@ class ARModelRunner:
         each request's run is trimmed at its first stop condition — KV
         written past a stop is position-keyed garbage in that request's
         own pages, never attended and freed with the request."""
-        b = _bucket(len(scheds), self._batch_buckets)
+        b = self._decode_bucket(len(scheds))
         token_ids = np.zeros((b,), np.int32)
         positions = (np.zeros((b, 3), np.int32) if self.use_mrope
                      else np.zeros((b,), np.int32))
@@ -1541,7 +1562,11 @@ class ARModelRunner:
         """Scatter per-layer dense [Hkv, seq_len, D] KV into the given
         pages — the receive half of the transfer manager (reference:
         omni_connectors/kv_transfer_manager.py:100+ receive path, which r1
-        lacked: extracted KV had nowhere to land).  Returns seq_len."""
+        lacked: extracted KV had nowhere to land) and of the kvcache
+        tier-restore path (docs/kv_cache.md).  The whole payload ships
+        host->device as ONE pytree transfer — a per-layer asarray walk
+        was 2 transfers per layer on the ~0.15 GB/s tunnel.  Returns
+        seq_len."""
         if len(payload) != len(self.kv_caches):
             raise ValueError(
                 f"KV payload has {len(payload)} layers, cache has "
@@ -1554,10 +1579,13 @@ class ARModelRunner:
             * self.page_size + pos % self.page_size,
             jnp.int32,
         )
+        device_payload = jax.device_put(
+            [(np.asarray(k), np.asarray(v)) for k, v in payload])
         new_caches = []
-        for (k_cache, v_cache), (k, v) in zip(self.kv_caches, payload):
-            kt = jnp.moveaxis(jnp.asarray(k), 0, 1)  # [seq, Hkv, D]
-            vt = jnp.moveaxis(jnp.asarray(v), 0, 1)
+        for (k_cache, v_cache), (k, v) in zip(self.kv_caches,
+                                              device_payload):
+            kt = jnp.moveaxis(k, 0, 1)  # [seq, Hkv, D]
+            vt = jnp.moveaxis(v, 0, 1)
             k_cache, v_cache = write_kv_cache(k_cache, v_cache, kt, vt, slots)
             new_caches.append((k_cache, v_cache))
         self.kv_caches = new_caches
@@ -1579,3 +1607,27 @@ class ARModelRunner:
         # omnilint: disable=OL2
         payload = jax.device_get(slices)
         return [(np.asarray(k), np.asarray(v)) for k, v in payload]
+
+    def extract_kv_batch(self, specs: list[tuple[list[int], int]]
+                         ) -> list[list]:
+        """``extract_kv`` for SEVERAL page runs in one device round
+        trip: [(block_ids, seq_len)] -> one payload each.  The kvcache
+        tier drain uses this so a step that evicts/park-extracts many
+        payloads still costs ONE host sync (docs/kv_cache.md) — the
+        bytes-moved discipline the ~0.15 GB/s tunnel demands."""
+        all_slices = []
+        for block_ids, seq_len in specs:
+            ids = jnp.asarray(block_ids, jnp.int32)
+            slices = []
+            for k_cache, v_cache in self.kv_caches:
+                k = k_cache[:, ids].reshape(
+                    k_cache.shape[0], -1, k_cache.shape[-1])
+                v = v_cache[:, ids].reshape(
+                    v_cache.shape[0], -1, v_cache.shape[-1])
+                slices.append((k[:, :seq_len], v[:, :seq_len]))
+            all_slices.append(slices)
+        # omnilint: disable=OL2 - ONE batched transfer for every
+        # payload this step parks (the whole point of the batch API)
+        payloads = jax.device_get(all_slices)
+        return [[(np.asarray(k), np.asarray(v)) for k, v in sl]
+                for sl in payloads]
