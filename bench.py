@@ -45,7 +45,13 @@ multi-turn sessions with idle gaps on an undersized page pool — reports
 prefix hit-rate, recompute-tokens-avoided, offload bytes moved per
 tier, and greedy bit-equality vs a never-offloaded oracle; see
 docs/kv_cache.md.  OMNI_BENCH_KV_SESSIONS / OMNI_BENCH_KV_TURNS /
-OMNI_BENCH_KV_QUANT=int8 tune it).
+OMNI_BENCH_KV_QUANT=int8 tune it) /
+OMNI_BENCH_SERVING=1 (STANDALONE serving-curve scenario, CPU-runnable:
+open-loop offered-load sweep through vllm_omni_tpu/loadgen against a
+live OpenAI server — per-rate attained throughput, goodput, SLO
+attainment, TTFT/TPOT/E2E percentiles, shed counts, plus a mid-flight
+/metrics scrape; OMNI_BENCH_SERVING_RATES / _SLO_TTFT_MS / _SLO_TPOT_MS
+/ _DURATION_S / _QUEUE_DEPTH / _TENANTS tune it; docs/load_testing.md).
 """
 
 from __future__ import annotations
@@ -222,6 +228,203 @@ def _release_device_memory() -> None:
 
     jax.clear_caches()
     gc.collect()
+
+
+# ---------------------------------------------------------- serving curve
+def _serving_tiny_factory():
+    """loadgen serving-curve stage model: a tiny dense LM so a CPU
+    sweep finishes in seconds — the scenario measures the SERVING stack
+    (admission control, queueing, SLO/goodput accounting), not model
+    FLOPs; the AR bench owns those."""
+    import jax
+    import jax.numpy as jnp
+
+    from vllm_omni_tpu.models.common import transformer as tfm
+
+    cfg = tfm.TransformerConfig.tiny(vocab_size=2048)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return params, cfg, None
+
+
+def bench_serving() -> dict:
+    """OMNI_BENCH_SERVING=1: the open-loop serving curve (ROADMAP item
+    5 / docs/load_testing.md).  Sweeps >= 3 offered-load rates against
+    a live OpenAI server driven by the loadgen harness and emits one
+    ``serving_curve`` point per rate — attained throughput, goodput
+    (SLO-met completions only), TTFT/TPOT/E2E percentiles, shed and
+    expired counts — plus a MID-FLIGHT /metrics scrape proving the
+    SLO/goodput/shed/queue-depth series are live while traffic runs.
+
+    Knobs: OMNI_BENCH_SERVING_RATES (req/s, comma list),
+    OMNI_BENCH_SERVING_SLO_TTFT_MS / _SLO_TPOT_MS,
+    OMNI_BENCH_SERVING_DURATION_S (per rate),
+    OMNI_BENCH_SERVING_QUEUE_DEPTH (admission cap),
+    OMNI_BENCH_SERVING_TENANTS (comma list, round-robined)."""
+    import threading
+    import urllib.request
+
+    from vllm_omni_tpu.config.stage import StageConfig
+    from vllm_omni_tpu.entrypoints.openai.api_server import build_server
+    from vllm_omni_tpu.loadgen import (
+        SLOTargets,
+        build_workload,
+        poisson_arrivals,
+        run_http,
+        summarize,
+    )
+    from vllm_omni_tpu.loadgen.workload import Scenario
+    from vllm_omni_tpu.metrics.prometheus import validate_exposition
+
+    rates = [float(x) for x in os.environ.get(
+        "OMNI_BENCH_SERVING_RATES", "2,4,8").split(",") if x.strip()]
+    slo = SLOTargets(
+        ttft_ms=float(os.environ.get(
+            "OMNI_BENCH_SERVING_SLO_TTFT_MS", "2000")),
+        tpot_ms=float(os.environ.get(
+            "OMNI_BENCH_SERVING_SLO_TPOT_MS", "500")))
+    duration = float(os.environ.get("OMNI_BENCH_SERVING_DURATION_S", "5"))
+    queue_depth = int(os.environ.get(
+        "OMNI_BENCH_SERVING_QUEUE_DEPTH", "32"))
+    tenants = [t for t in os.environ.get(
+        "OMNI_BENCH_SERVING_TENANTS", "tenant_a,tenant_b").split(",")
+        if t.strip()]
+    # CPU-scale catalog: the default long-context lengths would make a
+    # tiny-model CPU sweep prefill-bound for minutes; keep the same mix
+    # SHAPE at bench-scale lengths
+    # stream=True on most legs: SSE is how the client MEASURES TTFT —
+    # a non-streaming request can't judge the TTFT SLO leg (unmeasured
+    # legs pass), so the curve would under-constrain attainment
+    catalog = [
+        Scenario("chat", weight=0.5, prompt_len=(16, 48),
+                 output_len=(8, 16), stream=True),
+        Scenario("long_context", weight=0.2, prompt_len=(96, 160),
+                 output_len=(8, 12)),
+        Scenario("multi_turn", weight=0.2, prompt_len=(8, 32),
+                 output_len=(8, 12), shared_prefix_len=48,
+                 stream=True),
+        Scenario("streaming", weight=0.1, prompt_len=(16, 32),
+                 output_len=(8, 16), stream=True),
+    ]
+    stage = StageConfig(
+        stage_id=0, stage_type="llm",
+        engine_args={
+            "model_factory": _serving_tiny_factory,
+            "num_pages": 1024, "page_size": 16, "max_model_len": 2048,
+            "max_num_seqs": 8, "max_num_batched_tokens": 1024,
+            "enable_chunked_prefill": True,
+            # precompile every decode batch bucket before the server
+            # reports ready; prefill buckets are warmed by the catalog
+            # warmup below — a mid-sweep XLA compile would bill its
+            # stall to the lowest rate's latencies
+            "warmup": True,
+            "max_queue_depth": queue_depth,
+            "slo_ttft_ms": slo.ttft_ms, "slo_tpot_ms": slo.tpot_ms,
+        },
+        engine_input_source=[-1], final_output=True,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0},
+    )
+    _progress(f"serving: starting OpenAI server (queue_depth="
+              f"{queue_depth}, SLO ttft {slo.ttft_ms}ms / tpot "
+              f"{slo.tpot_ms}ms)")
+    server, state = build_server(model="loadgen-bench",
+                                 stage_configs=[stage],
+                                 host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    probe: dict = {"scraped_mid_flight": False}
+
+    def scrape_mid_flight():
+        # fire mid-sweep: the acceptance contract is that the series
+        # are scrape-able WHILE traffic runs, not post-hoc
+        time.sleep(duration * 0.5)
+        try:
+            with urllib.request.urlopen(f"{base}/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            required = ("slo_attainment_ratio", "goodput_tokens_total",
+                        "request_queue_depth", "queue_wait_ms",
+                        "phase_saturation_ratio")
+            probe.update(
+                scraped_mid_flight=True,
+                violations=validate_exposition(text),
+                series_present={
+                    name: f"vllm_omni_tpu_{name}" in text
+                    for name in required},
+                tenant_label_present='tenant="' in text,
+            )
+        except Exception as e:
+            probe["error"] = f"{type(e).__name__}: {e}"
+
+    curve = []
+    try:
+        # warmup: compile the executables before the first rate point —
+        # an XLA compile inside the sweep bills tens of seconds of
+        # one-time cost to the lowest rate's latencies (observed: TTFT
+        # p50 10.8s at rate 2 with a 2-request warmup).  Drawing the
+        # warmup from the SAME catalog walks the same prompt-length
+        # buckets the sweep will hit
+        _progress("serving: warmup requests (compiles)")
+        top = max(rates)
+        n_warm = max(int(round(top * duration)), 10)
+        warm = build_workload(
+            [0.0] * n_warm, catalog, seed=99,
+            vocab_size=2000, tenants=tenants, id_prefix="warm")
+        # closed-loop ON PURPOSE (warmup is not measured): groups
+        # small enough to stay under both the seat count and the
+        # admission cap fire together and fully drain before the next
+        # group — open-loop warmup at the top rate against a cold,
+        # compiling server would queue past max_queue_depth and SHED
+        # the very requests meant to compile the prompt-length
+        # buckets, leaving those compiles to stall a measured rate
+        # point (and polluting the cumulative shed ledger)
+        group = max(1, min(8, queue_depth if queue_depth > 0 else 8))
+        for lo in range(0, len(warm), group):
+            run_http(base, warm[lo:lo + group])
+        for i, rate in enumerate(rates):
+            n = max(int(round(rate * duration)), 3)
+            arrivals = poisson_arrivals(rate, n, seed=1000 + i)
+            wl = build_workload(arrivals, catalog, seed=2000 + i,
+                                vocab_size=2000, tenants=tenants,
+                                id_prefix=f"r{i}")
+            _progress(f"serving: rate {rate} req/s ({n} requests)")
+            scraper = None
+            if i == len(rates) - 1:  # scrape during the hottest point
+                scraper = threading.Thread(target=scrape_mid_flight)
+                scraper.start()
+            records = run_http(base, wl)
+            if scraper is not None:
+                scraper.join()
+            curve.append(summarize(records, rate, slo))
+            _progress(
+                f"serving: rate {rate} -> goodput "
+                f"{curve[-1]['goodput_tok_per_s']} tok/s, attainment "
+                f"{curve[-1]['slo_attainment']}, shed "
+                f"{curve[-1]['shed']}")
+    finally:
+        server.shutdown()
+        state.shutdown()
+    peak = max((p["goodput_tok_per_s"] for p in curve), default=None)
+    return {
+        "metric": "serving_peak_goodput_tok_per_s",
+        "value": peak,
+        "unit": "tok/s",
+        "vs_baseline": None,
+        "serving_curve": curve,
+        "slo": slo.as_dict(),
+        "offered_rates_rps": rates,
+        "tenants": tenants,
+        "max_queue_depth": queue_depth,
+        "metrics_probe": probe,
+        "arch": {
+            "note": "tiny dense LM on purpose — the scenario benches "
+                    "the serving stack (admission, queueing, SLO "
+                    "accounting), not model FLOPs",
+            "weights": "random-init",
+        },
+    }
 
 
 # ------------------------------------------------------------- diffusion
@@ -787,6 +990,14 @@ def bench_kv_reuse() -> dict:
 
 def main():
     os.environ.setdefault("OMNI_TPU_LOG_LEVEL", "WARNING")
+
+    if os.environ.get("OMNI_BENCH_SERVING", "") == "1":
+        # serving-curve scenario: a standalone mode (CPU-runnable; no
+        # chip probe — the scenario's tiny model runs wherever jax
+        # does) that sweeps offered-load rates through the loadgen
+        # harness and emits the serving_curve block
+        print(json.dumps(bench_serving()))
+        return
 
     if not _tpu_alive():
         # honest fast failure: no throughput number exists without the

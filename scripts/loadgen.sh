@@ -1,0 +1,27 @@
+#!/usr/bin/env sh
+# loadgen gate: the open-loop serving-curve harness end to end —
+# seeded Poisson / trace-replay determinism (same seed, same arrival
+# schedule), goodput-vs-throughput math against a hand-computed oracle,
+# SLO attainment edge cases (exactly-at-target, zero completions,
+# 1-token TPOT), the virtual-time smoke curve at 2 offered-load points
+# asserting monotone non-increasing goodput ratio past saturation plus
+# a schema-valid serving_curve artifact, the 429 shed path returning
+# before engine admission, the x-omni-tenant split of the SLO/goodput/
+# queue-depth series on /metrics, and a fast in-process AsyncOmni run
+# producing a schema-valid serving_curve record.
+#
+# Standalone face of the same coverage tier-1 carries (tests/loadgen is
+# a fast directory), sitting next to scripts/kvcache.sh,
+# scripts/ragged.sh, scripts/asyncstep.sh and scripts/omnilint.sh as a
+# pre-merge gate:
+#
+#   scripts/loadgen.sh               # the whole serving-curve contract
+#   scripts/loadgen.sh -k shed       # pass-through pytest args
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU: the smoke curve runs a tiny random-weight model on the
+# fake-device path; the gate must never touch a real chip a colocated
+# serving process owns
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/loadgen/ \
+    -q -p no:cacheprovider -m "not slow" "$@"

@@ -1,0 +1,227 @@
+"""Goodput-vs-throughput math against hand-computed oracles, SLO edge
+cases (exactly-at-target, zero completions), the virtual-time queue
+simulator, and the engine-side per-tenant SLO ledger + monotonic
+duration clocks."""
+
+import time
+
+from vllm_omni_tpu.loadgen.runner import (
+    RequestRecord,
+    SLOTargets,
+    simulate,
+    slo_met,
+    summarize,
+    validate_curve_point,
+)
+from vllm_omni_tpu.loadgen.workload import LoadRequest
+from vllm_omni_tpu.metrics.stats import (
+    EngineStepMetrics,
+    OrchestratorAggregator,
+    RequestE2EStats,
+)
+
+
+def _rec(rid, fired=0.0, first=None, end=None, tokens=0, status="ok",
+         tenant="default"):
+    return RequestRecord(request_id=rid, tenant=tenant, fired_s=fired,
+                         first_s=first, end_s=end, tokens_out=tokens,
+                         status=status)
+
+
+# ------------------------------------------------------- goodput oracle
+def test_summarize_matches_hand_oracle():
+    """4 offered: one fast (met), one slow TTFT (missed), one shed, one
+    errored.  Hand-computed over duration_s=10:
+      attained = 2 completions, 30 tokens
+      goodput  = 1 completion, 10 tokens (only the SLO-met one)
+      attainment = 1/4 (sheds and errors are misses by definition)."""
+    slo = SLOTargets(ttft_ms=100.0, tpot_ms=50.0)
+    records = [
+        # ttft 50ms, 10 tokens over (1.0 - 0.05)s -> tpot ~105.6/9? No:
+        # tpot = (end-first)/(tokens-1) = 0.95s/9 = 105.6ms > 50 — keep
+        # it under: end = first + 9 * 0.04 = 0.41
+        _rec("a", fired=0.0, first=0.05, end=0.41, tokens=10),
+        _rec("b", fired=0.0, first=0.5, end=1.0, tokens=20),  # ttft 500
+        _rec("c", fired=1.0, end=1.0, status="shed"),
+        _rec("d", fired=2.0, end=3.0, status="error"),
+    ]
+    p = summarize(records, offered_rps=0.4, slo=slo, duration_s=10.0)
+    assert p["num_requests"] == 4
+    assert p["completed"] == 2 and p["shed"] == 1 and p["errors"] == 1
+    assert p["attained_req_per_s"] == 0.2
+    assert p["attained_tok_per_s"] == 3.0
+    assert p["goodput_req_per_s"] == 0.1
+    assert p["goodput_tok_per_s"] == 1.0
+    assert p["slo_attainment"] == 0.25
+    assert validate_curve_point(p) == []
+
+
+def test_slo_exactly_at_target_counts_as_met():
+    slo = SLOTargets(ttft_ms=100.0, tpot_ms=50.0)
+    # ttft exactly 100ms; tpot exactly 50ms over 3 tokens
+    r = _rec("x", fired=0.0, first=0.1, end=0.1 + 2 * 0.05, tokens=3)
+    assert r.ttft_ms == 100.0 and abs(r.tpot_ms - 50.0) < 1e-9
+    assert slo_met(r, slo)
+    # one epsilon past either target misses
+    late = _rec("y", fired=0.0, first=0.1001, end=0.2, tokens=3)
+    assert not slo_met(late, slo)
+
+
+def test_slo_zero_completions_and_empty_percentiles():
+    p = summarize([_rec("a", status="shed", end=0.0)],
+                  offered_rps=1.0, slo=SLOTargets(ttft_ms=1.0),
+                  duration_s=1.0)
+    assert p["completed"] == 0
+    assert p["slo_attainment"] == 0.0
+    assert p["goodput_tok_per_s"] == 0.0
+    assert p["ttft_ms"]["p50"] == 0.0  # empty window renders zeros
+    assert validate_curve_point(p) == []
+    # degenerate: no records at all
+    empty = summarize([], offered_rps=1.0, duration_s=1.0)
+    assert empty["num_requests"] == 0 and empty["slo_attainment"] == 0.0
+
+
+def test_single_token_request_has_no_tpot_and_passes_that_leg():
+    slo = SLOTargets(tpot_ms=0.001)  # brutally tight
+    r = _rec("one", fired=0.0, first=0.2, end=0.2, tokens=1)
+    assert r.tpot_ms is None
+    assert slo_met(r, slo)
+
+
+def test_unmeasured_ttft_passes_but_missed_e2e_fails():
+    slo = SLOTargets(ttft_ms=1.0, e2e_ms=100.0)
+    r = _rec("nostream", fired=0.0, first=None, end=0.05, tokens=4)
+    assert r.ttft_ms is None and slo_met(r, slo)
+    slow = _rec("slow", fired=0.0, first=None, end=0.5, tokens=4)
+    assert not slo_met(slow, slo)
+
+
+def test_validate_curve_point_flags_drift():
+    p = summarize([_rec("a", first=0.1, end=0.2, tokens=2)],
+                  offered_rps=1.0, duration_s=1.0)
+    bad = dict(p)
+    bad.pop("goodput_tok_per_s")
+    assert any("goodput_tok_per_s" in e for e in
+               validate_curve_point(bad))
+    bad2 = dict(p)
+    bad2["completed"] = 7  # counts no longer partition num_requests
+    assert any("partition" in e for e in validate_curve_point(bad2))
+
+
+# ----------------------------------------------------------- simulator
+def _wl(n, gap_s, tokens=4, prefix="s"):
+    return [LoadRequest(at_s=i * gap_s, request_id=f"{prefix}-{i}",
+                        scenario="chat", tenant="default",
+                        prompt_token_ids=[1], max_tokens=tokens)
+            for i in range(n)]
+
+
+def test_simulate_unloaded_latencies_exact():
+    # service = 0.1 + 4*0.01 = 0.14s; gaps 1s >> service: no queueing
+    recs = simulate(_wl(3, 1.0), prefill_s=0.1, per_token_s=0.01)
+    for i, r in enumerate(recs):
+        assert r.status == "ok"
+        assert abs(r.ttft_ms - 110.0) < 1e-6  # prefill + 1 token
+        assert abs(r.e2e_ms - 140.0) < 1e-6
+        assert abs(r.first_s - (i * 1.0 + 0.11)) < 1e-9
+
+
+def test_simulate_queueing_and_shed():
+    # back-to-back arrivals, 1 server, service 1s each, queue_limit 2:
+    # r0 starts at 0; r1/r2 wait; r3+ find 2 waiting -> shed
+    recs = simulate(_wl(5, 0.0, tokens=0), prefill_s=1.0,
+                    per_token_s=0.0, queue_limit=2)
+    statuses = [r.status for r in recs]
+    assert statuses == ["ok", "ok", "ok", "shed", "shed"]
+    assert [r.end_s for r in recs if r.status == "ok"] == [1.0, 2.0, 3.0]
+
+
+def test_simulate_goodput_ratio_monotone_past_saturation():
+    """The loadgen.sh smoke contract: with a fixed-capacity server,
+    SLO attainment (goodput ratio) is non-increasing as offered load
+    crosses saturation."""
+    slo = SLOTargets(e2e_ms=500.0)
+    points = []
+    for rate, gap in ((2.0, 0.5), (20.0, 0.05)):
+        # capacity ~ 1/(0.1 + 4*0.025) = 5 req/s: rate 2 is under,
+        # rate 20 is 4x over
+        recs = simulate(_wl(40, gap), prefill_s=0.1, per_token_s=0.025,
+                        queue_limit=8)
+        points.append(summarize(recs, rate, slo))
+    assert points[0]["slo_attainment"] >= points[1]["slo_attainment"]
+    assert points[1]["shed"] > 0  # overload actually shed
+    for p in points:
+        assert validate_curve_point(p) == []
+
+
+def test_run_inproc_records_timeouts_as_errors():
+    """Requests still in flight at the runner timeout are recorded as
+    errors, not silently dropped — dropping would shrink the offered
+    population and flatter the knee of the curve."""
+    from vllm_omni_tpu.loadgen.runner import run_inproc
+
+    class StuckOmni:
+        async def generate(self, prompt, sp, request_id,
+                           deadline_s=None):
+            import asyncio
+
+            await asyncio.sleep(3600)
+            yield None  # pragma: no cover — never reached
+
+    wl = [LoadRequest(at_s=0.0, request_id="stuck-0", scenario="chat",
+                      tenant="t", prompt_token_ids=[1], max_tokens=2)]
+    recs = run_inproc(StuckOmni(), wl, timeout_s=0.2)
+    assert [r.status for r in recs] == ["error"]
+    point = summarize(recs, 1.0, SLOTargets(ttft_ms=1.0))
+    assert point["num_requests"] == 1 and point["errors"] == 1
+    assert validate_curve_point(point) == []
+
+
+# ------------------------------------------- engine-side tenant ledger
+def test_engine_step_metrics_tenant_slo_ledger():
+    sm = EngineStepMetrics()
+    sm.slo_ttft_ms, sm.slo_tpot_ms = 100.0, 50.0
+    sm.on_request_slo("a", ttft_ms=100.0, tpot_ms=50.0, n_tokens=10)
+    sm.on_request_slo("a", ttft_ms=200.0, tpot_ms=10.0, n_tokens=10)
+    sm.on_request_slo("b", ttft_ms=10.0, tpot_ms=None, n_tokens=1)
+    snap = sm.snapshot()["slo"]
+    assert snap["targets"] == {"ttft_ms": 100.0, "tpot_ms": 50.0}
+    a = snap["tenants"]["a"]
+    assert (a["finished"], a["met"], a["goodput_tokens"],
+            a["tokens"]) == (2, 1, 10, 20)
+    assert a["attainment"] == 0.5
+    b = snap["tenants"]["b"]
+    assert b["attainment"] == 1.0  # no TPOT for a 1-token request
+    # the default tenant exists from birth with zero completions -> 0.0
+    assert snap["tenants"]["default"]["attainment"] == 0.0
+
+
+def test_no_targets_means_goodput_equals_throughput():
+    sm = EngineStepMetrics()
+    sm.on_request_slo(None, ttft_ms=9999.0, tpot_ms=9999.0, n_tokens=7)
+    t = sm.snapshot()["slo"]["tenants"]["default"]
+    assert t["met"] == t["finished"] == 1
+    assert t["goodput_tokens"] == t["tokens"] == 7
+
+
+# --------------------------------------------------- duration clocks
+def test_e2e_duration_immune_to_wall_clock_step(monkeypatch):
+    """An NTP step between arrival and finish must not corrupt the E2E
+    latency: durations come from time.monotonic, the wall stamp stays
+    for logs only."""
+    agg = OrchestratorAggregator(num_stages=1)
+    walls = iter([1000.0, 500.0])  # wall clock steps BACKWARD 500s
+    monkeypatch.setattr(time, "time", lambda: next(walls))
+    agg.record_arrival("r")
+    agg.record_finish("r")
+    e2e = agg.summary()["e2e"]
+    assert e2e["num_finished"] == 1
+    # monotonic duration: tiny and non-negative, not -500s or clamped 0
+    assert 0.0 <= e2e["p50_ms"] < 1000.0
+
+
+def test_request_e2e_stats_uses_monotonic_fields():
+    r = RequestE2EStats(request_id="x", arrival_ts=100.0,
+                        finish_ts=50.0,  # wall went backward
+                        arrival_mono=10.0, finish_mono=10.5)
+    assert abs(r.e2e_ms - 500.0) < 1e-9

@@ -1,0 +1,263 @@
+"""Admission-control shedding (429 before engine admission) and the
+per-tenant metric split on /metrics."""
+
+import threading
+
+import pytest
+
+from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.metrics.prometheus import (
+    render_exposition,
+    validate_exposition,
+)
+from vllm_omni_tpu.sampling_params import SamplingParams
+from tests.helpers import tiny_lm_factory
+
+
+def _engine(**cfg):
+    params, model_cfg, _ = tiny_lm_factory()
+    return LLMEngine(params, model_cfg, EngineConfig(
+        num_pages=64, page_size=4, max_model_len=128, **cfg))
+
+
+# ------------------------------------------------------------ shedding
+def test_queue_depth_shed_before_admission():
+    eng = _engine(max_queue_depth=2)
+    for i in range(2):
+        eng.add_request([1, 2, 3], SamplingParams(max_tokens=2),
+                        request_id=f"ok-{i}")
+    assert len(eng.scheduler.waiting) == 2
+    eng.add_request([1, 2, 3], SamplingParams(max_tokens=2),
+                    request_id="over",
+                    additional_information={"tenant": "acme"})
+    # shed BEFORE engine admission: never entered the waiting queue,
+    # no pages allocated, counted per (reason, tenant)
+    assert len(eng.scheduler.waiting) == 2
+    assert all(r.request_id != "over" for r in eng.scheduler.waiting)
+    assert eng.scheduler.shed_counts == {("queue_depth", "acme"): 1}
+    outs = eng.step()
+    shed = next(o for o in outs if o.request_id == "over")
+    assert shed.is_error and shed.error_kind == "shed"
+    # the two admitted requests still finish normally
+    while eng.has_unfinished_requests:
+        outs += eng.step()
+    done = {o.request_id for o in outs if not o.is_error and o.finished}
+    assert done == {"ok-0", "ok-1"}
+
+
+def test_queue_depth_zero_sheds_everything():
+    eng = _engine(max_queue_depth=0)
+    eng.add_request([1, 2], SamplingParams(max_tokens=2),
+                    request_id="r")
+    assert not eng.scheduler.waiting
+    (out,) = eng.step()
+    assert out.error_kind == "shed"
+
+
+def test_deadline_headroom_shed():
+    import time
+
+    eng = _engine(admission_deadline_headroom_s=5.0)
+    eng.add_request([1, 2], SamplingParams(max_tokens=2),
+                    request_id="tight",
+                    deadline_ts=time.monotonic() + 0.5)
+    assert not eng.scheduler.waiting
+    assert eng.scheduler.shed_counts == {
+        ("deadline_headroom", "default"): 1}
+    (out,) = eng.step()
+    assert out.error_kind == "shed"
+    # plenty of headroom: admitted normally
+    eng.add_request([1, 2], SamplingParams(max_tokens=2),
+                    request_id="roomy",
+                    deadline_ts=time.monotonic() + 60.0)
+    assert len(eng.scheduler.waiting) == 1
+
+
+def test_invalid_request_still_wins_over_shed():
+    """A malformed request is the client's fault (400) even when the
+    queue is also full — shed only claims requests that would have
+    been served on an idle server."""
+    eng = _engine(max_queue_depth=0)
+    eng.add_request(list(range(500)), SamplingParams(max_tokens=2),
+                    request_id="toolong")
+    (out,) = eng.step()
+    assert out.error_kind == "invalid_request"
+
+
+# ------------------------------------------------------- tenant split
+def test_two_tenant_metrics_split():
+    eng = _engine(slo_ttft_ms=60_000.0, slo_tpot_ms=60_000.0)
+    for i, tenant in enumerate(["a", "a", "b"]):
+        eng.add_request([1, 2, 3, 4], SamplingParams(max_tokens=3),
+                        request_id=f"t-{i}",
+                        additional_information={"tenant": tenant})
+    while eng.has_unfinished_requests:
+        eng.step()
+    snap = eng.metrics_snapshot()
+    tenants = snap["slo"]["tenants"]
+    assert tenants["a"]["finished"] == 2 and tenants["b"]["finished"] == 1
+    assert tenants["a"]["goodput_tokens"] == 6
+    assert tenants["b"]["goodput_tokens"] == 3
+    assert tenants["a"]["attainment"] == 1.0
+    # queue wait observed once per request
+    assert snap["queue_wait_ms"]["count"] == 3
+    text = render_exposition({}, {0: snap})
+    assert validate_exposition(text) == []
+    assert ('vllm_omni_tpu_slo_attainment_ratio{stage="0",tenant="a"} 1'
+            in text)
+    assert ('vllm_omni_tpu_goodput_tokens_total{stage="0",tenant="b"} 3'
+            in text)
+    assert 'vllm_omni_tpu_request_queue_depth{stage="0",tenant="default"}' \
+        in text
+    assert 'vllm_omni_tpu_queue_wait_ms_count{stage="0"} 3' in text
+    assert 'vllm_omni_tpu_phase_saturation_ratio{stage="0",phase="seats"}' \
+        in text
+
+
+def test_shed_counts_render_with_reason_and_tenant():
+    eng = _engine(max_queue_depth=0)
+    eng.add_request([1], SamplingParams(max_tokens=1),
+                    request_id="x",
+                    additional_information={"tenant": "acme"})
+    eng.step()
+    text = render_exposition({}, {0: eng.metrics_snapshot()})
+    assert validate_exposition(text) == []
+    assert ('vllm_omni_tpu_shed_requests_total{stage="0",'
+            'reason="queue_depth",tenant="acme"} 1' in text)
+
+
+def test_tenant_header_injection_sanitized_and_escaped():
+    """The tenant label is CLIENT input: hostile values must neither
+    corrupt the exposition nor reach ledger keys unsanitized."""
+    from vllm_omni_tpu.metrics.prometheus import _fmt_labels
+    from vllm_omni_tpu.metrics.stats import sanitize_tenant
+
+    assert sanitize_tenant('a",evil="1') == "a__evil__1"
+    assert sanitize_tenant("x\ny") == "x_y"
+    assert sanitize_tenant(None) == "default"
+    assert sanitize_tenant("") == "default"
+    assert len(sanitize_tenant("q" * 200)) == 64
+    # exposition-side escaping holds even for values that slip through
+    assert _fmt_labels({"t": 'a"b\\c\nd'}) == '{t="a\\"b\\\\c\\nd"}'
+    # end to end: a hostile header still renders a VALID exposition
+    eng = _engine()
+    eng.add_request([1, 2], SamplingParams(max_tokens=2),
+                    request_id="evil",
+                    additional_information={"tenant": 'x",bad="1'})
+    while eng.has_unfinished_requests:
+        eng.step()
+    text = render_exposition({}, {0: eng.metrics_snapshot()})
+    assert validate_exposition(text) == []
+    assert 'tenant="x__bad__1"' in text
+
+
+def test_tenant_cardinality_capped():
+    """A client inventing a fresh tenant per request must not grow the
+    ledger (and /metrics series) without bound."""
+    from vllm_omni_tpu.metrics.stats import (
+        MAX_TENANT_SERIES,
+        OVERFLOW_TENANT,
+        EngineStepMetrics,
+    )
+
+    sm = EngineStepMetrics()
+    for i in range(5 * MAX_TENANT_SERIES):
+        sm.on_request_slo(f"tenant_{i}", ttft_ms=1.0, tpot_ms=None,
+                          n_tokens=1)
+    # bounded: the cap plus the overflow bucket (plus "default")
+    assert len(sm.tenants) <= MAX_TENANT_SERIES + 2
+    overflow = sm.tenants[OVERFLOW_TENANT]
+    assert overflow.finished > 0
+
+
+# ----------------------------------------------------------- HTTP face
+def _stage(extra_engine_args=None):
+    from vllm_omni_tpu.config.stage import StageConfig
+
+    args = {"model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128}
+    args.update(extra_engine_args or {})
+    return StageConfig(
+        stage_id=0, stage_type="llm", engine_args=args,
+        engine_input_source=[-1], final_output=True,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0, "max_tokens": 4},
+    )
+
+
+@pytest.fixture(scope="module")
+def shed_server_url():
+    from vllm_omni_tpu.entrypoints.openai.api_server import build_server
+
+    server, state = build_server(
+        model="shed-all", stage_configs=[_stage({"max_queue_depth": 0})],
+        host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    state.shutdown()
+
+
+def test_http_shed_returns_429(shed_server_url):
+    import json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{shed_server_url}/v1/chat/completions",
+        data=json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 2,
+        }).encode(),
+        headers={"Content-Type": "application/json",
+                 "x-omni-tenant": "acme"})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 429
+    body = json.loads(exc.value.read())
+    assert body["error"]["type"] == "overloaded"
+    # the shed is attributed to the header's tenant on /metrics
+    with urllib.request.urlopen(f"{shed_server_url}/metrics",
+                                timeout=60) as r:
+        text = r.read().decode()
+    assert 'reason="queue_depth",tenant="acme"' in text
+
+
+def test_http_shed_streaming_still_gets_429(shed_server_url):
+    """A STREAMING request shed before any output must get a real 429
+    status (the server peeks the first pipeline output before
+    committing to the 200 SSE preamble) — not an error event buried in
+    a 200 stream, which would hide the back-off contract."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{shed_server_url}/v1/chat/completions",
+        data=json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 2, "stream": True,
+        }).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 429
+    assert json.loads(exc.value.read())["error"]["type"] == "overloaded"
+
+
+def test_loadgen_http_driver_classifies_shed(shed_server_url):
+    """run_http records 429s as status 'shed' for both streaming and
+    non-streaming arrivals — the serving curve's shed count is how the
+    harness maps the knee."""
+    from vllm_omni_tpu.loadgen.runner import run_http
+    from vllm_omni_tpu.loadgen.workload import LoadRequest
+
+    wl = [LoadRequest(at_s=0.0, request_id="s0", scenario="chat",
+                      tenant="t", prompt_token_ids=[1, 2],
+                      max_tokens=2, stream=True),
+          LoadRequest(at_s=0.05, request_id="s1", scenario="chat",
+                      tenant="t", prompt_token_ids=[1, 2],
+                      max_tokens=2, stream=False)]
+    records = run_http(shed_server_url, wl)
+    assert sorted(r.status for r in records) == ["shed", "shed"]

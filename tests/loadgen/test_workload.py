@@ -1,0 +1,95 @@
+"""Workload determinism: same seed -> bit-identical arrival schedules,
+scenario picks, and prompt tokens (a serving-curve regression must come
+from the system under test, never from the workload)."""
+
+import pytest
+
+from vllm_omni_tpu.loadgen.workload import (
+    Scenario,
+    build_workload,
+    default_catalog,
+    poisson_arrivals,
+    trace_replay_arrivals,
+)
+
+
+def test_poisson_deterministic_per_seed():
+    a = poisson_arrivals(4.0, 100, seed=7)
+    b = poisson_arrivals(4.0, 100, seed=7)
+    assert a == b
+    assert poisson_arrivals(4.0, 100, seed=8) != a
+
+
+def test_poisson_rate_and_monotonicity():
+    xs = poisson_arrivals(10.0, 2000, seed=0)
+    assert len(xs) == 2000
+    assert all(b > a for a, b in zip(xs, xs[1:]))
+    # mean inter-arrival ~ 1/rate (loose: 2000 samples)
+    mean_gap = xs[-1] / len(xs)
+    assert 0.08 < mean_gap < 0.12
+
+
+def test_poisson_rejects_bad_rate():
+    with pytest.raises(ValueError):
+        poisson_arrivals(0.0, 10)
+
+
+def test_trace_replay_scales_and_validates():
+    assert trace_replay_arrivals([0.0, 1.0, 4.0],
+                                 time_scale=0.5) == [0.0, 0.5, 2.0]
+    with pytest.raises(ValueError):
+        trace_replay_arrivals([1.0, 0.5])  # unsorted
+    with pytest.raises(ValueError):
+        trace_replay_arrivals([-1.0])
+    with pytest.raises(ValueError):
+        trace_replay_arrivals([0.0], time_scale=0.0)
+
+
+def test_build_workload_deterministic():
+    arrivals = poisson_arrivals(5.0, 50, seed=3)
+    a = build_workload(arrivals, seed=11, tenants=("x", "y"))
+    b = build_workload(arrivals, seed=11, tenants=("x", "y"))
+    assert [(r.at_s, r.request_id, r.scenario, r.tenant,
+             r.prompt_token_ids, r.max_tokens, r.stream) for r in a] \
+        == [(r.at_s, r.request_id, r.scenario, r.tenant,
+             r.prompt_token_ids, r.max_tokens, r.stream) for r in b]
+    c = build_workload(arrivals, seed=12, tenants=("x", "y"))
+    assert [r.prompt_token_ids for r in c] != \
+        [r.prompt_token_ids for r in a]
+
+
+def test_workload_covers_catalog_and_tenants():
+    wl = build_workload(poisson_arrivals(5.0, 400, seed=0), seed=0,
+                        tenants=("a", "b"))
+    names = {r.scenario for r in wl}
+    assert names == {s.name for s in default_catalog()}
+    assert {r.tenant for r in wl} == {"a", "b"}
+    # round-robin: even index -> first tenant
+    assert wl[0].tenant == "a" and wl[1].tenant == "b"
+
+
+def test_shared_prefix_is_shared_within_scenario():
+    catalog = [Scenario("mt", weight=1.0, prompt_len=(4, 8),
+                        output_len=(2, 4), shared_prefix_len=32)]
+    wl = build_workload(poisson_arrivals(5.0, 10, seed=0),
+                        catalog=catalog, seed=5)
+    prefixes = {tuple(r.prompt_token_ids[:32]) for r in wl}
+    assert len(prefixes) == 1  # every request opens with the SAME run
+    assert all(len(r.prompt_token_ids) >= 32 + 4 for r in wl)
+
+
+def test_scenario_pinned_tenant_wins():
+    catalog = [Scenario("batch", weight=1.0, prompt_len=(4, 4),
+                        output_len=(2, 2), tenant="batch_tier")]
+    wl = build_workload([0.0, 1.0], catalog=catalog,
+                        tenants=("a", "b"))
+    assert all(r.tenant == "batch_tier" for r in wl)
+
+
+def test_workload_rejects_empty_or_zero_weight_catalog():
+    with pytest.raises(ValueError):
+        build_workload([0.0], catalog=[])
+    with pytest.raises(ValueError):
+        build_workload([0.0], catalog=[
+            Scenario("z", weight=0.0, prompt_len=(1, 1),
+                     output_len=(1, 1))])

@@ -35,6 +35,10 @@ HOT_PATHS: tuple[str, ...] = (
     "vllm_omni_tpu/sample/",
     "vllm_omni_tpu/worker/",
     "vllm_omni_tpu/engine/",
+    # the open-loop load harness: no jax today, but a stray host sync
+    # creeping into a driver would serialize the very concurrency the
+    # harness exists to measure — linted from day one
+    "vllm_omni_tpu/loadgen/",
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (
@@ -63,6 +67,12 @@ BENCH_PATHS: tuple[str, ...] = (
     # before stopping the clock
     "vllm_omni_tpu/engine/llm_engine.py",
     "vllm_omni_tpu/worker/model_runner.py",
+    # the open-loop runner times around async dispatch (arrival ->
+    # first output -> completion across asyncio tasks / HTTP threads);
+    # OL4 watches that any wall-clock pair it grows around a jax
+    # dispatch syncs first — today its durations are client-observed
+    # network/queue round trips, which is the product being measured
+    "vllm_omni_tpu/loadgen/",
 )
 
 METRIC_MODULES: tuple[str, ...] = (

@@ -75,6 +75,23 @@ def synthetic_engine_snapshot() -> dict:
             "parked_tokens": 32, "offload_evictions": 2,
         },
         "kv_restore_seconds": hist,
+        # serving-curve observability (docs/load_testing.md): tenant-
+        # labeled SLO/goodput ledger, queue depth + wait, shed ledger,
+        # per-phase saturation — the drift guard must cover every
+        # series the loadgen harness reads mid-flight
+        "queue_wait_ms": hist,
+        "queue": {"depth_by_tenant": {"default": 1, "acme": 2}},
+        "shed": {"queue_depth/acme": 3, "deadline_headroom/default": 1},
+        "slo": {
+            "targets": {"ttft_ms": 500.0, "tpot_ms": 50.0},
+            "tenants": {
+                "default": {"finished": 4, "met": 3, "tokens": 128,
+                            "goodput_tokens": 96, "attainment": 0.75},
+                "acme": {"finished": 2, "met": 2, "tokens": 64,
+                         "goodput_tokens": 64, "attainment": 1.0},
+            },
+        },
+        "saturation": {"prefill": 0.5, "decode": 0.25, "seats": 0.75},
         "diffusion": {"requests_total": 3, "batches_total": 2,
                       "gen_seconds": hist},
     }

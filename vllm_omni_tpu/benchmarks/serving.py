@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Optional
@@ -76,56 +77,92 @@ class BenchResult:
         return out
 
 
-def _one_chat(base_url: str, prompt: str, max_tokens: int,
-              stream: bool, result: BenchResult, lock: threading.Lock):
-    body = json.dumps({
-        "model": "bench",
-        "messages": [{"role": "user", "content": prompt}],
-        "max_tokens": max_tokens,
-        "stream": stream,
-    }).encode()
+def chat_http_request(base_url: str, body: dict,
+                      headers: Optional[dict] = None,
+                      timeout_s: float = 300.0) -> dict:
+    """The ONE chat-completions HTTP/SSE driver (this bench and the
+    loadgen harness both call it — the stream framing and in-stream
+    error detection must never fork).  Streams when ``body["stream"]``
+    is set, stamping the first SSE data event.  Never raises; returns:
+
+    - ``ok``: completed successfully (a stream that produced NO data
+      event counts as failed — the server dropped it without an error)
+    - ``http_status``: status code when the server refused the request
+      outright (429 shed / 504 deadline / 400 ...), else None
+    - ``error``: the OpenAI error payload, from the error body or the
+      in-stream SSE error event (which carries ``type`` + would-be
+      ``code`` for the 429/503/504 taxonomy), else None
+    - ``first_event_mono`` / ``end_mono``: time.monotonic stamps
+    - ``usage_completion_tokens``: from the non-streaming usage block
+    """
     req = urllib.request.Request(
-        f"{base_url}/v1/chat/completions", data=body,
-        headers={"Content-Type": "application/json"},
+        f"{base_url}/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
-    t0 = time.perf_counter()
-    ttft = None
-    failed = False
+    out = {"ok": False, "http_status": None, "error": None,
+           "first_event_mono": None, "end_mono": 0.0,
+           "usage_completion_tokens": None}
     try:
-        with urllib.request.urlopen(req, timeout=300) as resp:
-            if stream:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            if body.get("stream"):
                 for line in resp:
                     if not line.startswith(b"data:"):
                         continue
                     payload = line[5:].strip()
                     if payload == b"[DONE]":
                         break
-                    # the server surfaces in-stream failures as HTTP 200
-                    # with an error event — count them as errors, not as
-                    # healthy latencies
+                    # in-stream failures arrive as HTTP 200 + an error
+                    # event — surface them, never count them healthy
                     if b'"error"' in payload:
                         try:
-                            if "error" in json.loads(payload):
-                                failed = True
-                                break
+                            obj = json.loads(payload)
                         except json.JSONDecodeError:
-                            pass
-                    if ttft is None:
-                        ttft = (time.perf_counter() - t0) * 1e3
+                            obj = None
+                        if obj and "error" in obj:
+                            out["error"] = obj["error"]
+                            break
+                    if out["first_event_mono"] is None:
+                        out["first_event_mono"] = time.monotonic()
+                out["ok"] = (out["error"] is None
+                             and out["first_event_mono"] is not None)
             else:
-                body_out = json.loads(resp.read() or b"{}")
-                failed = "error" in body_out
-        e2e = (time.perf_counter() - t0) * 1e3
-        with lock:
-            if failed:
-                result.num_errors += 1
-            else:
-                result.e2e_ms.append(e2e)
-                if ttft is not None:
-                    result.ttft_ms.append(ttft)
+                obj = json.loads(resp.read() or b"{}")
+                if "error" in obj:
+                    out["error"] = obj["error"]
+                else:
+                    out["ok"] = True
+                    out["usage_completion_tokens"] = (
+                        obj.get("usage") or {}).get("completion_tokens")
+    except urllib.error.HTTPError as e:
+        out["http_status"] = e.code
+        try:
+            out["error"] = json.loads(e.read() or b"{}").get("error")
+        except Exception:
+            pass
     except Exception:
-        with lock:
+        pass
+    out["end_mono"] = time.monotonic()
+    return out
+
+
+def _one_chat(base_url: str, prompt: str, max_tokens: int,
+              stream: bool, result: BenchResult, lock: threading.Lock):
+    t0 = time.monotonic()
+    res = chat_http_request(base_url, {
+        "model": "bench",
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "stream": stream,
+    })
+    with lock:
+        if not res["ok"]:
             result.num_errors += 1
+        else:
+            result.e2e_ms.append((res["end_mono"] - t0) * 1e3)
+            if res["first_event_mono"] is not None:
+                result.ttft_ms.append(
+                    (res["first_event_mono"] - t0) * 1e3)
 
 
 def _one_blocking(base_url: str, path: str, payload: dict,

@@ -28,6 +28,15 @@ from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
 from vllm_omni_tpu.request import KVTransferState, Request, RequestStatus
 from vllm_omni_tpu.resilience.deadline import DEADLINE_EXCEEDED
 
+#: error_kind of a load-shed rejection (HTTP 429 at the serving layer).
+#: Distinct from the PR 3 taxonomy on purpose: 503 ("retryable") means
+#: infrastructure broke mid-request, 504 ("deadline_exceeded") means the
+#: time budget was spent — 429 means the server is HEALTHY but at
+#: capacity, and backing off (not just resubmitting) is the right
+#: client response.  The open-loop load harness maps the knee of the
+#: serving curve off this status instead of timing out.
+SHED = "shed"
+
 
 @dataclass
 class KVTransferConfig:
@@ -70,6 +79,17 @@ class SchedulerConfig:
     # becomes a transfer whenever the bytes beat the flops
     # (kvcache/policy.py decides per run)
     kv_offload: bool = False
+    # admission control (load shedding, docs/load_testing.md): cap on
+    # the waiting queue — an arrival that would push past it is SHED
+    # (error_kind "shed", HTTP 429) instead of queued into a wait it
+    # can only lose.  None = unbounded (classic behavior); 0 sheds
+    # every new request (drain mode)
+    max_queue_depth: Optional[int] = None
+    # shed arrivals whose remaining deadline budget is below this floor
+    # — a request that cannot plausibly finish in time is refused at
+    # the door (429) rather than admitted to expire mid-queue (504).
+    # 0.0 disables the check
+    admission_deadline_headroom_s: float = 0.0
 
     @property
     def chunking_enabled(self) -> bool:
@@ -154,6 +174,9 @@ class ARScheduler:
         # lifetime counters for step-level metrics (/metrics gauges)
         self.num_preemptions = 0
         self.num_rejections = 0
+        # load-shed counters, keyed (reason, tenant) — rendered as
+        # shed_requests_total{reason, tenant} on /metrics
+        self.shed_counts: dict[tuple[str, str], int] = {}
         # set once any admitted request carries a deadline, so the
         # per-step expiry sweep stays free for deadline-less serving
         self._deadlines_possible = False
@@ -186,12 +209,61 @@ class ARScheduler:
             self.reject(request, "deadline exceeded before admission",
                         kind=DEADLINE_EXCEEDED)
             return
+        # admission control AFTER the validity + expiry checks: a
+        # malformed request is a 400 and a spent budget a 504 even when
+        # the server is also overloaded — shed (429) only claims
+        # requests that WOULD have been served on an idle server.  The
+        # shed path returns before the request ever enters the waiting
+        # queue: no pages, no scheduling work, no engine admission.
+        if (self.config.max_queue_depth is not None
+                and len(self.waiting) >= self.config.max_queue_depth):
+            self.shed(request, "queue_depth",
+                      f"waiting queue at capacity "
+                      f"({self.config.max_queue_depth}); retry with "
+                      "backoff")
+            return
+        if (self.config.admission_deadline_headroom_s > 0.0
+                and request.deadline_ts is not None
+                and request.deadline_ts - time.monotonic()
+                < self.config.admission_deadline_headroom_s):
+            self.shed(request, "deadline_headroom",
+                      "remaining deadline below the admission floor "
+                      f"({self.config.admission_deadline_headroom_s}s); "
+                      "request would expire mid-queue")
+            return
         if request.deadline_ts is not None:
             self._deadlines_possible = True
         request.status = RequestStatus.WAITING
         if self.config.kv_transfer is not None:
             request.kv_transfer = KVTransferState.PENDING
         self.waiting.append(request)
+
+    def shed(self, request: Request, reason: str, message: str) -> None:
+        """Load-shed an arrival (admission control): count it per
+        (reason, tenant) and error-finish it with the distinct ``shed``
+        kind (HTTP 429) — the request never enters the waiting queue.
+        Tenant values past the cardinality cap collapse into "other"
+        (a client inventing tenants must not grow the ledger forever)."""
+        from vllm_omni_tpu.metrics.stats import cap_tenant
+
+        tenant = cap_tenant(request.tenant,
+                            {t for _, t in self.shed_counts})
+        key = (reason, tenant)
+        self.shed_counts[key] = self.shed_counts.get(key, 0) + 1
+        self.reject(request, message, kind=SHED)
+
+    def queue_depth_by_tenant(self) -> dict[str, int]:
+        """Waiting-queue depth split per tenant (request_queue_depth
+        gauge).  Always contains "default" so the series exists from
+        the first scrape, idle or not; tenants past the cardinality
+        cap report under "other"."""
+        from vllm_omni_tpu.metrics.stats import cap_tenant
+
+        depths: dict[str, int] = {"default": 0}
+        for req in self.waiting:
+            t = cap_tenant(req.tenant, depths)
+            depths[t] = depths.get(t, 0) + 1
+        return depths
 
     def reject(self, request: Request, reason: str,
                kind: str = "invalid_request") -> None:

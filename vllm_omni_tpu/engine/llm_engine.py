@@ -130,6 +130,22 @@ class EngineConfig:
     dtype: Any = jnp.bfloat16
     kv_transfer: Optional[KVTransferConfig] = None
     collect_hidden: bool = False
+    # serving SLO targets (docs/load_testing.md): per-request TTFT and
+    # TPOT upper bounds the engine accounts every finished request
+    # against — slo_attainment_ratio / goodput_tokens_total on
+    # /metrics, split per tenant.  None = that leg always passes
+    # (goodput degenerates to throughput), so unconfigured serving
+    # keeps its old behavior
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+    # admission control (load shedding): waiting-queue cap — arrivals
+    # past it are refused with error_kind "shed" (HTTP 429,
+    # shed_requests_total{reason="queue_depth"}) before any engine
+    # admission work.  None = unbounded
+    max_queue_depth: Optional[int] = None
+    # shed arrivals whose remaining deadline is below this floor
+    # (reason="deadline_headroom"); 0.0 disables
+    admission_deadline_headroom_s: float = 0.0
     seed: Optional[int] = None  # pins sampling entropy for reproducibility
     # tensor parallelism over the first N devices (reference:
     # tensor_parallel_size, stage_configs/qwen3_omni_moe.yaml:27)
@@ -213,6 +229,9 @@ class LLMEngine:
             kv_transfer=config.kv_transfer,
             unified_batching=config.unified_batching,
             kv_offload=self.kv_tiers is not None,
+            max_queue_depth=config.max_queue_depth,
+            admission_deadline_headroom_s=(
+                config.admission_deadline_headroom_s),
             # async pipelining and multi-step windows are alternative
             # round-trip amortizations; windowed decodes would force the
             # pipeline into permanent sync fallback, so async wins
@@ -289,6 +308,10 @@ class LLMEngine:
         # so spans and /metrics series carry the pipeline position.
         self.stage_id = 0
         self.step_metrics = EngineStepMetrics()
+        # SLO accounting targets: every finished request is judged
+        # against them per tenant (slo_attainment_ratio, goodput)
+        self.step_metrics.slo_ttft_ms = config.slo_ttft_ms
+        self.step_metrics.slo_tpot_ms = config.slo_tpot_ms
         # async pipeline drain granularity: how many steps fell back to
         # the synchronous path, PER REASON ("prefill", "spec",
         # "logprobs", "kv_transfer", ...) — under unified batching the
@@ -355,6 +378,7 @@ class LLMEngine:
             sampling_params=sampling_params or SamplingParams(),
             eos_token_id=self.eos_token_id,
             arrival_time=time.time(),
+            arrival_mono=time.monotonic(),
             **kwargs,
         )
         injected_len = 0
@@ -559,6 +583,46 @@ class LLMEngine:
         self.step_metrics.on_padding(useful - useful_before,
                                      padded - padded_before)
 
+    def _note_first_scheduled(self, scheduled) -> None:
+        """First-time-scheduled bookkeeping shared by the synchronous
+        and pipelined paths: the queue_wait trace span and the
+        queue_wait_ms histogram (arrival -> first scheduled, monotonic
+        duration — the queueing term the serving curve bends on)."""
+        rec = get_recorder()
+        now_w = time.time()
+        now_m = time.monotonic()
+        for s in scheduled:
+            req = s.request
+            if req.request_id in self._trace_started:
+                continue
+            self._trace_started.add(req.request_id)
+            wait_s = (max(now_m - req.arrival_mono, 0.0)
+                      if req.arrival_mono else 0.0)
+            self.step_metrics.queue_wait_ms.observe(wait_s * 1e3)
+            ctx = req.additional_information.get("trace")
+            if ctx and req.arrival_time:
+                # span START stays wall-clock (trace timelines align on
+                # wall timestamps); the DURATION is monotonic
+                rec.record(ctx, "queue_wait", req.arrival_time,
+                           wait_s if req.arrival_mono
+                           else now_w - req.arrival_time,
+                           stage_id=self.stage_id, cat="queue")
+
+    def _observe_saturation(self, sched_out: SchedulerOutput) -> None:
+        """Per-phase saturation gauges from this schedule: prefill and
+        decode token-budget fractions + running-seat fraction — the
+        axis that pins first is where the serving curve knees."""
+        budget = max(self.config.max_num_batched_tokens, 1)
+        prefill_toks = sum(s.num_new_tokens for s in sched_out.prefills)
+        decode_toks = sum(max(s.num_new_tokens, s.window)
+                          for s in sched_out.decodes)
+        self.step_metrics.on_saturation(
+            prefill=prefill_toks / budget,
+            decode=decode_toks / budget,
+            seats=(len(self.scheduler.running)
+                   / max(self.config.max_num_seqs, 1)),
+        )
+
     def metrics_snapshot(self) -> dict:
         """Step-level engine metrics for /metrics (Prometheus + JSON):
         latency histograms, scheduler depth + preemption/rejection
@@ -571,6 +635,16 @@ class LLMEngine:
             "running": len(self.scheduler.running),
             "preemptions": getattr(self.scheduler, "num_preemptions", 0),
             "rejections": getattr(self.scheduler, "num_rejections", 0),
+        }
+        # serving-curve observability: per-tenant queue depth + the
+        # admission-control shed ledger (docs/load_testing.md)
+        snap["queue"] = {
+            "depth_by_tenant": self.scheduler.queue_depth_by_tenant(),
+        }
+        snap["shed"] = {
+            f"{reason}/{tenant}": n
+            for (reason, tenant), n in sorted(
+                self.scheduler.shed_counts.items())
         }
         snap["kv"] = {
             "pages_total": kv.num_pages,
@@ -767,6 +841,8 @@ class LLMEngine:
         rec = get_recorder()
         prev = self._inflight
         scheduled = sched_out.prefills + sched_out.decodes
+        self._note_first_scheduled(scheduled)
+        self._observe_saturation(sched_out)
         t_d0, w_d0 = time.perf_counter(), time.time()
         u0, p0 = self._padding_totals()
         if sched_out.prefills:
@@ -1017,18 +1093,8 @@ class LLMEngine:
         self._starved_ticks = 0
         rec = get_recorder()
         scheduled = sched_out.prefills + sched_out.decodes
-        now_w = time.time()
-        for s in scheduled:
-            # queue-wait span: arrival to FIRST time scheduled
-            req = s.request
-            if req.request_id in self._trace_started:
-                continue
-            self._trace_started.add(req.request_id)
-            ctx = req.additional_information.get("trace")
-            if ctx and req.arrival_time:
-                rec.record(ctx, "queue_wait", req.arrival_time,
-                           now_w - req.arrival_time,
-                           stage_id=self.stage_id, cat="queue")
+        self._note_first_scheduled(scheduled)
+        self._observe_saturation(sched_out)
         t_ex0, w_ex0 = time.perf_counter(), time.time()
         u0, p0 = self._padding_totals()
         run_out = self.runner.execute(
@@ -1100,21 +1166,27 @@ class LLMEngine:
     def _observe_token_latencies(self, scheduled, finished) -> int:
         """TTFT / ITL / TPOT bookkeeping from the host-visible token
         deltas (shared by the sync step and the async lagged retire);
-        returns the number of new tokens observed."""
-        now = time.time()
+        returns the number of new tokens observed.  All durations are
+        monotonic-to-monotonic (``Request.arrival_mono``) — a wall
+        clock stepped by NTP mid-request must never corrupt the
+        latency histograms or the SLO verdicts built on them."""
+        now = time.monotonic()
         sm = self.step_metrics
         new_total = 0
         for s in scheduled:
             req = s.request
             n_out = len(req.output_token_ids)
-            st = self._req_lat.setdefault(req.request_id, [0.0, 0.0, 0])
+            # [first_token_mono, last_token_mono, tokens_seen, ttft_ms]
+            st = self._req_lat.setdefault(req.request_id,
+                                          [0.0, 0.0, 0, None])
             if n_out <= st[2]:
                 continue
             new = n_out - st[2]
             new_total += new
             if st[2] == 0:
-                if req.arrival_time:
-                    sm.ttft_ms.observe((now - req.arrival_time) * 1e3)
+                if req.arrival_mono:
+                    st[3] = (now - req.arrival_mono) * 1e3
+                    sm.ttft_ms.observe(st[3])
                 st[0] = now
                 new -= 1  # the first token is TTFT, not an ITL
             if new > 0 and st[1]:
@@ -1127,8 +1199,18 @@ class LLMEngine:
             st = self._req_lat.pop(req.request_id, None)
             self._trace_started.discard(req.request_id)
             n_out = len(req.output_token_ids)
+            tpot = None
             if st and st[0] and n_out > 1:
-                sm.tpot_ms.observe((now - st[0]) * 1e3 / (n_out - 1))
+                tpot = (now - st[0]) * 1e3 / (n_out - 1)
+                sm.tpot_ms.observe(tpot)
+            # SLO verdict per finished request (per-tenant attainment +
+            # goodput): TTFT unknown (e.g. a request that finished on
+            # its first observed token batch before a TTFT stamp
+            # existed) judges as inf against a configured target
+            if st is not None:
+                ttft = st[3] if st[3] is not None else (
+                    float("inf") if sm.slo_ttft_ms is not None else 0.0)
+                sm.on_request_slo(req.tenant, ttft, tpot, n_out)
         return new_total
 
     # ---------------------------------------------------------- generate()

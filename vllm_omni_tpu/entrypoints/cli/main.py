@@ -70,6 +70,20 @@ def _add_common(p: argparse.ArgumentParser):
                      help="remote-tier transport: a connector name "
                           "(inproc|shm|tcp) wired with retry + circuit "
                           "breaker on the edge")
+    eng.add_argument("--slo-ttft-ms", type=float, default=None,
+                     help="per-request TTFT SLO target: finished "
+                          "requests are judged against it per tenant "
+                          "(slo_attainment_ratio / goodput_tokens_total "
+                          "on /metrics; see docs/load_testing.md)")
+    eng.add_argument("--slo-tpot-ms", type=float, default=None,
+                     help="per-request TPOT (time per output token) "
+                          "SLO target")
+    eng.add_argument("--max-queue-depth", type=int, default=None,
+                     help="admission control: arrivals past this "
+                          "waiting-queue depth are shed with HTTP 429 "
+                          "(shed_requests_total{reason=queue_depth}) "
+                          "instead of queued into a wait they can only "
+                          "lose")
     eng.add_argument("--deterministic-decode", action="store_true",
                      default=None,
                      help="pin decode batches to the top bucket so a "
@@ -99,6 +113,7 @@ _ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
                 "async_scheduling", "unified_batching",
                 "kv_offload", "kv_offload_quant", "kv_offload_policy",
                 "kv_host_tier_bytes", "kv_offload_connector",
+                "slo_ttft_ms", "slo_tpot_ms", "max_queue_depth",
                 "deterministic_decode")
 
 

@@ -208,6 +208,10 @@ def _sampling_from_body(body: dict) -> dict:
     for k in ("temperature", "top_p", "top_k", "seed"):
         if body.get(k) is not None:
             sp[k] = body[k]
+    # extension (vLLM ships the same one): benchmark clients pin the
+    # output length so token accounting is exact
+    if body.get("ignore_eos") is not None:
+        sp["ignore_eos"] = bool(body["ignore_eos"])
     # OpenAI logprobs: chat sends a boolean + optional top_logprobs
     # count; legacy /v1/completions sends an integer count directly
     lp = body.get("logprobs")
@@ -257,6 +261,20 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
     def _error(self, code: int, message: str, etype: str = "invalid_request_error"):
         self._json(code, {"error": {"message": message, "type": etype}})
 
+    # error kind -> (HTTP status, OpenAI error type).  The taxonomy
+    # (docs/serving.md): 400 = the client's fault; 429 "shed" =
+    # admission control refused a HEALTHY server at capacity (back off,
+    # then retry — the load harness maps the knee of the serving curve
+    # off this status); 503 "retryable" = infra broke before any output
+    # (idempotent resubmit ok); 504 = the time budget was spent
+    # (response abandoned); anything else is a 500.
+    _ERROR_KIND_HTTP = {
+        "invalid_request": (400, "invalid_request_error"),
+        "shed": (429, "overloaded"),
+        "deadline_exceeded": (504, "deadline_exceeded"),
+        "retryable": (503, "retryable_error"),
+    }
+
     def _surface_error(self, outs) -> bool:
         """If any pipeline output is errored, reply with an OpenAI-style
         error (instead of HTTP 200 with an empty/garbage payload) and
@@ -265,19 +283,19 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         if err is None:
             return False
         msg = err.error_message or "request failed"
-        if err.error_kind == "invalid_request":
-            self._error(400, msg)
-        elif err.error_kind == "deadline_exceeded":
-            # distinct terminal status for a spent time budget — clients
-            # treat 504 as "response abandoned", not "request invalid"
-            self._error(504, msg, "deadline_exceeded")
-        elif err.error_kind == "retryable":
-            # e.g. the stage worker died mid-execution: no partial
-            # output was produced, an idempotent client may resubmit
-            self._error(503, msg, "retryable_error")
-        else:
-            self._error(500, msg, "internal_error")
+        code, etype = self._ERROR_KIND_HTTP.get(
+            err.error_kind, (500, "internal_error"))
+        self._error(code, msg, etype)
         return True
+
+    def _tenant_info(self) -> dict:
+        """Per-tenant metrics attribution: the ``x-omni-tenant`` header
+        rides request metadata (additional_information["tenant"]) into
+        the engine, labeling the SLO/goodput/queue-depth series on
+        /metrics so fleet dashboards can split the serving curve per
+        tenant (docs/load_testing.md)."""
+        tenant = self.headers.get("x-omni-tenant")
+        return {"tenant": tenant} if tenant else {}
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -426,8 +444,15 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             # wave.Error, non-string url -> AttributeError, bad base64,
             # ...) is the client's fault, never a 500
             return self._error(400, f"bad multimodal content: {e}")
-        prompt = ({"prompt": prompt_text, "multi_modal_data": mm}
-                  if mm else prompt_text)
+        info = self._tenant_info()
+        if mm or info:
+            prompt: Any = {"prompt": prompt_text}
+            if mm:
+                prompt["multi_modal_data"] = mm
+            if info:
+                prompt["additional_information"] = info
+        else:
+            prompt = prompt_text
         try:
             sp = _sampling_from_body(body)
         except ValueError as e:
@@ -444,14 +469,37 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             if n > 1:
                 return self._error(400, "streaming with n > 1 is not "
                                    "supported")
+            stream_iter = self.state.stream(prompt, sp, rid)
+            # peek the FIRST item before committing to SSE: an error
+            # before any output (shed at admission, expired deadline,
+            # invalid prompt) still gets its REAL HTTP status — a 429
+            # buried inside a 200 SSE stream would hide the back-off
+            # contract from every streaming client
+            first = next(stream_iter, None)
+            if isinstance(first, Exception):
+                return self._error(500, str(first), "internal_error")
+            if first is not None and first.is_error:
+                self._surface_error([first])
+                return
             self._sse_start()
-            for out in self.state.stream(prompt, sp, rid):
+            if first is not None:
+                for chunk in self._chat_chunks(first, rid, created):
+                    self._sse_send(chunk)
+            for out in stream_iter:
                 if isinstance(out, Exception):
-                    self._sse_send({"error": {"message": str(out)}})
+                    self._sse_send({"error": {"message": str(out),
+                                              "type": "internal_error",
+                                              "code": 500}})
                     break
                 if out.is_error:
+                    # mid-stream failure: the status line is long gone,
+                    # so the SSE error event carries the taxonomy
+                    # (type + would-be HTTP code) for clients to act on
+                    code, etype = self._ERROR_KIND_HTTP.get(
+                        out.error_kind, (500, "internal_error"))
                     self._sse_send({"error": {
-                        "message": out.error_message or "request failed"}})
+                        "message": out.error_message or "request failed",
+                        "type": etype, "code": code}})
                     break
                 for chunk in self._chat_chunks(out, rid, created):
                     self._sse_send(chunk)
@@ -615,7 +663,20 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._error(400, str(e))
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
-        jobs = [(p, sp, f"{rid}-{i}") for i, p in enumerate(prompts)]
+        info = self._tenant_info()
+
+        def _wrap(p):
+            # tenant attribution rides the dict prompt form; a fresh
+            # info dict per job (mutable metadata must not be shared)
+            if not info:
+                return p
+            if isinstance(p, str):
+                return {"prompt": p, "additional_information": dict(info)}
+            return {"prompt_token_ids": list(p),
+                    "additional_information": dict(info)}
+
+        jobs = [(_wrap(p), sp, f"{rid}-{i}")
+                for i, p in enumerate(prompts)]
         all_outs = self.state.collect_many(jobs)
         choices = []
         for i, outs in enumerate(all_outs):

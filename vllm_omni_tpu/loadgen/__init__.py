@@ -1,0 +1,28 @@
+"""Open-loop traffic harness (docs/load_testing.md).
+
+``workload.py`` builds deterministic arrival schedules (seeded Poisson
+or trace replay) over a mixed scenario catalog; ``runner.py`` drives
+them open-loop — arrivals NEVER gate on completions — against the
+OpenAI HTTP server, an in-process ``AsyncOmni``, or a virtual-time
+queue simulator, and folds the per-request records into ``serving_curve``
+points (attained throughput, goodput, SLO attainment, latency
+percentiles, shed/expired counts) per offered-load rate.
+"""
+
+from vllm_omni_tpu.loadgen.workload import (  # noqa: F401
+    LoadRequest,
+    Scenario,
+    build_workload,
+    default_catalog,
+    poisson_arrivals,
+    trace_replay_arrivals,
+)
+from vllm_omni_tpu.loadgen.runner import (  # noqa: F401
+    RequestRecord,
+    SLOTargets,
+    run_http,
+    run_inproc,
+    simulate,
+    summarize,
+    validate_curve_point,
+)

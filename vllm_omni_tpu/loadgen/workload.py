@@ -1,0 +1,166 @@
+"""Arrival processes + scenario catalog for the open-loop harness.
+
+Everything here is DETERMINISTIC given a seed: the same (seed, rate,
+catalog) produces bit-identical arrival schedules, scenario picks,
+prompt tokens, and output lengths — a serving-curve regression between
+two builds can only come from the system under test, never from the
+workload.  Nothing in this module touches jax or the network.
+
+Arrival processes (the open-loop stance: offered load is a property of
+the CLIENT population, so inter-arrival gaps are drawn up front and
+never stretched by slow completions — the closed-loop alternative
+flatters an overloaded server by self-throttling):
+
+- ``poisson_arrivals``: exponential inter-arrival gaps at a target
+  rate, the standard model for a large independent user population.
+- ``trace_replay_arrivals``: replay explicit offsets (production logs,
+  adversarial bursts), optionally time-scaled to sweep rates.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One traffic class in the mix.
+
+    ``shared_prefix_len`` > 0 models multi-turn / system-prompt reuse:
+    every request of the scenario starts with the SAME token run (drawn
+    once per scenario from the workload seed), so prefix caching and
+    the radix index see realistic overlap.  Length bounds are inclusive
+    uniform draws per request.
+    """
+
+    name: str
+    weight: float
+    prompt_len: tuple[int, int]
+    output_len: tuple[int, int]
+    shared_prefix_len: int = 0
+    stream: bool = False
+    tenant: Optional[str] = None  # None -> the workload-level default
+
+
+def default_catalog() -> list[Scenario]:
+    """The mixed serving catalog the ROADMAP asks the curve to cover:
+    chat, long-context, multi-turn shared-prefix, and streaming."""
+    return [
+        Scenario("chat", weight=0.5,
+                 prompt_len=(32, 128), output_len=(16, 64)),
+        Scenario("long_context", weight=0.2,
+                 prompt_len=(512, 1024), output_len=(16, 32)),
+        Scenario("multi_turn", weight=0.2,
+                 prompt_len=(16, 64), output_len=(16, 32),
+                 shared_prefix_len=256),
+        Scenario("streaming", weight=0.1,
+                 prompt_len=(32, 64), output_len=(32, 64), stream=True),
+    ]
+
+
+@dataclass
+class LoadRequest:
+    """One generated arrival: fire at ``at_s`` (offset from the run's
+    t0), submit ``prompt_token_ids`` (or ``prompt`` text for HTTP
+    drivers), collect up to ``max_tokens``."""
+
+    at_s: float
+    request_id: str
+    scenario: str
+    tenant: str
+    prompt_token_ids: list[int] = field(default_factory=list)
+    max_tokens: int = 16
+    stream: bool = False
+
+    @property
+    def prompt(self) -> str:
+        """Text form for HTTP drivers (the byte-tokenizer server path
+        re-encodes it; exact token identity doesn't matter over HTTP,
+        deterministic length does)."""
+        return " ".join(f"tok{t}" for t in self.prompt_token_ids[:64])
+
+
+def poisson_arrivals(rate_rps: float, num_requests: int,
+                     seed: int = 0) -> list[float]:
+    """``num_requests`` arrival offsets with exponential inter-arrival
+    gaps at ``rate_rps`` (a Poisson process).  Seeded: same inputs,
+    same schedule."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    rng = random.Random(seed)
+    t = 0.0
+    out = []
+    for _ in range(max(int(num_requests), 0)):
+        t += rng.expovariate(rate_rps)
+        out.append(t)
+    return out
+
+
+def trace_replay_arrivals(offsets: Sequence[float],
+                          time_scale: float = 1.0) -> list[float]:
+    """Replay explicit arrival offsets (seconds from t0), optionally
+    compressed/stretched by ``time_scale`` (< 1 replays faster,
+    sweeping offered load without editing the trace).  Offsets must be
+    non-negative and sorted — a shuffled trace is almost always a
+    units bug in the caller, not a workload."""
+    if time_scale <= 0:
+        raise ValueError(f"time_scale must be > 0, got {time_scale}")
+    out = []
+    prev = 0.0
+    for i, off in enumerate(offsets):
+        off = float(off)
+        if off < 0 or off < prev:
+            raise ValueError(
+                f"trace offsets must be sorted and non-negative "
+                f"(offset {i} = {off}, previous {prev})")
+        prev = off
+        out.append(off * time_scale)
+    return out
+
+
+def build_workload(
+    arrivals: Sequence[float],
+    catalog: Optional[Sequence[Scenario]] = None,
+    seed: int = 0,
+    vocab_size: int = 32000,
+    tenants: Sequence[str] = ("default",),
+    id_prefix: str = "load",
+) -> list[LoadRequest]:
+    """Bind one scenario + concrete prompt/output draws to every
+    arrival offset.  ``tenants`` round-robins across requests unless a
+    scenario pins its own tenant.  Deterministic per (arrivals order,
+    catalog, seed, vocab_size, tenants)."""
+    catalog = list(catalog if catalog is not None else default_catalog())
+    if not catalog:
+        raise ValueError("catalog must not be empty")
+    rng = random.Random(seed)
+    weights = [max(s.weight, 0.0) for s in catalog]
+    if sum(weights) <= 0:
+        raise ValueError("catalog weights must sum > 0")
+    # shared prefixes drawn ONCE per scenario, before the per-request
+    # stream, so adding requests never reshuffles them
+    prefixes = {
+        s.name: [rng.randrange(1, vocab_size)
+                 for _ in range(s.shared_prefix_len)]
+        for s in catalog if s.shared_prefix_len > 0
+    }
+    out: list[LoadRequest] = []
+    for i, at_s in enumerate(arrivals):
+        sc = rng.choices(catalog, weights=weights, k=1)[0]
+        n_prompt = rng.randint(*sc.prompt_len)
+        n_out = rng.randint(*sc.output_len)
+        toks = list(prefixes.get(sc.name, ()))
+        toks += [rng.randrange(1, vocab_size) for _ in range(n_prompt)]
+        tenant = sc.tenant or tenants[i % len(tenants)]
+        out.append(LoadRequest(
+            at_s=float(at_s),
+            request_id=f"{id_prefix}-{i}",
+            scenario=sc.name,
+            tenant=tenant,
+            prompt_token_ids=toks,
+            max_tokens=n_out,
+            stream=sc.stream,
+        ))
+    return out

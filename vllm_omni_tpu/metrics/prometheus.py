@@ -160,6 +160,42 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "counter",
         "Async pipeline steps that fell back to the synchronous path",
         ("stage", "reason")),
+    # ---- serving-curve observability (docs/load_testing.md): SLO
+    # attainment + goodput per tenant, admission-control shedding,
+    # queueing, and per-phase saturation — the engine-side face of the
+    # open-loop load harness (vllm_omni_tpu/loadgen/)
+    "slo_attainment_ratio": (
+        "gauge",
+        "Finished requests meeting every configured SLO target "
+        "(TTFT/TPOT) over all finished, per tenant", ("stage", "tenant")),
+    "slo_requests_total": (
+        "counter", "Finished requests judged against the SLO targets",
+        ("stage", "tenant")),
+    # lifetime counter pair for slo_attainment_ratio: rate() over any
+    # window recovers a WINDOWED attainment the cumulative gauge hides
+    "slo_requests_met_total": (
+        "counter", "Finished requests inside every SLO target",
+        ("stage", "tenant")),
+    "goodput_tokens_total": (
+        "counter",
+        "Output tokens from requests that met their SLO targets "
+        "(tokens_generated_total counts all — the gap is wasted work)",
+        ("stage", "tenant")),
+    "shed_requests_total": (
+        "counter",
+        "Arrivals refused by admission control (HTTP 429), per reason "
+        "— distinct from 503 retryable / 504 deadline_exceeded",
+        ("stage", "reason", "tenant")),
+    "request_queue_depth": (
+        "gauge", "Waiting-queue depth per tenant", ("stage", "tenant")),
+    "queue_wait_ms": (
+        "histogram", "Arrival to first scheduled, per request",
+        ("stage",)),
+    "phase_saturation_ratio": (
+        "gauge",
+        "Fraction of the capacity ceiling used per phase (prefill/"
+        "decode token budget, running seats) at the last schedule",
+        ("stage", "phase")),
     "diffusion_requests_total": (
         "counter", "Diffusion requests generated", ("stage",)),
     "diffusion_batches_total": (
@@ -213,11 +249,20 @@ def _fmt_value(v) -> str:
     return repr(f)
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label escaping (backslash, quote,
+    newline).  Label values can carry CLIENT input (the tenant label
+    comes from the x-omni-tenant header), so unescaped rendering would
+    let one request corrupt the whole exposition."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: dict) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{k}="{str(v)}"' for k, v in labels.items()
+        f'{k}="{_escape_label_value(v)}"' for k, v in labels.items()
     )
     return "{" + inner + "}"
 
@@ -382,6 +427,35 @@ def render_exposition(summary: dict, engine_snaps: dict,
                 (snap.get("async_fallback") or {}).items()):
             exp.sample("async_fallback_total",
                        {**labels, "reason": reason}, count)
+        # serving-curve observability: queue depth + shed ledger + SLO
+        # attainment/goodput per tenant + queue-wait + saturation
+        queue = snap.get("queue")
+        if queue:
+            for tenant, depth in sorted(
+                    (queue.get("depth_by_tenant") or {}).items()):
+                exp.sample("request_queue_depth",
+                           {**labels, "tenant": tenant}, depth)
+        for key, n in sorted((snap.get("shed") or {}).items()):
+            reason, _, tenant = str(key).partition("/")
+            exp.sample("shed_requests_total",
+                       {**labels, "reason": reason,
+                        "tenant": tenant or "default"}, n)
+        slo = snap.get("slo")
+        if slo:
+            for tenant, st in sorted((slo.get("tenants") or {}).items()):
+                tl = {**labels, "tenant": tenant}
+                exp.sample("slo_attainment_ratio", tl,
+                           st.get("attainment", 0.0))
+                exp.sample("slo_requests_total", tl,
+                           st.get("finished", 0))
+                exp.sample("slo_requests_met_total", tl, st.get("met", 0))
+                exp.sample("goodput_tokens_total", tl,
+                           st.get("goodput_tokens", 0))
+        if snap.get("queue_wait_ms"):
+            exp.histogram("queue_wait_ms", labels, snap["queue_wait_ms"])
+        for phase, v in sorted((snap.get("saturation") or {}).items()):
+            exp.sample("phase_saturation_ratio",
+                       {**labels, "phase": phase}, v)
         diff = snap.get("diffusion")
         if diff:
             exp.sample("diffusion_requests_total", labels,

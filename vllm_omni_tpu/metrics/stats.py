@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import re
 import threading
 import time
 from collections import deque
@@ -56,12 +57,19 @@ class TransferEdgeStats:
 @dataclass
 class RequestE2EStats:
     request_id: str
+    # wall-clock arrival, kept for LOGS only (jsonl records, dashboards
+    # correlating against external timestamps) — never for durations
     arrival_ts: float
     finish_ts: float = 0.0
+    # duration clock: monotonic stamps.  An NTP step mid-request would
+    # corrupt a wall-clock difference (negative or wildly inflated
+    # latencies poisoning the histograms); time.monotonic() is immune.
+    arrival_mono: float = 0.0
+    finish_mono: float = 0.0
 
     @property
     def e2e_ms(self) -> float:
-        return max(0.0, (self.finish_ts - self.arrival_ts) * 1e3)
+        return max(0.0, (self.finish_mono - self.arrival_mono) * 1e3)
 
 
 # Prometheus-style latency buckets (ms).  Wide on purpose: one set serves
@@ -144,6 +152,61 @@ class Histogram:
         }
 
 
+# label value for requests that carry no tenant metadata (the OpenAI
+# server stamps ``x-omni-tenant`` into additional_information["tenant"])
+DEFAULT_TENANT = "default"
+# tenant values past the cardinality cap collapse into this bucket —
+# the tenant label is CLIENT input, and a client inventing a fresh
+# tenant per request must not grow engine memory or /metrics series
+# without bound
+OVERFLOW_TENANT = "other"
+MAX_TENANT_SERIES = 32
+
+_TENANT_BAD_CHARS = re.compile(r"[^A-Za-z0-9_.:\-]")
+
+
+def sanitize_tenant(raw) -> str:
+    """Client tenant -> safe, bounded label value: charset restricted
+    to [A-Za-z0-9_.:-] (anything else becomes "_"), capped at 64
+    chars, empty/missing -> DEFAULT_TENANT.  Exposition-side escaping
+    exists too; sanitizing at the source keeps ledger keys, JSON
+    snapshots, and log lines clean as well."""
+    if not raw:
+        return DEFAULT_TENANT
+    s = _TENANT_BAD_CHARS.sub("_", str(raw))[:64]
+    return s or DEFAULT_TENANT
+
+
+def cap_tenant(tenant: str, known: "set[str] | dict") -> str:
+    """Collapse a NEW tenant into OVERFLOW_TENANT once ``known``
+    already tracks MAX_TENANT_SERIES distinct tenants."""
+    if tenant in known or len(known) < MAX_TENANT_SERIES:
+        return tenant
+    return OVERFLOW_TENANT
+
+
+@dataclass
+class TenantSLOStats:
+    """Per-tenant SLO attainment + goodput accounting over finished
+    requests.  "Met" means every CONFIGURED target held: TTFT <= target
+    and TPOT <= target (a missing target always passes; a <=1-token
+    request has no TPOT and passes that leg).  Exactly-at-target counts
+    as met — the SLO is an upper bound, not a strict one."""
+
+    finished: int = 0        # successfully finished requests
+    met: int = 0             # finished requests inside every SLO target
+    tokens: int = 0          # output tokens over all finished requests
+    goodput_tokens: int = 0  # output tokens of SLO-met requests only
+
+    @property
+    def attainment(self) -> float:
+        """met / finished; 0.0 with zero completions (an idle tenant
+        reports no attainment rather than a fake-perfect 1.0)."""
+        if self.finished <= 0:
+            return 0.0
+        return self.met / self.finished
+
+
 class EngineStepMetrics:
     """Step-level engine gauges/counters/histograms, sampled from
     ``LLMEngine.step()`` (the vLLM-core Stats/StatLogger analogue):
@@ -172,6 +235,20 @@ class EngineStepMetrics:
         # per-request KV tier restore latency (fetch + inject), seconds
         # — the cold path must earn its transfers (docs/kv_cache.md)
         self.kv_restore_s = Histogram(buckets=KV_RESTORE_BUCKETS_S)
+        # arrival -> FIRST time scheduled, per request (the queueing
+        # component the serving curve bends on)
+        self.queue_wait_ms = Histogram()
+        # SLO targets (None = unconfigured leg always passes) + the
+        # per-tenant attainment/goodput ledger they gate
+        self.slo_ttft_ms: Optional[float] = None
+        self.slo_tpot_ms: Optional[float] = None
+        self.tenants: dict[str, TenantSLOStats] = {
+            DEFAULT_TENANT: TenantSLOStats()}
+        # per-phase saturation (last schedule's fractions): how close
+        # each capacity axis ran to its ceiling — the knee of the
+        # serving curve shows up here before latency explodes
+        self.saturation: dict[str, float] = {
+            "prefill": 0.0, "decode": 0.0, "seats": 0.0}
         # gauges (last sampled values)
         self.num_waiting = 0
         self.num_running = 0
@@ -189,6 +266,35 @@ class EngineStepMetrics:
     def on_schedule(self, waiting: int, running: int) -> None:
         self.num_waiting = waiting
         self.num_running = running
+
+    def on_saturation(self, prefill: float, decode: float,
+                      seats: float) -> None:
+        """Fractions of this step's capacity ceilings actually used:
+        prefill/decode tokens over the step token budget, running seats
+        over max_num_seqs (sampled per schedule; last value wins)."""
+        self.saturation["prefill"] = round(min(max(prefill, 0.0), 1.0), 4)
+        self.saturation["decode"] = round(min(max(decode, 0.0), 1.0), 4)
+        self.saturation["seats"] = round(min(max(seats, 0.0), 1.0), 4)
+
+    def on_request_slo(self, tenant: Optional[str], ttft_ms: float,
+                       tpot_ms: Optional[float], n_tokens: int) -> None:
+        """Account one successfully finished request against the SLO
+        targets.  ``tpot_ms`` is None for <=1-token requests (no
+        per-output-token time exists); that leg passes.  Exactly at a
+        target counts as met (<=)."""
+        t = cap_tenant(sanitize_tenant(tenant), self.tenants)
+        st = self.tenants.setdefault(t, TenantSLOStats())
+        met = True
+        if self.slo_ttft_ms is not None and ttft_ms > self.slo_ttft_ms:
+            met = False
+        if (met and self.slo_tpot_ms is not None and tpot_ms is not None
+                and tpot_ms > self.slo_tpot_ms):
+            met = False
+        st.finished += 1
+        st.tokens += n_tokens
+        if met:
+            st.met += 1
+            st.goodput_tokens += n_tokens
 
     def on_step(self, step_ms: float, new_tokens: int,
                 prefill_tokens: int, host_ms: Optional[float] = None,
@@ -250,6 +356,19 @@ class EngineStepMetrics:
             "device_ms": self.device_ms.snapshot(),
             "batched_tokens": self.batched_tokens.snapshot(),
             "kv_restore_seconds": self.kv_restore_s.snapshot(),
+            "queue_wait_ms": self.queue_wait_ms.snapshot(),
+            "saturation": dict(self.saturation),
+            "slo": {
+                "targets": {"ttft_ms": self.slo_ttft_ms,
+                            "tpot_ms": self.slo_tpot_ms},
+                "tenants": {
+                    t: {"finished": st.finished, "met": st.met,
+                        "tokens": st.tokens,
+                        "goodput_tokens": st.goodput_tokens,
+                        "attainment": round(st.attainment, 4)}
+                    for t, st in sorted(self.tenants.items())
+                },
+            },
             "padding": {
                 "useful_tokens_total": self.useful_tokens_total,
                 "padded_tokens_total": self.padded_tokens_total,
@@ -302,7 +421,8 @@ class OrchestratorAggregator:
     # ------------------------------------------------------------ recording
     def record_arrival(self, request_id: str) -> None:
         self.requests[request_id] = RequestE2EStats(
-            request_id=request_id, arrival_ts=time.time()
+            request_id=request_id, arrival_ts=time.time(),
+            arrival_mono=time.monotonic(),
         )
 
     def record_finish(self, request_id: str) -> None:
@@ -310,6 +430,7 @@ class OrchestratorAggregator:
         if r is None:
             return
         r.finish_ts = time.time()
+        r.finish_mono = time.monotonic()
         self.num_finished += 1
         self._recent_e2e_ms.append(r.e2e_ms)
         if self._stats_path:

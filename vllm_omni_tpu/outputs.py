@@ -66,7 +66,9 @@ class OmniRequestOutput:
         """"invalid_request" (client's fault, HTTP 400) | "internal"
         (500) | "deadline_exceeded" (time budget spent, 504) |
         "retryable" (transient infra failure before any output — e.g. a
-        stage worker died mid-execution — safe to resubmit, 503)."""
+        stage worker died mid-execution — safe to resubmit, 503) |
+        "shed" (admission control refused a healthy server at capacity
+        — back off and retry, 429; see docs/load_testing.md)."""
         if not self.is_error:
             return None
         return self.multimodal_output.get("error_kind", "internal")

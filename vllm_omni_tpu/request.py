@@ -64,6 +64,11 @@ class Request:
     # single id or a list (multi-eos checkpoints stop on any)
     eos_token_id: Optional[int | list[int]] = None
     arrival_time: float = 0.0
+    # duration clock twin of arrival_time: TTFT/queue-wait spans are
+    # computed monotonic-to-monotonic (an NTP step mid-request must not
+    # corrupt latency histograms); the wall-clock stamp above stays for
+    # logs and trace timestamps only
+    arrival_mono: float = 0.0
     # omni extensions (reference: request.py:14)
     prompt_embeds: Optional[np.ndarray] = None      # [S, hidden]
     additional_information: dict[str, Any] = field(default_factory=dict)
@@ -129,6 +134,17 @@ class Request:
     # hidden states destined for the next stage (pooler_output payloads,
     # reference: gpu_ar_model_runner.py:525-568)
     pooled_hidden: Optional[np.ndarray] = None
+
+    @property
+    def tenant(self) -> str:
+        """Multi-tenant metrics label, plumbed from request metadata
+        (OpenAI header ``x-omni-tenant`` ->
+        additional_information["tenant"]); "default" when absent.
+        CLIENT input: sanitized to a bounded safe charset before it
+        can reach a metrics label or ledger key."""
+        from vllm_omni_tpu.metrics.stats import sanitize_tenant
+
+        return sanitize_tenant(self.additional_information.get("tenant"))
 
     @property
     def num_prompt_tokens(self) -> int:
