@@ -1,0 +1,28 @@
+#!/usr/bin/env sh
+# debugz gate: the introspection subsystem end to end — flight-recorder
+# ring determinism + dump-document schema, the stall watchdog's fake-
+# clock state machine (compile-stall exemption vs true hang) AND the
+# deterministic e2e: boot an in-proc engine, inject a stall through the
+# OMNI_TPU_FAULTS "step" site, assert the watchdog trips and its dump
+# names the stuck request id, carries all-thread stacks, and the last-N
+# step-record tail; the /debug/z + enriched /health endpoint scrapes
+# over real HTTP; and device-memory-ledger conservation (components sum
+# to total, peaks monotone) on the CPU fallback, with the new
+# device_memory_* / trace_spans_dropped_total series validating on
+# /metrics.
+#
+# Standalone face of the same coverage tier-1 carries (tests/
+# introspection is a fast directory), sitting next to scripts/
+# kvcache.sh, scripts/ragged.sh, scripts/asyncstep.sh, scripts/
+# loadgen.sh and scripts/omnilint.sh as a pre-merge gate:
+#
+#   scripts/debugz.sh              # the whole introspection contract
+#   scripts/debugz.sh -k watchdog  # pass-through pytest args
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU: the engine under the injected stall is a tiny
+# random-weight model; the gate must never touch a real chip a
+# colocated serving process owns
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/introspection/ \
+    -q -p no:cacheprovider -m "not slow" "$@"

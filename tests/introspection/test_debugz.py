@@ -1,0 +1,159 @@
+"""/debug/z endpoint scrapes + the enriched /health over real HTTP,
+and the new introspection series on /metrics (validate_exposition
+clean with device_memory_* and trace_spans_dropped_total live)."""
+
+import json
+import threading
+
+import httpx
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.entrypoints.openai.api_server import build_server
+
+
+def _llm_stage():
+    return StageConfig(
+        stage_id=0,
+        stage_type="llm",
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": 4, "max_model_len": 128,
+        },
+        engine_input_source=[-1],
+        final_output=True,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0, "max_tokens": 4},
+    )
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv, state = build_server(
+        model="tiny-lm", stage_configs=[_llm_stage()],
+        host="127.0.0.1", port=0,
+    )
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{port}"
+    # one completed request so every view has content
+    r = httpx.post(f"{url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3, "temperature": 0,
+    }, timeout=120)
+    assert r.status_code == 200
+    yield url, state
+    srv.shutdown()
+    state.shutdown()
+
+
+def test_debug_index(server):
+    url, _ = server
+    r = httpx.get(f"{url}/debug/z", timeout=30)
+    assert r.status_code == 200
+    eps = r.json()["endpoints"]
+    assert "/debug/engine" in eps and "/debug/flightrecorder" in eps
+
+
+def test_debug_engine(server):
+    url, _ = server
+    doc = httpx.get(f"{url}/debug/engine", timeout=30).json()
+    eng = doc["stages"]["0"]
+    assert eng["engine_type"] == "LLMEngine"
+    assert eng["pipeline_slot"]["occupied"] is False
+    assert eng["last_step"]["path"] in ("sync", "pipelined")
+    assert eng["last_step_age_s"] is not None
+    assert eng["warmup"]["batch_buckets"]
+    assert eng["compile"]["compiles"] > 0
+    assert eng["device_memory"]["components"]["weights"]["bytes"] > 0
+
+
+def test_debug_requests_empty_after_drain(server):
+    url, _ = server
+    doc = httpx.get(f"{url}/debug/requests", timeout=30).json()
+    assert doc["stages"]["0"] == []
+
+
+def test_debug_kv(server):
+    url, _ = server
+    kv = httpx.get(f"{url}/debug/kv", timeout=30).json()["stages"]["0"]
+    assert kv["pages_total"] == 64
+    assert kv["pins"]["pages_pinned"] == 0
+    assert kv["prefix_index"]["enabled"] is True
+    assert kv["pending_moves"] == {"offloads": 0, "restores": 0,
+                                   "extract_in_flight": 0}
+
+
+def test_debug_flightrecorder_tail(server):
+    url, _ = server
+    doc = httpx.get(f"{url}/debug/flightrecorder?n=2",
+                    timeout=30).json()
+    rec = doc["stages"]["0"]
+    assert rec["total_steps"] > 0
+    assert 0 < len(rec["records"]) <= 2
+    assert {"path", "seq", "requests"} <= set(rec["records"][-1])
+    bad = httpx.get(f"{url}/debug/flightrecorder?n=x", timeout=30)
+    assert bad.status_code == 400
+
+
+def test_debug_stacks_shows_server_threads(server):
+    url, _ = server
+    stacks = httpx.get(f"{url}/debug/stacks", timeout=30).json()["stacks"]
+    assert any("omni-engine" in label for label in stacks)
+
+
+def test_debug_watchdog_and_unknown_path(server):
+    url, _ = server
+    doc = httpx.get(f"{url}/debug/watchdog", timeout=30).json()
+    assert doc["tripped"] is None
+    assert any(name.endswith("/engine") for name in doc["sources"])
+    assert httpx.get(f"{url}/debug/nope", timeout=30).status_code == 404
+
+
+def test_health_enriched(server):
+    url, _ = server
+    r = httpx.get(f"{url}/health", timeout=30)
+    assert r.status_code == 200
+    body = r.json()
+    assert body["status"] == "ok"
+    assert body["engine_alive"] is True
+    assert body["last_step_age_s"] is not None
+    assert body["watchdog"]["tripped"] is None
+
+
+def test_metrics_has_introspection_series(server):
+    url, _ = server
+    r = httpx.get(f"{url}/metrics", timeout=30)
+    assert r.status_code == 200
+    text = r.text
+    from vllm_omni_tpu.metrics.prometheus import validate_exposition
+
+    assert validate_exposition(text) == []
+    assert 'vllm_omni_tpu_device_memory_bytes{stage="0",' \
+        'component="weights"}' in text
+    assert 'component="kv_pages"' in text
+    assert "vllm_omni_tpu_device_memory_peak_bytes" in text
+    assert "vllm_omni_tpu_trace_spans_dropped_total" in text
+    assert "vllm_omni_tpu_watchdog_tripped 0" in text
+
+
+def test_health_503_once_watchdog_trips(server):
+    """The load-balancer contract: a tripped watchdog flips /health to
+    503 (this must run LAST in the module — the latch is one-way)."""
+    url, state = server
+    wd = state.omni.watchdog
+    assert wd.tripped is None
+    wd.add_source("fake-hang", lambda: {"busy": True, "progress": 1})
+    t0 = wd._clock()
+    wd.check_once()                      # baseline
+    wd._clock = lambda: t0 + wd.deadline_s + 1.0
+    assert wd.check_once() is not None   # trip on the fake source
+    r = httpx.get(f"{url}/health", timeout=30)
+    assert r.status_code == 503
+    assert r.json()["status"] == "stalled"
+    assert r.json()["watchdog"]["tripped"]["sources"] == ["fake-hang"]
+    # the trip also lights the /metrics gauge
+    text = httpx.get(f"{url}/metrics", timeout=30).text
+    assert "vllm_omni_tpu_watchdog_tripped 1" in text
+    assert "vllm_omni_tpu_watchdog_trips_total 1" in text

@@ -1,0 +1,216 @@
+"""Flight-recorder units + engine integration: ring determinism, the
+dump-document schema, crash hooks, and the per-step records the engine
+appends (no device syncs asserted by omnilint OL2, behavior here)."""
+
+import json
+
+import pytest
+
+from vllm_omni_tpu.introspection.flight_recorder import (
+    SCHEMA_VERSION,
+    FlightRecorder,
+    build_dump,
+    capture_stacks,
+    dump_to_file,
+)
+
+
+# ------------------------------------------------------------------ ring
+def test_ring_bounded_and_deterministic():
+    fr = FlightRecorder(capacity=4, name="t")
+    for i in range(10):
+        fr.append({"i": i})
+    records = fr.tail()
+    assert len(records) == 4
+    # seq is monotone and the surviving tail is exactly the newest 4
+    assert [r["seq"] for r in records] == [7, 8, 9, 10]
+    assert [r["i"] for r in records] == [6, 7, 8, 9]
+    assert fr.total_steps == 10
+    # dropped == seq gap at the head of the ring
+    assert fr.dropped == 6
+    assert fr.dropped == records[0]["seq"] - 1
+
+
+def test_tail_sizes():
+    fr = FlightRecorder(capacity=8)
+    for i in range(5):
+        fr.append({"i": i})
+    assert len(fr.tail(2)) == 2
+    assert fr.tail(2)[-1]["i"] == 4
+    assert fr.tail(0) == []
+    assert len(fr.tail(100)) == 5
+
+
+def test_last_step_age():
+    fr = FlightRecorder(capacity=2)
+    assert fr.last_step_age_s() is None
+    fr.append({})
+    age = fr.last_step_age_s()
+    assert age is not None and 0.0 <= age < 5.0
+
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_snapshot_schema_json_ready():
+    fr = FlightRecorder(capacity=4, name="engine-0")
+    fr.append({"path": "sync", "decodes": 1})
+    snap = fr.snapshot()
+    for key in ("name", "capacity", "total_steps", "dropped",
+                "last_step_ts", "records"):
+        assert key in snap
+    assert snap["records"][0]["path"] == "sync"
+    assert snap["records"][0]["ts"] > 0
+    json.dumps(snap)  # rides HTTP + dump files
+
+
+# ------------------------------------------------------------------ dumps
+def test_build_dump_schema():
+    fr = FlightRecorder(capacity=4, name="a")
+    fr.append({"x": 1})
+    doc = build_dump("watchdog_trip", recorders=[fr],
+                     extra={"watchdog": {"stalled_s": 1.0}})
+    assert doc["schema_version"] == SCHEMA_VERSION
+    assert doc["reason"] == "watchdog_trip"
+    assert doc["pid"] > 0 and doc["ts"] > 0
+    assert doc["recorders"][0]["name"] == "a"
+    assert doc["watchdog"] == {"stalled_s": 1.0}
+    # all-thread stacks captured by default, keyed by thread label,
+    # and this very test frame is visible in its own thread's stack
+    assert doc["stacks"]
+    me = [frames for frames in doc["stacks"].values()
+          if any("test_build_dump_schema" in line for line in frames)]
+    assert me, "current frame missing from captured stacks"
+    json.dumps(doc, default=str)
+
+
+def test_dump_to_file_explicit_path(tmp_path):
+    fr = FlightRecorder(capacity=2)
+    fr.append({"i": 1})
+    path = str(tmp_path / "dump.json")
+    out = dump_to_file(build_dump("manual", recorders=[fr]), path)
+    assert out == path
+    doc = json.load(open(path))
+    assert doc["reason"] == "manual"
+    assert doc["recorders"][0]["records"][0]["i"] == 1
+
+
+def test_dump_skipped_without_flight_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("OMNI_TPU_FLIGHT_DIR", raising=False)
+    assert dump_to_file(build_dump("noop")) is None
+
+
+def test_dump_resolves_flight_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(tmp_path / "dumps"))
+    fr = FlightRecorder(capacity=2)
+    fr.append({})
+    out = dump_to_file(build_dump("sigusr2", recorders=[fr]))
+    assert out is not None and "sigusr2" in out
+    assert json.load(open(out))["reason"] == "sigusr2"
+    # two dumps with the same reason in the same SECOND must not
+    # overwrite each other (repeated SIGUSR2s)
+    out2 = dump_to_file(build_dump("sigusr2", recorders=[fr]))
+    assert out2 is not None and out2 != out
+    assert json.load(open(out))["reason"] == "sigusr2"
+
+
+def test_capture_stacks_covers_all_threads():
+    import threading
+
+    gate = threading.Event()
+    done = threading.Event()
+
+    def parked():
+        done.set()
+        gate.wait(5)
+
+    t = threading.Thread(target=parked, name="parked-thread",
+                         daemon=True)
+    t.start()
+    done.wait(5)
+    try:
+        stacks = capture_stacks()
+        labels = list(stacks)
+        assert any("parked-thread" in label for label in labels)
+    finally:
+        gate.set()
+
+
+# -------------------------------------------------------- engine records
+@pytest.fixture(scope="module")
+def stepped_engine():
+    from tests.helpers import tiny_lm_factory
+    from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+
+    params, cfg, _ = tiny_lm_factory()
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=32, page_size=4, max_model_len=64, max_num_seqs=4))
+    eng.generate([[1, 2, 3, 4], [5, 6, 7]],
+                 None)
+    return eng
+
+
+def test_engine_appends_step_records(stepped_engine):
+    eng = stepped_engine
+    records = eng.flight.tail()
+    assert records, "no flight records after generate()"
+    r = records[-1]
+    for key in ("path", "unified", "fallback", "prefills", "decodes",
+                "new_tokens", "prefill_tokens", "waiting", "running",
+                "host_ms", "device_ms", "kv_offloads", "kv_restores",
+                "slot", "compiles", "requests", "seq", "ts"):
+        assert key in r, f"record missing {key}"
+    assert r["path"] in ("sync", "pipelined")
+    # the scheduled request ids ride the record (the stuck-request
+    # answer in a dump)
+    assert any(rec["requests"] for rec in records)
+    assert {rid for rec in records for rid in rec["requests"]} \
+        >= {"req-0", "req-1"}
+    json.dumps(records)
+
+
+def test_kv_move_counts_consumed_per_record():
+    """Regression: pipelined steps never run _drain_kv_moves, so the
+    drain counts must be consumed by the record that reports them —
+    otherwise every later record replays the last sync step's churn.
+    Driven through _record_step on a stub engine (no jax, and no
+    pollution of the shared fixture's ring)."""
+    from types import SimpleNamespace
+
+    from vllm_omni_tpu.core.scheduler import SchedulerOutput
+    from vllm_omni_tpu.engine.llm_engine import LLMEngine
+
+    eng = SimpleNamespace(
+        runner=SimpleNamespace(compile_stats={"compiles": 0}),
+        scheduler=SimpleNamespace(waiting=[], running=[]),
+        flight=FlightRecorder(capacity=8),
+        _inflight=None,
+        _last_kv_moves=(3, 1),
+    )
+    record = LLMEngine._record_step
+    record(eng, "pipelined", SchedulerOutput(), [], 0, 0.0, 0.0)
+    record(eng, "pipelined", SchedulerOutput(), [], 0, 0.0, 0.0)
+    first, second = eng.flight.tail(2)
+    assert (first["kv_offloads"], first["kv_restores"]) == (3, 1)
+    assert (second["kv_offloads"], second["kv_restores"]) == (0, 0)
+
+
+def test_engine_registered_for_introspection(stepped_engine):
+    from vllm_omni_tpu import introspection
+
+    assert stepped_engine in introspection.iter_engines()
+    recs = introspection._live_recorders()
+    assert stepped_engine.flight in recs
+
+
+def test_engine_progress_probe(stepped_engine):
+    p = stepped_engine.introspect_progress()
+    assert p["busy"] is False
+    # progress counts step COMPLETIONS — at least every record-bearing
+    # step, plus any zero-scheduled ticks (which are still the loop
+    # turning, and must count so busy-idle states never false-trip)
+    assert p["progress"] >= stepped_engine.flight.total_steps > 0
+    assert p["compiles"] > 0
+    assert p["compile_in_flight"] is False

@@ -1,0 +1,127 @@
+"""Device-memory ledger: conservation (components sum to total) and
+monotone peak watermarks — on the deterministic CPU fallback tier-1
+exercises, and against a fake allocator for the device path."""
+
+import json
+
+from vllm_omni_tpu.introspection.memory_ledger import DeviceMemoryLedger
+
+
+# ------------------------------------------------------------- fallback
+def test_fallback_conservation_and_source():
+    comps = {"weights": 1000, "kv_pages": 500}
+    ledger = DeviceMemoryLedger(lambda: comps, stats_fn=lambda: None)
+    snap = ledger.refresh()
+    assert snap["source"] == "fallback"
+    total = sum(v["bytes"] for v in snap["components"].values())
+    assert snap["total_bytes"] == total == 1500
+    assert snap["components"]["workspace"]["bytes"] == 0
+    json.dumps(snap)
+
+
+def test_peaks_are_monotone():
+    comps = {"weights": 1000, "kv_pages": 500}
+    ledger = DeviceMemoryLedger(lambda: dict(comps),
+                                stats_fn=lambda: None)
+    s1 = ledger.refresh()
+    comps["kv_pages"] = 2000
+    s2 = ledger.refresh()
+    comps["kv_pages"] = 100          # live drops; peak must NOT
+    s3 = ledger.refresh()
+    assert s3["components"]["kv_pages"]["bytes"] == 100
+    assert s3["components"]["kv_pages"]["peak_bytes"] == 2000
+    assert (s1["peak_total_bytes"] <= s2["peak_total_bytes"]
+            == s3["peak_total_bytes"] == 3000)
+    # live total still conserves
+    assert s3["total_bytes"] == sum(
+        v["bytes"] for v in s3["components"].values())
+
+
+def test_device_stats_path_conservation():
+    """With allocator stats, workspace absorbs the unattributed
+    residual and the components STILL sum to the reported total."""
+    comps = {"weights": 1000, "kv_pages": 500}
+    stats = {"bytes_in_use": 2100, "bytes_limit": 4096,
+             "peak_bytes_in_use": 2500}
+    ledger = DeviceMemoryLedger(lambda: comps, stats_fn=lambda: stats)
+    snap = ledger.refresh()
+    assert snap["source"] == "device"
+    assert snap["components"]["workspace"]["bytes"] == 600
+    assert snap["total_bytes"] == sum(
+        v["bytes"] for v in snap["components"].values()) == 2100
+    assert snap["bytes_limit"] == 4096
+    assert snap["device_peak_bytes_in_use"] == 2500
+
+
+def test_device_stats_smaller_than_known_clamps():
+    """An allocator total below the attributed components (possible
+    when stats lag a just-freed buffer) clamps workspace at 0 and
+    redefines total as the component sum — conservation never breaks."""
+    comps = {"weights": 1000}
+    stats = {"bytes_in_use": 400}
+    ledger = DeviceMemoryLedger(lambda: comps, stats_fn=lambda: stats)
+    snap = ledger.refresh()
+    assert snap["components"]["workspace"]["bytes"] == 0
+    assert snap["total_bytes"] == 1000
+
+
+def test_broken_stats_probe_degrades_to_fallback():
+    def boom():
+        raise RuntimeError("no device")
+
+    ledger = DeviceMemoryLedger(lambda: {"weights": 7},
+                                stats_fn=boom)
+    snap = ledger.refresh()
+    assert snap["source"] == "fallback"
+    assert snap["total_bytes"] == 7
+
+
+def test_snapshot_lazy_refresh():
+    ledger = DeviceMemoryLedger(lambda: {"weights": 3},
+                                stats_fn=lambda: None)
+    snap = ledger.snapshot()      # first use refreshes
+    assert snap["total_bytes"] == 3
+    assert ledger.snapshot() == snap
+
+
+# ------------------------------------------------------- engine wiring
+def test_engine_ledger_cpu_conservation():
+    from tests.helpers import tiny_lm_factory
+    from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+
+    params, cfg, _ = tiny_lm_factory()
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=16, page_size=4, max_model_len=32, max_num_seqs=2))
+    snap = eng.metrics_snapshot()["device_memory"]
+    comps = snap["components"]
+    assert comps["weights"]["bytes"] > 0
+    assert comps["kv_pages"]["bytes"] > 0
+    assert snap["total_bytes"] == sum(v["bytes"] for v in comps.values())
+    # kv geometry is exact: pages * page_size * layers * 2 (k+v) *
+    # heads * head_dim * itemsize
+    import jax.numpy as jnp
+
+    expect_kv = (16 * 4 * cfg.num_layers * 2 * cfg.num_kv_heads
+                 * cfg.head_dim
+                 * jnp.dtype(eng.config.dtype).itemsize)
+    assert comps["kv_pages"]["bytes"] == expect_kv
+    # a second step's refresh keeps peaks monotone
+    eng.generate([[1, 2, 3]], None)
+    snap2 = eng.metrics_snapshot()["device_memory"]
+    for name, v in snap2["components"].items():
+        assert v["peak_bytes"] >= snap["components"].get(
+            name, {"peak_bytes": 0})["peak_bytes"]
+
+
+def test_spec_buffers_component_appears_with_draft_fn():
+    from tests.helpers import tiny_lm_factory
+    from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+
+    params, cfg, _ = tiny_lm_factory()
+    eng = LLMEngine(
+        params, cfg,
+        EngineConfig(num_pages=16, page_size=4, max_model_len=32,
+                     max_num_seqs=2, num_speculative_tokens=2),
+        draft_fn=lambda *a, **k: [])
+    comps = eng.memory.refresh()["components"]
+    assert comps.get("spec_buffers", {}).get("bytes", 0) > 0

@@ -52,6 +52,39 @@ def test_recorder_bounded_and_extend():
     assert len(other) == 4
 
 
+def test_recorder_counts_dropped_spans():
+    """Ring eviction is never silent: every span pushed out before a
+    drain increments spans_dropped (trace_spans_dropped_total)."""
+    rec = TraceRecorder(capacity=4)
+    ctx = new_trace_context("r")
+    for i in range(10):
+        rec.record(ctx, f"s{i}", float(i), 0.1)
+    assert rec.spans_dropped == 6
+    # drain does NOT reset the lifetime counter
+    rec.drain()
+    assert rec.spans_dropped == 6
+    rec.record(ctx, "post", 0.0, 0.1)
+    assert rec.spans_dropped == 6  # room again — no new drops
+
+
+def test_recorder_extend_counts_overflow():
+    rec = TraceRecorder(capacity=4)
+    ctx = new_trace_context("r")
+    rec.record(ctx, "a", 0.0, 0.1)
+    rec.record(ctx, "b", 0.0, 0.1)
+    src = TraceRecorder()
+    for i in range(6):
+        src.record(ctx, f"s{i}", float(i), 0.1)
+    rec.extend(src.drain())
+    # 2 resident + 6 merged - 4 capacity = 4 evicted
+    assert len(rec) == 4
+    assert rec.spans_dropped == 4
+    # merging under capacity drops nothing
+    fresh = TraceRecorder(capacity=16)
+    fresh.extend(rec.drain())
+    assert fresh.spans_dropped == 0
+
+
 def test_distinct_trace_ids():
     a, b = new_trace_context("a"), new_trace_context("b")
     assert a["trace_id"] != b["trace_id"]

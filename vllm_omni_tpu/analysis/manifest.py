@@ -39,6 +39,11 @@ HOT_PATHS: tuple[str, ...] = (
     # creeping into a driver would serialize the very concurrency the
     # harness exists to measure — linted from day one
     "vllm_omni_tpu/loadgen/",
+    # introspection: the flight recorder appends INSIDE the engine
+    # step loop and the watchdog/debugz probes read live engine state
+    # from other threads — a stray device sync in either would stall
+    # serving exactly while an operator is debugging it
+    "vllm_omni_tpu/introspection/",
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (

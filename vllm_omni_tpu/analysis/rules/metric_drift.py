@@ -92,6 +92,19 @@ def synthetic_engine_snapshot() -> dict:
             },
         },
         "saturation": {"prefill": 0.5, "decode": 0.25, "seats": 0.75},
+        # device-memory ledger (introspection/memory_ledger.py):
+        # components sum to total; every new component label value
+        # renders through the same two series
+        "device_memory": {
+            "source": "fallback",
+            "total_bytes": 3145728,
+            "peak_total_bytes": 3145728,
+            "components": {
+                "weights": {"bytes": 2097152, "peak_bytes": 2097152},
+                "kv_pages": {"bytes": 1048576, "peak_bytes": 1048576},
+                "workspace": {"bytes": 0, "peak_bytes": 0},
+            },
+        },
         "diffusion": {"requests_total": 3, "batches_total": 2,
                       "gen_seconds": hist},
     }
@@ -112,6 +125,9 @@ def run_check() -> list[str]:
         synthetic_summary(),
         {0: synthetic_engine_snapshot(), 1: synthetic_engine_snapshot()},
         device={"hbm_bytes": 16 * 2**30},
+        # process-level introspection counters (span loss + watchdog)
+        process_stats={"spans_dropped": 5, "watchdog_trips": 1,
+                       "watchdog_tripped": True},
     )
     errors += validate_exposition(text)
     return errors

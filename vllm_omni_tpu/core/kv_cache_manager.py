@@ -155,6 +155,51 @@ class KVCacheManager:
     def has_pending_moves(self) -> bool:
         return bool(self.pending_offloads or self.pending_restores)
 
+    def debug_snapshot(self) -> dict:
+        """JSON-ready occupancy view for /debug/kv (docs/debugging.md):
+        page pool state, per-request table sizes, pin refcounts, radix
+        node/tier counts, and the pending tier-move queues.  Read-only
+        host bookkeeping — safe from the HTTP thread mid-step."""
+        # C-level dict copies first: the engine thread mutates these
+        # dicts mid-step while the /debug HTTP thread snapshots, and a
+        # Python-level iteration over the live dicts could raise
+        # "dictionary changed size during iteration"
+        tables = dict(self._tables)
+        pin_count = dict(self._pin_count)
+        per_req_pins = dict(self._pinned)
+        pinned = {p: c for p, c in pin_count.items() if c > 0}
+        return {
+            "pages_total": self.num_pages,
+            "pages_free_list": len(self._free),
+            "pages_allocatable": self.num_free_pages,
+            "page_size": self.page_size,
+            "tables": {rid: len(pages)
+                       for rid, pages in sorted(tables.items())},
+            "pins": {
+                "pages_pinned": len(pinned),
+                "refcounts": {str(p): c
+                              for p, c in sorted(pinned.items())},
+                "by_request": {rid: len(pages) for rid, pages
+                               in sorted(per_req_pins.items())},
+            },
+            "prefix_index": (self.index.debug_stats()
+                             if self.enable_prefix_caching
+                             else {"enabled": False}),
+            "pending_moves": {
+                "offloads": len(self.pending_offloads),
+                "restores": len(self.pending_restores),
+                "extract_in_flight": len(self._extract_in_flight),
+            },
+            "counters": {
+                "prefix_hits": self.prefix_hits,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "restored_tokens": self.restored_tokens,
+                "parked_tokens": self.parked_tokens,
+                "offload_evictions": self.offload_evictions,
+                "drop_evictions": self.drop_evictions,
+            },
+        }
+
     # ------------------------------------------------------- prefix cache
     def match_prefix(self, request: Request) -> int:
         """Adopt cached nodes covering the longest full-page prefix of

@@ -22,8 +22,14 @@ import jax
 import jax.numpy as jnp
 
 from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.introspection import (
+    DeviceMemoryLedger,
+    FlightRecorder,
+    register_engine,
+)
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.metrics.stats import EngineStepMetrics
+from vllm_omni_tpu.resilience.faults import fault_point
 from vllm_omni_tpu.tracing import get_recorder
 from vllm_omni_tpu.core.scheduler import (
     ARScheduler,
@@ -321,6 +327,29 @@ class LLMEngine:
         # request_id -> [first_token_ts, last_token_ts, tokens_seen]
         self._req_lat: dict[str, list] = {}
         self._trace_started: set[str] = set()
+        # introspection (docs/debugging.md): the per-step flight
+        # recorder (bounded ring, appended with zero device syncs),
+        # the per-component device-memory ledger, and registration in
+        # the process registry so crash dumps / the stall watchdog /
+        # the /debug/z endpoints can find this engine
+        from vllm_omni_tpu import envs as _envs2
+
+        self.flight = FlightRecorder(
+            capacity=max(int(_envs2.OMNI_TPU_FLIGHT_CAPACITY), 1),
+            name=f"{config.worker_type}-engine")
+        self.memory = DeviceMemoryLedger(self._memory_components)
+        # kv tier moves drained this step — recorded per step so the
+        # flight tail shows offload/restore churn around a bad minute
+        self._last_kv_moves = (0, 0)
+        # watchdog progress signal: step() COMPLETIONS.  Distinct from
+        # flight.total_steps on purpose — zero-scheduled ticks (e.g. a
+        # streaming request idling for its next chunk, pages pinned by
+        # an in-flight transfer) append no record but ARE the step loop
+        # turning; a watchdog keyed on records would false-trip on
+        # those documented-normal busy-idle states, while a step
+        # wedged mid-flight freezes this counter exactly as intended
+        self._steps_completed = 0
+        register_engine(self)
         if config.warmup:
             shapes = (config.warmup if isinstance(
                 config.warmup, (list, tuple)) else ())
@@ -571,6 +600,71 @@ class LLMEngine:
         fn = getattr(kv, "reset_prefix_cache", None)
         return fn() if fn is not None else 0
 
+    # --------------------------------------------------------- introspection
+    def _memory_components(self) -> dict:
+        """Attributable device-memory components for the ledger (the
+        runner's static buffer sizes; empty for runners that don't
+        account themselves)."""
+        fn = getattr(self.runner, "memory_components", None)
+        return fn() if fn is not None else {}
+
+    def introspect_progress(self) -> dict:
+        """Stall-watchdog probe: busy-ness, a monotone step counter,
+        and the compile telemetry that separates an XLA-compile stall
+        from a true hang (docs/debugging.md).  Host-side reads only."""
+        compile_stats = getattr(self.runner, "compile_stats", {}) or {}
+        return {
+            "busy": self.has_unfinished_requests,
+            "progress": self._steps_completed,
+            "compiles": int(compile_stats.get("compiles", 0)),
+            "compile_in_flight": bool(compile_stats.get("in_flight", 0)),
+            "detail": {
+                "stage_id": self.stage_id,
+                "waiting": len(self.scheduler.waiting),
+                "running": len(self.scheduler.running),
+            },
+        }
+
+    def _record_step(self, path: str, sched_out: SchedulerOutput,
+                     scheduled, new_tokens: int, host_ms: float,
+                     device_ms: float,
+                     fallback: Optional[str] = None) -> None:
+        """Append one flight-recorder record.  Every field is a host
+        int/str the step already computed — NO device syncs here (the
+        recorder path is omnilint OL2 HOT_PATHS scoped)."""
+        compile_stats = getattr(self.runner, "compile_stats", {}) or {}
+        inflight = self._inflight
+        rows = (getattr(inflight.handle, "rows", None)
+                if inflight is not None else None)
+        # consume the drain counts: pipelined steps never run
+        # _drain_kv_moves, so without the reset every pipelined record
+        # would replay the LAST sync step's tier churn
+        offloads, restores = self._last_kv_moves
+        self._last_kv_moves = (0, 0)
+        self.flight.append({
+            "path": path,
+            "unified": bool(getattr(sched_out, "unified", False)),
+            "fallback": fallback,
+            "prefills": len(sched_out.prefills),
+            "decodes": len(sched_out.decodes),
+            "new_tokens": new_tokens,
+            "prefill_tokens": sum(s.num_new_tokens
+                                  for s in sched_out.prefills),
+            "waiting": len(self.scheduler.waiting),
+            "running": len(self.scheduler.running),
+            "host_ms": round(host_ms, 3),
+            "device_ms": round(device_ms, 3),
+            "kv_offloads": offloads,
+            "kv_restores": restores,
+            "slot": {"occupied": inflight is not None,
+                     "rows": (len(rows) if isinstance(rows, dict)
+                              else None)},
+            "compiles": int(compile_stats.get("compiles", 0)),
+            # which requests rode this step (capped: the record must
+            # stay small at any batch size)
+            "requests": [s.request.request_id for s in scheduled[:32]],
+        })
+
     def _padding_totals(self) -> tuple[int, int]:
         """Runner-side lifetime (useful, padded) token counters — the
         per-step deltas feed the padding-efficiency metrics."""
@@ -672,9 +766,18 @@ class LLMEngine:
             snap["compile"] = dict(compile_stats)
         if self.config.async_scheduling:
             snap["async_fallback"] = dict(self.async_fallback)
+        # device-memory ledger: per-component live/peak bytes
+        # (device_memory_bytes{component} on /metrics; refresh is a
+        # cold-path metadata walk + optional allocator probe)
+        snap["device_memory"] = self.memory.refresh()
         return snap
 
     def step(self) -> list[OmniRequestOutput]:
+        # deterministic stall injection for the watchdog/debugz tests
+        # (resilience/faults.py site "step": delay_ms stalls every step,
+        # fail_step raises into the caller) — one dict lookup when no
+        # fault plan is installed
+        fault_point("step")
         t_step0 = time.perf_counter()
         # deadline sweep BEFORE scheduling: expired requests become
         # deadline_exceeded outputs this very step instead of consuming
@@ -697,9 +800,14 @@ class LLMEngine:
         errored = [OmniRequestOutput.from_pipeline(r)
                    for r in errored_reqs]
         if self.config.async_scheduling:
-            return errored + self._step_async(t_step0)
-        sched_out = self.scheduler.schedule()
-        return errored + self._run_scheduled(sched_out, t_step0)
+            outs = errored + self._step_async(t_step0)
+        else:
+            sched_out = self.scheduler.schedule()
+            outs = errored + self._run_scheduled(sched_out, t_step0)
+        # counted at COMPLETION: a step wedged mid-flight never
+        # advances the watchdog's progress signal
+        self._steps_completed += 1
+        return outs
 
     # ------------------------------------------------ async pipelined step
     def _note_fallback(self, reason: str) -> None:
@@ -740,7 +848,7 @@ class LLMEngine:
             sched_out.prefills = drop(sched_out.prefills)
             return outs + self._run_scheduled(
                 sched_out, t_step0, skip_on_schedule=True,
-                drained_wait_s=drain_wait)
+                drained_wait_s=drain_wait, fallback="reshaped")
         # fallback step (prefills / spec / logprobs / streaming / ...):
         # retire FIRST so scheduling sees post-retire state and decode
         # inputs are host-visible for the synchronous runner
@@ -749,7 +857,8 @@ class LLMEngine:
         outs, drain_wait = self._drain_pipeline()
         sched_out = self.scheduler.schedule()
         return outs + self._run_scheduled(sched_out, t_step0,
-                                          drained_wait_s=drain_wait)
+                                          drained_wait_s=drain_wait,
+                                          fallback=reason)
 
     @property
     def _unified_async(self) -> bool:
@@ -892,6 +1001,8 @@ class LLMEngine:
             host_ms=host_ms, device_ms=wait_s * 1e3,
             overlapped_host_ms=host_ms if prev is not None else 0.0,
         )
+        self._record_step("pipelined", sched_out, scheduled, new_total,
+                          host_ms=host_ms, device_ms=wait_s * 1e3)
         return outs
 
     def _retire_step(self, inflight: _InflightStep):
@@ -954,9 +1065,11 @@ class LLMEngine:
         between match and fetch); the caller must drop their scheds
         from this step before executing."""
         kv = self.scheduler.kv
+        self._last_kv_moves = (0, 0)
         if self.kv_tiers is None or not kv.has_pending_moves():
             return set()
         offloads, restores = kv.take_pending_moves()
+        self._last_kv_moves = (len(offloads), len(restores))
         failed: set[str] = set()
         if offloads:
             payloads = self.runner.extract_kv_batch(
@@ -1017,7 +1130,8 @@ class LLMEngine:
     # --------------------------------------------------- synchronous step
     def _run_scheduled(self, sched_out: SchedulerOutput, t_step0: float,
                        skip_on_schedule: bool = False,
-                       drained_wait_s: float = 0.0
+                       drained_wait_s: float = 0.0,
+                       fallback: Optional[str] = None
                        ) -> list[OmniRequestOutput]:
         failed_restores = self._drain_kv_moves()
         if failed_restores:
@@ -1140,6 +1254,11 @@ class LLMEngine:
             device_ms=(dur_ex + drained_wait_s) * 1e3,
             overlapped_host_ms=0.0,
         )
+        self._record_step(
+            "sync", sched_out, scheduled, new_total,
+            host_ms=max(total_s - dur_ex - drained_wait_s, 0.0) * 1e3,
+            device_ms=(dur_ex + drained_wait_s) * 1e3,
+            fallback=fallback)
         if self.config.collect_hidden:
             # consolidate per-step hidden chunks into the next-stage payload
             # (reference pooler_output routing, engine/output_processor.py:246)

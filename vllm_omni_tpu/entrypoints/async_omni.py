@@ -68,6 +68,7 @@ class AsyncOmni:
     def shutdown(self) -> None:
         self._running = False
         self._thread.join(timeout=10)
+        self._omni.watchdog.stop()
         # final drain + the one Chrome-document export (the heartbeat
         # only streams JSONL)
         self._omni.flush_traces()
@@ -79,6 +80,17 @@ class AsyncOmni:
     @property
     def metrics(self):
         return self._omni.metrics
+
+    @property
+    def watchdog(self):
+        """The orchestrator's stall watchdog (introspection)."""
+        return self._omni.watchdog
+
+    @property
+    def engine_thread_alive(self) -> bool:
+        """Liveness of the engine loop thread — the /health answer to
+        "is anything still stepping the stages"."""
+        return self._thread.is_alive()
 
     def start_profile(self, trace_dir: str) -> None:
         """Fan a jax.profiler trace out to every stage (reference:

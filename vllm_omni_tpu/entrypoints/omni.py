@@ -164,6 +164,27 @@ class Omni:
                 self._edge_connectors[(cfg.stage_id, int(to_str))] = (
                     ConnectorFactory.create(name, **spec)
                 )
+        # stall watchdog (introspection/watchdog.py): every in-proc
+        # engine registers a progress probe; supervised process stages
+        # feed the same trip machinery through their heartbeat state.
+        # The monitor thread only starts when OMNI_TPU_WATCHDOG_S > 0 —
+        # the object always exists so /debug/watchdog and the /health
+        # snapshot have one source of truth (and tests can drive
+        # check_once with a fake clock).
+        from vllm_omni_tpu.introspection import StallWatchdog
+
+        deadline = float(_envs.OMNI_TPU_WATCHDOG_S or 0.0)
+        self.watchdog = StallWatchdog(deadline_s=deadline or 60.0)
+        for stage in self.stages:
+            eng = getattr(stage, "engine", None)
+            if eng is not None and hasattr(eng, "introspect_progress"):
+                self.watchdog.add_engine(
+                    f"stage{stage.stage_id}/engine", eng)
+            elif hasattr(stage, "_restart_policy"):  # StageSupervisor
+                self.watchdog.add_supervisor(
+                    f"stage{stage.stage_id}/supervisor", stage)
+        if deadline > 0:
+            self.watchdog.start()
 
     # ------------------------------------------------------------- tracing
     @property
@@ -419,6 +440,7 @@ class Omni:
     def shutdown(self) -> None:
         """Stop process-disaggregated stage workers (no-op for in-proc
         stages)."""
+        self.watchdog.stop()
         self.flush_traces()
         for stage in self.stages:
             stop = getattr(stage, "shutdown", None)

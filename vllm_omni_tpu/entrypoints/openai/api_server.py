@@ -250,8 +250,10 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         logger.debug("%s %s", self.address_string(), fmt % args)
 
-    def _json(self, code: int, obj: dict):
-        data = json.dumps(obj).encode()
+    def _json(self, code: int, obj: dict, default=None):
+        # ``default``: encoder fallback for the /debug family, whose
+        # duck-typed snapshots may carry numpy scalars etc.
+        data = json.dumps(obj, default=default).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
@@ -325,8 +327,10 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         parts = urlsplit(self.path)
         if parts.path == "/metrics":
             return self._metrics(parse_qs(parts.query))
+        if parts.path.startswith("/debug/"):
+            return self._debugz(parts.path, parse_qs(parts.query))
         if self.path == "/health":
-            self._json(200, {"status": "ok"})
+            self._health()
         elif self.path == "/v1/models":
             self._json(200, {
                 "object": "list",
@@ -348,6 +352,51 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             self._json(200, {"version": __version__})
         else:
             self._error(404, f"unknown path {self.path}")
+
+    def _health(self):
+        """Honest /health (docs/debugging.md): last-step age + engine
+        liveness, 503 once the stall watchdog has tripped or the engine
+        loop died — so a load balancer ejects a wedged replica instead
+        of the static "ok" feeding it traffic forever."""
+        from vllm_omni_tpu.introspection.debugz import health_snapshot
+
+        omni = getattr(self.state.omni, "_omni", self.state.omni)
+        alive = getattr(self.state.omni, "engine_thread_alive", None)
+        code, body = health_snapshot(omni, engine_thread_alive=alive)
+        self._json(code, body)
+
+    def _debugz(self, path: str, query: dict):
+        """``/debug/z`` introspection family (docs/debugging.md): live
+        JSON views of engines, requests, KV occupancy, the flight-
+        recorder ring, thread stacks, and the watchdog.  Read-only."""
+        from vllm_omni_tpu.introspection import debugz
+
+        omni = getattr(self.state.omni, "_omni", self.state.omni)
+        if path == "/debug/z":
+            return self._json(200, debugz.debug_index(), default=str)
+        if path == "/debug/engine":
+            return self._json(200, debugz.debug_engine(omni),
+                              default=str)
+        if path == "/debug/requests":
+            return self._json(200, debugz.debug_requests(omni),
+                              default=str)
+        if path == "/debug/kv":
+            return self._json(200, debugz.debug_kv(omni), default=str)
+        if path == "/debug/flightrecorder":
+            try:
+                tail = int(query.get("n", [0])[0]) or None
+            except (TypeError, ValueError):
+                return self._error(400, "n must be an integer")
+            return self._json(
+                200, debugz.debug_flightrecorder(omni, tail=tail),
+                default=str)
+        if path == "/debug/stacks":
+            return self._json(200, debugz.debug_stacks(), default=str)
+        if path == "/debug/watchdog":
+            return self._json(200, debugz.debug_watchdog(omni),
+                              default=str)
+        return self._error(404, f"unknown debug path {path}; "
+                           f"see /debug/z")
 
     def _metrics(self, query: dict):
         """``GET /metrics``: Prometheus text exposition (the scrape

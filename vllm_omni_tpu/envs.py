@@ -54,6 +54,19 @@ _register("OMNI_TPU_DEFAULT_DEADLINE_S", "0", float)
 # Fault-injection plan, e.g. "seed=42;stage1:kill_after=2;conn:drop_pct=0.2"
 # (resilience/faults.py grammar).  Inherited by spawned stage workers.
 _register("OMNI_TPU_FAULTS", "", str)
+# Flight-recorder dump directory (introspection/flight_recorder.py):
+# crash/SIGUSR2/watchdog dumps land here as JSON; empty disables the
+# file-writing face (the in-memory ring and /debug endpoints stay on).
+_register("OMNI_TPU_FLIGHT_DIR", "", str)
+# Per-engine flight-recorder ring capacity (step records kept).
+_register("OMNI_TPU_FLIGHT_CAPACITY", "256", int)
+# Stall-watchdog deadline in seconds (introspection/watchdog.py): a
+# busy engine making no step progress for this long — with no XLA
+# compile in flight — trips the watchdog (dump + /health 503).
+# 0 disables the monitor thread (the default: compiles on remote chips
+# legitimately stall for tens of seconds, so the deadline is a
+# deployment decision).
+_register("OMNI_TPU_WATCHDOG_S", "0", float)
 
 
 def __getattr__(name: str):

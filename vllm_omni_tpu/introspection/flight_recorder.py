@@ -1,0 +1,279 @@
+"""Flight recorder: a bounded ring of structured per-step records.
+
+The black-box layer under the tracing/metrics spine (docs/debugging.md).
+Counters and Perfetto traces are aggregate and after-the-fact; when an
+engine wedges, the question an operator actually asks is *what were the
+last 200 steps doing* — which path each step took, what the batch looked
+like, which requests rode it, where the time went.  The recorder answers
+that with a fixed-capacity deque of plain dicts that:
+
+- costs one lock + one deque append per engine step.  Every field is a
+  host-side int/str/float the step loop already computed — appending
+  performs **zero device syncs** (the recorder lives in the omnilint
+  OL2 HOT_PATHS manifest so a stray ``device_get`` can't creep in);
+- survives and explains the bad minute: the ring is dumped as JSON on
+  crash (``sys.excepthook`` / ``atexit``), on ``SIGUSR2``, on a stall-
+  watchdog trip, and on demand (``/debug/flightrecorder``);
+- is deterministic: records carry a monotone ``seq`` so tests (and
+  humans diffing two dumps) can see exactly which records the ring
+  evicted (``seq`` gaps at the head == ``dropped``).
+
+Dump files land under ``OMNI_TPU_FLIGHT_DIR`` when set; the crash hooks
+are silent no-ops without it (a test process exiting must not litter
+the working directory).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Optional
+
+from vllm_omni_tpu.logger import init_logger
+
+logger = init_logger(__name__)
+
+# bump when the dump/record schema changes shape (incident tooling
+# parses these files long after the process that wrote them is gone)
+SCHEMA_VERSION = 1
+
+
+class FlightRecorder:
+    """Bounded per-step record ring for one engine.
+
+    Thread-safe: the engine thread appends while the /debug HTTP thread
+    (or a crash hook on an arbitrary thread) snapshots.
+    """
+
+    def __init__(self, capacity: int = 256, name: str = "engine"):
+        if capacity < 1:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self.name = name
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dropped = 0
+        # monotonic stamp of the last append — /health reports this as
+        # last_step_age_s and the watchdog keys progress off _seq
+        self._last_mono = 0.0
+        self._last_wall = 0.0
+
+    # ------------------------------------------------------------- append
+    def append(self, record: dict) -> None:
+        """Append one step record (host values only — callers must never
+        compute a field by syncing the device for the recorder's sake).
+        Stamps ``seq`` (monotone) and ``ts`` (wall clock, for correlating
+        dumps against logs/traces)."""
+        now_m = time.monotonic()
+        record["ts"] = time.time()
+        with self._lock:
+            self._seq += 1
+            record["seq"] = self._seq
+            if len(self._ring) == self.capacity:
+                self._dropped += 1
+            self._ring.append(record)
+            self._last_mono = now_m
+            self._last_wall = record["ts"]
+
+    # ------------------------------------------------------------ reading
+    @property
+    def total_steps(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        """Records evicted by the ring (lifetime).  Expected to grow on
+        any long-running engine — the ring is a tail, not a history."""
+        with self._lock:
+            return self._dropped
+
+    def last_step_age_s(self) -> Optional[float]:
+        """Seconds since the last appended record (monotonic), or None
+        when nothing was ever recorded."""
+        with self._lock:
+            if self._last_mono == 0.0:
+                return None
+            return max(time.monotonic() - self._last_mono, 0.0)
+
+    def tail(self, n: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            records = list(self._ring)
+        if n is not None and n >= 0:
+            records = records[-n:] if n else []
+        return records
+
+    def snapshot(self, tail: Optional[int] = None) -> dict:
+        """JSON-ready view of the ring + its bookkeeping (the shape the
+        dump files and /debug/flightrecorder serve)."""
+        with self._lock:
+            records = list(self._ring)
+            seq, dropped = self._seq, self._dropped
+            last_wall = self._last_wall
+        if tail is not None and tail >= 0:
+            records = records[-tail:] if tail else []
+        return {
+            "name": self.name,
+            "capacity": self.capacity,
+            "total_steps": seq,
+            "dropped": dropped,
+            "last_step_ts": last_wall or None,
+            "records": records,
+        }
+
+
+# ---------------------------------------------------------------- dumping
+# process-wide dump ordinal: filenames stay unique even when two dumps
+# with the same reason land in the same second (e.g. repeated SIGUSR2)
+_dump_seq = 0
+_dump_seq_lock = threading.Lock()
+
+
+def capture_stacks() -> dict:
+    """All-thread stack traces, keyed by thread name (falling back to
+    the raw thread id).  Pure host introspection — safe from any thread,
+    including a signal handler or a dying excepthook."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks: dict[str, list[str]] = {}
+    for tid, frame in sys._current_frames().items():
+        label = f"{names.get(tid, 'unknown')}-{tid}"
+        stacks[label] = [
+            line.rstrip("\n")
+            for line in traceback.format_stack(frame)
+        ]
+    return stacks
+
+
+def build_dump(reason: str, *, recorders: list[FlightRecorder] = (),
+               extra: Optional[dict] = None,
+               include_stacks: bool = True) -> dict:
+    """One self-contained incident document: every recorder's ring,
+    all-thread stacks, and whatever context the tripper adds."""
+    doc: dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
+        "reason": reason,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "recorders": [r.snapshot() for r in recorders],
+    }
+    if include_stacks:
+        doc["stacks"] = capture_stacks()
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def dump_to_file(doc: dict, path: Optional[str] = None) -> Optional[str]:
+    """Write a dump document as JSON.  ``path`` None resolves through
+    ``OMNI_TPU_FLIGHT_DIR``; unset means the dump is skipped (returns
+    None) — crash hooks must not litter CWD in ordinary test runs."""
+    if path is None:
+        from vllm_omni_tpu import envs
+
+        flight_dir = envs.OMNI_TPU_FLIGHT_DIR
+        if not flight_dir:
+            return None
+        os.makedirs(flight_dir, exist_ok=True)
+        reason = str(doc.get("reason", "dump")).replace("/", "_")
+        global _dump_seq
+        with _dump_seq_lock:
+            _dump_seq += 1
+            seq = _dump_seq
+        path = os.path.join(
+            flight_dir,
+            f"flight-{os.getpid()}-{int(doc.get('ts', 0))}"
+            f"-{seq:03d}-{reason}.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+    except OSError as e:  # a dying process must not die harder
+        logger.error("flight-recorder dump to %s failed: %s", path, e)
+        return None
+    logger.warning("flight-recorder dump (%s) written to %s",
+                   doc.get("reason"), path)
+    return path
+
+
+# ------------------------------------------------------------ crash hooks
+def _dumping_enabled() -> bool:
+    """Whether dump_to_file would actually write (OMNI_TPU_FLIGHT_DIR
+    set).  The hooks check this FIRST — building a full dump (every
+    ring + all-thread stacks) just to throw it away would tax every
+    crash path of every undumped process."""
+    from vllm_omni_tpu import envs
+
+    return bool(envs.OMNI_TPU_FLIGHT_DIR)
+
+
+_hooks_installed = False
+_hooks_lock = threading.Lock()
+
+
+def install_crash_hooks(recorders_fn) -> None:
+    """Install the crash-dump hooks once per process: ``sys.excepthook``
+    (unhandled exception), ``atexit`` (normal/abnormal interpreter
+    exit), and ``SIGUSR2`` (operator-requested dump of a live process).
+    ``recorders_fn`` returns the live recorders at dump time — hooks
+    hold no strong references, so engines stay collectable.
+
+    All three write through :func:`dump_to_file`, so without
+    ``OMNI_TPU_FLIGHT_DIR`` every hook is a no-op.
+    """
+    global _hooks_installed
+    with _hooks_lock:
+        if _hooks_installed:
+            return
+        _hooks_installed = True
+
+    prev_hook = sys.excepthook
+
+    def _excepthook(exc_type, exc, tb):
+        try:
+            if _dumping_enabled():
+                doc = build_dump(
+                    "crash", recorders=recorders_fn(),
+                    extra={"exception": "".join(
+                        traceback.format_exception(exc_type, exc, tb))})
+                dump_to_file(doc)
+        except Exception:
+            pass
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _excepthook
+
+    def _atexit():
+        try:
+            if not _dumping_enabled():
+                return
+            recs = recorders_fn()
+            if any(r.total_steps for r in recs):
+                dump_to_file(build_dump("exit", recorders=recs,
+                                        include_stacks=False))
+        except Exception:
+            pass
+
+    atexit.register(_atexit)
+
+    def _on_sigusr2(signum, frame):
+        try:
+            if _dumping_enabled():
+                dump_to_file(build_dump("sigusr2",
+                                        recorders=recorders_fn()))
+        except Exception:
+            pass
+
+    try:
+        # only valid on the main thread (and not on every platform) —
+        # an engine built from a worker thread simply skips the signal
+        # face and keeps the other two hooks
+        signal.signal(signal.SIGUSR2, _on_sigusr2)
+    except (ValueError, AttributeError, OSError):
+        pass

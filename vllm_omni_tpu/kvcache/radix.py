@@ -114,6 +114,31 @@ class RadixPrefixIndex:
     def cold_nodes(self) -> int:
         return sum(1 for n in self._iter_nodes() if n.page is None)
 
+    def debug_stats(self) -> dict:
+        """Aggregate index state for /debug/kv: node/tier/refcount
+        counts plus the eviction machinery's internals (a diverging
+        ``unref_hbm`` vs recount is the first sign of a refcount leak
+        — check_invariants audits the same pair)."""
+        nodes = refs = cold = 0
+        by_tier: dict[str, int] = {}
+        for n in self._iter_nodes():
+            nodes += 1
+            refs += n.ref
+            by_tier[n.tier] = by_tier.get(n.tier, 0) + 1
+            if n.page is None:
+                cold += 1
+        return {
+            "enabled": True,
+            "nodes": nodes,
+            "hbm_pages": len(self._by_page),
+            "cold_nodes": cold,
+            "by_tier": by_tier,
+            "ref_total": refs,
+            "unref_hbm": self._unref_hbm,
+            "victim_heap": len(self._victims),
+            "clock": self._clock,
+        }
+
     # ----------------------------------------------------------- hashing
     def page_keys(self, token_ids, max_pages: Optional[int] = None
                   ) -> list[tuple[tuple[int, ...], str]]:

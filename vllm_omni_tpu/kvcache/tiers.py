@@ -272,3 +272,15 @@ class TieredKVStore:
             "restored_tokens": self.restored_tokens,
             "quant": self.quant,
         }
+
+    def debug_snapshot(self, key_limit: int = 32) -> dict:
+        """stats() plus a BOUNDED sample of resident keys per tier for
+        /debug/kv — enough to see which park runs / prefix nodes are
+        parked where, without serializing a fleet-sized directory."""
+        doc = self.stats()
+        doc["host_capacity_bytes"] = self.host_capacity_bytes
+        # oldest-first (the LRU's next demotion victims lead the list)
+        doc["host_keys"] = list(self._host)[:key_limit]
+        doc["remote_keys"] = sorted(self._remote_keys)[:key_limit]
+        doc["has_remote"] = self._remote is not None
+        return doc

@@ -196,6 +196,28 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Fraction of the capacity ceiling used per phase (prefill/"
         "decode token budget, running seats) at the last schedule",
         ("stage", "phase")),
+    # ---- introspection (docs/debugging.md): device-memory ledger,
+    # span-loss accounting, stall-watchdog state
+    "device_memory_bytes": (
+        "gauge",
+        "Live device memory per component (weights, kv_pages, "
+        "spec_buffers, workspace); components sum to the device total",
+        ("stage", "component")),
+    "device_memory_peak_bytes": (
+        "gauge",
+        "Peak watermark of device memory per component (monotone)",
+        ("stage", "component")),
+    "trace_spans_dropped_total": (
+        "counter",
+        "Trace spans evicted from the recorder ring before any drain "
+        "(a growing count means the trace files have holes)", ()),
+    "watchdog_trips_total": (
+        "counter", "Stall-watchdog trips (true hangs, compile stalls "
+        "exempted)", ()),
+    "watchdog_tripped": (
+        "gauge",
+        "Whether the stall watchdog has tripped (1 = /health serves "
+        "503)", ()),
     "diffusion_requests_total": (
         "counter", "Diffusion requests generated", ("stage",)),
     "diffusion_batches_total": (
@@ -304,11 +326,14 @@ class _Exposition:
 
 def render_exposition(summary: dict, engine_snaps: dict,
                       device: Optional[dict] = None,
-                      resilience: Optional[dict] = None) -> str:
+                      resilience: Optional[dict] = None,
+                      process_stats: Optional[dict] = None) -> str:
     """``summary``: OrchestratorAggregator.summary(); ``engine_snaps``:
     {stage_id: LLMEngine/DiffusionEngine.metrics_snapshot() or {}};
     ``resilience``: resilience_metrics.snapshot() (restart/retry/
-    breaker/deadline counters, labels already attached)."""
+    breaker/deadline counters, labels already attached);
+    ``process_stats``: process-level introspection counters
+    ({spans_dropped, watchdog_trips, watchdog_tripped})."""
     exp = _Exposition()
     e2e = summary.get("e2e", {})
     exp.sample("requests_finished_total", {}, e2e.get("num_finished", 0))
@@ -456,6 +481,16 @@ def render_exposition(summary: dict, engine_snaps: dict,
         for phase, v in sorted((snap.get("saturation") or {}).items()):
             exp.sample("phase_saturation_ratio",
                        {**labels, "phase": phase}, v)
+        # device-memory ledger: per-component live/peak bytes
+        # (components sum to total; docs/debugging.md)
+        dm = snap.get("device_memory")
+        if dm:
+            for comp, v in sorted((dm.get("components") or {}).items()):
+                cl = {**labels, "component": comp}
+                exp.sample("device_memory_bytes", cl,
+                           v.get("bytes", 0))
+                exp.sample("device_memory_peak_bytes", cl,
+                           v.get("peak_bytes", 0))
         diff = snap.get("diffusion")
         if diff:
             exp.sample("diffusion_requests_total", labels,
@@ -467,6 +502,13 @@ def render_exposition(summary: dict, engine_snaps: dict,
                               diff["gen_seconds"])
     if device and device.get("hbm_bytes"):
         exp.sample("hbm_bytes", {}, device["hbm_bytes"])
+    if process_stats:
+        exp.sample("trace_spans_dropped_total", {},
+                   process_stats.get("spans_dropped", 0))
+        exp.sample("watchdog_trips_total", {},
+                   process_stats.get("watchdog_trips", 0))
+        exp.sample("watchdog_tripped", {},
+                   1 if process_stats.get("watchdog_tripped") else 0)
     for name, samples in (resilience or {}).items():
         if name not in METRIC_SPECS:
             continue  # unknown names never leak past the drift guard
@@ -487,6 +529,7 @@ def render_from_omni(omni, device: Optional[dict] = None) -> str:
         merge_snapshots,
         resilience_metrics,
     )
+    from vllm_omni_tpu.tracing import get_recorder
 
     summary = omni.metrics.summary()
     snaps = {}
@@ -497,10 +540,19 @@ def render_from_omni(omni, device: Optional[dict] = None) -> str:
         rfn = getattr(stage, "resilience_snapshot", None)
         if rfn is not None:
             worker_res.append(rfn())
+    wd = getattr(omni, "watchdog", None)
+    process_stats = {
+        # THIS process's recorder (stage workers drain theirs over the
+        # outputs frames before their rings can evict)
+        "spans_dropped": get_recorder().spans_dropped,
+        "watchdog_trips": getattr(wd, "trips", 0),
+        "watchdog_tripped": getattr(wd, "tripped", None) is not None,
+    }
     return render_exposition(
         summary, snaps, device=device,
         resilience=merge_snapshots(resilience_metrics.snapshot(),
-                                   *worker_res))
+                                   *worker_res),
+        process_stats=process_stats)
 
 
 # ------------------------------------------------------------ validation

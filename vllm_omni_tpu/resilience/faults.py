@@ -17,6 +17,9 @@ Sites (each ``fault_point(site)`` call is one step at that site):
 - ``chan``      — stage command-channel send/recv
 - ``conn``      — connector ``put``/``get``
 - ``kv``        — per-layer KV transfer gets
+- ``step``      — ``LLMEngine.step`` entry (``delay_ms`` stalls every
+  engine step — the stall-watchdog tests' deterministic hang;
+  ``fail_step`` raises into the stepping loop)
 
 Actions:
 

@@ -43,11 +43,19 @@ def new_trace_context(request_id: str) -> dict:
 class TraceRecorder:
     """Process-global span sink.  Bounded: a recorder nobody drains (a
     stage worker between output batches, a server without tracing
-    enabled) must not grow memory forever."""
+    enabled) must not grow memory forever.
+
+    Eviction is COUNTED, never silent: ``spans_dropped`` is the
+    lifetime number of spans the ring pushed out before anyone drained
+    them, surfaced as ``trace_spans_dropped_total`` on /metrics — a
+    growing counter means the drain cadence (or the capacity) is wrong
+    and the traces being analyzed have holes."""
 
     def __init__(self, capacity: int = 65536):
+        self._capacity = capacity
         self._spans: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._dropped = 0
 
     def record(
         self,
@@ -77,12 +85,19 @@ class TraceRecorder:
         if args:
             span["args"] = args
         with self._lock:
+            if len(self._spans) == self._capacity:
+                self._dropped += 1
             self._spans.append(span)
 
     def extend(self, spans: list[dict]) -> None:
         """Merge spans recorded by another process (shipped over the
         stage worker's outputs message)."""
         with self._lock:
+            overflow = (len(self._spans) + len(spans)) - self._capacity
+            if overflow > 0:
+                # a batch larger than the whole ring also drops its own
+                # head, not just the resident spans it pushes out
+                self._dropped += overflow
             self._spans.extend(spans)
 
     def drain(self) -> list[dict]:
@@ -90,6 +105,12 @@ class TraceRecorder:
             spans = list(self._spans)
             self._spans.clear()
         return spans
+
+    @property
+    def spans_dropped(self) -> int:
+        """Lifetime spans evicted undrained (trace_spans_dropped_total)."""
+        with self._lock:
+            return self._dropped
 
     def __len__(self) -> int:
         with self._lock:

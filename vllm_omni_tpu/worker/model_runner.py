@@ -198,13 +198,26 @@ class ARModelRunner:
         # jit shape-cache telemetry: fresh compiles vs. cache hits and
         # cumulative first-call (compile-dominated) seconds, keyed by
         # this runner's own (kind, shape) signatures
+        # "in_flight" is the stall watchdog's compile-stall signal: set
+        # around the fresh-compile branch of _run_jit so a mid-traffic
+        # XLA compile reads as "compiling", never as a hung engine
         self.compile_stats = {"compiles": 0, "cache_hits": 0,
-                              "compile_s": 0.0}
+                              "compile_s": 0.0, "in_flight": 0}
         self._jit_seen: set[tuple] = set()
         self.kv_caches = init_kv_cache(
             cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
             cfg.head_dim, dtype,
         )
+        # device-memory ledger components (introspection/memory_ledger):
+        # static buffer sizes, summed ONCE from array metadata — .nbytes
+        # never syncs the device.  Spec-decode verify buffers are added
+        # by set_draft_fn.
+        self._weights_bytes = sum(
+            getattr(x, "nbytes", 0)
+            for x in jax.tree_util.tree_leaves(params))
+        self._kv_bytes = sum(k.nbytes + v.nbytes
+                             for k, v in self.kv_caches)
+        self._spec_bytes = 0
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -460,6 +473,12 @@ class ARModelRunner:
 
         self.draft_fn = draft_fn
         self.num_draft_tokens = num_draft_tokens
+        # memory-ledger estimate of the verify-path buffers: the widest
+        # batch's (k+1)-row logits at float32 (deterministic — the
+        # ledger's CPU fallback must not depend on allocator probes)
+        self._spec_bytes = (self._batch_buckets[-1]
+                            * (num_draft_tokens + 1)
+                            * self.cfg.vocab_size * 4)
         try:
             sig = inspect.signature(draft_fn)
             self._draft_takes_contexts = "contexts" in sig.parameters
@@ -482,11 +501,25 @@ class ARModelRunner:
             return thunk()
         self._jit_seen.add(key)
         t0 = time.perf_counter()
-        result = thunk()
-        jax.block_until_ready(result)
+        self.compile_stats["in_flight"] = 1
+        try:
+            result = thunk()
+            jax.block_until_ready(result)
+        finally:
+            self.compile_stats["in_flight"] = 0
         self.compile_stats["compiles"] += 1
         self.compile_stats["compile_s"] += time.perf_counter() - t0
         return result
+
+    def memory_components(self) -> dict:
+        """Attributable device-memory components for the engine's
+        ledger (introspection/memory_ledger.py): static buffer sizes
+        from array metadata — never a device sync."""
+        comps = {"weights": self._weights_bytes,
+                 "kv_pages": self._kv_bytes}
+        if self._spec_bytes:
+            comps["spec_buffers"] = self._spec_bytes
+        return comps
 
     def _note_padding(self, useful: int, padded: int) -> None:
         self.useful_tokens += int(useful)
