@@ -1,0 +1,31 @@
+#!/usr/bin/env sh
+# Disaggregation gate: the fault-tolerant prefill/decode topology end
+# to end — TPLA sharding + integrity/deadline units on the handoff
+# protocol, router units (health ejection/re-admission, least-loaded
+# dispatch, drain quiesce, degradation ladder, bounded failover,
+# idempotent redelivery), the tiny-model failover matrix (prefill
+# death mid-stream -> replay bit-identical to the colocated oracle,
+# handoff loss/corruption -> decode-side recompute, tier loss ->
+# degraded-colocated, drain-mode quiesce, deadline 504), the open-loop
+# chaos run asserting goodput degrades gracefully, and finally the
+# standalone two-prefill/one-decode in-proc topology smoke under a
+# seeded replica-kill fault plan.
+#
+# Standalone face of the same coverage tier-1 carries (tests/disagg is
+# a fast directory), sitting next to scripts/faultmatrix.sh and
+# scripts/loadgen.sh as a pre-merge gate:
+#
+#   scripts/disagg.sh                 # the whole disaggregation contract
+#   scripts/disagg.sh -k failover     # pass-through pytest args
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU: the matrix kills replicas on purpose; it must never touch
+# a real TPU chip a colocated serving process owns
+env JAX_PLATFORMS=cpu python -m pytest tests/disagg/ \
+    -q -p no:cacheprovider -m "not slow" "$@"
+# topology smoke: serve through a 2x1 split under a seeded mid-stream
+# replica kill; exits nonzero unless every stream matches the
+# colocated oracle bit for bit
+exec env JAX_PLATFORMS=cpu \
+    OMNI_TPU_FAULTS="seed=42;replica0:fail_step=3" \
+    python -m vllm_omni_tpu.disagg --prefill 2 --decode 1 --requests 4

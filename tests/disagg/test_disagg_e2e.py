@@ -1,0 +1,314 @@
+"""Disaggregated prefill/decode e2e on a tiny random-weight model.
+
+The contract (docs/disaggregation.md): a request served by the split
+topology — prefill tier computes the prompt + first token, KV streams
+to the decode tier, decode resumes through the decode executable — must
+produce a GREEDY stream bit-identical to a colocated single engine, and
+every fault on the way (replica death, handoff loss, corruption, tier
+loss) must degrade to replay/recompute, never to wrong tokens or
+dropped requests.  Chaos is the PR 3 deterministic fault framework.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.disagg.service import build_inproc_router
+from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.metrics.prometheus import validate_exposition
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.resilience.faults import (
+    FaultPlan,
+    set_fault_plan,
+)
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return params, cfg
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    set_fault_plan(None)
+    yield
+    set_fault_plan(None)
+
+
+BASE = dict(num_pages=64, page_size=4, max_model_len=128,
+            max_num_seqs=4, dtype=jnp.float32)
+GREEDY = SamplingParams(temperature=0.0, max_tokens=6)
+PROMPTS = [[1, 5, 9, 2, 7, 3, 8, 4], [2, 6, 1, 7, 3, 9, 5, 8],
+           [4, 4, 8, 1, 2, 2, 9, 7]]
+
+
+def _oracle(params, cfg, prompts, sp=GREEDY, **kw):
+    eng = LLMEngine(params, cfg, EngineConfig(**{**BASE, **kw}))
+    return [o.outputs[0].token_ids
+            for o in eng.generate([list(p) for p in prompts], sp)]
+
+
+def _serve(router, prompts, sp=GREEDY, max_steps=2000, **submit_kw):
+    rids = [router.submit(list(p), sp, request_id=f"e2e-{i}",
+                          **submit_kw)
+            for i, p in enumerate(prompts)]
+    finished = {}
+    for _ in range(max_steps):
+        if not router.has_unfinished:
+            break
+        router.step()
+        for out in router.poll():
+            finished[out.request_id] = out
+    for out in router.poll():
+        finished[out.request_id] = out
+    assert not router.has_unfinished, "requests lost in the router"
+    return [finished[r] for r in rids]
+
+
+def _router(params, cfg, n_prefill, n_decode, base_kw=None, **kw):
+    base = EngineConfig(**{**BASE, **(base_kw or {})})
+    return build_inproc_router(params, cfg, base, n_prefill, n_decode,
+                               **kw)
+
+
+# ------------------------------------------------------------ fast path
+def test_disagg_matches_colocated_oracle(tiny_model, monkeypatch):
+    # pin the FULL wire path (serialize -> store -> verify -> inject):
+    # the zero-copy fast path is exercised by every other e2e
+    monkeypatch.setenv("OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION", "1")
+    params, cfg = tiny_model
+    want = _oracle(params, cfg, PROMPTS)
+    router = _router(params, cfg, 1, 1)
+    outs = _serve(router, PROMPTS)
+    assert [o.outputs[0].token_ids for o in outs] == want, \
+        "disaggregation changed the greedy stream"
+    assert router.handoffs == len(PROMPTS), \
+        "the fast path must actually hand off, not recompute"
+    assert not router.failovers
+    # the decode tier's KV arrived as streamed pages, not recompute
+    decode_kv = router.decodes[0].engine.scheduler.kv
+    assert decode_kv.streamed_tokens >= sum(len(p) for p in PROMPTS)
+    assert router.handoff_seconds.snapshot()["count"] == len(PROMPTS)
+
+
+def test_prefill_role_auto_arms_kv_transfer(tiny_model):
+    params, cfg = tiny_model
+    eng = LLMEngine(params, cfg,
+                    EngineConfig(engine_role="prefill", **BASE))
+    assert eng.config.kv_transfer is not None
+    assert eng.config.kv_transfer.trigger == "prefill_finished"
+    with pytest.raises(ValueError, match="engine_role"):
+        LLMEngine(params, cfg, EngineConfig(engine_role="bogus", **BASE))
+
+
+def test_first_token_request_finishes_at_prefill_tier(tiny_model):
+    params, cfg = tiny_model
+    sp = SamplingParams(temperature=0.0, max_tokens=1)
+    want = _oracle(params, cfg, PROMPTS[:1], sp)
+    router = _router(params, cfg, 1, 1)
+    outs = _serve(router, PROMPTS[:1], sp)
+    assert [o.outputs[0].token_ids for o in outs] == want
+    assert router.handoffs == 0, "no decode hop for a 1-token stream"
+
+
+# ---------------------------------------------------- failover matrix
+def test_prefill_death_midstream_replays_on_survivor(tiny_model):
+    """A prefill replica dies mid-prompt (chunked prefill, fault at its
+    step loop): the request replays on the surviving replica and the
+    greedy output stays bit-identical to the colocated oracle —
+    exactly-once semantics via the request id."""
+    params, cfg = tiny_model
+    chunked = dict(enable_chunked_prefill=True,
+                   max_num_batched_tokens=4)
+    want = _oracle(params, cfg, PROMPTS[:2], **chunked)
+    router = _router(params, cfg, 2, 1, base_kw=chunked)
+    # replica0 = first prefill replica; its 2nd step is mid-prefill
+    # (8-token prompts at a 4-token budget take 2 chunks: the kill
+    # lands after chunk 1, before the sampling chunk)
+    set_fault_plan(FaultPlan.parse("seed=1;replica0:fail_step=2"))
+    outs = _serve(router, PROMPTS[:2])
+    assert [o.outputs[0].token_ids for o in outs] == want, \
+        "failover replay changed the greedy stream"
+    assert router.prefills[0].dead
+    assert router.failovers.get("prefill_replica_died", 0) >= 1
+
+
+def test_handoff_failure_degrades_to_decode_recompute(tiny_model):
+    """Every handoff injected to fail: the decode tier recomputes the
+    prompt locally — the PR 6 lost-payload path across hosts — and the
+    stream still matches the oracle."""
+    params, cfg = tiny_model
+    want = _oracle(params, cfg, PROMPTS[:2])
+    router = _router(params, cfg, 1, 1)
+    set_fault_plan(FaultPlan.parse("handoff:drop_after=0"))
+    outs = _serve(router, PROMPTS[:2])
+    assert [o.outputs[0].token_ids for o in outs] == want
+    assert router.handoffs == 0
+    assert router.failovers.get("handoff_failed", 0) == 2
+    # recompute means the decode engine computed the prompts itself
+    decode_kv = router.decodes[0].engine.scheduler.kv
+    assert decode_kv.streamed_tokens == 0
+
+
+def test_corrupt_payload_degrades_to_recompute(tiny_model, monkeypatch):
+    """A payload corrupted in transit trips the per-layer checksum and
+    the decode tier recomputes — garbage pages never enter its cache
+    and the stream stays bit-identical."""
+    # corruption happens ON the wire: force the serialized path
+    monkeypatch.setenv("OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION", "1")
+    params, cfg = tiny_model
+    want = _oracle(params, cfg, PROMPTS[:1])
+    router = _router(params, cfg, 1, 1)
+    inner_put = router.connector.put
+
+    def corrupting_put(key, obj):
+        if key.endswith("/L0"):
+            k, v = obj
+            obj = (np.asarray(k) + 1.0, v)  # same shape, flipped bits
+        return inner_put(key, obj)
+
+    router.connector.put = corrupting_put
+    outs = _serve(router, PROMPTS[:1])
+    assert [o.outputs[0].token_ids for o in outs] == want
+    assert router.failovers.get("handoff_failed", 0) == 1
+    assert router.decodes[0].engine.scheduler.kv.streamed_tokens == 0
+
+
+def test_zero_healthy_prefill_degrades_then_recovers(tiny_model):
+    """Tier loss: all prefill replicas dead -> colocated serving on the
+    decode tier (degraded_mode 1); a revived replica re-admits and the
+    disaggregated path resumes (degraded_mode 0)."""
+    params, cfg = tiny_model
+    want = _oracle(params, cfg, PROMPTS)
+    router = _router(params, cfg, 1, 1)
+    router.prefills[0].dead = True
+    router.step()
+    assert router.degraded
+    assert resilience_metrics.get("degraded_mode") == 1
+    outs = _serve(router, PROMPTS[:2])
+    assert [o.outputs[0].token_ids for o in outs] == want[:2], \
+        "degraded-colocated serving changed the stream"
+    assert router.handoffs == 0
+    # recovery: the replica revives, health re-admits, handoffs resume
+    router.prefills[0].revive()
+    router.step()
+    assert not router.degraded
+    assert resilience_metrics.get("degraded_mode") == 0
+    outs = _serve(router, [PROMPTS[2]])
+    assert outs[0].outputs[0].token_ids == want[2]
+    assert router.handoffs == 1
+
+
+def test_zero_healthy_decode_serves_on_prefill_tier(tiny_model):
+    params, cfg = tiny_model
+    want = _oracle(params, cfg, PROMPTS[:2])
+    router = _router(params, cfg, 1, 1)
+    router.decodes[0].dead = True
+    outs = _serve(router, PROMPTS[:2])
+    assert [o.outputs[0].token_ids for o in outs] == want
+    assert router.degraded and router.handoffs == 0
+    # colocated placement suppressed the per-request KV transfer: the
+    # prefill-role survivor must not pay a whole-prompt extraction for
+    # a payload nobody consumes
+    assert not router._payloads, \
+        "degraded-colocated serving extracted unconsumed KV payloads"
+
+
+def test_drain_mode_quiesces_live_replica(tiny_model):
+    """Rolling-restart drill: drain the only decode replica mid-flight;
+    its in-flight request completes (nothing dropped), it quiesces, and
+    new arrivals serve colocated on the prefill tier meanwhile."""
+    params, cfg = tiny_model
+    want = _oracle(params, cfg, PROMPTS[:2])
+    router = _router(params, cfg, 1, 1)
+    rid0 = router.submit(list(PROMPTS[0]), GREEDY, request_id="d-0")
+    # step until the request is adopted on the decode tier, then drain
+    for _ in range(200):
+        router.step()
+        if router.decodes[0].engine.has_unfinished_requests:
+            break
+    router.drain("decode1")
+    assert not router.quiesced("decode1")
+    rid1 = router.submit(list(PROMPTS[1]), GREEDY, request_id="d-1")
+    finished = {}
+    for _ in range(2000):
+        if not router.has_unfinished:
+            break
+        router.step()
+        for out in router.poll():
+            finished[out.request_id] = out
+    assert finished[rid0].outputs[0].token_ids == want[0], \
+        "drain dropped or corrupted the in-flight decode"
+    assert finished[rid1].outputs[0].token_ids == want[1]
+    assert router.quiesced("decode1")
+    # the drained replica took no NEW work
+    assert "d-1" not in router.decodes[0].engine.scheduler._finished_ids
+    router.undrain("decode1")
+    assert router.decodes[0].in_rotation
+
+
+def test_deadline_expired_surfaces_504_not_hang(tiny_model):
+    params, cfg = tiny_model
+    router = _router(params, cfg, 1, 1)
+    rid = router.submit(list(PROMPTS[0]), GREEDY, request_id="dl-0",
+                        deadline_s=0.0)
+    time.sleep(0.01)
+    finished = {}
+    for _ in range(200):
+        router.step()
+        for out in router.poll():
+            finished[out.request_id] = out
+        if rid in finished:
+            break
+    assert finished[rid].is_error
+    assert finished[rid].error_kind == "deadline_exceeded"
+
+
+# --------------------------------------------------- acceptance chaos e2e
+def test_chaos_prefill_kill_midhandoff_bit_identical_metrics(tiny_model):
+    """The acceptance criterion: seeded faults kill a prefill replica
+    mid-stream; requests complete on the survivor bit-identical to the
+    colocated oracle, failover_total shows on /metrics, and with ALL
+    prefill replicas dead the topology serves degraded-colocated with
+    no request errors a colocated engine would not produce."""
+    params, cfg = tiny_model
+    chunked = dict(enable_chunked_prefill=True,
+                   max_num_batched_tokens=4)
+    want = _oracle(params, cfg, PROMPTS, **chunked)
+    router = _router(params, cfg, 2, 1, base_kw=chunked)
+    set_fault_plan(FaultPlan.parse("seed=42;replica0:fail_step=3"))
+    outs = _serve(router, PROMPTS)
+    assert [o.outputs[0].token_ids for o in outs] == want
+    assert router.failovers.get("prefill_replica_died", 0) >= 1
+    # failover_total and the handoff series are live on /metrics
+    from vllm_omni_tpu.metrics.prometheus import render_exposition
+
+    text = render_exposition(
+        {}, {r.index: r.engine.metrics_snapshot()
+             for r in router.replicas if not r.dead},
+        resilience=resilience_metrics.snapshot(),
+        disagg=router.disagg_snapshot())
+    assert validate_exposition(text) == []
+    assert 'failover_total{reason="prefill_replica_died"}' in text
+    assert "kv_handoff_bytes_total" in text
+    # now lose the whole prefill tier: degraded-colocated, zero errors
+    set_fault_plan(None)
+    for r in router.prefills:
+        r.dead = True
+    router.step()
+    assert router.degraded
+    outs = _serve(router, PROMPTS)
+    assert not any(o.is_error for o in outs), \
+        "degraded serving produced errors a colocated engine would not"
+    assert [o.outputs[0].token_ids for o in outs] == want
+    text = render_exposition(
+        {}, {}, resilience=resilience_metrics.snapshot(),
+        disagg=router.disagg_snapshot())
+    assert 'degraded_mode 1' in text

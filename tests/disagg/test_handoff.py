@@ -1,0 +1,208 @@
+"""KV handoff protocol units: TPLA sharding, integrity guard, deadline
+clamp, and the chaos wiring on the handoff edge — no model, no engine.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.disagg import roles
+from vllm_omni_tpu.distributed.connectors import InProcConnector
+from vllm_omni_tpu.distributed.kv_transfer import (
+    KVDeadlineExceeded,
+    KVIntegrityError,
+    recv_kv,
+    ship_kv,
+)
+from vllm_omni_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedFault,
+    set_fault_plan,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    # explicit empty plan beats any ambient OMNI_TPU_FAULTS; every test
+    # leaves the process clean
+    set_fault_plan(None)
+    yield
+    set_fault_plan(None)
+
+
+def _payload(layers=3, heads=4, seq=8, dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.normal(size=(heads, seq, dim)).astype(np.float32),
+         rng.normal(size=(heads, seq, dim)).astype(np.float32))
+        for _ in range(layers)
+    ]
+
+
+def _conn():
+    import uuid
+
+    return InProcConnector(namespace=f"t-{uuid.uuid4().hex[:8]}")
+
+
+def _assert_payload_equal(a, b):
+    assert len(a) == len(b)
+    for (ka, va), (kb, vb) in zip(a, b):
+        np.testing.assert_array_equal(ka, kb)
+        np.testing.assert_array_equal(va, vb)
+
+
+# ------------------------------------------------------- TPLA sharding
+def test_shard_merge_roundtrip():
+    payload = _payload(heads=4)
+    shards = roles.shard_kv_payload(payload, 2)
+    assert len(shards) == 2
+    # each shard carries exactly its head slice — half the bytes
+    for r, shard in enumerate(shards):
+        for i, (k, v) in enumerate(shard):
+            np.testing.assert_array_equal(k, payload[i][0][2 * r:2 * r + 2])
+            assert k.nbytes == payload[i][0].nbytes // 2
+    _assert_payload_equal(roles.merge_kv_shards(shards), payload)
+
+
+def test_shard_indivisible_heads_rejected():
+    with pytest.raises(ValueError, match="cannot shard"):
+        roles.shard_kv_payload(_payload(heads=4), 3)
+
+
+def test_single_shard_is_identity():
+    payload = _payload()
+    assert roles.shard_kv_payload(payload, 1) == [payload]
+    assert roles.merge_kv_shards([payload]) == payload
+
+
+# ----------------------------------------------------- handoff transport
+def test_ship_recv_roundtrip():
+    conn, payload = _conn(), _payload()
+    n = roles.ship_handoff(conn, "r1", payload)
+    assert n > 0
+    _assert_payload_equal(roles.recv_handoff(conn, "r1", timeout=1.0),
+                          payload)
+
+
+def test_sharded_recv_single_slice():
+    """A decode TP rank fetches only its shard — the TPLA transfer
+    volume win."""
+    conn, payload = _conn(), _payload(heads=4)
+    roles.ship_handoff(conn, "r2", payload, tp_shards=2)
+    slice1 = roles.recv_handoff(conn, "r2", timeout=1.0, shard=1)
+    for i, (k, v) in enumerate(slice1):
+        np.testing.assert_array_equal(k, payload[i][0][2:4])
+        np.testing.assert_array_equal(v, payload[i][1][2:4])
+
+
+def test_sharded_recv_merges_all():
+    conn, payload = _conn(), _payload(heads=4)
+    roles.ship_handoff(conn, "r3", payload, tp_shards=2)
+    _assert_payload_equal(roles.recv_handoff(conn, "r3", timeout=1.0),
+                          payload)
+
+
+# ------------------------------------------------------ integrity guard
+def test_corrupted_layer_raises_integrity_error():
+    """Bit-flipped payload bytes fail the crc check — garbage can never
+    reach the decode tier's cache."""
+    conn, payload = _conn(), _payload()
+    ship_kv(conn, "k", payload)
+    evil = (payload[1][0] + 1.0, payload[1][1])
+    conn.put("k/L1", evil)
+    with pytest.raises(KVIntegrityError, match="checksum"):
+        recv_kv(conn, "k", timeout=1.0)
+
+
+def test_reshaped_layer_raises_integrity_error():
+    conn, payload = _conn(), _payload(seq=8)
+    ship_kv(conn, "k", payload)
+    torn = (payload[0][0][:, :4], payload[0][1][:, :4])
+    conn.put("k/L0", torn)
+    with pytest.raises(KVIntegrityError, match="shape"):
+        recv_kv(conn, "k", timeout=1.0)
+
+
+def test_wrong_dtype_raises_integrity_error():
+    conn, payload = _conn(), _payload()
+    ship_kv(conn, "k", payload)
+    conn.put("k/L2", (payload[2][0].astype(np.float64),
+                      payload[2][1].astype(np.float64)))
+    with pytest.raises(KVIntegrityError, match="dtype"):
+        recv_kv(conn, "k", timeout=1.0)
+
+
+def test_missing_layer_times_out_not_garbage():
+    """A torn stream (layer never arrives) surfaces as a timeout the
+    caller degrades on — never a partial payload."""
+    conn, payload = _conn(), _payload()
+    ship_kv(conn, "k", payload)
+    conn.cleanup("k/L1")
+    with pytest.raises(TimeoutError):
+        recv_kv(conn, "k", timeout=0.05)
+
+
+# ------------------------------------------------------- deadline clamp
+def test_expired_deadline_fails_fast_as_504():
+    """A spent end-to-end budget raises the DISTINCT deadline error
+    (504 taxonomy) immediately — not a full transport timeout later."""
+    conn = _conn()  # nothing shipped: any wait would block
+    t0 = time.monotonic()
+    with pytest.raises(KVDeadlineExceeded):
+        recv_kv(conn, "k", timeout=30.0,
+                deadline_ts=time.monotonic() - 0.01)
+    assert time.monotonic() - t0 < 1.0, "must fail fast, not wait out t"
+    assert KVDeadlineExceeded.error_kind == "deadline_exceeded"
+
+
+def test_deadline_mid_transfer_is_504():
+    """Meta arrived but a layer stalls: the wait clamps to the
+    remaining budget and dies with the deadline taxonomy."""
+    conn, payload = _conn(), _payload()
+    ship_kv(conn, "k", payload)
+    conn.cleanup("k/L2")
+    with pytest.raises(KVDeadlineExceeded):
+        recv_kv(conn, "k", timeout=30.0,
+                deadline_ts=time.monotonic() + 0.05)
+
+
+def test_flat_timeout_still_plain_timeout():
+    """Without a deadline the old contract holds: a missing payload is
+    a generic TimeoutError (the connector edge's problem)."""
+    conn = _conn()
+    with pytest.raises(TimeoutError) as ei:
+        roles.recv_handoff(conn, "never", timeout=0.05)
+    assert not isinstance(ei.value, KVDeadlineExceeded)
+
+
+# ------------------------------------------------------- chaos wiring
+def test_handoff_fault_site_fires_on_ship_and_recv():
+    set_fault_plan(FaultPlan.parse("handoff:drop_after=0"))
+    conn, payload = _conn(), _payload()
+    with pytest.raises(InjectedFault):
+        roles.ship_handoff(conn, "r", payload)
+    with pytest.raises(InjectedFault):
+        roles.recv_handoff(conn, "r", timeout=0.05)
+
+
+def test_handoff_fault_drop_pct_deterministic():
+    """Same seed, same drop schedule on the handoff edge — the chaos
+    matrix stays replayable."""
+
+    def run():
+        set_fault_plan(FaultPlan.parse("seed=3;handoff:drop_pct=0.5"))
+        conn, payload = _conn(), _payload(layers=1)
+        outcomes = []
+        for i in range(8):
+            try:
+                roles.ship_handoff(conn, f"r{i}", payload)
+                outcomes.append(True)
+            except InjectedFault:
+                outcomes.append(False)
+        return outcomes
+
+    first, second = run(), run()
+    assert first == second
+    assert not all(first) and any(first)

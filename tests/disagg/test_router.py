@@ -1,0 +1,393 @@
+"""Router units over scriptable fake engines: health-driven ejection +
+re-admission, least-loaded dispatch, drain quiesce, degradation ladder,
+bounded failover, and idempotent redelivery — no model, no jax compute.
+"""
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.disagg.router import DisaggRouter, EngineReplica
+from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.request import Request, RequestStatus
+from vllm_omni_tpu.resilience.faults import set_fault_plan
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    set_fault_plan(None)
+    yield
+    set_fault_plan(None)
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.waiting: list = []
+        self.running: list = []
+
+
+class FakeEngine:
+    """The engine surface the router touches, scriptable per test."""
+
+    def __init__(self):
+        self.scheduler = _FakeScheduler()
+        self.kv_transfer_sink = None
+        self.added: list[tuple] = []          # (rid, sp, kwargs)
+        self.outbox: list[OmniRequestOutput] = []
+        self.requests: dict[str, Request] = {}
+
+    @property
+    def has_unfinished_requests(self):
+        return bool(self.scheduler.waiting or self.scheduler.running
+                    or self.outbox)
+
+    def add_request(self, prompt_token_ids, sampling_params,
+                    request_id=None, **kwargs):
+        req = Request(request_id=request_id,
+                      prompt_token_ids=list(prompt_token_ids),
+                      sampling_params=sampling_params)
+        self.requests[request_id] = req
+        self.added.append((request_id, sampling_params, kwargs))
+        self.scheduler.running.append(req)
+        return request_id
+
+    def abort_request(self, request_id):
+        self.requests.pop(request_id, None)
+
+    def step(self):
+        out, self.outbox = self.outbox, []
+        for o in out:
+            self.scheduler.running = [
+                r for r in self.scheduler.running
+                if r.request_id != o.request_id]
+        return out
+
+    # -- test scripting -------------------------------------------------
+    def finish(self, request_id, tokens, reason="length"):
+        """Queue a finished output for the request on the next step."""
+        req = self.requests[request_id]
+        for t in tokens:
+            req.append_output_token(int(t))
+        req.status = (RequestStatus.FINISHED_STOPPED if reason == "stop"
+                      else RequestStatus.FINISHED_LENGTH)
+        self.outbox.append(OmniRequestOutput.from_pipeline(req))
+
+    def error(self, request_id, message, kind):
+        self.outbox.append(OmniRequestOutput.from_error(
+            request_id, message, kind=kind))
+        self.scheduler.running = [r for r in self.scheduler.running
+                                  if r.request_id != request_id]
+
+
+def _replica(rid, role, index):
+    return EngineReplica(rid, FakeEngine(), role, index)
+
+
+def _topology(n_prefill=1, n_decode=1, **kw):
+    prefills = [_replica(f"p{i}", "prefill", i)
+                for i in range(n_prefill)]
+    decodes = [_replica(f"d{i}", "decode", n_prefill + i)
+               for i in range(n_decode)]
+    return DisaggRouter(prefills, decodes, **kw)
+
+
+SP = SamplingParams(temperature=0.0, max_tokens=4)
+
+
+# ----------------------------------------------------- health ejection
+def test_health_ejection_and_readmission():
+    router = _topology(n_prefill=2)
+    p0, p1 = router.prefills
+    p0.health_fn = lambda: (503, {"status": "stalled"})
+    router.step()
+    assert p0.ejected and not p1.ejected
+    # dispatch skips the ejected replica
+    router.submit([1, 2, 3], SP, request_id="r1")
+    assert not p0.engine.added and p1.engine.added
+    # recovery re-admits
+    p0.health_fn = lambda: (200, {"status": "ok"})
+    router.step()
+    assert not p0.ejected
+
+
+def test_healthy_replica_gauges():
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    router = _topology(n_prefill=2, n_decode=1)
+    router.prefills[0].health_fn = lambda: (503, {})
+    router.step()
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="prefill") == 1
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="decode") == 1
+
+
+def test_ejected_replica_keeps_stepping_inflight():
+    """Ejection removes a replica from dispatch, not from stepping —
+    its in-flight work still finishes (unlike death)."""
+    router = _topology()
+    router.submit([1, 2], SP, request_id="r1")
+    p0 = router.prefills[0]
+    p0.health_fn = lambda: (503, {"status": "stalled"})
+    p0.engine.finish("r1", [7], reason="stop")  # first token hits EOS
+    router.step()
+    outs = router.poll()
+    assert [o.request_id for o in outs] == ["r1"]
+    assert not outs[0].is_error
+
+
+# -------------------------------------------------- least-loaded dispatch
+def test_least_loaded_dispatch():
+    router = _topology(n_prefill=2)
+    p0, p1 = router.prefills
+    p0.engine.scheduler.waiting = [object(), object()]  # depth 2
+    router.submit([1], SP, request_id="r1")
+    assert p1.engine.added and not p0.engine.added
+
+
+# ------------------------------------------------------------ drain mode
+def test_drain_quiesces_without_dropping_inflight():
+    router = _topology(n_prefill=1, n_decode=2)
+    router.submit([1, 2], SP, request_id="r1")
+    p0 = router.prefills[0]
+    d0, d1 = router.decodes
+    # prefill finishes; handoff adopts on the least-loaded decode (d0)
+    p0.engine.finish("r1", [5])
+    p0.engine.kv_transfer_sink(p0.engine.requests["r1"],
+                               _tiny_payload())
+    router.step()
+    assert d0.engine.added, "adoption must land on d0"
+    router.drain("d0")
+    assert not router.quiesced("d0"), "in-flight decode still running"
+    # new arrivals go to the other decode replica
+    router.submit([3, 4], SP, request_id="r2")
+    p0.engine.finish("r2", [6])
+    p0.engine.kv_transfer_sink(p0.engine.requests["r2"],
+                               _tiny_payload())
+    router.step()
+    assert any(rid == "r2" for rid, _, _ in d1.engine.added)
+    assert not any(rid == "r2" for rid, _, _ in d0.engine.added)
+    # the drained replica's in-flight decode completes — nothing dropped
+    d0.engine.finish("r1", [5, 8, 9, 10])
+    router.step()
+    assert any(o.request_id == "r1" and not o.is_error
+               for o in router.poll())
+    assert router.quiesced("d0")
+    router.undrain("d0")
+    assert d0.in_rotation
+
+
+def _tiny_payload(layers=2, heads=2, seq=2, dim=2):
+    rng = np.random.default_rng(0)
+    return [(rng.normal(size=(heads, seq, dim)).astype(np.float32),
+             rng.normal(size=(heads, seq, dim)).astype(np.float32))
+            for _ in range(layers)]
+
+
+# ------------------------------------------------------ handoff adoption
+def test_handoff_ships_and_adopts_with_first_token():
+    router = _topology()
+    router.submit([1, 2, 3], SP, request_id="r1")
+    p0, d0 = router.prefills[0], router.decodes[0]
+    (_, sp, _), = p0.engine.added
+    assert sp.max_tokens == 1, "prefill tier runs to first token only"
+    payload = _tiny_payload()
+    p0.engine.finish("r1", [9])
+    p0.engine.kv_transfer_sink(p0.engine.requests["r1"], payload)
+    router.step()
+    (rid, sp2, kwargs), = d0.engine.added
+    assert rid == "r1" and sp2.max_tokens == SP.max_tokens
+    assert kwargs["injected_first_token"] == 9
+    for (k, v), (k2, v2) in zip(payload, kwargs["injected_kv"]):
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+    assert router.handoffs == 1
+    # the decode output is the client-visible terminal
+    d0.engine.finish("r1", [9, 4, 2, 7])
+    router.step()
+    (out,) = router.poll()
+    assert out.outputs[0].token_ids == [9, 4, 2, 7]
+
+
+def test_first_token_eos_finishes_at_prefill_tier():
+    router = _topology()
+    router.submit([1, 2], SP, request_id="r1")
+    p0, d0 = router.prefills[0], router.decodes[0]
+    p0.engine.finish("r1", [3], reason="stop")
+    router.step()
+    (out,) = router.poll()
+    assert out.outputs[0].finish_reason == "stop"
+    assert not d0.engine.added, "no decode hop for a one-token stream"
+
+
+# ------------------------------------------------------ degradation ladder
+def test_no_healthy_prefill_serves_colocated_on_decode():
+    router = _topology(n_prefill=1, n_decode=1)
+    router.prefills[0].dead = True
+    router.step()
+    assert router.degraded
+    router.submit([1, 2], SP, request_id="r1")
+    (rid, sp, kwargs), = router.decodes[0].engine.added
+    assert sp.max_tokens == SP.max_tokens, "full request, not clamped"
+    assert "injected_kv" not in kwargs
+
+
+def test_no_healthy_decode_serves_colocated_on_prefill_tier():
+    router = _topology(n_prefill=1, n_decode=1)
+    router.decodes[0].dead = True
+    router.step()
+    assert router.degraded
+    router.submit([1, 2], SP, request_id="r1")
+    (rid, sp, _), = router.prefills[0].engine.added
+    assert sp.max_tokens == SP.max_tokens
+
+
+def test_nothing_healthy_sheds_with_429_taxonomy():
+    router = _topology(n_prefill=1, n_decode=1)
+    router.prefills[0].dead = True
+    router.decodes[0].dead = True
+    router.step()
+    router.submit([1, 2], SP, request_id="r1")
+    (out,) = router.poll()
+    assert out.is_error and out.error_kind == "shed"
+    assert router.sheds == 1
+
+
+# ---------------------------------------------------------- failover
+def test_dead_replica_fails_over_inflight_request():
+    router = _topology(n_prefill=2)
+    router.submit([1, 2], SP, request_id="r1")
+    src = next(r for r in router.prefills if r.engine.added)
+    other = next(r for r in router.prefills if r is not src)
+    src.dead = True
+    router.step()
+    assert any(rid == "r1" for rid, _, _ in other.engine.added), \
+        "request must be replayed on the survivor"
+    assert router.failovers.get("prefill_replica_died") == 1
+
+
+def test_failover_is_bounded_then_retryable_503():
+    router = _topology(n_prefill=2, max_failover_attempts=2)
+    router.submit([1, 2], SP, request_id="r1")
+    for r in router.replicas:
+        r.dead = True
+    # every reap re-dispatches onto... nothing healthy -> shed path is
+    # taken by _dispatch; kill decodes too so attempts burn down
+    outs = []
+    for _ in range(6):
+        router.step()
+        outs += router.poll()
+        if outs:
+            break
+    assert outs and outs[0].is_error
+    # with all replicas dead the re-dispatch sheds: either terminal is
+    # acceptable to a client (429 back off / 503 resubmit), never a hang
+    assert outs[0].error_kind in ("shed", "retryable")
+
+
+def test_internal_replica_error_fails_over():
+    router = _topology(n_prefill=2)
+    router.submit([1, 2], SP, request_id="r1")
+    src = next(r for r in router.prefills if r.engine.added)
+    other = next(r for r in router.prefills if r is not src)
+    src.engine.error("r1", "starved", kind="internal")
+    router.step()
+    assert router.failovers.get("replica_error") == 1
+    assert any(rid == "r1" for rid, _, _ in other.engine.added)
+
+
+def test_client_meaningful_errors_pass_through():
+    """400/429/504 are the client's answer — a colocated engine would
+    say the same; no failover burn."""
+    router = _topology()
+    router.submit([1, 2], SP, request_id="r1")
+    p0 = router.prefills[0]
+    p0.engine.error("r1", "prompt exceeds max_model_len",
+                    kind="invalid_request")
+    router.step()
+    (out,) = router.poll()
+    assert out.error_kind == "invalid_request"
+    assert not router.failovers
+
+
+# ------------------------------------------------- idempotent redelivery
+def test_duplicate_submit_dropped_while_inflight():
+    router = _topology()
+    p0 = router.prefills[0]
+    router.submit([1, 2], SP, request_id="r1")
+    assert not p0.submit("r1", [1, 2], SP), \
+        "redelivered id must not double-run"
+    assert len(p0.engine.added) == 1
+
+
+def test_stale_output_from_pre_failover_replica_ignored():
+    router = _topology(n_prefill=2)
+    router.submit([1, 2], SP, request_id="r1")
+    src = next(r for r in router.prefills if r.engine.added)
+    other = next(r for r in router.prefills if r is not src)
+    src.dead = True
+    router.step()  # failover to `other`
+    # the dead replica comes back and emits its stale result
+    src.revive()
+    src.engine.finish("r1", [9])
+    router.step()
+    # stale output discarded; the replay's outcome is authoritative
+    assert all(o.request_id != "r1" for o in router.poll())
+    assert any(rid == "r1" for rid, _, _ in other.engine.added)
+
+
+def test_revive_clears_submission_ledger():
+    """A revived replica must accept a resubmission of an id that was
+    stranded in its ledger when it crashed — otherwise the retryable
+    contract ('safe to resubmit') silently hangs the retry."""
+    router = _topology()
+    p0 = router.prefills[0]
+    router.submit([1, 2], SP, request_id="r1")
+    p0.dead = True
+    p0.revive()
+    assert p0.submit("r1", [1, 2], SP), \
+        "post-revive resubmission must be admitted, not swallowed"
+
+
+def test_swallowed_submit_terminates_not_hangs():
+    """A duplicate-guard drop during dispatch burns a failover attempt
+    and terminates with a client-actionable error — never a request
+    stuck in the router forever."""
+    router = _topology(n_prefill=1, max_failover_attempts=1)
+    router.prefills[0]._submitted.add("r1")  # stale ledger entry
+    router.submit([1, 2], SP, request_id="r1")
+    for _ in range(4):
+        router.step()
+    assert not router.has_unfinished, "swallowed submit must not hang"
+    (out,) = router.poll()
+    assert out.is_error and out.error_kind == "retryable"
+
+
+# -------------------------------------------------------- introspection
+def test_debugz_disagg_view():
+    """The /debug/disagg builder answers on routed AND non-routed
+    deployments (the endpoint must never 500)."""
+    from vllm_omni_tpu.introspection import debugz
+
+    class _Server:
+        pass
+
+    assert debugz.debug_disagg(_Server()) == {"enabled": False}
+    server = _Server()
+    server.router = _topology(n_prefill=1, n_decode=1)
+    doc = debugz.debug_disagg(server)
+    assert doc["enabled"] and len(doc["replicas"]) == 2
+    assert "/debug/disagg" in debugz.ENDPOINTS
+
+
+def test_debug_snapshot_shape():
+    router = _topology(n_prefill=2, n_decode=1)
+    router.submit([1, 2], SP, request_id="r1")
+    router.drain("d0")
+    snap = router.debug_snapshot()
+    assert snap["enabled"] and len(snap["replicas"]) == 3
+    roles_seen = {r["role"] for r in snap["replicas"]}
+    assert roles_seen == {"prefill", "decode"}
+    assert any(r["drained"] for r in snap["replicas"])
+    assert snap["requests"] and snap["requests"][0]["phase"]
+    assert "failovers" in snap["counters"]

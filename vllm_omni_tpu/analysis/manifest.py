@@ -44,6 +44,11 @@ HOT_PATHS: tuple[str, ...] = (
     # from other threads — a stray device sync in either would stall
     # serving exactly while an operator is debugging it
     "vllm_omni_tpu/introspection/",
+    # disaggregated serving: the router steps every replica engine on
+    # ONE thread — a stray device sync in the routing/handoff logic
+    # would stall all tiers at once (payloads are host numpy by the
+    # time they reach this layer; keep it that way)
+    "vllm_omni_tpu/disagg/",
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (
@@ -55,6 +60,12 @@ PROTOCOL_MODULES: tuple[str, ...] = (
     # literals itself today — listed so any future frame it grows is
     # linted from day one
     "vllm_omni_tpu/resilience/supervisor.py",
+    # the disagg handoff protocol (meta + per-shard layer streams) and
+    # the router consuming replica health answers — no frame literals
+    # today (payloads ride connector keys), listed so any future wire
+    # frames are linted from day one
+    "vllm_omni_tpu/disagg/roles.py",
+    "vllm_omni_tpu/disagg/router.py",
 )
 
 BENCH_PATHS: tuple[str, ...] = (

@@ -120,6 +120,8 @@ def run_check() -> list[str]:
         validate_specs,
     )
 
+    hist = {"buckets": [[0.005, 1], [0.1, 2], [float("inf"), 3]],
+            "sum": 0.2, "count": 3}
     errors = validate_specs()
     text = render_exposition(
         synthetic_summary(),
@@ -128,6 +130,19 @@ def run_check() -> list[str]:
         # process-level introspection counters (span loss + watchdog)
         process_stats={"spans_dropped": 5, "watchdog_trips": 1,
                        "watchdog_tripped": True},
+        # disaggregated serving (docs/disaggregation.md): the handoff
+        # histogram plus the router's registry-riding counters/gauges —
+        # every series the failover e2e asserts on must render here
+        disagg={"handoff_seconds": hist},
+        resilience={
+            "kv_handoff_bytes_total": [({"dir": "out"}, 8192),
+                                       ({"dir": "in"}, 8192)],
+            "failover_total": [({"reason": "prefill_replica_died"}, 1),
+                               ({"reason": "handoff_failed"}, 2)],
+            "router_healthy_replicas": [({"role": "prefill"}, 2),
+                                        ({"role": "decode"}, 1)],
+            "degraded_mode": [({}, 0)],
+        },
     )
     errors += validate_exposition(text)
     return errors

@@ -126,6 +126,10 @@ class KVCacheManager:
         self.parked_tokens = 0
         self.offload_evictions = 0
         self.drop_evictions = 0
+        # prompt tokens whose KV arrived as a STREAMED payload from a
+        # peer engine (disaggregated prefill adoption, docs/
+        # disaggregation.md) rather than being computed here
+        self.streamed_tokens = 0
 
     # ------------------------------------------------------------- queries
     def _pinned_pages(self) -> set[int]:
@@ -197,6 +201,7 @@ class KVCacheManager:
                 "parked_tokens": self.parked_tokens,
                 "offload_evictions": self.offload_evictions,
                 "drop_evictions": self.drop_evictions,
+                "streamed_tokens": self.streamed_tokens,
             },
         }
 
@@ -382,6 +387,23 @@ class KVCacheManager:
                 del self._tables[rid]
             return None
         return list(table)
+
+    def adopt_streamed(self, request: Request, n_tokens: int
+                       ) -> Optional[list[int]]:
+        """Streamed-page admission (disaggregated prefill): allocate
+        pages for ``n_tokens`` of KV that a PEER engine computed and is
+        about to inject — the decode tier's receive half.  Same failure
+        contract as ``allocate`` (None = out of pages, side-effect
+        free); the caller injects the payload before any forward
+        attends the pages, then calls ``note_streamed`` — counting at
+        allocation would claim tokens the injection later rejected."""
+        return self.allocate(request, n_tokens)
+
+    def note_streamed(self, n_tokens: int) -> None:
+        """Count tokens whose KV actually INJECTED from a peer engine
+        (vs. prefix-cache or tier-restore adoption) — /debug/kv's
+        answer to where a decode tier's KV came from."""
+        self.streamed_tokens += n_tokens
 
     def slot_mapping(self, request: Request, num_new_tokens: int) -> list[int]:
         """Flat slots (page*page_size + offset) for the next

@@ -234,7 +234,14 @@ class ARScheduler:
         if request.deadline_ts is not None:
             self._deadlines_possible = True
         request.status = RequestStatus.WAITING
-        if self.config.kv_transfer is not None:
+        # per-request opt-out: a disagg router placing a request
+        # COLOCATED on a prefill-role engine (degraded mode) suppresses
+        # the transfer — the whole-prompt extraction would produce a
+        # payload nobody consumes, exactly in the capacity-constrained
+        # state the degradation ladder exists for
+        if (self.config.kv_transfer is not None
+                and not request.additional_information.get(
+                    "disable_kv_transfer")):
             request.kv_transfer = KVTransferState.PENDING
         self.waiting.append(request)
 

@@ -1,0 +1,39 @@
+"""Disaggregated prefill/decode serving (docs/disaggregation.md).
+
+Prefill and decode as first-class engine roles
+(``EngineConfig.engine_role``), per-layer TPLA-sharded KV handoff
+between the tiers (``roles``), and the fault-tolerance machinery that
+makes the split survivable (``router``: health-driven ejection,
+least-loaded dispatch, bounded-retry failover, graceful degradation
+back to colocated serving, drain mode).  ``service`` wraps an in-proc
+topology in the AsyncOmni-shaped async contract.
+"""
+
+from vllm_omni_tpu.disagg.roles import (  # noqa: F401
+    ROLE_COLOCATED,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    ROLES,
+    adopt_prefill,
+    handoff_key,
+    merge_kv_shards,
+    recv_handoff,
+    shard_kv_payload,
+    ship_handoff,
+)
+from vllm_omni_tpu.disagg.router import (  # noqa: F401
+    DisaggRouter,
+    EngineReplica,
+)
+from vllm_omni_tpu.disagg.service import (  # noqa: F401
+    DisaggService,
+    build_inproc_router,
+)
+
+__all__ = [
+    "ROLES", "ROLE_PREFILL", "ROLE_DECODE", "ROLE_COLOCATED",
+    "handoff_key", "shard_kv_payload", "merge_kv_shards",
+    "ship_handoff", "recv_handoff", "adopt_prefill",
+    "DisaggRouter", "EngineReplica", "DisaggService",
+    "build_inproc_router",
+]

@@ -1,0 +1,201 @@
+"""Engine roles + the prefill→decode KV handoff protocol.
+
+Disaggregated prefill/decode serving (docs/disaggregation.md) splits
+one engine into two tiers: PREFILL engines run requests to the end of
+prompt processing (plus the first sampled token) and ship the paged KV
+per-layer through ``distributed/kv_transfer.py``; DECODE engines adopt
+the streamed pages into their ``KVCacheManager`` and resume through the
+decode executable — the PR 6 resume-as-decode rule, which is what keeps
+a disaggregated greedy stream bit-identical to the colocated oracle
+(prefill tier and oracle share the full-prompt prefill executable;
+decode tier and oracle share the decode executable; no position is ever
+computed by a third shape).
+
+TPLA-style sharding ("TPLA: Tensor Parallel Latent Attention for
+Efficient Disaggregated Prefill and Decode Inference", PAPERS.md): the
+transferred KV is sharded along the tensor-parallel axis — the KV-head
+axis of the dense [Hkv, seq, D] payload — so each decode shard receives
+only its slice, cutting per-link transfer volume by the TP degree.
+Shards ship under ``{key}/tp{r}`` and a top-level ``{key}/meta`` names
+the shard count, so a decode rank fetches exactly one subkey family.
+
+``fault_point("handoff")`` wraps both directions: the chaos matrix
+(resilience/faults.py) injects drops/delays on this edge exactly like
+any other connector edge, deterministic and seeded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from vllm_omni_tpu.distributed.connectors import OmniConnectorBase
+from vllm_omni_tpu.distributed.kv_transfer import (
+    KVDeadlineExceeded,
+    KVIntegrityError,
+    recv_kv,
+    ship_kv,
+)
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.resilience.faults import fault_point
+from vllm_omni_tpu.resilience.retry import RetryPolicy
+
+logger = init_logger(__name__)
+
+#: valid EngineConfig.engine_role values
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_COLOCATED = "colocated"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_COLOCATED)
+
+# handoff puts/gets ride the same shallow retry stance as kv_transfer:
+# the router's failover IS the outer retry layer
+_HANDOFF_RETRY = RetryPolicy(max_attempts=2)
+
+
+def handoff_key(request_id: str) -> str:
+    """Connector key family of one request's prefill→decode handoff."""
+    return f"disagg/{request_id}"
+
+
+# ------------------------------------------------------- TPLA sharding
+def shard_kv_payload(payload: list, num_shards: int) -> list[list]:
+    """Split a dense per-layer [(k, v)] payload ([Hkv, seq, D] arrays)
+    into ``num_shards`` slices along the KV-head (tensor-parallel)
+    axis.  Requires Hkv % num_shards == 0 — the same divisibility the
+    TP attention sharding itself requires."""
+    if num_shards <= 1:
+        return [payload]
+    heads = int(np.asarray(payload[0][0]).shape[0])
+    if heads % num_shards:
+        raise ValueError(
+            f"cannot shard {heads} KV heads into {num_shards} slices")
+    per = heads // num_shards
+    return [
+        [(k[r * per:(r + 1) * per], v[r * per:(r + 1) * per])
+         for k, v in payload]
+        for r in range(num_shards)
+    ]
+
+
+def merge_kv_shards(shards: list[list]) -> list:
+    """Inverse of ``shard_kv_payload``: concatenate per-layer slices
+    back along the KV-head axis (shards in rank order)."""
+    if len(shards) == 1:
+        return shards[0]
+    return [
+        (np.concatenate([s[i][0] for s in shards], axis=0),
+         np.concatenate([s[i][1] for s in shards], axis=0))
+        for i in range(len(shards[0]))
+    ]
+
+
+# ----------------------------------------------------- handoff ship/recv
+def ship_handoff(conn: OmniConnectorBase, request_id: str,
+                 payload: list, tp_shards: int = 1,
+                 retry: Optional[RetryPolicy] = None) -> int:
+    """Ship one request's prefill KV to the decode tier: TP-shard the
+    payload, put each shard's layer stream plus a top-level meta naming
+    the shard count.  Returns total bytes shipped.  Raises the
+    transport's ConnectionError/TimeoutError family on failure — the
+    router maps that to failover/recompute."""
+    from vllm_omni_tpu.resilience.retry import call_with_retry
+
+    fault_point("handoff")
+    retry = retry or _HANDOFF_RETRY
+    key = handoff_key(request_id)
+    shards = shard_kv_payload(payload, tp_shards)
+    # the meta put retries like every sibling put — one transient blip
+    # here would otherwise discard the whole prefill result
+    total = call_with_retry(
+        lambda: conn.put(f"{key}/meta", {"tp_shards": len(shards)}),
+        site=f"handoff:{key}/meta", policy=retry)
+    for r, shard in enumerate(shards):
+        total += ship_kv(conn, f"{key}/tp{r}", shard, retry=retry)
+    return total
+
+
+def recv_handoff(conn: OmniConnectorBase, request_id: str,
+                 timeout: float = 30.0,
+                 deadline_ts: Optional[float] = None,
+                 shard: Optional[int] = None,
+                 retry: Optional[RetryPolicy] = None) -> list:
+    """Receive one request's handoff.  ``shard`` fetches exactly one TP
+    slice (a decode TP rank pulls only its slice — the TPLA bandwidth
+    win); None fetches and merges every shard (the single-controller
+    in-proc topology).  Integrity violations raise ``KVIntegrityError``
+    and a spent end-to-end budget raises ``KVDeadlineExceeded`` — the
+    caller degrades to recompute or 504, never injects garbage."""
+    from vllm_omni_tpu.resilience.deadline import clamp_timeout, expired
+    from vllm_omni_tpu.resilience.retry import call_with_retry
+
+    fault_point("handoff")
+    retry = retry or _HANDOFF_RETRY
+    key = handoff_key(request_id)
+    # retried like every other operation on this edge: one transient
+    # blip at the meta get must not discard a shipped prefill result
+    meta = call_with_retry(
+        lambda: conn.get(f"{key}/meta",
+                         timeout=clamp_timeout(timeout, deadline_ts)),
+        site=f"handoff:{key}/meta", policy=retry,
+        deadline_ts=deadline_ts)
+    if meta is None:
+        if expired(deadline_ts):
+            raise KVDeadlineExceeded(
+                f"handoff {key}: deadline exceeded waiting for meta")
+        raise TimeoutError(f"handoff {key}: meta missing within "
+                           f"{timeout:.1f}s")
+    n = int(meta.get("tp_shards", 1))
+    if shard is not None:
+        return recv_kv(conn, f"{key}/tp{shard}", timeout,
+                       retry=retry, deadline_ts=deadline_ts)
+    shards = [recv_kv(conn, f"{key}/tp{r}", timeout, retry=retry,
+                      deadline_ts=deadline_ts)
+              for r in range(n)]
+    return merge_kv_shards(shards)
+
+
+def cleanup_handoff(conn: OmniConnectorBase, request_id: str,
+                    num_layers: int, tp_shards: int = 1) -> None:
+    """Best-effort cleanup of a handoff that will never be consumed
+    (adoption failed, request finished at prefill) so abandoned
+    payloads don't accumulate in the connector store."""
+    key = handoff_key(request_id)
+    try:
+        conn.cleanup(f"{key}/meta")
+        for r in range(max(tp_shards, 1)):
+            conn.cleanup(f"{key}/tp{r}/meta")
+            for i in range(num_layers):
+                conn.cleanup(f"{key}/tp{r}/L{i}")
+    except Exception:  # cleanup must never mask the original failure
+        logger.debug("handoff cleanup failed for %s", request_id,
+                     exc_info=True)
+
+
+# ------------------------------------------------------------- adoption
+def adopt_prefill(engine, request_id: str, prompt_token_ids: list[int],
+                  first_token: int, payload: list,
+                  sampling_params, deadline_ts: Optional[float] = None,
+                  additional_information: Optional[dict[str, Any]] = None,
+                  ) -> str:
+    """Decode-side adoption: admit the request with the streamed
+    full-prompt KV plus the prefill tier's first sampled token, so it
+    resumes through the DECODE executable (scheduler resume-as-decode).
+    A payload the engine rejects (layer-count/shape mismatch) degrades
+    to local recompute inside ``_inject_prefix_kv`` — adoption never
+    errors a request that recompute could still serve."""
+    return engine.add_request(
+        prompt_token_ids, sampling_params, request_id=request_id,
+        injected_kv=payload, injected_first_token=first_token,
+        deadline_ts=deadline_ts,
+        additional_information=dict(additional_information or {}),
+    )
+
+
+__all__ = [
+    "ROLES", "ROLE_PREFILL", "ROLE_DECODE", "ROLE_COLOCATED",
+    "handoff_key", "shard_kv_payload", "merge_kv_shards",
+    "ship_handoff", "recv_handoff", "cleanup_handoff", "adopt_prefill",
+    "KVIntegrityError", "KVDeadlineExceeded",
+]

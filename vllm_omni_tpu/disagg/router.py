@@ -1,0 +1,673 @@
+"""Request router over N prefill + M decode engine replicas.
+
+The fault-tolerance half of disaggregated serving
+(docs/disaggregation.md): splitting one engine into two tiers doubles
+the ways a request can die — a prefill replica crashing mid-stream, a
+KV handoff stalling, a decode tier with no healthy peers — so the
+router owns the machinery that makes the topology survivable:
+
+- **health-driven ejection**: every step consumes each replica's honest
+  health answer (PR 8 semantics: 503 = stalled/dead); unhealthy
+  replicas leave the dispatch rotation and re-admit on recovery.
+- **least-loaded dispatch**: among healthy, undrained replicas of a
+  tier, the one with the smallest queue depth wins (the same signal
+  ``request_queue_depth``/``phase_saturation_ratio`` export).
+- **bounded-retry failover**: a request whose prefill replica dies is
+  replayed on a surviving one — idempotent via request id, mirroring
+  the supervisor's exactly-once redelivery (a replica that already
+  completed the id returns its cached outcome instead of recomputing).
+  A decode-side adoption that times out or fails its integrity check
+  degrades to local recompute instead of erroring — the PR 6
+  lost-payload path generalized across hosts.
+- **degradation ladder**: when the peer tier has zero healthy replicas
+  the router falls back to colocated serving on whichever tier
+  survives (``degraded_mode`` 0/1 on /metrics); with NO healthy
+  replica anywhere, arrivals shed with the PR 7 429 taxonomy.  Drain
+  mode quiesces a replica for rolling restarts without dropping its
+  in-flight decodes.
+
+Failure semantics: an EJECTED (unhealthy) replica keeps stepping its
+in-flight work — ejection only stops NEW dispatch; only a DEAD replica
+(crashed step) triggers failover of its in-flight requests.  Replica
+crash detection is exception-based: any exception escaping a replica's
+step — including ``InjectedFault`` from the ``replica{N}`` chaos sites
+— marks it dead.
+
+Counters ride the process-global resilience registry
+(``failover_total{reason}``, ``kv_handoff_bytes_total{dir}``,
+``router_healthy_replicas{role}``, ``degraded_mode``) so any /metrics
+render in the process shows them; the ``kv_handoff_seconds`` histogram
+renders through the exposition's ``disagg`` block.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+from vllm_omni_tpu.disagg import roles
+from vllm_omni_tpu.disagg.roles import (
+    ROLE_COLOCATED,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+)
+from vllm_omni_tpu.distributed.connectors import (
+    ConnectorFactory,
+    OmniConnectorBase,
+)
+from vllm_omni_tpu.distributed.kv_transfer import KVDeadlineExceeded
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.metrics.stats import Histogram
+from vllm_omni_tpu.outputs import OmniRequestOutput
+from vllm_omni_tpu.resilience.deadline import (
+    DEADLINE_EXCEEDED,
+    RETRYABLE,
+    expiry_ts,
+    remaining_s,
+)
+from vllm_omni_tpu.resilience.faults import fault_point
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+#: handoff-latency buckets (seconds) — in-proc handoffs land in the
+#: sub-ms buckets, cross-host ones in the tail
+HANDOFF_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class EngineReplica:
+    """One engine behind the router: role + liveness + idempotent
+    submission.  ``index`` is process-wide (prefill replicas first) and
+    names the replica's chaos site ``replica{index}``
+    (resilience/faults.py) — ``fail_step``/``drop_after`` there crash
+    the replica in-proc (``kill_after`` stays a process-level fault for
+    real worker processes)."""
+
+    def __init__(self, replica_id: str, engine, role: str, index: int):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.role = role
+        self.index = index
+        self.dead = False
+        self.ejected = False     # health-driven: out of dispatch rotation
+        self.drained = False     # operator-driven: quiescing for restart
+        self.death_reason: Optional[str] = None
+        # test hook: override the health probe ((code, body) like the
+        # server's /health) to fake LB-visible state transitions
+        self.health_fn = None
+        # exactly-once submission ledger: a redelivered id the engine
+        # already saw is dropped (mirrors the supervisor's worker-side
+        # seen_ids dedup)
+        self._submitted: set[str] = set()
+
+    # ------------------------------------------------------------ probes
+    @property
+    def queue_depth(self) -> int:
+        s = self.engine.scheduler
+        return len(s.waiting) + len(s.running)
+
+    @property
+    def in_rotation(self) -> bool:
+        return not (self.dead or self.ejected or self.drained)
+
+    def health(self) -> tuple[int, dict]:
+        """The replica's honest health answer (PR 8 semantics): 503
+        once dead — a load balancer must eject a wedged replica, and
+        the router consumes the same contract."""
+        if self.health_fn is not None:
+            return self.health_fn()
+        if self.dead:
+            return 503, {"status": "dead",
+                         "reason": self.death_reason}
+        return 200, {"status": "ok", "role": self.role,
+                     "queue_depth": self.queue_depth}
+
+    @property
+    def quiesced(self) -> bool:
+        """True when a draining replica finished its in-flight work and
+        can be restarted without dropping anything."""
+        return not self.engine.has_unfinished_requests
+
+    # ----------------------------------------------------------- serving
+    def submit(self, request_id: str, prompt_token_ids: list[int],
+               sampling_params: SamplingParams, **kwargs) -> bool:
+        """Idempotent add_request: a duplicate id (failover replay
+        racing a slow original, supervisor-style redelivery) is dropped
+        — the first submission's outcome stands."""
+        if self.dead:
+            raise ConnectionError(
+                f"replica {self.replica_id} is dead")
+        if request_id in self._submitted:
+            return False
+        self._submitted.add(request_id)
+        self.engine.add_request(prompt_token_ids, sampling_params,
+                                request_id=request_id, **kwargs)
+        return True
+
+    def abort(self, request_id: str) -> None:
+        if not self.dead:
+            self.engine.abort_request(request_id)
+
+    def step(self) -> list[OmniRequestOutput]:
+        """One engine step under the replica's chaos site.  ANY escape
+        — injected or real — marks the replica dead: a half-stepped
+        engine's state can no longer be trusted, exactly like a crashed
+        worker process; the router fails its requests over."""
+        if self.dead:
+            return []
+        try:
+            fault_point(f"replica{self.index}")
+            if not self.engine.has_unfinished_requests:
+                return []
+            return self.engine.step()
+        except Exception as e:
+            self.dead = True
+            self.death_reason = f"{type(e).__name__}: {e}"
+            logger.warning("replica %s died: %s", self.replica_id,
+                           self.death_reason)
+            return []
+
+    def revive(self) -> None:
+        """Operator/test hook: bring a crashed replica back (the
+        in-proc analogue of a supervisor restart).  Its engine state is
+        whatever survived the crash — in-flight requests were already
+        failed over, so only NEW dispatch lands here.  The submission
+        ledger clears with the death: ids stranded in it would
+        otherwise silently swallow a post-revive resubmission of the
+        same request id."""
+        self.dead = False
+        self.death_reason = None
+        self._submitted.clear()
+
+
+@dataclass
+class _ReqCtx:
+    """Router-side lifecycle of one request across the tiers."""
+
+    request_id: str
+    prompt_token_ids: list[int]
+    sampling_params: SamplingParams
+    info: dict[str, Any] = field(default_factory=dict)
+    deadline_ts: Optional[float] = None
+    # "prefill" -> "handoff" -> "decode"; degraded/recompute paths run
+    # as "colocated" on whichever replica took them
+    phase: str = ROLE_PREFILL
+    replica: Optional[EngineReplica] = None
+    attempts: int = 0
+    first_token: Optional[int] = None
+    # finish metadata captured from the prefill output when the request
+    # terminates at the prefill tier (max_tokens==1 / EOS first token)
+    handoff_since_step: int = 0
+
+
+class DisaggRouter:
+    def __init__(self, prefills: list[EngineReplica],
+                 decodes: list[EngineReplica],
+                 connector: Optional[OmniConnectorBase] = None,
+                 tp_shards: int = 1,
+                 max_failover_attempts: int = 3,
+                 handoff_timeout_s: float = 5.0,
+                 payload_wait_steps: int = 16):
+        self.prefills = list(prefills)
+        self.decodes = list(decodes)
+        self.replicas = self.prefills + self.decodes
+        if not self.replicas:
+            raise ValueError("router needs at least one replica")
+        # the handoff transport; in-proc topologies default to a
+        # private inproc namespace (the router ships then receives —
+        # same put-then-get shape Omni._forward uses for stage edges)
+        self.connector = connector or ConnectorFactory.create(
+            "inproc", namespace=f"disagg-{uuid.uuid4().hex[:8]}")
+        self.tp_shards = tp_shards
+        self.max_failover_attempts = max_failover_attempts
+        self.handoff_timeout_s = handoff_timeout_s
+        self.payload_wait_steps = payload_wait_steps
+        self._ctx: dict[str, _ReqCtx] = {}
+        self._finished: list[OmniRequestOutput] = []
+        # prefill engines hand their extracted payloads to the router
+        # (not the stage-output rider): keyed by request id until the
+        # handoff ships
+        self._payloads: dict[str, list] = {}
+        for r in self.prefills:
+            r.engine.kv_transfer_sink = self._kv_sink
+        self.handoff_seconds = Histogram(buckets=HANDOFF_BUCKETS_S)
+        # same-address-space fast path (the Omni._forward stance): a
+        # zero_copy connector hands the host arrays over without the
+        # serialize->store->deserialize round trip — which would
+        # otherwise run on the ONE thread stepping every replica.  The
+        # handoff chaos site still fires on this path, and
+        # OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION=1 pins the full wire
+        # path (integrity/corruption tests ride it).  Read once: the
+        # flag can't change after process start.
+        import os
+
+        self._zero_copy = (
+            getattr(self.connector, "zero_copy", False)
+            and os.environ.get(
+                "OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION") != "1")
+        # lifetime ledgers (also mirrored into the resilience registry
+        # for /metrics): handoffs completed, failovers per reason, sheds
+        self.handoffs = 0
+        self.failovers: dict[str, int] = {}
+        self.sheds = 0
+        self.degraded = False
+        self._steps = 0
+        self._refresh_health()
+
+    # ------------------------------------------------------------- sinks
+    def _kv_sink(self, request, payload: list) -> None:
+        self._payloads[request.request_id] = payload
+
+    # ------------------------------------------------------------ health
+    def _refresh_health(self) -> None:
+        """Probe every replica's /health contract; eject non-200s from
+        rotation, re-admit recovered ones, refresh the tier gauges."""
+        for r in self.replicas:
+            try:
+                code, _ = r.health()
+            except Exception:
+                code = 503
+            healthy = code == 200 and not r.dead
+            if healthy and r.ejected:
+                logger.info("replica %s recovered; re-admitting",
+                            r.replica_id)
+            r.ejected = not healthy
+        for role, pool in ((ROLE_PREFILL, self.prefills),
+                           (ROLE_DECODE, self.decodes)):
+            if pool:
+                resilience_metrics.set_gauge(
+                    "router_healthy_replicas",
+                    sum(1 for r in pool if r.in_rotation), role=role)
+        self.degraded = bool(
+            (self.prefills and not self._healthy(self.prefills))
+            or (self.decodes and not self._healthy(self.decodes)))
+        resilience_metrics.set_gauge("degraded_mode",
+                                     1 if self.degraded else 0)
+
+    def _healthy(self, pool: list[EngineReplica]
+                 ) -> list[EngineReplica]:
+        return [r for r in pool if r.in_rotation]
+
+    def _pick(self, pool: list[EngineReplica],
+              avoid: Optional[EngineReplica] = None
+              ) -> Optional[EngineReplica]:
+        """Least-loaded healthy replica of ``pool`` (stable on ties).
+        ``avoid`` steers a failover replay away from the replica that
+        just failed the request — unless it is the only one left."""
+        healthy = self._healthy(pool)
+        if avoid is not None:
+            healthy = [r for r in healthy if r is not avoid] or healthy
+        if not healthy:
+            return None
+        return min(healthy, key=lambda r: r.queue_depth)
+
+    # -------------------------------------------------------- drain mode
+    def drain(self, replica_id: str) -> None:
+        """Quiesce a replica for a rolling restart: it leaves the
+        dispatch rotation but KEEPS stepping until its in-flight
+        requests finish (``quiesced(replica_id)`` says when)."""
+        self._replica(replica_id).drained = True
+
+    def undrain(self, replica_id: str) -> None:
+        self._replica(replica_id).drained = False
+
+    def quiesced(self, replica_id: str) -> bool:
+        return self._replica(replica_id).quiesced
+
+    def _replica(self, replica_id: str) -> EngineReplica:
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                return r
+        raise KeyError(f"unknown replica {replica_id!r}")
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt_token_ids: list[int],
+               sampling_params: Optional[SamplingParams | dict] = None,
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               additional_information: Optional[dict] = None) -> str:
+        """Route one request.  Healthy prefill + decode tiers run the
+        disaggregated path; a missing tier degrades to colocated on the
+        survivor; nothing healthy sheds with the 429 taxonomy (the
+        server is not serving — backing off is the client's move)."""
+        if request_id is None:
+            request_id = f"disagg-{uuid.uuid4().hex[:12]}"
+        sp = self._normalize_sp(sampling_params)
+        ctx = _ReqCtx(
+            request_id=request_id,
+            prompt_token_ids=list(prompt_token_ids),
+            sampling_params=sp,
+            info=dict(additional_information or {}),
+            deadline_ts=expiry_ts(deadline_s),
+        )
+        self._ctx[request_id] = ctx
+        self._dispatch(ctx)
+        return request_id
+
+    @staticmethod
+    def _normalize_sp(sp) -> SamplingParams:
+        if isinstance(sp, SamplingParams):
+            return sp
+        known = SamplingParams.__dataclass_fields__
+        return SamplingParams(**{k: v for k, v in (sp or {}).items()
+                                 if k in known})
+
+    def _dispatch(self, ctx: _ReqCtx,
+                  avoid: Optional[EngineReplica] = None) -> None:
+        """(Re)place a request on the topology according to the
+        degradation ladder."""
+        prefill = self._pick(self.prefills, avoid=avoid)
+        decode = self._pick(self.decodes, avoid=avoid)
+        if prefill is not None and decode is not None:
+            # the disaggregated fast path: prompt processing + first
+            # token on the prefill tier (max_tokens clamped to 1 — the
+            # decode tier owns the rest of the stream)
+            ctx.phase = ROLE_PREFILL
+            ctx.replica = prefill
+            self._submit_to(prefill, ctx,
+                            replace(ctx.sampling_params, max_tokens=1))
+            return
+        survivor = decode or prefill or self._pick(self.replicas,
+                                                   avoid=avoid)
+        if survivor is None:
+            # nothing healthy anywhere: shed per the PR 7 taxonomy —
+            # 429, distinct from 503 (broke mid-request) and 504
+            # (budget spent)
+            self.sheds += 1
+            self._finish(ctx, OmniRequestOutput.from_error(
+                ctx.request_id,
+                "no healthy replica in any tier; retry with backoff",
+                kind="shed"))
+            return
+        ctx.phase = ROLE_COLOCATED
+        ctx.replica = survivor
+        self._submit_to(survivor, ctx, ctx.sampling_params,
+                        suppress_kv_transfer=True)
+
+    def _submit_to(self, replica: EngineReplica, ctx: _ReqCtx,
+                   sp: SamplingParams,
+                   suppress_kv_transfer: bool = False,
+                   **kwargs) -> None:
+        # deadline re-stamped across every hop: the remaining budget is
+        # re-derived and converted back to an expiry, the same dance
+        # the orchestrator does on stage handoffs — a slow prefill tier
+        # shrinks what the decode tier gets
+        info = dict(ctx.info)
+        if suppress_kv_transfer:
+            # colocated placement on a prefill-role engine: nobody
+            # will consume an extracted payload — don't pay the
+            # whole-prompt device→host copy for it
+            info["disable_kv_transfer"] = True
+        try:
+            accepted = replica.submit(
+                ctx.request_id, ctx.prompt_token_ids, sp,
+                deadline_ts=expiry_ts(remaining_s(ctx.deadline_ts)),
+                additional_information=info, **kwargs)
+        except Exception:
+            # replica died between pick and submit: re-route
+            self._failover(ctx, "dispatch_failed")
+            return
+        if not accepted:
+            # the duplicate guard swallowed the id (a stale ledger
+            # entry, e.g. the replica crashed with it in flight and
+            # was revived): a silently-dropped submit would hang the
+            # request forever — treat it as a failed dispatch instead
+            self._failover(ctx, "dispatch_failed")
+
+    # -------------------------------------------------------------- step
+    def step(self) -> None:
+        """One router tick: refresh health, step every live replica
+        (drained and ejected ones included — their in-flight work must
+        finish), route outputs, ship pending handoffs, fail over
+        requests stranded on dead replicas."""
+        self._steps += 1
+        self._refresh_health()
+        for replica in self.replicas:
+            for out in replica.step():
+                self._on_output(replica, out)
+        self._pump_handoffs()
+        self._reap_dead()
+
+    def poll(self) -> list[OmniRequestOutput]:
+        out, self._finished = self._finished, []
+        return out
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self._ctx)
+
+    # ------------------------------------------------------ output logic
+    def _finish(self, ctx: _ReqCtx, out: OmniRequestOutput) -> None:
+        self._ctx.pop(ctx.request_id, None)
+        self._payloads.pop(ctx.request_id, None)
+        self._finished.append(out)
+
+    def _on_output(self, replica: EngineReplica,
+                   out: OmniRequestOutput) -> None:
+        # the id's run on THIS replica is over: lift the duplicate
+        # guard so a later failover may legitimately replay it here
+        # (the guard exists for concurrent duplicates, not history)
+        replica._submitted.discard(out.request_id)
+        ctx = self._ctx.get(out.request_id)
+        if ctx is None or ctx.replica is not replica:
+            # stale output from a pre-failover replica: the replay's
+            # outcome is authoritative, this one is discarded (the
+            # idempotency contract)
+            return
+        if out.is_error:
+            # client-meaningful taxonomy passes through (400/429/504 —
+            # a colocated engine would answer the same); an INTERNAL
+            # error is a replica-scoped failure and fails over like a
+            # crash (bounded)
+            if out.error_kind in ("invalid_request", "shed",
+                                  DEADLINE_EXCEEDED):
+                self._finish(ctx, out)
+            else:
+                self._failover(ctx, "replica_error")
+            return
+        if ctx.phase == ROLE_PREFILL:
+            toks = out.outputs[0].token_ids if out.outputs else []
+            reason = (out.outputs[0].finish_reason
+                      if out.outputs else None)
+            if not toks:
+                self._failover(ctx, "prefill_no_token")
+                return
+            ctx.first_token = int(toks[0])
+            if (ctx.sampling_params.max_tokens <= 1
+                    or reason == "stop"):
+                # the stream is already complete at the prefill tier
+                # (one-token request, or the first token hit EOS/stop):
+                # the prefill output IS the final answer
+                self._finish(ctx, out)
+                return
+            ctx.phase = "handoff"
+            ctx.handoff_since_step = self._steps
+            return
+        # decode or colocated: terminal
+        self._finish(ctx, out)
+
+    # ----------------------------------------------------------- handoff
+    def _pump_handoffs(self) -> None:
+        """Ship extracted prefill KV to the decode tier and adopt it.
+        Every failure on this edge degrades — recompute on the decode
+        tier, never a dropped or corrupted request."""
+        for ctx in [c for c in self._ctx.values()
+                    if c.phase == "handoff"]:
+            payload = self._payloads.pop(ctx.request_id, None)
+            if payload is None:
+                # extraction still in flight on the prefill engine; a
+                # dead replica is handled by _reap_dead, a stuck
+                # extraction by the bounded wait
+                if (self._steps - ctx.handoff_since_step
+                        > self.payload_wait_steps):
+                    self._adopt_or_recompute(ctx, None,
+                                             "payload_stalled")
+                continue
+            zero_copy = self._zero_copy
+            t0 = time.perf_counter()
+            received = None
+            try:
+                if zero_copy:
+                    fault_point("handoff")
+                    n = sum(int(k.nbytes) + int(v.nbytes)
+                            for k, v in payload)
+                    received = payload
+                else:
+                    n = roles.ship_handoff(
+                        self.connector, ctx.request_id, payload,
+                        tp_shards=self.tp_shards)
+                    resilience_metrics.inc("kv_handoff_bytes_total",
+                                           n, dir="out")
+                    received = roles.recv_handoff(
+                        self.connector, ctx.request_id,
+                        timeout=self.handoff_timeout_s,
+                        deadline_ts=ctx.deadline_ts)
+                if zero_copy:
+                    resilience_metrics.inc("kv_handoff_bytes_total",
+                                           n, dir="out")
+                resilience_metrics.inc("kv_handoff_bytes_total", n,
+                                       dir="in")
+            except KVDeadlineExceeded:
+                # the budget died in transit: 504, not a connector
+                # timeout — and not a recompute the client stopped
+                # waiting for
+                roles.cleanup_handoff(self.connector, ctx.request_id,
+                                      len(payload), self.tp_shards)
+                from vllm_omni_tpu.resilience.deadline import (
+                    deadline_output,
+                )
+
+                self._finish(ctx, deadline_output(
+                    ctx.request_id, 0, "KV handoff"))
+                continue
+            except Exception as e:
+                logger.warning(
+                    "handoff for %s failed (%s: %s); decode tier "
+                    "recomputes", ctx.request_id, type(e).__name__, e)
+                roles.cleanup_handoff(self.connector, ctx.request_id,
+                                      len(payload), self.tp_shards)
+            if received is not None:
+                # delivered handoffs only: a failed transfer's
+                # timeout-to-give-up is not a handoff latency — it
+                # would bury the real p99 under timeout spikes
+                self.handoff_seconds.observe(time.perf_counter() - t0)
+            self._adopt_or_recompute(
+                ctx, received,
+                None if received is not None else "handoff_failed")
+
+    def _adopt_or_recompute(self, ctx: _ReqCtx,
+                            payload: Optional[list],
+                            fail_reason: Optional[str]) -> None:
+        """Place the post-prefill remainder on the decode tier: adopt
+        the streamed KV when it arrived intact, else recompute the
+        whole prompt locally (greedy recompute re-derives the same
+        stream — the lost-payload contract)."""
+        decode = self._pick(self.decodes) or self._pick(self.prefills)
+        if decode is None:
+            self._failover(ctx, "no_decode_tier")
+            return
+        if fail_reason is not None:
+            self._note_failover(fail_reason)
+        ctx.phase = ROLE_DECODE if payload is not None \
+            else ROLE_COLOCATED
+        ctx.replica = decode
+        try:
+            if payload is not None:
+                roles.adopt_prefill(
+                    decode.engine, ctx.request_id,
+                    ctx.prompt_token_ids, ctx.first_token, payload,
+                    ctx.sampling_params,
+                    deadline_ts=expiry_ts(remaining_s(ctx.deadline_ts)),
+                    additional_information=ctx.info)
+                decode._submitted.add(ctx.request_id)
+                self.handoffs += 1
+            else:
+                # full local recompute: first token re-derived too, so
+                # the stream matches what a colocated engine serves
+                # (kv_transfer suppressed — the fallback target may be
+                # a prefill-role survivor and nobody consumes it)
+                self._submit_to(decode, ctx, ctx.sampling_params,
+                                suppress_kv_transfer=True)
+        except Exception:
+            self._failover(ctx, "adoption_failed")
+
+    # ---------------------------------------------------------- failover
+    def _note_failover(self, reason: str) -> None:
+        self.failovers[reason] = self.failovers.get(reason, 0) + 1
+        resilience_metrics.inc("failover_total", reason=reason)
+
+    def _failover(self, ctx: _ReqCtx, reason: str) -> None:
+        """Replay a request whose replica (or handoff) failed.  Bounded:
+        past ``max_failover_attempts`` the request fails fast with the
+        503 retryable kind — it produced no client-visible output, so
+        an idempotent client may resubmit.  The over-budget exit counts
+        NO failover: ``failover_total`` is re-routes performed, and it
+        must reconcile with the ledger."""
+        if ctx.attempts >= self.max_failover_attempts:
+            self._finish(ctx, OmniRequestOutput.from_error(
+                ctx.request_id,
+                f"request failed after {ctx.attempts} failover "
+                f"attempt(s) (last: {reason}); safe to resubmit",
+                kind=RETRYABLE))
+            return
+        ctx.attempts += 1
+        self._note_failover(reason)
+        ctx.first_token = None
+        self._payloads.pop(ctx.request_id, None)
+        self._dispatch(ctx, avoid=ctx.replica)
+
+    def _reap_dead(self) -> None:
+        """Fail over every request stranded on a dead replica.  Phase
+        matters only for the metric reason: any replay restarts from
+        the prompt (prefill KV on a dead replica is gone; decode
+        progress was never client-visible in the final-output API)."""
+        for ctx in list(self._ctx.values()):
+            r = ctx.replica
+            if r is None or not r.dead:
+                continue
+            reason = ("prefill_replica_died"
+                      if ctx.phase in (ROLE_PREFILL, "handoff")
+                      else "decode_replica_died")
+            self._failover(ctx, reason)
+
+    # ------------------------------------------------------ introspection
+    def disagg_snapshot(self) -> dict:
+        """The exposition's ``disagg`` block (kv_handoff_seconds)."""
+        return {"handoff_seconds": self.handoff_seconds.snapshot()}
+
+    def debug_snapshot(self) -> dict:
+        """/debug/disagg: replica table + in-flight request phases +
+        the failover/handoff ledgers.  Read-only host state."""
+        return {
+            "enabled": True,
+            "degraded_mode": self.degraded,
+            "steps": self._steps,
+            "replicas": [{
+                "replica_id": r.replica_id,
+                "role": r.role,
+                "index": r.index,
+                "dead": r.dead,
+                "death_reason": r.death_reason,
+                "ejected": r.ejected,
+                "drained": r.drained,
+                "quiesced": r.quiesced,
+                "queue_depth": r.queue_depth,
+            } for r in self.replicas],
+            "requests": [{
+                "request_id": c.request_id,
+                "phase": c.phase,
+                "replica": (c.replica.replica_id
+                            if c.replica is not None else None),
+                "attempts": c.attempts,
+                "deadline_remaining_s": remaining_s(c.deadline_ts),
+            } for c in self._ctx.values()],
+            "counters": {
+                "handoffs": self.handoffs,
+                "failovers": dict(self.failovers),
+                "sheds": self.sheds,
+            },
+        }

@@ -1,0 +1,220 @@
+"""DisaggService: the in-proc disaggregated topology, served async.
+
+Builds N prefill + M decode ``LLMEngine`` replicas from one model and
+steps the ``DisaggRouter`` on a dedicated engine thread, bridging
+results into per-request asyncio queues — the same contract
+``AsyncOmni`` exposes, so the open-loop load harness
+(``loadgen.run_inproc``) and the serving layer drive a disaggregated
+topology exactly like a colocated one.  ``python -m
+vllm_omni_tpu.disagg`` runs this as a standalone smoke against a tiny
+random-weight model (scripts/disagg.sh rides it).
+
+The router is single-threaded by design (replica engines are stepped
+by exactly one thread); intake crosses the thread boundary through a
+queue, never by touching router state from the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, AsyncIterator, Optional, Union
+
+from vllm_omni_tpu.disagg.roles import ROLE_COLOCATED, ROLE_DECODE, ROLE_PREFILL
+from vllm_omni_tpu.disagg.router import DisaggRouter, EngineReplica
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.outputs import OmniRequestOutput
+
+logger = init_logger(__name__)
+
+_SENTINEL = object()
+
+
+def build_inproc_router(params, model_cfg, base_config,
+                        n_prefill: int, n_decode: int,
+                        eos_token_id: Optional[int] = None,
+                        connector=None, **router_kwargs) -> DisaggRouter:
+    """Build an in-proc topology: ``n_prefill`` prefill-role engines +
+    ``n_decode`` decode-role engines from one (params, model config)
+    pair.  Either count at 0 builds colocated-role replicas instead —
+    the single-tier shape the degradation ladder falls back to.
+    Replica chaos sites are ``replica{i}`` with prefill replicas
+    numbered first (resilience/faults.py)."""
+    from vllm_omni_tpu.engine import LLMEngine
+
+    prefills: list[EngineReplica] = []
+    decodes: list[EngineReplica] = []
+    index = 0
+    if n_prefill <= 0 or n_decode <= 0:
+        # single-tier topology: colocated replicas in the decode pool
+        # (dispatch falls through to the survivor path)
+        cfg = dataclasses.replace(base_config,
+                                  engine_role=ROLE_COLOCATED)
+        for _ in range(max(n_prefill, n_decode, 1)):
+            eng = LLMEngine(params, model_cfg, cfg,
+                            eos_token_id=eos_token_id)
+            decodes.append(EngineReplica(
+                f"colocated{index}", eng, ROLE_COLOCATED, index))
+            index += 1
+        return DisaggRouter([], decodes, connector=connector,
+                            **router_kwargs)
+    pre_cfg = dataclasses.replace(base_config, engine_role=ROLE_PREFILL)
+    dec_cfg = dataclasses.replace(base_config, engine_role=ROLE_DECODE)
+    for _ in range(n_prefill):
+        eng = LLMEngine(params, model_cfg, pre_cfg,
+                        eos_token_id=eos_token_id)
+        prefills.append(EngineReplica(
+            f"prefill{index}", eng, ROLE_PREFILL, index))
+        index += 1
+    for _ in range(n_decode):
+        eng = LLMEngine(params, model_cfg, dec_cfg,
+                        eos_token_id=eos_token_id)
+        decodes.append(EngineReplica(
+            f"decode{index}", eng, ROLE_DECODE, index))
+        index += 1
+    return DisaggRouter(prefills, decodes, connector=connector,
+                        **router_kwargs)
+
+
+class DisaggService:
+    """Async facade over a ``DisaggRouter`` (AsyncOmni-shaped)."""
+
+    def __init__(self, router: DisaggRouter):
+        self.router = router
+        self._intake: queue.Queue = queue.Queue()
+        self._req_counter = itertools.count()
+        self._streams: dict[str, tuple[asyncio.AbstractEventLoop,
+                                       asyncio.Queue]] = {}
+        self._running = True
+        self._thread = threading.Thread(target=self._engine_loop,
+                                        daemon=True,
+                                        name="disagg-engine")
+        self._thread.start()
+
+    # ----------------------------------------------------------- lifecycle
+    def shutdown(self) -> None:
+        self._running = False
+        self._thread.join(timeout=10)
+
+    @property
+    def engine_thread_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    # -------------------------------------------------------------- intake
+    async def generate(
+        self,
+        prompt: Union[list[int], dict],
+        sampling_params: Optional[dict] = None,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> AsyncIterator[OmniRequestOutput]:
+        """Submit one request; yields its final output (errors included
+        — the taxonomy rides ``error_kind`` exactly like AsyncOmni).
+        Prompt forms: token-id list, or a dict with
+        ``prompt_token_ids`` (+ optional ``additional_information``)."""
+        if isinstance(prompt, dict):
+            toks = prompt.get("prompt_token_ids")
+            info = dict(prompt.get("additional_information") or {})
+        else:
+            toks, info = list(prompt), {}
+        if toks is None:
+            raise ValueError(
+                "DisaggService needs prompt_token_ids (no tokenizer "
+                "runs in front of the router)")
+        if request_id is None:
+            request_id = f"disagg-{next(self._req_counter)}"
+        if request_id in self._streams:
+            raise ValueError(
+                f"request_id {request_id!r} already in flight")
+        loop = asyncio.get_running_loop()
+        out_q: asyncio.Queue = asyncio.Queue()
+        self._streams[request_id] = (loop, out_q)
+        self._intake.put((request_id, toks, dict(sampling_params or {}),
+                          deadline_s, info))
+        try:
+            while True:
+                item = await out_q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            self._streams.pop(request_id, None)
+
+    # --------------------------------------------------------- engine loop
+    def _emit(self, request_id: str, item: Any) -> None:
+        entry = self._streams.get(request_id)
+        if entry is None:
+            return
+        loop, q = entry
+        try:
+            loop.call_soon_threadsafe(q.put_nowait, item)
+        except RuntimeError:
+            # the client's event loop closed with the stream still
+            # registered (abandoned generator): drop the stream — one
+            # dead client must never take the engine thread (and every
+            # other in-flight request) down with it
+            logger.warning("dropping stream %s: client loop closed",
+                           request_id)
+            self._streams.pop(request_id, None)
+
+    def _engine_loop(self) -> None:
+        router = self.router
+        while self._running:
+            pending = []
+            try:
+                while True:
+                    pending.append(self._intake.get_nowait())
+            except queue.Empty:
+                pass
+            for rid, toks, sp, deadline_s, info in pending:
+                try:
+                    router.submit(toks, sp, request_id=rid,
+                                  deadline_s=deadline_s,
+                                  additional_information=info)
+                except Exception as e:
+                    self._emit(rid, e)
+                    self._emit(rid, _SENTINEL)
+            try:
+                router.step()
+            except Exception:
+                # a step must never kill the engine thread: the router
+                # already scopes failures to replicas/requests, so an
+                # escape here is a bug — log it and keep serving (the
+                # same stance as AsyncOmni's per-stage poll guard)
+                logger.exception("router step failed; continuing")
+            for out in router.poll():
+                self._emit(out.request_id, out)
+                self._emit(out.request_id, _SENTINEL)
+            if not router.has_unfinished and not pending:
+                # idle: avoid a hot spin on the GIL
+                time.sleep(0.002)
+
+    # ------------------------------------------------------ introspection
+    def render_metrics(self) -> str:
+        """Full Prometheus exposition of the topology: per-replica
+        engine snapshots (stage label = replica index) + the
+        process-global resilience/disagg counters + the handoff
+        histogram."""
+        from vllm_omni_tpu.metrics.prometheus import render_exposition
+        from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+        snaps = {r.index: (r.engine.metrics_snapshot()
+                           if not r.dead else {})
+                 for r in self.router.replicas}
+        return render_exposition(
+            {}, snaps,
+            resilience=resilience_metrics.snapshot(),
+            disagg=self.router.disagg_snapshot())
+
+    def debug_snapshot(self) -> dict:
+        return self.router.debug_snapshot()
+
+
+__all__ = ["DisaggService", "build_inproc_router", "ROLE_PREFILL",
+           "ROLE_DECODE"]

@@ -8,16 +8,37 @@ finishes).  Here the natural chunk is a *layer*: the sender puts one
 ``(k, v)`` pair per layer under ``{key}/L{i}`` plus a ``{key}/meta``
 header, and the receiver consumes layers in order — with a paged-cache
 receiver (ARModelRunner.inject_kv) each layer can land as it arrives.
+
+Two hard edges of the disaggregated prefill/decode topology
+(docs/disaggregation.md) live here:
+
+- **Integrity**: the meta header carries per-layer shape/dtype/crc32 so
+  a torn, truncated, or bit-flipped stream raises ``KVIntegrityError``
+  at the receiver instead of injecting garbage pages into the decode
+  tier's cache.  The consumer degrades to local recompute — wrong KV is
+  the one failure mode with no recovery once attended.
+- **Deadlines**: per-layer waits clamp to the request's remaining
+  end-to-end budget (``deadline_ts``), and a wait that dies because the
+  DEADLINE expired (not the flat transport timeout) raises the distinct
+  ``KVDeadlineExceeded`` so callers surface 504, not a generic
+  connector timeout — a doomed handoff fails fast with the right
+  taxonomy.
 """
 
 from __future__ import annotations
 
 import time
+import zlib
 from typing import Any, Iterator, Optional
 
 import numpy as np
 
 from vllm_omni_tpu.distributed.connectors import OmniConnectorBase
+from vllm_omni_tpu.resilience.deadline import (
+    DEADLINE_EXCEEDED,
+    clamp_timeout,
+    expired,
+)
 from vllm_omni_tpu.resilience.faults import fault_point
 from vllm_omni_tpu.resilience.retry import RetryPolicy, call_with_retry
 
@@ -29,13 +50,62 @@ from vllm_omni_tpu.resilience.retry import RetryPolicy, call_with_retry
 _KV_RETRY = RetryPolicy(max_attempts=2)
 
 
+class KVIntegrityError(ValueError):
+    """A received KV layer failed its shape/dtype/checksum guard.
+
+    Deliberately NOT a ConnectionError: retrying fetches the same
+    bytes, so the retry layer must not treat this as transient — the
+    caller degrades to recompute instead."""
+
+
+class KVDeadlineExceeded(TimeoutError):
+    """A KV wait died because the request's END-TO-END deadline passed
+    (as opposed to the flat per-fetch transport timeout).  Carries the
+    deadline taxonomy so serving layers map it to 504, never 500."""
+
+    error_kind = DEADLINE_EXCEEDED
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _layer_spec(k: np.ndarray, v: np.ndarray) -> dict:
+    return {
+        "k_shape": list(k.shape), "v_shape": list(v.shape),
+        "dtype": str(k.dtype),
+        "k_crc": _crc(k), "v_crc": _crc(v),
+    }
+
+
+def _verify_layer(key: str, i: int, k: np.ndarray, v: np.ndarray,
+                  spec: dict) -> None:
+    """Raise KVIntegrityError unless layer ``i`` matches its header."""
+    if (list(k.shape) != spec["k_shape"]
+            or list(v.shape) != spec["v_shape"]):
+        raise KVIntegrityError(
+            f"KV transfer {key}: layer {i} shape "
+            f"{list(k.shape)}/{list(v.shape)} != header "
+            f"{spec['k_shape']}/{spec['v_shape']}")
+    if str(k.dtype) != spec["dtype"] or str(v.dtype) != spec["dtype"]:
+        raise KVIntegrityError(
+            f"KV transfer {key}: layer {i} dtype {k.dtype}/{v.dtype} "
+            f"!= header {spec['dtype']}")
+    if _crc(k) != spec["k_crc"] or _crc(v) != spec["v_crc"]:
+        raise KVIntegrityError(
+            f"KV transfer {key}: layer {i} checksum mismatch (torn or "
+            "corrupted stream)")
+
+
 def ship_kv(conn: OmniConnectorBase, key: str, payload: list,
             retry: Optional[RetryPolicy] = None) -> int:
     """Put a per-layer KV payload ([(k, v)] dense arrays) under ``key``.
     Returns total bytes shipped.  Each per-layer put retries
     independently under ``retry`` (puts are idempotent: re-putting a
-    layer overwrites the identical bytes)."""
+    layer overwrites the identical bytes).  The meta header carries the
+    per-layer integrity specs the receiver verifies against."""
     retry = retry or _KV_RETRY
+    arrays = [(np.asarray(k), np.asarray(v)) for k, v in payload]
 
     def put(subkey, obj):
         def attempt():
@@ -46,11 +116,12 @@ def ship_kv(conn: OmniConnectorBase, key: str, payload: list,
                                policy=retry)
 
     total = put(f"{key}/meta", {
-        "num_layers": len(payload),
-        "seq_len": int(payload[0][0].shape[1]),
+        "num_layers": len(arrays),
+        "seq_len": int(arrays[0][0].shape[1]),
+        "layers": [_layer_spec(k, v) for k, v in arrays],
     })
-    for i, (k, v) in enumerate(payload):
-        total += put(f"{key}/L{i}", (np.asarray(k), np.asarray(v)))
+    for i, (k, v) in enumerate(arrays):
+        total += put(f"{key}/L{i}", (k, v))
     return total
 
 
@@ -61,14 +132,21 @@ def iter_kv(conn: OmniConnectorBase, key: str, timeout: float = 30.0,
 
     Transient connector failures retry per fetch under ``retry``;
     ``deadline_ts`` (monotonic) bounds the WHOLE transfer — per-layer
-    waits shrink to the remaining budget so a stalled sender surfaces
-    as a TimeoutError at the deadline, not layers*timeout later."""
+    waits shrink to the remaining budget, and a wait that dies because
+    the deadline (not the flat ``timeout``) ran out raises
+    ``KVDeadlineExceeded`` (504), not a generic TimeoutError.  Layers
+    carrying an integrity header are verified; a mismatch raises
+    ``KVIntegrityError`` so a torn stream can never inject garbage."""
     retry = retry or _KV_RETRY
 
     def fetch(subkey: str, what: str):
-        t = timeout
-        if deadline_ts is not None:
-            t = min(t, max(deadline_ts - time.monotonic(), 0.0))
+        if expired(deadline_ts):
+            # fail fast: a doomed handoff must not spend a full
+            # transport timeout discovering the budget is gone
+            raise KVDeadlineExceeded(
+                f"KV transfer {key}: deadline exceeded before "
+                f"{what} arrived")
+        t = clamp_timeout(timeout, deadline_ts)
 
         def attempt():
             fault_point("kv")
@@ -78,13 +156,25 @@ def iter_kv(conn: OmniConnectorBase, key: str, timeout: float = 30.0,
             attempt, site=f"kv:{subkey}", policy=retry,
             deadline_ts=deadline_ts)
         if data is None:
+            if deadline_ts is not None \
+                    and time.monotonic() >= deadline_ts:
+                raise KVDeadlineExceeded(
+                    f"KV transfer {key}: deadline exceeded waiting "
+                    f"for {what}")
             raise TimeoutError(
                 f"KV transfer {key}: {what} missing within {t:.1f}s")
         return data
 
     meta = fetch(f"{key}/meta", "metadata")
+    specs = meta.get("layers")
     for i in range(meta["num_layers"]):
-        yield fetch(f"{key}/L{i}", f"layer {i}")
+        k, v = fetch(f"{key}/L{i}", f"layer {i}")
+        k, v = np.asarray(k), np.asarray(v)
+        if specs is not None:
+            # pre-header senders (no "layers") skip verification —
+            # the guard is opt-out by omission, never by flag
+            _verify_layer(key, i, k, v, specs[i])
+        yield k, v
 
 
 def recv_kv(conn: OmniConnectorBase, key: str, timeout: float = 30.0,

@@ -55,6 +55,17 @@ class EngineConfig:
     max_num_seqs: int = 8
     max_num_batched_tokens: int = 2048
     worker_type: str = "ar"  # "ar" | "generation"
+    # disaggregated prefill/decode serving (docs/disaggregation.md):
+    # "prefill" engines run requests to the end of prompt processing
+    # and ship the paged KV per-layer to a decode tier (kv_transfer is
+    # auto-armed with the prefill_finished trigger); "decode" engines
+    # adopt streamed KV into their paged cache and resume as decode
+    # (the PR 6 resume-as-decode executable-identity rule — the decode
+    # tier's first step runs the SAME decode executable an
+    # uninterrupted colocated stream would).  "colocated" is the
+    # classic single-engine shape and the degradation target when a
+    # peer tier has no healthy replicas (disagg/router.py).
+    engine_role: str = "colocated"  # "prefill" | "decode" | "colocated"
     enable_chunked_prefill: bool = False
     # automatic prefix caching: full prompt pages register under a
     # content hash when their producer frees; later requests sharing the
@@ -174,6 +185,21 @@ class LLMEngine:
                  eos_token_id: Optional[int] = None,
                  draft_fn=None):
         config = config if config is not None else EngineConfig()
+        if config.engine_role not in ("prefill", "decode", "colocated"):
+            raise ValueError(
+                f"engine_role must be prefill|decode|colocated, got "
+                f"{config.engine_role!r}")
+        if (config.engine_role == "prefill"
+                and config.kv_transfer is None
+                and config.worker_type == "ar"):
+            # a prefill-role engine EXISTS to ship KV: arm the transfer
+            # trigger so every request that finishes prompt processing
+            # pins + extracts its pages for the decode tier.  Private
+            # copy — the caller may build other roles from the same
+            # config object.
+            config = dataclasses.replace(
+                config, kv_transfer=KVTransferConfig(
+                    trigger="prefill_finished"))
         if (config.async_scheduling or config.unified_batching) \
                 and config.worker_type != "ar":
             logger.warning(
@@ -389,6 +415,7 @@ class LLMEngine:
         sampling_params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         injected_kv: Optional[list] = None,
+        injected_first_token: Optional[int] = None,
         **kwargs,
     ) -> str:
         """``injected_kv``: per-layer [(k, v)] dense KV of a prompt prefix
@@ -397,7 +424,17 @@ class LLMEngine:
         the remainder of the prompt is (re)computed — at least the last
         prompt token always recomputes so there are logits to sample from
         (the receive half of OmniKVTransferManager, reference:
-        kv_transfer_manager.py:100+)."""
+        kv_transfer_manager.py:100+).
+
+        ``injected_first_token``: the first sampled token, when the
+        upstream PREFILL engine already produced it (disaggregated
+        prefill, docs/disaggregation.md).  With it, the injected KV may
+        cover the WHOLE prompt and the request resumes through the
+        DECODE executable (the scheduler's resume-as-decode branch) —
+        the same executable an uninterrupted colocated stream runs, so
+        greedy continuations stay bit-identical to the colocated
+        oracle.  Without it the prefix is capped at prompt-1 tokens and
+        the last prompt position recomputes for its logits."""
         if request_id is None:
             request_id = f"req-{self._req_counter}"
             self._req_counter += 1
@@ -410,27 +447,38 @@ class LLMEngine:
             arrival_mono=time.monotonic(),
             **kwargs,
         )
+        if injected_first_token is not None:
+            # appended BEFORE admission: num_tokens includes it, so the
+            # remainder-to-compute is exactly one sampling position
+            req.append_output_token(int(injected_first_token))
         injected_len = 0
         if injected_kv is not None:
             injected_len = min(int(injected_kv[0][0].shape[1]),
-                               max(len(prompt_token_ids) - 1, 0))
+                               max(req.num_tokens - 1, 0))
         self.scheduler.add_request(req, injected_len=injected_len)
         if injected_kv is not None and req.status is RequestStatus.WAITING:
             self._inject_prefix_kv(req, injected_kv)
         return request_id
 
     def _inject_prefix_kv(self, req: Request, payload: list) -> None:
+        # with a pre-appended first token (disaggregated prefill) the
+        # whole PROMPT may inject — the one remaining position is the
+        # sampling one and re-enters as a decode; otherwise the last
+        # prompt token recomputes for its logits
         seq_len = int(payload[0][0].shape[1])
-        use = min(seq_len, req.num_prompt_tokens - 1)
+        use = min(seq_len, req.num_tokens - 1)
         if use <= 0:
+            if req.output_token_ids:
+                req.output_token_ids.pop()  # unbackable first token
             return
-        table = self.scheduler.kv.allocate(req, use)
+        table = self.scheduler.kv.adopt_streamed(req, use)
         if table is not None:
             try:
                 t0, w0 = time.perf_counter(), time.time()
                 trimmed = [(k[:, :use], v[:, :use]) for k, v in payload]
                 self.runner.inject_kv(table, trimmed)
                 req.num_computed_tokens = use
+                self.scheduler.kv.note_streamed(use)
                 get_recorder().record(
                     req.additional_information.get("trace"), "kv_inject",
                     w0, time.perf_counter() - t0, stage_id=self.stage_id,
@@ -446,11 +494,19 @@ class LLMEngine:
                     "request %s: injected KV rejected (%s); recomputing "
                     "the full prompt", req.request_id, e,
                 )
-        # fallback taken (pool pressure or bad payload): the request was
-        # admitted assuming the prefix would be injected — recheck it can
-        # actually be scheduled as a full recompute
+        # fallback taken (pool pressure or bad payload): the request
+        # recomputes from scratch.  A pre-appended first token whose
+        # backing KV never landed is STRIPPED first — keeping it would
+        # compute its successor position through a (prompt+1)-token
+        # prefill chunk, while the colocated oracle samples that
+        # position through full-prefill + decode; recompute must
+        # re-derive t1 through the oracle's own executables
+        # (bit-exactness rule, docs/disaggregation.md)
+        if req.output_token_ids and req.num_computed_tokens == 0:
+            req.output_token_ids.pop()
+        # recheck it can actually be scheduled as a full recompute
         if (not self.scheduler.config.chunking_enabled
-                and req.num_prompt_tokens
+                and req.num_tokens
                 > self.scheduler.config.max_num_batched_tokens):
             self.scheduler.waiting.remove(req)
             self.scheduler.kv.free(req)

@@ -84,6 +84,16 @@ def _add_common(p: argparse.ArgumentParser):
                           "(shed_requests_total{reason=queue_depth}) "
                           "instead of queued into a wait they can only "
                           "lose")
+    eng.add_argument("--engine-role", default=None,
+                     choices=("prefill", "decode", "colocated"),
+                     help="disaggregated serving role (docs/"
+                          "disaggregation.md): prefill engines run "
+                          "requests to the end of prompt processing "
+                          "and ship paged KV to a decode tier "
+                          "(kv_transfer auto-armed); decode engines "
+                          "adopt streamed KV and resume as decode; "
+                          "colocated (default) is the classic single-"
+                          "engine shape")
     eng.add_argument("--deterministic-decode", action="store_true",
                      default=None,
                      help="pin decode batches to the top bucket so a "
@@ -114,7 +124,7 @@ _ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
                 "kv_offload", "kv_offload_quant", "kv_offload_policy",
                 "kv_host_tier_bytes", "kv_offload_connector",
                 "slo_ttft_ms", "slo_tpot_ms", "max_queue_depth",
-                "deterministic_decode")
+                "engine_role", "deterministic_decode")
 
 
 def _stage_overrides(args) -> dict:

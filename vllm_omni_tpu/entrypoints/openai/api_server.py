@@ -395,6 +395,12 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         if path == "/debug/watchdog":
             return self._json(200, debugz.debug_watchdog(omni),
                               default=str)
+        if path == "/debug/disagg":
+            # disagg-router view (docs/disaggregation.md): replica
+            # health/drain state, in-flight request phases, failover
+            # ledger; {"enabled": false} on non-disagg deployments
+            return self._json(200, debugz.debug_disagg(omni),
+                              default=str)
         return self._error(404, f"unknown debug path {path}; "
                            f"see /debug/z")
 

@@ -31,7 +31,8 @@ from typing import Any, Optional
 from vllm_omni_tpu.introspection.flight_recorder import capture_stacks
 
 ENDPOINTS = ("/debug/engine", "/debug/requests", "/debug/kv",
-             "/debug/flightrecorder", "/debug/stacks", "/debug/watchdog")
+             "/debug/flightrecorder", "/debug/stacks", "/debug/watchdog",
+             "/debug/disagg")
 
 
 # -------------------------------------------------------- request table
@@ -219,6 +220,22 @@ def debug_stacks() -> dict:
 def debug_watchdog(omni) -> dict:
     wd = getattr(omni, "watchdog", None)
     return wd.state() if wd is not None else {"enabled": False}
+
+
+def debug_disagg(omni) -> dict:
+    """Disagg-router state (docs/disaggregation.md): replica table
+    (role/dead/ejected/drained/queue depth), in-flight request phases,
+    and the failover/handoff ledgers.  ``{"enabled": False}`` on
+    deployments without a router — the endpoint always answers."""
+    router = getattr(omni, "router", None)
+    if router is None:
+        return {"enabled": False}
+    try:
+        return router.debug_snapshot()
+    except Exception as e:
+        # same stance as _per_stage: a torn concurrent read degrades
+        # to a retry marker, never a 500 on the debugging request
+        return {"enabled": True, "error": repr(e), "retry": True}
 
 
 def debug_index() -> dict:

@@ -255,6 +255,31 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "faults_injected_total": (
         "counter", "Fault-plan injections fired (testing only)",
         ("site",)),
+    # ---- disaggregated prefill/decode serving (vllm_omni_tpu/disagg/,
+    # docs/disaggregation.md) — handoff volume/latency, failover ledger,
+    # router tier health, degradation state
+    "kv_handoff_bytes_total": (
+        "counter",
+        "Prefill->decode KV handoff bytes per direction (out = shipped "
+        "by the prefill tier, in = received by the decode tier)",
+        ("dir",)),
+    "kv_handoff_seconds": (
+        "histogram",
+        "Prefill->decode KV handoff latency per request (ship + "
+        "receive + integrity verification)", ()),
+    "failover_total": (
+        "counter",
+        "Requests re-routed by the disagg router, per reason (replica "
+        "death, handoff failure, adoption failure, tier loss)",
+        ("reason",)),
+    "router_healthy_replicas": (
+        "gauge",
+        "Replicas in the dispatch rotation per tier (healthy, not "
+        "drained)", ("role",)),
+    "degraded_mode": (
+        "gauge",
+        "Whether the router is serving colocated because a tier has "
+        "zero healthy replicas (1 = degraded)", ()),
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -327,13 +352,16 @@ class _Exposition:
 def render_exposition(summary: dict, engine_snaps: dict,
                       device: Optional[dict] = None,
                       resilience: Optional[dict] = None,
-                      process_stats: Optional[dict] = None) -> str:
+                      process_stats: Optional[dict] = None,
+                      disagg: Optional[dict] = None) -> str:
     """``summary``: OrchestratorAggregator.summary(); ``engine_snaps``:
     {stage_id: LLMEngine/DiffusionEngine.metrics_snapshot() or {}};
     ``resilience``: resilience_metrics.snapshot() (restart/retry/
     breaker/deadline counters, labels already attached);
     ``process_stats``: process-level introspection counters
-    ({spans_dropped, watchdog_trips, watchdog_tripped})."""
+    ({spans_dropped, watchdog_trips, watchdog_tripped});
+    ``disagg``: DisaggRouter.disagg_snapshot() (the handoff-latency
+    histogram — the disagg counters/gauges ride ``resilience``)."""
     exp = _Exposition()
     e2e = summary.get("e2e", {})
     exp.sample("requests_finished_total", {}, e2e.get("num_finished", 0))
@@ -509,6 +537,9 @@ def render_exposition(summary: dict, engine_snaps: dict,
                    process_stats.get("watchdog_trips", 0))
         exp.sample("watchdog_tripped", {},
                    1 if process_stats.get("watchdog_tripped") else 0)
+    if disagg and disagg.get("handoff_seconds", {}).get("count"):
+        exp.histogram("kv_handoff_seconds", {},
+                      disagg["handoff_seconds"])
     for name, samples in (resilience or {}).items():
         if name not in METRIC_SPECS:
             continue  # unknown names never leak past the drift guard
@@ -548,11 +579,17 @@ def render_from_omni(omni, device: Optional[dict] = None) -> str:
         "watchdog_trips": getattr(wd, "trips", 0),
         "watchdog_tripped": getattr(wd, "tripped", None) is not None,
     }
+    # a disagg-routed deployment hangs its router off the orchestrator;
+    # its handoff histogram joins the exposition (counters/gauges
+    # already ride the resilience registry)
+    router = getattr(omni, "router", None)
     return render_exposition(
         summary, snaps, device=device,
         resilience=merge_snapshots(resilience_metrics.snapshot(),
                                    *worker_res),
-        process_stats=process_stats)
+        process_stats=process_stats,
+        disagg=(router.disagg_snapshot() if router is not None
+                else None))
 
 
 # ------------------------------------------------------------ validation

@@ -17,6 +17,15 @@ Sites (each ``fault_point(site)`` call is one step at that site):
 - ``chan``      — stage command-channel send/recv
 - ``conn``      — connector ``put``/``get``
 - ``kv``        — per-layer KV transfer gets
+- ``handoff``   — the disagg prefill→decode KV handoff edge
+  (disagg/roles.py ship/recv; ``drop_pct``/``drop_after`` fail the
+  whole handoff — the router degrades to decode-side recompute —
+  and ``delay_ms`` models a slow tier link)
+- ``replica{N}``— disagg replica N's step loop (disagg/router.py
+  ``EngineReplica.step``; prefill replicas are numbered first).
+  ``fail_step``/``drop_after`` crash the replica IN-PROC (the router
+  marks it dead and fails its requests over); ``kill_after`` remains
+  the process-exit fault, meaningful only for process-backed replicas
 - ``step``      — ``LLMEngine.step`` entry (``delay_ms`` stalls every
   engine step — the stall-watchdog tests' deterministic hang;
   ``fail_step`` raises into the stepping loop)
