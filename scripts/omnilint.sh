@@ -1,6 +1,9 @@
 #!/usr/bin/env sh
 # omnilint CI gate: exits non-zero on any NEW finding (beyond the
-# committed analysis/baseline.json and inline suppressions).
+# committed analysis/baseline.json and inline suppressions) across ALL
+# rule families OL1-OL9 — including the omnirace concurrency rules
+# (OL7 lock-discipline, OL8 lock-order, OL9 blocking-under-lock;
+# scripts/racecheck.sh runs just those plus the runtime detector).
 #
 # The tier-1 pytest run exercises the same check through
 # tests/analysis/test_selflint.py; this wrapper is the standalone /

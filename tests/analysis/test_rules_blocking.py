@@ -1,0 +1,154 @@
+"""OL9 blocking-under-lock: device sync / jit / socket / sleep /
+connector waits while a lock is held (HOT_PATHS + THREADED_PATHS)."""
+
+from tests.analysis.util import lint, messages
+
+HOT = "vllm_omni_tpu/core/fixture.py"
+THREADED = "vllm_omni_tpu/resilience/fixture.py"
+COLD = "vllm_omni_tpu/model_loader/fixture.py"
+
+
+def test_blocking_call_matrix_under_lock():
+    src = '''
+import time
+import jax
+
+class Worker:
+    def step(self, arr, sock, connector, fut):
+        with self._lock:
+            jax.device_get(arr)          # device sync
+            arr.block_until_ready()      # device sync
+            time.sleep(0.1)              # sleep
+            sock.recv(4)                 # socket recv
+            connector.get("k", 5.0)      # connector round trip
+            self._run_jit(arr)           # jit dispatch
+            fut.result()                 # future wait
+'''
+    found = lint(src, path=HOT, rule="OL9")
+    assert len(found) == 7, messages(found)
+    for f in found:
+        assert "Worker._lock" in f.message
+
+
+def test_same_calls_outside_lock_are_fine():
+    src = '''
+import time
+import jax
+
+class Worker:
+    def step(self, arr, sock):
+        with self._lock:
+            n = len(arr)
+        jax.device_get(arr)
+        time.sleep(0.1)
+        sock.recv(4)
+        return n
+'''
+    assert lint(src, path=HOT, rule="OL9") == []
+
+
+def test_out_of_scope_module_not_linted():
+    src = '''
+import time
+
+class Loader:
+    def load(self):
+        with self._lock:
+            time.sleep(1.0)
+'''
+    assert lint(src, path=COLD, rule="OL9") == []
+
+
+def test_condition_wait_on_held_cv_is_blessed():
+    # Condition.wait on the condition you hold RELEASES it — the one
+    # legitimate blocking-under-lock idiom; waiting on anything ELSE
+    # while holding a lock is flagged
+    src = '''
+class Store:
+    def pop(self, key):
+        with self._cv:
+            while key not in self._d:
+                self._cv.wait(1.0)
+            return self._d.pop(key)
+
+    def bad(self, event):
+        with self._cv:
+            event.wait(1.0)
+'''
+    found = lint(src, path=THREADED, rule="OL9")
+    assert len(found) == 1, messages(found)
+    assert "wait on 'event'" in found[0].message
+    assert found[0].symbol == "Store.bad"
+
+
+def test_helper_indirection_flagged_at_the_locked_call_site():
+    src = '''
+import socket
+
+def _send_frame(sock, data):
+    sock.sendall(data)
+
+class Client:
+    def _connect(self):
+        return socket.create_connection(("h", 1))
+
+    def rpc(self, data):
+        with self._lock:
+            sock = self._connect()
+            _send_frame(sock, data)
+'''
+    found = lint(src, path=THREADED, rule="OL9")
+    assert len(found) == 2, messages(found)
+    assert "Client._connect" in found[0].message \
+        or "_connect()" in found[0].message
+    assert any("_send_frame" in f.message for f in found)
+
+
+def test_helper_blocking_already_under_its_own_lock_not_repropagated():
+    # the helper's blocking call under the helper's OWN lock is flagged
+    # once, at its own site — not again at every locked caller
+    src = '''
+import time
+
+class W:
+    def _slow(self):
+        with self._inner_lock:
+            time.sleep(0.5)
+
+    def outer(self):
+        with self._outer_lock:
+            self._slow()
+'''
+    found = lint(src, path=THREADED, rule="OL9")
+    assert len(found) == 1, messages(found)
+    assert found[0].symbol == "W._slow"
+
+
+def test_suppression_with_reason_respected():
+    src = '''
+class Client:
+    def rpc(self, sock, data):
+        with self._lock:
+            # omnilint: disable=OL9 - the lock IS the socket
+            # serializer; send/recv must pair per RPC
+            sock.sendall(data)
+            # omnilint: disable=OL9 - see above
+            return sock.recv(4)
+'''
+    assert lint(src, path=THREADED, rule="OL9") == []
+
+
+def test_closure_body_under_lexical_lock_not_flagged():
+    # the closure defined under the lock executes after release — its
+    # blocking calls are NOT blocking-under-lock
+    src = '''
+import time
+
+class W:
+    def spawn(self):
+        with self._lock:
+            def worker():
+                time.sleep(1.0)      # runs unlocked later
+            self._pending.append(worker)
+'''
+    assert lint(src, path=THREADED, rule="OL9") == []

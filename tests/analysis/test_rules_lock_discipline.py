@@ -1,0 +1,215 @@
+"""OL7 lock-discipline: LOCK_GUARDS attrs touched only under their lock."""
+
+import ast
+
+from vllm_omni_tpu.analysis import analyze_source
+from vllm_omni_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from tests.analysis.util import messages
+
+PATH = "vllm_omni_tpu/core/lockfix.py"
+
+
+class _Rule(LockDisciplineRule):
+    """The real rule against a test manifest (same schema as
+    manifest.LOCK_GUARDS)."""
+
+    manifest = {
+        f"{PATH}::Counter": {"_lock": ("_count", "_window")},
+    }
+
+
+def lint7(src: str):
+    found = analyze_source(src, PATH, rules=[_Rule])
+    return [f for f in found if not f.suppressed]
+
+
+def test_guarded_attr_miss_flagged_and_locked_access_not():
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0          # __init__ writes are exempt
+        self._window = []
+
+    def good(self, v):
+        with self._lock:
+            self._count += 1
+            self._window.append(v)
+
+    def bad_read(self):
+        return self._count       # OL7: unlocked read
+
+    def bad_write(self, v):
+        self._window.append(v)   # OL7: unlocked mutation
+'''
+    found = lint7(src)
+    assert len(found) == 2, messages(found)
+    assert "read of '_count'" in found[0].message
+    assert found[0].symbol == "Counter.bad_read"
+    assert "read of '_window'" in found[1].message
+
+
+def test_helper_method_indirection_resolved():
+    # a private helper whose EVERY same-class call site holds the lock
+    # inherits it; one unlocked call site breaks the inheritance
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def _bump_locked(self):
+        self._count += 1         # fine: all callers hold the lock
+
+    def outer_a(self):
+        with self._lock:
+            self._bump_locked()
+
+    def outer_b(self):
+        with self._lock:
+            self._bump_locked()
+'''
+    assert lint7(src) == [], messages(lint7(src))
+
+    src_broken = src + '''
+    def outer_c(self):
+        self._bump_locked()      # call WITHOUT the lock
+'''
+    found = lint7(src_broken)
+    assert len(found) == 1, messages(found)
+    assert found[0].symbol == "Counter._bump_locked"
+    assert "'_count'" in found[0].message
+
+
+def test_public_method_never_inherits_the_lock():
+    # a PUBLIC method touching guarded state unlocked is flagged even
+    # when its only same-class caller holds the lock — external callers
+    # hold nothing
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        self._count += 1
+
+    def locked_entry(self):
+        with self._lock:
+            self.bump()
+'''
+    found = lint7(src)
+    assert len(found) == 1, messages(found)
+    assert found[0].symbol == "Counter.bump"
+
+
+def test_rlock_reentry_is_not_a_finding():
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._count = 0
+
+    def outer(self):
+        with self._lock:
+            with self._lock:     # RLock re-entry
+                self._count += 1
+'''
+    assert lint7(src) == [], messages(lint7(src))
+
+
+def test_bare_acquire_flagged():
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def manual(self):
+        self._lock.acquire()
+        self._count += 1
+        self._lock.release()
+'''
+    found = lint7(src)
+    # bare acquire + bare release + the access it can't see as covered
+    assert any("bare .acquire" in f.message for f in found), \
+        messages(found)
+    assert any("bare .release" in f.message for f in found)
+
+
+def test_suppression_with_reason_respected():
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def racy_gauge(self):
+        # omnilint: disable=OL7 - GIL-atomic int read for /metrics
+        return self._count
+'''
+    assert lint7(src) == [], messages(lint7(src))
+
+
+def test_real_manifest_classes_have_valid_schema():
+    # every key parses as path::Class and every value maps lock -> attrs
+    from vllm_omni_tpu.analysis.manifest import LOCK_GUARDS
+
+    for key, guards in LOCK_GUARDS.items():
+        path, _, cls = key.partition("::")
+        assert path.endswith(".py") and cls.isidentifier(), key
+        assert guards, key
+        for lock, attrs in guards.items():
+            assert lock.isidentifier() and attrs, (key, lock)
+            assert all(a.isidentifier() for a in attrs)
+
+
+def test_manifest_lock_names_match_lock_convention():
+    # the with-scope recognizer is name-based; a manifest lock the
+    # recognizer can't see would make every access look unlocked
+    from vllm_omni_tpu.analysis.manifest import LOCK_GUARDS
+    from vllm_omni_tpu.analysis.rules._lockinfo import is_lockish_name
+
+    for key, guards in LOCK_GUARDS.items():
+        for lock in guards:
+            assert is_lockish_name(lock), (key, lock)
+
+
+def test_fixture_parses():
+    # guard against fixture rot: the snippets above must stay valid
+    ast.parse(open(__file__).read())
+
+
+def test_closure_under_lock_is_not_blessed():
+    # a thread-target closure DEFINED under the lock runs after release:
+    # its guarded accesses must be flagged, not blessed by the lexical
+    # with it happens to sit inside
+    src = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def spawn(self):
+        with self._lock:
+            def worker():
+                self._count += 1     # runs unlocked later
+            threading.Thread(target=worker).start()
+'''
+    found = lint7(src)
+    assert len(found) == 1, messages(found)
+    assert "'_count'" in found[0].message

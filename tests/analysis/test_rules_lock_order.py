@@ -1,0 +1,222 @@
+"""OL8 lock-order: cycles in the (cross-file) acquisition graph.
+
+Cross-file accumulation rides the engine's per-run state: standalone
+``analyze_source`` calls are isolated by default; passing one
+``run_state`` dict across calls emulates a multi-file run.
+"""
+
+from vllm_omni_tpu.analysis import analyze_source
+from vllm_omni_tpu.analysis.rules.lock_order import LockOrderRule
+from tests.analysis.util import messages
+
+
+def lint8(src, path, state=None):
+    return [f for f in analyze_source(src, path, rules=[LockOrderRule],
+                                      run_state=state)
+            if not f.suppressed]
+
+
+def test_two_path_cycle_in_one_file():
+    src = '''
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+'''
+    found = lint8(src, "vllm_omni_tpu/core/ordfix.py")
+    assert len(found) == 1, messages(found)
+    assert "potential deadlock" in found[0].message
+    assert "Pair._a_lock" in found[0].message
+    assert "Pair._b_lock" in found[0].message
+
+
+def test_cycle_across_two_files_names_both_paths():
+    # lock identity is class-qualified ("OrdA._x_lock"), so the same
+    # lock referenced from another module (via the class) shares its
+    # graph node — the two halves of the cycle live in different files
+    state = {}  # one shared run across the two files
+    src_fwd = '''
+import threading
+
+class OrdA:
+    _x_lock = threading.Lock()
+    _y_lock = threading.Lock()
+
+    def fwd(self):
+        with self._x_lock:
+            with self._y_lock:
+                pass
+'''
+    src_rev = '''
+from vllm_omni_tpu.core.orda import OrdA
+
+def rev():
+    with OrdA._y_lock:
+        with OrdA._x_lock:
+            pass
+'''
+    # first file alone: no cycle yet
+    first = lint8(src_fwd, "vllm_omni_tpu/core/orda.py", state)
+    assert first == [], messages(first)
+    found = lint8(src_rev, "vllm_omni_tpu/core/ordb.py", state)
+    assert len(found) == 1, messages(found)
+    assert found[0].path == "vllm_omni_tpu/core/ordb.py"
+    assert "vllm_omni_tpu/core/orda.py" in found[0].message
+    assert "OrdA.fwd" in found[0].message
+
+
+def test_call_edge_acquisition_counts():
+    src = '''
+import threading
+
+class Pair:
+    def _take_b(self):
+        with self._b_lock:
+            pass
+
+    def forward(self):
+        with self._a_lock:
+            self._take_b()        # a -> b via call edge
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:    # b -> a directly
+                pass
+'''
+    found = lint8(src, "vllm_omni_tpu/core/ordcall.py")
+    assert len(found) == 1, messages(found)
+
+
+def test_rlock_reentry_never_an_edge():
+    src = '''
+import threading
+
+class Re:
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:          # re-entry, not an ordering
+            pass
+'''
+    found = lint8(src, "vllm_omni_tpu/core/ordre.py")
+    assert found == [], messages(found)
+
+
+def test_consistent_global_order_is_clean():
+    src = '''
+import threading
+
+class Pair:
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+'''
+    found = lint8(src, "vllm_omni_tpu/core/ordok.py")
+    assert found == [], messages(found)
+
+
+def test_suppression_with_reason_respected():
+    # one cycle reports ONCE, anchored at the lexicographically-first
+    # edge — the suppression goes where the finding points
+    src = '''
+import threading
+
+class Pair:
+    def forward(self):
+        with self._a_lock:
+            # omnilint: disable=OL8 - deliberate: b is a leaf taken
+            # only under a on this path; backward() runs pre-serving
+            with self._b_lock:
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+'''
+    found = lint8(src, "vllm_omni_tpu/core/ordsup.py")
+    assert found == [], messages(found)
+
+
+def test_standalone_calls_are_isolated_by_default():
+    # no run_state passed: the reverse-order second call must NOT see
+    # the first call's edges (fixture runs can't poison later runs)
+    fwd = """
+with a_lock:
+    with b_lock:
+        pass
+"""
+    rev = """
+with b_lock:
+    with a_lock:
+        pass
+"""
+    assert lint8(fwd, "vllm_omni_tpu/core/iso.py") == []
+    assert lint8(rev, "vllm_omni_tpu/core/iso.py") == []
+
+
+def test_k_lock_cycle_reports_once():
+    # A->B->C->A is ONE defect: dedup by the cycle's node set, not by
+    # edge pair (which would report it three times)
+    src = '''
+import threading
+
+class Tri:
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._b_lock:
+            with self._c_lock:
+                pass
+
+    def three(self):
+        with self._c_lock:
+            with self._a_lock:
+                pass
+'''
+    found = lint8(src, "vllm_omni_tpu/core/ordtri.py")
+    assert len(found) == 1, messages(found)
+
+
+def test_multi_item_with_orders_left_to_right():
+    # `with A, B:` acquires left-to-right: reversing the item order in
+    # another method is the classic AB/BA deadlock and must be reported
+    # exactly like the nested form
+    src = '''
+import threading
+
+class Pair:
+    def one(self):
+        with self._a_lock, self._b_lock:
+            pass
+
+    def two(self):
+        with self._b_lock, self._a_lock:
+            pass
+'''
+    found = lint8(src, "vllm_omni_tpu/core/ordmulti.py")
+    assert len(found) == 1, messages(found)
+    assert "potential deadlock" in found[0].message

@@ -1,0 +1,244 @@
+"""Runtime lock-order/deadlock detector (analysis/runtime.py).
+
+Deterministic scripted interleavings only: the inversion tests run the
+two orders SEQUENTIALLY (no real contention, so no flake), and the
+seeded-deadlock regression forces the hold-and-wait interleaving with
+events before either thread blocks.
+"""
+
+import threading
+
+import pytest
+
+from vllm_omni_tpu.analysis import runtime as rt
+
+
+@pytest.fixture(autouse=True)
+def _enabled(monkeypatch):
+    monkeypatch.setenv("OMNI_TPU_LOCK_CHECK", "1")
+    rt.reset()
+    yield
+    rt.reset()
+
+
+def _run(*fns, timeout=5.0):
+    threads = [threading.Thread(target=f, daemon=True) for f in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), "worker hung"
+
+
+# ------------------------------------------------------------ off switch
+def test_off_by_default_is_identity(monkeypatch):
+    # zero-cost contract: with the env off, traced() hands back the
+    # very same object — no wrapper, no bookkeeping, nothing to pay
+    monkeypatch.delenv("OMNI_TPU_LOCK_CHECK", raising=False)
+    lock = threading.Lock()
+    assert rt.traced(lock, "x") is lock
+    cv = threading.Condition()
+    assert rt.traced(cv, "y") is cv
+
+
+def test_on_wraps_and_delegates():
+    lock = rt.traced(threading.Lock(), "t.lock")
+    assert isinstance(lock, rt.TracedLock)
+    with lock:
+        assert lock._inner.locked()
+    assert not lock._inner.locked()
+
+
+# ------------------------------------------------------- inversion books
+def test_seeded_inversion_is_detected():
+    A = rt.traced(threading.Lock(), "inv.A")
+    B = rt.traced(threading.Lock(), "inv.B")
+
+    def forward():
+        with A:
+            with B:
+                pass
+
+    def backward():
+        with B:
+            with A:
+                pass
+
+    _run(forward)   # establishes A -> B
+    _run(backward)  # sequential: safe this run, but the order reversed
+    vs = rt.violations()
+    assert len(vs) == 1, vs
+    assert "inversion" in vs[0]
+    assert "inv.A" in vs[0] and "inv.B" in vs[0]
+    with pytest.raises(AssertionError, match="inversion"):
+        rt.assert_clean()
+    # assert_clean resets by default
+    rt.assert_clean()
+
+
+def test_clean_consistent_ordering_passes():
+    A = rt.traced(threading.Lock(), "ok.A")
+    B = rt.traced(threading.Lock(), "ok.B")
+
+    def worker():
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+
+    _run(worker, worker)
+    rt.assert_clean()
+
+
+def test_rlock_reentry_is_not_an_edge_or_violation():
+    R = rt.traced(threading.RLock(), "re.R")
+    with R:
+        with R:
+            pass
+    assert rt.lock_graph() == {}
+    rt.assert_clean()
+
+
+def test_plain_lock_self_reentry_raises_instead_of_hanging():
+    P = rt.traced(threading.Lock(), "self.P")
+    with pytest.raises(rt.LockOrderViolation, match="self-deadlock"):
+        with P:
+            with P:
+                pass
+    assert not P._inner.locked()  # the with unwound cleanly
+
+
+def test_instances_of_one_class_do_not_alias_in_wait_detection():
+    # two Histogram-style locks share a graph NODE but must not share
+    # ownership: holding instance 1 while blocking on instance 2 held
+    # by a thread that wants nothing is plain contention, not a cycle
+    L1 = rt.traced(threading.Lock(), "H._lock")
+    L2 = rt.traced(threading.Lock(), "H._lock")
+    release = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with L2:
+            held.set()
+            release.wait(2)
+
+    def contender():
+        held.wait(2)
+        with L1:           # same NAME as L2, different instance
+            with L2:       # real contention; resolves when released
+                pass
+
+    t1 = threading.Thread(target=holder, daemon=True)
+    t2 = threading.Thread(target=contender, daemon=True)
+    t1.start(); t2.start()
+    # let the contender reach the L2 block, then release
+    import time
+    time.sleep(0.1)
+    release.set()
+    t1.join(3); t2.join(3)
+    assert not t1.is_alive() and not t2.is_alive()
+    rt.assert_clean()
+
+
+# --------------------------------------------------- condition delegation
+def test_condition_wait_releases_bookkeeping():
+    cv = rt.traced(threading.Condition(), "cv.C")
+    other = rt.traced(threading.Lock(), "cv.other")
+    ready = threading.Event()
+    done = []
+
+    def waiter():
+        with cv:
+            ready.set()
+            while not done:
+                cv.wait(1.0)
+
+    def notifier():
+        ready.wait(2)
+        # acquiring 'other' then cv: if wait() left cv marked held by
+        # the waiter, this nesting would fabricate edges/cycles
+        with other:
+            with cv:
+                done.append(1)
+                cv.notify_all()
+
+    _run(waiter, notifier)
+    rt.assert_clean()
+
+
+# ------------------------------------------------- the deadlock regression
+def test_seeded_two_lock_deadlock_is_caught_not_hung():
+    """The acceptance regression: a forced hold-and-wait cycle.  With
+    OMNI_TPU_LOCK_CHECK=1 (this suite) one thread gets
+    LockOrderViolation instead of the suite hanging until CI kills it;
+    without the wrapper the same interleaving deadlocks forever (the
+    off-switch test proves traced() is identity there, so nothing
+    would intervene)."""
+    A = rt.traced(threading.Lock(), "dl.A")
+    B = rt.traced(threading.Lock(), "dl.B")
+    got_a = threading.Event()
+    got_b = threading.Event()
+    caught = []
+
+    def one():
+        try:
+            with A:
+                got_a.set()
+                got_b.wait(2)     # force the cross-hold interleaving
+                with B:
+                    pass
+        except rt.LockOrderViolation as e:
+            caught.append(e)
+
+    def two():
+        try:
+            with B:
+                got_b.set()
+                got_a.wait(2)
+                with A:
+                    pass
+        except rt.LockOrderViolation as e:
+            caught.append(e)
+
+    _run(one, two)                # would hang here without detection
+    assert len(caught) >= 1, "deadlock went undetected"
+    assert "wait cycle" in str(caught[0])
+    # the cycle is also recorded for the teardown assert
+    assert any("deadlock" in v for v in rt.violations())
+    rt.reset()
+
+
+def test_lock_graph_view():
+    A = rt.traced(threading.Lock(), "g.A")
+    B = rt.traced(threading.Lock(), "g.B")
+    with A:
+        with B:
+            pass
+    assert rt.lock_graph() == {"g.A": ["g.B"]}
+
+
+def test_wait_on_unheld_condition_does_not_corrupt_books():
+    # cv.wait() without holding the cv raises from the inner condition;
+    # the wrapper must NOT restore bookkeeping it never dropped, or
+    # this thread's held-stack claims the cv forever and every later
+    # acquisition records phantom edges
+    cv = rt.traced(threading.Condition(), "bad.cv")
+    with pytest.raises(RuntimeError):
+        cv.wait(0.01)
+    other = rt.traced(threading.Lock(), "bad.other")
+    with other:
+        pass
+    assert rt.lock_graph() == {}, rt.lock_graph()  # no phantom cv edge
+    rt.assert_clean()
+
+
+def test_nonblocking_probe_on_held_plain_lock_returns_false():
+    # try-lock on a lock you hold cannot deadlock; it must mirror the
+    # raw primitive (False), not raise — only a BLOCKING re-acquire is
+    # the self-deadlock the detector converts into an error
+    P = rt.traced(threading.Lock(), "probe.P")
+    with P:
+        assert P.acquire(blocking=False) is False
+    assert P.acquire(blocking=False) is True
+    P.release()
+    rt.assert_clean()

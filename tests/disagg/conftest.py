@@ -1,0 +1,4 @@
+"""tests/disagg is one of the heavy threaded suites: run it under the
+omnirace runtime lock checker (see tests/lockcheck.py)."""
+
+from tests.lockcheck import _runtime_lock_check  # noqa: F401

@@ -64,3 +64,61 @@ def test_health(connector):
 def test_factory_unknown():
     with pytest.raises(KeyError):
         ConnectorFactory.create("mooncake")
+
+
+# ------------------------------------------------- shared-namespace wakeups
+def test_inproc_same_namespace_instances_share_store_and_cv():
+    """Regression for the class-level-lock / private-cv bug (omnirace
+    satellite): two InProcConnector instances of ONE namespace share
+    the store dict, so they must share the condition variable too — a
+    put through instance A has to wake a get blocked on instance B
+    immediately, not on B's next 1 s re-check slice."""
+    ns = f"shared_{time.time_ns()}"
+    a = InProcConnector(namespace=ns)
+    b = InProcConnector(namespace=ns)
+    assert a._store is b._store
+    assert a._cv is b._cv
+    # distinct namespaces stay fully isolated
+    c = InProcConnector(namespace=f"{ns}_other")
+    assert c._store is not a._store
+    assert c._cv is not a._cv
+
+
+def test_inproc_cross_instance_put_wakes_blocked_get():
+    ns = f"wake_{time.time_ns()}"
+    a = InProcConnector(namespace=ns)
+    b = InProcConnector(namespace=ns)
+    key = make_key("rx", 0, 1)
+    result = {}
+
+    def reader():
+        t0 = time.monotonic()
+        result["v"] = b.get(key, timeout=5.0)
+        result["waited"] = time.monotonic() - t0
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.05)
+    a.put(key, {"x": 1})
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert result["v"] == {"x": 1}
+    # woken by the notify, not by the 1 s wait slice expiring
+    assert result["waited"] < 0.9, result["waited"]
+
+
+def test_inproc_concurrent_construction_single_store():
+    ns = f"race_{time.time_ns()}"
+    made = []
+
+    def build():
+        made.append(InProcConnector(namespace=ns))
+
+    threads = [threading.Thread(target=build) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    stores = {id(c._store) for c in made}
+    cvs = {id(c._cv) for c in made}
+    assert len(stores) == 1 and len(cvs) == 1

@@ -1,0 +1,29 @@
+"""Shared autouse fixture: omnirace runtime lock checking for the
+heavy threaded suites (analysis/runtime.py).
+
+Imported into a suite's conftest.py as::
+
+    from tests.lockcheck import _runtime_lock_check  # noqa: F401
+
+Every lock constructed through ``traced(lock, "Class._attr")`` while
+``OMNI_TPU_LOCK_CHECK=1`` records acquisition order into the
+process-global graph (a raw ``threading.Lock`` that never passes
+through ``traced()`` — e.g. module-level locks created at import time
+— is NOT covered: wrap new cross-thread locks at construction); the
+teardown assert turns any lock-order inversion or wait cycle observed
+during a test into that test's failure — the dynamic half of the
+OL7-OL9 static rules, running continuously in tier-1.
+"""
+
+import pytest
+
+from vllm_omni_tpu.analysis import runtime as lock_runtime
+
+
+@pytest.fixture(autouse=True)
+def _runtime_lock_check(monkeypatch):
+    monkeypatch.setenv("OMNI_TPU_LOCK_CHECK", "1")
+    lock_runtime.reset()
+    yield
+    # raises AssertionError listing the two code paths of any cycle
+    lock_runtime.assert_clean()

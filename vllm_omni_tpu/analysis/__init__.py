@@ -3,8 +3,12 @@
 A stock linter sees valid Python; this package checks the contracts the
 serving stack actually hangs on: jit staging rules (OL1), hot-path
 host↔device syncs (OL2), buffer donation (OL3), async-dispatch-safe
-benchmarking (OL4), the cross-process stage frame protocol (OL5), and
-Prometheus metric-surface drift (OL6).
+benchmarking (OL4), the cross-process stage frame protocol (OL5),
+Prometheus metric-surface drift (OL6), and the omnirace concurrency
+families — lock discipline against the LOCK_GUARDS manifest (OL7),
+lock-order cycles (OL8), and blocking calls under a lock (OL9), with a
+runtime lock-order/deadlock detector in ``analysis.runtime``
+(``OMNI_TPU_LOCK_CHECK=1``).
 
 CLI::
 
@@ -21,18 +25,12 @@ workflow.  No jax import anywhere in this package — safe for any CI
 lane.
 """
 
-from vllm_omni_tpu.analysis.engine import (
-    DEFAULT_BASELINE,
-    Finding,
-    Rule,
-    analyze_paths,
-    analyze_source,
-    apply_baseline,
-    load_baseline,
-    new_findings,
-    save_baseline,
-)
-
+# Lazy (PEP 562) re-exports: production modules import
+# ``vllm_omni_tpu.analysis.runtime`` for ``traced()`` at lock
+# construction, and importing ANY submodule executes this __init__ —
+# eagerly pulling the whole AST rule engine into every server/worker
+# start would tax exactly the processes the zero-cost-when-off
+# contract protects.  The engine loads on first actual use.
 __all__ = [
     "DEFAULT_BASELINE",
     "Finding",
@@ -44,3 +42,12 @@ __all__ = [
     "new_findings",
     "save_baseline",
 ]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from vllm_omni_tpu.analysis import engine
+
+        return getattr(engine, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

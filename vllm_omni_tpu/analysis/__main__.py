@@ -27,7 +27,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m vllm_omni_tpu.analysis",
         description="omnilint: JAX/TPU-aware static analysis "
-                    "(rules OL1-OL6; see docs/static_analysis.md)")
+                    "(rules OL1-OL9; see docs/static_analysis.md)")
     parser.add_argument("paths", nargs="*", default=["vllm_omni_tpu"],
                         help="files/directories to analyze "
                              "(default: vllm_omni_tpu)")
@@ -43,9 +43,29 @@ def main(argv=None) -> int:
                         help="report every finding as new (audit mode)")
     parser.add_argument("--show-all", action="store_true",
                         help="also print suppressed/baselined findings")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run (e.g. "
+                             "OL7,OL8,OL9 — scripts/racecheck.sh's "
+                             "concurrency-only gate); default: all")
     args = parser.parse_args(argv)
 
-    findings = analyze_paths(args.paths)
+    rules = None
+    if args.rules:
+        if args.update_baseline:
+            # a baseline regenerated from a rule subset would silently
+            # drop every other family's entries
+            parser.error("--rules cannot be combined with "
+                         "--update-baseline (the baseline covers every "
+                         "family)")
+        from vllm_omni_tpu.analysis.rules import ALL_RULES
+
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in ALL_RULES if r.id in wanted]
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            parser.error(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+
+    findings = analyze_paths(args.paths, rules)
     if args.update_baseline:
         counts = save_baseline(findings, args.baseline)
         print(f"baseline updated: {sum(counts.values())} finding(s) "

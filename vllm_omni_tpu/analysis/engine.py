@@ -140,11 +140,17 @@ class Rule:
     """Base rule: subclasses declare ``node_types`` and yield Findings
     from ``visit`` (per matching node, one engine walk) and/or
     ``finish`` (after the walk — whole-file aggregates).  A fresh
-    instance runs per file, so instance state is per-file state."""
+    instance runs per file, so instance state is per-file state;
+    ``run_state`` (a dict the engine threads through one analysis run —
+    all files of an ``analyze_paths`` call share it, a standalone
+    ``analyze_source`` gets a fresh one unless the caller passes its
+    own) is where cross-FILE state lives, so one run never leaks into
+    the next (rule OL8's lock-order graph rides it)."""
 
     id: str = ""
     name: str = ""
     node_types: tuple = ()
+    run_state: Optional[dict] = None  # set by the engine per run
 
     def applies(self, ctx: FileContext) -> bool:
         return True
@@ -215,11 +221,15 @@ def default_rules() -> list[type]:
 
 
 def analyze_source(source: str, path: str,
-                   rules: Optional[list[type]] = None) -> list[Finding]:
+                   rules: Optional[list[type]] = None,
+                   run_state: Optional[dict] = None) -> list[Finding]:
     """Run the rule set over one in-memory source blob.  ``path`` is the
     repo-relative path the file *claims* to be at — rules scope by it
     (HOT_PATHS, protocol modules), which is what lets tests feed tiny
-    fixture snippets through the real engine."""
+    fixture snippets through the real engine.  ``run_state`` is the
+    cross-file dict rules with whole-run aggregates use; None (the
+    default) isolates this call completely — pass one dict across
+    calls to emulate a multi-file run."""
     path = path.replace(os.sep, "/")
     try:
         tree = ast.parse(source)
@@ -227,9 +237,11 @@ def analyze_source(source: str, path: str,
         return [Finding(rule="OL0", path=path, line=e.lineno or 1,
                         message=f"file does not parse: {e.msg}")]
     ctx = FileContext(path, source, tree)
+    state = run_state if run_state is not None else {}
     active = []
     for rule_cls in (rules if rules is not None else default_rules()):
         rule = rule_cls()
+        rule.run_state = state
         if rule.applies(ctx):
             active.append(rule)
     findings: list[Finding] = []
@@ -263,10 +275,12 @@ def iter_python_files(paths: Iterable[str]) -> list[str]:
 def analyze_paths(paths: Iterable[str],
                   rules: Optional[list[type]] = None) -> list[Finding]:
     findings: list[Finding] = []
+    run_state: dict = {}  # one run = one cross-file aggregate scope
     for fp in iter_python_files(paths):
         with open(fp, encoding="utf-8") as fh:
             source = fh.read()
-        findings.extend(analyze_source(source, canonical_path(fp), rules))
+        findings.extend(analyze_source(source, canonical_path(fp),
+                                       rules, run_state))
     return findings
 
 

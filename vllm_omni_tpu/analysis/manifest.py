@@ -95,6 +95,100 @@ METRIC_MODULES: tuple[str, ...] = (
     "vllm_omni_tpu/metrics/prometheus.py",
 )
 
+# --------------------------------------------------------------- omnirace
+# THREADED_PATHS: modules with real cross-thread locking that are NOT on
+# the serving hot path — rule OL9 (blocking-under-lock) covers
+# HOT_PATHS ∪ THREADED_PATHS.  A blocking call under a lock here won't
+# stall a device step directly, but it convoys every thread that needs
+# the lock (heartbeats, /metrics, intake) behind one slow operation.
+THREADED_PATHS: tuple[str, ...] = (
+    # supervisor heartbeat/restart threads + fault injector
+    "vllm_omni_tpu/resilience/",
+    # connector cv-protected stores, the TCP KV server's per-connection
+    # threads, and the client's one-socket mutex
+    "vllm_omni_tpu/distributed/",
+    # histograms observed by the engine thread, snapshotted by /metrics
+    "vllm_omni_tpu/metrics/",
+    # span ring shared by every stage thread + the drain/export path
+    "vllm_omni_tpu/tracing/",
+    # native shm ring op lock
+    "vllm_omni_tpu/native/",
+    # the async orchestrator's pause gate + engine loop
+    "vllm_omni_tpu/entrypoints/async_omni.py",
+    # the stage channel's send mutex (submit thread vs profile RPC)
+    "vllm_omni_tpu/entrypoints/stage_proc.py",
+    "vllm_omni_tpu/entrypoints/openai/api_server.py",
+    # closed-loop bench workers share a result lock
+    "vllm_omni_tpu/benchmarks/",
+    # the lock tracer itself: its meta-lock must stay leaf-only
+    "vllm_omni_tpu/analysis/runtime.py",
+)
+
+# LOCK_GUARDS: the concurrency manifest rule OL7 (lock-discipline)
+# enforces.  Per class (keyed "path::ClassName"), which attributes are
+# guarded by which lock attribute: every read/write of a guarded
+# attribute must happen under `with self.<lock>` — directly, or in a
+# private helper whose every same-class call site holds the lock
+# (__init__/__del__ are exempt: construction and teardown are
+# single-threaded by contract).  Lock attribute names must follow the
+# *lock/*cv/*cond naming convention (rules/_lockinfo.py) so the `with`
+# scopes are recognizable.
+#
+# Declare the invariant that is TRUE and must stay true — the manifest
+# is documentation the linter enforces, not aspiration.  Deliberately
+# unguarded attributes (GIL-atomic monitoring reads) are simply not
+# listed, or the access carries a reasoned OL7 suppression.
+LOCK_GUARDS: dict[str, dict[str, tuple[str, ...]]] = {
+    # engine thread observes while the /metrics HTTP thread snapshots
+    "vllm_omni_tpu/metrics/stats.py::Histogram": {
+        "_lock": ("_counts", "_sum", "_count", "_window"),
+    },
+    # every subsystem counts events here from its own thread
+    "vllm_omni_tpu/resilience/metrics.py::ResilienceMetrics": {
+        "_lock": ("_counters", "_gauges"),
+    },
+    # orchestrator thread (submit/poll) vs heartbeat + restart threads
+    "vllm_omni_tpu/resilience/supervisor.py::StageSupervisor": {
+        "_lock": ("_tracked", "_redelivered", "_failed_outs",
+                  "_restarts", "_restarting", "_dead", "_closed"),
+    },
+    # chaos sites fire from every replica/stage thread
+    "vllm_omni_tpu/resilience/faults.py::FaultInjector": {
+        "_lock": ("_steps", "_rngs"),
+    },
+    # engine step appends; /debug + crash hooks snapshot from anywhere
+    "vllm_omni_tpu/introspection/flight_recorder.py::FlightRecorder": {
+        "_lock": ("_ring", "_seq", "_dropped", "_last_mono",
+                  "_last_wall"),
+    },
+    "vllm_omni_tpu/introspection/memory_ledger.py::DeviceMemoryLedger": {
+        "_lock": ("_peaks", "_peak_total", "_last"),
+    },
+    # monitor thread mutates source states; /debug reads them
+    "vllm_omni_tpu/introspection/watchdog.py::StallWatchdog": {
+        "_lock": ("_sources",),
+    },
+    # per-connection server threads share the one object table
+    "vllm_omni_tpu/distributed/tcp.py::KVStoreServer": {
+        "_cv": ("_store",),
+    },
+    # one persistent socket, many caller threads
+    "vllm_omni_tpu/distributed/tcp.py::TCPConnector": {
+        "_lock": ("_sock",),
+    },
+    # per-namespace store shared by every same-namespace instance
+    "vllm_omni_tpu/distributed/connectors.py::InProcConnector": {
+        "_cv": ("_store",),
+    },
+    # every stage thread records; the writer drains
+    "vllm_omni_tpu/tracing/trace.py::TraceRecorder": {
+        "_lock": ("_spans", "_dropped"),
+    },
+    "vllm_omni_tpu/tracing/trace.py::TraceWriter": {
+        "_lock": ("_spans",),
+    },
+}
+
 
 def in_scope(path: str, prefixes: tuple[str, ...]) -> bool:
     """True when repo-relative ``path`` matches a manifest entry (a

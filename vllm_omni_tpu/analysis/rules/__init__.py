@@ -1,20 +1,34 @@
 """omnilint rule registry — one module per rule family.
 
-| id  | name               | contract it guards                         |
-|-----|--------------------|--------------------------------------------|
-| OL1 | jit-hazard         | jax.jit staging rules (traced branching,   |
-|     |                    | static decls, jit-in-loop re-wrapping)     |
-| OL2 | host-sync          | no device→host syncs in HOT_PATHS modules  |
-| OL3 | donation-safety    | no reads of donated buffers                |
-| OL4 | wall-clock-in-trace| bench timing syncs before the 2nd stamp    |
-| OL5 | stage-protocol     | every sent frame type has a handler; span  |
-|     |                    | payloads are re-stamped cross-process      |
-| OL6 | metric-drift       | Prometheus surface matches METRIC_SPECS    |
+| id  | name                | contract it guards                         |
+|-----|---------------------|--------------------------------------------|
+| OL1 | jit-hazard          | jax.jit staging rules (traced branching,   |
+|     |                     | static decls, jit-in-loop re-wrapping)     |
+| OL2 | host-sync           | no device→host syncs in HOT_PATHS modules  |
+| OL3 | donation-safety     | no reads of donated buffers                |
+| OL4 | wall-clock-in-trace | bench timing syncs before the 2nd stamp    |
+| OL5 | stage-protocol      | every sent frame type has a handler; span  |
+|     |                     | payloads are re-stamped cross-process      |
+| OL6 | metric-drift        | Prometheus surface matches METRIC_SPECS    |
+| OL7 | lock-discipline     | LOCK_GUARDS attrs touched only under their |
+|     |                     | lock (helper call edges resolved)          |
+| OL8 | lock-order          | no cycles in the acquisition-order graph   |
+| OL9 | blocking-under-lock | no device sync / jit / socket / sleep /    |
+|     |                     | connector wait while holding a lock        |
+
+OL7-OL9 ("omnirace") have a runtime counterpart in
+``analysis/runtime.py`` — traced locks that detect order inversions and
+wait cycles live under ``OMNI_TPU_LOCK_CHECK=1``.
 """
 
+from vllm_omni_tpu.analysis.rules.blocking_under_lock import (
+    BlockingUnderLockRule,
+)
 from vllm_omni_tpu.analysis.rules.donation import DonationRule
 from vllm_omni_tpu.analysis.rules.host_sync import HostSyncRule
 from vllm_omni_tpu.analysis.rules.jit_hazard import JitHazardRule
+from vllm_omni_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from vllm_omni_tpu.analysis.rules.lock_order import LockOrderRule
 from vllm_omni_tpu.analysis.rules.metric_drift import MetricDriftRule
 from vllm_omni_tpu.analysis.rules.stage_protocol import StageProtocolRule
 from vllm_omni_tpu.analysis.rules.wallclock import WallClockRule
@@ -26,6 +40,9 @@ ALL_RULES: tuple[type, ...] = (
     WallClockRule,
     StageProtocolRule,
     MetricDriftRule,
+    LockDisciplineRule,
+    LockOrderRule,
+    BlockingUnderLockRule,
 )
 
 __all__ = [
@@ -36,4 +53,7 @@ __all__ = [
     "WallClockRule",
     "StageProtocolRule",
     "MetricDriftRule",
+    "LockDisciplineRule",
+    "LockOrderRule",
+    "BlockingUnderLockRule",
 ]

@@ -1,0 +1,175 @@
+"""Shared lock-AST vocabulary for the omnirace rules (OL7-OL9).
+
+One place answers three questions every concurrency rule asks:
+
+- *is this expression a lock?*  Heuristic by terminal name (``_lock``,
+  ``_cv``, ``_cond``, ``_mutex``, ...), because the codebase's naming
+  convention is the only static signal — type inference on
+  ``threading.Lock()`` through attributes would be a whole-program
+  analysis for the same answer.
+- *what is a lock's graph identity?*  ``Class._attr`` for
+  ``self._attr``/``cls._attr``/``Class._attr`` (all instances of a
+  class share a node — the granularity the runtime validator
+  (analysis/runtime.py) uses too, so static and dynamic graphs line
+  up), ``<module-stem>._attr`` for module globals.
+- *which locks are held HERE?*  The lexical ``with`` stack: every
+  ancestor ``with`` whose context expression is a lock.  Lexical scope
+  is exact for ``with``-disciplined code (this repo's only acquisition
+  idiom; bare ``.acquire()`` is itself a finding under OL7's manifest
+  classes) and function-local, so a nested closure executed later
+  still reports the locks its own body wraps.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from vllm_omni_tpu.analysis.engine import FileContext
+
+# terminal-name heuristic for "this attribute/variable is a lock"
+LOCK_NAME_RE = re.compile(r"(?i)(?:^|_)(?:lock|rlock|cv|cond|condition|"
+                          r"mutex|sem|semaphore)$")
+
+
+def is_lockish_name(name: str) -> bool:
+    return bool(LOCK_NAME_RE.search(name))
+
+
+def enclosing_class(node: ast.AST, ctx: FileContext) -> Optional[ast.ClassDef]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+def module_stem(ctx: FileContext) -> str:
+    base = os.path.basename(ctx.path)
+    stem = base[:-3] if base.endswith(".py") else base
+    if stem == "__init__":
+        # a package's __init__ is named by the package, not "__init__"
+        parent = os.path.basename(os.path.dirname(ctx.path))
+        return parent or stem
+    return stem
+
+
+def lock_id(expr: ast.AST, ctx: FileContext) -> Optional[str]:
+    """Canonical graph identity of a lock expression, or None when the
+    expression is not lock-shaped.  ``traced(...)`` wrappers
+    (analysis/runtime.py) are transparent: the identity comes from the
+    attribute the wrapped lock is bound to, not the call."""
+    if isinstance(expr, ast.Attribute):
+        if not is_lockish_name(expr.attr):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls"):
+                cls = enclosing_class(expr, ctx)
+                owner = cls.name if cls is not None else module_stem(ctx)
+                return f"{owner}.{expr.attr}"
+            return f"{base.id}.{expr.attr}"
+        return None
+    if isinstance(expr, ast.Name) and is_lockish_name(expr.id):
+        return f"{module_stem(ctx)}.{expr.id}"
+    return None
+
+
+def with_lock_ids(node: ast.With, ctx: FileContext) -> list[str]:
+    """Lock identities acquired by one ``with`` statement."""
+    out = []
+    for item in node.items:
+        lid = lock_id(item.context_expr, ctx)
+        if lid is not None:
+            out.append(lid)
+    return out
+
+
+def held_locks(node: ast.AST, ctx: FileContext) -> list[str]:
+    """Locks held at ``node`` per the lexical ``with`` stack, outermost
+    first — STOPPING at the nearest enclosing function/class boundary:
+    a ``with`` that merely wraps a nested ``def`` holds nothing when
+    that closure actually runs (a thread target or callback defined
+    under a lock executes after release), so crossing the boundary
+    would both bless unlocked accesses (OL7) and fabricate
+    blocking-under-lock findings (OL9) in closure bodies."""
+    withs: list[ast.With] = []
+    for anc in ctx.ancestors(node):  # innermost-first
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            break
+        if isinstance(anc, ast.With):
+            withs.append(anc)
+    out: list[str] = []
+    for w in reversed(withs):
+        out.extend(with_lock_ids(w, ctx))
+    return out
+
+
+def self_attr(expr: ast.AST) -> Optional[str]:
+    """``self.X`` / ``cls.X`` -> "X", else None."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")):
+        return expr.attr
+    return None
+
+
+def callee_terminal(func: ast.AST) -> Optional[str]:
+    """Terminal name of a call target: ``foo`` / ``a.b.foo`` -> "foo"."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def iter_local_functions(ctx: FileContext):
+    """Every function/method in the module with its resolution key:
+    "funcname" at module level (nested functions too — they're keyed by
+    their own name), "Class.method" inside a class."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cls = None
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                cls = anc
+                break
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        key = f"{cls.name}.{node.name}" if cls is not None else node.name
+        yield key, node
+
+
+def resolve_local_call(call: ast.Call,
+                       ctx: FileContext) -> Optional[str]:
+    """Resolution key for a call target defined in this module: bare
+    names -> module functions, self/cls methods -> the enclosing
+    class.  Matches the keys :func:`iter_local_functions` yields."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in ("self", "cls"):
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, ast.ClassDef):
+                return f"{anc.name}.{f.attr}"
+    return None
+
+
+def receiver_terminal(func: ast.AST) -> Optional[str]:
+    """Immediate receiver name of a method call: ``self._sock.recv`` ->
+    "_sock", ``conn.recv`` -> "conn", ``self.recv`` -> "self", bare
+    ``recv(...)`` -> None."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    base = func.value
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    if isinstance(base, ast.Call):
+        return callee_terminal(base.func)
+    return None

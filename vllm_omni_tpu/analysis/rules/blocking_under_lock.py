@@ -1,0 +1,210 @@
+"""OL9 — blocking-under-lock: unbounded waits while holding a lock.
+
+A lock held across a blocking call turns one slow operation into a
+convoy: every thread that needs the lock — the engine step loop, the
+/metrics HTTP thread, a heartbeat — stalls behind it.  This is the
+hazard class the PR 8 stall watchdog exists to catch at runtime; OL9
+catches it in review.  Scope is ``HOT_PATHS`` plus ``THREADED_PATHS``
+(the manifest's census of modules with real cross-thread locking).
+
+Flagged while a lock is lexically held (directly, or one intra-module
+call away — the helper that hides the ``recv`` still runs under the
+caller's lock):
+
+- device syncs: ``jax.device_get`` / ``.block_until_ready()`` — the
+  worst case: the lock is held until the device queue drains;
+- jit dispatch (callee named ``*jit*``): a shape-cache miss compiles
+  for seconds with the lock held;
+- sleeps: ``time.sleep`` / injected ``self._sleep``;
+- socket/channel I/O: ``.recv``/``.recv_into``/``.accept``/
+  ``.connect``/``create_connection``/``.sendall`` (and ``.send``/
+  ``.put``/``.get``/``.join``/``.result`` on receivers whose names say
+  socket/channel/connector/store/queue/thread/future);
+- ``.wait(...)`` on anything that is NOT the lock being held
+  (``Condition.wait`` on the held condition releases it — that idiom
+  is fine and recognized);
+- file I/O (``open``) and ``subprocess.*``.
+
+Some holds are the entire point of the lock (a mutex serializing one
+socket's request/response pairing); those carry a suppression with the
+reason::
+
+    resp = _recv_frame(sock)  # omnilint: disable=OL9 - lock IS the
+    # socket serializer: send..recv must pair
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.manifest import (
+    HOT_PATHS,
+    THREADED_PATHS,
+    in_scope,
+)
+from vllm_omni_tpu.analysis.rules._jitinfo import dotted
+from vllm_omni_tpu.analysis.rules._lockinfo import (
+    callee_terminal,
+    held_locks,
+    iter_local_functions,
+    lock_id,
+    receiver_terminal,
+    resolve_local_call,
+)
+
+_JIT_NAME = re.compile(r"(?:^|_)jit(?:_|$|ted)")
+_SOCKETISH_RECV = re.compile(
+    r"(?i)(sock|chan|conn|pipe|stream|client)")
+_QUEUEISH_RECV = re.compile(
+    r"(?i)(connector|store|queue|_q$|chan|inbox|intake)")
+_THREADISH_RECV = re.compile(r"(?i)(thread|proc|worker)")
+_FUTUREISH_RECV = re.compile(r"(?i)(fut|promise)")
+
+# attr names that block regardless of receiver
+_ALWAYS_BLOCKING_ATTRS = {
+    "block_until_ready": "device sync",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "accept": "socket accept",
+    "create_connection": "socket connect",
+    "sleep": "sleep",
+    "_sleep": "sleep (injected)",
+}
+
+
+def blocking_reason(call: ast.Call,
+                    held: list[str],
+                    ctx: FileContext) -> Optional[str]:
+    """Why this call can block, or None.  ``held`` is the lexical lock
+    stack at the call (needed to bless Condition.wait on the held cv)."""
+    fn = dotted(call.func) or ""
+    attr = callee_terminal(call.func) or ""
+    recv = receiver_terminal(call.func) or ""
+
+    if fn in ("jax.device_get", "jax.block_until_ready"):
+        return "device sync"
+    if fn == "time.sleep":
+        return "sleep"
+    if fn == "open":
+        return "file I/O"
+    if fn.startswith("subprocess."):
+        return "subprocess"
+    if attr in _ALWAYS_BLOCKING_ATTRS:
+        return _ALWAYS_BLOCKING_ATTRS[attr]
+    if _JIT_NAME.search(attr) or _JIT_NAME.search(fn.replace(".", "_")):
+        return "jit dispatch (compiles on cache miss)"
+    if attr in ("wait", "wait_for"):
+        # waiting on the condition you hold RELEASES it — the one
+        # blessed blocking-under-lock idiom
+        wid = lock_id(call.func.value, ctx) \
+            if isinstance(call.func, ast.Attribute) else None
+        if wid is not None and wid in held:
+            return None
+        return f"wait on '{recv or '?'}'"
+    if attr == "connect" and _SOCKETISH_RECV.search(recv):
+        return "socket connect"
+    if attr in ("send", "sendall") and _SOCKETISH_RECV.search(recv):
+        return "socket send"
+    if attr in ("put", "get") and _QUEUEISH_RECV.search(recv):
+        return "connector/queue round trip"
+    if attr == "join" and _THREADISH_RECV.search(recv):
+        return "thread join"
+    if attr == "result" and _FUTUREISH_RECV.search(recv):
+        return "future wait"
+    return None
+
+
+class BlockingUnderLockRule(Rule):
+    id = "OL9"
+    name = "blocking-under-lock"
+    node_types = (ast.Call,)
+
+    def __init__(self):
+        self._locked_calls: list[tuple[ast.Call, list[str]]] = []
+        self._directly_flagged: set[int] = set()
+
+    def applies(self, ctx: FileContext) -> bool:
+        return in_scope(ctx.path, HOT_PATHS) \
+            or in_scope(ctx.path, THREADED_PATHS)
+
+    def visit(self, node: ast.Call,
+              ctx: FileContext) -> Iterable[Finding]:
+        held = held_locks(node, ctx)
+        if not held:
+            return
+        self._locked_calls.append((node, held))
+        reason = blocking_reason(node, held, ctx)
+        if reason is not None:
+            self._directly_flagged.add(id(node))
+            name = dotted(node.func) or callee_terminal(node.func) or "?"
+            yield ctx.finding(
+                self.id, node,
+                f"{reason} ({name}) while holding "
+                f"{'/'.join(sorted(set(held)))} — every thread needing "
+                "the lock convoys behind it; move the call outside the "
+                "lock or suppress with the reason the hold is required")
+
+    # --------------------------------------------------------------- finish
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        """Second face: a call *into a same-module helper* made under a
+        lock, where the helper's unlocked body blocks."""
+        if not self._locked_calls:
+            return
+        blocking_fns = self._helper_blockers(ctx)
+        if not blocking_fns:
+            return
+        for call, held in self._locked_calls:
+            if id(call) in self._directly_flagged:
+                continue
+            target = resolve_local_call(call, ctx)
+            reason = blocking_fns.get(target)
+            if reason is None:
+                continue
+            name = dotted(call.func) or callee_terminal(call.func)
+            yield ctx.finding(
+                self.id, call,
+                f"call to {name}(), which performs {reason}, while "
+                f"holding {'/'.join(sorted(set(held)))} — the helper's "
+                "blocking call runs under the caller's lock")
+
+    def _helper_blockers(self, ctx: FileContext) -> dict:
+        """function key -> blocking reason reachable through its (and
+        its local callees') *unlocked* body.  Blocking calls already
+        under a lock inside the helper were flagged at their own site —
+        propagating them too would double-report."""
+        direct: dict[str, Optional[str]] = {}
+        calls: dict[str, set] = {}
+        for key, fn in iter_local_functions(ctx):
+            reason = None
+            callees: set = set()
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                inner_held = held_locks(sub, ctx)
+                if inner_held:
+                    continue
+                r = blocking_reason(sub, inner_held, ctx)
+                if r is not None and reason is None:
+                    reason = r
+                t = resolve_local_call(sub, ctx)
+                if t is not None and t != key:
+                    callees.add(t)
+            direct[key] = reason
+            calls[key] = callees
+        # propagate through unlocked local calls to fixpoint
+        changed = True
+        while changed:
+            changed = False
+            for k, callees in calls.items():
+                if direct.get(k) is not None:
+                    continue
+                for c in callees:
+                    r = direct.get(c)
+                    if r is not None:
+                        direct[k] = f"{r} (via {c})"
+                        changed = True
+                        break
+        return {k: v for k, v in direct.items() if v is not None}

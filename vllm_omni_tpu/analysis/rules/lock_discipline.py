@@ -1,0 +1,174 @@
+"""OL7 — lock-discipline: guarded attributes touched outside their lock.
+
+The concurrency manifest (``analysis/manifest.py`` ``LOCK_GUARDS``)
+declares, per class, which attributes are guarded by which lock.  This
+rule flags every read/write of a guarded attribute that is not covered
+by a ``with self.<lock>`` scope — the missed-lock bug class that
+produces torn snapshots and lost updates under the serving stack's
+~10 thread-spawn sites (engine loops, heartbeats, watchdog, /metrics
+HTTP threads).
+
+Coverage is resolved through **same-class call edges**, because the
+codebase's idiom is locked public methods delegating to unlocked
+private helpers (``_fail_locked``, ``_connect``, ``_drop_sock``):
+
+- an access is covered when a guarding lock is held *lexically* (an
+  enclosing ``with``), or
+- the enclosing method *inherits* the lock: it is private (``_``-named)
+  and EVERY same-class call site holds the lock (directly or by its own
+  inheritance, computed to fixpoint).  Public methods never inherit —
+  external callers hold nothing.  Call sites inside ``__init__`` /
+  ``__new__`` / ``__del__`` count as holding every lock: construction
+  and teardown are single-threaded by contract, which also exempts the
+  ubiquitous ``self._x = ...`` initialization writes.
+
+Bare ``.acquire()``/``.release()`` on a manifest lock is flagged too:
+lexical analysis (and every reader) can only trust ``with`` discipline.
+
+Deliberate unlocked access (GIL-atomic reads on a monitoring path, a
+benign racy gauge) carries a same-line suppression with the reason::
+
+    depth = len(self._ctx)  # omnilint: disable=OL7 - racy read is a gauge
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.manifest import LOCK_GUARDS
+from vllm_omni_tpu.analysis.rules._lockinfo import held_locks
+
+# construction/teardown run before/after the object is shared; call
+# sites inside them count as holding every lock
+EXEMPT_METHODS = ("__init__", "__new__", "__del__", "__post_init__")
+
+
+class LockDisciplineRule(Rule):
+    id = "OL7"
+    name = "lock-discipline"
+    node_types = (ast.ClassDef,)
+    # overridable in tests: {"path::Class": {lock_attr: (guarded, ...)}}
+    manifest = LOCK_GUARDS
+
+    def applies(self, ctx: FileContext) -> bool:
+        prefix = f"{ctx.path}::"
+        return any(k.startswith(prefix) for k in self.manifest)
+
+    def visit(self, node: ast.ClassDef,
+              ctx: FileContext) -> Iterable[Finding]:
+        guards = self.manifest.get(f"{ctx.path}::{node.name}")
+        if not guards:
+            return
+        yield from self._check_class(node, guards, ctx)
+
+    # ------------------------------------------------------------ analysis
+    def _check_class(self, cls: ast.ClassDef,
+                     guards: dict, ctx: FileContext) -> Iterable[Finding]:
+        # lock attr -> graph id ("Class._lock"); attr -> its lock ids
+        lock_ids = {la: f"{cls.name}.{la}" for la in guards}
+        attr_locks: dict[str, set[str]] = {}
+        for la, attrs in guards.items():
+            for a in attrs:
+                attr_locks.setdefault(a, set()).add(lock_ids[la])
+
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+
+        # per-method: guarded-attr accesses + same-class call sites
+        accesses: dict[str, list] = {m: [] for m in methods}
+        call_sites: dict[str, list] = {m: [] for m in methods}
+        all_locks = set(lock_ids.values())
+        bare_ops: list = []
+        for mname, mnode in methods.items():
+            for sub in ast.walk(mnode):
+                if isinstance(sub, ast.Attribute):
+                    if (sub.attr in ("acquire", "release")
+                            and isinstance(sub.value, ast.Attribute)
+                            and self._attr_owner(sub.value, cls.name)
+                            and sub.value.attr in guards):
+                        bare_ops.append((sub.value.attr, sub))
+                        continue
+                    owner = self._attr_owner(sub, cls.name)
+                    if owner is None:
+                        continue
+                    if sub.attr in attr_locks:
+                        held = set(held_locks(sub, ctx))
+                        accesses[mname].append((sub.attr, sub, held))
+                elif isinstance(sub, ast.Call):
+                    callee = self._self_call(sub)
+                    if callee in methods:
+                        held = set(held_locks(sub, ctx))
+                        if mname in EXEMPT_METHODS:
+                            held = set(all_locks)
+                        call_sites[callee].append((mname, held))
+
+        # fixpoint: which locks can a method assume its callers hold?
+        inherited: dict[str, set[str]] = {}
+        for mname in methods:
+            if mname.startswith("_") and not mname.startswith("__") \
+                    and call_sites[mname]:
+                inherited[mname] = set(all_locks)
+            else:
+                inherited[mname] = set()
+        changed = True
+        while changed:
+            changed = False
+            for mname in methods:
+                if not inherited[mname]:
+                    continue
+                assume: Optional[set] = None
+                for caller, held in call_sites[mname]:
+                    ctx_locks = held | inherited.get(caller, set())
+                    assume = (set(ctx_locks) if assume is None
+                              else assume & ctx_locks)
+                assume = assume or set()
+                if assume != inherited[mname]:
+                    inherited[mname] = assume
+                    changed = True
+
+        for attr, node in bare_ops:
+            yield ctx.finding(
+                self.id, node,
+                f"bare .{node.attr} on manifest lock '{attr}' — use "
+                f"`with self.{attr}:` so lock scope is statically "
+                "checkable")
+
+        for mname, mnode in methods.items():
+            if mname in EXEMPT_METHODS:
+                continue
+            for attr, node, held in accesses[mname]:
+                effective = held | inherited[mname]
+                if attr_locks[attr] & effective:
+                    continue
+                kind = ("write" if isinstance(node.ctx,
+                                              (ast.Store, ast.Del))
+                        else "read")
+                locks = "/".join(sorted(
+                    lid.split(".", 1)[1] for lid in attr_locks[attr]))
+                yield ctx.finding(
+                    self.id, node,
+                    f"{kind} of '{attr}' (guarded by '{locks}' per "
+                    "LOCK_GUARDS) outside the lock — wrap in "
+                    f"`with self.{locks}:` or make every same-class "
+                    "call path hold it")
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _attr_owner(node: ast.Attribute, cls_name: str) -> Optional[str]:
+        """'self' / 'cls' / the class's own name when ``node`` is an
+        instance-or-class attribute access, else None."""
+        if isinstance(node.value, ast.Name) \
+                and node.value.id in ("self", "cls", cls_name):
+            return node.value.id
+        return None
+
+    @staticmethod
+    def _self_call(node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in ("self", "cls"):
+            return f.attr
+        return None

@@ -1,0 +1,191 @@
+"""OL8 — lock-order: cycles in the process-wide acquisition graph.
+
+Two threads acquiring the same two locks in opposite orders is the
+classic deadlock — each waits for the other's lock forever, and the
+PR 8 stall watchdog can only report the wedge after the fact.  This
+rule builds the acquisition-order graph statically:
+
+- **nested ``with``**: ``with A: ... with B:`` adds edge A -> B;
+- **intra-module call edges**: a call made while holding A, to a
+  function/method defined in the same module that (transitively)
+  acquires B, also adds A -> B — the indirection idiom
+  (``with self._lock: self._helper()``) must not hide an ordering.
+
+Lock identity is ``Class._attr`` / ``<module-stem>._attr`` — the same
+node granularity as OL7's manifest and the runtime validator
+(analysis/runtime.py), so a static cycle and a runtime inversion name
+the same nodes.  Edges accumulate **across every file analyzed in one
+run** (the engine's per-run state, keyed by path), so the two halves
+of a cycle may live in different modules.  The file whose analysis
+COMPLETES the cycle reports it — once, anchored at that file's
+acquisition site and naming the reverse path's location (files
+analyzed earlier saw no cycle yet; re-running the gate is stable
+because the walk order is deterministic).  One run never leaks into
+the next: a standalone ``analyze_source`` sees only its own file
+unless the caller threads a shared ``run_state`` dict across calls.
+
+Re-entry (``with self._lock`` nested under itself — the RLock idiom)
+is never an edge and never a cycle: self-deadlock on a plain ``Lock``
+is the runtime validator's call, which knows the lock's actual type.
+
+A deliberate, documented ordering that the graph misreads (e.g. two
+locks that provably never cross threads) carries a suppression::
+
+    with self._b:  # omnilint: disable=OL8 - B outlives A, single owner
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import FileContext, Finding, Rule
+from vllm_omni_tpu.analysis.rules._lockinfo import (
+    held_locks,
+    iter_local_functions,
+    resolve_local_call,
+    with_lock_ids,
+)
+
+
+class LockOrderRule(Rule):
+    id = "OL8"
+    name = "lock-order"
+    node_types = (ast.With,)
+
+    def __init__(self):
+        self._withs: list[ast.With] = []
+
+    def visit(self, node: ast.With,
+              ctx: FileContext) -> Iterable[Finding]:
+        self._withs.append(node)
+        return ()
+
+    # --------------------------------------------------------------- finish
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        # run-scoped edge registry: path -> {(holder, acquired):
+        # (line, qualname)} — all files of one analyze_paths run share
+        # it through the engine's run_state
+        registry = self.run_state.setdefault("ol8_edges", {})
+        edges = self._file_edges(ctx)
+        registry[ctx.path] = edges
+        if not edges:
+            return
+        merged: dict[tuple, tuple] = {}
+        for path, fe in registry.items():
+            for edge, (line, qual) in fe.items():
+                merged.setdefault(edge, (path, line, qual))
+        reported: set[frozenset] = set()
+        for (a, b) in sorted(edges):
+            rev = self._find_path(merged, b, a)
+            if rev is None:
+                continue
+            # one cycle = one finding: dedup by the cycle's full node
+            # set (edge-pair keying would report a k-lock cycle k times)
+            key = frozenset(set(rev) | {a, b})
+            if key in reported:
+                continue
+            reported.add(key)
+            line, qual = edges[(a, b)]
+            # where the first reverse leg lives (path + qualname, no
+            # line number: the fingerprint must survive unrelated edits)
+            rpath, _rline, rqual = merged[(rev[0], rev[1])]
+            yield Finding(
+                rule=self.id, path=ctx.path, line=line,
+                symbol=qual,
+                message=(
+                    f"potential deadlock: {a} -> {b} acquired here, "
+                    f"but the reverse order {' -> '.join(rev)} exists "
+                    f"at {rpath} ({rqual or 'module'}) — pick one "
+                    "global order or collapse to a single lock"),
+                stmt_span=(line, line))
+
+    # ---------------------------------------------------------- edge build
+    def _file_edges(self, ctx: FileContext) -> dict:
+        edges: dict[tuple, tuple] = {}
+        if not any(with_lock_ids(w, ctx) for w in self._withs):
+            return edges  # no lock acquisitions at all in this file
+
+        def add(a: str, b: str, node: ast.AST) -> None:
+            if a == b:
+                return  # re-entry (RLock idiom) is not an ordering
+            edges.setdefault(
+                (a, b),
+                (getattr(node, "lineno", 1), ctx.qualname(node)))
+
+        # 1. direct lexical nesting — including WITHIN one multi-item
+        # statement: `with A, B:` acquires left-to-right, so it is the
+        # same ordering fact as `with A: with B:`
+        for w in self._withs:
+            held = held_locks(w, ctx)
+            ids = with_lock_ids(w, ctx)
+            for i, lid in enumerate(ids):
+                for h in held:
+                    add(h, lid, w)
+                for prior in ids[:i]:
+                    add(prior, lid, w)
+
+        # 2. intra-module call edges: calls under a lock into local
+        # functions whose closure acquires more locks
+        acquires = self._closure_acquires(ctx)
+        if acquires:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = held_locks(node, ctx)
+                if not held:
+                    continue
+                target = resolve_local_call(node, ctx)
+                if target is None:
+                    continue
+                for lid in sorted(acquires.get(target, ())):
+                    for h in held:
+                        add(h, lid, node)
+        return edges
+
+    def _closure_acquires(self, ctx: FileContext) -> dict:
+        """function key -> lock ids its transitive local closure can
+        acquire.  Keys are "funcname" (module level) / "Class.method".
+        ``ast.walk`` includes nested function bodies, so a method whose
+        inner closure acquires a lock (the ``rpc``-under-retry idiom)
+        counts as acquiring it — a deliberate over-approximation: the
+        closure usually runs while the method is on the stack."""
+        direct: dict[str, set] = {}
+        calls: dict[str, set] = {}
+        for key, fn in iter_local_functions(ctx):
+            acq: set = set()
+            callees: set = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.With):
+                    acq.update(with_lock_ids(sub, ctx))
+                elif isinstance(sub, ast.Call):
+                    t = resolve_local_call(sub, ctx)
+                    if t is not None and t != key:
+                        callees.add(t)
+            direct[key] = acq
+            calls[key] = callees
+        closure = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, callees in calls.items():
+                for c in callees:
+                    extra = closure.get(c, set()) - closure[k]
+                    if extra:
+                        closure[k] |= extra
+                        changed = True
+        return {k: v for k, v in closure.items() if v}
+
+    @staticmethod
+    def _find_path(merged: dict, src: str, dst: str) -> Optional[list]:
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for (a, b) in sorted(merged):
+                if a == node and b not in seen:
+                    seen.add(b)
+                    stack.append((b, path + [b]))
+        return None

@@ -1,0 +1,366 @@
+"""omnirace runtime validator: traced locks + live deadlock detection.
+
+The static rules (OL7-OL9) see lock discipline the AST can prove; this
+module sees the discipline the PROCESS actually exercises.  The two
+validate each other: a static lock-order cycle that never manifests is
+noise to triage, and a runtime inversion the AST cannot see (callbacks,
+dynamic dispatch, locks passed across modules) is exactly the
+once-a-week wedge the PR 8 stall watchdog exists to catch after the
+fact — this module catches it before the hang, in the test suite.
+
+Opt-in and zero-cost when off: ``traced(lock, name)`` returns ``lock``
+UNCHANGED unless ``OMNI_TPU_LOCK_CHECK=1`` at wrap time, so production
+paths pay nothing — no wrapper object, no per-acquire bookkeeping, not
+even an attribute indirection.  The heavy threaded suites (disagg
+router + chaos loadgen, resilience supervisor, introspection watchdog,
+async engine) enable it via an autouse fixture and call
+``assert_clean()`` at teardown.
+
+What the wrapper records, per acquisition, into ONE process-global
+graph keyed by lock *name* (``Class._attr`` — all instances of a class
+share a node, the same granularity rule OL8 reasons at):
+
+- **order edges** ``A -> B``: some thread acquired B while holding A,
+  with the first-seen code site.  An acquisition that would create a
+  path-reversing edge (B is already an ancestor of A) records an
+  **inversion violation** naming both code paths — the two sides of a
+  potential deadlock, even if this run interleaved them safely.
+- **wait cycles**, live: before blocking on a contended lock the
+  wrapper walks the waits-for graph (per-INSTANCE owners, so two
+  instances of one class never alias); a cycle means the block would
+  never return — it raises :class:`LockOrderViolation` in the acquiring
+  thread instead of deadlocking the suite.  Re-entrant RLock
+  acquisition is recognized and never an edge or a cycle; re-entering a
+  plain ``Lock`` is reported as a self-deadlock.
+
+``Condition`` wrappers forward ``wait``/``notify``/``notify_all`` and
+mark the lock released for the duration of ``wait`` (Condition drops it
+internally — holding it in the books would fabricate inversions).
+
+See docs/debugging.md ("Lock-order checking") for how to read a
+reported cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Optional
+
+__all__ = [
+    "LockOrderViolation",
+    "TracedLock",
+    "assert_clean",
+    "enabled",
+    "lock_graph",
+    "reset",
+    "traced",
+    "violations",
+]
+
+
+class LockOrderViolation(RuntimeError):
+    """A wait-for cycle was detected at acquire time: blocking would
+    deadlock.  Raised in the acquiring thread so the suite fails with
+    the two code paths instead of hanging until a CI timeout."""
+
+
+def enabled() -> bool:
+    return os.environ.get("OMNI_TPU_LOCK_CHECK") == "1"
+
+
+# ------------------------------------------------------------ global state
+# The meta-lock guards every structure below.  It is, deliberately, a
+# raw lock: tracing the tracer would recurse.  It is leaf-only — held
+# for dict work, never while acquiring a traced lock — so it can't
+# participate in any cycle it would report.
+_state_lock = threading.Lock()
+# (holder_name, acquired_name) -> first-seen site description
+_edges: dict[tuple[str, str], str] = {}
+# recorded inversion/self-deadlock reports (deduped by lock-name pair)
+_violations: list[str] = []
+_seen_pairs: set[frozenset] = set()
+# instance-level ownership for wait-cycle detection: two instances of
+# one class must never alias (hist_a held by T1 must not make T2's
+# block on hist_b look like a cycle)
+_owners: dict[int, int] = {}      # id(wrapper) -> owning thread ident
+_wants: dict[int, "TracedLock"] = {}  # thread ident -> wrapper it blocks on
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    """This thread's stack of (wrapper, count) acquisitions."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _site(name: str) -> str:
+    """One human line for where an acquisition happened: the innermost
+    caller frame outside this module."""
+    for frame in reversed(traceback.extract_stack()):
+        if os.path.basename(frame.filename) == "runtime.py":
+            continue
+        return (f"{name} at {frame.filename}:{frame.lineno} "
+                f"in {frame.name} [thread {threading.current_thread().name}]")
+    return name
+
+
+def _path_between(src: str, dst: str) -> Optional[list[str]]:
+    """Lock-name path src -> ... -> dst through the order-edge graph
+    (caller holds _state_lock)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for (a, b) in _edges:
+            if a == node and b not in seen:
+                seen.add(b)
+                stack.append((b, path + [b]))
+    return None
+
+
+class TracedLock:
+    """Order-checking wrapper over Lock/RLock/Condition.
+
+    Context-manager and ``acquire``/``release`` faces match the wrapped
+    primitive; everything else (``wait``, ``notify``, ``locked``, ...)
+    is delegated, with ``wait`` additionally releasing the bookkeeping
+    for its duration.
+    """
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self.name = name
+
+    def __repr__(self) -> str:
+        return f"TracedLock({self.name!r}, {self._inner!r})"
+
+    # ------------------------------------------------------- bookkeeping
+    def _note_acquired(self, reentrant: bool) -> None:
+        me = threading.get_ident()
+        stack = _held()
+        if reentrant:
+            for entry in stack:
+                if entry[0] is self:
+                    entry[1] += 1
+                    return
+        with _state_lock:
+            _owners[id(self)] = me
+            for wrapper, _count in stack:
+                held_name = wrapper.name
+                if held_name == self.name:
+                    continue
+                pair = (held_name, self.name)
+                if pair not in _edges:
+                    # inversion: acquiring B under A when the graph
+                    # already shows a path B -> ... -> A
+                    rev = _path_between(self.name, held_name)
+                    if rev is not None:
+                        key = frozenset((held_name, self.name))
+                        if key not in _seen_pairs:
+                            _seen_pairs.add(key)
+                            first = _edges.get((rev[0], rev[1]), "?")
+                            _violations.append(
+                                "lock-order inversion: "
+                                f"{held_name} -> {self.name} "
+                                f"({_site(self.name)}) vs existing "
+                                f"{' -> '.join(rev)} (first seen: "
+                                f"{first})")
+                    _edges[pair] = _site(self.name)
+        stack.append([self, 1])
+
+    def _note_released(self) -> bool:
+        """True when this thread's bookkeeping actually dropped a
+        recorded acquisition (False: release of a lock never acquired
+        through the wrapper — ignored)."""
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is self:
+                stack[i][1] -= 1
+                if stack[i][1] == 0:
+                    del stack[i]
+                    with _state_lock:
+                        _owners.pop(id(self), None)
+                return True
+        return False
+
+    def _held_by_me(self) -> bool:
+        return any(entry[0] is self for entry in _held())
+
+    def _check_wait_cycle(self) -> None:
+        """Caller is about to block on self: walk waits-for (me wants
+        self; self's owner wants X; X's owner wants ...).  Raises
+        instead of letting the suite hang."""
+        me = threading.get_ident()
+        with _state_lock:
+            chain = [self]
+            seen_threads = {me}
+            cur = self
+            while True:
+                owner = _owners.get(id(cur))
+                if owner is None:
+                    return
+                if owner in seen_threads:
+                    names = " -> ".join(w.name for w in chain)
+                    report = ("deadlock (wait cycle): thread "
+                              f"{threading.current_thread().name} "
+                              f"blocking on {self.name} closes the "
+                              f"cycle [{names}]; {_site(self.name)}")
+                    _violations.append(report)
+                    raise LockOrderViolation(report)
+                seen_threads.add(owner)
+                nxt = _wants.get(owner)
+                if nxt is None:
+                    return
+                chain.append(nxt)
+                cur = nxt
+
+    # ---------------------------------------------------------- acquire
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if self._held_by_me():
+            # re-entrant path: RLock grants immediately; a plain Lock
+            # would block on itself forever — report it instead.  A
+            # NON-blocking probe on an already-held plain Lock is legal
+            # (it cannot deadlock) and must return False like the raw
+            # primitive, not raise.
+            got = self._inner.acquire(blocking=False)
+            if not got:
+                if not blocking:
+                    return False
+                report = ("self-deadlock: re-acquiring non-reentrant "
+                          f"lock {self.name}; {_site(self.name)}")
+                with _state_lock:
+                    _violations.append(report)
+                raise LockOrderViolation(report)
+            self._note_acquired(reentrant=True)
+            return True
+        got = self._inner.acquire(blocking=False)
+        if not got:
+            if not blocking:
+                return False
+            me = threading.get_ident()
+            with _state_lock:
+                _wants[me] = self
+            try:
+                self._check_wait_cycle()
+                if timeout is not None and timeout >= 0:
+                    got = self._inner.acquire(True, timeout)
+                else:
+                    got = self._inner.acquire()
+            finally:
+                with _state_lock:
+                    _wants.pop(me, None)
+            if not got:
+                return False
+        self._note_acquired(reentrant=False)
+        return True
+
+    def release(self) -> None:
+        # bookkeeping BEFORE the inner release: releasing first would
+        # let a woken contender record its new ownership, which our
+        # late _note_released() would then erase — blinding the
+        # wait-cycle walk for the contender's whole hold.  The reverse
+        # window (books cleared while we still hold for an instant) can
+        # only make a cycle check miss a lock whose release is already
+        # in progress — a cycle that is resolving itself.
+        noted = self._note_released()
+        try:
+            self._inner.release()
+        except BaseException:
+            if noted:
+                # inner refused (e.g. not owned): restore the books
+                self._note_acquired(reentrant=False)
+            raise
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # ------------------------------------------- Condition delegation
+    def wait(self, timeout: Optional[float] = None):
+        # Condition.wait releases the underlying lock for the duration;
+        # mirror that in the books or every lock acquired by OTHER
+        # threads while we sleep would look like it nests under ours.
+        # Restore ONLY what was dropped: wait() on an un-held condition
+        # raises from inner.wait, and re-acquiring books we never held
+        # would corrupt this thread's stack for the whole session.
+        noted = self._note_released()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            if noted:
+                self._note_acquired(reentrant=False)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        noted = self._note_released()
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            if noted:
+                self._note_acquired(reentrant=False)
+
+    def __getattr__(self, attr):
+        # notify/notify_all/locked/... pass straight through
+        return getattr(self._inner, attr)
+
+
+def traced(lock, name: str):
+    """Wrap ``lock`` for order checking — or return it untouched when
+    ``OMNI_TPU_LOCK_CHECK`` is off (the zero-overhead contract: the
+    decision is made once, at creation, not per acquire).
+
+    ``name`` should be ``Class._attr`` (or ``module._attr``): it is the
+    graph-node identity, deliberately shared by all instances of a
+    class so the order relation is about code paths, not objects.
+    """
+    if not enabled():
+        return lock
+    return TracedLock(lock, name)
+
+
+# -------------------------------------------------------------- inspection
+def violations() -> list[str]:
+    with _state_lock:
+        return list(_violations)
+
+
+def lock_graph() -> dict[str, list[str]]:
+    """Adjacency view of the observed acquisition order (debug aid)."""
+    out: dict[str, list[str]] = {}
+    with _state_lock:
+        for (a, b) in sorted(_edges):
+            out.setdefault(a, []).append(b)
+    return out
+
+
+def reset() -> None:
+    """Clear all recorded state (test isolation; per-thread held stacks
+    clear themselves as locks release)."""
+    with _state_lock:
+        _edges.clear()
+        _violations.clear()
+        _seen_pairs.clear()
+        _owners.clear()
+        _wants.clear()
+
+
+def assert_clean(do_reset: bool = True) -> None:
+    """Raise AssertionError listing every recorded violation (suite
+    teardown contract).  Resets afterwards by default so one poisoned
+    test doesn't fail the rest of the session."""
+    found = violations()
+    if do_reset:
+        reset()
+    if found:
+        raise AssertionError(
+            "lock-order violations recorded "
+            f"({len(found)}):\n" + "\n".join(f"  - {v}" for v in found))

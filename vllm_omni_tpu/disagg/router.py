@@ -38,6 +38,17 @@ Counters ride the process-global resilience registry
 ``router_healthy_replicas{role}``, ``degraded_mode``) so any /metrics
 render in the process shows them; the ``kv_handoff_seconds`` histogram
 renders through the exposition's ``disagg`` block.
+
+Concurrency contract (omnirace-audited): the router is SINGLE-THREADED
+by design and therefore lock-free — exactly one thread (DisaggService's
+``disagg-engine`` loop) calls ``submit``/``step``/``poll``/``drain``;
+intake crosses the thread boundary through ``DisaggService._intake``
+(a queue), never by touching ``_ctx``/``_payloads``/``_finished`` from
+the event loop.  The shared state it DOES touch — resilience_metrics,
+the handoff Histogram, connector stores — is the locked kind, and
+those locks are traced under ``OMNI_TPU_LOCK_CHECK=1`` in the disagg
+suites.  Grow a second router thread and the lock-free dicts here stop
+being safe: add a lock and declare it in LOCK_GUARDS first.
 """
 
 from __future__ import annotations

@@ -20,6 +20,7 @@ import time
 from abc import ABC, abstractmethod
 from typing import Any, Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.distributed.serialization import OmniSerializer
 from vllm_omni_tpu.logger import init_logger
 
@@ -82,13 +83,30 @@ class InProcConnector(OmniConnectorBase):
 
     zero_copy = True
 
-    _stores: dict[str, dict[str, bytes]] = {}
-    _lock = threading.Lock()
+    # namespace -> (store, condition), shared by EVERY instance of that
+    # namespace.  The condition must be per-STORE, not per-instance:
+    # two instances of one namespace share the dict, so they must share
+    # the wakeup channel too — with a private per-instance cv (the old
+    # shape), a put through instance A never notified a get blocked on
+    # instance B, which then only progressed on its 1 s re-check slice.
+    _stores: dict[str, tuple[dict, Any]] = {}
+    # deliberately class-level (process-global): it guards the
+    # class-level namespace registry above — a per-instance lock could
+    # not serialize two instances creating the same namespace.  Taken
+    # only at construction, never on the data path (the per-namespace
+    # cv owns that), so cross-instance contention is nil.
+    _registry_lock = threading.Lock()
 
     def __init__(self, namespace: str = "default", **_):
-        with InProcConnector._lock:
-            self._store = InProcConnector._stores.setdefault(namespace, {})
-        self._cv = threading.Condition()
+        with InProcConnector._registry_lock:
+            # omnilint: disable=OL9 - local registry dict probe, not a
+            # remote store round trip; non-blocking under the lock
+            entry = InProcConnector._stores.get(namespace)
+            if entry is None:
+                entry = InProcConnector._stores[namespace] = (
+                    {}, traced(threading.Condition(),
+                               "InProcConnector._cv"))
+        self._store, self._cv = entry
 
     def _put_bytes(self, key: str, data: bytes) -> None:
         with self._cv:
@@ -100,6 +118,8 @@ class InProcConnector(OmniConnectorBase):
         with self._cv:
             while key not in self._store:
                 if deadline is None:
+                    # omnilint: disable=OL9 - local dict probe, not a
+                    # remote store round trip; non-blocking under the cv
                     return self._store.get(key)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.distributed.connectors import (
     ConnectorFactory,
     OmniConnectorBase,
@@ -79,7 +80,7 @@ class KVStoreServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._store: dict[str, bytes] = {}
-        self._cv = threading.Condition()
+        self._cv = traced(threading.Condition(), "KVStoreServer._cv")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -200,7 +201,7 @@ class TCPConnector(OmniConnectorBase):
         self._retry_policy = RetryPolicy(**(retry or {}))
         self._breaker = CircuitBreaker(
             site=f"tcp:{address}", **(breaker or {}))
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "TCPConnector._lock")
         self._sock: Optional[socket.socket] = None
 
     def _connect(self) -> socket.socket:
@@ -238,11 +239,20 @@ class TCPConnector(OmniConnectorBase):
             # ANY failure closes the socket — a late response left in
             # the stream would otherwise be read as the next request's
             # reply (desync)
+            # the lock IS the socket serializer: one persistent socket,
+            # many caller threads — connect, send, and the matching recv
+            # must pair atomically per RPC or replies desync.  Holding
+            # it across the (blocking) network round trip is therefore
+            # the lock's contract, not an accident (OL9 below).
             with self._lock:
                 try:
+                    # omnilint: disable=OL9 - see above: the hold is
+                    # the request/response pairing invariant
                     sock = self._connect()
                     sock.settimeout(sock_timeout)
+                    # omnilint: disable=OL9 - see above
                     _send_frame(sock, frame)
+                    # omnilint: disable=OL9 - see above
                     resp = _recv_frame(sock)
                 except BaseException:
                     self._drop_sock()

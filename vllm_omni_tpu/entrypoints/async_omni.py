@@ -17,6 +17,7 @@ import queue
 import threading
 from typing import Any, AsyncIterator, Optional, Union
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.config.stage import StageConfig
 from vllm_omni_tpu.entrypoints.omni import Omni
 from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
@@ -57,7 +58,8 @@ class AsyncOmni:
         # the lock) is guaranteed to see it in _streams
         self._resume_event = threading.Event()
         self._resume_event.set()
-        self._pause_lock = threading.Lock()
+        self._pause_lock = traced(threading.Lock(),
+                                  "AsyncOmni._pause_lock")
         # engine-level stats heartbeat period (seconds); tests shrink it
         self._stats_interval = 10.0
         self._thread = threading.Thread(target=self._engine_loop,
@@ -206,7 +208,10 @@ class AsyncOmni:
                     self._finals_seen[request_id] = 0
                     # enqueue INSIDE the lock: a put after release could
                     # slip past a concurrent pause's intake-empty check
-                    # and run mid-weight-swap
+                    # and run mid-weight-swap.  The queue is unbounded,
+                    # so the put never actually blocks:
+                    # omnilint: disable=OL9 - unbounded queue put;
+                    # in-lock enqueue is the pause-gate invariant
                     self._intake.put(req)
                     break
             if not self._running:

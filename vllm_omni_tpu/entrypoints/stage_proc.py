@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.config.stage import StageConfig
 from vllm_omni_tpu.distributed.serialization import OmniSerializer
 from vllm_omni_tpu.distributed.tcp import _recv_frame, _send_frame
@@ -432,7 +433,8 @@ class ProcStage(OmniStage):
         self._fatal: Optional[str] = None
         # submit (engine loop) and profile RPC (HTTP thread) may send
         # concurrently; frames must not interleave
-        self._send_lock = threading.Lock()
+        self._send_lock = traced(threading.Lock(),
+                                 "ProcStage._send_lock")
         self._profile_ack = threading.Event()
         # supervision (resilience/supervisor.py): a supervised stage
         # leaves in-flight requests alone when the worker dies — the
@@ -632,6 +634,17 @@ class ProcStage(OmniStage):
             self._fatal = "worker channel closed"
 
     # ---------------------------------------------------------- liveness
+    def _locked_send(self, frame: dict) -> None:
+        """The ONE place a frame crosses the command channel.  Submit
+        (engine loop), ping (heartbeat thread), and the profile/shutdown
+        RPCs (server thread) all race here; interleaved writes would
+        corrupt the pickle stream, so the send lock is held ACROSS the
+        write — that is the lock's whole contract, not an accident."""
+        with self._send_lock:
+            # omnilint: disable=OL9 - the send lock IS the frame
+            # serializer; holding it across the pipe write is the point
+            self._chan.send(frame)
+
     def ping(self) -> bool:
         """Send a liveness heartbeat; the worker replies with a ``pong``
         frame (handled in ``_reader``).  Returns False when the channel
@@ -639,8 +652,7 @@ class ProcStage(OmniStage):
         if self._fatal is not None:
             return False
         try:
-            with self._send_lock:
-                self._chan.send({"type": "ping"})
+            self._locked_send({"type": "ping"})
             return True
         except (ConnectionError, OSError, ValueError) as e:
             self._fatal = f"ping failed: {type(e).__name__}: {e}"
@@ -704,8 +716,7 @@ class ProcStage(OmniStage):
             self._inflight.add(r.request_id)
         if self._fatal is None:
             try:
-                with self._send_lock:
-                    self._chan.send({"type": "submit", "requests": reqs})
+                self._locked_send({"type": "submit", "requests": reqs})
             except (ConnectionError, OSError, ValueError) as e:
                 # worker died between batches: the next poll() converts
                 # the whole in-flight set to per-request error outputs —
@@ -797,9 +808,8 @@ class ProcStage(OmniStage):
                            self.stage_id)
             return
         try:
-            with self._send_lock:
-                self._chan.send({"type": "profile_start",
-                                 "trace_dir": trace_dir})
+            self._locked_send({"type": "profile_start",
+                               "trace_dir": trace_dir})
         except (ConnectionError, OSError) as e:
             self._fatal = f"profile_start failed: {e}"
 
@@ -812,8 +822,7 @@ class ProcStage(OmniStage):
             return
         self._profile_ack.clear()
         try:
-            with self._send_lock:
-                self._chan.send({"type": "profile_stop"})
+            self._locked_send({"type": "profile_stop"})
         except (ConnectionError, OSError) as e:
             self._fatal = f"profile_stop failed: {e}"
             return
@@ -833,8 +842,7 @@ class ProcStage(OmniStage):
     # ----------------------------------------------------------- shutdown
     def shutdown(self, timeout: float = 10.0) -> None:
         try:
-            with self._send_lock:
-                self._chan.send({"type": "shutdown"})
+            self._locked_send({"type": "shutdown"})
         except (ConnectionError, OSError):
             pass
         if self._proc is not None:

@@ -36,6 +36,7 @@ import traceback
 from collections import deque
 from typing import Any, Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.logger import init_logger
 
 logger = init_logger(__name__)
@@ -58,7 +59,7 @@ class FlightRecorder:
         self.capacity = capacity
         self.name = name
         self._ring: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "FlightRecorder._lock")
         self._seq = 0
         self._dropped = 0
         # monotonic stamp of the last append — /health reports this as

@@ -31,6 +31,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
+
 COMPONENT_WORKSPACE = "workspace"
 
 
@@ -52,7 +54,8 @@ class DeviceMemoryLedger:
             stats_fn = device_memory_stats
         self._components_fn = components_fn
         self._stats_fn = stats_fn
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(),
+                            "DeviceMemoryLedger._lock")
         self._peaks: dict[str, int] = {}
         self._peak_total = 0
         self._last: dict = {}

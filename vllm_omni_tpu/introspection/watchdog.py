@@ -44,6 +44,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.introspection.flight_recorder import (
     build_dump,
     dump_to_file,
@@ -102,7 +103,7 @@ class StallWatchdog:
         self._dump_path = dump_path
         self._on_trip: list[Callable[[dict], None]] = (
             [on_trip] if on_trip else [])
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "StallWatchdog._lock")
         self._sources: dict[str, _SourceState] = {}
         # weak handles to engines for the trip dump's request tables +
         # flight-recorder tails (the introspection registry owns the
@@ -240,6 +241,10 @@ class StallWatchdog:
             "stall watchdog TRIPPED: %s made no progress for %.1fs "
             "(deadline %.1fs)", ", ".join(names), worst, self.deadline_s)
         engines = introspection.iter_engines()
+        # registry read under the lock: add_source from another thread
+        # mid-trip must not race the dump's source inventory (OL7)
+        with self._lock:
+            registered = sorted(self._sources)
         extra: dict[str, Any] = {
             "watchdog": {
                 "deadline_s": self.deadline_s,
@@ -249,7 +254,7 @@ class StallWatchdog:
                      "detail": st.detail}
                     for st, s in stalled
                 ],
-                "sources": sorted(self._sources),
+                "sources": registered,
             },
             "requests": [
                 {"engine": getattr(e, "stage_id", i),

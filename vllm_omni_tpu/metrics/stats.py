@@ -18,6 +18,8 @@ from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
+
 
 @dataclass
 class StageRequestStats:
@@ -111,7 +113,7 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._window: deque = deque(maxlen=window)
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "Histogram._lock")
 
     def observe(self, value: float, n: int = 1) -> None:
         """Record ``value`` ``n`` times (n>1 amortizes per-token metrics

@@ -17,6 +17,7 @@ import subprocess
 import threading
 from typing import Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.logger import init_logger
 
 logger = init_logger(__name__)
@@ -47,6 +48,8 @@ def load_shm_ring() -> ctypes.CDLL:
     global _lib
     with _lock:
         if _lib is None:
+            # omnilint: disable=OL9 - one-time toolchain build; the
+            # lock exists precisely to serialize concurrent builders
             lib = ctypes.CDLL(_build(), use_errno=True)
             lib.shm_ring_open.restype = ctypes.c_void_p
             lib.shm_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
@@ -94,7 +97,7 @@ class ShmRing:
             )
         self.name = name
         self.owner = owner
-        self._op_lock = threading.Lock()
+        self._op_lock = traced(threading.Lock(), "ShmRing._op_lock")
 
     @property
     def capacity(self) -> int:

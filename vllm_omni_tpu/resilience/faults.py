@@ -55,6 +55,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.resilience.metrics import resilience_metrics
 
@@ -125,7 +126,7 @@ class FaultInjector:
 
     def __init__(self, plan: FaultPlan):
         self.plan = plan
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "FaultInjector._lock")
         self._steps: dict[str, int] = {}
         self._rngs: dict[str, random.Random] = {}
 

@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Iterable
 
+from vllm_omni_tpu.analysis.runtime import traced
+
 
 def _label_key(labels: dict) -> tuple:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -27,7 +29,7 @@ class ResilienceMetrics:
     """Thread-safe labeled counters/gauges with a render-ready snapshot."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "ResilienceMetrics._lock")
         # name -> {label_key -> value}
         self._counters: dict[str, dict[tuple, float]] = {}
         self._gauges: dict[str, dict[tuple, float]] = {}

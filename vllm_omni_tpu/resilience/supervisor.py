@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
 from vllm_omni_tpu.config.stage import StageConfig
 from vllm_omni_tpu.entrypoints.omni_stage import StageRequest
 from vllm_omni_tpu.logger import init_logger
@@ -99,7 +100,8 @@ class StageSupervisor:
         self._clock = clock
         self._sleep = sleep
         self._rng = random.Random(f"supervisor/{config.stage_id}")
-        self._lock = threading.RLock()
+        self._lock = traced(threading.RLock(),
+                            "StageSupervisor._lock")
         # request_id -> original StageRequest (the redelivery payload)
         self._tracked: dict[str, StageRequest] = {}
         self._redelivered: set[str] = set()

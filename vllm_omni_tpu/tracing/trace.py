@@ -34,6 +34,8 @@ import uuid
 from collections import deque
 from typing import Any, Optional
 
+from vllm_omni_tpu.analysis.runtime import traced
+
 
 def new_trace_context(request_id: str) -> dict:
     """Fresh per-request trace context (created once, at arrival)."""
@@ -54,7 +56,7 @@ class TraceRecorder:
     def __init__(self, capacity: int = 65536):
         self._capacity = capacity
         self._spans: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "TraceRecorder._lock")
         self._dropped = 0
 
     def record(
@@ -180,7 +182,7 @@ class TraceWriter:
     def __init__(self, path_prefix: str, chrome_capacity: int = 200_000):
         self._prefix = path_prefix
         self._spans: deque = deque(maxlen=chrome_capacity)
-        self._lock = threading.Lock()
+        self._lock = traced(threading.Lock(), "TraceWriter._lock")
 
     @property
     def jsonl_path(self) -> str:
@@ -195,6 +197,9 @@ class TraceWriter:
             return
         with self._lock:
             self._spans.extend(spans)
+            # omnilint: disable=OL9 - the jsonl append must stay
+            # ordered with the chrome buffer extend above; writers are
+            # rare (drain cadence) and the file is local append-only
             with open(self.jsonl_path, "a") as f:
                 for s in spans:
                     f.write(json.dumps(s) + "\n")
