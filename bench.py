@@ -32,11 +32,13 @@ OMNI_BENCH_SCHEDULER (euler|unipc) / OMNI_BENCH_CACHE=1 (force TeaCache
 on the flagship itself) / OMNI_BENCH_PEAK_TFLOPS / OMNI_BENCH_BUDGET_S
 (wall-clock budget; variants are skipped when exceeded) /
 OMNI_BENCH_SKIP_AR=1 / OMNI_BENCH_AR_ASYNC=1 (AR bench runs the async
-pipelined step instead of the multi-step window; the emitted
-"step_phase" block reports host/device ms + overlap ratio either way) /
-OMNI_BENCH_AR_UNIFIED=1 (unified ragged mixed batching: one token-packed
-dispatch per mixed step; step_phase reports padding efficiency either
-way, so split vs unified runs are directly comparable) /
+pipelined step — the round-trip amortization that replaced the retired
+multi-step window; the emitted "step_phase" block reports host/device
+ms + overlap ratio either way) /
+OMNI_BENCH_AR_UNIFIED=1 (unified SCHEDULER packing policy — decodes
+claim the budget first, chunked prefill as mechanism; execution is
+always one token-packed dispatch per non-pure-decode step since PR 11,
+and step_phase reports padding efficiency either way) /
 OMNI_BENCH_SKIP_CACHE_VARIANT=1 /
 OMNI_BENCH_QUANT (int8|fp8 weight-only on the flagship; int8 halves the
 streamed transfer bytes) / OMNI_BENCH_SKIP_QUANT_VARIANT=1 /
@@ -664,33 +666,33 @@ def bench_ar() -> dict:
     )
     _progress("ar: init bench-scale MoE thinker (~8.8 GB bf16)")
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.bfloat16)
-    # multi_step_decode: W decode iterations per device call (on-device
-    # sampling) — on a remote-attached chip each host->device round trip
-    # costs network RTT, and single-step decode is RTT-bound (measured
-    # 0.5 s/step vs ~30 ms of compute; W=8 took the probe from 35 to
-    # 231 tok/s once mid-run compiles were gone).  The 8192-token
-    # prefill budget admits all 16 default requests in ONE prefill call
-    # (4 calls at the old 2048), so TTFT measures prefill, not RTT
-    # queueing.  64 pages/request = full prompt+gen headroom for every
-    # seat, so the whole fleet decodes concurrently.
+    # On a remote-attached chip each host->device round trip costs
+    # network RTT and single-step sync decode is RTT-bound (measured
+    # 0.5 s/step vs ~30 ms of compute) — OMNI_BENCH_AR_ASYNC=1 is the
+    # round-trip amortization (the retired multi-step scan measured 35
+    # -> 231 tok/s for the same reason).  The 8192-token prefill budget
+    # admits all 16 default requests in ONE prefill call (4 calls at
+    # the old 2048), so TTFT measures prefill, not RTT queueing.
+    # 64 pages/request = full prompt+gen headroom for every seat, so
+    # the whole fleet decodes concurrently.
     n_reqs = int(os.environ.get("OMNI_BENCH_AR_REQS", "16"))
     mbt = int(os.environ.get("OMNI_BENCH_AR_BATCHED", "8192"))
-    w = int(os.environ.get("OMNI_BENCH_AR_WINDOW", "8"))
-    # OMNI_BENCH_AR_ASYNC=1: run the async pipelined step instead of the
-    # multi-step window — per-step host work overlaps device compute via
-    # device-resident sampled tokens (docs/async_engine.md); the
-    # step-phase breakdown below makes the two modes comparable
+    # OMNI_BENCH_AR_ASYNC=1: the async pipelined step — per-step host
+    # work overlaps device compute via device-resident sampled tokens
+    # (docs/async_engine.md); the multi-step scan window it replaced is
+    # retired (PR 11).  The step-phase breakdown below quantifies it.
     use_async = os.environ.get("OMNI_BENCH_AR_ASYNC", "") == "1"
-    # OMNI_BENCH_AR_UNIFIED=1: mixed prefill+decode steps run as ONE
-    # token-packed ragged dispatch (docs/ragged_batching.md); the
-    # step_phase padding_efficiency line quantifies the win over the
-    # split path's (batch, seq) bucket padding
+    # OMNI_BENCH_AR_UNIFIED=1: the SCHEDULER packing policy (decodes
+    # claim the budget first, chunked prefill as the mechanism).  The
+    # execution mechanism is always unified since PR 11 — every
+    # non-pure-decode step is ONE token-packed ragged dispatch
+    # (docs/ragged_batching.md); step_phase padding_efficiency
+    # quantifies the win over the retired (batch, seq) bucket grid.
     use_unified = os.environ.get("OMNI_BENCH_AR_UNIFIED", "") == "1"
     engine = LLMEngine(params, cfg, EngineConfig(
         num_pages=64 * n_reqs, page_size=16, max_model_len=2048,
         max_num_seqs=n_reqs, max_num_batched_tokens=mbt,
         dtype=jnp.bfloat16,
-        multi_step_decode=1 if use_async else w,
         async_scheduling=use_async,
         unified_batching=use_unified,
     ))
@@ -826,7 +828,6 @@ def bench_ar() -> dict:
             "experts": f"top{cfg.num_experts_per_tok}of"
                        f"{cfg.num_experts}",
             "moe_intermediate": cfg.moe_intermediate_size,
-            "multi_step_decode": 1 if use_async else w,
             "async_scheduling": use_async,
             "unified_batching": use_unified,
             "max_num_seqs": n_reqs,

@@ -1,8 +1,10 @@
 #!/usr/bin/env sh
 # Async pipelined-step gate: the sync-vs-async greedy token-equality
 # oracle, stop/EOS one-step-lag rollback, preemption/deadline/abort with
-# a step in flight, fallback-matrix engagement, and the CPU-backend
-# overlap microbench (overlap ratio > 0).
+# a step in flight, pipelined spec/logprobs/collect_hidden/embeds
+# batches (the retired fallback reasons asserted absent), the retired
+# multi-step knob's no-op contract, and the CPU-backend overlap
+# microbench (overlap ratio > 0).
 #
 # Standalone face of the same coverage tier-1 carries — tests/engine is
 # a fast directory, so tests/engine/test_async_step.py rides

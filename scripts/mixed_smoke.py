@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Mixed spec+logprobs+embeds serving smoke on the PR 7 harness.
+
+Drives ONE async tiny-model engine open-loop (Poisson arrivals from
+``loadgen.workload``, ``RequestRecord``/``summarize`` accounting from
+``loadgen.runner``) with the traffic mix the unified-dispatch refactor
+exists for: speculative-decode greedy tenants, logprobs tenants, and
+embeds-as-input tenants, all interleaved.  Emits a serving-curve point
+per offered rate plus the engine's ``async_fallback`` counters and the
+per-step device-dispatch count.
+
+Under the split executor (pre PR 11) every one of these request classes
+drained the async pipeline (``async_fallback_total{reason}``); after
+the refactor the spec/logprobs/embeds/collect_hidden reasons are
+structurally impossible — ``--check-fallback`` asserts exactly that and
+is wired into scripts/ragged.sh as the CI smoke.
+
+    JAX_PLATFORMS=cpu python scripts/mixed_smoke.py \
+        --rates 4,8 --requests 24 --check-fallback --out curve.json
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.loadgen.runner import (
+    RequestRecord,
+    SLOTargets,
+    summarize,
+    validate_curve_point,
+)
+from vllm_omni_tpu.loadgen.workload import poisson_arrivals
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.models.qwen3_omni import mtp
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+#: reasons that must be structurally impossible after the unified
+#: refactor (the retired fallback matrix)
+FORBIDDEN_REASONS = ("spec", "logprobs", "collect_hidden", "embeds",
+                     "prefill")
+
+
+def build_engine(params, cfg, k: int):
+    draft_fn = mtp.tiny_factory(params, cfg, k) if k else None
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=128, page_size=4, max_model_len=128, max_num_seqs=8,
+        max_num_batched_tokens=64, dtype=jnp.float32, seed=0,
+        async_scheduling=True, unified_batching=True,
+        num_speculative_tokens=k), draft_fn=draft_fn)
+    return eng
+
+
+def make_workload(n: int, rate: float, seed: int, embed_table):
+    """n mixed arrivals: round-robin spec-greedy / logprobs / embeds /
+    sampled tenants, deterministic prompts per index."""
+    offs = poisson_arrivals(rate, n, seed=seed)
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i, at in enumerate(offs):
+        plen = int(rng.integers(4, 12))
+        prompt = [int(x) for x in rng.integers(1, 60, size=plen)]
+        kind = ("spec", "logprobs", "embeds", "sampled")[i % 4]
+        sp = dict(temperature=0.0, max_tokens=8, ignore_eos=True)
+        kwargs = {}
+        if kind == "logprobs":
+            sp["logprobs"] = 3
+        elif kind == "embeds":
+            kwargs["prompt_embeds"] = np.asarray(embed_table)[prompt]
+            prompt = [0] * plen
+        elif kind == "sampled":
+            sp.update(temperature=0.8, seed=7 + i)
+        reqs.append((at, f"{kind}-{i}", kind, prompt, sp, kwargs))
+    return reqs
+
+
+def run_point(params, cfg, rate: float, n: int, k: int) -> dict:
+    eng = build_engine(params, cfg, k)
+    # prime the jit shape caches with the same mix (measured points
+    # must reflect steady-state serving, not first-shape XLA compiles)
+    for _, rid, _, prompt, sp, kwargs in make_workload(
+            n, 100.0, seed=13, embed_table=params["embed"]["w"]):
+        eng.add_request(prompt, SamplingParams(**sp),
+                        request_id=f"warm-{rid}", **kwargs)
+    while eng.has_unfinished_requests:
+        eng.step()
+    eng.async_fallback.clear()
+    work = make_workload(n, rate, seed=13, embed_table=params["embed"]["w"])
+    recs: dict[str, RequestRecord] = {}
+    t0 = time.monotonic()
+    pending = list(work)
+    seen_first: set[str] = set()
+    while pending or eng.has_unfinished_requests:
+        now = time.monotonic() - t0
+        while pending and pending[0][0] <= now:
+            at, rid, kind, prompt, sp, kwargs = pending.pop(0)
+            recs[rid] = RequestRecord(
+                request_id=rid, tenant=kind, scenario=kind,
+                arrival_s=at, fired_s=now)
+            eng.add_request(prompt, SamplingParams(**sp),
+                            request_id=rid, **kwargs)
+        if not eng.has_unfinished_requests:
+            if pending:
+                time.sleep(max(pending[0][0] - (time.monotonic() - t0),
+                               0.0))
+            continue
+        outs = eng.step()
+        now = time.monotonic() - t0
+        # first-token stamps for TTFT (engine outputs surface only at
+        # finish; scan the live table for first emissions)
+        for q in (eng.scheduler.running,):
+            for req in q:
+                if req.output_token_ids and req.request_id in recs \
+                        and req.request_id not in seen_first:
+                    seen_first.add(req.request_id)
+                    recs[req.request_id].first_s = now
+        for o in outs:
+            rec = recs.get(o.request_id)
+            if rec is None:
+                continue
+            if o.is_error:
+                rec.status = "error"
+                rec.end_s = now
+                continue
+            toks = o.outputs[0].token_ids
+            if rec.first_s is None:
+                rec.first_s = now
+            rec.end_s = now
+            rec.tokens_out = len(toks)
+            rec.status = "ok"
+            if o.request_id.startswith("logprobs"):
+                lps = o.outputs[0].logprobs
+                assert lps and len(lps) >= len(toks), \
+                    f"{o.request_id}: logprobs missing"
+    point = summarize(list(recs.values()), offered_rps=rate,
+                      slo=SLOTargets(ttft_ms=2000.0, tpot_ms=500.0))
+    bad = validate_curve_point(point)
+    assert not bad, bad
+    point["async_fallback"] = dict(eng.async_fallback)
+    point["dispatches"] = eng.runner.dispatch_count
+    point["engine_steps"] = eng._steps_completed
+    point["spec_stats"] = dict(getattr(eng.runner, "spec_stats", {}))
+    return point
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rates", default="4,8")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--spec-k", type=int, default=2)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--check-fallback", action="store_true",
+                    help="assert the retired fallback reasons stay zero")
+    args = ap.parse_args()
+
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    curve = []
+    failed = []
+    for rate in (float(r) for r in args.rates.split(",")):
+        point = run_point(params, cfg, rate, args.requests, args.spec_k)
+        curve.append(point)
+        fb = point["async_fallback"]
+        print(f"rate={rate}: goodput={point['goodput_tok_per_s']} tok/s "
+              f"p99_tpot={point['tpot_ms']['p99']}ms "
+              f"completed={point['completed']}/{point['num_requests']} "
+              f"dispatches={point['dispatches']} fallback={fb}",
+              flush=True)
+        for reason in FORBIDDEN_REASONS:
+            if fb.get(reason):
+                failed.append((rate, reason, fb[reason]))
+    doc = {"scenario": "mixed spec+logprobs+embeds",
+           "serving_curve": curve}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+    if args.check_fallback and failed:
+        print(f"FORBIDDEN fallback reasons fired: {failed}",
+              file=sys.stderr)
+        return 1
+    ok = all(p["completed"] == p["num_requests"] for p in curve)
+    if not ok:
+        print("requests failed to complete", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

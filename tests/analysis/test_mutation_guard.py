@@ -38,14 +38,14 @@ def test_ol1_sees_the_real_sampler():
 
 
 def test_ol3_sees_the_real_model_runner():
-    # the decode call site routes through the _run_jit telemetry lambda;
+    # the decode dispatch routes through the _run_jit telemetry lambda;
     # OL3 must still resolve the donation through that indirection
     src, path = _mutated(
         "vllm_omni_tpu/worker/model_runner.py",
-        '        logits, hidden, self.kv_caches = self._run_jit(\n'
-        '            "decode",',
-        '        logits, hidden, _ = self._run_jit(\n'
-        '            "decode",')
+        '        outs, self.kv_caches = self._run_jit(\n'
+        '            kind, (b,),',
+        '        outs, _ = self._run_jit(\n'
+        '            kind, (b,),')
     found = _unsuppressed(src, path, "OL3")
     assert any("'self.kv_caches'" in f.message for f in found), found
 

@@ -85,3 +85,29 @@ def step(arr):
     assert lint(src, path=HOT, rule="OL2") == []
     withheld = lint(src, path=HOT, rule="OL2", include_suppressed=True)
     assert len(withheld) == 1 and withheld[0].suppressed
+
+
+def test_per_verify_step_device_get_pattern_flagged():
+    """Regression fixture for the RETIRED split-path spec-verify shape
+    (PR 11): a per-verify-step host argmax readback plus a per-request
+    device_get inside the accept loop.  The unified dispatch moved
+    verify/accept on device; if this pattern reappears in a hot module
+    OL2 must flag every sync so it cannot come back silently."""
+    src = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def run_spec_verify(scheds, logits, hidden):
+    greedy = np.asarray(jax.device_get(jnp.argmax(logits, axis=-1)))
+    accepted = []
+    for i, sc in enumerate(scheds):
+        rows = jax.device_get(hidden[i, : 2])   # per-request sync
+        accepted.append((int(greedy[i, 0]), rows))
+    return accepted
+'''
+    found = lint(src, path="vllm_omni_tpu/worker/fixture.py",
+                 rule="OL2")
+    msgs = messages(found)
+    assert len(found) >= 2, msgs
+    assert any("device_get" in f.message for f in found), msgs

@@ -219,10 +219,10 @@ def test_abort():
     assert not s.has_unfinished
 
 
-def test_multi_step_window_degrades_for_last_token():
-    """A request needing exactly one more token must run a single-step
-    decode, not a full W-iteration window of guaranteed-discarded work
-    (ADVICE round 5); requests needing >1 keep the full window."""
+def test_multi_step_window_retired():
+    """The multi-step window is retired (PR 11): the knob is accepted
+    as a no-op, every decode row is window 1 with exactly one slot —
+    no window-ahead page reservation survives."""
     cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
                           max_model_len=64, multi_step_decode=4)
     s = _mk(cfg)
@@ -230,22 +230,49 @@ def test_multi_step_window_degrades_for_last_token():
     s.update_from_output(s.schedule(), {"a": 1})  # prefill, 1 token out
 
     out = s.schedule()
-    assert out.decodes[0].window == 4  # 5 tokens still needed
-
-    # advance to one-token-remaining (5 of 6 emitted)
-    req = s.running[0]
-    for t in (2, 3, 4):
-        req.append_output_token(t)
-        req.num_computed_tokens += 1
-    s.update_from_output(out, {"a": 5})
-    assert len(req.output_token_ids) == 5
-
-    out = s.schedule()
     d = out.decodes[0]
-    assert d.window == 1  # degraded: only one token needed
-    assert len(d.slot_mapping) == 1  # no window-ahead page reservation
-    finished = s.update_from_output(out, {"a": 6})
-    assert finished and finished[0].finish_reason == "length"
+    assert d.window == 1
+    assert len(d.slot_mapping) == 1
+    finished = s.update_from_output(out, {"a": 2})
+    assert not finished
+
+
+def test_spec_verify_in_flight_holds_request():
+    """Async spec pipelining: while a k+1-candidate verify dispatch is
+    in flight (num_inflight_tokens > 1) the request's next KV position
+    is unknown — schedule() must HOLD it (no row emitted) until the
+    lagged retire lands; plain decode rows (one in-flight token) keep
+    pipelining ahead."""
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                          max_model_len=64, num_speculative_tokens=3)
+    s = _mk(cfg)
+    s.add_request(_req("a", n=4, max_tokens=16))
+    out = s.schedule()
+    s.note_async_dispatch(out)
+    retired = s.update_from_async_retire(out, {"a": 1})
+    assert not retired
+    req = s.running[0]
+    req.spec_draft_tokens = [5, 6, 7]
+
+    out2 = s.schedule()          # verify row: 1 + 3 candidates
+    assert out2.decodes and out2.decodes[0].num_new_tokens == 4
+    s.note_async_dispatch(out2)
+    assert req.num_inflight_tokens == 4
+
+    held = s.schedule()          # verify in flight -> held, not rescheduled
+    assert held.num_scheduled == 0
+    assert req in s.running
+
+    # lagged retire: 2 of 4 candidates accepted -> rewind keeps exactly
+    # the accepted prefix and the request schedules again
+    s.update_from_async_retire(out2, {"a": [2, 3]})
+    assert req.num_inflight_tokens == 0
+    assert req.output_token_ids == [1, 2, 3]
+    assert req.num_computed_tokens == req.num_tokens - 1
+    req.spec_draft_tokens = []
+    out3 = s.schedule()
+    assert out3.decodes and out3.decodes[0].num_new_tokens == 1
+    assert out3.decodes[0].start_pos == req.num_computed_tokens
 
 
 def test_preemption_and_rejection_counters():

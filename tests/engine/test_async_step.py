@@ -245,26 +245,49 @@ def test_async_abort_with_step_in_flight(tiny_model):
     assert len(results["stays"].outputs[0].token_ids) == 20
 
 
-# ----------------------------------------------------- fallback matrix
-def test_async_fallback_logprobs(tiny_model):
-    """logprobs need per-step host-visible distributions — those batches
-    ride the synchronous path (dispatch never fires) and still return
-    aligned logprob entries."""
+# ------------------------------------------- retired fallback matrix
+# The PR 11 contract: spec decode, logprobs, collect_hidden, and embeds
+# batches RIDE the pipeline (the unified dispatch carries their
+# verify/logprob/hidden work on device) — the per-reason drain counters
+# for them are structurally impossible to increment.
+
+FORBIDDEN_FALLBACKS = ("spec", "logprobs", "collect_hidden", "embeds",
+                       "prefill")
+
+
+def _assert_no_forbidden_fallbacks(eng):
+    for reason in FORBIDDEN_FALLBACKS:
+        assert reason not in eng.async_fallback, eng.async_fallback
+
+
+def test_async_logprobs_pipelines(tiny_model):
+    """logprobs ride the handle: the chosen/top-k values compute in the
+    dispatched step and surface at the lagged retire — the batch
+    pipelines, the entries stay 1:1 aligned with tokens, and the values
+    match a sync engine's."""
     params, cfg = tiny_model
-    eng = _engine(params, cfg, async_scheduling=True)
-    calls = _spy_dispatch(eng)
     sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True,
                         logprobs=3)
+    base = _engine(params, cfg).generate([PROMPTS[0]], sp)
+    eng = _engine(params, cfg, async_scheduling=True)
+    calls = _spy_dispatch(eng)
     out = eng.generate([PROMPTS[0]], sp)
     c = out[0].outputs[0]
-    assert len(c.token_ids) == 6
-    assert len(c.logprobs) >= 6
-    assert not calls, "logprobs batch must not take the pipelined path"
+    b = base[0].outputs[0]
+    assert c.token_ids == b.token_ids
+    assert len(c.logprobs) == len(b.logprobs)
+    for got, want in zip(c.logprobs, b.logprobs):
+        assert got["top_ids"] == want["top_ids"]
+        assert abs(got["logprob"] - want["logprob"]) < 1e-4
+    assert calls, "logprobs decode batch must take the pipelined path"
+    _assert_no_forbidden_fallbacks(eng)
 
 
-def test_async_fallback_spec_decode(tiny_model):
-    """An installed draft head keeps every step on the synchronous
-    verify path; outputs match a sync spec-decode engine exactly."""
+def test_async_spec_decode_pipelines(tiny_model):
+    """An installed draft head no longer drains the pipeline: verify
+    rows are k+1-token ragged rows of the unified dispatch, outputs
+    match a sync spec-decode engine exactly, and the 'spec' fallback
+    reason never fires."""
     params, cfg = tiny_model
 
     def draft_fn(hidden, tokens, positions):
@@ -275,24 +298,63 @@ def test_async_fallback_spec_decode(tiny_model):
             num_pages=64, page_size=4, max_model_len=128, max_num_seqs=4,
             dtype=jnp.float32, num_speculative_tokens=2,
             async_scheduling=async_mode), draft_fn=draft_fn)
-        spy = _spy_dispatch(eng)
-        return eng.generate(PROMPTS, GREEDY), spy
+        dispatched = []
+        orig = eng.runner.dispatch_unified
+        eng.runner.dispatch_unified = lambda so, prev=None: (
+            dispatched.append(
+                sum(s.num_new_tokens > 1 for s in so.decodes))
+            or orig(so, prev))
+        return eng.generate(PROMPTS, GREEDY), dispatched, eng
 
-    sync_out, _ = run(False)
-    async_out, calls = run(True)
+    sync_out, _, _ = run(False)
+    async_out, dispatched, eng = run(True)
     for b, m in zip(sync_out, async_out):
         assert m.outputs[0].token_ids == b.outputs[0].token_ids
-    assert not calls, "spec-decode batch must not take the pipelined path"
+    assert any(n > 0 for n in dispatched), \
+        "verify rows never rode the unified dispatch"
+    _assert_no_forbidden_fallbacks(eng)
+    assert eng.runner.spec_stats["accepted"] > 0
 
 
-def test_async_fallback_collect_hidden(tiny_model):
+def test_async_collect_hidden_pipelines(tiny_model):
+    """collect_hidden rides the handle: the packed hidden state ships
+    with the one lagged retire transfer, payloads match sync, and the
+    batch pipelines."""
     params, cfg = tiny_model
-    eng = _engine(params, cfg, async_scheduling=True, collect_hidden=True)
-    calls = _spy_dispatch(eng)
     sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    base = _engine(params, cfg, collect_hidden=True).generate(
+        [PROMPTS[0]], sp)
+    eng = _engine(params, cfg, async_scheduling=True, collect_hidden=True)
     outs = eng.generate([PROMPTS[0]], sp)
-    assert "hidden_states" in outs[0].multimodal_output
-    assert not calls, "collect_hidden must not take the pipelined path"
+    import numpy as np
+
+    want = base[0].multimodal_output["hidden_states"]
+    got = outs[0].multimodal_output["hidden_states"]
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=1e-5)
+    _assert_no_forbidden_fallbacks(eng)
+
+
+def test_async_embeds_pipelines(tiny_model):
+    """Embeds-as-input prefills scatter into the packed token buffer
+    and pipeline; the stream matches the token-id path exactly."""
+    import numpy as np
+
+    params, cfg = tiny_model
+    prompt = [3, 7, 11, 2]
+    embeds = np.asarray(params["embed"]["w"])[prompt]
+    sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
+    want = _engine(params, cfg).generate([prompt], sp)
+    eng = _engine(params, cfg, async_scheduling=True)
+    eng.add_request([0] * len(prompt), sp, request_id="e",
+                    prompt_embeds=embeds)
+    results = []
+    while eng.has_unfinished_requests:
+        results.extend(eng.step())
+    assert (results[0].outputs[0].token_ids
+            == want[0].outputs[0].token_ids)
+    _assert_no_forbidden_fallbacks(eng)
 
 
 def test_async_generation_worker_ignores_knob(tiny_model):

@@ -1,8 +1,9 @@
-"""Multi-step decode: W decode iterations per device call (lax.scan with
-on-device sampling), the round-trip amortization vLLM's TPU backend uses.
-Numerics contract: greedy multi-step output is IDENTICAL to single-step
-(same forward, same argmax — only dispatch granularity changes).
-(reference decode loop: worker/gpu_ar_model_runner.py execute_model)"""
+"""The multi-step decode window is RETIRED (PR 11): the async pipelined
+step is the host-round-trip amortization, and it serves the batches the
+lax.scan window never could (mixed, sampled, spec, logprobs).  The knob
+survives as an accepted no-op so existing configs keep constructing —
+these tests pin the deprecation contract and the warmup coverage that
+replaced the (batch, seq) executable grid."""
 
 import jax
 import jax.numpy as jnp
@@ -30,24 +31,12 @@ def _engine(params, cfg, **kw):
 PROMPTS = [[1, 5, 9, 2, 7], [3, 3, 8], [11, 4, 6, 1, 2, 9, 5]]
 
 
-def test_multi_step_greedy_matches_single_step(tiny_model):
+def test_multi_step_knob_is_accepted_noop(tiny_model):
+    """A config carrying the retired knob still constructs and serves;
+    the scheduler only ever emits window-1 rows, and the stream is
+    identical to an engine without the knob."""
     params, cfg = tiny_model
     sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
-    base = _engine(params, cfg).generate(PROMPTS, sp)
-    multi = _engine(params, cfg, multi_step_decode=4).generate(PROMPTS, sp)
-    for b, m in zip(base, multi):
-        assert m.outputs[0].token_ids == b.outputs[0].token_ids
-        assert len(m.outputs[0].token_ids) == 12
-
-
-def test_multi_step_window_not_dividing_max_tokens(tiny_model):
-    """max_tokens=10 with W=4: the tail window still runs FULL-width
-    (the overshoot is trimmed host-side) — output exact, and no
-    intermediate scan length is ever scheduled.  Distinct scan lengths
-    compile distinct executables; a mid-run tail compile measured 21 s
-    on a remote-attached chip."""
-    params, cfg = tiny_model
-    sp = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
     base = _engine(params, cfg).generate(PROMPTS, sp)
     eng = _engine(params, cfg, multi_step_decode=4)
     seen = set()
@@ -62,75 +51,43 @@ def test_multi_step_window_not_dividing_max_tokens(tiny_model):
     multi = eng.generate(PROMPTS, sp)
     for b, m in zip(base, multi):
         assert m.outputs[0].token_ids == b.outputs[0].token_ids
-        assert len(m.outputs[0].token_ids) == 10
-    assert seen <= {1, 4}, f"intermediate scan lengths scheduled: {seen}"
+        assert len(m.outputs[0].token_ids) == 12
+    assert seen == {1}, f"retired window machinery scheduled: {seen}"
 
 
 def test_warmup_precompiles_all_traffic_shapes(tiny_model):
-    """engine.warmup() + declared prefill shapes => serving traffic hits
-    zero new executables on the prefill/decode paths (a mid-traffic XLA
-    compile stalls every in-flight request 20-40 s on a remote chip).
-    Reference analogue: worker warmup before the engine goes live."""
+    """engine.warmup() => serving traffic hits zero new executables on
+    the unified/decode paths (a mid-traffic XLA compile stalls every
+    in-flight request 20-40 s on a remote chip).  The warmup surface is
+    the 1-D token-bucket line plus the decode buckets × {plain,
+    logprobs} — the (batch, seq) grid of the deleted split executor is
+    gone."""
     params, cfg = tiny_model
-    eng = _engine(params, cfg, multi_step_decode=4)
-    n = eng.warmup(prefill_shapes=[(len(PROMPTS), max(len(p) for p in PROMPTS))])
+    eng = _engine(params, cfg)
+    n = eng.warmup(prefill_shapes=[
+        (len(PROMPTS), max(len(p) for p in PROMPTS))])
     assert n > 0
-    r = eng.runner
-    fns = [r._prefill_fn, r._chunk_prefill_fn, r._decode_fn,
-           r._decode_multi_fn]
-    sizes = [f._cache_size() for f in fns]
+    compiles = eng.runner.compile_stats["compiles"]
     sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
     outs = eng.generate(PROMPTS, sp)
     assert all(len(o.outputs[0].token_ids) == 12 for o in outs)
-    # identical prompts again: APC prefix hits route through the
-    # chunked-continuation executable — warmed at the same buckets
+    # identical prompts again: APC prefix hits resume mid-prompt
+    # through the unified continuation — same token buckets, still warm
     outs2 = eng.generate(PROMPTS, sp)
-    assert [f._cache_size() for f in fns] == sizes, \
+    assert eng.runner.compile_stats["compiles"] == compiles, \
         "traffic compiled shapes warmup missed"
     for a, b in zip(outs, outs2):
         assert a.outputs[0].token_ids == b.outputs[0].token_ids
     # warmup's dropped-slot writes must not have corrupted generation:
     # a fresh un-warmed engine produces identical greedy tokens
-    base = _engine(params, cfg, multi_step_decode=4).generate(PROMPTS, sp)
+    base = _engine(params, cfg).generate(PROMPTS, sp)
     for b, m in zip(base, outs):
         assert m.outputs[0].token_ids == b.outputs[0].token_ids
 
 
-def test_multi_step_eos_truncates_mid_window(tiny_model):
-    """A request whose greedy continuation hits EOS mid-window must stop
-    there, exactly like single-step decoding."""
-    params, cfg = tiny_model
-    # find the greedy continuation, then declare its 6th token the EOS
-    sp_probe = SamplingParams(temperature=0.0, max_tokens=12,
-                              ignore_eos=True)
-    probe = _engine(params, cfg).generate([PROMPTS[0]], sp_probe)
-    toks = probe[0].outputs[0].token_ids
-    eos = toks[5]
-    first_hit = toks.index(eos)
-    sp_stop = SamplingParams(temperature=0.0, max_tokens=12,
-                             stop_token_ids=[eos])
-    out = _engine(params, cfg, multi_step_decode=4).generate(
-        [PROMPTS[0]], sp_stop)
-    got = out[0].outputs[0].token_ids
-    assert got == toks[: first_hit + 1]
-
-
-def test_multi_step_sampled_deterministic(tiny_model):
-    """Seeded temperature sampling through the in-scan sampler is
-    reproducible run-to-run (stream differs from single-step by
-    construction — keys fold the in-window step index)."""
-    params, cfg = tiny_model
-    sp = SamplingParams(temperature=0.9, seed=123, max_tokens=8,
-                        ignore_eos=True)
-    a = _engine(params, cfg, multi_step_decode=4).generate(PROMPTS, sp)
-    b = _engine(params, cfg, multi_step_decode=4).generate(PROMPTS, sp)
-    for x, y in zip(a, b):
-        assert x.outputs[0].token_ids == y.outputs[0].token_ids
-
-
-def test_multi_step_logprobs_falls_back(tiny_model):
-    """logprobs need per-step distributions — those requests must ride
-    the single-step path and still return aligned logprob entries."""
+def test_logprobs_with_retired_knob(tiny_model):
+    """logprobs requests serve normally with the knob present (they
+    ride the decode logprobs executable, not a fallback)."""
     params, cfg = tiny_model
     sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True,
                         logprobs=3)
@@ -138,4 +95,4 @@ def test_multi_step_logprobs_falls_back(tiny_model):
         [PROMPTS[0]], sp)
     c = out[0].outputs[0]
     assert len(c.token_ids) == 6
-    assert len(out[0].outputs[0].logprobs) >= 6
+    assert len(c.logprobs) >= 6

@@ -89,21 +89,29 @@ def test_unified_greedy_matches_split_staggered_mixed(tiny_model):
 
 def test_mixed_step_is_one_device_dispatch(tiny_model):
     """The tentpole contract: a mixed prefill+decode step executes as
-    ONE device dispatch under unified batching (the split path needs
-    one per variant)."""
+    ONE device dispatch — and since PR 11 the split executor is gone,
+    so this holds with OR without the unified_batching scheduling
+    policy flag (the flag only changes admission order/chunking)."""
     params, cfg = tiny_model
-    eng = _engine(params, cfg, unified_batching=True)
-    records = _spy_execute(eng)
-    _run_staggered(eng)
-    mixed = [r for r in records if r[0] and r[1]]
-    assert mixed
-    assert all(r[2] == 1 for r in mixed), records
-    # and the split engine pays >= 2 dispatches for the same steps
-    eng_s = _engine(params, cfg)
-    records_s = _spy_execute(eng_s)
-    _run_staggered(eng_s)
-    mixed_s = [r for r in records_s if r[0] and r[1]]
-    assert mixed_s and all(r[2] >= 2 for r in mixed_s), records_s
+    for flag in (True, False):
+        eng = _engine(params, cfg, unified_batching=flag)
+        records = _spy_execute(eng)
+        _run_staggered(eng)
+        mixed = [r for r in records if r[0] and r[1]]
+        assert mixed, f"no mixed steps at unified_batching={flag}"
+        assert all(r[2] == 1 for r in mixed), (flag, records)
+
+
+def test_split_executor_is_gone():
+    """The refactor is the point: the fallback matrix and the split
+    executor cannot come back silently."""
+    from vllm_omni_tpu.worker.model_runner import ARModelRunner
+
+    for name in ("_execute_split", "_unified_eligible",
+                 "_run_spec_decode", "_run_prefill", "_run_decode",
+                 "_run_decode_multi", "_batched_verify_probs",
+                 "_rejection_accept", "_sample_and_record"):
+        assert not hasattr(ARModelRunner, name), name
 
 
 def test_unified_sampled_seeded_reproducible(tiny_model):
@@ -165,6 +173,47 @@ def test_chunk_resume_after_preemption_mid_chunk(tiny_model):
     assert eng_u.scheduler.kv.num_free_pages == 12
 
 
+def test_resume_chunk_past_prompt_not_mistaken_for_verify(tiny_model):
+    """Review regression (PR 11): a preempt-resume recompute chunk can
+    start PAST the prompt with width > 1 — same (width, start) shape as
+    a spec verify row.  Retire must classify by how the row was
+    ASSEMBLED (handle.spec_rows), not by a predicate: the old check
+    returned the chunk's token as a one-element accepted LIST, whose
+    scheduler branch rewinds the multi-token advance to 1 and wedges
+    the resume into n-tokens-of-forward-per-emitted-token."""
+    params, cfg = tiny_model
+    prompt = PROMPTS[3]  # 4 tokens == the chunk budget below
+    kw = dict(max_num_seqs=4, max_num_batched_tokens=4,
+              enable_chunked_prefill=True, enable_prefix_caching=False,
+              page_size=4, max_model_len=128, dtype=jnp.float32)
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    def run(preempt: bool):
+        eng = _engine(params, cfg, **kw)
+        outs = []
+        eng.add_request(prompt, sp, request_id="a")
+        steps = 0
+        while eng.has_unfinished_requests:
+            outs.extend(eng.step())
+            steps += 1
+            if preempt and steps == 3:
+                # deterministic preemption with 3 generated tokens:
+                # the resume recomputes [0..4) then [4..7) — a FINAL
+                # chunk of width 3 starting exactly at the prompt
+                # boundary, the verify-row look-alike
+                _, req = eng.scheduler.find_request("a")
+                assert req is not None and len(req.output_token_ids) == 3
+                eng.scheduler._preempt(req)
+        return eng, outs[0].outputs[0].token_ids
+
+    _, want = run(False)
+    eng, got = run(True)
+    assert eng.scheduler.num_preemptions == 1
+    assert got == want
+    # no draft head: nothing may ever count as a verify proposal
+    assert eng.runner.spec_stats["proposed"] == 0
+
+
 def test_prefix_cache_hit_feeds_unified_step(tiny_model):
     """An APC prefix hit resumes mid-prompt: the remainder chunk rides
     the unified executable (start_pos > 0), token-identical to split."""
@@ -222,24 +271,31 @@ def test_async_unified_stop_token_overshoot(tiny_model):
     assert eng.scheduler.kv.num_free_pages == 64
 
 
-def test_async_fallback_reasons_are_granular(tiny_model):
-    """Per-reason drain counters: a logprobs request shows up as
-    'logprobs', not as an aggregate; under async WITHOUT unified the
-    same workload drains with reason 'prefill'."""
+def test_async_fallback_reasons_retired(tiny_model):
+    """The PR 11 acceptance contract: the spec / logprobs /
+    collect_hidden / embeds / prefill drain reasons are structurally
+    impossible — a workload exercising logprobs + staggered prefills
+    leaves all of them absent, with or without the unified scheduling
+    policy flag."""
     params, cfg = tiny_model
     sp_lp = SamplingParams(temperature=0.0, max_tokens=4,
                            ignore_eos=True, logprobs=2)
-    eng = _engine(params, cfg, unified_batching=True,
-                  async_scheduling=True)
-    eng.generate([PROMPTS[0]], sp_lp)
-    assert eng.async_fallback.get("logprobs"), eng.async_fallback
-    eng2 = _engine(params, cfg, async_scheduling=True)
-    _run_staggered(eng2)
-    assert eng2.async_fallback.get("prefill"), eng2.async_fallback
+    for flag in (True, False):
+        eng = _engine(params, cfg, unified_batching=flag,
+                      async_scheduling=True)
+        eng.generate([PROMPTS[0]], sp_lp)
+        _run_staggered(eng)
+        for reason in ("spec", "logprobs", "collect_hidden", "embeds",
+                       "prefill"):
+            assert reason not in eng.async_fallback, (
+                flag, eng.async_fallback)
 
 
-# ----------------------------------------------------- fallback matrix
-def test_logprobs_request_falls_back_to_split(tiny_model):
+# ------------------------------------------- retired fallback matrix
+def test_logprobs_request_rides_unified(tiny_model):
+    """logprobs no longer force the split path (which is gone): the
+    unified/decode executables compute chosen+top-k on device and the
+    entries match the pre-refactor oracle semantics."""
     params, cfg = tiny_model
     sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True,
                         logprobs=2)
@@ -248,28 +304,66 @@ def test_logprobs_request_falls_back_to_split(tiny_model):
         PROMPTS[:2], sp)
     for b, u in zip(base, outs):
         assert u.outputs[0].token_ids == b.outputs[0].token_ids
-        # logprobs still populated (split path served the batch)
         assert u.outputs[0].logprobs and len(u.outputs[0].logprobs) == 5
+        for got, want in zip(u.outputs[0].logprobs,
+                             b.outputs[0].logprobs):
+            assert got["top_ids"] == want["top_ids"]
+            assert abs(got["logprob"] - want["logprob"]) < 1e-4
 
 
 # ------------------------------------------------------------- metrics
-def test_padding_efficiency_improves_on_ragged_prefill(tiny_model):
-    """Ragged prompt lengths: the split path pays (batch, seq) bucket
-    padding, the unified path only token-block alignment — the exported
-    padding-efficiency must strictly improve."""
+def test_padding_efficiency_beats_bucket_grid(tiny_model):
+    """Ragged prompt lengths: the deleted split path paid (batch, seq)
+    bucket padding on its prefill steps; the unified packer pays only
+    token-block alignment.  Compare the measured efficiency against
+    the bucket-grid cost the SAME prompts would have paid (computed
+    host-side from the old bucketing rule: batch padded to a power of
+    two, every row padded to the longest prompt's seq bucket)."""
     params, cfg = tiny_model
-    prompts = [[(i % 9) + 1 for i in range(n)] for n in (33, 47, 18, 25)]
+    lens = (33, 47, 18, 25)
+    prompts = [[(i % 9) + 1 for i in range(n)] for n in lens]
     sp = SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True)
     kw = dict(max_num_batched_tokens=128, max_model_len=128,
               num_pages=128)
-    eng_s = _engine(params, cfg, **kw)
-    eng_s.generate(prompts, sp)
-    eng_u = _engine(params, cfg, unified_batching=True, **kw)
-    eng_u.generate(prompts, sp)
-    eff_s = eng_s.step_metrics.padding_efficiency
-    eff_u = eng_u.step_metrics.padding_efficiency
-    assert 0.0 < eff_s < 1.0
-    assert eff_u > eff_s, (eff_u, eff_s)
+    eng = _engine(params, cfg, unified_batching=True, **kw)
+    eng.generate(prompts, sp)
+    eff = eng.step_metrics.padding_efficiency
+    assert 0.0 < eff <= 1.0
+    # the split grid's prefill step: 4 prompts -> batch bucket 4, seq
+    # bucket 64 (covers 47) -> 256 padded rows for 123 useful tokens
+    split_prefill_eff = sum(lens) / (4 * 64)
+    assert eff > split_prefill_eff, (eff, split_prefill_eff)
+
+
+def test_padding_counts_verify_tokens_as_useful(tiny_model):
+    """MFU truthfulness when spec rows dominate: every candidate
+    position of a verify row is scored work, so it counts USEFUL; only
+    block-alignment slack pads.  A spec run must therefore report more
+    useful tokens than tokens emitted (rejected candidates were still
+    computed), and efficiency stays in (0, 1]."""
+    params, cfg = tiny_model
+    from vllm_omni_tpu.engine import LLMEngine
+
+    def draft_fn(hidden, tokens, positions):
+        return jnp.tile(tokens[:, None], (1, 3))
+
+    from vllm_omni_tpu.engine import EngineConfig
+
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=64, page_size=4, max_model_len=128, max_num_seqs=4,
+        dtype=jnp.float32, num_speculative_tokens=3), draft_fn=draft_fn)
+    outs = eng.generate(PROMPTS[:2], GREEDY)
+    emitted = sum(len(o.outputs[0].token_ids) for o in outs)
+    prompt_toks = sum(len(p) for p in PROMPTS[:2])
+    stats = eng.runner.spec_stats
+    assert stats["proposed"] > stats["accepted"], \
+        "rejections never exercised"
+    # useful = prompts + every candidate position scored (accepted OR
+    # rejected) — strictly more than prompts + emitted when any draft
+    # was rejected
+    assert eng.runner.useful_tokens > prompt_toks + emitted
+    eff = eng.step_metrics.padding_efficiency
+    assert 0.0 < eff <= 1.0
 
 
 def test_metrics_snapshot_and_exposition(tiny_model):

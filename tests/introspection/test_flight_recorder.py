@@ -158,7 +158,8 @@ def test_engine_appends_step_records(stepped_engine):
     assert records, "no flight records after generate()"
     r = records[-1]
     for key in ("path", "unified", "fallback", "prefills", "decodes",
-                "new_tokens", "prefill_tokens", "waiting", "running",
+                "spec_rows", "verify_tokens", "new_tokens",
+                "prefill_tokens", "waiting", "running",
                 "host_ms", "device_ms", "kv_offloads", "kv_restores",
                 "slot", "compiles", "requests", "seq", "ts"):
         assert key in r, f"record missing {key}"
@@ -169,6 +170,38 @@ def test_engine_appends_step_records(stepped_engine):
     assert {rid for rec in records for rid in rec["requests"]} \
         >= {"req-0", "req-1"}
     json.dumps(records)
+
+
+def test_spec_step_records_verify_rows():
+    """Flight-recorder honesty for spec decode (schema v2): a
+    verify-heavy step reports spec_rows/verify_tokens and carries the
+    unified flag — /debug/flightrecorder can distinguish verify-heavy
+    steps from plain decode."""
+    import jax.numpy as jnp
+
+    from tests.helpers import tiny_lm_factory
+    from vllm_omni_tpu.engine.llm_engine import EngineConfig, LLMEngine
+
+    params, cfg, _ = tiny_lm_factory()
+
+    def draft_fn(hidden, tokens, positions):
+        return jnp.tile(tokens[:, None], (1, 2))
+
+    eng = LLMEngine(params, cfg, EngineConfig(
+        num_pages=32, page_size=4, max_model_len=64, max_num_seqs=4,
+        num_speculative_tokens=2), draft_fn=draft_fn)
+    eng.generate([[1, 2, 3, 4]], None)
+    spec_recs = [r for r in eng.flight.tail() if r["spec_rows"]]
+    assert spec_recs, "no verify step recorded"
+    # a full-width verify: 1 regular + 2 draft candidates (the stream's
+    # last verify may be clamped by remaining max_tokens)
+    assert max(r["verify_tokens"] for r in spec_recs) == 3
+    for r in spec_recs:
+        assert r["spec_rows"] == 1
+        assert r["unified"] is True
+    plain = [r for r in eng.flight.tail() if not r["spec_rows"]]
+    for rec in plain:
+        assert rec["verify_tokens"] == 0
 
 
 def test_kv_move_counts_consumed_per_record():

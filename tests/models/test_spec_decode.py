@@ -122,42 +122,57 @@ def test_spec_decode_sampled_rejection_acceptance():
         assert len(t) == 12
 
 
-def test_rejection_accept_preserves_target_distribution():
-    """The emitted first token of the rejection-verify must be EXACTLY
-    p-distributed (p = temperature/top-k/top-p filtered target): accept
-    draft d w.p. p(d), else draw from p \\ {d} renormalized.  Empirical
-    check over many deterministic (request, step) streams."""
-    import types
-
-    from vllm_omni_tpu.worker.model_runner import ARModelRunner
-
-    from vllm_omni_tpu.sample.sampler import filtered_probs
+def test_on_device_rejection_preserves_target_distribution():
+    """The emitted first token of the ON-DEVICE rejection verify
+    (sample/sampler.py spec_verify_tokens — the rebuild of the split
+    path's host-side accept loop) must be EXACTLY p-distributed (p =
+    temperature/top-k/top-p filtered target): accept draft d w.p.
+    p(d), else draw from p \\ {d} renormalized.  Empirical check over
+    many deterministic (request, step) key streams."""
+    from vllm_omni_tpu.sample.sampler import (
+        SamplingTensors,
+        spec_verify_tokens,
+    )
 
     vocab = 16
     rng = np.random.default_rng(0)
-    logits = jnp.asarray(rng.standard_normal((4, vocab)) * 2.0,
-                         jnp.float32)
+    row = rng.standard_normal(vocab) * 2.0
     temp = 0.9
-    sp = SamplingParams(temperature=temp, max_tokens=4)
     p_target = np.asarray(jax.nn.softmax(
-        np.asarray(logits[0], np.float64) / temp))
+        jnp.asarray(row / temp, jnp.float32)), np.float64)
     draft = int(np.argmax(p_target))  # the greedy draft proposal
-    probs = np.asarray(filtered_probs(
-        logits, jnp.full((4,), temp), jnp.full((4,), sp.top_k, jnp.int32),
-        jnp.full((4,), sp.top_p)))
-
+    s = 256
+    logits = jnp.asarray(np.broadcast_to(row, (s, 4, vocab)),
+                         jnp.float32)
+    drafts = jnp.full((s, 3), draft, jnp.int32)
+    n_cand = jnp.full((s,), 4, jnp.int32)
+    sp = SamplingParams(temperature=temp, max_tokens=4)
     counts = np.zeros(vocab)
-    n = 4000
-    dummy = types.SimpleNamespace(_base_seed=123, _step=0)
-    req = types.SimpleNamespace(request_id="", sampling_params=sp)
-    for i in range(n):
-        req.request_id = f"r{i}"
-        acc = ARModelRunner._rejection_accept(
-            dummy, req, probs, [draft, draft, draft])
-        counts[acc[0]] += 1
-    emp = counts / n
+    accepted = proposed = 0
+    for step in range(16):
+        t = SamplingTensors.build([sp] * s, step=step, base_seed=123,
+                                  salts=list(range(s)))
+        tk, ct = spec_verify_tokens(logits, drafts, n_cand,
+                                    t.temperature, t.top_k, t.top_p,
+                                    t.keys)
+        tk, ct = np.asarray(tk), np.asarray(ct)
+        for i in range(s):
+            counts[tk[i, 0]] += 1
+        accepted += int((ct - 1).sum())
+        proposed += s * 3
+        # determinism: the same (seed, salt, step) keys reproduce
+        t2 = SamplingTensors.build([sp] * s, step=step, base_seed=123,
+                                   salts=list(range(s)))
+        tk2, ct2 = spec_verify_tokens(logits, drafts, n_cand,
+                                      t2.temperature, t2.top_k,
+                                      t2.top_p, t2.keys)
+        assert np.array_equal(tk, np.asarray(tk2))
+        assert np.array_equal(ct, np.asarray(ct2))
+    emp = counts / counts.sum()
     tv = 0.5 * np.abs(emp - p_target).sum()
-    assert tv < 0.1, (tv, emp, p_target)
+    assert tv < 0.05, (tv, emp, p_target)
+    # the greedy-exact draft is accepted at roughly its own probability
+    assert accepted > 0.1 * proposed
 
 
 def test_spec_decode_mixed_batch_greedy_unperturbed():

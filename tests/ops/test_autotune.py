@@ -1,0 +1,104 @@
+"""Shared per-shape block selection (ops/autotune.py): the dense
+kernel's (block_q, block_k) picker and the ragged paged kernel's
+(token_block, dma_slots) picker, guaranteed-fit fallbacks included."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vllm_omni_tpu.ops.autotune import (
+    RAGGED_VMEM_CAP,
+    auto_blocks,
+    auto_ragged_blocks,
+)
+
+
+def test_auto_blocks_alias_preserved():
+    """ops/attention.py keeps its historical private names as aliases
+    of the shared helper — the dense kernel's behavior is unchanged."""
+    from vllm_omni_tpu.ops.attention import _SCORE_CAP, _auto_blocks
+
+    assert _auto_blocks is auto_blocks
+    assert _SCORE_CAP == 2_097_152
+    # the measured-on-chip DiT shape keeps its tuned blocks
+    assert auto_blocks(4608, 4608, 128) == (2304, 768)
+
+
+def test_auto_blocks_guaranteed_fit():
+    """A cap below every candidate product shrinks instead of crashing
+    (huge head dim / wide inputs)."""
+    bq, bk = auto_blocks(4096, 4096, 4096, itemsize=4)
+    assert bq >= 8 and bk >= 8
+    cap = 2_097_152 * 128 // 4096 * 2 // 4
+    assert bq * bk <= cap
+
+
+def test_auto_ragged_blocks_decode_heavy_pins_min_tile():
+    """Serving default: decode-heavy pins the q block at the minimum
+    tile (a decode row costs token_block packed rows) and takes the
+    deepest DMA pipeline that fits."""
+    tb, slots = auto_ragged_blocks(head_dim=128, page_size=16, group=4,
+                                   kv_itemsize=2, q_itemsize=2)
+    assert tb == 8
+    assert slots == 4  # 2*4*16*128*2 = 32 KiB of KV buffers: fits easily
+
+
+def test_auto_ragged_blocks_guaranteed_fit():
+    """A VMEM budget below every candidate degrades to the smallest
+    working set (classic double buffering) instead of failing."""
+    tb, slots = auto_ragged_blocks(head_dim=4096, page_size=512,
+                                   group=16, kv_itemsize=4,
+                                   q_itemsize=4, vmem_cap_bytes=1 << 16)
+    assert (tb, slots) == (8, 2)
+
+
+def test_auto_ragged_blocks_budget_monotone():
+    """Shrinking the budget never deepens the pipeline."""
+    depths = []
+    for cap in (RAGGED_VMEM_CAP, RAGGED_VMEM_CAP // 8,
+                RAGGED_VMEM_CAP // 64):
+        _, slots = auto_ragged_blocks(head_dim=256, page_size=128,
+                                      group=8, kv_itemsize=2,
+                                      q_itemsize=2, vmem_cap_bytes=cap)
+        depths.append(slots)
+    assert depths == sorted(depths, reverse=True)
+    assert depths[-1] >= 2
+
+
+def test_ragged_kernel_matches_ref_at_deeper_dma(monkeypatch):
+    """The N-deep page-DMA pipeline (interpret mode) is numerically
+    identical to the XLA reference at every supported depth — the
+    autotuner may pick any of them."""
+    from vllm_omni_tpu.ops.ragged_paged_attention import (
+        ragged_paged_attention,
+        ragged_paged_attention_ref,
+    )
+
+    monkeypatch.setenv("OMNI_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(0)
+    hkv, group, d, page = 2, 2, 128, 8
+    h = hkv * group
+    s_max, pages = 4, 3
+    q_lens = np.array([1, 5, 8, 0], np.int32)     # decode + ragged rows
+    seq_lens = np.array([9, 13, 8, 0], np.int32)
+    cu = np.array([0, 8, 16, 24, 24], np.int32)   # 8-aligned starts
+    t = 24
+    q = jnp.asarray(rng.standard_normal((t, h, d)), jnp.float32)
+    k_cache = jnp.asarray(
+        rng.standard_normal((hkv, 16, page, d)), jnp.float32)
+    v_cache = jnp.asarray(
+        rng.standard_normal((hkv, 16, page, d)), jnp.float32)
+    tables = jnp.asarray(
+        rng.integers(0, 16, (s_max, pages)), jnp.int32)
+    args = (q, k_cache, v_cache, tables, jnp.asarray(cu),
+            jnp.asarray(q_lens), jnp.asarray(seq_lens), 3)
+    want = ragged_paged_attention_ref(*args)
+    for slots in (2, 3, 4):
+        got = ragged_paged_attention(*args, use_pallas=True,
+                                     dma_slots=slots)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5,
+            err_msg=f"dma_slots={slots}")
+        # rows past each segment's real tokens stay exactly zero
+        pad = np.asarray(got)[int(cu[0]) + 1: 8]
+        assert np.all(pad == 0.0), f"dma_slots={slots}"

@@ -60,12 +60,11 @@ class SchedulerConfig:
     # (drafts come from the runner's MTP head via req.spec_draft_tokens)
     num_speculative_tokens: int = 0
     kv_transfer: Optional[KVTransferConfig] = None
-    # multi-step decode: the runner may advance a pure-decode batch W
-    # steps in ONE device call (lax.scan with on-device sampling) —
-    # W-1 host<->device round trips saved per window, which is what
-    # dominates decode latency on remote-attached chips (vLLM's TPU
-    # backend ships the same idea).  The scheduler's part is allocating
-    # KV pages for the whole window up front.
+    # RETIRED (PR 11): the multi-step lax.scan window is gone — the
+    # async pipelined step is the round-trip amortization, and it works
+    # for mixed/sampled/spec batches where the scan could not.  The
+    # field is accepted so existing configs keep constructing; the
+    # scheduler always emits window 1.
     multi_step_decode: int = 1
     # unified ragged batching: emit ONE token-budgeted mixed batch per
     # step — decodes claim the budget first, prefill chunks fill the
@@ -106,9 +105,8 @@ class ScheduledRequest:
     block_table: list[int]
     # position of the first new token (== num_computed_tokens at schedule)
     start_pos: int
-    # decode window: KV pages are allocated for this many steps ahead so
-    # the runner may run them in one multi-step device call (window=1 =>
-    # classic one-token decode)
+    # RETIRED (PR 11): the multi-step decode window is always 1 — kept
+    # only so stored/constructed ScheduledRequests keep their shape
     window: int = 1
 
     @property
@@ -121,8 +119,8 @@ class ScheduledRequest:
         SAMPLES from its final row.  The ONE definition of the
         final-chunk predicate — the scheduler's async accounting
         (note_async_dispatch) and the runner's sampling-row selection
-        (_unified_sampling / _sample_and_record) must agree exactly, or
-        a lagged retire consumes a token the runner never sampled.
+        (_unified_sampling) must agree exactly, or a lagged retire
+        consumes a token the runner never sampled.
         Evaluate BEFORE the step's token is appended (num_tokens moves)."""
         req = self.request
         return (self.start_pos + self.num_new_tokens >= req.num_tokens
@@ -381,6 +379,15 @@ class ARScheduler:
             if budget <= 0:
                 still_running.append(req)
                 continue
+            if req.num_inflight_tokens > 1:
+                # a spec VERIFY dispatch is in flight: how many of its
+                # k+1 candidates were accepted — and therefore where
+                # this request's next KV position is — is unknown until
+                # the lagged retire.  Hold the request one step; plain
+                # decode rows (exactly one in-flight token) keep
+                # pipelining ahead.
+                still_running.append(req)
+                continue
             # async pipelining schedules AHEAD of token knowledge: a
             # dispatched-but-unretired decode will append exactly one
             # token, so its in-flight count stands in for the token the
@@ -454,56 +461,31 @@ class ARScheduler:
                 # guaranteed-discarded work — don't schedule them
                 remaining_out = (req.sampling_params.max_tokens
                                  - len(req.output_token_ids))
+                # max_model_len leg counts the IN-FLIGHT token too: an
+                # async-scheduled verify whose input token is still in
+                # flight has num_tokens lagging by one, and without the
+                # correction the last candidate position would land one
+                # slot past the cap (allocating a page the block-table
+                # truncation then cannot address)
                 n_spec = min(
                     len(req.spec_draft_tokens), k, budget - 1,
-                    self.config.max_model_len - req.num_tokens,
+                    self.config.max_model_len
+                    - (req.num_tokens + req.num_inflight_tokens),
                     max(remaining_out - 1, 0),
                 )
                 if n_spec > 0 and self.kv.can_allocate(req, 1 + n_spec):
                     n_new = 1 + n_spec
-            window = 1
-            if (n_new == 1 and self.config.multi_step_decode > 1
-                    and not req.spec_draft_tokens):
-                # Full window or none: every distinct scan length is a
-                # separate executable, and a runtime compile costs tens
-                # of seconds on a remote-attached chip (a measured 21 s
-                # stall when a request's last window degraded to
-                # max_tokens%W).  A request near max_tokens runs the
-                # FULL window into its up-front-allocated pages and the
-                # runner trims the overshoot host-side
-                # (_truncate_at_stop); KV past the stop is unreferenced
-                # garbage freed with the request.  A hard slot ceiling
-                # (max_model_len), an exhausted token budget, or a
-                # single remaining token degrades — to the single-step
-                # path, whose executable always exists, never to an
-                # intermediate length.
-                # need == 1: W-1 of the window's iterations would be
-                # guaranteed-discarded work (ADVICE round 5)
-                need = (req.sampling_params.max_tokens
-                        - len(req.output_token_ids))
-                w = self.config.multi_step_decode
-                if (need > 1
-                        and w <= self.config.max_model_len - req.num_tokens
-                        and w <= budget):
-                    window = w
-            alloc_n = max(n_new, window)
-            table = self.kv.allocate(req, alloc_n)
-            if table is None and window > 1:
-                # window-ahead pages are an optimization, not a need:
-                # degrade to plain one-token decode before preempting
-                window = alloc_n = 1
-                table = self.kv.allocate(req, 1)
+            table = self.kv.allocate(req, n_new)
             if table is None:
                 self._preempt(req)
                 out.preempted.append(req)
                 continue
-            slots = self.kv.slot_mapping(req, alloc_n)
+            slots = self.kv.slot_mapping(req, n_new)
             out.decodes.append(ScheduledRequest(
                 request=req, num_new_tokens=n_new, slot_mapping=slots,
                 block_table=table, start_pos=req.num_computed_tokens,
-                window=window,
             ))
-            budget -= alloc_n
+            budget -= n_new
             still_running.append(req)
         self.running = still_running
 
@@ -761,7 +743,7 @@ class ARScheduler:
     def update_from_async_retire(
         self,
         scheduler_output: SchedulerOutput,
-        sampled: dict[str, int],
+        sampled: dict[str, "int | list[int]"],
     ) -> list[Request]:
         """The one-step-lagged counterpart of ``update_from_output`` for
         a pipelined dispatch: num_computed_tokens already advanced at
@@ -771,16 +753,29 @@ class ARScheduler:
         overshoot contract — greedy recompute re-derives a preempted
         request's token bit-identically); a preempt-and-readmit is
         caught by the async_generation stamp, not just the in-flight
-        counter."""
+        counter.
+
+        A spec VERIFY row retires a LIST of accepted tokens: its
+        dispatch advanced ``num_computed_tokens`` by the full candidate
+        width (1 + drafts), so the rewind here keeps exactly the
+        accepted prefix — rejected candidate slots are position-keyed
+        garbage re-written when real tokens reach those positions, the
+        same contract as the synchronous update."""
         finished: list[Request] = []
-        for sched in scheduler_output.prefills + scheduler_output.decodes:
+        # in-flight contribution per row (mirrors note_async_dispatch):
+        # a final prefill chunk marked ONE in-flight token however wide
+        # the chunk; a decode/verify row marked its full candidate width
+        rows = ([(s, 1) for s in scheduler_output.prefills]
+                + [(s, s.num_new_tokens) for s in scheduler_output.decodes])
+        for sched, contrib in rows:
             req = sched.request
             gen = scheduler_output.async_sampled.get(req.request_id)
             consumed = (gen is not None
                         and gen == req.async_generation
                         and req.num_inflight_tokens > 0)
             if consumed:
-                req.num_inflight_tokens -= 1
+                req.num_inflight_tokens = max(
+                    req.num_inflight_tokens - contrib, 0)
             if req.is_finished:
                 # overshoot: the request stopped one step earlier
                 # (EOS/stop/abort/deadline) while this dispatch was in
@@ -798,6 +793,21 @@ class ARScheduler:
                 continue
             token = sampled.get(req.request_id)
             if token is None:
+                continue
+            if isinstance(token, list):
+                # verify row: keep the accepted prefix, rewind the rest
+                # (per-token advance mirrors the sync spec update so a
+                # stop inside the run leaves computed == appended)
+                req.num_computed_tokens -= sched.num_new_tokens
+                stopped = False
+                for t in token:
+                    req.num_computed_tokens += 1
+                    stopped = self._append_and_check_stop(req, t)
+                    if stopped:
+                        break
+                if stopped:
+                    finished.append(req)
+                    self._finish_running(req)
                 continue
             if self._append_and_check_stop(req, token):
                 finished.append(req)

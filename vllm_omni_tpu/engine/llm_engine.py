@@ -73,36 +73,35 @@ class EngineConfig:
     # allocatable via LRU eviction, so capacity is unaffected)
     enable_prefix_caching: bool = True
     # speculative decoding: drafts per step (needs a draft_fn — the MTP
-    # head, models/qwen3_omni/mtp.py); greedy requests only
+    # head, models/qwen3_omni/mtp.py).  Verify rows are k+1-token ragged
+    # rows of the unified dispatch; greedy requests verify by on-device
+    # accept-mask, sampled requests by on-device rejection sampling
     num_speculative_tokens: int = 0
-    # multi-step decode: run W decode iterations in one device call
-    # (on-device sampling inside a lax.scan) — amortizes the
-    # host<->device round trip that dominates decode latency on
-    # remote-attached chips; incompatible with spec decode,
-    # collect_hidden, and per-token logprobs (those batches fall back
-    # to single-step)
+    # RETIRED (PR 11): the multi-step lax.scan window is gone — the
+    # async pipelined step amortizes the host round trip instead, and
+    # it serves the batches the scan never could (mixed, sampled, spec,
+    # logprobs).  Accepted as a no-op so existing configs construct;
+    # values > 1 log a deprecation warning.
     multi_step_decode: int = 1
-    # unified ragged batching: mixed prefill+decode steps execute as ONE
-    # token-packed device dispatch (ops/ragged_paged_attention.py) —
+    # unified ragged batching POLICY (the execution mechanism is always
+    # on since PR 11 — every non-pure-decode step is ONE token-packed
+    # dispatch, ops/ragged_paged_attention.py, and the split executor
+    # is deleted).  This flag controls the SCHEDULER's packing policy:
     # decodes claim the token budget first, prefill chunks fill the
-    # remainder, and the jit shape-cache shrinks from a (batch, seq)
-    # bucket grid to a 1-D token-bucket line.  Chunked prefill becomes
-    # the mechanism (implied ON).  The split path remains the per-step
-    # fallback for spec decode, logprobs, collect_hidden, and
-    # embeds-as-input batches.  With async_scheduling, mixed steps stay
-    # eligible for the two-slot pipeline — prefills no longer force a
-    # sync drain.  See docs/ragged_batching.md.
+    # remainder, and chunked prefill becomes the mechanism (implied
+    # ON).  Off keeps the classic admission order and prompt-length
+    # limits.  See docs/ragged_batching.md.
     unified_batching: bool = False
-    # async pipelined step: two-slot pipeline over pure-decode batches —
-    # dispatch step N (forward + ON-DEVICE sampling, the sampled tokens
+    # async pipelined step: two-slot pipeline — dispatch step N
+    # (forward + ON-DEVICE sampling/verify/logprobs, the sampled tokens
     # stay device-resident and feed step N+1's dispatch directly), then
-    # do step N-1's host work (readback, stop checks, metrics) while the
-    # device computes.  Unlike multi_step_decode this works for MIXED
-    # sampling batches and doesn't delay token emission by a window —
-    # host readback lags exactly one step.  Batches needing host-visible
-    # logits (spec decode, logprobs, collect_hidden, streaming-chunk
-    # intake, cross-stage KV transfer) fall back to the synchronous path
-    # per step.  Greedy token streams are bit-identical to sync mode.
+    # do step N-1's host work (readback, stop checks, metrics) while
+    # the device computes.  Host readback lags exactly one step.  Since
+    # PR 11 every batch shape pipelines — spec decode, logprobs,
+    # collect_hidden, and embeds ride the unified dispatch; only
+    # host-synchronous KV movement (cross-stage transfer, tier-offload
+    # drains) and streaming chunk intake run a synchronous step.
+    # Greedy token streams are bit-identical to sync mode.
     # See docs/async_engine.md.
     async_scheduling: bool = False
     # tiered KV offload (docs/kv_cache.md): evicted prefix-cache pages
@@ -264,14 +263,12 @@ class LLMEngine:
             max_queue_depth=config.max_queue_depth,
             admission_deadline_headroom_s=(
                 config.admission_deadline_headroom_s),
-            # async pipelining and multi-step windows are alternative
-            # round-trip amortizations; windowed decodes would force the
-            # pipeline into permanent sync fallback, so async wins
-            multi_step_decode=(
-                1 if (config.num_speculative_tokens
-                      or config.async_scheduling) else
-                config.multi_step_decode),
         )
+        if config.multi_step_decode > 1:
+            logger.warning(
+                "multi_step_decode=%d is retired (PR 11): the async "
+                "pipelined step is the round-trip amortization; the "
+                "knob is ignored", config.multi_step_decode)
         sched_cls = (GenerationScheduler if config.worker_type == "generation"
                      else ARScheduler)
         self.scheduler = sched_cls(sched_cfg, kv)
@@ -313,13 +310,7 @@ class LLMEngine:
                 max_model_len=config.max_model_len, dtype=config.dtype,
                 collect_hidden=config.collect_hidden, seed=config.seed,
                 max_num_seqs=config.max_num_seqs, mesh=mesh,
-                # same forced-to-1 as the scheduler window: otherwise
-                # warmup compiles per-bucket multi-step executables
-                # (~21 s each on a remote chip) that can never run
-                multi_step_decode=(1 if config.async_scheduling
-                                   else config.multi_step_decode),
                 async_scheduling=config.async_scheduling,
-                unified_batching=config.unified_batching,
                 max_num_batched_tokens=config.max_num_batched_tokens,
                 deterministic_decode=config.deterministic_decode,
             )
@@ -697,12 +688,23 @@ class LLMEngine:
         # would replay the LAST sync step's tier churn
         offloads, restores = self._last_kv_moves
         self._last_kv_moves = (0, 0)
+        # spec decode honesty (record schema v2, docs/debugging.md): a
+        # verify-heavy step is distinguishable from plain decode —
+        # spec_rows counts k+1-token verify rows, verify_tokens their
+        # total candidate positions.  ``unified`` reflects the EXECUTED
+        # path (spec steps ride the unified dispatch since PR 11), not
+        # just the scheduler's packing-policy flag.
+        spec_rows = [s for s in sched_out.decodes if s.num_new_tokens > 1]
+        unified = bool(getattr(sched_out, "unified", False)
+                       or sched_out.prefills or spec_rows)
         self.flight.append({
             "path": path,
-            "unified": bool(getattr(sched_out, "unified", False)),
+            "unified": unified,
             "fallback": fallback,
             "prefills": len(sched_out.prefills),
             "decodes": len(sched_out.decodes),
+            "spec_rows": len(spec_rows),
+            "verify_tokens": sum(s.num_new_tokens for s in spec_rows),
             "new_tokens": new_tokens,
             "prefill_tokens": sum(s.num_new_tokens
                                   for s in sched_out.prefills),
@@ -764,8 +766,7 @@ class LLMEngine:
         axis that pins first is where the serving curve knees."""
         budget = max(self.config.max_num_batched_tokens, 1)
         prefill_toks = sum(s.num_new_tokens for s in sched_out.prefills)
-        decode_toks = sum(max(s.num_new_tokens, s.window)
-                          for s in sched_out.decodes)
+        decode_toks = sum(s.num_new_tokens for s in sched_out.decodes)
         self.step_metrics.on_saturation(
             prefill=prefill_toks / budget,
             decode=decode_toks / budget,
@@ -871,14 +872,15 @@ class LLMEngine:
             reason, 0) + 1
 
     def _step_async(self, t_step0: float) -> list[OmniRequestOutput]:
-        """Two-slot pipelined step: when the batch is pure single-token
-        decode — or, under unified batching, any mixed batch the ragged
-        executable serves — dispatch step N BEFORE retiring step N-1:
-        the device starts computing N while the host does N-1's token
-        readback, stop checks, and bookkeeping, plus (on the next call)
-        N+1's scheduling.  Anything needing host-visible logits drains
-        the pipeline and runs the synchronous path for that step,
-        counted per reason in ``async_fallback``."""
+        """Two-slot pipelined step: dispatch step N BEFORE retiring step
+        N-1 — the device starts computing N while the host does N-1's
+        token readback, stop checks, and bookkeeping, plus (on the next
+        call) N+1's scheduling.  Since PR 11 EVERY batch shape rides the
+        pipeline (mixed prefill+decode, spec verify, logprobs,
+        collect_hidden, embeds — the unified executable serves them
+        all); only host-synchronous KV movement (cross-stage transfer,
+        tier offload drains) and streaming chunk intake drain to the
+        synchronous path, counted per reason in ``async_fallback``."""
         ready, reason = self._pipeline_ready()
         if ready:
             sched_out = self.scheduler.schedule()
@@ -886,6 +888,13 @@ class LLMEngine:
                 waiting=len(self.scheduler.waiting),
                 running=len(self.scheduler.running),
             )
+            if sched_out.num_scheduled == 0 and self._inflight is not None:
+                # pipeline bubble: everything schedulable is waiting on
+                # the in-flight retire (e.g. a spec verify's accept
+                # count pins the request's next KV position) — retire
+                # now; the freed knowledge schedules next step
+                outs, _ = self._drain_pipeline()
+                return outs
             if self._pipeline_eligible(sched_out):
                 return self._step_pipelined(sched_out, t_step0)
             # scheduled but not dispatchable (e.g. page pressure
@@ -916,21 +925,16 @@ class LLMEngine:
                                           drained_wait_s=drain_wait,
                                           fallback=reason)
 
-    @property
-    def _unified_async(self) -> bool:
-        """Mixed batches ride the pipeline when the unified executable
-        exists (unified_batching on an AR runner)."""
-        return (self.config.unified_batching
-                and getattr(self.runner, "_unified_fn", None) is not None)
-
     def _pipeline_ready(self) -> "tuple[bool, Optional[str]]":
         """Cheap pre-schedule check: can the NEXT step be dispatched
-        ahead of token knowledge?  Mirrors the fallback matrix in
-        docs/async_engine.md (prefill row: unified batching keeps mixed
-        steps pipelined).  Returns (ready, fallback_reason) — reason is
-        None when there is simply nothing to dispatch."""
+        ahead of token knowledge?  Since PR 11 the list of drain
+        reasons is exactly the host-synchronous ones — KV movement and
+        streaming chunk intake; spec/logprobs/collect_hidden/embeds
+        batches pipeline through the unified dispatch and CANNOT
+        produce a fallback (docs/async_engine.md).  Returns (ready,
+        fallback_reason) — reason is None when there is simply nothing
+        to dispatch."""
         s = self.scheduler
-        unified = self._unified_async
         if not s.running and not s.waiting:
             return False, None  # idle: nothing to pipeline
         if self.config.kv_transfer is not None or s._pending_kv_transfers:
@@ -942,39 +946,20 @@ class LLMEngine:
             # tier moves are host-synchronous (batched extract/inject
             # between schedule and execute): run those steps sync
             return False, "kv_offload"
-        if self.config.collect_hidden:
-            return False, "collect_hidden"
-        if getattr(self.runner, "draft_fn", None) is not None:
-            return False, "spec"
-        if s.waiting and not unified:
-            return False, "prefill"
-        queues = (list(s.running) + list(s.waiting) if unified
-                  else list(s.running))
-        for r in queues:
+        for r in list(s.running) + list(s.waiting):
             if r.awaiting_chunks:
+                # chunk intake mutates the prompt between steps — the
+                # one remaining host-state hazard
                 return False, "streaming"
-            if r.spec_draft_tokens:
-                return False, "spec"
-            if r.sampling_params.logprobs is not None:
-                return False, "logprobs"
-            if (r.prompt_embeds is not None
-                    and r.num_computed_tokens < r.num_prompt_tokens):
-                return False, "embeds"
-            if r.deepstack_embeds and r.num_computed_tokens \
-                    < r.num_prompt_tokens:
-                return False, "embeds"
-            remaining = (r.num_tokens + r.num_inflight_tokens
-                         - r.num_computed_tokens)
-            if remaining != 1 and not unified:
-                return False, "prefill"
         return True, None
 
     def _pipeline_eligible(self, sched_out: SchedulerOutput) -> bool:
         """Post-schedule check on the actual output (preemption may have
-        reshaped it): single-token decodes — plus, under unified
-        batching, prefill chunks the ragged executable accepts — and
-        every decode input token either host-visible or device-resident
-        in the in-flight handle."""
+        reshaped it): every decode input token either host-visible or
+        device-resident in the in-flight handle, no KV movement queued
+        by this very schedule, and the batch packs into ONE unified
+        group (multi-group steps exist only under the one-shot
+        generation scheduler, which is never async)."""
         if not sched_out.decodes and not sched_out.prefills:
             return False
         if sched_out.kv_transfer_requests:
@@ -987,18 +972,13 @@ class LLMEngine:
             return False
         prev = self._inflight
         for s in sched_out.decodes:
-            if s.num_new_tokens != 1 or s.window != 1:
-                return False
             if s.start_pos >= s.request.num_tokens and (
                     prev is None
                     or s.request.request_id not in prev.handle.rows):
                 return False
-        if sched_out.prefills:
-            if not self._unified_async:
-                return False
-            eligible = getattr(self.runner, "_unified_eligible", None)
-            if eligible is None or not eligible(sched_out):
-                return False
+        if not self.runner._plain_decode_only(sched_out) \
+                and not self.runner.fits_unified(sched_out):
+            return False
         return True
 
     def _step_pipelined(self, sched_out: SchedulerOutput,
@@ -1010,15 +990,16 @@ class LLMEngine:
         self._observe_saturation(sched_out)
         t_d0, w_d0 = time.perf_counter(), time.time()
         u0, p0 = self._padding_totals()
-        if sched_out.prefills:
-            # unified mixed dispatch: prefill chunks pipeline too
-            handle = self.runner.dispatch_unified(
-                sched_out, prev.handle if prev is not None else None)
-        else:
+        if self.runner._plain_decode_only(sched_out):
             handle = self.runner.dispatch_decode(
                 sched_out.decodes,
                 prev.handle if prev is not None else None,
             )
+        else:
+            # unified dispatch: prefill chunks, spec verify rows,
+            # logprobs, and embeds batches pipeline too
+            handle = self.runner.dispatch_unified(
+                sched_out, prev.handle if prev is not None else None)
         # schedule-ahead accounting: the dispatched rows' tokens are
         # now in flight; the next schedule() counts them without seeing
         # their values
@@ -1061,16 +1042,33 @@ class LLMEngine:
                           host_ms=host_ms, device_ms=wait_s * 1e3)
         return outs
 
+    def _consolidate_hidden(self, finished) -> None:
+        """Fold per-step hidden chunks into the next-stage payload
+        (reference pooler_output routing, engine/output_processor.py:246)
+        — shared by the sync step and the async lagged retire, which
+        both finish requests."""
+        if not self.config.collect_hidden:
+            return
+        import numpy as np
+
+        for r in finished:
+            chunks = r.additional_information.pop("_hidden_chunks", None)
+            if chunks:
+                r.multimodal_output["hidden_states"] = np.concatenate(
+                    chunks, axis=0
+                )
+
     def _retire_step(self, inflight: _InflightStep):
         """Retire a dispatched step: the single lagged device_get, then
         token append / stop checks / latency bookkeeping.  Returns
         (outputs, new_tokens, seconds spent blocked on the device)."""
         rec = get_recorder()
         t_g0, w_g0 = time.perf_counter(), time.time()
-        sampled = self.runner.retire_decode(inflight.handle)
+        sampled = self.runner.retire_step(inflight.handle)
         wait_s = time.perf_counter() - t_g0
         finished = self.scheduler.update_from_async_retire(
             inflight.sched_out, sampled)
+        self._consolidate_hidden(finished)
         scheds = (inflight.sched_out.prefills
                   + inflight.sched_out.decodes)
         # only requests that could have appended a token this retire:
@@ -1280,8 +1278,7 @@ class LLMEngine:
         for s in sched_out.decodes:
             rec.record(s.request.additional_information.get("trace"),
                        "decode", w_ex0, dur_ex, stage_id=self.stage_id,
-                       args={"window": s.window,
-                             "tokens": s.num_new_tokens})
+                       args={"tokens": s.num_new_tokens})
         if self.kv_transfer_sink is not None:
             for req, _, _ in sched_out.kv_transfer_requests:
                 payload = run_out.extracted_kv.get(req.request_id)
@@ -1315,17 +1312,7 @@ class LLMEngine:
             host_ms=max(total_s - dur_ex - drained_wait_s, 0.0) * 1e3,
             device_ms=(dur_ex + drained_wait_s) * 1e3,
             fallback=fallback)
-        if self.config.collect_hidden:
-            # consolidate per-step hidden chunks into the next-stage payload
-            # (reference pooler_output routing, engine/output_processor.py:246)
-            import numpy as np
-
-            for r in finished:
-                chunks = r.additional_information.pop("_hidden_chunks", None)
-                if chunks:
-                    r.multimodal_output["hidden_states"] = np.concatenate(
-                        chunks, axis=0
-                    )
+        self._consolidate_hidden(finished)
         if not self.scheduler.has_unfinished:
             # no further step will run: drain transfers triggered just now
             # so finished requests still ship their KV

@@ -41,11 +41,12 @@ def _add_common(p: argparse.ArgumentParser):
                           "docs/async_engine.md)")
     eng.add_argument("--unified-batching", action="store_true",
                      default=None,
-                     help="unified ragged mixed batching: prefill "
-                          "chunks and decodes share ONE token-packed "
-                          "device dispatch per step, and mixed steps "
-                          "stay eligible for the async pipeline (see "
-                          "docs/ragged_batching.md)")
+                     help="unified scheduler packing policy: decodes "
+                          "claim the token budget first and chunked "
+                          "prefill becomes the mechanism.  Execution "
+                          "is always unified — every non-pure-decode "
+                          "step is ONE token-packed ragged dispatch "
+                          "(see docs/ragged_batching.md)")
     eng.add_argument("--kv-offload", action="store_true", default=None,
                      help="tiered KV offload: evicted prefix-cache "
                           "pages and preempted requests park their KV "

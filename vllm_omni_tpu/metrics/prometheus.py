@@ -154,8 +154,12 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "counter",
         "Cumulative seconds spent blocked on fresh compiles "
         "(first call per shape, to completion)", ("stage",)),
-    # async pipeline drain granularity (docs/async_engine.md fallback
-    # matrix): sync-path steps per reason while async scheduling is on
+    # async pipeline drain granularity (docs/async_engine.md): sync
+    # steps per reason while async scheduling is on.  Since PR 11 only
+    # host-state reasons exist (kv_transfer | kv_offload | streaming |
+    # reshaped) — the shape-based fallback matrix (spec / logprobs /
+    # collect_hidden / embeds / prefill) is deleted with the split
+    # executor and those label values can no longer be emitted
     "async_fallback_total": (
         "counter",
         "Async pipeline steps that fell back to the synchronous path",

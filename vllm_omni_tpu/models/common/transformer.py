@@ -532,6 +532,9 @@ def forward_unified(
     cu_q_lens: jax.Array,     # [S+1] aligned packed segment starts
     q_lens: jax.Array,        # [S] real token count per sequence
     num_seqs: jax.Array,      # [1]
+    inputs_embeds: Optional[jax.Array] = None,  # [T, embed_width]
+    embeds_mask: Optional[jax.Array] = None,    # [T] True=row uses embeds
+    deepstack: Optional[jax.Array] = None,      # [n_deep, T, hidden]
 ):
     """Unified ragged mixed-batch forward: prefill chunks and 1-token
     decode rows share ONE token-packed execution (ops/
@@ -540,16 +543,25 @@ def forward_unified(
     through the slot mapping, then attends the paged context raggedly —
     replacing the fresh/chunk/decode triple dispatch for mixed steps.
 
+    ``inputs_embeds``/``embeds_mask`` are the embeds-as-input path
+    scattered onto the packed token axis (see ``_embed_input``);
+    ``deepstack`` carries multiscale visual features per packed row
+    (zeros at non-visual rows), level ``i`` added to the residual
+    stream after decoder layer ``i`` — the same contract as
+    ``forward_prefill``, so embeds/deepstack batches ride the unified
+    dispatch instead of a separately padded executable.
+
     Returns (hidden [T, hidden], new kv_caches).
     """
-    x = nn.embedding(params["embed"], token_ids)  # [T, hidden]
+    x = _embed_input(params, token_ids, inputs_embeds, embeds_mask)
     if cfg.mrope_sections is None:
         cos, sin = _rope_tables(cfg, positions)
     else:
         # [3, T] -> the [B, 3, S] convention with B=1
         cos, sin = _rope_tables(cfg, positions[None])
     new_caches = []
-    for layer, (k_cache, v_cache) in zip(params["layers"], kv_caches):
+    for i, (layer, (k_cache, v_cache)) in enumerate(
+            zip(params["layers"], kv_caches)):
         def attend(q, k, v, k_cache=k_cache, v_cache=v_cache):
             k_cache, v_cache = write_kv_cache(
                 k_cache, v_cache, k, v, slot_mapping
@@ -561,6 +573,8 @@ def forward_unified(
             )
 
         x = _layer_step(layer, cfg, x, cos, sin, attend)
+        if deepstack is not None and i < deepstack.shape[0]:
+            x = x + deepstack[i].astype(x.dtype)
     return rms_norm(x, params["final_norm"]["w"], cfg.rms_eps), new_caches
 
 

@@ -311,55 +311,11 @@ def _finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr):
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-_SCORE_CAP = 2_097_152  # bq*bk elements: the f32 score block stays ~8 MB
-
-
-def _auto_blocks(sq: int, skv: int, d: int,
-                 itemsize: int = 2) -> tuple[int, int]:
-    """Pick (block_q, block_k) for the dense kernel by minimizing padded
-    MXU work under the score-block VMEM cap.
-
-    Measured on the chip (v5 lite, DiT joint seq 4608, d=128): the old
-    fixed (256, 256) grid ran 15552 tiny kernel invocations at 13% MFU —
-    per-step overhead dominated; (2048, 1024) hit 56%, and (2304, 768) —
-    both dividing the sequence exactly — 68%.  Large q blocks also cut
-    HBM traffic (KV is re-read once per q block), so ties prefer the
-    bigger bq.  Callers passing explicit block sizes bypass this.
-
-    The cap scales down with head dim and input width: q/k/v blocks and
-    the accumulator share VMEM with the score block, and f32 inputs
-    double their footprint (measured: (2304, 768) fits at bf16 d=128,
-    OOMs by 2.2 MB at f32)."""
-    cap = _SCORE_CAP * 128 // max(d, 128) * 2 // max(itemsize, 2)
-
-    def padded(s, b):
-        return -(-s // b) * b
-
-    best = None
-    for bq in (2304, 2048, 1792, 1536, 1280, 1024, 768, 512, 256):
-        bq_c = min(bq, max(8, sq))
-        for bk in (1024, 896, 768, 640, 512, 384, 256):
-            bk_c = min(bk, max(8, skv))
-            if bq_c * bk_c > cap:
-                continue
-            cand = (padded(sq, bq_c) * padded(skv, bk_c), -bq_c, -bk_c)
-            if best is None or cand < best[0]:
-                best = (cand, bq_c, bk_c)
-    if best is None:
-        # cap below even the smallest candidate product (huge head dim /
-        # wide inputs shrink it past 256*256): fall back instead of
-        # crashing on best[1] (ADVICE round 5).  Start from the smallest
-        # candidate pair and keep halving the larger side until the
-        # score block honors the cap too (floor 8 — the minimum tile).
-        bq = min(256, max(8, sq))
-        bk = min(256, max(8, skv))
-        while bq * bk > cap and (bq > 8 or bk > 8):
-            if bq >= bk and bq > 8:
-                bq = max(8, bq // 2)
-            else:
-                bk = max(8, bk // 2)
-        return bq, bk
-    return best[1], best[2]
+# (block_q, block_k) selection lives in ops/autotune.py (shared with
+# the ragged paged kernel's block picker); these aliases keep the
+# historical private names importable.
+from vllm_omni_tpu.ops.autotune import SCORE_CAP as _SCORE_CAP  # noqa: E402,F401
+from vllm_omni_tpu.ops.autotune import auto_blocks as _auto_blocks  # noqa: E402
 
 
 def _mk_kernel(with_lse: bool, with_mask: bool, with_qoff: bool = False, **cfg):

@@ -53,6 +53,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from vllm_omni_tpu.ops._dispatch import interpret_flag
+from vllm_omni_tpu.ops.autotune import auto_ragged_blocks
 
 _NEG_INF = -1e30
 
@@ -60,6 +61,9 @@ _NEG_INF = -1e30
 # packer must honor).  8 keeps the f32 sublane tile exact at group=1 and
 # bounds per-sequence alignment waste at 7 rows — a decode row costs 8
 # packed rows, vs the full (batch, seq) bucket pad of the split path.
+# ``auto_ragged_blocks`` (ops/autotune.py) picks the per-shape
+# (token_block, dma_slots) pair; this stays the packing contract's
+# default.
 DEFAULT_TOKEN_BLOCK = 8
 
 
@@ -143,15 +147,16 @@ def _ragged_kernel(
     # outputs
     o_ref,        # [1, 1, token_block * group, D] VMEM
     # scratch
-    k_buf,        # [2, page, D]
+    k_buf,        # [dma_slots, page, D]
     v_buf,
-    sems,         # DMA sems [2, 2]
+    sems,         # DMA sems [dma_slots, 2]
     acc_scr,      # [token_block * group, D]
     *,
     page_size: int,
     token_block: int,
     group: int,
     scale: float,
+    dma_slots: int,
 ):
     kvh = pl.program_id(0)
     j = pl.program_id(1)   # GLOBAL q block: segment alignment means it
@@ -181,8 +186,17 @@ def _ragged_kernel(
 
     @pl.when(jnp.logical_and(active, num_pages > 0))
     def _run():
+        # prime the page pipeline: up to ``dma_slots - 1`` pages in
+        # flight before the loop body consumes page 0 (dma_slots == 2
+        # is classic double buffering; deeper pipelines hide more HBM
+        # latency — ops/autotune.py picks the depth per shape)
         for dma in page_dma(0, 0):
             dma.start()
+        for ahead in range(1, dma_slots - 1):
+            @pl.when(ahead < num_pages)
+            def _prime(ahead=ahead):
+                for dma in page_dma(ahead, ahead):
+                    dma.start()
 
         # token index within the chunk / global position per q row
         # (rows pack ``group`` query heads per token, token-major);
@@ -196,12 +210,15 @@ def _ragged_kernel(
 
         def body(p_idx, carry):
             m_prev, l_prev, _ = carry  # acc lives in scratch
-            slot = jax.lax.rem(p_idx, 2)
-            nxt = jax.lax.rem(p_idx + 1, 2)
+            slot = jax.lax.rem(p_idx, dma_slots)
+            # keep the pipeline ``dma_slots - 1`` pages deep: the slot
+            # being refilled is the one consumed longest ago
+            pre = p_idx + dma_slots - 1
+            nxt = jax.lax.rem(pre, dma_slots)
 
-            @pl.when(p_idx + 1 < num_pages)
+            @pl.when(pre < num_pages)
             def _prefetch():
-                for dma in page_dma(nxt, p_idx + 1):
+                for dma in page_dma(nxt, pre):
                     dma.start()
 
             for dma in page_dma(slot, p_idx):
@@ -245,10 +262,11 @@ def _ragged_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "token_block", "use_pallas"))
+    jax.jit,
+    static_argnames=("scale", "token_block", "use_pallas", "dma_slots"))
 def _ragged_attention(
     q, k_cache, v_cache, page_tables, cu_q_lens, q_lens, seq_lens,
-    num_seqs, scale, token_block, use_pallas,
+    num_seqs, scale, token_block, use_pallas, dma_slots,
 ):
     t, h, d = q.shape
     hkv, _, page_size, _ = k_cache.shape
@@ -303,9 +321,9 @@ def _ragged_attention(
                                lambda kvh, j, *_: (kvh, j, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, d), k_cache.dtype),
-            pltpu.VMEM((2, page_size, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((dma_slots, page_size, d), k_cache.dtype),
+            pltpu.VMEM((dma_slots, page_size, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((dma_slots, 2)),
             pltpu.VMEM((rows, d), jnp.float32),
         ],
     )
@@ -316,6 +334,7 @@ def _ragged_attention(
             token_block=token_block,
             group=group,
             scale=scale,
+            dma_slots=dma_slots,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((hkv, nb, rows, d), q.dtype),
@@ -347,6 +366,7 @@ def ragged_paged_attention(
     scale: Optional[float] = None,
     token_block: int = DEFAULT_TOKEN_BLOCK,
     use_pallas: Optional[bool] = None,
+    dma_slots: Optional[int] = None,
 ):
     """Mixed prefill+decode paged attention over a token-packed batch.
 
@@ -356,7 +376,8 @@ def ragged_paged_attention(
     ``token_block``-aligned packed length; anything else (CPU tests,
     tiny shapes) takes the XLA reference.  An explicit
     ``use_pallas=True`` is honored as-is and fails loudly if
-    unsupported."""
+    unsupported.  ``dma_slots`` (page-DMA pipeline depth) defaults to
+    the per-shape ``auto_ragged_blocks`` choice."""
     if use_pallas is None:
         from vllm_omni_tpu.ops._dispatch import pallas_mode
 
@@ -364,8 +385,14 @@ def ragged_paged_attention(
         if (q.shape[-1] % 128 != 0 or k_cache.shape[2] % 8 != 0
                 or q.shape[0] % token_block != 0):
             use_pallas = False
+    if dma_slots is None:
+        _, dma_slots = auto_ragged_blocks(
+            head_dim=q.shape[-1], page_size=k_cache.shape[2],
+            group=q.shape[1] // k_cache.shape[0],
+            kv_itemsize=k_cache.dtype.itemsize,
+            q_itemsize=q.dtype.itemsize)
     num_seqs = jnp.asarray(num_seqs, jnp.int32)
     return _ragged_attention(
         q, k_cache, v_cache, page_tables, cu_q_lens, q_lens, seq_lens,
-        num_seqs, scale, token_block, use_pallas,
+        num_seqs, scale, token_block, use_pallas, dma_slots,
     )

@@ -179,6 +179,124 @@ def sample_tokens(
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
 
 
+@jax.jit
+def spec_verify_tokens(
+    cand_logits: jax.Array,  # [S, V, vocab] logits at candidate rows
+    drafts: jax.Array,       # [S, V-1] draft token ids (0-padded)
+    n_cand: jax.Array,       # [S] candidates per row (1 = plain sample)
+    temperature: jax.Array,  # [S]
+    top_k: jax.Array,        # [S]
+    top_p: jax.Array,        # [S]
+    keys: jax.Array,         # [S, 2] key data
+) -> tuple[jax.Array, jax.Array]:
+    """ON-DEVICE speculative verify + accept for a batch of candidate
+    rows — the accept-mask rebuild of the split path's host-side
+    ``_run_spec_decode`` loop (which paid an argmax ``device_get``, a
+    filtered-probs ``device_get``, and a numpy RNG walk per verify
+    step).  Returns ``(tokens [S, V] i32, counts [S] i32)``: row ``s``
+    emits ``tokens[s, :counts[s]]``.
+
+    Row semantics (``V`` = 1 + max draft length; row ``s`` carries
+    ``n_cand[s] - 1`` real drafts):
+
+    - plain rows (``n_cand == 1``): ``counts == 1`` and ``tokens[:, 0]``
+      is EXACTLY ``sample_tokens(cand_logits[:, 0], ...)`` — greedy
+      argmax or the same categorical draw from the same key, so folding
+      plain sampling and verify into one executable changes no stream.
+    - greedy verify (``temperature == 0``): accept the longest draft
+      prefix matching per-position argmax, then the bonus argmax — the
+      split path's accept loop, bit-identical.
+    - sampled verify: rejection sampling against the filtered target
+      distribution (accept draft ``d_j`` w.p. ``p_j(d_j)``; on
+      rejection draw the replacement from ``p_j`` with ``d_j`` excluded
+      and renormalized; full acceptance draws the bonus from the last
+      candidate's distribution) — the emitted stream is exactly
+      p-distributed.  Randomness is a deterministic per-(request, step,
+      position) stream derived from ``keys``.
+    """
+    s, v, vocab = cand_logits.shape
+    logits = cand_logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, V]
+    if v > 1:
+        j_idx = jnp.arange(v - 1)
+        has_draft = j_idx[None, :] < (n_cand - 1)[:, None]  # [S, V-1]
+        g_match = (drafts == greedy[:, :-1]) & has_draft
+        g_counts = 1 + jnp.sum(
+            jnp.cumprod(g_match.astype(jnp.int32), axis=1), axis=1)
+    else:
+        g_counts = jnp.ones((s,), jnp.int32)
+
+    def _sampled(_):
+        flat = logits.reshape(s * v, vocab)
+        rep = lambda x: jnp.repeat(x, v)  # noqa: E731
+        scaled = _filtered_scaled(flat, rep(temperature), rep(top_k),
+                                  rep(top_p)).reshape(s, v, vocab)
+        base = jax.vmap(jax.random.wrap_key_data)(keys)
+        # position-0 draw on the SAME stream as sample_tokens: a plain
+        # row folded into the verify executable samples identically
+        draw0 = jax.vmap(jax.random.categorical)(
+            base, scaled[:, 0]).astype(jnp.int32)
+        if v == 1:
+            return draw0[:, None], jnp.ones((s,), jnp.int32)
+        probs = jax.nn.softmax(scaled, axis=-1)  # [S, V, vocab]
+        # acceptance tests: u_j < p_j(d_j), stopped at the first miss
+        u = jax.vmap(lambda k: jax.vmap(
+            lambda j: jax.random.uniform(jax.random.fold_in(k, 1 + j))
+        )(j_idx))(base)
+        p_draft = jnp.take_along_axis(
+            probs[:, :-1], drafts[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        accept = (u < p_draft) & has_draft
+        r = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                    axis=1)                       # accepted drafts
+        counts = (r + 1).astype(jnp.int32)
+        # replacement draw per draft position: p_j \ {d_j} renormalized
+        # (log-space categorical == draw from the renormalized dist);
+        # a degenerate p_j(d_j) == 1 falls back to the argmax like the
+        # split path's host loop did
+        excl = jnp.where(jax.nn.one_hot(drafts, vocab, dtype=bool),
+                         0.0, probs[:, :-1])
+        excl_logits = jnp.where(excl > 0, jnp.log(excl), _NEG_INF)
+        rkeys = jax.vmap(lambda k: jax.vmap(
+            lambda j: jax.random.fold_in(k, 1001 + j))(j_idx))(base)
+        repl = jax.vmap(jax.vmap(jax.random.categorical))(
+            rkeys, excl_logits).astype(jnp.int32)
+        repl = jnp.where(excl.sum(-1) > 0, repl,
+                         jnp.argmax(probs[:, :-1], axis=-1)
+                         .astype(jnp.int32))
+        # bonus draw from the row's LAST candidate distribution
+        last = jnp.maximum(n_cand - 1, 0).astype(jnp.int32)
+        bonus_logits = jnp.take_along_axis(
+            jnp.where(probs > 0, jnp.log(probs), _NEG_INF),
+            last[:, None, None], axis=1)[:, 0]
+        bonus = jax.vmap(jax.random.categorical)(
+            jax.vmap(lambda k: jax.random.fold_in(k, 2001))(base),
+            bonus_logits).astype(jnp.int32)
+        # assemble: accepted drafts below r, replacement-or-bonus at r
+        pad = jnp.zeros((s, 1), jnp.int32)
+        drafts_pad = jnp.concatenate(
+            [drafts.astype(jnp.int32), pad], axis=1)     # [S, V]
+        repl_pad = jnp.concatenate([repl, pad], axis=1)
+        at_r = jnp.where(
+            r == (n_cand - 1),
+            bonus, jnp.take_along_axis(repl_pad, r[:, None], axis=1)[:, 0])
+        pos = jnp.arange(v)[None, :]
+        toks = jnp.where(pos == r[:, None], at_r[:, None], drafts_pad)
+        # plain rows keep the sample_tokens-identical position-0 draw
+        plain = (n_cand <= 1)
+        toks = toks.at[:, 0].set(jnp.where(plain, draw0, toks[:, 0]))
+        counts = jnp.where(plain, 1, counts)
+        return toks, counts
+
+    s_toks, s_counts = jax.lax.cond(
+        jnp.any(temperature > 0.0), _sampled,
+        lambda _: (greedy, g_counts), None)
+    is_greedy = temperature <= 0.0
+    tokens = jnp.where(is_greedy[:, None], greedy, s_toks)
+    counts = jnp.where(is_greedy, g_counts, s_counts)
+    return tokens.astype(jnp.int32), counts.astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def compute_logprobs(
     logits: jax.Array,   # [B, vocab]

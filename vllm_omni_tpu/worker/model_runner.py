@@ -1,17 +1,32 @@
-"""AR model runner: bucketed-jit execution of scheduler output.
+"""AR model runner: every step is ONE ragged dispatch.
 
 TPU-native counterpart of the reference's GPUARModelRunner (reference:
-worker/gpu_ar_model_runner.py:59).  Where the CUDA runner manages CUDA-graph
-capture + padded dispatch (:180-205), the TPU runner relies on XLA: every
-(bucket_batch, bucket_seq) shape compiles once and is cached; padding rides
-slot -1 (dropped by the KV scatter) and masked sampling.
+worker/gpu_ar_model_runner.py:59).  Where the CUDA runner manages
+CUDA-graph capture + padded dispatch (:180-205), this runner packs every
+scheduled batch onto a flat token axis and launches ONE token-packed
+executable per step (ops/ragged_paged_attention.py) — the split
+bucketed-jit executor (fresh prefill / chunked continuation / decode /
+spec verify as separately padded launches, deleted in PR 11) survives
+only as the dedicated [B]-row executable for pure single-token decode
+batches, where one row per sequence beats token-block alignment.
+
+Everything the split path used to drain the async pipeline for now
+rides the unified dispatch ON DEVICE:
+
+- speculative verify: a k+1-token ragged row; accept-mask + rejection
+  sampling run in the executable (sample/sampler.py
+  ``spec_verify_tokens``) — no per-verify-step ``device_get``
+- logprobs: chosen + top-k log-softmax computed in the step and carried
+  on the in-flight handle to the one lagged retire
+- collect_hidden: the packed hidden state rides the handle; per-request
+  rows are sliced host-side after the single retire transfer
+- embeds/deepstack inputs: scattered onto the packed token axis and fed
+  through ``forward_unified``
 
 Responsibilities (mirroring :90-396 / :398-588):
-- assemble padded device inputs from ``SchedulerOutput``
-- run jitted prefill / decode steps with donated KV caches
-- sample next tokens (sample/sampler.py)
-- slice per-request hidden states for next-stage payloads
-  (pooler_output analogue, reference :525-568)
+- assemble packed device inputs from ``SchedulerOutput``
+- run the jitted unified / decode steps with donated KV caches
+- sample next tokens ON DEVICE (sample/sampler.py)
 - extract KV pages for cross-stage transfer and ACK them
   (device half of OmniKVTransferManager, reference:
   distributed/omni_connectors/kv_transfer_manager.py:47)
@@ -19,24 +34,41 @@ Responsibilities (mirroring :90-396 / :398-588):
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import secrets
 import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from vllm_omni_tpu.core.scheduler import ScheduledRequest, SchedulerOutput
+from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.ops.autotune import auto_ragged_blocks
 from vllm_omni_tpu.ops.paged_attention import init_kv_cache, write_kv_cache
-from vllm_omni_tpu.ops.ragged_paged_attention import align_to_block
-from vllm_omni_tpu.sample.sampler import SamplingTensors, sample_tokens
+from vllm_omni_tpu.ops.ragged_paged_attention import (
+    DEFAULT_TOKEN_BLOCK,
+    align_to_block,
+)
+from vllm_omni_tpu.sample.sampler import (
+    SamplingTensors,
+    compute_logprobs,
+    sample_tokens,
+    spec_verify_tokens,
+)
 from vllm_omni_tpu.sampling_params import SamplingParams
+
+logger = init_logger(__name__)
+
+#: top-k width of the on-device logprob computation — the OpenAI API
+#: caps requests at 20, so one static width serves every request and
+#: the host trims per-request (a per-k executable would be a shape per
+#: distinct logprobs value)
+LOGPROBS_K = 20
 
 
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -51,8 +83,8 @@ def _bucketed_prefill_shapes(prefill_shapes, batch_buckets,
     """Expand declared (batch, seq_len) traffic shapes into the bucketed
     (b, s) set to warm: every batch bucket up to the declared batch (the
     scheduler admits whatever arrived, so smaller waves bucket lower),
-    seq clamped to its bucket.  Shared by the AR and generation runners'
-    precompile so their coverage policy cannot drift apart."""
+    seq clamped to its bucket.  Kept for the generation runner's padded
+    precompile; the AR runner's unified warmup walks token buckets."""
     todo = set()
     for raw_b, raw_s in prefill_shapes:
         b_top = _bucket(min(raw_b, batch_buckets[-1]), batch_buckets)
@@ -97,20 +129,42 @@ class UnifiedBatch(NamedTuple):
     last_idx: np.ndarray    # [S_max] packed row of each seq's last token
     t_pad: int              # token bucket the batch padded to
     total: int              # aligned rows actually occupied
+    verify_idx: np.ndarray  # [S_max, V] packed rows of candidate logits
+    n_cand: np.ndarray      # [S_max] candidates per row (1 = plain)
+    drafts: np.ndarray      # [S_max, V-1] draft token ids (0-padded)
+    embeds: Optional[np.ndarray] = None       # [T_pad, W]
+    embeds_mask: Optional[np.ndarray] = None  # [T_pad]
+    deepstack: Optional[np.ndarray] = None    # [n_deep, T_pad, H]
 
 
 @dataclass
 class InflightDecode:
-    """Handle for a dispatched-but-not-retired pipelined decode step.
+    """Handle for a dispatched-but-not-retired step (decode or unified).
 
     ``tokens`` stays DEVICE-resident: the next dispatch gathers its
-    input tokens straight from it (no host round trip), and the engine
-    retires it one step later with the single lagged ``device_get``
-    (the async pipeline's whole point — host readback leaves the
-    critical path)."""
+    input tokens straight from it (no host round trip) — for a unified
+    handle it is each row's LAST ACCEPTED token, so a spec verify row
+    feeds the following step exactly like a plain decode row.  The
+    engine retires the handle one step later with the single lagged
+    ``device_get`` of ``outs`` (the async pipeline's whole point — host
+    readback leaves the critical path)."""
 
-    tokens: jax.Array                 # [B_padded] i32, on device
-    rows: dict[str, int]              # request_id -> padded batch row
+    tokens: jax.Array                 # [rows] i32, on device
+    rows: dict[str, int]              # request_id -> row index
+    outs: Any = None                  # device output pytree of the step
+    kind: str = "decode"              # "decode" | "unified"
+    scheds: list = field(default_factory=list)  # row-ordered scheds
+    # per-row (async_generation at dispatch) — retire skips side
+    # effects (logprobs/hidden appends, spec stats) for rows whose
+    # request finished or was preempted-and-readmitted mid-flight
+    gens: list = field(default_factory=list)
+    asm: Optional[UnifiedBatch] = None
+    # indices of rows ASSEMBLED as spec verify rows.  Retire must key
+    # on this, not on a (width, is_prefill) predicate: a preempt-resume
+    # recompute chunk can start past the prompt with width > 1 and
+    # would otherwise be mistaken for a verify row, rewinding its
+    # multi-token advance to 1
+    spec_rows: set = field(default_factory=set)
 
 
 def _params_key(sp: SamplingParams) -> tuple:
@@ -142,15 +196,13 @@ class ARModelRunner:
         seed: Optional[int] = None,
         max_num_seqs: int = 64,
         mesh=None,  # 1-axis "tp" Mesh => tensor-parallel execution
-        multi_step_decode: int = 1,  # decode window per device call
-        async_scheduling: bool = False,  # precompile the dispatch path
-        unified_batching: bool = False,  # build the ragged unified step
+        multi_step_decode: int = 1,  # retired knob: accepted, ignored
+        async_scheduling: bool = False,
+        unified_batching: bool = True,  # retired knob: always unified
         max_num_batched_tokens: int = 2048,  # sizes the token buckets
         deterministic_decode: bool = False,  # pin decode batches to one bucket
     ):
-        self.multi_step_decode = max(1, int(multi_step_decode))
         self.async_scheduling = bool(async_scheduling)
-        self.unified_batching = bool(unified_batching)
         self.deterministic_decode = bool(deterministic_decode)
         self.mesh = mesh
         if mesh is not None:
@@ -159,6 +211,8 @@ class ARModelRunner:
             # shapes and cfg.tp_axis inserts the psum/all_gather
             # collectives (reference: tensor_parallel_size,
             # stage_configs/qwen3_omni_moe.yaml:27).
+            import dataclasses as _dc
+
             from vllm_omni_tpu.parallel.mesh import AXIS_TP
             from vllm_omni_tpu.parallel.sharding import shard_ar_params
 
@@ -168,7 +222,7 @@ class ARModelRunner:
                 raise ValueError(
                     f"tp={tp} must divide num_heads={cfg.num_heads} and "
                     f"num_kv_heads={cfg.num_kv_heads}")
-            cfg = dataclasses.replace(cfg, tp_axis=AXIS_TP)
+            cfg = _dc.replace(cfg, tp_axis=AXIS_TP)
             params = shard_ar_params(params, mesh)
         self.params = params
         self.cfg = cfg
@@ -176,17 +230,45 @@ class ARModelRunner:
         self.page_size = page_size
         self.max_pages_per_seq = -(-max_model_len // page_size)
         # bucket tables sized to the engine limits — the scheduler never
-        # emits a batch/chunk beyond them, so _bucket cannot overflow
+        # emits a batch beyond them, so _bucket cannot overflow
         self._batch_buckets = _make_buckets(1, max(max_num_seqs, 1))
         self._seq_buckets = _make_buckets(16, max(max_model_len, 16))
+        # ragged block choice (ops/autotune.py): the per-sequence
+        # q block doubles as the packer's segment alignment, so it is
+        # fixed here and honored by BOTH the assembler and the kernel;
+        # the DMA pipeline depth is the kernel's own knob.  Serving is
+        # decode-heavy, which pins the q block at the minimum tile.
+        _, dma_slots = auto_ragged_blocks(
+            head_dim=cfg.head_dim, page_size=page_size,
+            group=max(cfg.num_heads // max(cfg.num_kv_heads, 1), 1),
+            kv_itemsize=jnp.dtype(dtype).itemsize,
+            q_itemsize=jnp.dtype(dtype).itemsize)
+        # the packer's segment alignment is pinned to the kernel's
+        # packing contract (decode-heavy serving keeps the autotuner at
+        # the same minimum tile; plumb the block through forward_unified
+        # before honoring a larger choice here).  dma_slots is recorded
+        # for the warmup log — the kernel re-derives the identical value
+        # through the same lru-cached helper at dispatch.
+        self._token_block = DEFAULT_TOKEN_BLOCK
+        self._dma_slots = dma_slots
         # unified ragged batching pads to TOKEN-count buckets: a 1-D
         # bucket line replacing the (batch, seq) grid of the split path.
-        # Worst packed size = the step token budget plus per-sequence
-        # q-block alignment (ops/ragged_paged_attention.py layout).
-        t_cap = align_to_block(
+        # Worst packed size under the AR scheduler = the step token
+        # budget plus per-sequence q-block alignment; the one-shot
+        # generation scheduler ignores the token budget, so the line
+        # extends to max_model_len for CAPACITY — but warmup only walks
+        # the budget-reachable prefix (the AR scheduler can never emit
+        # the larger buckets, and each compile costs 20-40 s on a
+        # remote chip; a generation deployment takes the one-time
+        # first-hit compile instead)
+        budget_cap = align_to_block(
             max_num_batched_tokens
-            + max(max_num_seqs, 1) * (align_to_block(1) - 1))
+            + max(max_num_seqs, 1) * (align_to_block(1) - 1),
+            self._token_block)
+        t_cap = align_to_block(max(budget_cap, max_model_len),
+                               self._token_block)
         self._token_buckets = _make_buckets(16, max(t_cap, 16))
+        self._warm_token_cap = max(budget_cap, 16)
         self.collect_hidden = collect_hidden
         # --- telemetry (metrics/stats.py pulls these per step) ---
         # device dispatches: one jitted-executable launch each; tests
@@ -236,160 +318,106 @@ class ARModelRunner:
         # host-side hot-path caches: crc32 sampling salts per request_id
         # and assembled SamplingTensors per batch composition — a
         # pure-decode batch keeps the same (requests, params) for
-        # hundreds of steps, and _sample_and_record used to rebuild both
-        # every step (only the PRNG keys actually depend on the step)
+        # hundreds of steps, and rebuilding both every step was
+        # measurable in the step-phase breakdown
         self._salt_cache: dict[str, int] = {}
         self._st_cache: dict[tuple, tuple] = {}
-        # multimodal 3D-RoPE: positions carry 3 streams ([B, 3, S] / [B, 3])
+        # multimodal 3D-RoPE: positions carry 3 streams ([3, T] packed)
         self.use_mrope = cfg.mrope_sections is not None
 
         cfg_ = cfg
+        collect_ = collect_hidden
 
-        # KV caches are donated: each step consumes the old cache buffers and
-        # returns updated ones — no copy, the XLA equivalent of in-place
-        # CUDA cache writes.
-        # one closure serves both paths: inputs_embeds=None and =array are
-        # two jit specializations of the same function
-        def _prefill(params, token_ids, kv_caches, positions, slot_mapping,
-                     last_idx, inputs_embeds=None, embeds_mask=None,
-                     deepstack=None):
-            hidden, new_caches = tfm.forward_prefill(
-                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
-                inputs_embeds=inputs_embeds, embeds_mask=embeds_mask,
-                deepstack=deepstack,
-            )
-            b = token_ids.shape[0]
-            last_hidden = hidden[jnp.arange(b), last_idx]  # [B, H]
-            logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
-            return logits, last_hidden, hidden, new_caches
-
-        def _chunk_prefill(params, token_ids, kv_caches, positions,
-                           slot_mapping, last_idx, block_tables,
-                           context_lens, q_starts, inputs_embeds=None,
-                           embeds_mask=None, deepstack=None):
-            hidden, new_caches = tfm.forward_prefill_chunked(
-                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
-                block_tables, context_lens, q_starts,
-                inputs_embeds=inputs_embeds, embeds_mask=embeds_mask,
-                deepstack=deepstack,
-            )
-            b = token_ids.shape[0]
-            last_hidden = hidden[jnp.arange(b), last_idx]
-            logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
-            return logits, last_hidden, hidden, new_caches
-
-        def _verify(params, token_ids, kv_caches, positions, slot_mapping,
-                    block_tables, context_lens, q_starts):
-            # spec-decode verify: logits at EVERY candidate position
-            # (the chunked forward writes KV for all candidates; rejected
-            # slots are position-keyed and get overwritten by real tokens)
-            hidden, new_caches = tfm.forward_prefill_chunked(
-                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
-                block_tables, context_lens, q_starts,
-            )
-            logits = tfm.logits_from_hidden(params, cfg_, hidden)
-            return logits, hidden, new_caches
-
-        def _decode(params, token_ids, kv_caches, positions, slot_mapping,
-                    block_tables, context_lens):
+        def _decode_core(params, token_ids, kv_caches, positions,
+                         slot_mapping, block_tables, context_lens,
+                         temperature, top_k, top_p, keys,
+                         want_lp: bool):
+            # the [B]-row pure-decode step: forward + ON-DEVICE sampling
+            # (the hoist that enables the async pipelined engine step —
+            # sampled tokens stay device-resident and feed the NEXT
+            # dispatch, so jax.device_get becomes a one-step-lagged
+            # retire, engine/llm_engine.py).  The want_lp variant also
+            # computes chosen/top-k logprobs in the step, so logprobs
+            # decode batches pipeline instead of draining.
             hidden, new_caches = tfm.forward_decode(
-                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
-                block_tables, context_lens,
-            )
-            logits = tfm.logits_from_hidden(params, cfg_, hidden)
-            return logits, hidden, new_caches
-
-        def _decode_sample(params, token_ids, kv_caches, positions,
-                           slot_mapping, block_tables, context_lens,
-                           temperature, top_k, top_p, keys):
-            # single-step decode with ON-DEVICE sampling — the sampling
-            # hoist out of _decode_multi's scan body that enables the
-            # async pipelined engine step: the sampled tokens stay
-            # device-resident and feed the NEXT decode dispatch directly,
-            # so jax.device_get moves off the critical path and becomes
-            # a one-step-lagged retire (engine/llm_engine.py)
-            hidden, new_caches = tfm.forward_decode(
-                params, cfg_, token_ids, positions, kv_caches, slot_mapping,
-                block_tables, context_lens,
+                params, cfg_, token_ids, positions, kv_caches,
+                slot_mapping, block_tables, context_lens,
             )
             logits = tfm.logits_from_hidden(params, cfg_, hidden)
             toks = sample_tokens(logits, temperature, top_k, top_p, keys)
-            return toks, new_caches
+            out = {"tokens": toks}
+            if want_lp:
+                chosen, top_v, top_i = compute_logprobs(
+                    logits, toks, LOGPROBS_K)
+                out.update(lp_chosen=chosen, lp_topv=top_v, lp_topi=top_i)
+            if collect_:
+                out["hidden"] = hidden
+            return out, new_caches
 
-        def _unified(params, token_ids, kv_caches, positions, slot_mapping,
-                     page_tables, seq_lens, cu_q_lens, q_lens, num_seqs,
-                     last_idx, temperature, top_k, top_p, keys):
-            # ONE executable for a mixed prefill+decode step: the
-            # token-packed ragged forward (ops/ragged_paged_attention.py)
-            # writes KV through the same slot-mapping scatter, then
-            # samples ON DEVICE from each sequence's last-token row —
-            # non-final chunk rows sample discarded tokens (greedy
-            # padding params keep the sampler's fast path).  Shapes vary
+        def _decode_step(*args):
+            return _decode_core(*args, want_lp=False)
+
+        def _decode_step_lp(*args):
+            return _decode_core(*args, want_lp=True)
+
+        def _unified_core(params, token_ids, kv_caches, positions,
+                          slot_mapping, page_tables, seq_lens, cu_q_lens,
+                          q_lens, num_seqs, verify_idx, n_cand, drafts,
+                          temperature, top_k, top_p, keys,
+                          inputs_embeds=None, embeds_mask=None,
+                          deepstack=None):
+            # ONE executable for every non-pure-decode step: the
+            # token-packed ragged forward serves prefill chunks,
+            # decode rows, and k+1-token spec verify rows in the same
+            # flat [T] axis; candidate logits are gathered at
+            # ``verify_idx`` (all rows point at the sampling position
+            # for plain sequences), verify/accept + sampling run on
+            # device, and logprobs ride the output pytree.  Shapes vary
             # only in the token axis, so the jit cache is a 1-D
             # token-bucket line instead of a (batch, seq) grid.
             hidden, new_caches = tfm.forward_unified(
                 params, cfg_, token_ids, positions, kv_caches,
                 slot_mapping, page_tables, seq_lens, cu_q_lens, q_lens,
-                num_seqs,
+                num_seqs, inputs_embeds=inputs_embeds,
+                embeds_mask=embeds_mask, deepstack=deepstack,
             )
-            last_hidden = hidden[last_idx]  # [S, hidden]
-            logits = tfm.logits_from_hidden(params, cfg_, last_hidden)
-            toks = sample_tokens(logits, temperature, top_k, top_p, keys)
-            return toks, new_caches
-
-        ps_ = page_size
-
-        def _decode_multi(params, token_ids, kv_caches, positions, gpos,
-                          valid, block_tables, temperature, top_k, top_p,
-                          base_keys, n_steps):
-            """``n_steps`` decode iterations in ONE device execution:
-            forward -> sample (on device) -> feed back, via lax.scan.
-            Amortizes the host<->device round trip that dominates decode
-            latency on remote-attached chips (vLLM's TPU backend does
-            the same).  Per-step KV slots derive on device from the
-            block table and the running global position ``gpos`` — the
-            scheduler pre-allocated pages for the whole window.  Returns
-            (tokens [n_steps, B], new kv_caches)."""
-
-            def body(carry, step):
-                tok, pos, g, kv = carry
-                page = jnp.take_along_axis(
-                    block_tables, (g // ps_)[:, None], axis=1)[:, 0]
-                slot = jnp.where(valid, page * ps_ + g % ps_, -1)
-                hidden, kv = tfm.forward_decode(
-                    params, cfg_, tok, pos, kv, slot, block_tables,
-                    g + 1)
-                logits = tfm.logits_from_hidden(params, cfg_, hidden)
-                keys = jax.vmap(
-                    lambda kd: jax.random.key_data(jax.random.fold_in(
-                        jax.random.wrap_key_data(kd), step)))(base_keys)
-                nxt = sample_tokens(logits, temperature, top_k, top_p,
-                                    keys)
-                return (nxt, pos + 1, g + 1, kv), nxt
-
-            (_, _, _, kv_caches), toks = jax.lax.scan(
-                body, (token_ids, positions, gpos, kv_caches),
-                jnp.arange(n_steps))
-            return toks, kv_caches
+            cand_hidden = hidden[verify_idx]          # [S, V, H]
+            logits = tfm.logits_from_hidden(params, cfg_, cand_hidden)
+            toks, counts = spec_verify_tokens(
+                logits, drafts, n_cand, temperature, top_k, top_p, keys)
+            ar = jnp.arange(toks.shape[0])
+            last = jnp.maximum(counts - 1, 0)
+            last_tok = toks[ar, last]
+            chosen, top_v, top_i = compute_logprobs(
+                logits[:, 0], toks[:, 0], LOGPROBS_K)
+            out = {"tokens": toks, "counts": counts,
+                   "last_tok": last_tok,
+                   "lp_chosen": chosen, "lp_topv": top_v,
+                   "lp_topi": top_i}
+            if drafts.shape[1] > 0:
+                # the accept-position hidden rows feed the next draft
+                # proposal — only a drafted runner (V > 1, a STATIC
+                # shape) needs them; without a draft head the [S, H]
+                # array would be dead weight on every lagged retire
+                # transfer
+                out["accept_hidden"] = hidden[verify_idx[ar, last]]
+            if collect_:
+                out["hidden"] = hidden
+            return out, new_caches
 
         if mesh is None:
             jit2 = functools.partial(jax.jit, donate_argnums=(2,))
-            self._prefill_fn = jit2(_prefill)
-            self._chunk_prefill_fn = jit2(_chunk_prefill)
-            self._verify_fn = jit2(_verify)
-            self._decode_fn = jit2(_decode)
-            self._decode_sample_fn = jit2(_decode_sample)
-            self._unified_fn = (jit2(_unified)
-                                if self.unified_batching else None)
-            self._decode_multi_fn = jax.jit(
-                _decode_multi, donate_argnums=(2,),
-                static_argnums=(11,))
+            self._decode_sample_fn = jit2(_decode_step)
+            self._decode_lp_fn = jit2(_decode_step_lp)
+            self._unified_fn = jit2(_unified_core)
         else:
             # TP: shard_map over the tp axis — params/KV are the only
             # sharded operands; token inputs replicate, and the psums in
             # _layer_step make activations (logits/hidden) replicated
             # outputs. shard_map (not GSPMD) because the Pallas attention
-            # kernels cannot be auto-partitioned by XLA.
+            # kernels cannot be auto-partitioned by XLA.  Sampling is
+            # deterministic in (logits, keys) and logits replicate, so
+            # every shard samples the same token.
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
@@ -402,55 +430,64 @@ class ARModelRunner:
             kv_specs = [ar_kv_cache_spec()] * cfg.num_layers
             rep = P()
 
-            def wrap(f, n_rest, n_out):
+            def wrap(f, n_rest, out_keys):
+                out_spec = ({k: rep for k in out_keys}, kv_specs)
                 sm = shard_map(
                     f, mesh=mesh,
                     in_specs=(pspecs, rep, kv_specs) + (rep,) * n_rest,
-                    out_specs=(rep,) * n_out + (kv_specs,),
+                    out_specs=out_spec,
                     check_vma=False,
                 )
                 return jax.jit(sm, donate_argnums=(2,))
 
-            self._prefill_fn = wrap(_prefill, 6, 3)
-            self._chunk_prefill_fn = wrap(_chunk_prefill, 9, 3)
-            self._verify_fn = wrap(_verify, 5, 2)
-            self._decode_fn = wrap(_decode, 4, 2)
-            # sampling is deterministic in (logits, keys) and the
-            # per-layer psums make logits replicated, so every shard
-            # samples the same token — same argument as _decode_multi_tp
-            self._decode_sample_fn = wrap(_decode_sample, 8, 1)
-            # unified ragged step under TP: the ragged kernel runs on
-            # LOCAL head shapes inside the same shard_map wrap as the
-            # decode path (TPLA stance, PAPERS.md); metadata replicates
-            self._unified_fn = (wrap(_unified, 12, 1)
-                                if self.unified_batching else None)
+            dec_keys = ("tokens",) + (("hidden",) if collect_ else ())
+            self._decode_sample_fn = wrap(_decode_step, 8, dec_keys)
+            self._decode_lp_fn = wrap(
+                _decode_step_lp, 8,
+                dec_keys + ("lp_chosen", "lp_topv", "lp_topi"))
+            # the unified step's embeds/deepstack tail is optional and
+            # accept_hidden exists only for drafted runners (drafts
+            # width > 0), and shard_map needs a fixed arity + output
+            # tree — build one wrap per variant on first use (same
+            # shape-cache stance as jit itself)
+            uni_wraps: dict[tuple, Any] = {}
 
-            # Multi-step decode under TP: the scan lives INSIDE the
-            # shard_map body, so the KV carry stays on local shard
-            # shapes throughout the window.  The per-layer psums make
-            # hidden/logits replicated, and sampling is deterministic
-            # in (logits, keys) — every shard samples the same token,
-            # so the fed-back carry stays consistent without a
-            # collective.  n_steps must be static for the scan length:
-            # the shard_map closes over it per jit specialization.
-            @functools.partial(jax.jit, donate_argnums=(2,),
-                               static_argnums=(11,))
-            def _decode_multi_tp(params, token_ids, kv_caches, positions,
-                                 gpos, valid, block_tables, temperature,
-                                 top_k, top_p, base_keys, n_steps):
-                sm = shard_map(
-                    lambda p, t, k, *rest: _decode_multi(
-                        p, t, k, *rest, n_steps),
-                    mesh=mesh,
-                    in_specs=(pspecs, rep, kv_specs) + (rep,) * 8,
-                    out_specs=(rep, kv_specs),
-                    check_vma=False,
-                )
-                return sm(params, token_ids, kv_caches, positions, gpos,
-                          valid, block_tables, temperature, top_k, top_p,
-                          base_keys)
+            def unified_dispatch(*args, inputs_embeds=None,
+                                 embeds_mask=None, deepstack=None):
+                has_e = inputs_embeds is not None
+                has_d = deepstack is not None
+                has_dr = args[12].shape[1] > 0  # drafts operand
+                uni_keys = ("tokens", "counts", "last_tok",
+                            "lp_chosen", "lp_topv", "lp_topi")
+                if has_dr:
+                    uni_keys += ("accept_hidden",)
+                if collect_:
+                    uni_keys += ("hidden",)
+                fn = uni_wraps.get((has_e, has_d, has_dr))
+                if fn is None:
+                    extra = (2 if has_e else 0) + (1 if has_d else 0)
 
-            self._decode_multi_fn = _decode_multi_tp
+                    def make_core(he: bool, hd: bool):
+                        # he/hd are CLOSED-OVER python bools fixed per
+                        # wrap arity — never traced values
+                        def core(p, t, k, *rest):
+                            base, tail = rest[:14], rest[14:]
+                            emb = tail[0] if he else None
+                            mask = tail[1] if he else None
+                            deep = tail[2 if he else 0] if hd else None
+                            return _unified_core(
+                                p, t, k, *base, inputs_embeds=emb,
+                                embeds_mask=mask, deepstack=deep)
+
+                        return core
+
+                    fn = uni_wraps[(has_e, has_d, has_dr)] = wrap(
+                        make_core(has_e, has_d), 14 + extra, uni_keys)
+                extras = tuple(x for x in (inputs_embeds, embeds_mask,
+                                           deepstack) if x is not None)
+                return fn(*args, *extras)
+
+            self._unified_fn = unified_dispatch
         # speculative decoding (MTP draft head): draft_fn(last_hidden [M,H],
         # last_token [M], positions [M]) -> [M, k] proposals
         self.draft_fn = None
@@ -464,11 +501,21 @@ class ARModelRunner:
             if "embed_proj" in params else cfg.hidden_size
         )
 
+    @property
+    def _spec_v(self) -> int:
+        """Candidate rows per sequence in the unified executable: the
+        regular sample plus every possible draft.  1 without a draft
+        head — the verify machinery degenerates to plain sampling in
+        the same executable."""
+        return 1 + self.num_draft_tokens
+
     def set_draft_fn(self, draft_fn, num_draft_tokens: int) -> None:
         """Install the MTP draft head (talker spec decode, reference:
         gpu_ar_model_runner.py:466-497 EAGLE propose).  A draft_fn taking
         a ``contexts`` kwarg also receives each drafted request's full
-        post-step token history (oracle/tree drafters)."""
+        post-step token history (oracle/tree drafters).  Install BEFORE
+        warmup: the candidate width V = 1 + k is part of the unified
+        executable's input shapes."""
         import inspect
 
         self.draft_fn = draft_fn
@@ -542,46 +589,32 @@ class ARModelRunner:
     # ---------------------------------------------------------- precompile
     def precompile(self, prefill_shapes=(), decode: bool = True,
                    progress_fn=None) -> int:
-        """Build bucketed executables BEFORE serving traffic.
+        """Build the executables BEFORE serving traffic.
 
         XLA compiles one executable per input-shape signature, and a
         cache miss mid-traffic stalls every in-flight request for the
         full compile — measured 20-40 s per shape on a remote-attached
-        chip (the reference warms its runner at startup for the same
-        reason: worker warmup / CUDA-graph capture,
-        vllm_omni/worker/gpu_ar_model_runner.py capture path).
+        chip.  The unified refactor shrank the warmup surface from the
+        (batch, seq) grid × {prefill, chunk, decode, verify, multi} to:
 
-        ``decode`` compiles the single-step and (when configured)
-        multi-step executables for every batch bucket — engine traffic
-        can only ever produce those two scan lengths (core/scheduler.py
-        hands out the full window or 1) — plus, when a draft head is
-        installed, the spec-verify executable at its candidate length.
-        ``prefill_shapes`` is an iterable of (batch, seq_len) pairs for
-        the prompt shapes the deployment expects — bucketed and deduped
-        here, so callers pass raw traffic shapes.  Each pair warms BOTH
-        the fresh-prefill and the chunked-continuation executable at
-        EVERY batch bucket up to the given batch (APC prefix hits and
-        scheduler admission split one arrival wave into smaller
-        fresh/chunked sub-batches, each bucketed separately); a
-        continuation whose remainder buckets to a seq bucket not listed
-        still compiles on first hit — include the chunk lengths you
-        expect in ``prefill_shapes``.  Dummy inputs
-        write to KV slot -1, which the paged cache update drops
-        (ops/paged_attention.py write_kv mode="drop"), so the live KV
-        pool is untouched.
+        - the 1-D token-bucket line of the unified executable (one
+          shape per bucket; the candidate width V = 1 + draft k is
+          fixed per runner — install the draft head first), and
+        - the decode batch buckets × {plain, logprobs} of the dedicated
+          pure-decode step.
 
-        Returns the number of executables requested (cached ones are
-        free)."""
+        ``prefill_shapes`` is accepted for API compatibility; every
+        packed size a prefill can produce already lands on a token
+        bucket.  Embeds/deepstack batches add an argument-tree variant
+        that compiles on first hit.  Dummy inputs write to KV slot -1,
+        which the paged cache update drops, so the live KV pool is
+        untouched.  Returns the number of executables requested."""
+        del prefill_shapes  # the token-bucket line covers prefills
         built = 0
 
         def note(msg):
             if progress_fn is not None:
                 progress_fn(msg)
-
-        def pos_shape(b, s=None):
-            if s is None:
-                return (b, 3) if self.use_mrope else (b,)
-            return (b, 3, s) if self.use_mrope else (b, s)
 
         def warm(kind, key, thunk):
             nonlocal built
@@ -589,142 +622,91 @@ class ARModelRunner:
             built += 1
             return res
 
-        if decode:
+        logger.info(
+            "ragged blocks: token_block=%d dma_slots=%d (head_dim=%d "
+            "page_size=%d) — ops/autotune.py", self._token_block,
+            self._dma_slots, self.cfg.head_dim, self.page_size)
+
+        def pos_shape(b):
+            return (b, 3) if self.use_mrope else (b,)
+
+        if decode and self.draft_fn is None:
             # deterministic decode runs every step at the top bucket —
-            # the smaller executables can never be dispatched
+            # the smaller executables can never be dispatched.  A
+            # runner with a draft head never dispatches the [B]-row
+            # decode path at all (_plain_decode_only routes every
+            # decode batch unified), so its buckets would be pure
+            # warmup waste.
             decode_buckets = (self._batch_buckets[-1:]
                               if self.deterministic_decode
                               else self._batch_buckets)
             for b in decode_buckets:
-                note(f"precompile decode b={b}")
-                zeros_b = jnp.zeros((b,), jnp.int32)
                 tables = jnp.zeros((b, self.max_pages_per_seq), jnp.int32)
-                _, _, self.kv_caches = warm(
-                    "decode", (b,), lambda: self._decode_fn(
-                        self.params, zeros_b, self.kv_caches,
-                        jnp.zeros(pos_shape(b), jnp.int32),
-                        jnp.full((b,), -1, jnp.int32), tables,
-                        jnp.ones((b,), jnp.int32)))
-                if self.async_scheduling:
-                    # the async pipeline's dispatch path (forward +
-                    # on-device sampling) is its own executable
-                    t = SamplingTensors.build(
-                        [_PAD_SAMPLING] * b, step=0,
-                        base_seed=self._base_seed)
+                zeros_b = jnp.zeros((b,), jnp.int32)
+                t = SamplingTensors.build(
+                    [_PAD_SAMPLING] * b, step=0,
+                    base_seed=self._base_seed)
+                for kind, fn in (("dispatch", self._decode_sample_fn),
+                                 ("dispatch_lp", self._decode_lp_fn)):
+                    note(f"precompile {kind} b={b}")
                     _, self.kv_caches = warm(
-                        "dispatch", (b,), lambda: self._decode_sample_fn(
+                        kind, (b,), lambda fn=fn: fn(
                             self.params, zeros_b, self.kv_caches,
                             jnp.zeros(pos_shape(b), jnp.int32),
                             jnp.full((b,), -1, jnp.int32), tables,
                             jnp.ones((b,), jnp.int32),
                             t.temperature, t.top_k, t.top_p, t.keys))
-                if (self.multi_step_decode > 1
-                        and self._decode_multi_fn is not None):
-                    t = SamplingTensors.build(
-                        [_PAD_SAMPLING] * b, step=0,
-                        base_seed=self._base_seed)
-                    # valid=False derives slot -1 on device: the whole
-                    # window's KV writes drop
-                    _, self.kv_caches = warm(
-                        "multi", (b, self.multi_step_decode),
-                        lambda: self._decode_multi_fn(
-                            self.params, zeros_b, self.kv_caches,
-                            jnp.zeros(pos_shape(b), jnp.int32), zeros_b,
-                            jnp.zeros((b,), bool), tables,
-                            t.temperature, t.top_k, t.top_p, t.keys,
-                            self.multi_step_decode))
-                if self.draft_fn is not None and self.num_draft_tokens:
-                    # spec-decode verify batches run at the candidate
-                    # length (1 regular + k draft positions)
-                    s = _bucket(1 + self.num_draft_tokens,
-                                self._seq_buckets)
-                    _, _, self.kv_caches = warm(
-                        "verify", (b, s, self.max_pages_per_seq),
-                        lambda: self._verify_fn(
-                            self.params, jnp.zeros((b, s), jnp.int32),
-                            self.kv_caches,
-                            jnp.zeros(pos_shape(b, s), jnp.int32),
-                            jnp.full((b, s), -1, jnp.int32), tables,
-                            jnp.ones((b,), jnp.int32),
-                            jnp.zeros((b,), jnp.int32)))
-        if self._unified_fn is not None:
-            # ONE executable per token bucket — the 1-D shape-cache line
-            # that replaces the (batch, seq) grid for mixed steps
-            s_max = self._batch_buckets[-1]
-            t = SamplingTensors.build(
-                [_PAD_SAMPLING] * s_max, step=0,
-                base_seed=self._base_seed)
-            for t_pad in self._token_buckets:
-                note(f"precompile unified t={t_pad}")
-                pos = (jnp.zeros((3, t_pad), jnp.int32) if self.use_mrope
-                       else jnp.zeros((t_pad,), jnp.int32))
-                _, self.kv_caches = warm(
-                    "unified", (t_pad,), lambda: self._unified_fn(
-                        self.params, jnp.zeros((t_pad,), jnp.int32),
-                        self.kv_caches, pos,
-                        jnp.full((t_pad,), -1, jnp.int32),
-                        jnp.zeros((s_max, self.max_pages_per_seq),
-                                  jnp.int32),
-                        jnp.zeros((s_max,), jnp.int32),
-                        jnp.zeros((s_max + 1,), jnp.int32),
-                        jnp.zeros((s_max,), jnp.int32),
-                        jnp.zeros((1,), jnp.int32),
-                        jnp.zeros((s_max,), jnp.int32),
-                        t.temperature, t.top_k, t.top_p, t.keys))
-
-        seen_chunks = set()
-        for b, s in _bucketed_prefill_shapes(
-                prefill_shapes, self._batch_buckets, self._seq_buckets):
-            note(f"precompile prefill b={b} s={s}")
-            # trailing (None, None, None) mirrors _prefill_common's
-            # *embeds_args for a token-only batch: jit's cache key
-            # covers the argument TREE, so the same shapes with a
-            # different arity would still be a fresh executable
-            _, _, _, self.kv_caches = warm(
-                "prefill", (b, s, False, False), lambda: self._prefill_fn(
-                    self.params, jnp.zeros((b, s), jnp.int32),
-                    self.kv_caches, jnp.zeros(pos_shape(b, s), jnp.int32),
-                    jnp.full((b, s), -1, jnp.int32),
-                    jnp.zeros((b,), jnp.int32), None, None, None))
-            # APC prefix hits / chunked-prefill continuations run the
-            # chunked executable; its signature is (batch, chunk bucket,
-            # context pages) where pages derive from the CONTEXT's seq
-            # bucket (_cont_tables).  Warm the two dominant combos for
-            # this context: a full-width chunk (recompute/resume) and a
-            # minimum-bucket chunk (short APC remainder after a long
-            # cached prefix).  Intermediate chunk buckets still compile
-            # on first hit — list them in prefill_shapes if expected.
-            pages = -(-s // self.page_size)
-            for s_chunk in {s, self._seq_buckets[0]}:
-                key = ("chunk", b, s_chunk, pages)
-                if key in seen_chunks:
-                    continue
-                seen_chunks.add(key)
-                _, _, _, self.kv_caches = warm(
-                    "chunk", (b, s_chunk, pages, False, False),
-                    lambda: self._chunk_prefill_fn(
-                        self.params, jnp.zeros((b, s_chunk), jnp.int32),
-                        self.kv_caches,
-                        jnp.zeros(pos_shape(b, s_chunk), jnp.int32),
-                        jnp.full((b, s_chunk), -1, jnp.int32),
-                        jnp.zeros((b,), jnp.int32),
-                        jnp.zeros((b, pages), jnp.int32),
-                        jnp.ones((b,), jnp.int32),
-                        jnp.zeros((b,), jnp.int32),
-                        None, None, None))
+        # ONE executable per token bucket — the 1-D shape-cache line
+        # that replaces the (batch, seq) grid
+        s_max = self._batch_buckets[-1]
+        v = self._spec_v
+        t = SamplingTensors.build(
+            [_PAD_SAMPLING] * s_max, step=0, base_seed=self._base_seed)
+        for t_pad in self._token_buckets:
+            if t_pad > self._warm_token_cap:
+                # reachable only by the one-shot generation scheduler's
+                # whole-prompt packs — first-hit compile there, never
+                # under the budget-capped AR scheduler
+                continue
+            note(f"precompile unified t={t_pad} v={v}")
+            pos = (jnp.zeros((3, t_pad), jnp.int32) if self.use_mrope
+                   else jnp.zeros((t_pad,), jnp.int32))
+            _, self.kv_caches = warm(
+                "unified", (t_pad, v, False, False),
+                lambda: self._unified_fn(
+                    self.params, jnp.zeros((t_pad,), jnp.int32),
+                    self.kv_caches, pos,
+                    jnp.full((t_pad,), -1, jnp.int32),
+                    jnp.zeros((s_max, self.max_pages_per_seq),
+                              jnp.int32),
+                    jnp.zeros((s_max,), jnp.int32),
+                    jnp.zeros((s_max + 1,), jnp.int32),
+                    jnp.zeros((s_max,), jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((s_max, v), jnp.int32),
+                    jnp.ones((s_max,), jnp.int32),
+                    jnp.zeros((s_max, v - 1), jnp.int32),
+                    t.temperature, t.top_k, t.top_p, t.keys))
         return built
 
     # ---------------------------------------------------------------- step
     def execute(
         self, sched_out: SchedulerOutput, extract_kv: bool = True
     ) -> RunnerOutput:
-        self._step += 1
+        """Synchronous step: dispatch + immediate retire of the SAME
+        handles the async pipeline uses — one executable family, one
+        numerics contract, so sync and pipelined streams cannot drift."""
         out = RunnerOutput()
-        if self._unified_eligible(sched_out):
-            # mixed (or pure-prefill) step as ONE token-packed dispatch
-            self._run_unified(sched_out.decodes + sched_out.prefills, out)
-        else:
-            self._execute_split(sched_out, out)
+        decodes, prefills = sched_out.decodes, sched_out.prefills
+        if self._plain_decode_only(sched_out):
+            handle = self.dispatch_decode(decodes)
+            out.sampled.update(self.retire_step(handle))
+        elif decodes or prefills:
+            for g_decodes, g_prefills in self._pack_groups(decodes,
+                                                           prefills):
+                handle = self._dispatch_unified(g_decodes, g_prefills,
+                                                None)
+                out.sampled.update(self.retire_step(handle))
         for req, block_ids, seq_len in sched_out.kv_transfer_requests:
             # skip the device→host gather when no sink consumes it, but
             # still ACK so the scheduler releases the pinned pages
@@ -735,95 +717,72 @@ class ARModelRunner:
             out.kv_extracted_req_ids.add(req.request_id)
         return out
 
-    def _execute_split(self, sched_out: SchedulerOutput,
-                       out: RunnerOutput) -> None:
-        """The bucketed-jit split path: up to three separately padded
-        executables per step (fresh prefill / chunked continuation /
-        decode) — the fallback matrix behind the unified ragged path
-        (spec decode, logprobs, collect_hidden, embeds inputs; see
-        docs/ragged_batching.md)."""
-        plain = [s for s in sched_out.decodes if s.num_new_tokens == 1]
-        spec = [s for s in sched_out.decodes if s.num_new_tokens > 1]
-        if plain:
-            # Multi-step window: the scheduler hands out the FULL
-            # configured window or window=1, never an intermediate
-            # length (each distinct scan length is its own executable —
-            # a mid-run tail compile measured 21 s on a remote chip).
-            # The rare window=1 stragglers (near max_model_len / budget
-            # exhaustion) run as their own single-step batch instead of
-            # cliffing the windowed batch down with them.
-            full = [s for s in plain if s.window > 1]
-            single = [s for s in plain if s.window == 1]
-            if (full and self._decode_multi_fn is not None
-                    and self.draft_fn is None
-                    and not self.collect_hidden
-                    and all(s.request.sampling_params.logprobs is None
-                            for s in full)):
-                self._run_decode_multi(full, full[0].window, out)
-                if single:
-                    self._run_decode(single, out)
-            else:
-                self._run_decode(plain, out)
-        if spec:
-            self._run_spec_decode(spec, out)
-        if sched_out.prefills:
-            # Three-way split: continuation chunks (cached prefix; the
-            # chunked kernel gathers context pages) run separately from
-            # fresh prefills, and embeds-as-input prefills (downstream
-            # stages consuming upstream hidden states) run as a separate
-            # padded batch — the jit signature differs per variant.
-            fresh = [s for s in sched_out.prefills if s.start_pos == 0]
-            cont = [s for s in sched_out.prefills if s.start_pos > 0]
-            for group, runner in ((fresh, self._run_prefill),
-                                  (cont, self._run_chunk_prefill)):
-                with_embeds = [s for s in group
-                               if s.request.prompt_embeds is not None]
-                token_only = [s for s in group
-                              if s.request.prompt_embeds is None]
-                if token_only:
-                    runner(token_only, out)
-                if with_embeds:
-                    runner(with_embeds, out, use_embeds=True)
+    # ------------------------------------------------------------ routing
+    def _plain_decode_only(self, sched_out: SchedulerOutput) -> bool:
+        """Pure single-token decode batches keep the dedicated [B]
+        executable — 1 row per sequence beats token-block alignment.
+        Anything else (prefill chunks, spec verify rows) packs onto
+        the unified token axis.  This is a ROUTING choice between two
+        always-available single-dispatch paths, not a fallback: both
+        ride the async handle, and logprobs/collect_hidden are served
+        by either.  A runner with a draft head routes every decode
+        batch unified — the step's ``accept_hidden`` is what the draft
+        proposal reads, and a drafted request's rows are verify rows
+        (num_new_tokens > 1) on the very next step anyway."""
+        if self.draft_fn is not None:
+            return False
+        return (bool(sched_out.decodes) and not sched_out.prefills
+                and all(s.num_new_tokens == 1 for s in sched_out.decodes))
 
-    # ---------------------------------------------------- unified ragged
-    def _unified_eligible(self, sched_out: SchedulerOutput) -> bool:
-        """Mixed/prefill steps ride the unified token-packed executable
-        when the scheduler emitted a unified batch and nothing in it
-        needs the split path (the fallback matrix: spec decode,
-        logprobs, collect_hidden, embeds/deepstack inputs, multi-step
-        windows).  Pure-decode steps keep the dedicated [B] decode
-        executables — 1 row per sequence beats token-block alignment."""
-        if self._unified_fn is None or not getattr(
-                sched_out, "unified", False):
-            return False
-        if not sched_out.prefills:
-            return False
-        if self.collect_hidden or self.draft_fn is not None:
-            return False
+    def fits_unified(self, sched_out: SchedulerOutput) -> bool:
+        """One packed group?  The engine pipelines single-group steps;
+        a multi-group step (possible only under the one-shot generation
+        scheduler, which ignores the token budget) runs synchronously
+        as several dispatches."""
         scheds = sched_out.decodes + sched_out.prefills
         if len(scheds) > self._batch_buckets[-1]:
             return False
-        total = sum(align_to_block(s.num_new_tokens) for s in scheds)
-        if total > self._token_buckets[-1]:
-            return False
-        for s in sched_out.decodes:
-            if s.num_new_tokens != 1 or s.window != 1:
-                return False
-        for s in scheds:
-            req = s.request
-            if (req.sampling_params.logprobs is not None
-                    or req.prompt_embeds is not None
-                    or req.deepstack_embeds):
-                return False
-        return True
+        total = sum(align_to_block(s.num_new_tokens, self._token_block)
+                    for s in scheds)
+        return total <= self._token_buckets[-1]
 
-    def _assemble_unified(self, scheds: list[ScheduledRequest]):
+    def _pack_groups(self, decodes, prefills):
+        """Split an oversized step into sequential unified dispatches
+        (decodes first, arrival order preserved — the same admission
+        order the scheduler emitted)."""
+        s_cap = self._batch_buckets[-1]
+        t_cap = self._token_buckets[-1]
+        groups: list[tuple[list, list]] = []
+        cur_d: list[ScheduledRequest] = []
+        cur_p: list[ScheduledRequest] = []
+        tot = 0
+        for sched, is_decode in ([(s, True) for s in decodes]
+                                 + [(s, False) for s in prefills]):
+            need = align_to_block(sched.num_new_tokens, self._token_block)
+            if (cur_d or cur_p) and (
+                    len(cur_d) + len(cur_p) + 1 > s_cap
+                    or tot + need > t_cap):
+                groups.append((cur_d, cur_p))
+                cur_d, cur_p, tot = [], [], 0
+            (cur_d if is_decode else cur_p).append(sched)
+            tot += need
+        if cur_d or cur_p:
+            groups.append((cur_d, cur_p))
+        return groups
+
+    # ---------------------------------------------------- unified ragged
+    def _assemble_unified(self, scheds: list[ScheduledRequest],
+                          spec_rows: set[int]) -> UnifiedBatch:
         """Token-packed device inputs for a mixed batch: each sequence's
         chunk occupies a token-block-aligned segment of the flat token
         axis (the layout contract of ops/ragged_paged_attention.py);
         metadata arrays are fixed [S_max] width so shapes vary only in
-        the token bucket."""
+        the token bucket.  ``spec_rows``: indices of verify rows, whose
+        segment is [last_sampled, draft_1..draft_k] and whose candidate
+        logits cover every position."""
         s_max = self._batch_buckets[-1]
+        v = self._spec_v
+        tb = self._token_block
         n = len(scheds)
         cu = np.zeros((s_max + 1,), np.int32)
         q_lens = np.zeros((s_max,), np.int32)
@@ -836,7 +795,7 @@ class ARModelRunner:
             seq_lens[i] = sc.start_pos + sc.num_new_tokens
             t = sc.block_table[: self.max_pages_per_seq]
             tables[i, : len(t)] = t
-            total += align_to_block(sc.num_new_tokens)
+            total += align_to_block(sc.num_new_tokens, tb)
         cu[n:] = total
         t_pad = _bucket(max(total, self._token_buckets[0]),
                         self._token_buckets)
@@ -845,28 +804,95 @@ class ARModelRunner:
                      else np.zeros((t_pad,), np.int32))
         slots = np.full((t_pad,), -1, np.int32)
         last_idx = np.zeros((s_max,), np.int32)
+        verify_idx = np.zeros((s_max, v), np.int32)
+        n_cand = np.ones((s_max,), np.int32)
+        drafts = np.zeros((s_max, max(v - 1, 0)), np.int32)
+        use_embeds = any(s.request.prompt_embeds is not None
+                         for s in scheds)
+        embeds = (np.zeros((t_pad, self.embeds_width), np.float32)
+                  if use_embeds else None)
+        embeds_mask = np.zeros((t_pad,), bool) if use_embeds else None
+        # deepstack multiscale visual features, shipped as sparse
+        # (offset, [n_deep, T_item, hidden]) spans on the request and
+        # scattered here (zeros at non-visual rows): level i adds to the
+        # residual stream after decoder layer i
+        n_deep = max((arr.shape[0]
+                      for s in scheds
+                      for off, arr in (s.request.deepstack_embeds or ())
+                      if off < s.start_pos + s.num_new_tokens
+                      and off + arr.shape[1] > s.start_pos),
+                     default=0)
+        deep = (np.zeros((n_deep, t_pad, self.cfg.hidden_size),
+                         np.float32) if n_deep else None)
         for i, sc in enumerate(scheds):
+            req = sc.request
             m = sc.num_new_tokens
             lo = int(cu[i])
-            # an async-fed decode row's input token is still in flight
-            # (all_token_ids slice comes back empty): dispatch_unified
-            # scatters it device-side from the previous handle
-            toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + m]
-            token_ids[lo: lo + len(toks)] = toks
+            if i in spec_rows:
+                # verify row: [last_sampled, drafts...] — drafts are
+                # inputs from the previous step's proposal, verified by
+                # this step's candidate logits.  A pipelined verify
+                # whose first input token is still in flight leaves a
+                # placeholder; _dispatch_unified scatters the real
+                # token device-side from the previous handle
+                first = (req.all_token_ids[sc.start_pos]
+                         if sc.start_pos < req.num_tokens else 0)
+                row = ([first]
+                       + [int(x) for x in
+                          req.spec_draft_tokens[: m - 1]])
+                token_ids[lo: lo + m] = row
+                drafts[i, : m - 1] = row[1:]
+                n_cand[i] = m
+                verify_idx[i] = lo + np.minimum(np.arange(v), m - 1)
+            else:
+                # an async-fed decode row's input token is still in
+                # flight (all_token_ids slice comes back empty):
+                # _dispatch_unified scatters it device-side from the
+                # previous handle
+                toks = req.all_token_ids[sc.start_pos: sc.start_pos + m]
+                token_ids[lo: lo + len(toks)] = toks
+                # plain rows: every candidate slot points at the
+                # sampling position (the segment's last token)
+                verify_idx[i] = lo + m - 1
             p = np.arange(sc.start_pos, sc.start_pos + m)
             if self.use_mrope:
-                positions[:, lo: lo + m] = self._mrope_cols(sc.request, p)
+                positions[:, lo: lo + m] = self._mrope_cols(req, p)
             else:
                 positions[lo: lo + m] = p
             slots[lo: lo + m] = sc.slot_mapping
             last_idx[i] = lo + m - 1
+            if use_embeds and req.prompt_embeds is not None:
+                # embeds cover prompt rows only; a recompute-resumed
+                # request also re-prefills its generated tokens, which
+                # embed from the table (mask False)
+                pe = np.asarray(req.prompt_embeds)
+                elo = min(sc.start_pos, pe.shape[0])
+                ehi = min(sc.start_pos + m, pe.shape[0])
+                if ehi > elo:
+                    embeds[lo: lo + ehi - elo] = pe[elo:ehi]
+                    embeds_mask[lo: lo + ehi - elo] = True
+            if deep is not None:
+                # intersect each visual span with this chunk's window
+                # [start_pos, start_pos+m); rows outside any span (text,
+                # re-prefilled generated tokens) stay zero
+                for off, arr in req.deepstack_embeds or ():
+                    dlo = max(off, sc.start_pos)
+                    dhi = min(off + arr.shape[1], sc.start_pos + m)
+                    if dlo < dhi:
+                        deep[: arr.shape[0],
+                             lo + dlo - sc.start_pos:
+                             lo + dhi - sc.start_pos] = (
+                            arr[:, dlo - off: dhi - off])
         return UnifiedBatch(token_ids, positions, slots, tables,
-                            seq_lens, cu, q_lens, last_idx, t_pad, total)
+                            seq_lens, cu, q_lens, last_idx, t_pad, total,
+                            verify_idx, n_cand, drafts, embeds,
+                            embeds_mask, deep)
 
     def _unified_sampling(self, scheds, key_tag: str, t_pad: int):
         """[S_max]-wide SamplingTensors: real params on rows whose chunk
-        reaches the sequence's last token (the sequence-final flag),
-        greedy padding elsewhere (keeps sample_tokens' fast path)."""
+        reaches the sequence's last token (the sequence-final flag —
+        verify rows included), greedy padding elsewhere (keeps
+        sample_tokens' fast path)."""
         s_max = self._batch_buckets[-1]
         params_list = [_PAD_SAMPLING] * s_max
         salts = [0] * s_max
@@ -882,54 +908,35 @@ class ARModelRunner:
             + _params_key(sc.request.sampling_params) for i, sc in final)
         return self._sampling_tensors(key, params_list, salts), final
 
-    def _call_unified(self, asm: UnifiedBatch, tensors, token_ids,
-                      n: int):
-        """Shared device-invocation half of the sync and async unified
-        paths — ONE dispatch for the whole mixed batch."""
-        self._note_padding(int(asm.q_lens.sum()), asm.t_pad)
-        toks, self.kv_caches = self._run_jit(
-            "unified", (asm.t_pad,), lambda: self._unified_fn(
-                self.params, token_ids, self.kv_caches,
-                jnp.asarray(asm.positions), jnp.asarray(asm.slots),
-                jnp.asarray(asm.tables), jnp.asarray(asm.seq_lens),
-                jnp.asarray(asm.cu_q_lens), jnp.asarray(asm.q_lens),
-                jnp.asarray([n], jnp.int32), jnp.asarray(asm.last_idx),
-                tensors.temperature, tensors.top_k, tensors.top_p,
-                tensors.keys))
-        return toks
-
-    def _run_unified(self, scheds: list[ScheduledRequest],
-                     out: RunnerOutput) -> None:
-        asm = self._assemble_unified(scheds)
-        tensors, final = self._unified_sampling(scheds, "unified",
-                                                asm.t_pad)
-        toks = self._call_unified(asm, tensors,
-                                  jnp.asarray(asm.token_ids),
-                                  len(scheds))
-        # omnilint: disable=OL2 - batch boundary: scheduler needs tokens
-        toks = np.asarray(jax.device_get(toks))
-        for i, sc in final:
-            out.sampled[sc.request.request_id] = int(toks[i])
-
     def dispatch_unified(
         self, sched_out: SchedulerOutput,
         prev: Optional[InflightDecode] = None,
     ) -> InflightDecode:
-        """Async dispatch of a unified MIXED step: prefill chunks no
-        longer force the two-slot pipeline to drain (engine/
-        llm_engine.py).  Decode rows whose input token is still in
-        flight gather it device-side from ``prev.tokens`` — the same
-        device-resident feedback as ``dispatch_decode``; the returned
-        handle is retire-compatible with it (``retire_decode``)."""
+        """Dispatch a unified step on the async handle: prefill chunks,
+        spec verify rows, logprobs, collect_hidden, and embeds inputs
+        all ride the two-slot pipeline (engine/llm_engine.py).  Decode
+        rows whose input token is still in flight gather it device-side
+        from ``prev.tokens`` — each row's last ACCEPTED token, so the
+        feed works across decode and unified handles alike."""
+        return self._dispatch_unified(sched_out.decodes,
+                                      sched_out.prefills, prev)
+
+    def _dispatch_unified(self, decodes, prefills,
+                          prev: Optional[InflightDecode]
+                          ) -> InflightDecode:
         self._step += 1
-        scheds = sched_out.decodes + sched_out.prefills
-        asm = self._assemble_unified(scheds)
-        tensors, final = self._unified_sampling(scheds, "udispatch",
+        scheds = decodes + prefills
+        spec_rows = {i for i, s in enumerate(decodes)
+                     if s.num_new_tokens > 1}
+        asm = self._assemble_unified(scheds, spec_rows)
+        tensors, final = self._unified_sampling(scheds, "unified",
                                                 asm.t_pad)
         feed_dst: list[int] = []
         feed_src: list[int] = []
         for i, sc in enumerate(scheds):
-            if sc.start_pos >= sc.request.num_tokens:
+            if sc.start_pos >= sc.request.num_tokens and (
+                    prev is not None
+                    and sc.request.request_id in prev.rows):
                 # input token sampled by the previous dispatch, still
                 # device-resident
                 feed_dst.append(int(asm.cu_q_lens[i]))
@@ -938,134 +945,292 @@ class ARModelRunner:
         if feed_dst:
             token_ids = token_ids.at[jnp.asarray(feed_dst)].set(
                 prev.tokens[jnp.asarray(feed_src)])
-        toks = self._call_unified(asm, tensors, token_ids, len(scheds))
+        # verify tokens are USEFUL work (each is a candidate position
+        # the model scores); only block-alignment slack pads
+        self._note_padding(int(asm.q_lens.sum()), asm.t_pad)
+        if spec_rows:
+            self.spec_stats["verify_steps"] += 1
+        kwargs = {}
+        if asm.embeds is not None:
+            kwargs["inputs_embeds"] = jnp.asarray(
+                asm.embeds, dtype=self.params_dtype)
+            kwargs["embeds_mask"] = jnp.asarray(asm.embeds_mask)
+        if asm.deepstack is not None:
+            kwargs["deepstack"] = jnp.asarray(
+                asm.deepstack, dtype=self.params_dtype)
+        outs, self.kv_caches = self._run_jit(
+            "unified",
+            # the deepstack LEVEL COUNT is part of the operand shape —
+            # omitting it would misclassify a real mid-traffic compile
+            # as a cache hit and blind the compile-stall introspection
+            (asm.t_pad, self._spec_v, asm.embeds is not None,
+             asm.deepstack.shape[0] if asm.deepstack is not None else 0),
+            lambda: self._unified_fn(
+                self.params, token_ids, self.kv_caches,
+                jnp.asarray(asm.positions), jnp.asarray(asm.slots),
+                jnp.asarray(asm.tables), jnp.asarray(asm.seq_lens),
+                jnp.asarray(asm.cu_q_lens), jnp.asarray(asm.q_lens),
+                jnp.asarray([len(scheds)], jnp.int32),
+                jnp.asarray(asm.verify_idx), jnp.asarray(asm.n_cand),
+                jnp.asarray(asm.drafts),
+                tensors.temperature, tensors.top_k, tensors.top_p,
+                tensors.keys, **kwargs))
         return InflightDecode(
-            tokens=toks,
+            tokens=outs["last_tok"],
             rows={sc.request.request_id: i for i, sc in final},
+            outs=outs, kind="unified", scheds=list(scheds),
+            gens=[s.request.async_generation for s in scheds],
+            asm=asm, spec_rows=spec_rows,
         )
 
-    # ------------------------------------------------------------- prefill
-    def _run_prefill(self, scheds: list[ScheduledRequest], out: RunnerOutput,
-                     use_embeds: bool = False):
-        self._prefill_common(scheds, out, use_embeds, cont=False)
-
-    def _run_chunk_prefill(self, scheds: list[ScheduledRequest],
-                           out: RunnerOutput, use_embeds: bool = False):
-        """Later chunks of a chunked prefill: the chunk attends the cached
-        KV of earlier chunks through its block table."""
-        self._prefill_common(scheds, out, use_embeds, cont=True)
-
-    def _prefill_common(self, scheds: list[ScheduledRequest],
-                        out: RunnerOutput, use_embeds: bool, cont: bool):
-        """Shared padded-batch assembly for fresh prefills and chunk
-        continuations; ``cont`` adds the block-table/context/q-start
-        operands the cached-context kernel needs."""
-        b = _bucket(len(scheds), self._batch_buckets)
-        max_n = max(s.num_new_tokens for s in scheds)
-        s_len = _bucket(max_n, self._seq_buckets)
-
-        token_ids = np.zeros((b, s_len), np.int32)
-        positions = (np.zeros((b, 3, s_len), np.int32) if self.use_mrope
-                     else np.zeros((b, s_len), np.int32))
-        slots = np.full((b, s_len), -1, np.int32)
-        last_idx = np.zeros((b,), np.int32)
-        embeds = (np.zeros((b, s_len, self.embeds_width), np.float32)
-                  if use_embeds else None)
-        embeds_mask = np.zeros((b, s_len), bool) if use_embeds else None
-        # deepstack multiscale visual features, shipped as sparse
-        # (offset, [n_deep, T_item, hidden]) spans on the request and
-        # scattered here (zeros at non-visual rows): level i adds to the
-        # residual stream after decoder layer i
-        n_deep = max((arr.shape[0]
-                      for s in scheds
-                      for off, arr in (s.request.deepstack_embeds or ())
-                      if off < s.start_pos + s.num_new_tokens
-                      and off + arr.shape[1] > s.start_pos),
-                     default=0)
-        deep = (np.zeros((b, n_deep, s_len, self.cfg.hidden_size),
-                         np.float32) if n_deep else None)
-        if cont:
-            tables, ctx, q_starts, pages = self._cont_tables(scheds, b)
+    # ------------------------------------------------ pipelined dispatch
+    def dispatch_decode(
+        self, scheds: list[ScheduledRequest],
+        prev: Optional[InflightDecode] = None,
+    ) -> InflightDecode:
+        """Dispatch half of the async pipelined step for a pure
+        single-token decode batch: forward + on-device sampling (+
+        logprobs when any row wants them), returning WITHOUT waiting.
+        Input tokens that are not host-visible yet (sampled by ``prev``,
+        still in flight) are gathered device-side from ``prev.tokens``.
+        The engine retires the handle one step later (``retire_step``)."""
+        self._step += 1
+        b = self._decode_bucket(len(scheds))
+        token_host = np.zeros((b,), np.int32)
+        feed_rows: list[int] = []
+        feed_src: list[int] = []
+        params_list = [_PAD_SAMPLING] * b
+        salts = [0] * b
+        want_lp = False
         for i, sc in enumerate(scheds):
-            n = sc.num_new_tokens
-            toks = sc.request.all_token_ids[sc.start_pos: sc.start_pos + n]
-            token_ids[i, :n] = toks
-            p = np.arange(sc.start_pos, sc.start_pos + n)
-            if self.use_mrope:
-                positions[i, :, :n] = self._mrope_cols(sc.request, p)
+            req = sc.request
+            if sc.start_pos < req.num_tokens:
+                token_host[i] = req.all_token_ids[sc.start_pos]
             else:
-                positions[i, :n] = p
-            slots[i, :n] = sc.slot_mapping
-            last_idx[i] = n - 1
-            if use_embeds:
-                # embeds cover prompt rows only; a recompute-resumed request
-                # also re-prefills its generated tokens, which embed from
-                # the table (mask False)
-                pe = np.asarray(sc.request.prompt_embeds)
-                lo = min(sc.start_pos, pe.shape[0])
-                hi = min(sc.start_pos + n, pe.shape[0])
-                embeds[i, : hi - lo] = pe[lo:hi]
-                embeds_mask[i, : hi - lo] = True
-            if deep is not None:
-                # intersect each visual span with this chunk's window
-                # [start_pos, start_pos+n); rows outside any span (text,
-                # re-prefilled generated tokens) stay zero
-                for off, arr in sc.request.deepstack_embeds or ():
-                    lo = max(off, sc.start_pos)
-                    hi = min(off + arr.shape[1], sc.start_pos + n)
-                    if lo < hi:
-                        deep[i, : arr.shape[0],
-                             lo - sc.start_pos: hi - sc.start_pos] = (
-                            arr[:, lo - off: hi - off])
-
-        embeds_args = (
-            (jnp.asarray(embeds, dtype=self.params_dtype)
-             if use_embeds else None),
-            jnp.asarray(embeds_mask) if use_embeds else None,
-            (jnp.asarray(deep, dtype=self.params_dtype)
-             if deep is not None else None),
+                # input token still in flight from the previous dispatch
+                feed_rows.append(i)
+                feed_src.append(prev.rows[req.request_id])
+            params_list[i] = req.sampling_params
+            salts[i] = self._salt_of(req.request_id)
+            if req.sampling_params.logprobs is not None:
+                want_lp = True
+        positions, slots, tables, ctx = self._assemble_decode_rows(
+            scheds, b)
+        token_ids = jnp.asarray(token_host)
+        if feed_rows:
+            token_ids = token_ids.at[jnp.asarray(feed_rows)].set(
+                prev.tokens[jnp.asarray(feed_src)])
+        kind = "dispatch_lp" if want_lp else "dispatch"
+        fn = self._decode_lp_fn if want_lp else self._decode_sample_fn
+        key = (kind, b) + tuple(
+            (sc.request.request_id,) + _params_key(
+                sc.request.sampling_params) for sc in scheds)
+        tensors = self._sampling_tensors(key, params_list, salts)
+        self._note_padding(len(scheds), b)
+        outs, self.kv_caches = self._run_jit(
+            kind, (b,), lambda: fn(
+                self.params, token_ids, self.kv_caches,
+                jnp.asarray(positions), jnp.asarray(slots),
+                jnp.asarray(tables), jnp.asarray(ctx),
+                tensors.temperature, tensors.top_k, tensors.top_p,
+                tensors.keys,
+            )
         )
-        self._note_padding(sum(s.num_new_tokens for s in scheds),
-                           b * s_len)
-        if cont:
-            logits, last_hidden, hidden, self.kv_caches = self._run_jit(
-                "chunk", (b, s_len, pages, use_embeds, deep is not None),
-                lambda: self._chunk_prefill_fn(
-                    self.params, jnp.asarray(token_ids), self.kv_caches,
-                    jnp.asarray(positions), jnp.asarray(slots),
-                    jnp.asarray(last_idx), jnp.asarray(tables),
-                    jnp.asarray(ctx), jnp.asarray(q_starts), *embeds_args,
-                )
-            )
-        else:
-            logits, last_hidden, hidden, self.kv_caches = self._run_jit(
-                "prefill", (b, s_len, use_embeds, deep is not None),
-                lambda: self._prefill_fn(
-                    self.params, jnp.asarray(token_ids), self.kv_caches,
-                    jnp.asarray(positions), jnp.asarray(slots),
-                    jnp.asarray(last_idx), *embeds_args,
-                )
-            )
-        self._sample_and_record(scheds, logits, last_hidden, out,
-                                full_hidden=hidden)
-        self._maybe_draft(scheds, last_hidden, out)
+        return InflightDecode(
+            tokens=outs["tokens"],
+            rows={sc.request.request_id: i for i, sc in enumerate(scheds)},
+            outs=outs, kind="decode", scheds=list(scheds),
+            gens=[s.request.async_generation for s in scheds],
+        )
 
-    def _cont_tables(self, scheds: list[ScheduledRequest], b: int):
-        """Block-table / context-length / q-start operands shared by the
-        chunk-continuation and spec-verify paths (both feed
-        forward_prefill_chunked — one assembly, one bucketing policy)."""
-        max_ctx = max(s.start_pos + s.num_new_tokens for s in scheds)
-        ctx_bucket = _bucket(max_ctx, self._seq_buckets)
-        pages = -(-ctx_bucket // self.page_size)
-        tables = np.zeros((b, pages), np.int32)
-        ctx = np.zeros((b,), np.int32)
-        q_starts = np.zeros((b,), np.int32)
-        for i, sc in enumerate(scheds):
-            t = sc.block_table[:pages]
-            tables[i, : len(t)] = t
-            ctx[i] = sc.start_pos + sc.num_new_tokens
-            q_starts[i] = sc.start_pos
-        return tables, ctx, q_starts, pages
+    # ------------------------------------------------------------- retire
+    def retire_step(self, handle: InflightDecode
+                    ) -> dict[str, "int | list[int]"]:
+        """Retire half: the ONE host readback of a step, lagged a full
+        step behind dispatch in the async pipeline so it overlaps the
+        next step's device compute.  Unpacks tokens (plain ints or
+        spec-accepted lists), appends logprob entries and hidden
+        chunks, and proposes the next drafts — every per-request side
+        effect of the step happens here, behind the single transfer."""
+        # omnilint: disable=OL2 - the single lagged retire sync of the
+        # async pipeline: by the time the engine calls this, the NEXT
+        # step is already dispatched, so this get overlaps its compute
+        outs = jax.device_get(handle.outs)
+        sampled: dict[str, "int | list[int]"] = {}
+        if handle.kind == "decode":
+            toks = np.asarray(outs["tokens"])
+            for rid, i in handle.rows.items():
+                sampled[rid] = int(toks[i])
+            self._retire_side_effects(handle, outs, sampled)
+            return sampled
+        toks = np.asarray(outs["tokens"])      # [S, V]
+        counts = np.asarray(outs["counts"])    # [S]
+        for rid, i in handle.rows.items():
+            sc = handle.scheds[i]
+            if i in handle.spec_rows:
+                # spec verify row: the accepted run, trimmed at the
+                # first stop condition so downstream payloads align
+                acc = [int(x) for x in toks[i, : max(int(counts[i]), 1)]]
+                acc = self._truncate_at_stop(sc.request, acc)
+                sampled[rid] = acc
+                if not sc.request.is_finished \
+                        and handle.gens[i] == sc.request.async_generation:
+                    # overshoot / preempt-readmit rows are discarded by
+                    # the scheduler — keep them out of the acceptance
+                    # telemetry the flight-recorder honesty rides on
+                    self.spec_stats["proposed"] += sc.num_new_tokens - 1
+                    self.spec_stats["accepted"] += len(acc) - 1
+            else:
+                sampled[rid] = int(toks[i, 0])
+        self._retire_side_effects(handle, outs, sampled)
+        return sampled
+
+    # engine compatibility alias (the PR 4 pipeline called the pure
+    # decode retire by this name)
+    retire_decode = retire_step
+
+    def _retire_side_effects(self, handle: InflightDecode, outs: dict,
+                             sampled: dict) -> None:
+        """Logprob entries, hidden chunks, and draft proposals for the
+        retired step.  Rows whose request finished or was
+        preempted-and-readmitted while the step was in flight are
+        SKIPPED — the scheduler discards their token (the overshoot
+        contract), so appending their side effects would misalign the
+        per-token streams."""
+        live: list[tuple[int, ScheduledRequest]] = []
+        for i, sc in enumerate(handle.scheds):
+            req = sc.request
+            if req.is_finished or handle.gens[i] != req.async_generation:
+                # overshoot (finished at a previous retire) or
+                # preempt-and-readmit mid-flight: the scheduler discards
+                # the token; discard its side effects with it
+                sampled.pop(req.request_id, None)
+                continue
+            live.append((i, sc))
+        # logprobs: trim the static top-K to each request's ask
+        if "lp_chosen" in outs:
+            chosen = np.asarray(outs["lp_chosen"])
+            top_v = np.asarray(outs["lp_topv"])
+            top_i = np.asarray(outs["lp_topi"])
+            for i, sc in live:
+                req = sc.request
+                if req.sampling_params.logprobs is None:
+                    continue
+                if sc.request.request_id not in sampled:
+                    continue
+                kk = min(LOGPROBS_K,
+                         int(req.sampling_params.logprobs or 0))
+                req.output_logprobs.append({
+                    "logprob": float(chosen[i]),
+                    "top_ids": top_i[i, :kk].tolist(),
+                    "top_logprobs": top_v[i, :kk].tolist(),
+                })
+        if self.collect_hidden and "hidden" in outs:
+            hidden = np.asarray(outs["hidden"])
+            for i, sc in live:
+                req = sc.request
+                if handle.kind == "decode":
+                    rows = hidden[i: i + 1]
+                else:
+                    lo = int(handle.asm.cu_q_lens[i])
+                    s = sampled.get(req.request_id)
+                    if isinstance(s, list):
+                        # verify row: only accepted positions shipped
+                        rows = hidden[lo: lo + len(s)]
+                    else:
+                        rows = hidden[lo: lo + sc.num_new_tokens]
+                prev = req.additional_information.get("_hidden_chunks")
+                if prev is None:
+                    req.additional_information["_hidden_chunks"] = [
+                        np.asarray(rows)]
+                else:
+                    prev.append(np.asarray(rows))
+        self._maybe_draft(handle, outs, sampled, live)
+
+    # ------------------------------------------------- speculative drafts
+    def _maybe_draft(self, handle: InflightDecode, outs: dict,
+                     sampled: dict, live) -> None:
+        """Propose the next k tokens for every request that sampled this
+        step (spec decode draft phase).  The hidden rows at each row's
+        last ACCEPTED position were gathered ON DEVICE by the step
+        (``accept_hidden``); one draft-head dispatch serves the whole
+        batch.
+
+        Known pipelined transient: on ENTRY into spec mode (the step
+        after a prefill or pipeline bubble), the next schedule may pair
+        these drafts with an input token that was still in flight when
+        they were proposed — that one verify tests the drafts one
+        position late, so its acceptance is ~0 and it degrades to
+        plain-decode progress for a step.  Steady-state verifies (the
+        hold-then-retire cadence) always pair fresh drafts with a
+        host-visible input; correctness is unaffected either way (the
+        accept mask only ever admits true target tokens)."""
+        if self.draft_fn is None or self.num_draft_tokens <= 0:
+            return
+        ah = outs.get("accept_hidden")
+        if ah is None:
+            # pure-decode handle: the row's hidden IS the accept hidden
+            ah = outs.get("hidden")
+        rows, toks, poss, reqs, ctxs = [], [], [], [], []
+        for i, sc in live:
+            req = sc.request
+            s = sampled.get(req.request_id)
+            if s is None:
+                continue
+            if req.sampling_params.logprobs is not None:
+                # multi-token verify accepts have no per-token sampling
+                # distribution to report — logprobs requests stay on the
+                # one-token-per-step path so entries align 1:1
+                continue
+            if req.is_finished:
+                continue
+            new = s if isinstance(s, list) else [s]
+            # position where the just-sampled token will be computed:
+            # the per-token advance for spec lists, the full chunk
+            # width for int samples (a prefill covers num_new_tokens
+            # positions, not one); mrope models shift generated
+            # positions by delta
+            adv = len(new) if isinstance(s, list) else sc.num_new_tokens
+            pos = sc.start_pos + adv
+            if self.use_mrope:
+                pos += req.mrope_delta
+            rows.append(i)
+            toks.append(new[-1])
+            poss.append(pos)
+            reqs.append(req)
+            if self._draft_takes_contexts:
+                # full post-step history (the just-sampled tokens are
+                # not yet appended to the request at draft time); built
+                # only for drafters that want it — it is an O(n) copy
+                ctxs.append(req.all_token_ids + list(new))
+        if not rows:
+            return
+        if ah is None:
+            # a decode handle built without hidden output (no
+            # collect_hidden): decode batches cannot draft — the
+            # engine routes drafted requests through the unified
+            # dispatch (their verify rows have num_new_tokens > 1), so
+            # this only skips the very first post-prefill proposal of
+            # a request that landed in a pure-decode batch; it drafts
+            # at its next unified step
+            return
+        ah = np.asarray(ah)
+        m = len(rows)
+        mb = _bucket(m, self._batch_buckets)
+        hh = np.zeros((mb,) + ah.shape[1:], ah.dtype)
+        hh[:m] = ah[np.asarray(rows)]
+        tt = np.zeros((mb,), np.int32)
+        tt[:m] = toks
+        pp = np.zeros((mb,), np.int32)
+        pp[:m] = poss
+        kwargs = {"contexts": ctxs} if self._draft_takes_contexts else {}
+        # omnilint: disable=OL2 - batch boundary: drafts feed next schedule
+        drafts = np.asarray(jax.device_get(
+            self.draft_fn(jnp.asarray(hh), jnp.asarray(tt),
+                          jnp.asarray(pp), **kwargs)
+        ))
+        for r, req in enumerate(reqs):
+            req.spec_draft_tokens = [int(x) for x in drafts[r]]
 
     # ---------------------------------------------------- mrope positions
     def _mrope_cols(self, req, p: np.ndarray) -> np.ndarray:
@@ -1106,88 +1271,6 @@ class ARModelRunner:
             ctx[i] = sc.start_pos + 1
         return positions, slots, tables, ctx
 
-    def _run_decode(self, scheds: list[ScheduledRequest], out: RunnerOutput):
-        b = self._decode_bucket(len(scheds))
-        token_ids = np.zeros((b,), np.int32)
-        for i, sc in enumerate(scheds):
-            token_ids[i] = sc.request.all_token_ids[sc.start_pos]
-        positions, slots, tables, ctx = self._assemble_decode_rows(
-            scheds, b)
-        self._note_padding(len(scheds), b)
-        logits, hidden, self.kv_caches = self._run_jit(
-            "decode", (b,), lambda: self._decode_fn(
-                self.params, jnp.asarray(token_ids), self.kv_caches,
-                jnp.asarray(positions), jnp.asarray(slots),
-                jnp.asarray(tables), jnp.asarray(ctx),
-            )
-        )
-        self._sample_and_record(scheds, logits, hidden, out)
-        self._maybe_draft(scheds, hidden, out)
-
-    # ------------------------------------------------ pipelined dispatch
-    def dispatch_decode(
-        self, scheds: list[ScheduledRequest],
-        prev: Optional[InflightDecode] = None,
-    ) -> InflightDecode:
-        """Dispatch half of the async pipelined step: launch forward +
-        on-device sampling for a pure single-token decode batch and
-        return WITHOUT waiting.  Input tokens that are not host-visible
-        yet (they were sampled by ``prev``, still in flight) are
-        gathered device-side from ``prev.tokens`` — the device-resident
-        feedback that keeps the host out of the token loop.  The engine
-        retires the handle one step later (``retire_decode``)."""
-        self._step += 1
-        b = self._decode_bucket(len(scheds))
-        token_host = np.zeros((b,), np.int32)
-        feed_rows: list[int] = []
-        feed_src: list[int] = []
-        params_list = [_PAD_SAMPLING] * b
-        salts = [0] * b
-        for i, sc in enumerate(scheds):
-            req = sc.request
-            if sc.start_pos < req.num_tokens:
-                token_host[i] = req.all_token_ids[sc.start_pos]
-            else:
-                # input token still in flight from the previous dispatch
-                feed_rows.append(i)
-                feed_src.append(prev.rows[req.request_id])
-            params_list[i] = req.sampling_params
-            salts[i] = self._salt_of(req.request_id)
-        positions, slots, tables, ctx = self._assemble_decode_rows(
-            scheds, b)
-        token_ids = jnp.asarray(token_host)
-        if feed_rows:
-            token_ids = token_ids.at[jnp.asarray(feed_rows)].set(
-                prev.tokens[jnp.asarray(feed_src)])
-        key = ("dispatch", b) + tuple(
-            (sc.request.request_id,) + _params_key(
-                sc.request.sampling_params) for sc in scheds)
-        tensors = self._sampling_tensors(key, params_list, salts)
-        self._note_padding(len(scheds), b)
-        toks, self.kv_caches = self._run_jit(
-            "dispatch", (b,), lambda: self._decode_sample_fn(
-                self.params, token_ids, self.kv_caches,
-                jnp.asarray(positions), jnp.asarray(slots),
-                jnp.asarray(tables), jnp.asarray(ctx),
-                tensors.temperature, tensors.top_k, tensors.top_p,
-                tensors.keys,
-            )
-        )
-        return InflightDecode(
-            tokens=toks,
-            rows={sc.request.request_id: i for i, sc in enumerate(scheds)},
-        )
-
-    def retire_decode(self, handle: InflightDecode) -> dict[str, int]:
-        """Retire half: the ONE host readback of a pipelined step,
-        lagged a full step behind dispatch so it overlaps the next
-        step's device compute instead of serializing against it."""
-        # omnilint: disable=OL2 - the single lagged retire sync of the
-        # async pipeline: by the time the engine calls this, the NEXT
-        # step is already dispatched, so this get overlaps its compute
-        toks = np.asarray(jax.device_get(handle.tokens))
-        return {rid: int(toks[i]) for rid, i in handle.rows.items()}
-
     # ----------------------------------------------- sampling host caches
     def _salt_of(self, request_id: str) -> int:
         """Cached zlib.crc32 sampling salt (recomputing it for every
@@ -1221,212 +1304,7 @@ class ARModelRunner:
             tensors, any(p.temperature > 0.0 for p in params_list))
         return tensors
 
-    # ---------------------------------------------------- multi-step decode
-    def _run_decode_multi(self, scheds: list[ScheduledRequest], w: int,
-                          out: RunnerOutput):
-        """Advance the whole decode batch ``w`` steps in one device call
-        (sampling on device inside the scan).  Tokens come back [w, B];
-        each request's run is trimmed at its first stop condition — KV
-        written past a stop is position-keyed garbage in that request's
-        own pages, never attended and freed with the request."""
-        b = self._decode_bucket(len(scheds))
-        token_ids = np.zeros((b,), np.int32)
-        positions = (np.zeros((b, 3), np.int32) if self.use_mrope
-                     else np.zeros((b,), np.int32))
-        gpos = np.zeros((b,), np.int32)
-        valid = np.zeros((b,), bool)
-        tables = np.zeros((b, self.max_pages_per_seq), np.int32)
-        params_list = [_PAD_SAMPLING] * b
-        salts = [0] * b
-        for i, sc in enumerate(scheds):
-            req = sc.request
-            token_ids[i] = req.all_token_ids[sc.start_pos]
-            if self.use_mrope:
-                positions[i] = self._mrope_cols(
-                    req, np.asarray([sc.start_pos]))[:, 0]
-            else:
-                positions[i] = sc.start_pos
-            gpos[i] = sc.start_pos
-            valid[i] = True
-            t = sc.block_table[: self.max_pages_per_seq]
-            tables[i, : len(t)] = t
-            params_list[i] = req.sampling_params
-            salts[i] = self._salt_of(req.request_id)
-        key = ("multi", b) + tuple(
-            (sc.request.request_id,) + _params_key(
-                sc.request.sampling_params) for sc in scheds)
-        tensors = self._sampling_tensors(key, params_list, salts)
-        self._note_padding(len(scheds) * w, b * w)
-        toks, self.kv_caches = self._run_jit(
-            "multi", (b, w), lambda: self._decode_multi_fn(
-                self.params, jnp.asarray(token_ids), self.kv_caches,
-                jnp.asarray(positions), jnp.asarray(gpos),
-                jnp.asarray(valid), jnp.asarray(tables),
-                tensors.temperature, tensors.top_k, tensors.top_p,
-                tensors.keys, w,
-            )
-        )
-        # omnilint: disable=OL2 - the ONE sync per window (the point of
-        # multi-step decode: W steps, one host round trip)
-        toks = np.asarray(jax.device_get(toks))  # [w, b]
-        for i, sc in enumerate(scheds):
-            run = [int(x) for x in toks[:, i]]
-            out.sampled[sc.request.request_id] = \
-                self._truncate_at_stop(sc.request, run)
-
-    # ------------------------------------------------- speculative decode
-    def _run_spec_decode(self, scheds: list[ScheduledRequest],
-                         out: RunnerOutput):
-        """Verify step: run the backbone over [last_sampled, drafts...] in
-        one forward (chunked-prefill kernel), accept the longest draft
-        prefix that matches greedy argmax, and re-draft from the last
-        accepted position."""
-        b = _bucket(len(scheds), self._batch_buckets)
-        max_n = max(s.num_new_tokens for s in scheds)
-        s_len = _bucket(max_n, self._seq_buckets)
-
-        token_ids = np.zeros((b, s_len), np.int32)
-        positions = (np.zeros((b, 3, s_len), np.int32) if self.use_mrope
-                     else np.zeros((b, s_len), np.int32))
-        slots = np.full((b, s_len), -1, np.int32)
-        tables, ctx, q_starts, _ = self._cont_tables(scheds, b)
-        cands: list[list[int]] = []
-        for i, sc in enumerate(scheds):
-            req = sc.request
-            n = sc.num_new_tokens
-            row = ([req.all_token_ids[sc.start_pos]]
-                   + list(req.spec_draft_tokens[: n - 1]))
-            cands.append(row)
-            token_ids[i, :n] = row
-            p = np.arange(sc.start_pos, sc.start_pos + n)
-            if self.use_mrope:
-                positions[i, :, :n] = self._mrope_cols(req, p)
-            else:
-                positions[i, :n] = p
-            slots[i, :n] = sc.slot_mapping
-
-        self._note_padding(sum(s.num_new_tokens for s in scheds),
-                           b * s_len)
-        logits, hidden, self.kv_caches = self._run_jit(
-            "verify", (b, s_len, tables.shape[1]),
-            lambda: self._verify_fn(
-                self.params, jnp.asarray(token_ids), self.kv_caches,
-                jnp.asarray(positions), jnp.asarray(slots),
-                jnp.asarray(tables), jnp.asarray(ctx),
-                jnp.asarray(q_starts),
-            )
-        )
-        # omnilint: disable=OL2 - batch boundary: verify needs argmax host-side
-        greedy = np.asarray(jax.device_get(
-            jnp.argmax(logits, axis=-1)))  # [B, S]
-        # target distributions for every SAMPLED request in ONE batched
-        # device call (greedy rows verify off the argmax above)
-        sampled_probs = self._batched_verify_probs(scheds, logits)
-        # one verify forward per call, however many requests it batched
-        self.spec_stats["verify_steps"] += 1
-        accepted_idx: list[int] = []
-        for i, sc in enumerate(scheds):
-            req = sc.request
-            n = sc.num_new_tokens
-            drafts = cands[i][1:]
-            if req.sampling_params.temperature == 0.0:
-                # greedy verify: accept the longest prefix matching argmax
-                acc = [int(greedy[i, 0])]
-                for j, d in enumerate(drafts):
-                    if d != acc[-1]:
-                        break  # draft j diverges from the true token
-                    acc.append(int(greedy[i, j + 1]))
-            else:
-                acc = self._rejection_accept(req, sampled_probs[i],
-                                             drafts)
-            acc = self._truncate_at_stop(req, acc)
-            out.sampled[req.request_id] = acc
-            accepted_idx.append(len(acc) - 1)
-            self.spec_stats["proposed"] += len(drafts)
-            self.spec_stats["accepted"] += len(acc) - 1
-        if self.collect_hidden:
-            # ONE batched transfer for every request's accepted rows —
-            # a per-request device_get in the loop above was a sync per
-            # request per verify step (first omnilint OL2 harvest)
-            slices = [hidden[i, : accepted_idx[i] + 1]
-                      for i in range(len(scheds))]
-            # omnilint: disable=OL2 - single batched sync per verify step
-            hosts = jax.device_get(slices)
-            for sc, h in zip(scheds, hosts):
-                sc.request.additional_information.setdefault(
-                    "_hidden_chunks", []).append(np.asarray(h))
-        # re-draft from the last accepted position
-        last_hidden = hidden[jnp.arange(len(scheds)),
-                             jnp.asarray(accepted_idx)]
-        self._maybe_draft(scheds, last_hidden, out)
-
-    def _batched_verify_probs(self, scheds, logits) -> dict:
-        """{batch_row: [S, vocab] filtered target probs} for every
-        sampled (temperature > 0) request — ONE filtered_probs dispatch
-        + ONE device_get for the whole verify batch."""
-        from vllm_omni_tpu.sample.sampler import filtered_probs
-
-        rows = [(i, sc.request.sampling_params) for i, sc in
-                enumerate(scheds)
-                if sc.request.sampling_params.temperature != 0.0]
-        if not rows:
-            return {}
-        s_len = logits.shape[1]
-        idx = jnp.asarray([i for i, _ in rows])
-        sub = logits[idx].reshape(len(rows) * s_len, logits.shape[-1])
-        rep = lambda vals: np.repeat(  # noqa: E731
-            np.asarray(vals, np.float32), s_len)
-        flat = filtered_probs(
-            sub,
-            jnp.asarray(rep([sp.temperature for _, sp in rows])),
-            jnp.asarray(rep([sp.top_k for _, sp in rows]).astype(np.int32)),
-            jnp.asarray(rep([sp.top_p for _, sp in rows])),
-        )
-        probs = np.asarray(jax.device_get(flat)).reshape(
-            len(rows), s_len, -1)
-        return {i: probs[r] for r, (i, _) in enumerate(rows)}
-
-    def _rejection_accept(self, req, probs, drafts: list[int]
-                          ) -> list[int]:
-        """Rejection-sampling verify for a sampled request (reference:
-        gpu_ar_model_runner.py:466-497).  ``probs`` are the request's
-        precomputed [S, vocab] filtered target distributions
-        (_batched_verify_probs).  The MTP draft proposes
-        deterministically (greedy head), so the accept probability for
-        draft d at position j is the TARGET probability p_j(d); on
-        rejection the replacement is drawn from p_j with d excluded and
-        renormalized — the emitted stream is exactly p-distributed.
-        Randomness is a deterministic per-(request, step) stream, like
-        the main sampler."""
-        sp = req.sampling_params
-        seed = sp.seed if sp.seed is not None else self._base_seed
-        # plain crc32 (not _salt_of): this method is driven standalone
-        # in tests with a bare namespace, and it runs once per sampled
-        # request per verify step — not the per-step hot loop the salt
-        # cache exists for
-        salt = zlib.crc32(req.request_id.encode())
-        rng = np.random.default_rng((seed, salt, self._step))
-        acc: list[int] = []
-        for j, d in enumerate(drafts):
-            p_d = float(probs[j, d])
-            if rng.uniform() < p_d:
-                acc.append(int(d))
-                continue
-            # rejected: sample the replacement from p_j \ {d}
-            p = probs[j].astype(np.float64)
-            p[d] = 0.0
-            total = p.sum()
-            if total <= 0.0:
-                acc.append(int(np.argmax(probs[j])))
-            else:
-                acc.append(int(rng.choice(len(p), p=p / total)))
-            return acc
-        # every draft accepted: bonus token from the last position
-        p = probs[len(drafts)].astype(np.float64)
-        p = p / p.sum()
-        acc.append(int(rng.choice(len(p), p=p)))
-        return acc
-
+    # ----------------------------------------------------------- stopping
     @staticmethod
     def _truncate_at_stop(req, acc: list[int]) -> list[int]:
         """Trim an accepted spec run at the first stop condition (eos /
@@ -1448,147 +1326,6 @@ class ARModelRunner:
             if n >= sp.max_tokens:
                 return acc[: idx + 1]
         return acc
-
-    def _maybe_draft(self, scheds: list[ScheduledRequest],
-                     last_hidden, out: RunnerOutput):
-        """Propose the next k tokens for every greedy request that sampled
-        this step (spec decode draft phase)."""
-        if self.draft_fn is None or self.num_draft_tokens <= 0:
-            return
-        rows, toks, poss, reqs, ctxs = [], [], [], [], []
-        for i, sc in enumerate(scheds):
-            req = sc.request
-            s = out.sampled.get(req.request_id)
-            if s is None:
-                continue
-            if req.sampling_params.logprobs is not None:
-                # multi-token verify accepts have no per-token sampling
-                # distribution to report — logprobs requests stay on the
-                # one-token-per-step path so entries align 1:1
-                continue
-            # greedy requests verify by argmax match; sampled requests by
-            # rejection sampling (_rejection_accept) — both draft
-            new = s if isinstance(s, list) else [s]
-            # position where the just-sampled token will be computed: the
-            # per-token advance for spec lists, the full chunk width for
-            # int samples (a prefill covers num_new_tokens positions, not
-            # one); mrope models shift generated positions by delta
-            adv = len(new) if isinstance(s, list) else sc.num_new_tokens
-            pos = sc.start_pos + adv
-            if self.use_mrope:
-                pos += req.mrope_delta
-            rows.append(i)
-            toks.append(new[-1])
-            poss.append(pos)
-            reqs.append(req)
-            if self._draft_takes_contexts:
-                # full post-step history (the just-sampled tokens are not
-                # yet appended to the request at draft time); built only
-                # for drafters that want it — it is an O(n) copy
-                ctxs.append(req.all_token_ids + list(new))
-        if not rows:
-            return
-        m = len(rows)
-        mb = _bucket(m, self._batch_buckets)
-        hh = jnp.zeros((mb,) + last_hidden.shape[1:], last_hidden.dtype)
-        hh = hh.at[:m].set(last_hidden[jnp.asarray(rows)])
-        tt = np.zeros((mb,), np.int32)
-        tt[:m] = toks
-        pp = np.zeros((mb,), np.int32)
-        pp[:m] = poss
-        kwargs = {"contexts": ctxs} if self._draft_takes_contexts else {}
-        # omnilint: disable=OL2 - batch boundary: drafts feed next schedule
-        drafts = np.asarray(jax.device_get(
-            self.draft_fn(hh, jnp.asarray(tt), jnp.asarray(pp), **kwargs)
-        ))
-        for r, req in enumerate(reqs):
-            req.spec_draft_tokens = [int(x) for x in drafts[r]]
-
-    # ------------------------------------------------------------ sampling
-    def _sample_and_record(
-        self,
-        scheds: list[ScheduledRequest],
-        logits: jax.Array,       # [B_padded, vocab]
-        last_hidden: jax.Array,  # [B_padded, H]
-        out: RunnerOutput,
-        full_hidden: Optional[jax.Array] = None,
-    ):
-        # Requests sample only when the forward covered their last token —
-        # num_tokens, not num_prompt_tokens, so a preempted request that
-        # recomputes prompt+generated KV resumes without double-sampling
-        # (samples_final: the predicate shared with the scheduler's
-        # async accounting and the unified path).
-        sampling = [
-            (i, sc) for i, sc in enumerate(scheds) if sc.samples_final
-        ]
-        if sampling:
-            # Sample the full padded batch (one compile per bucket shape);
-            # non-sampling rows compute discarded tokens.
-            b_padded = logits.shape[0]
-            params = [_PAD_SAMPLING] * b_padded
-            salts = [0] * b_padded
-            for i, sc in sampling:
-                params[i] = sc.request.sampling_params
-                salts[i] = self._salt_of(sc.request.request_id)
-            key = ("single", b_padded) + tuple(
-                (i, sc.request.request_id)
-                + _params_key(sc.request.sampling_params)
-                for i, sc in sampling)
-            tensors = self._sampling_tensors(key, params, salts)
-            tokens = sample_tokens(
-                logits, tensors.temperature, tensors.top_k,
-                tensors.top_p, tensors.keys,
-            )
-            # omnilint: disable=OL2 - batch boundary: scheduler needs tokens
-            tokens = np.asarray(jax.device_get(tokens))
-            for i, sc in sampling:
-                out.sampled[sc.request.request_id] = int(tokens[i])
-            want_lp = [(i, sc) for i, sc in sampling
-                       if sc.request.sampling_params.logprobs is not None]
-            if want_lp:
-                from vllm_omni_tpu.sample.sampler import compute_logprobs
-
-                k = min(20, max(int(sc.request.sampling_params.logprobs
-                                    or 0) for _, sc in want_lp))
-                chosen, top_v, top_i = compute_logprobs(
-                    logits, jnp.asarray(tokens), k)
-                # one transfer for all three arrays, not three round
-                # trips (first omnilint OL2 harvest)
-                # omnilint: disable=OL2
-                chosen, top_v, top_i = jax.device_get(
-                    (chosen, top_v, top_i))
-                chosen, top_v, top_i = (np.asarray(chosen),
-                                        np.asarray(top_v),
-                                        np.asarray(top_i))
-                for i, sc in want_lp:
-                    kk = min(k, int(sc.request.sampling_params.logprobs
-                                    or 0))
-                    sc.request.output_logprobs.append({
-                        "logprob": float(chosen[i]),
-                        "top_ids": top_i[i, :kk].tolist(),
-                        "top_logprobs": top_v[i, :kk].tolist(),
-                    })
-        if self.collect_hidden:
-            # per-request hidden payloads for the next stage (reference
-            # pooler_output slicing, gpu_ar_model_runner.py:525-568).
-            # Device-side slicing + ONE batched transfer: a device_get
-            # per request in the loop was a sync per request per step
-            # (first omnilint OL2 harvest)
-            if full_hidden is not None:
-                slices = [full_hidden[i, : sc.num_new_tokens]
-                          for i, sc in enumerate(scheds)]
-            else:
-                slices = [last_hidden[i: i + 1]
-                          for i in range(len(scheds))]
-            # omnilint: disable=OL2 - single batched sync per step
-            hosts = [np.asarray(h) for h in jax.device_get(slices)]
-            for sc, h in zip(scheds, hosts):
-                req = sc.request
-                prev = req.additional_information.get("_hidden_chunks")
-                if prev is None:
-                    req.additional_information["_hidden_chunks"] = [h]
-                else:
-                    prev.append(h)
 
     # -------------------------------------------------------- kv injection
     def inject_kv(self, block_ids: list[int], payload: list) -> int:
