@@ -1,0 +1,4 @@
+# the runtime lock-order/deadlock detector rides the whole suite
+# (PR 10 stance): controller-thread vs router-thread lock traffic is
+# exactly what it exists to audit
+from tests.lockcheck import _runtime_lock_check  # noqa: F401

@@ -1,0 +1,430 @@
+"""Fake-clock control-plane units over scriptable fake replicas.
+
+The controller's whole decision surface (``tick``) and actuation
+surface (``actuate``) are driven synchronously — no threads, no
+sleeps, no model — exactly the PR 8 watchdog testing stance.  The
+fakes let each test script queue depth, saturation, SLO attainment,
+quiesce timing, and replica death per tick.
+"""
+
+import pytest
+
+from vllm_omni_tpu.controlplane import (
+    ControlPlane,
+    ControlPlaneConfig,
+    Hysteresis,
+    pressure_ratio,
+    role_sensors,
+)
+from vllm_omni_tpu.controlplane.controller import (
+    ACTION_DRAIN,
+    ACTION_REROLE,
+    ACTION_SCALE_UP,
+    ACTION_UNDRAIN,
+)
+from vllm_omni_tpu.disagg.router import DisaggRouter, EngineReplica
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.waiting: list = []
+        self.running: list = []
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.saturation = {"prefill": 0.0, "decode": 0.0, "seats": 0.0}
+        self.tenants = {}
+
+
+class FakeEngine:
+    """The engine surface the controller + router touch, scriptable."""
+
+    def __init__(self):
+        self.scheduler = _FakeScheduler()
+        self.step_metrics = _FakeMetrics()
+        self.kv_transfer_sink = None
+        self.role_flips: list[str] = []
+
+    @property
+    def has_unfinished_requests(self):
+        return bool(self.scheduler.waiting or self.scheduler.running)
+
+    def set_engine_role(self, role):
+        self.role_flips.append(role)
+
+    def load(self, waiting=0, running=0):
+        self.scheduler.waiting = [object()] * waiting
+        self.scheduler.running = [object()] * running
+
+
+def _replica(rid, role, index):
+    return EngineReplica(rid, FakeEngine(), role, index)
+
+
+def _topology(n_prefill=1, n_decode=1):
+    prefills = [_replica(f"p{i}", "prefill", i)
+                for i in range(n_prefill)]
+    decodes = [_replica(f"d{i}", "decode", n_prefill + i)
+               for i in range(n_decode)]
+    return DisaggRouter(prefills, decodes)
+
+
+def _cp(router, **kw):
+    kw.setdefault("hysteresis_ticks", 2)
+    kw.setdefault("cooldown_ticks", 3)
+    clock = [0.0]
+
+    def fake_clock():
+        clock[0] += 1.0
+        return clock[0]
+
+    return ControlPlane(router, ControlPlaneConfig(**kw),
+                        clock=fake_clock,
+                        replica_factory=kw.pop("_factory", None))
+
+
+def _run(cp, ticks):
+    """tick + actuate ``ticks`` times (the two threads, interleaved
+    the way the service loop interleaves them)."""
+    for _ in range(ticks):
+        cp.tick()
+        cp.actuate()
+
+
+# -------------------------------------------------------------- policy
+def test_role_sensors_pressure_model():
+    router = _topology(n_prefill=2)
+    p0, p1 = router.prefills
+    p0.engine.load(waiting=4, running=2)
+    p1.engine.load(waiting=2, running=0)
+    p1.engine.step_metrics.saturation["prefill"] = 0.5
+    s = role_sensors(router.prefills, "prefill", "prefill",
+                     saturation_gain=4.0)
+    assert s.queue_depth == 8 and s.in_rotation == 2
+    # depth/replica (4) + gain * mean saturation (4 * 0.25)
+    assert s.pressure == pytest.approx(5.0)
+
+
+def test_dead_replicas_contribute_nothing():
+    router = _topology(n_prefill=2)
+    router.prefills[0].engine.load(waiting=50)
+    router.prefills[0].dead = True
+    s = role_sensors(router.prefills, "prefill", "prefill", 4.0)
+    assert s.queue_depth == 0 and s.replicas == 1
+
+
+def test_starved_tier_with_queued_work_reads_hot():
+    router = _topology()
+    router.decodes[0].drained = True
+    router.decodes[0].engine.load(running=3)
+    s = role_sensors(router.decodes, "decode", "decode", 4.0)
+    assert s.in_rotation == 0
+    assert s.pressure >= 6.0  # never reads calm
+
+
+def test_pressure_ratio_epsilon_smoothing():
+    router = _topology()
+    pre = role_sensors(router.prefills, "prefill", "prefill", 4.0)
+    dec = role_sensors(router.decodes, "decode", "decode", 4.0)
+    assert pressure_ratio(pre, dec) == pytest.approx(1.0)  # idle = 1
+
+
+def test_hysteresis_debounce_and_direction_reset():
+    h = Hysteresis(3)
+    assert h.update("up") is None
+    assert h.update("up") is None
+    assert h.update("up") == "up"
+    assert h.update("down") is None  # direction change resets
+    assert h.update("down") is None
+    assert h.update("down") == "down"
+    assert h.update(None) is None
+    assert h.update("down") is None  # gap resets the count
+
+
+# ------------------------------------------------------------- re-role
+def test_in_band_pressure_never_acts():
+    router = _topology(n_prefill=1, n_decode=1)
+    cp = _cp(router)
+    router.prefills[0].engine.load(waiting=2)
+    router.decodes[0].engine.load(waiting=2)
+    _run(cp, 10)
+    assert cp.reroles == 0 and not cp.actions
+
+
+def test_transient_spike_is_debounced():
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router, hysteresis_ticks=3)
+    router.prefills[0].engine.load(waiting=20)
+    _run(cp, 2)                      # two hot ticks < hysteresis
+    router.prefills[0].engine.load(waiting=0)
+    _run(cp, 6)
+    assert cp.reroles == 0, "a 2-tick spike must not re-role"
+
+
+def test_sustained_pressure_reroles_decode_to_prefill():
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    router.prefills[0].engine.load(waiting=20)
+    _run(cp, 4)
+    assert cp.reroles == 1
+    assert len(router.prefills) == 2 and len(router.decodes) == 1
+    flipped = next(r for r in router.prefills
+                   if r.replica_id.startswith("d"))
+    assert flipped.engine.role_flips == ["prefill"]
+    # bound-method equality (a fresh bound object per access)
+    assert flipped.engine.kv_transfer_sink == router._kv_sink
+    assert not flipped.drained, "the flip must re-admit (undrain)"
+    assert [e["action"] for e in cp.debug_snapshot()["ring"]] == \
+        [ACTION_DRAIN, ACTION_REROLE, ACTION_UNDRAIN]
+
+
+def test_decode_pressure_reroles_prefill_to_decode():
+    router = _topology(n_prefill=2, n_decode=1)
+    cp = _cp(router)
+    router.decodes[0].engine.load(waiting=20)
+    _run(cp, 4)
+    assert cp.reroles == 1
+    assert len(router.prefills) == 1 and len(router.decodes) == 2
+    flipped = next(r for r in router.decodes
+                   if r.replica_id.startswith("p"))
+    assert flipped.engine.kv_transfer_sink is None, \
+        "a decode-role replica must not ship prefill payloads"
+
+
+def test_min_replicas_floor_blocks_rerole():
+    router = _topology(n_prefill=1, n_decode=1)
+    cp = _cp(router)
+    router.prefills[0].engine.load(waiting=50)
+    _run(cp, 10)
+    assert cp.reroles == 0, \
+        "donating the last decode replica would just swap starvation"
+
+
+def test_drain_waits_for_quiesce_and_streams_survive():
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    donor = router.decodes[0]
+    donor.engine.load(running=1)        # in-flight stream on the donor
+    router.decodes[1].engine.load(running=3)  # heavier: d0 is donor
+    router.prefills[0].engine.load(waiting=20)
+    _run(cp, 6)
+    # donor drained but NOT quiesced: no flip yet
+    assert donor.drained and donor.role == "decode"
+    assert cp.reroles == 0
+    donor.engine.load(running=0)        # the stream finishes
+    _run(cp, 2)
+    assert cp.reroles == 1 and donor.role == "prefill"
+    assert router.decodes[0].engine.scheduler.running, \
+        "the other replica's in-flight stream was never touched"
+
+
+def test_cooldown_prevents_flapping():
+    router = _topology(n_prefill=1, n_decode=3)
+    cp = _cp(router, hysteresis_ticks=1, cooldown_ticks=50)
+    router.prefills[0].engine.load(waiting=50)
+    _run(cp, 20)
+    assert cp.reroles == 1, \
+        "persistent pressure inside the cooldown must not re-fire"
+
+
+def test_donor_death_mid_drain_aborts_and_converges():
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    donor = router.decodes[0]
+    donor.engine.load(running=1)        # keeps the drain pending
+    router.decodes[1].engine.load(running=3)  # heavier: d0 is donor
+    router.prefills[0].engine.load(waiting=20)
+    _run(cp, 4)
+    assert donor.drained and cp.reroles == 0
+    donor.dead = True                    # replica crashes mid-drain
+    _run(cp, 12)
+    # aborted, cooled down, then re-roled the surviving decode replica
+    assert cp.reroles <= 1
+    aborts = [e for e in cp.debug_snapshot()["ring"]
+              if e.get("action") == "abort"]
+    assert aborts and aborts[0]["replica_id"] == donor.replica_id
+
+
+def test_abort_readmits_a_live_drained_donor():
+    """Regression: an aborted operation (e.g. retries exhausted) must
+    not strand a LIVE donor drained forever — that silently leaks a
+    replica of capacity until an operator notices."""
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    donor = router.decodes[0]
+    router.decodes[1].engine.load(running=3)  # d0 is the donor
+    router.prefills[0].engine.load(waiting=20)
+    _run(cp, 3)                              # drain lands on d0
+    assert donor.drained and cp._op is not None
+    # force an abort while the donor is alive and drained
+    cp._abort_op("test-forced abort")
+    cp.actuate()
+    assert not donor.drained, \
+        "abort must re-admit the live donor (undrain)"
+    assert donor.in_rotation
+
+
+def test_rerole_counter_bounded_under_replica_churn():
+    """The convergence acceptance: random replica kills during
+    controller operation never produce an unbounded re-role loop —
+    every completed/aborted operation pays a cooldown."""
+    router = _topology(n_prefill=2, n_decode=2)
+    cp = _cp(router, hysteresis_ticks=1, cooldown_ticks=4)
+    router.prefills[0].engine.load(waiting=30)
+    for i in range(40):
+        if i == 7:
+            router.decodes[0].dead = True
+        if i == 15:
+            router.decodes[0].dead = False
+        cp.tick()
+        cp.actuate()
+    # 40 ticks / (1 hysteresis + 4 cooldown) bounds the action count
+    assert cp.reroles <= 8
+    ring = cp.debug_snapshot()["ring"]
+    reroles = [e for e in ring if e.get("action") == ACTION_REROLE]
+    assert len(reroles) <= 8
+
+
+# ---------------------------------------------------------- autoscale
+def _fleet_factory(made):
+    def factory(role, index):
+        r = _replica(f"{role}{index}", role, index)
+        made.append(r)
+        return r
+
+    return factory
+
+
+def test_scale_up_enters_drained_then_warms_in():
+    made = []
+    router = _topology(n_prefill=1, n_decode=1)
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=2, cooldown_ticks=2, autoscale_enabled=True,
+        max_replicas=4, scale_up_pressure=5.0, warmup_ticks=3,
+        band_low=0.0, band_high=1e9),  # re-roling out of the picture
+        replica_factory=_fleet_factory(made))
+    router.decodes[0].engine.load(waiting=10)
+    _run(cp, 3)
+    assert len(made) == 1 and made[0].role == "decode"
+    assert made[0].drained, "a cold replica must not take traffic"
+    assert made[0] in router.decodes
+    _run(cp, 4)                          # warmup_ticks elapse
+    assert not made[0].drained, "warmed replica must re-admit"
+    assert cp.actions.get(ACTION_SCALE_UP) == 1
+
+
+def test_scale_up_does_not_stack_while_warming():
+    made = []
+    router = _topology(n_prefill=1, n_decode=1)
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=1, cooldown_ticks=0, autoscale_enabled=True,
+        max_replicas=8, scale_up_pressure=5.0, warmup_ticks=10,
+        band_low=0.0, band_high=1e9),
+        replica_factory=_fleet_factory(made))
+    router.decodes[0].engine.load(waiting=50)
+    _run(cp, 6)
+    assert len(made) == 1, \
+        "pressure during a warmup must not stack scale-ups (cold-" \
+        "start cost model: the warming replica IS the response)"
+
+
+def test_scale_up_respects_max_replicas():
+    made = []
+    router = _topology(n_prefill=1, n_decode=1)
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=1, cooldown_ticks=0, autoscale_enabled=True,
+        max_replicas=2, scale_up_pressure=2.0,
+        band_low=0.0, band_high=1e9),
+        replica_factory=_fleet_factory(made))
+    router.decodes[0].engine.load(waiting=50)
+    _run(cp, 5)
+    assert not made, "the replica budget is a hard cap"
+
+
+def test_scale_down_drains_then_removes():
+    router = _topology(n_prefill=1, n_decode=3)
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=2, cooldown_ticks=2, autoscale_enabled=True,
+        max_replicas=8, scale_down_pressure=0.5,
+        band_low=0.0, band_high=1e9))
+    _run(cp, 6)                          # everything idle
+    assert len(router.decodes) == 2
+    assert cp.actions.get("remove_replica") == 1
+
+
+def test_scale_down_gated_by_slo_attainment():
+    router = _topology(n_prefill=1, n_decode=2)
+
+    class _St:
+        finished, met = 10, 2            # 20% attainment
+
+    router.decodes[0].engine.step_metrics.tenants = {"default": _St()}
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=1, cooldown_ticks=0, autoscale_enabled=True,
+        max_replicas=8, scale_down_pressure=0.5,
+        slo_scale_down_floor=0.9, band_low=0.0, band_high=1e9))
+    _run(cp, 6)
+    assert len(router.decodes) == 2, \
+        "shrinking a fleet that is missing SLOs is pro-cyclical"
+
+
+def test_scale_down_respects_min_floor():
+    router = _topology(n_prefill=1, n_decode=1)
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=1, cooldown_ticks=0, autoscale_enabled=True,
+        max_replicas=8, scale_down_pressure=0.5,
+        band_low=0.0, band_high=1e9))
+    _run(cp, 6)
+    assert len(router.decodes) == 1
+
+
+# --------------------------------------------------- ring + snapshot
+def test_action_ring_is_bounded():
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=1, cooldown_ticks=0, ring_capacity=16))
+    for _ in range(40):
+        router.prefills[0].engine.load(waiting=30)
+        cp.tick()
+        cp.actuate()
+    assert len(cp.debug_snapshot()["ring"]) <= 16
+
+
+def test_debug_snapshot_shape_and_metrics():
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    router.prefills[0].engine.load(waiting=20)
+    _run(cp, 5)   # drain, quiesce->flip, readmit, complete
+    snap = cp.debug_snapshot()
+    assert snap["enabled"] and snap["ticks"] == 5
+    assert snap["sensors"]["prefill"]["pressure"] > 0
+    assert snap["counters"]["reroles"] == 1
+    assert snap["operation"] is None
+    assert resilience_metrics.get("controlplane_reroles_total",
+                                  from_role="decode",
+                                  to_role="prefill") >= 1
+    assert resilience_metrics.get("controlplane_replicas",
+                                  role="prefill") == 2
+    assert resilience_metrics.get(
+        "controlplane_actions_total", action=ACTION_REROLE) >= 1
+
+
+def test_tick_refreshes_router_gauges_while_idle():
+    """The satellite fix: an idle fleet's gauges refresh from the
+    controller's sensor poll, not only from the dispatch path."""
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    router = _topology(n_prefill=2, n_decode=1)
+    cp = _cp(router)
+    cp.tick()
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="prefill") == 2
+    # a replica dies; NOTHING dispatches or steps — the next sensor
+    # tick alone must move the gauge
+    router.prefills[0].dead = True
+    cp.tick()
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="prefill") == 1

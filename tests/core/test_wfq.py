@@ -1,0 +1,279 @@
+"""Weighted-fair overload scheduling (docs/control_plane.md):
+deficit-round-robin ordering hand-oracle, starvation freedom,
+priority-ordered shedding, and the deferral ledger — pure host-side
+scheduler math, no jax compute.
+"""
+
+import pytest
+
+from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.core.scheduler import ARScheduler, SchedulerConfig
+from vllm_omni_tpu.metrics.stats import (
+    DEFAULT_PRIORITY,
+    MAX_PRIORITY,
+    MIN_PRIORITY,
+    sanitize_priority,
+)
+from vllm_omni_tpu.request import Request, RequestStatus
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+
+def _sched(**kw):
+    kw.setdefault("max_num_seqs", 8)
+    kw.setdefault("max_num_batched_tokens", 64)
+    kw.setdefault("wfq_scheduling", True)
+    kw.setdefault("wfq_quantum_tokens", 8)
+    cfg = SchedulerConfig(**kw)
+    return ARScheduler(cfg, KVCacheManager(256, 16))
+
+
+def _req(rid, tenant, priority=None, n_prompt=8, max_tokens=4):
+    info = {"tenant": tenant}
+    if priority is not None:
+        info["priority"] = priority
+    return Request(request_id=rid,
+                   prompt_token_ids=list(range(1, n_prompt + 1)),
+                   sampling_params=SamplingParams(max_tokens=max_tokens),
+                   additional_information=info)
+
+
+# ------------------------------------------------------- sanitization
+def test_sanitize_priority_hostile_input():
+    assert sanitize_priority(None) == DEFAULT_PRIORITY
+    assert sanitize_priority("") == DEFAULT_PRIORITY
+    assert sanitize_priority("banana") == DEFAULT_PRIORITY
+    assert sanitize_priority(object()) == DEFAULT_PRIORITY
+    assert sanitize_priority(10**9) == MAX_PRIORITY
+    assert sanitize_priority(-10**9) == MIN_PRIORITY
+    assert sanitize_priority("6") == 6
+    assert sanitize_priority(" 2.9 ") == 2
+    assert sanitize_priority(float("nan")) == DEFAULT_PRIORITY
+    assert sanitize_priority("nan") == DEFAULT_PRIORITY
+    # regression: "inf" parses as a float and int(inf) raises
+    # OverflowError — one hostile header must clamp, never crash the
+    # scheduler for every tenant
+    assert sanitize_priority("inf") == MAX_PRIORITY
+    assert sanitize_priority("-inf") == MIN_PRIORITY
+    assert sanitize_priority("1e400") == MAX_PRIORITY
+    assert sanitize_priority(float("inf")) == MAX_PRIORITY
+
+
+def test_request_priority_property_defaults_neutral():
+    assert _req("r", "t").priority == DEFAULT_PRIORITY
+    assert _req("r", "t", priority="7").priority == 7
+    assert _req("r", "t", priority="evil\n").priority == DEFAULT_PRIORITY
+
+
+# ------------------------------------------------------- DRR ordering
+def test_drr_hand_oracle():
+    """quantum 8, costs 8: a weight-8 tenant drains its whole queue in
+    round one (deficit 64); the weight-1 tenant places exactly one
+    request per round and is deferred in each round it waits."""
+    s = _sched()
+    for i in range(4):
+        s.add_request(_req(f"a{i}", "alpha", 8))
+        s.add_request(_req(f"b{i}", "beta", 1))
+    s._wfq_order()
+    assert [r.request_id for r in s.waiting] == \
+        ["a0", "a1", "a2", "a3", "b0", "b1", "b2", "b3"]
+    # beta held in rounds 1-3 (placed b0..b2 one per round, b3 ends
+    # its queue so round 4 holds nothing)
+    assert s.wfq_deferred == {"beta": 3}
+
+
+def test_equal_weights_interleave_round_robin():
+    s = _sched()
+    for i in range(3):
+        s.add_request(_req(f"a{i}", "alpha", 1))
+        s.add_request(_req(f"b{i}", "beta", 1))
+    s._wfq_order()
+    order = [r.request_id for r in s.waiting]
+    # equal weights, quantum == cost: one request per tenant per round
+    # — strict alternation, FIFO within each tenant
+    assert order == ["a0", "b0", "a1", "b1", "a2", "b2"]
+    assert s.wfq_deferred == {"alpha": 2, "beta": 2}
+
+
+def test_neutral_default_drains_whole_queues_per_round():
+    """No client priorities at all: the neutral weight's quantum
+    (8 x 4 = 32 tokens) covers each tenant's queue in one visit, so
+    ordering degenerates to per-tenant FIFO blocks with no deferrals."""
+    s = _sched()
+    for i in range(3):
+        s.add_request(_req(f"a{i}", "alpha"))
+        s.add_request(_req(f"b{i}", "beta"))
+    s._wfq_order()
+    assert [r.request_id for r in s.waiting] == \
+        ["a0", "a1", "a2", "b0", "b1", "b2"]
+    assert s.wfq_deferred == {}
+
+
+def test_wfq_off_keeps_strict_arrival_order():
+    s = _sched(wfq_scheduling=False)
+    ids = []
+    for i in range(3):
+        s.add_request(_req(f"a{i}", "alpha", 1))
+        s.add_request(_req(f"b{i}", "beta", 8))
+        ids += [f"a{i}", f"b{i}"]
+    s.schedule()
+    # everything admitted in arrival order (budget covers all)
+    assert [r.request_id for r in s.running] == ids
+    assert s.wfq_deferred == {}
+
+
+def test_single_tenant_is_fifo_even_with_wfq_on():
+    s = _sched()
+    for i in range(4):
+        s.add_request(_req(f"r{i}", "alpha", (i % 2) * 7 + 1))
+    before = [r.request_id for r in s.waiting]
+    s._wfq_order()
+    assert [r.request_id for r in s.waiting] == before
+
+
+def test_resuming_requests_keep_the_queue_head():
+    s = _sched()
+    s.add_request(_req("fresh-hi", "alpha", 8))
+    victim = _req("victim", "beta", 1)
+    s.add_request(victim)
+    # simulate a preemption re-insert: progress + front position
+    s.waiting.remove(victim)
+    victim.status = RequestStatus.PREEMPTED
+    s.waiting.insert(0, victim)
+    s._wfq_order()
+    assert s.waiting[0] is victim, \
+        "a preemption victim must never rot behind fresh arrivals"
+
+
+def test_admission_follows_wfq_order_under_seat_pressure():
+    # quantum 2 < cost 8: the weight-1 tenant needs 4 rounds per
+    # request while weight-8 covers one per round — arrival order
+    # (beta first) loses to weight under contention
+    s = _sched(max_num_seqs=2, max_num_batched_tokens=16,
+               wfq_quantum_tokens=2)
+    s.add_request(_req("b0", "beta", 1))
+    s.add_request(_req("a0", "alpha", 8))
+    s.add_request(_req("a1", "alpha", 8))
+    out = s.schedule()
+    scheduled = [x.request.request_id for x in out.prefills]
+    assert scheduled == ["a0", "a1"], \
+        "the weight-8 tenant owns the contended seats"
+    assert s.wfq_deferred.get("beta", 0) >= 1
+
+
+def test_starvation_freedom():
+    """Every admitted tenant makes progress: with weights 8:1 and one
+    seat, the weight-1 tenant still finishes work in bounded rounds."""
+    s = _sched(max_num_seqs=1, max_num_batched_tokens=8)
+    for i in range(6):
+        s.add_request(_req(f"a{i}", "alpha", 8, max_tokens=1))
+        s.add_request(_req(f"b{i}", "beta", 1, max_tokens=1))
+    finished = []
+    for _ in range(60):
+        out = s.schedule()
+        for sched in out.prefills + out.decodes:
+            req = sched.request
+            req.num_computed_tokens += sched.num_new_tokens
+            req.status = RequestStatus.FINISHED_STOPPED
+            finished.append(req.request_id)
+            s.running.remove(req)
+            s._free_request(req)
+        if not s.has_unfinished:
+            break
+    assert not s.has_unfinished, "WFQ must drain the whole queue"
+    beta_done = [f for f in finished if f.startswith("b")]
+    assert len(beta_done) == 6, "low priority must progress, not starve"
+    # ...but the weight-8 tenant finished its work strictly earlier
+    assert finished.index("a5") < finished.index("b5")
+    assert s.wfq_deferred.get("beta", 0) > 0
+
+
+# ------------------------------------------------- priority-ordered shed
+def test_full_queue_sheds_lowest_priority_not_arrival():
+    s = _sched(max_queue_depth=3)
+    s.add_request(_req("lo0", "beta", 1))
+    s.add_request(_req("hi0", "alpha", 8))
+    s.add_request(_req("lo1", "beta", 1))
+    # queue full; a priority-8 arrival displaces the NEWEST priority-1
+    s.add_request(_req("hi1", "alpha", 8))
+    ids = [r.request_id for r in s.waiting]
+    assert ids == ["lo0", "hi0", "hi1"]
+    assert s.shed_counts == {("queue_depth", "beta"): 1}
+    shed = s.drain_errored()
+    assert [r.request_id for r in shed] == ["lo1"]
+    assert shed[0].additional_information["error_kind"] == "shed"
+
+
+def test_equal_priority_arrival_is_shed_fcfs():
+    s = _sched(max_queue_depth=2)
+    s.add_request(_req("r0", "alpha", 4))
+    s.add_request(_req("r1", "beta", 4))
+    s.add_request(_req("r2", "alpha", 4))
+    assert [r.request_id for r in s.waiting] == ["r0", "r1"]
+    assert s.shed_counts == {("queue_depth", "alpha"): 1}
+
+
+def test_progressed_requests_are_never_displaced():
+    s = _sched(max_queue_depth=2)
+    parked = _req("parked", "beta", 1)
+    s.add_request(parked)
+    parked.num_computed_tokens = 4     # restored/preempted progress
+    s.add_request(_req("lo", "beta", 1))
+    s.add_request(_req("hi", "alpha", 8))
+    ids = [r.request_id for r in s.waiting]
+    assert "parked" in ids and "hi" in ids and "lo" not in ids
+
+
+def test_preemption_victims_are_never_displaced():
+    """Regression: _preempt RESETS num_computed_tokens to 0, so a
+    preemption victim (with streamed output the client already saw)
+    must be recognized by STATUS/output, not progress — shedding it
+    would abort a live partially-streamed response."""
+    s = _sched(max_queue_depth=2)
+    victim = _req("victim", "beta", 1)
+    s.add_request(victim)
+    # simulate _preempt's re-insert: output exists, progress reset
+    victim.append_output_token(5)
+    victim.num_computed_tokens = 0
+    victim.status = RequestStatus.PREEMPTED
+    s.add_request(_req("hi", "alpha", 8))
+    s.add_request(_req("hi2", "alpha", 8))   # queue full at 2
+    ids = [r.request_id for r in s.waiting]
+    assert "victim" in ids, \
+        "a preemption victim must never be the priority-shed target"
+    assert s.shed_counts.get(("queue_depth", "alpha")) == 1
+
+
+def test_wfq_shed_off_without_flag():
+    s = _sched(wfq_scheduling=False, max_queue_depth=1)
+    s.add_request(_req("lo", "beta", 1))
+    s.add_request(_req("hi", "alpha", 8))
+    assert [r.request_id for r in s.waiting] == ["lo"], \
+        "without WFQ the classic FCFS shed stands"
+
+
+# ------------------------------------------------------------- metrics
+def test_deferred_ledger_caps_tenant_cardinality():
+    from vllm_omni_tpu.metrics.stats import MAX_TENANT_SERIES
+
+    s = _sched()
+    # more tenants than the cardinality cap, one request each, plus a
+    # heavy competitor so every round defers someone
+    for i in range(MAX_TENANT_SERIES + 8):
+        s.add_request(_req(f"t{i}", f"tenant{i}", 1, n_prompt=32))
+    s.add_request(_req("big", "whale", 8, n_prompt=8))
+    for _ in range(4):
+        s._wfq_order()
+    assert len(s.wfq_deferred) <= MAX_TENANT_SERIES + 1
+
+
+def test_deferred_counts_render_on_metrics():
+    from vllm_omni_tpu.metrics.prometheus import (
+        render_exposition,
+        validate_exposition,
+    )
+
+    snap = {"wfq": {"deferred_by_tenant": {"alpha": 0, "beta": 3}}}
+    text = render_exposition({}, {0: snap})
+    assert ('vllm_omni_tpu_wfq_deferred_requests_total'
+            '{stage="0",tenant="beta"} 3') in text
+    assert validate_exposition(text) == []

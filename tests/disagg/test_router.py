@@ -391,3 +391,129 @@ def test_debug_snapshot_shape():
     assert any(r["drained"] for r in snap["replicas"])
     assert snap["requests"] and snap["requests"][0]["phase"]
     assert "failovers" in snap["counters"]
+
+
+# ------------------------------------------------- gauge refresh (fix)
+def test_refresh_gauges_without_dispatch_or_step():
+    """Regression: the health/degraded gauges were only refreshed on
+    the dispatch path (inside step()), so an idle or fully-quiesced
+    fleet showed stale values on /metrics.  ``refresh_gauges()`` is
+    the extracted poll the health prober and the control plane call
+    without stepping anything."""
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    router = _topology(n_prefill=2, n_decode=1)
+    router.step()       # seed the gauges through the classic path
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="prefill") == 2
+    # the whole prefill tier dies while the fleet is idle: NO step, NO
+    # dispatch — the poll alone must move the gauges
+    for r in router.prefills:
+        r.dead = True
+    router.refresh_gauges()
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="prefill") == 0
+    assert resilience_metrics.get("degraded_mode") == 1
+    assert router.degraded
+
+
+# ----------------------------------------------------- fleet actuation
+def test_set_role_requires_drain_and_quiesce():
+    router = _topology(n_prefill=1, n_decode=2)
+    with pytest.raises(RuntimeError, match="drained and quiesced"):
+        router.set_role("d0", "prefill")
+    router.submit([1, 2], SP, request_id="r1")
+    router.drain("d0")
+    # d0 idle (request went to prefill tier): drained + quiesced
+    router.set_role("d0", "prefill")
+    assert [r.replica_id for r in router.prefills] == ["p0", "d0"]
+
+
+def test_set_role_moves_pools_and_wires_sink():
+    router = _topology(n_prefill=1, n_decode=2)
+    d0 = router._replica("d0")
+    router.drain("d0")
+    router.set_role("d0", "prefill")
+    assert d0.role == "prefill" and d0 in router.prefills
+    assert d0 not in router.decodes
+    assert d0.engine.kv_transfer_sink == router._kv_sink
+    assert d0.drained, "the flip must NOT auto-admit; undrain is " \
+        "the caller's explicit re-admission"
+    router.undrain("d0")
+    # and back again: the sink unwires
+    router.drain("d0")
+    router.set_role("d0", "decode")
+    assert d0.engine.kv_transfer_sink is None
+    assert d0 in router.decodes and len(router.replicas) == 3
+
+
+def test_set_role_rejects_dead_and_bad_targets():
+    router = _topology(n_prefill=1, n_decode=2)
+    with pytest.raises(ValueError, match="prefill|decode"):
+        router.set_role("d0", "colocated")
+    router._replica("d0").dead = True
+    with pytest.raises(RuntimeError, match="dead"):
+        router.set_role("d0", "prefill")
+
+
+def test_add_replica_and_duplicate_guard():
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    router = _topology(n_prefill=1, n_decode=1)
+    fresh = _replica("d9", "decode", 9)
+    router.add_replica(fresh)
+    assert fresh in router.decodes and fresh in router.replicas
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="decode") == 2
+    with pytest.raises(ValueError, match="already exists"):
+        router.add_replica(_replica("d9", "decode", 10))
+
+
+def test_remove_replica_requires_drain_and_guards_last():
+    router = _topology(n_prefill=1, n_decode=2)
+    with pytest.raises(RuntimeError, match="drained"):
+        router.remove_replica("d1")
+    router.drain("d1")
+    removed = router.remove_replica("d1")
+    assert removed.replica_id == "d1"
+    assert len(router.replicas) == 2
+    # the last replica can never be removed
+    router.drain("d0")
+    router.drain("p0")
+    router.remove_replica("d0")
+    with pytest.raises(RuntimeError, match="last replica"):
+        router.remove_replica("p0")
+
+
+def test_set_role_emptying_a_tier_zeroes_gauge():
+    """Regression: a role flip that empties a tier (1Px1D runbook
+    flip) must drop the emptied tier's gauge to 0 — the refresh loop
+    skips empty pools, so without the explicit zeroing /metrics keeps
+    advertising capacity that no longer exists."""
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    router = _topology(n_prefill=1, n_decode=1)
+    router.step()
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="decode") == 1
+    router.drain("d0")
+    router.set_role("d0", "prefill")
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="decode") == 0
+    assert len(router.prefills) == 2 and not router.decodes
+
+
+def test_remove_last_of_tier_zeroes_gauge():
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    router = _topology(n_prefill=1, n_decode=2)
+    router.step()
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="decode") == 2
+    router.drain("d0")
+    router.drain("d1")
+    router.remove_replica("d0")
+    router.remove_replica("d1")
+    assert resilience_metrics.get("router_healthy_replicas",
+                                  role="decode") == 0, \
+        "an emptied tier must not freeze its last gauge value"

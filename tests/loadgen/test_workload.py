@@ -7,7 +7,9 @@ import pytest
 from vllm_omni_tpu.loadgen.workload import (
     Scenario,
     build_workload,
+    burst_arrivals,
     default_catalog,
+    diurnal_arrivals,
     poisson_arrivals,
     trace_replay_arrivals,
 )
@@ -93,3 +95,109 @@ def test_workload_rejects_empty_or_zero_weight_catalog():
         build_workload([0.0], catalog=[
             Scenario("z", weight=0.0, prompt_len=(1, 1),
                      output_len=(1, 1))])
+
+
+# -------------------------------------------- diurnal / burst arrivals
+def test_diurnal_deterministic_sorted_and_counted():
+    a = diurnal_arrivals(5.0, 200, period_s=20.0, seed=9)
+    b = diurnal_arrivals(5.0, 200, period_s=20.0, seed=9)
+    assert a == b and a == sorted(a) and len(a) == 200
+    assert diurnal_arrivals(5.0, 200, period_s=20.0, seed=10) != a
+
+
+def test_diurnal_modulates_arrival_density():
+    """The peak half-period (sin > 0) must carry measurably more
+    arrivals than the trough half — that asymmetry is the entire
+    point of the generator (a static topology is wrong somewhere in
+    the cycle)."""
+    import math
+
+    period = 20.0
+    offsets = diurnal_arrivals(10.0, 2000, period_s=period,
+                               amplitude=0.9, seed=3)
+    peak = sum(1 for t in offsets
+               if math.sin(2 * math.pi * t / period) > 0)
+    trough = len(offsets) - peak
+    assert peak > trough * 1.5, (peak, trough)
+
+
+def test_diurnal_zero_amplitude_is_plain_poisson_rate():
+    offsets = diurnal_arrivals(8.0, 1600, period_s=10.0,
+                               amplitude=0.0, seed=1)
+    # mean inter-arrival ~ 1/8 s (law of large numbers, loose bound)
+    assert 0.10 < offsets[-1] / len(offsets) < 0.16
+
+
+def test_diurnal_rejects_bad_params():
+    with pytest.raises(ValueError):
+        diurnal_arrivals(0.0, 10)
+    with pytest.raises(ValueError):
+        diurnal_arrivals(1.0, 10, amplitude=1.0)
+    with pytest.raises(ValueError):
+        diurnal_arrivals(1.0, 10, period_s=0.0)
+
+
+def test_burst_deterministic_sorted_and_counted():
+    a = burst_arrivals(1.0, 30.0, 150, mean_on_s=2.0, mean_off_s=6.0,
+                       seed=4)
+    b = burst_arrivals(1.0, 30.0, 150, mean_on_s=2.0, mean_off_s=6.0,
+                       seed=4)
+    assert a == b and a == sorted(a) and len(a) == 150
+
+
+def test_burst_density_is_bimodal():
+    """ON phases must be an order of magnitude denser than OFF: count
+    arrivals in 1 s buckets and compare the busiest decile to the
+    median bucket."""
+    offsets = burst_arrivals(0.5, 50.0, 600, mean_on_s=2.0,
+                             mean_off_s=8.0, seed=7)
+    buckets: dict[int, int] = {}
+    for t in offsets:
+        buckets[int(t)] = buckets.get(int(t), 0) + 1
+    counts = sorted(buckets.get(i, 0)
+                    for i in range(int(offsets[-1]) + 1))
+    busiest = counts[-max(len(counts) // 10, 1):]
+    assert min(busiest) >= 10, "bursts must be dense"
+    assert counts[len(counts) // 2] <= 3, "troughs must be quiet"
+
+
+def test_burst_zero_base_rate_has_silent_troughs():
+    offsets = burst_arrivals(0.0, 40.0, 200, mean_on_s=1.0,
+                             mean_off_s=5.0, seed=11)
+    gaps = [b - a for a, b in zip(offsets, offsets[1:])]
+    assert max(gaps) > 2.0, "OFF phases at rate 0 must leave gaps"
+    assert min(gaps) < 0.2, "ON phases must be dense"
+
+
+def test_burst_rejects_bad_params():
+    with pytest.raises(ValueError):
+        burst_arrivals(1.0, 0.0, 10)
+    with pytest.raises(ValueError):
+        burst_arrivals(-1.0, 5.0, 10)
+    with pytest.raises(ValueError):
+        burst_arrivals(1.0, 5.0, 10, mean_on_s=0.0)
+
+
+# ------------------------------------------------------------ priority
+def test_priority_plumbing_scenario_and_tenant_map():
+    catalog = [
+        Scenario("pinned", weight=1.0, prompt_len=(4, 4),
+                 output_len=(2, 2), priority=7),
+        Scenario("plain", weight=1.0, prompt_len=(4, 4),
+                 output_len=(2, 2)),
+    ]
+    wl = build_workload(poisson_arrivals(5.0, 60, seed=0),
+                        catalog=catalog, seed=0,
+                        tenants=("gold", "bronze"),
+                        tenant_priorities={"gold": 8, "bronze": 1})
+    for r in wl:
+        if r.scenario == "pinned":
+            assert r.priority == 7, "scenario pin wins"
+        else:
+            assert r.priority == {"gold": 8, "bronze": 1}[r.tenant]
+
+
+def test_priority_defaults_to_none():
+    wl = build_workload([0.0, 0.5], seed=0)
+    assert all(r.priority is None for r in wl), \
+        "no priorities configured -> neutral (absent) weight"

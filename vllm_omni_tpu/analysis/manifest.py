@@ -49,6 +49,11 @@ HOT_PATHS: tuple[str, ...] = (
     # would stall all tiers at once (payloads are host numpy by the
     # time they reach this layer; keep it that way)
     "vllm_omni_tpu/disagg/",
+    # control plane: actuation runs BETWEEN router steps on the engine
+    # thread, and the sensor tick reads live engine state from the
+    # controller thread — a stray device sync in either stalls all
+    # replicas at once (or serializes serving behind a poll)
+    "vllm_omni_tpu/controlplane/",
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (
@@ -122,6 +127,9 @@ THREADED_PATHS: tuple[str, ...] = (
     "vllm_omni_tpu/benchmarks/",
     # the lock tracer itself: its meta-lock must stay leaf-only
     "vllm_omni_tpu/analysis/runtime.py",
+    # controller thread emits intents; the router thread actuates —
+    # the intent/ring lock convoys both if anything blocks under it
+    "vllm_omni_tpu/controlplane/",
 )
 
 # LOCK_GUARDS: the concurrency manifest rule OL7 (lock-discipline)
@@ -186,6 +194,15 @@ LOCK_GUARDS: dict[str, dict[str, tuple[str, ...]]] = {
     },
     "vllm_omni_tpu/tracing/trace.py::TraceWriter": {
         "_lock": ("_spans",),
+    },
+    # controller thread emits intents + reads the ring; the router
+    # thread drains intents, records outcomes, and bumps the applied-
+    # action counters.  The state-machine fields (_op, _warming,
+    # hysteresis) are deliberately NOT listed: they are controller-
+    # thread-private by contract (actuate() only touches the guarded
+    # attributes below)
+    "vllm_omni_tpu/controlplane/controller.py::ControlPlane": {
+        "_lock": ("_pending", "_done", "_ring", "_seq", "actions"),
     },
 }
 

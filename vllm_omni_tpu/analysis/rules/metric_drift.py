@@ -82,6 +82,8 @@ def synthetic_engine_snapshot() -> dict:
         "queue_wait_ms": hist,
         "queue": {"depth_by_tenant": {"default": 1, "acme": 2}},
         "shed": {"queue_depth/acme": 3, "deadline_headroom/default": 1},
+        # weighted-fair overload scheduling (docs/control_plane.md)
+        "wfq": {"deferred_by_tenant": {"default": 2, "acme": 1}},
         "slo": {
             "targets": {"ttft_ms": 500.0, "tpot_ms": 50.0},
             "tenants": {
@@ -142,6 +144,17 @@ def run_check() -> list[str]:
             "router_healthy_replicas": [({"role": "prefill"}, 2),
                                         ({"role": "decode"}, 1)],
             "degraded_mode": [({}, 0)],
+            # control plane (docs/control_plane.md): the controller's
+            # registry-riding fleet gauges and actuation counters —
+            # every series the closed-loop bench asserts on
+            "controlplane_reroles_total": [
+                ({"from_role": "decode", "to_role": "prefill"}, 1),
+                ({"from_role": "prefill", "to_role": "decode"}, 1)],
+            "controlplane_replicas": [({"role": "prefill"}, 2),
+                                      ({"role": "decode"}, 2)],
+            "controlplane_actions_total": [
+                ({"action": "drain"}, 2), ({"action": "rerole"}, 1),
+                ({"action": "scale_up"}, 1)],
         },
     )
     errors += validate_exposition(text)

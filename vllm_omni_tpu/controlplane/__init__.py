@@ -1,0 +1,37 @@
+"""omnictl — the SLO-driven control plane (docs/control_plane.md).
+
+Closes the serving feedback loop over a disaggregated fleet: the
+``ControlPlane`` watches per-role queue depth,
+``phase_saturation_ratio``, and per-tenant SLO attainment (the same
+snapshot surfaces /debug/z reads) and drives three actuator families —
+live prefill<->decode re-roling (drain -> quiesce -> flip -> re-admit
+through the PR 9 ``DisaggRouter``), fleet autoscaling with a modeled
+cold-start window, and the engines' weighted-fair overload admission
+(``core/scheduler.py`` WFQ, ordered by the ``x-omni-priority``
+metadata).  Decisions land as structured actions on a bounded ring
+served at ``/debug/controlplane``.
+"""
+
+from vllm_omni_tpu.controlplane.controller import (  # noqa: F401
+    ACTION_DRAIN,
+    ACTION_REMOVE,
+    ACTION_REROLE,
+    ACTION_SCALE_UP,
+    ACTION_UNDRAIN,
+    ControlPlane,
+    ControlPlaneConfig,
+    make_inproc_replica_factory,
+)
+from vllm_omni_tpu.controlplane.policy import (  # noqa: F401
+    Hysteresis,
+    RoleSensors,
+    pressure_ratio,
+    role_sensors,
+)
+
+__all__ = [
+    "ControlPlane", "ControlPlaneConfig",
+    "make_inproc_replica_factory", "Hysteresis", "RoleSensors",
+    "pressure_ratio", "role_sensors", "ACTION_DRAIN", "ACTION_UNDRAIN",
+    "ACTION_REROLE", "ACTION_SCALE_UP", "ACTION_REMOVE",
+]

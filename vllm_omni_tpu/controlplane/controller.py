@@ -1,0 +1,639 @@
+"""omnictl: the SLO-driven control plane closing the serving loop.
+
+Every sensor and actuator already existed — per-tenant SLO/goodput
+accounting and serving curves (PR 7), honest health and
+``phase_saturation_ratio`` (PR 8), engine roles, drain/quiesce and the
+degradation ladder (PR 9) — but nothing connected them, so a
+disaggregated fleet stayed pinned to whatever prefill:decode split and
+replica count it booted with.  ``ControlPlane`` is the feedback
+controller (docs/control_plane.md):
+
+- **live re-roling** — when the prefill:decode pressure ratio
+  (policy.py) departs its band with hysteresis, the least-loaded
+  replica of the over-provisioned tier is drained, and once quiesced
+  its role flips (``DisaggRouter.set_role`` -> engine KV-transfer
+  re-arming) and it re-admits into the starved tier.  In-flight
+  streams ride out the drain untouched — bit-identical to the
+  colocated oracle (tests/controlplane/test_e2e.py pins it).
+- **fleet autoscaling** — sustained pressure above/below thresholds
+  scales the in-proc fleet up/down per role through a replica
+  factory; a fresh replica enters DRAINED for ``warmup_ticks`` (the
+  cold-start model: weight load + XLA warmup means new capacity is
+  not instant), and scale-down only ever happens via drain.
+- **overload-adaptive admission** rides in the engines themselves (the
+  WFQ scheduler, core/scheduler.py) — the controller's job there is
+  observability: it polls ``refresh_gauges`` so an idle fleet's
+  /metrics stay honest, and records fleet SLO attainment per tick.
+
+Threading contract (omnirace-audited): the router is SINGLE-THREADED
+by design, so the controller NEVER touches router/replica mutation
+paths from its own thread.  ``tick()`` (controller thread, fake-clock
+testable exactly like the PR 8 watchdog) only READS replica/engine
+state and appends intents to a locked pending queue; ``actuate()``
+(called by the router's stepping thread — DisaggService's engine loop)
+drains that queue and applies the mutations.  ``_lock`` guards the
+pending queue, the apply-outcome queue, the action ring, and the
+applied-action counters — and nothing else; the state machine fields
+are controller-thread-private.
+The lock is declared in the omnilint LOCK_GUARDS manifest and traced
+under OMNI_TPU_LOCK_CHECK=1.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from vllm_omni_tpu.analysis.runtime import traced
+from vllm_omni_tpu.controlplane.policy import (
+    Hysteresis,
+    RoleSensors,
+    pressure_ratio,
+    role_sensors,
+)
+from vllm_omni_tpu.disagg.roles import ROLE_DECODE, ROLE_PREFILL
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+logger = init_logger(__name__)
+
+#: action kinds on the ring / controlplane_actions_total{action}
+ACTION_DRAIN = "drain"
+ACTION_UNDRAIN = "undrain"
+ACTION_REROLE = "rerole"
+ACTION_SCALE_UP = "scale_up"
+ACTION_REMOVE = "remove_replica"
+ACTION_ABORT = "abort"
+
+
+@dataclass
+class ControlPlaneConfig:
+    """Knobs of the feedback loop.  Tick counts (hysteresis, cooldown,
+    warmup) are POLL ticks, not seconds — the fake-clock tests drive
+    ``tick()`` directly and real deployments scale them with
+    ``poll_interval_s``."""
+
+    poll_interval_s: float = 1.0
+    # --- re-roling: the prefill:decode pressure ratio's dead band.
+    # Outside [band_low, band_high] for hysteresis_ticks consecutive
+    # ticks -> flip one replica toward the starved tier
+    rerole_enabled: bool = True
+    band_low: float = 0.5
+    band_high: float = 2.0
+    hysteresis_ticks: int = 3
+    # ticks after ANY completed/aborted operation before the next may
+    # begin — the anti-flap floor (a flip's effect needs time to show
+    # in the sensors before the controller may judge it insufficient)
+    cooldown_ticks: int = 5
+    min_replicas_per_role: int = 1
+    # saturation -> queue-depth-units conversion (policy.py): one
+    # fully saturated phase reads like this many queued requests
+    saturation_gain: float = 4.0
+    # --- autoscaling (off unless a replica factory is installed AND
+    # max_replicas is set)
+    autoscale_enabled: bool = False
+    max_replicas: Optional[int] = None
+    scale_up_pressure: float = 8.0
+    scale_down_pressure: float = 0.5
+    # cold-start model: a scaled-up replica serves nothing for this
+    # many ticks (weight load + warmup compile stand-in); it counts
+    # toward the DECISION capacity immediately so the controller does
+    # not stack scale-ups while one is still warming
+    warmup_ticks: int = 3
+    # never scale down while fleet SLO attainment sits below this
+    # floor (None/no-data = the gate passes)
+    slo_scale_down_floor: float = 0.9
+    # --- structured-action ring (/debug/controlplane)
+    ring_capacity: int = 256
+
+
+@dataclass
+class _Op:
+    """The one drain-based operation in flight (re-role or scale-down).
+    Controller-thread-private.  Re-role stages: "draining" ->
+    "flipping" -> "readmitting"; scale-down: "draining" -> "removing".
+    A flip the router refuses (the quiesce observation can race the
+    scheduler's admission window — popped from waiting, not yet in
+    running) RETRIES from "draining" instead of aborting: actuation
+    revalidates, the decision layer just re-observes."""
+
+    kind: str                  # "rerole" | "scale_down"
+    replica_id: str
+    from_role: str
+    to_role: Optional[str]     # rerole target; None for scale_down
+    stage: str = "draining"
+    started_tick: int = 0
+    retries: int = 0
+
+
+@dataclass
+class _Action:
+    """One intent crossing from the controller thread to the router
+    thread."""
+
+    kind: str
+    args: dict = field(default_factory=dict)
+    seq: int = 0
+
+
+class ControlPlane:
+    """The supervised controller thread + its router-thread actuator.
+
+    ``tick()`` is the whole decision state machine (the thread just
+    calls it on an interval) and ``actuate()`` is the whole actuation
+    path (the router's stepping thread calls it between router steps)
+    — tests drive both synchronously with a fake clock and scripted
+    replicas, no threads required.
+    """
+
+    def __init__(self, router,
+                 config: Optional[ControlPlaneConfig] = None,
+                 *,
+                 replica_factory: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.router = router
+        self.config = config or ControlPlaneConfig()
+        #: builds a fresh EngineReplica for scale-up:
+        #: ``factory(role: str, index: int) -> EngineReplica``
+        self.replica_factory = replica_factory
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = traced(threading.Lock(), "ControlPlane._lock")
+        # cross-thread queues (guarded by _lock): intents out,
+        # apply outcomes back, and the structured-action ring
+        self._pending: deque[_Action] = deque()
+        self._done: deque[dict] = deque()
+        self._ring: deque[dict] = deque(
+            maxlen=max(int(self.config.ring_capacity), 8))
+        self._seq = 0
+        # controller-thread-private state machine
+        self._ticks = 0
+        self._op: Optional[_Op] = None
+        self._scale_up_pending: Optional[str] = None   # role
+        self._warming: dict[str, int] = {}  # replica_id -> ready tick
+        self._cooldown_until = 0
+        self._rerole_hyst = Hysteresis(self.config.hysteresis_ticks)
+        self._scale_hyst = {
+            ROLE_PREFILL: Hysteresis(self.config.hysteresis_ticks),
+            ROLE_DECODE: Hysteresis(self.config.hysteresis_ticks),
+        }
+        self._replica_counter = len(router.replicas)
+        self._last_sensors: dict = {}
+        # lifetime ledgers (mirrored into the resilience registry)
+        self.reroles = 0
+        self.actions: dict[str, int] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ControlPlane":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="controlplane")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._closed = True
+
+    def _loop(self) -> None:
+        while not self._closed:
+            self._sleep(self.config.poll_interval_s)
+            if self._closed:
+                return
+            try:
+                self.tick()
+            except Exception:  # the controller must never kill serving
+                logger.exception("controlplane tick failed")
+
+    # ------------------------------------------------------------- the tick
+    def tick(self) -> dict:
+        """One control iteration: read sensors, advance the operation
+        state machine, emit intents.  Returns the tick's sensor
+        snapshot (tests assert on it)."""
+        self._ticks += 1
+        router = self.router
+        # keep the tier gauges honest even when nothing dispatches
+        # (the satellite fix this controller is the second caller of)
+        try:
+            router.refresh_gauges()
+        except Exception:
+            logger.exception("refresh_gauges failed")
+        sensors = self._read_sensors()
+        self._drain_done()
+        self._advance_warming()
+        if self._op is not None:
+            self._advance_op()
+        elif self._ticks >= self._cooldown_until:
+            self._maybe_rerole(sensors)
+            self._maybe_scale(sensors)
+        else:
+            # decisions are frozen through the cooldown, but the
+            # debouncers keep integrating so a genuinely sustained
+            # departure acts the moment the cooldown lifts
+            self._update_hysteresis(sensors)
+        return sensors
+
+    def _read_sensors(self) -> dict:
+        cfg = self.config
+        pre = role_sensors(self.router.prefills, ROLE_PREFILL,
+                           "prefill", cfg.saturation_gain)
+        dec = role_sensors(self.router.decodes, ROLE_DECODE,
+                           "decode", cfg.saturation_gain)
+        ratio = pressure_ratio(pre, dec)
+        attainment = self._fleet_attainment()
+        resilience_metrics.set_gauge("controlplane_replicas",
+                                     pre.replicas, role=ROLE_PREFILL)
+        resilience_metrics.set_gauge("controlplane_replicas",
+                                     dec.replicas, role=ROLE_DECODE)
+        self._last_sensors = {
+            "tick": self._ticks,
+            "prefill": pre.as_dict(),
+            "decode": dec.as_dict(),
+            "pressure_ratio": round(ratio, 4),
+            "slo_attainment": attainment,
+            "_pre": pre, "_dec": dec,  # objects for the decision legs
+        }
+        return self._last_sensors
+
+    def _fleet_attainment(self) -> Optional[float]:
+        """met/finished across every live engine's SLO ledger; None
+        before any judged completion (no data must gate nothing)."""
+        finished = met = 0
+        for r in self.router.replicas:
+            if r.dead:
+                continue
+            metrics = getattr(r.engine, "step_metrics", None)
+            for st in (getattr(metrics, "tenants", None) or {}).values():
+                finished += getattr(st, "finished", 0)
+                met += getattr(st, "met", 0)
+        if finished <= 0:
+            return None
+        return round(met / finished, 4)
+
+    # --------------------------------------------------- operation advance
+    def _advance_op(self) -> None:
+        op = self._op
+        try:
+            r = self.router._replica(op.replica_id)
+        except KeyError:
+            # removed (scale_down completed on the router thread)
+            if op.kind == "scale_down":
+                self._finish_op("removed")
+            else:
+                self._abort_op("replica vanished mid-operation")
+            return
+        if r.dead:
+            self._abort_op(f"replica {op.replica_id} died "
+                           f"mid-{op.kind}")
+            return
+        if op.stage == "draining":
+            if r.quiesced:
+                if op.kind == "rerole":
+                    op.stage = "flipping"
+                    self._emit(ACTION_REROLE,
+                               replica_id=op.replica_id,
+                               role=op.to_role)
+                else:
+                    op.stage = "removing"
+                    self._emit(ACTION_REMOVE,
+                               replica_id=op.replica_id)
+            return
+        if op.stage == "flipping":
+            if r.role == op.to_role:
+                # the flip landed: count it, then re-admit (undrain is
+                # a SEPARATE stage so a refused flip never leaves an
+                # undrained half-flipped replica behind)
+                self.reroles += 1
+                resilience_metrics.inc("controlplane_reroles_total",
+                                       from_role=op.from_role,
+                                       to_role=op.to_role)
+                op.stage = "readmitting"
+                self._emit(ACTION_UNDRAIN, replica_id=op.replica_id)
+            return
+        if op.stage == "readmitting":
+            if not r.drained:
+                self._finish_op("flipped and re-admitted")
+            return
+        # "removing": completion is observed as the KeyError above
+
+    def _finish_op(self, outcome: str) -> None:
+        logger.info("controlplane: %s of %s %s", self._op.kind,
+                    self._op.replica_id, outcome)
+        self._op = None
+        self._cooldown_until = self._ticks + self.config.cooldown_ticks
+        self._rerole_hyst.reset()
+        for h in self._scale_hyst.values():
+            h.reset()
+
+    def _abort_op(self, reason: str) -> None:
+        op = self._op
+        logger.warning("controlplane: aborting %s of %s: %s",
+                       op.kind, op.replica_id, reason)
+        self._record({"action": ACTION_ABORT, "kind": op.kind,
+                      "replica_id": op.replica_id,
+                      "reason": reason, "ok": False})
+        # a LIVE donor stranded drained by the abort would silently
+        # leak capacity forever (nothing else ever undrains it):
+        # re-admit it in whatever role it currently holds
+        try:
+            r = self.router._replica(op.replica_id)
+            if not r.dead and r.drained:
+                self._emit(ACTION_UNDRAIN, replica_id=op.replica_id)
+        except KeyError:
+            pass
+        self._finish_op("aborted")
+
+    def _advance_warming(self) -> None:
+        for rid, ready in list(self._warming.items()):
+            try:
+                r = self.router._replica(rid)
+            except KeyError:
+                self._warming.pop(rid, None)
+                continue
+            if r.dead:
+                self._warming.pop(rid, None)
+                continue
+            if self._ticks >= ready:
+                self._warming.pop(rid, None)
+                self._emit(ACTION_UNDRAIN, replica_id=rid)
+                # fresh capacity needs ticks to absorb queued work
+                # before its effect shows in the sensors: freezing
+                # decisions through that lag is the anti-flap floor
+                self._cooldown_until = max(
+                    self._cooldown_until,
+                    self._ticks + self.config.cooldown_ticks)
+                self._scale_hyst[r.role].reset()
+
+    # -------------------------------------------------------- decisions
+    def _rerole_signal(self, ratio: float) -> Optional[str]:
+        """Band departure direction, or None in-band.  The ONE
+        definition both the cooldown integration and the live decision
+        read — a divergence would make the debouncers count different
+        signals in the two modes."""
+        if ratio > self.config.band_high:
+            return "to_prefill"
+        if ratio < self.config.band_low:
+            return "to_decode"
+        return None
+
+    def _scale_signal(self, s: RoleSensors) -> Optional[str]:
+        if s.pressure > self.config.scale_up_pressure:
+            return "up"
+        if s.pressure < self.config.scale_down_pressure:
+            return "down"
+        return None
+
+    def _update_hysteresis(self, sensors: dict) -> None:
+        self._rerole_hyst.update(
+            self._rerole_signal(sensors["pressure_ratio"]))
+        for role, s in ((ROLE_PREFILL, sensors["_pre"]),
+                        (ROLE_DECODE, sensors["_dec"])):
+            self._scale_hyst[role].update(self._scale_signal(s))
+
+    def _maybe_rerole(self, sensors: dict) -> None:
+        cfg = self.config
+        if not cfg.rerole_enabled:
+            return
+        pre: RoleSensors = sensors["_pre"]
+        dec: RoleSensors = sensors["_dec"]
+        ratio = sensors["pressure_ratio"]
+        fired = self._rerole_hyst.update(self._rerole_signal(ratio))
+        if fired is None or self._op is not None:
+            return
+        donor_pool, donor_sensors, to_role = (
+            (self.router.decodes, dec, ROLE_PREFILL)
+            if fired == "to_prefill"
+            else (self.router.prefills, pre, ROLE_DECODE))
+        if donor_sensors.in_rotation <= cfg.min_replicas_per_role:
+            # the donor tier is at its floor: re-roling would just
+            # swap which tier starves.  (Autoscaling, if enabled, is
+            # the lever that can still act.)
+            return
+        donor = self._pick_donor(donor_pool)
+        if donor is None:
+            return
+        self._op = _Op(kind="rerole", replica_id=donor.replica_id,
+                       from_role=donor.role, to_role=to_role,
+                       started_tick=self._ticks)
+        self._emit(ACTION_DRAIN, replica_id=donor.replica_id,
+                   reason=f"rerole {donor.role}->{to_role} "
+                          f"(pressure_ratio={ratio:.2f})")
+
+    def _maybe_scale(self, sensors: dict) -> None:
+        cfg = self.config
+        if not (cfg.autoscale_enabled and cfg.max_replicas):
+            # still keep the debouncers warm for the rerole leg's reset
+            for role in self._scale_hyst:
+                self._scale_hyst[role].update(None)
+            return
+        total = sum(1 for r in self.router.replicas if not r.dead)
+        for role, s in ((ROLE_PREFILL, sensors["_pre"]),
+                        (ROLE_DECODE, sensors["_dec"])):
+            fired = self._scale_hyst[role].update(self._scale_signal(s))
+            if fired is None or self._op is not None \
+                    or self._scale_up_pending is not None:
+                continue
+            if fired == "up":
+                if self.replica_factory is None:
+                    continue  # nothing can build capacity
+                if total >= cfg.max_replicas or self._warming:
+                    # capacity already building (the cold-start model:
+                    # a warming replica IS the response to this
+                    # pressure — stacking another is the flap)
+                    continue
+                self._scale_up_pending = role
+                self._emit(ACTION_SCALE_UP, role=role,
+                           index=self._replica_counter)
+                self._replica_counter += 1
+                self._scale_hyst[role].reset()
+            else:
+                pool = (self.router.prefills if role == ROLE_PREFILL
+                        else self.router.decodes)
+                in_rot = sum(1 for r in pool if r.in_rotation)
+                att = sensors.get("slo_attainment")
+                if in_rot <= cfg.min_replicas_per_role:
+                    continue
+                if (att is not None
+                        and att < cfg.slo_scale_down_floor):
+                    # the fleet is missing SLOs: shrinking it now
+                    # would be pro-cyclical
+                    continue
+                donor = self._pick_donor(pool)
+                if donor is None:
+                    continue
+                self._op = _Op(kind="scale_down",
+                               replica_id=donor.replica_id,
+                               from_role=role, to_role=None,
+                               started_tick=self._ticks)
+                self._emit(ACTION_DRAIN, replica_id=donor.replica_id,
+                           reason=f"scale_down {role} "
+                                  f"(pressure={s.pressure:.2f})")
+
+    def _pick_donor(self, pool):
+        """Least-loaded in-rotation replica — the flip/removal that
+        strands the least in-flight work behind a drain.  Delegates to
+        the router's own dispatch-placement policy so donor choice can
+        never silently diverge from where new work lands."""
+        return self.router._pick(pool)
+
+    # ------------------------------------------------------- intent queue
+    def _emit(self, kind: str, **args) -> None:
+        with self._lock:
+            self._seq += 1
+            self._pending.append(_Action(kind=kind, args=args,
+                                         seq=self._seq))
+
+    def _record(self, doc: dict) -> None:
+        doc = dict(doc)
+        doc.setdefault("tick", self._ticks)
+        doc["t"] = round(self._clock(), 3)
+        with self._lock:
+            self._seq += 1
+            doc.setdefault("seq", self._seq)
+            self._ring.append(doc)
+
+    def _drain_done(self) -> None:
+        with self._lock:
+            done, self._done = self._done, deque()
+        for d in done:
+            if d.get("action") == ACTION_SCALE_UP:
+                if d.get("ok"):
+                    self._warming[d["replica_id"]] = (
+                        self._ticks + self.config.warmup_ticks)
+                self._scale_up_pending = None
+                if d.get("ok"):
+                    self._cooldown_until = (
+                        self._ticks + self.config.cooldown_ticks)
+            elif not d.get("ok") and self._op is not None \
+                    and d.get("replica_id") == self._op.replica_id:
+                op = self._op
+                retryable = (
+                    (d.get("action") == ACTION_REROLE
+                     and op.stage == "flipping")
+                    or (d.get("action") == ACTION_REMOVE
+                        and op.stage == "removing"))
+                if retryable and op.retries < 4:
+                    # the quiesce observation raced the scheduler's
+                    # admission window and the router refused the
+                    # mutation: re-observe and retry (bounded)
+                    op.retries += 1
+                    op.stage = "draining"
+                else:
+                    self._abort_op(
+                        f"actuation {d.get('action')} failed: "
+                        f"{d.get('error')}")
+
+    # ---------------------------------------------------------- actuation
+    def actuate(self, router=None) -> int:
+        """Apply pending intents — called on the ROUTER THREAD (the
+        only thread allowed to mutate router/replica state).  Returns
+        the number of actions applied.  Every outcome lands on the
+        structured ring and, for the ones the state machine waits on,
+        in the done-queue the next ``tick()`` drains."""
+        router = router or self.router
+        with self._lock:
+            pending, self._pending = self._pending, deque()
+        applied = 0
+        for act in pending:
+            outcome = {"action": act.kind, "seq": act.seq,
+                       "ok": True, **{k: v for k, v in act.args.items()
+                                      if k != "reason"}}
+            if act.args.get("reason"):
+                outcome["reason"] = act.args["reason"]
+            try:
+                if act.kind == ACTION_DRAIN:
+                    router.drain(act.args["replica_id"])
+                elif act.kind == ACTION_UNDRAIN:
+                    router.undrain(act.args["replica_id"])
+                elif act.kind == ACTION_REROLE:
+                    router.set_role(act.args["replica_id"],
+                                    act.args["role"])
+                elif act.kind == ACTION_SCALE_UP:
+                    replica = self.replica_factory(
+                        act.args["role"], act.args["index"])
+                    replica.drained = True  # warms before admission
+                    router.add_replica(replica)
+                    outcome["replica_id"] = replica.replica_id
+                elif act.kind == ACTION_REMOVE:
+                    router.remove_replica(act.args["replica_id"])
+                else:
+                    raise ValueError(f"unknown action {act.kind!r}")
+                applied += 1
+                with self._lock:
+                    self.actions[act.kind] = \
+                        self.actions.get(act.kind, 0) + 1
+                resilience_metrics.inc("controlplane_actions_total",
+                                       action=act.kind)
+            except Exception as e:
+                outcome["ok"] = False
+                outcome["error"] = f"{type(e).__name__}: {e}"
+                logger.warning("controlplane action %s failed: %s",
+                               act.kind, outcome["error"])
+            self._record(outcome)
+            if act.kind in (ACTION_SCALE_UP,) or not outcome["ok"]:
+                with self._lock:
+                    self._done.append(outcome)
+        return applied
+
+    # ------------------------------------------------------ introspection
+    def debug_snapshot(self) -> dict:
+        """/debug/controlplane: sensors, the in-flight operation,
+        warming replicas, cooldown state, and the action-ring tail.
+        Read-only host state."""
+        with self._lock:
+            ring = list(self._ring)
+            pending = len(self._pending)
+            actions = dict(self.actions)
+        sensors = {k: v for k, v in self._last_sensors.items()
+                   if not k.startswith("_")}
+        op = self._op
+        return {
+            "enabled": True,
+            "ticks": self._ticks,
+            "sensors": sensors,
+            "operation": (None if op is None else {
+                "kind": op.kind, "replica_id": op.replica_id,
+                "from_role": op.from_role, "to_role": op.to_role,
+                "stage": op.stage, "started_tick": op.started_tick,
+            }),
+            "warming": dict(self._warming),
+            "cooldown_remaining_ticks": max(
+                self._cooldown_until - self._ticks, 0),
+            "pending_actions": pending,
+            "counters": {"reroles": self.reroles,
+                         "actions": actions},
+            "config": {
+                "band": [self.config.band_low, self.config.band_high],
+                "hysteresis_ticks": self.config.hysteresis_ticks,
+                "cooldown_ticks": self.config.cooldown_ticks,
+                "autoscale": self.config.autoscale_enabled,
+                "max_replicas": self.config.max_replicas,
+            },
+            "ring": ring[-64:],
+        }
+
+
+def make_inproc_replica_factory(params, model_cfg, base_config,
+                                eos_token_id=None) -> Callable:
+    """Replica factory for in-proc autoscaling: builds an
+    ``LLMEngine`` of the requested role from the same (params, config)
+    family ``build_inproc_router`` uses, so scaled-up replicas are
+    indistinguishable from boot-time ones."""
+    import dataclasses
+
+    from vllm_omni_tpu.disagg.router import EngineReplica
+
+    def factory(role: str, index: int):
+        from vllm_omni_tpu.engine import LLMEngine
+
+        cfg = dataclasses.replace(base_config, engine_role=role)
+        eng = LLMEngine(params, model_cfg, cfg,
+                        eos_token_id=eos_token_id)
+        return EngineReplica(f"{role}{index}", eng, role, index)
+
+    return factory

@@ -1,0 +1,124 @@
+"""Control-plane sensor math: role pressure + hysteresis bands.
+
+Pure host-side arithmetic over replica/engine state — no jax, no
+locks, no side effects — so the whole decision layer is fake-clock
+unit-testable (the same stance as the PR 8 watchdog's ``check_once``).
+
+The pressure model (docs/control_plane.md): a role's pressure is its
+queue depth per in-rotation replica plus a weighted phase-saturation
+term.  Queue depth is the leading indicator (work already waiting);
+``phase_saturation_ratio`` is the coincident one (how close the last
+schedule ran to its token-budget ceiling) — a fleet can be saturated
+with shallow queues when arrivals exactly match capacity, and queued
+with low saturation right after a burst.  Summing both (saturation
+scaled into queue-depth units by ``saturation_gain``) makes either
+signal sufficient to move the controller.
+
+"TPLA" (PAPERS.md) frames why the prefill:decode pressure RATIO is the
+re-roling signal: the right tier split is workload-dependent — long
+prompts with short outputs want prefill capacity, chatty decode-heavy
+sessions want the opposite — so the ratio must float at runtime and
+any static split is wrong for part of a diurnal trace.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RoleSensors:
+    """One role's sensor reading for a tick (JSON-ready)."""
+
+    role: str
+    replicas: int          # non-dead replicas in the pool
+    in_rotation: int       # healthy, undrained (taking new dispatch)
+    queue_depth: int       # waiting+running across the pool
+    saturation: float      # mean phase saturation across live engines
+    pressure: float
+
+    def as_dict(self) -> dict:
+        return {
+            "role": self.role,
+            "replicas": self.replicas,
+            "in_rotation": self.in_rotation,
+            "queue_depth": self.queue_depth,
+            "saturation": round(self.saturation, 4),
+            "pressure": round(self.pressure, 4),
+        }
+
+
+def _replica_saturation(replica, phase: str) -> float:
+    """One replica's last-schedule saturation for ``phase``
+    (getattr-defensive: fake engines and generation stages report 0)."""
+    metrics = getattr(replica.engine, "step_metrics", None)
+    sat = getattr(metrics, "saturation", None) or {}
+    try:
+        return float(sat.get(phase, 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def role_sensors(pool, role: str, phase: str,
+                 saturation_gain: float) -> RoleSensors:
+    """Fold a replica pool into one ``RoleSensors`` reading.  Dead
+    replicas contribute nothing (their queues are being failed over);
+    drained/ejected ones still contribute queue depth — their in-flight
+    work is real load — but the per-replica normalization divides by
+    the IN-ROTATION count, because that is the capacity new work can
+    actually land on."""
+    alive = [r for r in pool if not r.dead]
+    in_rotation = [r for r in alive if r.in_rotation]
+    depth = sum(r.queue_depth for r in alive)
+    sats = [_replica_saturation(r, phase) for r in in_rotation]
+    sat = sum(sats) / len(sats) if sats else 0.0
+    pressure = (depth / max(len(in_rotation), 1)
+                + saturation_gain * sat)
+    if not in_rotation and depth > 0:
+        # a tier with queued work and nothing to serve it is the
+        # highest-pressure state there is — never report it as calm
+        pressure = max(pressure, depth * 2.0)
+    return RoleSensors(role=role, replicas=len(alive),
+                       in_rotation=len(in_rotation),
+                       queue_depth=depth, saturation=sat,
+                       pressure=pressure)
+
+
+def pressure_ratio(prefill: RoleSensors, decode: RoleSensors,
+                   eps: float = 0.25) -> float:
+    """prefill:decode pressure ratio, epsilon-smoothed so an idle tier
+    doesn't blow the ratio to infinity (eps acts as a quarter-request
+    of standing pressure on both sides)."""
+    return (prefill.pressure + eps) / (decode.pressure + eps)
+
+
+class Hysteresis:
+    """Consecutive-tick debouncer: ``update`` returns the signal only
+    after it has held for ``ticks`` consecutive updates.  A transient
+    spike (one hot schedule, one burst arrival) never moves the
+    controller; a sustained departure does.  Any change of direction
+    resets the count."""
+
+    def __init__(self, ticks: int):
+        self.ticks = max(int(ticks), 1)
+        self._signal = None
+        self._count = 0
+
+    def update(self, signal):
+        """``signal`` is any hashable direction (e.g. "up"/"down") or
+        None for in-band; returns the debounced signal or None."""
+        if signal is None or signal != self._signal:
+            self._signal = signal
+            self._count = 1 if signal is not None else 0
+            return None
+        self._count += 1
+        return signal if self._count >= self.ticks else None
+
+    def reset(self) -> None:
+        self._signal = None
+        self._count = 0
+
+    @property
+    def pending(self) -> dict:
+        return {"signal": self._signal, "count": self._count,
+                "needed": self.ticks}

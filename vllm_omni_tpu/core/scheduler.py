@@ -89,6 +89,21 @@ class SchedulerConfig:
     # the door (429) rather than admitted to expire mid-queue (504).
     # 0.0 disables the check
     admission_deadline_headroom_s: float = 0.0
+    # weighted-fair overload scheduling (docs/control_plane.md): order
+    # the waiting queue by per-tenant deficit round robin — each
+    # tenant's admission share is proportional to its requests'
+    # priority weight (Request.priority, the sanitized x-omni-priority
+    # metadata) — and make the max_queue_depth shed priority-ordered:
+    # a full queue sheds its lowest-priority fresh entry to admit a
+    # higher-priority arrival, instead of FCFS-shedding the arrival.
+    # Off (default) keeps strict arrival order; with it on but no
+    # client sending priorities, every tenant carries the neutral
+    # weight and DRR degenerates to per-tenant round robin
+    wfq_scheduling: bool = False
+    # DRR quantum added per unit of priority weight each round, in
+    # prompt tokens — the granularity of interleaving between tenants
+    # (bigger = longer per-tenant runs, smaller = finer interleave)
+    wfq_quantum_tokens: int = 256
 
     @property
     def chunking_enabled(self) -> bool:
@@ -175,6 +190,15 @@ class ARScheduler:
         # load-shed counters, keyed (reason, tenant) — rendered as
         # shed_requests_total{reason, tenant} on /metrics
         self.shed_counts: dict[tuple[str, str], int] = {}
+        # WFQ deferral ledger: rounds a tenant's head-of-line fresh
+        # request was held back by its deficit while the DRR pass
+        # placed other work — rendered as
+        # wfq_deferred_requests_total{tenant} on /metrics
+        self.wfq_deferred: dict[str, int] = {}
+        # DRR rotation pointer: the tenant the next ordering pass
+        # visits first (rotates every pass so quantum ties don't
+        # permanently favor the first-arrived tenant)
+        self._wfq_rotation = 0
         # set once any admitted request carries a deadline, so the
         # per-step expiry sweep stays free for deadline-less serving
         self._deadlines_possible = False
@@ -215,11 +239,18 @@ class ARScheduler:
         # queue: no pages, no scheduling work, no engine admission.
         if (self.config.max_queue_depth is not None
                 and len(self.waiting) >= self.config.max_queue_depth):
-            self.shed(request, "queue_depth",
-                      f"waiting queue at capacity "
-                      f"({self.config.max_queue_depth}); retry with "
-                      "backoff")
-            return
+            # priority-ordered shed (WFQ): a full queue prefers to
+            # displace its lowest-priority FRESH entry over refusing a
+            # strictly higher-priority arrival — under overload the
+            # low-priority work is what defers, not whoever arrived
+            # last.  Equal priority keeps the FCFS shed (no churn).
+            if not (self.config.wfq_scheduling
+                    and self._shed_lower_priority(request)):
+                self.shed(request, "queue_depth",
+                          f"waiting queue at capacity "
+                          f"({self.config.max_queue_depth}); retry with "
+                          "backoff")
+                return
         if (self.config.admission_deadline_headroom_s > 0.0
                 and request.deadline_ts is not None
                 and request.deadline_ts - time.monotonic()
@@ -256,6 +287,101 @@ class ARScheduler:
         key = (reason, tenant)
         self.shed_counts[key] = self.shed_counts.get(key, 0) + 1
         self.reject(request, message, kind=SHED)
+
+    def _shed_lower_priority(self, arrival: Request) -> bool:
+        """Displace the lowest-priority fresh waiting request (newest
+        among ties) when the ``arrival`` strictly outranks it; returns
+        True when room was made.  Only FRESH entries are candidates —
+        anything with computed progress (preemption victims, prefix-
+        cache adoptions, parked restores) or streaming chunk intake
+        holds state worth strictly more than an empty slot."""
+        victim = None
+        for req in self.waiting:
+            if (req.num_computed_tokens > 0 or req.awaiting_chunks
+                    or req.status is RequestStatus.PREEMPTED
+                    or req.output_token_ids
+                    or req.additional_information.get("_parked_len")):
+                # progress, streamed output, or a preemption victim
+                # (whose num_computed_tokens was RESET to 0): all hold
+                # state a client already saw — never displaceable
+                continue
+            if victim is None or req.priority <= victim.priority:
+                victim = req  # <=: newest of the lowest class loses
+        if victim is None or victim.priority >= arrival.priority:
+            return False
+        self.waiting.remove(victim)
+        self.shed(victim, "queue_depth",
+                  "displaced by a higher-priority arrival with the "
+                  f"waiting queue at capacity "
+                  f"({self.config.max_queue_depth}); retry with backoff")
+        return True
+
+    def _wfq_order(self) -> None:
+        """Deficit-round-robin ordering of the waiting queue
+        (docs/control_plane.md).  Entries with computed progress —
+        preemption victims, parked restores — keep the queue head in
+        their existing order (their pages/progress must not rot behind
+        fresh arrivals); fresh arrivals are grouped per tenant (FIFO
+        within a tenant) and interleaved by DRR: each round a tenant's
+        deficit grows by ``wfq_quantum_tokens x priority`` and its
+        head requests are placed while the deficit covers their token
+        cost.  Every tenant's deficit grows every round, so every
+        admitted tenant makes progress — starvation-free by
+        construction.  A round that holds a tenant's head back while
+        placing other work counts one deferral for that tenant."""
+        from vllm_omni_tpu.metrics.stats import cap_tenant
+
+        resuming: list[Request] = []
+        groups: dict[str, list[Request]] = {}
+        for req in self.waiting:
+            if (req.num_computed_tokens > 0
+                    or req.status is RequestStatus.PREEMPTED
+                    or req.additional_information.get("_parked_len")):
+                resuming.append(req)
+            else:
+                groups.setdefault(req.tenant, []).append(req)
+        if len(groups) <= 1:
+            return  # zero or one tenant: FIFO is already fair
+        tenants = list(groups)
+        start = self._wfq_rotation % len(tenants)
+        tenants = tenants[start:] + tenants[:start]
+        self._wfq_rotation += 1
+        quantum = max(self.config.wfq_quantum_tokens, 1)
+        deficit = {t: 0.0 for t in tenants}
+        idx = {t: 0 for t in tenants}
+        order: list[Request] = []
+        remaining = sum(len(q) for q in groups.values())
+        while remaining > 0:
+            placed_this_round = 0
+            held: list[str] = []
+            for t in tenants:
+                q = groups[t]
+                i = idx[t]
+                if i >= len(q):
+                    continue
+                deficit[t] += quantum * q[i].priority
+                while i < len(q) and deficit[t] >= max(
+                        q[i].num_tokens, 1):
+                    deficit[t] -= max(q[i].num_tokens, 1)
+                    order.append(q[i])
+                    i += 1
+                    remaining -= 1
+                    placed_this_round += 1
+                idx[t] = i
+                if i < len(q):
+                    held.append(t)
+                else:
+                    # classic DRR: an emptied queue forfeits its
+                    # leftover deficit (no banking credit while idle)
+                    deficit[t] = 0.0
+            if placed_this_round:
+                for t in held:
+                    key = cap_tenant(t, self.wfq_deferred)
+                    self.wfq_deferred[key] = \
+                        self.wfq_deferred.get(key, 0) + 1
+            # a round that placed nothing still grew every deficit, so
+            # the loop always terminates (costs are finite)
+        self.waiting = resuming + order
 
     def queue_depth_by_tenant(self) -> dict[str, int]:
         """Waiting-queue depth split per tenant (request_queue_depth
@@ -352,6 +478,10 @@ class ARScheduler:
         out.unified = self.config.unified_batching
         out.kv_transfer_requests = self.drain_pending_kv_transfers()
         budget = self.config.max_num_batched_tokens
+        if self.config.wfq_scheduling and len(self.waiting) > 1:
+            # weighted-fair admission order: the loop below still pops
+            # waiting[0]; DRR just decides who stands there
+            self._wfq_order()
 
         # 1. running requests decode first (one token each) — prioritize
         #    latency of in-flight sequences, preempting the newest on OOM

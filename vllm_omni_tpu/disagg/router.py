@@ -287,6 +287,16 @@ class DisaggRouter:
                 logger.info("replica %s recovered; re-admitting",
                             r.replica_id)
             r.ejected = not healthy
+        self.refresh_gauges()
+
+    def refresh_gauges(self) -> None:
+        """Recompute ``router_healthy_replicas{role}`` and
+        ``degraded_mode`` from the CURRENT replica states — without
+        re-probing health.  Split out of the dispatch-path refresh so
+        pollers that never dispatch (the health prober, the control
+        plane's sensor tick) see live values: an idle or fully-
+        quiesced fleet used to show whatever the last dispatch left
+        behind."""
         for role, pool in ((ROLE_PREFILL, self.prefills),
                            (ROLE_DECODE, self.decodes)):
             if pool:
@@ -334,6 +344,98 @@ class DisaggRouter:
             if r.replica_id == replica_id:
                 return r
         raise KeyError(f"unknown replica {replica_id!r}")
+
+    # ---------------------------------------------- fleet actuation
+    # (the control plane's actuator family, docs/control_plane.md —
+    # called on the ROUTER THREAD only, like every other mutator here)
+    def set_role(self, replica_id: str, role: str) -> None:
+        """Live re-roling: flip a DRAINED, QUIESCED replica between the
+        prefill and decode tiers (drain -> quiesce -> flip -> undrain
+        is the caller's sequence; this is the flip).  The replica moves
+        pools, its engine re-arms/disarms the KV-transfer trigger
+        (LLMEngine.set_engine_role), and the prefill payload sink is
+        (un)wired.  The replica STAYS drained — re-admission is the
+        caller's explicit undrain, so a half-finished sequence never
+        accidentally takes traffic."""
+        if role not in (ROLE_PREFILL, ROLE_DECODE):
+            raise ValueError(
+                f"re-role target must be prefill|decode, got {role!r}")
+        r = self._replica(replica_id)
+        if r.dead:
+            raise RuntimeError(f"replica {replica_id} is dead")
+        if r.role == role:
+            return
+        if not (r.drained and r.quiesced):
+            raise RuntimeError(
+                f"replica {replica_id} must be drained and quiesced "
+                "before a role flip (in-flight streams survive the "
+                "drain; the flip itself must see an empty engine)")
+        flip = getattr(r.engine, "set_engine_role", None)
+        if flip is not None:
+            flip(role)
+        for pool in (self.prefills, self.decodes):
+            if r in pool:
+                pool.remove(r)
+        from_role = r.role
+        if role == ROLE_PREFILL:
+            self.prefills.append(r)
+            r.engine.kv_transfer_sink = self._kv_sink
+        else:
+            self.decodes.append(r)
+            r.engine.kv_transfer_sink = None
+        r.role = role
+        self.replicas = self.prefills + self.decodes
+        self._zero_gauge_if_emptied(from_role)
+        self.refresh_gauges()
+
+    def add_replica(self, replica: EngineReplica) -> None:
+        """Scale-up actuation: admit a freshly built replica into its
+        role's pool.  The caller decides when it takes traffic (a
+        cold replica typically enters DRAINED and is undrained after
+        its warmup window — the controller's cold-start model)."""
+        if any(r.replica_id == replica.replica_id
+               for r in self.replicas):
+            raise ValueError(
+                f"replica id {replica.replica_id!r} already exists")
+        if replica.role == ROLE_PREFILL:
+            self.prefills.append(replica)
+            replica.engine.kv_transfer_sink = self._kv_sink
+        else:
+            self.decodes.append(replica)
+        self.replicas = self.prefills + self.decodes
+        self.refresh_gauges()
+
+    def remove_replica(self, replica_id: str) -> EngineReplica:
+        """Scale-down actuation: remove a replica that is DEAD or
+        (drained and quiesced) — scale-down only ever happens via
+        drain, so no in-flight request is dropped.  Returns the removed
+        replica (the caller owns teardown)."""
+        r = self._replica(replica_id)
+        if not r.dead and not (r.drained and r.quiesced):
+            raise RuntimeError(
+                f"replica {replica_id} must be dead, or drained and "
+                "quiesced, before removal")
+        if len(self.replicas) <= 1:
+            raise RuntimeError(
+                "refusing to remove the last replica of the topology")
+        for pool in (self.prefills, self.decodes):
+            if r in pool:
+                pool.remove(r)
+        self.replicas = self.prefills + self.decodes
+        self._zero_gauge_if_emptied(r.role)
+        self.refresh_gauges()
+        return r
+
+    def _zero_gauge_if_emptied(self, role: str) -> None:
+        """An emptied pool's gauge must drop to 0 even though the
+        refresh loop skips empty pools (colocated topologies never
+        emit the absent tier's series — but a tier that EXISTED and
+        emptied, via removal OR a role flip, must not freeze its last
+        value on /metrics)."""
+        pool = self.prefills if role == ROLE_PREFILL else self.decodes
+        if not pool and role in (ROLE_PREFILL, ROLE_DECODE):
+            resilience_metrics.set_gauge(
+                "router_healthy_replicas", 0, role=role)
 
     # ------------------------------------------------------------ intake
     def submit(self, prompt_token_ids: list[int],

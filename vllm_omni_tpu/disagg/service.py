@@ -81,10 +81,18 @@ def build_inproc_router(params, model_cfg, base_config,
 
 
 class DisaggService:
-    """Async facade over a ``DisaggRouter`` (AsyncOmni-shaped)."""
+    """Async facade over a ``DisaggRouter`` (AsyncOmni-shaped).
 
-    def __init__(self, router: DisaggRouter):
+    ``controlplane``: an optional ``ControlPlane`` (docs/
+    control_plane.md).  Its decision thread only READS fleet state;
+    the mutations it emits are applied HERE, on the engine thread,
+    between router steps (``controlplane.actuate``) — the router stays
+    single-threaded.  The service starts the controller's thread and
+    stops it at shutdown."""
+
+    def __init__(self, router: DisaggRouter, controlplane=None):
         self.router = router
+        self.controlplane = controlplane
         self._intake: queue.Queue = queue.Queue()
         self._req_counter = itertools.count()
         self._streams: dict[str, tuple[asyncio.AbstractEventLoop,
@@ -94,10 +102,14 @@ class DisaggService:
                                         daemon=True,
                                         name="disagg-engine")
         self._thread.start()
+        if controlplane is not None:
+            controlplane.start()
 
     # ----------------------------------------------------------- lifecycle
     def shutdown(self) -> None:
         self._running = False
+        if self.controlplane is not None:
+            self.controlplane.stop()
         self._thread.join(timeout=10)
 
     @property
@@ -188,6 +200,15 @@ class DisaggService:
                 # escape here is a bug — log it and keep serving (the
                 # same stance as AsyncOmni's per-stage poll guard)
                 logger.exception("router step failed; continuing")
+            if self.controlplane is not None:
+                try:
+                    # apply the controller's pending intents ON THIS
+                    # thread — the only one allowed to mutate the
+                    # router (drain/flip/scale are router mutations)
+                    self.controlplane.actuate(router)
+                except Exception:
+                    logger.exception(
+                        "controlplane actuation failed; continuing")
             for out in router.poll():
                 self._emit(out.request_id, out)
                 self._emit(out.request_id, _SENTINEL)

@@ -162,6 +162,16 @@ class EngineConfig:
     # shed arrivals whose remaining deadline is below this floor
     # (reason="deadline_headroom"); 0.0 disables
     admission_deadline_headroom_s: float = 0.0
+    # weighted-fair overload scheduling (docs/control_plane.md): order
+    # waiting-queue admission by per-tenant deficit round robin keyed
+    # on Request.priority (the sanitized x-omni-priority metadata) and
+    # make the max_queue_depth shed priority-ordered — under overload
+    # low-priority work defers or sheds instead of everyone starving
+    # equally.  Off keeps strict arrival order
+    wfq_scheduling: bool = False
+    # DRR quantum per unit of priority weight, in prompt tokens (the
+    # tenant-interleave granularity; see core/scheduler.py)
+    wfq_quantum_tokens: int = 256
     seed: Optional[int] = None  # pins sampling entropy for reproducibility
     # tensor parallelism over the first N devices (reference:
     # tensor_parallel_size, stage_configs/qwen3_omni_moe.yaml:27)
@@ -263,6 +273,8 @@ class LLMEngine:
             max_queue_depth=config.max_queue_depth,
             admission_deadline_headroom_s=(
                 config.admission_deadline_headroom_s),
+            wfq_scheduling=config.wfq_scheduling,
+            wfq_quantum_tokens=config.wfq_quantum_tokens,
         )
         if config.multi_step_decode > 1:
             logger.warning(
@@ -630,6 +642,41 @@ class LLMEngine:
         return (self.scheduler.has_unfinished
                 or self.scheduler.has_pending_errored)
 
+    # ----------------------------------------------------------- re-roling
+    def set_engine_role(self, role: str) -> None:
+        """Flip a QUIESCED engine's disaggregated-serving role
+        (docs/control_plane.md live re-roling): prefill arms the
+        prefill_finished KV-transfer trigger, decode/colocated disarm
+        it.  The same compiled executables serve every role — a role is
+        scheduler policy plus transfer arming, never a recompile — so a
+        flip is O(host state).  Refused while requests are in flight:
+        an armed/disarmed trigger changing under a live request would
+        split its stream across transfer regimes (the caller drains
+        first — the router's drain -> quiesce -> flip sequence)."""
+        if role not in ("prefill", "decode", "colocated"):
+            raise ValueError(
+                f"engine_role must be prefill|decode|colocated, got "
+                f"{role!r}")
+        if self.has_unfinished_requests:
+            raise RuntimeError(
+                "cannot re-role an engine with unfinished requests; "
+                "drain it first (router.drain -> quiesced)")
+        if role == self.config.engine_role:
+            return
+        if (self.config.engine_role == "colocated"
+                and self.config.kv_transfer is not None):
+            # a colocated engine whose transfer trigger serves the
+            # CROSS-STAGE pipeline (thinker -> talker) is not a disagg
+            # tier: flipping it would silently unhook the next stage
+            raise RuntimeError(
+                "refusing to re-role an engine with a cross-stage "
+                "kv_transfer config")
+        kv_cfg = (KVTransferConfig(trigger="prefill_finished")
+                  if role == "prefill" else None)
+        self.config = dataclasses.replace(
+            self.config, engine_role=role, kv_transfer=kv_cfg)
+        self.scheduler.config.kv_transfer = kv_cfg
+
     # ---------------------------------------------------------------- step
     @property
     def prefix_cache_stats(self) -> dict:
@@ -797,6 +844,11 @@ class LLMEngine:
             for (reason, tenant), n in sorted(
                 self.scheduler.shed_counts.items())
         }
+        # weighted-fair queueing deferral ledger (only the AR
+        # scheduler keeps one; wfq_deferred_requests_total on /metrics)
+        wfq = getattr(self.scheduler, "wfq_deferred", None)
+        if wfq:
+            snap["wfq"] = {"deferred_by_tenant": dict(wfq)}
         snap["kv"] = {
             "pages_total": kv.num_pages,
             "pages_used": used,

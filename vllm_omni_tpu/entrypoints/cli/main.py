@@ -85,6 +85,15 @@ def _add_common(p: argparse.ArgumentParser):
                           "(shed_requests_total{reason=queue_depth}) "
                           "instead of queued into a wait they can only "
                           "lose")
+    eng.add_argument("--wfq-scheduling", action="store_true",
+                     default=None,
+                     help="weighted-fair overload scheduling (docs/"
+                          "control_plane.md): deficit-round-robin "
+                          "admission over per-tenant priority weights "
+                          "(x-omni-priority) and priority-ordered "
+                          "shedding at max-queue-depth — low-priority "
+                          "work defers under overload instead of "
+                          "everyone starving equally")
     eng.add_argument("--engine-role", default=None,
                      choices=("prefill", "decode", "colocated"),
                      help="disaggregated serving role (docs/"
@@ -125,7 +134,7 @@ _ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
                 "kv_offload", "kv_offload_quant", "kv_offload_policy",
                 "kv_host_tier_bytes", "kv_offload_connector",
                 "slo_ttft_ms", "slo_tpot_ms", "max_queue_depth",
-                "engine_role", "deterministic_decode")
+                "wfq_scheduling", "engine_role", "deterministic_decode")
 
 
 def _stage_overrides(args) -> dict:

@@ -291,13 +291,24 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         return True
 
     def _tenant_info(self) -> dict:
-        """Per-tenant metrics attribution: the ``x-omni-tenant`` header
-        rides request metadata (additional_information["tenant"]) into
-        the engine, labeling the SLO/goodput/queue-depth series on
-        /metrics so fleet dashboards can split the serving curve per
-        tenant (docs/load_testing.md)."""
+        """Per-tenant metrics attribution + WFQ weight: the
+        ``x-omni-tenant`` header rides request metadata
+        (additional_information["tenant"]) into the engine, labeling
+        the SLO/goodput/queue-depth series on /metrics so fleet
+        dashboards can split the serving curve per tenant
+        (docs/load_testing.md); ``x-omni-priority`` rides alongside it
+        into ``Request.priority`` — the deficit-round-robin weight of
+        the WFQ overload scheduler (docs/control_plane.md).  Both are
+        CLIENT input: sanitized/clamped at the Request property, never
+        trusted here."""
+        info = {}
         tenant = self.headers.get("x-omni-tenant")
-        return {"tenant": tenant} if tenant else {}
+        if tenant:
+            info["tenant"] = tenant
+        priority = self.headers.get("x-omni-priority")
+        if priority:
+            info["priority"] = priority
+        return info
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
@@ -400,6 +411,12 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             # health/drain state, in-flight request phases, failover
             # ledger; {"enabled": false} on non-disagg deployments
             return self._json(200, debugz.debug_disagg(omni),
+                              default=str)
+        if path == "/debug/controlplane":
+            # control-plane view (docs/control_plane.md): sensors,
+            # in-flight re-role/scale operation, action ring;
+            # {"enabled": false} on uncontrolled deployments
+            return self._json(200, debugz.debug_controlplane(omni),
                               default=str)
         return self._error(404, f"unknown debug path {path}; "
                            f"see /debug/z")

@@ -32,7 +32,7 @@ from vllm_omni_tpu.introspection.flight_recorder import capture_stacks
 
 ENDPOINTS = ("/debug/engine", "/debug/requests", "/debug/kv",
              "/debug/flightrecorder", "/debug/stacks", "/debug/watchdog",
-             "/debug/disagg")
+             "/debug/disagg", "/debug/controlplane")
 
 
 # -------------------------------------------------------- request table
@@ -232,6 +232,22 @@ def debug_disagg(omni) -> dict:
         return {"enabled": False}
     try:
         return router.debug_snapshot()
+    except Exception as e:
+        # same stance as _per_stage: a torn concurrent read degrades
+        # to a retry marker, never a 500 on the debugging request
+        return {"enabled": True, "error": repr(e), "retry": True}
+
+
+def debug_controlplane(omni) -> dict:
+    """Control-plane state (docs/control_plane.md): the sensor
+    snapshot, the in-flight operation's stage, warming replicas, and
+    the structured-action ring.  ``{"enabled": False}`` on deployments
+    without a controller — the endpoint always answers."""
+    cp = getattr(omni, "controlplane", None)
+    if cp is None:
+        return {"enabled": False}
+    try:
+        return cp.debug_snapshot()
     except Exception as e:
         # same stance as _per_stage: a torn concurrent read degrades
         # to a retry marker, never a 500 on the debugging request

@@ -13,7 +13,9 @@ from vllm_omni_tpu.loadgen.workload import (  # noqa: F401
     LoadRequest,
     Scenario,
     build_workload,
+    burst_arrivals,
     default_catalog,
+    diurnal_arrivals,
     poisson_arrivals,
     trace_replay_arrivals,
 )

@@ -243,8 +243,11 @@ def run_inproc(omni, workload: Sequence[LoadRequest],
             request_id=lr.request_id, tenant=lr.tenant,
             scenario=lr.scenario, arrival_s=lr.at_s,
             fired_s=time.monotonic() - t0)
+        info = {"tenant": lr.tenant}
+        if lr.priority is not None:
+            info["priority"] = lr.priority
         prompt = {"prompt_token_ids": list(lr.prompt_token_ids),
-                  "additional_information": {"tenant": lr.tenant}}
+                  "additional_information": info}
         sp = {"max_tokens": lr.max_tokens, "temperature": temperature,
               "ignore_eos": True}
         failed = None
@@ -327,6 +330,9 @@ def _http_one(base_url: str, lr: LoadRequest, t0: float,
     rec = RequestRecord(
         request_id=lr.request_id, tenant=lr.tenant, scenario=lr.scenario,
         arrival_s=lr.at_s, fired_s=time.monotonic() - t0)
+    headers = {"x-omni-tenant": lr.tenant}
+    if lr.priority is not None:
+        headers["x-omni-priority"] = str(lr.priority)
     res = chat_http_request(base_url, {
         "model": "loadgen",
         "messages": [{"role": "user", "content": lr.prompt}],
@@ -337,7 +343,7 @@ def _http_one(base_url: str, lr: LoadRequest, t0: float,
         # token count to BE max_tokens
         "ignore_eos": True,
         "stream": bool(lr.stream),
-    }, headers={"x-omni-tenant": lr.tenant}, timeout_s=timeout_s)
+    }, headers=headers, timeout_s=timeout_s)
     rec.end_s = res["end_mono"] - t0
     if res["first_event_mono"] is not None:
         rec.first_s = res["first_event_mono"] - t0

@@ -15,10 +15,19 @@ flatters an overloaded server by self-throttling):
   rate, the standard model for a large independent user population.
 - ``trace_replay_arrivals``: replay explicit offsets (production logs,
   adversarial bursts), optionally time-scaled to sweep rates.
+- ``diurnal_arrivals``: sinusoid-modulated Poisson — the compressed
+  day/night cycle the control plane (docs/control_plane.md) must track:
+  offered load swings around the mean, so any STATIC topology is wrong
+  for part of the period.
+- ``burst_arrivals``: on/off MMPP-style bursts — alternating
+  exponentially-distributed ON (high-rate) and OFF (low-rate) phases,
+  the adversarial shape for admission control and autoscaling
+  (cold-start cost means a controller that chases every burst flaps).
 """
 
 from __future__ import annotations
 
+import math
 import random
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -42,6 +51,10 @@ class Scenario:
     shared_prefix_len: int = 0
     stream: bool = False
     tenant: Optional[str] = None  # None -> the workload-level default
+    # client priority/weight (x-omni-priority): None -> the neutral
+    # weight (metrics/stats.py DEFAULT_PRIORITY), so catalogs that
+    # never set it generate exactly the traffic they always did
+    priority: Optional[int] = None
 
 
 def default_catalog() -> list[Scenario]:
@@ -73,6 +86,9 @@ class LoadRequest:
     prompt_token_ids: list[int] = field(default_factory=list)
     max_tokens: int = 16
     stream: bool = False
+    # weighted-fair-queueing priority (None = neutral): run_inproc
+    # stamps it into request metadata, run_http into x-omni-priority
+    priority: Optional[int] = None
 
     @property
     def prompt(self) -> str:
@@ -120,6 +136,85 @@ def trace_replay_arrivals(offsets: Sequence[float],
     return out
 
 
+def diurnal_arrivals(rate_rps: float, num_requests: int,
+                     period_s: float = 60.0, amplitude: float = 0.8,
+                     seed: int = 0, phase: float = 0.0) -> list[float]:
+    """``num_requests`` offsets from a sinusoid-modulated Poisson
+    process: instantaneous rate ``rate_rps * (1 + amplitude *
+    sin(2*pi*t/period_s + phase))`` — a compressed diurnal cycle whose
+    prefill:decode pressure mix shifts over the period.  Generated by
+    Lewis-Shedler thinning against the peak rate, so the draws stay
+    bit-deterministic per seed regardless of the modulation shape.
+    ``amplitude`` in [0, 1): 0 degenerates to plain Poisson."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if not 0.0 <= amplitude < 1.0:
+        raise ValueError(
+            f"amplitude must be in [0, 1), got {amplitude}")
+    if period_s <= 0:
+        raise ValueError(f"period_s must be > 0, got {period_s}")
+    rng = random.Random(seed)
+    lam_max = rate_rps * (1.0 + amplitude)
+    t = 0.0
+    out: list[float] = []
+    while len(out) < max(int(num_requests), 0):
+        t += rng.expovariate(lam_max)
+        lam_t = rate_rps * (1.0 + amplitude
+                            * math.sin(2.0 * math.pi * t / period_s
+                                       + phase))
+        # thinning: accept with prob lambda(t)/lambda_max.  The draw
+        # happens on EVERY candidate so the accept stream stays aligned
+        # with the seed regardless of where the sinusoid sits
+        if rng.random() * lam_max <= lam_t:
+            out.append(t)
+    return out
+
+
+def burst_arrivals(base_rps: float, burst_rps: float,
+                   num_requests: int, mean_on_s: float = 5.0,
+                   mean_off_s: float = 15.0, seed: int = 0
+                   ) -> list[float]:
+    """``num_requests`` offsets from an on/off MMPP-style process:
+    exponentially-distributed ON phases (mean ``mean_on_s``) arriving
+    at ``burst_rps`` alternate with OFF phases (mean ``mean_off_s``)
+    at ``base_rps`` — quiet background traffic punctured by bursts the
+    controller must absorb without flapping.  ``base_rps`` may be 0
+    (silent troughs).  Seeded and bit-deterministic."""
+    if burst_rps <= 0:
+        raise ValueError(f"burst_rps must be > 0, got {burst_rps}")
+    if base_rps < 0:
+        raise ValueError(f"base_rps must be >= 0, got {base_rps}")
+    if mean_on_s <= 0 or mean_off_s <= 0:
+        raise ValueError("mean_on_s and mean_off_s must be > 0")
+    rng = random.Random(seed)
+    out: list[float] = []
+    t = 0.0          # current time
+    on = False       # start in the OFF (background) phase
+    phase_end = rng.expovariate(1.0 / mean_off_s)
+    while len(out) < max(int(num_requests), 0):
+        rate = burst_rps if on else base_rps
+        if rate <= 0:
+            # silent phase: jump to its end
+            t = phase_end
+            on = not on
+            phase_end = t + rng.expovariate(
+                1.0 / (mean_on_s if on else mean_off_s))
+            continue
+        gap = rng.expovariate(rate)
+        if t + gap >= phase_end:
+            # the next arrival would land past the phase boundary:
+            # advance to the boundary and flip phase (memorylessness
+            # makes discarding the partial gap distribution-correct)
+            t = phase_end
+            on = not on
+            phase_end = t + rng.expovariate(
+                1.0 / (mean_on_s if on else mean_off_s))
+            continue
+        t += gap
+        out.append(t)
+    return out
+
+
 def build_workload(
     arrivals: Sequence[float],
     catalog: Optional[Sequence[Scenario]] = None,
@@ -127,10 +222,13 @@ def build_workload(
     vocab_size: int = 32000,
     tenants: Sequence[str] = ("default",),
     id_prefix: str = "load",
+    tenant_priorities: Optional[dict] = None,
 ) -> list[LoadRequest]:
     """Bind one scenario + concrete prompt/output draws to every
     arrival offset.  ``tenants`` round-robins across requests unless a
-    scenario pins its own tenant.  Deterministic per (arrivals order,
+    scenario pins its own tenant.  ``tenant_priorities`` maps tenant ->
+    WFQ priority (a scenario's own ``priority`` wins; unmapped tenants
+    stay at the neutral weight).  Deterministic per (arrivals order,
     catalog, seed, vocab_size, tenants)."""
     catalog = list(catalog if catalog is not None else default_catalog())
     if not catalog:
@@ -154,6 +252,9 @@ def build_workload(
         toks = list(prefixes.get(sc.name, ()))
         toks += [rng.randrange(1, vocab_size) for _ in range(n_prompt)]
         tenant = sc.tenant or tenants[i % len(tenants)]
+        priority = sc.priority
+        if priority is None and tenant_priorities:
+            priority = tenant_priorities.get(tenant)
         out.append(LoadRequest(
             at_s=float(at_s),
             request_id=f"{id_prefix}-{i}",
@@ -162,5 +263,6 @@ def build_workload(
             prompt_token_ids=toks,
             max_tokens=n_out,
             stream=sc.stream,
+            priority=priority,
         ))
     return out

@@ -284,6 +284,25 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "gauge",
         "Whether the router is serving colocated because a tier has "
         "zero healthy replicas (1 = degraded)", ()),
+    # ---- control plane (vllm_omni_tpu/controlplane/,
+    # docs/control_plane.md) — re-role/autoscale actuation ledger,
+    # fleet shape, and the WFQ scheduler's deferral accounting
+    "controlplane_reroles_total": (
+        "counter",
+        "Completed live role flips (drain -> quiesce -> flip -> "
+        "re-admit) per direction", ("from_role", "to_role")),
+    "controlplane_replicas": (
+        "gauge", "Non-dead replicas per role, as the controller sees "
+        "the fleet", ("role",)),
+    "controlplane_actions_total": (
+        "counter",
+        "Control-plane actions applied on the router thread (drain, "
+        "undrain, rerole, scale_up, remove_replica)", ("action",)),
+    "wfq_deferred_requests_total": (
+        "counter",
+        "Deficit-round-robin rounds that held a tenant's head-of-line "
+        "request back while placing other work (weighted-fair overload "
+        "scheduling)", ("stage", "tenant")),
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -497,6 +516,14 @@ def render_exposition(summary: dict, engine_snaps: dict,
             exp.sample("shed_requests_total",
                        {**labels, "reason": reason,
                         "tenant": tenant or "default"}, n)
+        # WFQ deferral ledger (docs/control_plane.md): rounds a
+        # tenant's head-of-line request waited behind other tenants
+        wfq = snap.get("wfq")
+        if wfq:
+            for tenant, n in sorted(
+                    (wfq.get("deferred_by_tenant") or {}).items()):
+                exp.sample("wfq_deferred_requests_total",
+                           {**labels, "tenant": tenant}, n)
         slo = snap.get("slo")
         if slo:
             for tenant, st in sorted((slo.get("tenants") or {}).items()):

@@ -187,6 +187,40 @@ def cap_tenant(tenant: str, known: "set[str] | dict") -> str:
     return OVERFLOW_TENANT
 
 
+# weighted-fair-queueing priority (docs/control_plane.md): an integer
+# weight in [MIN_PRIORITY, MAX_PRIORITY] — a priority-8 tenant gets 8x
+# a priority-1 tenant's share of the admission quantum under overload.
+# DEFAULT_PRIORITY is the neutral weight every request without explicit
+# metadata gets, so deployments that never send x-omni-priority keep
+# exact FCFS-equivalent behavior (equal weights degenerate DRR to
+# round-robin over tenants).
+MIN_PRIORITY = 1
+MAX_PRIORITY = 8
+DEFAULT_PRIORITY = 4
+
+
+def sanitize_priority(raw) -> int:
+    """Client priority -> bounded int weight: parsed leniently (ints,
+    numeric strings, floats truncate), clamped to
+    [MIN_PRIORITY, MAX_PRIORITY]; anything unparseable or missing ->
+    DEFAULT_PRIORITY.  CLIENT input (the x-omni-priority header) — it
+    must never raise and never exceed the clamp, exactly the
+    hostile-input stance of ``sanitize_tenant``."""
+    if raw is None:
+        return DEFAULT_PRIORITY
+    try:
+        f = float(str(raw).strip())
+    except (TypeError, ValueError):
+        return DEFAULT_PRIORITY
+    if f != f:  # NaN parses as a float but orders with nothing
+        return DEFAULT_PRIORITY
+    # clamp in FLOAT space before truncating: "inf"/"1e400" parse fine
+    # and int() on an infinity raises OverflowError — an out-of-range
+    # value must clamp, never raise (one hostile header would
+    # otherwise crash schedule() for every tenant)
+    return int(max(float(MIN_PRIORITY), min(float(MAX_PRIORITY), f)))
+
+
 @dataclass
 class TenantSLOStats:
     """Per-tenant SLO attainment + goodput accounting over finished

@@ -146,6 +146,26 @@ class Request:
 
         return sanitize_tenant(self.additional_information.get("tenant"))
 
+    # lazily cached sanitized priority (the WFQ scheduler reads it in
+    # per-schedule loops; re-parsing the raw header per access would be
+    # avoidable hot-path work)
+    _priority_cache: Optional[int] = field(default=None, repr=False)
+
+    @property
+    def priority(self) -> int:
+        """Weighted-fair-queueing weight, plumbed from request metadata
+        (OpenAI header ``x-omni-priority`` ->
+        additional_information["priority"]); the neutral weight when
+        absent.  CLIENT input: clamped to the bounded priority range
+        exactly like the tenant label is sanitized.  Cached on first
+        read — metadata is fixed by the time scheduling reads it."""
+        if self._priority_cache is None:
+            from vllm_omni_tpu.metrics.stats import sanitize_priority
+
+            self._priority_cache = sanitize_priority(
+                self.additional_information.get("priority"))
+        return self._priority_cache
+
     @property
     def num_prompt_tokens(self) -> int:
         return len(self.prompt_token_ids)
