@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""perfguard: the perf-regression gate over BENCH_*.json artifacts.
+
+BENCH files accumulated for 12 PRs with no tool that reads two of them
+— regressions were caught by vibes.  This script loads any two bench
+artifacts, extracts every comparable performance surface through a
+schema-versioned extractor, prints a delta table, and exits nonzero
+past a configurable regression threshold:
+
+    python scripts/perfguard.py BENCH_r12.json BENCH_new.json
+    python scripts/perfguard.py old.json new.json --threshold 0.15
+
+Known artifact shapes (the extractor walks recursively, so nesting
+under ``parsed`` / ``secondary_metrics`` / variant blocks is handled):
+
+- ``serving_curve`` lists (loadgen ``summarize`` points: r11/r12 and
+  ``OMNI_BENCH_SERVING=1`` runs) — keyed by (path, offered_rps[, the
+  point's ``topology``]); goodput / attainment / p99 latencies gate.
+- scalar records (diffusion flagship and variants): ``mfu``,
+  ``seconds_per_image``.
+
+Exit codes: 0 = no regression beyond threshold; 1 = regression;
+2 = schema mismatch (no comparable surface between the two files).
+
+``--emit-guard-curve OUT.json`` writes a seed-deterministic in-proc
+serving curve (the loadgen virtual-time simulator — bit-identical
+across machines, zero wall-clock) so CI can own the trajectory:
+``scripts/perfguard.sh`` regenerates it and compares against the
+committed ``BENCH_guard_baseline.json``; any change to the admission /
+goodput / summarize math shows up as a nonzero delta there, gated at a
+tight threshold, while honest cross-run comparisons of real bench
+artifacts use the default (looser) threshold.
+
+Stdlib-only for the compare paths — safe in any CI lane; only the
+guard-curve emitter imports ``vllm_omni_tpu.loadgen`` (numpy-free,
+jax-free).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: required keys for a list entry to count as a serving-curve point
+#: (mirrors loadgen.runner.CURVE_POINT_KEYS minus derived sub-dicts —
+#: duplicated here so the compare path stays stdlib-only)
+_POINT_KEYS = ("offered_rps", "goodput_tok_per_s", "slo_attainment")
+
+#: gated metrics: name -> (+1 higher-is-better | -1 lower-is-better)
+GATED_CURVE_METRICS = {
+    "goodput_tok_per_s": +1,
+    "attained_tok_per_s": +1,
+    "slo_attainment": +1,
+    "ttft_p99_ms": -1,
+    "tpot_p99_ms": -1,
+    "e2e_p99_ms": -1,
+    "mfu": +1,
+}
+GATED_SCALAR_METRICS = {
+    "mfu": +1,
+    "seconds_per_image": -1,
+}
+
+SCHEMA = "perfguard/1"
+
+
+# ------------------------------------------------------------ extraction
+def _looks_like_curve(val) -> bool:
+    return (isinstance(val, list) and val
+            and all(isinstance(p, dict) for p in val)
+            and all(all(k in p for k in _POINT_KEYS) for p in val))
+
+
+def _point_metrics(p: dict) -> dict:
+    out = {}
+    for k in ("goodput_tok_per_s", "attained_tok_per_s",
+              "slo_attainment"):
+        if isinstance(p.get(k), (int, float)):
+            out[k] = float(p[k])
+    for lat in ("ttft_ms", "tpot_ms", "e2e_ms"):
+        sub = p.get(lat)
+        if isinstance(sub, dict) and isinstance(sub.get("p99"),
+                                                (int, float)):
+            out[f"{lat[:-3]}_p99_ms"] = float(sub["p99"])
+    if isinstance(p.get("mfu"), (int, float)):
+        out["mfu"] = float(p["mfu"])
+    return out
+
+
+def extract(doc) -> dict:
+    """Walk one bench artifact; returns
+    {"schema", "points": {key: {metric: value}},
+     "scalars": {key: {metric: value}}} — empty maps when the file has
+    no recognizable performance surface."""
+    points: dict[str, dict] = {}
+    scalars: dict[str, dict] = {}
+
+    def walk(node, path: str) -> None:
+        if isinstance(node, dict):
+            curve = node.get("serving_curve")
+            if _looks_like_curve(curve):
+                for p in curve:
+                    key = f"{path}serving_curve@rps={p['offered_rps']}"
+                    if p.get("topology"):
+                        key += f",topo={p['topology']}"
+                    points[key] = _point_metrics(p)
+            sc = {}
+            for k in GATED_SCALAR_METRICS:
+                if isinstance(node.get(k), (int, float)):
+                    sc[k] = float(node[k])
+            if sc and "serving_curve" not in node:
+                scalars[path.rstrip("/") or "."] = sc
+            for k, v in node.items():
+                if k == "serving_curve":
+                    continue
+                walk(v, f"{path}{k}/")
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f"{path}{i}/")
+
+    walk(doc, "")
+    return {"schema": SCHEMA, "points": points, "scalars": scalars}
+
+
+# ------------------------------------------------------------ comparison
+def _rel_delta(base: float, new: float, direction: int) -> float:
+    """Signed relative change where NEGATIVE = regression, regardless
+    of metric direction.  Ratio-like metrics near zero (attainment,
+    mfu) still behave: the denominator floors at a small epsilon."""
+    denom = max(abs(base), 1e-9)
+    change = (new - base) / denom
+    return change * direction
+
+
+def compare(base: dict, new: dict, threshold: float
+            ) -> tuple[list, list, list]:
+    """Returns (rows, regressions, missing).  Each row:
+    (surface, metric, base, new, signed_delta_frac, gated).
+
+    ``missing`` lists every baseline surface/metric ABSENT from the
+    new artifact — a bench that stopped emitting a point (crashed leg,
+    dropped field) must be disclosed, never silently un-gated; under
+    ``--strict`` (the deterministic CI leg) it fails the gate."""
+    rows, regressions, missing = [], [], []
+    for section, gated in (("points", GATED_CURVE_METRICS),
+                           ("scalars", GATED_SCALAR_METRICS)):
+        for key in sorted(set(base[section]) - set(new[section])):
+            missing.append(f"{section}: {key} (whole surface)")
+        for key in sorted(set(base[section]) & set(new[section])):
+            b, n = base[section][key], new[section][key]
+            for metric in sorted(set(b) - set(n)):
+                if metric in gated:
+                    missing.append(f"{section}: {key} {metric}")
+            for metric in sorted(set(b) & set(n)):
+                direction = gated.get(metric)
+                if direction is None:
+                    continue
+                d = _rel_delta(b[metric], n[metric], direction)
+                regressed = d < -threshold
+                rows.append((key, metric, b[metric], n[metric], d,
+                             regressed))
+                if regressed:
+                    regressions.append((key, metric, b[metric],
+                                        n[metric], d))
+    return rows, regressions, missing
+
+
+def render_table(rows: list, threshold: float) -> str:
+    lines = [f"{'surface':56s} {'metric':20s} {'base':>12s} "
+             f"{'new':>12s} {'delta':>8s}"]
+    for key, metric, b, n, d, regressed in rows:
+        flag = " REGRESSED" if regressed else ""
+        lines.append(f"{key[:56]:56s} {metric:20s} {b:12.4f} "
+                     f"{n:12.4f} {d * 100:+7.1f}%{flag}")
+    lines.append(f"(negative delta = worse; gate at "
+                 f"-{threshold * 100:.0f}%)")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------- deterministic guard curve
+def emit_guard_curve(out_path: str) -> None:
+    """Write the seed-deterministic in-proc serving curve: the loadgen
+    virtual-time simulator over a seeded Poisson workload — bit-exact
+    across machines, so CI compares it against the committed baseline
+    at a tight threshold.  Constants are part of the contract: change
+    them and the baseline must be regenerated IN THE SAME COMMIT."""
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from vllm_omni_tpu.loadgen import (
+        SLOTargets,
+        build_workload,
+        default_catalog,
+        poisson_arrivals,
+        simulate,
+        summarize,
+    )
+
+    slo = SLOTargets(ttft_ms=2000.0, tpot_ms=500.0)
+    curve = []
+    for i, rate in enumerate((2.0, 8.0, 32.0)):
+        arrivals = poisson_arrivals(rate, num_requests=64,
+                                    seed=1300 + i)
+        wl = build_workload(arrivals, default_catalog(), seed=2300 + i,
+                            vocab_size=2000,
+                            tenants=["tenant_a", "tenant_b"],
+                            id_prefix=f"guard{i}")
+        records = simulate(wl, prefill_s=0.05, per_token_s=0.01,
+                           servers=4, queue_limit=32)
+        curve.append(summarize(records, rate, slo))
+    doc = {"bench": "perfguard_deterministic_curve",
+           "note": "virtual-time simulator; bit-deterministic — any "
+                   "delta vs the committed baseline is a code change "
+                   "in the admission/goodput/summarize math",
+           "serving_curve": curve}
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"guard curve written to {out_path}")
+
+
+# ------------------------------------------------------------------ main
+def run(base_path: str, new_path: str, threshold: float,
+        strict: bool = False) -> int:
+    try:
+        with open(base_path) as f:
+            base_doc = json.load(f)
+        with open(new_path) as f:
+            new_doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perfguard: cannot load artifacts: {e}", file=sys.stderr)
+        return 2
+    base = extract(base_doc)
+    new = extract(new_doc)
+    for name, ex in ((base_path, base), (new_path, new)):
+        if not ex["points"] and not ex["scalars"]:
+            print(f"perfguard: {name}: no comparable performance "
+                  "surface (schema mismatch?)", file=sys.stderr)
+            return 2
+    rows, regressions, missing = compare(base, new, threshold)
+    if not rows:
+        print("perfguard: the two artifacts share no comparable "
+              "surface (different benches?)", file=sys.stderr)
+        return 2
+    print(render_table(rows, threshold))
+    if missing:
+        # disclosed always; gated only under --strict (honest cross-PR
+        # comparisons legitimately add/retire rate points — the
+        # deterministic CI leg must not)
+        print(f"\nperfguard: {len(missing)} baseline surface(s) "
+              "absent from the new artifact (NOT gated"
+              + (" -> strict: REGRESSION" if strict else "") + "):",
+              file=sys.stderr)
+        for m in missing:
+            print(f"  missing {m}", file=sys.stderr)
+        if strict:
+            return 1
+    if regressions:
+        print(f"\nperfguard: {len(regressions)} regression(s) beyond "
+              f"{threshold * 100:.0f}%:", file=sys.stderr)
+        for key, metric, b, n, d in regressions:
+            print(f"  {key} {metric}: {b:.4f} -> {n:.4f} "
+                  f"({d * 100:+.1f}%)", file=sys.stderr)
+        return 1
+    print("\nperfguard: no regression beyond threshold")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base", nargs="?", help="baseline BENCH_*.json")
+    ap.add_argument("new", nargs="?", help="candidate BENCH_*.json")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="relative regression gate (default 0.2 = 20%% "
+                         "— bench noise across machines is real; the "
+                         "deterministic guard curve uses a tight one)")
+    ap.add_argument("--emit-guard-curve", metavar="OUT",
+                    help="write the seed-deterministic simulator curve "
+                         "and exit")
+    ap.add_argument("--strict", action="store_true",
+                    help="treat baseline surfaces/metrics missing from "
+                         "the new artifact as regressions (the "
+                         "deterministic CI leg)")
+    args = ap.parse_args(argv)
+    if args.emit_guard_curve:
+        emit_guard_curve(args.emit_guard_curve)
+        return 0
+    if not args.base or not args.new:
+        ap.error("need BASE and NEW artifacts (or --emit-guard-curve)")
+    return run(args.base, args.new, args.threshold, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

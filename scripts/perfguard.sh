@@ -1,0 +1,40 @@
+#!/usr/bin/env sh
+# perfguard gate: CI owns the performance trajectory.
+#
+# Two legs, mirroring scripts/loadgen.sh as a standalone pre-merge
+# gate:
+#
+#   1. the perfguard unit tier (tests/benchmarks/test_perfguard.py):
+#      extractor over every known BENCH_*.json shape, the delta/gate
+#      math on hand-built pass / regress / schema-mismatch fixtures,
+#      and the live comparison against the repo's own artifacts.
+#   2. the deterministic trajectory check: regenerate the virtual-time
+#      guard curve (seeded Poisson workload through the loadgen
+#      simulator — bit-identical across machines, zero wall-clock) and
+#      compare it against the committed BENCH_guard_baseline.json at a
+#      TIGHT threshold.  Any change to the admission / goodput /
+#      summarize math shows up as a delta here and fails the gate; a
+#      deliberate change regenerates the baseline in the same commit:
+#
+#          python scripts/perfguard.py --emit-guard-curve \
+#              BENCH_guard_baseline.json
+#
+# Usage:
+#   scripts/perfguard.sh                    # the whole gate
+#   scripts/perfguard.sh -k regress         # pass-through pytest args
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU defensively: the compare paths are stdlib-only and the
+# guard-curve emitter imports only the (jax-free) loadgen package, but
+# the pytest leg must never touch a real chip a serving process owns
+env JAX_PLATFORMS=cpu python -m pytest \
+    tests/benchmarks/test_perfguard.py \
+    -q -p no:cacheprovider -m "not slow" "$@"
+
+# no exec on the final leg: POSIX sh does not run EXIT traps across
+# exec, which would leak one temp curve per gate run
+tmp="$(mktemp /tmp/perfguard_curve.XXXXXX.json)"
+trap 'rm -f "$tmp"' EXIT
+python scripts/perfguard.py --emit-guard-curve "$tmp" >/dev/null
+python scripts/perfguard.py BENCH_guard_baseline.json "$tmp" \
+    --threshold 0.01 --strict

@@ -1,0 +1,200 @@
+"""perfguard: the BENCH_*.json regression gate (scripts/perfguard.py).
+
+Covers the schema-versioned extractor over every artifact shape the
+repo has actually accumulated (top-level serving_curve, topology-keyed
+r12 points, wrapper/parsed scalar records), the delta/gate math on
+hand-built pass / regress / schema-mismatch fixtures, and the
+deterministic guard curve's bit-stability."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", ".."))
+
+
+def _load_perfguard():
+    path = os.path.join(REPO, "scripts", "perfguard.py")
+    spec = importlib.util.spec_from_file_location("perfguard", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def pg():
+    return _load_perfguard()
+
+
+def _point(rps, goodput, ttft_p99, attainment=1.0, topology=None):
+    p = {
+        "offered_rps": rps, "duration_s": 5.0, "num_requests": 10,
+        "completed": 10, "shed": 0, "expired": 0, "errors": 0,
+        "attained_req_per_s": 2.0, "attained_tok_per_s": goodput,
+        "goodput_req_per_s": 2.0, "goodput_tok_per_s": goodput,
+        "slo_attainment": attainment,
+        "slo": {"ttft_ms": 2000.0, "tpot_ms": 500.0, "e2e_ms": None},
+        "ttft_ms": {"p50": 10.0, "p90": 20.0, "p99": ttft_p99},
+        "tpot_ms": {"p50": 5.0, "p90": 8.0, "p99": 12.0},
+        "e2e_ms": {"p50": 100.0, "p90": 200.0, "p99": 400.0},
+    }
+    if topology:
+        p["topology"] = topology
+    return p
+
+
+# ------------------------------------------------------------- extractor
+def test_extract_top_level_curve(pg):
+    doc = {"serving_curve": [_point(2.0, 50.0, 100.0),
+                             _point(8.0, 90.0, 300.0)]}
+    ex = pg.extract(doc)
+    assert len(ex["points"]) == 2
+    key = "serving_curve@rps=2.0"
+    assert ex["points"][key]["goodput_tok_per_s"] == 50.0
+    assert ex["points"][key]["ttft_p99_ms"] == 100.0
+
+
+def test_extract_topology_keyed_points(pg):
+    doc = {"serving_curve": [_point(4.0, 50.0, 100.0, topology="2Px1D"),
+                             _point(4.0, 60.0, 90.0, topology="1Px2D")]}
+    ex = pg.extract(doc)
+    # same offered rate, distinct topologies: two distinct surfaces
+    assert len(ex["points"]) == 2
+    assert any("topo=2Px1D" in k for k in ex["points"])
+
+
+def test_extract_nested_and_scalar_shapes(pg):
+    # the bench.py wrapper shape: scalar mfu/seconds_per_image under
+    # parsed + a nested serving_curve under secondary_metrics
+    doc = {"n": 5, "rc": 0, "parsed": {
+        "metric": "x", "mfu": 0.41, "seconds_per_image": 12.5,
+        "secondary_metrics": {
+            "ar_serving": {"serving_curve": [_point(2.0, 40.0, 80.0)]}},
+    }}
+    ex = pg.extract(doc)
+    assert any(k.startswith("parsed/") and "serving_curve" in k
+               for k in ex["points"])
+    assert ex["scalars"]["parsed"]["mfu"] == 0.41
+    assert ex["scalars"]["parsed"]["seconds_per_image"] == 12.5
+
+
+def test_extract_rejects_unrecognizable(pg):
+    ex = pg.extract({"metric": "imgs/s", "value": None, "error": "x"})
+    assert not ex["points"] and not ex["scalars"]
+
+
+def test_repo_artifacts_extract(pg):
+    """Every committed serving-curve artifact must stay extractable —
+    the whole point of the gate is that these files are readable."""
+    for name in ("BENCH_r11_unified.json", "BENCH_r12.json",
+                 "BENCH_guard_baseline.json"):
+        with open(os.path.join(REPO, name)) as f:
+            ex = pg.extract(json.load(f))
+        assert ex["points"], f"{name} lost its serving_curve surface"
+
+
+# ------------------------------------------------------------- the gate
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_gate_passes_on_equal_and_improved(pg, tmp_path):
+    base = {"serving_curve": [_point(2.0, 50.0, 100.0)]}
+    better = {"serving_curve": [_point(2.0, 60.0, 80.0)]}
+    b = _write(tmp_path, "base.json", base)
+    assert pg.run(b, _write(tmp_path, "same.json", base), 0.1) == 0
+    assert pg.run(b, _write(tmp_path, "better.json", better), 0.1) == 0
+
+
+def test_gate_trips_on_regression(pg, tmp_path):
+    base = {"serving_curve": [_point(2.0, 50.0, 100.0)]}
+    worse = {"serving_curve": [_point(2.0, 30.0, 100.0)]}  # -40% goodput
+    rc = pg.run(_write(tmp_path, "base.json", base),
+                _write(tmp_path, "worse.json", worse), 0.2)
+    assert rc == 1
+    # latency regressions gate too (lower-is-better direction)
+    slow = {"serving_curve": [_point(2.0, 50.0, 400.0)]}
+    rc = pg.run(_write(tmp_path, "base2.json", base),
+                _write(tmp_path, "slow.json", slow), 0.2)
+    assert rc == 1
+    # under a loose enough threshold the same delta passes
+    mild = {"serving_curve": [_point(2.0, 45.0, 110.0)]}
+    rc = pg.run(_write(tmp_path, "base3.json", base),
+                _write(tmp_path, "mild.json", mild), 0.2)
+    assert rc == 0
+
+
+def test_gate_schema_mismatch_exits_two(pg, tmp_path):
+    curve = {"serving_curve": [_point(2.0, 50.0, 100.0)]}
+    junk = {"metric": "imgs/s", "value": None}
+    rc = pg.run(_write(tmp_path, "a.json", curve),
+                _write(tmp_path, "b.json", junk), 0.2)
+    assert rc == 2
+    rc = pg.run(_write(tmp_path, "c.json", junk),
+                _write(tmp_path, "d.json", curve), 0.2)
+    assert rc == 2
+    # unreadable file is a schema failure, not a crash
+    assert pg.run(str(tmp_path / "missing.json"),
+                  _write(tmp_path, "e.json", curve), 0.2) == 2
+
+
+def test_missing_surfaces_disclosed_and_strict_gated(pg, tmp_path,
+                                                     capsys):
+    """A baseline point absent from the NEW artifact (a crashed bench
+    leg, a dropped field) is disclosed in the output always, and fails
+    the gate under --strict (the deterministic CI leg)."""
+    base = {"serving_curve": [_point(2.0, 50.0, 100.0),
+                              _point(32.0, 200.0, 900.0)]}
+    partial = {"serving_curve": [_point(2.0, 50.0, 100.0)]}
+    b = _write(tmp_path, "base.json", base)
+    n = _write(tmp_path, "partial.json", partial)
+    assert pg.run(b, n, 0.2) == 0          # default: disclosed only
+    err = capsys.readouterr().err
+    assert "absent from the new artifact" in err
+    assert "rps=32.0" in err
+    assert pg.run(b, n, 0.2, strict=True) == 1
+    # a dropped gated METRIC on a surviving surface is caught too
+    no_mfu = {"serving_curve": [dict(_point(2.0, 50.0, 100.0)),
+                                dict(_point(32.0, 200.0, 900.0))]}
+    base_mfu = {"serving_curve": [
+        dict(_point(2.0, 50.0, 100.0), mfu=0.4),
+        dict(_point(32.0, 200.0, 900.0), mfu=0.5)]}
+    assert pg.run(_write(tmp_path, "bm.json", base_mfu),
+                  _write(tmp_path, "nm.json", no_mfu), 0.2,
+                  strict=True) == 1
+
+
+def test_gate_disjoint_surfaces_exit_two(pg, tmp_path):
+    a = {"serving_curve": [_point(2.0, 50.0, 100.0)]}
+    b = {"serving_curve": [_point(99.0, 50.0, 100.0)]}  # no common rps
+    rc = pg.run(_write(tmp_path, "a.json", a),
+                _write(tmp_path, "b.json", b), 0.2)
+    assert rc == 2
+
+
+# ------------------------------------------------- deterministic curve
+def test_guard_curve_is_deterministic_and_matches_baseline(pg,
+                                                           tmp_path):
+    """The CI trajectory leg: regenerating the virtual-time curve must
+    reproduce the committed baseline bit-for-bit (any diff means the
+    admission/goodput/summarize math changed — regenerate the baseline
+    in the same commit, deliberately)."""
+    out1 = str(tmp_path / "g1.json")
+    out2 = str(tmp_path / "g2.json")
+    pg.emit_guard_curve(out1)
+    pg.emit_guard_curve(out2)
+    assert open(out1).read() == open(out2).read()
+    with open(os.path.join(REPO, "BENCH_guard_baseline.json")) as f:
+        baseline = f.read()
+    assert open(out1).read() == baseline, (
+        "deterministic guard curve diverged from "
+        "BENCH_guard_baseline.json — if the loadgen math changed on "
+        "purpose, regenerate the baseline in this commit")
+    # and the gate itself agrees at the tight CI threshold
+    assert pg.run(os.path.join(REPO, "BENCH_guard_baseline.json"),
+                  out1, 0.01) == 0

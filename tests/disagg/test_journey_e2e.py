@@ -1,0 +1,183 @@
+"""Fleet journey tracing e2e (docs/observability.md): a disaggregated
+2x1 topology with a seeded mid-stream replica kill must produce ONE
+connected trace per request — router dispatch -> KV handoff ship/recv
+-> failover -> decode adoption all under the request's trace id, laid
+out on per-replica Perfetto process tracks — and a controller-driven
+re-role must appear as a controlplane span on the acted-on replica's
+track."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vllm_omni_tpu.controlplane import ControlPlane, ControlPlaneConfig
+from vllm_omni_tpu.disagg.service import build_inproc_router
+from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.resilience.faults import FaultPlan, set_fault_plan
+from vllm_omni_tpu.sampling_params import SamplingParams
+from vllm_omni_tpu.tracing import (
+    get_recorder,
+    new_trace_context,
+    to_chrome_trace,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return params, cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    set_fault_plan(None)
+    get_recorder().drain()
+    yield
+    set_fault_plan(None)
+    get_recorder().drain()
+
+
+BASE = dict(num_pages=64, page_size=4, max_model_len=128,
+            max_num_seqs=4, dtype=jnp.float32)
+GREEDY = SamplingParams(temperature=0.0, max_tokens=6)
+PROMPTS = [[1, 5, 9, 2, 7, 3, 8, 4], [2, 6, 1, 7, 3, 9, 5, 8],
+           [4, 4, 8, 1, 2, 2, 9, 7]]
+
+
+def _router(params, cfg, n_prefill, n_decode, **kw):
+    return build_inproc_router(params, cfg, EngineConfig(**BASE),
+                               n_prefill, n_decode, **kw)
+
+
+def _serve_traced(router, prompts, sp=GREEDY, cp=None, max_steps=2000,
+                  prefix="j"):
+    ctxs = {}
+    for i, p in enumerate(prompts):
+        rid = f"{prefix}-{i}"
+        ctxs[rid] = new_trace_context(rid)
+        router.submit(list(p), sp, request_id=rid,
+                      additional_information={"trace": ctxs[rid]})
+    finished = {}
+    for _ in range(max_steps):
+        if not router.has_unfinished:
+            break
+        router.step()
+        if cp is not None:
+            cp.tick()
+            cp.actuate()
+        for out in router.poll():
+            finished[out.request_id] = out
+    for out in router.poll():
+        finished[out.request_id] = out
+    assert not router.has_unfinished
+    return ctxs, finished
+
+
+def _by_trace(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s["trace_id"], []).append(s)
+    return out
+
+
+# ------------------------------------------------- failover journey e2e
+def test_failover_journey_is_one_connected_trace(tiny_model,
+                                                 monkeypatch):
+    """2 prefill x 1 decode, the decode replica killed mid-stream
+    (its 4th step — after adoption, before the streams finish): every
+    request still completes, and the stranded requests' spans form ONE
+    trace each — dispatch -> handoff ship/recv -> adoption -> failover
+    — crossing the router track and multiple replica tracks."""
+    # pin the full wire path so ship AND recv spans exist
+    monkeypatch.setenv("OMNI_TPU_FORCE_CONNECTOR_SERIALIZATION", "1")
+    params, cfg = tiny_model
+    router = _router(params, cfg, 2, 1)
+    # replica2 = the decode tier (prefill replicas are numbered first)
+    set_fault_plan(FaultPlan.parse("seed=7;replica2:fail_step=4"))
+    ctxs, finished = _serve_traced(router, PROMPTS)
+    assert len(finished) == len(PROMPTS)
+    assert all(not o.is_error for o in finished.values())
+    assert router.failovers, "the seeded kill must have failed over"
+
+    spans = get_recorder().drain()
+    traces = _by_trace(spans)
+    # every request's journey is connected: its trace id exists and
+    # covers the full dispatch -> handoff -> adoption path
+    for rid, ctx in ctxs.items():
+        names = {s["name"] for s in traces.get(ctx["trace_id"], ())}
+        assert "router_dispatch" in names, rid
+        assert "kv_handoff_ship" in names and "kv_handoff_recv" in names
+        assert "decode_adopt" in names, rid
+    # at least one request carries the failover hop, and its spans
+    # touch more than one replica track plus the router track
+    failed = [t for t in traces.values()
+              if any(s["name"] == "failover" for s in t)]
+    assert failed, "no trace recorded the failover"
+    journey = failed[0]
+    replica_tracks = {s.get("replica_id") for s in journey
+                      if s.get("replica_id")}
+    assert "router" in replica_tracks
+    assert len(replica_tracks - {"router"}) >= 2, (
+        "the failover journey must cross replicas: "
+        f"{sorted(replica_tracks)}")
+    # engine-side spans carry the replica identity too (the span_tags
+    # stamp): prefill/decode executions name their replica + role
+    exec_spans = [s for s in journey
+                  if s["name"] in ("prefill", "decode", "queue_wait")]
+    assert exec_spans and all(s.get("replica_id") and s.get("role")
+                              for s in exec_spans)
+    # handoff spans carry payload attribution
+    ship = next(s for s in journey if s["name"] == "kv_handoff_ship")
+    assert ship["args"]["bytes"] > 0 and ship["args"]["layers"] > 0
+    assert "tier" in ship["args"]
+
+    # Perfetto layout: per-replica process tracks, no pid collisions
+    doc = to_chrome_trace(spans)
+    names = {m["args"]["name"] for m in doc["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "process_name"}
+    assert any(n.startswith("replica:prefill0") for n in names)
+    assert any(n.startswith("replica:prefill1") for n in names)
+    assert any(n.startswith("replica:decode2") for n in names)
+    assert any(n.startswith("replica:router") for n in names)
+
+
+def test_rerole_appears_as_controlplane_span(tiny_model):
+    """The controller-driven re-role (prefill pressure on a 1P+2D
+    fleet) renders as a ``cp:rerole`` interval on the flipped replica's
+    track, with the drain/flip/undrain actuation marks inside it."""
+    params, cfg = tiny_model
+    prompts = [[(i + j) % 60 + 1 for j in range(16)] for i in range(16)]
+    sp = SamplingParams(temperature=0.0, max_tokens=2)
+    router = _router(params, cfg, 1, 2)
+    cp = ControlPlane(router, ControlPlaneConfig(
+        hysteresis_ticks=1, cooldown_ticks=200, band_high=1.5,
+        saturation_gain=0.0))
+    _serve_traced(router, prompts, sp=sp, cp=cp, prefix="rr")
+    assert cp.reroles == 1
+    # a second traced wave exercises the re-shaped fleet so the
+    # flipped replica records engine spans under its NEW role
+    _serve_traced(router, prompts[:4], sp=sp, prefix="rr2")
+    spans = get_recorder().drain()
+    ops = [s for s in spans if s["name"] == "cp:rerole"]
+    # the whole-operation interval (outcome-stamped) + the flip mark
+    whole = [s for s in ops if s.get("args", {}).get("outcome")]
+    assert whole, "the completed re-role must record its interval"
+    op = whole[0]
+    assert op["args"]["outcome"] == "flipped and re-admitted"
+    assert op["args"]["from_role"] == "decode"
+    assert op["args"]["to_role"] == "prefill"
+    assert op["replica_id"].startswith("decode")
+    assert op["dur_us"] > 0
+    # actuation marks on the same replica's track
+    marks = {s["name"] for s in spans
+             if s.get("replica_id") == op["replica_id"]
+             and s["name"].startswith("cp:")}
+    assert {"cp:drain", "cp:rerole", "cp:undrain"} <= marks
+    # post-flip engine spans carry the NEW role on the same track
+    post = [s for s in spans
+            if s.get("replica_id") == op["replica_id"]
+            and s["name"] in ("prefill", "decode", "queue_wait")
+            and s.get("role") == "prefill"]
+    assert post, "re-stamped span_tags must show the flipped role"

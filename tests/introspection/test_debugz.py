@@ -138,6 +138,45 @@ def test_metrics_has_introspection_series(server):
     assert "vllm_omni_tpu_watchdog_tripped 0" in text
 
 
+def test_debug_trace_view(server):
+    """The trace layer's own /debug view: recorder occupancy + drop
+    accounting always answer; no writer on this server, so enabled is
+    false and no writer block renders."""
+    url, _ = server
+    r = httpx.get(f"{url}/debug/trace", timeout=30)
+    assert r.status_code == 200
+    doc = r.json()
+    assert doc["enabled"] is False
+    rec = doc["recorder"]
+    assert rec["capacity"] > 0
+    assert rec["buffered_spans"] >= 0
+    assert rec["spans_dropped"] >= 0
+    assert "writer" not in doc
+    # and the index advertises it
+    eps = httpx.get(f"{url}/debug/z", timeout=30).json()["endpoints"]
+    assert "/debug/trace" in eps
+
+
+def test_traceparent_header_joins_external_trace(server):
+    """An inbound W3C traceparent opts the request into tracing and its
+    spans continue the CALLER's trace id (tracing/journey.py)."""
+    from vllm_omni_tpu.tracing import get_recorder
+
+    url, _ = server
+    get_recorder().drain()
+    ext = "4bf92f3577b34da6a3ce929d0e0e4736"
+    r = httpx.post(f"{url}/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "traced"}],
+        "max_tokens": 3, "temperature": 0,
+    }, headers={"traceparent": f"00-{ext}-00f067aa0ba902b7-01"},
+        timeout=120)
+    assert r.status_code == 200
+    spans = get_recorder().drain()
+    joined = [s for s in spans if s["trace_id"] == ext]
+    assert joined, "spans must continue the external trace id"
+    assert {"queue_wait", "request"} <= {s["name"] for s in joined}
+
+
 def test_health_503_once_watchdog_trips(server):
     """The load-balancer contract: a tripped watchdog flips /health to
     503 (this must run LAST in the module — the latch is one-way)."""

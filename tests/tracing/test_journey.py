@@ -1,0 +1,152 @@
+"""omnijourney unit tier: external trace joining, replica-tagged spans,
+per-replica Perfetto tracks, and the bounded/streamed Chrome export."""
+
+import json
+
+from vllm_omni_tpu.tracing import (
+    TraceRecorder,
+    TraceWriter,
+    inbound_trace_id,
+    iter_chrome_events,
+    new_trace_context,
+    parse_traceparent,
+    to_chrome_trace,
+)
+from vllm_omni_tpu.tracing.journey import (
+    journey_instant,
+    record_journey,
+)
+
+
+# ----------------------------------------------------- traceparent join
+def test_parse_traceparent_valid():
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert parse_traceparent(
+        f"00-{tid}-00f067aa0ba902b7-01") == tid
+    # case-insensitive, whitespace-tolerant
+    assert parse_traceparent(
+        f"  00-{tid.upper()}-00F067AA0BA902B7-01 ") == tid
+
+
+def test_parse_traceparent_rejects_malformed():
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-short-00f067aa0ba902b7-01") is None
+    # the spec's all-zero invalid sentinel
+    assert parse_traceparent(
+        "00-" + "0" * 32 + "-00f067aa0ba902b7-01") is None
+    assert parse_traceparent(12345) is None
+
+
+def test_inbound_trace_id_precedence_and_bounds():
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    tp = f"00-{tid}-00f067aa0ba902b7-01"
+    # x-omni-trace-id wins over traceparent
+    assert inbound_trace_id(
+        {"x-omni-trace-id": "my-trace", "traceparent": tp}) == "my-trace"
+    assert inbound_trace_id({"traceparent": tp}) == tid
+    assert inbound_trace_id({}) is None
+    # hostile header values never join (charset/length bounded)
+    assert inbound_trace_id(
+        {"x-omni-trace-id": 'x" onload="evil'}) is None
+    assert inbound_trace_id({"x-omni-trace-id": "a" * 65}) is None
+
+
+# ------------------------------------------------- replica-tagged spans
+def test_record_journey_requires_context(monkeypatch):
+    rec = TraceRecorder()
+    monkeypatch.setattr("vllm_omni_tpu.tracing.journey.get_recorder",
+                        lambda: rec)
+    record_journey(None, "router_dispatch", 0.0, 0.1)
+    assert len(rec) == 0
+    ctx = new_trace_context("r1")
+    record_journey(ctx, "router_dispatch", 0.0, 0.1,
+                   replica_id="prefill0", role="prefill",
+                   args={"attempt": 0})
+    journey_instant(ctx, "failover", args={"reason": "died"})
+    spans = rec.drain()
+    assert [s["name"] for s in spans] == ["router_dispatch", "failover"]
+    assert spans[0]["replica_id"] == "prefill0"
+    assert spans[0]["role"] == "prefill"
+    assert spans[1]["dur_us"] == 0.0
+    # both spans share the one trace id: the journey is connected
+    assert {s["trace_id"] for s in spans} == {ctx["trace_id"]}
+
+
+def test_chrome_export_per_replica_process_tracks():
+    rec = TraceRecorder()
+    ctx = new_trace_context("req-1")
+    # one stage span + spans on two replicas + a router span
+    rec.record(ctx, "prefill", 1.0, 0.1, stage_id=0)
+    rec.record(ctx, "decode", 1.1, 0.1, stage_id=0,
+               replica_id="prefill0", role="prefill")
+    rec.record(ctx, "decode", 1.2, 0.1, stage_id=0,
+               replica_id="decode1", role="decode")
+    rec.record(ctx, "router_dispatch", 0.9, 0.05,
+               replica_id="router", role="router")
+    doc = to_chrome_trace(rec.drain())
+    events = doc["traceEvents"]
+    x = [e for e in events if e["ph"] == "X"]
+    # the two replicas and the router land on three DISTINCT pids,
+    # none of which is the stage pid
+    stage_pid = next(e["pid"] for e in x if "replica_id" not in e["args"])
+    replica_pids = {e["pid"] for e in x if "replica_id" in e["args"]}
+    assert len(replica_pids) == 3
+    assert stage_pid not in replica_pids
+    names = {m["args"]["name"] for m in events
+             if m["ph"] == "M" and m["name"] == "process_name"}
+    assert "replica:prefill0 (prefill)" in names
+    assert "replica:decode1 (decode)" in names
+    assert "replica:router (router)" in names
+    assert "stage_0" in names
+
+
+def test_iter_chrome_events_streams_same_doc():
+    rec = TraceRecorder()
+    ctx = new_trace_context("r")
+    rec.record(ctx, "a", 0.0, 0.1, stage_id=1)
+    rec.record(ctx, "b", 0.1, 0.1, replica_id="x", role="prefill")
+    spans = rec.drain()
+    assert list(iter_chrome_events(spans)) == \
+        to_chrome_trace(spans)["traceEvents"]
+
+
+# ------------------------------------------------ bounded chrome export
+def test_writer_counts_chrome_drops_and_declares_truncation(tmp_path):
+    prefix = str(tmp_path / "run")
+    w = TraceWriter(prefix, chrome_capacity=4)
+    ctx = new_trace_context("r")
+    rec = TraceRecorder()
+    for i in range(7):
+        rec.record(ctx, f"s{i}", float(i), 0.1, stage_id=0)
+    w.write(rec.drain())
+    assert w.chrome_spans_dropped == 3
+    path = w.export_chrome()
+    doc = json.load(open(path))
+    assert doc["otherData"]["truncated"] is True
+    assert doc["otherData"]["spans_dropped"] == 3
+    assert doc["otherData"]["spans"] == 4
+    kept = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert kept == ["s3", "s4", "s5", "s6"], "cap keeps the TAIL"
+    # the JSONL keeps the full history regardless
+    lines = open(w.jsonl_path).read().splitlines()
+    assert len(lines) == 7
+    snap = w.debug_snapshot()
+    assert snap["chrome_spans_dropped"] == 3
+    assert snap["buffered_spans"] == 4
+    assert snap["last_export_ts"] is not None
+    assert snap["jsonl_path"].endswith(".trace.jsonl")
+
+
+def test_writer_untruncated_export_is_loadable(tmp_path):
+    w = TraceWriter(str(tmp_path / "ok"))
+    ctx = new_trace_context("r")
+    rec = TraceRecorder()
+    rec.record(ctx, "span", 0.0, 0.5, stage_id=0,
+               replica_id="decode0", role="decode")
+    w.write(rec.drain())
+    doc = json.load(open(w.export_chrome()))
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["truncated"] is False
+    assert any(e.get("name") == "span" for e in doc["traceEvents"])

@@ -54,6 +54,13 @@ HOT_PATHS: tuple[str, ...] = (
     # controller thread — a stray device sync in either stalls all
     # replicas at once (or serializes serving behind a poll)
     "vllm_omni_tpu/controlplane/",
+    # journey tracing + live roofline: both record INSIDE the router/
+    # engine step loops (spans per dispatch/handoff/retire, MFU/MBU per
+    # step) — the whole design is host-ints-only, and a stray device
+    # sync here would stall serving exactly in proportion to how
+    # observable it is
+    "vllm_omni_tpu/tracing/",
+    "vllm_omni_tpu/metrics/roofline.py",
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (
@@ -193,7 +200,11 @@ LOCK_GUARDS: dict[str, dict[str, tuple[str, ...]]] = {
         "_lock": ("_spans", "_dropped"),
     },
     "vllm_omni_tpu/tracing/trace.py::TraceWriter": {
-        "_lock": ("_spans",),
+        "_lock": ("_spans", "_chrome_dropped", "_last_export_ts"),
+    },
+    # engine thread accounts steps; /metrics + /debug threads snapshot
+    "vllm_omni_tpu/metrics/roofline.py::RooflineTracker": {
+        "_lock": ("_window", "_flops_total", "_bytes_total"),
     },
     # controller thread emits intents + reads the ring; the router
     # thread drains intents, records outcomes, and bumps the applied-

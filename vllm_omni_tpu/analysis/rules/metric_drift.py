@@ -61,6 +61,12 @@ def synthetic_engine_snapshot() -> dict:
                     "efficiency": 0.6563},
         "compile": {"compiles": 9, "cache_hits": 120,
                     "compile_s": 33.5},
+        # live roofline attribution (metrics/roofline.py):
+        # engine_step_mfu + the phase-labeled engine_step_mbu
+        "roofline": {"mfu": 0.31, "mbu": {"prefill": 0.12,
+                                          "decode": 0.55,
+                                          "mixed": 0.4},
+                     "window_steps": 128},
         "async_fallback": {"prefill": 4, "kv_transfer": 1},
         "scheduler": {"waiting": 1, "running": 2, "preemptions": 1,
                       "rejections": 0},

@@ -57,6 +57,7 @@ from vllm_omni_tpu.controlplane.policy import (
 from vllm_omni_tpu.disagg.roles import ROLE_DECODE, ROLE_PREFILL
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.resilience.metrics import resilience_metrics
+from vllm_omni_tpu.tracing import journey, new_trace_context
 
 logger = init_logger(__name__)
 
@@ -127,6 +128,10 @@ class _Op:
     stage: str = "draining"
     started_tick: int = 0
     retries: int = 0
+    # wall-clock start for the journey span: the whole drain -> quiesce
+    # -> flip/remove -> re-admit operation renders as ONE interval on
+    # the acted-on replica's trace track (tracing/journey.py)
+    started_wall: float = 0.0
 
 
 @dataclass
@@ -183,6 +188,13 @@ class ControlPlane:
         }
         self._replica_counter = len(router.replicas)
         self._last_sensors: dict = {}
+        # journey tracing: control-plane operations are fleet-scoped,
+        # not request-scoped — they ride one long-lived synthetic
+        # context so a fleet Perfetto capture shows drain/flip/re-admit
+        # intervals on the acted-on replica's track next to the very
+        # requests they displaced.  Ops are rare (cooldown-gated), so
+        # the bounded recorder ring absorbs them on untraced deployments
+        self._trace_ctx = new_trace_context("controlplane")
         # lifetime ledgers (mirrored into the resilience registry)
         self.reroles = 0
         self.actions: dict[str, int] = {}
@@ -322,8 +334,21 @@ class ControlPlane:
         # "removing": completion is observed as the KeyError above
 
     def _finish_op(self, outcome: str) -> None:
-        logger.info("controlplane: %s of %s %s", self._op.kind,
-                    self._op.replica_id, outcome)
+        op = self._op
+        logger.info("controlplane: %s of %s %s", op.kind,
+                    op.replica_id, outcome)
+        if op.started_wall:
+            # the whole operation as one interval on the acted-on
+            # replica's track (abort paths land here too — the outcome
+            # rides the args, so a refused flip is visibly different
+            # from a completed one)
+            journey.record_journey(
+                self._trace_ctx, journey.CP_PREFIX + op.kind,
+                op.started_wall, max(time.time() - op.started_wall, 0.0),
+                replica_id=op.replica_id,
+                role=op.to_role or op.from_role, cat="controlplane",
+                args={"from_role": op.from_role, "to_role": op.to_role,
+                      "outcome": outcome})
         self._op = None
         self._cooldown_until = self._ticks + self.config.cooldown_ticks
         self._rerole_hyst.reset()
@@ -419,7 +444,8 @@ class ControlPlane:
             return
         self._op = _Op(kind="rerole", replica_id=donor.replica_id,
                        from_role=donor.role, to_role=to_role,
-                       started_tick=self._ticks)
+                       started_tick=self._ticks,
+                       started_wall=time.time())
         self._emit(ACTION_DRAIN, replica_id=donor.replica_id,
                    reason=f"rerole {donor.role}->{to_role} "
                           f"(pressure_ratio={ratio:.2f})")
@@ -469,7 +495,8 @@ class ControlPlane:
                 self._op = _Op(kind="scale_down",
                                replica_id=donor.replica_id,
                                from_role=role, to_role=None,
-                               started_tick=self._ticks)
+                               started_tick=self._ticks,
+                               started_wall=time.time())
                 self._emit(ACTION_DRAIN, replica_id=donor.replica_id,
                            reason=f"scale_down {role} "
                                   f"(pressure={s.pressure:.2f})")
@@ -545,6 +572,7 @@ class ControlPlane:
                                       if k != "reason"}}
             if act.args.get("reason"):
                 outcome["reason"] = act.args["reason"]
+            t_a0, w_a0 = time.perf_counter(), time.time()
             try:
                 if act.kind == ACTION_DRAIN:
                     router.drain(act.args["replica_id"])
@@ -574,6 +602,18 @@ class ControlPlane:
                 outcome["error"] = f"{type(e).__name__}: {e}"
                 logger.warning("controlplane action %s failed: %s",
                                act.kind, outcome["error"])
+            # one journey span per applied actuation (drain / undrain /
+            # flip / scale) on the acted-on replica's track — the
+            # fine-grained marks inside the whole-operation interval
+            # recorded at _finish_op
+            journey.record_journey(
+                self._trace_ctx, journey.CP_PREFIX + act.kind, w_a0,
+                time.perf_counter() - t_a0,
+                replica_id=str(outcome.get("replica_id")
+                               or act.args.get("role") or "fleet"),
+                role=str(act.args.get("role") or ""),
+                cat="controlplane",
+                args={"ok": outcome["ok"], "seq": act.seq})
             self._record(outcome)
             if act.kind in (ACTION_SCALE_UP,) or not outcome["ok"]:
                 with self._lock:

@@ -81,6 +81,7 @@ from vllm_omni_tpu.resilience.deadline import (
 from vllm_omni_tpu.resilience.faults import fault_point
 from vllm_omni_tpu.resilience.metrics import resilience_metrics
 from vllm_omni_tpu.sampling_params import SamplingParams
+from vllm_omni_tpu.tracing import journey
 
 logger = init_logger(__name__)
 
@@ -103,6 +104,13 @@ class EngineReplica:
         self.engine = engine
         self.role = role
         self.index = index
+        # fleet span identity (tracing/journey.py): the engine's own
+        # spans (queue_wait/prefill/decode/dispatch/retire) render on
+        # this replica's Perfetto track instead of colliding with its
+        # same-process siblings on one stage row.  Plain attribute set
+        # — works on any engine object, read by LLMEngine's recorder
+        # calls; a role flip re-stamps it (router.set_role).
+        engine.span_tags = {"replica_id": replica_id, "role": role}
         self.dead = False
         self.ejected = False     # health-driven: out of dispatch rotation
         self.drained = False     # operator-driven: quiescing for restart
@@ -213,6 +221,11 @@ class _ReqCtx:
     # finish metadata captured from the prefill output when the request
     # terminates at the prefill tier (max_tokens==1 / EOS first token)
     handoff_since_step: int = 0
+
+    @property
+    def trace(self) -> Optional[dict]:
+        """The request's trace context (journey spans); None = untraced."""
+        return self.info.get("trace")
 
 
 class DisaggRouter:
@@ -384,6 +397,9 @@ class DisaggRouter:
             self.decodes.append(r)
             r.engine.kv_transfer_sink = None
         r.role = role
+        # re-stamp the fleet span identity: post-flip engine spans must
+        # carry the NEW role on the replica's track
+        r.engine.span_tags = {"replica_id": r.replica_id, "role": role}
         self.replicas = self.prefills + self.decodes
         self._zero_gauge_if_emptied(from_role)
         self.refresh_gauges()
@@ -473,6 +489,7 @@ class DisaggRouter:
                   avoid: Optional[EngineReplica] = None) -> None:
         """(Re)place a request on the topology according to the
         degradation ladder."""
+        t0, w0 = time.perf_counter(), time.time()
         prefill = self._pick(self.prefills, avoid=avoid)
         decode = self._pick(self.decodes, avoid=avoid)
         if prefill is not None and decode is not None:
@@ -483,6 +500,11 @@ class DisaggRouter:
             ctx.replica = prefill
             self._submit_to(prefill, ctx,
                             replace(ctx.sampling_params, max_tokens=1))
+            journey.record_journey(
+                ctx.trace, journey.SPAN_DISPATCH, w0,
+                time.perf_counter() - t0,
+                args={"replica": prefill.replica_id,
+                      "phase": ROLE_PREFILL, "attempt": ctx.attempts})
             return
         survivor = decode or prefill or self._pick(self.replicas,
                                                    avoid=avoid)
@@ -491,6 +513,9 @@ class DisaggRouter:
             # 429, distinct from 503 (broke mid-request) and 504
             # (budget spent)
             self.sheds += 1
+            journey.journey_instant(
+                ctx.trace, journey.SPAN_SHED,
+                args={"attempt": ctx.attempts})
             self._finish(ctx, OmniRequestOutput.from_error(
                 ctx.request_id,
                 "no healthy replica in any tier; retry with backoff",
@@ -500,6 +525,15 @@ class DisaggRouter:
         ctx.replica = survivor
         self._submit_to(survivor, ctx, ctx.sampling_params,
                         suppress_kv_transfer=True)
+        # a colocated placement on a two-tier topology is a
+        # degradation-ladder transition — a distinct span name so the
+        # ladder reads directly off the timeline
+        name = (journey.SPAN_DEGRADED if (self.prefills and self.decodes)
+                else journey.SPAN_DISPATCH)
+        journey.record_journey(
+            ctx.trace, name, w0, time.perf_counter() - t0,
+            args={"replica": survivor.replica_id,
+                  "phase": ROLE_COLOCATED, "attempt": ctx.attempts})
 
     def _submit_to(self, replica: EngineReplica, ctx: _ReqCtx,
                    sp: SamplingParams,
@@ -623,7 +657,16 @@ class DisaggRouter:
             zero_copy = self._zero_copy
             t0 = time.perf_counter()
             received = None
+            # ship/recv journey spans: the ship leg renders on the
+            # PREFILL replica's track (it produced the payload), the
+            # recv leg on the router track (transport + merge happen
+            # here) — args carry bytes/layers/tier so the timeline
+            # answers "how big and over what" without the metrics page
+            prefill_replica = ctx.replica
+            tier = "zero_copy" if zero_copy else type(
+                self.connector).__name__
             try:
+                t_ship, w_ship = time.perf_counter(), time.time()
                 if zero_copy:
                     fault_point("handoff")
                     n = sum(int(k.nbytes) + int(v.nbytes)
@@ -633,15 +676,27 @@ class DisaggRouter:
                     n = roles.ship_handoff(
                         self.connector, ctx.request_id, payload,
                         tp_shards=self.tp_shards)
-                    resilience_metrics.inc("kv_handoff_bytes_total",
-                                           n, dir="out")
+                journey.record_journey(
+                    ctx.trace, journey.SPAN_HANDOFF_SHIP, w_ship,
+                    time.perf_counter() - t_ship,
+                    replica_id=(prefill_replica.replica_id
+                                if prefill_replica else "?"),
+                    role=ROLE_PREFILL, cat="handoff",
+                    args={"bytes": n, "layers": len(payload),
+                          "tp_shards": self.tp_shards, "tier": tier})
+                resilience_metrics.inc("kv_handoff_bytes_total",
+                                       n, dir="out")
+                if not zero_copy:
+                    t_recv, w_recv = time.perf_counter(), time.time()
                     received = roles.recv_handoff(
                         self.connector, ctx.request_id,
                         timeout=self.handoff_timeout_s,
                         deadline_ts=ctx.deadline_ts)
-                if zero_copy:
-                    resilience_metrics.inc("kv_handoff_bytes_total",
-                                           n, dir="out")
+                    journey.record_journey(
+                        ctx.trace, journey.SPAN_HANDOFF_RECV, w_recv,
+                        time.perf_counter() - t_recv, cat="handoff",
+                        args={"bytes": n, "layers": len(payload),
+                              "tier": tier})
                 resilience_metrics.inc("kv_handoff_bytes_total", n,
                                        dir="in")
             except KVDeadlineExceeded:
@@ -690,12 +745,20 @@ class DisaggRouter:
         ctx.replica = decode
         try:
             if payload is not None:
+                t0, w0 = time.perf_counter(), time.time()
                 roles.adopt_prefill(
                     decode.engine, ctx.request_id,
                     ctx.prompt_token_ids, ctx.first_token, payload,
                     ctx.sampling_params,
                     deadline_ts=expiry_ts(remaining_s(ctx.deadline_ts)),
                     additional_information=ctx.info)
+                journey.record_journey(
+                    ctx.trace, journey.SPAN_ADOPT, w0,
+                    time.perf_counter() - t0,
+                    replica_id=decode.replica_id, role=decode.role,
+                    cat="handoff",
+                    args={"tokens": len(ctx.prompt_token_ids),
+                          "layers": len(payload)})
                 decode._submitted.add(ctx.request_id)
                 self.handoffs += 1
             else:
@@ -705,6 +768,11 @@ class DisaggRouter:
                 # a prefill-role survivor and nobody consumes it)
                 self._submit_to(decode, ctx, ctx.sampling_params,
                                 suppress_kv_transfer=True)
+                journey.journey_instant(
+                    ctx.trace, journey.SPAN_ADOPT,
+                    replica_id=decode.replica_id, role=decode.role,
+                    cat="handoff",
+                    args={"recompute": True, "reason": fail_reason})
         except Exception:
             self._failover(ctx, "adoption_failed")
 
@@ -721,6 +789,10 @@ class DisaggRouter:
         NO failover: ``failover_total`` is re-routes performed, and it
         must reconcile with the ledger."""
         if ctx.attempts >= self.max_failover_attempts:
+            journey.journey_instant(
+                ctx.trace, journey.SPAN_FAILOVER,
+                args={"reason": reason, "attempt": ctx.attempts,
+                      "outcome": "budget_exhausted"})
             self._finish(ctx, OmniRequestOutput.from_error(
                 ctx.request_id,
                 f"request failed after {ctx.attempts} failover "
@@ -729,6 +801,11 @@ class DisaggRouter:
             return
         ctx.attempts += 1
         self._note_failover(reason)
+        journey.journey_instant(
+            ctx.trace, journey.SPAN_FAILOVER,
+            args={"reason": reason, "attempt": ctx.attempts,
+                  "from_replica": (ctx.replica.replica_id
+                                   if ctx.replica is not None else None)})
         ctx.first_token = None
         self._payloads.pop(ctx.request_id, None)
         self._dispatch(ctx, avoid=ctx.replica)

@@ -139,6 +139,14 @@ class DisaggService:
                 "runs in front of the router)")
         if request_id is None:
             request_id = f"disagg-{next(self._req_counter)}"
+        # external trace join (tracing/journey.py): a caller-supplied
+        # trace_id (the OpenAI server's traceparent / x-omni-trace-id)
+        # mints this request's journey context so router + replica
+        # spans continue the caller's trace instead of a fresh one
+        tid = info.pop("trace_id", None)
+        if tid and "trace" not in info:
+            info["trace"] = {"trace_id": str(tid),
+                             "request_id": request_id}
         if request_id in self._streams:
             raise ValueError(
                 f"request_id {request_id!r} already in flight")

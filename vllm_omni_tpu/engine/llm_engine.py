@@ -342,6 +342,10 @@ class LLMEngine:
         # per-request span recording.  stage_id is stamped by OmniStage
         # so spans and /metrics series carry the pipeline position.
         self.stage_id = 0
+        # fleet span identity (tracing/journey.py): empty for pipeline
+        # stages; EngineReplica stamps {"replica_id", "role"} so this
+        # engine's spans render on its own Perfetto replica track
+        self.span_tags: dict = {}
         self.step_metrics = EngineStepMetrics()
         # SLO accounting targets: every finished request is judged
         # against them per tenant (slo_attainment_ratio, goodput)
@@ -366,6 +370,27 @@ class LLMEngine:
         self.flight = FlightRecorder(
             capacity=max(int(_envs2.OMNI_TPU_FLIGHT_CAPACITY), 1),
             name=f"{config.worker_type}-engine")
+        # live roofline attribution (metrics/roofline.py): per-step
+        # achieved FLOPs / HBM bytes from static geometry × the step's
+        # token mix, against the platform peaks — engine_step_mfu /
+        # engine_step_mbu{phase} on /metrics, per-record fields in the
+        # flight recorder, the rolling window on /debug/engine.  Host
+        # math only (zero device syncs); AR transformers only — the
+        # one-shot generation runner has no token-mix geometry.
+        self.roofline = None
+        if isinstance(model_cfg, tfm.TransformerConfig):
+            from vllm_omni_tpu.metrics.roofline import (
+                ModelGeometry,
+                RooflineTracker,
+            )
+            from vllm_omni_tpu.platforms import current_platform
+
+            p = current_platform()
+            self.roofline = RooflineTracker(
+                ModelGeometry.from_transformer_config(
+                    model_cfg, jnp.dtype(config.dtype).itemsize),
+                peak_tflops=p.peak_tflops_bf16(),
+                peak_gbps=p.peak_hbm_gbps())
         self.memory = DeviceMemoryLedger(self._memory_components)
         # kv tier moves drained this step — recorded per step so the
         # flight tail shows offload/restore churn around a bad minute
@@ -485,7 +510,7 @@ class LLMEngine:
                 get_recorder().record(
                     req.additional_information.get("trace"), "kv_inject",
                     w0, time.perf_counter() - t0, stage_id=self.stage_id,
-                    cat="kv", args={"tokens": use},
+                    cat="kv", args={"tokens": use}, **self.span_tags,
                 )
                 return
             except (ValueError, IndexError) as e:
@@ -744,6 +769,40 @@ class LLMEngine:
         spec_rows = [s for s in sched_out.decodes if s.num_new_tokens > 1]
         unified = bool(getattr(sched_out, "unified", False)
                        or sched_out.prefills or spec_rows)
+        # record schema v3 additions (docs/debugging.md): live roofline
+        # attribution + the journey-trace cross-link.  All host ints —
+        # start_pos/num_new_tokens are scheduler state, the wall time
+        # is the host_ms/device_ms sum already computed; NO device syncs
+        roofline = None
+        tracker = getattr(self, "roofline", None)  # duck-typed fakes
+        if tracker is not None:
+            from vllm_omni_tpu.metrics.roofline import ctx_positions
+
+            roofline = tracker.on_step(
+                prefill_tokens=sum(s.num_new_tokens
+                                   for s in sched_out.prefills),
+                prefill_ctx=sum(ctx_positions(s.start_pos,
+                                              s.num_new_tokens)
+                                for s in sched_out.prefills),
+                decode_tokens=sum(s.num_new_tokens
+                                  for s in sched_out.decodes),
+                decode_ctx=sum(ctx_positions(s.start_pos,
+                                             s.num_new_tokens)
+                               for s in sched_out.decodes),
+                sampled_rows=len(sched_out.prefills)
+                + len(sched_out.decodes),
+                wall_s=(host_ms + device_ms) / 1e3,
+            )
+        # capped trace-id cross-link: a watchdog-trip dump pivots from
+        # the bad step straight to the journey timeline (the ids to
+        # grep in the .trace.jsonl / Perfetto search box)
+        trace_ids = []
+        for s in scheduled[:32]:
+            t = (getattr(s.request, "additional_information", None)
+                 or {}).get("trace")
+            if t and t.get("trace_id") and len(trace_ids) < 8:
+                if t["trace_id"] not in trace_ids:
+                    trace_ids.append(t["trace_id"])
         self.flight.append({
             "path": path,
             "unified": unified,
@@ -768,6 +827,11 @@ class LLMEngine:
             # which requests rode this step (capped: the record must
             # stay small at any batch size)
             "requests": [s.request.request_id for s in scheduled[:32]],
+            # v3: roofline attribution + journey cross-link (capped)
+            "mfu": roofline["mfu"] if roofline else None,
+            "mbu": roofline["mbu"] if roofline else None,
+            "roofline_phase": roofline["phase"] if roofline else None,
+            "trace_ids": trace_ids,
         })
 
     def _padding_totals(self) -> tuple[int, int]:
@@ -801,11 +865,16 @@ class LLMEngine:
             ctx = req.additional_information.get("trace")
             if ctx and req.arrival_time:
                 # span START stays wall-clock (trace timelines align on
-                # wall timestamps); the DURATION is monotonic
+                # wall timestamps); the DURATION is monotonic.  The
+                # tenant rides the args so WFQ queue-wait reads
+                # per-tenant straight off the timeline
                 rec.record(ctx, "queue_wait", req.arrival_time,
                            wait_s if req.arrival_mono
                            else now_w - req.arrival_time,
-                           stage_id=self.stage_id, cat="queue")
+                           stage_id=self.stage_id, cat="queue",
+                           args={"tenant": getattr(req, "tenant",
+                                                   "default")},
+                           **self.span_tags)
 
     def _observe_saturation(self, sched_out: SchedulerOutput) -> None:
         """Per-phase saturation gauges from this schedule: prefill and
@@ -873,6 +942,13 @@ class LLMEngine:
         compile_stats = getattr(self.runner, "compile_stats", None)
         if compile_stats is not None:
             snap["compile"] = dict(compile_stats)
+        if self.roofline is not None:
+            # rolling-window MFU/MBU (engine_step_mfu /
+            # engine_step_mbu{phase}); the per-step series rides the
+            # flight recorder and /debug/engine
+            rf = self.roofline.snapshot(recent=0)
+            snap["roofline"] = {"mfu": rf["mfu"], "mbu": rf["mbu"],
+                                "window_steps": rf["window_steps"]}
         if self.config.async_scheduling:
             snap["async_fallback"] = dict(self.async_fallback)
         # device-memory ledger: per-component live/peak bytes
@@ -1062,7 +1138,8 @@ class LLMEngine:
             rec.record(s.request.additional_information.get("trace"),
                        "dispatch", w_d0, dur_disp,
                        stage_id=self.stage_id,
-                       args={"batch": len(scheduled)})
+                       args={"batch": len(scheduled)},
+                       **self.span_tags)
         self._inflight = _InflightStep(sched_out=sched_out, handle=handle)
         outs: list[OmniRequestOutput] = []
         new_total = 0
@@ -1138,7 +1215,7 @@ class LLMEngine:
         for s in scheds:
             rec.record(s.request.additional_information.get("trace"),
                        "retire", w_g0, dur, stage_id=self.stage_id,
-                       args={"batch": len(scheds)})
+                       args={"batch": len(scheds)}, **self.span_tags)
         outs = [OmniRequestOutput.from_pipeline(r) for r in finished]
         return outs, new_total, wait_s
 
@@ -1326,11 +1403,13 @@ class LLMEngine:
             rec.record(s.request.additional_information.get("trace"),
                        "prefill", w_ex0, dur_ex, stage_id=self.stage_id,
                        args={"tokens": s.num_new_tokens,
-                             "start_pos": s.start_pos})
+                             "start_pos": s.start_pos},
+                       **self.span_tags)
         for s in sched_out.decodes:
             rec.record(s.request.additional_information.get("trace"),
                        "decode", w_ex0, dur_ex, stage_id=self.stage_id,
-                       args={"tokens": s.num_new_tokens})
+                       args={"tokens": s.num_new_tokens},
+                       **self.span_tags)
         if self.kv_transfer_sink is not None:
             for req, _, _ in sched_out.kv_transfer_requests:
                 payload = run_out.extracted_kv.get(req.request_id)
@@ -1344,7 +1423,7 @@ class LLMEngine:
         for s in scheduled:
             rec.record(s.request.additional_information.get("trace"),
                        "sampling", w_up0, dur_up, stage_id=self.stage_id,
-                       args={"batch": len(scheduled)})
+                       args={"batch": len(scheduled)}, **self.span_tags)
         new_total = self._observe_token_latencies(scheduled, finished)
         total_s = time.perf_counter() - t_step0
         self.step_metrics.on_step(

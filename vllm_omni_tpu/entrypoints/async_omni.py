@@ -186,8 +186,13 @@ class AsyncOmni:
                                prompt_token_ids=list(prompt),
                                sampling_params=sp)
         # trace context + deadline BEFORE enqueue: the engine thread may
-        # drain the intake the instant the put lands
-        req.trace = self._omni.trace_begin(request_id)
+        # drain the intake the instant the put lands.  A caller-supplied
+        # trace_id (the server's traceparent / x-omni-trace-id join)
+        # rides additional_information and is consumed here — the
+        # journey continues the external trace instead of a fresh id
+        req.trace = self._omni.trace_begin(
+            request_id,
+            trace_id=req.additional_information.pop("trace_id", None))
         req.deadline_s = self._omni.deadline_begin(
             request_id,
             req.deadline_s if req.deadline_s is not None else deadline_s)

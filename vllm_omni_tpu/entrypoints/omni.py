@@ -191,12 +191,22 @@ class Omni:
     def tracing_enabled(self) -> bool:
         return self._trace_writer is not None
 
-    def trace_begin(self, request_id: str) -> Optional[dict]:
+    def trace_begin(self, request_id: str,
+                    trace_id: Optional[str] = None) -> Optional[dict]:
         """Create the request's trace context at arrival (None when
-        tracing is disabled — every recording call downstream no-ops)."""
-        if self._trace_writer is None:
+        tracing is disabled — every recording call downstream no-ops).
+
+        ``trace_id``: an EXTERNAL trace id to join (the OpenAI server's
+        ``traceparent`` / ``x-omni-trace-id`` headers, already
+        validated) — the request's spans continue the caller's trace
+        instead of minting a fresh id.  An explicit join also enables
+        recording without a writer: the caller opted this one request
+        into tracing, and the bounded recorder absorbs it."""
+        if self._trace_writer is None and trace_id is None:
             return None
         ctx = new_trace_context(request_id)
+        if trace_id:
+            ctx["trace_id"] = str(trace_id)
         self._trace_ctx[request_id] = ctx
         self._trace_arrival[request_id] = time.time()
         return ctx
@@ -353,7 +363,9 @@ class Omni:
                                          prompt_token_ids=list(p),
                                          sampling_params=sp))
             self.metrics.record_arrival(rid)
-            seed[-1].trace = self.trace_begin(rid)
+            seed[-1].trace = self.trace_begin(
+                rid, trace_id=seed[-1].additional_information.pop(
+                    "trace_id", None))
             # deadline armed at arrival; the seed request carries the
             # full budget into stage 0's admission
             seed[-1].deadline_s = self.deadline_begin(

@@ -308,6 +308,15 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         priority = self.headers.get("x-omni-priority")
         if priority:
             info["priority"] = priority
+        # external trace join (tracing/journey.py): a W3C traceparent
+        # or x-omni-trace-id header continues the CALLER's trace id
+        # through this request's journey spans — validated/bounded
+        # client input; the orchestrator mints the context at arrival
+        from vllm_omni_tpu.tracing import inbound_trace_id
+
+        tid = inbound_trace_id(self.headers)
+        if tid:
+            info["trace_id"] = tid
         return info
 
     def _body(self) -> dict:
@@ -417,6 +426,11 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             # in-flight re-role/scale operation, action ring;
             # {"enabled": false} on uncontrolled deployments
             return self._json(200, debugz.debug_controlplane(omni),
+                              default=str)
+        if path == "/debug/trace":
+            # trace-layer self-view (docs/observability.md): recorder
+            # occupancy, spans_dropped, writer paths, last export
+            return self._json(200, debugz.debug_trace(omni),
                               default=str)
         return self._error(404, f"unknown debug path {path}; "
                            f"see /debug/z")

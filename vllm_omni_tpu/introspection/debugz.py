@@ -32,7 +32,7 @@ from vllm_omni_tpu.introspection.flight_recorder import capture_stacks
 
 ENDPOINTS = ("/debug/engine", "/debug/requests", "/debug/kv",
              "/debug/flightrecorder", "/debug/stacks", "/debug/watchdog",
-             "/debug/disagg", "/debug/controlplane")
+             "/debug/disagg", "/debug/controlplane", "/debug/trace")
 
 
 # -------------------------------------------------------- request table
@@ -131,6 +131,11 @@ def engine_debug(engine) -> dict:
     ledger = getattr(engine, "memory", None)
     if ledger is not None:
         doc["device_memory"] = ledger.snapshot()
+    roofline = getattr(engine, "roofline", None)
+    if roofline is not None:
+        # rolling MFU/MBU window (metrics/roofline.py): the live
+        # roofline view — window means + the last ~32 per-step readings
+        doc["roofline"] = roofline.snapshot()
     return doc
 
 
@@ -252,6 +257,34 @@ def debug_controlplane(omni) -> dict:
         # same stance as _per_stage: a torn concurrent read degrades
         # to a retry marker, never a 500 on the debugging request
         return {"enabled": True, "error": repr(e), "retry": True}
+
+
+def debug_trace(omni) -> dict:
+    """Trace-layer self-view (docs/observability.md): recorder
+    occupancy + drop accounting, and — when a writer is configured —
+    its file paths, chrome-buffer bookkeeping, and the last-export
+    timestamp.  The one subsystem that had no /debug view of itself:
+    "why does my trace have holes" is answered here, not by reading
+    the jsonl backwards."""
+    from vllm_omni_tpu.tracing import get_recorder
+
+    rec = get_recorder()
+    writer = getattr(omni, "_trace_writer", None)
+    doc = {
+        "enabled": writer is not None,
+        "recorder": {
+            "buffered_spans": len(rec),
+            "capacity": rec.capacity,
+            "spans_dropped": rec.spans_dropped,
+        },
+    }
+    if writer is not None:
+        try:
+            doc["writer"] = writer.debug_snapshot()
+        except Exception as e:
+            # same stance as _per_stage: torn read -> retry marker
+            doc["writer"] = {"error": repr(e), "retry": True}
+    return doc
 
 
 def debug_index() -> dict:

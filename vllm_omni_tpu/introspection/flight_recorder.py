@@ -42,8 +42,12 @@ from vllm_omni_tpu.logger import init_logger
 logger = init_logger(__name__)
 
 # bump when the dump/record schema changes shape (incident tooling
-# parses these files long after the process that wrote them is gone)
-SCHEMA_VERSION = 1
+# parses these files long after the process that wrote them is gone).
+# v2: step records are record-schema v3 — they gain live roofline
+# attribution ("mfu"/"mbu"/"roofline_phase") and the capped
+# "trace_ids" journey cross-link (docs/debugging.md) — additive, so
+# v1 consumers keep parsing
+SCHEMA_VERSION = 2
 
 
 class FlightRecorder:

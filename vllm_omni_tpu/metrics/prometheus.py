@@ -142,6 +142,22 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         ("stage",)),
     "engine_step_padded_tokens_total": (
         "counter", "Padded device rows across dispatches", ("stage",)),
+    # ---- live roofline attribution (metrics/roofline.py,
+    # docs/performance.md): achieved FLOPs / HBM bytes per step from
+    # static model geometry × the token mix, over the platform peaks —
+    # rolling-window means, wall-clock denominator (host stalls count
+    # against utilization, exactly as they count against goodput)
+    "engine_step_mfu": (
+        "gauge",
+        "Achieved model FLOPs over the platform bf16 peak, rolling "
+        "window over recent steps (wall-clock denominator)", ("stage",)),
+    "engine_step_mbu": (
+        "gauge",
+        "Achieved HBM bytes (weights + KV traffic) over the platform "
+        "bandwidth peak per phase (prefill | decode | mixed — a "
+        "token-packed step carrying both row kinds reports honestly "
+        "as mixed), rolling window",
+        ("stage", "phase")),
     # jit shape-cache telemetry: the unified path shrinks the cache
     # from a (batch, seq) grid to a token-bucket line — measurable here
     "jit_compiles_total": (
@@ -491,6 +507,13 @@ def render_exposition(summary: dict, engine_snaps: dict,
                        padding.get("useful_tokens_total", 0))
             exp.sample("engine_step_padded_tokens_total", labels,
                        padding.get("padded_tokens_total", 0))
+        roofline = snap.get("roofline")
+        if roofline:
+            exp.sample("engine_step_mfu", labels,
+                       roofline.get("mfu", 0.0))
+            for phase, v in sorted((roofline.get("mbu") or {}).items()):
+                exp.sample("engine_step_mbu",
+                           {**labels, "phase": phase}, v)
         compile_stats = snap.get("compile")
         if compile_stats:
             exp.sample("jit_compiles_total", labels,

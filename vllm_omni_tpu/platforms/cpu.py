@@ -28,6 +28,12 @@ class CpuPlatform(OmniPlatform):
     def peak_tflops_bf16(self) -> float:
         return 0.5  # rough host-CPU figure; MFU on CPU is informational
 
+    def peak_hbm_gbps(self) -> float:
+        # rough dual-channel DDR figure; like the TFLOP/s peak above,
+        # CPU MBU is informational — the gauges must still be finite
+        # and nonzero so the metric surface exercises on the test lane
+        return 50.0
+
     def stage_device_env(self, devices: str = "all") -> dict:
         # children must not grab a TPU the parent may hold — nor load
         # ambient TPU PJRT plugins whose sitecustomize hangs at startup

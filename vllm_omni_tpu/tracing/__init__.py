@@ -1,12 +1,21 @@
 """Per-request distributed tracing: span recorder + trace-event export.
 
-See ``trace.py`` for the design; ``docs/observability.md`` for usage.
+See ``trace.py`` for the single-engine span layer, ``journey.py`` for
+the fleet-wide journey layer (router/handoff/control-plane spans,
+external trace joining); ``docs/observability.md`` for usage.
 """
 
+from vllm_omni_tpu.tracing.journey import (
+    inbound_trace_id,
+    journey_instant,
+    parse_traceparent,
+    record_journey,
+)
 from vllm_omni_tpu.tracing.trace import (
     TraceRecorder,
     TraceWriter,
     get_recorder,
+    iter_chrome_events,
     new_trace_context,
     to_chrome_trace,
 )
@@ -15,6 +24,11 @@ __all__ = [
     "TraceRecorder",
     "TraceWriter",
     "get_recorder",
+    "iter_chrome_events",
     "new_trace_context",
     "to_chrome_trace",
+    "inbound_trace_id",
+    "journey_instant",
+    "parse_traceparent",
+    "record_journey",
 ]

@@ -1,0 +1,138 @@
+"""omnijourney: fleet-wide request-journey tracing helpers.
+
+The PR 1 span layer (tracing/trace.py) stops at the single-engine
+boundary: engines record prefill/decode/dispatch/retire spans, stages
+ship them across process boundaries, and one request id yields one
+timeline — as long as exactly one engine served it.  PR 9/12 made the
+FLEET the unit of serving (router dispatch, KV handoff, failover,
+re-roling, WFQ), and the exact minute the control plane exists to
+explain — a drain→flip→re-admit under failover — was invisible.
+
+This module is the producing side of the journey layer:
+
+- **span vocabulary** for the fleet edges: router dispatch/failover/
+  shed, the prefill→decode KV handoff (ship/recv/adopt), degradation
+  transitions, and control-plane operations.  Every journey span
+  carries ``(trace_id, replica_id, role)`` so the exporter
+  (``iter_chrome_events``) lays each replica out on its own Perfetto
+  process track — the router and N same-process replicas must not
+  collide on one pid row.
+- **external trace joining**: ``inbound_trace_id`` parses the W3C
+  ``traceparent`` header (or the simpler ``x-omni-trace-id``) so a
+  request arriving from an already-traced caller continues the
+  caller's trace id instead of minting a fresh one.  Both are CLIENT
+  input: parsed defensively, length/charset bounded, never raised on.
+
+Recording remains enablement-by-context: no trace context on the
+request, no spans (one dict lookup per would-be span).  Control-plane
+operations are the one exception — they are fleet-scoped, not
+request-scoped, and rare (a handful per minute at most), so they ride
+a long-lived synthetic context and the bounded recorder ring absorbs
+them on untraced deployments.
+
+No jax imports, no device syncs — this module is on the router/engine
+hot path (omnilint HOT_PATHS) and must stay host-only.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Optional
+
+from vllm_omni_tpu.tracing.trace import get_recorder
+
+# ---------------------------------------------------------------- names
+#: router-edge spans (cat="router")
+SPAN_DISPATCH = "router_dispatch"
+SPAN_FAILOVER = "failover"
+SPAN_SHED = "shed"
+SPAN_DEGRADED = "degraded_dispatch"
+#: KV handoff spans (cat="handoff")
+SPAN_HANDOFF_SHIP = "kv_handoff_ship"
+SPAN_HANDOFF_RECV = "kv_handoff_recv"
+SPAN_ADOPT = "decode_adopt"
+#: control-plane operation spans (cat="controlplane"): "cp:" + kind —
+#: kinds are the controller's action/operation names (drain, undrain,
+#: rerole, scale_up, remove_replica, scale_down)
+CP_PREFIX = "cp:"
+
+#: the router's own pseudo-replica identity: router-scoped spans
+#: (dispatch decisions, sheds, handoff transport) get one track of
+#: their own instead of landing on whichever replica was involved
+ROUTER_TRACK = "router"
+
+
+def record_journey(ctx: Optional[dict], name: str, start_wall: float,
+                   dur_s: float, *, replica_id: str = ROUTER_TRACK,
+                   role: str = "router", cat: str = "router",
+                   args: Optional[dict] = None) -> None:
+    """Record one fleet span.  No-op without a trace context — the same
+    enablement switch every engine span uses."""
+    if not ctx:
+        return
+    get_recorder().record(ctx, name, start_wall, dur_s, cat=cat,
+                          args=args, replica_id=replica_id, role=role)
+
+
+def journey_instant(ctx: Optional[dict], name: str, *,
+                    replica_id: str = ROUTER_TRACK, role: str = "router",
+                    cat: str = "router",
+                    args: Optional[dict] = None) -> None:
+    """Zero-duration marker span (failover decisions, sheds, ladder
+    transitions — events, not intervals)."""
+    record_journey(ctx, name, time.time(), 0.0, replica_id=replica_id,
+                   role=role, cat=cat, args=args)
+
+
+# ------------------------------------------------------ external joins
+# W3C traceparent: version "-" 32 lowercase hex trace-id "-" 16 hex
+# parent-id "-" 2 hex flags.  An all-zero trace id is the spec's
+# "invalid" sentinel and must not be joined.
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$")
+# x-omni-trace-id: our own lighter header — hex/word chars, bounded
+_OMNI_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_\-]{1,64}$")
+
+
+def parse_traceparent(value) -> Optional[str]:
+    """W3C ``traceparent`` header -> trace id, or None when malformed
+    (client input: never raises)."""
+    if not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    tid = m.group(1)
+    if tid == "0" * 32:
+        return None
+    return tid
+
+
+def inbound_trace_id(headers) -> Optional[str]:
+    """Join an external trace: ``x-omni-trace-id`` wins (explicit
+    opt-in to OUR tracing), then ``traceparent`` (ambient W3C context).
+    ``headers`` is any mapping with ``.get`` (http.server's message
+    object is case-insensitive).  Returns a validated trace id or
+    None."""
+    try:
+        raw = headers.get("x-omni-trace-id")
+    except Exception:
+        return None
+    if raw and _OMNI_TRACE_ID_RE.match(str(raw).strip()):
+        return str(raw).strip()
+    try:
+        tp = headers.get("traceparent")
+    except Exception:
+        return None
+    if tp:
+        return parse_traceparent(tp)
+    return None
+
+
+__all__ = [
+    "SPAN_DISPATCH", "SPAN_FAILOVER", "SPAN_SHED", "SPAN_DEGRADED",
+    "SPAN_HANDOFF_SHIP", "SPAN_HANDOFF_RECV", "SPAN_ADOPT", "CP_PREFIX",
+    "ROUTER_TRACK", "record_journey", "journey_instant",
+    "parse_traceparent", "inbound_trace_id",
+]

@@ -69,10 +69,18 @@ class TraceRecorder:
         stage_id: int = -1,
         cat: str = "engine",
         args: Optional[dict] = None,
+        replica_id: Optional[str] = None,
+        role: Optional[str] = None,
     ) -> None:
         """Record one finished span.  ``ctx`` None means the request is
         untraced — the call is a no-op (this is the enablement switch:
-        no trace context, no spans)."""
+        no trace context, no spans).
+
+        ``replica_id``/``role``: fleet identity (docs/observability.md
+        journey traces).  Spans carrying a replica id render on their
+        own Perfetto process track — N same-process engine replicas
+        stepped by one router must not collide on one pid row the way
+        same-process pipeline stages deliberately do."""
         if not ctx:
             return
         span = {
@@ -84,6 +92,10 @@ class TraceRecorder:
             "ts_us": start_ts * 1e6,
             "dur_us": max(dur_s, 0.0) * 1e6,
         }
+        if replica_id is not None:
+            span["replica_id"] = replica_id
+        if role is not None:
+            span["role"] = role
         if args:
             span["args"] = args
         with self._lock:
@@ -114,6 +126,10 @@ class TraceRecorder:
         with self._lock:
             return self._dropped
 
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans)
@@ -129,25 +145,54 @@ def get_recorder() -> TraceRecorder:
 
 
 # ------------------------------------------------------------- exporters
-def to_chrome_trace(spans: list[dict]) -> dict:
-    """Spans -> Chrome trace-event JSON (Perfetto loadable).
+#: pid base for per-replica process tracks — far above any plausible
+#: stage_id+1 pid so the two namespaces can never collide
+_REPLICA_PID_BASE = 1000
 
-    pid = stage_id + 1 (pid 0 is the orchestrator, whose spans carry
-    stage_id -1); tid = one lane per (pid, request_id) so concurrent
-    requests don't overlap in the track view.  Metadata events name the
-    processes/threads."""
-    events: list[dict] = []
+
+def iter_chrome_events(spans):
+    """Spans -> Chrome trace events, one at a time (the streaming core
+    shared by ``to_chrome_trace`` and ``TraceWriter.export_chrome`` —
+    the writer must never materialize a second full copy of the span
+    buffer just to serialize it).
+
+    Track layout (docs/observability.md journey-trace tour):
+
+    - spans WITHOUT a replica id: pid = stage_id + 1 (pid 0 is the
+      orchestrator, whose spans carry stage_id -1) — the classic
+      pipeline-stage layout;
+    - spans WITH a replica id (fleet spans: engine replicas behind a
+      DisaggRouter, the router itself, control-plane operations): one
+      pid per distinct replica id, allocated in first-seen order from
+      ``_REPLICA_PID_BASE`` — N same-process replicas get N tracks
+      instead of colliding on one stage row;
+    - tid = one lane per (pid, request_id) so concurrent requests don't
+      overlap in the track view.  Metadata events name every process
+      and thread, emitted after the X events."""
     tids: dict[tuple, int] = {}
-    pids: set[int] = set()
+    stage_pids: set[int] = set()
+    replica_pids: dict[str, int] = {}
+    replica_roles: dict[str, str] = {}
     for s in spans:
-        pid = int(s.get("stage_id", -1)) + 1
-        pids.add(pid)
+        rid = s.get("replica_id")
+        if rid is not None:
+            pid = replica_pids.setdefault(
+                rid, _REPLICA_PID_BASE + len(replica_pids))
+            if s.get("role"):
+                replica_roles[rid] = s["role"]  # last role wins
+        else:
+            pid = int(s.get("stage_id", -1)) + 1
+            stage_pids.add(pid)
         key = (pid, s.get("request_id", ""))
         tid = tids.setdefault(key, len(tids) + 1)
         args = {"trace_id": s.get("trace_id", ""),
                 "request_id": s.get("request_id", "")}
+        if rid is not None:
+            args["replica_id"] = rid
+            if s.get("role"):
+                args["role"] = s["role"]
         args.update(s.get("args") or {})
-        events.append({
+        yield {
             "name": s.get("name", ""),
             "cat": s.get("cat", ""),
             "ph": "X",
@@ -156,19 +201,34 @@ def to_chrome_trace(spans: list[dict]) -> dict:
             "pid": pid,
             "tid": tid,
             "args": args,
-        })
-    for pid in sorted(pids):
-        events.append({
+        }
+    for pid in sorted(stage_pids):
+        yield {
             "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
             "args": {"name": ("orchestrator" if pid == 0
                               else f"stage_{pid - 1}")},
-        })
-    for (pid, rid), tid in tids.items():
-        events.append({
+        }
+    for rid, pid in replica_pids.items():
+        role = replica_roles.get(rid)
+        yield {
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": (f"replica:{rid} ({role})" if role
+                              else f"replica:{rid}")},
+        }
+    for (pid, req_id), tid in tids.items():
+        yield {
             "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
-            "args": {"name": rid or "-"},
-        })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+            "args": {"name": req_id or "-"},
+        }
+
+
+def to_chrome_trace(spans: list[dict]) -> dict:
+    """Spans -> a complete Chrome trace-event document (Perfetto
+    loadable).  Convenience face of ``iter_chrome_events`` for bounded
+    span lists; long-running writers stream instead
+    (``TraceWriter.export_chrome``)."""
+    return {"traceEvents": list(iter_chrome_events(spans)),
+            "displayTimeUnit": "ms"}
 
 
 class TraceWriter:
@@ -177,12 +237,28 @@ class TraceWriter:
     files) and rewrites ``{prefix}.trace.json`` as a complete Chrome
     trace on every ``export_chrome``.  The in-memory accumulation for the
     Chrome export is bounded so a long-running server doesn't hold a
-    lifetime of spans (the JSONL keeps the full history)."""
+    lifetime of spans (the JSONL keeps the full history), spans the cap
+    pushed out are COUNTED (``chrome_spans_dropped``), and the export
+    declares its own truncation in ``otherData`` instead of silently
+    presenting a tail as the whole story.  The export itself streams
+    event-by-event — serializing 200k spans must not build a second
+    full copy of the buffer in memory."""
 
     def __init__(self, path_prefix: str, chrome_capacity: int = 200_000):
         self._prefix = path_prefix
         self._spans: deque = deque(maxlen=chrome_capacity)
         self._lock = traced(threading.Lock(), "TraceWriter._lock")
+        # spans the bounded chrome buffer evicted before any export
+        # (lifetime) — the truncation note in the export metadata; the
+        # JSONL still has them
+        self._chrome_dropped = 0
+        self._last_export_ts: Optional[float] = None
+        # serializes whole exports (heartbeat vs shutdown flush) so two
+        # concurrent export_chrome calls never interleave on the same
+        # file; distinct from _lock so recording threads don't convoy
+        # behind export IO
+        self._export_lock = traced(threading.Lock(),
+                                   "TraceWriter._export_lock")
 
     @property
     def jsonl_path(self) -> str:
@@ -196,6 +272,10 @@ class TraceWriter:
         if not spans:
             return
         with self._lock:
+            cap = self._spans.maxlen or 0
+            overflow = (len(self._spans) + len(spans)) - cap
+            if cap and overflow > 0:
+                self._chrome_dropped += overflow
             self._spans.extend(spans)
             # omnilint: disable=OL9 - the jsonl append must stay
             # ordered with the chrome buffer extend above; writers are
@@ -205,8 +285,58 @@ class TraceWriter:
                     f.write(json.dumps(s) + "\n")
 
     def export_chrome(self) -> str:
-        with self._lock:
-            doc = to_chrome_trace(list(self._spans))
-        with open(self.chrome_path, "w") as f:
-            json.dump(doc, f)
+        import os
+        import time as _time
+
+        with self._export_lock:
+            with self._lock:
+                spans = list(self._spans)
+                dropped = self._chrome_dropped
+            # serialize OUTSIDE the span lock (recording threads must
+            # not convoy behind file IO), streaming one event at a
+            # time into a temp file swapped in atomically — a reader
+            # (or a crashed export) never sees a half-written document
+            tmp = f"{self.chrome_path}.tmp"
+            # omnilint: disable=OL9 - file IO under the EXPORT lock is
+            # the point: it serializes rare whole-document exports
+            # against each other; span recording rides _lock only and
+            # never waits here
+            with open(tmp, "w") as f:
+                f.write('{"traceEvents":[')
+                first = True
+                for ev in iter_chrome_events(spans):
+                    if not first:
+                        f.write(",")
+                    first = False
+                    f.write(json.dumps(ev))
+                meta = {
+                    "spans": len(spans),
+                    "spans_dropped": dropped,
+                    "truncated": dropped > 0,
+                    "note": ("chrome buffer capped; the .trace.jsonl "
+                             "keeps the full span history"
+                             if dropped > 0 else "complete"),
+                }
+                f.write('],"displayTimeUnit":"ms","otherData":'
+                        + json.dumps(meta) + "}")
+            os.replace(tmp, self.chrome_path)
+            with self._lock:
+                self._last_export_ts = _time.time()
         return self.chrome_path
+
+    @property
+    def chrome_spans_dropped(self) -> int:
+        with self._lock:
+            return self._chrome_dropped
+
+    def debug_snapshot(self) -> dict:
+        """/debug/trace: writer paths + chrome-buffer bookkeeping."""
+        with self._lock:
+            return {
+                "jsonl_path": self.jsonl_path,
+                "chrome_path": self.chrome_path,
+                "buffered_spans": len(self._spans),
+                "chrome_capacity": self._spans.maxlen,
+                "chrome_spans_dropped": self._chrome_dropped,
+                "last_export_ts": self._last_export_ts,
+            }
