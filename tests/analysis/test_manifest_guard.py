@@ -1,0 +1,57 @@
+"""Manifest drift guard: every HOT_PATHS/THREADED_PATHS/BENCH_PATHS/
+PROTOCOL_MODULES/LOCK_GUARDS/SANITIZERS entry must resolve to real
+code — a renamed module/class fails the lint run loudly instead of
+silently un-linting whatever the entry used to cover.
+"""
+
+import pytest
+
+from vllm_omni_tpu.analysis import manifest as m
+from vllm_omni_tpu.analysis.__main__ import main
+
+
+def test_committed_manifest_resolves():
+    m.validate_manifest()
+
+
+def test_bogus_hot_path_entry_fails_loudly(monkeypatch):
+    monkeypatch.setattr(
+        m, "HOT_PATHS", m.HOT_PATHS + ("vllm_omni_tpu/renamed_away/",))
+    with pytest.raises(m.ManifestError, match="renamed_away"):
+        m.validate_manifest()
+
+
+def test_bogus_bench_file_entry_fails_loudly(monkeypatch):
+    monkeypatch.setattr(
+        m, "BENCH_PATHS", m.BENCH_PATHS + ("vllm_omni_tpu/gone.py",))
+    with pytest.raises(m.ManifestError, match="gone.py"):
+        m.validate_manifest()
+
+
+def test_renamed_lock_guard_class_fails_loudly(monkeypatch):
+    guards = dict(m.LOCK_GUARDS)
+    guards["vllm_omni_tpu/metrics/stats.py::RenamedHistogram"] = {
+        "_lock": ("_counts",)}
+    monkeypatch.setattr(m, "LOCK_GUARDS", guards)
+    with pytest.raises(m.ManifestError, match="RenamedHistogram"):
+        m.validate_manifest()
+
+
+def test_renamed_sanitizer_fails_loudly(monkeypatch):
+    san = dict(m.SANITIZERS)
+    san["sanitize_everything"] = "vllm_omni_tpu/metrics/stats.py"
+    monkeypatch.setattr(m, "SANITIZERS", san)
+    with pytest.raises(m.ManifestError, match="sanitize_everything"):
+        m.validate_manifest()
+
+
+def test_cli_exits_2_on_broken_manifest(monkeypatch, tmp_path):
+    # the lint RUN fails, not just a helper: scripts/omnilint.sh stops
+    # before reporting anything clean
+    monkeypatch.setattr(
+        m, "HOT_PATHS", m.HOT_PATHS + ("vllm_omni_tpu/renamed_away/",))
+    f = tmp_path / "empty.py"
+    f.write_text("x = 1\n")
+    with pytest.raises(SystemExit) as exc:
+        main([str(f)])
+    assert exc.value.code == 2

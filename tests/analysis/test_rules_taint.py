@@ -1,0 +1,379 @@
+"""OL10 hostile-input taint: manifest sources reaching manifest sinks
+without a declared sanitizer crossing — resolved package-wide over the
+ProgramGraph (finalize_run), so single-file fixtures ride
+``analyze_source`` and cross-module flows ride ``analyze_sources``.
+"""
+
+from vllm_omni_tpu.analysis.engine import analyze_source, analyze_sources
+from tests.analysis.util import messages
+
+
+def lint10(src, path="vllm_omni_tpu/entrypoints/fix.py"):
+    return [f for f in analyze_source(src, path)
+            if f.rule == "OL10" and not f.suppressed]
+
+
+# ------------------------------------------------------------ direct flows
+def test_header_to_log_fstring():
+    src = '''
+def handle(self, headers):
+    tenant = headers.get("x-omni-tenant")
+    logger.info(f"serving tenant={tenant}")
+'''
+    found = lint10(src)
+    assert len(found) == 1, messages(found)
+    assert "x-omni-tenant" in found[0].message
+    assert "log" in found[0].message
+    assert "sanitizer" in found[0].message.lower()
+
+
+def test_dict_key_flow_into_fmt_labels():
+    # the PR 7 shape: raw tenant -> label dict -> exposition formatting
+    src = '''
+def render(self, headers):
+    tenant = headers.get("x-omni-tenant")
+    labels = {"tenant": tenant}
+    return _fmt_labels(labels)
+'''
+    found = lint10(src, "vllm_omni_tpu/metrics/fix.py")
+    assert len(found) == 1, messages(found)
+    assert "_fmt_labels" in found[0].message
+    assert "metric-label" in found[0].message
+
+
+def test_header_subscript_source_and_fs_sink():
+    src = '''
+def dump(self, headers):
+    name = headers["x-omni-trace-id"]
+    with open("/tmp/traces/" + name, "w") as fh:
+        fh.write("x")
+'''
+    found = lint10(src)
+    assert len(found) == 1, messages(found)
+    assert "filesystem-path" in found[0].message
+
+
+def test_additional_information_to_scheduler_arithmetic():
+    src = '''
+def order(self, req):
+    weight = req.additional_information.get("priority")
+    return self.quantum * weight
+'''
+    found = lint10(src, "vllm_omni_tpu/core/scheduler.py")
+    assert len(found) == 1, messages(found)
+    assert "scheduler arithmetic" in found[0].message
+
+
+def test_connector_meta_source():
+    src = '''
+def adopt(self, conn, key):
+    meta = conn.get(f"{key}/meta")
+    logger.warning("payload meta %s", meta)
+'''
+    found = lint10(src, "vllm_omni_tpu/disagg/fix.py")
+    assert len(found) == 1, messages(found)
+    assert "payload metadata" in found[0].message
+
+
+# ------------------------------------------------------------- sanitizers
+def test_sanitized_flow_is_clean():
+    src = '''
+from vllm_omni_tpu.metrics.stats import sanitize_tenant
+def render(self, headers):
+    tenant = sanitize_tenant(headers.get("x-omni-tenant"))
+    return _fmt_labels({"tenant": tenant})
+'''
+    assert lint10(src, "vllm_omni_tpu/metrics/fix.py") == []
+
+
+def test_sanitizer_on_one_branch_only_still_flags():
+    # the classic half-fix: the else branch keeps the raw bytes alive
+    src = '''
+def record(self, headers):
+    raw = headers.get("x-omni-priority")
+    if raw and raw.isdigit():
+        p = sanitize_priority(raw)
+    else:
+        p = raw
+    logger.info(f"priority={p}")
+'''
+    found = lint10(src)
+    assert len(found) == 1, messages(found)
+
+
+def test_both_branches_sanitized_is_clean():
+    src = '''
+def record(self, headers):
+    raw = headers.get("x-omni-priority")
+    if raw:
+        p = sanitize_priority(raw)
+    else:
+        p = sanitize_priority(None)
+    logger.info(f"priority={p}")
+'''
+    assert lint10(src) == []
+
+
+def test_internal_underscore_keys_are_engine_state():
+    # additional_information doubles as the engine's scratch namespace;
+    # underscore-prefixed keys are engine-written, not client input
+    src = '''
+def resume(self, req):
+    parked = req.additional_information.get("_parked_len", 0)
+    chunks = req.additional_information.pop("_hidden_chunks", None)
+    return self.budget - parked
+'''
+    assert lint10(src, "vllm_omni_tpu/core/scheduler.py") == []
+
+
+def test_cap_tenant_is_a_sink_not_a_sanitizer():
+    # cap_tenant bounds CARDINALITY, not content — raw bytes through it
+    # still reach the ledger key
+    src = '''
+def shed(self, headers):
+    t = headers.get("x-omni-tenant")
+    return cap_tenant(t, self.tenants)
+'''
+    found = lint10(src, "vllm_omni_tpu/core/fix.py")
+    assert len(found) == 1, messages(found)
+
+
+# -------------------------------------------------------- interprocedural
+def test_helper_indirection_same_file():
+    src = '''
+class H:
+    def _read(self, headers):
+        return headers.get("x-omni-tenant")
+
+    def record(self, headers):
+        t = self._read(headers)
+        logger.info(f"tenant={t}")
+'''
+    found = lint10(src)
+    assert len(found) == 1, messages(found)
+    assert "_read" in found[0].message  # names the source end
+
+
+def test_tainted_argument_seeds_the_callee():
+    # the sink lives INSIDE the helper; the hostile read is the caller's
+    src = '''
+class H:
+    def _label(self, tenant):
+        return _fmt_labels({"tenant": tenant})
+
+    def record(self, headers):
+        return self._label(headers.get("x-omni-tenant"))
+'''
+    found = lint10(src, "vllm_omni_tpu/metrics/fix.py")
+    assert len(found) == 1, messages(found)
+    assert "record" in found[0].message  # the crossing is in the trail
+
+
+def test_helper_return_nested_directly_in_sink_arg():
+    # the helper call sits INSIDE the sink's argument list — no
+    # intermediate name — and its return taint must still arrive
+    src = '''
+class H:
+    def _norm(self, v):
+        return v
+
+    def record(self, headers):
+        t = headers.get("x-omni-tenant")
+        logger.info("t=%s", self._norm(t))
+'''
+    found = lint10(src)
+    assert len(found) == 1, messages(found)
+
+
+def test_call_in_if_test_seeds_the_callee():
+    # a call in an `if` test (neither a bare statement nor an
+    # assignment RHS) still carries its argument into the callee, so
+    # the sink inside the callee reports
+    src = '''
+class H:
+    def _record(self, v):
+        logger.info("t=%s", v)
+        return True
+
+    def handle(self, headers):
+        t = headers.get("x-omni-tenant")
+        if self._record(t):
+            pass
+'''
+    found = lint10(src)
+    assert len(found) == 1, messages(found)
+
+
+def test_deep_flow_found_regardless_of_caller_sort_order():
+    # a_caller sorts FIRST and reaches the whole helper chain with a
+    # reduced depth budget; the truncated results must not be memoized
+    # over z_sink's own full-depth top-level analysis (memo is keyed
+    # on depth)
+    src = '''
+def a_caller(headers):
+    z_sink(headers)
+
+def z_sink(headers):
+    t = h2(headers)
+    logger.info(f"t={t}")
+
+def h2(headers):
+    return h3(headers)
+
+def h3(headers):
+    return h4(headers)
+
+def h4(headers):
+    return h5(headers)
+
+def h5(headers):
+    return headers.get("x-omni-tenant")
+'''
+    found = lint10(src)
+    assert len(found) == 1, messages(found)
+
+
+def test_staticmethod_params_keep_their_first_slot():
+    # self._label(...) on a @staticmethod has NO implicit self slot —
+    # the first real parameter must still receive the tainted argument
+    src = '''
+class H:
+    @staticmethod
+    def _label(tenant):
+        return _fmt_labels({"tenant": tenant})
+
+    def record(self, headers):
+        return self._label(headers.get("x-omni-tenant"))
+'''
+    found = lint10(src, "vllm_omni_tpu/metrics/fix.py")
+    assert len(found) == 1, messages(found)
+
+
+def test_incremental_run_state_rebuilds_the_graph():
+    # analyze_source's documented shared-run_state protocol: files
+    # added AFTER a finalize must be visible to the next finalize (the
+    # files dict mutates in place — the graph cannot cache by dict
+    # identity)
+    from vllm_omni_tpu.analysis.engine import finalize_findings
+
+    state: dict = {}
+    analyze_source("def ok():\n    return 1\n",
+                   "vllm_omni_tpu/entrypoints/a.py", run_state=state)
+    finalize_findings(None, state)
+    analyze_source('''
+def handle(self, headers):
+    tenant = headers.get("x-omni-tenant")
+    logger.info(f"tenant={tenant}")
+''', "vllm_omni_tpu/entrypoints/b.py", run_state=state)
+    found = [f for f in finalize_findings(None, state)
+             if f.rule == "OL10" and not f.suppressed]
+    assert len(found) == 1, messages(found)
+    assert found[0].path == "vllm_omni_tpu/entrypoints/b.py"
+
+
+def test_cross_module_flow_names_both_ends():
+    srcs = {
+        "vllm_omni_tpu/entrypoints/hdr.py": '''
+def read_tenant(headers):
+    return headers.get("x-omni-tenant")
+''',
+        "vllm_omni_tpu/metrics/lbl.py": '''
+from vllm_omni_tpu.entrypoints.hdr import read_tenant
+
+def emit(headers):
+    t = read_tenant(headers)
+    return cap_tenant(t, set())
+''',
+    }
+    found = [f for f in analyze_sources(srcs)
+             if f.rule == "OL10" and not f.suppressed]
+    assert len(found) == 1, messages(found)
+    # anchored at the sink, naming the source file like an OL8 cycle
+    assert found[0].path == "vllm_omni_tpu/metrics/lbl.py"
+    assert "vllm_omni_tpu/entrypoints/hdr.py" in found[0].message
+    assert "read_tenant" in found[0].message
+
+
+def test_imported_function_not_shadowed_by_same_named_method():
+    # a bare name can never invoke a method: an unrelated method named
+    # like the imported helper must not swallow the call edge
+    srcs = {
+        "vllm_omni_tpu/metrics/util.py": '''
+def fmt(v):
+    return _fmt_labels({"tenant": v})
+''',
+        "vllm_omni_tpu/entrypoints/srv.py": '''
+from vllm_omni_tpu.metrics.util import fmt
+
+class Other:
+    def fmt(self, y):
+        return y
+
+def emit(headers):
+    return fmt(headers.get("x-omni-tenant"))
+''',
+    }
+    found = [f for f in analyze_sources(srcs)
+             if f.rule == "OL10" and not f.suppressed]
+    assert len(found) == 1, messages(found)
+    assert found[0].path == "vllm_omni_tpu/metrics/util.py"
+
+
+def test_unbound_method_call_passes_self_explicitly():
+    # Cls.method(obj, tainted): self is the FIRST positional — the
+    # tainted second argument must land on the second parameter
+    src = '''
+class C:
+    def use(self, x):
+        return _fmt_labels({"tenant": x})
+
+def emit(c, headers):
+    return C.use(c, headers.get("x-omni-tenant"))
+'''
+    found = lint10(src, "vllm_omni_tpu/metrics/fix.py")
+    assert len(found) == 1, messages(found)
+
+
+def test_suppression_with_reason_is_honored():
+    src = '''
+def handle(self, headers):
+    tenant = headers.get("x-omni-tenant")
+    logger.info("t=%s", tenant)  # omnilint: disable=OL10 - bounded upstream
+'''
+    assert lint10(src) == []
+
+
+# ------------------------------------------------- PR 7 bug re-introduction
+def test_pr7_unsanitized_tenant_label_is_caught_by_exactly_ol10():
+    """The PR 7 bug, re-introduced as a two-module fixture: the OpenAI
+    server's raw x-omni-tenant header riding request metadata into the
+    Prometheus label formatter with the sanitize_tenant crossing
+    removed.  OL10 (and only OL10) must catch it."""
+    srcs = {
+        "vllm_omni_tpu/entrypoints/srv.py": '''
+from vllm_omni_tpu.metrics.expo import record_finish
+
+class Handler:
+    def _tenant_info(self):
+        info = {}
+        tenant = self.headers.get("x-omni-tenant")
+        if tenant:
+            info["tenant"] = tenant
+        return info
+
+    def observe(self):
+        info = self._tenant_info()
+        record_finish(info)
+''',
+        "vllm_omni_tpu/metrics/expo.py": '''
+def record_finish(info):
+    tenant = info.get("tenant")
+    return _fmt_labels({"tenant": tenant})
+''',
+    }
+    found = [f for f in analyze_sources(srcs) if not f.suppressed]
+    assert found, "the re-introduced PR 7 bug went undetected"
+    assert {f.rule for f in found} == {"OL10"}, messages(found)
+    assert any("_fmt_labels" in f.message
+               and "x-omni-tenant" in f.message for f in found), \
+        messages(found)

@@ -1,0 +1,81 @@
+"""SARIF 2.1.0 output: rule metadata, locations, fingerprints — and
+the round trip from findings to the emitted document back to the same
+facts, which is what a CI annotator consumes.
+"""
+
+import json
+
+from vllm_omni_tpu.analysis import analyze_source
+from vllm_omni_tpu.analysis.__main__ import main
+from vllm_omni_tpu.analysis.sarif import (
+    RULE_DESCRIPTIONS,
+    to_sarif,
+    write_sarif,
+)
+
+SRC = '''
+def handle(self, headers):
+    tenant = headers.get("x-omni-tenant")
+    logger.info(f"tenant={tenant}")
+'''
+
+
+def _findings():
+    return analyze_source(SRC, "vllm_omni_tpu/entrypoints/fix.py")
+
+
+def test_document_shape_and_rule_catalogue():
+    doc = to_sarif(_findings())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rules = run["tool"]["driver"]["rules"]
+    ids = [r["id"] for r in rules]
+    # the full catalogue ships even when only one family fired, so CI
+    # can render any finding the next push produces
+    for rid in RULE_DESCRIPTIONS:
+        assert rid in ids
+    for r in rules:
+        assert r["shortDescription"]["text"]
+
+
+def test_round_trip_results_match_findings():
+    findings = _findings()
+    new = [f for f in findings if not f.suppressed and not f.baselined]
+    assert new, "fixture must produce a finding"
+    results = to_sarif(findings)["runs"][0]["results"]
+    assert len(results) == len(new)
+    for f, r in zip(new, results):
+        assert r["ruleId"] == f.rule
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == f.path
+        assert loc["region"]["startLine"] == f.line
+        assert (r["partialFingerprints"]["omnilintFingerprint/v1"]
+                == f.fingerprint)
+        assert f.message in r["message"]["text"]
+
+
+def test_suppressed_findings_are_excluded():
+    src = SRC.replace(
+        'logger.info(f"tenant={tenant}")',
+        'logger.info(f"tenant={tenant}")  '
+        '# omnilint: disable=OL10 - fixture')
+    findings = analyze_source(src, "vllm_omni_tpu/entrypoints/fix.py")
+    assert any(f.suppressed for f in findings)
+    assert to_sarif(findings)["runs"][0]["results"] == []
+
+
+def test_write_sarif_and_cli_hook(tmp_path):
+    out = tmp_path / "omni.sarif"
+    write_sarif(_findings(), str(out))
+    doc = json.loads(out.read_text())
+    assert doc["runs"][0]["results"]
+
+    # the CLI face scripts/omnilint.sh's OMNI_LINT_SARIF hook rides
+    fixture = tmp_path / "fix.py"
+    fixture.write_text(SRC)
+    cli_out = tmp_path / "cli.sarif"
+    rc = main(["--no-baseline", "--sarif-out", str(cli_out),
+               str(fixture)])
+    assert rc == 1  # the finding also fails the gate
+    doc = json.loads(cli_out.read_text())
+    assert [r["ruleId"] for r in doc["runs"][0]["results"]] == ["OL10"]

@@ -1,0 +1,207 @@
+"""Stale-suppression audit: `# omnilint: disable=OLx` comments that no
+longer suppress anything (and baseline entries nothing produces) are
+dead armor — the audit finds them and ``scripts/omnilint.sh`` fails on
+them.
+"""
+
+import json
+
+from vllm_omni_tpu.analysis.engine import (
+    analyze_source,
+    finalize_findings,
+    stale_baseline_entries,
+    stale_suppressions,
+)
+from vllm_omni_tpu.analysis.__main__ import main
+
+HOT = "vllm_omni_tpu/ops/fixture.py"
+
+LIVE = '''
+import jax
+
+def step(arr):
+    return jax.device_get(arr)  # omnilint: disable=OL2 - batch boundary
+'''
+
+STALE = '''
+import jax
+
+def step(arr):
+    x = arr.shape[0]  # omnilint: disable=OL2 - nothing to suppress
+    return x
+'''
+
+
+def _audit(src, path=HOT):
+    state = {}
+    analyze_source(src, path, run_state=state)
+    finalize_findings(None, state)
+    return stale_suppressions(state)
+
+
+def test_live_suppression_is_not_stale():
+    assert _audit(LIVE) == []
+
+
+def test_dead_suppression_is_stale():
+    stale = _audit(STALE)
+    assert len(stale) == 1
+    path, line, rule = stale[0]
+    assert path == HOT and rule == "OL2"
+
+
+def test_docstring_example_is_not_a_suppression():
+    src = '''
+"""Example in documentation::
+
+    x = jax.device_get(t)  # omnilint: disable=OL2 - example only
+"""
+'''
+    assert _audit(src) == []
+
+
+def test_wrong_rule_id_on_real_finding_is_stale():
+    # the finding fires (unsuppressed) AND the comment is dead: the
+    # audit catches a disable targeting the wrong family
+    src = '''
+import jax
+
+def step(arr):
+    return jax.device_get(arr)  # omnilint: disable=OL4 - wrong family
+'''
+    state = {}
+    found = analyze_source(src, HOT, run_state=state)
+    assert any(f.rule == "OL2" and not f.suppressed for f in found)
+    stale = stale_suppressions(state)
+    assert len(stale) == 1 and stale[0][2] == "OL4"
+
+
+def test_stale_baseline_entries():
+    baseline = {"OL2|gone.py|fn|msg": 1}
+    assert stale_baseline_entries([], baseline) == ["OL2|gone.py|fn|msg"]
+
+
+def test_baseline_entries_outside_the_analyzed_set_are_unjudged():
+    # a path-subset run never analyzed worker/ — an EXISTING file's
+    # baseline debt is unjudgeable, not stale (the gate must not cry
+    # wolf); a file gone from disk stays judgeable everywhere (the
+    # classic deleted/renamed stale debt)
+    existing = "vllm_omni_tpu/worker/model_runner.py"
+    baseline = {f"OL2|{existing}|fn|msg": 1,
+                "OL2|vllm_omni_tpu/worker/deleted.py|fn|msg": 1}
+    assert stale_baseline_entries(
+        [], baseline, {"vllm_omni_tpu/ops/y.py"}) == [
+            "OL2|vllm_omni_tpu/worker/deleted.py|fn|msg"]
+    assert stale_baseline_entries(
+        [], baseline, {existing}) == sorted(baseline)
+
+
+# ------------------------------------------------------------- CLI gate
+# OL1 scopes by no path manifest, so the fixture fires (and its
+# suppression stays live) from a pytest tmp_path too
+LIVE_ANYWHERE = '''
+import jax
+
+@jax.jit
+def step(x):
+    if x > 0:  # omnilint: disable=OL1 - fixture, deliberate
+        x = -x
+    return x
+'''
+
+
+def test_cli_clean_tree_exits_zero(tmp_path):
+    f = tmp_path / "clean.py"
+    f.write_text(LIVE_ANYWHERE)
+    assert main(["--report-stale-suppressions", str(f)]) == 0
+
+
+def test_cli_fails_on_injected_stale_suppression(tmp_path):
+    # the scripts/omnilint.sh hard gate: an injected stale disable
+    # fails the run
+    f = tmp_path / "stale.py"
+    f.write_text(STALE)
+    assert main(["--report-stale-suppressions", str(f)]) == 1
+
+
+def test_cli_fails_on_stale_baseline_entry(tmp_path):
+    f = tmp_path / "clean.py"
+    f.write_text(LIVE_ANYWHERE)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"findings": {"OL2|gone.py|fn|msg": 1}}))
+    assert main(["--report-stale-suppressions",
+                 "--baseline", str(baseline), str(f)]) == 1
+
+
+def test_cli_stale_audit_combined_gate(tmp_path):
+    # --stale-audit: gate + audit over ONE analysis pass (the
+    # scripts/omnilint.sh mode) — clean tree passes, a stale disable
+    # fails, a new finding fails
+    clean = tmp_path / "clean.py"
+    clean.write_text(LIVE_ANYWHERE)
+    empty = tmp_path / "baseline.json"
+    empty.write_text(json.dumps({"findings": {}}))
+    assert main(["--stale-audit", "--baseline", str(empty),
+                 str(clean)]) == 0
+    stale = tmp_path / "stale.py"
+    stale.write_text(STALE)
+    assert main(["--stale-audit", "--baseline", str(empty),
+                 str(stale)]) == 1
+    hot = tmp_path / "finding.py"
+    hot.write_text("import jax\n\n@jax.jit\ndef step(x):\n"
+                   "    if x > 0:\n        x = -x\n    return x\n")
+    assert main(["--stale-audit", "--baseline", str(empty),
+                 str(hot)]) == 1
+
+
+def test_cli_stale_audit_keeps_json_stdout_parseable(tmp_path, capsys):
+    # audit detail must not corrupt the machine-readable document on
+    # stdout when the gate has something to report
+    stale = tmp_path / "stale.py"
+    stale.write_text(STALE)
+    empty = tmp_path / "baseline.json"
+    empty.write_text(json.dumps({"findings": {}}))
+    assert main(["--stale-audit", "--format", "json",
+                 "--baseline", str(empty), str(stale)]) == 1
+    out = capsys.readouterr()
+    doc = json.loads(out.out)  # stdout is pure JSON
+    assert doc["new"] == 0
+    assert "stale suppression" in out.err
+
+
+def test_cli_report_mode_still_writes_requested_sarif(tmp_path):
+    # omnilint.sh prepends --sarif-out from OMNI_LINT_SARIF whatever
+    # the caller's mode — an audit-mode run must not silently skip the
+    # artifact a CI step will try to upload
+    f = tmp_path / "clean.py"
+    f.write_text(LIVE_ANYWHERE)
+    out = tmp_path / "out.sarif"
+    assert main(["--report-stale-suppressions",
+                 "--sarif-out", str(out), str(f)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+
+
+def test_cli_refuses_rule_subset_stale_audit(tmp_path):
+    f = tmp_path / "clean.py"
+    f.write_text(LIVE_ANYWHERE)
+    try:
+        main(["--stale-audit", "--rules", "OL2", str(f)])
+    except SystemExit as e:
+        assert e.code == 2
+    else:
+        raise AssertionError("expected a usage error")
+
+
+def test_cli_refuses_rule_subset_audit(tmp_path, capsys):
+    # a subset run trivially leaves other families' suppressions
+    # unmatched — the combination is a usage error
+    f = tmp_path / "clean.py"
+    f.write_text(LIVE_ANYWHERE)
+    try:
+        main(["--report-stale-suppressions", "--rules", "OL2", str(f)])
+    except SystemExit as e:
+        assert e.code == 2
+    else:
+        raise AssertionError("expected a usage error")

@@ -4,16 +4,21 @@ A stock linter sees valid Python; this package checks the contracts the
 serving stack actually hangs on: jit staging rules (OL1), hot-path
 host↔device syncs (OL2), buffer donation (OL3), async-dispatch-safe
 benchmarking (OL4), the cross-process stage frame protocol (OL5),
-Prometheus metric-surface drift (OL6), and the omnirace concurrency
+Prometheus metric-surface drift (OL6), the omnirace concurrency
 families — lock discipline against the LOCK_GUARDS manifest (OL7),
 lock-order cycles (OL8), and blocking calls under a lock (OL9), with a
 runtime lock-order/deadlock detector in ``analysis.runtime``
-(``OMNI_TPU_LOCK_CHECK=1``).
+(``OMNI_TPU_LOCK_CHECK=1``) — and the omniflow package-wide families:
+hostile-input taint against the TAINT_SOURCES/SANITIZERS/TAINT_SINKS
+manifest (OL10) and jit recompile hazards against the RECOMPILE
+manifest (OL11), both resolved over a cross-module symbol table + call
+graph (``engine.ProgramGraph``).
 
 CLI::
 
-    python -m vllm_omni_tpu.analysis [--format text|json]
-        [--update-baseline] [--no-baseline] [paths...]
+    python -m vllm_omni_tpu.analysis [--format text|json|sarif]
+        [--sarif-out path] [--update-baseline] [--no-baseline]
+        [--report-stale-suppressions] [paths...]
 
 Library::
 
@@ -34,13 +39,18 @@ lane.
 __all__ = [
     "DEFAULT_BASELINE",
     "Finding",
+    "ProgramGraph",
     "Rule",
     "analyze_paths",
     "analyze_source",
+    "analyze_sources",
     "apply_baseline",
+    "finalize_findings",
     "load_baseline",
     "new_findings",
     "save_baseline",
+    "stale_baseline_entries",
+    "stale_suppressions",
 ]
 
 

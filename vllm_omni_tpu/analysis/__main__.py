@@ -1,10 +1,16 @@
 """omnilint CLI: ``python -m vllm_omni_tpu.analysis [opts] paths...``
 
 Exit codes: 0 = clean against the committed baseline, 1 = NEW findings
-(or OL0 parse failures), 2 = usage error.  ``--update-baseline`` is the
-escape hatch for deliberate changes: it rewrites
-``analysis/baseline.json`` from the current findings and exits 0 —
-review the diff it produces like any other code change.
+(or OL0 parse failures, or stale suppressions under
+``--report-stale-suppressions`` / ``--stale-audit``), 2 = usage error /
+broken manifest.
+``--update-baseline`` is the escape hatch for deliberate changes: it
+rewrites ``analysis/baseline.json`` from the current findings and
+exits 0 — review the diff it produces like any other code change.
+
+The path manifests (``analysis/manifest.py``) are validated before any
+analysis: a renamed module/class must fail the run loudly instead of
+silently un-linting whatever its entry used to cover.
 """
 
 from __future__ import annotations
@@ -20,19 +26,39 @@ from vllm_omni_tpu.analysis.engine import (
     load_baseline,
     new_findings,
     save_baseline,
+    stale_baseline_entries,
+    stale_suppressions,
 )
+
+
+def _print_stale(stale, stale_base, dest) -> None:
+    """One report shape for both audit modes — detail lines to
+    ``dest``, the summary always to stderr."""
+    for path, line, rule in stale:
+        print(f"{path}:{line}: stale suppression: disable={rule} "
+              "matches no finding — remove it (or the contract it "
+              "documented no longer holds)", file=dest)
+    for fp in stale_base:
+        print(f"stale baseline entry: {fp}", file=dest)
+    print(f"omnilint: {len(stale)} stale suppression(s), "
+          f"{len(stale_base)} stale baseline entr(ies)",
+          file=sys.stderr)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m vllm_omni_tpu.analysis",
         description="omnilint: JAX/TPU-aware static analysis "
-                    "(rules OL1-OL9; see docs/static_analysis.md)")
+                    "(rules OL1-OL11; see docs/static_analysis.md)")
     parser.add_argument("paths", nargs="*", default=["vllm_omni_tpu"],
                         help="files/directories to analyze "
                              "(default: vllm_omni_tpu)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
+    parser.add_argument("--sarif-out", default=None, metavar="PATH",
+                        help="also write a SARIF 2.1.0 document of the "
+                             "NEW findings to PATH (scripts/omnilint.sh "
+                             "wires OMNI_LINT_SARIF=path to this)")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="baseline file (default: the committed "
                              "analysis/baseline.json)")
@@ -46,8 +72,32 @@ def main(argv=None) -> int:
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run (e.g. "
                              "OL7,OL8,OL9 — scripts/racecheck.sh's "
-                             "concurrency-only gate); default: all")
+                             "concurrency-only gate; OL10,OL11 — the "
+                             "omniflow families); default: all")
+    parser.add_argument("--report-stale-suppressions", action="store_true",
+                        help="audit mode: list `# omnilint: disable` "
+                             "comments that no longer suppress any "
+                             "finding and baseline entries nothing "
+                             "produces; exit 1 if any exist")
+    parser.add_argument("--stale-audit", action="store_true",
+                        help="run the normal gate AND the stale-"
+                             "suppression audit over the same analysis "
+                             "pass (scripts/omnilint.sh uses this so "
+                             "the gate analyzes once, not twice); exit "
+                             "1 on new findings OR stale entries")
     args = parser.parse_args(argv)
+
+    # a broken manifest must fail LOUDLY before any analysis claims
+    # cleanliness with half its scope silently gone
+    from vllm_omni_tpu.analysis.manifest import (
+        ManifestError,
+        validate_manifest,
+    )
+
+    try:
+        validate_manifest()
+    except ManifestError as e:
+        parser.exit(2, f"{e}\n")
 
     rules = None
     if args.rules:
@@ -57,6 +107,13 @@ def main(argv=None) -> int:
             parser.error("--rules cannot be combined with "
                          "--update-baseline (the baseline covers every "
                          "family)")
+        if args.report_stale_suppressions or args.stale_audit:
+            # a subset run trivially leaves every other family's
+            # suppressions unmatched — the audit would cry wolf
+            parser.error("--rules cannot be combined with "
+                         "--report-stale-suppressions/--stale-audit "
+                         "(staleness is only meaningful for a "
+                         "full-family run)")
         from vllm_omni_tpu.analysis.rules import ALL_RULES
 
         wanted = {r.strip().upper() for r in args.rules.split(",")}
@@ -65,17 +122,58 @@ def main(argv=None) -> int:
         if unknown:
             parser.error(f"unknown rule id(s): {', '.join(sorted(unknown))}")
 
-    findings = analyze_paths(args.paths, rules)
+    run_state: dict = {}
+    findings = analyze_paths(args.paths, rules, run_state)
+    analyzed = set(run_state.get("files", ()))
     if args.update_baseline:
         counts = save_baseline(findings, args.baseline)
         print(f"baseline updated: {sum(counts.values())} finding(s) "
               f"across {len(counts)} fingerprint(s) -> {args.baseline}")
+        if args.sarif_out:
+            # a requested artifact must not silently vanish; against
+            # the just-written baseline every finding is accepted debt
+            from vllm_omni_tpu.analysis.sarif import write_sarif
+
+            write_sarif(apply_baseline(findings,
+                                       load_baseline(args.baseline)),
+                        args.sarif_out)
         return 0
 
+    if args.report_stale_suppressions:
+        stale = stale_suppressions(run_state)
+        stale_base = stale_baseline_entries(
+            findings, load_baseline(args.baseline), analyzed)
+        if args.sarif_out:
+            # a requested artifact must not silently vanish because
+            # the run happened to be an audit-mode invocation
+            from vllm_omni_tpu.analysis.sarif import write_sarif
+
+            write_sarif(apply_baseline(
+                findings,
+                {} if args.no_baseline else load_baseline(args.baseline)),
+                args.sarif_out)
+        _print_stale(stale, stale_base, sys.stdout)
+        return 1 if (stale or stale_base) else 0
+
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    # the combined gate audits the SAME analysis pass the gate judges
+    # (same paths, same baseline) instead of re-running everything
+    stale: list = []
+    stale_base: list = []
+    if args.stale_audit:
+        stale = stale_suppressions(run_state)
+        stale_base = stale_baseline_entries(findings, baseline, analyzed)
     findings = apply_baseline(findings, baseline)
     new = new_findings(findings)
 
+    if args.sarif_out or args.format == "sarif":
+        from vllm_omni_tpu.analysis.sarif import to_sarif, write_sarif
+
+        doc = (write_sarif(findings, args.sarif_out) if args.sarif_out
+               else to_sarif(findings))
+        if args.format == "sarif":
+            json.dump(doc, sys.stdout, indent=1)
+            print()
     if args.format == "json":
         payload = [
             {"rule": f.rule, "path": f.path, "line": f.line,
@@ -85,10 +183,17 @@ def main(argv=None) -> int:
             for f in findings
             if args.show_all or not (f.suppressed or f.baselined)
         ]
-        json.dump({"findings": payload, "new": len(new)},
-                  sys.stdout, indent=1)
+        doc = {"findings": payload, "new": len(new)}
+        if args.stale_audit:
+            # the machine-readable document must record WHY a failing
+            # exit code fired, not just the finding count
+            doc["stale_suppressions"] = [
+                {"path": p, "line": ln, "rule": r}
+                for p, ln, r in stale]
+            doc["stale_baseline_entries"] = list(stale_base)
+        json.dump(doc, sys.stdout, indent=1)
         print()
-    else:
+    elif args.format == "text":
         shown = findings if args.show_all else new
         for f in shown:
             print(f.render())
@@ -97,7 +202,12 @@ def main(argv=None) -> int:
         print(f"omnilint: {len(new)} new finding(s) "
               f"({n_base} baselined, {n_supp} suppressed)",
               file=sys.stderr)
-    return 1 if new else 0
+    if args.stale_audit:
+        # stdout carries the machine-readable document under
+        # --format json/sarif — audit detail must not corrupt it
+        _print_stale(stale, stale_base,
+                     sys.stdout if args.format == "text" else sys.stderr)
+    return 1 if (new or stale or stale_base) else 0
 
 
 if __name__ == "__main__":

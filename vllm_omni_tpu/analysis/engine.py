@@ -161,48 +161,139 @@ class Rule:
     def finish(self, ctx: FileContext) -> Iterable[Finding]:
         return ()
 
+    def finalize_run(self) -> Iterable[Finding]:
+        """Called ONCE per analysis run, after every file's walk, on a
+        fresh instance whose ``run_state`` carries the whole run: the
+        ``files`` registry (path -> FileContext) and anything per-file
+        passes stashed.  This is where package-wide rules live — the
+        cross-module taint (OL10) and recompile-hazard (OL11) families
+        need the full symbol table and call graph
+        (:class:`ProgramGraph`) before they can judge any one file.
+        Suppressions are applied afterwards by the engine, per the
+        finding's own path."""
+        return ()
+
 
 # --------------------------------------------------------------- suppression
-def _suppressions(ctx: FileContext):
-    """(file-wide rule set, {line -> rule set}).  Rule ids are
-    upper-cased; ``all`` suppresses every rule."""
-    file_wide: set[str] = set()
-    by_line: dict[int, set[str]] = {}
-    for i, line in enumerate(ctx.lines, start=1):
-        m = _SUPPRESS_RE.search(line)
-        if not m:
-            continue
-        rules = {r.strip().upper() for r in m.group("rules").split(",")}
-        if m.group("file"):
-            file_wide |= rules
-        else:
-            by_line.setdefault(i, set()).update(rules)
+class SuppressionIndex:
+    """Per-file ``# omnilint: disable`` comments with USE tracking.
+
+    Each comment declares (declaration line, rule) pairs; applying the
+    file's findings marks the pairs that actually suppressed one.  The
+    pairs that never fire are *stale* — dead suppressions that would
+    silently bless a future regression — and the
+    ``--report-stale-suppressions`` audit (``stale_suppressions``)
+    collects them across a run."""
+
+    def __init__(self, ctx: FileContext):
+        self.path = ctx.path
+        # (decl_line, rule) -> covered line set, or None for file-wide
+        self.declared: dict[tuple, Optional[set]] = {}
+        self.used: set[tuple] = set()
+        n = len(ctx.lines)
+        comment_lines = self._comment_lines(ctx)
+        for i, line in enumerate(ctx.lines, start=1):
+            if comment_lines is not None and i not in comment_lines:
+                continue  # e.g. a suppression EXAMPLE inside a docstring
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group("rules").split(",")}
+            if m.group("file"):
+                for r in rules:
+                    self.declared[(i, r)] = None
+                continue
+            covered = {i}
             # a comment-only line suppresses the next CODE line (the
             # disable may sit atop a multi-line explanation block)
             if line.strip().startswith("#"):
                 j = i + 1
-                while j <= len(ctx.lines) \
-                        and ctx.lines[j - 1].strip().startswith("#"):
+                while j <= n and ctx.lines[j - 1].strip().startswith("#"):
                     j += 1
-                by_line.setdefault(j, set()).update(rules)
-    return file_wide, by_line
+                covered.add(j)
+            for r in rules:
+                cur = self.declared.setdefault((i, r), set())
+                if cur is not None:
+                    cur.update(covered)
+
+    @staticmethod
+    def _comment_lines(ctx: FileContext) -> Optional[set]:
+        """Lines carrying a REAL comment token — a ``disable=`` inside
+        a docstring is documentation, not a suppression (and would
+        read as permanently stale to the audit).  None when the file
+        doesn't tokenize (fall back to treating every line as
+        eligible, the pre-audit behavior)."""
+        import io
+        import tokenize
+
+        try:
+            return {tok.start[0] for tok in tokenize.generate_tokens(
+                        io.StringIO(ctx.source).readline)
+                    if tok.type == tokenize.COMMENT}
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return None
+
+    def apply(self, findings: list[Finding]) -> list[Finding]:
+        if not self.declared:
+            return findings
+        out = []
+        for f in findings:
+            lo, hi = f.stmt_span if f.stmt_span else (f.line, f.line)
+            lines = set(range(lo, hi + 1)) | {f.line}
+            hit = False
+            for (decl, rule), covered in self.declared.items():
+                if rule != f.rule and rule != "ALL":
+                    continue
+                if covered is None or covered & lines:
+                    hit = True
+                    self.used.add((decl, rule))
+            out.append(replace(f, suppressed=True) if hit else f)
+        return out
+
+    def stale(self) -> list[tuple]:
+        """(decl_line, rule) pairs that suppressed nothing this run."""
+        return sorted(k for k in self.declared if k not in self.used)
 
 
-def _apply_suppressions(findings: list[Finding],
-                        ctx: FileContext) -> list[Finding]:
-    file_wide, by_line = _suppressions(ctx)
-    if not file_wide and not by_line:
-        return findings
+def stale_suppressions(run_state: dict) -> list[tuple]:
+    """All (path, decl_line, rule) suppression declarations in the run
+    that matched no finding.  Only meaningful after a FULL run (every
+    rule family over the whole tree): a subset run trivially leaves the
+    other families' suppressions unmatched."""
     out = []
-    for f in findings:
-        active = file_wide | by_line.get(f.line, set())
-        lo, hi = f.stmt_span if f.stmt_span else (f.line, f.line)
-        for ln in range(lo, hi + 1):
-            active |= by_line.get(ln, set())
-        if f.rule in active or "ALL" in active:
-            f = replace(f, suppressed=True)
-        out.append(f)
+    for path in sorted(run_state.get("suppressions", {})):
+        idx = run_state["suppressions"][path]
+        out.extend((path, line, rule) for line, rule in idx.stale())
     return out
+
+
+def stale_baseline_entries(findings: Iterable[Finding],
+                           baseline: dict[str, int],
+                           analyzed_paths: Optional[set] = None
+                           ) -> list[str]:
+    """Baseline fingerprints whose current unsuppressed finding count
+    fell below the committed count — debt nothing produces anymore.
+    ``analyzed_paths`` (the run's file set) scopes the verdict: an
+    entry for an EXISTING file this run never analyzed is unjudgeable,
+    not stale — a path-subset invocation must not cry wolf on the
+    gate's full baseline.  An entry whose file is gone from disk stays
+    judgeable everywhere (a deleted/renamed file is the classic stale
+    debt)."""
+    produced: dict[str, int] = {}
+    for f in findings:
+        if not f.suppressed:
+            produced[f.fingerprint] = produced.get(f.fingerprint, 0) + 1
+    out = []
+    for fp, count in baseline.items():
+        if analyzed_paths is not None:
+            parts = fp.split("|")
+            if (len(parts) > 1 and parts[1] not in analyzed_paths
+                    and os.path.exists(os.path.join(REPO_ROOT,
+                                                    parts[1]))):
+                continue
+        if produced.get(fp, 0) < count:
+            out.append(fp)
+    return sorted(out)
 
 
 # ------------------------------------------------------------------ analysis
@@ -228,8 +319,10 @@ def analyze_source(source: str, path: str,
     (HOT_PATHS, protocol modules), which is what lets tests feed tiny
     fixture snippets through the real engine.  ``run_state`` is the
     cross-file dict rules with whole-run aggregates use; None (the
-    default) isolates this call completely — pass one dict across
-    calls to emulate a multi-file run."""
+    default) isolates this call completely AND treats it as a complete
+    one-file run (the package-wide finalize stage fires too).  Pass
+    one dict across calls to emulate a multi-file run, finishing with
+    ``finalize_findings`` — or use :func:`analyze_sources`."""
     path = path.replace(os.sep, "/")
     try:
         tree = ast.parse(source)
@@ -238,6 +331,11 @@ def analyze_source(source: str, path: str,
                         message=f"file does not parse: {e.msg}")]
     ctx = FileContext(path, source, tree)
     state = run_state if run_state is not None else {}
+    # the run-wide registries package-level rules (finalize_run) and
+    # the stale-suppression audit consume
+    state.setdefault("files", {})[path] = ctx
+    supp = state.setdefault("suppressions", {})[path] = \
+        SuppressionIndex(ctx)
     active = []
     for rule_cls in (rules if rules is not None else default_rules()):
         rule = rule_cls()
@@ -254,7 +352,37 @@ def analyze_source(source: str, path: str,
         for rule in active:
             findings.extend(rule.finish(ctx))
     findings.sort(key=lambda f: (f.line, f.rule, f.message))
-    return _apply_suppressions(findings, ctx)
+    findings = supp.apply(findings)
+    if run_state is None:
+        # an isolated call IS a complete one-file run: package-wide
+        # rules still fire (fixture tests feed single files through
+        # the full pipeline)
+        findings.extend(finalize_findings(rules, state))
+    return findings
+
+
+def finalize_findings(rules: Optional[list[type]],
+                      run_state: dict) -> list[Finding]:
+    """Run every package-wide rule's ``finalize_run`` over the
+    accumulated run state and apply each finding's own file's
+    suppressions.  ``analyze_paths``/``analyze_sources`` call this once
+    at the end of a run; callers emulating a multi-file run through
+    repeated ``analyze_source(..., run_state=state)`` calls finish with
+    it explicitly."""
+    out: list[Finding] = []
+    for rule_cls in (rules if rules is not None else default_rules()):
+        if rule_cls.finalize_run is Rule.finalize_run:
+            continue
+        rule = rule_cls()
+        rule.run_state = run_state
+        out.extend(rule.finalize_run())
+    by_path = run_state.get("suppressions", {})
+    applied = []
+    for f in out:
+        idx = by_path.get(f.path)
+        applied.append(idx.apply([f])[0] if idx is not None else f)
+    applied.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return applied
 
 
 def iter_python_files(paths: Iterable[str]) -> list[str]:
@@ -273,14 +401,33 @@ def iter_python_files(paths: Iterable[str]) -> list[str]:
 
 
 def analyze_paths(paths: Iterable[str],
-                  rules: Optional[list[type]] = None) -> list[Finding]:
+                  rules: Optional[list[type]] = None,
+                  run_state: Optional[dict] = None) -> list[Finding]:
     findings: list[Finding] = []
-    run_state: dict = {}  # one run = one cross-file aggregate scope
+    # one run = one cross-file aggregate scope; callers pass their own
+    # dict to inspect run-wide registries afterwards (the CLI's
+    # stale-suppression audit)
+    state: dict = run_state if run_state is not None else {}
     for fp in iter_python_files(paths):
         with open(fp, encoding="utf-8") as fh:
             source = fh.read()
         findings.extend(analyze_source(source, canonical_path(fp),
-                                       rules, run_state))
+                                       rules, state))
+    findings.extend(finalize_findings(rules, state))
+    return findings
+
+
+def analyze_sources(sources: dict[str, str],
+                    rules: Optional[list[type]] = None) -> list[Finding]:
+    """One complete run over in-memory {claimed path: source} blobs —
+    the multi-file counterpart of ``analyze_source`` for fixture tests
+    exercising cross-module flows (an OL10 source in one file reaching
+    a sink in another)."""
+    findings: list[Finding] = []
+    state: dict = {}
+    for path, source in sources.items():
+        findings.extend(analyze_source(source, path, rules, state))
+    findings.extend(finalize_findings(rules, state))
     return findings
 
 
@@ -330,3 +477,260 @@ def apply_baseline(findings: list[Finding],
 
 def new_findings(findings: Iterable[Finding]) -> list[Finding]:
     return [f for f in findings if not f.suppressed and not f.baselined]
+
+
+# ------------------------------------------------------------ program graph
+def own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Nodes of one function's OWN body: descends into everything
+    except nested def/class subtrees (a closure is its own analysis
+    unit — it runs on its own schedule, often after the enclosing
+    frame is gone) while lambdas stay in (they are inline
+    expressions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted_names(expr: ast.AST) -> set[str]:
+    """Every dotted name readable off ``expr``: ``asm.deepstack.shape``
+    contributes {"asm", "asm.deepstack", "asm.deepstack.shape"} — the
+    vocabulary two expressions are compared in when asking "does the
+    cache key OBSERVE this variant?" (rule OL11)."""
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            parts.reverse()
+            for i in range(1, len(parts) + 1):
+                out.add(".".join(parts[:i]))
+    return out
+
+
+class FunctionInfo:
+    """One function/method in the program graph."""
+
+    __slots__ = ("key", "path", "qual", "node", "ctx", "name",
+                 "cls_name", "is_method")
+
+    def __init__(self, key, path, qual, node, ctx, cls_name,
+                 is_method=False):
+        self.key = key          # "path::Qual.Name"
+        self.path = path
+        self.qual = qual        # dotted def/class chain
+        self.node = node
+        self.ctx = ctx
+        self.name = node.name   # terminal name
+        self.cls_name = cls_name
+        # direct class-body member (has a self/cls slot, callable only
+        # through an attribute) vs a plain function or a closure — a
+        # closure nested in a method keeps cls_name but IS bare-callable
+        self.is_method = is_method
+
+    def param_names(self) -> list[str]:
+        a = self.node.args
+        return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+                + [p.arg for p in a.kwonlyargs])
+
+
+class ProgramGraph:
+    """Symbol table + cross-module call graph over every FileContext of
+    one analysis run — the substrate the package-wide rule families
+    (OL10 taint, OL11 recompile-hazard) resolve interprocedural flows
+    on.  Generalizes the intra-module call-edge fixpoint OL7/OL8 run
+    per class/file: imports are resolved to the analyzed file set, so a
+    helper in another module is a graph edge, not a dead end.  Built
+    lazily once per run by the first finalize-stage rule that asks
+    (``ProgramGraph.ensure``)."""
+
+    def __init__(self, files: dict[str, FileContext]):
+        self.files = files
+        # run_state["files"] is mutated IN PLACE by every
+        # analyze_source call, so `ensure` cannot detect growth by
+        # dict identity — snapshot what this graph was built over
+        self._built_over = {p: id(c) for p, c in files.items()}
+        self.functions: dict[str, FunctionInfo] = {}
+        # (path, terminal name) -> [FunctionInfo] for same-file calls
+        self._file_by_name: dict[tuple, list[FunctionInfo]] = {}
+        # path -> {local binding -> dotted import target}
+        self.imports: dict[str, dict[str, str]] = {}
+        # dotted module -> path, for the files of THIS run
+        self.module_paths: dict[str, str] = {}
+        self._callers: Optional[dict] = None
+        for path, ctx in files.items():
+            self._index_file(path, ctx)
+
+    @classmethod
+    def ensure(cls, run_state: dict) -> "ProgramGraph":
+        files = run_state.get("files", {})
+        graph = run_state.get("program_graph")
+        if (graph is None
+                or graph._built_over != {p: id(c)
+                                         for p, c in files.items()}):
+            graph = cls(files)
+            run_state["program_graph"] = graph
+        return graph
+
+    # ------------------------------------------------------------ indexing
+    @staticmethod
+    def module_name(path: str) -> str:
+        mod = path[:-3] if path.endswith(".py") else path
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        return mod.replace("/", ".")
+
+    def _index_file(self, path: str, ctx: FileContext) -> None:
+        self.module_paths[self.module_name(path)] = path
+        imp = self.imports.setdefault(path, {})
+        pkg_parts = path.split("/")[:-1]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imp[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    prefix = ".".join(base)
+                else:
+                    prefix = ""
+                mod = ".".join(p for p in (prefix, node.module or "")
+                               if p)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    target = f"{mod}.{alias.name}" if mod else alias.name
+                    imp[alias.asname or alias.name] = target
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ctx.qualname(node)
+                cls_name = None
+                in_closure = False
+                for anc in ctx.ancestors(node):
+                    if isinstance(anc, ast.ClassDef):
+                        cls_name = anc.name
+                        break
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        # an enclosing def before any class: closure
+                        in_closure = True
+                is_method = cls_name is not None and not in_closure
+                fi = FunctionInfo(f"{path}::{qual}", path, qual, node,
+                                  ctx, cls_name, is_method)
+                self.functions[fi.key] = fi
+                self._file_by_name.setdefault(
+                    (path, node.name), []).append(fi)
+
+    # ----------------------------------------------------------- resolution
+    def _key_for_dotted(self, dotted: str) -> Optional[FunctionInfo]:
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            path = self.module_paths.get(mod)
+            if path is None:
+                continue
+            return self.functions.get(f"{path}::{'.'.join(parts[i:])}")
+        return None
+
+    def resolve_call(self, call: ast.Call,
+                     ctx: FileContext) -> Optional[FunctionInfo]:
+        """The FunctionInfo a call lands on, or None when the target is
+        outside the analyzed file set (stdlib, jax, an instance whose
+        class the graph can't see)."""
+        f = call.func
+        path = ctx.path
+        if isinstance(f, ast.Name):
+            # a bare name can never invoke a method — an unrelated
+            # same-named method must not shadow an imported function
+            # (closures nested in methods ARE bare-callable and stay)
+            cands = [c for c in self._file_by_name.get((path, f.id), [])
+                     if not c.is_method]
+            if len(cands) == 1:
+                return cands[0]
+            dotted = self.imports.get(path, {}).get(f.id)
+            if dotted:
+                return self._key_for_dotted(dotted)
+            return None
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                for anc in ctx.ancestors(call):
+                    if isinstance(anc, ast.ClassDef):
+                        cands = [
+                            fi for fi in self._file_by_name.get(
+                                (path, f.attr), [])
+                            if fi.cls_name == anc.name]
+                        if len(cands) == 1:
+                            return cands[0]
+                        return None
+                return None
+            if isinstance(base, ast.Name):
+                # same-file ClassName.method (unbound call)
+                cands = [fi for fi in self._file_by_name.get(
+                             (path, f.attr), [])
+                         if fi.cls_name == base.id]
+                if len(cands) == 1:
+                    return cands[0]
+                dotted = self.imports.get(path, {}).get(base.id)
+                if dotted:
+                    return self._key_for_dotted(f"{dotted}.{f.attr}")
+        return None
+
+    def callers_of(self, key: str) -> list[tuple]:
+        """(caller FunctionInfo, call node) pairs for every resolvable
+        call site of ``key`` across the run.  Built once, lazily."""
+        if self._callers is None:
+            callers: dict[str, list] = {}
+            for fi in self.functions.values():
+                for node in own_nodes(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = self.resolve_call(node, fi.ctx)
+                    if target is not None:
+                        callers.setdefault(target.key, []).append(
+                            (fi, node))
+            self._callers = callers
+        return self._callers.get(key, [])
+
+    @staticmethod
+    def call_arg_for_param(call: ast.Call, fi: "FunctionInfo",
+                           param: str) -> Optional[ast.AST]:
+        """The argument expression a call passes for ``fi``'s named
+        parameter, accounting for the implicit self/cls slot on
+        ``obj.method(...)`` calls."""
+        params = fi.param_names()
+        decorators = {d.id for d in fi.node.decorator_list
+                      if isinstance(d, ast.Name)}
+        if fi.is_method and "classmethod" in decorators:
+            # cls is implicit on EVERY call shape (instance, self, or
+            # Cls.method(...) — the class binds it)
+            params = params[1:] if params else params
+        elif (fi.is_method
+                and isinstance(call.func, ast.Attribute)
+                and not (isinstance(call.func.value, ast.Name)
+                         and call.func.value.id == fi.cls_name)
+                and "staticmethod" not in decorators):
+            # self is implicit on obj.method(...) — but a staticmethod
+            # has no such slot, and an unbound Cls.method(obj, x) call
+            # passes self EXPLICITLY, so neither may have its first
+            # parameter swallowed
+            params = params[1:] if params else params
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        try:
+            idx = params.index(param)
+        except ValueError:
+            return None
+        if idx < len(call.args):
+            arg = call.args[idx]
+            return None if isinstance(arg, ast.Starred) else arg
+        return None

@@ -218,6 +218,164 @@ LOCK_GUARDS: dict[str, dict[str, tuple[str, ...]]] = {
 }
 
 
+# --------------------------------------------------------------- omniflow
+# The OL10 hostile-input-taint manifest: which expressions produce
+# attacker-controlled values (TAINT_SOURCES), which calls launder them
+# into safe values (SANITIZERS), and which calls/operations must never
+# see them raw (TAINT_SINKS).  The rule (rules/taint_flow.py) flags
+# every source->sink dataflow that crosses no sanitizer — the bug class
+# of the PR 7 unsanitized tenant label (unbounded /metrics cardinality
+# + label injection) and the PR 12 float("inf") priority crash.
+
+TAINT_SOURCES: dict[str, tuple[str, ...]] = {
+    # hostile HTTP headers read off the OpenAI server's request object:
+    # `headers.get("x-omni-tenant")` / `headers["x-omni-tenant"]`
+    "headers": ("x-omni-tenant", "x-omni-priority", "traceparent",
+                "x-omni-trace-id"),
+    # raw (pre-sanitizer) client metadata: EVERY read of these
+    # attributes is hostile until a sanitizer touches it — the
+    # Request.tenant/priority properties exist precisely to be the one
+    # blessed crossing
+    "attrs": ("additional_information",),
+    # cross-host payload metadata off a connector edge: a torn or
+    # hostile remote store controls every field of the `{key}/meta`
+    # header (num_layers/shape/dtype/crc32)
+    "meta_suffixes": ("/meta",),
+    # key-prefix carve-out: `additional_information` doubles as the
+    # engine's internal scratch namespace, and internal keys are
+    # underscore-prefixed by convention ("_parked_len",
+    # "_hidden_chunks") — reads of those are engine-written state, not
+    # client input
+    "internal_key_prefixes": ("_",),
+}
+
+# terminal function name -> defining file (the drift guard checks the
+# def still exists there; matching in the rule is by terminal name so
+# fixture files exercise the same manifest)
+SANITIZERS: dict[str, str] = {
+    "sanitize_tenant": "vllm_omni_tpu/metrics/stats.py",
+    "sanitize_priority": "vllm_omni_tpu/metrics/stats.py",
+    "inbound_trace_id": "vllm_omni_tpu/tracing/journey.py",
+    "parse_traceparent": "vllm_omni_tpu/tracing/journey.py",
+    "_escape_label_value": "vllm_omni_tpu/metrics/prometheus.py",
+}
+
+TAINT_SINKS: dict[str, tuple[str, ...]] = {
+    # metric label dicts: a raw tenant here is unbounded series
+    # cardinality + Prometheus exposition injection
+    "metric_labels": ("_fmt_labels", "cap_tenant"),
+    # log calls: raw client bytes in a log line are log injection (and
+    # an f-string renders them before any later escaping could help)
+    "log_receivers": ("logger", "logging", "log"),
+    # filesystem paths: a client-controlled path component is traversal
+    "fs_calls": ("open", "os.replace", "os.rename", "os.remove",
+                 "os.unlink", "os.makedirs", "os.path.join"),
+    # scheduler arithmetic (WFQ quantum weights): an unclamped client
+    # number in admission math is the float("inf") crash class — scoped
+    # to the scheduler so ordinary string plumbing stays quiet
+    "sched_arith_paths": ("vllm_omni_tpu/core/scheduler.py",),
+}
+
+# --------------------------------------------------------------- recompile
+# The OL11 recompile-hazard manifest: every `_run_jit(kind, shape_key,
+# thunk)` dispatch must build its shape key from BUCKETED values or
+# static config — a per-request int in the key (or in a jitted dummy
+# array's shape) compiles one executable per distinct value, which is
+# the mid-traffic 20-40 s XLA stall warmup exists to prevent.  The
+# rule (rules/recompile_hazard.py) also checks every conditional
+# argument variant at the dispatch site is observable in the key (the
+# PR 11 `n_deep` bug class) and every dispatched `kind` is reachable
+# from the warmup walker.
+RECOMPILE: dict[str, tuple[str, ...]] = {
+    # the jit telemetry choke points — every dispatch goes through one
+    "dispatch_fns": ("_run_jit",),
+    # calls that BUCKET a raw count (their result is shape-safe even
+    # when fed per-request ints)
+    "bucket_fns": ("_bucket", "_make_buckets", "_decode_bucket",
+                   "_bucketed_prefill_shapes", "auto_blocks",
+                   "auto_ragged_blocks"),
+    # attributes holding precomputed bucket tables / static tile picks
+    "bucket_attrs": ("_token_buckets", "_batch_buckets", "_seq_buckets",
+                     "_token_block", "_dma_slots"),
+    # attribute reads that ARE per-request counts
+    "per_request_attrs": ("num_new_tokens", "num_tokens",
+                          "num_computed_tokens", "num_inflight_tokens",
+                          "num_prompt_tokens"),
+    # the warmup bucket walkers: kinds dispatched outside these must be
+    # warmed inside them
+    "warmup_funcs": ("precompile",),
+    # jax array constructors whose literal shape tuples the rule scans
+    "array_ctors": ("zeros", "ones", "full", "empty"),
+}
+
+
+class ManifestError(RuntimeError):
+    """A manifest entry no longer resolves to real code — a renamed
+    module/class must fail the lint run loudly, not silently un-lint
+    whatever the entry used to cover."""
+
+
+def validate_manifest(root: "str | None" = None) -> None:
+    """Check every path-shaped manifest entry resolves to an existing
+    file/dir and every ``path::Class`` / sanitizer entry to a real
+    class/def.  Called once per CLI run (``__main__``) and by
+    ``tests/analysis``; raises :class:`ManifestError` listing every
+    broken entry."""
+    import os
+
+    if root is None:
+        from vllm_omni_tpu.analysis.engine import REPO_ROOT
+        root = REPO_ROOT
+    problems: list[str] = []
+
+    def check_path(entry: str, table: str) -> "str | None":
+        """Absolute path for an existing entry, else records a problem."""
+        p = os.path.join(root, entry.rstrip("/"))
+        if entry.endswith("/"):
+            if not os.path.isdir(p):
+                problems.append(f"{table}: no such directory: {entry}")
+                return None
+        elif not os.path.isfile(p):
+            problems.append(f"{table}: no such file: {entry}")
+            return None
+        return p
+
+    for table, entries in (("HOT_PATHS", HOT_PATHS),
+                           ("THREADED_PATHS", THREADED_PATHS),
+                           ("BENCH_PATHS", BENCH_PATHS),
+                           ("PROTOCOL_MODULES", PROTOCOL_MODULES),
+                           ("METRIC_MODULES", METRIC_MODULES),
+                           ("sched_arith_paths",
+                            TAINT_SINKS["sched_arith_paths"])):
+        for entry in entries:
+            check_path(entry, table)
+    for key, guards in LOCK_GUARDS.items():
+        path, _, cls = key.partition("::")
+        p = check_path(path, "LOCK_GUARDS")
+        if p is None:
+            continue
+        with open(p, encoding="utf-8") as fh:
+            src = fh.read()
+        import re as _re
+        if not _re.search(rf"^\s*class\s+{_re.escape(cls)}\b", src,
+                          _re.MULTILINE):
+            problems.append(f"LOCK_GUARDS: no class '{cls}' in {path}")
+        del guards
+    for fn, path in SANITIZERS.items():
+        p = check_path(path, "SANITIZERS")
+        if p is None:
+            continue
+        with open(p, encoding="utf-8") as fh:
+            src = fh.read()
+        if f"def {fn}(" not in src:
+            problems.append(f"SANITIZERS: no def '{fn}' in {path}")
+    if problems:
+        raise ManifestError(
+            "manifest entries no longer resolve (a rename must update "
+            "analysis/manifest.py, not silently un-lint):\n  "
+            + "\n  ".join(problems))
+
+
 def in_scope(path: str, prefixes: tuple[str, ...]) -> bool:
     """True when repo-relative ``path`` matches a manifest entry (a
     directory prefix ending in "/", an exact file, or a bare filename)."""

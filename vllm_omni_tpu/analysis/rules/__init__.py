@@ -15,10 +15,17 @@
 | OL8 | lock-order          | no cycles in the acquisition-order graph   |
 | OL9 | blocking-under-lock | no device sync / jit / socket / sleep /    |
 |     |                     | connector wait while holding a lock        |
+| OL10| hostile-input-taint | no TAINT_SOURCES -> TAINT_SINKS dataflow   |
+|     |                     | without a declared SANITIZER crossing      |
+| OL11| recompile-hazard    | jit cache keys bucketed, dispatch variants |
+|     |                     | in the key, every kind warmup-reachable    |
 
 OL7-OL9 ("omnirace") have a runtime counterpart in
 ``analysis/runtime.py`` — traced locks that detect order inversions and
-wait cycles live under ``OMNI_TPU_LOCK_CHECK=1``.
+wait cycles live under ``OMNI_TPU_LOCK_CHECK=1``.  OL10/OL11
+("omniflow") are package-wide: they run at ``finalize_run`` over the
+whole run's ProgramGraph (symbol table + cross-module call graph)
+instead of one file at a time.
 """
 
 from vllm_omni_tpu.analysis.rules.blocking_under_lock import (
@@ -30,7 +37,11 @@ from vllm_omni_tpu.analysis.rules.jit_hazard import JitHazardRule
 from vllm_omni_tpu.analysis.rules.lock_discipline import LockDisciplineRule
 from vllm_omni_tpu.analysis.rules.lock_order import LockOrderRule
 from vllm_omni_tpu.analysis.rules.metric_drift import MetricDriftRule
+from vllm_omni_tpu.analysis.rules.recompile_hazard import (
+    RecompileHazardRule,
+)
 from vllm_omni_tpu.analysis.rules.stage_protocol import StageProtocolRule
+from vllm_omni_tpu.analysis.rules.taint_flow import TaintFlowRule
 from vllm_omni_tpu.analysis.rules.wallclock import WallClockRule
 
 ALL_RULES: tuple[type, ...] = (
@@ -43,6 +54,8 @@ ALL_RULES: tuple[type, ...] = (
     LockDisciplineRule,
     LockOrderRule,
     BlockingUnderLockRule,
+    TaintFlowRule,
+    RecompileHazardRule,
 )
 
 __all__ = [
@@ -56,4 +69,6 @@ __all__ = [
     "LockDisciplineRule",
     "LockOrderRule",
     "BlockingUnderLockRule",
+    "TaintFlowRule",
+    "RecompileHazardRule",
 ]

@@ -1,0 +1,471 @@
+"""OL10 — hostile-input taint: client bytes reaching a sink unsanitized.
+
+Every review-hardening pass since PR 7 has hand-harvested the same bug
+class: a value a CLIENT controls (the ``x-omni-tenant`` /
+``x-omni-priority`` headers, raw ``additional_information`` metadata,
+connector payload meta) reaching a sensitive operation — metric label
+dicts (unbounded cardinality + exposition injection), log lines (log
+injection), filesystem paths (traversal), scheduler arithmetic (the
+``float("inf")`` priority crash) — without passing one of the declared
+sanitizers first.  This rule encodes the harvest: the manifest
+(``analysis/manifest.py`` ``TAINT_SOURCES`` / ``SANITIZERS`` /
+``TAINT_SINKS``) declares the three vocabularies, and a forward
+dataflow pass flags every source→sink flow no sanitizer touches.
+
+The analysis runs at ``finalize_run`` over the whole run's
+:class:`~vllm_omni_tpu.analysis.engine.ProgramGraph`:
+
+- **per function**: reaching definitions over names, ``self.attr``
+  chains, and dict-key writes (``d["k"] = tainted`` taints ``d`` — a
+  label dict carries its values), iterated to fixpoint.  The union is
+  deliberately flow-INsensitive: a name sanitized on one branch and
+  raw on the other keeps the raw definition, which is exactly the
+  sanitizer-on-one-branch-only bug.
+- **interprocedural**: calls resolved through the cross-module call
+  graph propagate taint both ways to a bounded depth — a helper
+  returning a raw header read taints its callers, and a tainted
+  argument seeds the callee's parameter so a sink inside the callee
+  reports with the full path.
+- **both-ends report**: like an OL8 cycle, the finding anchors at the
+  sink and names the source end plus the def-use chain between them
+  (function names, not line numbers, so the fingerprint survives
+  unrelated edits).
+
+A flow that is safe for a reason the rule cannot see carries a reasoned
+suppression::
+
+    logger.info("tenant=%s", raw)  # omnilint: disable=OL10 - bounded upstream
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    ProgramGraph,
+    Rule,
+    own_nodes,
+)
+from vllm_omni_tpu.analysis.manifest import (
+    SANITIZERS,
+    TAINT_SINKS,
+    TAINT_SOURCES,
+    in_scope,
+)
+from vllm_omni_tpu.analysis.rules._jitinfo import dotted
+from vllm_omni_tpu.analysis.rules._lockinfo import callee_terminal
+
+LOG_METHODS = ("debug", "info", "warning", "error", "exception",
+               "critical", "log")
+
+# builtins that hand a tainted argument straight back (a copy or a
+# re-rendering of hostile bytes is still hostile)
+PASSTHROUGH = ("str", "repr", "format", "dict", "list", "tuple", "set",
+               "sorted", "reversed", "copy", "deepcopy", "join")
+
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv,
+              ast.Mod, ast.Pow)
+
+
+@dataclass(frozen=True)
+class Taint:
+    """Provenance of one hostile value: where it entered and the
+    function chain it crossed (names only — fingerprints must survive
+    unrelated edits)."""
+
+    desc: str   # "'x-omni-tenant' header read"
+    path: str
+    qual: str   # function the source read happened in
+    trail: tuple = ()
+
+    def via(self, qual: str) -> "Taint":
+        if self.trail and self.trail[-1] == qual:
+            return self
+        return Taint(self.desc, self.path, self.qual,
+                     self.trail + (qual,))
+
+
+@dataclass(frozen=True)
+class _FnResult:
+    returns: Optional[Taint]
+    findings: tuple
+
+
+_EMPTY = _FnResult(None, ())
+
+
+def _target_name(expr: ast.AST) -> Optional[str]:
+    """Assignment-target identity: ``x`` -> "x", ``self.x`` ->
+    "self.x", anything deeper -> None."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                      ast.Name):
+        return f"{expr.value.id}.{expr.attr}"
+    return None
+
+
+def _receiver_name(func: ast.AST) -> Optional[str]:
+    """Terminal name of a method call's receiver: ``self.headers.get``
+    -> "headers", ``headers.get`` -> "headers"."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    base = func.value
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    return None
+
+
+def _const_str(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    return None
+
+
+def _fstring_tail(expr: ast.AST) -> Optional[str]:
+    """Last literal fragment of an f-string (or the whole constant):
+    how ``f"{key}/meta"`` declares itself a metadata fetch."""
+    s = _const_str(expr)
+    if s is not None:
+        return s
+    if isinstance(expr, ast.JoinedStr) and expr.values:
+        last = expr.values[-1]
+        return _const_str(last)
+    return None
+
+
+class TaintFlowRule(Rule):
+    id = "OL10"
+    name = "hostile-input-taint"
+    node_types = ()
+    # overridable in tests
+    sources = TAINT_SOURCES
+    sanitizers = SANITIZERS
+    sinks = TAINT_SINKS
+    MAX_DEPTH = 4
+
+    def applies(self, ctx: FileContext) -> bool:
+        return False  # package-wide: everything happens in finalize_run
+
+    # ------------------------------------------------------------ finalize
+    def finalize_run(self) -> Iterable[Finding]:
+        graph = ProgramGraph.ensure(self.run_state)
+        self._graph = graph
+        self._memo: dict = {}
+        self._stack: set = set()
+        self._defs_cache: dict = {}
+        seen: dict = {}
+        for key in sorted(graph.functions):
+            fi = graph.functions[key]
+            res = self._analyze(fi, (), self.MAX_DEPTH)
+            for f in res.findings:
+                seen.setdefault((f.path, f.line, f.message), f)
+        return [seen[k] for k in sorted(seen)]
+
+    # ------------------------------------------------------- per function
+    def _analyze(self, fi, seeds: tuple, depth: int) -> _FnResult:
+        # depth is part of the key: a result computed under a
+        # truncated budget (reached transitively from an
+        # alphabetically-earlier caller) must not shadow the
+        # full-depth top-level analysis of the same function
+        memo_key = (fi.key, seeds, depth)
+        if memo_key in self._memo:
+            return self._memo[memo_key]
+        if memo_key in self._stack or depth < 0:
+            return _EMPTY  # recursion/depth bound: assume clean
+        self._stack.add(memo_key)
+        try:
+            result = self._analyze_body(fi, dict(seeds), depth)
+        finally:
+            self._stack.discard(memo_key)
+        self._memo[memo_key] = result
+        return result
+
+    def _collect_defs(self, fi) -> tuple:
+        """(defs, container_writes): name -> [value exprs] for every
+        assignment shape in the function's own body."""
+        if fi.key in self._defs_cache:
+            return self._defs_cache[fi.key]
+        defs: dict = {}
+        writes: dict = {}
+        for node in own_nodes(fi.node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    self._record_target(tgt, node.value, defs, writes)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._record_target(node.target, node.value, defs, writes)
+            elif isinstance(node, ast.AugAssign):
+                self._record_target(node.target, node.value, defs, writes)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._record_target(node.target, node.iter, defs, writes)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        self._record_target(item.optional_vars,
+                                            item.context_expr, defs,
+                                            writes)
+            elif isinstance(node, ast.NamedExpr):
+                self._record_target(node.target, node.value, defs, writes)
+        self._defs_cache[fi.key] = (defs, writes)
+        return defs, writes
+
+    @staticmethod
+    def _record_target(tgt, value, defs, writes) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                TaintFlowRule._record_target(elt, value, defs, writes)
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = _target_name(tgt.value)
+            if base is not None:
+                writes.setdefault(base, []).append(value)
+            return
+        name = _target_name(tgt)
+        if name is not None:
+            defs.setdefault(name, []).append(value)
+
+    def _analyze_body(self, fi, env: dict, depth: int) -> _FnResult:
+        defs, writes = self._collect_defs(fi)
+        findings: list = []
+        # ---- fixpoint over the union of reaching definitions
+        for _ in range(10):
+            changed = False
+            for name, exprs in defs.items():
+                if name in env:
+                    continue
+                for e in exprs:
+                    t = self._expr_taint(e, env, fi, depth, findings)
+                    if t is not None:
+                        env[name] = t
+                        changed = True
+                        break
+            for name, exprs in writes.items():
+                if name in env:
+                    continue
+                for e in exprs:
+                    t = self._expr_taint(e, env, fi, depth, findings)
+                    if t is not None:
+                        env[name] = t  # container carries its values
+                        changed = True
+                        break
+            if not changed:
+                break
+        # ---- sinks (and EVERY call, whatever its statement position:
+        # a discarded-result statement, an `if`/`while` test, an
+        # assert, a comprehension — each still carries its arguments
+        # INTO the callee, so each must go through expression
+        # evaluation for the seeding/descend.  own_nodes yields nested
+        # calls too; re-evaluation is memoized and findings dedup at
+        # finalize)
+        for node in own_nodes(fi.node):
+            if isinstance(node, ast.Call):
+                self._expr_taint(node, env, fi, depth, findings)
+                findings.extend(self._check_sink_call(node, env, fi,
+                                                      depth))
+            elif (isinstance(node, ast.BinOp)
+                  and isinstance(node.op, _ARITH_OPS)
+                  and in_scope(fi.path,
+                               self.sinks.get("sched_arith_paths", ()))):
+                t = (self._expr_taint(node.left, env, fi, depth,
+                                      findings)
+                     or self._expr_taint(node.right, env, fi, depth,
+                                         findings))
+                if t is not None:
+                    findings.append(self._finding(
+                        fi, node, t, "scheduler arithmetic",
+                        "an admission-math operand"))
+        # ---- return taint
+        returns: Optional[Taint] = None
+        for node in own_nodes(fi.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                t = self._expr_taint(node.value, env, fi, depth,
+                                     findings)
+                if t is not None:
+                    returns = t
+                    break
+        return _FnResult(returns, tuple(findings))
+
+    # ------------------------------------------------------- taint of expr
+    def _expr_taint(self, e, env: dict, fi, depth: int,
+                    findings: list) -> Optional[Taint]:
+        if isinstance(e, ast.Constant):
+            return None
+        if isinstance(e, ast.Name):
+            return env.get(e.id)
+        if isinstance(e, ast.Attribute):
+            if e.attr in self.sources.get("attrs", ()):
+                return Taint(f"raw '{e.attr}' metadata read", fi.path,
+                             fi.qual)
+            tn = _target_name(e)
+            if tn is not None and tn in env:
+                return env[tn]
+            return self._expr_taint(e.value, env, fi, depth, findings)
+        if isinstance(e, ast.Subscript):
+            hdr = _const_str(e.slice)
+            recv = _target_name(e.value)
+            if (hdr in self.sources.get("headers", ())
+                    and recv is not None and "headers" in recv):
+                return Taint(f"hostile '{hdr}' header read", fi.path,
+                             fi.qual)
+            if self._internal_key_read(e.value, e.slice):
+                return None
+            return self._expr_taint(e.value, env, fi, depth, findings)
+        if isinstance(e, ast.Call):
+            return self._call_taint(e, env, fi, depth, findings)
+        if isinstance(e, ast.JoinedStr):
+            for part in e.values:
+                t = self._expr_taint(part, env, fi, depth, findings)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(e, ast.FormattedValue):
+            return self._expr_taint(e.value, env, fi, depth, findings)
+        if isinstance(e, ast.BinOp):
+            return (self._expr_taint(e.left, env, fi, depth, findings)
+                    or self._expr_taint(e.right, env, fi, depth,
+                                        findings))
+        if isinstance(e, ast.BoolOp):
+            for v in e.values:
+                t = self._expr_taint(v, env, fi, depth, findings)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(e, ast.IfExp):
+            return (self._expr_taint(e.body, env, fi, depth, findings)
+                    or self._expr_taint(e.orelse, env, fi, depth,
+                                        findings))
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            for elt in e.elts:
+                t = self._expr_taint(elt, env, fi, depth, findings)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(e, ast.Dict):
+            for v in e.values:
+                if v is None:
+                    continue
+                t = self._expr_taint(v, env, fi, depth, findings)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(e, (ast.Starred, ast.Await)):
+            return self._expr_taint(e.value, env, fi, depth, findings)
+        if isinstance(e, ast.NamedExpr):
+            return self._expr_taint(e.value, env, fi, depth, findings)
+        return None
+
+    def _internal_key_read(self, container: ast.AST,
+                           key_expr: ast.AST) -> bool:
+        """A read of an engine-internal (underscore-prefixed) key off a
+        source dict is engine-written state, not client input."""
+        if not (isinstance(container, ast.Attribute)
+                and container.attr in self.sources.get("attrs", ())):
+            return False
+        key = _const_str(key_expr)
+        return key is not None and any(
+            key.startswith(p)
+            for p in self.sources.get("internal_key_prefixes", ()))
+
+    def _call_taint(self, call: ast.Call, env: dict, fi, depth: int,
+                    findings: list) -> Optional[Taint]:
+        term = callee_terminal(call.func)
+        # 1. a declared sanitizer launders whatever flows through it
+        if term in self.sanitizers:
+            return None
+        # 1b. engine-internal key reads off the metadata dict
+        if (term in ("get", "pop") and call.args
+                and isinstance(call.func, ast.Attribute)
+                and self._internal_key_read(call.func.value,
+                                            call.args[0])):
+            return None
+        # 2. source patterns
+        if term == "get" and call.args:
+            hdr = _const_str(call.args[0])
+            recv = _receiver_name(call.func)
+            if (hdr in self.sources.get("headers", ())
+                    and recv is not None and "headers" in recv):
+                return Taint(f"hostile '{hdr}' header read", fi.path,
+                             fi.qual)
+        if term in ("get", "fetch", "recv") and call.args:
+            tail = _fstring_tail(call.args[0])
+            if tail is not None and any(
+                    tail.endswith(sfx)
+                    for sfx in self.sources.get("meta_suffixes", ())):
+                return Taint("connector payload metadata "
+                             f"('...{tail}')", fi.path, fi.qual)
+        # 3. interprocedural: resolve through the program graph
+        target = self._graph.resolve_call(call, fi.ctx)
+        if target is not None and target.key != fi.key:
+            seeds = []
+            for param in target.param_names():
+                if param in ("self", "cls"):
+                    continue
+                arg = ProgramGraph.call_arg_for_param(call, target, param)
+                if arg is None:
+                    continue
+                t = self._expr_taint(arg, env, fi, depth, findings)
+                if t is not None:
+                    seeds.append((param, t.via(fi.qual)))
+            res = self._analyze(target, tuple(sorted(seeds)), depth - 1)
+            findings.extend(res.findings)
+            if res.returns is not None:
+                return res.returns.via(fi.qual)
+            return None
+        # 4. unresolvable: a method ON a tainted object yields hostile
+        # bytes; pass-through builtins hand tainted args back
+        if isinstance(call.func, ast.Attribute):
+            t = self._expr_taint(call.func.value, env, fi, depth,
+                                 findings)
+            if t is not None:
+                return t
+        if term in PASSTHROUGH:
+            for arg in call.args:
+                t = self._expr_taint(arg, env, fi, depth, findings)
+                if t is not None:
+                    return t
+        return None
+
+    # --------------------------------------------------------------- sinks
+    def _check_sink_call(self, call: ast.Call, env: dict, fi,
+                         depth: int) -> list:
+        out: list = []
+        term = callee_terminal(call.func)
+        dotted_name = dotted(call.func)
+        kind = None
+        what = None
+        if term in self.sinks.get("metric_labels", ()):
+            kind, what = "metric-label", f"`{term}(...)`"
+        elif (isinstance(call.func, ast.Attribute)
+              and call.func.attr in LOG_METHODS
+              and (_receiver_name(call.func) or "")
+              in self.sinks.get("log_receivers", ())):
+            kind = "log"
+            what = f"`{_receiver_name(call.func)}.{call.func.attr}(...)`"
+        elif dotted_name in self.sinks.get("fs_calls", ()):
+            kind, what = "filesystem-path", f"`{dotted_name}(...)`"
+        if kind is None:
+            return out
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            t = self._expr_taint(arg, env, fi, depth, out)
+            if t is not None:
+                out.append(self._finding(fi, call, t, kind, what))
+                break
+        return out
+
+    def _finding(self, fi, node, taint: Taint, kind: str,
+                 what: str) -> Finding:
+        chain = " -> ".join(dict.fromkeys(
+            taint.trail + (fi.qual or "module",)))
+        src_qual = taint.qual or "module"
+        return fi.ctx.finding(
+            self.id, node,
+            f"hostile input reaches {kind} sink unsanitized: "
+            f"{taint.desc} ({src_qual} in {taint.path}) flows into "
+            f"{what} via {chain} — route it through a declared "
+            "sanitizer (SANITIZERS, analysis/manifest.py) first")

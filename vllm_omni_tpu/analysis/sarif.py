@@ -1,0 +1,117 @@
+"""SARIF 2.1.0 output for omnilint findings.
+
+SARIF (Static Analysis Results Interchange Format) is what CI
+annotation surfaces (GitHub code scanning, reviewdog, VS Code SARIF
+viewers) ingest — one document carries the rule catalogue, per-finding
+locations, and stable fingerprints, so a PR gate can pin an omnilint
+finding to the exact diff line without knowing anything about the
+engine.  ``python -m vllm_omni_tpu.analysis --format sarif`` prints
+the document; ``--sarif-out PATH`` (or ``OMNI_LINT_SARIF=path`` through
+``scripts/omnilint.sh``) writes it alongside the human output.
+
+Only NEW findings become ``results`` — suppressed/baselined ones are
+the gate's accepted debt and would spam every PR with pre-existing
+annotations.  The finding's engine fingerprint ((rule|path|symbol|
+message), line-free by design) rides ``partialFingerprints`` so the
+consumer's dedup survives unrelated edits, exactly like the baseline
+does.
+
+No jax import, stdlib-only — same any-lane stance as the engine.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from vllm_omni_tpu.analysis.engine import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+#: one-line rule descriptions for the tool.driver.rules catalogue —
+#: kept here (not in each rule class) so the SARIF surface and the
+#: docs table stay reviewable side by side
+RULE_DESCRIPTIONS: dict[str, str] = {
+    "OL0": "file does not parse",
+    "OL1": "jit-hazard: jax.jit staging rules (traced branching, "
+           "static decls, jit-in-loop re-wrapping)",
+    "OL2": "host-sync: no device-to-host syncs in HOT_PATHS modules",
+    "OL3": "donation-safety: no reads of donated buffers",
+    "OL4": "wall-clock-in-trace: bench timing must sync before the "
+           "second stamp",
+    "OL5": "stage-protocol: every sent frame type has a handler",
+    "OL6": "metric-drift: Prometheus surface matches METRIC_SPECS",
+    "OL7": "lock-discipline: LOCK_GUARDS attrs touched only under "
+           "their lock",
+    "OL8": "lock-order: no cycles in the acquisition-order graph",
+    "OL9": "blocking-under-lock: no blocking call while holding a lock",
+    "OL10": "hostile-input-taint: no TAINT_SOURCES to TAINT_SINKS "
+            "dataflow without a declared SANITIZER crossing",
+    "OL11": "recompile-hazard: jit cache keys bucketed, dispatch "
+            "variants observed by the key, every kind warmed",
+}
+
+
+def to_sarif(findings: Iterable[Finding],
+             tool_version: str = "1.0") -> dict:
+    """SARIF 2.1.0 document for the run's NEW findings."""
+    new = [f for f in findings if not f.suppressed and not f.baselined]
+    used_rules = sorted({f.rule for f in new} | set(RULE_DESCRIPTIONS))
+    rule_index = {rid: i for i, rid in enumerate(used_rules)}
+    results = []
+    for f in new:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": "error",
+            "message": {"text": f.message
+                        + (f" ({f.symbol})" if f.symbol else "")},
+            "locations": [{
+                "physicalLocation": {
+                    # bare repo-relative URI: consumers (GitHub code
+                    # scanning, reviewdog) resolve it against the
+                    # checkout root — a uriBaseId would need an
+                    # originalUriBaseIds declaration to be valid SARIF
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+                "logicalLocations": ([{"fullyQualifiedName": f.symbol}]
+                                     if f.symbol else []),
+            }],
+            "partialFingerprints": {
+                "omnilintFingerprint/v1": f.fingerprint,
+            },
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "omnilint",
+                    "informationUri":
+                        "docs/static_analysis.md",
+                    "version": tool_version,
+                    "rules": [
+                        {"id": rid,
+                         "name": RULE_DESCRIPTIONS.get(
+                             rid, "").split(":", 1)[0] or rid,
+                         "shortDescription": {
+                             "text": RULE_DESCRIPTIONS.get(rid, rid)}}
+                        for rid in used_rules
+                    ],
+                }
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(findings: Iterable[Finding], path: str) -> dict:
+    doc = to_sarif(findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    return doc

@@ -302,12 +302,18 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
         CLIENT input: sanitized/clamped at the Request property, never
         trusted here."""
         info = {}
+        # bound the RAW bytes at ingress (OL10 first-harvest): the
+        # values ride request metadata — and every stage-handoff
+        # serialization — until the Request properties sanitize at
+        # use, so a megabyte header must not be carried that far.
+        # Semantic sanitization stays where it was: sanitize_tenant
+        # caps the label at 64 chars, sanitize_priority clamps [1, 8]
         tenant = self.headers.get("x-omni-tenant")
         if tenant:
-            info["tenant"] = tenant
+            info["tenant"] = tenant[:256]
         priority = self.headers.get("x-omni-priority")
         if priority:
-            info["priority"] = priority
+            info["priority"] = priority[:64]
         # external trace join (tracing/journey.py): a W3C traceparent
         # or x-omni-trace-id header continues the CALLER's trace id
         # through this request's journey spans — validated/bounded
