@@ -1,0 +1,28 @@
+#!/usr/bin/env sh
+# omnipulse gate: the detection-and-attribution layer end to end —
+# the windowed burn-rate math against its hand oracle, the fake-clock
+# alert lifecycle matrix (pending / for-duration / firing / resolve /
+# flap / probe-error immunity), the space-saving attribution sketch's
+# proven error bounds under 10k-tenant adversarial churn, the
+# per-reason dump cooldown, AND the live e2e: an overload wave on a
+# tiny in-proc engine drives the fast-burn alert pending -> firing,
+# drops exactly one schema-valid evidence bundle on disk, resolves
+# after the wave, and a mid-flight /metrics probe validates clean with
+# the alerts_firing / alert_transitions_total / per-tenant attribution
+# series live.
+#
+# Standalone face of the same coverage tier-1 carries (tests/alerts is
+# a fast directory, unlike the slow-tiered tests/metrics), sitting next
+# to scripts/debugz.sh, scripts/loadgen.sh, scripts/controlplane.sh and
+# scripts/omnilint.sh as a pre-merge gate:
+#
+#   scripts/alerts.sh               # the whole omnipulse contract
+#   scripts/alerts.sh -k burn       # pass-through pytest args
+set -eu
+cd "$(dirname "$0")/.."
+# JAX on CPU: the e2e engine is a tiny random-weight model; the gate
+# must never touch a real chip a colocated serving process owns
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/alerts/ \
+    tests/introspection/test_flight_recorder.py \
+    -q -p no:cacheprovider -m "not slow" "$@"

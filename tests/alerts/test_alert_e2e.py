@@ -1,0 +1,174 @@
+"""omnipulse end to end on a live tiny-model engine: an overload wave
+drives the fast-burn alert pending -> firing with exactly one evidence
+bundle on disk, the alert resolves after the wave, a mid-flight
+/metrics probe is validate-clean with the alert + attribution series
+live, and the watchdog wiring surfaces trips as `engine_stalled`
+without changing the /health 503 contract."""
+
+import json
+import time
+
+import pytest
+
+from vllm_omni_tpu.config.stage import StageConfig
+from vllm_omni_tpu.loadgen import build_workload, poisson_arrivals, run_inproc
+from vllm_omni_tpu.loadgen.workload import Scenario
+from vllm_omni_tpu.metrics.alerts import AlertEngine, build_default_rules
+from vllm_omni_tpu.metrics.prometheus import (
+    render_from_omni,
+    validate_exposition,
+)
+
+_CATALOG = [Scenario("chat", weight=1.0, prompt_len=(4, 10),
+                     output_len=(2, 4))]
+
+
+def _stage():
+    return StageConfig(
+        stage_id=0, stage_type="llm",
+        engine_args={"model_factory": "tests.helpers:tiny_lm_factory",
+                     "num_pages": 128, "page_size": 4,
+                     "max_model_len": 128,
+                     # impossible targets: every finished request
+                     # misses its SLO, so the wave burns the error
+                     # budget at 1/budget = 100x — far past the 14.4
+                     # fast-page threshold
+                     "slo_ttft_ms": 0.001, "slo_tpot_ms": 0.001},
+        engine_input_source=[-1], final_output=True,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0},
+    )
+
+
+@pytest.fixture(scope="module")
+def async_omni():
+    from vllm_omni_tpu.entrypoints.async_omni import AsyncOmni
+
+    omni = AsyncOmni(stage_configs=[_stage()])
+    yield omni
+    omni.shutdown()
+
+
+def _wait_until(pred, timeout_s=8.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+def test_overload_wave_fires_burn_alert_with_one_bundle(
+        async_omni, tmp_path, monkeypatch):
+    monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("OMNI_TPU_DUMP_COOLDOWN_S", "3600")
+    inner = async_omni._omni
+    # short real-time windows so the e2e runs in seconds; the rule
+    # SHAPE (fast + slow window, 14.4 page threshold, 1% budget) is
+    # exactly the production default
+    engine = AlertEngine(
+        build_default_rules(inner, fast_window_s=0.4,
+                            slow_window_s=1.2),
+        interval_s=0.05).start()
+    try:
+        wl = build_workload(poisson_arrivals(30.0, 12, seed=5),
+                            catalog=_CATALOG, seed=5, vocab_size=60,
+                            tenants=("acme", "free"))
+        records = run_inproc(async_omni, wl)
+        assert sum(1 for r in records if r.status == "ok") >= 6
+        # the wave's SLO misses push BOTH burn windows past threshold
+        assert _wait_until(lambda: "slo_fast_burn" in engine.firing())
+        snap = engine.snapshot()
+        assert snap["rules"]["slo_fast_burn"]["state"] == "firing"
+        # the firing alert is an overload advisory for the controller
+        assert "slo_fast_burn" in engine.firing_overload()
+        # lifecycle on the transition ring: pending BEFORE firing
+        tos = [t["to"] for t in snap["transitions"]
+               if t["alert"] == "slo_fast_burn"]
+        assert tos.index("pending") < tos.index("firing")
+
+        # mid-flight /metrics probe: validate-clean, with the alert
+        # lifecycle + per-tenant attribution series live
+        text = render_from_omni(inner)
+        assert validate_exposition(text) == []
+        assert 'alerts_firing{alert="slo_fast_burn"} 1' in text
+        assert 'alert_transitions_total{alert="slo_fast_burn",' \
+               'to="firing"}' in text
+        assert 'tenant_tokens_total{stage="0",tenant="acme",' \
+               'kind="prefill"}' in text
+        assert 'tenant_tokens_total{stage="0",tenant="free",' \
+               'kind="decode"}' in text
+        assert "tenant_kv_page_seconds_total" in text
+        assert "attribution_tracked_tenants" in text
+
+        # exactly ONE evidence bundle FOR THIS REASON (the per-reason
+        # cooldown absorbs flaps; other rules under the impossible SLO
+        # targets — ttft_p_high after its 15s hysteresis on a slow box
+        # — may legitimately drop their own), schema-valid, with the
+        # window values at the firing edge
+        bundles = [p for p in tmp_path.iterdir()
+                   if "alert:slo_fast_burn" in p.name]
+        assert len(bundles) == 1
+        doc = json.loads(bundles[0].read_text())
+        assert doc["reason"] == "alert:slo_fast_burn"
+        assert doc["alert"]["name"] == "slo_fast_burn"
+        burns = doc["alert"]["transition"]["values"]
+        assert burns["burn_0.4s"] > 14.4
+        assert burns["burn_1.2s"] > 14.4
+        assert doc["attribution"]["0"]["meters"]["prefill_tokens"][
+            "total"] > 0
+        assert isinstance(doc["recorders"], list) and doc["recorders"]
+        assert doc["recorders"][0]["records"], \
+            "flight tail must ride the bundle"
+
+        # the wave is over: both windows drain and the alert RESOLVES
+        assert _wait_until(
+            lambda: "slo_fast_burn" not in engine.firing(),
+            timeout_s=6.0)
+        tos = [t["to"] for t in engine.snapshot()["transitions"]
+               if t["alert"] == "slo_fast_burn"]
+        assert tos[-1] == "resolved"
+        # still exactly one fast-burn bundle after the resolve
+        assert len([p for p in tmp_path.iterdir()
+                    if "alert:slo_fast_burn" in p.name]) == 1
+    finally:
+        engine.stop()
+
+
+def test_watchdog_trip_surfaces_as_engine_stalled(async_omni):
+    """The Omni wiring: a watchdog trip force-fires `engine_stalled`
+    on the orchestrator's own alert engine — one source of truth for
+    "this replica is wedged"."""
+    inner = async_omni._omni
+    assert "engine_stalled" not in inner.alerts.firing()
+    # drive the registered on_trip callbacks (what _trip() invokes)
+    for fn in list(inner.alerts._on_firing):
+        del fn  # (no callbacks registered by default)
+    for fn in list(inner.watchdog._on_trip):
+        fn({"reason": "test"})
+    assert "engine_stalled" in inner.alerts.firing()
+    # no evidence bundle for this rule by design: the watchdog's trip
+    # dump IS the evidence
+    rs = inner.alerts._rules["engine_stalled"]
+    assert rs.evidence_captured == 0
+    # the probe remains the source of truth: watchdog not actually
+    # tripped -> the next evaluation resolves the forced latch
+    inner.alerts.evaluate_once()
+    assert "engine_stalled" not in inner.alerts.firing()
+
+
+def test_health_gains_read_only_alert_count(async_omni):
+    """/health carries alerts_firing without changing the 503
+    contract: firing alerts alone never eject the replica."""
+    from vllm_omni_tpu.introspection.debugz import health_snapshot
+
+    inner = async_omni._omni
+    inner.alerts.force_firing("degraded_mode", reason="test")
+    try:
+        code, body = health_snapshot(inner, engine_thread_alive=True)
+        assert code == 200 and body["status"] == "ok"
+        assert body["alerts_firing"] >= 1
+    finally:
+        inner.alerts.evaluate_once()  # probe resolves the forced latch
+    code, body = health_snapshot(inner, engine_thread_alive=True)
+    assert body["alerts_firing"] == 0

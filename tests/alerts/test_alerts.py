@@ -1,0 +1,478 @@
+"""omnipulse alert engine: windowed burn math (hand oracle), the
+fake-clock lifecycle matrix (pending / for-duration / firing / resolve
+/ flap / probe-error immunity), forced firing (the watchdog wiring),
+evidence capture + its per-reason cooldown, and the /metrics face."""
+
+import json
+import os
+
+import pytest
+
+from vllm_omni_tpu.introspection.flight_recorder import DumpCooldown
+from vllm_omni_tpu.metrics.alerts import (
+    KIND_BURN,
+    KIND_RATE,
+    KIND_STATE,
+    KIND_THRESHOLD,
+    STATE_FIRING,
+    STATE_INACTIVE,
+    STATE_PENDING,
+    AlertEngine,
+    AlertRule,
+)
+from vllm_omni_tpu.metrics.stats import (
+    DeltaRing,
+    EngineStepMetrics,
+    burn_rate,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------- windowed burn math
+class TestWindowMath:
+    def test_delta_ring_window_selection(self):
+        clock = FakeClock(0.0)
+        ring = DeltaRing(horizon_s=100.0, clock=clock)
+        for i in range(11):
+            ring.sample({"c": float(i * 10)})
+            clock.advance(1.0)
+        # newest at t=10 (c=100); 5s window differences against t=5
+        d, span = ring.window_delta(5.0, "c")
+        assert (d, span) == (50.0, 5.0)
+        # a window longer than history falls back to the oldest sample
+        d, span = ring.window_delta(60.0, "c")
+        assert (d, span) == (100.0, 10.0)
+
+    def test_delta_ring_bounds_memory(self):
+        clock = FakeClock(0.0)
+        ring = DeltaRing(horizon_s=10.0, max_samples=720, clock=clock)
+        for _ in range(5_000):
+            ring.sample({"c": 1.0})
+            clock.advance(0.1)
+        # horizon eviction keeps ~window/cadence samples (+1 baseline)
+        assert len(ring._samples) <= 103
+
+    def test_two_window_burn_hand_oracle(self):
+        """Hand-computed multi-window burn: 1000 requests/hour
+        baseline at 0.1% errors, then a bad minute at 50% errors,
+        against a 99.9% objective (budget 0.001).
+
+        Fast 60s window during the bad minute: 30 bad / 60 total ->
+        bad fraction 0.5 -> burn 500.  Slow 3600s window: baseline
+        contributed 1 bad / 1000, the bad minute 30 / 60 -> 31/1060 ≈
+        0.02925 -> burn ≈ 29.25.  Both clear 14.4 -> page."""
+        assert burn_rate(30, 60, 0.001) == pytest.approx(500.0)
+        assert burn_rate(31, 1060, 0.001) == pytest.approx(29.245,
+                                                           abs=0.01)
+        # on-budget traffic burns exactly 1.0; empty windows burn 0
+        assert burn_rate(1, 1000, 0.001) == pytest.approx(1.0)
+        assert burn_rate(0, 0, 0.001) == 0.0
+        assert burn_rate(5, 0, 0.001) == 0.0
+
+    def test_two_window_burn_through_the_ring(self):
+        """The same oracle driven through DeltaRing sampling: an hour
+        of baseline then a bad minute; both windows must agree with
+        the hand math."""
+        clock = FakeClock(0.0)
+        ring = DeltaRing(horizon_s=3700.0, clock=clock)
+        bad = total = 0.0
+        # baseline: ~1000 req/h at 0.1% errors, sampled every 60 s
+        for _ in range(60):
+            total += 1000.0 / 60.0
+            bad += 1.0 / 60.0
+            ring.sample({"bad": bad, "total": total})
+            clock.advance(60.0)
+        # the bad minute: 60 more requests, 30 bad
+        total += 60
+        bad += 30
+        ring.sample({"bad": bad, "total": total})
+        d_bad, _ = ring.window_delta(60.0, "bad")
+        d_total, _ = ring.window_delta(60.0, "total")
+        assert burn_rate(d_bad, d_total, 0.001) == pytest.approx(
+            500.0)  # the window baseline sits exactly at t-60
+        d_bad, _ = ring.window_delta(3600.0, "bad")
+        d_total, _ = ring.window_delta(3600.0, "total")
+        assert burn_rate(d_bad, d_total, 0.001) == pytest.approx(
+            29.4, abs=0.5)
+
+    def test_engine_step_metrics_slo_totals(self):
+        m = EngineStepMetrics()
+        m.slo_ttft_ms = 10.0
+        m.on_request_slo("a", 5.0, None, 4)    # met
+        m.on_request_slo("b", 50.0, None, 8)   # missed
+        t = m.slo_totals()
+        assert t == {"finished": 2, "met": 1, "bad": 1, "tokens": 12,
+                     "goodput_tokens": 4}
+
+
+# ------------------------------------------------- the lifecycle matrix
+def _engine(rules, clock):
+    return AlertEngine(rules, interval_s=1.0, clock=clock,
+                       sleep=lambda s: None)
+
+
+class TestLifecycle:
+    def test_threshold_pending_for_duration_firing_resolve(self):
+        clock = FakeClock()
+        value = {"v": 0.0}
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                         probe=lambda: {"value": value["v"]},
+                         windows=((0.0, 10.0),), for_duration_s=5.0)
+        eng = _engine([rule], clock)
+        rs = eng._rules["q"]
+        eng.evaluate_once()
+        assert rs.state == STATE_INACTIVE
+        value["v"] = 50.0
+        eng.evaluate_once()
+        assert rs.state == STATE_PENDING     # condition true, holding
+        clock.advance(4.0)
+        eng.evaluate_once()
+        assert rs.state == STATE_PENDING     # for-duration not yet met
+        clock.advance(1.0)
+        ts = eng.evaluate_once()
+        assert rs.state == STATE_FIRING
+        assert any(t["to"] == STATE_FIRING for t in ts)
+        assert eng.firing()["q"]["values"]["value"] == 50.0
+        value["v"] = 0.0
+        ts = eng.evaluate_once()
+        assert rs.state == STATE_INACTIVE
+        assert any(t["to"] == "resolved" for t in ts)
+
+    def test_flap_below_for_duration_never_fires(self):
+        clock = FakeClock()
+        value = {"v": 0.0}
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                         probe=lambda: {"value": value["v"]},
+                         windows=((0.0, 10.0),), for_duration_s=10.0)
+        eng = _engine([rule], clock)
+        rs = eng._rules["q"]
+        for _ in range(5):  # 2s-on / 2s-off flapping
+            value["v"] = 50.0
+            eng.evaluate_once()
+            clock.advance(2.0)
+            value["v"] = 0.0
+            eng.evaluate_once()
+            clock.advance(2.0)
+        assert rs.state == STATE_INACTIVE
+        assert rs.transitions == 10  # pending->inactive churn recorded
+        assert eng.firing() == {}
+
+    def test_zero_for_duration_fires_same_evaluation(self):
+        clock = FakeClock()
+        rule = AlertRule(name="s", kind=KIND_STATE,
+                         probe=lambda: {"value": True})
+        eng = _engine([rule], clock)
+        ts = eng.evaluate_once()
+        assert eng._rules["s"].state == STATE_FIRING
+        assert [t["to"] for t in ts] == [STATE_FIRING]
+
+    def test_multi_window_burn_requires_all_windows(self):
+        """The fast window spikes instantly but the slow window keeps
+        the page quiet until the burn SUSTAINS — the whole point of
+        multi-window multi-burn-rate."""
+        clock = FakeClock()
+        counters = {"bad": 0.0, "total": 0.0}
+        rule = AlertRule(
+            name="burn", kind=KIND_BURN,
+            probe=lambda: dict(counters),
+            windows=((10.0, 14.4), (100.0, 14.4)), budget=0.01)
+        eng = _engine([rule], clock)
+        rs = eng._rules["burn"]
+        # 100s of clean traffic builds slow-window history
+        for _ in range(100):
+            counters["total"] += 10
+            eng.evaluate_once()
+            clock.advance(1.0)
+        # 5s of 100% errors: fast window burns >> 14.4 but the slow
+        # window still averages below -> NOT firing
+        for _ in range(5):
+            counters["total"] += 10
+            counters["bad"] += 10
+            eng.evaluate_once()
+            clock.advance(1.0)
+        assert rs.last_values["burn_10s"] > 14.4
+        assert rs.state != STATE_FIRING
+        # sustained: another 25s pushes the slow window past too
+        for _ in range(25):
+            counters["total"] += 10
+            counters["bad"] += 10
+            eng.evaluate_once()
+            clock.advance(1.0)
+        assert rs.state == STATE_FIRING
+        assert rs.last_values["burn_100s"] > 14.4
+        # errors stop: both windows decay and the alert resolves
+        for _ in range(30):
+            counters["total"] += 10
+            eng.evaluate_once()
+            clock.advance(1.0)
+        assert rs.state == STATE_INACTIVE
+
+    def test_under_covered_slow_window_scales_burn(self):
+        """Early process life: until the slow window is backed by a
+        full span of history its burn is scaled by real coverage (the
+        unobserved remainder is assumed burn-free), so it cannot
+        degenerate into a second copy of the fast window — but a burn
+        sustained across the history that DOES exist still fires."""
+        clock = FakeClock()
+        counters = {"bad": 0.0, "total": 0.0}
+        rule = AlertRule(
+            name="burn", kind=KIND_BURN,
+            probe=lambda: dict(counters),
+            windows=((10.0, 14.4), (100.0, 14.4)), budget=0.01)
+        eng = _engine([rule], clock)
+        rs = eng._rules["burn"]
+        # 100% errors from the very first request the process serves
+        for _ in range(11):
+            counters["total"] += 10
+            counters["bad"] += 10
+            eng.evaluate_once()
+            clock.advance(1.0)
+        # fast window fully covered: burn 100; slow window 10% covered:
+        # scaled to 10 < 14.4 -> the blip alone cannot page
+        assert rs.last_values["burn_10s"] == pytest.approx(100.0)
+        assert rs.last_values["burn_100s"] == pytest.approx(10.0)
+        assert rs.state != STATE_FIRING
+        # sustained into minute one: coverage grows and the page lands
+        for _ in range(40):
+            counters["total"] += 10
+            counters["bad"] += 10
+            eng.evaluate_once()
+            clock.advance(1.0)
+        assert rs.state == STATE_FIRING
+
+    def test_ring_sized_from_horizon_and_interval(self):
+        """The sample cap must never silently shorten a window: a 1h
+        window at a 1s cadence needs ~3800 samples, not the 720
+        default (which would cap history at 12 minutes forever)."""
+        clock = FakeClock()
+        rule = AlertRule(name="burn", kind=KIND_BURN,
+                         probe=lambda: {"bad": 0, "total": 0},
+                         windows=((300.0, 14.4), (3600.0, 14.4)))
+        eng = AlertEngine([rule], interval_s=1.0, clock=clock,
+                          sleep=lambda s: None)
+        assert eng._rules["burn"].ring.max_samples >= 3600 * 1.05
+
+    def test_rate_rule_floors_at_nominal_window(self):
+        clock = FakeClock()
+        counters = {"count": 0.0}
+        rule = AlertRule(name="shed", kind=KIND_RATE,
+                         probe=lambda: dict(counters),
+                         windows=((10.0, 0.5),))
+        eng = _engine([rule], clock)
+        rs = eng._rules["shed"]
+        # 2 sheds over the first 2 seconds of process life: against
+        # the NOMINAL 10s window that is 0.2/s (the unobserved 8s is
+        # assumed shed-free) — a sliver of history must not page as a
+        # sustained rate
+        eng.evaluate_once()
+        clock.advance(1.0)
+        counters["count"] = 1.0
+        eng.evaluate_once()
+        clock.advance(1.0)
+        counters["count"] = 2.0
+        eng.evaluate_once()
+        assert rs.last_values["rate_10s"] == pytest.approx(0.2)
+        assert rs.state != STATE_FIRING
+        # sustained 1 shed/s through a fully covered window DOES page,
+        # normalized by the real span
+        for i in range(3, 14):
+            clock.advance(1.0)
+            counters["count"] = float(i)
+            eng.evaluate_once()
+        assert rs.last_values["rate_10s"] == pytest.approx(1.0)
+        assert rs.state == STATE_FIRING
+
+    def test_probe_error_immunity(self):
+        """A raising probe neither fires nor resolves: the firing
+        state latches through the outage and the error is surfaced."""
+        clock = FakeClock()
+        mode = {"raise": False, "v": 50.0}
+
+        def probe():
+            if mode["raise"]:
+                raise RuntimeError("sensor torn")
+            return {"value": mode["v"]}
+
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD, probe=probe,
+                         windows=((0.0, 10.0),))
+        eng = _engine([rule], clock)
+        rs = eng._rules["q"]
+        eng.evaluate_once()
+        assert rs.state == STATE_FIRING
+        mode["raise"] = True
+        for _ in range(3):
+            assert eng.evaluate_once() == []
+        assert rs.state == STATE_FIRING        # unchanged
+        assert rs.probe_errors == 3
+        assert "sensor torn" in rs.last_error
+        mode["raise"] = False
+        mode["v"] = 0.0
+        eng.evaluate_once()
+        assert rs.state == STATE_INACTIVE
+
+    def test_force_firing_and_overload_advisory(self):
+        clock = FakeClock()
+        rules = [
+            AlertRule(name="engine_stalled", kind=KIND_STATE,
+                      probe=lambda: {"value": False},
+                      capture_evidence=False),
+            AlertRule(name="shed_rate_high", kind=KIND_THRESHOLD,
+                      probe=lambda: {"value": 99.0},
+                      windows=((0.0, 1.0),), overload=True),
+        ]
+        eng = _engine(rules, clock)
+        assert eng.force_firing("engine_stalled", reason="watchdog")
+        assert not eng.force_firing("engine_stalled")  # already firing
+        assert not eng.force_firing("nope")
+        assert eng.firing()["engine_stalled"]["values"] == {
+            "forced": "watchdog"}
+        eng.evaluate_once()
+        # overload advisory lists ONLY overload-marked firing rules
+        assert eng.firing_overload() == ["shed_rate_high"]
+        # the probe stays the source of truth after a force: a False
+        # probe resolves the forced latch on the next evaluation (the
+        # REAL watchdog wiring latches `tripped`, so its probe keeps
+        # answering True after a trip)
+        assert set(eng.firing()) == {"shed_rate_high"}
+
+    def test_transition_ring_bounded_and_snapshot_shape(self):
+        clock = FakeClock()
+        value = {"v": 0.0}
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                         probe=lambda: {"value": value["v"]},
+                         windows=((0.0, 10.0),),
+                         description="queue past bound")
+        eng = _engine([rule], clock)
+        for i in range(400):  # 800 transitions of flap
+            value["v"] = 50.0 if i % 2 == 0 else 0.0
+            eng.evaluate_once()
+            clock.advance(1.0)
+        with eng._lock:
+            assert len(eng._transitions) <= 256
+        snap = eng.snapshot()
+        assert snap["enabled"] and snap["evaluations"] == 400
+        doc = snap["rules"]["q"]
+        assert doc["kind"] == KIND_THRESHOLD
+        # each on-evaluation walks inactive->pending->firing (2), each
+        # off-evaluation resolves (1): 200 cycles x 3
+        assert doc["transitions"] == 600
+        assert "dump_cooldown" in snap
+        assert len(snap["transitions"]) <= 64
+
+
+# -------------------------------------------- evidence + dump cooldown
+class TestEvidence:
+    def test_firing_edge_captures_schema_valid_bundle(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("OMNI_TPU_DUMP_COOLDOWN_S", "3600")
+        clock = FakeClock()
+        value = {"v": 99.0}
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                         probe=lambda: {"value": value["v"]},
+                         windows=((0.0, 10.0),))
+        eng = _engine([rule], clock)
+        seen = []
+        eng.on_firing(lambda name, t: seen.append((name, t["to"])))
+        eng.evaluate_once()
+        rs = eng._rules["q"]
+        assert rs.evidence_captured == 1
+        assert seen == [("q", STATE_FIRING)]
+        path = rs.last_evidence_path
+        assert path and os.path.exists(path)
+        doc = json.loads(open(path).read())
+        # the bundle contract (docs/debugging.md): dump schema + the
+        # alert block + attribution + journey slice + request tables
+        assert doc["reason"] == "alert:q"
+        assert doc["schema_version"] >= 2
+        assert doc["alert"]["name"] == "q"
+        assert doc["alert"]["transition"]["to"] == STATE_FIRING
+        assert doc["alert"]["transition"]["values"]["value"] == 99.0
+        assert doc["alert"]["engine"]["rules"]["q"]["kind"] \
+            == KIND_THRESHOLD
+        assert isinstance(doc["attribution"], dict)
+        assert isinstance(doc["journey_tail"], list)
+        assert isinstance(doc["recorders"], list)
+        assert isinstance(doc["requests"], list)
+        # the flap: resolve and re-fire inside the cooldown — the
+        # second bundle is SUPPRESSED (exactly one file on disk)
+        value["v"] = 0.0
+        eng.evaluate_once()
+        value["v"] = 99.0
+        eng.evaluate_once()
+        assert rs.state == STATE_FIRING
+        assert rs.evidence_captured == 1
+        assert len(list(tmp_path.iterdir())) == 1
+
+    def test_no_flight_dir_no_bundle(self, monkeypatch):
+        monkeypatch.delenv("OMNI_TPU_FLIGHT_DIR", raising=False)
+        clock = FakeClock()
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                         probe=lambda: {"value": 99.0},
+                         windows=((0.0, 1.0),))
+        eng = _engine([rule], clock)
+        eng.evaluate_once()
+        rs = eng._rules["q"]
+        assert rs.state == STATE_FIRING
+        assert rs.evidence_captured == 0
+        assert rs.last_evidence_path is None
+
+
+class TestDumpCooldown:
+    def test_fake_clock_window_and_counting(self):
+        clock = FakeClock()
+        cd = DumpCooldown(cooldown_s=30.0, clock=clock)
+        # ready() RESERVES atomically: two racing same-reason dumpers
+        # cannot both pass the window check
+        assert cd.ready("alert:q", "/dir")
+        assert not cd.ready("alert:q", "/dir")      # inside window
+        # distinct reasons and distinct dirs are independent; a failed
+        # write releases its reservation so the retry that could
+        # succeed is not suppressed by a bundle that never landed
+        assert cd.ready("sigusr2", "/dir")
+        cd.release("sigusr2", "/dir")
+        assert cd.ready("sigusr2", "/dir")
+        assert cd.ready("alert:q", "/other")
+        clock.advance(29.0)
+        assert not cd.ready("alert:q", "/dir")
+        clock.advance(1.0)
+        assert cd.ready("alert:q", "/dir")          # window elapsed
+        snap = cd.snapshot()
+        assert snap["cooldown_s"] == 30.0
+        assert snap["reasons"]["alert:q@/dir"]["suppressed"] == 2
+        assert snap["reasons"]["alert:q@/dir"]["last_dump_age_s"] == 0.0
+
+    def test_zero_window_disables(self):
+        clock = FakeClock()
+        cd = DumpCooldown(cooldown_s=0.0, clock=clock)
+        for _ in range(5):
+            assert cd.ready("r", "/d")
+
+
+# ------------------------------------------------------- /metrics face
+def test_alert_series_ride_the_registry():
+    from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+    clock = FakeClock()
+    rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                     probe=lambda: {"value": 99.0},
+                     windows=((0.0, 1.0),))
+    eng = _engine([rule], clock)
+    eng.evaluate_once()
+    snap = resilience_metrics.snapshot()
+    # the registry is process-global (counts accumulate across the
+    # suite): assert presence, not exact counts
+    assert ({"alert": "q"}, 1) in snap["alerts_firing"]
+    labels = [l for l, _ in snap["alert_transitions_total"]]
+    assert {"alert": "q", "to": "pending"} in labels
+    assert {"alert": "q", "to": "firing"} in labels

@@ -1,0 +1,180 @@
+"""Heavy-hitter attribution sketch: the proven space-saving bounds
+under adversarial tenant churn, bounded memory at 10k+ tenants, and the
+TenantAttribution snapshot/export contract (docs/observability.md)."""
+
+import random
+
+from vllm_omni_tpu.metrics.attribution import (
+    EXPORT_TOP_K,
+    METERS,
+    SpaceSavingSketch,
+    TenantAttribution,
+)
+from vllm_omni_tpu.metrics.stats import MAX_TENANT_SERIES
+
+
+def _churn(sketch, capacity, n_tenants, n_events, seed=0,
+           heavy=None):
+    """Adversarial stream: a huge churning tail + optional heavy
+    hitters; returns the exact counts."""
+    rng = random.Random(seed)
+    true = {}
+
+    def add(key, n=1.0):
+        sketch.add(key, n)
+        true[key] = true.get(key, 0.0) + n
+
+    for i in range(n_events):
+        add(f"tail{rng.randint(0, n_tenants - 1)}")
+        if heavy and i % 10 == 0:
+            add(rng.choice(heavy), 5.0)
+    return true
+
+
+class TestSpaceSavingBounds:
+    def test_memory_bounded_under_tenant_churn(self):
+        sk = SpaceSavingSketch(capacity=128)
+        _churn(sk, 128, n_tenants=10_000, n_events=30_000)
+        assert len(sk) <= 128
+        # the lazy heap compacts: bounded too, not one entry per add
+        assert len(sk._heap) <= 8 * 128 + 1
+
+    def test_overestimate_and_error_bounds(self):
+        """For every tracked key: est >= true (never undercount),
+        est - true <= total/capacity (the proven bound), and the
+        tracked per-key error brackets the truth: est - err <= true."""
+        sk = SpaceSavingSketch(capacity=64)
+        true = _churn(sk, 64, n_tenants=5_000, n_events=20_000,
+                      heavy=["gold", "whale"])
+        bound = sk.max_overestimate
+        assert bound == sk.total / 64
+        for key, est, err in sk.top(64):
+            t = true.get(key, 0.0)
+            assert est >= t - 1e-9
+            assert est - t <= bound + 1e-9
+            assert est - err <= t + 1e-9
+
+    def test_guaranteed_heavy_hitters_present(self):
+        """Any key with true count > total/capacity MUST be tracked —
+        the guarantee that makes top-k trustworthy."""
+        sk = SpaceSavingSketch(capacity=64)
+        true = _churn(sk, 64, n_tenants=5_000, n_events=20_000,
+                      heavy=["gold", "whale", "acme"])
+        threshold = sk.total / sk.capacity
+        tracked = {k for k, _, _ in sk.top(64)}
+        for key, t in true.items():
+            if t > threshold:
+                assert key in tracked, (key, t, threshold)
+
+    def test_top_k_vs_exact_oracle(self):
+        """The sketch's top-k contains every exact top hitter whose
+        margin over the rest exceeds the error bound, in order."""
+        sk = SpaceSavingSketch(capacity=256)
+        rng = random.Random(7)
+        true = {}
+        # zipf-ish: tenant i gets weight ~ 1/(i+1)
+        keys = [f"t{i}" for i in range(2_000)]
+        for _ in range(40_000):
+            i = min(int(rng.paretovariate(1.0)) - 1, len(keys) - 1)
+            k = keys[i]
+            sk.add(k)
+            true[k] = true.get(k, 0) + 1
+        bound = sk.max_overestimate
+        exact = sorted(true.items(), key=lambda kv: -kv[1])
+        sketch_top = {k for k, _, _ in sk.top(10)}
+        for key, t in exact[:10]:
+            # only hitters separable from rank-11 by the bound are
+            # guaranteed; the rest may legitimately swap
+            if t - exact[10][1] > 2 * bound:
+                assert key in sketch_top
+        # and every reported estimate is within the bound of exact
+        for key, est, _ in sk.top(10):
+            assert abs(est - true.get(key, 0)) <= bound + 1e-9
+
+    def test_weighted_increments(self):
+        sk = SpaceSavingSketch(capacity=4)
+        sk.add("a", 100.0)
+        sk.add("b", 0.5)
+        est, err = sk.estimate("a")
+        assert est == 100.0 and err == 0.0
+        assert sk.total == 100.5
+        # non-positive amounts are ignored, never corrupt totals
+        sk.add("a", 0.0)
+        sk.add("a", -5.0)
+        assert sk.estimate("a")[0] == 100.0
+
+
+class TestTenantAttribution:
+    def test_meters_and_snapshot_shape(self):
+        attr = TenantAttribution(capacity=32)
+        attr.add("acme", "prefill_tokens", 100)
+        attr.add("acme", "decode_tokens", 10)
+        attr.add("other_co", "decode_tokens", 90)
+        attr.add("acme", "sheds")
+        snap = attr.snapshot()
+        assert snap["capacity"] == 32
+        assert set(snap["meters"]) == set(METERS)
+        dec = snap["meters"]["decode_tokens"]
+        assert dec["total"] == 100.0
+        assert dec["top"][0] == {"tenant": "other_co", "est": 90.0,
+                                 "err": 0.0, "export": True}
+        assert dec["tenants_tracked"] == 2
+
+    def test_hostile_tenant_sanitized_and_unknown_meter_dropped(self):
+        attr = TenantAttribution(capacity=8)
+        attr.add('evil"\n{injection}', "sheds", 1)
+        attr.add(None, "sheds", 1)
+        attr.add("x", "no_such_meter", 1)
+        rows = attr.top_k("sheds", 8)
+        tenants = [t for t, _, _ in rows]
+        assert "default" in tenants  # None -> default
+        assert all('"' not in t and "\n" not in t for t in tenants)
+
+    def test_export_top_k_inside_cardinality_cap(self):
+        """/metrics export per meter stays strictly inside the tenant
+        cardinality budget even with thousands of live tenants."""
+        assert EXPORT_TOP_K <= MAX_TENANT_SERIES
+        attr = TenantAttribution(capacity=256)
+        for i in range(5_000):
+            attr.add(f"t{i}", "queue_wait_ms", float(i % 13 + 1))
+        assert len(attr.top_k("queue_wait_ms")) == EXPORT_TOP_K
+        snap = attr.snapshot()
+        assert len(snap["meters"]["queue_wait_ms"]["top"]) \
+            == EXPORT_TOP_K
+        assert snap["meters"]["queue_wait_ms"]["tenants_tracked"] <= 256
+
+    def test_lifetime_export_slots_bounded_under_churn(self):
+        """The per-row export flag claims from a LIFETIME slot set:
+        however top-k membership churns across snapshots, the union
+        of ever-exported tenant labels stays within the cap — the
+        scrape database can never accrete unbounded dead series."""
+        attr = TenantAttribution(capacity=64)
+        exported = set()
+        rng = random.Random(3)
+        for wave in range(50):
+            # each wave a fresh cohort floods one meter to the top
+            for i in range(100):
+                attr.add(f"w{wave}_t{i}", "sheds",
+                         float(rng.randint(1, 1000)))
+            for row in attr.snapshot()["meters"]["sheds"]["top"]:
+                if row["export"]:
+                    exported.add(row["tenant"])
+        assert len(exported) <= MAX_TENANT_SERIES
+        # and a slot, once claimed, is held forever (monotone label
+        # set -> the exported counter series never vanish-and-reset)
+        assert exported <= attr._exported
+
+    def test_debug_snapshot_does_not_claim_slots(self):
+        """/debug/tenants and evidence bundles read with
+        claim_slots=False: a debugging poll during an incident must
+        not burn the lifetime /metrics label budget on tenants the
+        exposition never rendered."""
+        attr = TenantAttribution(capacity=8)
+        attr.add("acme", "sheds", 5.0)
+        rows = attr.snapshot(claim_slots=False)["meters"]["sheds"]["top"]
+        assert rows[0]["export"] is False
+        assert attr._exported == set()
+        # the exposition path claims; debug then reports membership
+        assert attr.snapshot()["meters"]["sheds"]["top"][0]["export"]
+        rows = attr.snapshot(claim_slots=False)["meters"]["sheds"]["top"]
+        assert rows[0]["export"] is True and attr._exported == {"acme"}

@@ -428,3 +428,80 @@ def test_tick_refreshes_router_gauges_while_idle():
     cp.tick()
     assert resilience_metrics.get("router_healthy_replicas",
                                   role="prefill") == 1
+
+
+# ------------------------------------------------- alert advisory (PR 15)
+class _FakeAlerts:
+    """metrics/alerts.py AlertEngine surface the controller reads."""
+
+    def __init__(self):
+        self.overload: list[str] = []
+
+    def firing_overload(self):
+        return list(self.overload)
+
+
+def test_overload_alert_advisory_boosts_pressure():
+    """A firing overload alert is an ADVISORY early-shed signal: it
+    adds pressure symmetrically so scale decisions accelerate, and the
+    sensors disclose which alerts drove the bias."""
+    router = _topology(n_prefill=1, n_decode=1)
+    alerts = _FakeAlerts()
+    cp = ControlPlane(router, ControlPlaneConfig(
+        alert_pressure_bonus=2.0), alert_engine=alerts)
+    base = cp.tick()
+    assert base["overload_alerts"] == []
+    p0 = base["prefill"]["pressure"]
+    alerts.overload = ["shed_rate_high", "queue_depth_high"]
+    boosted = cp.tick()
+    assert boosted["overload_alerts"] == ["shed_rate_high",
+                                          "queue_depth_high"]
+    # one bonus per firing overload alert, on BOTH roles
+    assert boosted["prefill"]["pressure"] == pytest.approx(p0 + 4.0)
+    assert boosted["decode"]["pressure"] == pytest.approx(
+        base["decode"]["pressure"] + 4.0)
+    # visible on /debug/controlplane
+    assert cp.debug_snapshot()["sensors"]["overload_alerts"] == [
+        "shed_rate_high", "queue_depth_high"]
+
+
+def test_overload_advisory_accelerates_scale_up():
+    """Pressure just under the scale-up threshold crosses it only
+    while an overload alert is firing — the advisory can accelerate
+    the controller but a broken alert engine can't wedge it (reads
+    are exception-guarded)."""
+    router = _topology(n_prefill=1, n_decode=1)
+    alerts = _FakeAlerts()
+    built = []
+
+    def factory(role, index):
+        r = _replica(f"new{index}", role, index)
+        built.append(r)
+        return r
+
+    cp = ControlPlane(
+        router,
+        ControlPlaneConfig(hysteresis_ticks=2, cooldown_ticks=1,
+                           autoscale_enabled=True, max_replicas=4,
+                           scale_up_pressure=8.0,
+                           alert_pressure_bonus=2.0,
+                           # park the rerole band wide so only the
+                           # scale leg can act
+                           band_low=0.01, band_high=100.0),
+        replica_factory=factory, alert_engine=alerts)
+    # standing pressure just under the threshold: 7 waiting on the one
+    # prefill replica -> pressure 7.0 < 8.0, never scales
+    router.prefills[0].engine.load(waiting=7)
+    for _ in range(6):
+        cp.tick()
+        cp.actuate()
+    assert built == []
+    # the detection layer fires an overload alert: +2 pushes past 8.0
+    alerts.overload = ["slo_fast_burn"]
+    for _ in range(4):
+        cp.tick()
+        cp.actuate()
+    assert len(built) == 1
+    # a raising alert engine degrades to no advisory, never a crash
+    alerts.firing_overload = None  # attribute no longer callable
+    cp.tick()

@@ -336,3 +336,39 @@ def test_restored_park_resumes_as_decode():
     s.update_from_output(out3, {"b": 2})
     assert victim.output_token_ids == [1, 2]
     assert victim.status == RequestStatus.RUNNING
+
+
+def test_parked_payload_lost_closes_park_interval():
+    """Payload-lost recompute goes through drop_park: the host-tier
+    page·second interval (per-tenant attribution, metrics/
+    attribution.py) stops at the shed instead of accruing phantom
+    residency through the request's whole recompute+decode life."""
+    from vllm_omni_tpu.kvcache.policy import OffloadPolicy
+    from vllm_omni_tpu.kvcache.tiers import TieredKVStore
+
+    cfg = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64,
+                          max_model_len=64, kv_offload=True)
+    kv = KVCacheManager(4, 4, enable_prefix_caching=False,
+                        tiers=TieredKVStore(),
+                        policy=OffloadPolicy(mode="always"))
+    s = ARScheduler(cfg, kv)
+    s.add_request(_req("a", n=8, max_tokens=2))
+    s.add_request(_req("b", n=8, max_tokens=8))
+    out = s.schedule()
+    s.update_from_output(out, {"a": 1, "b": 1})
+    out2 = s.schedule()         # a's decode page preempts b -> parked
+    victim = out2.preempted[0]
+    assert victim.request_id == "b"
+    # extraction drains, but the payload never lands in the host tier
+    # (shed before the restore): parked_available stays False
+    offloads, _ = kv.take_pending_moves()
+    for o in offloads:
+        if o.key.endswith(victim.request_id):
+            kv.note_park_extracted(o.key)
+    s.update_from_output(out2, {"a": 2})  # a finishes -> pages free
+    assert victim.request_id in kv._park_time
+    out3 = s.schedule()         # payload lost -> full recompute
+    assert [p.request.request_id for p in out3.prefills] == ["b"]
+    # the park interval is CLOSED and the park marker is gone
+    assert victim.request_id not in kv._park_time
+    assert "_parked_len" not in victim.additional_information

@@ -109,11 +109,54 @@ def test_dump_resolves_flight_dir(tmp_path, monkeypatch):
     out = dump_to_file(build_dump("sigusr2", recorders=[fr]))
     assert out is not None and "sigusr2" in out
     assert json.load(open(out))["reason"] == "sigusr2"
-    # two dumps with the same reason in the same SECOND must not
-    # overwrite each other (repeated SIGUSR2s)
+    # a second dump with the SAME reason inside the per-reason
+    # cooldown window is SUPPRESSED (repeated SIGUSR2s / a flapping
+    # alert must not flood the incident dir) — and counted in the
+    # cooldown self-view
+    from vllm_omni_tpu.introspection.flight_recorder import (
+        dump_cooldown,
+    )
+
     out2 = dump_to_file(build_dump("sigusr2", recorders=[fr]))
-    assert out2 is not None and out2 != out
-    assert json.load(open(out))["reason"] == "sigusr2"
+    assert out2 is None
+    snap = dump_cooldown.snapshot()
+    key = f"sigusr2@{tmp_path / 'dumps'}"
+    assert snap["reasons"][key]["suppressed"] == 1
+    # a DIFFERENT reason is independent of the sigusr2 window
+    out3 = dump_to_file(build_dump("crash", recorders=[fr]))
+    assert out3 is not None and out3 != out
+
+
+def test_failed_write_does_not_consume_cooldown(tmp_path, monkeypatch):
+    """A write that never lands (unusable flight dir) must neither
+    start the per-reason window nor register a last-dump age: the
+    first retry after the disk comes back succeeds immediately."""
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("")
+    monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(blocker / "dumps"))
+    fr = FlightRecorder(capacity=2)
+    fr.append({})
+    assert dump_to_file(build_dump("sigusr2", recorders=[fr])) is None
+    from vllm_omni_tpu.introspection.flight_recorder import dump_cooldown
+
+    assert f"sigusr2@{blocker / 'dumps'}" not in \
+        dump_cooldown.snapshot()["reasons"]
+    # the disk comes back: the very next attempt writes, no window owed
+    monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(tmp_path / "dumps"))
+    out = dump_to_file(build_dump("sigusr2", recorders=[fr]))
+    assert out is not None and json.load(open(out))["reason"] == "sigusr2"
+
+
+def test_dump_cooldown_zero_disables(tmp_path, monkeypatch):
+    monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("OMNI_TPU_DUMP_COOLDOWN_S", "0")
+    fr = FlightRecorder(capacity=2)
+    fr.append({})
+    # with the limiter off, same-reason dumps in the same second get
+    # distinct filenames (the process-wide dump ordinal)
+    out = dump_to_file(build_dump("sigusr2", recorders=[fr]))
+    out2 = dump_to_file(build_dump("sigusr2", recorders=[fr]))
+    assert out is not None and out2 is not None and out2 != out
 
 
 def test_capture_stacks_covers_all_threads():

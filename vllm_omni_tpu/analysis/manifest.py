@@ -61,6 +61,13 @@ HOT_PATHS: tuple[str, ...] = (
     # observable it is
     "vllm_omni_tpu/tracing/",
     "vllm_omni_tpu/metrics/roofline.py",
+    # omnipulse: the attribution sketch is fed from the engine step
+    # loop (token/page·second/shed meters per request event) and the
+    # alert probes read live engine state from the evaluation thread —
+    # host dict/heap arithmetic only; a device sync in either stalls
+    # serving in proportion to how observable it is
+    "vllm_omni_tpu/metrics/attribution.py",
+    "vllm_omni_tpu/metrics/alerts.py",
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (
@@ -105,6 +112,11 @@ BENCH_PATHS: tuple[str, ...] = (
 
 METRIC_MODULES: tuple[str, ...] = (
     "vllm_omni_tpu/metrics/prometheus.py",
+    # alert gauges/transition counters and attribution series render
+    # through METRIC_SPECS like everything else; listed so any future
+    # spec table grown in these modules rides the OL6 drift guard
+    "vllm_omni_tpu/metrics/alerts.py",
+    "vllm_omni_tpu/metrics/attribution.py",
 )
 
 # --------------------------------------------------------------- omnirace
@@ -214,6 +226,22 @@ LOCK_GUARDS: dict[str, dict[str, tuple[str, ...]]] = {
     # attributes below)
     "vllm_omni_tpu/controlplane/controller.py::ControlPlane": {
         "_lock": ("_pending", "_done", "_ring", "_seq", "actions"),
+    },
+    # evaluation thread and force_firing (the watchdog thread) both
+    # step the per-rule lifecycle — every state WRITE happens under
+    # the lock (serialized check+set, so the two can't double-land a
+    # firing edge); /debug/alerts, /health, and the control plane's
+    # advisory READ the per-rule scalars lock-free in the watchdog's
+    # GIL-atomic monitoring-read stance, so they're not listed
+    "vllm_omni_tpu/metrics/alerts.py::AlertEngine": {
+        "_lock": ("_rules", "_transitions"),
+    },
+    # (TenantAttribution's _meters dict is immutable post-__init__ —
+    # the lock guards the SKETCH CONTENTS, which OL7's attribute
+    # granularity can't express; its mutation sites all hold _lock)
+    # any thread may dump (crash hooks, alert evidence, SIGUSR2)
+    "vllm_omni_tpu/introspection/flight_recorder.py::DumpCooldown": {
+        "_lock": ("_last", "_suppressed"),
     },
 }
 

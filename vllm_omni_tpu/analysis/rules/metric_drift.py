@@ -100,6 +100,52 @@ def synthetic_engine_snapshot() -> dict:
             },
         },
         "saturation": {"prefill": 0.5, "decode": 0.25, "seats": 0.75},
+        # per-tenant heavy-hitter attribution (metrics/attribution.py):
+        # every meter that maps to a /metrics series must render —
+        # tenant_tokens_total{kind}, tenant_kv_page_seconds_total{tier},
+        # handoff/queue-wait/shed meters, and the tracked-tenants gauge
+        "attribution": {
+            "capacity": 256,
+            "meters": {
+                "prefill_tokens": {
+                    "total": 1200.0, "tenants_tracked": 2,
+                    "max_overestimate": 4.7,
+                    "top": [{"tenant": "acme", "est": 900.0,
+                             "err": 0.0},
+                            {"tenant": "default", "est": 300.0,
+                             "err": 4.0}]},
+                "decode_tokens": {
+                    "total": 640.0, "tenants_tracked": 2,
+                    "max_overestimate": 2.5,
+                    "top": [{"tenant": "acme", "est": 512.0,
+                             "err": 0.0}]},
+                "kv_page_seconds_hbm": {
+                    "total": 42.5, "tenants_tracked": 1,
+                    "max_overestimate": 0.2,
+                    "top": [{"tenant": "acme", "est": 42.5,
+                             "err": 0.0}]},
+                "kv_page_seconds_host": {
+                    "total": 7.25, "tenants_tracked": 1,
+                    "max_overestimate": 0.1,
+                    "top": [{"tenant": "default", "est": 7.25,
+                             "err": 0.0}]},
+                "handoff_bytes": {
+                    "total": 16384.0, "tenants_tracked": 1,
+                    "max_overestimate": 64.0,
+                    "top": [{"tenant": "acme", "est": 16384.0,
+                             "err": 0.0}]},
+                "queue_wait_ms": {
+                    "total": 850.0, "tenants_tracked": 2,
+                    "max_overestimate": 3.4,
+                    "top": [{"tenant": "default", "est": 600.0,
+                             "err": 1.0}]},
+                "sheds": {
+                    "total": 4.0, "tenants_tracked": 1,
+                    "max_overestimate": 0.1,
+                    "top": [{"tenant": "acme", "est": 4.0,
+                             "err": 0.0}]},
+            },
+        },
         # device-memory ledger (introspection/memory_ledger.py):
         # components sum to total; every new component label value
         # renders through the same two series
@@ -161,6 +207,15 @@ def run_check() -> list[str]:
             "controlplane_actions_total": [
                 ({"action": "drain"}, 2), ({"action": "rerole"}, 1),
                 ({"action": "scale_up"}, 1)],
+            # omnipulse alert lifecycle (metrics/alerts.py): the
+            # firing gauge + per-destination transition counters the
+            # loadgen overload e2e asserts on mid-flight
+            "alerts_firing": [({"alert": "slo_fast_burn"}, 1),
+                              ({"alert": "engine_stalled"}, 0)],
+            "alert_transitions_total": [
+                ({"alert": "slo_fast_burn", "to": "pending"}, 2),
+                ({"alert": "slo_fast_burn", "to": "firing"}, 1),
+                ({"alert": "slo_fast_burn", "to": "resolved"}, 1)],
         },
     )
     errors += validate_exposition(text)

@@ -107,6 +107,14 @@ class ControlPlaneConfig:
     # never scale down while fleet SLO attainment sits below this
     # floor (None/no-data = the gate passes)
     slo_scale_down_floor: float = 0.9
+    # firing overload alerts (metrics/alerts.py, rules marked
+    # overload=True) are an ADVISORY early-shed signal: each adds this
+    # many queue-depth-units of pressure to BOTH roles, so scale-up
+    # hysteresis integrates sooner and scale-down is held off while
+    # the detection layer says the fleet is drowning.  Advisory only —
+    # the alert can accelerate the controller, never force an action
+    # the sensors themselves would not eventually take
+    alert_pressure_bonus: float = 2.0
     # --- structured-action ring (/debug/controlplane)
     ring_capacity: int = 256
 
@@ -158,10 +166,14 @@ class ControlPlane:
                  config: Optional[ControlPlaneConfig] = None,
                  *,
                  replica_factory: Optional[Callable] = None,
+                 alert_engine=None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         self.router = router
         self.config = config or ControlPlaneConfig()
+        #: optional metrics/alerts.py AlertEngine whose firing
+        #: overload alerts feed tick() as advisory pressure
+        self.alert_engine = alert_engine
         #: builds a fresh EngineReplica for scale-up:
         #: ``factory(role: str, index: int) -> EngineReplica``
         self.replica_factory = replica_factory
@@ -251,11 +263,30 @@ class ControlPlane:
         return sensors
 
     def _read_sensors(self) -> dict:
+        import dataclasses
+
         cfg = self.config
         pre = role_sensors(self.router.prefills, ROLE_PREFILL,
                            "prefill", cfg.saturation_gain)
         dec = role_sensors(self.router.decodes, ROLE_DECODE,
                            "decode", cfg.saturation_gain)
+        # advisory early-shed signal: firing overload alerts bias the
+        # pressure model symmetrically — scale decisions accelerate,
+        # and the symmetric bonus pulls the rerole RATIO toward the
+        # dead band (an overload says "too little fleet", not "wrong
+        # prefill:decode split"; flipping roles mid-overload just
+        # moves the starvation)
+        overload_alerts: list[str] = []
+        if self.alert_engine is not None:
+            try:
+                overload_alerts = list(
+                    self.alert_engine.firing_overload())
+            except Exception:
+                logger.exception("alert advisory read failed")
+        if overload_alerts:
+            bonus = cfg.alert_pressure_bonus * len(overload_alerts)
+            pre = dataclasses.replace(pre, pressure=pre.pressure + bonus)
+            dec = dataclasses.replace(dec, pressure=dec.pressure + bonus)
         ratio = pressure_ratio(pre, dec)
         attainment = self._fleet_attainment()
         resilience_metrics.set_gauge("controlplane_replicas",
@@ -268,6 +299,7 @@ class ControlPlane:
             "decode": dec.as_dict(),
             "pressure_ratio": round(ratio, 4),
             "slo_attainment": attainment,
+            "overload_alerts": overload_alerts,
             "_pre": pre, "_dec": dec,  # objects for the decision legs
         }
         return self._last_sensors

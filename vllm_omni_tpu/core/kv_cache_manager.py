@@ -33,6 +33,7 @@ so the per-layer caches stay aligned (same layout the TPU kernel wants).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -130,6 +131,19 @@ class KVCacheManager:
         # peer engine (disaggregated prefill adoption, docs/
         # disaggregation.md) rather than being computed here
         self.streamed_tokens = 0
+        # ---- per-tenant attribution hooks (metrics/attribution.py):
+        # host-int timestamp accounting of page occupancy — every
+        # table-size change closes the previous (pages x elapsed)
+        # interval into the per-tenant accumulator.  Pure monotonic
+        # host arithmetic, zero device syncs; the engine drains the
+        # accumulators into its heavy-hitter sketch each step.
+        # request_id -> (pages, since_mono, tenant) for live HBM
+        # tables; parked host-tier payloads tracked separately
+        self._page_time: dict[str, tuple[int, float, str]] = {}
+        self._park_time: dict[str, tuple[int, float, str]] = {}
+        # tenant -> page·seconds accumulated since the last drain
+        self._page_seconds: dict[str, float] = {}
+        self._park_seconds: dict[str, float] = {}
 
     # ------------------------------------------------------------- queries
     def _pinned_pages(self) -> set[int]:
@@ -204,6 +218,56 @@ class KVCacheManager:
                 "streamed_tokens": self.streamed_tokens,
             },
         }
+
+    # ------------------------------------------------ tenant attribution
+    def _stamp_pages(self, request: Request) -> None:
+        """Close the request's open (pages x elapsed) HBM interval into
+        the per-tenant accumulator and re-open it at the CURRENT table
+        size (0 pages closes for good).  Called at every table-size
+        change; the interval's tenant is captured at open so a free()
+        after the request object is otherwise forgotten still lands on
+        the right tenant."""
+        rid = request.request_id
+        now = time.monotonic()
+        prev = self._page_time.pop(rid, None)
+        if prev is not None:
+            pages, since, tenant = prev
+            self._page_seconds[tenant] = (
+                self._page_seconds.get(tenant, 0.0)
+                + pages * (now - since))
+        else:
+            tenant = getattr(request, "tenant", "default")
+        n = len(self._tables.get(rid, ()))
+        if n:
+            self._page_time[rid] = (n, now, tenant)
+
+    def _close_park(self, request: Request) -> None:
+        """Close the request's parked host-tier interval (restore or
+        drop)."""
+        prev = self._park_time.pop(request.request_id, None)
+        if prev is not None:
+            pages, since, tenant = prev
+            self._park_seconds[tenant] = (
+                self._park_seconds.get(tenant, 0.0)
+                + pages * (time.monotonic() - since))
+
+    def drain_page_seconds(self) -> dict[str, dict[str, float]]:
+        """Per-tenant KV page·seconds accumulated since the last drain,
+        per tier: ``{"hbm": {tenant: s}, "host": {tenant: s}}``.  Live
+        intervals are folded up to now and re-stamped, so repeated
+        drains partition time exactly (no interval is counted twice or
+        dropped).  The engine calls this on its own thread each step
+        and meters the result through its attribution sketch."""
+        now = time.monotonic()
+        for table, acc in ((self._page_time, self._page_seconds),
+                           (self._park_time, self._park_seconds)):
+            for rid, (pages, since, tenant) in table.items():
+                acc[tenant] = (acc.get(tenant, 0.0)
+                               + pages * (now - since))
+                table[rid] = (pages, now, tenant)
+        hbm, self._page_seconds = self._page_seconds, {}
+        host, self._park_seconds = self._park_seconds, {}
+        return {"hbm": hbm, "host": host}
 
     # ------------------------------------------------------- prefix cache
     def match_prefix(self, request: Request) -> int:
@@ -287,6 +351,7 @@ class KVCacheManager:
         self.prefix_hits += 1
         self.prefix_hit_tokens += matched
         self.restored_tokens += restored
+        self._stamp_pages(request)
         return matched
 
     def reset_prefix_cache(self) -> int:
@@ -386,6 +451,8 @@ class KVCacheManager:
             if fresh:
                 del self._tables[rid]
             return None
+        if grow > 0:
+            self._stamp_pages(request)
         return list(table)
 
     def adopt_streamed(self, request: Request, n_tokens: int
@@ -451,6 +518,8 @@ class KVCacheManager:
             if page in pinned:
                 continue  # released by ack_transfer
             self._free.append(page)
+        # table gone: closes the request's HBM page·seconds interval
+        self._stamp_pages(request)
 
     # -------------------------------------------------------- park/restore
     def park_request(self, request: Request) -> int:
@@ -483,6 +552,11 @@ class KVCacheManager:
         self._extract_in_flight.add(key)
         request.additional_information["_parked_len"] = seq_len
         self.parked_tokens += seq_len
+        # host-tier occupancy interval opens at park (closed by
+        # restore_parked / drop_park)
+        self._park_time[request.request_id] = (
+            keep, time.monotonic(), getattr(request, "tenant",
+                                            "default"))
         return seq_len
 
     def park_in_flight(self, request: Request) -> bool:
@@ -520,6 +594,7 @@ class KVCacheManager:
         request.num_computed_tokens = parked
         request.additional_information.pop("_parked_len", None)
         self.restored_tokens += parked
+        self._close_park(request)
         return True
 
     def drop_park(self, request: Request) -> None:
@@ -532,6 +607,7 @@ class KVCacheManager:
             o for o in self.pending_offloads if o.key != key]
         if self.tiers is not None:
             self.tiers.drop(key)
+        self._close_park(request)
 
     def take_pending_moves(self) -> tuple[list[PendingOffload],
                                           list[PendingRestore]]:
@@ -615,6 +691,7 @@ class KVCacheManager:
             self._free.append(page)
         request.num_computed_tokens = min(request.num_computed_tokens,
                                           keep_tokens)
+        self._stamp_pages(request)
 
     # --------------------------------------------------------- transfers
     def pin_for_transfer(self, request: Request, seq_len: int) -> list[int]:

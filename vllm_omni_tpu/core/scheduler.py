@@ -190,6 +190,11 @@ class ARScheduler:
         # load-shed counters, keyed (reason, tenant) — rendered as
         # shed_requests_total{reason, tenant} on /metrics
         self.shed_counts: dict[tuple[str, str], int] = {}
+        # optional heavy-hitter attribution sink installed by the
+        # engine (metrics/attribution.py ``TenantAttribution.add``):
+        # unlike the capped shed ledger above, the sketch sees past
+        # the cardinality cap — which tenant is driving the 429s
+        self.attribution_sink = None
         # WFQ deferral ledger: rounds a tenant's head-of-line fresh
         # request was held back by its deficit while the DRR pass
         # placed other work — rendered as
@@ -286,6 +291,10 @@ class ARScheduler:
                             {t for _, t in self.shed_counts})
         key = (reason, tenant)
         self.shed_counts[key] = self.shed_counts.get(key, 0) + 1
+        if self.attribution_sink is not None:
+            # UNcapped tenant on purpose: the sketch bounds its own
+            # memory, and attribution past the cap is its whole point
+            self.attribution_sink(request.tenant, "sheds", 1.0)
         self.reject(request, message, kind=SHED)
 
     def _shed_lower_priority(self, arrival: Request) -> bool:
@@ -643,8 +652,12 @@ class ARScheduler:
                     # may no longer fit one step.  _preempt skipped its
                     # starvation reject trusting the park; re-check
                     # here or the head request wedges the queue forever
-                    # while other traffic keeps the engine busy
-                    req.additional_information.pop("_parked_len", None)
+                    # while other traffic keeps the engine busy.
+                    # drop_park (not a bare _parked_len pop) also
+                    # closes the host-tier page·second interval —
+                    # residency attribution must stop at the shed, not
+                    # run on through the whole recompute
+                    self.kv.drop_park(req)
                     if (not self.config.chunking_enabled
                             and req.num_tokens
                             > self.config.max_num_batched_tokens):

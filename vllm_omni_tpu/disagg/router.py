@@ -686,6 +686,14 @@ class DisaggRouter:
                           "tp_shards": self.tp_shards, "tier": tier})
                 resilience_metrics.inc("kv_handoff_bytes_total",
                                        n, dir="out")
+                # per-tenant handoff-byte attribution on the SHIPPING
+                # engine's sketch (one add per handoff; the recv leg
+                # is the same bytes — counting both would double it)
+                attr = (getattr(prefill_replica.engine, "attribution",
+                                None)
+                        if prefill_replica is not None else None)
+                if attr is not None:
+                    attr.add(ctx.info.get("tenant"), "handoff_bytes", n)
                 if not zero_copy:
                     t_recv, w_recv = time.perf_counter(), time.time()
                     received = roles.recv_handoff(

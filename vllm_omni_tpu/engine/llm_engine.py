@@ -351,6 +351,17 @@ class LLMEngine:
         # against them per tenant (slo_attainment_ratio, goodput)
         self.step_metrics.slo_ttft_ms = config.slo_ttft_ms
         self.step_metrics.slo_tpot_ms = config.slo_tpot_ms
+        # per-tenant heavy-hitter attribution (metrics/attribution.py):
+        # bounded-memory space-saving sketches metering prefill/decode
+        # tokens, KV page·seconds per tier, handoff bytes, queue wait,
+        # and sheds — the answer to "which tenant is eating this
+        # engine" that survives millions of distinct tenants.  The
+        # scheduler's shed path and the KV manager's occupancy clock
+        # feed it; top-k renders on /metrics and /debug/tenants
+        from vllm_omni_tpu.metrics.attribution import TenantAttribution
+
+        self.attribution = TenantAttribution()
+        self.scheduler.attribution_sink = self.attribution.add
         # async pipeline drain granularity: how many steps fell back to
         # the synchronous path, PER REASON ("prefill", "spec",
         # "logprobs", "kv_transfer", ...) — under unified batching the
@@ -862,6 +873,8 @@ class LLMEngine:
             wait_s = (max(now_m - req.arrival_mono, 0.0)
                       if req.arrival_mono else 0.0)
             self.step_metrics.queue_wait_ms.observe(wait_s * 1e3)
+            self.attribution.add(req.tenant, "queue_wait_ms",
+                                 wait_s * 1e3)
             ctx = req.additional_information.get("trace")
             if ctx and req.arrival_time:
                 # span START stays wall-clock (trace timelines align on
@@ -951,6 +964,9 @@ class LLMEngine:
                                 "window_steps": rf["window_steps"]}
         if self.config.async_scheduling:
             snap["async_fallback"] = dict(self.async_fallback)
+        # per-tenant heavy-hitter boards (metrics/attribution.py):
+        # top-k per meter, inside the tenant-cardinality budget
+        snap["attribution"] = self.attribution.snapshot()
         # device-memory ledger: per-component live/peak bytes
         # (device_memory_bytes{component} on /metrics; refresh is a
         # cold-path metadata walk + optional allocator probe)
@@ -1504,6 +1520,19 @@ class LLMEngine:
                 ttft = st[3] if st[3] is not None else (
                     float("inf") if sm.slo_ttft_ms is not None else 0.0)
                 sm.on_request_slo(req.tenant, ttft, tpot, n_out)
+            # heavy-hitter token attribution, metered at finish (one
+            # sketch update per request, not per token)
+            self.attribution.add(req.tenant, "prefill_tokens",
+                                 req.num_prompt_tokens)
+            self.attribution.add(req.tenant, "decode_tokens", n_out)
+        # KV occupancy attribution: fold the manager's host-int
+        # interval clock into the sketch (engine thread — the KV
+        # manager is single-threaded by contract)
+        drained = self.scheduler.kv.drain_page_seconds()
+        for tier, by_tenant in drained.items():
+            for tenant, secs in by_tenant.items():
+                self.attribution.add(tenant, f"kv_page_seconds_{tier}",
+                                     secs)
         return new_total
 
     # ---------------------------------------------------------- generate()

@@ -71,6 +71,7 @@ class AsyncOmni:
         self._running = False
         self._thread.join(timeout=10)
         self._omni.watchdog.stop()
+        self._omni.alerts.stop()
         # final drain + the one Chrome-document export (the heartbeat
         # only streams JSONL)
         self._omni.flush_traces()
@@ -87,6 +88,11 @@ class AsyncOmni:
     def watchdog(self):
         """The orchestrator's stall watchdog (introspection)."""
         return self._omni.watchdog
+
+    @property
+    def alerts(self):
+        """The orchestrator's alert engine (metrics/alerts.py)."""
+        return self._omni.alerts
 
     @property
     def engine_thread_alive(self) -> bool:

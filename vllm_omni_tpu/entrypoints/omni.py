@@ -185,6 +185,28 @@ class Omni:
                     f"stage{stage.stage_id}/supervisor", stage)
         if deadline > 0:
             self.watchdog.start()
+        # omnipulse alerting (metrics/alerts.py): the detection layer
+        # over the sensors above — multi-window burn-rate rules over
+        # the SLO/shed/queue/saturation registries, the watchdog trip
+        # surfaced as a firing `engine_stalled` alert (one source of
+        # truth for "this replica is wedged"), and alert-triggered
+        # evidence capture through the flight-recorder dump path.
+        # Same lifecycle stance as the watchdog: the object always
+        # exists (one source of truth for /debug/alerts + /health);
+        # the evaluation thread only starts when OMNI_TPU_ALERTS_S > 0
+        from vllm_omni_tpu.metrics.alerts import (
+            AlertEngine,
+            build_default_rules,
+        )
+
+        alert_interval = float(_envs.OMNI_TPU_ALERTS_S or 0.0)
+        self.alerts = AlertEngine(build_default_rules(self),
+                                  interval_s=alert_interval or 5.0)
+        self.watchdog.on_trip(
+            lambda doc: self.alerts.force_firing(
+                "engine_stalled", reason="watchdog trip"))
+        if alert_interval > 0:
+            self.alerts.start()
 
     # ------------------------------------------------------------- tracing
     @property
@@ -453,6 +475,7 @@ class Omni:
         """Stop process-disaggregated stage workers (no-op for in-proc
         stages)."""
         self.watchdog.stop()
+        self.alerts.stop()
         self.flush_traces()
         for stage in self.stages:
             stop = getattr(stage, "shutdown", None)

@@ -433,6 +433,16 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             # {"enabled": false} on uncontrolled deployments
             return self._json(200, debugz.debug_controlplane(omni),
                               default=str)
+        if path == "/debug/alerts":
+            # omnipulse rule states + transition ring + dump-cooldown
+            # self-view ({"enabled": false} without an alert engine)
+            return self._json(200, debugz.debug_alerts(omni),
+                              default=str)
+        if path == "/debug/tenants":
+            # per-stage heavy-hitter attribution boards (top-k per
+            # consumption meter, with error bounds)
+            return self._json(200, debugz.debug_tenants(omni),
+                              default=str)
         if path == "/debug/trace":
             # trace-layer self-view (docs/observability.md): recorder
             # occupancy, spans_dropped, writer paths, last export

@@ -60,6 +60,18 @@ _register("OMNI_TPU_FAULTS", "", str)
 _register("OMNI_TPU_FLIGHT_DIR", "", str)
 # Per-engine flight-recorder ring capacity (step records kept).
 _register("OMNI_TPU_FLIGHT_CAPACITY", "256", int)
+# Alert-engine evaluation interval in seconds (metrics/alerts.py):
+# > 0 starts the evaluation thread over the default burn-rate/overload
+# rule set.  0 (default) builds the engine without the thread — tests
+# and operators drive evaluate_once() directly, and /debug/alerts
+# still answers.
+_register("OMNI_TPU_ALERTS_S", "0", float)
+# Per-reason flight-dump cooldown in seconds (introspection/
+# flight_recorder.py DumpCooldown): repeated dumps with the same
+# reason into the same OMNI_TPU_FLIGHT_DIR within the window are
+# suppressed (and counted) — a flapping alert or a held-down SIGUSR2
+# must not flood the incident directory.  0 disables the limit.
+_register("OMNI_TPU_DUMP_COOLDOWN_S", "30", float)
 # Stall-watchdog deadline in seconds (introspection/watchdog.py): a
 # busy engine making no step progress for this long — with no XLA
 # compile in flight — trips the watchdog (dump + /health 503).

@@ -32,7 +32,8 @@ from vllm_omni_tpu.introspection.flight_recorder import capture_stacks
 
 ENDPOINTS = ("/debug/engine", "/debug/requests", "/debug/kv",
              "/debug/flightrecorder", "/debug/stacks", "/debug/watchdog",
-             "/debug/disagg", "/debug/controlplane", "/debug/trace")
+             "/debug/disagg", "/debug/controlplane", "/debug/trace",
+             "/debug/alerts", "/debug/tenants")
 
 
 # -------------------------------------------------------- request table
@@ -287,6 +288,40 @@ def debug_trace(omni) -> dict:
     return doc
 
 
+def debug_alerts(omni) -> dict:
+    """Alert-engine state (docs/observability.md): every rule's
+    declaration + lifecycle state, window values at the last
+    evaluation, the transition-ring tail, and the dump-cooldown
+    self-view evidence capture rides.  ``{"enabled": False}`` on
+    deployments without an alert engine — the endpoint always
+    answers."""
+    alerts = getattr(omni, "alerts", None)
+    if alerts is None:
+        return {"enabled": False}
+    try:
+        return alerts.snapshot()
+    except Exception as e:
+        # same stance as _per_stage: a torn concurrent read degrades
+        # to a retry marker, never a 500 on the debugging request
+        return {"enabled": True, "error": repr(e), "retry": True}
+
+
+def debug_tenants(omni) -> dict:
+    """Per-stage tenant attribution boards (metrics/attribution.py):
+    top-k heavy hitters per consumption meter with their proven error
+    bounds — the incident answer to "which tenant is eating the
+    fleet"."""
+
+    def one(engine):
+        attr = getattr(engine, "attribution", None)
+        # claim_slots=False: a debugging poll must not burn lifetime
+        # /metrics label slots on tenants the exposition never renders
+        return (attr.snapshot(claim_slots=False)
+                if attr is not None else {})
+
+    return {"stages": _per_stage(omni, one, {})}
+
+
 def debug_index() -> dict:
     return {"endpoints": list(ENDPOINTS),
             "hint": "see docs/debugging.md for the tour"}
@@ -318,6 +353,16 @@ def health_snapshot(omni, engine_thread_alive: Optional[bool] = None
         "watchdog": (wd.state() if wd is not None
                      else {"enabled": False}),
     }
+    # read-only alert visibility: the count of firing alerts rides the
+    # payload WITHOUT joining the 503 decision — ejection stays the
+    # watchdog/engine-liveness contract (an overload alert means "shed
+    # and scale", not "take the replica out back")
+    alerts = getattr(omni, "alerts", None)
+    if alerts is not None:
+        try:
+            body["alerts_firing"] = len(alerts.firing())
+        except Exception:
+            body["alerts_firing"] = None
     if engine_thread_alive is not None:
         body["engine_alive"] = bool(engine_thread_alive)
     code = 200

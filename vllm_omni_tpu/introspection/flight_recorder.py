@@ -142,6 +142,92 @@ _dump_seq = 0
 _dump_seq_lock = threading.Lock()
 
 
+class DumpCooldown:
+    """Per-reason dump rate limit: a flapping alert, a held-down
+    SIGUSR2, or a crash loop must not flood ``OMNI_TPU_FLIGHT_DIR``
+    with near-identical documents.  Keys are ``reason@dir`` — distinct
+    reasons never throttle each other (a crash dump lands even seconds
+    after an alert bundle), and distinct directories are independent
+    (test processes point each dump at a fresh tmpdir).
+
+    Suppressions are COUNTED per key and visible in ``snapshot()``
+    (served on /debug/alerts, the watchdog-state stance) so an
+    operator can see that dumps were elided, not lost.  Clock is
+    injectable for fake-clock tests; the window resolves through
+    ``OMNI_TPU_DUMP_COOLDOWN_S`` unless pinned at construction."""
+
+    def __init__(self, cooldown_s: Optional[float] = None,
+                 clock=time.monotonic):
+        self._cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = traced(threading.Lock(), "DumpCooldown._lock")
+        self._last: dict[str, float] = {}
+        self._prev: dict[str, Optional[float]] = {}
+        self._suppressed: dict[str, int] = {}
+
+    def window_s(self) -> float:
+        if self._cooldown_s is not None:
+            return float(self._cooldown_s)
+        from vllm_omni_tpu import envs
+
+        return float(envs.OMNI_TPU_DUMP_COOLDOWN_S)
+
+    def ready(self, reason: str, where: str = "") -> bool:
+        """True (and RESERVES the window atomically — two threads
+        racing the same reason cannot both pass) when a dump for
+        ``reason`` may write now; False counts a suppression.  A
+        writer whose write then fails calls :meth:`release` so a full
+        disk at the worst possible moment neither eats the window nor
+        fakes a last-dump age for a bundle that was never written."""
+        key = f"{reason}@{where}"
+        window = self.window_s()
+        now = self._clock()
+        with self._lock:
+            last = self._last.get(key)
+            if window > 0 and last is not None and now - last < window:
+                self._suppressed[key] = self._suppressed.get(key, 0) + 1
+                return False
+            self._prev[key] = last
+            self._last[key] = now
+            return True
+
+    def release(self, reason: str, where: str = "") -> None:
+        """Roll back a :meth:`ready` reservation whose write failed:
+        the prior stamp (if any) is restored, so the next attempt is
+        not suppressed by a dump that never landed."""
+        key = f"{reason}@{where}"
+        with self._lock:
+            prev = self._prev.pop(key, None)
+            if prev is None:
+                self._last.pop(key, None)
+            else:
+                self._last[key] = prev
+
+    def snapshot(self) -> dict:
+        """JSON-ready self-view: the window plus, per reason key, the
+        age of the last written dump and the suppressed count."""
+        now = self._clock()
+        with self._lock:
+            last = dict(self._last)
+            suppressed = dict(self._suppressed)
+        return {
+            "cooldown_s": self.window_s(),
+            "reasons": {
+                key: {
+                    "last_dump_age_s": round(now - t, 3),
+                    "suppressed": suppressed.get(key, 0),
+                }
+                for key, t in sorted(last.items())
+            },
+        }
+
+
+#: the process-wide limiter ``dump_to_file`` consults for every
+#: flight-dir-resolved write (explicit-path callers manage their own
+#: files and bypass it)
+dump_cooldown = DumpCooldown()
+
+
 def capture_stacks() -> dict:
     """All-thread stack traces, keyed by thread name (falling back to
     the raw thread id).  Pure host introspection — safe from any thread,
@@ -179,15 +265,33 @@ def build_dump(reason: str, *, recorders: list[FlightRecorder] = (),
 def dump_to_file(doc: dict, path: Optional[str] = None) -> Optional[str]:
     """Write a dump document as JSON.  ``path`` None resolves through
     ``OMNI_TPU_FLIGHT_DIR``; unset means the dump is skipped (returns
-    None) — crash hooks must not litter CWD in ordinary test runs."""
+    None) — crash hooks must not litter CWD in ordinary test runs.
+    Flight-dir-resolved writes are rate-limited PER REASON through
+    :data:`dump_cooldown` (suppressed writes return None and are
+    counted); an explicit ``path`` bypasses the limiter — the caller
+    chose the exact file, so flooding is its problem to solve."""
+    cooldown_key = None
     if path is None:
         from vllm_omni_tpu import envs
 
         flight_dir = envs.OMNI_TPU_FLIGHT_DIR
         if not flight_dir:
             return None
-        os.makedirs(flight_dir, exist_ok=True)
         reason = str(doc.get("reason", "dump")).replace("/", "_")
+        if not dump_cooldown.ready(reason, flight_dir):
+            logger.warning(
+                "flight-recorder dump (%s) suppressed by the %ss "
+                "per-reason cooldown", reason,
+                dump_cooldown.window_s())
+            return None
+        cooldown_key = (reason, flight_dir)
+        try:
+            os.makedirs(flight_dir, exist_ok=True)
+        except OSError as e:  # a dying process must not die harder
+            logger.error("flight-recorder dir %s unusable: %s",
+                         flight_dir, e)
+            dump_cooldown.release(*cooldown_key)
+            return None
         global _dump_seq
         with _dump_seq_lock:
             _dump_seq += 1
@@ -201,6 +305,10 @@ def dump_to_file(doc: dict, path: Optional[str] = None) -> Optional[str]:
             json.dump(doc, f, indent=1, default=str)
     except OSError as e:  # a dying process must not die harder
         logger.error("flight-recorder dump to %s failed: %s", path, e)
+        if cooldown_key is not None:
+            # a bundle that never landed must not hold the window:
+            # the retry that could succeed stays unsuppressed
+            dump_cooldown.release(*cooldown_key)
         return None
     logger.warning("flight-recorder dump (%s) written to %s",
                    doc.get("reason"), path)

@@ -1,0 +1,659 @@
+"""omnipulse: SLO burn-rate alerting over the in-proc metric registries.
+
+The stack can record a bad minute (flight recorder), trace it (journey
+spans), and act on sustained pressure (control plane) — this module
+*detects* one as it starts.  ``AlertEngine`` is a monitor thread (the
+watchdog/controller stance: injectable clock + sleep, ``evaluate_once``
+is the whole state machine so tests drive it synchronously) evaluating
+declarative :class:`AlertRule`\\s against live engine state:
+
+- **burn_rate** rules implement multi-window multi-burn-rate SLO
+  alerting (the SRE-workbook shape): cumulative bad/total counters are
+  sampled into a :class:`~vllm_omni_tpu.metrics.stats.DeltaRing` and
+  the error-budget burn is computed over BOTH a fast (5m-style) and a
+  slow (1h-style) window — the fast window gives low detection latency,
+  the slow window stops a single bad second from paging.  All listed
+  windows must exceed their threshold to fire.  A window not yet
+  backed by a full span of history (early process life) has its burn
+  scaled by real coverage, so the slow window holds pages back from
+  the very first evaluation instead of degenerating into a second
+  copy of the fast window.
+- **rate** rules alert on counter velocity over windows (sheds/s,
+  failovers/s) — delta over the REAL covered span once the window has
+  history, with the nominal window as the floor before it does (the
+  early-life guard again).
+- **threshold** rules compare an instantaneous gauge (queue depth,
+  p99-vs-target, saturation) against a bound, smoothed by
+  ``for_duration_s``.
+- **state** rules latch on booleans (watchdog tripped, degraded mode).
+
+Lifecycle per rule: ``inactive -> pending -> firing -> resolved``
+(pending holds for ``for_duration_s`` before firing — the hysteresis
+that keeps a one-evaluation blip from paging), every transition lands
+on a bounded ring and on /metrics (``alerts_firing{alert}``,
+``alert_transitions_total{alert,to}`` riding the resilience registry).
+A probe that raises is counted and SKIPPED — a broken probe must never
+fire or resolve an alert (probe-error immunity).
+
+A ``pending -> firing`` transition captures **evidence** while the bad
+minute is still alive: one rate-limited dump document through the PR 8
+``build_dump``/``dump_to_file`` path (reason ``alert:<name>``, gated on
+``OMNI_TPU_FLIGHT_DIR`` and the per-reason dump cooldown) carrying the
+flight-recorder tails, a journey-trace slice, every engine's top-k
+tenant attribution board, and the rule's window values at the moment it
+fired.  The control plane reads firing ``overload=True`` alerts as an
+advisory early-shed signal (controlplane/controller.py).
+
+Threading: the evaluation thread and ``force_firing`` (called from the
+watchdog thread) both step per-rule lifecycle state — every state
+WRITE happens under ``_lock`` (serialized check+set: the two sides
+cannot double-land a firing edge), which also guards the rule table
+and the transition ring (LOCK_GUARDS manifest); /debug/alerts and
+/health READ the per-rule scalars lock-free in the watchdog's
+GIL-atomic monitoring-read stance.  Evidence capture runs OUTSIDE the
+lock — file writes under it would convoy every reader.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from vllm_omni_tpu.analysis.runtime import traced
+from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.metrics.stats import DeltaRing, burn_rate
+from vllm_omni_tpu.resilience.metrics import resilience_metrics
+
+logger = init_logger(__name__)
+
+KIND_BURN = "burn_rate"
+KIND_RATE = "rate"
+KIND_THRESHOLD = "threshold"
+KIND_STATE = "state"
+
+STATE_INACTIVE = "inactive"
+STATE_PENDING = "pending"
+STATE_FIRING = "firing"
+
+#: transition ring capacity (bounded like the controlplane action ring)
+TRANSITION_RING = 256
+
+
+@dataclass
+class AlertRule:
+    """One declarative alert (docs/observability.md has the schema).
+
+    ``probe`` returns a dict and must be cheap host reads only:
+      - burn_rate: ``{"bad": cum_bad, "total": cum_total}``
+      - rate:      ``{"count": cum_count}``
+      - threshold/state: ``{"value": v}``
+    ``windows`` is ``((window_s, threshold), ...)`` — burn/rate rules
+    require EVERY window to exceed its threshold (multi-window);
+    threshold rules use the first entry's threshold instantaneously.
+    ``budget`` is the error budget (1 - SLO objective) for burn rules.
+    ``overload=True`` marks the rule as an overload signal the control
+    plane may read as advisory early-shed.  ``capture_evidence=False``
+    skips the firing-edge dump (e.g. ``engine_stalled`` — the watchdog
+    already wrote the richer trip dump)."""
+
+    name: str
+    kind: str
+    probe: Callable[[], dict]
+    windows: tuple = ()
+    budget: float = 0.01
+    for_duration_s: float = 0.0
+    overload: bool = False
+    capture_evidence: bool = True
+    description: str = ""
+
+
+class _RuleState:
+    def __init__(self, rule: AlertRule, clock, interval_s: float):
+        self.rule = rule
+        horizon = max((w for w, _ in rule.windows), default=60.0) * 1.05
+        # size the ring from horizon/cadence so the sample cap never
+        # silently shortens a window: at OMNI_TPU_ALERTS_S=1 an hour
+        # needs ~3800 samples, not DeltaRing's 720 default
+        self.ring = DeltaRing(
+            horizon_s=horizon,
+            max_samples=max(720,
+                            int(horizon / max(interval_s, 1e-3)) + 4),
+            clock=clock)
+        self.state = STATE_INACTIVE
+        self.pending_since: Optional[float] = None
+        self.firing_since: Optional[float] = None
+        self.last_values: dict = {}
+        self.probe_errors = 0
+        self.last_error: Optional[str] = None
+        self.transitions = 0
+        self.evidence_captured = 0
+        self.last_evidence_path: Optional[str] = None
+
+
+class AlertEngine:
+    """The evaluation loop + its read-side views.
+
+    ``evaluate_once()`` is the whole state machine (the thread just
+    calls it on an interval) — tests and operators drive it with a
+    fake clock, exactly like ``StallWatchdog.check_once``.
+    """
+
+    def __init__(self, rules: Optional[list[AlertRule]] = None, *,
+                 interval_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = traced(threading.Lock(), "AlertEngine._lock")
+        self._rules: dict[str, _RuleState] = {}
+        self._transitions: "list[dict]" = []
+        self._on_firing: list[Callable[[str, dict], None]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.evaluations = 0
+        for r in rules or ():
+            self.add_rule(r)
+
+    # ------------------------------------------------------------- rules
+    def add_rule(self, rule: AlertRule) -> None:
+        if rule.kind not in (KIND_BURN, KIND_RATE, KIND_THRESHOLD,
+                             KIND_STATE):
+            raise ValueError(f"unknown alert kind {rule.kind!r}")
+        with self._lock:
+            self._rules[rule.name] = _RuleState(rule, self._clock,
+                                                self.interval_s)
+        # the gauge exists from registration so dashboards see 0, not
+        # absence, before the first evaluation
+        resilience_metrics.set_gauge("alerts_firing", 0,
+                                     alert=rule.name)
+
+    def on_firing(self, fn: Callable[[str, dict], None]) -> None:
+        """Register ``fn(rule_name, transition_doc)`` called on every
+        pending->firing edge (after the built-in evidence capture)."""
+        self._on_firing.append(fn)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "AlertEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="alert-engine")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._closed = True
+
+    def _loop(self) -> None:
+        while not self._closed:
+            self._sleep(self.interval_s)
+            if self._closed:
+                return
+            try:
+                self.evaluate_once()
+            except Exception:  # the monitor must never kill serving
+                logger.exception("alert evaluation failed")
+
+    # ------------------------------------------------------- evaluation
+    def evaluate_once(self) -> list[dict]:
+        """Probe + evaluate every rule once; returns the transitions
+        this evaluation produced.  Probe errors leave the rule's state
+        untouched (immunity): a broken sensor is surfaced on
+        /debug/alerts, never paged on."""
+        now = self._clock()
+        self.evaluations += 1
+        with self._lock:
+            states = list(self._rules.values())
+        transitions: list[dict] = []
+        fired: list[tuple[_RuleState, dict]] = []
+        for rs in states:
+            try:
+                p = rs.rule.probe() or {}
+            except Exception as e:
+                rs.probe_errors += 1
+                rs.last_error = repr(e)
+                continue
+            rs.last_error = None
+            cond, values = self._condition(rs, p, now)
+            rs.last_values = values
+            # the lifecycle step AND the gauge run under the lock:
+            # force_firing (the watchdog thread) mutates the same
+            # per-rule state, and an unserialized check+set (or a
+            # stale-state gauge write) would double-land a firing
+            # edge or clobber a concurrent force's gauge=1 with 0
+            with self._lock:
+                t = self._advance(rs, cond, now, values)
+                resilience_metrics.set_gauge(
+                    "alerts_firing",
+                    1 if rs.state == STATE_FIRING else 0,
+                    alert=rs.rule.name)
+            if t is not None:
+                transitions.append(t)
+                if t["to"] == STATE_FIRING:
+                    fired.append((rs, t))
+        # evidence + callbacks OUTSIDE the lock and after the sweep:
+        # a slow dump must not delay the other rules' evaluation state
+        for rs, t in fired:
+            self._on_firing_edge(rs, t)
+        return transitions
+
+    def _condition(self, rs: _RuleState, p: dict, now: float
+                   ) -> tuple[bool, dict]:
+        rule = rs.rule
+        values: dict[str, Any] = {}
+        if rule.kind == KIND_BURN:
+            rs.ring.sample({"bad": float(p.get("bad", 0.0)),
+                            "total": float(p.get("total", 0.0))})
+            ok = bool(rule.windows)
+            for w, th in rule.windows:
+                d_bad, _ = rs.ring.window_delta(w, "bad")
+                d_total, span = rs.ring.window_delta(w, "total")
+                b = burn_rate(d_bad, d_total, rule.budget)
+                if 0 < span < w:
+                    # under-covered window (early process life): treat
+                    # the unobserved remainder as burn-free traffic at
+                    # the same rate, i.e. scale by real coverage.  The
+                    # slow window keeps its "one bad second cannot
+                    # page" guarantee from the first evaluation on,
+                    # while a burn SUSTAINED across the history that
+                    # does exist still fires
+                    b *= span / w
+                values[f"burn_{w:g}s"] = round(b, 3)
+                if not (span > 0 and b > th):
+                    ok = False
+            return ok, values
+        if rule.kind == KIND_RATE:
+            rs.ring.sample({"count": float(p.get("count", 0.0))})
+            ok = bool(rule.windows)
+            for w, th in rule.windows:
+                d, span = rs.ring.window_delta(w, "count")
+                # real span once the window is covered; the NOMINAL
+                # window as the floor while it is not — the same
+                # early-life stance as the burn scaling above (one
+                # failover in a 10s-old process must not read as a
+                # page-worthy sustained rate over a 5m window)
+                r = d / max(span, w) if span > 0 else 0.0
+                values[f"rate_{w:g}s"] = round(r, 4)
+                if not r > th:
+                    ok = False
+            return ok, values
+        if rule.kind == KIND_THRESHOLD:
+            v = float(p.get("value", 0.0))
+            th = rule.windows[0][1] if rule.windows else 0.0
+            values["value"] = round(v, 4)
+            values["threshold"] = th
+            return v > th, values
+        # KIND_STATE
+        v = bool(p.get("value"))
+        values["value"] = v
+        return v, values
+
+    def _advance(self, rs: _RuleState, cond: bool, now: float,
+                 values: dict) -> Optional[dict]:
+        """One lifecycle step; returns the transition doc if the state
+        changed.  Caller holds ``_lock``."""
+        if cond:
+            if rs.state == STATE_INACTIVE:
+                rs.pending_since = now
+                t = self._transition(rs, STATE_PENDING, now, values)
+                # zero for-duration fires on the SAME evaluation —
+                # fall through so a duration-free rule still records
+                # the pending edge (the lifecycle is observable)
+                if rs.rule.for_duration_s > 0:
+                    return t
+            if (rs.state == STATE_PENDING
+                    and now - (rs.pending_since or now)
+                    >= rs.rule.for_duration_s):
+                rs.firing_since = now
+                return self._transition(rs, STATE_FIRING, now, values)
+            return None
+        if rs.state == STATE_FIRING:
+            rs.firing_since = None
+            rs.pending_since = None
+            return self._transition(rs, "resolved", now, values)
+        if rs.state == STATE_PENDING:
+            # the pending window broke before for_duration: back to
+            # inactive without ever firing (the flap the hysteresis
+            # exists to absorb)
+            rs.pending_since = None
+            return self._transition(rs, STATE_INACTIVE, now, values)
+        return None
+
+    def _transition(self, rs: _RuleState, to: str, now: float,
+                    values: dict) -> Optional[dict]:
+        """Record one state change.  Caller holds ``_lock``; returns
+        None when another thread already landed the same target state
+        (the force_firing/evaluate race both sides must lose at most
+        once)."""
+        new_state = STATE_INACTIVE if to in ("resolved",
+                                             STATE_INACTIVE) else to
+        if rs.state == new_state:
+            return None
+        frm = rs.state
+        rs.state = new_state
+        rs.transitions += 1
+        doc = {"alert": rs.rule.name, "from": frm, "to": to,
+               "t": round(now, 3), "ts": time.time(),
+               "values": dict(values)}
+        self._transitions.append(doc)
+        del self._transitions[:-TRANSITION_RING]
+        resilience_metrics.inc("alert_transitions_total",
+                               alert=rs.rule.name, to=to)
+        if to in (STATE_FIRING, "resolved"):
+            logger.warning("alert %s: %s -> %s %s", rs.rule.name, frm,
+                           to, values)
+        return doc
+
+    def force_firing(self, name: str, reason: str = "forced") -> bool:
+        """Latch a rule straight to firing (the watchdog's ``on_trip``
+        wiring: one source of truth for "this replica is wedged").
+        Returns False for an unknown rule or one already firing —
+        including one the evaluation thread fires concurrently."""
+        now = self._clock()
+        with self._lock:
+            rs = self._rules.get(name)
+            if rs is None:
+                return False
+            t = self._transition(rs, STATE_FIRING, now,
+                                 {"forced": reason})
+            if t is None:        # already firing (or lost the race)
+                return False
+            rs.firing_since = now
+            rs.last_values = {"forced": reason}
+            resilience_metrics.set_gauge("alerts_firing", 1,
+                                         alert=name)
+        self._on_firing_edge(rs, t)
+        return True
+
+    # --------------------------------------------------------- evidence
+    def _on_firing_edge(self, rs: _RuleState, t: dict) -> None:
+        if rs.rule.capture_evidence:
+            try:
+                path = capture_evidence(rs.rule.name, t,
+                                        snapshot=self.snapshot)
+            except Exception:
+                logger.exception("alert evidence capture failed")
+                path = None
+            if path is not None:
+                rs.evidence_captured += 1
+                rs.last_evidence_path = path
+        for fn in list(self._on_firing):
+            try:
+                fn(rs.rule.name, t)
+            except Exception:
+                logger.exception("alert on_firing callback failed")
+
+    # ---------------------------------------------------------- reading
+    def firing(self) -> dict:
+        """{name: {"since_s", "values", "overload"}} for firing rules."""
+        now = self._clock()
+        with self._lock:
+            states = list(self._rules.values())
+        return {
+            rs.rule.name: {
+                "since_s": (round(now - rs.firing_since, 3)
+                            if rs.firing_since is not None else 0.0),
+                "values": dict(rs.last_values),
+                "overload": rs.rule.overload,
+            }
+            for rs in states if rs.state == STATE_FIRING
+        }
+
+    def firing_overload(self) -> list[str]:
+        """Names of firing rules marked ``overload=True`` — the control
+        plane's advisory early-shed signal."""
+        with self._lock:
+            states = list(self._rules.values())
+        return sorted(rs.rule.name for rs in states
+                      if rs.state == STATE_FIRING and rs.rule.overload)
+
+    def snapshot(self) -> dict:
+        """/debug/alerts: every rule's declaration + live state, the
+        transition-ring tail, and the dump-cooldown self-view (the
+        rate limit evidence capture rides)."""
+        from vllm_omni_tpu.introspection.flight_recorder import (
+            dump_cooldown,
+        )
+
+        now = self._clock()
+        with self._lock:
+            states = list(self._rules.values())
+            ring = list(self._transitions[-64:])
+        rules = {}
+        for rs in states:
+            r = rs.rule
+            rules[r.name] = {
+                "kind": r.kind,
+                "state": rs.state,
+                "overload": r.overload,
+                "description": r.description,
+                "windows": [list(w) for w in r.windows],
+                "budget": r.budget if r.kind == KIND_BURN else None,
+                "for_duration_s": r.for_duration_s,
+                "pending_for_s": (round(now - rs.pending_since, 3)
+                                  if rs.pending_since is not None
+                                  else None),
+                "firing_for_s": (round(now - rs.firing_since, 3)
+                                 if rs.firing_since is not None
+                                 else None),
+                "last_values": dict(rs.last_values),
+                "probe_errors": rs.probe_errors,
+                "last_probe_error": rs.last_error,
+                "transitions": rs.transitions,
+                "evidence": {
+                    "captured": rs.evidence_captured,
+                    "last_path": rs.last_evidence_path,
+                    "enabled": r.capture_evidence,
+                },
+            }
+        return {
+            "enabled": True,
+            "interval_s": self.interval_s,
+            "running": self._thread is not None and not self._closed,
+            "evaluations": self.evaluations,
+            "firing": sorted(n for n, d in rules.items()
+                             if d["state"] == STATE_FIRING),
+            "rules": rules,
+            "transitions": ring,
+            "dump_cooldown": dump_cooldown.snapshot(),
+        }
+
+
+# ------------------------------------------------------------- evidence
+def capture_evidence(name: str, transition: dict,
+                     snapshot: Optional[Callable[[], dict]] = None
+                     ) -> Optional[str]:
+    """Assemble and write one alert evidence bundle through the flight
+    recorder's dump path: the per-engine step-record rings, a journey-
+    trace slice (the recorder's most recent spans, non-destructive),
+    every engine's top-k tenant attribution board, and the firing
+    rule's window values.  Returns the written path, or None when
+    ``OMNI_TPU_FLIGHT_DIR`` is unset or the per-reason cooldown
+    suppressed the write (a flapping alert must not flood the dir)."""
+    from vllm_omni_tpu import introspection
+    from vllm_omni_tpu.introspection.flight_recorder import (
+        _dumping_enabled,
+        build_dump,
+        dump_to_file,
+    )
+    from vllm_omni_tpu.tracing import get_recorder
+
+    if not _dumping_enabled():
+        return None
+    engines = introspection.iter_engines()
+    attribution = {}
+    for i, e in enumerate(engines):
+        attr = getattr(e, "attribution", None)
+        if attr is not None:
+            # claim_slots=False: an evidence bundle must not burn
+            # lifetime /metrics label slots on incident-time tenants
+            attribution[str(getattr(e, "stage_id", i))] = \
+                attr.snapshot(claim_slots=False)
+    extra: dict[str, Any] = {
+        "alert": {
+            "name": name,
+            "transition": dict(transition),
+            "engine": snapshot() if snapshot is not None else None,
+        },
+        "attribution": attribution,
+        "journey_tail": get_recorder().tail(256),
+        "requests": [
+            {"engine": getattr(e, "stage_id", i),
+             "table": introspection.request_table(e)}
+            for i, e in enumerate(engines)
+        ],
+    }
+    doc = build_dump(
+        f"alert:{name}",
+        recorders=[e.flight for e in engines
+                   if getattr(e, "flight", None) is not None],
+        extra=extra, include_stacks=False)
+    return dump_to_file(doc)
+
+
+# -------------------------------------------------------- default rules
+def build_default_rules(
+    omni, *,
+    fast_window_s: float = 300.0,
+    slow_window_s: float = 3600.0,
+    fast_burn: float = 14.4,
+    slow_burn: float = 6.0,
+    slo_objective: float = 0.99,
+    queue_depth_limit: Optional[float] = None,
+    saturation_limit: float = 0.98,
+    shed_rate_limit: float = 0.5,
+    failover_rate_limit: float = 0.1,
+    latency_mult: float = 1.0,
+    for_duration_s: float = 15.0,
+) -> list[AlertRule]:
+    """The stock rule set over an ``Omni``-shaped orchestrator (probes
+    are getattr-defensive duck-typed reads, the debugz stance).  SLO
+    burn rules only engage once traffic produces judged completions;
+    latency rules only exist when SLO targets are configured."""
+
+    def engines():
+        return [e for e in (getattr(s, "engine", None)
+                            for s in getattr(omni, "stages", ()))
+                if e is not None
+                and getattr(e, "step_metrics", None) is not None]
+
+    def slo_probe() -> dict:
+        bad = total = 0
+        for e in engines():
+            t = e.step_metrics.slo_totals()
+            bad += t["bad"]
+            total += t["finished"]
+        return {"bad": bad, "total": total}
+
+    def shed_probe() -> dict:
+        n = 0
+        for e in engines():
+            counts = getattr(getattr(e, "scheduler", None),
+                             "shed_counts", None) or {}
+            n += sum(counts.values())
+        return {"count": n}
+
+    def failover_probe() -> dict:
+        samples = resilience_metrics.snapshot().get(
+            "failover_total", [])
+        return {"count": sum(v for _, v in samples)}
+
+    def queue_probe() -> dict:
+        return {"value": sum(
+            len(getattr(getattr(e, "scheduler", None), "waiting", ()))
+            for e in engines())}
+
+    def saturation_probe() -> dict:
+        v = 0.0
+        for e in engines():
+            sat = getattr(e.step_metrics, "saturation", None) or {}
+            v = max(v, *sat.values()) if sat else v
+        return {"value": v}
+
+    def watchdog_probe() -> dict:
+        wd = getattr(omni, "watchdog", None)
+        return {"value": wd is not None
+                and getattr(wd, "tripped", None) is not None}
+
+    def degraded_probe() -> dict:
+        samples = resilience_metrics.snapshot().get("degraded_mode", [])
+        return {"value": any(v for _, v in samples)}
+
+    budget = max(1.0 - slo_objective, 1e-9)
+    rules = [
+        AlertRule(
+            name="slo_fast_burn", kind=KIND_BURN, probe=slo_probe,
+            windows=((fast_window_s, fast_burn),
+                     (slow_window_s, fast_burn)),
+            budget=budget, overload=True,
+            description="error budget burning at page speed in BOTH "
+                        "the fast and slow windows"),
+        AlertRule(
+            name="slo_slow_burn", kind=KIND_BURN, probe=slo_probe,
+            windows=((slow_window_s, slow_burn),),
+            budget=budget, for_duration_s=for_duration_s,
+            description="sustained slow burn (ticket, not page)"),
+        AlertRule(
+            name="queue_depth_high", kind=KIND_THRESHOLD,
+            probe=queue_probe,
+            windows=((0.0, queue_depth_limit
+                      if queue_depth_limit is not None else 64.0),),
+            for_duration_s=for_duration_s, overload=True,
+            description="fleet waiting-queue depth past the bound"),
+        AlertRule(
+            name="saturation_high", kind=KIND_THRESHOLD,
+            probe=saturation_probe,
+            windows=((0.0, saturation_limit),),
+            for_duration_s=for_duration_s, overload=True,
+            description="a phase capacity axis pinned at its ceiling"),
+        AlertRule(
+            name="shed_rate_high", kind=KIND_RATE, probe=shed_probe,
+            windows=((fast_window_s, shed_rate_limit),),
+            overload=True,
+            description="admission control shedding arrivals (429s/s "
+                        "over the fast window)"),
+        AlertRule(
+            name="failover_rate_high", kind=KIND_RATE,
+            probe=failover_probe,
+            windows=((fast_window_s, failover_rate_limit),),
+            description="disagg router re-routing requests (replica "
+                        "deaths / handoff failures per second)"),
+        AlertRule(
+            name="engine_stalled", kind=KIND_STATE,
+            probe=watchdog_probe, capture_evidence=False,
+            description="stall watchdog tripped (the trip dump is the "
+                        "evidence; /health already serves 503)"),
+        AlertRule(
+            name="degraded_mode", kind=KIND_STATE,
+            probe=degraded_probe,
+            description="router serving colocated because a tier has "
+                        "zero healthy replicas"),
+    ]
+    # latency-vs-target rules need a target to compare against; the
+    # Histogram's percentile() is already a bounded recent window
+    cfg_engines = engines()
+    slo_ttft = next((e.step_metrics.slo_ttft_ms for e in cfg_engines
+                     if e.step_metrics.slo_ttft_ms is not None), None)
+    slo_tpot = next((e.step_metrics.slo_tpot_ms for e in cfg_engines
+                     if e.step_metrics.slo_tpot_ms is not None), None)
+    if slo_ttft is not None:
+        rules.append(AlertRule(
+            name="ttft_p_high", kind=KIND_THRESHOLD,
+            probe=lambda: {"value": max(
+                (e.step_metrics.ttft_ms.percentile(0.99)
+                 for e in engines()), default=0.0)},
+            windows=((0.0, slo_ttft * latency_mult),),
+            for_duration_s=for_duration_s,
+            description="recent-window p99 TTFT past the SLO target"))
+    if slo_tpot is not None:
+        rules.append(AlertRule(
+            name="tpot_p_high", kind=KIND_THRESHOLD,
+            probe=lambda: {"value": max(
+                (e.step_metrics.tpot_ms.percentile(0.99)
+                 for e in engines()), default=0.0)},
+            windows=((0.0, slo_tpot * latency_mult),),
+            for_duration_s=for_duration_s,
+            description="recent-window p99 TPOT past the SLO target"))
+    return rules

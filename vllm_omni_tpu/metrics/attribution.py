@@ -1,0 +1,230 @@
+"""Per-tenant heavy-hitter attribution: bounded-memory answer to
+"which tenant is eating the fleet".
+
+The SLO ledger (stats.py ``TenantSLOStats``) already keys on tenant,
+but it is capped at ``MAX_TENANT_SERIES`` distinct labels and collapses
+everyone else into "other" — correct for /metrics cardinality, useless
+for attribution: at millions-of-users scale the tenant that suddenly
+floods the fleet is overwhelmingly likely to be one of the collapsed
+ones.  This module meters consumption per tenant in O(capacity) memory
+regardless of how many tenants exist, using the **space-saving** sketch
+(Misra–Gries family; Metwally et al. 2005) with its textbook guarantees:
+
+- for every tracked key: ``est - err <= true <= est`` (the per-key
+  ``err`` records the count inherited from the evicted victim);
+- every key whose true count exceeds ``total / capacity`` is GUARANTEED
+  to be tracked — a genuine heavy hitter can never be missed;
+- the overestimate of ANY key is at most ``total / capacity``.
+
+``TenantAttribution`` runs one sketch per *meter* (prefill/decode
+tokens, KV page·seconds per tier, handoff bytes, queue wait, sheds) so
+each resource axis has its own heavy-hitter board.  Top-k export stays
+inside the existing ``cap_tenant`` cardinality budget: /metrics renders
+at most ``EXPORT_TOP_K`` tenants per meter, and — because top-k bounds
+a scrape but adversarial churn makes its membership over time
+unbounded, while every label value lives forever in the scrape
+database — each snapshot row carries an ``export`` flag backed by a
+LIFETIME set of at most ``MAX_TENANT_SERIES`` distinct tenants (slots
+claimed on first top-k appearance, so a shed-flooding tenant that
+never finishes a request still gets one).  Per-key estimates never
+decrease, so exported series stay monotone (counter-safe).  The full
+uncapped board is on ``/debug/tenants``.
+
+Hot-path discipline: ``add()`` is called from the engine step loop
+(this file rides the omnilint OL2 HOT_PATHS manifest) — pure host
+dict/heap arithmetic, zero device syncs.  Thread contract: the engine
+thread adds while /metrics and /debug snapshot, so the per-instance
+lock guards the sketch tables (LOCK_GUARDS manifest).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.runtime import traced
+from vllm_omni_tpu.metrics.stats import MAX_TENANT_SERIES, sanitize_tenant
+
+#: meters a TenantAttribution tracks by default — one sketch each.
+#: Units differ per meter (documented in docs/observability.md):
+#: tokens, page·seconds, bytes, milliseconds, request counts.
+METERS = (
+    "prefill_tokens",
+    "decode_tokens",
+    "kv_page_seconds_hbm",
+    "kv_page_seconds_host",
+    "handoff_bytes",
+    "queue_wait_ms",
+    "sheds",
+)
+
+#: tenants exported per meter on /metrics — strictly inside the
+#: MAX_TENANT_SERIES cardinality cap (stats.py) so attribution can
+#: never widen the exposition past what the SLO ledger already allows
+EXPORT_TOP_K = 16
+
+
+class SpaceSavingSketch:
+    """Space-saving heavy hitters over weighted increments.
+
+    ``capacity`` bounds memory: at most that many (key -> [est, err])
+    counters exist, ever.  When a new key arrives at a full table, the
+    key with the MINIMUM estimate is evicted and the newcomer inherits
+    its estimate as ``err`` (the possible overcount).  Increments are
+    floats so page·seconds and byte meters ride the same structure.
+
+    Eviction needs the current minimum; a lazy min-heap of
+    ``(est_at_push, key)`` keeps that amortized O(log n) — entries go
+    stale when their key's count grows (counts only grow), so the pop
+    loop discards entries that no longer match the live table.
+
+    NOT thread-safe on its own — TenantAttribution holds the lock.
+    """
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        # key -> [estimate, error]; error = estimate inherited from the
+        # evicted victim (0 for keys admitted into free space)
+        self._counts: dict[str, list] = {}
+        # lazy min-heap over (estimate, key); stale entries (estimate
+        # no longer current) are discarded at pop time
+        self._heap: list[tuple[float, str]] = []
+        self.total = 0.0
+
+    def add(self, key: str, amount: float = 1.0) -> None:
+        if amount <= 0:
+            return
+        self.total += amount
+        if len(self._heap) > 8 * self.capacity:
+            # stale-entry compaction: the lazy heap gains one entry per
+            # add and only sheds them at eviction pops — rebuild from
+            # the live table so a long-running engine stays O(capacity)
+            self._heap = [(row[0], k)
+                          for k, row in self._counts.items()]
+            heapq.heapify(self._heap)
+        row = self._counts.get(key)
+        if row is not None:
+            row[0] += amount
+            heapq.heappush(self._heap, (row[0], key))
+            return
+        if len(self._counts) < self.capacity:
+            self._counts[key] = [amount, 0.0]
+            heapq.heappush(self._heap, (amount, key))
+            return
+        # full: evict the minimum-estimate key; the newcomer inherits
+        # its estimate as the error bound (the space-saving move)
+        while self._heap:
+            est, victim = heapq.heappop(self._heap)
+            row = self._counts.get(victim)
+            if row is not None and row[0] == est:
+                break
+        else:  # pragma: no cover - heap always covers the live table
+            victim, est = min(
+                self._counts.items(), key=lambda kv: kv[1][0])[0], 0.0
+            est = self._counts[victim][0]
+        del self._counts[victim]
+        self._counts[key] = [est + amount, est]
+        heapq.heappush(self._heap, (est + amount, key))
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def estimate(self, key: str) -> tuple[float, float]:
+        """(estimate, error) for ``key``; (0, 0) when untracked."""
+        row = self._counts.get(key)
+        return (row[0], row[1]) if row is not None else (0.0, 0.0)
+
+    @property
+    def max_overestimate(self) -> float:
+        """The proven bound: no estimate exceeds truth by more than
+        ``total / capacity`` (tight only under adversarial churn)."""
+        return self.total / self.capacity
+
+    def top(self, k: int) -> list[tuple[str, float, float]]:
+        """The k largest estimates as (key, est, err), descending.
+        Deterministic tie-break on the key so snapshots are stable."""
+        rows = sorted(self._counts.items(),
+                      key=lambda kv: (-kv[1][0], kv[0]))
+        return [(key, row[0], row[1]) for key, row in rows[:k]]
+
+
+class TenantAttribution:
+    """One space-saving sketch per consumption meter, keyed by
+    sanitized tenant.  The engine adds; /metrics and /debug/tenants
+    snapshot — the lock guards the sketch tables."""
+
+    def __init__(self, capacity: int = 256,
+                 meters: Iterable[str] = METERS,
+                 export_cap: int = MAX_TENANT_SERIES):
+        self.capacity = capacity
+        self.export_cap = export_cap
+        self._lock = traced(threading.Lock(), "TenantAttribution._lock")
+        self._meters: dict[str, SpaceSavingSketch] = {
+            m: SpaceSavingSketch(capacity) for m in meters}
+        # lifetime /metrics label budget: the first ``export_cap``
+        # distinct tenants to reach any meter's top-k claim the slots
+        self._exported: set[str] = set()
+
+    def add(self, tenant: Optional[str], meter: str,
+            amount: float = 1.0) -> None:
+        """Meter ``amount`` of ``meter`` against ``tenant``.  The
+        tenant is CLIENT input — sanitized here so hostile bytes never
+        become sketch keys (the sketch itself bounds cardinality, so
+        no ``cap_tenant`` collapse: attribution exists precisely to
+        see past that cap)."""
+        sketch = self._meters.get(meter)
+        if sketch is None or amount <= 0:
+            return
+        key = sanitize_tenant(tenant)
+        with self._lock:
+            sketch.add(key, float(amount))
+
+    def top_k(self, meter: str, k: int = EXPORT_TOP_K
+              ) -> list[tuple[str, float, float]]:
+        sketch = self._meters.get(meter)
+        if sketch is None:
+            return []
+        with self._lock:
+            return sketch.top(k)
+
+    def _exportable(self, key: str) -> bool:
+        """Lifetime label-budget check (caller holds the lock): a
+        tenant already holding a slot, or one claiming a free slot
+        now, renders on /metrics; everyone else is /debug-only."""
+        if key in self._exported:
+            return True
+        if len(self._exported) < self.export_cap:
+            self._exported.add(key)
+            return True
+        return False
+
+    def snapshot(self, k: int = EXPORT_TOP_K, *,
+                 claim_slots: bool = True) -> dict:
+        """JSON-ready per-meter heavy-hitter board (the
+        ``/debug/tenants`` and engine-snapshot shape): top-k rows with
+        estimate + error + the lifetime ``export`` flag, tracked-key
+        count, the lifetime total, and the proven overestimate bound.
+        ``claim_slots=False`` reports current slot membership without
+        consuming any — debug and evidence readers must not burn the
+        /metrics label budget on tenants the exposition never saw."""
+        doc: dict[str, dict] = {}
+        with self._lock:
+            for meter, sketch in self._meters.items():
+                doc[meter] = {
+                    "total": round(sketch.total, 3),
+                    "tenants_tracked": len(sketch),
+                    "max_overestimate": round(
+                        sketch.max_overestimate, 3),
+                    "top": [
+                        {"tenant": key, "est": round(est, 3),
+                         "err": round(err, 3),
+                         "export": (self._exportable(key)
+                                    if claim_slots
+                                    else key in self._exported)}
+                        for key, est, err in sketch.top(k)
+                    ],
+                }
+        return {"capacity": self.capacity, "meters": doc}

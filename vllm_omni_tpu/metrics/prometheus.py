@@ -319,6 +319,60 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Deficit-round-robin rounds that held a tenant's head-of-line "
         "request back while placing other work (weighted-fair overload "
         "scheduling)", ("stage", "tenant")),
+    # ---- omnipulse (metrics/alerts.py + metrics/attribution.py,
+    # docs/observability.md): alert lifecycle + per-tenant heavy-hitter
+    # attribution.  Attribution values are space-saving sketch
+    # ESTIMATES (est >= true >= est - total/capacity); only the top-k
+    # tenants per meter render, inside the tenant cardinality cap
+    "alerts_firing": (
+        "gauge", "Whether the named alert rule is firing (1 = its "
+        "condition held past for-duration)", ("alert",)),
+    "alert_transitions_total": (
+        "counter",
+        "Alert lifecycle transitions per rule and destination state "
+        "(pending | firing | resolved | inactive)", ("alert", "to")),
+    "tenant_tokens_total": (
+        "counter",
+        "Per-tenant token consumption by kind (prefill | decode), "
+        "space-saving estimate over the top-k heavy hitters",
+        ("stage", "tenant", "kind")),
+    "tenant_kv_page_seconds_total": (
+        "counter",
+        "Per-tenant KV page-seconds of residency per tier (hbm = live "
+        "device pages, host = parked payloads), sketch estimate",
+        ("stage", "tenant", "tier")),
+    "tenant_handoff_bytes_total": (
+        "counter",
+        "Per-tenant prefill->decode KV handoff bytes, sketch estimate",
+        ("stage", "tenant")),
+    "tenant_queue_wait_ms_total": (
+        "counter",
+        "Per-tenant cumulative arrival-to-first-scheduled wait, "
+        "sketch estimate", ("stage", "tenant")),
+    "tenant_sheds_total": (
+        "counter",
+        "Per-tenant admission-control sheds, sketch estimate — unlike "
+        "shed_requests_total this sees past the cardinality cap",
+        ("stage", "tenant")),
+    "attribution_tracked_tenants": (
+        "gauge",
+        "Distinct tenants currently tracked by the attribution sketch "
+        "per meter (bounded by the sketch capacity)",
+        ("stage", "meter")),
+}
+
+#: attribution meter -> (/metrics series, fixed extra labels); meters
+#: without a row stay /debug/tenants-only
+_ATTRIBUTION_SERIES: dict[str, tuple[str, dict]] = {
+    "prefill_tokens": ("tenant_tokens_total", {"kind": "prefill"}),
+    "decode_tokens": ("tenant_tokens_total", {"kind": "decode"}),
+    "kv_page_seconds_hbm": ("tenant_kv_page_seconds_total",
+                            {"tier": "hbm"}),
+    "kv_page_seconds_host": ("tenant_kv_page_seconds_total",
+                             {"tier": "host"}),
+    "handoff_bytes": ("tenant_handoff_bytes_total", {}),
+    "queue_wait_ms": ("tenant_queue_wait_ms_total", {}),
+    "sheds": ("tenant_sheds_total", {}),
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -558,6 +612,33 @@ def render_exposition(summary: dict, engine_snaps: dict,
                 exp.sample("slo_requests_met_total", tl, st.get("met", 0))
                 exp.sample("goodput_tokens_total", tl,
                            st.get("goodput_tokens", 0))
+        # per-tenant heavy-hitter attribution: top-k sketch estimates
+        # per meter (docs/observability.md); only meters with traffic
+        # render, and every value is declared approximate in HELP.
+        # Rows without the lifetime ``export`` slot are skipped: top-k
+        # bounds each scrape, but under adversarial churn its
+        # membership over time is unbounded, and every label value
+        # lives forever in the scrape database — the sketch layer
+        # budgets MAX_TENANT_SERIES distinct tenants per engine for
+        # its whole life (attribution.py), and per-key estimates never
+        # decrease, so the counter-typed series stay monotone.
+        # /debug/tenants keeps the full uncapped boards
+        attr = snap.get("attribution")
+        if attr:
+            for meter, doc in sorted((attr.get("meters") or {}).items()):
+                series = _ATTRIBUTION_SERIES.get(meter)
+                if series is None:
+                    continue
+                name, extra = series
+                for row in doc.get("top") or ():
+                    if not row.get("export", True):
+                        continue
+                    exp.sample(name, {**labels, "tenant": row["tenant"],
+                                      **extra}, row["est"])
+                if doc.get("tenants_tracked"):
+                    exp.sample("attribution_tracked_tenants",
+                               {**labels, "meter": meter},
+                               doc["tenants_tracked"])
         if snap.get("queue_wait_ms"):
             exp.histogram("queue_wait_ms", labels, snap["queue_wait_ms"])
         for phase, v in sorted((snap.get("saturation") or {}).items()):

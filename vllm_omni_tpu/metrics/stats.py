@@ -243,6 +243,74 @@ class TenantSLOStats:
         return self.met / self.finished
 
 
+class DeltaRing:
+    """Bounded ring of (monotonic_t, cumulative-sample) pairs for
+    windowed counter deltas — the substrate burn-rate alerting
+    (metrics/alerts.py) computes real windows from.
+
+    Lifetime-cumulative ratios hide incidents: after a week of uptime,
+    a minute of 100% errors moves ``slo_attainment_ratio`` by noise.
+    Sampling the cumulative counters on a cadence and differencing
+    against the sample closest to ``now - window_s`` recovers the
+    WINDOWED rate.  All stamps are ``time.monotonic()`` — the same
+    NTP-immunity stance as the PR 7 duration clocks (an NTP step
+    mid-window must never fabricate or swallow a burn).
+
+    Samples are plain dicts of floats; ``window_delta`` returns both
+    the delta and the actual span covered (early in a process's life a
+    1h window is backed by whatever history exists — the caller
+    normalizes rates by the REAL span, never the nominal one).
+    Not thread-safe: one owner samples and reads (the alert engine's
+    evaluation thread).
+    """
+
+    def __init__(self, horizon_s: float, max_samples: int = 720,
+                 clock=time.monotonic):
+        self.horizon_s = float(horizon_s)
+        self.max_samples = int(max_samples)
+        self._clock = clock
+        self._samples: deque = deque()
+
+    def sample(self, values: dict) -> None:
+        now = self._clock()
+        self._samples.append((now, dict(values)))
+        # keep ONE sample at-or-beyond the horizon so a full-window
+        # delta always has a baseline to difference against
+        while (len(self._samples) > 2
+               and (now - self._samples[1][0] >= self.horizon_s
+                    or len(self._samples) > self.max_samples)):
+            self._samples.popleft()
+
+    def window_delta(self, window_s: float, key: str
+                     ) -> tuple[float, float]:
+        """(delta, span_s) of ``key`` over the trailing ``window_s``:
+        newest sample minus the newest sample at least ``window_s``
+        old (falling back to the oldest available).  (0, 0) before two
+        samples exist."""
+        if len(self._samples) < 2:
+            return 0.0, 0.0
+        t_new, new = self._samples[-1]
+        base_t, base = self._samples[0]
+        for t, s in self._samples:
+            if t_new - t >= window_s:
+                base_t, base = t, s
+            else:
+                break
+        return (float(new.get(key, 0.0)) - float(base.get(key, 0.0)),
+                t_new - base_t)
+
+
+def burn_rate(d_bad: float, d_total: float, budget: float) -> float:
+    """Error-budget burn rate over one window: the window's bad
+    fraction divided by the allowed bad fraction (``budget`` =
+    1 - SLO objective).  1.0 = exactly on budget; 14.4 = burning a
+    30-day budget in ~2 days (the classic fast-page threshold).  An
+    empty window burns nothing — no traffic is not an SLO violation."""
+    if d_total <= 0:
+        return 0.0
+    return (max(d_bad, 0.0) / d_total) / max(budget, 1e-9)
+
+
 class EngineStepMetrics:
     """Step-level engine gauges/counters/histograms, sampled from
     ``LLMEngine.step()`` (the vLLM-core Stats/StatLogger analogue):
@@ -357,6 +425,25 @@ class EngineStepMetrics:
         self.useful_tokens_total += useful
         self.padded_tokens_total += padded
         self.batched_tokens.observe(float(useful))
+
+    def slo_totals(self) -> dict:
+        """Cumulative SLO counters summed over tenants — the shape the
+        alert engine's :class:`DeltaRing` samples so burn rates come
+        from real windows instead of the lifetime attainment ratio
+        (which a week of uptime renders incident-blind)."""
+        finished = met = tokens = goodput = 0
+        for st in self.tenants.values():
+            finished += st.finished
+            met += st.met
+            tokens += st.tokens
+            goodput += st.goodput_tokens
+        return {
+            "finished": finished,
+            "met": met,
+            "bad": finished - met,
+            "tokens": tokens,
+            "goodput_tokens": goodput,
+        }
 
     @property
     def padding_efficiency(self) -> float:

@@ -120,6 +120,15 @@ class TraceRecorder:
             self._spans.clear()
         return spans
 
+    def tail(self, n: int) -> list[dict]:
+        """The ``n`` most recent undrained spans, NON-destructively —
+        the journey slice an alert evidence bundle captures
+        (metrics/alerts.py) must never steal spans from the writer's
+        next drain."""
+        with self._lock:
+            spans = list(self._spans)
+        return spans[-n:] if n > 0 else []
+
     @property
     def spans_dropped(self) -> int:
         """Lifetime spans evicted undrained (trace_spans_dropped_total)."""
