@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""BENCH_r16: the cache-blind baseline bench (docs/disaggregation.md).
+
+A shared-prefix multi-tenant workload — N tenant-pinned scenarios all
+opening with ONE common system prompt (``shared_prefix_catalog``) — is
+replayed open-loop over a 2 prefill x 2 decode in-proc fleet under the
+stock queue-depth dispatcher, which is cache-BLIND by construction:
+nothing steers a request toward the replica that already holds its
+prefix.  The CacheEconomics board quantifies exactly what that costs —
+cross-replica duplicate-prefix bytes, per-dispatch wasted re-prefill
+tokens (the regret ledger), fleet prefix hit-rate — and this bench
+freezes those numbers as the baseline a prefix-affinity router
+(ROADMAP item 3) must beat.
+
+Writes BENCH_r16_cacheblind.json: one schema-valid serving_curve
+point, the fleet cache board (hit rate, duplicate-by-reason, top
+duplicated prefixes, regret-ledger tail), and a mid-flight /metrics
+probe (validate_exposition clean, every cache-economics series live).
+Asserts the digest stays provably cheap: every replica's exported
+node count is bounded by the cap.
+
+    JAX_PLATFORMS=cpu python scripts/cache_bench.py
+    JAX_PLATFORMS=cpu python scripts/cache_bench.py --smoke
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from vllm_omni_tpu.disagg.router import (  # noqa: E402
+    DIGEST_MAX_NODES,
+)
+from vllm_omni_tpu.disagg.service import (  # noqa: E402
+    DisaggService,
+    build_inproc_router,
+)
+from vllm_omni_tpu.engine import EngineConfig  # noqa: E402
+from vllm_omni_tpu.loadgen import (  # noqa: E402
+    SLOTargets,
+    build_workload,
+    poisson_arrivals,
+    run_inproc,
+    shared_prefix_catalog,
+    summarize,
+    validate_curve_point,
+)
+from vllm_omni_tpu.metrics.prometheus import (  # noqa: E402
+    validate_exposition,
+)
+from vllm_omni_tpu.models.common import transformer as tfm  # noqa: E402
+
+# the series the mid-flight scrape must see live — names, not values:
+# a rename that breaks dashboards fails the bench before it ships
+CACHE_SERIES = (
+    "fleet_prefix_hit_tokens_total",
+    "fleet_prefill_tokens_total",
+    "fleet_prefix_hit_rate",
+    "fleet_duplicate_prefill_tokens_total",
+    "fleet_duplicate_prefix_tokens",
+    "cache_digest_nodes",
+)
+
+
+def build_trace(n_requests: int, rate: float, seed: int,
+                n_tenants: int, prefix_len: int):
+    catalog = shared_prefix_catalog(n_tenants=n_tenants,
+                                    prefix_len=prefix_len)
+    arrivals = poisson_arrivals(rate, n_requests, seed=seed)
+    return build_workload(arrivals, catalog=catalog, seed=seed,
+                          vocab_size=60, id_prefix="cachebench")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-speed run: fewer requests, no "
+                         "redundancy-floor assert")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--rate", type=float, default=6.0)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--prefix-len", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_r16_cacheblind.json")
+    args = ap.parse_args()
+
+    n = args.requests or (12 if args.smoke else 64)
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    trace = build_trace(n, args.rate, args.seed, args.tenants,
+                        args.prefix_len)
+    slo = SLOTargets(ttft_ms=600.0, e2e_ms=10000.0)
+    base = EngineConfig(
+        num_pages=96, page_size=4, max_model_len=160, max_num_seqs=2,
+        max_num_batched_tokens=256, dtype=jnp.float32,
+        slo_ttft_ms=slo.ttft_ms, slo_tpot_ms=None,
+        max_queue_depth=24,
+        # precompile before the trace: a shape-cache miss mid-traffic
+        # is a multi-second stall that would swamp the cache signal
+        warmup=[(1, 8), (1, 16), (1, 64), (2, 8), (2, 16), (2, 64)])
+    router = build_inproc_router(params, cfg, base, 2, 2)
+    service = DisaggService(router)
+    probe = {}
+
+    def _probe():
+        time.sleep(max(trace[-1].at_s * 0.6, 0.5))
+        text = service.render_metrics()
+        probe["errors"] = validate_exposition(text)
+        probe["cache_series_live"] = {
+            s: (s in text) for s in CACHE_SERIES}
+
+    prober = threading.Thread(target=_probe, daemon=True)
+    prober.start()
+    t0 = time.monotonic()
+    records = run_inproc(service, trace, timeout_s=600.0)
+    wall = time.monotonic() - t0
+    prober.join(timeout=30)
+
+    offered = len(trace) / max(trace[-1].at_s, 1e-9)
+    point = summarize(records, offered_rps=offered, slo=slo)
+    errs = validate_curve_point(point)
+    assert not errs, f"curve point schema violations: {errs}"
+    point["topology"] = "2Px2D-cacheblind"
+    point["wall_s"] = round(wall, 2)
+
+    board = router.cache.board()
+    expo = router.cache.exposition()
+    service.shutdown()
+
+    # the digest must be provably cheap: bounded node count on every
+    # replica, no matter how much traffic the trace pushed through
+    for rid, nodes in expo["digest_nodes"].items():
+        assert nodes <= DIGEST_MAX_NODES, (
+            f"replica {rid} exported {nodes} digest nodes "
+            f"(cap {DIGEST_MAX_NODES})")
+    assert probe.get("errors") == [], \
+        f"mid-flight /metrics probe not clean: {probe.get('errors')}"
+    missing = [s for s, live in probe["cache_series_live"].items()
+               if not live]
+    assert not missing, \
+        f"cache-economics series missing mid-flight: {missing}"
+    if not args.smoke:
+        # the baseline must actually exhibit the waste the affinity
+        # router exists to reclaim — a zero here means the workload
+        # no longer exercises cross-replica redundancy
+        assert expo["duplicate_prefix_tokens"] > 0, \
+            "cache-blind 2x2 run produced no duplicate prefix pages"
+
+    doc = {
+        "bench": "BENCH_r16_cacheblind",
+        "trace": {"requests": n, "rate_rps": args.rate,
+                  "tenants": args.tenants,
+                  "shared_prefix_len": args.prefix_len,
+                  "seed": args.seed},
+        "slo": slo.as_dict(),
+        "topology": {"prefill": 2, "decode": 2,
+                     "dispatch": "queue-depth (cache-blind)"},
+        "digest_node_cap": DIGEST_MAX_NODES,
+        "serving_curve": [point],
+        "cache_board": board,
+        "metrics_probe": probe,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+    fleet = board["fleet"]
+    print(f"[2Px2D cache-blind] goodput="
+          f"{point['goodput_req_per_s']} req/s "
+          f"attainment={point['slo_attainment']} "
+          f"hit_rate={fleet['hit_rate']} "
+          f"dup_tokens={fleet['duplicate_prefix_tokens']} "
+          f"dup_bytes={fleet['duplicate_prefix_bytes']}")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""Radix digest export: fingerprint stability through the full node
+lifecycle (insert / evict / tier-demotion / park-restore), the hard
+node cap, BFS shallow-first ordering, and the O(1) subtree HBM token
+counts against a recounting oracle."""
+
+import pytest
+
+from vllm_omni_tpu.kvcache.radix import (
+    RadixPrefixIndex,
+    chain_page_keys,
+)
+from vllm_omni_tpu.kvcache.tiers import TIER_HBM, TIER_HOST
+
+PAGE = 4
+
+
+def toks(*pages):
+    """Flatten page tuples into one token list."""
+    out = []
+    for p in pages:
+        out.extend(p)
+    return out
+
+
+def digest_keys(d):
+    return [n["key"] for n in d["nodes"]]
+
+
+def oracle_hbm_tokens(index, node_key):
+    """Recount subtree HBM tokens the slow way — the digest must agree
+    with a full walk even though it never performs one."""
+    target = None
+    for n in index._iter_nodes():
+        if n.key == node_key:
+            target = n
+            break
+    assert target is not None
+    count = 1 if target.page is not None else 0
+    stack = list(target.children.values())
+    while stack:
+        n = stack.pop()
+        if n.page is not None:
+            count += 1
+        stack.extend(n.children.values())
+    return count * index.page_size
+
+
+class TestChainKeys:
+    def test_module_helper_matches_index_method(self):
+        idx = RadixPrefixIndex(PAGE)
+        ids = list(range(1, 13))
+        assert chain_page_keys(ids, PAGE) == idx.page_keys(ids)
+
+    def test_equal_prefixes_equal_keys(self):
+        a = chain_page_keys([1, 2, 3, 4, 5, 6, 7, 8], PAGE)
+        b = chain_page_keys([1, 2, 3, 4, 9, 9, 9, 9], PAGE)
+        assert a[0][1] == b[0][1]      # shared first page
+        assert a[1][1] != b[1][1]      # diverged second page
+
+    def test_chain_commits_to_history(self):
+        # same page content behind DIFFERENT prefixes must not collide:
+        # the key is a chain, not a per-page content hash
+        a = chain_page_keys([1, 1, 1, 1, 5, 5, 5, 5], PAGE)
+        b = chain_page_keys([2, 2, 2, 2, 5, 5, 5, 5], PAGE)
+        assert a[1][1] != b[1][1]
+
+    def test_max_pages_and_bad_page_size(self):
+        assert len(chain_page_keys(list(range(40)), PAGE,
+                                   max_pages=3)) == 3
+        with pytest.raises(ValueError):
+            chain_page_keys([1, 2], 0)
+
+
+class TestDigestShape:
+    def test_insert_then_digest_matches_tree(self):
+        idx = RadixPrefixIndex(PAGE)
+        p1, p2, p3 = (1, 2, 3, 4), (5, 6, 7, 8), (9, 10, 11, 12)
+        idx.insert(toks(p1, p2, p3), [0, 1, 2])
+        idx.insert(toks(p1, (7, 7, 7, 7)), [0, 3])
+        d = idx.digest()
+        assert d["page_size"] == PAGE
+        assert d["hbm_pages"] == 4
+        assert d["truncated"] is False
+        assert d["node_cap"] == 64
+        assert len(d["nodes"]) == 4
+        # BFS: depths are non-decreasing, shallow nodes always first
+        depths = [n["depth"] for n in d["nodes"]]
+        assert depths == sorted(depths)
+        # every emitted fingerprint is the chain key the matcher uses
+        expect = {h for _, h in idx.page_keys(toks(p1, p2, p3))}
+        expect |= {h for _, h in idx.page_keys(toks(p1, (7, 7, 7, 7)))}
+        assert set(digest_keys(d)) == expect
+        # the O(1) hbm_desc arithmetic agrees with a full recount
+        for n in d["nodes"]:
+            assert n["hbm_tokens"] == oracle_hbm_tokens(idx, n["key"])
+
+    def test_node_cap_enforced_and_marked(self):
+        idx = RadixPrefixIndex(PAGE)
+        for i in range(20):
+            idx.insert([i, i, i, i], [i])
+        d = idx.digest(max_nodes=8)
+        assert len(d["nodes"]) == 8
+        assert d["truncated"] is True
+        full = idx.digest(max_nodes=64)
+        assert len(full["nodes"]) == 20
+        assert full["truncated"] is False
+
+    def test_cap_prefers_shallow_nodes(self):
+        # one deep chain + many roots: the cut must keep the widely
+        # shared shallow layer, not the one deep tail
+        idx = RadixPrefixIndex(PAGE)
+        idx.insert(list(range(1, 41)), list(range(10)))   # 10-deep chain
+        for i in range(50, 58):
+            idx.insert([i] * PAGE, [i])                    # 8 more roots
+        d = idx.digest(max_nodes=9)
+        assert all(n["depth"] == 1 for n in d["nodes"])
+        assert d["truncated"] is True
+
+    def test_bad_cap_rejected(self):
+        with pytest.raises(ValueError):
+            RadixPrefixIndex(PAGE).digest(max_nodes=0)
+
+
+class TestDigestLifecycle:
+    """The SAME fingerprint must identify a prefix across every tier
+    transition — cross-replica comparison (cache_economics) breaks the
+    moment a demotion or restore renames a node."""
+
+    def test_fingerprints_stable_through_demote_restore_evict(self):
+        idx = RadixPrefixIndex(PAGE)
+        p1, p2 = (1, 2, 3, 4), (5, 6, 7, 8)
+        idx.insert(toks(p1, p2), [0, 1])
+        d0 = idx.digest()
+        keys0 = digest_keys(d0)
+        by_key0 = {n["key"]: n for n in d0["nodes"]}
+
+        # tier demotion (offload-evict): node stays, bytes leave HBM
+        deep = idx.match(toks(p1, p2))[-1]
+        freed = idx.mark_cold(deep, TIER_HOST)
+        assert freed == 1
+        d1 = idx.digest()
+        assert digest_keys(d1) == keys0          # identity unchanged
+        by_key1 = {n["key"]: n for n in d1["nodes"]}
+        assert by_key1[deep.key]["tier"] == TIER_HOST
+        assert by_key1[deep.key]["hbm_tokens"] == 0
+        # the parent's subtree count dropped by exactly one page
+        parent_key = keys0[0]
+        assert by_key1[parent_key]["hbm_tokens"] \
+            == by_key0[parent_key]["hbm_tokens"] - PAGE
+
+        # park-restore: fresh page, SAME fingerprint, hot again
+        idx.rebind_page(deep, 7)
+        d2 = idx.digest()
+        assert digest_keys(d2) == keys0
+        by_key2 = {n["key"]: n for n in d2["nodes"]}
+        assert by_key2[deep.key]["tier"] == TIER_HBM
+        assert by_key2[deep.key]["hbm_tokens"] == PAGE
+        assert by_key2[parent_key]["hbm_tokens"] \
+            == by_key0[parent_key]["hbm_tokens"]
+
+        # drop-evict: the fingerprint disappears, the rest survive
+        idx.drop(deep)
+        d3 = idx.digest()
+        assert deep.key not in digest_keys(d3)
+        assert digest_keys(d3) == [parent_key]
+        assert idx.check_invariants() == []
+
+    def test_ref_and_clock_surface(self):
+        idx = RadixPrefixIndex(PAGE)
+        idx.insert([1, 2, 3, 4], [0])
+        node = idx.match([1, 2, 3, 4])[0]
+        idx.acquire(node)
+        d = idx.digest()
+        assert d["nodes"][0]["ref"] == 1
+        assert d["clock"] == idx._clock
+        # the export itself must NOT touch the LRU clock: a metrics
+        # scrape is not a use of the cached prefix
+        before = idx._clock
+        idx.digest()
+        assert idx._clock == before

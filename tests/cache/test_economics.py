@@ -1,0 +1,200 @@
+"""CacheEconomics board: the hand-oracled 3-replica duplicate-prefix
+fixture, dispatch-regret scoring, reset-tolerant fleet counters, and
+the exposition/board contracts."""
+
+import json
+
+from vllm_omni_tpu.kvcache.radix import chain_page_keys
+from vllm_omni_tpu.kvcache.tiers import TIER_HBM, TIER_HOST
+from vllm_omni_tpu.metrics.cache_economics import (
+    REASON_PEER_COLD_TIER,
+    REASON_PEER_REPLICA,
+    CacheEconomics,
+)
+
+PAGE = 4
+
+# one shared 2-page prompt prefix, chain-hashed exactly the way every
+# replica's radix index would hash it
+PREFIX = [1, 2, 3, 4, 5, 6, 7, 8]
+KEYS = [h for _, h in chain_page_keys(PREFIX, PAGE)]
+A1, A2 = KEYS
+
+
+def scripted_digest(rows):
+    """A digest as RadixPrefixIndex.digest would export it, scripted."""
+    return {
+        "page_size": PAGE, "clock": 1, "hbm_pages": len(rows),
+        "node_cap": 64, "truncated": False,
+        "nodes": [{"key": k, "depth": d, "tier": t, "ref": 0,
+                   "last_use": 1, "hbm_tokens": PAGE}
+                  for k, d, t in rows],
+    }
+
+
+def three_replica_board(bytes_per_token=2):
+    """The hand-oracled fixture: r0 and r1 both hold the full 2-page
+    prefix hot; r2 holds only page 1, parked cold.
+
+    Duplicate oracle: A1 on 3 replicas -> 2 redundant copies (8
+    tokens); A2 on 2 replicas -> 1 redundant copy (4 tokens).  Total
+    12 duplicate tokens = 24 bytes at 2 bytes/token."""
+    econ = CacheEconomics(bytes_per_token=bytes_per_token)
+    econ.observe_digest("r0", scripted_digest(
+        [(A1, 1, TIER_HBM), (A2, 2, TIER_HBM)]))
+    econ.observe_digest("r1", scripted_digest(
+        [(A1, 1, TIER_HBM), (A2, 2, TIER_HBM)]))
+    econ.observe_digest("r2", scripted_digest(
+        [(A1, 1, TIER_HOST)]))
+    return econ
+
+
+class TestDuplicateOracle:
+    def test_duplicate_tokens_and_bytes(self):
+        econ = three_replica_board()
+        expo = econ.exposition()
+        assert expo["duplicate_prefix_tokens"] == 12
+        board = econ.board()
+        assert board["fleet"]["duplicate_prefix_tokens"] == 12
+        assert board["fleet"]["duplicate_prefix_bytes"] == 24
+
+    def test_top_duplicates_rows(self):
+        top = three_replica_board().board()["top_duplicates"]
+        # most-replicated first, shallowest first — deterministic
+        assert [r["key"] for r in top] == [A1, A2]
+        assert top[0]["replicas"] == ["r0", "r1", "r2"]
+        assert top[0]["duplicate_tokens"] == 8
+        assert top[0]["tiers"] == {TIER_HBM: 2, TIER_HOST: 1}
+        assert top[1]["replicas"] == ["r0", "r1"]
+        assert top[1]["duplicate_tokens"] == 4
+
+    def test_unique_prefixes_cost_nothing(self):
+        econ = CacheEconomics()
+        econ.observe_digest("r0", scripted_digest([(A1, 1, TIER_HBM)]))
+        econ.observe_digest("r1", scripted_digest([(A2, 1, TIER_HBM)]))
+        assert econ.exposition()["duplicate_prefix_tokens"] == 0
+        assert econ.board()["top_duplicates"] == []
+
+
+class TestDispatchRegret:
+    def test_blind_dispatch_scores_the_waste(self):
+        econ = three_replica_board()
+        # cache-blind choice: r2 (1 page cold) while r0/r1 hold both
+        doc = econ.note_dispatch("r2", KEYS, tenant="acme",
+                                 request_id="req1")
+        assert doc["expected_hit_tokens"] == 1 * PAGE
+        assert doc["peer_hit_tokens"] == 2 * PAGE
+        assert doc["best_peer"] in ("r0", "r1")
+        assert doc["wasted_tokens"] == 4
+        assert doc["reason"] == REASON_PEER_REPLICA
+        expo = econ.exposition()
+        assert expo["duplicate_by_reason"][REASON_PEER_REPLICA] == 4
+        assert expo["duplicate_by_reason"][REASON_PEER_COLD_TIER] == 0
+
+    def test_best_replica_dispatch_has_zero_regret(self):
+        econ = three_replica_board()
+        doc = econ.note_dispatch("r0", KEYS)
+        assert doc["wasted_tokens"] == 0
+        assert doc["reason"] is None
+        assert econ.exposition()["duplicate_by_reason"][
+            REASON_PEER_REPLICA] == 0
+
+    def test_cold_peer_reason(self):
+        econ = CacheEconomics()
+        econ.observe_digest("r0", scripted_digest([(A1, 1, TIER_HOST)]))
+        econ.observe_digest("r1", scripted_digest([]))
+        doc = econ.note_dispatch("r1", KEYS)
+        assert doc["wasted_tokens"] == 4
+        assert doc["reason"] == REASON_PEER_COLD_TIER
+
+    def test_resolve_joins_actual_and_is_one_shot(self):
+        econ = three_replica_board()
+        econ.note_dispatch("r2", KEYS, request_id="req1")
+        assert econ.board()["pending_dispatches"] == 1
+        done = econ.resolve_dispatch("req1", actual_hit_tokens=4)
+        assert done["actual_hit_tokens"] == 4
+        assert done["wasted_tokens"] == 4
+        # the ledger holds it; a duplicate resolve is a no-op
+        assert econ.resolve_dispatch("req1", 4) is None
+        board = econ.board()
+        assert board["pending_dispatches"] == 0
+        assert board["regret_ledger"][-1]["request_id"] == "req1"
+
+    def test_abandon_drops_pending(self):
+        econ = three_replica_board()
+        econ.note_dispatch("r2", KEYS, request_id="dead")
+        econ.abandon_dispatch("dead")
+        assert econ.board()["pending_dispatches"] == 0
+        assert econ.resolve_dispatch("dead", 0) is None
+        econ.abandon_dispatch(None)  # id-less requests are fine
+
+    def test_ledger_is_bounded(self):
+        econ = CacheEconomics(ledger_size=4)
+        econ.observe_digest("r0", scripted_digest([]))
+        for i in range(10):
+            econ.note_dispatch("r0", KEYS, request_id=f"r{i}")
+            econ.resolve_dispatch(f"r{i}", 0)
+        ledger = econ.board()["regret_ledger"]
+        assert [e["request_id"] for e in ledger] \
+            == ["r6", "r7", "r8", "r9"]
+
+
+class TestFleetCounters:
+    def test_delta_accumulation_and_reset_tolerance(self):
+        econ = CacheEconomics()
+        d = scripted_digest([])
+        econ.observe_digest("r0", d, hit_tokens=100, prefill_tokens=50)
+        econ.observe_digest("r0", d, hit_tokens=150, prefill_tokens=75)
+        expo = econ.exposition()
+        assert expo["fleet_hit_tokens"] == 150
+        assert expo["fleet_prefill_tokens"] == 75
+        # a restarted engine's counter goes backwards: count its new
+        # value from zero, never subtract (the totals stay monotone)
+        econ.observe_digest("r0", d, hit_tokens=10, prefill_tokens=5)
+        expo = econ.exposition()
+        assert expo["fleet_hit_tokens"] == 160
+        assert expo["fleet_prefill_tokens"] == 80
+
+    def test_forget_keeps_totals_drops_digest(self):
+        econ = CacheEconomics()
+        econ.observe_digest("r0", scripted_digest([(A1, 1, TIER_HBM)]),
+                            hit_tokens=40, prefill_tokens=60)
+        econ.forget_replica("r0")
+        expo = econ.exposition()
+        assert expo["fleet_hit_tokens"] == 40
+        assert expo["digest_nodes"] == {}
+        # re-observing the SAME id after a replacement restarts its
+        # baseline at zero (the _last entry was dropped)
+        econ.observe_digest("r0", scripted_digest([]),
+                            hit_tokens=5, prefill_tokens=5)
+        assert econ.exposition()["fleet_hit_tokens"] == 45
+
+    def test_hit_rate(self):
+        econ = CacheEconomics()
+        assert econ.exposition()["hit_rate"] == 0.0
+        econ.observe_digest("r0", scripted_digest([]),
+                            hit_tokens=30, prefill_tokens=10)
+        assert econ.exposition()["hit_rate"] == 0.75
+
+
+class TestRenderContracts:
+    def test_exposition_and_board_are_json(self):
+        econ = three_replica_board()
+        econ.note_dispatch("r2", KEYS, tenant="acme", request_id="x")
+        econ.resolve_dispatch("x", 4)
+        json.dumps(econ.exposition())
+        json.dumps(econ.board())
+
+    def test_board_replica_summaries(self):
+        board = three_replica_board().board()
+        assert sorted(board["replicas"]) == ["r0", "r1", "r2"]
+        r0 = board["replicas"]["r0"]
+        assert r0["nodes"] == 2
+        assert r0["node_cap"] == 64
+        assert r0["truncated"] is False
+        assert r0["page_size"] == PAGE
+        assert board["fleet"]["dispatches"] == 0
+
+    def test_digest_nodes_gauge(self):
+        expo = three_replica_board().exposition()
+        assert expo["digest_nodes"] == {"r0": 2, "r1": 2, "r2": 1}

@@ -1,0 +1,79 @@
+"""The /metrics face of the cache-economics board: every new series
+rides the registry with its declared type/labels, the disagg render
+block emits them from a live CacheEconomics exposition, and the
+per-tenant duplicate-prefill meter maps onto its attribution series."""
+
+from vllm_omni_tpu.kvcache.tiers import TIER_HBM
+from vllm_omni_tpu.metrics.attribution import METERS
+from vllm_omni_tpu.metrics.cache_economics import CacheEconomics
+from vllm_omni_tpu.metrics.prometheus import (
+    _ATTRIBUTION_SERIES,
+    METRIC_SPECS,
+    render_exposition,
+    validate_exposition,
+)
+
+CACHE_SERIES = {
+    "fleet_prefix_hit_tokens_total": ("counter", ()),
+    "fleet_prefill_tokens_total": ("counter", ()),
+    "fleet_prefix_hit_rate": ("gauge", ()),
+    "fleet_duplicate_prefill_tokens_total": ("counter", ("reason",)),
+    "fleet_duplicate_prefix_tokens": ("gauge", ()),
+    "cache_digest_nodes": ("gauge", ("replica",)),
+    "tenant_duplicate_prefill_tokens_total": ("counter",
+                                              ("stage", "tenant")),
+}
+
+
+def _digest(keys):
+    return {"page_size": 4, "clock": 1, "hbm_pages": len(keys),
+            "node_cap": 64, "truncated": False,
+            "nodes": [{"key": k, "depth": i + 1, "tier": TIER_HBM,
+                       "ref": 0, "last_use": 1, "hbm_tokens": 4}
+                      for i, k in enumerate(keys)]}
+
+
+class TestRegistry:
+    def test_series_declared_with_types_and_labels(self):
+        for name, (kind, labels) in CACHE_SERIES.items():
+            spec = METRIC_SPECS.get(name)
+            assert spec is not None, f"{name} missing from registry"
+            assert spec[0] == kind
+            assert tuple(spec[2]) == labels
+
+    def test_duplicate_prefill_meter_wired_to_attribution(self):
+        assert "duplicate_prefill_tokens" in METERS
+        series, fixed = _ATTRIBUTION_SERIES["duplicate_prefill_tokens"]
+        assert series == "tenant_duplicate_prefill_tokens_total"
+        assert fixed == {}
+
+
+class TestDisaggRender:
+    def test_live_exposition_renders_clean(self):
+        econ = CacheEconomics(bytes_per_token=2)
+        econ.observe_digest("prefill0", _digest(["a", "b"]),
+                            hit_tokens=320, prefill_tokens=480)
+        econ.observe_digest("decode1", _digest(["a"]),
+                            hit_tokens=0, prefill_tokens=0)
+        econ.note_dispatch("decode1", ["a", "b"])  # wasted: 4 tokens
+        text = render_exposition(
+            {}, {}, disagg={"handoff_seconds": {},
+                            "cache": econ.exposition()})
+        assert validate_exposition(text) == []
+        assert "fleet_prefix_hit_tokens_total 320" in text
+        assert "fleet_prefill_tokens_total 480" in text
+        assert "fleet_prefix_hit_rate 0.4" in text
+        assert ('fleet_duplicate_prefill_tokens_total'
+                '{reason="peer_replica"} 4') in text
+        assert ('fleet_duplicate_prefill_tokens_total'
+                '{reason="peer_cold_tier"} 0') in text
+        # the shared key "a" on 2 replicas = one redundant page
+        assert "fleet_duplicate_prefix_tokens 4" in text
+        assert 'cache_digest_nodes{replica="prefill0"} 2' in text
+        assert 'cache_digest_nodes{replica="decode1"} 1' in text
+
+    def test_no_cache_block_renders_nothing(self):
+        text = render_exposition({}, {}, disagg={"handoff_seconds": {}})
+        assert validate_exposition(text) == []
+        assert "fleet_prefix_hit_tokens_total" not in text
+        assert "cache_digest_nodes" not in text

@@ -1,0 +1,200 @@
+"""prefix_hit_rate_low: the fake-clock lifecycle (inactive -> pending
+-> firing -> resolved) driven through the CacheEconomics fleet
+counters, the single-engine fallback probe, and the cache-board
+evidence provider riding the alert bundle."""
+
+import json
+import os
+from types import SimpleNamespace
+
+from vllm_omni_tpu.metrics.alerts import (
+    KIND_THRESHOLD,
+    STATE_FIRING,
+    STATE_INACTIVE,
+    STATE_PENDING,
+    AlertEngine,
+    AlertRule,
+    build_default_rules,
+)
+from vllm_omni_tpu.metrics.cache_economics import CacheEconomics
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _digest():
+    return {"page_size": 4, "clock": 1, "hbm_pages": 0, "node_cap": 64,
+            "truncated": False, "nodes": []}
+
+
+def _prefix_rule(omni, **kw):
+    rules = build_default_rules(omni, **kw)
+    return next(r for r in rules if r.name == "prefix_hit_rate_low")
+
+
+def _omni_with_cache(cache):
+    return SimpleNamespace(router=SimpleNamespace(cache=cache),
+                           stages=[])
+
+
+class TestLifecycle:
+    def test_miss_storm_pending_firing_resolve(self):
+        """Healthy traffic (25% miss, objective 0.5) stays inactive; a
+        sustained full-miss storm drags the fast window's miss
+        fraction over budget -> pending, holds for for_duration ->
+        firing; recovered traffic drains the window -> resolved."""
+        cache = CacheEconomics()
+        omni = _omni_with_cache(cache)
+        rule = _prefix_rule(omni, fast_window_s=10.0,
+                            for_duration_s=3.0,
+                            prefix_hit_objective=0.5)
+        clock = FakeClock()
+        eng = AlertEngine([rule], interval_s=1.0, clock=clock,
+                          sleep=lambda s: None)
+        rs = eng._rules["prefix_hit_rate_low"]
+        hit, prefill = 0, 0
+
+        def tick(dhit, dprefill):
+            nonlocal hit, prefill
+            hit += dhit
+            prefill += dprefill
+            cache.observe_digest("r0", _digest(), hit_tokens=hit,
+                                 prefill_tokens=prefill)
+            eng.evaluate_once()
+            clock.advance(1.0)
+
+        for _ in range(12):           # healthy: 75% hit rate
+            tick(30, 10)
+        assert rs.state == STATE_INACTIVE
+        # storm: 100% miss.  The 10s window mixes healthy history, so
+        # the miss fraction crosses the 0.5 budget on the 4th storm
+        # tick ((100 + 30*4) / 400 = 0.55 -> burn 1.1) — pending, not
+        # yet firing (for_duration holds it)
+        for _ in range(4):
+            tick(0, 40)
+        assert rs.state == STATE_PENDING
+        for _ in range(3):            # hold through for_duration
+            tick(0, 40)
+        assert rs.state == STATE_FIRING
+        assert "prefix_hit_rate_low" in eng.firing()
+        for _ in range(12):           # recovery: 100% hit
+            tick(40, 0)
+        assert rs.state == STATE_INACTIVE
+        assert eng.firing() == {}
+
+    def test_healthy_fleet_never_leaves_inactive(self):
+        cache = CacheEconomics()
+        omni = _omni_with_cache(cache)
+        rule = _prefix_rule(omni, fast_window_s=10.0,
+                            prefix_hit_objective=0.5)
+        clock = FakeClock()
+        eng = AlertEngine([rule], interval_s=1.0, clock=clock,
+                          sleep=lambda s: None)
+        hit = prefill = 0
+        for _ in range(30):
+            hit += 35
+            prefill += 5
+            cache.observe_digest("r0", _digest(), hit_tokens=hit,
+                                 prefill_tokens=prefill)
+            eng.evaluate_once()
+            clock.advance(1.0)
+        assert eng._rules["prefix_hit_rate_low"].state == STATE_INACTIVE
+
+    def test_idle_fleet_is_not_an_incident(self):
+        # zero traffic -> zero-sample windows -> burn 0, not a page
+        omni = _omni_with_cache(CacheEconomics())
+        rule = _prefix_rule(omni, fast_window_s=10.0)
+        clock = FakeClock()
+        eng = AlertEngine([rule], interval_s=1.0, clock=clock,
+                          sleep=lambda s: None)
+        for _ in range(20):
+            eng.evaluate_once()
+            clock.advance(1.0)
+        assert eng._rules["prefix_hit_rate_low"].state == STATE_INACTIVE
+
+
+class TestProbeSources:
+    def test_probe_prefers_router_cache(self):
+        cache = CacheEconomics()
+        cache.observe_digest("r0", _digest(), hit_tokens=60,
+                             prefill_tokens=40)
+        rule = _prefix_rule(_omni_with_cache(cache))
+        assert rule.probe() == {"bad": 40, "total": 100}
+
+    def test_probe_falls_back_to_engine_counters(self):
+        kv = SimpleNamespace(enable_prefix_caching=True,
+                             prefix_hit_tokens=30)
+        engine = SimpleNamespace(
+            step_metrics=SimpleNamespace(prefill_tokens=10,
+                                         slo_ttft_ms=None,
+                                         slo_tpot_ms=None),
+            scheduler=SimpleNamespace(kv=kv))
+        omni = SimpleNamespace(stages=[SimpleNamespace(engine=engine)])
+        rule = _prefix_rule(omni)
+        assert rule.probe() == {"bad": 10, "total": 40}
+
+    def test_probe_skips_disabled_prefix_caching(self):
+        kv = SimpleNamespace(enable_prefix_caching=False,
+                             prefix_hit_tokens=30)
+        engine = SimpleNamespace(
+            step_metrics=SimpleNamespace(prefill_tokens=10,
+                                         slo_ttft_ms=None,
+                                         slo_tpot_ms=None),
+            scheduler=SimpleNamespace(kv=kv))
+        omni = SimpleNamespace(stages=[SimpleNamespace(engine=engine)])
+        rule = _prefix_rule(omni)
+        assert rule.probe() == {"bad": 0, "total": 0}
+
+
+class TestEvidenceProvider:
+    def test_cache_board_rides_the_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("OMNI_TPU_DUMP_COOLDOWN_S", "3600")
+        cache = CacheEconomics()
+        cache.observe_digest("r0", _digest(), hit_tokens=1,
+                             prefill_tokens=9)
+        clock = FakeClock()
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                         probe=lambda: {"value": 99.0},
+                         windows=((0.0, 10.0),))
+        eng = AlertEngine([rule], interval_s=1.0, clock=clock,
+                          sleep=lambda s: None)
+        eng.add_evidence_provider("cache_board", cache.board)
+        eng.evaluate_once()
+        path = eng._rules["q"].last_evidence_path
+        assert path and os.path.exists(path)
+        doc = json.loads(open(path).read())
+        board = doc["cache_board"]
+        assert board["enabled"] is True
+        assert board["fleet"]["prefill_tokens"] == 9
+
+    def test_broken_provider_degrades_inside_bundle(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("OMNI_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("OMNI_TPU_DUMP_COOLDOWN_S", "3600")
+        clock = FakeClock()
+        rule = AlertRule(name="q", kind=KIND_THRESHOLD,
+                         probe=lambda: {"value": 99.0},
+                         windows=((0.0, 10.0),))
+        eng = AlertEngine([rule], interval_s=1.0, clock=clock,
+                          sleep=lambda s: None)
+
+        def boom():
+            raise RuntimeError("torn")
+
+        eng.add_evidence_provider("cache_board", boom)
+        eng.evaluate_once()
+        path = eng._rules["q"].last_evidence_path
+        doc = json.loads(open(path).read())
+        # a broken provider must not cost the bundle — the error is
+        # recorded in its slot and everything else still lands
+        assert doc["cache_board"] == {"error": "RuntimeError('torn')"}
+        assert doc["alert"]["name"] == "q"

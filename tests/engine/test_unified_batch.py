@@ -76,6 +76,9 @@ def test_unified_greedy_matches_split_batch(tiny_model):
         assert u.outputs[0].token_ids == b.outputs[0].token_ids
 
 
+@pytest.mark.slow  # fast siblings: oracle fixture [staggered_mixed]
+# replays this exact stream bit-identically, and
+# test_mixed_step_is_one_device_dispatch proves mixed batches form
 def test_unified_greedy_matches_split_staggered_mixed(tiny_model):
     params, cfg = tiny_model
     split = _run_staggered(_engine(params, cfg))
@@ -237,6 +240,9 @@ def test_prefix_cache_hit_feeds_unified_step(tiny_model):
 
 
 # ------------------------------------------------------- async pipeline
+@pytest.mark.slow  # fast siblings: oracle fixture [async_unified] pins
+# the async-unified stream; test_async_greedy_matches_sync_mixed_waves
+# pins async==sync over mixed arrival waves
 def test_async_unified_matches_sync_and_pipelines_prefills(tiny_model):
     params, cfg = tiny_model
     split = _run_staggered(_engine(params, cfg))
@@ -271,6 +277,10 @@ def test_async_unified_stop_token_overshoot(tiny_model):
     assert eng.scheduler.kv.num_free_pages == 64
 
 
+@pytest.mark.slow  # fast siblings: test_split_executor_is_gone pins the
+# retirement structurally; test_async_step's per-workload pipelining
+# tests (logprobs/spec/collect_hidden/embeds) each prove their reason
+# never trips
 def test_async_fallback_reasons_retired(tiny_model):
     """The PR 11 acceptance contract: the spec / logprobs /
     collect_hidden / embeds / prefill drain reasons are structurally
@@ -393,6 +403,9 @@ def test_metrics_snapshot_and_exposition(tiny_model):
         assert needle in text, needle
 
 
+@pytest.mark.slow  # fast sibling: test_warmup_precompiles_all_traffic_
+# shapes warms the same 1-D token-bucket line (the split executor's
+# grid is gone, so the compiled surface no longer depends on the flag)
 def test_warmup_precompiles_token_buckets(tiny_model):
     """Unified warmup walks the 1-D token-bucket line; traffic at any
     packed size then hits the shape cache (no mid-traffic compiles)."""

@@ -68,6 +68,11 @@ HOT_PATHS: tuple[str, ...] = (
     # serving in proportion to how observable it is
     "vllm_omni_tpu/metrics/attribution.py",
     "vllm_omni_tpu/metrics/alerts.py",
+    # omniscope: dispatch-regret scoring runs inside the router's
+    # dispatch path and digest folding inside its step loop — pure
+    # dict/set arithmetic over already-exported digests; a device sync
+    # here would stall every tier at once
+    "vllm_omni_tpu/metrics/cache_economics.py",
 )
 
 PROTOCOL_MODULES: tuple[str, ...] = (
@@ -117,6 +122,10 @@ METRIC_MODULES: tuple[str, ...] = (
     # spec table grown in these modules rides the OL6 drift guard
     "vllm_omni_tpu/metrics/alerts.py",
     "vllm_omni_tpu/metrics/attribution.py",
+    # omniscope fleet cache series (fleet_prefix_hit_tokens_total &
+    # co.) render from the router's exposition block through the same
+    # spec table
+    "vllm_omni_tpu/metrics/cache_economics.py",
 )
 
 # --------------------------------------------------------------- omnirace
@@ -242,6 +251,14 @@ LOCK_GUARDS: dict[str, dict[str, tuple[str, ...]]] = {
     # any thread may dump (crash hooks, alert evidence, SIGUSR2)
     "vllm_omni_tpu/introspection/flight_recorder.py::DumpCooldown": {
         "_lock": ("_last", "_suppressed"),
+    },
+    # the router thread folds digests + scores dispatches while
+    # /metrics and /debug/cache snapshot from HTTP threads and the
+    # alert probe reads from the evaluation thread
+    "vllm_omni_tpu/metrics/cache_economics.py::CacheEconomics": {
+        "_lock": ("_digests", "_cover", "_last", "_fleet_hit_tokens",
+                  "_fleet_prefill_tokens", "_dup_by_reason",
+                  "_pending", "_ledger", "_dispatches"),
     },
 }
 

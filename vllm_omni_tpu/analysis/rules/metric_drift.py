@@ -144,6 +144,14 @@ def synthetic_engine_snapshot() -> dict:
                     "max_overestimate": 0.1,
                     "top": [{"tenant": "acme", "est": 4.0,
                              "err": 0.0}]},
+                # omniscope per-tenant redundancy (metrics/
+                # cache_economics.py): wasted re-prefill tokens the
+                # router meters at dispatch time
+                "duplicate_prefill_tokens": {
+                    "total": 96.0, "tenants_tracked": 1,
+                    "max_overestimate": 0.4,
+                    "top": [{"tenant": "acme", "est": 96.0,
+                             "err": 0.0}]},
             },
         },
         # device-memory ledger (introspection/memory_ledger.py):
@@ -186,8 +194,19 @@ def run_check() -> list[str]:
                        "watchdog_tripped": True},
         # disaggregated serving (docs/disaggregation.md): the handoff
         # histogram plus the router's registry-riding counters/gauges —
-        # every series the failover e2e asserts on must render here
-        disagg={"handoff_seconds": hist},
+        # every series the failover e2e asserts on must render here —
+        # and the omniscope fleet cache board (metrics/
+        # cache_economics.py exposition shape)
+        disagg={"handoff_seconds": hist,
+                "cache": {
+                    "fleet_hit_tokens": 320,
+                    "fleet_prefill_tokens": 480,
+                    "hit_rate": 0.4,
+                    "duplicate_by_reason": {"peer_replica": 96,
+                                            "peer_cold_tier": 32},
+                    "duplicate_prefix_tokens": 64,
+                    "digest_nodes": {"prefill0": 12, "decode0": 3},
+                }},
         resilience={
             "kv_handoff_bytes_total": [({"dir": "out"}, 8192),
                                        ({"dir": "in"}, 8192)],

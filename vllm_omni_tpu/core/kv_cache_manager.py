@@ -34,6 +34,7 @@ so the per-layer caches stay aligned (same layout the TPU kernel wants).
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -44,6 +45,11 @@ from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.request import Request
 
 logger = init_logger(__name__)
+
+# unclaimed per-request prefix-hit entries age out past this many ids
+# (an engine whose router never joins them — decode tier, aborts —
+# must not accumulate them forever)
+_REQUEST_HIT_CAP = 1024
 
 
 def park_key(request_id: str) -> str:
@@ -121,6 +127,14 @@ class KVCacheManager:
         # cache effectiveness counters (surfaced by engine stats)
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
+        # per-request hit sizes for the fleet cache-economics board
+        # (metrics/cache_economics.py): the router joins the ACTUAL
+        # prefix hit onto its dispatch-time expectation.  MUST survive
+        # free() — a prefill-tier engine frees the request inside the
+        # same step() that emits its output, before the router's join
+        # runs — so entries live until take_request_hit pops them,
+        # bounded by an LRU cap instead (never-claimed ids age out)
+        self._request_hit_tokens: "OrderedDict[str, int]" = OrderedDict()
         # recompute avoided by tier restores (cold prefix adoptions +
         # park restores), in tokens
         self.restored_tokens = 0
@@ -172,6 +186,21 @@ class KVCacheManager:
 
     def has_pending_moves(self) -> bool:
         return bool(self.pending_offloads or self.pending_restores)
+
+    def take_request_hit(self, request_id: str) -> int:
+        """Pop the request's recorded prefix-hit token count (0 when
+        the prompt missed the cache entirely).  One-shot by design:
+        the router's cache-economics join reads it exactly once.
+        Deliberately NOT swept by free() — a prefill-tier engine frees
+        the request before the router sees its output, so the entry
+        must outlive the table; the LRU cap bounds unclaimed ids."""
+        return self._request_hit_tokens.pop(request_id, 0)
+
+    def _record_request_hit(self, request_id: str, matched: int) -> None:
+        self._request_hit_tokens[request_id] = matched
+        self._request_hit_tokens.move_to_end(request_id)
+        while len(self._request_hit_tokens) > _REQUEST_HIT_CAP:
+            self._request_hit_tokens.popitem(last=False)
 
     def debug_snapshot(self) -> dict:
         """JSON-ready occupancy view for /debug/kv (docs/debugging.md):
@@ -350,6 +379,7 @@ class KVCacheManager:
         request.num_computed_tokens = matched
         self.prefix_hits += 1
         self.prefix_hit_tokens += matched
+        self._record_request_hit(request.request_id, matched)
         self.restored_tokens += restored
         self._stamp_pages(request)
         return matched

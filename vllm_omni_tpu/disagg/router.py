@@ -69,7 +69,9 @@ from vllm_omni_tpu.distributed.connectors import (
     OmniConnectorBase,
 )
 from vllm_omni_tpu.distributed.kv_transfer import KVDeadlineExceeded
+from vllm_omni_tpu.kvcache.radix import chain_page_keys
 from vllm_omni_tpu.logger import init_logger
+from vllm_omni_tpu.metrics.cache_economics import CacheEconomics
 from vllm_omni_tpu.metrics.stats import Histogram
 from vllm_omni_tpu.outputs import OmniRequestOutput
 from vllm_omni_tpu.resilience.deadline import (
@@ -89,6 +91,17 @@ logger = init_logger(__name__)
 #: sub-ms buckets, cross-host ones in the tail
 HANDOFF_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: cache-economics digest cadence: radix digests refresh every
+#: DIGEST_STRIDE router steps (a digest is O(DIGEST_MAX_NODES) host
+#: work per replica — stride keeps it off every tick without letting
+#: the fleet board go stale), bounded to DIGEST_MAX_NODES entries
+DIGEST_STRIDE = 8
+DIGEST_MAX_NODES = 64
+#: pages of a prompt hashed for dispatch-regret scoring — matches the
+#: digest depth bound (coverage beyond the digest horizon is invisible
+#: anyway, so hashing further is wasted host work)
+DISPATCH_KEY_PAGES = DIGEST_MAX_NODES
 
 
 class EngineReplica:
@@ -280,11 +293,112 @@ class DisaggRouter:
         self.sheds = 0
         self.degraded = False
         self._steps = 0
+        # fleet cache-economics board (metrics/cache_economics.py):
+        # radix digests folded in on a step stride, every dispatch
+        # scored for wasted re-prefill against them.  The board has
+        # its own lock (HTTP threads read /metrics + /debug/cache);
+        # the router side stays on the single engine-stepping thread
+        # per the contract above.
+        self.cache = CacheEconomics(
+            bytes_per_token=self._kv_bytes_per_token())
+        self._refresh_digests()
         self._refresh_health()
 
     # ------------------------------------------------------------- sinks
     def _kv_sink(self, request, payload: list) -> None:
         self._payloads[request.request_id] = payload
+
+    # --------------------------------------------------- cache economics
+    def _kv_bytes_per_token(self) -> int:
+        """Per-token KV footprint from the first replica whose memory
+        ledger reports the kv_pages component (bytes / page-pool token
+        capacity).  Best-effort: 0 when unavailable — token counts are
+        the board's primary unit, bytes a rendering convenience."""
+        for r in self.replicas:
+            try:
+                kv = r.engine.scheduler.kv
+                comp = r.engine.memory.snapshot()["components"]
+                kv_bytes = int(comp["kv_pages"]["bytes"])
+                capacity = int(kv.num_pages) * int(kv.page_size)
+                if kv_bytes > 0 and capacity > 0:
+                    return kv_bytes // capacity
+            except Exception:
+                continue
+        return 0
+
+    def _refresh_digests(self) -> None:
+        """Fold every live replica's radix digest + cumulative
+        hit/prefill token counters into the cache board.  Bounded host
+        work per replica (DIGEST_MAX_NODES node entries, O(1) subtree
+        counts — kvcache/radix.py digest); engines without prefix
+        caching simply never export."""
+        for r in self.replicas:
+            if r.dead:
+                # a dead replica's cached pages are gone with it — a
+                # stale digest would fake peer coverage that no longer
+                # exists (accumulated fleet counters stay)
+                self.cache.forget_replica(r.replica_id)
+                continue
+            try:
+                kv = r.engine.scheduler.kv
+                if not getattr(kv, "enable_prefix_caching", False):
+                    continue
+                sm = getattr(r.engine, "step_metrics", None)
+                self.cache.observe_digest(
+                    r.replica_id, kv.index.digest(DIGEST_MAX_NODES),
+                    hit_tokens=int(kv.prefix_hit_tokens),
+                    prefill_tokens=int(
+                        getattr(sm, "prefill_tokens", 0) or 0))
+            except Exception:
+                # a replica that cannot digest must not take the
+                # router down — the board just goes stale for it
+                continue
+
+    def _note_cache_dispatch(self, ctx: "_ReqCtx",
+                             replica: EngineReplica) -> dict:
+        """Score one placement against the fleet digests and meter the
+        regret: per-reason duplicate counters on the board, per-tenant
+        redundancy on the chosen engine's attribution sketch.  Returns
+        the expected-hit doc for the dispatch span args."""
+        try:
+            page_size = replica.engine.scheduler.kv.page_size
+        except Exception:
+            page_size = 1
+        keys = [h for _, h in chain_page_keys(
+            ctx.prompt_token_ids, page_size,
+            max_pages=DISPATCH_KEY_PAGES)]
+        doc = self.cache.note_dispatch(
+            replica.replica_id, keys,
+            tenant=ctx.info.get("tenant"),
+            request_id=ctx.request_id)
+        wasted = doc.get("wasted_tokens", 0)
+        if wasted:
+            attr = getattr(replica.engine, "attribution", None)
+            if attr is not None:
+                attr.add(ctx.info.get("tenant"),
+                         "duplicate_prefill_tokens", wasted)
+        return doc
+
+    def _resolve_prefix_hit(self, ctx: "_ReqCtx",
+                            replica: EngineReplica) -> None:
+        """Retire the request's open dispatch entry with the engine's
+        actual prefix-hit count and stamp the expected-vs-actual
+        receipt on the journey timeline."""
+        try:
+            actual = replica.engine.scheduler.kv.take_request_hit(
+                ctx.request_id)
+        except Exception:
+            actual = 0
+        doc = self.cache.resolve_dispatch(ctx.request_id, actual)
+        if doc is not None:
+            journey.journey_instant(
+                ctx.trace, journey.SPAN_PREFIX_HIT,
+                replica_id=replica.replica_id, role=replica.role,
+                args={"expected_hit_tokens":
+                          doc.get("expected_hit_tokens", 0),
+                      "peer_hit_tokens": doc.get("peer_hit_tokens", 0),
+                      "actual_hit_tokens": actual,
+                      "wasted_tokens": doc.get("wasted_tokens", 0)})
 
     # ------------------------------------------------------------ health
     def _refresh_health(self) -> None:
@@ -439,6 +553,7 @@ class DisaggRouter:
                 pool.remove(r)
         self.replicas = self.prefills + self.decodes
         self._zero_gauge_if_emptied(r.role)
+        self.cache.forget_replica(replica_id)
         self.refresh_gauges()
         return r
 
@@ -498,13 +613,17 @@ class DisaggRouter:
             # decode tier owns the rest of the stream)
             ctx.phase = ROLE_PREFILL
             ctx.replica = prefill
+            exp = self._note_cache_dispatch(ctx, prefill)
             self._submit_to(prefill, ctx,
                             replace(ctx.sampling_params, max_tokens=1))
             journey.record_journey(
                 ctx.trace, journey.SPAN_DISPATCH, w0,
                 time.perf_counter() - t0,
                 args={"replica": prefill.replica_id,
-                      "phase": ROLE_PREFILL, "attempt": ctx.attempts})
+                      "phase": ROLE_PREFILL, "attempt": ctx.attempts,
+                      "expected_hit_tokens":
+                          exp.get("expected_hit_tokens", 0),
+                      "peer_hit_tokens": exp.get("peer_hit_tokens", 0)})
             return
         survivor = decode or prefill or self._pick(self.replicas,
                                                    avoid=avoid)
@@ -523,6 +642,7 @@ class DisaggRouter:
             return
         ctx.phase = ROLE_COLOCATED
         ctx.replica = survivor
+        exp = self._note_cache_dispatch(ctx, survivor)
         self._submit_to(survivor, ctx, ctx.sampling_params,
                         suppress_kv_transfer=True)
         # a colocated placement on a two-tier topology is a
@@ -533,7 +653,10 @@ class DisaggRouter:
         journey.record_journey(
             ctx.trace, name, w0, time.perf_counter() - t0,
             args={"replica": survivor.replica_id,
-                  "phase": ROLE_COLOCATED, "attempt": ctx.attempts})
+                  "phase": ROLE_COLOCATED, "attempt": ctx.attempts,
+                  "expected_hit_tokens":
+                      exp.get("expected_hit_tokens", 0),
+                  "peer_hit_tokens": exp.get("peer_hit_tokens", 0)})
 
     def _submit_to(self, replica: EngineReplica, ctx: _ReqCtx,
                    sp: SamplingParams,
@@ -573,6 +696,8 @@ class DisaggRouter:
         requests stranded on dead replicas."""
         self._steps += 1
         self._refresh_health()
+        if self._steps % DIGEST_STRIDE == 0:
+            self._refresh_digests()
         for replica in self.replicas:
             for out in replica.step():
                 self._on_output(replica, out)
@@ -591,6 +716,10 @@ class DisaggRouter:
     def _finish(self, ctx: _ReqCtx, out: OmniRequestOutput) -> None:
         self._ctx.pop(ctx.request_id, None)
         self._payloads.pop(ctx.request_id, None)
+        # a request that never reached prefill output (shed, error,
+        # budget exhausted) leaves its dispatch expectation open —
+        # drop it so the board's pending table stays bounded
+        self.cache.abandon_dispatch(ctx.request_id)
         self._finished.append(out)
 
     def _on_output(self, replica: EngineReplica,
@@ -616,6 +745,13 @@ class DisaggRouter:
             else:
                 self._failover(ctx, "replica_error")
             return
+        if ctx.phase in (ROLE_PREFILL, ROLE_COLOCATED):
+            # first output from the replica that ran the prefill: join
+            # the ACTUAL prefix hit onto the dispatch-time expectation
+            # (same thread that steps the engine — no race with the
+            # kv manager's dict).  Idempotent: the board pops the open
+            # entry, so a decode-tier terminal can't double-count.
+            self._resolve_prefix_hit(ctx, replica)
         if ctx.phase == ROLE_PREFILL:
             toks = out.outputs[0].token_ids if out.outputs else []
             reason = (out.outputs[0].finish_reason
@@ -834,8 +970,10 @@ class DisaggRouter:
 
     # ------------------------------------------------------ introspection
     def disagg_snapshot(self) -> dict:
-        """The exposition's ``disagg`` block (kv_handoff_seconds)."""
-        return {"handoff_seconds": self.handoff_seconds.snapshot()}
+        """The exposition's ``disagg`` block: the handoff histogram +
+        the fleet cache-economics counters/gauges."""
+        return {"handoff_seconds": self.handoff_seconds.snapshot(),
+                "cache": self.cache.exposition()}
 
     def debug_snapshot(self) -> dict:
         """/debug/disagg: replica table + in-flight request phases +

@@ -202,6 +202,14 @@ class Omni:
         alert_interval = float(_envs.OMNI_TPU_ALERTS_S or 0.0)
         self.alerts = AlertEngine(build_default_rules(self),
                                   interval_s=alert_interval or 5.0)
+        # evidence riders: a firing alert's bundle carries the fleet
+        # cache-economics board when a disagg router is attached
+        # (getattr-defensive — most deployments have no router), so a
+        # prefix_hit_rate_low page records WHICH prefixes scattered
+        self.alerts.add_evidence_provider(
+            "cache_board",
+            lambda: (lambda c: c.board() if c is not None else None)(
+                getattr(getattr(self, "router", None), "cache", None)))
         self.watchdog.on_trip(
             lambda doc: self.alerts.force_firing(
                 "engine_stalled", reason="watchdog trip"))

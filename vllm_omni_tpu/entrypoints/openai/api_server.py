@@ -443,6 +443,12 @@ class OmniRequestHandler(BaseHTTPRequestHandler):
             # consumption meter, with error bounds)
             return self._json(200, debugz.debug_tenants(omni),
                               default=str)
+        if path == "/debug/cache":
+            # fleet cache-economics board (docs/disaggregation.md):
+            # replica digests, duplicated prefixes, regret ledger;
+            # {"enabled": false} on non-disagg deployments
+            return self._json(200, debugz.debug_cache(omni),
+                              default=str)
         if path == "/debug/trace":
             # trace-layer self-view (docs/observability.md): recorder
             # occupancy, spans_dropped, writer paths, last export

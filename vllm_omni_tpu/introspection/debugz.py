@@ -33,7 +33,7 @@ from vllm_omni_tpu.introspection.flight_recorder import capture_stacks
 ENDPOINTS = ("/debug/engine", "/debug/requests", "/debug/kv",
              "/debug/flightrecorder", "/debug/stacks", "/debug/watchdog",
              "/debug/disagg", "/debug/controlplane", "/debug/trace",
-             "/debug/alerts", "/debug/tenants")
+             "/debug/alerts", "/debug/tenants", "/debug/cache")
 
 
 # -------------------------------------------------------- request table
@@ -241,6 +241,22 @@ def debug_disagg(omni) -> dict:
     except Exception as e:
         # same stance as _per_stage: a torn concurrent read degrades
         # to a retry marker, never a 500 on the debugging request
+        return {"enabled": True, "error": repr(e), "retry": True}
+
+
+def debug_cache(omni) -> dict:
+    """Fleet cache-economics board (docs/disaggregation.md): per-
+    replica radix digest summaries, top cross-replica duplicated
+    prefixes, the dispatch regret ledger, and the fleet hit-rate
+    counters.  ``{"enabled": False}`` on deployments without a disagg
+    router — the endpoint always answers; a torn concurrent read
+    degrades to the retry marker, never a 500."""
+    cache = getattr(getattr(omni, "router", None), "cache", None)
+    if cache is None:
+        return {"enabled": False}
+    try:
+        return cache.board()
+    except Exception as e:
         return {"enabled": True, "error": repr(e), "retry": True}
 
 

@@ -41,6 +41,34 @@ from typing import Iterator, Optional
 from vllm_omni_tpu.kvcache.tiers import TIER_HBM
 
 
+def chain_page_keys(token_ids, page_size: int,
+                    max_pages: Optional[int] = None
+                    ) -> list[tuple[tuple[int, ...], str]]:
+    """[(page token tuple, chain-hash key)] for the FULL pages of
+    ``token_ids`` — the chained content address shared by every index
+    (a page's key commits to every page before it, so equal keys mean
+    equal whole prefixes).  Module-level so consumers that never hold
+    an index (the router's cache-economics board computing dispatch
+    coverage against exported digests) can derive the same keys."""
+    if page_size < 1:
+        raise ValueError("page_size must be positive")
+    out = []
+    prev = b""
+    n_full = len(token_ids) // page_size
+    if max_pages is not None:
+        n_full = min(n_full, max_pages)
+    for p in range(n_full):
+        chunk = tuple(
+            int(t) for t in
+            token_ids[p * page_size: (p + 1) * page_size])
+        h = hashlib.blake2b(
+            prev + b"," + repr(list(chunk)).encode(), digest_size=16
+        ).hexdigest()
+        out.append((chunk, h))
+        prev = h.encode()
+    return out
+
+
 class RadixNode:
     """One full KV page of a shared prompt prefix."""
 
@@ -139,6 +167,55 @@ class RadixPrefixIndex:
             "clock": self._clock,
         }
 
+    # ------------------------------------------------------------ digest
+    def digest(self, max_nodes: int = 64) -> dict:
+        """Bounded export of the top of the tree for fleet-wide
+        cache-economics aggregation (metrics/cache_economics.py).
+
+        BFS from the root so shallow nodes — the widely shared
+        prefixes worth comparing across replicas — always make the cut;
+        the walk stops dead at ``max_nodes`` emitted entries
+        (``truncated`` marks the cut).  Per-node subtree HBM token
+        counts come from the incrementally maintained ``hbm_desc``
+        counter: O(1) per node, NO subtree walks, so the whole export
+        is O(max_nodes) host work regardless of tree size.  Pure host
+        dict/list assembly — zero device syncs (omnilint OL2)."""
+        if max_nodes < 1:
+            raise ValueError("max_nodes must be positive")
+        nodes: list[dict] = []
+        truncated = False
+        queue: list[tuple[int, RadixNode]] = [
+            (1, n) for n in self._root.children.values()]
+        head = 0
+        while head < len(queue):
+            depth, n = queue[head]
+            head += 1
+            if len(nodes) >= max_nodes:
+                truncated = True
+                break
+            own_hbm = 1 if n.page is not None else 0
+            nodes.append({
+                "key": n.key,
+                "depth": depth,
+                "tier": n.tier,
+                "ref": n.ref,
+                "last_use": n.last_use,
+                # tokens resident in HBM in the subtree rooted here
+                # (hbm_desc = strict descendants; add the node's own
+                # page) — the O(1) counter the eviction path maintains
+                "hbm_tokens": (n.hbm_desc + own_hbm) * self.page_size,
+            })
+            for child in n.children.values():
+                queue.append((depth + 1, child))
+        return {
+            "page_size": self.page_size,
+            "clock": self._clock,
+            "hbm_pages": len(self._by_page),
+            "node_cap": max_nodes,
+            "truncated": truncated,
+            "nodes": nodes,
+        }
+
     # ----------------------------------------------------------- hashing
     def page_keys(self, token_ids, max_pages: Optional[int] = None
                   ) -> list[tuple[tuple[int, ...], str]]:
@@ -146,22 +223,7 @@ class RadixPrefixIndex:
         ``token_ids`` — the same chained content address the flat map
         used, so cold-tier payloads stay findable across index
         rebuilds."""
-        out = []
-        prev = b""
-        n_full = len(token_ids) // self.page_size
-        if max_pages is not None:
-            n_full = min(n_full, max_pages)
-        for p in range(n_full):
-            chunk = tuple(
-                int(t) for t in
-                token_ids[p * self.page_size: (p + 1) * self.page_size])
-            # chain hash: a page's key commits to every page before it
-            h = hashlib.blake2b(
-                prev + b"," + repr(list(chunk)).encode(), digest_size=16
-            ).hexdigest()
-            out.append((chunk, h))
-            prev = h.encode()
-        return out
+        return chain_page_keys(token_ids, self.page_size, max_pages)
 
     # ------------------------------------------------------------- match
     def match(self, token_ids=None, max_pages: Optional[int] = None,

@@ -17,6 +17,7 @@ from vllm_omni_tpu.loadgen.workload import (  # noqa: F401
     default_catalog,
     diurnal_arrivals,
     poisson_arrivals,
+    shared_prefix_catalog,
     trace_replay_arrivals,
 )
 from vllm_omni_tpu.loadgen.runner import (  # noqa: F401

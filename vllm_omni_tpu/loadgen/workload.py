@@ -42,6 +42,14 @@ class Scenario:
     once per scenario from the workload seed), so prefix caching and
     the radix index see realistic overlap.  Length bounds are inclusive
     uniform draws per request.
+
+    ``prefix_group`` names the prefix draw to share: scenarios with
+    the same group (and equal ``shared_prefix_len``) emit the SAME
+    shared prefix — N tenant-pinned scenarios over one common system
+    prompt, the cross-replica redundancy workload
+    (``shared_prefix_catalog``).  None keeps the per-scenario-name
+    draw, so existing catalogs generate exactly the traffic they
+    always did.
     """
 
     name: str
@@ -55,6 +63,7 @@ class Scenario:
     # weight (metrics/stats.py DEFAULT_PRIORITY), so catalogs that
     # never set it generate exactly the traffic they always did
     priority: Optional[int] = None
+    prefix_group: Optional[str] = None
 
 
 def default_catalog() -> list[Scenario]:
@@ -70,6 +79,32 @@ def default_catalog() -> list[Scenario]:
                  shared_prefix_len=256),
         Scenario("streaming", weight=0.1,
                  prompt_len=(32, 64), output_len=(32, 64), stream=True),
+    ]
+
+
+def shared_prefix_catalog(n_tenants: int = 4,
+                          prefix_len: int = 64,
+                          prompt_len: tuple[int, int] = (8, 24),
+                          output_len: tuple[int, int] = (8, 16),
+                          group: str = "system_prompt"
+                          ) -> list[Scenario]:
+    """The cache-economics workload (docs/load_testing.md): N equal-
+    weight tenant-pinned scenarios all opening with ONE common system
+    prompt (``prefix_group`` shares the draw).  Under a cache-blind
+    router the common prefix lands on every replica — the redundancy
+    `scripts/cache_bench.py` scores and prefix-affinity routing
+    (ROADMAP item 3) must reclaim.  Seed-deterministic like every
+    catalog: the prefix is drawn once from the workload seed."""
+    if n_tenants < 1:
+        raise ValueError("n_tenants must be positive")
+    if prefix_len < 1:
+        raise ValueError("prefix_len must be positive")
+    return [
+        Scenario(f"shared_prefix_t{i}", weight=1.0,
+                 prompt_len=prompt_len, output_len=output_len,
+                 shared_prefix_len=prefix_len,
+                 tenant=f"tenant{i}", prefix_group=group)
+        for i in range(n_tenants)
     ]
 
 
@@ -237,19 +272,25 @@ def build_workload(
     weights = [max(s.weight, 0.0) for s in catalog]
     if sum(weights) <= 0:
         raise ValueError("catalog weights must sum > 0")
-    # shared prefixes drawn ONCE per scenario, before the per-request
-    # stream, so adding requests never reshuffles them
-    prefixes = {
-        s.name: [rng.randrange(1, vocab_size)
-                 for _ in range(s.shared_prefix_len)]
-        for s in catalog if s.shared_prefix_len > 0
-    }
+    # shared prefixes drawn ONCE per prefix key (the scenario's
+    # prefix_group, or its name when ungrouped), before the
+    # per-request stream, so adding requests never reshuffles them and
+    # grouped scenarios share one draw in catalog order
+    prefixes: dict[str, list[int]] = {}
+    for s in catalog:
+        if s.shared_prefix_len <= 0:
+            continue
+        k = s.prefix_group or s.name
+        if k not in prefixes:
+            prefixes[k] = [rng.randrange(1, vocab_size)
+                           for _ in range(s.shared_prefix_len)]
     out: list[LoadRequest] = []
     for i, at_s in enumerate(arrivals):
         sc = rng.choices(catalog, weights=weights, k=1)[0]
         n_prompt = rng.randint(*sc.prompt_len)
         n_out = rng.randint(*sc.output_len)
-        toks = list(prefixes.get(sc.name, ()))
+        toks = list(prefixes.get(sc.prefix_group or sc.name, ())
+                    if sc.shared_prefix_len > 0 else ())
         toks += [rng.randrange(1, vocab_size) for _ in range(n_prompt)]
         tenant = sc.tenant or tenants[i % len(tenants)]
         priority = sc.priority

@@ -151,6 +151,12 @@ class AlertEngine:
         self._rules: dict[str, _RuleState] = {}
         self._transitions: "list[dict]" = []
         self._on_firing: list[Callable[[str, dict], None]] = []
+        # extra evidence sections: (key, fn) pairs merged into every
+        # bundle's ``extra`` — how deployment-scoped boards (the
+        # router's fleet cache board) join the dump without the
+        # evidence path importing deployment shapes
+        self._evidence_providers: list[
+            tuple[str, Callable[[], Any]]] = []
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self.evaluations = 0
@@ -174,6 +180,13 @@ class AlertEngine:
         """Register ``fn(rule_name, transition_doc)`` called on every
         pending->firing edge (after the built-in evidence capture)."""
         self._on_firing.append(fn)
+
+    def add_evidence_provider(self, key: str,
+                              fn: Callable[[], Any]) -> None:
+        """Register an extra evidence section: ``fn()`` runs at
+        capture time (outside the lock, exceptions contained) and its
+        JSON-ready return lands in the bundle under ``key``."""
+        self._evidence_providers.append((key, fn))
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "AlertEngine":
@@ -371,8 +384,9 @@ class AlertEngine:
     def _on_firing_edge(self, rs: _RuleState, t: dict) -> None:
         if rs.rule.capture_evidence:
             try:
-                path = capture_evidence(rs.rule.name, t,
-                                        snapshot=self.snapshot)
+                path = capture_evidence(
+                    rs.rule.name, t, snapshot=self.snapshot,
+                    providers=list(self._evidence_providers))
             except Exception:
                 logger.exception("alert evidence capture failed")
                 path = None
@@ -463,12 +477,15 @@ class AlertEngine:
 
 # ------------------------------------------------------------- evidence
 def capture_evidence(name: str, transition: dict,
-                     snapshot: Optional[Callable[[], dict]] = None
+                     snapshot: Optional[Callable[[], dict]] = None,
+                     providers: tuple = ()
                      ) -> Optional[str]:
     """Assemble and write one alert evidence bundle through the flight
     recorder's dump path: the per-engine step-record rings, a journey-
     trace slice (the recorder's most recent spans, non-destructive),
-    every engine's top-k tenant attribution board, and the firing
+    every engine's top-k tenant attribution board, any registered
+    extra provider sections (e.g. the fleet cache board — a hit-rate
+    collapse captures WHICH prefixes scattered), and the firing
     rule's window values.  Returns the written path, or None when
     ``OMNI_TPU_FLIGHT_DIR`` is unset or the per-reason cooldown
     suppressed the write (a flapping alert must not flood the dir)."""
@@ -505,6 +522,11 @@ def capture_evidence(name: str, transition: dict,
             for i, e in enumerate(engines)
         ],
     }
+    for key, fn in providers:
+        try:
+            extra[key] = fn()
+        except Exception as e:  # one broken board must not void the rest
+            extra[key] = {"error": repr(e)}
     doc = build_dump(
         f"alert:{name}",
         recorders=[e.flight for e in engines
@@ -527,6 +549,7 @@ def build_default_rules(
     failover_rate_limit: float = 0.1,
     latency_mult: float = 1.0,
     for_duration_s: float = 15.0,
+    prefix_hit_objective: float = 0.5,
 ) -> list[AlertRule]:
     """The stock rule set over an ``Omni``-shaped orchestrator (probes
     are getattr-defensive duck-typed reads, the debugz stance).  SLO
@@ -581,6 +604,28 @@ def build_default_rules(
         samples = resilience_metrics.snapshot().get("degraded_mode", [])
         return {"value": any(v for _, v in samples)}
 
+    def prefix_probe() -> dict:
+        """Burn shape over prefix-cache economics: bad = prompt tokens
+        PREFILLED (cache misses), total = hit + prefilled.  Prefers
+        the disagg router's fleet board; single-engine deployments
+        fall back to summing engine counters."""
+        cache = getattr(getattr(omni, "router", None), "cache", None)
+        if cache is not None:
+            expo = cache.exposition()
+            bad = int(expo.get("fleet_prefill_tokens", 0))
+            return {"bad": bad,
+                    "total": int(expo.get("fleet_hit_tokens", 0)) + bad}
+        bad = total = 0
+        for e in engines():
+            kv = getattr(getattr(e, "scheduler", None), "kv", None)
+            if kv is None or not getattr(kv, "enable_prefix_caching",
+                                         False):
+                continue
+            prefill = int(getattr(e.step_metrics, "prefill_tokens", 0))
+            bad += prefill
+            total += int(getattr(kv, "prefix_hit_tokens", 0)) + prefill
+        return {"bad": bad, "total": total}
+
     budget = max(1.0 - slo_objective, 1e-9)
     rules = [
         AlertRule(
@@ -630,6 +675,15 @@ def build_default_rules(
             probe=degraded_probe,
             description="router serving colocated because a tier has "
                         "zero healthy replicas"),
+        AlertRule(
+            name="prefix_hit_rate_low", kind=KIND_BURN,
+            probe=prefix_probe,
+            windows=((fast_window_s, 1.0),),
+            budget=max(1.0 - prefix_hit_objective, 1e-9),
+            for_duration_s=for_duration_s,
+            description="fleet prefix hit rate below objective: the "
+                        "miss budget (prefilled / total prompt "
+                        "tokens) burning at >1x over the fast window"),
     ]
     # latency-vs-target rules need a target to compare against; the
     # Histogram's percentile() is already a bounded recent window

@@ -57,6 +57,10 @@ METERS = (
     "handoff_bytes",
     "queue_wait_ms",
     "sheds",
+    # wasted re-prefill tokens (metrics/cache_economics.py): added by
+    # the disagg router at dispatch time, so per-tenant redundancy
+    # rides the same sketch/export machinery as every other meter
+    "duplicate_prefill_tokens",
 )
 
 #: tenants exported per meter on /metrics — strictly inside the

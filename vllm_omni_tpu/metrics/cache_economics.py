@@ -1,0 +1,329 @@
+"""omniscope: fleet KV-cache economics — the sensor half of
+prefix-affinity routing (ROADMAP item 3).
+
+Every engine's ``RadixPrefixIndex`` already knows exactly which
+prefixes it holds and where their bytes live; the fleet knows nothing.
+The cache-blind router therefore re-prefills prompt prefixes that a
+sibling replica (or the remote tier) already paid for — invisible
+work, because each engine's local ``prefix_hits`` counter looks
+perfectly healthy while the FLEET hit rate collapses with replica
+count.  This module is the scoreboard that makes the waste visible
+before the affinity router (the needle-mover) exists:
+
+- **digest aggregation**: each replica's bounded radix digest
+  (``RadixPrefixIndex.digest`` — top-of-tree chain-hash fingerprints
+  with O(1) per-subtree HBM token counts, hard node cap) lands here on
+  a router stride.  Chain hashing makes cross-replica comparison
+  trivial: equal keys mean equal whole prefixes, no token shipping.
+- **dispatch regret**: at dispatch time the router asks
+  ``note_dispatch`` what the chosen replica holds versus the best
+  in-rotation peer.  The gap — tokens the chosen replica is about to
+  prefill that a peer already held — is the *wasted re-prefill*
+  ledger, the exact signal an affinity router minimizes.  Reasons
+  split hot-peer (``peer_replica``) from parked-cold
+  (``peer_cold_tier``) so the fix (route-to-peer vs restore-from-tier)
+  is attributable per event.
+- **fleet counters**: per-replica cumulative hit/prefill token
+  counters are folded into monotone fleet totals (delta-accumulated,
+  reset-tolerant, retained across replica replacement) so
+  ``fleet_prefix_hit_tokens_total`` and the fleet hit-rate gauge stay
+  counter-safe on /metrics.
+
+Thread contract: the router thread (the single engine-stepping thread,
+router.py's contract) calls ``observe_digest`` / ``note_dispatch`` /
+``resolve_dispatch``; /metrics and /debug/cache snapshot from HTTP
+threads via ``exposition`` / ``board`` — the per-instance lock guards
+every table (LOCK_GUARDS manifest).  Hot-path discipline: dispatch
+accounting is dict/set arithmetic over already-exported digests, zero
+device syncs (omnilint OL2, HOT_PATHS manifest).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+from vllm_omni_tpu.analysis.runtime import traced
+from vllm_omni_tpu.kvcache.tiers import TIER_HBM
+
+#: wasted re-prefill reasons (the {reason} label on
+#: fleet_duplicate_prefill_tokens_total)
+REASON_PEER_REPLICA = "peer_replica"
+REASON_PEER_COLD_TIER = "peer_cold_tier"
+REASONS = (REASON_PEER_REPLICA, REASON_PEER_COLD_TIER)
+
+#: regret-ledger ring bound: enough to explain "why is hit rate low
+#: right now", small enough that /debug/cache stays a cheap read
+LEDGER_SIZE = 128
+
+#: duplicated-prefix rows exported on the board
+TOP_DUPLICATES = 10
+
+
+class CacheEconomics:
+    """Fleet-wide cache board: replica digests in, regret signal out."""
+
+    def __init__(self, *, ledger_size: int = LEDGER_SIZE,
+                 bytes_per_token: int = 0):
+        self._lock = traced(threading.Lock(), "CacheEconomics._lock")
+        # replica_id -> the replica's latest radix digest (stored as
+        # exported — digest() builds fresh dicts, nothing aliases the
+        # live tree)
+        self._digests: dict[str, dict] = {}
+        # replica_id -> {key -> (depth, tier)} — the coverage lookup
+        # note_dispatch walks, precomputed once per digest refresh
+        self._cover: dict[str, dict[str, tuple[int, str]]] = {}
+        # replica_id -> last observed cumulative (hit, prefill) token
+        # counters, for delta accumulation into the fleet totals
+        self._last: dict[str, tuple[int, int]] = {}
+        # monotone fleet totals: survive replica replacement and
+        # engine counter resets (deltas clamp at zero; a reset counts
+        # from zero again instead of subtracting)
+        self._fleet_hit_tokens = 0
+        self._fleet_prefill_tokens = 0
+        self._dup_by_reason: dict[str, int] = {r: 0 for r in REASONS}
+        # request_id -> open dispatch entry (expected side recorded at
+        # dispatch, actual side joined at first prefill output)
+        self._pending: dict[str, dict] = {}
+        self._ledger: deque = deque(maxlen=ledger_size)
+        self._dispatches = 0
+        self.bytes_per_token = int(bytes_per_token)
+
+    # ------------------------------------------------------- digest side
+    def observe_digest(self, replica_id: str, digest: dict,
+                       hit_tokens: int = 0,
+                       prefill_tokens: int = 0) -> None:
+        """Fold one replica's refreshed digest + cumulative hit/prefill
+        token counters into the board (router thread, on a stride)."""
+        cover: dict[str, tuple[int, str]] = {}
+        for n in digest.get("nodes", ()):
+            cover[n["key"]] = (int(n["depth"]), str(n["tier"]))
+        with self._lock:
+            self._digests[replica_id] = digest
+            self._cover[replica_id] = cover
+            last_hit, last_prefill = self._last.get(replica_id, (0, 0))
+            hit = int(hit_tokens)
+            prefill = int(prefill_tokens)
+            # delta-accumulate; a counter that went backwards is a
+            # replaced/reset engine — count its new value from zero
+            self._fleet_hit_tokens += (
+                hit - last_hit if hit >= last_hit else hit)
+            self._fleet_prefill_tokens += (
+                prefill - last_prefill if prefill >= last_prefill
+                else prefill)
+            self._last[replica_id] = (hit, prefill)
+
+    def forget_replica(self, replica_id: str) -> None:
+        """Drop a reaped replica's digest; its already-accumulated
+        fleet deltas stay (totals are monotone by construction)."""
+        with self._lock:
+            self._digests.pop(replica_id, None)
+            self._cover.pop(replica_id, None)
+            self._last.pop(replica_id, None)
+
+    # ----------------------------------------------------- dispatch side
+    @staticmethod
+    def _coverage(cover: dict[str, tuple[int, str]],
+                  keys: Sequence[str]) -> tuple[int, str]:
+        """(pages covered, tier of the deepest covering node).  Chain
+        hashing means key membership at position i implies the whole
+        i+1-page prefix matches — the walk only has to find the
+        deepest hit, and a miss at depth d ends the chain (a digest
+        never holds a child without its parent)."""
+        pages, tier = 0, TIER_HBM
+        for i, key in enumerate(keys):
+            hit = cover.get(key)
+            if hit is None:
+                break
+            pages = i + 1
+            tier = hit[1]
+        return pages, tier
+
+    def note_dispatch(self, replica_id: str, keys: Sequence[str],
+                      tenant: Optional[str] = None,
+                      request_id: Optional[str] = None) -> dict:
+        """Score one routing decision against the current digests.
+
+        ``keys`` are the request's chain-hash page keys
+        (``kvcache.radix.chain_page_keys``).  Returns the expected-hit
+        doc (journey span args + attribution amount for the caller):
+        ``expected_hit_tokens`` on the chosen replica,
+        ``peer_hit_tokens`` on the best in-rotation peer, and
+        ``wasted_tokens`` — the re-prefill regret — with its reason.
+        Digests are best-effort snapshots (stride-refreshed, node-
+        capped), so coverage is a LOWER bound on what replicas hold;
+        regret is correspondingly conservative."""
+        with self._lock:
+            self._dispatches += 1
+            chosen = self._cover.get(replica_id, {})
+            local_pages, _ = self._coverage(chosen, keys)
+            peer_pages, peer_tier, best_peer = 0, TIER_HBM, None
+            for rid, cover in self._cover.items():
+                if rid == replica_id:
+                    continue
+                pages, tier = self._coverage(cover, keys)
+                if pages > peer_pages:
+                    peer_pages, peer_tier, best_peer = pages, tier, rid
+            page_size = self._page_size_locked(replica_id, best_peer)
+            wasted_pages = max(peer_pages - local_pages, 0)
+            wasted = wasted_pages * page_size
+            reason = (REASON_PEER_REPLICA if peer_tier == TIER_HBM
+                      else REASON_PEER_COLD_TIER)
+            if wasted > 0:
+                self._dup_by_reason[reason] = (
+                    self._dup_by_reason.get(reason, 0) + wasted)
+            doc = {
+                "request_id": request_id,
+                "tenant": tenant,
+                "replica": replica_id,
+                "expected_hit_tokens": local_pages * page_size,
+                "peer_hit_tokens": peer_pages * page_size,
+                "best_peer": best_peer,
+                "wasted_tokens": wasted,
+                "reason": reason if wasted > 0 else None,
+            }
+            if request_id is not None:
+                self._pending[request_id] = doc
+            return doc
+
+    def resolve_dispatch(self, request_id: Optional[str],
+                         actual_hit_tokens: int) -> Optional[dict]:
+        """Join the actual prefix hit (the engine's per-request count)
+        onto the open dispatch entry and retire it into the regret
+        ledger.  Returns the completed entry (journey annotation), or
+        None when no entry is open for ``request_id``."""
+        if request_id is None:
+            return None
+        with self._lock:
+            doc = self._pending.pop(request_id, None)
+            if doc is None:
+                return None
+            doc["actual_hit_tokens"] = int(actual_hit_tokens)
+            self._ledger.append(doc)
+            return doc
+
+    def abandon_dispatch(self, request_id: Optional[str]) -> None:
+        """Drop an open entry whose request died before prefill output
+        (failover/shed) so the pending table stays bounded."""
+        if request_id is None:
+            return
+        with self._lock:
+            self._pending.pop(request_id, None)
+
+    # --------------------------------------------------------- rendering
+    def _page_size_locked(self, *replica_ids) -> int:
+        """Best page size for token math (caller holds the lock):
+        prefer the named replicas' digests, fall back to any."""
+        for rid in replica_ids:
+            d = self._digests.get(rid)
+            if d is not None:
+                return int(d.get("page_size", 1)) or 1
+        for d in self._digests.values():
+            return int(d.get("page_size", 1)) or 1
+        return 1
+
+    def _duplicates_locked(self) -> tuple[int, list[dict]]:
+        """(duplicate tokens across replicas, top duplicated rows).
+        A key held by k replicas means k-1 redundant page copies —
+        summed over every duplicated key that is the cross-replica
+        duplicate-prefix bill.  Rows sort most-replicated first, then
+        shallowest (prefix heads), then key — deterministic for the
+        hand-oracled fixture test."""
+        seen: dict[str, dict] = {}
+        for rid, cover in self._cover.items():
+            page_size = self._page_size_locked(rid)
+            for key, (depth, tier) in cover.items():
+                row = seen.get(key)
+                if row is None:
+                    seen[key] = {"key": key, "depth": depth,
+                                 "replicas": [rid], "tiers": {tier: 1},
+                                 "page_size": page_size}
+                else:
+                    row["replicas"].append(rid)
+                    row["tiers"][tier] = row["tiers"].get(tier, 0) + 1
+        dup_tokens = 0
+        rows = []
+        for row in seen.values():
+            k = len(row["replicas"])
+            if k < 2:
+                continue
+            tokens = (k - 1) * row["page_size"]
+            dup_tokens += tokens
+            rows.append({
+                "key": row["key"], "depth": row["depth"],
+                "replicas": sorted(row["replicas"]),
+                "tiers": dict(sorted(row["tiers"].items())),
+                "duplicate_tokens": tokens,
+                "duplicate_bytes": tokens * self.bytes_per_token,
+            })
+        rows.sort(key=lambda r: (-len(r["replicas"]), r["depth"],
+                                 r["key"]))
+        return dup_tokens, rows
+
+    def _hit_rate_locked(self) -> float:
+        total = self._fleet_hit_tokens + self._fleet_prefill_tokens
+        return self._fleet_hit_tokens / total if total else 0.0
+
+    def exposition(self) -> dict:
+        """Compact block for the /metrics disagg render: fleet
+        hit/prefill counters, hit-rate gauge, per-reason duplicate
+        counters, per-replica digest node gauges."""
+        with self._lock:
+            dup_tokens, _ = self._duplicates_locked()
+            return {
+                "fleet_hit_tokens": self._fleet_hit_tokens,
+                "fleet_prefill_tokens": self._fleet_prefill_tokens,
+                "hit_rate": round(self._hit_rate_locked(), 6),
+                "duplicate_by_reason": dict(self._dup_by_reason),
+                "duplicate_prefix_tokens": dup_tokens,
+                "digest_nodes": {
+                    rid: len(d.get("nodes", ()))
+                    for rid, d in sorted(self._digests.items())},
+            }
+
+    def board(self) -> dict:
+        """The /debug/cache fleet board: per-replica digest summaries,
+        top duplicated prefixes, the regret ledger, fleet totals.
+        Copies out every mutable structure under the lock (C-level
+        list/dict constructions — the debugz torn-read contract)."""
+        with self._lock:
+            dup_tokens, top = self._duplicates_locked()
+            replicas = {}
+            for rid in sorted(self._digests):
+                d = self._digests[rid]
+                hit, prefill = self._last.get(rid, (0, 0))
+                replicas[rid] = {
+                    "nodes": len(d.get("nodes", ())),
+                    "node_cap": d.get("node_cap"),
+                    "truncated": bool(d.get("truncated")),
+                    "hbm_pages": d.get("hbm_pages"),
+                    "page_size": d.get("page_size"),
+                    "clock": d.get("clock"),
+                    "hit_tokens": hit,
+                    "prefill_tokens": prefill,
+                }
+            return {
+                "enabled": True,
+                "replicas": replicas,
+                "fleet": {
+                    "hit_tokens": self._fleet_hit_tokens,
+                    "prefill_tokens": self._fleet_prefill_tokens,
+                    "hit_rate": round(self._hit_rate_locked(), 6),
+                    "dispatches": self._dispatches,
+                    "duplicate_by_reason": dict(self._dup_by_reason),
+                    "duplicate_prefix_tokens": dup_tokens,
+                    "duplicate_prefix_bytes":
+                        dup_tokens * self.bytes_per_token,
+                    "bytes_per_token": self.bytes_per_token,
+                },
+                "top_duplicates": top[:TOP_DUPLICATES],
+                "regret_ledger": list(self._ledger),
+                "pending_dispatches": len(self._pending),
+            }
+
+
+__all__ = [
+    "CacheEconomics", "REASON_PEER_REPLICA", "REASON_PEER_COLD_TIER",
+    "REASONS", "LEDGER_SIZE", "TOP_DUPLICATES",
+]

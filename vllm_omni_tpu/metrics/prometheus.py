@@ -359,6 +359,43 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Distinct tenants currently tracked by the attribution sketch "
         "per meter (bounded by the sketch capacity)",
         ("stage", "meter")),
+    # ---- omniscope (metrics/cache_economics.py,
+    # docs/observability.md): fleet KV-cache economics — router-
+    # aggregated radix digests scoring every dispatch for wasted
+    # re-prefill (the regret signal prefix-affinity routing minimizes)
+    "fleet_prefix_hit_tokens_total": (
+        "counter",
+        "Prompt tokens served from ANY replica's prefix cache, "
+        "fleet-wide (delta-accumulated across replica replacement)",
+        ()),
+    "fleet_prefill_tokens_total": (
+        "counter",
+        "Prompt tokens prefilled fleet-wide — the hit-rate "
+        "denominator's other half", ()),
+    "fleet_prefix_hit_rate": (
+        "gauge",
+        "Fleet prefix hit rate: hit tokens / (hit + prefilled) over "
+        "the fleet's lifetime counters", ()),
+    "fleet_duplicate_prefill_tokens_total": (
+        "counter",
+        "Wasted re-prefill: tokens the chosen replica prefilled that "
+        "another in-rotation replica (peer_replica) or a parked cold "
+        "copy (peer_cold_tier) already held", ("reason",)),
+    "fleet_duplicate_prefix_tokens": (
+        "gauge",
+        "Tokens of prefix content currently duplicated across replica "
+        "caches (k replicas holding a page count k-1 redundant "
+        "copies), from the bounded digests", ()),
+    "cache_digest_nodes": (
+        "gauge",
+        "Radix digest entries exported by the replica on the last "
+        "stride refresh (hard-capped — the digest cost bound)",
+        ("replica",)),
+    "tenant_duplicate_prefill_tokens_total": (
+        "counter",
+        "Per-tenant wasted re-prefill tokens, sketch estimate — which "
+        "tenants' traffic the cache-blind router scatters",
+        ("stage", "tenant")),
 }
 
 #: attribution meter -> (/metrics series, fixed extra labels); meters
@@ -373,6 +410,8 @@ _ATTRIBUTION_SERIES: dict[str, tuple[str, dict]] = {
     "handoff_bytes": ("tenant_handoff_bytes_total", {}),
     "queue_wait_ms": ("tenant_queue_wait_ms_total", {}),
     "sheds": ("tenant_sheds_total", {}),
+    "duplicate_prefill_tokens": (
+        "tenant_duplicate_prefill_tokens_total", {}),
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -675,6 +714,25 @@ def render_exposition(summary: dict, engine_snaps: dict,
     if disagg and disagg.get("handoff_seconds", {}).get("count"):
         exp.histogram("kv_handoff_seconds", {},
                       disagg["handoff_seconds"])
+    cache = (disagg or {}).get("cache")
+    if cache:
+        # fleet cache economics (metrics/cache_economics.py): the
+        # router's aggregated digest board
+        exp.sample("fleet_prefix_hit_tokens_total", {},
+                   cache.get("fleet_hit_tokens", 0))
+        exp.sample("fleet_prefill_tokens_total", {},
+                   cache.get("fleet_prefill_tokens", 0))
+        exp.sample("fleet_prefix_hit_rate", {},
+                   cache.get("hit_rate", 0.0))
+        for reason, v in sorted(
+                (cache.get("duplicate_by_reason") or {}).items()):
+            exp.sample("fleet_duplicate_prefill_tokens_total",
+                       {"reason": reason}, v)
+        exp.sample("fleet_duplicate_prefix_tokens", {},
+                   cache.get("duplicate_prefix_tokens", 0))
+        for rid, n in sorted(
+                (cache.get("digest_nodes") or {}).items()):
+            exp.sample("cache_digest_nodes", {"replica": str(rid)}, n)
     for name, samples in (resilience or {}).items():
         if name not in METRIC_SPECS:
             continue  # unknown names never leak past the drift guard

@@ -48,6 +48,10 @@ SPAN_DISPATCH = "router_dispatch"
 SPAN_FAILOVER = "failover"
 SPAN_SHED = "shed"
 SPAN_DEGRADED = "degraded_dispatch"
+#: expected-vs-actual prefix hit marker (cat="router"): emitted at
+#: first prefill output with the dispatch-time expectation joined to
+#: the engine's actual match — the per-request cache-economics receipt
+SPAN_PREFIX_HIT = "prefix_hit"
 #: KV handoff spans (cat="handoff")
 SPAN_HANDOFF_SHIP = "kv_handoff_ship"
 SPAN_HANDOFF_RECV = "kv_handoff_recv"
@@ -132,6 +136,7 @@ def inbound_trace_id(headers) -> Optional[str]:
 
 __all__ = [
     "SPAN_DISPATCH", "SPAN_FAILOVER", "SPAN_SHED", "SPAN_DEGRADED",
+    "SPAN_PREFIX_HIT",
     "SPAN_HANDOFF_SHIP", "SPAN_HANDOFF_RECV", "SPAN_ADOPT", "CP_PREFIX",
     "ROUTER_TRACK", "record_journey", "journey_instant",
     "parse_traceparent", "inbound_trace_id",
