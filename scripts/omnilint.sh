@@ -1,10 +1,11 @@
 #!/usr/bin/env sh
 # omnilint CI gate: exits non-zero on any NEW finding (beyond the
 # committed analysis/baseline.json and inline suppressions) across ALL
-# rule families OL1-OL11 — the omnirace concurrency rules (OL7-OL9;
-# scripts/racecheck.sh runs just those plus the runtime detector) and
-# the omniflow package-wide rules (OL10 hostile-input taint, OL11
-# recompile-hazard) included — AND on any stale suppression: a
+# rule families OL1-OL13 — the omnirace concurrency rules (OL7-OL9;
+# scripts/racecheck.sh runs just those plus the runtime detector), the
+# omniflow package-wide rules (OL10 hostile-input taint, OL11
+# recompile-hazard), and the omnileak path-sensitive rules (OL12
+# resource-lifecycle, OL13 typestate) included — AND on any stale suppression: a
 # `# omnilint: disable=OLx` comment that no longer suppresses anything
 # (or a baseline entry nothing produces) is dead armor that would
 # silently bless the next regression, so the audit is a hard gate.

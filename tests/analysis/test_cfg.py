@@ -1,0 +1,256 @@
+"""Exception-edge CFG (engine ``FunctionCFG``) + ``cfg_leak_path``:
+the path-sensitive substrate OL12/OL13 stand on.  Tests pin the load-
+bearing semantics — finally copies, catch-all dispatch, cleanup-only
+escape discharge, the swallowed-crossing witness — on tiny sources so
+a builder regression fails here, not as a mystery false positive in a
+rule suite.
+"""
+
+import ast
+import textwrap
+
+from vllm_omni_tpu.analysis.engine import (
+    FunctionCFG,
+    cfg_leak_path,
+    describe_path,
+    scan_calls,
+)
+from vllm_omni_tpu.analysis.rules._lockinfo import callee_terminal
+
+
+def build(src: str) -> FunctionCFG:
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    return FunctionCFG(fn)
+
+
+def site(cfg: FunctionCFG, name: str) -> int:
+    """First node index owning a call to ``name``."""
+    for idx, call in cfg.call_sites():
+        if callee_terminal(call.func) == name:
+            return idx
+    raise AssertionError(f"no call to {name} in fixture")
+
+
+def released(cfg: FunctionCFG):
+    """Discharge predicate: node owns a ``release(...)`` call."""
+    def dis(idx: int) -> bool:
+        return any(callee_terminal(c.func) == "release"
+                   for c in scan_calls(cfg.nodes[idx].owned))
+    return dis
+
+
+# --------------------------------------------------------------- escape kind
+def test_unprotected_acquire_escapes():
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            self.work(h)
+    ''')
+    path = cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "escape")
+    assert path is not None
+    assert path[-1] == cfg.RAISE
+
+
+def test_finally_release_discharges_the_unwind():
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            try:
+                self.work(h)
+            finally:
+                self.pool.release(h)
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "escape") is None
+
+
+def test_guarded_release_in_finally_still_discharges():
+    # a condition guarding the release inside a finally is the
+    # author's explicit intent, not a leak — reachability, not
+    # must-execute, on the cleanup side
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            done = False
+            try:
+                self.work(h)
+                done = True
+            finally:
+                if not done:
+                    self.pool.release(h)
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "escape") is None
+
+
+def test_narrow_handler_release_does_not_mask_the_escape():
+    # the PR 15 flight-recorder shape: only OSError releases; any
+    # other exception unwinds past the handler with the obligation
+    # live.  A handler-resident release is NOT must-execute cleanup.
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            try:
+                self.work(h)
+            except OSError:
+                self.pool.release(h)
+    ''')
+    path = cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "escape")
+    assert path is not None and path[-1] == cfg.RAISE
+
+
+def test_acquire_own_raise_is_exempt():
+    # if the acquire itself raised, nothing was acquired — the search
+    # starts from the acquire's NORMAL successors only
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "escape") is None
+
+
+def test_logging_calls_are_non_raising():
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            logger.info("leased %s", h)
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "escape") is None
+
+
+# -------------------------------------------------------------- swallow kind
+def test_catch_all_without_recovery_is_a_swallow_not_an_escape():
+    src = '''
+        def f(self):
+            h = self.pool.acquire()
+            try:
+                self.work(h)
+            except Exception:
+                logger.error("boom")
+            return True
+    '''
+    cfg = build(src)
+    start = site(cfg, "acquire")
+    # the catch-all kills the RAISE path entirely...
+    assert cfg_leak_path(cfg, start, released(cfg), "escape") is None
+    # ...but the swallowed crossing still exits normally undischarged
+    path = cfg_leak_path(cfg, start, released(cfg), "swallow")
+    assert path is not None and path[-1] == cfg.EXIT
+
+
+def test_handler_release_clears_the_swallow():
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            try:
+                self.work(h)
+            except Exception:
+                self.pool.release(h)
+            return True
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "swallow") is None
+
+
+def test_swallow_needs_a_crossing():
+    # a plain normal exit is not a swallow — no exception edge crossed
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            return h
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "swallow") is None
+
+
+# --------------------------------------------------------------- normal kind
+def test_normal_exit_leak_and_release_discharge():
+    leaky = build('''
+        def f(self):
+            h = self.pool.acquire()
+            self.prep(h)
+            return True
+    ''')
+    path = cfg_leak_path(leaky, site(leaky, "acquire"),
+                         released(leaky), "normal")
+    assert path is not None and path[-1] == leaky.EXIT
+
+    clean = build('''
+        def f(self):
+            h = self.pool.acquire()
+            self.prep(h)
+            self.pool.release(h)
+            return True
+    ''')
+    assert cfg_leak_path(clean, site(clean, "acquire"),
+                         released(clean), "normal") is None
+
+
+def test_return_unwinds_through_finally():
+    # ``return`` inside try/finally runs the finally copy first — the
+    # release there discharges the normal exit too
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            try:
+                return self.work(h)
+            finally:
+                self.pool.release(h)
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "normal") is None
+
+
+def test_break_unwinds_through_finally():
+    cfg = build('''
+        def f(self):
+            for x in self.items():
+                h = self.pool.acquire()
+                try:
+                    if self.bad(x):
+                        break
+                    self.work(h)
+                finally:
+                    self.pool.release(h)
+            return True
+    ''')
+    assert cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "normal") is None
+
+
+# ----------------------------------------------------- structure + reporting
+def test_with_statement_shape():
+    cfg = build('''
+        def f(self):
+            with self.pool.lease() as h:
+                self.work(h)
+    ''')
+    kinds = [n.kind for n in cfg.nodes]
+    assert "with" in kinds
+    # the exception-unwind __exit__ copy is must-execute cleanup
+    assert any(n.kind == "withexit" and n.cleanup for n in cfg.nodes)
+    # the with-node owns the context expression, so the acquire call
+    # lands on a "with"-kind node (OL12's skip condition)
+    assert cfg.nodes[site(cfg, "lease")].kind == "with"
+
+
+def test_describe_path_waypoints():
+    cfg = build('''
+        def f(self):
+            h = self.pool.acquire()
+            self.work(h)
+    ''')
+    path = cfg_leak_path(cfg, site(cfg, "acquire"), released(cfg),
+                         "escape")
+    trace = describe_path(cfg, path, "escape")
+    assert trace[0][1] == "acquired/entered here"
+    assert trace[-1][1] == "exception escapes the function"
+    assert all(isinstance(line, int) and line > 0 for line, _ in trace)
+    # the crossing waypoint names the statement the edge leaves from
+    assert any("exception edge" in note for _, note in trace)

@@ -55,3 +55,68 @@ def test_cli_exits_2_on_broken_manifest(monkeypatch, tmp_path):
     with pytest.raises(SystemExit) as exc:
         main([str(f)])
     assert exc.value.code == 2
+
+
+# ------------------------------------------- omnileak (OL12/OL13) manifests
+def test_renamed_protocol_release_spec_fails_loudly(monkeypatch):
+    proto = dict(m.RESOURCE_PROTOCOLS[0])
+    proto["name"] = "bogus-proto"
+    proto["release"] = ("kv.free_everything",)
+    monkeypatch.setattr(
+        m, "RESOURCE_PROTOCOLS", m.RESOURCE_PROTOCOLS + (proto,))
+    with pytest.raises(m.ManifestError, match="free_everything"):
+        m.validate_manifest()
+
+
+def test_renamed_protocol_carrier_class_fails_loudly(monkeypatch):
+    proto = dict(m.RESOURCE_PROTOCOLS[0])
+    proto["name"] = "bogus-proto"
+    proto["carrier"] = ("vllm_omni_tpu/core/kv_cache_manager.py"
+                       "::RenamedManager")
+    monkeypatch.setattr(
+        m, "RESOURCE_PROTOCOLS", m.RESOURCE_PROTOCOLS + (proto,))
+    with pytest.raises(m.ManifestError, match="RenamedManager"):
+        m.validate_manifest()
+
+
+def test_unknown_protocol_path_kind_fails_loudly(monkeypatch):
+    proto = dict(m.RESOURCE_PROTOCOLS[0])
+    proto["name"] = "bogus-proto"
+    proto["on"] = ("sideways",)
+    monkeypatch.setattr(
+        m, "RESOURCE_PROTOCOLS", m.RESOURCE_PROTOCOLS + (proto,))
+    with pytest.raises(m.ManifestError, match="sideways"):
+        m.validate_manifest()
+
+
+def test_renamed_machine_field_fails_loudly(monkeypatch):
+    mach = dict(m.STATE_MACHINES[0])
+    mach["name"] = "bogus-machine"
+    mach["field"] = "stage_renamed_away"
+    monkeypatch.setattr(
+        m, "STATE_MACHINES", m.STATE_MACHINES + (mach,))
+    with pytest.raises(m.ManifestError, match="stage_renamed_away"):
+        m.validate_manifest()
+
+
+def test_machine_transition_to_undeclared_state_fails_loudly(
+        monkeypatch):
+    mach = dict(m.STATE_MACHINES[0])
+    mach["name"] = "bogus-machine"
+    mach["transitions"] = dict(mach["transitions"],
+                               draining=("teleporting",))
+    monkeypatch.setattr(
+        m, "STATE_MACHINES", m.STATE_MACHINES + (mach,))
+    with pytest.raises(m.ManifestError, match="teleporting"):
+        m.validate_manifest()
+
+
+def test_renamed_machine_recover_fn_fails_loudly(monkeypatch):
+    mach = dict(m.STATE_MACHINES[0])
+    mach["name"] = "bogus-machine"
+    mach["recover"] = ("_abort_op_renamed_away",)
+    monkeypatch.setattr(
+        m, "STATE_MACHINES", m.STATE_MACHINES + (mach,))
+    with pytest.raises(m.ManifestError,
+                       match="_abort_op_renamed_away"):
+        m.validate_manifest()

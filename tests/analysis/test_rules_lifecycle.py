@@ -1,0 +1,277 @@
+"""OL12 resource-lifecycle: RESOURCE_PROTOCOLS acquire/release
+obligations checked per CFG path.  Semantics tests ride a toy protocol
+(overridden ``protocols`` class attr); the historical-bug section
+replays the PR 15 cooldown-consumed-by-failed-write bug against the
+REAL manifest — the fixture must fail exactly this family, and its
+fixed shape (the try/finally mirror of the in-tree code) must pass.
+"""
+
+from vllm_omni_tpu.analysis.engine import analyze_source, analyze_sources
+from vllm_omni_tpu.analysis.rules import ALL_RULES
+from vllm_omni_tpu.analysis.rules.resource_lifecycle import (
+    ResourceLifecycleRule,
+)
+from tests.analysis.util import messages
+
+TOY = {
+    "name": "toy-handle",
+    "carrier": "vllm_omni_tpu/core/kv_cache_manager.py::KVCacheManager",
+    "acquire": ("pool.acquire",),
+    "release": ("pool.release",),
+    "on": ("escape", "swallow", "normal"),
+}
+
+
+def make_rule(**overrides):
+    proto = dict(TOY, **overrides)
+
+    class _Rule(ResourceLifecycleRule):
+        protocols = (proto,)
+
+    return _Rule
+
+
+def lint12(src, path="vllm_omni_tpu/ops/fixture.py", **overrides):
+    found = analyze_source(src, path, rules=[make_rule(**overrides)])
+    return [f for f in found if f.rule == "OL12" and not f.suppressed]
+
+
+# ----------------------------------------------------------------- the kinds
+def test_escape_leak_flagged_with_trace():
+    src = '''
+def grab(self):
+    h = self.pool.acquire()
+    self.work(h)
+'''
+    found = lint12(src)
+    assert len(found) == 1, messages(found)
+    f = found[0]
+    assert "exception-escape" in f.message
+    assert "toy-handle" in f.message and "pool.acquire" in f.message
+    assert f.trace and f.trace[0][1] == "acquired/entered here"
+    # the chain report renders as indented waypoint lines
+    assert "exception escapes" in f.render()
+
+
+def test_try_finally_release_is_clean():
+    src = '''
+def grab(self):
+    h = self.pool.acquire()
+    try:
+        self.work(h)
+    finally:
+        self.pool.release(h)
+'''
+    assert lint12(src) == []
+
+
+def test_swallowed_abort_flagged_and_handler_release_clean():
+    src = '''
+def grab(self):
+    h = self.pool.acquire()
+    try:
+        self.work(h)
+    except Exception:
+        logger.error("boom")
+    return True
+'''
+    found = lint12(src, on=("swallow",))
+    assert len(found) == 1, messages(found)
+    assert "swallowed-exception" in found[0].message
+    fixed = src.replace('logger.error("boom")',
+                        'self.pool.release(h)')
+    assert lint12(fixed, on=("swallow",)) == []
+
+
+def test_normal_exit_leak_flagged():
+    src = '''
+def grab(self):
+    h = self.pool.acquire()
+    self.prep(h)
+    return True
+'''
+    found = lint12(src, on=("normal",))
+    assert len(found) == 1, messages(found)
+    assert "normal-exit" in found[0].message
+
+
+# ------------------------------------------------------------ the discharges
+def test_with_acquire_is_auto_discharged():
+    src = '''
+def grab(self):
+    with self.pool.acquire() as h:
+        self.work(h)
+'''
+    assert lint12(src) == []
+
+
+def test_release_through_helper_callee_is_seen():
+    src = '''
+def close_out(pool, h):
+    pool.release(h)
+
+def grab(self):
+    h = self.pool.acquire()
+    try:
+        self.work(h)
+    finally:
+        close_out(self.pool, h)
+'''
+    assert lint12(src) == []
+
+
+def test_escape_obligation_handed_up_to_releasing_caller():
+    # the acquiring helper leaks on escape — but a resolvable caller
+    # releases, so the obligation rides the propagating exception
+    src = '''
+def fetch(pool):
+    h = pool.acquire()
+    pool.prep(h)
+    return h
+
+def run(pool):
+    h = fetch(pool)
+    try:
+        use(h)
+    finally:
+        pool.release(h)
+'''
+    assert lint12(src, on=("escape",)) == []
+    orphan = src.replace("        pool.release(h)", "        pass")
+    found = lint12(orphan, on=("escape",))
+    assert len(found) == 1, messages(found)
+
+
+def test_normal_kind_return_and_store_transfer_ownership():
+    returned = '''
+def grab(self):
+    h = self.pool.acquire()
+    self.prep(h)
+    return h
+'''
+    assert lint12(returned, on=("normal",)) == []
+    stored = '''
+def grab(self):
+    h = self.pool.acquire()
+    self.live.append(h)
+    return True
+'''
+    assert lint12(stored, on=("normal",)) == []
+
+
+def test_carrier_class_methods_are_exempt():
+    # the carrier's own internals ARE the protocol implementation
+    src = '''
+class KVCacheManager:
+    def _refill(self):
+        h = self.pool.acquire()
+        self.work(h)
+'''
+    assert lint12(
+        src, path="vllm_omni_tpu/core/kv_cache_manager.py") == []
+
+
+def test_receiver_qualified_spec_needs_the_receiver():
+    src = '''
+def grab(self):
+    h = self.scratch.acquire()
+    self.work(h)
+'''
+    # "pool.acquire" must not match self.scratch.acquire
+    assert lint12(src) == []
+
+
+def test_reasoned_suppression_is_honoured():
+    src = '''
+def grab(self):
+    h = self.pool.acquire()  # omnilint: disable=OL12 - freed by GC sweep
+    self.work(h)
+'''
+    assert lint12(src) == []
+    found = analyze_source(src, "vllm_omni_tpu/ops/fixture.py",
+                           rules=[make_rule()])
+    assert any(f.rule == "OL12" and f.suppressed for f in found)
+
+
+# ----------------------------------- historical bug: PR 15 cooldown consume
+# The flight-recorder dump path once claimed the cooldown window
+# (cooldown.ready) and released it only in the OSError handler around
+# makedirs — any later failure (path build, open, json.dump) escaped
+# with the window consumed, muting dumps for the whole cooldown
+# interval after a transient write error.  Caught by OL12 against the
+# real dump-cooldown-window protocol; OL13 stays silent (exactly one
+# family owns this bug).
+
+PR15_BUGGY = '''
+import json
+import os
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class _Recorder:
+    def dump_to_file(self, doc):
+        key = self.cooldown.ready(doc.get("reason"))
+        if key is None:
+            return None
+        try:
+            os.makedirs(self.flight_dir, exist_ok=True)
+        except OSError as e:
+            logger.error("flight dir %s: %s", self.flight_dir, e)
+            self.cooldown.release(*key)
+            return None
+        path = self.build_path(doc)
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        logger.warning("dump written to %s", path)
+        return path
+'''
+
+PR15_FIXED = '''
+import json
+import os
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class _Recorder:
+    def dump_to_file(self, doc):
+        key = self.cooldown.ready(doc.get("reason"))
+        if key is None:
+            return None
+        written = None
+        try:
+            os.makedirs(self.flight_dir, exist_ok=True)
+            path = self.build_path(doc)
+            with open(path, "w") as fh:
+                json.dump(doc, fh)
+            written = path
+        except OSError as e:
+            logger.error("dump failed: %s", e)
+            return None
+        finally:
+            if written is None:
+                self.cooldown.release(*key)
+        return written
+'''
+
+_FIXTURE_PATH = "vllm_omni_tpu/introspection/fix_recorder.py"
+
+
+def _families(src):
+    found = analyze_sources({_FIXTURE_PATH: src}, rules=list(ALL_RULES))
+    return [f for f in found if f.rule in ("OL12", "OL13")
+            and not f.suppressed]
+
+
+def test_pr15_cooldown_bug_caught_by_ol12_only():
+    found = _families(PR15_BUGGY)
+    assert found, "the PR 15 bug shape must be caught"
+    assert {f.rule for f in found} == {"OL12"}, messages(found)
+    assert any("dump-cooldown-window" in f.message for f in found)
+
+
+def test_pr15_fixed_shape_is_clean():
+    assert _families(PR15_FIXED) == []

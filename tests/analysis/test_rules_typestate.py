@@ -1,0 +1,262 @@
+"""OL13 typestate: STATE_MACHINES transition validity + the
+generalized swallowed-abort check.  Semantics tests ride a toy machine
+(overridden ``machines`` class attr); the historical-bug section
+replays the PR 12 stranded-drained-donor bug against the REAL
+replica-rotation machine — the fixture must fail exactly this family,
+and its fixed shape (handler re-admits the donor) must pass.
+"""
+
+from vllm_omni_tpu.analysis.engine import analyze_source, analyze_sources
+from vllm_omni_tpu.analysis.rules import ALL_RULES
+from vllm_omni_tpu.analysis.rules.typestate import TypestateRule
+from tests.analysis.util import messages
+
+TOY = {
+    "name": "toy-job",
+    "class": "vllm_omni_tpu/core/kv_cache_manager.py::KVCacheManager",
+    "field": "stage",
+    "states": ("new", "running", "done"),
+    "transitions": {"new": ("running",), "running": ("done",)},
+    "terminal": ("done",),
+    "aliases": {"finished": "done"},
+    "recover": ("abort_job",),
+}
+
+
+def make_rule(**overrides):
+    mach = dict(TOY, **overrides)
+
+    class _Rule(TypestateRule):
+        machines = (mach,)
+
+    return _Rule
+
+# applicability rides the carrier-class import
+_PRELUDE = ("from vllm_omni_tpu.core.kv_cache_manager "
+            "import KVCacheManager\n")
+
+
+def lint13(src, path="vllm_omni_tpu/ops/fixture.py", prelude=_PRELUDE,
+           **overrides):
+    found = analyze_source(prelude + src, path,
+                           rules=[make_rule(**overrides)])
+    return [f for f in found if f.rule == "OL13" and not f.suppressed]
+
+
+# ---------------------------------------------------------------- validity
+def test_unknown_state_flagged():
+    found = lint13('''
+def kick(job):
+    job.stage = "zombie"
+''')
+    assert len(found) == 1, messages(found)
+    assert "unknown state 'zombie'" in found[0].message
+
+
+def test_invalid_transition_flagged_valid_one_clean():
+    bad = lint13('''
+def finish(job):
+    if job.stage == "new":
+        job.stage = "done"
+''')
+    assert len(bad) == 1, messages(bad)
+    assert "invalid transition 'new' -> 'done'" in bad[0].message
+    assert lint13('''
+def advance(job):
+    if job.stage == "new":
+        job.stage = "running"
+''') == []
+
+
+def test_module_constants_resolve():
+    found = lint13('''
+STAGE_NEW = "new"
+STAGE_DONE = "done"
+
+def finish(job):
+    if job.stage == STAGE_NEW:
+        job.stage = STAGE_DONE
+''')
+    assert len(found) == 1, messages(found)
+    assert "invalid transition" in found[0].message
+
+
+def test_alias_maps_writer_vocabulary():
+    # "finished" aliases to the canonical terminal "done"
+    assert lint13('''
+def finish(job):
+    if job.stage == "running":
+        job.stage = "finished"
+''') == []
+
+
+def test_unresolvable_value_is_out_of_model():
+    assert lint13('''
+def restore(job, snapshot):
+    job.stage = snapshot.stage_value
+''') == []
+
+
+def test_self_transition_is_allowed():
+    # re-asserting the current state (retry loops) is not an edge
+    assert lint13('''
+def retry(job):
+    if job.stage == "running":
+        job.stage = "running"
+''') == []
+
+
+# -------------------------------------------------------------- exemptions
+def test_init_and_carrier_methods_exempt():
+    assert lint13('''
+class Holder:
+    def __init__(self):
+        self.stage = "zombie"
+''') == []
+    carrier = '''
+class KVCacheManager:
+    def _reset(self):
+        self.stage = "zombie"
+'''
+    assert lint13(carrier,
+                  path="vllm_omni_tpu/core/kv_cache_manager.py") == []
+
+
+def test_transition_fn_machine():
+    overrides = {"transition_fn": "advance_to", "target_arg": 1}
+    found = lint13('''
+def kick(job):
+    advance_to(job, "zombie")
+''', **overrides)
+    assert len(found) == 1, messages(found)
+    assert "unknown state" in found[0].message
+    # the blessed transition function's own body is exempt
+    assert lint13('''
+def advance_to(job, state):
+    job.stage = state
+''', **overrides) == []
+
+
+def test_machine_only_applies_where_the_class_is_visible():
+    # no import, foreign path, no "field" match mode: out of scope
+    assert lint13('''
+def kick(job):
+    job.stage = "zombie"
+''', prelude="") == []
+
+
+# -------------------------------------------------------------- abort check
+ABORT = '''
+def flip(self, job):
+    job.stage = "running"
+    try:
+        self.do_flip(job)
+    except Exception:
+        logger.error("flip failed")
+        return False
+    return True
+'''
+
+
+def test_swallowed_abort_strands_non_terminal_state():
+    found = lint13(ABORT)
+    assert len(found) == 1, messages(found)
+    f = found[0]
+    assert "stranded" in f.message and "'running'" in f.message
+    assert f.trace, "abort findings carry the witness path"
+
+
+def test_recover_call_in_handler_clears_the_abort():
+    fixed = ABORT.replace('logger.error("flip failed")',
+                          "abort_job(job)")
+    assert lint13(fixed) == []
+
+
+def test_terminal_write_in_handler_clears_the_abort():
+    fixed = ABORT.replace('logger.error("flip failed")',
+                          'job.stage = "done"')
+    assert lint13(fixed) == []
+
+
+def test_terminal_state_write_needs_no_recovery():
+    assert lint13('''
+def finish(self, job):
+    job.stage = "done"
+    try:
+        self.notify(job)
+    except Exception:
+        logger.error("notify failed")
+    return True
+''') == []
+
+
+def test_escaping_exception_is_not_an_abort():
+    # un-swallowed: the obligation propagates with the exception, and
+    # the frame that swallows is the one judged
+    assert lint13('''
+def flip(self, job):
+    job.stage = "running"
+    self.do_flip(job)
+    return True
+''') == []
+
+
+# ------------------------------- historical bug: PR 12 stranded drained donor
+# An aborted re-role once drained the donor replica, hit a flip
+# failure, logged it, and returned — leaving a live replica out of
+# rotation forever while the caller saw an ordinary False.  Caught by
+# OL13 against the real replica-rotation flag machine (match:
+# "field"); OL12 stays silent (exactly one family owns this bug).
+
+PR12_BUGGY = '''
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def execute_rerole(router, replica, new_role):
+    replica.drained = True
+    try:
+        router.flip_role(replica, new_role)
+    except Exception:
+        logger.error("re-role of %s failed", replica.replica_id)
+        return False
+    return True
+'''
+
+PR12_FIXED = '''
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def execute_rerole(router, replica, new_role):
+    replica.drained = True
+    try:
+        router.flip_role(replica, new_role)
+    except Exception:
+        logger.error("re-role of %s failed", replica.replica_id)
+        router.undrain(replica.replica_id)
+        return False
+    return True
+'''
+
+_FIXTURE_PATH = "vllm_omni_tpu/disagg/fix_rerole.py"
+
+
+def _families(src):
+    found = analyze_sources({_FIXTURE_PATH: src}, rules=list(ALL_RULES))
+    return [f for f in found if f.rule in ("OL12", "OL13")
+            and not f.suppressed]
+
+
+def test_pr12_stranded_donor_caught_by_ol13_only():
+    found = _families(PR12_BUGGY)
+    assert found, "the PR 12 bug shape must be caught"
+    assert {f.rule for f in found} == {"OL13"}, messages(found)
+    assert any("replica-rotation" in f.message and "stranded"
+               in f.message for f in found)
+
+
+def test_pr12_fixed_shape_is_clean():
+    assert _families(PR12_FIXED) == []

@@ -79,3 +79,42 @@ def test_write_sarif_and_cli_hook(tmp_path):
     assert rc == 1  # the finding also fails the gate
     doc = json.loads(cli_out.read_text())
     assert [r["ruleId"] for r in doc["runs"][0]["results"]] == ["OL10"]
+
+
+def test_trace_waypoints_become_related_locations():
+    # OL12/OL13 chain reports ride relatedLocations so SARIF viewers
+    # render the leaking path like the text output does
+    from vllm_omni_tpu.analysis.rules.resource_lifecycle import (
+        ResourceLifecycleRule,
+    )
+
+    class _R(ResourceLifecycleRule):
+        protocols = ({
+            "name": "toy-handle",
+            "carrier": ("vllm_omni_tpu/core/kv_cache_manager.py"
+                        "::KVCacheManager"),
+            "acquire": ("pool.acquire",),
+            "release": ("pool.release",),
+            "on": ("escape",),
+        },)
+
+    src = '''
+def grab(self):
+    h = self.pool.acquire()
+    self.work(h)
+'''
+    findings = analyze_source(src, "vllm_omni_tpu/ops/fix.py",
+                              rules=[_R])
+    assert findings and findings[0].trace
+    result = to_sarif(findings)["runs"][0]["results"][0]
+    rel = result["relatedLocations"]
+    assert len(rel) == len(findings[0].trace)
+    for (line, note), loc in zip(findings[0].trace, rel):
+        assert loc["message"]["text"] == note
+        assert (loc["physicalLocation"]["region"]["startLine"]
+                == max(line, 1))
+        assert (loc["physicalLocation"]["artifactLocation"]["uri"]
+                == "vllm_omni_tpu/ops/fix.py")
+    # findings without a trace carry no relatedLocations key
+    plain = to_sarif(_findings())["runs"][0]["results"][0]
+    assert "relatedLocations" not in plain

@@ -205,3 +205,36 @@ def test_cli_refuses_rule_subset_audit(tmp_path, capsys):
         assert e.code == 2
     else:
         raise AssertionError("expected a usage error")
+
+
+# ---------------------------------------------- omnileak families (OL12/13)
+def test_live_ol12_suppression_is_not_stale():
+    src = '''
+def grab(self, reason):
+    key = self.cooldown.ready(reason)  # omnilint: disable=OL12 - fixture
+    self.work(key)
+'''
+    assert _audit(src) == []
+
+
+def test_dead_ol12_suppression_is_stale():
+    src = '''
+def grab(self):
+    x = self.count()  # omnilint: disable=OL12 - nothing acquired here
+    return x
+'''
+    stale = _audit(src)
+    assert len(stale) == 1 and stale[0][2] == "OL12"
+
+
+def test_live_ol13_suppression_is_not_stale():
+    src = '''
+def rerole(self, replica):
+    replica.drained = True  # omnilint: disable=OL13 - fixture
+    try:
+        self.flip(replica)
+    except Exception:
+        return False
+    return True
+'''
+    assert _audit(src) == []

@@ -62,6 +62,9 @@ def test_async_greedy_matches_sync(tiny_model):
     assert calls, "async engine never took the pipelined path"
 
 
+@pytest.mark.slow  # fast siblings: test_async_greedy_matches_sync pins
+#                    async/sync parity, oracle fixture [staggered_mixed]
+#                    pins staggered-wave streams bit-exactly
 def test_async_greedy_matches_sync_mixed_waves(tiny_model):
     """Staggered arrivals force repeated prefill (sync fallback) /
     decode (pipelined) transitions — the pipeline must drain and refill

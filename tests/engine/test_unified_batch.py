@@ -260,6 +260,9 @@ def test_async_unified_matches_sync_and_pipelines_prefills(tiny_model):
     assert "prefill" not in eng.async_fallback, eng.async_fallback
 
 
+@pytest.mark.slow  # fast siblings: test_async_eos_one_step_lag_rollback
+#                    pins overshoot discard + KV rewind, oracle fixture
+#                    [async_unified] pins async-unified stream parity
 def test_async_unified_stop_token_overshoot(tiny_model):
     """A stop token lands while the next (possibly mixed) step is in
     flight: the overshoot token is discarded, streams match sync, and

@@ -49,7 +49,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m vllm_omni_tpu.analysis",
         description="omnilint: JAX/TPU-aware static analysis "
-                    "(rules OL1-OL11; see docs/static_analysis.md)")
+                    "(rules OL1-OL13; see docs/static_analysis.md)")
     parser.add_argument("paths", nargs="*", default=["vllm_omni_tpu"],
                         help="files/directories to analyze "
                              "(default: vllm_omni_tpu)")
@@ -73,7 +73,9 @@ def main(argv=None) -> int:
                         help="comma-separated rule ids to run (e.g. "
                              "OL7,OL8,OL9 — scripts/racecheck.sh's "
                              "concurrency-only gate; OL10,OL11 — the "
-                             "omniflow families); default: all")
+                             "omniflow families; OL12,OL13 — the "
+                             "omnileak lifecycle families); "
+                             "default: all")
     parser.add_argument("--report-stale-suppressions", action="store_true",
                         help="audit mode: list `# omnilint: disable` "
                              "comments that no longer suppress any "

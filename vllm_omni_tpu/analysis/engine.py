@@ -62,6 +62,11 @@ class Finding:
     # line span of the enclosing statement: a suppression anywhere in it
     # applies (multi-line calls anchor findings on continuation lines)
     stmt_span: tuple = ()
+    # OL12/OL13 chain report: ((line, note), ...) waypoints of the
+    # leaking path (acquire site -> exception crossings -> escape
+    # point).  Rendering only — NOT part of the fingerprint, so the
+    # baseline survives path-shape churn from unrelated edits.
+    trace: tuple = ()
 
     @property
     def fingerprint(self) -> str:
@@ -71,8 +76,12 @@ class Finding:
         tag = (" [suppressed]" if self.suppressed
                else " [baselined]" if self.baselined else "")
         sym = f" ({self.symbol})" if self.symbol else ""
-        return (f"{self.path}:{self.line}: {self.rule}{tag} "
-                f"{self.message}{sym}")
+        out = (f"{self.path}:{self.line}: {self.rule}{tag} "
+               f"{self.message}{sym}")
+        if self.trace:
+            out += "".join(f"\n    {self.path}:{ln}: {note}"
+                           for ln, note in self.trace)
+        return out
 
 
 class FileContext:
@@ -514,6 +523,414 @@ def dotted_names(expr: ast.AST) -> set[str]:
             for i in range(1, len(parts) + 1):
                 out.add(".".join(parts[:i]))
     return out
+
+
+# ------------------------------------------------------- control-flow graph
+#
+# The path-sensitive substrate under the lifecycle families (OL12/OL13):
+# a statement-level intraprocedural CFG with EXCEPTION edges.  What the
+# reaching-defs pass (ProgramGraph) deliberately flattened — "which
+# paths can actually execute between these two statements" — is exactly
+# what resource-lifecycle checking needs: an acquire leaks precisely
+# when SOME path escapes the function without its release, and the
+# paths that matter most are the ones a stock linter cannot see at all,
+# the implicit gotos every call inside a ``try`` carries.
+#
+# Modeling decisions (each one a noise/recall trade documented in
+# docs/static_analysis.md):
+#
+# - every statement that contains a call (or ``raise``/``assert``) gets
+#   an exception edge to a per-``try`` DISPATCH node fanning out to the
+#   handlers, plus — unless some handler is a catch-all — onward to the
+#   enclosing dispatch and ultimately the synthetic RAISE exit;
+# - ``finally`` bodies are built TWICE: a normal-completion copy whose
+#   continuation is the code after the try, and an exception-unwind
+#   copy (marked ``cleanup``) whose continuation is the enclosing
+#   exception target.  Without the split, a normal-flow path could
+#   spuriously reach RAISE through the shared finally block;
+# - ``with`` is try/finally with a synthetic ``withexit`` cleanup node
+#   on both continuations (context managers are must-execute cleanup);
+# - logging calls (``logger.*``) are modeled as non-raising: handlers
+#   swallow, and counting them would put an exception edge under
+#   virtually every statement in the tree;
+# - loops get back edges and the visit-once search below is the
+#   bounded widening: each (node, crossed-exception) state is explored
+#   once, so cycles terminate and path count stays linear.
+
+
+_LOG_RECEIVERS = frozenset({"logger", "logging", "log"})
+_CATCH_ALL = frozenset({"Exception", "BaseException"})
+
+
+def scan_calls(trees) -> Iterable[ast.Call]:
+    """Calls in the given trees, skipping nested def/class/lambda
+    subtrees (they run on their own schedule, like ``own_nodes``)."""
+    stack = [t for t in trees if t is not None]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_log_call(call: ast.Call) -> bool:
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    base = f.value
+    term = (base.attr if isinstance(base, ast.Attribute)
+            else base.id if isinstance(base, ast.Name) else None)
+    return term in _LOG_RECEIVERS
+
+
+def _can_raise(owned) -> bool:
+    """Whether the expressions a CFG node owns can raise: any
+    non-logging call or ``await``.  Attribute/subscript/arithmetic
+    errors are deliberately out of model (noise)."""
+    stack = [t for t in owned if t is not None]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Await):
+            return True
+        if isinstance(node, ast.Call) and not _is_log_call(node):
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _catches_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        term = (n.attr if isinstance(n, ast.Attribute)
+                else n.id if isinstance(n, ast.Name) else None)
+        if term in _CATCH_ALL:
+            return True
+    return False
+
+
+class CFGNode:
+    """One CFG node.  ``owned`` is the expression set the node
+    evaluates (an ``if`` node owns its test, not its body — body
+    statements have their own nodes); ``cleanup`` marks nodes inside
+    an exception-unwind ``finally``/``with``-exit copy (must-execute
+    cleanup — a release there discharges escaping obligations)."""
+
+    __slots__ = ("kind", "stmt", "owned", "cleanup")
+
+    def __init__(self, kind, stmt=None, owned=(), cleanup=False):
+        self.kind = kind      # entry/exit/raise/stmt/dispatch/with/withexit
+        self.stmt = stmt
+        self.owned = tuple(owned)
+        self.cleanup = cleanup
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+
+@dataclass(frozen=True)
+class _Frame:
+    """Builder context: where exceptions, returns and break/continue
+    go from the current nesting."""
+
+    exc: int            # exception continuation (dispatch/cleanup/RAISE)
+    fins: tuple = ()    # enclosing finally bodies, innermost LAST
+    loop: Optional[tuple] = None   # (break target, continue target,
+    #                                 fin-stack depth at loop entry)
+
+
+class FunctionCFG:
+    """Intraprocedural CFG of one function with exception edges.
+    ``succs[i]`` is ``[(dst, kind)]`` with kind "normal" or "exc"."""
+
+    ENTRY, EXIT, RAISE = 0, 1, 2
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.nodes: list[CFGNode] = [CFGNode("entry", fn),
+                                     CFGNode("exit", fn),
+                                     CFGNode("raise", fn)]
+        self.succs: list[list[tuple]] = [[], [], []]
+        self._cleanup = 0
+        self._reach: dict[int, frozenset] = {}
+        entry = self._block(fn.body, self.EXIT, _Frame(exc=self.RAISE))
+        self.succs[self.ENTRY].append((entry, "normal"))
+
+    # ------------------------------------------------------------ building
+    def _new(self, kind, stmt=None, owned=()) -> int:
+        self.nodes.append(CFGNode(kind, stmt, owned,
+                                  cleanup=self._cleanup > 0))
+        self.succs.append([])
+        return len(self.nodes) - 1
+
+    def _block(self, stmts, nxt: int, fr: _Frame) -> int:
+        cur = nxt
+        for stmt in reversed(stmts):
+            cur = self._stmt(stmt, cur, fr)
+        return cur
+
+    def _cleanup_block(self, stmts, nxt: int, fr: _Frame) -> int:
+        self._cleanup += 1
+        try:
+            return self._block(stmts, nxt, fr)
+        finally:
+            self._cleanup -= 1
+
+    def _unwind(self, fins, target: int, fr: _Frame) -> int:
+        """Chain of finally copies a return/break/continue runs
+        through before reaching ``target`` (innermost executes first:
+        built backwards, outermost-first)."""
+        cur = target
+        for body in fins:               # fins holds innermost LAST
+            cur = self._cleanup_block(body, cur, fr)
+        return cur
+
+    def _simple(self, stmt, nxt: int, fr: _Frame, owned=None) -> int:
+        idx = self._new("stmt", stmt,
+                        [stmt] if owned is None else owned)
+        self.succs[idx].append((nxt, "normal"))
+        if _can_raise(self.nodes[idx].owned):
+            self.succs[idx].append((fr.exc, "exc"))
+        return idx
+
+    def _stmt(self, stmt, nxt: int, fr: _Frame) -> int:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return self._simple(stmt, nxt, fr,
+                                owned=stmt.decorator_list)
+        if isinstance(stmt, ast.Return):
+            idx = self._new("stmt", stmt, [stmt.value])
+            self.succs[idx].append(
+                (self._unwind(fr.fins, self.EXIT, fr), "normal"))
+            if _can_raise(self.nodes[idx].owned):
+                self.succs[idx].append((fr.exc, "exc"))
+            return idx
+        if isinstance(stmt, ast.Raise):
+            idx = self._new("stmt", stmt, [stmt.exc, stmt.cause])
+            self.succs[idx].append((fr.exc, "exc"))
+            return idx
+        if isinstance(stmt, ast.Assert):
+            idx = self._new("stmt", stmt, [stmt.test, stmt.msg])
+            self.succs[idx].append((nxt, "normal"))
+            self.succs[idx].append((fr.exc, "exc"))
+            return idx
+        if isinstance(stmt, (ast.Break, ast.Continue)) and fr.loop:
+            brk, cont, depth = fr.loop
+            target = brk if isinstance(stmt, ast.Break) else cont
+            idx = self._new("stmt", stmt)
+            self.succs[idx].append(
+                (self._unwind(fr.fins[depth:], target, fr), "normal"))
+            return idx
+        if isinstance(stmt, ast.If):
+            idx = self._new("stmt", stmt, [stmt.test])
+            self.succs[idx].append(
+                (self._block(stmt.body, nxt, fr), "normal"))
+            self.succs[idx].append(
+                (self._block(stmt.orelse, nxt, fr), "normal"))
+            if _can_raise(self.nodes[idx].owned):
+                self.succs[idx].append((fr.exc, "exc"))
+            return idx
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            owned = ([stmt.test] if isinstance(stmt, ast.While)
+                     else [stmt.iter])
+            idx = self._new("stmt", stmt, owned)
+            body_fr = replace_frame(fr, loop=(nxt, idx, len(fr.fins)))
+            body = self._block(stmt.body, idx, body_fr)
+            after = (self._block(stmt.orelse, nxt, fr)
+                     if stmt.orelse else nxt)
+            self.succs[idx].append((body, "normal"))
+            self.succs[idx].append((after, "normal"))
+            if _can_raise(owned):
+                self.succs[idx].append((fr.exc, "exc"))
+            return idx
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, nxt, fr)
+        if isinstance(stmt, ast.Try) or isinstance(
+                stmt, getattr(ast, "TryStar", ())):
+            return self._try(stmt, nxt, fr)
+        if isinstance(stmt, ast.Match):
+            idx = self._new("stmt", stmt, [stmt.subject])
+            matched = False
+            for case in stmt.cases:
+                self.succs[idx].append(
+                    (self._block(case.body, nxt, fr), "normal"))
+                if (isinstance(case.pattern, ast.MatchAs)
+                        and case.pattern.pattern is None):
+                    matched = True
+            if not matched:
+                self.succs[idx].append((nxt, "normal"))
+            if _can_raise(self.nodes[idx].owned):
+                self.succs[idx].append((fr.exc, "exc"))
+            return idx
+        return self._simple(stmt, nxt, fr)
+
+    def _with(self, stmt, nxt: int, fr: _Frame) -> int:
+        wexit_r = self._new("withexit", stmt)
+        self.nodes[wexit_r].cleanup = True
+        self.succs[wexit_r].append((fr.exc, "normal"))
+        wexit_n = self._new("withexit", stmt)
+        self.succs[wexit_n].append((nxt, "normal"))
+        body = self._block(stmt.body, wexit_n,
+                           replace_frame(fr, exc=wexit_r))
+        owned = [i.context_expr for i in stmt.items]
+        idx = self._new("with", stmt, owned)
+        self.succs[idx].append((body, "normal"))
+        if _can_raise(owned):
+            self.succs[idx].append((fr.exc, "exc"))
+        return idx
+
+    def _try(self, stmt, nxt: int, fr: _Frame) -> int:
+        fins = stmt.finalbody
+        # normal-completion finally copy -> code after the try;
+        # exception-unwind copy (cleanup) -> enclosing exception target
+        after_normal = self._block(fins, nxt, fr) if fins else nxt
+        f_raise = (self._cleanup_block(fins, fr.exc, fr)
+                   if fins else fr.exc)
+        inner_fins = fr.fins + ((fins,) if fins else ())
+        fr_handler = replace_frame(fr, exc=f_raise, fins=inner_fins)
+        dispatch = self._new("dispatch", stmt)
+        caught_all = False
+        for h in stmt.handlers:
+            h_idx = self._new("stmt", h, [h.type])
+            self.succs[h_idx].append(
+                (self._block(h.body, after_normal, fr_handler),
+                 "normal"))
+            self.succs[dispatch].append((h_idx, "normal"))
+            caught_all = caught_all or _catches_all(h)
+        if not caught_all:
+            self.succs[dispatch].append((f_raise, "normal"))
+        orelse = (self._block(stmt.orelse, after_normal, fr_handler)
+                  if stmt.orelse else after_normal)
+        return self._block(stmt.body, orelse,
+                           replace_frame(fr, exc=dispatch,
+                                         fins=inner_fins))
+
+    # ----------------------------------------------------------- querying
+    def reachable(self, start: int) -> frozenset:
+        """Node set reachable from ``start`` (memoized)."""
+        cached = self._reach.get(start)
+        if cached is not None:
+            return cached
+        seen = {start}
+        stack = [start]
+        while stack:
+            for dst, _ in self.succs[stack.pop()]:
+                if dst not in seen:
+                    seen.add(dst)
+                    stack.append(dst)
+        out = frozenset(seen)
+        self._reach[start] = out
+        return out
+
+    def call_sites(self) -> Iterable[tuple]:
+        """(node index, call) for every call each node owns — the
+        finally duplication means one source call can appear under
+        several nodes, and all of them must be checked."""
+        for idx, node in enumerate(self.nodes):
+            for call in scan_calls(node.owned):
+                yield idx, call
+
+
+def replace_frame(fr: _Frame, **kw) -> _Frame:
+    return replace(fr, **kw)
+
+
+def cfg_leak_path(cfg: FunctionCFG, start: int, is_discharge,
+                  kind: str) -> Optional[list]:
+    """First witness path (node-index list, ``start`` first) of the
+    given kind from ``start``'s NORMAL successors — exception edges out
+    of the start node itself don't count (if the acquire raised,
+    nothing was acquired).  Visit-once per (node, crossed-exception)
+    state is the bounded widening: loops terminate, cost stays linear.
+
+    - "escape": reaches the RAISE exit with no discharge node on the
+      path and no discharge inside a must-execute cleanup reachable
+      from any crossed exception edge (a release in a ``finally``
+      discharges the unwind even when a condition guards it);
+    - "swallow": crosses an exception edge whose handler side can
+      reach NO discharge at all, then still reaches the normal EXIT —
+      the swallowed-abort shape (the object/resource is stranded and
+      the function reports success);
+    - "normal": reaches EXIT along normal edges only, undischarged.
+    """
+    succs, nodes = cfg.succs, cfg.nodes
+
+    def exc_side_discharged(dst: int, cleanup_only: bool) -> bool:
+        return any((nodes[x].cleanup or not cleanup_only)
+                   and is_discharge(x)
+                   for x in cfg.reachable(dst))
+
+    target = cfg.RAISE if kind == "escape" else cfg.EXIT
+    init = [(dst, False) for dst, ek in succs[start] if ek == "normal"]
+    visited = set(init)
+    parent: dict[tuple, tuple] = {s: None for s in init}
+    stack = list(init)
+    while stack:
+        state = stack.pop()
+        n, crossed = state
+        if n != target and is_discharge(n):
+            continue
+        if n == target and (kind != "swallow" or crossed):
+            path = [n]
+            cur = parent[state]
+            while cur is not None:
+                path.append(cur[0])
+                cur = parent[cur]
+            path.append(start)
+            path.reverse()
+            return path
+        if n == target:
+            continue
+        for dst, ek in succs[n]:
+            nxt_crossed = crossed
+            if ek == "exc":
+                if kind == "normal":
+                    continue
+                if exc_side_discharged(dst,
+                                       cleanup_only=kind == "escape"):
+                    continue
+                nxt_crossed = True
+            nxt = (dst, nxt_crossed)
+            if nxt not in visited:
+                visited.add(nxt)
+                parent[nxt] = state
+                stack.append(nxt)
+    return None
+
+
+def describe_path(cfg: FunctionCFG, path: list, kind: str) -> tuple:
+    """Compress a witness path into (line, note) waypoints for the
+    chain report: the acquire site, each exception crossing, and the
+    escape point.  Rides ``Finding.trace`` (not the fingerprint)."""
+    out = [(cfg.nodes[path[0]].line, "acquired/entered here")]
+    for a, b in zip(path, path[1:]):
+        if any(dst == b and ek == "exc" for dst, ek in cfg.succs[a]):
+            line = cfg.nodes[a].line or out[-1][0]
+            out.append((line, "exception edge leaves here"))
+    last = path[-1]
+    end_note = ("exception escapes the function" if kind == "escape"
+                else "function exits normally — obligation dropped")
+    line = 0
+    for idx in reversed(path):
+        if cfg.nodes[idx].line:
+            line = cfg.nodes[idx].line
+            break
+    out.append((line, end_note))
+    # dedupe consecutive same-line waypoints, bound the length
+    compact: list = []
+    for wp in out:
+        if not compact or compact[-1] != wp:
+            compact.append(wp)
+    return tuple(compact[:8])
 
 
 class FunctionInfo:

@@ -354,6 +354,181 @@ RECOMPILE: dict[str, tuple[str, ...]] = {
 }
 
 
+# ---------------------------------------------------------------- omnileak
+# The OL12 resource-lifecycle manifest: acquire->release pairs whose
+# obligation the exception-edge CFG checks path-by-path.  Every entry is
+# a protocol this repo's review passes have already paid for once: the
+# PR 15 harvest found a failed dump write consuming the DumpCooldown
+# window and an un-closed host-tier park interval; PR 12's found an
+# aborted re-role stranding a drained donor; PR 9's found failover
+# ledger entries surviving revive.
+#
+# Spec shape (schema in docs/static_analysis.md):
+#   carrier  "path::Class" owning the protocol — the carrier's own
+#            methods ARE the implementation and are never judged;
+#   acquire/release/transfer
+#            call specs, "recv.method" or bare "method".  The receiver
+#            part substring-matches the call receiver's terminal name
+#            ("kv.allocate" matches self.kv.allocate and
+#            self.scheduler.kv.allocate, NOT recorder.allocate) —
+#            transfer marks ownership moving into a tracked container;
+#   on       which witness-path kinds to report:
+#            "escape"  — an exception leaves the function with the
+#                        obligation live (caller-owned resources: only
+#                        the acquiring frame can release);
+#            "swallow" — an exception is caught and the function exits
+#                        normally with no release reachable from the
+#                        handler (the stranded-state shape — valid for
+#                        registry-owned resources too, where a later
+#                        keyed cleanup covers ordinary escapes);
+#            "normal"  — a normal path drops the obligation (strictest;
+#                        no in-tree protocol needs it, tests use it).
+RESOURCE_PROTOCOLS: tuple[dict, ...] = (
+    {
+        # paged KV page-table entries: registry-owned (the manager
+        # tracks pages per request; abort/finish free by request id),
+        # so only a swallowed failure that reports success leaks
+        "name": "kv-page-table",
+        "carrier": "vllm_omni_tpu/core/kv_cache_manager.py"
+                   "::KVCacheManager",
+        "acquire": ("kv.allocate", "kv.adopt_streamed"),
+        "release": ("kv.free", "kv.restore_truncated"),
+        "on": ("swallow",),
+    },
+    {
+        # cross-tier transfer pins: pinned pages survive free() until
+        # acked, so a swallowed transfer failure pins HBM forever
+        "name": "kv-transfer-pin",
+        "carrier": "vllm_omni_tpu/core/kv_cache_manager.py"
+                   "::KVCacheManager",
+        "acquire": ("kv.pin_for_transfer",),
+        "release": ("kv.ack_transfer",),
+        "on": ("swallow",),
+    },
+    {
+        # host-tier park intervals (the PR 15 un-closed interval bug):
+        # every parked request must be restored or dropped
+        "name": "kv-park-interval",
+        "carrier": "vllm_omni_tpu/core/kv_cache_manager.py"
+                   "::KVCacheManager",
+        "acquire": ("kv.park_request",),
+        "release": ("kv.restore_parked", "kv.drop_park"),
+        "on": ("swallow",),
+    },
+    {
+        # the flight-recorder dump window: caller-owned — ready()
+        # atomically reserves the cooldown window and ONLY the
+        # acquiring frame can roll it back, so an escaping exception
+        # after a successful ready() suppresses evidence capture for
+        # the whole cooldown period (the PR 15 consumed-window bug)
+        "name": "dump-cooldown-window",
+        "carrier": "vllm_omni_tpu/introspection/flight_recorder.py"
+                   "::DumpCooldown",
+        "acquire": ("cooldown.ready",),
+        "release": ("cooldown.release",),
+        "on": ("swallow", "escape"),
+    },
+    {
+        # router drain: a drained replica serves nothing until
+        # undrained or removed — the PR 12 stranded-donor resource
+        "name": "router-drain",
+        "carrier": "vllm_omni_tpu/disagg/router.py::DisaggRouter",
+        "acquire": ("router.drain",),
+        "release": ("router.undrain", "router.remove_replica"),
+        "on": ("swallow", "escape"),
+    },
+    {
+        # exactly-once failover submission ledger: an entry nothing
+        # clears replays or suppresses a request forever (PR 9)
+        "name": "failover-submission-ledger",
+        "carrier": "vllm_omni_tpu/disagg/router.py::EngineReplica",
+        "acquire": ("_submitted.add",),
+        "release": ("_submitted.discard", "_submitted.clear"),
+        "on": ("swallow",),
+    },
+)
+
+# The OL13 typestate manifest: declared state machines whose mutation
+# sites the CFG checks against the transition graph, plus the
+# generalized PR 12 abort check — a non-terminal state write followed
+# by a swallowed exception path from which no recovery transition is
+# reachable strands the object.
+#
+# Spec shape:
+#   class       "path::Class" carrying the state field (the class's own
+#               methods are exempt — they ARE the machine);
+#   field       the attribute holding the state;
+#   states/transitions/terminal
+#               the graph; ``aliases`` maps writer-vocabulary names to
+#               canonical states ("resolved" -> "inactive");
+#   values      for boolean flag machines: {True: name, False: name};
+#   transition_fn
+#               mutations also happen through calls to this method
+#               (target = positional arg ``target_arg``), and ITS body
+#               is exempt (it is the one blessed mutation site);
+#   recover     call vocabulary that re-admits/rolls back — reaching
+#               one from a swallowed handler discharges the abort
+#               check;
+#   match       "class" (default: the file must define/import the
+#               class or its module) or "field" (any assignment of the
+#               field counts — for distinctive field names whose
+#               carrier instances travel between modules).
+STATE_MACHINES: tuple[dict, ...] = (
+    {
+        # the control-plane operation ladder (rerole/scale_down), with
+        # the bounded actuation-refused retry edges back to draining
+        "name": "controlplane-op",
+        "class": "vllm_omni_tpu/controlplane/controller.py::_Op",
+        "field": "stage",
+        "states": ("draining", "flipping", "readmitting", "removing"),
+        "transitions": {
+            "draining": ("flipping", "removing"),
+            "flipping": ("readmitting", "draining"),
+            "removing": ("draining",),
+            "readmitting": (),
+        },
+        "terminal": (),
+        "recover": ("_abort_op", "_finish_op"),
+    },
+    {
+        # the alert lifecycle ring; "resolved" is writer vocabulary
+        # for the inactive state (the transition doc keeps the word)
+        "name": "alert-lifecycle",
+        "class": "vllm_omni_tpu/metrics/alerts.py::_RuleState",
+        "field": "state",
+        "states": ("inactive", "pending", "firing"),
+        "aliases": {"resolved": "inactive"},
+        "transitions": {
+            "inactive": ("pending", "firing"),
+            "pending": ("firing", "inactive"),
+            "firing": ("inactive",),
+        },
+        "terminal": ("inactive",),
+        "transition_fn": "_transition",
+        "target_arg": 1,
+        "recover": (),
+    },
+    {
+        # replica rotation membership as a two-state machine: drained
+        # is the non-terminal "someone must re-admit or remove me"
+        # state (the PR 12 stranded-donor bug, generalized)
+        "name": "replica-rotation",
+        "class": "vllm_omni_tpu/disagg/router.py::EngineReplica",
+        "field": "drained",
+        "values": {True: "drained", False: "in-rotation"},
+        "states": ("drained", "in-rotation"),
+        "transitions": {
+            "drained": ("in-rotation",),
+            "in-rotation": ("drained",),
+        },
+        "terminal": ("in-rotation",),
+        "recover": ("undrain", "remove_replica", "revive",
+                    "_abort_op"),
+        "match": "field",
+    },
+)
+
+
 class ManifestError(RuntimeError):
     """A manifest entry no longer resolves to real code — a renamed
     module/class must fail the lint run loudly, not silently un-lint
@@ -414,6 +589,99 @@ def validate_manifest(root: "str | None" = None) -> None:
             src = fh.read()
         if f"def {fn}(" not in src:
             problems.append(f"SANITIZERS: no def '{fn}' in {path}")
+
+    # ---- omnileak (OL12/OL13): every acquire/release/transfer spec,
+    # state, transition endpoint and recover name must resolve to real
+    # code — a renamed method must fail the run, not silently un-lint
+    # the protocol it used to guard
+    import re as _re
+
+    def read_class_src(key: str, table: str) -> "str | None":
+        path, _, cls = key.partition("::")
+        p = check_path(path, table)
+        if p is None:
+            return None
+        with open(p, encoding="utf-8") as fh:
+            src = fh.read()
+        if not _re.search(rf"^\s*class\s+{_re.escape(cls)}\b", src,
+                          _re.MULTILINE):
+            problems.append(f"{table}: no class '{cls}' in {path}")
+            return None
+        return src
+
+    def def_somewhere(name: str) -> bool:
+        """``def name(`` anywhere under the package tree — recover
+        vocabularies cross modules (the controller re-admits what the
+        router drained)."""
+        pkg = os.path.join(root, "vllm_omni_tpu")
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in filenames:
+                if not f.endswith(".py"):
+                    continue
+                with open(os.path.join(dirpath, f),
+                          encoding="utf-8") as fh:
+                    if f"def {name}(" in fh.read():
+                        return True
+        return False
+
+    for proto in RESOURCE_PROTOCOLS:
+        tag = f"RESOURCE_PROTOCOLS[{proto.get('name', '?')}]"
+        src = read_class_src(proto["carrier"], tag)
+        for kind in proto.get("on", ()):
+            if kind not in ("escape", "swallow", "normal"):
+                problems.append(f"{tag}: unknown path kind {kind!r}")
+        if src is None:
+            continue
+        for spec in (proto.get("acquire", ()) + proto.get("release", ())
+                     + proto.get("transfer", ())):
+            recv, _, meth = spec.rpartition(".")
+            if f"def {meth}(" in src:
+                continue
+            # container protocols (``_submitted.add``): the method is
+            # a builtin, the receiver must be a carrier attribute
+            if recv and f"self.{recv}" in src:
+                continue
+            problems.append(
+                f"{tag}: spec '{spec}' resolves to neither a def nor "
+                f"a carrier attribute in {proto['carrier']}")
+    for mach in STATE_MACHINES:
+        tag = f"STATE_MACHINES[{mach.get('name', '?')}]"
+        src = read_class_src(mach["class"], tag)
+        if src is None:
+            continue
+        field = mach["field"]
+        if not _re.search(rf"\b{_re.escape(field)}\b\s*[:=]", src):
+            problems.append(
+                f"{tag}: field '{field}' never assigned/declared in "
+                f"{mach['class'].partition('::')[0]}")
+        states = tuple(mach.get("states", ()))
+        if not mach.get("values"):
+            for st in states:
+                if f'"{st}"' not in src and f"'{st}'" not in src:
+                    problems.append(
+                        f"{tag}: state {st!r} never appears in "
+                        f"{mach['class'].partition('::')[0]}")
+        for src_st, dsts in mach.get("transitions", {}).items():
+            for st in (src_st,) + tuple(dsts):
+                if st not in states:
+                    problems.append(
+                        f"{tag}: transition endpoint {st!r} not in "
+                        f"states")
+        for st in tuple(mach.get("terminal", ())) + tuple(
+                mach.get("aliases", {}).values()):
+            if st not in states:
+                problems.append(f"{tag}: state {st!r} not in states")
+        fn = mach.get("transition_fn")
+        if fn and f"def {fn}(" not in src:
+            problems.append(
+                f"{tag}: no def '{fn}' in "
+                f"{mach['class'].partition('::')[0]}")
+        for name in mach.get("recover", ()):
+            if not def_somewhere(name):
+                problems.append(
+                    f"{tag}: recover '{name}' is not a def anywhere "
+                    f"under vllm_omni_tpu/")
     if problems:
         raise ManifestError(
             "manifest entries no longer resolve (a rename must update "

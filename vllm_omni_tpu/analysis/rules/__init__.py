@@ -19,13 +19,20 @@
 |     |                     | without a declared SANITIZER crossing      |
 | OL11| recompile-hazard    | jit cache keys bucketed, dispatch variants |
 |     |                     | in the key, every kind warmup-reachable    |
+| OL12| resource-lifecycle  | RESOURCE_PROTOCOLS acquire/release pairs   |
+|     |                     | discharged on every CFG path (exc edges)   |
+| OL13| typestate           | STATE_MACHINES transition validity + the   |
+|     |                     | swallowed-abort stranded-state check       |
 
 OL7-OL9 ("omnirace") have a runtime counterpart in
 ``analysis/runtime.py`` — traced locks that detect order inversions and
 wait cycles live under ``OMNI_TPU_LOCK_CHECK=1``.  OL10/OL11
 ("omniflow") are package-wide: they run at ``finalize_run`` over the
 whole run's ProgramGraph (symbol table + cross-module call graph)
-instead of one file at a time.
+instead of one file at a time.  OL12/OL13 ("omnileak") add the
+path-sensitive layer: an intraprocedural CFG with exception edges
+(engine ``FunctionCFG``) checks resource acquire/release obligations
+and declared state machines along every path, normal or aborting.
 """
 
 from vllm_omni_tpu.analysis.rules.blocking_under_lock import (
@@ -40,8 +47,12 @@ from vllm_omni_tpu.analysis.rules.metric_drift import MetricDriftRule
 from vllm_omni_tpu.analysis.rules.recompile_hazard import (
     RecompileHazardRule,
 )
+from vllm_omni_tpu.analysis.rules.resource_lifecycle import (
+    ResourceLifecycleRule,
+)
 from vllm_omni_tpu.analysis.rules.stage_protocol import StageProtocolRule
 from vllm_omni_tpu.analysis.rules.taint_flow import TaintFlowRule
+from vllm_omni_tpu.analysis.rules.typestate import TypestateRule
 from vllm_omni_tpu.analysis.rules.wallclock import WallClockRule
 
 ALL_RULES: tuple[type, ...] = (
@@ -56,6 +67,8 @@ ALL_RULES: tuple[type, ...] = (
     BlockingUnderLockRule,
     TaintFlowRule,
     RecompileHazardRule,
+    ResourceLifecycleRule,
+    TypestateRule,
 )
 
 __all__ = [
@@ -71,4 +84,6 @@ __all__ = [
     "BlockingUnderLockRule",
     "TaintFlowRule",
     "RecompileHazardRule",
+    "ResourceLifecycleRule",
+    "TypestateRule",
 ]

@@ -1,0 +1,282 @@
+"""OL12 — resource-lifecycle: acquire/release pairs checked path-wise.
+
+The most expensive recurring bug class in this repo is invisible to
+OL1-OL11: a resource acquired and then leaked on an abort/exception
+path.  PR 12's review pass found an aborted re-role stranding a
+drained donor out of rotation forever; PR 15's found a failed dump
+write consuming the flight-recorder cooldown window and an un-closed
+host-tier park interval; PR 9's found failover ledger entries
+surviving revive.  Every one was caught by a human re-reading diffs.
+This rule encodes the harvest: the ``RESOURCE_PROTOCOLS`` manifest
+(analysis/manifest.py) declares each acquire->release pair with its
+carrier class, and the exception-edge CFG (engine ``FunctionCFG``)
+asks, per acquire site, whether some path — normal OR exception —
+escapes the function with the obligation still live.
+
+What discharges an obligation on a path:
+
+- a release (or declared ownership ``transfer``) call on the path,
+  matched by receiver-qualified spec ("kv.free" matches
+  ``self.kv.free`` and ``self.scheduler.kv.free``);
+- a call resolving (cross-module, bounded depth) to a helper whose
+  body releases;
+- a release inside a must-execute cleanup (``finally`` unwind copy /
+  ``with`` exit) reachable from the crossed exception edge — a
+  condition guarding the release inside a ``finally`` is the author's
+  explicit intent, not a leak;
+- acquisition as a ``with`` context expression (``__exit__`` is the
+  release);
+- for "escape" protocols, a hand-off UP the PR 14 call graph: some
+  resolvable caller (bounded depth) releases, so the obligation
+  propagates with the exception;
+- for "normal" protocols, a hand-off OUT: returning the acquired
+  value or storing it into a tracked container transfers ownership.
+
+Like OL8/OL10, the finding is a chain report: the acquire site
+anchors it, and ``Finding.trace`` carries the leaking path's
+waypoints (exception crossings, escape point) into the text renderer
+and SARIF ``relatedLocations``.  A leak that is safe for a reason the
+rule cannot see carries a reasoned suppression::
+
+    self.kv.allocate(req, n)  # omnilint: disable=OL12 - freed by GC sweep
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import replace
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    FunctionCFG,
+    ProgramGraph,
+    Rule,
+    cfg_leak_path,
+    describe_path,
+    own_nodes,
+    scan_calls,
+)
+from vllm_omni_tpu.analysis.manifest import RESOURCE_PROTOCOLS
+from vllm_omni_tpu.analysis.rules._lockinfo import callee_terminal
+
+# report priority when a site leaks several ways: the sharpest first,
+# one finding per (site, protocol)
+_KIND_ORDER = ("escape", "swallow", "normal")
+_KIND_WORD = {
+    "escape": "exception-escape",
+    "swallow": "swallowed-exception",
+    "normal": "normal-exit",
+}
+# container mutators that count as "ownership transfer into a tracked
+# container" for normal-path protocols
+_STORE_METHODS = frozenset({"append", "add", "put", "setdefault",
+                            "insert"})
+
+
+def _receiver_terminal(func: ast.AST) -> Optional[str]:
+    """Terminal name of a method call's receiver:
+    ``self.scheduler.kv.free`` -> "kv", ``router.drain`` -> "router"."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    base = func.value
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    return None
+
+
+def spec_match(call: ast.Call, spec: str) -> bool:
+    """Whether a call matches a "recv.method" / "method" spec — the
+    receiver part substring-matches the receiver's terminal name."""
+    recv, _, meth = spec.rpartition(".")
+    if callee_terminal(call.func) != meth:
+        return False
+    if not recv:
+        return True
+    term = _receiver_terminal(call.func)
+    return term is not None and recv in term
+
+
+def _names_in(expr: ast.AST) -> set:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+class ResourceLifecycleRule(Rule):
+    id = "OL12"
+    name = "resource-lifecycle"
+    node_types = ()
+    # overridable in tests
+    protocols = RESOURCE_PROTOCOLS
+    CALLEE_DEPTH = 2   # release hidden inside a helper chain
+    CALLER_DEPTH = 2   # obligation handed up to a releasing caller
+
+    def applies(self, ctx: FileContext) -> bool:
+        return False  # package-wide: everything happens in finalize_run
+
+    # ------------------------------------------------------------ finalize
+    def finalize_run(self) -> Iterable[Finding]:
+        graph = ProgramGraph.ensure(self.run_state)
+        self._graph = graph
+        self._rel_memo: dict = {}
+        self._up_memo: dict = {}
+        seen: dict = {}
+        for key in sorted(graph.functions):
+            fi = graph.functions[key]
+            hits = self._acquire_sites(fi)
+            if not hits:
+                continue
+            cfg = FunctionCFG(fi.node)
+            by_call: dict = {}
+            for idx, call in cfg.call_sites():
+                by_call.setdefault(id(call), []).append(idx)
+            for proto, call, spec in hits:
+                for f in self._check_site(fi, cfg, proto, call, spec,
+                                          by_call.get(id(call), ())):
+                    seen.setdefault((f.path, f.line, f.message), f)
+        return [seen[k] for k in sorted(seen)]
+
+    # ------------------------------------------------------------ scanning
+    def _is_carrier(self, fi, proto) -> bool:
+        path, _, cls = proto["carrier"].partition("::")
+        return fi.path == path and fi.cls_name == cls.split(".")[-1]
+
+    def _acquire_sites(self, fi) -> list:
+        out = []
+        for node in own_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for proto in self.protocols:
+                if self._is_carrier(fi, proto):
+                    continue
+                for spec in proto.get("acquire", ()):
+                    if spec_match(node, spec):
+                        out.append((proto, node, spec))
+                        break
+        return out
+
+    # ----------------------------------------------------------- discharge
+    def _releases_within(self, fi, proto, depth: int) -> bool:
+        """Whether ``fi``'s body releases/transfers the protocol,
+        directly or through resolvable helpers (bounded)."""
+        key = (proto["name"], fi.key, depth)
+        if key in self._rel_memo:
+            return self._rel_memo[key]
+        self._rel_memo[key] = False  # recursion guard
+        specs = (proto.get("release", ())
+                 + proto.get("transfer", ()))
+        result = False
+        for node in own_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(spec_match(node, s) for s in specs):
+                result = True
+                break
+            if depth > 0:
+                callee = self._graph.resolve_call(node, fi.ctx)
+                if callee is not None and self._releases_within(
+                        callee, proto, depth - 1):
+                    result = True
+                    break
+        self._rel_memo[key] = result
+        return result
+
+    def _handed_up(self, fi, proto) -> bool:
+        """Escape discharge through the call graph: some resolvable
+        caller (bounded depth) releases, so the obligation rides the
+        propagating exception to a frame that settles it."""
+        key = (proto["name"], fi.key)
+        if key in self._up_memo:
+            return self._up_memo[key]
+        self._up_memo[key] = False
+        frontier, result = [fi.key], False
+        for _ in range(self.CALLER_DEPTH):
+            nxt = []
+            for fkey in frontier:
+                for caller, _call in self._graph.callers_of(fkey):
+                    if self._releases_within(caller, proto, 0):
+                        result = True
+                        break
+                    nxt.append(caller.key)
+                if result:
+                    break
+            if result or not nxt:
+                break
+            frontier = nxt
+        self._up_memo[key] = result
+        return result
+
+    def _discharge_fn(self, fi, cfg, proto, kind, acquired_names):
+        """Per-node discharge predicate for one (function, protocol)
+        pair, memoized — the path search and the exception-side
+        reachability scans call it many times per node."""
+        specs = proto.get("release", ()) + proto.get("transfer", ())
+        memo: dict = {}
+
+        def dis(idx: int) -> bool:
+            if idx in memo:
+                return memo[idx]
+            memo[idx] = False
+            node = cfg.nodes[idx]
+            result = False
+            for call in scan_calls(node.owned):
+                if any(spec_match(call, s) for s in specs):
+                    result = True
+                    break
+                if kind == "normal" and acquired_names \
+                        and callee_terminal(call.func) in _STORE_METHODS \
+                        and any(_names_in(a) & acquired_names
+                                for a in call.args):
+                    result = True  # ownership into a tracked container
+                    break
+                callee = self._graph.resolve_call(call, fi.ctx)
+                if callee is not None and self._releases_within(
+                        callee, proto, self.CALLEE_DEPTH - 1):
+                    result = True
+                    break
+            if not result and kind == "normal" and acquired_names:
+                stmt = node.stmt
+                if (isinstance(stmt, ast.Return) and stmt.value is not None
+                        and _names_in(stmt.value) & acquired_names):
+                    result = True  # ownership returned to the caller
+            memo[idx] = result
+            return result
+
+        return dis
+
+    # ------------------------------------------------------------ checking
+    def _check_site(self, fi, cfg, proto, call, spec,
+                    node_idxs) -> Iterable[Finding]:
+        acquired_names: set = set()
+        stmt = None
+        for idx in node_idxs:
+            stmt = cfg.nodes[idx].stmt or stmt
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    acquired_names.add(t.id)
+        kinds = [k for k in _KIND_ORDER if k in proto.get("on", ())]
+        for idx in node_idxs:
+            if cfg.nodes[idx].kind == "with":
+                continue  # context-manager acquire: __exit__ releases
+            for kind in kinds:
+                if kind == "escape" and self._handed_up(fi, proto):
+                    continue
+                dis = self._discharge_fn(fi, cfg, proto, kind,
+                                         acquired_names)
+                path = cfg_leak_path(cfg, idx, dis, kind)
+                if path is None:
+                    continue
+                rels = "/".join(
+                    f"'{s}'" for s in proto.get("release", ()))
+                art = "an" if _KIND_WORD[kind][0] in "aeiou" else "a"
+                msg = (f"{proto['name']}: '{spec}' acquired here can "
+                       f"leak on {art} {_KIND_WORD[kind]} path — no {rels} "
+                       f"on the way out (release in a finally/handler, "
+                       f"hand the obligation to a releasing caller, or "
+                       f"transfer ownership)")
+                f = fi.ctx.finding("OL12", call, msg)
+                yield replace(f, trace=describe_path(cfg, path, kind))
+                return  # one finding per site: the sharpest kind wins

@@ -1,0 +1,283 @@
+"""OL13 — typestate: declared state machines checked at mutation sites.
+
+The ``STATE_MACHINES`` manifest (analysis/manifest.py) declares the
+multi-step protocols this repo's reviews keep re-deriving by hand: the
+control-plane operation ladder (draining -> flipping -> readmitting,
+with bounded retry edges), the alert lifecycle ring
+(inactive -> pending -> firing -> resolved), and replica rotation
+membership as a two-state flag machine.  The rule checks two things:
+
+- **transition validity** — every mutation site of a declared state
+  field (attribute assignment, or a call to the machine's blessed
+  ``transition_fn``) whose source state is recoverable from an
+  enclosing ``if obj.field == STATE`` comparison must follow a
+  declared edge; any resolvable target must be a declared state.
+  Module-level ``STATE_X = "literal"`` constants resolve; aliases map
+  writer vocabulary ("resolved") to canonical states.
+- **the generalized PR 12 abort check** — a mutation to a
+  NON-terminal state followed by a CFG path that crosses an exception
+  edge, gets swallowed, and exits the function normally with no
+  recovery reachable from the handler side strands the object: the
+  function reports success while the protocol can never finish
+  (exactly how an aborted re-role once left a live donor drained
+  forever).  Recovery is reaching any declared ``recover`` call, a
+  terminal-state write to the same field, or a ``transition_fn`` call
+  to a terminal state.  Escaping (un-swallowed) exceptions are NOT
+  flagged: the obligation propagates, and the frame that swallows is
+  the one judged.
+
+Exempt by construction: ``__init__`` (the initial state write), the
+carrier class's own methods, and the ``transition_fn`` body (it is
+the one blessed mutation site).  The machine applies to a file that
+defines or imports the carrier class (or its module) — or, with
+``match: "field"``, to any file assigning the field, for distinctive
+fields whose carrier instances travel between modules.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import replace
+from typing import Iterable, Optional
+
+from vllm_omni_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    FunctionCFG,
+    ProgramGraph,
+    Rule,
+    cfg_leak_path,
+    describe_path,
+    scan_calls,
+)
+from vllm_omni_tpu.analysis.manifest import STATE_MACHINES
+from vllm_omni_tpu.analysis.rules._lockinfo import callee_terminal
+
+
+def _module_constants(tree: ast.Module) -> dict:
+    """Module-level ``NAME = "literal"`` string constants."""
+    out: dict = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value.value
+    return out
+
+
+class TypestateRule(Rule):
+    id = "OL13"
+    name = "typestate"
+    node_types = ()
+    # overridable in tests
+    machines = STATE_MACHINES
+
+    # -------------------------------------------------------------- finish
+    def finish(self, ctx: FileContext) -> Iterable[Finding]:
+        consts = _module_constants(ctx.tree)
+        defined = {n.name for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.ClassDef)}
+        imported_names: set = set()
+        imported_mods: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                imported_names.update(a.asname or a.name
+                                      for a in node.names)
+                if node.module:
+                    imported_mods.add(node.module)
+            elif isinstance(node, ast.Import):
+                imported_mods.update(a.name for a in node.names)
+        self._cfgs: dict = {}
+        out: list = []
+        for mach in self.machines:
+            if self._applicable(mach, ctx, defined, imported_names,
+                                imported_mods):
+                out.extend(self._check_machine(mach, ctx, consts))
+        return out
+
+    def _applicable(self, mach, ctx, defined, imported_names,
+                    imported_mods) -> bool:
+        path, _, qual = mach["class"].partition("::")
+        cls = qual.split(".")[-1]
+        if ctx.path == path or cls in defined or cls in imported_names:
+            return True
+        if ProgramGraph.module_name(path) in imported_mods:
+            return True
+        if mach.get("match") == "field":
+            field = mach["field"]
+            return any(isinstance(n, ast.Attribute) and n.attr == field
+                       and isinstance(n.ctx, ast.Store)
+                       for n in ast.walk(ctx.tree))
+        return False
+
+    # ----------------------------------------------------------- resolving
+    def _resolve_state(self, mach, expr, consts) -> Optional[str]:
+        """State name an expression resolves to, through module
+        constants, flag-machine values, and aliases.  None when the
+        value is not statically known."""
+        val = None
+        if isinstance(expr, ast.Constant):
+            val = expr.value
+        elif isinstance(expr, ast.Name):
+            val = consts.get(expr.id)
+            if val is None:
+                return None
+        else:
+            return None
+        values = mach.get("values")
+        if values is not None and val in values:
+            return values[val]
+        if not isinstance(val, str):
+            return None
+        return mach.get("aliases", {}).get(val, val)
+
+    def _governing_source(self, mach, node, ctx,
+                          consts) -> Optional[str]:
+        """Source state from the innermost enclosing
+        ``if obj.field == STATE`` the mutation sits in the BODY of."""
+        field = mach["field"]
+        cur = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+            if isinstance(anc, ast.If) and cur in anc.body:
+                for cmp_ in ast.walk(anc.test):
+                    if (isinstance(cmp_, ast.Compare)
+                            and len(cmp_.ops) == 1
+                            and isinstance(cmp_.ops[0], ast.Eq)
+                            and isinstance(cmp_.left, ast.Attribute)
+                            and cmp_.left.attr == field):
+                        src = self._resolve_state(
+                            mach, cmp_.comparators[0], consts)
+                        if src is not None:
+                            return src
+            cur = anc
+        return None
+
+    # ------------------------------------------------------------ checking
+    def _mutations(self, mach, ctx, consts) -> list:
+        """(anchor node, target state or None, enclosing fn) for every
+        judged mutation site of the machine's field."""
+        field = mach["field"]
+        fn_name = mach.get("transition_fn")
+        target_arg = mach.get("target_arg", 1)
+        cls = mach["class"].partition("::")[2].split(".")[-1]
+        out = []
+        for node in ast.walk(ctx.tree):
+            anchor = value = None
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and t.attr == field:
+                        anchor, value = t, node.value
+                        break
+            elif (fn_name and isinstance(node, ast.Call)
+                    and callee_terminal(node.func) == fn_name
+                    and len(node.args) > target_arg):
+                anchor, value = node, node.args[target_arg]
+            if anchor is None or value is None:
+                continue
+            fn = ctx.enclosing_function(anchor)
+            if fn is not None:
+                if fn.name in ("__init__", fn_name):
+                    continue
+                in_carrier = any(
+                    isinstance(a, ast.ClassDef) and a.name == cls
+                    for a in ctx.ancestors(fn))
+                # methods of the carrier class ARE the machine — but a
+                # closure or unrelated nested class stays judged
+                if in_carrier and ctx.enclosing_function(fn) is None:
+                    continue
+            state = self._resolve_state(mach, value, consts)
+            out.append((anchor, state, fn))
+        return out
+
+    def _recover_fn(self, mach, cfg, consts):
+        """Per-node recovery predicate for the abort check."""
+        field = mach["field"]
+        recover = set(mach.get("recover", ()))
+        terminal = set(mach.get("terminal", ()))
+        fn_name = mach.get("transition_fn")
+        target_arg = mach.get("target_arg", 1)
+
+        def rec(idx: int) -> bool:
+            node = cfg.nodes[idx]
+            for call in scan_calls(node.owned):
+                term = callee_terminal(call.func)
+                if term in recover:
+                    return True
+                if (fn_name and term == fn_name
+                        and len(call.args) > target_arg):
+                    st = self._resolve_state(mach,
+                                             call.args[target_arg],
+                                             consts)
+                    if st in terminal:
+                        return True
+            stmt = node.stmt
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == field:
+                        st = self._resolve_state(mach, stmt.value,
+                                                 consts)
+                        if st in terminal:
+                            return True
+            return False
+
+        return rec
+
+    def _check_machine(self, mach, ctx,
+                       consts) -> Iterable[Finding]:
+        name = mach["name"]
+        field = mach["field"]
+        states = set(mach.get("states", ()))
+        transitions = mach.get("transitions", {})
+        terminal = set(mach.get("terminal", ()))
+        for anchor, state, fn in self._mutations(mach, ctx, consts):
+            if state is None:
+                continue  # not statically resolvable: out of model
+            if state not in states:
+                yield ctx.finding(
+                    "OL13", anchor,
+                    f"typestate '{name}': {field} assigned unknown "
+                    f"state {state!r} (declared: "
+                    f"{', '.join(sorted(states))})")
+                continue
+            src = self._governing_source(mach, anchor, ctx, consts)
+            if src is not None and src in transitions \
+                    and state not in transitions[src] and state != src:
+                allowed = ", ".join(transitions[src]) or "none"
+                yield ctx.finding(
+                    "OL13", anchor,
+                    f"typestate '{name}': invalid transition {src!r} "
+                    f"-> {state!r} for {field} (allowed from {src!r}: "
+                    f"{allowed})")
+                continue
+            if fn is None or state in terminal:
+                continue
+            # the generalized PR 12 abort check
+            cfg = self._cfgs.get(id(fn))
+            if cfg is None:
+                cfg = self._cfgs[id(fn)] = FunctionCFG(fn)
+            stmt = ctx.enclosing_statement(anchor)
+            rec = self._recover_fn(mach, cfg, consts)
+            for idx, node in enumerate(cfg.nodes):
+                if node.stmt is not stmt:
+                    continue
+                path = cfg_leak_path(cfg, idx, rec, "swallow")
+                if path is None:
+                    continue
+                recs = ", ".join(mach.get("recover", ())) or \
+                    "no recover vocabulary declared"
+                f = ctx.finding(
+                    "OL13", anchor,
+                    f"typestate '{name}': {field} set to non-terminal "
+                    f"{state!r} and an exception path is swallowed "
+                    f"with no recovery ({recs}) reachable — the "
+                    f"object exits the protocol stranded")
+                yield replace(f,
+                              trace=describe_path(cfg, path, "swallow"))
+                break

@@ -51,6 +51,11 @@ RULE_DESCRIPTIONS: dict[str, str] = {
             "dataflow without a declared SANITIZER crossing",
     "OL11": "recompile-hazard: jit cache keys bucketed, dispatch "
             "variants observed by the key, every kind warmed",
+    "OL12": "resource-lifecycle: RESOURCE_PROTOCOLS acquire/release "
+            "obligations discharged on every CFG path, normal or "
+            "exception",
+    "OL13": "typestate: STATE_MACHINES transition validity and the "
+            "swallowed-abort stranded-state check",
 }
 
 
@@ -62,7 +67,7 @@ def to_sarif(findings: Iterable[Finding],
     rule_index = {rid: i for i, rid in enumerate(used_rules)}
     results = []
     for f in new:
-        results.append({
+        result = {
             "ruleId": f.rule,
             "ruleIndex": rule_index[f.rule],
             "level": "error",
@@ -83,7 +88,20 @@ def to_sarif(findings: Iterable[Finding],
             "partialFingerprints": {
                 "omnilintFingerprint/v1": f.fingerprint,
             },
-        })
+        }
+        if f.trace:
+            # OL12/OL13 chain reports: the leaking path's waypoints
+            # (acquire site -> exception crossings -> escape point)
+            # as relatedLocations, so SARIF viewers render the path
+            # the same way the text output does
+            result["relatedLocations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(int(line), 1)},
+                },
+                "message": {"text": note},
+            } for line, note in f.trace]
+        results.append(result)
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,

@@ -607,6 +607,11 @@ class ControlPlane:
             t_a0, w_a0 = time.perf_counter(), time.time()
             try:
                 if act.kind == ACTION_DRAIN:
+                    # omnilint: disable=OL12 - the escape witness needs
+                    # the handler's own error-formatting to raise; real
+                    # failures land ok=False on the done-queue and
+                    # tick's _drain_done aborts the op, which re-admits
+                    # the drained donor (_abort_op)
                     router.drain(act.args["replica_id"])
                 elif act.kind == ACTION_UNDRAIN:
                     router.undrain(act.args["replica_id"])

@@ -271,48 +271,55 @@ def dump_to_file(doc: dict, path: Optional[str] = None) -> Optional[str]:
     counted); an explicit ``path`` bypasses the limiter — the caller
     chose the exact file, so flooding is its problem to solve."""
     cooldown_key = None
-    if path is None:
-        from vllm_omni_tpu import envs
-
-        flight_dir = envs.OMNI_TPU_FLIGHT_DIR
-        if not flight_dir:
-            return None
-        reason = str(doc.get("reason", "dump")).replace("/", "_")
-        if not dump_cooldown.ready(reason, flight_dir):
-            logger.warning(
-                "flight-recorder dump (%s) suppressed by the %ss "
-                "per-reason cooldown", reason,
-                dump_cooldown.window_s())
-            return None
-        cooldown_key = (reason, flight_dir)
-        try:
-            os.makedirs(flight_dir, exist_ok=True)
-        except OSError as e:  # a dying process must not die harder
-            logger.error("flight-recorder dir %s unusable: %s",
-                         flight_dir, e)
-            dump_cooldown.release(*cooldown_key)
-            return None
-        global _dump_seq
-        with _dump_seq_lock:
-            _dump_seq += 1
-            seq = _dump_seq
-        path = os.path.join(
-            flight_dir,
-            f"flight-{os.getpid()}-{int(doc.get('ts', 0))}"
-            f"-{seq:03d}-{reason}.json")
+    written = None
     try:
+        if path is None:
+            from vllm_omni_tpu import envs
+
+            flight_dir = envs.OMNI_TPU_FLIGHT_DIR
+            if not flight_dir:
+                return None
+            reason = str(doc.get("reason", "dump")).replace("/", "_")
+            if not dump_cooldown.ready(reason, flight_dir):
+                logger.warning(
+                    "flight-recorder dump (%s) suppressed by the %ss "
+                    "per-reason cooldown", reason,
+                    dump_cooldown.window_s())
+                return None
+            cooldown_key = (reason, flight_dir)
+            try:
+                os.makedirs(flight_dir, exist_ok=True)
+            except OSError as e:  # a dying process must not die harder
+                logger.error("flight-recorder dir %s unusable: %s",
+                             flight_dir, e)
+                return None
+            global _dump_seq
+            with _dump_seq_lock:
+                _dump_seq += 1
+                seq = _dump_seq
+            path = os.path.join(
+                flight_dir,
+                f"flight-{os.getpid()}-{int(doc.get('ts', 0))}"
+                f"-{seq:03d}-{reason}.json")
         with open(path, "w") as f:
             json.dump(doc, f, indent=1, default=str)
+        logger.warning("flight-recorder dump (%s) written to %s",
+                       doc.get("reason"), path)
+        written = path
     except OSError as e:  # a dying process must not die harder
         logger.error("flight-recorder dump to %s failed: %s", path, e)
-        if cooldown_key is not None:
-            # a bundle that never landed must not hold the window:
-            # the retry that could succeed stays unsuppressed
-            dump_cooldown.release(*cooldown_key)
         return None
-    logger.warning("flight-recorder dump (%s) written to %s",
-                   doc.get("reason"), path)
-    return path
+    finally:
+        # the cooldown window is held only by a bundle that actually
+        # LANDED.  Releasing in the OSError handlers alone (the
+        # original PR 15 shape) left every other failure — a
+        # non-serializable doc raising TypeError out of json.dump, a
+        # KeyboardInterrupt mid-write — consuming the window for the
+        # whole cooldown period with nothing on disk, which OL12's
+        # exception-edge pass flags as a leaked acquire.
+        if cooldown_key is not None and written is None:
+            dump_cooldown.release(*cooldown_key)
+    return written
 
 
 # ------------------------------------------------------------ crash hooks
