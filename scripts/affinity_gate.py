@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""The omniaffinity win gate: BENCH_r19_affinity.json must beat
+BENCH_r16_cacheblind.json, per the pre-registered criteria —
+
+- fleet prefix **hit-rate improves** (strictly),
+- **goodput improves** (strictly),
+- **p99 TTFT does not regress** (beyond a small latency-tail noise
+  allowance),
+
+plus the standard perfguard no-regression sweep over every gated
+curve metric the two artifacts share.  The two benches label their
+serving-curve points with different topologies (``2Px2D-cacheblind``
+vs ``2Px2D-affinity``) — honest labels, but perfguard only compares
+matching surfaces, so the comparison runs on aligned copies (the
+affinity point re-labeled to the baseline topology).  Both artifacts
+are 5-trial median-by-goodput runs from the same machine
+(scripts/cache_bench.py); single-trial numbers are lottery tickets.
+
+    python scripts/affinity_gate.py                      # committed pair
+    python scripts/affinity_gate.py BASE.json NEW.json   # explicit pair
+"""
+
+import copy
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from scripts.perfguard import compare, extract, render_table  # noqa: E402
+
+#: p99 TTFT is a tail percentile of a 64-request run: allow this much
+#: relative noise before calling "no regress" violated
+TTFT_TOLERANCE = 0.05
+#: perfguard sweep threshold (same default as scripts/perfguard.py)
+THRESHOLD = 0.2
+
+
+def _headline(doc):
+    point = doc["serving_curve"][0]
+    return {
+        "hit_rate": float(doc["cache_board"]["fleet"]["hit_rate"]),
+        "goodput_req_per_s": float(point["goodput_req_per_s"]),
+        "ttft_p99_ms": float(point["ttft_ms"]["p99"]),
+    }
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    base_path = argv[0] if argv else "BENCH_r16_cacheblind.json"
+    new_path = argv[1] if len(argv) > 1 else "BENCH_r19_affinity.json"
+    with open(base_path) as f:
+        base_doc = json.load(f)
+    with open(new_path) as f:
+        new_doc = json.load(f)
+
+    # align the topology label so perfguard sees one shared surface
+    aligned = copy.deepcopy(new_doc)
+    for bp, np_ in zip(base_doc["serving_curve"],
+                       aligned["serving_curve"]):
+        np_["topology"] = bp["topology"]
+    rows, regressions, missing = compare(
+        extract(base_doc), extract(aligned), THRESHOLD)
+    if not rows:
+        print("affinity_gate: no comparable surface between "
+              f"{base_path} and {new_path}", file=sys.stderr)
+        return 2
+    print(render_table(rows, THRESHOLD))
+    failures = [f"perfguard: {key} {metric}: {b:.4f} -> {n:.4f} "
+                f"({d * 100:+.1f}%)"
+                for key, metric, b, n, d in regressions]
+    failures += [f"perfguard: missing surface {m}" for m in missing]
+
+    b, n = _headline(base_doc), _headline(new_doc)
+    print(f"\nhit_rate:  {b['hit_rate']:.6f} -> {n['hit_rate']:.6f}")
+    print(f"goodput:   {b['goodput_req_per_s']:.4f} -> "
+          f"{n['goodput_req_per_s']:.4f} req/s")
+    print(f"ttft_p99:  {b['ttft_p99_ms']:.1f} -> "
+          f"{n['ttft_p99_ms']:.1f} ms")
+    if not n["hit_rate"] > b["hit_rate"]:
+        failures.append("hit-rate must strictly improve")
+    if not n["goodput_req_per_s"] > b["goodput_req_per_s"]:
+        failures.append("goodput must strictly improve")
+    if n["ttft_p99_ms"] > b["ttft_p99_ms"] * (1 + TTFT_TOLERANCE):
+        failures.append(
+            f"p99 TTFT regressed beyond {TTFT_TOLERANCE:.0%}")
+
+    if failures:
+        print(f"\naffinity_gate: FAIL ({len(failures)}):",
+              file=sys.stderr)
+        for f_ in failures:
+            print(f"  {f_}", file=sys.stderr)
+        return 1
+    print("\naffinity_gate: PASS — affinity beats the cache-blind "
+          "baseline on hit-rate and goodput without a TTFT regression")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,13 +1,17 @@
 #!/usr/bin/env sh
-# omniscope gate: the fleet cache-economics layer end to end — the
-# radix digest's fingerprint consistency through insert / evict /
-# tier-demotion / park-restore cycles with the node cap enforced, the
-# CacheEconomics board's duplicate-prefix accounting against a
-# hand-oracled 3-replica fixture, torn-read immunity on /debug/kv and
-# /debug/cache under a mutating writer thread, the prefix_hit_rate_low
-# fake-clock alert lifecycle, the shared-prefix workload's determinism,
-# and the cache-blind baseline bench in smoke mode (2 prefill x 2
-# decode in-proc fleet, mid-flight /metrics probe, bounded digests).
+# omniscope + omniaffinity gate: the fleet cache-economics layer end
+# to end — the radix digest's fingerprint consistency through insert /
+# evict / tier-demotion / park-restore cycles with the node cap
+# enforced, the CacheEconomics board's duplicate-prefix accounting
+# against a hand-oracled 3-replica fixture, torn-read immunity on
+# /debug/kv and /debug/cache under a mutating writer thread, the
+# prefix_hit_rate_low fake-clock alert lifecycle, the shared-prefix
+# workload's determinism, both bench modes in smoke (cache-blind AND
+# prefix-affinity 2 prefill x 2 decode in-proc fleets, mid-flight
+# /metrics probes, bounded digests), and the pre-registered
+# omniaffinity win over the committed baseline artifacts: hit-rate
+# and goodput improve, p99 TTFT does not regress
+# (scripts/affinity_gate.py, perfguard-backed).
 #
 # Standalone face of the same coverage tier-1 carries (tests/cache is
 # a fast directory), sitting next to scripts/alerts.sh,
@@ -22,5 +26,9 @@ cd "$(dirname "$0")/.."
 env JAX_PLATFORMS=cpu python -m pytest \
     tests/cache/ \
     -q -p no:cacheprovider -m "not slow" "$@"
-exec env JAX_PLATFORMS=cpu python scripts/cache_bench.py --smoke \
+env JAX_PLATFORMS=cpu python scripts/cache_bench.py --smoke \
     --out /tmp/BENCH_r16_cacheblind_smoke.json
+env JAX_PLATFORMS=cpu python scripts/cache_bench.py --smoke --affinity \
+    --out /tmp/BENCH_r19_affinity_smoke.json
+# the committed full-run artifacts carry the pre-registered win
+exec python scripts/affinity_gate.py

@@ -1,0 +1,125 @@
+"""omniaffinity e2e on a real 2x2 in-proc topology: shared-prefix
+traffic converges on one prefill owner, the owner's completed prefix
+is published into the cluster KV fabric, and when the owner dies the
+cold survivor PULLS the prefix instead of recomputing — with token
+streams identical to the warm run (greedy), the pull leg on the
+journey timeline, and a clean regret ledger."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vllm_omni_tpu.disagg.service import build_inproc_router
+from vllm_omni_tpu.engine import EngineConfig
+from vllm_omni_tpu.models.common import transformer as tfm
+from vllm_omni_tpu.sampling_params import SamplingParams
+from vllm_omni_tpu.tracing import get_recorder, new_trace_context
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return params, cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    get_recorder().drain()
+    yield
+    get_recorder().drain()
+
+
+BASE = dict(num_pages=64, page_size=4, max_model_len=128,
+            max_num_seqs=4, dtype=jnp.float32)
+GREEDY = SamplingParams(temperature=0.0, max_tokens=4)
+# one shared 2-page system prompt + per-request suffix pages
+PREFIX = [1, 5, 9, 2, 7, 3, 8, 4]
+SUFFIXES = [[11, 12, 13, 14], [21, 22, 23, 24], [31, 32, 33, 34]]
+
+
+def _serve(router, prompts, wave):
+    ctxs = {}
+    for i, p in enumerate(prompts):
+        rid = f"{wave}-{i}"
+        ctxs[rid] = new_trace_context(rid)
+        router.submit(list(p), GREEDY, request_id=rid,
+                      additional_information={
+                          "tenant": f"tenant{i}",
+                          "trace": ctxs[rid]})
+    finished = {}
+    for _ in range(2000):
+        if not router.has_unfinished:
+            break
+        router.step()
+        for out in router.poll():
+            finished[out.request_id] = out
+    for out in router.poll():
+        finished[out.request_id] = out
+    assert not router.has_unfinished
+    return ctxs, finished
+
+
+def _streams(finished, wave, n):
+    return [tuple(finished[f"{wave}-{i}"].outputs[0].token_ids)
+            for i in range(n)]
+
+
+def test_owner_death_survivor_pulls_from_fabric(tiny_model):
+    params, cfg = tiny_model
+    router = build_inproc_router(params, cfg, EngineConfig(**BASE),
+                                 2, 2)
+    prompts = [PREFIX + s for s in SUFFIXES]
+
+    # wave 1: shared-prefix traffic with tenants — affinity converges
+    # the cold prefix onto ONE rendezvous owner, and the completed
+    # prefill payloads publish the in-demand prefix into the fabric
+    _, finished = _serve(router, prompts, "warm")
+    assert all(not o.is_error for o in finished.values())
+    warm_streams = _streams(finished, "warm", len(prompts))
+    placed = [r for r in router.prefills if r.engine.scheduler.kv
+              .prefix_hit_tokens + len(r.engine.scheduler.kv._tables)
+              >= 0]
+    owners = [r for r in router.prefills
+              if r.engine.scheduler.kv.index.digest(8)["nodes"]]
+    assert len(owners) == 1, (
+        "cold shared prefix must converge on one owner, found "
+        f"{[r.replica_id for r in placed]}")
+    assert router._fabric, "in-demand prefix never published"
+    board = router.cache.board()
+    assert board["fabric"]["publishes"] >= 1
+
+    # the owner dies; its digest is forgotten, its cache is gone
+    owner = owners[0]
+    owner.dead = True
+    router.step()
+
+    # wave 2: same prompts — the survivor is cold, the fabric is not.
+    # The pull injects the published prefix instead of recomputing.
+    hot_ctxs, finished = _serve(router, prompts, "cold")
+    assert all(not o.is_error for o in finished.values())
+    board = router.cache.board()
+    assert board["fabric"]["pulls"] >= 1, board["fabric"]
+    assert board["fabric"]["pull_failures"] == 0
+
+    # bit-identical streams: injected KV must continue exactly like
+    # the recomputed prefix did (greedy decoding, same model)
+    assert _streams(finished, "cold", len(prompts)) == warm_streams
+
+    # the pull leg rides the journey timeline of wave 2
+    spans = get_recorder().drain()
+    traces = {c["trace_id"] for c in hot_ctxs.values()}
+    pulls = [s for s in spans if s["name"] == "prefix_pull"
+             and s["trace_id"] in traces]
+    assert pulls, "no prefix_pull span on the cold wave"
+    for s in pulls:
+        assert {"key", "tokens", "bytes", "src"} <= set(s["args"])
+
+    # regret stays clean: no dispatch left re-prefill work on the
+    # table that a live peer's digest had promised cheaper
+    wasted = sum(e["wasted_tokens"]
+                 for e in board["regret_ledger"])
+    assert wasted == 0, board["regret_ledger"]
+    # pulled tokens are fleet hits — the economics must price them
+    assert (board["fleet"]["hit_tokens"]
+            >= board["fabric"]["pulled_tokens"])

@@ -1,0 +1,68 @@
+"""Donor selection vs cache heat (omniaffinity): re-role/scale-down
+must prefer a cold donor when one exists — draining the replica that
+owns the fleet's hot prefixes evicts exactly the cache the affinity
+router converged onto."""
+
+from vllm_omni_tpu.controlplane import ControlPlane, ControlPlaneConfig
+from vllm_omni_tpu.kvcache.tiers import TIER_HBM
+
+from tests.controlplane.test_controller import (
+    _cp,
+    _run,
+    _topology,
+)
+
+
+def _heat(router, rid, pages, page_size=4):
+    """Advertise ``pages`` HBM-resident prefix pages on ``rid``."""
+    router.cache.observe_digest(rid, {
+        "page_size": page_size,
+        "nodes": [{"key": f"{rid}-k{i}", "depth": i + 1,
+                   "tier": TIER_HBM} for i in range(pages)],
+    })
+
+
+def test_donor_pick_avoids_the_hot_replica():
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    _heat(router, "d0", pages=8)         # 32 hot tokens on d0
+    donor = cp._pick_donor(router.decodes)
+    assert donor.replica_id == "d1", \
+        "equal load must break toward the cold donor"
+
+
+def test_donor_penalty_is_bounded_by_real_load():
+    """Heat is a tiebreak-scale penalty, not a veto: a hot replica
+    with an empty queue still donates over a cold one buried in work
+    (penalty * hot_tokens stays well under one queue slot per page
+    at the default 0.02)."""
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    _heat(router, "d0", pages=8)         # penalty 0.02 * 32 = 0.64
+    router.decodes[1].engine.load(running=2)
+    donor = cp._pick_donor(router.decodes)
+    assert donor.replica_id == "d0", \
+        "0.64 heat-slots must not outweigh 2 real queue slots"
+
+
+def test_zero_penalty_delegates_to_router_pick():
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router, donor_cache_penalty=0.0)
+    _heat(router, "d0", pages=64)
+    oracle = router._pick(router.decodes)
+    assert cp._pick_donor(router.decodes) is oracle
+
+
+def test_rerole_drains_the_cold_donor_end_to_end():
+    """Through the full tick/actuate loop: prefill pressure re-roles a
+    decode replica, and the drain lands on the cold one."""
+    router = _topology(n_prefill=1, n_decode=2)
+    cp = _cp(router)
+    _heat(router, "d0", pages=8)
+    router.prefills[0].engine.load(waiting=20)
+    _run(cp, 6)
+    assert cp.reroles == 1
+    flipped = next(r for r in router.prefills
+                   if r.replica_id.startswith("d"))
+    assert flipped.replica_id == "d1", \
+        "the hot replica must keep its cache through a re-role"

@@ -1,0 +1,329 @@
+"""omniaffinity units over scriptable fake engines: affinity-vs-load
+scoring against a hand-evaluated oracle, hysteresis floor, cold-path
+rendezvous convergence (and re-homing under churn), owner-death
+failover staying affinity-blind, fabric pull injection, fetch-failure
+degradation to recompute, the ejection digest-invalidation regression,
+and the replica-keys freshness floor — no model, no jax compute."""
+
+import numpy as np
+import pytest
+
+from vllm_omni_tpu.disagg.router import (
+    AFFINITY_FLOOR_PAGES,
+    DisaggRouter,
+    EngineReplica,
+)
+from vllm_omni_tpu.kvcache.radix import chain_page_keys
+from vllm_omni_tpu.kvcache.tiers import TIER_HBM
+from vllm_omni_tpu.resilience.faults import set_fault_plan
+from vllm_omni_tpu.sampling_params import SamplingParams
+
+from tests.disagg.test_router import FakeEngine, _replica
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    set_fault_plan(None)
+    yield
+    set_fault_plan(None)
+
+
+SP = SamplingParams(temperature=0.0, max_tokens=4)
+#: FakeEngine replicas expose no kv page size, so the router hashes
+#: request pages at size 1 — one token, one page, one chain key
+PROMPT = list(range(1, 9))
+
+
+def _topology(n_prefill=2, n_decode=1, **kw):
+    prefills = [_replica(f"p{i}", "prefill", i)
+                for i in range(n_prefill)]
+    decodes = [_replica(f"d{i}", "decode", n_prefill + i)
+               for i in range(n_decode)]
+    return DisaggRouter(prefills, decodes, **kw)
+
+
+def _keys(tokens, page_size=1):
+    return [h for _, h in chain_page_keys(tokens, page_size)]
+
+
+def _warm(router, rid, tokens, pages=None):
+    """Publish a digest for ``rid`` covering the first ``pages`` chain
+    keys of ``tokens`` (all of them by default), tier HBM."""
+    keys = _keys(tokens)
+    if pages is not None:
+        keys = keys[:pages]
+    router.cache.observe_digest(rid, {
+        "page_size": 1,
+        "nodes": [{"key": k, "depth": i + 1, "tier": TIER_HBM}
+                  for i, k in enumerate(keys)],
+    })
+
+
+def _load(replica, depth):
+    replica.engine.scheduler.waiting = [object()] * depth
+
+
+def _placed(router):
+    for r in router.prefills:
+        if r.engine.added:
+            return r.replica_id
+    raise AssertionError("nothing placed on the prefill tier")
+
+
+# ------------------------------------------------------- scoring oracle
+def test_warm_replica_beats_lighter_cold_one():
+    """score = hit_tokens*affinity_weight - queue_depth*load_weight:
+    8 covered tokens on p0 at depth 0 vs 0 on an idle p1 — with
+    load_weight 2 the warm replica wins until it trails by 4 slots."""
+    router = _topology(load_weight=2.0)
+    _warm(router, "p0", PROMPT)
+    _load(router.prefills[0], 3)         # p0: 8 - 6 = 2 > p1: 0
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    assert _placed(router) == "p0"
+    (doc,) = router.cache.board()["affinity"]["ring"]
+    assert doc["outcome"] == "hit"
+    assert doc["expected_hit_tokens"] == len(PROMPT)
+
+
+def test_load_overrides_affinity_past_the_break_even():
+    """Past hit/load_weight queue slots the cold replica wins — and
+    the decision is recorded as a load override, not a hit."""
+    router = _topology(load_weight=2.0)
+    _warm(router, "p0", PROMPT)
+    _load(router.prefills[0], 5)         # p0: 8 - 10 = -2 < p1: 0
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    assert _placed(router) == "p1"
+    (doc,) = router.cache.board()["affinity"]["ring"]
+    assert doc["outcome"] == "load_override"
+
+
+@pytest.mark.parametrize("q0,q1,cov0,cov1", [
+    (0, 0, 8, 0), (2, 0, 8, 0), (0, 0, 8, 4), (1, 3, 4, 8),
+])
+def test_scoring_matches_the_hand_oracle(q0, q1, cov0, cov1):
+    """The chosen replica is argmax of the published formula — checked
+    against an independently evaluated oracle per configuration."""
+    w_aff, w_load = 1.0, 2.0
+    router = _topology(affinity_weight=w_aff, load_weight=w_load)
+    if cov0:
+        _warm(router, "p0", PROMPT, pages=cov0)
+    if cov1:
+        _warm(router, "p1", PROMPT, pages=cov1)
+    _load(router.prefills[0], q0)
+    _load(router.prefills[1], q1)
+    scores = {"p0": cov0 * w_aff - q0 * w_load,
+              "p1": cov1 * w_aff - q1 * w_load}
+    oracle = max(sorted(scores), key=lambda r: scores[r])
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    assert _placed(router) == oracle, scores
+
+
+def test_hysteresis_floor_sends_tiny_hits_to_the_cold_path():
+    """A sub-floor hit must never override load balancing: one covered
+    page on a deeply queued p0 routes to the idle replica and the
+    decision reads ``miss`` (cold path), not ``hit``."""
+    router = _topology()
+    _warm(router, "p0", PROMPT, pages=AFFINITY_FLOOR_PAGES - 1)
+    # past the cold-owner slack too, so the owner can't soak it up
+    _load(router.prefills[0], router.cold_owner_slack + 1)
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    assert _placed(router) == "p1"
+    (doc,) = router.cache.board()["affinity"]["ring"]
+    assert doc["outcome"] == "miss"
+
+
+def test_no_tenant_cold_path_is_bit_identical_to_pick():
+    """Tenantless cold requests take the exact ``_pick`` placement —
+    the affinity router degrades to the cache-blind one."""
+    router = _topology()
+    _load(router.prefills[0], 2)
+    router.submit(PROMPT, SP, request_id="r1")
+    assert _placed(router) == router._pick(router.prefills).replica_id
+
+
+# ------------------------------------- cold-path rendezvous convergence
+def test_cold_prefixes_converge_on_one_owner_across_tenants():
+    """Four tenants, one shared prompt, zero digests: every placement
+    lands on the SAME replica — the salt is the prefix identity, so a
+    shared system prompt converges even across tenants."""
+    router = _topology(n_prefill=3)
+    for i in range(4):
+        router.submit(PROMPT, SP, request_id=f"r{i}",
+                      additional_information={"tenant": f"t{i}"})
+    placed = [r.replica_id for r in router.prefills if r.engine.added]
+    assert len(placed) == 1, placed
+    counts = [len(r.engine.added) for r in router.prefills]
+    assert sorted(counts) == [0, 0, 4]
+
+
+def test_cold_owner_yields_past_the_slack_window():
+    """Owner stickiness is bounded: once the owner trails the least
+    loaded candidate by more than ``cold_owner_slack`` queue slots,
+    load balancing wins."""
+    router = _topology(n_prefill=2)
+    keys = _keys(PROMPT)
+    salt = keys[min(len(keys), AFFINITY_FLOOR_PAGES) - 1]
+    owner = max(router.prefills,
+                key=lambda r: router._owner_weight(salt, r.replica_id))
+    other, = [r for r in router.prefills if r is not owner]
+    _load(owner, router.cold_owner_slack + 1)
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    assert other.engine.added and not owner.engine.added
+
+
+def test_owner_death_rehomes_only_its_prefixes():
+    """Churn: when an owner dies, its prefixes re-home onto one new
+    owner; a prefix owned elsewhere keeps its placement (rendezvous —
+    no global reshuffle)."""
+    router = _topology(n_prefill=3)
+    # find two prompts with different owners (deterministic hash walk)
+    def owner_of(tokens):
+        keys = _keys(tokens)
+        salt = keys[min(len(keys), AFFINITY_FLOOR_PAGES) - 1]
+        return max(router.prefills,
+                   key=lambda r: router._owner_weight(
+                       salt, r.replica_id))
+
+    prompt_a = PROMPT
+    prompt_b = next(
+        [100 + j, 101 + j, 102 + j] for j in range(64)
+        if owner_of([100 + j, 101 + j, 102 + j]) is not owner_of(PROMPT))
+    owner_a, owner_b = owner_of(prompt_a), owner_of(prompt_b)
+    owner_a.dead = True
+    router._refresh_health()
+    router.submit(prompt_a, SP, request_id="ra",
+                  additional_information={"tenant": "t0"})
+    router.submit(prompt_b, SP, request_id="rb",
+                  additional_information={"tenant": "t1"})
+    assert not owner_a.engine.added, "dead owner must not place"
+    assert any(rid == "rb" for rid, _, _ in owner_b.engine.added), \
+        "surviving owner keeps its prefix"
+    # the dead owner's prefix re-homes onto the surviving replica the
+    # rendezvous ranks next — deterministically, to exactly one place
+    keys_a = _keys(prompt_a)
+    salt_a = keys_a[min(len(keys_a), AFFINITY_FLOOR_PAGES) - 1]
+    new_owner = max((r for r in router.prefills if r is not owner_a),
+                    key=lambda r: router._owner_weight(
+                        salt_a, r.replica_id))
+    assert any(rid == "ra" for rid, _, _ in new_owner.engine.added)
+    placed_a = [r.replica_id for r in router.prefills
+                if any(rid == "ra" for rid, _, _ in r.engine.added)]
+    assert placed_a == [new_owner.replica_id]
+
+
+# --------------------------------------------- failover affinity-blind
+def test_owner_death_failover_replays_via_plain_pick():
+    """A failover replay is affinity-blind by contract: even with the
+    dead owner's digest promising full coverage, the replay takes the
+    ``_pick`` placement among survivors."""
+    router = _topology(n_prefill=3)
+    _warm(router, "p0", PROMPT)
+    _load(router.prefills[1], 1)         # make _pick's choice distinct
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    victim = next(r for r in router.prefills if r.engine.added)
+    victim.engine.error("r1", "boom", kind="internal")
+    victim.dead = True
+    # the _pick oracle, frozen at replay time (before the replay
+    # itself shifts queue depths)
+    oracle = min((r for r in router.prefills if r is not victim),
+                 key=lambda r: r.queue_depth)
+    router.step()
+    survivors = [r for r in router.prefills
+                 if r is not victim and r.engine.added]
+    assert len(survivors) == 1
+    assert survivors[0].replica_id == oracle.replica_id
+
+
+# ----------------------------------------------------- fabric pull path
+def _arm_fabric(router, tokens, pages):
+    """Plant a published prefix: index row + zero-copy payload (the
+    in-proc connector hands arrays over without serialization)."""
+    keys = _keys(tokens)
+    key = keys[pages - 1]
+    payload = [(np.ones((1, pages), np.float32),
+                np.ones((1, pages), np.float32))]
+    router._fabric[key] = {"tokens": pages, "pages": pages,
+                           "layers": 1}
+    router._fabric_payloads[key] = payload
+    return key
+
+
+def test_cold_replica_pulls_published_prefix():
+    router = _topology(n_prefill=1)
+    _arm_fabric(router, PROMPT, pages=4)
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    (_, _, kwargs), = router.prefills[0].engine.added
+    assert kwargs["injected_kv"] is not None
+    info = kwargs["additional_information"]
+    assert info["prefix_pull"]["tokens"] == 4
+    fabric = router.cache.board()["fabric"]
+    assert fabric["pulls"] == 1 and fabric["pulled_tokens"] == 4
+    # pulled tokens are fleet cache hits: served, not re-prefilled
+    assert router.cache.board()["fleet"]["hit_tokens"] == 4
+
+
+def test_fetch_failure_degrades_to_recompute():
+    """ANY fetch failure = plain recompute (the lost-payload
+    contract): the request still places, nothing is injected, the
+    poisoned entry is evicted, and the failure is metered."""
+    router = _topology(n_prefill=1)
+    key = _arm_fabric(router, PROMPT, pages=4)
+    del router._fabric_payloads[key]     # vanished payload -> KeyError
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    (_, _, kwargs), = router.prefills[0].engine.added
+    assert "injected_kv" not in kwargs
+    assert "prefix_pull" not in kwargs["additional_information"]
+    assert key not in router._fabric, "failed entry must be evicted"
+    fabric = router.cache.board()["fabric"]
+    assert fabric["pulls"] == 0 and fabric["pull_failures"] == 1
+
+
+def test_replica_keys_freshness_floor_suppresses_warm_pulls():
+    """The digest is stride-stale, but the router knows what it just
+    placed: a replica that already routed this prefix must NOT have
+    its radix hit shadowed by an injected pull."""
+    router = _topology(n_prefill=1)
+    router.submit(PROMPT, SP, request_id="r0",
+                  additional_information={"tenant": "t0"})
+    _arm_fabric(router, PROMPT, pages=4)
+    router.submit(PROMPT, SP, request_id="r1",
+                  additional_information={"tenant": "t0"})
+    for _, _, kwargs in router.prefills[0].engine.added:
+        assert "injected_kv" not in kwargs
+    assert router.cache.board()["fabric"]["pulls"] == 0
+
+
+# --------------------------------------- ejection digest invalidation
+def test_ejection_invalidates_digest_immediately():
+    """Regression: an ejected replica's stale digest kept steering
+    affinity until the next stride refresh.  Ejection must drop the
+    coverage NOW — and keep the counter baseline so re-admission does
+    not double-count fleet totals."""
+    router = _topology(n_prefill=2)
+    router.cache.observe_digest("p0", {
+        "page_size": 1,
+        "nodes": [{"key": k, "depth": i + 1, "tier": TIER_HBM}
+                  for i, (_, k) in enumerate(
+                      chain_page_keys(PROMPT, 1))],
+    }, hit_tokens=100, prefill_tokens=50)
+    p0 = router.prefills[0]
+    p0.health_fn = lambda: (503, {"status": "stalled"})
+    router.step()
+    assert p0.ejected
+    cov = router.cache.expected_hits(["p0"], _keys(PROMPT))
+    assert cov["p0"] == (0, 0), "stale digest survived ejection"
+    # re-admission + re-observe with unchanged counters: no double count
+    p0.health_fn = lambda: (200, {"status": "ok"})
+    router.step()
+    before = router.cache.board()["fleet"]["hit_tokens"]
+    router.cache.observe_digest("p0", {"page_size": 1, "nodes": []},
+                                hit_tokens=100, prefill_tokens=50)
+    assert router.cache.board()["fleet"]["hit_tokens"] == before

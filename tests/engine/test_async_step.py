@@ -150,6 +150,9 @@ def test_async_eos_one_step_lag_rollback(tiny_model):
     assert req.num_computed_tokens == req.num_tokens - 1
 
 
+@pytest.mark.slow  # fast siblings: test_async_eos_one_step_lag_rollback
+# pins the lagged retire never overshoots; sync max_tokens exactness
+# lives in test_llm_engine.py
 def test_async_max_tokens_exact(tiny_model):
     """max_tokens is enforced at the lagged retire — never overshot in
     the emitted stream."""
@@ -426,6 +429,10 @@ def test_async_dispatch_retire_spans_recorded(tiny_model):
     assert "dispatch" in names and "retire" in names, names
 
 
+@pytest.mark.slow  # fast siblings: test_warmup_precompiles_all_traffic_
+# shapes warms the same token-bucket executables and
+# test_async_greedy_matches_sync pins pipelined correctness; only the
+# dispatch-fn cache-stability assertion is unique here
 def test_async_warmup_precompiles_dispatch_path(tiny_model):
     """warmup() with async_scheduling warms the dispatch executable so
     serving traffic hits no new compile on the pipelined path."""

@@ -198,6 +198,7 @@ def run_check() -> list[str]:
         # and the omniscope fleet cache board (metrics/
         # cache_economics.py exposition shape)
         disagg={"handoff_seconds": hist,
+                "prefix_pull_seconds": hist,
                 "cache": {
                     "fleet_hit_tokens": 320,
                     "fleet_prefill_tokens": 480,
@@ -215,6 +216,13 @@ def run_check() -> list[str]:
             "router_healthy_replicas": [({"role": "prefill"}, 2),
                                         ({"role": "decode"}, 1)],
             "degraded_mode": [({}, 0)],
+            # omniaffinity (disagg/router.py): affinity dispatch
+            # outcomes + cluster-KV-fabric pull bytes
+            "router_affinity_dispatch_total": [
+                ({"outcome": "hit"}, 5), ({"outcome": "miss"}, 3),
+                ({"outcome": "load_override"}, 1)],
+            "kv_prefix_pull_bytes_total": [({"src": "peer"}, 8192),
+                                           ({"src": "cold"}, 4096)],
             # control plane (docs/control_plane.md): the controller's
             # registry-riding fleet gauges and actuation counters —
             # every series the closed-loop bench asserts on

@@ -115,6 +115,13 @@ class ControlPlaneConfig:
     # the alert can accelerate the controller, never force an action
     # the sensors themselves would not eventually take
     alert_pressure_bonus: float = 2.0
+    # donor selection subtracts cache heat (PR 19 omniaffinity): a
+    # replica owning hot radix digests is the fleet's cache, and
+    # draining it for a re-role/scale-down evicts every prefix the
+    # affinity router converged onto it.  Each HBM-resident digest
+    # token adds this many queue-depth units to the replica's donor
+    # score; 0 restores the pure least-loaded policy (router._pick).
+    donor_cache_penalty: float = 0.02
     # --- structured-action ring (/debug/controlplane)
     ring_capacity: int = 256
 
@@ -534,11 +541,25 @@ class ControlPlane:
                                   f"(pressure={s.pressure:.2f})")
 
     def _pick_donor(self, pool):
-        """Least-loaded in-rotation replica — the flip/removal that
-        strands the least in-flight work behind a drain.  Delegates to
-        the router's own dispatch-placement policy so donor choice can
-        never silently diverge from where new work lands."""
-        return self.router._pick(pool)
+        """Least-loaded in-rotation replica, penalized by cache heat —
+        the flip/removal that strands the least in-flight work AND the
+        least affinity-converged cache behind a drain.  With the
+        penalty at 0 this delegates to the router's own dispatch
+        policy (``_pick``) so donor choice can never silently diverge
+        from where new work lands; with it on, a replica whose radix
+        digest advertises hot HBM-resident prefixes scores worse as a
+        donor (queue_depth + penalty * hot_tokens), so the controller
+        stops evicting the fleet's cache when a colder donor exists."""
+        penalty = float(self.config.donor_cache_penalty)
+        if penalty <= 0:
+            return self.router._pick(pool)
+        candidates = [r for r in pool if r.in_rotation]
+        if not candidates:
+            return None
+        heat = self.router.cache.replica_heat()
+        return min(candidates,
+                   key=lambda r: (r.queue_depth + penalty
+                                  * heat.get(r.replica_id, 0)))
 
     # ------------------------------------------------------- intent queue
     def _emit(self, kind: str, **args) -> None:

@@ -145,6 +145,10 @@ class KVCacheManager:
         # peer engine (disaggregated prefill adoption, docs/
         # disaggregation.md) rather than being computed here
         self.streamed_tokens = 0
+        # prompt tokens whose KV was PULLED from the cluster KV fabric
+        # (a shared-prefix page another replica published to the
+        # connector store) instead of being re-prefilled here
+        self.prefix_pull_tokens = 0
         # ---- per-tenant attribution hooks (metrics/attribution.py):
         # host-int timestamp accounting of page occupancy — every
         # table-size change closes the previous (pages x elapsed)
@@ -245,6 +249,7 @@ class KVCacheManager:
                 "offload_evictions": self.offload_evictions,
                 "drop_evictions": self.drop_evictions,
                 "streamed_tokens": self.streamed_tokens,
+                "prefix_pull_tokens": self.prefix_pull_tokens,
             },
         }
 
@@ -501,6 +506,24 @@ class KVCacheManager:
         (vs. prefix-cache or tier-restore adoption) — /debug/kv's
         answer to where a decode tier's KV came from."""
         self.streamed_tokens += n_tokens
+
+    def adopt_prefix(self, request: Request, n_tokens: int
+                     ) -> Optional[list[int]]:
+        """Fabric-pull admission (cluster KV fabric, PR 19): allocate
+        pages for ``n_tokens`` of a shared-prefix payload fetched from
+        the connector store — the same side-effect-free contract as
+        ``adopt_streamed``, kept as a distinct entry so the two KV
+        provenances (peer handoff vs fabric pull) stay separately
+        accountable.  The payload rode the kv_transfer integrity/
+        deadline guards on the way in; the caller injects before any
+        forward attends the pages, then calls ``note_pulled``."""
+        return self.allocate(request, n_tokens)
+
+    def note_pulled(self, n_tokens: int) -> None:
+        """Count tokens whose KV actually INJECTED from a fabric pull
+        (a prefix a sibling replica published) — the saved-re-prefill
+        half of /debug/kv's provenance story."""
+        self.prefix_pull_tokens += n_tokens
 
     def slot_mapping(self, request: Request, num_new_tokens: int) -> list[int]:
         """Flat slots (page*page_size + offset) for the next

@@ -55,8 +55,12 @@ from __future__ import annotations
 
 import time
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field, replace
+from hashlib import blake2b
 from typing import Any, Optional
+
+import numpy as np
 
 from vllm_omni_tpu.disagg import roles
 from vllm_omni_tpu.disagg.roles import (
@@ -68,10 +72,19 @@ from vllm_omni_tpu.distributed.connectors import (
     ConnectorFactory,
     OmniConnectorBase,
 )
-from vllm_omni_tpu.distributed.kv_transfer import KVDeadlineExceeded
+from vllm_omni_tpu.distributed.kv_transfer import (
+    KVDeadlineExceeded,
+    recv_kv,
+    ship_kv,
+)
 from vllm_omni_tpu.kvcache.radix import chain_page_keys
 from vllm_omni_tpu.logger import init_logger
-from vllm_omni_tpu.metrics.cache_economics import CacheEconomics
+from vllm_omni_tpu.metrics.cache_economics import (
+    AFFINITY_HIT,
+    AFFINITY_LOAD_OVERRIDE,
+    AFFINITY_MISS,
+    CacheEconomics,
+)
 from vllm_omni_tpu.metrics.stats import Histogram
 from vllm_omni_tpu.outputs import OmniRequestOutput
 from vllm_omni_tpu.resilience.deadline import (
@@ -102,6 +115,41 @@ DIGEST_MAX_NODES = 64
 #: digest depth bound (coverage beyond the digest horizon is invisible
 #: anyway, so hashing further is wasted host work)
 DISPATCH_KEY_PAGES = DIGEST_MAX_NODES
+
+#: affinity dispatch defaults (omniaffinity): the score is
+#: ``expected_hit_tokens * affinity_weight - queue_depth * load_weight``
+#: — with the defaults, one queued request outweighs 16 tokens of
+#: expected hit, so affinity steers only when the cache win is real
+#: and load stays the primary balancer under pressure
+AFFINITY_WEIGHT = 1.0
+LOAD_WEIGHT = 16.0
+#: hysteresis floor: hits below this many pages never override plain
+#: least-loaded dispatch (a 1-page hit is noise, not a placement
+#: signal) — also the minimum fabric-pull gain worth the fetch
+AFFINITY_FLOOR_PAGES = 2
+#: cold-path stickiness: a cold prefix sticks to its rendezvous owner
+#: until the owner trails the least-loaded candidate by more than this
+#: many queue slots.  Without slack the second cold arrival bounces
+#: off the owner the moment its queue is non-empty, and an identical
+#: prefix gets prefilled on every replica before the first digest
+#: refresh can steer anything (DIGEST_STRIDE staleness window).
+COLD_OWNER_SLACK = 4
+
+#: cluster-KV-fabric bounds: at most this many prefix publications per
+#: router step (each is a host-side slice + store put on the one
+#: engine-stepping thread), a prefix must be requested this many times
+#: before it earns a publication, and the fabric index/store hold at
+#: most FABRIC_CAP entries (LRU) with demand counts capped at
+#: PREFIX_SEEN_CAP distinct keys
+PUBLISH_BUDGET_PER_STEP = 2
+PUBLISH_MIN_SEEN = 2
+FABRIC_CAP = 128
+PREFIX_SEEN_CAP = 4096
+#: per-replica dispatched-key memory (LRU): the router's own record of
+#: which prefixes it already routed to each replica — the freshness
+#: floor under digest staleness (a digest refreshes on a stride; the
+#: router knows what it placed between strides)
+REPLICA_KEYS_CAP = 2048
 
 
 class EngineReplica:
@@ -234,6 +282,13 @@ class _ReqCtx:
     # finish metadata captured from the prefill output when the request
     # terminates at the prefill tier (max_tokens==1 / EOS first token)
     handoff_since_step: int = 0
+    # chain-hash page keys of the prompt (router page size), computed
+    # once per request and reused by affinity scoring, regret metering
+    # and the fabric publish/pull legs
+    keys: Optional[list[str]] = None
+    # the affinity decision doc for this placement (None = affinity off
+    # or the placement was a failover replay, which is affinity-blind)
+    affinity: Optional[dict] = None
 
     @property
     def trace(self) -> Optional[dict]:
@@ -248,7 +303,13 @@ class DisaggRouter:
                  tp_shards: int = 1,
                  max_failover_attempts: int = 3,
                  handoff_timeout_s: float = 5.0,
-                 payload_wait_steps: int = 16):
+                 payload_wait_steps: int = 16,
+                 affinity_routing: bool = True,
+                 affinity_weight: float = AFFINITY_WEIGHT,
+                 load_weight: float = LOAD_WEIGHT,
+                 affinity_floor_pages: int = AFFINITY_FLOOR_PAGES,
+                 cold_owner_slack: int = COLD_OWNER_SLACK,
+                 publish_budget: int = PUBLISH_BUDGET_PER_STEP):
         self.prefills = list(prefills)
         self.decodes = list(decodes)
         self.replicas = self.prefills + self.decodes
@@ -301,6 +362,46 @@ class DisaggRouter:
         # per the contract above.
         self.cache = CacheEconomics(
             bytes_per_token=self._kv_bytes_per_token())
+        # --- prefix-affinity dispatch (omniaffinity, ROADMAP item 3):
+        # score healthy candidates by expected prefix hit against their
+        # live digests, blended with load; failover replays stay
+        # affinity-blind (a dead owner must never pin a request)
+        self.affinity_routing = affinity_routing
+        self.affinity_weight = float(affinity_weight)
+        self.load_weight = float(load_weight)
+        self.affinity_floor_pages = int(affinity_floor_pages)
+        self.cold_owner_slack = int(cold_owner_slack)
+        # page size for request-side chain keys (homogeneous fleets;
+        # _note_cache_dispatch re-hashes if a replica disagrees)
+        self._page_size = 1
+        for r in self.replicas:
+            try:
+                self._page_size = int(
+                    r.engine.scheduler.kv.page_size) or 1
+                break
+            except Exception:
+                continue
+        # --- the remote tier as a cluster KV fabric: prefill engines
+        # publish completed shared-prefix pages into the connector
+        # store (bounded budget, demand-gated), and a chosen replica
+        # that misses a published prefix pulls it instead of
+        # re-prefilling.  All router-thread-only state (the
+        # single-threaded contract above).
+        self.publish_budget = int(publish_budget)
+        self._publish_left = self.publish_budget
+        # chain key -> dispatch demand count (LRU-capped)
+        self._prefix_seen: OrderedDict[str, int] = OrderedDict()
+        # replica_id -> LRU of chain keys already dispatched there:
+        # the digest is stride-stale, but the router knows what it
+        # placed in between — a replica that just prefilled a prefix
+        # must not be "helped" with a fabric pull that would shadow
+        # its own radix hit
+        self._replica_keys: dict[str, OrderedDict[str, int]] = {}
+        # chain key -> {tokens, pages, layers} of the published payload
+        self._fabric: OrderedDict[str, dict] = OrderedDict()
+        # zero-copy fast path: published slices held in-process
+        self._fabric_payloads: dict[str, list] = {}
+        self.prefix_pull_seconds = Histogram(buckets=HANDOFF_BUCKETS_S)
         self._refresh_digests()
         self._refresh_health()
 
@@ -354,6 +455,26 @@ class DisaggRouter:
                 # router down — the board just goes stale for it
                 continue
 
+    def _page_keys(self, ctx: "_ReqCtx") -> list[str]:
+        """The request's chain-hash page keys at the ROUTER page size,
+        computed once and cached on the ctx — affinity scoring, regret
+        metering and the fabric legs all walk the same keys."""
+        if ctx.keys is None:
+            ctx.keys = [h for _, h in chain_page_keys(
+                ctx.prompt_token_ids, self._page_size,
+                max_pages=DISPATCH_KEY_PAGES)]
+        return ctx.keys
+
+    def _note_prefix_demand(self, keys: list[str]) -> None:
+        """Count dispatch demand per chain key (LRU-capped): a prefix
+        requested PUBLISH_MIN_SEEN times earns fabric publication."""
+        seen = self._prefix_seen
+        for key in keys:
+            seen[key] = seen.get(key, 0) + 1
+            seen.move_to_end(key)
+        while len(seen) > PREFIX_SEEN_CAP:
+            seen.popitem(last=False)
+
     def _note_cache_dispatch(self, ctx: "_ReqCtx",
                              replica: EngineReplica) -> dict:
         """Score one placement against the fleet digests and meter the
@@ -364,9 +485,21 @@ class DisaggRouter:
             page_size = replica.engine.scheduler.kv.page_size
         except Exception:
             page_size = 1
-        keys = [h for _, h in chain_page_keys(
-            ctx.prompt_token_ids, page_size,
-            max_pages=DISPATCH_KEY_PAGES)]
+        if page_size == self._page_size:
+            keys = self._page_keys(ctx)
+        else:
+            keys = [h for _, h in chain_page_keys(
+                ctx.prompt_token_ids, page_size,
+                max_pages=DISPATCH_KEY_PAGES)]
+        if self.affinity_routing:
+            self._note_prefix_demand(keys)
+            rec = self._replica_keys.setdefault(
+                replica.replica_id, OrderedDict())
+            for key in keys:
+                rec[key] = self._steps
+                rec.move_to_end(key)
+            while len(rec) > REPLICA_KEYS_CAP:
+                rec.popitem(last=False)
         doc = self.cache.note_dispatch(
             replica.replica_id, keys,
             tenant=ctx.info.get("tenant"),
@@ -400,6 +533,142 @@ class DisaggRouter:
                       "actual_hit_tokens": actual,
                       "wasted_tokens": doc.get("wasted_tokens", 0)})
 
+    # ------------------------------------------------- cluster KV fabric
+    def _maybe_publish_prefix(self, ctx: "_ReqCtx",
+                              payload: list) -> None:
+        """Publish the deepest in-demand shared-prefix slice of a
+        completed prefill payload into the connector store.  Bounded:
+        per-step publish budget, demand gate (PUBLISH_MIN_SEEN
+        dispatches), floor-page minimum, FABRIC_CAP LRU on the index.
+        The published slice is a COPY — it outlives the publishing
+        replica (that is the point: the fabric is the fleet's cache,
+        not a pointer into one engine's HBM)."""
+        if not self.affinity_routing or self._publish_left <= 0:
+            return
+        keys = self._page_keys(ctx)
+        best_i = -1
+        for i, key in enumerate(keys):
+            if key in self._fabric:
+                continue
+            if self._prefix_seen.get(key, 0) >= PUBLISH_MIN_SEEN:
+                best_i = i
+        if best_i + 1 < self.affinity_floor_pages:
+            return
+        key = keys[best_i]
+        tokens = (best_i + 1) * self._page_size
+        try:
+            seq_len = int(np.asarray(payload[0][0]).shape[1])
+        except Exception:
+            return
+        if tokens > seq_len:
+            return
+        sliced = [(np.asarray(k)[:, :tokens].copy(),
+                   np.asarray(v)[:, :tokens].copy())
+                  for k, v in payload]
+        if self._zero_copy:
+            self._fabric_payloads[key] = sliced
+        else:
+            try:
+                ship_kv(self.connector, f"prefix/{key}", sliced)
+            except Exception as e:
+                logger.warning("prefix publish %s failed (%s: %s)",
+                               key[:12], type(e).__name__, e)
+                return
+        self._publish_left -= 1
+        self._fabric[key] = {"tokens": tokens, "pages": best_i + 1,
+                             "layers": len(sliced)}
+        self._fabric.move_to_end(key)
+        self.cache.note_publish(tokens)
+        while len(self._fabric) > FABRIC_CAP:
+            self._drop_fabric(next(iter(self._fabric)))
+
+    def _drop_fabric(self, key: str) -> None:
+        """Evict one fabric entry: index row, zero-copy payload, and
+        (wire path) the connector keys ship_kv left behind."""
+        entry = self._fabric.pop(key, None)
+        self._fabric_payloads.pop(key, None)
+        if entry is not None and not self._zero_copy:
+            self.connector.cleanup(f"prefix/{key}/meta")
+            for i in range(int(entry.get("layers", 0))):
+                self.connector.cleanup(f"prefix/{key}/L{i}")
+
+    def _fetch_prefix(self, key: str, ctx: "_ReqCtx") -> list:
+        """Fetch a published prefix payload.  The wire path rides the
+        kv_transfer integrity/deadline guards (KVIntegrityError on a
+        torn stream, KVDeadlineExceeded past the request budget) and
+        RE-PUBLISHES after the read — connector gets pop."""
+        fault_point("prefix_pull")
+        if self._zero_copy:
+            payload = self._fabric_payloads.get(key)
+            if payload is None:
+                raise KeyError(f"fabric payload {key[:12]} vanished")
+            return payload
+        payload = recv_kv(self.connector, f"prefix/{key}",
+                          timeout=self.handoff_timeout_s,
+                          deadline_ts=ctx.deadline_ts)
+        ship_kv(self.connector, f"prefix/{key}", payload)
+        return payload
+
+    def _maybe_pull_prefix(self, ctx: "_ReqCtx",
+                           replica: EngineReplica) -> dict:
+        """When the chosen replica's digest misses a prefix the fabric
+        holds, pull it and inject instead of re-prefilling.  Returns
+        the extra ``_submit_to`` kwargs ({} = no injection).  ANY fetch
+        failure degrades to plain recompute — the lost-payload
+        contract; an integrity failure also evicts the entry (its bytes
+        can no longer be trusted)."""
+        if not self.affinity_routing or not self._fabric:
+            return {}
+        keys = self._page_keys(ctx)
+        best_i = -1
+        for i, key in enumerate(keys):
+            if key in self._fabric:
+                best_i = i
+        local_pages = (ctx.affinity or {}).get("expected_hit_pages", 0)
+        # freshness floor: the digest refreshes on a stride, but the
+        # router knows which prefixes it ALREADY routed here — a
+        # replica that just prefilled this prefix would only have its
+        # own radix hit shadowed by an injected pull
+        rec = self._replica_keys.get(replica.replica_id)
+        if rec:
+            for i in range(len(keys) - 1, -1, -1):
+                if keys[i] in rec:
+                    local_pages = max(local_pages, i + 1)
+                    break
+        if best_i < 0 or \
+                (best_i + 1) - local_pages < self.affinity_floor_pages:
+            return {}
+        key = keys[best_i]
+        tokens = int(self._fabric[key]["tokens"])
+        src = self.cache.key_src(key)
+        t0, w0 = time.perf_counter(), time.time()
+        try:
+            payload = self._fetch_prefix(key, ctx)
+        except Exception as e:
+            logger.warning(
+                "prefix pull %s for %s failed (%s: %s); replica "
+                "recomputes", key[:12], ctx.request_id,
+                type(e).__name__, e)
+            self._drop_fabric(key)
+            self.cache.note_pull(0, ok=False)
+            return {}
+        self.prefix_pull_seconds.observe(time.perf_counter() - t0)
+        n = sum(int(np.asarray(k).nbytes) + int(np.asarray(v).nbytes)
+                for k, v in payload)
+        resilience_metrics.inc("kv_prefix_pull_bytes_total", n,
+                               src=src)
+        self.cache.note_pull(tokens, ok=True)
+        journey.record_journey(
+            ctx.trace, journey.SPAN_PREFIX_PULL, w0,
+            time.perf_counter() - t0,
+            replica_id=replica.replica_id, role=replica.role,
+            cat="handoff",
+            args={"key": key, "tokens": tokens, "bytes": n,
+                  "src": src, "pages": best_i + 1})
+        return {"injected_kv": payload,
+                "extra_info": {"prefix_pull": {"tokens": tokens,
+                                               "src": src}}}
+
     # ------------------------------------------------------------ health
     def _refresh_health(self) -> None:
         """Probe every replica's /health contract; eject non-200s from
@@ -413,6 +682,20 @@ class DisaggRouter:
             if healthy and r.ejected:
                 logger.info("replica %s recovered; re-admitting",
                             r.replica_id)
+            if not healthy and not r.ejected:
+                # freshly ejected: its digest must stop steering
+                # affinity NOW, not at the next stride — the coverage
+                # may have died with the replica, and a stale digest
+                # would keep pinning requests to it the moment it
+                # re-admits with a cold cache.  Dead replicas forget
+                # entirely; a live-but-unhealthy one keeps its counter
+                # baseline (invalidate) so re-admission never
+                # double-counts its lifetime hit/prefill totals.
+                if r.dead:
+                    self.cache.forget_replica(r.replica_id)
+                else:
+                    self.cache.invalidate_digest(r.replica_id)
+                self._replica_keys.pop(r.replica_id, None)
             r.ejected = not healthy
         self.refresh_gauges()
 
@@ -452,6 +735,102 @@ class DisaggRouter:
         if not healthy:
             return None
         return min(healthy, key=lambda r: r.queue_depth)
+
+    # -------------------------------------------------- affinity dispatch
+    @staticmethod
+    def _owner_weight(salt: str, replica_id: str) -> int:
+        """Rendezvous (highest-random-weight) hash of (salt, replica):
+        every router ranks the same candidates identically, so cold
+        prefixes converge onto one owner — and when that owner leaves
+        rotation only ITS salts re-home (no global reshuffle, unlike
+        modular hashing).  The salt is the deepest chain key when the
+        request carries prompt pages (identical prefixes converge even
+        across tenants — the shared-system-prompt case) and the tenant
+        otherwise."""
+        return int.from_bytes(
+            blake2b(f"{salt}|{replica_id}".encode(),
+                    digest_size=8).digest(), "big")
+
+    def _least_loaded_owner(self, healthy: list[EngineReplica],
+                            tenant: Optional[str],
+                            keys: list[str]) -> EngineReplica:
+        """Cold-prefix placement: converge on the rendezvous owner of
+        the prefix identity (the chain key at the affinity-floor depth;
+        tenant when the prompt has no pages) while the owner trails the
+        least-loaded candidate by at most ``cold_owner_slack`` queue
+        slots — past that, load wins and ties break toward the owner.
+        The floor-depth key — NOT the deepest — is the identity:
+        deeper keys mix in each request's unique suffix and scatter
+        requests that share a system prompt, while the floor depth is
+        exactly the shallowest overlap worth routing on.  No tenant
+        means no owner: plain ``_pick`` order (stable first),
+        bit-identical to the cache-blind router."""
+        chosen = min(healthy, key=lambda r: r.queue_depth)
+        if tenant is None:
+            return chosen
+        salt = (keys[min(len(keys), self.affinity_floor_pages) - 1]
+                if keys else tenant)
+        owner = max(healthy, key=lambda r: self._owner_weight(
+            salt, r.replica_id))
+        if owner.queue_depth <= chosen.queue_depth + self.cold_owner_slack:
+            return owner
+        depth = chosen.queue_depth
+        tied = [r for r in healthy if r.queue_depth == depth]
+        if len(tied) == 1:
+            return chosen
+        return max(tied, key=lambda r: self._owner_weight(
+            salt, r.replica_id))
+
+    def _pick_affinity(self, pool: list[EngineReplica],
+                       ctx: "_ReqCtx") -> Optional[EngineReplica]:
+        """Prefix-affinity placement: among healthy replicas of
+        ``pool``, score ``expected_hit_tokens * affinity_weight -
+        queue_depth * load_weight`` against the live digests.  The
+        hysteresis floor keeps sub-``affinity_floor_pages`` hits from
+        overriding load balancing (those fall to the cold path), and
+        score ties break on the tenant's rendezvous owner.  Only first
+        placements come here — failover replays use plain ``_pick``
+        (affinity-blind by contract)."""
+        healthy = self._healthy(pool)
+        if not healthy:
+            return None
+        keys = self._page_keys(ctx)
+        tenant = ctx.info.get("tenant")
+        cov = self.cache.expected_hits(
+            [r.replica_id for r in healthy], keys)
+        floor_tokens = self.affinity_floor_pages * self._page_size
+        best_hit = max(hit for _, hit in cov.values())
+        if best_hit < floor_tokens:
+            chosen = self._least_loaded_owner(healthy, tenant, keys)
+            outcome = AFFINITY_MISS
+        else:
+            def score(r: EngineReplica) -> float:
+                return (cov[r.replica_id][1] * self.affinity_weight
+                        - r.queue_depth * self.load_weight)
+
+            top = max(score(r) for r in healthy)
+            tied = [r for r in healthy if score(r) >= top - 1e-9]
+            chosen = (tied[0] if tenant is None or len(tied) == 1
+                      else max(tied, key=lambda r: self._owner_weight(
+                          tenant, r.replica_id)))
+            outcome = (AFFINITY_HIT
+                       if cov[chosen.replica_id][1] >= floor_tokens
+                       else AFFINITY_LOAD_OVERRIDE)
+        doc = {
+            "request_id": ctx.request_id,
+            "tenant": tenant,
+            "outcome": outcome,
+            "chosen": chosen.replica_id,
+            "expected_hit_pages": cov[chosen.replica_id][0],
+            "expected_hit_tokens": cov[chosen.replica_id][1],
+            "best_hit_tokens": best_hit,
+            "queue_depth": chosen.queue_depth,
+        }
+        ctx.affinity = doc
+        self.cache.note_affinity(doc)
+        resilience_metrics.inc("router_affinity_dispatch_total",
+                               outcome=outcome)
+        return chosen
 
     # -------------------------------------------------------- drain mode
     def drain(self, replica_id: str) -> None:
@@ -554,6 +933,7 @@ class DisaggRouter:
         self.replicas = self.prefills + self.decodes
         self._zero_gauge_if_emptied(r.role)
         self.cache.forget_replica(replica_id)
+        self._replica_keys.pop(replica_id, None)
         self.refresh_gauges()
         return r
 
@@ -605,17 +985,36 @@ class DisaggRouter:
         """(Re)place a request on the topology according to the
         degradation ladder."""
         t0, w0 = time.perf_counter(), time.time()
-        prefill = self._pick(self.prefills, avoid=avoid)
-        decode = self._pick(self.decodes, avoid=avoid)
+        # affinity applies to FIRST placements only: failover replays
+        # (avoid set / attempts > 0) fall back to plain least-loaded so
+        # a dead owner can never pin its tenants' requests
+        affinity = (self.affinity_routing and avoid is None
+                    and ctx.attempts == 0)
+        # the tier that will run the PREFILL work is the one affinity
+        # steers: the prefill pool on a two-tier topology, the decode
+        # pool when it alone exists (single-tier colocated serving)
+        prefill = (self._pick_affinity(self.prefills, ctx)
+                   if affinity and self.prefills
+                   else self._pick(self.prefills, avoid=avoid))
+        decode = (self._pick_affinity(self.decodes, ctx)
+                  if affinity and self.decodes and not self.prefills
+                  else self._pick(self.decodes, avoid=avoid))
         if prefill is not None and decode is not None:
             # the disaggregated fast path: prompt processing + first
             # token on the prefill tier (max_tokens clamped to 1 — the
             # decode tier owns the rest of the stream)
             ctx.phase = ROLE_PREFILL
             ctx.replica = prefill
+            # pull BEFORE the dispatch is metered: _note_cache_dispatch
+            # records this request's keys as the replica's coverage,
+            # which must not mask a genuinely cold replica from the
+            # pull decision
+            pull = self._maybe_pull_prefix(ctx, prefill) \
+                if affinity else {}
             exp = self._note_cache_dispatch(ctx, prefill)
             self._submit_to(prefill, ctx,
-                            replace(ctx.sampling_params, max_tokens=1))
+                            replace(ctx.sampling_params, max_tokens=1),
+                            **pull)
             journey.record_journey(
                 ctx.trace, journey.SPAN_DISPATCH, w0,
                 time.perf_counter() - t0,
@@ -623,7 +1022,9 @@ class DisaggRouter:
                       "phase": ROLE_PREFILL, "attempt": ctx.attempts,
                       "expected_hit_tokens":
                           exp.get("expected_hit_tokens", 0),
-                      "peer_hit_tokens": exp.get("peer_hit_tokens", 0)})
+                      "peer_hit_tokens": exp.get("peer_hit_tokens", 0),
+                      "affinity_outcome":
+                          (ctx.affinity or {}).get("outcome")})
             return
         survivor = decode or prefill or self._pick(self.replicas,
                                                    avoid=avoid)
@@ -661,12 +1062,15 @@ class DisaggRouter:
     def _submit_to(self, replica: EngineReplica, ctx: _ReqCtx,
                    sp: SamplingParams,
                    suppress_kv_transfer: bool = False,
+                   extra_info: Optional[dict] = None,
                    **kwargs) -> None:
         # deadline re-stamped across every hop: the remaining budget is
         # re-derived and converted back to an expiry, the same dance
         # the orchestrator does on stage handoffs — a slow prefill tier
         # shrinks what the decode tier gets
         info = dict(ctx.info)
+        if extra_info:
+            info.update(extra_info)
         if suppress_kv_transfer:
             # colocated placement on a prefill-role engine: nobody
             # will consume an extracted payload — don't pay the
@@ -695,6 +1099,7 @@ class DisaggRouter:
         finish), route outputs, ship pending handoffs, fail over
         requests stranded on dead replicas."""
         self._steps += 1
+        self._publish_left = self.publish_budget
         self._refresh_health()
         if self._steps % DIGEST_STRIDE == 0:
             self._refresh_digests()
@@ -790,6 +1195,10 @@ class DisaggRouter:
                     self._adopt_or_recompute(ctx, None,
                                              "payload_stalled")
                 continue
+            # the fabric publish leg: completed prefill payloads are
+            # the only place whole-prefix KV exists host-side — carve
+            # the in-demand shared slice off before the handoff ships
+            self._maybe_publish_prefix(ctx, payload)
             zero_copy = self._zero_copy
             t0 = time.perf_counter()
             received = None
@@ -970,9 +1379,11 @@ class DisaggRouter:
 
     # ------------------------------------------------------ introspection
     def disagg_snapshot(self) -> dict:
-        """The exposition's ``disagg`` block: the handoff histogram +
-        the fleet cache-economics counters/gauges."""
+        """The exposition's ``disagg`` block: the handoff + fabric-pull
+        histograms + the fleet cache-economics counters/gauges."""
         return {"handoff_seconds": self.handoff_seconds.snapshot(),
+                "prefix_pull_seconds":
+                    self.prefix_pull_seconds.snapshot(),
                 "cache": self.cache.exposition()}
 
     def debug_snapshot(self) -> dict:
@@ -1005,5 +1416,6 @@ class DisaggRouter:
                 "handoffs": self.handoffs,
                 "failovers": dict(self.failovers),
                 "sheds": self.sheds,
+                "fabric_entries": len(self._fabric),
             },
         }

@@ -510,18 +510,29 @@ class LLMEngine:
             if req.output_token_ids:
                 req.output_token_ids.pop()  # unbackable first token
             return
-        table = self.scheduler.kv.adopt_streamed(req, use)
+        # provenance split (PR 19): a payload the router pulled from
+        # the cluster KV fabric admits through adopt_prefix and counts
+        # as prefix_pull_tokens; a disaggregated-prefill handoff stays
+        # on the adopt_streamed/streamed_tokens path.  Same allocator,
+        # same guards — only the accounting differs.
+        pulled = bool(req.additional_information.get("prefix_pull"))
+        kv = self.scheduler.kv
+        table = (kv.adopt_prefix(req, use) if pulled
+                 else kv.adopt_streamed(req, use))
         if table is not None:
             try:
                 t0, w0 = time.perf_counter(), time.time()
                 trimmed = [(k[:, :use], v[:, :use]) for k, v in payload]
                 self.runner.inject_kv(table, trimmed)
                 req.num_computed_tokens = use
-                self.scheduler.kv.note_streamed(use)
+                (kv.note_pulled if pulled else kv.note_streamed)(use)
                 get_recorder().record(
                     req.additional_information.get("trace"), "kv_inject",
                     w0, time.perf_counter() - t0, stage_id=self.stage_id,
-                    cat="kv", args={"tokens": use}, **self.span_tags,
+                    cat="kv",
+                    args={"tokens": use,
+                          "src": "fabric" if pulled else "peer"},
+                    **self.span_tags,
                 )
                 return
             except (ValueError, IndexError) as e:

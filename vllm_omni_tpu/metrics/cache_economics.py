@@ -60,6 +60,18 @@ LEDGER_SIZE = 128
 #: duplicated-prefix rows exported on the board
 TOP_DUPLICATES = 10
 
+#: affinity decision ring bound (the /debug/cache "affinity" block):
+#: enough recent decisions to explain "why did this land there", small
+#: enough that the board stays a cheap copy under the lock
+AFFINITY_RING = 64
+
+#: affinity dispatch outcomes (the {outcome} label on
+#: router_affinity_dispatch_total)
+AFFINITY_HIT = "hit"                  # affinity score chose a warm owner
+AFFINITY_MISS = "miss"                # cold prefix: load + tenant-hash owner
+AFFINITY_LOAD_OVERRIDE = "load_override"  # a warm hit existed but load won
+AFFINITY_OUTCOMES = (AFFINITY_HIT, AFFINITY_MISS, AFFINITY_LOAD_OVERRIDE)
+
 
 class CacheEconomics:
     """Fleet-wide cache board: replica digests in, regret signal out."""
@@ -89,6 +101,18 @@ class CacheEconomics:
         self._ledger: deque = deque(maxlen=ledger_size)
         self._dispatches = 0
         self.bytes_per_token = int(bytes_per_token)
+        # affinity decision ring + per-outcome counters (PR 19): every
+        # affinity-scored placement leaves a bounded explanation here
+        self._affinity_ring: deque = deque(maxlen=AFFINITY_RING)
+        self._affinity_outcomes: dict[str, int] = {
+            o: 0 for o in AFFINITY_OUTCOMES}
+        # cluster KV fabric ledgers: prefix pages published to the
+        # shared store and pages pulled back instead of re-prefilled
+        self._fabric_published_tokens = 0
+        self._fabric_publishes = 0
+        self._fabric_pulled_tokens = 0
+        self._fabric_pulls = 0
+        self._fabric_pull_failures = 0
 
     # ------------------------------------------------------- digest side
     def observe_digest(self, replica_id: str, digest: dict,
@@ -121,6 +145,96 @@ class CacheEconomics:
             self._digests.pop(replica_id, None)
             self._cover.pop(replica_id, None)
             self._last.pop(replica_id, None)
+
+    def invalidate_digest(self, replica_id: str) -> None:
+        """Drop a replica's digest WITHOUT dropping its counter
+        baseline.  The ejection path: an ejected replica's coverage
+        must stop steering affinity immediately (it may come back with
+        a cold cache, or not at all), but its cumulative hit/prefill
+        baseline must survive re-admission — ``forget_replica`` here
+        would reset ``_last`` and double-count the replica's lifetime
+        counters into the fleet totals on the next observe."""
+        with self._lock:
+            self._digests.pop(replica_id, None)
+            self._cover.pop(replica_id, None)
+
+    def expected_hits(self, replica_ids: Sequence[str],
+                      keys: Sequence[str]) -> dict[str, tuple[int, int]]:
+        """Affinity scoring probe: for each candidate replica, the
+        (covered pages, covered tokens) its current digest promises for
+        ``keys``.  One lock hold for the whole candidate set — the
+        dispatch hot path calls this once per request.  Replicas with
+        no digest (cold, ejected, never exported) score (0, 0)."""
+        with self._lock:
+            out: dict[str, tuple[int, int]] = {}
+            for rid in replica_ids:
+                cover = self._cover.get(rid)
+                if not cover:
+                    out[rid] = (0, 0)
+                    continue
+                pages, _ = self._coverage(cover, keys)
+                out[rid] = (pages, pages * self._page_size_locked(rid))
+            return out
+
+    def key_src(self, key: str) -> str:
+        """Provenance label for a fabric pull of ``key``: ``peer`` when
+        some live replica's digest advertises it HBM-resident, ``cold``
+        when only a parked tier (or no digest at all — the publisher
+        may have evicted since) backs it."""
+        with self._lock:
+            for cover in self._cover.values():
+                hit = cover.get(key)
+                if hit is not None and hit[1] == TIER_HBM:
+                    return "peer"
+            return "cold"
+
+    def replica_heat(self) -> dict[str, int]:
+        """Per-replica cache heat: HBM-resident tokens promised by each
+        live digest (``hbm_tokens`` summed over leaf-most nodes would
+        double-count ancestors, so sum per-node own pages instead:
+        every digest node is one page).  The control plane subtracts
+        this from donor scores so scale-down/re-role stops evicting the
+        fleet's hottest caches."""
+        with self._lock:
+            heat: dict[str, int] = {}
+            for rid, cover in self._cover.items():
+                page_size = self._page_size_locked(rid)
+                heat[rid] = page_size * sum(
+                    1 for _, tier in cover.values() if tier == TIER_HBM)
+            return heat
+
+    # ----------------------------------------------------- affinity side
+    def note_affinity(self, doc: dict) -> None:
+        """Record one affinity routing decision (bounded ring + outcome
+        counter).  ``doc`` carries outcome/chosen/score breakdowns from
+        the router; the ring is the /debug/cache explanation surface."""
+        outcome = doc.get("outcome")
+        with self._lock:
+            self._affinity_ring.append(doc)
+            if outcome in self._affinity_outcomes:
+                self._affinity_outcomes[outcome] += 1
+
+    def note_publish(self, tokens: int) -> None:
+        """Meter one prefix-page publication into the cluster fabric."""
+        with self._lock:
+            self._fabric_publishes += 1
+            self._fabric_published_tokens += int(tokens)
+
+    def note_pull(self, tokens: int, ok: bool = True) -> None:
+        """Meter one fabric pull attempt (tokens admitted on success;
+        a failure degrades to recompute — the lost-payload contract).
+        Pulled tokens count toward the fleet hit ledger: they were
+        served from fleet cache instead of re-prefilled, which is
+        exactly what the hit-rate gauge prices.  No double count — a
+        pull injects pages the local radix did NOT hold, so the same
+        tokens never also arrive through a replica digest delta."""
+        with self._lock:
+            if ok:
+                self._fabric_pulls += 1
+                self._fabric_pulled_tokens += int(tokens)
+                self._fleet_hit_tokens += int(tokens)
+            else:
+                self._fabric_pull_failures += 1
 
     # ----------------------------------------------------- dispatch side
     @staticmethod
@@ -320,10 +434,23 @@ class CacheEconomics:
                 "top_duplicates": top[:TOP_DUPLICATES],
                 "regret_ledger": list(self._ledger),
                 "pending_dispatches": len(self._pending),
+                "affinity": {
+                    "ring": list(self._affinity_ring),
+                    "outcomes": dict(self._affinity_outcomes),
+                },
+                "fabric": {
+                    "publishes": self._fabric_publishes,
+                    "published_tokens": self._fabric_published_tokens,
+                    "pulls": self._fabric_pulls,
+                    "pulled_tokens": self._fabric_pulled_tokens,
+                    "pull_failures": self._fabric_pull_failures,
+                },
             }
 
 
 __all__ = [
     "CacheEconomics", "REASON_PEER_REPLICA", "REASON_PEER_COLD_TIER",
-    "REASONS", "LEDGER_SIZE", "TOP_DUPLICATES",
+    "REASONS", "LEDGER_SIZE", "TOP_DUPLICATES", "AFFINITY_RING",
+    "AFFINITY_HIT", "AFFINITY_MISS", "AFFINITY_LOAD_OVERRIDE",
+    "AFFINITY_OUTCOMES",
 ]

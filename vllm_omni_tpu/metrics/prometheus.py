@@ -396,6 +396,24 @@ METRIC_SPECS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Per-tenant wasted re-prefill tokens, sketch estimate — which "
         "tenants' traffic the cache-blind router scatters",
         ("stage", "tenant")),
+    # ---- omniaffinity (disagg/router.py, docs/disaggregation.md):
+    # prefix-affinity dispatch + the cluster KV fabric
+    "router_affinity_dispatch_total": (
+        "counter",
+        "Affinity-scored placements by outcome: hit (a warm owner "
+        "won), miss (cold prefix — load + tenant-hash owner), "
+        "load_override (a warm hit existed but load won the score)",
+        ("outcome",)),
+    "kv_prefix_pull_bytes_total": (
+        "counter",
+        "Bytes of shared-prefix KV pulled from the cluster fabric "
+        "instead of re-prefilled; src=peer when a live replica still "
+        "advertises the prefix HBM-resident, cold otherwise",
+        ("src",)),
+    "kv_prefix_pull_seconds": (
+        "histogram",
+        "Fabric prefix-pull latency: fetch + integrity verify + "
+        "re-publish, as seen by the router thread", ()),
 }
 
 #: attribution meter -> (/metrics series, fixed extra labels); meters
@@ -714,6 +732,9 @@ def render_exposition(summary: dict, engine_snaps: dict,
     if disagg and disagg.get("handoff_seconds", {}).get("count"):
         exp.histogram("kv_handoff_seconds", {},
                       disagg["handoff_seconds"])
+    if disagg and disagg.get("prefix_pull_seconds", {}).get("count"):
+        exp.histogram("kv_prefix_pull_seconds", {},
+                      disagg["prefix_pull_seconds"])
     cache = (disagg or {}).get("cache")
     if cache:
         # fleet cache economics (metrics/cache_economics.py): the

@@ -56,6 +56,10 @@ SPAN_PREFIX_HIT = "prefix_hit"
 SPAN_HANDOFF_SHIP = "kv_handoff_ship"
 SPAN_HANDOFF_RECV = "kv_handoff_recv"
 SPAN_ADOPT = "decode_adopt"
+#: cluster-KV-fabric pull leg (cat="handoff"): the router fetched a
+#: shared-prefix payload from the connector store instead of letting
+#: the chosen replica re-prefill it — args carry key/tokens/bytes/src
+SPAN_PREFIX_PULL = "prefix_pull"
 #: control-plane operation spans (cat="controlplane"): "cp:" + kind —
 #: kinds are the controller's action/operation names (drain, undrain,
 #: rerole, scale_up, remove_replica, scale_down)
@@ -137,7 +141,8 @@ def inbound_trace_id(headers) -> Optional[str]:
 __all__ = [
     "SPAN_DISPATCH", "SPAN_FAILOVER", "SPAN_SHED", "SPAN_DEGRADED",
     "SPAN_PREFIX_HIT",
-    "SPAN_HANDOFF_SHIP", "SPAN_HANDOFF_RECV", "SPAN_ADOPT", "CP_PREFIX",
+    "SPAN_HANDOFF_SHIP", "SPAN_HANDOFF_RECV", "SPAN_ADOPT",
+    "SPAN_PREFIX_PULL", "CP_PREFIX",
     "ROUTER_TRACK", "record_journey", "journey_instant",
     "parse_traceparent", "inbound_trace_id",
 ]
