@@ -1,0 +1,343 @@
+#!/usr/bin/env python
+"""BENCH_r20: int8-resident paged KV vs the bf16 pool (omniquant-kv).
+
+Two engine arms share ONE HBM page-pool budget (``--budget-bytes``):
+the dense bf16 layout and the int8 layout (``kv_cache_dtype=int8``,
+per-page per-head scales resident next to the pages).  The bench
+measures what the quantized layout is FOR — session capacity:
+
+- **concurrency ladder** (per arm, engine-direct): N identical
+  long-decode sessions start together; the step loop timestamps every
+  token of every session, so TPOT here is the real inter-token
+  latency, preempt/recompute stalls included (an SSE client can't see
+  those — this server end-loads its streams).
+  ``max_sessions_at_tpot_slo`` is the largest N where every session
+  completes and the p99-across-sessions of each session's WORST
+  inter-token gap stays under the target.  The
+  dense pool runs out of pages first — the scheduler's
+  preempt/recompute thrash is exactly what blows the p99 — so the
+  int8 arm must hold MORE concurrent sessions at the same SLO, at a
+  decode tok/s the artifact also records alongside the rung's
+  preemption count.
+- **serving curve** (int8 arm, open-loop in-proc): the same offered
+  rates the r11 unified-engine baseline committed (4/8/16 rps),
+  written at the top level so ``scripts/perfguard.py`` finds the
+  comparable surface:
+
+      python scripts/perfguard.py BENCH_r11_unified.json \\
+          BENCH_r20_kvquant.json
+
+  The full run invokes that gate itself (``--no-gate`` to skip): the
+  quantized engine must not regress goodput / attainment / p99s
+  against the committed full-precision baseline.
+
+Full runs repeat everything ``--trials`` times (default 3; smoke 1) on
+fresh engines and commit the MEDIAN-by-goodput trial — wall-clock
+numbers on a contended host are noise, and the gate compares medians,
+not lottery tickets.  Every trial's headline numbers land under
+``trials`` so the spread is auditable.
+
+    JAX_PLATFORMS=cpu python scripts/kv_quant_bench.py --smoke
+    JAX_PLATFORMS=cpu python scripts/kv_quant_bench.py
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from vllm_omni_tpu.loadgen import (  # noqa: E402
+    RequestRecord,
+    SLOTargets,
+    build_workload,
+    poisson_arrivals,
+    run_inproc,
+    summarize,
+    validate_curve_point,
+)
+from vllm_omni_tpu.loadgen.workload import Scenario  # noqa: E402
+
+#: per-session worst-case inter-token latency the ladder holds
+#: sessions to — an order of magnitude over a clean tiny-model decode
+#: step (2-6 ms), violated by a session parking behind a
+#: preempt/recompute cycle (it waits for a peer to finish and free
+#: pages, tens of steps of stall); an aggregate-p99 over all gaps
+#: would average a single victim's stall away, so the rung gate takes
+#: the p99 over SESSIONS of each session's worst gap
+TPOT_SLO_MS = 30.0
+LADDER_SLO = SLOTargets(ttft_ms=60_000.0, tpot_ms=TPOT_SLO_MS)
+#: one ladder session: 16-token prompt + fixed-length decode
+SESSION_PROMPT = 16
+#: the r11 baseline's SLO targets — the gated curve reuses them
+CURVE_SLO = SLOTargets(ttft_ms=2000.0, tpot_ms=500.0)
+
+CHAT_CATALOG = [Scenario("chat", weight=1.0, prompt_len=(4, 12),
+                         output_len=(8, 12), stream=True)]
+
+
+def _engine(arm, budget, page_size, max_model_len):
+    import jax
+    import jax.numpy as jnp
+
+    from vllm_omni_tpu.engine import EngineConfig, LLMEngine
+    from vllm_omni_tpu.models.common import transformer as tfm
+
+    cfg = tfm.TransformerConfig.tiny(vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    return LLMEngine(params, cfg, EngineConfig(
+        num_pages=64, page_size=page_size, max_model_len=max_model_len,
+        max_num_seqs=16, max_queue_depth=64, dtype=jnp.bfloat16,
+        kv_cache_dtype=arm, kv_hbm_budget_bytes=budget,
+        # capacity bench: random prompts never hit, and cached free
+        # pages would blur the rung-to-rung pool accounting
+        enable_prefix_caching=False,
+        # precompile every bucket the ladder walks: a mid-rung XLA
+        # compile would bill its stall to that rung's gaps (OL11)
+        warmup=True))
+
+
+def _ladder_rung(eng, label, n, decode_len, rng):
+    """One burst of N sessions on a drained engine; every token of
+    every session is timestamped from the step loop."""
+    from vllm_omni_tpu.sampling_params import SamplingParams
+
+    sp = SamplingParams(temperature=0.0, max_tokens=decode_len)
+    preempt0 = eng.scheduler.num_preemptions
+    for i in range(n):
+        prompt = [int(t) for t in rng.integers(
+            1, 60, size=SESSION_PROMPT)]
+        eng.add_request(prompt, sp, request_id=f"{label}-c{n}-s{i}")
+    handles = {r.request_id: r for r in eng.scheduler.waiting}
+    assert len(handles) == n
+    times = {rid: [] for rid in handles}
+    t0 = time.monotonic()
+    while eng.has_unfinished_requests:
+        eng.step()
+        now = time.monotonic() - t0
+        for rid, req in handles.items():
+            while len(times[rid]) < len(req.output_token_ids):
+                times[rid].append(now)
+    records, gaps, worst = [], [], []
+    for rid, ts in times.items():
+        session_gaps = [b - a for a, b in zip(ts, ts[1:])]
+        gaps.extend(session_gaps)
+        if session_gaps:
+            worst.append(max(session_gaps))
+        records.append(RequestRecord(
+            request_id=rid, tenant="bench", scenario="session",
+            arrival_s=0.0, fired_s=0.0,
+            first_s=ts[0] if ts else None,
+            end_s=ts[-1] if ts else None,
+            tokens_out=len(ts),
+            status="ok" if len(ts) == decode_len else "error"))
+    wall = time.monotonic() - t0
+    point = summarize(records, offered_rps=n / max(wall, 1e-9),
+                      slo=LADDER_SLO)
+    errs = validate_curve_point(point)
+    assert not errs, f"ladder point schema violations: {errs}"
+    def _p99(vals):
+        s = sorted(vals)
+        return s[max(int(np.ceil(0.99 * len(s))) - 1, 0)] if s else 0.0
+
+    point["concurrency"] = n
+    point["wall_s"] = round(wall, 3)
+    # the REAL per-token latency tail (step-loop timestamps): the rung
+    # is held to worst_p99 — the p99 over sessions of each session's
+    # WORST gap — because summarize()'s tpot is a per-request mean and
+    # even a p99 over all gaps averages one victim's stall away
+    point["itl_ms"] = {
+        "p99": round(_p99(g * 1000.0 for g in gaps), 3),
+        "worst_p99": round(_p99(w * 1000.0 for w in worst), 3),
+        "max": round(max(gaps) * 1000.0, 3) if gaps else 0.0,
+    }
+    point["preemptions"] = eng.scheduler.num_preemptions - preempt0
+    return point
+
+
+def run_ladder(arm, label, budget, rungs, decode_len, page_size,
+               max_model_len):
+    eng = _engine(arm, budget, page_size, max_model_len)
+    pages = eng.scheduler.kv.num_pages
+    bpt = eng.metrics_snapshot()["kv"]["bytes_per_token"]
+    print(f"ladder: {label} arm ({pages} pages in {budget} B)")
+    points = []
+    for n in rungs:
+        rng = np.random.default_rng(1000 + n)
+        point = _ladder_rung(eng, label, n, decode_len, rng)
+        points.append(point)
+        print(f"  [{label}] N={n}: completed={point['completed']}/{n} "
+              f"itl_worst_p99={point['itl_ms']['worst_p99']}ms "
+              f"preempts={point['preemptions']} "
+              f"tok/s={point['attained_tok_per_s']}")
+    held = [p for p in points
+            if p["completed"] == p["concurrency"]
+            and p["itl_ms"]["worst_p99"] <= TPOT_SLO_MS]
+    best = max(held, key=lambda p: p["concurrency"]) if held else None
+    return {
+        "kv_pages": pages,
+        "bytes_per_token": bpt,
+        "tpot_slo_ms": TPOT_SLO_MS,
+        "max_sessions_at_tpot_slo": (best["concurrency"] if best
+                                     else 0),
+        "decode_tok_per_s_at_max": (best["attained_tok_per_s"]
+                                    if best else 0.0),
+        "ladder": points,
+    }
+
+
+def run_serving_curve(budget, rates, n_requests, page_size,
+                      max_model_len):
+    """int8-arm open-loop curve at the r11 baseline's offered rates —
+    the perfguard-comparable surface.  Client-observed via AsyncOmni
+    (this server end-loads its streams, so ttft here is conservative:
+    it reads as the full generation time)."""
+    from vllm_omni_tpu.config.stage import StageConfig
+    from vllm_omni_tpu.entrypoints.async_omni import AsyncOmni
+
+    omni = AsyncOmni(stage_configs=[StageConfig(
+        stage_id=0, stage_type="llm",
+        engine_args={
+            "model_factory": "tests.helpers:tiny_lm_factory",
+            "num_pages": 64, "page_size": page_size,
+            "max_model_len": max_model_len, "max_num_seqs": 16,
+            "max_queue_depth": 64,
+            "kv_cache_dtype": "int8", "kv_hbm_budget_bytes": budget,
+            "slo_ttft_ms": CURVE_SLO.ttft_ms,
+            "slo_tpot_ms": CURVE_SLO.tpot_ms,
+            "warmup": True,
+        },
+        engine_input_source=[-1], final_output=True,
+        final_output_type="text",
+        default_sampling_params={"temperature": 0.0},
+    )])
+    curve = []
+    try:
+        for i, rate in enumerate(rates):
+            wl = build_workload(
+                poisson_arrivals(rate, n_requests, seed=100 + i),
+                catalog=CHAT_CATALOG, seed=200 + i, vocab_size=60,
+                id_prefix=f"curve{i}")
+            records = run_inproc(omni, wl, timeout_s=600.0)
+            point = summarize(records, offered_rps=rate, slo=CURVE_SLO)
+            errs = validate_curve_point(point)
+            assert not errs, f"curve point schema violations: {errs}"
+            curve.append(point)
+            print(f"  [int8 curve] rps={rate}: goodput="
+                  f"{point['goodput_tok_per_s']} tok/s "
+                  f"attainment={point['slo_attainment']}")
+    finally:
+        omni.shutdown()
+    return curve
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-speed run: short ladder, tiny curve, no "
+                         "perfguard gate")
+    ap.add_argument("--trials", type=int, default=None,
+                    help="independent repeats (fresh engines each); "
+                         "the median-by-goodput trial is committed "
+                         "(default: 3, smoke: 1)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per serving-curve rate point "
+                         "(default: 24, smoke: 8)")
+    ap.add_argument("--budget-bytes", type=int, default=64 * 1024,
+                    help="shared HBM page-pool budget for BOTH arms")
+    ap.add_argument("--decode-len", type=int, default=None,
+                    help="ladder session decode length (default: 64, "
+                         "smoke: 16)")
+    ap.add_argument("--baseline", default="BENCH_r11_unified.json")
+    ap.add_argument("--no-gate", action="store_true")
+    ap.add_argument("--out", default="BENCH_r20_kvquant.json")
+    args = ap.parse_args()
+
+    page_size, max_model_len = 4, 96
+    # full-run sessions are 16 + 64 = 80 tokens = 20 pages: the bf16
+    # pool (64 pages at the default budget) thrashes from N=4, the
+    # int8 pool (120 pages) holds through N=6
+    decode_len = args.decode_len or (16 if args.smoke else 64)
+    rungs = [2, 4] if args.smoke else [2, 4, 6, 8, 10]
+    rates = (4.0,) if args.smoke else (4.0, 8.0, 16.0)
+    n_req = args.requests or (8 if args.smoke else 24)
+    n_trials = args.trials or (1 if args.smoke else 3)
+
+    def run_trial():
+        arms = {}
+        for dtype, label in (("auto", "bf16"), ("int8", "int8")):
+            arms[label] = run_ladder(dtype, label, args.budget_bytes,
+                                     rungs, decode_len, page_size,
+                                     max_model_len)
+        curve = run_serving_curve(args.budget_bytes, rates, n_req,
+                                  page_size, max_model_len)
+        return arms, curve
+
+    trials = []
+    for i in range(n_trials):
+        arms, curve = run_trial()
+        goodput = sum(p["goodput_tok_per_s"] for p in curve)
+        trials.append((arms, curve, goodput))
+        print(f"trial {i + 1}/{n_trials}: curve_goodput={goodput:.1f} "
+              f"sessions int8={arms['int8']['max_sessions_at_tpot_slo']}"
+              f" bf16={arms['bf16']['max_sessions_at_tpot_slo']}")
+
+    # commit the median-by-goodput trial: one internally-consistent
+    # artifact (not field-wise medians no single run produced)
+    ranked = sorted(trials, key=lambda t: t[2])
+    arms, curve, _ = ranked[len(ranked) // 2]
+
+    ratio = arms["int8"]["kv_pages"] / max(arms["bf16"]["kv_pages"], 1)
+    assert ratio >= 1.8, (
+        f"int8 pool only {ratio:.2f}x the bf16 pages in the same "
+        "budget (contract: >= 1.8x)")
+    if not args.smoke:
+        # the headline: the quantized pool holds MORE concurrent
+        # sessions at the same p99 TPOT target
+        assert (arms["int8"]["max_sessions_at_tpot_slo"]
+                > arms["bf16"]["max_sessions_at_tpot_slo"]), (
+            "int8 arm did not hold more sessions at the TPOT SLO: "
+            f"{arms['int8']['max_sessions_at_tpot_slo']} vs "
+            f"{arms['bf16']['max_sessions_at_tpot_slo']}")
+
+    doc = {
+        "bench": "BENCH_r20_kvquant",
+        "smoke": args.smoke,
+        "hbm_budget_bytes": args.budget_bytes,
+        "session": {"prompt_len": SESSION_PROMPT,
+                    "decode_len": decode_len,
+                    "page_size": page_size},
+        "tpot_slo_ms": TPOT_SLO_MS,
+        "capacity_ratio_int8_over_bf16": round(ratio, 3),
+        "arms": arms,
+        "trials": [{
+            "curve_goodput_tok_per_s": round(g, 2),
+            "int8_max_sessions": a["int8"]["max_sessions_at_tpot_slo"],
+            "bf16_max_sessions": a["bf16"]["max_sessions_at_tpot_slo"],
+        } for a, _, g in trials],
+        # top level: the perfguard-comparable surface (same offered
+        # rates the r11 unified baseline committed)
+        "serving_curve": curve,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+    print(f"[kvquant] pages int8={arms['int8']['kv_pages']} "
+          f"bf16={arms['bf16']['kv_pages']} (x{ratio:.2f}) "
+          f"sessions@{TPOT_SLO_MS:.0f}ms "
+          f"int8={arms['int8']['max_sessions_at_tpot_slo']} "
+          f"bf16={arms['bf16']['max_sessions_at_tpot_slo']}")
+    print(f"wrote {args.out}")
+
+    if args.smoke or args.no_gate:
+        return 0
+    print(f"gating {args.out} vs {args.baseline}")
+    return subprocess.call([sys.executable, "scripts/perfguard.py",
+                            args.baseline, args.out])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,9 +43,9 @@ def test_ol3_sees_the_real_model_runner():
     src, path = _mutated(
         "vllm_omni_tpu/worker/model_runner.py",
         '        outs, self.kv_caches = self._run_jit(\n'
-        '            kind, (b,),',
+        '            kind, (b, self._kv_quant),',
         '        outs, _ = self._run_jit(\n'
-        '            kind, (b,),')
+        '            kind, (b, self._kv_quant),')
     found = _unsuppressed(src, path, "OL3")
     assert any("'self.kv_caches'" in f.message for f in found), found
 

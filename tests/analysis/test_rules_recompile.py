@@ -345,12 +345,13 @@ def test_pr11_missing_cache_key_dim_is_caught_by_exactly_ol11():
     src = _real_runner_source()
     needle = ("            (asm.t_pad, self._spec_v, asm.embeds is "
               "not None,\n             asm.deepstack.shape[0] if "
-              "asm.deepstack is not None else 0),")
+              "asm.deepstack is not None else 0,\n"
+              "             self._kv_quant),")
     assert needle in src, "dispatch-site anchor moved - update the test"
     mutated = src.replace(
         needle,
         "            (asm.t_pad, self._spec_v, "
-        "asm.embeds is not None),")
+        "asm.embeds is not None, self._kv_quant),")
     found = [f for f in analyze_source(
         mutated, "vllm_omni_tpu/worker/model_runner.py")
         if not f.suppressed]
@@ -359,3 +360,44 @@ def test_pr11_missing_cache_key_dim_is_caught_by_exactly_ol11():
     ol11 = [f for f in found if f.rule == "OL11"]
     assert any("'deepstack'" in f.message and "n_deep" in f.message
                for f in ol11), messages(ol11)
+
+
+# ------------------------------------------- PR 20 quantized-layout keys
+def test_kv_quant_layout_flag_in_key_is_static_config():
+    # the resident-KV layout flag is manifest bucket_attrs: carrying
+    # `self._kv_quant` in a dispatch key is the REQUIRED discriminator
+    # for the int8 executable family, never a per-request hazard
+    src = '''
+class R:
+    def precompile(self):
+        for b in self._batch_buckets:
+            self._run_jit("decode", (b, self._kv_quant), lambda: 1)
+
+    def dispatch(self, scheds):
+        b = self._bucket(len(scheds))
+        return self._run_jit("decode", (b, self._kv_quant), lambda: 1)
+'''
+    assert lint11(src) == [], messages(lint11(src))
+
+
+def test_quant_scales_kwarg_without_layout_key_is_caught():
+    # the PR 20 bug class: the quantized path conditionally binds the
+    # per-page scale operand but the cache key carries no layout
+    # discriminator — flipping kv_cache_dtype mid-fleet would alias the
+    # int8 executable onto the bf16 signature (or vice versa) and
+    # miscount a real mid-traffic compile as a cache hit
+    src = '''
+class R:
+    def precompile(self):
+        self._run_jit("unified", (8,), lambda: self._fn(0))
+
+    def dispatch(self, asm, t):
+        kwargs = {}
+        if self.kv_quantized:
+            kwargs["kv_scales"] = asm.scales
+        return self._run_jit("unified", (t,),
+                             lambda: self._fn(t, **kwargs))
+'''
+    found = lint11(src)
+    assert len(found) == 1, messages(found)
+    assert "'kv_scales'" in found[0].message

@@ -32,6 +32,11 @@ HOT_PATHS: tuple[str, ...] = (
     # listed explicitly because a stray host sync inside the ONE
     # dispatch serving a whole mixed step stalls every request at once
     "vllm_omni_tpu/ops/ragged_paged_attention.py",
+    # the shared KV quantizer is covered by the kvcache/ prefix above;
+    # listed explicitly because its helpers run inside the KV-write
+    # path of EVERY forward and inside the tier drain — a host sync in
+    # quantize/dequantize would serialize each step on each payload
+    "vllm_omni_tpu/kvcache/quant.py",
     "vllm_omni_tpu/sample/",
     "vllm_omni_tpu/worker/",
     "vllm_omni_tpu/engine/",
@@ -340,8 +345,12 @@ RECOMPILE: dict[str, tuple[str, ...]] = {
                    "_bucketed_prefill_shapes", "auto_blocks",
                    "auto_ragged_blocks"),
     # attributes holding precomputed bucket tables / static tile picks
+    # (and the resident-KV layout flag: one of exactly two executable
+    # families per kind — int8-quantized caches are a different pytree,
+    # so the flag MUST ride every dispatch key, threaded through the
+    # warmup walker so both layouts compile before traffic)
     "bucket_attrs": ("_token_buckets", "_batch_buckets", "_seq_buckets",
-                     "_token_block", "_dma_slots"),
+                     "_token_block", "_dma_slots", "_kv_quant"),
     # attribute reads that ARE per-request counts
     "per_request_attrs": ("num_new_tokens", "num_tokens",
                           "num_computed_tokens", "num_inflight_tokens",

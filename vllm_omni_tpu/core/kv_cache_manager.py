@@ -92,11 +92,19 @@ class KVCacheManager:
     def __init__(self, num_pages: int, page_size: int,
                  enable_prefix_caching: bool = True,
                  tiers: Optional[TieredKVStore] = None,
-                 policy: Optional[OffloadPolicy] = None):
+                 policy: Optional[OffloadPolicy] = None,
+                 cache_dtype: Optional[str] = None,
+                 bytes_per_token: Optional[float] = None):
         if num_pages < 1 or page_size < 1:
             raise ValueError("num_pages and page_size must be positive")
         self.num_pages = num_pages
         self.page_size = page_size
+        # resident KV layout metadata for /debug/kv (informational —
+        # the allocator is layout-agnostic): the pool dtype label
+        # ("int8" / "bfloat16" / None when the engine didn't say) and
+        # the amortized all-layer HBM bytes per cached token
+        self.cache_dtype = cache_dtype
+        self.bytes_per_token = bytes_per_token
         self.enable_prefix_caching = enable_prefix_caching
         self._free: list[int] = list(range(num_pages))
         # request_id -> allocated page ids, in sequence order
@@ -224,6 +232,8 @@ class KVCacheManager:
             "pages_free_list": len(self._free),
             "pages_allocatable": self.num_free_pages,
             "page_size": self.page_size,
+            "cache_dtype": self.cache_dtype,
+            "bytes_per_token": self.bytes_per_token,
             "tables": {rid: len(pages)
                        for rid, pages in sorted(tables.items())},
             "pins": {

@@ -60,20 +60,41 @@ def handoff_key(request_id: str) -> str:
 
 
 # ------------------------------------------------------- TPLA sharding
+def _shard_half(half, lo: int, hi: int):
+    """Head-slice one cache half.  A quantized (data, scale) half
+    slices BOTH arrays — each leads with the Hkv axis."""
+    if isinstance(half, (tuple, list)):
+        return (half[0][lo:hi], half[1][lo:hi])
+    return half[lo:hi]
+
+
+def _merge_half(halves):
+    if isinstance(halves[0], (tuple, list)):
+        return (np.concatenate([h[0] for h in halves], axis=0),
+                np.concatenate([h[1] for h in halves], axis=0))
+    return np.concatenate(halves, axis=0)
+
+
 def shard_kv_payload(payload: list, num_shards: int) -> list[list]:
-    """Split a dense per-layer [(k, v)] payload ([Hkv, seq, D] arrays)
-    into ``num_shards`` slices along the KV-head (tensor-parallel)
-    axis.  Requires Hkv % num_shards == 0 — the same divisibility the
-    TP attention sharding itself requires."""
+    """Split a per-layer KV payload into ``num_shards`` slices along
+    the KV-head (tensor-parallel) axis — dense ``[(k, v)]``
+    ([Hkv, seq, D] arrays) or the quantized wire layout
+    ``[((kq, ks), (vq, vs))]`` (data AND per-page scales both slice on
+    their leading Hkv axis).  Requires Hkv % num_shards == 0 — the
+    same divisibility the TP attention sharding itself requires."""
     if num_shards <= 1:
         return [payload]
-    heads = int(np.asarray(payload[0][0]).shape[0])
+    first = payload[0][0]
+    heads = int(np.asarray(
+        first[0] if isinstance(first, (tuple, list)) else first
+    ).shape[0])
     if heads % num_shards:
         raise ValueError(
             f"cannot shard {heads} KV heads into {num_shards} slices")
     per = heads // num_shards
     return [
-        [(k[r * per:(r + 1) * per], v[r * per:(r + 1) * per])
+        [(_shard_half(k, r * per, (r + 1) * per),
+          _shard_half(v, r * per, (r + 1) * per))
          for k, v in payload]
         for r in range(num_shards)
     ]
@@ -81,12 +102,13 @@ def shard_kv_payload(payload: list, num_shards: int) -> list[list]:
 
 def merge_kv_shards(shards: list[list]) -> list:
     """Inverse of ``shard_kv_payload``: concatenate per-layer slices
-    back along the KV-head axis (shards in rank order)."""
+    back along the KV-head axis (shards in rank order), either
+    layout."""
     if len(shards) == 1:
         return shards[0]
     return [
-        (np.concatenate([s[i][0] for s in shards], axis=0),
-         np.concatenate([s[i][1] for s in shards], axis=0))
+        (_merge_half([s[i][0] for s in shards]),
+         _merge_half([s[i][1] for s in shards]))
         for i in range(len(shards[0]))
     ]
 

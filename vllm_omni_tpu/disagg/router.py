@@ -77,6 +77,11 @@ from vllm_omni_tpu.distributed.kv_transfer import (
     recv_kv,
     ship_kv,
 )
+from vllm_omni_tpu.kvcache.quant import (
+    payload_seq_len,
+    payload_wire_nbytes,
+    trim_payload,
+)
 from vllm_omni_tpu.kvcache.radix import chain_page_keys
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.metrics.cache_economics import (
@@ -557,14 +562,23 @@ class DisaggRouter:
         key = keys[best_i]
         tokens = (best_i + 1) * self._page_size
         try:
-            seq_len = int(np.asarray(payload[0][0]).shape[1])
+            seq_len = payload_seq_len(payload)
         except Exception:
             return
         if tokens > seq_len:
             return
-        sliced = [(np.asarray(k)[:, :tokens].copy(),
-                   np.asarray(v)[:, :tokens].copy())
-                  for k, v in payload]
+        # format-agnostic page-aligned slice (tokens is a page
+        # multiple, so quantized scales never split a page), copied so
+        # the fabric entry outlives the publishing replica
+
+        def copy_half(half):
+            if isinstance(half, (tuple, list)):
+                return tuple(np.asarray(a).copy() for a in half)
+            return np.asarray(half).copy()
+
+        sliced = [(copy_half(k), copy_half(v))
+                  for k, v in trim_payload(payload, tokens,
+                                           self._page_size)]
         if self._zero_copy:
             self._fabric_payloads[key] = sliced
         else:
@@ -653,8 +667,7 @@ class DisaggRouter:
             self.cache.note_pull(0, ok=False)
             return {}
         self.prefix_pull_seconds.observe(time.perf_counter() - t0)
-        n = sum(int(np.asarray(k).nbytes) + int(np.asarray(v).nbytes)
-                for k, v in payload)
+        n = payload_wire_nbytes(payload)
         resilience_metrics.inc("kv_prefix_pull_bytes_total", n,
                                src=src)
         self.cache.note_pull(tokens, ok=True)
@@ -1214,8 +1227,7 @@ class DisaggRouter:
                 t_ship, w_ship = time.perf_counter(), time.time()
                 if zero_copy:
                     fault_point("handoff")
-                    n = sum(int(k.nbytes) + int(v.nbytes)
-                            for k, v in payload)
+                    n = payload_wire_nbytes(payload)
                     received = payload
                 else:
                     n = roles.ship_handoff(

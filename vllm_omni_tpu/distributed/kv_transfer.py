@@ -70,28 +70,68 @@ def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
-def _layer_spec(k: np.ndarray, v: np.ndarray) -> dict:
-    return {
-        "k_shape": list(k.shape), "v_shape": list(v.shape),
-        "dtype": str(k.dtype),
-        "k_crc": _crc(k), "v_crc": _crc(v),
+def _half_crc(half) -> int:
+    """CRC of one cache half.  A quantized half is a (data, scale)
+    pair; ONE checksum covers BOTH arrays (scale corruption dequantizes
+    every token of the page wrongly — exactly as fatal as flipped data
+    bytes) by chaining the scale bytes onto the data crc."""
+    if isinstance(half, (tuple, list)):
+        data, scale = half
+        c = _crc(np.asarray(data))
+        return zlib.crc32(
+            np.ascontiguousarray(np.asarray(scale)).tobytes(), c)
+    return _crc(half)
+
+
+def _half_np(half):
+    if isinstance(half, (tuple, list)):
+        return (np.asarray(half[0]), np.asarray(half[1]))
+    return np.asarray(half)
+
+
+def _half_shape_dtype(half):
+    if isinstance(half, (tuple, list)):
+        return ([list(half[0].shape), list(half[1].shape)],
+                f"{half[0].dtype}+{half[1].dtype}")
+    return list(half.shape), str(half.dtype)
+
+
+def _layer_spec(k, v) -> dict:
+    """Integrity header for one layer.  Dense halves keep the original
+    fields; quantized (data, scale) halves record both shapes and a
+    joint dtype/crc — the disagg handoff ships int8 pages + scales and
+    the CRC covers both arrays."""
+    ks, kd = _half_shape_dtype(k)
+    vs, _ = _half_shape_dtype(v)
+    spec = {
+        "k_shape": ks, "v_shape": vs,
+        "dtype": kd,
+        "k_crc": _half_crc(k), "v_crc": _half_crc(v),
     }
+    if isinstance(k, (tuple, list)):
+        spec["quant"] = True
+    return spec
 
 
-def _verify_layer(key: str, i: int, k: np.ndarray, v: np.ndarray,
-                  spec: dict) -> None:
+def _verify_layer(key: str, i: int, k, v, spec: dict) -> None:
     """Raise KVIntegrityError unless layer ``i`` matches its header."""
-    if (list(k.shape) != spec["k_shape"]
-            or list(v.shape) != spec["v_shape"]):
+    if bool(spec.get("quant")) != isinstance(k, (tuple, list)):
+        raise KVIntegrityError(
+            f"KV transfer {key}: layer {i} layout "
+            f"({'quant' if isinstance(k, (tuple, list)) else 'dense'}) "
+            f"!= header ({'quant' if spec.get('quant') else 'dense'})")
+    k_shape, k_dtype = _half_shape_dtype(k)
+    v_shape, v_dtype = _half_shape_dtype(v)
+    if k_shape != spec["k_shape"] or v_shape != spec["v_shape"]:
         raise KVIntegrityError(
             f"KV transfer {key}: layer {i} shape "
-            f"{list(k.shape)}/{list(v.shape)} != header "
+            f"{k_shape}/{v_shape} != header "
             f"{spec['k_shape']}/{spec['v_shape']}")
-    if str(k.dtype) != spec["dtype"] or str(v.dtype) != spec["dtype"]:
+    if k_dtype != spec["dtype"] or v_dtype != spec["dtype"]:
         raise KVIntegrityError(
-            f"KV transfer {key}: layer {i} dtype {k.dtype}/{v.dtype} "
+            f"KV transfer {key}: layer {i} dtype {k_dtype}/{v_dtype} "
             f"!= header {spec['dtype']}")
-    if _crc(k) != spec["k_crc"] or _crc(v) != spec["v_crc"]:
+    if _half_crc(k) != spec["k_crc"] or _half_crc(v) != spec["v_crc"]:
         raise KVIntegrityError(
             f"KV transfer {key}: layer {i} checksum mismatch (torn or "
             "corrupted stream)")
@@ -99,13 +139,15 @@ def _verify_layer(key: str, i: int, k: np.ndarray, v: np.ndarray,
 
 def ship_kv(conn: OmniConnectorBase, key: str, payload: list,
             retry: Optional[RetryPolicy] = None) -> int:
-    """Put a per-layer KV payload ([(k, v)] dense arrays) under ``key``.
+    """Put a per-layer KV payload under ``key`` — dense ``[(k, v)]``
+    arrays or the quantized wire layout ``[((kq, ks), (vq, vs))]``
+    (kvcache/quant.py); int8 handoffs ship roughly half the bytes.
     Returns total bytes shipped.  Each per-layer put retries
     independently under ``retry`` (puts are idempotent: re-putting a
     layer overwrites the identical bytes).  The meta header carries the
     per-layer integrity specs the receiver verifies against."""
     retry = retry or _KV_RETRY
-    arrays = [(np.asarray(k), np.asarray(v)) for k, v in payload]
+    arrays = [(_half_np(k), _half_np(v)) for k, v in payload]
 
     def put(subkey, obj):
         def attempt():
@@ -115,9 +157,12 @@ def ship_kv(conn: OmniConnectorBase, key: str, payload: list,
         return call_with_retry(attempt, site=f"kv:{subkey}",
                                policy=retry)
 
+    first = arrays[0][0]
+    seq_len = int(first[0].shape[1] if isinstance(first, tuple)
+                  else first.shape[1])
     total = put(f"{key}/meta", {
         "num_layers": len(arrays),
-        "seq_len": int(arrays[0][0].shape[1]),
+        "seq_len": seq_len,
         "layers": [_layer_spec(k, v) for k, v in arrays],
     })
     for i, (k, v) in enumerate(arrays):
@@ -169,7 +214,7 @@ def iter_kv(conn: OmniConnectorBase, key: str, timeout: float = 30.0,
     specs = meta.get("layers")
     for i in range(meta["num_layers"]):
         k, v = fetch(f"{key}/L{i}", f"layer {i}")
-        k, v = np.asarray(k), np.asarray(v)
+        k, v = _half_np(k), _half_np(v)
         if specs is not None:
             # pre-header senders (no "layers") skip verification —
             # the guard is opt-out by omission, never by flag

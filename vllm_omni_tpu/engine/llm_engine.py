@@ -22,6 +22,11 @@ import jax
 import jax.numpy as jnp
 
 from vllm_omni_tpu.core.kv_cache_manager import KVCacheManager
+from vllm_omni_tpu.kvcache.quant import (
+    concat_payloads,
+    payload_seq_len,
+    trim_payload,
+)
 from vllm_omni_tpu.introspection import (
     DeviceMemoryLedger,
     FlightRecorder,
@@ -114,6 +119,17 @@ class EngineConfig:
     # (restored greedy streams match the never-offloaded oracle);
     # "int8" halves the bytes over the ~0.15 GB/s host tunnel
     kv_offload_quant: str = "none"
+    # HBM-RESIDENT KV dtype (docs/performance.md): "int8" stores the
+    # paged pool as int8 bytes + per-(head, page) absmax scales — the
+    # attention kernels dequantize in-register during the page DMA
+    # pipeline, and the same HBM budget holds ~2x the pages (more
+    # concurrent sessions at fixed p99 TPOT, scripts/kv_quant_bench.py).
+    # "auto"/"bf16" keep the dense layout in ``dtype``
+    kv_cache_dtype: str = "auto"  # "auto" | "bf16" | "int8"
+    # HBM budget the page pool is sized from.  None derives it from
+    # ``num_pages`` at the DENSE layout — flipping to int8 then converts
+    # the SAME budget into ~2x pages rather than keeping the page count
+    kv_hbm_budget_bytes: Optional[int] = None
     # bytes-vs-recompute admission (kvcache/policy.py): "auto" runs the
     # break-even math, "always"/"never" pin the decision
     kv_offload_policy: str = "auto"
@@ -251,16 +267,61 @@ class LLMEngine:
                 jnp.dtype(config.dtype).itemsize,
                 mode=config.kv_offload_policy,
                 quant_mode=config.kv_offload_quant)
+        # HBM-resident KV layout: resolve the page-pool size from the
+        # HBM budget under the chosen dtype.  int8 pages cost roughly
+        # half a bf16 page (data + per-(head, page) scales), so the
+        # SAME budget yields ~2x pages — capacity, not just bytes, is
+        # the point of the quantized pool (docs/performance.md)
+        if config.kv_cache_dtype not in ("auto", "bf16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be auto|bf16|int8, got "
+                f"{config.kv_cache_dtype!r}")
+        self._kv_quant = config.kv_cache_dtype == "int8"
+        num_pages = config.num_pages
+        self._kv_bytes_per_token: Optional[float] = None
+        if isinstance(model_cfg, tfm.TransformerConfig) \
+                and config.worker_type == "ar":
+            from vllm_omni_tpu.kvcache.quant import (
+                bytes_per_token,
+                page_bytes,
+                pages_for_budget,
+            )
+
+            itemsize = jnp.dtype(config.dtype).itemsize
+            if self._kv_quant or config.kv_hbm_budget_bytes is not None:
+                budget = config.kv_hbm_budget_bytes
+                if budget is None:
+                    budget = config.num_pages * model_cfg.num_layers * \
+                        page_bytes(model_cfg.num_kv_heads,
+                                   config.page_size, model_cfg.head_dim,
+                                   quantized=False, itemsize=itemsize)
+                num_pages = pages_for_budget(
+                    budget, model_cfg.num_layers, model_cfg.num_kv_heads,
+                    config.page_size, model_cfg.head_dim,
+                    quantized=self._kv_quant, itemsize=itemsize)
+                logger.info(
+                    "kv_cache_dtype=%s: %d pages in a %.1f MiB HBM "
+                    "budget (config asked %d at the dense layout)",
+                    config.kv_cache_dtype, num_pages, budget / 2**20,
+                    config.num_pages)
+            self._kv_bytes_per_token = bytes_per_token(
+                model_cfg.num_layers, model_cfg.num_kv_heads,
+                config.page_size, model_cfg.head_dim,
+                quantized=self._kv_quant, itemsize=itemsize)
         # prefix caching skips the forward for cached positions, so it
         # cannot coexist with collect_hidden (downstream stages need the
         # hidden row of EVERY prompt position) — thinker-style stages
         # run uncached, plain LM serving gets APC
-        kv = KVCacheManager(config.num_pages, config.page_size,
+        kv = KVCacheManager(num_pages, config.page_size,
                             enable_prefix_caching=(
                                 config.enable_prefix_caching
                                 and config.worker_type == "ar"
                                 and not config.collect_hidden),
-                            tiers=self.kv_tiers, policy=kv_policy)
+                            tiers=self.kv_tiers, policy=kv_policy,
+                            cache_dtype=(
+                                "int8" if self._kv_quant
+                                else str(jnp.dtype(config.dtype))),
+                            bytes_per_token=self._kv_bytes_per_token)
         sched_cfg = SchedulerConfig(
             max_num_seqs=config.max_num_seqs,
             max_num_batched_tokens=config.max_num_batched_tokens,
@@ -318,7 +379,8 @@ class LLMEngine:
                 mesh = Mesh(_np.array(devs[:tp]), (AXIS_TP,))
             self.runner = ARModelRunner(
                 params, model_cfg,
-                num_pages=config.num_pages, page_size=config.page_size,
+                num_pages=num_pages, page_size=config.page_size,
+                kv_cache_dtype=config.kv_cache_dtype,
                 max_model_len=config.max_model_len, dtype=config.dtype,
                 collect_hidden=config.collect_hidden, seed=config.seed,
                 max_num_seqs=config.max_num_seqs, mesh=mesh,
@@ -492,7 +554,7 @@ class LLMEngine:
             req.append_output_token(int(injected_first_token))
         injected_len = 0
         if injected_kv is not None:
-            injected_len = min(int(injected_kv[0][0].shape[1]),
+            injected_len = min(payload_seq_len(injected_kv),
                                max(req.num_tokens - 1, 0))
         self.scheduler.add_request(req, injected_len=injected_len)
         if injected_kv is not None and req.status is RequestStatus.WAITING:
@@ -504,7 +566,7 @@ class LLMEngine:
         # whole PROMPT may inject — the one remaining position is the
         # sampling one and re-enters as a decode; otherwise the last
         # prompt token recomputes for its logits
-        seq_len = int(payload[0][0].shape[1])
+        seq_len = payload_seq_len(payload)
         use = min(seq_len, req.num_tokens - 1)
         if use <= 0:
             if req.output_token_ids:
@@ -522,7 +584,11 @@ class LLMEngine:
         if table is not None:
             try:
                 t0, w0 = time.perf_counter(), time.time()
-                trimmed = [(k[:, :use], v[:, :use]) for k, v in payload]
+                # format-agnostic trim: dense slices the token axis;
+                # quantized wire payloads also trim the per-page scale
+                # axis (kvcache/quant.py)
+                trimmed = trim_payload(payload, use,
+                                       self.config.page_size)
                 self.runner.inject_kv(table, trimmed)
                 req.num_computed_tokens = use
                 (kv.note_pulled if pulled else kv.note_streamed)(use)
@@ -946,6 +1012,13 @@ class LLMEngine:
             "pages_total": kv.num_pages,
             "pages_used": used,
             "utilization": round(used / kv.num_pages, 4),
+            # resident layout label + amortized HBM cost per cached
+            # token (all layers) — the capacity story the int8 pool
+            # exists for (docs/performance.md)
+            "cache_dtype": getattr(
+                self.runner, "kv_cache_dtype",
+                str(jnp.dtype(self.config.dtype))),
+            "bytes_per_token": self._kv_bytes_per_token,
         }
         snap["prefix_cache"] = self.prefix_cache_stats
         if self.kv_tiers is not None:
@@ -1312,15 +1385,15 @@ class LLMEngine:
                 if e.drop_after:
                     self.kv_tiers.drop(e.key)
             if parts:
-                import numpy as np
-
                 if len(parts) == 1:
                     payload = parts[0]
                 else:
-                    payload = [
-                        (np.concatenate([p[i][0] for p in parts], axis=1),
-                         np.concatenate([p[i][1] for p in parts], axis=1))
-                        for i in range(len(parts[0]))]
+                    # format-agnostic stitch (kvcache/quant.py): dense
+                    # parts concat on the token axis; quantized parts
+                    # concat data + per-page scales (radix node runs
+                    # are page-aligned, so scales never split a page)
+                    payload = concat_payloads(
+                        parts, self.config.page_size)
                 self.runner.inject_kv(pages, payload)
                 self.kv_tiers.restored_tokens += sum(
                     e.n_tokens for e in entries[:len(parts)])

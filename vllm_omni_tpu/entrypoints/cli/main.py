@@ -58,6 +58,14 @@ def _add_common(p: argparse.ArgumentParser):
                      help="cold-path payload storage: none keeps "
                           "restores bit-exact, int8 halves the bytes "
                           "over the host tunnel")
+    eng.add_argument("--kv-cache-dtype", default=None,
+                     choices=("auto", "int8", "bf16"),
+                     help="HBM-RESIDENT paged-KV layout: int8 stores "
+                          "the page pool as int8 + per-(head, page) "
+                          "scales dequantized in-kernel — ~2x pages "
+                          "(sessions) in the same HBM budget (see "
+                          "docs/performance.md); auto/bf16 keep the "
+                          "dense layout in the model dtype")
     eng.add_argument("--kv-offload-policy", default=None,
                      choices=("auto", "always", "never"),
                      help="bytes-vs-recompute admission: auto runs the "
@@ -131,7 +139,8 @@ _ENTRY_FLAGS = ("tensor_parallel_size", "max_model_len", "max_num_seqs",
                 "max_num_batched_tokens", "dtype", "seed",
                 "enable_chunked_prefill", "num_speculative_tokens",
                 "async_scheduling", "unified_batching",
-                "kv_offload", "kv_offload_quant", "kv_offload_policy",
+                "kv_offload", "kv_offload_quant", "kv_cache_dtype",
+                "kv_offload_policy",
                 "kv_host_tier_bytes", "kv_offload_connector",
                 "slo_ttft_ms", "slo_tpot_ms", "max_queue_depth",
                 "wfq_scheduling", "engine_role", "deterministic_decode")

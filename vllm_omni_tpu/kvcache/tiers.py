@@ -32,6 +32,11 @@ from typing import Any, Optional
 
 import numpy as np
 
+from vllm_omni_tpu.kvcache.quant import (
+    dequantize_np,
+    is_quant_payload,
+    quantize_np,
+)
 from vllm_omni_tpu.logger import init_logger
 
 logger = init_logger(__name__)
@@ -43,17 +48,15 @@ TIER_REMOTE = "remote"
 
 # ---------------------------------------------------------- quantization
 def quantize_kv_payload(payload: list) -> dict:
-    """[(k, v)] float arrays ([Hkv, S, D]) -> int8 bodies + per-head
-    float32 absmax scales.  Mirrors diffusion/quantization's
-    per-out-channel absmax stance, applied per (layer, tensor, head)."""
+    """Dense [(k, v)] float arrays ([Hkv, S, D]) -> int8 bodies + per-head
+    float32 absmax scales (rounding shared with ``kvcache/quant.py``).
+    Mirrors diffusion/quantization's per-out-channel absmax stance,
+    applied per (layer, tensor, head)."""
     layers = []
     for k, v in payload:
         out = []
         for arr in (k, v):
-            a = np.asarray(arr, dtype=np.float32)
-            absmax = np.max(np.abs(a), axis=(1, 2), keepdims=True)
-            scale = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
-            q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+            q, scale = quantize_np(arr, axis=(1, 2))
             out.append((q, scale, str(np.asarray(arr).dtype)))
         layers.append(tuple(out))
     return {"quant": "int8", "layers": layers}
@@ -62,20 +65,37 @@ def quantize_kv_payload(payload: list) -> dict:
 def dequantize_kv_payload(obj: dict) -> list:
     payload = []
     for (kq, ks, kd), (vq, vs, vd) in obj["layers"]:
-        k = (kq.astype(np.float32) * ks).astype(kd)
-        v = (vq.astype(np.float32) * vs).astype(vd)
-        payload.append((k, v))
+        payload.append((dequantize_np(kq, ks).astype(kd),
+                        dequantize_np(vq, vs).astype(vd)))
     return payload
 
 
+def _resident_wrap(payload: list) -> dict:
+    """Already-quantized wire payload (int8-resident extraction,
+    ``kvcache/quant.py`` layout) stored AS-IS — re-quantizing int8 data
+    would double the rounding error, and dequantizing to bf16 to satisfy
+    the cold format would double the bytes.  ``fetch`` hands the list
+    straight back for an exact int8->int8 restore."""
+    layers = [((np.asarray(kq), np.asarray(ks)),
+               (np.asarray(vq), np.asarray(vs)))
+              for (kq, ks), (vq, vs) in payload]
+    return {"quant": "int8", "resident": True, "layers": layers}
+
+
 def payload_nbytes(payload) -> int:
-    """Stored size of a payload (raw [(k, v)] or quantized dict)."""
+    """Stored size of a payload (raw [(k, v)], quantized wire list, or
+    stored dict of either cold flavor)."""
     if isinstance(payload, dict):
-        return sum(
-            part[0].nbytes + part[1].nbytes
-            for layer in payload["layers"] for part in layer)
-    return sum(np.asarray(k).nbytes + np.asarray(v).nbytes
-               for k, v in payload)
+        payload = payload["layers"]
+
+    def walk(node) -> int:
+        if isinstance(node, (tuple, list)):
+            return sum(walk(x) for x in node)
+        if isinstance(node, str):
+            return 0
+        return np.asarray(node).nbytes
+
+    return walk(payload)
 
 
 class TieredKVStore:
@@ -143,8 +163,12 @@ class TieredKVStore:
         """Park a payload in the host tier (quantizing per policy);
         returns stored bytes.  Overflow demotes LRU host entries to the
         remote tier, or drops them without one."""
-        if self.quant == "int8":
-            stored: Any = quantize_kv_payload(payload)
+        if is_quant_payload(payload):
+            # int8-resident extraction: already quantized once at
+            # KV-write time — park it verbatim (never double-quantize)
+            stored: Any = _resident_wrap(payload)
+        elif self.quant == "int8":
+            stored = quantize_kv_payload(payload)
         else:
             stored = [(np.asarray(k), np.asarray(v)) for k, v in payload]
         n = payload_nbytes(stored)
@@ -196,6 +220,12 @@ class TieredKVStore:
         else:
             return None
         if isinstance(stored, dict):
+            if stored.get("resident"):
+                # quantized wire payload: hand back as-is — an int8
+                # runner re-injects it bit-exactly, a bf16 runner's
+                # inject path dequantizes (kvcache/quant.py)
+                return [((kq, ks), (vq, vs))
+                        for (kq, ks), (vq, vs) in stored["layers"]]
             return dequantize_kv_payload(stored)
         return [(k, v) for k, v in stored]
 

@@ -20,9 +20,11 @@ import jax.numpy as jnp
 from vllm_omni_tpu.models.common import nn
 from vllm_omni_tpu.ops import (
     apply_rope,
+    cache_shape,
     compute_mrope_freqs,
     compute_rope_freqs,
     flash_attention,
+    gather_pages,
     paged_attention,
     ragged_paged_attention,
     rms_norm,
@@ -487,7 +489,7 @@ def forward_prefill_chunked(
     Returns (hidden [B, S, hidden], new kv_caches).
     """
     b, s = token_ids.shape
-    hkv, _, page_size, d = kv_caches[0][0].shape
+    hkv, _, page_size, d = cache_shape(kv_caches[0][0])
     x = _embed_input(params, token_ids, inputs_embeds, embeds_mask)
     cos, sin = _rope_tables(cfg, positions)
     flat_slots = slot_mapping.reshape(-1)
@@ -503,12 +505,13 @@ def forward_prefill_chunked(
             )
             new_caches.append((k_cache, v_cache))
             # gather context pages: [Hkv, B, P, page, D] -> [B, ctx, Hkv, D]
+            # (gather_pages dequantizes the int8 layout's pages)
             kg = jnp.transpose(
-                k_cache[:, block_tables], (1, 2, 3, 0, 4)
-            ).reshape(b, ctx_width, hkv, d)
+                gather_pages(k_cache, block_tables), (1, 2, 3, 0, 4)
+            ).reshape(b, ctx_width, hkv, d).astype(k.dtype)
             vg = jnp.transpose(
-                v_cache[:, block_tables], (1, 2, 3, 0, 4)
-            ).reshape(b, ctx_width, hkv, d)
+                gather_pages(v_cache, block_tables), (1, 2, 3, 0, 4)
+            ).reshape(b, ctx_width, hkv, d).astype(v.dtype)
             return flash_attention(
                 q.reshape(b, s, -1, cfg.head_dim), kg, vg,
                 causal=True, kv_mask=kv_mask, q_offsets=q_starts,

@@ -17,6 +17,10 @@ from vllm_omni_tpu.ops.rope import (
 )
 from vllm_omni_tpu.ops.attention import flash_attention, attention_ref
 from vllm_omni_tpu.ops.paged_attention import (
+    cache_data,
+    cache_is_quantized,
+    cache_shape,
+    gather_pages,
     paged_attention,
     paged_attention_ref,
     write_kv_cache,
@@ -36,6 +40,10 @@ __all__ = [
     "compute_mrope_freqs",
     "flash_attention",
     "attention_ref",
+    "cache_data",
+    "cache_is_quantized",
+    "cache_shape",
+    "gather_pages",
     "paged_attention",
     "paged_attention_ref",
     "ragged_paged_attention",

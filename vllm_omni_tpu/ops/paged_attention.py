@@ -7,8 +7,18 @@ head and page indices yields a *contiguous* (page_size, D) tile, so the
 decode kernel's HBM→VMEM page DMAs are dense (the CUDA layout
 [pages, page_size, Hkv, D] would stride every row on TPU).
 
+Quantized layout (``--kv-cache-dtype int8``): each cache half becomes a
+2-tuple ``(data int8 [Hkv, P, page_size, D], scale f32 [Hkv, P])`` — one
+absmax scale per (kv-head, page), rounding shared with
+``kvcache/quant.py``.  The pytree structure carries the layout through
+jit, so every op here branches statically on ``isinstance(half, tuple)``
+and the kernels dequantize in-register after the page DMA (the math
+stays f32; only HBM bytes shrink ~2x).
+
 Three ops:
 - ``write_kv_cache``  — slot-mapping scatter of new K/V into the paged cache
+  (the quantized branch grows per-page scales monotonically within a
+  page's tenancy and rescales the page's prior rows in-place)
 - ``paged_attention_ref`` — gather-based XLA fallback (also the test oracle)
 - ``paged_attention`` — Pallas decode kernel: per (seq, kv-head) grid cell,
   double-buffered page DMAs + online softmax over pages.
@@ -25,6 +35,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from vllm_omni_tpu.kvcache.quant import QMAX, SCALE_EPS
 from vllm_omni_tpu.ops._dispatch import interpret_flag
 
 _NEG_INF = -1e30
@@ -37,19 +48,99 @@ def init_kv_cache(
     num_kv_heads: int,
     head_dim: int,
     dtype=jnp.bfloat16,
+    quantized: bool = False,
 ):
-    """Allocate per-layer (k, v) caches."""
+    """Allocate per-layer (k, v) caches.
+
+    ``quantized`` allocates the int8 layout: each half is
+    ``(data int8, scale f32 [Hkv, P])``; zero scales mean "never
+    written" and dequantize to the same zeros the bf16 pool starts
+    with."""
     shape = (num_kv_heads, num_pages, page_size, head_dim)
+    if quantized:
+        return [
+            ((jnp.zeros(shape, jnp.int8),
+              jnp.zeros((num_kv_heads, num_pages), jnp.float32)),
+             (jnp.zeros(shape, jnp.int8),
+              jnp.zeros((num_kv_heads, num_pages), jnp.float32)))
+            for _ in range(num_layers)
+        ]
     return [
         (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
         for _ in range(num_layers)
     ]
 
 
+def cache_is_quantized(cache_half) -> bool:
+    """True for the (data, scale) int8 layout of one cache half."""
+    return isinstance(cache_half, tuple)
+
+
+def cache_data(cache_half) -> jax.Array:
+    """The [Hkv, P, page_size, D] data array of either layout."""
+    return cache_half[0] if isinstance(cache_half, tuple) else cache_half
+
+
+def cache_shape(cache_half) -> tuple:
+    return cache_data(cache_half).shape
+
+
+def gather_pages(cache_half, page_ids: jax.Array) -> jax.Array:
+    """Dequantizing page gather: ``cache[:, page_ids]`` for either
+    layout.  Returns ``[Hkv, *page_ids.shape, page_size, D]`` — float32
+    when quantized, the cache dtype otherwise."""
+    if isinstance(cache_half, tuple):
+        data, scale = cache_half
+        return (data[:, page_ids].astype(jnp.float32)
+                * scale[:, page_ids][..., None, None])
+    return cache_half[:, page_ids]
+
+
+def _write_kv_quant(cache_half, x_new, slot_mapping):
+    """Quantized slot scatter for one cache half.
+
+    Per touched page: (1) a page whose FIRST row is being written is a
+    fresh tenancy — its old scale (a previous sequence's) is treated as
+    zero so stale scales never leak across the page pool's reuse; (2)
+    the scale grows monotonically, ``new = max(old, absmax(new)/127)``,
+    and the page's prior int8 rows are rescaled onto the grown scale
+    in-place (cost O(T * page_size), never O(cache)); (3) the new rows
+    quantize with the settled scale and scatter through the flat-slot
+    view exactly like the dense path (slot -1 drops)."""
+    data, scale = cache_half  # int8 [Hkv,P,ps,D], f32 [Hkv,P]
+    hkv, p, ps, d = data.shape
+    xn = jnp.moveaxis(x_new, 1, 0).astype(jnp.float32)  # [Hkv, T, D]
+    slots = jnp.where(slot_mapping < 0, p * ps, slot_mapping)
+    pages = slots // ps  # p (out of range -> dropped) for padding rows
+    offs = slots % ps
+    ones = jnp.ones_like(pages, jnp.int32)
+    fresh = jnp.zeros((p,), jnp.int32).at[pages].max(
+        jnp.where(offs == 0, ones, 0), mode="drop")
+    touched = jnp.zeros((p,), jnp.int32).at[pages].max(ones, mode="drop")
+    old = jnp.where(fresh[None, :] > 0, 0.0, scale)
+    cand = jnp.zeros((hkv, p), jnp.float32).at[:, pages].max(
+        jnp.max(jnp.abs(xn), axis=-1), mode="drop") / QMAX
+    new_scale = jnp.where(
+        touched[None, :] > 0,
+        jnp.maximum(jnp.maximum(old, cand), SCALE_EPS), scale)
+    # rescale what the touched pages already hold onto the grown scale
+    # (fresh pages get ratio 0: the previous tenant's rows zero out)
+    ratio = (old / jnp.maximum(new_scale, SCALE_EPS))[:, pages]
+    pg = data[:, pages].astype(jnp.float32) * ratio[..., None, None]
+    pg = jnp.clip(jnp.round(pg), -QMAX, QMAX).astype(jnp.int8)
+    data = data.at[:, pages].set(pg, mode="drop")
+    # quantize + scatter the step's rows
+    s_tok = jnp.maximum(new_scale[:, pages], SCALE_EPS)  # [Hkv, T]
+    qn = jnp.clip(jnp.round(xn / s_tok[..., None]),
+                  -QMAX, QMAX).astype(jnp.int8)
+    flat = data.reshape(hkv, p * ps, d).at[:, slots].set(qn, mode="drop")
+    return flat.reshape(data.shape), new_scale
+
+
 @jax.jit
 def write_kv_cache(
-    k_cache: jax.Array,  # [Hkv, P, page, D]
-    v_cache: jax.Array,
+    k_cache,  # [Hkv, P, page, D] or quantized (data, scale) tuple
+    v_cache,
     k_new: jax.Array,  # [T, Hkv, D]
     v_new: jax.Array,
     slot_mapping: jax.Array,  # [T] int32, flat slot = page*page_size + offset
@@ -58,7 +149,12 @@ def write_kv_cache(
 
     Padded tokens use slot -1: they scatter out of bounds, which XLA drops
     (mode=drop), matching the CUDA kernel's ignore-negative-slot contract.
+    The quantized (data, scale) layout dispatches on pytree structure —
+    static under jit, so both layouts share one entry point.
     """
+    if isinstance(k_cache, tuple):  # omnilint: disable=OL1 - pytree STRUCTURE branch (tuple vs array), static at trace time: jit specializes per layout by design
+        return (_write_kv_quant(k_cache, k_new, slot_mapping),
+                _write_kv_quant(v_cache, v_new, slot_mapping))
     hkv, p, ps, d = k_cache.shape
     kc = k_cache.reshape(hkv, p * ps, d)
     vc = v_cache.reshape(hkv, p * ps, d)
@@ -74,23 +170,23 @@ def write_kv_cache(
 
 def paged_attention_ref(
     q: jax.Array,  # [B, H, D] (one decode token per sequence)
-    k_cache: jax.Array,  # [Hkv, P, page, D]
-    v_cache: jax.Array,
+    k_cache,  # [Hkv, P, page, D] or quantized (data, scale) tuple
+    v_cache,
     block_tables: jax.Array,  # [B, max_pages] int32 page ids
     context_lens: jax.Array,  # [B] int32
     scale: Optional[float] = None,
 ):
     b, h, d = q.shape
-    hkv, _, page, _ = k_cache.shape
+    hkv, _, page, _ = cache_shape(k_cache)
     group = h // hkv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     max_pages = block_tables.shape[1]
     # Gather pages: [B, Hkv, max_pages, page, D] -> [B, Hkv, L, D]
-    kg = jnp.moveaxis(k_cache[:, block_tables], 0, 1).reshape(
+    kg = jnp.moveaxis(gather_pages(k_cache, block_tables), 0, 1).reshape(
         b, hkv, max_pages * page, d
     )
-    vg = jnp.moveaxis(v_cache[:, block_tables], 0, 1).reshape(
+    vg = jnp.moveaxis(gather_pages(v_cache, block_tables), 0, 1).reshape(
         b, hkv, max_pages * page, d
     )
     qg = q.reshape(b, hkv, group, d).astype(jnp.float32)
@@ -109,19 +205,21 @@ def _paged_decode_kernel(
     context_lens_ref,  # [B] (SMEM)
     # inputs
     q_ref,  # [1, 1, group_p, D] VMEM
-    k_hbm,  # [Hkv, P, page, D] ANY/HBM
+    k_hbm,  # [Hkv, P, page, D] ANY/HBM (int8 when quantized)
     v_hbm,
-    # outputs
-    o_ref,  # [1, 1, group_p, D] VMEM
-    # scratch
-    k_buf,  # [2, page, D]
-    v_buf,
-    sems,  # DMA sems [2, 2]
-    acc_scr,  # [group_p, D]
-    *,
+    # quantized only: k_sc_ref/v_sc_ref [1, P] VMEM per-page scales,
+    # then outputs o_ref [1, 1, group_p, D] and scratch
+    # k_buf/v_buf [2, page, D], sems [2, 2], acc_scr [group_p, D]
+    *refs,
     page_size: int,
     scale: float,
+    quantized: bool,
 ):
+    if quantized:
+        k_sc_ref, v_sc_ref, o_ref, k_buf, v_buf, sems, acc_scr = refs
+    else:
+        o_ref, k_buf, v_buf, sems, acc_scr = refs
+        k_sc_ref = v_sc_ref = None
     b = pl.program_id(0)
     kvh = pl.program_id(1)
     ctx_len = context_lens_ref[b]
@@ -158,6 +256,13 @@ def _paged_decode_kernel(
 
             q = q_ref[0, 0].astype(jnp.float32)
             k = k_buf[slot].astype(jnp.float32)
+            v = v_buf[slot].astype(jnp.float32)
+            if quantized:
+                # dequantize in-register: one f32 scale per (head, page),
+                # fetched alongside the int8 page bytes
+                page_id = block_tables_ref[b, p_idx]
+                k = k * k_sc_ref[0, page_id]
+                v = v * v_sc_ref[0, page_id]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
             pos = p_idx * page_size + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1
@@ -170,8 +275,7 @@ def _paged_decode_kernel(
             p = jnp.exp(s - m_new)
             l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
-                p, v_buf[slot].astype(jnp.float32),
-                preferred_element_type=jnp.float32,
+                p, v, preferred_element_type=jnp.float32,
             )
             return m_new, l_new, 0
 
@@ -195,7 +299,10 @@ def _paged_attention(
     q, k_cache, v_cache, block_tables, context_lens, scale, use_pallas
 ):
     b, h, d = q.shape
-    hkv, num_pages_total, page_size, _ = k_cache.shape
+    quantized = isinstance(k_cache, tuple)
+    k_data, k_scale = k_cache if quantized else (k_cache, None)
+    v_data, v_scale = v_cache if quantized else (v_cache, None)
+    hkv, num_pages_total, page_size, _ = k_data.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if not use_pallas:
@@ -209,26 +316,43 @@ def _paged_attention(
         qx = jnp.pad(qx, ((0, 0), (0, 0), (0, group_p - group), (0, 0)))
     max_pages = block_tables.shape[1]
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, group_p, d),
+            lambda b_, h_, *_: (b_, h_, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    operands = [
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        qx,
+        k_data,
+        v_data,
+    ]
+    if quantized:
+        # per-page scales ride in VMEM, one (1, P) row per kv head
+        sc_spec = pl.BlockSpec(
+            (1, num_pages_total),
+            lambda b_, h_, *_: (h_, 0),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, group_p, d),
-                lambda b_, h_, *_: (b_, h_, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, group_p, d),
             lambda b_, h_, *_: (b_, h_, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, d), k_cache.dtype),
-            pltpu.VMEM((2, page_size, d), v_cache.dtype),
+            pltpu.VMEM((2, page_size, d), k_data.dtype),
+            pltpu.VMEM((2, page_size, d), v_data.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.VMEM((group_p, d), jnp.float32),
         ],
@@ -238,24 +362,19 @@ def _paged_attention(
             _paged_decode_kernel,
             page_size=page_size,
             scale=scale,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, group_p, d), q.dtype),
         interpret=interpret_flag(),
-    )(
-        block_tables.astype(jnp.int32),
-        context_lens.astype(jnp.int32),
-        qx,
-        k_cache,
-        v_cache,
-    )
+    )(*operands)
     return out[:, :, :group].reshape(b, h, d)
 
 
 def paged_attention(
     q: jax.Array,
-    k_cache: jax.Array,
-    v_cache: jax.Array,
+    k_cache,
+    v_cache,
     block_tables: jax.Array,
     context_lens: jax.Array,
     scale: Optional[float] = None,
@@ -267,12 +386,15 @@ def paged_attention(
 
         use_pallas = pallas_mode() == "native"
         # Mosaic tiling constraints: page tiles are (page_size, head_dim)
-        # VMEM buffers → need lane dim % 128 and sublane dim % 8 (f32).
+        # VMEM buffers → need lane dim % 128 and sublane dim % 8 (f32),
+        # % 32 for int8 page tiles (docs/performance.md capacity notes).
         # Auto-dispatch routes tiny/test shapes to the XLA ref path;
         # production shapes (D=128, page_size>=16) take the kernel.  An
         # explicit use_pallas=True is honored as-is (kernel tests rely on
         # it; unsupported shapes then fail loudly at compile).
-        if q.shape[-1] % 128 != 0 or k_cache.shape[2] % 8 != 0:
+        page_size = cache_shape(k_cache)[2]
+        sublane = 32 if cache_is_quantized(k_cache) else 8
+        if q.shape[-1] % 128 != 0 or page_size % sublane != 0:
             use_pallas = False
     return _paged_attention(
         q, k_cache, v_cache, block_tables, context_lens, scale, use_pallas

@@ -54,6 +54,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from vllm_omni_tpu.ops._dispatch import interpret_flag
 from vllm_omni_tpu.ops.autotune import auto_ragged_blocks
+from vllm_omni_tpu.ops.paged_attention import (
+    cache_data,
+    cache_is_quantized,
+    cache_shape,
+    gather_pages,
+)
 
 _NEG_INF = -1e30
 
@@ -90,7 +96,7 @@ def ragged_paged_attention_ref(
     a production path (production shapes satisfy the kernel's tiling
     requirements: D % 128 == 0, page_size % 8 == 0)."""
     t, h, d = q.shape
-    hkv, _, page, _ = k_cache.shape
+    hkv, _, page, _ = cache_shape(k_cache)
     s_max = q_lens.shape[0]
     group = h // hkv
     if scale is None:
@@ -112,10 +118,13 @@ def ragged_paged_attention_ref(
 
     max_ctx = page_tables.shape[1] * page
     # [Hkv, S, P, page, D] -> [S, max_ctx, Hkv, D] -> per-token [T, ...]
-    kg = jnp.transpose(k_cache[:, page_tables], (1, 2, 3, 0, 4)).reshape(
-        s_max, max_ctx, hkv, d)[seq_of]
-    vg = jnp.transpose(v_cache[:, page_tables], (1, 2, 3, 0, 4)).reshape(
-        s_max, max_ctx, hkv, d)[seq_of]
+    # (gather_pages dequantizes int8 pages with their per-page scales)
+    kg = jnp.transpose(
+        gather_pages(k_cache, page_tables), (1, 2, 3, 0, 4)
+    ).reshape(s_max, max_ctx, hkv, d)[seq_of]
+    vg = jnp.transpose(
+        gather_pages(v_cache, page_tables), (1, 2, 3, 0, 4)
+    ).reshape(s_max, max_ctx, hkv, d)[seq_of]
     qg = q.reshape(t, hkv, group, d).astype(jnp.float32)
     s = jnp.einsum("thgd,tlhd->thgl", qg, kg.astype(jnp.float32)) * scale
     k_pos = jnp.arange(max_ctx)
@@ -142,22 +151,25 @@ def _ragged_kernel(
     tables_ref,   # [S, max_pages]
     # inputs
     q_ref,        # [1, 1, token_block * group, D] VMEM
-    k_hbm,        # [Hkv, P, page, D] ANY/HBM
+    k_hbm,        # [Hkv, P, page, D] ANY/HBM (int8 when quantized)
     v_hbm,
-    # outputs
-    o_ref,        # [1, 1, token_block * group, D] VMEM
-    # scratch
-    k_buf,        # [dma_slots, page, D]
-    v_buf,
-    sems,         # DMA sems [dma_slots, 2]
-    acc_scr,      # [token_block * group, D]
-    *,
+    # quantized only: k_sc_ref/v_sc_ref [1, P] VMEM per-page scales,
+    # then outputs o_ref [1, 1, token_block * group, D] and scratch
+    # k_buf/v_buf [dma_slots, page, D], sems [dma_slots, 2],
+    # acc_scr [token_block * group, D]
+    *refs,
     page_size: int,
     token_block: int,
     group: int,
     scale: float,
     dma_slots: int,
+    quantized: bool,
 ):
+    if quantized:
+        k_sc_ref, v_sc_ref, o_ref, k_buf, v_buf, sems, acc_scr = refs
+    else:
+        o_ref, k_buf, v_buf, sems, acc_scr = refs
+        k_sc_ref = v_sc_ref = None
     kvh = pl.program_id(0)
     j = pl.program_id(1)   # GLOBAL q block: segment alignment means it
     #                        belongs to exactly one sequence — the grid
@@ -226,6 +238,13 @@ def _ragged_kernel(
 
             q = q_ref[0, 0].astype(jnp.float32)
             k = k_buf[slot].astype(jnp.float32)
+            v = v_buf[slot].astype(jnp.float32)
+            if quantized:
+                # dequantize in-register: the page's int8 bytes were
+                # DMAed; its (head, page) f32 scale rides a VMEM row
+                page_id = tables_ref[i_safe, p_idx]
+                k = k * k_sc_ref[0, page_id]
+                v = v * v_sc_ref[0, page_id]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
             k_pos = p_idx * page_size + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1
@@ -241,8 +260,7 @@ def _ragged_kernel(
             p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
             l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
-                p, v_buf[slot].astype(jnp.float32),
-                preferred_element_type=jnp.float32,
+                p, v, preferred_element_type=jnp.float32,
             )
             return m_new, l_new, 0
 
@@ -269,7 +287,10 @@ def _ragged_attention(
     num_seqs, scale, token_block, use_pallas, dma_slots,
 ):
     t, h, d = q.shape
-    hkv, _, page_size, _ = k_cache.shape
+    quantized = isinstance(k_cache, tuple)
+    k_data, k_scale = k_cache if quantized else (k_cache, None)
+    v_data, v_scale = v_cache if quantized else (v_cache, None)
+    hkv, num_pages_total, page_size, _ = k_data.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if not use_pallas:
@@ -307,22 +328,40 @@ def _ragged_attention(
     block_seq = jnp.where(jnp.any(in_seq, axis=0),
                           jnp.argmax(in_seq, axis=0), -1).astype(jnp.int32)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, d),
+                     lambda kvh, j, *_: (kvh, j, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    operands = [
+        block_seq,
+        cu_q_lens.astype(jnp.int32),
+        q_lens.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        page_tables.astype(jnp.int32),
+        qx,
+        k_data,
+        v_data,
+    ]
+    if quantized:
+        # per-page scales ride in VMEM, one (1, P) row per kv head
+        sc_spec = pl.BlockSpec((1, num_pages_total),
+                               lambda kvh, j, *_: (kvh, 0),
+                               memory_space=pltpu.VMEM)
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(hkv, nb),
-        in_specs=[
-            pl.BlockSpec((1, 1, rows, d),
-                         lambda kvh, j, *_: (kvh, j, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, rows, d),
                                lambda kvh, j, *_: (kvh, j, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((dma_slots, page_size, d), k_cache.dtype),
-            pltpu.VMEM((dma_slots, page_size, d), v_cache.dtype),
+            pltpu.VMEM((dma_slots, page_size, d), k_data.dtype),
+            pltpu.VMEM((dma_slots, page_size, d), v_data.dtype),
             pltpu.SemaphoreType.DMA((dma_slots, 2)),
             pltpu.VMEM((rows, d), jnp.float32),
         ],
@@ -335,20 +374,12 @@ def _ragged_attention(
             group=group,
             scale=scale,
             dma_slots=dma_slots,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((hkv, nb, rows, d), q.dtype),
         interpret=interpret_flag(),
-    )(
-        block_seq,
-        cu_q_lens.astype(jnp.int32),
-        q_lens.astype(jnp.int32),
-        seq_lens.astype(jnp.int32),
-        page_tables.astype(jnp.int32),
-        qx,
-        k_cache,
-        v_cache,
-    )
+    )(*operands)
     # [Hkv, NB, tb*group, D] -> [T, H, D]
     out = out.reshape(hkv, t, group, d)
     return jnp.transpose(out, (1, 0, 2, 3)).reshape(t, h, d)
@@ -356,8 +387,8 @@ def _ragged_attention(
 
 def ragged_paged_attention(
     q: jax.Array,            # [T, H, D] token-packed queries
-    k_cache: jax.Array,      # [Hkv, P, page, D]
-    v_cache: jax.Array,
+    k_cache,                 # [Hkv, P, page, D] or quantized tuple
+    v_cache,
     page_tables: jax.Array,  # [S, max_pages]
     cu_q_lens: jax.Array,    # [S+1] aligned segment starts
     q_lens: jax.Array,       # [S]
@@ -378,19 +409,25 @@ def ragged_paged_attention(
     ``use_pallas=True`` is honored as-is and fails loudly if
     unsupported.  ``dma_slots`` (page-DMA pipeline depth) defaults to
     the per-shape ``auto_ragged_blocks`` choice."""
+    quantized = cache_is_quantized(k_cache)
+    k_data = cache_data(k_cache)
     if use_pallas is None:
         from vllm_omni_tpu.ops._dispatch import pallas_mode
 
         use_pallas = pallas_mode() == "native"
-        if (q.shape[-1] % 128 != 0 or k_cache.shape[2] % 8 != 0
+        # int8 page tiles need sublane % 32 (vs % 8 for bf16/f32)
+        sublane = 32 if quantized else 8
+        if (q.shape[-1] % 128 != 0 or k_data.shape[2] % sublane != 0
                 or q.shape[0] % token_block != 0):
             use_pallas = False
     if dma_slots is None:
         _, dma_slots = auto_ragged_blocks(
-            head_dim=q.shape[-1], page_size=k_cache.shape[2],
-            group=q.shape[1] // k_cache.shape[0],
-            kv_itemsize=k_cache.dtype.itemsize,
-            q_itemsize=q.dtype.itemsize)
+            head_dim=q.shape[-1], page_size=k_data.shape[2],
+            group=q.shape[1] // k_data.shape[0],
+            kv_itemsize=k_data.dtype.itemsize,
+            q_itemsize=q.dtype.itemsize,
+            quantized=quantized,
+            num_pages=k_data.shape[1])
     num_seqs = jnp.asarray(num_seqs, jnp.int32)
     return _ragged_attention(
         q, k_cache, v_cache, page_tables, cu_q_lens, q_lens, seq_lens,

@@ -208,8 +208,15 @@ def shard_ar_params(params, mesh: Mesh):
     return place(params)
 
 
-def ar_kv_cache_spec() -> tuple[P, P]:
-    """Paged KV caches [Hkv, pages, page_size, D]: KV heads over tp."""
+def ar_kv_cache_spec(quantized: bool = False):
+    """Paged KV caches [Hkv, pages, page_size, D]: KV heads over tp.
+
+    The quantized layout shards each half's (data, scale) pair the same
+    way — both lead with the Hkv axis — so the spec tree mirrors the
+    cache pytree (ops/paged_attention.py int8 layout)."""
+    if quantized:
+        half = (P(AXIS_TP, None, None, None), P(AXIS_TP, None))
+        return (half, half)
     spec = P(AXIS_TP, None, None, None)
     return (spec, spec)
 

@@ -46,6 +46,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from vllm_omni_tpu.core.scheduler import ScheduledRequest, SchedulerOutput
+from vllm_omni_tpu.kvcache.quant import (
+    dequantize_payload,
+    is_quant_payload,
+    payload_seq_len,
+)
 from vllm_omni_tpu.logger import init_logger
 from vllm_omni_tpu.models.common import transformer as tfm
 from vllm_omni_tpu.ops.autotune import auto_ragged_blocks
@@ -201,7 +206,20 @@ class ARModelRunner:
         unified_batching: bool = True,  # retired knob: always unified
         max_num_batched_tokens: int = 2048,  # sizes the token buckets
         deterministic_decode: bool = False,  # pin decode batches to one bucket
+        kv_cache_dtype: str = "auto",  # auto | bf16 | int8 resident layout
     ):
+        if kv_cache_dtype not in ("auto", "bf16", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {kv_cache_dtype!r} "
+                "(expected auto, bf16, or int8)")
+        # int8 = the quantized resident layout (per-(head, page) absmax
+        # scales, ops/paged_attention.py); auto/bf16 keep the dense
+        # layout in the runner ``dtype``.  The flag is part of every
+        # dispatch cache key: the quantized executables are a distinct
+        # jit variant and warmup must prove it compiled (OL11).
+        self._kv_quant = kv_cache_dtype == "int8"
+        self.kv_cache_dtype = ("int8" if self._kv_quant
+                               else str(jnp.dtype(dtype)))
         self.async_scheduling = bool(async_scheduling)
         self.deterministic_decode = bool(deterministic_decode)
         self.mesh = mesh
@@ -241,8 +259,10 @@ class ARModelRunner:
         _, dma_slots = auto_ragged_blocks(
             head_dim=cfg.head_dim, page_size=page_size,
             group=max(cfg.num_heads // max(cfg.num_kv_heads, 1), 1),
-            kv_itemsize=jnp.dtype(dtype).itemsize,
-            q_itemsize=jnp.dtype(dtype).itemsize)
+            kv_itemsize=1 if self._kv_quant else jnp.dtype(dtype).itemsize,
+            q_itemsize=jnp.dtype(dtype).itemsize,
+            quantized=self._kv_quant,
+            num_pages=num_pages if self._kv_quant else 0)
         # the packer's segment alignment is pinned to the kernel's
         # packing contract (decode-heavy serving keeps the autotuner at
         # the same minimum tile; plumb the block through forward_unified
@@ -288,27 +308,35 @@ class ARModelRunner:
         self._jit_seen: set[tuple] = set()
         self.kv_caches = init_kv_cache(
             cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
-            cfg.head_dim, dtype,
+            cfg.head_dim, dtype, quantized=self._kv_quant,
         )
         # device-memory ledger components (introspection/memory_ledger):
         # static buffer sizes, summed ONCE from array metadata — .nbytes
         # never syncs the device.  Spec-decode verify buffers are added
-        # by set_draft_fn.
+        # by set_draft_fn.  The tree walk counts int8 page bodies AND
+        # their scale arrays, so kv_pages is exact under either layout.
         self._weights_bytes = sum(
             getattr(x, "nbytes", 0)
             for x in jax.tree_util.tree_leaves(params))
-        self._kv_bytes = sum(k.nbytes + v.nbytes
-                             for k, v in self.kv_caches)
+        self._kv_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(self.kv_caches))
         self._spec_bytes = 0
         if mesh is not None:
             from jax.sharding import NamedSharding
 
             from vllm_omni_tpu.parallel.sharding import ar_kv_cache_spec
 
-            k_spec, v_spec = ar_kv_cache_spec()
+            k_spec, v_spec = ar_kv_cache_spec(quantized=self._kv_quant)
+
+            def _put(half, spec):
+                if isinstance(half, tuple):
+                    return tuple(
+                        jax.device_put(a, NamedSharding(mesh, s))
+                        for a, s in zip(half, spec))
+                return jax.device_put(half, NamedSharding(mesh, spec))
+
             self.kv_caches = [
-                (jax.device_put(k, NamedSharding(mesh, k_spec)),
-                 jax.device_put(v, NamedSharding(mesh, v_spec)))
+                (_put(k, k_spec), _put(v, v_spec))
                 for k, v in self.kv_caches
             ]
         self._step = 0
@@ -427,7 +455,9 @@ class ARModelRunner:
             )
 
             pspecs = ar_param_specs_tree(params)
-            kv_specs = [ar_kv_cache_spec()] * cfg.num_layers
+            kv_specs = ([ar_kv_cache_spec(quantized=True)] * cfg.num_layers
+                        if self._kv_quant
+                        else [ar_kv_cache_spec()] * cfg.num_layers)
             rep = P()
 
             def wrap(f, n_rest, out_keys):
@@ -624,8 +654,9 @@ class ARModelRunner:
 
         logger.info(
             "ragged blocks: token_block=%d dma_slots=%d (head_dim=%d "
-            "page_size=%d) — ops/autotune.py", self._token_block,
-            self._dma_slots, self.cfg.head_dim, self.page_size)
+            "page_size=%d kv_cache_dtype=%s) — ops/autotune.py picks "
+            "per layout", self._token_block, self._dma_slots,
+            self.cfg.head_dim, self.page_size, self.kv_cache_dtype)
 
         def pos_shape(b):
             return (b, 3) if self.use_mrope else (b,)
@@ -650,7 +681,7 @@ class ARModelRunner:
                                  ("dispatch_lp", self._decode_lp_fn)):
                     note(f"precompile {kind} b={b}")
                     _, self.kv_caches = warm(
-                        kind, (b,), lambda fn=fn: fn(
+                        kind, (b, self._kv_quant), lambda fn=fn: fn(
                             self.params, zeros_b, self.kv_caches,
                             jnp.zeros(pos_shape(b), jnp.int32),
                             jnp.full((b,), -1, jnp.int32), tables,
@@ -672,7 +703,7 @@ class ARModelRunner:
             pos = (jnp.zeros((3, t_pad), jnp.int32) if self.use_mrope
                    else jnp.zeros((t_pad,), jnp.int32))
             _, self.kv_caches = warm(
-                "unified", (t_pad, v, False, False),
+                "unified", (t_pad, v, False, False, self._kv_quant),
                 lambda: self._unified_fn(
                     self.params, jnp.zeros((t_pad,), jnp.int32),
                     self.kv_caches, pos,
@@ -962,9 +993,12 @@ class ARModelRunner:
             "unified",
             # the deepstack LEVEL COUNT is part of the operand shape —
             # omitting it would misclassify a real mid-traffic compile
-            # as a cache hit and blind the compile-stall introspection
+            # as a cache hit and blind the compile-stall introspection;
+            # the KV layout flag keeps the int8 executables a distinct
+            # signature family (quantized caches are a different pytree)
             (asm.t_pad, self._spec_v, asm.embeds is not None,
-             asm.deepstack.shape[0] if asm.deepstack is not None else 0),
+             asm.deepstack.shape[0] if asm.deepstack is not None else 0,
+             self._kv_quant),
             lambda: self._unified_fn(
                 self.params, token_ids, self.kv_caches,
                 jnp.asarray(asm.positions), jnp.asarray(asm.slots),
@@ -1028,7 +1062,7 @@ class ARModelRunner:
         tensors = self._sampling_tensors(key, params_list, salts)
         self._note_padding(len(scheds), b)
         outs, self.kv_caches = self._run_jit(
-            kind, (b,), lambda: fn(
+            kind, (b, self._kv_quant), lambda: fn(
                 self.params, token_ids, self.kv_caches,
                 jnp.asarray(positions), jnp.asarray(slots),
                 jnp.asarray(tables), jnp.asarray(ctx),
@@ -1329,20 +1363,33 @@ class ARModelRunner:
 
     # -------------------------------------------------------- kv injection
     def inject_kv(self, block_ids: list[int], payload: list) -> int:
-        """Scatter per-layer dense [Hkv, seq_len, D] KV into the given
-        pages — the receive half of the transfer manager (reference:
+        """Scatter a per-layer KV payload into the given pages — the
+        receive half of the transfer manager (reference:
         omni_connectors/kv_transfer_manager.py:100+ receive path, which r1
         lacked: extracted KV had nowhere to land) and of the kvcache
         tier-restore path (docs/kv_cache.md).  The whole payload ships
         host->device as ONE pytree transfer — a per-layer asarray walk
         was 2 transfers per layer on the ~0.15 GB/s tunnel.  Returns
-        seq_len."""
+        seq_len.
+
+        Payloads arrive dense ([Hkv, seq, D]) or quantized (the
+        kvcache/quant.py wire layout).  Quantized into an int8 pool is
+        an EXACT page set (data bytes + per-page scales land verbatim —
+        the cross-path no-double-quantize contract); quantized into a
+        dense pool dequantizes first; dense into an int8 pool quantizes
+        through the write op's shared rounding."""
         if len(payload) != len(self.kv_caches):
             raise ValueError(
                 f"KV payload has {len(payload)} layers, cache has "
                 f"{len(self.kv_caches)}"
             )
-        seq_len = int(payload[0][0].shape[1])
+        quant_in = is_quant_payload(payload)
+        if quant_in and not self._kv_quant:
+            payload = dequantize_payload(payload, self.page_size)
+            quant_in = False
+        seq_len = payload_seq_len(payload)
+        if quant_in:
+            return self._inject_kv_exact(block_ids, payload, seq_len)
         pos = np.arange(seq_len)
         slots = jnp.asarray(
             np.asarray(block_ids, np.int64)[pos // self.page_size]
@@ -1361,22 +1408,83 @@ class ARModelRunner:
         self.kv_caches = new_caches
         return seq_len
 
+    def _inject_kv_exact(self, block_ids: list[int], payload: list,
+                         seq_len: int) -> int:
+        """int8 wire payload -> int8 pool: page-granular set of data
+        bytes and scales, bit-exact (no re-quantization).  The run's
+        trailing partial page pads with zeros — those rows sit past
+        every context length, and the settled page scale stays valid
+        for later decode appends into the same page."""
+        ps = self.page_size
+        n_pages = min(len(block_ids), -(-seq_len // ps))
+        ids = jnp.asarray(block_ids[:n_pages], jnp.int32)
+        pad = n_pages * ps - seq_len
+
+        def to_pages(q):
+            a = np.asarray(q)[:, : n_pages * ps]
+            if pad:
+                a = np.pad(a, ((0, 0), (0, pad), (0, 0)))
+            return a.reshape(a.shape[0], n_pages, ps, a.shape[-1])
+
+        host = [((to_pages(kq), np.asarray(ks)[:, :n_pages]),
+                 (to_pages(vq), np.asarray(vs)[:, :n_pages]))
+                for (kq, ks), (vq, vs) in payload]
+        dev = jax.device_put(host)
+        new_caches = []
+        for (k_half, v_half), ((kp, ks), (vp, vs)) in zip(
+                self.kv_caches, dev):
+            kd, ksc = k_half
+            vd, vsc = v_half
+            new_caches.append((
+                (kd.at[:, ids].set(kp), ksc.at[:, ids].set(ks)),
+                (vd.at[:, ids].set(vp), vsc.at[:, ids].set(vs)),
+            ))
+        self.kv_caches = new_caches
+        return seq_len
+
     # -------------------------------------------------------- kv extraction
-    def extract_kv(self, block_ids: list[int], seq_len: int) -> list:
-        """Gather the pages holding ``seq_len`` tokens into dense per-layer
-        [Hkv, seq_len, D] arrays (device half of OmniKVTransferManager)."""
-        ids = jnp.asarray(block_ids, jnp.int32)
+    def _extract_layer_slices(self, ids, seq_len: int) -> list:
+        """Per-layer device slices for one page run.  Dense pools emit
+        [Hkv, seq_len, D] halves; int8 pools emit the quantized wire
+        layout ((data[:, :seq_len], page scales)) — the bytes leave the
+        device as stored, so a later inject restores them bit-exact."""
         slices = []
         for k_cache, v_cache in self.kv_caches:
-            k = k_cache[:, ids].reshape(k_cache.shape[0], -1, k_cache.shape[-1])
-            v = v_cache[:, ids].reshape(v_cache.shape[0], -1, v_cache.shape[-1])
-            slices.append((k[:, :seq_len], v[:, :seq_len]))
+            if isinstance(k_cache, tuple):
+                layer = []
+                for data, scale in (k_cache, v_cache):
+                    q = data[:, ids].reshape(
+                        data.shape[0], -1, data.shape[-1])
+                    layer.append((q[:, :seq_len], scale[:, ids]))
+                slices.append(tuple(layer))
+            else:
+                k = k_cache[:, ids].reshape(
+                    k_cache.shape[0], -1, k_cache.shape[-1])
+                v = v_cache[:, ids].reshape(
+                    v_cache.shape[0], -1, v_cache.shape[-1])
+                slices.append((k[:, :seq_len], v[:, :seq_len]))
+        return slices
+
+    @staticmethod
+    def _host_payload(slices: list) -> list:
+        return [tuple(
+            tuple(np.asarray(a) for a in half)
+            if isinstance(half, tuple) else np.asarray(half)
+            for half in layer) for layer in slices]
+
+    def extract_kv(self, block_ids: list[int], seq_len: int) -> list:
+        """Gather the pages holding ``seq_len`` tokens into a per-layer
+        payload (device half of OmniKVTransferManager): dense
+        [Hkv, seq_len, D] halves, or the kvcache/quant.py wire layout
+        when the pool is int8."""
+        ids = jnp.asarray(block_ids, jnp.int32)
+        slices = self._extract_layer_slices(ids, seq_len)
         # ONE transfer for the whole payload — 2 syncs per LAYER before
         # the first omnilint OL2 harvest (a 28-layer model paid 56
         # host round trips per extraction)
         # omnilint: disable=OL2
         payload = jax.device_get(slices)
-        return [(np.asarray(k), np.asarray(v)) for k, v in payload]
+        return self._host_payload(payload)
 
     def extract_kv_batch(self, specs: list[tuple[list[int], int]]
                          ) -> list[list]:
@@ -1388,16 +1496,8 @@ class ARModelRunner:
         all_slices = []
         for block_ids, seq_len in specs:
             ids = jnp.asarray(block_ids, jnp.int32)
-            slices = []
-            for k_cache, v_cache in self.kv_caches:
-                k = k_cache[:, ids].reshape(
-                    k_cache.shape[0], -1, k_cache.shape[-1])
-                v = v_cache[:, ids].reshape(
-                    v_cache.shape[0], -1, v_cache.shape[-1])
-                slices.append((k[:, :seq_len], v[:, :seq_len]))
-            all_slices.append(slices)
+            all_slices.append(self._extract_layer_slices(ids, seq_len))
         # omnilint: disable=OL2 - ONE batched transfer for every
         # payload this step parks (the whole point of the batch API)
         payloads = jax.device_get(all_slices)
-        return [[(np.asarray(k), np.asarray(v)) for k, v in sl]
-                for sl in payloads]
+        return [self._host_payload(sl) for sl in payloads]
